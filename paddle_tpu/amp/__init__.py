@@ -1,0 +1,23 @@
+"""paddle.amp parity (python/paddle/amp/__init__.py): auto_cast + GradScaler +
+white/black lists. On TPU, level 'O1' maps to bfloat16 autocast (no scaler needed,
+but the scaler API is kept for parity; it is numerically a no-op pass-through when
+loss scaling is disabled)."""
+from .auto_cast import amp_guard, auto_cast, white_list, black_list  # noqa: F401
+from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
+from . import debugging  # noqa: F401
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16", master_weight=None, save_dtype=None):
+    """paddle.amp.decorate parity: O2 casts model params to the low-precision dtype."""
+    from ..core import dtype as dtype_mod
+
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        d = dtype_mod.convert_dtype(dtype)
+        for m in model_list:
+            for p in m.parameters():
+                p._data = p._data.astype(d)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers

@@ -1,0 +1,59 @@
+"""AMP autocast.
+
+Reference parity: paddle/fluid/imperative/amp_auto_cast.cc (white/black op lists, input
+casting in Tracer::TraceOp) + python/paddle/fluid/dygraph/amp/auto_cast.py:91 amp_guard.
+
+TPU-native design: instead of per-op kernel-dtype choice, the autocast context installs a
+dispatch-level input cast: ops in the white list (matmul/conv — the MXU ops) run in
+bfloat16 (or float16), black-list ops (softmax/log/reductions in loss) stay float32.
+Hooked via core.dispatch by wrapping the op's tensor inputs.
+"""
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+
+# operators/amp lists parity (imperative/amp_auto_cast.cc white/black lists)
+white_list = {"matmul", "conv2d", "conv1d", "conv3d", "linear", "einsum", "bmm", "mm", "mv", "addmm"}
+black_list = {"exp", "log", "softmax", "log_softmax", "cross_entropy", "mean", "sum", "cosh", "sinh", "softmax_with_cross_entropy"}
+
+_STATE = {"enabled": False, "dtype": None, "level": "O1", "custom_white": set(), "custom_black": set()}
+
+
+def amp_state():
+    return _STATE
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1", dtype="bfloat16"):
+    old = dict(_STATE)
+    _STATE["enabled"] = enable
+    _STATE["dtype"] = dtype_mod.convert_dtype(dtype)
+    _STATE["level"] = level
+    _STATE["custom_white"] = set(custom_white_list or ())
+    _STATE["custom_black"] = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        _STATE.update(old)
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_inputs(op_name, vals):
+    """Called by ops that participate in autocast (linear/conv/matmul paths)."""
+    if not _STATE["enabled"]:
+        return vals
+    name = op_name
+    if name in _STATE["custom_black"] or (name in black_list and name not in _STATE["custom_white"]):
+        return [v.astype(jnp.float32) if v.dtype in (jnp.bfloat16, jnp.float16) else v for v in vals]
+    if _STATE["level"] == "O2" or name in white_list or name in _STATE["custom_white"]:
+        d = _STATE["dtype"]
+        return [v.astype(d) if jnp.issubdtype(v.dtype, jnp.floating) else v for v in vals]
+    return vals
+
+
+def amp_dtype():
+    return _STATE["dtype"] if _STATE["enabled"] else None

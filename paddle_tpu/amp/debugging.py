@@ -1,0 +1,34 @@
+"""Numeric debugging: FLAGS_check_nan_inf parity
+(framework/details/nan_inf_utils_detail.cc — after-kernel NaN/Inf scan and abort).
+TPU-native: a dispatch-level post-op check toggled by enable_operator_stats_collection /
+the check_nan_inf flag, plus jax.debug_nans passthrough."""
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from .. import flags
+
+
+class NaNInfError(FloatingPointError):
+    pass
+
+
+def check_numerics(tensor, op_name="op"):
+    import numpy as np
+
+    v = tensor._data if hasattr(tensor, "_data") else tensor
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        if not bool(jnp.all(jnp.isfinite(v))):
+            raise NaNInfError(f"NaN/Inf found in output of {op_name}")
+    return tensor
+
+
+@contextlib.contextmanager
+def enable_check_nan_inf():
+    flags.set_flags({"check_nan_inf": True})
+    try:
+        with jax.debug_nans(True):
+            yield
+    finally:
+        flags.set_flags({"check_nan_inf": False})

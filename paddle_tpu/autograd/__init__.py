@@ -1,0 +1,11 @@
+"""paddle.autograd parity (python/paddle/autograd/__init__.py): backward, grad,
+no_grad, PyLayer (custom VJP)."""
+from ..core.tape import no_grad  # noqa: F401
+from .functional import grad  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    from ..core.tape import backward as _b
+
+    _b(tensors, grad_tensors, retain_graph)

@@ -1,0 +1,30 @@
+"""paddle.grad parity (python/paddle/fluid/dygraph/base.py grad() — the
+PartialGradEngine path, imperative/partial_grad_engine.cc)."""
+from ..core.tape import backward as _tape_backward
+from ..core.tensor import Tensor
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         only_inputs=True, allow_unused=False, no_grad_vars=None):
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    # save/restore .grad so paddle.grad doesn't pollute accumulated grads
+    saved = [t.grad for t in inputs]
+    saved_retain = [t.retain_grads for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t.retain_grads = True
+    retain = retain_graph if retain_graph is not None else create_graph
+    _tape_backward(list(outputs), grad_outputs, retain_graph=bool(retain))
+    grads = []
+    for t, old, old_r in zip(inputs, saved, saved_retain):
+        g = t.grad
+        if g is None and not allow_unused:
+            raise RuntimeError("a gradient is None; pass allow_unused=True to permit it")
+        grads.append(g)
+        t.grad = old
+        t.retain_grads = old_r
+    return grads

@@ -1,0 +1,71 @@
+"""PyLayer: user-defined forward/backward (python/paddle/autograd/py_layer.py parity,
+imperative/py_layer_fwd.h). TPU-native: the backward staticmethod becomes the recorded
+pullback on the tape."""
+from ..core.tape import Node, global_tape
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self._attrs = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    def __setattr__(self, k, v):
+        if k in ("_saved", "_attrs"):
+            object.__setattr__(self, k, v)
+        else:
+            object.__setattr__(self, k, v)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tape = global_tape()
+        with tape.pause():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+        diff_inputs = [a for a in args if isinstance(a, Tensor) and not a.stop_gradient]
+        if tape.enabled and diff_inputs:
+            input_positions = [i for i, a in enumerate(args) if isinstance(a, Tensor) and not a.stop_gradient]
+
+            def pullback(cot_list):
+                gs = [Tensor(c, stop_gradient=True) for c in cot_list]
+                with tape.pause():
+                    in_grads = cls.backward(ctx, *gs)
+                if not isinstance(in_grads, (tuple, list)):
+                    in_grads = [in_grads]
+                # map backward outputs (one per forward tensor arg) to diff inputs
+                tensor_args = [a for a in args if isinstance(a, Tensor)]
+                out_map = dict(zip((id(a) for a in tensor_args), in_grads))
+                return tuple(
+                    (out_map.get(id(t))._data if out_map.get(id(t)) is not None else None)
+                    for t in diff_inputs
+                )
+
+            for o in outs:
+                o.stop_gradient = False
+            node = Node(diff_inputs, outs, pullback)
+            for o in outs:
+                o._node = node
+            tape.record(node)
+        return out if multi else outs[0]

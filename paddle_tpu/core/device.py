@@ -1,0 +1,128 @@
+"""Device/Place abstraction.
+
+Reference parity: paddle/fluid/platform/place.h:26-103 (CPUPlace/CUDAPlace/XPUPlace +
+boost::variant Place) and DeviceContextPool (platform/device_context.h:695).
+TPU-native design: a Place is a thin view over a jax.Device; there is no DeviceContext /
+stream management — XLA owns scheduling. `set_device` picks the default device used by
+tensor-creation ops (jax.default_device).
+"""
+import jax
+
+
+class Place:
+    """Base place. Equality is by device kind + index."""
+
+    kind = "undefined"
+
+    def __init__(self, device_id=0):
+        self._device_id = int(device_id)
+
+    def get_device_id(self):
+        return self._device_id
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.kind == other.kind
+            and self._device_id == other._device_id
+        )
+
+    def __hash__(self):
+        return hash((self.kind, self._device_id))
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self._device_id})"
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _kind_of(d) == self.kind]
+        if not devs:
+            # fall back to CPU host devices
+            devs = jax.devices("cpu")
+        return devs[min(self._device_id, len(devs) - 1)]
+
+
+class CPUPlace(Place):
+    kind = "cpu"
+
+
+class TPUPlace(Place):
+    kind = "tpu"
+
+
+class CUDAPlace(Place):  # accepted for API compat; maps to the accelerator if present
+    kind = "tpu"
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+class XPUPlace(TPUPlace):
+    pass
+
+
+def _kind_of(jdev):
+    plat = jdev.platform.lower()
+    if plat in ("tpu", "axon"):
+        return "tpu"
+    if plat in ("gpu", "cuda", "rocm"):
+        return "gpu"
+    return "cpu"
+
+
+_CURRENT = [None]
+
+
+def _default_place():
+    for d in jax.devices():
+        if _kind_of(d) == "tpu":
+            return TPUPlace(0)
+    return CPUPlace(0)
+
+
+def set_device(device):
+    """paddle.set_device('tpu'|'cpu'|'tpu:0'|'gpu') parity
+    (python/paddle/fluid/framework.py _current_expected_place)."""
+    if isinstance(device, Place):
+        _CURRENT[0] = device
+        return device
+    name = str(device).lower()
+    idx = 0
+    if ":" in name:
+        name, idx_s = name.split(":", 1)
+        idx = int(idx_s)
+    if name in ("tpu", "gpu", "cuda", "xpu", "npu"):
+        place = TPUPlace(idx)
+    elif name == "cpu":
+        place = CPUPlace(idx)
+    else:
+        raise ValueError(f"Unknown device {device!r}")
+    _CURRENT[0] = place
+    return place
+
+
+def get_device():
+    p = current_place()
+    return f"{p.kind}:{p.get_device_id()}"
+
+
+def current_place():
+    if _CURRENT[0] is None:
+        _CURRENT[0] = _default_place()
+    return _CURRENT[0]
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def device_count():
+    return len(jax.devices())

@@ -1,0 +1,95 @@
+"""Op dispatch: the Tracer::TraceOp equivalent.
+
+Reference parity: paddle/fluid/imperative/tracer.cc:132 (TraceOp — runs the kernel, then
+CreateGradOpNode layer.cc:445 if any input requires grad) and the generated
+core.ops.<op> fast path (pybind/op_function_generator.cc:490).
+
+TPU-native design: one generic `apply(fn, *args, **kwargs)` replaces 494 generated
+bindings. `fn` is a pure jnp function; differentiable Tensor args are functionalized and
+run through `jax.vjp` so the pullback (XLA-derived grad) lands on the tape. Non-floating
+inputs and stop_gradient inputs are closed over as constants.
+"""
+import jax
+
+from . import dtype as dtype_mod
+from .tape import Node, global_tape
+from .tensor import Tensor
+
+
+def _needs_grad(t):
+    return (not t.stop_gradient) and dtype_mod.is_floating(t.dtype)
+
+
+def apply(fn, *args, n_outputs=None, **kwargs):
+    """Run `fn` over the raw values of Tensor args; tape a vjp node if needed.
+
+    Only Tensor positional args participate in autodiff. Returns Tensor or tuple of
+    Tensors mirroring fn's output structure (tuple/list -> tuple).
+    """
+    tape = global_tape()
+    diff_idx = []
+    diff_tensors = []
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor) and _needs_grad(a):
+            diff_idx.append(i)
+            diff_tensors.append(a)
+
+    record = tape.enabled and bool(diff_tensors)
+
+    def pure(*vals):
+        call = list(args)
+        for j, i in enumerate(diff_idx):
+            call[i] = vals[j]
+        call = [c._data if isinstance(c, Tensor) else c for c in call]
+        return fn(*call, **kwargs)
+
+    if record:
+        out, vjp_fn = jax.vjp(pure, *[t._data for t in diff_tensors])
+    else:
+        out = pure(*[t._data for t in diff_tensors])
+
+    multi = isinstance(out, (tuple, list))
+    raw_outs = list(out) if multi else [out]
+    out_tensors = []
+    for o in raw_outs:
+        t = Tensor.__new__(Tensor)
+        t._data = o
+        t.stop_gradient = not record
+        t.grad = None
+        t._node = None
+        t.name = ""
+        t.persistable = False
+        t.retain_grads = False
+        t._hooks = None
+        out_tensors.append(t)
+
+    if record:
+        def pullback(cot_list, _vjp=vjp_fn, _multi=multi):
+            return _vjp(tuple(cot_list) if _multi else cot_list[0])
+
+        node = Node(diff_tensors, out_tensors, pullback)
+        for t in out_tensors:
+            t._node = node
+        tape.record(node)
+
+    if multi:
+        return tuple(out_tensors)
+    return out_tensors[0]
+
+
+def apply_inplace(fn, target, *args, **kwargs):
+    """In-place op: computes fn and rebinds target._data, keeping grad flow.
+
+    Mirrors paddle inplace ops (e.g. add_, scale_); TensorInplaceVersion
+    (framework/tensor.h:77) bumping is unnecessary — the tape holds the old value in the
+    vjp residuals, so inplace rebinding is always autograd-safe here.
+    """
+    out = apply(fn, target, *args, **kwargs)
+    target._data = out._data
+    target._node = out._node
+    if out._node is not None:
+        # make the recorded node point at the *target* so future grads flow
+        idx = out._node.outputs.index(out)
+        out._node.outputs[idx] = target
+        target.stop_gradient = out.stop_gradient
+    return target

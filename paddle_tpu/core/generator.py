@@ -1,0 +1,61 @@
+"""RNG generator: paddle global-seed facade over explicit JAX PRNG keys.
+
+Reference parity: paddle/fluid/framework/generator.cc (per-device seeded Generator feeding
+dropout/random ops); python/paddle/framework/random.py (paddle.seed).
+TPU-native design: a Generator owns a jax PRNG key; every draw splits the key. Under a jit
+trace, drawing from the *global* generator would bake a constant key into the compiled
+program, so traced code paths (to_static / Model.fit static mode) must thread keys
+explicitly — `fold_in(step)` is provided for that; the eager path uses the global state.
+"""
+import time
+
+import jax
+import numpy as np
+
+
+class Generator:
+    def __init__(self, seed=None):
+        if seed is None:
+            seed = np.uint32(int(time.time() * 1e6) & 0xFFFFFFFF)
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        self._offset = 0
+
+    def manual_seed(self, seed):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        self._offset = 0
+        return self
+
+    def seed(self):
+        return self._seed
+
+    def initial_seed(self):
+        return self._seed
+
+    def split(self):
+        """Return a fresh subkey, advancing internal state."""
+        self._key, sub = jax.random.split(self._key)
+        self._offset += 1
+        return sub
+
+    def fold_in(self, data):
+        """Pure derivation of a key from the base seed — safe under jit tracing."""
+        return jax.random.fold_in(jax.random.key(self._seed), data)
+
+
+_DEFAULT = Generator(0)
+
+
+def default_generator():
+    return _DEFAULT
+
+
+def seed(s):
+    """paddle.seed parity."""
+    _DEFAULT.manual_seed(s)
+    return _DEFAULT
+
+
+def get_rng_key():
+    return _DEFAULT.split()

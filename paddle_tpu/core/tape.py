@@ -1,0 +1,146 @@
+"""Eager autograd tape.
+
+Reference parity: paddle/fluid/imperative/ — Tracer::TraceOp (tracer.cc:132) records a grad
+node per op; BasicEngine (basic_engine.cc:39,265) runs the queue-driven reverse walk;
+GradientAccumulator (gradient_accumulator.h:27) sums multi-consumer grads.
+
+TPU-native design: instead of per-op hand-written grad kernels, every recorded node stores
+the `jax.vjp` pullback of the pure function that produced it, so backward is a reverse walk
+calling pullbacks — XLA differentiates each op. The tape is global, append-only, and cleared
+after `backward()` (retain_graph semantics supported). Under `no_grad()` or `pause()`
+nothing is recorded, which is also how jit-traced (to_static) code avoids taping.
+"""
+import contextlib
+
+import jax
+
+
+class Node:
+    __slots__ = ("inputs", "outputs", "pullback", "alive")
+
+    def __init__(self, inputs, outputs, pullback):
+        self.inputs = inputs      # list[Tensor] (only differentiable tensor args)
+        self.outputs = outputs    # list[Tensor]
+        self.pullback = pullback  # vjp function: cotangents-tuple -> input cotangents
+        self.alive = True
+
+
+class Tape:
+    def __init__(self):
+        self.nodes = []
+        self._paused = 0
+
+    @property
+    def enabled(self):
+        return self._paused == 0
+
+    def record(self, node):
+        self.nodes.append(node)
+
+    def clear(self):
+        self.nodes.clear()
+
+    @contextlib.contextmanager
+    def pause(self):
+        self._paused += 1
+        try:
+            yield
+        finally:
+            self._paused -= 1
+
+
+_TAPE = Tape()
+
+
+def global_tape():
+    return _TAPE
+
+
+def no_grad():
+    """paddle.no_grad parity (python/paddle/fluid/dygraph/base.py no_grad)."""
+    return _TAPE.pause()
+
+
+def is_grad_enabled():
+    return _TAPE.enabled
+
+
+def _zeros_like_val(v):
+    import jax.numpy as jnp
+
+    return jnp.zeros_like(v)
+
+
+def backward(loss_tensors, grad_tensors=None, retain_graph=False):
+    """Run reverse accumulation from `loss_tensors`.
+
+    Mirrors BasicEngine::Execute (imperative/basic_engine.cc:265): walk recorded nodes in
+    reverse creation order; a node fires if any of its outputs has a pending cotangent;
+    input cotangents accumulate into `Tensor.grad` for leaves and into pending buffers for
+    interior tensors.
+    """
+    import jax.numpy as jnp
+
+    if not isinstance(loss_tensors, (list, tuple)):
+        loss_tensors = [loss_tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(loss_tensors)
+    if not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    # pending cotangents keyed by id(tensor); keep tensor refs alive alongside
+    pending = {}
+
+    def add_pending(t, g):
+        k = id(t)
+        if k in pending:
+            pending[k] = (t, pending[k][1] + g)
+        else:
+            pending[k] = (t, g)
+
+    for t, g in zip(loss_tensors, grad_tensors):
+        if g is None:
+            gval = jnp.ones_like(t._data)
+        else:
+            gval = g._data if hasattr(g, "_data") else jnp.asarray(g)
+        add_pending(t, gval)
+
+    for node in reversed(_TAPE.nodes):
+        if not node.alive:
+            continue
+        outs_g = []
+        fired = False
+        for o in node.outputs:
+            entry = pending.get(id(o))
+            if entry is not None:
+                outs_g.append(entry[1])
+                fired = True
+            else:
+                outs_g.append(_zeros_like_val(o._data))
+        if not fired:
+            continue
+        # consume the outputs' pending cotangents — an in-place op aliases its output
+        # tensor with an earlier node's output, so leaving them would double-count
+        for o in node.outputs:
+            pending.pop(id(o), None)
+        cots = node.pullback(outs_g)  # dispatch wraps vjp_fn to take a list
+        for inp, cot in zip(node.inputs, cots):
+            if cot is None:
+                continue
+            if getattr(cot, "dtype", None) is not None and str(cot.dtype) == "float0":
+                continue
+            if inp.stop_gradient:
+                continue
+            if inp._node is None:
+                # leaf: accumulate into .grad (GradientAccumulator semantics)
+                inp._accumulate_grad(cot)
+            else:
+                add_pending(inp, cot)
+                # also expose interior grads if user asked (retain_grads)
+                if getattr(inp, "retain_grads", False):
+                    inp._accumulate_grad(cot)
+        if not retain_graph:
+            node.alive = False
+
+    if not retain_graph:
+        _TAPE.clear()

@@ -1,0 +1,295 @@
+"""Eager Tensor: the dygraph VarBase equivalent.
+
+Reference parity: paddle/fluid/imperative/layer.h:65 (VarBase — data + grad var +
+stop_gradient + hooks), python/paddle/fluid/dygraph/math_op_patch.py (operator overloads),
+varbase_patch_methods.py:136 (backward()).
+
+TPU-native design: a Tensor wraps a jax.Array (which may be a tracer inside jit — the same
+class flows through eager and traced code). Ops are pure jnp functions run through
+`apply()`, which records a vjp pullback on the global tape when grads are needed. In-place
+ops rebind `_data` (functional under the hood, mutable at the API).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtype_mod
+from .device import current_place
+from .tape import Node, global_tape
+
+_SCALAR_TYPES = (int, float, bool, np.number, np.bool_)
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "grad",
+        "_node",
+        "name",
+        "persistable",
+        "retain_grads",
+        "_hooks",
+        "__weakref__",
+    )
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, jax.Array) and not _is_tracer(data):
+            data = np.asarray(data)
+            if dtype is None and data.dtype == np.float64:
+                data = data.astype(dtype_mod.get_default_dtype())
+            data = jnp.asarray(data, dtype=dtype_mod.convert_dtype(dtype))
+        elif dtype is not None and data.dtype != dtype_mod.convert_dtype(dtype):
+            data = data.astype(dtype_mod.convert_dtype(dtype))
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self.name = name or ""
+        self.persistable = False
+        self.retain_grads = False
+        self._hooks = None
+
+    # ---- basic properties ----------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    ndimension = ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        return current_place()
+
+    @property
+    def T(self):
+        from .dispatch import apply
+
+        return apply(lambda x: jnp.transpose(x), self)
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    def rank(self):
+        return self.ndim
+
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}, "
+            f"stop_gradient={self.stop_gradient},\n       {np.asarray(self._data)!r})"
+        )
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    def __dlpack__(self, stream=None):
+        return self._data.__dlpack__()
+
+    # ---- autograd ------------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from .tape import backward as _backward
+
+        _backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def retain_grad(self):
+        self.retain_grads = True
+
+    def detach(self):
+        t = Tensor.__new__(Tensor)
+        t._data = self._data
+        t.stop_gradient = True
+        t.grad = None
+        t._node = None
+        t.name = self.name
+        t.persistable = self.persistable
+        t.retain_grads = False
+        t._hooks = None
+        return t
+
+    def clone(self):
+        from .dispatch import apply
+
+        return apply(lambda x: x + jnp.zeros_like(x), self)
+
+    def register_hook(self, hook):
+        """VarBase hook parity (imperative/hooks.h); applied to .grad on accumulate."""
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+        return hook
+
+    def _accumulate_grad(self, cot):
+        if cot.dtype != self._data.dtype:
+            cot = cot.astype(self._data.dtype)
+        if self._hooks:
+            g = Tensor(cot, stop_gradient=True)
+            for h in self._hooks:
+                out = h(g)
+                if out is not None:
+                    g = out
+            cot = g._data
+        if self.grad is None:
+            self.grad = Tensor(cot, stop_gradient=True)
+        else:
+            self.grad = Tensor(self.grad._data + cot, stop_gradient=True)
+
+    # ---- mutation ------------------------------------------------------------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        value = jnp.asarray(value, dtype=self._data.dtype)
+        if tuple(value.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._data.shape}"
+            )
+        self._data = value
+
+    def copy_(self, other, *a):
+        self.set_value(other)
+        return self
+
+    def astype(self, dtype):
+        from .dispatch import apply
+
+        d = dtype_mod.convert_dtype(dtype)
+        return apply(lambda x: x.astype(d), self)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def to(self, *args, **kwargs):
+        # device moves are XLA-managed; only dtype casts are meaningful
+        for a in args:
+            try:
+                return self.astype(a)
+            except TypeError:
+                continue
+        return self
+
+    def cpu(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    # ---- indexing ------------------------------------------------------------
+    def __getitem__(self, idx):
+        from .dispatch import apply
+
+        idx = _unwrap_index(idx)
+        return apply(lambda x: x[idx], self)
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = self._data.at[idx].set(value)
+
+    # ---- python operators are patched in tensor/math_patch.py -----------------
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(np.asarray(idx))
+    return idx
+
+
+class ParamBase(Tensor):
+    """Trainable parameter (python/paddle/fluid/framework.py:5430 ParamBase)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "is_distributed", "spmd_spec")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+        self.spmd_spec = None  # PartitionSpec for tensor-parallel layers (TPU-native)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity (python/paddle/tensor/creation.py to_tensor)."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

@@ -1,0 +1,37 @@
+"""paddle.distributed parity (python/paddle/distributed/__init__.py)."""
+from . import collective  # noqa: F401
+from . import env  # noqa: F401
+from . import fleet  # noqa: F401
+from . import mesh  # noqa: F401
+from . import spmd  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    alltoall,
+    barrier,
+    broadcast,
+    destroy_process_group,
+    get_group,
+    get_rank,
+    get_world_size,
+    is_initialized,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    spmd_context,
+    in_spmd_context,
+    wait,
+)
+from .env import ParallelEnv  # noqa: F401
+from .parallel import DataParallel, init_parallel_env, spawn  # noqa: F401
+from .split import (  # noqa: F401
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    collect_spmd_specs,
+    split,
+)

@@ -1,0 +1,344 @@
+"""Collective communication API.
+
+Reference parity: python/paddle/distributed/collective.py (all_reduce, all_gather,
+broadcast, reduce, scatter, barrier, send/recv) backed by operators/collective/c_*
+NCCL kernels (c_allreduce_op.h:109-131 ring-id lookup + ncclAllReduce).
+
+TPU-native design: two execution contexts —
+ 1. SPMD (inside shard_map/pjit over a Mesh): collectives are jax.lax primitives on a
+    named axis; XLA schedules them on ICI. This is the performance path; "ring_id"/
+    "group" maps to the axis name.
+ 2. Eager multi-process: jax.experimental.multihost_utils (process_allgather etc.) over
+    the jax.distributed coordination service — functional parity for host-side code.
+Single-process eager collectives are identities (world_size == 1), matching the
+reference's behavior when nranks == 1 (collective ops skip NCCL).
+No stream-sync ops exist: XLA orders collectives (c_sync_*_stream -> no-op).
+"""
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import env as _env
+
+_SPMD_AXIS = []  # stack of axis names active under spmd_context
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """Process-group facade; on TPU a group IS a mesh axis name."""
+
+    def __init__(self, axis_name="dp", ranks=None, id=0):
+        self.axis_name = axis_name
+        self.ranks = ranks
+        self.id = id
+
+    @property
+    def nranks(self):
+        if self.ranks:
+            return len(self.ranks)
+        return _env.get_world_size()
+
+
+_DEFAULT_GROUP = Group("dp", id=0)
+
+
+def new_group(ranks=None, backend=None, axis_name=None):
+    return Group(axis_name or "dp", ranks=ranks, id=np.random.randint(1 << 30))
+
+
+@contextlib.contextmanager
+def spmd_context(axis_name):
+    """Mark that we are inside a shard_map/pmap body for `axis_name`."""
+    _SPMD_AXIS.append(axis_name)
+    try:
+        yield
+    finally:
+        _SPMD_AXIS.pop()
+
+
+def in_spmd_context():
+    return bool(_SPMD_AXIS)
+
+
+def _axis(group):
+    if group is not None and isinstance(group, Group):
+        return group.axis_name
+    if _SPMD_AXIS:
+        return _SPMD_AXIS[-1]
+    return "dp"
+
+
+def _unary_collective(x, spmd_fn, eager_multi_fn=None):
+    if isinstance(x, Tensor):
+        from ..core.dispatch import apply
+
+        if in_spmd_context():
+            return apply(spmd_fn, x)
+        if _env.get_world_size() > 1 and eager_multi_fn is not None:
+            return eager_multi_fn(x)
+        return x  # world_size == 1: identity
+    # raw array (used inside user shard_map bodies)
+    return spmd_fn(x)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    ax = _axis(group)
+
+    def spmd(v):
+        if op in (ReduceOp.SUM, "sum"):
+            return jax.lax.psum(v, ax)
+        if op in (ReduceOp.MAX, "max"):
+            return jax.lax.pmax(v, ax)
+        if op in (ReduceOp.MIN, "min"):
+            return jax.lax.pmin(v, ax)
+        if op in (ReduceOp.AVG, "avg"):
+            return jax.lax.pmean(v, ax)
+        if op in (ReduceOp.PROD, "prod"):
+            return jnp.exp(jax.lax.psum(jnp.log(v), ax))
+        raise ValueError(op)
+
+    def eager_multi(t):
+        from jax.experimental import multihost_utils
+
+        g = multihost_utils.process_allgather(t._data)
+        if op in (ReduceOp.SUM, "sum"):
+            red = g.sum(0)
+        elif op in (ReduceOp.MAX, "max"):
+            red = g.max(0)
+        elif op in (ReduceOp.MIN, "min"):
+            red = g.min(0)
+        elif op in (ReduceOp.AVG, "avg"):
+            red = g.mean(0)
+        else:
+            red = g.prod(0)
+        if isinstance(t, Tensor):
+            t._data = jnp.asarray(red)
+            return t
+        return Tensor(red)
+
+    out = _unary_collective(tensor, spmd, eager_multi)
+    if isinstance(tensor, Tensor) and isinstance(out, Tensor) and out is not tensor and in_spmd_context():
+        # paddle all_reduce is in-place on the tensor
+        tensor._data = out._data
+        tensor._node = out._node
+        return tensor
+    return out
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    ax = _axis(group)
+    if in_spmd_context():
+        from ..core.dispatch import apply
+
+        out = apply(lambda v: jax.lax.all_gather(v, ax), tensor)
+        if tensor_list is not None:
+            n = out.shape[0]
+            for i in range(n):
+                tensor_list.append(out[i])
+        return out
+    if _env.get_world_size() > 1:
+        from jax.experimental import multihost_utils
+
+        g = multihost_utils.process_allgather(tensor._data if isinstance(tensor, Tensor) else tensor)
+        outs = [Tensor(g[i]) for i in range(g.shape[0])]
+        if tensor_list is not None:
+            tensor_list.extend(outs)
+        return Tensor(jnp.asarray(g))
+    if tensor_list is not None:
+        tensor_list.append(tensor)
+    return tensor
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    ax = _axis(group)
+    from ..core.dispatch import apply
+
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        from ..tensor.manipulation import concat
+
+        src = concat(list(src), axis=0)
+    if in_spmd_context():
+        out = apply(lambda v: jax.lax.psum_scatter(v, ax, tiled=True), src)
+        if tensor is not None:
+            tensor._data = out._data
+            tensor._node = out._node
+            return tensor
+        return out
+    if tensor is not None and src is not tensor:
+        tensor._data = (src._data if isinstance(src, Tensor) else jnp.asarray(src))
+        return tensor
+    return src
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    if in_spmd_context():
+        from ..core.dispatch import apply
+
+        # broadcast = select rank src's value: all_gather then index (XLA optimizes)
+        return apply(lambda v: jax.lax.all_gather(v, ax)[src], tensor)
+    if _env.get_world_size() > 1:
+        from jax.experimental import multihost_utils
+
+        val = multihost_utils.broadcast_one_to_all(
+            tensor._data if isinstance(tensor, Tensor) else tensor,
+            is_source=_env.get_rank() == src,
+        )
+        if isinstance(tensor, Tensor):
+            tensor._data = jnp.asarray(val)
+            return tensor
+        return Tensor(val)
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # on mesh collectives a reduce == all_reduce (result replicated; dst keeps it)
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    if in_spmd_context():
+        from ..core.dispatch import apply
+        from ..tensor.manipulation import stack
+
+        stacked = stack(tensor_list, axis=0) if tensor_list else tensor
+
+        def fn(v):
+            idx = jax.lax.axis_index(ax)
+            return jax.lax.dynamic_index_in_dim(v, idx, axis=0, keepdims=False)
+
+        out = apply(fn, stacked)
+        if tensor is not None:
+            tensor._data = out._data
+            tensor._node = out._node
+            return tensor
+        return out
+    if tensor_list:
+        val = tensor_list[_env.get_rank() % len(tensor_list)]
+        tensor._data = val._data
+        return tensor
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    ax = _axis(group)
+    from ..core.dispatch import apply
+    from ..tensor.manipulation import stack
+
+    if in_spmd_context():
+        x = stack(list(in_tensor_list), axis=0) if isinstance(in_tensor_list, (list, tuple)) else in_tensor_list
+        out = apply(lambda v: jax.lax.all_to_all(v, ax, split_axis=0, concat_axis=0, tiled=False), x)
+        if out_tensor_list is not None:
+            for i in range(out.shape[0]):
+                out_tensor_list.append(out[i])
+        return out
+    if out_tensor_list is not None and isinstance(in_tensor_list, (list, tuple)):
+        out_tensor_list.extend(in_tensor_list)
+    return in_tensor_list
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """send_v2 parity. In SPMD, point-to-point is ppermute (used by pipeline)."""
+    ax = _axis(group)
+    if in_spmd_context():
+        from ..core.dispatch import apply
+
+        n = jax.lax.psum(1, ax)
+        return apply(lambda v: jax.lax.ppermute(v, ax, [(i, dst) for i in range(n)]), tensor)
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    if in_spmd_context():
+        from ..core.dispatch import apply
+
+        n = jax.lax.psum(1, ax)
+        out = apply(lambda v: jax.lax.ppermute(v, ax, [(src, i) for i in range(n)]), tensor)
+        tensor._data = out._data
+        tensor._node = out._node
+    return tensor
+
+
+def p2p_shift(x, axis_name, shift=1):
+    """Ring shift (ppermute) — the building block of ring attention and 1F1B."""
+    idx_pairs = None
+
+    def fn(v):
+        n = jax.lax.psum(1, axis_name)
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return jax.lax.ppermute(v, axis_name, perm)
+
+    if isinstance(x, Tensor):
+        from ..core.dispatch import apply
+
+        return apply(fn, x)
+    return fn(x)
+
+
+def barrier(group=None):
+    if in_spmd_context():
+        return
+    if _env.get_world_size() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def get_rank(group=None):
+    return _env.get_rank()
+
+
+def get_world_size(group=None):
+    return _env.get_world_size()
+
+
+def is_initialized():
+    return _env.is_initialized()
+
+
+def get_group(id=0):
+    return _DEFAULT_GROUP
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """c_sync_*_stream parity: XLA orders collectives — block for API compat."""
+    if isinstance(tensor, Tensor) and hasattr(tensor._data, "block_until_ready"):
+        tensor._data.block_until_ready()
+    return tensor
+
+
+def destroy_process_group(group=None):
+    pass
+
+
+# ---- SyncBatchNorm functional (used by nn.SyncBatchNorm under SPMD) -----------
+def sync_batch_norm(x, running_mean, running_var, weight, bias, training, momentum,
+                    epsilon, data_format):
+    from ..core.dispatch import apply
+
+    ax = _axis(None)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+
+    def fn(v, w, b):
+        m = jax.lax.pmean(jnp.mean(v, axis=reduce_axes), ax)
+        var = jax.lax.pmean(jnp.mean(v * v, axis=reduce_axes), ax) - m * m
+        out = (v - m.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+        return out * w.reshape(shape) + b.reshape(shape)
+
+    return apply(fn, x, weight, bias)

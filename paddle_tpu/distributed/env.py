@@ -1,0 +1,88 @@
+"""Distributed environment.
+
+Reference parity: the PADDLE_TRAINER_* env protocol (fleet/launch_utils.py:457-464) and
+ParallelEnv (fluid/dygraph/parallel.py:68); NCCL-id TCP bootstrap
+(platform/gen_comm_id_helper.cc:286) is replaced by jax.distributed.initialize's
+coordination service.
+"""
+import os
+
+import jax
+
+_INITIALIZED = [False]
+
+
+def get_rank():
+    if _INITIALIZED[0]:
+        return jax.process_index()
+    return int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", 0)))
+
+
+def get_world_size():
+    if _INITIALIZED[0]:
+        return jax.process_count()
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    if eps:
+        return len(eps.split(","))
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", 1)))
+
+
+def init_distributed(coordinator_address=None, num_processes=None, process_id=None):
+    """jax.distributed.initialize wrapper honoring the PADDLE_* env protocol."""
+    if _INITIALIZED[0]:
+        return
+    nproc = num_processes or get_world_size()
+    if nproc <= 1:
+        _INITIALIZED[0] = True
+        return
+    if coordinator_address is None:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        coordinator_address = eps[0] if eps and eps[0] else "127.0.0.1:12355"
+    pid = process_id if process_id is not None else get_rank()
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=nproc,
+        process_id=pid,
+    )
+    _INITIALIZED[0] = True
+
+
+def is_initialized():
+    return _INITIALIZED[0]
+
+
+class ParallelEnv:
+    """fluid/dygraph/parallel.py:68 ParallelEnv parity."""
+
+    def __init__(self):
+        self._rank = get_rank()
+        self._world_size = get_world_size()
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_LOCAL_RANK", self._rank))
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def nranks(self):
+        return self._world_size
+
+    @property
+    def device_id(self):
+        return self.local_rank
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []

@@ -1,0 +1,22 @@
+"""paddle.distributed.fleet parity (python/paddle/distributed/fleet/__init__.py)."""
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .fleet_base import Fleet, fleet  # noqa: F401
+from .role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker  # noqa: F401
+from . import metrics  # noqa: F401
+
+# module-level facade functions (fleet.init(...) style)
+init = fleet.init
+distributed_optimizer = fleet.distributed_optimizer
+distributed_model = fleet.distributed_model
+build_trainer = fleet.build_trainer
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_first_worker = fleet.is_first_worker
+is_worker = fleet.is_worker
+is_server = fleet.is_server
+barrier_worker = fleet.barrier_worker
+init_worker = fleet.init_worker
+init_server = fleet.init_server
+run_server = fleet.run_server
+stop_worker = fleet.stop_worker
+worker_endpoints = fleet.worker_endpoints

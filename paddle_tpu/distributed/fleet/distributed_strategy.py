@@ -1,0 +1,191 @@
+"""DistributedStrategy.
+
+Reference parity: paddle/fluid/framework/distributed_strategy.proto:25-169 (every
+parallelism toggle + nested *Config messages) and its Python property wrapper
+fleet/base/distributed_strategy.py. Protobuf replaced by a plain dataclass tree —
+same field names so user code ports 1:1.
+"""
+import copy
+import dataclasses
+from dataclasses import dataclass, field
+
+
+@dataclass
+class RecomputeConfig:  # proto:25-28
+    checkpoints: list = field(default_factory=list)
+    enable_offload: bool = False
+    checkpoint_shape: list = field(default_factory=list)
+
+
+@dataclass
+class ShardingConfig:  # proto:31-35
+    segment_broadcast_MB: float = 32.0
+    hybrid_dp: bool = False
+    sharding_degree: int = 8
+    sharding_stage: int = 2
+    mp_degree: int = 1
+    segment_anchors: list = field(default_factory=list)
+    gradient_merge_acc_step: int = 1
+    offload: bool = False
+
+
+@dataclass
+class AMPConfig:  # proto:37-49
+    init_loss_scaling: float = 32768.0
+    incr_every_n_steps: int = 1000
+    decr_every_n_nan_or_inf: int = 2
+    incr_ratio: float = 2.0
+    decr_ratio: float = 0.8
+    use_dynamic_loss_scaling: bool = True
+    custom_white_list: list = field(default_factory=list)
+    custom_black_list: list = field(default_factory=list)
+    custom_black_varnames: list = field(default_factory=list)
+    use_pure_fp16: bool = False
+    use_fp16_guard: bool = True
+    dtype: str = "bfloat16"  # TPU-native default
+
+
+@dataclass
+class LocalSGDConfig:  # proto:51-54
+    k_steps: int = 1
+    begin_step: int = 1
+
+
+@dataclass
+class AdaptiveLocalSGDConfig:  # proto:56-59
+    init_k_steps: int = 1
+    begin_step: int = 1
+
+
+@dataclass
+class GradientMergeConfig:  # proto:61-64
+    k_steps: int = 1
+    avg: bool = True
+
+
+@dataclass
+class DGCConfig:  # proto:66-70
+    rampup_begin_step: int = 0
+    rampup_step: int = 1
+    sparsity: list = field(default_factory=lambda: [0.999])
+
+
+@dataclass
+class LambConfig:  # proto:72-75
+    lamb_weight_decay: float = 0.01
+    exclude_from_weight_decay: list = field(default_factory=list)
+
+
+@dataclass
+class LarsConfig:
+    lars_coeff: float = 0.001
+    lars_weight_decay: float = 0.0005
+    epsilon: float = 0.0
+    exclude_from_weight_decay: list = field(default_factory=list)
+
+
+@dataclass
+class PipelineConfig:  # proto:120-124
+    micro_batch_size: int = 1
+    accumulate_steps: int = 1
+    schedule_mode: str = "1F1B"
+    pp_degree: int = 1
+
+
+@dataclass
+class AsyncConfig:  # proto:106-118
+    k_steps: int = -1
+    max_merge_var_num: int = 1
+    send_queue_size: int = 16
+    independent_recv_thread: bool = False
+    thread_pool_size: int = 1
+    send_wait_times: int = 1
+    runtime_split_send_recv: bool = False
+    launch_barrier: bool = True
+    heter_worker_device_guard: str = "cpu"
+    lr_decay_steps: int = 10
+
+
+@dataclass
+class HybridConfig:
+    dp_degree: int = -1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sep_degree: int = 1  # sequence parallel (beyond reference)
+
+
+@dataclass
+class TensorParallelConfig:
+    tensor_parallel_degree: int = 1
+    tensor_init_seed: int = -1
+
+
+class DistributedStrategy:
+    """fleet/base/distributed_strategy.py parity (proto:126-169 field set)."""
+
+    def __init__(self):
+        # execution/build (proto:84-104) — on TPU these are XLA's job; kept as inert
+        self.build_strategy = None
+        self.execution_strategy = None
+        # main toggles (proto:126-169)
+        self.amp = False
+        self.amp_configs = AMPConfig()
+        self.recompute = False
+        self.recompute_configs = RecomputeConfig()
+        self.sharding = False
+        self.sharding_configs = ShardingConfig()
+        self.pipeline = False
+        self.pipeline_configs = PipelineConfig()
+        self.localsgd = False
+        self.localsgd_configs = LocalSGDConfig()
+        self.adaptive_localsgd = False
+        self.adaptive_localsgd_configs = AdaptiveLocalSGDConfig()
+        self.dgc = False
+        self.dgc_configs = DGCConfig()
+        self.gradient_merge = False
+        self.gradient_merge_configs = GradientMergeConfig()
+        self.lars = False
+        self.lars_configs = LarsConfig()
+        self.lamb = False
+        self.lamb_configs = LambConfig()
+        self.a_sync = False
+        self.a_sync_configs = AsyncConfig()
+        self.hybrid_configs = HybridConfig()
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = TensorParallelConfig()
+        self.elastic = False  # proto:137 (flag only in reference too)
+        self.auto = False
+        self.nccl_comm_num = 1  # inert on TPU (no rings)
+        self.fuse_all_reduce_ops = True  # XLA fuses; inert
+        self.fuse_grad_size_in_MB = 32
+        self.fp16_allreduce = False
+        self.find_unused_parameters = False
+        self.heter_ccl_mode = False
+        self.cudnn_exhaustive_search = False  # no cuDNN on TPU
+        self.sync_nccl_allreduce = True
+        self.without_graph_optimization = False
+
+    def _set_config(self, holder, configs):
+        if dataclasses.is_dataclass(holder):
+            for k, v in configs.items():
+                if hasattr(holder, k):
+                    setattr(holder, k, v)
+        return holder
+
+    def __setattr__(self, name, value):
+        # accept dict assignment to *_configs like the reference property setters
+        if name.endswith("_configs") and isinstance(value, dict) and name in self.__dict__:
+            self._set_config(self.__dict__[name], value)
+            return
+        object.__setattr__(self, name, value)
+
+    def __deepcopy__(self, memo):
+        new = DistributedStrategy()
+        for k, v in self.__dict__.items():
+            setattr(new, k, copy.deepcopy(v, memo))
+        return new
+
+    def __repr__(self):
+        on = [k for k, v in self.__dict__.items() if v is True]
+        return f"DistributedStrategy(enabled={on})"

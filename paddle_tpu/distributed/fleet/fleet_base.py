@@ -1,0 +1,192 @@
+"""The fleet facade.
+
+Reference parity: python/paddle/distributed/fleet/base/fleet_base.py (Fleet:63,
+init:130, distributed_optimizer:598, minimize:1075) + the meta-optimizer composition
+(meta_optimizer_factory.py / strategy_compiler.py).
+
+TPU-native design: fleet.minimize / fleet.distributed_optimizer compose *functional*
+meta-optimizers: instead of rewriting a ProgramDesc (sharding_optimizer.py:161
+_split_program etc.), each enabled strategy contributes configuration to one
+SpmdTrainer (sharding -> state shardings; recompute -> jax.checkpoint;
+gradient_merge -> micro-batch scan; amp -> bf16 autocast; lamb/lars -> optimizer swap).
+The dygraph path (fleet.distributed_model) wraps DataParallel.
+"""
+from ... import optimizer as opt_mod
+from .. import env as _env
+from ..mesh import build_mesh, get_mesh, set_mesh
+from ..parallel import DataParallel, init_parallel_env
+from .distributed_strategy import DistributedStrategy
+from .role_maker import PaddleCloudRoleMaker
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker = None
+        self._strategy = None
+        self._is_collective = True
+        self._user_defined_optimizer = None
+        self._inited = False
+
+    # -- init ------------------------------------------------------------------
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        """fleet_base.py:130 parity."""
+        self._role_maker = role_maker or PaddleCloudRoleMaker(is_collective=is_collective)
+        self._is_collective = is_collective
+        self._strategy = strategy or DistributedStrategy()
+        if _env.get_world_size() > 1:
+            init_parallel_env()
+        self._apply_mesh()
+        self._inited = True
+        return self
+
+    def _apply_mesh(self):
+        """Build the hybrid mesh from strategy.hybrid_configs (dp/mp/pp/sharding)."""
+        import jax
+
+        hc = self._strategy.hybrid_configs if self._strategy else None
+        n = len(jax.devices())
+        if hc and (hc.mp_degree > 1 or hc.pp_degree > 1 or hc.sep_degree > 1):
+            mp, pp, sep = hc.mp_degree, hc.pp_degree, hc.sep_degree
+            dp = hc.dp_degree if hc.dp_degree > 0 else max(1, n // (mp * pp * sep))
+            shape = (dp, pp, sep, mp)
+            names = ("dp", "pp", "sp", "mp")
+            set_mesh(build_mesh(shape, names))
+        else:
+            set_mesh(build_mesh((n,), ("dp",)))
+
+    # -- info ------------------------------------------------------------------
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._role_maker.get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def barrier_worker(self):
+        from .. import collective as C
+
+        C.barrier()
+
+    # -- dygraph path ----------------------------------------------------------
+    def distributed_model(self, model):
+        """fleet_base.py distributed_model parity (dygraph DDP wrap)."""
+        if _env.get_world_size() > 1:
+            return DataParallel(model)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """fleet_base.py:598 parity — returns a wrapper whose minimize/step applies
+        the enabled meta-optimizer stack."""
+        if strategy is not None:
+            self._strategy = strategy
+        self._user_defined_optimizer = optimizer
+        return FleetOptimizer(optimizer, self._strategy, self)
+
+    # -- static-ish path: build a sharded trainer -------------------------------
+    def build_trainer(self, layer, optimizer=None, loss_fn=None, **overrides):
+        """Compose the meta-optimizer stack into one SpmdTrainer (TPU-native
+        equivalent of fleet.minimize graph rewriting)."""
+        from ..spmd import SpmdTrainer
+
+        s = self._strategy
+        optimizer = optimizer or self._user_defined_optimizer
+        kw = dict(sharding_stage=0, recompute=False, accumulate_steps=1)
+        if s.sharding:
+            kw["sharding_stage"] = s.sharding_configs.sharding_stage
+            if s.sharding_configs.gradient_merge_acc_step > 1:
+                kw["accumulate_steps"] = s.sharding_configs.gradient_merge_acc_step
+        if s.recompute:
+            kw["recompute"] = True
+        if s.gradient_merge:
+            kw["accumulate_steps"] = max(kw["accumulate_steps"], s.gradient_merge_configs.k_steps)
+        if s.pipeline:
+            kw["accumulate_steps"] = max(kw["accumulate_steps"], s.pipeline_configs.accumulate_steps)
+        if s.lamb and not isinstance(optimizer, opt_mod.Lamb):
+            optimizer = opt_mod.Lamb(
+                learning_rate=optimizer._lr,
+                lamb_weight_decay=s.lamb_configs.lamb_weight_decay,
+                parameters=optimizer._parameters,
+            )
+        if s.lars and not isinstance(optimizer, opt_mod.Lars):
+            optimizer = opt_mod.Lars(
+                learning_rate=optimizer._lr,
+                momentum=getattr(optimizer, "_momentum", 0.9),
+                lars_coeff=s.lars_configs.lars_coeff,
+                lars_weight_decay=s.lars_configs.lars_weight_decay,
+                parameters=optimizer._parameters,
+            )
+        kw.update(overrides)
+        return SpmdTrainer(layer, optimizer, loss_fn, mesh=get_mesh(), **kw)
+
+    # -- PS-mode stubs (reference parity placeholders) -------------------------
+    def init_worker(self):
+        pass
+
+    def init_server(self, *args, **kwargs):
+        pass
+
+    def run_server(self):
+        raise NotImplementedError("parameter-server mode: see distributed/ps (round 2+)")
+
+    def stop_worker(self):
+        pass
+
+    def save_inference_model(self, executor, dirname, feeded_var_names, target_vars,
+                             main_program=None, export_for_deployment=True):
+        pass
+
+    def save_persistables(self, executor, dirname, main_program=None, mode=0):
+        pass
+
+
+class FleetOptimizer:
+    """Wrapper returned by fleet.distributed_optimizer (meta-optimizer stack applied
+    at minimize time)."""
+
+    def __init__(self, inner, strategy, fleet):
+        self._inner = inner
+        self._strategy = strategy
+        self._fleet = fleet
+        self.user_defined_strategy = strategy
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def step(self):
+        # dygraph DDP: grads already allreduced via hooks
+        self._inner.step()
+
+    def clear_grad(self):
+        self._inner.clear_grad()
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        s = self._strategy
+        if s.amp:
+            # loss scaling handled by GradScaler in dygraph; here grads exist already
+            pass
+        loss.backward()
+        if _env.get_world_size() > 1:
+            from .. import collective as C
+
+            n = _env.get_world_size()
+            for p in self._inner._parameters:
+                if p.grad is not None:
+                    C.all_reduce(p.grad)
+                    p.grad._data = p.grad._data / n
+        self._inner.step()
+        return None, [(p, p.grad) for p in self._inner._parameters]
+
+
+fleet = Fleet()

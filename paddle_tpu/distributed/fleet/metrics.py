@@ -1,0 +1,61 @@
+"""Fleet distributed metrics (python/paddle/distributed/fleet/metrics/metric.py parity:
+sum/max/min/auc aggregated across workers via the collective backend)."""
+import numpy as np
+
+from ...core.tensor import Tensor
+from .. import collective as C
+from .. import env as _env
+
+
+def _agg(value, op):
+    if isinstance(value, Tensor):
+        t = value
+    else:
+        t = Tensor(np.asarray(value))
+    if _env.get_world_size() > 1 or C.in_spmd_context():
+        t = C.all_reduce(t, op=op)
+    return np.asarray(t._data)
+
+
+def sum(value, scope=None, util=None):
+    return _agg(value, C.ReduceOp.SUM)
+
+
+def max(value, scope=None, util=None):
+    return _agg(value, C.ReduceOp.MAX)
+
+
+def min(value, scope=None, util=None):
+    return _agg(value, C.ReduceOp.MIN)
+
+
+def acc(correct, total, scope=None, util=None):
+    c = _agg(correct, C.ReduceOp.SUM)
+    t = _agg(total, C.ReduceOp.SUM)
+    return float(c) / float(t) if float(t) else 0.0
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    e = _agg(abserr, C.ReduceOp.SUM)
+    n = _agg(total_ins_num, C.ReduceOp.SUM)
+    return float(e) / float(n)
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    e = _agg(sqrerr, C.ReduceOp.SUM)
+    n = _agg(total_ins_num, C.ReduceOp.SUM)
+    return (float(e) / float(n)) ** 0.5
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    pos = _agg(stat_pos, C.ReduceOp.SUM)
+    neg = _agg(stat_neg, C.ReduceOp.SUM)
+    tot_pos = tot_neg = 0.0
+    area = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        np_ = tot_pos + pos[i]
+        nn = tot_neg + neg[i]
+        area += (np_ + tot_pos) * (nn - tot_neg) / 2.0
+        tot_pos, tot_neg = np_, nn
+    denom = tot_pos * tot_neg
+    return float(area / denom) if denom else 0.0

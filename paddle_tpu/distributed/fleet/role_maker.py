@@ -1,0 +1,69 @@
+"""Role makers (python/paddle/distributed/fleet/base/role_maker.py parity:
+PaddleCloudRoleMaker:528 reads the PADDLE_* env protocol; UserDefinedRoleMaker)."""
+import os
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def worker_index(self):
+        raise NotImplementedError
+
+    def worker_num(self):
+        raise NotImplementedError
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=True, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        training_role = os.environ.get("TRAINING_ROLE", "TRAINER")
+        self._role = Role.SERVER if training_role == "PSERVER" else Role.WORKER
+
+    def worker_index(self):
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+    def worker_num(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        if eps:
+            return len(eps.split(","))
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+    def server_num(self):
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        return len(eps.split(",")) if eps else 0
+
+    def node_num(self):
+        return max(1, self.worker_num())
+
+    def get_trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, is_collective=True, init_gloo=False, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._kwargs = kwargs
+
+    def worker_index(self):
+        return self._kwargs.get("current_id", super().worker_index())
+
+    def worker_num(self):
+        return self._kwargs.get("worker_num", super().worker_num())

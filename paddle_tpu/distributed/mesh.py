@@ -1,0 +1,58 @@
+"""Device mesh management — the Place/ring-id world replaced by jax.sharding.Mesh.
+
+Reference parity: NCCLCommContext's ring-id -> communicator map
+(platform/collective_helper.h:67) becomes named mesh axes; process groups become
+sub-meshes. Axis naming convention across the framework:
+  'dp' data parallel | 'sharding' ZeRO | 'mp' tensor/model parallel |
+  'pp' pipeline | 'sp' sequence/context parallel | 'ep' expert parallel.
+"""
+import contextlib
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+_CURRENT_MESH = [None]
+
+
+def build_mesh(mesh_shape=None, axis_names=None, devices=None):
+    """Build a Mesh over the available devices (default: 1-axis 'dp' over all)."""
+    devs = devices if devices is not None else jax.devices()
+    if mesh_shape is None:
+        mesh_shape = (len(devs),)
+        axis_names = axis_names or ("dp",)
+    axis_names = tuple(axis_names)
+    arr = np.array(devs).reshape(tuple(mesh_shape))
+    return Mesh(arr, axis_names)
+
+
+def set_mesh(mesh):
+    _CURRENT_MESH[0] = mesh
+    return mesh
+
+
+def get_mesh():
+    if _CURRENT_MESH[0] is None:
+        _CURRENT_MESH[0] = build_mesh()
+    return _CURRENT_MESH[0]
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh):
+    old = _CURRENT_MESH[0]
+    _CURRENT_MESH[0] = mesh
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _CURRENT_MESH[0] = old
+
+
+def sharding(*spec, mesh=None):
+    return NamedSharding(mesh or get_mesh(), P(*spec))
+
+
+def replicated(mesh=None):
+    return NamedSharding(mesh or get_mesh(), P())

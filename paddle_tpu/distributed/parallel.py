@@ -1,0 +1,112 @@
+"""Dygraph data parallel.
+
+Reference parity: python/paddle/distributed/parallel.py (init_parallel_env:57) and
+fluid/dygraph/parallel.py:322 DataParallel + imperative/reducer.cc:293 (bucketed
+grad allreduce on ready-hooks).
+
+TPU-native design: no Reducer buckets — per-parameter grad hooks call the mesh/process
+allreduce; under the jitted SPMD path (spmd.data_parallel) gradients are psum'ed by XLA
+inside the step, which is the perf path and needs no hooks at all.
+"""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from . import collective as C
+from . import env as _env
+
+
+def init_parallel_env():
+    """distributed/parallel.py:57 parity -> jax.distributed.initialize."""
+    _env.init_distributed()
+    return _env.ParallelEnv()
+
+
+def get_rank():
+    return _env.get_rank()
+
+
+def get_world_size():
+    return _env.get_world_size()
+
+
+class DataParallel(Layer):
+    """paddle.DataParallel parity (fluid/dygraph/parallel.py:322)."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self._nranks = _env.get_world_size()
+        self._group = group
+        if self._nranks > 1:
+            self._register_grad_hooks()
+
+    def _register_grad_hooks(self):
+        nranks = self._nranks
+
+        def make_hook():
+            def hook(grad):
+                out = C.all_reduce(grad, op=C.ReduceOp.SUM, group=self._group)
+                return Tensor(out._data / nranks) if out is not None else grad
+
+            return hook
+
+        for p in self._layers.parameters():
+            if getattr(p, "trainable", True):
+                p.register_hook(make_hook())
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        if self._nranks <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                C.all_reduce(p.grad, op=C.ReduceOp.SUM, group=self._group)
+                p.grad._data = p.grad._data / self._nranks
+
+    # delegate everything else to the wrapped layer
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn parity — fork one python process per device/host rank."""
+    import multiprocessing as mp
+    import os
+
+    if nprocs in (-1, None):
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env_patch = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+        }
+
+        def target(rank=rank, env_patch=env_patch):
+            os.environ.update(env_patch)
+            func(*args)
+
+        p = ctx.Process(target=target, daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+    return procs

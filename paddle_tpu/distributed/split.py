@@ -1,0 +1,93 @@
+"""Tensor (model) parallel building blocks.
+
+Reference parity: python/paddle/distributed/collective.py:566 `split` (cases :581-605),
+_parallel_linear:492, _parallel_embedding:526 — row/column-parallel Linear and parallel
+Embedding with gather/allreduce.
+
+TPU-native design: the layers carry a PartitionSpec for their weights (axis 'mp');
+under SpmdTrainer/pjit, XLA partitions the matmuls and inserts the psum/all_gather the
+reference builds manually with c_allreduce/c_concat ops. In eager single-process mode
+they behave as ordinary layers (full weights).
+"""
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.layers import Layer
+
+
+class ColumnParallelLinear(Layer):
+    """operation 'linear' with axis=1 in distributed.split (weight cols sharded)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 gather_output=True, name=None, mp_axis="mp"):
+        super().__init__()
+        self.weight = self.create_parameter([in_features, out_features], attr=weight_attr,
+                                            default_initializer=I.XavierNormal())
+        self.weight.spmd_spec = P(None, mp_axis)
+        self.bias = self.create_parameter([out_features], is_bias=True) if has_bias else None
+        if self.bias is not None:
+            self.bias.spmd_spec = P(mp_axis)
+        self.gather_output = gather_output
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class RowParallelLinear(Layer):
+    """operation 'linear' with axis=0 (weight rows sharded; output psum'ed by XLA)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, name=None, mp_axis="mp"):
+        super().__init__()
+        self.weight = self.create_parameter([in_features, out_features], attr=weight_attr,
+                                            default_initializer=I.XavierNormal())
+        self.weight.spmd_spec = P(mp_axis, None)
+        self.bias = self.create_parameter([out_features], is_bias=True) if has_bias else None
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class VocabParallelEmbedding(Layer):
+    """operation 'embedding' in distributed.split (vocab rows sharded)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, name=None, mp_axis="mp"):
+        super().__init__()
+        self.weight = self.create_parameter([num_embeddings, embedding_dim], attr=weight_attr,
+                                            default_initializer=I.XavierNormal())
+        self.weight.spmd_spec = P(mp_axis, None)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split parity (collective.py:566): returns a parallel layer
+    applied to x. On TPU `num_partitions` must equal the 'mp' mesh-axis size (checked
+    at trainer build)."""
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:
+            layer = ColumnParallelLinear(in_f, out_f, weight_attr, bias_attr is not False, gather_out)
+        else:
+            layer = RowParallelLinear(in_f, out_f, weight_attr, bias_attr is not False)
+        return layer(x)
+    if operation == "embedding":
+        n, d = size
+        layer = VocabParallelEmbedding(n, d, weight_attr)
+        return layer(x)
+    raise ValueError(f"unknown operation {operation!r}")
+
+
+def collect_spmd_specs(layer):
+    """Gather {param_name: PartitionSpec} from layers built with parallel specs."""
+    out = {}
+    for n, p in layer.named_parameters():
+        spec = getattr(p, "spmd_spec", None)
+        if spec is not None:
+            out[n] = spec
+    return out

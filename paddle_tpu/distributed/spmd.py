@@ -1,0 +1,224 @@
+"""SPMD training engine — the ParallelExecutor/SSA-graph replacement.
+
+Reference parity: this one module supersedes the reference's multi-device machinery:
+ParallelExecutor + multi_devices_graph_pass (grad allreduce insertion,
+framework/details/), ShardingOptimizer program surgery
+(fleet/meta_optimizers/sharding_optimizer.py:161-308), and the dygraph Reducer.
+
+TPU-native design: ONE jitted train step over a Mesh.
+ - data parallel: batch sharded on 'dp'; XLA inserts the grad psum (ICI).
+ - ZeRO ("sharding" stage 1/2/3): optimizer states (and for stage 3, params) get
+   NamedShardings over the dp axis; XLA emits reduce_scatter/all_gather — the
+   _split_program/_add_broadcast_allreduce passes become sharding annotations.
+ - tensor parallel: param shardings over 'mp' provided by distributed.split layers.
+ - recompute: jax.checkpoint on the forward.
+ - gradient merge / accumulation: lax.scan over micro-batches.
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tape import global_tape
+from ..core.tensor import Tensor
+from .mesh import get_mesh
+
+
+def _first_divisible_axis(shape, n):
+    for i, s in enumerate(shape):
+        if s % n == 0 and s >= n:
+            return i
+    return None
+
+
+def param_shardings(params, mesh, axis_name, min_size=16384, shard_params=False):
+    """ZeRO-style shardings: arrays >= min_size sharded on their first divisible dim."""
+    n = mesh.shape[axis_name]
+    out = {}
+    for k, v in params.items():
+        ax = _first_divisible_axis(v.shape, n)
+        if shard_params and ax is not None and v.size >= min_size:
+            spec = [None] * v.ndim
+            spec[ax] = axis_name
+            out[k] = NamedSharding(mesh, P(*spec))
+        else:
+            out[k] = NamedSharding(mesh, P())
+    return out
+
+
+def state_shardings(opt_state, p_shardings, mesh, axis_name, stage):
+    """Shard optimizer moments like their params (stage>=2) or replicate."""
+    out = {}
+    n = mesh.shape[axis_name]
+    for pname, st in opt_state.items():
+        if pname == "__step__":
+            out[pname] = NamedSharding(mesh, P())
+            continue
+        sub = {}
+        for k, v in st.items():
+            if stage >= 2 and hasattr(v, "ndim") and v.ndim > 0:
+                ax = _first_divisible_axis(v.shape, n)
+                if ax is not None and v.size >= 16384:
+                    spec = [None] * v.ndim
+                    spec[ax] = axis_name
+                    sub[k] = NamedSharding(mesh, P(*spec))
+                    continue
+            sub[k] = NamedSharding(mesh, P())
+        out[pname] = sub
+    return out
+
+
+class SpmdTrainer:
+    """Compile a Layer + Optimizer + loss into one sharded XLA train step."""
+
+    def __init__(self, layer, optimizer, loss_fn=None, mesh=None, dp_axis="dp",
+                 sharding_stage=0, recompute=False, accumulate_steps=1,
+                 extra_param_specs=None, metrics_fn=None, donate=True):
+        self.layer = layer
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.mesh = mesh or get_mesh()
+        self.dp_axis = dp_axis
+        self.sharding_stage = sharding_stage
+        self.recompute = recompute
+        self.accumulate_steps = accumulate_steps
+        self.extra_param_specs = extra_param_specs or {}
+        self._compiled = None
+        self.params = {n: p._data for n, p in layer.named_parameters() if getattr(p, "trainable", True)}
+        self.frozen = {n: p._data for n, p in layer.named_parameters() if not getattr(p, "trainable", True)}
+        self.buffers = {n: b._data for n, b in layer.named_buffers()}
+        self.opt_state = optimizer.functional_init(self.params)
+        self._place_state()
+
+    # -- sharding placement ----------------------------------------------------
+    def _place_state(self):
+        mesh = self.mesh
+        ax = self.dp_axis
+        self.p_shardings = param_shardings(
+            self.params, mesh, ax, shard_params=(self.sharding_stage >= 3)
+        )
+        for k, spec in self.extra_param_specs.items():
+            if k in self.p_shardings:
+                self.p_shardings[k] = NamedSharding(mesh, spec)
+        self.s_shardings = state_shardings(self.opt_state, self.p_shardings, mesh, ax, self.sharding_stage)
+        self.b_shardings = {k: NamedSharding(mesh, P()) for k in self.buffers}
+        # device_put everything per its sharding
+        self.params = {k: jax.device_put(v, self.p_shardings[k]) for k, v in self.params.items()}
+        self.buffers = {k: jax.device_put(v, self.b_shardings[k]) for k, v in self.buffers.items()}
+        new_state = {}
+        for pname, st in self.opt_state.items():
+            if pname == "__step__":
+                new_state[pname] = jax.device_put(st, NamedSharding(self.mesh, P()))
+            else:
+                new_state[pname] = {k: jax.device_put(v, self.s_shardings[pname][k]) for k, v in st.items()}
+        self.opt_state = new_state
+
+    # -- pure step -------------------------------------------------------------
+    def _forward_loss(self, params, buffers, batch):
+        layer = self.layer
+        tape = global_tape()
+        named_p = dict(layer.named_parameters())
+        named_b = dict(layer.named_buffers())
+        saved = {n: t._data for n, t in {**named_p, **named_b}.items()}
+        try:
+            for n, v in params.items():
+                named_p[n]._data = v
+            for n, v in self.frozen.items():
+                named_p[n]._data = v
+            for n, v in buffers.items():
+                named_b[n]._data = v
+            with tape.pause():
+                inputs = [Tensor(b) for b in batch[:-1]]
+                label = Tensor(batch[-1])
+                if self.loss_fn is not None:
+                    out = layer(*inputs)
+                    loss = self.loss_fn(out, label)
+                else:
+                    loss = layer(*inputs, label)
+            new_buffers = {n: named_b[n]._data for n in buffers}
+            return loss._data if isinstance(loss, Tensor) else loss, new_buffers
+        finally:
+            for n, t in {**named_p, **named_b}.items():
+                t._data = saved[n]
+
+    def _build(self, batch_arrays):
+        mesh = self.mesh
+        ax = self.dp_axis
+
+        fwd = self._forward_loss
+        if self.recompute:
+            fwd = jax.checkpoint(fwd, static_argnums=())
+
+        accum = self.accumulate_steps
+
+        def step(params, opt_state, buffers, lr, *batch):
+            def loss_fn(p, b):
+                loss, new_buf = fwd(p, buffers, b)
+                return loss.astype(jnp.float32), new_buf
+
+            if accum > 1:
+                # gradient merge (fleet/meta_optimizers/gradient_merge_optimizer.py):
+                # micro-batch scan, grads averaged
+                micro = [jnp.reshape(b, (accum, b.shape[0] // accum) + b.shape[1:]) for b in batch]
+
+                def body(carry, mb):
+                    g_acc, l_acc = carry
+                    (loss, nb), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, mb)
+                    g_acc = jax.tree_util.tree_map(lambda a, g: a + g, g_acc, grads)
+                    return (g_acc, l_acc + loss), nb
+
+                g0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+                (grads, loss_sum), new_buf_all = jax.lax.scan(body, (g0, jnp.zeros((), jnp.float32)), micro)
+                grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+                loss = loss_sum / accum
+                new_buffers = jax.tree_util.tree_map(lambda v: v[-1], new_buf_all)
+            else:
+                (loss, new_buffers), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+            new_params, new_state = self.optimizer.functional_apply(params, grads, opt_state, lr=lr)
+            return loss, new_params, new_state, new_buffers
+
+        batch_shard = NamedSharding(mesh, P(ax))
+        repl = NamedSharding(mesh, P())
+        in_shardings = (
+            self.p_shardings,
+            dict(self.s_shardings),
+            self.b_shardings,
+            repl,
+        ) + tuple(batch_shard for _ in batch_arrays)
+        out_shardings = (
+            repl,
+            self.p_shardings,
+            dict(self.s_shardings),
+            self.b_shardings,
+        )
+        return jax.jit(step, in_shardings=in_shardings, out_shardings=out_shardings,
+                       donate_argnums=(0, 1))
+
+    # -- public ---------------------------------------------------------------
+    def train_step(self, *batch):
+        batch_arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(np.asarray(b)) for b in batch]
+        if self._compiled is None:
+            self._compiled = self._build(batch_arrays)
+        lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
+        loss, self.params, self.opt_state, self.buffers = self._compiled(
+            self.params, self.opt_state, self.buffers, lr, *batch_arrays
+        )
+        self.optimizer._step_count += 1
+        if isinstance(self.optimizer._lr, object) and hasattr(self.optimizer._lr, "step"):
+            pass  # LR schedulers advance via user calls (paddle semantics)
+        return Tensor(loss)
+
+    def sync_to_layer(self):
+        """Write the (possibly sharded) params back into the Layer's tensors."""
+        named = dict(self.layer.named_parameters())
+        for n, v in self.params.items():
+            named[n]._data = jax.device_get(v) if self.sharding_stage >= 3 else v
+        named_b = dict(self.layer.named_buffers())
+        for n, v in self.buffers.items():
+            named_b[n]._data = v
+
+
+def data_parallel_step_fn(layer, optimizer, loss_fn, mesh=None, **kw):
+    return SpmdTrainer(layer, optimizer, loss_fn, mesh=mesh, **kw)

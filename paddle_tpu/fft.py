@@ -1,0 +1,63 @@
+"""paddle.fft namespace (python/paddle/fft.py parity) — thin jnp.fft wrappers."""
+import jax.numpy as jnp
+
+from .core.dispatch import apply
+from .core.tensor import Tensor
+
+
+def _t(x):
+    import numpy as np
+
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def _mk(name):
+    jfn = getattr(jnp.fft, name)
+
+    def op(x, n=None, axis=-1, norm="backward", name_=None):
+        return apply(lambda v: jfn(v, n=n, axis=axis, norm=norm), _t(x))
+
+    op.__name__ = name
+    return op
+
+
+def _mk_nd(name):
+    jfn = getattr(jnp.fft, name)
+
+    def op(x, s=None, axes=None, norm="backward", name_=None):
+        return apply(lambda v: jfn(v, s=s, axes=axes, norm=norm), _t(x))
+
+    op.__name__ = name
+    return op
+
+
+fft = _mk("fft")
+ifft = _mk("ifft")
+rfft = _mk("rfft")
+irfft = _mk("irfft")
+hfft = _mk("hfft")
+ihfft = _mk("ihfft")
+fft2 = _mk_nd("fft2")
+ifft2 = _mk_nd("ifft2")
+rfft2 = _mk_nd("rfft2")
+irfft2 = _mk_nd("irfft2")
+fftn = _mk_nd("fftn")
+ifftn = _mk_nd("ifftn")
+rfftn = _mk_nd("rfftn")
+irfftn = _mk_nd("irfftn")
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda v: jnp.fft.fftshift(v, axes=axes), _t(x))
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda v: jnp.fft.ifftshift(v, axes=axes), _t(x))
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d))

@@ -1,0 +1,60 @@
+"""Global flag registry.
+
+Reference parity: paddle/fluid/platform/flags.cc (~40 process-level gflags, exposed to
+Python as FLAGS_* via pybind/global_value_getter_setter.cc) and
+paddle.set_flags/get_flags. Flags can be seeded from environment (FLAGS_xxx=...).
+"""
+import os
+
+_REGISTRY = {}
+
+
+def define_flag(name, default, help_str=""):
+    env = os.environ.get("FLAGS_" + name)
+    value = default
+    if env is not None:
+        if isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            value = int(env)
+        elif isinstance(default, float):
+            value = float(env)
+        else:
+            value = env
+    _REGISTRY[name] = {"value": value, "default": default, "help": help_str}
+    return value
+
+
+def set_flags(flags):
+    """paddle.set_flags parity."""
+    for k, v in flags.items():
+        k = k[6:] if k.startswith("FLAGS_") else k
+        if k not in _REGISTRY:
+            define_flag(k, v)
+        else:
+            _REGISTRY[k]["value"] = v
+
+
+def get_flags(names):
+    """paddle.get_flags parity."""
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for k in names:
+        key = k[6:] if k.startswith("FLAGS_") else k
+        if key in _REGISTRY:
+            out[k] = _REGISTRY[key]["value"]
+    return out
+
+
+def get_flag(name, default=None):
+    e = _REGISTRY.get(name)
+    return e["value"] if e else default
+
+
+# core flags (platform/flags.cc parity where meaningful on TPU)
+define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf (flags.cc:44)")
+define_flag("sort_sum_gradient", False, "deterministic grad accumulation order (flags.cc:527)")
+define_flag("benchmark", False, "sync after each op for timing")
+define_flag("seed", 0, "global random seed")
+define_flag("use_bfloat16", True, "prefer bfloat16 matmuls on MXU")

@@ -1,0 +1,5 @@
+"""paddle.framework parity namespace."""
+from ..core.generator import seed  # noqa: F401
+from ..core.device import get_device, set_device  # noqa: F401
+from . import io  # noqa: F401
+from .io import load, save  # noqa: F401

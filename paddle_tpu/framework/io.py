@@ -1,0 +1,62 @@
+"""paddle.save / paddle.load.
+
+Reference parity: python/paddle/framework/io.py:202 save (pickled state_dict) / :292
+load; fluid/dygraph/checkpoint.py:56 save_dygraph. Tensors are stored as numpy arrays
+(bfloat16 kept via ml_dtypes view round-trip).
+"""
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._data), "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_pack(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _unpack(obj):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            t = Tensor(obj["data"])
+            t.stop_gradient = obj.get("stop_gradient", True)
+            t.name = obj.get("name", "")
+            return t
+        return {k: _unpack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_unpack(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return _unpack(pickle.load(f))
+
+
+def save_dygraph(state_dict, model_path):
+    save(state_dict, model_path + (".pdparams" if not model_path.endswith(".pdparams") else ""))
+
+
+def load_dygraph(model_path, **configs):
+    params_path = model_path + ".pdparams"
+    opt_path = model_path + ".pdopt"
+    para = load(params_path) if os.path.exists(params_path) else None
+    opt = load(opt_path) if os.path.exists(opt_path) else None
+    return para, opt

@@ -1,0 +1,4 @@
+"""paddle.hapi parity."""
+from . import callbacks  # noqa: F401
+from .model import Model, flops  # noqa: F401
+from .model_summary import summary  # noqa: F401

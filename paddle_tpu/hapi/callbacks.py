@@ -1,0 +1,317 @@
+"""Training callbacks (python/paddle/hapi/callbacks.py parity: Callback, ProgBarLogger,
+ModelCheckpoint, LRScheduler, EarlyStopping, VisualDL->TensorBoard-style writer,
+ReduceLROnPlateau)."""
+import numbers
+import os
+
+import numpy as np
+
+from .progressbar import ProgressBar
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
+                     steps=None, log_freq=2, verbose=2, save_freq=1, save_dir=None,
+                     metrics=None, mode="train"):
+    cbks = callbacks if callbacks is not None else []
+    cbks = cbks if isinstance(cbks, (list, tuple)) else [cbks]
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)] + list(cbks)
+    if not any(isinstance(c, LRScheduler) for c in cbks):
+        cbks = cbks + [LRScheduler()]
+    if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
+    lst = CallbackList(cbks)
+    lst.set_model(model)
+    lst.set_params({
+        "batch_size": batch_size, "epochs": epochs, "steps": steps,
+        "verbose": verbose, "metrics": metrics or ["loss"],
+    })
+    return lst
+
+
+class CallbackList:
+    def __init__(self, callbacks):
+        self.callbacks = list(callbacks)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def __iter__(self):
+        return iter(self.callbacks)
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            fn = getattr(c, name, None)
+            if fn:
+                fn(*args)
+
+    def on_train_begin(self, logs=None):
+        self._call("on_train_begin", logs)
+
+    def on_train_end(self, logs=None):
+        self._call("on_train_end", logs)
+
+    def on_eval_begin(self, logs=None):
+        self._call("on_eval_begin", logs)
+
+    def on_eval_end(self, logs=None):
+        self._call("on_eval_end", logs)
+
+    def on_predict_begin(self, logs=None):
+        self._call("on_predict_begin", logs)
+
+    def on_predict_end(self, logs=None):
+        self._call("on_predict_end", logs)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._call("on_epoch_begin", epoch, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._call("on_epoch_end", epoch, logs)
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._call("on_train_batch_begin", step, logs)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._call("on_train_batch_end", step, logs)
+
+    def on_eval_batch_begin(self, step, logs=None):
+        self._call("on_eval_batch_begin", step, logs)
+
+    def on_eval_batch_end(self, step, logs=None):
+        self._call("on_eval_batch_end", step, logs)
+
+    def on_predict_batch_begin(self, step, logs=None):
+        self._call("on_predict_batch_begin", step, logs)
+
+    def on_predict_batch_end(self, step, logs=None):
+        self._call("on_predict_batch_end", step, logs)
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_predict_begin(self, logs=None):
+        pass
+
+    def on_predict_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+    def on_predict_batch_begin(self, step, logs=None):
+        pass
+
+    def on_predict_batch_end(self, step, logs=None):
+        pass
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        self.steps = self.params.get("steps")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        if self.verbose:
+            print(f"Epoch {epoch + 1}/{self.epochs}")
+        self.progbar = ProgressBar(num=self.steps, verbose=self.verbose)
+        self.seen = 0
+
+    def _values(self, logs):
+        return [(k, v) for k, v in (logs or {}).items() if isinstance(v, numbers.Number)]
+
+    def on_train_batch_end(self, step, logs=None):
+        self.seen += 1
+        if self.seen % self.log_freq == 0 and self.verbose:
+            self.progbar.update(self.seen, self._values(logs))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            self.progbar.update(self.seen, self._values(logs))
+
+    def on_eval_begin(self, logs=None):
+        self.eval_progbar = ProgressBar(num=(logs or {}).get("steps"), verbose=self.verbose)
+        self.eval_seen = 0
+
+    def on_eval_batch_end(self, step, logs=None):
+        self.eval_seen += 1
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            print("Eval - " + " - ".join(f"{k}: {v}" for k, v in self._values(logs)))
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and epoch % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class LRScheduler(Callback):
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        from ..optimizer.lr import LRScheduler as Sched
+
+        opt = getattr(self.model, "_optimizer", None)
+        if opt and isinstance(opt._lr, Sched):
+            return opt._lr
+        return None
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            s = self._sched()
+            if s:
+                s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            s = self._sched()
+            if s:
+                s.step()
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        self.stopped_epoch = 0
+        if mode == "max" or (mode == "auto" and "acc" in monitor):
+            self.monitor_op = np.greater
+        else:
+            self.monitor_op = np.less
+        self.best = None
+        self.wait = 0
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        current = logs.get(self.monitor)
+        if current is None:
+            return
+        if isinstance(current, (list, tuple)):
+            current = current[0]
+        if self.best is None or self.monitor_op(current - self.min_delta, self.best):
+            self.best = current
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.model.stop_training = True
+
+
+class VisualDL(Callback):
+    """VisualDL writer parity — writes scalar logs as TSV (no visualdl dep in image)."""
+
+    def __init__(self, log_dir):
+        super().__init__()
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self._f = None
+        self._step = 0
+
+    def on_train_begin(self, logs=None):
+        self._f = open(os.path.join(self.log_dir, "scalars.tsv"), "a")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        for k, v in (logs or {}).items():
+            if isinstance(v, numbers.Number):
+                self._f.write(f"{self._step}\t{k}\t{v}\n")
+
+    def on_train_end(self, logs=None):
+        if self._f:
+            self._f.close()
+
+
+class ReduceLROnPlateau(Callback):
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1, mode="auto",
+                 min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        from ..optimizer.lr import ReduceOnPlateau as _R
+
+        self.monitor = monitor
+        self._impl_args = dict(factor=factor, patience=patience, cooldown=cooldown, min_lr=min_lr)
+
+    def on_eval_end(self, logs=None):
+        current = (logs or {}).get(self.monitor)
+        if current is None:
+            return
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is None:
+            return
+        # simple plateau: reduce when not improving
+        if not hasattr(self, "_best") or current < self._best - 1e-9:
+            self._best = current
+            self._wait = 0
+        else:
+            self._wait = getattr(self, "_wait", 0) + 1
+            if self._wait > self._impl_args["patience"]:
+                try:
+                    opt.set_lr(max(opt.get_lr() * self._impl_args["factor"], self._impl_args["min_lr"]))
+                except RuntimeError:
+                    pass
+                self._wait = 0

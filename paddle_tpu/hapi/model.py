@@ -1,0 +1,349 @@
+"""High-level Model API.
+
+Reference parity: python/paddle/hapi/model.py (Model:810 — fit:1299, evaluate:1515,
+predict:1609, save:1043, load, prepare:1244, train_batch:896; DynamicGraphAdapter:609
+and StaticGraphAdapter:224).
+
+TPU-native design: DynamicGraphAdapter = eager tape loop (semantics parity);
+JitGraphAdapter (the StaticGraphAdapter analog) compiles the whole train step with
+SpmdTrainer — one XLA program incl. optimizer update, batch sharded over the mesh. The
+adapter is chosen by paddle_tpu.static mode or Model(..., use_jit=True); both share the
+same fit/evaluate/predict driver.
+"""
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import DataLoader
+from ..metric.metrics import Metric
+from . import callbacks as cbks_mod
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class DynamicGraphAdapter:
+    """hapi/model.py:609 parity — eager forward/backward/step."""
+
+    def __init__(self, model):
+        self.model = model
+
+    def train_batch(self, inputs, labels=None):
+        net = self.model.network
+        net.train()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        outputs = net(*inputs)
+        losses = self.model._loss(*(_to_list(outputs) + labels)) if self.model._loss else outputs
+        loss = losses if isinstance(losses, Tensor) else sum(losses)
+        loss.backward()
+        self.model._optimizer.step()
+        self.model._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return self._return(loss, metrics)
+
+    def eval_batch(self, inputs, labels=None):
+        from ..core.tape import no_grad
+
+        net = self.model.network
+        net.eval()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        with no_grad():
+            outputs = net(*inputs)
+            if self.model._loss:
+                losses = self.model._loss(*(_to_list(outputs) + labels))
+                loss = losses if isinstance(losses, Tensor) else sum(losses)
+            else:
+                loss = None
+        metrics = self._update_metrics(outputs, labels)
+        return self._return(loss, metrics)
+
+    def predict_batch(self, inputs):
+        from ..core.tape import no_grad
+
+        net = self.model.network
+        net.eval()
+        with no_grad():
+            outputs = net(*_to_list(inputs))
+        return [np.asarray(o._data) for o in _to_list(outputs)]
+
+    def _update_metrics(self, outputs, labels):
+        vals = []
+        for m in self.model._metrics:
+            res = m.compute(*(_to_list(outputs) + labels))
+            v = m.update(*_to_list(res))
+            vals.append(v)
+        return vals
+
+    def _return(self, loss, metrics):
+        l = [float(np.asarray(loss._data))] if loss is not None else []
+        if metrics:
+            return (l, metrics) if l else metrics
+        return l
+
+
+class JitGraphAdapter(DynamicGraphAdapter):
+    """StaticGraphAdapter:224 analog — whole-step XLA compilation via SpmdTrainer."""
+
+    def __init__(self, model):
+        super().__init__(model)
+        self._trainer = None
+
+    def train_batch(self, inputs, labels=None):
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        if self._trainer is None:
+            from ..distributed.spmd import SpmdTrainer
+
+            def loss_fn(out, label):
+                outs = _to_list(out)
+                return self.model._loss(*(outs + [label]))
+
+            self._trainer = SpmdTrainer(
+                self.model.network, self.model._optimizer, loss_fn,
+            )
+        loss = self._trainer.train_step(*(inputs + labels))
+        metrics = []
+        if self.model._metrics:
+            # metrics need outputs: run a forward (cheap, jitted by to_static cache)
+            self._trainer.sync_to_layer()
+            from ..core.tape import no_grad
+
+            with no_grad():
+                outputs = self.model.network(*inputs)
+            metrics = self._update_metrics(outputs, labels)
+        return self._return(loss, metrics)
+
+    def eval_batch(self, inputs, labels=None):
+        if self._trainer is not None:
+            self._trainer.sync_to_layer()
+        return super().eval_batch(inputs, labels)
+
+    def predict_batch(self, inputs):
+        if self._trainer is not None:
+            self._trainer.sync_to_layer()
+        return super().predict_batch(inputs)
+
+
+class Model:
+    """paddle.Model parity (hapi/model.py:810)."""
+
+    def __init__(self, network, inputs=None, labels=None, use_jit=False):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._loss = None
+        self._optimizer = None
+        self._metrics = []
+        self.stop_training = False
+        from ..static import in_static_mode
+
+        use_jit = use_jit or in_static_mode()
+        self._adapter = JitGraphAdapter(self) if use_jit else DynamicGraphAdapter(self)
+
+    # -- setup -----------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        """hapi/model.py:1244 parity."""
+        self._optimizer = optimizer
+        if loss is not None and not callable(loss):
+            raise TypeError("loss must be callable (a Layer or function)")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric must be paddle_tpu.metric.Metric, got {type(m)}")
+        return self
+
+    # -- batch-level API --------------------------------------------------------
+    def train_batch(self, inputs, labels=None):
+        return self._adapter.train_batch(inputs, labels)
+
+    def eval_batch(self, inputs, labels=None):
+        return self._adapter.eval_batch(inputs, labels)
+
+    def predict_batch(self, inputs):
+        return self._adapter.predict_batch(inputs)
+
+    # -- loop API ---------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        """hapi/model.py:1299 parity."""
+        train_loader = self._to_loader(train_data, batch_size, shuffle, drop_last, num_workers)
+        eval_loader = self._to_loader(eval_data, batch_size, False, False, num_workers) if eval_data is not None else None
+
+        steps = self._len_or_none(train_loader)
+        cbks = cbks_mod.config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps, log_freq=log_freq,
+            verbose=verbose, save_freq=save_freq, save_dir=save_dir,
+            metrics=["loss"] + [m.name() for m in self._metrics],
+        )
+        self.stop_training = False
+        cbks.on_train_begin()
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                if num_iters is not None and step >= num_iters:
+                    break
+                cbks.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                res = self.train_batch(inputs, labels)
+                logs = self._make_logs(res)
+                cbks.on_train_batch_end(step, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cbks)
+                logs.update({"eval_" + k: v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+        cbks.on_train_end(logs if "logs" in dir() else None)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        """hapi/model.py:1515 parity."""
+        loader = self._to_loader(eval_data, batch_size, False, False, num_workers)
+        cbks = cbks_mod.config_callbacks(callbacks, model=self, verbose=verbose,
+                                         metrics=["loss"] + [m.name() for m in self._metrics])
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin({"steps": self._len_or_none(loader)})
+        logs = {}
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            cbks.on_eval_batch_begin(step)
+            inputs, labels = self._split_batch(batch)
+            res = self.eval_batch(inputs, labels)
+            logs = self._make_logs(res)
+            cbks.on_eval_batch_end(step, logs)
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        """hapi/model.py:1609 parity."""
+        loader = self._to_loader(test_data, batch_size, False, False, num_workers)
+        cbks = cbks_mod.config_callbacks(callbacks, model=self, verbose=verbose)
+        cbks.on_predict_begin()
+        outputs = []
+        for step, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step)
+            inputs, _ = self._split_batch(batch, predict=True)
+            out = self.predict_batch(inputs)
+            outputs.append(out)
+            cbks.on_predict_batch_end(step)
+        cbks.on_predict_end()
+        # transpose to per-output lists
+        n_out = len(outputs[0]) if outputs else 0
+        result = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            result = [np.concatenate(r, axis=0) for r in result]
+        return result
+
+    # -- persistence ------------------------------------------------------------
+    def save(self, path, training=True):
+        """hapi/model.py:1043 parity."""
+        from ..framework.io import save as psave
+
+        if training:
+            psave(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                psave(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            from .. import jit
+
+            jit.save(self.network, path)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as pload
+        import os
+
+        state = pload(path + ".pdparams" if not path.endswith(".pdparams") else path)
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(pload(opt_path))
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+
+        return summary(self.network, input_size, dtypes=dtype)
+
+    # -- helpers ----------------------------------------------------------------
+    def _to_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if hasattr(data, "__iter__") and not hasattr(data, "__getitem__"):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+
+    @staticmethod
+    def _len_or_none(loader):
+        try:
+            return len(loader)
+        except TypeError:
+            return None
+
+    def _split_batch(self, batch, predict=False):
+        batch = batch if isinstance(batch, (list, tuple)) else [batch]
+        if predict:
+            return list(batch), []
+        if len(batch) == 1:
+            return [batch[0]], []
+        return list(batch[:-1]), [batch[-1]]
+
+    def _make_logs(self, res):
+        logs = {}
+        if isinstance(res, tuple) and len(res) == 2:
+            losses, metrics = res
+            if losses:
+                logs["loss"] = losses[0]
+            for m, v in zip(self._metrics, metrics):
+                names = m.name() if isinstance(m.name(), list) else [m.name()]
+                vals = v if isinstance(v, (list, tuple)) else [v]
+                for n, val in zip(names, vals):
+                    logs[n] = float(np.asarray(val).mean()) if val is not None else None
+            # use accumulated values for stable display
+            for m in self._metrics:
+                names = m.name() if isinstance(m.name(), list) else [m.name()]
+                accs = m.accumulate()
+                accs = accs if isinstance(accs, (list, tuple)) else [accs]
+                for n, a in zip(names, accs):
+                    logs[n] = a
+        elif isinstance(res, list) and res:
+            logs["loss"] = res[0]
+        return logs
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """paddle.flops rough parity: counts matmul/conv FLOPs via cost analysis."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.tape import global_tape
+
+    x = jnp.zeros(tuple(input_size), dtype=jnp.float32)
+
+    def fwd(v):
+        with global_tape().pause():
+            return net(Tensor(v))._data
+
+    try:
+        analysis = jax.jit(fwd).lower(x).compile().cost_analysis()
+        f = analysis.get("flops", 0.0) if isinstance(analysis, dict) else 0.0
+        return int(f)
+    except Exception:
+        return 0

@@ -1,0 +1,29 @@
+"""Model summary (python/paddle/hapi/model_summary.py parity)."""
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    rows = []
+    total_params = 0
+    trainable_params = 0
+    for name, layer in net.named_sublayers(include_self=False):
+        n_params = sum(p.size for p in layer._parameters.values() if p is not None)
+        total_params_layer = n_params
+        rows.append((name or layer.__class__.__name__, layer.__class__.__name__, total_params_layer))
+    for p in net.parameters():
+        total_params += p.size
+        if getattr(p, "trainable", True):
+            trainable_params += p.size
+    print("-" * 64)
+    print(f"{'Layer':<30}{'Type':<22}{'Params':>10}")
+    print("=" * 64)
+    for name, typ, n in rows:
+        print(f"{name:<30}{typ:<22}{n:>10,}")
+    print("=" * 64)
+    print(f"Total params: {total_params:,}")
+    print(f"Trainable params: {trainable_params:,}")
+    print(f"Non-trainable params: {total_params - trainable_params:,}")
+    print("-" * 64)
+    return {"total_params": total_params, "trainable_params": trainable_params}

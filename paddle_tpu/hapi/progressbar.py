@@ -1,0 +1,34 @@
+"""Progress bar (python/paddle/hapi/progressbar.py parity, simplified terminal output)."""
+import sys
+import time
+
+
+class ProgressBar:
+    def __init__(self, num=None, width=30, verbose=1, start=True, file=sys.stdout):
+        self._num = num
+        self._width = width
+        self._verbose = verbose
+        self._file = file
+        self._start = time.time()
+        self._last_update = 0
+
+    def update(self, current_num, values=None):
+        if self._verbose == 0:
+            return
+        now = time.time()
+        metrics = " - ".join(
+            f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
+            for k, v in (values or [])
+        )
+        if self._num:
+            msg = f"step {current_num}/{self._num} - {metrics}"
+        else:
+            msg = f"step {current_num} - {metrics}"
+        if self._verbose == 1:
+            self._file.write("\r" + msg)
+            if self._num and current_num >= self._num:
+                self._file.write("\n")
+        elif self._verbose == 2 and (self._num is None or current_num >= self._num or now - self._last_update > 10):
+            self._file.write(msg + "\n")
+        self._last_update = now
+        self._file.flush()

@@ -1,0 +1,284 @@
+"""DataLoader.
+
+Reference parity: python/paddle/fluid/reader.py DataLoader +
+fluid/dataloader/dataloader_iter.py (single-process iter :100 and
+_DataLoaderIterMultiProcess :228 with worker procs + queues + ParentWatchDog).
+
+TPU-native design: workers produce host numpy batches (multiprocessing); device transfer
+happens in the consuming step function (jax device_put is async). The shared-memory
+LoDTensor queue of the reference is unnecessary — numpy pickling over a
+multiprocessing.Queue feeds a single TPU host fine; jax arrays never cross processes.
+"""
+import atexit
+import itertools
+import multiprocessing as mp
+import queue as pyqueue
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler
+
+_worker_info = threading.local()
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return [default_collate_fn([b[i] for b in batch]) for i in range(len(sample))]
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(b._data) for b in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.number)):
+        return Tensor(np.asarray(batch))
+    return batch
+
+
+def _np_collate(batch):
+    """Collate to plain numpy (used inside worker processes — no jax there)."""
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return [_np_collate([b[i] for b in batch]) for i in range(len(sample))]
+    if isinstance(sample, dict):
+        return {k: _np_collate([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.number)):
+        return np.asarray(batch)
+    return batch
+
+
+def _to_tensor(obj):
+    if isinstance(obj, list):
+        return [_to_tensor(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _to_tensor(v) for k, v in obj.items()}
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    return obj
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, num_workers, seed):
+    np.random.seed(seed + worker_id)
+    _worker_info.info = WorkerInfo(worker_id, num_workers, dataset)
+    if isinstance(dataset, IterableDataset):
+        it = iter(dataset)
+        while True:
+            msg = index_queue.get()
+            if msg is None:
+                break
+            batch_id, batch_size = msg
+            samples = list(itertools.islice(it, batch_size))
+            if not samples:
+                data_queue.put((batch_id, None))
+                break
+            data_queue.put((batch_id, collate_fn(samples)))
+    else:
+        while True:
+            msg = index_queue.get()
+            if msg is None:
+                break
+            batch_id, indices = msg
+            try:
+                samples = [dataset[i] for i in indices]
+                data_queue.put((batch_id, collate_fn(samples)))
+            except Exception as e:  # surface worker errors to parent
+                data_queue.put((batch_id, e))
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=False, timeout=120,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.num_workers = num_workers
+        self.timeout = timeout
+        self._is_iterable_ds = isinstance(dataset, IterableDataset)
+        self.collate_fn = collate_fn or (default_collate_fn if num_workers == 0 else _np_collate)
+        self._user_collate = collate_fn is not None
+        self.prefetch_factor = prefetch_factor
+        if self._is_iterable_ds:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last)
+
+    def __len__(self):
+        if self._is_iterable_ds:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self.num_workers == 0:
+            return self._single_process_iter()
+        return self._multi_process_iter()
+
+    def _single_process_iter(self):
+        if self._is_iterable_ds:
+            it = iter(self.dataset)
+            while True:
+                samples = list(itertools.islice(it, self.batch_size))
+                if not samples or (self.drop_last and len(samples) < self.batch_size):
+                    return
+                yield self.collate_fn(samples)
+        else:
+            for indices in self.batch_sampler:
+                samples = [self.dataset[i] for i in indices]
+                yield self.collate_fn(samples)
+
+    def _multi_process_iter(self):
+        ctx = mp.get_context("fork")
+        index_queues = []
+        data_queue = ctx.Queue()
+        workers = []
+        seed = np.random.randint(0, 2**31 - 1)
+        for wid in range(self.num_workers):
+            iq = ctx.Queue()
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, iq, data_queue, self.collate_fn, wid, self.num_workers, seed),
+                daemon=True,
+            )
+            w.start()
+            index_queues.append(iq)
+            workers.append(w)
+
+        def shutdown():
+            for iq in index_queues:
+                try:
+                    iq.put(None)
+                except Exception:
+                    pass
+            for w in workers:
+                w.join(timeout=1)
+                if w.is_alive():
+                    w.terminate()
+
+        atexit.register(shutdown)
+        try:
+            if self._is_iterable_ds:
+                yield from self._iter_iterable_mp(index_queues, data_queue, workers)
+            else:
+                yield from self._iter_map_mp(index_queues, data_queue, workers)
+        finally:
+            shutdown()
+            atexit.unregister(shutdown)
+
+    def _iter_map_mp(self, index_queues, data_queue, workers):
+        sampler_iter = iter(self.batch_sampler)
+        sent = 0
+        received = 0
+        buffers = {}
+        # prime the pipeline
+        for _ in range(self.num_workers * self.prefetch_factor):
+            try:
+                indices = next(sampler_iter)
+            except StopIteration:
+                break
+            index_queues[sent % self.num_workers].put((sent, indices))
+            sent += 1
+        while received < sent:
+            while received in buffers:
+                data = buffers.pop(received)
+                received += 1
+                yield self._finalize(data)
+                try:
+                    indices = next(sampler_iter)
+                    index_queues[sent % self.num_workers].put((sent, indices))
+                    sent += 1
+                except StopIteration:
+                    pass
+            if received >= sent:
+                break
+            # ParentWatchDog (dataloader_iter.py:384): detect dead workers
+            if not any(w.is_alive() for w in workers) and data_queue.empty():
+                raise RuntimeError("DataLoader workers exited unexpectedly")
+            try:
+                batch_id, data = data_queue.get(timeout=self.timeout)
+            except pyqueue.Empty:
+                raise RuntimeError(f"DataLoader timed out after {self.timeout}s")
+            if isinstance(data, Exception):
+                raise data
+            buffers[batch_id] = data
+
+    def _iter_iterable_mp(self, index_queues, data_queue, workers):
+        # iterable datasets: each worker holds its own iterator (sharded by worker_info)
+        sent = 0
+        finished = set()
+        for wid in range(self.num_workers):
+            index_queues[wid].put((sent, self.batch_size))
+            sent += 1
+        while len(finished) < self.num_workers:
+            batch_id, data = data_queue.get(timeout=self.timeout)
+            wid = batch_id % self.num_workers
+            if isinstance(data, Exception):
+                raise data
+            if data is None:
+                finished.add(wid)
+                continue
+            yield self._finalize(data)
+            index_queues[wid].put((sent, self.batch_size))
+            sent += 1
+
+    def _finalize(self, data):
+        if self._user_collate:
+            return data
+        return _to_tensor(data)
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=None, use_double_buffer=True,
+                       iterable=True, return_list=False, use_multiprocess=False,
+                       drop_last=True):
+        """fluid-era DataLoader.from_generator compatibility shim."""
+
+        class _GenLoader:
+            def __init__(self):
+                self._gen = None
+
+            def set_sample_generator(self, reader, batch_size, drop_last=True, places=None):
+                def batched():
+                    batch = []
+                    for s in reader():
+                        batch.append(s if isinstance(s, (list, tuple)) else (s,))
+                        if len(batch) == batch_size:
+                            yield default_collate_fn(batch)
+                            batch = []
+                    if batch and not drop_last:
+                        yield default_collate_fn(batch)
+
+                self._gen = batched
+                return self
+
+            def set_batch_generator(self, reader, places=None):
+                def conv():
+                    for b in reader():
+                        yield _to_tensor(list(b) if isinstance(b, (list, tuple)) else b)
+
+                self._gen = conv
+                return self
+
+            def __iter__(self):
+                return iter(self._gen())
+
+        return _GenLoader()

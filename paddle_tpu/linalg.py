@@ -1,0 +1,7 @@
+"""paddle.linalg namespace (python/paddle/linalg.py parity)."""
+from .tensor.linalg import (  # noqa: F401
+    cholesky, cholesky_solve, cond, det, eig, eigh, eigvals, eigvalsh,
+    householder_product, inv, lstsq, lu, matrix_norm, matrix_power, matrix_rank,
+    multi_dot, norm, pinv, qr, slogdet, solve, svd, triangular_solve, vector_norm,
+)
+from .tensor.math import matmul  # noqa: F401

@@ -1,0 +1,176 @@
+"""Metrics (python/paddle/metric/metrics.py parity: Metric:37 base + Accuracy:180,
+Precision:329, Recall:459, Auc:592)."""
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _np(x):
+    return np.asarray(x._data) if isinstance(x, Tensor) else np.asarray(x)
+
+
+class Metric:
+    def __init__(self):
+        pass
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None, *args, **kwargs):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred_np = _np(pred)
+        label_np = _np(label)
+        idx = np.argsort(-pred_np, axis=-1)[..., : self.maxk]
+        if label_np.ndim == pred_np.ndim and label_np.shape[-1] == 1:
+            label_np = label_np.squeeze(-1)
+        correct = idx == label_np[..., None]
+        return Tensor(correct.astype(np.float32))
+
+    def update(self, correct, *args):
+        c = _np(correct)
+        num = c.shape[0]
+        accs = []
+        for k in self.topk:
+            c_k = c[..., :k].sum(-1).mean()
+            self.total[self.topk.index(k)] += c_k * num
+            self.count[self.topk.index(k)] += num
+            accs.append(float(c_k))
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [t / c if c > 0 else 0.0 for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    def __init__(self, name="precision", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = _np(preds)
+        l = _np(labels)
+        pred_bin = (p > 0.5).astype(np.int32).reshape(-1)
+        l = l.reshape(-1).astype(np.int32)
+        self.tp += int(((pred_bin == 1) & (l == 1)).sum())
+        self.fp += int(((pred_bin == 1) & (l == 0)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name="recall", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = _np(preds)
+        l = _np(labels)
+        pred_bin = (p > 0.5).astype(np.int32).reshape(-1)
+        l = l.reshape(-1).astype(np.int32)
+        self.tp += int(((pred_bin == 1) & (l == 1)).sum())
+        self.fn += int(((pred_bin == 0) & (l == 1)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def update(self, preds, labels):
+        p = _np(preds)
+        l = _np(labels).reshape(-1).astype(np.int64)
+        if p.ndim == 2:
+            p = p[:, 1]
+        p = p.reshape(-1)
+        bins = np.clip((p * self.num_thresholds).astype(np.int64), 0, self.num_thresholds)
+        for b, y in zip(bins, l):
+            if y:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, dtype=np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds + 1, dtype=np.int64)
+
+    def accumulate(self):
+        tot_pos = 0.0
+        tot_neg = 0.0
+        auc = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            auc += (new_pos + tot_pos) * (new_neg - tot_neg) / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        denom = tot_pos * tot_neg
+        return float(auc / denom) if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """paddle.metric.accuracy functional parity."""
+    import jax.numpy as jnp
+
+    p = _np(input)
+    l = _np(label)
+    idx = np.argsort(-p, axis=-1)[:, :k]
+    if l.ndim == 2 and l.shape[1] == 1:
+        l = l[:, 0]
+    correct_v = (idx == l[:, None]).any(axis=1).mean()
+    return Tensor(jnp.asarray(np.float32(correct_v)))

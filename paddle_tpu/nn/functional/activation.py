@@ -1,0 +1,202 @@
+"""Activation functionals.
+
+Reference parity: python/paddle/nn/functional/activation.py backed by
+operators/activation_op.cc. All map to jax.nn / jnp primitives; XLA fuses them into
+surrounding matmuls (replacing operators/fused/fused_elemwise_activation_op.cc).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def relu(x, name=None):
+    return apply(jax.nn.relu, _t(x))
+
+
+def relu_(x, name=None):
+    from ...core.dispatch import apply_inplace
+
+    return apply_inplace(jax.nn.relu, x)
+
+
+def relu6(x, name=None):
+    return apply(jax.nn.relu6, _t(x))
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, _t(x))
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, _t(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda v: jax.nn.gelu(v, approximate=approximate), _t(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda v: jax.nn.leaky_relu(v, negative_slope), _t(x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(v, w):
+        if w.size == 1:
+            return jnp.where(v >= 0, v, w.reshape(()) * v)
+        ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+        shape = [1] * v.ndim
+        shape[ch_axis] = w.size
+        return jnp.where(v >= 0, v, w.reshape(shape) * v)
+
+    return apply(fn, _t(x), _t(weight))
+
+
+def rrelu(x, lower=0.125, upper=0.333, training=False, name=None):
+    mid = (lower + upper) / 2.0
+    return leaky_relu(x, mid)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.elu(v, alpha), _t(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), _t(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.celu(v, alpha), _t(x))
+
+
+def silu(x, name=None):
+    return apply(jax.nn.silu, _t(x))
+
+
+def swish(x, name=None):
+    return apply(jax.nn.silu, _t(x))
+
+
+def mish(x, name=None):
+    return apply(lambda v: v * jnp.tanh(jax.nn.softplus(v)), _t(x))
+
+
+def hardswish(x, name=None):
+    return apply(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, _t(x))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(lambda v: jnp.clip(v * slope + offset, 0.0, 1.0), _t(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda v: jnp.clip(v, min, max), _t(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(jnp.abs(v) > threshold, v, jnp.zeros_like(v)), _t(x))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(
+        lambda v: jnp.where(v > threshold, v - threshold, jnp.where(v < -threshold, v + threshold, jnp.zeros_like(v))),
+        _t(x),
+    )
+
+
+def tanhshrink(x, name=None):
+    return apply(lambda v: v - jnp.tanh(v), _t(x))
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply(lambda v: jnp.where(v > threshold, v, jnp.zeros_like(v)), _t(x))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(
+        lambda v: jnp.where(v * beta > threshold, v, jax.nn.softplus(v * beta) / beta), _t(x)
+    )
+
+
+def softsign(x, name=None):
+    return apply(jax.nn.soft_sign, _t(x))
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, _t(x))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as dtype_mod
+
+    d = dtype_mod.convert_dtype(dtype)
+
+    def fn(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.softmax(v, axis=axis)
+
+    return apply(fn, _t(x))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from ...core.dispatch import apply_inplace
+
+    return apply_inplace(lambda v: jax.nn.softmax(v, axis=axis), x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as dtype_mod
+
+    d = dtype_mod.convert_dtype(dtype)
+
+    def fn(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.log_softmax(v, axis=axis)
+
+    return apply(fn, _t(x))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core.generator import default_generator
+
+    key = default_generator().split()
+
+    def fn(v):
+        g = jax.random.gumbel(key, v.shape, dtype=v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+
+    return apply(fn, _t(x))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1 :]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+
+    return apply(fn, _t(x))
+
+
+def glu(x, axis=-1, name=None):
+    return apply(lambda v: jax.nn.glu(v, axis=axis), _t(x))
+
+
+def tanh_(x, name=None):
+    from ...core.dispatch import apply_inplace
+
+    return apply_inplace(jnp.tanh, x)

@@ -1,0 +1,294 @@
+"""Common functionals: linear, dropout, pad, interpolate, embedding-adjacent utilities.
+
+Reference parity: python/paddle/nn/functional/common.py (+ input.py) backed by
+operators/{matmul_v2,dropout,pad3d,interpolate_v2,one_hot_v2,embedding}*.
+Linear is the MXU workhorse: kept as a single jnp.matmul (+bias add) so XLA emits one
+fused GEMM.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import dtype as dtype_mod
+from ...core.dispatch import apply
+from ...core.generator import default_generator
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def linear(x, weight, bias=None, name=None):
+    from ...amp.auto_cast import amp_dtype
+
+    def fn(v, w, *b):
+        d = amp_dtype()
+        if d is not None and jnp.issubdtype(v.dtype, jnp.floating):
+            v, w = v.astype(d), w.astype(d)
+        out = jnp.matmul(v, w)
+        if b:
+            out = out + b[0].astype(out.dtype)
+        return out
+
+    if bias is None:
+        return apply(fn, _t(x), _t(weight))
+    return apply(fn, _t(x), _t(weight), _t(bias))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = _t(x)
+    if not training or p == 0.0:
+        return x
+    if p == 1.0:
+        return apply(lambda v: jnp.zeros_like(v), x)
+    key = default_generator().split()
+
+    def fn(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), jnp.zeros_like(v))
+        return jnp.where(keep, v, jnp.zeros_like(v))
+
+    return apply(fn, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = _t(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = default_generator().split()
+
+    def fn(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / np.sqrt((1.0 - p) * (1.0 + p * alpha_p**2))).astype(np.float32)
+        b = -a * alpha_p * p
+        return a * jnp.where(keep, v, jnp.full_like(v, alpha_p)) + b
+
+    return apply(fn, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = _t(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+
+    def fn(v):
+        nd = v.ndim
+        if len(pad) == 2 * nd:
+            # paddle "all-dim" format: [lo0, hi0, lo1, hi1, ...]
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # spatial-only format, reversed (last dim first): NCHW [l,r,t,b]
+            widths = [(0, 0)] * nd
+            n_spatial = len(pad) // 2
+            if data_format.startswith("NC"):
+                spatial_dims = list(range(nd - n_spatial, nd))  # pad the trailing dims
+            else:
+                spatial_dims = list(range(1, 1 + n_spatial))
+            for i, d in enumerate(reversed(spatial_dims)):
+                widths[d] = (pad[2 * i], pad[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, widths, mode="constant", constant_values=value)
+        return jnp.pad(v, widths, mode=jmode)
+
+    return apply(fn, x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode="nearest",
+    align_corners=False,
+    align_mode=0,
+    data_format="NCHW",
+    name=None,
+):
+    """operators/interpolate_v2_op.cc parity via jax.image.resize."""
+    x = _t(x)
+    nd = x.ndim
+    channel_last = not data_format.startswith("NC")
+    spatial = nd - 2
+    in_spatial = x.shape[1:-1] if channel_last else x.shape[2:]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_spatial = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * spatial
+        out_spatial = [int(d * s) for d, s in zip(in_spatial, scale_factor)]
+
+    method = {
+        "nearest": "nearest",
+        "bilinear": "bilinear",
+        "trilinear": "trilinear",
+        "bicubic": "bicubic",
+        "linear": "linear",
+        "area": "linear",
+    }[mode]
+
+    def fn(v):
+        if channel_last:
+            out_shape = (v.shape[0],) + tuple(out_spatial) + (v.shape[-1],)
+        else:
+            out_shape = v.shape[:2] + tuple(out_spatial)
+        if mode == "nearest" or not align_corners:
+            return jax.image.resize(v, out_shape, method=method)
+        # align_corners: linear interpolation on corner-aligned grid
+        sp_dims = list(range(1, 1 + spatial)) if channel_last else list(range(2, 2 + spatial))
+        out = v
+        for d, new in zip(sp_dims, out_spatial):
+            old = out.shape[d]
+            if old == new:
+                continue
+            idx = jnp.linspace(0.0, old - 1.0, new)
+            lo = jnp.floor(idx).astype(jnp.int32)
+            hi = jnp.clip(lo + 1, 0, old - 1)
+            w = (idx - lo).reshape([-1 if i == d else 1 for i in range(out.ndim)])
+            out = jnp.take(out, lo, axis=d) * (1 - w) + jnp.take(out, hi, axis=d) * w
+        return out
+
+    return apply(fn, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *bs):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bs:
+            out = out + bs[0]
+        return out
+
+    if bias is None:
+        return apply(fn, _t(x1), _t(x2), _t(weight))
+    return apply(fn, _t(x1), _t(x2), _t(weight), _t(bias))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply(fn, _t(x1), _t(x2))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(v):
+        if data_format == "NCHW":
+            b, c, h, w = v.shape
+            v = v.reshape(b, c // (r * r), r, r, h, w)
+            v = jnp.transpose(v, (0, 1, 4, 2, 5, 3))
+            return v.reshape(b, c // (r * r), h * r, w * r)
+        b, h, w, c = v.shape
+        v = v.reshape(b, h, w, c // (r * r), r, r)
+        v = jnp.transpose(v, (0, 1, 4, 2, 5, 3))
+        return v.reshape(b, h * r, w * r, c // (r * r))
+
+    return apply(fn, _t(x))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(v):
+        if data_format == "NCHW":
+            b, c, h, w = v.shape
+            v = v.reshape(b, c, h // r, r, w // r, r)
+            v = jnp.transpose(v, (0, 1, 3, 5, 2, 4))
+            return v.reshape(b, c * r * r, h // r, w // r)
+        raise NotImplementedError
+
+    return apply(fn, _t(x))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """operators/unfold_op.cc parity (im2col)."""
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings) if not (isinstance(paddings, (list, tuple)) and len(paddings) == 4) else (paddings[0], paddings[1])
+    dh, dw = _pair(dilations)
+
+    def fn(v):
+        b, c, h, w = v.shape
+        v = jnp.pad(v, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        out_h = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        out_w = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        patches = []
+        for i in range(kh):
+            for j in range(kw):
+                sl = v[:, :, i * dh : i * dh + out_h * sh : sh, j * dw : j * dw + out_w * sw : sw]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # [b, c, kh*kw, oh, ow]
+        return out.reshape(b, c * kh * kw, out_h * out_w)
+
+    return apply(fn, _t(x))
+
+
+def one_hot(x, num_classes, name=None):
+    out = apply(lambda v: jax.nn.one_hot(v.astype(jnp.int32), num_classes, dtype=jnp.float32), _t(x).detach())
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """operators/lookup_table_v2_op.cc parity. `sparse` (SelectedRows grads) is a no-op:
+    XLA scatter-add on the gather VJP is already sparse-friendly."""
+
+    def fn(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (ids != padding_idx)[..., None]
+            out = out * mask.astype(out.dtype)
+        return out
+
+    return apply(fn, _t(x).detach(), _t(weight))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._data if isinstance(prior_dist, Tensor) else jnp.asarray(prior_dist)
+            return (1 - epsilon) * l + epsilon * pd
+        return (1 - epsilon) * l + epsilon / k
+
+    return apply(fn, _t(label))
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample: PS-mode op, deferred")

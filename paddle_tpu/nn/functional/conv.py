@@ -1,0 +1,128 @@
+"""Convolution functionals.
+
+Reference parity: python/paddle/nn/functional/conv.py backed by operators/conv_op.cc /
+conv_cudnn_op.cu / conv_transpose_op.cc.
+TPU-native design: all convs lower to a single lax.conv_general_dilated — XLA maps it
+onto the MXU (no cuDNN algorithm search / workspace logic needed).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def _ntuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(p, p) if isinstance(p, int) else tuple(p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, channel_last, transpose=False, output_padding=0):
+    strides = _ntuple(stride, n)
+    dils = _ntuple(dilation, n)
+    pad = _padding(padding, n)
+
+    if channel_last:
+        lhs_spec = "N" + "DHW"[3 - n :] + "C"
+    else:
+        lhs_spec = "NC" + "DHW"[3 - n :]
+    out_spec = lhs_spec
+    rhs_spec = "OI" + "DHW"[3 - n :]
+    dn = jax.lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2), (lhs_spec, rhs_spec, out_spec))
+
+    if not transpose:
+        def fn(v, w, *b):
+            out = jax.lax.conv_general_dilated(
+                v, w, window_strides=strides, padding=pad,
+                rhs_dilation=dils, dimension_numbers=dn, feature_group_count=groups,
+                preferred_element_type=None,
+            )
+            if b:
+                bias_shape = [1] * out.ndim
+                bias_shape[out.ndim - 1 if channel_last else 1] = b[0].shape[0]
+                out = out + b[0].reshape(bias_shape)
+            return out
+    else:
+        opad = _ntuple(output_padding, n)
+
+        def fn(v, w, *b):
+            # conv_transpose: lhs_dilation = stride; weight layout [in, out//groups, *k]
+            k_dims = w.shape[2:]
+            if isinstance(pad, str):
+                pads = [(0, 0)] * n if pad == "VALID" else None
+                if pads is None:
+                    raise ValueError("SAME padding unsupported for conv_transpose")
+            else:
+                pads = [
+                    (dils[i] * (k_dims[i] - 1) - pad[i][0],
+                     dils[i] * (k_dims[i] - 1) - pad[i][1] + opad[i])
+                    for i in range(n)
+                ]
+            # weight [I, O/g, *k] -> flip spatial, swap to [O, I/g? ...]
+            w_t = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+            if groups == 1:
+                w_t = jnp.swapaxes(w_t, 0, 1)  # [O, I, *k]
+            else:
+                i, og = w.shape[0], w.shape[1]
+                w_g = w_t.reshape((groups, i // groups, og) + k_dims)
+                w_g = jnp.swapaxes(w_g, 1, 2)  # [g, og, i/g, *k]
+                w_t = w_g.reshape((groups * og, i // groups) + k_dims)
+            out = jax.lax.conv_general_dilated(
+                v, w_t, window_strides=(1,) * n, padding=pads,
+                lhs_dilation=strides, rhs_dilation=dils, dimension_numbers=dn,
+                feature_group_count=groups,
+            )
+            if b:
+                bias_shape = [1] * out.ndim
+                bias_shape[out.ndim - 1 if channel_last else 1] = b[0].shape[0]
+                out = out + b[0].reshape(bias_shape)
+            return out
+
+    args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return apply(fn, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, data_format in ("NLC",))
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format == "NHWC")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format == "NDHWC")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, data_format == "NLC", transpose=True, output_padding=output_padding)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format == "NHWC", transpose=True, output_padding=output_padding)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format == "NDHWC", transpose=True, output_padding=output_padding)

@@ -1,0 +1,129 @@
+"""Extension functionals (python/paddle/nn/functional/extension.py + vision.py parity):
+sequence_mask, temporal_shift, affine_grid, grid_sample, diag_embed."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...core import dtype as dtype_mod
+
+    x = _t(x)
+    ml = maxlen if maxlen is not None else int(np.asarray(x._data).max())
+    d = dtype_mod.convert_dtype(dtype)
+
+    def fn(v):
+        rng = jnp.arange(ml)
+        return (rng[None, :] < v[..., None]).astype(d)
+
+    out = apply(fn, x.detach())
+    out.stop_gradient = True
+    return out
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def fn(v):
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v = v.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold : 2 * fold]), v[:, :-1, fold : 2 * fold]], axis=1)
+        rest = v[:, :, 2 * fold :]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+
+    return apply(fn, _t(x))
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    def fn(v):
+        n = v.shape[-1]
+        size = n + abs(offset)
+        out = jnp.zeros(v.shape[:-1] + (size, size), dtype=v.dtype)
+        idx = jnp.arange(n)
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(v)
+        if dim1 != -2 or dim2 != -1:
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+
+    return apply(fn, _t(input))
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    if isinstance(out_shape, Tensor):
+        out_shape = out_shape.tolist()
+    n, c, h, w = [int(s) for s in out_shape]
+
+    def fn(th):
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) + 0.5) / h * 2 - 1
+            xs = (jnp.arange(w) + 0.5) / w * 2 - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # [h*w, 3]
+        out = jnp.einsum("nij,kj->nki", th, base)  # [n, h*w, 2]
+        return out.reshape(n, h, w, 2)
+
+    return apply(fn, _t(theta))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    def fn(v, g):
+        n, c, h, w = v.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(ix, iy):
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+            val = v[jnp.arange(n)[:, None, None], :, iyc, ixc]  # [n, gh, gw, c]
+            if padding_mode == "zeros":
+                ok = ((ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1))[..., None]
+                val = val * ok.astype(val.dtype)
+            return val
+
+        if mode == "nearest":
+            out = sample(jnp.round(fx).astype(jnp.int32), jnp.round(fy).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            x1, y1 = x0 + 1, y0 + 1
+            wx = fx - x0
+            wy = fy - y0
+            v00 = sample(x0, y0)
+            v01 = sample(x1, y0)
+            v10 = sample(x0, y1)
+            v11 = sample(x1, y1)
+            wx = wx[..., None]
+            wy = wy[..., None]
+            out = (
+                v00 * (1 - wx) * (1 - wy)
+                + v01 * wx * (1 - wy)
+                + v10 * (1 - wx) * wy
+                + v11 * wx * wy
+            )
+        return jnp.moveaxis(out, -1, 1)  # [n, c, gh, gw]
+
+    return apply(fn, _t(x), _t(grid))
+
+
+def npu_identity(x, op_flag=0):
+    return _t(x)

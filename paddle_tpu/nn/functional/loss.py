@@ -1,0 +1,311 @@
+"""Loss functionals.
+
+Reference parity: python/paddle/nn/functional/loss.py backed by
+operators/{cross_entropy_op,softmax_with_cross_entropy_op,bce_loss_op,smooth_l1_loss_op,
+kldiv_loss_op,margin_rank_loss_op,nll_loss_op,ctc_align_op/warpctc_op,hinge_loss_op}.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    """softmax_with_cross_entropy_op.cc parity (fused log-softmax + NLL on TPU)."""
+    args = [_t(input), _t(label) if soft_label else _t(label).detach()]
+    if weight is not None:
+        args.append(_t(weight).detach())
+
+    def fn(logits, label_v, *w):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(jnp.clip(logits, 1e-30, None))
+        n_classes = logits.shape[axis]
+        if soft_label or (label_v.ndim == logits.ndim and label_v.shape == logits.shape):
+            soft = label_v
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=axis)
+            return _reduce(loss, reduction)
+        ids = label_v
+        if ids.ndim == logits.ndim and ids.shape[axis] == 1:
+            ids = jnp.squeeze(ids, axis=axis)
+        ids = ids.astype(jnp.int32)
+        valid = ids != ignore_index
+        safe_ids = jnp.where(valid, ids, 0)
+        oh = jax.nn.one_hot(safe_ids, n_classes, axis=axis, dtype=logp.dtype)
+        if label_smoothing > 0:
+            oh = oh * (1 - label_smoothing) + label_smoothing / n_classes
+        loss = -jnp.sum(oh * logp, axis=axis)
+        loss = jnp.where(valid, loss, 0.0)
+        if w:
+            wt = jnp.take(w[0], safe_ids, axis=0) * valid
+            loss = loss * wt
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+
+    return apply(fn, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index, reduction="none", axis=axis)
+    from .activation import softmax as _softmax
+
+    loss = loss.unsqueeze(axis) if loss.ndim < _t(logits).ndim else loss
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    return cross_entropy(input, label, weight=weight, ignore_index=ignore_index, reduction=reduction, use_softmax=False, soft_label=False) if False else _nll(input, label, weight, ignore_index, reduction)
+
+
+def _nll(input, label, weight, ignore_index, reduction):
+    args = [_t(input), _t(label).detach()]
+    if weight is not None:
+        args.append(_t(weight).detach())
+
+    def fn(logp, ids, *w):
+        ids = ids.astype(jnp.int32)
+        valid = ids != ignore_index
+        safe = jnp.where(valid, ids, 0)
+        picked = jnp.take_along_axis(logp, safe[..., None] if logp.ndim == ids.ndim + 1 else safe, axis=1 if logp.ndim > 1 else 0)
+        if logp.ndim == ids.ndim + 1:
+            picked = jnp.squeeze(picked, axis=1)
+        loss = -picked * valid
+        if w:
+            wt = jnp.take(w[0], safe, axis=0) * valid
+            loss = loss * wt
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+
+    return apply(fn, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce((a - b) ** 2, reduction), _t(input), _t(label))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction), _t(input), _t(label))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        # paddle multiplies by delta
+        return _reduce(loss * delta, reduction)
+
+    return apply(fn, _t(input), _t(label))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight).detach())
+
+    def fn(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    return apply(fn, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    args = [_t(logit), _t(label)]
+    if weight is not None:
+        args.append(_t(weight).detach())
+
+    def fn(z, y, *w):
+        pw = pos_weight._data if isinstance(pos_weight, Tensor) else pos_weight
+        # numerically-stable BCE-with-logits
+        log_sig = jax.nn.log_sigmoid(z)
+        log_sig_neg = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            loss = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        else:
+            loss = -(y * log_sig + (1 - y) * log_sig_neg)
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    return apply(fn, *args)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def fn(logp, y):
+        loss = y * (jnp.log(jnp.clip(y, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply(fn, _t(input), _t(label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply(
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        _t(input), _t(other), _t(label),
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply(
+        lambda a, y: _reduce(jnp.where(y == 1, a, jnp.maximum(0.0, margin - a)), reduction),
+        _t(input), _t(label).detach(),
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / (jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return apply(fn, _t(input1), _t(input2), _t(label).detach())
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+    return apply(fn, _t(input), _t(positive), _t(negative))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    """warpctc_op parity — forward-backward in pure XLA (scan over time).
+
+    log_probs: [T, B, C] (paddle layout), labels: [B, S] int32.
+    """
+    args = [_t(log_probs), _t(labels).detach(), _t(input_lengths).detach(), _t(label_lengths).detach()]
+
+    def fn(lp, lab, in_len, lab_len):
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        # extended label sequence with blanks: length 2S+1
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        ext_len = 2 * lab_len.astype(jnp.int32) + 1
+        neg_inf = jnp.asarray(-1e30, dtype=lp.dtype)
+        # allow skip when ext[s] != blank and ext[s] != ext[s-2]
+        can_skip = jnp.concatenate(
+            [jnp.zeros((B, 2), dtype=bool), (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])], axis=1
+        )
+        alpha0 = jnp.full((B, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lab = jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(first_lab)
+
+        def step(alpha, lp_t):
+            a_prev = alpha
+            a_shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(can_skip, a_shift2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        def scan_step(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, lp[t])
+            # freeze beyond input length
+            new_alpha = jnp.where((t < in_len)[:, None], new_alpha, alpha)
+            return new_alpha, None
+
+        alpha, _ = jax.lax.scan(scan_step, alpha0, jnp.arange(1, T))
+        idx_last = ext_len - 1
+        a1 = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+        a2 = jnp.take_along_axis(alpha, jnp.maximum(idx_last - 1, 0)[:, None], axis=1)[:, 0]
+        ll = jnp.logaddexp(a1, a2)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(loss.dtype), 1.0))
+        return _reduce(loss, reduction)
+
+    return apply(fn, *args)
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: (a - b) ** 2, _t(input), _t(label))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    args = [_t(logit), _t(label)]
+
+    def fn(z, y):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if normalizer is not None:
+            nv = normalizer._data if isinstance(normalizer, Tensor) else normalizer
+            loss = loss / nv
+        return _reduce(loss, reduction)
+
+    return apply(fn, *args)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply(
+        lambda p, y: -(y * jnp.log(p + epsilon) + (1 - y) * jnp.log(1 - p + epsilon)),
+        _t(input), _t(label),
+    )
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def fn(a, p, y):
+        batch = a.shape[0]
+        sim = a @ p.T
+        y = y.reshape(-1, 1)
+        tgt = (y == y.T).astype(sim.dtype)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        xent = -jnp.mean(jnp.sum(tgt * jax.nn.log_softmax(sim, axis=1), axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1)) + jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+        return xent + reg * 2
+
+    return apply(fn, _t(anchor), _t(positive), _t(labels).detach())
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None, path_code=None, is_sparse=False, name=None):
+    raise NotImplementedError("hsigmoid_loss: deferred (hierarchical softmax)")

@@ -1,0 +1,169 @@
+"""Normalization functionals.
+
+Reference parity: python/paddle/nn/functional/norm.py backed by
+operators/{batch_norm,layer_norm,instance_norm,group_norm}_op.cc.
+BatchNorm keeps running stats on the host-side Layer (buffers); inside jit the update is
+functional (new stats returned via buffer rebinding).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-5,
+    data_format="NCHW",
+    use_global_stats=None,
+    name=None,
+):
+    x = _t(x)
+    ch_axis = x.ndim - 1 if data_format.endswith("C") and data_format != "NC" else 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        # compute batch stats eagerly; update running buffers (momentum convention:
+        # running = momentum*running + (1-momentum)*batch, operators/batch_norm_op.cc)
+        def stats(v):
+            m = jnp.mean(v, axis=reduce_axes)
+            var = jnp.var(v, axis=reduce_axes)
+            return m, var
+
+        m_t, v_t = apply(stats, x)
+        running_mean._data = momentum * running_mean._data + (1 - momentum) * jnp.asarray(m_t._data, dtype=running_mean.dtype)
+        running_var._data = momentum * running_var._data + (1 - momentum) * jnp.asarray(v_t._data, dtype=running_var.dtype)
+
+        def fn(v, m, var, *wb):
+            out = (v - m.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+            if len(wb) >= 1:
+                out = out * wb[0].reshape(shape)
+            if len(wb) == 2:
+                out = out + wb[1].reshape(shape)
+            return out
+
+        args = [x, m_t, v_t]
+    else:
+        def fn(v, m, var, *wb):
+            out = (v - m.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+            if len(wb) >= 1:
+                out = out * wb[0].reshape(shape)
+            if len(wb) == 2:
+                out = out + wb[1].reshape(shape)
+            return out
+
+        args = [x, _t(running_mean).detach(), _t(running_var).detach()]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply(fn, *args)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n = len(normalized_shape)
+
+    def fn(v, *wb):
+        axes = tuple(range(v.ndim - n, v.ndim))
+        m = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - m) / jnp.sqrt(var + epsilon)
+        if len(wb) >= 1:
+            out = out * wb[0]
+        if len(wb) == 2:
+            out = out + wb[1]
+        return out
+
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply(fn, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    def fn(v, *wb):
+        axes = tuple(range(2, v.ndim))
+        m = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - m) / jnp.sqrt(var + eps)
+        c = v.shape[1]
+        shape = [1, c] + [1] * (v.ndim - 2)
+        if len(wb) >= 1:
+            out = out * wb[0].reshape(shape)
+        if len(wb) == 2:
+            out = out + wb[1].reshape(shape)
+        return out
+
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply(fn, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):
+    def fn(v, *wb):
+        b, c = v.shape[0], v.shape[1]
+        spatial = v.shape[2:]
+        g = v.reshape((b, num_groups, c // num_groups) + spatial)
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - m) / jnp.sqrt(var + epsilon)).reshape(v.shape)
+        shape = [1, c] + [1] * (v.ndim - 2)
+        if len(wb) >= 1:
+            out = out * wb[0].reshape(shape)
+        if len(wb) == 2:
+            out = out + wb[1].reshape(shape)
+        return out
+
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply(fn, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def fn(v):
+        sq = v * v
+        half = size // 2
+        c = v.shape[1]
+        pad_width = [(0, 0)] * v.ndim
+        pad_width[1] = (half, size - half - 1)
+        padded = jnp.pad(sq, pad_width)
+        acc = jnp.zeros_like(v)
+        for i in range(size):
+            acc = acc + jnp.take(padded, jnp.arange(i, i + c), axis=1)
+        return v / jnp.power(k + alpha * acc / size, beta)
+
+    return apply(fn, _t(x))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(v):
+        norm_v = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(norm_v, epsilon)
+
+    return apply(fn, _t(x))

@@ -1,0 +1,192 @@
+"""Pooling functionals.
+
+Reference parity: python/paddle/nn/functional/pooling.py backed by operators/pool_op.cc.
+All pools lower to lax.reduce_window; adaptive pools compute per-output windows.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def _ntuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    return v * n if len(v) == 1 else v
+
+
+def _pool(x, kernel, stride, padding, n, op, channel_last, ceil_mode=False, exclusive=True, count_include_pad=False):
+    ks = _ntuple(kernel, n)
+    st = _ntuple(stride if stride is not None else kernel, n)
+    pd = _ntuple(padding, n) if not isinstance(padding, str) else padding
+
+    def fn(v):
+        if channel_last:
+            window = (1,) + ks + (1,)
+            strides = (1,) + st + (1,)
+            if isinstance(pd, str):
+                pads = pd.upper()
+            else:
+                pads = ((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),)
+        else:
+            window = (1, 1) + ks
+            strides = (1, 1) + st
+            if isinstance(pd, str):
+                pads = pd.upper()
+            else:
+                pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+        if op == "max":
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+            return jax.lax.reduce_window(v, init, jax.lax.max, window, strides, pads)
+        # avg
+        summed = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides, pads)
+        if isinstance(pads, str) or count_include_pad or not exclusive:
+            denom = float(np.prod(ks))
+            return summed / denom
+        ones = jnp.ones_like(v)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+        return summed / counts
+
+    return apply(fn, _t(x))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, "max", data_format == "NLC", ceil_mode)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, "max", data_format == "NHWC", ceil_mode)
+    if return_mask:
+        idx = _max_pool_indices(x, kernel_size, stride, padding, 2)
+        return out, idx
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "max", data_format == "NDHWC", ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", data_format == "NLC", ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", data_format == "NHWC", ceil_mode, exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", data_format == "NDHWC", ceil_mode, exclusive)
+
+
+def _max_pool_indices(x, kernel, stride, padding, n):
+    # indices of maxima within each window, flattened per spatial map (paddle semantics)
+    x = _t(x)
+    ks = _ntuple(kernel, n)
+    st = _ntuple(stride if stride is not None else kernel, n)
+
+    def fn(v):
+        flat_idx = jnp.arange(int(np.prod(v.shape[2:]))).reshape((1, 1) + v.shape[2:]).astype(jnp.float32)
+        idx_b = jnp.broadcast_to(flat_idx, v.shape)
+
+        def reducer(a, b):
+            av, ai = a
+            bv, bi = b
+            take_b = bv > av
+            return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        init = (-jnp.inf, jnp.float32(-1))
+        vals, idxs = jax.lax.reduce_window((v, idx_b), init, reducer, window, strides, "VALID")
+        return idxs.astype(jnp.int32)
+
+    out = apply(fn, x.detach())
+    out.stop_gradient = True
+    return out
+
+
+def _adaptive_windows(in_size, out_size):
+    starts = [int(np.floor(i * in_size / out_size)) for i in range(out_size)]
+    ends = [int(np.ceil((i + 1) * in_size / out_size)) for i in range(out_size)]
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, n, op, channel_last=False):
+    x = _t(x)
+    spatial = x.shape[2:] if not channel_last else x.shape[1:-1]
+    out_size = _ntuple(output_size, n)
+    out_size = tuple(s if o is None else o for s, o in zip(spatial, out_size))
+
+    def fn(v):
+        # reduce one spatial dim at a time with gathered windows
+        out = v
+        for d in range(n):
+            axis = (2 + d) if not channel_last else (1 + d)
+            in_s = out.shape[axis]
+            o_s = out_size[d]
+            if in_s == o_s:
+                continue
+            starts, ends = _adaptive_windows(in_s, o_s)
+            slices = []
+            for s, e in zip(starts, ends):
+                win = jax.lax.slice_in_dim(out, s, e, axis=axis)
+                red = jnp.max(win, axis=axis, keepdims=True) if op == "max" else jnp.mean(win, axis=axis, keepdims=True)
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=axis)
+        return out
+
+    return apply(fn, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg", data_format == "NHWC")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg", data_format == "NDHWC")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "max")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="NCHW", output_size=None, name=None):
+    ks = _ntuple(kernel_size, 2)
+    st = _ntuple(stride if stride is not None else kernel_size, 2)
+
+    def fn(v, idx):
+        b, c, h, w = v.shape
+        if output_size is not None:
+            oh, ow = output_size[-2:]
+        else:
+            oh = (h - 1) * st[0] + ks[0]
+            ow = (w - 1) * st[1] + ks[1]
+        flat = jnp.zeros((b, c, oh * ow), dtype=v.dtype)
+        idx_f = idx.reshape(b, c, -1).astype(jnp.int32)
+        v_f = v.reshape(b, c, -1)
+        bi = jnp.arange(b)[:, None, None]
+        ci = jnp.arange(c)[None, :, None]
+        flat = flat.at[bi, ci, idx_f].set(v_f)
+        return flat.reshape(b, c, oh, ow)
+
+    return apply(fn, _t(x), _t(indices).detach())

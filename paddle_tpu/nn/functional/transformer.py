@@ -1,0 +1,73 @@
+"""Attention functionals.
+
+Reference parity: the reference has no fused attention op (MultiHeadAttention composes
+matmuls in python/paddle/nn/layer/transformer.py:83); this module goes beyond it with a
+single attention entry point that can route to the Pallas flash-attention kernel
+(paddle_tpu/ops/flash_attention.py) on TPU, or the naive XLA path elsewhere.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def scaled_dot_product_attention(
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
+):
+    """query/key/value: [batch, seq, heads, head_dim] (paddle 2.x layout).
+
+    Routes to the Pallas flash kernel when shapes allow (TPU, no mask beyond causal);
+    falls back to the naive XLA softmax(QK^T)V otherwise.
+    """
+    args = [_t(query), _t(key), _t(value)]
+    mask_val = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
+
+    use_flash = False
+    try:
+        from ...ops import flash_attention as fa
+
+        q = args[0]
+        use_flash = (
+            mask_val is None
+            and dropout_p == 0.0
+            and fa.supported(tuple(q.shape), str(q.dtype))
+        )
+    except Exception:
+        use_flash = False
+
+    if use_flash:
+        def fn(q, k, v):
+            return fa.flash_attention(q, k, v, causal=is_causal)
+
+        return apply(fn, *args)
+
+    def fn(q, k, v):
+        # [b, s, h, d] -> [b, h, s, d]
+        q = jnp.swapaxes(q, 1, 2)
+        k = jnp.swapaxes(k, 1, 2)
+        v = jnp.swapaxes(v, 1, 2)
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        if mask_val is not None:
+            m = mask_val
+            if m.dtype == jnp.bool_:
+                scores = jnp.where(m, scores, jnp.asarray(-1e30, scores.dtype))
+            else:
+                scores = scores + m.astype(scores.dtype)
+        if is_causal:
+            s_q, s_k = scores.shape[-2], scores.shape[-1]
+            causal = jnp.tril(jnp.ones((s_q, s_k), dtype=bool))
+            scores = jnp.where(causal, scores, jnp.asarray(-1e30, scores.dtype))
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        return jnp.swapaxes(out, 1, 2)
+
+    return apply(fn, *args)

@@ -1,0 +1,169 @@
+"""Weight initializers.
+
+Reference parity: python/paddle/fluid/initializer.py (Constant, Uniform, Normal,
+TruncatedNormal, Xavier, MSRA/Kaiming, Bilinear, Assign) re-exported as
+paddle.nn.initializer.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.generator import default_generator
+
+
+def _key():
+    return default_generator().split()
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels: paddle stores [out_c, in_c, *spatial]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(tuple(shape), self.value, dtype=dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        return jax.random.uniform(_key(), tuple(shape), dtype=jnp.float32, minval=self.low, maxval=self.high).astype(dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        return (jax.random.normal(_key(), tuple(shape), dtype=jnp.float32) * self.std + self.mean).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        return (jax.random.truncated_normal(_key(), -2.0, 2.0, tuple(shape), dtype=jnp.float32) * self.std + self.mean).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(_key(), tuple(shape), dtype=jnp.float32, minval=-limit, maxval=limit).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return (jax.random.normal(_key(), tuple(shape), dtype=jnp.float32) * std).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope**2))
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(_key(), tuple(shape), dtype=jnp.float32, minval=-limit, maxval=limit).astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope**2))
+        std = gain / math.sqrt(fi)
+        return (jax.random.normal(_key(), tuple(shape), dtype=jnp.float32) * std).astype(dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        from ..core.tensor import Tensor
+
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v.numpy()
+        arr = jnp.asarray(np.asarray(v), dtype=dtype)
+        return arr.reshape(tuple(shape)) if arr.shape != tuple(shape) else arr
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel init (fluid/initializer.py BilinearInitializer)."""
+
+    def __call__(self, shape, dtype):
+        weight = np.zeros(tuple(shape), dtype=np.float32)
+        f = math.ceil(shape[3] / 2)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for k in range(int(np.prod(shape))):
+            idx = np.unravel_index(k, shape)
+            x, y = idx[3], idx[2]
+            weight[idx] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return jnp.asarray(weight, dtype=dtype)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        flat = (shape[0], int(np.prod(shape[1:])))
+        a = jax.random.normal(_key(), flat, dtype=jnp.float32)
+        q, r = jnp.linalg.qr(a if flat[0] >= flat[1] else a.T)
+        q = q * jnp.sign(jnp.diag(r))
+        if flat[0] < flat[1]:
+            q = q.T
+        return (self.gain * q.reshape(tuple(shape))).astype(dtype)
+
+
+class Dirac(Initializer):
+    def __call__(self, shape, dtype):
+        w = np.zeros(tuple(shape), dtype=np.float32)
+        oc, ic = shape[0], shape[1]
+        centers = [s // 2 for s in shape[2:]]
+        for i in range(min(oc, ic)):
+            w[(i, i) + tuple(centers)] = 1.0
+        return jnp.asarray(w, dtype=dtype)

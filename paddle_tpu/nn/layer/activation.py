@@ -1,0 +1,98 @@
+"""Activation layers (python/paddle/nn/layer/activation.py parity — 23 classes)."""
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+
+def _simple(fn_name, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = {**fixed}
+            # map positional args onto the functional's keyword names in order
+            names = [k for k in _ARG_NAMES.get(fn_name, [])]
+            for n, v in zip(names, args):
+                self._kwargs[n] = v
+            for k, v in kwargs.items():
+                if k != "name":
+                    self._kwargs[k] = v
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._kwargs)
+
+    _Act.__name__ = fn_name.title().replace("_", "")
+    return _Act
+
+
+_ARG_NAMES = {
+    "elu": ["alpha"],
+    "gelu": ["approximate"],
+    "hardshrink": ["threshold"],
+    "hardtanh": ["min", "max"],
+    "hardsigmoid": [],
+    "leaky_relu": ["negative_slope"],
+    "log_softmax": ["axis"],
+    "maxout": ["groups", "axis"],
+    "softmax": ["axis"],
+    "softplus": ["beta", "threshold"],
+    "softshrink": ["threshold"],
+    "thresholded_relu": ["threshold"],
+    "celu": ["alpha"],
+}
+
+ELU = _simple("elu")
+GELU = _simple("gelu")
+Hardshrink = _simple("hardshrink")
+Hardswish = _simple("hardswish")
+Tanh = _simple("tanh")
+Hardtanh = _simple("hardtanh")
+ReLU = _simple("relu")
+ReLU6 = _simple("relu6")
+SELU = _simple("selu")
+CELU = _simple("celu")
+LeakyReLU = _simple("leaky_relu")
+Sigmoid = _simple("sigmoid")
+Hardsigmoid = _simple("hardsigmoid")
+Softplus = _simple("softplus")
+Softshrink = _simple("softshrink")
+Softsign = _simple("softsign")
+Swish = _simple("swish")
+Silu = _simple("silu")
+Mish = _simple("mish")
+Tanhshrink = _simple("tanhshrink")
+ThresholdedReLU = _simple("thresholded_relu")
+LogSigmoid = _simple("log_sigmoid")
+Softmax = _simple("softmax")
+LogSoftmax = _simple("log_softmax")
+Maxout = _simple("maxout")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[num_parameters], attr=weight_attr, default_initializer=I.Constant(init)
+        )
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self._data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=0.125, upper=0.3333333333333333, name=None):
+        super().__init__()
+        self.lower = lower
+        self.upper = upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class GLU(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.glu(x, axis=self.axis)

@@ -1,0 +1,279 @@
+"""RNN layers (python/paddle/nn/layer/rnn.py parity): SimpleRNNCell/LSTMCell/GRUCell,
+RNN/BiRNN wrappers, SimpleRNN/LSTM/GRU multi-layer nets.
+
+TPU-native design: the whole sequence loop is ONE lax.scan inside one autodiff apply()
+(the reference runs cuDNN fused kernels, operators/cudnn_lstm_op.cu.cc; scan+matmul gets
+the same fusion from XLA without a hand-written kernel). Gate weight layout matches
+paddle: weight_ih [gates*hidden, input], weight_hh [gates*hidden, hidden].
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from .. import functional as F  # noqa: F401
+from .. import initializer as I
+from .layers import Layer
+
+
+def _cell_params(layer, input_size, hidden_size, gates, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+    std = 1.0 / math.sqrt(hidden_size)
+    layer.weight_ih = layer.create_parameter([gates * hidden_size, input_size], attr=weight_ih_attr, default_initializer=I.Uniform(-std, std))
+    layer.weight_hh = layer.create_parameter([gates * hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=I.Uniform(-std, std))
+    layer.bias_ih = layer.create_parameter([gates * hidden_size], attr=bias_ih_attr, is_bias=True, default_initializer=I.Uniform(-std, std))
+    layer.bias_hh = layer.create_parameter([gates * hidden_size], attr=bias_hh_attr, is_bias=True, default_initializer=I.Uniform(-std, std))
+
+
+def _simple_rnn_step(x, h, w_ih, w_hh, b_ih, b_hh, activation="tanh"):
+    z = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    return jnp.tanh(z) if activation == "tanh" else jax.nn.relu(z)
+
+
+def _lstm_step(x, hc, w_ih, w_hh, b_ih, b_hh):
+    h, c = hc
+    z = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def _gru_step(x, h, w_ih, w_hh, b_ih, b_hh):
+    xz = x @ w_ih.T + b_ih
+    hz = h @ w_hh.T + b_hh
+    xr, xu, xn = jnp.split(xz, 3, axis=-1)
+    hr, hu, hn = jnp.split(hz, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    u = jax.nn.sigmoid(xu + hu)
+    n = jnp.tanh(xn + r * hn)
+    return (1 - u) * n + u * h
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        h = Tensor(jnp.full((batch, self.hidden_size), init_value, dtype=jnp.float32))
+        if getattr(self, "state_components", 1) == 2:
+            c = Tensor(jnp.full((batch, self.hidden_size), init_value, dtype=jnp.float32))
+            return h, c
+        return h
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self.state_components = 1
+        _cell_params(self, input_size, hidden_size, 1, weight_ih_attr, weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = apply(
+            lambda x, h, wi, wh, bi, bh: _simple_rnn_step(x, h, wi, wh, bi, bh, self.activation),
+            inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+        )
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.state_components = 2
+        _cell_params(self, input_size, hidden_size, 4, weight_ih_attr, weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        h_new, c_new = apply(
+            lambda x, hh, cc, wi, wh, bi, bh: _lstm_step(x, (hh, cc), wi, wh, bi, bh),
+            inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+        )
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.state_components = 1
+        _cell_params(self, input_size, hidden_size, 3, weight_ih_attr, weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = apply(
+            _gru_step, inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+        )
+        return out, out
+
+
+class RNN(Layer):
+    """Runs a cell over a sequence with lax.scan (layer/rnn.py RNN parity)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        cell = self.cell
+        mode = {SimpleRNNCell: "rnn", LSTMCell: "lstm", GRUCell: "gru"}[type(cell)]
+        act = getattr(cell, "activation", "tanh")
+        batch_axis = 1 if self.time_major else 0
+
+        x = inputs
+        if initial_states is None:
+            ref = x
+            batch = x.shape[0 if not self.time_major else 1]
+            h0 = Tensor(jnp.zeros((batch, cell.hidden_size), dtype=jnp.float32))
+            initial_states = (h0, Tensor(jnp.zeros((batch, cell.hidden_size), dtype=jnp.float32))) if mode == "lstm" else h0
+
+        states = list(initial_states) if isinstance(initial_states, (tuple, list)) else [initial_states]
+        rev = self.is_reverse
+        tm = self.time_major
+
+        def fn(xv, *rest):
+            sts = rest[: len(states)]
+            wi, wh, bi, bh = rest[len(states) :]
+            seq = xv if tm else jnp.swapaxes(xv, 0, 1)  # [T, B, D]
+            if rev:
+                seq = jnp.flip(seq, axis=0)
+
+            def step(carry, xt):
+                if mode == "lstm":
+                    h_new, c_new = _lstm_step(xt, carry, wi, wh, bi, bh)
+                    return (h_new, c_new), h_new
+                if mode == "gru":
+                    h_new = _gru_step(xt, carry[0], wi, wh, bi, bh)
+                    return (h_new,), h_new
+                h_new = _simple_rnn_step(xt, carry[0], wi, wh, bi, bh, act)
+                return (h_new,), h_new
+
+            carry0 = tuple(sts)
+            carry, outs = jax.lax.scan(step, carry0, seq)
+            if rev:
+                outs = jnp.flip(outs, axis=0)
+            if not tm:
+                outs = jnp.swapaxes(outs, 0, 1)
+            return (outs,) + carry
+
+        results = apply(fn, x, *states, cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh)
+        outs = results[0]
+        final = results[1:]
+        if mode == "lstm":
+            return outs, (final[0], final[1])
+        return outs, final[0]
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states_fw, states_bw = (initial_states if initial_states is not None else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        from ...tensor.manipulation import concat
+
+        out = concat([out_fw, out_bw], axis=-1)
+        return out, (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if bidirect else 1
+        self.state_components = 2 if mode == "lstm" else 1
+
+        def make_cell(in_size):
+            if mode == "lstm":
+                return LSTMCell(in_size, hidden_size, weight_ih_attr, weight_hh_attr, bias_ih_attr, bias_hh_attr)
+            if mode == "gru":
+                return GRUCell(in_size, hidden_size, weight_ih_attr, weight_hh_attr, bias_ih_attr, bias_hh_attr)
+            return SimpleRNNCell(in_size, hidden_size, activation, weight_ih_attr, weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+        from .common import LayerList
+
+        self.rnns = LayerList()
+        for layer_i in range(num_layers):
+            in_size = input_size if layer_i == 0 else hidden_size * self.num_directions
+            if bidirect:
+                self.rnns.append(BiRNN(make_cell(in_size), make_cell(in_size), time_major))
+            else:
+                self.rnns.append(RNN(make_cell(in_size), time_major=time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import stack
+
+        x = inputs
+        finals_h = []
+        finals_c = []
+        for i, rnn_l in enumerate(self.rnns):
+            x, st = rnn_l(x)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                x = F.dropout(x, p=self.dropout, training=self.training)
+            if self.num_directions == 2:
+                st_fw, st_bw = st
+                if self.mode == "lstm":
+                    finals_h += [st_fw[0], st_bw[0]]
+                    finals_c += [st_fw[1], st_bw[1]]
+                else:
+                    finals_h += [st_fw, st_bw]
+            else:
+                if self.mode == "lstm":
+                    finals_h.append(st[0])
+                    finals_c.append(st[1])
+                else:
+                    finals_h.append(st)
+        h = stack(finals_h, axis=0)
+        if self.mode == "lstm":
+            c = stack(finals_c, axis=0)
+            return x, (h, c)
+        return x, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        super().__init__("rnn", input_size, hidden_size, num_layers, direction, time_major, dropout, activation, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("lstm", input_size, hidden_size, num_layers, direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("gru", input_size, hidden_size, num_layers, direction, time_major, dropout, **kwargs)

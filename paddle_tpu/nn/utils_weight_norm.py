@@ -1,0 +1,60 @@
+"""Weight normalization utilities (python/paddle/nn/utils/weight_norm_hook.py parity)."""
+import jax.numpy as jnp
+
+from ..core.tensor import ParamBase
+
+
+def _norm_except(w, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(w * w))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=False))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    w = getattr(layer, name)
+    g0 = _norm_except(w._data, dim)
+    v0 = w._data
+    layer.add_parameter(name + "_g", ParamBase(g0))
+    layer.add_parameter(name + "_v", ParamBase(v0))
+    del layer._parameters[name]
+
+    def hook(l, inputs):
+        from ..core.dispatch import apply
+
+        g = l._parameters[name + "_g"]
+        v = l._parameters[name + "_v"]
+
+        def fn(gv, vv):
+            n = _norm_except(vv, dim)
+            if dim is not None:
+                shape = [1] * vv.ndim
+                shape[dim] = -1
+                return vv * (gv / n).reshape(shape)
+            return vv * (gv / n)
+
+        w_t = apply(fn, g, v)
+        object.__setattr__(l, "_wn_cached", w_t)
+        l._parameters[name] = w_t  # temporary for forward
+        return None
+
+    def post_hook(l, inputs, output):
+        l._parameters.pop(name, None)
+        return None
+
+    layer._wn_pre = layer.register_forward_pre_hook(hook)
+    layer._wn_post = layer.register_forward_post_hook(post_hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    if hasattr(layer, "_wn_pre"):
+        layer._wn_pre.remove()
+        layer._wn_post.remove()
+        g = layer._parameters.pop(name + "_g")
+        v = layer._parameters.pop(name + "_v")
+        n = _norm_except(v._data, 0)
+        shape = [1] * v._data.ndim
+        shape[0] = -1
+        layer.add_parameter(name, ParamBase(v._data * (g._data / n).reshape(shape)))
+    return layer

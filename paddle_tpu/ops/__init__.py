@@ -1,0 +1,3 @@
+"""Custom TPU kernels (Pallas) — the analog of the reference's hand-written CUDA ops
+(paddle/fluid/operators/*.cu): flash attention, NMS, and quantization kernels live here.
+Only ops where XLA fusion is insufficient get a kernel; everything else is plain jnp."""

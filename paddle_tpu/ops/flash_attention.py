@@ -1,0 +1,99 @@
+"""Pallas flash-attention kernel for TPU.
+
+No reference equivalent (the reference composes attention from matmuls,
+python/paddle/nn/layer/transformer.py:83); this is a TPU-native addition following the
+standard blockwise-softmax (Flash) recipe from /opt/skills/guides/pallas_guide.md.
+
+Falls back (supported() -> False) when shapes don't tile onto the MXU (head_dim % 128,
+seq % block) or when not running on TPU.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_BLOCK_Q = 128
+_BLOCK_K = 128
+
+
+def _on_tpu():
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def supported(q_shape, dtype_str):
+    """q_shape: (batch, seq, heads, head_dim)."""
+    if len(q_shape) != 4:
+        return False
+    b, s, h, d = q_shape
+    if not _on_tpu():
+        return False
+    if d % 128 != 0 or s % _BLOCK_Q != 0 or s < 2 * _BLOCK_Q:
+        return False
+    if dtype_str not in ("float32", "bfloat16"):
+        return False
+    return True
+
+
+@functools.partial(jax.jit, static_argnames=("causal",))
+def flash_attention(q, k, v, causal=False):
+    """q,k,v: [b, s, h, d] -> [b, s, h, d]. Blockwise online-softmax attention."""
+    from jax.experimental import pallas as pl
+
+    b, s, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    # [b, s, h, d] -> [b*h, s, d]
+    qh = jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
+    kh = jnp.swapaxes(k, 1, 2).reshape(b * h, s, d)
+    vh = jnp.swapaxes(v, 1, 2).reshape(b * h, s, d)
+
+    n_q = s // _BLOCK_Q
+    n_k = s // _BLOCK_K
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        qi = pl.program_id(1)
+        q_blk = q_ref[...].astype(jnp.float32) * scale  # [BQ, d]
+
+        def body(ki, carry):
+            acc, m_i, l_i = carry
+            k_blk = pl.load(k_ref, (pl.dslice(ki * _BLOCK_K, _BLOCK_K), slice(None))).astype(jnp.float32)
+            v_blk = pl.load(v_ref, (pl.dslice(ki * _BLOCK_K, _BLOCK_K), slice(None))).astype(jnp.float32)
+            scores = q_blk @ k_blk.T  # [BQ, BK]
+            if causal:
+                q_pos = qi * _BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, (_BLOCK_Q, _BLOCK_K), 0)
+                k_pos = ki * _BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, (_BLOCK_Q, _BLOCK_K), 1)
+                scores = jnp.where(q_pos >= k_pos, scores, -1e30)
+            m_new = jnp.maximum(m_i, jnp.max(scores, axis=-1))
+            p = jnp.exp(scores - m_new[:, None])
+            alpha = jnp.exp(m_i - m_new)
+            l_new = l_i * alpha + jnp.sum(p, axis=-1)
+            acc = acc * alpha[:, None] + p @ v_blk
+            return acc, m_new, l_new
+
+        acc0 = jnp.zeros((_BLOCK_Q, d), jnp.float32)
+        m0 = jnp.full((_BLOCK_Q,), -1e30, jnp.float32)
+        l0 = jnp.zeros((_BLOCK_Q,), jnp.float32)
+        if causal:
+            upper = qi + 1  # only blocks up to the diagonal
+            acc, m_i, l_i = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
+        else:
+            acc, m_i, l_i = jax.lax.fori_loop(0, n_k, body, (acc0, m0, l0))
+        o_ref[...] = (acc / l_i[:, None]).astype(o_ref.dtype)
+
+    from jax.experimental.pallas import BlockSpec
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, n_q),
+        in_specs=[
+            BlockSpec((None, _BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
+            BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
+            BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=BlockSpec((None, _BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), qh.dtype),
+    )(qh, kh, vh)
+    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)

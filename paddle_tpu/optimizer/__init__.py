@@ -1,0 +1,18 @@
+"""paddle.optimizer parity surface (python/paddle/optimizer/__init__.py)."""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Dpsgd,
+    Ftrl,
+    Lamb,
+    Lars,
+    LarsMomentum,
+    Momentum,
+    Optimizer,
+    RMSProp,
+    SGD,
+)

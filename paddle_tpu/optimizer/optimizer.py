@@ -1,0 +1,434 @@
+"""Optimizer base + the full paddle optimizer family.
+
+Reference parity: python/paddle/optimizer/optimizer.py (Optimizer.step/minimize/
+clear_grad), operators/optimizers/{sgd,momentum,adam,adamw,lamb,lars_momentum,rmsprop,
+adagrad,adadelta,adamax,ftrl}_op.cc update rules (the C++ kernels' exact math, fused
+here into single jitted XLA updates).
+
+TPU-native design: every optimizer defines a pure `_rule(p, g, state, hp) -> (p, state)`.
+Eager `step()` runs it under one jit per param-group; the same rule powers the functional
+train-step used by Model.fit-static / fleet (optax-style, but paddle semantics).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import ParamBase, Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameters = list(parameters) if parameters is not None else []
+        self._grad_clip = grad_clip
+        if weight_decay is None:
+            self._wd = 0.0
+            self._wd_is_l2 = True
+        elif isinstance(weight_decay, (int, float)):
+            self._wd = float(weight_decay)
+            self._wd_is_l2 = True
+        else:  # L2Decay/L1Decay object
+            self._wd = float(getattr(weight_decay, "_coeff", getattr(weight_decay, "coeff", 0.0)))
+            self._wd_is_l2 = weight_decay.__class__.__name__ != "L1Decay"
+        self._state = {}  # id(param) -> dict of jnp arrays
+        self._step_count = 0
+        self._jit_rule = jax.jit(self._rule_with_decay)
+
+    # -- learning rate ---------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return self._lr()
+        return self._lr
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("can't set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- state -----------------------------------------------------------------
+    def _get_state(self, p):
+        k = id(p)
+        if k not in self._state:
+            self._state[k] = self._init_state(p)
+        return self._state[k]
+
+    def _init_state(self, p):
+        return {}
+
+    # -- update rule (pure; overridden per optimizer) --------------------------
+    def _rule(self, p, g, state, lr):
+        raise NotImplementedError
+
+    def _rule_with_decay(self, p, g, state, lr, wd):
+        # L2 regularization folded into grad (paddle regularizer semantics);
+        # decoupled decay (AdamW) overrides this.
+        if self._wd_is_l2:
+            g = g + wd * p
+        else:
+            g = g + wd * jnp.sign(p)
+        return self._rule(p, g, state, lr)
+
+    # -- public API ------------------------------------------------------------
+    def step(self):
+        self._step_count += 1
+        params_grads = [(p, p.grad) for p in self._parameters if p.grad is not None and getattr(p, "trainable", True)]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = jnp.asarray(self.get_lr(), dtype=jnp.float32)
+        wd = jnp.asarray(self._wd, dtype=jnp.float32)
+        for p, g in params_grads:
+            if g is None:
+                continue
+            state = self._get_state(p)
+            new_p, new_state = self._jit_rule(p._data, g._data.astype(p._data.dtype), state, lr, wd)
+            p._data = new_p
+            self._state[id(p)] = new_state
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameters]
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameters:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        out = {"LR_Scheduler": self._lr.state_dict() if isinstance(self._lr, LRScheduler) else {}}
+        for i, p in enumerate(self._parameters):
+            st = self._state.get(id(p))
+            if st:
+                for k, v in st.items():
+                    out[f"{p.name or i}_{k}"] = Tensor(v)
+        out["step"] = self._step_count
+        return out
+
+    def set_state_dict(self, state):
+        if isinstance(self._lr, LRScheduler) and state.get("LR_Scheduler"):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+        self._step_count = state.get("step", 0)
+        for i, p in enumerate(self._parameters):
+            st = self._init_state(p)
+            loaded = {}
+            for k in st:
+                key = f"{p.name or i}_{k}"
+                if key in state:
+                    v = state[key]
+                    loaded[k] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+            if loaded:
+                st.update(loaded)
+                self._state[id(p)] = st
+
+    # -- functional view (for jitted/sharded train steps) ----------------------
+    def functional_init(self, params):
+        """params: dict name->array. Returns state pytree."""
+        states = {}
+        for n, v in params.items():
+            fake = Tensor(v)
+            states[n] = self._init_state(fake)
+        states["__step__"] = jnp.zeros((), jnp.int32)
+        return states
+
+    def functional_apply(self, params, grads, states, lr=None):
+        """Pure update over dicts of arrays. Returns (new_params, new_states).
+
+        `lr` may be passed as a traced array so LR schedules work under jit."""
+        lr = jnp.asarray(self.get_lr(), dtype=jnp.float32) if lr is None else jnp.asarray(lr, dtype=jnp.float32)
+        wd = jnp.asarray(self._wd, dtype=jnp.float32)
+        new_params, new_states = {}, {}
+        if self._grad_clip is not None and isinstance(self._grad_clip, _GLOBAL_NORM_TYPES):
+            clip_norm = self._grad_clip.clip_norm
+            sq = sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in grads.values())
+            gnorm = jnp.sqrt(sq)
+            scale = clip_norm / jnp.maximum(gnorm, clip_norm)
+            grads = {k: (g * scale).astype(g.dtype) for k, g in grads.items()}
+        for n, p in params.items():
+            g = grads[n]
+            st = {k: v for k, v in states[n].items()}
+            new_p, new_st = self._rule_with_decay(p, g.astype(p.dtype), st, lr, wd)
+            new_params[n] = new_p
+            new_states[n] = new_st
+        new_states["__step__"] = states["__step__"] + 1
+        return new_params, new_states
+
+
+from ..nn.clip import ClipGradByGlobalNorm as _CGBGN  # noqa: E402
+
+_GLOBAL_NORM_TYPES = (_CGBGN,)
+
+
+class SGD(Optimizer):
+    """operators/optimizers/sgd_op.cc parity."""
+
+    def _rule(self, p, g, state, lr):
+        return p - lr.astype(p.dtype) * g, state
+
+
+class Momentum(Optimizer):
+    """operators/optimizers/momentum_op.cc parity (incl. nesterov)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p._data)}
+
+    def _rule(self, p, g, state, lr):
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            new_p = p - lr.astype(p.dtype) * (g + self._momentum * v)
+        else:
+            new_p = p - lr.astype(p.dtype) * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """operators/optimizers/adam_op.cc parity (bias-corrected via beta-pow state)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _init_state(self, p):
+        return {
+            "moment1": jnp.zeros_like(p._data),
+            "moment2": jnp.zeros_like(p._data),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _rule(self, p, g, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * (g * g)
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        new_p = p - (lr_t.astype(p.dtype) * m / (jnp.sqrt(v) + eps)).astype(p.dtype)
+        return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    """python/paddle/optimizer/adamw.py parity — decoupled weight decay."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        self._decay_fun = apply_decay_param_fun
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip)
+
+    def _rule_with_decay(self, p, g, state, lr, wd):
+        # decoupled: p -= lr*wd*p before adam update (paddle adamw semantics)
+        p = p * (1.0 - lr.astype(p.dtype) * wd.astype(p.dtype))
+        return self._rule(p, g, state, lr)
+
+
+class Adagrad(Optimizer):
+    """operators/optimizers/adagrad_op.cc parity."""
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None):
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(p._data, self._init_acc)}
+
+    def _rule(self, p, g, state, lr):
+        mom = state["moment"] + g * g
+        new_p = p - lr.astype(p.dtype) * g / (jnp.sqrt(mom) + self._eps)
+        return new_p, {"moment": mom}
+
+
+class Adadelta(Optimizer):
+    """operators/optimizers/adadelta_op.cc parity."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        self._eps = epsilon
+        self._rho = rho
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p._data),
+                "avg_squared_update": jnp.zeros_like(p._data)}
+
+    def _rule(self, p, g, state, lr):
+        rho, eps = self._rho, self._eps
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * g * g
+        update = -jnp.sqrt(state["avg_squared_update"] + eps) / jnp.sqrt(asg + eps) * g
+        asu = rho * state["avg_squared_update"] + (1 - rho) * update * update
+        return p + lr.astype(p.dtype) * update, {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    """operators/optimizers/adamax_op.cc parity."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros_like(p._data),
+                "inf_norm": jnp.zeros_like(p._data),
+                "beta1_pow": jnp.ones((), jnp.float32)}
+
+    def _rule(self, p, g, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        b1p = state["beta1_pow"] * b1
+        m = b1 * state["moment"] + (1 - b1) * g
+        inf = jnp.maximum(b2 * state["inf_norm"], jnp.abs(g) + eps)
+        new_p = p - (lr / (1 - b1p)).astype(p.dtype) * m / inf
+        return new_p, {"moment": m, "inf_norm": inf, "beta1_pow": b1p}
+
+
+class RMSProp(Optimizer):
+    """operators/optimizers/rmsprop_op.cc parity (centered option)."""
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        self._rho, self._eps, self._momentum, self._centered = rho, epsilon, momentum, centered
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _init_state(self, p):
+        st = {"mean_square": jnp.zeros_like(p._data), "moment": jnp.zeros_like(p._data)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(p._data)
+        return st
+
+    def _rule(self, p, g, state, lr):
+        rho, eps = self._rho, self._eps
+        ms = rho * state["mean_square"] + (1 - rho) * g * g
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * g
+            denom = jnp.sqrt(ms - mg * mg + eps)
+        else:
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * state["moment"] + lr.astype(p.dtype) * g / denom
+        new_state = {"mean_square": ms, "moment": mom}
+        if self._centered:
+            new_state["mean_grad"] = mg
+        return p - mom, new_state
+
+
+class Lamb(Optimizer):
+    """operators/optimizers/lamb_op.cc parity (trust-ratio layerwise adaptation)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+        super().__init__(learning_rate, parameters, None, grad_clip)
+
+    def _init_state(self, p):
+        return {
+            "moment1": jnp.zeros_like(p._data),
+            "moment2": jnp.zeros_like(p._data),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _rule(self, p, g, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        r = m_hat / (jnp.sqrt(v_hat) + eps) + self._lamb_wd * p
+        w_norm = jnp.sqrt(jnp.sum(p.astype(jnp.float32) ** 2))
+        r_norm = jnp.sqrt(jnp.sum(r.astype(jnp.float32) ** 2))
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = p - (lr * ratio).astype(p.dtype) * r
+        return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class Lars(Momentum):
+    """operators/optimizers/lars_momentum_op.cc parity."""
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0, name=None):
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._lars_eps = epsilon
+        super().__init__(learning_rate, momentum, parameters, False, None, grad_clip)
+
+    def _rule(self, p, g, state, lr):
+        p_norm = jnp.sqrt(jnp.sum(p.astype(jnp.float32) ** 2))
+        g_norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            self._lars_coeff * p_norm / (g_norm + self._lars_wd * p_norm + self._lars_eps),
+            1.0,
+        )
+        v = self._momentum * state["velocity"] + (lr * local_lr).astype(p.dtype) * (g + self._lars_wd * p)
+        return p - v, {"velocity": v}
+
+
+LarsMomentum = Lars
+
+
+class Ftrl(Optimizer):
+    """operators/optimizers/ftrl_op.cc parity."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _init_state(self, p):
+        return {"squared": jnp.zeros_like(p._data), "linear": jnp.zeros_like(p._data)}
+
+    def _rule(self, p, g, state, lr):
+        l1, l2, lrp = self._l1, self._l2, self._lr_power
+        new_sq = state["squared"] + g * g
+        sigma = (new_sq ** -lrp - state["squared"] ** -lrp) / lr.astype(p.dtype)
+        lin = state["linear"] + g - sigma * p
+        pre = jnp.where(jnp.abs(lin) > l1, (jnp.sign(lin) * l1 - lin) /
+                        (new_sq ** -lrp / lr.astype(p.dtype) + 2 * l2), jnp.zeros_like(p))
+        return pre, {"squared": new_sq, "linear": lin}
+
+
+class Dpsgd(SGD):
+    """operators/optimizers/dpsgd_op.cc (differentially-private SGD) — clip+noise."""
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16, sigma=1.0,
+                 parameters=None, name=None):
+        self._clip_v = clip
+        self._sigma = sigma
+        self._batch = batch_size
+        super().__init__(learning_rate, parameters)
+        self._jit_rule = self._rule_with_decay  # fresh noise per step: stay un-jitted
+
+    def _rule(self, p, g, state, lr):
+        from ..core.generator import default_generator
+
+        gnorm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+        g = g / jnp.maximum(1.0, gnorm / self._clip_v)
+        key = default_generator().split()
+        noise = jax.random.normal(key, g.shape, dtype=g.dtype) * (self._sigma * self._clip_v / self._batch)
+        return p - lr.astype(p.dtype) * (g + noise), state

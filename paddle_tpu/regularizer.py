@@ -1,0 +1,23 @@
+"""Regularizers (python/paddle/fluid/regularizer.py parity)."""
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+
+L2DecayRegularizer = L2Decay
+L1DecayRegularizer = L1Decay

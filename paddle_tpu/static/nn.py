@@ -1,0 +1,40 @@
+"""paddle.static.nn functional shims (fc, conv2d, batch_norm ...) — thin wrappers over
+paddle_tpu.nn layers for static-style code (python/paddle/static/nn/__init__.py parity)."""
+from .. import nn as _nn
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None, activation=None, name=None):
+    from ..tensor.manipulation import flatten
+
+    in_features = 1
+    for s in x.shape[num_flatten_dims:]:
+        in_features *= s
+    layer = _nn.Linear(in_features, size, weight_attr, bias_attr)
+    x2 = flatten(x, num_flatten_dims, -1) if x.ndim > num_flatten_dims + 1 else x
+    out = layer(x2)
+    if activation:
+        out = getattr(_nn.functional, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1, groups=1,
+           param_attr=None, bias_attr=None, act=None, name=None, data_format="NCHW"):
+    in_c = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    layer = _nn.Conv2D(in_c, num_filters, filter_size, stride, padding, dilation,
+                       groups or 1, weight_attr=param_attr, bias_attr=bias_attr,
+                       data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_layout="NCHW", is_test=False, name=None, **kw):
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = _nn.BatchNorm2D(c, momentum, epsilon, param_attr, bias_attr, data_layout)
+    layer.training = not is_test
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out

@@ -1,0 +1,16 @@
+"""paddle.tensor API family (python/paddle/tensor/__init__.py parity)."""
+from ..core.tensor import Tensor, ParamBase, to_tensor
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import std, var, median, nanmedian, quantile, nanquantile, histogram, bincount, corrcoef, cov  # noqa: F401
+from .random import *  # noqa: F401,F403
+from .linalg import (  # noqa: F401
+    norm, dist, cond, t, cross, cholesky, cholesky_solve, matrix_power, matrix_rank,
+    det, slogdet, inv, pinv, solve, triangular_solve, lstsq, svd, qr, eig, eigh,
+    eigvals, eigvalsh, lu, multi_dot, householder_product,
+)
+from .attribute import shape, rank, is_floating_point, is_integer, is_complex  # noqa: F401
+from . import math_patch  # noqa: F401  (installs operator overloads)

@@ -1,0 +1,27 @@
+"""Attribute ops (python/paddle/tensor/attribute.py parity)."""
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+
+
+def shape(x):
+    """Returns the shape as an int32 tensor (operators/shape_op.cc parity)."""
+    return Tensor(jnp.asarray(np.array(x.shape, dtype=np.int32)))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(np.array(x.ndim, dtype=np.int32)))
+
+
+def is_floating_point(x):
+    return dtype_mod.is_floating(x.dtype)
+
+
+def is_integer(x):
+    return dtype_mod.is_integer(x.dtype)
+
+
+def is_complex(x):
+    return dtype_mod.is_complex(x.dtype)

@@ -1,0 +1,162 @@
+"""Tensor creation ops.
+
+Reference parity: python/paddle/tensor/creation.py (17 public fns: to_tensor, ones, zeros,
+full, arange, eye, linspace, empty, *_like, tril/triu, meshgrid, diag, assign, ...).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.dispatch import apply
+from ..core.tensor import Tensor, to_tensor  # re-export
+
+
+def _d(dtype, like=None):
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None:
+        d = like if like is not None else dtype_mod.get_default_dtype()
+    return d
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), dtype=_d(dtype)))
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), dtype=_d(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None:
+        if isinstance(fill_value, bool):
+            d = np.dtype("bool")
+        elif isinstance(fill_value, int):
+            d = dtype_mod.get_default_dtype()
+        else:
+            d = dtype_mod.get_default_dtype()
+    return Tensor(jnp.full(_shape(shape), fill_value, dtype=d))
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply(lambda v: jnp.ones_like(v, dtype=dtype_mod.convert_dtype(dtype)), x)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply(lambda v: jnp.zeros_like(v, dtype=dtype_mod.convert_dtype(dtype)), x)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply(
+        lambda v: jnp.full_like(v, fill_value, dtype=dtype_mod.convert_dtype(dtype)), x
+    )
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            d = np.dtype("int64")
+        else:
+            d = dtype_mod.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_d(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=_d(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+
+    return Tensor(jnp.logspace(_v(start), _v(stop), int(_v(num)), base=_v(base), dtype=_d(dtype)))
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.tril(v, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.triu(v, k=diagonal), x)
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = apply(lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), *args)
+    return list(outs)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def _diag(v):
+        out = jnp.diag(v, k=offset)
+        if v.ndim == 1 and padding_value != 0:
+            mask = jnp.diag(jnp.ones_like(v, dtype=bool), k=offset)
+            out = jnp.where(mask, out, jnp.asarray(padding_value, dtype=v.dtype))
+        return out
+
+    return apply(_diag, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(lambda v: jnp.diagflat(v, k=offset), x)
+
+
+def assign(x, output=None):
+    """python/paddle/tensor/creation.py assign parity."""
+    if not isinstance(x, Tensor):
+        x = Tensor(np.asarray(x))
+    out = apply(lambda v: v + jnp.zeros_like(v), x)
+    if output is not None:
+        output._data = out._data
+        output._node = out._node
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def complex(real, imag, name=None):
+    return apply(lambda r, i: r + 1j * i.astype(jnp.complex64 if r.dtype == jnp.float32 else jnp.complex128), real, imag)
+
+
+def as_complex(x, name=None):
+    return apply(lambda v: v[..., 0] + 1j * v[..., 1], x)
+
+
+def as_real(x, name=None):
+    return apply(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x)

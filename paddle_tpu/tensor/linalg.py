@@ -1,0 +1,209 @@
+"""Linear algebra ops (python/paddle/tensor/linalg.py parity: norm, dist, cond, matrix_*,
+svd, qr, eig/eigh, cholesky, solve family, pinv, det, slogdet, lu, lstsq).
+
+TPU note: decompositions (svd/qr/eig) run on XLA's CPU path when not supported on-device;
+matmul-heavy ops (norm, matrix_power) stay on the MXU.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .math import matmul, dot, bmm, mv, einsum  # re-export
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def fn(v):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(v * v))
+            return jnp.linalg.norm(v, ord=None, axis=_ax(axis), keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(v, ord="nuc", axis=_ax(axis), keepdims=keepdim)
+        if p == np.inf or p == float("inf"):
+            if axis is None:
+                return jnp.max(jnp.abs(v))
+            return jnp.linalg.norm(v, ord=np.inf, axis=_ax(axis), keepdims=keepdim)
+        if p == -np.inf or p == float("-inf"):
+            if axis is None:
+                return jnp.min(jnp.abs(v))
+            return jnp.linalg.norm(v, ord=-np.inf, axis=_ax(axis), keepdims=keepdim)
+        if axis is None:
+            return jnp.sum(jnp.abs(v) ** p) ** (1.0 / p)
+        return jnp.linalg.norm(v, ord=p, axis=_ax(axis), keepdims=keepdim)
+
+    def _ax(a):
+        if a is None:
+            return None
+        if isinstance(a, (list, tuple)):
+            return tuple(a)
+        return int(a)
+
+    return apply(fn, _t(x))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply(lambda v: jnp.linalg.norm(v, ord=None if p == "fro" else p, axis=tuple(axis), keepdims=keepdim), _t(x))
+
+
+def dist(x, y, p=2, name=None):
+    return apply(lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), _t(x), _t(y))
+
+
+def cond(x, p=None, name=None):
+    return apply(lambda v: jnp.linalg.cond(v, p=p), _t(x))
+
+
+def t(x, name=None):
+    return apply(lambda v: jnp.swapaxes(v, -1, -2) if v.ndim >= 2 else v, _t(x))
+
+
+def transpose(x, perm, name=None):
+    from .manipulation import transpose as _tr
+
+    return _tr(x, perm)
+
+
+def cross(x, y, axis=9, name=None):
+    def fn(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return apply(fn, _t(x), _t(y))
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(v):
+        l = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(l, -1, -2).conj() if upper else l
+
+    return apply(fn, _t(x))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+
+    return apply(fn, _t(x), _t(y))
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda v: jnp.linalg.matrix_power(v, n), _t(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    tv = tol._data if isinstance(tol, Tensor) else tol
+    out = apply(lambda v: jnp.linalg.matrix_rank(v, rtol=None if tv is None else tv), _t(x).detach())
+    out.stop_gradient = True
+    return out
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, _t(x))
+
+
+def slogdet(x, name=None):
+    sign, logdet = apply(lambda v: tuple(jnp.linalg.slogdet(v)), _t(x))
+    return sign, logdet
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, _t(x))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), _t(x))
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, _t(x), _t(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return apply(fn, _t(x), _t(y))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = apply(lambda a, b: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)), _t(x), _t(y))
+    return sol, res, rank, sv
+
+
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = apply(lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)), _t(x))
+    # paddle returns V, not V^H
+    from .manipulation import transpose as _tr
+
+    v = apply(lambda m: jnp.swapaxes(m, -1, -2).conj(), vh)
+    return u, s, v
+
+
+def qr(x, mode="reduced", name=None):
+    if mode == "r":
+        return apply(lambda v: jnp.linalg.qr(v, mode="r"), _t(x))
+    q, r = apply(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), _t(x))
+    return q, r
+
+
+def eig(x, name=None):
+    w, v = apply(lambda m: tuple(jnp.linalg.eig(m)), _t(x).detach())
+    return w, v
+
+
+def eigh(x, UPLO="L", name=None):
+    w, v = apply(lambda m: tuple(jnp.linalg.eigh(m, UPLO=UPLO)), _t(x))
+    return w, v
+
+
+def eigvals(x, name=None):
+    out = apply(jnp.linalg.eigvals, _t(x).detach())
+    return out
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda m: jnp.linalg.eigvalsh(m, UPLO=UPLO), _t(x))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def fn(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+
+    lu_t, piv = apply(fn, _t(x))
+    piv.stop_gradient = True
+    if get_infos:
+        info = Tensor(jnp.zeros((), dtype=jnp.int32))
+        return lu_t, piv, info
+    return lu_t, piv
+
+
+def multi_dot(x, name=None):
+    tensors = [_t(v) for v in x]
+    return apply(lambda *vs: jnp.linalg.multi_dot(vs), *tensors)
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t_):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros(i, a.dtype), jnp.ones(1, a.dtype), a[i + 1 :, i]])
+            q = q - t_[i] * jnp.outer(q @ v, v)
+        return q
+
+    return apply(fn, _t(x), _t(tau))

@@ -1,0 +1,97 @@
+"""Logical/comparison ops (python/paddle/tensor/logic.py parity, 9 public fns +
+comparisons from operators/controlflow/compare_op.cc)."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def _cmp(fn, x, y):
+    x = _t(x)
+    if isinstance(y, Tensor):
+        out = apply(fn, x.detach(), y.detach())
+    else:
+        out = apply(lambda v: fn(v, y), x.detach())
+    out.stop_gradient = True
+    return out
+
+
+def equal(x, y, name=None):
+    return _cmp(jnp.equal, x, y)
+
+
+def not_equal(x, y, name=None):
+    return _cmp(jnp.not_equal, x, y)
+
+
+def greater_than(x, y, name=None):
+    return _cmp(jnp.greater, x, y)
+
+
+def greater_equal(x, y, name=None):
+    return _cmp(jnp.greater_equal, x, y)
+
+
+def less_than(x, y, name=None):
+    return _cmp(jnp.less, x, y)
+
+
+def less_equal(x, y, name=None):
+    return _cmp(jnp.less_equal, x, y)
+
+
+def logical_and(x, y, name=None, out=None):
+    return _cmp(jnp.logical_and, x, y)
+
+
+def logical_or(x, y, name=None, out=None):
+    return _cmp(jnp.logical_or, x, y)
+
+
+def logical_xor(x, y, name=None, out=None):
+    return _cmp(jnp.logical_xor, x, y)
+
+
+def logical_not(x, name=None, out=None):
+    return _cmp(lambda v, _=None: jnp.logical_not(v), x, None)
+
+
+def bitwise_and(x, y, name=None):
+    return _cmp(jnp.bitwise_and, x, y)
+
+
+def bitwise_or(x, y, name=None):
+    return _cmp(jnp.bitwise_or, x, y)
+
+
+def bitwise_xor(x, y, name=None):
+    return _cmp(jnp.bitwise_xor, x, y)
+
+
+def bitwise_not(x, name=None):
+    return _cmp(lambda v, _=None: jnp.bitwise_not(v), x, None)
+
+
+def equal_all(x, y, name=None):
+    return _cmp(lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _cmp(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _cmp(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(_t(x).size == 0))

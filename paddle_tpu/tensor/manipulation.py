@@ -1,0 +1,408 @@
+"""Shape / layout manipulation ops.
+
+Reference parity: python/paddle/tensor/manipulation.py (29 public fns: reshape, transpose,
+concat, split, stack, squeeze, gather, scatter, tile, flip, roll, ...). Static shapes
+only — XLA requirement; dynamic-shape paddle APIs (e.g. masked_select) return compacted
+results eagerly or require a size hint under jit.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, apply_inplace
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def _int_list(xs):
+    if isinstance(xs, Tensor):
+        return [int(v) for v in xs.numpy()]
+    if isinstance(xs, (int, np.integer)):
+        return [int(xs)]
+    return [int(x._data) if isinstance(x, Tensor) else int(x) for x in xs]
+
+
+def reshape(x, shape, name=None):
+    return apply(lambda v: jnp.reshape(v, tuple(_int_list(shape))), _t(x))
+
+
+def reshape_(x, shape, name=None):
+    return apply_inplace(lambda v: jnp.reshape(v, tuple(_int_list(shape))), x)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = v.shape[:s] + (-1,) + v.shape[e + 1 :]
+        return jnp.reshape(v, new_shape)
+
+    return apply(fn, _t(x))
+
+
+def transpose(x, perm=None, name=None):
+    return apply(lambda v: jnp.transpose(v, None if perm is None else tuple(perm)), _t(x))
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda v: jnp.moveaxis(v, source, destination), _t(x))
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply(lambda v: jnp.swapaxes(v, axis0, axis1), _t(x))
+
+
+transpose_ = transpose
+
+
+def unsqueeze(x, axis, name=None):
+    axes = _int_list(axis)
+
+    def fn(v):
+        out = v
+        for a in sorted(axes):
+            out = jnp.expand_dims(out, a)
+        return out
+
+    return apply(fn, _t(x))
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = tuple(a % v.ndim for a in _int_list(axis))
+        axes = tuple(a for a in axes if v.shape[a] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+
+    return apply(fn, _t(x))
+
+
+unsqueeze_ = unsqueeze
+squeeze_ = squeeze
+
+
+def concat(x, axis=0, name=None):
+    tensors = [_t(v) for v in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply(lambda *vs: jnp.concatenate(vs, axis=axis), *tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = [_t(v) for v in x]
+    return apply(lambda *vs: jnp.stack(vs, axis=axis), *tensors)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num if num is not None else _t(x).shape[axis]
+    outs = apply(
+        lambda v: tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(v, n, axis=axis)),
+        _t(x),
+    )
+    return list(outs)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _t(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = _int_list(num_or_sections)
+        if any(s == -1 for s in sections):
+            known = sum(s for s in sections if s != -1)
+            sections = [dim - known if s == -1 else s for s in sections]
+    offsets = np.cumsum(sections)[:-1].tolist()
+    outs = apply(lambda v: tuple(jnp.split(v, offsets, axis=axis)), x)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tile(x, repeat_times, name=None):
+    return apply(lambda v: jnp.tile(v, tuple(_int_list(repeat_times))), _t(x))
+
+
+def expand(x, shape, name=None):
+    shape = _int_list(shape)
+
+    def fn(v):
+        tgt = list(shape)
+        for i in range(1, len(tgt) + 1):
+            if i <= v.ndim and tgt[-i] == -1:
+                tgt[-i] = v.shape[-i]
+        return jnp.broadcast_to(v, tuple(tgt))
+
+    return apply(fn, _t(x))
+
+
+def expand_as(x, y, name=None):
+    return apply(lambda v, w: jnp.broadcast_to(v, w.shape), _t(x), _t(y).detach())
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    tensors = [_t(v) for v in inputs]
+    outs = apply(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *tensors)
+    return list(outs)
+
+
+def flip(x, axis, name=None):
+    return apply(lambda v: jnp.flip(v, axis=tuple(_int_list(axis))), _t(x))
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _int_list(shifts)
+    ax = None if axis is None else _int_list(axis)
+
+    def fn(v):
+        if ax is None:
+            return jnp.roll(v, sh[0] if len(sh) == 1 else tuple(sh))
+        return jnp.roll(v, tuple(sh), axis=tuple(ax))
+
+    return apply(fn, _t(x))
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply(lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=axis), _t(x), _t(index).detach())
+
+
+def gather_nd(x, index, name=None):
+    def fn(v, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        flat_idx = tuple(idx[..., j] for j in range(k))
+        return v[flat_idx]
+
+    return apply(fn, _t(x), _t(index).detach())
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply(
+        lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=axis),
+        _t(arr),
+        _t(indices).detach(),
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def fn(v, i, val):
+        i = i.astype(jnp.int32)
+        if reduce == "assign":
+            return jnp.put_along_axis(v, i, val, axis=axis, inplace=False)
+        if reduce == "add":
+            dims = list(range(v.ndim))
+            # scatter-add via segment trick: use at[] with explicit index grids
+            idx = [jnp.broadcast_to(jnp.arange(s).reshape([-1 if d == j else 1 for d in dims]), i.shape) for j, s in enumerate(v.shape)]
+            idx[axis] = i
+            return v.at[tuple(idx)].add(jnp.broadcast_to(val, i.shape))
+        raise ValueError(reduce)
+
+    return apply(fn, _t(arr), _t(indices).detach(), _t(values))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    """operators/scatter_op.cc parity: row-wise scatter on axis 0."""
+
+    def fn(v, i, u):
+        i = i.reshape(-1).astype(jnp.int32)
+        if overwrite:
+            return v.at[i].set(u)
+        return v.at[i].set(jnp.zeros_like(u)).at[i].add(u)
+
+    return apply(fn, _t(x), _t(index).detach(), _t(updates))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    def fn(v, i, u):
+        i = i.reshape(-1).astype(jnp.int32)
+        if overwrite:
+            return v.at[i].set(u)
+        return v.at[i].set(jnp.zeros_like(u)).at[i].add(u)
+
+    return apply_inplace(fn, x, _t(index).detach(), _t(updates))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(v, i, u):
+        i = i.astype(jnp.int32)
+        k = i.shape[-1]
+        return v.at[tuple(i[..., j] for j in range(k))].add(u)
+
+    return apply(fn, _t(x), _t(index).detach(), _t(updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    z = Tensor(jnp.zeros(tuple(_int_list(shape)), dtype=_t(updates).dtype))
+    return scatter_nd_add(z, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index, name=None):
+    return take_along_axis(x, index, axis=1)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: eager-only (uses concrete mask)
+    x, mask = _t(x), _t(mask)
+    sel = np.asarray(mask._data)
+    return apply(lambda v: v[jnp.asarray(np.nonzero(sel.reshape(-1))[0])], reshape(x, [-1]))
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value._data if isinstance(value, Tensor) else value
+    return apply(lambda a, m: jnp.where(m, jnp.asarray(v, dtype=a.dtype), a), _t(x), _t(mask).detach())
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(lambda c, a, b: jnp.where(c, a, b), _t(condition).detach(), _t(x), _t(y))
+
+
+def nonzero(x, as_tuple=False):
+    x = _t(x)
+    nz = np.nonzero(np.asarray(x._data))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = _t(x)
+    res = np.unique(
+        np.asarray(x._data),
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = np.asarray(_t(x)._data)
+    if axis is None:
+        x = x.reshape(-1)
+    keep = np.ones(x.shape[0], dtype=bool)
+    keep[1:] = (x[1:] != x[:-1]).reshape(x.shape[0] - 1, -1).any(axis=-1) if x.ndim > 1 else x[1:] != x[:-1]
+    out = Tensor(jnp.asarray(x[keep]))
+    outs = [out]
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.append(idx, x.shape[0]))
+        outs.append(Tensor(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def slice(input, axes, starts, ends, name=None):
+    import builtins
+
+    axes = _int_list(axes)
+    starts = _int_list(starts)
+    ends = _int_list(ends)
+
+    def fn(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            idx[a] = builtins.slice(s, e)
+        return v[tuple(idx)]
+
+    return apply(fn, _t(input))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    import builtins
+
+    axes, starts, ends, strides = map(_int_list, (axes, starts, ends, strides))
+
+    def fn(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = builtins.slice(s, e, st)
+        return v[tuple(idx)]
+
+    return apply(fn, _t(x))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    import builtins
+
+    shape = _int_list(shape)
+    offsets = _int_list(offsets) if offsets is not None else [0] * len(shape)
+
+    def fn(v):
+        idx = tuple(
+            builtins.slice(o, o + (s if s != -1 else v.shape[d] - o))
+            for d, (o, s) in enumerate(zip(offsets, shape))
+        )
+        return v[idx]
+
+    return apply(fn, _t(x))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ..nn.functional.common import pad as _pad
+
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats._data if isinstance(repeats, Tensor) else repeats
+    return apply(lambda v: jnp.repeat(v, r, axis=axis), _t(x))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    def fn(v):
+        flat = v.reshape(-1)[offset:]
+        idx = np.zeros(tuple(shape), dtype=np.int64)
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            rng = np.arange(s) * st
+            idx = idx + rng.reshape([-1 if i == d else 1 for i in range(len(shape))])
+        return flat[jnp.asarray(idx)]
+
+    return apply(fn, _t(x))
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=axes), _t(x), _t(y))
+
+
+def tolist(x):
+    return _t(x).tolist()
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(_t(x).size, dtype=jnp.int64))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """operators/shard_index_op.cc parity (PS embedding sharding)."""
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def fn(v):
+        in_shard = (v // shard_size) == shard_id
+        return jnp.where(in_shard, v % shard_size, ignore_value)
+
+    return apply(fn, _t(input))

@@ -1,0 +1,411 @@
+"""Elementwise + reduction math ops.
+
+Reference parity: python/paddle/tensor/math.py (41 public fns) backed by
+paddle/fluid/operators/elementwise/ and reduce_ops/. All ops are thin pure-jnp lambdas
+through the autodiff dispatcher; XLA fuses chains of these into single kernels, replacing
+the reference's fused_elemwise_activation op (operators/fused/).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.dispatch import apply, apply_inplace
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def _binop(fn, x, y, name=None):
+    x = _t(x)
+    # python scalars stay scalars (no dtype promotion surprises)
+    if isinstance(y, Tensor):
+        return apply(fn, x, y)
+    return apply(lambda v: fn(v, y), x)
+
+
+# ---- elementwise binary ------------------------------------------------------
+def add(x, y, name=None):
+    return _binop(jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    return _binop(jnp.subtract, x, y)
+
+
+def multiply(x, y, name=None):
+    return _binop(jnp.multiply, x, y)
+
+
+def divide(x, y, name=None):
+    return _binop(jnp.divide, x, y)
+
+
+def floor_divide(x, y, name=None):
+    return _binop(jnp.floor_divide, x, y)
+
+
+def mod(x, y, name=None):
+    return _binop(jnp.mod, x, y)
+
+
+remainder = mod
+floor_mod = mod
+
+
+def pow(x, y, name=None):
+    return _binop(jnp.power, x, y)
+
+
+def maximum(x, y, name=None):
+    return _binop(jnp.maximum, x, y)
+
+
+def minimum(x, y, name=None):
+    return _binop(jnp.minimum, x, y)
+
+
+def fmax(x, y, name=None):
+    return _binop(jnp.fmax, x, y)
+
+
+def fmin(x, y, name=None):
+    return _binop(jnp.fmin, x, y)
+
+
+def atan2(x, y, name=None):
+    return _binop(jnp.arctan2, x, y)
+
+
+def hypot(x, y, name=None):
+    return _binop(jnp.hypot, x, y)
+
+
+# ---- elementwise unary -------------------------------------------------------
+def _unary(fn):
+    def op(x, name=None):
+        return apply(fn, _t(x))
+
+    return op
+
+
+exp = _unary(jnp.exp)
+expm1 = _unary(jnp.expm1)
+log = _unary(jnp.log)
+log2 = _unary(jnp.log2)
+log10 = _unary(jnp.log10)
+log1p = _unary(jnp.log1p)
+sqrt = _unary(jnp.sqrt)
+rsqrt = _unary(lambda v: jax.lax.rsqrt(v))
+square = _unary(jnp.square)
+sin = _unary(jnp.sin)
+cos = _unary(jnp.cos)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+acos = _unary(jnp.arccos)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+cosh = _unary(jnp.cosh)
+tanh = _unary(jnp.tanh)
+asinh = _unary(jnp.arcsinh)
+acosh = _unary(jnp.arccosh)
+atanh = _unary(jnp.arctanh)
+abs = _unary(jnp.abs)
+ceil = _unary(jnp.ceil)
+floor = _unary(jnp.floor)
+round = _unary(jnp.round)
+trunc = _unary(jnp.trunc)
+frac = _unary(lambda v: v - jnp.trunc(v))
+sign = _unary(jnp.sign)
+neg = _unary(jnp.negative)
+reciprocal = _unary(jnp.reciprocal)
+sigmoid = _unary(jax.nn.sigmoid)
+erf = _unary(jax.scipy.special.erf)
+erfinv = _unary(jax.scipy.special.erfinv)
+lgamma = _unary(jax.scipy.special.gammaln)
+digamma = _unary(jax.scipy.special.digamma)
+angle = _unary(jnp.angle)
+conj = _unary(jnp.conj)
+real = _unary(jnp.real)
+imag = _unary(jnp.imag)
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
+isnan_ = _unary(jnp.isnan)
+logit = _unary(jax.scipy.special.logit)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """operators/scale_op.cc parity."""
+    def fn(v):
+        s = jnp.asarray(scale._data if isinstance(scale, Tensor) else scale, dtype=v.dtype)
+        b = jnp.asarray(bias, dtype=v.dtype)
+        out = v * s + b if bias_after_scale else (v + b) * s
+        return out
+
+    return apply(fn, _t(x))
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply(lambda v: jnp.clip(v, lo, hi), _t(x))
+
+
+def increment(x, value=1.0, name=None):
+    return apply_inplace(lambda v: v + jnp.asarray(value, dtype=v.dtype), x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda v: scale_b * jnp.tanh(scale_a * v), _t(x))
+
+
+def multiplex(inputs, index, name=None):
+    """operators/multiplex_op.cc parity: out[b] = inputs[index[b]][b]."""
+
+    def fn(*vs):
+        idx = vs[-1]
+        stacked = jnp.stack(vs[:-1], axis=0)  # [n, batch, ...]
+        sel = idx.reshape(-1).astype(jnp.int32)
+        sel = sel.reshape((1, -1) + (1,) * (stacked.ndim - 2))
+        sel = jnp.broadcast_to(sel, (1,) + stacked.shape[1:])
+        return jnp.take_along_axis(stacked, sel, axis=0)[0]
+
+    return apply(fn, *inputs, _t(index))
+
+
+# ---- reductions --------------------------------------------------------------
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = dtype_mod.convert_dtype(dtype)
+    return apply(lambda v: jnp.sum(v, axis=_axis(axis), dtype=d, keepdims=keepdim), _t(x))
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.mean(v, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.max(v, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.min(v, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype)
+    return apply(lambda v: jnp.prod(v, axis=_axis(axis), dtype=d, keepdims=keepdim), _t(x))
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(
+        lambda v: jax.scipy.special.logsumexp(v, axis=_axis(axis), keepdims=keepdim), _t(x)
+    )
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.all(v, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.any(v, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.count_nonzero(v, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.nanmean(v, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = dtype_mod.convert_dtype(dtype)
+    return apply(lambda v: jnp.nansum(v, axis=_axis(axis), dtype=d, keepdims=keepdim), _t(x))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype)
+
+    def fn(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1), dtype=d)
+        return jnp.cumsum(v, axis=int(axis), dtype=d)
+
+    return apply(fn, _t(x))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype)
+    return apply(lambda v: jnp.cumprod(v, axis=dim, dtype=d), _t(x))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def fn(v):
+        a = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        out = jax.lax.associative_scan(jnp.maximum, vv, axis=a)
+        idx = jnp.argmax(
+            jnp.cumsum(jnp.ones_like(vv, dtype=jnp.int32), axis=a) * (vv == out), axis=a
+        )
+        return out
+
+    return apply(fn, _t(x))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), _t(x))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2), _t(x))
+
+
+def kron(x, y, name=None):
+    return apply(jnp.kron, _t(x), _t(y))
+
+
+def gcd(x, y, name=None):
+    return _binop(jnp.gcd, x, y)
+
+
+def lcm(x, y, name=None):
+    return _binop(jnp.lcm, x, y)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = prepend._data if isinstance(prepend, Tensor) else prepend
+    app = append._data if isinstance(append, Tensor) else append
+    return apply(lambda v: jnp.diff(v, n=n, axis=axis, prepend=pre, append=app), _t(x))
+
+
+def heaviside(x, y, name=None):
+    return _binop(jnp.heaviside, x, y)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), _t(x))
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), _t(x), _t(y), weight)
+    return apply(lambda a, b: a + weight * (b - a), _t(x), _t(y))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), _t(x))
+
+
+# ---- matmul family (the MXU path) -------------------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """operators/matmul_v2_op.cc parity. bf16-preserving; feeds the MXU directly."""
+
+    def fn(a, b):
+        from ..amp.auto_cast import amp_dtype
+
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        d = amp_dtype()
+        if d is not None and jnp.issubdtype(a.dtype, jnp.floating):
+            a, b = a.astype(d), b.astype(d)
+        return jnp.matmul(a, b)
+
+    return apply(fn, _t(x), _t(y))
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), _t(x), _t(y))
+
+
+def bmm(x, y, name=None):
+    return apply(jnp.matmul, _t(x), _t(y))
+
+
+def inner(x, y, name=None):
+    return apply(jnp.inner, _t(x), _t(y))
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), _t(x), _t(y))
+
+
+def mv(x, vec, name=None):
+    return apply(jnp.matmul, _t(x), _t(vec))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), _t(input), _t(x), _t(y))
+
+
+def inverse(x, name=None):
+    return apply(jnp.linalg.inv, _t(x))
+
+
+def einsum(equation, *operands):
+    ops = [_t(o) for o in operands]
+    return apply(lambda *vs: jnp.einsum(equation, *vs), *ops)
+
+
+def isfinite(x, name=None):
+    return apply(jnp.isfinite, _t(x))
+
+
+def isinf(x, name=None):
+    return apply(jnp.isinf, _t(x))
+
+
+def isnan(x, name=None):
+    return apply(jnp.isnan, _t(x))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+# ---- in-place variants -------------------------------------------------------
+def add_(x, y, name=None):
+    yv = y._data if isinstance(y, Tensor) else y
+    return apply_inplace(lambda v: v + yv, x) if not isinstance(y, Tensor) else apply_inplace(jnp.add, x, y)
+
+
+def subtract_(x, y, name=None):
+    return apply_inplace(jnp.subtract, x, _t(y))
+
+
+def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def fn(v):
+        s = jnp.asarray(scale, dtype=v.dtype)
+        b = jnp.asarray(bias, dtype=v.dtype)
+        return v * s + b if bias_after_scale else (v + b) * s
+
+    return apply_inplace(fn, x)
+
+
+def clip_(x, min=None, max=None, name=None):
+    return apply_inplace(lambda v: jnp.clip(v, min, max), x)

@@ -1,0 +1,117 @@
+"""Operator overloads + method attachment for Tensor.
+
+Reference parity: python/paddle/fluid/dygraph/math_op_patch.py (monkey-patched dunder ops)
+and varbase_patch_methods.py (Tensor methods delegating to the functional API).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from . import creation, linalg, logic, manipulation, math, random, search, stat
+
+
+def _scalar_or_tensor(fn_tensor, fn_scalar):
+    def op(self, other):
+        if isinstance(other, Tensor):
+            return fn_tensor(self, other)
+        if isinstance(other, (list, tuple, np.ndarray)):
+            return fn_tensor(self, Tensor(np.asarray(other)))
+        return fn_scalar(self, other)
+
+    return op
+
+
+def _install():
+    T = Tensor
+
+    T.__add__ = _scalar_or_tensor(math.add, lambda s, o: apply(lambda v: v + o, s))
+    T.__radd__ = T.__add__
+    T.__sub__ = _scalar_or_tensor(math.subtract, lambda s, o: apply(lambda v: v - o, s))
+    T.__rsub__ = _scalar_or_tensor(
+        lambda s, o: math.subtract(o, s), lambda s, o: apply(lambda v: o - v, s)
+    )
+    T.__mul__ = _scalar_or_tensor(math.multiply, lambda s, o: apply(lambda v: v * o, s))
+    T.__rmul__ = T.__mul__
+    T.__truediv__ = _scalar_or_tensor(math.divide, lambda s, o: apply(lambda v: v / o, s))
+    T.__rtruediv__ = _scalar_or_tensor(
+        lambda s, o: math.divide(o, s), lambda s, o: apply(lambda v: o / v, s)
+    )
+    T.__floordiv__ = _scalar_or_tensor(
+        math.floor_divide, lambda s, o: apply(lambda v: jnp.floor_divide(v, o), s)
+    )
+    T.__mod__ = _scalar_or_tensor(math.mod, lambda s, o: apply(lambda v: jnp.mod(v, o), s))
+    T.__pow__ = _scalar_or_tensor(math.pow, lambda s, o: apply(lambda v: jnp.power(v, o), s))
+    T.__rpow__ = _scalar_or_tensor(
+        lambda s, o: math.pow(o, s), lambda s, o: apply(lambda v: jnp.power(o, v), s)
+    )
+    T.__neg__ = lambda self: apply(jnp.negative, self)
+    T.__abs__ = lambda self: apply(jnp.abs, self)
+    T.__matmul__ = lambda self, other: math.matmul(self, other)
+    T.__rmatmul__ = lambda self, other: math.matmul(other, self)
+    T.__invert__ = lambda self: logic.logical_not(self) if self.dtype == np.dtype("bool") else logic.bitwise_not(self)
+    T.__and__ = _scalar_or_tensor(
+        lambda s, o: logic.logical_and(s, o) if s.dtype == np.dtype("bool") else logic.bitwise_and(s, o),
+        lambda s, o: apply(lambda v: v & o, s),
+    )
+    T.__or__ = _scalar_or_tensor(
+        lambda s, o: logic.logical_or(s, o) if s.dtype == np.dtype("bool") else logic.bitwise_or(s, o),
+        lambda s, o: apply(lambda v: v | o, s),
+    )
+    T.__xor__ = _scalar_or_tensor(
+        lambda s, o: logic.logical_xor(s, o) if s.dtype == np.dtype("bool") else logic.bitwise_xor(s, o),
+        lambda s, o: apply(lambda v: v ^ o, s),
+    )
+    def _eq(self, other):
+        if other is None:
+            return False
+        return _scalar_or_tensor(logic.equal, lambda s, o: logic.equal(s, o))(self, other)
+
+    def _ne(self, other):
+        if other is None:
+            return True
+        return _scalar_or_tensor(logic.not_equal, lambda s, o: logic.not_equal(s, o))(self, other)
+
+    T.__eq__ = _eq
+    T.__ne__ = _ne
+    T.__lt__ = _scalar_or_tensor(logic.less_than, lambda s, o: logic.less_than(s, o))
+    T.__le__ = _scalar_or_tensor(logic.less_equal, lambda s, o: logic.less_equal(s, o))
+    T.__gt__ = _scalar_or_tensor(logic.greater_than, lambda s, o: logic.greater_than(s, o))
+    T.__ge__ = _scalar_or_tensor(logic.greater_equal, lambda s, o: logic.greater_equal(s, o))
+
+    # methods: every tensor.* function becomes a Tensor method (varbase_patch parity)
+    families = [math, manipulation, linalg, logic, search, stat, creation]
+    skip = {"to_tensor", "ones", "zeros", "full", "arange", "eye", "linspace", "logspace",
+            "empty", "meshgrid", "assign"}
+    for mod in families:
+        for name in dir(mod):
+            if name.startswith("_") or name in skip:
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn):
+                continue
+            if not hasattr(T, name):
+                setattr(T, name, fn)
+
+    # special-cased methods
+    T.mean = math.mean
+    T.sum = math.sum
+    T.max = math.max
+    T.min = math.min
+    T.abs = math.abs
+    T.exp = math.exp
+    T.log = math.log
+    T.sqrt = math.sqrt
+    T.matmul = math.matmul
+    T.reshape = manipulation.reshape
+    T.transpose = manipulation.transpose
+    T.flatten = manipulation.flatten
+    T.squeeze = manipulation.squeeze
+    T.unsqueeze = manipulation.unsqueeze
+    T.argmax = search.argmax
+    T.argmin = search.argmin
+    T.topk = search.topk
+    T.cast = lambda self, dtype: self.astype(dtype)
+
+
+_install()

@@ -1,0 +1,130 @@
+"""Random ops (python/paddle/tensor/random.py parity: rand, randn, randint, uniform,
+normal, randperm, multinomial, bernoulli, poisson, standard_normal, exponential_).
+
+TPU-native design: all draws pull explicit PRNG subkeys from the global Generator
+(core/generator.py) — reference's per-device seeded Generator (framework/generator.cc)
+maps onto jax.random key splitting.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.generator import default_generator
+from ..core.tensor import Tensor
+
+
+def _key():
+    return default_generator().split()
+
+
+def _d(dtype):
+    d = dtype_mod.convert_dtype(dtype)
+    return d if d is not None else dtype_mod.get_default_dtype()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(_key(), _shape(shape), dtype=_d(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(_key(), _shape(shape), dtype=_d(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else _key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=_d(dtype), minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(_key(), shp) * s + m)
+    return Tensor(jax.random.normal(_key(), _shape(shape)) * std + mean)
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = jax.random.key(seed) if seed else _key()
+    return Tensor(jax.random.normal(key, _shape(shape), dtype=_d(dtype)) * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_key(), _shape(shape), low, high, dtype=_d(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = dtype_mod.convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.randint(_key(), tuple(x.shape), low, high).astype(d))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(_key(), n).astype(_d(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    logits = jnp.log(jnp.clip(x._data if isinstance(x, Tensor) else jnp.asarray(x), 1e-30, None))
+    key = _key()
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1, shape=(num_samples,) + logits.shape[:-1] if logits.ndim > 1 else (num_samples,))
+        if logits.ndim > 1:
+            out = jnp.moveaxis(out, 0, -1)
+    else:
+        # Gumbel top-k for sampling without replacement
+        g = jax.random.gumbel(key, logits.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    p = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(_key(), p).astype(p.dtype))
+
+
+def poisson(x, name=None):
+    lam = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(_key(), lam).astype(lam.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    out = jax.random.exponential(_key(), tuple(x.shape), dtype=x.dtype) / lam
+    x._data = out
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._data = jax.random.normal(_key(), tuple(x.shape), dtype=x.dtype) * std + mean
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else _key()
+    x._data = jax.random.uniform(key, tuple(x.shape), dtype=x.dtype, minval=min, maxval=max)
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.uniform(_key(), tuple(x.shape), dtype=d))
+
+
+def randn_like(x, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.normal(_key(), tuple(x.shape), dtype=d))

@@ -1,0 +1,141 @@
+"""Search/sort ops (python/paddle/tensor/search.py parity: argmax, argmin, argsort, sort,
+topk, index_select, nonzero, kthvalue, mode, searchsorted, bucketize, masked_select)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = apply(lambda v: jnp.argmax(v, axis=axis, keepdims=keepdim).astype(jnp.int64), _t(x).detach())
+    out.stop_gradient = True
+    return out
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = apply(lambda v: jnp.argmin(v, axis=axis, keepdims=keepdim).astype(jnp.int64), _t(x).detach())
+    out.stop_gradient = True
+    return out
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(v):
+        idx = jnp.argsort(v, axis=axis, stable=True)
+        if descending:
+            idx = jnp.flip(idx, axis=axis)
+        return idx.astype(jnp.int64)
+
+    out = apply(fn, _t(x).detach())
+    out.stop_gradient = True
+    return out
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(v):
+        out = jnp.sort(v, axis=axis)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return out
+
+    return apply(fn, _t(x))
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    x = _t(x)
+    ax = -1 if axis is None else axis
+
+    def fn(v):
+        vv = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vv, k)
+        else:
+            vals, idx = jax.lax.top_k(-vv, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+
+    vals, idx = apply(fn, x)
+    idx.stop_gradient = True
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        s = jnp.sort(v, axis=axis)
+        i = jnp.argsort(v, axis=axis, stable=True)
+        vals = jnp.take(s, k - 1, axis=axis)
+        idx = jnp.take(i, k - 1, axis=axis).astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+
+    vals, idx = apply(fn, _t(x))
+    idx.stop_gradient = True
+    return vals, idx
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    """Most frequent value along axis (eager/numpy path — dynamic by nature)."""
+    arr = np.asarray(_t(x)._data)
+
+    def _mode1d(a):
+        vals, counts = np.unique(a, return_counts=True)
+        m = vals[np.argmax(counts)]
+        # paddle returns the last index of the mode value
+        idx = np.nonzero(a == m)[0][-1]
+        return m, idx
+
+    moved = np.moveaxis(arr, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    ms = np.empty(flat.shape[0], dtype=arr.dtype)
+    ids = np.empty(flat.shape[0], dtype=np.int64)
+    for r in range(flat.shape[0]):
+        ms[r], ids[r] = _mode1d(flat[r])
+    out_shape = moved.shape[:-1]
+    ms = ms.reshape(out_shape)
+    ids = ids.reshape(out_shape)
+    if keepdim:
+        ms = np.expand_dims(ms, axis)
+        ids = np.expand_dims(ids, axis)
+    return Tensor(jnp.asarray(ms)), Tensor(jnp.asarray(ids))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def fn(s, v):
+        side = "right" if right else "left"
+        out = jnp.searchsorted(s, v, side=side)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    out = apply(fn, _t(sorted_sequence).detach(), _t(values).detach())
+    out.stop_gradient = True
+    return out
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def fn(v, i):
+        i = i.astype(jnp.int32)
+        idx = [jnp.arange(s) for s in v.shape]
+        val = value._data if isinstance(value, Tensor) else value
+        moved = jnp.moveaxis(v, axis, 0)
+        moved = moved.at[i].set(val)
+        return jnp.moveaxis(moved, 0, axis)
+
+    return apply(fn, _t(x), _t(index).detach())
+
+
+def where_index(condition):
+    from .manipulation import nonzero
+
+    return nonzero(condition)

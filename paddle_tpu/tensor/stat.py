@@ -1,0 +1,88 @@
+"""Statistics ops (python/paddle/tensor/stat.py parity: mean, std, var, median,
+nanmedian, quantile, nanquantile)."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .math import mean  # re-export
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        lambda v: jnp.std(v, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        _t(x),
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        lambda v: jnp.var(v, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        _t(x),
+    )
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def fn(v):
+        if mode == "min":
+            # paddle 'min' mode: lower of the two middle values
+            n = v.shape[_axis(axis)] if axis is not None else v.size
+            sorted_v = jnp.sort(v.reshape(-1) if axis is None else v, axis=-1 if axis is None else _axis(axis))
+            k = (n - 1) // 2
+            return jnp.take(sorted_v, k, axis=-1 if axis is None else _axis(axis))
+        return jnp.median(v, axis=_axis(axis), keepdims=keepdim)
+
+    return apply(fn, _t(x))
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.nanmedian(v, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q._data if isinstance(q, Tensor) else q
+    return apply(
+        lambda v: jnp.quantile(v, jnp.asarray(qv), axis=_axis(axis), keepdims=keepdim, method=interpolation),
+        _t(x),
+    )
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q._data if isinstance(q, Tensor) else q
+    return apply(
+        lambda v: jnp.nanquantile(v, jnp.asarray(qv), axis=_axis(axis), keepdims=keepdim, method=interpolation),
+        _t(x),
+    )
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    x = np.asarray(_t(input)._data)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (x.min(), x.max())
+    hist, _ = np.histogram(x, bins=bins, range=(lo, hi))
+    return Tensor(jnp.asarray(hist.astype(np.int64)))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    w = np.asarray(weights._data) if isinstance(weights, Tensor) else weights
+    out = np.bincount(np.asarray(_t(x)._data), weights=w, minlength=minlength)
+    return Tensor(jnp.asarray(out))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda v: jnp.corrcoef(v, rowvar=rowvar), _t(x))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0), _t(x))

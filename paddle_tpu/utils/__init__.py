@@ -1,0 +1,25 @@
+"""paddle.utils parity: unique_name, deprecated, try_import, monitor gauges, dlpack."""
+from . import unique_name  # noqa: F401
+from .monitor import StatRegistry, stat_add, stat_get  # noqa: F401
+from .lazy_import import try_import  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import cpp_extension  # noqa: F401
+
+
+def deprecated(since=None, update_to=None, reason=None):
+    def wrap(fn):
+        return fn
+
+    return wrap
+
+
+def run_check():
+    """paddle.utils.run_check parity: verifies the device works."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((128, 128))
+    y = (x @ x).block_until_ready()
+    dev = jax.devices()[0]
+    print(f"paddle_tpu works on {dev.platform}:{dev.id} (matmul checksum {float(y.sum()):.0f})")
+    return True

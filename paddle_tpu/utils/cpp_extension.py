@@ -1,0 +1,83 @@
+"""Custom-op extension path.
+
+Reference parity: python/paddle/utils/cpp_extension/cpp_extension.py:50,206,256
+(setup/CppExtension/CUDAExtension + runtime registration through
+framework/custom_operator.cc:865).
+
+TPU-native design: a custom op = a python function (optionally backed by a C shared
+library via ctypes for host-side work, or a Pallas kernel for device work) plus an
+optional custom VJP. `load`/`setup` compile C++ sources with the system toolchain into a
+shared library and return a ctypes handle; `register_op` wires a python wrapper into the
+autodiff dispatcher.
+"""
+import ctypes
+import os
+import subprocess
+import sysconfig
+import tempfile
+
+_REGISTRY = {}
+
+
+def register_op(name, forward, backward=None):
+    """Register a custom op: forward is a pure jnp function; backward (optional) a
+    custom VJP (fn(*inputs, *cotangents) -> input grads)."""
+    import jax
+
+    if backward is not None:
+        f = jax.custom_vjp(forward)
+
+        def fwd(*args):
+            return forward(*args), args
+
+        def bwd(res, g):
+            out = backward(*res, g)
+            return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+
+        f.defvjp(fwd, bwd)
+    else:
+        f = forward
+
+    def op(*tensors, **kwargs):
+        from ..core.dispatch import apply
+
+        return apply(f, *tensors, **kwargs)
+
+    _REGISTRY[name] = op
+    return op
+
+
+def get_op(name):
+    return _REGISTRY[name]
+
+
+class CppExtension:
+    def __init__(self, sources, name=None, extra_compile_args=None, include_dirs=None, **kw):
+        self.sources = sources
+        self.name = name
+        self.extra_compile_args = extra_compile_args or []
+        self.include_dirs = include_dirs or []
+
+
+CUDAExtension = CppExtension  # no CUDA on TPU; accepted for compat, built as C++
+
+
+def load(name, sources, extra_cxx_cflags=None, build_directory=None, verbose=False, **kw):
+    """Compile C++ sources into a shared lib and return a ctypes CDLL
+    (cpp_extension.load parity, minus pybind11: use extern "C" symbols)."""
+    build_dir = build_directory or tempfile.mkdtemp(prefix="pt_ext_")
+    os.makedirs(build_dir, exist_ok=True)
+    so_path = os.path.join(build_dir, f"{name}.so")
+    cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", so_path]
+    cmd += [f"-I{sysconfig.get_paths()['include']}"]
+    cmd += extra_cxx_cflags or []
+    cmd += list(sources)
+    subprocess.run(cmd, check=True, capture_output=not verbose)
+    return ctypes.CDLL(so_path)
+
+
+def setup(name=None, ext_modules=None, **kw):
+    libs = []
+    for ext in ext_modules or []:
+        libs.append(load(ext.name or name, ext.sources, ext.extra_compile_args))
+    return libs

@@ -1,0 +1,15 @@
+"""DLPack interop (paddle/fluid/framework/dlpack_tensor.cc + pybind tensor exchange
+parity) — zero-copy with any dlpack-speaking library (torch/numpy/cupy)."""
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def to_dlpack(x):
+    return x._data.__dlpack__()
+
+
+def from_dlpack(capsule):
+    arr = jnp.from_dlpack(capsule)
+    return Tensor(arr)

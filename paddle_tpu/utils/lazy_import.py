@@ -1,0 +1,11 @@
+"""try_import (python/paddle/utils/lazy_import.py parity)."""
+import importlib
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        if err_msg:
+            raise ImportError(err_msg)
+        raise

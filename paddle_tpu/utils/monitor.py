@@ -1,0 +1,41 @@
+"""Named int64 gauges (paddle/fluid/platform/monitor.h:77 StatRegistry + STAT_ADD:130
+parity)."""
+import threading
+
+
+class StatRegistry:
+    _inst = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._stats = {}
+
+    @classmethod
+    def instance(cls):
+        with cls._lock:
+            if cls._inst is None:
+                cls._inst = cls()
+            return cls._inst
+
+    def add(self, name, value):
+        self._stats[name] = self._stats.get(name, 0) + int(value)
+
+    def get(self, name):
+        return self._stats.get(name, 0)
+
+    def reset(self, name=None):
+        if name is None:
+            self._stats.clear()
+        else:
+            self._stats.pop(name, None)
+
+    def stats(self):
+        return dict(self._stats)
+
+
+def stat_add(name, value=1):
+    StatRegistry.instance().add(name, value)
+
+
+def stat_get(name):
+    return StatRegistry.instance().get(name)

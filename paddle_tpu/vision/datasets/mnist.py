@@ -1,0 +1,97 @@
+"""MNIST dataset (python/paddle/vision/datasets/mnist.py parity).
+
+Reads the standard idx-ubyte files when present (image_path/label_path or
+~/.cache/paddle/dataset/mnist); otherwise generates a deterministic synthetic set so
+training flows run in zero-egress environments (class-conditional gaussian blobs —
+learnable, converges like a toy MNIST).
+"""
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+_HOME = os.path.expanduser("~/.cache/paddle/dataset/mnist")
+
+
+def _load_idx_images(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(n, rows, cols)
+
+
+def _load_idx_labels(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data
+
+
+def _synthetic_mnist(n, seed):
+    """Deterministic class-conditional digit-blob images."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n).astype(np.int64)
+    images = np.zeros((n, 28, 28), dtype=np.uint8)
+    centers = [(7 + 2 * (d % 5), 7 + 3 * (d // 5)) for d in range(10)]
+    yy, xx = np.mgrid[0:28, 0:28]
+    for i in range(n):
+        cy, cx = centers[labels[i]]
+        blob = np.exp(-(((yy - cy) ** 2 + (xx - cx) ** 2) / (2.0 * (2.0 + labels[i] * 0.3) ** 2)))
+        noise = rng.rand(28, 28) * 0.15
+        images[i] = np.clip((blob + noise) * 255, 0, 255).astype(np.uint8)
+    return images, labels
+
+
+class MNIST(Dataset):
+    NAME = "mnist"
+    N_TRAIN = 60000
+    N_TEST = 10000
+
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        self.backend = backend or "cv2"
+        images, labels = self._load(image_path, label_path)
+        self.images = images
+        self.labels = labels
+
+    def _load(self, image_path, label_path):
+        prefix = "train" if self.mode == "train" else "t10k"
+        candidates = [
+            (image_path, label_path),
+            (os.path.join(_HOME, f"{prefix}-images-idx3-ubyte.gz"),
+             os.path.join(_HOME, f"{prefix}-labels-idx1-ubyte.gz")),
+            (os.path.join(_HOME, f"{prefix}-images-idx3-ubyte"),
+             os.path.join(_HOME, f"{prefix}-labels-idx1-ubyte")),
+        ]
+        for ip, lp in candidates:
+            if ip and lp and os.path.exists(ip) and os.path.exists(lp):
+                return _load_idx_images(ip), _load_idx_labels(lp).astype(np.int64)
+        n = 6000 if self.mode == "train" else 1000  # synthetic fallback (smaller)
+        return _synthetic_mnist(n, seed=42 if self.mode == "train" else 7)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = np.asarray([self.labels[idx]], dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)
+        from ...core.tensor import Tensor
+
+        if isinstance(img, Tensor):
+            img = np.asarray(img._data)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"

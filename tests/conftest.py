@@ -1,0 +1,36 @@
+"""Test harness: force an 8-device virtual CPU mesh (SURVEY.md §4 template —
+the TPU analog of the reference's localhost multi-process NCCL tests).
+
+The axon sitecustomize pins jax_platforms to the TPU tunnel; tests override it to CPU
+*before* any jax computation so the suite is hermetic and multi-device.
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tape():
+    """Isolate the global autograd tape between tests."""
+    from paddle_tpu.core.tape import global_tape
+
+    global_tape().clear()
+    yield
+    global_tape().clear()
+
+
+@pytest.fixture
+def seed():
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    np.random.seed(0)
+    paddle.seed(0)
+    return 0

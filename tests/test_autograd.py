@@ -1,0 +1,168 @@
+"""Autograd engine tests — BasicEngine/GradientAccumulator semantics
+(imperative/basic_engine.cc:265, gradient_accumulator.h:27) + numeric-gradient checks
+(op_test.py get_numeric_gradient analog)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, sg=False):
+    return paddle.to_tensor(np.asarray(a, dtype=np.float32), stop_gradient=sg)
+
+
+def numeric_grad(f, x, eps=1e-3):
+    g = np.zeros_like(x)
+    for i in range(x.size):
+        xp = x.copy().reshape(-1)
+        xm = x.copy().reshape(-1)
+        xp[i] += eps
+        xm[i] -= eps
+        g.reshape(-1)[i] = (f(xp.reshape(x.shape)) - f(xm.reshape(x.shape))) / (2 * eps)
+    return g
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = t([2.0])
+        y = x * x + 3 * x
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0], rtol=1e-5)
+
+    def test_matmul_grad(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4, 2).astype(np.float32)
+        x, y = t(a), t(b)
+        loss = paddle.matmul(x, y).sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones((3, 2)) @ b.T, rtol=1e-4)
+        np.testing.assert_allclose(y.grad.numpy(), a.T @ np.ones((3, 2)), rtol=1e-4)
+
+    def test_grad_accumulation(self):
+        x = t([1.0, 2.0])
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_multi_consumer(self):
+        x = t([2.0])
+        y = x * x
+        z = y + y * y
+        z.backward()
+        # dz/dy = 1 + 2y = 9 at y=4; dy/dx = 2x = 4 -> dz/dx = 36
+        np.testing.assert_allclose(x.grad.numpy(), [36.0], rtol=1e-5)
+
+    def test_stop_gradient(self):
+        x = t([1.0])
+        w = t([2.0], sg=True)
+        y = x * w
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        assert w.grad is None
+
+    def test_detach(self):
+        x = t([3.0])
+        d = x.detach()
+        assert d.stop_gradient
+        y = x * d
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+    def test_numeric_grad_check_softmax_ce(self):
+        logits = np.random.rand(4, 5).astype(np.float32)
+        labels = np.array([1, 0, 3, 2])
+
+        def f(lv):
+            import jax.nn as jnn
+            import jax.numpy as jnp
+
+            lp = jnn.log_softmax(jnp.asarray(lv), axis=-1)
+            return float(-lp[np.arange(4), labels].mean())
+
+        x = t(logits)
+        loss = paddle.nn.functional.cross_entropy(x, paddle.to_tensor(labels))
+        loss.backward()
+        ng = numeric_grad(f, logits)
+        np.testing.assert_allclose(x.grad.numpy(), ng, atol=1e-2)
+
+    def test_retain_graph(self):
+        x = t([2.0])
+        y = x * x
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+    def test_backward_with_grad_tensor(self):
+        x = t([1.0, 2.0])
+        y = x * 2
+        y.backward(paddle.to_tensor([1.0, 0.5]))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 1.0])
+
+    def test_no_grad(self):
+        x = t([1.0])
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_inplace_add(self):
+        from paddle_tpu.tensor.math import add_
+
+        x = t([1.0])
+        y = x * 2
+        add_(y, paddle.to_tensor([1.0]))
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+class TestPaddleGrad:
+    def test_grad_api(self):
+        x = t([3.0])
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [6.0])
+        assert x.grad is None  # paddle.grad does not pollute .grad
+
+    def test_double_like_grad_create_graph(self):
+        x = t([2.0])
+        y = x * x * x
+        (gx,) = paddle.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(gx.numpy(), [12.0], rtol=1e-5)
+
+
+class TestHooks:
+    def test_register_hook(self):
+        x = t([1.0])
+        seen = []
+        x.register_hook(lambda g: seen.append(g.numpy().copy()))
+        (x * 5).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [5.0])
+
+    def test_hook_modify(self):
+        x = t([1.0])
+        x.register_hook(lambda g: g * 0)
+        (x * 5).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.0])
+
+
+class TestPyLayer:
+    def test_custom_vjp(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Square(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor()
+                return grad * 2 * x
+
+        x = t([3.0])
+        y = Square.apply(x)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])

@@ -1,0 +1,334 @@
+"""nn.Layer system + layers/functionals (fluid/dygraph/layers.py + nn layer tests
+pattern from fluid/tests/unittests/test_layers.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a, dtype=np.float32), stop_gradient=sg)
+
+
+class TestLayerSystem:
+    def test_param_registration(self):
+        l = nn.Linear(4, 3)
+        names = [n for n, _ in l.named_parameters()]
+        assert set(names) == {"weight", "bias"}
+        assert l.weight.shape == [4, 3]
+        assert not l.weight.stop_gradient
+
+    def test_sublayers_state_dict(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        sd = net.state_dict()
+        assert "fc1.weight" in sd and "fc2.bias" in sd
+        net2 = Net()
+        net2.set_state_dict(sd)
+        np.testing.assert_allclose(net2.fc1.weight.numpy(), net.fc1.weight.numpy())
+
+    def test_train_eval_mode(self):
+        l = nn.Sequential(nn.Linear(3, 3), nn.Dropout(0.5))
+        l.eval()
+        assert not l[1].training
+        l.train()
+        assert l[1].training
+
+    def test_forward_hooks(self):
+        l = nn.Linear(2, 2)
+        calls = []
+        h = l.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        l(t(np.zeros((1, 2))))
+        assert calls == [1]
+        h.remove()
+        l(t(np.zeros((1, 2))))
+        assert calls == [1]
+
+    def test_layerlist_parameterlist(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3
+        assert len(list(ll.parameters())) == 6
+
+
+class TestActivations:
+    def test_relu_gelu_softmax(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(F.relu(t(a)).numpy(), np.maximum(a, 0))
+        s = F.softmax(t(a), axis=-1).numpy()
+        np.testing.assert_allclose(s.sum(-1), np.ones(3), rtol=1e-5)
+        assert F.gelu(t(a)).shape == [3, 4]
+        np.testing.assert_allclose(F.sigmoid(t(a)).numpy(), 1 / (1 + np.exp(-a)), rtol=1e-5)
+
+    def test_activation_layers(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        for cls in [nn.ReLU, nn.GELU, nn.Tanh, nn.Sigmoid, nn.Softmax, nn.LeakyReLU,
+                    nn.ELU, nn.SELU, nn.Hardswish, nn.Silu, nn.Mish]:
+            out = cls()(t(a))
+            assert out.shape == [2, 3]
+        p = nn.PReLU(num_parameters=3)
+        assert p(t(np.random.randn(2, 3, 4, 4).astype(np.float32))).shape == [2, 3, 4, 4]
+
+
+class TestLinearConv:
+    def test_linear_matches_numpy(self):
+        l = nn.Linear(4, 3)
+        x = np.random.rand(5, 4).astype(np.float32)
+        out = l(t(x))
+        np.testing.assert_allclose(out.numpy(), x @ l.weight.numpy() + l.bias.numpy(), rtol=1e-5)
+
+    def test_conv2d_shape_and_grad(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        x = t(np.random.rand(2, 3, 16, 16), sg=False)
+        out = conv(x)
+        assert out.shape == [2, 8, 8, 8]
+        out.sum().backward()
+        assert conv.weight.grad is not None
+        assert x.grad.shape == [2, 3, 16, 16]
+
+    def test_conv2d_vs_manual(self):
+        conv = nn.Conv2D(1, 1, 2, bias_attr=False)
+        x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+        w = conv.weight.numpy()
+        out = conv(t(x)).numpy()
+        expect = np.zeros((1, 1, 2, 2), np.float32)
+        for i in range(2):
+            for j in range(2):
+                expect[0, 0, i, j] = (x[0, 0, i : i + 2, j : j + 2] * w[0, 0]).sum()
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    def test_conv_transpose(self):
+        convt = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1)
+        x = t(np.random.rand(1, 4, 8, 8))
+        assert convt(x).shape == [1, 2, 15, 15]
+
+    def test_conv1d_3d(self):
+        assert nn.Conv1D(2, 4, 3)(t(np.random.rand(1, 2, 10))).shape == [1, 4, 8]
+        assert nn.Conv3D(1, 2, 2)(t(np.random.rand(1, 1, 4, 4, 4))).shape == [1, 2, 3, 3, 3]
+
+    def test_grouped_conv(self):
+        conv = nn.Conv2D(4, 8, 3, groups=2, padding=1)
+        assert conv(t(np.random.rand(1, 4, 5, 5))).shape == [1, 8, 5, 5]
+        assert conv.weight.shape == [8, 2, 3, 3]
+
+
+class TestNorm:
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = np.random.rand(4, 3, 5, 5).astype(np.float32) * 2 + 1
+        bn.train()
+        out = bn(t(x)).numpy()
+        np.testing.assert_allclose(out.mean((0, 2, 3)), np.zeros(3), atol=1e-4)
+        np.testing.assert_allclose(out.std((0, 2, 3)), np.ones(3), atol=1e-2)
+        assert bn._mean.numpy().mean() != 0  # running stats updated
+        bn.eval()
+        out2 = bn(t(x))
+        assert out2.shape == [4, 3, 5, 5]
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = np.random.rand(2, 4, 8).astype(np.float32)
+        out = ln(t(x)).numpy()
+        np.testing.assert_allclose(out.mean(-1), np.zeros((2, 4)), atol=1e-5)
+
+    def test_groupnorm_instancenorm(self):
+        gn = nn.GroupNorm(2, 4)
+        assert gn(t(np.random.rand(2, 4, 3, 3))).shape == [2, 4, 3, 3]
+        inorm = nn.InstanceNorm2D(4)
+        assert inorm(t(np.random.rand(2, 4, 3, 3))).shape == [2, 4, 3, 3]
+
+
+class TestPooling:
+    def test_maxpool_avgpool(self):
+        x = np.random.rand(1, 2, 8, 8).astype(np.float32)
+        mp = nn.MaxPool2D(2, 2)(t(x)).numpy()
+        assert mp.shape == (1, 2, 4, 4)
+        np.testing.assert_allclose(mp[0, 0, 0, 0], x[0, 0, :2, :2].max())
+        ap = nn.AvgPool2D(2, 2)(t(x)).numpy()
+        np.testing.assert_allclose(ap[0, 0, 0, 0], x[0, 0, :2, :2].mean(), rtol=1e-5)
+
+    def test_adaptive_pools(self):
+        x = t(np.random.rand(1, 3, 7, 9))
+        assert nn.AdaptiveAvgPool2D((2, 3))(x).shape == [1, 3, 2, 3]
+        assert nn.AdaptiveMaxPool2D(1)(x).shape == [1, 3, 1, 1]
+        g = nn.AdaptiveAvgPool2D(1)(x).numpy()
+        np.testing.assert_allclose(g[:, :, 0, 0], np.asarray(x.numpy()).mean((2, 3)), rtol=1e-5)
+
+
+class TestLosses:
+    def test_cross_entropy(self):
+        logits = np.random.rand(4, 5).astype(np.float32)
+        labels = np.array([0, 1, 2, 3])
+        loss = F.cross_entropy(t(logits), paddle.to_tensor(labels))
+        # numpy reference
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expect = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(float(loss.numpy()), expect, rtol=1e-5)
+
+    def test_cross_entropy_soft_and_smoothing(self):
+        logits = np.random.rand(4, 5).astype(np.float32)
+        soft = np.random.dirichlet(np.ones(5), 4).astype(np.float32)
+        l1 = F.cross_entropy(t(logits), t(soft), soft_label=True)
+        assert l1.ndim == 0
+        l2 = F.cross_entropy(t(logits), paddle.to_tensor(np.array([0, 1, 2, 3])), label_smoothing=0.1)
+        assert l2.ndim == 0
+
+    def test_mse_l1_bce(self):
+        a = np.random.rand(6).astype(np.float32)
+        b = np.random.rand(6).astype(np.float32)
+        np.testing.assert_allclose(float(F.mse_loss(t(a), t(b)).numpy()), ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(float(F.l1_loss(t(a), t(b)).numpy()), np.abs(a - b).mean(), rtol=1e-5)
+        p = np.clip(a, 0.01, 0.99)
+        y = (b > 0.5).astype(np.float32)
+        bce = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(float(F.binary_cross_entropy(t(p), t(y)).numpy()), bce, rtol=1e-4)
+
+    def test_loss_layers(self):
+        logits = t(np.random.rand(4, 5))
+        labels = paddle.to_tensor(np.array([0, 1, 2, 3]))
+        assert nn.CrossEntropyLoss()(logits, labels).ndim == 0
+        assert nn.MSELoss()(logits, t(np.random.rand(4, 5))).ndim == 0
+
+    def test_ctc_loss_smoke(self):
+        T, B, C, S = 8, 2, 5, 3
+        lp = t(np.random.rand(T, B, C), sg=False)
+        labels = paddle.to_tensor(np.random.randint(1, C, (B, S)))
+        in_len = paddle.to_tensor(np.array([T, T]))
+        lab_len = paddle.to_tensor(np.array([S, S - 1]))
+        loss = F.ctc_loss(lp, labels, in_len, lab_len)
+        assert float(loss.numpy()) > 0
+        loss.backward()
+        assert lp.grad is not None
+
+
+class TestEmbeddingDropout:
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+        out = emb(ids)
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+    def test_embedding_grad_sparse_rows(self):
+        emb = nn.Embedding(10, 4)
+        ids = paddle.to_tensor(np.array([0, 0, 5]))
+        emb(ids).sum().backward()
+        g = emb.weight.grad.numpy()
+        np.testing.assert_allclose(g[0], 2 * np.ones(4))
+        np.testing.assert_allclose(g[1], np.zeros(4))
+
+    def test_dropout(self, seed):
+        x = t(np.ones((100, 100)))
+        d = nn.Dropout(0.5)
+        out = d(x).numpy()
+        assert 0.3 < (out == 0).mean() < 0.7
+        np.testing.assert_allclose(out[out != 0], 2.0 * np.ones_like(out[out != 0]), rtol=1e-6)
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+class TestRNN:
+    def test_lstm_cell_and_net(self):
+        cell = nn.LSTMCell(4, 8)
+        h, (h2, c2) = cell(t(np.random.rand(2, 4)))
+        assert h.shape == [2, 8] and c2.shape == [2, 8]
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        out, (h, c) = lstm(t(np.random.rand(2, 5, 4)))
+        assert out.shape == [2, 5, 8]
+        assert h.shape == [2, 2, 8]
+
+    def test_gru_simple_rnn(self):
+        gru = nn.GRU(4, 6)
+        out, h = gru(t(np.random.rand(3, 7, 4)))
+        assert out.shape == [3, 7, 6] and h.shape == [1, 3, 6]
+        rnn = nn.SimpleRNN(4, 6, direction="bidirect")
+        out, h = rnn(t(np.random.rand(3, 7, 4)))
+        assert out.shape == [3, 7, 12]
+
+    def test_rnn_grad_flows(self):
+        lstm = nn.LSTM(3, 4)
+        x = t(np.random.rand(2, 6, 3), sg=False)
+        out, _ = lstm(x)
+        out.sum().backward()
+        assert x.grad is not None
+        assert lstm.rnns[0].cell.weight_ih.grad is not None
+
+
+class TestTransformer:
+    def test_mha_shapes(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = t(np.random.rand(2, 5, 16))
+        assert mha(x).shape == [2, 5, 16]
+
+    def test_encoder_decoder(self):
+        enc_l = nn.TransformerEncoderLayer(16, 4, 32)
+        enc = nn.TransformerEncoder(enc_l, 2)
+        src = t(np.random.rand(2, 6, 16))
+        mem = enc(src)
+        assert mem.shape == [2, 6, 16]
+        dec_l = nn.TransformerDecoderLayer(16, 4, 32)
+        dec = nn.TransformerDecoder(dec_l, 2)
+        tgt = t(np.random.rand(2, 4, 16))
+        assert dec(tgt, mem).shape == [2, 4, 16]
+
+    def test_full_transformer_with_mask(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32)
+        src = t(np.random.rand(2, 5, 16))
+        tgt = t(np.random.rand(2, 3, 16))
+        mask = model.generate_square_subsequent_mask(3)
+        out = model(src, tgt, tgt_mask=mask)
+        assert out.shape == [2, 3, 16]
+
+    def test_causal_mask_effect(self):
+        # with a causal mask, output at position 0 must not depend on position 2
+        mha = nn.MultiHeadAttention(8, 2)
+        mha.eval()
+        x1 = np.random.rand(1, 3, 8).astype(np.float32)
+        x2 = x1.copy()
+        x2[0, 2] += 1.0
+        mask = np.triu(np.full((3, 3), -1e9, np.float32), 1)
+        o1 = mha(t(x1), attn_mask=t(mask)).numpy()
+        o2 = mha(t(x2), attn_mask=t(mask)).numpy()
+        np.testing.assert_allclose(o1[0, 0], o2[0, 0], atol=1e-5)
+
+
+class TestPadInterp:
+    def test_pad(self):
+        x = t(np.random.rand(1, 2, 3, 3))
+        assert F.pad(x, [1, 1, 2, 2]).shape == [1, 2, 7, 5]
+        assert F.pad(x, [1, 0], mode="reflect").shape == [1, 2, 3, 4]
+
+    def test_interpolate(self):
+        x = t(np.random.rand(1, 2, 4, 4))
+        assert F.interpolate(x, size=[8, 8]).shape == [1, 2, 8, 8]
+        assert F.interpolate(x, scale_factor=0.5, mode="bilinear").shape == [1, 2, 2, 2]
+        up = nn.Upsample(scale_factor=2, mode="nearest")
+        np.testing.assert_allclose(
+            up(x).numpy()[0, 0, ::2, ::2], x.numpy()[0, 0], rtol=1e-6
+        )
+
+    def test_one_hot_label_smooth(self):
+        oh = F.one_hot(paddle.to_tensor(np.array([0, 2])), 3).numpy()
+        np.testing.assert_allclose(oh, [[1, 0, 0], [0, 0, 1]])
+
+
+class TestClip:
+    def test_global_norm_clip(self):
+        from paddle_tpu.nn import ClipGradByGlobalNorm
+
+        p = paddle.ParamBase(np.ones(4, np.float32))
+        g = paddle.to_tensor(np.full(4, 10.0, np.float32))
+        clip = ClipGradByGlobalNorm(1.0)
+        (_, g2), = clip([(p, g)])
+        np.testing.assert_allclose(np.linalg.norm(g2.numpy()), 1.0, rtol=1e-5)

@@ -1,0 +1,217 @@
+"""Optimizer update-rule correctness vs hand-computed references
+(operators/optimizers/*_op.cc math) + LR schedules (optimizer/lr.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as opt
+
+
+def make_param(val):
+    return paddle.ParamBase(np.asarray(val, dtype=np.float32))
+
+
+def set_grad(p, g):
+    p.grad = paddle.to_tensor(np.asarray(g, dtype=np.float32))
+
+
+class TestRules:
+    def test_sgd(self):
+        p = make_param([1.0, 2.0])
+        o = opt.SGD(learning_rate=0.1, parameters=[p])
+        set_grad(p, [1.0, 1.0])
+        o.step()
+        np.testing.assert_allclose(p.numpy(), [0.9, 1.9], rtol=1e-6)
+
+    def test_momentum(self):
+        p = make_param([1.0])
+        o = opt.Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+        set_grad(p, [1.0])
+        o.step()
+        np.testing.assert_allclose(p.numpy(), [0.9], rtol=1e-6)
+        set_grad(p, [1.0])
+        o.step()
+        # v = 0.9*1 + 1 = 1.9 ; p = 0.9 - 0.1*1.9 = 0.71
+        np.testing.assert_allclose(p.numpy(), [0.71], rtol=1e-5)
+
+    def test_adam_matches_reference_formula(self):
+        p = make_param([1.0])
+        o = opt.Adam(learning_rate=0.1, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=[p])
+        g = 0.5
+        set_grad(p, [g])
+        o.step()
+        m = 0.1 * g
+        v = 0.001 * g * g
+        lr_t = 0.1 * np.sqrt(1 - 0.999) / (1 - 0.9)
+        expect = 1.0 - lr_t * m / (np.sqrt(v) + 1e-8)
+        np.testing.assert_allclose(p.numpy(), [expect], rtol=1e-5)
+
+    def test_adamw_decoupled_decay(self):
+        p1 = make_param([1.0])
+        p2 = make_param([1.0])
+        a = opt.Adam(learning_rate=0.1, parameters=[p1], weight_decay=0.0)
+        w = opt.AdamW(learning_rate=0.1, parameters=[p2], weight_decay=0.1)
+        set_grad(p1, [0.5])
+        set_grad(p2, [0.5])
+        a.step()
+        w.step()
+        assert p2.numpy()[0] < p1.numpy()[0]  # decay shrinks the weight
+
+    def test_adagrad_rmsprop_adadelta_adamax(self):
+        for cls, kw in [
+            (opt.Adagrad, dict(learning_rate=0.1)),
+            (opt.RMSProp, dict(learning_rate=0.1)),
+            (opt.Adadelta, dict(learning_rate=1.0)),
+            (opt.Adamax, dict(learning_rate=0.1)),
+            (opt.Ftrl, dict(learning_rate=0.1)),
+        ]:
+            p = make_param([1.0, -1.0])
+            o = cls(parameters=[p], **kw)
+            before = p.numpy().copy()
+            for _ in range(3):
+                set_grad(p, [0.5, -0.5])
+                o.step()
+            assert not np.allclose(p.numpy(), before)
+
+    def test_lamb_trust_ratio(self):
+        p = make_param(np.ones(10))
+        o = opt.Lamb(learning_rate=0.01, parameters=[p])
+        set_grad(p, np.full(10, 0.1))
+        o.step()
+        assert (p.numpy() < 1.0).all()
+
+    def test_lars(self):
+        p = make_param(np.ones(10))
+        o = opt.Lars(learning_rate=0.1, parameters=[p])
+        set_grad(p, np.full(10, 0.1))
+        o.step()
+        assert (p.numpy() < 1.0).all()
+
+    def test_weight_decay_l2(self):
+        p = make_param([1.0])
+        o = opt.SGD(learning_rate=0.1, parameters=[p], weight_decay=0.5)
+        set_grad(p, [0.0])
+        o.step()
+        # g_eff = 0 + 0.5*1 -> p = 1 - 0.05
+        np.testing.assert_allclose(p.numpy(), [0.95], rtol=1e-5)
+
+    def test_grad_clip_in_step(self):
+        from paddle_tpu.nn import ClipGradByGlobalNorm
+
+        p = make_param(np.zeros(4))
+        o = opt.SGD(learning_rate=1.0, parameters=[p], grad_clip=ClipGradByGlobalNorm(1.0))
+        set_grad(p, np.full(4, 100.0))
+        o.step()
+        np.testing.assert_allclose(np.linalg.norm(p.numpy()), 1.0, rtol=1e-4)
+
+    def test_minimize_and_state_dict(self):
+        p = make_param([2.0])
+        o = opt.Adam(learning_rate=0.1, parameters=[p])
+        x = paddle.to_tensor(np.array([3.0], np.float32))
+        loss = (p * x).sum()
+        o.minimize(loss)
+        sd = o.state_dict()
+        assert "step" in sd
+        o2 = opt.Adam(learning_rate=0.1, parameters=[p])
+        o2.set_state_dict(sd)
+        assert o2._step_count == 1
+
+
+class TestFunctionalView:
+    def test_functional_matches_eager(self):
+        p_eager = make_param(np.ones(4))
+        o1 = opt.Adam(learning_rate=0.1, parameters=[p_eager])
+        g = np.full(4, 0.3, np.float32)
+        set_grad(p_eager, g)
+        o1.step()
+
+        o2 = opt.Adam(learning_rate=0.1)
+        params = {"w": np.ones(4, np.float32)}
+        state = o2.functional_init({"w": paddle.to_tensor(params["w"])._data})
+        new_p, new_s = o2.functional_apply(
+            {"w": paddle.to_tensor(params["w"])._data}, {"w": paddle.to_tensor(g)._data}, state
+        )
+        np.testing.assert_allclose(np.asarray(new_p["w"]), p_eager.numpy(), rtol=1e-6)
+
+
+class TestLRSchedules:
+    def test_step_decay(self):
+        s = opt.lr.StepDecay(learning_rate=1.0, step_size=2, gamma=0.5)
+        vals = [s()]
+        for _ in range(4):
+            s.step()
+            vals.append(s())
+        np.testing.assert_allclose(vals, [1.0, 1.0, 0.5, 0.5, 0.25])
+
+    def test_multistep_piecewise_exp(self):
+        s = opt.lr.MultiStepDecay(1.0, [2, 4], 0.1)
+        for _ in range(5):
+            s.step()
+        np.testing.assert_allclose(s(), 0.01, rtol=1e-6)
+        pw = opt.lr.PiecewiseDecay([2, 4], [0.1, 0.05, 0.01])
+        assert pw() == 0.1
+        e = opt.lr.ExponentialDecay(1.0, 0.9)
+        e.step()
+        np.testing.assert_allclose(e(), 0.9, rtol=1e-6)
+
+    def test_warmup_cosine_noam(self):
+        w = opt.lr.LinearWarmup(learning_rate=1.0, warmup_steps=10, start_lr=0.0, end_lr=1.0)
+        for _ in range(5):
+            w.step()
+        assert 0.4 < w() < 0.6
+        c = opt.lr.CosineAnnealingDecay(1.0, T_max=10)
+        for _ in range(10):
+            c.step()
+        np.testing.assert_allclose(c(), 0.0, atol=1e-6)
+        n = opt.lr.NoamDecay(d_model=512, warmup_steps=100)
+        assert n() > 0
+
+    def test_reduce_on_plateau(self):
+        r = opt.lr.ReduceOnPlateau(learning_rate=1.0, patience=1, factor=0.5)
+        r.step(1.0)
+        r.step(1.0)
+        r.step(1.0)
+        assert r() == 0.5
+
+    def test_scheduler_with_optimizer(self):
+        p = make_param([1.0])
+        sched = opt.lr.StepDecay(learning_rate=0.1, step_size=1, gamma=0.1)
+        o = opt.SGD(learning_rate=sched, parameters=[p])
+        assert o.get_lr() == 0.1
+        sched.step()
+        assert abs(o.get_lr() - 0.01) < 1e-9
+        set_grad(p, [1.0])
+        o.step()
+        np.testing.assert_allclose(p.numpy(), [1.0 - 0.01], rtol=1e-5)
+
+
+class TestAmp:
+    def test_autocast_matmul_bf16(self):
+        import jax.numpy as jnp
+
+        a = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32))
+        b = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32))
+        with paddle.amp.auto_cast(True, dtype="bfloat16"):
+            out = paddle.matmul(a, b)
+        assert out.dtype == jnp.bfloat16.dtype
+
+    def test_grad_scaler_roundtrip(self):
+        p = make_param([1.0])
+        o = opt.SGD(learning_rate=0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        loss = (p * x).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(o)
+        # grad was 2*2=4 scaled, unscaled to 2 -> p = 1 - 0.2
+        np.testing.assert_allclose(p.numpy(), [0.8], rtol=1e-5)
+
+    def test_scaler_skips_inf(self):
+        p = make_param([1.0])
+        o = opt.SGD(learning_rate=0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        p.grad = paddle.to_tensor(np.array([np.inf], np.float32))
+        scaler.step(o)
+        np.testing.assert_allclose(p.numpy(), [1.0])  # update skipped
+        assert scaler._scale < 4.0 or scaler._bad_steps > 0

@@ -1,0 +1,225 @@
+"""Op correctness vs numpy reference — the OpTest pattern
+(python/paddle/fluid/tests/unittests/op_test.py:255 check_output_with_place) with
+jax-native numeric gradient checks (op_test.py:110 get_numeric_gradient analog)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a), stop_gradient=sg)
+
+
+class TestCreation:
+    def test_ones_zeros_full(self):
+        assert paddle.ones([2, 3]).numpy().tolist() == np.ones((2, 3)).tolist()
+        assert paddle.zeros([4]).shape == [4]
+        assert float(paddle.full([1], 3.5).numpy()[0]) == 3.5
+
+    def test_arange_linspace_eye(self):
+        np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+        np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+
+    def test_like_family(self):
+        x = t(np.random.rand(3, 4).astype(np.float32))
+        assert paddle.ones_like(x).shape == [3, 4]
+        assert paddle.zeros_like(x).numpy().sum() == 0
+        assert paddle.full_like(x, 2.0).numpy().mean() == 2.0
+
+    def test_tril_triu_diag(self):
+        a = np.random.rand(4, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.tril(t(a)).numpy(), np.tril(a))
+        np.testing.assert_allclose(paddle.triu(t(a), 1).numpy(), np.triu(a, 1))
+        v = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+        np.testing.assert_allclose(paddle.diag(t(v)).numpy(), np.diag(v))
+
+    def test_to_tensor_dtypes(self):
+        assert str(paddle.to_tensor([1, 2]).dtype) == "int64" or paddle.to_tensor([1, 2]).dtype == np.dtype("int32")
+        x = paddle.to_tensor([1.0, 2.0])
+        assert x.dtype == np.dtype("float32")
+
+
+class TestMath:
+    def test_elementwise_binary(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(3, 4).astype(np.float32) + 0.5
+        np.testing.assert_allclose(paddle.add(t(a), t(b)).numpy(), a + b, rtol=1e-6)
+        np.testing.assert_allclose(paddle.subtract(t(a), t(b)).numpy(), a - b, rtol=1e-6)
+        np.testing.assert_allclose(paddle.multiply(t(a), t(b)).numpy(), a * b, rtol=1e-6)
+        np.testing.assert_allclose(paddle.divide(t(a), t(b)).numpy(), a / b, rtol=1e-5)
+        np.testing.assert_allclose(paddle.maximum(t(a), t(b)).numpy(), np.maximum(a, b))
+        np.testing.assert_allclose(paddle.pow(t(a), 2).numpy(), a**2, rtol=1e-6)
+
+    def test_operator_overloads(self):
+        a = np.random.rand(3).astype(np.float32)
+        x = t(a)
+        np.testing.assert_allclose((x + 1).numpy(), a + 1, rtol=1e-6)
+        np.testing.assert_allclose((2 * x).numpy(), 2 * a, rtol=1e-6)
+        np.testing.assert_allclose((1 - x).numpy(), 1 - a, rtol=1e-6)
+        np.testing.assert_allclose((x / 2).numpy(), a / 2, rtol=1e-6)
+        np.testing.assert_allclose((-x).numpy(), -a, rtol=1e-6)
+        assert ((x > 0.5).numpy() == (a > 0.5)).all()
+
+    def test_unary(self):
+        a = np.random.rand(5).astype(np.float32) + 0.1
+        np.testing.assert_allclose(paddle.exp(t(a)).numpy(), np.exp(a), rtol=1e-6)
+        np.testing.assert_allclose(paddle.log(t(a)).numpy(), np.log(a), rtol=1e-5)
+        np.testing.assert_allclose(paddle.sqrt(t(a)).numpy(), np.sqrt(a), rtol=1e-6)
+        np.testing.assert_allclose(paddle.tanh(t(a)).numpy(), np.tanh(a), rtol=1e-6)
+        np.testing.assert_allclose(paddle.abs(t(-a)).numpy(), a, rtol=1e-6)
+        np.testing.assert_allclose(paddle.floor(t(a * 3)).numpy(), np.floor(a * 3))
+
+    def test_reductions(self):
+        a = np.random.rand(3, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.sum(t(a)).numpy(), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(paddle.mean(t(a), axis=1).numpy(), a.mean(1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.max(t(a), axis=[0, 2]).numpy(), a.max((0, 2)))
+        np.testing.assert_allclose(paddle.min(t(a), keepdim=True).numpy(), a.min(keepdims=True).reshape(1, 1, 1))
+        np.testing.assert_allclose(paddle.prod(t(a), axis=0).numpy(), a.prod(0), rtol=1e-5)
+
+    def test_cumsum_clip(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.cumsum(t(a), axis=1).numpy(), a.cumsum(1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.clip(t(a), 0.2, 0.8).numpy(), a.clip(0.2, 0.8))
+
+    def test_matmul_family(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+        np.testing.assert_allclose(paddle.matmul(t(a), t(b.T), transpose_y=True).numpy(), a @ b, rtol=1e-5)
+        v = np.random.rand(4).astype(np.float32)
+        np.testing.assert_allclose(paddle.mv(t(a), t(v)).numpy(), a @ v, rtol=1e-5)
+        c = np.random.rand(2, 3, 4).astype(np.float32)
+        d = np.random.rand(2, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.bmm(t(c), t(d)).numpy(), c @ d, rtol=1e-5)
+
+    def test_einsum(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.einsum("ij,jk->ik", t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose_flatten(self):
+        a = np.random.rand(2, 3, 4).astype(np.float32)
+        assert paddle.reshape(t(a), [6, 4]).shape == [6, 4]
+        np.testing.assert_allclose(paddle.transpose(t(a), [2, 0, 1]).numpy(), a.transpose(2, 0, 1))
+        assert paddle.flatten(t(a), 1, -1).shape == [2, 12]
+
+    def test_concat_split_stack(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(2, 3).astype(np.float32)
+        np.testing.assert_allclose(paddle.concat([t(a), t(b)], axis=0).numpy(), np.concatenate([a, b], 0))
+        np.testing.assert_allclose(paddle.stack([t(a), t(b)], axis=1).numpy(), np.stack([a, b], 1))
+        parts = paddle.split(t(a), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1]
+        parts = paddle.split(t(a), [1, -1], axis=1)
+        assert parts[1].shape == [2, 2]
+
+    def test_squeeze_unsqueeze_tile_expand(self):
+        a = np.random.rand(1, 3, 1).astype(np.float32)
+        assert paddle.squeeze(t(a)).shape == [3]
+        assert paddle.unsqueeze(t(a.squeeze()), [0, 2]).shape == [1, 3, 1]
+        assert paddle.tile(t(a.squeeze()), [2, 2]).shape == [2, 6]
+        assert paddle.expand(t(np.zeros((1, 3), np.float32)), [4, 3]).shape == [4, 3]
+
+    def test_gather_scatter(self):
+        a = np.random.rand(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        np.testing.assert_allclose(paddle.gather(t(a), t(idx), axis=0).numpy(), a[idx])
+        upd = np.ones((2, 3), np.float32)
+        out = paddle.scatter(t(a), t(np.array([1, 3])), t(upd))
+        expect = a.copy()
+        expect[[1, 3]] = 1.0
+        np.testing.assert_allclose(out.numpy(), expect)
+
+    def test_where_masked(self):
+        a = np.random.rand(3, 3).astype(np.float32)
+        b = np.random.rand(3, 3).astype(np.float32)
+        cond = a > 0.5
+        np.testing.assert_allclose(paddle.where(t(cond), t(a), t(b)).numpy(), np.where(cond, a, b))
+        np.testing.assert_allclose(paddle.masked_select(t(a), t(cond)).numpy(), a[cond])
+
+    def test_flip_roll_unique(self):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_allclose(paddle.flip(t(a), [0]).numpy(), a[::-1])
+        np.testing.assert_allclose(paddle.roll(t(a), 1, 1).numpy(), np.roll(a, 1, 1))
+        u = paddle.unique(t(np.array([3, 1, 2, 1, 3])))
+        np.testing.assert_allclose(u.numpy(), [1, 2, 3])
+
+    def test_indexing(self):
+        a = np.random.rand(4, 5).astype(np.float32)
+        x = t(a)
+        np.testing.assert_allclose(x[1].numpy(), a[1])
+        np.testing.assert_allclose(x[1:3, 2:].numpy(), a[1:3, 2:])
+        x[0] = 0.0
+        assert x.numpy()[0].sum() == 0
+
+
+class TestSearchSortStat:
+    def test_argmax_topk_sort(self):
+        a = np.random.rand(3, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.argmax(t(a), axis=1).numpy(), a.argmax(1))
+        vals, idx = paddle.topk(t(a), 2, axis=1)
+        np.testing.assert_allclose(vals.numpy(), np.sort(a, 1)[:, ::-1][:, :2], rtol=1e-6)
+        np.testing.assert_allclose(paddle.sort(t(a), axis=1).numpy(), np.sort(a, 1))
+        np.testing.assert_allclose(paddle.argsort(t(a), axis=1).numpy(), np.argsort(a, 1, kind="stable"))
+
+    def test_stat(self):
+        a = np.random.rand(10, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.std(t(a)).numpy(), a.std(ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.var(t(a), axis=0).numpy(), a.var(0, ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.median(t(a)).numpy(), np.median(a), rtol=1e-6)
+
+    def test_logic(self):
+        a = np.array([1, 2, 3])
+        b = np.array([1, 0, 3])
+        assert (paddle.equal(t(a), t(b)).numpy() == (a == b)).all()
+        assert bool(paddle.allclose(t(a.astype(np.float32)), t(a.astype(np.float32))))
+        assert bool(paddle.equal_all(t(a), t(a)))
+
+
+class TestLinalg:
+    def test_norm_det_inv(self):
+        a = np.random.rand(3, 3).astype(np.float32) + np.eye(3, dtype=np.float32)
+        np.testing.assert_allclose(paddle.linalg.norm(t(a)).numpy(), np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(paddle.linalg.det(t(a)).numpy(), np.linalg.det(a), rtol=1e-4)
+        np.testing.assert_allclose(paddle.linalg.inv(t(a)).numpy(), np.linalg.inv(a), rtol=1e-4, atol=1e-5)
+
+    def test_svd_qr_cholesky(self):
+        a = np.random.rand(4, 3).astype(np.float32)
+        u, s, v = paddle.linalg.svd(t(a))
+        np.testing.assert_allclose((u.numpy() * s.numpy()) @ v.numpy().T, a, atol=1e-4)
+        q, r = paddle.linalg.qr(t(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-5)
+        spd = a.T @ a + np.eye(3, dtype=np.float32)
+        l = paddle.linalg.cholesky(t(spd))
+        np.testing.assert_allclose(l.numpy() @ l.numpy().T, spd, atol=1e-4)
+
+    def test_solve(self):
+        a = np.random.rand(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+        b = np.random.rand(3, 2).astype(np.float32)
+        np.testing.assert_allclose(paddle.linalg.solve(t(a), t(b)).numpy(), np.linalg.solve(a, b), rtol=1e-4, atol=1e-5)
+
+
+class TestRandom:
+    def test_shapes_and_determinism(self, seed):
+        assert paddle.rand([3, 4]).shape == [3, 4]
+        assert paddle.randn([5]).shape == [5]
+        r = paddle.randint(0, 10, [100])
+        assert (r.numpy() >= 0).all() and (r.numpy() < 10).all()
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
+        paddle.seed(123)
+        a = paddle.rand([4]).numpy()
+        paddle.seed(123)
+        b = paddle.rand([4]).numpy()
+        np.testing.assert_allclose(a, b)
+
+    def test_bernoulli_multinomial(self, seed):
+        p = paddle.bernoulli(t(np.full((1000,), 0.3, np.float32)))
+        assert 0.2 < p.numpy().mean() < 0.4
+        m = paddle.multinomial(t(np.array([0.1, 0.0, 0.9], np.float32)), 50, replacement=True)
+        assert set(m.numpy().tolist()) <= {0, 2}
