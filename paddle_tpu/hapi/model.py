@@ -206,6 +206,18 @@ class Model:
         cbks.on_train_end(logs if "logs" in dir() else None)
         return self
 
+    def _run_eval(self, eval_loader, cbks):
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin({"steps": self._len_or_none(eval_loader)})
+        logs = {}
+        for step, batch in enumerate(eval_loader):
+            inputs, labels = self._split_batch(batch)
+            res = self.eval_batch(inputs, labels)
+            logs = self._make_logs(res)
+        cbks.on_eval_end(logs)
+        return logs
+
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_iters=None):
         """hapi/model.py:1515 parity."""
@@ -300,6 +312,9 @@ class Model:
     def _split_batch(self, batch, predict=False):
         batch = batch if isinstance(batch, (list, tuple)) else [batch]
         if predict:
+            # datasets that yield (x, label): drop the label when a loss was prepared
+            if self._loss is not None and len(batch) > 1:
+                return list(batch[:-1]), []
             return list(batch), []
         if len(batch) == 1:
             return [batch[0]], []

@@ -1,0 +1,185 @@
+"""End-to-end model tests — the 'book' tests pattern
+(fluid/tests/book/test_recognize_digits.py: build + train small models to a
+convergence threshold) + hapi Model tests (python/paddle/tests/test_model.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.models import LeNet
+
+
+def _toy_classification(n=256, d=16, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d, k).astype(np.float32)
+    x = rng.randn(n, d).astype(np.float32)
+    y = (x @ w).argmax(1).astype(np.int64)
+    return x, y
+
+
+class TestEagerTrainingLoop:
+    def test_linear_regression_converges(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(128, 4).astype(np.float32)
+        w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+        y = x @ w_true + 0.1
+        net = nn.Linear(4, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.2, parameters=net.parameters())
+        xs, ys = paddle.to_tensor(x), paddle.to_tensor(y)
+        for _ in range(300):
+            loss = nn.functional.mse_loss(net(xs), ys)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.numpy()) < 1e-3
+
+    def test_mlp_classification_converges(self):
+        x, y = _toy_classification()
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+        opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+        xs = paddle.to_tensor(x)
+        ys = paddle.to_tensor(y)
+        for _ in range(100):
+            loss = nn.functional.cross_entropy(net(xs), ys)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        pred = net(xs).numpy().argmax(1)
+        assert (pred == y).mean() > 0.9
+
+
+class TestModelFit:
+    def _mnist_like(self, n=128):
+        rng = np.random.RandomState(0)
+        imgs = rng.rand(n, 1, 28, 28).astype(np.float32)
+        labels = rng.randint(0, 10, (n, 1)).astype(np.int64)
+        # make it learnable: label leaks into a corner patch
+        for i in range(n):
+            imgs[i, 0, :3, :3] = labels[i, 0] / 10.0
+        return TensorDataset([paddle.to_tensor(imgs), paddle.to_tensor(labels)])
+
+    def test_fit_evaluate_predict(self):
+        ds = self._mnist_like()
+        model = paddle.Model(LeNet())
+        opt = paddle.optimizer.Adam(learning_rate=0.001, parameters=model.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+        model.fit(ds, epochs=2, batch_size=32, verbose=0)
+        res = model.evaluate(ds, batch_size=32, verbose=0)
+        assert "loss" in res and "acc" in res
+        preds = model.predict(ds, batch_size=32, stack_outputs=True, verbose=0)
+        assert preds[0].shape == (128, 10)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        ds = self._mnist_like(32)
+        model = paddle.Model(LeNet())
+        opt = paddle.optimizer.Adam(parameters=model.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        model.fit(ds, epochs=1, batch_size=16, verbose=0)
+        path = str(tmp_path / "ckpt")
+        model.save(path)
+        model2 = paddle.Model(LeNet())
+        opt2 = paddle.optimizer.Adam(parameters=model2.parameters())
+        model2.prepare(opt2, nn.CrossEntropyLoss())
+        model2.load(path)
+        w1 = model.network.features[0].weight.numpy()
+        w2 = model2.network.features[0].weight.numpy()
+        np.testing.assert_allclose(w1, w2)
+
+    def test_callbacks_early_stopping(self):
+        ds = self._mnist_like(32)
+        model = paddle.Model(LeNet())
+        opt = paddle.optimizer.Adam(parameters=model.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        es = paddle.callbacks.EarlyStopping(monitor="loss", patience=0, mode="min")
+        model.fit(ds, eval_data=ds, epochs=3, batch_size=16, verbose=0, callbacks=[es])
+        # ran without error; stop_training toggled at most after patience exceeded
+        assert hasattr(model, "stop_training")
+
+
+class TestToStatic:
+    def test_function_jit(self):
+        calls = []
+
+        @paddle.jit.to_static
+        def f(x):
+            calls.append(1)
+            return x * 2 + 1
+
+        a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        out1 = f(a)
+        out2 = f(a)
+        np.testing.assert_allclose(out1.numpy(), [3.0, 5.0])
+        np.testing.assert_allclose(out2.numpy(), [3.0, 5.0])
+        assert len(calls) == 1  # traced once, cached
+
+    def test_layer_to_static_matches_eager(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32))
+        eager = net(x).numpy()
+        snet = paddle.jit.to_static(net)
+        static = snet(x).numpy()
+        np.testing.assert_allclose(eager, static, rtol=1e-5)
+
+    def test_to_static_retrace_on_shape_change(self):
+        @paddle.jit.to_static
+        def f(x):
+            return x.sum()
+
+        f(paddle.to_tensor(np.zeros((2, 2), np.float32)))
+        out = f(paddle.to_tensor(np.ones((3, 3), np.float32)))
+        np.testing.assert_allclose(float(out.numpy()), 9.0)
+
+
+class TestDataLoader:
+    def test_single_process(self):
+        x = np.arange(20, dtype=np.float32).reshape(10, 2)
+        y = np.arange(10, dtype=np.int64).reshape(10, 1)
+        ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+        loader = DataLoader(ds, batch_size=4, shuffle=False, drop_last=False)
+        batches = list(loader)
+        assert len(batches) == 3
+        assert batches[0][0].shape == [4, 2]
+        assert batches[2][0].shape == [2, 2]
+
+    def test_shuffle_covers_all(self):
+        ds = TensorDataset([paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(16, 1))])
+        loader = DataLoader(ds, batch_size=4, shuffle=True)
+        seen = np.concatenate([b[0].numpy().ravel() for b in loader])
+        assert sorted(seen.tolist()) == list(range(16))
+
+    def test_multiprocess_workers(self):
+        from paddle_tpu.io.dataset import Dataset
+
+        class Sq(Dataset):
+            def __getitem__(self, i):
+                return np.asarray([i * i], dtype=np.float32)
+
+            def __len__(self):
+                return 20
+
+        loader = DataLoader(Sq(), batch_size=5, num_workers=2, shuffle=False)
+        out = np.concatenate([b[0].numpy() if isinstance(b, list) else b.numpy() for b in loader])
+        np.testing.assert_allclose(sorted(out.ravel().tolist()), [i * i for i in range(20)])
+
+    def test_distributed_batch_sampler(self):
+        from paddle_tpu.io import DistributedBatchSampler
+
+        ds = TensorDataset([paddle.to_tensor(np.arange(10, dtype=np.float32).reshape(10, 1))])
+        s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=0)
+        s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert len(i0) == len(i1) == 5
+        assert set(i0) | set(i1) == set(range(10))
+
+
+class TestSaveLoad:
+    def test_paddle_save_load(self, tmp_path):
+        sd = {"w": paddle.to_tensor(np.random.rand(3, 3).astype(np.float32)), "meta": 7}
+        p = str(tmp_path / "m.pdparams")
+        paddle.save(sd, p)
+        back = paddle.load(p)
+        np.testing.assert_allclose(back["w"].numpy(), sd["w"].numpy())
+        assert back["meta"] == 7
