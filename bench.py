@@ -1,0 +1,70 @@
+"""Benchmark: GPT-2 small causal-LM training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: tokens/sec/chip for a full jitted train step (fwd+bwd+AdamW) in bfloat16
+matmuls — the BASELINE.md north-star family (ERNIE/BERT-class tokens/sec/chip).
+vs_baseline: ratio against the reference-class target of 10_000 tokens/sec/device
+(0.6 × a ~16.6k tok/s A100+NCCL BERT-base-class figure — BASELINE.json's ≥60% goal),
+since the reference repo publishes no absolute numbers (BASELINE.md: "published: {}").
+"""
+import json
+import time
+
+import numpy as np
+
+BASELINE_TOKENS_PER_SEC = 10_000.0
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM, GPTPretrainLoss
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    batch, seq = (8, 1024) if on_tpu else (2, 128)
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
+                    max_seq_len=seq, dropout=0.0)
+    if not on_tpu:  # keep the CPU fallback tractable
+        cfg = GPTConfig(vocab_size=8192, hidden_size=256, num_layers=4, num_heads=8,
+                        max_seq_len=seq, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    loss_layer = GPTPretrainLoss()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+    trainer = SpmdTrainer(model, opt, loss_fn=loss_layer, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+
+    with paddle.amp.auto_cast(True, dtype="bfloat16"):
+        # warmup + compile (host-copy forces real completion through the device tunnel)
+        np.asarray(trainer.train_step(ids, labels)._data)
+        n_steps = 20 if on_tpu else 3
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(n_steps):
+            loss = trainer.train_step(ids, labels)
+        # trailing sync: the last loss + a param leaf depend on every prior step
+        np.asarray(loss._data)
+        np.asarray(next(iter(trainer.params.values()))[(0,) * trainer.params[next(iter(trainer.params))].ndim])
+        dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * n_steps / dt
+    print(json.dumps({
+        "metric": "gpt2s_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,145 @@
+"""Long-context sequence/context parallelism: ring attention + Ulysses.
+
+No reference equivalent — SURVEY.md §5 records SP/CP as ABSENT in thisjiang/Paddle
+(sequence length there is scaled only via recompute/pipeline). These are TPU-native
+additions required by the build plan (SURVEY.md §2.3 last row, §7 step 7):
+
+- ring attention: sequence-sharded Q stays resident; K/V blocks rotate around the ICI
+  ring with jax.lax.ppermute while a running (max, sum, acc) online-softmax merges each
+  block — memory O(seq/N), compute overlapped with the rotation.
+- Ulysses: all_to_all swaps the sharded axis from sequence to heads before standard
+  attention and back after — cheap on ICI, needs heads % sp == 0.
+
+Both are pure functions over raw arrays meant to be called inside shard_map bodies
+(axis name 'sp'); `ring_attention`/`ulysses_attention` wrap them for Layer use.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _block_attn(q, k, v, scale, causal_mask=None):
+    """Plain softmax stats for one K/V block: returns (acc, m, l)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal_mask is not None:
+        s = jnp.where(causal_mask, s, -1e30)
+    m = jnp.max(s, axis=-1)  # [b,h,q]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return acc, m, l
+
+
+def ring_attention_spmd(q, k, v, axis_name="sp", causal=False):
+    """Blockwise ring attention inside shard_map.
+
+    q,k,v: [batch, seq_shard, heads, head_dim] (this rank's sequence shard).
+    Rotates K/V around the ring; merges blocks with online softmax.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    b, sq, h, d = q.shape
+
+    def mask_for(block_rank):
+        if not causal:
+            return None
+        # global positions: q at idx*sq + i ; k at block_rank*sq + j
+        qi = idx * sq + jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 0)
+        kj = block_rank * sq + jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 1)
+        return (qi >= kj)[None, None]  # [1,1,q,k]
+
+    def body(i, carry):
+        k_blk, v_blk, acc, m_run, l_run = carry
+        src_rank = (idx - i) % n  # which rank's K/V we now hold
+        blk_acc, m_blk, l_blk = _block_attn(q, k_blk, v_blk, scale, mask_for(src_rank))
+        m_new = jnp.maximum(m_run, m_blk)
+        alpha = jnp.exp(m_run - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        l_new = l_run * alpha + l_blk * beta
+        acc = acc * alpha.transpose(0, 2, 1)[..., None] + blk_acc * beta.transpose(0, 2, 1)[..., None]
+        # rotate K/V to the next rank (ride the ICI ring)
+        perm = [(r, (r + 1) % n) for r in range(n)]
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return k_next, v_next, acc, m_new, l_new
+
+    def _vary(x):
+        # mark carry init as device-varying over the ring axis (shard_map vma typing)
+        try:
+            return jax.lax.pcast(x, (axis_name,), to="varying")
+        except (AttributeError, TypeError):
+            return jax.lax.pvary(x, (axis_name,))
+
+    acc0 = _vary(jnp.zeros((b, sq, h, d), jnp.float32))
+    m0 = _vary(jnp.full((b, h, sq), -1e30, jnp.float32))
+    l0 = _vary(jnp.zeros((b, h, sq), jnp.float32))
+    _, _, acc, m_fin, l_fin = jax.lax.fori_loop(
+        0, n, body, (k.astype(jnp.float32), v.astype(jnp.float32), acc0, m0, l0)
+    )
+    out = acc / l_fin.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention_spmd(q, k, v, axis_name="sp", causal=False):
+    """Ulysses (DeepSpeed-style) attention inside shard_map.
+
+    Input: [batch, seq_shard, heads, head_dim] sequence-sharded.
+    all_to_all -> [batch, seq_full, heads_shard, head_dim], full attention locally,
+    all_to_all back. Needs heads % sp_size == 0.
+    """
+    n = jax.lax.psum(1, axis_name)
+
+    def seq_to_heads(x):
+        # [b, s/n, h, d] -> [b, s, h/n, d]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
+    if causal:
+        sq = s.shape[-2]
+        mask = jnp.tril(jnp.ones((sq, sq), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
+    return heads_to_seq(out).astype(q.dtype)
+
+
+def sequence_parallel_attention(q, k, v, mesh, impl="ring", causal=False, axis_name="sp"):
+    """Convenience wrapper: shard_map over the 'sp' axis of `mesh` on seq dim 1."""
+    from jax.sharding import NamedSharding
+
+    try:
+        from jax import shard_map as _sm
+
+        def smap(f, **kw):
+            return _sm(f, **kw)
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map as _sm
+
+        smap = _sm
+
+    fn = ring_attention_spmd if impl == "ring" else ulysses_attention_spmd
+    spec = P(None, axis_name, None, None)
+    body = functools.partial(fn, axis_name=axis_name, causal=causal)
+    mapped = smap(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return mapped(q, k, v)
+
+
+def full_attention_reference(q, k, v, causal=False):
+    """Unsharded reference for tests."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

@@ -1,0 +1,10 @@
+"""Model zoo: flagship LMs (GPT/BERT) + vision models re-export."""
+from .bert import BertConfig, BertForPretraining, BertModel, BertPretrainLoss, bert_base  # noqa: F401
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTForCausalLM,
+    GPTModel,
+    GPTPretrainLoss,
+    gpt2_medium,
+    gpt2_small,
+)

@@ -1,0 +1,104 @@
+"""BERT/ERNIE-base encoder for MLM pretraining (BASELINE.json config #3: BERT-base
+fleet data-parallel pretraining — the north-star benchmark model).
+
+Built on paddle_tpu.nn.TransformerEncoder (layer/transformer.py parity surface)."""
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12, num_heads=12,
+                 intermediate_size=3072, max_position=512, type_vocab_size=2,
+                 dropout=0.1):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position = max_position
+        self.type_vocab_size = type_vocab_size
+        self.dropout = dropout
+
+    @staticmethod
+    def base():
+        return BertConfig()
+
+    @staticmethod
+    def tiny():
+        return BertConfig(vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
+                          intermediate_size=128, max_position=128, dropout=0.0)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.word = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position = nn.Embedding(cfg.max_position, cfg.hidden_size)
+        self.token_type = nn.Embedding(cfg.type_vocab_size, cfg.hidden_size)
+        self.ln = nn.LayerNorm(cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, input_ids, token_type_ids=None):
+        from ..tensor.creation import arange, zeros_like
+
+        s = input_ids.shape[1]
+        pos = arange(s, dtype="int64")
+        x = self.word(input_ids) + self.position(pos)
+        if token_type_ids is not None:
+            x = x + self.token_type(token_type_ids)
+        return self.drop(self.ln(x))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        enc_layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_heads, cfg.intermediate_size,
+            dropout=cfg.dropout, activation="gelu",
+        )
+        self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        x = self.encoder(x, attention_mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForPretraining(nn.Layer):
+    """MLM head (tied) + NSP head."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.ln = nn.LayerNorm(cfg.hidden_size)
+        self.nsp = nn.Linear(cfg.hidden_size, 2)
+        self.cfg = cfg
+
+    def forward(self, input_ids, token_type_ids=None):
+        seq, pooled = self.bert(input_ids, token_type_ids)
+        h = self.ln(F.gelu(self.transform(seq)))
+        from ..tensor.math import matmul
+
+        mlm_logits = matmul(h, self.bert.embeddings.word.weight, transpose_y=True)
+        nsp_logits = self.nsp(pooled)
+        return mlm_logits, nsp_logits
+
+
+class BertPretrainLoss(nn.Layer):
+    def forward(self, outputs, labels):
+        mlm_logits, _ = outputs if isinstance(outputs, (tuple, list)) else (outputs, None)
+        b, s, v = mlm_logits.shape
+        return F.cross_entropy(
+            mlm_logits.reshape([b * s, v]), labels.reshape([b * s]), ignore_index=-100
+        )
+
+
+def bert_base(**kw):
+    return BertModel(BertConfig.base())

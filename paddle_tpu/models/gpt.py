@@ -1,0 +1,174 @@
+"""GPT-2 style causal LM — the flagship model (BASELINE.json config #4: GPT-2 medium /
+ERNIE-class pretraining).
+
+Built entirely from paddle_tpu.nn; tensor-parallel variants use the distributed.split
+layers so SpmdTrainer shards the matmuls over 'mp'. Attention goes through
+F.scaled_dot_product_attention (Pallas flash kernel on TPU when shapes tile).
+
+Reference parity: the reference trains ERNIE/GPT through fleet on the same Transformer
+building blocks (python/paddle/nn/layer/transformer.py); there is no gpt model file in
+the reference tree — this is the framework's own model zoo.
+"""
+import math
+
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..nn import functional as F
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
+                 max_seq_len=1024, intermediate_size=None, dropout=0.1,
+                 tensor_parallel=False, use_flash=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.max_seq_len = max_seq_len
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.dropout = dropout
+        self.tensor_parallel = tensor_parallel
+        self.use_flash = use_flash
+
+    @staticmethod
+    def small():
+        return GPTConfig(hidden_size=768, num_layers=12, num_heads=12)
+
+    @staticmethod
+    def medium():
+        return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16)
+
+    @staticmethod
+    def tiny():  # tests / dryrun
+        return GPTConfig(vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
+                         max_seq_len=128, dropout=0.0)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        h = cfg.hidden_size
+        self.num_heads = cfg.num_heads
+        self.head_dim = h // cfg.num_heads
+        if cfg.tensor_parallel:
+            from ..distributed.split import ColumnParallelLinear, RowParallelLinear
+
+            self.qkv = ColumnParallelLinear(h, 3 * h)
+            self.proj = RowParallelLinear(h, h)
+        else:
+            self.qkv = nn.Linear(h, 3 * h)
+            self.proj = nn.Linear(h, h)
+        self.dropout = cfg.dropout
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        from ..tensor.manipulation import split as tsplit
+
+        q, k, v = tsplit(qkv, 3, axis=2)
+        q = q.reshape([b, s, self.num_heads, self.head_dim])
+        k = k.reshape([b, s, self.num_heads, self.head_dim])
+        v = v.reshape([b, s, self.num_heads, self.head_dim])
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.dropout if self.training else 0.0, training=self.training,
+        )
+        return self.proj(out.reshape([b, s, h]))
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        h, i = cfg.hidden_size, cfg.intermediate_size
+        if cfg.tensor_parallel:
+            from ..distributed.split import ColumnParallelLinear, RowParallelLinear
+
+            self.fc1 = ColumnParallelLinear(h, i)
+            self.fc2 = RowParallelLinear(i, h)
+        else:
+            self.fc1 = nn.Linear(h, i)
+            self.fc2 = nn.Linear(i, h)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x)))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden_size)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size)
+        self.mlp = GPTMLP(cfg)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        x = x + self.drop(self.attn(self.ln1(x)))
+        x = x + self.drop(self.mlp(self.ln2(x)))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        if cfg.tensor_parallel:
+            from ..distributed.split import VocabParallelEmbedding
+
+            self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        else:
+            self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        import jax.numpy as jnp
+
+        from ..tensor.creation import arange
+
+        pos = arange(s, dtype="int64")
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    """LM head ties to wte (weight sharing, paddle GPT convention)."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.gpt = GPTModel(cfg)
+        self.cfg = cfg
+
+    def forward(self, input_ids):
+        h = self.gpt(input_ids)
+        # tied head: logits = h @ wte^T
+        from ..tensor.math import matmul
+
+        return matmul(h, self.gpt.wte.weight, transpose_y=True)
+
+    def loss(self, input_ids, labels):
+        logits = self.forward(input_ids)
+        b, s, v = logits.shape
+        return F.cross_entropy(logits.reshape([b * s, v]), labels.reshape([b * s]))
+
+
+class GPTPretrainLoss(nn.Layer):
+    def forward(self, logits, labels):
+        b, s, v = logits.shape
+        return F.cross_entropy(logits.reshape([b * s, v]), labels.reshape([b * s]))
+
+
+def gpt2_small(**kw):
+    return GPTForCausalLM(GPTConfig.small())
+
+
+def gpt2_medium(**kw):
+    return GPTForCausalLM(GPTConfig.medium())
