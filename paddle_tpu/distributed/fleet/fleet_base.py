@@ -99,34 +99,14 @@ class Fleet:
         """Compose the meta-optimizer stack into one SpmdTrainer (TPU-native
         equivalent of fleet.minimize graph rewriting)."""
         from ..spmd import SpmdTrainer
+        from .meta_optimizers import apply_meta_optimizers
 
         s = self._strategy
         optimizer = optimizer or self._user_defined_optimizer
+        if hasattr(optimizer, "_inner"):  # unwrap FleetOptimizer
+            optimizer = optimizer._inner
         kw = dict(sharding_stage=0, recompute=False, accumulate_steps=1)
-        if s.sharding:
-            kw["sharding_stage"] = s.sharding_configs.sharding_stage
-            if s.sharding_configs.gradient_merge_acc_step > 1:
-                kw["accumulate_steps"] = s.sharding_configs.gradient_merge_acc_step
-        if s.recompute:
-            kw["recompute"] = True
-        if s.gradient_merge:
-            kw["accumulate_steps"] = max(kw["accumulate_steps"], s.gradient_merge_configs.k_steps)
-        if s.pipeline:
-            kw["accumulate_steps"] = max(kw["accumulate_steps"], s.pipeline_configs.accumulate_steps)
-        if s.lamb and not isinstance(optimizer, opt_mod.Lamb):
-            optimizer = opt_mod.Lamb(
-                learning_rate=optimizer._lr,
-                lamb_weight_decay=s.lamb_configs.lamb_weight_decay,
-                parameters=optimizer._parameters,
-            )
-        if s.lars and not isinstance(optimizer, opt_mod.Lars):
-            optimizer = opt_mod.Lars(
-                learning_rate=optimizer._lr,
-                momentum=getattr(optimizer, "_momentum", 0.9),
-                lars_coeff=s.lars_configs.lars_coeff,
-                lars_weight_decay=s.lars_configs.lars_weight_decay,
-                parameters=optimizer._parameters,
-            )
+        kw, optimizer = apply_meta_optimizers(kw, optimizer, s)
         kw.update(overrides)
         return SpmdTrainer(layer, optimizer, loss_fn, mesh=get_mesh(), **kw)
 

@@ -1,0 +1,39 @@
+"""Meta-optimizer stack.
+
+Reference parity: python/paddle/distributed/fleet/meta_optimizers/ — each file rewrote
+the Program (insert ops / split blocks); here each meta-optimizer is a *functional
+transformer*: it takes (trainer_kwargs, optimizer, strategy) and returns updated ones.
+fleet.build_trainer composes them in the reference's strategy-compiler order
+(fleet/base/strategy_compiler.py).
+"""
+from .amp_optimizer import AMPOptimizer  # noqa: F401
+from .dgc_optimizer import DGCMomentumOptimizer, DGCOptimizer  # noqa: F401
+from .gradient_merge_optimizer import GradientMergeOptimizer  # noqa: F401
+from .lamb_optimizer import LambOptimizer  # noqa: F401
+from .lars_optimizer import LarsOptimizer  # noqa: F401
+from .localsgd_optimizer import AdaptiveLocalSGDOptimizer, LocalSGDOptimizer  # noqa: F401
+from .pipeline_optimizer import PipelineOptimizer  # noqa: F401
+from .recompute_optimizer import RecomputeOptimizer  # noqa: F401
+from .sharding_optimizer import ShardingOptimizer  # noqa: F401
+
+META_OPTIMIZER_ORDER = [
+    # strategy_compiler order: amp/recompute wrap compute; sharding/pipeline shape the
+    # mesh; gradient-merge/localsgd/dgc shape the update; lamb/lars swap the rule
+    AMPOptimizer,
+    RecomputeOptimizer,
+    ShardingOptimizer,
+    PipelineOptimizer,
+    GradientMergeOptimizer,
+    LocalSGDOptimizer,
+    DGCOptimizer,
+    LambOptimizer,
+    LarsOptimizer,
+]
+
+
+def apply_meta_optimizers(trainer_kwargs, optimizer, strategy):
+    for cls in META_OPTIMIZER_ORDER:
+        mo = cls()
+        if mo.can_apply(strategy):
+            trainer_kwargs, optimizer = mo.apply(trainer_kwargs, optimizer, strategy)
+    return trainer_kwargs, optimizer

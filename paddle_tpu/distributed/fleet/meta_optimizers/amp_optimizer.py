@@ -1,0 +1,17 @@
+"""AMP meta-optimizer (fleet/meta_optimizers/amp_optimizer.py parity).
+On TPU: bf16 autocast needs no loss scaling; fp16 installs a scaled loss wrapper."""
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class AMPOptimizer(MetaOptimizerBase):
+    def can_apply(self, strategy):
+        return strategy.amp
+
+    def apply(self, trainer_kwargs, optimizer, strategy):
+        cfg = strategy.amp_configs
+        trainer_kwargs["amp_dtype"] = "float16" if cfg.use_pure_fp16 else cfg.dtype
+        trainer_kwargs["amp_custom_white"] = list(cfg.custom_white_list)
+        trainer_kwargs["amp_custom_black"] = list(cfg.custom_black_list)
+        if cfg.dtype == "float16" or cfg.use_pure_fp16:
+            trainer_kwargs["loss_scaling"] = cfg.init_loss_scaling
+        return trainer_kwargs, optimizer

@@ -1,0 +1,15 @@
+"""Gradient merge meta-optimizer (fleet/meta_optimizers/gradient_merge_optimizer.py
+parity) — k-step micro-batch accumulation via the trainer's lax.scan."""
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class GradientMergeOptimizer(MetaOptimizerBase):
+    def can_apply(self, strategy):
+        return strategy.gradient_merge
+
+    def apply(self, trainer_kwargs, optimizer, strategy):
+        cfg = strategy.gradient_merge_configs
+        trainer_kwargs["accumulate_steps"] = max(
+            trainer_kwargs.get("accumulate_steps", 1), cfg.k_steps)
+        trainer_kwargs["grad_merge_avg"] = cfg.avg
+        return trainer_kwargs, optimizer

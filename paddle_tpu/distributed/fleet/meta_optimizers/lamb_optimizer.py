@@ -1,0 +1,21 @@
+"""LAMB meta-optimizer (fleet/meta_optimizers/lamb_optimizer.py parity)."""
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class LambOptimizer(MetaOptimizerBase):
+    def can_apply(self, strategy):
+        return strategy.lamb
+
+    def apply(self, trainer_kwargs, optimizer, strategy):
+        from .... import optimizer as opt_mod
+
+        if not isinstance(optimizer, opt_mod.Lamb):
+            cfg = strategy.lamb_configs
+            ex = set(cfg.exclude_from_weight_decay)
+            optimizer = opt_mod.Lamb(
+                learning_rate=optimizer._lr,
+                lamb_weight_decay=cfg.lamb_weight_decay,
+                parameters=optimizer._parameters,
+                exclude_from_weight_decay_fn=(lambda p: p.name in ex) if ex else None,
+            )
+        return trainer_kwargs, optimizer

@@ -1,0 +1,22 @@
+"""LARS meta-optimizer (fleet/meta_optimizers/lars_optimizer.py parity)."""
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class LarsOptimizer(MetaOptimizerBase):
+    def can_apply(self, strategy):
+        return strategy.lars
+
+    def apply(self, trainer_kwargs, optimizer, strategy):
+        from .... import optimizer as opt_mod
+
+        if not isinstance(optimizer, opt_mod.Lars):
+            cfg = strategy.lars_configs
+            optimizer = opt_mod.Lars(
+                learning_rate=optimizer._lr,
+                momentum=getattr(optimizer, "_momentum", 0.9),
+                lars_coeff=cfg.lars_coeff,
+                lars_weight_decay=cfg.lars_weight_decay,
+                epsilon=cfg.epsilon,
+                parameters=optimizer._parameters,
+            )
+        return trainer_kwargs, optimizer

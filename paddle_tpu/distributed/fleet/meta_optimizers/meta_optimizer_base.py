@@ -1,0 +1,10 @@
+"""Base meta-optimizer (fleet/meta_optimizers/meta_optimizer_base.py parity)."""
+
+
+class MetaOptimizerBase:
+    def can_apply(self, strategy):
+        raise NotImplementedError
+
+    def apply(self, trainer_kwargs, optimizer, strategy):
+        """Return (updated trainer_kwargs, updated optimizer)."""
+        return trainer_kwargs, optimizer

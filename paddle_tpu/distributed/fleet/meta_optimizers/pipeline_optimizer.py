@@ -1,0 +1,17 @@
+"""Pipeline meta-optimizer (fleet/meta_optimizers/pipeline_optimizer.py:25 parity).
+Sets micro-batch accumulation; stage placement is the Pipeline class
+(distributed/pipeline.py) — 1F1B scheduling is the shard_map tick loop."""
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class PipelineOptimizer(MetaOptimizerBase):
+    def can_apply(self, strategy):
+        return strategy.pipeline
+
+    def apply(self, trainer_kwargs, optimizer, strategy):
+        cfg = strategy.pipeline_configs
+        trainer_kwargs["accumulate_steps"] = max(
+            trainer_kwargs.get("accumulate_steps", 1), cfg.accumulate_steps)
+        trainer_kwargs["pp_degree"] = cfg.pp_degree
+        trainer_kwargs["schedule_mode"] = cfg.schedule_mode
+        return trainer_kwargs, optimizer

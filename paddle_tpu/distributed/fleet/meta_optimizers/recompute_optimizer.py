@@ -1,0 +1,14 @@
+"""Recompute meta-optimizer (fleet/meta_optimizers/recompute_optimizer.py parity);
+activation checkpointing = jax.checkpoint on the forward (backward.py:725 analog)."""
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class RecomputeOptimizer(MetaOptimizerBase):
+    def can_apply(self, strategy):
+        return strategy.recompute
+
+    def apply(self, trainer_kwargs, optimizer, strategy):
+        trainer_kwargs["recompute"] = True
+        if strategy.recompute_configs.enable_offload:
+            trainer_kwargs["remat_offload"] = True  # jax.checkpoint offload policy
+        return trainer_kwargs, optimizer

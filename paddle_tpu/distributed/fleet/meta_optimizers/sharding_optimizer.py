@@ -1,0 +1,19 @@
+"""Sharding (ZeRO) meta-optimizer (fleet/meta_optimizers/sharding_optimizer.py:33
+parity). The reference's _split_program/_prune_main_program/_add_broadcast_allreduce
+(sharding_optimizer.py:161,224,308) become NamedSharding assignments in SpmdTrainer."""
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class ShardingOptimizer(MetaOptimizerBase):
+    def can_apply(self, strategy):
+        return strategy.sharding
+
+    def apply(self, trainer_kwargs, optimizer, strategy):
+        cfg = strategy.sharding_configs
+        trainer_kwargs["sharding_stage"] = cfg.sharding_stage
+        if cfg.gradient_merge_acc_step > 1:
+            trainer_kwargs["accumulate_steps"] = max(
+                trainer_kwargs.get("accumulate_steps", 1), cfg.gradient_merge_acc_step)
+        if cfg.offload:
+            trainer_kwargs["state_offload"] = True  # optimizer state on host memory
+        return trainer_kwargs, optimizer

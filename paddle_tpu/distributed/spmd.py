@@ -75,7 +75,8 @@ class SpmdTrainer:
 
     def __init__(self, layer, optimizer, loss_fn=None, mesh=None, dp_axis="dp",
                  sharding_stage=0, recompute=False, accumulate_steps=1,
-                 extra_param_specs=None, metrics_fn=None, donate=True):
+                 extra_param_specs=None, metrics_fn=None, donate=True,
+                 amp_dtype=None, **extra_kwargs):
         self.layer = layer
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -85,6 +86,8 @@ class SpmdTrainer:
         self.recompute = recompute
         self.accumulate_steps = accumulate_steps
         self.extra_param_specs = extra_param_specs or {}
+        self.amp_dtype = amp_dtype
+        self.extra_kwargs = extra_kwargs  # meta-optimizer hints not yet consumed
         self._compiled = None
         self.params = {n: p._data for n, p in layer.named_parameters() if getattr(p, "trainable", True)}
         self.frozen = {n: p._data for n, p in layer.named_parameters() if not getattr(p, "trainable", True)}
@@ -122,6 +125,13 @@ class SpmdTrainer:
         named_p = dict(layer.named_parameters())
         named_b = dict(layer.named_buffers())
         saved = {n: t._data for n, t in {**named_p, **named_b}.items()}
+        import contextlib
+
+        amp_ctx = contextlib.nullcontext()
+        if self.amp_dtype is not None:
+            from ..amp.auto_cast import auto_cast
+
+            amp_ctx = auto_cast(True, dtype=self.amp_dtype)
         try:
             for n, v in params.items():
                 named_p[n]._data = v
@@ -129,7 +139,7 @@ class SpmdTrainer:
                 named_p[n]._data = v
             for n, v in buffers.items():
                 named_b[n]._data = v
-            with tape.pause():
+            with tape.pause(), amp_ctx:
                 inputs = [Tensor(b) for b in batch[:-1]]
                 label = Tensor(batch[-1])
                 if self.loss_fn is not None:

@@ -1,0 +1,2 @@
+"""paddle.incubate parity: auto-checkpoint, (later) sparse utils."""
+from . import checkpoint  # noqa: F401

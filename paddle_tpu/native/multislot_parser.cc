@@ -1,0 +1,262 @@
+// MultiSlot data-feed parser — native C++ core of the dataset pipeline.
+//
+// Reference parity: paddle/fluid/framework/data_feed.cc MultiSlotDataFeed /
+// MultiSlotInMemoryDataFeed (data_feed.h:682) — parses the MultiSlot text format
+//     <num_1> v v ... <num_2> v v ...        (one group per slot, per line)
+// into per-slot ragged buffers, and data_set.cc Dataset's in-memory shuffle.
+//
+// TPU-native design: the parser fills contiguous host buffers (values + per-instance
+// lengths) that Python turns into padded numpy batches for device_put — no LoDTensor;
+// LoD lives only at this boundary (SURVEY.md "hard parts" #2). Multithreaded file
+// parsing mirrors the reference's per-thread DataFeed channels.
+//
+// extern "C" API (ctypes-consumed; no pybind11 in the image):
+//   msp_create(slot_types, n_slots)            -> handle
+//   msp_parse_file(h, path, n_threads)         -> n_instances (appends)
+//   msp_parse_buffer(h, data, len)             -> n_instances
+//   msp_shuffle(h, seed)
+//   msp_num_instances(h)
+//   msp_slot_total_values(h, slot)             -> total value count for slot
+//   msp_copy_slot(h, slot, float*|int64* out_vals, int64* out_lens)
+//   msp_clear(h) / msp_destroy(h)
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <mutex>
+#include <random>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct SlotData {
+  // ragged: values_f or values_i + per-instance value counts
+  std::vector<float> values_f;
+  std::vector<int64_t> values_i;
+  std::vector<int64_t> lengths;
+  bool is_float = true;
+};
+
+struct Instance {
+  // parsed single line: per-slot values
+  std::vector<std::vector<float>> f;
+  std::vector<std::vector<int64_t>> i;
+};
+
+struct Parser {
+  std::vector<int> slot_types;  // 0 = float, 1 = int64
+  std::vector<SlotData> slots;
+  int64_t n_instances = 0;
+  std::mutex mu;
+
+  explicit Parser(const int* types, int n) : slot_types(types, types + n), slots(n) {
+    for (int s = 0; s < n; ++s) slots[s].is_float = (slot_types[s] == 0);
+  }
+};
+
+bool parse_line(const char* line, size_t len, const std::vector<int>& types,
+                Instance* out) {
+  const char* p = line;
+  const char* end = line + len;
+  out->f.assign(types.size(), {});
+  out->i.assign(types.size(), {});
+  for (size_t s = 0; s < types.size(); ++s) {
+    char* next = nullptr;
+    long n = strtol(p, &next, 10);
+    if (next == p || n < 0) return false;
+    p = next;
+    if (types[s] == 0) {
+      auto& v = out->f[s];
+      v.reserve(n);
+      for (long k = 0; k < n; ++k) {
+        float x = strtof(p, &next);
+        if (next == p) return false;
+        v.push_back(x);
+        p = next;
+      }
+    } else {
+      auto& v = out->i[s];
+      v.reserve(n);
+      for (long k = 0; k < n; ++k) {
+        long long x = strtoll(p, &next, 10);
+        if (next == p) return false;
+        v.push_back((int64_t)x);
+        p = next;
+      }
+    }
+    if (p > end) return false;
+  }
+  return true;
+}
+
+void append_instances(Parser* h, std::vector<Instance>& batch) {
+  std::lock_guard<std::mutex> lock(h->mu);
+  for (auto& inst : batch) {
+    for (size_t s = 0; s < h->slots.size(); ++s) {
+      auto& slot = h->slots[s];
+      if (slot.is_float) {
+        slot.values_f.insert(slot.values_f.end(), inst.f[s].begin(), inst.f[s].end());
+        slot.lengths.push_back((int64_t)inst.f[s].size());
+      } else {
+        slot.values_i.insert(slot.values_i.end(), inst.i[s].begin(), inst.i[s].end());
+        slot.lengths.push_back((int64_t)inst.i[s].size());
+      }
+    }
+    h->n_instances++;
+  }
+  batch.clear();
+}
+
+int64_t parse_chunk(Parser* h, const std::vector<std::string>& lines, size_t begin,
+                    size_t endi) {
+  std::vector<Instance> local;
+  local.reserve(endi - begin);
+  Instance inst;
+  int64_t ok = 0;
+  for (size_t idx = begin; idx < endi; ++idx) {
+    if (lines[idx].empty()) continue;
+    if (parse_line(lines[idx].c_str(), lines[idx].size(), h->slot_types, &inst)) {
+      local.push_back(std::move(inst));
+      inst = Instance();
+      ok++;
+    }
+  }
+  append_instances(h, local);
+  return ok;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* msp_create(const int* slot_types, int n_slots) {
+  return new Parser(slot_types, n_slots);
+}
+
+void msp_destroy(void* handle) { delete static_cast<Parser*>(handle); }
+
+void msp_clear(void* handle) {
+  auto* h = static_cast<Parser*>(handle);
+  std::lock_guard<std::mutex> lock(h->mu);
+  for (auto& s : h->slots) {
+    s.values_f.clear();
+    s.values_i.clear();
+    s.lengths.clear();
+  }
+  h->n_instances = 0;
+}
+
+int64_t msp_parse_file(void* handle, const char* path, int n_threads) {
+  auto* h = static_cast<Parser*>(handle);
+  std::ifstream in(path);
+  if (!in.good()) return -1;
+  std::vector<std::string> lines;
+  std::string line;
+  while (std::getline(in, line)) lines.push_back(std::move(line));
+  if (n_threads <= 1 || lines.size() < 1024) {
+    return parse_chunk(h, lines, 0, lines.size());
+  }
+  std::vector<std::thread> threads;
+  std::atomic<int64_t> total{0};
+  size_t per = (lines.size() + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    size_t b = t * per;
+    size_t e = std::min(lines.size(), b + per);
+    if (b >= e) break;
+    threads.emplace_back([&, b, e]() { total += parse_chunk(h, lines, b, e); });
+  }
+  for (auto& th : threads) th.join();
+  return total.load();
+}
+
+int64_t msp_parse_buffer(void* handle, const char* data, int64_t len) {
+  auto* h = static_cast<Parser*>(handle);
+  std::vector<std::string> lines;
+  const char* p = data;
+  const char* end = data + len;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    if (!nl) nl = end;
+    lines.emplace_back(p, nl - p);
+    p = nl + 1;
+  }
+  return parse_chunk(h, lines, 0, lines.size());
+}
+
+int64_t msp_num_instances(void* handle) {
+  return static_cast<Parser*>(handle)->n_instances;
+}
+
+int64_t msp_slot_total_values(void* handle, int slot) {
+  auto* h = static_cast<Parser*>(handle);
+  auto& s = h->slots[slot];
+  return s.is_float ? (int64_t)s.values_f.size() : (int64_t)s.values_i.size();
+}
+
+// copy slot data out: vals must hold slot_total_values, lens must hold n_instances
+void msp_copy_slot_f(void* handle, int slot, float* vals, int64_t* lens) {
+  auto* h = static_cast<Parser*>(handle);
+  auto& s = h->slots[slot];
+  memcpy(vals, s.values_f.data(), s.values_f.size() * sizeof(float));
+  memcpy(lens, s.lengths.data(), s.lengths.size() * sizeof(int64_t));
+}
+
+void msp_copy_slot_i(void* handle, int slot, int64_t* vals, int64_t* lens) {
+  auto* h = static_cast<Parser*>(handle);
+  auto& s = h->slots[slot];
+  memcpy(vals, s.values_i.data(), s.values_i.size() * sizeof(int64_t));
+  memcpy(lens, s.lengths.data(), s.lengths.size() * sizeof(int64_t));
+}
+
+// Fisher-Yates over instance order, applied consistently to every slot
+// (data_set.cc LocalShuffle parity).
+void msp_shuffle(void* handle, uint64_t seed) {
+  auto* h = static_cast<Parser*>(handle);
+  std::lock_guard<std::mutex> lock(h->mu);
+  int64_t n = h->n_instances;
+  if (n <= 1) return;
+  std::mt19937_64 rng(seed);
+  std::vector<int64_t> perm(n);
+  for (int64_t i = 0; i < n; ++i) perm[i] = i;
+  for (int64_t i = n - 1; i > 0; --i) {
+    std::uniform_int_distribution<int64_t> dist(0, i);
+    std::swap(perm[i], perm[dist(rng)]);
+  }
+  for (auto& s : h->slots) {
+    // offsets of each instance in the value stream
+    std::vector<int64_t> offs(n + 1, 0);
+    for (int64_t i = 0; i < n; ++i) offs[i + 1] = offs[i] + s.lengths[i];
+    std::vector<int64_t> new_lens(n);
+    if (s.is_float) {
+      std::vector<float> nv(s.values_f.size());
+      int64_t w = 0;
+      for (int64_t i = 0; i < n; ++i) {
+        int64_t src = perm[i];
+        new_lens[i] = s.lengths[src];
+        memcpy(nv.data() + w, s.values_f.data() + offs[src],
+               s.lengths[src] * sizeof(float));
+        w += s.lengths[src];
+      }
+      s.values_f.swap(nv);
+    } else {
+      std::vector<int64_t> nv(s.values_i.size());
+      int64_t w = 0;
+      for (int64_t i = 0; i < n; ++i) {
+        int64_t src = perm[i];
+        new_lens[i] = s.lengths[src];
+        memcpy(nv.data() + w, s.values_i.data() + offs[src],
+               s.lengths[src] * sizeof(int64_t));
+        w += s.lengths[src];
+      }
+      s.values_i.swap(nv);
+    }
+    s.lengths.swap(new_lens);
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,105 @@
+"""Detection op tests vs numpy references (operators/detection/ op-test pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+def ref_nms(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        xx1 = np.maximum(boxes[i, 0], boxes[order[1:], 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[order[1:], 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[order[1:], 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[order[1:], 3])
+        w = np.maximum(0.0, xx2 - xx1)
+        h = np.maximum(0.0, yy2 - yy1)
+        inter = w * h
+        a_i = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        a_o = (boxes[order[1:], 2] - boxes[order[1:], 0]) * (boxes[order[1:], 3] - boxes[order[1:], 1])
+        iou = inter / (a_i + a_o - inter + 1e-9)
+        order = order[1:][iou <= thresh]
+    return sorted(keep)
+
+
+class TestNMS:
+    def test_matches_reference(self):
+        rng = np.random.RandomState(0)
+        xy = rng.rand(50, 2) * 100
+        wh = rng.rand(50, 2) * 30 + 5
+        boxes = np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+        scores = rng.rand(50).astype(np.float32)
+        kept = vops.nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores))
+        expect = ref_nms(boxes, scores, 0.5)
+        assert sorted(kept.numpy().tolist()) == expect
+
+    def test_categories(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [0, 0, 10, 10]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        cats = np.array([0, 0, 1])
+        kept = vops.nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores),
+                        paddle.to_tensor(cats), categories=[0, 1])
+        # box 1 suppressed by box 0 (same class); box 2 kept (different class)
+        assert sorted(kept.numpy().tolist()) == [0, 2]
+
+    def test_multiclass_nms_shapes(self):
+        rng = np.random.RandomState(1)
+        bboxes = rng.rand(2, 20, 4).astype(np.float32) * 50
+        bboxes[..., 2:] += bboxes[..., :2]
+        scores = rng.rand(2, 3, 20).astype(np.float32)
+        out, valid = vops.multiclass_nms(paddle.to_tensor(bboxes), paddle.to_tensor(scores),
+                                         keep_top_k=10, background_label=-1)
+        assert out.shape == [2, 10, 6]
+        assert (valid.numpy() >= 0).all()
+
+
+class TestYoloBox:
+    def test_shapes_and_ranges(self):
+        N, an, C, H, W = 2, 3, 5, 4, 4
+        x = np.random.RandomState(0).randn(N, an * (5 + C), H, W).astype(np.float32)
+        img = np.array([[320, 320], [416, 416]], np.int32)
+        boxes, scores = vops.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                                      anchors=[10, 13, 16, 30, 33, 23], class_num=C)
+        assert boxes.shape == [N, an * H * W, 4]
+        assert scores.shape == [N, an * H * W, C]
+        b = boxes.numpy()
+        assert (b[0, :, 0] <= 320).all() and (b[0] >= 0).all()
+
+
+class TestRoiAlign:
+    def test_constant_map(self):
+        feat = np.full((1, 2, 8, 8), 3.0, np.float32)
+        rois = np.array([[0, 0, 4, 4], [2, 2, 6, 6]], np.float32)
+        out = vops.roi_align(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                             paddle.to_tensor(np.array([2])), output_size=2, aligned=True)
+        assert out.shape == [2, 2, 2, 2]
+        np.testing.assert_allclose(out.numpy(), np.full((2, 2, 2, 2), 3.0), rtol=1e-5)
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.RandomState(0)
+        priors = rng.rand(10, 4).astype(np.float32)
+        priors[:, 2:] = priors[:, :2] + rng.rand(10, 2).astype(np.float32) + 0.2
+        var = np.full((10, 4), 0.1, np.float32)
+        targets = priors + 0.05
+        enc = vops.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                             paddle.to_tensor(targets), code_type="encode_center_size")
+        dec = vops.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                             enc, code_type="decode_center_size")
+        np.testing.assert_allclose(dec.numpy(), targets, atol=1e-4)
+
+
+class TestPriorBox:
+    def test_shapes(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+        boxes, var = vops.prior_box(feat, img, min_sizes=[16.0], aspect_ratios=[1.0, 2.0], flip=True)
+        assert boxes.shape[0] == 4 and boxes.shape[1] == 4
+        assert boxes.shape == var.shape
