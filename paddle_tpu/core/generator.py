@@ -18,12 +18,12 @@ class Generator:
         if seed is None:
             seed = np.uint32(int(time.time() * 1e6) & 0xFFFFFFFF)
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        self._key = None  # lazy: creating a key initializes the jax backend
         self._offset = 0
 
     def manual_seed(self, seed):
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        self._key = None
         self._offset = 0
         return self
 
@@ -35,6 +35,8 @@ class Generator:
 
     def split(self):
         """Return a fresh subkey, advancing internal state."""
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
         self._key, sub = jax.random.split(self._key)
         self._offset += 1
         return sub
