@@ -62,6 +62,8 @@ from . import static  # noqa: E402
 from . import distributed  # noqa: E402
 from . import linalg  # noqa: E402
 from . import fft  # noqa: E402
+from . import distribution  # noqa: E402
+from . import onnx  # noqa: E402
 from . import profiler as profiler  # noqa: E402
 from . import utils  # noqa: E402
 from .autograd import grad  # noqa: E402
@@ -76,4 +78,4 @@ DataParallel = distributed.DataParallel
 disable_static = static.disable_static
 enable_static = static.enable_static
 in_dynamic_mode = static.in_dynamic_mode
-flops = None  # filled by hapi import when available
+from .hapi.model import flops  # noqa: E402

@@ -127,3 +127,55 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=Tr
 
 def npu_identity(x, op_flag=0):
     return _t(x)
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """Pad a list of variable-length sequences (list of Tensors / arrays) into a
+    dense [batch, maxlen, ...] tensor + a length vector. TPU-native stance on
+    LoDTensor (reference framework/lod_tensor.h:114, operators/sequence_ops/
+    sequence_pad_op.cc): ragged sequences live only at the data boundary; inside
+    the framework everything is dense + mask."""
+
+    seqs = [np.asarray(s._data if hasattr(s, "_data") else s) for s in x]
+    lens = np.array([s.shape[0] for s in seqs], dtype=np.int64)
+    ml = int(maxlen) if maxlen is not None else int(lens.max())
+    pv = np.asarray(pad_value._data if hasattr(pad_value, "_data") else pad_value)
+    trailing = seqs[0].shape[1:]
+    out = np.full((len(seqs), ml) + trailing, pv, dtype=seqs[0].dtype)
+    for i, s in enumerate(seqs):
+        n = min(s.shape[0], ml)
+        out[i, :n] = s[:n]
+    from ...core.tensor import Tensor
+
+    return Tensor(out), Tensor(np.minimum(lens, ml))
+
+
+def sequence_unpad(x, length, name=None):
+    """Inverse of sequence_pad: dense [batch, maxlen, ...] -> list of Tensors."""
+    from ...core.tensor import Tensor
+
+    data = np.asarray(x._data if hasattr(x, "_data") else x)
+    lens = np.asarray(length._data if hasattr(length, "_data") else length)
+    return [Tensor(data[i, : int(lens[i])]) for i in range(data.shape[0])]
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference operators/gather_tree_op.cc): walk parent
+    pointers from the last step to recover full beams. Shapes [max_time, batch, beam]."""
+
+    def fn(idv, parv):
+        max_time = idv.shape[0]
+
+        def step(parent, t):
+            tt = max_time - 1 - t
+            row = jnp.take_along_axis(idv[tt], parent, axis=-1)
+            nxt = jnp.take_along_axis(parv[tt], parent, axis=-1)
+            return nxt, row
+
+        init_parent = jnp.broadcast_to(
+            jnp.arange(idv.shape[2], dtype=idv.dtype), idv.shape[1:]
+        )
+        _, rows = jax.lax.scan(step, init_parent, jnp.arange(max_time))
+        return rows[::-1]
+
+    return apply(fn, _t(ids), _t(parents))

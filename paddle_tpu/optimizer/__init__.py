@@ -16,3 +16,8 @@ from .optimizer import (  # noqa: F401
     RMSProp,
     SGD,
 )
+from .extras import (  # noqa: F401
+    ExponentialMovingAverage,
+    LookAhead,
+    ModelAverage,
+)

@@ -1,0 +1,155 @@
+"""Averaging/wrapping optimizers: ExponentialMovingAverage, ModelAverage, LookAhead.
+
+Reference parity: python/paddle/fluid/optimizer.py (ModelAverage:3157,
+ExponentialMovingAverage:3466) and the LookAhead optimizer from
+python/paddle/fluid/incubate (SURVEY.md §Appendix A optimizer extras). TPU-native
+design: these keep shadow copies of parameters as host-resident jnp arrays and
+swap them in/out of the live Layer parameters — no graph rewriting needed, since
+eager Tensors rebind `_data` functionally.
+"""
+import contextlib
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class ExponentialMovingAverage:
+    """shadow = decay * shadow + (1 - decay) * param, with bias correction
+    matching fluid/optimizer.py:3466 (thres_steps-free form)."""
+
+    def __init__(self, parameters, decay=0.999, name=None):
+        self._decay = float(decay)
+        self._parameters = list(parameters)
+        # shadow starts at 0 so the (1 - decay^t) bias correction in apply()
+        # is exact, matching the reference's ema_0 = 0 accumulation scheme
+        self._shadow = {id(p): jnp.zeros_like(p._data) for p in self._parameters}
+        self._step = 0
+        self._backup = None
+
+    def update(self):
+        self._step += 1
+        d = self._decay
+        for p in self._parameters:
+            s = self._shadow[id(p)]
+            self._shadow[id(p)] = d * s + (1.0 - d) * p._data
+
+    @contextlib.contextmanager
+    def apply(self, need_restore=True):
+        """Swap EMA weights in (bias-corrected); restore originals on exit."""
+        self._backup = {id(p): p._data for p in self._parameters}
+        corr = 1.0 - self._decay ** max(self._step, 1)
+        for p in self._parameters:
+            p._data = (self._shadow[id(p)] / corr).astype(p._data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self):
+        if self._backup is None:
+            return
+        for p in self._parameters:
+            p._data = self._backup[id(p)]
+        self._backup = None
+
+
+class ModelAverage:
+    """Running average of parameters over a sliding window
+    (fluid/optimizer.py:3157). `update()` per step; `apply()` swaps the
+    averaged weights in for evaluation."""
+
+    def __init__(self, average_window_rate, parameters=None, min_average_window=10000,
+                 max_average_window=10000, name=None):
+        self._rate = float(average_window_rate)
+        self._min_w = int(min_average_window)
+        self._max_w = int(max_average_window)
+        self._parameters = list(parameters or [])
+        self._sum = {id(p): jnp.zeros_like(p._data) for p in self._parameters}
+        self._num = 0
+        self._backup = None
+
+    def update(self):
+        # Window restarts once it outgrows max(min_window, rate * steps) — the
+        # same sliding-window intent as the reference's sum_1/2/3 rotation.
+        window = max(self._min_w, min(self._max_w, int(self._rate * (self._num + 1)) or 1))
+        if self._num >= window:
+            self._num = 0
+            for p in self._parameters:
+                self._sum[id(p)] = jnp.zeros_like(p._data)
+        self._num += 1
+        for p in self._parameters:
+            self._sum[id(p)] = self._sum[id(p)] + p._data
+
+    @contextlib.contextmanager
+    def apply(self, need_restore=True):
+        self._backup = {id(p): p._data for p in self._parameters}
+        n = max(self._num, 1)
+        for p in self._parameters:
+            p._data = (self._sum[id(p)] / n).astype(p._data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self):
+        if self._backup is None:
+            return
+        for p in self._parameters:
+            p._data = self._backup[id(p)]
+        self._backup = None
+
+
+class LookAhead(Optimizer):
+    """k-step lookahead wrapper: every k inner steps, slow weights move
+    alpha of the way toward the fast weights and the fast weights reset to
+    the slow ones (incubate LookaheadOptimizer parity)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self._alpha = float(alpha)
+        self._k = int(k)
+        self._parameters = inner_optimizer._parameters
+        self._slow = {id(p): jnp.asarray(p._data) for p in self._parameters}
+        self._count = 0
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def set_lr(self, value):
+        self.inner_optimizer.set_lr(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self.inner_optimizer.set_lr_scheduler(scheduler)
+
+    @property
+    def _learning_rate(self):
+        return self.inner_optimizer._learning_rate
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._count += 1
+        if self._count % self._k == 0:
+            a = self._alpha
+            for p in self._parameters:
+                slow = self._slow[id(p)] + a * (p._data - self._slow[id(p)])
+                self._slow[id(p)] = slow
+                p._data = slow.astype(p._data.dtype)
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameters]
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self.inner_optimizer.state_dict()
+
+    def set_state_dict(self, state):
+        self.inner_optimizer.set_state_dict(state)

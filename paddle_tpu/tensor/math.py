@@ -409,3 +409,57 @@ def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
 
 def clip_(x, min=None, max=None, name=None):
     return apply_inplace(lambda v: jnp.clip(v, min, max), x)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Trapezoidal rule integration. Parity: paddle.trapezoid (reference
+    python/paddle/tensor/math.py trapezoid family)."""
+    y = _t(y)
+    if x is not None and dx is not None:
+        raise ValueError("trapezoid: pass either x or dx, not both")
+    if x is not None:
+        return apply(lambda yv, xv: jnp.trapezoid(yv, x=xv, axis=axis), y, _t(x))
+    step = 1.0 if dx is None else dx
+    return apply(lambda yv: jnp.trapezoid(yv, dx=step, axis=axis), y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = _t(y)
+    if x is not None and dx is not None:
+        raise ValueError("cumulative_trapezoid: pass either x or dx, not both")
+
+    def _cumtrap(yv, xv=None):
+        y1 = jnp.moveaxis(yv, axis, -1)
+        heights = (y1[..., 1:] + y1[..., :-1]) / 2.0
+        if xv is None:
+            widths = dx if dx is not None else 1.0
+            areas = heights * widths
+        else:
+            if xv.ndim == 1:
+                # 1-D x integrates along `axis`: place its length there
+                shape = [1] * yv.ndim
+                shape[axis % yv.ndim] = xv.shape[0]
+                xv = xv.reshape(shape)
+            x1 = jnp.moveaxis(jnp.broadcast_to(xv, yv.shape), axis, -1)
+            areas = heights * (x1[..., 1:] - x1[..., :-1])
+        return jnp.moveaxis(jnp.cumsum(areas, axis=-1), -1, axis)
+
+    if x is not None:
+        return apply(_cumtrap, y, _t(x))
+    return apply(_cumtrap, y)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Renormalize sub-tensors along `axis` so each slice's p-norm <= max_norm.
+    Parity: paddle.renorm (reference operators/renorm_op.cc semantics)."""
+    x = _t(x)
+
+    def fn(v):
+        moved = jnp.moveaxis(v, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+    return apply(fn, x)

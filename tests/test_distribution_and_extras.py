@@ -1,0 +1,188 @@
+"""Tests: paddle.distribution, optimizer extras (EMA/ModelAverage/LookAhead),
+new tensor fns (trapezoid/renorm), sequence ops, onnx export facade.
+
+Mirrors the reference's test style (test_distribution.py, test_ema.py,
+test_lookahead.py in python/paddle/fluid/tests/unittests/) — numpy references,
+small shapes.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+class TestDistribution:
+    def test_normal(self):
+        paddle.seed(7)
+        n = D.Normal(0.0, 1.0)
+        s = np.asarray(n.sample((4000,))._data)
+        assert abs(s.mean()) < 0.1 and abs(s.std() - 1.0) < 0.1
+        lp = float(np.asarray(n.log_prob(paddle.to_tensor(0.0))._data))
+        assert abs(lp - (-0.5 * np.log(2 * np.pi))) < 1e-5
+        ent = float(np.asarray(n.entropy()._data))
+        assert abs(ent - 0.5 * (1 + np.log(2 * np.pi))) < 1e-5
+
+    def test_normal_kl(self):
+        a, b = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+        kl = float(np.asarray(a.kl_divergence(b)._data))
+        # closed form: log(s2/s1) + (s1^2 + (m1-m2)^2)/(2 s2^2) - 1/2
+        want = np.log(2.0) + (1.0 + 1.0) / 8.0 - 0.5
+        assert abs(kl - want) < 1e-5
+
+    def test_uniform(self):
+        u = D.Uniform(1.0, 3.0)
+        paddle.seed(3)
+        s = np.asarray(u.sample((2000,))._data)
+        assert s.min() >= 1.0 and s.max() < 3.0
+        assert abs(float(np.asarray(u.entropy()._data)) - np.log(2.0)) < 1e-6
+        p = np.asarray(u.probs(paddle.to_tensor([0.0, 2.0]))._data)
+        np.testing.assert_allclose(p, [0.0, 0.5], atol=1e-6)
+
+    def test_categorical(self):
+        logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+        c = D.Categorical(logits)
+        ent = float(np.asarray(c.entropy()._data))
+        want = -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5))
+        assert abs(ent - want) < 1e-5
+        paddle.seed(11)
+        s = np.asarray(c.sample((5000,))._data)
+        assert abs((s == 2).mean() - 0.5) < 0.05
+        c2 = D.Categorical(np.zeros(3, np.float32))
+        kl = float(np.asarray(c.kl_divergence(c2)._data))
+        assert kl > 0
+
+    def test_categorical_batched_and_stable(self):
+        c = D.Categorical(np.random.RandomState(0).randn(3, 5).astype(np.float32))
+        assert list(c.sample((2,)).shape) == [2, 3]
+        c2 = D.Categorical(np.array([0.0, -100.0], np.float32))
+        lp = float(np.asarray(c2.log_prob(paddle.to_tensor(np.int64(1)))._data))
+        assert np.isfinite(lp) and -100.5 < lp <= -99.9
+
+    def test_log_prob_grad(self):
+        mu = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+        n = D.Normal(mu, 1.0)
+        lp = n.log_prob(paddle.to_tensor(np.float32(1.5)))
+        lp.backward()
+        # d/dmu of -(v-mu)^2/2 = (v-mu) = 1.0
+        assert abs(float(np.asarray(mu.grad._data)) - 1.0) < 1e-5
+
+
+class TestOptimizerExtras:
+    def _toy(self):
+        lin = paddle.nn.Linear(4, 4)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4).astype(np.float32))
+        return lin, x
+
+    def test_ema_apply_restore(self):
+        lin, x = self._toy()
+        ema = paddle.optimizer.ExponentialMovingAverage(lin.parameters(), decay=0.5)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+        w0 = np.asarray(lin.weight._data).copy()
+        for _ in range(3):
+            loss = (lin(x) ** 2).mean() if hasattr(lin(x), "mean") else None
+            loss = paddle.mean(lin(x) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ema.update()
+        live = np.asarray(lin.weight._data).copy()
+        with ema.apply():
+            shadow = np.asarray(lin.weight._data).copy()
+            assert not np.allclose(shadow, live)
+        np.testing.assert_allclose(np.asarray(lin.weight._data), live)
+        assert not np.allclose(live, w0)
+
+    def test_model_average(self):
+        lin, x = self._toy()
+        ma = paddle.optimizer.ModelAverage(0.15, parameters=lin.parameters(),
+                                           min_average_window=2, max_average_window=10)
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=lin.parameters())
+        for _ in range(4):
+            loss = paddle.mean(lin(x) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ma.update()
+        live = np.asarray(lin.weight._data).copy()
+        with ma.apply():
+            avg = np.asarray(lin.weight._data).copy()
+        assert not np.allclose(avg, live)
+        np.testing.assert_allclose(np.asarray(lin.weight._data), live)
+
+    def test_lookahead_converges(self):
+        lin, x = self._toy()
+        inner = paddle.optimizer.SGD(learning_rate=0.2, parameters=lin.parameters())
+        opt = paddle.optimizer.LookAhead(inner, alpha=0.5, k=2)
+        losses = []
+        for _ in range(10):
+            loss = paddle.mean(lin(x) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss._data)))
+        assert losses[-1] < losses[0]
+
+
+class TestNewTensorOps:
+    def test_trapezoid(self):
+        y = paddle.to_tensor(np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], np.float32))
+        np.testing.assert_allclose(np.asarray(paddle.trapezoid(y)._data), [4.0, 10.0])
+        x = paddle.to_tensor(np.array([0.0, 1.0, 3.0], np.float32))
+        out = np.asarray(paddle.trapezoid(y, x=x)._data)
+        np.testing.assert_allclose(out, np.trapezoid(np.asarray(y._data), np.asarray(x._data), axis=-1))
+        ct = np.asarray(paddle.cumulative_trapezoid(y)._data)
+        np.testing.assert_allclose(ct, [[1.5, 4.0], [4.5, 10.0]])
+        # 1-D x along a non-last axis
+        y0 = paddle.to_tensor(np.ones((3, 4), np.float32))
+        x0 = paddle.to_tensor(np.array([0.0, 1.0, 3.0], np.float32))
+        out0 = np.asarray(paddle.cumulative_trapezoid(y0, x=x0, axis=0)._data)
+        np.testing.assert_allclose(out0[:, 0], [1.0, 3.0])
+
+    def test_renorm(self):
+        x = np.random.RandomState(1).randn(3, 5).astype(np.float32)
+        out = np.asarray(paddle.renorm(paddle.to_tensor(x), 2.0, 0, 1.0)._data)
+        norms = np.linalg.norm(out, axis=1)
+        assert (norms <= 1.0 + 1e-5).all()
+        # rows already under the cap are untouched
+        small = x / (np.linalg.norm(x, axis=1, keepdims=True) * 2)
+        out2 = np.asarray(paddle.renorm(paddle.to_tensor(small), 2.0, 0, 1.0)._data)
+        np.testing.assert_allclose(out2, small, rtol=1e-5)
+
+
+class TestSequenceOps:
+    def test_pad_unpad_roundtrip(self):
+        import paddle_tpu.nn.functional as F
+
+        a = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+        b = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(2, 2))
+        out, lens = F.sequence_pad([a, b], 0.0)
+        assert list(out.shape) == [2, 3, 2]
+        np.testing.assert_array_equal(np.asarray(lens._data), [3, 2])
+        back = F.sequence_unpad(out, lens)
+        np.testing.assert_allclose(np.asarray(back[0]._data), np.asarray(a._data))
+        np.testing.assert_allclose(np.asarray(back[1]._data), np.asarray(b._data))
+
+    def test_gather_tree(self):
+        import paddle_tpu.nn.functional as F
+
+        ids = paddle.to_tensor(np.array([[[2, 2], [6, 1]], [[3, 9], [5, 1]], [[0, 1], [9, 0]]], np.int64))
+        parents = paddle.to_tensor(np.array([[[0, 0], [1, 1]], [[1, 0], [1, 0]], [[0, 0], [0, 1]]], np.int64))
+        out = np.asarray(F.gather_tree(ids, parents)._data)
+        # reference docstring example (operators/gather_tree_op.cc)
+        want = np.array([[[2, 2], [1, 6]], [[3, 3], [5, 1]], [[0, 1], [9, 0]]])
+        np.testing.assert_array_equal(out, want)
+
+
+class TestOnnxFacade:
+    def test_export_raises_but_saves(self, tmp_path):
+        lin = paddle.nn.Linear(3, 2)
+        path = str(tmp_path / "model")
+        spec = [paddle.static.InputSpec(shape=[1, 3], dtype="float32")]
+        with pytest.raises(RuntimeError, match="onnx"):
+            paddle.onnx.export(lin, path, input_spec=spec)
+        loaded = paddle.jit.load(path)
+        x = paddle.to_tensor(np.ones((1, 3), np.float32))
+        np.testing.assert_allclose(
+            np.asarray(loaded(x)._data), np.asarray(lin(x)._data), rtol=1e-5
+        )
