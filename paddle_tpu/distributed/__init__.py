@@ -35,3 +35,4 @@ from .split import (  # noqa: F401
     collect_spmd_specs,
     split,
 )
+from . import ps  # noqa: F401,E402

@@ -20,3 +20,5 @@ init_server = fleet.init_server
 run_server = fleet.run_server
 stop_worker = fleet.stop_worker
 worker_endpoints = fleet.worker_endpoints
+def __getattr__(name):  # delegate everything else to the singleton (e.g. ps_runtime)
+    return getattr(fleet, name)

@@ -33,9 +33,12 @@ class Fleet:
         self._role_maker = role_maker or PaddleCloudRoleMaker(is_collective=is_collective)
         self._is_collective = is_collective
         self._strategy = strategy or DistributedStrategy()
-        if _env.get_world_size() > 1:
+        # PS mode (is_collective=False) rendezvouses over the PS RPC tier, not
+        # the jax.distributed coordination service (reference: PS init skips NCCL)
+        if is_collective and _env.get_world_size() > 1:
             init_parallel_env()
-        self._apply_mesh()
+        if is_collective:
+            self._apply_mesh()
         self._inited = True
         return self
 
@@ -110,18 +113,30 @@ class Fleet:
         kw.update(overrides)
         return SpmdTrainer(layer, optimizer, loss_fn, mesh=get_mesh(), **kw)
 
-    # -- PS-mode stubs (reference parity placeholders) -------------------------
+    # -- PS mode (distributed/ps: host tables + TCP RPC) -----------------------
+    @property
+    def ps_runtime(self):
+        """Lazily-built TheOnePs runtime (fleet/runtime/the_one_ps.py parity)."""
+        if getattr(self, "_ps_runtime", None) is None:
+            from ..ps.runtime import TheOnePs
+
+            self._ps_runtime = TheOnePs(role_maker=self._role_maker,
+                                        strategy=self._strategy)
+        return self._ps_runtime
+
     def init_worker(self):
-        pass
+        if not self._is_collective:
+            self.ps_runtime.init_worker()
 
     def init_server(self, *args, **kwargs):
-        pass
+        self.ps_runtime.make_server()
 
     def run_server(self):
-        raise NotImplementedError("parameter-server mode: see distributed/ps (round 2+)")
+        self.ps_runtime.run_server()
 
     def stop_worker(self):
-        pass
+        if getattr(self, "_ps_runtime", None) is not None:
+            self._ps_runtime.stop_worker()
 
     def save_inference_model(self, executor, dirname, feeded_var_names, target_vars,
                              main_program=None, export_for_deployment=True):

@@ -98,8 +98,68 @@ def launch_collective(args):
     return exit_code
 
 
+def launch_ps(args):
+    """launch.py:260 parity (launch_ps): spawn --server_num PS servers and
+    --worker_num trainers on this host with the PADDLE_PSERVERS_IP_PORT_LIST /
+    TRAINING_ROLE env protocol (fleet/launch_utils.py)."""
+    import socket
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    n_servers = args.server_num
+    n_workers = args.worker_num if (args.worker_num or 0) > 0 else args.nproc_per_node
+    server_eps = ",".join(f"127.0.0.1:{free_port()}" for _ in range(n_servers))
+    log_dir = args.log_dir
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    procs = []
+
+    def spawn(role, idx, extra_env, tag):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_PSERVERS_IP_PORT_LIST": server_eps,
+            "PADDLE_TRAINERS_NUM": str(n_workers),
+            "TRAINING_ROLE": role,
+        })
+        env.update(extra_env)
+        cmd = [sys.executable, args.training_script] + args.training_script_args
+        out = open(os.path.join(log_dir, f"{tag}.{idx}"), "w") if log_dir else None
+        procs.append((subprocess.Popen(
+            cmd, env=env, stdout=out, stderr=subprocess.STDOUT if out else None), out))
+
+    for i in range(n_servers):
+        ip, port = server_eps.split(",")[i].rsplit(":", 1)
+        spawn("PSERVER", i, {"PADDLE_PSERVER_ID": str(i), "POD_IP": ip,
+                             "PADDLE_PORT": port}, "serverlog")
+    for i in range(n_workers):
+        spawn("TRAINER", i, {"PADDLE_TRAINER_ID": str(i)}, "workerlog")
+
+    exit_code = 0
+    try:
+        # workers are the tail of `procs`; servers exit when a worker stops them
+        for p, _ in procs[n_servers:]:
+            ret = p.wait()
+            if ret != 0:
+                exit_code = ret
+    finally:
+        for p, out in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+                p.wait()
+            if out:
+                out.close()
+    return exit_code
+
+
 def launch():
     args = _parse_args()
+    if args.server_num > 0:
+        sys.exit(launch_ps(args))
     sys.exit(launch_collective(args))
 
 

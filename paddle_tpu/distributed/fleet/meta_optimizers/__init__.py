@@ -15,8 +15,13 @@ from .localsgd_optimizer import AdaptiveLocalSGDOptimizer, LocalSGDOptimizer  # 
 from .pipeline_optimizer import PipelineOptimizer  # noqa: F401
 from .recompute_optimizer import RecomputeOptimizer  # noqa: F401
 from .sharding_optimizer import ShardingOptimizer  # noqa: F401
+from .parameter_server_optimizer import (  # noqa: F401
+    ParameterServerOptimizer,
+    PsDenseOptimizer,
+)
 
 META_OPTIMIZER_ORDER = [
+    ParameterServerOptimizer,
     # strategy_compiler order: amp/recompute wrap compute; sharding/pipeline shape the
     # mesh; gradient-merge/localsgd/dgc shape the update; lamb/lars swap the rule
     AMPOptimizer,

@@ -1,0 +1,68 @@
+"""Parameter-server meta-optimizer (fleet/meta_optimizers/parameter_server_optimizer.py
+parity, selected by strategy.a_sync like the reference's strategy factory).
+
+Wraps the user optimizer so that dense parameters live on the PS: after local
+backward, gradients are pushed (sync push-pull, or queued via the async
+Communicator) and fresh values are pulled back — the DownpourWorker dense flow.
+Sparse tables are handled by PsEmbedding directly (runtime.py)."""
+import numpy as np
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class ParameterServerOptimizer(MetaOptimizerBase):
+    def can_apply(self, strategy):
+        return bool(getattr(strategy, "a_sync", False))
+
+    def apply(self, trainer_kwargs, optimizer, strategy):
+        # marker only at graph-build time; the worker runtime binds the client
+        trainer_kwargs["ps_mode"] = True
+        return trainer_kwargs, optimizer
+
+
+class PsDenseOptimizer:
+    """Worker-side dense optimizer: push grads / pull params per step.
+
+    `parameters` are ordinary eager Params; each is assigned one dense table.
+    The server applies the real update rule (tables.py _Rule), matching the
+    reference where optimizer rules execute inside the table
+    (table/depends/dense.h)."""
+
+    def __init__(self, parameters, client, communicator=None, optimizer="sgd", lr=0.01,
+                 table_id_base=0):
+        self._parameters = list(parameters)
+        self.client = client
+        self.communicator = communicator
+        self._table_ids = {}
+        for i, p in enumerate(self._parameters):
+            tid = table_id_base + i
+            self._table_ids[id(p)] = tid
+            client.create_dense_table(tid, tuple(p.shape), optimizer=optimizer, lr=lr,
+                                      init=np.asarray(p._data, np.float32))
+        # all workers start from server's (worker-0-initialized) values
+        self.pull()
+
+    def step(self):
+        for p in self._parameters:
+            if p.grad is None:
+                continue
+            tid = self._table_ids[id(p)]
+            g = np.asarray(p.grad._data, np.float32)
+            if self.communicator is not None and self.communicator.mode == "async":
+                self.communicator.push_dense_async(tid, g)
+            else:
+                self.client.push_dense(tid, g)
+        self.pull()
+
+    def pull(self):
+        import jax.numpy as jnp
+
+        for p in self._parameters:
+            tid = self._table_ids[id(p)]
+            p._data = jnp.asarray(self.client.pull_dense(tid), dtype=p._data.dtype)
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameters:
+            p.clear_grad()
+
+    clear_gradients = clear_grad

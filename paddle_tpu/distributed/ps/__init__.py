@@ -1,0 +1,22 @@
+"""TPU-native parameter-server mode.
+
+Reference parity: paddle/fluid/distributed/ ("pscore" — service/brpc_ps_server.cc,
+service/ps_client.h, service/communicator.h, table/*.h) and the legacy
+operators/distributed/ RPC ops. TPU-native design: the PS tier is a host-side
+(CPU, numpy) key-value tier that feeds the XLA compute path — embedding rows are
+pulled into device arrays at batch start and row gradients are pushed after
+backward (the DownpourWorker flow, framework/device_worker.h:271), while the
+dense math stays inside jit. RPC is a length-prefixed-pickle TCP protocol
+instead of brpc/protobuf; sharding is row-hash across servers.
+"""
+from .tables import (  # noqa: F401
+    BarrierTable,
+    DenseTable,
+    GeoSparseTable,
+    SparseTable,
+    TensorTable,
+)
+from .rpc import RpcClient, RpcServer  # noqa: F401
+from .server import HeartBeatMonitor, PsServer  # noqa: F401
+from .client import Communicator, PsClient  # noqa: F401
+from .runtime import TheOnePs, PsEmbedding  # noqa: F401

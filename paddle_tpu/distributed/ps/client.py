@@ -1,0 +1,219 @@
+"""PS client + async Communicator.
+
+Reference parity: paddle/fluid/distributed/service/ps_client.h (PSClient API:
+pull/push dense & sparse, barrier) and service/communicator.h (async mode:
+background send queues that merge up to max_merge_var_num gradient batches
+before each RPC; geo mode: periodic delta exchange every k_steps).
+
+Sharding: dense tables live whole on one server (round-robin by table id);
+sparse rows shard by id % server_num — the reference's hash placement.
+"""
+import queue
+import threading
+import time
+
+import numpy as np
+
+from .rpc import RpcClient
+
+
+class PsClient:
+    def __init__(self, endpoints, trainer_id=0):
+        self.endpoints = list(endpoints)
+        self.trainer_id = int(trainer_id)
+        self._conns = [RpcClient(ep) for ep in self.endpoints]
+        self._n = len(self._conns)
+        self._sparse_dims = {}  # table_id -> dim (for empty-batch pulls)
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+
+    # -- placement -------------------------------------------------------------
+    def _dense_conn(self, table_id):
+        return self._conns[table_id % self._n]
+
+    # -- table creation (broadcast so every shard knows the schema) ------------
+    def create_dense_table(self, table_id, shape, optimizer="sgd", lr=0.01, init=None):
+        self._dense_conn(table_id).call(
+            "create_table", "dense", table_id,
+            dict(shape=shape, optimizer=optimizer, lr=lr, init=init))
+
+    def create_sparse_table(self, table_id, dim, optimizer="sgd", lr=0.01, geo=False, **kw):
+        kind = "geo" if geo else "sparse"
+        payload = dict(dim=dim, **kw) if geo else dict(dim=dim, optimizer=optimizer, lr=lr, **kw)
+        self._sparse_dims[int(table_id)] = int(dim)
+        for c in self._conns:
+            c.call("create_table", kind, table_id, payload)
+
+    # -- dense -----------------------------------------------------------------
+    def pull_dense(self, table_id):
+        return self._dense_conn(table_id).call("pull_dense", table_id)
+
+    def push_dense(self, table_id, grad):
+        return self._dense_conn(table_id).call("push_dense", table_id, np.asarray(grad, np.float32))
+
+    def set_dense(self, table_id, value):
+        return self._dense_conn(table_id).call("set_dense", table_id, np.asarray(value, np.float32))
+
+    # -- sparse (rows sharded by id % n) ---------------------------------------
+    def _shard(self, ids):
+        ids = np.asarray(ids, np.int64).ravel()
+        shard = (ids % self._n).astype(np.int64)
+        return ids, shard
+
+    def pull_sparse(self, table_id, ids):
+        ids, shard = self._shard(ids)
+        rows = None
+        for s in range(self._n):
+            mask = shard == s
+            if not mask.any():
+                continue
+            part = self._conns[s].call("pull_sparse", table_id, ids[mask])
+            if rows is None:
+                rows = np.empty((len(ids), part.shape[1]), np.float32)
+            rows[mask] = part
+        if rows is None:
+            rows = np.empty((0, self._sparse_dims.get(int(table_id), 0)), np.float32)
+        return rows
+
+    def push_sparse(self, table_id, ids, grads):
+        ids, shard = self._shard(ids)
+        grads = np.asarray(grads, np.float32)
+        for s in range(self._n):
+            mask = shard == s
+            if mask.any():
+                self._conns[s].call("push_sparse", table_id, ids[mask], grads[mask])
+
+    def push_sparse_delta(self, table_id, ids, deltas):
+        ids, shard = self._shard(ids)
+        deltas = np.asarray(deltas, np.float32)
+        for s in range(self._n):
+            mask = shard == s
+            if mask.any():
+                self._conns[s].call(
+                    "push_sparse_delta", table_id, self.trainer_id, ids[mask], deltas[mask])
+
+    def pull_geo(self, table_id):
+        all_ids, all_deltas = [], []
+        for c in self._conns:
+            ids, deltas = c.call("pull_geo", table_id, self.trainer_id)
+            if len(ids):
+                all_ids.append(ids)
+                all_deltas.append(deltas)
+        if not all_ids:
+            return np.empty(0, np.int64), None
+        return np.concatenate(all_ids), np.concatenate(all_deltas)
+
+    # -- control ---------------------------------------------------------------
+    def barrier(self):
+        """Global worker barrier rendezvoused at server 0 (BarrierTable)."""
+        return self._conns[0].call("barrier")
+
+    def start_heartbeat(self, interval=2.0):
+        def loop():
+            while not self._hb_stop.is_set():
+                for c in self._conns:
+                    try:
+                        c.call("heartbeat", self.trainer_id)
+                    except (RuntimeError, ConnectionError, OSError):
+                        pass
+                self._hb_stop.wait(interval)
+
+        self._hb_thread = threading.Thread(target=loop, daemon=True)
+        self._hb_thread.start()
+
+    def stop_server(self):
+        for c in self._conns:
+            try:
+                c.call("stop")
+            except (RuntimeError, ConnectionError, OSError):
+                pass
+
+    def close(self):
+        self._hb_stop.set()
+        if self._hb_thread:
+            self._hb_thread.join(timeout=5)
+        for c in self._conns:
+            c.close()
+
+
+class Communicator:
+    """Async/geo gradient pipe (service/communicator.h).
+
+    async: push goes into a bounded queue; a background thread merges up to
+    `max_merge_var_num` pending grads per table and issues one RPC — training
+    never blocks on the PS round-trip.
+    geo: `step()` counts local steps; every `k_steps` the worker pushes its
+    accumulated sparse deltas and pulls other trainers' deltas.
+    """
+
+    def __init__(self, client, mode="async", send_queue_size=16, max_merge_var_num=4,
+                 k_steps=4):
+        self.client = client
+        self.mode = mode
+        self.k_steps = int(k_steps)
+        self._max_merge = int(max_merge_var_num)
+        self._q = queue.Queue(maxsize=int(send_queue_size))
+        self._stop = threading.Event()
+        self._thread = None
+        self._step = 0
+        if mode == "async":
+            self._thread = threading.Thread(target=self._send_loop, daemon=True)
+            self._thread.start()
+
+    # -- async path ------------------------------------------------------------
+    def push_dense_async(self, table_id, grad):
+        self._q.put(("dense", table_id, np.asarray(grad, np.float32)))
+
+    def push_sparse_async(self, table_id, ids, grads):
+        self._q.put(("sparse", table_id, (np.asarray(ids, np.int64), np.asarray(grads, np.float32))))
+
+    def _send_loop(self):
+        while not self._stop.is_set():
+            try:
+                item = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = [item]
+            while len(batch) < self._max_merge:
+                try:
+                    batch.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            merged = {}
+            for kind, tid, payload in batch:
+                merged.setdefault((kind, tid), []).append(payload)
+            for (kind, tid), items in merged.items():
+                try:
+                    if kind == "dense":
+                        self.client.push_dense(tid, np.sum(items, axis=0))
+                    else:
+                        ids = np.concatenate([i for i, _ in items])
+                        grads = np.concatenate([g for _, g in items])
+                        self.client.push_sparse(tid, ids, grads)
+                except (RuntimeError, ConnectionError, OSError):
+                    pass  # dropped sends are acceptable in async mode
+
+    def flush(self, timeout=10.0):
+        deadline = time.time() + timeout
+        while not self._q.empty() and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.05)  # let the in-flight batch finish
+
+    # -- geo path --------------------------------------------------------------
+    def geo_step(self, table_id, local_table):
+        """Called per step in geo mode with the worker's local SparseTable-like
+        dict {id: (new_row, old_row)} of rows touched since last sync."""
+        self._step += 1
+        if self._step % self.k_steps:
+            return None
+        if local_table:
+            ids = np.fromiter(local_table.keys(), np.int64, len(local_table))
+            deltas = np.stack([local_table[int(i)][0] - local_table[int(i)][1] for i in ids])
+            self.client.push_sparse_delta(table_id, ids, deltas)
+            local_table.clear()
+        return self.client.pull_geo(table_id)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

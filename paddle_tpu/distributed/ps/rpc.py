@@ -1,0 +1,130 @@
+"""Minimal threaded RPC: length-prefixed pickle over TCP.
+
+Reference parity: the brpc/gRPC channel layer (paddle/fluid/distributed/service/
+brpc_ps_client.h, operators/distributed/grpc/). One persistent connection per
+client; the server runs one thread per connection — PS traffic is few-and-large
+(whole dense blocks / batched sparse rows), so per-message threading overhead is
+irrelevant next to serialization, and pickle handles numpy arrays zero-fuss.
+"""
+import pickle
+import socket
+import struct
+import threading
+
+_HDR = struct.Struct("!Q")
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock):
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class RpcServer:
+    """Serves `handler(method: str, args: tuple) -> result` over TCP."""
+
+    def __init__(self, host, port, handler):
+        self._handler = handler
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+
+    def start(self):
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
+
+    def _serve_conn(self, conn):
+        try:
+            while not self._stop.is_set():
+                method, args = _recv_msg(conn)
+                try:
+                    result = self._handler(method, args)
+                    _send_msg(conn, ("ok", result))
+                except Exception as e:  # surfaced client-side
+                    _send_msg(conn, ("err", f"{type(e).__name__}: {e}"))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def shutdown(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RpcClient:
+    """Blocking call() against one server; thread-safe via a per-connection lock."""
+
+    def __init__(self, endpoint, timeout=120.0, connect_timeout=60.0):
+        import time
+
+        host, port = endpoint.rsplit(":", 1)
+        deadline = time.time() + connect_timeout
+        while True:  # workers may start before servers finish booting
+            try:
+                self._sock = socket.create_connection((host, int(port)), timeout=timeout)
+                break
+            except OSError:
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.2)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def call(self, method, *args):
+        with self._lock:
+            if self._sock is None:
+                raise ConnectionError("PS RPC connection is broken")
+            try:
+                _send_msg(self._sock, (method, args))
+                status, result = _recv_msg(self._sock)
+            except OSError:
+                # a timeout/half-send leaves the stream desynced (a late reply
+                # would be read as the answer to the next call) — poison it
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+                raise
+        if status == "err":
+            raise RuntimeError(f"PS RPC {method} failed: {result}")
+        return result
+
+    def close(self):
+        if self._sock is None:
+            return
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._sock = None

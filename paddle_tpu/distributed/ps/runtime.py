@@ -1,0 +1,153 @@
+"""The-one-PS runtime: fleet-facing glue + the PS-backed embedding layer.
+
+Reference parity: python/paddle/distributed/fleet/runtime/the_one_ps.py (table
+construction from the program, server/worker lifecycles) and the DownpourWorker
+pull→compute→push step (framework/device_worker.h:271). TPU-native design: the
+worker's dense math runs the normal jit path; PS interaction happens at the
+batch boundary. PsEmbedding materializes the batch's rows as an autograd *leaf*
+tensor so a normal loss.backward() leaves the row gradients on the leaf — no
+custom tracing needed — and push_step() ships them (sync, async-queue, or
+geo-delta per DistributedStrategy).
+"""
+import os
+
+import numpy as np
+
+from .client import Communicator, PsClient
+from .server import PsServer
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+
+
+class TheOnePs:
+    """One instance per process; role decides server vs worker behavior."""
+
+    def __init__(self, role_maker=None, strategy=None, endpoints=None, trainer_id=0,
+                 worker_num=1):
+        self._rm = role_maker
+        self._strategy = strategy
+        if role_maker is not None:
+            self.endpoints = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "").split(",")
+            self.endpoints = [e for e in self.endpoints if e]
+            self.trainer_id = role_maker.worker_index()
+            self.worker_num = role_maker.worker_num()
+        else:
+            self.endpoints = list(endpoints or [])
+            self.trainer_id = int(trainer_id)
+            self.worker_num = int(worker_num)
+        self.client = None
+        self.communicator = None
+        self._server = None
+
+    # -- server side -----------------------------------------------------------
+    def make_server(self, port=None, host=None):
+        """Create (not yet blocking) this process's PsServer from its endpoint."""
+        if port is None:
+            my_ep = os.environ.get("PADDLE_PORT")
+            ip = os.environ.get("POD_IP", "127.0.0.1")
+            if my_ep is None:
+                # derive from endpoint list position
+                idx = int(os.environ.get("PADDLE_PSERVER_ID", 0))
+                ip, my_ep = self.endpoints[idx].rsplit(":", 1)
+            host, port = ip, int(my_ep)
+        self._server = PsServer(host or "127.0.0.1", int(port), worker_num=self.worker_num)
+        return self._server
+
+    def run_server(self):
+        if self._server is None:
+            self.make_server()
+        self._server.run()
+
+    # -- worker side -----------------------------------------------------------
+    def init_worker(self):
+        self.client = PsClient(self.endpoints, trainer_id=self.trainer_id)
+        mode = "sync"
+        kw = {}
+        if self._strategy is not None and getattr(self._strategy, "a_sync", False):
+            cfg = getattr(self._strategy, "a_sync_configs", None)
+            k = getattr(cfg, "k_steps", -1) if cfg else -1
+            mode = "geo" if k > 0 else "async"
+            if cfg:
+                kw = dict(send_queue_size=cfg.send_queue_size,
+                          max_merge_var_num=cfg.max_merge_var_num,
+                          k_steps=max(k, 1))
+        self.mode = mode
+        if mode != "sync":
+            self.communicator = Communicator(self.client, mode=mode, **kw)
+        self.client.start_heartbeat()
+        launch_barrier = True
+        if self._strategy is not None and getattr(self._strategy, "a_sync_configs", None):
+            launch_barrier = self._strategy.a_sync_configs.launch_barrier
+        if launch_barrier and self.worker_num > 1:
+            self.client.barrier()
+        return self.client
+
+    def stop_worker(self):
+        if self.communicator is not None:
+            self.communicator.flush()
+            self.communicator.stop()
+        if self.client is not None:
+            all_arrived = True
+            if self.worker_num > 1:
+                try:
+                    all_arrived = bool(self.client.barrier())
+                except (RuntimeError, ConnectionError, OSError):
+                    all_arrived = False
+            # only tear the PS tier down once every trainer is known finished —
+            # a failed barrier means someone may still be training against it
+            if self.trainer_id == 0 and all_arrived:
+                self.client.stop_server()
+            self.client.close()
+            self.client = None
+
+
+class PsEmbedding(Layer):
+    """Distributed lookup table (the reference's sparse-embedding path:
+    distributed/table/common_sparse_table.cc + DownpourWorker pull/push).
+
+    forward(ids) pulls the batch's unique rows from the PS into a leaf Tensor
+    (stop_gradient=False) and gathers locally; after loss.backward(), the leaf
+    holds d(loss)/d(rows), and push_step() ships them to the table's server-side
+    optimizer. In geo mode the layer keeps a local row cache trained locally and
+    exchanges deltas every k steps via the Communicator."""
+
+    def __init__(self, table_id, embedding_dim, client=None, communicator=None,
+                 optimizer="sgd", lr=0.01, name=None):
+        super().__init__()
+        self.table_id = int(table_id)
+        self.dim = int(embedding_dim)
+        self.client = client
+        self.communicator = communicator
+        self._pending = []  # [(ids, leaf_tensor)] awaiting push
+        if client is not None:
+            client.create_sparse_table(self.table_id, self.dim, optimizer=optimizer, lr=lr)
+
+    def forward(self, ids):
+        import jax.numpy as jnp
+
+        from ...core.dispatch import apply
+
+        ids_np = np.asarray(ids._data if isinstance(ids, Tensor) else ids).astype(np.int64)
+        flat = ids_np.ravel()
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        rows_np = self.client.pull_sparse(self.table_id, uniq)
+        rows = Tensor(rows_np, stop_gradient=False)
+        inv = jnp.asarray(inverse.reshape(ids_np.shape))
+        out = apply(lambda r: jnp.take(r, inv, axis=0), rows)
+        from ...core.tape import is_grad_enabled
+
+        if is_grad_enabled():  # eval loops never push; don't accumulate leaves
+            self._pending.append((uniq, rows))
+        return out
+
+    def push_step(self):
+        """Push accumulated row grads for every forward since the last push."""
+        for uniq, rows in self._pending:
+            if rows.grad is None:
+                continue
+            g = np.asarray(rows.grad._data, np.float32)
+            if self.communicator is not None and self.communicator.mode == "async":
+                self.communicator.push_sparse_async(self.table_id, uniq, g)
+            else:
+                self.client.push_sparse(self.table_id, uniq, g)
+        self._pending.clear()

@@ -1,0 +1,126 @@
+"""PS server process: table host + heartbeat monitor.
+
+Reference parity: paddle/fluid/distributed/service/brpc_ps_server.cc (service
+dispatch over tables), operators/distributed/heart_beat_monitor.h (worker
+liveness tracking at the server).
+"""
+import threading
+import time
+
+from .rpc import RpcServer
+from .tables import BarrierTable, DenseTable, GeoSparseTable, SparseTable, TensorTable
+
+
+class HeartBeatMonitor:
+    """Tracks last-beat time per worker; flags workers silent > `threshold` s
+    (heart_beat_monitor.h UPDATE/CHECK loop)."""
+
+    def __init__(self, worker_num, threshold=60.0):
+        self._beats = {}
+        self._threshold = float(threshold)
+        self._worker_num = int(worker_num)
+        self._lock = threading.Lock()
+
+    def update(self, worker_id):
+        with self._lock:
+            self._beats[int(worker_id)] = time.time()
+
+    def dead_workers(self):
+        now = time.time()
+        with self._lock:
+            return sorted(
+                w for w, t in self._beats.items() if now - t > self._threshold
+            )
+
+    def alive_count(self):
+        now = time.time()
+        with self._lock:
+            return sum(1 for t in self._beats.values() if now - t <= self._threshold)
+
+
+class PsServer:
+    """Hosts tables behind the RPC endpoint. Table ids are dense ints assigned
+    by the runtime; method surface mirrors PSClient (service/ps_client.h):
+    pull/push dense, pull/push sparse, geo pull/push, barrier, stop."""
+
+    def __init__(self, host="127.0.0.1", port=0, worker_num=1):
+        self._tables = {}
+        self._worker_num = int(worker_num)
+        self._barrier = BarrierTable(self._worker_num)
+        self._monitor = HeartBeatMonitor(self._worker_num)
+        self._stop_requested = threading.Event()
+        self._rpc = RpcServer(host, port, self._handle)
+        self.endpoint = f"{host}:{self._rpc.port}"
+
+    # -- table management (idempotent: every worker announces the schema) ------
+    def create_dense_table(self, table_id, shape, optimizer="sgd", lr=0.01, init=None):
+        self._tables.setdefault(int(table_id), DenseTable(shape, optimizer, lr, init))
+
+    def create_sparse_table(self, table_id, dim, optimizer="sgd", lr=0.01, **kw):
+        self._tables.setdefault(int(table_id), SparseTable(dim, optimizer, lr, **kw))
+
+    def create_geo_table(self, table_id, dim, **kw):
+        self._tables.setdefault(int(table_id), GeoSparseTable(dim, self._worker_num, **kw))
+
+    def create_tensor_table(self, table_id):
+        self._tables.setdefault(int(table_id), TensorTable())
+
+    # -- RPC dispatch ----------------------------------------------------------
+    def _handle(self, method, args):
+        if method == "heartbeat":
+            self._monitor.update(args[0])
+            return self._monitor.alive_count()
+        if method == "barrier":
+            return self._barrier.barrier()
+        if method == "stop":
+            self._stop_requested.set()
+            return True
+        if method == "list_tables":
+            return sorted(self._tables)
+        if method == "create_table":
+            kind, table_id, kw = args
+            getattr(self, f"create_{kind}_table")(table_id, **kw)
+            return True
+        table = self._tables[int(args[0])]
+        rest = args[1:]
+        if method == "pull_dense":
+            return table.pull()
+        if method == "push_dense":
+            table.push(*rest)
+            return True
+        if method == "set_dense":
+            table.set(*rest)
+            return True
+        if method == "pull_sparse":
+            return table.pull(*rest)
+        if method == "push_sparse":
+            table.push(*rest)
+            return True
+        if method == "push_sparse_delta":
+            table.push_delta(*rest)
+            return True
+        if method == "pull_geo":
+            return table.pull_geo(*rest)
+        if method == "tensor_set":
+            table.set(*rest)
+            return True
+        if method == "tensor_get":
+            return table.get(*rest)
+        if method == "sparse_size":
+            return table.size()
+        raise ValueError(f"unknown PS method: {method}")
+
+    # -- lifecycle -------------------------------------------------------------
+    def start(self):
+        self._rpc.start()
+        return self
+
+    def run(self, poll_s=0.2):
+        """Block until a worker calls stop() — fleet.run_server() semantics."""
+        self.start()
+        while not self._stop_requested.is_set():
+            time.sleep(poll_s)
+        self.shutdown()
+
+    def shutdown(self):
+        self._rpc.shutdown()

@@ -1,0 +1,205 @@
+"""PS tables with server-side optimizer rules.
+
+Reference parity: paddle/fluid/distributed/table/ — CommonDenseTable,
+CommonSparseTable (common_sparse_table.cc), SparseGeoTable (sparse_geo_table.cc),
+BarrierTable (barrier_table.cc), TensorTable (tensor_table.h); embedded optimizer
+rules mirror table/depends/dense.h and table/depends/sparse.h (sum/sgd/adagrad/
+adam applied where the parameters live, so workers ship gradients, not weights).
+
+All storage is host numpy — the PS tier is deliberately off the XLA path; only
+pulled rows enter device memory, as jnp arrays on the worker side.
+"""
+import threading
+
+import numpy as np
+
+
+class _Rule:
+    """Server-side optimizer rules (table/depends/{dense,sparse}.h parity)."""
+
+    def __init__(self, name, lr):
+        self.name = name
+        self.lr = float(lr)
+
+    def slots(self, dim):
+        if self.name == "adagrad":
+            return {"g2sum": np.zeros(dim, np.float32)}
+        if self.name == "adam":
+            return {
+                "m": np.zeros(dim, np.float32),
+                "v": np.zeros(dim, np.float32),
+                "beta1_pow": np.ones((), np.float32),
+                "beta2_pow": np.ones((), np.float32),
+            }
+        return {}
+
+    def apply(self, value, grad, slots):
+        if self.name == "sum":
+            value -= grad  # raw accumulation; caller controls scaling
+        elif self.name == "sgd":
+            value -= self.lr * grad
+        elif self.name == "adagrad":
+            slots["g2sum"] += grad * grad
+            value -= self.lr * grad / (np.sqrt(slots["g2sum"]) + 1e-6)
+        elif self.name == "adam":
+            b1, b2, eps = 0.9, 0.999, 1e-8
+            slots["beta1_pow"] *= b1
+            slots["beta2_pow"] *= b2
+            slots["m"] = b1 * slots["m"] + (1 - b1) * grad
+            slots["v"] = b2 * slots["v"] + (1 - b2) * grad * grad
+            mhat = slots["m"] / (1 - slots["beta1_pow"])
+            vhat = slots["v"] / (1 - slots["beta2_pow"])
+            value -= self.lr * mhat / (np.sqrt(vhat) + eps)
+        else:
+            raise ValueError(f"unknown PS optimizer rule: {self.name}")
+        return value
+
+
+class DenseTable:
+    """Whole-block dense parameters (table/common_dense_table.cc)."""
+
+    def __init__(self, shape, optimizer="sgd", lr=0.01, init=None):
+        self._value = (
+            np.asarray(init, np.float32).copy()
+            if init is not None
+            else np.zeros(shape, np.float32)
+        )
+        self._rule = _Rule(optimizer, lr)
+        self._slots = self._rule.slots(self._value.shape)
+        self._lock = threading.Lock()
+
+    def pull(self):
+        with self._lock:
+            return self._value.copy()
+
+    def push(self, grad):
+        with self._lock:
+            self._value = self._rule.apply(self._value, np.asarray(grad, np.float32), self._slots)
+
+    def set(self, value):
+        with self._lock:
+            self._value = np.asarray(value, np.float32).copy()
+
+
+class SparseTable:
+    """Auto-growing row store keyed by int64 id (table/common_sparse_table.cc).
+    Rows initialize lazily on first pull — the reference's fill-on-miss accessor."""
+
+    def __init__(self, dim, optimizer="sgd", lr=0.01, initializer="uniform",
+                 init_scale=0.01, seed=0):
+        self.dim = int(dim)
+        self._rule = _Rule(optimizer, lr)
+        self._rows = {}
+        self._slots = {}
+        self._lock = threading.Lock()
+        self._initializer = initializer
+        self._scale = float(init_scale)
+        self._rng = np.random.RandomState(seed)
+
+    def _init_row(self, rid):
+        if self._initializer == "zeros":
+            row = np.zeros(self.dim, np.float32)
+        else:
+            row = self._rng.uniform(-self._scale, self._scale, self.dim).astype(np.float32)
+        self._rows[rid] = row
+        self._slots[rid] = self._rule.slots(self.dim)
+        return row
+
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64).ravel()
+        with self._lock:
+            return np.stack([
+                self._rows.get(int(i)) if int(i) in self._rows else self._init_row(int(i))
+                for i in ids
+            ])
+
+    def push(self, ids, grads):
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        with self._lock:
+            # duplicate ids in one batch accumulate (reference merges by id)
+            order = np.argsort(ids, kind="stable")
+            uniq, starts = np.unique(ids[order], return_index=True)
+            summed = np.add.reduceat(grads[order], starts, axis=0)
+            for rid, g in zip(uniq, summed):
+                rid = int(rid)
+                if rid not in self._rows:
+                    self._init_row(rid)
+                self._rows[rid] = self._rule.apply(self._rows[rid], g, self._slots[rid])
+
+    def size(self):
+        with self._lock:
+            return len(self._rows)
+
+
+class GeoSparseTable(SparseTable):
+    """Geo-async sparse table (table/sparse_geo_table.cc): workers train local
+    replicas; the server additionally accumulates per-trainer row deltas so each
+    trainer can periodically pull only what *others* changed."""
+
+    def __init__(self, dim, trainers, **kw):
+        super().__init__(dim, **kw)
+        self._trainers = int(trainers)
+        self._pending = [dict() for _ in range(self._trainers)]  # per-trainer {id: delta}
+
+    def push_delta(self, trainer_id, ids, deltas):
+        ids = np.asarray(ids, np.int64).ravel()
+        deltas = np.asarray(deltas, np.float32).reshape(len(ids), self.dim)
+        with self._lock:
+            for rid, d in zip(ids, deltas):
+                rid = int(rid)
+                if rid not in self._rows:
+                    self._init_row(rid)
+                self._rows[rid] = self._rows[rid] + d
+                for t in range(self._trainers):
+                    if t == trainer_id:
+                        continue
+                    q = self._pending[t]
+                    q[rid] = q.get(rid, 0) + d
+
+    def pull_geo(self, trainer_id):
+        with self._lock:
+            q = self._pending[trainer_id]
+            self._pending[trainer_id] = {}
+        if not q:
+            return np.empty(0, np.int64), np.empty((0, self.dim), np.float32)
+        ids = np.fromiter(q.keys(), np.int64, len(q))
+        deltas = np.stack([np.asarray(q[int(i)], np.float32) for i in ids])
+        return ids, deltas
+
+
+class BarrierTable:
+    """Blocks until `trigger` participants arrive (table/barrier_table.cc)."""
+
+    def __init__(self, trigger):
+        self._trigger = int(trigger)
+        self._count = 0
+        self._generation = 0
+        self._cond = threading.Condition()
+
+    def barrier(self, timeout=60.0):
+        with self._cond:
+            gen = self._generation
+            self._count += 1
+            if self._count >= self._trigger:
+                self._count = 0
+                self._generation += 1
+                self._cond.notify_all()
+                return True
+            return self._cond.wait_for(lambda: self._generation != gen, timeout=timeout)
+
+
+class TensorTable:
+    """Named arbitrary tensors (table/tensor_table.h) — e.g. global step, lr."""
+
+    def __init__(self):
+        self._store = {}
+        self._lock = threading.Lock()
+
+    def set(self, name, value):
+        with self._lock:
+            self._store[name] = np.asarray(value)
+
+    def get(self, name):
+        with self._lock:
+            return self._store.get(name)

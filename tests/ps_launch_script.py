@@ -1,0 +1,54 @@
+"""Driver script for test_ps_launch: run under the PS launcher as either a
+PSERVER or TRAINER process (test_fleet_launch_ps.sh analog)."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.distributed_strategy import DistributedStrategy
+from paddle_tpu.distributed.fleet.meta_optimizers import PsDenseOptimizer
+from paddle_tpu.distributed.fleet.role_maker import PaddleCloudRoleMaker
+
+
+def main():
+    strategy = DistributedStrategy()
+    strategy.a_sync = False  # sync push-pull
+    fleet.init(role_maker=PaddleCloudRoleMaker(is_collective=False), is_collective=False,
+               strategy=strategy)
+    if fleet.is_server():
+        fleet.init_server()
+        fleet.run_server()
+        return
+    fleet.init_worker()
+    client = fleet.ps_runtime.client
+    paddle.seed(0)
+    lin = paddle.nn.Linear(2, 1)
+    opt = PsDenseOptimizer(lin.parameters(), client, optimizer="sgd", lr=0.1)
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 2).astype(np.float32)
+    Y = X @ np.array([[2.0], [-1.0]], np.float32)
+    first = last = None
+    for i in range(30):
+        xb, yb = paddle.to_tensor(X[i % 56:i % 56 + 8]), paddle.to_tensor(Y[i % 56:i % 56 + 8])
+        loss = paddle.mean((lin(xb) - yb) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        v = float(np.asarray(loss._data))
+        first = v if first is None else first
+        last = v
+    assert last < first, (first, last)
+    print(f"PS_LAUNCH_OK trainer={fleet.worker_index()} first={first:.4f} last={last:.4f}")
+    fleet.stop_worker()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,250 @@
+"""Parameter-server mode tests.
+
+Modeled on the reference's PS test strategy (SURVEY.md §4):
+- table semantics unit tests = paddle/fluid/distributed/test/sparse_table_test.cc
+- in-process server+client on localhost ports = brpc_service_dense_sgd_test.cc
+- multi-worker convergence = test_dist_base.py (threads stand in for processes;
+  the RPC path is identical).
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.ps import (
+    Communicator,
+    DenseTable,
+    GeoSparseTable,
+    PsClient,
+    PsEmbedding,
+    PsServer,
+    SparseTable,
+    TheOnePs,
+)
+from paddle_tpu.distributed.fleet.meta_optimizers import PsDenseOptimizer
+
+
+# ---------- table semantics (no RPC) -----------------------------------------
+class TestTables:
+    def test_dense_sgd(self):
+        t = DenseTable((4,), optimizer="sgd", lr=0.5, init=np.ones(4, np.float32))
+        t.push(np.full(4, 2.0, np.float32))
+        np.testing.assert_allclose(t.pull(), np.zeros(4))
+
+    def test_dense_adam_moves_toward_minimum(self):
+        t = DenseTable((2,), optimizer="adam", lr=0.1, init=np.array([1.0, -1.0], np.float32))
+        for _ in range(50):
+            t.push(t.pull())  # grad = x for loss x^2/2
+        assert np.abs(t.pull()).max() < 0.5
+
+    def test_sparse_autoinit_and_update(self):
+        t = SparseTable(3, optimizer="sgd", lr=1.0, initializer="zeros")
+        rows = t.pull([5, 9, 5])
+        assert rows.shape == (3, 3)
+        np.testing.assert_allclose(rows, 0)
+        # duplicate ids in one push accumulate
+        t.push([5, 5, 9], np.ones((3, 3), np.float32))
+        np.testing.assert_allclose(t.pull([5])[0], [-2, -2, -2])
+        np.testing.assert_allclose(t.pull([9])[0], [-1, -1, -1])
+        assert t.size() == 2
+
+    def test_sparse_adagrad(self):
+        t = SparseTable(2, optimizer="adagrad", lr=0.1, initializer="zeros")
+        t.push([1], np.ones((1, 2), np.float32))
+        # g2sum=1 -> delta = 0.1/1
+        np.testing.assert_allclose(t.pull([1])[0], [-0.1, -0.1], atol=1e-5)
+
+    def test_geo_delta_exchange(self):
+        t = GeoSparseTable(2, trainers=2, initializer="zeros")
+        t.push_delta(0, [7], np.full((1, 2), 0.5, np.float32))
+        ids, deltas = t.pull_geo(1)  # trainer 1 sees trainer 0's delta
+        np.testing.assert_array_equal(ids, [7])
+        np.testing.assert_allclose(deltas, 0.5)
+        ids2, _ = t.pull_geo(1)  # drained
+        assert len(ids2) == 0
+        ids0, _ = t.pull_geo(0)  # own pushes not echoed back
+        assert len(ids0) == 0
+
+
+# ---------- RPC server/client ------------------------------------------------
+@pytest.fixture()
+def two_servers():
+    servers = [PsServer(port=0, worker_num=2).start() for _ in range(2)]
+    yield servers
+    for s in servers:
+        s.shutdown()
+
+
+class TestRpcPath:
+    def test_dense_roundtrip_server_side_sgd(self, two_servers):
+        client = PsClient([s.endpoint for s in two_servers])
+        client.create_dense_table(0, (3,), optimizer="sgd", lr=0.5,
+                                  init=np.ones(3, np.float32))
+        client.push_dense(0, np.full(3, 2.0, np.float32))
+        np.testing.assert_allclose(client.pull_dense(0), np.zeros(3))
+        client.close()
+
+    def test_sparse_sharded_across_servers(self, two_servers):
+        client = PsClient([s.endpoint for s in two_servers])
+        client.create_sparse_table(1, 4, optimizer="sgd", lr=1.0, initializer="zeros")
+        ids = np.array([0, 1, 2, 3, 10, 11], np.int64)  # both parities -> both shards
+        rows = client.pull_sparse(1, ids)
+        assert rows.shape == (6, 4)
+        client.push_sparse(1, ids, np.ones((6, 4), np.float32))
+        np.testing.assert_allclose(client.pull_sparse(1, ids), -1)
+        # each shard only holds its own rows
+        sizes = [s._tables[1].size() for s in two_servers]
+        assert sorted(sizes) == [3, 3]
+        client.close()
+
+    def test_barrier_two_workers(self, two_servers):
+        eps = [s.endpoint for s in two_servers]
+        results = []
+
+        def worker(tid):
+            c = PsClient(eps, trainer_id=tid)
+            results.append(c.barrier())
+            c.close()
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert results == [True, True]
+
+    def test_heartbeat_monitor(self, two_servers):
+        client = PsClient([s.endpoint for s in two_servers], trainer_id=0)
+        alive = client._conns[0].call("heartbeat", 0)
+        assert alive == 1
+        assert two_servers[0]._monitor.dead_workers() == []
+        client.close()
+
+    def test_stop(self):
+        server = PsServer(port=0, worker_num=1)
+        run_t = threading.Thread(target=server.run, daemon=True)
+        run_t.start()
+        import time
+
+        time.sleep(0.2)
+        client = PsClient([server.endpoint])
+        client.stop_server()
+        run_t.join(timeout=10)
+        assert not run_t.is_alive()
+        client.close()
+
+
+# ---------- async communicator ------------------------------------------------
+class TestCommunicator:
+    def test_async_merge_and_apply(self, two_servers):
+        client = PsClient([s.endpoint for s in two_servers])
+        client.create_dense_table(0, (2,), optimizer="sum", lr=1.0,
+                                  init=np.zeros(2, np.float32))
+        comm = Communicator(client, mode="async", max_merge_var_num=4)
+        for _ in range(8):
+            comm.push_dense_async(0, np.ones(2, np.float32))
+        comm.flush()
+        comm.stop()
+        np.testing.assert_allclose(client.pull_dense(0), -8)
+        client.close()
+
+
+# ---------- end-to-end: PS-backed training ------------------------------------
+class TestPsTraining:
+    def test_ps_embedding_regression_single_worker(self, two_servers):
+        """Sparse embedding pulled from PS, trained via server-side sgd."""
+        paddle.seed(0)
+        client = PsClient([s.endpoint for s in two_servers])
+        emb = PsEmbedding(table_id=3, embedding_dim=4, client=client,
+                          optimizer="sgd", lr=1.0)
+        ids = paddle.to_tensor(np.array([1, 2, 3, 4], np.int64))
+        target = paddle.to_tensor(np.random.RandomState(0).randn(4, 4).astype(np.float32))
+        losses = []
+        for _ in range(30):
+            out = emb(ids)
+            loss = paddle.mean((out - target) ** 2)
+            loss.backward()
+            emb.push_step()
+            losses.append(float(np.asarray(loss._data)))
+        assert losses[-1] < 0.1 * losses[0]
+        client.close()
+
+    def test_dense_ps_optimizer_two_workers(self, two_servers):
+        """Two workers hogwild-train shared dense params through the PS."""
+        eps = [s.endpoint for s in two_servers]
+        w_true = np.array([[2.0], [-1.0]], np.float32)
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 2).astype(np.float32)
+        Y = X @ w_true
+
+        def worker(tid, losses):
+            lin = paddle.nn.Linear(2, 1)
+            client = PsClient(eps, trainer_id=tid)
+            opt = PsDenseOptimizer(lin.parameters(), client, optimizer="sgd", lr=0.1)
+            if tid == 0:  # worker 0's init wins (create is idempotent)
+                pass
+            for i in range(40):
+                xb = paddle.to_tensor(X[(tid * 8 + i) % 56:(tid * 8 + i) % 56 + 8])
+                yb = paddle.to_tensor(Y[(tid * 8 + i) % 56:(tid * 8 + i) % 56 + 8])
+                loss = paddle.mean((lin(xb) - yb) ** 2)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(np.asarray(loss._data)))
+            client.close()
+
+        l0, l1 = [], []
+        ts = [threading.Thread(target=worker, args=(0, l0)),
+              threading.Thread(target=worker, args=(1, l1))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert min(l0[-5:]) < 0.1 * l0[0]
+        assert min(l1[-5:]) < 0.1 * l1[0]
+
+
+# ---------- fleet integration --------------------------------------------------
+class TestFleetPsIntegration:
+    def test_runtime_roles_via_env(self, monkeypatch):
+        server = PsServer(port=0, worker_num=1).start()
+        monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", server.endpoint)
+        monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+        from paddle_tpu.distributed.fleet.role_maker import PaddleCloudRoleMaker
+        from paddle_tpu.distributed.fleet.distributed_strategy import DistributedStrategy
+
+        strategy = DistributedStrategy()
+        strategy.a_sync = True
+        rt = TheOnePs(role_maker=PaddleCloudRoleMaker(is_collective=False),
+                      strategy=strategy)
+        client = rt.init_worker()
+        assert rt.mode == "async" and rt.communicator is not None
+        client.create_dense_table(0, (2,), optimizer="sgd", lr=1.0,
+                                  init=np.zeros(2, np.float32))
+        assert client.pull_dense(0).shape == (2,)
+        rt.stop_worker()
+        server.shutdown()
+
+    def test_geo_mode_selected_by_k_steps(self):
+        from paddle_tpu.distributed.fleet.distributed_strategy import DistributedStrategy
+
+        server = PsServer(port=0, worker_num=1).start()
+        strategy = DistributedStrategy()
+        strategy.a_sync = True
+        strategy.a_sync_configs.k_steps = 2
+        rt = TheOnePs(strategy=strategy, endpoints=[server.endpoint], worker_num=1)
+        rt.init_worker()
+        assert rt.mode == "geo"
+        rt.stop_worker()
+        server.shutdown()
+
+    def test_meta_optimizer_selection(self):
+        from paddle_tpu.distributed.fleet.distributed_strategy import DistributedStrategy
+        from paddle_tpu.distributed.fleet.meta_optimizers import apply_meta_optimizers
+
+        strategy = DistributedStrategy()
+        strategy.a_sync = True
+        kw, _ = apply_meta_optimizers({}, None, strategy)
+        assert kw.get("ps_mode") is True

@@ -1,0 +1,24 @@
+"""Subprocess PS-cluster launch test (test_fleet_launch_ps.sh /
+test_dist_base.py analog): real server + trainer processes via the fleetrun
+launcher's PS path."""
+import os
+import subprocess
+import sys
+
+
+def test_fleetrun_ps_mode(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "tests", "ps_launch_script.py")
+    log_dir = str(tmp_path / "logs")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.fleet.launch",
+         "--server_num", "2", "--worker_num", "2", "--log_dir", log_dir, script],
+        cwd=repo, env=env, timeout=240, capture_output=True, text=True)
+    worker_logs = ""
+    for i in range(2):
+        with open(os.path.join(log_dir, f"workerlog.{i}")) as f:
+            worker_logs += f.read()
+    assert proc.returncode == 0, (proc.stdout, proc.stderr, worker_logs)
+    assert worker_logs.count("PS_LAUNCH_OK") == 2, worker_logs
