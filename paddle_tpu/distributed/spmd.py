@@ -70,6 +70,20 @@ def state_shardings(opt_state, p_shardings, mesh, axis_name, stage):
     return out
 
 
+def _collect_moe_aux(layer):
+    """Sum MoE load-balance aux losses from the last forward (None if dense).
+
+    Keeps the router's load-balancing gradient alive on trainer paths where the
+    loss_fn only sees (outputs, labels)."""
+    from ..nn.layer.moe import MoELayer
+
+    aux = None
+    for sub in layer.sublayers(include_self=True):
+        if isinstance(sub, MoELayer) and sub.aux_loss is not None:
+            aux = sub.aux_loss if aux is None else aux + sub.aux_loss
+    return aux
+
+
 class SpmdTrainer:
     """Compile a Layer + Optimizer + loss into one sharded XLA train step."""
 
@@ -145,6 +159,10 @@ class SpmdTrainer:
                 if self.loss_fn is not None:
                     out = layer(*inputs)
                     loss = self.loss_fn(out, label)
+                    aux = _collect_moe_aux(layer)
+                    if aux is not None:
+                        w = getattr(getattr(layer, "cfg", None), "moe_aux_weight", 0.01)
+                        loss = loss + w * aux
                 else:
                     loss = layer(*inputs, label)
             new_buffers = {n: named_b[n]._data for n in buffers}

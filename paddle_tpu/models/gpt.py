@@ -21,7 +21,9 @@ from ..nn import functional as F
 class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
                  max_seq_len=1024, intermediate_size=None, dropout=0.1,
-                 tensor_parallel=False, use_flash=True):
+                 tensor_parallel=False, use_flash=True,
+                 num_experts=0, moe_every=2, moe_k=2, moe_capacity_factor=2.0,
+                 moe_aux_weight=0.01, moe_mesh=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -31,6 +33,20 @@ class GPTConfig:
         self.dropout = dropout
         self.tensor_parallel = tensor_parallel
         self.use_flash = use_flash
+        # MoE (num_experts > 0 turns every `moe_every`-th block's MLP into a
+        # MoELayer; moe_mesh with an 'ep' axis enables expert parallelism)
+        if num_experts > 0 and tensor_parallel:
+            # MoE expert weights are not mp-sharded; combining would silently
+            # replicate the dominant parameter mass on every mp rank. Use
+            # expert parallelism (moe_mesh with an 'ep' axis) instead.
+            raise ValueError("num_experts > 0 with tensor_parallel=True is not "
+                             "supported; shard experts with moe_mesh ('ep' axis)")
+        self.num_experts = num_experts
+        self.moe_every = moe_every
+        self.moe_k = moe_k
+        self.moe_capacity_factor = moe_capacity_factor
+        self.moe_aux_weight = moe_aux_weight
+        self.moe_mesh = moe_mesh
 
     @staticmethod
     def small():
@@ -96,12 +112,18 @@ class GPTMLP(nn.Layer):
 
 
 class GPTBlock(nn.Layer):
-    def __init__(self, cfg):
+    def __init__(self, cfg, layer_idx=0):
         super().__init__()
         self.ln1 = nn.LayerNorm(cfg.hidden_size)
         self.attn = GPTAttention(cfg)
         self.ln2 = nn.LayerNorm(cfg.hidden_size)
-        self.mlp = GPTMLP(cfg)
+        if cfg.num_experts > 0 and (layer_idx + 1) % cfg.moe_every == 0:
+            self.mlp = nn.MoELayer(
+                cfg.hidden_size, cfg.intermediate_size, cfg.num_experts,
+                k=cfg.moe_k, capacity_factor=cfg.moe_capacity_factor,
+                mesh=cfg.moe_mesh)
+        else:
+            self.mlp = GPTMLP(cfg)
         self.drop = nn.Dropout(cfg.dropout)
 
     def forward(self, x):
@@ -122,7 +144,7 @@ class GPTModel(nn.Layer):
             self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
         self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
         self.drop = nn.Dropout(cfg.dropout)
-        self.blocks = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.blocks = nn.LayerList([GPTBlock(cfg, i) for i in range(cfg.num_layers)])
         self.ln_f = nn.LayerNorm(cfg.hidden_size)
 
     def forward(self, input_ids):
@@ -157,7 +179,20 @@ class GPTForCausalLM(nn.Layer):
     def loss(self, input_ids, labels):
         logits = self.forward(input_ids)
         b, s, v = logits.shape
-        return F.cross_entropy(logits.reshape([b * s, v]), labels.reshape([b * s]))
+        loss = F.cross_entropy(logits.reshape([b * s, v]), labels.reshape([b * s]))
+        aux = self.moe_aux_loss()
+        if aux is not None:
+            loss = loss + self.cfg.moe_aux_weight * aux
+        return loss
+
+    def moe_aux_loss(self):
+        """Sum of MoE load-balance losses from the last forward (None if dense)."""
+        aux = None
+        for blk in self.gpt.blocks:
+            a = getattr(blk.mlp, "aux_loss", None)
+            if a is not None:
+                aux = a if aux is None else aux + a
+        return aux
 
 
 class GPTPretrainLoss(nn.Layer):
