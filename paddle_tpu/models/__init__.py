@@ -1,5 +1,14 @@
 """Model zoo: flagship LMs (GPT/BERT) + vision models re-export."""
 from .bert import BertConfig, BertForPretraining, BertModel, BertPretrainLoss, bert_base  # noqa: F401
+from .ernie import (  # noqa: F401
+    ErnieConfig,
+    ErnieForPretraining,
+    ErnieForSequenceClassification,
+    ErnieModel,
+    ErniePretrainLoss,
+    ernie_base,
+    knowledge_mask,
+)
 from .gpt import (  # noqa: F401
     GPTConfig,
     GPTForCausalLM,

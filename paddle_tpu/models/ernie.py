@@ -1,0 +1,166 @@
+"""ERNIE-1.0 style encoder (BASELINE.json config #4: ERNIE-1.0 / GPT-2 medium).
+
+ERNIE (Enhanced Representation through kNowledge IntEgration) is architecturally a
+BERT-family encoder; its distinguishing features are (1) relu FFN activation and the
+Chinese-vocab sizing of the original release, (2) knowledge masking — whole-phrase /
+whole-entity span masking at the data level rather than token-level masking — and
+(3) optional task-type embeddings (ERNIE 2.0 continual pretraining).
+
+The reference trains ERNIE through fleet on the same Transformer blocks
+(python/paddle/nn/layer/transformer.py); there is no ernie model file in the
+reference tree — this is the framework's own model zoo, built on paddle_tpu.nn.
+"""
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+
+
+class ErnieConfig:
+    def __init__(self, vocab_size=18000, hidden_size=768, num_layers=12, num_heads=12,
+                 intermediate_size=3072, max_position=513, type_vocab_size=2,
+                 task_type_vocab_size=0, dropout=0.1, activation="relu"):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position = max_position
+        self.type_vocab_size = type_vocab_size
+        self.task_type_vocab_size = task_type_vocab_size  # >0: ERNIE-2.0 task emb
+        self.dropout = dropout
+        self.activation = activation
+
+    @staticmethod
+    def base():
+        return ErnieConfig()
+
+    @staticmethod
+    def tiny():
+        return ErnieConfig(vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
+                           intermediate_size=128, max_position=128, dropout=0.0)
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.word = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position = nn.Embedding(cfg.max_position, cfg.hidden_size)
+        self.token_type = nn.Embedding(cfg.type_vocab_size, cfg.hidden_size)
+        self.task_type = (nn.Embedding(cfg.task_type_vocab_size, cfg.hidden_size)
+                          if cfg.task_type_vocab_size > 0 else None)
+        self.ln = nn.LayerNorm(cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, input_ids, token_type_ids=None, task_type_ids=None):
+        from ..tensor.creation import arange
+
+        s = input_ids.shape[1]
+        pos = arange(s, dtype="int64")
+        x = self.word(input_ids) + self.position(pos)
+        if token_type_ids is not None:
+            x = x + self.token_type(token_type_ids)
+        if self.task_type is not None and task_type_ids is not None:
+            x = x + self.task_type(task_type_ids)
+        return self.drop(self.ln(x))
+
+
+class ErnieModel(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = ErnieEmbeddings(cfg)
+        enc_layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_heads, cfg.intermediate_size,
+            dropout=cfg.dropout, activation=cfg.activation,
+        )
+        self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None):
+        x = self.embeddings(input_ids, token_type_ids, task_type_ids)
+        x = self.encoder(x, attention_mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class ErnieForPretraining(nn.Layer):
+    """MLM head (weight tied to word embedding) + NSP head, BERT-style."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.ln = nn.LayerNorm(cfg.hidden_size)
+        self.nsp = nn.Linear(cfg.hidden_size, 2)
+        self.cfg = cfg
+
+    def forward(self, input_ids, token_type_ids=None):
+        seq, pooled = self.ernie(input_ids, token_type_ids)
+        h = self.ln(F.gelu(self.transform(seq)))
+        from ..tensor.math import matmul
+
+        mlm_logits = matmul(h, self.ernie.embeddings.word.weight, transpose_y=True)
+        return mlm_logits, self.nsp(pooled)
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, cfg, num_classes=2):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None):
+        _, pooled = self.ernie(input_ids, token_type_ids)
+        return self.classifier(self.drop(pooled))
+
+
+class ErniePretrainLoss(nn.Layer):
+    """MLM + NSP joint loss; labels = (mlm_labels, nsp_labels) or mlm only."""
+
+    def forward(self, outputs, labels):
+        mlm_logits, nsp_logits = outputs
+        if isinstance(labels, (tuple, list)):
+            mlm_labels, nsp_labels = labels
+        else:
+            mlm_labels, nsp_labels = labels, None
+        b, s, v = mlm_logits.shape
+        loss = F.cross_entropy(mlm_logits.reshape([b * s, v]),
+                               mlm_labels.reshape([b * s]), ignore_index=-100)
+        if nsp_labels is not None:
+            loss = loss + F.cross_entropy(nsp_logits, nsp_labels)
+        return loss
+
+
+def knowledge_mask(input_ids, spans, mask_token_id, vocab_size, mask_prob=0.15,
+                   rng=None, ignore_index=-100):
+    """ERNIE knowledge masking: mask whole spans (phrases/entities), not tokens.
+
+    input_ids: np.ndarray [b, s]; spans: per-example list of (start, end) spans
+    covering candidate phrase/entity units. A span is masked with prob
+    `mask_prob` — 80% [MASK], 10% random, 10% unchanged, applied to the WHOLE
+    span (the ERNIE-1.0 phrase/entity-level strategy). Returns (masked_ids,
+    labels) with labels == ignore_index at unmasked positions.
+    """
+    if rng is None:
+        rng = np.random  # global RNG: fresh masking every call/epoch
+    ids = np.array(input_ids, copy=True)
+    labels = np.full_like(ids, ignore_index)
+    for b, ex_spans in enumerate(spans):
+        for (start, end) in ex_spans:
+            if rng.rand() >= mask_prob:
+                continue
+            labels[b, start:end] = ids[b, start:end]
+            r = rng.rand()
+            if r < 0.8:
+                ids[b, start:end] = mask_token_id
+            elif r < 0.9:
+                ids[b, start:end] = rng.randint(0, vocab_size, size=end - start)
+            # else: keep original tokens
+    return ids, labels
+
+
+def ernie_base(**kw):
+    return ErnieModel(ErnieConfig.base())

@@ -35,6 +35,9 @@ class GPTConfig:
         self.use_flash = use_flash
         # MoE (num_experts > 0 turns every `moe_every`-th block's MLP into a
         # MoELayer; moe_mesh with an 'ep' axis enables expert parallelism)
+        if num_experts > 0 and not (1 <= moe_every <= num_layers):
+            raise ValueError(f"moe_every={moe_every} must be in [1, num_layers="
+                             f"{num_layers}] when num_experts > 0")
         if num_experts > 0 and tensor_parallel:
             # MoE expert weights are not mp-sharded; combining would silently
             # replicate the dominant parameter mass on every mp rank. Use

@@ -13,7 +13,6 @@ import jax
 
 from ...core.dispatch import apply
 from ...distributed import moe as moe_ops
-from .. import functional as F  # noqa: F401  (activation names)
 from .. import initializer as I
 from .layers import Layer
 

@@ -1,0 +1,26 @@
+"""Quantization toolkit — the fluid/contrib/slim capability family.
+
+Reference parity:
+- imperative QAT: fluid/contrib/slim/quantization/imperative/qat.py
+  (ImperativeQuantAware — wraps Linear/Conv2D with fake-quant of weights+activations)
+- static QAT passes: slim/quantization/quantization_pass.py (QuantizationTransformPass)
+- post-training: slim/quantization/post_training_quantization.py
+- fake-quant ops: operators/fake_quantize_op.cc (abs_max, moving_average_abs_max,
+  channel_wise_abs_max)
+
+TPU-native design: fake quantization is a pure jnp function with a
+straight-through-estimator gradient (x + stop_gradient(q(x) - x)); there is no graph
+pass — QAT is a Layer substitution (QuantedLinear/QuantedConv2D), which jax.jit then
+fuses. Int8 inference export stores real int8 weights + scales; the int8 matmul is an
+XLA dot over int8 with f32 rescale (MXU-native on TPU).
+"""
+from .quant_ops import (  # noqa: F401
+    dequantize,
+    fake_quantize_abs_max,
+    fake_quantize_channel_wise_abs_max,
+    fake_quantize_moving_average_abs_max,
+    quantize_to_int8,
+)
+from .imperative import ImperativeQuantAware, QuantConfig  # noqa: F401
+from .layers import QuantedConv2D, QuantedLinear  # noqa: F401
+from .ptq import PostTrainingQuantization  # noqa: F401

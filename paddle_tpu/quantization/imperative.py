@@ -1,0 +1,67 @@
+"""Imperative quantization-aware training.
+
+Reference parity: fluid/contrib/slim/quantization/imperative/qat.py
+(ImperativeQuantAware.quantize — in-place substitution of quantizable sublayers) with
+the weight/activation quantizer choices of QuantizationTransformPass
+(slim/quantization/quantization_pass.py) reduced to the TPU-relevant pair:
+channel_wise_abs_max weights + moving_average_abs_max activations.
+"""
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv2D
+from ..nn.layer.layers import Layer
+from .layers import QuantedConv2D, QuantedLinear
+
+
+class QuantConfig:
+    """Quantization settings (the knobs of ImperativeQuantAware's ctor)."""
+
+    def __init__(self, weight_bits=8, activation_bits=8, act_moving_rate=0.9,
+                 quantizable_layer_types=("Linear", "Conv2D"), skip_layers=()):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.act_moving_rate = act_moving_rate
+        self.quantizable_layer_types = tuple(quantizable_layer_types)
+        self.skip_layers = set(skip_layers)
+
+
+class ImperativeQuantAware:
+    """Wrap quantizable sublayers of a model with fake-quant QAT layers in place.
+
+    usage:
+        quanter = ImperativeQuantAware()
+        quanter.quantize(model)          # model now trains with fake quant
+        ... train ...
+        quanter.save_quantized_model(model, path, input_spec)  # jit.save
+    """
+
+    def __init__(self, config=None, **kwargs):
+        self.config = config or QuantConfig(**kwargs)
+
+    def _make_quanted(self, layer):
+        cfg = self.config
+        if isinstance(layer, Linear) and "Linear" in cfg.quantizable_layer_types:
+            return QuantedLinear(layer, bits=cfg.weight_bits,
+                                 act_rate=cfg.act_moving_rate)
+        if isinstance(layer, Conv2D) and "Conv2D" in cfg.quantizable_layer_types:
+            return QuantedConv2D(layer, bits=cfg.weight_bits,
+                                 act_rate=cfg.act_moving_rate)
+        return None
+
+    def quantize(self, model):
+        """In-place: replace every quantizable sublayer (skip_layers by name)."""
+        replaced = 0
+        for parent in model.sublayers(include_self=True):
+            for name, child in list(parent._sub_layers.items()):
+                if child is None or name in self.config.skip_layers:
+                    continue
+                q = self._make_quanted(child)
+                if q is not None:
+                    parent._sub_layers[name] = q
+                    replaced += 1
+        return replaced
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from .. import jit
+
+        model.eval()
+        jit.save(model, path, input_spec=input_spec)

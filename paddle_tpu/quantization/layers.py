@@ -1,0 +1,107 @@
+"""Quantization-aware layers: Linear/Conv2D with fake-quant weights + activations.
+
+Reference parity: the QuantizedLinear/QuantizedConv2D wrappers that
+slim/quantization/imperative/qat.py substitutes into the model, backed by the
+fake_quantize_op.cc kernels. Weight quant is channel-wise abs_max; activation quant is
+moving-average abs_max with the running range stored as a Layer buffer (so it rides the
+functional-state path through jit/SpmdTrainer like BatchNorm statistics).
+"""
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+from . import quant_ops as Q
+
+
+class _QuantedBase(Layer):
+    def __init__(self, bits, act_rate):
+        super().__init__()
+        self.bits = bits
+        self.act_rate = act_rate
+        self.register_buffer("act_scale", Tensor(jnp.zeros([], jnp.float32)))
+
+    def _fake_quant_input(self, x):
+        out, new_scale = apply(
+            Q.fake_quantize_moving_average_abs_max, x, self.act_scale,
+            bits=self.bits, rate=self.act_rate, training=self.training)
+        self.act_scale._data = jnp.asarray(new_scale._data)
+        return out
+
+    def _fake_quant_weight(self, w, axis):
+        out, _ = apply(Q.fake_quantize_channel_wise_abs_max, w,
+                       bits=self.bits, axis=axis)
+        return out
+
+
+class QuantedLinear(_QuantedBase):
+    """Linear with fake-quantized input + per-out-channel weight."""
+
+    def __init__(self, layer, bits=8, act_rate=0.9):
+        super().__init__(bits, act_rate)
+        self.weight = layer.weight  # [in, out]; quant per out channel (axis -1)
+        if layer.bias is not None:
+            self.bias = layer.bias
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        xq = self._fake_quant_input(x)
+        wq = self._fake_quant_weight(self.weight, axis=-1)
+        return F.linear(xq, wq, self.bias)
+
+
+class QuantedConv2D(_QuantedBase):
+    """Conv2D with fake-quantized input + per-out-channel weight."""
+
+    def __init__(self, layer, bits=8, act_rate=0.9):
+        super().__init__(bits, act_rate)
+        self.weight = layer.weight  # [out_c, in_c, kh, kw]; quant axis 0
+        if layer.bias is not None:
+            self.bias = layer.bias
+        else:
+            self.bias = None
+        self._stride = layer._stride
+        self._padding = layer._padding
+        self._dilation = layer._dilation
+        self._groups = layer._groups
+        self._data_format = layer._data_format
+
+    def forward(self, x):
+        xq = self._fake_quant_input(x)
+        wq = self._fake_quant_weight(self.weight, axis=0)
+        return F.conv2d(xq, wq, self.bias, self._stride, self._padding,
+                        self._dilation, self._groups, self._data_format)
+
+
+class Int8Linear(Layer):
+    """Inference-only Linear over real int8 weights (PTQ `convert` output).
+
+    The matmul runs int8 x int8 -> int32 on the MXU with a float rescale —
+    the TPU-native analog of the mkldnn int8 kernels the reference converts to
+    (slim/quantization/quant_int8_mkldnn_pass.py).
+    """
+
+    def __init__(self, w_int8, w_scale, bias, act_scale, bits=8):
+        super().__init__()
+        self.register_buffer("w_int8", Tensor(w_int8))
+        self.register_buffer("w_scale", Tensor(w_scale))
+        self.register_buffer("act_scale", Tensor(jnp.asarray(act_scale, jnp.float32)))
+        self.bias = bias
+        self.bits = bits
+
+    def forward(self, x):
+        def fn(v, w_q, w_s, a_s, *b):
+            qmax = 127.0
+            xq = jnp.clip(jnp.round(v / a_s * qmax), -qmax, qmax).astype(jnp.int8)
+            acc = jnp.matmul(xq.astype(jnp.int32), w_q.astype(jnp.int32))
+            out = acc.astype(jnp.float32) * (a_s / qmax) * (w_s.reshape(1, -1) / qmax)
+            if b:
+                out = out + b[0]
+            return out
+
+        args = [x, self.w_int8, self.w_scale, self.act_scale]
+        if self.bias is not None:
+            args.append(self.bias)
+        return apply(fn, *args)

@@ -1,0 +1,120 @@
+"""Post-training quantization.
+
+Reference parity: fluid/contrib/slim/quantization/post_training_quantization.py —
+run calibration batches through the float model collecting activation ranges
+(abs_max or histogram percentile, the reference's 'abs_max'/'hist' algos), then emit a
+model whose Linear layers hold real int8 weights + scales (Int8Linear).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.common import Linear
+from .layers import Int8Linear
+from .quant_ops import quantize_to_int8
+
+
+class _Observer:
+    """Range observer: plain abs_max, or a fixed-size |x| histogram whose range
+    grows by proportional rebinning (memory O(hist_bins) per layer, never the
+    raw activations)."""
+
+    def __init__(self, algo="abs_max", hist_bins=2048, percentile=0.99999):
+        self.algo = algo
+        self.hist_bins = hist_bins
+        self.percentile = percentile
+        self.abs_max = 0.0
+        self._hist = None  # counts over [0, abs_max] in hist_bins bins
+
+    def collect(self, arr):
+        a = np.abs(np.asarray(arr, np.float32)).reshape(-1)
+        cur_max = float(a.max()) if a.size else 0.0
+        if self.algo == "hist" and a.size:
+            new_max = max(self.abs_max, cur_max)
+            if new_max > 0:
+                if self._hist is None:
+                    self._hist = np.zeros(self.hist_bins, np.float64)
+                elif new_max > self.abs_max and self.abs_max > 0:
+                    # stretch old bins into the wider range proportionally
+                    old_edges = np.linspace(0, self.abs_max, self.hist_bins + 1)
+                    centers = (old_edges[:-1] + old_edges[1:]) / 2
+                    idx = np.minimum(
+                        (centers / new_max * self.hist_bins).astype(int),
+                        self.hist_bins - 1)
+                    stretched = np.zeros(self.hist_bins, np.float64)
+                    np.add.at(stretched, idx, self._hist)
+                    self._hist = stretched
+                bins = np.minimum((a / new_max * self.hist_bins).astype(int),
+                                  self.hist_bins - 1)
+                np.add.at(self._hist, bins, 1.0)
+        self.abs_max = max(self.abs_max, cur_max)
+
+    def scale(self):
+        if self.algo == "hist" and self._hist is not None and self._hist.sum() > 0:
+            cdf = np.cumsum(self._hist) / self._hist.sum()
+            bin_idx = int(np.searchsorted(cdf, self.percentile))
+            edge = (bin_idx + 1) / self.hist_bins * self.abs_max
+            return edge or self.abs_max
+        return self.abs_max
+
+
+class PostTrainingQuantization:
+    """Calibrate a float model on sample data, then convert Linears to int8.
+
+    usage:
+        ptq = PostTrainingQuantization(model, algo="abs_max")
+        for batch in calib_loader: ptq.collect(model, batch)   # or ptq.quantize(data)
+        qmodel_stats = ptq.convert(model)                      # in place
+    """
+
+    def __init__(self, model=None, algo="abs_max", skip_layers=()):
+        self.algo = algo
+        self.skip_layers = set(skip_layers)
+        self._observers = {}
+        self._hooks = []
+        if model is not None:
+            self.attach(model)
+
+    def attach(self, model):
+        """Register forward-pre hooks on every Linear to observe input ranges."""
+        for name, layer in model.named_sublayers(include_self=True):
+            if isinstance(layer, Linear) and name not in self.skip_layers:
+                obs = _Observer(self.algo)
+                self._observers[name] = obs
+
+                def hook(l, inputs, _obs=obs):
+                    x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+                    _obs.collect(x._data)
+                    return None
+
+                self._hooks.append(layer.register_forward_pre_hook(hook))
+        return len(self._observers)
+
+    def collect(self, model, *batch):
+        """Run one calibration forward (observers collect via hooks)."""
+        model.eval()
+        return model(*batch)
+
+    def convert(self, model):
+        """Replace observed Linears with Int8Linear (real int8 weights). In place."""
+        converted = 0
+        names = {id(l): n
+                 for n, l in model.named_sublayers(include_self=True)}
+        for parent in model.sublayers(include_self=True):
+            for cname, child in list(parent._sub_layers.items()):
+                if not isinstance(child, Linear):
+                    continue
+                full = names.get(id(child))
+                if full is None:
+                    continue
+                obs = self._observers.get(full)
+                if obs is None or obs.abs_max == 0.0:
+                    continue
+                w_q, w_s = quantize_to_int8(child.weight._data, axis=-1)
+                parent._sub_layers[cname] = Int8Linear(
+                    w_q, jnp.asarray(w_s), child.bias, obs.scale())
+                converted += 1
+        for h in self._hooks:
+            h.remove()
+        self._hooks = []
+        return converted

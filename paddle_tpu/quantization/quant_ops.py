@@ -1,0 +1,64 @@
+"""Fake-quantize ops with straight-through-estimator gradients.
+
+Reference parity: operators/fake_quantize_op.cc — FakeQuantizeAbsMax,
+FakeChannelWiseQuantizeAbsMax, FakeQuantizeMovingAverageAbsMax (the three kernels the
+slim QAT passes insert). The STE is expressed as x + stop_gradient(q(x) - x), which
+XLA folds into the forward while jax.vjp sees identity — no custom grad op needed.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def _qmax(bits):
+    return float(2 ** (bits - 1) - 1)  # 127 for int8
+
+
+def _ste(x, q):
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def fake_quantize_abs_max(x, bits=8):
+    """Per-tensor abs-max fake quant. Returns (quantized_float, scale)."""
+    qmax = _qmax(bits)
+    scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    q = jnp.round(x / scale * qmax) / qmax * scale
+    return _ste(x, q), scale
+
+
+def fake_quantize_channel_wise_abs_max(x, bits=8, axis=-1):
+    """Per-channel (weight) abs-max fake quant along `axis`."""
+    qmax = _qmax(bits)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+    scale = jnp.maximum(jnp.max(jnp.abs(x), axis=reduce_axes, keepdims=True), 1e-8)
+    q = jnp.round(x / scale * qmax) / qmax * scale
+    return _ste(x, q), scale.reshape(-1)
+
+
+def fake_quantize_moving_average_abs_max(x, state_scale, bits=8, rate=0.9,
+                                         training=True):
+    """Activation fake quant with a moving-average abs-max range.
+
+    state_scale: scalar array (the observer state). Returns (q, new_scale).
+    In eval mode the stored scale is used unchanged.
+    """
+    qmax = _qmax(bits)
+    cur = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    if training:
+        new_scale = jnp.where(state_scale > 0, rate * state_scale + (1 - rate) * cur,
+                              cur)
+    else:
+        new_scale = jnp.where(state_scale > 0, state_scale, cur)
+    q = jnp.clip(jnp.round(x / new_scale * qmax), -qmax, qmax) / qmax * new_scale
+    return _ste(x, q), new_scale
+
+
+def quantize_to_int8(w, axis=-1):
+    """Real int8 weight quantization for export. Returns (int8 array, f32 scales)."""
+    reduce_axes = tuple(i for i in range(w.ndim) if i != (axis % w.ndim))
+    scale = jnp.maximum(jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True), 1e-8)
+    q = jnp.clip(jnp.round(w / scale * 127.0), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize(q, scale):
+    return q.astype(jnp.float32) / 127.0 * scale

@@ -1,0 +1,91 @@
+"""ERNIE model family tests (BASELINE.json config #4)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import (
+    ErnieConfig, ErnieForPretraining, ErnieForSequenceClassification,
+    ErniePretrainLoss, knowledge_mask,
+)
+
+
+def _ids(b=2, s=16, v=1024, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randint(0, v, (b, s)).astype(np.int64))
+
+
+class TestErnieModel:
+    def test_pretrain_forward_and_joint_loss(self):
+        paddle.seed(0)
+        cfg = ErnieConfig.tiny()
+        model = ErnieForPretraining(cfg)
+        ids = _ids(v=cfg.vocab_size)
+        mlm_logits, nsp_logits = model(ids)
+        assert tuple(mlm_logits.shape) == (2, 16, cfg.vocab_size)
+        assert tuple(nsp_logits.shape) == (2, 2)
+
+        loss_fn = ErniePretrainLoss()
+        nsp_labels = paddle.to_tensor(np.array([0, 1], np.int64))
+        loss = loss_fn((mlm_logits, nsp_logits), (ids, nsp_labels))
+        loss.backward()
+        g = model.ernie.embeddings.word.weight.grad
+        assert g is not None and np.isfinite(np.asarray(g._data)).all()
+
+    def test_task_type_embedding_ernie2(self):
+        paddle.seed(0)
+        cfg = ErnieConfig.tiny()
+        cfg.task_type_vocab_size = 3
+        model = ErnieForPretraining(cfg)
+        ids = _ids(v=cfg.vocab_size)
+        task = paddle.zeros([2, 16], dtype="int64")
+        seq, pooled = model.ernie(ids, task_type_ids=task)
+        assert tuple(pooled.shape) == (2, cfg.hidden_size)
+
+    def test_sequence_classification_trains(self):
+        paddle.seed(0)
+        cfg = ErnieConfig.tiny()
+        model = ErnieForSequenceClassification(cfg, num_classes=3)
+        model.eval()  # no dropout for determinism
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        ids = _ids(v=cfg.vocab_size)
+        labels = paddle.to_tensor(np.array([0, 2], np.int64))
+        losses = []
+        for _ in range(3):
+            logits = model(ids)
+            loss = paddle.nn.functional.cross_entropy(logits, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss._data)))
+        assert losses[-1] < losses[0]
+
+
+class TestKnowledgeMasking:
+    def test_whole_spans_masked_together(self):
+        rng = np.random.RandomState(0)
+        ids = rng.randint(10, 1000, (4, 32))
+        spans = [[(0, 4), (8, 12), (20, 25)] for _ in range(4)]
+        masked, labels = knowledge_mask(ids, spans, mask_token_id=3,
+                                        vocab_size=1000, mask_prob=1.0,
+                                        rng=np.random.RandomState(1))
+        # every span position has a label; non-span positions have none
+        span_mask = np.zeros_like(ids, bool)
+        for b in range(4):
+            for (s, e) in spans[b]:
+                span_mask[b, s:e] = True
+        assert (labels[span_mask] != -100).all()
+        assert (labels[~span_mask] == -100).all()
+        # spans are atomic: within a masked-to-[MASK] span, all positions change
+        for b in range(4):
+            for (s, e) in spans[b]:
+                seg = masked[b, s:e]
+                if (seg == 3).any():
+                    assert (seg == 3).all()
+
+    def test_mask_prob_zero_is_identity(self):
+        ids = np.arange(64).reshape(2, 32) + 10
+        masked, labels = knowledge_mask(ids, [[(0, 5)], [(3, 8)]],
+                                        mask_token_id=3, vocab_size=100,
+                                        mask_prob=0.0)
+        np.testing.assert_array_equal(masked, ids)
+        assert (labels == -100).all()

@@ -202,3 +202,11 @@ class TestGPTMoE:
         trainer.train_step(ids, ids)
         after = np.asarray(trainer.params[gate_name])
         assert np.abs(after - before).max() > 0, "router got no gradient"
+
+    def test_bad_moe_every_rejected(self):
+        from paddle_tpu.models import GPTConfig
+
+        with pytest.raises(ValueError):
+            GPTConfig(num_experts=4, moe_every=0)
+        with pytest.raises(ValueError):
+            GPTConfig(num_experts=4, num_layers=2, moe_every=3)

@@ -1,0 +1,151 @@
+"""Quantization tests (slim parity: QAT + PTQ + fake-quant ops)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quantization import (
+    ImperativeQuantAware, PostTrainingQuantization, QuantConfig,
+    fake_quantize_abs_max, fake_quantize_channel_wise_abs_max,
+    fake_quantize_moving_average_abs_max, quantize_to_int8,
+)
+from paddle_tpu.quantization.layers import Int8Linear, QuantedConv2D, QuantedLinear
+
+
+class TestFakeQuantOps:
+    def test_abs_max_error_bound_and_ste(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(64, 32).astype(np.float32))
+        q, scale = fake_quantize_abs_max(x)
+        assert float(scale) == float(jnp.max(jnp.abs(x)))
+        # max quantization error <= scale/127/2 (round-to-nearest)
+        assert float(jnp.max(jnp.abs(q - x))) <= float(scale) / 127 / 2 + 1e-6
+        # straight-through: gradient of sum(q) w.r.t. x is all-ones
+        g = jax.grad(lambda v: jnp.sum(fake_quantize_abs_max(v)[0]))(x)
+        np.testing.assert_allclose(np.asarray(g), np.ones_like(np.asarray(g)))
+
+    def test_channel_wise_scales(self):
+        x = jnp.stack([jnp.ones((8,)) * 1.0, jnp.ones((8,)) * 4.0], axis=1)  # [8,2]
+        q, scales = fake_quantize_channel_wise_abs_max(x, axis=-1)
+        np.testing.assert_allclose(np.asarray(scales), [1.0, 4.0])
+        np.testing.assert_allclose(np.asarray(q), np.asarray(x), atol=1e-6)
+
+    def test_moving_average_updates(self):
+        x1 = jnp.ones((4,)) * 2.0
+        s0 = jnp.zeros([])
+        _, s1 = fake_quantize_moving_average_abs_max(x1, s0, training=True)
+        assert float(s1) == 2.0  # first step adopts current max
+        x2 = jnp.ones((4,)) * 4.0
+        _, s2 = fake_quantize_moving_average_abs_max(x2, s1, rate=0.9, training=True)
+        np.testing.assert_allclose(float(s2), 0.9 * 2.0 + 0.1 * 4.0, rtol=1e-6)
+        # eval mode keeps the stored scale
+        _, s3 = fake_quantize_moving_average_abs_max(x2, s2, training=False)
+        np.testing.assert_allclose(float(s3), float(s2), rtol=1e-6)
+
+    def test_int8_roundtrip(self):
+        rng = np.random.RandomState(1)
+        w = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+        q, s = quantize_to_int8(w, axis=-1)
+        assert q.dtype == jnp.int8
+        back = np.asarray(q, np.float32) / 127.0 * np.asarray(s)
+        np.testing.assert_allclose(back, np.asarray(w), atol=float(s.max()) / 127)
+
+
+class TestQAT:
+    def _mlp(self):
+        class MLP(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(16, 32)
+                self.fc2 = nn.Linear(32, 4)
+
+            def forward(self, x):
+                return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+        return MLP()
+
+    def test_quantize_replaces_layers_and_trains(self):
+        paddle.seed(0)
+        model = self._mlp()
+        n = ImperativeQuantAware().quantize(model)
+        assert n == 2
+        assert isinstance(model.fc1, QuantedLinear)
+        assert isinstance(model.fc2, QuantedLinear)
+
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=model.parameters())
+        x = paddle.randn([8, 16])
+        y = paddle.to_tensor(np.random.RandomState(0).randint(0, 4, (8,)))
+        losses = []
+        for _ in range(5):
+            loss = paddle.nn.functional.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss._data)))
+        assert losses[-1] < losses[0]
+        # observer ran: activation scale is positive
+        assert float(np.asarray(model.fc1.act_scale._data)) > 0
+
+    def test_conv_quantization_on_lenet(self):
+        from paddle_tpu.vision.models import LeNet
+
+        paddle.seed(0)
+        model = LeNet()
+        n = ImperativeQuantAware(config=QuantConfig()).quantize(model)
+        assert n >= 3  # 2 convs + linears
+        x = paddle.randn([2, 1, 28, 28])
+        out = model(x)
+        assert tuple(out.shape)[0] == 2
+        quanted = [l for l in model.sublayers()
+                   if isinstance(l, (QuantedConv2D, QuantedLinear))]
+        assert len(quanted) == n
+
+    def test_skip_layers(self):
+        model = self._mlp()
+        n = ImperativeQuantAware(skip_layers=("fc2",)).quantize(model)
+        assert n == 1
+        assert isinstance(model.fc1, QuantedLinear)
+        assert isinstance(model.fc2, nn.Linear)
+
+
+class TestPTQ:
+    def test_calibrate_convert_accuracy(self):
+        paddle.seed(0)
+
+        class MLP(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(16, 64)
+                self.fc2 = nn.Linear(64, 8)
+
+            def forward(self, x):
+                return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+        model = MLP()
+        model.eval()
+        rng = np.random.RandomState(0)
+        calib = [paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+                 for _ in range(4)]
+        ref = np.asarray(model(calib[0])._data)
+
+        ptq = PostTrainingQuantization(model, algo="abs_max")
+        for b in calib:
+            ptq.collect(model, b)
+        n = ptq.convert(model)
+        assert n == 2
+        assert isinstance(model.fc1, Int8Linear)
+
+        out = np.asarray(model(calib[0])._data)
+        # int8 sim should stay close to float (scale-bounded error)
+        rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
+        assert rel < 0.05, f"int8 rel err {rel}"
+
+    def test_hist_algo_percentile_scale(self):
+        from paddle_tpu.quantization.ptq import _Observer
+
+        obs = _Observer(algo="hist", percentile=0.5)
+        obs.collect(np.linspace(-1, 1, 1001))
+        assert 0.4 < obs.scale() < 0.6  # median of |x| ~ 0.5
+        assert obs.abs_max == 1.0
