@@ -1,0 +1,250 @@
+"""PP-YOLOE-style anchor-free detector (BASELINE.json config #5: mixed conv +
+NMS custom ops via Pallas).
+
+The reference tree ships the detection *operators* (paddle/fluid/operators/detection/:
+yolo_box_op.cc, multiclass_nms_op.cc, prior_box, roi_align …) but no detection model —
+model zoos live in PaddleDetection. This is the framework's own compact PP-YOLOE-class
+model exercising those ops end-to-end on TPU: CSP backbone (conv+BN+SiLU), PAN-lite
+neck, decoupled anchor-free head with per-level objectness/class/box branches, decode +
+multiclass NMS (vision/ops.py, Pallas greedy kernel on TPU) postprocessing, and a
+trainable varifocal+GIoU-style loss.
+
+Layout is NCHW to match the reference detection ops' convention.
+"""
+import numpy as np
+
+from ... import nn
+from ...nn import functional as F
+
+
+class ConvBNLayer(nn.Layer):
+    def __init__(self, ch_in, ch_out, k=3, stride=1, groups=1, act="silu"):
+        super().__init__()
+        self.conv = nn.Conv2D(ch_in, ch_out, k, stride=stride, padding=k // 2,
+                              groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(ch_out)
+        self.act = act
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return getattr(F, self.act)(x) if self.act else x
+
+
+class CSPBlock(nn.Layer):
+    """Cross-stage-partial block: split, residual bottlenecks, merge."""
+
+    def __init__(self, ch, n_bottlenecks=1):
+        super().__init__()
+        mid = ch // 2
+        self.left = ConvBNLayer(ch, mid, k=1)
+        self.right = ConvBNLayer(ch, mid, k=1)
+        self.blocks = nn.LayerList([
+            nn.Sequential(ConvBNLayer(mid, mid, k=1), ConvBNLayer(mid, mid, k=3))
+            for _ in range(n_bottlenecks)
+        ])
+        self.merge = ConvBNLayer(2 * mid, ch, k=1)
+
+    def forward(self, x):
+        left = self.left(x)
+        y = self.right(x)
+        for blk in self.blocks:
+            y = y + blk(y)
+        from ...tensor.manipulation import concat
+
+        return self.merge(concat([left, y], axis=1))
+
+
+class CSPBackbone(nn.Layer):
+    """Stages at strides 8/16/32 -> feature pyramid [C3, C4, C5]."""
+
+    def __init__(self, width=32, depth=1):
+        super().__init__()
+        w = width
+        self.stem = nn.Sequential(
+            ConvBNLayer(3, w, k=3, stride=2),
+            ConvBNLayer(w, w, k=3, stride=2),
+        )
+        self.stages = nn.LayerList()
+        chs = [w, 2 * w, 4 * w, 8 * w]
+        for i in range(3):
+            self.stages.append(nn.Sequential(
+                ConvBNLayer(chs[i], chs[i + 1], k=3, stride=2),
+                CSPBlock(chs[i + 1], depth),
+            ))
+        self.out_channels = chs[1:]
+
+    def forward(self, x):
+        x = self.stem(x)
+        feats = []
+        for stage in self.stages:
+            x = stage(x)
+            feats.append(x)
+        return feats  # strides 8, 16, 32
+
+
+class PANNeck(nn.Layer):
+    """Top-down feature fusion (PAN-lite: upsample + lateral 1x1 + CSP merge)."""
+
+    def __init__(self, in_channels):
+        super().__init__()
+        c3, c4, c5 = in_channels
+        self.lat5 = ConvBNLayer(c5, c4, k=1)
+        self.merge4 = CSPBlock(c4)
+        self.lat4 = ConvBNLayer(c4, c3, k=1)
+        self.merge3 = CSPBlock(c3)
+        self.out_channels = [c3, c4, c5]
+
+    def forward(self, feats):
+        c3, c4, c5 = feats
+        p5 = c5
+        up5 = F.interpolate(self.lat5(p5), scale_factor=2, mode="nearest",
+                            data_format="NCHW")
+        p4 = self.merge4(c4 + up5)
+        up4 = F.interpolate(self.lat4(p4), scale_factor=2, mode="nearest",
+                            data_format="NCHW")
+        p3 = self.merge3(c3 + up4)
+        return [p3, p4, p5]
+
+
+class PPYOLOEHead(nn.Layer):
+    """Decoupled anchor-free head: per level, cls logits [B,C,H,W] and box
+    ltrb distances [B,4,H,W] (distance-from-point regression, PP-YOLOE style)."""
+
+    def __init__(self, in_channels, num_classes=80):
+        super().__init__()
+        self.num_classes = num_classes
+        self.cls_convs = nn.LayerList()
+        self.reg_convs = nn.LayerList()
+        self.cls_preds = nn.LayerList()
+        self.reg_preds = nn.LayerList()
+        for ch in in_channels:
+            self.cls_convs.append(ConvBNLayer(ch, ch, k=3))
+            self.reg_convs.append(ConvBNLayer(ch, ch, k=3))
+            self.cls_preds.append(nn.Conv2D(ch, num_classes, 1))
+            self.reg_preds.append(nn.Conv2D(ch, 4, 1))
+
+    def forward(self, feats):
+        outs = []
+        for i, x in enumerate(feats):
+            cls = self.cls_preds[i](self.cls_convs[i](x))
+            reg = self.reg_preds[i](self.reg_convs[i](x))
+            outs.append((cls, reg))
+        return outs
+
+
+class PPYOLOE(nn.Layer):
+    """Compact PP-YOLOE-class detector. strides (8, 16, 32)."""
+
+    def __init__(self, num_classes=80, width=32, depth=1):
+        super().__init__()
+        self.backbone = CSPBackbone(width, depth)
+        self.neck = PANNeck(self.backbone.out_channels)
+        self.head = PPYOLOEHead(self.neck.out_channels, num_classes)
+        self.num_classes = num_classes
+        self.strides = (8, 16, 32)
+
+    def forward(self, images):
+        return self.head(self.neck(self.backbone(images)))
+
+    # ---- decode / postprocess ------------------------------------------------
+    def decode(self, head_outs):
+        """-> (boxes [B, A, 4] xyxy in pixels, scores [B, num_classes, A])."""
+        from ...tensor.manipulation import concat
+
+        all_boxes, all_scores = [], []
+        import jax
+        import jax.numpy as jnp
+
+        from ...core.dispatch import apply
+
+        for (cls, reg), stride in zip(head_outs, self.strides):
+            b, c, h, w = cls.shape
+
+            def fn(cls_v, reg_v, _stride=stride, _h=h, _w=w):
+                ys = (jnp.arange(_h, dtype=jnp.float32) + 0.5) * _stride
+                xs = (jnp.arange(_w, dtype=jnp.float32) + 0.5) * _stride
+                cy, cx = jnp.meshgrid(ys, xs, indexing="ij")
+                # ltrb distances are kept positive via softplus
+                l, t, r, btm = [reg_v[:, i] * _stride for i in range(4)]
+                x1 = cx[None] - jax.nn.softplus(l)
+                y1 = cy[None] - jax.nn.softplus(t)
+                x2 = cx[None] + jax.nn.softplus(r)
+                y2 = cy[None] + jax.nn.softplus(btm)
+                boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(
+                    cls_v.shape[0], _h * _w, 4)
+                scores = jax.nn.sigmoid(cls_v).reshape(
+                    cls_v.shape[0], cls_v.shape[1], _h * _w)
+                return boxes, scores
+
+            boxes, scores = apply(fn, cls, reg, n_outputs=2)
+            all_boxes.append(boxes)
+            all_scores.append(scores)
+        return concat(all_boxes, axis=1), concat(all_scores, axis=2)
+
+    def postprocess(self, head_outs, score_threshold=0.05, nms_threshold=0.5,
+                    keep_top_k=100):
+        """Full inference tail: decode + per-class NMS (Pallas kernel on TPU)."""
+        from ..ops import multiclass_nms
+
+        boxes, scores = self.decode(head_outs)
+        # anchor-free sigmoid scores: every class is foreground (no background
+        # column), so disable multiclass_nms's background skip
+        return multiclass_nms(boxes, scores, score_threshold=score_threshold,
+                              nms_threshold=nms_threshold, keep_top_k=keep_top_k,
+                              background_label=-1)
+
+
+class PPYOLOELoss(nn.Layer):
+    """Simplified PP-YOLOE training loss over decoded predictions.
+
+    targets: (gt_boxes [B, A, 4] per-anchor assigned boxes, gt_labels [B, A]
+    with num_classes = background). Classification = focal BCE on assigned
+    anchors; regression = GIoU-style IoU loss on positive anchors. A full
+    TOOD/ATSS assigner belongs in a detection library; the per-anchor-target
+    interface matches what such an assigner emits.
+    """
+
+    def __init__(self, num_classes=80, cls_weight=1.0, iou_weight=2.5):
+        super().__init__()
+        self.num_classes = num_classes
+        self.cls_weight = cls_weight
+        self.iou_weight = iou_weight
+
+    def forward(self, decoded, targets):
+        import jax
+        import jax.numpy as jnp
+
+        from ...core.dispatch import apply
+
+        boxes, scores = decoded
+        gt_boxes, gt_labels = targets
+        C = self.num_classes
+
+        def fn(boxes_v, scores_v, gt_b, gt_l):
+            pos = (gt_l < C)  # [B, A]
+            onehot = jax.nn.one_hot(gt_l, C + 1)[..., :C]  # bg -> all-zero
+            logits = jnp.moveaxis(scores_v, 1, 2)  # [B, A, C], already sigmoided
+            p = jnp.clip(logits, 1e-6, 1 - 1e-6)
+            focal = -(onehot * (1 - p) ** 2 * jnp.log(p)
+                      + (1 - onehot) * p ** 2 * jnp.log(1 - p))
+            cls_loss = focal.sum() / jnp.maximum(pos.sum(), 1)
+
+            # IoU loss on positives
+            ix1 = jnp.maximum(boxes_v[..., 0], gt_b[..., 0])
+            iy1 = jnp.maximum(boxes_v[..., 1], gt_b[..., 1])
+            ix2 = jnp.minimum(boxes_v[..., 2], gt_b[..., 2])
+            iy2 = jnp.minimum(boxes_v[..., 3], gt_b[..., 3])
+            inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+            area_p = jnp.maximum(boxes_v[..., 2] - boxes_v[..., 0], 0) * \
+                jnp.maximum(boxes_v[..., 3] - boxes_v[..., 1], 0)
+            area_g = jnp.maximum(gt_b[..., 2] - gt_b[..., 0], 0) * \
+                jnp.maximum(gt_b[..., 3] - gt_b[..., 1], 0)
+            iou = inter / jnp.maximum(area_p + area_g - inter, 1e-9)
+            iou_loss = ((1 - iou) * pos).sum() / jnp.maximum(pos.sum(), 1)
+            return self.cls_weight * cls_loss + self.iou_weight * iou_loss
+
+        return apply(fn, boxes, scores, gt_boxes, gt_labels)
+
+
+def ppyoloe_tiny(num_classes=80):
+    return PPYOLOE(num_classes=num_classes, width=16, depth=1)

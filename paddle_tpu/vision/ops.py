@@ -29,10 +29,24 @@ def _iou_matrix(boxes):
     return inter / jnp.maximum(union, 1e-9)
 
 
-def nms_mask(boxes, scores, iou_threshold=0.3, score_threshold=None, top_k=None):
-    """Pure static-shape NMS: returns keep mask [n] (sequential suppression via scan)."""
+def nms_mask(boxes, scores, iou_threshold=3e-1, score_threshold=None, top_k=None,
+             use_pallas=None):
+    """Pure static-shape NMS: returns keep mask [n].
+
+    On TPU the greedy sweep runs as a single-VMEM Pallas kernel
+    (ops/nms_pallas.py); elsewhere (or when `use_pallas=False`) it is a
+    lax.scan over the precomputed IoU matrix."""
+    from ..ops import nms_pallas as _np_kernel
+
     n = boxes.shape[0]
     order = jnp.argsort(-scores)
+    if use_pallas is None:
+        use_pallas = _np_kernel.supported(n)
+    if use_pallas:
+        keep_sorted_full = _np_kernel.nms_keep_mask_pallas(
+            boxes[order], iou_threshold)
+        keep = jnp.zeros(n, dtype=bool).at[order].set(keep_sorted_full)
+        return _nms_mask_filters(keep, scores, score_threshold, top_k, order, n)
     iou = _iou_matrix(boxes)
     iou_sorted = iou[order][:, order]
 
@@ -45,8 +59,15 @@ def nms_mask(boxes, scores, iou_threshold=0.3, score_threshold=None, top_k=None)
     keep0 = jnp.zeros(n, dtype=bool).at[0].set(True)
     keep_sorted, _ = jax.lax.scan(body, keep0, jnp.arange(1, n))
     keep = jnp.zeros(n, dtype=bool).at[order].set(keep_sorted)
+    return _nms_mask_filters(keep, scores, score_threshold, top_k, order, n)
+
+
+def _nms_mask_filters(keep, scores, score_threshold, top_k, order, n):
     if score_threshold is not None:
         keep = keep & (scores > score_threshold)
+    if top_k is not None:
+        rank = jnp.zeros(n, jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+        keep = keep & (rank < top_k)
     return keep
 
 

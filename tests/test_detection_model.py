@@ -1,0 +1,153 @@
+"""PP-YOLOE-style detector + Pallas NMS kernel tests (BASELINE config #5)."""
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.nms_pallas import nms_keep_mask_pallas
+from paddle_tpu.vision.models import PPYOLOE, PPYOLOELoss, ppyoloe_tiny
+from paddle_tpu.vision.ops import nms_mask
+
+
+def _greedy_nms_ref(boxes, thresh):
+    """Numpy greedy NMS on score-desc-sorted boxes."""
+    n = len(boxes)
+    keep = np.ones(n, bool)
+    for i in range(n):
+        if not keep[i]:
+            continue
+        for j in range(i + 1, n):
+            if not keep[j]:
+                continue
+            ix1 = max(boxes[i, 0], boxes[j, 0])
+            iy1 = max(boxes[i, 1], boxes[j, 1])
+            ix2 = min(boxes[i, 2], boxes[j, 2])
+            iy2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+            a_i = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            a_j = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            iou = inter / max(a_i + a_j - inter, 1e-9)
+            if iou > thresh:
+                keep[j] = False
+    return keep
+
+
+class TestPallasNMS:
+    def _rand_boxes(self, n, seed=0):
+        rng = np.random.RandomState(seed)
+        xy = rng.rand(n, 2) * 100
+        wh = rng.rand(n, 2) * 30 + 1
+        return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+    def test_matches_greedy_reference_interpret(self):
+        for seed in (0, 1, 2):
+            boxes = self._rand_boxes(100, seed)
+            keep = np.asarray(nms_keep_mask_pallas(jnp.asarray(boxes), 0.5,
+                                                   interpret=True))
+            ref = _greedy_nms_ref(boxes, 0.5)
+            np.testing.assert_array_equal(keep, ref)
+
+    def test_matches_xla_scan_path(self):
+        boxes = self._rand_boxes(64, seed=3)
+        scores = np.random.RandomState(4).rand(64).astype(np.float32)
+        order = np.argsort(-scores)
+        keep_pallas_sorted = np.asarray(nms_keep_mask_pallas(
+            jnp.asarray(boxes[order]), 0.4, interpret=True))
+        keep_pallas = np.zeros(64, bool)
+        keep_pallas[order] = keep_pallas_sorted
+        keep_xla = np.asarray(nms_mask(jnp.asarray(boxes), jnp.asarray(scores),
+                                       0.4, use_pallas=False))
+        np.testing.assert_array_equal(keep_pallas, keep_xla)
+
+    def test_padding_boxes_never_suppress(self):
+        # 3 boxes -> padded to 128; pads are zero-area and must not interfere
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                         np.float32)
+        keep = np.asarray(nms_keep_mask_pallas(jnp.asarray(boxes), 0.5,
+                                               interpret=True))
+        np.testing.assert_array_equal(keep, [True, False, True])
+
+
+class TestPPYOLOE:
+    def test_forward_shapes(self):
+        paddle.seed(0)
+        model = ppyoloe_tiny(num_classes=4)
+        model.eval()
+        x = paddle.randn([1, 3, 64, 64])
+        outs = model(x)
+        assert len(outs) == 3
+        for (cls, reg), stride in zip(outs, model.strides):
+            assert tuple(cls.shape) == (1, 4, 64 // stride, 64 // stride)
+            assert tuple(reg.shape) == (1, 4, 64 // stride, 64 // stride)
+
+    def test_decode_boxes_valid(self):
+        paddle.seed(0)
+        model = ppyoloe_tiny(num_classes=4)
+        model.eval()
+        outs = model(paddle.randn([2, 3, 64, 64]))
+        boxes, scores = model.decode(outs)
+        A = sum((64 // s) ** 2 for s in model.strides)
+        assert tuple(boxes.shape) == (2, A, 4)
+        assert tuple(scores.shape) == (2, 4, A)
+        b = np.asarray(boxes._data)
+        assert (b[..., 2] >= b[..., 0]).all() and (b[..., 3] >= b[..., 1]).all()
+        s = np.asarray(scores._data)
+        assert (s >= 0).all() and (s <= 1).all()
+
+    def test_postprocess_returns_detections(self):
+        paddle.seed(0)
+        model = ppyoloe_tiny(num_classes=4)
+        model.eval()
+        outs = model(paddle.randn([1, 3, 64, 64]))
+        res = model.postprocess(outs, score_threshold=0.0, keep_top_k=10)
+        # multiclass_nms returns (out [N, keep_top_k, 6], valid counts)
+        out, counts = res if isinstance(res, tuple) else (res, None)
+        assert tuple(out.shape)[0] == 1
+
+    def test_loss_trains(self):
+        paddle.seed(0)
+        model = ppyoloe_tiny(num_classes=4)
+        model.eval()  # freeze BN stats for a deterministic descent check
+        loss_fn = PPYOLOELoss(num_classes=4)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        x = paddle.randn([1, 3, 64, 64])
+        A = sum((64 // s) ** 2 for s in model.strides)
+        rng = np.random.RandomState(0)
+        gt_boxes = paddle.to_tensor(rng.rand(1, A, 4).astype(np.float32) * 64)
+        labels = rng.randint(0, 5, (1, A))  # 4 == background
+        gt_labels = paddle.to_tensor(labels.astype(np.int64))
+        losses = []
+        for _ in range(3):
+            decoded = model.decode(model(x))
+            loss = loss_fn(decoded, (gt_boxes, gt_labels))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss._data)))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+
+class TestNMSMaskFilters:
+    def test_top_k_caps_kept_boxes(self):
+        rng = np.random.RandomState(5)
+        boxes = np.concatenate([rng.rand(50, 2) * 500,
+                                rng.rand(50, 2) * 20 + 500], axis=1)
+        scores = rng.rand(50).astype(np.float32)
+        keep = np.asarray(nms_mask(jnp.asarray(boxes.astype(np.float32)),
+                                   jnp.asarray(scores), 0.99, top_k=5,
+                                   use_pallas=False))
+        assert keep.sum() <= 5
+        # the kept ones are the top-scored survivors
+        assert set(np.nonzero(keep)[0]) <= set(np.argsort(-scores)[:5])
+
+    def test_class0_detections_survive_postprocess(self):
+        """Regression: background_label default must not eat class 0."""
+        paddle.seed(0)
+        model = ppyoloe_tiny(num_classes=2)
+        model.eval()
+        outs = model(paddle.randn([1, 3, 64, 64]))
+        out, counts = model.postprocess(outs, score_threshold=0.0, keep_top_k=50)
+        labels = np.asarray(out._data)[0, :, 0]
+        valid = int(np.asarray(counts._data)[0])
+        assert (labels[:valid] == 0).any(), "class-0 detections were dropped"
