@@ -1,0 +1,138 @@
+"""ctypes binding for the native C++ sparse PS table (native/sparse_table.cc).
+
+Reference parity: paddle/fluid/distributed/table/common_sparse_table.cc via the
+same build-on-first-use pattern as io/multislot.py (no pybind11 in the image).
+Drop-in for tables.SparseTable: pull/push/size plus save/load snapshots.
+"""
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native",
+                    "sparse_table.cc")
+_SO = os.path.join(os.path.dirname(__file__), "..", "..", "native",
+                   "_sparse_table.so")
+
+_OPT_IDS = {"sum": 0, "sgd": 1, "adagrad": 2, "adam": 3}
+
+
+def _load_lib():
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is False:  # negative cache: build already failed this session
+            raise RuntimeError("native sparse table build failed previously")
+        if _LIB is not None:
+            return _LIB
+        src = os.path.abspath(_SRC)
+        so = os.path.abspath(_SO)
+        try:
+            if (not os.path.exists(so)
+                    or os.path.getmtime(so) < os.path.getmtime(src)):
+                subprocess.run(
+                    ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread",
+                     "-o", so, src],
+                    check=True, capture_output=True,
+                )
+        except (OSError, subprocess.CalledProcessError):
+            _LIB = False
+            raise
+        lib = ctypes.CDLL(so)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        lib.pst_create.restype = ctypes.c_void_p
+        lib.pst_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_float,
+                                   ctypes.c_float, ctypes.c_uint64]
+        lib.pst_destroy.argtypes = [ctypes.c_void_p]
+        lib.pst_size.restype = ctypes.c_int64
+        lib.pst_size.argtypes = [ctypes.c_void_p]
+        lib.pst_pull.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64, f32p]
+        lib.pst_get_rows.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64, f32p]
+        lib.pst_push.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64, f32p]
+        lib.pst_keys.argtypes = [ctypes.c_void_p, i64p]
+        lib.pst_save.restype = ctypes.c_int
+        lib.pst_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pst_load.restype = ctypes.c_int
+        lib.pst_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        _LIB = lib
+        return lib
+
+
+def available():
+    try:
+        _load_lib()
+        return True
+    except Exception:
+        return False
+
+
+def _i64p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f32p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class NativeSparseTable:
+    """SparseTable-compatible facade over the C++ engine."""
+
+    def __init__(self, dim, optimizer="sgd", lr=0.01, initializer="uniform",
+                 init_scale=0.01, seed=0):
+        if optimizer not in _OPT_IDS:
+            raise ValueError(f"unknown PS optimizer rule: {optimizer}")
+        self.dim = int(dim)
+        self._lib = _load_lib()
+        scale = 0.0 if initializer == "zeros" else float(init_scale)
+        self._h = self._lib.pst_create(self.dim, _OPT_IDS[optimizer],
+                                       float(lr), scale, int(seed))
+        self._destroy = self._lib.pst_destroy  # survive interpreter teardown
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._destroy(h)
+            self._h = None
+
+    def pull(self, ids):
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).ravel())
+        out = np.empty((len(ids), self.dim), np.float32)
+        self._lib.pst_pull(self._h, _i64p(ids), len(ids), _f32p(out))
+        return out
+
+    def get_rows(self, ids):
+        """Lookup without init-on-miss (missing rows read as zeros)."""
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).ravel())
+        out = np.empty((len(ids), self.dim), np.float32)
+        self._lib.pst_get_rows(self._h, _i64p(ids), len(ids), _f32p(out))
+        return out
+
+    def push(self, ids, grads):
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).ravel())
+        grads = np.ascontiguousarray(
+            np.asarray(grads, np.float32).reshape(len(ids), self.dim))
+        self._lib.pst_push(self._h, _i64p(ids), len(ids), _f32p(grads))
+
+    def size(self):
+        return int(self._lib.pst_size(self._h))
+
+    def keys(self):
+        n = self.size()
+        out = np.empty(n, np.int64)
+        if n:
+            self._lib.pst_keys(self._h, _i64p(out))
+        return out
+
+    def save(self, path):
+        rc = self._lib.pst_save(self._h, str(path).encode())
+        if rc != 0:
+            raise IOError(f"pst_save({path}) failed: {rc}")
+
+    def load(self, path):
+        rc = self._lib.pst_load(self._h, str(path).encode())
+        if rc != 0:
+            raise IOError(f"pst_load({path}) failed: {rc}")

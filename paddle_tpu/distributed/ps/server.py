@@ -57,7 +57,10 @@ class PsServer:
         self._tables.setdefault(int(table_id), DenseTable(shape, optimizer, lr, init))
 
     def create_sparse_table(self, table_id, dim, optimizer="sgd", lr=0.01, **kw):
-        self._tables.setdefault(int(table_id), SparseTable(dim, optimizer, lr, **kw))
+        from .tables import make_sparse_table
+
+        self._tables.setdefault(int(table_id),
+                                make_sparse_table(dim, optimizer, lr, **kw))
 
     def create_geo_table(self, table_id, dim, **kw):
         self._tables.setdefault(int(table_id), GeoSparseTable(dim, self._worker_num, **kw))

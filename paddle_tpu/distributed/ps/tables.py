@@ -203,3 +203,20 @@ class TensorTable:
     def get(self, name):
         with self._lock:
             return self._store.get(name)
+
+
+def make_sparse_table(dim, optimizer="sgd", lr=0.01, backend="auto", **kw):
+    """Factory: native C++ engine (native/sparse_table.cc) when it builds,
+    Python fallback otherwise. backend: 'auto' | 'native' | 'python'."""
+    if optimizer not in ("sum", "sgd", "adagrad", "adam"):
+        raise ValueError(f"unknown PS optimizer rule: {optimizer}")
+    if backend in ("auto", "native"):
+        from . import native_table
+
+        # available() negative-caches a failed g++ build, so auto mode never
+        # re-spawns the compiler per table inside an RPC handler
+        if native_table.available():
+            return native_table.NativeSparseTable(dim, optimizer, lr, **kw)
+        if backend == "native":
+            raise RuntimeError("native sparse table backend failed to build")
+    return SparseTable(dim, optimizer, lr, **kw)

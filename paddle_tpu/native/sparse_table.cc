@@ -1,0 +1,329 @@
+// Sparse parameter-server table — native C++ engine.
+//
+// Reference parity: paddle/fluid/distributed/table/common_sparse_table.cc
+// (auto-growing id -> row store with fill-on-miss initialization) with the
+// server-side optimizer rules of table/depends/sparse.h (sum/sgd/adagrad/adam
+// applied where the parameters live, so workers ship gradients, not weights).
+//
+// TPU-native design: the PS tier is host-side by construction (SURVEY.md §2.3 —
+// embedding tables larger than HBM live on hosts; only pulled rows enter device
+// memory), so this is plain C++ — an open-addressing hash (linear probing,
+// power-of-two capacity) over one contiguous row pool:
+//     row layout = [dim value floats][slot floats (adagrad: dim; adam: 2*dim+2)]
+// Batch pull/push loop in C++ at -O3; duplicate ids within a push merge first
+// (the reference merges by id before applying the rule). Row init is a
+// per-id-seeded xorshift uniform so values are deterministic regardless of
+// insertion order or thread count.
+//
+// extern "C" API (ctypes-consumed; no pybind11 in the image):
+//   pst_create(dim, opt_id, lr, init_scale, seed)   -> handle
+//      opt_id: 0=sum 1=sgd 2=adagrad 3=adam
+//   pst_pull(h, ids, n, out)                        out: [n, dim] f32
+//   pst_push(h, ids, n, grads)                      grads: [n, dim] f32
+//   pst_size(h)                                     -> row count
+//   pst_keys(h, out_ids)                            fills all ids (size() int64)
+//   pst_get_rows(h, ids, n, out)                    pull without init-on-miss
+//                                                   (missing rows -> zeros)
+//   pst_save(h, path) / pst_load(h, path)           binary snapshot
+//   pst_destroy(h)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cmath>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum Opt { OPT_SUM = 0, OPT_SGD = 1, OPT_ADAGRAD = 2, OPT_ADAM = 3 };
+
+struct Table {
+  int dim;
+  int opt;
+  float lr;
+  float init_scale;
+  uint64_t seed;
+  int row_stride;   // dim + slot floats
+  // open addressing: buckets hold index+1 into rows (0 = empty)
+  std::vector<uint64_t> bucket_key;
+  std::vector<uint32_t> bucket_val;
+  std::vector<float> rows;       // row-major pool, row_stride per row
+  std::vector<uint64_t> ids;     // rowIdx -> id
+  size_t count = 0;
+  std::mutex mu;
+
+  int slot_floats() const {
+    switch (opt) {
+      case OPT_ADAGRAD: return dim;
+      case OPT_ADAM: return 2 * dim + 2;
+      default: return 0;
+    }
+  }
+};
+
+inline uint64_t mix(uint64_t x) {
+  x ^= x >> 33; x *= 0xff51afd7ed558ccdULL;
+  x ^= x >> 33; x *= 0xc4ceb9fe1a85ec53ULL;
+  x ^= x >> 33; return x;
+}
+
+void grow(Table* t);
+
+uint32_t find_or_insert(Table* t, uint64_t key, bool* inserted) {
+  if ((t->count + 1) * 10 > t->bucket_key.size() * 7) grow(t);
+  size_t mask = t->bucket_key.size() - 1;
+  size_t i = mix(key) & mask;
+  while (true) {
+    if (t->bucket_val[i] == 0) {
+      uint32_t idx = static_cast<uint32_t>(t->count++);
+      t->bucket_key[i] = key;
+      t->bucket_val[i] = idx + 1;
+      *inserted = true;
+      return idx;
+    }
+    if (t->bucket_key[i] == key) {
+      *inserted = false;
+      return t->bucket_val[i] - 1;
+    }
+    i = (i + 1) & mask;
+  }
+}
+
+// lookup only; returns UINT32_MAX when absent
+uint32_t find(const Table* t, uint64_t key) {
+  size_t mask = t->bucket_key.size() - 1;
+  size_t i = mix(key) & mask;
+  while (true) {
+    if (t->bucket_val[i] == 0) return UINT32_MAX;
+    if (t->bucket_key[i] == key) return t->bucket_val[i] - 1;
+    i = (i + 1) & mask;
+  }
+}
+
+void grow(Table* t) {
+  size_t ncap = t->bucket_key.size() * 2;
+  std::vector<uint64_t> nk(ncap, 0);
+  std::vector<uint32_t> nv(ncap, 0);
+  size_t mask = ncap - 1;
+  for (size_t i = 0; i < t->bucket_key.size(); ++i) {
+    if (t->bucket_val[i] == 0) continue;
+    size_t j = mix(t->bucket_key[i]) & mask;
+    while (nv[j] != 0) j = (j + 1) & mask;
+    nk[j] = t->bucket_key[i];
+    nv[j] = t->bucket_val[i];
+  }
+  t->bucket_key.swap(nk);
+  t->bucket_val.swap(nv);
+}
+
+float* row_ptr(Table* t, uint32_t idx) {
+  size_t need = (static_cast<size_t>(idx) + 1) * t->row_stride;
+  if (t->rows.size() < need) t->rows.resize(need, 0.f);
+  if (t->ids.size() <= idx) t->ids.resize(idx + 1, 0);
+  return t->rows.data() + static_cast<size_t>(idx) * t->row_stride;
+}
+
+void init_row(Table* t, uint64_t id, float* row) {
+  // per-id xorshift: deterministic under any insertion order
+  uint64_t s = mix(t->seed ^ mix(id)) | 1ULL;
+  for (int d = 0; d < t->dim; ++d) {
+    s ^= s << 13; s ^= s >> 7; s ^= s << 17;
+    // 24-bit mantissa uniform in [0, 1)
+    float u = static_cast<float>((s >> 40) & 0xFFFFFF) / 16777216.0f;
+    row[d] = (2.0f * u - 1.0f) * t->init_scale;
+  }
+  float* slots = row + t->dim;
+  int ns = t->slot_floats();
+  for (int k = 0; k < ns; ++k) slots[k] = 0.f;
+  if (t->opt == OPT_ADAM) {           // beta1_pow / beta2_pow start at 1
+    slots[2 * t->dim] = 1.0f;
+    slots[2 * t->dim + 1] = 1.0f;
+  }
+}
+
+void apply_rule(Table* t, float* row, const float* grad) {
+  const int dim = t->dim;
+  float* slots = row + dim;
+  switch (t->opt) {
+    case OPT_SUM:
+      for (int d = 0; d < dim; ++d) row[d] -= grad[d];
+      break;
+    case OPT_SGD:
+      for (int d = 0; d < dim; ++d) row[d] -= t->lr * grad[d];
+      break;
+    case OPT_ADAGRAD:
+      for (int d = 0; d < dim; ++d) {
+        slots[d] += grad[d] * grad[d];
+        row[d] -= t->lr * grad[d] / (std::sqrt(slots[d]) + 1e-6f);
+      }
+      break;
+    case OPT_ADAM: {
+      const float b1 = 0.9f, b2 = 0.999f, eps = 1e-8f;
+      float* m = slots;
+      float* v = slots + dim;
+      float& b1p = slots[2 * dim];
+      float& b2p = slots[2 * dim + 1];
+      b1p *= b1;
+      b2p *= b2;
+      for (int d = 0; d < dim; ++d) {
+        m[d] = b1 * m[d] + (1 - b1) * grad[d];
+        v[d] = b2 * v[d] + (1 - b2) * grad[d] * grad[d];
+        float mhat = m[d] / (1 - b1p);
+        float vhat = v[d] / (1 - b2p);
+        row[d] -= t->lr * mhat / (std::sqrt(vhat) + eps);
+      }
+      break;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pst_create(int dim, int opt_id, float lr, float init_scale, uint64_t seed) {
+  Table* t = new Table();
+  t->dim = dim;
+  t->opt = opt_id;
+  t->lr = lr;
+  t->init_scale = init_scale;
+  t->seed = seed;
+  t->row_stride = dim + t->slot_floats();
+  t->bucket_key.assign(1024, 0);
+  t->bucket_val.assign(1024, 0);
+  return t;
+}
+
+void pst_destroy(void* h) { delete static_cast<Table*>(h); }
+
+int64_t pst_size(void* h) {
+  Table* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  return static_cast<int64_t>(t->count);
+}
+
+void pst_pull(void* h, const int64_t* ids, int64_t n, float* out) {
+  Table* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    bool inserted = false;
+    uint32_t idx = find_or_insert(t, static_cast<uint64_t>(ids[i]), &inserted);
+    float* row = row_ptr(t, idx);
+    if (inserted) {
+      t->ids[idx] = static_cast<uint64_t>(ids[i]);
+      init_row(t, static_cast<uint64_t>(ids[i]), row);
+    }
+    std::memcpy(out + i * t->dim, row, sizeof(float) * t->dim);
+  }
+}
+
+void pst_get_rows(void* h, const int64_t* ids, int64_t n, float* out) {
+  Table* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t idx = find(t, static_cast<uint64_t>(ids[i]));
+    if (idx == UINT32_MAX) {
+      std::memset(out + i * t->dim, 0, sizeof(float) * t->dim);
+    } else {
+      std::memcpy(out + i * t->dim,
+                  t->rows.data() + static_cast<size_t>(idx) * t->row_stride,
+                  sizeof(float) * t->dim);
+    }
+  }
+}
+
+void pst_push(void* h, const int64_t* ids, int64_t n, const float* grads) {
+  Table* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  const int dim = t->dim;
+  // merge duplicate ids first (reference merges by id before apply): O(n)
+  std::unordered_map<int64_t, size_t> first;
+  first.reserve(static_cast<size_t>(n));
+  std::vector<int64_t> uniq;
+  std::vector<float> merged;
+  uniq.reserve(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) {
+    auto it = first.find(ids[i]);
+    if (it == first.end()) {
+      first.emplace(ids[i], uniq.size());
+      uniq.push_back(ids[i]);
+      merged.insert(merged.end(), grads + i * dim, grads + (i + 1) * dim);
+    } else {
+      float* dst = merged.data() + it->second * dim;
+      const float* src = grads + i * dim;
+      for (int d = 0; d < dim; ++d) dst[d] += src[d];
+    }
+  }
+  for (size_t i = 0; i < uniq.size(); ++i) {
+    bool inserted = false;
+    uint32_t idx = find_or_insert(t, static_cast<uint64_t>(uniq[i]), &inserted);
+    float* row = row_ptr(t, idx);
+    if (inserted) {
+      t->ids[idx] = static_cast<uint64_t>(uniq[i]);
+      init_row(t, static_cast<uint64_t>(uniq[i]), row);
+    }
+    apply_rule(t, row, merged.data() + i * dim);
+  }
+}
+
+void pst_keys(void* h, int64_t* out) {
+  Table* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  for (size_t i = 0; i < t->count; ++i) out[i] = static_cast<int64_t>(t->ids[i]);
+}
+
+int pst_save(void* h, const char* path) {
+  Table* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  int64_t header[4] = {static_cast<int64_t>(t->count), t->dim, t->opt,
+                       t->row_stride};
+  std::fwrite(header, sizeof(int64_t), 4, f);
+  std::fwrite(t->ids.data(), sizeof(uint64_t), t->count, f);
+  std::fwrite(t->rows.data(), sizeof(float),
+              t->count * static_cast<size_t>(t->row_stride), f);
+  std::fclose(f);
+  return 0;
+}
+
+int pst_load(void* h, const char* path) {
+  Table* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int64_t header[4];
+  if (std::fread(header, sizeof(int64_t), 4, f) != 4) { std::fclose(f); return -2; }
+  if (header[1] != t->dim || header[2] != t->opt || header[3] != t->row_stride) {
+    std::fclose(f);
+    return -3;
+  }
+  size_t count = static_cast<size_t>(header[0]);
+  t->ids.assign(count, 0);
+  t->rows.assign(count * static_cast<size_t>(t->row_stride), 0.f);
+  if (std::fread(t->ids.data(), sizeof(uint64_t), count, f) != count) {
+    std::fclose(f); return -2;
+  }
+  size_t nfloats = count * static_cast<size_t>(t->row_stride);
+  if (std::fread(t->rows.data(), sizeof(float), nfloats, f) != nfloats) {
+    std::fclose(f); return -2;
+  }
+  std::fclose(f);
+  // rebuild hash
+  size_t cap = 1024;
+  while (cap * 7 < count * 10) cap *= 2;
+  t->bucket_key.assign(cap, 0);
+  t->bucket_val.assign(cap, 0);
+  t->count = 0;
+  for (size_t i = 0; i < count; ++i) {
+    bool ins = false;
+    uint32_t idx = find_or_insert(t, t->ids[i], &ins);
+    (void)idx;
+  }
+  t->count = count;
+  return 0;
+}
+
+}  // extern "C"

@@ -81,8 +81,17 @@ def nms_keep_mask_pallas(boxes, iou_threshold, interpret=False):
     return keep[0, :n] > 0
 
 
+_DISABLED = [False]  # session-wide negative cache after a lowering failure
+
+
+def mark_unsupported():
+    _DISABLED[0] = True
+
+
 def supported(n_boxes):
     """VMEM budget: [n_pad, 4] boxes + a few [1, n_pad] rows — generous cap."""
+    if _DISABLED[0]:
+        return False
     try:
         on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     except Exception:

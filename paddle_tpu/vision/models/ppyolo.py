@@ -144,6 +144,11 @@ class PPYOLOE(nn.Layer):
         self.strides = (8, 16, 32)
 
     def forward(self, images):
+        h, w = images.shape[-2], images.shape[-1]
+        if h % self.strides[-1] or w % self.strides[-1]:
+            raise ValueError(
+                f"PPYOLOE input H/W must be multiples of {self.strides[-1]}, "
+                f"got {h}x{w} (pad or resize the batch first)")
         return self.head(self.neck(self.backbone(images)))
 
     # ---- decode / postprocess ------------------------------------------------

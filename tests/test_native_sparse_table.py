@@ -1,0 +1,111 @@
+"""Native C++ sparse PS table tests — semantics vs the Python SparseTable."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps.native_table import NativeSparseTable, available
+from paddle_tpu.distributed.ps.tables import SparseTable, make_sparse_table
+
+pytestmark = pytest.mark.skipif(not available(), reason="g++ build unavailable")
+
+
+class TestNativeSparseTable:
+    def test_pull_initializes_deterministically(self):
+        t = NativeSparseTable(8, init_scale=0.05, seed=42)
+        rows = t.pull([5, 9, 5])
+        assert rows.shape == (3, 8)
+        np.testing.assert_array_equal(rows[0], rows[2])  # same id, same row
+        assert (np.abs(rows) <= 0.05).all()
+        assert np.abs(rows).max() > 0
+        # insertion order must not matter
+        t2 = NativeSparseTable(8, init_scale=0.05, seed=42)
+        rows2 = t2.pull([9, 5])
+        np.testing.assert_array_equal(rows2[1], rows[0])
+        np.testing.assert_array_equal(rows2[0], rows[1])
+
+    def test_sgd_matches_python_table(self):
+        ids = np.array([1, 7, 1, 3], np.int64)
+        grads = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+        nat = NativeSparseTable(4, optimizer="sgd", lr=0.1, initializer="zeros")
+        py = SparseTable(4, optimizer="sgd", lr=0.1, initializer="zeros")
+        nat.pull(ids)
+        py.pull(ids)
+        nat.push(ids, grads)
+        py.push(ids, grads)
+        np.testing.assert_allclose(nat.pull([1, 3, 7]), py.pull([1, 3, 7]),
+                                   atol=1e-6)
+
+    @pytest.mark.parametrize("opt", ["adagrad", "adam", "sum"])
+    def test_optimizer_rules_match_python(self, opt):
+        ids = np.arange(16, dtype=np.int64) % 5
+        nat = NativeSparseTable(8, optimizer=opt, lr=0.05, initializer="zeros")
+        py = SparseTable(8, optimizer=opt, lr=0.05, initializer="zeros")
+        rng = np.random.RandomState(1)
+        for _ in range(3):
+            grads = rng.randn(16, 8).astype(np.float32)
+            nat.push(ids, grads)
+            py.push(ids, grads)
+        np.testing.assert_allclose(nat.pull(np.arange(5)), py.pull(np.arange(5)),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_growth_many_rows(self):
+        t = NativeSparseTable(4, initializer="zeros")
+        ids = np.arange(20000, dtype=np.int64)
+        t.push(ids, np.ones((20000, 4), np.float32))
+        assert t.size() == 20000
+        # every row got exactly one -lr*grad step
+        np.testing.assert_allclose(t.pull([0, 19999]), -0.01 * np.ones((2, 4)),
+                                   atol=1e-6)
+
+    def test_get_rows_no_init(self):
+        t = NativeSparseTable(4, initializer="zeros")
+        t.pull([1])
+        out = t.get_rows([1, 2])
+        assert t.size() == 1  # id 2 was NOT created
+        np.testing.assert_array_equal(out[1], np.zeros(4))
+
+    def test_save_load_roundtrip(self, tmp_path):
+        t = NativeSparseTable(8, optimizer="adam", lr=0.01, seed=7)
+        ids = np.array([3, 1, 4, 1, 5], np.int64)
+        t.push(ids, np.random.RandomState(2).randn(5, 8).astype(np.float32))
+        before = t.pull([1, 3, 4, 5])
+        path = str(tmp_path / "table.bin")
+        t.save(path)
+
+        t2 = NativeSparseTable(8, optimizer="adam", lr=0.01, seed=7)
+        t2.load(path)
+        assert t2.size() == t.size()
+        np.testing.assert_array_equal(t2.pull([1, 3, 4, 5]), before)
+        # optimizer slots restored: one more identical push stays identical
+        g = np.ones((4, 8), np.float32)
+        t.push([1, 3, 4, 5], g)
+        t2.push([1, 3, 4, 5], g)
+        np.testing.assert_allclose(t2.pull([1, 3, 4, 5]), t.pull([1, 3, 4, 5]),
+                                   atol=1e-7)
+
+    def test_factory_prefers_native(self):
+        t = make_sparse_table(4)
+        assert isinstance(t, NativeSparseTable)
+        t2 = make_sparse_table(4, backend="python")
+        assert isinstance(t2, SparseTable)
+
+    def test_perf_native_faster_than_python(self):
+        """The point of the C++ engine: batch push must beat the per-row
+        Python loop comfortably (>=3x on a 50k-row push)."""
+        import time
+
+        n, dim = 50000, 16
+        ids = np.random.RandomState(0).randint(0, 10000, n).astype(np.int64)
+        grads = np.random.RandomState(1).randn(n, dim).astype(np.float32)
+
+        nat = NativeSparseTable(dim, optimizer="adam", initializer="zeros")
+        py = SparseTable(dim, optimizer="adam", initializer="zeros")
+        nat.push(ids, grads)  # warm (allocates rows)
+        py.push(ids, grads)
+
+        t0 = time.perf_counter()
+        nat.push(ids, grads)
+        t_nat = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        py.push(ids, grads)
+        t_py = time.perf_counter() - t0
+        assert t_nat * 3 < t_py, f"native {t_nat:.4f}s vs python {t_py:.4f}s"
