@@ -1,0 +1,120 @@
+"""Model encryption — CipherFactory/AESCipher facade over the native AES core.
+
+Reference parity: paddle/fluid/framework/io/crypto/ (cryptopp AESCipher,
+cipher_utils key generation, pybind/crypto.cc bindings) — weights/programs are
+encrypted at rest with a symmetric cipher. TPU build: AES-256-CTR implemented in
+native/crypto_aes.cc (FIPS-197, no external deps); key derivation = PBKDF2-HMAC-SHA256
+and integrity = HMAC-SHA256, both from the stdlib. Wire format:
+    b"PTAE" | iv[16] | hmac[32] | ciphertext
+The HMAC covers iv+ciphertext with a key derived separately from the passphrase.
+"""
+import ctypes
+import hashlib
+import hmac as hmac_mod
+import os
+import subprocess
+import threading
+
+_MAGIC = b"PTAE"
+_LIB = None
+_LIB_LOCK = threading.Lock()
+_SRC = os.path.join(os.path.dirname(__file__), "..", "native", "crypto_aes.cc")
+_SO = os.path.join(os.path.dirname(__file__), "..", "native", "_crypto_aes.so")
+
+
+def _load_lib():
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is False:
+            raise RuntimeError("native AES build failed previously")
+        if _LIB is not None:
+            return _LIB
+        src = os.path.abspath(_SRC)
+        so = os.path.abspath(_SO)
+        try:
+            if (not os.path.exists(so)
+                    or os.path.getmtime(so) < os.path.getmtime(src)):
+                subprocess.run(
+                    ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", so,
+                     src],
+                    check=True, capture_output=True,
+                )
+        except (OSError, subprocess.CalledProcessError):
+            _LIB = False
+            raise
+        lib = ctypes.CDLL(so)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.aes256_ctr_crypt.argtypes = [u8p, u8p, u8p, ctypes.c_uint64, u8p]
+        _LIB = lib
+        return lib
+
+
+def _u8(b):
+    return ctypes.cast(ctypes.create_string_buffer(b, len(b)),
+                       ctypes.POINTER(ctypes.c_uint8))
+
+
+def _derive_keys(key, salt=b"paddle-tpu-cipher"):
+    """passphrase/bytes -> (enc_key[32], mac_key[32]) via PBKDF2-HMAC-SHA256."""
+    if isinstance(key, str):
+        key = key.encode()
+    master = hashlib.pbkdf2_hmac("sha256", key, salt, 10000, dklen=64)
+    return master[:32], master[32:]
+
+
+def _ctr(enc_key, iv, data):
+    lib = _load_lib()
+    out = ctypes.create_string_buffer(len(data))
+    lib.aes256_ctr_crypt(_u8(enc_key), _u8(iv), _u8(data), len(data),
+                         ctypes.cast(out, ctypes.POINTER(ctypes.c_uint8)))
+    return out.raw
+
+
+class AESCipher:
+    """framework/io/crypto/aes_cipher.cc parity: authenticated AES-256-CTR."""
+
+    def __init__(self, key):
+        self._enc_key, self._mac_key = _derive_keys(key)
+
+    def encrypt(self, plaintext):
+        iv = os.urandom(16)
+        ct = _ctr(self._enc_key, iv, bytes(plaintext))
+        tag = hmac_mod.new(self._mac_key, iv + ct, hashlib.sha256).digest()
+        return _MAGIC + iv + tag + ct
+
+    def decrypt(self, blob):
+        blob = bytes(blob)
+        if blob[:4] != _MAGIC:
+            raise ValueError("not an encrypted paddle_tpu payload")
+        iv, tag, ct = blob[4:20], blob[20:52], blob[52:]
+        expect = hmac_mod.new(self._mac_key, iv + ct, hashlib.sha256).digest()
+        if not hmac_mod.compare_digest(tag, expect):
+            raise ValueError("decryption failed: wrong key or corrupted data")
+        return _ctr(self._enc_key, iv, ct)
+
+    def encrypt_to_file(self, plaintext, path):
+        with open(path, "wb") as f:
+            f.write(self.encrypt(plaintext))
+
+    def decrypt_from_file(self, path):
+        with open(path, "rb") as f:
+            return self.decrypt(f.read())
+
+
+class CipherFactory:
+    """pybind/crypto.cc CipherFactory parity."""
+
+    @staticmethod
+    def create_cipher(key=None, cipher_name="AESCipher"):
+        if cipher_name != "AESCipher":
+            raise ValueError(f"unknown cipher: {cipher_name}")
+        return AESCipher(key if key is not None else CipherFactory.generate_key())
+
+    @staticmethod
+    def generate_key(nbytes=32):
+        return os.urandom(nbytes)
+
+
+def is_encrypted(path):
+    with open(path, "rb") as f:
+        return f.read(4) == _MAGIC

@@ -38,15 +38,37 @@ def _unpack(obj):
 
 
 def save(obj, path, protocol=4, **configs):
+    """configs: encryption_key=<str|bytes> encrypts the payload at rest
+    (framework/io/crypto parity, native AES-256-CTR + HMAC)."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+    key = configs.get("encryption_key")
+    if key is not None:
+        from .crypto import AESCipher
+
+        payload = AESCipher(key).encrypt(pickle.dumps(_pack(obj),
+                                                      protocol=protocol))
+        with open(path, "wb") as f:
+            f.write(payload)
+    else:  # streaming path: no full-payload copy in memory
+        with open(path, "wb") as f:
+            pickle.dump(_pack(obj), f, protocol=protocol)
 
 
 def load(path, **configs):
+    from .crypto import _MAGIC
+
+    key = configs.get("encryption_key")
     with open(path, "rb") as f:
+        if f.read(4) == _MAGIC:
+            if key is None:
+                raise ValueError(f"{path} is encrypted; pass encryption_key=")
+            from .crypto import AESCipher
+
+            f.seek(0)
+            return _unpack(pickle.loads(AESCipher(key).decrypt(f.read())))
+        f.seek(0)
         return _unpack(pickle.load(f))
 
 
@@ -57,6 +79,6 @@ def save_dygraph(state_dict, model_path):
 def load_dygraph(model_path, **configs):
     params_path = model_path + ".pdparams"
     opt_path = model_path + ".pdopt"
-    para = load(params_path) if os.path.exists(params_path) else None
-    opt = load(opt_path) if os.path.exists(opt_path) else None
+    para = load(params_path, **configs) if os.path.exists(params_path) else None
+    opt = load(opt_path, **configs) if os.path.exists(opt_path) else None
     return para, opt
