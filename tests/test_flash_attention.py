@@ -1,0 +1,75 @@
+"""Flash-attention kernel tests (interpret mode on CPU): fwd + custom-VJP bwd
+against the naive softmax(QK^T)V reference."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops.flash_attention import flash_attention
+
+
+def _naive(q, k, v, causal):
+    b, s, h, d = q.shape
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _qkv(b=1, s=256, h=2, d=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return [jnp.asarray(rng.randn(b, s, h, d).astype(np.float32) * 0.5)
+            for _ in range(3)]
+
+
+class TestFlashForward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_naive(self, causal):
+        q, k, v = _qkv(seed=1)
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        ref = _naive(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_head_dim_64_supported_on_tpu_gate(self):
+        from paddle_tpu.ops.flash_attention import supported, _on_tpu
+
+        if _on_tpu():
+            assert supported((8, 4096, 12, 64), "float32")
+        # shape gates independent of platform
+        assert not supported((8, 100, 12, 64), "float32")   # seq % 128
+        assert not supported((8, 1024, 12, 48), "float32")  # d % 64
+
+
+class TestFlashBackward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_naive(self, causal):
+        q, k, v = _qkv(s=256, seed=2)
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=causal, interpret=True)
+            return jnp.sum(o * jnp.cos(o))  # non-trivial cotangent
+
+        def loss_naive(q, k, v):
+            o = _naive(q, k, v, causal)
+            return jnp.sum(o * jnp.cos(o))
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gn = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gn, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5, rtol=5e-4,
+                                       err_msg=f"d{name} mismatch")
+
+    def test_bf16_grads_finite(self):
+        q, k, v = [x.astype(jnp.bfloat16) for x in _qkv(seed=3)]
+
+        def loss(q):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           interpret=True).astype(jnp.float32))
+
+        g = jax.grad(loss)(q)
+        assert g.dtype == jnp.bfloat16
+        assert np.isfinite(np.asarray(g, np.float32)).all()
