@@ -1,0 +1,1 @@
+from .fs import FS, FSFileExistsError, FSFileNotExistsError, HDFSClient, LocalFS  # noqa: F401

@@ -1,0 +1,209 @@
+"""Filesystem abstraction for fleet checkpointing.
+
+Reference parity: python/paddle/distributed/fleet/utils/fs.py — abstract `FS` with
+LocalFS and HDFSClient implementations (ls_dir, is_dir/is_file/is_exist, upload,
+download, mkdirs, delete, mv, touch, cat, need_upload_download) used by
+auto-checkpoint and dataset shuffling; the C++ side is framework/io/fs.cc (shell-out
+to `hadoop fs`). TPU build keeps the same shell-out design for HDFS — it is the
+portable path and carries no JVM binding dependency.
+"""
+import os
+import shutil
+import subprocess
+
+
+class FSFileExistsError(RuntimeError):
+    pass
+
+
+class FSFileNotExistsError(RuntimeError):
+    pass
+
+
+class FS:
+    """Abstract interface (reference fs.py FS)."""
+
+    def ls_dir(self, path):
+        raise NotImplementedError
+
+    def is_dir(self, path):
+        raise NotImplementedError
+
+    def is_file(self, path):
+        raise NotImplementedError
+
+    def is_exist(self, path):
+        raise NotImplementedError
+
+    def mkdirs(self, path):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+    def mv(self, src, dst, overwrite=False):
+        raise NotImplementedError
+
+    def touch(self, path, exist_ok=True):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        return False
+
+    def cat(self, path):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local filesystem (reference fs.py LocalFS)."""
+
+    def ls_dir(self, path):
+        """-> (dirs, files), names only (reference convention)."""
+        if not self.is_exist(path):
+            return [], []
+        entries = sorted(os.listdir(path))
+        dirs = [e for e in entries if os.path.isdir(os.path.join(path, e))]
+        files = [e for e in entries if not os.path.isdir(os.path.join(path, e))]
+        return dirs, files
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def mv(self, src, dst, overwrite=False):
+        if not self.is_exist(src):
+            raise FSFileNotExistsError(src)
+        if self.is_exist(dst):
+            if not overwrite:
+                raise FSFileExistsError(dst)
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def touch(self, path, exist_ok=True):
+        if self.is_exist(path):
+            if not exist_ok:
+                raise FSFileExistsError(path)
+            return
+        d = os.path.dirname(path)
+        if d:
+            self.mkdirs(d)
+        open(path, "a").close()
+
+    def upload(self, local_path, fs_path):  # local == fs
+        if os.path.abspath(local_path) != os.path.abspath(fs_path):
+            shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        if os.path.abspath(local_path) != os.path.abspath(fs_path):
+            shutil.copy(fs_path, local_path)
+
+    def cat(self, path):
+        with open(path, "rb") as f:
+            return f.read()
+
+
+class HDFSClient(FS):
+    """`hadoop fs` shell-out client (reference fs.py HDFSClient / C++ io/fs.cc).
+
+    hadoop_home/configs mirror the reference ctor; every operation execs
+    `{hadoop}/bin/hadoop fs <cmd>`. Raises a clear error when no hadoop binary
+    is present (zero-egress images ship none).
+    """
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        self._hadoop_home = hadoop_home or os.environ.get("HADOOP_HOME", "")
+        self._configs = configs or {}
+        self._timeout_s = time_out / 1000.0
+        bin_cand = (os.path.join(self._hadoop_home, "bin", "hadoop")
+                    if self._hadoop_home else "hadoop")
+        self._bin = bin_cand if (shutil.which(bin_cand)
+                                 or os.path.exists(bin_cand)) else None
+
+    def available(self):
+        return self._bin is not None
+
+    def _run(self, *args, check=True):
+        if self._bin is None:
+            raise RuntimeError(
+                "HDFSClient needs a hadoop binary (set hadoop_home= or "
+                "HADOOP_HOME); none found on this host")
+        cmd = [self._bin, "fs"]
+        for k, v in self._configs.items():
+            cmd += ["-D", f"{k}={v}"]
+        cmd += list(args)
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=self._timeout_s)
+        if check and res.returncode != 0:
+            raise RuntimeError(f"hadoop fs {' '.join(args)} failed: {res.stderr}")
+        return res
+
+    def ls_dir(self, path):
+        res = self._run("-ls", path, check=False)
+        dirs, files = [], []
+        for line in res.stdout.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_dir(self, path):
+        return self._run("-test", "-d", path, check=False).returncode == 0
+
+    def is_file(self, path):
+        return self._run("-test", "-f", path, check=False).returncode == 0
+
+    def is_exist(self, path):
+        return self._run("-test", "-e", path, check=False).returncode == 0
+
+    def mkdirs(self, path):
+        self._run("-mkdir", "-p", path)
+
+    def delete(self, path):
+        self._run("-rm", "-r", "-f", path)
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        self._run("-mv", src, dst)
+
+    def touch(self, path, exist_ok=True):
+        if self.is_exist(path):
+            if not exist_ok:
+                raise FSFileExistsError(path)
+            return
+        self._run("-touchz", path)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", "-f", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def need_upload_download(self):
+        return True
+
+    def cat(self, path):
+        return self._run("-cat", path).stdout.encode()

@@ -23,7 +23,8 @@ class GPTConfig:
                  max_seq_len=1024, intermediate_size=None, dropout=0.1,
                  tensor_parallel=False, use_flash=True,
                  num_experts=0, moe_every=2, moe_k=2, moe_capacity_factor=2.0,
-                 moe_aux_weight=0.01, moe_mesh=None):
+                 moe_aux_weight=0.01, moe_mesh=None,
+                 sequence_parallel=False, sp_mesh=None, sp_impl="ring"):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -50,6 +51,25 @@ class GPTConfig:
         self.moe_capacity_factor = moe_capacity_factor
         self.moe_aux_weight = moe_aux_weight
         self.moe_mesh = moe_mesh
+        # long-context sequence parallelism (beyond-reference; SURVEY.md §5):
+        # sp_mesh with an 'sp' axis shards attention over the sequence dim —
+        # 'ring' rotates K/V blocks with ppermute, 'ulysses' all_to_alls
+        # seq<->heads. Composes with dp on the same mesh.
+        if sequence_parallel:
+            if sp_mesh is None or "sp" not in sp_mesh.axis_names:
+                raise ValueError("sequence_parallel=True needs sp_mesh with an "
+                                 "'sp' axis (otherwise attention silently runs "
+                                 "dense and defeats the sharding)")
+            if dropout > 0:
+                raise ValueError("sequence-parallel attention does not "
+                                 "implement attention dropout; set dropout=0.0")
+            sp_size = sp_mesh.shape["sp"]
+            if sp_impl == "ulysses" and num_heads % sp_size != 0:
+                raise ValueError(f"ulysses needs num_heads ({num_heads}) "
+                                 f"divisible by sp={sp_size}")
+        self.sequence_parallel = sequence_parallel
+        self.sp_mesh = sp_mesh
+        self.sp_impl = sp_impl
 
     @staticmethod
     def small():
@@ -71,6 +91,8 @@ class GPTAttention(nn.Layer):
         h = cfg.hidden_size
         self.num_heads = cfg.num_heads
         self.head_dim = h // cfg.num_heads
+        self.sp_mesh = cfg.sp_mesh if getattr(cfg, "sequence_parallel", False) else None
+        self.sp_impl = getattr(cfg, "sp_impl", "ring")
         if cfg.tensor_parallel:
             from ..distributed.split import ColumnParallelLinear, RowParallelLinear
 
@@ -90,10 +112,20 @@ class GPTAttention(nn.Layer):
         q = q.reshape([b, s, self.num_heads, self.head_dim])
         k = k.reshape([b, s, self.num_heads, self.head_dim])
         v = v.reshape([b, s, self.num_heads, self.head_dim])
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True,
-            dropout_p=self.dropout if self.training else 0.0, training=self.training,
-        )
+        if self.sp_mesh is not None and "sp" in self.sp_mesh.axis_names:
+            from ..core.dispatch import apply
+            from ..distributed.long_context import sequence_parallel_attention
+
+            out = apply(
+                lambda qv, kv, vv: sequence_parallel_attention(
+                    qv, kv, vv, self.sp_mesh, impl=self.sp_impl, causal=True),
+                q, k, v)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True,
+                dropout_p=self.dropout if self.training else 0.0,
+                training=self.training,
+            )
         return self.proj(out.reshape([b, s, h]))
 
 

@@ -1,0 +1,152 @@
+"""OpTest — declarative numpy-reference operator test harness.
+
+Reference parity: python/paddle/fluid/tests/unittests/op_test.py (OpTest:255 —
+subclasses declare `self.op`, `self.inputs`, `self.attrs`, `self.outputs`;
+check_output_with_place:1054 compares against the numpy reference;
+check_grad:1362 compares analytic grads with get_numeric_gradient:110's central
+differences).
+
+TPU-native design: `self.op` is any callable over paddle_tpu Tensors (a
+paddle.tensor fn, nn.functional fn, or lambda). check_output runs it eagerly AND
+under jax.jit (the dygraph/static dual-path check collapses to eager-vs-jit
+parity); check_grad compares tape autograd against central differences of the
+same callable — jax.grad is the oracle-free analytic side.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+
+class OpTest:
+    """Subclass contract:
+
+        def setUp(self):
+            self.op = paddle.tensor.add            # callable
+            self.inputs = {"x": np_arr, "y": np_arr}  # positional by order
+            self.attrs = {}                        # keyword args
+            self.outputs = {"out": np_expected}    # or list for multi-output
+
+    then call self.check_output() / self.check_grad(["x"], "out").
+    """
+
+    op = None
+    inputs = None
+    attrs = None
+    outputs = None
+    atol = 1e-5
+    rtol = 1e-5
+    grad_atol = 1e-3
+    grad_rtol = 1e-2
+
+    # pytest runs setUp via the autouse fixture in subclass modules; call
+    # explicitly for plain invocation
+    def setUp(self):  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def _ensure(self):
+        if self.op is None:
+            self.setUp()
+        self.attrs = self.attrs or {}
+
+    def _run_op(self, raw_inputs):
+        tensors = [Tensor(jnp.asarray(v)) for v in raw_inputs.values()]
+        for t in tensors:
+            t.stop_gradient = False
+        out = self.op(*tensors, **self.attrs)
+        return out, tensors
+
+    @staticmethod
+    def _flatten(out):
+        if isinstance(out, (tuple, list)):
+            return list(out)
+        return [out]
+
+    # ---- output check --------------------------------------------------------
+    def check_output(self, atol=None, rtol=None, jit=True):
+        """jit=False for dynamic-output-shape ops (masked_select, unique, nms)
+        that are host-eager by design — the reference's CPU-only kernels."""
+        self._ensure()
+        atol = atol or self.atol
+        rtol = rtol or self.rtol
+        out, _ = self._run_op(self.inputs)
+        got = [np.asarray(o._data) for o in self._flatten(out)]
+        want = (list(self.outputs.values())
+                if isinstance(self.outputs, dict) else list(self.outputs))
+        assert len(got) == len(want), f"{len(got)} outputs vs {len(want)} expected"
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, np.asarray(w), atol=atol, rtol=rtol)
+
+        if not jit:
+            return
+        # eager-vs-jit parity (the dygraph/to_static dual-path check)
+        def pure(*vals):
+            ts = [Tensor(v) for v in vals]
+            return [o._data for o in
+                    self._flatten(self.op(*ts, **self.attrs))]
+
+        jit_out = jax.jit(pure)(*[jnp.asarray(v)
+                                  for v in self.inputs.values()])
+        for g, j in zip(got, jit_out):
+            np.testing.assert_allclose(g, np.asarray(j), atol=atol, rtol=rtol,
+                                       err_msg="eager vs jit mismatch")
+
+    # ---- gradient check ------------------------------------------------------
+    def _numeric_grad(self, wrt_idx, out_idx, delta):
+        """Central differences of sum(output[out_idx]) w.r.t. input wrt_idx."""
+        vals = [np.asarray(v, np.float64) for v in self.inputs.values()]
+        x = vals[wrt_idx]
+        grad = np.zeros_like(x, np.float64)
+
+        def f(xv):
+            call = [jnp.asarray(v, jnp.float32) for v in vals]
+            call[wrt_idx] = jnp.asarray(xv, jnp.float32)
+            ts = [Tensor(c) for c in call]
+            for t in ts:
+                t.stop_gradient = True
+            out = self._flatten(self.op(*ts, **self.attrs))[out_idx]
+            return float(jnp.sum(out._data.astype(jnp.float64)))
+
+        flat = x.reshape(-1)
+        g = grad.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + delta
+            hi = f(x)
+            flat[i] = orig - delta
+            lo = f(x)
+            flat[i] = orig
+            g[i] = (hi - lo) / (2 * delta)
+        return grad
+
+    def check_grad(self, inputs_to_check, output_name=None, delta=1e-3,
+                   atol=None, rtol=None, max_elems=64):
+        """Analytic (tape) grads vs central differences.
+
+        max_elems guards runtime: inputs larger than this are rejected — keep
+        op-test shapes small like the reference does.
+        """
+        self._ensure()
+        atol = atol or self.grad_atol
+        rtol = rtol or self.grad_rtol
+        names = list(self.inputs.keys())
+        out_idx = 0
+        if output_name is not None and isinstance(self.outputs, dict):
+            out_idx = list(self.outputs.keys()).index(output_name)
+
+        out, tensors = self._run_op(self.inputs)
+        target = self._flatten(out)[out_idx]
+        target.sum().backward()
+
+        for name in inputs_to_check:
+            i = names.index(name)
+            x = np.asarray(self.inputs[name])
+            assert x.size <= max_elems, (
+                f"input {name} has {x.size} elems; keep op-test shapes small")
+            analytic = tensors[i].grad
+            assert analytic is not None, f"no gradient reached input {name!r}"
+            numeric = self._numeric_grad(i, out_idx, delta)
+            np.testing.assert_allclose(
+                np.asarray(analytic._data), numeric, atol=atol, rtol=rtol,
+                err_msg=f"grad mismatch for input {name!r}")

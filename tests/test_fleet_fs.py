@@ -1,0 +1,61 @@
+"""fleet utils fs tests (reference distributed/fleet/utils/fs.py parity)."""
+import os
+
+import pytest
+
+from paddle_tpu.distributed.fleet.utils import (
+    FSFileExistsError, FSFileNotExistsError, HDFSClient, LocalFS,
+)
+
+
+class TestLocalFS:
+    def test_full_lifecycle(self, tmp_path):
+        fs = LocalFS()
+        root = str(tmp_path / "ckpt")
+        fs.mkdirs(root)
+        assert fs.is_dir(root) and fs.is_exist(root)
+
+        f = os.path.join(root, "epoch_0")
+        fs.touch(f)
+        assert fs.is_file(f)
+        with pytest.raises(FSFileExistsError):
+            fs.touch(f, exist_ok=False)
+
+        sub = os.path.join(root, "sub")
+        fs.mkdirs(sub)
+        dirs, files = fs.ls_dir(root)
+        assert dirs == ["sub"] and files == ["epoch_0"]
+
+        dst = os.path.join(root, "epoch_1")
+        fs.mv(f, dst)
+        assert fs.is_file(dst) and not fs.is_exist(f)
+        with pytest.raises(FSFileNotExistsError):
+            fs.mv(f, dst)
+
+        with open(dst, "wb") as fh:
+            fh.write(b"abc")
+        assert fs.cat(dst) == b"abc"
+
+        fs.delete(root)
+        assert not fs.is_exist(root)
+        assert fs.ls_dir(root) == ([], [])
+        assert not fs.need_upload_download()
+
+    def test_mv_overwrite(self, tmp_path):
+        fs = LocalFS()
+        a, b = str(tmp_path / "a"), str(tmp_path / "b")
+        fs.touch(a)
+        fs.touch(b)
+        with pytest.raises(FSFileExistsError):
+            fs.mv(a, b)
+        fs.mv(a, b, overwrite=True)
+        assert fs.is_exist(b) and not fs.is_exist(a)
+
+
+class TestHDFSClient:
+    def test_clear_error_without_hadoop(self):
+        client = HDFSClient(hadoop_home="/nonexistent")
+        assert not client.available()
+        assert client.need_upload_download()
+        with pytest.raises(RuntimeError, match="hadoop binary"):
+            client.mkdirs("/tmp/x")

@@ -1,0 +1,189 @@
+"""Op-test burn-down, batch 1: elementwise / reduce / manipulation / activation /
+loss ops against numpy references with numeric gradient checks (SURVEY §4 —
+the reference's 1005-file op_test suite, table-driven here)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+from op_test import OpTest
+
+rng = np.random.RandomState(7)
+
+
+def _pos(*shape):
+    return (rng.rand(*shape) + 0.5).astype(np.float32)
+
+
+def _randn(*shape):
+    return rng.randn(*shape).astype(np.float32)
+
+
+def _softmax_np(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+X = _randn(3, 4)
+Y = _randn(3, 4)
+P = _pos(3, 4)
+V6 = _randn(6)
+
+# (id, op, inputs, attrs, expected outputs, grad_inputs or None)
+CASES = [
+    ("add", paddle.add, {"x": X, "y": Y}, {}, [X + Y], ["x", "y"]),
+    ("subtract", paddle.subtract, {"x": X, "y": Y}, {}, [X - Y], ["x", "y"]),
+    ("multiply", paddle.multiply, {"x": X, "y": Y}, {}, [X * Y], ["x", "y"]),
+    ("divide", paddle.divide, {"x": X, "y": P}, {}, [X / P], ["x", "y"]),
+    ("pow", paddle.pow, {"x": P}, {"y": 3.0}, [P ** 3.0], ["x"]),
+    ("exp", paddle.exp, {"x": X}, {}, [np.exp(X)], ["x"]),
+    ("log", paddle.log, {"x": P}, {}, [np.log(P)], ["x"]),
+    ("sqrt", paddle.sqrt, {"x": P}, {}, [np.sqrt(P)], ["x"]),
+    ("rsqrt", paddle.rsqrt, {"x": P}, {}, [1 / np.sqrt(P)], ["x"]),
+    ("abs", paddle.abs, {"x": X + 0.3}, {}, [np.abs(X + 0.3)], ["x"]),
+    ("tanh", paddle.tanh, {"x": X}, {}, [np.tanh(X)], ["x"]),
+    ("maximum", paddle.maximum, {"x": X, "y": Y}, {}, [np.maximum(X, Y)], None),
+    ("minimum", paddle.minimum, {"x": X, "y": Y}, {}, [np.minimum(X, Y)], None),
+    ("clip", paddle.clip, {"x": X}, {"min": -0.5, "max": 0.5},
+     [np.clip(X, -0.5, 0.5)], None),
+    ("floor", paddle.floor, {"x": X * 3}, {}, [np.floor(X * 3)], None),
+    ("ceil", paddle.ceil, {"x": X * 3}, {}, [np.ceil(X * 3)], None),
+    ("round", paddle.round, {"x": X * 3}, {}, [np.round(X * 3)], None),
+    ("sign", paddle.sign, {"x": X}, {}, [np.sign(X)], None),
+    ("reciprocal", paddle.reciprocal, {"x": P}, {}, [1 / P], ["x"]),
+    ("square", paddle.square, {"x": X}, {}, [X * X], ["x"]),
+    # reductions
+    ("mean", paddle.mean, {"x": X}, {}, [X.mean()], ["x"]),
+    ("sum", paddle.sum, {"x": X}, {"axis": 1}, [X.sum(1)], ["x"]),
+    ("max", paddle.max, {"x": X}, {"axis": 0}, [X.max(0)], None),
+    ("min", paddle.min, {"x": X}, {"axis": 0}, [X.min(0)], None),
+    ("prod", paddle.prod, {"x": P}, {"axis": 1}, [P.prod(1)], ["x"]),
+    ("logsumexp", paddle.logsumexp, {"x": X}, {"axis": 1},
+     [np.log(np.exp(X).sum(1))], ["x"]),
+    # linalg
+    ("matmul", paddle.matmul, {"x": _randn(3, 4), "y": _randn(4, 2)}, {},
+     None, ["x", "y"]),
+    ("matmul_tx", paddle.matmul, {"x": _randn(4, 3), "y": _randn(4, 2)},
+     {"transpose_x": True}, None, ["x", "y"]),
+    ("dot", paddle.dot, {"x": V6, "y": _randn(6)}, {}, None, ["x", "y"]),
+    ("t", paddle.t, {"x": X}, {}, [X.T], ["x"]),
+    # manipulation
+    ("reshape", paddle.reshape, {"x": X}, {"shape": [4, 3]},
+     [X.reshape(4, 3)], ["x"]),
+    ("transpose", paddle.transpose, {"x": X}, {"perm": [1, 0]}, [X.T], ["x"]),
+    ("squeeze", paddle.squeeze, {"x": X[None]}, {"axis": 0}, [X], None),
+    ("unsqueeze", paddle.unsqueeze, {"x": X}, {"axis": 0}, [X[None]], None),
+    ("flip", paddle.flip, {"x": X}, {"axis": [0]}, [X[::-1]], None),
+    ("roll", paddle.roll, {"x": V6}, {"shifts": 2}, [np.roll(V6, 2)], None),
+    ("cumsum", paddle.cumsum, {"x": X}, {"axis": 1}, [X.cumsum(1)], ["x"]),
+    ("cumprod", paddle.cumprod, {"x": P}, {"dim": 1}, [P.cumprod(1)], ["x"]),
+    ("tile", paddle.tile, {"x": X}, {"repeat_times": [2, 1]},
+     [np.tile(X, (2, 1))], None),
+    ("expand", paddle.expand, {"x": _randn(1, 4)}, {"shape": [3, 4]}, None,
+     None),
+    # activations
+    ("relu", F.relu, {"x": X}, {}, [np.maximum(X, 0)], None),
+    ("sigmoid", F.sigmoid, {"x": X}, {}, [1 / (1 + np.exp(-X))], ["x"]),
+    ("softmax", F.softmax, {"x": X}, {"axis": -1}, [_softmax_np(X)], ["x"]),
+    ("log_softmax", F.log_softmax, {"x": X}, {"axis": -1},
+     [np.log(_softmax_np(X))], ["x"]),
+    ("elu", F.elu, {"x": X}, {"alpha": 1.0},
+     [np.where(X > 0, X, np.exp(X) - 1)], None),
+    ("softplus", F.softplus, {"x": X}, {}, [np.log1p(np.exp(X))], ["x"]),
+    ("hardtanh", F.hardtanh, {"x": X * 2}, {}, [np.clip(X * 2, -1, 1)], None),
+    ("leaky_relu", F.leaky_relu, {"x": X}, {"negative_slope": 0.1},
+     [np.where(X > 0, X, 0.1 * X)], None),
+    ("gelu", F.gelu, {"x": X}, {}, None, ["x"]),
+    ("silu", F.silu, {"x": X}, {}, [X / (1 + np.exp(-X))], ["x"]),
+    # losses
+    ("mse_loss", F.mse_loss, {"input": X, "label": Y}, {},
+     [((X - Y) ** 2).mean()], ["input"]),
+    ("l1_loss", F.l1_loss, {"input": X, "label": Y}, {},
+     [np.abs(X - Y).mean()], None),
+    ("log_loss", F.log_loss, {"input": _pos(4, 1) / 2, "label": _pos(4, 1) / 2},
+     {}, None, ["input"]),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c[0] for c in CASES])
+def test_op(case):
+    name, op, inputs, attrs, outputs, grad_inputs = case
+
+    t = OpTest()
+    t.op = op
+    t.inputs = inputs
+    t.attrs = attrs
+    if outputs is None:
+        # reference computed by the op itself in f64-ish sanity mode: only
+        # grad-check these (they're jnp-backed; output equality is circular)
+        t.outputs = None
+    else:
+        t.outputs = outputs
+
+    if outputs is not None:
+        t.check_output(atol=1e-4, rtol=1e-4)
+    if grad_inputs:
+        t.check_grad(grad_inputs)
+
+
+class TestCrossEntropyOp(OpTest):
+    def setUp(self):
+        logits = _randn(4, 5)
+        labels = np.array([0, 2, 4, 1], np.int64)
+        self.op = lambda x: F.cross_entropy(x, paddle.to_tensor(labels))
+        self.inputs = {"x": logits}
+        p = _softmax_np(logits)
+        self.outputs = [np.mean([-np.log(p[i, labels[i]]) for i in range(4)])]
+
+    def test(self):
+        self.check_output(atol=1e-5)
+        self.check_grad(["x"])
+
+
+class TestLayerNormOp(OpTest):
+    def setUp(self):
+        x = _randn(2, 8)
+        self.op = lambda x: F.layer_norm(x, 8)
+        self.inputs = {"x": x}
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        self.outputs = [(x - mu) / np.sqrt(var + 1e-5)]
+
+    def test(self):
+        self.check_output(atol=1e-4, rtol=1e-3)
+        self.check_grad(["x"], atol=5e-3, rtol=5e-2)
+
+
+class TestConv2DOp(OpTest):
+    def setUp(self):
+        x = _randn(1, 2, 5, 5)
+        w = _randn(3, 2, 3, 3)
+        self.op = lambda x, w: F.conv2d(x, w, stride=1, padding=1)
+        self.inputs = {"x": x, "w": w}
+        # direct numpy convolution reference
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        out = np.zeros((1, 3, 5, 5), np.float32)
+        for co in range(3):
+            for i in range(5):
+                for j in range(5):
+                    out[0, co, i, j] = np.sum(
+                        xp[0, :, i:i + 3, j:j + 3] * w[co])
+        self.outputs = [out]
+
+    def test(self):
+        self.check_output(atol=1e-4, rtol=1e-3)
+
+
+class TestAvgPoolOp(OpTest):
+    def setUp(self):
+        x = _randn(1, 1, 4, 4)
+        self.op = lambda x: F.avg_pool2d(x, kernel_size=2, stride=2)
+        self.inputs = {"x": x}
+        out = x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.outputs = [out]
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])

@@ -1,0 +1,143 @@
+"""Op-test burn-down, batch 2: search / logic / stat / creation / indexing ops
+(SURVEY §4 table-driven continuation)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+from op_test import OpTest
+
+rng = np.random.RandomState(11)
+X = rng.randn(3, 4).astype(np.float32)
+Y = rng.randn(3, 4).astype(np.float32)
+I2 = np.array([2, 0], np.int64)
+
+CASES = [
+    # search / sort
+    ("argmax", paddle.argmax, {"x": X}, {"axis": 1}, [X.argmax(1)], None),
+    ("argmin", paddle.argmin, {"x": X}, {"axis": 1}, [X.argmin(1)], None),
+    ("argsort", paddle.argsort, {"x": X}, {"axis": 1}, [X.argsort(1)], None),
+    ("sort", paddle.sort, {"x": X}, {"axis": 1}, [np.sort(X, 1)], ["x"]),
+    ("where", paddle.where,
+     {"c": X > 0, "x": X, "y": Y}, {}, [np.where(X > 0, X, Y)], None),
+    ("masked_select", paddle.masked_select,
+     {"x": X, "mask": np.ones((3, 4), bool)}, {}, [X.reshape(-1)], None),
+    # logic
+    ("equal", paddle.equal, {"x": X, "y": X}, {}, [np.ones((3, 4), bool)], None),
+    ("not_equal", paddle.not_equal, {"x": X, "y": X}, {},
+     [np.zeros((3, 4), bool)], None),
+    ("greater_than", paddle.greater_than, {"x": X, "y": Y}, {}, [X > Y], None),
+    ("less_equal", paddle.less_equal, {"x": X, "y": Y}, {}, [X <= Y], None),
+    ("logical_and", paddle.logical_and,
+     {"x": X > 0, "y": Y > 0}, {}, [(X > 0) & (Y > 0)], None),
+    ("logical_not", paddle.logical_not, {"x": X > 0}, {}, [~(X > 0)], None),
+    ("isfinite", paddle.isfinite, {"x": X}, {}, [np.isfinite(X)], None),
+    ("allclose", paddle.allclose, {"x": X, "y": X}, {}, [np.array(True)], None),
+    # stat
+    ("std", paddle.std, {"x": X}, {}, [X.std(ddof=1)], None),
+    ("var", paddle.var, {"x": X}, {}, [X.var(ddof=1)], None),
+    ("median", paddle.median, {"x": np.arange(5).astype(np.float32)}, {},
+     [np.float32(2.0)], None),
+    ("quantile", paddle.quantile,
+     {"x": np.arange(5).astype(np.float32)}, {"q": 0.5}, [np.float32(2.0)],
+     None),
+    # indexing / gather
+    ("gather", paddle.gather, {"x": X, "index": I2}, {"axis": 0}, [X[I2]],
+     None),
+    ("index_select", paddle.index_select, {"x": X, "index": I2}, {"axis": 0},
+     [X[I2]], None),
+    ("take_along_axis", paddle.take_along_axis,
+     {"x": X, "indices": X.argsort(1)}, {"axis": 1},
+     [np.take_along_axis(X, X.argsort(1), 1)], None),
+    ("diag", paddle.diag, {"x": np.arange(3).astype(np.float32)}, {},
+     [np.diag(np.arange(3).astype(np.float32))], None),
+    ("tril", paddle.tril, {"x": X}, {}, [np.tril(X)], None),
+    ("triu", paddle.triu, {"x": X}, {}, [np.triu(X)], None),
+    # linalg extras
+    ("norm_fro", paddle.linalg.norm, {"x": X}, {},
+     [np.linalg.norm(X)], None),
+    ("cross", paddle.cross,
+     {"x": np.array([[1., 0, 0]], np.float32),
+      "y": np.array([[0., 1, 0]], np.float32)}, {"axis": 1},
+     [np.array([[0., 0, 1]], np.float32)], None),
+    # functional extras
+    ("one_hot", F.one_hot, {"x": np.array([0, 2], np.int64)},
+     {"num_classes": 3},
+     [np.eye(3, dtype=np.float32)[[0, 2]]], None),
+    ("normalize", F.normalize, {"x": X}, {"axis": 1},
+     [X / np.linalg.norm(X, axis=1, keepdims=True)], ["x"]),
+    ("pad1", F.pad, {"x": X}, {"pad": [1, 1, 0, 0]}, None, None),
+    ("cosine_similarity", F.cosine_similarity, {"x1": X, "x2": Y}, {"axis": 1},
+     [np.sum(X * Y, 1) / (np.linalg.norm(X, axis=1) *
+                          np.linalg.norm(Y, axis=1))], None),
+]
+
+
+_EAGER_ONLY = {"masked_select"}  # dynamic output shape -> host-eager by design
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c[0] for c in CASES])
+def test_op(case):
+    name, op, inputs, attrs, outputs, grad_inputs = case
+    t = OpTest()
+    t.op = op
+    t.inputs = inputs
+    t.attrs = attrs
+    t.outputs = outputs
+    if outputs is not None:
+        t.check_output(atol=1e-4, rtol=1e-4, jit=name not in _EAGER_ONLY)
+    if grad_inputs:
+        t.check_grad(grad_inputs)
+
+
+class TestCreationOps:
+    """Creation ops have no tensor inputs — direct value checks."""
+
+    def test_creation_family(self):
+        np.testing.assert_array_equal(
+            np.asarray(paddle.zeros([2, 3])._data), np.zeros((2, 3)))
+        np.testing.assert_array_equal(
+            np.asarray(paddle.ones([2])._data), np.ones(2))
+        np.testing.assert_array_equal(
+            np.asarray(paddle.full([2, 2], 7.0)._data), np.full((2, 2), 7.0))
+        np.testing.assert_array_equal(
+            np.asarray(paddle.arange(5)._data), np.arange(5))
+        np.testing.assert_allclose(
+            np.asarray(paddle.linspace(0, 1, 5)._data), np.linspace(0, 1, 5))
+        np.testing.assert_array_equal(
+            np.asarray(paddle.eye(3)._data), np.eye(3))
+        x = paddle.to_tensor(X)
+        np.testing.assert_array_equal(
+            np.asarray(paddle.zeros_like(x)._data), np.zeros_like(X))
+        np.testing.assert_array_equal(
+            np.asarray(paddle.full_like(x, 2.0)._data), np.full_like(X, 2.0))
+
+    def test_meshgrid_and_tril_indices(self):
+        a, b = paddle.meshgrid(paddle.arange(2), paddle.arange(3))
+        na, nb = np.meshgrid(np.arange(2), np.arange(3), indexing="ij")
+        np.testing.assert_array_equal(np.asarray(a._data), na)
+        np.testing.assert_array_equal(np.asarray(b._data), nb)
+
+
+class TestTopkOp(OpTest):
+    def setUp(self):
+        self.op = paddle.topk
+        self.inputs = {"x": X}
+        self.attrs = {"k": 2, "axis": 1}
+        idx = np.argsort(-X, 1)[:, :2]
+        self.outputs = {"values": np.take_along_axis(X, idx, 1), "indices": idx}
+
+    def test(self):
+        self.check_output()
+
+
+class TestUniqueOp(OpTest):
+    def setUp(self):
+        x = np.array([3., 1., 2., 1., 3.], np.float32)
+        self.op = paddle.unique
+        self.inputs = {"x": x}
+        self.outputs = [np.array([1., 2., 3.], np.float32)]
+
+    def test(self):
+        self.check_output(jit=False)  # dynamic output shape
