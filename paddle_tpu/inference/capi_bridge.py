@@ -1,0 +1,29 @@
+"""Python side of the C inference API (native/capi.cc).
+
+Keeps the C shim free of the numpy C API: the shim passes flat float lists +
+shape, this bridge reshapes, runs the jit-loaded model, and returns
+(flat_output_list, shape_list).
+"""
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def run_float(model, flat, shape):
+    arr = np.asarray(flat, np.float32).reshape([int(s) for s in shape])
+    res = _run(model, arr)
+    return [float(v) for v in res.reshape(-1)], [int(s) for s in res.shape]
+
+
+def run_float_bytes(model, buf, shape):
+    """Zero-boxing path: C passes the raw float32 buffer as bytes."""
+    arr = np.frombuffer(buf, np.float32).reshape([int(s) for s in shape])
+    res = _run(model, arr)
+    return np.ascontiguousarray(res).tobytes(), [int(s) for s in res.shape]
+
+
+def _run(model, arr):
+    out = model(Tensor(arr))
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    return np.asarray(out._data, np.float32)

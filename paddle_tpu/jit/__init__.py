@@ -47,11 +47,28 @@ class StaticFunction:
     parity): caches one compiled XLA program per input signature."""
 
     def __init__(self, fn, input_spec=None, layer=None):
-        self._fn = fn
+        self._fn = self._maybe_dy2static(fn)
         self._layer = layer
         self._input_spec = input_spec
         self._cache = {}
         functools.update_wrapper(self, fn)
+
+    @staticmethod
+    def _maybe_dy2static(fn):
+        """Rewrite tensor-dependent if/while into lax.cond/while_loop
+        (dygraph_to_static transformer parity); fall back to plain tracing."""
+        try:
+            from .dy2static import transform_function
+
+            base = fn.__func__ if hasattr(fn, "__func__") else fn
+            new, n = transform_function(base)
+            if n == 0:
+                return fn
+            if hasattr(fn, "__self__"):
+                return new.__get__(fn.__self__)
+            return new
+        except Exception:
+            return fn
 
     def __get__(self, instance, owner):
         if instance is None:

@@ -1,0 +1,413 @@
+"""dy2static control-flow transforms.
+
+Reference parity: fluid/dygraph/dygraph_to_static/ — `IfElseTransformer`
+(ifelse_transformer.py) and `LoopTransformer` (loop_transformer.py) rewrite
+tensor-dependent python control flow into graph ops (`cond`, `while_loop`)
+inside `@to_static`; `convert_ifelse`/`convert_while_loop` are the runtime
+dispatchers (convert_operators.py) that fall back to plain python control flow
+when the predicate is a host value.
+
+TPU-native design: the rewrite targets `jax.lax.cond` / `jax.lax.while_loop`
+(compiled, MXU-friendly control flow — SURVEY.md "no data-dependent Python
+control flow inside jit"). Scope is the structured subset that covers real
+model code:
+  - `if`/`elif`/`else` whose branches assign locals (no return/break inside),
+  - `while` loops whose bodies assign locals (no break/continue/return).
+Anything else — or any function we cannot re-compile (closures, missing
+source) — is left untouched and falls back to plain tracing, which is already
+correct for host-value predicates.
+"""
+import ast
+import inspect
+import textwrap
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["convert_ifelse", "convert_while_loop", "transform_function"]
+
+
+def _is_traced(x):
+    return isinstance(x, Tensor) or isinstance(x, jax.core.Tracer) or (
+        hasattr(x, "dtype") and hasattr(x, "shape") and not isinstance(x, bool))
+
+
+def _raw(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return x
+
+
+def _to_carry(vals):
+    """Carry elements through lax control flow as arrays; remember wrappers."""
+    raws, kinds = [], []
+    for v in vals:
+        if isinstance(v, Tensor):
+            raws.append(v._data)
+            kinds.append("tensor")
+        elif isinstance(v, (bool, int, float)) or hasattr(v, "dtype"):
+            raws.append(jnp.asarray(v))
+            kinds.append("array")
+        else:
+            raise TypeError(f"unsupported carry value {type(v).__name__}")
+    return tuple(raws), kinds
+
+
+def _from_carry(raws, kinds):
+    out = []
+    for r, k in zip(raws, kinds):
+        out.append(Tensor(r) if k == "tensor" else r)
+    return tuple(out)
+
+
+def convert_ifelse(pred, true_fn, false_fn, seed=()):
+    """Runtime dispatch for rewritten `if`: lax.cond when pred is traced.
+
+    `seed` carries the pre-branch values of names either branch may read or
+    rebind (so aug-assigns see the outer binding); branch fns take it as their
+    single argument."""
+    p = _raw(pred)
+    if not _is_traced(p):
+        return true_fn(seed) if p else false_fn(seed)
+
+    seed_raws, seed_kinds = _to_carry(seed)
+    kinds_box = {}
+
+    def wrap(fn, tag):
+        def pure(raw_seed):
+            out = fn(_from_carry(raw_seed, seed_kinds))
+            out = out if isinstance(out, tuple) else (out,)
+            raws, kinds = _to_carry(out)
+            kinds_box[tag] = kinds
+            return raws
+        return pure
+
+    raws = jax.lax.cond(jnp.asarray(p).astype(bool), wrap(true_fn, "t"),
+                        wrap(false_fn, "f"), seed_raws)
+    if kinds_box.get("t") != kinds_box.get("f"):
+        raise TypeError(
+            "convert_ifelse branches returned different value kinds "
+            f"({kinds_box.get('t')} vs {kinds_box.get('f')}); both branches "
+            "must produce the same Tensor/array structure")
+    return _from_carry(raws, kinds_box["t"])
+
+
+def convert_while_loop(cond_fn, body_fn, carry):
+    """Runtime dispatch for rewritten `while`: lax.while_loop when the
+    condition is traced. Carried values become arrays (ints/floats included),
+    matching the reference's tensor-loop-var semantics."""
+    first = cond_fn(carry)
+    if not _is_traced(_raw(first)):
+        while cond_fn(carry):
+            carry = body_fn(carry)
+        return carry
+
+    raws, kinds = _to_carry(carry)
+
+    def cond(raw_carry):
+        c = cond_fn(_from_carry(raw_carry, kinds))
+        return jnp.asarray(_raw(c)).astype(bool)
+
+    def body(raw_carry):
+        out = body_fn(_from_carry(raw_carry, kinds))
+        new_raws, _ = _to_carry(out)
+        return new_raws
+
+    final = jax.lax.while_loop(cond, body, raws)
+    return _from_carry(final, kinds)
+
+
+# ---------------- AST rewrite -------------------------------------------------
+
+_BAD_IF = (ast.Return, ast.Break, ast.Continue, ast.Yield, ast.YieldFrom)
+_BAD_LOOP = _BAD_IF
+
+
+def _contains(nodes, kinds):
+    """True if any node of `kinds` appears in the CURRENT scope (a Return in
+    a nested def — e.g. an already-generated __dy2st_* helper — is its own
+    scope's concern, not the enclosing control flow's)."""
+    def walk(n):
+        if isinstance(n, kinds):
+            return True
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            if walk(child):
+                return True
+        return False
+
+    return any(walk(n) for n in nodes
+               if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)))
+
+
+def _assigned_names(nodes):
+    """Plain-Name assignment targets in a statement list (incl. aug-assign)."""
+    names = set()
+    for n in nodes:
+        names |= _scoped_assigned(n)
+    return names
+
+
+def _target_names(t):
+    """Local names bound by an assignment target. Subscript/Attribute targets
+    bind nothing (`d[k] = v` must not collect `k`)."""
+    if isinstance(t, ast.Name):
+        return {t.id}
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out = set()
+        for e in t.elts:
+            out |= _target_names(e)
+        return out
+    if isinstance(t, ast.Starred):
+        return _target_names(t.value)
+    return set()
+
+
+def _scoped_assigned(node):
+    """Names bound by `node` in the CURRENT scope — does not descend into
+    nested function/class scopes, and skips generated __dy2st_* helpers."""
+    names = set()
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            names |= _target_names(t)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        names |= _target_names(node.target)
+    elif isinstance(node, (ast.For, ast.AsyncFor)):
+        names |= _target_names(node.target)
+    elif isinstance(node, (ast.With, ast.AsyncWith)):
+        for item in node.items:
+            if item.optional_vars is not None:
+                names |= _target_names(item.optional_vars)
+    elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+        if not node.name.startswith("__dy2st_"):
+            names.add(node.name)
+        return names  # do not descend into the nested scope
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            if not child.name.startswith("__dy2st_"):
+                names.add(child.name)
+            continue
+        names |= _scoped_assigned(child)
+    return names
+
+
+def _annotate_bound_before(fdef):
+    """Attach `_bound_before` (names surely bound when control reaches the
+    node) to every If/While in the function scope."""
+    bound = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args
+                             + fdef.args.kwonlyargs)}
+    if fdef.args.vararg:
+        bound.add(fdef.args.vararg.arg)
+    if fdef.args.kwarg:
+        bound.add(fdef.args.kwarg.arg)
+
+    def walk(stmts, bound):
+        for st in stmts:
+            if isinstance(st, (ast.If, ast.While)):
+                st._bound_before = set(bound)
+            if isinstance(st, ast.If):
+                walk(st.body, set(bound))
+                walk(st.orelse, set(bound))
+            elif isinstance(st, (ast.While, ast.For)):
+                inner = set(bound)
+                if isinstance(st, ast.For):
+                    inner |= _target_names(st.target)
+                walk(st.body, inner)
+                walk(st.orelse, set(bound))
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                walk(st.body, bound)
+            elif isinstance(st, ast.Try):
+                for blk in (st.body, st.orelse, st.finalbody):
+                    walk(blk, set(bound))
+                for h in st.handlers:
+                    walk(h.body, set(bound))
+            bound |= _scoped_assigned(st)
+
+    walk(fdef.body, bound)
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.applied = 0
+
+    def _names_tuple(self, names, ctx):
+        return ast.Tuple(elts=[ast.Name(id=n, ctx=ctx()) for n in names],
+                         ctx=ctx())
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _contains(node.body + node.orelse, _BAD_IF):
+            return node
+        if _host_only_pred(node.test):
+            return node  # `x is None` / `self.training`-style flags: plain if
+        bound_before = getattr(node, "_bound_before", set())
+        a_true = _assigned_names(node.body)
+        a_false = _assigned_names(node.orelse)
+        assigned = a_true | a_false
+        if not assigned:
+            return node
+        seed = sorted(assigned & bound_before)
+        both = sorted((a_true & a_false) - set(seed))
+        if set(seed) | set(both) != assigned:
+            return node  # a name assigned in only one branch with no prior
+                         # binding: the untaken branch could not return it
+        names = seed + both
+        i = self.counter
+        self.counter += 1
+        carry_arg = f"__dy2st_carry_{i}"
+        # branch fns take the seed values as a carry tuple so reads (incl.
+        # aug-assign reads) see the pre-branch bindings
+        unpack = ([ast.Assign(targets=[self._names_tuple(seed, ast.Store)],
+                              value=ast.Name(id=carry_arg, ctx=ast.Load()))]
+                  if seed else [])
+        ret = ast.Return(value=self._names_tuple(names, ast.Load))
+        true_fn = ast.FunctionDef(
+            name=f"__dy2st_true_{i}",
+            body=[_copy_stmt(s) for s in unpack] + list(node.body) + [ret],
+            args=_one_arg(carry_arg), decorator_list=[])
+        false_fn = ast.FunctionDef(
+            name=f"__dy2st_false_{i}",
+            body=[_copy_stmt(s) for s in unpack] + list(node.orelse) + [
+                ast.Return(value=self._names_tuple(names, ast.Load))],
+            args=_one_arg(carry_arg), decorator_list=[])
+        call = ast.Assign(
+            targets=[self._names_tuple(names, ast.Store)],
+            value=ast.Call(
+                func=ast.Name(id="__dy2st_ifelse", ctx=ast.Load()),
+                args=[node.test,
+                      ast.Name(id=true_fn.name, ctx=ast.Load()),
+                      ast.Name(id=false_fn.name, ctx=ast.Load()),
+                      self._names_tuple(seed, ast.Load)],
+                keywords=[]))
+        self.applied += 1
+        return [true_fn, false_fn, call]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _contains(node.body, _BAD_LOOP):
+            return node
+        bound_before = getattr(node, "_bound_before", set())
+        assigned = _assigned_names(node.body)
+        # loop-local temporaries (never bound before the loop) stay local to
+        # the body fn; the carry holds only pre-bound names
+        names = sorted(assigned & bound_before)
+        if not names:
+            return node
+        i = self.counter
+        self.counter += 1
+        carry_arg = f"__dy2st_carry_{i}"
+        unpack = ast.Assign(
+            targets=[self._names_tuple(names, ast.Store)],
+            value=ast.Name(id=carry_arg, ctx=ast.Load()))
+        cond_fn = ast.FunctionDef(
+            name=f"__dy2st_cond_{i}",
+            body=[unpack, ast.Return(value=node.test)],
+            args=_one_arg(carry_arg), decorator_list=[])
+        body_fn = ast.FunctionDef(
+            name=f"__dy2st_body_{i}",
+            body=[_copy_stmt(unpack)] + list(node.body) + [
+                ast.Return(value=self._names_tuple(names, ast.Load))],
+            args=_one_arg(carry_arg), decorator_list=[])
+        call = ast.Assign(
+            targets=[self._names_tuple(names, ast.Store)],
+            value=ast.Call(
+                func=ast.Name(id="__dy2st_while", ctx=ast.Load()),
+                args=[ast.Name(id=cond_fn.name, ctx=ast.Load()),
+                      ast.Name(id=body_fn.name, ctx=ast.Load()),
+                      self._names_tuple(names, ast.Load)],
+                keywords=[]))
+        self.applied += 1
+        return [cond_fn, body_fn, call]
+
+
+def _host_only_pred(test):
+    """Predicates that are host flags, not tensors: `x is (not) None`, a bare
+    name/attribute (`self.training`, `flag`), `not <host>`, `isinstance(...)`,
+    or boolean combinations thereof."""
+    if isinstance(test, (ast.Name, ast.Attribute, ast.Constant)):
+        return True
+    if isinstance(test, ast.Compare):
+        if any(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+               for op in test.ops):
+            return True
+        return False
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _host_only_pred(test.operand)
+    if isinstance(test, ast.BoolOp):
+        return all(_host_only_pred(v) for v in test.values)
+    if isinstance(test, ast.Call):
+        fn = test.func
+        if isinstance(fn, ast.Name) and fn.id in ("isinstance", "hasattr",
+                                                  "len", "callable"):
+            return True
+    return False
+
+
+def _no_args():
+    return ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                         kw_defaults=[], defaults=[])
+
+
+def _one_arg(name):
+    return ast.arguments(posonlyargs=[], args=[ast.arg(arg=name)],
+                         kwonlyargs=[], kw_defaults=[], defaults=[])
+
+
+def _copy_stmt(stmt):
+    return ast.parse(ast.unparse(ast.fix_missing_locations(
+        ast.Module(body=[stmt], type_ignores=[])))).body[0]
+
+
+def transform_function(fn):
+    """Rewrite tensor control flow in `fn`. Returns (new_fn, n_transforms);
+    (fn, 0) when nothing applies or the function cannot be rewritten."""
+    cached = getattr(fn, "__dy2static_cache__", None)
+    if cached is not None:
+        return cached  # (new_fn, n) memo — transform runs once per function
+    if getattr(fn, "__closure__", None):
+        return fn, 0  # cannot rebuild closure cells faithfully
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn, 0
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return fn, 0
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn, 0
+    fdef.decorator_list = []  # decorators already applied to the original
+
+    _annotate_bound_before(fdef)
+    tr = _ControlFlowTransformer()
+    tr.visit(tree)
+    if tr.applied == 0:
+        try:
+            fn.__dy2static_cache__ = (fn, 0)
+        except (AttributeError, TypeError):
+            pass
+        return fn, 0
+    ast.fix_missing_locations(tree)
+
+    globs = dict(fn.__globals__)
+    globs["__dy2st_ifelse"] = convert_ifelse
+    globs["__dy2st_while"] = convert_while_loop
+    code = compile(tree, filename=f"<dy2static:{fn.__name__}>", mode="exec")
+    ns = {}
+    exec(code, globs, ns)
+    new_fn = ns[fdef.name]
+    new_fn.__dy2static_transforms__ = tr.applied
+    try:
+        fn.__dy2static_cache__ = (new_fn, tr.applied)
+    except (AttributeError, TypeError):
+        pass
+    return new_fn, tr.applied

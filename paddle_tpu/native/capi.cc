@@ -1,0 +1,203 @@
+// C inference API — native shim over the paddle_tpu predictor.
+//
+// Reference parity: paddle/fluid/inference/capi/ (PD_NewAnalysisConfig,
+// PD_NewPredictor, PD_PredictorRun, paddle_c_api.h) — the C surface that the Go
+// (go/paddle/predictor.go) and R clients wrap.
+//
+// TPU-native design: the predictor's execution engine is XLA reached through
+// Python (jit.load -> jax), so the C ABI embeds the CPython interpreter rather
+// than re-implementing a runtime: each call acquires the GIL (PyGILState) and
+// drives paddle_tpu.inference. Inside an existing Python process (the test
+// path, and any embedder that already runs Python) the resident interpreter is
+// reused; standalone C hosts get one via Py_Initialize.
+//
+// API (see native/paddle_tpu_capi.h):
+//   PD_Init() / PD_Finalize()
+//   PD_CreatePredictor(model_prefix)        -> handle (0 on failure)
+//   PD_PredictorRunFloat(h, in, shape, ndim, out_buf, out_shape, max_*)
+//   PD_DestroyPredictor(h)
+//   PD_GetLastError()                       -> thread-local message
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const char* where) {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  g_last_error = where;
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      g_last_error += ": ";
+      g_last_error += PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+struct Predictor {
+  PyObject* obj;  // paddle_tpu TranslatedLayer / Predictor callable
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* PD_GetLastError() { return g_last_error.c_str(); }
+
+int PD_Init() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+  }
+  return Py_IsInitialized() ? 0 : -1;
+}
+
+void PD_Finalize() {
+  // no-op when embedded in a live Python process; standalone hosts may call
+  // Py_FinalizeEx themselves once all predictors are destroyed
+}
+
+void* PD_CreatePredictor(const char* model_prefix) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  void* result = nullptr;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.jit");
+  if (!mod) {
+    set_error("import paddle_tpu.jit failed");
+  } else {
+    PyObject* loaded =
+        PyObject_CallMethod(mod, "load", "s", model_prefix);
+    if (!loaded) {
+      set_error("jit.load failed");
+    } else {
+      Predictor* p = new Predictor{loaded};
+      result = p;
+    }
+    Py_DECREF(mod);
+  }
+  PyGILState_Release(gil);
+  return result;
+}
+
+void PD_DestroyPredictor(void* h) {
+  if (!h) return;
+  Predictor* p = static_cast<Predictor*>(h);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(p->obj);
+  PyGILState_Release(gil);
+  delete p;
+}
+
+// Runs the predictor on one float32 input; writes up to max_elems outputs.
+// Returns number of output elements, or -1 on error.
+int64_t PD_PredictorRunFloat(void* h, const float* data, const int64_t* shape,
+                             int ndim, float* out_buf, int64_t max_elems,
+                             int64_t* out_shape, int max_out_dims,
+                             int* out_ndim) {
+  if (!h) {
+    g_last_error = "null predictor";
+    return -1;
+  }
+  Predictor* p = static_cast<Predictor*>(h);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int64_t n_out = -1;
+
+  do {
+    if (ndim <= 0 || ndim > 16) {
+      g_last_error = "invalid ndim";
+      break;
+    }
+    int64_t n_in = 1;
+    bool bad = false;
+    for (int i = 0; i < ndim; ++i) {
+      if (shape[i] <= 0 || n_in > (int64_t{1} << 40) / (shape[i] + 1)) {
+        bad = true;
+        break;
+      }
+      n_in *= shape[i];
+    }
+    if (bad) {
+      g_last_error = "invalid shape (non-positive or overflowing dims)";
+      break;
+    }
+    // marshal via bytes (no per-element boxing; bridge uses np.frombuffer)
+    PyObject* buf = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(data), n_in * sizeof(float));
+    PyObject* shp = buf ? PyList_New(ndim) : nullptr;
+    if (!buf || !shp) {
+      set_error("allocation failed");
+      Py_XDECREF(buf);
+      Py_XDECREF(shp);
+      break;
+    }
+    bool shp_ok = true;
+    for (int i = 0; i < ndim; ++i) {
+      PyObject* v = PyLong_FromLongLong(shape[i]);
+      if (!v) {
+        shp_ok = false;
+        break;
+      }
+      PyList_SET_ITEM(shp, i, v);
+    }
+    if (!shp_ok) {
+      set_error("allocation failed");
+      Py_DECREF(buf);
+      Py_DECREF(shp);
+      break;
+    }
+    PyObject* helper = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
+    if (!helper) {
+      set_error("import capi_bridge failed");
+      Py_DECREF(buf);
+      Py_DECREF(shp);
+      break;
+    }
+    PyObject* res =
+        PyObject_CallMethod(helper, "run_float_bytes", "OOO", p->obj, buf, shp);
+    Py_DECREF(helper);
+    Py_DECREF(buf);
+    Py_DECREF(shp);
+    if (!res) {
+      set_error("predictor run failed");
+      break;
+    }
+    // res = (bytes, shape_list)
+    PyObject* out_bytes = PyTuple_GetItem(res, 0);
+    PyObject* out_shp = PyTuple_GetItem(res, 1);
+    char* raw = nullptr;
+    Py_ssize_t raw_len = 0;
+    if (!out_bytes || !out_shp ||
+        PyBytes_AsStringAndSize(out_bytes, &raw, &raw_len) != 0) {
+      set_error("malformed bridge result");
+      Py_DECREF(res);
+      break;
+    }
+    Py_ssize_t n = raw_len / static_cast<Py_ssize_t>(sizeof(float));
+    Py_ssize_t nd = PyList_Size(out_shp);
+    if (n > max_elems || nd > max_out_dims) {
+      g_last_error = "output buffer too small";
+      Py_DECREF(res);
+      break;
+    }
+    std::memcpy(out_buf, raw, n * sizeof(float));
+    for (Py_ssize_t i = 0; i < nd; ++i) {
+      out_shape[i] = PyLong_AsLongLong(PyList_GetItem(out_shp, i));
+    }
+    *out_ndim = static_cast<int>(nd);
+    n_out = static_cast<int64_t>(n);
+    Py_DECREF(res);
+  } while (false);
+
+  PyGILState_Release(gil);
+  return n_out;
+}
+
+}  // extern "C"

@@ -1,0 +1,35 @@
+/* C inference API for paddle_tpu — header for external (C/Go/R) clients.
+ *
+ * Reference parity: paddle/fluid/inference/capi/paddle_c_api.h. Build the shim
+ * with:  g++ -O2 -fPIC -shared $(python3-config --includes) -o libpaddle_tpu_capi.so capi.cc
+ * Standalone (non-Python) hosts must also link $(python3-config --embed --ldflags).
+ */
+#ifndef PADDLE_TPU_CAPI_H_
+#define PADDLE_TPU_CAPI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Initialize the runtime (embeds CPython when not already hosted). 0 = ok. */
+int PD_Init(void);
+void PD_Finalize(void);
+
+/* Load a jit.save'd model by path prefix. NULL on failure (see PD_GetLastError). */
+void* PD_CreatePredictor(const char* model_prefix);
+void PD_DestroyPredictor(void* predictor);
+
+/* Run on one float32 input tensor. Returns #output elements or -1 on error. */
+int64_t PD_PredictorRunFloat(void* predictor, const float* data,
+                             const int64_t* shape, int ndim, float* out_buf,
+                             int64_t max_elems, int64_t* out_shape,
+                             int max_out_dims, int* out_ndim);
+
+const char* PD_GetLastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PADDLE_TPU_CAPI_H_ */

@@ -1,0 +1,108 @@
+"""Book tests — small models trained to convergence thresholds.
+
+Reference parity: fluid/tests/book/ (test_fit_a_line.py, test_word2vec_book.py,
+test_recognize_digits.py) — the reference gates on reaching a loss/accuracy
+threshold, not just 'loss went down'."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+class TestFitALine:
+    def test_linear_regression_converges(self):
+        """fit_a_line: recover a known linear map to tight MSE."""
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        true_w = rng.randn(13, 1).astype(np.float32)
+        X = rng.randn(256, 13).astype(np.float32)
+        Y = X @ true_w + 0.01 * rng.randn(256, 1).astype(np.float32)
+
+        net = nn.Linear(13, 1)
+        opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                    parameters=net.parameters())
+        xs, ys = paddle.to_tensor(X), paddle.to_tensor(Y)
+        loss_val = None
+        for _ in range(150):
+            loss = F.mse_loss(net(xs), ys)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            loss_val = float(np.asarray(loss._data))
+        assert loss_val < 1e-2, f"fit_a_line failed to converge: {loss_val}"
+        w = np.asarray(net.weight._data)
+        np.testing.assert_allclose(w, true_w, atol=0.05)
+
+
+class TestWord2Vec:
+    def test_skipgram_embeddings_learn_cooccurrence(self):
+        """word2vec book test: after training on a deterministic corpus,
+        words that co-occur score higher than words that never do."""
+        paddle.seed(0)
+        V, D = 20, 8
+        rng = np.random.RandomState(1)
+        # synthetic corpus: word 2i and 2i+1 always co-occur
+        centers, contexts = [], []
+        for _ in range(400):
+            i = rng.randint(0, V // 2)
+            centers.append(2 * i)
+            contexts.append(2 * i + 1)
+        centers = np.asarray(centers, np.int64)
+        contexts = np.asarray(contexts, np.int64)
+
+        emb_in = nn.Embedding(V, D)
+        emb_out = nn.Embedding(V, D)
+        opt = paddle.optimizer.Adam(
+            learning_rate=0.05,
+            parameters=list(emb_in.parameters()) + list(emb_out.parameters()))
+
+        for start in range(0, 400, 100):
+            for _ in range(10):
+                c = paddle.to_tensor(centers[start:start + 100])
+                o = paddle.to_tensor(contexts[start:start + 100])
+                h = emb_in(c)                      # [B, D]
+                logits = paddle.matmul(h, emb_out.weight, transpose_y=True)
+                loss = F.cross_entropy(logits, o)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+
+        wi = np.asarray(emb_in.weight._data)
+        wo = np.asarray(emb_out.weight._data)
+        scores = wi @ wo.T                        # [V, V]
+        # each even word must rank its partner top-1 among all words
+        correct = sum(int(scores[2 * i].argmax()) == 2 * i + 1
+                      for i in range(V // 2))
+        assert correct >= V // 2 - 1, f"only {correct}/{V//2} pairs learned"
+
+
+class TestRecognizeDigits:
+    def test_mlp_reaches_accuracy_threshold(self):
+        """recognize_digits: blobby synthetic 'digits' to >=90% train accuracy
+        via the high-level Model API."""
+        paddle.seed(0)
+        rng = np.random.RandomState(2)
+        n, n_cls = 256, 10
+        protos = rng.randn(n_cls, 64).astype(np.float32) * 2
+        labels = rng.randint(0, n_cls, n).astype(np.int64)
+        X = protos[labels] + 0.3 * rng.randn(n, 64).astype(np.float32)
+
+        class DS(paddle.io.Dataset):
+            def __len__(self):
+                return n
+
+            def __getitem__(self, i):
+                return X[i], labels[i:i + 1]
+
+        net = nn.Sequential(nn.Linear(64, 32), nn.ReLU(), nn.Linear(32, n_cls))
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.Adam(learning_rate=1e-2,
+                                  parameters=net.parameters()),
+            nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+        model.fit(DS(), epochs=5, batch_size=64, verbose=0)
+        result = model.evaluate(DS(), batch_size=64, verbose=0)
+        acc = result["acc"] if isinstance(result, dict) else result[-1]
+        acc = float(acc[0] if isinstance(acc, (list, tuple)) else acc)
+        assert acc >= 0.9, f"digit accuracy {acc} < 0.9"

@@ -1,0 +1,79 @@
+"""C inference API tests: build the native shim, load a jit-saved model through
+the C ABI via ctypes, and compare against the in-process Python predictor."""
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+_NATIVE = os.path.join(os.path.dirname(paddle.__file__), "native")
+_SRC = os.path.join(_NATIVE, "capi.cc")
+_SO = os.path.join(_NATIVE, "libpaddle_tpu_capi.so")
+
+
+def _build():
+    if (os.path.exists(_SO)
+            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+        return _SO
+    inc = subprocess.run(["python3-config", "--includes"], check=True,
+                         capture_output=True, text=True).stdout.split()
+    subprocess.run(["g++", "-O2", "-fPIC", "-shared", "-std=c++17", *inc,
+                    "-o", _SO, _SRC], check=True, capture_output=True)
+    return _SO
+
+
+class TestCAPI:
+    def test_c_abi_predict_roundtrip(self, tmp_path):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+        net.eval()
+        prefix = str(tmp_path / "capi_model")
+        paddle.jit.save(net, prefix,
+                        input_spec=[paddle.jit.InputSpec([2, 4], "float32")])
+
+        lib = ctypes.CDLL(_build())
+        lib.PD_Init.restype = ctypes.c_int
+        lib.PD_CreatePredictor.restype = ctypes.c_void_p
+        lib.PD_CreatePredictor.argtypes = [ctypes.c_char_p]
+        lib.PD_GetLastError.restype = ctypes.c_char_p
+        lib.PD_PredictorRunFloat.restype = ctypes.c_int64
+        lib.PD_PredictorRunFloat.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int)]
+        lib.PD_DestroyPredictor.argtypes = [ctypes.c_void_p]
+
+        assert lib.PD_Init() == 0
+        h = lib.PD_CreatePredictor(prefix.encode())
+        assert h, lib.PD_GetLastError().decode()
+
+        x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        shape = (ctypes.c_int64 * 2)(2, 4)
+        out_buf = (ctypes.c_float * 64)()
+        out_shape = (ctypes.c_int64 * 8)()
+        out_ndim = ctypes.c_int(0)
+        n = lib.PD_PredictorRunFloat(
+            h, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), shape, 2,
+            out_buf, 64, out_shape, 8, ctypes.byref(out_ndim))
+        assert n == 6, lib.PD_GetLastError().decode()
+        assert list(out_shape[:out_ndim.value]) == [2, 3]
+
+        got = np.array(out_buf[:6], np.float32).reshape(2, 3)
+        ref = np.asarray(net(paddle.to_tensor(x))._data)
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+        lib.PD_DestroyPredictor(h)
+
+    def test_c_abi_error_reporting(self):
+        lib = ctypes.CDLL(_build())
+        lib.PD_CreatePredictor.restype = ctypes.c_void_p
+        lib.PD_CreatePredictor.argtypes = [ctypes.c_char_p]
+        lib.PD_GetLastError.restype = ctypes.c_char_p
+        h = lib.PD_CreatePredictor(b"/nonexistent/model")
+        assert not h
+        assert b"load" in lib.PD_GetLastError()

@@ -1,0 +1,214 @@
+"""dy2static control-flow transform tests (ifelse/loop transformer parity)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import (
+    convert_ifelse, convert_while_loop, transform_function,
+)
+
+
+class TestRuntimeDispatch:
+    def test_ifelse_python_pred(self):
+        out = convert_ifelse(True, lambda _: (1,), lambda _: (2,))
+        assert out == (1,)
+        out = convert_ifelse(False, lambda _: (1,), lambda _: (2,))
+        assert out == (2,)
+
+    def test_ifelse_tensor_pred(self):
+        x = paddle.to_tensor(3.0)
+        (y,) = convert_ifelse(x > paddle.to_tensor(0.0),
+                              lambda s: (s[0] * paddle.to_tensor(2.0),),
+                              lambda s: (s[0] - paddle.to_tensor(1.0),),
+                              seed=(x,))
+        assert float(np.asarray(y._data)) == 6.0
+
+    def test_ifelse_mismatched_branch_kinds_rejected(self):
+        import jax.numpy as jnp
+
+        x = paddle.to_tensor(1.0)
+        with pytest.raises(TypeError, match="different value kinds"):
+            convert_ifelse(x > paddle.to_tensor(0.0),
+                           lambda s: (jnp.zeros(2),),
+                           lambda s: (paddle.to_tensor(np.ones(2, np.float32)),))
+
+    def test_while_python_cond(self):
+        out = convert_while_loop(lambda c: c[0] < 5,
+                                 lambda c: (c[0] + 1,), (0,))
+        assert out == (5,)
+
+    def test_while_tensor_cond(self):
+        i0 = paddle.to_tensor(0.0)
+        (i,) = convert_while_loop(
+            lambda c: c[0] < paddle.to_tensor(5.0),
+            lambda c: (c[0] + paddle.to_tensor(1.0),), (i0,))
+        assert float(np.asarray(i._data)) == 5.0
+
+
+class TestASTTransform:
+    def test_if_transformed_and_jittable(self):
+        def f(x):
+            if (x.sum() > paddle.to_tensor(0.0)):
+                y = x * paddle.to_tensor(2.0)
+            else:
+                y = x - paddle.to_tensor(1.0)
+            return y
+
+        new, n = transform_function(f)
+        assert n == 1
+        xp = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        xn = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+        np.testing.assert_allclose(np.asarray(new(xp)._data), [2.0, 4.0])
+        np.testing.assert_allclose(np.asarray(new(xn)._data), [-2.0, -3.0])
+
+        # under @to_static the lax.cond path compiles (no trace-time branch)
+        static = paddle.jit.to_static(f)
+        np.testing.assert_allclose(np.asarray(static(xp)._data), [2.0, 4.0])
+        np.testing.assert_allclose(np.asarray(static(xn)._data), [-2.0, -3.0])
+
+    def test_trace_only_would_freeze_branch(self):
+        """Without the transform, tracing bakes in one branch — the transform
+        is what makes both sides of the data-dependent if reachable."""
+        def f(x):
+            if (x.sum() > paddle.to_tensor(0.0)):
+                y = x * paddle.to_tensor(2.0)
+            else:
+                y = x - paddle.to_tensor(1.0)
+            return y
+
+        static = paddle.jit.to_static(f)
+        xp = paddle.to_tensor(np.array([1.0], np.float32))
+        xn = paddle.to_tensor(np.array([-1.0], np.float32))
+        # same shape/dtype -> same compiled cache entry; both branches correct
+        np.testing.assert_allclose(np.asarray(static(xp)._data), [2.0])
+        np.testing.assert_allclose(np.asarray(static(xn)._data), [-2.0])
+
+    def test_while_transformed(self):
+        def f(x):
+            i = paddle.to_tensor(0.0)
+            s = paddle.to_tensor(0.0)
+            while (i < x):
+                s = s + i
+                i = i + paddle.to_tensor(1.0)
+            return s
+
+        new, n = transform_function(f)
+        assert n == 1
+        out = new(paddle.to_tensor(5.0))
+        assert float(np.asarray(out._data)) == 10.0  # 0+1+2+3+4
+
+        static = paddle.jit.to_static(f)
+        out2 = static(paddle.to_tensor(5.0))
+        assert float(np.asarray(out2._data)) == 10.0
+
+    def test_untransformable_falls_back(self):
+        def f(x):
+            if x.sum() > paddle.to_tensor(0.0):
+                return x  # return inside branch -> not transformed
+            return x * paddle.to_tensor(2.0)
+
+        new, n = transform_function(f)
+        assert n == 0 and new is f
+
+    def test_layer_forward_with_tensor_if(self):
+        from paddle_tpu import nn
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if (h.sum() > paddle.to_tensor(0.0)):
+                    out = h * paddle.to_tensor(2.0)
+                else:
+                    out = -h
+                return out
+
+        paddle.seed(0)
+        net = Net()
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+        eager = net(x)
+        static_net = paddle.jit.to_static(Net())
+        static_net.set_state_dict(net.state_dict())
+        out = static_net(x)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(eager._data), atol=1e-5)
+
+    def test_host_flag_ifs_not_transformed(self):
+        def f(x, mask=None):
+            if mask is not None:
+                z = x + mask
+                x = z * paddle.to_tensor(2.0)
+            if isinstance(x, object):
+                w = x
+            return x
+
+        # `is not None` / isinstance guards stay plain python — no NameError
+        # from the untaken branch's unbound locals
+        new, n = transform_function(f)
+        assert n == 0
+
+    def test_loop_local_temp_supported(self):
+        """Regression: temporaries first assigned inside the loop body must
+        not poison the carry (code-review finding)."""
+        def f(x, n):
+            i = paddle.to_tensor(0.0)
+            while (i < n):
+                t = x * paddle.to_tensor(2.0)
+                x = x + t
+                i = i + paddle.to_tensor(1.0)
+            return x
+
+        new, cnt = transform_function(f)
+        assert cnt == 1
+        out = new(paddle.to_tensor(1.0), paddle.to_tensor(3.0))
+        assert float(np.asarray(out._data)) == 27.0  # x *= 3 each iter
+
+    def test_if_augassign_supported(self):
+        """Regression: aug-assign in a rewritten branch reads the pre-branch
+        binding via the seed carry (code-review finding)."""
+        def f(x, n):
+            s = x
+            if (n > paddle.to_tensor(0.0)):
+                s += x
+            return s
+
+        new, cnt = transform_function(f)
+        assert cnt == 1
+        out = new(paddle.to_tensor(2.0), paddle.to_tensor(1.0))
+        assert float(np.asarray(out._data)) == 4.0
+        out = new(paddle.to_tensor(2.0), paddle.to_tensor(-1.0))
+        assert float(np.asarray(out._data)) == 2.0
+
+    def test_disjoint_branch_assignment_skipped(self):
+        """`if: y=.. else: z=..` with no prior bindings cannot be rewritten."""
+        def f(x):
+            if (x.sum() > paddle.to_tensor(0.0)):
+                y = x
+            else:
+                z = -x
+            return x
+
+        new, cnt = transform_function(f)
+        assert cnt == 0
+
+    def test_nested_if_in_while(self):
+        """Regression: generated __dy2st_* helpers of an inner rewrite must
+        not leak into the outer loop carry (code-review finding)."""
+        def f(x):
+            i = paddle.to_tensor(0.0)
+            while (i < paddle.to_tensor(3.0)):
+                if (x.sum() > paddle.to_tensor(0.0)):
+                    x = x - paddle.to_tensor(1.0)
+                else:
+                    x = x + paddle.to_tensor(1.0)
+                i = i + paddle.to_tensor(1.0)
+            return x
+
+        new, cnt = transform_function(f)
+        assert cnt == 2
+        out = new(paddle.to_tensor(np.array([2.0], np.float32)))
+        # 3 iters: 2>0 -> 1; 1>0 -> 0; 0>0 false -> +1 => 1
+        assert float(np.asarray(out._data)[0]) == 1.0
