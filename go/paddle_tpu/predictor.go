@@ -1,0 +1,96 @@
+// Go inference client for paddle_tpu — cgo wrapper over the C API.
+//
+// Reference parity: go/paddle/predictor.go (cgo over inference/capi).
+// Build: the shared library must be built first (see
+// paddle_tpu/native/paddle_tpu_capi.h), then:
+//
+//	CGO_LDFLAGS="-L<path> -lpaddle_tpu_capi $(python3-config --embed --ldflags)" go build
+//
+// NOTE: no Go toolchain ships in the framework CI image, so this client is
+// compiled and exercised by downstream users; the C ABI itself is tested in
+// tests/test_capi.py.
+package paddle_tpu
+
+/*
+#cgo LDFLAGS: -lpaddle_tpu_capi
+#include <stdint.h>
+#include <stdlib.h>
+#include "paddle_tpu_capi.h"
+*/
+import "C"
+
+import (
+	"errors"
+	"unsafe"
+)
+
+// Predictor wraps a jit.save'd paddle_tpu model. Not safe for concurrent Run
+// calls on the same instance (outBuf is reused).
+type Predictor struct {
+	handle unsafe.Pointer
+	outBuf []float32
+}
+
+// Init initializes the runtime (embeds CPython when standalone).
+func Init() error {
+	if C.PD_Init() != 0 {
+		return errors.New("paddle_tpu: runtime init failed")
+	}
+	return nil
+}
+
+// NewPredictor loads a model saved with paddle.jit.save(prefix).
+func NewPredictor(modelPrefix string) (*Predictor, error) {
+	cs := C.CString(modelPrefix)
+	defer C.free(unsafe.Pointer(cs))
+	h := C.PD_CreatePredictor(cs)
+	if h == nil {
+		return nil, errors.New("paddle_tpu: " + C.GoString(C.PD_GetLastError()))
+	}
+	return &Predictor{handle: h}, nil
+}
+
+// Run executes the model on one float32 tensor and returns (data, shape).
+// The output buffer grows on "too small" errors and is reused across calls.
+func (p *Predictor) Run(data []float32, shape []int64) ([]float32, []int64, error) {
+	if len(data) == 0 || len(shape) == 0 {
+		return nil, nil, errors.New("paddle_tpu: empty input data or shape")
+	}
+	if p.outBuf == nil {
+		p.outBuf = make([]float32, 1<<16)
+	}
+	outShape := make([]int64, 16)
+	for {
+		var outNdim C.int
+		n := C.PD_PredictorRunFloat(
+			p.handle,
+			(*C.float)(unsafe.Pointer(&data[0])),
+			(*C.int64_t)(unsafe.Pointer(&shape[0])),
+			C.int(len(shape)),
+			(*C.float)(unsafe.Pointer(&p.outBuf[0])),
+			C.int64_t(len(p.outBuf)),
+			(*C.int64_t)(unsafe.Pointer(&outShape[0])),
+			C.int(len(outShape)),
+			&outNdim,
+		)
+		if n >= 0 {
+			out := make([]float32, n)
+			copy(out, p.outBuf[:n])
+			return out, outShape[:outNdim], nil
+		}
+		msg := C.GoString(C.PD_GetLastError())
+		if msg == "output buffer too small" && len(p.outBuf) < 1<<28 {
+			p.outBuf = make([]float32, len(p.outBuf)*4)
+			continue
+		}
+		return nil, nil, errors.New("paddle_tpu: " + msg)
+	}
+}
+
+// Destroy releases the predictor.
+func (p *Predictor) Destroy() {
+	if p.handle != nil {
+		C.PD_DestroyPredictor(p.handle)
+		p.handle = nil
+	}
+}

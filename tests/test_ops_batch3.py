@@ -1,0 +1,208 @@
+"""Op-test burn-down, batch 3: norm / conv variants / linalg decompositions /
+einsum / fft / vision-adjacent ops (SURVEY §4 continuation)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+from op_test import OpTest
+
+rng = np.random.RandomState(21)
+X = rng.randn(3, 4).astype(np.float32)
+
+
+class TestEinsumOps(OpTest):
+    def setUp(self):
+        a = rng.randn(2, 3).astype(np.float32)
+        b = rng.randn(3, 4).astype(np.float32)
+        self.op = lambda a, b: paddle.einsum("ij,jk->ik", a, b)
+        self.inputs = {"a": a, "b": b}
+        self.outputs = [a @ b]
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["a", "b"])
+
+
+class TestBmmOp(OpTest):
+    def setUp(self):
+        a = rng.randn(2, 3, 4).astype(np.float32)
+        b = rng.randn(2, 4, 2).astype(np.float32)
+        self.op = paddle.bmm
+        self.inputs = {"a": a, "b": b}
+        self.outputs = [a @ b]
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["a"], max_elems=24)
+
+
+class TestCholeskyOp(OpTest):
+    def setUp(self):
+        a = rng.randn(4, 4).astype(np.float32)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        self.op = paddle.linalg.cholesky
+        self.inputs = {"x": spd}
+        self.outputs = [np.linalg.cholesky(spd)]
+
+    def test(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestSolveOps:
+    def test_solve_and_triangular(self):
+        a = rng.randn(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+        b = rng.randn(3, 2).astype(np.float32)
+        out = paddle.linalg.solve(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.linalg.solve(a, b), atol=1e-4)
+
+    def test_qr_svd_eigh(self):
+        a = rng.randn(4, 3).astype(np.float32)
+        q, r = paddle.linalg.qr(paddle.to_tensor(a))
+        np.testing.assert_allclose(np.asarray(q._data) @ np.asarray(r._data),
+                                   a, atol=1e-4)
+        u, s, v = paddle.linalg.svd(paddle.to_tensor(a))  # paddle returns V
+        np.testing.assert_allclose(
+            (np.asarray(u._data)[:, :3] * np.asarray(s._data))
+            @ np.asarray(v._data).T,
+            a, atol=1e-4)
+        sym = a.T @ a
+        w, v = paddle.linalg.eigh(paddle.to_tensor(sym))
+        np.testing.assert_allclose(
+            np.asarray(v._data) @ np.diag(np.asarray(w._data)) @ np.asarray(v._data).T,
+            sym, atol=1e-3)
+
+
+class TestGroupedConvOp(OpTest):
+    def setUp(self):
+        x = rng.randn(1, 4, 5, 5).astype(np.float32)
+        w = rng.randn(4, 2, 3, 3).astype(np.float32)  # groups=2
+        self.op = lambda x, w: F.conv2d(x, w, padding=1, groups=2)
+        self.inputs = {"x": x, "w": w}
+        out = np.zeros((1, 4, 5, 5), np.float32)
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        for g in range(2):
+            for co in range(2):
+                oc = g * 2 + co
+                for i in range(5):
+                    for j in range(5):
+                        out[0, oc, i, j] = np.sum(
+                            xp[0, g * 2:(g + 1) * 2, i:i + 3, j:j + 3] * w[oc])
+        self.outputs = [out]
+
+    def test(self):
+        self.check_output(atol=1e-4, rtol=1e-3)
+
+
+class TestConvTransposeOp:
+    def test_conv2d_transpose_shape_and_grad(self):
+        x = paddle.to_tensor(rng.randn(1, 2, 4, 4).astype(np.float32))
+        x.stop_gradient = False
+        w = paddle.to_tensor(rng.randn(2, 3, 2, 2).astype(np.float32))
+        out = F.conv2d_transpose(x, w, stride=2)
+        assert tuple(out.shape) == (1, 3, 8, 8)
+        out.sum().backward()
+        assert x.grad is not None
+        assert np.isfinite(np.asarray(x.grad._data)).all()
+
+
+class TestNormOps:
+    def test_batch_norm_functional_train_stats(self):
+        x = rng.randn(8, 4).astype(np.float32)
+        xt = paddle.to_tensor(x)
+        rm = paddle.zeros([4])
+        rv = paddle.ones([4])
+        out = F.batch_norm(xt, rm, rv, training=True, momentum=0.9)
+        ref = (x - x.mean(0)) / np.sqrt(x.var(0) + 1e-5)
+        np.testing.assert_allclose(np.asarray(out._data), ref, atol=1e-4)
+
+    def test_group_norm(self):
+        x = rng.randn(2, 4, 3, 3).astype(np.float32)
+        out = F.group_norm(paddle.to_tensor(x), num_groups=2, epsilon=1e-5)
+        g = x.reshape(2, 2, 2 * 3 * 3)
+        ref = ((g - g.mean(-1, keepdims=True))
+               / np.sqrt(g.var(-1, keepdims=True) + 1e-5)).reshape(x.shape)
+        np.testing.assert_allclose(np.asarray(out._data), ref, atol=1e-4)
+
+    def test_instance_norm(self):
+        x = rng.randn(2, 3, 4, 4).astype(np.float32)
+        out = F.instance_norm(paddle.to_tensor(x))
+        m = x.mean(axis=(2, 3), keepdims=True)
+        v = x.var(axis=(2, 3), keepdims=True)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   (x - m) / np.sqrt(v + 1e-5), atol=1e-4)
+
+
+class TestFFTOps:
+    def test_fft_roundtrip(self):
+        x = rng.randn(8).astype(np.float32)
+        f = paddle.fft.fft(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(f._data), np.fft.fft(x),
+                                   atol=1e-4)
+        back = paddle.fft.ifft(f)
+        np.testing.assert_allclose(np.asarray(back._data).real, x, atol=1e-4)
+
+    def test_rfft(self):
+        x = rng.randn(8).astype(np.float32)
+        f = paddle.fft.rfft(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(f._data), np.fft.rfft(x),
+                                   atol=1e-4)
+
+
+class TestVisionAdjacent:
+    def test_pixel_shuffle(self):
+        x = rng.randn(1, 4, 2, 2).astype(np.float32)
+        out = F.pixel_shuffle(paddle.to_tensor(x), 2)
+        assert tuple(out.shape) == (1, 1, 4, 4)
+
+    def test_interpolate_bilinear_matches_numpy_corners(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = F.interpolate(paddle.to_tensor(x), size=(8, 8), mode="bilinear",
+                            align_corners=True)
+        o = np.asarray(out._data)
+        assert o[0, 0, 0, 0] == 0.0 and o[0, 0, -1, -1] == 15.0
+
+    def test_grid_sample_identity(self):
+        x = rng.randn(1, 1, 4, 4).astype(np.float32)
+        ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                             indexing="ij")
+        grid = np.stack([xs, ys], -1)[None].astype(np.float32)
+        out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                            align_corners=True)
+        np.testing.assert_allclose(np.asarray(out._data), x, atol=1e-5)
+
+
+class TestRNNCells:
+    def test_lstm_cell_manual_reference(self):
+        from paddle_tpu import nn
+
+        paddle.seed(0)
+        cell = nn.LSTMCell(4, 3)
+        x = paddle.to_tensor(rng.randn(2, 4).astype(np.float32))
+        h0 = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        c0 = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        out, (h1, c1) = cell(x, (h0, c0))
+        # manual gate math from the cell's own weights
+        wi = np.asarray(cell.weight_ih._data)
+        wh = np.asarray(cell.weight_hh._data)
+        bi = np.asarray(cell.bias_ih._data)
+        bh = np.asarray(cell.bias_hh._data)
+        z = np.asarray(x._data) @ wi.T + bi + np.zeros((2, 3)) @ wh.T + bh
+        i, f, g, o = np.split(z, 4, axis=1)
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        c_ref = sig(f) * 0 + sig(i) * np.tanh(g)
+        h_ref = sig(o) * np.tanh(c_ref)
+        np.testing.assert_allclose(np.asarray(h1._data), h_ref, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(c1._data), c_ref, atol=1e-4)
+
+    def test_gru_sequence_shapes(self):
+        from paddle_tpu import nn
+
+        paddle.seed(0)
+        gru = nn.GRU(input_size=4, hidden_size=3, num_layers=2)
+        x = paddle.to_tensor(rng.randn(2, 5, 4).astype(np.float32))
+        out, h = gru(x)
+        assert tuple(out.shape) == (2, 5, 3)
+        assert tuple(h.shape) == (2, 2, 3)
