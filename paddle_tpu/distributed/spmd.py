@@ -177,7 +177,24 @@ class SpmdTrainer:
 
         fwd = self._forward_loss
         if self.recompute:
-            fwd = jax.checkpoint(fwd, static_argnums=())
+            # the offload custom call (annotate_device_placement) has no CPU
+            # lowering under the sharded jit step in this jax version; guard
+            # verified empirically — the policy itself works on TPU
+            on_cpu = np.asarray(self.mesh.devices).flat[0].platform == "cpu"
+            if self.extra_kwargs.get("remat_offload") and on_cpu:
+                import warnings
+
+                warnings.warn("remat_offload ignored on the CPU backend; "
+                              "falling back to plain recompute")
+            if self.extra_kwargs.get("remat_offload") and not on_cpu:
+                # RecomputeConfig.enable_offload parity: matmul residuals go
+                # to pinned host memory instead of being recomputed or held
+                # in HBM (reference offloads checkpoints to CPU)
+                policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+                    "device", "pinned_host")
+                fwd = jax.checkpoint(fwd, static_argnums=(), policy=policy)
+            else:
+                fwd = jax.checkpoint(fwd, static_argnums=())
 
         accum = self.accumulate_steps
 
