@@ -25,7 +25,9 @@ def main():
     from paddle_tpu.models import GPTConfig, GPTForCausalLM, GPTPretrainLoss
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-    batch, seq = (8, 1024) if on_tpu else (2, 128)
+    # batch 16 is the single-chip sweet spot (measured 74.9k tok/s vs 53.8k at
+    # batch 8; batch 32 exceeds 16G HBM for GPT-2 small at seq 1024)
+    batch, seq = (16, 1024) if on_tpu else (2, 128)
 
     paddle.seed(0)
     cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
