@@ -73,6 +73,22 @@ class Predictor:
 
     def _load(self):
         path = self.config.model_path
+        self._aot = None
+        if path and os.path.exists(path + ".pdmodel.jaxexport"):
+            # AOT path (save_inference_model artifact): no python Layer, no
+            # re-trace — the AnalysisPredictor-on-saved-model analog. The
+            # pickled-Layer path (shape-polymorphic) stays as a fallback for
+            # corrupt artifacts or off-export input shapes.
+            from ..static.io import load_aot_predictor
+
+            try:
+                self._aot = load_aot_predictor(path)
+            except Exception:
+                self._aot = None
+        if self._aot is None:
+            self._load_pickled_layer(path)
+
+    def _load_pickled_layer(self, path):
         if path and os.path.exists(path + ".pdmodel"):
             with open(path + ".pdmodel", "rb") as f:
                 self._layer = pickle.load(f)
@@ -82,7 +98,7 @@ class Predictor:
                 raise RuntimeError("saved model not loadable")
             self._layer.set_state_dict(state)
             self._layer.eval()
-        else:
+        elif self._aot is None:
             raise FileNotFoundError(f"no model at {path}.pdmodel")
 
     def get_input_names(self):
@@ -105,6 +121,17 @@ class Predictor:
             for i, a in enumerate(inputs):
                 self._inputs[f"input_{i}" if i >= len(self._input_names) else self._input_names[i]] = a
         arrs = [self._inputs[n] for n in self._input_names if n in self._inputs]
+        if self._aot is not None:
+            try:
+                return self._pack_outputs(self._aot(*arrs))
+            except Exception:
+                # off-export shape/dtype or corrupt artifact: fall back to the
+                # shape-polymorphic pickled-Layer path when it exists
+                if self._layer is None:
+                    self._load_pickled_layer(self.config.model_path)
+                if self._layer is None:
+                    raise
+                self._aot = None
         key = tuple((a.shape, str(a.dtype)) for a in arrs)
         if key not in self._compiled:
             layer = self._layer
@@ -120,11 +147,14 @@ class Predictor:
 
             self._compiled[key] = jax.jit(pure)
         out = self._compiled[key](*[jnp.asarray(a) for a in arrs])
+        return self._pack_outputs(out)
+
+    def _pack_outputs(self, out):
         outs = out if isinstance(out, (list, tuple)) else [out]
         self._outputs.clear()
         results = []
         for i, o in enumerate(outs):
-            arr = np.asarray(o)
+            arr = np.asarray(o._data if isinstance(o, Tensor) else o)
             self._outputs[f"output_{i}"] = arr
             results.append(arr)
         return results

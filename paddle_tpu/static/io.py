@@ -42,9 +42,27 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, prog
                                           is_leaf=lambda v: isinstance(v, Tensor))
 
         example = [jnp.zeros(tuple(v.shape), dtype=v.dtype) for v in feed_vars]
-        lowered = jax.jit(pure).lower({k: jnp.asarray(v) for k, v in params.items()}, *example)
+        params_j = {k: jnp.asarray(v) for k, v in params.items()}
+        jitted = jax.jit(pure)
+        lowered = jitted.lower(params_j, *example)
         with open(path_prefix + ".pdmodel.stablehlo", "w") as f:
             f.write(lowered.as_text())
+        # executable round-trip artifact (jax.export): the AOT predictor loads
+        # this without the original python Layer — the deployment-grade path.
+        # serialize fully before touching disk, write tmp + rename so a crash
+        # can never leave a truncated artifact the predictor would prefer
+        try:
+            blob = jax.export.export(jitted)(params_j, *example).serialize()
+        except Exception as e:
+            import warnings
+
+            warnings.warn(f"jax.export serialization unavailable ({e}); "
+                          "saving StableHLO text + params only")
+        else:
+            tmp = path_prefix + ".pdmodel.jaxexport.tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path_prefix + ".pdmodel.jaxexport")
         with open(path_prefix + ".pdmodel.meta", "wb") as f:
             pickle.dump({"feed_shapes": [tuple(v.shape) for v in feed_vars],
                          "feed_dtypes": [str(v.dtype) for v in feed_vars]}, f)
@@ -60,6 +78,24 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     with open(path_prefix + ".pdmodel.stablehlo") as f:
         hlo_text = f.read()
     return params, meta, hlo_text
+
+
+def load_aot_predictor(path_prefix):
+    """AOT predictor from the serialized jax.export artifact: a callable
+    `fn(*inputs) -> outputs` bound to the saved params — no python Layer or
+    re-trace needed (the AnalysisPredictor-on-saved-model analog)."""
+    with open(path_prefix + ".pdmodel.jaxexport", "rb") as f:
+        exported = jax.export.deserialize(bytearray(f.read()))
+    data = np.load(path_prefix + ".pdiparams.npz")
+    params = {k: jnp.asarray(data[k]) for k in data.files}
+
+    def predict(*inputs):
+        arrs = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in inputs]
+        out = exported.call(params, *arrs)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    return predict
 
 
 def save(program, model_path, protocol=4, **configs):
