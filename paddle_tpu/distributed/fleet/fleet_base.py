@@ -111,6 +111,35 @@ class Fleet:
         kw = dict(sharding_stage=0, recompute=False, accumulate_steps=1)
         kw, optimizer = apply_meta_optimizers(kw, optimizer, s)
         kw.update(overrides)
+        pp_degree = kw.pop("pp_degree", 1)
+        if pp_degree and pp_degree > 1:
+            # PipelineOptimizer parity: split the model into sections and train
+            # through the scheduled pipeline (reference section_worker.cc:98-141)
+            from ..pipeline import PipelineTrainer
+
+            if not hasattr(layer, "pipeline_split"):
+                raise ValueError(
+                    "strategy.pipeline needs a model with pipeline_split(pp) "
+                    "-> (pre, stages, post_loss); GPTForCausalLM implements it")
+            unconsumed = [k for k, bad in (
+                ("amp_dtype", kw.get("amp_dtype") is not None),
+                ("sharding_stage", kw.get("sharding_stage", 0) > 0),
+                ("recompute", kw.get("recompute", False)),
+                ("loss_fn", loss_fn is not None),
+            ) if bad]
+            if unconsumed:
+                import warnings
+
+                warnings.warn(
+                    f"pipeline trainer does not consume {unconsumed}; the "
+                    "model's post_loss section defines the loss, and amp/"
+                    "sharding/recompute do not yet compose with pp_degree>1")
+            pre, stages, post = layer.pipeline_split(pp_degree)
+            n_micro = max(kw.get("accumulate_steps", 1), pp_degree)
+            return PipelineTrainer(
+                pre, stages, post, optimizer, mesh=get_mesh(),
+                n_micro=n_micro,
+                schedule_mode=kw.get("schedule_mode", "1F1B"))
         return SpmdTrainer(layer, optimizer, loss_fn, mesh=get_mesh(), **kw)
 
     # -- PS mode (distributed/ps: host tables + TCP RPC) -----------------------

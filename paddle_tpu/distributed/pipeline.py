@@ -18,7 +18,7 @@ import functools
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.tape import global_tape
 from ..core.tensor import Tensor
@@ -33,6 +33,21 @@ def _smap(f, mesh, in_specs, out_specs):
     return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
 
 
+def _pure_call(layer, params, *args):
+    """Call `layer` as a pure function of a params dict (name -> array)."""
+    named = dict(layer.named_parameters())
+    saved = {n: t._data for n, t in named.items()}
+    try:
+        for n, v in params.items():
+            named[n]._data = v
+        with global_tape().pause():
+            out = layer(*[Tensor(a) if not isinstance(a, Tensor) else a for a in args])
+        return out._data if isinstance(out, Tensor) else out
+    finally:
+        for n, t in named.items():
+            t._data = saved[n]
+
+
 class PipelineStage:
     """One stage = a pure fn(params, x) -> y derived from a Layer."""
 
@@ -40,26 +55,17 @@ class PipelineStage:
         self.layer = layer
 
     def pure(self, params, x):
-        named = dict(self.layer.named_parameters())
-        saved = {n: t._data for n, t in named.items()}
-        try:
-            for n, v in params.items():
-                named[n]._data = v
-            with global_tape().pause():
-                out = self.layer(Tensor(x))
-            return out._data if isinstance(out, Tensor) else out
-        finally:
-            for n, t in named.items():
-                t._data = saved[n]
+        return _pure_call(self.layer, params, x)
 
 
 def _stack_stage_params(stages):
     """Stack per-stage param pytrees along a leading 'pp' axis (stages must be
     structurally identical, like transformer blocks)."""
-    names = [n for n, _ in stages[0].layer.named_parameters()]
+    layers = [getattr(s, "layer", s) for s in stages]
+    names = [n for n, _ in layers[0].named_parameters()]
     stacked = {}
     for n in names:
-        arrs = [dict(s.layer.named_parameters())[n]._data for s in stages]
+        arrs = [dict(l.named_parameters())[n]._data for l in layers]
         stacked[n] = jnp.stack(arrs, axis=0)
     return stacked
 
@@ -146,3 +152,238 @@ class Pipeline:
         mapped = _smap(spmd, self.mesh, in_specs=(param_specs, P()), out_specs=P())
         outs = mapped(params, x_micro)
         return Tensor(outs.reshape((self.n_micro * mb,) + outs.shape[2:]))
+
+
+# ---------------------------------------------------------------------------
+# Pipeline *training* — fwd + bwd + optimizer across stages
+# ---------------------------------------------------------------------------
+
+def _vary(arr, ax):
+    try:
+        return jax.lax.pcast(arr, (ax,), to="varying")
+    except (AttributeError, TypeError):
+        return jax.lax.pvary(arr, (ax,))
+
+
+class PipelineTrainer:
+    """Pipeline-parallel TRAINING over a pp(×dp) mesh — one jitted step.
+
+    Reference parity: PipelineTrainer + SectionWorker's micro-batch schedule
+    (framework/section_worker.cc:98-141) and PipelineOptimizer's program split
+    (fleet/meta_optimizers/pipeline_optimizer.py:25). There, each device runs a
+    program section and grads flow stage-to-stage via send_v2/recv_v2.
+
+    TPU-native design (GSPMD-style "pipelining as collective permute"): the model
+    is (pre, stages, post_loss) — embedding, N structurally identical stage
+    layers, and a head+loss layer. Stage params are STACKED on a leading axis
+    sharded over 'pp'; the GPipe fill/drain schedule (n_micro + n_stages - 1
+    ticks, rank r works micro-batch t - r at tick t) is a lax.scan whose
+    activations move between ranks with ppermute inside a shard_map that is
+    manual over 'pp' and automatic over 'dp' (XLA inserts the dp grad psum).
+    The backward schedule is autodiff's reversal of the forward scan — a drain/
+    fill mirror, mechanically correct without hand-written 1F1B send/recv.
+
+    Memory profile (honest note): reverse-mode through the scanned schedule
+    retains O(n_ticks) per-tick residuals — the GPipe profile, not true 1F1B's
+    O(n_stages). schedule_mode='1F1B' reclaims that headroom the TPU way:
+    jax.checkpoint on each stage tick drops intra-stage residuals and recomputes
+    them in the backward sweep, bounding live memory to the scan carries
+    (one activation per tick) — the same peak-memory class 1F1B targets.
+    schedule_mode='F-then-B' keeps all residuals (fastest, most memory).
+
+    `pre` and `post_loss` params are replicated over pp (every rank computes
+    them; only rank 0's / the psum'd last-rank path carries gradients — XLA
+    dead-code-eliminates the rest).
+    """
+
+    def __init__(self, pre, stages, post_loss, optimizer, mesh=None,
+                 pp_axis="pp", dp_axis="dp", n_micro=None,
+                 schedule_mode="1F1B", donate=True):
+        from .mesh import get_mesh
+
+        self.mesh = mesh or get_mesh()
+        assert pp_axis in self.mesh.axis_names, f"mesh needs a '{pp_axis}' axis"
+        self.pre = pre
+        self.stage_layers = list(stages)
+        self.post_loss = post_loss
+        self.optimizer = optimizer
+        self.pp_axis = pp_axis
+        self.dp_axis = dp_axis if dp_axis in self.mesh.axis_names else None
+        self.n_stages = self.mesh.shape[pp_axis]
+        assert len(self.stage_layers) == self.n_stages, \
+            f"{len(self.stage_layers)} stages for pp={self.n_stages}"
+        self.n_micro = n_micro or self.n_stages
+        self.schedule_mode = schedule_mode
+        self.donate = donate
+        self._compiled = None
+
+        # stage params must be uniformly trainable across stages (they are one
+        # stacked array) — a per-stage freeze cannot be expressed, so reject it
+        stacked = _stack_stage_params(self.stage_layers)
+        stage0_named = dict(self.stage_layers[0].named_parameters())
+        for i, s in enumerate(self.stage_layers[1:], start=1):
+            for n, p in s.named_parameters():
+                if getattr(p, "trainable", True) != getattr(
+                        stage0_named[n], "trainable", True):
+                    raise ValueError(
+                        f"stage {i} param '{n}' trainable flag differs from "
+                        "stage 0; stacked pipeline stages must be uniformly "
+                        "trainable — freeze the same params on every stage")
+        self.params, self.frozen = {}, {}
+        for n, p in pre.named_parameters():
+            dst = self.params if getattr(p, "trainable", True) else self.frozen
+            dst["pre::" + n] = p._data
+        for n, v in stacked.items():
+            trainable = getattr(stage0_named[n], "trainable", True)
+            (self.params if trainable else self.frozen)["stage::" + n] = v
+        for n, p in post_loss.named_parameters():
+            dst = self.params if getattr(p, "trainable", True) else self.frozen
+            dst["post::" + n] = p._data
+        self.opt_state = optimizer.functional_init(self.params)
+        self._place_state()
+
+    # -- sharding placement ----------------------------------------------------
+    def _sharding_for(self, name):
+        if name.startswith("stage::"):
+            return NamedSharding(self.mesh, P(self.pp_axis))
+        return NamedSharding(self.mesh, P())
+
+    def _place_state(self):
+        from .spmd import owned_device_put
+
+        self.p_shardings = {k: self._sharding_for(k) for k in self.params}
+        self.params = {k: owned_device_put(v, self.p_shardings[k])
+                       for k, v in self.params.items()}
+        self.f_shardings = {k: self._sharding_for(k) for k in self.frozen}
+        self.frozen = {k: jax.device_put(v, self.f_shardings[k])
+                       for k, v in self.frozen.items()}
+        self.s_shardings, new_state = {}, {}
+        for pname, st in self.opt_state.items():
+            if pname == "__step__":
+                self.s_shardings[pname] = NamedSharding(self.mesh, P())
+                new_state[pname] = owned_device_put(st, self.s_shardings[pname])
+                continue
+            sub_sh, sub = {}, {}
+            for k, v in st.items():
+                sh = (self._sharding_for(pname)
+                      if hasattr(v, "ndim") and v.ndim > 0
+                      else NamedSharding(self.mesh, P()))
+                sub_sh[k] = sh
+                sub[k] = owned_device_put(v, sh)
+            self.s_shardings[pname] = sub_sh
+            new_state[pname] = sub
+        self.opt_state = new_state
+
+    # -- the scheduled pipeline forward ---------------------------------------
+    def _pipelined(self, stage_params, h_micro):
+        """[n_micro, mb, ...] -> final-stage outputs [n_micro, mb, ...]."""
+        ax = self.pp_axis
+        n_stage, n_micro = self.n_stages, self.n_micro
+        stage0 = self.stage_layers[0]
+        base_fn = functools.partial(_pure_call, stage0)
+        fn = jax.checkpoint(base_fn) if self.schedule_mode == "1F1B" else base_fn
+
+        def spmd(params_sh, x_all):
+            params_my = {k: v[0] for k, v in params_sh.items()}
+            r = jax.lax.axis_index(ax)
+            n_ticks = n_micro + n_stage - 1
+            perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+            buf0 = _vary(jnp.zeros_like(x_all[0]), ax)
+
+            def tick(buf, t):
+                mb_idx = t - r
+                active = (mb_idx >= 0) & (mb_idx < n_micro)
+                x_in = jnp.where(r == 0, x_all[jnp.clip(t, 0, n_micro - 1)], buf)
+                y = fn(params_my, x_in)
+                y = jnp.where(active, y, jnp.zeros_like(y))
+                y_out = jnp.where(r == n_stage - 1, y, jnp.zeros_like(y))
+                return jax.lax.ppermute(y, ax, perm), y_out
+
+            _, ys = jax.lax.scan(tick, buf0, jnp.arange(n_ticks))
+            # last rank finishes micro-batch m at tick m + n_stage - 1
+            outs = ys[n_stage - 1:n_stage - 1 + n_micro]
+            return jax.lax.psum(outs, ax)  # replicate from the last rank
+
+        specs = {k: P(ax) for k in stage_params}
+        try:
+            mapped = jax.shard_map(spmd, mesh=self.mesh, in_specs=(specs, P()),
+                                   out_specs=P(), axis_names={ax})
+        except (AttributeError, TypeError):  # older jax: full-manual shard_map
+            mapped = _smap(spmd, self.mesh, in_specs=(specs, P()), out_specs=P())
+        return mapped(stage_params, h_micro)
+
+    # -- jitted train step ------------------------------------------------------
+    def _build(self):
+        pre, post = self.pre, self.post_loss
+
+        def split_tree(flat, frozen):
+            t = {"pre": {}, "stage": {}, "post": {}}
+            for k, v in {**frozen, **flat}.items():
+                grp, name = k.split("::", 1)
+                t[grp][name] = v
+            return t
+
+        def step(params, opt_state, frozen, lr, x_micro, y_micro):
+            def loss_fn(flat):
+                t = split_tree(flat, frozen)
+                h = jax.vmap(lambda xi: _pure_call(pre, t["pre"], xi))(x_micro)
+                outs = self._pipelined(t["stage"], h)
+                losses = jax.vmap(
+                    lambda oi, yi: _pure_call(post, t["post"], oi, yi)
+                )(outs, y_micro)
+                return jnp.mean(losses.astype(jnp.float32))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            new_params, new_state = self.optimizer.functional_apply(
+                params, grads, opt_state, lr=lr)
+            return loss, new_params, new_state
+
+        repl = NamedSharding(self.mesh, P())
+        batch_sh = NamedSharding(
+            self.mesh, P(None, self.dp_axis) if self.dp_axis else P())
+        donate = (0, 1) if self.donate else ()
+        return jax.jit(
+            step,
+            in_shardings=(self.p_shardings, dict(self.s_shardings),
+                          self.f_shardings, repl, batch_sh, batch_sh),
+            out_shardings=(repl, self.p_shardings, dict(self.s_shardings)),
+            donate_argnums=donate,
+        )
+
+    def train_step(self, x, y):
+        """x, y: full batch [B, ...]; B must divide by n_micro (and dp on the
+        micro-batch dim). Returns the mean loss over all micro-batches."""
+        x = x._data if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+        y = y._data if isinstance(y, Tensor) else jnp.asarray(np.asarray(y))
+        assert x.shape[0] % self.n_micro == 0, \
+            f"batch {x.shape[0]} not divisible by n_micro={self.n_micro}"
+        mb = x.shape[0] // self.n_micro
+        x_micro = x.reshape((self.n_micro, mb) + x.shape[1:])
+        y_micro = y.reshape((self.n_micro, mb) + y.shape[1:])
+        if self._compiled is None:
+            self._compiled = self._build()
+        lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
+        loss, self.params, self.opt_state = self._compiled(
+            self.params, self.opt_state, self.frozen, lr, x_micro, y_micro)
+        self.optimizer._step_count += 1
+        return Tensor(loss)
+
+    def sync_to_layer(self):
+        """Write trained params back into pre/stages/post Layer tensors.
+
+        Copies (never aliases) the trainer's arrays: the jitted step donates
+        self.params, so handing those buffers to the Layer would let the next
+        train_step invalidate the Layer's eager tensors."""
+        pre_named = dict(self.pre.named_parameters())
+        post_named = dict(self.post_loss.named_parameters())
+        stage_named = [dict(s.named_parameters()) for s in self.stage_layers]
+        for k, v in self.params.items():
+            grp, name = k.split("::", 1)
+            if grp == "pre":
+                pre_named[name]._data = jnp.asarray(jax.device_get(v))
+            elif grp == "post":
+                post_named[name]._data = jnp.asarray(jax.device_get(v))
+            else:
+                host = jax.device_get(v)
+                for i, named in enumerate(stage_named):
+                    named[name]._data = jnp.asarray(host[i])

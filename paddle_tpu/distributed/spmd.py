@@ -26,6 +26,17 @@ from ..core.tensor import Tensor
 from .mesh import get_mesh
 
 
+def owned_device_put(v, sh):
+    """device_put that never shares buffers with `v`.
+
+    The jitted train step donates its param/state inputs; device_put to a
+    replicated sharding reuses the source's buffer for the shard on its device,
+    so donating the placed array would invalidate the Layer's eager tensors
+    (and any other trainer placed from the same source). Copy first so the
+    trainer exclusively owns every buffer it donates."""
+    return jax.device_put(jnp.copy(jnp.asarray(v)), sh)
+
+
 def _first_divisible_axis(shape, n):
     for i, s in enumerate(shape):
         if s % n == 0 and s >= n:
@@ -121,15 +132,15 @@ class SpmdTrainer:
                 self.p_shardings[k] = NamedSharding(mesh, spec)
         self.s_shardings = state_shardings(self.opt_state, self.p_shardings, mesh, ax, self.sharding_stage)
         self.b_shardings = {k: NamedSharding(mesh, P()) for k in self.buffers}
-        # device_put everything per its sharding
-        self.params = {k: jax.device_put(v, self.p_shardings[k]) for k, v in self.params.items()}
-        self.buffers = {k: jax.device_put(v, self.b_shardings[k]) for k, v in self.buffers.items()}
+        # device_put everything per its sharding (owned copies: the step donates)
+        self.params = {k: owned_device_put(v, self.p_shardings[k]) for k, v in self.params.items()}
+        self.buffers = {k: owned_device_put(v, self.b_shardings[k]) for k, v in self.buffers.items()}
         new_state = {}
         for pname, st in self.opt_state.items():
             if pname == "__step__":
-                new_state[pname] = jax.device_put(st, NamedSharding(self.mesh, P()))
+                new_state[pname] = owned_device_put(st, NamedSharding(self.mesh, P()))
             else:
-                new_state[pname] = {k: jax.device_put(v, self.s_shardings[pname][k]) for k, v in st.items()}
+                new_state[pname] = {k: owned_device_put(v, self.s_shardings[pname][k]) for k, v in st.items()}
         self.opt_state = new_state
 
     # -- pure step -------------------------------------------------------------

@@ -206,6 +206,10 @@ class GPTForCausalLM(nn.Layer):
 
     def forward(self, input_ids):
         h = self.gpt(input_ids)
+        if getattr(self, "lm_head", None) is not None:
+            # untied head installed by pipeline_split: after pipelined training
+            # the trained head lives here, not in wte
+            return self.lm_head(h)
         # tied head: logits = h @ wte^T
         from ..tensor.math import matmul
 
@@ -219,6 +223,13 @@ class GPTForCausalLM(nn.Layer):
         if aux is not None:
             loss = loss + self.cfg.moe_aux_weight * aux
         return loss
+
+    def pipeline_split(self, pp_degree):
+        """Split into (pre, stages, post_loss) for distributed.pipeline.
+        PipelineTrainer. Unties the LM head (see GPTHeadLoss) and installs it
+        as self.lm_head so forward()/state_dict() use the trained head after
+        PipelineTrainer.sync_to_layer()."""
+        return _gpt_pipeline_split(self, pp_degree)
 
     def moe_aux_loss(self):
         """Sum of MoE load-balance losses from the last forward (None if dense)."""
@@ -234,6 +245,89 @@ class GPTPretrainLoss(nn.Layer):
     def forward(self, logits, labels):
         b, s, v = logits.shape
         return F.cross_entropy(logits.reshape([b * s, v]), labels.reshape([b * s]))
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-parallel decomposition (distributed.pipeline.PipelineTrainer model
+# protocol: pre / homogeneous stages / post+loss).
+# ---------------------------------------------------------------------------
+
+class GPTEmbed(nn.Layer):
+    """First pipeline section: token + position embedding (shares the parent
+    model's wte/wpe parameter tensors)."""
+
+    def __init__(self, wte, wpe, dropout):
+        super().__init__()
+        self.wte = wte
+        self.wpe = wpe
+        self.drop = nn.Dropout(dropout)
+
+    def forward(self, input_ids):
+        from ..tensor.creation import arange
+
+        s = input_ids.shape[-1]
+        pos = arange(s, dtype="int64")
+        return self.drop(self.wte(input_ids) + self.wpe(pos))
+
+
+class GPTStage(nn.Layer):
+    """One pipeline stage: a run of consecutive GPTBlocks (shares the parent's
+    block sublayers, so parameters stay the same Tensor objects)."""
+
+    def __init__(self, blocks):
+        super().__init__()
+        self.blocks = nn.LayerList(blocks)
+
+    def forward(self, x):
+        for blk in self.blocks:
+            x = blk(x)
+        return x
+
+
+class GPTHeadLoss(nn.Layer):
+    """Last pipeline section: final LayerNorm + LM head + cross-entropy.
+
+    The head is UNTIED here (initialized from a copy of wte): pipeline splits
+    put the embedding on stage 0 and the head on the last stage — the megatron/
+    reference convention where tied weights need an extra embedding grad
+    all-reduce between first and last stage; we untie instead and document it.
+    """
+
+    def __init__(self, ln_f, wte_weight):
+        super().__init__()
+        self.ln_f = ln_f
+        v, h = wte_weight.shape
+        self.head = nn.Linear(h, v, bias_attr=False)
+        self.head.weight._data = wte_weight._data.T.copy()
+
+    def forward(self, h, labels):
+        h = self.ln_f(h)
+        logits = self.head(h)
+        b, s, v = logits.shape
+        return F.cross_entropy(logits.reshape([b * s, v]), labels.reshape([b * s]))
+
+
+def _gpt_pipeline_split(model, pp_degree):
+    """Split a GPTForCausalLM into (pre, stages, post_loss) for PipelineTrainer.
+
+    Stage layers share the model's block parameter tensors; each stage gets
+    num_layers // pp_degree consecutive blocks (must divide evenly so stages
+    are structurally identical — the stacked-params representation needs it).
+    """
+    cfg = model.cfg
+    if cfg.num_layers % pp_degree != 0:
+        raise ValueError(f"num_layers={cfg.num_layers} not divisible by "
+                         f"pp_degree={pp_degree}")
+    per = cfg.num_layers // pp_degree
+    gpt = model.gpt
+    pre = GPTEmbed(gpt.wte, gpt.wpe, cfg.dropout)
+    stages = [GPTStage(list(gpt.blocks)[i * per:(i + 1) * per])
+              for i in range(pp_degree)]
+    post = GPTHeadLoss(gpt.ln_f, gpt.wte.weight)
+    # expose the untied head on the model so its forward path and state_dict
+    # reflect pipelined training after sync_to_layer
+    model.lm_head = post.head
+    return pre, stages, post
 
 
 def gpt2_small(**kw):
