@@ -143,7 +143,7 @@ class HDFSClient(FS):
     def available(self):
         return self._bin is not None
 
-    def _run(self, *args, check=True):
+    def _run(self, *args, check=True, binary=False):
         if self._bin is None:
             raise RuntimeError(
                 "HDFSClient needs a hadoop binary (set hadoop_home= or "
@@ -152,10 +152,17 @@ class HDFSClient(FS):
         for k, v in self._configs.items():
             cmd += ["-D", f"{k}={v}"]
         cmd += list(args)
-        res = subprocess.run(cmd, capture_output=True, text=True,
-                             timeout=self._timeout_s)
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=not binary,
+                                 timeout=self._timeout_s)
+        except subprocess.TimeoutExpired as e:
+            raise RuntimeError(
+                f"hadoop fs {' '.join(args)} timed out after "
+                f"{self._timeout_s}s") from e
         if check and res.returncode != 0:
-            raise RuntimeError(f"hadoop fs {' '.join(args)} failed: {res.stderr}")
+            err = res.stderr if not binary else res.stderr.decode(
+                "utf-8", "replace")
+            raise RuntimeError(f"hadoop fs {' '.join(args)} failed: {err}")
         return res
 
     def ls_dir(self, path):
@@ -185,7 +192,11 @@ class HDFSClient(FS):
         self._run("-rm", "-r", "-f", path)
 
     def mv(self, src, dst, overwrite=False):
-        if overwrite and self.is_exist(dst):
+        if not self.is_exist(src):
+            raise FSFileNotExistsError(src)
+        if self.is_exist(dst):
+            if not overwrite:
+                raise FSFileExistsError(dst)
             self.delete(dst)
         self._run("-mv", src, dst)
 
@@ -206,4 +217,6 @@ class HDFSClient(FS):
         return True
 
     def cat(self, path):
-        return self._run("-cat", path).stdout.encode()
+        # binary capture: checkpoints are pickled/encrypted bytes — text-mode
+        # newline translation would corrupt them (LocalFS.cat returns bytes too)
+        return self._run("-cat", path, binary=True).stdout

@@ -68,6 +68,12 @@ def load(path, **configs):
 
             f.seek(0)
             return _unpack(pickle.loads(AESCipher(key).decrypt(f.read())))
+        if key is not None:
+            # caller expected an authenticated payload — a plain-pickle file
+            # here means tampering or a save/load mismatch, not a soft fallback
+            raise ValueError(
+                f"encryption_key given but {path} is not encrypted "
+                "(magic header missing); refusing to load unauthenticated data")
         f.seek(0)
         return _unpack(pickle.load(f))
 

@@ -47,6 +47,7 @@ class StaticFunction:
     parity): caches one compiled XLA program per input signature."""
 
     def __init__(self, fn, input_spec=None, layer=None):
+        self._orig_fn = fn
         self._fn = self._maybe_dy2static(fn)
         self._layer = layer
         self._input_spec = input_spec
@@ -73,7 +74,7 @@ class StaticFunction:
     def __get__(self, instance, owner):
         if instance is None:
             return self
-        return StaticFunction(self._fn.__get__(instance, owner), self._input_spec, layer=instance)
+        return StaticFunction(self._orig_fn.__get__(instance, owner), self._input_spec, layer=instance)
 
     def _resolve_layer(self, args):
         if self._layer is not None:
@@ -85,6 +86,8 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         layer, call_args = self._resolve_layer(args)
         tensor_args = [_tensorize(a) for a in call_args]
+        if getattr(self, "_eager_fallback", False):
+            return self._orig_fn(*tensor_args, **kwargs)
         key_parts = []
         for a in tensor_args:
             if isinstance(a, Tensor):
@@ -104,7 +107,28 @@ class StaticFunction:
         else:
             params, buffers = {}, {}
         arr_args = [a._data if isinstance(a, Tensor) else a for a in tensor_args]
-        out = compiled(params, buffers, *arr_args)
+        try:
+            out = compiled(params, buffers, *arr_args)
+        except Exception as e:
+            from .dy2static import Dy2StCarryError
+
+            # the rewritten control flow can fail only at trace time (a local
+            # the carry can't hold, a branch-structure mismatch): fall back to
+            # dygraph — run the original function eagerly, the reference's
+            # ProgramTranslator fallback semantics
+            if self._fn is self._orig_fn or not isinstance(
+                    e, (Dy2StCarryError, NameError)):
+                raise
+            import warnings
+
+            warnings.warn(
+                f"dy2static transform of '{getattr(self._orig_fn, '__name__', '?')}' "
+                f"failed at trace time ({type(e).__name__}: {e}); falling back "
+                "to eager (dygraph) execution")
+            self._fn = self._orig_fn
+            self._cache.clear()
+            self._eager_fallback = True
+            return self._orig_fn(*tensor_args, **kwargs)
         return jax.tree_util.tree_map(
             lambda v: Tensor(v), out, is_leaf=lambda v: isinstance(v, (jax.Array, np.ndarray))
         )

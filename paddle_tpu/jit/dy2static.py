@@ -26,7 +26,14 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 
-__all__ = ["convert_ifelse", "convert_while_loop", "transform_function"]
+__all__ = ["convert_ifelse", "convert_while_loop", "transform_function",
+           "Dy2StCarryError"]
+
+
+class Dy2StCarryError(TypeError):
+    """A rewritten control-flow region swept a value into its carry that lax
+    control flow cannot hold (e.g. None, a string, an object). StaticFunction
+    catches this and re-traces with the untransformed function."""
 
 
 def _is_traced(x):
@@ -51,7 +58,8 @@ def _to_carry(vals):
             raws.append(jnp.asarray(v))
             kinds.append("array")
         else:
-            raise TypeError(f"unsupported carry value {type(v).__name__}")
+            raise Dy2StCarryError(
+                f"unsupported carry value {type(v).__name__}")
     return tuple(raws), kinds
 
 
@@ -198,9 +206,36 @@ def _scoped_assigned(node):
     return names
 
 
+def _must_bound(st):
+    """Names SURELY bound after `st` executes (must-analysis): an If only
+    guarantees names both branches bind; a loop body may run zero times, a
+    Try may bail early — those guarantee nothing."""
+    if isinstance(st, ast.If):
+        t = set()
+        for s in st.body:
+            t |= _must_bound(s)
+        f = set()
+        for s in st.orelse:
+            f |= _must_bound(s)
+        return t & f
+    if isinstance(st, (ast.While, ast.For, ast.AsyncFor, ast.Try)):
+        return set()
+    if isinstance(st, (ast.With, ast.AsyncWith)):
+        out = set()
+        for item in st.items:
+            if item.optional_vars is not None:
+                out |= _target_names(item.optional_vars)
+        for s in st.body:
+            out |= _must_bound(s)
+        return out
+    return _scoped_assigned(st)
+
+
 def _annotate_bound_before(fdef):
-    """Attach `_bound_before` (names surely bound when control reaches the
-    node) to every If/While in the function scope."""
+    """Attach `_bound_before` (names SURELY bound when control reaches the
+    node — must-analysis, not may) to every If/While in the function scope.
+    May-bound would sweep a conditionally-assigned local into the carry and
+    NameError at runtime when the binding branch wasn't taken."""
     bound = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args
                              + fdef.args.kwonlyargs)}
     if fdef.args.vararg:
@@ -228,7 +263,7 @@ def _annotate_bound_before(fdef):
                     walk(blk, set(bound))
                 for h in st.handlers:
                     walk(h.body, set(bound))
-            bound |= _scoped_assigned(st)
+            bound |= _must_bound(st)
 
     walk(fdef.body, bound)
 

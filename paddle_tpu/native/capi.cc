@@ -57,8 +57,15 @@ const char* PD_GetLastError() { return g_last_error.c_str(); }
 int PD_Init() {
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);
+    if (!Py_IsInitialized()) return -1;
+    // Release the GIL held by the initializing thread: callers (the Go
+    // client migrates goroutines across OS threads) reach the interpreter
+    // via PyGILState_Ensure, which deadlocks if the init thread keeps the
+    // GIL forever. Saving the thread state here makes every later call —
+    // from ANY OS thread, including this one — go through PyGILState.
+    PyEval_SaveThread();
   }
-  return Py_IsInitialized() ? 0 : -1;
+  return 0;
 }
 
 void PD_Finalize() {

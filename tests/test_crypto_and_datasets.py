@@ -112,3 +112,16 @@ class TestEncryptedDygraphCheckpoint:
         para, _ = load_dygraph(base, encryption_key="kk")
         np.testing.assert_array_equal(np.asarray(para["weight"]._data),
                                       np.asarray(layer.weight._data))
+
+
+class TestLoadStrictKey:
+    def test_key_on_plain_file_rejected(self, tmp_path):
+        """ADVICE r1: load(encryption_key=...) on an unencrypted file must
+        raise, not silently fall back to plain pickle."""
+        import pytest
+        import paddle_tpu as paddle
+
+        p = str(tmp_path / "m.pdparams")
+        paddle.save({"w": paddle.to_tensor([1.0])}, p)
+        with pytest.raises(ValueError, match="not encrypted"):
+            paddle.load(p, encryption_key="0" * 32)

@@ -212,3 +212,40 @@ class TestASTTransform:
         out = new(paddle.to_tensor(np.array([2.0], np.float32)))
         # 3 iters: 2>0 -> 1; 1>0 -> 0; 0>0 false -> +1 => 1
         assert float(np.asarray(out._data)[0]) == 1.0
+
+    def test_conditionally_bound_local_not_in_carry(self):
+        """ADVICE r1: may-bound analysis swept a conditionally-assigned local
+        into the seed and NameError'd at runtime. Must-bound analysis keeps
+        it out of the carry (the tensor-if is then skipped or safe)."""
+        def f(x, flag):
+            if flag:            # host if: binds y only on one path
+                y = x * 2.0
+            if (x.sum() > paddle.to_tensor(0.0)):
+                z = x + 1.0
+            else:
+                z = x - 1.0
+            return z
+
+        new, cnt = transform_function(f)
+        out = new(paddle.to_tensor(np.array([1.0], np.float32)), False)
+        assert float(np.asarray(out._data)[0]) == 2.0
+
+    def test_none_local_falls_back_at_call_time(self):
+        """ADVICE r1: a None local swept into the carry raised TypeError with
+        no recovery. StaticFunction now falls back to plain tracing."""
+        import warnings as _w
+
+        class M(paddle.nn.Layer):
+            def forward(self, x):
+                state = None
+                i = paddle.to_tensor(0.0)
+                while (i < paddle.to_tensor(2.0)):
+                    state = x if state is None else state + x
+                    i = i + paddle.to_tensor(1.0)
+                return state
+
+        m = paddle.jit.to_static(M())
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            out = m(paddle.to_tensor(np.array([3.0], np.float32)))
+        assert float(np.asarray(out._data)[0]) == 6.0
