@@ -26,6 +26,17 @@ from ..core.tensor import Tensor
 from .mesh import get_mesh
 
 
+def _pvary(x, ax):
+    """Mark x device-varying over `ax` inside shard_map. Differentiating
+    w.r.t. an UNVARYING (replicated) input auto-psums the cotangent across
+    the axis — so a "local" gradient taken against replicated params comes
+    back pre-summed. pvary first keeps the grad genuinely rank-local."""
+    try:
+        return jax.lax.pcast(x, (ax,), to="varying")
+    except (AttributeError, TypeError):
+        return jax.lax.pvary(x, (ax,))
+
+
 def owned_device_put(v, sh):
     """device_put that never shares buffers with `v`.
 
@@ -131,12 +142,14 @@ class SpmdTrainer:
         self._place_state()
 
     # -- sharding placement ----------------------------------------------------
-    def _offload_state_shardings(self):
+    def _offload_state_shardings(self, force=False):
         """sharding_configs.offload parity: optimizer moments live in pinned
         host memory; XLA inserts the HBM<->host transfers around the update.
         TPU-only — the CPU backend cannot execute replicated pinned_host
-        programs (same XLA limitation as remat_offload)."""
-        on_cpu = np.asarray(self.mesh.devices).flat[0].platform == "cpu"
+        programs (same XLA limitation as remat_offload). `force` skips the
+        CPU guard so tests can assert the produced memory kinds."""
+        on_cpu = (not force and
+                  np.asarray(self.mesh.devices).flat[0].platform == "cpu")
         if on_cpu:
             import warnings
 
@@ -453,8 +466,12 @@ class SpmdTrainer:
                     loss, nb = fwd(pp, buffers, b)
                     return loss.astype(jnp.float32), nb
 
+                # differentiate against VARYING params: grads stay rank-local
+                # so top-k masks the local gradient and pmean below is the one
+                # true cross-rank reduce (see _pvary)
+                params_v = {n: _pvary(p, ax) for n, p in params.items()}
                 (loss, new_buf), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params, batch_local)
+                    loss_fn, has_aux=True)(params_v, batch_local)
                 new_p, new_st = {}, {"__step__": st["__step__"] + 1}
                 for n, p in params.items():
                     g = grads[n].astype(p.dtype)

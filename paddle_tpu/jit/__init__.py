@@ -74,7 +74,20 @@ class StaticFunction:
     def __get__(self, instance, owner):
         if instance is None:
             return self
-        return StaticFunction(self._orig_fn.__get__(instance, owner), self._input_spec, layer=instance)
+        # cache the bound wrapper per instance: a fresh StaticFunction per
+        # attribute access would discard its jit cache and _eager_fallback
+        # state, re-tracing (and re-warning) on every call
+        key = "__static_fn_" + getattr(self._orig_fn, "__name__", "fn")
+        try:
+            cached = instance.__dict__.get(key)
+        except AttributeError:  # instance without __dict__ (slots)
+            return StaticFunction(self._orig_fn.__get__(instance, owner),
+                                  self._input_spec, layer=instance)
+        if cached is None:
+            cached = StaticFunction(self._orig_fn.__get__(instance, owner),
+                                    self._input_spec, layer=instance)
+            instance.__dict__[key] = cached
+        return cached
 
     def _resolve_layer(self, args):
         if self._layer is not None:

@@ -92,10 +92,15 @@ def convert_ifelse(pred, true_fn, false_fn, seed=()):
             return raws
         return pure
 
-    raws = jax.lax.cond(jnp.asarray(p).astype(bool), wrap(true_fn, "t"),
-                        wrap(false_fn, "f"), seed_raws)
+    try:
+        raws = jax.lax.cond(jnp.asarray(p).astype(bool), wrap(true_fn, "t"),
+                            wrap(false_fn, "f"), seed_raws)
+    except TypeError as e:
+        # branch output structure mismatch (shape/dtype) from lax.cond:
+        # the rewrite is unsuitable — signal StaticFunction to fall back
+        raise Dy2StCarryError(f"cond branch structure mismatch: {e}") from e
     if kinds_box.get("t") != kinds_box.get("f"):
-        raise TypeError(
+        raise Dy2StCarryError(
             "convert_ifelse branches returned different value kinds "
             f"({kinds_box.get('t')} vs {kinds_box.get('f')}); both branches "
             "must produce the same Tensor/array structure")
@@ -123,7 +128,10 @@ def convert_while_loop(cond_fn, body_fn, carry):
         new_raws, _ = _to_carry(out)
         return new_raws
 
-    final = jax.lax.while_loop(cond, body, raws)
+    try:
+        final = jax.lax.while_loop(cond, body, raws)
+    except TypeError as e:
+        raise Dy2StCarryError(f"while carry structure mismatch: {e}") from e
     return _from_carry(final, kinds)
 
 
@@ -243,29 +251,34 @@ def _annotate_bound_before(fdef):
     if fdef.args.kwarg:
         bound.add(fdef.args.kwarg.arg)
 
-    def walk(stmts, bound):
+    def walk(stmts, bound, maybe):
         for st in stmts:
             if isinstance(st, (ast.If, ast.While)):
                 st._bound_before = set(bound)
+                # may-bound-but-not-must names are the danger zone: a rewrite
+                # must not classify them as loop-local temporaries (their
+                # writes would be silently discarded when the name IS bound)
+                st._maybound_before = set(maybe)
             if isinstance(st, ast.If):
-                walk(st.body, set(bound))
-                walk(st.orelse, set(bound))
+                walk(st.body, set(bound), set(maybe))
+                walk(st.orelse, set(bound), set(maybe))
             elif isinstance(st, (ast.While, ast.For)):
                 inner = set(bound)
                 if isinstance(st, ast.For):
                     inner |= _target_names(st.target)
-                walk(st.body, inner)
-                walk(st.orelse, set(bound))
+                walk(st.body, inner, set(maybe) | inner)
+                walk(st.orelse, set(bound), set(maybe))
             elif isinstance(st, (ast.With, ast.AsyncWith)):
-                walk(st.body, bound)
+                walk(st.body, bound, maybe)
             elif isinstance(st, ast.Try):
                 for blk in (st.body, st.orelse, st.finalbody):
-                    walk(blk, set(bound))
+                    walk(blk, set(bound), set(maybe))
                 for h in st.handlers:
-                    walk(h.body, set(bound))
+                    walk(h.body, set(bound), set(maybe))
             bound |= _must_bound(st)
+            maybe |= _scoped_assigned(st)
 
-    walk(fdef.body, bound)
+    walk(fdef.body, bound, set(bound))
 
 
 class _ControlFlowTransformer(ast.NodeTransformer):
@@ -330,7 +343,15 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if node.orelse or _contains(node.body, _BAD_LOOP):
             return node
         bound_before = getattr(node, "_bound_before", set())
+        maybound_before = getattr(node, "_maybound_before", set())
         assigned = _assigned_names(node.body)
+        # a body write to a name that MAY be bound before the loop but is not
+        # SURELY bound cannot be classified: as a carry it could NameError on
+        # the unbound path, as a loop-local its write would be silently
+        # dropped on the bound path — bail out, keep the python loop
+        risky = (assigned & maybound_before) - bound_before
+        if risky:
+            return node
         # loop-local temporaries (never bound before the loop) stay local to
         # the body fn; the carry holds only pre-bound names
         names = sorted(assigned & bound_before)

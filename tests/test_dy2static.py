@@ -249,3 +249,51 @@ class TestASTTransform:
             _w.simplefilter("ignore")
             out = m(paddle.to_tensor(np.array([3.0], np.float32)))
         assert float(np.asarray(out._data)[0]) == 6.0
+
+    def test_maybound_loop_write_not_dropped(self):
+        """Code-review r2: a while-body write to a conditionally-bound name
+        must not be discarded as a loop-local temp — the loop stays python
+        (transform bails) so semantics are preserved."""
+        def f(x, flag):
+            i = paddle.to_tensor(0.0)
+            if flag:
+                y = paddle.to_tensor(0.0)
+            while (i < x.sum()):
+                y = i * 2.0
+                i = i + 1.0
+            return y
+
+        new, cnt = transform_function(f)
+        out = new(paddle.to_tensor(np.array([3.0], np.float32)), True)
+        # eager semantics: loop runs i=0,1,2 -> y = 2*2 = 4
+        assert float(np.asarray(out._data if hasattr(out, "_data") else out)) == 4.0
+
+    def test_branch_structure_mismatch_falls_back(self):
+        """Code-review r2: a tensor-if whose branches produce mismatched
+        structures falls back to eager instead of hard-failing."""
+        import warnings as _w
+
+        class M(paddle.nn.Layer):
+            def forward(self, x):
+                if (x.sum() > paddle.to_tensor(0.0)):
+                    z = x + 1.0
+                else:
+                    z = 0.0  # python float vs Tensor: structure mismatch
+                return z
+
+        m = paddle.jit.to_static(M())
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            out = m(paddle.to_tensor(np.array([2.0], np.float32)))
+        assert float(np.asarray(out._data)) == 3.0
+
+    def test_bound_staticfunction_cached_on_instance(self):
+        """Code-review r2: class-level @to_static methods must reuse one
+        StaticFunction per instance (jit cache + fallback state persist)."""
+        class M(paddle.nn.Layer):
+            @paddle.jit.to_static
+            def forward(self, x):
+                return x * 2.0
+
+        m = M()
+        assert m.forward is m.forward

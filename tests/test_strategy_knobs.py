@@ -1,0 +1,280 @@
+"""Strategy-knob wiring tests (VERDICT r1 #2): enabling each fleet flag must
+provably change the compiled program or the training dynamics — the TPU-native
+rebirth of the reference's meta-optimizer graph-pattern tests
+(test_fleet_sharding_meta_optimizer.py style: there ops are asserted in the
+rewritten program; here shardings / HLO text / rank-divergence are asserted).
+"""
+import warnings
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.distributed.spmd import SpmdTrainer
+from paddle_tpu.distributed.fleet.meta_optimizers.dgc_optimizer import (
+    DGCMomentumOptimizer,
+)
+
+
+def needs_8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+
+
+def _net(seed=0, din=8, dout=4):
+    paddle.seed(seed)
+    rng = np.random.RandomState(seed)
+    net = nn.Linear(din, dout)
+    init = {k: rng.randn(*v.shape).astype(np.float32) * 0.1
+            for k, v in net.state_dict().items()}
+    net.set_state_dict(init)
+    return net, init
+
+
+def _data(seed=1, n=32, din=8, dout=4):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, din).astype(np.float32)
+    y = rng.randn(n, dout).astype(np.float32)
+    return paddle.to_tensor(x), paddle.to_tensor(y)
+
+
+MSE = staticmethod(lambda o, l: ((o - l) ** 2).mean())
+
+
+def _lowered_text(trainer, x, y):
+    """HLO text of the trainer's step for these inputs."""
+    batch = [x._data, y._data]
+    step = trainer._build(batch)
+    lr = jnp.asarray(0.1, jnp.float32)
+    return step.lower(trainer.params, trainer.opt_state, trainer.buffers,
+                      lr, *batch).as_text()
+
+
+class TestLocalSGD:
+    def test_k1_sgd_matches_plain_dp(self):
+        """k=1 LocalSGD with SGD == plain DP: per-rank update then param
+        pmean equals update with pmean'd grads (linearity of SGD)."""
+        needs_8()
+        mesh = build_mesh((8,), ("dp",))
+        x, y = _data()
+
+        net_a, init = _net()
+        opt_a = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net_a.parameters())
+        dp = SpmdTrainer(net_a, opt_a, lambda o, l: ((o - l) ** 2).mean(),
+                         mesh=mesh)
+        net_b, _ = _net()
+        net_b.set_state_dict(init)
+        opt_b = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net_b.parameters())
+        ls = SpmdTrainer(net_b, opt_b, lambda o, l: ((o - l) ** 2).mean(),
+                         mesh=mesh, localsgd_k=1)
+
+        for _ in range(3):
+            la = float(dp.train_step(x, y)._data)
+            lb = float(ls.train_step(x, y)._data)
+            np.testing.assert_allclose(la, lb, rtol=1e-5)
+        dp.sync_to_layer()
+        # localsgd params carry a leading replica dim; all replicas synced
+        for k, v in dp.params.items():
+            reps = np.asarray(ls.params[k])
+            np.testing.assert_allclose(reps[0], np.asarray(v), rtol=1e-4,
+                                       atol=1e-6)
+
+    def test_k2_ranks_diverge_then_sync(self):
+        """The defining LocalSGD dynamic: replicas differ after an off-sync
+        step and are identical after the k-th step's param pmean."""
+        needs_8()
+        mesh = build_mesh((8,), ("dp",))
+        net, _ = _net()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        tr = SpmdTrainer(net, opt, lambda o, l: ((o - l) ** 2).mean(),
+                         mesh=mesh, localsgd_k=2)
+        x, y = _data()
+
+        tr.train_step(x, y)  # step 1: no sync
+        w = np.asarray(tr.params["weight"])  # [8, din, dout] replicas
+        spread1 = np.abs(w - w[0]).max()
+        assert spread1 > 1e-7, "ranks saw different shards; replicas must differ"
+
+        tr.train_step(x, y)  # step 2: pmean sync
+        w = np.asarray(tr.params["weight"])
+        spread2 = np.abs(w - w[0]).max()
+        assert spread2 < 1e-6, f"after k-th step replicas must agree ({spread2})"
+
+    def test_localsgd_program_differs_from_dp(self):
+        """Jaxpr/HLO-level: the localsgd step compiles to a different program
+        (param pmean gated on step count instead of per-step grad psum)."""
+        needs_8()
+        mesh = build_mesh((8,), ("dp",))
+        x, y = _data()
+        net_a, _ = _net()
+        dp = SpmdTrainer(net_a, paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net_a.parameters()),
+            lambda o, l: ((o - l) ** 2).mean(), mesh=mesh)
+        net_b, _ = _net()
+        ls = SpmdTrainer(net_b, paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net_b.parameters()),
+            lambda o, l: ((o - l) ** 2).mean(), mesh=mesh, localsgd_k=4)
+        t_dp = _lowered_text(dp, x, y)
+        t_ls = _lowered_text(ls, x, y)
+        assert t_dp != t_ls
+        # the gate: localsgd selects between synced and local params
+        assert "stablehlo.select" in t_ls
+
+    def test_localsgd_rejects_sharding(self):
+        needs_8()
+        mesh = build_mesh((8,), ("dp",))
+        net, _ = _net()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        with pytest.raises(ValueError, match="localsgd"):
+            SpmdTrainer(net, opt, lambda o, l: ((o - l) ** 2).mean(),
+                        mesh=mesh, localsgd_k=2, sharding_stage=2)
+
+    def test_fleet_strategy_routes_localsgd(self):
+        needs_8()
+        from paddle_tpu.distributed.fleet import DistributedStrategy, fleet
+
+        strategy = DistributedStrategy()
+        strategy.localsgd = True
+        strategy.localsgd_configs.k_steps = 4
+        strategy.localsgd_configs.begin_step = 2
+        fleet.init(is_collective=True, strategy=strategy)
+        net, _ = _net()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        tr = fleet.build_trainer(net, opt,
+                                 loss_fn=lambda o, l: ((o - l) ** 2).mean())
+        assert tr.localsgd_k == 4 and tr.localsgd_begin == 2
+
+
+class TestDGC:
+    def test_sparsity_zero_matches_plain_sgd_dp(self):
+        """sparsity=0 -> full mask, residuals reset each step: the momentum-
+        corrected allreduce degenerates to plain SGD on the mean grad."""
+        needs_8()
+        mesh = build_mesh((8,), ("dp",))
+        x, y = _data()
+        net_a, init = _net()
+        dp = SpmdTrainer(net_a, paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net_a.parameters()),
+            lambda o, l: ((o - l) ** 2).mean(), mesh=mesh)
+        net_b, _ = _net()
+        net_b.set_state_dict(init)
+        dgc_opt = DGCMomentumOptimizer(learning_rate=0.1, momentum=0.9,
+                                       sparsity=0.0,
+                                       parameters=net_b.parameters())
+        dg = SpmdTrainer(net_b, dgc_opt, lambda o, l: ((o - l) ** 2).mean(),
+                         mesh=mesh)
+        assert dg._is_dgc()
+        for _ in range(3):
+            la = float(dp.train_step(x, y)._data)
+            lb = float(dg.train_step(x, y)._data)
+            np.testing.assert_allclose(la, lb, rtol=1e-5)
+
+    def test_sparse_reduce_keeps_residuals_and_converges(self):
+        needs_8()
+        mesh = build_mesh((8,), ("dp",))
+        net, _ = _net()
+        dgc_opt = DGCMomentumOptimizer(learning_rate=0.05, momentum=0.9,
+                                       sparsity=0.75,
+                                       parameters=net.parameters())
+        tr = SpmdTrainer(net, dgc_opt, lambda o, l: ((o - l) ** 2).mean(),
+                         mesh=mesh)
+        x, y = _data()
+        losses = [float(tr.train_step(x, y)._data) for _ in range(8)]
+        assert losses[-1] < losses[0]
+        # residuals are genuinely carried (the un-sent 75% accumulates)
+        u = np.asarray(tr.opt_state["weight"]["dgc_u"])
+        assert np.abs(u).max() > 0
+        # and PER-RANK: replicas must not be forced equal
+        assert u.shape[0] == 8
+
+    def test_dgc_program_has_topk_sort(self):
+        """HLO-level: DGC's top-k threshold compiles to a sort; plain DP SGD
+        has none."""
+        needs_8()
+        mesh = build_mesh((8,), ("dp",))
+        x, y = _data()
+        net_a, _ = _net()
+        dp = SpmdTrainer(net_a, paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net_a.parameters()),
+            lambda o, l: ((o - l) ** 2).mean(), mesh=mesh)
+        net_b, _ = _net()
+        dg = SpmdTrainer(net_b, DGCMomentumOptimizer(
+            learning_rate=0.1, sparsity=0.9, parameters=net_b.parameters()),
+            lambda o, l: ((o - l) ** 2).mean(), mesh=mesh)
+        t_dp = _lowered_text(dp, x, y)
+        t_dg = _lowered_text(dg, x, y)
+        assert "chlo.top_k" in t_dg or "sort" in t_dg
+        assert "chlo.top_k" not in t_dp and "sort" not in t_dp
+
+    def test_dgc_rejects_sharding(self):
+        needs_8()
+        mesh = build_mesh((8,), ("dp",))
+        net, _ = _net()
+        dgc_opt = DGCMomentumOptimizer(learning_rate=0.1,
+                                       parameters=net.parameters())
+        with pytest.raises(ValueError, match="DGC"):
+            SpmdTrainer(net, dgc_opt, lambda o, l: ((o - l) ** 2).mean(),
+                        mesh=build_mesh((8,), ("dp",)), sharding_stage=2)
+
+
+class TestStateOffload:
+    def test_warns_and_ignores_on_cpu(self):
+        needs_8()
+        mesh = build_mesh((8,), ("dp",))
+        net, _ = _net()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            tr = SpmdTrainer(net, opt, lambda o, l: ((o - l) ** 2).mean(),
+                             mesh=mesh, state_offload=True)
+        assert any("state_offload" in str(x.message) for x in w)
+        x, y = _data()
+        assert np.isfinite(float(tr.train_step(x, y)._data))
+
+    def test_offload_shardings_are_pinned_host(self):
+        """The TPU path: every optimizer moment gets memory_kind=pinned_host
+        (sharding_configs.offload parity); __step__ stays in device memory."""
+        needs_8()
+        mesh = build_mesh((8,), ("dp",))
+        net, _ = _net()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            tr = SpmdTrainer(net, opt, lambda o, l: ((o - l) ** 2).mean(),
+                             mesh=mesh, state_offload=True)
+        off = tr._offload_state_shardings(force=True)
+        for pname, st in off.items():
+            if pname == "__step__":
+                continue
+            for k, sh in st.items():
+                assert sh.memory_kind == "pinned_host", (pname, k)
+
+    def test_fleet_sharding_offload_routes(self):
+        needs_8()
+        from paddle_tpu.distributed.fleet import DistributedStrategy, fleet
+
+        strategy = DistributedStrategy()
+        strategy.sharding = True
+        strategy.sharding_configs.stage = 2
+        strategy.sharding_configs.offload = True
+        fleet.init(is_collective=True, strategy=strategy)
+        net, _ = _net()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # CPU backend ignores the offload
+            tr = fleet.build_trainer(
+                net, opt, loss_fn=lambda o, l: ((o - l) ** 2).mean())
+        assert tr.sharding_stage == 2 and tr.state_offload
