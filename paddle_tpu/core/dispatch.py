@@ -20,6 +20,14 @@ def _needs_grad(t):
     return (not t.stop_gradient) and dtype_mod.is_floating(t.dtype)
 
 
+# Static-graph op recorder (paddle_tpu.static installs itself here): when
+# static mode is on, every dispatched op is appended to the default Program
+# so Executor.run can replay it — the TraceOp -> OpDesc path of the
+# reference's static world (fluid/framework.py append_op).
+_STATIC_RECORDER = [None]
+_STATIC_REBIND = [None]
+
+
 def apply(fn, *args, n_outputs=None, **kwargs):
     """Run `fn` over the raw values of Tensor args; tape a vjp node if needed.
 
@@ -72,6 +80,10 @@ def apply(fn, *args, n_outputs=None, **kwargs):
             t._node = node
         tape.record(node)
 
+    rec = _STATIC_RECORDER[0]
+    if rec is not None:
+        rec(fn, args, kwargs, out_tensors)
+
     if multi:
         return tuple(out_tensors)
     return out_tensors[0]
@@ -92,4 +104,7 @@ def apply_inplace(fn, target, *args, **kwargs):
         idx = out._node.outputs.index(out)
         out._node.outputs[idx] = target
         target.stop_gradient = out.stop_gradient
+    reb = _STATIC_REBIND[0]
+    if reb is not None:
+        reb(out, target)
     return target

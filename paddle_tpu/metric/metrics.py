@@ -164,13 +164,22 @@ class Auc(Metric):
 
 
 def accuracy(input, label, k=1, correct=None, total=None, name=None):
-    """paddle.metric.accuracy functional parity."""
+    """paddle.metric.accuracy functional parity (metrics/accuracy_op.cc).
+
+    Dispatched (jnp, not host numpy) so it works under jit traces and is
+    recorded into static Programs for fetch_list."""
+    import jax
     import jax.numpy as jnp
 
-    p = _np(input)
-    l = _np(label)
-    idx = np.argsort(-p, axis=-1)[:, :k]
-    if l.ndim == 2 and l.shape[1] == 1:
-        l = l[:, 0]
-    correct_v = (idx == l[:, None]).any(axis=1).mean()
-    return Tensor(jnp.asarray(np.float32(correct_v)))
+    from ..core.dispatch import apply
+
+    def fn(p, l):
+        idx = jax.lax.top_k(p, k)[1]
+        if l.ndim == 2 and l.shape[1] == 1:
+            l = l[:, 0]
+        hit = (idx == l[:, None]).any(axis=1)
+        return hit.astype(jnp.float32).mean()
+
+    return apply(fn,
+                 input if isinstance(input, Tensor) else Tensor(input),
+                 label if isinstance(label, Tensor) else Tensor(label))

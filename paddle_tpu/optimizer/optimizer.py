@@ -93,6 +93,17 @@ class Optimizer:
             self._state[id(p)] = new_state
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from .. import static as _static
+
+        if _static.in_static_mode():
+            # static world: attach the optimizer to the recorded program
+            # (append_backward + optimize-op insertion, executor-side).
+            # set_optimizer raises if the loss is not a var of the program —
+            # a silent eager fallback would train against zero placeholders.
+            prog = _static.default_main_program()
+            prog.set_optimizer(self, loss, parameters=parameters,
+                               no_grad_set=no_grad_set)
+            return None, []
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in self._parameters]

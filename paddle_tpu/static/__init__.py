@@ -1,10 +1,20 @@
 """paddle.static parity (python/paddle/static/__init__.py).
 
-Reference parity: the Program/Executor static-graph world (fluid/framework.py:4174
-Program, fluid/executor.py:475 Executor). TPU-native design: a "Program" is a recorded
-python callable + captured parameter state; Executor.run jit-compiles it. This keeps the
-paddle.static API shape (enable_static, data, program_guard, Executor) while the real
-compilation is jax.jit — there is no separate graph IR to interpret.
+Reference parity: the Program/Executor static-graph world — Program/Block/
+Operator/Variable graph construction (fluid/framework.py:4174 Program,
+:978 Block/append_op) and Executor.run(feed, fetch_list)
+(fluid/executor.py:916). There, every fluid API call appends OpDescs to the
+default program; Executor interprets the graph against a Scope.
+
+TPU-native design: ops still EXECUTE eagerly at build time (placeholders are
+zero arrays, so shapes are concrete), but while static mode is on every
+dispatched op is also RECORDED into the default Program as
+(pure_jnp_fn, arg_specs, out_ids). Executor.run slices the recorded op list
+to what the fetch_list needs, replays it as one pure function of
+(params, feed) and jax.jit-compiles that per feed-signature — the ParallelExecutor/
+interpreter world collapses into XLA compilation. `minimize` attaches the
+optimizer functionally (jax.value_and_grad over the replay + functional_apply),
+the append_backward program-surgery equivalent.
 """
 import contextlib
 
@@ -13,7 +23,8 @@ import jax
 import jax.numpy as jnp
 
 from ..core import dtype as dtype_mod
-from ..core.tensor import Tensor
+from ..core import dispatch as _dispatch
+from ..core.tensor import Tensor, ParamBase
 from ..jit import InputSpec  # noqa: F401
 
 _STATIC_MODE = [False]
@@ -35,24 +46,184 @@ def in_dynamic_mode():
     return not _STATIC_MODE[0]
 
 
-class Program:
-    """Deferred-execution program: a list of (fn, inputs, outputs) build steps.
+class _OpRecord:
+    __slots__ = ("fn", "arg_specs", "kwargs", "out_ids")
 
-    The fluid Program/Block/Op IR (framework.py:978-4174) collapses to: the user builds
-    with symbolic `data` tensors; we record the callable graph lazily by just keeping
-    the python closures — at run time the feed dict supplies leaf values and the
-    recorded forward is executed under jax.jit.
-    """
+    def __init__(self, fn, arg_specs, kwargs, out_ids):
+        self.fn = fn
+        self.arg_specs = arg_specs  # [("var", id) | ("const", value)]
+        self.kwargs = kwargs
+        self.out_ids = out_ids
+
+
+class Program:
+    """Recorded op-list program (fluid Program/Block collapse).
+
+    vars holds strong refs to every Tensor the graph touches; params are the
+    persistable leaves (scope state), placeholders the feed slots. `_scope`
+    is shared with clones — the Scope of the reference's executor."""
 
     def __init__(self):
-        self._build_fns = []  # ordered (callable, feed_names, fetch_holder)
+        self.ops = []
+        self.vars = {}          # id(tensor) -> Tensor
+        self._data_ids = {}     # id(tensor._data) -> var id: functionals often
+                                # RE-WRAP args (Tensor(x) shares x._data, new
+                                # object id); resolving through the underlying
+                                # immutable jax array keeps the var chain intact
+                                # instead of baking the build-time value
+        self.placeholders = {}  # feed name -> var id
+        self.placeholder_shapes = {}  # feed name -> declared shape (None dims)
+        self.params = {}        # var id -> param name
+        self.param_names = {}   # param name -> var id
+        self._initial = {}      # param name -> np.ndarray (startup values)
+        self._scope = {"params": None, "opt_state": None}
+        self._optimizer = None
+        self._loss_id = None
+        self._train_param_names = None  # None = all params the loss reaches
+        self._paired_main = None        # set on startup programs by program_guard
+        self._version = 0
         self.random_seed = None
 
+    # -- building --------------------------------------------------------------
+    def _register_placeholder(self, name, t, declared_shape):
+        self.vars[id(t)] = t
+        self._data_ids[id(t._data)] = id(t)
+        self.placeholders[name] = id(t)
+        self.placeholder_shapes[name] = tuple(declared_shape)
+
+    def _register_param(self, t):
+        name = t.name or f"param_{len(self.param_names)}"
+        if name in self.param_names and self.param_names[name] != id(t):
+            name = f"{name}_{len(self.param_names)}"
+        self.vars[id(t)] = t
+        self._data_ids[id(t._data)] = id(t)
+        self.params[id(t)] = name
+        self.param_names[name] = id(t)
+        self._initial[name] = np.asarray(t._data)
+        return name
+
+    def _resolve_var(self, t):
+        """SSA resolution of a Tensor to its var id. _data identity is checked
+        FIRST: functionals re-wrap tensors (new object, same array) and
+        apply_inplace rebinds a target's _data to the op output — in both
+        cases the underlying immutable array names the current value, while
+        the object id may point at a stale binding."""
+        vid = self._data_ids.get(id(t._data))
+        if vid is not None:
+            return vid
+        return id(t) if id(t) in self.vars else None
+
+    def _record(self, fn, args, kwargs, outs):
+        specs = []
+        for a in args:
+            if isinstance(a, Tensor):
+                vid = self._resolve_var(a)
+                if vid is None:
+                    if isinstance(a, ParamBase) or a.persistable:
+                        self._register_param(a)
+                        vid = id(a)
+                    else:
+                        # a tensor created eagerly outside the graph: bake it
+                        specs.append(("const", a._data))
+                        continue
+                specs.append(("var", vid))
+            else:
+                specs.append(("const", a))
+        kw = {k: (v._data if isinstance(v, Tensor) else v)
+              for k, v in kwargs.items()}
+        for o in outs:
+            self.vars[id(o)] = o
+            self._data_ids[id(o._data)] = id(o)
+        self.ops.append(_OpRecord(fn, specs, kw, [id(o) for o in outs]))
+        self._version += 1
+
+    def _rebind(self, old, new_t):
+        """apply_inplace rebound new_t._data to old's value: keep a strong
+        ref to new_t; _resolve_var already routes future uses through the
+        shared array to `old`'s record (SSA — the old producer op stays the
+        sole producer of its id)."""
+        if id(old) in self.vars:
+            self.vars[id(new_t)] = new_t
+
+    # -- optimizer attachment (append_backward + optimize-op insertion) --------
+    def set_optimizer(self, optimizer, loss, parameters=None,
+                      no_grad_set=None):
+        lid = self._resolve_var(loss) if isinstance(loss, Tensor) else None
+        if lid is None:
+            raise ValueError(
+                "minimize(loss): loss was not built in this program "
+                "(build it from static.data placeholders under program_guard)")
+        self._optimizer = optimizer
+        self._loss_id = lid
+        self._train_param_names = None
+        if parameters:
+            names = set()
+            for p in parameters:
+                pid = self._resolve_var(p) if isinstance(p, Tensor) else None
+                if pid in self.params:
+                    names.add(self.params[pid])
+                elif isinstance(p, str) and p in self.param_names:
+                    names.add(p)
+            self._train_param_names = names
+        if no_grad_set:
+            frozen = set()
+            for p in no_grad_set:
+                pid = self._resolve_var(p) if isinstance(p, Tensor) else None
+                if pid in self.params:
+                    frozen.add(self.params[pid])
+                elif isinstance(p, str):
+                    frozen.add(p)
+            base = (self._train_param_names
+                    if self._train_param_names is not None
+                    else set(self.param_names))
+            self._train_param_names = base - frozen
+        self._version += 1
+
+    # -- scope/state -----------------------------------------------------------
+    def _ensure_scope(self):
+        if self._scope["params"] is None:
+            self._scope["params"] = {}
+        # top-up: params registered since the last run initialize lazily
+        for name in self.param_names:
+            if name not in self._scope["params"]:
+                self._scope["params"][name] = jnp.asarray(self._initial[name])
+
+    def _reset_scope(self):
+        self._scope["params"] = {
+            name: jnp.asarray(self._initial[name]) for name in self.param_names
+        }
+        self._scope["opt_state"] = None
+
+    def _sync_params_to_tensors(self):
+        for vid, name in self.params.items():
+            t = self.vars.get(vid)
+            if t is not None and self._scope["params"] is not None:
+                t._data = self._scope["params"][name]
+
+    def state_dict(self):
+        self._ensure_scope()
+        return {n: Tensor(v) for n, v in self._scope["params"].items()}
+
+    # -- reference API surface -------------------------------------------------
     def global_block(self):
         return self
 
+    def all_parameters(self):
+        return [self.vars[vid] for vid in self.params]
+
+    def list_vars(self):
+        return list(self.vars.values())
+
     def clone(self, for_test=False):
-        return self
+        """Shares ops/vars/scope (the reference clones the graph but runs in
+        the same Scope); for_test drops the optimizer so Executor.run does
+        pure inference — the canonical `test_program = main.clone(True)`."""
+        c = Program.__new__(Program)
+        c.__dict__ = dict(self.__dict__)
+        if for_test:
+            c._optimizer = None
+            c._loss_id = None
+        return c
 
 
 _default_main = [Program()]
@@ -73,6 +244,9 @@ def program_guard(main_program, startup_program=None):
     _default_main[0] = main_program
     if startup_program is not None:
         _default_startup[0] = startup_program
+        # running the startup later must initialize THIS main program's
+        # params, wherever the defaults point at that moment
+        startup_program._paired_main = main_program
     try:
         yield
     finally:
@@ -80,38 +254,240 @@ def program_guard(main_program, startup_program=None):
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    """paddle.static.data parity: returns a named placeholder Tensor (zeros)."""
-    shape = [1 if (s is None or s < 0) else s for s in shape]
-    t = Tensor(jnp.zeros(shape, dtype=dtype_mod.convert_dtype(dtype)))
+    """paddle.static.data parity: a named feed placeholder.
+
+    Build-time value is zeros with None dims -> 1, so downstream ops execute
+    (and shape-infer) concretely; Executor.run replaces it with the fed batch."""
+    declared = list(shape)
+    concrete = [1 if (s is None or s < 0) else s for s in shape]
+    t = Tensor(jnp.zeros(concrete, dtype=dtype_mod.convert_dtype(dtype)))
     t.name = name
     t.stop_gradient = True
-    t._is_placeholder = True  # type: ignore[attr-defined]
+    if _STATIC_MODE[0]:
+        _default_main[0]._register_placeholder(name, t, declared)
     return t
 
 
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """fluid.backward.append_backward parity: in this design gradients are
+    derived by jax.value_and_grad over the recorded replay at run time, so
+    this only validates that `loss` belongs to the default program."""
+    prog = _default_main[0]
+    if id(loss) not in prog.vars:
+        raise ValueError("append_backward: loss is not a var of the default "
+                         "main program")
+    return []
+
+
+# -- the dispatch hooks --------------------------------------------------------
+
+from ..core.tape import global_tape as _global_tape  # noqa: E402
+
+
+def _record_hook(fn, args, kwargs, outs):
+    if not _STATIC_MODE[0]:
+        return
+    # tape paused == inside a jitted trainer/StaticFunction trace: those
+    # compile their own programs; recording their tracer ops would leak
+    if not _global_tape().enabled:
+        return
+    _default_main[0]._record(fn, args, kwargs, outs)
+
+
+def _rebind_hook(old, new_t):
+    if not _STATIC_MODE[0]:
+        return
+    _default_main[0]._rebind(old, new_t)
+
+
+_dispatch._STATIC_RECORDER[0] = _record_hook
+_dispatch._STATIC_REBIND[0] = _rebind_hook
+
+
+# -- execution -----------------------------------------------------------------
+
+def _slice_ops(program, target_ids):
+    """Backward slice: only ops the targets (+loss) actually need run."""
+    producer = {}
+    for idx, op in enumerate(program.ops):
+        for oid in op.out_ids:
+            producer[oid] = idx
+    needed = set()
+    stack = [t for t in target_ids if t is not None]
+    while stack:
+        vid = stack.pop()
+        idx = producer.get(vid)
+        if idx is None or idx in needed:
+            continue
+        needed.add(idx)
+        for spec in program.ops[idx].arg_specs:
+            if spec[0] == "var":
+                stack.append(spec[1])
+    return [program.ops[i] for i in sorted(needed)]
+
+
 class Executor:
-    """fluid/executor.py:475 Executor parity, jax.jit-backed."""
+    """fluid/executor.py:916 Executor parity: run(feed, fetch_list) over the
+    recorded program, jax.jit-compiled per (program version, feed signature,
+    fetch set). Running an empty program (the startup program) initializes
+    the default main program's parameters — the startup-initializer-ops run."""
 
     def __init__(self, place=None):
         self.place = place
+        self._cache = {}
 
-    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
-        # static programs in this framework are callables recorded via
-        # paddle.static.nn or user closures; the common path is Model-based.
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True,
+            scope=None):
+        feed = feed or {}
+        if program is None:
+            program = default_main_program()
         if callable(program) and not isinstance(program, Program):
-            out = program(**(feed or {}))
+            # legacy path: a plain python callable "program"
+            out = program(**feed)
             outs = out if isinstance(out, (list, tuple)) else [out]
-        elif fetch_list:
-            outs = fetch_list
-        else:
-            outs = []
-        res = []
-        for o in outs:
-            if isinstance(o, Tensor):
-                res.append(np.asarray(o._data) if return_numpy else o)
+            return [np.asarray(o._data) if isinstance(o, Tensor) and return_numpy
+                    else o for o in outs]
+        if not isinstance(program, Program):
+            return []
+        if not program.ops:
+            # startup program: (re)run parameter initialization for the main
+            # program it was paired with (fallback: the current default)
+            main = program._paired_main or default_main_program()
+            main._reset_scope()
+            return []
+        return self._run_program(program, feed, fetch_list or [], return_numpy)
+
+    # -- internals -------------------------------------------------------------
+    def _fetch_id(self, program, f):
+        if isinstance(f, Tensor):
+            vid = id(f)
+            if vid in program.vars:
+                return vid
+            raise ValueError(f"fetch var {getattr(f, 'name', f)} is not part "
+                             "of the program")
+        if isinstance(f, str):
+            if f in program.placeholders:
+                return program.placeholders[f]
+            if f in program.param_names:
+                return program.param_names[f]
+            for t in program.vars.values():
+                if getattr(t, "name", None) == f:
+                    return id(t)
+            raise ValueError(f"fetch name '{f}' not found in program")
+        raise TypeError(f"cannot fetch {type(f).__name__}")
+
+    def _run_program(self, program, feed, fetch_list, return_numpy):
+        program._ensure_scope()
+        fetch_ids = tuple(self._fetch_id(program, f) for f in fetch_list)
+        train = program._optimizer is not None and program._loss_id is not None
+        feed_arrays = {k: jnp.asarray(np.asarray(v)) for k, v in feed.items()}
+        sig = tuple(sorted((k, v.shape, str(v.dtype))
+                           for k, v in feed_arrays.items()))
+        key = (id(program), program._version, train, fetch_ids, sig)
+        if key not in self._cache:
+            self._cache[key] = self._compile(program, tuple(feed_arrays),
+                                             fetch_ids, train)
+        compiled = self._cache[key]
+        scope = program._scope
+        if train:
+            opt = program._optimizer
+            if scope["opt_state"] is None:
+                scope["opt_state"] = opt.functional_init(scope["params"])
             else:
-                res.append(o)
-        return res
+                for n, v in scope["params"].items():
+                    if n not in scope["opt_state"]:
+                        scope["opt_state"][n] = opt.functional_init({n: v})[n]
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            new_p, new_s, fetches = compiled(scope["params"],
+                                             scope["opt_state"], lr,
+                                             feed_arrays)
+            scope["params"] = new_p
+            scope["opt_state"] = new_s
+            opt._step_count += 1
+            program._sync_params_to_tensors()
+        else:
+            fetches = compiled(scope["params"], feed_arrays)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    def _compile(self, program, feed_names, fetch_ids, train):
+        targets = list(fetch_ids) + ([program._loss_id] if train else [])
+        ops = _slice_ops(program, targets)
+
+        # validate feeds BEFORE jit: every needed placeholder must be fed
+        bound = set()
+        for name in feed_names:
+            if name not in program.placeholders:
+                raise ValueError(f"feed '{name}' is not a static.data "
+                                 "placeholder of this program")
+            bound.add(program.placeholders[name])
+        bound |= set(program.params)
+        for op in ops:
+            for spec in op.arg_specs:
+                if spec[0] == "var" and spec[1] not in bound:
+                    missing = spec[1]
+                    for n, vid in program.placeholders.items():
+                        if vid == missing:
+                            raise ValueError(
+                                f"placeholder '{n}' is required by the "
+                                f"fetch_list but missing from feed")
+            bound |= set(op.out_ids)
+
+        ph = program.placeholders
+        params_map = dict(program.params)
+
+        def forward(param_arrays, feed_arrays):
+            env = {}
+            for name, arr in feed_arrays.items():
+                env[ph[name]] = arr
+            for vid, name in params_map.items():
+                env[vid] = param_arrays[name]
+            for op in ops:
+                vals = [env[s[1]] if s[0] == "var" else s[1]
+                        for s in op.arg_specs]
+                out = op.fn(*vals, **op.kwargs)
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                for oid, o in zip(op.out_ids, outs):
+                    env[oid] = o
+            return env
+
+        if not train:
+            def ev(param_arrays, feed_arrays):
+                env = forward(param_arrays, feed_arrays)
+                return [env[i] for i in fetch_ids]
+
+            return jax.jit(ev)
+
+        opt = program._optimizer
+        # update ONLY params the sliced loss graph actually uses (a second
+        # model in the same program must not weight-decay toward zero), and
+        # honor minimize(parameters=/no_grad_set=)
+        used = set()
+        for op in ops:
+            for s in op.arg_specs:
+                if s[0] == "var" and s[1] in params_map:
+                    used.add(params_map[s[1]])
+        train_names = (used if program._train_param_names is None
+                       else used & program._train_param_names)
+
+        def step(param_arrays, opt_state, lr, feed_arrays):
+            sub = {n: param_arrays[n] for n in train_names}
+
+            def loss_fn(sp):
+                env = forward({**param_arrays, **sp}, feed_arrays)
+                return env[program._loss_id].astype(jnp.float32), env
+
+            (_, env), grads = jax.value_and_grad(loss_fn, has_aux=True)(sub)
+            sub_state = {n: opt_state[n] for n in train_names}
+            sub_state["__step__"] = opt_state["__step__"]
+            new_sub, new_sub_state = opt.functional_apply(sub, grads,
+                                                          sub_state, lr=lr)
+            new_p = {**param_arrays, **new_sub}
+            new_s = {**opt_state, **new_sub_state}
+            return new_p, new_s, [env[i] for i in fetch_ids]
+
+        return jax.jit(step)
 
 
 # re-exports for API-surface parity
