@@ -112,7 +112,7 @@ class SpmdTrainer:
     def __init__(self, layer, optimizer, loss_fn=None, mesh=None, dp_axis="dp",
                  sharding_stage=0, recompute=False, accumulate_steps=1,
                  extra_param_specs=None, metrics_fn=None, donate=True,
-                 amp_dtype=None, **extra_kwargs):
+                 amp_dtype=None, return_outputs=False, **extra_kwargs):
         self.layer = layer
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -123,6 +123,10 @@ class SpmdTrainer:
         self.accumulate_steps = accumulate_steps
         self.extra_param_specs = extra_param_specs or {}
         self.amp_dtype = amp_dtype
+        # hapi metric path: the jitted step also returns the network outputs
+        # (no second eager forward per batch); see JitGraphAdapter
+        self.return_outputs = return_outputs
+        self.last_outputs = None
         self.extra_kwargs = extra_kwargs
         # consumed meta-optimizer knobs (VERDICT r1 #2: every flag must change
         # the compiled program or raise)
@@ -134,6 +138,10 @@ class SpmdTrainer:
                 raise ValueError(
                     "localsgd holds per-rank param replicas and cannot compose "
                     "with sharding/gradient-merge/tensor-parallel specs")
+        if return_outputs and (self.localsgd_k or self._is_dgc()):
+            raise ValueError(
+                "return_outputs is not supported with localsgd/DGC steps "
+                "(their shard_map programs do not thread outputs)")
         self._compiled = None
         self.params = {n: p._data for n, p in layer.named_parameters() if getattr(p, "trainable", True)}
         self.frozen = {n: p._data for n, p in layer.named_parameters() if not getattr(p, "trainable", True)}
@@ -273,6 +281,7 @@ class SpmdTrainer:
             with tape.pause(), amp_ctx:
                 inputs = [Tensor(b) for b in batch[:-1]]
                 label = Tensor(batch[-1])
+                out = None
                 if self.loss_fn is not None:
                     out = layer(*inputs)
                     loss = self.loss_fn(out, label)
@@ -283,7 +292,13 @@ class SpmdTrainer:
                 else:
                     loss = layer(*inputs, label)
             new_buffers = {n: named_b[n]._data for n in buffers}
-            return loss._data if isinstance(loss, Tensor) else loss, new_buffers
+            out_raw = None
+            if self.return_outputs and out is not None:
+                out_raw = jax.tree_util.tree_map(
+                    lambda t: t._data if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+            return (loss._data if isinstance(loss, Tensor) else loss,
+                    new_buffers, out_raw)
         finally:
             for n, t in {**named_p, **named_b}.items():
                 t._data = saved[n]
@@ -330,10 +345,12 @@ class SpmdTrainer:
         fwd = self._wrapped_forward()
         accum = self.accumulate_steps
 
+        want_out = self.return_outputs
+
         def step(params, opt_state, buffers, lr, *batch):
             def loss_fn(p, b):
-                loss, new_buf = fwd(p, buffers, b)
-                return loss.astype(jnp.float32), new_buf
+                loss, new_buf, outs = fwd(p, buffers, b)
+                return loss.astype(jnp.float32), (new_buf, outs)
 
             if accum > 1:
                 # gradient merge (fleet/meta_optimizers/gradient_merge_optimizer.py):
@@ -342,18 +359,26 @@ class SpmdTrainer:
 
                 def body(carry, mb):
                     g_acc, l_acc = carry
-                    (loss, nb), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, mb)
+                    (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, mb)
                     g_acc = jax.tree_util.tree_map(lambda a, g: a + g, g_acc, grads)
-                    return (g_acc, l_acc + loss), nb
+                    return (g_acc, l_acc + loss), aux
 
                 g0 = jax.tree_util.tree_map(jnp.zeros_like, params)
-                (grads, loss_sum), new_buf_all = jax.lax.scan(body, (g0, jnp.zeros((), jnp.float32)), micro)
+                (grads, loss_sum), (new_buf_all, outs_all) = jax.lax.scan(
+                    body, (g0, jnp.zeros((), jnp.float32)), micro)
                 grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
                 loss = loss_sum / accum
                 new_buffers = jax.tree_util.tree_map(lambda v: v[-1], new_buf_all)
+                # outputs scanned [accum, mb, ...] -> full batch [accum*mb, ...]
+                outputs = (jax.tree_util.tree_map(
+                    lambda v: v.reshape((-1,) + v.shape[2:]), outs_all)
+                    if want_out else None)
             else:
-                (loss, new_buffers), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+                (loss, (new_buffers, outputs)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, batch)
             new_params, new_state = self.optimizer.functional_apply(params, grads, opt_state, lr=lr)
+            if want_out:
+                return loss, new_params, new_state, new_buffers, outputs
             return loss, new_params, new_state, new_buffers
 
         batch_shard = NamedSharding(mesh, P(ax))
@@ -370,6 +395,9 @@ class SpmdTrainer:
             dict(self.s_shardings),
             self.b_shardings,
         )
+        if want_out:
+            # outputs: per-example arrays, batch-sharded over dp (prefix spec)
+            out_shardings = out_shardings + (batch_shard,)
         return jax.jit(step, in_shardings=in_shardings, out_shardings=out_shardings,
                        donate_argnums=(0, 1))
 
@@ -404,7 +432,7 @@ class SpmdTrainer:
                       for n, v in state_r.items()}
 
                 def loss_fn(pp, b):
-                    loss, nb = fwd(pp, buffers, b)
+                    loss, nb, _ = fwd(pp, buffers, b)
                     return loss.astype(jnp.float32), nb
 
                 (loss, new_buf), grads = jax.value_and_grad(
@@ -463,7 +491,7 @@ class SpmdTrainer:
                       for n, v in state_r.items()}
 
                 def loss_fn(pp, b):
-                    loss, nb = fwd(pp, buffers, b)
+                    loss, nb, _ = fwd(pp, buffers, b)
                     return loss.astype(jnp.float32), nb
 
                 # differentiate against VARYING params: grads stay rank-local
@@ -516,9 +544,15 @@ class SpmdTrainer:
         if self._compiled is None:
             self._compiled = self._build(batch_arrays)
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
-        loss, self.params, self.opt_state, self.buffers = self._compiled(
-            self.params, self.opt_state, self.buffers, lr, *batch_arrays
-        )
+        if self.return_outputs:  # ctor rejects localsgd/dgc combinations
+            loss, self.params, self.opt_state, self.buffers, outs = self._compiled(
+                self.params, self.opt_state, self.buffers, lr, *batch_arrays
+            )
+            self.last_outputs = jax.tree_util.tree_map(Tensor, outs)
+        else:
+            loss, self.params, self.opt_state, self.buffers = self._compiled(
+                self.params, self.opt_state, self.buffers, lr, *batch_arrays
+            )
         self.optimizer._step_count += 1
         if isinstance(self.optimizer._lr, object) and hasattr(self.optimizer._lr, "step"):
             pass  # LR schedulers advance via user calls (paddle semantics)

@@ -102,19 +102,16 @@ class JitGraphAdapter(DynamicGraphAdapter):
                 outs = _to_list(out)
                 return self.model._loss(*(outs + [label]))
 
+            # return_outputs: the jitted step hands back the forward outputs,
+            # so metrics never trigger a second (eager) forward per batch
             self._trainer = SpmdTrainer(
                 self.model.network, self.model._optimizer, loss_fn,
+                return_outputs=bool(self.model._metrics),
             )
         loss = self._trainer.train_step(*(inputs + labels))
         metrics = []
         if self.model._metrics:
-            # metrics need outputs: run a forward (cheap, jitted by to_static cache)
-            self._trainer.sync_to_layer()
-            from ..core.tape import no_grad
-
-            with no_grad():
-                outputs = self.model.network(*inputs)
-            metrics = self._update_metrics(outputs, labels)
+            metrics = self._update_metrics(self._trainer.last_outputs, labels)
         return self._return(loss, metrics)
 
     def eval_batch(self, inputs, labels=None):
@@ -146,7 +143,12 @@ class Model:
 
     # -- setup -----------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
-        """hapi/model.py:1244 parity."""
+        """hapi/model.py:1244 parity. Re-preparing resets the compiled
+        trainer (reference semantics: prepare rebuilds the adapter programs),
+        so a metrics change re-compiles with the matching step signature."""
+        if isinstance(self._adapter, JitGraphAdapter) and self._adapter._trainer is not None:
+            self._adapter._trainer.sync_to_layer()
+            self._adapter._trainer = None
         self._optimizer = optimizer
         if loss is not None and not callable(loss):
             raise TypeError("loss must be callable (a Layer or function)")

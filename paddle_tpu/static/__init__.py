@@ -77,6 +77,7 @@ class Program:
         self.param_names = {}   # param name -> var id
         self._initial = {}      # param name -> np.ndarray (startup values)
         self._scope = {"params": None, "opt_state": None}
+        self._exec_cache = {}
         self._optimizer = None
         self._loss_id = None
         self._train_param_names = None  # None = all params the loss reaches
@@ -334,7 +335,6 @@ class Executor:
 
     def __init__(self, place=None):
         self.place = place
-        self._cache = {}
 
     def run(self, program=None, feed=None, fetch_list=None, return_numpy=True,
             scope=None):
@@ -360,8 +360,8 @@ class Executor:
     # -- internals -------------------------------------------------------------
     def _fetch_id(self, program, f):
         if isinstance(f, Tensor):
-            vid = id(f)
-            if vid in program.vars:
+            vid = program._resolve_var(f)  # handles re-wraps and in-place
+            if vid is not None:
                 return vid
             raise ValueError(f"fetch var {getattr(f, 'name', f)} is not part "
                              "of the program")
@@ -383,11 +383,14 @@ class Executor:
         feed_arrays = {k: jnp.asarray(np.asarray(v)) for k, v in feed.items()}
         sig = tuple(sorted((k, v.shape, str(v.dtype))
                            for k, v in feed_arrays.items()))
-        key = (id(program), program._version, train, fetch_ids, sig)
-        if key not in self._cache:
-            self._cache[key] = self._compile(program, tuple(feed_arrays),
-                                             fetch_ids, train)
-        compiled = self._cache[key]
+        # cache lives ON the program (not the executor) so dropped programs
+        # release their compiled closures and baked arrays with them
+        cache = program._exec_cache
+        key = (program._version, train, fetch_ids, sig)
+        if key not in cache:
+            cache[key] = self._compile(program, tuple(feed_arrays),
+                                       fetch_ids, train)
+        compiled = cache[key]
         scope = program._scope
         if train:
             opt = program._optimizer
@@ -423,16 +426,22 @@ class Executor:
                                  "placeholder of this program")
             bound.add(program.placeholders[name])
         bound |= set(program.params)
+        def _missing(vid, what):
+            for n, pvid in program.placeholders.items():
+                if pvid == vid:
+                    raise ValueError(f"placeholder '{n}' is required by the "
+                                     f"{what} but missing from feed")
+            raise ValueError(f"{what} references a var with no producer "
+                             "(was it built in a different program?)")
+
         for op in ops:
             for spec in op.arg_specs:
                 if spec[0] == "var" and spec[1] not in bound:
-                    missing = spec[1]
-                    for n, vid in program.placeholders.items():
-                        if vid == missing:
-                            raise ValueError(
-                                f"placeholder '{n}' is required by the "
-                                f"fetch_list but missing from feed")
+                    _missing(spec[1], "fetch_list")
             bound |= set(op.out_ids)
+        for fid in targets:
+            if fid is not None and fid not in bound:
+                _missing(fid, "fetch_list")
 
         ph = program.placeholders
         params_map = dict(program.params)
@@ -460,6 +469,7 @@ class Executor:
             return jax.jit(ev)
 
         opt = program._optimizer
+        loss_id = program._loss_id  # snapshot: closures must not pin program
         # update ONLY params the sliced loss graph actually uses (a second
         # model in the same program must not weight-decay toward zero), and
         # honor minimize(parameters=/no_grad_set=)
@@ -476,7 +486,7 @@ class Executor:
 
             def loss_fn(sp):
                 env = forward({**param_arrays, **sp}, feed_arrays)
-                return env[program._loss_id].astype(jnp.float32), env
+                return env[loss_id].astype(jnp.float32), env
 
             (_, env), grads = jax.value_and_grad(loss_fn, has_aux=True)(sub)
             sub_state = {n: opt_state[n] for n in train_names}

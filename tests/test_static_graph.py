@@ -230,3 +230,26 @@ class TestReviewFindings:
             out = paddle.static.nn.fc(h, size=2)
         (o,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
         assert o.shape == (2, 2)
+
+    def test_fetch_rewrapped_and_inplace_tensors(self):
+        """Executor fetch resolves via array identity (review r2b)."""
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data(name="x", shape=[None, 3], dtype="float32")
+            h = x * 3.0
+            rewrapped = paddle.Tensor(h)  # new object, same array
+        exe = paddle.static.Executor()
+        xs = np.ones((2, 3), np.float32)
+        (o,) = exe.run(main, feed={"x": xs}, fetch_list=[rewrapped])
+        np.testing.assert_allclose(o, xs * 3.0)
+
+    def test_unfed_placeholder_fetch_raises_cleanly(self):
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data(name="x", shape=[None, 3], dtype="float32")
+            y = paddle.static.data(name="y", shape=[None, 3], dtype="float32")
+            out = x + 0.0
+        exe = paddle.static.Executor()
+        xs = np.ones((2, 3), np.float32)
+        with pytest.raises(ValueError, match="placeholder 'y'"):
+            exe.run(main, feed={"x": xs}, fetch_list=[y])
