@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 
 __all__ = ["convert_ifelse", "convert_while_loop", "transform_function",
-           "Dy2StCarryError"]
+           "convert_logical_not", "convert_logical_and", "Dy2StCarryError"]
 
 
 class Dy2StCarryError(TypeError):
@@ -110,12 +110,19 @@ def convert_ifelse(pred, true_fn, false_fn, seed=()):
 def convert_while_loop(cond_fn, body_fn, carry):
     """Runtime dispatch for rewritten `while`: lax.while_loop when the
     condition is traced. Carried values become arrays (ints/floats included),
-    matching the reference's tensor-loop-var semantics."""
-    first = cond_fn(carry)
-    if not _is_traced(_raw(first)):
-        while cond_fn(carry):
-            carry = body_fn(carry)
-        return carry
+    matching the reference's tensor-loop-var semantics.
+
+    Traced-ness is re-checked EVERY host iteration, not just the first: a
+    lowered `while True: ... if tensor_pred: break` starts with a pure-host
+    condition (break flag False, test True) and only becomes traced once the
+    body computes the flag — the loop must switch to lax at that point."""
+    while True:
+        c = cond_fn(carry)
+        if _is_traced(_raw(c)):
+            break
+        if not c:
+            return carry
+        carry = body_fn(carry)
 
     raws, kinds = _to_carry(carry)
 
@@ -133,6 +140,23 @@ def convert_while_loop(cond_fn, body_fn, carry):
     except TypeError as e:
         raise Dy2StCarryError(f"while carry structure mismatch: {e}") from e
     return _from_carry(final, kinds)
+
+
+def convert_logical_not(x):
+    """Runtime `not` that stays traced for tensors (convert_operators.py
+    convert_logical_not parity)."""
+    r = _raw(x)
+    if _is_traced(r):
+        return jnp.logical_not(r)
+    return not r
+
+
+def convert_logical_and(a, b):
+    r_a, r_b = _raw(a), _raw(b)
+    if _is_traced(r_a) or _is_traced(r_b):
+        return jnp.logical_and(jnp.asarray(r_a).astype(bool),
+                               jnp.asarray(r_b).astype(bool))
+    return r_a and r_b
 
 
 # ---------------- AST rewrite -------------------------------------------------
@@ -281,21 +305,187 @@ def _annotate_bound_before(fdef):
     walk(fdef.body, bound, set(bound))
 
 
+class _LoopLowering(ast.NodeTransformer):
+    """Pass 1 (LoopTransformer parity, loop_transformer.py): desugar
+    `for i in range(...)` into while, and lower `if p: break/continue`
+    into flag-guarded form — pure python-semantics-preserving rewrites, so
+    pass 3 can treat every loop as a plain while. Unsupported loop shapes
+    are left untouched and reported via `skipped`."""
+
+    def __init__(self):
+        self.counter = 0
+        self.skipped = []  # (construct, lineno)
+
+    def _skip(self, node, construct):
+        self.skipped.append((construct, getattr(node, "lineno", 0)))
+        return node
+
+    # -- for-range desugaring --------------------------------------------------
+    def visit_For(self, node):
+        self.generic_visit(node)
+        # non-range/host iterations unroll fine under plain tracing — no
+        # warning; only range() shapes we ALMOST handled are worth reporting
+        if node.orelse:
+            return node
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords):
+            return node
+        if not isinstance(node.target, ast.Name):
+            return self._skip(node, "for-range with a tuple target")
+        a = ast.Constant(value=0)
+        s = ast.Constant(value=1)
+        if len(it.args) == 1:
+            b = it.args[0]
+        elif len(it.args) == 2:
+            a, b = it.args
+        elif len(it.args) == 3:
+            a, b, s = it.args
+            if not (isinstance(s, ast.Constant) and isinstance(s.value, int)):
+                return self._skip(node, "for-range with a non-literal step")
+        else:
+            return self._skip(node, "malformed range()")
+        step_neg = isinstance(s, ast.Constant) and isinstance(s.value, int) \
+            and s.value < 0
+        i = node.target.id
+        n = self.counter
+        self.counter += 1
+        # python range semantics: a hidden counter advances BEFORE the user
+        # body (continue-safe, body reassignment of `i` cannot derail the
+        # iteration, and after the loop `i` holds the last yielded value)
+        bname = f"__dy2st_bound_{n}"
+        cname = f"__dy2st_it_{n}"
+        cmp_op = ast.Gt() if step_neg else ast.Lt()
+        test = ast.Compare(left=ast.Name(id=cname, ctx=ast.Load()),
+                           ops=[cmp_op],
+                           comparators=[ast.Name(id=bname, ctx=ast.Load())])
+        body = [
+            ast.Assign(targets=[ast.Name(id=i, ctx=ast.Store())],
+                       value=ast.Name(id=cname, ctx=ast.Load())),
+            ast.AugAssign(target=ast.Name(id=cname, ctx=ast.Store()),
+                          op=ast.Add(), value=s),
+        ] + list(node.body)
+        while_node = ast.While(test=test, body=body, orelse=[])
+        lowered = self._lower_while(while_node)
+        out = [ast.Assign(targets=[ast.Name(id=bname, ctx=ast.Store())],
+                          value=b),
+               ast.Assign(targets=[ast.Name(id=cname, ctx=ast.Store())],
+                          value=a),
+               # pre-bind the loop var so it is carried out of a lax loop
+               # (post-loop reads see the last yielded value, like python);
+               # deviation: an empty range leaves it = start, not NameError
+               ast.Assign(targets=[ast.Name(id=i, ctx=ast.Store())],
+                          value=ast.Name(id=cname, ctx=ast.Load()))]
+        return out + (lowered if isinstance(lowered, list) else [lowered])
+
+    # -- break/continue lowering ----------------------------------------------
+    @staticmethod
+    def _is_exit_if(st):
+        return (isinstance(st, ast.If) and not st.orelse and len(st.body) == 1
+                and isinstance(st.body[0], (ast.Break, ast.Continue)))
+
+    def visit_While(self, node):
+        if not isinstance(node, ast.While):
+            return node
+        self.generic_visit(node)
+        return self._lower_while(node)
+
+    def _lower_while(self, node):
+        # children already visited (visit_While / visit_For both guarantee it)
+        if node.orelse:
+            return self._skip(node, "while-else")
+        if not _contains(node.body, (ast.Break, ast.Continue)):
+            return node
+        # supported shape: every break/continue is a lone `if p: break`
+        # at the TOP level of the loop body
+        exits = sum(1 for st in node.body if self._is_exit_if(st))
+        total = 0
+
+        def count(nodes):
+            nonlocal total
+            for st in nodes:
+                if isinstance(st, (ast.Break, ast.Continue)):
+                    total += 1
+                for child in ast.iter_child_nodes(st):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef, ast.ClassDef,
+                                          ast.While, ast.For)):
+                        continue  # other scope / inner loop owns its exits
+                    count([child])
+
+        count(node.body)
+        if exits != total:
+            # host-predicate loops run as plain python anyway — stay quiet
+            if _host_only_pred(node.test):
+                return node
+            return self._skip(node, "break/continue not of the form "
+                                    "'if <pred>: break' at loop-body top level")
+        n = self.counter
+        self.counter += 1
+        brk = f"__dy2st_brk_{n}"
+        has_break = False
+
+        def guard(flag, rest):
+            if not rest:
+                return []
+            return [ast.If(
+                test=ast.Call(func=ast.Name(id="__dy2st_not", ctx=ast.Load()),
+                              args=[ast.Name(id=flag, ctx=ast.Load())],
+                              keywords=[]),
+                body=rest, orelse=[])]
+
+        def lower(stmts, depth):
+            nonlocal has_break
+            out = []
+            for idx, st in enumerate(stmts):
+                if self._is_exit_if(st):
+                    is_brk = isinstance(st.body[0], ast.Break)
+                    flag = brk if is_brk else f"__dy2st_cont_{n}_{depth}_{idx}"
+                    if is_brk:
+                        has_break = True
+                    out.append(ast.Assign(
+                        targets=[ast.Name(id=flag, ctx=ast.Store())],
+                        value=st.test))
+                    out.extend(guard(flag, lower(stmts[idx + 1:], depth + 1)))
+                    return out
+                out.append(st)
+            return out
+
+        node.body = lower(list(node.body), 0)
+        pre = []
+        if has_break:
+            pre.append(ast.Assign(targets=[ast.Name(id=brk, ctx=ast.Store())],
+                                  value=ast.Constant(value=False)))
+            node.test = ast.Call(
+                func=ast.Name(id="__dy2st_and", ctx=ast.Load()),
+                args=[ast.Call(func=ast.Name(id="__dy2st_not", ctx=ast.Load()),
+                               args=[ast.Name(id=brk, ctx=ast.Load())],
+                               keywords=[]),
+                      node.test],
+                keywords=[])
+        return pre + [node] if pre else node
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self.counter = 0
         self.applied = 0
+        self.skipped = []  # (construct, lineno)
 
     def _names_tuple(self, names, ctx):
         return ast.Tuple(elts=[ast.Name(id=n, ctx=ctx()) for n in names],
                          ctx=ctx())
 
+    def _skip(self, node, construct):
+        self.skipped.append((construct, getattr(node, "lineno", 0)))
+        return node
+
     def visit_If(self, node):
         self.generic_visit(node)
-        if _contains(node.body + node.orelse, _BAD_IF):
-            return node
         if _host_only_pred(node.test):
             return node  # `x is None` / `self.training`-style flags: plain if
+        if _contains(node.body + node.orelse, _BAD_IF):
+            return self._skip(node, "if containing return/break/continue/yield")
         bound_before = getattr(node, "_bound_before", set())
         a_true = _assigned_names(node.body)
         a_false = _assigned_names(node.orelse)
@@ -305,8 +495,11 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         seed = sorted(assigned & bound_before)
         both = sorted((a_true & a_false) - set(seed))
         if set(seed) | set(both) != assigned:
-            return node  # a name assigned in only one branch with no prior
-                         # binding: the untaken branch could not return it
+            # a name assigned in only one branch with no prior binding: the
+            # untaken branch could not return it
+            return self._skip(
+                node, "if assigning a name in only one branch with no "
+                      "prior binding")
         names = seed + both
         i = self.counter
         self.counter += 1
@@ -341,7 +534,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
     def visit_While(self, node):
         self.generic_visit(node)
         if node.orelse or _contains(node.body, _BAD_LOOP):
-            return node
+            if _host_only_pred(node.test):
+                return node  # plain python loop: correct as-is, stay quiet
+            return self._skip(
+                node, "while with else or unlowered break/continue/return")
         bound_before = getattr(node, "_bound_before", set())
         maybound_before = getattr(node, "_maybound_before", set())
         assigned = _assigned_names(node.body)
@@ -351,7 +547,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         # dropped on the bound path — bail out, keep the python loop
         risky = (assigned & maybound_before) - bound_before
         if risky:
-            return node
+            return self._skip(
+                node, f"while writing conditionally-bound name(s) "
+                      f"{sorted(risky)}")
         # loop-local temporaries (never bound before the loop) stay local to
         # the body fn; the carry holds only pre-bound names
         names = sorted(assigned & bound_before)
@@ -443,9 +641,21 @@ def transform_function(fn):
         return fn, 0
     fdef.decorator_list = []  # decorators already applied to the original
 
+    lower = _LoopLowering()
+    lower.visit(tree)
+    ast.fix_missing_locations(tree)
     _annotate_bound_before(fdef)
     tr = _ControlFlowTransformer()
     tr.visit(tree)
+    skipped = {(c, ln) for c, ln in lower.skipped + tr.skipped}
+    if skipped:
+        import warnings
+
+        details = "; ".join(f"line {ln}: {c}" for c, ln in sorted(
+            skipped, key=lambda x: x[1]))
+        warnings.warn(
+            f"to_static({fn.__name__}): some control flow was not rewritten "
+            f"to lax ops and will fall back to plain tracing — {details}")
     if tr.applied == 0:
         try:
             fn.__dy2static_cache__ = (fn, 0)
@@ -457,6 +667,8 @@ def transform_function(fn):
     globs = dict(fn.__globals__)
     globs["__dy2st_ifelse"] = convert_ifelse
     globs["__dy2st_while"] = convert_while_loop
+    globs["__dy2st_not"] = convert_logical_not
+    globs["__dy2st_and"] = convert_logical_and
     code = compile(tree, filename=f"<dy2static:{fn.__name__}>", mode="exec")
     ns = {}
     exec(code, globs, ns)

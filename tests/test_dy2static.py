@@ -297,3 +297,156 @@ class TestASTTransform:
 
         m = M()
         assert m.forward is m.forward
+
+
+class TestLoopLowering:
+    """VERDICT r1 #10: for-range, break/continue, fallback diagnostics."""
+
+    def test_for_range_tensor_bound(self):
+        """for i in range(n) with a traced bound compiles to lax.while_loop."""
+        def f(n):
+            acc = paddle.to_tensor(0.0)
+            i0 = paddle.to_tensor(0.0)  # keeps acc float-kind stable
+            for i in range(n):
+                acc = acc + float(1.0) * (i0 + i)
+            return acc
+
+        new, cnt = transform_function(f)
+        assert cnt >= 1
+        out = new(paddle.to_tensor(np.int32(5)))
+        assert float(np.asarray(out._data)) == 10.0  # 0+1+2+3+4
+
+    def test_for_range_two_args_host_still_correct(self):
+        def f(x):
+            for i in range(2, 5):
+                x = x + i
+            return x
+
+        new, cnt = transform_function(f)
+        out = new(paddle.to_tensor(np.array([0.0], np.float32)))
+        assert float(np.asarray(out._data)[0]) == 9.0  # 2+3+4
+
+    def test_while_true_if_break(self):
+        """`while True: ... if p: break` lowers to a flag-gated lax loop."""
+        def f(x):
+            i = paddle.to_tensor(0.0)
+            while (i < paddle.to_tensor(100.0)):
+                x = x * 2.0
+                i = i + 1.0
+                if (x.sum() > paddle.to_tensor(50.0)):
+                    break
+            return x, i
+
+        new, cnt = transform_function(f)
+        assert cnt >= 1
+        x, i = new(paddle.to_tensor(np.array([1.0], np.float32)))
+        assert float(np.asarray(x._data)[0]) == 64.0  # first power of 2 > 50
+        assert float(np.asarray(i._data)) == 6.0
+
+    def test_if_continue(self):
+        """`if p: continue` guards the rest of the iteration."""
+        def f(x):
+            i = paddle.to_tensor(0.0)
+            acc = paddle.to_tensor(0.0)
+            while (i < paddle.to_tensor(6.0)):
+                i = i + 1.0
+                if (i % 2.0 < 1.0):
+                    continue
+                acc = acc + i
+            return acc
+
+        new, cnt = transform_function(f)
+        assert cnt >= 1
+        out = new(paddle.to_tensor(np.array([0.0], np.float32)))
+        assert float(np.asarray(out._data)) == 9.0  # 1+3+5
+
+    def test_fallback_warning_names_construct(self):
+        import warnings as _w
+
+        def f(x):
+            i = paddle.to_tensor(0.0)
+            while (i < x.sum()):
+                for unsupported in [1, 2]:
+                    if (x.sum() > paddle.to_tensor(0.0)):
+                        break  # nested break: unsupported shape
+                i = i + 1.0
+            return i
+
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            transform_function(f)
+        msgs = [str(r.message) for r in rec]
+        assert any("not rewritten" in m and "break" in m for m in msgs), msgs
+
+    def test_host_loops_stay_quiet(self):
+        import warnings as _w
+
+        def f(x, flag):
+            for item in [1, 2, 3]:
+                if flag:
+                    break
+                x = x + item
+            while flag:
+                break
+            return x
+
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            transform_function(f)
+        assert not [r for r in rec if "not rewritten" in str(r.message)], \
+            [str(r.message) for r in rec]
+
+    def test_for_range_with_continue_terminates(self):
+        """Review r2c #1: continue must not skip the loop-var increment."""
+        def f(x):
+            acc = paddle.to_tensor(0.0)
+            i0 = paddle.to_tensor(0.0)
+            for i in range(5):
+                if ((i0 + i) % 2.0 < 1.0):
+                    continue
+                acc = acc + (i0 + i)
+            return acc
+
+        new, cnt = transform_function(f)
+        out = new(paddle.to_tensor(np.array([0.0], np.float32)))
+        assert float(np.asarray(out._data)) == 4.0  # 1 + 3
+
+    def test_while_true_break_under_to_static(self):
+        """Review r2c #2: host-True first condition must still switch to lax
+        when the break flag becomes traced (no TracerBoolConversionError)."""
+        class M(paddle.nn.Layer):
+            def forward(self, x):
+                i = paddle.to_tensor(0.0)
+                while True:
+                    x = x * 2.0
+                    i = i + 1.0
+                    if (x.sum() > paddle.to_tensor(50.0)):
+                        break
+                return x
+
+        m = paddle.jit.to_static(M())
+        out = m(paddle.to_tensor(np.array([1.0], np.float32)))
+        assert float(np.asarray(out._data)[0]) == 64.0
+
+    def test_for_range_loop_var_python_semantics(self):
+        """Review r2c #3: after the loop the var holds the last yielded value
+        and body reassignment cannot derail the iteration count."""
+        def f(x):
+            for i in range(3):
+                x = x + 1.0
+            return x * 0.0 + i
+
+        new, cnt = transform_function(f)
+        out = new(paddle.to_tensor(np.array([0.0], np.float32)))
+        assert float(np.asarray(out._data)[0]) == 2.0
+
+        def g(x):
+            cnt2 = paddle.to_tensor(0.0)
+            for i in range(5):
+                i = 0  # must not make the loop infinite
+                cnt2 = cnt2 + 1.0
+            return cnt2
+
+        new_g, _ = transform_function(g)
+        out = new_g(paddle.to_tensor(np.array([0.0], np.float32)))
+        assert float(np.asarray(out._data)) == 5.0
