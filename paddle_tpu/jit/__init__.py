@@ -220,34 +220,85 @@ class TranslatedLayer(Layer):
 
 
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save parity: params pickle + callable closure.
+    """paddle.jit.save parity (fluid/dygraph/jit.py:160 + dygraph/io.py).
 
-    Serializes state_dict + an input-spec; the program itself is re-traced at load from
-    the pickled layer (cloudpickle via python pickling of the Layer object). For
-    deployment-grade export see static/io.py save_inference_model (StableHLO text).
-    """
+    Durable path: when an input spec is available (explicit `input_spec=` or
+    recorded on a @to_static forward), the program is exported via jax.export
+    (static/io.py) — params npz + serialized StableHLO artifact that
+    `jit.load` runs WITHOUT the original class definition. A pickled Layer is
+    written as a fallback only (shape-polymorphic re-trace path)."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     state = {n: np.asarray(t._data) for n, t in layer.state_dict().items()}
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(state, f, protocol=4)
-    import pickle as pkl
+
+    spec = input_spec
+    if spec is None and isinstance(getattr(layer, "forward", None),
+                                   StaticFunction):
+        spec = layer.forward._input_spec
+    if spec is not None:
+        from ..static.io import save_inference_model
+
+        class _Var:  # shape/dtype carrier for save_inference_model
+            def __init__(self, shape, dtype):
+                self.shape = tuple(shape)  # None dims -> symbolic export
+                self.dtype = dtype
+
+        feed_vars = [_Var(s.shape, getattr(s, "dtype", "float32"))
+                     for s in _to_spec_list(spec)]
+        try:
+            save_inference_model(path, feed_vars, None, layer=layer)
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                f"jit.save: durable export failed ({type(e).__name__}: {e}); "
+                "falling back to the pickled-Layer artifact only")
 
     try:
         with open(path + ".pdmodel", "wb") as f:
-            pkl.dump(layer, f, protocol=4)
+            pickle.dump(layer, f, protocol=4)
     except Exception:
-        # layer not picklable: save spec only
+        # layer not picklable: durable artifact above is the only program
         with open(path + ".pdmodel", "wb") as f:
-            pkl.dump(None, f)
+            pickle.dump(None, f)
+
+
+def _to_spec_list(spec):
+    specs = spec if isinstance(spec, (list, tuple)) else [spec]
+    out = []
+    for s in specs:
+        if isinstance(s, InputSpec):
+            out.append(s)
+        elif isinstance(s, Tensor):
+            out.append(InputSpec(s.shape, str(s.dtype)))
+        else:
+            out.append(InputSpec(tuple(s.shape), str(getattr(s, "dtype", "float32"))))
+    return out
 
 
 def load(path, **configs):
+    """jit.load parity: prefers the durable jax.export artifact — no python
+    class needed; falls back to the pickled Layer (requires the class)."""
+    if os.path.exists(path + ".pdmodel.jaxexport"):
+        from ..static.io import _load_exported
+
+        exported, params = _load_exported(path)
+
+        def program_fn(params_d, *args):
+            return exported.call({k: jnp.asarray(v)
+                                  for k, v in params_d.items()}, *args)
+
+        return TranslatedLayer(program_fn, params)
     with open(path + ".pdiparams", "rb") as f:
         state = pickle.load(f)
     with open(path + ".pdmodel", "rb") as f:
         layer = pickle.load(f)
     if layer is None:
-        raise RuntimeError("saved model is not loadable (layer was not picklable)")
+        raise RuntimeError(
+            "saved model is not loadable: no jax.export artifact and the "
+            "Layer was not picklable — re-save with input_spec= for a "
+            "durable export")
     layer.set_state_dict(state)
     return layer
 

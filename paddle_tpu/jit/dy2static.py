@@ -152,7 +152,14 @@ def convert_logical_not(x):
 
 
 def convert_logical_and(a, b):
-    r_a, r_b = _raw(a), _raw(b)
+    """Short-circuiting: `b` may be a thunk — it is only evaluated when `a`
+    is traced or host-truthy, preserving python `and` semantics (the lowered
+    loop test must not re-evaluate the original condition after a break flag
+    fires — e.g. an index probe that is only safe while in bounds)."""
+    r_a = _raw(a)
+    if not _is_traced(r_a) and not r_a:
+        return False
+    r_b = _raw(b() if callable(b) else b)
     if _is_traced(r_a) or _is_traced(r_b):
         return jnp.logical_and(jnp.asarray(r_a).astype(bool),
                                jnp.asarray(r_b).astype(bool))
@@ -385,8 +392,6 @@ class _LoopLowering(ast.NodeTransformer):
                 and isinstance(st.body[0], (ast.Break, ast.Continue)))
 
     def visit_While(self, node):
-        if not isinstance(node, ast.While):
-            return node
         self.generic_visit(node)
         return self._lower_while(node)
 
@@ -456,12 +461,15 @@ class _LoopLowering(ast.NodeTransformer):
         if has_break:
             pre.append(ast.Assign(targets=[ast.Name(id=brk, ctx=ast.Store())],
                                   value=ast.Constant(value=False)))
+            # original test passed as a THUNK: it must not re-evaluate once
+            # the break flag fired (convert_logical_and short-circuits)
+            test_thunk = ast.Lambda(args=_no_args(), body=node.test)
             node.test = ast.Call(
                 func=ast.Name(id="__dy2st_and", ctx=ast.Load()),
                 args=[ast.Call(func=ast.Name(id="__dy2st_not", ctx=ast.Load()),
                                args=[ast.Name(id=brk, ctx=ast.Load())],
                                keywords=[]),
-                      node.test],
+                      test_thunk],
                 keywords=[])
         return pre + [node] if pre else node
 

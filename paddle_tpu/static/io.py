@@ -41,28 +41,58 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, prog
             return jax.tree_util.tree_map(lambda v: v._data if isinstance(v, Tensor) else v, out,
                                           is_leaf=lambda v: isinstance(v, Tensor))
 
-        example = [jnp.zeros(tuple(v.shape), dtype=v.dtype) for v in feed_vars]
+        def _arg_structs(symbolic):
+            """None/-1 dims become export-time symbolic dims (batch-
+            polymorphic artifact); `symbolic=False` pins them to 1."""
+            structs, n_sym = [], 0
+            for v in feed_vars:
+                dims = []
+                for s in v.shape:
+                    if s is None or (isinstance(s, int) and s < 0):
+                        if symbolic:
+                            (d,) = jax.export.symbolic_shape(f"d{n_sym}")
+                            n_sym += 1
+                            dims.append(d)
+                        else:
+                            dims.append(1)
+                    else:
+                        dims.append(s)
+                structs.append(jax.ShapeDtypeStruct(tuple(dims), v.dtype))
+            return structs
+
         params_j = {k: jnp.asarray(v) for k, v in params.items()}
         jitted = jax.jit(pure)
-        lowered = jitted.lower(params_j, *example)
-        with open(path_prefix + ".pdmodel.stablehlo", "w") as f:
-            f.write(lowered.as_text())
-        # executable round-trip artifact (jax.export): the AOT predictor loads
-        # this without the original python Layer — the deployment-grade path.
-        # serialize fully before touching disk, write tmp + rename so a crash
-        # can never leave a truncated artifact the predictor would prefer
+        # executable round-trip artifact (jax.export): the AOT predictor and
+        # jit.load run this without the original python Layer — the
+        # deployment-grade path. serialize fully before touching disk, write
+        # tmp + rename so a crash can never leave a truncated artifact.
+        exported = None
         try:
-            blob = jax.export.export(jitted)(params_j, *example).serialize()
-        except Exception as e:
-            import warnings
+            exported = jax.export.export(jitted)(params_j,
+                                                 *_arg_structs(True))
+        except Exception as e_sym:
+            try:
+                exported = jax.export.export(jitted)(params_j,
+                                                     *_arg_structs(False))
+                import warnings
 
-            warnings.warn(f"jax.export serialization unavailable ({e}); "
-                          "saving StableHLO text + params only")
-        else:
+                warnings.warn(
+                    f"symbolic-batch export failed ({e_sym}); exported with "
+                    "dynamic dims pinned to 1 — loads serve that shape only")
+            except Exception as e:
+                import warnings
+
+                warnings.warn(f"jax.export serialization unavailable ({e}); "
+                              "saving StableHLO text + params only")
+        if exported is not None:
+            blob = exported.serialize()
             tmp = path_prefix + ".pdmodel.jaxexport.tmp"
             with open(tmp, "wb") as f:
                 f.write(blob)
             os.replace(tmp, path_prefix + ".pdmodel.jaxexport")
+        lowered = jitted.lower(params_j, *_arg_structs(False))
+        with open(path_prefix + ".pdmodel.stablehlo", "w") as f:
+            f.write(lowered.as_text())
         with open(path_prefix + ".pdmodel.meta", "wb") as f:
             pickle.dump({"feed_shapes": [tuple(v.shape) for v in feed_vars],
                          "feed_dtypes": [str(v.dtype) for v in feed_vars]}, f)
@@ -80,14 +110,22 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     return params, meta, hlo_text
 
 
+def _load_exported(path_prefix):
+    """Deserialize the jax.export artifact + params (shared by jit.load and
+    load_aot_predictor)."""
+    with open(path_prefix + ".pdmodel.jaxexport", "rb") as f:
+        exported = jax.export.deserialize(bytearray(f.read()))
+    data = np.load(path_prefix + ".pdiparams.npz")
+    params = {k: data[k] for k in data.files}
+    return exported, params
+
+
 def load_aot_predictor(path_prefix):
     """AOT predictor from the serialized jax.export artifact: a callable
     `fn(*inputs) -> outputs` bound to the saved params — no python Layer or
     re-trace needed (the AnalysisPredictor-on-saved-model analog)."""
-    with open(path_prefix + ".pdmodel.jaxexport", "rb") as f:
-        exported = jax.export.deserialize(bytearray(f.read()))
-    data = np.load(path_prefix + ".pdiparams.npz")
-    params = {k: jnp.asarray(data[k]) for k in data.files}
+    exported, raw = _load_exported(path_prefix)
+    params = {k: jnp.asarray(v) for k, v in raw.items()}
 
     def predict(*inputs):
         arrs = [a._data if isinstance(a, Tensor) else jnp.asarray(a)

@@ -450,3 +450,35 @@ class TestLoopLowering:
         new_g, _ = transform_function(g)
         out = new_g(paddle.to_tensor(np.array([0.0], np.float32)))
         assert float(np.asarray(out._data)) == 5.0
+
+    def test_break_short_circuits_loop_test(self):
+        """Review r2d: once the break flag fires, the original loop test must
+        not be re-evaluated (it may only be safe while in bounds)."""
+        def f(lst, x):
+            i = 0
+            while lst[i] > 0:
+                x = x + lst[i]
+                i = i + 1
+                if i >= len(lst):
+                    break
+            return x
+
+        new, cnt = transform_function(f)
+        out = new([1.0, 2.0, 3.0], paddle.to_tensor(np.array([0.0], np.float32)))
+        assert float(np.asarray(out._data)[0]) == 6.0
+
+    def test_dynamic_batch_jit_save_roundtrip(self, tmp_path):
+        """Review r2d: None batch dims export symbolically — the loaded
+        artifact serves ANY batch size."""
+        import paddle_tpu as p
+
+        net = p.nn.Sequential(p.nn.Linear(4, 3))
+        prefix = str(tmp_path / "dyn")
+        p.jit.save(net, prefix,
+                   input_spec=[p.jit.InputSpec([None, 4], "float32")])
+        loaded = p.jit.load(prefix)
+        for bs in (1, 2, 7):
+            x = p.to_tensor(np.ones((bs, 4), np.float32))
+            got = loaded(x)
+            np.testing.assert_allclose(np.asarray(got._data),
+                                       np.asarray(net(x)._data), rtol=1e-5)

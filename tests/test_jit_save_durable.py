@@ -1,0 +1,108 @@
+"""Durable jit.save/load (VERDICT r1 #7): the saved artifact must run
+without the original class definition — jax.export program + params
+(reference: fluid/dygraph/jit.py:160 save + dygraph/io.py TranslatedLayer).
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _make_unpicklable_net():
+    """A Layer class created in a throwaway namespace: pickle cannot find it,
+    so only the durable artifact can serve jit.load."""
+    ns = {}
+    exec(textwrap.dedent("""
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+
+        class Throwaway(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 3)
+
+            def forward(self, x):
+                return self.fc2(nn.functional.relu(self.fc1(x)))
+    """), ns)
+    return ns["Throwaway"]()
+
+
+class TestDurableJitSave:
+    def test_load_without_class(self, tmp_path):
+        paddle.seed(0)
+        net = _make_unpicklable_net()
+        net.eval()
+        x = paddle.to_tensor(np.random.RandomState(0).rand(2, 4).astype(np.float32))
+        want = net(x).numpy()
+
+        prefix = str(tmp_path / "durable")
+        paddle.jit.save(net, prefix,
+                        input_spec=[paddle.jit.InputSpec([2, 4], "float32")])
+        assert os.path.exists(prefix + ".pdmodel.jaxexport")
+
+        loaded = paddle.jit.load(prefix)
+        from paddle_tpu.jit import TranslatedLayer
+
+        assert isinstance(loaded, TranslatedLayer)
+        got = loaded(x)
+        np.testing.assert_allclose(np.asarray(got._data), want, rtol=1e-5)
+
+    def test_fresh_process_load(self, tmp_path):
+        """Save here; load + predict in a NEW python process that never sees
+        the class definition."""
+        paddle.seed(1)
+        net = _make_unpicklable_net()
+        net.eval()
+        x = np.random.RandomState(1).rand(2, 4).astype(np.float32)
+        want = net(paddle.to_tensor(x)).numpy()
+        prefix = str(tmp_path / "fresh")
+        paddle.jit.save(net, prefix,
+                        input_spec=[paddle.jit.InputSpec([2, 4], "float32")])
+        np.save(str(tmp_path / "x.npy"), x)
+        np.save(str(tmp_path / "want.npy"), want)
+
+        script = textwrap.dedent(f"""
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import paddle_tpu as paddle
+
+            x = np.load({str(tmp_path / 'x.npy')!r})
+            want = np.load({str(tmp_path / 'want.npy')!r})
+            loaded = paddle.jit.load({prefix!r})
+            got = loaded(paddle.to_tensor(x))
+            np.testing.assert_allclose(np.asarray(got._data), want, rtol=1e-5)
+            print("FRESH-PROCESS-OK")
+        """)
+        sp = str(tmp_path / "load_script.py")
+        with open(sp, "w") as f:
+            f.write(script)
+        env = dict(os.environ, PYTHONPATH=os.getcwd(), JAX_PLATFORMS="cpu")
+        res = subprocess.run([sys.executable, sp], capture_output=True,
+                             text=True, timeout=300, env=env)
+        assert "FRESH-PROCESS-OK" in res.stdout, res.stderr[-2000:]
+
+    def test_pickle_fallback_still_works(self, tmp_path):
+        """No input_spec + picklable layer: legacy re-trace path."""
+        net = nn.Sequential(nn.Linear(3, 2))
+        prefix = str(tmp_path / "legacy")
+        paddle.jit.save(net, prefix)
+        assert not os.path.exists(prefix + ".pdmodel.jaxexport")
+        loaded = paddle.jit.load(prefix)
+        x = paddle.to_tensor(np.ones((1, 3), np.float32))
+        np.testing.assert_allclose(np.asarray(loaded(x)._data),
+                                   np.asarray(net(x)._data), rtol=1e-6)
+
+    def test_unpicklable_without_spec_errors_helpfully(self, tmp_path):
+        net = _make_unpicklable_net()
+        prefix = str(tmp_path / "nospec")
+        paddle.jit.save(net, prefix)
+        with pytest.raises(RuntimeError, match="input_spec"):
+            paddle.jit.load(prefix)
