@@ -92,11 +92,12 @@ class Predictor:
         if path and os.path.exists(path + ".pdmodel"):
             with open(path + ".pdmodel", "rb") as f:
                 self._layer = pickle.load(f)
-            with open(path + ".pdiparams", "rb") as f:
-                state = pickle.load(f)
             if self._layer is None:
                 raise RuntimeError("saved model not loadable")
-            self._layer.set_state_dict(state)
+            if os.path.exists(path + ".pdiparams"):
+                with open(path + ".pdiparams", "rb") as f:
+                    self._layer.set_state_dict(pickle.load(f))
+            # else: the pickled layer already carries its weights
             self._layer.eval()
         elif self._aot is None:
             raise FileNotFoundError(f"no model at {path}.pdmodel")

@@ -228,14 +228,19 @@ def save(layer, path, input_spec=None, **configs):
     `jit.load` runs WITHOUT the original class definition. A pickled Layer is
     written as a fallback only (shape-polymorphic re-trace path)."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    state = {n: np.asarray(t._data) for n, t in layer.state_dict().items()}
-    with open(path + ".pdiparams", "wb") as f:
-        pickle.dump(state, f, protocol=4)
+    # a previous save's durable artifact must never shadow this save: load()
+    # prefers .pdmodel.jaxexport, so a stale one would serve the OLD model
+    for stale in (".pdmodel.jaxexport", ".pdiparams.npz"):
+        try:
+            os.remove(path + stale)
+        except FileNotFoundError:
+            pass
 
     spec = input_spec
     if spec is None and isinstance(getattr(layer, "forward", None),
                                    StaticFunction):
         spec = layer.forward._input_spec
+    exported = False
     if spec is not None:
         from ..static.io import save_inference_model
 
@@ -247,7 +252,8 @@ def save(layer, path, input_spec=None, **configs):
         feed_vars = [_Var(s.shape, getattr(s, "dtype", "float32"))
                      for s in _to_spec_list(spec)]
         try:
-            save_inference_model(path, feed_vars, None, layer=layer)
+            res = save_inference_model(path, feed_vars, None, layer=layer)
+            exported = bool(isinstance(res, dict) and res.get("exported"))
         except Exception as e:
             import warnings
 
@@ -255,6 +261,13 @@ def save(layer, path, input_spec=None, **configs):
                 f"jit.save: durable export failed ({type(e).__name__}: {e}); "
                 "falling back to the pickled-Layer artifact only")
 
+    if not exported:
+        # fallback path needs the params pickle; when the durable artifact
+        # was written the weights already live in .pdiparams.npz — don't
+        # serialize a multi-GB state twice
+        state = {n: np.asarray(t._data) for n, t in layer.state_dict().items()}
+        with open(path + ".pdiparams", "wb") as f:
+            pickle.dump(state, f, protocol=4)
     try:
         with open(path + ".pdmodel", "wb") as f:
             pickle.dump(layer, f, protocol=4)
@@ -290,8 +303,6 @@ def load(path, **configs):
                                   for k, v in params_d.items()}, *args)
 
         return TranslatedLayer(program_fn, params)
-    with open(path + ".pdiparams", "rb") as f:
-        state = pickle.load(f)
     with open(path + ".pdmodel", "rb") as f:
         layer = pickle.load(f)
     if layer is None:
@@ -299,7 +310,10 @@ def load(path, **configs):
             "saved model is not loadable: no jax.export artifact and the "
             "Layer was not picklable — re-save with input_spec= for a "
             "durable export")
-    layer.set_state_dict(state)
+    if os.path.exists(path + ".pdiparams"):
+        with open(path + ".pdiparams", "rb") as f:
+            layer.set_state_dict(pickle.load(f))
+    # else: the pickled layer already carries its weights
     return layer
 
 

@@ -43,18 +43,26 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, prog
 
         def _arg_structs(symbolic):
             """None/-1 dims become export-time symbolic dims (batch-
-            polymorphic artifact); `symbolic=False` pins them to 1."""
-            structs, n_sym = [], 0
+            polymorphic artifact); `symbolic=False` pins them to 1.
+
+            Leading (dim-0, batch) dynamic dims SHARE one symbol — models
+            that relate two inputs along batch (loss(input, label)) need the
+            equality constraint; other dynamic dims get distinct symbols."""
+            structs, n_sym, batch_sym = [], 0, None
             for v in feed_vars:
                 dims = []
-                for s in v.shape:
+                for pos, s in enumerate(v.shape):
                     if s is None or (isinstance(s, int) and s < 0):
-                        if symbolic:
+                        if not symbolic:
+                            dims.append(1)
+                        elif pos == 0:
+                            if batch_sym is None:
+                                (batch_sym,) = jax.export.symbolic_shape("b")
+                            dims.append(batch_sym)
+                        else:
                             (d,) = jax.export.symbolic_shape(f"d{n_sym}")
                             n_sym += 1
                             dims.append(d)
-                        else:
-                            dims.append(1)
                     else:
                         dims.append(s)
                 structs.append(jax.ShapeDtypeStruct(tuple(dims), v.dtype))
@@ -84,19 +92,31 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, prog
 
                 warnings.warn(f"jax.export serialization unavailable ({e}); "
                               "saving StableHLO text + params only")
+        wrote_artifact = False
         if exported is not None:
-            blob = exported.serialize()
-            tmp = path_prefix + ".pdmodel.jaxexport.tmp"
-            with open(tmp, "wb") as f:
-                f.write(blob)
-            os.replace(tmp, path_prefix + ".pdmodel.jaxexport")
-        lowered = jitted.lower(params_j, *_arg_structs(False))
+            try:
+                blob = exported.serialize()
+            except Exception as e:
+                import warnings
+
+                warnings.warn(f"jax.export serialization failed ({e}); "
+                              "saving StableHLO text + params only")
+            else:
+                tmp = path_prefix + ".pdmodel.jaxexport.tmp"
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, path_prefix + ".pdmodel.jaxexport")
+                wrote_artifact = True
+        if exported is not None:
+            hlo_text = str(exported.mlir_module())  # no second trace
+        else:
+            hlo_text = jitted.lower(params_j, *_arg_structs(False)).as_text()
         with open(path_prefix + ".pdmodel.stablehlo", "w") as f:
-            f.write(lowered.as_text())
+            f.write(hlo_text)
         with open(path_prefix + ".pdmodel.meta", "wb") as f:
             pickle.dump({"feed_shapes": [tuple(v.shape) for v in feed_vars],
                          "feed_dtypes": [str(v.dtype) for v in feed_vars]}, f)
-        return path_prefix
+        return {"path": path_prefix, "exported": wrote_artifact}
     raise NotImplementedError("save_inference_model requires layer= in the TPU build")
 
 

@@ -34,3 +34,8 @@ def seed():
     np.random.seed(0)
     paddle.seed(0)
     return 0
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process/subprocess tests (seconds-scale)")

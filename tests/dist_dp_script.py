@@ -1,0 +1,67 @@
+"""Worker script for the multi-process DP harness (test_dist_multiproc.py).
+
+Launched via fleetrun (python -m paddle_tpu.distributed.fleet.launch): each
+rank initializes jax.distributed over the PADDLE_TRAINER_* env protocol
+(CPU backend, 1 device per process), trains a small model data-parallel via
+SpmdTrainer over the GLOBAL mesh, and rank 0 writes the loss trajectory.
+
+Reference parity: the test_dist_base.py pattern — real localhost processes,
+loss parity asserted against a single-process run
+(python/paddle/fluid/tests/unittests/test_dist_base.py:671,934-942).
+"""
+import argparse
+import json
+import os
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--steps", type=int, default=5)
+    args = ap.parse_args()
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import env as denv
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+
+    denv.init_distributed()  # no-op for world=1; coordination service for >1
+    world = denv.get_world_size()
+    rank = denv.get_rank()
+    assert len(jax.devices()) == world, (len(jax.devices()), world)
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    rng = np.random.RandomState(0)
+    init = {k: (rng.randn(*v.shape) * 0.1).astype(np.float32)
+            for k, v in net.state_dict().items()}
+    net.set_state_dict(init)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    mesh = build_mesh((len(jax.devices()),), ("dp",))
+    trainer = SpmdTrainer(net, opt,
+                          lambda o, l: ((o - l) ** 2).mean(), mesh=mesh)
+
+    data_rng = np.random.RandomState(1)
+    x = data_rng.randn(32, 16).astype(np.float32)
+    y = data_rng.randn(32, 4).astype(np.float32)
+    losses = []
+    for _ in range(args.steps):
+        loss = trainer.train_step(paddle.to_tensor(x), paddle.to_tensor(y))
+        losses.append(float(np.asarray(loss._data)))
+
+    if rank == 0:
+        with open(args.out, "w") as f:
+            json.dump({"world": world, "losses": losses}, f)
+    print(f"rank {rank}/{world} done: {losses[-1]:.6f}")
+
+
+if __name__ == "__main__":
+    main()

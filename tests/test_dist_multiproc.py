@@ -1,0 +1,58 @@
+"""Multi-process distributed harness (VERDICT r1 #5): spawn REAL processes
+via fleetrun with jax.distributed.initialize on the CPU backend and assert
+DP loss parity against a single-process run — the TPU-native rebirth of
+test_dist_base.py's localhost-NCCL two-trainer comparison
+(/root/reference/python/paddle/fluid/tests/unittests/test_dist_base.py:671).
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_fleet(tmp_path, nproc, steps=5, timeout=420):
+    out = str(tmp_path / f"losses_{nproc}.json")
+    script = os.path.join(os.path.dirname(__file__), "dist_dp_script.py")
+    env = dict(
+        os.environ,
+        PYTHONPATH=os.getcwd(),
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        JAX_PLATFORMS="cpu",
+    )
+    env.pop("PADDLE_TRAINER_ID", None)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.fleet.launch",
+           "--nproc_per_node", str(nproc),
+           "--start_port", str(_free_port()),
+           script, "--out", out, "--steps", str(steps)]
+    res = subprocess.run(cmd, capture_output=True, text=True,
+                         timeout=timeout, env=env, cwd=os.getcwd())
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+    with open(out) as f:
+        return json.load(f)
+
+
+@pytest.mark.slow
+class TestMultiProcessDP:
+    def test_two_process_dp_matches_single(self, tmp_path):
+        """2 real processes (1 CPU device each, jax.distributed over the
+        PADDLE_TRAINER_* protocol) must produce the same DP loss trajectory
+        as a single process."""
+        two = _run_fleet(tmp_path, nproc=2)
+        one = _run_fleet(tmp_path, nproc=1)
+        assert two["world"] == 2 and one["world"] == 1
+        np.testing.assert_allclose(two["losses"], one["losses"],
+                                   rtol=1e-4, atol=1e-6)
+        # and training actually progressed
+        assert two["losses"][-1] < two["losses"][0]

@@ -106,3 +106,43 @@ class TestDurableJitSave:
         paddle.jit.save(net, prefix)
         with pytest.raises(RuntimeError, match="input_spec"):
             paddle.jit.load(prefix)
+
+    def test_resave_without_spec_serves_new_model(self, tmp_path):
+        """Review r2e: a stale jax.export artifact from a previous save must
+        not shadow a re-save without input_spec."""
+        paddle.seed(0)
+        v1 = nn.Sequential(nn.Linear(4, 3))
+        prefix = str(tmp_path / "resave")
+        paddle.jit.save(v1, prefix,
+                        input_spec=[paddle.jit.InputSpec([2, 4], "float32")])
+        paddle.seed(7)
+        v2 = nn.Sequential(nn.Linear(4, 3))
+        paddle.jit.save(v2, prefix)  # no spec: pickle-only save
+        assert not os.path.exists(prefix + ".pdmodel.jaxexport")
+        loaded = paddle.jit.load(prefix)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        np.testing.assert_allclose(np.asarray(loaded(x)._data),
+                                   np.asarray(v2(x)._data), rtol=1e-6)
+
+    def test_two_dynamic_batch_inputs_share_symbol(self, tmp_path):
+        """Review r2e: inputs related along batch need one shared symbol."""
+        class TwoIn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, a, b):
+                return self.fc(a) + b  # requires batch(a) == batch(b)
+
+        net = TwoIn()
+        prefix = str(tmp_path / "twoin")
+        paddle.jit.save(net, prefix, input_spec=[
+            paddle.jit.InputSpec([None, 4], "float32"),
+            paddle.jit.InputSpec([None, 4], "float32")])
+        assert os.path.exists(prefix + ".pdmodel.jaxexport")
+        loaded = paddle.jit.load(prefix)
+        for bs in (2, 5):
+            a = paddle.to_tensor(np.ones((bs, 4), np.float32))
+            got = loaded(a, a)
+            np.testing.assert_allclose(np.asarray(got._data),
+                                       np.asarray(net(a, a)._data), rtol=1e-5)
