@@ -103,6 +103,17 @@ class PsClient:
             return np.empty(0, np.int64), None
         return np.concatenate(all_ids), np.concatenate(all_deltas)
 
+    # -- global-shuffle exchange (data_set.cc GlobalShuffle routing) -----------
+    def shuffle_put(self, dst_worker, blob):
+        """Push a text blob of instances destined for `dst_worker`; spread
+        across servers by destination so exchange bandwidth scales."""
+        self._conns[dst_worker % len(self._conns)].call(
+            "shuffle_put", dst_worker, blob)
+
+    def shuffle_get(self, worker_id):
+        return self._conns[worker_id % len(self._conns)].call(
+            "shuffle_get", worker_id)
+
     # -- control ---------------------------------------------------------------
     def barrier(self):
         """Global worker barrier rendezvoused at server 0 (BarrierTable)."""

@@ -49,6 +49,11 @@ class PsServer:
         self._barrier = BarrierTable(self._worker_num)
         self._monitor = HeartBeatMonitor(self._worker_num)
         self._stop_requested = threading.Event()
+        # global-shuffle exchange buffers (data_set.cc Dataset::GlobalShuffle:
+        # instances route between workers THROUGH the servers): dst worker ->
+        # list of text blobs pushed by source workers
+        self._shuffle_buf = {}
+        self._shuffle_lock = threading.Lock()
         self._rpc = RpcServer(host, port, self._handle)
         self.endpoint = f"{host}:{self._rpc.port}"
 
@@ -80,6 +85,14 @@ class PsServer:
             return True
         if method == "list_tables":
             return sorted(self._tables)
+        if method == "shuffle_put":
+            dst, blob = args
+            with self._shuffle_lock:
+                self._shuffle_buf.setdefault(int(dst), []).append(blob)
+            return True
+        if method == "shuffle_get":
+            with self._shuffle_lock:
+                return self._shuffle_buf.pop(int(args[0]), [])
         if method == "create_table":
             kind, table_id, kw = args
             getattr(self, f"create_{kind}_table")(table_id, **kw)

@@ -120,9 +120,76 @@ class InMemoryDataset:
         lib = self._ensure_handle()
         lib.msp_shuffle(self._handle, seed)
 
-    def global_shuffle(self, fleet=None, thread_num=12):
-        # single-host: same as local (reference shuffles across PS ranks)
-        self.local_shuffle()
+    def _slots_with_offsets(self):
+        """(slots, n, per-slot instance offsets) — shared ragged layout of
+        batch_iter and _instance_lines."""
+        slots = self._slot_arrays()
+        n = self.get_memory_data_size()
+        offsets = [np.concatenate([[0], np.cumsum(lens)]) for _, lens in slots]
+        return slots, n, offsets
+
+    def _instance_lines(self):
+        """Serialize the in-memory instances back to MultiSlot text lines
+        (`<count> v v ...` per slot) — the exchange format of global_shuffle.
+        float32 values use numpy's shortest float32 repr (strtof round-trips
+        it bit-exactly; float() would widen to float64 and ~triple the
+        payload)."""
+        slots, n, offsets = self._slots_with_offsets()
+        lines = []
+        for inst in range(n):
+            parts = []
+            for (vals, lens), offs in zip(slots, offsets):
+                l = int(lens[inst])
+                vs = vals[offs[inst]:offs[inst] + l]
+                parts.append(str(l))
+                parts.extend(str(v) if vals.dtype == np.float32
+                             else str(int(v)) for v in vs)
+            lines.append(" ".join(parts))
+        return lines
+
+    def global_shuffle(self, fleet=None, thread_num=12, client=None,
+                       worker_id=None, worker_num=None, seed=0):
+        """Cross-worker instance exchange (data_set.cc Dataset::GlobalShuffle
+        parity): every instance is routed to a random worker THROUGH the PS
+        servers (shuffle_put/shuffle_get RPC), then locally shuffled. Must be
+        called on ALL workers (it rendezvouses at the worker barrier).
+
+        Single-process (no PS client / world 1): plain local shuffle."""
+        if client is None and fleet is not None:
+            runtime = getattr(fleet, "ps_runtime", None) or getattr(
+                getattr(fleet, "fleet", None), "ps_runtime", None)
+            client = getattr(runtime, "client", None)
+            if worker_id is None and hasattr(fleet, "worker_index"):
+                worker_id = fleet.worker_index()
+            if worker_num is None and hasattr(fleet, "worker_num"):
+                worker_num = fleet.worker_num()
+        if worker_id is None:
+            worker_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        if worker_num is None:
+            worker_num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        if client is None or worker_num <= 1:
+            self.local_shuffle(seed)
+            return
+        lines = self._instance_lines()
+        rng = np.random.RandomState(seed + 1000003 * worker_id)
+        dsts = rng.randint(0, worker_num, size=len(lines))
+        for dst in range(worker_num):
+            part = [lines[i] for i in np.flatnonzero(dsts == dst)]
+            client.shuffle_put(dst, "\n".join(part))
+        # a timed-out barrier means some worker's puts may be missing: getting
+        # now would silently drop (and later duplicate) instances — fail loud
+        if not client.barrier():
+            raise RuntimeError("global_shuffle: worker barrier timed out "
+                               "before the exchange completed")
+        blobs = client.shuffle_get(worker_id)
+        self.release_memory()
+        for blob in blobs:
+            if blob:
+                self.load_from_string(blob + "\n")
+        self.local_shuffle(seed + worker_id)
+        if not client.barrier():  # all gets done before buffers are reused
+            raise RuntimeError("global_shuffle: worker barrier timed out "
+                               "after the exchange")
 
     def get_memory_data_size(self, fleet=None):
         lib = self._ensure_handle()
@@ -154,9 +221,7 @@ class InMemoryDataset:
 
     def batch_iter(self, drop_last=False, return_mask=False):
         """Yield dicts {slot: padded [b, max_len] array (+ '<slot>_mask')}."""
-        slots = self._slot_arrays()
-        n = self.get_memory_data_size()
-        offsets = [np.concatenate([[0], np.cumsum(lens)]) for _, lens in slots]
+        slots, n, offsets = self._slots_with_offsets()
         bs = self._batch_size
         for b0 in range(0, n, bs):
             b1 = min(n, b0 + bs)

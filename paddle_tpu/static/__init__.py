@@ -357,6 +357,44 @@ class Executor:
             return []
         return self._run_program(program, feed, fetch_list or [], return_numpy)
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """fluid/executor.py train_from_dataset parity: drive the recorded
+        program from an InMemoryDataset/QueueDataset — slot names feed the
+        matching static.data placeholders batch by batch (the reference's
+        hogwild_worker.cc:195-211 DataFeed->Program loop).
+
+        Ragged slots pad per batch; a new pad width jit-compiles a new feed
+        signature (fixed-length slots compile exactly once)."""
+        if dataset is None:
+            raise ValueError("train_from_dataset requires dataset=")
+        program = program or default_main_program()
+        names = set(program.placeholders) if isinstance(program, Program) \
+            else None
+        last = None
+        for step, batch in enumerate(dataset.batch_iter()):
+            feed = {k: v for k, v in batch.items()
+                    if names is None or k in names}
+            last = self.run(program, feed=feed, fetch_list=fetch_list)
+            if debug and fetch_list and step % max(1, print_period) == 0:
+                info = fetch_info or [f"fetch{i}"
+                                      for i in range(len(fetch_list))]
+                vals = ", ".join(f"{n}={np.asarray(v).mean():.6f}"
+                                 for n, v in zip(info, last))
+                print(f"[train_from_dataset] step {step}: {vals}")
+        return last
+
+    def infer_from_dataset(self, program=None, dataset=None, **kwargs):
+        """Inference twin: NEVER runs the optimizer — a program that has one
+        attached is evaluated through its for_test clone (is_infer=True
+        semantics; the reference skips gradient push on this path)."""
+        program = program or default_main_program()
+        if isinstance(program, Program) and program._optimizer is not None:
+            program = program.clone(for_test=True)
+        return self.train_from_dataset(program=program, dataset=dataset,
+                                       **kwargs)
+
     # -- internals -------------------------------------------------------------
     def _fetch_id(self, program, f):
         if isinstance(f, Tensor):

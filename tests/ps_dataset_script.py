@@ -1,0 +1,106 @@
+"""Driver for test_ps_dataset: 2 servers + 2 workers; each worker loads ITS
+OWN MultiSlot file, global-shuffles THROUGH the PS servers, then trains a
+sparse-embedding model from the dataset (data_set.cc GlobalShuffle +
+hogwild_worker.cc train-from-dataset loop parity)."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.distributed_strategy import DistributedStrategy
+from paddle_tpu.distributed.fleet.role_maker import PaddleCloudRoleMaker
+from paddle_tpu.io.multislot import InMemoryDataset
+
+
+def _write_slot_file(path, worker_id, n=32):
+    """ids slot (int64, ragged) + src slot (float: which worker wrote it) +
+    label slot (float)."""
+    rng = np.random.RandomState(worker_id)
+    lines = []
+    for i in range(n):
+        n_ids = rng.randint(1, 4)
+        ids = rng.randint(0, 50, n_ids)
+        label = float((ids.sum() % 2))
+        lines.append(f"{n_ids} " + " ".join(map(str, ids))
+                     + f" 1 {float(worker_id)} 1 {label}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def main():
+    workdir = os.environ["PS_DATASET_DIR"]
+    strategy = DistributedStrategy()
+    strategy.a_sync = False
+    fleet.init(role_maker=PaddleCloudRoleMaker(is_collective=False),
+               is_collective=False, strategy=strategy)
+    if fleet.is_server():
+        fleet.init_server()
+        fleet.run_server()
+        return
+
+    fleet.init_worker()
+    client = fleet.ps_runtime.client
+    wid = fleet.worker_index()
+    wnum = fleet.worker_num()
+
+    # each worker owns a disjoint file: global shuffle must MIX the sources
+    my_file = os.path.join(workdir, f"slots.part-{wid}")
+    _write_slot_file(my_file, wid)
+    ds = InMemoryDataset()
+    ds.add_slot("ids", "int64")
+    ds.add_slot("src", "float32")
+    ds.add_slot("label", "float32")
+    ds.set_batch_size(8)
+    ds.set_filelist([my_file])
+    n_local = ds.load_into_memory()
+    assert n_local == 32, n_local
+
+    ds.global_shuffle(client=client, worker_id=wid, worker_num=wnum, seed=7)
+    n_after = ds.get_memory_data_size()
+    srcs = set()
+    for batch in ds.batch_iter():
+        srcs |= set(np.asarray(batch["src"]).ravel().tolist())
+    assert srcs == {0.0, 1.0}, f"worker {wid} sees only sources {srcs}"
+    print(f"GLOBAL_SHUFFLE_OK worker={wid} n_after={n_after}")
+
+    # train a sparse-embedding model from the shuffled dataset via PS tables
+    from paddle_tpu.distributed.ps.runtime import PsEmbedding
+    from paddle_tpu.distributed.fleet.meta_optimizers import PsDenseOptimizer
+
+    paddle.seed(0)
+    emb = PsEmbedding(table_id=100, embedding_dim=8, client=client)
+    head = paddle.nn.Linear(8, 1)
+    opt = PsDenseOptimizer(head.parameters(), client, optimizer="sgd", lr=0.2)
+    first = last = None
+    for epoch in range(6):
+        for batch in ds.batch_iter(return_mask=True):
+            ids = paddle.to_tensor(batch["ids"])
+            mask = paddle.to_tensor(batch["ids_mask"])
+            label = paddle.to_tensor(batch["label"])
+            e = emb(ids)  # [b, L, d]
+            pooled = (e * mask.unsqueeze(-1)).sum(axis=1) / mask.sum(
+                axis=1, keepdim=True)
+            pred = head(pooled)
+            loss = paddle.mean((pred - label) ** 2)
+            loss.backward()
+            opt.step()
+            emb.push_step()
+            opt.clear_grad()
+            v = float(np.asarray(loss._data))
+            first = v if first is None else first
+            last = v
+    assert last < first, (first, last)
+    print(f"PS_DATASET_OK worker={wid} first={first:.4f} last={last:.4f}")
+    fleet.stop_worker()
+
+
+if __name__ == "__main__":
+    main()
