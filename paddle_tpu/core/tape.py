@@ -25,10 +25,17 @@ class Node:
         self.alive = True
 
 
+# nodes held without a backward() call before a one-time leak warning fires:
+# forward-only loops over requires-grad tensors (RL rollouts, eval phases
+# without no_grad) otherwise grow the tape unboundedly and silently
+_LEAK_WARN_THRESHOLD = 100_000
+
+
 class Tape:
     def __init__(self):
         self.nodes = []
         self._paused = 0
+        self._leak_warned = False
 
     @property
     def enabled(self):
@@ -36,9 +43,21 @@ class Tape:
 
     def record(self, node):
         self.nodes.append(node)
+        if (not self._leak_warned
+                and len(self.nodes) >= _LEAK_WARN_THRESHOLD):
+            import warnings
+
+            self._leak_warned = True
+            warnings.warn(
+                f"autograd tape holds {len(self.nodes)} nodes with no "
+                "backward() — a forward-only loop over tensors with "
+                "stop_gradient=False leaks memory; wrap inference in "
+                "paddle.no_grad() or call tensor.backward()/tape.clear()",
+                ResourceWarning)
 
     def clear(self):
         self.nodes.clear()
+        self._leak_warned = False
 
     @contextlib.contextmanager
     def pause(self):

@@ -135,15 +135,20 @@ class InMemoryDataset:
         it bit-exactly; float() would widen to float64 and ~triple the
         payload)."""
         slots, n, offsets = self._slots_with_offsets()
+        # vectorized formatting: %.9g round-trips float32 exactly through
+        # strtof; per-value python str() would make the PS-scale exchange
+        # O(total values) in interpreted code
+        slot_strs = []
+        for vals, _ in slots:
+            fmt = "%.9g" if vals.dtype == np.float32 else "%d"
+            slot_strs.append(np.char.mod(fmt, vals))
         lines = []
         for inst in range(n):
             parts = []
-            for (vals, lens), offs in zip(slots, offsets):
+            for (vals, lens), offs, strs in zip(slots, offsets, slot_strs):
                 l = int(lens[inst])
-                vs = vals[offs[inst]:offs[inst] + l]
                 parts.append(str(l))
-                parts.extend(str(v) if vals.dtype == np.float32
-                             else str(int(v)) for v in vs)
+                parts.extend(strs[offs[inst]:offs[inst] + l])
             lines.append(" ".join(parts))
         return lines
 

@@ -10,7 +10,7 @@ from .random import *  # noqa: F401,F403
 from .linalg import (  # noqa: F401
     norm, dist, cond, t, cross, cholesky, cholesky_solve, matrix_power, matrix_rank,
     det, slogdet, inv, pinv, solve, triangular_solve, lstsq, svd, qr, eig, eigh,
-    eigvals, eigvalsh, lu, multi_dot, householder_product,
+    eigvals, eigvalsh, lu, multi_dot, householder_product, cdist,
 )
 from .attribute import shape, rank, is_floating_point, is_integer, is_complex  # noqa: F401
 from . import math_patch  # noqa: F401  (installs operator overloads)

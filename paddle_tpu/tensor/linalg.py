@@ -207,3 +207,18 @@ def householder_product(x, tau, name=None):
         return q
 
     return apply(fn, _t(x), _t(tau))
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    """Parity: paddle.cdist — pairwise p-norm distance [.., M, N]."""
+    def fn(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 0.0:  # hamming-style count of differing components
+            return jnp.sum((diff != 0).astype(a.dtype), axis=-1)
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 0.0)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(diff), axis=-1)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+    return apply(fn, _t(x), _t(y))

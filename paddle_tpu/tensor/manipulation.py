@@ -406,3 +406,18 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
         return jnp.where(in_shard, v % shard_size, ignore_value)
 
     return apply(fn, _t(input))
+
+
+def unbind(input, axis=0, name=None):
+    """Parity: paddle.unbind — split along `axis` into axis-size tensors.
+    ONE multi-output op (single tape node/vjp), not N slices."""
+    x = _t(input)
+    n = x.shape[axis]
+    return apply(
+        lambda v: tuple(jnp.moveaxis(v, axis, 0)[i] for i in range(n)), x)
+
+
+def cast(x, dtype):
+    """Parity: paddle.cast (cast_op.cc) — delegates to Tensor.astype (same
+    dispatch + autograd path)."""
+    return _t(x).astype(dtype)

@@ -463,3 +463,71 @@ def renorm(x, p, axis, max_norm, name=None):
         return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
 
     return apply(fn, x)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """Parity: operators/cum_op (logcumsumexp) — running log-sum-exp."""
+    d = dtype_mod.convert_dtype(dtype)
+
+    def fn(v):
+        vv = v.reshape(-1) if axis is None else v
+        if d is not None:
+            vv = vv.astype(d)  # reference casts BEFORE the scan: accumulation
+            # runs in the requested precision, not the input's
+        a = 0 if axis is None else int(axis)
+        return jax.lax.associative_scan(jnp.logaddexp, vv, axis=a)
+
+    return apply(fn, _t(x))
+
+
+def sgn(x, name=None):
+    """Parity: paddle.sgn — sign for real, unit phasor for complex."""
+    def fn(v):
+        if jnp.iscomplexobj(v):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0.0 + 0.0j, v / jnp.where(mag == 0, 1.0, mag))
+        return jnp.sign(v)
+
+    return apply(fn, _t(x))
+
+
+def frexp(x, name=None):
+    return apply(lambda v: tuple(jnp.frexp(v)), _t(x))
+
+
+def ldexp(x, y, name=None):
+    return apply(lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), _t(x), _t(y))
+
+
+def copysign(x, y, name=None):
+    y = _t(y) if hasattr(y, "ndim") or isinstance(y, (list, tuple)) else y
+    if isinstance(y, (int, float)):
+        return apply(lambda a: jnp.copysign(a, y), _t(x))
+    return apply(jnp.copysign, _t(x), y)
+
+
+def nextafter(x, y, name=None):
+    # not differentiable (no JVP rule); zero-grad like the reference op
+    return apply(lambda a, b: jnp.nextafter(jax.lax.stop_gradient(a),
+                                            jax.lax.stop_gradient(b)),
+                 _t(x), _t(y))
+
+
+def i0(x, name=None):
+    return apply(lambda v: jax.scipy.special.i0(v), _t(x))
+
+
+def polygamma(x, n, name=None):
+    return apply(lambda v: jax.scipy.special.polygamma(int(n), v), _t(x))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """Parity: paddle.vander (Vandermonde matrix)."""
+    def fn(v):
+        cols = v.shape[0] if n is None else int(n)
+        p = jnp.arange(cols)
+        if not increasing:
+            p = p[::-1]
+        return v[:, None] ** p[None, :]
+
+    return apply(fn, _t(x))

