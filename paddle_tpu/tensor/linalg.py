@@ -216,7 +216,10 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=
         if p == 0.0:  # hamming-style count of differing components
             return jnp.sum((diff != 0).astype(a.dtype), axis=-1)
         if p == 2.0:
-            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 0.0)
+            d2 = jnp.sum(diff * diff, axis=-1)
+            # masked sqrt: sqrt'(0)=inf would NaN the gradient of every
+            # zero-distance pair (cdist(x, x)'s whole diagonal)
+            return jnp.where(d2 == 0, 0.0, jnp.sqrt(jnp.where(d2 == 0, 1.0, d2)))
         if p == float("inf"):
             return jnp.max(jnp.abs(diff), axis=-1)
         return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)

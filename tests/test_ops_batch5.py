@@ -224,13 +224,26 @@ class TestScatterRef(OpTest):
 
 class TestSequencePadUnpadRoundtrip:
     def test_roundtrip(self):
-        flat = paddle.to_tensor(np.arange(6, dtype=np.float32))
-        # F.sequence_pad over ragged lengths [2, 1, 3]
-        lens = paddle.to_tensor(np.array([2, 1, 3], np.int64))
-        padded = F.sequence_pad(flat, 0.0, maxlen=3, length=lens) \
-            if "length" in F.sequence_pad.__code__.co_varnames else None
-        if padded is None:
-            pytest.skip("sequence_pad signature differs")
+        """sequence_pad: ragged list -> dense [b, maxlen] + lengths;
+        sequence_unpad inverts it exactly (sequence_pad_op.cc parity)."""
+        seqs = [np.array([1.0, 2.0], np.float32),
+                np.array([3.0], np.float32),
+                np.array([4.0, 5.0, 6.0], np.float32)]
+        padded, lens = F.sequence_pad([paddle.to_tensor(s) for s in seqs],
+                                      0.0)
+        np.testing.assert_array_equal(np.asarray(lens._data), [2, 1, 3])
+        want = np.array([[1, 2, 0], [3, 0, 0], [4, 5, 6]], np.float32)
+        np.testing.assert_allclose(np.asarray(padded._data), want)
+        back = F.sequence_unpad(padded, lens)
+        for s, b in zip(seqs, back):
+            np.testing.assert_allclose(np.asarray(b._data), s)
+
+    def test_maxlen_truncates(self):
+        seqs = [np.array([1.0, 2.0, 3.0], np.float32)]
+        padded, lens = F.sequence_pad([paddle.to_tensor(s) for s in seqs],
+                                      -1.0, maxlen=2)
+        np.testing.assert_allclose(np.asarray(padded._data), [[1.0, 2.0]])
+        np.testing.assert_array_equal(np.asarray(lens._data), [2])
 
 
 class TestAccuracyValue(OpTest):
@@ -269,3 +282,15 @@ class TestPutAlongAxis(OpTest):
 
     def test(self):
         self.check_output()
+
+
+class TestCdistSelfGrad(OpTest):
+    """Review r2g: cdist(x, x)'s zero diagonal must not NaN the gradient."""
+
+    def test(self):
+        x = paddle.to_tensor(_randn(4, 3))
+        x.stop_gradient = False
+        d = paddle.cdist(x, x)
+        d.sum().backward()
+        g = np.asarray(x.grad._data)
+        assert np.all(np.isfinite(g)), g

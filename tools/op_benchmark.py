@@ -132,7 +132,7 @@ def compare(base_path, new_path, tol):
         elif ratio < 1 - tol:
             flag = "+"  # improvement
         print(f"{flag} {name:24s} {b['mean_us']:10.2f} -> {n['mean_us']:10.2f}"
-              f" us  ({ratio:+.1%})", file=sys.stderr)
+              f" us  ({ratio - 1:+.1%})", file=sys.stderr)
     if regressions:
         print(json.dumps({"status": "FAIL", "regressions": [
             {"op": n, "slowdown": round(r, 3)} for n, r in regressions]}))
