@@ -8,6 +8,19 @@ See SURVEY.md for the structural analysis of the reference this targets.
 """
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("PADDLE_TPU_FORCE_CPU"):
+    # escape hatch for embedded/headless hosts where a sitecustomize pins the
+    # platform before user code can call jax.config.update (e.g. the C-ABI
+    # predictor host): honor the env var at first import
+    import jax as _jax
+
+    try:
+        _jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
 from . import flags as _flags_mod  # noqa: F401
 from .core import dtype as _dtype
 

@@ -77,3 +77,91 @@ class TestCAPI:
         h = lib.PD_CreatePredictor(b"/nonexistent/model")
         assert not h
         assert b"load" in lib.PD_GetLastError()
+
+
+class TestStandaloneCHost:
+    """A REAL C host binary (gcc + libpython embed) drives the C ABI from a
+    non-Python process — exercising PD_Init's GIL release (ADVICE r1 medium:
+    PyEval_SaveThread) and a worker-thread call path like the Go client's
+    goroutine migration."""
+
+    C_SRC = r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <pthread.h>
+
+extern int PD_Init(void);
+extern void* PD_CreatePredictor(const char*);
+extern long long PD_PredictorRunFloat(void*, const float*, const long long*,
+                                      int, float*, long long,
+                                      long long*, int, int*);
+extern void PD_DestroyPredictor(void*);
+extern const char* PD_GetLastError(void);
+
+static const char* g_prefix;
+static int g_ok = 0;
+
+static void* worker(void* arg) {
+    /* a DIFFERENT OS thread than the one that ran PD_Init: deadlocks
+       unless PD_Init released the GIL */
+    void* p = PD_CreatePredictor(g_prefix);
+    if (!p) { fprintf(stderr, "create: %s\n", PD_GetLastError()); return 0; }
+    float in[8]; long long shape[2] = {2, 4};
+    for (int i = 0; i < 8; ++i) in[i] = 1.0f;
+    float out[64]; long long out_shape[8]; int out_ndim = 0;
+    long long n = PD_PredictorRunFloat(p, in, shape, 2, out, 64,
+                                       out_shape, 8, &out_ndim);
+    if (n <= 0) { fprintf(stderr, "run: %s\n", PD_GetLastError()); return 0; }
+    PD_DestroyPredictor(p);
+    g_ok = 1;
+    printf("C_HOST_OK n=%lld first=%f\n", n, out[0]);
+    return 0;
+}
+
+int main(int argc, char** argv) {
+    g_prefix = argv[1];
+    if (PD_Init() != 0) { fprintf(stderr, "init failed\n"); return 1; }
+    pthread_t t;
+    pthread_create(&t, 0, worker, 0);
+    pthread_join(t, 0);
+    return g_ok ? 0 : 2;
+}
+'''
+
+    def test_c_host_binary(self, tmp_path):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+        net.eval()
+        prefix = str(tmp_path / "chost_model")
+        paddle.jit.save(net, prefix,
+                        input_spec=[paddle.jit.InputSpec([2, 4], "float32")])
+
+        so = _build()
+        csrc = str(tmp_path / "host.c")
+        with open(csrc, "w") as f:
+            f.write(self.C_SRC)
+        exe = str(tmp_path / "host")
+        # embed the SAME interpreter that runs pytest (a PATH python3-config
+        # could belong to a different python whose site-packages lack jax)
+        import sysconfig
+
+        ver = sysconfig.get_config_var("VERSION")
+        libdir = sysconfig.get_config_var("LIBDIR")
+        ldflags = [f"-L{libdir}", f"-lpython{ver}", "-ldl", "-lm"]
+        subprocess.run(
+            ["gcc", "-O1", csrc, "-o", exe, so, *ldflags, "-lpthread",
+             f"-Wl,-rpath,{os.path.dirname(so)}", f"-Wl,-rpath,{libdir}"],
+            check=True, capture_output=True, text=True)
+        repo_root = os.path.dirname(os.path.dirname(paddle.__file__))
+        pythonpath = repo_root + (
+            os.pathsep + os.environ["PYTHONPATH"]
+            if os.environ.get("PYTHONPATH") else "")
+        # the embedded interpreter runs no conftest: PADDLE_TPU_FORCE_CPU
+        # makes the package itself pin the CPU backend at import
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PADDLE_TPU_FORCE_CPU="1", PYTHONPATH=pythonpath)
+        res = subprocess.run([exe, prefix], capture_output=True, text=True,
+                             timeout=300, env=env)
+        assert res.returncode == 0, (res.stdout, res.stderr[-1500:])
+        assert "C_HOST_OK" in res.stdout, res.stdout
