@@ -1,14 +1,21 @@
 """Benchmark: GPT-2 small causal-LM training throughput on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} (+ mfu).
 
 Metric: tokens/sec/chip for a full jitted train step (fwd+bwd+AdamW) in bfloat16
 matmuls — the BASELINE.md north-star family (ERNIE/BERT-class tokens/sec/chip).
 vs_baseline: ratio against the reference-class target of 10_000 tokens/sec/device
 (0.6 × a ~16.6k tok/s A100+NCCL BERT-base-class figure — BASELINE.json's ≥60% goal),
 since the reference repo publishes no absolute numbers (BASELINE.md: "published: {}").
+
+The recorded number for a round lives in BENCH_r{N}.json (written by the driver);
+that file is the single source of truth — sweep locally with --sweep.
+
+Usage: python bench.py [--batch B] [--seq S] [--steps N] [--sweep]
 """
+import argparse
 import json
+import sys
 import time
 
 import numpy as np
@@ -16,7 +23,15 @@ import numpy as np
 BASELINE_TOKENS_PER_SEC = 10_000.0
 
 
-def main():
+def _model_flops_per_token(cfg):
+    """Approximate training FLOPs/token (fwd+bwd ~= 6*N params + attention)."""
+    h, L, s, v = cfg.hidden_size, cfg.num_layers, cfg.max_seq_len, cfg.vocab_size
+    n_params = v * h + L * (12 * h * h) + h * v  # emb + blocks + head (tied-ish)
+    attn = L * 12 * s * h  # 2 matmuls of [s,h]x[h,s] per layer, fwd+bwd
+    return 6 * n_params + attn
+
+
+def run_config(batch, seq, steps, quiet=False):
     import jax
 
     import paddle_tpu as paddle
@@ -25,46 +40,98 @@ def main():
     from paddle_tpu.models import GPTConfig, GPTForCausalLM, GPTPretrainLoss
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-    # batch 16 is the single-chip sweet spot (measured 74.9k tok/s vs 53.8k at
-    # batch 8; batch 32 exceeds 16G HBM for GPT-2 small at seq 1024)
-    batch, seq = (16, 1024) if on_tpu else (2, 128)
+    if not on_tpu:  # keep the CPU fallback tractable
+        cfg = GPTConfig(vocab_size=8192, hidden_size=256, num_layers=4,
+                        num_heads=8, max_seq_len=seq, dropout=0.0)
+        steps = min(steps, 3)
+    else:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=seq, dropout=0.0)
 
     paddle.seed(0)
-    cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
-                    max_seq_len=seq, dropout=0.0)
-    if not on_tpu:  # keep the CPU fallback tractable
-        cfg = GPTConfig(vocab_size=8192, hidden_size=256, num_layers=4, num_heads=8,
-                        max_seq_len=seq, dropout=0.0)
     model = GPTForCausalLM(cfg)
     loss_layer = GPTPretrainLoss()
-    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
-
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
     mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
     trainer = SpmdTrainer(model, opt, loss_fn=loss_layer, mesh=mesh)
 
     rng = np.random.RandomState(0)
-    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
-    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
 
     with paddle.amp.auto_cast(True, dtype="bfloat16"):
-        # warmup + compile (host-copy forces real completion through the device tunnel)
+        # warmup + compile (host-copy forces completion through the tunnel)
         np.asarray(trainer.train_step(ids, labels)._data)
-        n_steps = 20 if on_tpu else 3
         t0 = time.perf_counter()
         loss = None
-        for _ in range(n_steps):
+        for _ in range(steps):
             loss = trainer.train_step(ids, labels)
-        # trailing sync: the last loss + a param leaf depend on every prior step
+        # trailing sync: last loss + a param leaf depend on every prior step
         np.asarray(loss._data)
-        np.asarray(next(iter(trainer.params.values()))[(0,) * trainer.params[next(iter(trainer.params))].ndim])
+        first = next(iter(trainer.params))
+        np.asarray(trainer.params[first][(0,) * trainer.params[first].ndim])
         dt = time.perf_counter() - t0
 
-    tokens_per_sec = batch * seq * n_steps / dt
+    tokens_per_sec = batch * seq * steps / dt
+    # MFU against one v5e-class chip (~197 TFLOP/s bf16); CPU runs report 0
+    peak = 197e12 if on_tpu else float("inf")
+    mfu = tokens_per_sec * _model_flops_per_token(cfg) / peak
+    if not quiet:
+        print(f"  batch={batch} seq={seq}: {tokens_per_sec:,.0f} tok/s "
+              f"(mfu~{mfu:.1%})", file=sys.stderr)
+    return tokens_per_sec, mfu
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--seq", type=int, default=None)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--sweep", action="store_true",
+                    help="sweep batch/seq configs, report the best")
+    args = ap.parse_args()
+
+    import jax
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    # batch 16 is the measured single-chip sweet spot at seq 1024 (BENCH_r01:
+    # 61.9k tok/s there; batch 32 exceeds 16G HBM for GPT-2 small)
+    batch = args.batch or (16 if on_tpu else 2)
+    seq = args.seq or (1024 if on_tpu else 128)
+
+    if args.sweep:
+        best = (0.0, 0.0, None)
+        for b, s in ((8, 1024), (16, 1024), (24, 1024), (16, 2048),
+                     (8, 2048), (4, 4096), (8, 4096)):
+            try:
+                tps, mfu = run_config(b, s, args.steps)
+            except Exception as e:
+                print(f"  batch={b} seq={s}: failed ({e})", file=sys.stderr)
+                continue
+            if tps > best[0]:
+                best = (tps, mfu, (b, s))
+        tps, mfu, cfg = best
+        if cfg is None:
+            print(json.dumps({"error": "every sweep config failed"}))
+            sys.exit(1)
+        print(json.dumps({
+            "metric": "gpt2s_train_tokens_per_sec_per_chip",
+            "value": round(tps, 1), "unit": "tokens/s",
+            "vs_baseline": round(tps / BASELINE_TOKENS_PER_SEC, 3),
+            "mfu": round(mfu, 4), "config": cfg,
+        }))
+        return
+
+    tps, mfu = run_config(batch, seq, args.steps, quiet=True)
     print(json.dumps({
         "metric": "gpt2s_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
+        "value": round(tps, 1),
         "unit": "tokens/s",
-        "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 3),
+        "vs_baseline": round(tps / BASELINE_TOKENS_PER_SEC, 3),
+        "mfu": round(mfu, 4),
     }))
 
 

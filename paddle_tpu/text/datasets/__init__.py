@@ -235,23 +235,90 @@ class UCIHousing(Dataset):
 
 
 class WMT14(_SyntheticTextDataset):
-    """Machine translation: (src_ids, trg_ids, trg_next_ids)."""
+    """Machine translation: (src_ids, trg_ids, trg_next_ids).
+
+    Real path (reference wmt14.py:107-160 parity): tar with src.dict/trg.dict
+    members (one word per line, rank = id) and <mode>/<mode> members of
+    tab-separated parallel lines; <s>/<e> wrapping, UNK=2, len>80 pruning."""
 
     VOCAB = 30000
 
-    def __init__(self, data_file=None, mode="train", dict_size=30000, download=True):
-        self.VOCAB = dict_size
-        super().__init__(mode=mode, seed=300)
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 trg_dict_size=None, download=True):
+        assert mode.lower() in ("train", "test", "gen"), mode
+        self.mode = mode.lower()
+        if data_file and os.path.exists(data_file):
+            self._load_real(data_file, dict_size, trg_dict_size or dict_size)
+        else:
+            self.VOCAB = dict_size
+            super().__init__(mode=mode, seed=300)
+
+    def _load_real(self, data_file, dict_size, trg_dict_size):
+        START, END, UNK_IDX = "<s>", "<e>", 2
+
+        def to_dict(fd, size):
+            out = {}
+            for i, line in enumerate(fd):
+                if i >= size:
+                    break
+                out[line.decode().strip()] = i
+            return out
+
+        def one_member(f, suffix):
+            names = [m.name for m in f if m.name.endswith(suffix)]
+            if len(names) != 1:
+                raise ValueError(
+                    f"{data_file}: expected exactly one *{suffix} member, "
+                    f"found {names} — is this the wmt14 archive?")
+            return names[0]
+
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(data_file) as f:
+            self.src_dict = to_dict(
+                f.extractfile(one_member(f, "src.dict")), dict_size)
+            self.trg_dict = to_dict(
+                f.extractfile(one_member(f, "trg.dict")), trg_dict_size)
+            suffix = f"{self.mode}/{self.mode}"
+            members = [m.name for m in f if m.name.endswith(suffix)]
+            if not members:
+                raise ValueError(
+                    f"{data_file}: no '{suffix}' member for mode="
+                    f"'{self.mode}'")
+            for name in members:
+                for line in f.extractfile(name):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, UNK_IDX)
+                           for w in [START] + parts[0].split() + [END]]
+                    trg = [self.trg_dict.get(w, UNK_IDX)
+                           for w in parts[1].split()]
+                    if len(src) > 80 or len(trg) > 80:
+                        continue
+                    self.trg_ids_next.append(trg + [self.trg_dict[END]])
+                    self.trg_ids.append([self.trg_dict[START]] + trg)
+                    self.src_ids.append(src)
 
     def __getitem__(self, idx):
+        if hasattr(self, "src_ids"):
+            return (np.array(self.src_ids[idx], np.int64),
+                    np.array(self.trg_ids[idx], np.int64),
+                    np.array(self.trg_ids_next[idx], np.int64))
         row = self.data[idx]
         return row, np.roll(row, -1), np.roll(row, -2)
+
+    def __len__(self):
+        if hasattr(self, "src_ids"):
+            return len(self.src_ids)
+        return super().__len__()
 
 
 class WMT16(WMT14):
     def __init__(self, data_file=None, mode="train", src_dict_size=30000,
                  trg_dict_size=30000, lang="en", download=True):
-        super().__init__(mode=mode, dict_size=src_dict_size)
+        super().__init__(data_file=data_file, mode=mode,
+                         dict_size=src_dict_size,
+                         trg_dict_size=trg_dict_size)
 
 
 class Conll05st(_SyntheticTextDataset):

@@ -149,3 +149,65 @@ class TestReviewRegressions:
         ds = Imikolov(data_type="SEQ", window_size=6)
         src, trg = ds[0]
         assert len(src) == len(trg)
+
+
+@pytest.fixture
+def wmt14_tar(tmp_path):
+    p = str(tmp_path / "wmt14.tgz")
+    src_dict = "<s>\n<e>\n<unk>\nhello\nworld\n"
+    trg_dict = "<s>\n<e>\n<unk>\nbonjour\nmonde\n"
+    train = "hello world\tbonjour monde\nhello\tbonjour\n"
+    with tarfile.open(p, "w:gz") as tar:
+        _add_text(tar, "wmt14/src.dict", src_dict)
+        _add_text(tar, "wmt14/trg.dict", trg_dict)
+        _add_text(tar, "wmt14/train/train", train)
+    return p
+
+
+class TestWMT14Real:
+    def test_parallel_parse(self, wmt14_tar):
+        from paddle_tpu.text.datasets import WMT14
+
+        ds = WMT14(data_file=wmt14_tar, mode="train", dict_size=5)
+        assert len(ds) == 2
+        src, trg, trg_next = ds[0]
+        # <s> hello world <e> -> [0, 3, 4, 1]
+        np.testing.assert_array_equal(src, [0, 3, 4, 1])
+        # trg: <s> bonjour monde ; trg_next: bonjour monde <e>
+        np.testing.assert_array_equal(trg, [0, 3, 4])
+        np.testing.assert_array_equal(trg_next, [3, 4, 1])
+
+    def test_unk_and_wmt16_passthrough(self, wmt14_tar):
+        from paddle_tpu.text.datasets import WMT14, WMT16
+
+        ds = WMT14(data_file=wmt14_tar, mode="train", dict_size=3)
+        src, _, _ = ds[0]  # hello/world beyond dict_size=3 -> UNK=2
+        np.testing.assert_array_equal(src, [0, 2, 2, 1])
+        ds16 = WMT16(data_file=wmt14_tar, mode="train", src_dict_size=5)
+        assert len(ds16) == 2
+
+    def test_wmt14_mode_and_archive_validation(self, tmp_path):
+        from paddle_tpu.text.datasets import WMT14
+
+        with pytest.raises(AssertionError):
+            WMT14(mode="valid")
+        p = str(tmp_path / "nodicts.tgz")
+        with tarfile.open(p, "w:gz") as tar:
+            _add_text(tar, "whatever.txt", "x")
+        with pytest.raises(ValueError, match="src.dict"):
+            WMT14(data_file=p, mode="train")
+
+    def test_wmt16_trg_dict_size_honored(self, tmp_path):
+        from paddle_tpu.text.datasets import WMT16
+
+        p = str(tmp_path / "w16.tgz")
+        with tarfile.open(p, "w:gz") as tar:
+            _add_text(tar, "d/src.dict", "<s>\n<e>\n<unk>\na\n")
+            _add_text(tar, "d/trg.dict", "<s>\n<e>\n<unk>\nb\nc\n")
+            _add_text(tar, "d/train/train", "a\tb c\n")
+        ds = WMT16(data_file=p, mode="train", src_dict_size=3,
+                   trg_dict_size=5)
+        src, trg, nxt = ds[0]
+        # src 'a' beyond size-3 dict -> UNK; trg 'b','c' resolved (size 5)
+        np.testing.assert_array_equal(src, [0, 2, 1])
+        np.testing.assert_array_equal(nxt, [3, 4, 1])
