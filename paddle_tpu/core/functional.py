@@ -1,0 +1,28 @@
+"""Functionalization helper: run a Layer as a pure function of its params.
+
+The swap-and-restore of `Tensor._data` is the trickiest invariant in the
+eager<->jit bridge (a leaked tracer in a Layer poisons every later eager
+call); every jitted path (SpmdTrainer, hapi eval, static export) must go
+through this one implementation.
+"""
+import contextlib
+
+
+@contextlib.contextmanager
+def functional_state(layer, params, buffers=None):
+    """Temporarily bind `params`/`buffers` (name -> raw array) into the
+    Layer's tensors; ALWAYS restores the originals, even on trace errors."""
+    named_p = dict(layer.named_parameters())
+    named_b = dict(layer.named_buffers())
+    saved = {n: t._data for n, t in {**named_p, **named_b}.items()}
+    try:
+        for n, v in params.items():
+            if n in named_p:
+                named_p[n]._data = v
+        for n, v in (buffers or {}).items():
+            if n in named_b:
+                named_b[n]._data = v
+        yield named_p, named_b
+    finally:
+        for n, t in {**named_p, **named_b}.items():
+            t._data = saved[n]

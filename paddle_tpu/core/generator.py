@@ -34,7 +34,16 @@ class Generator:
         return self._seed
 
     def split(self):
-        """Return a fresh subkey, advancing internal state."""
+        """Return a fresh subkey, advancing internal state.
+
+        Inside a traced_rng scope (a jitted train step), subkeys derive from
+        the TRACED step key instead — otherwise the key drawn at trace time
+        bakes into the compiled program and every step reuses the same
+        dropout masks."""
+        if _TRACED_RNG:
+            scope = _TRACED_RNG[-1]
+            scope["key"], sub = jax.random.split(scope["key"])
+            return sub
         if self._key is None:
             self._key = jax.random.key(self._seed)
         self._key, sub = jax.random.split(self._key)
@@ -61,3 +70,21 @@ def seed(s):
 
 def get_rng_key():
     return _DEFAULT.split()
+
+
+# -- traced RNG scope (jitted train steps thread a per-step key) --------------
+import contextlib as _contextlib
+
+_TRACED_RNG = []
+
+
+@_contextlib.contextmanager
+def traced_rng(key):
+    """All Generator.split() calls inside derive from `key` (a traced PRNG
+    key fed as a step argument), so compiled programs get fresh randomness
+    every step instead of a trace-time constant."""
+    _TRACED_RNG.append({"key": key})
+    try:
+        yield
+    finally:
+        _TRACED_RNG.pop()

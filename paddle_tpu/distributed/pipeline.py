@@ -35,17 +35,11 @@ def _smap(f, mesh, in_specs, out_specs):
 
 def _pure_call(layer, params, *args):
     """Call `layer` as a pure function of a params dict (name -> array)."""
-    named = dict(layer.named_parameters())
-    saved = {n: t._data for n, t in named.items()}
-    try:
-        for n, v in params.items():
-            named[n]._data = v
-        with global_tape().pause():
-            out = layer(*[Tensor(a) if not isinstance(a, Tensor) else a for a in args])
+    from ..core.functional import functional_state
+
+    with functional_state(layer, params), global_tape().pause():
+        out = layer(*[Tensor(a) if not isinstance(a, Tensor) else a for a in args])
         return out._data if isinstance(out, Tensor) else out
-    finally:
-        for n, t in named.items():
-            t._data = saved[n]
 
 
 class PipelineStage:

@@ -258,27 +258,23 @@ class SpmdTrainer:
         self.opt_state = new_state
 
     # -- pure step -------------------------------------------------------------
-    def _forward_loss(self, params, buffers, batch):
-        layer = self.layer
-        tape = global_tape()
-        named_p = dict(layer.named_parameters())
-        named_b = dict(layer.named_buffers())
-        saved = {n: t._data for n, t in {**named_p, **named_b}.items()}
+    def _forward_loss(self, params, buffers, batch, rng=None):
         import contextlib
 
+        from ..core.functional import functional_state
+        from ..core.generator import traced_rng
+
+        layer = self.layer
+        tape = global_tape()
         amp_ctx = contextlib.nullcontext()
         if self.amp_dtype is not None:
             from ..amp.auto_cast import auto_cast
 
             amp_ctx = auto_cast(True, dtype=self.amp_dtype)
-        try:
-            for n, v in params.items():
-                named_p[n]._data = v
-            for n, v in self.frozen.items():
-                named_p[n]._data = v
-            for n, v in buffers.items():
-                named_b[n]._data = v
-            with tape.pause(), amp_ctx:
+        rng_ctx = traced_rng(rng) if rng is not None else contextlib.nullcontext()
+        with functional_state(layer, {**params, **self.frozen},
+                              buffers) as (named_p, named_b):
+            with tape.pause(), amp_ctx, rng_ctx:
                 inputs = [Tensor(b) for b in batch[:-1]]
                 label = Tensor(batch[-1])
                 out = None
@@ -299,9 +295,6 @@ class SpmdTrainer:
                     is_leaf=lambda t: isinstance(t, Tensor))
             return (loss._data if isinstance(loss, Tensor) else loss,
                     new_buffers, out_raw)
-        finally:
-            for n, t in {**named_p, **named_b}.items():
-                t._data = saved[n]
 
     def _is_dgc(self):
         """DGC + dp>1: grads must be top-k compressed BEFORE the cross-rank
@@ -347,25 +340,29 @@ class SpmdTrainer:
 
         want_out = self.return_outputs
 
-        def step(params, opt_state, buffers, lr, *batch):
-            def loss_fn(p, b):
-                loss, new_buf, outs = fwd(p, buffers, b)
+        def step(params, opt_state, buffers, lr, rng, *batch):
+            def loss_fn(p, b, r):
+                loss, new_buf, outs = fwd(p, buffers, b, r)
                 return loss.astype(jnp.float32), (new_buf, outs)
 
             if accum > 1:
                 # gradient merge (fleet/meta_optimizers/gradient_merge_optimizer.py):
-                # micro-batch scan, grads averaged
+                # micro-batch scan, grads averaged; per-micro rng via fold_in
                 micro = [jnp.reshape(b, (accum, b.shape[0] // accum) + b.shape[1:]) for b in batch]
 
-                def body(carry, mb):
+                def body(carry, xs):
                     g_acc, l_acc = carry
-                    (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, mb)
+                    mb, idx = xs[:-1], xs[-1]
+                    r = jax.random.fold_in(rng, idx)
+                    (loss, aux), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True)(params, list(mb), r)
                     g_acc = jax.tree_util.tree_map(lambda a, g: a + g, g_acc, grads)
                     return (g_acc, l_acc + loss), aux
 
                 g0 = jax.tree_util.tree_map(jnp.zeros_like, params)
                 (grads, loss_sum), (new_buf_all, outs_all) = jax.lax.scan(
-                    body, (g0, jnp.zeros((), jnp.float32)), micro)
+                    body, (g0, jnp.zeros((), jnp.float32)),
+                    tuple(micro) + (jnp.arange(accum),))
                 grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
                 loss = loss_sum / accum
                 new_buffers = jax.tree_util.tree_map(lambda v: v[-1], new_buf_all)
@@ -375,7 +372,7 @@ class SpmdTrainer:
                     if want_out else None)
             else:
                 (loss, (new_buffers, outputs)), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params, batch)
+                    loss_fn, has_aux=True)(params, batch, rng)
             new_params, new_state = self.optimizer.functional_apply(params, grads, opt_state, lr=lr)
             if want_out:
                 return loss, new_params, new_state, new_buffers, outputs
@@ -388,6 +385,7 @@ class SpmdTrainer:
             dict(self.s_shardings),
             self.b_shardings,
             repl,
+            repl,  # per-step rng key
         ) + tuple(batch_shard for _ in batch_arrays)
         out_shardings = (
             repl,
@@ -425,14 +423,16 @@ class SpmdTrainer:
         fwd = self._wrapped_forward()
         opt = self.optimizer
 
-        def step(params, opt_state, buffers, lr, *batch):
-            def local(params_r, state_r, buffers, lr, *batch_local):
+        def step(params, opt_state, buffers, lr, rng, *batch):
+            def local(params_r, state_r, buffers, lr, rng, *batch_local):
                 p = {n: v[0] for n, v in params_r.items()}
                 st = {n: (v if n == "__step__" else {m: a[0] for m, a in v.items()})
                       for n, v in state_r.items()}
+                # per-rank dropout masks (ranks intentionally diverge)
+                r = jax.random.fold_in(rng, jax.lax.axis_index(ax))
 
                 def loss_fn(pp, b):
-                    loss, nb, _ = fwd(pp, buffers, b)
+                    loss, nb, _ = fwd(pp, buffers, b, r)
                     return loss.astype(jnp.float32), nb
 
                 (loss, new_buf), grads = jax.value_and_grad(
@@ -455,18 +455,19 @@ class SpmdTrainer:
                  for n, st in opt_state.items()},
                 {n: P() for n in buffers},
                 P(),
+                P(),  # rng key (ranks fold in their axis index)
             ) + tuple(P(ax) for _ in batch)
             out_specs = (P(), {n: P(ax) for n in params},
                          {n: (P() if n == "__step__" else {m: P(ax) for m in st})
                           for n, st in opt_state.items()},
                          {n: P() for n in buffers})
             return self._shard_map(local, in_specs, out_specs)(
-                params, opt_state, buffers, lr, *batch)
+                params, opt_state, buffers, lr, rng, *batch)
 
         batch_shard = NamedSharding(mesh, P(ax))
         repl = NamedSharding(mesh, P())
         in_shardings = (self.p_shardings, dict(self.s_shardings),
-                        self.b_shardings, repl) + tuple(batch_shard for _ in batch_arrays)
+                        self.b_shardings, repl, repl) + tuple(batch_shard for _ in batch_arrays)
         out_shardings = (repl, self.p_shardings, dict(self.s_shardings), self.b_shardings)
         return jax.jit(step, in_shardings=in_shardings,
                        out_shardings=out_shardings, donate_argnums=(0, 1))
@@ -483,15 +484,16 @@ class SpmdTrainer:
         sparsity = opt._sparsity
         fwd = self._wrapped_forward()
 
-        def step(params, opt_state, buffers, lr, *batch):
-            def local(params, state_r, buffers, lr, *batch_local):
+        def step(params, opt_state, buffers, lr, rng, *batch):
+            def local(params, state_r, buffers, lr, rng, *batch_local):
                 st = {n: (v if n == "__step__" else
                           {k2: (a[0] if k2 in ("dgc_u", "dgc_v") else a)
                            for k2, a in v.items()})
                       for n, v in state_r.items()}
+                r = jax.random.fold_in(rng, jax.lax.axis_index(ax))
 
                 def loss_fn(pp, b):
-                    loss, nb, _ = fwd(pp, buffers, b)
+                    loss, nb, _ = fwd(pp, buffers, b, r)
                     return loss.astype(jnp.float32), nb
 
                 # differentiate against VARYING params: grads stay rank-local
@@ -524,34 +526,47 @@ class SpmdTrainer:
                                for k2 in st})
                           for n, st in opt_state.items()}
             in_specs = ({n: P() for n in params}, state_spec,
-                        {n: P() for n in buffers}, P()) + tuple(P(ax) for _ in batch)
+                        {n: P() for n in buffers}, P(),
+                        P()) + tuple(P(ax) for _ in batch)
             out_specs = (P(), {n: P() for n in params}, state_spec,
                          {n: P() for n in buffers})
             return self._shard_map(local, in_specs, out_specs)(
-                params, opt_state, buffers, lr, *batch)
+                params, opt_state, buffers, lr, rng, *batch)
 
         batch_shard = NamedSharding(mesh, P(ax))
         repl = NamedSharding(mesh, P())
         in_shardings = (self.p_shardings, dict(self.s_shardings),
-                        self.b_shardings, repl) + tuple(batch_shard for _ in batch_arrays)
+                        self.b_shardings, repl, repl) + tuple(batch_shard for _ in batch_arrays)
         out_shardings = (repl, self.p_shardings, dict(self.s_shardings), self.b_shardings)
         return jax.jit(step, in_shardings=in_shardings,
                        out_shardings=out_shardings, donate_argnums=(0, 1))
 
     # -- public ---------------------------------------------------------------
     def train_step(self, *batch):
+        from ..core.generator import default_generator
+
         batch_arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(np.asarray(b)) for b in batch]
         if self._compiled is None:
             self._compiled = self._build(batch_arrays)
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
+        # fresh per-step randomness (dropout etc.): deterministic under
+        # paddle.seed, varies per step — a trace-time key would bake ONE
+        # dropout mask into the compiled program
+        rng = default_generator().fold_in(self.optimizer._step_count)
+        if self.localsgd_k or self._is_dgc():
+            loss, self.params, self.opt_state, self.buffers = self._compiled(
+                self.params, self.opt_state, self.buffers, lr, rng, *batch_arrays
+            )
+            self.optimizer._step_count += 1
+            return Tensor(loss)
         if self.return_outputs:  # ctor rejects localsgd/dgc combinations
             loss, self.params, self.opt_state, self.buffers, outs = self._compiled(
-                self.params, self.opt_state, self.buffers, lr, *batch_arrays
+                self.params, self.opt_state, self.buffers, lr, rng, *batch_arrays
             )
             self.last_outputs = jax.tree_util.tree_map(Tensor, outs)
         else:
             loss, self.params, self.opt_state, self.buffers = self._compiled(
-                self.params, self.opt_state, self.buffers, lr, *batch_arrays
+                self.params, self.opt_state, self.buffers, lr, rng, *batch_arrays
             )
         self.optimizer._step_count += 1
         if isinstance(self.optimizer._lr, object) and hasattr(self.optimizer._lr, "step"):

@@ -10,7 +10,10 @@ SpmdTrainer — one XLA program incl. optimizer update, batch sharded over the m
 adapter is chosen by paddle_tpu.static mode or Model(..., use_jit=True); both share the
 same fit/evaluate/predict driver.
 """
+import functools
+
 import numpy as np
+import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..io import DataLoader
@@ -91,10 +94,16 @@ class JitGraphAdapter(DynamicGraphAdapter):
     def __init__(self, model):
         super().__init__(model)
         self._trainer = None
+        self._eval_fn = None
+        self._eval_synced = False
 
     def train_batch(self, inputs, labels=None):
         inputs = _to_list(inputs)
         labels = _to_list(labels)
+        # train mode BEFORE any (re)trace: an eval's net.eval() would
+        # otherwise bake dropout-off/BN-frozen into the compiled train step
+        self.model.network.train()
+        self._eval_synced = False
         if self._trainer is None:
             from ..distributed.spmd import SpmdTrainer
 
@@ -115,9 +124,54 @@ class JitGraphAdapter(DynamicGraphAdapter):
         return self._return(loss, metrics)
 
     def eval_batch(self, inputs, labels=None):
-        if self._trainer is not None:
+        """Jitted eval: forward+loss compile once per shape (the
+        StaticGraphAdapter's test program analog) instead of eager per batch."""
+        import jax
+
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        if self._trainer is not None and not self._eval_synced:
+            # once per eval loop, not per batch (stage-3 sync device_gets
+            # every param; train_batch resets the flag)
             self._trainer.sync_to_layer()
-        return super().eval_batch(inputs, labels)
+            self._eval_synced = True
+        net = self.model.network
+        net.eval()
+        unwrap = functools.partial(
+            jax.tree_util.tree_map,
+            lambda v: v._data if isinstance(v, Tensor) else v,
+            is_leaf=lambda v: isinstance(v, Tensor))
+        if self._eval_fn is None:
+            from ..core.functional import functional_state
+            from ..core.tape import global_tape
+
+            def pure(n_labels, params, buffers, *arrs):
+                with functional_state(net, params, buffers), \
+                        global_tape().pause():
+                    n_in = len(arrs) - n_labels
+                    ins = [Tensor(a) for a in arrs[:n_in]]
+                    lbs = [Tensor(a) for a in arrs[n_in:]]
+                    outputs = net(*ins)
+                    loss = None
+                    if self.model._loss:
+                        losses = self.model._loss(*(_to_list(outputs) + lbs))
+                        loss = (losses if isinstance(losses, Tensor)
+                                else sum(losses))
+                return (loss._data if loss is not None else None), \
+                    unwrap(outputs)
+
+            # n_labels is STATIC: a changed input/label split with identical
+            # array shapes must re-trace, not replay a stale split
+            self._eval_fn = jax.jit(pure, static_argnums=0)
+        params = {n: p._data for n, p in net.named_parameters()}
+        buffers = {n: b._data for n, b in net.named_buffers()}
+        arrs = [x._data if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+                for x in inputs + labels]
+        loss_raw, outs_raw = self._eval_fn(len(labels), params, buffers, *arrs)
+        outputs = jax.tree_util.tree_map(Tensor, outs_raw)
+        loss = Tensor(loss_raw) if loss_raw is not None else None
+        metrics = self._update_metrics(outputs, labels)
+        return self._return(loss, metrics)
 
     def predict_batch(self, inputs):
         if self._trainer is not None:
@@ -146,9 +200,11 @@ class Model:
         """hapi/model.py:1244 parity. Re-preparing resets the compiled
         trainer (reference semantics: prepare rebuilds the adapter programs),
         so a metrics change re-compiles with the matching step signature."""
-        if isinstance(self._adapter, JitGraphAdapter) and self._adapter._trainer is not None:
-            self._adapter._trainer.sync_to_layer()
-            self._adapter._trainer = None
+        if isinstance(self._adapter, JitGraphAdapter):
+            if self._adapter._trainer is not None:
+                self._adapter._trainer.sync_to_layer()
+                self._adapter._trainer = None
+            self._adapter._eval_fn = None
         self._optimizer = optimizer
         if loss is not None and not callable(loss):
             raise TypeError("loss must be callable (a Layer or function)")

@@ -243,9 +243,11 @@ class WMT14(_SyntheticTextDataset):
 
     VOCAB = 30000
 
+    _MODES = ("train", "test", "gen")
+
     def __init__(self, data_file=None, mode="train", dict_size=30000,
                  trg_dict_size=None, download=True):
-        assert mode.lower() in ("train", "test", "gen"), mode
+        assert mode.lower() in self._MODES, mode
         self.mode = mode.lower()
         if data_file and os.path.exists(data_file):
             self._load_real(data_file, dict_size, trg_dict_size or dict_size)
@@ -314,6 +316,8 @@ class WMT14(_SyntheticTextDataset):
 
 
 class WMT16(WMT14):
+    _MODES = ("train", "test", "val")  # reference wmt16.py accepts val
+
     def __init__(self, data_file=None, mode="train", src_dict_size=30000,
                  trg_dict_size=30000, lang="en", download=True):
         super().__init__(data_file=data_file, mode=mode,

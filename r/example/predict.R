@@ -1,0 +1,27 @@
+# paddle_tpu R inference example (reference r/example parity).
+#
+# Like the reference's R client, this drives the Python inference API through
+# reticulate — the TPU-native predictor is XLA reached via Python, so R (and
+# any reticulate-capable host) gets the full predictor surface:
+#
+#   install.packages("reticulate")
+#
+# Expects a model saved with paddle.jit.save(net, prefix, input_spec=[...])
+# (the durable jax.export artifact loads without the original Python class).
+
+library(reticulate)
+
+# point reticulate at the environment that has paddle_tpu on PYTHONPATH
+# use_python("/opt/venv/bin/python")
+
+paddle <- import("paddle_tpu")
+np <- import("numpy")
+
+args <- commandArgs(trailingOnly = TRUE)
+prefix <- if (length(args) >= 1) args[[1]] else "./model"
+
+predictor <- paddle$jit$load(prefix)
+
+x <- np$ones(c(2L, 4L), dtype = "float32")
+out <- predictor(paddle$to_tensor(x))
+print(out$numpy())

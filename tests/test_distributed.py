@@ -327,3 +327,38 @@ class TestDistributedHapi:
         acc = res["acc"] if isinstance(res, dict) else res[-1]
         acc = float(acc[0] if isinstance(acc, (list, tuple)) else acc)
         assert acc > 0.5
+
+
+class TestTracedRng:
+    def test_dropout_varies_per_step_in_jitted_trainer(self):
+        """Dropout inside the compiled step must draw fresh masks per step
+        (trace-time keys bake ONE mask into the program)."""
+        from paddle_tpu.distributed.spmd import SpmdTrainer
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 32), nn.Dropout(0.5), nn.Linear(32, 2))
+        opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=net.parameters())
+        mesh = build_mesh((8,), ("dp",))
+        tr = SpmdTrainer(net, opt, lambda o, l: ((o - l) ** 2).mean(), mesh=mesh)
+        x = paddle.to_tensor(np.random.rand(16, 8).astype(np.float32))
+        y = paddle.to_tensor(np.random.rand(16, 2).astype(np.float32))
+        # lr=0 -> params frozen; loss differences come from dropout masks only
+        l1 = float(tr.train_step(x, y)._data)
+        l2 = float(tr.train_step(x, y)._data)
+        l3 = float(tr.train_step(x, y)._data)
+        assert len({round(l1, 9), round(l2, 9), round(l3, 9)}) > 1, (l1, l2, l3)
+
+    def test_dropout_varies_in_localsgd_step(self):
+        """Review r2i: localsgd/dgc paths must thread the per-step rng too."""
+        from paddle_tpu.distributed.spmd import SpmdTrainer
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 32), nn.Dropout(0.5), nn.Linear(32, 2))
+        opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=net.parameters())
+        mesh = build_mesh((8,), ("dp",))
+        tr = SpmdTrainer(net, opt, lambda o, l: ((o - l) ** 2).mean(),
+                         mesh=mesh, localsgd_k=2)
+        x = paddle.to_tensor(np.random.rand(16, 8).astype(np.float32))
+        y = paddle.to_tensor(np.random.rand(16, 2).astype(np.float32))
+        losses = {round(float(tr.train_step(x, y)._data), 9) for _ in range(3)}
+        assert len(losses) > 1, losses

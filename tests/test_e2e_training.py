@@ -250,3 +250,43 @@ class TestJitAdapterMetricPath:
         model.fit(ds, epochs=1, batch_size=16, verbose=0)
         model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
         model.fit(ds, epochs=1, batch_size=16, verbose=0)  # must not crash
+
+    def test_eval_then_fit_keeps_train_mode(self):
+        """Review r2h #1: an evaluate() before fit() must not bake eval mode
+        (dropout off) into the compiled train step."""
+        paddle.seed(0)
+        net = nn.Sequential(nn.Flatten(), nn.Linear(784, 32), nn.Dropout(0.5),
+                            nn.Linear(32, 10))
+        rng = np.random.RandomState(0)
+        imgs = rng.rand(32, 1, 28, 28).astype(np.float32)
+        labels = rng.randint(0, 10, (32, 1)).astype(np.int64)
+        ds = TensorDataset([paddle.to_tensor(imgs), paddle.to_tensor(labels)])
+        model = paddle.Model(net, use_jit=True)
+        opt = paddle.optimizer.SGD(learning_rate=0.0,
+                                   parameters=model.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        model.evaluate(ds, batch_size=32, verbose=0)  # net.eval() ran
+        r1 = model.train_batch([paddle.to_tensor(imgs)],
+                               [paddle.to_tensor(labels)])
+        r2 = model.train_batch([paddle.to_tensor(imgs)],
+                               [paddle.to_tensor(labels)])
+        # lr=0: params frozen; with dropout ACTIVE the two losses differ
+        # (different masks); with eval-mode baked in they would be identical
+        assert abs(r1[0] - r2[0]) > 1e-8, (r1, r2)
+
+    def test_jit_eval_loss_matches_eager(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Flatten(), nn.Linear(784, 10))
+        rng = np.random.RandomState(0)
+        imgs = rng.rand(16, 1, 28, 28).astype(np.float32)
+        labels = rng.randint(0, 10, (16, 1)).astype(np.int64)
+        ds = TensorDataset([paddle.to_tensor(imgs), paddle.to_tensor(labels)])
+        m_jit = paddle.Model(net, use_jit=True)
+        m_jit.prepare(paddle.optimizer.SGD(parameters=m_jit.parameters()),
+                      nn.CrossEntropyLoss())
+        r_jit = m_jit.evaluate(ds, batch_size=16, verbose=0)
+        m_dyn = paddle.Model(net)
+        m_dyn.prepare(paddle.optimizer.SGD(parameters=m_dyn.parameters()),
+                      nn.CrossEntropyLoss())
+        r_dyn = m_dyn.evaluate(ds, batch_size=16, verbose=0)
+        np.testing.assert_allclose(r_jit["loss"], r_dyn["loss"], rtol=1e-5)

@@ -51,8 +51,9 @@ def _lowered_text(trainer, x, y):
     batch = [x._data, y._data]
     step = trainer._build(batch)
     lr = jnp.asarray(0.1, jnp.float32)
+    rng = jax.random.key(0)
     return step.lower(trainer.params, trainer.opt_state, trainer.buffers,
-                      lr, *batch).as_text()
+                      lr, rng, *batch).as_text()
 
 
 class TestLocalSGD:
