@@ -18,7 +18,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
         t.grad = None
         t.retain_grads = True
     retain = retain_graph if retain_graph is not None else create_graph
-    _tape_backward(list(outputs), grad_outputs, retain_graph=bool(retain))
+    targets = {id(t) for t in inputs} if only_inputs else None
+    _tape_backward(list(outputs), grad_outputs, retain_graph=bool(retain),
+                   create_graph=bool(create_graph), targets=targets)
     grads = []
     for t, old, old_r in zip(inputs, saved, saved_retain):
         g = t.grad

@@ -44,17 +44,22 @@ def apply(fn, *args, n_outputs=None, **kwargs):
 
     record = tape.enabled and bool(diff_tensors)
 
+    # template holds RAW values only (no Tensor objects): the Node retains
+    # `pure` for create_graph, so closing over Tensors would pin their
+    # grads/hooks/node graph for the tape's lifetime
+    template = [a._data if isinstance(a, Tensor) else a for a in args]
+
     def pure(*vals):
-        call = list(args)
+        call = list(template)
         for j, i in enumerate(diff_idx):
             call[i] = vals[j]
-        call = [c._data if isinstance(c, Tensor) else c for c in call]
         return fn(*call, **kwargs)
 
+    saved_in = [t._data for t in diff_tensors]
     if record:
-        out, vjp_fn = jax.vjp(pure, *[t._data for t in diff_tensors])
+        out, vjp_fn = jax.vjp(pure, *saved_in)
     else:
-        out = pure(*[t._data for t in diff_tensors])
+        out = pure(*saved_in)
 
     multi = isinstance(out, (tuple, list))
     raw_outs = list(out) if multi else [out]
@@ -75,7 +80,8 @@ def apply(fn, *args, n_outputs=None, **kwargs):
         def pullback(cot_list, _vjp=vjp_fn, _multi=multi):
             return _vjp(tuple(cot_list) if _multi else cot_list[0])
 
-        node = Node(diff_tensors, out_tensors, pullback)
+        node = Node(diff_tensors, out_tensors, pullback, pure=pure,
+                    multi=multi, saved_in=saved_in)
         for t in out_tensors:
             t._node = node
         tape.record(node)

@@ -16,13 +16,21 @@ import jax
 
 
 class Node:
-    __slots__ = ("inputs", "outputs", "pullback", "alive")
+    __slots__ = ("inputs", "outputs", "pullback", "alive", "pure", "multi",
+                 "saved_in")
 
-    def __init__(self, inputs, outputs, pullback):
+    def __init__(self, inputs, outputs, pullback, pure=None, multi=False,
+                 saved_in=None):
         self.inputs = inputs      # list[Tensor] (only differentiable tensor args)
         self.outputs = outputs    # list[Tensor]
         self.pullback = pullback  # vjp function: cotangents-tuple -> input cotangents
         self.alive = True
+        # for create_graph (double grad): the pure fn over the diff inputs'
+        # raw values, and those values AT RECORD TIME (detects in-place
+        # rebinding — re-deriving the vjp at mutated values would be wrong)
+        self.pure = pure
+        self.multi = multi
+        self.saved_in = saved_in
 
 
 # nodes held without a backward() call before a one-time leak warning fires:
@@ -90,14 +98,25 @@ def _zeros_like_val(v):
     return jnp.zeros_like(v)
 
 
-def backward(loss_tensors, grad_tensors=None, retain_graph=False):
+def backward(loss_tensors, grad_tensors=None, retain_graph=False,
+             create_graph=False, targets=None):
     """Run reverse accumulation from `loss_tensors`.
 
     Mirrors BasicEngine::Execute (imperative/basic_engine.cc:265): walk recorded nodes in
     reverse creation order; a node fires if any of its outputs has a pending cotangent;
     input cotangents accumulate into `Tensor.grad` for leaves and into pending buffers for
     interior tensors.
+
+    create_graph=True (PartialGradEngine double-grad parity): every pullback
+    is re-derived from the node's pure fn and executed THROUGH the dispatcher,
+    so the produced gradients are themselves taped — grad-of-grad works.
+
+    `targets` (a set of tensor ids) restricts which LEAVES accumulate .grad
+    — paddle.grad's only_inputs=True (PartialGradEngine pruning).
     """
+    if create_graph:
+        return _backward_create_graph(loss_tensors, grad_tensors,
+                                      retain_graph, targets)
     import jax.numpy as jnp
 
     if not isinstance(loss_tensors, (list, tuple)):
@@ -152,7 +171,8 @@ def backward(loss_tensors, grad_tensors=None, retain_graph=False):
                 continue
             if inp._node is None:
                 # leaf: accumulate into .grad (GradientAccumulator semantics)
-                inp._accumulate_grad(cot)
+                if targets is None or id(inp) in targets:
+                    inp._accumulate_grad(cot)
             else:
                 add_pending(inp, cot)
                 # also expose interior grads if user asked (retain_grads)
@@ -163,3 +183,116 @@ def backward(loss_tensors, grad_tensors=None, retain_graph=False):
 
     if not retain_graph:
         _TAPE.clear()
+
+
+def _backward_create_graph(loss_tensors, grad_tensors, retain_graph,
+                           targets=None):
+    """Taped reverse sweep: cotangents flow as Tensors through dispatch.apply,
+    so second-order backward() over the produced .grad tensors works.
+
+    Each node's vjp is re-derived from node.pure (re-runs that op's forward —
+    the FLOP cost of higher-order grads). Inputs rebound by an in-place op
+    since recording are detected via node.saved_in and raise (the reference's
+    inplace-version check); nodes without a pure fn (PyLayer) raise too."""
+    import jax
+    import jax.numpy as jnp
+
+    from .dispatch import apply as _apply
+    from .tensor import Tensor
+
+    if not isinstance(loss_tensors, (list, tuple)):
+        loss_tensors = [loss_tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(loss_tensors)
+    if not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    pending = {}  # id(tensor) -> (tensor, cot Tensor)
+
+    def add_pending(t, g):
+        k = id(t)
+        if k in pending:
+            pending[k] = (t, pending[k][1] + g)
+        else:
+            pending[k] = (t, g)
+
+    for t, g in zip(loss_tensors, grad_tensors):
+        if g is None:
+            gt = Tensor(jnp.ones_like(t._data), stop_gradient=True)
+        elif hasattr(g, "_data"):
+            gt = g
+        else:
+            gt = Tensor(jnp.asarray(g), stop_gradient=True)
+        add_pending(t, gt)
+
+    def accumulate(inp, cot):
+        """TAPED accumulation into .grad with the normal path's dtype cast
+        and registered-hook semantics (both as taped ops)."""
+        if cot._data.dtype != inp._data.dtype:
+            cot = cot.astype(inp._data.dtype)
+        if inp._hooks:
+            for h in inp._hooks:
+                out = h(cot)
+                if out is not None:
+                    cot = out
+        inp.grad = cot if inp.grad is None else inp.grad + cot
+
+    for node in reversed(_TAPE.nodes):
+        if not node.alive:
+            continue
+        cot_tensors = []
+        fired = False
+        for o in node.outputs:
+            entry = pending.get(id(o))
+            if entry is not None:
+                cot_tensors.append(entry[1])
+                fired = True
+            else:
+                cot_tensors.append(
+                    Tensor(jnp.zeros_like(o._data), stop_gradient=True))
+        if not fired:
+            continue
+        if node.pure is None:
+            raise RuntimeError(
+                "backward(create_graph=True) through a PyLayer/custom node "
+                "is not supported: the node records no re-derivable pure "
+                "function for second-order gradients")
+        if node.saved_in is not None and any(
+                s is not t._data
+                for s, t in zip(node.saved_in, node.inputs)):
+            raise RuntimeError(
+                "backward(create_graph=True): an input of a recorded op was "
+                "rebound by an in-place op (or mutated) after the forward — "
+                "re-deriving its vjp would be wrong. Remove the in-place op "
+                "or avoid create_graph through it (inplace-version check, "
+                "imperative/variable_wrapper.h parity)")
+        for o in node.outputs:
+            pending.pop(id(o), None)
+
+        n_in = len(node.inputs)
+
+        def pull(*vals, _pure=node.pure, _n=n_in, _multi=node.multi):
+            ins, cots = vals[:_n], vals[_n:]
+            _, vjp_fn = jax.vjp(_pure, *ins)
+            return vjp_fn(tuple(cots) if _multi else cots[0])
+
+        out = _apply(pull, *node.inputs, *cot_tensors)
+        cots = list(out) if isinstance(out, tuple) else [out]
+        for inp, cot in zip(node.inputs, cots):
+            if cot is None or inp.stop_gradient:
+                continue
+            if inp._node is None:
+                # leaf: .grad stays TAPED (the whole point of create_graph)
+                if targets is None or id(inp) in targets:
+                    accumulate(inp, cot)
+            else:
+                add_pending(inp, cot)
+                if getattr(inp, "retain_grads", False):
+                    accumulate(inp, cot)
+        if not retain_graph:
+            node.alive = False
+
+    # the plain path clears the whole tape; here new (taped-grad) nodes must
+    # survive for the second backward — drop only the consumed ones
+    if not retain_graph:
+        _TAPE.nodes = [n for n in _TAPE.nodes if n.alive]

@@ -145,10 +145,12 @@ class Tensor:
         return self._data.__dlpack__()
 
     # ---- autograd ------------------------------------------------------------
-    def backward(self, grad_tensor=None, retain_graph=False):
+    def backward(self, grad_tensor=None, retain_graph=False,
+                 create_graph=False):
         from .tape import backward as _backward
 
-        _backward([self], [grad_tensor], retain_graph=retain_graph)
+        _backward([self], [grad_tensor], retain_graph=retain_graph,
+                  create_graph=create_graph)
 
     def clear_grad(self):
         self.grad = None
