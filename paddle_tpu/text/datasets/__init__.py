@@ -326,15 +326,151 @@ class WMT16(WMT14):
 
 
 class Conll05st(_SyntheticTextDataset):
-    """SRL sequence labeling."""
+    """SRL sequence labeling: (word_ids, predicate_id, bio_label_ids).
+
+    Real path (reference conll05.py:170-230 parity): parse the conll05st tar
+    (words/*.words.gz + props/*.props.gz members; blank line = sentence end);
+    bracketed-star props convert to B-/I-/O tags; one sample per (sentence,
+    predicate) pair. Dicts build from the corpus unless *_dict_file given
+    (one entry per line, rank = id). Returns the core (words, predicate,
+    labels) triple — the reference's ctx-window/mark features derive from it."""
 
     VOCAB = 5000
 
     def __init__(self, data_file=None, word_dict_file=None, verb_dict_file=None,
                  target_dict_file=None, emb_file=None, mode="train", download=True):
-        super().__init__(mode=mode, seed=400)
+        if data_file and os.path.exists(data_file):
+            self._load_real(data_file, word_dict_file, verb_dict_file,
+                            target_dict_file)
+        else:
+            super().__init__(mode=mode, seed=400)
+
+    @staticmethod
+    def _bio(lbl_cols):
+        """Bracketed-star -> BIO (reference conll05.py:203-224)."""
+        out, cur, inside = [], "O", False
+        for l in lbl_cols:
+            if l == "*" and not inside:
+                out.append("O")
+            elif l == "*" and inside:
+                out.append("I-" + cur)
+            elif l == "*)":
+                out.append("I-" + cur)
+                inside = False
+            elif "(" in l and ")" in l:
+                cur = l[1:l.find("*")]
+                out.append("B-" + cur)
+                inside = False
+            elif "(" in l:
+                cur = l[1:l.find("*")]
+                out.append("B-" + cur)
+                inside = True
+            else:
+                raise RuntimeError(f"unexpected SRL label: {l}")
+        return out
+
+    def _load_real(self, data_file, word_dict_file, verb_dict_file,
+                   target_dict_file):
+        import gzip
+
+        samples = []  # (words, predicate, bio_labels)
+
+        def flush(sent, cols):
+            if not (sent and cols):
+                return
+            verbs = [c[0] for c in cols if c[0] != "-"]
+            n_pred = len(cols[0]) - 1
+            for i in range(n_pred):
+                samples.append((list(sent),
+                                verbs[i] if i < len(verbs) else "-",
+                                self._bio([c[i + 1] for c in cols])))
+
+        with tarfile.open(data_file) as tf:
+            names = tf.getnames()
+            # pair words/props by shared stem — the real archive holds BOTH
+            # test.wsj and test.brown trees; independent suffix picks could
+            # zip one split's words against the other's props
+            pairs = []
+            for wn in sorted(n for n in names if n.endswith(".words.gz")):
+                stem = wn.rsplit("/words/", 1)[-1][:-len(".words.gz")]
+                pn = next((n for n in names
+                           if n.endswith(f"/props/{stem}.props.gz")), None)
+                if pn is not None:
+                    pairs.append((wn, pn))
+            if not pairs:
+                raise ValueError(f"{data_file}: no paired words/props "
+                                 "members — is this the conll05st archive?")
+            for words_name, props_name in pairs:
+                with gzip.GzipFile(fileobj=tf.extractfile(words_name)) as wfh, \
+                        gzip.GzipFile(fileobj=tf.extractfile(props_name)) as pfh:
+                    sent, cols = [], []
+                    for wline, pline in zip(wfh, pfh):
+                        w = wline.decode().strip()
+                        p = pline.decode().strip().split()
+                        if not p:  # sentence boundary
+                            flush(sent, cols)
+                            sent, cols = [], []
+                            continue
+                        sent.append(w.lower())
+                        cols.append(p)
+                    flush(sent, cols)  # file may lack a trailing blank line
+
+        def read_dict(path):
+            with open(path) as f:
+                return {line.strip(): i for i, line in enumerate(f)
+                        if line.strip()}
+
+        def build_dict(items):
+            freq = collections.Counter(items)
+            return {w: i for i, (w, _) in enumerate(
+                sorted(freq.items(), key=lambda x: (-x[1], x[0])))}
+
+        self.word_dict = (read_dict(word_dict_file) if word_dict_file
+                          else build_dict(w for s, _, _ in samples for w in s))
+        self.predicate_dict = (read_dict(verb_dict_file) if verb_dict_file
+                               else build_dict(v for _, v, _ in samples))
+        self.label_dict = (read_dict(target_dict_file) if target_dict_file
+                           else build_dict(l for _, _, ls in samples
+                                           for l in ls))
+        self.word_dict.setdefault("<unk>", len(self.word_dict))
+        unk = self.word_dict["<unk>"]
+
+        def strict(d, key, what):
+            # only words get an <unk> bucket; a predicate/label missing from
+            # a user-supplied dict file is a stale dict, not vocab overflow
+            if key not in d:
+                raise ValueError(
+                    f"conll05st: {what} '{key}' not in the supplied dict "
+                    "file — dict/corpus mismatch")
+            return d[key]
+
+        self.samples = [
+            (np.array([self.word_dict.get(w, unk) for w in s], np.int64),
+             np.array([strict(self.predicate_dict, v, "predicate")],
+                      np.int64),
+             np.array([strict(self.label_dict, l, "label") for l in ls],
+                      np.int64))
+            for s, v, ls in samples
+        ]
+
+    def get_dict(self):
+        if not hasattr(self, "word_dict"):
+            # synthetic fallback: shape-compatible dicts
+            self.word_dict = {f"w{i}": i for i in range(self.VOCAB)}
+            self.predicate_dict = {f"v{i}": i for i in range(100)}
+            self.label_dict = {f"l{i}": i for i in range(20)}
+        return self.word_dict, self.predicate_dict, self.label_dict
 
     def __getitem__(self, idx):
+        if hasattr(self, "samples"):
+            return self.samples[idx]
+        # synthetic fallback emits the SAME 3-tuple shape as the real path
         row = self.data[idx]
+        pred = np.array([int(row[0]) % 100], np.int64)
         labels = (row % 20).astype(np.int64)
-        return row, labels
+        return row, pred, labels
+
+    def __len__(self):
+        if hasattr(self, "samples"):
+            return len(self.samples)
+        return super().__len__()

@@ -211,3 +211,78 @@ class TestWMT14Real:
         # src 'a' beyond size-3 dict -> UNK; trg 'b','c' resolved (size 5)
         np.testing.assert_array_equal(src, [0, 2, 1])
         np.testing.assert_array_equal(nxt, [3, 4, 1])
+
+
+@pytest.fixture
+def conll05_tar(tmp_path):
+    import gzip
+
+    words = "The\ncat\nsat\n\nDogs\nbark\n\n"
+    # props: col0 = predicate lemma or '-', col i+1 = labels for predicate i
+    props = ("- (A0* *\n- *) *\nsit * (V*)\n\n"
+             "- (A0*)\nbark (V*)\n\n")
+    wgz = tmp_path / "test.wsj.words.gz"
+    pgz = tmp_path / "test.wsj.props.gz"
+    with gzip.open(wgz, "wb") as f:
+        f.write(words.encode())
+    with gzip.open(pgz, "wb") as f:
+        f.write(props.encode())
+    p = str(tmp_path / "conll05st-tests.tar.gz")
+    import tarfile as tfmod
+
+    with tfmod.open(p, "w:gz") as tar:
+        tar.add(str(wgz), arcname="conll05st-release/test.wsj/words/test.wsj.words.gz")
+        tar.add(str(pgz), arcname="conll05st-release/test.wsj/props/test.wsj.props.gz")
+    return p
+
+
+class TestConll05Real:
+    def test_bio_conversion_and_samples(self, conll05_tar):
+        from paddle_tpu.text.datasets import Conll05st
+
+        ds = Conll05st(data_file=conll05_tar)
+        # sentence 1 has 2 predicate columns, sentence 2 has 1 -> 3 samples
+        assert len(ds) == 3
+        words, pred, labels = ds[0]
+        assert words.dtype == np.int64 and len(words) == 3
+        assert len(labels) == 3
+        wd, pd, ld = ds.get_dict()
+        inv_l = {v: k for k, v in ld.items()}
+        # first predicate col of sentence 1: (A0* *) * -> B-A0 I-A0 O
+        assert [inv_l[i] for i in labels.tolist()] == ["B-A0", "I-A0", "O"]
+
+    def test_synthetic_fallback(self):
+        from paddle_tpu.text.datasets import Conll05st
+
+        ds = Conll05st()
+        row, pred, labels = ds[0]  # same 3-tuple shape as the real path
+        assert row.dtype == np.int64 and pred.shape == (1,)
+        wd, pd, ld = ds.get_dict()
+        assert len(ld) == 20
+
+    def test_trailing_sentence_without_blank_line(self, tmp_path):
+        """Review r2k: the final sentence must not be dropped."""
+        import gzip
+        import tarfile as tfmod
+        from paddle_tpu.text.datasets import Conll05st
+
+        wgz = tmp_path / "x.words.gz"
+        pgz = tmp_path / "x.props.gz"
+        with gzip.open(wgz, "wb") as f:
+            f.write(b"Only\nsentence\n")   # NO trailing blank line
+        with gzip.open(pgz, "wb") as f:
+            f.write(b"- (A0*)\nrun (V*)\n")
+        p = str(tmp_path / "c.tgz")
+        with tfmod.open(p, "w:gz") as tar:
+            tar.add(str(wgz), arcname="rel/test.wsj/words/x.words.gz")
+            tar.add(str(pgz), arcname="rel/test.wsj/props/x.props.gz")
+        ds = Conll05st(data_file=p)
+        assert len(ds) == 1
+
+    def test_stale_dict_file_raises(self, tmp_path, conll05_tar):
+        from paddle_tpu.text.datasets import Conll05st
+
+        bad = tmp_path / "labels.dict"
+        bad.write_text("O\n")  # missing B-A0 etc.
+        with pytest.raises(ValueError, match="dict/corpus mismatch"):
+            Conll05st(data_file=conll05_tar, target_dict_file=str(bad))
