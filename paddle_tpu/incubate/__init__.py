@@ -1,2 +1,3 @@
-"""paddle.incubate parity: auto-checkpoint, (later) sparse utils."""
+"""paddle.incubate parity: auto-checkpoint, segment reductions."""
 from . import checkpoint  # noqa: F401
+from .segment import segment_max, segment_mean, segment_min, segment_sum  # noqa: F401

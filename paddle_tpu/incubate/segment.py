@@ -1,0 +1,56 @@
+"""Segment reductions (paddle.incubate.segment_* parity; reference
+operators/segment_pool_op / tdm-style segment kernels). XLA-native:
+jax.ops.segment_* with the segment count taken from the ids host-side
+(eager API, like the reference's dynamic-output CPU kernels)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def _segment(data, segment_ids, kind):
+    ids_np = np.asarray(_t(segment_ids)._data).astype(np.int32)
+    n = int(ids_np.max()) + 1 if ids_np.size else 0
+    fns = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+           "min": jax.ops.segment_min}
+
+    def fn(d, ids):
+        ids = ids.astype(jnp.int32)
+        if kind == "mean":
+            s = jax.ops.segment_sum(d, ids, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones_like(ids, d.dtype), ids,
+                                      num_segments=n)
+            shape = (n,) + (1,) * (d.ndim - 1)
+            return s / jnp.maximum(cnt.reshape(shape), 1)
+        out = fns[kind](d, ids, num_segments=n)
+        if kind in ("max", "min"):
+            # empty segments: paddle fills 0, jax fills +-inf
+            cnt = jax.ops.segment_sum(jnp.ones_like(ids, d.dtype), ids,
+                                      num_segments=n)
+            shape = (n,) + (1,) * (d.ndim - 1)
+            out = jnp.where(cnt.reshape(shape) > 0, out, 0)
+        return out
+
+    return apply(fn, _t(data), _t(segment_ids).detach())
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "max")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "min")

@@ -1,2 +1,2 @@
 """paddle.metric parity (python/paddle/metric/metrics.py)."""
-from .metrics import Accuracy, Auc, Metric, Precision, Recall, accuracy  # noqa: F401
+from .metrics import Accuracy, Auc, Metric, Precision, Recall, accuracy, mean_iou  # noqa: F401

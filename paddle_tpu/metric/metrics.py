@@ -183,3 +183,39 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
     return apply(fn,
                  input if isinstance(input, Tensor) else Tensor(input),
                  label if isinstance(label, Tensor) else Tensor(label))
+
+
+def mean_iou(input, label, num_classes, name=None):
+    """operators/metrics/mean_iou_op.cc parity: input/label int class maps.
+
+    Returns (mean_iou scalar, out_wrong [num_classes], out_correct [num_classes])
+    — IoU per class = correct / (pred + label - correct), averaged over classes
+    that appear; bincount via XLA scatter-add.
+    """
+    import jax.numpy as jnp
+    from ..core.dispatch import apply
+    from ..core.tensor import Tensor
+    import numpy as np
+
+    def _t(x):
+        return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+    def fn(p, l):
+        p = p.reshape(-1).astype(jnp.int32)
+        l = l.reshape(-1).astype(jnp.int32)
+        ones = jnp.ones_like(p, jnp.float32)
+        pred_cnt = jnp.zeros(num_classes, jnp.float32).at[p].add(ones)
+        lab_cnt = jnp.zeros(num_classes, jnp.float32).at[l].add(ones)
+        correct = jnp.zeros(num_classes, jnp.float32).at[p].add(
+            (p == l).astype(jnp.float32))
+        union = pred_cnt + lab_cnt - correct
+        present = union > 0
+        iou = jnp.where(present, correct / jnp.maximum(union, 1.0), 0.0)
+        miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
+        wrong = (lab_cnt - correct).astype(jnp.int32)
+        return miou, wrong, correct.astype(jnp.int32)
+
+    m, w, c = apply(fn, _t(input).detach(), _t(label).detach())
+    for t in (m, w, c):
+        t.stop_gradient = True
+    return m, w, c

@@ -27,7 +27,7 @@ from .layer.activation import (  # noqa: E402,F401
 from .layer.common import (  # noqa: E402,F401
     AlphaDropout, Bilinear, CosineSimilarity, Dropout, Dropout2D, Dropout3D, Embedding,
     Flatten, Identity, LayerList, Linear, Pad1D, Pad2D, Pad3D, PairwiseDistance,
-    ParameterList, PixelShuffle, PixelUnshuffle, Sequential, Unfold, Upsample,
+    ChannelShuffle, Fold, ParameterList, PixelShuffle, PixelUnshuffle, Sequential, Unfold, Upsample,
     UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D,
 )
 from .layer.conv import (  # noqa: E402,F401

@@ -260,6 +260,41 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     return apply(fn, _t(x))
 
 
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """operators/fold (col2im) parity — inverse of unfold: [b, c*kh*kw, L]
+    patches scatter-added back into [b, c, H, W] (overlaps accumulate)."""
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    oh_out, ow_out = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings) if not (isinstance(paddings, (list, tuple)) and len(paddings) == 4) else (paddings[0], paddings[1])
+    dh, dw = _pair(dilations)
+    out_h = (oh_out + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    out_w = (ow_out + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    def fn(v):
+        b, ckk, L = v.shape
+        c = ckk // (kh * kw)
+        v = v.reshape(b, c, kh * kw, out_h, out_w)
+        canvas = jnp.zeros((b, c, oh_out + 2 * ph, ow_out + 2 * pw), v.dtype)
+        idx = 0
+        for i in range(kh):
+            for j in range(kw):
+                patch = v[:, :, idx]                      # [b, c, oh, ow]
+                # strided scatter-add of this kernel tap
+                canvas = canvas.at[
+                    :, :, i * dh : i * dh + out_h * sh : sh,
+                    j * dw : j * dw + out_w * sw : sw].add(patch)
+                idx += 1
+        return canvas[:, :, ph : ph + oh_out, pw : pw + ow_out]
+
+    return apply(fn, _t(x))
+
+
 def one_hot(x, num_classes, name=None):
     out = apply(lambda v: jax.nn.one_hot(v.astype(jnp.int32), num_classes, dtype=jnp.float32), _t(x).detach())
     return out

@@ -179,3 +179,97 @@ def gather_tree(ids, parents):
         return rows[::-1]
 
     return apply(fn, _t(ids), _t(parents))
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Batched Levenshtein distance (reference operators/edit_distance_op.cc).
+
+    input/label: [B, Th]/[B, Tr] padded int token tensors with
+    input_length/label_length [B]; without lengths the full padded rows count.
+    TPU design: one lax.scan over hypothesis positions carrying the whole
+    [B, Tr+1] DP row — batch and reference dims stay vectorized; ignored
+    tokens are compacted out host-side (they change sequence lengths).
+    Returns (distances [B, 1] float32, sequence_num [1] int64).
+    """
+    hyp = np.asarray(_t(input)._data)
+    ref = np.asarray(_t(label)._data)
+    hl = (np.asarray(_t(input_length)._data) if input_length is not None
+          else np.full(hyp.shape[0], hyp.shape[1]))
+    rl = (np.asarray(_t(label_length)._data) if label_length is not None
+          else np.full(ref.shape[0], ref.shape[1]))
+    if ignored_tokens:
+        ig = set(int(t) for t in np.atleast_1d(ignored_tokens))
+
+        def compact(mat, lens):
+            out = np.zeros_like(mat)
+            new_lens = np.zeros_like(lens)
+            for i in range(mat.shape[0]):
+                row = [t for t in mat[i, : int(lens[i])] if int(t) not in ig]
+                out[i, : len(row)] = row
+                new_lens[i] = len(row)
+            return out, new_lens
+
+        hyp, hl = compact(hyp, hl)
+        ref, rl = compact(ref, rl)
+
+    B, Th = hyp.shape
+    Tr = ref.shape[1]
+    hyp_j = jnp.asarray(hyp)
+    ref_j = jnp.asarray(ref)
+    hl_j = jnp.asarray(hl.astype(np.int32))
+    rl_j = jnp.asarray(rl.astype(np.int32))
+
+    def fn(hv, rv, hlen, rlen):
+        cols = jnp.arange(Tr + 1, dtype=jnp.float32)
+        # dp row for 0 hyp tokens: distance = min(j, rlen) capped at valid region
+        row0 = jnp.broadcast_to(cols, (B, Tr + 1))
+
+        def step(row, i):
+            # new_row[0] = i+1
+            sub_cost = (hv[:, i][:, None] != rv).astype(jnp.float32)  # [B, Tr]
+            # scan over columns is inherent to Levenshtein; do the standard
+            # trick: new[j] = min(row[j]+1, new[j-1]+1, row[j-1]+cost) needs the
+            # sequential new[j-1]; use associative min-plus prefix instead:
+            # new[j] >= min over k<=j of (base[k] + (j-k)) where
+            # base[k] = min(row[k]+1 [del], row[k-1]+cost[k] [sub]) at column k
+            del_or_sub = jnp.minimum(row[:, 1:] + 1.0, row[:, :-1] + sub_cost)
+            base = jnp.concatenate(
+                [jnp.full((B, 1), i + 1.0), del_or_sub], axis=1)  # [B, Tr+1]
+            # prefix min of (base[k] - k), then add j  == min-plus with ins cost
+            shifted = base - cols[None, :]
+            prefix = jax.lax.associative_scan(jnp.minimum, shifted, axis=1)
+            new_row = prefix + cols[None, :]
+            keep = (i < hlen)[:, None]
+            return jnp.where(keep, new_row, row), None
+
+        row_final, _ = jax.lax.scan(step, row0, jnp.arange(Th))
+        dist = jnp.take_along_axis(row_final, rlen[:, None].astype(jnp.int32),
+                                   axis=1)[:, 0]
+        if normalized:
+            dist = dist / jnp.maximum(rlen.astype(jnp.float32), 1.0)
+        return dist[:, None]
+
+    out = apply(fn, Tensor(hyp_j).detach(), Tensor(ref_j).detach(),
+                Tensor(hl_j).detach(), Tensor(rl_j).detach())
+    out.stop_gradient = True
+    from ...core.tensor import Tensor as _T
+
+    return out, _T(jnp.asarray([B], dtype=jnp.int64))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """channel_shuffle_op parity: regroup channels [N, g*c, H, W] ->
+    interleave across groups (transpose trick)."""
+    def fn(v):
+        if data_format == "NCHW":
+            n, ch, h, w = v.shape
+            v = v.reshape(n, groups, ch // groups, h, w)
+            v = jnp.swapaxes(v, 1, 2)
+            return v.reshape(n, ch, h, w)
+        n, h, w, ch = v.shape
+        v = v.reshape(n, h, w, groups, ch // groups)
+        v = jnp.swapaxes(v, 3, 4)
+        return v.reshape(n, h, w, ch)
+
+    return apply(fn, _t(x))

@@ -215,6 +215,30 @@ class Unfold(Layer):
         return F.unfold(x, *self.args)
 
 
+class Fold(Layer):
+    """Inverse of Unfold (col2im; reference fold/col2im kernels)."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.args = (output_sizes, kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self.args)
+
+
+class ChannelShuffle(Layer):
+    """channel_shuffle_op parity (ShuffleNet block primitive)."""
+
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
 class Identity(Layer):
     def __init__(self, *args, **kwargs):
         super().__init__()

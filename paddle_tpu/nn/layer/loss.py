@@ -143,7 +143,25 @@ class TripletMarginLoss(Layer):
 
 
 class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid layer (python/paddle/nn/layer/loss.py HSigmoidLoss,
+    operators/hierarchical_sigmoid_op.cc). Default complete-binary-tree over
+    num_classes; is_custom=True expects (path_table, path_code) at call time."""
+
     def __init__(self, feature_size, num_classes, weight_attr=None, bias_attr=None,
                  is_custom=False, is_sparse=False, name=None):
         super().__init__()
-        raise NotImplementedError("HSigmoidLoss: deferred (hierarchical softmax)")
+        if num_classes < 2 and not is_custom:
+            raise ValueError("num_classes must be >= 2 for the default tree")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        n_nodes = num_classes - 1 if not is_custom else num_classes
+        self.weight = self.create_parameter(
+            shape=[n_nodes, feature_size], attr=weight_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter(shape=[n_nodes], attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table=path_table,
+                               path_code=path_code)

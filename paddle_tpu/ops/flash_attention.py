@@ -10,10 +10,13 @@ sequences fit. The forward also emits the per-row logsumexp; the backward
 recomputes P = exp(S - L) blockwise (dq kernel and dk/dv kernel), never
 materializing the [s, s] matrix in HBM.
 
-Supported: head_dim % 64 == 0, seq % 128 == 0, fp32/bf16, seq >= 4096 — below
-that XLA's fused attention is faster on-chip (measured 53.8k vs 47.8k GPT-2
-tokens/s at s=1024); flash earns its keep where the naive [s, s] score
-materialization dominates HBM. `interpret=True` runs the kernels on CPU.
+Supported: head_dim % 64 == 0, seq % 128 == 0, fp32/bf16, seq >= 1024. Block
+sizes adapt to seq (largest of 512/256/128 dividing it): 512-wide blocks keep
+the MXU fed ([512, d] @ [d, 512] tiles) and cut grid-step overhead — measured
+GPT-2-small full-train-step throughput at s=1024 on one v5e chip: 115.5k tok/s
+(blk 512) vs 93.2k (blk 256) vs 63.1k (blk 128) vs 70.5k for XLA's fused
+attention. Below s=1024 the [s, s] materialization XLA does is cheap enough
+that flash doesn't pay. `interpret=True` runs the kernels on CPU.
 
 Hand-rolled rather than importing jax.experimental.pallas.ops.tpu.flash_attention
 deliberately: the framework owns its hot kernels end-to-end (same reason the
@@ -27,9 +30,15 @@ import math
 import jax
 import jax.numpy as jnp
 
-_BLOCK_Q = 128
-_BLOCK_K = 128
 _NEG = -1e30
+
+
+def _block_for(s):
+    """Largest MXU-friendly block (512/256/128) that tiles seq exactly."""
+    for blk in (512, 256, 128):
+        if s % blk == 0:
+            return blk
+    raise ValueError(f"seq {s} not divisible by 128")
 
 
 def _on_tpu():
@@ -46,7 +55,7 @@ def supported(q_shape, dtype_str):
     b, s, h, d = q_shape
     if not _on_tpu():
         return False
-    if d % 64 != 0 or s % _BLOCK_Q != 0 or s < 4096:
+    if d % 64 != 0 or s % 128 != 0 or s < 1024:
         return False
     if dtype_str not in ("float32", "bfloat16"):
         return False
@@ -75,17 +84,16 @@ def _lse_index(causal):
 
 
 def _causal_mask(qi, ki, scores):
-    q_pos = qi * _BLOCK_Q + jax.lax.broadcasted_iota(
-        jnp.int32, (_BLOCK_Q, _BLOCK_K), 0)
-    k_pos = ki * _BLOCK_K + jax.lax.broadcasted_iota(
-        jnp.int32, (_BLOCK_Q, _BLOCK_K), 1)
+    bq, bk = scores.shape
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     return jnp.where(q_pos >= k_pos, scores, _NEG)
 
 
 # ---------------- forward kernel ---------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                causal, scale, n_k, d):
+                causal, scale, n_k, d, blk):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -93,9 +101,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(ki == 0)
     def _init():
-        acc_ref[...] = jnp.zeros((_BLOCK_Q, d), jnp.float32)
-        m_ref[...] = jnp.full((_BLOCK_Q, 128), _NEG, jnp.float32)
-        l_ref[...] = jnp.zeros((_BLOCK_Q, 128), jnp.float32)
+        acc_ref[...] = jnp.zeros((blk, d), jnp.float32)
+        m_ref[...] = jnp.full((blk, 128), _NEG, jnp.float32)
+        l_ref[...] = jnp.zeros((blk, 128), jnp.float32)
 
     run = (ki <= qi) if causal else (ki >= 0)
 
@@ -110,12 +118,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         m_prev = m_ref[...]                                   # [BQ, 128]
         l_prev = l_ref[...]
         m_cur = jnp.broadcast_to(jnp.max(scores, -1, keepdims=True),
-                                 (_BLOCK_Q, 128))
+                                 (blk, 128))
         m_next = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_next)                      # [BQ, 128]
         p = jnp.exp(scores - m_next[:, :1])                   # [BQ, BK]
         l_ref[...] = alpha * l_prev + jnp.broadcast_to(
-            jnp.sum(p, -1, keepdims=True), (_BLOCK_Q, 128))
+            jnp.sum(p, -1, keepdims=True), (blk, 128))
         m_ref[...] = m_next
         acc_ref[...] = acc_ref[...] * alpha[:, :1] + p @ v_blk
 
@@ -123,7 +131,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
     def _flush():
         l = l_ref[:, :1]                                      # [BQ, 1]
         o_ref[...] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[...] = (m_ref[:, :1] + jnp.log(l)).reshape(1, _BLOCK_Q)
+        lse_ref[...] = (m_ref[:, :1] + jnp.log(l)).reshape(1, blk)
 
 
 def _flash_fwd(q3, k3, v3, causal, scale, interpret):
@@ -133,27 +141,29 @@ def _flash_fwd(q3, k3, v3, causal, scale, interpret):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, s, d = q3.shape
-    n_q, n_k = s // _BLOCK_Q, s // _BLOCK_K
+    blk = _block_for(s)
+    n_q, n_k = s // blk, s // blk
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, causal=causal, scale=scale, n_k=n_k, d=d),
+        functools.partial(_fwd_kernel, causal=causal, scale=scale, n_k=n_k,
+                          d=d, blk=blk),
         grid=(bh, n_q, n_k),
         in_specs=[
-            BlockSpec((None, _BLOCK_Q, d), lambda b, qi, ki: (b, qi, 0)),
-            BlockSpec((None, _BLOCK_K, d), _kv_index(causal)),
-            BlockSpec((None, _BLOCK_K, d), _kv_index(causal)),
+            BlockSpec((None, blk, d), lambda b, qi, ki: (b, qi, 0)),
+            BlockSpec((None, blk, d), _kv_index(causal)),
+            BlockSpec((None, blk, d), _kv_index(causal)),
         ],
         out_specs=[
-            BlockSpec((None, _BLOCK_Q, d), lambda b, qi, ki: (b, qi, 0)),
-            BlockSpec((None, 1, _BLOCK_Q), lambda b, qi, ki: (b, 0, qi)),
+            BlockSpec((None, blk, d), lambda b, qi, ki: (b, qi, 0)),
+            BlockSpec((None, 1, blk), lambda b, qi, ki: (b, 0, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
             jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((_BLOCK_Q, d), jnp.float32),
-            pltpu.VMEM((_BLOCK_Q, 128), jnp.float32),
-            pltpu.VMEM((_BLOCK_Q, 128), jnp.float32),
+            pltpu.VMEM((blk, d), jnp.float32),
+            pltpu.VMEM((blk, 128), jnp.float32),
+            pltpu.VMEM((blk, 128), jnp.float32),
         ],
         interpret=interpret,
     )(q3, k3, v3)
@@ -163,7 +173,7 @@ def _flash_fwd(q3, k3, v3, causal, scale, interpret):
 # ---------------- backward kernels -------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc_ref, *, causal, scale, n_k, d):
+               dq_acc_ref, *, causal, scale, n_k, d, blk):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -171,7 +181,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(ki == 0)
     def _init():
-        dq_acc_ref[...] = jnp.zeros((_BLOCK_Q, d), jnp.float32)
+        dq_acc_ref[...] = jnp.zeros((blk, d), jnp.float32)
 
     run = (ki <= qi) if causal else (ki >= 0)
 
@@ -181,8 +191,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k_blk = k_ref[...].astype(jnp.float32)
         v_blk = v_ref[...].astype(jnp.float32)
         do_blk = do_ref[...].astype(jnp.float32)              # [BQ, d]
-        lse = lse_ref[...].reshape(_BLOCK_Q, 1)
-        delta = delta_ref[...].reshape(_BLOCK_Q, 1)
+        lse = lse_ref[...].reshape(blk, 1)
+        delta = delta_ref[...].reshape(blk, 1)
         scores = q_blk @ k_blk.T                              # [BQ, BK]
         if causal:
             scores = _causal_mask(qi, ki, scores)
@@ -197,7 +207,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                dk_acc_ref, dv_acc_ref, *, causal, scale, n_q, d):
+                dk_acc_ref, dv_acc_ref, *, causal, scale, n_q, d, blk):
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(1)
@@ -205,8 +215,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
     @pl.when(qi == 0)
     def _init():
-        dk_acc_ref[...] = jnp.zeros((_BLOCK_K, d), jnp.float32)
-        dv_acc_ref[...] = jnp.zeros((_BLOCK_K, d), jnp.float32)
+        dk_acc_ref[...] = jnp.zeros((blk, d), jnp.float32)
+        dv_acc_ref[...] = jnp.zeros((blk, d), jnp.float32)
 
     run = (qi >= ki) if causal else (qi >= 0)
 
@@ -216,8 +226,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         k_blk = k_ref[...].astype(jnp.float32)                # [BK, d]
         v_blk = v_ref[...].astype(jnp.float32)
         do_blk = do_ref[...].astype(jnp.float32)
-        lse = lse_ref[...].reshape(_BLOCK_Q, 1)
-        delta = delta_ref[...].reshape(_BLOCK_Q, 1)
+        lse = lse_ref[...].reshape(blk, 1)
+        delta = delta_ref[...].reshape(blk, 1)
         scores = q_blk @ k_blk.T                              # [BQ, BK]
         if causal:
             scores = _causal_mask(qi, ki, scores)
@@ -239,51 +249,54 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, scale, interpret):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, s, d = q3.shape
-    n_q, n_k = s // _BLOCK_Q, s // _BLOCK_K
+    blk = _block_for(s)
+    n_q, n_k = s // blk, s // blk
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1)                                  # [bh, s]
     lse2 = lse[:, None, :]                                    # [bh, 1, s]
     delta2 = delta[:, None, :]
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, causal=causal, scale=scale, n_k=n_k, d=d),
+        functools.partial(_dq_kernel, causal=causal, scale=scale, n_k=n_k,
+                          d=d, blk=blk),
         grid=(bh, n_q, n_k),
         in_specs=[
-            BlockSpec((None, _BLOCK_Q, d), lambda b, qi, ki: (b, qi, 0)),
-            BlockSpec((None, _BLOCK_K, d), _kv_index(causal)),
-            BlockSpec((None, _BLOCK_K, d), _kv_index(causal)),
-            BlockSpec((None, _BLOCK_Q, d), lambda b, qi, ki: (b, qi, 0)),
-            BlockSpec((None, 1, _BLOCK_Q), lambda b, qi, ki: (b, 0, qi)),
-            BlockSpec((None, 1, _BLOCK_Q), lambda b, qi, ki: (b, 0, qi)),
+            BlockSpec((None, blk, d), lambda b, qi, ki: (b, qi, 0)),
+            BlockSpec((None, blk, d), _kv_index(causal)),
+            BlockSpec((None, blk, d), _kv_index(causal)),
+            BlockSpec((None, blk, d), lambda b, qi, ki: (b, qi, 0)),
+            BlockSpec((None, 1, blk), lambda b, qi, ki: (b, 0, qi)),
+            BlockSpec((None, 1, blk), lambda b, qi, ki: (b, 0, qi)),
         ],
-        out_specs=BlockSpec((None, _BLOCK_Q, d), lambda b, qi, ki: (b, qi, 0)),
+        out_specs=BlockSpec((None, blk, d), lambda b, qi, ki: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
-        scratch_shapes=[pltpu.VMEM((_BLOCK_Q, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((blk, d), jnp.float32)],
         interpret=interpret,
     )(q3, k3, v3, do3, lse2, delta2)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, causal=causal, scale=scale, n_q=n_q, d=d),
+        functools.partial(_dkv_kernel, causal=causal, scale=scale, n_q=n_q,
+                          d=d, blk=blk),
         grid=(bh, n_k, n_q),
         in_specs=[
-            BlockSpec((None, _BLOCK_Q, d), _q_index(causal)),
-            BlockSpec((None, _BLOCK_K, d), lambda b, ki, qi: (b, ki, 0)),
-            BlockSpec((None, _BLOCK_K, d), lambda b, ki, qi: (b, ki, 0)),
-            BlockSpec((None, _BLOCK_Q, d), _q_index(causal)),
-            BlockSpec((None, 1, _BLOCK_Q), _lse_index(causal)),
-            BlockSpec((None, 1, _BLOCK_Q), _lse_index(causal)),
+            BlockSpec((None, blk, d), _q_index(causal)),
+            BlockSpec((None, blk, d), lambda b, ki, qi: (b, ki, 0)),
+            BlockSpec((None, blk, d), lambda b, ki, qi: (b, ki, 0)),
+            BlockSpec((None, blk, d), _q_index(causal)),
+            BlockSpec((None, 1, blk), _lse_index(causal)),
+            BlockSpec((None, 1, blk), _lse_index(causal)),
         ],
         out_specs=[
-            BlockSpec((None, _BLOCK_K, d), lambda b, ki, qi: (b, ki, 0)),
-            BlockSpec((None, _BLOCK_K, d), lambda b, ki, qi: (b, ki, 0)),
+            BlockSpec((None, blk, d), lambda b, ki, qi: (b, ki, 0)),
+            BlockSpec((None, blk, d), lambda b, ki, qi: (b, ki, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
             jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((_BLOCK_K, d), jnp.float32),
-            pltpu.VMEM((_BLOCK_K, d), jnp.float32),
+            pltpu.VMEM((blk, d), jnp.float32),
+            pltpu.VMEM((blk, d), jnp.float32),
         ],
         interpret=interpret,
     )(q3, k3, v3, do3, lse2, delta2)

@@ -421,3 +421,70 @@ def cast(x, dtype):
     """Parity: paddle.cast (cast_op.cc) — delegates to Tensor.astype (same
     dispatch + autograd path)."""
     return _t(x).astype(dtype)
+
+
+def reverse(x, axis, name=None):
+    """fluid.layers.reverse parity (reverse_op.cc) — alias of flip."""
+    return flip(x, axis)
+
+
+def index_add(x, index, axis, value, name=None):
+    """index_add_op parity: x with value rows scatter-added at `index` along
+    `axis` (XLA scatter-add; duplicate indices accumulate)."""
+    def fn(xv, iv, vv):
+        perm = None
+        if axis != 0:
+            perm = list(range(xv.ndim))
+            perm[0], perm[axis] = perm[axis], perm[0]
+            xv = jnp.transpose(xv, perm)
+            vv = jnp.transpose(vv, perm)
+        out = xv.at[iv.astype(jnp.int32)].add(vv)
+        if perm is not None:
+            out = jnp.transpose(out, perm)
+        return out
+
+    return apply(fn, _t(x), _t(index).detach(), _t(value))
+
+
+def index_add_(x, index, axis, value, name=None):
+    def fn(xv, iv, vv):
+        perm = None
+        if axis != 0:
+            perm = list(range(xv.ndim))
+            perm[0], perm[axis] = perm[axis], perm[0]
+            xv = jnp.transpose(xv, perm)
+            vv = jnp.transpose(vv, perm)
+        out = xv.at[iv.astype(jnp.int32)].add(vv)
+        if perm is not None:
+            out = jnp.transpose(out, perm)
+        return out
+
+    return apply_inplace(fn, _t(x), _t(index).detach(), _t(value))
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    """diag_embed_op parity (same impl as nn.functional.extension.diag_embed,
+    exported at paddle.* level like the reference)."""
+    from ..nn.functional.extension import diag_embed as _de
+
+    return _de(input, offset=offset, dim1=dim1, dim2=dim2)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Tensor.unfold parity (sliding windows along `axis`): returns a view-like
+    tensor with a trailing window dim of `size`, windows spaced by `step`."""
+    def fn(v):
+        ax = axis % v.ndim
+        n = (v.shape[ax] - size) // step + 1
+        starts = jnp.arange(n) * step
+        idx = starts[:, None] + jnp.arange(size)[None, :]       # [n, size]
+        win = jnp.take(v, idx.reshape(-1), axis=ax)
+        shp = list(v.shape)
+        shp[ax:ax + 1] = [n, size]
+        win = win.reshape(shp)
+        # paddle puts the window dim last
+        perm = list(range(len(shp)))
+        perm.append(perm.pop(ax + 1))
+        return jnp.transpose(win, perm)
+
+    return apply(fn, _t(x))

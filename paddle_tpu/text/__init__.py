@@ -1,3 +1,4 @@
-"""paddle.text parity (python/paddle/text/datasets)."""
+"""paddle.text parity (python/paddle/text/datasets + viterbi/CRF ops)."""
 from . import datasets  # noqa: F401
 from .datasets import Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16, Conll05st  # noqa: F401
+from .viterbi import ViterbiDecoder, linear_chain_crf, viterbi_decode  # noqa: F401

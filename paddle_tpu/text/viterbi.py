@@ -1,0 +1,136 @@
+"""Viterbi decoding + linear-chain CRF (reference operators/crf_decoding_op.cc,
+linear_chain_crf_op.cc; 2.x API paddle.text.viterbi_decode / ViterbiDecoder).
+
+TPU design: one lax.scan over time carrying the [B, T] score lattice (decode
+keeps the [B, T] argmax backpointers per step and backtraces with a second
+scan) — batch and tag dims stay vectorized, sequence lengths are masks, no
+LoD. The CRF loss is fully differentiable (logsumexp forward algorithm), so
+grads for emission AND transition come from XLA autodiff instead of the
+reference's hand-written linear_chain_crf_grad kernel.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """potentials [B, L, T], transition [T, T], lengths [B] ->
+    (scores [B], paths [B, L]).  With include_bos_eos_tag, tag T-2 is BOS
+    (adds its transition row at t=0) and T-1 is EOS (added at sequence end),
+    matching paddle.text.viterbi_decode."""
+    pot = _t(potentials)
+    trans = _t(transition_params)
+    lens = _t(lengths).detach()
+
+    def fn(pv, tv, lv):
+        B, L, T = pv.shape
+        lv = lv.astype(jnp.int32)
+        if include_bos_eos_tag:
+            init = pv[:, 0] + tv[T - 2][None, :]
+        else:
+            init = pv[:, 0]
+
+        def step(carry, t):
+            score = carry                                   # [B, T]
+            cand = score[:, :, None] + tv[None, :, :]       # [B, from, to]
+            best = jnp.max(cand, axis=1) + pv[:, t]         # [B, T]
+            ptr = jnp.argmax(cand, axis=1).astype(jnp.int32)
+            live = (t < lv)[:, None]
+            new_score = jnp.where(live, best, score)
+            # dead steps backtrace to themselves (identity pointer)
+            ptr = jnp.where(live, ptr, jnp.arange(T, dtype=jnp.int32)[None, :])
+            return new_score, ptr
+
+        score, ptrs = jax.lax.scan(step, init, jnp.arange(1, L))  # ptrs [L-1, B, T]
+        if include_bos_eos_tag:
+            score = score + tv[:, T - 1][None, :]
+        last_tag = jnp.argmax(score, axis=1).astype(jnp.int32)    # [B]
+        best_score = jnp.max(score, axis=1)
+
+        def back(carry, t):
+            tag = carry                                     # [B]
+            prev = jnp.take_along_axis(ptrs[t], tag[:, None], axis=1)[:, 0]
+            return prev, prev
+
+        _, rev = jax.lax.scan(back, last_tag, jnp.arange(L - 2, -1, -1))
+        path = jnp.concatenate([rev[::-1].T, last_tag[:, None]], axis=1)  # [B, L]
+        # positions past each length repeat the final valid tag upstream; mask
+        # them to the tag at their own position like the reference (truncated)
+        pos = jnp.arange(L)[None, :]
+        path = jnp.where(pos < lv[:, None], path, 0)
+        return best_score, path.astype(jnp.int64)
+
+    s, p = apply(fn, pot.detach(), trans.detach(), lens)
+    s.stop_gradient = True
+    p.stop_gradient = True
+    return s, p
+
+
+class ViterbiDecoder:
+    """paddle.text.ViterbiDecoder parity (callable layer-style wrapper)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = _t(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+def linear_chain_crf(emission, transition, label, length=None):
+    """linear_chain_crf_op.cc parity, padded-batch form.
+
+    emission [B, L, T]; transition [(T+2), T] — row 0 start weights, row 1 stop
+    weights, rows 2.. the [T, T] tag-to-tag matrix (the reference's layout);
+    label [B, L] int; length [B] (None = full rows). Returns per-sequence
+    negative log-likelihood [B, 1] = log Z - gold score, differentiable wrt
+    emission and transition.
+    """
+    em = _t(emission)
+    tr = _t(transition)
+    lab = _t(label).detach()
+    B, L, T = em.shape
+    if length is None:
+        length = np.full((B,), L, np.int32)
+    lens = _t(length).detach()
+
+    def fn(ev, tv, yv, lv):
+        start, stop, mat = tv[0], tv[1], tv[2:]
+        lv = lv.astype(jnp.int32)
+        yv = yv.astype(jnp.int32)
+        mask = (jnp.arange(L)[None, :] < lv[:, None]).astype(ev.dtype)  # [B, L]
+
+        # --- log partition (forward algorithm) ---
+        alpha = start[None, :] + ev[:, 0]                   # [B, T]
+
+        def fwd(carry, t):
+            a = carry
+            nxt = jax.nn.logsumexp(a[:, :, None] + mat[None, :, :], axis=1) + ev[:, t]
+            live = (t < lv)[:, None]
+            return jnp.where(live, nxt, a), None
+
+        alpha, _ = jax.lax.scan(fwd, alpha, jnp.arange(1, L))
+        logz = jax.nn.logsumexp(alpha + stop[None, :], axis=1)  # [B]
+
+        # --- gold path score ---
+        em_score = jnp.sum(
+            jnp.take_along_axis(ev, yv[:, :, None], axis=2)[:, :, 0] * mask,
+            axis=1)
+        pair_live = mask[:, 1:]                              # [B, L-1]
+        tr_score = jnp.sum(
+            mat[yv[:, :-1], yv[:, 1:]] * pair_live, axis=1)
+        last_idx = jnp.maximum(lv - 1, 0)
+        last_tag = jnp.take_along_axis(yv, last_idx[:, None], axis=1)[:, 0]
+        gold = em_score + tr_score + start[yv[:, 0]] + stop[last_tag]
+        return (logz - gold)[:, None]
+
+    return apply(fn, em, tr, lab, lens)

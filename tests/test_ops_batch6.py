@@ -1,0 +1,512 @@
+"""Op-test burn-down, batch 6: the round-2 gap families — hierarchical/ranking/
+distillation losses, CRF + viterbi, edit distance, fold/channel_shuffle,
+index_add/segment reductions, and the detection ops (iou_similarity,
+bipartite_match, roi_pool, psroi_pool, matrix_nms, distribute_fpn_proposals,
+generate_proposals, deform_conv2d). Reference: operators/{hierarchical_sigmoid,
+hinge_loss,rank_loss,teacher_student_sigmoid_loss,edit_distance,
+linear_chain_crf,crf_decoding}_op.cc + operators/detection/."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+from paddle_tpu.vision import ops as V
+
+from op_test import OpTest
+
+rng = np.random.RandomState(42)
+
+
+def _randn(*shape):
+    return rng.randn(*shape).astype(np.float32)
+
+
+def _softplus(x):
+    return np.maximum(x, 0) + np.log1p(np.exp(-np.abs(x)))
+
+
+X2 = _randn(4, 5)
+Y01 = rng.randint(0, 2, (4, 5)).astype(np.float32)
+
+# --- simple elementwise losses -------------------------------------------
+
+CASES = [
+    ("hinge_loss", F.hinge_loss, {"input": X2, "label": Y01}, {},
+     [np.maximum(0, 1 - (2 * Y01 - 1) * X2)], ["input"]),
+    ("rank_loss", F.rank_loss,
+     {"label": Y01[:, :1], "left": X2[:, :1], "right": X2[:, 1:2]}, {},
+     [_softplus(X2[:, :1] - X2[:, 1:2]) - Y01[:, :1] * (X2[:, :1] - X2[:, 1:2])
+      + np.minimum(X2[:, :1] - X2[:, 1:2], 0) * 0],
+     ["left", "right"]),
+    ("dice_loss", F.dice_loss,
+     {"input": np.abs(_randn(3, 4)) + 0.1,
+      "label": rng.randint(0, 4, (3, 1)).astype(np.int64)}, {}, None,
+     ["input"]),
+    ("channel_shuffle", F.channel_shuffle,
+     {"x": _randn(1, 6, 3, 3)}, {"groups": 3},
+     [None],  # filled below from the numpy reference
+     ["x"]),
+]
+
+
+def _channel_shuffle_np(x, groups):
+    n, c, h, w = x.shape
+    return x.reshape(n, groups, c // groups, h, w).swapaxes(1, 2).reshape(n, c, h, w)
+
+
+CASES[3] = ("channel_shuffle", F.channel_shuffle,
+            {"x": CASES[3][2]["x"]}, {"groups": 3},
+            [_channel_shuffle_np(CASES[3][2]["x"], 3)], ["x"])
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c[0] for c in CASES])
+def test_op(case):
+    name, op, inputs, attrs, outputs, grad_inputs = case
+    t = OpTest()
+    t.op = op
+    t.inputs = inputs
+    t.attrs = attrs
+    t.outputs = outputs
+    if outputs is not None:
+        t.check_output(atol=1e-4, rtol=1e-4)
+    if grad_inputs:
+        t.check_grad(grad_inputs)
+
+
+def test_teacher_student_sigmoid_loss():
+    x = _randn(6)
+    lab = np.array([-2.0, -1.0, 0.0, 0.4, 1.0, 1.9], np.float32)
+    got = np.asarray(F.teacher_student_sigmoid_loss(
+        paddle.to_tensor(x), paddle.to_tensor(lab))._data)
+    sp = _softplus(x)
+    exp = np.empty_like(x)
+    for i, y in enumerate(lab):
+        if y < -1:
+            exp[i] = sp[i]
+        elif y < 0:
+            exp[i] = sp[i] - x[i]
+        elif y < 1:
+            exp[i] = sp[i] + sp[i] - x[i] * y
+        else:
+            exp[i] = sp[i] - x[i] + sp[i] - x[i] * (y - 1)
+    np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-6)
+
+
+def test_hsigmoid_loss_default_tree():
+    NC, D = 7, 4
+    x = _randn(5, D)
+    w = _randn(NC - 1, D)
+    b = _randn(NC - 1)
+    lab = rng.randint(0, NC, (5,)).astype(np.int64)
+    got = np.asarray(F.hsigmoid_loss(
+        paddle.to_tensor(x), paddle.to_tensor(lab), NC, paddle.to_tensor(w),
+        paddle.to_tensor(b))._data).ravel()
+
+    def ref(xi, c):
+        total, bpos, leaf = 0.0, 0, c + NC
+        while (leaf >> (bpos + 1)) >= 1:
+            node = (leaf >> (bpos + 1)) - 1
+            bit = (leaf >> bpos) & 1
+            z = w[node] @ xi + b[node]
+            total += max(z, 0) - z * bit + np.log1p(np.exp(-abs(z)))
+            bpos += 1
+        return total
+
+    np.testing.assert_allclose(got, [ref(x[i], int(lab[i])) for i in range(5)],
+                               rtol=1e-4)
+
+
+def test_hsigmoid_loss_custom_path_and_grad():
+    # custom 3-node path per sample
+    x = paddle.to_tensor(_randn(2, 4))
+    x.stop_gradient = False
+    w = paddle.to_tensor(_randn(5, 4))
+    w.stop_gradient = False
+    table = np.array([[0, 2, 4], [1, 3, -1]], np.int64)
+    code = np.array([[1, 0, 1], [0, 1, 0]], np.int64)
+    out = F.hsigmoid_loss(x, paddle.to_tensor(np.array([0, 1])), 6, w,
+                          path_table=table, path_code=code)
+    out.sum().backward()
+    assert np.asarray(out._data).shape == (2, 1)
+    assert np.isfinite(np.asarray(x.grad._data)).all()
+    g = np.asarray(w.grad._data)
+    assert np.abs(g[4]).sum() > 0 and np.abs(g).sum() > 0
+    # padded (-1) node must get zero grad from row 1's path
+    assert np.isfinite(g).all()
+
+
+def test_edit_distance():
+    h = np.array([[1, 2, 3, 4], [5, 5, 5, 0]], np.int64)
+    r = np.array([[1, 3, 3, 0, 0], [5, 6, 0, 0, 0]], np.int64)
+    hl = np.array([4, 3])
+    rl = np.array([3, 2])
+    d, n = F.edit_distance(h, r, normalized=False, input_length=hl,
+                           label_length=rl)
+    got = np.asarray(d._data).ravel()
+
+    def lev(a, b):
+        dp = np.zeros((len(a) + 1, len(b) + 1))
+        dp[:, 0] = np.arange(len(a) + 1)
+        dp[0, :] = np.arange(len(b) + 1)
+        for i in range(1, len(a) + 1):
+            for j in range(1, len(b) + 1):
+                dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                               dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+        return dp[-1, -1]
+
+    np.testing.assert_allclose(got, [lev([1, 2, 3, 4], [1, 3, 3]),
+                                     lev([5, 5, 5], [5, 6])])
+    assert int(np.asarray(n._data)[0]) == 2
+    # normalized divides by reference length
+    dn, _ = F.edit_distance(h, r, normalized=True, input_length=hl,
+                            label_length=rl)
+    np.testing.assert_allclose(np.asarray(dn._data).ravel(), got / rl)
+    # ignored tokens are removed from both sides first
+    di, _ = F.edit_distance(h, r, normalized=False, ignored_tokens=[5],
+                            input_length=hl, label_length=rl)
+    np.testing.assert_allclose(np.asarray(di._data).ravel()[1],
+                               lev([], [6]))
+
+
+def test_fold_inverts_unfold():
+    x = _randn(2, 3, 6, 6)
+    u = F.unfold(paddle.to_tensor(x), 2, strides=2)
+    f = F.fold(u, (6, 6), 2, strides=2)
+    np.testing.assert_allclose(np.asarray(f._data), x, rtol=1e-6)
+    # overlapping windows accumulate: ones through unfold(3, stride 1, pad 1)
+    ones = np.ones((1, 1, 4, 4), np.float32)
+    u2 = F.unfold(paddle.to_tensor(ones), 3, strides=1, paddings=1)
+    f2 = np.asarray(F.fold(u2, (4, 4), 3, strides=1, paddings=1)._data)
+    assert f2[0, 0, 1, 1] > f2[0, 0, 0, 0]  # interior counted by more windows
+
+
+def test_index_add_and_segment():
+    x = paddle.to_tensor(np.zeros((4, 3), np.float32))
+    out = paddle.index_add(x, paddle.to_tensor(np.array([1, 1, 3])), 0,
+                           paddle.to_tensor(np.ones((3, 3), np.float32)))
+    np.testing.assert_allclose(np.asarray(out._data)[:, 0], [0, 2, 0, 1])
+
+    from paddle_tpu.incubate import segment_max, segment_mean, segment_sum
+
+    data = np.array([[1., 2.], [3., 4.], [10., 20.]], np.float32)
+    ids = np.array([0, 0, 2])
+    np.testing.assert_allclose(
+        np.asarray(segment_sum(data, ids)._data),
+        [[4, 6], [0, 0], [10, 20]])
+    np.testing.assert_allclose(
+        np.asarray(segment_mean(data, ids)._data),
+        [[2, 3], [0, 0], [10, 20]])
+    np.testing.assert_allclose(
+        np.asarray(segment_max(data, ids)._data),
+        [[3, 4], [0, 0], [10, 20]])
+
+
+def test_tensor_unfold_windows():
+    from paddle_tpu.tensor.manipulation import unfold as t_unfold
+
+    x = np.arange(10, dtype=np.float32)
+    got = np.asarray(t_unfold(paddle.to_tensor(x), 0, 4, 3)._data)
+    np.testing.assert_allclose(got, [[0, 1, 2, 3], [3, 4, 5, 6], [6, 7, 8, 9]])
+
+
+def test_viterbi_decode_bruteforce():
+    from paddle_tpu.text import viterbi_decode
+
+    B, L, T = 2, 4, 3
+    pot = _randn(B, L, T)
+    trans = _randn(T, T)
+    lens = np.array([4, 2], np.int32)
+    for include in (False, True):
+        s, p = viterbi_decode(paddle.to_tensor(pot), paddle.to_tensor(trans),
+                              paddle.to_tensor(lens),
+                              include_bos_eos_tag=include)
+        s, p = np.asarray(s._data), np.asarray(p._data)
+        for b in range(B):
+            ln = lens[b]
+            best, bestpath = -1e30, None
+            for path in itertools.product(range(T), repeat=int(ln)):
+                sc = pot[b, 0, path[0]] + (trans[T - 2, path[0]] if include else 0)
+                for t in range(1, ln):
+                    sc += trans[path[t - 1], path[t]] + pot[b, t, path[t]]
+                if include:
+                    sc += trans[path[-1], T - 1]
+                if sc > best:
+                    best, bestpath = sc, path
+            assert abs(best - s[b]) < 1e-4
+            assert tuple(p[b, :ln]) == bestpath
+
+
+def test_linear_chain_crf_bruteforce_and_grad():
+    from paddle_tpu.text import linear_chain_crf
+
+    B, L, T = 2, 4, 3
+    pot = _randn(B, L, T)
+    tr2 = _randn(T + 2, T)
+    lab = rng.randint(0, T, (B, L)).astype(np.int64)
+    lens = np.array([4, 3], np.int32)
+    em = paddle.to_tensor(pot)
+    em.stop_gradient = False
+    tt = paddle.to_tensor(tr2)
+    tt.stop_gradient = False
+    loss = linear_chain_crf(em, tt, paddle.to_tensor(lab),
+                            paddle.to_tensor(lens))
+    got = np.asarray(loss._data)
+    start, stop, mat = tr2[0], tr2[1], tr2[2:]
+    for b in range(B):
+        ln = lens[b]
+        scores = []
+        for path in itertools.product(range(T), repeat=int(ln)):
+            sc = start[path[0]] + pot[b, 0, path[0]]
+            for t in range(1, ln):
+                sc += mat[path[t - 1], path[t]] + pot[b, t, path[t]]
+            sc += stop[path[-1]]
+            scores.append(sc)
+        m = max(scores)
+        logz = np.log(np.sum(np.exp(np.array(scores) - m))) + m
+        gold = start[lab[b, 0]] + pot[b, 0, lab[b, 0]]
+        for t in range(1, ln):
+            gold += mat[lab[b, t - 1], lab[b, t]] + pot[b, t, lab[b, t]]
+        gold += stop[lab[b, ln - 1]]
+        assert abs((logz - gold) - got[b, 0]) < 1e-3
+    loss.sum().backward()
+    assert np.isfinite(np.asarray(em.grad._data)).all()
+    assert np.abs(np.asarray(tt.grad._data)).sum() > 0
+
+
+def test_mean_iou():
+    from paddle_tpu.metric import mean_iou
+
+    pred = np.array([0, 0, 1, 1, 2], np.int64)
+    lab = np.array([0, 1, 1, 1, 0], np.int64)
+    m, wrong, correct = mean_iou(pred, lab, 3)
+    # class 0: correct 1, union 2+2-1=3 -> 1/3; class 1: correct 2, union 2+3-2=3
+    # -> 2/3; class 2: union 1 (pred only) -> 0; mean over present = 1/3
+    np.testing.assert_allclose(float(np.asarray(m._data)),
+                               (1 / 3 + 2 / 3 + 0) / 3, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(correct._data), [1, 2, 0])
+    np.testing.assert_allclose(np.asarray(wrong._data), [1, 1, 0])
+
+
+# --- detection family -----------------------------------------------------
+
+def _iou_np(a, b, off=0.0):
+    out = np.zeros((len(a), len(b)))
+    for i in range(len(a)):
+        for j in range(len(b)):
+            ix = max(0.0, min(a[i, 2], b[j, 2]) - max(a[i, 0], b[j, 0]) + off)
+            iy = max(0.0, min(a[i, 3], b[j, 3]) - max(a[i, 1], b[j, 1]) + off)
+            inter = ix * iy
+            ar_a = max(0, a[i, 2] - a[i, 0] + off) * max(0, a[i, 3] - a[i, 1] + off)
+            ar_b = max(0, b[j, 2] - b[j, 0] + off) * max(0, b[j, 3] - b[j, 1] + off)
+            u = ar_a + ar_b - inter
+            out[i, j] = inter / u if u > 0 else 0
+    return out
+
+
+def test_iou_similarity():
+    a = np.abs(_randn(5, 4))
+    a[:, 2:] += a[:, :2]
+    b = np.abs(_randn(6, 4))
+    b[:, 2:] += b[:, :2]
+    got = np.asarray(V.iou_similarity(paddle.to_tensor(a),
+                                      paddle.to_tensor(b))._data)
+    np.testing.assert_allclose(got, _iou_np(a, b), atol=1e-5)
+    got2 = np.asarray(V.iou_similarity(paddle.to_tensor(a), paddle.to_tensor(b),
+                                       box_normalized=False)._data)
+    np.testing.assert_allclose(got2, _iou_np(a, b, 1.0), atol=1e-5)
+
+
+def test_bipartite_match():
+    D = rng.rand(4, 6).astype(np.float32)
+    idx, dist = V.bipartite_match(paddle.to_tensor(D))
+    idx, dist = np.asarray(idx._data), np.asarray(dist._data)
+    d = D.copy()
+    exp_idx = -np.ones(6, np.int32)
+    exp_d = np.zeros(6)
+    for _ in range(4):
+        i, j = np.unravel_index(np.argmax(d), d.shape)
+        if d[i, j] <= 1e-6:
+            break
+        exp_idx[j] = i
+        exp_d[j] = D[i, j]
+        d[i, :] = -1
+        d[:, j] = -1
+    assert (idx == exp_idx).all()
+    np.testing.assert_allclose(dist, exp_d, atol=1e-6)
+    idx2, _ = V.bipartite_match(paddle.to_tensor(D),
+                                match_type="per_prediction",
+                                overlap_threshold=0.0)
+    assert (np.asarray(idx2._data) >= 0).all()
+
+
+def test_roi_pool():
+    x = _randn(2, 3, 8, 8)
+    rois = np.array([[0, 0, 4, 4], [1, 1, 6, 5], [2, 0, 7, 7]], np.float32)
+    bn = np.array([2, 1], np.int32)
+    got = np.asarray(V.roi_pool(paddle.to_tensor(x), paddle.to_tensor(rois),
+                                paddle.to_tensor(bn), 2, 1.0)._data)
+
+    def ref(feat, roi, ph_n=2, pw_n=2):
+        x1, y1, x2, y2 = [int(round(v)) for v in roi]
+        rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+        C, H, W = feat.shape
+        out = np.zeros((C, ph_n, pw_n), np.float32)
+        for ph in range(ph_n):
+            for pw in range(pw_n):
+                hs = max(int(np.floor(ph * rh / ph_n)) + y1, 0)
+                he = min(int(np.ceil((ph + 1) * rh / ph_n)) + y1, H)
+                ws = max(int(np.floor(pw * rw / pw_n)) + x1, 0)
+                we = min(int(np.ceil((pw + 1) * rw / pw_n)) + x1, W)
+                if he <= hs or we <= ws:
+                    continue
+                out[:, ph, pw] = feat[:, hs:he, ws:we].max(axis=(1, 2))
+        return out
+
+    exp = np.stack([ref(x[0], rois[0]), ref(x[0], rois[1]), ref(x[1], rois[2])])
+    np.testing.assert_allclose(got, exp, atol=1e-5)
+    # grad flows to the feature map
+    xt = paddle.to_tensor(x)
+    xt.stop_gradient = False
+    V.roi_pool(xt, paddle.to_tensor(rois), paddle.to_tensor(bn),
+               2).sum().backward()
+    assert np.abs(np.asarray(xt.grad._data)).sum() > 0
+
+
+def test_psroi_pool():
+    c_out, phn = 2, 2
+    x = np.ones((1, c_out * phn * phn, 6, 6), np.float32) * 3.0
+    rois = np.array([[0, 0, 5, 5]], np.float32)
+    got = np.asarray(V.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(rois),
+                                  paddle.to_tensor(np.array([1], np.int32)),
+                                  phn, 1.0)._data)
+    assert got.shape == (1, c_out, phn, phn)
+    np.testing.assert_allclose(got, 3.0)
+    # position sensitivity: channel block k feeds only bin k
+    x2 = np.zeros((1, c_out * phn * phn, 6, 6), np.float32)
+    x2[0, 0] = 7.0  # (c=0, ph=0, pw=0) block
+    got2 = np.asarray(V.psroi_pool(paddle.to_tensor(x2), paddle.to_tensor(rois),
+                                   paddle.to_tensor(np.array([1], np.int32)),
+                                   phn, 1.0)._data)
+    assert got2[0, 0, 0, 0] == pytest.approx(7.0)
+    assert np.abs(got2).sum() == pytest.approx(7.0)
+
+
+def test_matrix_nms():
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                       [20, 20, 30, 30]]], np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]
+    out, num = V.matrix_nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                            score_threshold=0.1, keep_top_k=3,
+                            background_label=0)
+    o = np.asarray(out._data)[0]
+    assert int(np.asarray(num._data)[0]) == 3
+    assert o[0, 1] == pytest.approx(0.9)       # top box undecayed
+    assert o[1, 1] == pytest.approx(0.7)       # distinct box ~undecayed
+    # linear decay of the overlapping box: s * (1-iou)/(1-0)
+    iou = _iou_np(boxes[0, :1], boxes[0, 1:2])[0, 0]
+    assert o[2, 1] == pytest.approx(0.8 * (1 - iou), rel=1e-4)
+    # gaussian decay
+    outg, _ = V.matrix_nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                           score_threshold=0.1, keep_top_k=3,
+                           use_gaussian=True, gaussian_sigma=2.0,
+                           background_label=0)
+    og = np.asarray(outg._data)[0]
+    assert og[2, 1] == pytest.approx(0.8 * np.exp(-(iou ** 2) * 2.0), rel=1e-4)
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0, 0, 16, 16], [0, 0, 64, 64], [0, 0, 224, 224],
+                     [0, 0, 500, 500]], np.float32)
+    multi, restore, nums = V.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224,
+        rois_num=paddle.to_tensor(np.array([4], np.int32)))
+    counts = [np.asarray(m._data).shape[0] for m in multi]
+    assert sum(counts) == 4
+    assert counts[0] >= 1 and counts[-1] >= 1  # smallest + largest split apart
+    # restore index maps concatenated-multi order back to input order
+    cat = np.concatenate([np.asarray(m._data) for m in multi if
+                          np.asarray(m._data).size], axis=0)
+    ri = np.asarray(restore._data).ravel()
+    np.testing.assert_allclose(cat[ri], rois)
+
+
+def test_generate_proposals():
+    H = W = 4
+    A = 2
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    for y in range(H):
+        for x in range(W):
+            anchors[y, x, 0] = [x * 8, y * 8, x * 8 + 8, y * 8 + 8]
+            anchors[y, x, 1] = [x * 8, y * 8, x * 8 + 16, y * 8 + 16]
+    var = np.ones((H, W, A, 4), np.float32)
+    sc = rng.rand(1, A, H, W).astype(np.float32)
+    dl = np.zeros((1, 4 * A, H, W), np.float32)  # zero deltas: rois == anchors
+    rois, rsc, num = V.generate_proposals(
+        paddle.to_tensor(sc), paddle.to_tensor(dl),
+        paddle.to_tensor(np.array([[32.0, 32.0]], np.float32)),
+        paddle.to_tensor(anchors), paddle.to_tensor(var),
+        pre_nms_top_n=32, post_nms_top_n=8, nms_thresh=0.8, min_size=1.0)
+    r = np.asarray(rois._data)[0]
+    s = np.asarray(rsc._data)[0]
+    n = int(np.asarray(num._data)[0])
+    assert r.shape == (8, 4) and 1 <= n <= 8
+    # scores sorted desc over the valid region
+    assert all(s[i] >= s[i + 1] for i in range(n - 1))
+    # every valid roi is a clipped anchor (zero deltas)
+    flat_anchors = anchors.reshape(-1, 4)
+    clipped = flat_anchors.copy()
+    clipped[:, 0::2] = np.clip(clipped[:, 0::2], 0, 32)
+    clipped[:, 1::2] = np.clip(clipped[:, 1::2], 0, 32)
+    for i in range(n):
+        assert any(np.allclose(r[i], c, atol=1e-4) for c in clipped)
+
+
+def test_deform_conv2d():
+    import jax
+    import jax.numpy as jnp
+
+    x = _randn(2, 4, 7, 7)
+    w = _randn(6, 4, 3, 3)
+    off0 = np.zeros((2, 18, 7, 7), np.float32)
+    got = np.asarray(V.deform_conv2d(paddle.to_tensor(x),
+                                     paddle.to_tensor(off0),
+                                     paddle.to_tensor(w), padding=1)._data)
+    exp = np.asarray(jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW")))
+    np.testing.assert_allclose(got, exp, atol=1e-3)
+    # modulated (v2): mask of ones is identity, mask of 0.5 halves the output
+    m1 = np.ones((2, 9, 7, 7), np.float32)
+    got2 = np.asarray(V.deform_conv2d(paddle.to_tensor(x),
+                                      paddle.to_tensor(off0),
+                                      paddle.to_tensor(w), padding=1,
+                                      mask=paddle.to_tensor(m1))._data)
+    np.testing.assert_allclose(got2, exp, atol=1e-3)
+    got3 = np.asarray(V.deform_conv2d(paddle.to_tensor(x),
+                                      paddle.to_tensor(off0),
+                                      paddle.to_tensor(w), padding=1,
+                                      mask=paddle.to_tensor(m1 * 0.5))._data)
+    np.testing.assert_allclose(got3, exp * 0.5, atol=1e-3)
+    # integer offset (+1, +1) == conv over shifted input (interior check)
+    off1 = np.ones((2, 18, 7, 7), np.float32)
+    got4 = np.asarray(V.deform_conv2d(paddle.to_tensor(x),
+                                      paddle.to_tensor(off1),
+                                      paddle.to_tensor(w), padding=1)._data)
+    x_shift = np.zeros_like(x)
+    x_shift[:, :, :-1, :-1] = x[:, :, 1:, 1:]
+    exp4 = np.asarray(jax.lax.conv_general_dilated(
+        jnp.asarray(x_shift), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW")))
+    np.testing.assert_allclose(got4[:, :, 1:-2, 1:-2], exp4[:, :, 1:-2, 1:-2],
+                               atol=1e-3)
+    # grads flow to x, offset, weight
+    xt, ot, wt = (paddle.to_tensor(v) for v in (x, off0 + 0.3, w))
+    for t in (xt, ot, wt):
+        t.stop_gradient = False
+    V.deform_conv2d(xt, ot, wt, padding=1).sum().backward()
+    for t in (xt, ot, wt):
+        assert np.isfinite(np.asarray(t.grad._data)).all()
+        assert np.abs(np.asarray(t.grad._data)).sum() > 0
