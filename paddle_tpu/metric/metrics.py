@@ -208,12 +208,14 @@ def mean_iou(input, label, num_classes, name=None):
         lab_cnt = jnp.zeros(num_classes, jnp.float32).at[l].add(ones)
         correct = jnp.zeros(num_classes, jnp.float32).at[p].add(
             (p == l).astype(jnp.float32))
-        union = pred_cnt + lab_cnt - correct
+        # reference: a mismatch increments out_wrong for BOTH the label's and
+        # the prediction's class; denominator = wrong + correct (= union)
+        wrong = pred_cnt + lab_cnt - 2.0 * correct
+        union = wrong + correct
         present = union > 0
         iou = jnp.where(present, correct / jnp.maximum(union, 1.0), 0.0)
         miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
-        wrong = (lab_cnt - correct).astype(jnp.int32)
-        return miou, wrong, correct.astype(jnp.int32)
+        return miou, wrong.astype(jnp.int32), correct.astype(jnp.int32)
 
     m, w, c = apply(fn, _t(input).detach(), _t(label).detach())
     for t in (m, w, c):

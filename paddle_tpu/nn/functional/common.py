@@ -241,14 +241,19 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
 
     kh, kw = _pair(kernel_sizes)
     sh, sw = _pair(strides)
-    ph, pw = _pair(paddings) if not (isinstance(paddings, (list, tuple)) and len(paddings) == 4) else (paddings[0], paddings[1])
+    if isinstance(paddings, (list, tuple)) and len(paddings) == 4:
+        pt, pl, pb, pr = paddings  # [top, left, bottom, right] (paddle layout)
+    else:
+        ph_, pw_ = _pair(paddings)
+        pt = pb = ph_
+        pl = pr = pw_
     dh, dw = _pair(dilations)
 
     def fn(v):
         b, c, h, w = v.shape
-        v = jnp.pad(v, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
-        out_h = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
-        out_w = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        v = jnp.pad(v, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+        out_h = (h + pt + pb - dh * (kh - 1) - 1) // sh + 1
+        out_w = (w + pl + pr - dw * (kw - 1) - 1) // sw + 1
         patches = []
         for i in range(kh):
             for j in range(kw):
@@ -271,16 +276,21 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
     oh_out, ow_out = _pair(output_sizes)
     kh, kw = _pair(kernel_sizes)
     sh, sw = _pair(strides)
-    ph, pw = _pair(paddings) if not (isinstance(paddings, (list, tuple)) and len(paddings) == 4) else (paddings[0], paddings[1])
+    if isinstance(paddings, (list, tuple)) and len(paddings) == 4:
+        pt, pl, pb, pr = paddings  # [top, left, bottom, right] (paddle layout)
+    else:
+        ph_, pw_ = _pair(paddings)
+        pt = pb = ph_
+        pl = pr = pw_
     dh, dw = _pair(dilations)
-    out_h = (oh_out + 2 * ph - dh * (kh - 1) - 1) // sh + 1
-    out_w = (ow_out + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    out_h = (oh_out + pt + pb - dh * (kh - 1) - 1) // sh + 1
+    out_w = (ow_out + pl + pr - dw * (kw - 1) - 1) // sw + 1
 
     def fn(v):
         b, ckk, L = v.shape
         c = ckk // (kh * kw)
         v = v.reshape(b, c, kh * kw, out_h, out_w)
-        canvas = jnp.zeros((b, c, oh_out + 2 * ph, ow_out + 2 * pw), v.dtype)
+        canvas = jnp.zeros((b, c, oh_out + pt + pb, ow_out + pl + pr), v.dtype)
         idx = 0
         for i in range(kh):
             for j in range(kw):
@@ -290,7 +300,7 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
                     :, :, i * dh : i * dh + out_h * sh : sh,
                     j * dw : j * dw + out_w * sw : sw].add(patch)
                 idx += 1
-        return canvas[:, :, ph : ph + oh_out, pw : pw + ow_out]
+        return canvas[:, :, pt : pt + oh_out, pl : pl + ow_out]
 
     return apply(fn, _t(x))
 

@@ -428,38 +428,31 @@ def reverse(x, axis, name=None):
     return flip(x, axis)
 
 
+def _index_add_fn(axis):
+    def fn(xv, iv, vv):
+        perm = None
+        if axis % xv.ndim != 0:
+            perm = list(range(xv.ndim))
+            perm[0], perm[axis] = perm[axis], perm[0]
+            xv = jnp.transpose(xv, perm)
+            vv = jnp.transpose(vv, perm)
+        out = xv.at[iv.astype(jnp.int32)].add(vv)
+        if perm is not None:
+            out = jnp.transpose(out, perm)
+        return out
+
+    return fn
+
+
 def index_add(x, index, axis, value, name=None):
     """index_add_op parity: x with value rows scatter-added at `index` along
     `axis` (XLA scatter-add; duplicate indices accumulate)."""
-    def fn(xv, iv, vv):
-        perm = None
-        if axis != 0:
-            perm = list(range(xv.ndim))
-            perm[0], perm[axis] = perm[axis], perm[0]
-            xv = jnp.transpose(xv, perm)
-            vv = jnp.transpose(vv, perm)
-        out = xv.at[iv.astype(jnp.int32)].add(vv)
-        if perm is not None:
-            out = jnp.transpose(out, perm)
-        return out
-
-    return apply(fn, _t(x), _t(index).detach(), _t(value))
+    return apply(_index_add_fn(axis), _t(x), _t(index).detach(), _t(value))
 
 
 def index_add_(x, index, axis, value, name=None):
-    def fn(xv, iv, vv):
-        perm = None
-        if axis != 0:
-            perm = list(range(xv.ndim))
-            perm[0], perm[axis] = perm[axis], perm[0]
-            xv = jnp.transpose(xv, perm)
-            vv = jnp.transpose(vv, perm)
-        out = xv.at[iv.astype(jnp.int32)].add(vv)
-        if perm is not None:
-            out = jnp.transpose(out, perm)
-        return out
-
-    return apply_inplace(fn, _t(x), _t(index).detach(), _t(value))
+    return apply_inplace(_index_add_fn(axis), _t(x), _t(index).detach(),
+                         _t(value))
 
 
 def diag_embed(input, offset=0, dim1=-2, dim2=-1):

@@ -417,6 +417,10 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
                 [jnp.full((k, 1), float(c)), decayed[:, None], bsel], axis=1)
             entry = jnp.where(valid[:, None], entry, -1.0)
             outs.append((entry, jnp.where(valid, decayed, -jnp.inf), top_i))
+        if not outs:  # every class was the background label
+            kk = min(keep_top_k, M)
+            return (jnp.full((kk, 6), -1.0), jnp.zeros((), jnp.int32),
+                    jnp.zeros((kk,), jnp.int32))
         all_e = jnp.concatenate([e for e, _, _ in outs], axis=0)
         all_s = jnp.concatenate([s for _, s, _ in outs], axis=0)
         all_i = jnp.concatenate([i for _, _, i in outs], axis=0)

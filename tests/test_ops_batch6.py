@@ -286,7 +286,9 @@ def test_mean_iou():
     np.testing.assert_allclose(float(np.asarray(m._data)),
                                (1 / 3 + 2 / 3 + 0) / 3, rtol=1e-5)
     np.testing.assert_allclose(np.asarray(correct._data), [1, 2, 0])
-    np.testing.assert_allclose(np.asarray(wrong._data), [1, 1, 0])
+    # mismatches increment wrong for BOTH label and prediction class
+    # (ref mean_iou_op.h:95-96): pixels (pred=0,lab=1) and (pred=2,lab=0)
+    np.testing.assert_allclose(np.asarray(wrong._data), [2, 1, 1])
 
 
 # --- detection family -----------------------------------------------------
@@ -510,3 +512,25 @@ def test_deform_conv2d():
     for t in (xt, ot, wt):
         assert np.isfinite(np.asarray(t.grad._data)).all()
         assert np.abs(np.asarray(t.grad._data)).sum() > 0
+
+
+def test_fold_unfold_asymmetric_padding():
+    # [top, left, bottom, right] 4-element paddle layout must roundtrip
+    x = _randn(1, 2, 5, 5)
+    u = F.unfold(paddle.to_tensor(x), 2, strides=1, paddings=[1, 0, 0, 0])
+    # out_h = (5 + 1 + 0 - 2)//1 + 1 = 5, out_w = 4
+    assert np.asarray(u._data).shape == (1, 2 * 4, 5 * 4)
+    ones = np.ones((1, 1, 4, 4), np.float32)
+    u2 = F.unfold(paddle.to_tensor(ones), 2, strides=2, paddings=[1, 1, 1, 1])
+    f2 = F.fold(u2, (4, 4), 2, strides=2, paddings=[1, 1, 1, 1])
+    np.testing.assert_allclose(np.asarray(f2._data), ones)
+
+
+def test_matrix_nms_single_background_class():
+    boxes = np.array([[[0, 0, 10, 10]]], np.float32)
+    scores = np.ones((1, 1, 1), np.float32)
+    out, num = V.matrix_nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                            score_threshold=0.1, keep_top_k=2,
+                            background_label=0)
+    assert int(np.asarray(num._data)[0]) == 0
+    assert (np.asarray(out._data) == -1).all()
