@@ -233,6 +233,18 @@ def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
     return apply(fn, _t(x))
 
 
+def _norm_pad4(paddings):
+    """Normalize paddle's int | (ph, pw) | [top, left, bottom, right] padding
+    spec to (top, left, bottom, right)."""
+    if isinstance(paddings, (list, tuple)) and len(paddings) == 4:
+        pt, pl, pb, pr = paddings
+    elif isinstance(paddings, (list, tuple)):
+        (pt, pl) = (pb, pr) = tuple(paddings)
+    else:
+        pt = pb = pl = pr = paddings
+    return pt, pl, pb, pr
+
+
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     """operators/unfold_op.cc parity (im2col)."""
 
@@ -241,12 +253,7 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
 
     kh, kw = _pair(kernel_sizes)
     sh, sw = _pair(strides)
-    if isinstance(paddings, (list, tuple)) and len(paddings) == 4:
-        pt, pl, pb, pr = paddings  # [top, left, bottom, right] (paddle layout)
-    else:
-        ph_, pw_ = _pair(paddings)
-        pt = pb = ph_
-        pl = pr = pw_
+    pt, pl, pb, pr = _norm_pad4(paddings)
     dh, dw = _pair(dilations)
 
     def fn(v):
@@ -276,12 +283,7 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
     oh_out, ow_out = _pair(output_sizes)
     kh, kw = _pair(kernel_sizes)
     sh, sw = _pair(strides)
-    if isinstance(paddings, (list, tuple)) and len(paddings) == 4:
-        pt, pl, pb, pr = paddings  # [top, left, bottom, right] (paddle layout)
-    else:
-        ph_, pw_ = _pair(paddings)
-        pt = pb = ph_
-        pl = pr = pw_
+    pt, pl, pb, pr = _norm_pad4(paddings)
     dh, dw = _pair(dilations)
     out_h = (oh_out + pt + pb - dh * (kh - 1) - 1) // sh + 1
     out_w = (ow_out + pl + pr - dw * (kw - 1) - 1) // sw + 1

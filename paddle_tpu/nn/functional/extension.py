@@ -273,3 +273,208 @@ def channel_shuffle(x, groups, data_format="NCHW", name=None):
         return v.reshape(n, h, w, ch)
 
     return apply(fn, _t(x))
+
+
+def l1_norm(x, name=None):
+    """l1_norm_op.cc parity: sum of absolute values (scalar)."""
+    return apply(lambda v: jnp.sum(jnp.abs(v)), _t(x))
+
+
+def squared_l2_norm(x, name=None):
+    """squared_l2_norm_op.cc parity: sum of squares (scalar)."""
+    return apply(lambda v: jnp.sum(v * v), _t(x))
+
+
+def cos_sim(x, y, name=None):
+    """cos_sim_op.cc parity: per-row cosine similarity [N, 1] (y may be a
+    single row broadcast against every row of x)."""
+    def fn(a, b):
+        if b.shape[0] == 1 and a.shape[0] != 1:
+            b = jnp.broadcast_to(b, a.shape)
+        num = jnp.sum(a * b, axis=-1)
+        den = jnp.sqrt(jnp.sum(a * a, axis=-1)) * jnp.sqrt(jnp.sum(b * b, axis=-1))
+        return (num / jnp.maximum(den, 1e-12))[:, None]
+
+    return apply(fn, _t(x), _t(y))
+
+
+def space_to_depth(x, blocksize, name=None):
+    """space_to_depth_op.cc parity: [N, C, H, W] -> [N, C*b*b, H/b, W/b]."""
+    def fn(v):
+        n, c, h, w = v.shape
+        b = blocksize
+        v = v.reshape(n, c, h // b, b, w // b, b)
+        v = jnp.transpose(v, (0, 3, 5, 1, 2, 4))
+        return v.reshape(n, c * b * b, h // b, w // b)
+
+    return apply(fn, _t(x))
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """pad_constant_like_op.cc parity: pad y up to x's shape with pad_value."""
+    def fn(xv, yv):
+        pads = [(0, xv.shape[i] - yv.shape[i]) for i in range(yv.ndim)]
+        return jnp.pad(yv, pads, constant_values=pad_value)
+
+    return apply(fn, _t(x).detach(), _t(y))
+
+
+def add_position_encoding(x, alpha=1.0, beta=1.0, name=None):
+    """add_position_encoding_op.cc parity: out = alpha*x + beta*PE with the
+    transformer sinusoid table (first half sin, second half cos)."""
+    def fn(v):
+        B, T, D = v.shape
+        half = D // 2
+        pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+        div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+        ang = pos / div[None, :]
+        pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=1)
+        if pe.shape[1] < D:
+            pe = jnp.pad(pe, ((0, 0), (0, D - pe.shape[1])))
+        return alpha * v + beta * pe[None, :, :].astype(v.dtype)
+
+    return apply(fn, _t(x))
+
+
+def bilinear_tensor_product(x, y, weight, bias=None, name=None):
+    """bilinear_tensor_product_op.cc parity: out[:, k] = x W_k y^T.
+    x [N, D1], y [N, D2], weight [K, D1, D2] -> [N, K]."""
+    args = [_t(x), _t(y), _t(weight)]
+    if bias is not None:
+        args.append(_t(bias))
+
+    def fn(a, b, w, *bb):
+        out = jnp.einsum("nd,kde,ne->nk", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+
+    return apply(fn, *args)
+
+
+def conv_shift(x, y, name=None):
+    """conv_shift_op.cc parity (NTM circular correlation): x [B, M], y [B, N]
+    (N odd, N <= M): out[b, i] = sum_j x[b, (i + j - N//2) mod M] * y[b, j]."""
+    def fn(a, b):
+        B, M = a.shape
+        N = b.shape[1]
+        half = N // 2
+        idx = (jnp.arange(M)[:, None] + jnp.arange(N)[None, :] - half) % M
+        ax = a[:, idx]                                      # [B, M, N]
+        return jnp.einsum("bmn,bn->bm", ax, b)
+
+    return apply(fn, _t(x), _t(y))
+
+
+def row_conv(x, weight, length=None, name=None):
+    """row_conv_op.cc parity (Deep Speech lookahead conv): x [B, T, D],
+    weight [future_context, D]: out[t] = sum_c w[c] * x[t + c] (zero past the
+    end / sequence length)."""
+    def fn(v, w, *rest):
+        B, T, D = v.shape
+        ctx = w.shape[0]
+        ln = rest[0].astype(jnp.int32) if rest else jnp.full((B,), T, jnp.int32)
+        valid = jnp.arange(T)[None, :] < ln[:, None]
+        out = jnp.zeros_like(v)
+        for c in range(ctx):
+            pos = jnp.arange(T) + c
+            inb = pos < T
+            src = jnp.clip(pos, 0, T - 1).astype(jnp.int32)
+            tap = v[:, src] * (inb[None, :] & jnp.take(valid, src, axis=1))[:, :, None]
+            out = out + tap * w[c][None, None, :]
+        return out * valid[:, :, None]
+
+    args = [_t(x), _t(weight)]
+    if length is not None:
+        args.append(_t(length).detach())
+    return apply(fn, *args)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, name=None):
+    """sampling_id_op.cc parity: sample a column index per row of the
+    probability matrix x [B, C] (inverse-CDF on uniform draws)."""
+    from ...core.generator import default_generator
+
+    # seed=0 means fresh randomness per call (reference semantics); a nonzero
+    # seed is deterministic
+    key = (default_generator().split() if not seed
+           else default_generator().fold_in(seed))
+
+    def fn(v):
+        u = jax.random.uniform(key, (v.shape[0], 1), dtype=v.dtype)
+        cdf = jnp.cumsum(v, axis=1) / jnp.sum(v, axis=1, keepdims=True)
+        return jnp.sum((u > cdf).astype(jnp.int64), axis=1)
+
+    out = apply(fn, _t(x).detach())
+    out.stop_gradient = True
+    return out
+
+
+def partial_concat(xs, start_index=0, length=-1, name=None):
+    """partial_concat_op.cc parity: concat the [start, start+length) column
+    slice of each [B, D] input."""
+    def fn(*vs):
+        outs = []
+        for v in vs:
+            start = start_index if start_index >= 0 else v.shape[1] + start_index
+            end = v.shape[1] if length < 0 else start + length
+            outs.append(v[:, start:end])
+        return jnp.concatenate(outs, axis=1)
+
+    return apply(fn, *[_t(x) for x in xs])
+
+
+def partial_sum(xs, start_index=0, length=-1, name=None):
+    """partial_sum_op.cc parity: elementwise sum of the column slices."""
+    def fn(*vs):
+        acc = None
+        for v in vs:
+            start = start_index if start_index >= 0 else v.shape[1] + start_index
+            end = v.shape[1] if length < 0 else start + length
+            sl = v[:, start:end]
+            acc = sl if acc is None else acc + sl
+        return acc
+
+    return apply(fn, *[_t(x) for x in xs])
+
+
+def im2sequence(x, filter_size=1, stride=1, padding=0, name=None):
+    """im2sequence_op.cc parity: [N, C, H, W] -> patch rows
+    [N, oh*ow, C*fh*fw] (per-image patch sequence; LoD -> fixed oh*ow rows)."""
+    from .common import _norm_pad4
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    fh, fw = _pair(filter_size)
+    sh, sw = _pair(stride)
+    pt, pl, pb, pr = _norm_pad4(padding)
+
+    def fn(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+        oh = (h + pt + pb - fh) // sh + 1
+        ow = (w + pl + pr - fw) // sw + 1
+        taps = []
+        for i in range(fh):
+            for j in range(fw):
+                taps.append(v[:, :, i : i + oh * sh : sh, j : j + ow * sw : sw])
+        pat = jnp.stack(taps, axis=2)          # [n, c, fh*fw, oh, ow]
+        pat = jnp.transpose(pat, (0, 3, 4, 1, 2))  # [n, oh, ow, c, fh*fw]
+        return pat.reshape(n, oh * ow, c * fh * fw)
+
+    return apply(fn, _t(x))
+
+
+def shuffle_batch(x, seed=0, name=None):
+    """shuffle_batch_op.cc parity: random permutation of rows. Eager (the
+    permutation is data-independent host randomness, like the reference)."""
+    from ...core.generator import default_generator
+
+    v = _t(x)
+    # seed=0 -> fresh permutation every call (reference semantics)
+    key = (default_generator().split() if not seed
+           else default_generator().fold_in(seed))
+    perm = jax.random.permutation(key, v.shape[0])
+    out = apply(lambda a: a[perm], v)
+    return out

@@ -1,0 +1,299 @@
+"""Sequence op family (reference operators/sequence_ops/ — 6.2k LoC of LoD
+kernels: sequence_pool_op.cc, sequence_conv_op.cc, sequence_expand_op.cc,
+sequence_reverse_op.h, sequence_slice_op.h, sequence_softmax_op.cc,
+sequence_concat_op.cc, sequence_enumerate_op.cc, sequence_erase_op.cc,
+sequence_scatter_op.cc, sequence_reshape_op.cc).
+
+TPU-native design (SURVEY hard-part #2): LoD tensors become (data [B, T, ...],
+length [B]) padded batches — every op is a masked dense computation with static
+shapes, so the whole family jits and fuses instead of walking LoD offsets on
+the host. Ops whose output length differs per sequence (erase, enumerate with
+trimming) re-pad to the input's T and return new lengths.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def _mask(T, length, dtype=jnp.float32):
+    # length [B] -> [B, T] 0/1 mask
+    return (jnp.arange(T)[None, :] < length[:, None]).astype(dtype)
+
+
+def sequence_pool(x, length, pool_type="sum", pad_value=0.0, name=None):
+    """sequence_pool_op.cc parity over padded [B, T, D] + length [B].
+    pool_type: sum | average | sqrt | max | min | first | last.
+    Empty sequences (length 0) yield pad_value like the reference."""
+    pt = pool_type.lower()
+
+    def fn(v, ln):
+        B, T = v.shape[0], v.shape[1]
+        ln = ln.astype(jnp.int32)
+        m = _mask(T, ln, v.dtype)
+        mex = m.reshape(B, T, *([1] * (v.ndim - 2)))
+        empty = (ln == 0).reshape(B, *([1] * (v.ndim - 2)))
+        if pt in ("sum", "average", "sqrt"):
+            s = jnp.sum(v * mex, axis=1)
+            denom = jnp.maximum(ln, 1).astype(v.dtype).reshape(
+                B, *([1] * (v.ndim - 2)))
+            if pt == "average":
+                s = s / denom
+            elif pt == "sqrt":
+                s = s / jnp.sqrt(denom)
+            out = s
+        elif pt == "max":
+            out = jnp.max(jnp.where(mex > 0, v, -jnp.inf), axis=1)
+        elif pt == "min":
+            out = jnp.min(jnp.where(mex > 0, v, jnp.inf), axis=1)
+        elif pt == "first":
+            out = v[:, 0]
+        elif pt == "last":
+            idx = jnp.maximum(ln - 1, 0)
+            out = jnp.take_along_axis(
+                v, idx.reshape(B, 1, *([1] * (v.ndim - 2))).astype(jnp.int32)
+                * jnp.ones((B, 1, *v.shape[2:]), jnp.int32), axis=1)[:, 0]
+        else:
+            raise ValueError(f"unknown pool_type {pool_type}")
+        return jnp.where(empty, jnp.asarray(pad_value, v.dtype), out)
+
+    return apply(fn, _t(x), _t(length).detach())
+
+
+def sequence_first_step(x, length, name=None):
+    return sequence_pool(x, length, "first")
+
+
+def sequence_last_step(x, length, name=None):
+    return sequence_pool(x, length, "last")
+
+
+def sequence_softmax(x, length, name=None):
+    """sequence_softmax_op.cc parity: softmax over each sequence's valid steps
+    (padded steps get 0 probability). x [B, T] or [B, T, 1]."""
+    def fn(v, ln):
+        squeeze = v.ndim == 3 and v.shape[-1] == 1
+        vv = v[..., 0] if squeeze else v
+        T = vv.shape[1]
+        m = _mask(T, ln.astype(jnp.int32), jnp.bool_)
+        z = jnp.where(m, vv, -jnp.inf)
+        p = jax.nn.softmax(z, axis=1)
+        p = jnp.where(m, p, 0.0)
+        return p[..., None] if squeeze else p
+
+    return apply(fn, _t(x), _t(length).detach())
+
+
+def sequence_reverse(x, length, name=None):
+    """sequence_reverse_op.h parity: reverse each sequence's first `length`
+    steps in place; padding stays put."""
+    def fn(v, ln):
+        B, T = v.shape[0], v.shape[1]
+        ln = ln.astype(jnp.int32)
+        pos = jnp.arange(T)[None, :]
+        src = jnp.where(pos < ln[:, None], ln[:, None] - 1 - pos, pos)
+        src = src.astype(jnp.int32)
+        idx = src.reshape(B, T, *([1] * (v.ndim - 2)))
+        idx = jnp.broadcast_to(idx, v.shape)
+        return jnp.take_along_axis(v, idx, axis=1)
+
+    return apply(fn, _t(x), _t(length).detach())
+
+
+def sequence_expand(x, length_x, ref_length, name=None):
+    """sequence_expand_op.cc parity (padded): repeat each sequence i of x
+    ref_length[i] times along a new repeat axis is LoD-specific; the padded
+    equivalent used by the reference's main consumer (beam search / attention)
+    tiles each row's sequence to the reference's length. Here: x [B, Tx, ...]
+    is re-padded to [B, max(ref_length), ...] by cycling its valid steps,
+    matching sequence_expand with per-sequence repeat."""
+    def fn(v, lx, lr):
+        B, T = v.shape[0], v.shape[1]
+        lx = jnp.maximum(lx.astype(jnp.int32), 1)
+        lr = jnp.minimum(lr.astype(jnp.int32), T)  # output keeps the static T
+        pos = jnp.arange(T)[None, :]
+        src = (pos % lx[:, None]).astype(jnp.int32)
+        idx = jnp.broadcast_to(
+            src.reshape(B, T, *([1] * (v.ndim - 2))), v.shape)
+        out = jnp.take_along_axis(v, idx, axis=1)
+        m = _mask(T, lr, v.dtype).reshape(B, T, *([1] * (v.ndim - 2)))
+        return out * m
+
+    return apply(fn, _t(x), _t(length_x).detach(), _t(ref_length).detach())
+
+
+def sequence_expand_as(x, length_x, y, ref_length, name=None):
+    return sequence_expand(x, length_x, ref_length)
+
+
+def sequence_slice(x, length, offset, out_length, name=None):
+    """sequence_slice_op.h parity: per-sequence [offset, offset+out_length)
+    window, left-aligned into the output padding. Returns ([B, T, ...], new
+    lengths = out_length)."""
+    def fn(v, ln, off, ol):
+        B, T = v.shape[0], v.shape[1]
+        off = off.reshape(-1).astype(jnp.int32)
+        ol = ol.reshape(-1).astype(jnp.int32)
+        pos = jnp.arange(T)[None, :]
+        src = jnp.clip(pos + off[:, None], 0, T - 1).astype(jnp.int32)
+        idx = jnp.broadcast_to(
+            src.reshape(B, T, *([1] * (v.ndim - 2))), v.shape)
+        shifted = jnp.take_along_axis(v, idx, axis=1)
+        m = _mask(T, ol, v.dtype).reshape(B, T, *([1] * (v.ndim - 2)))
+        return shifted * m
+
+    out = apply(fn, _t(x), _t(length).detach(), _t(offset).detach(),
+                _t(out_length).detach())
+    return out, _t(out_length)
+
+
+def sequence_concat(xs, lengths, name=None):
+    """sequence_concat_op.cc parity: concatenate the i-th sequences of every
+    input along time (valid steps back to back). Returns (data, lengths)."""
+    xs = [_t(x) for x in xs]
+    lens = [_t(l).detach() for l in lengths]
+    T_out = sum(int(x.shape[1]) for x in xs)
+
+    def fn(*args):
+        n = len(args) // 2
+        vs, lns = args[:n], args[n:]
+        B = vs[0].shape[0]
+        total = sum(ln.astype(jnp.int32) for ln in lns)
+        out_shape = (B, T_out) + vs[0].shape[2:]
+        out = jnp.zeros(out_shape, vs[0].dtype)
+        base = jnp.zeros((B,), jnp.int32)
+        for v, ln in zip(vs, lns):
+            T = v.shape[1]
+            ln = ln.astype(jnp.int32)
+            pos = jnp.arange(T)[None, :]
+            valid = pos < ln[:, None]
+            dest = jnp.where(valid, base[:, None] + pos, T_out)  # T_out = dump
+            bidx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+            out = jnp.zeros((B, T_out + 1) + v.shape[2:], v.dtype).at[
+                bidx.reshape(-1), dest.reshape(-1)].add(
+                    v.reshape((-1,) + v.shape[2:]))[:, :T_out] + out
+            base = base + ln
+        return out, total
+
+    flat = list(xs) + list(lens)
+    out, total = apply(fn, *flat)
+    return out, total
+
+
+def sequence_enumerate(x, length, win_size, pad_value=0, name=None):
+    """sequence_enumerate_op.cc parity: each position emits the window
+    [i, i+win_size) of token ids, padded with pad_value past the sequence
+    end. x [B, T] int -> [B, T, win_size]."""
+    def fn(v, ln):
+        B, T = v.shape
+        ln = ln.astype(jnp.int32)
+        pos = jnp.arange(T)[None, :, None] + jnp.arange(win_size)[None, None, :]
+        inb = pos < ln[:, None, None]
+        src = jnp.clip(pos, 0, T - 1).astype(jnp.int32)
+        win = jnp.take_along_axis(
+            jnp.broadcast_to(v[:, :, None], (B, T, win_size)), src, axis=1)
+        valid_row = jnp.arange(T)[None, :, None] < ln[:, None, None]
+        return jnp.where(inb & valid_row, win, pad_value)
+
+    out = apply(fn, _t(x).detach(), _t(length).detach())
+    out.stop_gradient = True
+    return out
+
+
+def sequence_erase(x, length, tokens, name=None):
+    """sequence_erase_op.cc parity: delete the given token ids from each
+    sequence and re-compact left. Returns (ids [B, T], new lengths [B])."""
+    def fn(v, ln):
+        B, T = v.shape
+        ln = ln.astype(jnp.int32)
+        valid = jnp.arange(T)[None, :] < ln[:, None]
+        keep = valid
+        for t in tokens:
+            keep = keep & (v != t)
+        dest = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+        dest = jnp.where(keep, dest, T)                     # T = dump slot
+        bidx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+        out = jnp.zeros((B, T + 1), v.dtype).at[
+            bidx.reshape(-1), dest.reshape(-1)].set(v.reshape(-1))[:, :T]
+        return out, jnp.sum(keep, axis=1)
+
+    ids, newlen = apply(fn, _t(x).detach(), _t(length).detach())
+    ids.stop_gradient = True
+    newlen.stop_gradient = True
+    return ids, newlen
+
+
+def sequence_reshape(x, length, new_dim, name=None):
+    """sequence_reshape_op.cc parity: refold each sequence's valid elements
+    into rows of width new_dim (length[i]*D must divide by new_dim). Padded
+    representation: [B, T, D] -> [B, T*D//new_dim, new_dim] with new lengths."""
+    def fn(v, ln):
+        B, T, D = v.shape
+        T2 = T * D // new_dim
+        return v.reshape(B, T2, new_dim), (ln.astype(jnp.int32) * D) // new_dim
+
+    out, newlen = apply(fn, _t(x), _t(length).detach())
+    newlen.stop_gradient = True
+    return out, newlen
+
+
+def sequence_scatter(x, index, updates, length, name=None):
+    """sequence_scatter_op.cc parity (padded): add updates at per-sequence
+    positions. x [B, T], index [B, U] (positions within each sequence),
+    updates [B, U]; entries past `length` of the update row are ignored."""
+    def fn(v, ix, up, ln):
+        B, T = v.shape[0], v.shape[1]
+        U = ix.shape[1]
+        ln = ln.astype(jnp.int32)
+        valid = jnp.arange(U)[None, :] < ln[:, None]
+        dest = jnp.where(valid, ix.astype(jnp.int32), T)    # T = dump slot
+        bidx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, U))
+        return jnp.concatenate(
+            [v, jnp.zeros((B, 1) + v.shape[2:], v.dtype)], axis=1).at[
+                bidx.reshape(-1), dest.reshape(-1)].add(
+                    up.reshape((-1,) + up.shape[2:]))[:, :T]
+
+    return apply(fn, _t(x), _t(index).detach(), _t(updates),
+                 _t(length).detach())
+
+
+def sequence_conv(x, length, weight, context_length, context_start=None,
+                  bias=None, name=None):
+    """sequence_conv_op.cc parity: time-dimension context-window projection.
+    x [B, T, D]; weight [context_length*D, M]; out [B, T, M]. Out-of-sequence
+    context rows are zero (the reference's context padding without trainable
+    padding data). context_start defaults to -context_length//2."""
+    if context_start is None:
+        context_start = -(context_length // 2)
+
+    args = [_t(x), _t(length).detach(), _t(weight)]
+    if bias is not None:
+        args.append(_t(bias))
+
+    def fn(v, ln, w, *b):
+        B, T, D = v.shape
+        ln = ln.astype(jnp.int32)
+        valid = jnp.arange(T)[None, :] < ln[:, None]        # [B, T]
+        cols = []
+        for c in range(context_length):
+            shift = context_start + c
+            pos = jnp.arange(T) + shift
+            inb = (pos >= 0) & (pos < T)
+            src = jnp.clip(pos, 0, T - 1).astype(jnp.int32)
+            col = v[:, src]                                  # [B, T, D]
+            ok = inb[None, :] & jnp.take(
+                valid, src, axis=1)                          # [B, T]
+            cols.append(col * ok[:, :, None])
+        ctx = jnp.concatenate(cols, axis=-1)                 # [B, T, cl*D]
+        out = ctx @ w
+        if b:
+            out = out + b[0]
+        return out * valid[:, :, None]
+
+    return apply(fn, *args)

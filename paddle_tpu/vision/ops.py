@@ -735,3 +735,233 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         return out
 
     return apply(fn, *args)
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, variances,
+                     stride, offset=0.5, name=None):
+    """detection/anchor_generator_op.h parity: per-cell anchors over the
+    feature map. input [N, C, H, W] (only H, W used). Returns
+    (anchors [H, W, A, 4], variances [H, W, A, 4]); anchor order is
+    aspect_ratio-major, size-minor like the reference (:62-64)."""
+    H, W = int(input.shape[2]), int(input.shape[3])
+    sw, sh = float(stride[0]), float(stride[1])
+    whs = []
+    for ar in aspect_ratios:
+        area = sw * sh
+        base_w = np.round(np.sqrt(area / ar))
+        base_h = np.round(base_w * ar)
+        for s in anchor_sizes:
+            whs.append((s / sw * base_w, s / sh * base_h))
+    whs = jnp.asarray(np.asarray(whs, np.float32))          # [A, 2]
+    x_ctr = jnp.arange(W, dtype=jnp.float32) * sw + offset * (sw - 1)
+    y_ctr = jnp.arange(H, dtype=jnp.float32) * sh + offset * (sh - 1)
+    xc = jnp.broadcast_to(x_ctr[None, :, None], (H, W, whs.shape[0]))
+    yc = jnp.broadcast_to(y_ctr[:, None, None], (H, W, whs.shape[0]))
+    aw = whs[None, None, :, 0]
+    ah = whs[None, None, :, 1]
+    anchors = jnp.stack([xc - 0.5 * (aw - 1), yc - 0.5 * (ah - 1),
+                         xc + 0.5 * (aw - 1), yc + 0.5 * (ah - 1)], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           anchors.shape)
+    a = Tensor(anchors)
+    v = Tensor(var)
+    a.stop_gradient = True
+    v.stop_gradient = True
+    return a, v
+
+
+def box_clip(input, im_info, name=None):
+    """detection/box_clip_op.h parity: clip [N, M, 4] (or [M, 4]) boxes to
+    the image: [0, round(h/scale) - 1] x [0, round(w/scale) - 1];
+    im_info rows are (height, width, scale)."""
+    def fn(b, info):
+        batched = b.ndim == 3
+        if not batched:
+            b = b[None]
+            info = info.reshape(1, -1)
+        im_h = jnp.round(info[:, 0] / info[:, 2]).reshape(-1, 1)
+        im_w = jnp.round(info[:, 1] / info[:, 2]).reshape(-1, 1)
+        x1 = jnp.clip(b[..., 0], 0, im_w - 1)
+        y1 = jnp.clip(b[..., 1], 0, im_h - 1)
+        x2 = jnp.clip(b[..., 2], 0, im_w - 1)
+        y2 = jnp.clip(b[..., 3], 0, im_h - 1)
+        out = jnp.stack([x1, y1, x2, y2], axis=-1)
+        return out if batched else out[0]
+
+    return apply(fn, _t(input), _t(im_info).detach())
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    """detection/target_assign_op.h parity: out[b, p] = input[b, match[b, p]]
+    (mismatch rows filled with mismatch_value, weight 0; negative_indices
+    entries get mismatch_value with weight 1 — SSD negative mining)."""
+    args = [_t(input).detach(), _t(matched_indices).detach()]
+    if negative_indices is not None:
+        args.append(_t(negative_indices).detach())
+
+    def fn(x, mi, *neg):
+        B, P = mi.shape
+        mi = mi.astype(jnp.int32)
+        matched = mi >= 0
+        safe = jnp.where(matched, mi, 0)
+        out = jnp.take_along_axis(
+            x, safe[:, :, None] if x.ndim == 3 else safe, axis=1)
+        fill = jnp.asarray(mismatch_value, x.dtype)
+        out = jnp.where(matched[:, :, None] if x.ndim == 3 else matched,
+                        out, fill)
+        wt = matched.astype(jnp.float32)
+        if neg:
+            ni = neg[0].astype(jnp.int32)                    # [B, Q]
+            bidx = jnp.broadcast_to(jnp.arange(B)[:, None], ni.shape)
+            valid = ni >= 0
+            dump = jnp.where(valid, ni, P)
+            wt = jnp.concatenate([wt, jnp.zeros((B, 1), wt.dtype)], axis=1)
+            wt = wt.at[bidx.reshape(-1), dump.reshape(-1)].set(1.0)[:, :P]
+            if x.ndim == 3:
+                out = jnp.concatenate(
+                    [out, jnp.zeros((B, 1, out.shape[2]), out.dtype)], axis=1
+                ).at[bidx.reshape(-1), dump.reshape(-1)].set(fill)[:, :P]
+            else:
+                out = jnp.concatenate(
+                    [out, jnp.zeros((B, 1), out.dtype)], axis=1
+                ).at[bidx.reshape(-1), dump.reshape(-1)].set(fill)[:, :P]
+        return out, (wt[:, :, None] if x.ndim == 3 else wt)
+
+    out, wt = apply(fn, *args)
+    out.stop_gradient = True
+    wt.stop_gradient = True
+    return out, wt
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, scale_x_y=1.0, name=None):
+    """detection/yolov3_loss_op.h parity (vectorized; loss per image [N]).
+
+    x [N, mask_num*(5+C), H, W]; gt_box [N, B, 4] normalized (cx, cy, w, h);
+    gt_label [N, B]; anchors = flat [a0w, a0h, ...]; anchor_mask = this
+    level's anchor indices. Per-gt best-anchor matching scatters positives;
+    objectness cells whose predicted box IoUs any gt above ignore_thresh are
+    excluded from the negative term (obj target semantics of :384-397). The
+    whole thing is differentiable through XLA (no hand-written grad kernel).
+    """
+    mask_num = len(anchor_mask)
+    an_np = np.asarray(anchors, np.float32).reshape(-1, 2)   # [an_num, 2]
+    an_masked = an_np[list(anchor_mask)]                     # [mask_num, 2]
+    scale, bias = scale_x_y, -0.5 * (scale_x_y - 1.0)
+
+    args = [_t(x), _t(gt_box).detach(), _t(gt_label).detach()]
+    if gt_score is not None:
+        args.append(_t(gt_score).detach())
+
+    smooth = min(1.0 / class_num, 1.0 / 40) if use_label_smooth else 0.0
+    pos_lab, neg_lab = 1.0 - smooth, smooth
+
+    def sce(logit, label):
+        return jnp.maximum(logit, 0) - logit * label + jnp.log1p(
+            jnp.exp(-jnp.abs(logit)))
+
+    def fn(xv, gb, gl, *gs):
+        N, _, H, W = xv.shape
+        input_size = downsample_ratio * H
+        xv = xv.reshape(N, mask_num, 5 + class_num, H, W)
+        score = (gs[0] if gs else jnp.ones(gb.shape[:2], xv.dtype))
+        gl = gl.astype(jnp.int32)
+        valid = (gb[..., 2] > 0) & (gb[..., 3] > 0)          # [N, B]
+
+        amw = jnp.asarray(an_masked[:, 0])
+        amh = jnp.asarray(an_masked[:, 1])
+        # predicted boxes (for the ignore mask)
+        gx = (jnp.arange(W)[None, :] + jax.nn.sigmoid(xv[:, :, 0]) * scale
+              + bias) / W
+        gy = (jnp.arange(H)[:, None] + jax.nn.sigmoid(xv[:, :, 1]) * scale
+              + bias) / H
+        gw = jnp.exp(xv[:, :, 2]) * amw[None, :, None, None] / input_size
+        gh = jnp.exp(xv[:, :, 3]) * amh[None, :, None, None] / input_size
+
+        def iou_cwh(ax, ay, aw_, ah_, bx, by, bw, bh):
+            ax1, ay1 = ax - aw_ / 2, ay - ah_ / 2
+            ax2, ay2 = ax + aw_ / 2, ay + ah_ / 2
+            bx1, by1 = bx - bw / 2, by - bh / 2
+            bx2, by2 = bx + bw / 2, by + bh / 2
+            iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0)
+            ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0)
+            inter = iw * ih
+            return inter / jnp.maximum(aw_ * ah_ + bw * bh - inter, 1e-10)
+
+        # best IoU of each predicted box vs any valid gt: [N, mask, H, W]
+        ious = iou_cwh(
+            gx[:, :, :, :, None], gy[:, :, :, :, None],
+            gw[:, :, :, :, None], gh[:, :, :, :, None],
+            gb[:, None, None, None, :, 0], gb[:, None, None, None, :, 1],
+            gb[:, None, None, None, :, 2], gb[:, None, None, None, :, 3])
+        ious = jnp.where(valid[:, None, None, None, :], ious, 0.0)
+        ignore = jnp.max(ious, axis=-1) > ignore_thresh      # [N, mask, H, W]
+
+        # per-gt best anchor over ALL anchors (wh IoU at origin)
+        all_aw = jnp.asarray(an_np[:, 0]) / input_size
+        all_ah = jnp.asarray(an_np[:, 1]) / input_size
+        inter = (jnp.minimum(gb[..., 2:3], all_aw[None, None, :])
+                 * jnp.minimum(gb[..., 3:4], all_ah[None, None, :]))
+        union = (gb[..., 2:3] * gb[..., 3:4]
+                 + all_aw[None, None, :] * all_ah[None, None, :] - inter)
+        best_n = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)  # [N, B]
+        # map to this level's mask slot (-1 if not ours)
+        mask_arr = jnp.asarray(np.asarray(anchor_mask, np.int64))
+        mask_idx = jnp.argmax(mask_arr[None, None, :] == best_n[..., None],
+                              axis=-1)
+        ours = jnp.any(mask_arr[None, None, :] == best_n[..., None], axis=-1)
+        take = valid & ours                                   # [N, B]
+
+        gi = jnp.clip((gb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+
+        # gather predictions at each gt's cell: [N, B, 5+C]
+        flat = xv.reshape(N, mask_num, 5 + class_num, H * W)
+        cell = gj * W + gi                                    # [N, B]
+        midx = jnp.where(take, mask_idx, 0).astype(jnp.int32)
+        pred = jnp.take_along_axis(
+            jnp.take_along_axis(
+                flat, midx[:, :, None, None] *
+                jnp.ones((1, 1, 5 + class_num, H * W), jnp.int32), axis=1),
+            cell[:, :, None, None] *
+            jnp.ones((1, 1, 5 + class_num, 1), jnp.int32), axis=3)[:, :, :, 0]
+
+        tx = gb[..., 0] * W - gi
+        ty = gb[..., 1] * H - gj
+        aw_t = jnp.take(jnp.asarray(an_np[:, 0]), best_n)
+        ah_t = jnp.take(jnp.asarray(an_np[:, 1]), best_n)
+        tw = jnp.log(jnp.maximum(gb[..., 2] * input_size / aw_t, 1e-9))
+        th = jnp.log(jnp.maximum(gb[..., 3] * input_size / ah_t, 1e-9))
+        loc_scale = (2.0 - gb[..., 2] * gb[..., 3]) * score
+        loc = (sce(pred[..., 0], tx) + sce(pred[..., 1], ty)
+               + jnp.abs(pred[..., 2] - tw) + jnp.abs(pred[..., 3] - th)
+               ) * loc_scale
+        cls_t = jax.nn.one_hot(gl, class_num) * (pos_lab - neg_lab) + neg_lab
+        cls = jnp.sum(sce(pred[..., 5:], cls_t), axis=-1) * score
+        per_gt = jnp.where(take, loc + cls, 0.0)              # [N, B]
+
+        # objectness target map: later gts win on cell collisions (reference
+        # loop order). JAX scatter-set with duplicate indices is unordered, so
+        # pick the winner deterministically: scatter-max each gt's (t+1) into
+        # the cell, then only the gt matching that rank contributes its score.
+        Bn = gb.shape[1]
+        dest = jnp.where(take, midx * H * W + cell, mask_num * H * W)
+        bidx = jnp.broadcast_to(jnp.arange(N)[:, None], dest.shape)
+        ranks = jnp.broadcast_to(jnp.arange(1, Bn + 1)[None, :], dest.shape)
+        order = jnp.zeros((N, mask_num * H * W + 1), jnp.int32).at[
+            bidx.reshape(-1), dest.reshape(-1)].max(
+                jnp.where(take, ranks, 0).reshape(-1))
+        winner = take & (jnp.take_along_axis(order, dest, axis=1) == ranks)
+        obj_t = jnp.zeros((N, mask_num * H * W + 1), xv.dtype).at[
+            bidx.reshape(-1), dest.reshape(-1)].add(
+                jnp.where(winner, score, 0.0).reshape(-1))
+        obj_t = obj_t[:, :mask_num * H * W].reshape(N, mask_num, H, W)
+        conf = xv[:, :, 4]
+        pos = obj_t > 1e-5
+        obj_loss = jnp.where(pos, sce(conf, 1.0) * obj_t,
+                             jnp.where(ignore, 0.0, sce(conf, 0.0)))
+        return jnp.sum(per_gt, axis=1) + jnp.sum(obj_loss, axis=(1, 2, 3))
+
+    return apply(fn, *args)

@@ -1,0 +1,472 @@
+"""Op-test burn-down, batch 7: sequence family (padded+length LoD design),
+misc reference ops (l1_norm, squared_l2_norm, cos_sim, space_to_depth,
+pad_constant_like, add_position_encoding, bilinear_tensor_product, conv_shift,
+row_conv, im2sequence, partial_concat/sum, sampling_id, shuffle_batch) and the
+detection additions (anchor_generator, box_clip, target_assign, yolov3_loss
+verified against a loop-for-loop numpy port of the reference kernel)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+from paddle_tpu.vision import ops as V
+
+rng = np.random.RandomState(11)
+
+
+def _randn(*shape):
+    return rng.randn(*shape).astype(np.float32)
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+# --------------------------- sequence family ------------------------------
+
+X = _randn(3, 5, 2)
+LEN = np.array([5, 3, 0], np.int64)
+
+
+def test_sequence_pool_modes():
+    for mode, ref in [
+        ("sum", lambda v, n: v[:n].sum(0)),
+        ("average", lambda v, n: v[:n].mean(0)),
+        ("sqrt", lambda v, n: v[:n].sum(0) / np.sqrt(n)),
+        ("max", lambda v, n: v[:n].max(0)),
+        ("min", lambda v, n: v[:n].min(0)),
+        ("first", lambda v, n: v[0]),
+        ("last", lambda v, n: v[n - 1]),
+    ]:
+        got = _np(F.sequence_pool(paddle.to_tensor(X), paddle.to_tensor(LEN),
+                                  mode))
+        for b in range(3):
+            if LEN[b] == 0:
+                np.testing.assert_allclose(got[b], 0.0, err_msg=mode)
+            else:
+                np.testing.assert_allclose(got[b], ref(X[b], LEN[b]),
+                                           rtol=1e-5, err_msg=mode)
+
+
+def test_sequence_pool_grad():
+    x = paddle.to_tensor(X)
+    x.stop_gradient = False
+    F.sequence_pool(x, paddle.to_tensor(LEN), "sum").sum().backward()
+    g = _np(x.grad)
+    np.testing.assert_allclose(g[0], 1.0)          # all 5 steps valid
+    np.testing.assert_allclose(g[1, 3:], 0.0)      # padding gets no grad
+    np.testing.assert_allclose(g[2], 0.0)
+
+
+def test_sequence_softmax():
+    x = _randn(2, 4)
+    ln = np.array([3, 4])
+    got = _np(F.sequence_softmax(paddle.to_tensor(x), paddle.to_tensor(ln)))
+    for b in range(2):
+        e = np.exp(x[b, :ln[b]] - x[b, :ln[b]].max())
+        np.testing.assert_allclose(got[b, :ln[b]], e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(got[b, ln[b]:], 0.0)
+
+
+def test_sequence_reverse():
+    x = _randn(2, 4, 3)
+    ln = np.array([3, 4])
+    got = _np(F.sequence_reverse(paddle.to_tensor(x), paddle.to_tensor(ln)))
+    np.testing.assert_allclose(got[0, :3], x[0, :3][::-1])
+    np.testing.assert_allclose(got[0, 3], x[0, 3])  # padding untouched
+    np.testing.assert_allclose(got[1], x[1][::-1])
+
+
+def test_sequence_expand():
+    x = _randn(2, 4, 2)
+    lx = np.array([2, 1])
+    lr = np.array([4, 3])
+    got = _np(F.sequence_expand(paddle.to_tensor(x), paddle.to_tensor(lx),
+                                paddle.to_tensor(lr)))
+    # row 0 cycles its 2 valid steps to fill 4; row 1 tiles its single step
+    np.testing.assert_allclose(got[0], np.stack([x[0, 0], x[0, 1],
+                                                 x[0, 0], x[0, 1]]))
+    np.testing.assert_allclose(got[1, :3], np.stack([x[1, 0]] * 3))
+    np.testing.assert_allclose(got[1, 3], 0.0)
+
+
+def test_sequence_slice():
+    x = _randn(2, 5)
+    ln = np.array([5, 4])
+    out, newlen = F.sequence_slice(paddle.to_tensor(x), paddle.to_tensor(ln),
+                                   np.array([1, 0]), np.array([2, 3]))
+    got = _np(out)
+    np.testing.assert_allclose(got[0, :2], x[0, 1:3])
+    np.testing.assert_allclose(got[0, 2:], 0.0)
+    np.testing.assert_allclose(got[1, :3], x[1, :3])
+    np.testing.assert_allclose(_np(newlen), [2, 3])
+
+
+def test_sequence_concat():
+    a = _randn(2, 3)
+    b = _randn(2, 2)
+    la = np.array([2, 3])
+    lb = np.array([1, 2])
+    out, total = F.sequence_concat([paddle.to_tensor(a), paddle.to_tensor(b)],
+                                   [paddle.to_tensor(la), paddle.to_tensor(lb)])
+    got = _np(out)
+    np.testing.assert_allclose(_np(total), [3, 5])
+    np.testing.assert_allclose(got[0, :3], [a[0, 0], a[0, 1], b[0, 0]])
+    np.testing.assert_allclose(got[1, :5],
+                               [a[1, 0], a[1, 1], a[1, 2], b[1, 0], b[1, 1]])
+    np.testing.assert_allclose(got[0, 3:], 0.0)
+
+
+def test_sequence_enumerate_erase_reshape_scatter():
+    ids = np.array([[1, 2, 3, 0], [4, 5, 0, 0]], np.int64)
+    ln = np.array([3, 2])
+    win = _np(F.sequence_enumerate(ids, ln, 2, pad_value=9))
+    np.testing.assert_allclose(win[0], [[1, 2], [2, 3], [3, 9], [9, 9]])
+    np.testing.assert_allclose(win[1], [[4, 5], [5, 9], [9, 9], [9, 9]])
+
+    out, nl = F.sequence_erase(np.array([[1, 7, 2, 7], [7, 7, 5, 0]], np.int64),
+                               np.array([4, 3]), [7])
+    np.testing.assert_allclose(_np(out)[0, :2], [1, 2])
+    np.testing.assert_allclose(_np(nl), [2, 1])
+
+    data = _randn(2, 4, 6)
+    out2, nl2 = F.sequence_reshape(paddle.to_tensor(data),
+                                   np.array([2, 4]), 12)
+    assert _np(out2).shape == (2, 2, 12)
+    np.testing.assert_allclose(_np(nl2), [1, 2])
+
+    base = np.zeros((2, 5), np.float32)
+    got = _np(F.sequence_scatter(paddle.to_tensor(base),
+                                 np.array([[0, 2], [1, 3]]),
+                                 paddle.to_tensor(np.ones((2, 2), np.float32)),
+                                 np.array([2, 1])))
+    np.testing.assert_allclose(got[0], [1, 0, 1, 0, 0])
+    np.testing.assert_allclose(got[1], [0, 1, 0, 0, 0])  # 2nd update masked
+
+
+def test_sequence_conv():
+    B, T, D, M, CL = 2, 4, 3, 5, 3
+    x = _randn(B, T, D)
+    ln = np.array([4, 2])
+    w = _randn(CL * D, M)
+    got = _np(F.sequence_conv(paddle.to_tensor(x), paddle.to_tensor(ln),
+                              paddle.to_tensor(w), CL))
+    # numpy reference: context [-1, 0, 1] rows (zero outside sequence)
+    for b in range(B):
+        for t in range(int(ln[b])):
+            ctx = []
+            for c in range(CL):
+                p = t + c - 1
+                ctx.append(x[b, p] if 0 <= p < ln[b] else np.zeros(D))
+            np.testing.assert_allclose(got[b, t], np.concatenate(ctx) @ w,
+                                       rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(got[b, int(ln[b]):], 0.0)
+
+
+# ------------------------------ misc ops ----------------------------------
+
+def test_misc_norms_and_sims():
+    x = _randn(3, 4)
+    np.testing.assert_allclose(float(_np(F.l1_norm(paddle.to_tensor(x)))),
+                               np.abs(x).sum(), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(_np(F.squared_l2_norm(paddle.to_tensor(x)))), (x * x).sum(),
+        rtol=1e-5)
+    y = _randn(3, 4)
+    got = _np(F.cos_sim(paddle.to_tensor(x), paddle.to_tensor(y)))
+    exp = (x * y).sum(1) / (np.linalg.norm(x, axis=1) * np.linalg.norm(y, axis=1))
+    np.testing.assert_allclose(got.ravel(), exp, rtol=1e-5)
+    # broadcast single-row y
+    got1 = _np(F.cos_sim(paddle.to_tensor(x), paddle.to_tensor(y[:1])))
+    exp1 = (x * y[:1]).sum(1) / (np.linalg.norm(x, axis=1) * np.linalg.norm(y[0]))
+    np.testing.assert_allclose(got1.ravel(), exp1, rtol=1e-5)
+
+
+def test_space_to_depth_matches_pixel_unshuffle_reorder():
+    x = np.arange(1 * 2 * 4 * 4, dtype=np.float32).reshape(1, 2, 4, 4)
+    got = _np(F.space_to_depth(paddle.to_tensor(x), 2))
+    assert got.shape == (1, 8, 2, 2)
+    # block (0,0) of channel 0 lands in the first output channel
+    np.testing.assert_allclose(got[0, 0], x[0, 0, 0::2, 0::2])
+
+
+def test_pad_constant_like_and_position_encoding():
+    x = np.zeros((3, 4), np.float32)
+    y = _randn(2, 3)
+    got = _np(F.pad_constant_like(paddle.to_tensor(x), paddle.to_tensor(y),
+                                  pad_value=7.0))
+    np.testing.assert_allclose(got[:2, :3], y)
+    np.testing.assert_allclose(got[2, :], 7.0)
+    np.testing.assert_allclose(got[:, 3], 7.0)
+
+    v = _randn(2, 5, 6)
+    pe = _np(F.add_position_encoding(paddle.to_tensor(v), alpha=2.0, beta=1.0))
+    half = 3
+    pos, i = 1, 0
+    expected = 2.0 * v[0, pos, i] + np.sin(pos / (10000 ** (i / half)))
+    np.testing.assert_allclose(pe[0, pos, i], expected, rtol=1e-5)
+    expected_cos = 2.0 * v[0, pos, half] + np.cos(pos / (10000 ** (0 / half)))
+    np.testing.assert_allclose(pe[0, pos, half], expected_cos, rtol=1e-5)
+
+
+def test_bilinear_tensor_product_and_conv_shift():
+    x, y = _randn(3, 4), _randn(3, 5)
+    w = _randn(2, 4, 5)
+    b = _randn(2)
+    got = _np(F.bilinear_tensor_product(paddle.to_tensor(x),
+                                        paddle.to_tensor(y),
+                                        paddle.to_tensor(w),
+                                        paddle.to_tensor(b)))
+    exp = np.stack([x @ w[k] @ y.T for k in range(2)], 1)
+    exp = np.stack([exp[i, :, i] for i in range(3)]) + b
+    np.testing.assert_allclose(got, exp, rtol=1e-4)
+
+    a = _randn(2, 6)
+    k = _randn(2, 3)
+    got = _np(F.conv_shift(paddle.to_tensor(a), paddle.to_tensor(k)))
+    exp = np.zeros((2, 6), np.float32)
+    for b_ in range(2):
+        for i in range(6):
+            for j in range(3):
+                exp[b_, i] += a[b_, (i + j - 1) % 6] * k[b_, j]
+    np.testing.assert_allclose(got, exp, rtol=1e-4)
+
+
+def test_row_conv():
+    x = _randn(2, 5, 3)
+    w = _randn(3, 3)  # future_context=3
+    ln = np.array([5, 3])
+    got = _np(F.row_conv(paddle.to_tensor(x), paddle.to_tensor(w),
+                         paddle.to_tensor(ln)))
+    for b in range(2):
+        for t in range(int(ln[b])):
+            exp = np.zeros(3, np.float32)
+            for c in range(3):
+                if t + c < ln[b]:
+                    exp += w[c] * x[b, t + c]
+            np.testing.assert_allclose(got[b, t], exp, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(got[b, int(ln[b]):], 0.0)
+
+
+def test_im2sequence_partial_and_shuffle():
+    x = np.arange(1 * 1 * 4 * 4, dtype=np.float32).reshape(1, 1, 4, 4)
+    got = _np(F.im2sequence(paddle.to_tensor(x), 2, 2))
+    assert got.shape == (1, 4, 4)
+    np.testing.assert_allclose(got[0, 0], [0, 1, 4, 5])
+    np.testing.assert_allclose(got[0, 3], [10, 11, 14, 15])
+
+    a, b = _randn(2, 4), _randn(2, 4)
+    pc = _np(F.partial_concat([paddle.to_tensor(a), paddle.to_tensor(b)],
+                              start_index=1, length=2))
+    np.testing.assert_allclose(pc, np.concatenate([a[:, 1:3], b[:, 1:3]], 1))
+    ps = _np(F.partial_sum([paddle.to_tensor(a), paddle.to_tensor(b)],
+                           start_index=1, length=2))
+    np.testing.assert_allclose(ps, a[:, 1:3] + b[:, 1:3])
+
+    paddle.seed(5)
+    sb = _np(F.shuffle_batch(paddle.to_tensor(a)))
+    assert sorted(sb[:, 0].tolist()) == sorted(a[:, 0].tolist())
+
+    paddle.seed(5)
+    probs = np.array([[0.0, 1.0, 0.0], [0.0, 0.0, 1.0]], np.float32)
+    sid = _np(F.sampling_id(paddle.to_tensor(probs)))
+    np.testing.assert_allclose(sid, [1, 2])
+
+
+# ------------------------- detection additions ----------------------------
+
+def test_anchor_generator():
+    x = paddle.to_tensor(np.zeros((1, 8, 2, 3), np.float32))
+    anchors, variances = V.anchor_generator(
+        x, anchor_sizes=[64.0], aspect_ratios=[1.0, 2.0],
+        variances=[0.1, 0.1, 0.2, 0.2], stride=[16.0, 16.0], offset=0.5)
+    a = _np(anchors)
+    assert a.shape == (2, 3, 2, 4)
+    # reference math at cell (0, 0), ar=1, size=64:
+    xc = 0.5 * 15
+    base = round(np.sqrt(16 * 16 / 1.0))
+    aw = 64 / 16 * base
+    np.testing.assert_allclose(a[0, 0, 0],
+                               [xc - 0.5 * (aw - 1), xc - 0.5 * (aw - 1),
+                                xc + 0.5 * (aw - 1), xc + 0.5 * (aw - 1)])
+    v = _np(variances)
+    np.testing.assert_allclose(v[1, 2, 1], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_box_clip_and_target_assign():
+    boxes = np.array([[[-5, -5, 50, 60], [10, 10, 20, 20]]], np.float32)
+    im = np.array([[40.0, 30.0, 1.0]], np.float32)
+    got = _np(V.box_clip(paddle.to_tensor(boxes), paddle.to_tensor(im)))
+    np.testing.assert_allclose(got[0, 0], [0, 0, 29, 39])
+    np.testing.assert_allclose(got[0, 1], [10, 10, 20, 20])
+
+    x = _randn(1, 3, 2)
+    mi = np.array([[2, -1, 0, 1]], np.int64)
+    out, wt = V.target_assign(paddle.to_tensor(x), paddle.to_tensor(mi),
+                              mismatch_value=5.0)
+    o, w = _np(out), _np(wt)
+    np.testing.assert_allclose(o[0, 0], x[0, 2])
+    np.testing.assert_allclose(o[0, 1], 5.0)
+    np.testing.assert_allclose(w.ravel(), [1, 0, 1, 1])
+    # negative indices force mismatch_value with weight 1
+    out2, wt2 = V.target_assign(paddle.to_tensor(x), paddle.to_tensor(mi),
+                                negative_indices=np.array([[3]], np.int64),
+                                mismatch_value=5.0)
+    np.testing.assert_allclose(_np(out2)[0, 3], 5.0)
+    np.testing.assert_allclose(_np(wt2).ravel(), [1, 0, 1, 1])
+
+
+def _yolo_loss_np(x, gt_box, gt_label, gt_score, anchors, anchor_mask,
+                  class_num, ignore_thresh, downsample_ratio,
+                  use_label_smooth=True, scale_xy=1.0):
+    """Loop-for-loop port of yolov3_loss_op.h Compute (the oracle)."""
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    def sce(p, t):
+        return max(p, 0) - p * t + np.log1p(np.exp(-abs(p)))
+
+    def iou_cwh(a, b):
+        ax1, ay1, ax2, ay2 = a[0] - a[2] / 2, a[1] - a[3] / 2, a[0] + a[2] / 2, a[1] + a[3] / 2
+        bx1, by1, bx2, by2 = b[0] - b[2] / 2, b[1] - b[3] / 2, b[0] + b[2] / 2, b[1] + b[3] / 2
+        iw = max(0.0, min(ax2, bx2) - max(ax1, bx1))
+        ih = max(0.0, min(ay2, by2) - max(ay1, by1))
+        inter = iw * ih
+        return inter / max(a[2] * a[3] + b[2] * b[3] - inter, 1e-10)
+
+    N, _, H, W = x.shape
+    mask_num = len(anchor_mask)
+    an_num = len(anchors) // 2
+    input_size = downsample_ratio * H
+    xr = x.reshape(N, mask_num, 5 + class_num, H, W)
+    smooth = min(1.0 / class_num, 1.0 / 40) if use_label_smooth else 0.0
+    pos_l, neg_l = 1 - smooth, smooth
+    bias = -0.5 * (scale_xy - 1)
+    loss = np.zeros(N)
+    for i in range(N):
+        obj = np.zeros((mask_num, H, W))
+        for j in range(mask_num):
+            for k in range(H):
+                for l in range(W):
+                    px = (l + sig(xr[i, j, 0, k, l]) * scale_xy + bias) / W
+                    py = (k + sig(xr[i, j, 1, k, l]) * scale_xy + bias) / H
+                    pw = np.exp(xr[i, j, 2, k, l]) * anchors[2 * anchor_mask[j]] / input_size
+                    ph = np.exp(xr[i, j, 3, k, l]) * anchors[2 * anchor_mask[j] + 1] / input_size
+                    best = 0.0
+                    for t in range(gt_box.shape[1]):
+                        if gt_box[i, t, 2] <= 0 or gt_box[i, t, 3] <= 0:
+                            continue
+                        best = max(best, iou_cwh((px, py, pw, ph), gt_box[i, t]))
+                    if best > ignore_thresh:
+                        obj[j, k, l] = -1
+        for t in range(gt_box.shape[1]):
+            gt = gt_box[i, t]
+            if gt[2] <= 0 or gt[3] <= 0:
+                continue
+            gi, gj = int(gt[0] * W), int(gt[1] * H)
+            best_iou, best_n = 0.0, 0
+            for a_ in range(an_num):
+                cand = (0, 0, anchors[2 * a_] / input_size,
+                        anchors[2 * a_ + 1] / input_size)
+                iou = iou_cwh(cand, (0, 0, gt[2], gt[3]))
+                if iou > best_iou:
+                    best_iou, best_n = iou, a_
+            if best_n not in anchor_mask:
+                continue
+            mj = anchor_mask.index(best_n)
+            score = gt_score[i, t]
+            tx, ty = gt[0] * W - gi, gt[1] * H - gj
+            tw = np.log(gt[2] * input_size / anchors[2 * best_n])
+            th = np.log(gt[3] * input_size / anchors[2 * best_n + 1])
+            sc = (2 - gt[2] * gt[3]) * score
+            loss[i] += sce(xr[i, mj, 0, gj, gi], tx) * sc
+            loss[i] += sce(xr[i, mj, 1, gj, gi], ty) * sc
+            loss[i] += abs(xr[i, mj, 2, gj, gi] - tw) * sc
+            loss[i] += abs(xr[i, mj, 3, gj, gi] - th) * sc
+            for c in range(class_num):
+                tgt = pos_l if c == gt_label[i, t] else neg_l
+                loss[i] += sce(xr[i, mj, 5 + c, gj, gi], tgt) * score
+            obj[mj, gj, gi] = score
+        for j in range(mask_num):
+            for k in range(H):
+                for l in range(W):
+                    o = obj[j, k, l]
+                    if o > 1e-5:
+                        loss[i] += sce(xr[i, j, 4, k, l], 1.0) * o
+                    elif o > -0.5:
+                        loss[i] += sce(xr[i, j, 4, k, l], 0.0)
+    return loss
+
+
+def test_yolov3_loss_vs_reference_port():
+    N, H, W, C = 2, 4, 4, 3
+    anchors = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119]
+    anchor_mask = [0, 1, 2]
+    mask_num = len(anchor_mask)
+    x = _randn(N, mask_num * (5 + C), H, W) * 0.5
+    gt_box = np.zeros((N, 3, 4), np.float32)
+    gt_box[0, 0] = [0.3, 0.4, 0.2, 0.2]
+    gt_box[0, 1] = [0.7, 0.6, 0.4, 0.5]
+    gt_box[1, 0] = [0.5, 0.5, 0.1, 0.3]
+    gt_label = rng.randint(0, C, (N, 3)).astype(np.int64)
+    gt_score = np.ones((N, 3), np.float32)
+    got = _np(V.yolov3_loss(paddle.to_tensor(x), paddle.to_tensor(gt_box),
+                            paddle.to_tensor(gt_label), anchors, anchor_mask,
+                            C, ignore_thresh=0.5, downsample_ratio=8))
+    exp = _yolo_loss_np(x, gt_box, gt_label, gt_score, anchors, anchor_mask,
+                        C, 0.5, 8)
+    np.testing.assert_allclose(got, exp, rtol=1e-4)
+    # grad flows through predictions
+    xt = paddle.to_tensor(x)
+    xt.stop_gradient = False
+    V.yolov3_loss(xt, paddle.to_tensor(gt_box), paddle.to_tensor(gt_label),
+                  anchors, anchor_mask, C, 0.5, 8).sum().backward()
+    g = _np(xt.grad)
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_review_fixes_batch7():
+    # im2sequence asymmetric [top, left, bottom, right] padding
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    got = _np(F.im2sequence(paddle.to_tensor(x), 2, 2, padding=[1, 0, 1, 0]))
+    assert got.shape == (1, 3 * 2, 4)  # oh=(4+2-2)/2+1=3, ow=2
+
+    # shuffle_batch: fresh permutation per call under default seed
+    paddle.seed(3)
+    big = np.arange(64, dtype=np.float32).reshape(64, 1)
+    p1 = _np(F.shuffle_batch(paddle.to_tensor(big))).ravel()
+    p2 = _np(F.shuffle_batch(paddle.to_tensor(big))).ravel()
+    assert not np.array_equal(p1, p2)
+
+    # partial_concat with negative start_index counts from the end
+    a = _randn(2, 4)
+    pc = _np(F.partial_concat([paddle.to_tensor(a)], start_index=-2, length=2))
+    np.testing.assert_allclose(pc, a[:, -2:])
+
+    # target_assign 2-D negative indices get mismatch_value
+    lab = np.array([[7, 8, 9]], np.float32)
+    mi = np.array([[1, 0, 2, 0]], np.int64)
+    out, wt = V.target_assign(paddle.to_tensor(lab), paddle.to_tensor(mi),
+                              negative_indices=np.array([[3]], np.int64),
+                              mismatch_value=0.0)
+    np.testing.assert_allclose(_np(out).ravel(), [8, 7, 9, 0])
+    np.testing.assert_allclose(_np(wt).ravel(), [1, 1, 1, 1])
+
+
+def test_yolov3_loss_cell_collision_later_gt_wins():
+    N, H, W, C = 1, 4, 4, 2
+    anchors = [10, 13, 16, 30]
+    anchor_mask = [0, 1]
+    x = _randn(N, 2 * (5 + C), H, W) * 0.3
+    # two gts in the SAME cell matching the same anchor, different scores
+    gt_box = np.zeros((N, 2, 4), np.float32)
+    gt_box[0, 0] = [0.3, 0.3, 0.08, 0.10]
+    gt_box[0, 1] = [0.3, 0.3, 0.08, 0.11]
+    gt_label = np.array([[0, 1]], np.int64)
+    gt_score = np.array([[0.4, 0.9]], np.float32)
+    got = _np(V.yolov3_loss(paddle.to_tensor(x), paddle.to_tensor(gt_box),
+                            paddle.to_tensor(gt_label), anchors, anchor_mask,
+                            C, 0.7, 8, gt_score=paddle.to_tensor(gt_score)))
+    exp = _yolo_loss_np(x, gt_box, gt_label, gt_score, anchors, anchor_mask,
+                        C, 0.7, 8)
+    np.testing.assert_allclose(got, exp, rtol=1e-4)
