@@ -478,3 +478,81 @@ def shuffle_batch(x, seed=0, name=None):
     perm = jax.random.permutation(key, v.shape[0])
     out = apply(lambda a: a[perm], v)
     return out
+
+
+def cvm(input, cvm_info, use_cvm=True, name=None):
+    """cvm_op.h parity (CTR show/click features): with use_cvm the first two
+    columns become log(show+1) and log(click+1)-log(show+1); without it they
+    are dropped."""
+    def fn(x):
+        c0 = jnp.log(x[:, 0:1] + 1.0)
+        c1 = jnp.log(x[:, 1:2] + 1.0) - c0
+        if use_cvm:
+            return jnp.concatenate([c0, c1, x[:, 2:]], axis=1)
+        return x[:, 2:]
+
+    return apply(fn, _t(input))
+
+
+def data_norm(x, batch_size, batch_sum, batch_square_sum, name=None):
+    """data_norm_op.cc parity (:302-330): y = (x - batch_sum/batch_size) *
+    sqrt(batch_size / batch_square_sum) — the PS-CTR running-stat normalizer."""
+    def fn(v, bsz, bsum, bsq):
+        mean = bsum / bsz
+        scale = jnp.sqrt(bsz / bsq)
+        return (v - mean[None, :]) * scale[None, :]
+
+    return apply(fn, _t(x), _t(batch_size).detach(), _t(batch_sum).detach(),
+                 _t(batch_square_sum).detach())
+
+
+def affine_channel(x, scale, bias, data_format="NCHW", name=None):
+    """affine_channel_op.cc parity: per-channel y = x*scale[c] + bias[c]."""
+    def fn(v, s, b):
+        if data_format == "NCHW":
+            shape = (1, -1) + (1,) * (v.ndim - 2)
+        else:
+            shape = (1,) * (v.ndim - 1) + (-1,)
+        return v * s.reshape(shape) + b.reshape(shape)
+
+    return apply(fn, _t(x), _t(scale), _t(bias))
+
+
+def ctc_align(input, input_length, blank=0, merge_repeated=True,
+              padding_value=0, name=None):
+    """ctc_align_op.h parity (CTC greedy-decode postprocess): drop blanks,
+    optionally merge repeats, left-compact; returns (ids, lengths)."""
+    def fn(v, ln):
+        B, T = v.shape
+        ln = ln.reshape(-1).astype(jnp.int32)
+        valid = jnp.arange(T)[None, :] < ln[:, None]
+        prev = jnp.concatenate([jnp.full((B, 1), -1, v.dtype), v[:, :-1]],
+                               axis=1)
+        keep = valid & (v != blank)
+        if merge_repeated:
+            keep = keep & (v != prev)
+        dest = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+        dest = jnp.where(keep, dest, T)
+        bidx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+        out = jnp.full((B, T + 1), padding_value, v.dtype).at[
+            bidx.reshape(-1), dest.reshape(-1)].set(v.reshape(-1))[:, :T]
+        # .at[].set on the dump column may leave stale values; re-fill padding
+        newlen = jnp.sum(keep, axis=1)
+        pad_mask = jnp.arange(T)[None, :] >= newlen[:, None]
+        out = jnp.where(pad_mask, padding_value, out)
+        return out, newlen
+
+    ids, lens = apply(fn, _t(input).detach(), _t(input_length).detach())
+    ids.stop_gradient = True
+    lens.stop_gradient = True
+    return ids, lens
+
+
+def fsp_matrix(x, y, name=None):
+    """fsp_op.h parity (flow-of-solution-procedure distillation matrix):
+    x [B, Cx, H, W], y [B, Cy, H, W] -> [B, Cx, Cy] = x·y^T / (H*W)."""
+    def fn(a, b):
+        B, Cx, H, W = a.shape
+        return jnp.einsum("bchw,bdhw->bcd", a, b) / (H * W)
+
+    return apply(fn, _t(x), _t(y))

@@ -433,3 +433,55 @@ def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
         return (sp - x * clk) + has_teacher * (sp - x * zprime)
 
     return apply(fn, _t(input), _t(label).detach())
+
+
+def bpr_loss(input, label, name=None):
+    """bpr_loss_op.h parity (Bayesian Personalized Ranking): per row,
+    -mean over j != label of log(sigmoid(x[label] - x[j]))."""
+    def fn(x, y):
+        N, C = x.shape
+        y = y.reshape(-1).astype(jnp.int32)
+        pos = jnp.take_along_axis(x, y[:, None], axis=1)       # [N, 1]
+        d = pos - x                                            # [N, C]
+        # -log(sigmoid(d)) = softplus(-d)
+        sp = jnp.maximum(-d, 0) + jnp.log1p(jnp.exp(-jnp.abs(d)))
+        mask = jax.nn.one_hot(y, C, dtype=x.dtype)
+        return (jnp.sum(sp * (1 - mask), axis=1) / (C - 1))[:, None]
+
+    return apply(fn, _t(input), _t(label).detach())
+
+
+def modified_huber_loss(input, label, name=None):
+    """modified_huber_loss_op.h parity: v = x*(2y-1);
+    loss = -4v if v < -1 else (1-v)^2 if v < 1 else 0."""
+    def fn(x, y):
+        v = x * (2.0 * y - 1.0)
+        return jnp.where(v < -1.0, -4.0 * v,
+                         jnp.where(v < 1.0, (1.0 - v) ** 2, 0.0))
+
+    return apply(fn, _t(input), _t(label).detach())
+
+
+def center_loss(input, label, num_classes, alpha, centers, update_center=True,
+                name=None):
+    """center_loss_op.h parity: loss = 0.5*||x - centers[label]||^2 per row;
+    when update_center, centers[c] -= alpha * sum_{i:y=c}(centers[c]-x_i) /
+    (1 + count_c). Returns (loss [N, 1], centers_out [num_classes, D])."""
+    x = _t(input)
+    lab = _t(label).detach()
+    cen = _t(centers)
+
+    def fn(xv, yv, cv):
+        yv = yv.reshape(-1).astype(jnp.int32)
+        sel = cv[yv]                                           # [N, D]
+        diff = sel - xv
+        loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+        cnt = jnp.zeros((num_classes,), xv.dtype).at[yv].add(1.0)
+        acc = jnp.zeros_like(cv).at[yv].add(diff)
+        new_c = cv - alpha * acc / (1.0 + cnt[:, None])
+        return loss, new_c
+
+    loss, new_centers = apply(fn, x, lab, cen)
+    if update_center:
+        cen._data = new_centers._data.astype(cen._data.dtype)
+    return loss, new_centers

@@ -190,3 +190,18 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="N
         return flat.reshape(b, c, oh, ow)
 
     return apply(fn, _t(x), _t(indices).detach())
+
+
+def spp(x, pyramid_height=3, pool_type="max", name=None):
+    """spp_op parity (Spatial Pyramid Pooling): concat adaptive pools at
+    2^l x 2^l bins for l in [0, pyramid_height), flattened per level."""
+    outs = []
+    for l in range(pyramid_height):
+        bins = 2 ** l
+        pooled = (adaptive_max_pool2d(x, bins) if pool_type == "max"
+                  else adaptive_avg_pool2d(x, bins))
+        n, c = pooled.shape[0], pooled.shape[1]
+        outs.append(pooled.reshape([n, c * bins * bins]))
+    from ...tensor.manipulation import concat
+
+    return concat(outs, axis=1)

@@ -470,3 +470,100 @@ def test_yolov3_loss_cell_collision_later_gt_wins():
     exp = _yolo_loss_np(x, gt_box, gt_label, gt_score, anchors, anchor_mask,
                         C, 0.7, 8)
     np.testing.assert_allclose(got, exp, rtol=1e-4)
+
+
+def test_batch8_losses():
+    # bpr_loss vs loop
+    x = _randn(3, 4)
+    y = np.array([1, 0, 3], np.int64)
+    got = _np(F.bpr_loss(paddle.to_tensor(x), paddle.to_tensor(y))).ravel()
+    exp = np.zeros(3)
+    for i in range(3):
+        s = 0.0
+        for j in range(4):
+            if j == y[i]:
+                continue
+            s += -np.log(1 / (1 + np.exp(-(x[i, y[i]] - x[i, j]))))
+        exp[i] = s / 3
+    np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+    # modified huber: v<-1 -> -4v ; v<1 -> (1-v)^2 ; else 0
+    xs = np.array([-2.0, 0.5, 3.0], np.float32)
+    ys = np.array([1.0, 1.0, 1.0], np.float32)
+    got = _np(F.modified_huber_loss(paddle.to_tensor(xs), paddle.to_tensor(ys)))
+    np.testing.assert_allclose(got, [8.0, 0.25, 0.0])
+
+    # center_loss: loss + center update rule
+    feat = _randn(4, 3)
+    lab = np.array([0, 1, 1, 2], np.int64)
+    centers0 = _randn(5, 3).copy()
+    ct = paddle.to_tensor(centers0.copy())
+    loss, new_c = F.center_loss(paddle.to_tensor(feat), paddle.to_tensor(lab),
+                                5, 0.1, ct)
+    np.testing.assert_allclose(
+        _np(loss).ravel(),
+        [0.5 * ((centers0[c] - feat[i]) ** 2).sum()
+         for i, c in enumerate(lab)], rtol=1e-5)
+    exp_c = centers0.copy()
+    for c in range(5):
+        idx = np.nonzero(lab == c)[0]
+        if len(idx):
+            diff = (centers0[c] - feat[idx]).sum(0)
+            exp_c[c] -= 0.1 * diff / (1 + len(idx))
+    np.testing.assert_allclose(_np(new_c), exp_c, rtol=1e-5)
+    np.testing.assert_allclose(_np(ct), exp_c, rtol=1e-5)  # updated in place
+
+
+def test_batch8_feature_ops():
+    # cvm
+    x = np.abs(_randn(2, 4)) + 0.5
+    got = _np(F.cvm(paddle.to_tensor(x), None, use_cvm=True))
+    np.testing.assert_allclose(got[:, 0], np.log(x[:, 0] + 1), rtol=1e-5)
+    np.testing.assert_allclose(got[:, 1],
+                               np.log(x[:, 1] + 1) - np.log(x[:, 0] + 1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(got[:, 2:], x[:, 2:])
+    got2 = _np(F.cvm(paddle.to_tensor(x), None, use_cvm=False))
+    np.testing.assert_allclose(got2, x[:, 2:])
+
+    # data_norm: y = (x - sum/size) * sqrt(size/square_sum)
+    xv = _randn(3, 2)
+    bsz = np.array([4.0, 4.0], np.float32)
+    bsum = np.array([2.0, -1.0], np.float32)
+    bsq = np.array([9.0, 16.0], np.float32)
+    got = _np(F.data_norm(paddle.to_tensor(xv), paddle.to_tensor(bsz),
+                          paddle.to_tensor(bsum), paddle.to_tensor(bsq)))
+    np.testing.assert_allclose(
+        got, (xv - bsum / bsz) * np.sqrt(bsz / bsq), rtol=1e-5)
+
+    # affine_channel
+    img = _randn(2, 3, 2, 2)
+    s = _randn(3)
+    b = _randn(3)
+    got = _np(F.affine_channel(paddle.to_tensor(img), paddle.to_tensor(s),
+                               paddle.to_tensor(b)))
+    np.testing.assert_allclose(got, img * s[None, :, None, None]
+                               + b[None, :, None, None], rtol=1e-5)
+
+    # ctc_align: merge repeats then drop blanks
+    ids = np.array([[1, 1, 0, 2, 2, 3], [0, 0, 4, 4, 0, 0]], np.int64)
+    ln = np.array([6, 4])
+    out, nl = F.ctc_align(paddle.to_tensor(ids), paddle.to_tensor(ln),
+                          blank=0, merge_repeated=True)
+    np.testing.assert_allclose(_np(out)[0, :3], [1, 2, 3])
+    np.testing.assert_allclose(_np(out)[0, 3:], 0)
+    np.testing.assert_allclose(_np(out)[1, :1], [4])
+    np.testing.assert_allclose(_np(nl), [3, 1])
+
+    # fsp matrix
+    a = _randn(2, 3, 4, 4)
+    bb = _randn(2, 5, 4, 4)
+    got = _np(F.fsp_matrix(paddle.to_tensor(a), paddle.to_tensor(bb)))
+    exp = np.einsum("bchw,bdhw->bcd", a, bb) / 16
+    np.testing.assert_allclose(got, exp, rtol=1e-4)
+
+    # spp output size: C * (1 + 4 + 16)
+    img2 = _randn(2, 3, 8, 8)
+    got = _np(F.spp(paddle.to_tensor(img2), 3, "max"))
+    assert got.shape == (2, 3 * 21)
+    np.testing.assert_allclose(got[:, :3], img2.max(axis=(2, 3)), rtol=1e-5)
