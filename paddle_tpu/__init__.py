@@ -80,6 +80,12 @@ from . import onnx  # noqa: E402
 from . import quantization  # noqa: E402
 from . import profiler as profiler  # noqa: E402
 from . import utils  # noqa: E402
+from . import regularizer  # noqa: E402
+from . import compat  # noqa: E402
+from . import sysconfig  # noqa: E402
+from . import reader  # noqa: E402
+from . import dataset  # noqa: E402
+from .batch import batch  # noqa: E402
 from .autograd import grad  # noqa: E402
 from .framework import io as _fio  # noqa: E402
 from .hapi import callbacks  # noqa: E402

@@ -1,0 +1,36 @@
+"""paddle.compat parity (python/paddle/compat.py): py2/py3 helpers the fluid
+API surface still references."""
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    if isinstance(obj, (bytes, bytearray)):
+        return obj.decode(encoding)
+    if isinstance(obj, list):
+        return [to_text(o, encoding) for o in obj]
+    if isinstance(obj, set):
+        return {to_text(o, encoding) for o in obj}
+    return str(obj) if not isinstance(obj, str) else obj
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    if isinstance(obj, list):
+        return [to_bytes(o, encoding) for o in obj]
+    if isinstance(obj, set):
+        return {to_bytes(o, encoding) for o in obj}
+    return obj
+
+
+def round(x, d=0):
+    import builtins
+
+    return builtins.round(x, d)
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    return str(exc)

@@ -1,0 +1,190 @@
+"""paddle.dataset parity (python/paddle/dataset/) — the fluid-era
+reader-creator API: `paddle.dataset.uci_housing.train()` returns a generator
+creator yielding per-sample tuples, composable with paddle.batch /
+paddle.reader decorators.
+
+TPU-native stance: these are thin adapters over the 2.x map-style datasets in
+paddle_tpu.vision.datasets / paddle_tpu.text.datasets (which parse the real
+archive formats when given data files and fall back to deterministic synthetic
+samples without them); the reader-creator protocol itself is pure python.
+"""
+import types
+
+import numpy as np
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "imikolov", "movielens",
+           "wmt14", "wmt16", "conll05", "flowers", "voc2012", "common"]
+
+
+def _creator(ds_factory, mapper=None):
+    def reader():
+        ds = ds_factory()
+        for i in range(len(ds)):
+            sample = ds[i]
+            yield mapper(sample) if mapper else tuple(
+                np.asarray(getattr(p, "_data", p)) for p in sample)
+
+    return reader
+
+
+def _module(name, **fns):
+    m = types.ModuleType(f"{__name__}.{name}")
+    for k, v in fns.items():
+        setattr(m, k, v)
+    return m
+
+
+def _mnist_mod():
+    from ..vision.datasets import MNIST
+
+    return _module(
+        "mnist",
+        train=lambda: _creator(lambda: MNIST(mode="train")),
+        test=lambda: _creator(lambda: MNIST(mode="test")),
+    )
+
+
+def _cifar_mod():
+    from ..vision.datasets import Cifar10, Cifar100
+
+    return _module(
+        "cifar",
+        train10=lambda: _creator(lambda: Cifar10(mode="train")),
+        test10=lambda: _creator(lambda: Cifar10(mode="test")),
+        train100=lambda: _creator(lambda: Cifar100(mode="train")),
+        test100=lambda: _creator(lambda: Cifar100(mode="test")),
+    )
+
+
+def _uci_mod():
+    from ..text.datasets import UCIHousing
+
+    return _module(
+        "uci_housing",
+        train=lambda: _creator(lambda: UCIHousing(mode="train")),
+        test=lambda: _creator(lambda: UCIHousing(mode="test")),
+        feature_names=["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE",
+                       "DIS", "RAD", "TAX", "PTRATIO", "B", "LSTAT"],
+    )
+
+
+def _imdb_mod():
+    from ..text.datasets import Imdb
+
+    def word_dict(cutoff=150):
+        ds = Imdb(mode="train", cutoff=cutoff)
+        if hasattr(ds, "word_idx"):      # real aclImdb archive parsed
+            return ds.word_idx
+        # synthetic fallback: deterministic ids over the synthetic vocab
+        return {f"w{i}".encode(): i for i in range(ds.VOCAB)}
+
+    return _module(
+        "imdb",
+        train=lambda word_idx=None: _creator(lambda: Imdb(mode="train")),
+        test=lambda word_idx=None: _creator(lambda: Imdb(mode="test")),
+        word_dict=word_dict,
+    )
+
+
+def _imikolov_mod():
+    from ..text.datasets import Imikolov
+
+    def build_dict(min_word_freq=50):
+        return Imikolov(mode="train", min_word_freq=min_word_freq).word_idx
+
+    return _module(
+        "imikolov",
+        train=lambda word_idx=None, n=5: _creator(
+            lambda: Imikolov(mode="train", window_size=n)),
+        test=lambda word_idx=None, n=5: _creator(
+            lambda: Imikolov(mode="test", window_size=n)),
+        build_dict=build_dict,
+    )
+
+
+def _movielens_mod():
+    from ..text.datasets import Movielens
+
+    return _module(
+        "movielens",
+        train=lambda: _creator(lambda: Movielens(mode="train")),
+        test=lambda: _creator(lambda: Movielens(mode="test")),
+    )
+
+
+def _wmt_mod(cls_name):
+    def make():
+        from .. import text
+
+        cls = getattr(text, cls_name)
+        return _module(
+            cls_name.lower(),
+            train=lambda dict_size=30000: _creator(
+                lambda: cls(mode="train", dict_size=dict_size)
+                if cls_name == "WMT14" else cls(mode="train")),
+            test=lambda dict_size=30000: _creator(
+                lambda: cls(mode="test", dict_size=dict_size)
+                if cls_name == "WMT14" else cls(mode="test")),
+        )
+
+    return make
+
+
+def _conll05_mod():
+    from ..text.datasets import Conll05st
+
+    return _module(
+        "conll05",
+        test=lambda: _creator(lambda: Conll05st(mode="test")),
+        get_dict=lambda: (lambda d: (d.word_dict, d.verb_dict, d.label_dict))(
+            Conll05st(mode="test")),
+    )
+
+
+def _flowers_mod():
+    from ..vision.datasets import Flowers
+
+    return _module(
+        "flowers",
+        train=lambda: _creator(lambda: Flowers(mode="train")),
+        test=lambda: _creator(lambda: Flowers(mode="test")),
+        valid=lambda: _creator(lambda: Flowers(mode="valid")),
+    )
+
+
+def _voc_mod():
+    from ..vision.datasets import VOC2012
+
+    return _module(
+        "voc2012",
+        train=lambda: _creator(lambda: VOC2012(mode="train")),
+        test=lambda: _creator(lambda: VOC2012(mode="test")),
+        val=lambda: _creator(lambda: VOC2012(mode="valid")),
+    )
+
+
+_LAZY = {
+    "mnist": _mnist_mod,
+    "cifar": _cifar_mod,
+    "uci_housing": _uci_mod,
+    "imdb": _imdb_mod,
+    "imikolov": _imikolov_mod,
+    "movielens": _movielens_mod,
+    "wmt14": _wmt_mod("WMT14"),
+    "wmt16": _wmt_mod("WMT16"),
+    "conll05": _conll05_mod,
+    "flowers": _flowers_mod,
+    "voc2012": _voc_mod,
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        mod = _LAZY[name]()
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+common = _module("common", md5file=lambda path: __import__("hashlib").md5(
+    open(path, "rb").read()).hexdigest())

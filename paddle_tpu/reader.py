@@ -1,0 +1,115 @@
+"""paddle.reader decorators (python/paddle/reader/decorator.py parity).
+
+The fluid-era data pipeline composes plain python generators; nothing here
+touches the device, so these are direct ports of the *semantics* (buffering
+through queues/threads collapses to plain generators — the TPU input pipeline
+proper lives in paddle_tpu.io.DataLoader)."""
+import itertools
+import random as _random
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain", "shuffle",
+           "ComposeNotAligned", "firstn", "xmap_readers",
+           "multiprocess_reader"]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def cache(reader):
+    """Cache all samples in memory on first pass."""
+    all_data = []
+    filled = []
+
+    def cached():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        yield from all_data
+
+    return cached
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def shuffled():
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return shuffled
+
+
+def chain(*readers):
+    def chained():
+        for r in readers:
+            yield from r()
+
+    return chained
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def composed():
+        rs = [r() for r in readers]
+        for parts in itertools.zip_longest(*rs):
+            if check_alignment and any(p is None for p in parts):
+                raise ComposeNotAligned(
+                    "outputs of readers are not aligned")
+            yield sum((make_tuple(p) for p in parts), ())
+
+    return composed
+
+
+def buffered(reader, size):
+    """Reference buffers through a thread+queue; the semantics (read-ahead of
+    `size` samples) reduce to eager chunking for a single-host pipeline."""
+    def buffered_reader():
+        it = reader()
+        while True:
+            chunk = list(itertools.islice(it, size))
+            if not chunk:
+                return
+            yield from chunk
+
+    return buffered_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        yield from itertools.islice(reader(), n)
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader. process_num/buffer_size are accepted for
+    API parity; mapping runs in-process (XLA host callbacks own the threads)."""
+    def xreader():
+        for s in reader():
+            yield mapper(s)
+
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    return chain(*readers)

@@ -1,0 +1,10 @@
+"""paddle.sysconfig parity: include/lib dirs of the installed package."""
+import os
+
+
+def get_include():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "include")
+
+
+def get_lib():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")

@@ -1,0 +1,54 @@
+"""fluid-era data pipeline parity: paddle.batch + paddle.reader decorators +
+paddle.dataset reader creators (python/paddle/batch.py, reader/decorator.py,
+dataset/)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_batch_and_drop_last():
+    r = lambda: iter(range(10))
+    batches = list(paddle.batch(r, 3)())
+    assert batches[0] == [0, 1, 2] and batches[-1] == [9]
+    batches = list(paddle.batch(r, 3, drop_last=True)())
+    assert batches[-1] == [6, 7, 8] and len(batches) == 3
+
+
+def test_reader_decorators():
+    r = lambda: iter(range(6))
+    assert list(paddle.reader.firstn(r, 3)()) == [0, 1, 2]
+    assert list(paddle.reader.buffered(r, 2)()) == list(range(6))
+    assert list(paddle.reader.chain(r, r)()) == list(range(6)) * 2
+    assert sorted(paddle.reader.shuffle(r, 4)()) == list(range(6))
+    assert list(paddle.reader.map_readers(lambda a, b: a + b, r, r)()) == [
+        0, 2, 4, 6, 8, 10]
+    comp = paddle.reader.compose(r, r)
+    assert list(comp())[0] == (0, 0)
+    c = paddle.reader.cache(r)
+    assert list(c()) == list(range(6)) and list(c()) == list(range(6))
+    assert list(paddle.reader.xmap_readers(lambda x: x * 2, r, 2, 4)()) == [
+        0, 2, 4, 6, 8, 10]
+
+
+def test_dataset_reader_creators():
+    tr = paddle.dataset.uci_housing.train()
+    first = next(iter(tr()))
+    assert first[0].shape == (13,) and first[1].shape == (1,)
+    assert len(paddle.dataset.uci_housing.feature_names) == 13
+    # composes with paddle.batch
+    b = next(iter(paddle.batch(tr, 4)()))
+    assert len(b) == 4
+
+    mn = paddle.dataset.mnist.test()
+    img, lab = next(iter(mn()))
+    assert img.shape[-1] == 28 * 28 or img.shape == (28, 28) or img.shape == (1, 28, 28)
+
+    wd = paddle.dataset.imdb.word_dict()
+    assert len(wd) > 10
+
+
+def test_compat_and_sysconfig():
+    assert paddle.compat.to_text(b"abc") == "abc"
+    assert paddle.compat.to_bytes("abc") == b"abc"
+    assert paddle.sysconfig.get_lib().endswith("native")
+    assert paddle.regularizer.L2Decay(1e-4).coeff == 1e-4
