@@ -485,3 +485,56 @@ def center_loss(input, label, num_classes, alpha, centers, update_center=True,
     if update_center:
         cen._data = new_centers._data.astype(cen._data.dtype)
     return loss, new_centers
+
+
+def nce(input, label, weight, bias=None, num_total_classes=None,
+        num_neg_samples=10, sampler="uniform", custom_dist=None, seed=0,
+        sample_weight=None, name=None):
+    """nce_op.h parity (noise-contrastive estimation): o = sigmoid(w_c·x+b_c),
+    noise mass b = k*P(c); cost = -log(o/(o+b)) for the true class and
+    -log(b/(o+b)) for each sampled negative (:202-205). Negatives are drawn
+    host-side per call (uniform / log_uniform / custom_dist) — `seed` makes
+    the draw deterministic like the reference attribute."""
+    x = _t(input)
+    lab = _t(label).detach()
+    w = _t(weight)
+    R = num_total_classes if num_total_classes is not None else w.shape[0]
+    B = x.shape[0]
+
+    rng_ = np.random.RandomState(seed if seed else None)
+    if sampler == "uniform":
+        neg = rng_.randint(0, R, size=(B, num_neg_samples))
+        probs = np.full(R, 1.0 / R)
+    elif sampler == "log_uniform":
+        u = rng_.rand(B, num_neg_samples)
+        neg = (np.exp(u * np.log(R + 1.0)) - 1.0).astype(np.int64) % R
+        ranks = np.arange(R, dtype=np.float64)
+        probs = (np.log((ranks + 2.0) / (ranks + 1.0))) / np.log(R + 1.0)
+    elif sampler == "custom_dist":
+        probs = np.asarray(custom_dist, np.float64)
+        probs = probs / probs.sum()
+        neg = np.stack([rng_.choice(R, size=num_neg_samples, p=probs)
+                        for _ in range(B)])
+    else:
+        raise ValueError(f"unknown sampler {sampler}")
+    probs_j = jnp.asarray(probs.astype(np.float32))
+    neg_j = jnp.asarray(neg.astype(np.int32))
+
+    args = [x, lab, w]
+    if bias is not None:
+        args.append(_t(bias))
+
+    def fn(xv, yv, wv, *bb):
+        yv = yv.reshape(-1).astype(jnp.int32)
+        ids = jnp.concatenate([yv[:, None], neg_j], axis=1)   # [B, 1+k]
+        logits = jnp.einsum("bkd,bd->bk", wv[ids], xv)
+        if bb:
+            logits = logits + bb[0].reshape(-1)[ids]
+        o = jax.nn.sigmoid(logits)
+        noise = num_neg_samples * probs_j[ids]
+        cost_true = -jnp.log(o[:, :1] / (o[:, :1] + noise[:, :1]))
+        cost_neg = -jnp.log(noise[:, 1:] / (o[:, 1:] + noise[:, 1:]))
+        total = jnp.sum(cost_true, axis=1) + jnp.sum(cost_neg, axis=1)
+        return total[:, None]
+
+    return apply(fn, *args)

@@ -965,3 +965,71 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         return jnp.sum(per_gt, axis=1) + jnp.sum(obj_loss, axis=(1, 2, 3))
 
     return apply(fn, *args)
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variances, clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    """detection/density_prior_box_op.h parity: per-cell density-sampled SSD
+    priors. input [N, C, H, W] feature map, image [N, C, Hi, Wi]. Returns
+    (boxes [H, W, P, 4] normalized (or [H*W*P, 4] when flatten_to_2d),
+    variances same shape)."""
+    H, W = int(input.shape[2]), int(input.shape[3])
+    img_h, img_w = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] if steps[0] > 0 else img_w / W
+    step_h = steps[1] if steps[1] > 0 else img_h / H
+    step_avg = int(0.5 * (step_w + step_h))
+
+    boxes = []
+    for h in range(H):
+        for w in range(W):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            for fs, density in zip(fixed_sizes, densities):
+                shift = step_avg // density
+                for fr in fixed_ratios:
+                    bw = fs * np.sqrt(fr)
+                    bh = fs / np.sqrt(fr)
+                    dcx = cx - step_avg / 2.0 + shift / 2.0
+                    dcy = cy - step_avg / 2.0 + shift / 2.0
+                    for di in range(density):
+                        for dj in range(density):
+                            cxt = dcx + dj * shift
+                            cyt = dcy + di * shift
+                            boxes.append([
+                                max((cxt - bw / 2.0) / img_w, 0.0),
+                                max((cyt - bh / 2.0) / img_h, 0.0),
+                                min((cxt + bw / 2.0) / img_w, 1.0),
+                                min((cyt + bh / 2.0) / img_h, 1.0),
+                            ])
+    P = len(boxes) // (H * W)
+    arr = np.asarray(boxes, np.float32).reshape(H, W, P, 4)
+    if clip:
+        arr = np.clip(arr, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variances, np.float32), arr.shape).copy()
+    if flatten_to_2d:
+        arr = arr.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    b = Tensor(jnp.asarray(arr))
+    v = Tensor(jnp.asarray(var))
+    b.stop_gradient = True
+    v.stop_gradient = True
+    return b, v
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None, name=None):
+    """detection/collect_fpn_proposals_op.h parity: merge per-level RoIs,
+    keep the global top post_nms_top_n by score (inverse of
+    distribute_fpn_proposals). Eager, single-image LoD-free form."""
+    rois = np.concatenate([np.asarray(_t(r)._data).reshape(-1, 4)
+                           for r in multi_rois], axis=0)
+    scores = np.concatenate([np.asarray(_t(s)._data).reshape(-1)
+                             for s in multi_scores], axis=0)
+    k = min(post_nms_top_n, len(scores))
+    order = np.argsort(-scores, kind="stable")[:k]
+    out = Tensor(jnp.asarray(rois[order]))
+    out.stop_gradient = True
+    if rois_num_per_level is not None:
+        return out, Tensor(jnp.asarray(np.asarray([k], np.int32)))
+    return out

@@ -567,3 +567,70 @@ def test_batch8_feature_ops():
     got = _np(F.spp(paddle.to_tensor(img2), 3, "max"))
     assert got.shape == (2, 3 * 21)
     np.testing.assert_allclose(got[:, :3], img2.max(axis=(2, 3)), rtol=1e-5)
+
+
+def test_density_prior_box():
+    feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 16, 16), np.float32))
+    boxes, var = V.density_prior_box(
+        feat, img, densities=[2], fixed_sizes=[4.0], fixed_ratios=[1.0],
+        variances=[0.1, 0.1, 0.2, 0.2], offset=0.5)
+    b = _np(boxes)
+    assert b.shape == (2, 2, 4, 4)  # density^2 * ratios = 4 priors per cell
+    # loop-port of the reference kernel for cell (0, 0)
+    step_w = step_h = 8.0
+    step_avg = 8
+    shift = step_avg // 2
+    cx = cy = 0.5 * 8
+    dcx = cx - step_avg / 2 + shift / 2
+    exp0 = [max((dcx - 2) / 16, 0), max((dcx - 2) / 16, 0),
+            min((dcx + 2) / 16, 1), min((dcx + 2) / 16, 1)]
+    np.testing.assert_allclose(b[0, 0, 0], exp0, rtol=1e-5)
+    np.testing.assert_allclose(_np(var)[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+    # boxes all inside [0, 1]
+    assert (b >= 0).all() and (b <= 1).all()
+
+
+def test_collect_fpn_proposals():
+    r1 = np.array([[0, 0, 10, 10], [1, 1, 5, 5]], np.float32)
+    r2 = np.array([[2, 2, 8, 8]], np.float32)
+    s1 = np.array([0.9, 0.2], np.float32)
+    s2 = np.array([0.5], np.float32)
+    out = V.collect_fpn_proposals([r1, r2], [s1, s2], 2, 3, post_nms_top_n=2)
+    got = _np(out)
+    np.testing.assert_allclose(got[0], r1[0])  # score 0.9
+    np.testing.assert_allclose(got[1], r2[0])  # score 0.5
+
+
+def test_nce_vs_loop():
+    B, D, R, K = 3, 4, 7, 5
+    x = _randn(B, D)
+    w = _randn(R, D)
+    b = _randn(R)
+    lab = np.array([2, 0, 6], np.int64)
+    got = _np(F.nce(paddle.to_tensor(x), paddle.to_tensor(lab),
+                    paddle.to_tensor(w), paddle.to_tensor(b),
+                    num_total_classes=R, num_neg_samples=K,
+                    sampler="uniform", seed=9)).ravel()
+    # reproduce the draw and the reference cost (nce_op.h:202-205)
+    rng_ = np.random.RandomState(9)
+    neg = rng_.randint(0, R, size=(B, K))
+    exp = np.zeros(B)
+    for i in range(B):
+        ids = [lab[i]] + list(neg[i])
+        for j, c in enumerate(ids):
+            o = 1 / (1 + np.exp(-(w[c] @ x[i] + b[c])))
+            bb = K * (1.0 / R)
+            exp[i] += -np.log(o / (o + bb)) if j == 0 else -np.log(bb / (o + bb))
+    np.testing.assert_allclose(got, exp, rtol=1e-4)
+    # grads flow to input and weight
+    xt = paddle.to_tensor(x); xt.stop_gradient = False
+    wt = paddle.to_tensor(w); wt.stop_gradient = False
+    F.nce(xt, paddle.to_tensor(lab), wt, paddle.to_tensor(b),
+          num_total_classes=R, num_neg_samples=K, seed=9).sum().backward()
+    assert np.abs(_np(xt.grad)).sum() > 0 and np.abs(_np(wt.grad)).sum() > 0
+    # log_uniform sampler runs and is finite
+    got2 = _np(F.nce(paddle.to_tensor(x), paddle.to_tensor(lab),
+                     paddle.to_tensor(w), num_total_classes=R,
+                     num_neg_samples=K, sampler="log_uniform", seed=3))
+    assert np.isfinite(got2).all()
