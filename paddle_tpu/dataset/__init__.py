@@ -136,8 +136,7 @@ def _conll05_mod():
     return _module(
         "conll05",
         test=lambda: _creator(lambda: Conll05st(mode="test")),
-        get_dict=lambda: (lambda d: (d.word_dict, d.verb_dict, d.label_dict))(
-            Conll05st(mode="test")),
+        get_dict=lambda: Conll05st(mode="test").get_dict(),
     )
 
 

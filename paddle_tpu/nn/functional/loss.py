@@ -469,7 +469,10 @@ def center_loss(input, label, num_classes, alpha, centers, update_center=True,
     (1 + count_c). Returns (loss [N, 1], centers_out [num_classes, D])."""
     x = _t(input)
     lab = _t(label).detach()
-    cen = _t(centers)
+    orig = _t(centers)
+    # detached view: the reference CenterLossGradKernel emits no Centers grad
+    # — centers move ONLY through the explicit alpha update below
+    cen = orig.detach()
 
     def fn(xv, yv, cv):
         yv = yv.reshape(-1).astype(jnp.int32)
@@ -483,7 +486,7 @@ def center_loss(input, label, num_classes, alpha, centers, update_center=True,
 
     loss, new_centers = apply(fn, x, lab, cen)
     if update_center:
-        cen._data = new_centers._data.astype(cen._data.dtype)
+        orig._data = new_centers._data.astype(orig._data.dtype)
     return loss, new_centers
 
 
@@ -493,15 +496,17 @@ def nce(input, label, weight, bias=None, num_total_classes=None,
     """nce_op.h parity (noise-contrastive estimation): o = sigmoid(w_c·x+b_c),
     noise mass b = k*P(c); cost = -log(o/(o+b)) for the true class and
     -log(b/(o+b)) for each sampled negative (:202-205). Negatives are drawn
-    host-side per call (uniform / log_uniform / custom_dist) — `seed` makes
-    the draw deterministic like the reference attribute."""
+    host-side with RandomState(seed) — the reference kernel reseeds its
+    sampler from the `seed` attribute on every Compute, so a fixed seed
+    yields the same draw per call there too. Under jit the draw happens at
+    trace time (sample fresh per step by rebuilding the loss eagerly)."""
     x = _t(input)
     lab = _t(label).detach()
     w = _t(weight)
     R = num_total_classes if num_total_classes is not None else w.shape[0]
     B = x.shape[0]
 
-    rng_ = np.random.RandomState(seed if seed else None)
+    rng_ = np.random.RandomState(seed)
     if sampler == "uniform":
         neg = rng_.randint(0, R, size=(B, num_neg_samples))
         probs = np.full(R, 1.0 / R)

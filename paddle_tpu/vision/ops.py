@@ -967,6 +967,9 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     return apply(fn, *args)
 
 
+_DENSITY_PRIOR_CACHE = {}
+
+
 def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
                       variances, clip=False, steps=(0.0, 0.0), offset=0.5,
                       flatten_to_2d=False, name=None):
@@ -976,37 +979,51 @@ def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
     variances same shape)."""
     H, W = int(input.shape[2]), int(input.shape[3])
     img_h, img_w = int(image.shape[2]), int(image.shape[3])
-    step_w = steps[0] if steps[0] > 0 else img_w / W
-    step_h = steps[1] if steps[1] > 0 else img_h / H
-    step_avg = int(0.5 * (step_w + step_h))
+    key = (H, W, img_h, img_w, tuple(densities), tuple(fixed_sizes),
+           tuple(fixed_ratios), tuple(np.ravel(variances)), bool(clip),
+           tuple(steps), float(offset))
+    cached = _DENSITY_PRIOR_CACHE.get(key)
+    if cached is None:
+        step_w = steps[0] if steps[0] > 0 else img_w / W
+        step_h = steps[1] if steps[1] > 0 else img_h / H
+        step_avg = int(0.5 * (step_w + step_h))
 
-    boxes = []
-    for h in range(H):
-        for w in range(W):
-            cx = (w + offset) * step_w
-            cy = (h + offset) * step_h
-            for fs, density in zip(fixed_sizes, densities):
-                shift = step_avg // density
-                for fr in fixed_ratios:
-                    bw = fs * np.sqrt(fr)
-                    bh = fs / np.sqrt(fr)
-                    dcx = cx - step_avg / 2.0 + shift / 2.0
-                    dcy = cy - step_avg / 2.0 + shift / 2.0
-                    for di in range(density):
-                        for dj in range(density):
-                            cxt = dcx + dj * shift
-                            cyt = dcy + di * shift
-                            boxes.append([
-                                max((cxt - bw / 2.0) / img_w, 0.0),
-                                max((cyt - bh / 2.0) / img_h, 0.0),
-                                min((cxt + bw / 2.0) / img_w, 1.0),
-                                min((cyt + bh / 2.0) / img_h, 1.0),
-                            ])
-    P = len(boxes) // (H * W)
-    arr = np.asarray(boxes, np.float32).reshape(H, W, P, 4)
-    if clip:
-        arr = np.clip(arr, 0.0, 1.0)
-    var = np.broadcast_to(np.asarray(variances, np.float32), arr.shape).copy()
+        # vectorized over the grid: per-cell prior geometry is identical, so
+        # build the per-cell offsets once and broadcast-add the cell centers
+        cxs = (np.arange(W) + offset) * step_w                  # [W]
+        cys = (np.arange(H) + offset) * step_h                  # [H]
+        rel = []                                                # per-prior (dx, dy, bw, bh)
+        for fs, density in zip(fixed_sizes, densities):
+            shift = step_avg // density
+            base = -step_avg / 2.0 + shift / 2.0
+            for fr in fixed_ratios:
+                bw = fs * np.sqrt(fr)
+                bh = fs / np.sqrt(fr)
+                for di in range(density):
+                    for dj in range(density):
+                        rel.append((base + dj * shift, base + di * shift,
+                                    bw, bh))
+        rel = np.asarray(rel, np.float32)                       # [P, 4]
+        P = rel.shape[0]
+        cxt = cxs[None, :, None] + rel[None, None, :, 0]        # [1, W, P]
+        cyt = cys[:, None, None] + rel[None, None, :, 1]        # [H, 1, P]
+        cxt = np.broadcast_to(cxt, (H, W, P))
+        cyt = np.broadcast_to(cyt, (H, W, P))
+        bw = rel[None, None, :, 2]
+        bh = rel[None, None, :, 3]
+        arr = np.stack([
+            np.maximum((cxt - bw / 2.0) / img_w, 0.0),
+            np.maximum((cyt - bh / 2.0) / img_h, 0.0),
+            np.minimum((cxt + bw / 2.0) / img_w, 1.0),
+            np.minimum((cyt + bh / 2.0) / img_h, 1.0),
+        ], axis=-1).astype(np.float32)
+        if clip:
+            arr = np.clip(arr, 0.0, 1.0)
+        var = np.broadcast_to(np.asarray(variances, np.float32),
+                              arr.shape).copy()
+        cached = (arr, var)
+        _DENSITY_PRIOR_CACHE[key] = cached
+    arr, var = cached
     if flatten_to_2d:
         arr = arr.reshape(-1, 4)
         var = var.reshape(-1, 4)
