@@ -613,6 +613,64 @@ def _host_only_pred(test):
     return False
 
 
+class _PrintAssertTransformer(ast.NodeTransformer):
+    """PrintTransformer + AssertTransformer parity (dygraph_to_static/
+    print_transformer.py, assert_transformer.py): `print(x)` on traced
+    tensors becomes a compiled-side jax.debug.print; `assert cond[, msg]`
+    becomes a host callback check (the reference lowers these to Print/Assert
+    ops). Host-value prints/asserts keep plain python semantics at runtime —
+    the dispatcher decides per call."""
+
+    def __init__(self):
+        self.applied = 0
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if isinstance(node.func, ast.Name) and node.func.id == "print":
+            node.func = ast.Name(id="__dy2st_print", ctx=ast.Load())
+            self.applied += 1
+        return node
+
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        msg = node.msg if node.msg is not None else ast.Constant(value=None)
+        call = ast.Expr(value=ast.Call(
+            func=ast.Name(id="__dy2st_assert", ctx=ast.Load()),
+            args=[node.test, msg], keywords=[]))
+        self.applied += 1
+        return ast.copy_location(call, node)
+
+
+def convert_print(*args, **kwargs):
+    """Runtime dispatcher for rewritten print(): traced args print from the
+    compiled program via jax.debug.print; host values print normally."""
+    if any(_is_traced(a) for a in args):
+        fmt = " ".join("{}" for _ in args)
+        jax.debug.print(fmt, *[_raw(a) for a in args])
+        return
+    print(*args, **kwargs)
+
+
+def convert_assert(test, msg=None):
+    """Runtime dispatcher for rewritten assert: traced predicates check on
+    host via debug callback (reference Assert op semantics: report + halt);
+    host predicates assert normally."""
+    if _is_traced(test):
+        def _check(ok):
+            import numpy as _np
+
+            ok_val = bool(_np.asarray(ok).all())
+            if not ok_val:
+                raise AssertionError(
+                    msg if msg is not None
+                    else "Assert failed in @to_static function")
+
+        jax.debug.callback(_check, _raw(test))
+        return
+    if not test:
+        raise AssertionError(msg if msg is not None else "")
+
+
 def _no_args():
     return ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
                          kw_defaults=[], defaults=[])
@@ -655,6 +713,8 @@ def transform_function(fn):
     _annotate_bound_before(fdef)
     tr = _ControlFlowTransformer()
     tr.visit(tree)
+    pa = _PrintAssertTransformer()
+    pa.visit(tree)
     skipped = {(c, ln) for c, ln in lower.skipped + tr.skipped}
     if skipped:
         import warnings
@@ -664,7 +724,8 @@ def transform_function(fn):
         warnings.warn(
             f"to_static({fn.__name__}): some control flow was not rewritten "
             f"to lax ops and will fall back to plain tracing — {details}")
-    if tr.applied == 0:
+    n_applied = tr.applied + pa.applied
+    if n_applied == 0:
         try:
             fn.__dy2static_cache__ = (fn, 0)
         except (AttributeError, TypeError):
@@ -677,13 +738,15 @@ def transform_function(fn):
     globs["__dy2st_while"] = convert_while_loop
     globs["__dy2st_not"] = convert_logical_not
     globs["__dy2st_and"] = convert_logical_and
+    globs["__dy2st_print"] = convert_print
+    globs["__dy2st_assert"] = convert_assert
     code = compile(tree, filename=f"<dy2static:{fn.__name__}>", mode="exec")
     ns = {}
     exec(code, globs, ns)
     new_fn = ns[fdef.name]
-    new_fn.__dy2static_transforms__ = tr.applied
+    new_fn.__dy2static_transforms__ = n_applied
     try:
-        fn.__dy2static_cache__ = (new_fn, tr.applied)
+        fn.__dy2static_cache__ = (new_fn, n_applied)
     except (AttributeError, TypeError):
         pass
-    return new_fn, tr.applied
+    return new_fn, n_applied

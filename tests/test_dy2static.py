@@ -482,3 +482,44 @@ class TestLoopLowering:
             got = loaded(x)
             np.testing.assert_allclose(np.asarray(got._data),
                                        np.asarray(net(x)._data), rtol=1e-5)
+
+
+def test_print_transform_traced(capfd):
+    import paddle_tpu as paddle
+
+    @paddle.jit.to_static
+    def f(x):
+        print("value:", x)
+        return x * 2
+
+    out = f(paddle.to_tensor(np.array([3.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(out._data), [6.0])
+    # jax.debug.print writes to stdout once the computation runs
+    import jax
+
+    jax.effects_barrier() if hasattr(jax, "effects_barrier") else None
+    captured = capfd.readouterr()
+    assert "value:" in captured.out
+
+
+def test_assert_transform():
+    import paddle_tpu as paddle
+
+    @paddle.jit.to_static
+    def f(x):
+        assert x.sum() > 0, "must be positive"
+        return x + 1
+
+    out = f(paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(out._data), [2.0, 3.0])
+
+    # failing assert halts execution (reference Assert op semantics); the
+    # bool arg is traced by the jitted wrapper, so the AssertionError from
+    # the host callback surfaces wrapped in JAX's runtime error
+    @paddle.jit.to_static
+    def g(x, flag):
+        assert flag, "flag off"
+        return x
+
+    with pytest.raises(Exception, match="flag off"):
+        g(paddle.to_tensor(np.array([1.0], np.float32)), False)
