@@ -36,3 +36,38 @@ from .split import (  # noqa: F401
     split,
 )
 from . import ps  # noqa: F401,E402
+from ..io.multislot import InMemoryDataset, QueueDataset  # noqa: F401,E402
+
+
+def all_gather_object(object_list, obj, group=None):
+    """paddle.distributed.all_gather_object parity: gather arbitrary picklable
+    objects from every rank. Single-process groups (the common local case)
+    append the object directly; multi-process uses the collective all_gather
+    over a pickled uint8 buffer."""
+    import pickle
+
+    import numpy as np
+
+    from . import collective as C
+    from .env import ParallelEnv
+
+    world = ParallelEnv().world_size
+    if world <= 1:
+        object_list.append(obj)
+        return
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    # length-prefix so ranks can unpickle despite padding to the max size
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    n = np.array([payload.size], np.int64)
+    sizes = []
+    C.all_gather(sizes, Tensor(jnp.asarray(n)), group=group)
+    max_n = int(max(int(np.asarray(s._data)[0]) for s in sizes))
+    padded = np.zeros(max_n, np.uint8)
+    padded[: payload.size] = payload
+    gathered = []
+    C.all_gather(gathered, Tensor(jnp.asarray(padded)), group=group)
+    for s, g in zip(sizes, gathered):
+        k = int(np.asarray(s._data)[0])
+        object_list.append(pickle.loads(np.asarray(g._data)[:k].tobytes()))
