@@ -1050,3 +1050,63 @@ def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
     if rois_num_per_level is not None:
         return out, Tensor(jnp.asarray(np.asarray([k], np.int32)))
     return out
+
+
+def polygon_box_transform(input, name=None):
+    """detection/polygon_box_transform_op.cc parity (EAST-style geometry →
+    quad coordinates): even channels out = 4*w_idx - in, odd channels
+    out = 4*h_idx - in."""
+    def fn(v):
+        N, C, H, W = v.shape
+        wk = 4.0 * jnp.arange(W, dtype=v.dtype)[None, None, None, :]
+        hk = 4.0 * jnp.arange(H, dtype=v.dtype)[None, None, :, None]
+        even = jnp.arange(C) % 2 == 0
+        return jnp.where(even[None, :, None, None], wk - v, hk - v)
+
+    return apply(fn, _t(input))
+
+
+def mine_hard_examples(cls_loss, match_indices, match_dist, loc_loss=None,
+                       neg_pos_ratio=3.0, neg_dist_threshold=0.5,
+                       sample_size=0, mining_type="max_negative", name=None):
+    """detection/mine_hard_examples_op.cc parity (SSD negative mining).
+
+    cls_loss/match_dist [B, P]; match_indices [B, P] (-1 = unmatched).
+    max_negative: eligible = unmatched priors with dist < neg_dist_threshold,
+    keep the top num_pos*neg_pos_ratio by cls_loss. hard_example: every prior
+    is eligible, loss = cls+loc, keep sample_size, and positives that are not
+    selected get their match index erased. Returns (neg_indices list of [k_b]
+    arrays, updated_match_indices [B, P])."""
+    cl = np.asarray(_t(cls_loss)._data)
+    mi = np.asarray(_t(match_indices)._data).astype(np.int64)
+    md = np.asarray(_t(match_dist)._data)
+    ll = np.asarray(_t(loc_loss)._data) if loc_loss is not None else None
+    B, P = mi.shape
+    neg_out, updated = [], mi.copy()
+    for n in range(B):
+        if mining_type == "max_negative":
+            elig = (mi[n] == -1) & (md[n] < neg_dist_threshold)
+            loss = cl[n]
+            num_pos = int((mi[n] != -1).sum())
+            cap = int(num_pos * neg_pos_ratio)
+        elif mining_type == "hard_example":
+            elig = np.ones(P, bool)
+            loss = cl[n] + (ll[n] if ll is not None else 0.0)
+            cap = sample_size
+        else:
+            raise ValueError("mining_type must be max_negative or hard_example")
+        cand = np.nonzero(elig)[0]
+        order = cand[np.argsort(-loss[cand], kind="stable")]
+        sel = order[: min(cap, len(order))]
+        sel_set = set(int(s) for s in sel)
+        if mining_type == "hard_example":
+            for m in range(P):
+                if mi[n, m] > -1 and m not in sel_set:
+                    updated[n, m] = -1
+            neg = sorted(s for s in sel_set if mi[n, s] == -1)
+        else:
+            neg = sorted(sel_set)
+        neg_out.append(Tensor(jnp.asarray(np.asarray(neg, np.int32))))
+    upd = Tensor(jnp.asarray(updated))
+    upd.stop_gradient = True
+    return neg_out, upd

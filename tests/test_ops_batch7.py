@@ -634,3 +634,39 @@ def test_nce_vs_loop():
                      paddle.to_tensor(w), num_total_classes=R,
                      num_neg_samples=K, sampler="log_uniform", seed=3))
     assert np.isfinite(got2).all()
+
+
+def test_polygon_box_transform():
+    x = _randn(1, 4, 2, 3)
+    got = _np(V.polygon_box_transform(paddle.to_tensor(x)))
+    for c in range(4):
+        for h in range(2):
+            for w in range(3):
+                exp = (4 * w - x[0, c, h, w]) if c % 2 == 0 else (4 * h - x[0, c, h, w])
+                assert abs(got[0, c, h, w] - exp) < 1e-5
+
+
+def test_mine_hard_examples_max_negative():
+    cls = np.array([[0.5, 0.9, 0.1, 0.7, 0.3]], np.float32)
+    mi = np.array([[2, -1, -1, -1, -1]], np.int64)
+    md = np.array([[0.8, 0.1, 0.2, 0.9, 0.3]], np.float32)
+    neg, upd = V.mine_hard_examples(cls, mi, md, neg_pos_ratio=2.0,
+                                    neg_dist_threshold=0.5)
+    # eligible: priors 1, 2, 4 (unmatched, dist<0.5); cap = 1 pos * 2 = 2
+    # top-2 by cls_loss: 1 (0.9), 4 (0.3)
+    np.testing.assert_allclose(_np(neg[0]), [1, 4])
+    np.testing.assert_allclose(_np(upd), mi)  # unchanged in max_negative
+
+
+def test_mine_hard_examples_hard_example():
+    cls = np.array([[0.5, 0.9, 0.1]], np.float32)
+    loc = np.array([[0.0, 0.0, 0.6]], np.float32)
+    mi = np.array([[1, -1, 0]], np.int64)
+    md = np.zeros((1, 3), np.float32)
+    neg, upd = V.mine_hard_examples(cls, mi, md, loc_loss=loc,
+                                    sample_size=2,
+                                    mining_type="hard_example")
+    # losses: [0.5, 0.9, 0.7] -> top-2 = priors 1, 2; positive 0 unselected
+    # loses its match; selected negatives = [1]
+    np.testing.assert_allclose(_np(neg[0]), [1])
+    np.testing.assert_allclose(_np(upd), [[-1, -1, 0]])
