@@ -1110,3 +1110,106 @@ def mine_hard_examples(cls_loss, match_indices, match_dist, loc_loss=None,
     upd = Tensor(jnp.asarray(updated))
     upd.stop_gradient = True
     return neg_out, upd
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, gt_boxes, im_info,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True, name=None):
+    """detection/rpn_target_assign_op.cc parity (Faster-RCNN RPN sampling,
+    Detectron-matching two-direction assignment :190-205).
+
+    Per image: fg = anchors holding any gt's max overlap OR IoU >=
+    rpn_positive_overlap, subsampled to fg_fraction*batch_size; bg = anchors
+    with max IoU < rpn_negative_overlap, subsampled to the remainder (bg
+    sampling may demote sampled fg — the fg_fake/bbox_inside_weight dance at
+    :235-250 is reproduced). Eager host op (dynamic output counts, like the
+    reference CPU kernel). Returns (loc_index, score_index, tgt_bbox,
+    tgt_lbl, bbox_inside_weight) for a single image.
+    """
+    anchors = np.asarray(_t(anchor_box)._data).reshape(-1, 4)
+    gts = np.asarray(_t(gt_boxes)._data).reshape(-1, 4)
+    A, G = len(anchors), len(gts)
+    rng_ = np.random.RandomState(0)
+
+    # IoU anchor x gt
+    ov = np.zeros((A, G), np.float32)
+    for j in range(G):
+        ix1 = np.maximum(anchors[:, 0], gts[j, 0])
+        iy1 = np.maximum(anchors[:, 1], gts[j, 1])
+        ix2 = np.minimum(anchors[:, 2], gts[j, 2])
+        iy2 = np.minimum(anchors[:, 3], gts[j, 3])
+        iw = np.maximum(ix2 - ix1 + 1, 0)
+        ih = np.maximum(iy2 - iy1 + 1, 0)
+        inter = iw * ih
+        aa = (anchors[:, 2] - anchors[:, 0] + 1) * (anchors[:, 3] - anchors[:, 1] + 1)
+        ga = (gts[j, 2] - gts[j, 0] + 1) * (gts[j, 3] - gts[j, 1] + 1)
+        ov[:, j] = inter / np.maximum(aa + ga - inter, 1e-10)
+    a2g_max = ov.max(axis=1) if G else np.zeros(A, np.float32)
+    a2g_arg = ov.argmax(axis=1) if G else np.zeros(A, np.int64)
+    g2a_max = ov.max(axis=0) if G else np.zeros(0, np.float32)
+
+    def reservoir(cands, k):
+        cands = list(cands)
+        if k <= 0 or len(cands) <= k:
+            return cands
+        if not use_random:
+            return cands[:k]
+        out = cands[:k]
+        for i in range(k, len(cands)):
+            j = rng_.randint(0, i + 1)
+            if j < k:
+                out[j] = cands[i]
+        return out
+
+    eps = 1e-5
+    with_max = (np.abs(ov - g2a_max[None, :]) < eps).any(axis=1) if G else np.zeros(A, bool)
+    fg_fake_inds = reservoir(
+        np.nonzero(with_max | (a2g_max >= rpn_positive_overlap))[0],
+        int(rpn_fg_fraction * rpn_batch_size_per_im))
+    label = np.full(A, -1, np.int64)
+    label[np.asarray(fg_fake_inds, np.int64)] = 1
+    fg_fake_num = len(fg_fake_inds)
+
+    bg_cands = np.nonzero(a2g_max < rpn_negative_overlap)[0]
+    bg_sel = reservoir(bg_cands, rpn_batch_size_per_im - fg_fake_num)
+
+    fg_fake, inside_w = [], []
+    fake_num = 0
+    for b in bg_sel:
+        if label[b] == 1:  # demoted fg keeps a zero-weight loc slot
+            fake_num += 1
+            fg_fake.append(int(fg_fake_inds[0]))
+            inside_w.extend([0.0] * 4)
+        label[b] = 0
+    inside_w.extend([1.0] * 4 * (fg_fake_num - fake_num))
+
+    fg_inds = np.nonzero(label == 1)[0]
+    bg_inds = np.nonzero(label == 0)[0]
+    fg_fake.extend(int(i) for i in fg_inds)
+    loc_index = np.asarray(fg_fake, np.int32)
+    score_index = np.concatenate([fg_inds, bg_inds]).astype(np.int32)
+    tgt_lbl = np.concatenate([np.ones(len(fg_inds), np.int32),
+                              np.zeros(len(bg_inds), np.int32)])
+
+    # box deltas anchor -> matched gt for each loc_index entry
+    def deltas(aidx):
+        a = anchors[aidx]
+        g = gts[a2g_arg[aidx]] if G else a
+        aw, ah = a[2] - a[0] + 1, a[3] - a[1] + 1
+        acx, acy = a[0] + aw / 2, a[1] + ah / 2
+        gw, gh = g[2] - g[0] + 1, g[3] - g[1] + 1
+        gcx, gcy = g[0] + gw / 2, g[1] + gh / 2
+        return [(gcx - acx) / aw, (gcy - acy) / ah,
+                np.log(gw / aw), np.log(gh / ah)]
+
+    tgt_bbox = np.asarray([deltas(i) for i in loc_index], np.float32).reshape(-1, 4)
+    iw_arr = np.asarray(inside_w, np.float32).reshape(-1, 4)
+
+    outs = [Tensor(jnp.asarray(loc_index)), Tensor(jnp.asarray(score_index)),
+            Tensor(jnp.asarray(tgt_bbox)),
+            Tensor(jnp.asarray(tgt_lbl.reshape(-1, 1))),
+            Tensor(jnp.asarray(iw_arr))]
+    for t in outs:
+        t.stop_gradient = True
+    return tuple(outs)

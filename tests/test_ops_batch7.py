@@ -670,3 +670,23 @@ def test_mine_hard_examples_hard_example():
     # loses its match; selected negatives = [1]
     np.testing.assert_allclose(_np(neg[0]), [1])
     np.testing.assert_allclose(_np(upd), [[-1, -1, 0]])
+
+
+def test_rpn_target_assign():
+    anchors = np.array([[0, 0, 9, 9], [10, 10, 19, 19], [0, 0, 4, 4],
+                        [30, 30, 49, 49]], np.float32)
+    gts = np.array([[0, 0, 9, 9], [31, 31, 48, 48]], np.float32)
+    loc, score, tbox, tlbl, biw = V.rpn_target_assign(
+        None, None, anchors, gts, None, rpn_batch_size_per_im=4,
+        rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+        rpn_negative_overlap=0.3, use_random=False)
+    loc = _np(loc)
+    lbl = _np(tlbl).ravel()
+    # anchors 0 and 3 hold the per-gt max overlaps -> fg; 1 and 2 are bg/else
+    assert set(loc.tolist()) == {0, 3}
+    assert (lbl[:2] == 1).all()
+    tbox = _np(tbox)
+    # anchor 0 == gt 0: zero deltas
+    row0 = tbox[list(loc).index(0)]
+    np.testing.assert_allclose(row0, 0.0, atol=1e-5)
+    np.testing.assert_allclose(_np(biw), 1.0)
