@@ -1,0 +1,216 @@
+"""Book examples, part 2 (reference fluid/tests/book/ parity): the five
+canonical end-to-end programs not covered by test_book.py — image
+classification (CNN), sentiment (LSTM over padded sequences), recommender
+(embedding factorization), machine translation (encoder-decoder + greedy
+decode), and label semantic roles (BiLSTM + linear-chain CRF + viterbi)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.nn import functional as F
+
+rng = np.random.RandomState(3)
+
+
+def _train(model, opt, loss_fn, batches, steps=12):
+    losses = []
+    for i in range(steps):
+        x, y = batches[i % len(batches)]
+        loss = loss_fn(model, paddle.to_tensor(x), paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss._data)))
+    return losses
+
+
+def test_book_image_classification():
+    """conv -> bn -> pool -> fc image classifier learns a separable signal."""
+    net = nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8), nn.ReLU(),
+        nn.MaxPool2D(2), nn.Flatten(), nn.Linear(8 * 4 * 4, 4))
+    opt = paddle.optimizer.Adam(learning_rate=5e-3, parameters=net.parameters())
+    # class k = image whose channel mean is shifted by k
+    xs, ys = [], []
+    for _ in range(4):
+        y = rng.randint(0, 4, (16,)).astype(np.int64)
+        x = rng.randn(16, 3, 8, 8).astype(np.float32) + y[:, None, None, None]
+        xs.append(x)
+        ys.append(y)
+    batches = list(zip(xs, ys))
+
+    def loss_fn(m, x, y):
+        return F.cross_entropy(m(x), y)
+
+    losses = _train(net, opt, loss_fn, batches, steps=16)
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_book_understand_sentiment_lstm():
+    """LSTM over padded token sequences + sequence_last_step readout."""
+    V_, D, H = 50, 16, 32
+
+    class SentimentNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(V_, D)
+            self.lstm = nn.LSTM(D, H)
+            self.fc = nn.Linear(H, 2)
+
+        def forward(self, ids, length):
+            h, _ = self.lstm(self.emb(ids))
+            pooled = F.sequence_last_step(h, length)
+            return self.fc(pooled)
+
+    net = SentimentNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+    # label 1 sequences contain token 7 at the end of the valid region
+    batches = []
+    for _ in range(3):
+        ids = rng.randint(10, V_, (8, 12)).astype(np.int64)
+        lens = rng.randint(4, 12, (8,)).astype(np.int64)
+        y = rng.randint(0, 2, (8,)).astype(np.int64)
+        for b in range(8):
+            if y[b]:
+                ids[b, lens[b] - 1] = 7
+        batches.append(((ids, lens), y))
+
+    losses = []
+    for i in range(18):
+        (ids, lens), y = batches[i % len(batches)]
+        logits = net(paddle.to_tensor(ids), paddle.to_tensor(lens))
+        loss = F.cross_entropy(logits, paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss._data)))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_book_recommender_system():
+    """Embedding factorization (movielens shape): rating ~ user·item."""
+    U, M, D = 30, 40, 8
+
+    class Recommender(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.u = nn.Embedding(U, D)
+            self.m = nn.Embedding(M, D)
+
+        def forward(self, uid, mid):
+            return (self.u(uid) * self.m(mid)).sum(axis=-1)
+
+    net = Recommender()
+    opt = paddle.optimizer.Adam(learning_rate=5e-2, parameters=net.parameters())
+    true_u = rng.randn(U, 3).astype(np.float32)
+    true_m = rng.randn(M, 3).astype(np.float32)
+    # fixed training set, multiple epochs (book-example shape)
+    uid = rng.randint(0, U, (128,))
+    mid = rng.randint(0, M, (128,))
+    r = (true_u[uid] * true_m[mid]).sum(1).astype(np.float32)
+    losses = []
+    for i in range(40):
+        pred = net(paddle.to_tensor(uid), paddle.to_tensor(mid))
+        loss = F.mse_loss(pred, paddle.to_tensor(r))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss._data)))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_book_machine_translation():
+    """GRU encoder-decoder with teacher forcing + greedy decode."""
+    Vs, Vt, D, H = 30, 25, 12, 24
+
+    class Seq2Seq(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.src_emb = nn.Embedding(Vs, D)
+            self.tgt_emb = nn.Embedding(Vt, D)
+            self.enc = nn.GRU(D, H)
+            self.dec = nn.GRU(D, H)
+            self.out = nn.Linear(H, Vt)
+
+        def forward(self, src, tgt_in):
+            _, hN = self.enc(self.src_emb(src))
+            dec_out, _ = self.dec(self.tgt_emb(tgt_in), hN)
+            return self.out(dec_out)
+
+        def greedy(self, src, bos, steps):
+            _, h = self.enc(self.src_emb(src))
+            tok = paddle.to_tensor(np.full((src.shape[0], 1), bos, np.int64))
+            outs = []
+            for _ in range(steps):
+                o, h = self.dec(self.tgt_emb(tok), h)
+                tok = self.out(o).argmax(axis=-1)
+                outs.append(np.asarray(tok._data))
+            return np.concatenate(outs, axis=1)
+
+    net = Seq2Seq()
+    opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+    # task: copy source prefix into target — fixed corpus, multiple epochs
+    src = rng.randint(2, Vs, (32, 6)).astype(np.int64)
+    tgt = (src[:, :5] % (Vt - 2)) + 2
+    tgt_in = np.concatenate([np.ones((32, 1), np.int64), tgt[:, :-1]], 1)
+    losses = []
+    for i in range(50):
+        logits = net(paddle.to_tensor(src), paddle.to_tensor(tgt_in))
+        b, s, v = logits.shape
+        loss = F.cross_entropy(logits.reshape([b * s, v]),
+                               paddle.to_tensor(tgt.reshape(-1)))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss._data)))
+    assert losses[-1] < losses[0] * 0.6, losses
+    dec = net.greedy(paddle.to_tensor(src), bos=1, steps=5)
+    assert dec.shape == (32, 5)
+
+
+def test_book_label_semantic_roles_crf():
+    """BiLSTM emissions + linear_chain_crf loss + viterbi decode (SRL shape)."""
+    from paddle_tpu.text import linear_chain_crf, viterbi_decode
+
+    V_, D, H, T = 40, 12, 16, 5
+
+    class SRL(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(V_, D)
+            self.lstm = nn.LSTM(D, H, direction="bidirect")
+            self.fc = nn.Linear(2 * H, T)
+
+        def forward(self, ids):
+            h, _ = self.lstm(self.emb(ids))
+            return self.fc(h)
+
+    net = SRL()
+    trans = paddle.to_tensor(rng.randn(T + 2, T).astype(np.float32) * 0.1)
+    trans.stop_gradient = False
+    params = net.parameters() + [trans]
+    opt = paddle.optimizer.Adam(learning_rate=2e-2, parameters=params)
+    # tag = token id mod T (deterministic mapping the model can learn)
+    losses = []
+    for i in range(15):
+        ids = rng.randint(0, V_, (6, 8)).astype(np.int64)
+        tags = (ids % T).astype(np.int64)
+        lens = np.full((6,), 8, np.int32)
+        em = net(paddle.to_tensor(ids))
+        loss = linear_chain_crf(em, trans, paddle.to_tensor(tags),
+                                paddle.to_tensor(lens)).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss._data)))
+    assert losses[-1] < losses[0] * 0.8, losses
+    # viterbi decode with the learned transitions recovers most tags
+    ids = rng.randint(0, V_, (4, 8)).astype(np.int64)
+    em = net(paddle.to_tensor(ids))
+    # drop the start/stop rows for the [T, T] decoder transition
+    tr_np = np.asarray(trans._data)[2:]
+    _, path = viterbi_decode(em.detach(), paddle.to_tensor(tr_np),
+                             paddle.to_tensor(np.full((4,), 8, np.int32)),
+                             include_bos_eos_tag=False)
+    acc = (np.asarray(path._data) == (ids % T)).mean()
+    assert acc > 0.5, acc
