@@ -1213,3 +1213,92 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, gt_boxes, im_info,
     for t in outs:
         t.stop_gradient = True
     return tuple(outs)
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes, im_info,
+                             batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=81, use_random=True,
+                             is_cls_agnostic=False, name=None):
+    """detection/generate_proposal_labels_op.cc parity (Fast-RCNN stage-2
+    sampler), single image: gt boxes join the candidate pool, fg = RoIs with
+    max gt IoU >= fg_thresh (subsampled to fg_fraction*batch), bg = RoIs with
+    IoU in [bg_thresh_lo, bg_thresh_hi) (fills the remainder, labeled 0).
+    Returns (rois, labels_int32, bbox_targets, bbox_inside_weights,
+    bbox_outside_weights) — targets one-hot-expanded per class like the
+    reference (class-agnostic collapses to a single foreground slot)."""
+    rois = np.asarray(_t(rpn_rois)._data).reshape(-1, 4)
+    gts = np.asarray(_t(gt_boxes)._data).reshape(-1, 4)
+    cls = np.asarray(_t(gt_classes)._data).reshape(-1).astype(np.int64)
+    crowd = (np.asarray(_t(is_crowd)._data).reshape(-1).astype(np.int64)
+             if is_crowd is not None else np.zeros(len(gts), np.int64))
+    rng_ = np.random.RandomState(0)
+
+    # gt boxes participate as candidates (reference appends them)
+    pool = np.concatenate([rois, gts], axis=0) if len(gts) else rois
+    P, G = len(pool), len(gts)
+    ov = np.zeros((P, max(G, 1)), np.float32)
+    for j in range(G):
+        if crowd[j]:
+            continue
+        ix1 = np.maximum(pool[:, 0], gts[j, 0])
+        iy1 = np.maximum(pool[:, 1], gts[j, 1])
+        ix2 = np.minimum(pool[:, 2], gts[j, 2])
+        iy2 = np.minimum(pool[:, 3], gts[j, 3])
+        iw = np.maximum(ix2 - ix1 + 1, 0)
+        ih = np.maximum(iy2 - iy1 + 1, 0)
+        inter = iw * ih
+        pa = (pool[:, 2] - pool[:, 0] + 1) * (pool[:, 3] - pool[:, 1] + 1)
+        ga = (gts[j, 2] - gts[j, 0] + 1) * (gts[j, 3] - gts[j, 1] + 1)
+        ov[:, j] = inter / np.maximum(pa + ga - inter, 1e-10)
+    mx = ov.max(axis=1)
+    arg = ov.argmax(axis=1)
+
+    fg_cand = np.nonzero(mx >= fg_thresh)[0]
+    bg_cand = np.nonzero((mx >= bg_thresh_lo) & (mx < bg_thresh_hi))[0]
+    fg_per_im = int(np.floor(batch_size_per_im * fg_fraction))
+    n_fg = min(fg_per_im, len(fg_cand))
+    if use_random and len(fg_cand) > n_fg:
+        fg_sel = rng_.choice(fg_cand, n_fg, replace=False)
+    else:
+        fg_sel = fg_cand[:n_fg]
+    n_bg = min(batch_size_per_im - n_fg, len(bg_cand))
+    if use_random and len(bg_cand) > n_bg:
+        bg_sel = rng_.choice(bg_cand, n_bg, replace=False)
+    else:
+        bg_sel = bg_cand[:n_bg]
+
+    sel = np.concatenate([fg_sel, bg_sel]).astype(np.int64)
+    out_rois = pool[sel]
+    labels = np.concatenate([
+        cls[arg[fg_sel]] if G else np.zeros(len(fg_sel), np.int64),
+        np.zeros(len(bg_sel), np.int64)]).astype(np.int32)
+
+    # box regression targets (fg only), weighted like the reference
+    wx, wy, ww, wh = bbox_reg_weights
+    n_cls = 2 if is_cls_agnostic else class_nums
+    targets = np.zeros((len(sel), 4 * n_cls), np.float32)
+    inside = np.zeros_like(targets)
+    for k, ridx in enumerate(fg_sel):
+        a = pool[ridx]
+        g = gts[arg[ridx]] if G else a
+        aw, ah = a[2] - a[0] + 1, a[3] - a[1] + 1
+        acx, acy = a[0] + aw / 2, a[1] + ah / 2
+        gw, gh = g[2] - g[0] + 1, g[3] - g[1] + 1
+        gcx, gcy = g[0] + gw / 2, g[1] + gh / 2
+        d = [(gcx - acx) / aw / wx, (gcy - acy) / ah / wy,
+             np.log(gw / aw) / ww, np.log(gh / ah) / wh]
+        c = 1 if is_cls_agnostic else int(labels[k])
+        targets[k, 4 * c: 4 * c + 4] = d
+        inside[k, 4 * c: 4 * c + 4] = 1.0
+    outside = (inside > 0).astype(np.float32)
+
+    outs = [Tensor(jnp.asarray(out_rois.astype(np.float32))),
+            Tensor(jnp.asarray(labels.reshape(-1, 1))),
+            Tensor(jnp.asarray(targets)),
+            Tensor(jnp.asarray(inside)),
+            Tensor(jnp.asarray(outside))]
+    for t in outs:
+        t.stop_gradient = True
+    return tuple(outs)

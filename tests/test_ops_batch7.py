@@ -690,3 +690,27 @@ def test_rpn_target_assign():
     row0 = tbox[list(loc).index(0)]
     np.testing.assert_allclose(row0, 0.0, atol=1e-5)
     np.testing.assert_allclose(_np(biw), 1.0)
+
+
+def test_generate_proposal_labels():
+    rois = np.array([[0, 0, 9, 9], [1, 1, 10, 10], [50, 50, 60, 60],
+                     [30, 0, 40, 9]], np.float32)
+    gts = np.array([[0, 0, 9, 9]], np.float32)
+    cls = np.array([3], np.int64)
+    out_rois, labels, targets, biw, bow = V.generate_proposal_labels(
+        rois, cls, np.array([0], np.int64), gts,
+        np.array([[100.0, 100.0, 1.0]], np.float32),
+        batch_size_per_im=4, fg_fraction=0.5, fg_thresh=0.5,
+        bg_thresh_hi=0.5, bg_thresh_lo=0.0, class_nums=5, use_random=False)
+    lab = _np(labels).ravel()
+    r = _np(out_rois)
+    # fg: roi 0 (IoU 1 via itself...) — roi 0 == gt and appended gt both fg
+    n_fg = (lab > 0).sum()
+    assert n_fg >= 1 and (lab[:n_fg] == 3).all()
+    # fg box targets live in class-3 slot, inside weights mark it
+    t = _np(targets)
+    w = _np(biw)
+    assert t.shape == (len(lab), 20)
+    assert w[0, 12:16].sum() == 4.0 and w[0, :12].sum() == 0.0
+    # bg rows have zero weights everywhere
+    assert w[lab == 0].sum() == 0.0
