@@ -86,6 +86,51 @@ from . import sysconfig  # noqa: E402
 from . import reader  # noqa: E402
 from . import dataset  # noqa: E402
 from .batch import batch  # noqa: E402
+from .nn import ParamAttr  # noqa: E402
+from .core.generator import default_generator as _defgen  # noqa: E402
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """paddle.set_printoptions parity — delegates to numpy (Tensor repr
+    renders through numpy)."""
+    import numpy as _np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """paddle.create_parameter parity: a free-standing trainable tensor."""
+    from .nn.initializer import Constant, XavierNormal
+    import jax.numpy as _jnp
+
+    init = default_initializer or (Constant(0.0) if is_bias else XavierNormal())
+    t = Tensor(_jnp.asarray(init(list(shape), dtype)))
+    t.stop_gradient = False
+    return t
+
+
+def get_cuda_rng_state():
+    """Compat: returns the framework RNG seed state (no CUDA here; the
+    per-device generator is the TPU analog)."""
+    return [_defgen().initial_seed()]
+
+
+def set_cuda_rng_state(state):
+    if state:
+        seed(int(state[0]))
 from .autograd import grad  # noqa: E402
 from .framework import io as _fio  # noqa: E402
 from .hapi import callbacks  # noqa: E402

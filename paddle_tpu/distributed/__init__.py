@@ -71,3 +71,4 @@ def all_gather_object(object_list, obj, group=None):
     for s, g in zip(sizes, gathered):
         k = int(np.asarray(s._data)[0])
         object_list.append(pickle.loads(np.asarray(g._data)[:k].tobytes()))
+from .ps.tables import CountFilterEntry, ProbabilityEntry  # noqa: F401,E402

@@ -22,3 +22,43 @@ stop_worker = fleet.stop_worker
 worker_endpoints = fleet.worker_endpoints
 def __getattr__(name):  # delegate everything else to the singleton (e.g. ps_runtime)
     return getattr(fleet, name)
+from .role_maker import Role  # noqa: F401,E402
+from .data_generator import (  # noqa: F401,E402
+    MultiSlotDataGenerator,
+    MultiSlotStringDataGenerator,
+)
+
+
+class UtilBase:
+    """fleet.UtilBase parity: cross-worker helper utilities."""
+
+    def __init__(self):
+        from ..env import ParallelEnv
+
+        self._env = ParallelEnv()
+
+    def get_file_shard(self, files):
+        """Split a file list across workers (contiguous shards, remainder to
+        the leading workers — reference util_base get_file_shard)."""
+        n = max(self._env.world_size, 1)
+        i = self._env.rank
+        base, rem = divmod(len(files), n)
+        start = i * base + min(i, rem)
+        return files[start: start + base + (1 if i < rem else 0)]
+
+    def all_reduce(self, input, mode="sum"):
+        import numpy as np
+
+        return np.asarray(input)  # single-process group: identity
+
+    def barrier(self):
+        from .. import collective
+
+        collective.barrier()
+
+    def print_on_rank(self, message, rank_id=0):
+        if self._env.rank == rank_id:
+            print(message)
+
+
+util = UtilBase()

@@ -1,0 +1,66 @@
+"""fleet MultiSlotDataGenerator (python/paddle/distributed/fleet/data_generator/
+data_generator.py parity): user subclasses generate_sample(); run_from_stdin /
+run_from_memory emit MultiSlot-format lines the dataset/PS ingestion parses."""
+import sys
+
+
+class DataGenerator:
+    def __init__(self):
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclasses implement generate_sample(line) -> iterator of "
+            "(slot_name, values) lists")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+
+        return local_iter
+
+    def _gen_str(self, userdata):
+        raise NotImplementedError
+
+    def run_from_stdin(self):
+        for line in sys.stdin:
+            line_iter = self.generate_sample(line)
+            for user_parsed_line in line_iter():
+                if user_parsed_line is None:
+                    continue
+                sys.stdout.write(self._gen_str(user_parsed_line))
+
+    def run_from_memory(self):
+        samples = []
+        for user_parsed_line in self.generate_sample(None)():
+            if user_parsed_line is None:
+                continue
+            samples.append(self._gen_str(user_parsed_line))
+        for s in samples:
+            sys.stdout.write(s)
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Emits `slot:n v1 .. vn` per feature (int ids)."""
+
+    def _gen_str(self, line):
+        parts = []
+        for name, values in line:
+            parts.append(f"{len(values)}")
+            parts.extend(str(int(v)) for v in values)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """Emits raw string tokens per slot (reference string variant)."""
+
+    def _gen_str(self, line):
+        parts = []
+        for name, values in line:
+            parts.append(f"{len(values)}")
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"

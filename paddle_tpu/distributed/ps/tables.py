@@ -81,12 +81,39 @@ class DenseTable:
             self._value = np.asarray(value, np.float32).copy()
 
 
+class CountFilterEntry:
+    """paddle.distributed.CountFilterEntry parity (the_one_ps accessor entry
+    config): a sparse key is only admitted (row created) after it has been
+    seen `count_filter` times in pushes/pulls."""
+
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self.threshold = int(count_filter)
+
+    def admit(self, seen_count, rng):
+        return seen_count >= self.threshold
+
+
+class ProbabilityEntry:
+    """paddle.distributed.ProbabilityEntry parity: a new sparse key is
+    admitted with the given probability."""
+
+    def __init__(self, probability):
+        if not 0.0 <= probability <= 1.0:
+            raise ValueError("probability must be in [0, 1]")
+        self.probability = float(probability)
+
+    def admit(self, seen_count, rng):
+        return rng.rand() < self.probability
+
+
 class SparseTable:
     """Auto-growing row store keyed by int64 id (table/common_sparse_table.cc).
     Rows initialize lazily on first pull — the reference's fill-on-miss accessor."""
 
     def __init__(self, dim, optimizer="sgd", lr=0.01, initializer="uniform",
-                 init_scale=0.01, seed=0):
+                 init_scale=0.01, seed=0, entry=None):
         self.dim = int(dim)
         self._rule = _Rule(optimizer, lr)
         self._rows = {}
@@ -95,6 +122,9 @@ class SparseTable:
         self._initializer = initializer
         self._scale = float(init_scale)
         self._rng = np.random.RandomState(seed)
+        # admission policy (CountFilterEntry / ProbabilityEntry); None admits all
+        self._entry = entry
+        self._seen = {}
 
     def _init_row(self, rid):
         if self._initializer == "zeros":
@@ -105,13 +135,26 @@ class SparseTable:
         self._slots[rid] = self._rule.slots(self.dim)
         return row
 
+    def _admitted(self, rid):
+        if self._entry is None or rid in self._rows:
+            return True
+        self._seen[rid] = self._seen.get(rid, 0) + 1
+        return self._entry.admit(self._seen[rid], self._rng)
+
     def pull(self, ids):
         ids = np.asarray(ids, np.int64).ravel()
+        zero = np.zeros(self.dim, np.float32)
         with self._lock:
-            return np.stack([
-                self._rows.get(int(i)) if int(i) in self._rows else self._init_row(int(i))
-                for i in ids
-            ])
+            out = []
+            for i in ids:
+                rid = int(i)
+                if rid in self._rows:
+                    out.append(self._rows[rid])
+                elif self._admitted(rid):
+                    out.append(self._init_row(rid))
+                else:
+                    out.append(zero)  # filtered keys read as zeros until admitted
+            return np.stack(out)
 
     def push(self, ids, grads):
         ids = np.asarray(ids, np.int64).ravel()

@@ -1,3 +1,23 @@
 """paddle.incubate parity: auto-checkpoint, segment reductions."""
 from . import checkpoint  # noqa: F401
 from .segment import segment_max, segment_mean, segment_min, segment_sum  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import reader  # noqa: F401
+
+
+class LayerHelper:
+    """fluid LayerHelper compat: create_parameter/create_variable helpers for
+    code ported from fluid layers. Thin — parameters come from
+    paddle.create_parameter."""
+
+    def __init__(self, layer_type, **kwargs):
+        self.layer_type = layer_type
+        self.kwargs = kwargs
+
+    def create_parameter(self, attr=None, shape=None, dtype="float32",
+                         is_bias=False, default_initializer=None):
+        import paddle_tpu as paddle
+
+        return paddle.create_parameter(shape, dtype, attr=attr,
+                                       is_bias=is_bias,
+                                       default_initializer=default_initializer)

@@ -1,0 +1,4 @@
+"""paddle.incubate.optimizer parity: the experimental optimizer wrappers the
+reference exposes here (LookAhead, ModelAverage) live in paddle_tpu.optimizer;
+re-exported under the incubate path."""
+from ..optimizer.extras import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,5 @@
+"""paddle.incubate.reader parity: the fluid reader decorators re-exported."""
+from ..reader import (  # noqa: F401
+    buffered, cache, chain, compose, firstn, map_readers, shuffle,
+    xmap_readers,
+)

@@ -340,3 +340,33 @@ class TracedLayer:
 
     def save_inference_model(self, path, feed=None, fetch=None):
         save(self._layer, path)
+
+
+def set_code_level(level=100):
+    """Compat (dygraph_to_static logging): records the desired level."""
+    import os
+
+    os.environ["PADDLE_TPU_D2S_CODE_LEVEL"] = str(level)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    import os
+
+    os.environ["PADDLE_TPU_D2S_VERBOSITY"] = str(level)
+
+
+class ProgramTranslator:
+    """Compat singleton (dygraph_to_static ProgramTranslator): enable()
+    toggles whether @to_static transforms or falls straight through."""
+
+    _instance = None
+    _enabled = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static=True):
+        ProgramTranslator._enabled = bool(enable_to_static)

@@ -58,3 +58,11 @@ from .layer.transformer import (  # noqa: E402,F401
 from .layer.moe import MoELayer  # noqa: E402,F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: E402,F401
 from .utils_weight_norm import remove_weight_norm, weight_norm  # noqa: E402,F401
+
+# reference exposes the layer submodules at paddle.nn.<name> (nn/__init__.py
+# imports them); alias ours so `from paddle.nn import loss` style works
+from .layer import common, conv, loss, norm, rnn  # noqa: E402,F401
+from .functional import extension  # noqa: E402,F401
+from ..vision import ops as vision  # noqa: E402,F401
+from .utils_weight_norm import weight_norm as weight_norm_hook  # noqa: E402,F401
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: E402,F401

@@ -1,0 +1,132 @@
+"""Beam-search decoding (python/paddle/nn/decode.py BeamSearchDecoder +
+dynamic_decode parity; reference beam_search_op.cc / beam_search_decode_op.cc).
+
+TPU-native stance: the beam dimension is folded into batch ([B*K, ...]) so the
+cell runs one MXU-friendly batched step per time step; the per-step top-k over
+(beam x vocab) and the final backtrace (gather_tree) are the same primitives
+the compiled beam ops use. dynamic_decode drives the loop eagerly — decode is
+an inference utility with data-dependent termination (every step's `finished`
+is reduced on host, like the reference's while_op + is_empty check).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.functional.extension import gather_tree
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class BeamSearchDecoder:
+    """Wraps an RNN cell for beam search. `embedding_fn` maps token ids
+    [B*K] -> embeddings [B*K, D]; `output_fn` maps cell outputs to vocab
+    logits (identity when the cell already emits logits)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- state helpers: states are Tensors or (nested) tuples of Tensors ----
+    def _map_state(self, states, fn):
+        if isinstance(states, (tuple, list)):
+            return type(states)(self._map_state(s, fn) for s in states)
+        return Tensor(fn(_raw(states)))
+
+    def tile_beam_merge_with_batch(self, t):
+        """[B, ...] -> [B*K, ...] (repeat each batch row beam_size times)."""
+        K = self.beam_size
+
+        def f(v):
+            return jnp.repeat(v, K, axis=0)
+
+        return self._map_state(t, f)
+
+    def initialize(self, initial_cell_states):
+        states = self.tile_beam_merge_with_batch(initial_cell_states)
+        first = initial_cell_states
+        while isinstance(first, (tuple, list)):
+            first = first[0]
+        B = _raw(first).shape[0]
+        K = self.beam_size
+        ids = np.full((B, K), self.start_token, np.int64)
+        # only beam 0 is live initially so the K start tokens don't duplicate
+        log_probs = np.full((B, K), -1e9, np.float32)
+        log_probs[:, 0] = 0.0
+        finished = np.zeros((B, K), bool)
+        return ids, states, log_probs, finished
+
+    def step(self, ids, states, log_probs, finished):
+        """One beam step. Returns (next_ids, parent_idx, next_states,
+        next_log_probs, next_finished)."""
+        B, K = ids.shape
+        flat_ids = Tensor(jnp.asarray(ids.reshape(-1)))
+        inputs = (self.embedding_fn(flat_ids) if self.embedding_fn is not None
+                  else flat_ids)
+        cell_out, next_states = self.cell(inputs, states)
+        logits = self.output_fn(cell_out) if self.output_fn is not None else cell_out
+        logp = np.asarray(jax.nn.log_softmax(_raw(logits), axis=-1))  # [B*K, V]
+        V = logp.shape[-1]
+        logp = logp.reshape(B, K, V)
+        # finished beams emit only end_token with probability 1
+        fin_row = np.full(V, -1e9, np.float32)
+        fin_row[self.end_token] = 0.0
+        logp = np.where(finished[:, :, None], fin_row[None, None, :], logp)
+        total = log_probs[:, :, None] + logp                   # [B, K, V]
+        flat = total.reshape(B, K * V)
+        top_idx = np.argsort(-flat, axis=1, kind="stable")[:, :K]
+        next_log_probs = np.take_along_axis(flat, top_idx, axis=1)
+        parent = (top_idx // V).astype(np.int64)               # [B, K]
+        token = (top_idx % V).astype(np.int64)
+        next_finished = np.take_along_axis(finished, parent, axis=1) | (
+            token == self.end_token)
+
+        # reorder cell states by the chosen parent beams
+        gather = (parent + np.arange(B)[:, None] * K).reshape(-1)
+
+        def f(v):
+            return jnp.asarray(np.asarray(v)[gather])
+
+        next_states = self._map_state(next_states, f)
+        return token, parent, next_states, next_log_probs, next_finished
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Runs the decoder until every beam finishes or max_step_num steps.
+    Returns (predicted_ids [B, T, K], final_log_probs [B, K]) and, with
+    return_length, the per-beam sequence lengths [B, K]."""
+    ids, states, log_probs, finished = decoder.initialize(inits)
+    B, K = ids.shape
+    all_tokens, all_parents = [], []
+    lengths = np.zeros((B, K), np.int64)
+    for _ in range(max_step_num):
+        token, parent, states, log_probs, new_finished = decoder.step(
+            ids, states, log_probs, finished)
+        all_tokens.append(np.asarray(token))
+        all_parents.append(np.asarray(parent))
+        lengths += (~finished).astype(np.int64)
+        ids, finished = np.asarray(token), np.asarray(new_finished)
+        if finished.all():
+            break
+    T = len(all_tokens)
+    tok = np.stack(all_tokens)                                 # [T, B, K]
+    par = np.stack(all_parents)
+    traced = gather_tree(Tensor(jnp.asarray(tok)), Tensor(jnp.asarray(par)))
+    out = np.asarray(traced._data).transpose(1, 0, 2)          # [B, T, K]
+    if output_time_major:
+        out = out.transpose(1, 0, 2)
+    outs = (Tensor(jnp.asarray(out)), Tensor(jnp.asarray(log_probs)))
+    if return_length:
+        return outs + (Tensor(jnp.asarray(lengths)),)
+    return outs

@@ -200,3 +200,10 @@ def tanh_(x, name=None):
     from ...core.dispatch import apply_inplace
 
     return apply_inplace(jnp.tanh, x)
+
+
+def elu_(x, alpha=1.0, name=None):
+    from ...core.dispatch import apply_inplace
+
+    return apply_inplace(
+        lambda v: jnp.where(v > 0, v, alpha * (jnp.exp(v) - 1)), _t(x))

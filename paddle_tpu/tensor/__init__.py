@@ -13,4 +13,5 @@ from .linalg import (  # noqa: F401
     eigvals, eigvalsh, lu, multi_dot, householder_product, cdist,
 )
 from .attribute import shape, rank, is_floating_point, is_integer, is_complex  # noqa: F401
+from .array import array_length, array_read, array_write, create_array  # noqa: F401
 from . import math_patch  # noqa: F401  (installs operator overloads)

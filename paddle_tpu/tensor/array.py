@@ -1,0 +1,34 @@
+"""LoDTensorArray API (fluid array_read/array_write/create_array parity).
+
+The reference's tensor arrays back dynamic RNN state inside while_loops
+(operators/array_operator.* / lod_array ops). TPU-native stance: a tensor
+array is a plain python list at trace level — lax control flow carries
+stacked tensors, so these exist for fluid-era API compatibility."""
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def create_array(dtype="float32", initialized_list=None):
+    return list(initialized_list) if initialized_list is not None else []
+
+
+def array_write(x, i, array=None):
+    idx = int(np.asarray(i._data if isinstance(i, Tensor) else i))
+    if array is None:
+        array = []
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    idx = int(np.asarray(i._data if isinstance(i, Tensor) else i))
+    return array[idx]
+
+
+def array_length(array):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(np.int64(len(array))))

@@ -531,3 +531,18 @@ def vander(x, n=None, increasing=False, name=None):
         return v[:, None] ** p[None, :]
 
     return apply(fn, _t(x))
+
+
+def add_n(inputs, name=None):
+    """sum_op.cc parity: elementwise sum of a list of same-shape tensors."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    ts = [_t(x) for x in inputs]
+    out = ts[0]
+    for t in ts[1:]:
+        out = out + t
+    return out
+
+
+def tanh_(x, name=None):
+    return apply_inplace(jnp.tanh, _t(x))

@@ -23,3 +23,58 @@ def run_check():
     dev = jax.devices()[0]
     print(f"paddle_tpu works on {dev.platform}:{dev.id} (matmul checksum {float(y.sum()):.0f})")
     return True
+
+
+def require_version(min_version, max_version=None):
+    """paddle.utils.require_version parity — this framework tracks the 2.x
+    API surface; accepts any 0/1/2 constraint."""
+    return True
+
+
+def download(url, path=None, md5sum=None):
+    """paddle.utils.download parity: zero-egress image — only file:// or
+    existing local paths resolve; network URLs raise with a clear message."""
+    import os
+    import shutil
+
+    src = url[7:] if url.startswith("file://") else url
+    if os.path.exists(src):
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            shutil.copy(src, path)
+            return path
+        return src
+    raise RuntimeError(
+        f"download({url!r}): no network egress in this environment; place "
+        "the file locally and pass its path (or file:// URL)")
+
+
+class ProfilerOptions:
+    def __init__(self, options=None):
+        self.options = options or {}
+
+
+class Profiler:
+    """Compat shim over paddle_tpu.profiler (RecordEvent tree + chrome trace)."""
+
+    def __init__(self, enabled=True, options=None):
+        self.enabled = enabled
+        self.options = options
+
+    def __enter__(self):
+        from .. import profiler as P
+
+        if self.enabled:
+            P.start_profiler("All")
+        return self
+
+    def __exit__(self, *a):
+        from .. import profiler as P
+
+        if self.enabled:
+            P.stop_profiler()
+        return False
+
+
+def get_profiler(options=None):
+    return Profiler(options=options)

@@ -4,3 +4,7 @@ from .mobilenet import MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2  # n
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .ppyolo import PPYOLOE, PPYOLOELoss, ppyoloe_tiny  # noqa: F401
+
+# reference module names (vision/models/__init__.py imports them)
+from . import mobilenet as mobilenetv1  # noqa: E402,F401
+from . import mobilenet as mobilenetv2  # noqa: E402,F401

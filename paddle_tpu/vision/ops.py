@@ -1302,3 +1302,12 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes, im_info,
     for t in outs:
         t.stop_gradient = True
     return tuple(outs)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """paddle.vision.ops.yolo_loss 2.x alias of yolov3_loss."""
+    return yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                       ignore_thresh, downsample_ratio, gt_score=gt_score,
+                       use_label_smooth=use_label_smooth, scale_x_y=scale_x_y)

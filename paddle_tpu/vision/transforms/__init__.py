@@ -24,3 +24,5 @@ from .transforms import (  # noqa: F401
     normalize,
     resize,
 )
+
+from . import functional  # noqa: E402,F401

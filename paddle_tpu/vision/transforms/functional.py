@@ -1,0 +1,69 @@
+"""paddle.vision.transforms.functional parity: stateless transform fns the
+class transforms delegate to (python/paddle/vision/transforms/functional.py).
+Backed by the same numpy/PIL-free implementations as transforms.py."""
+import numpy as np
+
+
+def _chw(img):
+    return np.asarray(img)
+
+
+def to_tensor(pic, data_format="CHW"):
+    from .transforms import ToTensor
+
+    return ToTensor(data_format)(pic)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    from .transforms import Normalize
+
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    from .transforms import Resize
+
+    return Resize(size, interpolation)(img)
+
+
+def center_crop(img, output_size):
+    from .transforms import CenterCrop
+
+    return CenterCrop(output_size)(img)
+
+
+def crop(img, top, left, height, width):
+    a = np.asarray(img)
+    if a.ndim == 3 and a.shape[0] in (1, 3):  # CHW
+        return a[:, top: top + height, left: left + width]
+    return a[top: top + height, left: left + width]
+
+
+def hflip(img):
+    a = np.asarray(img)
+    return a[:, :, ::-1] if (a.ndim == 3 and a.shape[0] in (1, 3)) else a[:, ::-1]
+
+
+def vflip(img):
+    a = np.asarray(img)
+    return a[:, ::-1, :] if (a.ndim == 3 and a.shape[0] in (1, 3)) else a[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    from .transforms import Pad
+
+    return Pad(padding, fill, padding_mode)(img)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    from .transforms import RandomRotation
+
+    r = RandomRotation((angle, angle), interpolation, expand, center, fill)
+    return r(img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    from .transforms import Grayscale
+
+    return Grayscale(num_output_channels)(img)

@@ -214,3 +214,34 @@ def test_book_label_semantic_roles_crf():
                              include_bos_eos_tag=False)
     acc = (np.asarray(path._data) == (ids % T)).mean()
     assert acc > 0.5, acc
+
+
+def test_beam_search_decoder():
+    """BeamSearchDecoder + dynamic_decode find the argmax path of a biased
+    GRU language model (beam 1 == greedy; wider beams score >= greedy)."""
+    V_, D, H, K = 12, 8, 16, 3
+
+    emb = nn.Embedding(V_, D)
+    cell = nn.GRUCell(D, H)
+    out_fc = nn.Linear(H, V_)
+
+    def output_fn(h):
+        return out_fc(h)
+
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=2, beam_size=K,
+                               embedding_fn=emb, output_fn=output_fn)
+    B = 2
+    init = cell.get_initial_states(paddle.to_tensor(np.zeros((B, D), np.float32)))
+    ids, scores, lens = nn.dynamic_decode(dec, inits=init, max_step_num=6,
+                                          return_length=True)
+    ids_np = np.asarray(ids._data)
+    assert ids_np.shape[0] == B and ids_np.shape[2] == K
+    assert np.asarray(lens._data).max() <= 6
+    # scores sorted descending across beams
+    sc = np.asarray(scores._data)
+    assert (np.diff(sc, axis=1) <= 1e-5).all()
+    # greedy (beam 1) matches the top beam of the same model
+    dec1 = nn.BeamSearchDecoder(cell, start_token=1, end_token=2, beam_size=1,
+                                embedding_fn=emb, output_fn=output_fn)
+    ids1, sc1 = nn.dynamic_decode(dec1, inits=init, max_step_num=6)
+    assert np.asarray(sc1._data)[0, 0] <= sc[0, 0] + 1e-5

@@ -1,0 +1,138 @@
+"""API-coverage report: reference public python surface vs paddle_tpu.
+
+Walks the reference package's `__init__.py` import-as graph (textually — the
+reference can't be imported here) to collect the public `paddle.*` names, then
+checks each against the installed paddle_tpu package. Prints per-namespace
+counts and the missing names; exits 0 always (informational).
+
+Usage: python tools/api_coverage.py [--ref /root/reference/python/paddle]
+                                    [--list-missing]
+"""
+import argparse
+import ast
+import importlib
+import os
+import sys
+
+
+NAMESPACES = [
+    ("paddle", "__init__.py"),
+    ("paddle.nn", "nn/__init__.py"),
+    ("paddle.nn.functional", "nn/functional/__init__.py"),
+    ("paddle.tensor", "tensor/__init__.py"),
+    ("paddle.optimizer", "optimizer/__init__.py"),
+    ("paddle.metric", "metric/__init__.py"),
+    ("paddle.vision.ops", "vision/ops.py"),
+    ("paddle.vision.transforms", "vision/transforms/__init__.py"),
+    ("paddle.vision.models", "vision/models/__init__.py"),
+    ("paddle.text", "text/__init__.py"),
+    ("paddle.io", "io/__init__.py"),
+    ("paddle.jit", "jit/__init__.py"),
+    ("paddle.static", "static/__init__.py"),
+    ("paddle.distributed", "distributed/__init__.py"),
+    ("paddle.distributed.fleet", "distributed/fleet/__init__.py"),
+    ("paddle.amp", "amp/__init__.py"),
+    ("paddle.utils", "utils/__init__.py"),
+    ("paddle.incubate", "incubate/__init__.py"),
+]
+
+
+def public_names(path):
+    """Names a module's __init__ exposes: __all__ if present, else top-level
+    imports/defs/assigns (textual AST walk, no import)."""
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except (OSError, SyntaxError):
+        return set()
+    names = set()
+    all_lists = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    try:
+                        all_lists.append([ast.literal_eval(e) for e in
+                                          node.value.elts])
+                    except Exception:
+                        pass
+        if isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Name) and node.target.id == "__all__":
+                all_lists.append(None)  # computed __all__ -> fall back
+    if all_lists and all(a is not None for a in all_lists):
+        for a in all_lists:
+            names.update(a)
+        return {n for n in names if isinstance(n, str)}
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                n = alias.asname or alias.name.split(".")[0]
+                if not n.startswith("_"):
+                    names.add(n)
+        elif isinstance(node, (ast.FunctionDef, ast.ClassDef)):
+            if not node.name.startswith("_"):
+                names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and not t.id.startswith("_"):
+                    names.add(t.id)
+    return names
+
+
+# names that are build-system/compat internals in the reference, not API
+NOISE = {"core", "core_avx", "core_noavx", "libpaddle", "monkey_patch_varbase",
+         "monkey_patch_math_varbase", "proto", "cpt", "six", "np", "numpy",
+         "sys", "os", "re", "warnings", "functools", "collections", "copy",
+         "inspect", "math", "json", "pickle", "paddle", "fluid", "logging",
+         "itertools", "contextlib", "threading", "time", "types", "typing",
+         "struct", "subprocess", "tempfile", "textwrap", "traceback"}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ref", default="/root/reference/python/paddle")
+    ap.add_argument("--list-missing", action="store_true")
+    args = ap.parse_args()
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu
+
+    total_ref = total_have = 0
+    rows = []
+    all_missing = {}
+    for ns, rel in NAMESPACES:
+        ref_path = os.path.join(args.ref, rel)
+        ref_names = {n for n in public_names(ref_path) if n not in NOISE}
+        if not ref_names:
+            continue
+        mod_name = ns.replace("paddle", "paddle_tpu", 1)
+        try:
+            mod = importlib.import_module(mod_name)
+        except ImportError:
+            mod = None
+        have = {n for n in ref_names if mod is not None and hasattr(mod, n)}
+        missing = sorted(ref_names - have)
+        rows.append((ns, len(have), len(ref_names)))
+        all_missing[ns] = missing
+        total_ref += len(ref_names)
+        total_have += len(have)
+
+    width = max(len(r[0]) for r in rows)
+    for ns, h, r in rows:
+        pct = 100.0 * h / r
+        print(f"{ns:<{width}}  {h:>4}/{r:<4}  {pct:5.1f}%")
+    print("-" * (width + 20))
+    print(f"{'TOTAL':<{width}}  {total_have:>4}/{total_ref:<4}  "
+          f"{100.0 * total_have / total_ref:5.1f}%")
+    if args.list_missing:
+        for ns, missing in all_missing.items():
+            if missing:
+                print(f"\n[{ns}] missing ({len(missing)}):")
+                print("  " + ", ".join(missing))
+
+
+if __name__ == "__main__":
+    main()
