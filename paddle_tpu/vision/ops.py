@@ -1311,3 +1311,38 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     return yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
                        ignore_thresh, downsample_ratio, gt_score=gt_score,
                        use_label_smooth=use_label_smooth, scale_x_y=scale_x_y)
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip=4.135, name=None):
+    """detection/box_decoder_and_assign_op.h parity (Cascade-RCNN): decode
+    per-class deltas against each RoI (+1-width convention, dw/dh clipped to
+    box_clip), then assign each RoI the decoded box of its best non-background
+    class. Returns (decode_box [R, C*4], output_assign_box [R, 4])."""
+    def fn(pb, pv, tb, sc):
+        R = pb.shape[0]
+        C = sc.shape[1]
+        pw = pb[:, 2] - pb[:, 0] + 1
+        ph = pb[:, 3] - pb[:, 1] + 1
+        pcx = pb[:, 0] + pw / 2
+        pcy = pb[:, 1] + ph / 2
+        d = tb.reshape(R, C, 4)
+        dw = jnp.minimum(pv[2] * d[..., 2], box_clip)
+        dh = jnp.minimum(pv[3] * d[..., 3], box_clip)
+        cx = pv[0] * d[..., 0] * pw[:, None] + pcx[:, None]
+        cy = pv[1] * d[..., 1] * ph[:, None] + pcy[:, None]
+        bw = jnp.exp(dw) * pw[:, None]
+        bh = jnp.exp(dh) * ph[:, None]
+        boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2 - 1, cy + bh / 2 - 1], axis=-1)
+        # best non-background class per roi (class 0 = background)
+        masked = jnp.where(jnp.arange(C)[None, :] > 0, sc, -jnp.inf)
+        best = jnp.argmax(masked, axis=1)
+        assign = jnp.take_along_axis(
+            boxes, best[:, None, None] * jnp.ones((1, 1, 4), jnp.int64),
+            axis=1)[:, 0]
+        return boxes.reshape(R, C * 4), assign
+
+    db, ab = apply(fn, _t(prior_box).detach(), _t(prior_box_var).detach(),
+                   _t(target_box), _t(box_score).detach())
+    return db, ab

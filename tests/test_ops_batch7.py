@@ -714,3 +714,29 @@ def test_generate_proposal_labels():
     assert w[0, 12:16].sum() == 4.0 and w[0, :12].sum() == 0.0
     # bg rows have zero weights everywhere
     assert w[lab == 0].sum() == 0.0
+
+
+def test_box_decoder_and_assign():
+    pb = np.array([[0, 0, 9, 9], [10, 10, 29, 19]], np.float32)
+    pv = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+    tb = rng.randn(2, 3 * 4).astype(np.float32) * 0.5
+    sc = np.array([[0.8, 0.1, 0.7], [0.2, 0.9, 0.3]], np.float32)
+    db, ab = V.box_decoder_and_assign(pb, pv, tb, sc, box_clip=4.135)
+    db, ab = _np(db), _np(ab)
+    # loop-port of the reference kernel
+    for i in range(2):
+        pw = pb[i, 2] - pb[i, 0] + 1
+        ph = pb[i, 3] - pb[i, 1] + 1
+        pcx, pcy = pb[i, 0] + pw / 2, pb[i, 1] + ph / 2
+        for j in range(3):
+            o = j * 4
+            dw = min(pv[2] * tb[i, o + 2], 4.135)
+            dh = min(pv[3] * tb[i, o + 3], 4.135)
+            cx = pv[0] * tb[i, o] * pw + pcx
+            cy = pv[1] * tb[i, o + 1] * ph + pcy
+            bw, bh = np.exp(dw) * pw, np.exp(dh) * ph
+            exp = [cx - bw / 2, cy - bh / 2, cx + bw / 2 - 1, cy + bh / 2 - 1]
+            np.testing.assert_allclose(db[i, o: o + 4], exp, rtol=1e-4)
+    # assignment picks best non-background class (2 for roi0, 1 for roi1)
+    np.testing.assert_allclose(ab[0], db[0, 8:12], rtol=1e-6)
+    np.testing.assert_allclose(ab[1], db[1, 4:8], rtol=1e-6)
