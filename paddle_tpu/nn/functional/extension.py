@@ -556,3 +556,75 @@ def fsp_matrix(x, y, name=None):
         return jnp.einsum("bchw,bdhw->bcd", a, b) / (H * W)
 
     return apply(fn, _t(x), _t(y))
+
+
+def tdm_child(x, tree_info, child_nums, dtype="int32", name=None):
+    """tdm_child_op.h parity (tree-based deep match): per input node id,
+    return its `child_nums` children from tree_info rows
+    [item_id, layer_id, ancestor_id, child_0, ..] and a leaf mask
+    (child is an item <=> tree_info[child][0] != 0). Eager host op."""
+    ids = np.asarray(_t(x)._data).astype(np.int64)
+    info = np.asarray(_t(tree_info)._data).astype(np.int64)
+    flat = ids.reshape(-1)
+    child = np.zeros((flat.size, child_nums), np.int64)
+    mask = np.zeros((flat.size, child_nums), np.int64)
+    for k, nid in enumerate(flat):
+        if nid == 0 or info[nid, 3] == 0:
+            continue
+        for c in range(child_nums):
+            cid = info[nid, 3 + c]
+            child[k, c] = cid
+            mask[k, c] = 1 if info[cid, 0] != 0 else 0
+    shape = list(ids.shape) + [child_nums]
+    out_c = Tensor(jnp.asarray(child.reshape(shape)))
+    out_m = Tensor(jnp.asarray(mask.reshape(shape)))
+    out_c.stop_gradient = True
+    out_m.stop_gradient = True
+    return out_c, out_m
+
+
+def tdm_sampler(x, travel, layer, neg_samples_num_list, layer_offset_lod,
+                output_positive=True, output_list=False, seed=0,
+                tree_dtype="int32", dtype="int32", name=None):
+    """tdm_sampler_op.h parity: per leaf, walk its root-to-leaf travel path;
+    at each tree layer emit [positive +] N uniformly-sampled negatives from
+    that layer (positive excluded), with 1/0 labels and a padding mask
+    (travel id 0 = padded layer -> mask 0). Eager host op."""
+    ids = np.asarray(_t(x)._data).astype(np.int64).reshape(-1)
+    trav = np.asarray(_t(travel)._data).astype(np.int64)
+    lay = np.asarray(_t(layer)._data).astype(np.int64).reshape(-1)
+    rng_ = np.random.RandomState(seed if seed else None)
+    L = len(neg_samples_num_list)
+    per = [n + (1 if output_positive else 0) for n in neg_samples_num_list]
+    width = sum(per)
+    out = np.zeros((ids.size, width), np.int64)
+    lab = np.zeros((ids.size, width), np.int64)
+    msk = np.ones((ids.size, width), np.int64)
+    for i, leaf in enumerate(ids):
+        off = 0
+        for li in range(L):
+            pos = trav[leaf, li]
+            lo, hi = layer_offset_lod[li], layer_offset_lod[li + 1]
+            nodes = lay[lo:hi]
+            if output_positive:
+                out[i, off] = pos
+                lab[i, off] = 1
+                if pos == 0:  # padded ancestor
+                    msk[i, off] = 0
+                off += 1
+            n_neg = neg_samples_num_list[li]
+            cand = nodes[nodes != pos]
+            if len(cand) >= n_neg:
+                neg = rng_.choice(cand, n_neg, replace=False)
+            else:
+                neg = np.resize(cand, n_neg) if len(cand) else np.zeros(n_neg, np.int64)
+            out[i, off: off + n_neg] = neg
+            if pos == 0:
+                msk[i, off: off + n_neg] = 0
+                out[i, off: off + n_neg] = 0
+            off += n_neg
+    outs = (Tensor(jnp.asarray(out)), Tensor(jnp.asarray(lab)),
+            Tensor(jnp.asarray(msk)))
+    for t in outs:
+        t.stop_gradient = True
+    return outs

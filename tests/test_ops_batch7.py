@@ -740,3 +740,33 @@ def test_box_decoder_and_assign():
     # assignment picks best non-background class (2 for roi0, 1 for roi1)
     np.testing.assert_allclose(ab[0], db[0, 8:12], rtol=1e-6)
     np.testing.assert_allclose(ab[1], db[1, 4:8], rtol=1e-6)
+
+
+def test_tdm_child_and_sampler():
+    # tree: 0 unused; 1=root(non-item, children 2,3); 2,3 leaves (items 10, 11)
+    #        cols: [item_id, layer_id, ancestor_id, child0, child1]
+    info = np.array([
+        [0, 0, 0, 0, 0],
+        [0, 0, 0, 2, 3],
+        [10, 1, 1, 0, 0],
+        [11, 1, 1, 0, 0],
+    ], np.int64)
+    child, mask = F.tdm_child(np.array([1, 2]), info, child_nums=2)
+    np.testing.assert_allclose(_np(child), [[2, 3], [0, 0]])
+    np.testing.assert_allclose(_np(mask), [[1, 1], [0, 0]])
+
+    # travel paths for leaves (rows indexed by leaf id): layers = [root-level,
+    # leaf-level]; layer node lists: layer0 = [1], layer1 = [2, 3]
+    travel = np.zeros((4, 2), np.int64)
+    travel[2] = [1, 2]
+    travel[3] = [1, 3]
+    layer = np.array([1, 2, 3], np.int64)
+    out, lab, msk = F.tdm_sampler(np.array([2, 3]), travel, layer,
+                                  neg_samples_num_list=[0, 1],
+                                  layer_offset_lod=[0, 1, 3], seed=4)
+    o, l, m = _np(out), _np(lab), _np(msk)
+    # row 0 (leaf 2): [pos 1] [pos 2, neg 3]; row 1 (leaf 3): [1] [3, 2]
+    np.testing.assert_allclose(o[0], [1, 2, 3])
+    np.testing.assert_allclose(o[1], [1, 3, 2])
+    np.testing.assert_allclose(l, [[1, 1, 0], [1, 1, 0]])
+    np.testing.assert_allclose(m, 1)
