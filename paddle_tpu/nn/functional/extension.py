@@ -628,3 +628,31 @@ def tdm_sampler(x, travel, layer, neg_samples_num_list, layer_offset_lod,
     for t in outs:
         t.stop_gradient = True
     return outs
+
+
+def match_matrix_tensor(x, y, w, x_length=None, y_length=None, dim_t=1,
+                        name=None):
+    """match_matrix_tensor_op.cc parity (text-matching bilinear tensor):
+    out[b, t, i, j] = x[b, i] @ W[:, t, :] @ y[b, j] with positions past each
+    sequence's length masked to 0. Padded form of the reference's LoD op:
+    x [B, Lx, D1], y [B, Ly, D2], w [D1, dim_t, D2] -> [B, dim_t, Lx, Ly]."""
+    args = [_t(x), _t(y), _t(w)]
+    if x_length is not None:
+        args.append(_t(x_length).detach())
+    if y_length is not None:
+        args.append(_t(y_length).detach())
+
+    def fn(xv, yv, wv, *lens):
+        out = jnp.einsum("bid,dte,bje->btij", xv, wv, yv)
+        B, _, Lx, Ly = out.shape
+        if lens:
+            lx = lens[0].astype(jnp.int32)
+            mask_x = (jnp.arange(Lx)[None, :] < lx[:, None])
+            out = out * mask_x[:, None, :, None]
+            if len(lens) > 1:
+                ly = lens[1].astype(jnp.int32)
+                mask_y = (jnp.arange(Ly)[None, :] < ly[:, None])
+                out = out * mask_y[:, None, None, :]
+        return out
+
+    return apply(fn, *args)

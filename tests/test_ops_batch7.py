@@ -770,3 +770,28 @@ def test_tdm_child_and_sampler():
     np.testing.assert_allclose(o[1], [1, 3, 2])
     np.testing.assert_allclose(l, [[1, 1, 0], [1, 1, 0]])
     np.testing.assert_allclose(m, 1)
+
+
+def test_match_matrix_tensor():
+    B, Lx, Ly, D1, D2, T = 2, 3, 4, 5, 6, 2
+    x = _randn(B, Lx, D1)
+    y = _randn(B, Ly, D2)
+    w = _randn(D1, T, D2)
+    lx = np.array([3, 2])
+    ly = np.array([4, 1])
+    got = _np(F.match_matrix_tensor(paddle.to_tensor(x), paddle.to_tensor(y),
+                                    paddle.to_tensor(w), lx, ly, dim_t=T))
+    assert got.shape == (B, T, Lx, Ly)
+    for b in range(B):
+        for t in range(T):
+            exp = x[b] @ w[:, t, :] @ y[b].T
+            exp[lx[b]:, :] = 0
+            exp[:, ly[b]:] = 0
+            np.testing.assert_allclose(got[b, t], exp, rtol=1e-4, atol=1e-5)
+    # grads flow through all three inputs
+    xt, yt, wt = (paddle.to_tensor(v) for v in (x, y, w))
+    for t in (xt, yt, wt):
+        t.stop_gradient = False
+    F.match_matrix_tensor(xt, yt, wt, lx, ly, dim_t=T).sum().backward()
+    for t in (xt, yt, wt):
+        assert np.abs(_np(t.grad)).sum() > 0
