@@ -97,10 +97,22 @@ def main():
     import jax
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-    # batch 16 is the measured single-chip sweet spot at seq 1024 (BENCH_r01:
-    # 61.9k tok/s there; batch 32 exceeds 16G HBM for GPT-2 small)
+    # batch 16 was the r1 sweet spot at seq 1024 (batch 32 exceeded 16G HBM);
+    # the r2 flash-attention retune cut attention HBM traffic, so when no
+    # explicit --batch is given on TPU, a quick 2-config probe (6 steps each)
+    # picks between 16 and 24 before the full 20-step measurement.
     batch = args.batch or (16 if on_tpu else 2)
     seq = args.seq or (1024 if on_tpu else 128)
+
+    if on_tpu and args.batch is None and not args.sweep:
+        probes = {}
+        for b in (16, 24):
+            try:
+                probes[b], _ = run_config(b, seq, 6)
+            except Exception as e:
+                print(f"  probe batch={b} failed ({e})", file=sys.stderr)
+        if probes:
+            batch = max(probes, key=probes.get)
 
     if args.sweep:
         best = (0.0, 0.0, None)
