@@ -795,3 +795,30 @@ def test_match_matrix_tensor():
     F.match_matrix_tensor(xt, yt, wt, lx, ly, dim_t=T).sum().backward()
     for t in (xt, yt, wt):
         assert np.abs(_np(t.grad)).sum() > 0
+
+
+def test_prroi_pool():
+    # constant feature map -> every bin averages to the constant
+    x = np.full((1, 2, 6, 6), 4.0, np.float32)
+    # interior roi (within pixel centers [0, 5]): bilinear surface is exactly
+    # constant there; outside the centers the interpolant decays to zero
+    # (zero-padding convention of the original PrRoI pooling)
+    rois = np.array([[0.7, 0.9, 4.3, 4.9]], np.float32)
+    got = _np(V.prroi_pool(paddle.to_tensor(x), paddle.to_tensor(rois),
+                           paddle.to_tensor(np.array([1], np.int32)), 2))
+    np.testing.assert_allclose(got, 4.0, rtol=1e-4)
+    # linear ramp f(x, y) = x: bin average == analytic mean of x over the bin
+    ramp = np.tile(np.arange(6, dtype=np.float32)[None, :], (6, 1))
+    xr = ramp[None, None]
+    rois2 = np.array([[1.0, 1.0, 5.0, 5.0]], np.float32)
+    got2 = _np(V.prroi_pool(paddle.to_tensor(xr), paddle.to_tensor(rois2),
+                            paddle.to_tensor(np.array([1], np.int32)), 2))
+    # bins split x-range [1, 5] into [1, 3] and [3, 5]: means 2 and 4
+    np.testing.assert_allclose(got2[0, 0, :, 0], [2.0, 2.0], rtol=1e-4)
+    np.testing.assert_allclose(got2[0, 0, :, 1], [4.0, 4.0], rtol=1e-4)
+    # differentiable wrt features
+    xt = paddle.to_tensor(x)
+    xt.stop_gradient = False
+    V.prroi_pool(xt, paddle.to_tensor(rois),
+                 paddle.to_tensor(np.array([1], np.int32)), 2).sum().backward()
+    assert np.abs(_np(xt.grad)).sum() > 0
