@@ -1416,3 +1416,70 @@ def prroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
         return jax.vmap(one)(rois, img_idx)
 
     return apply(fn, xv, bv)
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                       nms_threshold=0.3, normalized=True,
+                       background_label=-1, name=None):
+    """detection/locality_aware_nms_op.cc parity (EAST text detection):
+    a sequential pass over boxes in input order score-weighted-MERGES runs of
+    mutually-overlapping boxes (:102-128), then standard multiclass NMS runs
+    on the merged survivors. Eager host op (the merge is order-dependent).
+    bboxes [N, M, 4], scores [N, C, M] -> (out [N, keep_top_k, 6], num [N])."""
+    bv = np.asarray(_t(bboxes)._data)
+    sv = np.asarray(_t(scores)._data)
+    off = 0.0 if normalized else 1.0
+
+    def iou(a, b):
+        ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]) + off)
+        iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]) + off)
+        inter = ix * iy
+        ar_a = max(0, a[2] - a[0] + off) * max(0, a[3] - a[1] + off)
+        ar_b = max(0, b[2] - b[0] + off) * max(0, b[3] - b[1] + off)
+        u = ar_a + ar_b - inter
+        return inter / u if u > 0 else 0.0
+
+    N, C, M = sv.shape
+    outs, nums = [], []
+    for n in range(N):
+        entries = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            boxes = bv[n].copy()
+            sc = sv[n, c].copy()
+            skip = np.ones(M, bool)
+            idx = -1
+            for i in range(M):
+                if idx > -1:
+                    if iou(boxes[i], boxes[idx]) > nms_threshold:
+                        si, sx = sc[i], sc[idx]
+                        boxes[idx] = (boxes[i] * si + boxes[idx] * sx) / (si + sx)
+                        sc[idx] += sc[i]
+                    else:
+                        skip[idx] = False
+                        idx = i
+                else:
+                    idx = i
+            if idx > -1:
+                skip[idx] = False
+            keep = np.nonzero((~skip) & (sc > score_threshold))[0]
+            keep = keep[np.argsort(-sc[keep], kind="stable")]
+            if nms_top_k > -1:
+                keep = keep[:nms_top_k]
+            if len(keep):
+                kmask = np.asarray(nms_mask(jnp.asarray(boxes[keep]),
+                                            jnp.asarray(sc[keep]),
+                                            nms_threshold))
+                for k in keep[kmask]:
+                    entries.append([float(c), sc[k], *boxes[k]])
+        entries.sort(key=lambda e: -e[1])
+        entries = entries[:keep_top_k]
+        nums.append(len(entries))
+        pad = [[-1.0] * 6] * (keep_top_k - len(entries))
+        outs.append(np.asarray(entries + pad, np.float32).reshape(keep_top_k, 6))
+    out_t = Tensor(jnp.asarray(np.stack(outs)))
+    num_t = Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    out_t.stop_gradient = True
+    num_t.stop_gradient = True
+    return out_t, num_t

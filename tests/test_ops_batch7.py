@@ -822,3 +822,23 @@ def test_prroi_pool():
     V.prroi_pool(xt, paddle.to_tensor(rois),
                  paddle.to_tensor(np.array([1], np.int32)), 2).sum().backward()
     assert np.abs(_np(xt.grad)).sum() > 0
+
+
+def test_locality_aware_nms():
+    # three near-identical boxes in sequence + one far box: the run of three
+    # merges into one score-weighted box; far box survives separately
+    boxes = np.array([[[0, 0, 10, 10], [0.2, 0.2, 10.2, 10.2],
+                       [0.1, 0.1, 10.1, 10.1], [50, 50, 60, 60]]], np.float32)
+    scores = np.zeros((1, 1, 4), np.float32)
+    scores[0, 0] = [0.5, 0.3, 0.2, 0.9]
+    out, num = V.locality_aware_nms(paddle.to_tensor(boxes),
+                                    paddle.to_tensor(scores),
+                                    score_threshold=0.1, nms_top_k=10,
+                                    keep_top_k=5, nms_threshold=0.5)
+    o = _np(out)[0]
+    assert int(_np(num)[0]) == 2
+    # merged box score = 0.5+0.3+0.2 = 1.0 (tops the far box's 0.9)
+    np.testing.assert_allclose(o[0, 1], 1.0, rtol=1e-5)
+    np.testing.assert_allclose(o[1, 1], 0.9, rtol=1e-5)
+    # merged coords = weighted average, near [0.1, 0.1, 10.1, 10.1]
+    assert abs(o[0, 2] - 0.11) < 0.1 and abs(o[0, 5] - 10.1) < 0.15
