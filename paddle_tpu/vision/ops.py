@@ -1483,3 +1483,69 @@ def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
     out_t.stop_gradient = True
     num_t.stop_gradient = True
     return out_t, num_t
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3, nms_eta=1.0,
+                               name=None):
+    """detection/retinanet_detection_output_op.cc parity: multi-level (FPN)
+    RetinaNet post-processing — per level, threshold the [cells*A, C] sigmoid
+    scores (last level thresholds at 0), keep nms_top_k, decode the top
+    candidates' anchor deltas (+1 convention, clipped to the rescaled image),
+    then per-class NMS over the union and keep_top_k. Single image, eager.
+    bboxes/scores/anchors: lists per level ([M_l, 4], [M_l, C], [M_l, 4]);
+    im_info (h, w, scale). Returns (out [k, 6], num)."""
+    info = np.asarray(_t(im_info)._data).reshape(-1)
+    im_h = round(float(info[0]) / float(info[2]))
+    im_w = round(float(info[1]) / float(info[2]))
+    scale = float(info[2])
+
+    cand = []  # (class, score, box)
+    L = len(scores)
+    for l in range(L):
+        sc = np.asarray(_t(scores[l])._data).reshape(-1)
+        bx = np.asarray(_t(bboxes[l])._data).reshape(-1, 4)
+        an = np.asarray(_t(anchors[l])._data).reshape(-1, 4)
+        C = np.asarray(_t(scores[l])._data).shape[-1]
+        thr = score_threshold if l < L - 1 else 0.0
+        keep = np.nonzero(sc > thr)[0]
+        keep = keep[np.argsort(-sc[keep], kind="stable")][:nms_top_k]
+        for idx in keep:
+            a, c = idx // C, idx % C
+            aw = an[a, 2] - an[a, 0] + 1
+            ah = an[a, 3] - an[a, 1] + 1
+            acx = an[a, 0] + aw / 2
+            acy = an[a, 1] + ah / 2
+            cx = bx[a, 0] * aw + acx
+            cy = bx[a, 1] * ah + acy
+            bw = np.exp(bx[a, 2]) * aw
+            bh = np.exp(bx[a, 3]) * ah
+            box = np.array([cx - bw / 2, cy - bh / 2,
+                            cx + bw / 2 - 1, cy + bh / 2 - 1]) / scale
+            box[0::2] = np.clip(box[0::2], 0, im_w - 1)
+            box[1::2] = np.clip(box[1::2], 0, im_h - 1)
+            cand.append((int(c), float(sc[idx]), box))
+
+    entries = []
+    if cand:
+        classes = sorted(set(c for c, _, _ in cand))
+        for c in classes:
+            cl = [(s, b) for cc, s, b in cand if cc == c]
+            cl.sort(key=lambda e: -e[0])
+            boxes_c = np.stack([b for _, b in cl])
+            sc_c = np.asarray([s for s, _ in cl], np.float32)
+            kmask = np.asarray(nms_mask(jnp.asarray(boxes_c),
+                                        jnp.asarray(sc_c), nms_threshold,
+                                        use_pallas=False))
+            for k in np.nonzero(kmask)[0]:
+                entries.append([float(c), sc_c[k], *boxes_c[k]])
+        entries.sort(key=lambda e: -e[1])
+        entries = entries[:keep_top_k]
+    n = len(entries)
+    pad = [[-1.0] * 6] * (keep_top_k - n)
+    out = Tensor(jnp.asarray(np.asarray(entries + pad, np.float32)))
+    num = Tensor(jnp.asarray(np.asarray([n], np.int32)))
+    out.stop_gradient = True
+    num.stop_gradient = True
+    return out, num

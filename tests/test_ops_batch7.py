@@ -842,3 +842,23 @@ def test_locality_aware_nms():
     np.testing.assert_allclose(o[1, 1], 0.9, rtol=1e-5)
     # merged coords = weighted average, near [0.1, 0.1, 10.1, 10.1]
     assert abs(o[0, 2] - 0.11) < 0.1 and abs(o[0, 5] - 10.1) < 0.15
+
+
+def test_retinanet_detection_output():
+    # one level, two anchors, two classes; zero deltas decode to the anchors
+    anchors = np.array([[0, 0, 9, 9], [20, 20, 39, 39]], np.float32)
+    deltas = np.zeros((2, 4), np.float32)
+    scores = np.array([[0.9, 0.1], [0.05, 0.8]], np.float32)
+    out, num = V.retinanet_detection_output(
+        [deltas], [scores], [anchors],
+        np.array([100.0, 100.0, 1.0], np.float32),
+        score_threshold=0.3, keep_top_k=5, nms_threshold=0.5)
+    o = _np(out)
+    # last level thresholds at 0.0, so ALL 4 (anchor, class) pairs become
+    # candidates; per-class NMS keeps the best per location -> 4 entries but
+    # the two high-score ones lead
+    assert int(_np(num)[0]) >= 2
+    assert o[0, 1] == pytest.approx(0.9) and o[0, 0] == 0
+    np.testing.assert_allclose(o[0, 2:], [0, 0, 9, 9], atol=1e-4)
+    assert o[1, 1] == pytest.approx(0.8) and o[1, 0] == 1
+    np.testing.assert_allclose(o[1, 2:], [20, 20, 39, 39], atol=1e-4)
