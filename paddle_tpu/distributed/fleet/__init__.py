@@ -49,7 +49,15 @@ class UtilBase:
     def all_reduce(self, input, mode="sum"):
         import numpy as np
 
-        return np.asarray(input)  # single-process group: identity
+        if self._env.world_size <= 1:
+            return np.asarray(input)
+        from .. import collective as C
+        from ...core.tensor import Tensor
+        import jax.numpy as jnp
+
+        t = Tensor(jnp.asarray(np.asarray(input)))
+        C.all_reduce(t, op=getattr(C.ReduceOp, mode.upper(), C.ReduceOp.SUM))
+        return np.asarray(t._data)
 
     def barrier(self):
         from .. import collective

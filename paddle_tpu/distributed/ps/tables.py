@@ -167,6 +167,8 @@ class SparseTable:
             for rid, g in zip(uniq, summed):
                 rid = int(rid)
                 if rid not in self._rows:
+                    if not self._admitted(rid):  # entry policy gates pushes too
+                        continue
                     self._init_row(rid)
                 self._rows[rid] = self._rule.apply(self._rows[rid], g, self._slots[rid])
 
@@ -192,6 +194,8 @@ class GeoSparseTable(SparseTable):
             for rid, d in zip(ids, deltas):
                 rid = int(rid)
                 if rid not in self._rows:
+                    if not self._admitted(rid):
+                        continue
                     self._init_row(rid)
                 self._rows[rid] = self._rows[rid] + d
                 for t in range(self._trainers):

@@ -99,7 +99,9 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         layer, call_args = self._resolve_layer(args)
         tensor_args = [_tensorize(a) for a in call_args]
-        if getattr(self, "_eager_fallback", False):
+        if getattr(self, "_eager_fallback", False) or not ProgramTranslator._enabled:
+            # ProgramTranslator.enable(False): run the original function
+            # eagerly (reference StaticFunction._decorated_function fallback)
             return self._orig_fn(*tensor_args, **kwargs)
         key_parts = []
         for a in tensor_args:

@@ -115,7 +115,12 @@ def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=Fals
             ids, states, log_probs, finished)
         all_tokens.append(np.asarray(token))
         all_parents.append(np.asarray(parent))
-        lengths += (~finished).astype(np.int64)
+        # beams reorder every step: carry each slot's length along its parent
+        # lineage, then extend the slots whose PARENT beam was still live
+        par = np.asarray(parent)
+        parent_finished = np.take_along_axis(finished, par, axis=1)
+        lengths = np.take_along_axis(lengths, par, axis=1) + (
+            ~parent_finished).astype(np.int64)
         ids, finished = np.asarray(token), np.asarray(new_finished)
         if finished.all():
             break

@@ -643,20 +643,61 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
-    """py_func_op.cc parity: host-python op. Eager dispatch: runs `func` on
-    host values; the optional backward_func is attached as a custom VJP."""
+    """py_func_op.cc parity: host-python op on tensor values. With
+    `backward_func`, gradients flow: it is attached as the op's VJP and
+    receives (*inputs, *outputs, *output_grads) host arrays, returning the
+    input grads (the reference's backward py_func contract). Without it the
+    outputs are detached — same as the reference, whose py_func has no grad
+    op unless backward_func is given."""
     import numpy as np
 
+    import jax
+    from ..core.dispatch import apply
     from ..core.tensor import Tensor
     import jax.numpy as jnp
 
     xs = x if isinstance(x, (list, tuple)) else [x]
-    host = [np.asarray(v._data if isinstance(v, Tensor) else v) for v in xs]
-    res = func(*host)
-    if not isinstance(res, (list, tuple)):
-        res = [res]
-    outs = [Tensor(jnp.asarray(np.asarray(r))) for r in res]
-    return outs if len(outs) > 1 else outs[0]
+    ts = [v if isinstance(v, Tensor) else Tensor(jnp.asarray(np.asarray(v)))
+          for v in xs]
+
+    if backward_func is None:
+        host = [np.asarray(v._data) for v in ts]
+        res = func(*host)
+        if not isinstance(res, (list, tuple)):
+            res = [res]
+        outs = [Tensor(jnp.asarray(np.asarray(r))) for r in res]
+        for o in outs:
+            o.stop_gradient = True
+        return outs if len(outs) > 1 else outs[0]
+
+    multi = [None]  # whether func returned a tuple (fixed at first call)
+
+    @jax.custom_vjp
+    def _op(*arrs):
+        res = func(*[np.asarray(a) for a in arrs])
+        multi[0] = isinstance(res, (list, tuple))
+        res = res if multi[0] else [res]
+        out = tuple(jnp.asarray(np.asarray(r)) for r in res)
+        return out if len(out) > 1 else out[0]
+
+    def _fwd(*arrs):
+        out = _op(*arrs)
+        return out, (arrs, out if isinstance(out, tuple) else (out,))
+
+    def _bwd(resid, gout):
+        arrs, outs_v = resid
+        gs = gout if isinstance(gout, tuple) else (gout,)
+        host = ([np.asarray(a) for a in arrs]
+                + [np.asarray(o) for o in outs_v]
+                + [np.asarray(g) for g in gs])
+        gx = backward_func(*host)
+        if not isinstance(gx, (list, tuple)):
+            gx = [gx]
+        return tuple(jnp.asarray(np.asarray(g)) for g in gx)
+
+    _op.defvjp(_fwd, _bwd)
+    result = apply(_op, *ts)
+    return result
 
 
 def save(program, model_path, protocol=4):

@@ -1339,8 +1339,8 @@ def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
         masked = jnp.where(jnp.arange(C)[None, :] > 0, sc, -jnp.inf)
         best = jnp.argmax(masked, axis=1)
         assign = jnp.take_along_axis(
-            boxes, best[:, None, None] * jnp.ones((1, 1, 4), jnp.int64),
-            axis=1)[:, 0]
+            boxes, jnp.broadcast_to(best[:, None, None].astype(jnp.int32),
+                                    (boxes.shape[0], 1, 4)), axis=1)[:, 0]
         return boxes.reshape(R, C * 4), assign
 
     db, ab = apply(fn, _t(prior_box).detach(), _t(prior_box_var).detach(),

@@ -245,3 +245,23 @@ def test_beam_search_decoder():
                                 embedding_fn=emb, output_fn=output_fn)
     ids1, sc1 = nn.dynamic_decode(dec1, inits=init, max_step_num=6)
     assert np.asarray(sc1._data)[0, 0] <= sc[0, 0] + 1e-5
+
+
+def test_beam_search_lengths_follow_parents():
+    """Lengths must be gathered along parent lineages, not beam slots."""
+    V_, D, H, K = 8, 4, 8, 2
+    emb = nn.Embedding(V_, D)
+    cell = nn.GRUCell(D, H)
+    fc = nn.Linear(H, V_)
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=2, beam_size=K,
+                               embedding_fn=emb, output_fn=fc)
+    init = cell.get_initial_states(paddle.to_tensor(np.zeros((1, D), np.float32)))
+    ids, _, lens = nn.dynamic_decode(dec, inits=init, max_step_num=5,
+                                     return_length=True)
+    ids_np, lens_np = np.asarray(ids._data), np.asarray(lens._data)
+    # each slot's length equals the count of its OWN pre-end tokens + end
+    for k in range(K):
+        seq = ids_np[0, :, k]
+        if 2 in seq:
+            assert lens_np[0, k] <= len(seq)
+        assert lens_np[0, k] >= 1

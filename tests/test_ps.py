@@ -248,3 +248,27 @@ class TestFleetPsIntegration:
         strategy.a_sync = True
         kw, _ = apply_meta_optimizers({}, None, strategy)
         assert kw.get("ps_mode") is True
+
+
+def test_sparse_entry_admission():
+    from paddle_tpu.distributed.ps.tables import (CountFilterEntry,
+                                                  ProbabilityEntry,
+                                                  SparseTable)
+
+    t = SparseTable(4, entry=CountFilterEntry(3))
+    import numpy as np
+
+    # pushes before admission are dropped, pulls read zeros
+    t.push([7], np.ones((1, 4), np.float32))
+    assert t.size() == 0
+    v1 = t.pull([7])          # seen 2x now (push + pull)
+    np.testing.assert_allclose(v1, 0.0)
+    v2 = t.pull([7])          # 3rd sighting -> admitted
+    assert t.size() == 1
+    # ProbabilityEntry(1.0) admits immediately; (0.0) never does
+    t2 = SparseTable(4, entry=ProbabilityEntry(0.0))
+    t2.pull([1])
+    assert t2.size() == 0
+    t3 = SparseTable(4, entry=ProbabilityEntry(1.0))
+    t3.pull([1])
+    assert t3.size() == 1

@@ -253,3 +253,50 @@ class TestReviewFindings:
         xs = np.ones((2, 3), np.float32)
         with pytest.raises(ValueError, match="placeholder 'y'"):
             exe.run(main, feed={"x": xs}, fetch_list=[y])
+
+
+def test_py_func_backward():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    def forward(a):
+        return a * a
+
+    def backward(a, out, dout):
+        return 2.0 * a * dout
+
+    x = paddle.to_tensor(np.array([3.0, -2.0], np.float32))
+    x.stop_gradient = False
+    y = static.py_func(forward, x, None, backward_func=backward)
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._data), [6.0, -4.0],
+                               rtol=1e-6)
+    # without backward_func outputs are detached (reference: no grad op)
+    z = static.py_func(forward, x, None)
+    assert z.stop_gradient
+
+
+def test_program_translator_enable_toggle():
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    calls = []
+
+    @paddle.jit.to_static
+    def f(x):
+        calls.append(1)
+        return x + 1
+
+    pt = paddle.jit.ProgramTranslator.get_instance()
+    try:
+        pt.enable(False)
+        a = f(paddle.to_tensor(np.ones(2, np.float32)))
+        b = f(paddle.to_tensor(np.ones(2, np.float32)))
+        # eager fallback: python body runs every call (no trace cache)
+        assert len(calls) >= 2
+        np.testing.assert_allclose(np.asarray(a._data), 2.0)
+    finally:
+        pt.enable(True)

@@ -120,6 +120,10 @@ def main():
         total_ref += len(ref_names)
         total_have += len(have)
 
+    if not rows or total_ref == 0:
+        print(f"no reference namespaces found under {args.ref} — nothing to "
+              "compare (informational; exiting 0)")
+        return
     width = max(len(r[0]) for r in rows)
     for ns, h, r in rows:
         pct = 100.0 * h / r
