@@ -1549,3 +1549,88 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info,
     out.stop_gradient = True
     num.stop_gradient = True
     return out, num
+
+
+def roi_perspective_transform(x, rois, transformed_height, transformed_width,
+                              spatial_scale=1.0, name=None):
+    """detection/roi_perspective_transform_op.cc parity (OCR text
+    rectification): each RoI is a quadrilateral [x0 y0 .. x3 y3]; the op
+    builds the projective map from the output rectangle onto the quad
+    (:110-168 — width normalized by the quad's estimated aspect) and
+    bilinearly samples the feature map (out-of-bounds reads 0).
+
+    x [N, C, H, W]; rois [R, 8] with every RoI belonging to image 0..N-1 via
+    `rois_num`-free single-image usage (reference uses LoD; here all RoIs
+    sample image 0 unless rois has a leading batch column). Returns
+    (out [R, C, th, tw], mask [R, 1, th, tw], transform_matrix [R, 9])."""
+    th, tw = int(transformed_height), int(transformed_width)
+    xv = _t(x)
+    rv = _t(rois).detach()
+
+    def fn(feat, quads):
+        N, C, H, W = feat.shape
+        R = quads.shape[0]
+
+        def one(quad):
+            qx = quad[0::2] * spatial_scale
+            qy = quad[1::2] * spatial_scale
+            len1 = jnp.sqrt((qx[0] - qx[1]) ** 2 + (qy[0] - qy[1]) ** 2)
+            len2 = jnp.sqrt((qx[1] - qx[2]) ** 2 + (qy[1] - qy[2]) ** 2)
+            len3 = jnp.sqrt((qx[2] - qx[3]) ** 2 + (qy[2] - qy[3]) ** 2)
+            len4 = jnp.sqrt((qx[3] - qx[0]) ** 2 + (qy[3] - qy[0]) ** 2)
+            est_h = (len2 + len4) / 2.0
+            est_w = (len1 + len3) / 2.0
+            nh = max(2, th)
+            nw = jnp.clip(jnp.round(est_w * (nh - 1) / jnp.maximum(est_h, 1e-5)
+                                    ) + 1, 2, tw)
+            dx1, dx2 = qx[1] - qx[2], qx[3] - qx[2]
+            dx3 = qx[0] - qx[1] + qx[2] - qx[3]
+            dy1, dy2 = qy[1] - qy[2], qy[3] - qy[2]
+            dy3 = qy[0] - qy[1] + qy[2] - qy[3]
+            den = dx1 * dy2 - dx2 * dy1 + 1e-5
+            m6 = (dx3 * dy2 - dx2 * dy3) / den / (nw - 1)
+            m7 = (dx1 * dy3 - dx3 * dy1) / den / (nh - 1)
+            m8 = 1.0
+            m3 = (qy[1] - qy[0] + m6 * (nw - 1) * qy[1]) / (nw - 1)
+            m4 = (qy[3] - qy[0] + m7 * (nh - 1) * qy[3]) / (nh - 1)
+            m5 = qy[0]
+            m0 = (qx[1] - qx[0] + m6 * (nw - 1) * qx[1]) / (nw - 1)
+            m1 = (qx[3] - qx[0] + m7 * (nh - 1) * qx[3]) / (nh - 1)
+            m2 = qx[0]
+            mat = jnp.stack([m0, m1, m2, m3, m4, m5, m6, m7, m8])
+
+            ww = jnp.arange(tw, dtype=jnp.float32)[None, :]
+            hh = jnp.arange(th, dtype=jnp.float32)[:, None]
+            u = m0 * ww + m1 * hh + m2
+            v = m3 * ww + m4 * hh + m5
+            w_ = m6 * ww + m7 * hh + m8
+            in_w = u / w_
+            in_h = v / w_
+            inb = ((in_w > -0.5) & (in_w < W - 0.5)
+                   & (in_h > -0.5) & (in_h < H - 0.5))
+
+            x0 = jnp.floor(in_w)
+            y0 = jnp.floor(in_h)
+            wx = in_w - x0
+            wy = in_h - y0
+
+            def at(yy, xx):
+                ok = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+                yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+                xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+                return feat[0][:, yc, xc] * ok[None]
+
+            val = (at(y0, x0) * (1 - wy) * (1 - wx)
+                   + at(y0, x0 + 1) * (1 - wy) * wx
+                   + at(y0 + 1, x0) * wy * (1 - wx)
+                   + at(y0 + 1, x0 + 1) * wy * wx)
+            out = val * inb[None]
+            return out, inb.astype(jnp.int32)[None], mat
+
+        outs, masks, mats = jax.vmap(one)(quads)
+        return outs, masks, mats
+
+    o, m, t = apply(fn, xv, rv)
+    m.stop_gradient = True
+    t.stop_gradient = True
+    return o, m, t

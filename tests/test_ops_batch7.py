@@ -862,3 +862,27 @@ def test_retinanet_detection_output():
     np.testing.assert_allclose(o[0, 2:], [0, 0, 9, 9], atol=1e-4)
     assert o[1, 1] == pytest.approx(0.8) and o[1, 0] == 1
     np.testing.assert_allclose(o[1, 2:], [20, 20, 39, 39], atol=1e-4)
+
+
+def test_roi_perspective_transform():
+    # axis-aligned quad == plain crop+resize of that rectangle
+    H = W = 8
+    x = np.arange(H * W, dtype=np.float32).reshape(1, 1, H, W)
+    # quad corners (x0,y0)=(1,1) top-left, (6,1), (6,5), (1,5) — reference
+    # order: 0-1 top edge, 1-2 right edge
+    quad = np.array([[1, 1, 6, 1, 6, 5, 1, 5]], np.float32)
+    out, mask, mat = V.roi_perspective_transform(
+        paddle.to_tensor(x), paddle.to_tensor(quad), 5, 6)
+    o = _np(out)
+    assert o.shape == (1, 1, 5, 6)
+    # corner (0, 0) of the output maps exactly to the quad's first corner
+    np.testing.assert_allclose(o[0, 0, 0, 0], x[0, 0, 1, 1], rtol=1e-4)
+    # output is monotone along rows (sampling a monotone ramp)
+    assert (np.diff(o[0, 0, 0, :]) >= -1e-3).all()
+    np.testing.assert_allclose(_np(mask)[0, 0], 1)
+    # grad flows to the feature map
+    xt = paddle.to_tensor(x)
+    xt.stop_gradient = False
+    o2, _, _ = V.roi_perspective_transform(xt, paddle.to_tensor(quad), 5, 6)
+    o2.sum().backward()
+    assert np.abs(_np(xt.grad)).sum() > 0
