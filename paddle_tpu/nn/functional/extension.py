@@ -656,3 +656,37 @@ def match_matrix_tensor(x, y, w, x_length=None, y_length=None, dim_t=1,
         return out
 
     return apply(fn, *args)
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """similarity_focus_op.cc parity: for each selected slice along `axis`,
+    greedily pick min(rows, cols) maxima with distinct rows/columns (same
+    greedy-global-max scan as bipartite matching), OR the masks over indexes,
+    broadcast back over `axis`, and gate the input. x [B, d1, d2, d3]."""
+    def fn(v):
+        B = v.shape[0]
+        vm = jnp.moveaxis(v, axis, 1)                     # [B, A, R, C]
+        A, Rr, Cc = vm.shape[1], vm.shape[2], vm.shape[3]
+
+        def greedy_mask(T):
+            def step(carry, _):
+                live, m = carry
+                masked = jnp.where(live, T, -jnp.inf)
+                flat = jnp.argmax(masked)
+                i, j = flat // Cc, flat % Cc
+                m = m.at[i, j].set(1.0)
+                live = live & (jnp.arange(Rr)[:, None] != i) \
+                    & (jnp.arange(Cc)[None, :] != j)
+                return (live, m), None
+
+            init = (jnp.ones((Rr, Cc), bool), jnp.zeros((Rr, Cc), T.dtype))
+            (_, m), _ = jax.lax.scan(step, init, None, length=min(Rr, Cc))
+            return m
+
+        mask = jnp.zeros((B, Rr, Cc), v.dtype)
+        for a in indexes:
+            mask = jnp.maximum(mask, jax.vmap(greedy_mask)(vm[:, a]))
+        out = vm * mask[:, None, :, :]
+        return jnp.moveaxis(out, 1, axis)
+
+    return apply(fn, _t(input))

@@ -886,3 +886,14 @@ def test_roi_perspective_transform():
     o2, _, _ = V.roi_perspective_transform(xt, paddle.to_tensor(quad), 5, 6)
     o2.sum().backward()
     assert np.abs(_np(xt.grad)).sum() > 0
+
+
+def test_similarity_focus():
+    x = np.zeros((1, 2, 3, 3), np.float32)
+    x[0, 0] = [[9, 1, 1], [1, 5, 1], [1, 1, 7]]   # maxima on the diagonal
+    x[0, 1] = rng.rand(3, 3)
+    got = _np(F.similarity_focus(paddle.to_tensor(x), axis=1, indexes=[0]))
+    # mask = identity (picks (0,0)=9 then (2,2)=7 then (1,1)=5)
+    exp_mask = np.eye(3, dtype=np.float32)
+    np.testing.assert_allclose(got[0, 0], x[0, 0] * exp_mask, rtol=1e-6)
+    np.testing.assert_allclose(got[0, 1], x[0, 1] * exp_mask, rtol=1e-6)
