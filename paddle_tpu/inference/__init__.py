@@ -9,3 +9,54 @@ save_inference_model); the Predictor re-jits the restored callable once and serv
 zero-copy numpy in/out.
 """
 from .predictor import Config, Predictor, create_predictor  # noqa: F401
+
+
+class PrecisionType:
+    """paddle.inference.PrecisionType parity (analysis_config precision)."""
+
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+class PlaceType:
+    kUNK = -1
+    kCPU = 0
+    kGPU = 1
+    kXPU = 2
+    kTPU = 3
+
+
+class DataType:
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+def get_version():
+    return "paddle_tpu-2.0 (TPU-native; StableHLO/jax.export runtime)"
+
+
+def convert_to_mixed_precision(src_model, src_params, dst_model, dst_params,
+                               mixed_precision=PrecisionType.Bfloat16,
+                               backend=None, keep_io_types=True,
+                               black_list=None):
+    """Compat: precision policy is applied at run time via amp.auto_cast
+    (bf16-first); the saved artifact is precision-agnostic StableHLO, so the
+    conversion is a copy + recorded precision hint."""
+    import json
+    import shutil
+
+    shutil.copy(src_model, dst_model)
+    if src_params and dst_params:
+        shutil.copy(src_params, dst_params)
+    hint = {"mixed_precision": int(mixed_precision),
+            "keep_io_types": bool(keep_io_types),
+            "black_list": sorted(black_list or [])}
+    with open(str(dst_model) + ".precision.json", "w") as f:
+        json.dump(hint, f)
