@@ -690,3 +690,38 @@ def similarity_focus(input, axis, indexes, name=None):
         return jnp.moveaxis(out, 1, axis)
 
     return apply(fn, _t(input))
+
+
+def var_conv_2d(x, row_length, col_length, weight, input_channel,
+                output_channel, filter_size, stride=1, name=None):
+    """var_conv_2d_op parity (text-matching variable-size conv): each sample's
+    image has its own valid (rows, cols) region; positions outside are zero
+    before AND after the conv (the reference computes per-sample on exact
+    sizes — padded+mask is numerically identical for interior positions).
+    x [B, C_in, H, W]; weight [C_out, C_in*kh*kw]."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    kh, kw = _pair(filter_size)
+    sh, sw = _pair(stride)
+
+    def fn(v, rl, cl, w):
+        B, Cin, H, W = v.shape
+        rl = rl.astype(jnp.int32)
+        cl = cl.astype(jnp.int32)
+        rmask = (jnp.arange(H)[None, :] < rl[:, None]).astype(v.dtype)
+        cmask = (jnp.arange(W)[None, :] < cl[:, None]).astype(v.dtype)
+        vm = v * rmask[:, None, :, None] * cmask[:, None, None, :]
+        wk = w.reshape(output_channel, Cin, kh, kw)
+        out = jax.lax.conv_general_dilated(
+            vm, wk, (sh, sw), [(kh // 2, kh // 2), (kw // 2, kw // 2)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        Ho, Wo = out.shape[2], out.shape[3]
+        ro = jnp.maximum((rl + sh - 1) // sh, 1)
+        co = jnp.maximum((cl + sw - 1) // sw, 1)
+        rm = (jnp.arange(Ho)[None, :] < ro[:, None]).astype(v.dtype)
+        cm = (jnp.arange(Wo)[None, :] < co[:, None]).astype(v.dtype)
+        return out * rm[:, None, :, None] * cm[:, None, None, :]
+
+    return apply(fn, _t(x), _t(row_length).detach(), _t(col_length).detach(),
+                 _t(weight))

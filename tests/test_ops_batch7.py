@@ -897,3 +897,22 @@ def test_similarity_focus():
     exp_mask = np.eye(3, dtype=np.float32)
     np.testing.assert_allclose(got[0, 0], x[0, 0] * exp_mask, rtol=1e-6)
     np.testing.assert_allclose(got[0, 1], x[0, 1] * exp_mask, rtol=1e-6)
+
+
+def test_var_conv_2d():
+    B, C, H, W, CO = 2, 2, 6, 6, 3
+    x = _randn(B, C, H, W)
+    w = _randn(CO, C * 3 * 3)
+    rl = np.array([6, 3])
+    cl = np.array([6, 4])
+    got = _np(F.var_conv_2d(paddle.to_tensor(x), rl, cl, paddle.to_tensor(w),
+                            C, CO, 3))
+    import jax, jax.numpy as jnp
+    # sample 0 (full size) matches a plain conv
+    full = np.asarray(jax.lax.conv_general_dilated(
+        jnp.asarray(x[:1]), jnp.asarray(w.reshape(CO, C, 3, 3)), (1, 1),
+        [(1, 1), (1, 1)], dimension_numbers=("NCHW", "OIHW", "NCHW")))
+    np.testing.assert_allclose(got[0], full[0], rtol=1e-4, atol=1e-5)
+    # sample 1: outputs beyond its valid region are zero
+    np.testing.assert_allclose(got[1, :, 3:, :], 0.0)
+    np.testing.assert_allclose(got[1, :, :, 4:], 0.0)
