@@ -712,18 +712,26 @@ def save(program, model_path, protocol=4):
                     protocol=protocol)
 
 
+def _write_program_params(program, arrs):
+    """Write named arrays into the Program's parameter scope (state_dict()
+    hands out copies, so mutating those would be a silent no-op)."""
+    import jax.numpy as jnp
+
+    program._ensure_scope()
+    store = program._scope["params"]
+    for k, v in arrs.items():
+        if k in store:
+            store[k] = jnp.asarray(v)
+    program._sync_params_to_tensors()
+
+
 def load(program, model_path, executor=None, var_list=None):
     import pickle
-
-    import numpy as np
-    import jax.numpy as jnp
 
     path = model_path if model_path.endswith(".pdparams") else model_path + ".pdparams"
     with open(path, "rb") as f:
         arrs = pickle.load(f)
-    for k, t in (program.state_dict() or {}).items():
-        if k in arrs:
-            t._data = jnp.asarray(arrs[k])
+    _write_program_params(program, arrs)
 
 
 def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
@@ -747,11 +755,7 @@ def load_program_state(model_path, var_list=None):
 
 
 def set_program_state(program, state):
-    import jax.numpy as jnp
-
-    for k, t in (program.state_dict() or {}).items():
-        if k in state:
-            t._data = jnp.asarray(state[k])
+    _write_program_params(program, state)
 
 
 def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,

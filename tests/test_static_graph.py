@@ -300,3 +300,66 @@ def test_program_translator_enable_toggle():
         np.testing.assert_allclose(np.asarray(a._data), 2.0)
     finally:
         pt.enable(True)
+
+
+def test_static_save_load_program_state(tmp_path):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4])
+            lin = paddle.nn.Linear(4, 3)
+            y = lin(x)
+        exe = static.Executor()
+        exe.run(startup)
+        path = str(tmp_path / "model")
+        static.save(main, path)
+        state = static.load_program_state(path)
+        assert any(v.size for v in state.values())
+        # perturb then restore (write through the scope, not copies)
+        static.set_program_state(main, {k: v * 0.0 for k, v in state.items()})
+        for v in main.state_dict().values():
+            np.testing.assert_allclose(np.asarray(v._data), 0.0)
+        static.load(main, path)
+        restored = {k: np.asarray(v._data) for k, v in main.state_dict().items()}
+        for k, v in state.items():
+            np.testing.assert_allclose(restored[k], v)
+        # set_program_state roundtrip
+        static.set_program_state(main, {k: v * 2 for k, v in state.items()})
+        for k, v in state.items():
+            np.testing.assert_allclose(
+                np.asarray(main.state_dict()[k]._data), v * 2)
+    finally:
+        paddle.disable_static()
+
+
+def test_compiled_program_and_parallel_executor():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 2])
+            y = x * 2.0
+        exe = static.Executor()
+        exe.run(startup)
+        cp = static.CompiledProgram(main).with_data_parallel(loss_name=None)
+        out = exe.run(cp._program, feed={"x": np.ones((3, 2), np.float32)},
+                      fetch_list=[y])
+        np.testing.assert_allclose(out[0], 2.0)
+        pe = static.ParallelExecutor(main_program=main)
+        out2 = pe.run(feed={"x": np.ones((3, 2), np.float32)}, fetch_list=[y])
+        np.testing.assert_allclose(out2[0], 2.0)
+    finally:
+        paddle.disable_static()
