@@ -1634,3 +1634,72 @@ def roi_perspective_transform(x, rois, transformed_height, transformed_width,
     m.stop_gradient = True
     t.stop_gradient = True
     return o, m, t
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4, name=None):
+    """detection/rpn_target_assign_op.cc:875 RetinanetTargetAssign parity:
+    the RPN two-direction assignment with NO subsampling (every anchor is
+    labeled), fg targets carry the matched gt's CLASS label (not 1), bg = 0.
+    Returns (loc_index, score_index, tgt_bbox, tgt_lbl, bbox_inside_weight,
+    fg_num) for one image; fg_num = #fg + 1 (the reference's focal-loss
+    normalizer convention)."""
+    anchors = np.asarray(_t(anchor_box)._data).reshape(-1, 4)
+    gts = np.asarray(_t(gt_boxes)._data).reshape(-1, 4)
+    labels_np = np.asarray(_t(gt_labels)._data).reshape(-1).astype(np.int64)
+    crowd = (np.asarray(_t(is_crowd)._data).reshape(-1).astype(np.int64)
+             if is_crowd is not None else np.zeros(len(gts), np.int64))
+    keep_gt = crowd == 0
+    gts = gts[keep_gt]
+    labels_np = labels_np[keep_gt]
+    A, G = len(anchors), len(gts)
+
+    ov = np.zeros((A, max(G, 1)), np.float32)
+    for j in range(G):
+        ix1 = np.maximum(anchors[:, 0], gts[j, 0])
+        iy1 = np.maximum(anchors[:, 1], gts[j, 1])
+        ix2 = np.minimum(anchors[:, 2], gts[j, 2])
+        iy2 = np.minimum(anchors[:, 3], gts[j, 3])
+        iw = np.maximum(ix2 - ix1 + 1, 0)
+        ih = np.maximum(iy2 - iy1 + 1, 0)
+        inter = iw * ih
+        aa = (anchors[:, 2] - anchors[:, 0] + 1) * (anchors[:, 3] - anchors[:, 1] + 1)
+        ga = (gts[j, 2] - gts[j, 0] + 1) * (gts[j, 3] - gts[j, 1] + 1)
+        ov[:, j] = inter / np.maximum(aa + ga - inter, 1e-10)
+    a2g_max = ov.max(axis=1) if G else np.zeros(A, np.float32)
+    a2g_arg = ov.argmax(axis=1) if G else np.zeros(A, np.int64)
+    g2a_max = ov.max(axis=0) if G else np.zeros(0, np.float32)
+
+    eps = 1e-5
+    with_max = (np.abs(ov - g2a_max[None, :]) < eps).any(axis=1) if G else np.zeros(A, bool)
+    fg_mask = with_max | (a2g_max >= positive_overlap)
+    bg_mask = (~fg_mask) & (a2g_max < negative_overlap)
+    fg_inds = np.nonzero(fg_mask)[0]
+    bg_inds = np.nonzero(bg_mask)[0]
+
+    def deltas(aidx):
+        a = anchors[aidx]
+        g = gts[a2g_arg[aidx]] if G else a
+        aw, ah = a[2] - a[0] + 1, a[3] - a[1] + 1
+        acx, acy = a[0] + aw / 2, a[1] + ah / 2
+        gw, gh = g[2] - g[0] + 1, g[3] - g[1] + 1
+        gcx, gcy = g[0] + gw / 2, g[1] + gh / 2
+        return [(gcx - acx) / aw, (gcy - acy) / ah,
+                np.log(gw / aw), np.log(gh / ah)]
+
+    tgt_bbox = np.asarray([deltas(i) for i in fg_inds], np.float32).reshape(-1, 4)
+    tgt_lbl = np.concatenate([
+        labels_np[a2g_arg[fg_inds]] if G else np.zeros(len(fg_inds), np.int64),
+        np.zeros(len(bg_inds), np.int64)]).astype(np.int32)
+    score_index = np.concatenate([fg_inds, bg_inds]).astype(np.int32)
+    outs = [Tensor(jnp.asarray(fg_inds.astype(np.int32))),
+            Tensor(jnp.asarray(score_index)),
+            Tensor(jnp.asarray(tgt_bbox)),
+            Tensor(jnp.asarray(tgt_lbl.reshape(-1, 1))),
+            Tensor(jnp.asarray(np.ones((len(fg_inds), 4), np.float32))),
+            Tensor(jnp.asarray(np.asarray([len(fg_inds) + 1], np.int32)))]
+    for t in outs:
+        t.stop_gradient = True
+    return tuple(outs)

@@ -916,3 +916,23 @@ def test_var_conv_2d():
     # sample 1: outputs beyond its valid region are zero
     np.testing.assert_allclose(got[1, :, 3:, :], 0.0)
     np.testing.assert_allclose(got[1, :, :, 4:], 0.0)
+
+
+def test_retinanet_target_assign():
+    anchors = np.array([[0, 0, 9, 9], [10, 10, 19, 19], [30, 30, 49, 49],
+                        [100, 100, 109, 109]], np.float32)
+    gts = np.array([[0, 0, 9, 9], [31, 31, 48, 48]], np.float32)
+    labs = np.array([3, 7], np.int64)
+    loc, score, tbox, tlbl, biw, fg_num = V.retinanet_target_assign(
+        None, None, anchors, None, gts, labs, np.array([0, 0], np.int64),
+        None, positive_overlap=0.5, negative_overlap=0.4)
+    loc = _np(loc)
+    lbl = _np(tlbl).ravel()
+    # anchors 0 and 2 are fg (hold per-gt maxima); labels carry gt classes
+    assert set(loc.tolist()) == {0, 2}
+    assert set(lbl[:2].tolist()) == {3, 7}
+    # all remaining anchors are bg with label 0 (no subsampling)
+    assert (lbl[2:] == 0).all() and len(lbl) == 4
+    assert int(_np(fg_num)[0]) == 3  # fg + 1
+    row0 = _np(tbox)[list(loc).index(0)]
+    np.testing.assert_allclose(row0, 0.0, atol=1e-5)
