@@ -725,3 +725,82 @@ def var_conv_2d(x, row_length, col_length, weight, input_channel,
 
     return apply(fn, _t(x), _t(row_length).detach(), _t(col_length).detach(),
                  _t(weight))
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1, max_depth=2,
+              act=None, filter=None, name=None):
+    """tree_conv_op (TBCNN, math/tree2col.cc parity): per node, gather its
+    subtree within max_depth; each patch member contributes its feature
+    weighted by the continuous binary-tree coefficients (eta_l, eta_r, eta_t)
+    (:35-52), then one matmul against filter [F, 3, output_size, num_filters].
+    Eager tree walk (data-dependent structure), XLA matmul + autodiff for the
+    compute. nodes_vector [N, F] (node ids are 1-based in edge_set);
+    edge_set [E, 2] int, (0, 0)-terminated. Returns [P, output_size, M]."""
+    feats = _t(nodes_vector)
+    edges = np.asarray(_t(edge_set)._data).astype(np.int64).reshape(-1, 2)
+    w = _t(filter)
+    F_ = feats.shape[-1]
+
+    tr = {}
+    node_count = 0
+    for u, v in edges:
+        if u == 0 or v == 0:
+            break
+        tr.setdefault(int(u), []).append(int(v))
+        node_count += 1
+    node_count += 1
+
+    # weights[p] : list of (node_id, eta_l, eta_r, eta_t)
+    d = float(max_depth)
+    patches = []
+    for root in range(1, node_count + 1):
+        visited = {root}
+        # (node, index, pclen, depth)
+        stack = [(root, 1, 1, 0)]
+        patch = [(root, 1, 1, 0)]
+        while stack:
+            node, _, _, depth = stack[-1]
+            children = tr.get(node, [])
+            advanced = False
+            for i, v in enumerate(children):
+                if v not in visited and depth + 1 < max_depth:
+                    visited.add(v)
+                    stack.append((v, i, len(children), depth + 1))
+                    patch.append((v, i + 1, len(children), depth + 1))
+                    advanced = True
+            if not advanced:
+                stack.pop()
+        rows = []
+        for node, index, pclen, depth in patch:
+            eta_t = (d - depth) / d
+            tmp = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)
+            eta_l = (1.0 - eta_t) * tmp
+            eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+            rows.append((node - 1, eta_l, eta_r, eta_t))
+        patches.append(rows)
+
+    P = len(patches)
+    # sparse gather plan -> dense [P, N, 3] coefficient tensor (small trees)
+    N = feats.shape[0]
+    coef = np.zeros((P, N, 3), np.float32)
+    for p, rows in enumerate(patches):
+        for nid, el, er, et in rows:
+            coef[p, nid, 0] += el
+            coef[p, nid, 1] += er
+            coef[p, nid, 2] += et
+    coef_j = jnp.asarray(coef)
+
+    def fn(fv, wv):
+        # patch [P, F, 3] = coef^T gathered features; flatten matches the
+        # filter's [F, 3, O, M] row-major layout
+        patch = jnp.einsum("pnk,nf->pfk", coef_j, fv)
+        O, M = wv.shape[2], wv.shape[3]
+        out = patch.reshape(P, 3 * F_) @ wv.reshape(3 * F_, O * M)
+        return out.reshape(P, O, M)
+
+    out = apply(fn, feats, w)
+    if act == "tanh":
+        from ...tensor.math import tanh as _tanh
+
+        out = _tanh(out)
+    return out

@@ -936,3 +936,38 @@ def test_retinanet_target_assign():
     assert int(_np(fg_num)[0]) == 3  # fg + 1
     row0 = _np(tbox)[list(loc).index(0)]
     np.testing.assert_allclose(row0, 0.0, atol=1e-5)
+
+
+def test_tree_conv():
+    # tree: 1 -> (2, 3); features one-hot per node
+    edges = np.array([[1, 2], [1, 3], [0, 0]], np.int32)
+    feats = np.eye(3, dtype=np.float32)          # node i-1 -> e_i
+    F_, O, M = 3, 2, 1
+    w = rng.randn(F_, 3, O, M).astype(np.float32)
+    got = _np(F.tree_conv(paddle.to_tensor(feats), edges, O, M, max_depth=2,
+                          filter=paddle.to_tensor(w)))
+    assert got.shape == (3, O, M)
+    # manual: patch for root 1 = {1 (d0), 2 (idx1, len2, d1), 3 (idx2, len2, d1)}
+    d = 2.0
+    def etas(index, pclen, depth):
+        et = (d - depth) / d
+        tmp = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)
+        el = (1 - et) * tmp
+        er = (1 - et) * (1 - el)
+        return el, er, et
+    patch = np.zeros((F_, 3), np.float32)
+    for node, (i_, p_, dep) in [(1, (1, 1, 0)), (2, (1, 2, 1)), (3, (2, 2, 1))]:
+        el, er, et = etas(i_, p_, dep)
+        patch[node - 1] += np.array([el, er, et]) * 1.0  # one-hot features
+    exp0 = patch.reshape(-1) @ w.reshape(3 * F_, O * M)
+    np.testing.assert_allclose(got[0].reshape(-1), exp0, rtol=1e-4)
+    # leaves' patches contain only themselves (depth cap): eta_t = 1
+    exp1 = (np.eye(3)[1][:, None] * np.array([0.0, 0.0, 1.0])[None, :]
+            * np.array([0.5, (1 - 0.0), 1.0])[None, :] * 0 + 0)
+    # simpler: node 2's patch = {(2, idx1, len1, d0)} -> etas (0.5*0, ..., 1)
+    el, er, et = etas(1, 1, 0)
+    p2 = np.zeros((F_, 3), np.float32)
+    p2[1] = [el, er, et]
+    np.testing.assert_allclose(got[1].reshape(-1),
+                               p2.reshape(-1) @ w.reshape(3 * F_, O * M),
+                               rtol=1e-4)
