@@ -804,3 +804,44 @@ def tree_conv(nodes_vector, edge_set, output_size, num_filters=1, max_depth=2,
 
         out = _tanh(out)
     return out
+
+
+def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1, name=None):
+    """correlation_op.cu parity (FlowNet cost volume): for each displacement
+    (ti, tj) in a (2*max_displacement/stride2+1)^2 grid, the mean over a
+    kernel window and channels of x[h1, w1] * y[h1+tj*s2, w1+ti*s2] on the
+    zero-padded inputs. TPU design: one jnp.roll + windowed mean per
+    displacement — each is an XLA reduce the compiler fuses; no per-pixel
+    loops. Returns [N, D*D, Ho, Wo]."""
+    def fn(a, b):
+        N, C, H, W = a.shape
+        kr = (kernel_size - 1) // 2
+        drad = max_displacement // stride2
+        D = 2 * drad + 1
+        ap = jnp.pad(a, ((0, 0), (0, 0), (pad_size, pad_size),
+                         (pad_size, pad_size)))
+        bp = jnp.pad(b, ((0, 0), (0, 0), (pad_size, pad_size),
+                         (pad_size, pad_size)))
+        Hp, Wp = H + 2 * pad_size, W + 2 * pad_size
+        Ho = int(np.ceil((Hp - 2 * max_displacement) / float(stride1)))
+        Wo = int(np.ceil((Wp - 2 * max_displacement) / float(stride1)))
+        nelems = kernel_size * kernel_size * C
+        outs = []
+        for tj in range(-drad, drad + 1):
+            for ti in range(-drad, drad + 1):
+                shifted = jnp.roll(bp, (-tj * stride2, -ti * stride2),
+                                   axis=(2, 3))
+                prod = ap * shifted                    # [N, C, Hp, Wp]
+                # window-sum over the kernel, then slice the output grid
+                acc = jnp.zeros_like(prod)
+                for j in range(-kr, kr + 1):
+                    for i in range(-kr, kr + 1):
+                        acc = acc + jnp.roll(prod, (-j, -i), axis=(2, 3))
+                summed = jnp.sum(acc, axis=1)          # [N, Hp, Wp]
+                h_idx = max_displacement + stride1 * jnp.arange(Ho)
+                w_idx = max_displacement + stride1 * jnp.arange(Wo)
+                outs.append(summed[:, h_idx[:, None], w_idx[None, :]] / nelems)
+        return jnp.stack(outs, axis=1)
+
+    return apply(fn, _t(x), _t(y))

@@ -971,3 +971,23 @@ def test_tree_conv():
     np.testing.assert_allclose(got[1].reshape(-1),
                                p2.reshape(-1) @ w.reshape(3 * F_, O * M),
                                rtol=1e-4)
+
+
+def test_correlation():
+    N, C, H, W = 1, 2, 6, 6
+    x = _randn(N, C, H, W)
+    y = _randn(N, C, H, W)
+    got = _np(F.correlation(paddle.to_tensor(x), paddle.to_tensor(y),
+                            pad_size=2, kernel_size=1, max_displacement=2,
+                            stride1=1, stride2=2))
+    drad, D = 1, 3
+    assert got.shape[1] == D * D
+    # loop-port of the CUDA kernel for a couple of positions
+    xp = np.pad(x, ((0, 0), (0, 0), (2, 2), (2, 2)))
+    yp = np.pad(y, ((0, 0), (0, 0), (2, 2), (2, 2)))
+    for (tj, ti, oy, ox) in [(0, 0, 1, 1), (1, -1, 2, 3)]:
+        h1, w1 = 2 + oy, 2 + ox
+        h2, w2 = h1 + tj * 2, w1 + ti * 2
+        exp = (xp[0, :, h1, w1] * yp[0, :, h2, w2]).sum() / C
+        tc = (tj + drad) * D + (ti + drad)
+        np.testing.assert_allclose(got[0, tc, oy, ox], exp, rtol=1e-4)
