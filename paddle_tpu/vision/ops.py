@@ -1703,3 +1703,95 @@ def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
     for t in outs:
         t.stop_gradient = True
     return tuple(outs)
+
+
+def deformable_psroi_pooling(input, rois, trans, no_trans=False,
+                             spatial_scale=1.0, group_size=(1, 1),
+                             pooled_height=1, pooled_width=1,
+                             part_size=None, sample_per_part=1,
+                             trans_std=0.1, position_sensitive=True,
+                             boxes_num=None, name=None):
+    """deformable_psroi_pooling_op.cu parity (deformable R-FCN head): each
+    bin samples sample_per_part^2 bilinear points, shifted by the learned
+    normalized offsets trans[r, 2, part_h, part_w]*trans_std*roi_size; the
+    channel is picked position-sensitively via group_size. All RoIs read
+    image 0 (single-image eager form). Returns [R, output_dim, ph, pw]."""
+    ph_n, pw_n = int(pooled_height), int(pooled_width)
+    gh_n, gw_n = (int(group_size[0]), int(group_size[1]))
+    if part_size is None:
+        part_size = (ph_n, pw_n)
+    pth, ptw = int(part_size[0]), int(part_size[1])
+
+    xv = _t(input)
+    rv = _t(rois).detach()
+    args = [xv, rv]
+    if trans is not None and not no_trans:
+        args.append(_t(trans))
+
+    def fn(feat, rois_v, *tr):
+        N, C, H, W = feat.shape
+        out_dim = C // (gh_n * gw_n) if position_sensitive else C
+        trans_v = tr[0] if tr else None
+
+        def one(ri):
+            roi = rois_v[ri]
+            x1 = jnp.round(roi[0]) * spatial_scale - 0.5
+            y1 = jnp.round(roi[1]) * spatial_scale - 0.5
+            x2 = (jnp.round(roi[2]) + 1.0) * spatial_scale - 0.5
+            y2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            bh, bw = rh / ph_n, rw / pw_n
+            sh, sw = bh / sample_per_part, bw / sample_per_part
+
+            def bin_val(phw):
+                ph, pw = phw // pw_n, phw % pw_n
+                part_h = (ph * pth) // ph_n
+                part_w = (pw * ptw) // pw_n
+                tx = (trans_v[ri, 0, part_h, part_w] * trans_std
+                      if trans_v is not None else 0.0)
+                ty = (trans_v[ri, 1, part_h, part_w] * trans_std
+                      if trans_v is not None else 0.0)
+                ws = pw * bw + x1 + tx * rw
+                hs = ph * bh + y1 + ty * rh
+                gw = jnp.clip((pw * gw_n) // pw_n, 0, gw_n - 1)
+                gh = jnp.clip((ph * gh_n) // ph_n, 0, gh_n - 1)
+                if position_sensitive:
+                    ch = (jnp.arange(out_dim) * gh_n + gh) * gw_n + gw
+                else:
+                    ch = jnp.arange(out_dim)
+                fm = feat[0][ch]                       # [out_dim, H, W]
+
+                ihs = jnp.arange(sample_per_part, dtype=jnp.float32)
+                iws = jnp.arange(sample_per_part, dtype=jnp.float32)
+                hh = hs + ihs[:, None] * sh            # [s, 1]
+                wwv = ws + iws[None, :] * sw           # [1, s]
+                hh = jnp.broadcast_to(hh, (sample_per_part, sample_per_part))
+                wwv = jnp.broadcast_to(wwv, (sample_per_part, sample_per_part))
+                inb = ((wwv >= -0.5) & (wwv <= W - 0.5)
+                       & (hh >= -0.5) & (hh <= H - 0.5))
+                wc = jnp.clip(wwv, 0.0, W - 1.0)
+                hc = jnp.clip(hh, 0.0, H - 1.0)
+                x0 = jnp.floor(wc)
+                y0 = jnp.floor(hc)
+                ax = wc - x0
+                ay = hc - y0
+
+                def at(yy, xx):
+                    yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+                    xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+                    return fm[:, yi, xi]               # [out_dim, s, s]
+
+                val = (at(y0, x0) * (1 - ay) * (1 - ax)
+                       + at(y0, x0 + 1) * (1 - ay) * ax
+                       + at(y0 + 1, x0) * ay * (1 - ax)
+                       + at(y0 + 1, x0 + 1) * ay * ax)
+                cnt = jnp.maximum(jnp.sum(inb), 1)
+                return jnp.sum(val * inb[None], axis=(1, 2)) / cnt
+
+            vals = jax.vmap(bin_val)(jnp.arange(ph_n * pw_n))
+            return vals.T.reshape(out_dim, ph_n, pw_n)
+
+        return jax.vmap(one)(jnp.arange(rois_v.shape[0]))
+
+    return apply(fn, *args)

@@ -991,3 +991,42 @@ def test_correlation():
         exp = (xp[0, :, h1, w1] * yp[0, :, h2, w2]).sum() / C
         tc = (tj + drad) * D + (ti + drad)
         np.testing.assert_allclose(got[0, tc, oy, ox], exp, rtol=1e-4)
+
+
+def test_deformable_psroi_pooling():
+    # zero offsets + group 1x1 degenerates to average pooling of the bin
+    C = 2
+    x = np.full((1, C, 8, 8), 5.0, np.float32)
+    rois = np.array([[0, 0, 7, 7]], np.float32)
+    tr = np.zeros((1, 2, 2, 2), np.float32)
+    got = _np(V.deformable_psroi_pooling(
+        paddle.to_tensor(x), paddle.to_tensor(rois), paddle.to_tensor(tr),
+        spatial_scale=1.0, group_size=(1, 1), pooled_height=2, pooled_width=2,
+        sample_per_part=2, position_sensitive=False))
+    assert got.shape == (1, C, 2, 2)
+    np.testing.assert_allclose(got, 5.0, rtol=1e-4)
+    # nonzero offset shifts sampling: ramp feature changes the bin mean
+    ramp = np.tile(np.arange(8, dtype=np.float32)[None, :], (8, 1))[None, None]
+    tr2 = np.zeros((1, 2, 2, 2), np.float32)
+    tr2[0, 0] = 1.0  # x-offset of trans_std * roi_w
+    base = _np(V.deformable_psroi_pooling(
+        paddle.to_tensor(ramp), paddle.to_tensor(rois), paddle.to_tensor(tr),
+        pooled_height=2, pooled_width=2, sample_per_part=2,
+        position_sensitive=False))
+    shifted = _np(V.deformable_psroi_pooling(
+        paddle.to_tensor(ramp), paddle.to_tensor(rois), paddle.to_tensor(tr2),
+        pooled_height=2, pooled_width=2, sample_per_part=2, trans_std=0.2,
+        position_sensitive=False))
+    assert (shifted[0, 0] > base[0, 0] - 1e-6).all()
+    assert shifted[0, 0, 0, 0] > base[0, 0, 0, 0] + 0.5
+    # grads flow to features and offsets
+    xt = paddle.to_tensor(ramp)
+    tt = paddle.to_tensor(tr2)
+    xt.stop_gradient = False
+    tt.stop_gradient = False
+    V.deformable_psroi_pooling(xt, paddle.to_tensor(rois), tt,
+                               pooled_height=2, pooled_width=2,
+                               sample_per_part=2, trans_std=0.2,
+                               position_sensitive=False).sum().backward()
+    assert np.abs(_np(xt.grad)).sum() > 0
+    assert np.abs(_np(tt.grad)).sum() > 0
