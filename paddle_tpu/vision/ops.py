@@ -1795,3 +1795,90 @@ def deformable_psroi_pooling(input, rois, trans, no_trans=False,
         return jax.vmap(one)(jnp.arange(rois_v.shape[0]))
 
     return apply(fn, *args)
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution, name=None):
+    """detection/generate_mask_labels_op.cc parity (Mask R-CNN mask targets):
+    each fg RoI (label > 0) is matched (IoU vs the polygons' bounding boxes,
+    in unscaled image coords) to a non-crowd gt; the gt's polygons are
+    rasterized within the RoI at resolution^2 (even-odd point-in-polygon on
+    the bin-center grid, the Polys2MaskWrtBox recipe) and one-hot-expanded to
+    [fg, num_classes*res^2] with -1 outside the class slot. Eager host op.
+
+    gt_segms: list (per gt) of lists of flat polygons [x0, y0, x1, y1, ...].
+    Returns (mask_rois [fg, 4], roi_has_mask_int32 [fg, 1], mask_int32)."""
+    info = np.asarray(_t(im_info)._data).reshape(-1)
+    im_scale = float(info[2])
+    cls = np.asarray(_t(gt_classes)._data).reshape(-1).astype(np.int64)
+    crowd = np.asarray(_t(is_crowd)._data).reshape(-1).astype(np.int64)
+    rois_np = np.asarray(_t(rois)._data).reshape(-1, 4)
+    labels = np.asarray(_t(labels_int32)._data).reshape(-1).astype(np.int64)
+
+    keep = [(i, gt_segms[i]) for i in range(len(cls))
+            if cls[i] > 0 and crowd[i] == 0]
+    gt_polys = [p for _, p in keep]
+    gt_ids = [i for i, _ in keep]
+    boxes = np.zeros((len(gt_polys), 4), np.float32)
+    for k, polys in enumerate(gt_polys):
+        pts = np.concatenate([np.asarray(p, np.float32).reshape(-1, 2)
+                              for p in polys])
+        boxes[k] = [pts[:, 0].min(), pts[:, 1].min(),
+                    pts[:, 0].max(), pts[:, 1].max()]
+
+    fg_inds = np.nonzero(labels > 0)[0]
+    res = int(resolution)
+    M = res * res
+    mask_t = -np.ones((max(len(fg_inds), 1), num_classes * M), np.int32)
+    out_rois = np.zeros((max(len(fg_inds), 1), 4), np.float32)
+
+    def in_polys(px, py, polys):
+        inside = np.zeros(px.shape, bool)
+        for poly in polys:
+            pts = np.asarray(poly, np.float32).reshape(-1, 2)
+            n = len(pts)
+            acc = np.zeros(px.shape, bool)
+            j = n - 1
+            for i in range(n):
+                xi, yi = pts[i]
+                xj, yj = pts[j]
+                crosses = ((yi > py) != (yj > py)) & (
+                    px < (xj - xi) * (py - yi) / (yj - yi + 1e-12) + xi)
+                acc ^= crosses
+                j = i
+            inside |= acc
+        return inside
+
+    for k, ridx in enumerate(fg_inds):
+        roi = rois_np[ridx] / im_scale
+        out_rois[k] = rois_np[ridx]
+        if len(boxes):
+            ix1 = np.maximum(roi[0], boxes[:, 0])
+            iy1 = np.maximum(roi[1], boxes[:, 1])
+            ix2 = np.minimum(roi[2], boxes[:, 2])
+            iy2 = np.minimum(roi[3], boxes[:, 3])
+            inter = np.maximum(ix2 - ix1, 0) * np.maximum(iy2 - iy1, 0)
+            ra = (roi[2] - roi[0]) * (roi[3] - roi[1])
+            ba = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+            best = int(np.argmax(inter / np.maximum(ra + ba - inter, 1e-10)))
+            polys = gt_polys[best]
+            c = int(cls[gt_ids[best]])
+        else:
+            polys, c = [], int(labels[ridx])
+        w = max(roi[2] - roi[0], 1e-3)
+        h = max(roi[3] - roi[1], 1e-3)
+        gx = roi[0] + (np.arange(res) + 0.5) * w / res
+        gy = roi[1] + (np.arange(res) + 0.5) * h / res
+        px, py = np.meshgrid(gx, gy)
+        m = in_polys(px, py, polys).astype(np.int32).reshape(-1)
+        c = min(max(c, 0), num_classes - 1)
+        mask_t[k, c * M:(c + 1) * M] = m
+
+    n_fg = len(fg_inds)
+    outs = (Tensor(jnp.asarray(out_rois[:max(n_fg, 1)])),
+            Tensor(jnp.asarray(fg_inds.astype(np.int32).reshape(-1, 1)
+                               if n_fg else np.zeros((1, 1), np.int32))),
+            Tensor(jnp.asarray(mask_t)))
+    for t in outs:
+        t.stop_gradient = True
+    return outs

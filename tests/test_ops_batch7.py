@@ -1030,3 +1030,23 @@ def test_deformable_psroi_pooling():
                                position_sensitive=False).sum().backward()
     assert np.abs(_np(xt.grad)).sum() > 0
     assert np.abs(_np(tt.grad)).sum() > 0
+
+
+def test_generate_mask_labels():
+    # one gt: a square polygon covering the left half of its box
+    gt_segms = [[[0, 0, 4, 0, 4, 8, 0, 8]]]
+    rois = np.array([[0, 0, 8, 8], [20, 20, 30, 30]], np.float32)
+    labels = np.array([2, 0], np.int64)  # roi 0 fg class 2, roi 1 bg
+    mask_rois, has_mask, mask = V.generate_mask_labels(
+        np.array([[8.0, 8.0, 1.0]], np.float32), np.array([2], np.int64),
+        np.array([0], np.int64), gt_segms, rois, labels,
+        num_classes=4, resolution=4)
+    m = _np(mask)
+    assert m.shape == (1, 4 * 16)
+    grid = m[0, 2 * 16:3 * 16].reshape(4, 4)
+    # left half of the roi is inside the polygon
+    np.testing.assert_allclose(grid[:, :2], 1)
+    np.testing.assert_allclose(grid[:, 2:], 0)
+    # other class slots stay -1
+    assert (m[0, :2 * 16] == -1).all() and (m[0, 3 * 16:] == -1).all()
+    np.testing.assert_allclose(_np(has_mask).ravel(), [0])
