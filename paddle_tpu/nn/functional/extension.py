@@ -845,3 +845,57 @@ def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
         return jnp.stack(outs, axis=1)
 
     return apply(fn, _t(x), _t(y))
+
+
+def bilateral_slice(x, guide, grid, has_offset=False, name=None):
+    """bilateral_slice_op.cu parity (HDRNet): per pixel, trilinearly slice an
+    affine-coefficient grid at (x/w, y/h, guide) and apply it to the input
+    channels (+ optional offset row). x [N, Ci, H, W]; guide [N, H, W];
+    grid [N, Ci'*Co, gd, gh, gw] with Ci' = Ci (+1 with offset).
+    TPU design: the 8 trilinear corners become gathered tensors combined with
+    one einsum over input channels — no per-pixel loops."""
+    def fn(xv, gv, grid_v):
+        N, Ci, H, W = xv.shape
+        coeff_stride = Ci + (1 if has_offset else 0)
+        Gc = grid_v.shape[1]
+        Co = Gc // coeff_stride
+        gd, gh, gw = grid_v.shape[2], grid_v.shape[3], grid_v.shape[4]
+
+        gx = (jnp.arange(W, dtype=jnp.float32) + 0.5) * gw / W   # [W]
+        gy = (jnp.arange(H, dtype=jnp.float32) + 0.5) * gh / H   # [H]
+        gz = gv * gd                                             # [N, H, W]
+        gxb = jnp.broadcast_to(gx[None, None, :], (N, H, W))
+        gyb = jnp.broadcast_to(gy[None, :, None], (N, H, W))
+
+        fx = jnp.floor(gxb - 0.5)
+        fy = jnp.floor(gyb - 0.5)
+        fz = jnp.floor(gz - 0.5)
+
+        grid5 = grid_v.reshape(N, Co, coeff_stride, gd, gh, gw)
+        coeff = jnp.zeros((N, Co, coeff_stride, H, W), xv.dtype)
+        for dx in range(2):
+            xx = fx + dx
+            x_ = jnp.clip(xx, 0, gw - 1).astype(jnp.int32)
+            wx = jnp.maximum(1.0 - jnp.abs(xx + 0.5 - gxb), 0.0)
+            for dy in range(2):
+                yy = fy + dy
+                y_ = jnp.clip(yy, 0, gh - 1).astype(jnp.int32)
+                wy = jnp.maximum(1.0 - jnp.abs(yy + 0.5 - gyb), 0.0)
+                for dz in range(2):
+                    zz = fz + dz
+                    z_ = jnp.clip(zz, 0, gd - 1).astype(jnp.int32)
+                    wz = jnp.maximum(1.0 - jnp.abs(zz + 0.5 - gz), 0.0)
+                    # gather [N, Co, Cs, H, W] at per-pixel (z, y, x)
+                    sample = grid5[jnp.arange(N)[:, None, None, None, None],
+                                   jnp.arange(Co)[None, :, None, None, None],
+                                   jnp.arange(coeff_stride)[None, None, :, None, None],
+                                   z_[:, None, None, :, :],
+                                   y_[:, None, None, :, :],
+                                   x_[:, None, None, :, :]]
+                    coeff = coeff + sample * (wx * wy * wz)[:, None, None, :, :]
+        out = jnp.einsum("ncihw,nihw->nchw", coeff[:, :, :Ci], xv)
+        if has_offset:
+            out = out + coeff[:, :, Ci]
+        return out
+
+    return apply(fn, _t(x), _t(guide), _t(grid))

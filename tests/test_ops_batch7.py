@@ -1050,3 +1050,24 @@ def test_generate_mask_labels():
     # other class slots stay -1
     assert (m[0, :2 * 16] == -1).all() and (m[0, 3 * 16:] == -1).all()
     np.testing.assert_allclose(_np(has_mask).ravel(), [0])
+
+
+def test_bilateral_slice():
+    # constant identity grid: out = a*x + b with a=2, b=0.5 everywhere
+    N, Ci, Co, H, W = 1, 1, 1, 4, 4
+    gd, gh, gw = 2, 2, 2
+    grid = np.zeros((N, (Ci + 1) * Co, gd, gh, gw), np.float32)
+    grid[:, 0] = 2.0   # multiplier on x
+    grid[:, 1] = 0.5   # offset row
+    x = _randn(N, Ci, H, W)
+    guide = np.full((N, H, W), 0.5, np.float32)
+    got = _np(F.bilateral_slice(paddle.to_tensor(x), paddle.to_tensor(guide),
+                                paddle.to_tensor(grid), has_offset=True))
+    np.testing.assert_allclose(got, 2.0 * x + 0.5, rtol=1e-4)
+    # grads flow to input, guide, grid
+    xt, gt_, grt = (paddle.to_tensor(v) for v in (x, guide, grid))
+    for t in (xt, gt_, grt):
+        t.stop_gradient = False
+    F.bilateral_slice(xt, gt_, grt, has_offset=True).sum().backward()
+    for t in (xt, grt):
+        assert np.abs(_np(t.grad)).sum() > 0
