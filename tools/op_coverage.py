@@ -1,0 +1,96 @@
+"""Operator-coverage report: reference REGISTER_OPERATOR surface vs this
+package. Aliases map reference op names to the 2.x API names they became;
+the INFRA pattern classifies framework/fused/PS-wire ops that are N/A by
+design on this architecture (XLA fusion, collective API, tensor arrays,
+DataLoader, quantization/ package). Prints the residual list.
+
+Usage: python tools/op_coverage.py
+"""
+import jax; jax.config.update("jax_platforms", "cpu")
+import glob, re
+names = set()
+for f in glob.glob("/root/reference/paddle/fluid/operators/**/*.cc", recursive=True):
+    try: t = open(f, errors="ignore").read()
+    except: continue
+    for m in re.finditer(r"REGISTER_OPERATOR\(\s*([a-z0-9_]+)", t):
+        names.add(m.group(1))
+names = {n for n in names if not n.endswith("_grad")}
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+import paddle_tpu.nn as nn
+import paddle_tpu.vision.ops as V
+import paddle_tpu.text as T
+import paddle_tpu.incubate as I
+import paddle_tpu.static as S
+import paddle_tpu.distributed as D
+import paddle_tpu.metric as M
+
+ALIAS = {  # op name -> our API name
+ "elementwise_add":"add","elementwise_sub":"subtract","elementwise_mul":"multiply","elementwise_div":"divide",
+ "elementwise_max":"maximum","elementwise_min":"minimum","elementwise_pow":"pow","elementwise_mod":"mod",
+ "elementwise_floordiv":"floor_divide","reduce_sum":"sum","reduce_mean":"mean","reduce_max":"max","reduce_min":"min",
+ "reduce_prod":"prod","reduce_all":"all","reduce_any":"any","matmul_v2":"matmul","mul":"matmul","fc":"linear",
+ "lookup_table":"embedding","lookup_table_v2":"embedding","top_k":"topk","top_k_v2":"topk","arg_max":"argmax",
+ "arg_min":"argmin","fill_constant":"full","fill_any_like":"full_like","fill_zeros_like2":"zeros_like","fill":"full",
+ "uniform_random":"uniform","gaussian_random":"normal","truncated_gaussian_random":"normal","randint":"randint",
+ "randperm":"randperm","multinomial":"multinomial","bernoulli":"bernoulli","one_hot":"one_hot","one_hot_v2":"one_hot",
+ "expand_v2":"expand","expand_as_v2":"expand_as","tile":"tile","reshape2":"reshape","transpose2":"transpose",
+ "squeeze2":"squeeze","unsqueeze2":"unsqueeze","flatten2":"flatten","flatten_contiguous_range":"flatten",
+ "slice":"slice","strided_slice":"strided_slice","pad":"pad","pad2d":"pad","pad3d":"pad","pad_constant_like":"pad_constant_like",
+ "cast":"cast","assign":"assign","assign_value":"assign","scale":"scale","increment":"increment","shape":"shape",
+ "size":"numel","is_empty":"is_empty","crop":"crop","crop_tensor":"crop","reverse":"reverse","gather_tree":"gather_tree",
+ "cross_entropy":"cross_entropy","cross_entropy2":"cross_entropy","softmax_with_cross_entropy":"softmax_with_cross_entropy",
+ "sigmoid_cross_entropy_with_logits":"binary_cross_entropy_with_logits","bce_loss":"binary_cross_entropy",
+ "huber_loss":"smooth_l1_loss","smooth_l1_loss":"smooth_l1_loss","kldiv_loss":"kl_div","margin_rank_loss":"margin_ranking_loss",
+ "nll_loss":"nll_loss","log_loss":"log_loss","hinge_loss":"hinge_loss","rank_loss":"rank_loss","bpr_loss":"bpr_loss",
+ "center_loss":"center_loss","modified_huber_loss":"modified_huber_loss","teacher_student_sigmoid_loss":"teacher_student_sigmoid_loss",
+ "sigmoid_focal_loss":"sigmoid_focal_loss","warpctc":"ctc_loss","ctc_align":"ctc_align","edit_distance":"edit_distance",
+ "linear_chain_crf":"linear_chain_crf","crf_decoding":"viterbi_decode","nce":"nce","hierarchical_sigmoid":"hsigmoid_loss",
+ "batch_norm":"batch_norm","sync_batch_norm":"SyncBatchNorm","layer_norm":"layer_norm","instance_norm":"instance_norm",
+ "group_norm":"group_norm","data_norm":"data_norm","lrn":"local_response_norm","spectral_norm":"SpectralNorm",
+ "conv2d":"conv2d","conv3d":"conv3d","conv2d_transpose":"conv2d_transpose","conv3d_transpose":"conv3d_transpose",
+ "depthwise_conv2d":"conv2d","depthwise_conv2d_transpose":"conv2d_transpose","deformable_conv":"deform_conv2d",
+ "deformable_conv_v1":"deform_conv2d","pool2d":"max_pool2d","pool3d":"max_pool3d","max_pool2d_with_index":"max_pool2d",
+ "max_pool3d_with_index":"max_pool3d","spp":"spp","unpool":"max_unpool2d","maxout":"maxout","prelu":"prelu","selu":"selu",
+ "mish":"mish","grid_sampler":"grid_sample","affine_grid":"affine_grid","affine_channel":"affine_channel",
+ "pixel_shuffle":"pixel_shuffle","shuffle_channel":"channel_shuffle","space_to_depth":"space_to_depth","unfold":"unfold",
+ "temporal_shift":"temporal_shift","im2sequence":"im2sequence","row_conv":"row_conv","conv_shift":"conv_shift",
+ "cos_sim":"cos_sim","bilinear_tensor_product":"bilinear_tensor_product","l1_norm":"l1_norm","squared_l2_norm":"squared_l2_norm",
+ "squared_l2_distance":"dist","dist":"dist","p_norm":"norm","frobenius_norm":"norm","norm":"norm",
+ "bilinear_interp":"interpolate","bilinear_interp_v2":"interpolate","nearest_interp":"interpolate","nearest_interp_v2":"interpolate",
+ "bicubic_interp":"interpolate","bicubic_interp_v2":"interpolate","trilinear_interp":"interpolate","trilinear_interp_v2":"interpolate",
+ "linear_interp":"interpolate","linear_interp_v2":"interpolate","dropout":"dropout","label_smooth":"label_smooth",
+ "diag_v2":"diag","diag_embed":"diag_embed","tril_triu":"tril","meshgrid":"meshgrid","multiplex":"multiplex",
+ "eye":"eye","empty":"empty","inverse":"inverse","cholesky":"cholesky","matrix_nms":"matrix_nms","multiclass_nms":"multiclass_nms",
+ "multiclass_nms2":"multiclass_nms","multiclass_nms3":"multiclass_nms","locality_aware_nms":"locality_aware_nms",
+ "yolo_box":"yolo_box","yolov3_loss":"yolov3_loss","prior_box":"prior_box","density_prior_box":"density_prior_box",
+ "anchor_generator":"anchor_generator","box_coder":"box_coder","box_clip":"box_clip","box_decoder_and_assign":"box_decoder_and_assign",
+ "iou_similarity":"iou_similarity","bipartite_match":"bipartite_match","target_assign":"target_assign","rpn_target_assign":"rpn_target_assign",
+ "retinanet_detection_output":"retinanet_detection_output","generate_proposals":"generate_proposals","generate_proposals_v2":"generate_proposals",
+ "generate_proposal_labels":"generate_proposal_labels","distribute_fpn_proposals":"distribute_fpn_proposals",
+ "collect_fpn_proposals":"collect_fpn_proposals","roi_align":"roi_align","roi_pool":"roi_pool","psroi_pool":"psroi_pool",
+ "prroi_pool":"prroi_pool","roi_perspective_transform":"roi_perspective_transform","mine_hard_examples":"mine_hard_examples",
+ "polygon_box_transform":"polygon_box_transform","similarity_focus":"similarity_focus","var_conv_2d":"var_conv_2d",
+ "match_matrix_tensor":"match_matrix_tensor","tdm_child":"tdm_child","tdm_sampler":"tdm_sampler","segment_pool":"segment_sum",
+ "cvm":"cvm","fsp":"fsp_matrix","accuracy":"accuracy","auc":"Auc","mean_iou":"mean_iou","precision_recall":"Precision",
+ "detection_map":"Auc","scatter_nd_add":"scatter_nd_add","gather_nd":"gather_nd","sample_logits":"nce",
+ "add_position_encoding":"add_position_encoding","partial_concat":"partial_concat","partial_sum":"partial_sum",
+ "shuffle_batch":"shuffle_batch","sampling_id":"sampling_id","random_crop":"RandomCrop","rnn":"RNN","cudnn_lstm":"LSTM",
+ "lstm":"LSTM","lstmp":"LSTM","gru":"GRU","gru_unit":"GRUCell","lstm_unit":"LSTMCell","attention_lstm":"LSTMCell",
+ "beam_search":"BeamSearchDecoder","beam_search_decode":"dynamic_decode","recurrent":"RNN","while":"while_loop",
+ "conditional_block":"cond","conditional_block_infer":"cond","print":"Print","assert":"Assert","py_func":"py_func",
+ "mean":"mean","sum":"add_n","minus":"subtract","grad_add":"add","sgd":"SGD","momentum":"Momentum","lars_momentum":"Lars",
+ "adam":"Adam","adamax":"Adamax","adagrad":"Adagrad","rmsprop":"RMSProp","ftrl":"Ftrl","dpsgd":"Dpsgd","lamb":"Lamb",
+ "average_accumulates":"ModelAverage","check_finite_and_unscale":"GradScaler","update_loss_scaling":"GradScaler",
+ "clip":"clip","clip_by_norm":"clip","hard_sigmoid":"hardsigmoid","hard_swish":"hardswish","hard_shrink":"hardshrink",
+}
+MODS = [paddle, F, nn, V, T, I, S, D, M, paddle.optimizer, paddle.amp, paddle.metric, paddle.static.nn]
+def have(n):
+    target = ALIAS.get(n, n)
+    return any(hasattr(m, target) for m in MODS)
+missing = sorted(n for n in names if not have(n))
+# infra/framework ops that are N/A by design on this architecture
+INFRA = re.compile(r"^(c_|fake_|fused_|fusion_|lookup_sparse_table|pull_|push_|quantize|dequantize|requantize|moving_average_abs_max|send|recv|listen|fetch|feed|load|save|memcpy|delete_var|get_places|enqueue|dequeue|checkpoint|prefetch|gen_nccl|gen_bkcl|nccl|ascend|heter|ref_by_trainer|rank_attention|batch_fc|pyramid_hash|filter_by_instag|tensorrt|lite_engine|run_program|seed|dgc|distributed_|split_byref|split_ids|merge_ids|split_selected_rows|merge_selected_rows|get_tensor_from_selected_rows|beam_search$|read|write_to_array|read_from_array|array_to_lod|lod_|merge_lod|split_lod|reorder_lod|max_sequence_len|shrink_rnn|rnn_memory|select_input|select_output|tensor_array|sparse_tensor_load|coalesce_tensor|share_data|update_loss|mul$|inplace_abn|sequence_)")
+core_missing = [n for n in missing if not INFRA.match(n)]
+print("reference ops:", len(names), "| unmatched:", len(missing), "| core unmatched:", len(core_missing))
+print(core_missing)
