@@ -662,7 +662,8 @@ def similarity_focus(input, axis, indexes, name=None):
     """similarity_focus_op.cc parity: for each selected slice along `axis`,
     greedily pick min(rows, cols) maxima with distinct rows/columns (same
     greedy-global-max scan as bipartite matching), OR the masks over indexes,
-    broadcast back over `axis`, and gate the input. x [B, d1, d2, d3]."""
+    and broadcast over `axis`. The OUTPUT IS THE 0/1 MASK (input-shaped),
+    like the reference — not the gated input. x [B, d1, d2, d3]."""
     def fn(v):
         B = v.shape[0]
         vm = jnp.moveaxis(v, axis, 1)                     # [B, A, R, C]
@@ -686,7 +687,7 @@ def similarity_focus(input, axis, indexes, name=None):
         mask = jnp.zeros((B, Rr, Cc), v.dtype)
         for a in indexes:
             mask = jnp.maximum(mask, jax.vmap(greedy_mask)(vm[:, a]))
-        out = vm * mask[:, None, :, :]
+        out = jnp.broadcast_to(mask[:, None, :, :], vm.shape)
         return jnp.moveaxis(out, 1, axis)
 
     return apply(fn, _t(input))
@@ -817,30 +818,34 @@ def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
     def fn(a, b):
         N, C, H, W = a.shape
         kr = (kernel_size - 1) // 2
+        border = max_displacement + kr          # border_radius (:33)
         drad = max_displacement // stride2
         D = 2 * drad + 1
-        ap = jnp.pad(a, ((0, 0), (0, 0), (pad_size, pad_size),
-                         (pad_size, pad_size)))
-        bp = jnp.pad(b, ((0, 0), (0, 0), (pad_size, pad_size),
-                         (pad_size, pad_size)))
+        # extra zero margin so displacement+kernel shifts slice, never wrap
+        m = max_displacement + kr
+        ap = jnp.pad(a, ((0, 0), (0, 0), (pad_size + m, pad_size + m),
+                         (pad_size + m, pad_size + m)))
+        bp = jnp.pad(b, ((0, 0), (0, 0), (pad_size + m, pad_size + m),
+                         (pad_size + m, pad_size + m)))
         Hp, Wp = H + 2 * pad_size, W + 2 * pad_size
-        Ho = int(np.ceil((Hp - 2 * max_displacement) / float(stride1)))
-        Wo = int(np.ceil((Wp - 2 * max_displacement) / float(stride1)))
+        Ho = int(np.ceil((Hp - 2 * border) / float(stride1)))
+        Wo = int(np.ceil((Wp - 2 * border) / float(stride1)))
         nelems = kernel_size * kernel_size * C
         outs = []
         for tj in range(-drad, drad + 1):
             for ti in range(-drad, drad + 1):
-                shifted = jnp.roll(bp, (-tj * stride2, -ti * stride2),
-                                   axis=(2, 3))
-                prod = ap * shifted                    # [N, C, Hp, Wp]
-                # window-sum over the kernel, then slice the output grid
-                acc = jnp.zeros_like(prod)
+                acc = None
                 for j in range(-kr, kr + 1):
                     for i in range(-kr, kr + 1):
-                        acc = acc + jnp.roll(prod, (-j, -i), axis=(2, 3))
+                        a_sl = ap[:, :, m + j: m + j + Hp, m + i: m + i + Wp]
+                        b_sl = bp[:, :,
+                                  m + j + tj * stride2: m + j + tj * stride2 + Hp,
+                                  m + i + ti * stride2: m + i + ti * stride2 + Wp]
+                        term = a_sl * b_sl
+                        acc = term if acc is None else acc + term
                 summed = jnp.sum(acc, axis=1)          # [N, Hp, Wp]
-                h_idx = max_displacement + stride1 * jnp.arange(Ho)
-                w_idx = max_displacement + stride1 * jnp.arange(Wo)
+                h_idx = border + stride1 * jnp.arange(Ho)
+                w_idx = border + stride1 * jnp.arange(Wo)
                 outs.append(summed[:, h_idx[:, None], w_idx[None, :]] / nelems)
         return jnp.stack(outs, axis=1)
 

@@ -192,4 +192,5 @@ def gaussian_random_batch_size_like(input, shape, mean=0.0, std=1.0,
     ref = input if hasattr(input, "shape") else Tensor(jnp.asarray(input))
     shape = list(shape)
     shape[output_dim_idx] = ref.shape[input_dim_idx]
-    return normal(mean=mean, std=std, shape=shape)
+    out = normal(mean=mean, std=std, shape=shape)
+    return out.astype(dtype) if dtype not in (None, "float32") else out

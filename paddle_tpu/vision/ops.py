@@ -1606,7 +1606,28 @@ def roi_perspective_transform(x, rois, transformed_height, transformed_width,
             w_ = m6 * ww + m7 * hh + m8
             in_w = u / w_
             in_h = v / w_
-            inb = ((in_w > -0.5) & (in_w < W - 0.5)
+            # reference also zeroes output+mask when the source point falls
+            # OUTSIDE the quadrilateral (roi_perspective_transform_op.cc:303)
+            # — even-odd crossing test against the 4-gon
+            inq = jnp.zeros(in_w.shape, bool)
+            on_edge = jnp.zeros(in_w.shape, bool)
+            for e in range(4):
+                xi, yi = qx[e], qy[e]
+                xj, yj = qx[(e + 3) % 4], qy[(e + 3) % 4]
+                crosses = ((yi > in_h) != (yj > in_h)) & (
+                    in_w < (xj - xi) * (in_h - yi) / (yj - yi + 1e-12) + xi)
+                inq = inq ^ crosses
+                # reference in_quad counts points ON an edge as inside (:46-60)
+                cross = (xj - xi) * (in_h - yi) - (yj - yi) * (in_w - xi)
+                seg_len = jnp.sqrt((xj - xi) ** 2 + (yj - yi) ** 2) + 1e-12
+                near = jnp.abs(cross) / seg_len < 1e-3
+                inseg = ((in_w >= jnp.minimum(xi, xj) - 1e-3)
+                         & (in_w <= jnp.maximum(xi, xj) + 1e-3)
+                         & (in_h >= jnp.minimum(yi, yj) - 1e-3)
+                         & (in_h <= jnp.maximum(yi, yj) + 1e-3))
+                on_edge = on_edge | (near & inseg)
+            inq = inq | on_edge
+            inb = (inq & (in_w > -0.5) & (in_w < W - 0.5)
                    & (in_h > -0.5) & (in_h < H - 0.5))
 
             x0 = jnp.floor(in_w)
@@ -1651,6 +1672,11 @@ def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
     labels_np = np.asarray(_t(gt_labels)._data).reshape(-1).astype(np.int64)
     crowd = (np.asarray(_t(is_crowd)._data).reshape(-1).astype(np.int64)
              if is_crowd is not None else np.zeros(len(gts), np.int64))
+    # gt boxes arrive in ORIGINAL image coords; anchors live on the resized
+    # image — scale gts by im_scale like the reference (:~975)
+    if im_info is not None:
+        im_scale = float(np.asarray(_t(im_info)._data).reshape(-1)[2])
+        gts = gts * im_scale
     keep_gt = crowd == 0
     gts = gts[keep_gt]
     labels_np = labels_np[keep_gt]
@@ -1862,9 +1888,12 @@ def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
             ba = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
             best = int(np.argmax(inter / np.maximum(ra + ba - inter, 1e-10)))
             polys = gt_polys[best]
-            c = int(cls[gt_ids[best]])
         else:
-            polys, c = [], int(labels[ridx])
+            polys = []
+        # the mask goes into the RoI's OWN class slot (reference gathers
+        # mask_class_labels from labels_int32); the matched gt only supplies
+        # the polygon geometry
+        c = int(labels[ridx])
         w = max(roi[2] - roi[0], 1e-3)
         h = max(roi[3] - roi[1], 1e-3)
         gx = roi[0] + (np.arange(res) + 0.5) * w / res

@@ -893,10 +893,11 @@ def test_similarity_focus():
     x[0, 0] = [[9, 1, 1], [1, 5, 1], [1, 1, 7]]   # maxima on the diagonal
     x[0, 1] = rng.rand(3, 3)
     got = _np(F.similarity_focus(paddle.to_tensor(x), axis=1, indexes=[0]))
-    # mask = identity (picks (0,0)=9 then (2,2)=7 then (1,1)=5)
+    # output IS the broadcast 0/1 mask (reference writes 1s, never gates x):
+    # identity pattern (picks (0,0)=9 then (2,2)=7 then (1,1)=5)
     exp_mask = np.eye(3, dtype=np.float32)
-    np.testing.assert_allclose(got[0, 0], x[0, 0] * exp_mask, rtol=1e-6)
-    np.testing.assert_allclose(got[0, 1], x[0, 1] * exp_mask, rtol=1e-6)
+    np.testing.assert_allclose(got[0, 0], exp_mask, rtol=1e-6)
+    np.testing.assert_allclose(got[0, 1], exp_mask, rtol=1e-6)
 
 
 def test_var_conv_2d():
@@ -1071,3 +1072,31 @@ def test_bilateral_slice():
     F.bilateral_slice(xt, gt_, grt, has_offset=True).sum().backward()
     for t in (xt, grt):
         assert np.abs(_np(t.grad)).sum() > 0
+
+
+def test_correlation_kernel3():
+    # kernel_size=3: border = max_disp + 1; loop-port check incl. zero padding
+    N, C, H, W = 1, 2, 8, 8
+    x = _randn(N, C, H, W)
+    y = _randn(N, C, H, W)
+    pad, ks, md, s1, s2 = 3, 3, 2, 1, 2
+    got = _np(F.correlation(paddle.to_tensor(x), paddle.to_tensor(y),
+                            pad_size=pad, kernel_size=ks, max_displacement=md,
+                            stride1=s1, stride2=s2))
+    kr = 1
+    border = md + kr
+    Hp = H + 2 * pad
+    Ho = int(np.ceil((Hp - 2 * border) / s1))
+    assert got.shape == (N, 9, Ho, Ho)
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    yp = np.pad(y, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    nelems = ks * ks * C
+    for (tj, ti, oy, ox) in [(0, 0, 0, 0), (-1, 1, 2, 1), (1, -1, Ho - 1, 3)]:
+        h1, w1 = border + oy * s1, border + ox * s1
+        h2, w2 = h1 + tj * s2, w1 + ti * s2
+        exp = 0.0
+        for j in range(-kr, kr + 1):
+            for i in range(-kr, kr + 1):
+                exp += (xp[0, :, h1 + j, w1 + i] * yp[0, :, h2 + j, w2 + i]).sum()
+        tc = (tj + 1) * 3 + (ti + 1)
+        np.testing.assert_allclose(got[0, tc, oy, ox], exp / nelems, rtol=1e-4)
