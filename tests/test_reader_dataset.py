@@ -52,3 +52,60 @@ def test_compat_and_sysconfig():
     assert paddle.compat.to_bytes("abc") == b"abc"
     assert paddle.sysconfig.get_lib().endswith("native")
     assert paddle.regularizer.L2Decay(1e-4).coeff == 1e-4
+
+
+def test_fleet_data_generator():
+    import io
+    import sys
+
+    from paddle_tpu.distributed.fleet import MultiSlotDataGenerator
+
+    class Gen(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def iters():
+                yield [("ids", [1, 2, 3]), ("label", [0])]
+
+            return iters
+
+    g = Gen()
+    out = io.StringIO()
+    old = sys.stdout
+    sys.stdout = out
+    try:
+        g.run_from_memory()
+    finally:
+        sys.stdout = old
+    assert out.getvalue().strip() == "3 1 2 3 1 0"
+
+
+def test_fleet_util_file_shard():
+    from paddle_tpu.distributed.fleet import UtilBase
+
+    u = UtilBase()
+    files = [f"f{i}" for i in range(5)]
+    assert u.get_file_shard(files) == files  # world_size 1
+
+
+def test_utils_profiler_and_download(tmp_path):
+    import paddle_tpu as paddle
+
+    with paddle.utils.Profiler():
+        _ = 1 + 1
+    src = tmp_path / "a.txt"
+    src.write_text("hi")
+    dst = tmp_path / "b.txt"
+    assert paddle.utils.download(str(src), str(dst)) == str(dst)
+    assert dst.read_text() == "hi"
+    import pytest
+
+    with pytest.raises(RuntimeError, match="egress"):
+        paddle.utils.download("https://example.com/x")
+    assert paddle.utils.require_version("2.0")
+
+
+def test_incubate_layer_helper():
+    from paddle_tpu.incubate import LayerHelper
+
+    h = LayerHelper("fc")
+    p = h.create_parameter(shape=[3, 2])
+    assert list(p.shape) == [3, 2] and not p.stop_gradient
