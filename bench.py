@@ -85,6 +85,28 @@ def run_config(batch, seq, steps, quiet=False):
     return tokens_per_sec, mfu
 
 
+def _arm_watchdog(seconds=900):
+    """If the TPU tunnel is wedged (device init / first compile hangs), emit a
+    parseable failure line instead of hanging until the driver's kill. The
+    timer is cancelled once the first measurement completes."""
+    import os
+    import threading
+
+    def _fire():
+        print(json.dumps({
+            "metric": "gpt2s_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+            "error": f"watchdog: no measurement within {seconds}s — "
+                     "TPU tunnel unavailable/wedged",
+        }), flush=True)
+        os._exit(3)
+
+    t = threading.Timer(seconds, _fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=None)
@@ -94,9 +116,16 @@ def main():
                     help="sweep batch/seq configs, report the best")
     args = ap.parse_args()
 
+    # arm BEFORE backend init: a wedged tunnel hangs inside jax.devices()
+    # itself, which is precisely the case the watchdog must catch
+    watchdog = _arm_watchdog(900)
+
     import jax
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    if not on_tpu:
+        watchdog.cancel()
+        watchdog = None
     # batch 16 was the r1 sweet spot at seq 1024 (batch 32 exceeded 16G HBM);
     # the r2 flash-attention retune cut attention HBM traffic, so when no
     # explicit --batch is given on TPU, a quick 2-config probe (6 steps each)
@@ -113,6 +142,9 @@ def main():
                 print(f"  probe batch={b} failed ({e})", file=sys.stderr)
         if probes:
             batch = max(probes, key=probes.get)
+        if watchdog is not None:
+            watchdog.cancel()          # device + compile proven healthy
+            watchdog = _arm_watchdog(900)
 
     if args.sweep:
         best = (0.0, 0.0, None)
@@ -138,6 +170,8 @@ def main():
         return
 
     tps, mfu = run_config(batch, seq, args.steps, quiet=True)
+    if watchdog is not None:
+        watchdog.cancel()
     print(json.dumps({
         "metric": "gpt2s_train_tokens_per_sec_per_chip",
         "value": round(tps, 1),
