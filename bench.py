@@ -93,9 +93,8 @@ def _arm_watchdog(seconds=900):
     import threading
 
     def _fire():
+        # no "metric"/"value" keys: a failure must never parse as a number
         print(json.dumps({
-            "metric": "gpt2s_train_tokens_per_sec_per_chip",
-            "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
             "error": f"watchdog: no measurement within {seconds}s — "
                      "TPU tunnel unavailable/wedged",
         }), flush=True)
