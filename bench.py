@@ -154,6 +154,11 @@ def main():
             except Exception as e:
                 print(f"  batch={b} seq={s}: failed ({e})", file=sys.stderr)
                 continue
+            if watchdog is not None:
+                # first config proved the tunnel healthy; a long sweep is
+                # not a wedge — stand the watchdog down
+                watchdog.cancel()
+                watchdog = None
             if tps > best[0]:
                 best = (tps, mfu, (b, s))
         tps, mfu, cfg = best

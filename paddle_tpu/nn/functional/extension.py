@@ -729,18 +729,29 @@ def var_conv_2d(x, row_length, col_length, weight, input_channel,
 
 
 def tree_conv(nodes_vector, edge_set, output_size, num_filters=1, max_depth=2,
-              act=None, filter=None, name=None):
+              act="tanh", filter=None, name=None):
     """tree_conv_op (TBCNN, math/tree2col.cc parity): per node, gather its
     subtree within max_depth; each patch member contributes its feature
     weighted by the continuous binary-tree coefficients (eta_l, eta_r, eta_t)
     (:35-52), then one matmul against filter [F, 3, output_size, num_filters].
     Eager tree walk (data-dependent structure), XLA matmul + autodiff for the
-    compute. nodes_vector [N, F] (node ids are 1-based in edge_set);
-    edge_set [E, 2] int, (0, 0)-terminated. Returns [P, output_size, M]."""
+    compute. nodes_vector [batch, N, F] (or unbatched [N, F]); node ids are
+    1-based in edge_set [batch, E, 2] (or [E, 2]), (0, 0)-terminated; act
+    defaults to tanh like fluid.contrib.layers.tree_conv. Returns
+    [batch, P, output_size, M] (or unbatched [P, output_size, M])."""
     feats = _t(nodes_vector)
-    edges = np.asarray(_t(edge_set)._data).astype(np.int64).reshape(-1, 2)
+    edges_all = np.asarray(_t(edge_set)._data).astype(np.int64)
     w = _t(filter)
     F_ = feats.shape[-1]
+
+    if feats.ndim == 3:  # batched: per-sample trees, stacked results
+        outs = [tree_conv(feats[b], edges_all[b], output_size, num_filters,
+                          max_depth, act, filter)
+                for b in range(feats.shape[0])]
+        from ...tensor.manipulation import stack as _stack
+
+        return _stack(outs, axis=0)
+    edges = edges_all.reshape(-1, 2)
 
     tr = {}
     node_count = 0

@@ -162,14 +162,20 @@ def as_real(x, name=None):
     return apply(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x)
 
 
+def _batch_size_like_shape(input, shape, input_dim_idx, output_dim_idx):
+    """Shared *_batch_size_like shape builder: copy the input's batch dim."""
+    ref = input if hasattr(input, "shape") else Tensor(jnp.asarray(input))
+    shape = list(shape)
+    shape[output_dim_idx] = ref.shape[input_dim_idx]
+    return shape
+
+
 def fill_constant_batch_size_like(input, shape, dtype, value,
                                   input_dim_idx=0, output_dim_idx=0,
                                   name=None):
     """fill_constant_batch_size_like_op.cc parity: like full(shape) but the
     output's batch dim copies the input's (dynamic RNN init-state idiom)."""
-    ref = input if hasattr(input, "shape") else Tensor(jnp.asarray(input))
-    shape = list(shape)
-    shape[output_dim_idx] = ref.shape[input_dim_idx]
+    shape = _batch_size_like_shape(input, shape, input_dim_idx, output_dim_idx)
     return full(shape, value, dtype=dtype)
 
 
@@ -178,9 +184,7 @@ def uniform_random_batch_size_like(input, shape, low=-1.0, high=1.0,
                                    dtype="float32", name=None):
     from .random import uniform
 
-    ref = input if hasattr(input, "shape") else Tensor(jnp.asarray(input))
-    shape = list(shape)
-    shape[output_dim_idx] = ref.shape[input_dim_idx]
+    shape = _batch_size_like_shape(input, shape, input_dim_idx, output_dim_idx)
     return uniform(shape, min=low, max=high, dtype=dtype)
 
 
@@ -189,8 +193,6 @@ def gaussian_random_batch_size_like(input, shape, mean=0.0, std=1.0,
                                     dtype="float32", name=None):
     from .random import normal
 
-    ref = input if hasattr(input, "shape") else Tensor(jnp.asarray(input))
-    shape = list(shape)
-    shape[output_dim_idx] = ref.shape[input_dim_idx]
+    shape = _batch_size_like_shape(input, shape, input_dim_idx, output_dim_idx)
     out = normal(mean=mean, std=std, shape=shape)
     return out.astype(dtype) if dtype not in (None, "float32") else out

@@ -1700,10 +1700,24 @@ def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
 
     eps = 1e-5
     with_max = (np.abs(ov - g2a_max[None, :]) < eps).any(axis=1) if G else np.zeros(A, bool)
-    fg_mask = with_max | (a2g_max >= positive_overlap)
-    bg_mask = (~fg_mask) & (a2g_max < negative_overlap)
+    fg_cand = with_max | (a2g_max >= positive_overlap)
+    # reference bg loop (rpn_target_assign_op.cc:236-246) demotes fg anchors
+    # whose max IoU is below negative_overlap back to background, keeping a
+    # zero-weight loc slot (duplicated first fg candidate) for each
+    below_neg = a2g_max < negative_overlap
+    demoted = fg_cand & below_neg
+    fg_mask = fg_cand & ~below_neg
+    bg_mask = below_neg                      # includes the demoted anchors
     fg_inds = np.nonzero(fg_mask)[0]
     bg_inds = np.nonzero(bg_mask)[0]
+    n_demoted = int(demoted.sum())
+    fg_cand_inds = np.nonzero(fg_cand)[0]
+    first_fg = int(fg_cand_inds[0]) if len(fg_cand_inds) else 0
+    loc_index = np.concatenate([
+        np.full(n_demoted, first_fg, np.int64), fg_inds]).astype(np.int64)
+    inside_w = np.concatenate([
+        np.zeros((n_demoted, 4), np.float32),
+        np.ones((len(fg_inds), 4), np.float32)], axis=0)
 
     def deltas(aidx):
         a = anchors[aidx]
@@ -1715,17 +1729,18 @@ def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
         return [(gcx - acx) / aw, (gcy - acy) / ah,
                 np.log(gw / aw), np.log(gh / ah)]
 
-    tgt_bbox = np.asarray([deltas(i) for i in fg_inds], np.float32).reshape(-1, 4)
+    tgt_bbox = np.asarray([deltas(i) for i in loc_index],
+                          np.float32).reshape(-1, 4)
     tgt_lbl = np.concatenate([
         labels_np[a2g_arg[fg_inds]] if G else np.zeros(len(fg_inds), np.int64),
         np.zeros(len(bg_inds), np.int64)]).astype(np.int32)
     score_index = np.concatenate([fg_inds, bg_inds]).astype(np.int32)
-    outs = [Tensor(jnp.asarray(fg_inds.astype(np.int32))),
+    outs = [Tensor(jnp.asarray(loc_index.astype(np.int32))),
             Tensor(jnp.asarray(score_index)),
             Tensor(jnp.asarray(tgt_bbox)),
             Tensor(jnp.asarray(tgt_lbl.reshape(-1, 1))),
-            Tensor(jnp.asarray(np.ones((len(fg_inds), 4), np.float32))),
-            Tensor(jnp.asarray(np.asarray([len(fg_inds) + 1], np.int32)))]
+            Tensor(jnp.asarray(inside_w)),
+            Tensor(jnp.asarray(np.asarray([len(loc_index) + 1], np.int32)))]
     for t in outs:
         t.stop_gradient = True
     return tuple(outs)

@@ -946,7 +946,7 @@ def test_tree_conv():
     F_, O, M = 3, 2, 1
     w = rng.randn(F_, 3, O, M).astype(np.float32)
     got = _np(F.tree_conv(paddle.to_tensor(feats), edges, O, M, max_depth=2,
-                          filter=paddle.to_tensor(w)))
+                          act=None, filter=paddle.to_tensor(w)))
     assert got.shape == (3, O, M)
     # manual: patch for root 1 = {1 (d0), 2 (idx1, len2, d1), 3 (idx2, len2, d1)}
     d = 2.0
@@ -1100,3 +1100,18 @@ def test_correlation_kernel3():
                 exp += (xp[0, :, h1 + j, w1 + i] * yp[0, :, h2 + j, w2 + i]).sum()
         tc = (tj + 1) * 3 + (ti + 1)
         np.testing.assert_allclose(got[0, tc, oy, ox], exp / nelems, rtol=1e-4)
+
+
+
+def test_tree_conv_batched_tanh_default():
+    edges = np.array([[[1, 2], [1, 3], [0, 0]]] * 2, np.int32)
+    feats = np.stack([np.eye(3, dtype=np.float32)] * 2)
+    w = rng.randn(3, 3, 2, 1).astype(np.float32)
+    got = _np(F.tree_conv(paddle.to_tensor(feats), edges, 2, 1, max_depth=2,
+                          filter=paddle.to_tensor(w)))
+    assert got.shape == (2, 3, 2, 1)
+    # default act is tanh (fluid.contrib parity)
+    raw = _np(F.tree_conv(paddle.to_tensor(feats[0]), edges[0], 2, 1,
+                          max_depth=2, act=None, filter=paddle.to_tensor(w)))
+    np.testing.assert_allclose(got[0], np.tanh(raw), rtol=1e-5)
+    np.testing.assert_allclose(got[0], got[1], rtol=1e-6)
