@@ -73,6 +73,25 @@ def _cases():
                              (f32(256, 16384),))),
         ("sgd_update_8m", lambda: (
             lambda p, g: p - 0.01 * g, (f32(2048, 4096), f32(2048, 4096)))),
+        ("cross_entropy_lse_16kx50k", lambda: (
+            # the r2 hard-label CE path: logsumexp+gather, no one_hot
+            lambda lg, ids: (jax.nn.logsumexp(lg.astype(jnp.float32), axis=-1)
+                             - jnp.take_along_axis(
+                                 lg.astype(jnp.float32), ids[:, None],
+                                 axis=-1)[:, 0]).mean(),
+            (f32(2048, 8192).astype(jnp.bfloat16), i32(0, 8192, 2048)))),
+        ("sequence_pool_sum_4kx128", lambda: (
+            lambda x, ln: (x * (jnp.arange(x.shape[1])[None, :, None]
+                                < ln[:, None, None])).sum(axis=1),
+            (f32(4096, 128, 64), i32(1, 128, 4096)))),
+        ("segment_sum_1m", lambda: (
+            lambda d, ids: jax.ops.segment_sum(d, ids, num_segments=1024),
+            (f32(1 << 20, 8), i32(0, 1024, 1 << 20)))),
+        ("iou_matrix_2k", lambda: (
+            lambda b: (lambda lt, rb: (jnp.maximum(rb - lt, 0).prod(-1)))(
+                jnp.maximum(b[:, None, :2], b[None, :, :2]),
+                jnp.minimum(b[:, None, 2:], b[None, :, 2:])),
+            (f32(2048, 4),))),
         ("adam_update_8m", lambda: (
             lambda p, g, m, v: (
                 p - 0.01 * (0.9 * m + 0.1 * g)
