@@ -1115,3 +1115,32 @@ def test_tree_conv_batched_tanh_default():
                           max_depth=2, act=None, filter=paddle.to_tensor(w)))
     np.testing.assert_allclose(got[0], np.tanh(raw), rtol=1e-5)
     np.testing.assert_allclose(got[0], got[1], rtol=1e-6)
+
+
+def test_sequence_family_jit_parity():
+    """The padded+length design's point: every sequence op also jits."""
+    import jax
+
+    x = _randn(2, 6, 3)
+    ln = np.array([6, 4])
+    cases = [
+        lambda xv, lv: F.sequence_pool(xv, lv, "sum"),
+        lambda xv, lv: F.sequence_pool(xv, lv, "max"),
+        lambda xv, lv: F.sequence_reverse(xv, lv),
+        lambda xv, lv: F.sequence_expand(xv, lv, lv),
+    ]
+    for op in cases:
+        eager = _np(op(paddle.to_tensor(x), paddle.to_tensor(ln)))
+
+        def raw(a, b):
+            return op(paddle.to_tensor(a), paddle.to_tensor(b))._data
+
+        jitted = np.asarray(jax.jit(raw)(x, ln))
+        np.testing.assert_allclose(eager, jitted, rtol=1e-5)
+    # sequence_softmax (2-D) as well
+    s = _randn(2, 6)
+    eager = _np(F.sequence_softmax(paddle.to_tensor(s), paddle.to_tensor(ln)))
+    jitted = np.asarray(jax.jit(
+        lambda a, b: F.sequence_softmax(paddle.to_tensor(a),
+                                        paddle.to_tensor(b))._data)(s, ln))
+    np.testing.assert_allclose(eager, jitted, rtol=1e-5)
