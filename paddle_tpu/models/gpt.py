@@ -91,6 +91,7 @@ class GPTAttention(nn.Layer):
         h = cfg.hidden_size
         self.num_heads = cfg.num_heads
         self.head_dim = h // cfg.num_heads
+        self.use_flash = getattr(cfg, "use_flash", True)
         self.sp_mesh = cfg.sp_mesh if getattr(cfg, "sequence_parallel", False) else None
         self.sp_impl = getattr(cfg, "sp_impl", "ring")
         if cfg.tensor_parallel:
@@ -125,6 +126,7 @@ class GPTAttention(nn.Layer):
                 q, k, v, is_causal=True,
                 dropout_p=self.dropout if self.training else 0.0,
                 training=self.training,
+                use_flash=self.use_flash,
             )
         return self.proj(out.reshape([b, s, h]))
 

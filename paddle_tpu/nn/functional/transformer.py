@@ -20,7 +20,8 @@ def _t(x):
 
 
 def scaled_dot_product_attention(
-    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None,
+    use_flash=True,
 ):
     """query/key/value: [batch, seq, heads, head_dim] (paddle 2.x layout).
 
@@ -30,20 +31,21 @@ def scaled_dot_product_attention(
     args = [_t(query), _t(key), _t(value)]
     mask_val = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
 
-    use_flash = False
+    flash_ok = False
     try:
         from ...ops import flash_attention as fa
 
         q = args[0]
-        use_flash = (
-            mask_val is None
+        flash_ok = (
+            use_flash
+            and mask_val is None
             and dropout_p == 0.0
             and fa.supported(tuple(q.shape), str(q.dtype))
         )
     except Exception:
-        use_flash = False
+        flash_ok = False
 
-    if use_flash:
+    if flash_ok:
         def fn(q, k, v):
             return fa.flash_attention(q, k, v, causal=is_causal)
 
