@@ -46,17 +46,76 @@ def convert_to_mixed_precision(src_model, src_params, dst_model, dst_params,
                                mixed_precision=PrecisionType.Bfloat16,
                                backend=None, keep_io_types=True,
                                black_list=None):
-    """Compat: precision policy is applied at run time via amp.auto_cast
-    (bf16-first); the saved artifact is precision-agnostic StableHLO, so the
-    conversion is a copy + recorded precision hint."""
+    """Reference parity: inference convert_to_mixed_precision (the analysis
+    pass that rewrites a saved program to fp16/bf16).
+
+    TPU-native behavior: the saved PARAMS are actually cast to the target
+    low precision (the artifact shrinks ~2x) and a precision hint is written
+    beside the model. The Predictor's re-jit path reads the hint and runs
+    the forward under amp.auto_cast with the recorded dtype/black_list, so
+    compute precision changes too; the AOT jax.export path upcasts params to
+    its traced dtypes at load (static/io._load_exported), keeping it servable.
+    `black_list` entries name params/ops to keep in float32."""
     import json
+    import os
+    import pickle
     import shutil
 
-    shutil.copy(src_model, dst_model)
+    import numpy as np
+    import ml_dtypes
+
+    target = {PrecisionType.Bfloat16: ml_dtypes.bfloat16,
+              PrecisionType.Half: np.float16}.get(mixed_precision)
+    black = set(black_list or ())
+
+    def _cast(params):
+        out = {}
+        for k, v in params.items():
+            v = np.asarray(v)
+            if (target is not None and k not in black
+                    and v.dtype in (np.float32, np.float64)):
+                v = v.astype(target)
+            out[k] = v
+        return out
+
+    # model side: single file, or a save_inference_model/jit.save prefix
+    copied = False
+    if src_model and os.path.isfile(src_model):
+        if os.path.abspath(src_model) != os.path.abspath(dst_model):
+            shutil.copy(src_model, dst_model)
+        copied = True
+    else:
+        for suf in (".pdmodel", ".pdmodel.jaxexport", ".pdmodel.stablehlo",
+                    ".pdmodel.meta"):
+            if os.path.isfile(str(src_model) + suf):
+                if os.path.abspath(str(src_model) + suf) != \
+                        os.path.abspath(str(dst_model) + suf):
+                    shutil.copy(str(src_model) + suf, str(dst_model) + suf)
+                copied = True
+    if not copied:
+        raise FileNotFoundError(f"no model file/prefix at {src_model!r}")
+
+    # params side: npz (static/io artifact), pickle (.pdiparams), or prefix
     if src_params and dst_params:
-        shutil.copy(src_params, dst_params)
+        from ..static.io import _load_params_npz, _savez_params
+
+        sp, dp = str(src_params), str(dst_params)
+        if not os.path.isfile(sp) and os.path.isfile(sp + ".pdiparams.npz"):
+            sp, dp = sp + ".pdiparams.npz", dp + ".pdiparams.npz"
+        elif not os.path.isfile(sp) and os.path.isfile(sp + ".pdiparams"):
+            sp, dp = sp + ".pdiparams", dp + ".pdiparams"
+        if sp.endswith(".npz"):
+            _savez_params(dp, _cast(_load_params_npz(sp)))
+        else:
+            with open(sp, "rb") as f:
+                params = pickle.load(f)
+            with open(dp, "wb") as f:
+                pickle.dump(_cast(params), f)
+
     hint = {"mixed_precision": int(mixed_precision),
+            "dtype": (np.dtype(target).name if target is not None
+                      else "float32"),
             "keep_io_types": bool(keep_io_types),
-            "black_list": sorted(black_list or [])}
+            "black_list": sorted(black)}
     with open(str(dst_model) + ".precision.json", "w") as f:
         json.dump(hint, f)

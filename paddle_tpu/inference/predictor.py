@@ -74,6 +74,17 @@ class Predictor:
     def _load(self):
         path = self.config.model_path
         self._aot = None
+        # convert_to_mixed_precision hint: the re-jit path honors it by
+        # tracing under amp.auto_cast with the recorded dtype/black_list
+        self._precision = None
+        if path and os.path.exists(path + ".precision.json"):
+            import json
+
+            try:
+                with open(path + ".precision.json") as f:
+                    self._precision = json.load(f)
+            except Exception:
+                self._precision = None
         if path and os.path.exists(path + ".pdmodel.jaxexport"):
             # AOT path (save_inference_model artifact): no python Layer, no
             # re-trace — the AnalysisPredictor-on-saved-model analog. The
@@ -137,14 +148,34 @@ class Predictor:
         if key not in self._compiled:
             layer = self._layer
             tape = global_tape()
+            hint = self._precision
+
+            low_precision = bool(hint) and \
+                hint.get("dtype") in ("bfloat16", "float16")
 
             def pure(*xs):
-                with tape.pause():
+                import contextlib
+
+                amp_ctx = contextlib.nullcontext()
+                if low_precision:
+                    from ..amp import auto_cast
+
+                    amp_ctx = auto_cast(
+                        True, dtype=hint["dtype"],
+                        custom_black_list=hint.get("black_list") or None)
+                with tape.pause(), amp_ctx:
                     out = layer(*[Tensor(x) for x in xs])
-                return jax.tree_util.tree_map(
+                out = jax.tree_util.tree_map(
                     lambda v: v._data if isinstance(v, Tensor) else v, out,
                     is_leaf=lambda v: isinstance(v, Tensor),
                 )
+                if low_precision and hint.get("keep_io_types", True):
+                    out = jax.tree_util.tree_map(
+                        lambda v: v.astype(jnp.float32)
+                        if hasattr(v, "dtype")
+                        and jnp.issubdtype(v.dtype, jnp.floating)
+                        and v.dtype != jnp.float32 else v, out)
+                return out
 
             self._compiled[key] = jax.jit(pure)
         out = self._compiled[key](*[jnp.asarray(a) for a in arrs])

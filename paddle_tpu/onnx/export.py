@@ -2,37 +2,29 @@
 
 The reference delegates to the external `paddle2onnx` converter over a
 `jit.save`d TranslatedLayer. The TPU-native export pipeline is StableHLO
-(jit.save → jaxpr/StableHLO, see inference/predictor.py); ONNX is an optional
-interop tail that needs the `onnx` package. When it is unavailable (this image
-does not bundle it), we still honor the API: trace the layer, save the portable
-StableHLO/program artifact next to the requested path, and raise a clear error
-only if the caller insists on a .onnx protobuf.
+(jit.save → jax.export artifact, see inference/predictor.py); ONNX is an
+optional interop tail that would need a real op-by-op converter (paddle2onnx's
+job). We always save the framework-native portable artifact at `path`; since
+no converter ships in this build, a `.onnx` protobuf is NEVER written — an
+executable-looking-but-empty .onnx would be worse than an honest error.
 """
-import os
 
 
 def export(layer, path, input_spec=None, opset_version=9, **configs):
-    """Export `layer` for interop. Writes `path`.onnx when the `onnx` package is
-    importable; always writes the framework-native saved program at `path`."""
+    """Save `layer` at `path` in the framework-native portable format, then
+    raise: ONNX protobuf emission needs an op-by-op converter this build does
+    not include (the reference itself defers to the external `paddle2onnx`).
+    The saved artifact is loadable via paddle_tpu.jit.load / the inference
+    Predictor, and its `.pdmodel.stablehlo` is consumable by any XLA runtime.
+    """
     from .. import jit as pjit
 
     pjit.save(layer, path, input_spec=input_spec)
-    try:
-        import onnx  # noqa: F401
-    except ImportError as e:
-        raise RuntimeError(
-            "paddle_tpu.onnx.export: the 'onnx' package is not installed in this "
-            "environment. The model was saved in the framework-native StableHLO/"
-            f"program format at '{path}' (loadable via paddle_tpu.jit.load or the "
-            "inference Predictor). Install 'onnx' to emit a .onnx protobuf."
-        ) from e
-    # onnx available: emit a minimal model proto carrying the saved program as
-    # an external reference (full op-by-op conversion is out of scope here).
-    model = onnx.ModelProto()
-    model.ir_version = onnx.IR_VERSION
-    model.opset_import.add().version = opset_version
-    model.producer_name = "paddle_tpu"
-    model.doc_string = f"StableHLO program saved at {os.path.abspath(path)}"
-    with open(path + ".onnx", "wb") as f:
-        f.write(model.SerializeToString())
-    return path + ".onnx"
+    raise RuntimeError(
+        "paddle_tpu.onnx.export: op-by-op ONNX conversion is not bundled "
+        "(the reference delegates this to the external 'paddle2onnx' "
+        "package). The model WAS saved in the framework-native StableHLO/"
+        f"jax.export format at '{path}' — load it with paddle_tpu.jit.load "
+        "or the inference Predictor, or feed the .pdmodel.stablehlo to any "
+        "XLA-compatible runtime. No .onnx file was written."
+    )

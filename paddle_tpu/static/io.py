@@ -1,12 +1,21 @@
 """Inference model export/import.
 
-Reference parity: python/paddle/static/io.py save/load_inference_model (+
-fluid/io.py, pybind inference AnalysisPredictor consumption).
-TPU-native design: export = params npz + StableHLO text of the jitted forward —
-consumable by any XLA runtime (the inference/predictor.py AOT path loads it back).
+Reference parity: python/paddle/static/io.py save/load_inference_model (the
+Program-path signature at static/io.py:442 `(path_prefix, feed_vars,
+fetch_vars, executor)` and the layer-based jit path), legacy fluid/io.py:1199,
+plus pybind inference AnalysisPredictor consumption.
+
+TPU-native design: export = params npz + a serialized `jax.export` artifact
+(+ StableHLO text) of the jitted forward — consumable by any XLA runtime
+(inference/predictor.py AOT path loads it back without python model code).
+Both entry paths converge here:
+  * layer=Layer       — trace the dygraph Layer's forward.
+  * (feed, fetch, exe) — replay the recorded static Program's op slice
+                         (static/__init__.py Executor's compile path).
 """
 import os
 import pickle
+import warnings
 
 import numpy as np
 import jax
@@ -15,114 +24,256 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, program=None, layer=None, **kwargs):
-    """When `layer` is given (the TPU-native path), exports StableHLO + params."""
-    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
-    if layer is not None:
-        params = {n: np.asarray(t._data) for n, t in layer.state_dict().items()}
-        np.savez(path_prefix + ".pdiparams.npz", **params)
+_BF16_KEY = "__bf16_names__"
 
-        def pure(params_d, *args):
-            wrapped = [Tensor(a) for a in args]
-            from ..core.tape import global_tape
 
-            named = dict(layer.named_parameters())
-            named.update(dict(layer.named_buffers()))
-            saved = {n: t._data for n, t in named.items()}
-            try:
-                for n, v in params_d.items():
-                    if n in named:
-                        named[n]._data = v
-                with global_tape().pause():
-                    out = layer.forward(*wrapped)
-            finally:
-                for n, t in named.items():
-                    t._data = saved[n]
-            return jax.tree_util.tree_map(lambda v: v._data if isinstance(v, Tensor) else v, out,
-                                          is_leaf=lambda v: isinstance(v, Tensor))
+def _savez_params(path, params):
+    """np.savez with bfloat16 support: numpy serializes ml_dtypes.bfloat16 as
+    an opaque V2 void dtype, so bf16 arrays are stored as uint16 bit-views
+    plus a name manifest under _BF16_KEY (consumed by _load_params_npz)."""
+    import ml_dtypes
 
-        def _arg_structs(symbolic):
-            """None/-1 dims become export-time symbolic dims (batch-
-            polymorphic artifact); `symbolic=False` pins them to 1.
-
-            Leading (dim-0, batch) dynamic dims SHARE one symbol — models
-            that relate two inputs along batch (loss(input, label)) need the
-            equality constraint; other dynamic dims get distinct symbols."""
-            structs, n_sym, batch_sym = [], 0, None
-            for v in feed_vars:
-                dims = []
-                for pos, s in enumerate(v.shape):
-                    if s is None or (isinstance(s, int) and s < 0):
-                        if not symbolic:
-                            dims.append(1)
-                        elif pos == 0:
-                            if batch_sym is None:
-                                (batch_sym,) = jax.export.symbolic_shape("b")
-                            dims.append(batch_sym)
-                        else:
-                            (d,) = jax.export.symbolic_shape(f"d{n_sym}")
-                            n_sym += 1
-                            dims.append(d)
-                    else:
-                        dims.append(s)
-                structs.append(jax.ShapeDtypeStruct(tuple(dims), v.dtype))
-            return structs
-
-        params_j = {k: jnp.asarray(v) for k, v in params.items()}
-        jitted = jax.jit(pure)
-        # executable round-trip artifact (jax.export): the AOT predictor and
-        # jit.load run this without the original python Layer — the
-        # deployment-grade path. serialize fully before touching disk, write
-        # tmp + rename so a crash can never leave a truncated artifact.
-        exported = None
-        try:
-            exported = jax.export.export(jitted)(params_j,
-                                                 *_arg_structs(True))
-        except Exception as e_sym:
-            try:
-                exported = jax.export.export(jitted)(params_j,
-                                                     *_arg_structs(False))
-                import warnings
-
-                warnings.warn(
-                    f"symbolic-batch export failed ({e_sym}); exported with "
-                    "dynamic dims pinned to 1 — loads serve that shape only")
-            except Exception as e:
-                import warnings
-
-                warnings.warn(f"jax.export serialization unavailable ({e}); "
-                              "saving StableHLO text + params only")
-        wrote_artifact = False
-        if exported is not None:
-            try:
-                blob = exported.serialize()
-            except Exception as e:
-                import warnings
-
-                warnings.warn(f"jax.export serialization failed ({e}); "
-                              "saving StableHLO text + params only")
-            else:
-                tmp = path_prefix + ".pdmodel.jaxexport.tmp"
-                with open(tmp, "wb") as f:
-                    f.write(blob)
-                os.replace(tmp, path_prefix + ".pdmodel.jaxexport")
-                wrote_artifact = True
-        if exported is not None:
-            hlo_text = str(exported.mlir_module())  # no second trace
+    out, bf16 = {}, []
+    for k, v in params.items():
+        v = np.asarray(v)
+        if v.dtype == ml_dtypes.bfloat16:
+            out[k] = v.view(np.uint16)
+            bf16.append(k)
         else:
-            hlo_text = jitted.lower(params_j, *_arg_structs(False)).as_text()
-        with open(path_prefix + ".pdmodel.stablehlo", "w") as f:
-            f.write(hlo_text)
-        with open(path_prefix + ".pdmodel.meta", "wb") as f:
-            pickle.dump({"feed_shapes": [tuple(v.shape) for v in feed_vars],
-                         "feed_dtypes": [str(v.dtype) for v in feed_vars]}, f)
-        return {"path": path_prefix, "exported": wrote_artifact}
-    raise NotImplementedError("save_inference_model requires layer= in the TPU build")
+            out[k] = v
+    if bf16:
+        out[_BF16_KEY] = np.array(bf16)
+    np.savez(path, **out)
+
+
+def _load_params_npz(path):
+    import ml_dtypes
+
+    data = np.load(path)
+    bf16 = set(np.asarray(data[_BF16_KEY]).tolist()) \
+        if _BF16_KEY in data.files else set()
+    return {k: (np.asarray(data[k]).view(ml_dtypes.bfloat16)
+                if k in bf16 else data[k])
+            for k in data.files if k != _BF16_KEY}
+
+
+def _arg_structs(shapes, dtypes, symbolic):
+    """Build ShapeDtypeStructs for export. None/-1 dims become export-time
+    symbolic dims (batch-polymorphic artifact); `symbolic=False` pins them
+    to 1.
+
+    Leading (dim-0, batch) dynamic dims SHARE one symbol — models that
+    relate two inputs along batch (loss(input, label)) need the equality
+    constraint; other dynamic dims get distinct symbols."""
+    structs, n_sym, batch_sym = [], 0, None
+    for shape, dtype in zip(shapes, dtypes):
+        dims = []
+        for pos, s in enumerate(shape):
+            if s is None or (isinstance(s, int) and s < 0):
+                if not symbolic:
+                    dims.append(1)
+                elif pos == 0:
+                    if batch_sym is None:
+                        (batch_sym,) = jax.export.symbolic_shape("b")
+                    dims.append(batch_sym)
+                else:
+                    (d,) = jax.export.symbolic_shape(f"d{n_sym}")
+                    n_sym += 1
+                    dims.append(d)
+            else:
+                dims.append(s)
+        structs.append(jax.ShapeDtypeStruct(tuple(dims), dtype))
+    return structs
+
+
+def _write_export_artifact(pure, params, shapes, dtypes, path_prefix):
+    """Shared export tail: serialize `pure(params, *feeds)` as a durable
+    jax.export artifact + StableHLO text + params npz + meta. Serializes
+    fully before touching disk and writes tmp + rename so a crash can never
+    leave a truncated artifact. Returns whether the executable artifact was
+    written (StableHLO text always is)."""
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    _savez_params(path_prefix + ".pdiparams.npz", params)
+    params_j = {k: jnp.asarray(v) for k, v in params.items()}
+    jitted = jax.jit(pure)
+    exported = None
+    try:
+        exported = jax.export.export(jitted)(
+            params_j, *_arg_structs(shapes, dtypes, True))
+    except Exception as e_sym:
+        try:
+            exported = jax.export.export(jitted)(
+                params_j, *_arg_structs(shapes, dtypes, False))
+            warnings.warn(
+                f"symbolic-batch export failed ({e_sym}); exported with "
+                "dynamic dims pinned to 1 — loads serve that shape only")
+        except Exception as e:
+            warnings.warn(f"jax.export serialization unavailable ({e}); "
+                          "saving StableHLO text + params only")
+    wrote_artifact = False
+    if exported is not None:
+        try:
+            blob = exported.serialize()
+        except Exception as e:
+            warnings.warn(f"jax.export serialization failed ({e}); "
+                          "saving StableHLO text + params only")
+        else:
+            tmp = path_prefix + ".pdmodel.jaxexport.tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path_prefix + ".pdmodel.jaxexport")
+            wrote_artifact = True
+    if exported is not None:
+        hlo_text = str(exported.mlir_module())  # no second trace
+    else:
+        hlo_text = jitted.lower(
+            params_j, *_arg_structs(shapes, dtypes, False)).as_text()
+    with open(path_prefix + ".pdmodel.stablehlo", "w") as f:
+        f.write(hlo_text)
+    with open(path_prefix + ".pdmodel.meta", "wb") as f:
+        pickle.dump({"feed_shapes": [tuple(s) for s in shapes],
+                     "feed_dtypes": [str(d) for d in dtypes]}, f)
+    return wrote_artifact
+
+
+def _save_layer(path_prefix, feed_vars, layer):
+    params = {n: np.asarray(t._data) for n, t in layer.state_dict().items()}
+
+    def pure(params_d, *args):
+        wrapped = [Tensor(a) for a in args]
+        from ..core.tape import global_tape
+
+        named = dict(layer.named_parameters())
+        named.update(dict(layer.named_buffers()))
+        saved = {n: t._data for n, t in named.items()}
+        try:
+            for n, v in params_d.items():
+                if n in named:
+                    named[n]._data = v
+            with global_tape().pause():
+                out = layer.forward(*wrapped)
+        finally:
+            for n, t in named.items():
+                t._data = saved[n]
+        return jax.tree_util.tree_map(
+            lambda v: v._data if isinstance(v, Tensor) else v, out,
+            is_leaf=lambda v: isinstance(v, Tensor))
+
+    shapes = [tuple(v.shape) for v in feed_vars]
+    dtypes = [v.dtype for v in feed_vars]
+    wrote = _write_export_artifact(pure, params, shapes, dtypes, path_prefix)
+    return {"path": path_prefix, "exported": wrote}
+
+
+def _save_program(path_prefix, feed_vars, fetch_vars, program):
+    """Program path (reference static/io.py:442): export the recorded static
+    Program's backward slice as a pure (params, *feeds) -> fetches function.
+    Mirrors static/__init__.py Executor._compile's inference path, but traced
+    for AOT export instead of jit-per-feed-signature."""
+    from . import _slice_ops
+
+    if not program.ops:
+        raise ValueError(
+            "save_inference_model: the program records no ops — build it "
+            "from static.data placeholders under program_guard first")
+    program._ensure_scope()
+
+    feeds = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetches = (fetch_vars if isinstance(fetch_vars, (list, tuple))
+               else [fetch_vars])
+
+    # resolve feed tensors -> placeholder (name, var id, declared shape)
+    ph_by_vid = {vid: name for name, vid in program.placeholders.items()}
+    feed_ids, shapes, dtypes = [], [], []
+    for v in feeds:
+        vid = program._resolve_var(v) if isinstance(v, Tensor) else None
+        if vid is None and isinstance(v, str):
+            vid = program.placeholders.get(v)
+        if vid is None or vid not in ph_by_vid:
+            raise ValueError(
+                f"feed var {getattr(v, 'name', v)!r} is not a static.data "
+                "placeholder of this program")
+        name = ph_by_vid[vid]
+        feed_ids.append(vid)
+        shapes.append(program.placeholder_shapes[name])
+        dtypes.append(program.vars[vid].dtype)
+
+    fetch_ids = []
+    for v in fetches:
+        vid = program._resolve_var(v) if isinstance(v, Tensor) else None
+        if vid is None:
+            raise ValueError(
+                f"fetch var {getattr(v, 'name', v)!r} was not built in this "
+                "program")
+        fetch_ids.append(vid)
+
+    ops = _slice_ops(program, fetch_ids)
+
+    # validate the slice is fully served by feeds + params before tracing
+    bound = set(feed_ids) | set(program.params)
+    for op in ops:
+        for spec in op.arg_specs:
+            if spec[0] == "var" and spec[1] not in bound:
+                missing = ph_by_vid.get(spec[1])
+                if missing is not None:
+                    raise ValueError(
+                        f"placeholder '{missing}' is required by the fetch "
+                        "targets but is not among feed_vars")
+                raise ValueError("fetch targets reference a var with no "
+                                 "producer (built in a different program?)")
+        bound |= set(op.out_ids)
+    for fid in fetch_ids:
+        if fid not in bound:
+            raise ValueError("fetch target has no producer in this program")
+
+    params = {n: np.asarray(program._scope["params"][n])
+              for n in program.param_names}
+    params_map = dict(program.params)
+
+    def pure(params_d, *feed_arrays):
+        env = dict(zip(feed_ids, feed_arrays))
+        for vid, name in params_map.items():
+            env[vid] = params_d[name]
+        for op in ops:
+            vals = [env[s[1]] if s[0] == "var" else s[1]
+                    for s in op.arg_specs]
+            out = op.fn(*vals, **op.kwargs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for oid, o in zip(op.out_ids, outs):
+                env[oid] = o
+        return [env[i] for i in fetch_ids]
+
+    wrote = _write_export_artifact(pure, params, shapes, dtypes, path_prefix)
+    return {"path": path_prefix, "exported": wrote}
+
+
+def save_inference_model(path_prefix, feed_vars=None, fetch_vars=None,
+                         executor=None, program=None, layer=None, **kwargs):
+    """Both reference signatures converge on the same AOT artifact:
+
+    * `save_inference_model(path, feed_vars, fetch_vars, exe)` — the static
+      Program path (reference python/paddle/static/io.py:442): exports the
+      recorded default (or `program=`) Program's inference slice.
+    * `save_inference_model(path, feed_vars, ..., layer=layer)` — the
+      TPU-native dygraph path: traces the Layer's forward.
+    """
+    if layer is not None:
+        os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+        return _save_layer(path_prefix, feed_vars, layer)
+    from . import Program, default_main_program
+
+    prog = program or default_main_program()
+    if isinstance(prog, Program):
+        if prog._optimizer is not None:
+            prog = prog.clone(for_test=True)  # never export the train step
+        return _save_program(path_prefix, feed_vars, fetch_vars, prog)
+    raise TypeError(
+        "save_inference_model: pass layer= (dygraph) or build a static "
+        "Program (program_guard + static.data) before exporting")
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
-    data = np.load(path_prefix + ".pdiparams.npz")
-    params = {k: data[k] for k in data.files}
+    params = _load_params_npz(path_prefix + ".pdiparams.npz")
     with open(path_prefix + ".pdmodel.meta", "rb") as f:
         meta = pickle.load(f)
     with open(path_prefix + ".pdmodel.stablehlo") as f:
@@ -132,11 +283,28 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
 
 def _load_exported(path_prefix):
     """Deserialize the jax.export artifact + params (shared by jit.load and
-    load_aot_predictor)."""
+    load_aot_predictor). Params are cast back to the dtypes the exported
+    signature expects, so a bf16-converted params file
+    (inference.convert_to_mixed_precision) still serves an f32 artifact."""
     with open(path_prefix + ".pdmodel.jaxexport", "rb") as f:
         exported = jax.export.deserialize(bytearray(f.read()))
-    data = np.load(path_prefix + ".pdiparams.npz")
-    params = {k: data[k] for k in data.files}
+    params = _load_params_npz(path_prefix + ".pdiparams.npz")
+    want = None
+    try:
+        # Exported.in_tree is the treedef of (args, kwargs); args[0] is the
+        # params dict of avals for artifacts written by this module
+        tree = jax.tree_util.tree_unflatten(exported.in_tree,
+                                            list(exported.in_avals))
+        args = tree[0] if isinstance(tree, tuple) and len(tree) == 2 else tree
+        if isinstance(args, (list, tuple)) and args and \
+                isinstance(args[0], dict):
+            want = args[0]
+    except Exception:
+        want = None
+    if want:
+        params = {k: (v.astype(want[k].dtype)
+                      if k in want and v.dtype != want[k].dtype else v)
+                  for k, v in params.items()}
     return exported, params
 
 
