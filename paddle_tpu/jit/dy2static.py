@@ -633,7 +633,10 @@ class _PrintAssertTransformer(ast.NodeTransformer):
 
     def visit_Assert(self, node):
         self.generic_visit(node)
-        msg = node.msg if node.msg is not None else ast.Constant(value=None)
+        # msg passes as a zero-arg lambda so it is only evaluated on failure
+        # (python assert semantics: a passing assert never computes its msg)
+        msg = (ast.Lambda(args=_no_args(), body=node.msg)
+               if node.msg is not None else ast.Constant(value=None))
         call = ast.Expr(value=ast.Call(
             func=ast.Name(id="__dy2st_assert", ctx=ast.Load()),
             args=[node.test, msg], keywords=[]))
@@ -654,21 +657,27 @@ def convert_print(*args, **kwargs):
 def convert_assert(test, msg=None):
     """Runtime dispatcher for rewritten assert: traced predicates check on
     host via debug callback (reference Assert op semantics: report + halt);
-    host predicates assert normally."""
+    host predicates assert normally. `msg` arrives as a zero-arg callable
+    (lazy — only evaluated on failure, like python assert)."""
+    def _msg():
+        return msg() if callable(msg) else msg
+
     if _is_traced(test):
         def _check(ok):
             import numpy as _np
 
             ok_val = bool(_np.asarray(ok).all())
             if not ok_val:
+                m = _msg()
                 raise AssertionError(
-                    msg if msg is not None
+                    m if m is not None
                     else "Assert failed in @to_static function")
 
         jax.debug.callback(_check, _raw(test))
         return
     if not test:
-        raise AssertionError(msg if msg is not None else "")
+        m = _msg()
+        raise AssertionError(m if m is not None else "")
 
 
 def _no_args():

@@ -338,4 +338,37 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
 
 
 def class_center_sample(label, num_classes, num_samples, group=None):
-    raise NotImplementedError("class_center_sample: PS-mode op, deferred")
+    """PartialFC class-center sampling (paddle 2.x API, post-dating the
+    reference snapshot; kept for margin-softmax training).
+
+    Returns (remapped_label, sampled_class_index): every class present in
+    `label` is sampled, the rest of the num_samples budget is filled with
+    uniformly-drawn negative classes, and the sampled set is sorted
+    ascending; remapped_label re-indexes each label into that set.
+
+    TPU design: fixed [num_samples] output (XLA static shapes) via priority
+    keys — positives rank 2+u, negatives u~U[0,1), one top_k over
+    num_classes — instead of host-side rejection sampling. Deviation: the
+    reference grows the output when label holds > num_samples distinct
+    classes; here the budget is hard and over-budget positives remap to -1
+    (see PARITY.md).
+    """
+    if group not in (None, False):
+        raise ValueError(
+            "class_center_sample: process groups are not supported in this "
+            "build; shard classes with distributed.split instead")
+    if num_samples > num_classes:
+        raise ValueError("num_samples may not exceed num_classes")
+    key = default_generator().split()
+
+    def fn(l):
+        flat = l.reshape(-1).astype(jnp.int32)
+        pos = jnp.zeros((num_classes,), jnp.float32).at[flat].set(1.0)
+        prio = pos * 2.0 + jax.random.uniform(key, (num_classes,))
+        _, idx = jax.lax.top_k(prio, num_samples)
+        sampled = jnp.sort(idx.astype(jnp.int32))
+        slot = jnp.clip(jnp.searchsorted(sampled, flat), 0, num_samples - 1)
+        remapped = jnp.where(sampled[slot] == flat, slot, -1)
+        return remapped.reshape(l.shape).astype(jnp.int32), sampled
+
+    return apply(fn, _t(label).detach())

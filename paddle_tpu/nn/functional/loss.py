@@ -82,14 +82,19 @@ def cross_entropy(
                 loss = ((1 - label_smoothing) * loss
                         - label_smoothing * jnp.mean(loglf, axis=axis))
         loss = jnp.where(valid, loss, 0.0)
+        # fp32 accumulation, but return the logits dtype (reference output-
+        # dtype parity for bf16/fp16 inputs)
+        out_dtype = logits.dtype
         if w:
             wt = jnp.take(w[0], safe_ids, axis=0) * valid
             loss = loss * wt
             if reduction == "mean":
-                return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+                return (jnp.sum(loss)
+                        / jnp.maximum(jnp.sum(wt), 1e-12)).astype(out_dtype)
         if reduction == "mean":
-            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
-        return _reduce(loss, reduction)
+            return (jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(loss.dtype)), 1.0)).astype(out_dtype)
+        return _reduce(loss, reduction).astype(out_dtype)
 
     return apply(fn, *args)
 
@@ -426,6 +431,10 @@ def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
     z' in [0,1) -> (0, z'), 1+z' -> (1, z'). Loss = softplus(x) - x*z
     [+ softplus(x) - x*z' when a teacher score exists] — branchless here."""
     def fn(x, y):
+        # reference grad kernel clamps x to the soft_max bounds and zeroes dx
+        # outside them; value-preserving clamp with clip's gradient
+        xc = jnp.clip(x, soft_max_lower_bound, soft_max_up_bound)
+        x = xc + jax.lax.stop_gradient(x - xc)
         sp = jnp.maximum(x, 0) + jnp.log1p(jnp.exp(-jnp.abs(x)))
         clk = (((y >= -1.0) & (y < 0.0)) | (y >= 1.0)).astype(x.dtype)
         has_teacher = (y >= 0.0).astype(x.dtype)
@@ -506,6 +515,14 @@ def nce(input, label, weight, bias=None, num_total_classes=None,
     R = num_total_classes if num_total_classes is not None else w.shape[0]
     B = x.shape[0]
 
+    if isinstance(x._data, jax.core.Tracer):
+        import warnings
+
+        warnings.warn(
+            "nce() called under a jit trace: negative samples are drawn "
+            "host-side at trace time and BAKED into the compiled program — "
+            "every step reuses the same negatives. Build the loss eagerly "
+            "(or re-trace per epoch) to resample.", stacklevel=2)
     rng_ = np.random.RandomState(seed)
     if sampler == "uniform":
         neg = rng_.randint(0, R, size=(B, num_neg_samples))

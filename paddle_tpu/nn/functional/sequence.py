@@ -112,7 +112,21 @@ def sequence_expand(x, length_x, ref_length, name=None):
     equivalent used by the reference's main consumer (beam search / attention)
     tiles each row's sequence to the reference's length. Here: x [B, Tx, ...]
     is re-padded to [B, max(ref_length), ...] by cycling its valid steps,
-    matching sequence_expand with per-sequence repeat."""
+    matching sequence_expand with per-sequence repeat.
+
+    Deviation (PARITY.md): the output keeps x's static T — true repeat-style
+    LoD growth (output longer than T) is unsupported; eager calls with
+    ref_length > T raise instead of silently truncating."""
+    xt, lrt = _t(x), _t(ref_length).detach()
+    if not isinstance(lrt._data, jax.core.Tracer):
+        T = xt.shape[1]
+        if int(jnp.max(lrt._data)) > T:
+            raise ValueError(
+                f"sequence_expand: ref_length (max "
+                f"{int(jnp.max(lrt._data))}) exceeds x's padded length {T}; "
+                "repeat-style LoD growth is unsupported in the padded design "
+                "— re-pad x to max(ref_length) first")
+
     def fn(v, lx, lr):
         B, T = v.shape[0], v.shape[1]
         lx = jnp.maximum(lx.astype(jnp.int32), 1)
@@ -125,7 +139,7 @@ def sequence_expand(x, length_x, ref_length, name=None):
         m = _mask(T, lr, v.dtype).reshape(B, T, *([1] * (v.ndim - 2)))
         return out * m
 
-    return apply(fn, _t(x), _t(length_x).detach(), _t(ref_length).detach())
+    return apply(fn, xt, _t(length_x).detach(), lrt)
 
 
 def sequence_expand_as(x, length_x, y, ref_length, name=None):

@@ -775,6 +775,30 @@ def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
     return apply(fn, input if isinstance(input, Tensor) else Tensor(input))
 
 
+def Assert(cond, data=None, summarize=20, name=None):
+    """assert_op.cc parity (fluid.layers.Assert): halt with the tensor data
+    when `cond` is false. Traced predicates check host-side via debug
+    callback (the reference op prints `data` then throws); concrete ones
+    raise immediately."""
+    from ..jit.dy2static import convert_assert
+
+    items = list(data) if isinstance(data, (list, tuple)) else (
+        [data] if data is not None else [])
+
+    def msg():
+        shown = []
+        for d in items:
+            v = d._data if isinstance(d, Tensor) else d
+            try:
+                shown.append(str(np.asarray(v).reshape(-1)[:summarize]))
+            except Exception:  # still-traced aux data: name it, don't crash
+                shown.append(f"<traced {getattr(v, 'shape', '?')}>")
+        return "Assert failed: " + "; ".join(shown) if shown else \
+            "Assert failed"
+
+    convert_assert(cond, msg)
+
+
 class BuildStrategy:
     """Compat knobs (reference pass toggles). XLA owns fusion/layout here;
     attributes are accepted and ignored."""

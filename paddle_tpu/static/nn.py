@@ -1,5 +1,13 @@
-"""paddle.static.nn functional shims (fc, conv2d, batch_norm ...) — thin wrappers over
-paddle_tpu.nn layers for static-style code (python/paddle/static/nn/__init__.py parity)."""
+"""paddle.static.nn functional shims (fc, conv2d, batch_norm, control flow ...)
+— thin wrappers over paddle_tpu.nn layers for static-style code
+(python/paddle/static/nn/__init__.py parity: the reference's 22-name surface).
+
+Control flow (cond/case/switch_case/while_loop) dispatches through the
+dy2static runtime converters: host branches for concrete predicates,
+lax.cond/lax.switch/lax.while_loop under a trace. In a recorded static
+Program, data-dependent control flow should live inside an @to_static
+function (the record-replay executor records eager ops; a build-time python
+branch would bake one side)."""
 from .. import nn as _nn
 
 
@@ -38,3 +46,370 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
     if act:
         out = getattr(_nn.functional, act)(out)
     return out
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None,
+                     data_format="NCHW"):
+    in_c = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    layer = _nn.Conv2DTranspose(in_c, num_filters, filter_size or 1,
+                                stride=stride, padding=padding,
+                                dilation=dilation, groups=groups or 1,
+                                weight_attr=param_attr, bias_attr=bias_attr,
+                                data_format=data_format)
+    out = layer(input, output_size=output_size)
+    return getattr(_nn.functional, act)(out) if act else out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None,
+           data_format="NCDHW"):
+    in_c = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    layer = _nn.Conv3D(in_c, num_filters, filter_size, stride, padding,
+                       dilation, groups or 1, weight_attr=param_attr,
+                       bias_attr=bias_attr, data_format=data_format)
+    out = layer(input)
+    return getattr(_nn.functional, act)(out) if act else out
+
+
+def conv3d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None,
+                     data_format="NCDHW"):
+    in_c = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    layer = _nn.Conv3DTranspose(in_c, num_filters, filter_size or 1,
+                                stride=stride, padding=padding,
+                                dilation=dilation, groups=groups or 1,
+                                weight_attr=param_attr, bias_attr=bias_attr,
+                                data_format=data_format)
+    out = layer(input, output_size=output_size)
+    return getattr(_nn.functional, act)(out) if act else out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """static.nn.embedding parity: creates the table parameter in place.
+    is_sparse/is_distributed are accepted (XLA gathers are already sparse
+    lookups; the PS path owns truly distributed tables)."""
+    layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                          weight_attr=param_attr)
+    return layer(input)
+
+
+def sparse_embedding(input, size, padding_idx=None, param_attr=None,
+                     dtype="float32", **kwargs):
+    """fluid.contrib sparse_embedding (PS huge-table lookup): dense on TPU —
+    the distributed PS path serves real sparse tables (distributed/ps)."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    import paddle_tpu as _paddle
+
+    return _paddle.create_parameter(
+        shape, dtype, name=name, attr=attr, is_bias=is_bias,
+        default_initializer=default_initializer)
+
+
+def crf_decoding(input, transition, label=None, length=None, name=None):
+    """crf_decoding_op parity: viterbi argmax path under the linear-chain CRF
+    (text/viterbi.py). `transition` is the [T+2, T] parameter learned by
+    text.linear_chain_crf."""
+    from ..text.viterbi import crf_decoding as _crf
+
+    return _crf(input, transition, length=length, label=label)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None, **kwargs):
+    from ..core.tensor import Tensor
+    import numpy as np
+    import jax.numpy as jnp
+
+    c = input.shape[-1]
+    bsz = Tensor(jnp.full((c,), 1e4, jnp.float32))
+    bsum = Tensor(jnp.zeros((c,), jnp.float32))
+    bsq = Tensor(jnp.full((c,), 1e4, jnp.float32))
+    out = _nn.functional.data_norm(input, bsz, bsum, bsq)
+    return getattr(_nn.functional, act)(out) if act else out
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    from ..vision.ops import DeformConv2D as _DC
+
+    layer = _DC(input.shape[1], num_filters, filter_size, stride, padding,
+                dilation, deformable_groups, groups or 1,
+                weight_attr=param_attr, bias_attr=bias_attr)
+    return layer(input, offset, mask=mask)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = _nn.GroupNorm(groups, c, epsilon, param_attr, bias_attr)
+    out = layer(input)
+    return getattr(_nn.functional, act)(out) if act else out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    c = input.shape[1]
+    layer = _nn.InstanceNorm2D(c, epsilon=epsilon, weight_attr=param_attr,
+                               bias_attr=bias_attr)
+    return layer(input)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = list(input.shape[begin_norm_axis:])
+    layer = _nn.LayerNorm(shape, epsilon,
+                          param_attr if scale else False,
+                          bias_attr if shift else False)
+    out = layer(input)
+    return getattr(_nn.functional, act)(out) if act else out
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    dim = input.shape[-1]
+    w = create_parameter([num_total_classes, dim], attr=param_attr)
+    b = None if bias_attr is False else create_parameter(
+        [num_total_classes], attr=bias_attr, is_bias=True)
+    return _nn.functional.nce(input, label, w, bias=b,
+                              num_total_classes=num_total_classes,
+                              num_neg_samples=num_neg_samples or 10,
+                              sampler=sampler, custom_dist=custom_dist,
+                              seed=seed, sample_weight=sample_weight)
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    from ..nn import initializer as I
+
+    if mode == "all":
+        n = [1]
+    elif mode == "channel":
+        n = [x.shape[1] if data_format == "NCHW" else x.shape[-1]]
+    else:  # element: one alpha per non-batch element
+        n = list(x.shape[1:])
+    alpha = create_parameter(n, attr=param_attr,
+                             default_initializer=I.Constant(0.25))
+    if mode in ("all", "channel"):
+        return _nn.functional.prelu(x, alpha, data_format=data_format)
+    # element mode: functional.prelu only reshapes per-channel; apply the
+    # per-element alpha directly (broadcast over batch)
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+
+    return apply(lambda v, a: jnp.where(v >= 0, v, a[None] * v), x, alpha)
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    from . import py_func as _pf
+
+    return _pf(func, x, out, backward_func=backward_func,
+               skip_vars_in_backward_input=skip_vars_in_backward_input)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    c = input.shape[-1]
+    w = create_parameter([future_context_size + 1, c], attr=param_attr)
+    out = _nn.functional.row_conv(input, w)
+    return getattr(_nn.functional, act)(out) if act else out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """spectral_norm_op parity: normalize `weight` by its largest singular
+    value, estimated with `power_iters` rounds of power iteration."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+    from ..core.tensor import Tensor
+
+    def fn(w):
+        mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((mat.shape[0],), w.dtype) / jnp.sqrt(mat.shape[0])
+        for _ in range(max(1, power_iters)):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ mat @ v
+        return w / (sigma + eps)
+
+    return apply(fn, weight if isinstance(weight, Tensor) else Tensor(weight))
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    w = create_parameter([size, x.shape[-1], y.shape[-1]], attr=param_attr)
+    b = None if bias_attr is False else create_parameter(
+        [size], attr=bias_attr, is_bias=True)
+    out = _nn.functional.bilinear_tensor_product(x, y, w, b)
+    return getattr(_nn.functional, act)(out) if act else out
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head (fluid/layers/detection.py multi_box_head parity):
+    per feature map, a conv each for loc (priors*4) and conf
+    (priors*num_classes) plus its prior boxes; outputs concatenated over maps.
+    Returns (mbox_locs [N, P, 4], mbox_confs [N, P, C], boxes [P, 4],
+    variances [P, 4])."""
+    import numpy as np
+
+    from ..tensor.manipulation import concat, reshape, transpose
+    from ..vision.ops import prior_box as _prior_box
+
+    n_in = len(inputs)
+    if min_sizes is None:
+        # the reference's min/max_ratio schedule
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / (n_in - 2)) if n_in > 2 else 0
+        for ratio in range(min_ratio, max_ratio + 1, max(step, 1)):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes[:n_in - 1]
+        max_sizes = [base_size * 0.20] + max_sizes[:n_in - 1]
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ms = min_sizes[i] if isinstance(min_sizes[i], (list, tuple)) \
+            else [min_sizes[i]]
+        mx = (max_sizes[i] if isinstance(max_sizes[i], (list, tuple))
+              else [max_sizes[i]]) if max_sizes else None
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i], (list, tuple)) \
+            else [aspect_ratios[i]]
+        st = None
+        if steps is not None:
+            st = steps[i] if isinstance(steps[i], (list, tuple)) \
+                else [steps[i], steps[i]]
+        elif step_w is not None and step_h is not None:
+            st = [step_w[i], step_h[i]]
+        box, var = _prior_box(feat, image, min_sizes=ms, max_sizes=mx,
+                              aspect_ratios=ar, variance=list(variance),
+                              flip=flip, clip=clip,
+                              steps=st or [0.0, 0.0], offset=offset)
+        n_priors_cell = box.shape[2]
+        boxes_all.append(reshape(box, [-1, 4]))
+        vars_all.append(reshape(var, [-1, 4]))
+        loc = conv2d(feat, n_priors_cell * 4, kernel_size, stride=stride,
+                     padding=pad)
+        conf = conv2d(feat, n_priors_cell * num_classes, kernel_size,
+                      stride=stride, padding=pad)
+        locs.append(reshape(transpose(loc, [0, 2, 3, 1]),
+                            [loc.shape[0], -1, 4]))
+        confs.append(reshape(transpose(conf, [0, 2, 3, 1]),
+                             [conf.shape[0], -1, num_classes]))
+    return (concat(locs, axis=1), concat(confs, axis=1),
+            concat(boxes_all, axis=0), concat(vars_all, axis=0))
+
+
+# -- control flow (fluid/layers/control_flow.py parity) ----------------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """lax.cond under a trace; a host branch for concrete predicates.
+    A None branch (permitted by the reference) is a no-op returning None —
+    valid only when the other branch also returns nothing."""
+    from ..jit.dy2static import convert_ifelse
+
+    def _norm(f):
+        if f is None:
+            return lambda _s: ()
+
+        def g(_s):
+            r = f()
+            return () if r is None else r  # side-effect-only branches
+
+        return g
+
+    out = convert_ifelse(pred, _norm(true_fn), _norm(false_fn))
+    if isinstance(out, tuple) and len(out) == 0:
+        return None
+    return out[0] if isinstance(out, tuple) and len(out) == 1 else out
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-true-pred dispatch, lowered to a nested cond chain."""
+    if not pred_fn_pairs:
+        raise ValueError("case: pred_fn_pairs may not be empty")
+    (pred, fn), rest = pred_fn_pairs[0], pred_fn_pairs[1:]
+    if not rest:
+        if default is None:
+            return fn()
+        return cond(pred, fn, default)
+    return cond(pred, fn, lambda: case(rest, default=default))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """lax.switch under a trace; host dispatch for concrete indices."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    else:
+        pairs = [(i, f) if not isinstance(f, (tuple, list)) else tuple(f)
+                 for i, f in enumerate(branch_fns)]
+    keys = [k for k, _ in pairs]
+    fns = [f for _, f in pairs]
+    idx_raw = branch_index._data if isinstance(branch_index, Tensor) \
+        else branch_index
+    if not isinstance(idx_raw, jax.core.Tracer):
+        i = int(np.asarray(idx_raw))
+        if i in keys:
+            return fns[keys.index(i)]()
+        if default is None:
+            return fns[-1]()  # reference: last branch is the fallback
+        return default()
+    # traced: dense lax.switch over the key range (+1 slot for default)
+    all_fns = fns + [default if default is not None else fns[-1]]
+    lut = np.full(max(keys) + 1, len(all_fns) - 1, np.int32)
+    for pos, k in enumerate(keys):
+        lut[k] = pos
+    sel = jnp.clip(jnp.asarray(idx_raw).astype(jnp.int32), 0, max(keys))
+    sel = jnp.asarray(lut)[sel]
+    sel = jnp.where(
+        (jnp.asarray(idx_raw) < 0) | (jnp.asarray(idx_raw) > max(keys)),
+        len(all_fns) - 1, sel)
+
+    def wrap(f):
+        def g(_):
+            o = f()
+            return tuple(v._data if isinstance(v, Tensor) else v
+                         for v in (o if isinstance(o, tuple) else (o,)))
+        return g
+
+    res = jax.lax.switch(sel, [wrap(f) for f in all_fns], 0)
+    res = tuple(Tensor(r) for r in res)
+    return res[0] if len(res) == 1 else res
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """fluid.layers.while_loop parity: cond/body take *loop_vars; runs
+    lax.while_loop when the condition is traced, a host loop otherwise."""
+    from ..jit.dy2static import convert_while_loop
+
+    def _cond(carry):
+        return cond(*carry)
+
+    def _body(carry):
+        out = body(*carry)
+        return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+
+    res = convert_while_loop(_cond, _body, tuple(loop_vars))
+    return list(res)

@@ -1,4 +1,5 @@
 """paddle.text parity (python/paddle/text/datasets + viterbi/CRF ops)."""
 from . import datasets  # noqa: F401
 from .datasets import Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16, Conll05st  # noqa: F401
-from .viterbi import ViterbiDecoder, linear_chain_crf, viterbi_decode  # noqa: F401
+from .viterbi import (ViterbiDecoder, crf_decoding, linear_chain_crf,  # noqa: F401
+                      viterbi_decode)

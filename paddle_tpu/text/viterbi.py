@@ -74,6 +74,61 @@ def viterbi_decode(potentials, transition_params, lengths,
     return s, p
 
 
+def crf_decoding(emission, transition, length=None, label=None):
+    """crf_decoding_op.cc parity over the linear_chain_crf [(T+2), T]
+    transition layout (row 0 start, row 1 stop, rows 2.. the [T, T] matrix).
+    Returns the viterbi path [B, L] int64 (0 past each length); with `label`,
+    returns per-step 0/1 correctness instead, like the reference op."""
+    em = _t(emission)
+    tr = _t(transition)
+    B, L, T = em.shape
+    if length is None:
+        length = np.full((B,), L, np.int32)
+    lens = _t(length).detach()
+
+    def fn(ev, tv, lv):
+        start, stop, mat = tv[0], tv[1], tv[2:]
+        lv = lv.astype(jnp.int32)
+        init = start[None, :] + ev[:, 0]
+
+        def step(carry, t):
+            score = carry
+            cand = score[:, :, None] + mat[None, :, :]
+            best = jnp.max(cand, axis=1) + ev[:, t]
+            ptr = jnp.argmax(cand, axis=1).astype(jnp.int32)
+            live = (t < lv)[:, None]
+            new_score = jnp.where(live, best, score)
+            ptr = jnp.where(live, ptr,
+                            jnp.arange(T, dtype=jnp.int32)[None, :])
+            return new_score, ptr
+
+        score, ptrs = jax.lax.scan(step, init, jnp.arange(1, L))
+        score = score + stop[None, :]
+        last_tag = jnp.argmax(score, axis=1).astype(jnp.int32)
+
+        def back(carry, t):
+            tag = carry
+            prev = jnp.take_along_axis(ptrs[t], tag[:, None], axis=1)[:, 0]
+            return prev, prev
+
+        _, rev = jax.lax.scan(back, last_tag, jnp.arange(L - 2, -1, -1))
+        path = jnp.concatenate([rev[::-1].T, last_tag[:, None]], axis=1)
+        pos = jnp.arange(L)[None, :]
+        return jnp.where(pos < lv[:, None], path, 0).astype(jnp.int64)
+
+    p = apply(fn, em.detach(), tr.detach(), lens)
+    p.stop_gradient = True
+    if label is not None:
+        lab = _t(label).detach()
+        from ..core.dispatch import apply as _apply
+
+        ok = _apply(lambda a, b: (a == b.astype(a.dtype)).astype(jnp.int64),
+                    p, lab)
+        ok.stop_gradient = True
+        return ok
+    return p
+
+
 class ViterbiDecoder:
     """paddle.text.ViterbiDecoder parity (callable layer-style wrapper)."""
 

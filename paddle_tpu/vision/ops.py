@@ -125,6 +125,11 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400, keep_top
             )
             entry = jnp.where(keep[:, None], entry, jnp.full_like(entry, -1.0))
             all_entries.append(entry)
+        if not all_entries:
+            # every class is background (C==1 with background_label=0):
+            # the reference emits an empty LoD result; here all-(-1) padding
+            return (jnp.full((keep_top_k, 6), -1.0, boxes.dtype),
+                    jnp.zeros((), jnp.int32))
         cat = jnp.concatenate(all_entries, axis=0)
         # rank by score, take keep_top_k
         k2 = min(keep_top_k, cat.shape[0])
@@ -297,9 +302,57 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
     return Tensor(jnp.asarray(b)), Tensor(jnp.asarray(var))
 
 
-class DeformConv2D:  # registered for inventory completeness; XLA path pending
-    def __init__(self, *a, **k):
-        raise NotImplementedError("DeformConv2D: deferred (gather-based impl, round 2)")
+from ..nn.layer.layers import Layer as _Layer
+
+
+class DeformConv2D(_Layer):
+    """Deformable conv v1/v2 Layer (reference python/paddle/vision/ops.py:598).
+
+    Thin stateful wrapper over the functional `deform_conv2d` below: holds
+    weight [out, in/groups, kh, kw] (Normal(0, sqrt(2/fan_in)) like the
+    reference's default initializer) and optional bias; v2 (modulated) when
+    `mask` is passed to forward."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if in_channels % groups != 0:
+            raise ValueError("in_channels must be divisible by groups.")
+
+        def _pair(v):
+            return [v, v] if isinstance(v, int) else list(v)
+
+        from ..nn import initializer as I
+
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = _pair(kernel_size)
+        self._stride = _pair(stride)
+        self._padding = _pair(padding)
+        self._dilation = _pair(dilation)
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        filter_shape = ([out_channels, in_channels // groups]
+                        + self._kernel_size)
+        std = (2.0 / (int(np.prod(self._kernel_size)) * in_channels)) ** 0.5
+        self.weight = self.create_parameter(
+            shape=filter_shape, attr=weight_attr,
+            default_initializer=None
+            if (weight_attr and getattr(weight_attr, "initializer", None))
+            else I.Normal(0.0, std))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                shape=[out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, bias=self.bias, stride=self._stride,
+            padding=self._padding, dilation=self._dilation,
+            deformable_groups=self._deformable_groups, groups=self._groups,
+            mask=mask)
 
 
 def iou_similarity(x, y, box_normalized=True, name=None):

@@ -523,3 +523,32 @@ def test_assert_transform():
 
     with pytest.raises(Exception, match="flag off"):
         g(paddle.to_tensor(np.array([1.0], np.float32)), False)
+
+
+def test_assert_msg_lazy():
+    """ADVICE r2: a passing assert must not evaluate its msg expression
+    (python semantics); a failing one must."""
+    evals = []
+
+    def expensive():
+        evals.append(1)
+        return "boom"
+
+    @paddle.jit.to_static
+    def ok(x):
+        assert x.shape[0] > 0, expensive()
+        return x + 1
+
+    out = ok(paddle.to_tensor(np.ones(3, np.float32)))
+    np.testing.assert_allclose(np.asarray(out._data), 2.0)
+    assert evals == []  # msg never computed on the passing path
+
+    @paddle.jit.to_static
+    def bad(x):
+        assert x.shape[0] > 99, expensive()
+        return x
+
+    import pytest
+    with pytest.raises(AssertionError, match="boom"):
+        bad(paddle.to_tensor(np.ones(3, np.float32)))
+    assert evals == [1]

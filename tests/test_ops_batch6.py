@@ -534,3 +534,66 @@ def test_matrix_nms_single_background_class():
                             background_label=0)
     assert int(np.asarray(num._data)[0]) == 0
     assert (np.asarray(out._data) == -1).all()
+
+
+def test_deform_conv2d_layer_class():
+    """DeformConv2D Layer (reference python/paddle/vision/ops.py:598): wraps
+    the functional op with learned weight/bias; v1 and v2 (mask) paths."""
+    paddle.seed(0)
+    layer = V.DeformConv2D(in_channels=3, out_channels=5, kernel_size=3,
+                           padding=1)
+    assert tuple(layer.weight.shape) == (5, 3, 3, 3)
+    assert tuple(layer.bias.shape) == (5,)
+    x = paddle.to_tensor(_randn(2, 3, 8, 8))
+    off = paddle.to_tensor(np.zeros((2, 18, 8, 8), np.float32))
+    out = layer(x, off)
+    assert tuple(out.shape) == (2, 5, 8, 8)
+    # zero offsets == plain conv with the layer's own weight
+    want = np.asarray(V.deform_conv2d(
+        x, off, layer.weight, bias=layer.bias, padding=1)._data)
+    np.testing.assert_allclose(np.asarray(out._data), want, atol=1e-5)
+    # v2: mask of ones is identity
+    m = paddle.to_tensor(np.ones((2, 9, 8, 8), np.float32))
+    out2 = layer(x, off, mask=m)
+    np.testing.assert_allclose(np.asarray(out2._data), want, atol=1e-4)
+    # trains: grads reach the layer params
+    loss = layer(x, off).sum()
+    loss.backward()
+    assert np.abs(np.asarray(layer.weight.grad._data)).sum() > 0
+    # bias_attr=False drops the bias
+    nl = V.DeformConv2D(3, 5, 3, bias_attr=False)
+    assert nl.bias is None
+    # groups must divide channels
+    import pytest
+    with pytest.raises(ValueError, match="divisible"):
+        V.DeformConv2D(3, 4, 3, groups=2)
+
+
+def test_class_center_sample():
+    """PartialFC sampling: all positives kept, budget filled with negatives,
+    sampled set sorted, labels remapped into it."""
+    paddle.seed(7)
+    label = np.array([3, 11, 3, 42, 7, 11], np.int64)
+    num_classes, num_samples = 64, 16
+    remapped, sampled = F.class_center_sample(
+        paddle.to_tensor(label), num_classes, num_samples)
+    s = np.asarray(sampled._data)
+    r = np.asarray(remapped._data)
+    assert s.shape == (num_samples,) and r.shape == label.shape
+    assert (np.diff(s) > 0).all()  # sorted, distinct
+    assert (s >= 0).all() and (s < num_classes).all()
+    for cls in np.unique(label):  # every positive was sampled
+        assert cls in s
+    np.testing.assert_array_equal(s[r], label)  # remap round-trips
+    # seed-deterministic
+    paddle.seed(7)
+    r2, s2 = F.class_center_sample(paddle.to_tensor(label), num_classes,
+                                   num_samples)
+    np.testing.assert_array_equal(np.asarray(s2._data), s)
+    # all-classes budget: sampled == arange
+    paddle.seed(1)
+    _, s_all = F.class_center_sample(paddle.to_tensor(label), 8, 8)
+    np.testing.assert_array_equal(np.asarray(s_all._data), np.arange(8))
+    import pytest
+    with pytest.raises(ValueError, match="num_samples"):
+        F.class_center_sample(paddle.to_tensor(label), 8, 9)

@@ -1144,3 +1144,49 @@ def test_sequence_family_jit_parity():
         lambda a, b: F.sequence_softmax(paddle.to_tensor(a),
                                         paddle.to_tensor(b))._data)(s, ln))
     np.testing.assert_allclose(eager, jitted, rtol=1e-5)
+
+
+def test_teacher_student_loss_grad_clamps_at_bounds():
+    """ADVICE r2: reference grad kernel zeroes dx outside the soft_max
+    bounds; forward value stays unclamped."""
+    # click + teacher 0.5: loss = 2*softplus(x) - 1.5x, grad = 2*sigmoid(x)
+    # - 1.5, which is 0.5 at x=+20 UNLESS the bound clamp zeroes it
+    x = np.array([0.5, 20.0, -20.0], np.float32)
+    y = np.array([1.5, 1.5, 1.5], np.float32)
+    xt = paddle.to_tensor(x); xt.stop_gradient = False
+    out = F.teacher_student_sigmoid_loss(xt, paddle.to_tensor(y),
+                                         soft_max_up_bound=15.0,
+                                         soft_max_lower_bound=-15.0)
+    out.sum().backward()
+    g = _np(xt.grad)
+    np.testing.assert_allclose(g[0], 2 / (1 + np.exp(-0.5)) - 1.5, atol=1e-5)
+    np.testing.assert_allclose(g[1:], 0.0, atol=1e-7)  # outside bounds: dx=0
+    # forward keeps the UNCLAMPED value: 2*softplus(20) - 1.5*20 = 10
+    np.testing.assert_allclose(_np(out)[1], 10.0, atol=1e-3)
+
+
+def test_cross_entropy_returns_input_dtype():
+    """ADVICE r2: bf16 logits -> bf16 loss (fp32 accumulation inside)."""
+    import ml_dtypes
+
+    logits = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 8).astype(ml_dtypes.bfloat16))
+    label = paddle.to_tensor(np.array([1, 2, 3, 4], np.int64))
+    for reduction in ("mean", "none", "sum"):
+        out = F.cross_entropy(logits, label, reduction=reduction)
+        assert np.asarray(out._data).dtype == ml_dtypes.bfloat16, reduction
+    f32 = F.cross_entropy(paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 8).astype(np.float32)), label)
+    assert np.asarray(f32._data).dtype == np.float32
+
+
+def test_sequence_expand_rejects_overlong_ref():
+    """ADVICE r2: ref_length > padded T raises instead of truncating."""
+    import pytest
+
+    x = paddle.to_tensor(np.ones((2, 3, 4), np.float32))
+    lx = paddle.to_tensor(np.array([3, 2], np.int64))
+    with pytest.raises(ValueError, match="exceeds x's padded length"):
+        F.sequence_expand(x, lx, paddle.to_tensor(np.array([5, 2], np.int64)))
+    out = F.sequence_expand(x, lx, paddle.to_tensor(np.array([3, 3], np.int64)))
+    assert _np(out).shape == (2, 3, 4)

@@ -1,0 +1,216 @@
+"""paddle.static.nn full-surface tests (reference python/paddle/static/nn/
+__init__.py's 22-name __all__): every name exists and executes; control flow
+(cond/case/switch_case/while_loop) checks both host and traced dispatch."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+S = paddle.static.nn
+
+REFERENCE_ALL = [
+    "fc", "batch_norm", "embedding", "bilinear_tensor_product", "case",
+    "cond", "conv2d", "conv2d_transpose", "conv3d", "conv3d_transpose",
+    "create_parameter", "crf_decoding", "data_norm", "deform_conv2d",
+    "group_norm", "instance_norm", "layer_norm", "multi_box_head", "nce",
+    "prelu", "py_func", "row_conv", "spectral_norm", "switch_case",
+    "while_loop", "sparse_embedding",
+]
+
+
+def _rand(*s):
+    return paddle.to_tensor(np.random.RandomState(0).rand(*s).astype("float32"))
+
+
+def test_reference_all_names_exist():
+    missing = [n for n in REFERENCE_ALL if not hasattr(S, n)]
+    assert missing == [], missing
+
+
+class TestStaticNnOps:
+    def test_embedding_and_sparse(self):
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 0]], np.int64))
+        out = S.embedding(ids, size=[8, 5])
+        assert tuple(out.shape) == (2, 2, 5)
+        out2 = S.sparse_embedding(ids, size=[8, 5])
+        assert tuple(out2.shape) == (2, 2, 5)
+
+    def test_convs(self):
+        x = _rand(1, 3, 8, 8)
+        assert tuple(S.conv2d_transpose(x, 4, 3).shape)[1] == 4
+        v = _rand(1, 2, 4, 6, 6)
+        assert tuple(S.conv3d(v, 3, 3, padding=1).shape) == (1, 3, 4, 6, 6)
+        assert tuple(S.conv3d_transpose(v, 3, 3).shape)[1] == 3
+
+    def test_norms_and_activation(self):
+        x = _rand(2, 4, 6, 6)
+        assert tuple(S.group_norm(x, 2).shape) == (2, 4, 6, 6)
+        assert tuple(S.instance_norm(x).shape) == (2, 4, 6, 6)
+        out = S.layer_norm(x, begin_norm_axis=1, act="relu")
+        assert float(np.asarray(out._data).min()) >= 0
+        d = _rand(4, 6)
+        assert tuple(S.data_norm(d).shape) == (4, 6)
+
+    def test_param_creating_ops(self):
+        x = _rand(3, 5)
+        y = _rand(3, 7)
+        out = S.bilinear_tensor_product(x, y, size=4)
+        assert tuple(out.shape) == (3, 4)
+        p = S.prelu(_rand(2, 3, 4, 4), mode="channel")
+        assert tuple(p.shape) == (2, 3, 4, 4)
+        r = S.row_conv(_rand(2, 6, 5), future_context_size=2)
+        assert tuple(r.shape) == (2, 6, 5)
+        lab = paddle.to_tensor(np.array([[1], [2], [0]], np.int64))
+        n = S.nce(x, lab, num_total_classes=10, num_neg_samples=3)
+        assert np.isfinite(np.asarray(n._data)).all()
+
+    def test_spectral_norm_unit_sigma(self):
+        w = _rand(6, 4)
+        wn = np.asarray(S.spectral_norm(w, power_iters=20)._data)
+        s = np.linalg.svd(wn, compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, atol=1e-3)
+
+    def test_deform_conv2d_functional_form(self):
+        x = _rand(1, 3, 6, 6)
+        off = paddle.to_tensor(np.zeros((1, 18, 6, 6), np.float32))
+        m = paddle.to_tensor(np.ones((1, 9, 6, 6), np.float32))
+        out = S.deform_conv2d(x, off, m, num_filters=4, filter_size=3,
+                              padding=1)
+        assert tuple(out.shape) == (1, 4, 6, 6)
+
+    def test_crf_decoding(self):
+        T = 4
+        em = _rand(2, 5, T)
+        trans = _rand(T + 2, T)
+        path = S.crf_decoding(em, trans,
+                              length=paddle.to_tensor(
+                                  np.array([5, 3], np.int64)))
+        p = np.asarray(path._data)
+        assert p.shape == (2, 5) and (p >= 0).all() and (p < T).all()
+        assert (p[1, 3:] == 0).all()  # past-length positions zeroed
+        # label form returns 0/1 correctness (same lengths)
+        ok = S.crf_decoding(em, trans, label=path,
+                            length=paddle.to_tensor(
+                                np.array([5, 3], np.int64)))
+        assert (np.asarray(ok._data) == 1).all()
+
+    def test_multi_box_head(self):
+        feats = [_rand(1, 8, 4, 4), _rand(1, 8, 2, 2)]
+        img = _rand(1, 3, 32, 32)
+        locs, confs, boxes, vars_ = S.multi_box_head(
+            feats, img, base_size=32, num_classes=3,
+            aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90)
+        P = boxes.shape[0]
+        assert locs.shape[1] == P and confs.shape[1] == P
+        assert tuple(confs.shape)[2] == 3 and tuple(vars_.shape) == (P, 4)
+
+
+class TestControlFlow:
+    def test_cond_host(self):
+        a = _rand(2, 2)
+        out = S.cond(paddle.to_tensor(np.True_), lambda: a + 1, lambda: a - 1)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(a._data) + 1)
+
+    def test_cond_traced(self):
+        @paddle.jit.to_static
+        def f(x, flag):
+            return S.cond(flag, lambda: x * 2.0, lambda: x * 3.0)
+
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        got_t = f(x, paddle.to_tensor(np.array(True)))
+        got_f = f(x, paddle.to_tensor(np.array(False)))
+        np.testing.assert_allclose(np.asarray(got_t._data), 2.0)
+        np.testing.assert_allclose(np.asarray(got_f._data), 3.0)
+
+    def test_case_picks_first_true(self):
+        x = _rand(3)
+        out = S.case(
+            [(paddle.to_tensor(np.False_), lambda: x * 0.0),
+             (paddle.to_tensor(np.True_), lambda: x + 5.0)],
+            default=lambda: x)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(x._data) + 5.0)
+
+    def test_switch_case_host_and_default(self):
+        x = _rand(2)
+        fns = {1: lambda: x + 1.0, 3: lambda: x + 3.0}
+        out = S.switch_case(paddle.to_tensor(np.int32(3)), fns,
+                            default=lambda: x)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(x._data) + 3.0)
+        out2 = S.switch_case(paddle.to_tensor(np.int32(7)), fns,
+                             default=lambda: x - 1.0)
+        np.testing.assert_allclose(np.asarray(out2._data),
+                                   np.asarray(x._data) - 1.0)
+
+    def test_switch_case_traced(self):
+        @paddle.jit.to_static
+        def f(x, i):
+            return S.switch_case(
+                i, {0: lambda: x, 2: lambda: x * 10.0},
+                default=lambda: x * 100.0)
+
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        np.testing.assert_allclose(
+            np.asarray(f(x, paddle.to_tensor(np.int32(2)))._data), 10.0)
+        np.testing.assert_allclose(
+            np.asarray(f(x, paddle.to_tensor(np.int32(5)))._data), 100.0)
+
+    def test_while_loop_host(self):
+        i = paddle.to_tensor(np.int32(0))
+        s = paddle.to_tensor(np.float32(0))
+        i2, s2 = S.while_loop(lambda i, s: i < 5,
+                              lambda i, s: [i + 1, s + 2.0], [i, s])
+        assert int(np.asarray(i2._data)) == 5
+        np.testing.assert_allclose(np.asarray(s2._data), 10.0)
+
+    def test_while_loop_traced(self):
+        @paddle.jit.to_static
+        def f(n):
+            i = paddle.to_tensor(np.int32(0))
+            s = paddle.to_tensor(np.float32(1))
+            i, s = S.while_loop(lambda i, s: i < n,
+                                lambda i, s: [i + 1, s * 2.0], [i, s])
+            return s
+
+        out = f(paddle.to_tensor(np.int32(6)))
+        np.testing.assert_allclose(np.asarray(out._data), 64.0)
+
+    def test_assert_api(self):
+        paddle.static.Assert(paddle.to_tensor(np.True_))  # passes silently
+        with pytest.raises(AssertionError, match="Assert failed"):
+            paddle.static.Assert(paddle.to_tensor(np.False_),
+                                 data=[paddle.to_tensor(
+                                     np.array([1.5], np.float32))])
+
+
+def test_conv2d_transpose_groups_dilation_routing():
+    """Review r3: groups/dilation must land in their own slots."""
+    x = _rand(1, 4, 8, 8)
+    out = S.conv2d_transpose(x, 4, 3, groups=2, dilation=1)
+    assert tuple(out.shape)[1] == 4
+    # dilation=2 grows the output of a transpose conv; groups must not
+    d1 = S.conv2d_transpose(x, 4, 3, dilation=1).shape[-1]
+    d2 = S.conv2d_transpose(x, 4, 3, dilation=2).shape[-1]
+    assert d2 > d1, (d1, d2)
+
+
+def test_prelu_element_mode():
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 4, 4).astype(np.float32))
+    out = S.prelu(x, mode="element")
+    assert tuple(out.shape) == (2, 3, 4, 4)
+    xv = np.asarray(x._data)
+    np.testing.assert_allclose(np.asarray(out._data),
+                               np.where(xv >= 0, xv, 0.25 * xv), rtol=1e-6)
+
+
+def test_cond_none_branch():
+    """A None branch (reference-permitted) must not crash; like the
+    reference's static cond, BOTH branches are built, so a None-returning
+    fn is valid only alongside a None/omitted other branch."""
+    assert S.cond(paddle.to_tensor(np.False_), lambda: None) is None
+    assert S.cond(paddle.to_tensor(np.True_), lambda: None, None) is None
+    assert S.cond(paddle.to_tensor(np.True_), None,
+                  lambda: None) is None

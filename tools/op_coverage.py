@@ -7,7 +7,8 @@ DataLoader, quantization/ package). Prints the residual list.
 Usage: python tools/op_coverage.py
 """
 import jax; jax.config.update("jax_platforms", "cpu")
-import glob, re
+import glob, os, re, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 names = set()
 for f in glob.glob("/root/reference/paddle/fluid/operators/**/*.cc", recursive=True):
     try: t = open(f, errors="ignore").read()
@@ -84,7 +85,8 @@ ALIAS = {  # op name -> our API name
  "average_accumulates":"ModelAverage","check_finite_and_unscale":"GradScaler","update_loss_scaling":"GradScaler",
  "clip":"clip","clip_by_norm":"clip","hard_sigmoid":"hardsigmoid","hard_swish":"hardswish","hard_shrink":"hardshrink",
 }
-MODS = [paddle, F, nn, V, T, I, S, D, M, paddle.optimizer, paddle.amp, paddle.metric, paddle.static.nn]
+import paddle_tpu.vision.transforms as VTR
+MODS = [paddle, F, nn, V, T, I, S, D, M, VTR, paddle.optimizer, paddle.amp, paddle.metric, paddle.static.nn]
 def have(n):
     target = ALIAS.get(n, n)
     return any(hasattr(m, target) for m in MODS)
@@ -92,5 +94,8 @@ missing = sorted(n for n in names if not have(n))
 # infra/framework ops that are N/A by design on this architecture
 INFRA = re.compile(r"^(c_|fake_|fused_|fusion_|lookup_sparse_table|pull_|push_|quantize|dequantize|requantize|moving_average_abs_max|send|recv|listen|fetch|feed|load|save|memcpy|delete_var|get_places|enqueue|dequeue|checkpoint|prefetch|gen_nccl|gen_bkcl|nccl|ascend|heter|ref_by_trainer|rank_attention|batch_fc|pyramid_hash|filter_by_instag|tensorrt|lite_engine|run_program|seed|dgc|distributed_|split_byref|split_ids|merge_ids|split_selected_rows|merge_selected_rows|get_tensor_from_selected_rows|beam_search$|read|write_to_array|read_from_array|array_to_lod|lod_|merge_lod|split_lod|reorder_lod|max_sequence_len|shrink_rnn|rnn_memory|select_input|select_output|tensor_array|sparse_tensor_load|coalesce_tensor|share_data|update_loss|mul$|inplace_abn|sequence_)")
 core_missing = [n for n in missing if not INFRA.match(n)]
-print("reference ops:", len(names), "| unmatched:", len(missing), "| core unmatched:", len(core_missing))
-print(core_missing)
+
+if __name__ == "__main__":
+    print("reference ops:", len(names), "| unmatched:", len(missing),
+          "| core unmatched:", len(core_missing))
+    print(core_missing)
