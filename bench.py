@@ -11,7 +11,15 @@ since the reference repo publishes no absolute numbers (BASELINE.md: "published:
 The recorded number for a round lives in BENCH_r{N}.json (written by the driver);
 that file is the single source of truth — sweep locally with --sweep.
 
+Other BASELINE.md milestone configs measure standalone via --config:
+  --config resnet50   ResNet-50 @to_static-style jitted train step, imgs/s
+  --config bert_dp    BERT-base pretrain step, tokens/s
+  --config lenet      LeNet hapi Model train_batch loop, steps/s
+The default (gpt2s) run also appends an "extra" dict with a quick ResNet-50
+measurement when the chip is healthy (disable with --no-extra).
+
 Usage: python bench.py [--batch B] [--seq S] [--steps N] [--sweep]
+                       [--config gpt2s|resnet50|bert_dp|lenet] [--no-extra]
 """
 import argparse
 import json
@@ -85,6 +93,124 @@ def run_config(batch, seq, steps, quiet=False):
     return tokens_per_sec, mfu
 
 
+def run_resnet50(batch, steps, quiet=False):
+    """BASELINE config #2: ResNet-50 fwd+bwd+Momentum, imgs/s/chip."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.vision.models import resnet50
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    size = 224 if on_tpu else 32
+    if not on_tpu:
+        steps = min(steps, 2)
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    loss_layer = paddle.nn.CrossEntropyLoss()
+    mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+    trainer = SpmdTrainer(model, opt, loss_fn=loss_layer, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    imgs = paddle.to_tensor(rng.rand(batch, 3, size, size).astype(np.float32))
+    labels = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int32))
+
+    with paddle.amp.auto_cast(True, dtype="bfloat16"):
+        np.asarray(trainer.train_step(imgs, labels)._data)  # compile+sync
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(steps):
+            loss = trainer.train_step(imgs, labels)
+        np.asarray(loss._data)
+        dt = time.perf_counter() - t0
+    ips = batch * steps / dt
+    if not quiet:
+        print(f"  resnet50 batch={batch}: {ips:,.1f} imgs/s", file=sys.stderr)
+    return ips
+
+
+def run_bert(batch, seq, steps, quiet=False):
+    """BASELINE config #3: BERT-base pretrain step (MLM+NSP), tokens/s/chip."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.models import BertConfig, BertForPretraining, \
+        BertPretrainLoss
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    if not on_tpu:
+        cfg = BertConfig(vocab_size=8192, hidden_size=128, num_layers=2,
+                         num_heads=4, intermediate_size=256,
+                         max_position=max(seq, 128), dropout=0.0)
+        steps = min(steps, 2)
+    else:
+        cfg = BertConfig(dropout=0.0)  # base: 12L/768h/12heads, 512 pos
+
+    paddle.seed(0)
+    model = BertForPretraining(cfg)
+    loss_layer = BertPretrainLoss()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+    trainer = SpmdTrainer(model, opt, loss_fn=loss_layer, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    tok_type = paddle.to_tensor(np.zeros((batch, seq), np.int32))
+    mlm_labels = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+
+    with paddle.amp.auto_cast(True, dtype="bfloat16"):
+        np.asarray(trainer.train_step(ids, tok_type, mlm_labels)._data)
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(steps):
+            loss = trainer.train_step(ids, tok_type, mlm_labels)
+        np.asarray(loss._data)
+        dt = time.perf_counter() - t0
+    tps = batch * seq * steps / dt
+    if not quiet:
+        print(f"  bert batch={batch} seq={seq}: {tps:,.0f} tok/s",
+              file=sys.stderr)
+    return tps
+
+
+def run_lenet(batch, steps, quiet=False):
+    """BASELINE config #1: LeNet hapi Model train_batch loop, steps/s."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import LeNet
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    if not on_tpu:
+        steps = min(steps, 3)
+    paddle.seed(0)
+    model = paddle.Model(LeNet())
+    model.prepare(paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=model.network.parameters()),
+                  paddle.nn.CrossEntropyLoss())
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(batch, 1, 28, 28).astype(np.float32)
+    labels = rng.randint(0, 10, (batch, 1)).astype(np.int64)
+    model.train_batch([imgs], [labels])  # compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = model.train_batch([imgs], [labels])
+    dt = time.perf_counter() - t0
+    sps = steps / dt
+    if not quiet:
+        print(f"  lenet batch={batch}: {sps:,.1f} steps/s", file=sys.stderr)
+    return sps
+
+
 def _arm_watchdog(seconds=900):
     """If the TPU tunnel is wedged (device init / first compile hangs), emit a
     parseable failure line instead of hanging until the driver's kill. The
@@ -113,6 +239,10 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--sweep", action="store_true",
                     help="sweep batch/seq configs, report the best")
+    ap.add_argument("--config", default="gpt2s",
+                    choices=["gpt2s", "resnet50", "bert_dp", "lenet"])
+    ap.add_argument("--no-extra", action="store_true",
+                    help="skip the appended quick ResNet-50 measurement")
     args = ap.parse_args()
 
     # arm BEFORE backend init: a wedged tunnel hangs inside jax.devices()
@@ -125,6 +255,29 @@ def main():
     if not on_tpu:
         watchdog.cancel()
         watchdog = None
+
+    if args.config != "gpt2s":
+        if args.config == "resnet50":
+            b = args.batch or (64 if on_tpu else 4)
+            v = run_resnet50(b, args.steps, quiet=True)
+            metric, unit, base = "resnet50_train_imgs_per_sec_per_chip", \
+                "imgs/s", 170.0  # ~0.6x a V100-class ResNet-50 fp16 figure
+        elif args.config == "bert_dp":
+            b = args.batch or (16 if on_tpu else 2)
+            s = args.seq or (512 if on_tpu else 128)
+            v = run_bert(b, s, args.steps, quiet=True)
+            metric, unit, base = "bert_base_train_tokens_per_sec_per_chip", \
+                "tokens/s", BASELINE_TOKENS_PER_SEC
+        else:
+            b = args.batch or 64
+            v = run_lenet(b, args.steps, quiet=True)
+            metric, unit, base = "lenet_fit_steps_per_sec", "steps/s", 100.0
+        if watchdog is not None:
+            watchdog.cancel()
+        print(json.dumps({"metric": metric, "value": round(v, 1),
+                          "unit": unit, "vs_baseline": round(v / base, 3),
+                          "config": args.config}))
+        return
     # batch 16 was the r1 sweet spot at seq 1024 (batch 32 exceeded 16G HBM);
     # the r2 flash-attention retune cut attention HBM traffic, so when no
     # explicit --batch is given on TPU, a quick 2-config probe (6 steps each)
@@ -176,13 +329,23 @@ def main():
     tps, mfu = run_config(batch, seq, args.steps, quiet=True)
     if watchdog is not None:
         watchdog.cancel()
-    print(json.dumps({
+    line = {
         "metric": "gpt2s_train_tokens_per_sec_per_chip",
         "value": round(tps, 1),
         "unit": "tokens/s",
         "vs_baseline": round(tps / BASELINE_TOKENS_PER_SEC, 3),
         "mfu": round(mfu, 4),
-    }))
+    }
+    if on_tpu and not args.no_extra:
+        # chip proven healthy by the main measurement: append the ResNet-50
+        # milestone config (BASELINE #2) — failure must not cost the line
+        try:
+            ips = run_resnet50(64, 10, quiet=True)
+            line["extra"] = {"resnet50_train_imgs_per_sec_per_chip":
+                             round(ips, 1)}
+        except Exception as e:
+            print(f"  resnet50 extra failed ({e})", file=sys.stderr)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

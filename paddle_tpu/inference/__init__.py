@@ -55,7 +55,13 @@ def convert_to_mixed_precision(src_model, src_params, dst_model, dst_params,
     the forward under amp.auto_cast with the recorded dtype/black_list, so
     compute precision changes too; the AOT jax.export path upcasts params to
     its traced dtypes at load (static/io._load_exported), keeping it servable.
-    `black_list` entries name params/ops to keep in float32."""
+
+    `black_list` semantics (two granularities, both honored where they can
+    be): entries matching PARAM names keep those params f32 on disk; entries
+    matching OP names (the reference's semantics, e.g. 'matmul'/'softmax')
+    are forwarded to auto_cast's custom_black_list so those ops compute in
+    f32 on the Predictor's re-jit path. A param name alone does not force
+    f32 COMPUTE for ops consuming it — pass the op name for that."""
     import json
     import os
     import pickle

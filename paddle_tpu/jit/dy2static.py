@@ -663,14 +663,21 @@ def convert_assert(test, msg=None):
         return msg() if callable(msg) else msg
 
     if _is_traced(test):
+        # msg must evaluate NOW (trace time): deferring into the callback
+        # would run it on leaked tracers. Tracer-safe msgs (f-strings of
+        # shapes) work; ones needing concrete values fall back generically.
+        try:
+            m_val = _msg()
+        except Exception:
+            m_val = None
+
         def _check(ok):
             import numpy as _np
 
             ok_val = bool(_np.asarray(ok).all())
             if not ok_val:
-                m = _msg()
                 raise AssertionError(
-                    m if m is not None
+                    m_val if m_val is not None
                     else "Assert failed in @to_static function")
 
         jax.debug.callback(_check, _raw(test))

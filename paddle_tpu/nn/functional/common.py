@@ -360,6 +360,16 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     if num_samples > num_classes:
         raise ValueError("num_samples may not exceed num_classes")
     key = default_generator().split()
+    lab_t = _t(label)
+    if isinstance(lab_t._data, jax.core.Tracer) and \
+            not isinstance(key, jax.core.Tracer):
+        import warnings
+
+        warnings.warn(
+            "class_center_sample under a jit trace without a traced RNG "
+            "scope: the negative-class sample is drawn at trace time and "
+            "BAKED into the compiled program. Run inside a trainer step "
+            "(traced_rng) or eagerly to resample per step.", stacklevel=2)
 
     def fn(l):
         flat = l.reshape(-1).astype(jnp.int32)
@@ -371,4 +381,4 @@ def class_center_sample(label, num_classes, num_samples, group=None):
         remapped = jnp.where(sampled[slot] == flat, slot, -1)
         return remapped.reshape(l.shape).astype(jnp.int32), sampled
 
-    return apply(fn, _t(label).detach())
+    return apply(fn, lab_t.detach())

@@ -53,13 +53,37 @@ def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
                      param_attr=None, bias_attr=None, act=None, name=None,
                      data_format="NCHW"):
     in_c = input.shape[1] if data_format == "NCHW" else input.shape[-1]
-    layer = _nn.Conv2DTranspose(in_c, num_filters, filter_size or 1,
+    k = _derive_transpose_kernel(filter_size, output_size, input.shape[-1],
+                                 stride, padding, dilation)
+    layer = _nn.Conv2DTranspose(in_c, num_filters, k,
                                 stride=stride, padding=padding,
                                 dilation=dilation, groups=groups or 1,
                                 weight_attr=param_attr, bias_attr=bias_attr,
                                 data_format=data_format)
     out = layer(input, output_size=output_size)
     return getattr(_nn.functional, act)(out) if act else out
+
+
+def _derive_transpose_kernel(filter_size, output_size, in_size, stride,
+                             padding, dilation):
+    """Reference conv*_transpose derives the kernel from output_size when
+    filter_size is None: out = (in-1)*stride - 2*pad + dilation*(k-1) + 1."""
+    if filter_size is not None:
+        return filter_size
+    if output_size is None:
+        raise ValueError(
+            "conv transpose: one of filter_size / output_size is required")
+    o = output_size[-1] if isinstance(output_size, (list, tuple)) \
+        else output_size
+    s = stride[-1] if isinstance(stride, (list, tuple)) else stride
+    p = padding[-1] if isinstance(padding, (list, tuple)) else padding
+    d = dilation[-1] if isinstance(dilation, (list, tuple)) else dilation
+    k = (o - (in_size - 1) * s + 2 * p - 1) // d + 1
+    if k < 1:
+        raise ValueError(
+            f"conv transpose: output_size {o} unreachable from input "
+            f"{in_size} with stride {s}/padding {p}")
+    return k
 
 
 def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
@@ -78,7 +102,9 @@ def conv3d_transpose(input, num_filters, filter_size=None, output_size=None,
                      param_attr=None, bias_attr=None, act=None, name=None,
                      data_format="NCDHW"):
     in_c = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
-    layer = _nn.Conv3DTranspose(in_c, num_filters, filter_size or 1,
+    k = _derive_transpose_kernel(filter_size, output_size, input.shape[-1],
+                                 stride, padding, dilation)
+    layer = _nn.Conv3DTranspose(in_c, num_filters, k,
                                 stride=stride, padding=padding,
                                 dilation=dilation, groups=groups or 1,
                                 weight_attr=param_attr, bias_attr=bias_attr,

@@ -214,3 +214,13 @@ def test_cond_none_branch():
     assert S.cond(paddle.to_tensor(np.True_), lambda: None, None) is None
     assert S.cond(paddle.to_tensor(np.True_), None,
                   lambda: None) is None
+
+
+def test_conv2d_transpose_output_size_derives_kernel():
+    """Review r3b: filter_size=None derives the kernel from output_size
+    (reference semantics), instead of silently using k=1."""
+    x = _rand(1, 3, 8, 8)
+    out = S.conv2d_transpose(x, 4, filter_size=None, output_size=16, stride=2)
+    assert tuple(out.shape) == (1, 4, 16, 16)
+    with pytest.raises(ValueError, match="required"):
+        S.conv2d_transpose(x, 4, filter_size=None)
