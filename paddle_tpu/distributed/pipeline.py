@@ -192,11 +192,32 @@ class PipelineTrainer:
 
     def __init__(self, pre, stages, post_loss, optimizer, mesh=None,
                  pp_axis="pp", dp_axis="dp", n_micro=None,
-                 schedule_mode="1F1B", donate=True):
+                 schedule_mode="1F1B", donate=True, stage_param_specs=None):
+        """stage_param_specs: optional {stage_param_name: PartitionSpec}
+        (collect_spmd_specs of one stage) adding a TENSOR-PARALLEL axis under
+        the pipeline: stacked stage params shard P('pp', *spec) and XLA's
+        sharding propagation inserts the mp collectives inside each stage
+        tick (the shard_map is manual over pp only; dp/mp stay automatic) —
+        3-axis pp x dp x mp hybrid parallelism."""
         from .mesh import get_mesh
+
+        from .split import collect_spmd_specs
 
         self.mesh = mesh or get_mesh()
         assert pp_axis in self.mesh.axis_names, f"mesh needs a '{pp_axis}' axis"
+        self.stage_param_specs = dict(stage_param_specs or {})
+        if self.stage_param_specs:
+            known = {n for n, _ in stages[0].named_parameters()}
+            unknown = sorted(set(self.stage_param_specs) - known)
+            if unknown:
+                raise ValueError(
+                    f"stage_param_specs names no stage-0 params: {unknown} "
+                    "— pass collect_spmd_specs(stages[0]) (stage-local "
+                    "names), not full-model paths")
+        # pre/post tensor-parallel specs (vocab-parallel embedding, split lm
+        # head — the largest GPT tensors) apply automatically when present
+        self.pre_param_specs = collect_spmd_specs(pre)
+        self.post_param_specs = collect_spmd_specs(post_loss)
         self.pre = pre
         self.stage_layers = list(stages)
         self.post_loss = post_loss
@@ -238,8 +259,21 @@ class PipelineTrainer:
 
     # -- sharding placement ----------------------------------------------------
     def _sharding_for(self, name):
-        if name.startswith("stage::"):
+        grp, local = name.split("::", 1)
+        if grp == "stage":
+            spec = self.stage_param_specs.get(local)
+            if spec is not None:
+                # stacked stage param: leading pp dim + the stage-local
+                # tensor-parallel spec on the remaining dims
+                return NamedSharding(self.mesh, P(self.pp_axis, *spec))
             return NamedSharding(self.mesh, P(self.pp_axis))
+        spec = (self.pre_param_specs if grp == "pre"
+                else self.post_param_specs).get(local)
+        if spec is not None and all(
+                ax is None or ax in self.mesh.axis_names
+                for d in spec for ax in
+                ((d,) if not isinstance(d, tuple) else d)):
+            return NamedSharding(self.mesh, P(*spec))
         return NamedSharding(self.mesh, P())
 
     def _place_state(self):
@@ -303,6 +337,13 @@ class PipelineTrainer:
             mapped = jax.shard_map(spmd, mesh=self.mesh, in_specs=(specs, P()),
                                    out_specs=P(), axis_names={ax})
         except (AttributeError, TypeError):  # older jax: full-manual shard_map
+            if self.stage_param_specs:
+                import warnings
+
+                warnings.warn(
+                    "this jax lacks shard_map auto axes: the full-manual "
+                    "fallback replicates stage params over the tensor-"
+                    "parallel axis, dropping stage_param_specs sharding")
             mapped = _smap(spmd, self.mesh, in_specs=(specs, P()), out_specs=P())
         return mapped(stage_params, h_micro)
 

@@ -1,0 +1,135 @@
+"""3-axis hybrid parallelism at 16 virtual devices (VERDICT r2 #9): one
+combined pp x dp x mp pipeline train step runs finite, and ZeRO stage-2/3
+HLO carries reduce-scatter/all-gather at that scale.
+
+The suite's conftest pins 8 virtual devices, so these tests re-exec in a
+subprocess with a 16-device CPU platform."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run16(body, timeout=560):
+    script = textwrap.dedent("""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import paddle_tpu as paddle
+    """) + textwrap.dedent(body)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    env["JAX_PLATFORMS"] = ""
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    r = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=timeout)
+    assert r.returncode == 0, r.stdout + r.stderr
+    return r.stdout
+
+
+def test_pp_dp_mp_combined_step_16dev():
+    """pp=4 x dp=2 x mp=2: pipeline schedule + dp grad psum + tensor-parallel
+    stage shardings in ONE jitted train step."""
+    out = _run16("""
+        from paddle_tpu.distributed.mesh import build_mesh
+        from paddle_tpu.distributed.pipeline import PipelineTrainer
+        from paddle_tpu.distributed.split import collect_spmd_specs
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        from paddle_tpu import optimizer as popt
+
+        devices = jax.devices()
+        assert len(devices) >= 16, devices
+        mesh = build_mesh((4, 2, 2), ("pp", "dp", "mp"), devices=devices[:16])
+
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=4,
+                        num_heads=4, max_seq_len=32, dropout=0.0,
+                        tensor_parallel=True)
+        model = GPTForCausalLM(cfg)
+        pre, stages, post = model.pipeline_split(4)
+        specs = collect_spmd_specs(stages[0])
+        assert specs, "tensor-parallel stages must expose spmd specs"
+        opt = popt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+        trainer = PipelineTrainer(pre, stages, post, opt, mesh=mesh,
+                                  n_micro=4, schedule_mode="1F1B",
+                                  stage_param_specs=specs)
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, 512, (8, 32)).astype(np.int32)
+        y = rng.randint(0, 512, (8, 32)).astype(np.int32)
+        loss = float(np.asarray(trainer.train_step(x, y)._data))
+        assert np.isfinite(loss), loss
+        # a stacked stage param really is sharded over pp AND mp
+        name = next(k for k in trainer.params if k.startswith("stage::")
+                    and trainer.stage_param_specs.get(
+                        k.split("::", 1)[1]) is not None)
+        spec = trainer.p_shardings[name].spec
+        flat = [ax for d in spec if d for ax in
+                (d if isinstance(d, tuple) else (d,))]
+        assert "pp" in flat and "mp" in flat, spec
+        print("PP_DP_MP_OK", loss)
+    """)
+    assert "PP_DP_MP_OK" in out
+
+
+def test_zero_stage_hlo_collectives_16dev():
+    """dp=8 x mp=2 ZeRO: stage-2 HLO must reduce-scatter grads and stage-3
+    must all-gather params — asserted on the lowered step at 16 devices."""
+    out = _run16("""
+        from paddle_tpu.distributed.mesh import build_mesh
+        from paddle_tpu.distributed.spmd import SpmdTrainer
+        from paddle_tpu.distributed.split import collect_spmd_specs
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM, \
+            GPTPretrainLoss
+
+        devices = jax.devices()[:16]
+        mesh = build_mesh((8, 2), ("dp", "mp"), devices=devices)
+
+        def lowered(stage):
+            paddle.seed(0)
+            cfg = GPTConfig.tiny()
+            cfg.tensor_parallel = True
+            model = GPTForCausalLM(cfg)
+            loss_layer = GPTPretrainLoss()
+            opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                         parameters=model.parameters())
+            tr = SpmdTrainer(model, opt, loss_fn=loss_layer, mesh=mesh,
+                             sharding_stage=stage,
+                             extra_param_specs=collect_spmd_specs(model))
+            rng = np.random.RandomState(0)
+            ids = jax.numpy.asarray(
+                rng.randint(0, cfg.vocab_size, (16, 32)).astype(np.int32))
+            batch = [ids, ids]
+            step = tr._compiled or tr._build(batch)
+            import jax.numpy as jnp
+            lr = jnp.asarray(0.1, jnp.float32)
+            r = jax.random.key(0)
+            # post-SPMD-partitioning HLO: collectives only exist after
+            # compilation (the lowered StableHLO carries sharding annotations)
+            return step.lower(tr.params, tr.opt_state, tr.buffers, lr, r,
+                              *batch).compile().as_text()
+
+        t0 = lowered(0)
+        t2 = lowered(2)
+        t3 = lowered(3)
+        # the CPU backend lowers reduce-scatter to all-reduce+slice, so the
+        # robust cross-backend discriminator is the all-gather that sharded
+        # optimizer state (stage 2) / sharded params (stage 3) require and
+        # plain DP (stage 0) must NOT have, plus grad reduction being present
+        c0, c2, c3 = (t.count("all-gather") for t in (t0, t2, t3))
+        # mp=2 tensor parallel gathers activations at every stage, so the
+        # ZeRO evidence is the GROWTH in all-gathers: sharded opt-state
+        # (stage 2) and sharded params (stage 3) add param-reassembly
+        # gathers plain DP does not have
+        assert c2 > c0, f"stage-2 adds no param/state gathers ({c2} vs {c0})"
+        assert c3 > c0, f"stage-3 adds no param gathers ({c3} vs {c0})"
+        for name, t in (("stage-2", t2), ("stage-3", t3)):
+            assert ("reduce-scatter" in t) or ("all-reduce" in t), \
+                f"{name} HLO lacks grad reduction"
+        print("ZERO_HLO_OK", c0, c2, c3)
+    """)
+    assert "ZERO_HLO_OK" in out
