@@ -39,6 +39,15 @@ def _padding(padding, n):
     raise ValueError(f"bad padding {padding}")
 
 
+def _match_dtypes(v, w):
+    """lax conv requires matching dtypes; mixed bf16-input/f32-weight calls
+    (outside auto_cast) promote like the reference would."""
+    if v.dtype != w.dtype:
+        ct = jnp.promote_types(v.dtype, w.dtype)
+        return v.astype(ct), w.astype(ct)
+    return v, w
+
+
 def _conv(x, weight, bias, stride, padding, dilation, groups, n, channel_last, transpose=False, output_padding=0):
     strides = _ntuple(stride, n)
     dils = _ntuple(dilation, n)
@@ -54,6 +63,7 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n, channel_last, t
 
     if not transpose:
         def fn(v, w, *b):
+            v, w = _match_dtypes(v, w)
             out = jax.lax.conv_general_dilated(
                 v, w, window_strides=strides, padding=pad,
                 rhs_dilation=dils, dimension_numbers=dn, feature_group_count=groups,
@@ -69,6 +79,7 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n, channel_last, t
 
         def fn(v, w, *b):
             # conv_transpose: lhs_dilation = stride; weight layout [in, out//groups, *k]
+            v, w = _match_dtypes(v, w)
             k_dims = w.shape[2:]
             if isinstance(pad, str):
                 pads = [(0, 0)] * n if pad == "VALID" else None

@@ -23,4 +23,4 @@ from .quant_ops import (  # noqa: F401
 )
 from .imperative import ImperativeQuantAware, QuantConfig  # noqa: F401
 from .layers import QuantedConv2D, QuantedLinear  # noqa: F401
-from .ptq import PostTrainingQuantization  # noqa: F401
+from .ptq import PostTrainingQuantization, save_quantized_model  # noqa: F401

@@ -14,6 +14,22 @@ from .layers import Int8Linear
 from .quant_ops import quantize_to_int8
 
 
+def save_quantized_model(model, path_prefix, input_spec):
+    """Export a PTQ-converted model as a deployable INT8 artifact
+    (reference: slim post_training_quantization's save_quantized_model →
+    int8 program + params).
+
+    TPU-native: the Int8Linear buffers (int8 weights + dequant scales) ride
+    the standard save_inference_model path — the params npz stores the real
+    int8 arrays (4x smaller than f32) and the traced StableHLO/jax.export
+    program contains the int8 x int8 -> int32 MXU matmuls, so the AOT
+    Predictor serves int8 with no python model code. `input_spec`: list of
+    example tensors (None/-1 dims export batch-polymorphic)."""
+    from ..static.io import save_inference_model
+
+    return save_inference_model(path_prefix, input_spec, None, layer=model)
+
+
 class _Observer:
     """Range observer: plain abs_max, or a fixed-size |x| histogram whose range
     grows by proportional rebinning (memory O(hist_bins) per layer, never the

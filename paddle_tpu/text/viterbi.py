@@ -38,6 +38,16 @@ def viterbi_decode(potentials, transition_params, lengths,
         else:
             init = pv[:, 0]
 
+        if L == 1:
+            # single-step sequences: no transitions, no backtrace (a scan of
+            # length 0 would index a size-0 pointer array while tracing).
+            # zero-length rows mask their path to 0 like the L>1 tail mask
+            score = init + (tv[:, T - 1][None, :]
+                            if include_bos_eos_tag else 0.0)
+            best = jnp.argmax(score, axis=1).astype(jnp.int64)
+            best = jnp.where(lv > 0, best, 0)
+            return jnp.max(score, axis=1), best[:, None]
+
         def step(carry, t):
             score = carry                                   # [B, T]
             cand = score[:, :, None] + tv[None, :, :]       # [B, from, to]
@@ -90,6 +100,10 @@ def crf_decoding(emission, transition, length=None, label=None):
         start, stop, mat = tv[0], tv[1], tv[2:]
         lv = lv.astype(jnp.int32)
         init = start[None, :] + ev[:, 0]
+
+        if L == 1:
+            best = jnp.argmax(init + stop[None, :], axis=1).astype(jnp.int64)
+            return jnp.where(lv > 0, best, 0)[:, None]
 
         def step(carry, t):
             score = carry
