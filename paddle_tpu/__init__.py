@@ -90,24 +90,9 @@ from .nn import ParamAttr  # noqa: E402
 from .core.generator import default_generator as _defgen  # noqa: E402
 
 
-def set_printoptions(precision=None, threshold=None, edgeitems=None,
-                     sci_mode=None, linewidth=None):
-    """paddle.set_printoptions parity — delegates to numpy (Tensor repr
-    renders through numpy)."""
-    import numpy as _np
-
-    kw = {}
-    if precision is not None:
-        kw["precision"] = precision
-    if threshold is not None:
-        kw["threshold"] = threshold
-    if edgeitems is not None:
-        kw["edgeitems"] = edgeitems
-    if linewidth is not None:
-        kw["linewidth"] = linewidth
-    if sci_mode is not None:
-        kw["suppress"] = not sci_mode
-    _np.set_printoptions(**kw)
+# paddle.set_printoptions parity (reference tensor/to_string.py:34):
+# framework-local options consumed by Tensor.__repr__ — already re-exported
+# by `from .tensor import *` above; nothing to wrap.
 
 
 def create_parameter(shape, dtype="float32", name=None, attr=None,
@@ -120,6 +105,19 @@ def create_parameter(shape, dtype="float32", name=None, attr=None,
     t = Tensor(_jnp.asarray(init(list(shape), dtype)))
     t.stop_gradient = False
     return t
+
+
+def get_cudnn_version():
+    """Reference device.get_cudnn_version parity: None when no cuDNN is
+    present — always the case on TPU."""
+    return None
+
+
+def monkey_patch_variable():
+    """fluid compat no-op: Tensor operator methods are installed at import
+    (tensor/math_patch.py), so the fluid-era static-Variable patching the
+    reference runs at startup has nothing left to do here."""
+    return None
 
 
 def get_cuda_rng_state():

@@ -115,9 +115,15 @@ class Tensor:
         return self._data.shape[0]
 
     def __repr__(self):
+        try:
+            from ..tensor.to_string import array_repr
+        except ImportError:  # early-import repr before the package finishes
+            body = repr(np.asarray(self._data))
+        else:
+            body = array_repr(self._data)
         return (
             f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}, "
-            f"stop_gradient={self.stop_gradient},\n       {np.asarray(self._data)!r})"
+            f"stop_gradient={self.stop_gradient},\n       {body})"
         )
 
     def __bool__(self):

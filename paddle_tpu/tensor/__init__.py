@@ -1,5 +1,6 @@
 """paddle.tensor API family (python/paddle/tensor/__init__.py parity)."""
 from ..core.tensor import Tensor, ParamBase, to_tensor
+from .to_string import set_printoptions  # noqa: F401
 from .creation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403

@@ -1,0 +1,37 @@
+"""Tensor print options (reference python/paddle/tensor/to_string.py:34
+set_printoptions). Tensor.__repr__ renders its array through these."""
+import numpy as np
+
+_OPTIONS = {"precision": 8, "threshold": 1000, "edgeitems": 3,
+            "linewidth": 80, "sci_mode": None}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """numpy-style print options for Tensor reprs; None leaves a field as-is."""
+    for k, v in (("precision", precision), ("threshold", threshold),
+                 ("edgeitems", edgeitems), ("linewidth", linewidth)):
+        if v is not None:
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                raise TypeError(f"set_printoptions: {k} must be a "
+                                f"non-negative int, got {v!r}")
+            _OPTIONS[k] = v
+    if sci_mode is not None:
+        _OPTIONS["sci_mode"] = bool(sci_mode)
+
+
+def array_repr(arr):
+    """Render an array honoring set_printoptions (used by Tensor.__repr__)."""
+    a = np.asarray(arr)
+    if a.dtype.kind == "V":  # ml_dtypes (bf16/fp8) register as void-kind:
+        a = a.astype(np.float32)  # render through f32 so options apply
+    kw = {}
+    if np.issubdtype(a.dtype, np.floating):
+        kw["precision"] = _OPTIONS["precision"]
+        if _OPTIONS["sci_mode"] is not None:
+            kw["suppress_small"] = not _OPTIONS["sci_mode"]
+            if _OPTIONS["sci_mode"]:
+                kw["formatter"] = {"float_kind": lambda x: f"{x:.{_OPTIONS['precision']}e}"}
+    return np.array2string(a, threshold=_OPTIONS["threshold"],
+                           edgeitems=_OPTIONS["edgeitems"],
+                           max_line_width=_OPTIONS["linewidth"], **kw)

@@ -4,6 +4,7 @@ from .monitor import StatRegistry, stat_add, stat_get  # noqa: F401
 from .lazy_import import try_import  # noqa: F401
 from . import dlpack  # noqa: F401
 from . import cpp_extension  # noqa: F401
+from .op_version import OpLastCheckpointChecker  # noqa: F401
 
 
 def deprecated(since=None, update_to=None, reason=None):

@@ -1,0 +1,29 @@
+"""Op version checkpoints (reference python/paddle/utils/op_version.py:50).
+
+The reference tracks per-op attribute/IO changes across framework versions
+(core.get_op_version_map) so converters can gate on op compatibility. This
+framework has a single op surface (the jnp/lax functionals) with no version
+drift to track, so the checker is a faithful-but-empty compat: every query
+reports no pending updates."""
+
+
+class OpUpdateInfoHelper:
+    def __init__(self, info):
+        self._info = info
+
+    def verify_key_value(self, name=""):
+        return name == ""
+
+
+class OpLastCheckpointChecker:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance.raw_version_map = {}
+            cls._instance.checkpoints_map = {}
+        return cls._instance
+
+    def filter_updates(self, op_name, type=None, key=""):
+        return []

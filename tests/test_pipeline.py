@@ -192,3 +192,27 @@ def test_pipeline_sync_to_layer_roundtrip():
     for i, s in enumerate(stages):
         got = np.asarray(dict(s.named_parameters())["blocks.0.ln1.weight"]._data)
         np.testing.assert_allclose(got, host[i], rtol=1e-6)
+
+
+def test_1f1b_peak_memory_below_gpipe():
+    """VERDICT r2 #5: the 1F1B remat schedule exists to bound live memory —
+    XLA's own memory analysis must show its transient working set well under
+    GPipe's O(n_ticks) residual retention for the SAME model/config."""
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "pipeline_memory", os.path.join(repo, "tools", "pipeline_memory.py"))
+    pm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pm)
+
+    import jax
+
+    devices = jax.devices()[:4]
+    # small vocab so stage-block residuals (what the schedule bounds), not
+    # the replicated embedding/head, dominate the transient working set
+    gpipe = pm.measure("F-then-B", 4, 4, 256, 256, 8, devices, vocab=512)
+    f1b = pm.measure("1F1B", 4, 4, 256, 256, 8, devices, vocab=512)
+    assert f1b["temp_bytes"] < 0.5 * gpipe["temp_bytes"], (
+        f1b["temp_bytes"], gpipe["temp_bytes"])

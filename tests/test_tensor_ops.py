@@ -223,3 +223,28 @@ class TestRandom:
         assert 0.2 < p.numpy().mean() < 0.4
         m = paddle.multinomial(t(np.array([0.1, 0.0, 0.9], np.float32)), 50, replacement=True)
         assert set(m.numpy().tolist()) <= {0, 2}
+
+
+def test_set_printoptions_and_compat_apis():
+    """API-coverage tail: set_printoptions drives Tensor repr (framework-
+    local, numpy global state untouched); cudnn/monkey-patch/op-version
+    compat surfaces exist and answer honestly."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    before = np.get_printoptions()["threshold"]
+    try:
+        paddle.set_printoptions(precision=2, threshold=5)
+        r = repr(paddle.to_tensor(np.linspace(0, 1, 50).astype(np.float32)))
+        assert "..." in r  # summarized past the threshold
+        assert np.get_printoptions()["threshold"] == before  # numpy untouched
+    finally:
+        paddle.set_printoptions(precision=8, threshold=1000)
+    assert paddle.get_cudnn_version() is None
+    assert paddle.monkey_patch_variable() is None
+    from paddle_tpu.utils import OpLastCheckpointChecker
+
+    checker = OpLastCheckpointChecker()
+    assert checker.filter_updates("relu") == []
+    assert OpLastCheckpointChecker() is checker  # singleton like the reference

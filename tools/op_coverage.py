@@ -89,7 +89,10 @@ import paddle_tpu.vision.transforms as VTR
 MODS = [paddle, F, nn, V, T, I, S, D, M, VTR, paddle.optimizer, paddle.amp, paddle.metric, paddle.static.nn]
 def have(n):
     target = ALIAS.get(n, n)
-    return any(hasattr(m, target) for m in MODS)
+    # Tensor methods count (e.g. set_value — the reference's set_value op
+    # surfaces as Tensor.set_value in 2.x)
+    return any(hasattr(m, target) for m in MODS) or \
+        hasattr(paddle.Tensor, target)
 missing = sorted(n for n in names if not have(n))
 # infra/framework ops that are N/A by design on this architecture
 INFRA = re.compile(r"^(c_|fake_|fused_|fusion_|lookup_sparse_table|pull_|push_|quantize|dequantize|requantize|moving_average_abs_max|send|recv|listen|fetch|feed|load|save|memcpy|delete_var|get_places|enqueue|dequeue|checkpoint|prefetch|gen_nccl|gen_bkcl|nccl|ascend|heter|ref_by_trainer|rank_attention|batch_fc|pyramid_hash|filter_by_instag|tensorrt|lite_engine|run_program|seed|dgc|distributed_|split_byref|split_ids|merge_ids|split_selected_rows|merge_selected_rows|get_tensor_from_selected_rows|beam_search$|read|write_to_array|read_from_array|array_to_lod|lod_|merge_lod|split_lod|reorder_lod|max_sequence_len|shrink_rnn|rnn_memory|select_input|select_output|tensor_array|sparse_tensor_load|coalesce_tensor|share_data|update_loss|mul$|inplace_abn|sequence_)")
