@@ -1,0 +1,23 @@
+#!/bin/bash
+# One TPU session: everything we need from a healthy tunnel, sequentially in
+# ONE process chain (never two TPU clients at once — see
+# .claude/skills/verify/SKILL.md). Each step's JSON lands in /tmp.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "[tpu_session] bench (gpt2s + resnet50 extra)..." >&2
+timeout 3500 python bench.py > /tmp/tpu_bench.json 2>/tmp/tpu_bench.log
+echo "[tpu_session] bench exit=$? $(cat /tmp/tpu_bench.json 2>/dev/null)" >&2
+
+if grep -q '"metric"' /tmp/tpu_bench.json 2>/dev/null; then
+  echo "[tpu_session] pipeline memory on chip..." >&2
+  timeout 1800 python tools/pipeline_memory.py \
+    > /tmp/tpu_pipeline_memory.json 2>/tmp/tpu_pipeline_memory.log
+  echo "[tpu_session] pipmem exit=$? $(cat /tmp/tpu_pipeline_memory.json 2>/dev/null)" >&2
+
+  echo "[tpu_session] bert_dp config..." >&2
+  timeout 1800 python bench.py --config bert_dp \
+    > /tmp/tpu_bench_bert.json 2>/tmp/tpu_bench_bert.log
+  echo "[tpu_session] bert exit=$? $(cat /tmp/tpu_bench_bert.json 2>/dev/null)" >&2
+fi
+echo "[tpu_session] done" >&2
