@@ -1,0 +1,133 @@
+"""KV-cache autoregressive decoding (GPTForCausalLM.generate): the fused
+prefill+scan program must reproduce the cache-free reference decode (full
+re-forward through the model's own layer stack each step) token for token."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+
+def _model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                    max_seq_len=64, dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _reference_greedy(model, ids, n_new):
+    """Cache-free decode: full forward over the growing sequence each step."""
+    cur = np.asarray(ids)
+    for _ in range(n_new):
+        logits = model(paddle.to_tensor(cur.astype(np.int32)))
+        nxt = np.argmax(np.asarray(logits._data)[:, -1], -1)
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+    return cur
+
+
+class TestGenerate:
+    def test_greedy_matches_cache_free_reference(self):
+        model = _model()
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 128, (2, 7)).astype(np.int32)
+        want = _reference_greedy(model, ids, 9)
+        got = np.asarray(
+            model.generate(paddle.to_tensor(ids), max_new_tokens=9,
+                           temperature=0.0)._data)
+        np.testing.assert_array_equal(got, want)
+
+    def test_single_new_token(self):
+        model = _model()
+        ids = np.arange(5, dtype=np.int32)[None]
+        want = _reference_greedy(model, ids, 1)
+        got = np.asarray(model.generate(paddle.to_tensor(ids),
+                                        max_new_tokens=1,
+                                        temperature=0.0)._data)
+        np.testing.assert_array_equal(got, want)
+
+    def test_sampling_seeded_deterministic_and_varies(self):
+        model = _model()
+        ids = paddle.to_tensor(np.ones((1, 4), np.int32))
+        a = np.asarray(model.generate(ids, max_new_tokens=8, temperature=1.0,
+                                      top_k=20, seed=7)._data)
+        b = np.asarray(model.generate(ids, max_new_tokens=8, temperature=1.0,
+                                      top_k=20, seed=7)._data)
+        c = np.asarray(model.generate(ids, max_new_tokens=8, temperature=1.0,
+                                      top_k=20, seed=8)._data)
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)  # different seed, different sample
+        assert (a[:, :4] == 1).all()     # prompt preserved
+
+    def test_eos_freezes_tail(self):
+        model = _model()
+        ids = paddle.to_tensor(np.ones((1, 3), np.int32))
+        out = np.asarray(model.generate(ids, max_new_tokens=12,
+                                        temperature=0.0,
+                                        eos_token_id=int(
+                                            _first_greedy_token(model)))._data)
+        new = out[0, 3:]
+        # the first emitted token IS the eos here, so the whole tail is eos
+        assert (new == new[0]).all()
+
+    def test_rejects_overlong_and_parallel_configs(self):
+        model = _model()
+        ids = paddle.to_tensor(np.ones((1, 60), np.int32))
+        with pytest.raises(ValueError, match="max_seq_len"):
+            model.generate(ids, max_new_tokens=10)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_heads=2, max_seq_len=32, dropout=0.0,
+                        num_experts=2, moe_every=1)
+        moe = GPTForCausalLM(cfg)
+        with pytest.raises(ValueError, match="dense"):
+            moe.generate(paddle.to_tensor(np.ones((1, 4), np.int32)),
+                         max_new_tokens=2)
+
+    def test_weight_update_no_stale_cache(self):
+        """Params pass as arguments, so training between generate calls must
+        change the output without a retrace."""
+        model = _model()
+        ids = paddle.to_tensor(
+            np.random.RandomState(1).randint(0, 128, (1, 6)).astype(np.int32))
+        before = np.asarray(model.generate(ids, max_new_tokens=6,
+                                           temperature=0.0)._data)
+        for p in model.parameters():  # crude "training": perturb weights
+            p.set_value(np.asarray(p._data) * 1.5 + 0.01)
+        after = np.asarray(model.generate(ids, max_new_tokens=6,
+                                          temperature=0.0)._data)
+        want = _reference_greedy(model, np.asarray(ids._data), 6)
+        np.testing.assert_array_equal(after, want)
+        assert not np.array_equal(before, after)
+
+
+def _first_greedy_token(model):
+    ids = paddle.to_tensor(np.ones((1, 3), np.int32))
+    logits = model(ids)
+    return np.argmax(np.asarray(logits._data)[0, -1])
+
+
+def test_untied_head_after_pipeline_split():
+    """Review r3: pipeline_split installs a bias-free lm_head; generate must
+    take the untied branch without a KeyError and match the model forward."""
+    model = _model()
+    model.pipeline_split(2)  # installs model.lm_head (bias_attr=False)
+    assert getattr(model, "lm_head", None) is not None
+    ids = np.random.RandomState(3).randint(0, 128, (1, 5)).astype(np.int32)
+    want = _reference_greedy(model, ids, 4)
+    got = np.asarray(model.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                                    temperature=0.0)._data)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_generate_validates_and_greedy_keeps_rng_state():
+    model = _model()
+    ids = paddle.to_tensor(np.ones((1, 4), np.int32))
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        model.generate(ids, max_new_tokens=0)
+    from paddle_tpu.core.generator import default_generator
+
+    paddle.seed(123)
+    model.generate(ids, max_new_tokens=2, temperature=0.0)
+    offset_after = default_generator()._offset
+    assert offset_after == 0  # greedy consumed no global randomness
