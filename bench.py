@@ -12,14 +12,16 @@ The recorded number for a round lives in BENCH_r{N}.json (written by the driver)
 that file is the single source of truth — sweep locally with --sweep.
 
 Other BASELINE.md milestone configs measure standalone via --config:
-  --config resnet50   ResNet-50 @to_static-style jitted train step, imgs/s
-  --config bert_dp    BERT-base pretrain step, tokens/s
-  --config lenet      LeNet hapi Model train_batch loop, steps/s
+  --config resnet50      ResNet-50 @to_static-style jitted train step, imgs/s
+  --config bert_dp       BERT-base pretrain step, tokens/s
+  --config lenet         LeNet hapi Model train_batch loop, steps/s
+  --config gpt2s_decode  KV-cache decode, pure new-tokens/s (prefill excluded)
 The default (gpt2s) run also appends an "extra" dict with a quick ResNet-50
 measurement when the chip is healthy (disable with --no-extra).
 
 Usage: python bench.py [--batch B] [--seq S] [--steps N] [--sweep]
-                       [--config gpt2s|resnet50|bert_dp|lenet] [--no-extra]
+                       [--config gpt2s|resnet50|bert_dp|lenet|gpt2s_decode]
+                       [--no-extra]
 """
 import argparse
 import json
@@ -39,22 +41,30 @@ def _model_flops_per_token(cfg):
     return 6 * n_params + attn
 
 
+def _gpt2s_cfg(on_tpu, seq):
+    """The benchmark's GPT-2-small config (CPU runs shrink it to stay
+    tractable) — single source for the train AND decode configs."""
+    from paddle_tpu.models import GPTConfig
+
+    if not on_tpu:
+        return GPTConfig(vocab_size=8192, hidden_size=256, num_layers=4,
+                         num_heads=8, max_seq_len=seq, dropout=0.0)
+    return GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                     num_heads=12, max_seq_len=seq, dropout=0.0)
+
+
 def run_config(batch, seq, steps, quiet=False):
     import jax
 
     import paddle_tpu as paddle
     from paddle_tpu.distributed.mesh import build_mesh
     from paddle_tpu.distributed.spmd import SpmdTrainer
-    from paddle_tpu.models import GPTConfig, GPTForCausalLM, GPTPretrainLoss
+    from paddle_tpu.models import GPTForCausalLM, GPTPretrainLoss
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    cfg = _gpt2s_cfg(on_tpu, seq)
     if not on_tpu:  # keep the CPU fallback tractable
-        cfg = GPTConfig(vocab_size=8192, hidden_size=256, num_layers=4,
-                        num_heads=8, max_seq_len=seq, dropout=0.0)
         steps = min(steps, 3)
-    else:
-        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
-                        num_heads=12, max_seq_len=seq, dropout=0.0)
 
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
@@ -211,6 +221,48 @@ def run_lenet(batch, steps, quiet=False):
     return sps
 
 
+def run_decode(batch, steps, quiet=False):
+    """Serving-side metric: KV-cache decode, PURE new-tokens/s/chip (GPT-2
+    small, prompt 128, greedy). Prefill time is excluded by differencing a
+    max_new_tokens=1 run against the full run at identical reps."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    cfg = _gpt2s_cfg(on_tpu, 1024 if on_tpu else 512)
+    new_tokens = 256 if on_tpu else 32
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, 128)).astype(np.int32))
+    reps = max(1, steps // 4)
+
+    def timed(n):
+        np.asarray(model.generate(ids, max_new_tokens=n,
+                                  temperature=0.0)._data)  # compile + warm
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(reps):
+            out = model.generate(ids, max_new_tokens=n, temperature=0.0)
+        np.asarray(out._data)
+        return time.perf_counter() - t0
+
+    dt_full = timed(new_tokens)
+    dt_prefill = timed(1)  # prefill + a single decode step
+    decode_dt = max(dt_full - dt_prefill, 1e-9)
+    tps = batch * (new_tokens - 1) * reps / decode_dt
+    if not quiet:
+        print(f"  decode batch={batch}: {tps:,.0f} new tok/s "
+              f"(full {dt_full:.2f}s, prefill {dt_prefill:.2f}s)",
+              file=sys.stderr)
+    return tps
+
+
 def _arm_watchdog(seconds=900):
     """If the TPU tunnel is wedged (device init / first compile hangs), emit a
     parseable failure line instead of hanging until the driver's kill. The
@@ -240,7 +292,8 @@ def main():
     ap.add_argument("--sweep", action="store_true",
                     help="sweep batch/seq configs, report the best")
     ap.add_argument("--config", default="gpt2s",
-                    choices=["gpt2s", "resnet50", "bert_dp", "lenet"])
+                    choices=["gpt2s", "resnet50", "bert_dp", "lenet",
+                             "gpt2s_decode"])
     ap.add_argument("--no-extra", action="store_true",
                     help="skip the appended quick ResNet-50 measurement")
     args = ap.parse_args()
@@ -268,6 +321,11 @@ def main():
             v = run_bert(b, s, args.steps, quiet=True)
             metric, unit, base = "bert_base_train_tokens_per_sec_per_chip", \
                 "tokens/s", BASELINE_TOKENS_PER_SEC
+        elif args.config == "gpt2s_decode":
+            b = args.batch or (8 if on_tpu else 2)
+            v = run_decode(b, args.steps, quiet=True)
+            metric, unit, base = "gpt2s_decode_new_tokens_per_sec_per_chip", \
+                "tokens/s", 1000.0  # ~A100-class HF GPT-2 batch decode proxy
         else:
             b = args.batch or 64
             v = run_lenet(b, args.steps, quiet=True)

@@ -19,5 +19,10 @@ if grep -q '"metric"' /tmp/tpu_bench.json 2>/dev/null; then
   timeout 1800 python bench.py --config bert_dp \
     > /tmp/tpu_bench_bert.json 2>/tmp/tpu_bench_bert.log
   echo "[tpu_session] bert exit=$? $(cat /tmp/tpu_bench_bert.json 2>/dev/null)" >&2
+
+  echo "[tpu_session] decode config..." >&2
+  timeout 1800 python bench.py --config gpt2s_decode \
+    > /tmp/tpu_bench_decode.json 2>/tmp/tpu_bench_decode.log
+  echo "[tpu_session] decode exit=$? $(cat /tmp/tpu_bench_decode.json 2>/dev/null)" >&2
 fi
 echo "[tpu_session] done" >&2
