@@ -227,12 +227,19 @@ class GPTForCausalLM(nn.Layer):
         return loss
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_k=None, seed=None, eos_token_id=None):
+                 top_k=None, seed=None, eos_token_id=None, num_beams=1,
+                 length_penalty=1.0):
         """Autoregressive decode with a KV cache, compiled as ONE program
         (prefill + lax.scan; static shapes, dynamic_update_slice cache).
-        temperature=0 decodes greedily; otherwise samples (top_k optional).
-        Returns [b, prompt + max_new_tokens] token ids including the prompt.
-        See _gpt_generate for the TPU design notes."""
+        temperature=0 decodes greedily; otherwise samples (top_k optional);
+        num_beams>1 runs beam search and returns a (sequences, scores)
+        pair — the best beam per batch row plus its joint log-prob
+        (PaddleNLP generate convention).
+        Sequences are [b, prompt + max_new_tokens] ids including the prompt.
+        See _gpt_generate/_gpt_beam_search for the TPU design notes."""
+        if num_beams > 1:
+            return _gpt_beam_search(self, input_ids, max_new_tokens,
+                                    num_beams, eos_token_id, length_penalty)
         return _gpt_generate(self, input_ids, max_new_tokens, temperature,
                              top_k, seed, eos_token_id)
 
@@ -263,44 +270,17 @@ class GPTPretrainLoss(nn.Layer):
 # Autoregressive decoding with a KV cache (the serving path).
 # ---------------------------------------------------------------------------
 
-def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
-                  seed, eos_token_id):
-    """TPU-native autoregressive decode: ONE jitted program — prefill plus a
-    lax.scan over decode steps against a static-shape KV cache updated with
-    dynamic_update_slice. No per-step retrace, no dynamic shapes; the decode
-    math is a pure-jnp mirror of the dense layer stack (parity against the
-    cache-free full forward is pinned by tests/test_gpt_generate.py).
-
-    Reference analog: the reference serves decoding via BeamSearchDecoder/
-    dynamic_decode (which this framework also has); a fused single-program
-    KV-cache loop is the TPU-idiomatic form."""
+def _decode_fns(cfg, untied, untied_bias):
+    """Pure-jnp decode math shared by sampling and beam search: returns
+    (fwd, logits_of). fwd(p, tok_ids [B, t], pos, kc, vc) runs the block
+    stack with the KV cache [L, B, H, T, hd] (B is read from the input, so
+    beam-expanded batches reuse the same functions)."""
     import jax
     import jax.numpy as jnp
 
-    cfg = model.cfg
-    if cfg.num_experts > 0 or cfg.sequence_parallel or cfg.tensor_parallel:
-        raise ValueError(
-            "generate() decodes dense single-replica configs; for parallel "
-            "variants run the dense copy of the trained weights (state_dict "
-            "round-trips) or use BeamSearchDecoder/dynamic_decode")
-
-    ids = input_ids._data if isinstance(input_ids, Tensor) else \
-        jnp.asarray(np.asarray(input_ids))
-    b, s0 = ids.shape
-    if max_new_tokens < 1:
-        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-    T = s0 + max_new_tokens
-    if T > cfg.max_seq_len:
-        raise ValueError(f"prompt {s0} + max_new_tokens {max_new_tokens} "
-                         f"exceeds max_seq_len {cfg.max_seq_len}")
     L, Hh = cfg.num_layers, cfg.num_heads
     hd = cfg.hidden_size // Hh
     scale = 1.0 / math.sqrt(hd)
-    untied = getattr(model, "lm_head", None) is not None
-
-    params = {n: p._data for n, p in model.named_parameters()}
-    # pipeline_split installs the head with bias_attr=False: no bias param
-    untied_bias = untied and "lm_head.bias" in params
 
     def ln(x, w, bb):
         mu = jnp.mean(x, -1, keepdims=True)
@@ -308,14 +288,14 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
         return (x - mu) / jnp.sqrt(var + 1e-5) * w + bb
 
     def block(p, i, x, kc, vc, pos):
-        """x [b, t, h] starting at absolute position `pos`; kc/vc
-        [L, b, H, T, hd]. Returns (x, kc, vc)."""
+        """x [B, t, h] starting at absolute position `pos`."""
         pre = f"gpt.blocks.{i}."
-        t = x.shape[1]
+        bb, t = x.shape[0], x.shape[1]
+        T = kc.shape[3]
         h_in = ln(x, p[pre + "ln1.weight"], p[pre + "ln1.bias"])
         qkv = h_in @ p[pre + "attn.qkv.weight"] + p[pre + "attn.qkv.bias"]
-        qkv = qkv.reshape(b, t, 3, Hh, hd)
-        q = jnp.moveaxis(qkv[:, :, 0], 1, 2)          # [b, H, t, hd]
+        qkv = qkv.reshape(bb, t, 3, Hh, hd)
+        q = jnp.moveaxis(qkv[:, :, 0], 1, 2)          # [B, H, t, hd]
         k = jnp.moveaxis(qkv[:, :, 1], 1, 2)
         v = jnp.moveaxis(qkv[:, :, 2], 1, 2)
         kc = jax.lax.dynamic_update_slice(kc, k[None], (i, 0, 0, pos, 0))
@@ -329,7 +309,7 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
         att = jnp.where(mask[None, None], att, -jnp.inf)
         att = jax.nn.softmax(att, axis=-1)
         out = jnp.einsum("bhtT,bhTd->bhtd", att, vc[i])
-        out = jnp.moveaxis(out, 1, 2).reshape(b, t, Hh * hd)
+        out = jnp.moveaxis(out, 1, 2).reshape(bb, t, Hh * hd)
         x = x + out @ p[pre + "attn.proj.weight"] + p[pre + "attn.proj.bias"]
         h2 = ln(x, p[pre + "ln2.weight"], p[pre + "ln2.bias"])
         h2 = jax.nn.gelu(h2 @ p[pre + "mlp.fc1.weight"]
@@ -351,6 +331,58 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
         for i in range(L):
             x, kc, vc = block(p, i, x, kc, vc, pos)
         return x, kc, vc
+
+    return fwd, logits_of
+
+
+def _check_decode_config(cfg):
+    if cfg.num_experts > 0 or cfg.sequence_parallel or cfg.tensor_parallel:
+        raise ValueError(
+            "generate() decodes dense single-replica configs; for parallel "
+            "variants run the dense copy of the trained weights (state_dict "
+            "round-trips) or use BeamSearchDecoder/dynamic_decode")
+
+
+def _decode_setup(model, input_ids, max_new_tokens):
+    import jax.numpy as jnp
+
+    cfg = model.cfg
+    _check_decode_config(cfg)
+    ids = input_ids._data if isinstance(input_ids, Tensor) else \
+        jnp.asarray(np.asarray(input_ids))
+    b, s0 = ids.shape
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    T = s0 + max_new_tokens
+    if T > cfg.max_seq_len:
+        raise ValueError(f"prompt {s0} + max_new_tokens {max_new_tokens} "
+                         f"exceeds max_seq_len {cfg.max_seq_len}")
+    untied = getattr(model, "lm_head", None) is not None
+    params = {n: p._data for n, p in model.named_parameters()}
+    # pipeline_split installs the head with bias_attr=False: no bias param
+    untied_bias = untied and "lm_head.bias" in params
+    return cfg, ids, b, s0, T, untied, untied_bias, params
+
+
+def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
+                  seed, eos_token_id):
+    """TPU-native autoregressive decode: ONE jitted program — prefill plus a
+    lax.scan over decode steps against a static-shape KV cache updated with
+    dynamic_update_slice. No per-step retrace, no dynamic shapes; the decode
+    math is a pure-jnp mirror of the dense layer stack (parity against the
+    cache-free full forward is pinned by tests/test_gpt_generate.py).
+
+    Reference analog: the reference serves decoding via BeamSearchDecoder/
+    dynamic_decode (which this framework also has); a fused single-program
+    KV-cache loop is the TPU-idiomatic form."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg, ids, b, s0, T, untied, untied_bias, params = _decode_setup(
+        model, input_ids, max_new_tokens)
+    L, Hh = cfg.num_layers, cfg.num_heads
+    hd = cfg.hidden_size // Hh
+    fwd, logits_of = _decode_fns(cfg, untied, untied_bias)
 
     def pick(logits, key):
         if temperature == 0.0:
@@ -402,6 +434,110 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
     out = store[cache_key](params, ids, key)
     full = jnp.concatenate([ids.astype(out.dtype), out], axis=1)
     return Tensor(full)
+
+
+def _gpt_beam_search(model, input_ids, max_new_tokens, num_beams,
+                     eos_token_id, length_penalty):
+    """Beam search over the same fused KV-cache program: prefill once at
+    batch b, tile the cache per beam ([L, b*K, H, T, hd]), and lax.scan
+    steps that (a) add log-probs, (b) take the joint top-K over K*V
+    continuations, (c) reorder the cache by surviving parent beam, and
+    (d) record (token, parent) for the reverse-scan backtrace. Finished
+    beams (eos) only continue with eos at zero added log-prob. Scores are
+    length-normalized by (new_len ** length_penalty) at the final pick."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg, ids, b, s0, T, untied, untied_bias, params = _decode_setup(
+        model, input_ids, max_new_tokens)
+    if num_beams < 2:
+        raise ValueError("num_beams must be >= 2 for beam search")
+    if num_beams > cfg.vocab_size:
+        raise ValueError(f"num_beams ({num_beams}) cannot exceed "
+                         f"vocab_size ({cfg.vocab_size})")
+    L, Hh = cfg.num_layers, cfg.num_heads
+    hd = cfg.hidden_size // Hh
+    K, V = num_beams, cfg.vocab_size
+    fwd, logits_of = _decode_fns(cfg, untied, untied_bias)
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+
+    def run(p, ids_):
+        kc = jnp.zeros((L, b, Hh, T, hd), jnp.float32)
+        vc = jnp.zeros_like(kc)
+        x, kc, vc = fwd(p, ids_, 0, kc, vc)
+        logp0 = jax.nn.log_softmax(logits_of(p, x[:, -1]), -1)   # [b, V]
+        scores, tok = jax.lax.top_k(logp0, K)                    # [b, K]
+        tok = tok.astype(jnp.int32)
+        done = tok == eos
+        # tile cache per beam: batch-major layout [b*K] = (b0k0, b0k1, ...)
+        kc = jnp.repeat(kc, K, axis=1)
+        vc = jnp.repeat(vc, K, axis=1)
+        batch_base = (jnp.arange(b) * K)[:, None]                # [b, 1]
+
+        gen_len = jnp.ones_like(scores)  # per-beam generated length
+
+        def step(carry, i):
+            tok, scores, done, gen_len, kc, vc = carry
+            x, kc, vc = fwd(p, tok.reshape(b * K, 1), s0 + i - 1, kc, vc)
+            logp = jax.nn.log_softmax(
+                logits_of(p, x[:, 0]), -1).reshape(b, K, V)
+            # finished beams: only eos continues, at no cost
+            if eos >= 0:
+                frozen = jnp.full((V,), -jnp.inf).at[eos].set(0.0)
+                logp = jnp.where(done[:, :, None], frozen[None, None], logp)
+            total = scores[:, :, None] + logp                    # [b, K, V]
+            scores, sel = jax.lax.top_k(total.reshape(b, K * V), K)
+            parent = (sel // V).astype(jnp.int32)                # [b, K]
+            tok = (sel % V).astype(jnp.int32)
+            parent_done = jnp.take_along_axis(done, parent, axis=1)
+            # a beam that was already finished keeps its length; live ones
+            # grow to i+1 tokens (GNMT length normalization needs this)
+            gen_len = jnp.where(parent_done,
+                                jnp.take_along_axis(gen_len, parent, axis=1),
+                                i + 1.0) \
+                if eos >= 0 else gen_len + 1.0
+            done = parent_done | (tok == eos) \
+                if eos >= 0 else jnp.zeros_like(tok, bool)
+            # reorder beam-expanded cache rows by surviving parent
+            rows = (batch_base + parent).reshape(-1)             # [b*K]
+            kc = kc[:, rows]
+            vc = vc[:, rows]
+            return (tok, scores, done, gen_len, kc, vc), (tok, parent)
+
+        init_tok, init_scores, init_done = tok, scores, done
+        if max_new_tokens == 1:
+            best = jnp.argmax(init_scores, -1)
+            return jnp.take_along_axis(init_tok, best[:, None], 1), \
+                jnp.take_along_axis(init_scores, best[:, None], 1)[:, 0]
+        (tok, scores, done, gen_len, _, _), (toks, parents) = jax.lax.scan(
+            step, (init_tok, init_scores, init_done, gen_len, kc, vc),
+            jnp.arange(1, max_new_tokens))
+        # GNMT-style final pick: each beam normalized by ITS generated
+        # length (eos-frozen beams keep their shorter length)
+        norm = scores / (gen_len ** length_penalty)
+        best = jnp.argmax(norm, -1)                              # [b]
+        final_score = jnp.take_along_axis(scores, best[:, None], 1)[:, 0]
+
+        # backtrace: walk parents from the last step down to the prefill pick
+        def back(beam, t):
+            tk = jnp.take_along_axis(toks[t], beam[:, None], 1)[:, 0]
+            beam = jnp.take_along_axis(parents[t], beam[:, None], 1)[:, 0]
+            return beam, tk
+
+        beam, rev = jax.lax.scan(back, best,
+                                 jnp.arange(max_new_tokens - 2, -1, -1))
+        first = jnp.take_along_axis(init_tok, beam[:, None], 1)  # [b, 1]
+        seq = jnp.concatenate([first, rev.T[:, ::-1]], axis=1)
+        return seq, final_score
+
+    cache_key = ("beam", b, s0, max_new_tokens, K, eos, untied, untied_bias,
+                 float(length_penalty))
+    store = model.__dict__.setdefault("_generate_compiled", {})
+    if cache_key not in store:
+        store[cache_key] = jax.jit(run)
+    out, score = store[cache_key](params, ids)
+    full = jnp.concatenate([ids.astype(out.dtype), out], axis=1)
+    return Tensor(full), Tensor(score)
 
 
 # ---------------------------------------------------------------------------
