@@ -17,3 +17,4 @@ from .gpt import (  # noqa: F401
     gpt2_medium,
     gpt2_small,
 )
+from .hf_bridge import gpt2_from_huggingface  # noqa: F401

@@ -24,7 +24,8 @@ class GPTConfig:
                  tensor_parallel=False, use_flash=True,
                  num_experts=0, moe_every=2, moe_k=2, moe_capacity_factor=2.0,
                  moe_aux_weight=0.01, moe_mesh=None,
-                 sequence_parallel=False, sp_mesh=None, sp_impl="ring"):
+                 sequence_parallel=False, sp_mesh=None, sp_impl="ring",
+                 gelu_approx=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -34,6 +35,8 @@ class GPTConfig:
         self.dropout = dropout
         self.tensor_parallel = tensor_parallel
         self.use_flash = use_flash
+        # tanh-approximate gelu (HF GPT-2's gelu_new); False = exact erf
+        self.gelu_approx = gelu_approx
         # MoE (num_experts > 0 turns every `moe_every`-th block's MLP into a
         # MoELayer; moe_mesh with an 'ep' axis enables expert parallelism)
         if num_experts > 0 and not (1 <= moe_every <= num_layers):
@@ -143,9 +146,10 @@ class GPTMLP(nn.Layer):
         else:
             self.fc1 = nn.Linear(h, i)
             self.fc2 = nn.Linear(i, h)
+        self._gelu_approx = getattr(cfg, "gelu_approx", False)
 
     def forward(self, x):
-        return self.fc2(F.gelu(self.fc1(x)))
+        return self.fc2(F.gelu(self.fc1(x), approximate=self._gelu_approx))
 
 
 class GPTBlock(nn.Layer):
@@ -313,7 +317,8 @@ def _decode_fns(cfg, untied, untied_bias):
         x = x + out @ p[pre + "attn.proj.weight"] + p[pre + "attn.proj.bias"]
         h2 = ln(x, p[pre + "ln2.weight"], p[pre + "ln2.bias"])
         h2 = jax.nn.gelu(h2 @ p[pre + "mlp.fc1.weight"]
-                         + p[pre + "mlp.fc1.bias"], approximate=False)
+                         + p[pre + "mlp.fc1.bias"],
+                         approximate=getattr(cfg, "gelu_approx", False))
         x = x + h2 @ p[pre + "mlp.fc2.weight"] + p[pre + "mlp.fc2.bias"]
         return x, kc, vc
 

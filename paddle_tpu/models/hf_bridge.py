@@ -1,0 +1,81 @@
+"""HuggingFace transformers interop: convert a GPT2LMHeadModel into this
+framework's GPTForCausalLM (the migration path for users with existing
+torch GPT-2 checkpoints).
+
+Layout notes (verified against transformers' GPT2 state_dict):
+ * HF Conv1D stores weights [in, out] — identical to this framework's
+   Linear, so qkv/proj/fc weights copy without transpose.
+ * c_attn packs [Q|K|V] along the output dim in that order, matching
+   GPTAttention's reshape([b, s, 3, H, hd]).
+ * HF GPT-2 uses the tanh-approximate gelu ("gelu_new"): the converted
+   config sets gelu_approx=True so logits match bit-for-tolerance
+   (tests/test_hf_bridge.py pins parity against the torch forward).
+"""
+import numpy as np
+
+from .gpt import GPTConfig, GPTForCausalLM
+
+
+def gpt2_from_huggingface(hf_model=None, model_name=None, dtype="float32"):
+    """Build a GPTForCausalLM carrying the weights of a transformers
+    GPT2LMHeadModel.
+
+    Pass an instantiated `hf_model` (offline-safe), or `model_name` to let
+    transformers resolve it (requires the checkpoint in the local HF cache —
+    this image has no network egress)."""
+    if hf_model is None:
+        if model_name is None:
+            raise ValueError("pass hf_model= or model_name=")
+        from transformers import GPT2LMHeadModel
+
+        hf_model = GPT2LMHeadModel.from_pretrained(model_name)
+    hc = hf_model.config
+    act = getattr(hc, "activation_function", "gelu_new")
+    if act in ("gelu_new", "gelu_pytorch_tanh"):
+        gelu_approx = True
+    elif act == "gelu":
+        gelu_approx = False
+    else:
+        raise ValueError(f"unsupported activation_function {act!r}; this "
+                         "bridge maps gelu_new/gelu_pytorch_tanh/gelu only")
+    cfg = GPTConfig(vocab_size=hc.vocab_size, hidden_size=hc.n_embd,
+                    num_layers=hc.n_layer, num_heads=hc.n_head,
+                    max_seq_len=hc.n_positions,
+                    intermediate_size=getattr(hc, "n_inner", None)
+                    or 4 * hc.n_embd,
+                    dropout=0.0, gelu_approx=gelu_approx)
+    model = GPTForCausalLM(cfg)
+
+    sd = {k: v.detach().cpu().numpy().astype(dtype)
+          for k, v in hf_model.state_dict().items()}
+    ours = dict(model.named_parameters())
+
+    def put(name, arr):
+        t = ours[name]
+        if tuple(t.shape) != tuple(arr.shape):
+            raise ValueError(f"{name}: shape {tuple(arr.shape)} != "
+                             f"{tuple(t.shape)}")
+        t.set_value(arr)
+
+    put("gpt.wte.weight", sd["transformer.wte.weight"])
+    put("gpt.wpe.weight", sd["transformer.wpe.weight"])
+    for i in range(cfg.num_layers):
+        hf = f"transformer.h.{i}."
+        us = f"gpt.blocks.{i}."
+        put(us + "ln1.weight", sd[hf + "ln_1.weight"])
+        put(us + "ln1.bias", sd[hf + "ln_1.bias"])
+        put(us + "attn.qkv.weight", sd[hf + "attn.c_attn.weight"])
+        put(us + "attn.qkv.bias", sd[hf + "attn.c_attn.bias"])
+        put(us + "attn.proj.weight", sd[hf + "attn.c_proj.weight"])
+        put(us + "attn.proj.bias", sd[hf + "attn.c_proj.bias"])
+        put(us + "ln2.weight", sd[hf + "ln_2.weight"])
+        put(us + "ln2.bias", sd[hf + "ln_2.bias"])
+        put(us + "mlp.fc1.weight", sd[hf + "mlp.c_fc.weight"])
+        put(us + "mlp.fc1.bias", sd[hf + "mlp.c_fc.bias"])
+        put(us + "mlp.fc2.weight", sd[hf + "mlp.c_proj.weight"])
+        put(us + "mlp.fc2.bias", sd[hf + "mlp.c_proj.bias"])
+    put("gpt.ln_f.weight", sd["transformer.ln_f.weight"])
+    put("gpt.ln_f.bias", sd["transformer.ln_f.bias"])
+    # lm_head ties to wte in HF GPT-2 exactly like this framework's tied head
+    model.eval()
+    return model
