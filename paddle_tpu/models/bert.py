@@ -11,7 +11,7 @@ from ..nn import functional as F
 class BertConfig:
     def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12, num_heads=12,
                  intermediate_size=3072, max_position=512, type_vocab_size=2,
-                 dropout=0.1):
+                 dropout=0.1, layer_norm_eps=1e-12):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -20,6 +20,7 @@ class BertConfig:
         self.max_position = max_position
         self.type_vocab_size = type_vocab_size
         self.dropout = dropout
+        self.layer_norm_eps = layer_norm_eps  # BERT convention (HF parity)
 
     @staticmethod
     def base():
@@ -46,8 +47,11 @@ class BertEmbeddings(nn.Layer):
         s = input_ids.shape[1]
         pos = arange(s, dtype="int64")
         x = self.word(input_ids) + self.position(pos)
-        if token_type_ids is not None:
-            x = x + self.token_type(token_type_ids)
+        if token_type_ids is None:
+            # BERT semantics: absent segment ids mean segment 0, whose
+            # embedding still contributes (trained checkpoints rely on it)
+            token_type_ids = zeros_like(input_ids)
+        x = x + self.token_type(token_type_ids)
         return self.drop(self.ln(x))
 
 
@@ -62,6 +66,13 @@ class BertModel(nn.Layer):
         )
         self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_layers)
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        # thread the config's LayerNorm epsilon through every norm (the
+        # encoder-layer API has no eps knob; rebuilt models keep parity
+        # because the eps rides BertConfig, not a post-hoc patch)
+        eps = getattr(cfg, "layer_norm_eps", 1e-12)
+        for _, sub in self.named_sublayers(include_self=True):
+            if isinstance(sub, nn.LayerNorm):
+                sub._epsilon = eps
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         x = self.embeddings(input_ids, token_type_ids)

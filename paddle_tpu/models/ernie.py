@@ -53,14 +53,19 @@ class ErnieEmbeddings(nn.Layer):
         self.drop = nn.Dropout(cfg.dropout)
 
     def forward(self, input_ids, token_type_ids=None, task_type_ids=None):
-        from ..tensor.creation import arange
+        from ..tensor.creation import arange, zeros_like
 
         s = input_ids.shape[1]
         pos = arange(s, dtype="int64")
         x = self.word(input_ids) + self.position(pos)
-        if token_type_ids is not None:
-            x = x + self.token_type(token_type_ids)
-        if self.task_type is not None and task_type_ids is not None:
+        if token_type_ids is None:
+            # segment-0 embedding still contributes when ids are omitted
+            # (same BERT-family semantics as models/bert.py)
+            token_type_ids = zeros_like(input_ids)
+        x = x + self.token_type(token_type_ids)
+        if self.task_type is not None:
+            if task_type_ids is None:
+                task_type_ids = zeros_like(input_ids)
             x = x + self.task_type(task_type_ids)
         return self.drop(self.ln(x))
 

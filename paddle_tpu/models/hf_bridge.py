@@ -1,6 +1,6 @@
-"""HuggingFace transformers interop: convert a GPT2LMHeadModel into this
-framework's GPTForCausalLM (the migration path for users with existing
-torch GPT-2 checkpoints).
+"""HuggingFace transformers interop: convert GPT2LMHeadModel /
+BertModel checkpoints into this framework's models (the migration path for
+users with existing torch weights).
 
 Layout notes (verified against transformers' GPT2 state_dict):
  * HF Conv1D stores weights [in, out] — identical to this framework's
@@ -14,6 +14,18 @@ Layout notes (verified against transformers' GPT2 state_dict):
 import numpy as np
 
 from .gpt import GPTConfig, GPTForCausalLM
+
+
+def _put(ours, name, arr, transpose=False):
+    """Copy one weight into the converted model, guarding layout: a shape
+    mismatch here is exactly what a transpose/packing regression produces."""
+    t = ours[name]
+    if transpose:
+        arr = arr.T
+    if tuple(t.shape) != tuple(arr.shape):
+        raise ValueError(f"{name}: shape {tuple(arr.shape)} != "
+                         f"{tuple(t.shape)}")
+    t.set_value(np.ascontiguousarray(arr))
 
 
 def gpt2_from_huggingface(hf_model=None, model_name=None, dtype="float32"):
@@ -51,11 +63,7 @@ def gpt2_from_huggingface(hf_model=None, model_name=None, dtype="float32"):
     ours = dict(model.named_parameters())
 
     def put(name, arr):
-        t = ours[name]
-        if tuple(t.shape) != tuple(arr.shape):
-            raise ValueError(f"{name}: shape {tuple(arr.shape)} != "
-                             f"{tuple(t.shape)}")
-        t.set_value(arr)
+        _put(ours, name, arr)
 
     put("gpt.wte.weight", sd["transformer.wte.weight"])
     put("gpt.wpe.weight", sd["transformer.wpe.weight"])
@@ -77,5 +85,87 @@ def gpt2_from_huggingface(hf_model=None, model_name=None, dtype="float32"):
     put("gpt.ln_f.weight", sd["transformer.ln_f.weight"])
     put("gpt.ln_f.bias", sd["transformer.ln_f.bias"])
     # lm_head ties to wte in HF GPT-2 exactly like this framework's tied head
+    model.eval()
+    return model
+
+
+def bert_from_huggingface(hf_model=None, model_name=None, dtype="float32"):
+    """Build this framework's BertModel carrying a transformers BertModel's
+    weights. torch Linear stores [out, in] — transposed into this
+    framework's [in, out] convention; embeddings/LayerNorms copy directly.
+    Post-LN encoder layers match BERT's architecture one-to-one
+    (tests/test_hf_bridge.py pins hidden-state + pooler parity)."""
+    if hf_model is None:
+        if model_name is None:
+            raise ValueError("pass hf_model= or model_name=")
+        from transformers import BertModel as HFBert
+
+        hf_model = HFBert.from_pretrained(model_name)
+    hc = hf_model.config
+    if getattr(hc, "hidden_act", "gelu") != "gelu":
+        raise ValueError(f"unsupported hidden_act {hc.hidden_act!r}; this "
+                         "bridge maps BERT's standard gelu only")
+    pet = getattr(hc, "position_embedding_type", "absolute")
+    if pet != "absolute":
+        raise ValueError(f"unsupported position_embedding_type {pet!r}; "
+                         "relative-position checkpoints carry "
+                         "distance_embedding weights this bridge does not "
+                         "map — converting would silently diverge")
+    from .bert import BertConfig, BertModel
+
+    cfg = BertConfig(vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
+                     num_layers=hc.num_hidden_layers,
+                     num_heads=hc.num_attention_heads,
+                     intermediate_size=hc.intermediate_size,
+                     max_position=hc.max_position_embeddings,
+                     type_vocab_size=hc.type_vocab_size, dropout=0.0,
+                     layer_norm_eps=float(
+                         getattr(hc, "layer_norm_eps", 1e-12)))
+    model = BertModel(cfg)
+    sd = {k: v.detach().cpu().numpy().astype(dtype)
+          for k, v in hf_model.state_dict().items()}
+    # from_pretrained on a full checkpoint may prefix with "bert."
+    if any(k.startswith("bert.") for k in sd):
+        sd = {k[len("bert."):]: v for k, v in sd.items()
+              if k.startswith("bert.")}
+    if "pooler.dense.weight" not in sd:
+        raise ValueError(
+            "checkpoint has no pooler (e.g. BertForMaskedLM / "
+            "add_pooling_layer=False); convert the base BertModel with a "
+            "pooler, or extend the bridge for pooler-less heads")
+    ours = dict(model.named_parameters())
+
+    def put(name, arr, transpose=False):
+        _put(ours, name, arr, transpose=transpose)
+
+    put("embeddings.word.weight", sd["embeddings.word_embeddings.weight"])
+    put("embeddings.position.weight",
+        sd["embeddings.position_embeddings.weight"])
+    put("embeddings.token_type.weight",
+        sd["embeddings.token_type_embeddings.weight"])
+    put("embeddings.ln.weight", sd["embeddings.LayerNorm.weight"])
+    put("embeddings.ln.bias", sd["embeddings.LayerNorm.bias"])
+    for i in range(cfg.num_layers):
+        hf = f"encoder.layer.{i}."
+        us = f"encoder.layers.{i}."
+        for mine, theirs in (("q_proj", "attention.self.query"),
+                             ("k_proj", "attention.self.key"),
+                             ("v_proj", "attention.self.value"),
+                             ("out_proj", "attention.output.dense")):
+            put(us + f"self_attn.{mine}.weight",
+                sd[hf + theirs + ".weight"], transpose=True)
+            put(us + f"self_attn.{mine}.bias", sd[hf + theirs + ".bias"])
+        put(us + "norm1.weight", sd[hf + "attention.output.LayerNorm.weight"])
+        put(us + "norm1.bias", sd[hf + "attention.output.LayerNorm.bias"])
+        put(us + "linear1.weight", sd[hf + "intermediate.dense.weight"],
+            transpose=True)
+        put(us + "linear1.bias", sd[hf + "intermediate.dense.bias"])
+        put(us + "linear2.weight", sd[hf + "output.dense.weight"],
+            transpose=True)
+        put(us + "linear2.bias", sd[hf + "output.dense.bias"])
+        put(us + "norm2.weight", sd[hf + "output.LayerNorm.weight"])
+        put(us + "norm2.bias", sd[hf + "output.LayerNorm.bias"])
+    put("pooler.weight", sd["pooler.dense.weight"], transpose=True)
+    put("pooler.bias", sd["pooler.dense.bias"])
     model.eval()
     return model

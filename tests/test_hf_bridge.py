@@ -86,3 +86,93 @@ class TestHFBridge:
         hf.state_dict = lambda: sd  # feed the bad layout to the bridge
         with pytest.raises(ValueError, match="attn.qkv.weight"):
             hf_bridge.gpt2_from_huggingface(hf_model=hf)
+
+
+class TestBertBridge:
+    def test_hidden_and_pooler_parity(self):
+        from transformers import BertConfig as HFCfg, BertModel as HFBert
+
+        from paddle_tpu.models import bert_from_huggingface
+
+        torch.manual_seed(0)
+        hf = HFBert(HFCfg(vocab_size=200, hidden_size=48, num_hidden_layers=2,
+                          num_attention_heads=4, intermediate_size=96,
+                          max_position_embeddings=64, type_vocab_size=2,
+                          hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0))
+        hf.eval()
+        ours = bert_from_huggingface(hf_model=hf)
+
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 200, (2, 11)).astype(np.int64)
+        toktype = rng.randint(0, 2, (2, 11)).astype(np.int64)
+        with torch.no_grad():
+            out = hf(torch.tensor(ids), token_type_ids=torch.tensor(toktype))
+        seq, pooled = ours(paddle.to_tensor(ids.astype(np.int32)),
+                           paddle.to_tensor(toktype.astype(np.int32)))
+        np.testing.assert_allclose(np.asarray(seq._data),
+                                   out.last_hidden_state.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(pooled._data),
+                                   out.pooler_output.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_unsupported_activation_refuses(self):
+        from transformers import BertConfig as HFCfg, BertModel as HFBert
+
+        from paddle_tpu.models import bert_from_huggingface
+
+        hf = HFBert(HFCfg(vocab_size=32, hidden_size=16, num_hidden_layers=1,
+                          num_attention_heads=2, intermediate_size=32,
+                          hidden_act="relu"))
+        with pytest.raises(ValueError, match="hidden_act"):
+            bert_from_huggingface(hf_model=hf)
+
+
+def test_bert_bridge_threads_layer_norm_eps():
+    """Real BERT checkpoints use layer_norm_eps=1e-12; every converted
+    LayerNorm must carry it (framework default is 1e-5)."""
+    from transformers import BertConfig as HFCfg, BertModel as HFBert
+
+    from paddle_tpu.models import bert_from_huggingface
+    from paddle_tpu.nn.layer.norm import LayerNorm
+
+    hf = HFBert(HFCfg(vocab_size=32, hidden_size=16, num_hidden_layers=1,
+                      num_attention_heads=2, intermediate_size=32))
+    ours = bert_from_huggingface(hf_model=hf)
+    lns = [sub for _, sub in ours.named_sublayers(include_self=True)
+           if isinstance(sub, LayerNorm)]
+    assert lns and all(ln._epsilon == 1e-12 for ln in lns)
+
+
+def test_bert_bridge_rejects_poolerless():
+    from transformers import BertConfig as HFCfg, BertForMaskedLM
+
+    from paddle_tpu.models import bert_from_huggingface
+
+    hf = BertForMaskedLM(HFCfg(vocab_size=32, hidden_size=16,
+                               num_hidden_layers=1, num_attention_heads=2,
+                               intermediate_size=32))
+    with pytest.raises(ValueError, match="pooler"):
+        bert_from_huggingface(hf_model=hf)
+
+
+def test_bert_parity_without_token_type_ids():
+    """Verify-drive regression: omitting token_type_ids must still add the
+    segment-0 embedding (BERT semantics), keeping torch parity."""
+    from transformers import BertConfig as HFCfg, BertModel as HFBert
+
+    from paddle_tpu.models import bert_from_huggingface
+
+    torch.manual_seed(2)
+    hf = HFBert(HFCfg(vocab_size=100, hidden_size=32, num_hidden_layers=2,
+                      num_attention_heads=2, intermediate_size=64,
+                      hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)).eval()
+    ours = bert_from_huggingface(hf_model=hf)
+    ids = np.random.RandomState(0).randint(0, 100, (1, 9)).astype(np.int64)
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).last_hidden_state.numpy()
+    seq, _ = ours(paddle.to_tensor(ids.astype(np.int32)))
+    np.testing.assert_allclose(np.asarray(seq._data), want,
+                               rtol=2e-4, atol=2e-4)
