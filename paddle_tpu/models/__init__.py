@@ -1,5 +1,14 @@
 """Model zoo: flagship LMs (GPT/BERT) + vision models re-export."""
-from .bert import BertConfig, BertForPretraining, BertModel, BertPretrainLoss, bert_base  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForPretraining,
+    BertForQuestionAnswering,
+    BertForSequenceClassification,
+    BertForTokenClassification,
+    BertModel,
+    BertPretrainLoss,
+    bert_base,
+)
 from .ernie import (  # noqa: F401
     ErnieConfig,
     ErnieForPretraining,

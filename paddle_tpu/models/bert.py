@@ -102,6 +102,48 @@ class BertForPretraining(nn.Layer):
         return mlm_logits, nsp_logits
 
 
+class BertForSequenceClassification(nn.Layer):
+    """Pooled-[CLS] classification head (GLUE-style fine-tuning)."""
+
+    def __init__(self, cfg, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = nn.Dropout(cfg.dropout)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertForTokenClassification(nn.Layer):
+    """Per-token tagging head (NER-style fine-tuning)."""
+
+    def __init__(self, cfg, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = nn.Dropout(cfg.dropout)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(seq))
+
+
+class BertForQuestionAnswering(nn.Layer):
+    """SQuAD-style span head: (start_logits, end_logits)."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.qa_outputs = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.qa_outputs(seq)          # [b, s, 2]
+        return logits[:, :, 0], logits[:, :, 1]
+
+
 class BertPretrainLoss(nn.Layer):
     def forward(self, outputs, labels):
         mlm_logits, _ = outputs if isinstance(outputs, (tuple, list)) else (outputs, None)

@@ -176,3 +176,61 @@ def test_bert_parity_without_token_type_ids():
     seq, _ = ours(paddle.to_tensor(ids.astype(np.int32)))
     np.testing.assert_allclose(np.asarray(seq._data), want,
                                rtol=2e-4, atol=2e-4)
+
+
+class TestBertTaskHeads:
+    """Fine-tune heads over BertModel: shapes + a tiny separable fine-tune
+    actually learns (classification), spans flow (QA), tags flow (token)."""
+
+    def _cfg(self):
+        from paddle_tpu.models import BertConfig
+
+        return BertConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                          num_heads=2, intermediate_size=64, max_position=32,
+                          dropout=0.0)
+
+    def test_sequence_classification_learns(self):
+        from paddle_tpu.models import BertForSequenceClassification
+
+        paddle.seed(0)
+        model = BertForSequenceClassification(self._cfg(), num_classes=2)
+        opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                    parameters=model.parameters())
+        loss_fn = paddle.nn.CrossEntropyLoss()
+        rng = np.random.RandomState(0)
+        # class 0 sentences use tokens < 32, class 1 tokens >= 32
+        n = 64
+        ys = rng.randint(0, 2, n)
+        xs = np.where(ys[:, None] == 0,
+                      rng.randint(0, 32, (n, 12)),
+                      rng.randint(32, 64, (n, 12))).astype(np.int32)
+        accs = []
+        for i in range(30):
+            logits = model(paddle.to_tensor(xs))
+            loss = loss_fn(logits, paddle.to_tensor(ys.astype(np.int64)))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            accs.append(float((np.argmax(np.asarray(logits._data), -1)
+                               == ys).mean()))
+        assert accs[-1] > 0.9, accs[::10]
+
+    def test_token_and_qa_heads_shapes_and_grads(self):
+        from paddle_tpu.models import (BertForQuestionAnswering,
+                                       BertForTokenClassification)
+
+        paddle.seed(0)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 64, (2, 10)).astype(np.int32))
+        tok = BertForTokenClassification(self._cfg(), num_classes=5)
+        out = tok(ids)
+        assert tuple(out.shape) == (2, 10, 5)
+        out.sum().backward()
+        assert np.abs(np.asarray(
+            tok.classifier.weight.grad._data)).sum() > 0
+
+        qa = BertForQuestionAnswering(self._cfg())
+        start, end = qa(ids)
+        assert tuple(start.shape) == (2, 10) and tuple(end.shape) == (2, 10)
+        (start.sum() + end.sum()).backward()
+        assert np.abs(np.asarray(qa.qa_outputs.weight.grad._data)).sum() > 0
