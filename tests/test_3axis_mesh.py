@@ -133,3 +133,26 @@ def test_zero_stage_hlo_collectives_16dev():
         print("ZERO_HLO_OK", c0, c2, c3)
     """)
     assert "ZERO_HLO_OK" in out
+
+
+def test_weak_scaling_structure_32dev():
+    """BASELINE's 'allreduce scaling eff' in compile-checkable form: with a
+    fixed per-device batch, per-device FLOPs and grad all-reduce
+    count/payload must be IDENTICAL at dp=2/8/32 — collective cost rides the
+    ring, independent of world size (tools/scaling_check.py)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "scaling_check.py")],
+        env={**os.environ,
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=32",
+             "JAX_PLATFORMS": "",
+             "PYTHONPATH": REPO + (
+                 os.pathsep + os.environ["PYTHONPATH"]
+                 if os.environ.get("PYTHONPATH") else "")},
+        capture_output=True, text=True, timeout=560)
+    assert out.returncode == 0, out.stdout + out.stderr
+    import json
+
+    lines = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    verdict = lines[-1]
+    assert verdict["scaling_ok"] is True, lines
+    assert verdict["dps"] == [2, 8, 32]

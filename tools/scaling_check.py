@@ -1,0 +1,115 @@
+"""Weak-scaling structure check (BASELINE metric: "fleet allreduce scaling
+eff 8→256 chips").
+
+Wall-clock scaling needs a pod; what is checkable anywhere is the PROGRAM
+STRUCTURE that determines it: with a fixed per-device batch, the compiled
+per-device train step must keep (a) per-device FLOPs, (b) grad all-reduce
+count, and (c) all-reduce payload bytes CONSTANT as dp grows — collectives
+whose cost rides the ring (per-device bytes ~2x payload, independent of N)
+instead of multiplying with world size. A design that gathered params or
+scaled payload with dp would fail here long before a pod run could.
+
+Usage: XLA_FLAGS=--xla_force_host_platform_device_count=32 \
+       python tools/scaling_check.py [--dp 2 8 32]
+Prints one JSON line per dp plus a "scaling_ok" verdict.
+"""
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(dp, per_device_batch=4, seq=64):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # virtual host devices
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM, GPTPretrainLoss
+
+    devices = jax.devices()[:dp]
+    assert len(devices) == dp, f"need {dp} devices, have {len(jax.devices())}"
+    mesh = build_mesh((dp,), ("dp",), devices=devices)
+    paddle.seed(0)
+    cfg = GPTConfig.tiny()
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    tr = SpmdTrainer(model, opt, loss_fn=GPTPretrainLoss(), mesh=mesh)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(
+        0, cfg.vocab_size, (per_device_batch * dp, seq)).astype(np.int32))
+    batch = [ids, ids]
+    step = tr._build(batch)
+    lr = jnp.asarray(1e-4, jnp.float32)
+    compiled = step.lower(tr.params, tr.opt_state, tr.buffers, lr,
+                          jax.random.key(0), *batch).compile()
+    txt = compiled.as_text()
+    # DEFINING all-reduce instructions only (use sites of %all-reduce.N must
+    # not count): "%x = f32[64]{0} all-reduce(" or the tuple form
+    # "%x = (f32[a]{0}, f32[b]{0}) all-reduce("
+    elt_bytes = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8, "s32": 4,
+                 "u32": 4, "pred": 1}
+
+    def shape_bytes(ty, shape):
+        n = 1
+        for d in shape.split(","):
+            if d.strip():
+                n *= int(d)
+        return n * elt_bytes.get(ty, 4)
+
+    count, payload = 0, 0
+    for m in re.finditer(
+            r"=\s*(\w+)\[([\d,]*)\](?:\{[^}]*\})?\s+all-reduce\(", txt):
+        count += 1
+        payload += shape_bytes(m.group(1), m.group(2))
+    for m in re.finditer(r"=\s*\(([^)]*)\)\s+all-reduce\(", txt):
+        count += 1
+        for ty, shape in re.findall(r"(\w+)\[([\d,]*)\]", m.group(1)):
+            payload += shape_bytes(ty, shape)
+    cost = compiled.cost_analysis()
+    cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+    flops = float(cost.get("flops", -1.0)) if cost else -1.0
+    return {"dp": dp, "allreduce_count": count,
+            "allreduce_payload_bytes": payload,
+            "flops_per_device": flops}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dp", type=int, nargs="+", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    n = len(jax.devices())
+    dps = args.dp or [d for d in (2, 8, 32) if d <= n]
+    if len(dps) < 2:
+        print(json.dumps({"error": f"need >=2 dp points; only {n} devices "
+                          "visible — set XLA_FLAGS="
+                          "--xla_force_host_platform_device_count=32"}))
+        sys.exit(1)
+    rows = [measure(dp) for dp in dps]
+    for r in rows:
+        print(json.dumps(r))
+    base = rows[0]
+    ok = all(r["allreduce_count"] == base["allreduce_count"]
+             and r["allreduce_payload_bytes"]
+             == base["allreduce_payload_bytes"]
+             and (base["flops_per_device"] < 0 or r["flops_per_device"] < 0
+                  or abs(r["flops_per_device"] - base["flops_per_device"])
+                  <= 0.01 * base["flops_per_device"])
+             for r in rows[1:])
+    print(json.dumps({"scaling_ok": bool(ok), "dps": dps}))
+
+
+if __name__ == "__main__":
+    main()
