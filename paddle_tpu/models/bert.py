@@ -76,6 +76,8 @@ class BertModel(nn.Layer):
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         x = self.embeddings(input_ids, token_type_ids)
+        # [b, s] keep-masks normalize inside the shared attention stack
+        # (nn/layer/transformer.py _convert_attn_mask)
         x = self.encoder(x, attention_mask)
         pooled = F.tanh(self.pooler(x[:, 0]))
         return x, pooled

@@ -85,6 +85,7 @@ class ErnieModel(nn.Layer):
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
                 task_type_ids=None):
         x = self.embeddings(input_ids, token_type_ids, task_type_ids)
+        # [b, s] keep-masks normalize inside the shared attention stack
         x = self.encoder(x, attention_mask)
         pooled = F.tanh(self.pooler(x[:, 0]))
         return x, pooled

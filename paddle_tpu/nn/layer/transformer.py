@@ -14,10 +14,30 @@ from .layers import Layer
 from .norm import LayerNorm
 
 
-def _convert_attn_mask(attn_mask, dtype=None):
+def _convert_attn_mask(attn_mask, q_len=None, k_len=None):
+    """Normalize user masks for SDPA ([b, heads, q, k] broadcast space).
+
+    2-D masks are ambiguous: paddle's documented form is a [q, k] score mask
+    (broadcasts right-aligned, bool = keep / float = additive), while the
+    HF/BERT convention is a [b, s] key-padding keep-mask. Disambiguate by
+    shape: an exact (q_len, k_len) match keeps paddle semantics (pass
+    through; this wins the square b==q==s tie for backward compat);
+    otherwise a trailing k_len means key-padding and expands to bool
+    [b, 1, 1, s] — previously such masks were silently ADDED as 0/1.
+    Richer (>=3-D) masks pass through."""
     if attn_mask is None:
         return None
-    return attn_mask
+    m = attn_mask
+    if m.ndim == 2:
+        import jax.numpy as jnp
+
+        if q_len is not None and tuple(m.shape) == (q_len, k_len):
+            return m  # paddle [q, k] score mask
+        if jnp.issubdtype(jnp.asarray(m._data).dtype, jnp.floating):
+            return m  # float 2-D mask: additive semantics, broadcast as-is
+        if k_len is None or m.shape[-1] == k_len:
+            return m.astype("bool").unsqueeze(1).unsqueeze(2)
+    return m
 
 
 class MultiHeadAttention(Layer):
@@ -58,7 +78,9 @@ class MultiHeadAttention(Layer):
             new_cache = (k, v)
 
         out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=_convert_attn_mask(attn_mask),
+            q, k, v,
+            attn_mask=_convert_attn_mask(attn_mask, q_len=sq,
+                                         k_len=k.shape[1]),
             dropout_p=self.dropout if self.training else 0.0, training=self.training,
         )
         out = out.reshape([b, sq, self.embed_dim])

@@ -234,3 +234,88 @@ class TestBertTaskHeads:
         assert tuple(start.shape) == (2, 10) and tuple(end.shape) == (2, 10)
         (start.sum() + end.sum()).backward()
         assert np.abs(np.asarray(qa.qa_outputs.weight.grad._data)).sum() > 0
+
+
+def test_bert_attention_mask_parity_with_hf():
+    """[b, s] keep-masks (the HF/paddle convention) must work and match the
+    torch reference at valid positions (masked positions are don't-care)."""
+    from transformers import BertConfig as HFCfg, BertModel as HFBert
+
+    from paddle_tpu.models import bert_from_huggingface
+
+    torch.manual_seed(0)
+    hf = HFBert(HFCfg(vocab_size=100, hidden_size=32, num_hidden_layers=2,
+                      num_attention_heads=2, intermediate_size=64,
+                      hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)).eval()
+    ours = bert_from_huggingface(hf_model=hf)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 100, (2, 10)).astype(np.int64)
+    mask = np.ones((2, 10), np.int64)
+    mask[0, 6:] = 0
+    mask[1, 8:] = 0
+    with torch.no_grad():
+        want = hf(torch.tensor(ids),
+                  attention_mask=torch.tensor(mask)).last_hidden_state.numpy()
+    seq, _ = ours(paddle.to_tensor(ids.astype(np.int32)),
+                  attention_mask=paddle.to_tensor(mask.astype(np.int32)))
+    got = np.asarray(seq._data)
+    np.testing.assert_allclose(got[0, :6], want[0, :6], rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(got[1, :8], want[1, :8], rtol=2e-4, atol=2e-4)
+
+
+def test_ernie_key_padding_mask_works():
+    """ERNIE shares the normalized mask path: [b, s] keep-masks must change
+    attention (masked vs unmasked outputs differ at valid positions) and not
+    crash."""
+    from paddle_tpu.models import ErnieConfig, ErnieModel
+
+    paddle.seed(0)
+    m = ErnieModel(ErnieConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                               num_heads=2, intermediate_size=64,
+                               dropout=0.0))
+    m.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 64, (1, 8)).astype(np.int32))
+    mask = paddle.to_tensor(
+        np.array([[1, 1, 1, 1, 0, 0, 0, 0]], np.int32))
+    full, _ = m(ids)
+    masked, _ = m(ids, attention_mask=mask)
+    a, b = np.asarray(full._data), np.asarray(masked._data)
+    assert np.isfinite(b).all()
+    assert not np.allclose(a[0, :4], b[0, :4])  # masking changed attention
+
+
+def test_transformer_encoder_direct_2d_mask():
+    """The shared stack itself (not just the model zoo) accepts [b, s]
+    keep-masks — nn.TransformerEncoder is the public paddle surface."""
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    layer = nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 1)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 6, 16).astype(np.float32))
+    mask = paddle.to_tensor(np.array([[1, 1, 1, 0, 0, 0],
+                                      [1, 1, 1, 1, 1, 1]], np.int32))
+    out = enc(x, mask)
+    assert np.isfinite(np.asarray(out._data)).all()
+
+
+def test_float_additive_2d_mask_unchanged():
+    """Review r3: a float additive mask (0 / -1e9, broadcast over batch)
+    must keep additive semantics — not be bool-inverted by the keep-mask
+    expansion."""
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    layer = nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 1)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 6, 16).astype(np.float32))
+    add_mask = np.zeros((1, 6), np.float32)
+    add_mask[0, 3:] = -1e9  # mask keys 3..5
+    keep_mask = np.array([[1, 1, 1, 0, 0, 0]] * 2, np.int32)
+    out_add = np.asarray(enc(x, paddle.to_tensor(add_mask))._data)
+    out_keep = np.asarray(enc(x, paddle.to_tensor(keep_mask))._data)
+    np.testing.assert_allclose(out_add, out_keep, rtol=1e-5, atol=1e-5)
