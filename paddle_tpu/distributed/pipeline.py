@@ -422,3 +422,19 @@ class PipelineTrainer:
                 host = jax.device_get(v)
                 for i, named in enumerate(stage_named):
                     named[name]._data = jnp.asarray(host[i])
+
+    # -- checkpoint / resume ---------------------------------------------------
+    def state_dict(self):
+        """Host-side checkpoint of the pipeline train state (stacked stage
+        params + pre/post params + optimizer moments + step counters + LR
+        scheduler); restore with set_state_dict for bit-exact resume."""
+        from .spmd import gather_train_state
+
+        return gather_train_state(self.params, self.opt_state,
+                                  self.optimizer)
+
+    def set_state_dict(self, state):
+        from .spmd import restore_train_state
+
+        self.params, self.opt_state = restore_train_state(
+            state, self.p_shardings, self.s_shardings, self.optimizer)

@@ -582,6 +582,90 @@ class SpmdTrainer:
         for n, v in self.buffers.items():
             named_b[n]._data = v
 
+    # -- checkpoint / resume ---------------------------------------------------
+    def state_dict(self):
+        """Host-side checkpoint of the FULL train state — params, buffers,
+        optimizer moments, step counters, LR-scheduler state — gathered
+        from whatever shardings are live. `paddle.save(trainer.state_dict(),
+        path)` + `set_state_dict(paddle.load(path))` resumes bit-exact
+        (asserted by tests/test_trainer_checkpoint.py)."""
+        state = gather_train_state(self.params, self.opt_state,
+                                   self.optimizer)
+        state["buffers"] = {k: _host_gather(v)
+                            for k, v in self.buffers.items()}
+        return state
+
+    def set_state_dict(self, state):
+        """Restore a state_dict() checkpoint, re-placing every array with
+        the trainer's live shardings (same mesh topology). Key mismatches
+        (stale checkpoint vs a changed model) fail fast with names."""
+        self.params, self.opt_state = restore_train_state(
+            state, self.p_shardings, self.s_shardings, self.optimizer)
+        _validate_state_keys("buffers", state.get("buffers", {}),
+                             self.b_shardings)
+        self.buffers = {k: owned_device_put(jnp.asarray(v),
+                                            self.b_shardings[k])
+                        for k, v in state.get("buffers", {}).items()}
+
 
 def data_parallel_step_fn(layer, optimizer, loss_fn, mesh=None, **kw):
     return SpmdTrainer(layer, optimizer, loss_fn, mesh=mesh, **kw)
+
+
+# -- shared checkpoint helpers (SpmdTrainer + PipelineTrainer) ----------------
+
+def _host_gather(v):
+    """device_get that stays correct on multi-process meshes: arrays spanning
+    non-addressable devices gather via process_allgather."""
+    try:
+        return np.asarray(jax.device_get(v))
+    except RuntimeError:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(v))
+
+
+def _validate_state_keys(what, got, expected):
+    missing = sorted(set(expected) - set(got))
+    unexpected = sorted(set(got) - set(expected))
+    if missing or unexpected:
+        raise ValueError(
+            f"checkpoint {what} mismatch — missing: {missing or 'none'}, "
+            f"unexpected: {unexpected or 'none'} (stale checkpoint for a "
+            "changed model?)")
+
+
+def gather_train_state(params, opt_state, optimizer):
+    """Host-side {params, opt_state, step, lr_scheduler} snapshot."""
+    lr = optimizer._lr
+    return {
+        "params": {k: _host_gather(v) for k, v in params.items()},
+        "opt_state": {
+            pname: (_host_gather(st) if pname == "__step__"
+                    else {k: _host_gather(v) for k, v in st.items()})
+            for pname, st in opt_state.items()},
+        "optimizer_step_count": int(optimizer._step_count),
+        "lr_scheduler": (lr.state_dict()
+                         if hasattr(lr, "state_dict") else None),
+    }
+
+
+def restore_train_state(state, p_shardings, s_shardings, optimizer):
+    """Re-place a gather_train_state snapshot onto live shardings; restores
+    step counters and LR-scheduler state. Returns (params, opt_state)."""
+    _validate_state_keys("params", state["params"], p_shardings)
+    _validate_state_keys("opt_state", state["opt_state"], s_shardings)
+    params = {k: owned_device_put(jnp.asarray(v), p_shardings[k])
+              for k, v in state["params"].items()}
+    opt_state = {
+        pname: (owned_device_put(jnp.asarray(st), s_shardings[pname])
+                if pname == "__step__"
+                else {k: owned_device_put(jnp.asarray(v),
+                                          s_shardings[pname][k])
+                      for k, v in st.items()})
+        for pname, st in state["opt_state"].items()}
+    optimizer._step_count = int(state.get("optimizer_step_count", 0))
+    lr = optimizer._lr
+    if state.get("lr_scheduler") and hasattr(lr, "set_state_dict"):
+        lr.set_state_dict(state["lr_scheduler"])
+    return params, opt_state
