@@ -26,4 +26,8 @@ from .gpt import (  # noqa: F401
     gpt2_medium,
     gpt2_small,
 )
-from .hf_bridge import bert_from_huggingface, gpt2_from_huggingface  # noqa: F401
+from .hf_bridge import (  # noqa: F401
+    bert_from_huggingface,
+    gpt2_from_huggingface,
+    gpt2_to_huggingface,
+)

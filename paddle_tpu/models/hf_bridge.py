@@ -16,6 +16,22 @@ import numpy as np
 from .gpt import GPTConfig, GPTForCausalLM
 
 
+# per-block weight-name pairs shared by BOTH GPT-2 bridge directions
+# (ours suffix, HF suffix) — HF Conv1D's [in, out] matches our Linear
+_GPT2_LAYER_MAP = [
+    ("ln1.weight", "ln_1.weight"), ("ln1.bias", "ln_1.bias"),
+    ("attn.qkv.weight", "attn.c_attn.weight"),
+    ("attn.qkv.bias", "attn.c_attn.bias"),
+    ("attn.proj.weight", "attn.c_proj.weight"),
+    ("attn.proj.bias", "attn.c_proj.bias"),
+    ("ln2.weight", "ln_2.weight"), ("ln2.bias", "ln_2.bias"),
+    ("mlp.fc1.weight", "mlp.c_fc.weight"),
+    ("mlp.fc1.bias", "mlp.c_fc.bias"),
+    ("mlp.fc2.weight", "mlp.c_proj.weight"),
+    ("mlp.fc2.bias", "mlp.c_proj.bias"),
+]
+
+
 def _put(ours, name, arr, transpose=False):
     """Copy one weight into the converted model, guarding layout: a shape
     mismatch here is exactly what a transpose/packing regression produces."""
@@ -70,18 +86,8 @@ def gpt2_from_huggingface(hf_model=None, model_name=None, dtype="float32"):
     for i in range(cfg.num_layers):
         hf = f"transformer.h.{i}."
         us = f"gpt.blocks.{i}."
-        put(us + "ln1.weight", sd[hf + "ln_1.weight"])
-        put(us + "ln1.bias", sd[hf + "ln_1.bias"])
-        put(us + "attn.qkv.weight", sd[hf + "attn.c_attn.weight"])
-        put(us + "attn.qkv.bias", sd[hf + "attn.c_attn.bias"])
-        put(us + "attn.proj.weight", sd[hf + "attn.c_proj.weight"])
-        put(us + "attn.proj.bias", sd[hf + "attn.c_proj.bias"])
-        put(us + "ln2.weight", sd[hf + "ln_2.weight"])
-        put(us + "ln2.bias", sd[hf + "ln_2.bias"])
-        put(us + "mlp.fc1.weight", sd[hf + "mlp.c_fc.weight"])
-        put(us + "mlp.fc1.bias", sd[hf + "mlp.c_fc.bias"])
-        put(us + "mlp.fc2.weight", sd[hf + "mlp.c_proj.weight"])
-        put(us + "mlp.fc2.bias", sd[hf + "mlp.c_proj.bias"])
+        for mine, theirs in _GPT2_LAYER_MAP:
+            put(us + mine, sd[hf + theirs])
     put("gpt.ln_f.weight", sd["transformer.ln_f.weight"])
     put("gpt.ln_f.bias", sd["transformer.ln_f.bias"])
     # lm_head ties to wte in HF GPT-2 exactly like this framework's tied head
@@ -169,3 +175,63 @@ def bert_from_huggingface(hf_model=None, model_name=None, dtype="float32"):
     put("pooler.bias", sd["pooler.dense.bias"])
     model.eval()
     return model
+
+
+def gpt2_to_huggingface(model, hf_model=None):
+    """Export a GPTForCausalLM's weights INTO a transformers GPT2LMHeadModel
+    (the reverse bridge — take trained models back to the torch ecosystem).
+    Pass an instantiated hf_model with a matching config, or one is built
+    from the model's GPTConfig. Returns the hf_model."""
+    import torch
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    cfg = model.cfg
+    if getattr(model, "lm_head", None) is not None:
+        raise ValueError("untied-head models (after pipeline_split) do not "
+                         "map onto HF GPT-2's tied head; export the tied "
+                         "pre-split model")
+    if cfg.num_experts > 0:
+        raise ValueError("MoE models have no GPT-2 equivalent (expert MLPs "
+                         "replace dense fc1/fc2); export is unsupported")
+    if hf_model is not None:
+        act = getattr(hf_model.config, "activation_function", "gelu_new")
+        want_approx = act in ("gelu_new", "gelu_pytorch_tanh")
+        if act not in ("gelu_new", "gelu_pytorch_tanh", "gelu") or \
+                want_approx != bool(cfg.gelu_approx):
+            raise ValueError(
+                f"hf_model activation_function {act!r} does not match "
+                f"gelu_approx={cfg.gelu_approx}; logits would silently "
+                "diverge")
+    if hf_model is None:
+        hf_model = GPT2LMHeadModel(GPT2Config(
+            vocab_size=cfg.vocab_size, n_positions=cfg.max_seq_len,
+            n_embd=cfg.hidden_size, n_layer=cfg.num_layers,
+            n_head=cfg.num_heads,
+            n_inner=cfg.intermediate_size,
+            activation_function=("gelu_new" if cfg.gelu_approx else "gelu"),
+            resid_pdrop=cfg.dropout, embd_pdrop=cfg.dropout,
+            attn_pdrop=cfg.dropout))
+    ours = {n: np.asarray(p._data) for n, p in model.named_parameters()}
+    sd = {}
+    sd["transformer.wte.weight"] = ours["gpt.wte.weight"]
+    sd["transformer.wpe.weight"] = ours["gpt.wpe.weight"]
+    for i in range(cfg.num_layers):
+        hf = f"transformer.h.{i}."
+        us = f"gpt.blocks.{i}."
+        for mine, theirs in _GPT2_LAYER_MAP:
+            sd[hf + theirs] = ours[us + mine]
+    sd["transformer.ln_f.weight"] = ours["gpt.ln_f.weight"]
+    sd["transformer.ln_f.bias"] = ours["gpt.ln_f.bias"]
+    sd["lm_head.weight"] = ours["gpt.wte.weight"]  # tied
+    tensors = {k: torch.tensor(np.ascontiguousarray(v))
+               for k, v in sd.items()}
+    missing, unexpected = hf_model.load_state_dict(tensors, strict=False)
+    # attn.bias (causal mask buffers) are derived, not weights; anything
+    # else missing means a layout/config mismatch
+    real_missing = [k for k in missing
+                    if not k.endswith((".attn.bias", ".attn.masked_bias"))]
+    if real_missing or unexpected:
+        raise ValueError(f"export mismatch — missing: {real_missing}, "
+                         f"unexpected: {unexpected}")
+    hf_model.eval()
+    return hf_model

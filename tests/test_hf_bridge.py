@@ -319,3 +319,58 @@ def test_float_additive_2d_mask_unchanged():
     out_add = np.asarray(enc(x, paddle.to_tensor(add_mask))._data)
     out_keep = np.asarray(enc(x, paddle.to_tensor(keep_mask))._data)
     np.testing.assert_allclose(out_add, out_keep, rtol=1e-5, atol=1e-5)
+
+
+def test_gpt2_roundtrip_ours_to_hf():
+    """Reverse bridge: a (randomly initialized) GPTForCausalLM exports into
+    a torch GPT2LMHeadModel with logits parity — the round trip out of the
+    framework."""
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.models.hf_bridge import gpt2_to_huggingface
+
+    paddle.seed(3)
+    cfg = GPTConfig(vocab_size=120, hidden_size=48, num_layers=2, num_heads=4,
+                    max_seq_len=32, dropout=0.0, gelu_approx=True)
+    ours = GPTForCausalLM(cfg)
+    ours.eval()
+    hf = gpt2_to_huggingface(ours)
+    ids = np.random.RandomState(0).randint(0, 120, (2, 9)).astype(np.int64)
+    want = np.asarray(ours(paddle.to_tensor(ids.astype(np.int32)))._data)
+    with torch.no_grad():
+        got = hf(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt2_roundtrip_rejects_untied():
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.models.hf_bridge import gpt2_to_huggingface
+
+    paddle.seed(0)
+    m = GPTForCausalLM(GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                                 num_heads=2, max_seq_len=16, dropout=0.0))
+    m.pipeline_split(2)  # installs untied lm_head
+    with pytest.raises(ValueError, match="untied"):
+        gpt2_to_huggingface(m)
+
+
+def test_reverse_bridge_guards():
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   gpt2_to_huggingface)
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    paddle.seed(0)
+    # activation mismatch with a caller-provided hf_model refuses
+    erf_model = GPTForCausalLM(GPTConfig(vocab_size=64, hidden_size=32,
+                                         num_layers=1, num_heads=2,
+                                         max_seq_len=16, dropout=0.0,
+                                         gelu_approx=False))
+    hf = GPT2LMHeadModel(GPT2Config(vocab_size=64, n_positions=16, n_embd=32,
+                                    n_layer=1, n_head=2))  # gelu_new default
+    with pytest.raises(ValueError, match="activation_function"):
+        gpt2_to_huggingface(erf_model, hf_model=hf)
+    # MoE refuses with a clear error, not a KeyError
+    moe = GPTForCausalLM(GPTConfig(vocab_size=64, hidden_size=32,
+                                   num_layers=2, num_heads=2, max_seq_len=16,
+                                   dropout=0.0, num_experts=2, moe_every=1))
+    with pytest.raises(ValueError, match="MoE"):
+        gpt2_to_huggingface(moe)
