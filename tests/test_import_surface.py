@@ -1,0 +1,32 @@
+"""Every paddle_tpu submodule imports cleanly (wiring/regression smoke):
+a rename or circular import anywhere in the package fails here by name."""
+import importlib
+import pkgutil
+
+import paddle_tpu
+
+
+def test_all_submodules_import():
+    failures = []
+    # onerror: walk_packages re-imports subpackages to descend; without it a
+    # raising __init__ aborts the walk and discards collected failures
+    for mod in pkgutil.walk_packages(
+            paddle_tpu.__path__, prefix="paddle_tpu.",
+            onerror=lambda name: failures.append((name, "walk error"))):
+        try:
+            importlib.import_module(mod.name)
+        except Exception as e:  # noqa: BLE001 - collecting all failures
+            failures.append((mod.name, repr(e)))
+    assert not failures, failures
+
+
+def test_public_namespaces_nonempty():
+    import paddle_tpu as paddle
+
+    for ns in ("nn", "tensor", "optimizer", "amp", "io", "jit", "static",
+               "distributed", "metric", "vision", "text", "inference",
+               "quantization", "models", "incubate", "utils", "profiler",
+               "autograd", "onnx", "hapi"):
+        mod = getattr(paddle, ns, None) or importlib.import_module(
+            f"paddle_tpu.{ns}")
+        assert len([n for n in dir(mod) if not n.startswith("_")]) > 0, ns
