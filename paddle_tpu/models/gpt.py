@@ -232,7 +232,7 @@ class GPTForCausalLM(nn.Layer):
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=None, seed=None, eos_token_id=None, num_beams=1,
-                 length_penalty=1.0):
+                 length_penalty=1.0, dtype=None):
         """Autoregressive decode with a KV cache, compiled as ONE program
         (prefill + lax.scan; static shapes, dynamic_update_slice cache).
         temperature=0 decodes greedily; otherwise samples (top_k optional);
@@ -243,9 +243,10 @@ class GPTForCausalLM(nn.Layer):
         See _gpt_generate/_gpt_beam_search for the TPU design notes."""
         if num_beams > 1:
             return _gpt_beam_search(self, input_ids, max_new_tokens,
-                                    num_beams, eos_token_id, length_penalty)
+                                    num_beams, eos_token_id, length_penalty,
+                                    dtype=dtype)
         return _gpt_generate(self, input_ids, max_new_tokens, temperature,
-                             top_k, seed, eos_token_id)
+                             top_k, seed, eos_token_id, dtype=dtype)
 
     def pipeline_split(self, pp_degree):
         """Split into (pre, stages, post_loss) for distributed.pipeline.
@@ -348,6 +349,24 @@ def _check_decode_config(cfg):
             "round-trips) or use BeamSearchDecoder/dynamic_decode")
 
 
+def _decode_compute_dtype(dtype):
+    """None = f32 (exact); 'bfloat16'/'float16' = low-precision serving:
+    params and the KV cache cast down (the decode loop is HBM-bound, so the
+    cache halving is the win); logits always pick in f32."""
+    if dtype is None:
+        return None
+    import jax.numpy as jnp
+
+    from ..core import dtype as dtype_mod
+
+    d = dtype_mod.convert_dtype(dtype)
+    if not jnp.issubdtype(d, jnp.floating):
+        raise ValueError(f"generate dtype must be floating, got {dtype!r}")
+    if d == jnp.float32:
+        return None  # the default path already IS f32 — avoid a dup compile
+    return d
+
+
 def _decode_setup(model, input_ids, max_new_tokens):
     import jax.numpy as jnp
 
@@ -370,7 +389,7 @@ def _decode_setup(model, input_ids, max_new_tokens):
 
 
 def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
-                  seed, eos_token_id):
+                  seed, eos_token_id, dtype=None):
     """TPU-native autoregressive decode: ONE jitted program — prefill plus a
     lax.scan over decode steps against a static-shape KV cache updated with
     dynamic_update_slice. No per-step retrace, no dynamic shapes; the decode
@@ -388,6 +407,7 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
     L, Hh = cfg.num_layers, cfg.num_heads
     hd = cfg.hidden_size // Hh
     fwd, logits_of = _decode_fns(cfg, untied, untied_bias)
+    compute_dtype = _decode_compute_dtype(dtype)
 
     def pick(logits, key):
         if temperature == 0.0:
@@ -399,10 +419,16 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
         return jax.random.categorical(key, lg).astype(jnp.int32)
 
     def run(p, ids_, key):
-        kc = jnp.zeros((L, b, Hh, T, hd), jnp.float32)
+        if compute_dtype is not None:
+            # serving precision: bf16 params + bf16 KV cache (half the HBM
+            # traffic the decode loop is bound by); logits pick in f32
+            p = {k: (v.astype(compute_dtype)
+                     if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                 for k, v in p.items()}
+        kc = jnp.zeros((L, b, Hh, T, hd), compute_dtype or jnp.float32)
         vc = jnp.zeros_like(kc)
         x, kc, vc = fwd(p, ids_, 0, kc, vc)
-        tok = pick(logits_of(p, x[:, -1]), key)
+        tok = pick(logits_of(p, x[:, -1]).astype(jnp.float32), key)
         done = jnp.zeros((b,), bool) if eos_token_id is None else \
             (tok == eos_token_id)
 
@@ -411,7 +437,7 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
             key, sub = jax.random.split(key)
             # the fed token is the (i-1)-th generated one: absolute s0 + i - 1
             x, kc, vc = fwd(p, tok[:, None], s0 + i - 1, kc, vc)
-            nxt = pick(logits_of(p, x[:, 0]), sub)
+            nxt = pick(logits_of(p, x[:, 0]).astype(jnp.float32), sub)
             if eos_token_id is not None:
                 nxt = jnp.where(done, eos_token_id, nxt)
                 done = done | (nxt == eos_token_id)
@@ -423,7 +449,7 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
             if max_new_tokens > 1 else tok[:, None]
 
     cache_key = (b, s0, max_new_tokens, float(temperature), top_k,
-                 eos_token_id, untied, untied_bias)
+                 eos_token_id, untied, untied_bias, str(compute_dtype))
     store = model.__dict__.setdefault("_generate_compiled", {})
     if cache_key not in store:
         store[cache_key] = jax.jit(run)
@@ -442,7 +468,7 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
 
 
 def _gpt_beam_search(model, input_ids, max_new_tokens, num_beams,
-                     eos_token_id, length_penalty):
+                     eos_token_id, length_penalty, dtype=None):
     """Beam search over the same fused KV-cache program: prefill once at
     batch b, tile the cache per beam ([L, b*K, H, T, hd]), and lax.scan
     steps that (a) add log-probs, (b) take the joint top-K over K*V
@@ -465,12 +491,19 @@ def _gpt_beam_search(model, input_ids, max_new_tokens, num_beams,
     K, V = num_beams, cfg.vocab_size
     fwd, logits_of = _decode_fns(cfg, untied, untied_bias)
     eos = -1 if eos_token_id is None else int(eos_token_id)
+    compute_dtype = _decode_compute_dtype(dtype)
 
     def run(p, ids_):
-        kc = jnp.zeros((L, b, Hh, T, hd), jnp.float32)
+        if compute_dtype is not None:
+            # bf16 cache matters MOST here: the cache is K x larger
+            p = {k: (v.astype(compute_dtype)
+                     if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                 for k, v in p.items()}
+        kc = jnp.zeros((L, b, Hh, T, hd), compute_dtype or jnp.float32)
         vc = jnp.zeros_like(kc)
         x, kc, vc = fwd(p, ids_, 0, kc, vc)
-        logp0 = jax.nn.log_softmax(logits_of(p, x[:, -1]), -1)   # [b, V]
+        logp0 = jax.nn.log_softmax(
+            logits_of(p, x[:, -1]).astype(jnp.float32), -1)      # [b, V]
         scores, tok = jax.lax.top_k(logp0, K)                    # [b, K]
         tok = tok.astype(jnp.int32)
         done = tok == eos
@@ -485,7 +518,8 @@ def _gpt_beam_search(model, input_ids, max_new_tokens, num_beams,
             tok, scores, done, gen_len, kc, vc = carry
             x, kc, vc = fwd(p, tok.reshape(b * K, 1), s0 + i - 1, kc, vc)
             logp = jax.nn.log_softmax(
-                logits_of(p, x[:, 0]), -1).reshape(b, K, V)
+                logits_of(p, x[:, 0]).astype(jnp.float32),
+                -1).reshape(b, K, V)
             # finished beams: only eos continues, at no cost
             if eos >= 0:
                 frozen = jnp.full((V,), -jnp.inf).at[eos].set(0.0)
@@ -536,7 +570,7 @@ def _gpt_beam_search(model, input_ids, max_new_tokens, num_beams,
         return seq, final_score
 
     cache_key = ("beam", b, s0, max_new_tokens, K, eos, untied, untied_bias,
-                 float(length_penalty))
+                 float(length_penalty), str(compute_dtype))
     store = model.__dict__.setdefault("_generate_compiled", {})
     if cache_key not in store:
         store[cache_key] = jax.jit(run)
