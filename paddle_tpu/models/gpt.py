@@ -232,7 +232,7 @@ class GPTForCausalLM(nn.Layer):
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=None, seed=None, eos_token_id=None, num_beams=1,
-                 length_penalty=1.0, dtype=None):
+                 length_penalty=1.0, dtype=None, attention_mask=None):
         """Autoregressive decode with a KV cache, compiled as ONE program
         (prefill + lax.scan; static shapes, dynamic_update_slice cache).
         temperature=0 decodes greedily; otherwise samples (top_k optional);
@@ -242,11 +242,17 @@ class GPTForCausalLM(nn.Layer):
         Sequences are [b, prompt + max_new_tokens] ids including the prompt.
         See _gpt_generate/_gpt_beam_search for the TPU design notes."""
         if num_beams > 1:
+            if attention_mask is not None:
+                raise ValueError("attention_mask (ragged batches) is not "
+                                 "supported with beam search yet; decode "
+                                 "ragged rows separately or pad-left and "
+                                 "sample/greedy")
             return _gpt_beam_search(self, input_ids, max_new_tokens,
                                     num_beams, eos_token_id, length_penalty,
                                     dtype=dtype)
         return _gpt_generate(self, input_ids, max_new_tokens, temperature,
-                             top_k, seed, eos_token_id, dtype=dtype)
+                             top_k, seed, eos_token_id, dtype=dtype,
+                             attention_mask=attention_mask)
 
     def pipeline_split(self, pp_degree):
         """Split into (pre, stages, post_loss) for distributed.pipeline.
@@ -292,8 +298,12 @@ def _decode_fns(cfg, untied, untied_bias):
         var = jnp.mean((x - mu) ** 2, -1, keepdims=True)
         return (x - mu) / jnp.sqrt(var + 1e-5) * w + bb
 
-    def block(p, i, x, kc, vc, pos):
-        """x [B, t, h] starting at absolute position `pos`."""
+    def block(p, i, x, kc, vc, pos, key_valid=None):
+        """x [B, t, h] whose first column sits at cache column `pos`.
+        key_valid [B, T] (optional): False columns (left-pad slots) are
+        masked out of every real query; a pad-position query still sees
+        itself so its softmax row is never empty (its lane is garbage that
+        no valid query ever reads)."""
         pre = f"gpt.blocks.{i}."
         bb, t = x.shape[0], x.shape[1]
         T = kc.shape[3]
@@ -305,13 +315,16 @@ def _decode_fns(cfg, untied, untied_bias):
         v = jnp.moveaxis(qkv[:, :, 2], 1, 2)
         kc = jax.lax.dynamic_update_slice(kc, k[None], (i, 0, 0, pos, 0))
         vc = jax.lax.dynamic_update_slice(vc, v[None], (i, 0, 0, pos, 0))
-        # causal over absolute positions: query row r (absolute pos+r) sees
+        # causal over cache columns: query row r (column pos+r) sees
         # cache column c iff c <= pos + r
         cols = jnp.arange(T)[None, :]
         rows = pos + jnp.arange(t)[:, None]
-        mask = cols <= rows                            # [t, T]
+        mask = (cols <= rows)[None]                    # [1, t, T]
+        if key_valid is not None:
+            self_col = cols[None] == rows[None]        # keep self: no NaN rows
+            mask = mask & (key_valid[:, None, :] | self_col)
         att = jnp.einsum("bhtd,bhTd->bhtT", q, kc[i]) * scale
-        att = jnp.where(mask[None, None], att, -jnp.inf)
+        att = jnp.where(mask[:, None], att, -jnp.inf)
         att = jax.nn.softmax(att, axis=-1)
         out = jnp.einsum("bhtT,bhTd->bhtd", att, vc[i])
         out = jnp.moveaxis(out, 1, 2).reshape(bb, t, Hh * hd)
@@ -330,12 +343,16 @@ def _decode_fns(cfg, untied, untied_bias):
             return out + p["lm_head.bias"] if untied_bias else out
         return h @ p["gpt.wte.weight"].T
 
-    def fwd(p, tok_ids, pos, kc, vc):
+    def fwd(p, tok_ids, pos, kc, vc, key_valid=None, pos_ids=None):
         t = tok_ids.shape[1]
-        x = jnp.take(p["gpt.wte.weight"], tok_ids, axis=0) \
-            + jax.lax.dynamic_slice_in_dim(p["gpt.wpe.weight"], pos, t)
+        if pos_ids is None:
+            wpe = jax.lax.dynamic_slice_in_dim(p["gpt.wpe.weight"], pos, t)
+        else:
+            # ragged rows: per-row position ids (left-padding support)
+            wpe = jnp.take(p["gpt.wpe.weight"], pos_ids, axis=0)
+        x = jnp.take(p["gpt.wte.weight"], tok_ids, axis=0) + wpe
         for i in range(L):
-            x, kc, vc = block(p, i, x, kc, vc, pos)
+            x, kc, vc = block(p, i, x, kc, vc, pos, key_valid=key_valid)
         return x, kc, vc
 
     return fwd, logits_of
@@ -389,7 +406,7 @@ def _decode_setup(model, input_ids, max_new_tokens):
 
 
 def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
-                  seed, eos_token_id, dtype=None):
+                  seed, eos_token_id, dtype=None, attention_mask=None):
     """TPU-native autoregressive decode: ONE jitted program — prefill plus a
     lax.scan over decode steps against a static-shape KV cache updated with
     dynamic_update_slice. No per-step retrace, no dynamic shapes; the decode
@@ -408,6 +425,7 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
     hd = cfg.hidden_size // Hh
     fwd, logits_of = _decode_fns(cfg, untied, untied_bias)
     compute_dtype = _decode_compute_dtype(dtype)
+    mask = _left_pad_mask(attention_mask, b, s0)
 
     def pick(logits, key):
         if temperature == 0.0:
@@ -418,7 +436,7 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
             lg = jnp.where(lg < kth, -jnp.inf, lg)
         return jax.random.categorical(key, lg).astype(jnp.int32)
 
-    def run(p, ids_, key):
+    def run(p, ids_, key, mask_):
         if compute_dtype is not None:
             # serving precision: bf16 params + bf16 KV cache (half the HBM
             # traffic the decode loop is bound by); logits pick in f32
@@ -427,7 +445,19 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
                  for k, v in p.items()}
         kc = jnp.zeros((L, b, Hh, T, hd), compute_dtype or jnp.float32)
         vc = jnp.zeros_like(kc)
-        x, kc, vc = fwd(p, ids_, 0, kc, vc)
+        if mask_ is None:
+            key_valid = pos_ids = None
+            lens = None
+        else:
+            # ragged batch, LEFT-padded: row i's real tokens start at column
+            # s0 - len_i; generated columns (>= s0) are always valid
+            lens = jnp.sum(mask_, axis=1).astype(jnp.int32)       # [b]
+            key_valid = jnp.concatenate(
+                [mask_.astype(bool), jnp.ones((b, T - s0), bool)], axis=1)
+            pos_ids = jnp.maximum(
+                jnp.arange(s0)[None, :] - (s0 - lens)[:, None], 0)
+        x, kc, vc = fwd(p, ids_, 0, kc, vc, key_valid=key_valid,
+                        pos_ids=pos_ids)
         tok = pick(logits_of(p, x[:, -1]).astype(jnp.float32), key)
         done = jnp.zeros((b,), bool) if eos_token_id is None else \
             (tok == eos_token_id)
@@ -435,8 +465,13 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
         def step(carry, i):
             tok, kc, vc, key, done = carry
             key, sub = jax.random.split(key)
-            # the fed token is the (i-1)-th generated one: absolute s0 + i - 1
-            x, kc, vc = fwd(p, tok[:, None], s0 + i - 1, kc, vc)
+            # the fed token is the (i-1)-th generated one: cache column
+            # s0 + i - 1; its POSITION id is per-row (len_i + i - 1) when
+            # the batch is ragged
+            step_pos = None if lens is None else \
+                (lens + (i - 1))[:, None]
+            x, kc, vc = fwd(p, tok[:, None], s0 + i - 1, kc, vc,
+                            key_valid=key_valid, pos_ids=step_pos)
             nxt = pick(logits_of(p, x[:, 0]).astype(jnp.float32), sub)
             if eos_token_id is not None:
                 nxt = jnp.where(done, eos_token_id, nxt)
@@ -449,7 +484,8 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
             if max_new_tokens > 1 else tok[:, None]
 
     cache_key = (b, s0, max_new_tokens, float(temperature), top_k,
-                 eos_token_id, untied, untied_bias, str(compute_dtype))
+                 eos_token_id, untied, untied_bias, str(compute_dtype),
+                 mask is not None)
     store = model.__dict__.setdefault("_generate_compiled", {})
     if cache_key not in store:
         store[cache_key] = jax.jit(run)
@@ -462,9 +498,37 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
         from ..core.generator import default_generator
 
         key = default_generator().split()
-    out = store[cache_key](params, ids, key)
+    out = store[cache_key](params, ids, key, mask)
     full = jnp.concatenate([ids.astype(out.dtype), out], axis=1)
     return Tensor(full)
+
+
+def _left_pad_mask(attention_mask, b, s0):
+    """Validate/convert a [b, s0] keep-mask for ragged decode. Rows must be
+    LEFT-padded (zeros then ones) so the last column is every row's final
+    real token — the position the next-token logits read."""
+    if attention_mask is None:
+        return None
+    import jax.numpy as jnp
+
+    m = attention_mask._data if isinstance(attention_mask, Tensor) else \
+        jnp.asarray(np.asarray(attention_mask))
+    if m.shape != (b, s0):
+        raise ValueError(f"attention_mask shape {tuple(m.shape)} != "
+                         f"{(b, s0)}")
+    mi = m.astype(jnp.int32)
+    host = np.asarray(mi)  # generate() is a host API: masks arrive concrete
+    if not np.isin(host, (0, 1)).all():
+        raise ValueError("attention_mask must be binary (0 = pad, 1 = "
+                         "attend); got other values")
+    if not (np.diff(host, axis=1) >= 0).all():
+        raise ValueError(
+            "attention_mask must be LEFT-padded (0s then 1s per row): "
+            "right-padded prompts would put pad tokens at the positions "
+            "the decode reads — re-pad with the prompt at the END")
+    if not host.any(axis=1).all():
+        raise ValueError("attention_mask has an all-pad row")
+    return mi
 
 
 def _gpt_beam_search(model, input_ids, max_new_tokens, num_beams,

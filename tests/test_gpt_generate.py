@@ -260,3 +260,60 @@ def test_beam_accepts_dtype_and_f32_is_default_path():
     n_mid = len(model._generate_compiled)
     model.generate(ids, max_new_tokens=3, temperature=0.0, dtype="float32")
     assert len(model._generate_compiled) == n_mid
+
+
+class TestRaggedBatchDecode:
+    def test_left_padded_rows_match_individual_decodes(self):
+        """Batched ragged serving: each LEFT-padded row's greedy continuation
+        must EXACTLY match decoding that prompt alone (positions, masks and
+        cache columns all line up)."""
+        model = _model()
+        rng = np.random.RandomState(4)
+        p1 = rng.randint(1, 128, 4).astype(np.int32)   # len 4
+        p2 = rng.randint(1, 128, 7).astype(np.int32)   # len 7
+        s0 = 7
+        batch = np.zeros((2, s0), np.int32)
+        batch[0, s0 - 4:] = p1
+        batch[1] = p2
+        mask = np.zeros((2, s0), np.int32)
+        mask[0, s0 - 4:] = 1
+        mask[1] = 1
+
+        out = np.asarray(model.generate(
+            paddle.to_tensor(batch), max_new_tokens=6, temperature=0.0,
+            attention_mask=paddle.to_tensor(mask))._data)
+
+        solo1 = np.asarray(model.generate(
+            paddle.to_tensor(p1[None]), max_new_tokens=6,
+            temperature=0.0)._data)
+        solo2 = np.asarray(model.generate(
+            paddle.to_tensor(p2[None]), max_new_tokens=6,
+            temperature=0.0)._data)
+        np.testing.assert_array_equal(out[0, s0:], solo1[0, 4:])
+        np.testing.assert_array_equal(out[1, s0:], solo2[0, 7:])
+
+    def test_mask_validation(self):
+        model = _model()
+        ids = paddle.to_tensor(np.ones((2, 5), np.int32))
+        right_pad = paddle.to_tensor(
+            np.array([[1, 1, 1, 0, 0]] * 2, np.int32))
+        with pytest.raises(ValueError, match="LEFT-padded"):
+            model.generate(ids, max_new_tokens=2, temperature=0.0,
+                           attention_mask=right_pad)
+        all_pad = paddle.to_tensor(np.zeros((2, 5), np.int32))
+        with pytest.raises(ValueError, match="all-pad"):
+            model.generate(ids, max_new_tokens=2, temperature=0.0,
+                           attention_mask=all_pad)
+        with pytest.raises(ValueError, match="not.*supported|supported"):
+            model.generate(ids, max_new_tokens=2, num_beams=2,
+                           attention_mask=paddle.to_tensor(
+                               np.ones((2, 5), np.int32)))
+
+
+def test_non_binary_mask_rejected():
+    model = _model()
+    ids = paddle.to_tensor(np.ones((1, 4), np.int32))
+    bad = paddle.to_tensor(np.array([[0, 1, 2, 2]], np.int32))
+    with pytest.raises(ValueError, match="binary"):
+        model.generate(ids, max_new_tokens=2, temperature=0.0,
+                       attention_mask=bad)
