@@ -374,3 +374,35 @@ def test_reverse_bridge_guards():
                                    dropout=0.0, num_experts=2, moe_every=1))
     with pytest.raises(ValueError, match="MoE"):
         gpt2_to_huggingface(moe)
+
+
+def test_ragged_decode_parity_with_hf():
+    """Left-padded batched generate must match transformers' own padded
+    greedy decode token for token (positions + masks validated externally)."""
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    from paddle_tpu.models import gpt2_from_huggingface
+
+    torch.manual_seed(5)
+    hf = GPT2LMHeadModel(GPT2Config(
+        vocab_size=96, n_positions=48, n_embd=32, n_layer=2, n_head=2,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)).eval()
+    ours = gpt2_from_huggingface(hf_model=hf)
+
+    rng = np.random.RandomState(0)
+    s0 = 8
+    ids = np.zeros((2, s0), np.int64)
+    mask = np.zeros((2, s0), np.int64)
+    for r, n in enumerate((4, 8)):
+        ids[r, s0 - n:] = rng.randint(1, 96, n)
+        mask[r, s0 - n:] = 1
+    with torch.no_grad():
+        want = hf.generate(torch.tensor(ids),
+                           attention_mask=torch.tensor(mask),
+                           max_new_tokens=7, do_sample=False,
+                           pad_token_id=0).numpy()
+    got = np.asarray(ours.generate(
+        paddle.to_tensor(ids.astype(np.int32)), max_new_tokens=7,
+        temperature=0.0,
+        attention_mask=paddle.to_tensor(mask.astype(np.int32)))._data)
+    np.testing.assert_array_equal(got[:, s0:], want[:, s0:])
