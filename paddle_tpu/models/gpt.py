@@ -242,14 +242,10 @@ class GPTForCausalLM(nn.Layer):
         Sequences are [b, prompt + max_new_tokens] ids including the prompt.
         See _gpt_generate/_gpt_beam_search for the TPU design notes."""
         if num_beams > 1:
-            if attention_mask is not None:
-                raise ValueError("attention_mask (ragged batches) is not "
-                                 "supported with beam search yet; decode "
-                                 "ragged rows separately or pad-left and "
-                                 "sample/greedy")
             return _gpt_beam_search(self, input_ids, max_new_tokens,
                                     num_beams, eos_token_id, length_penalty,
-                                    dtype=dtype)
+                                    dtype=dtype,
+                                    attention_mask=attention_mask)
         return _gpt_generate(self, input_ids, max_new_tokens, temperature,
                              top_k, seed, eos_token_id, dtype=dtype,
                              attention_mask=attention_mask)
@@ -445,17 +441,7 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
                  for k, v in p.items()}
         kc = jnp.zeros((L, b, Hh, T, hd), compute_dtype or jnp.float32)
         vc = jnp.zeros_like(kc)
-        if mask_ is None:
-            key_valid = pos_ids = None
-            lens = None
-        else:
-            # ragged batch, LEFT-padded: row i's real tokens start at column
-            # s0 - len_i; generated columns (>= s0) are always valid
-            lens = jnp.sum(mask_, axis=1).astype(jnp.int32)       # [b]
-            key_valid = jnp.concatenate(
-                [mask_.astype(bool), jnp.ones((b, T - s0), bool)], axis=1)
-            pos_ids = jnp.maximum(
-                jnp.arange(s0)[None, :] - (s0 - lens)[:, None], 0)
+        lens, key_valid, pos_ids = _ragged_setup(mask_, b, s0, T)
         x, kc, vc = fwd(p, ids_, 0, kc, vc, key_valid=key_valid,
                         pos_ids=pos_ids)
         tok = pick(logits_of(p, x[:, -1]).astype(jnp.float32), key)
@@ -503,6 +489,21 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
     return Tensor(full)
 
 
+def _ragged_setup(mask_, b, s0, T):
+    """Shared ragged-batch derivation for both decode programs: per-row real
+    lengths, the [b, T] key-validity mask (generated columns always valid)
+    and the prefill position ids for LEFT-padded prompts."""
+    import jax.numpy as jnp
+
+    if mask_ is None:
+        return None, None, None
+    lens = jnp.sum(mask_, axis=1).astype(jnp.int32)
+    key_valid = jnp.concatenate(
+        [mask_.astype(bool), jnp.ones((b, T - s0), bool)], axis=1)
+    pos_ids = jnp.maximum(jnp.arange(s0)[None, :] - (s0 - lens)[:, None], 0)
+    return lens, key_valid, pos_ids
+
+
 def _left_pad_mask(attention_mask, b, s0):
     """Validate/convert a [b, s0] keep-mask for ragged decode. Rows must be
     LEFT-padded (zeros then ones) so the last column is every row's final
@@ -532,7 +533,8 @@ def _left_pad_mask(attention_mask, b, s0):
 
 
 def _gpt_beam_search(model, input_ids, max_new_tokens, num_beams,
-                     eos_token_id, length_penalty, dtype=None):
+                     eos_token_id, length_penalty, dtype=None,
+                     attention_mask=None):
     """Beam search over the same fused KV-cache program: prefill once at
     batch b, tile the cache per beam ([L, b*K, H, T, hd]), and lax.scan
     steps that (a) add log-probs, (b) take the joint top-K over K*V
@@ -556,8 +558,9 @@ def _gpt_beam_search(model, input_ids, max_new_tokens, num_beams,
     fwd, logits_of = _decode_fns(cfg, untied, untied_bias)
     eos = -1 if eos_token_id is None else int(eos_token_id)
     compute_dtype = _decode_compute_dtype(dtype)
+    mask = _left_pad_mask(attention_mask, b, s0)
 
-    def run(p, ids_):
+    def run(p, ids_, mask_):
         if compute_dtype is not None:
             # bf16 cache matters MOST here: the cache is K x larger
             p = {k: (v.astype(compute_dtype)
@@ -565,7 +568,9 @@ def _gpt_beam_search(model, input_ids, max_new_tokens, num_beams,
                  for k, v in p.items()}
         kc = jnp.zeros((L, b, Hh, T, hd), compute_dtype or jnp.float32)
         vc = jnp.zeros_like(kc)
-        x, kc, vc = fwd(p, ids_, 0, kc, vc)
+        lens, key_valid, pos_ids = _ragged_setup(mask_, b, s0, T)
+        x, kc, vc = fwd(p, ids_, 0, kc, vc, key_valid=key_valid,
+                        pos_ids=pos_ids)
         logp0 = jax.nn.log_softmax(
             logits_of(p, x[:, -1]).astype(jnp.float32), -1)      # [b, V]
         scores, tok = jax.lax.top_k(logp0, K)                    # [b, K]
@@ -574,13 +579,19 @@ def _gpt_beam_search(model, input_ids, max_new_tokens, num_beams,
         # tile cache per beam: batch-major layout [b*K] = (b0k0, b0k1, ...)
         kc = jnp.repeat(kc, K, axis=1)
         vc = jnp.repeat(vc, K, axis=1)
+        kv_beam = None if key_valid is None else \
+            jnp.repeat(key_valid, K, axis=0)                     # [b*K, T]
+        lens_beam = None if lens is None else jnp.repeat(lens, K)
         batch_base = (jnp.arange(b) * K)[:, None]                # [b, 1]
 
         gen_len = jnp.ones_like(scores)  # per-beam generated length
 
         def step(carry, i):
             tok, scores, done, gen_len, kc, vc = carry
-            x, kc, vc = fwd(p, tok.reshape(b * K, 1), s0 + i - 1, kc, vc)
+            step_pos = None if lens_beam is None else \
+                (lens_beam + (i - 1))[:, None]
+            x, kc, vc = fwd(p, tok.reshape(b * K, 1), s0 + i - 1, kc, vc,
+                            key_valid=kv_beam, pos_ids=step_pos)
             logp = jax.nn.log_softmax(
                 logits_of(p, x[:, 0]).astype(jnp.float32),
                 -1).reshape(b, K, V)
@@ -634,11 +645,11 @@ def _gpt_beam_search(model, input_ids, max_new_tokens, num_beams,
         return seq, final_score
 
     cache_key = ("beam", b, s0, max_new_tokens, K, eos, untied, untied_bias,
-                 float(length_penalty), str(compute_dtype))
+                 float(length_penalty), str(compute_dtype), mask is not None)
     store = model.__dict__.setdefault("_generate_compiled", {})
     if cache_key not in store:
         store[cache_key] = jax.jit(run)
-    out, score = store[cache_key](params, ids)
+    out, score = store[cache_key](params, ids, mask)
     full = jnp.concatenate([ids.astype(out.dtype), out], axis=1)
     return Tensor(full), Tensor(score)
 

@@ -304,10 +304,7 @@ class TestRaggedBatchDecode:
         with pytest.raises(ValueError, match="all-pad"):
             model.generate(ids, max_new_tokens=2, temperature=0.0,
                            attention_mask=all_pad)
-        with pytest.raises(ValueError, match="not.*supported|supported"):
-            model.generate(ids, max_new_tokens=2, num_beams=2,
-                           attention_mask=paddle.to_tensor(
-                               np.ones((2, 5), np.int32)))
+
 
 
 def test_non_binary_mask_rejected():
@@ -317,3 +314,31 @@ def test_non_binary_mask_rejected():
     with pytest.raises(ValueError, match="binary"):
         model.generate(ids, max_new_tokens=2, temperature=0.0,
                        attention_mask=bad)
+
+
+def test_ragged_beam_matches_solo_beam():
+    """Beam search over a left-padded ragged batch: each row's best beam
+    must match beam-decoding that prompt alone."""
+    model = _model()
+    rng = np.random.RandomState(6)
+    p1 = rng.randint(1, 128, 3).astype(np.int32)
+    p2 = rng.randint(1, 128, 6).astype(np.int32)
+    s0 = 6
+    batch = np.zeros((2, s0), np.int32)
+    mask = np.zeros((2, s0), np.int32)
+    batch[0, s0 - 3:] = p1; mask[0, s0 - 3:] = 1
+    batch[1] = p2; mask[1] = 1
+
+    seqs, scores = model.generate(paddle.to_tensor(batch), max_new_tokens=5,
+                                  num_beams=3,
+                                  attention_mask=paddle.to_tensor(mask))
+    out = np.asarray(seqs._data)
+    s1, sc1 = model.generate(paddle.to_tensor(p1[None]), max_new_tokens=5,
+                             num_beams=3)
+    s2, sc2 = model.generate(paddle.to_tensor(p2[None]), max_new_tokens=5,
+                             num_beams=3)
+    np.testing.assert_array_equal(out[0, s0:], np.asarray(s1._data)[0, 3:])
+    np.testing.assert_array_equal(out[1, s0:], np.asarray(s2._data)[0, 6:])
+    np.testing.assert_allclose(np.asarray(scores._data),
+                               [float(np.asarray(sc1._data)[0]),
+                                float(np.asarray(sc2._data)[0])], rtol=1e-5)
