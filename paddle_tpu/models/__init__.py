@@ -28,6 +28,7 @@ from .gpt import (  # noqa: F401
 )
 from .hf_bridge import (  # noqa: F401
     bert_from_huggingface,
+    ernie_from_huggingface,
     gpt2_from_huggingface,
     gpt2_to_huggingface,
 )

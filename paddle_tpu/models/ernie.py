@@ -19,7 +19,8 @@ from ..nn import functional as F
 class ErnieConfig:
     def __init__(self, vocab_size=18000, hidden_size=768, num_layers=12, num_heads=12,
                  intermediate_size=3072, max_position=513, type_vocab_size=2,
-                 task_type_vocab_size=0, dropout=0.1, activation="relu"):
+                 task_type_vocab_size=0, dropout=0.1, activation="relu",
+                 layer_norm_eps=1e-5):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -30,6 +31,7 @@ class ErnieConfig:
         self.task_type_vocab_size = task_type_vocab_size  # >0: ERNIE-2.0 task emb
         self.dropout = dropout
         self.activation = activation
+        self.layer_norm_eps = layer_norm_eps
 
     @staticmethod
     def base():
@@ -81,6 +83,10 @@ class ErnieModel(nn.Layer):
         )
         self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_layers)
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        eps = getattr(cfg, "layer_norm_eps", 1e-5)
+        for _, sub in self.named_sublayers(include_self=True):
+            if isinstance(sub, nn.LayerNorm):
+                sub._epsilon = eps
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
                 task_type_ids=None):

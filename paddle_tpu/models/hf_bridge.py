@@ -95,6 +95,59 @@ def gpt2_from_huggingface(hf_model=None, model_name=None, dtype="float32"):
     return model
 
 
+def _bert_family_sd(hf_model, prefix, dtype):
+    """state_dict -> numpy with the wrapper prefix stripped and the pooler
+    presence guarded (shared by the BERT and ERNIE bridges)."""
+    sd = {k: v.detach().cpu().numpy().astype(dtype)
+          for k, v in hf_model.state_dict().items()}
+    if any(k.startswith(prefix) for k in sd):
+        sd = {k[len(prefix):]: v for k, v in sd.items()
+              if k.startswith(prefix)}
+    if "pooler.dense.weight" not in sd:
+        raise ValueError(
+            "checkpoint has no pooler (e.g. a bare MLM head / "
+            "add_pooling_layer=False); convert the base model with a pooler")
+    return sd
+
+
+def _map_bert_embeddings_and_pooler(put, sd):
+    """Shared word/position/token-type/LN embedding + pooler mapping."""
+    put("embeddings.word.weight", sd["embeddings.word_embeddings.weight"])
+    put("embeddings.position.weight",
+        sd["embeddings.position_embeddings.weight"])
+    put("embeddings.token_type.weight",
+        sd["embeddings.token_type_embeddings.weight"])
+    put("embeddings.ln.weight", sd["embeddings.LayerNorm.weight"])
+    put("embeddings.ln.bias", sd["embeddings.LayerNorm.bias"])
+    put("pooler.weight", sd["pooler.dense.weight"], transpose=True)
+    put("pooler.bias", sd["pooler.dense.bias"])
+
+
+def _map_bert_encoder(put, sd, num_layers):
+    """Shared BERT-family encoder mapping (torch [out,in] Linears transpose
+    into our [in,out]; post-LN layout) — used by the BERT and ERNIE bridges."""
+    for i in range(num_layers):
+        hf = f"encoder.layer.{i}."
+        us = f"encoder.layers.{i}."
+        for mine, theirs in (("q_proj", "attention.self.query"),
+                             ("k_proj", "attention.self.key"),
+                             ("v_proj", "attention.self.value"),
+                             ("out_proj", "attention.output.dense")):
+            put(us + f"self_attn.{mine}.weight",
+                sd[hf + theirs + ".weight"], transpose=True)
+            put(us + f"self_attn.{mine}.bias", sd[hf + theirs + ".bias"])
+        put(us + "norm1.weight", sd[hf + "attention.output.LayerNorm.weight"])
+        put(us + "norm1.bias", sd[hf + "attention.output.LayerNorm.bias"])
+        put(us + "linear1.weight", sd[hf + "intermediate.dense.weight"],
+            transpose=True)
+        put(us + "linear1.bias", sd[hf + "intermediate.dense.bias"])
+        put(us + "linear2.weight", sd[hf + "output.dense.weight"],
+            transpose=True)
+        put(us + "linear2.bias", sd[hf + "output.dense.bias"])
+        put(us + "norm2.weight", sd[hf + "output.LayerNorm.weight"])
+        put(us + "norm2.bias", sd[hf + "output.LayerNorm.bias"])
+
+
 def bert_from_huggingface(hf_model=None, model_name=None, dtype="float32"):
     """Build this framework's BertModel carrying a transformers BertModel's
     weights. torch Linear stores [out, in] — transposed into this
@@ -128,51 +181,14 @@ def bert_from_huggingface(hf_model=None, model_name=None, dtype="float32"):
                      layer_norm_eps=float(
                          getattr(hc, "layer_norm_eps", 1e-12)))
     model = BertModel(cfg)
-    sd = {k: v.detach().cpu().numpy().astype(dtype)
-          for k, v in hf_model.state_dict().items()}
-    # from_pretrained on a full checkpoint may prefix with "bert."
-    if any(k.startswith("bert.") for k in sd):
-        sd = {k[len("bert."):]: v for k, v in sd.items()
-              if k.startswith("bert.")}
-    if "pooler.dense.weight" not in sd:
-        raise ValueError(
-            "checkpoint has no pooler (e.g. BertForMaskedLM / "
-            "add_pooling_layer=False); convert the base BertModel with a "
-            "pooler, or extend the bridge for pooler-less heads")
+    sd = _bert_family_sd(hf_model, "bert.", dtype)
     ours = dict(model.named_parameters())
 
     def put(name, arr, transpose=False):
         _put(ours, name, arr, transpose=transpose)
 
-    put("embeddings.word.weight", sd["embeddings.word_embeddings.weight"])
-    put("embeddings.position.weight",
-        sd["embeddings.position_embeddings.weight"])
-    put("embeddings.token_type.weight",
-        sd["embeddings.token_type_embeddings.weight"])
-    put("embeddings.ln.weight", sd["embeddings.LayerNorm.weight"])
-    put("embeddings.ln.bias", sd["embeddings.LayerNorm.bias"])
-    for i in range(cfg.num_layers):
-        hf = f"encoder.layer.{i}."
-        us = f"encoder.layers.{i}."
-        for mine, theirs in (("q_proj", "attention.self.query"),
-                             ("k_proj", "attention.self.key"),
-                             ("v_proj", "attention.self.value"),
-                             ("out_proj", "attention.output.dense")):
-            put(us + f"self_attn.{mine}.weight",
-                sd[hf + theirs + ".weight"], transpose=True)
-            put(us + f"self_attn.{mine}.bias", sd[hf + theirs + ".bias"])
-        put(us + "norm1.weight", sd[hf + "attention.output.LayerNorm.weight"])
-        put(us + "norm1.bias", sd[hf + "attention.output.LayerNorm.bias"])
-        put(us + "linear1.weight", sd[hf + "intermediate.dense.weight"],
-            transpose=True)
-        put(us + "linear1.bias", sd[hf + "intermediate.dense.bias"])
-        put(us + "linear2.weight", sd[hf + "output.dense.weight"],
-            transpose=True)
-        put(us + "linear2.bias", sd[hf + "output.dense.bias"])
-        put(us + "norm2.weight", sd[hf + "output.LayerNorm.weight"])
-        put(us + "norm2.bias", sd[hf + "output.LayerNorm.bias"])
-    put("pooler.weight", sd["pooler.dense.weight"], transpose=True)
-    put("pooler.bias", sd["pooler.dense.bias"])
+    _map_bert_embeddings_and_pooler(put, sd)
+    _map_bert_encoder(put, sd, cfg.num_layers)
     model.eval()
     return model
 
@@ -235,3 +251,49 @@ def gpt2_to_huggingface(model, hf_model=None):
                          f"unexpected: {unexpected}")
     hf_model.eval()
     return hf_model
+
+
+def ernie_from_huggingface(hf_model=None, model_name=None, dtype="float32"):
+    """Build this framework's ErnieModel from a transformers ErnieModel
+    (the PaddleNLP-lineage ERNIE port in transformers): same BERT-family
+    encoder mapping plus the optional task-type embedding table
+    (tests/test_hf_bridge.py pins hidden+pooler parity)."""
+    if hf_model is None:
+        if model_name is None:
+            raise ValueError("pass hf_model= or model_name=")
+        from transformers import ErnieModel as HFErnie
+
+        hf_model = HFErnie.from_pretrained(model_name)
+    hc = hf_model.config
+    if getattr(hc, "hidden_act", "gelu") not in ("gelu", "relu"):
+        raise ValueError(f"unsupported hidden_act {hc.hidden_act!r}")
+    pet = getattr(hc, "position_embedding_type", "absolute")
+    if pet != "absolute":
+        raise ValueError(f"unsupported position_embedding_type {pet!r}")
+    from .ernie import ErnieConfig, ErnieModel
+
+    use_task = bool(getattr(hc, "use_task_id", False))
+    cfg = ErnieConfig(
+        vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
+        num_layers=hc.num_hidden_layers, num_heads=hc.num_attention_heads,
+        intermediate_size=hc.intermediate_size,
+        max_position=hc.max_position_embeddings,
+        type_vocab_size=hc.type_vocab_size,
+        task_type_vocab_size=(getattr(hc, "task_type_vocab_size", 0)
+                              if use_task else 0),
+        dropout=0.0, activation=hc.hidden_act,
+        layer_norm_eps=float(getattr(hc, "layer_norm_eps", 1e-12)))
+    model = ErnieModel(cfg)
+    sd = _bert_family_sd(hf_model, "ernie.", dtype)
+    ours = dict(model.named_parameters())
+
+    def put(name, arr, transpose=False):
+        _put(ours, name, arr, transpose=transpose)
+
+    _map_bert_embeddings_and_pooler(put, sd)
+    if use_task:
+        put("embeddings.task_type.weight",
+            sd["embeddings.task_type_embeddings.weight"])
+    _map_bert_encoder(put, sd, cfg.num_layers)
+    model.eval()
+    return model

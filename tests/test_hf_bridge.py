@@ -406,3 +406,38 @@ def test_ragged_decode_parity_with_hf():
         temperature=0.0,
         attention_mask=paddle.to_tensor(mask.astype(np.int32)))._data)
     np.testing.assert_array_equal(got[:, s0:], want[:, s0:])
+
+
+def test_ernie_bridge_parity_with_task_types():
+    """transformers ErnieModel (task-type embeddings on) converts with
+    hidden-state + pooler parity — third external model validation."""
+    from transformers import ErnieConfig as HFCfg, ErnieModel as HFErnie
+
+    from paddle_tpu.models import ernie_from_huggingface
+
+    torch.manual_seed(1)
+    hf = HFErnie(HFCfg(vocab_size=150, hidden_size=32, num_hidden_layers=2,
+                       num_attention_heads=2, intermediate_size=64,
+                       max_position_embeddings=64, type_vocab_size=2,
+                       task_type_vocab_size=3, use_task_id=True,
+                       hidden_dropout_prob=0.0,
+                       attention_probs_dropout_prob=0.0)).eval()
+    ours = ernie_from_huggingface(hf_model=hf)
+    assert ours.embeddings.task_type is not None
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 150, (2, 10)).astype(np.int64)
+    tok = rng.randint(0, 2, (2, 10)).astype(np.int64)
+    task = rng.randint(0, 3, (2, 10)).astype(np.int64)
+    with torch.no_grad():
+        out = hf(torch.tensor(ids), token_type_ids=torch.tensor(tok),
+                 task_type_ids=torch.tensor(task))
+    seq, pooled = ours(paddle.to_tensor(ids.astype(np.int32)),
+                       paddle.to_tensor(tok.astype(np.int32)),
+                       task_type_ids=paddle.to_tensor(task.astype(np.int32)))
+    np.testing.assert_allclose(np.asarray(seq._data),
+                               out.last_hidden_state.numpy(),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(pooled._data),
+                               out.pooler_output.numpy(),
+                               rtol=2e-4, atol=2e-4)
