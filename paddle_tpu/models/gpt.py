@@ -231,24 +231,32 @@ class GPTForCausalLM(nn.Layer):
         return loss
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_k=None, seed=None, eos_token_id=None, num_beams=1,
-                 length_penalty=1.0, dtype=None, attention_mask=None):
+                 top_k=None, top_p=None, seed=None, eos_token_id=None,
+                 num_beams=1, length_penalty=1.0, dtype=None,
+                 attention_mask=None):
         """Autoregressive decode with a KV cache, compiled as ONE program
         (prefill + lax.scan; static shapes, dynamic_update_slice cache).
-        temperature=0 decodes greedily; otherwise samples (top_k optional);
+        temperature=0 decodes greedily; otherwise samples — top_k keeps the
+        k highest logits and top_p then applies nucleus filtering (smallest
+        prefix reaching mass top_p; needs top_p < 1.0 to take effect).
         num_beams>1 runs beam search and returns a (sequences, scores)
         pair — the best beam per batch row plus its joint log-prob
-        (PaddleNLP generate convention).
+        (PaddleNLP generate convention); sampling knobs (temperature/top_k/
+        top_p) do not apply to beam search, which raises if they are set.
         Sequences are [b, prompt + max_new_tokens] ids including the prompt.
         See _gpt_generate/_gpt_beam_search for the TPU design notes."""
         if num_beams > 1:
+            if top_p is not None or top_k is not None:
+                raise ValueError(
+                    "top_k/top_p are sampling knobs; beam search is "
+                    "deterministic — drop them or use num_beams=1")
             return _gpt_beam_search(self, input_ids, max_new_tokens,
                                     num_beams, eos_token_id, length_penalty,
                                     dtype=dtype,
                                     attention_mask=attention_mask)
         return _gpt_generate(self, input_ids, max_new_tokens, temperature,
                              top_k, seed, eos_token_id, dtype=dtype,
-                             attention_mask=attention_mask)
+                             attention_mask=attention_mask, top_p=top_p)
 
     def pipeline_split(self, pp_degree):
         """Split into (pre, stages, post_loss) for distributed.pipeline.
@@ -402,7 +410,8 @@ def _decode_setup(model, input_ids, max_new_tokens):
 
 
 def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
-                  seed, eos_token_id, dtype=None, attention_mask=None):
+                  seed, eos_token_id, dtype=None, attention_mask=None,
+                  top_p=None):
     """TPU-native autoregressive decode: ONE jitted program — prefill plus a
     lax.scan over decode steps against a static-shape KV cache updated with
     dynamic_update_slice. No per-step retrace, no dynamic shapes; the decode
@@ -430,6 +439,16 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
         if top_k is not None and top_k > 0:
             kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
             lg = jnp.where(lg < kth, -jnp.inf, lg)
+        if top_p is not None and top_p < 1.0:
+            # nucleus: keep the smallest prefix of the sorted distribution
+            # whose mass reaches top_p (the top token always survives)
+            srt = jnp.sort(lg, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(srt, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            k_keep = jnp.sum(cum - probs < top_p, axis=-1)     # [b]
+            cutoff = jnp.take_along_axis(
+                srt, jnp.maximum(k_keep - 1, 0)[:, None], axis=-1)
+            lg = jnp.where(lg < cutoff, -jnp.inf, lg)
         return jax.random.categorical(key, lg).astype(jnp.int32)
 
     def run(p, ids_, key, mask_):
@@ -471,7 +490,7 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
 
     cache_key = (b, s0, max_new_tokens, float(temperature), top_k,
                  eos_token_id, untied, untied_bias, str(compute_dtype),
-                 mask is not None)
+                 mask is not None, None if top_p is None else float(top_p))
     store = model.__dict__.setdefault("_generate_compiled", {})
     if cache_key not in store:
         store[cache_key] = jax.jit(run)

@@ -342,3 +342,31 @@ def test_ragged_beam_matches_solo_beam():
     np.testing.assert_allclose(np.asarray(scores._data),
                                [float(np.asarray(sc1._data)[0]),
                                 float(np.asarray(sc2._data)[0])], rtol=1e-5)
+
+
+def test_top_p_sampling():
+    """Nucleus sampling: top_p -> 0 degenerates to greedy (only the argmax
+    survives the nucleus); seeded runs are deterministic."""
+    model = _model()
+    ids = paddle.to_tensor(np.ones((2, 4), np.int32))
+    greedy = np.asarray(model.generate(ids, max_new_tokens=6,
+                                       temperature=0.0)._data)
+    tiny_p = np.asarray(model.generate(ids, max_new_tokens=6,
+                                       temperature=1.0, top_p=1e-6,
+                                       seed=0)._data)
+    np.testing.assert_array_equal(tiny_p, greedy)
+    a = np.asarray(model.generate(ids, max_new_tokens=6, temperature=1.0,
+                                  top_p=0.9, seed=3)._data)
+    b = np.asarray(model.generate(ids, max_new_tokens=6, temperature=1.0,
+                                  top_p=0.9, seed=3)._data)
+    np.testing.assert_array_equal(a, b)
+    assert np.isfinite(a).all()
+
+
+def test_beam_rejects_sampling_knobs():
+    model = _model()
+    ids = paddle.to_tensor(np.ones((1, 4), np.int32))
+    with pytest.raises(ValueError, match="sampling knobs"):
+        model.generate(ids, max_new_tokens=2, num_beams=2, top_p=0.9)
+    with pytest.raises(ValueError, match="sampling knobs"):
+        model.generate(ids, max_new_tokens=2, num_beams=2, top_k=5)
