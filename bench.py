@@ -16,11 +16,12 @@ Other BASELINE.md milestone configs measure standalone via --config:
   --config bert_dp       BERT-base pretrain step, tokens/s
   --config lenet         LeNet hapi Model train_batch loop, steps/s
   --config gpt2s_decode  KV-cache decode, pure new-tokens/s (prefill excluded)
+  --config ppyolo        PP-YOLOE train step imgs/s (+ infer+NMS imgs/s extra)
 The default (gpt2s) run also appends an "extra" dict with a quick ResNet-50
 measurement when the chip is healthy (disable with --no-extra).
 
 Usage: python bench.py [--batch B] [--seq S] [--steps N] [--sweep]
-                       [--config gpt2s|resnet50|bert_dp|lenet|gpt2s_decode]
+                       [--config gpt2s|resnet50|bert_dp|lenet|gpt2s_decode|ppyolo]
                        [--no-extra]
 """
 import argparse
@@ -221,6 +222,108 @@ def run_lenet(batch, steps, quiet=False):
     return sps
 
 
+def _ppyolo_setup(batch):
+    """Shared model+data setup for the two ppyolo measurements."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import PPYOLOE
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    if on_tpu:
+        size, model = 640, PPYOLOE(num_classes=80, width=64, depth=2)
+    else:
+        size, model = 64, PPYOLOE(num_classes=80, width=16, depth=1)
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    imgs = paddle.to_tensor(rng.rand(batch, 3, size, size).astype(np.float32))
+    return on_tpu, size, model, imgs
+
+
+def run_ppyolo_train(batch, steps, quiet=False):
+    """BASELINE config #5 (train half): PP-YOLOE jitted fwd+bwd+Momentum
+    step via SpmdTrainer, imgs/s/chip."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.vision.models import PPYOLOELoss
+
+    on_tpu, size, model, imgs = _ppyolo_setup(batch)
+    if not on_tpu:
+        steps = min(steps, 2)
+
+    class TrainStep(nn.Layer):
+        """Detector + loss fused so SpmdTrainer jits loss(decode(model(x)))."""
+
+        def __init__(self, det, loss_fn):
+            super().__init__()
+            self.det = det
+            self.det_loss = loss_fn
+
+        def forward(self, x, gt_boxes, gt_labels):
+            decoded = self.det.decode(self.det(x))
+            return self.det_loss(decoded, (gt_boxes, gt_labels))
+
+    step_layer = TrainStep(model, PPYOLOELoss(num_classes=80))
+    opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                    parameters=step_layer.parameters())
+    mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+    trainer = SpmdTrainer(step_layer, opt, loss_fn=None, mesh=mesh)
+
+    A = sum((size // s) ** 2 for s in model.strides)
+    rng = np.random.RandomState(1)
+    gt_boxes = paddle.to_tensor(
+        (rng.rand(batch, A, 4) * size).astype(np.float32))
+    gt_labels = paddle.to_tensor(
+        rng.randint(0, 81, (batch, A)).astype(np.int64))  # 80 == background
+
+    with paddle.amp.auto_cast(True, dtype="bfloat16"):
+        np.asarray(trainer.train_step(imgs, gt_boxes, gt_labels)._data)
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(steps):
+            loss = trainer.train_step(imgs, gt_boxes, gt_labels)
+        np.asarray(loss._data)
+        train_ips = batch * steps / (time.perf_counter() - t0)
+    if not quiet:
+        print(f"  ppyolo batch={batch} size={size}: train {train_ips:,.1f} "
+              f"imgs/s", file=sys.stderr)
+    return train_ips
+
+
+def run_ppyolo_infer(batch, steps, quiet=False):
+    """BASELINE config #5 (infer half): forward + decode + multiclass-NMS
+    postprocess as ONE @to_static-compiled program (Pallas NMS on TPU),
+    imgs/s/chip."""
+    import paddle_tpu as paddle
+
+    on_tpu, size, model, imgs = _ppyolo_setup(batch)
+    if not on_tpu:
+        steps = min(steps, 2)
+    model.eval()
+
+    infer_fn = paddle.jit.to_static(
+        lambda im: model.postprocess(model(im), score_threshold=0.3,
+                                     keep_top_k=100))
+
+    def infer_once():
+        _, counts = infer_fn(imgs)
+        np.asarray(counts._data)  # sync
+
+    infer_once()  # compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        infer_once()
+    infer_ips = batch * steps / (time.perf_counter() - t0)
+    if not quiet:
+        print(f"  ppyolo batch={batch} size={size}: infer+nms "
+              f"{infer_ips:,.1f} imgs/s", file=sys.stderr)
+    return infer_ips
+
+
 def run_decode(batch, steps, quiet=False):
     """Serving-side metric: KV-cache decode, PURE new-tokens/s/chip (GPT-2
     small, prompt 128, greedy). Prefill time is excluded by differencing a
@@ -295,7 +398,7 @@ def main():
                     help="sweep batch/seq configs, report the best")
     ap.add_argument("--config", default="gpt2s",
                     choices=["gpt2s", "resnet50", "bert_dp", "lenet",
-                             "gpt2s_decode"])
+                             "gpt2s_decode", "ppyolo"])
     ap.add_argument("--no-extra", action="store_true",
                     help="skip the appended quick ResNet-50 measurement")
     args = ap.parse_args()
@@ -312,6 +415,7 @@ def main():
         watchdog = None
 
     if args.config != "gpt2s":
+        extra = None
         if args.config == "resnet50":
             b = args.batch or (64 if on_tpu else 4)
             v = run_resnet50(b, args.steps, quiet=True)
@@ -328,15 +432,41 @@ def main():
             v = run_decode(b, args.steps, quiet=True)
             metric, unit, base = "gpt2s_decode_new_tokens_per_sec_per_chip", \
                 "tokens/s", 1000.0  # ~A100-class HF GPT-2 batch decode proxy
+        elif args.config == "ppyolo":
+            b = args.batch or (8 if on_tpu else 1)
+            v = run_ppyolo_train(b, args.steps, quiet=True)
+            metric, unit, base = "ppyoloe_train_imgs_per_sec_per_chip", \
+                "imgs/s", 60.0  # ~0.6x a V100-class PP-YOLOE-s 640px figure
+            if watchdog is not None:
+                watchdog.cancel()          # train measured: tunnel healthy
+                watchdog = None
+            if not args.no_extra:
+                # the train number must survive an infer hang/kill: emit it
+                # now; a successful infer re-emits the full line below (the
+                # LAST line is the most complete)
+                print(json.dumps({"metric": metric, "value": round(v, 1),
+                                  "unit": unit,
+                                  "vs_baseline": round(v / base, 3),
+                                  "config": args.config}), flush=True)
+                try:
+                    infer_ips = run_ppyolo_infer(b, args.steps, quiet=True)
+                    extra = {"ppyoloe_infer_nms_imgs_per_sec_per_chip":
+                             round(infer_ips, 1)}
+                except Exception as e:  # train number already emitted
+                    print(f"  ppyolo infer failed ({e})", file=sys.stderr)
+                    return
         else:
             b = args.batch or 64
             v = run_lenet(b, args.steps, quiet=True)
             metric, unit, base = "lenet_fit_steps_per_sec", "steps/s", 100.0
         if watchdog is not None:
             watchdog.cancel()
-        print(json.dumps({"metric": metric, "value": round(v, 1),
-                          "unit": unit, "vs_baseline": round(v / base, 3),
-                          "config": args.config}))
+        line = {"metric": metric, "value": round(v, 1),
+                "unit": unit, "vs_baseline": round(v / base, 3),
+                "config": args.config}
+        if extra:
+            line["extra"] = extra
+        print(json.dumps(line))
         return
     # batch 16 was the r1 sweet spot at seq 1024 (batch 32 exceeded 16G HBM);
     # the r2 flash-attention retune cut attention HBM traffic, so when no

@@ -1,0 +1,31 @@
+#!/bin/bash
+# ONE patient TPU probe. Writes an unbuffered timeline to /tmp/tpu_probe.log so
+# a partial run shows exactly where init/compile/execute stalled. Never run
+# two TPU processes at once; go quiet 30+ min between probes (see
+# .claude/skills/verify/SKILL.md). On success, chain the full measurement
+# batch (tools/tpu_session.sh) immediately — same process chain, one client
+# at a time.
+set -u
+cd "$(dirname "$0")/.."
+
+stdbuf -oL -eL timeout "${1:-3000}" python -u - <<'EOF' > /tmp/tpu_probe.log 2>&1
+import time, sys
+t0 = time.time()
+def mark(msg):
+    print(f"[{time.time()-t0:7.1f}s] {msg}", flush=True)
+mark("python up")
+import jax, jax.numpy as jnp
+mark("jax imported")
+d = jax.devices()
+mark(f"devices: {d}")
+x = jnp.ones((1024, 1024), jnp.bfloat16)
+mark("array placed")
+y = (x @ x).block_until_ready()
+mark("matmul done — tunnel HEALTHY")
+EOF
+rc=$?
+echo "[tpu_probe] exit=$rc" >> /tmp/tpu_probe.log
+if grep -q "HEALTHY" /tmp/tpu_probe.log; then
+  echo "[tpu_probe] healthy — chaining measurement batch" >> /tmp/tpu_probe.log
+  bash tools/tpu_session.sh >> /tmp/tpu_probe.log 2>&1
+fi

@@ -24,5 +24,10 @@ if grep -q '"metric"' /tmp/tpu_bench.json 2>/dev/null; then
   timeout 1800 python bench.py --config gpt2s_decode \
     > /tmp/tpu_bench_decode.json 2>/tmp/tpu_bench_decode.log
   echo "[tpu_session] decode exit=$? $(cat /tmp/tpu_bench_decode.json 2>/dev/null)" >&2
+
+  echo "[tpu_session] ppyolo config..." >&2
+  timeout 1800 python bench.py --config ppyolo \
+    > /tmp/tpu_bench_ppyolo.json 2>/tmp/tpu_bench_ppyolo.log
+  echo "[tpu_session] ppyolo exit=$? $(cat /tmp/tpu_bench_ppyolo.json 2>/dev/null)" >&2
 fi
 echo "[tpu_session] done" >&2
