@@ -1,0 +1,120 @@
+"""Profile the GPT-2s train step (the BENCH headline config) on the current
+backend and print ONE JSON line with the numbers a tuning session needs:
+
+- XLA cost analysis of the compiled step: model FLOPs, bytes accessed (HBM
+  traffic), and the flops/byte arithmetic intensity — tells whether the step
+  is MXU-bound or HBM-bound.
+- XLA memory analysis: peak temp allocation + argument/output footprint —
+  tells how much batch headroom remains before OOM.
+- Measured step time + achieved TFLOP/s vs the analysis FLOPs.
+- Optional: --trace DIR dumps a jax.profiler trace for offline tensorboard.
+
+Run on the real TPU during a healthy window (tools/tpu_session.sh chains the
+bench first; run this after). CPU runs shrink the model like bench.py does.
+
+Usage: python tools/profile_gpt.py [--batch B] [--seq S] [--steps N]
+                                   [--trace DIR]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--trace", default=None,
+                    help="dump a jax.profiler trace to this directory")
+    args = ap.parse_args()
+
+    import jax
+
+    import bench
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.models import GPTForCausalLM, GPTPretrainLoss
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    batch = args.batch or (16 if on_tpu else 2)
+    seq = args.seq if on_tpu else min(args.seq, 128)
+    steps = args.steps if on_tpu else 2
+    cfg = bench._gpt2s_cfg(on_tpu, seq)
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+    trainer = SpmdTrainer(model, opt, loss_fn=GPTPretrainLoss(), mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+
+    with paddle.amp.auto_cast(True, dtype="bfloat16"):
+        np.asarray(trainer.train_step(ids, labels)._data)  # compile + sync
+
+        # AOT analysis of the exact step the trainer runs
+        from paddle_tpu.core.generator import default_generator
+
+        lr = np.float32(opt.get_lr())
+        key = default_generator().fold_in(opt._step_count)
+        lowered = trainer._compiled.lower(
+            trainer.params, trainer.opt_state, trainer.buffers, lr, key,
+            ids._data, labels._data)
+        compiled = lowered.compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        mem = compiled.memory_analysis()
+
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(steps):
+            loss = trainer.train_step(ids, labels)
+        np.asarray(loss._data)
+        dt = (time.perf_counter() - t0) / steps
+
+        if args.trace:
+            with jax.profiler.trace(args.trace):
+                for _ in range(3):
+                    loss = trainer.train_step(ids, labels)
+                np.asarray(loss._data)
+
+    flops = float(cost.get("flops", 0.0)) if cost else 0.0
+    bytes_acc = float(cost.get("bytes accessed", 0.0)) if cost else 0.0
+    line = {
+        "config": {"batch": batch, "seq": seq, "platform":
+                   jax.devices()[0].platform},
+        "step_time_s": round(dt, 4),
+        "tokens_per_sec": round(batch * seq / dt, 1),
+        "xla_flops_per_step": flops,
+        "xla_bytes_accessed_per_step": bytes_acc,
+        "arithmetic_intensity_flops_per_byte":
+            round(flops / bytes_acc, 2) if bytes_acc else None,
+        "achieved_tflops_per_sec": round(flops / dt / 1e12, 2) if flops else None,
+    }
+    if mem is not None:
+        for attr in ("temp_size_in_bytes", "argument_size_in_bytes",
+                     "output_size_in_bytes", "generated_code_size_in_bytes"):
+            v = getattr(mem, attr, None)
+            if v is not None:
+                line.setdefault("memory", {})[attr] = int(v)
+    if args.trace:
+        line["trace_dir"] = args.trace
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

@@ -26,7 +26,9 @@ if grep -q '"metric"' /tmp/tpu_bench.json 2>/dev/null; then
   echo "[tpu_session] decode exit=$? $(cat /tmp/tpu_bench_decode.json 2>/dev/null)" >&2
 
   echo "[tpu_session] ppyolo config..." >&2
-  timeout 1800 python bench.py --config ppyolo \
+  # two fresh heavy compiles (train step + to_static infer+NMS): give it the
+  # same worst-case budget as the main bench so timeout never kills mid-compile
+  timeout 3500 python bench.py --config ppyolo \
     > /tmp/tpu_bench_ppyolo.json 2>/tmp/tpu_bench_ppyolo.log
   echo "[tpu_session] ppyolo exit=$? $(cat /tmp/tpu_bench_ppyolo.json 2>/dev/null)" >&2
 fi
