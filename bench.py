@@ -54,7 +54,9 @@ def _gpt2s_cfg(on_tpu, seq):
                      num_heads=12, max_seq_len=seq, dropout=0.0)
 
 
-def run_config(batch, seq, steps, quiet=False):
+def _gpt2s_setup(batch, seq):
+    """Model+trainer+data for the headline GPT-2s train config — shared with
+    tools/profile_gpt.py so the profiled program IS the benchmarked one."""
     import jax
 
     import paddle_tpu as paddle
@@ -64,22 +66,27 @@ def run_config(batch, seq, steps, quiet=False):
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     cfg = _gpt2s_cfg(on_tpu, seq)
-    if not on_tpu:  # keep the CPU fallback tractable
-        steps = min(steps, 3)
-
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
-    loss_layer = GPTPretrainLoss()
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters())
     mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
-    trainer = SpmdTrainer(model, opt, loss_fn=loss_layer, mesh=mesh)
+    trainer = SpmdTrainer(model, opt, loss_fn=GPTPretrainLoss(), mesh=mesh)
 
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(
         rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
     labels = paddle.to_tensor(
         rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    return on_tpu, cfg, trainer, ids, labels
+
+
+def run_config(batch, seq, steps, quiet=False):
+    import paddle_tpu as paddle
+
+    on_tpu, cfg, trainer, ids, labels = _gpt2s_setup(batch, seq)
+    if not on_tpu:  # keep the CPU fallback tractable
+        steps = min(steps, 3)
 
     with paddle.amp.auto_cast(True, dtype="bfloat16"):
         # warmup + compile (host-copy forces completion through the tunnel)
@@ -456,7 +463,9 @@ def main():
                                   "vs_baseline": round(v / base, 3),
                                   "config": args.config}), flush=True)
                 if watchdog is not None:
-                    watchdog = _arm_watchdog(900)
+                    # generous: must exceed worst-case to_static+NMS compile
+                    # (session script budgets 3500s for the two halves)
+                    watchdog = _arm_watchdog(1500)
                 try:
                     infer_ips = run_ppyolo_infer(b, args.steps, quiet=True,
                                                  setup=setup)

@@ -34,7 +34,17 @@ _NEG = -1e30
 
 
 def _block_for(s):
-    """Largest MXU-friendly block (512/256/128) that tiles seq exactly."""
+    """Largest MXU-friendly block (512/256/128) that tiles seq exactly.
+    FLAGS_flash_attention_block forces a specific size for tuning sweeps."""
+    from ..flags import get_flag
+
+    forced = get_flag("flash_attention_block", 0)
+    if forced:
+        if forced not in (128, 256, 512) or s % forced:
+            raise ValueError(
+                f"FLAGS_flash_attention_block={forced} must be 128/256/512 "
+                f"and divide seq {s}")
+        return forced
     for blk in (512, 256, 128):
         if s % blk == 0:
             return blk
@@ -328,9 +338,22 @@ def _flash_bwd_rule(causal, interpret, res, do3):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "interpret"))
 def flash_attention(q, k, v, causal=False, interpret=False):
-    """q,k,v: [b, s, h, d] -> [b, s, h, d]. Differentiable (custom VJP)."""
+    """q,k,v: [b, s, h, d] -> [b, s, h, d]. Differentiable (custom VJP).
+
+    The resolved FLAGS_flash_attention_block value joins the jit cache key
+    (static `_blk`), so in-process set_flags sweeps retrace rather than
+    silently reusing the old block's executable. Enclosing jits (e.g. a
+    trainer's compiled train step) still bake the flag at THEIR build time —
+    rebuild the trainer (or use a fresh process) when sweeping under one."""
+    from ..flags import get_flag
+
+    return _flash_attention_jit(q, k, v, causal=causal, interpret=interpret,
+                                _blk=get_flag("flash_attention_block", 0))
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "interpret", "_blk"))
+def _flash_attention_jit(q, k, v, causal, interpret, _blk):
     b, s, h, d = q.shape
     qh = jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
     kh = jnp.swapaxes(k, 1, 2).reshape(b * h, s, d)

@@ -98,3 +98,33 @@ def test_use_flash_knob_consumed():
         F.scaled_dot_product_attention(q, q, q, is_causal=True, use_flash=False)
     # gate short-circuits before consulting the kernel when use_flash=False
     assert not calls
+
+
+def test_block_flag_forces_block_size():
+    """FLAGS_flash_attention_block must override the auto block choice (the
+    on-chip tuning knob) and still produce correct output; invalid values
+    fail loudly rather than silently fall back. The resolved flag is a
+    static arg of the inner jit, so the forced-128 call below retraces with
+    blk=128 even though earlier tests cached this shape at auto blk=256 —
+    the correctness check genuinely exercises the forced block."""
+    from paddle_tpu import flags
+    from paddle_tpu.ops.flash_attention import _block_for
+
+    assert _block_for(1024) == 512  # auto picks the largest
+    try:
+        flags.set_flags({"flash_attention_block": 128})
+        assert _block_for(1024) == 128
+        q, k, v = _qkv(s=256, seed=3)
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_naive(q, k, v, True)),
+                                   atol=2e-5, rtol=2e-5)
+        flags.set_flags({"flash_attention_block": 384})
+        with pytest.raises(ValueError):
+            _block_for(1024)
+        flags.set_flags({"flash_attention_block": 512})
+        with pytest.raises(ValueError):
+            _block_for(256)  # does not divide
+    finally:
+        flags.set_flags({"flash_attention_block": 0})
+    assert _block_for(1024) == 512
