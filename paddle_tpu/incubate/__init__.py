@@ -1,8 +1,10 @@
-"""paddle.incubate parity: auto-checkpoint, segment reductions."""
+"""paddle.incubate parity: auto-checkpoint, segment reductions; plus LoRA
+fine-tuning (beyond reference)."""
 from . import checkpoint  # noqa: F401
 from .segment import segment_max, segment_mean, segment_min, segment_sum  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import reader  # noqa: F401
+from . import lora  # noqa: F401
 
 
 class LayerHelper:

@@ -1,0 +1,190 @@
+"""LoRA (Low-Rank Adaptation) fine-tuning — a beyond-reference addition.
+
+No equivalent in the reference tree (thisjiang/Paddle ~v2.0 predates LoRA);
+this follows the LoRA recipe (Hu et al. 2021): freeze the pretrained weight
+W and learn a rank-r update, y = x W + b + (alpha/r) * (x A) B, with A
+gaussian-init and B zero-init so training starts from the base model
+exactly. TPU notes: the low-rank path is two thin matmuls the MXU handles
+well, XLA fuses the add, and because only A/B are trainable the optimizer
+state (and ZeRO shards) shrink to O(r * (in+out)) per layer — SpmdTrainer
+already routes non-trainable params through its frozen set
+(distributed/spmd.py:146-147), so LoRA composes with dp/ZeRO/tp meshes
+unchanged.
+
+Usage::
+
+    replaced = apply_lora(model, r=8, alpha=16,
+                          target_modules=["q_proj", "v_proj"])
+    opt = paddle.optimizer.AdamW(parameters=lora_parameters(model))
+    ... train ...
+    sd = lora_state_dict(model)      # adapter-only checkpoint
+    merge_lora(model)                # fold A@B into W for serving
+"""
+import numpy as np
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.common import Linear
+from ..nn.layer.layers import Layer
+
+__all__ = ["LoRALinear", "apply_lora", "merge_lora", "lora_parameters",
+           "lora_state_dict", "mark_only_lora_trainable"]
+
+
+def _freeze(p):
+    p.trainable = False
+    p.stop_gradient = True
+
+
+def _unfreeze(p):
+    p.trainable = True
+    p.stop_gradient = False
+
+
+class LoRALinear(Layer):
+    """Wraps an existing ``nn.Linear``; the base weight/bias are frozen and
+    only ``lora_A``/``lora_B`` train. ``merge()`` folds the adapter back
+    into the base layer for zero-overhead serving."""
+
+    def __init__(self, base, r=8, alpha=None, dropout=0.0):
+        super().__init__()
+        if not isinstance(base, Linear):
+            raise TypeError(f"LoRALinear wraps nn.Linear, got {type(base)}")
+        if r <= 0:
+            raise ValueError(f"rank must be positive, got {r}")
+        self.base = base
+        _freeze(base.weight)
+        if base.bias is not None:
+            _freeze(base.bias)
+        self.r = r
+        self.scaling = (alpha if alpha is not None else r) / r
+        self.dropout_p = dropout
+        self.lora_A = self.create_parameter(
+            shape=[base.in_features, r],
+            default_initializer=I.Normal(0.0, 0.02))
+        self.lora_B = self.create_parameter(
+            shape=[r, base.out_features],
+            default_initializer=I.Constant(0.0))
+
+    def forward(self, x):
+        y = self.base(x)
+        h = x
+        if self.dropout_p:
+            h = F.dropout(h, p=self.dropout_p, training=self.training)
+        delta = F.linear(F.linear(h, self.lora_A), self.lora_B)
+        return y + delta * self.scaling
+
+    def merge(self):
+        """Fold scaling * A @ B into the base weight and return the base
+        Linear (unfrozen), dropping the adapter."""
+        w = np.asarray(self.base.weight.numpy())
+        a = np.asarray(self.lora_A.numpy())
+        b = np.asarray(self.lora_B.numpy())
+        self.base.weight.set_value((w + self.scaling * (a @ b)).astype(w.dtype))
+        _unfreeze(self.base.weight)
+        if self.base.bias is not None:
+            _unfreeze(self.base.bias)
+        return self.base
+
+    def extra_repr(self):
+        return (f"in={self.base.in_features}, out={self.base.out_features}, "
+                f"r={self.r}, scaling={self.scaling}")
+
+
+def _iter_linear_sites(layer, target_modules):
+    """Yield (parent, attr_key, qualified_name) for every nn.Linear to wrap.
+    target_modules: substrings matched against the qualified sublayer name
+    (HF-style, e.g. ["q_proj", "v_proj"]); None matches every Linear."""
+    sites = []
+
+    def walk(parent, prefix):
+        for key, sub in list(parent._sub_layers.items()):
+            if sub is None:
+                continue
+            qual = f"{prefix}.{key}" if prefix else key
+            if isinstance(sub, LoRALinear):
+                continue  # never double-wrap (also skips its .base)
+            if isinstance(sub, Linear):
+                if target_modules is None or any(t in qual
+                                                 for t in target_modules):
+                    sites.append((parent, key, qual))
+            else:
+                walk(sub, qual)
+
+    walk(layer, "")
+    return sites
+
+
+def apply_lora(layer, r=8, alpha=None, dropout=0.0, target_modules=None,
+               freeze_rest=True):
+    """Replace matching ``nn.Linear`` sublayers with ``LoRALinear`` in place.
+    Returns the list of qualified names replaced. With ``freeze_rest`` (the
+    default) every other parameter is frozen, so ``layer.parameters()``
+    handed to an optimizer trains adapters only; ``merge_lora`` restores the
+    pre-LoRA trainable set. A Linear registered under several parents
+    (module aliasing / weight tying) gets ONE shared adapter."""
+    sites = _iter_linear_sites(layer, target_modules)
+    if not sites:
+        raise ValueError(
+            f"no nn.Linear sublayer matched target_modules={target_modules}")
+    prev_trainable = {n: getattr(p, "trainable", True)
+                      for n, p in layer.named_parameters()}
+    wrappers = {}  # id(base Linear) -> its single shared LoRALinear
+    for parent, key, _ in sites:
+        base = parent._sub_layers[key]
+        if id(base) not in wrappers:
+            wrappers[id(base)] = LoRALinear(base, r=r, alpha=alpha,
+                                            dropout=dropout)
+        parent._sub_layers[key] = wrappers[id(base)]
+    if freeze_rest:
+        mark_only_lora_trainable(layer)
+    layer.__dict__["_lora_prev_trainable"] = prev_trainable
+    return [qual for _, _, qual in sites]
+
+
+def mark_only_lora_trainable(layer):
+    """Freeze every parameter except lora_A/lora_B."""
+    for name, p in layer.named_parameters():
+        if "lora_A" in name or "lora_B" in name:
+            _unfreeze(p)
+        else:
+            _freeze(p)
+
+
+def merge_lora(layer):
+    """Recursively fold every LoRALinear back into a plain Linear (in place)
+    and restore the pre-apply_lora trainable set. Returns the number of
+    distinct adapters merged (a shared adapter merges once even if it is
+    registered under several parents)."""
+    merged_bases = {}  # id(wrapper) -> merged base Linear
+
+    def walk(parent):
+        for key, sub in list(parent._sub_layers.items()):
+            if sub is None:
+                continue
+            if isinstance(sub, LoRALinear):
+                if id(sub) not in merged_bases:
+                    merged_bases[id(sub)] = sub.merge()
+                parent._sub_layers[key] = merged_bases[id(sub)]
+            else:
+                walk(sub)
+
+    walk(layer)
+    prev = layer.__dict__.pop("_lora_prev_trainable", None)
+    if prev is not None:
+        for n, p in layer.named_parameters():
+            if n in prev:
+                (_unfreeze if prev[n] else _freeze)(p)
+    return len(merged_bases)
+
+
+def lora_parameters(layer):
+    """The trainable adapter parameters (for the optimizer)."""
+    return [p for n, p in layer.named_parameters()
+            if "lora_A" in n or "lora_B" in n]
+
+
+def lora_state_dict(layer):
+    """Adapter-only checkpoint: {qualified_name: numpy array} for A/B."""
+    return {n: np.asarray(p.numpy()) for n, p in layer.named_parameters()
+            if "lora_A" in n or "lora_B" in n}

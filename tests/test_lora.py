@@ -1,0 +1,192 @@
+"""LoRA adapter tests (incubate/lora.py — beyond-reference addition)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate.lora import (LoRALinear, apply_lora, lora_parameters,
+                                      lora_state_dict, merge_lora)
+
+
+class TinyNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.q_proj = nn.Linear(8, 8)
+        self.v_proj = nn.Linear(8, 8)
+        self.ffn = nn.Linear(8, 4)
+
+    def forward(self, x):
+        return self.ffn(nn.functional.relu(self.q_proj(x) + self.v_proj(x)))
+
+
+def _x(b=4, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return paddle.to_tensor(rng.randn(b, d).astype(np.float32))
+
+
+class TestLoRALinear:
+    def test_init_is_identity(self, seed):
+        base = nn.Linear(8, 4)
+        x = _x()
+        y0 = np.asarray(base(x)._data)
+        wrapped = LoRALinear(base, r=2, alpha=4)
+        np.testing.assert_allclose(np.asarray(wrapped(x)._data), y0,
+                                   atol=1e-6)
+
+    def test_base_frozen_adapters_train(self, seed):
+        net = TinyNet()
+        apply_lora(net, r=2)
+        w_before = np.asarray(net.q_proj.base.weight.numpy()).copy()
+        a_before = np.asarray(net.q_proj.lora_A.numpy()).copy()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=lora_parameters(net))
+        x, target = _x(), _x(4, 4, seed=1)
+        losses = []
+        for _ in range(5):
+            loss = nn.functional.mse_loss(net(x), target)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss._data)))
+        assert losses[-1] < losses[0]
+        np.testing.assert_array_equal(
+            np.asarray(net.q_proj.base.weight.numpy()), w_before)
+        assert np.abs(np.asarray(net.q_proj.lora_A.numpy())
+                      - a_before).max() > 0
+
+    def test_target_modules_filter(self, seed):
+        net = TinyNet()
+        replaced = apply_lora(net, r=2, target_modules=["q_proj", "v_proj"])
+        assert sorted(replaced) == ["q_proj", "v_proj"]
+        assert isinstance(net.q_proj, LoRALinear)
+        assert isinstance(net.v_proj, LoRALinear)
+        assert isinstance(net.ffn, nn.Linear)
+        # freeze_rest froze the untouched ffn too
+        assert not net.ffn.weight.trainable
+
+    def test_double_wrap_raises(self, seed):
+        net = TinyNet()
+        apply_lora(net, r=2)
+        try:
+            apply_lora(net, r=2)
+            raise AssertionError("second apply_lora should find no Linear")
+        except ValueError:
+            pass
+
+    def test_merge_parity_and_cleanup(self, seed):
+        net = TinyNet()
+        apply_lora(net, r=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=lora_parameters(net))
+        x, target = _x(), _x(4, 4, seed=1)
+        for _ in range(3):
+            loss = nn.functional.mse_loss(net(x), target)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        y_lora = np.asarray(net(x)._data)
+        n = merge_lora(net)
+        assert n == 3
+        assert isinstance(net.q_proj, nn.Linear)
+        np.testing.assert_allclose(np.asarray(net(x)._data), y_lora,
+                                   atol=1e-5, rtol=1e-5)
+        # merged model is fully trainable again
+        assert all(p.trainable for p in net.parameters())
+
+    def test_adapter_state_dict_roundtrip(self):
+        paddle.seed(7)
+        net = TinyNet()
+        apply_lora(net, r=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=lora_parameters(net))
+        x, target = _x(), _x(4, 4, seed=1)
+        for _ in range(3):
+            loss = nn.functional.mse_loss(net(x), target)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        sd = lora_state_dict(net)
+        assert sorted(sd) == sorted(
+            n for n, _ in net.named_parameters() if "lora_" in n)
+        y = np.asarray(net(x)._data)
+
+        paddle.seed(7)   # identical base init...
+        net2 = TinyNet()
+        paddle.seed(999)  # ...but different fresh adapters
+        apply_lora(net2, r=2)
+        assert np.abs(np.asarray(net2(x)._data) - y).max() > 1e-6
+        named = dict(net2.named_parameters())
+        for k, v in sd.items():
+            named[k].set_value(v)  # the adapters carry the whole delta
+        np.testing.assert_allclose(np.asarray(net2(x)._data), y,
+                                   atol=1e-6)
+
+
+class TestLoRAWithTrainer:
+    def test_spmd_trainer_frozen_split(self, seed):
+        import jax
+
+        from paddle_tpu.distributed.mesh import build_mesh
+        from paddle_tpu.distributed.spmd import SpmdTrainer
+
+        net = TinyNet()
+        apply_lora(net, r=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=lora_parameters(net))
+        mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+        trainer = SpmdTrainer(net, opt, loss_fn=nn.MSELoss(), mesh=mesh)
+        # only adapters are trainable params; bases route to the frozen set
+        assert all("lora_" in n for n in trainer.params)
+        assert any("lora_" not in n for n in trainer.frozen)
+        x, target = _x(), _x(4, 4, seed=1)
+        l0 = float(np.asarray(trainer.train_step(x, target)._data))
+        l5 = l0
+        for _ in range(5):
+            l5 = float(np.asarray(trainer.train_step(x, target)._data))
+        assert np.isfinite(l5) and l5 < l0
+
+
+class TestLoRAAliasing:
+    def test_shared_linear_gets_one_adapter_and_merges_once(self, seed):
+        """A Linear registered under two parents (weight tying via module
+        aliasing) must train ONE shared adapter and fold its delta exactly
+        once on merge."""
+
+        class Tied(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.enc = nn.Linear(8, 8)
+                self.dec = self.enc  # same object, two registrations
+
+            def forward(self, x):
+                return self.dec(nn.functional.relu(self.enc(x)))
+
+        net = Tied()
+        apply_lora(net, r=2)
+        assert net.enc is net.dec  # one shared wrapper
+        assert isinstance(net.enc, LoRALinear)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=lora_parameters(net))
+        x, target = _x(), _x(4, 8, seed=1)
+        for _ in range(3):
+            loss = nn.functional.mse_loss(net(x), target)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        y = np.asarray(net(x)._data)
+        assert merge_lora(net) == 1
+        assert isinstance(net.enc, nn.Linear) and net.enc is net.dec
+        np.testing.assert_allclose(np.asarray(net(x)._data), y,
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_merge_restores_pre_lora_trainable_set(self, seed):
+        """freeze_rest freezes unmatched layers; merge_lora must hand back
+        the ORIGINAL trainable set, not leave the rest frozen."""
+        net = TinyNet()
+        net.ffn.bias.trainable = False  # user froze this before LoRA
+        net.ffn.bias.stop_gradient = True
+        apply_lora(net, r=2, target_modules=["q_proj"])
+        assert not net.v_proj.weight.trainable  # freeze_rest
+        merge_lora(net)
+        assert net.v_proj.weight.trainable
+        assert net.q_proj.weight.trainable
+        assert not net.ffn.bias.trainable  # user's own freeze preserved
