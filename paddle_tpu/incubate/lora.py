@@ -127,8 +127,12 @@ def apply_lora(layer, r=8, alpha=None, dropout=0.0, target_modules=None,
     if not sites:
         raise ValueError(
             f"no nn.Linear sublayer matched target_modules={target_modules}")
+    # first-seen wins: a second apply_lora (disjoint target_modules) must not
+    # overwrite the original snapshot with the post-freeze_rest state, or
+    # merge_lora would permanently freeze unrelated params
     prev_trainable = {n: getattr(p, "trainable", True)
                       for n, p in layer.named_parameters()}
+    prev_trainable.update(layer.__dict__.get("_lora_prev_trainable", {}))
     wrappers = {}  # id(base Linear) -> its single shared LoRALinear
     for parent, key, _ in sites:
         base = parent._sub_layers[key]

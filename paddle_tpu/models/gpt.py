@@ -404,6 +404,12 @@ def _decode_setup(model, input_ids, max_new_tokens):
                          f"exceeds max_seq_len {cfg.max_seq_len}")
     untied = getattr(model, "lm_head", None) is not None
     params = {n: p._data for n, p in model.named_parameters()}
+    if any(".lora_A" in n for n in params):  # any wrap site, any Linear
+        raise ValueError(
+            "generate() reads name-addressed params and the model has "
+            "un-merged LoRA adapters: call "
+            "paddle_tpu.incubate.lora.merge_lora(model) before generating, "
+            "or use the eager forward for sampling during fine-tuning")
     # pipeline_split installs the head with bias_attr=False: no bias param
     untied_bias = untied and "lm_head.bias" in params
     return cfg, ids, b, s0, T, untied, untied_bias, params

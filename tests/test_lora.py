@@ -190,3 +190,75 @@ class TestLoRAAliasing:
         assert net.v_proj.weight.trainable
         assert net.q_proj.weight.trainable
         assert not net.ffn.bias.trainable  # user's own freeze preserved
+
+
+class TestLoRAOnGPT:
+    def test_gpt_attention_adapters_then_merged_generate(self, seed):
+        """LoRA on the flagship LM's attention projections: adapters train
+        under the LM loss, and after merge the model serves through the
+        name-addressed KV-cache generate path (which reads qualified param
+        names like blocks.0.attn.proj.weight — merge must restore them)."""
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=4, max_seq_len=32, dropout=0.0)
+        model = GPTForCausalLM(cfg)
+        replaced = apply_lora(model, r=4, target_modules=["attn.qkv",
+                                                          "attn.proj"])
+        assert len(replaced) == 2 * cfg.num_layers
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=lora_parameters(model))
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(
+            rng.randint(0, 64, (2, 16)).astype(np.int32))
+        labels = paddle.to_tensor(
+            rng.randint(0, 64, (2, 16)).astype(np.int32))
+        losses = []
+        for _ in range(4):
+            loss = model.loss(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss._data)))
+        assert losses[-1] < losses[0]
+
+        model.eval()
+        logits_lora = np.asarray(model(ids)._data)
+        assert merge_lora(model) == 2 * cfg.num_layers
+        np.testing.assert_allclose(np.asarray(model(ids)._data),
+                                   logits_lora, atol=1e-4, rtol=1e-4)
+        out = model.generate(ids[:, :8], max_new_tokens=4)
+        seqs = out[0] if isinstance(out, tuple) else out
+        arr = np.asarray(seqs._data if hasattr(seqs, "_data") else seqs)
+        assert arr.shape[-1] >= 4
+
+
+class TestLoRAGuards:
+    def test_repeat_apply_keeps_original_trainable_snapshot(self, seed):
+        """A second apply_lora with disjoint targets must not overwrite the
+        pre-LoRA snapshot with the post-freeze state: after merge, params
+        untouched by either apply are trainable again."""
+        net = TinyNet()
+        apply_lora(net, r=2, target_modules=["q_proj"])
+        apply_lora(net, r=2, target_modules=["ffn"])
+        merge_lora(net)
+        assert net.v_proj.weight.trainable  # wrapped by neither apply
+        assert net.ffn.weight.trainable
+        assert net.q_proj.weight.trainable
+
+    def test_unmerged_generate_raises_helpful_error(self, seed):
+        """The name-addressed KV-cache decode path cannot see un-merged
+        adapters; generate must fail with a message pointing at merge_lora,
+        not an opaque KeyError."""
+        import pytest
+
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_heads=4, max_seq_len=32, dropout=0.0)
+        model = GPTForCausalLM(cfg)
+        apply_lora(model, r=2, target_modules=["attn.qkv"])
+        model.eval()
+        ids = paddle.to_tensor(np.zeros((1, 4), np.int32))
+        with pytest.raises(ValueError, match="merge_lora"):
+            model.generate(ids, max_new_tokens=2)
