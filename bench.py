@@ -337,10 +337,11 @@ def run_ppyolo_infer(batch, steps, quiet=False, setup=None):
     return infer_ips
 
 
-def run_decode(batch, steps, quiet=False):
+def run_decode(batch, steps, quiet=False, cache_dtype=None):
     """Serving-side metric: KV-cache decode, PURE new-tokens/s/chip (GPT-2
     small, prompt 128, greedy). Prefill time is excluded by differencing a
-    max_new_tokens=1 run against the full run at identical reps."""
+    max_new_tokens=1 run against the full run at identical reps.
+    cache_dtype='int8' measures the quantized-cache serving config."""
     import jax
 
     import paddle_tpu as paddle
@@ -361,12 +362,13 @@ def run_decode(batch, steps, quiet=False):
 
     def timed(n):
         np.asarray(model.generate(ids, max_new_tokens=n, temperature=0.0,
-                                  dtype=dec_dtype)._data)  # compile + warm
+                                  dtype=dec_dtype,
+                                  cache_dtype=cache_dtype)._data)  # compile
         t0 = time.perf_counter()
         out = None
         for _ in range(reps):
             out = model.generate(ids, max_new_tokens=n, temperature=0.0,
-                                 dtype=dec_dtype)
+                                 dtype=dec_dtype, cache_dtype=cache_dtype)
         np.asarray(out._data)
         return time.perf_counter() - t0
 
@@ -375,9 +377,9 @@ def run_decode(batch, steps, quiet=False):
     decode_dt = max(dt_full - dt_prefill, 1e-9)
     tps = batch * (new_tokens - 1) * reps / decode_dt
     if not quiet:
-        print(f"  decode batch={batch}: {tps:,.0f} new tok/s "
-              f"(full {dt_full:.2f}s, prefill {dt_prefill:.2f}s)",
-              file=sys.stderr)
+        print(f"  decode batch={batch} cache={cache_dtype or 'dtype'}: "
+              f"{tps:,.0f} new tok/s (full {dt_full:.2f}s, prefill "
+              f"{dt_prefill:.2f}s)", file=sys.stderr)
     return tps
 
 
@@ -445,6 +447,14 @@ def main():
             v = run_decode(b, args.steps, quiet=True)
             metric, unit, base = "gpt2s_decode_new_tokens_per_sec_per_chip", \
                 "tokens/s", 1000.0  # ~A100-class HF GPT-2 batch decode proxy
+            if on_tpu:  # int8-KV A/B rides the same healthy window
+                try:
+                    i8 = run_decode(b, args.steps, quiet=True,
+                                    cache_dtype="int8")
+                    extra = {"gpt2s_decode_int8_kv_new_tokens_per_sec_per_chip":
+                             round(i8, 1)}
+                except Exception as e:
+                    print(f"  int8-kv decode failed ({e})", file=sys.stderr)
         elif args.config == "ppyolo":
             b = args.batch or (8 if on_tpu else 1)
             setup = _ppyolo_setup(b)
