@@ -17,11 +17,12 @@ Other BASELINE.md milestone configs measure standalone via --config:
   --config lenet         LeNet hapi Model train_batch loop, steps/s
   --config gpt2s_decode  KV-cache decode, pure new-tokens/s (prefill excluded)
   --config ppyolo        PP-YOLOE train step imgs/s (+ infer+NMS imgs/s extra)
+  --config gpt2m         GPT-2-medium (~350M) train step, tokens/s (BASELINE #4 class)
 The default (gpt2s) run also appends an "extra" dict with a quick ResNet-50
 measurement when the chip is healthy (disable with --no-extra).
 
 Usage: python bench.py [--batch B] [--seq S] [--steps N] [--sweep]
-                       [--config gpt2s|resnet50|bert_dp|lenet|gpt2s_decode|ppyolo]
+                       [--config gpt2s|resnet50|bert_dp|lenet|gpt2s_decode|ppyolo|gpt2m]
                        [--no-extra]
 """
 import argparse
@@ -54,9 +55,24 @@ def _gpt2s_cfg(on_tpu, seq):
                      num_heads=12, max_seq_len=seq, dropout=0.0)
 
 
-def _gpt2s_setup(batch, seq):
+def _gpt2m_cfg(on_tpu, seq):
+    """GPT-2-medium (~350M params): the BASELINE #4 model class (ERNIE-1.0 /
+    GPT-2 medium). Single-chip it exercises HBM pressure at real scale; the
+    sharding_stage2 side of BASELINE #4 is compile-validated by
+    tools/scaling_check.py and dryrun_multichip (no multi-chip hardware)."""
+    from paddle_tpu.models import GPTConfig
+
+    if not on_tpu:
+        return GPTConfig(vocab_size=8192, hidden_size=320, num_layers=6,
+                         num_heads=8, max_seq_len=seq, dropout=0.0)
+    return GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                     num_heads=16, max_seq_len=seq, dropout=0.0)
+
+
+def _gpt2s_setup(batch, seq, cfg_fn=None):
     """Model+trainer+data for the headline GPT-2s train config — shared with
-    tools/profile_gpt.py so the profiled program IS the benchmarked one."""
+    tools/profile_gpt.py so the profiled program IS the benchmarked one.
+    cfg_fn overrides the model config family (e.g. _gpt2m_cfg)."""
     import jax
 
     import paddle_tpu as paddle
@@ -65,7 +81,7 @@ def _gpt2s_setup(batch, seq):
     from paddle_tpu.models import GPTForCausalLM, GPTPretrainLoss
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-    cfg = _gpt2s_cfg(on_tpu, seq)
+    cfg = (cfg_fn or _gpt2s_cfg)(on_tpu, seq)
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
@@ -81,10 +97,10 @@ def _gpt2s_setup(batch, seq):
     return on_tpu, cfg, trainer, ids, labels
 
 
-def run_config(batch, seq, steps, quiet=False):
+def run_config(batch, seq, steps, quiet=False, cfg_fn=None):
     import paddle_tpu as paddle
 
-    on_tpu, cfg, trainer, ids, labels = _gpt2s_setup(batch, seq)
+    on_tpu, cfg, trainer, ids, labels = _gpt2s_setup(batch, seq, cfg_fn)
     if not on_tpu:  # keep the CPU fallback tractable
         steps = min(steps, 3)
 
@@ -413,7 +429,7 @@ def main():
                     help="sweep batch/seq configs, report the best")
     ap.add_argument("--config", default="gpt2s",
                     choices=["gpt2s", "resnet50", "bert_dp", "lenet",
-                             "gpt2s_decode", "ppyolo"])
+                             "gpt2s_decode", "ppyolo", "gpt2m"])
     ap.add_argument("--no-extra", action="store_true",
                     help="skip the appended quick ResNet-50 measurement")
     args = ap.parse_args()
@@ -448,6 +464,16 @@ def main():
             metric, unit, base = "gpt2s_decode_new_tokens_per_sec_per_chip", \
                 "tokens/s", 1000.0  # ~A100-class HF GPT-2 batch decode proxy
             if on_tpu:  # int8-KV A/B rides the same healthy window
+                # the measured bf16 number must survive a slow/hung int8
+                # half: emit it now (ppyolo pattern; LAST line is the most
+                # complete) and give the int8 recompile a fresh window
+                print(json.dumps({"metric": metric, "value": round(v, 1),
+                                  "unit": unit,
+                                  "vs_baseline": round(v / base, 3),
+                                  "config": args.config}), flush=True)
+                if watchdog is not None:
+                    watchdog.cancel()
+                    watchdog = _arm_watchdog(1500)
                 try:
                     i8 = run_decode(b, args.steps, quiet=True,
                                     cache_dtype="int8")
@@ -455,6 +481,21 @@ def main():
                              round(i8, 1)}
                 except Exception as e:
                     print(f"  int8-kv decode failed ({e})", file=sys.stderr)
+                    return
+        elif args.config == "gpt2m":
+            b = args.batch or (8 if on_tpu else 2)
+            s = args.seq or (1024 if on_tpu else 128)
+            v, mfu = run_config(b, s, args.steps, quiet=True,
+                                cfg_fn=_gpt2m_cfg)
+            if watchdog is not None:
+                watchdog.cancel()
+            print(json.dumps({
+                "metric": "gpt2m_train_tokens_per_sec_per_chip",
+                "value": round(v, 1), "unit": "tokens/s",
+                # same 10k tok/s/device class target as the BERT/ERNIE row
+                "vs_baseline": round(v / BASELINE_TOKENS_PER_SEC, 3),
+                "mfu": round(mfu, 4), "config": args.config}))
+            return
         elif args.config == "ppyolo":
             b = args.batch or (8 if on_tpu else 1)
             setup = _ppyolo_setup(b)

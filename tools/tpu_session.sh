@@ -20,10 +20,15 @@ if grep -q '"metric"' /tmp/tpu_bench.json 2>/dev/null; then
     > /tmp/tpu_bench_bert.json 2>/tmp/tpu_bench_bert.log
   echo "[tpu_session] bert exit=$? $(cat /tmp/tpu_bench_bert.json 2>/dev/null)" >&2
 
-  echo "[tpu_session] decode config..." >&2
-  timeout 1800 python bench.py --config gpt2s_decode \
+  echo "[tpu_session] decode config (bf16 + int8-KV A/B)..." >&2
+  timeout 3500 python bench.py --config gpt2s_decode \
     > /tmp/tpu_bench_decode.json 2>/tmp/tpu_bench_decode.log
   echo "[tpu_session] decode exit=$? $(cat /tmp/tpu_bench_decode.json 2>/dev/null)" >&2
+
+  echo "[tpu_session] gpt2m config..." >&2
+  timeout 3500 python bench.py --config gpt2m \
+    > /tmp/tpu_bench_gpt2m.json 2>/tmp/tpu_bench_gpt2m.log
+  echo "[tpu_session] gpt2m exit=$? $(cat /tmp/tpu_bench_gpt2m.json 2>/dev/null)" >&2
 
   echo "[tpu_session] ppyolo config..." >&2
   # two fresh heavy compiles (train step + to_static infer+NMS): give it the
