@@ -485,6 +485,12 @@ def main():
         elif args.config == "gpt2m":
             b = args.batch or (8 if on_tpu else 2)
             s = args.seq or (1024 if on_tpu else 128)
+            if watchdog is not None:
+                # 24-layer compile is much heavier than gpt2s: one wide
+                # window (inside the session script's 3500s budget) so a
+                # slow-but-healthy compile isn't mislabeled a wedge
+                watchdog.cancel()
+                watchdog = _arm_watchdog(2500)
             v, mfu = run_config(b, s, args.steps, quiet=True,
                                 cfg_fn=_gpt2m_cfg)
             if watchdog is not None:

@@ -1,5 +1,6 @@
-"""Profile the GPT-2s train step (the BENCH headline config) on the current
-backend and print ONE JSON line with the numbers a tuning session needs:
+"""Profile a bench.py GPT train step (gpt2s — the BENCH headline config —
+or gpt2m via --model) on the current backend and print ONE JSON line with
+the numbers a tuning session needs:
 
 - XLA cost analysis of the compiled step: model FLOPs, bytes accessed (HBM
   traffic), and the flops/byte arithmetic intensity — tells whether the step
@@ -17,7 +18,7 @@ Run on the real TPU during a healthy window (tools/tpu_session.sh chains the
 bench first; run this after). CPU runs shrink the model like bench.py does.
 
 Usage: python tools/profile_gpt.py [--batch B] [--seq S] [--steps N]
-                                   [--trace DIR]
+                                   [--trace DIR] [--model gpt2s|gpt2m]
 """
 import argparse
 import json
@@ -37,6 +38,8 @@ def main():
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--trace", default=None,
                     help="dump a jax.profiler trace to this directory")
+    ap.add_argument("--model", default="gpt2s", choices=["gpt2s", "gpt2m"],
+                    help="config family (matches bench.py --config)")
     args = ap.parse_args()
 
     import jax
@@ -47,11 +50,15 @@ def main():
     from paddle_tpu.core.generator import default_generator
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-    batch = args.batch or (16 if on_tpu else 2)
+    # defaults match bench.py's per-config TPU batches (gpt2s probes 16/24;
+    # gpt2m runs 8) so the profiled program is the benchmarked one
+    batch = args.batch or ((8 if args.model == "gpt2m" else 16)
+                           if on_tpu else 2)
     seq = args.seq if on_tpu else min(args.seq, 128)
     steps = args.steps if on_tpu else 2
 
-    on_tpu, cfg, trainer, ids, labels = bench._gpt2s_setup(batch, seq)
+    cfg_fn = bench._gpt2m_cfg if args.model == "gpt2m" else None
+    on_tpu, cfg, trainer, ids, labels = bench._gpt2s_setup(batch, seq, cfg_fn)
     batch_arrays = (ids._data, labels._data)
     lr = jnp.asarray(trainer.optimizer.get_lr(), dtype=jnp.float32)
     key = default_generator().fold_in(0)
@@ -91,8 +98,8 @@ def main():
     flops = float(cost.get("flops", 0.0)) if cost else 0.0
     bytes_acc = float(cost.get("bytes accessed", 0.0)) if cost else 0.0
     line = {
-        "config": {"batch": batch, "seq": seq, "platform":
-                   jax.devices()[0].platform},
+        "config": {"model": args.model, "batch": batch, "seq": seq,
+                   "platform": jax.devices()[0].platform},
         "step_time_s": round(dt, 4),
         "tokens_per_sec": round(batch * seq / dt, 1),
         "xla_flops_per_step": flops,
