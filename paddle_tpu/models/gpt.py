@@ -266,6 +266,19 @@ class GPTForCausalLM(nn.Layer):
                              attention_mask=attention_mask, top_p=top_p,
                              cache_dtype=cache_dtype)
 
+    def generate_speculative(self, draft_model, input_ids,
+                             max_new_tokens=32, k=4, dtype=None,
+                             cache_dtype=None):
+        """Speculative greedy decoding with a small draft model: identical
+        output to greedy `generate` (the acceptance rule is exact) but
+        1..k+1 tokens per target forward. Returns (sequences, n_rounds) —
+        n_rounds target forwards vs max_new_tokens single-token steps is
+        the speedup headroom. Batch 1; greedy only. See _gpt_speculative
+        for the cache-invariant design notes."""
+        return _gpt_speculative(self, draft_model, input_ids,
+                                max_new_tokens, k=k, dtype=dtype,
+                                cache_dtype=cache_dtype)
+
     def pipeline_split(self, pp_degree):
         """Split into (pre, stages, post_loss) for distributed.pipeline.
         PipelineTrainer. Unties the LM head (see GPTHeadLoss) and installs it
@@ -454,17 +467,24 @@ def _decode_setup(model, input_ids, max_new_tokens):
     if T > cfg.max_seq_len:
         raise ValueError(f"prompt {s0} + max_new_tokens {max_new_tokens} "
                          f"exceeds max_seq_len {cfg.max_seq_len}")
+    untied, untied_bias, params = _decode_params(model, "the model")
+    return cfg, ids, b, s0, T, untied, untied_bias, params
+
+
+def _decode_params(model, who):
+    """Name-addressed param snapshot for the decode programs + the shared
+    un-merged-LoRA guard and untied-head detection."""
     untied = getattr(model, "lm_head", None) is not None
     params = {n: p._data for n, p in model.named_parameters()}
     if any(".lora_A" in n for n in params):  # any wrap site, any Linear
         raise ValueError(
-            "generate() reads name-addressed params and the model has "
-            "un-merged LoRA adapters: call "
-            "paddle_tpu.incubate.lora.merge_lora(model) before generating, "
-            "or use the eager forward for sampling during fine-tuning")
+            f"decoding reads name-addressed params and {who} has un-merged "
+            "LoRA adapters: call paddle_tpu.incubate.lora.merge_lora on it "
+            "before generating, or use the eager forward for sampling "
+            "during fine-tuning")
     # pipeline_split installs the head with bias_attr=False: no bias param
     untied_bias = untied and "lm_head.bias" in params
-    return cfg, ids, b, s0, T, untied, untied_bias, params
+    return untied, untied_bias, params
 
 
 def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
@@ -565,6 +585,137 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
     out = store[cache_key](params, ids, key, mask)
     full = jnp.concatenate([ids.astype(out.dtype), out], axis=1)
     return Tensor(full)
+
+
+def _gpt_speculative(model, draft_model, input_ids, max_new_tokens, k=4,
+                     dtype=None, cache_dtype=None):
+    """Speculative GREEDY decoding (beyond reference): a small draft model
+    proposes k tokens per round; the target verifies all k in ONE forward
+    and accepts the longest matching prefix plus its own fix-up token, so
+    each round costs k tiny draft steps + one (k+1)-token target step yet
+    emits 1..k+1 tokens. Greedy acceptance makes the output equal to the
+    target model's own greedy decode whatever the draft quality (up to XLA
+    reassociation flipping argmax on exact logit ties — the multi-token
+    verify forward and generate()'s single-token steps can round near-ties
+    differently; tests pin equality on the test models). The whole loop is
+    one jitted lax.while_loop program (trip count is data-dependent:
+    better drafts finish in fewer rounds).
+
+    Cache invariant per round: both KV caches hold the accepted prefix
+    [0, pos); `cur` is the last accepted token not yet fed. The round feeds
+    [cur, p0..p_{k-1}] (target) so stale columns beyond the accepted prefix
+    are never read (causal mask) and are overwritten by later rounds.
+
+    v1 scope: batch 1, greedy only, no eos early-stop (the emitted count is
+    exact, so callers can post-trim at eos)."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg, ids, b, s0, T0, untied, untied_bias, params = _decode_setup(
+        model, input_ids, max_new_tokens)
+    if b != 1:
+        raise ValueError(f"speculative decoding is batch-1 (got batch {b}); "
+                         "run rows separately or use generate()")
+    if draft_model.cfg.vocab_size != cfg.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    if not (1 <= k <= 16):
+        raise ValueError(f"k must be in [1, 16], got {k}")
+    if s0 < 2:
+        raise ValueError("speculative decoding needs a prompt of >= 2 tokens")
+    _check_decode_config(draft_model.cfg)
+    d_cfg = draft_model.cfg
+    T = s0 + max_new_tokens + k + 1  # writes can run k past the accepted end
+    if T > cfg.max_seq_len or T > d_cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {s0} + max_new_tokens {max_new_tokens} + draft window "
+            f"{k + 1} exceeds a max_seq_len ({cfg.max_seq_len} target, "
+            f"{d_cfg.max_seq_len} draft)")
+    d_untied, d_untied_bias, params_d = _decode_params(draft_model,
+                                                       "the draft model")
+
+    fwd_t, logits_t, cache_init_t = _decode_fns(cfg, untied, untied_bias,
+                                                cache_dtype=cache_dtype)
+    fwd_d, logits_d, cache_init_d = _decode_fns(d_cfg, d_untied,
+                                                d_untied_bias,
+                                                cache_dtype=cache_dtype)
+    compute_dtype = _decode_compute_dtype(dtype)
+
+    def run(pt, pd, ids_):
+        if compute_dtype is not None:
+            cast = lambda p: {n: (v.astype(compute_dtype)
+                                  if jnp.issubdtype(v.dtype, jnp.floating)
+                                  else v) for n, v in p.items()}
+            pt, pd = cast(pt), cast(pd)
+        kc_t, vc_t = cache_init_t(1, T, compute_dtype or jnp.float32)
+        kc_d, vc_d = cache_init_d(1, T, compute_dtype or jnp.float32)
+        # prefill both caches with the prompt MINUS its last token; that
+        # last token is `cur` (fed at the head of each round)
+        prefix = ids_[:, :s0 - 1]
+        _, kc_t, vc_t = fwd_t(pt, prefix, 0, kc_t, vc_t)
+        _, kc_d, vc_d = fwd_d(pd, prefix, 0, kc_d, vc_d)
+        cur = ids_[:, s0 - 1]                              # [1]
+        out_buf = jnp.zeros((1, max_new_tokens + k + 1), jnp.int32)
+
+        def round_body(carry):
+            pos, cur, emitted, out_buf, kc_t, vc_t, kc_d, vc_d, rounds = carry
+            # --- draft proposes k tokens (k single-token forwards) -------
+            props = []
+            d_cur = cur
+            for j in range(k):
+                xd, kc_d, vc_d = fwd_d(pd, d_cur[:, None], pos + j,
+                                       kc_d, vc_d)
+                d_cur = jnp.argmax(
+                    logits_d(pd, xd[:, -1]).astype(jnp.float32),
+                    -1).astype(jnp.int32)                  # [1]
+                props.append(d_cur)
+            # write p_{k-1}'s KV too (logits discarded): when all k
+            # proposals are accepted the next round starts PAST this
+            # column, and an unwritten (zero) column inside the accepted
+            # prefix would poison every later draft query's attention
+            _, kc_d, vc_d = fwd_d(pd, d_cur[:, None], pos + k, kc_d, vc_d)
+            props_a = jnp.stack(props, axis=1)             # [1, k]
+            # --- target verifies in ONE (k+1)-token forward --------------
+            seq = jnp.concatenate([cur[:, None], props_a], axis=1)
+            xt, kc_t, vc_t = fwd_t(pt, seq, pos, kc_t, vc_t)
+            preds = jnp.argmax(
+                logits_t(pt, xt).astype(jnp.float32),
+                -1).astype(jnp.int32)                      # [1, k+1]
+            # longest accepted prefix: p_j must equal the target's argmax
+            # after the same prefix (preds[:, j])
+            matches = (props_a == preds[:, :k]).astype(jnp.int32)
+            m = jnp.cumprod(matches, axis=1).sum(axis=1)[0]  # scalar 0..k
+            # emitted this round: p_0..p_{m-1} then the target fix-up
+            # preds[m]; tail slots are junk overwritten by later rounds
+            j_idx = jnp.arange(k + 1)
+            fixup = preds[0, m]
+            emit = jnp.where(j_idx < m, jnp.pad(props_a[0], (0, 1)),
+                             fixup)                        # [k+1]
+            out_buf = jax.lax.dynamic_update_slice(out_buf, emit[None],
+                                                   (0, emitted))
+            return (pos + m + 1, preds[:, m], emitted + m + 1, out_buf,
+                    kc_t, vc_t, kc_d, vc_d, rounds + 1)
+
+        def cond(carry):
+            return carry[2] < max_new_tokens
+
+        init = (jnp.int32(s0 - 1), cur, jnp.int32(0), out_buf,
+                kc_t, vc_t, kc_d, vc_d, jnp.int32(0))
+        pos, cur, emitted, out_buf, *_, rounds = jax.lax.while_loop(
+            cond, round_body, init)
+        return out_buf[:, :max_new_tokens], rounds
+
+    cache_key = ("spec", b, s0, max_new_tokens, k, untied, untied_bias,
+                 d_untied, d_untied_bias, str(compute_dtype), cache_dtype,
+                 # value-based draft identity (id() could alias a GC'd
+                 # model of a different architecture)
+                 d_cfg.num_layers, d_cfg.hidden_size, d_cfg.num_heads,
+                 d_cfg.vocab_size, d_cfg.max_seq_len)
+    store = model.__dict__.setdefault("_generate_compiled", {})
+    if cache_key not in store:
+        store[cache_key] = jax.jit(run)
+    out, rounds = store[cache_key](params, params_d, ids)
+    full = jnp.concatenate([ids.astype(out.dtype), out], axis=1)
+    return Tensor(full), int(rounds)
 
 
 def _ragged_setup(mask_, b, s0, T):

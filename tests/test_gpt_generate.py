@@ -453,3 +453,72 @@ class TestInt8KVCache:
                 pytest.skip("backend reports no memory analysis")
             sizes["int8" if k[-1] == "int8" else "f32"] = t
         assert sizes["int8"] < 0.75 * sizes["f32"], sizes
+
+
+class TestSpeculativeDecoding:
+    """generate_speculative: draft proposes k, target verifies in one
+    forward; output must equal the target's own greedy decode."""
+
+    def _pair(self):
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=128, dropout=0.0)
+        target = GPTForCausalLM(cfg)
+        target.eval()
+        paddle.seed(7)
+        dcfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=1,
+                         num_heads=2, max_seq_len=128, dropout=0.0)
+        draft = GPTForCausalLM(dcfg)
+        draft.eval()
+        ids = paddle.to_tensor(
+            np.random.RandomState(2).randint(0, 128, (1, 6)).astype(np.int32))
+        return target, draft, ids
+
+    def test_matches_plain_greedy(self):
+        target, draft, ids = self._pair()
+        plain = np.asarray(target.generate(ids, max_new_tokens=20,
+                                           temperature=0.0)._data)
+        spec, rounds = target.generate_speculative(draft, ids,
+                                                   max_new_tokens=20, k=4)
+        np.testing.assert_array_equal(np.asarray(spec._data), plain)
+        assert 1 <= rounds <= 20
+
+    def test_perfect_draft_needs_fewer_rounds(self):
+        """Draft == target: every proposal accepted, so the data-dependent
+        while_loop exits in the ideal ceil(20/(k+1)) = 4 rounds (a small
+        slack tolerates numeric near-ties on the random test model; rounds
+        near 20 would mean acceptance — or the draft KV cache — broke)."""
+        target, _, ids = self._pair()
+        plain = np.asarray(target.generate(ids, max_new_tokens=20,
+                                           temperature=0.0)._data)
+        spec, rounds = target.generate_speculative(target, ids,
+                                                   max_new_tokens=20, k=4)
+        np.testing.assert_array_equal(np.asarray(spec._data), plain)
+        assert rounds <= 5, rounds
+
+    def test_validation(self):
+        import pytest
+
+        target, draft, ids = self._pair()
+        with pytest.raises(ValueError, match="batch"):
+            target.generate_speculative(
+                draft, paddle.to_tensor(np.ones((2, 6), np.int32)),
+                max_new_tokens=4)
+        with pytest.raises(ValueError, match="k must"):
+            target.generate_speculative(draft, ids, max_new_tokens=4, k=0)
+        paddle.seed(1)
+        other = GPTForCausalLM(GPTConfig(vocab_size=64, hidden_size=32,
+                                         num_layers=1, num_heads=2,
+                                         max_seq_len=128, dropout=0.0))
+        other.eval()
+        with pytest.raises(ValueError, match="vocab"):
+            target.generate_speculative(other, ids, max_new_tokens=4)
+
+    def test_composes_with_bf16_and_int8_cache(self):
+        target, draft, ids = self._pair()
+        spec, rounds = target.generate_speculative(
+            draft, ids, max_new_tokens=12, k=3, dtype="bfloat16",
+            cache_dtype="int8")
+        arr = np.asarray(spec._data)
+        assert arr.shape == (1, 18)
+        assert ((0 <= arr) & (arr < 128)).all()
