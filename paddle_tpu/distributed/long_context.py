@@ -67,21 +67,153 @@ def ring_attention_spmd(q, k, v, axis_name="sp", causal=False):
         v_next = jax.lax.ppermute(v_blk, axis_name, perm)
         return k_next, v_next, acc, m_new, l_new
 
-    def _vary(x):
-        # mark carry init as device-varying over the ring axis (shard_map vma typing)
-        try:
-            return jax.lax.pcast(x, (axis_name,), to="varying")
-        except (AttributeError, TypeError):
-            return jax.lax.pvary(x, (axis_name,))
-
-    acc0 = _vary(jnp.zeros((b, sq, h, d), jnp.float32))
-    m0 = _vary(jnp.full((b, h, sq), -1e30, jnp.float32))
-    l0 = _vary(jnp.zeros((b, h, sq), jnp.float32))
+    acc0 = _vary(jnp.zeros((b, sq, h, d), jnp.float32), axis_name)
+    m0 = _vary(jnp.full((b, h, sq), -1e30, jnp.float32), axis_name)
+    l0 = _vary(jnp.zeros((b, h, sq), jnp.float32), axis_name)
     _, _, acc, m_fin, l_fin = jax.lax.fori_loop(
         0, n, body, (k.astype(jnp.float32), v.astype(jnp.float32), acc0, m0, l0)
     )
     out = acc / l_fin.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention with per-block Pallas flash kernels (forward AND backward).
+# ---------------------------------------------------------------------------
+
+def _vary(x, axis_name):
+    """Mark a carry init as device-varying over the ring axis (shard_map
+    vma typing)."""
+    try:
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+    except (AttributeError, TypeError):
+        return jax.lax.pvary(x, (axis_name,))
+
+
+def _fold_heads(x):
+    b, s, h, d = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
+
+
+def _unfold_heads(x3, b, h):
+    bh, s, d = x3.shape
+    return jnp.swapaxes(x3.reshape(b, h, s, d), 1, 2)
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, interpret):
+    """Ring forward with flash-kernel blocks: returns (out [b,sq,h,d],
+    lse [b*h, sq] f32 — the GLOBAL row logsumexp, exactly what the flash
+    backward kernels need per ring pair)."""
+    from ..ops import flash_attention as fa
+
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    q3 = _fold_heads(q)
+
+    def pair(k3, v3, src):
+        if not causal:
+            return fa._flash_fwd(q3, k3, v3, False, scale, interpret)
+        return jax.lax.switch(
+            jnp.where(src == idx, 1, jnp.where(src < idx, 0, 2)),
+            (lambda: fa._flash_fwd(q3, k3, v3, False, scale, interpret),
+             lambda: fa._flash_fwd(q3, k3, v3, True, scale, interpret),
+             lambda: (jnp.zeros_like(q3),
+                      jnp.full((b * h, sq), -1e30, jnp.float32))))
+
+    def body(i, carry):
+        k3_blk, v3_blk, o_run, lse_run = carry
+        src = (idx - i) % n
+        o_blk, lse_blk = pair(k3_blk, v3_blk, src)
+        # merge normalized per-block outputs via logsumexp weights:
+        # sum_i o_i * exp(lse_i - lse_tot) == acc_tot / l_tot
+        lse_new = jnp.logaddexp(lse_run, lse_blk)
+        o_run = (o_run * jnp.exp(lse_run - lse_new)[..., None]
+                 + o_blk.astype(jnp.float32)
+                 * jnp.exp(lse_blk - lse_new)[..., None])
+        perm = [(r, (r + 1) % n) for r in range(n)]
+        return (jax.lax.ppermute(k3_blk, axis_name, perm),
+                jax.lax.ppermute(v3_blk, axis_name, perm), o_run, lse_new)
+
+    o0 = _vary(jnp.zeros((b * h, sq, d), jnp.float32), axis_name)
+    lse0 = _vary(jnp.full((b * h, sq), -1e30, jnp.float32), axis_name)
+    # fold heads ONCE; the ring carries [b*h, sq, d] blocks (ppermute is
+    # layout-agnostic), avoiding per-hop transpose copies
+    _, _, o_fin, lse_fin = jax.lax.fori_loop(
+        0, n, body, (_fold_heads(k), _fold_heads(v), o0, lse0))
+    return _unfold_heads(o_fin, b, h).astype(q.dtype), lse_fin
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_flash_attention_spmd(q, k, v, axis_name="sp", causal=False,
+                              interpret=False):
+    """Ring attention whose per-block math runs the Pallas flash kernels
+    (ops/flash_attention.py) instead of materializing [sq, sq] score
+    blocks: per-rank memory O(sq * blk) in the kernel, O(sq) merge state.
+    Differentiable — the custom VJP re-rotates K/V and calls the flash
+    BACKWARD kernels per ring pair with the global (out, lse, dout), whose
+    row-local form makes per-pair calls exact contributions to the global
+    softmax gradient; dK/dV partial sums ride the ring with their block
+    and arrive home after n hops. The flash-fusion step the r2 kernel
+    docstring planned. interpret=True runs the kernels on CPU (tests)."""
+    out, _ = _ring_flash_fwd(q, k, v, axis_name, causal, interpret)
+    return out
+
+
+def _rf_fwd(q, k, v, axis_name, causal, interpret):
+    out, lse = _ring_flash_fwd(q, k, v, axis_name, causal, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _rf_bwd(axis_name, causal, interpret, res, g):
+    from ..ops import flash_attention as fa
+
+    q, k, v, out, lse = res
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    q3, o3 = _fold_heads(q), _fold_heads(out)
+    do3 = _fold_heads(g).astype(q3.dtype)
+    # delta = rowsum(dO * O) is hop-invariant: compute once for all pairs
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1)
+
+    def pair_bwd(k3, v3, src):
+        def run(causal_flag):
+            return fa._flash_bwd(q3, k3, v3, o3, lse, do3, causal_flag,
+                                 scale, interpret, delta=delta)
+        if not causal:
+            return run(False)
+        return jax.lax.switch(
+            jnp.where(src == idx, 1, jnp.where(src < idx, 0, 2)),
+            (lambda: run(False), lambda: run(True),
+             lambda: (jnp.zeros_like(q3), jnp.zeros_like(q3),
+                      jnp.zeros_like(q3))))
+
+    def body(i, carry):
+        k3_blk, v3_blk, dk_acc, dv_acc, dq_run = carry
+        src = (idx - i) % n
+        dq_c, dk_c, dv_c = pair_bwd(k3_blk, v3_blk, src)
+        dq_run = dq_run + dq_c.astype(jnp.float32)
+        # dK/dV partial sums belong to the block currently held: they
+        # rotate WITH it and are complete when the block arrives home
+        dk_acc = dk_acc + dk_c.astype(jnp.float32)
+        dv_acc = dv_acc + dv_c.astype(jnp.float32)
+        perm = [(r, (r + 1) % n) for r in range(n)]
+        rot = lambda x: jax.lax.ppermute(x, axis_name, perm)
+        return rot(k3_blk), rot(v3_blk), rot(dk_acc), rot(dv_acc), dq_run
+
+    z3 = lambda: _vary(jnp.zeros((b * h, sq, d), jnp.float32), axis_name)
+    _, _, dk_fin, dv_fin, dq_fin = jax.lax.fori_loop(
+        0, n, body, (_fold_heads(k), _fold_heads(v), z3(), z3(), z3()))
+    return (_unfold_heads(dq_fin, b, h).astype(q.dtype),
+            _unfold_heads(dk_fin, b, h).astype(k.dtype),
+            _unfold_heads(dv_fin, b, h).astype(v.dtype))
+
+
+ring_flash_attention_spmd.defvjp(_rf_fwd, _rf_bwd)
 
 
 def ulysses_attention_spmd(q, k, v, axis_name="sp", causal=False):
@@ -112,8 +244,12 @@ def ulysses_attention_spmd(q, k, v, axis_name="sp", causal=False):
     return heads_to_seq(out).astype(q.dtype)
 
 
-def sequence_parallel_attention(q, k, v, mesh, impl="ring", causal=False, axis_name="sp"):
-    """Convenience wrapper: shard_map over the 'sp' axis of `mesh` on seq dim 1."""
+def sequence_parallel_attention(q, k, v, mesh, impl="ring", causal=False,
+                                axis_name="sp", interpret=False):
+    """Convenience wrapper: shard_map over the 'sp' axis of `mesh` on seq
+    dim 1. impl: 'ring' (einsum blocks), 'ring_flash' (Pallas flash-kernel
+    blocks — per-shard seq must be a multiple of 128), or 'ulysses'.
+    interpret only applies to ring_flash (CPU kernel interpretation)."""
     from jax.sharding import NamedSharding
 
     try:
@@ -126,10 +262,29 @@ def sequence_parallel_attention(q, k, v, mesh, impl="ring", causal=False, axis_n
 
         smap = _sm
 
-    fn = ring_attention_spmd if impl == "ring" else ulysses_attention_spmd
+    if impl == "ring":
+        body = functools.partial(ring_attention_spmd, axis_name=axis_name,
+                                 causal=causal)
+    elif impl == "ring_flash":
+        body = functools.partial(ring_flash_attention_spmd,
+                                 axis_name=axis_name, causal=causal,
+                                 interpret=interpret)
+    elif impl == "ulysses":
+        body = functools.partial(ulysses_attention_spmd,
+                                 axis_name=axis_name, causal=causal)
+    else:
+        raise ValueError(f"impl must be ring|ring_flash|ulysses, got {impl!r}")
     spec = P(None, axis_name, None, None)
-    body = functools.partial(fn, axis_name=axis_name, causal=causal)
-    mapped = smap(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    kw = {}
+    if impl == "ring_flash":
+        # pallas_call's out_shape carries no vma typing; skip the check
+        kw["check_vma"] = False
+    try:
+        mapped = smap(body, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec, **kw)
+    except TypeError:  # older jax: no check_vma param (no vma checking)
+        mapped = smap(body, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)
     return mapped(q, k, v)
 
 

@@ -21,8 +21,10 @@ that flash doesn't pay. `interpret=True` runs the kernels on CPU.
 Hand-rolled rather than importing jax.experimental.pallas.ops.tpu.flash_attention
 deliberately: the framework owns its hot kernels end-to-end (same reason the
 reference carries its own fused attention ops), the guide-driven implementation is
-the template for further custom kernels (ring-attention fusion, block-sparse
-masks), and upstream's experimental API/layout has no stability promise.
+the template for further custom kernels, and upstream's experimental API/layout
+has no stability promise. The planned ring-attention fusion landed in
+distributed/long_context.py `ring_flash_attention_spmd`: these forward AND
+backward kernels run per ring block (global-lse blockwise calls are exact).
 """
 import functools
 import math
@@ -253,7 +255,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dv_ref[...] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, scale, interpret):
+def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, scale, interpret,
+               delta=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import BlockSpec
     from jax.experimental.pallas import tpu as pltpu
@@ -261,8 +264,9 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, scale, interpret):
     bh, s, d = q3.shape
     blk = _block_for(s)
     n_q, n_k = s // blk, s // blk
-    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
-                    axis=-1)                                  # [bh, s]
+    if delta is None:  # ring callers precompute: o3/do3 are hop-invariant
+        delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                        axis=-1)                              # [bh, s]
     lse2 = lse[:, None, :]                                    # [bh, 1, s]
     delta2 = delta[:, None, :]
 
