@@ -266,6 +266,12 @@ def sequence_parallel_attention(q, k, v, mesh, impl="ring", causal=False,
         body = functools.partial(ring_attention_spmd, axis_name=axis_name,
                                  causal=causal)
     elif impl == "ring_flash":
+        # off-TPU the kernels only run interpreted — auto-enable so models
+        # configured with sp_impl='ring_flash' work on the CPU test mesh
+        if not interpret:
+            from ..ops.flash_attention import _on_tpu
+
+            interpret = not _on_tpu()
         body = functools.partial(ring_flash_attention_spmd,
                                  axis_name=axis_name, causal=causal,
                                  interpret=interpret)

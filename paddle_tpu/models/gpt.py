@@ -67,9 +67,21 @@ class GPTConfig:
                 raise ValueError("sequence-parallel attention does not "
                                  "implement attention dropout; set dropout=0.0")
             sp_size = sp_mesh.shape["sp"]
+            if sp_impl not in ("ring", "ring_flash", "ulysses"):
+                raise ValueError(f"sp_impl must be ring|ring_flash|ulysses, "
+                                 f"got {sp_impl!r}")
             if sp_impl == "ulysses" and num_heads % sp_size != 0:
                 raise ValueError(f"ulysses needs num_heads ({num_heads}) "
                                  f"divisible by sp={sp_size}")
+            if sp_impl == "ring_flash":
+                shard = max_seq_len // sp_size
+                if max_seq_len % sp_size != 0 or shard % 128 != 0:
+                    raise ValueError(
+                        f"ring_flash needs the per-rank seq shard "
+                        f"({max_seq_len}/{sp_size}={shard}) to be exact "
+                        f"and a multiple of the 128 flash block")
+                if (hidden_size // num_heads) % 64 != 0:
+                    raise ValueError("ring_flash needs head_dim % 64 == 0")
         self.sequence_parallel = sequence_parallel
         self.sp_mesh = sp_mesh
         self.sp_impl = sp_impl

@@ -198,3 +198,45 @@ class TestRingFlash:
         mesh = build_mesh((8,), ("sp",))
         with pytest.raises(ValueError, match="impl"):
             sequence_parallel_attention(q, k, v, mesh, impl="nope")
+
+
+class TestGPTRingFlash:
+    def test_gpt_sp_ring_flash_matches_dense(self):
+        """GPT configured with sp_impl='ring_flash' (per-rank 128-token
+        shards through the Pallas kernels, auto-interpret on CPU) == the
+        same weights run dense."""
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        mesh = build_mesh((8,), ("sp",))
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=256, hidden_size=128, num_layers=1,
+                        num_heads=2, max_seq_len=1024, dropout=0.0,
+                        sequence_parallel=True, sp_mesh=mesh,
+                        sp_impl="ring_flash")
+        model = GPTForCausalLM(cfg)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 256, (1, 1024))
+            .astype(np.int64))
+        logits_sp = model(ids)
+        for blk in model.gpt.blocks:
+            blk.attn.sp_mesh = None
+        logits_dense = model(ids)
+        np.testing.assert_allclose(np.asarray(logits_sp._data),
+                                   np.asarray(logits_dense._data),
+                                   atol=2e-3)
+
+    def test_ring_flash_config_validation(self):
+        from paddle_tpu.models import GPTConfig
+
+        mesh = build_mesh((8,), ("sp",))
+        with pytest.raises(ValueError, match="128 flash block"):
+            GPTConfig(hidden_size=128, num_heads=2, max_seq_len=512,
+                      dropout=0.0, sequence_parallel=True, sp_mesh=mesh,
+                      sp_impl="ring_flash")  # 512/8 = 64-token shards
+        with pytest.raises(ValueError, match="head_dim"):
+            GPTConfig(hidden_size=64, num_heads=2, max_seq_len=1024,
+                      dropout=0.0, sequence_parallel=True, sp_mesh=mesh,
+                      sp_impl="ring_flash")
+        with pytest.raises(ValueError, match="sp_impl"):
+            GPTConfig(dropout=0.0, sequence_parallel=True, sp_mesh=mesh,
+                      sp_impl="bogus")
