@@ -21,6 +21,11 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
+# the one source of truth for sequence-parallel attention impl names
+# (GPTConfig validates against this same tuple)
+VALID_SP_IMPLS = ("ring", "ring_flash", "ulysses", "ulysses_flash")
+
+
 def _block_attn(q, k, v, scale, causal_mask=None):
     """Plain softmax stats for one K/V block: returns (acc, m, l)."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -216,12 +221,15 @@ def _rf_bwd(axis_name, causal, interpret, res, g):
 ring_flash_attention_spmd.defvjp(_rf_fwd, _rf_bwd)
 
 
-def ulysses_attention_spmd(q, k, v, axis_name="sp", causal=False):
+def ulysses_attention_spmd(q, k, v, axis_name="sp", causal=False,
+                           use_flash=False, interpret=False):
     """Ulysses (DeepSpeed-style) attention inside shard_map.
 
     Input: [batch, seq_shard, heads, head_dim] sequence-sharded.
     all_to_all -> [batch, seq_full, heads_shard, head_dim], full attention locally,
-    all_to_all back. Needs heads % sp_size == 0.
+    all_to_all back. Needs heads % sp_size == 0. With use_flash the local
+    attention runs the differentiable Pallas flash kernel (full-seq must be
+    a multiple of 128, head_dim of 64) instead of materializing [s, s].
     """
     n = jax.lax.psum(1, axis_name)
 
@@ -234,6 +242,13 @@ def ulysses_attention_spmd(q, k, v, axis_name="sp", causal=False):
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     scale = 1.0 / math.sqrt(q.shape[-1])
+    if use_flash:
+        from ..ops import flash_attention as fa
+
+        b, s_full, h_loc, d = qh.shape
+        o3 = fa._flash(_fold_heads(qh), _fold_heads(kh), _fold_heads(vh),
+                       causal, interpret)
+        return heads_to_seq(_unfold_heads(o3, b, h_loc)).astype(q.dtype)
     s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
     if causal:
         sq = s.shape[-2]
@@ -248,8 +263,10 @@ def sequence_parallel_attention(q, k, v, mesh, impl="ring", causal=False,
                                 axis_name="sp", interpret=False):
     """Convenience wrapper: shard_map over the 'sp' axis of `mesh` on seq
     dim 1. impl: 'ring' (einsum blocks), 'ring_flash' (Pallas flash-kernel
-    blocks — per-shard seq must be a multiple of 128), or 'ulysses'.
-    interpret only applies to ring_flash (CPU kernel interpretation)."""
+    blocks — per-shard seq must be a multiple of 128), 'ulysses', or
+    'ulysses_flash' (local attention through the flash kernel — FULL seq
+    must be a multiple of 128). interpret applies to the *_flash impls
+    (CPU kernel interpretation; auto-on off-TPU)."""
     from jax.sharding import NamedSharding
 
     try:
@@ -262,27 +279,30 @@ def sequence_parallel_attention(q, k, v, mesh, impl="ring", causal=False,
 
         smap = _sm
 
+    if impl.endswith("_flash") and not interpret:
+        # off-TPU the kernels only run interpreted — auto-enable so models
+        # configured with a *_flash impl work on the CPU test mesh
+        from ..ops.flash_attention import _on_tpu
+
+        interpret = not _on_tpu()
     if impl == "ring":
         body = functools.partial(ring_attention_spmd, axis_name=axis_name,
                                  causal=causal)
     elif impl == "ring_flash":
-        # off-TPU the kernels only run interpreted — auto-enable so models
-        # configured with sp_impl='ring_flash' work on the CPU test mesh
-        if not interpret:
-            from ..ops.flash_attention import _on_tpu
-
-            interpret = not _on_tpu()
         body = functools.partial(ring_flash_attention_spmd,
                                  axis_name=axis_name, causal=causal,
                                  interpret=interpret)
-    elif impl == "ulysses":
+    elif impl in ("ulysses", "ulysses_flash"):
         body = functools.partial(ulysses_attention_spmd,
-                                 axis_name=axis_name, causal=causal)
+                                 axis_name=axis_name, causal=causal,
+                                 use_flash=impl == "ulysses_flash",
+                                 interpret=interpret)
     else:
-        raise ValueError(f"impl must be ring|ring_flash|ulysses, got {impl!r}")
+        raise ValueError(
+            f"impl must be one of {'|'.join(VALID_SP_IMPLS)}, got {impl!r}")
     spec = P(None, axis_name, None, None)
     kw = {}
-    if impl == "ring_flash":
+    if impl.endswith("_flash"):
         # pallas_call's out_shape carries no vma typing; skip the check
         kw["check_vma"] = False
     try:

@@ -67,10 +67,17 @@ class GPTConfig:
                 raise ValueError("sequence-parallel attention does not "
                                  "implement attention dropout; set dropout=0.0")
             sp_size = sp_mesh.shape["sp"]
-            if sp_impl not in ("ring", "ring_flash", "ulysses"):
-                raise ValueError(f"sp_impl must be ring|ring_flash|ulysses, "
-                                 f"got {sp_impl!r}")
-            if sp_impl == "ulysses" and num_heads % sp_size != 0:
+            from ..distributed.long_context import VALID_SP_IMPLS
+
+            if sp_impl not in VALID_SP_IMPLS:
+                raise ValueError(f"sp_impl must be one of "
+                                 f"{'|'.join(VALID_SP_IMPLS)}, got "
+                                 f"{sp_impl!r}")
+            if max_seq_len % sp_size != 0:
+                raise ValueError(
+                    f"sequence parallelism shards seq dim over sp={sp_size}: "
+                    f"max_seq_len ({max_seq_len}) must divide evenly")
+            if sp_impl.startswith("ulysses") and num_heads % sp_size != 0:
                 raise ValueError(f"ulysses needs num_heads ({num_heads}) "
                                  f"divisible by sp={sp_size}")
             if sp_impl == "ring_flash":
@@ -80,8 +87,13 @@ class GPTConfig:
                         f"ring_flash needs the per-rank seq shard "
                         f"({max_seq_len}/{sp_size}={shard}) to be exact "
                         f"and a multiple of the 128 flash block")
-                if (hidden_size // num_heads) % 64 != 0:
-                    raise ValueError("ring_flash needs head_dim % 64 == 0")
+            if sp_impl == "ulysses_flash" and max_seq_len % 128 != 0:
+                raise ValueError("ulysses_flash needs the full seq "
+                                 f"({max_seq_len}) to be a multiple of the "
+                                 "128 flash block")
+            if sp_impl.endswith("_flash") and \
+                    (hidden_size // num_heads) % 64 != 0:
+                raise ValueError(f"{sp_impl} needs head_dim % 64 == 0")
         self.sequence_parallel = sequence_parallel
         self.sp_mesh = sp_mesh
         self.sp_impl = sp_impl
@@ -132,6 +144,22 @@ class GPTAttention(nn.Layer):
             from ..core.dispatch import apply
             from ..distributed.long_context import sequence_parallel_attention
 
+            # config validation covers max_seq_len; the RUNTIME seq must
+            # satisfy the same constraints (shorter batches are routine)
+            sp_size = self.sp_mesh.shape["sp"]
+            if s % sp_size != 0:
+                raise ValueError(f"seq {s} must divide over sp={sp_size}")
+            if self.sp_impl == "ring_flash" and (s // sp_size) % 128 != 0:
+                raise ValueError(
+                    f"ring_flash needs the per-rank shard ({s}/{sp_size}="
+                    f"{s // sp_size}) in 128-token flash blocks: pad the "
+                    f"batch to a multiple of {128 * sp_size} or use "
+                    f"sp_impl='ring'")
+            if self.sp_impl == "ulysses_flash" and s % 128 != 0:
+                raise ValueError(
+                    f"ulysses_flash needs seq ({s}) in 128-token flash "
+                    f"blocks: pad to a multiple of 128 or use "
+                    f"sp_impl='ulysses'")
             out = apply(
                 lambda qv, kv, vv: sequence_parallel_attention(
                     qv, kv, vv, self.sp_mesh, impl=self.sp_impl, causal=True),
