@@ -18,6 +18,7 @@ Other BASELINE.md milestone configs measure standalone via --config:
   --config gpt2s_decode  KV-cache decode, pure new-tokens/s (prefill excluded)
   --config ppyolo        PP-YOLOE train step imgs/s (+ infer+NMS imgs/s extra)
   --config gpt2m         GPT-2-medium (~350M) train step, tokens/s (BASELINE #4 class)
+  --config gpt2s_16k     GPT-2s train step at seq 16384 (flash long-context)
 The default (gpt2s) run also appends an "extra" dict with a quick ResNet-50
 measurement when the chip is healthy (disable with --no-extra).
 
@@ -429,7 +430,8 @@ def main():
                     help="sweep batch/seq configs, report the best")
     ap.add_argument("--config", default="gpt2s",
                     choices=["gpt2s", "resnet50", "bert_dp", "lenet",
-                             "gpt2s_decode", "ppyolo", "gpt2m"])
+                             "gpt2s_decode", "ppyolo", "gpt2m",
+                             "gpt2s_16k"])
     ap.add_argument("--no-extra", action="store_true",
                     help="skip the appended quick ResNet-50 measurement")
     args = ap.parse_args()
@@ -482,6 +484,23 @@ def main():
                 except Exception as e:
                     print(f"  int8-kv decode failed ({e})", file=sys.stderr)
                     return
+        elif args.config == "gpt2s_16k":
+            # long-context single chip: flash attention is what makes 16k
+            # fit (VMEM-resident blocks; nothing scales with seq in VMEM)
+            b = args.batch or 1
+            s = args.seq or (16384 if on_tpu else 512)
+            if watchdog is not None:
+                watchdog.cancel()
+                watchdog = _arm_watchdog(2500)  # long-seq compile headroom
+            v, mfu = run_config(b, s, args.steps, quiet=True)
+            if watchdog is not None:
+                watchdog.cancel()
+            print(json.dumps({
+                "metric": "gpt2s_16k_train_tokens_per_sec_per_chip",
+                "value": round(v, 1), "unit": "tokens/s",
+                "vs_baseline": round(v / BASELINE_TOKENS_PER_SEC, 3),
+                "mfu": round(mfu, 4), "config": args.config}))
+            return
         elif args.config == "gpt2m":
             b = args.batch or (8 if on_tpu else 2)
             s = args.seq or (1024 if on_tpu else 128)

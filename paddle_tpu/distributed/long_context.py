@@ -245,7 +245,7 @@ def ulysses_attention_spmd(q, k, v, axis_name="sp", causal=False,
     if use_flash:
         from ..ops import flash_attention as fa
 
-        b, s_full, h_loc, d = qh.shape
+        b, h_loc = qh.shape[0], qh.shape[2]  # _flash derives its own scale
         o3 = fa._flash(_fold_heads(qh), _fold_heads(kh), _fold_heads(vh),
                        causal, interpret)
         return heads_to_seq(_unfold_heads(o3, b, h_loc)).astype(q.dtype)

@@ -273,7 +273,7 @@ class GPTForCausalLM(nn.Layer):
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=None, top_p=None, seed=None, eos_token_id=None,
                  num_beams=1, length_penalty=1.0, dtype=None,
-                 attention_mask=None, cache_dtype=None):
+                 attention_mask=None, cache_dtype=None, tp_mesh=None):
         """Autoregressive decode with a KV cache, compiled as ONE program
         (prefill + lax.scan; static shapes, dynamic_update_slice cache).
         temperature=0 decodes greedily; otherwise samples — top_k keeps the
@@ -287,6 +287,10 @@ class GPTForCausalLM(nn.Layer):
         cache_dtype='int8' quantizes the KV cache (per-row absmax scales) —
         half the bf16 cache's HBM traffic in the HBM-bound decode loop;
         composes with dtype='bfloat16' params.
+        tp_mesh (a Mesh with an 'mp' axis) serves a DENSE model
+        tensor-parallel: heads and the MLP inner dim shard over mp, the KV
+        cache holds only local heads, two psums per layer ride the ICI —
+        for models too big for one chip's HBM.
         See _gpt_generate/_gpt_beam_search for the TPU design notes."""
         if cache_dtype not in (None, "int8"):
             raise ValueError(
@@ -296,6 +300,9 @@ class GPTForCausalLM(nn.Layer):
                 raise ValueError(
                     "top_k/top_p are sampling knobs; beam search is "
                     "deterministic — drop them or use num_beams=1")
+            if tp_mesh is not None:
+                raise ValueError("tensor-parallel beam search is not "
+                                 "supported yet; use num_beams=1")
             return _gpt_beam_search(self, input_ids, max_new_tokens,
                                     num_beams, eos_token_id, length_penalty,
                                     dtype=dtype,
@@ -304,7 +311,7 @@ class GPTForCausalLM(nn.Layer):
         return _gpt_generate(self, input_ids, max_new_tokens, temperature,
                              top_k, seed, eos_token_id, dtype=dtype,
                              attention_mask=attention_mask, top_p=top_p,
-                             cache_dtype=cache_dtype)
+                             cache_dtype=cache_dtype, tp_mesh=tp_mesh)
 
     def generate_speculative(self, draft_model, input_ids,
                              max_new_tokens=32, k=4, dtype=None,
@@ -352,7 +359,8 @@ def _cache_map(f, c):
     return tuple(f(x) for x in c) if isinstance(c, tuple) else f(c)
 
 
-def _decode_fns(cfg, untied, untied_bias, cache_dtype=None):
+def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
+                tp_size=1):
     """Pure-jnp decode math shared by sampling and beam search: returns
     (fwd, logits_of, cache_init). fwd(p, tok_ids [B, t], pos, kc, vc) runs
     the block stack with the KV cache [L, B, H, T, hd] (B is read from the
@@ -363,7 +371,14 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None):
     bound the decode loop even vs a bf16 cache; values dequantize blockwise
     into the attention einsums (XLA fuses the multiply into the read). No
     reference analog (the reference has no fused KV-cache decode at all) —
-    this is the int8-KV serving recipe from modern LLM inference stacks."""
+    this is the int8-KV serving recipe from modern LLM inference stacks.
+
+    tp_axis/tp_size: tensor-parallel serving inside shard_map — attention
+    heads and the MLP inner dim are sharded over the mesh axis (Megatron
+    column/row split), the KV cache holds only the local heads, and one
+    psum after attn.proj + one after mlp.fc2 restore replicated
+    activations. Param layout in this mode: qkv.weight [h, 3, H_loc, hd],
+    qkv.bias [3, H_loc, hd] (see _tp_param_shard)."""
     import jax
     import jax.numpy as jnp
 
@@ -371,14 +386,15 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None):
     hd = cfg.hidden_size // Hh
     scale = 1.0 / math.sqrt(hd)
     int8_cache = cache_dtype == "int8"
+    H_loc = Hh // tp_size  # local heads (== Hh when not tensor-parallel)
 
     def cache_init(b_, T_, dt):
-        shape = (L, b_, Hh, T_, hd)
+        shape = (L, b_, H_loc, T_, hd)
         if not int8_cache:
             z = jnp.zeros(shape, dt)
             return z, jnp.zeros_like(z)
         vals = jnp.zeros(shape, jnp.int8)
-        scales = jnp.zeros((L, b_, Hh, T_, 1), jnp.float32)
+        scales = jnp.zeros((L, b_, H_loc, T_, 1), jnp.float32)
         return (vals, scales), (jnp.zeros_like(vals),
                                 jnp.zeros_like(scales))
 
@@ -417,9 +433,15 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None):
         bb, t = x.shape[0], x.shape[1]
         T = (kc[0] if isinstance(kc, tuple) else kc).shape[3]
         h_in = ln(x, p[pre + "ln1.weight"], p[pre + "ln1.bias"])
-        qkv = h_in @ p[pre + "attn.qkv.weight"] + p[pre + "attn.qkv.bias"]
-        qkv = qkv.reshape(bb, t, 3, Hh, hd)
-        q = jnp.moveaxis(qkv[:, :, 0], 1, 2)          # [B, H, t, hd]
+        if tp_axis is not None:
+            # column-parallel qkv over LOCAL heads: weight [h, 3, H_loc, hd]
+            qkv = jnp.einsum("bti,iknd->btknd",
+                             h_in, p[pre + "attn.qkv.weight"]) \
+                + p[pre + "attn.qkv.bias"]
+        else:
+            qkv = (h_in @ p[pre + "attn.qkv.weight"]
+                   + p[pre + "attn.qkv.bias"]).reshape(bb, t, 3, H_loc, hd)
+        q = jnp.moveaxis(qkv[:, :, 0], 1, 2)          # [B, H_loc, t, hd]
         k = jnp.moveaxis(qkv[:, :, 1], 1, 2)
         v = jnp.moveaxis(qkv[:, :, 2], 1, 2)
         kc = _store(kc, k, i, pos)
@@ -436,13 +458,19 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None):
         att = jnp.where(mask[:, None], att, -jnp.inf)
         att = jax.nn.softmax(att, axis=-1)
         out = jnp.einsum("bhtT,bhTd->bhtd", att, _load(vc, i, att.dtype))
-        out = jnp.moveaxis(out, 1, 2).reshape(bb, t, Hh * hd)
-        x = x + out @ p[pre + "attn.proj.weight"] + p[pre + "attn.proj.bias"]
+        out = jnp.moveaxis(out, 1, 2).reshape(bb, t, H_loc * hd)
+        proj = out @ p[pre + "attn.proj.weight"]  # row-parallel under tp
+        if tp_axis is not None:
+            proj = jax.lax.psum(proj, tp_axis)
+        x = x + proj + p[pre + "attn.proj.bias"]
         h2 = ln(x, p[pre + "ln2.weight"], p[pre + "ln2.bias"])
         h2 = jax.nn.gelu(h2 @ p[pre + "mlp.fc1.weight"]
                          + p[pre + "mlp.fc1.bias"],
                          approximate=getattr(cfg, "gelu_approx", False))
-        x = x + h2 @ p[pre + "mlp.fc2.weight"] + p[pre + "mlp.fc2.bias"]
+        mlp = h2 @ p[pre + "mlp.fc2.weight"]      # row-parallel under tp
+        if tp_axis is not None:
+            mlp = jax.lax.psum(mlp, tp_axis)
+        x = x + mlp + p[pre + "mlp.fc2.bias"]
         return x, kc, vc
 
     def logits_of(p, x_last):
@@ -527,9 +555,41 @@ def _decode_params(model, who):
     return untied, untied_bias, params
 
 
+def _tp_param_shard(params, cfg):
+    """Reshape the packed qkv params for head-sharded serving and build the
+    per-name PartitionSpecs (Megatron column/row split). Returns
+    (params, specs): qkv.weight [h, 3h] -> [h, 3, H, hd] sharded on H;
+    proj/fc2 row-split with the matching psum in the decode block; biases
+    of row-parallel layers stay replicated (added once after the psum)."""
+    from jax.sharding import PartitionSpec as P
+
+    h, Hh = cfg.hidden_size, cfg.num_heads
+    hd = h // Hh
+    out, specs = {}, {}
+    for n, v in params.items():
+        if n.endswith("attn.qkv.weight"):
+            v = v.reshape(h, 3, Hh, hd)
+            specs[n] = P(None, None, "mp", None)
+        elif n.endswith("attn.qkv.bias"):
+            v = v.reshape(3, Hh, hd)
+            specs[n] = P(None, "mp", None)
+        elif n.endswith("attn.proj.weight"):
+            specs[n] = P("mp", None)
+        elif n.endswith("mlp.fc1.weight"):
+            specs[n] = P(None, "mp")
+        elif n.endswith("mlp.fc1.bias"):
+            specs[n] = P("mp")
+        elif n.endswith("mlp.fc2.weight"):
+            specs[n] = P("mp", None)
+        else:
+            specs[n] = P()  # ln/embeddings/head + row-parallel biases
+        out[n] = v
+    return out, specs
+
+
 def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
                   seed, eos_token_id, dtype=None, attention_mask=None,
-                  top_p=None, cache_dtype=None):
+                  top_p=None, cache_dtype=None, tp_mesh=None):
     """TPU-native autoregressive decode: ONE jitted program — prefill plus a
     lax.scan over decode steps against a static-shape KV cache updated with
     dynamic_update_slice. No per-step retrace, no dynamic shapes; the decode
@@ -546,8 +606,21 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
         model, input_ids, max_new_tokens)
     L, Hh = cfg.num_layers, cfg.num_heads
     hd = cfg.hidden_size // Hh
+    tp_axis, tp_size, tp_specs = None, 1, None
+    if tp_mesh is not None:
+        if "mp" not in tp_mesh.axis_names:
+            raise ValueError("tp_mesh needs an 'mp' axis")
+        tp_axis, tp_size = "mp", tp_mesh.shape["mp"]
+        inter = cfg.intermediate_size  # GPTConfig defaults this to 4h
+        if Hh % tp_size != 0 or inter % tp_size != 0:
+            raise ValueError(
+                f"tensor-parallel serving needs num_heads ({Hh}) and the "
+                f"MLP inner dim ({inter}) divisible by mp={tp_size}")
+        params, tp_specs = _tp_param_shard(params, cfg)
     fwd, logits_of, cache_init = _decode_fns(cfg, untied, untied_bias,
-                                             cache_dtype=cache_dtype)
+                                             cache_dtype=cache_dtype,
+                                             tp_axis=tp_axis,
+                                             tp_size=tp_size)
     compute_dtype = _decode_compute_dtype(dtype)
     mask = _left_pad_mask(attention_mask, b, s0)
 
@@ -609,10 +682,30 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
     cache_key = (b, s0, max_new_tokens, float(temperature), top_k,
                  eos_token_id, untied, untied_bias, str(compute_dtype),
                  mask is not None, None if top_p is None else float(top_p),
-                 cache_dtype)
+                 cache_dtype,
+                 # the Mesh itself (hashable): same-size but different
+                 # meshes must not reuse each other's shard_map closure
+                 ("tp", tp_mesh) if tp_mesh is not None else None)
     store = model.__dict__.setdefault("_generate_compiled", {})
     if cache_key not in store:
-        store[cache_key] = jax.jit(run)
+        if tp_mesh is None:
+            store[cache_key] = jax.jit(run)
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            try:
+                from jax import shard_map as _sm
+            except ImportError:
+                from jax.experimental.shard_map import shard_map as _sm
+            try:
+                mapped = _sm(run, mesh=tp_mesh,
+                             in_specs=(tp_specs, P(), P(), P()),
+                             out_specs=P(), check_vma=False)
+            except TypeError:  # older jax: no check_vma param
+                mapped = _sm(run, mesh=tp_mesh,
+                             in_specs=(tp_specs, P(), P(), P()),
+                             out_specs=P())
+            store[cache_key] = jax.jit(mapped)
     if temperature == 0.0:
         key = jax.random.key(0)  # greedy never samples: don't advance the
         # global generator (reproducibility side effect otherwise)

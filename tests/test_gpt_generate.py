@@ -451,7 +451,7 @@ class TestInt8KVCache:
             t = getattr(mem, "temp_size_in_bytes", None)
             if t is None:
                 pytest.skip("backend reports no memory analysis")
-            sizes["int8" if k[-1] == "int8" else "f32"] = t
+            sizes["int8" if "int8" in k else "f32"] = t
         assert sizes["int8"] < 0.75 * sizes["f32"], sizes
 
 
@@ -522,3 +522,85 @@ class TestSpeculativeDecoding:
         arr = np.asarray(spec._data)
         assert arr.shape == (1, 18)
         assert ((0 <= arr) & (arr < 128)).all()
+
+
+class TestTensorParallelDecode:
+    """generate(tp_mesh=...): Megatron-style head/MLP-sharded serving of a
+    DENSE model — local-head KV caches, two psums per layer; tokens must
+    match the single-replica decode exactly."""
+
+    def _mesh(self, n=4):
+        import jax
+
+        from paddle_tpu.distributed.mesh import build_mesh
+
+        return build_mesh((n,), ("mp",), devices=jax.devices()[:n])
+
+    def test_greedy_matches_dense(self):
+        model = _model()
+        ids = paddle.to_tensor(
+            np.random.RandomState(2).randint(0, 128, (2, 6)).astype(np.int32))
+        dense = np.asarray(model.generate(ids, max_new_tokens=8,
+                                          temperature=0.0)._data)
+        tp = np.asarray(model.generate(ids, max_new_tokens=8,
+                                       temperature=0.0,
+                                       tp_mesh=self._mesh())._data)
+        np.testing.assert_array_equal(tp, dense)
+
+    def test_ragged_and_int8_compose(self):
+        model = _model()
+        ids = np.full((2, 6), 7, np.int32)
+        ids[1, :3] = 0
+        amask = np.ones((2, 6), np.int32)
+        amask[1, :3] = 0
+        ids_t = paddle.to_tensor(ids)
+        mk = paddle.to_tensor(amask)
+        dense = np.asarray(model.generate(ids_t, max_new_tokens=6,
+                                          temperature=0.0,
+                                          attention_mask=mk)._data)
+        tp = np.asarray(model.generate(ids_t, max_new_tokens=6,
+                                       temperature=0.0, attention_mask=mk,
+                                       tp_mesh=self._mesh())._data)
+        np.testing.assert_array_equal(tp, dense)
+        # int8 codec correctness under tp, in f32 so psum reassociation
+        # cannot flip near-tie argmaxes (bf16 composition is exercised for
+        # shape/compile by the drive below)
+        i8_dense = np.asarray(model.generate(ids_t, max_new_tokens=6,
+                                             temperature=0.0,
+                                             cache_dtype="int8")._data)
+        i8_tp = np.asarray(model.generate(ids_t, max_new_tokens=6,
+                                          temperature=0.0,
+                                          cache_dtype="int8",
+                                          tp_mesh=self._mesh())._data)
+        np.testing.assert_array_equal(i8_tp, i8_dense)
+        bf = np.asarray(model.generate(ids_t, max_new_tokens=6,
+                                       temperature=0.0, dtype="bfloat16",
+                                       cache_dtype="int8",
+                                       tp_mesh=self._mesh())._data)
+        assert bf.shape == dense.shape
+
+    def test_sampling_replicated_across_ranks(self):
+        """Sampled decode under tp runs the categorical draw replicated on
+        every rank with the same key — output must equal the dense sample
+        with the same seed."""
+        model = _model()
+        ids = paddle.to_tensor(
+            np.random.RandomState(3).randint(0, 128, (2, 5)).astype(np.int32))
+        dense = np.asarray(model.generate(ids, max_new_tokens=6,
+                                          temperature=0.8, top_k=20,
+                                          seed=11)._data)
+        tp = np.asarray(model.generate(ids, max_new_tokens=6,
+                                       temperature=0.8, top_k=20, seed=11,
+                                       tp_mesh=self._mesh())._data)
+        np.testing.assert_array_equal(tp, dense)
+
+    def test_validation(self):
+        import pytest
+
+        model = _model()
+        ids = paddle.to_tensor(np.ones((1, 4), np.int32))
+        with pytest.raises(ValueError, match="divisible"):
+            model.generate(ids, max_new_tokens=2, tp_mesh=self._mesh(8))
+        with pytest.raises(ValueError, match="beam"):
+            model.generate(ids, max_new_tokens=2, num_beams=2,
+                           tp_mesh=self._mesh())

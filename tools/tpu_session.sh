@@ -30,6 +30,11 @@ if grep -q '"metric"' /tmp/tpu_bench.json 2>/dev/null; then
     > /tmp/tpu_bench_gpt2m.json 2>/tmp/tpu_bench_gpt2m.log
   echo "[tpu_session] gpt2m exit=$? $(cat /tmp/tpu_bench_gpt2m.json 2>/dev/null)" >&2
 
+  echo "[tpu_session] gpt2s_16k long-context config..." >&2
+  timeout 3500 python bench.py --config gpt2s_16k \
+    > /tmp/tpu_bench_16k.json 2>/tmp/tpu_bench_16k.log
+  echo "[tpu_session] 16k exit=$? $(cat /tmp/tpu_bench_16k.json 2>/dev/null)" >&2
+
   echo "[tpu_session] ppyolo config..." >&2
   # two fresh heavy compiles (train step + to_static infer+NMS): give it the
   # same worst-case budget as the main bench so timeout never kills mid-compile
