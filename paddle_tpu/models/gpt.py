@@ -300,14 +300,12 @@ class GPTForCausalLM(nn.Layer):
                 raise ValueError(
                     "top_k/top_p are sampling knobs; beam search is "
                     "deterministic — drop them or use num_beams=1")
-            if tp_mesh is not None:
-                raise ValueError("tensor-parallel beam search is not "
-                                 "supported yet; use num_beams=1")
             return _gpt_beam_search(self, input_ids, max_new_tokens,
                                     num_beams, eos_token_id, length_penalty,
                                     dtype=dtype,
                                     attention_mask=attention_mask,
-                                    cache_dtype=cache_dtype)
+                                    cache_dtype=cache_dtype,
+                                    tp_mesh=tp_mesh)
         return _gpt_generate(self, input_ids, max_new_tokens, temperature,
                              top_k, seed, eos_token_id, dtype=dtype,
                              attention_mask=attention_mask, top_p=top_p,
@@ -587,6 +585,41 @@ def _tp_param_shard(params, cfg):
     return out, specs
 
 
+def _tp_setup(tp_mesh, cfg, params):
+    """Shared tensor-parallel serving setup: validates the mesh/config and
+    reshapes+specs the params. Returns (tp_axis, tp_size, params, specs)."""
+    if "mp" not in tp_mesh.axis_names:
+        raise ValueError("tp_mesh needs an 'mp' axis")
+    tp_size = tp_mesh.shape["mp"]
+    Hh, inter = cfg.num_heads, cfg.intermediate_size
+    if Hh % tp_size != 0 or inter % tp_size != 0:
+        raise ValueError(
+            f"tensor-parallel serving needs num_heads ({Hh}) and the "
+            f"MLP inner dim ({inter}) divisible by mp={tp_size}")
+    params, specs = _tp_param_shard(params, cfg)
+    return "mp", tp_size, params, specs
+
+
+def _tp_wrap(run, tp_mesh, tp_specs, n_extra_in, out_specs):
+    """jit(shard_map(run)) for TP serving: params sharded per tp_specs,
+    the n_extra_in trailing args and all outputs replicated."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map as _sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+    in_specs = (tp_specs,) + (P(),) * n_extra_in
+    try:
+        mapped = _sm(run, mesh=tp_mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_vma=False)
+    except TypeError:  # older jax: no check_vma param
+        mapped = _sm(run, mesh=tp_mesh, in_specs=in_specs,
+                     out_specs=out_specs)
+    return jax.jit(mapped)
+
+
 def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
                   seed, eos_token_id, dtype=None, attention_mask=None,
                   top_p=None, cache_dtype=None, tp_mesh=None):
@@ -608,15 +641,7 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
     hd = cfg.hidden_size // Hh
     tp_axis, tp_size, tp_specs = None, 1, None
     if tp_mesh is not None:
-        if "mp" not in tp_mesh.axis_names:
-            raise ValueError("tp_mesh needs an 'mp' axis")
-        tp_axis, tp_size = "mp", tp_mesh.shape["mp"]
-        inter = cfg.intermediate_size  # GPTConfig defaults this to 4h
-        if Hh % tp_size != 0 or inter % tp_size != 0:
-            raise ValueError(
-                f"tensor-parallel serving needs num_heads ({Hh}) and the "
-                f"MLP inner dim ({inter}) divisible by mp={tp_size}")
-        params, tp_specs = _tp_param_shard(params, cfg)
+        tp_axis, tp_size, params, tp_specs = _tp_setup(tp_mesh, cfg, params)
     fwd, logits_of, cache_init = _decode_fns(cfg, untied, untied_bias,
                                              cache_dtype=cache_dtype,
                                              tp_axis=tp_axis,
@@ -693,19 +718,7 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
         else:
             from jax.sharding import PartitionSpec as P
 
-            try:
-                from jax import shard_map as _sm
-            except ImportError:
-                from jax.experimental.shard_map import shard_map as _sm
-            try:
-                mapped = _sm(run, mesh=tp_mesh,
-                             in_specs=(tp_specs, P(), P(), P()),
-                             out_specs=P(), check_vma=False)
-            except TypeError:  # older jax: no check_vma param
-                mapped = _sm(run, mesh=tp_mesh,
-                             in_specs=(tp_specs, P(), P(), P()),
-                             out_specs=P())
-            store[cache_key] = jax.jit(mapped)
+            store[cache_key] = _tp_wrap(run, tp_mesh, tp_specs, 3, P())
     if temperature == 0.0:
         key = jax.random.key(0)  # greedy never samples: don't advance the
         # global generator (reproducibility side effect otherwise)
@@ -896,7 +909,7 @@ def _left_pad_mask(attention_mask, b, s0):
 
 def _gpt_beam_search(model, input_ids, max_new_tokens, num_beams,
                      eos_token_id, length_penalty, dtype=None,
-                     attention_mask=None, cache_dtype=None):
+                     attention_mask=None, cache_dtype=None, tp_mesh=None):
     """Beam search over the same fused KV-cache program: prefill once at
     batch b, tile the cache per beam ([L, b*K, H, T, hd]), and lax.scan
     steps that (a) add log-probs, (b) take the joint top-K over K*V
@@ -917,8 +930,13 @@ def _gpt_beam_search(model, input_ids, max_new_tokens, num_beams,
     L, Hh = cfg.num_layers, cfg.num_heads
     hd = cfg.hidden_size // Hh
     K, V = num_beams, cfg.vocab_size
+    tp_axis, tp_size, tp_specs = None, 1, None
+    if tp_mesh is not None:
+        tp_axis, tp_size, params, tp_specs = _tp_setup(tp_mesh, cfg, params)
     fwd, logits_of, cache_init = _decode_fns(cfg, untied, untied_bias,
-                                             cache_dtype=cache_dtype)
+                                             cache_dtype=cache_dtype,
+                                             tp_axis=tp_axis,
+                                             tp_size=tp_size)
     eos = -1 if eos_token_id is None else int(eos_token_id)
     compute_dtype = _decode_compute_dtype(dtype)
     mask = _left_pad_mask(attention_mask, b, s0)
@@ -1008,10 +1026,17 @@ def _gpt_beam_search(model, input_ids, max_new_tokens, num_beams,
 
     cache_key = ("beam", b, s0, max_new_tokens, K, eos, untied, untied_bias,
                  float(length_penalty), str(compute_dtype), mask is not None,
-                 cache_dtype)
+                 cache_dtype,
+                 ("tp", tp_mesh) if tp_mesh is not None else None)
     store = model.__dict__.setdefault("_generate_compiled", {})
     if cache_key not in store:
-        store[cache_key] = jax.jit(run)
+        if tp_mesh is None:
+            store[cache_key] = jax.jit(run)
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            store[cache_key] = _tp_wrap(run, tp_mesh, tp_specs, 2,
+                                        (P(), P()))
     out, score = store[cache_key](params, ids, mask)
     full = jnp.concatenate([ids.astype(out.dtype), out], axis=1)
     return Tensor(full), Tensor(score)

@@ -601,6 +601,23 @@ class TestTensorParallelDecode:
         ids = paddle.to_tensor(np.ones((1, 4), np.int32))
         with pytest.raises(ValueError, match="divisible"):
             model.generate(ids, max_new_tokens=2, tp_mesh=self._mesh(8))
-        with pytest.raises(ValueError, match="beam"):
+        with pytest.raises(ValueError, match="divisible"):  # beam path too
             model.generate(ids, max_new_tokens=2, num_beams=2,
-                           tp_mesh=self._mesh())
+                           tp_mesh=self._mesh(8))
+        with pytest.raises(ValueError, match="mp"):
+            from paddle_tpu.distributed.mesh import build_mesh
+            import jax
+            bad = build_mesh((4,), ("dp",), devices=jax.devices()[:4])
+            model.generate(ids, max_new_tokens=2, tp_mesh=bad)
+
+    def test_beam_search_matches_dense(self):
+        model = _model()
+        ids = paddle.to_tensor(
+            np.random.RandomState(3).randint(0, 128, (2, 5)).astype(np.int32))
+        s_d, sc_d = model.generate(ids, max_new_tokens=6, num_beams=3)
+        s_t, sc_t = model.generate(ids, max_new_tokens=6, num_beams=3,
+                                   tp_mesh=self._mesh())
+        np.testing.assert_array_equal(np.asarray(s_t._data),
+                                      np.asarray(s_d._data))
+        np.testing.assert_allclose(np.asarray(sc_t._data),
+                                   np.asarray(sc_d._data), atol=1e-4)
