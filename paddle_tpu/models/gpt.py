@@ -313,16 +313,17 @@ class GPTForCausalLM(nn.Layer):
 
     def generate_speculative(self, draft_model, input_ids,
                              max_new_tokens=32, k=4, dtype=None,
-                             cache_dtype=None):
+                             cache_dtype=None, tp_mesh=None):
         """Speculative greedy decoding with a small draft model: identical
         output to greedy `generate` (the acceptance rule is exact) but
         1..k+1 tokens per target forward. Returns (sequences, n_rounds) —
         n_rounds target forwards vs max_new_tokens single-token steps is
-        the speedup headroom. Batch 1; greedy only. See _gpt_speculative
-        for the cache-invariant design notes."""
+        the speedup headroom. Batch 1; greedy only. tp_mesh shards the
+        TARGET over 'mp' (the draft stays replicated — it is small by
+        design). See _gpt_speculative for the cache-invariant notes."""
         return _gpt_speculative(self, draft_model, input_ids,
                                 max_new_tokens, k=k, dtype=dtype,
-                                cache_dtype=cache_dtype)
+                                cache_dtype=cache_dtype, tp_mesh=tp_mesh)
 
     def pipeline_split(self, pp_degree):
         """Split into (pre, stages, post_loss) for distributed.pipeline.
@@ -734,7 +735,7 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
 
 
 def _gpt_speculative(model, draft_model, input_ids, max_new_tokens, k=4,
-                     dtype=None, cache_dtype=None):
+                     dtype=None, cache_dtype=None, tp_mesh=None):
     """Speculative GREEDY decoding (beyond reference): a small draft model
     proposes k tokens per round; the target verifies all k in ONE forward
     and accepts the longest matching prefix plus its own fix-up token, so
@@ -779,8 +780,14 @@ def _gpt_speculative(model, draft_model, input_ids, max_new_tokens, k=4,
     d_untied, d_untied_bias, params_d = _decode_params(draft_model,
                                                        "the draft model")
 
+    tp_axis, tp_size, tp_specs = None, 1, None
+    if tp_mesh is not None:
+        # target shards over mp; the (small) draft stays replicated
+        tp_axis, tp_size, params, tp_specs = _tp_setup(tp_mesh, cfg, params)
     fwd_t, logits_t, cache_init_t = _decode_fns(cfg, untied, untied_bias,
-                                                cache_dtype=cache_dtype)
+                                                cache_dtype=cache_dtype,
+                                                tp_axis=tp_axis,
+                                                tp_size=tp_size)
     fwd_d, logits_d, cache_init_d = _decode_fns(d_cfg, d_untied,
                                                 d_untied_bias,
                                                 cache_dtype=cache_dtype)
@@ -855,10 +862,19 @@ def _gpt_speculative(model, draft_model, input_ids, max_new_tokens, k=4,
                  # value-based draft identity (id() could alias a GC'd
                  # model of a different architecture)
                  d_cfg.num_layers, d_cfg.hidden_size, d_cfg.num_heads,
-                 d_cfg.vocab_size, d_cfg.max_seq_len)
+                 d_cfg.vocab_size, d_cfg.max_seq_len,
+                 ("tp", tp_mesh) if tp_mesh is not None else None)
     store = model.__dict__.setdefault("_generate_compiled", {})
     if cache_key not in store:
-        store[cache_key] = jax.jit(run)
+        if tp_mesh is None:
+            store[cache_key] = jax.jit(run)
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            # run(pt, pd, ids): a bare P() prefix replicates the whole
+            # draft-param dict and the ids
+            store[cache_key] = _tp_wrap(run, tp_mesh, tp_specs, 2,
+                                        (P(), P()))
     out, rounds = store[cache_key](params, params_d, ids)
     full = jnp.concatenate([ids.astype(out.dtype), out], axis=1)
     return Tensor(full), int(rounds)

@@ -621,3 +621,25 @@ class TestTensorParallelDecode:
                                       np.asarray(s_d._data))
         np.testing.assert_allclose(np.asarray(sc_t._data),
                                    np.asarray(sc_d._data), atol=1e-4)
+
+    def test_speculative_under_tp(self):
+        """Speculative decode with the TARGET sharded over mp (draft
+        replicated) still reproduces the plain greedy output exactly."""
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=128, dropout=0.0)
+        target = GPTForCausalLM(cfg)
+        target.eval()
+        paddle.seed(7)
+        draft = GPTForCausalLM(GPTConfig(vocab_size=128, hidden_size=32,
+                                         num_layers=1, num_heads=2,
+                                         max_seq_len=128, dropout=0.0))
+        draft.eval()
+        ids = paddle.to_tensor(
+            np.random.RandomState(2).randint(0, 128, (1, 6)).astype(np.int32))
+        plain = np.asarray(target.generate(ids, max_new_tokens=16,
+                                           temperature=0.0)._data)
+        spec, rounds = target.generate_speculative(
+            draft, ids, max_new_tokens=16, k=4, tp_mesh=self._mesh())
+        np.testing.assert_array_equal(np.asarray(spec._data), plain)
+        assert 1 <= rounds <= 16
