@@ -24,6 +24,8 @@ class ColumnParallelLinear(Layer):
     def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
                  gather_output=True, name=None, mp_axis="mp"):
         super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
         self.weight = self.create_parameter([in_features, out_features], attr=weight_attr,
                                             default_initializer=I.XavierNormal())
         self.weight.spmd_spec = P(None, mp_axis)
@@ -42,6 +44,8 @@ class RowParallelLinear(Layer):
     def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
                  input_is_parallel=False, name=None, mp_axis="mp"):
         super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
         self.weight = self.create_parameter([in_features, out_features], attr=weight_attr,
                                             default_initializer=I.XavierNormal())
         self.weight.spmd_spec = P(mp_axis, None)

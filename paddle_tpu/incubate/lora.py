@@ -41,15 +41,27 @@ def _unfreeze(p):
     p.stop_gradient = False
 
 
+def _wrappable_types():
+    """Linear-like layers LoRA can wrap: plain nn.Linear plus the tensor-
+    parallel variants (full [in, out] weights with spmd_spec annotations;
+    the tiny A/B adapters stay replicated, the frozen base keeps its
+    sharding — GSPMD reconciles the replicated low-rank add)."""
+    from ..distributed.split import ColumnParallelLinear, RowParallelLinear
+
+    return (Linear, ColumnParallelLinear, RowParallelLinear)
+
+
 class LoRALinear(Layer):
-    """Wraps an existing ``nn.Linear``; the base weight/bias are frozen and
-    only ``lora_A``/``lora_B`` train. ``merge()`` folds the adapter back
-    into the base layer for zero-overhead serving."""
+    """Wraps an existing ``nn.Linear`` (or Column/RowParallelLinear); the
+    base weight/bias are frozen and only ``lora_A``/``lora_B`` train.
+    ``merge()`` folds the adapter back into the base layer for
+    zero-overhead serving."""
 
     def __init__(self, base, r=8, alpha=None, dropout=0.0):
         super().__init__()
-        if not isinstance(base, Linear):
-            raise TypeError(f"LoRALinear wraps nn.Linear, got {type(base)}")
+        if not isinstance(base, _wrappable_types()):
+            raise TypeError(f"LoRALinear wraps nn.Linear or the tensor-"
+                            f"parallel Linears, got {type(base)}")
         if r <= 0:
             raise ValueError(f"rank must be positive, got {r}")
         self.base = base
@@ -76,7 +88,9 @@ class LoRALinear(Layer):
 
     def merge(self):
         """Fold scaling * A @ B into the base weight and return the base
-        Linear (unfrozen), dropping the adapter."""
+        layer (a plain or tensor-parallel Linear, unfrozen — set_value
+        keeps the parameter object, so spmd_spec survives), dropping the
+        adapter."""
         w = np.asarray(self.base.weight.numpy())
         a = np.asarray(self.lora_A.numpy())
         b = np.asarray(self.lora_B.numpy())
@@ -97,6 +111,8 @@ def _iter_linear_sites(layer, target_modules):
     (HF-style, e.g. ["q_proj", "v_proj"]); None matches every Linear."""
     sites = []
 
+    wrap_types = _wrappable_types()
+
     def walk(parent, prefix):
         for key, sub in list(parent._sub_layers.items()):
             if sub is None:
@@ -104,7 +120,7 @@ def _iter_linear_sites(layer, target_modules):
             qual = f"{prefix}.{key}" if prefix else key
             if isinstance(sub, LoRALinear):
                 continue  # never double-wrap (also skips its .base)
-            if isinstance(sub, Linear):
+            if isinstance(sub, wrap_types):
                 if target_modules is None or any(t in qual
                                                  for t in target_modules):
                     sites.append((parent, key, qual))
@@ -156,8 +172,9 @@ def mark_only_lora_trainable(layer):
 
 
 def merge_lora(layer):
-    """Recursively fold every LoRALinear back into a plain Linear (in place)
-    and restore the pre-apply_lora trainable set. Returns the number of
+    """Recursively fold every LoRALinear back into its base layer (plain or
+    tensor-parallel Linear, in place) and restore the pre-apply_lora
+    trainable set. Returns the number of
     distinct adapters merged (a shared adapter merges once even if it is
     registered under several parents)."""
     merged_bases = {}  # id(wrapper) -> merged base Linear

@@ -262,3 +262,43 @@ class TestLoRAGuards:
         ids = paddle.to_tensor(np.zeros((1, 4), np.int32))
         with pytest.raises(ValueError, match="merge_lora"):
             model.generate(ids, max_new_tokens=2)
+
+
+class TestLoRATensorParallel:
+    def test_wraps_parallel_linears_and_merges(self, seed):
+        """LoRA on a tensor-parallel GPT (Column/RowParallelLinear blocks):
+        adapters train eagerly, bases stay frozen with their spmd_spec, and
+        merge restores a forward identical to the trained LoRA model."""
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_heads=4, max_seq_len=16, dropout=0.0,
+                        tensor_parallel=True)
+        model = GPTForCausalLM(cfg)
+        replaced = apply_lora(model, r=2, target_modules=["attn.qkv",
+                                                          "mlp.fc1"])
+        assert len(replaced) == 2
+        qkv = model.gpt.blocks[0].attn.qkv
+        assert isinstance(qkv, LoRALinear)
+        # frozen base keeps its tensor-parallel sharding annotation
+        assert getattr(qkv.base.weight, "spmd_spec", None) is not None
+        assert not qkv.base.weight.trainable
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=lora_parameters(model))
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 64, (2, 8)).astype(np.int32))
+        labels = paddle.to_tensor(
+            rng.randint(0, 64, (2, 8)).astype(np.int32))
+        losses = []
+        for _ in range(3):
+            loss = model.loss(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss._data)))
+        assert losses[-1] < losses[0]
+        model.eval()
+        y = np.asarray(model(ids)._data)
+        assert merge_lora(model) == 2
+        np.testing.assert_allclose(np.asarray(model(ids)._data), y,
+                                   atol=1e-4, rtol=1e-4)
