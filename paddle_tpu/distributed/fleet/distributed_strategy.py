@@ -12,6 +12,9 @@ from dataclasses import dataclass, field
 
 @dataclass
 class RecomputeConfig:  # proto:25-28
+    # reference: tensor names to checkpoint. TPU mapping: a jax.checkpoint
+    # policy name in this list ("dots"/"dots_no_batch"/"nothing"/
+    # "everything") selects SpmdTrainer's recompute_policy instead.
     checkpoints: list = field(default_factory=list)
     enable_offload: bool = False
     checkpoint_shape: list = field(default_factory=list)

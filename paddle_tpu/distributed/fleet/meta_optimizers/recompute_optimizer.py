@@ -8,7 +8,17 @@ class RecomputeOptimizer(MetaOptimizerBase):
         return strategy.recompute
 
     def apply(self, trainer_kwargs, optimizer, strategy):
+        from ...spmd import _REMAT_POLICIES
+
         trainer_kwargs["recompute"] = True
-        if strategy.recompute_configs.enable_offload:
+        cfg = strategy.recompute_configs
+        if cfg.enable_offload:
             trainer_kwargs["remat_offload"] = True  # jax.checkpoint offload policy
+        elif cfg.checkpoints:
+            # reference checkpoints name TENSORS to save; the TPU analog is a
+            # jax.checkpoint policy — accept a policy name in the list
+            # (e.g. recompute_configs.checkpoints = ["dots"])
+            named = [c for c in cfg.checkpoints if c in _REMAT_POLICIES]
+            if named:
+                trainer_kwargs["recompute_policy"] = named[0]
         return trainer_kwargs, optimizer

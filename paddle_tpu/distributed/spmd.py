@@ -106,6 +106,23 @@ def _collect_moe_aux(layer):
     return aux
 
 
+#: named selective-remat policies (SpmdTrainer recompute_policy=...): the
+#: TPU-native analog of the reference RecomputeConfig.checkpoints name list
+_REMAT_POLICIES = {
+    "dots": "dots_saveable",
+    "dots_no_batch": "dots_with_no_batch_dims_saveable",
+    "nothing": "nothing_saveable",
+    "everything": "everything_saveable",
+}
+
+
+def _resolve_remat_policy(name):
+    if name not in _REMAT_POLICIES:
+        raise ValueError(f"recompute_policy must be one of "
+                         f"{sorted(_REMAT_POLICIES)}, got {name!r}")
+    return getattr(jax.checkpoint_policies, _REMAT_POLICIES[name])
+
+
 class SpmdTrainer:
     """Compile a Layer + Optimizer + loss into one sharded XLA train step."""
 
@@ -142,6 +159,16 @@ class SpmdTrainer:
             raise ValueError(
                 "return_outputs is not supported with localsgd/DGC steps "
                 "(their shard_map programs do not thread outputs)")
+        pol = extra_kwargs.get("recompute_policy")
+        if pol is not None:
+            _resolve_remat_policy(pol)  # fail fast on unknown names
+            if not recompute:
+                raise ValueError("recompute_policy requires recompute=True "
+                                 "(the policy selects WHAT jax.checkpoint "
+                                 "saves; without remat it changes nothing)")
+            if extra_kwargs.get("remat_offload"):
+                raise ValueError("remat_offload and recompute_policy both "
+                                 "select a jax.checkpoint policy — pick one")
         self._compiled = None
         self.params = {n: p._data for n, p in layer.named_parameters() if getattr(p, "trainable", True)}
         self.frozen = {n: p._data for n, p in layer.named_parameters() if not getattr(p, "trainable", True)}
@@ -324,6 +351,15 @@ class SpmdTrainer:
                 policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
                     "device", "pinned_host")
                 fwd = jax.checkpoint(fwd, static_argnums=(), policy=policy)
+            elif self.extra_kwargs.get("recompute_policy") is not None:
+                # selective remat: trade recompute FLOPs vs HBM per policy.
+                # 'dots' saves matmul outputs (recompute elementwise only) —
+                # usually the sweet spot on TPU; 'nothing' recomputes
+                # everything (max memory savings, max FLOPs).
+                fwd = jax.checkpoint(
+                    fwd, static_argnums=(),
+                    policy=_resolve_remat_policy(
+                        self.extra_kwargs["recompute_policy"]))
             else:
                 fwd = jax.checkpoint(fwd, static_argnums=())
         return fwd
