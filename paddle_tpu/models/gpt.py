@@ -313,7 +313,8 @@ class GPTForCausalLM(nn.Layer):
 
     def generate_speculative(self, draft_model, input_ids,
                              max_new_tokens=32, k=4, dtype=None,
-                             cache_dtype=None, tp_mesh=None):
+                             cache_dtype=None, tp_mesh=None,
+                             eos_token_id=None):
         """Speculative greedy decoding with a small draft model: identical
         output to greedy `generate` (the acceptance rule is exact) but
         1..k+1 tokens per target forward. Returns (sequences, n_rounds) —
@@ -323,7 +324,8 @@ class GPTForCausalLM(nn.Layer):
         design). See _gpt_speculative for the cache-invariant notes."""
         return _gpt_speculative(self, draft_model, input_ids,
                                 max_new_tokens, k=k, dtype=dtype,
-                                cache_dtype=cache_dtype, tp_mesh=tp_mesh)
+                                cache_dtype=cache_dtype, tp_mesh=tp_mesh,
+                                eos_token_id=eos_token_id)
 
     def pipeline_split(self, pp_degree):
         """Split into (pre, stages, post_loss) for distributed.pipeline.
@@ -735,7 +737,8 @@ def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
 
 
 def _gpt_speculative(model, draft_model, input_ids, max_new_tokens, k=4,
-                     dtype=None, cache_dtype=None, tp_mesh=None):
+                     dtype=None, cache_dtype=None, tp_mesh=None,
+                     eos_token_id=None):
     """Speculative GREEDY decoding (beyond reference): a small draft model
     proposes k tokens per round; the target verifies all k in ONE forward
     and accepts the longest matching prefix plus its own fix-up token, so
@@ -753,8 +756,9 @@ def _gpt_speculative(model, draft_model, input_ids, max_new_tokens, k=4,
     [cur, p0..p_{k-1}] (target) so stale columns beyond the accepted prefix
     are never read (causal mask) and are overwritten by later rounds.
 
-    v1 scope: batch 1, greedy only, no eos early-stop (the emitted count is
-    exact, so callers can post-trim at eos)."""
+    Scope: batch 1, greedy only. eos_token_id stops the loop once the
+    accepted slice contains eos, filling the tail with eos exactly like
+    the dense scan's done-mask — fewer rounds on early termination."""
     import jax
     import jax.numpy as jnp
 
@@ -809,8 +813,11 @@ def _gpt_speculative(model, draft_model, input_ids, max_new_tokens, k=4,
         cur = ids_[:, s0 - 1]                              # [1]
         out_buf = jnp.zeros((1, max_new_tokens + k + 1), jnp.int32)
 
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+
         def round_body(carry):
-            pos, cur, emitted, out_buf, kc_t, vc_t, kc_d, vc_d, rounds = carry
+            (pos, cur, emitted, out_buf, kc_t, vc_t, kc_d, vc_d, rounds,
+             done) = carry
             # --- draft proposes k tokens (k single-token forwards) -------
             props = []
             d_cur = cur
@@ -843,26 +850,39 @@ def _gpt_speculative(model, draft_model, input_ids, max_new_tokens, k=4,
             fixup = preds[0, m]
             emit = jnp.where(j_idx < m, jnp.pad(props_a[0], (0, 1)),
                              fixup)                        # [k+1]
+            if eos >= 0:
+                # dense-generate parity: everything after the first eos in
+                # the ACCEPTED slice becomes eos, and the loop stops
+                seen = jnp.cumsum((emit == eos) & (j_idx <= m)) > 0
+                emit = jnp.where(seen, eos, emit)
+                done = done | seen[m]
             out_buf = jax.lax.dynamic_update_slice(out_buf, emit[None],
                                                    (0, emitted))
             return (pos + m + 1, preds[:, m], emitted + m + 1, out_buf,
-                    kc_t, vc_t, kc_d, vc_d, rounds + 1)
+                    kc_t, vc_t, kc_d, vc_d, rounds + 1, done)
 
         def cond(carry):
-            return carry[2] < max_new_tokens
+            return (carry[2] < max_new_tokens) & ~carry[-1]
 
         init = (jnp.int32(s0 - 1), cur, jnp.int32(0), out_buf,
-                kc_t, vc_t, kc_d, vc_d, jnp.int32(0))
-        pos, cur, emitted, out_buf, *_, rounds = jax.lax.while_loop(
+                kc_t, vc_t, kc_d, vc_d, jnp.int32(0),
+                jnp.asarray(False))
+        (pos, cur, emitted, out_buf, *_, rounds, done) = jax.lax.while_loop(
             cond, round_body, init)
-        return out_buf[:, :max_new_tokens], rounds
+        out = out_buf[:, :max_new_tokens]
+        if eos >= 0:
+            # early stop leaves the tail unwritten: fill with eos (what the
+            # dense scan would have emitted after done)
+            out = jnp.where(jnp.arange(max_new_tokens)[None] >= emitted,
+                            eos, out)
+        return out, rounds
 
     cache_key = ("spec", b, s0, max_new_tokens, k, untied, untied_bias,
                  d_untied, d_untied_bias, str(compute_dtype), cache_dtype,
                  # value-based draft identity (id() could alias a GC'd
                  # model of a different architecture)
                  d_cfg.num_layers, d_cfg.hidden_size, d_cfg.num_heads,
-                 d_cfg.vocab_size, d_cfg.max_seq_len,
+                 d_cfg.vocab_size, d_cfg.max_seq_len, eos_token_id,
                  ("tp", tp_mesh) if tp_mesh is not None else None)
     store = model.__dict__.setdefault("_generate_compiled", {})
     if cache_key not in store:

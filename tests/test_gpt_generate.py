@@ -643,3 +643,23 @@ class TestTensorParallelDecode:
             draft, ids, max_new_tokens=16, k=4, tp_mesh=self._mesh())
         np.testing.assert_array_equal(np.asarray(spec._data), plain)
         assert 1 <= rounds <= 16
+
+
+def test_speculative_eos_early_stop_matches_dense():
+    """eos inside the accepted slice stops the speculative loop early and
+    the output (eos-filled tail) matches dense generate with the same eos."""
+    model = _model()
+    ids = paddle.to_tensor(
+        np.random.RandomState(2).randint(0, 128, (1, 6)).astype(np.int32))
+    plain = np.asarray(model.generate(ids, max_new_tokens=20,
+                                      temperature=0.0)._data)
+    eos_tok = int(plain[0, 6 + 4])  # the 5th generated token as 'eos'
+    dense = np.asarray(model.generate(ids, max_new_tokens=20,
+                                      temperature=0.0,
+                                      eos_token_id=eos_tok)._data)
+    spec, rounds = model.generate_speculative(model, ids, max_new_tokens=20,
+                                              k=4, eos_token_id=eos_tok)
+    np.testing.assert_array_equal(np.asarray(spec._data), dense)
+    # perfect draft without eos needs ceil(20/5)=4 rounds; the early eos
+    # must cut that down
+    assert rounds < 4, rounds
