@@ -28,6 +28,7 @@ backward kernels run per ring block (global-lse blockwise calls are exact).
 """
 import functools
 import math
+import operator
 
 import jax
 import jax.numpy as jnp
@@ -74,38 +75,58 @@ def supported(q_shape, dtype_str):
     return True
 
 
-def _kv_index(causal):
-    """K/V block map for (b, qi, ki) grids: on masked causal steps (ki > qi)
-    alias the diagonal block already in VMEM so no new DMA is issued."""
+def _kv_index(causal, n_win=None):
+    """K/V block map for (b, qi, ki) grids: on masked steps (causal ki > qi,
+    or window ki < qi - n_win) alias a block already needed so no new DMA
+    is issued."""
     if not causal:
         return lambda b, qi, ki: (b, ki, 0)
-    return lambda b, qi, ki: (b, jnp.minimum(ki, qi), 0)
+    if n_win is None:
+        return lambda b, qi, ki: (b, jnp.minimum(ki, qi), 0)
+    return lambda b, qi, ki: (b, jnp.clip(ki, jnp.maximum(qi - n_win, 0),
+                                          qi), 0)
 
 
-def _q_index(causal):
-    """Q/dO block map for (b, ki, qi) grids: masked steps (qi < ki) alias ki."""
+def _q_index(causal, n_win=None):
+    """Q/dO block map for (b, ki, qi) grids: masked steps alias into the
+    visible band [ki, ki + n_win]."""
     if not causal:
         return lambda b, ki, qi: (b, qi, 0)
-    return lambda b, ki, qi: (b, jnp.maximum(qi, ki), 0)
+    if n_win is None:
+        return lambda b, ki, qi: (b, jnp.maximum(qi, ki), 0)
+    return lambda b, ki, qi: (b, jnp.clip(qi, ki, ki + n_win), 0)
 
 
-def _lse_index(causal):
+def _lse_index(causal, n_win=None):
     if not causal:
         return lambda b, ki, qi: (b, 0, qi)
-    return lambda b, ki, qi: (b, 0, jnp.maximum(qi, ki))
+    if n_win is None:
+        return lambda b, ki, qi: (b, 0, jnp.maximum(qi, ki))
+    return lambda b, ki, qi: (b, 0, jnp.clip(qi, ki, ki + n_win))
 
 
-def _causal_mask(qi, ki, scores):
+def _causal_mask(qi, ki, scores, window=None):
+    """Causal (and optionally sliding-window) score mask: keep
+    k_pos <= q_pos, and with `window` also q_pos - k_pos < window."""
     bq, bk = scores.shape
     q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return jnp.where(q_pos >= k_pos, scores, _NEG)
+    keep = q_pos >= k_pos
+    if window is not None:
+        keep &= (q_pos - k_pos) < window
+    return jnp.where(keep, scores, _NEG)
+
+
+def _n_win(window, blk):
+    """Max block distance qi - ki with any visible position (conservative
+    by at most one block; exact masking happens inside the kernel)."""
+    return None if window is None else (window - 1 + blk - 1) // blk
 
 
 # ---------------- forward kernel ---------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                causal, scale, n_k, d, blk):
+                causal, scale, n_k, d, blk, window=None, nwin=None):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -118,6 +139,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         l_ref[...] = jnp.zeros((blk, 128), jnp.float32)
 
     run = (ki <= qi) if causal else (ki >= 0)
+    if nwin is not None:
+        run &= (qi - ki) <= nwin
 
     @pl.when(run)
     def _step():
@@ -126,7 +149,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         v_blk = v_ref[...].astype(jnp.float32)
         scores = q_blk @ k_blk.T                              # [BQ, BK]
         if causal:
-            scores = _causal_mask(qi, ki, scores)
+            scores = _causal_mask(qi, ki, scores, window)
         m_prev = m_ref[...]                                   # [BQ, 128]
         l_prev = l_ref[...]
         m_cur = jnp.broadcast_to(jnp.max(scores, -1, keepdims=True),
@@ -146,23 +169,27 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         lse_ref[...] = (m_ref[:, :1] + jnp.log(l)).reshape(1, blk)
 
 
-def _flash_fwd(q3, k3, v3, causal, scale, interpret):
-    """q3/k3/v3: [bh, s, d] -> (o [bh, s, d], lse [bh, s] f32)."""
+def _flash_fwd(q3, k3, v3, causal, scale, interpret, window=None):
+    """q3/k3/v3: [bh, s, d] -> (o [bh, s, d], lse [bh, s] f32). window:
+    sliding-window causal attention (keep q_pos - k_pos < window)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import BlockSpec
     from jax.experimental.pallas import tpu as pltpu
 
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     bh, s, d = q3.shape
     blk = _block_for(s)
+    nwin = _n_win(window, blk)
     n_q, n_k = s // blk, s // blk
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, scale=scale, n_k=n_k,
-                          d=d, blk=blk),
+                          d=d, blk=blk, window=window, nwin=nwin),
         grid=(bh, n_q, n_k),
         in_specs=[
             BlockSpec((None, blk, d), lambda b, qi, ki: (b, qi, 0)),
-            BlockSpec((None, blk, d), _kv_index(causal)),
-            BlockSpec((None, blk, d), _kv_index(causal)),
+            BlockSpec((None, blk, d), _kv_index(causal, nwin)),
+            BlockSpec((None, blk, d), _kv_index(causal, nwin)),
         ],
         out_specs=[
             BlockSpec((None, blk, d), lambda b, qi, ki: (b, qi, 0)),
@@ -185,7 +212,8 @@ def _flash_fwd(q3, k3, v3, causal, scale, interpret):
 # ---------------- backward kernels -------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc_ref, *, causal, scale, n_k, d, blk):
+               dq_acc_ref, *, causal, scale, n_k, d, blk, window=None,
+               nwin=None):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -196,6 +224,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_acc_ref[...] = jnp.zeros((blk, d), jnp.float32)
 
     run = (ki <= qi) if causal else (ki >= 0)
+    if nwin is not None:
+        run &= (qi - ki) <= nwin
 
     @pl.when(run)
     def _step():
@@ -207,7 +237,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         delta = delta_ref[...].reshape(blk, 1)
         scores = q_blk @ k_blk.T                              # [BQ, BK]
         if causal:
-            scores = _causal_mask(qi, ki, scores)
+            scores = _causal_mask(qi, ki, scores, window)
         p = jnp.exp(scores - lse)                             # [BQ, BK]
         dp = do_blk @ v_blk.T
         ds = p * (dp - delta)
@@ -219,7 +249,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                dk_acc_ref, dv_acc_ref, *, causal, scale, n_q, d, blk):
+                dk_acc_ref, dv_acc_ref, *, causal, scale, n_q, d, blk,
+                window=None, nwin=None):
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(1)
@@ -231,6 +262,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dv_acc_ref[...] = jnp.zeros((blk, d), jnp.float32)
 
     run = (qi >= ki) if causal else (qi >= 0)
+    if nwin is not None:
+        run &= (qi - ki) <= nwin
 
     @pl.when(run)
     def _step():
@@ -242,7 +275,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         delta = delta_ref[...].reshape(blk, 1)
         scores = q_blk @ k_blk.T                              # [BQ, BK]
         if causal:
-            scores = _causal_mask(qi, ki, scores)
+            scores = _causal_mask(qi, ki, scores, window)
         p = jnp.exp(scores - lse)                             # [BQ, BK]
         dv_acc_ref[...] += p.T @ do_blk
         dp = do_blk @ v_blk.T
@@ -256,13 +289,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
 
 def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, scale, interpret,
-               delta=None):
+               delta=None, window=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import BlockSpec
     from jax.experimental.pallas import tpu as pltpu
 
     bh, s, d = q3.shape
     blk = _block_for(s)
+    nwin = _n_win(window, blk)
     n_q, n_k = s // blk, s // blk
     if delta is None:  # ring callers precompute: o3/do3 are hop-invariant
         delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
@@ -272,12 +306,12 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, scale, interpret,
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, scale=scale, n_k=n_k,
-                          d=d, blk=blk),
+                          d=d, blk=blk, window=window, nwin=nwin),
         grid=(bh, n_q, n_k),
         in_specs=[
             BlockSpec((None, blk, d), lambda b, qi, ki: (b, qi, 0)),
-            BlockSpec((None, blk, d), _kv_index(causal)),
-            BlockSpec((None, blk, d), _kv_index(causal)),
+            BlockSpec((None, blk, d), _kv_index(causal, nwin)),
+            BlockSpec((None, blk, d), _kv_index(causal, nwin)),
             BlockSpec((None, blk, d), lambda b, qi, ki: (b, qi, 0)),
             BlockSpec((None, 1, blk), lambda b, qi, ki: (b, 0, qi)),
             BlockSpec((None, 1, blk), lambda b, qi, ki: (b, 0, qi)),
@@ -290,15 +324,15 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, scale, interpret,
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, scale=scale, n_q=n_q,
-                          d=d, blk=blk),
+                          d=d, blk=blk, window=window, nwin=nwin),
         grid=(bh, n_k, n_q),
         in_specs=[
-            BlockSpec((None, blk, d), _q_index(causal)),
+            BlockSpec((None, blk, d), _q_index(causal, nwin)),
             BlockSpec((None, blk, d), lambda b, ki, qi: (b, ki, 0)),
             BlockSpec((None, blk, d), lambda b, ki, qi: (b, ki, 0)),
-            BlockSpec((None, blk, d), _q_index(causal)),
-            BlockSpec((None, 1, blk), _lse_index(causal)),
-            BlockSpec((None, 1, blk), _lse_index(causal)),
+            BlockSpec((None, blk, d), _q_index(causal, nwin)),
+            BlockSpec((None, 1, blk), _lse_index(causal, nwin)),
+            BlockSpec((None, 1, blk), _lse_index(causal, nwin)),
         ],
         out_specs=[
             BlockSpec((None, blk, d), lambda b, ki, qi: (b, ki, 0)),
@@ -319,31 +353,37 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, scale, interpret,
 
 # ---------------- public API (custom VJP over [b, s, h, d]) -------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q3, k3, v3, causal, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q3, k3, v3, causal, interpret, window=None):
     scale = 1.0 / math.sqrt(q3.shape[-1])
-    o, _ = _flash_fwd(q3, k3, v3, causal, scale, interpret)
+    o, _ = _flash_fwd(q3, k3, v3, causal, scale, interpret, window=window)
     return o
 
 
-def _flash_fwd_rule(q3, k3, v3, causal, interpret):
+def _flash_fwd_rule(q3, k3, v3, causal, interpret, window=None):
     scale = 1.0 / math.sqrt(q3.shape[-1])
-    o, lse = _flash_fwd(q3, k3, v3, causal, scale, interpret)
+    o, lse = _flash_fwd(q3, k3, v3, causal, scale, interpret, window=window)
     return o, (q3, k3, v3, o, lse)
 
 
-def _flash_bwd_rule(causal, interpret, res, do3):
+def _flash_bwd_rule(causal, interpret, window, res, do3):
     q3, k3, v3, o3, lse = res
     scale = 1.0 / math.sqrt(q3.shape[-1])
-    dq, dk, dv = _flash_bwd(q3, k3, v3, o3, lse, do3, causal, scale, interpret)
+    dq, dk, dv = _flash_bwd(q3, k3, v3, o3, lse, do3, causal, scale,
+                            interpret, window=window)
     return dq, dk, dv
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_attention(q, k, v, causal=False, interpret=False):
+def flash_attention(q, k, v, causal=False, interpret=False, window=None):
     """q,k,v: [b, s, h, d] -> [b, s, h, d]. Differentiable (custom VJP).
+
+    window=W (requires causal=True) restricts attention to the last W
+    tokens (Mistral-style sliding window): block pairs entirely outside
+    the band are skipped — compute AND cache reads scale O(s * W) instead
+    of O(s^2) for long sequences.
 
     The resolved FLAGS_flash_attention_block value joins the jit cache key
     (static `_blk`), so in-process set_flags sweeps retrace rather than
@@ -352,15 +392,29 @@ def flash_attention(q, k, v, causal=False, interpret=False):
     rebuild the trainer (or use a fresh process) when sweeping under one."""
     from ..flags import get_flag
 
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if isinstance(window, bool):
+            raise ValueError(f"window must be a positive int, got {window!r}")
+        try:
+            window = int(operator.index(window))  # accepts numpy ints
+        except TypeError:
+            raise ValueError(
+                f"window must be a positive int, got {window!r}") from None
+        if window < 1:
+            raise ValueError(f"window must be a positive int, got {window!r}")
     return _flash_attention_jit(q, k, v, causal=causal, interpret=interpret,
+                                window=window,
                                 _blk=get_flag("flash_attention_block", 0))
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "interpret", "_blk"))
-def _flash_attention_jit(q, k, v, causal, interpret, _blk):
+@functools.partial(jax.jit, static_argnames=("causal", "interpret", "_blk",
+                                             "window"))
+def _flash_attention_jit(q, k, v, causal, interpret, _blk, window=None):
     b, s, h, d = q.shape
     qh = jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
     kh = jnp.swapaxes(k, 1, 2).reshape(b * h, s, d)
     vh = jnp.swapaxes(v, 1, 2).reshape(b * h, s, d)
-    out = _flash(qh, kh, vh, causal, interpret)
+    out = _flash(qh, kh, vh, causal, interpret, window)
     return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)

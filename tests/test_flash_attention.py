@@ -128,3 +128,74 @@ def test_block_flag_forces_block_size():
     finally:
         flags.set_flags({"flash_attention_block": 0})
     assert _block_for(1024) == 512
+
+
+class TestSlidingWindow:
+    """window=W (Mistral-style): out-of-band block pairs are SKIPPED, so
+    compute scales O(s*W); in-band positions mask exactly."""
+
+    def _ref(self, q, k, v, w):
+        s_ = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(q.shape[-1])
+        n = q.shape[1]
+        qp = jnp.arange(n)[:, None]
+        kp = jnp.arange(n)[None, :]
+        keep = (qp >= kp) & ((qp - kp) < w)
+        s_ = jnp.where(keep[None, None], s_, -1e30)
+        return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s_, -1), v)
+
+    @pytest.mark.parametrize("window", [1, 64, 100, 256, 1000])
+    def test_matches_windowed_reference_multiblock(self, window):
+        """s=512 at the forced 128 block -> a 4x4 block grid: the band
+        skip predicate, the clip index maps, and the masked-block
+        alpha-wipe all execute (a single-block grid tests none of them)."""
+        from paddle_tpu import flags
+
+        q, k, v = _qkv(s=512, seed=5)
+        try:
+            flags.set_flags({"flash_attention_block": 128})
+            out = flash_attention(q, k, v, causal=True, interpret=True,
+                                  window=window)
+        finally:
+            flags.set_flags({"flash_attention_block": 0})
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(self._ref(q, k, v, window)),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_grads_match_windowed_reference_multiblock(self):
+        from paddle_tpu import flags
+
+        q, k, v = _qkv(s=512, seed=6)
+        wt = jnp.asarray(np.random.RandomState(7)
+                         .randn(*q.shape).astype(np.float32))
+
+        def f(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           interpret=True, window=100) * wt)
+
+        def fr(q, k, v):
+            return jnp.sum(self._ref(q, k, v, 100) * wt)
+
+        try:
+            flags.set_flags({"flash_attention_block": 128})
+            g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        finally:
+            flags.set_flags({"flash_attention_block": 0})
+        gr = jax.grad(fr, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-5)
+
+    def test_validation(self):
+        q, k, v = _qkv()
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=False, interpret=True, window=64)
+        with pytest.raises(ValueError, match="positive"):
+            flash_attention(q, k, v, causal=True, interpret=True, window=0)
+        with pytest.raises(ValueError, match="positive"):
+            flash_attention(q, k, v, causal=True, interpret=True,
+                            window=True)
+        out = flash_attention(q, k, v, causal=True, interpret=True,
+                              window=np.int64(64))  # numpy ints accepted
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(self._ref(q, k, v, 64)),
+            atol=2e-5, rtol=2e-5)
