@@ -25,7 +25,7 @@ class GPTConfig:
                  num_experts=0, moe_every=2, moe_k=2, moe_capacity_factor=2.0,
                  moe_aux_weight=0.01, moe_mesh=None,
                  sequence_parallel=False, sp_mesh=None, sp_impl="ring",
-                 gelu_approx=False):
+                 gelu_approx=False, attention_window=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -97,6 +97,28 @@ class GPTConfig:
         self.sequence_parallel = sequence_parallel
         self.sp_mesh = sp_mesh
         self.sp_impl = sp_impl
+        # sliding-window causal attention (Mistral-style): train AND decode
+        # attend only to the last W tokens; flash block-skips out-of-band
+        # pairs, the KV-cache decode masks the same band
+        if attention_window is not None:
+            import operator
+
+            if isinstance(attention_window, bool):
+                raise ValueError(f"attention_window must be a positive int, "
+                                 f"got {attention_window!r}")
+            try:
+                attention_window = int(operator.index(attention_window))
+            except TypeError:
+                raise ValueError(
+                    f"attention_window must be a positive int, got "
+                    f"{attention_window!r}") from None
+            if attention_window < 1:
+                raise ValueError(f"attention_window must be a positive int, "
+                                 f"got {attention_window!r}")
+            if sequence_parallel:
+                raise ValueError("attention_window does not compose with "
+                                 "sequence_parallel yet")
+        self.attention_window = attention_window
 
     @staticmethod
     def small():
@@ -119,6 +141,7 @@ class GPTAttention(nn.Layer):
         self.num_heads = cfg.num_heads
         self.head_dim = h // cfg.num_heads
         self.use_flash = getattr(cfg, "use_flash", True)
+        self.window = getattr(cfg, "attention_window", None)
         self.sp_mesh = cfg.sp_mesh if getattr(cfg, "sequence_parallel", False) else None
         self.sp_impl = getattr(cfg, "sp_impl", "ring")
         if cfg.tensor_parallel:
@@ -170,6 +193,7 @@ class GPTAttention(nn.Layer):
                 dropout_p=self.dropout if self.training else 0.0,
                 training=self.training,
                 use_flash=self.use_flash,
+                window=self.window,
             )
         return self.proj(out.reshape([b, s, h]))
 
@@ -387,6 +411,7 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
     hd = cfg.hidden_size // Hh
     scale = 1.0 / math.sqrt(hd)
     int8_cache = cache_dtype == "int8"
+    win = getattr(cfg, "attention_window", None)
     H_loc = Hh // tp_size  # local heads (== Hh when not tensor-parallel)
 
     def cache_init(b_, T_, dt):
@@ -452,6 +477,8 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
         cols = jnp.arange(T)[None, :]
         rows = pos + jnp.arange(t)[:, None]
         mask = (cols <= rows)[None]                    # [1, t, T]
+        if win is not None:  # sliding window: same band as training
+            mask &= ((rows - cols) < win)[None]
         if key_valid is not None:
             self_col = cols[None] == rows[None]        # keep self: no NaN rows
             mask = mask & (key_valid[:, None, :] | self_col)

@@ -663,3 +663,39 @@ def test_speculative_eos_early_stop_matches_dense():
     # perfect draft without eos needs ceil(20/5)=4 rounds; the early eos
     # must cut that down
     assert rounds < 4, rounds
+
+
+def test_attention_window_decode_matches_cache_free():
+    """GPTConfig(attention_window=W): the KV-cache decode masks the same
+    band the training forward uses, so greedy generate equals the
+    cache-free windowed forward — and differs from full attention."""
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=64, dropout=0.0,
+                    attention_window=8)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(2).randint(0, 128, (2, 20)).astype(np.int32))
+    cur = np.asarray(ids._data)
+    for _ in range(10):
+        logits = np.asarray(m(paddle.to_tensor(cur))._data)
+        nxt = logits[:, -1].argmax(-1).astype(np.int32)[:, None]
+        cur = np.concatenate([cur, nxt], axis=1)
+    gen = np.asarray(m.generate(ids, max_new_tokens=10,
+                                temperature=0.0)._data)
+    np.testing.assert_array_equal(gen, cur)
+
+    paddle.seed(0)
+    full = GPTForCausalLM(GPTConfig(vocab_size=128, hidden_size=64,
+                                    num_layers=2, num_heads=4,
+                                    max_seq_len=64, dropout=0.0))
+    full.eval()
+    full.set_state_dict(m.state_dict())
+    gen_full = np.asarray(full.generate(ids, max_new_tokens=10,
+                                        temperature=0.0)._data)
+    assert not (gen_full == gen).all()  # the window is actually active
+
+    import pytest
+    with pytest.raises(ValueError, match="attention_window"):
+        GPTConfig(attention_window=0)
