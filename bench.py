@@ -37,10 +37,13 @@ BASELINE_TOKENS_PER_SEC = 10_000.0
 
 
 def _model_flops_per_token(cfg):
-    """Approximate training FLOPs/token (fwd+bwd ~= 6*N params + attention)."""
+    """Approximate training FLOPs/token (fwd+bwd ~= 6*N params + attention).
+    Sliding-window attention only computes an O(s*W) band — charge that,
+    not O(s^2), or windowed MFU overstates by the skipped blocks."""
     h, L, s, v = cfg.hidden_size, cfg.num_layers, cfg.max_seq_len, cfg.vocab_size
     n_params = v * h + L * (12 * h * h) + h * v  # emb + blocks + head (tied-ish)
-    attn = L * 12 * s * h  # 2 matmuls of [s,h]x[h,s] per layer, fwd+bwd
+    eff = min(getattr(cfg, "attention_window", None) or s, s)
+    attn = L * 12 * eff * h  # 2 matmuls of [s,eff]x[eff,s-ish] per layer
     return 6 * n_params + attn
 
 
@@ -70,10 +73,12 @@ def _gpt2m_cfg(on_tpu, seq):
                      num_heads=16, max_seq_len=seq, dropout=0.0)
 
 
-def _gpt2s_setup(batch, seq, cfg_fn=None):
+def _gpt2s_setup(batch, seq, cfg_fn=None, window=None):
     """Model+trainer+data for the headline GPT-2s train config — shared with
     tools/profile_gpt.py so the profiled program IS the benchmarked one.
-    cfg_fn overrides the model config family (e.g. _gpt2m_cfg)."""
+    cfg_fn overrides the model config family (e.g. _gpt2m_cfg); window sets
+    sliding-window attention (the flash kernels then skip out-of-band
+    blocks: O(s*W) attention instead of O(s^2))."""
     import jax
 
     import paddle_tpu as paddle
@@ -83,6 +88,16 @@ def _gpt2s_setup(batch, seq, cfg_fn=None):
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     cfg = (cfg_fn or _gpt2s_cfg)(on_tpu, seq)
+    if window is not None:
+        # rebuild THROUGH the constructor so its validation fires (a bad
+        # window must fail loudly, not print a garbage throughput line)
+        from paddle_tpu.models import GPTConfig
+
+        cfg = GPTConfig(vocab_size=cfg.vocab_size,
+                        hidden_size=cfg.hidden_size,
+                        num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+                        max_seq_len=cfg.max_seq_len, dropout=0.0,
+                        attention_window=window)
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
@@ -98,10 +113,11 @@ def _gpt2s_setup(batch, seq, cfg_fn=None):
     return on_tpu, cfg, trainer, ids, labels
 
 
-def run_config(batch, seq, steps, quiet=False, cfg_fn=None):
+def run_config(batch, seq, steps, quiet=False, cfg_fn=None, window=None):
     import paddle_tpu as paddle
 
-    on_tpu, cfg, trainer, ids, labels = _gpt2s_setup(batch, seq, cfg_fn)
+    on_tpu, cfg, trainer, ids, labels = _gpt2s_setup(batch, seq, cfg_fn,
+                                                     window=window)
     if not on_tpu:  # keep the CPU fallback tractable
         steps = min(steps, 3)
 
@@ -434,6 +450,9 @@ def main():
                              "gpt2s_16k"])
     ap.add_argument("--no-extra", action="store_true",
                     help="skip the appended quick ResNet-50 measurement")
+    ap.add_argument("--window", type=int, default=None,
+                    help="sliding-window attention width for gpt2s/gpt2s_16k "
+                         "(flash kernels skip out-of-band blocks)")
     args = ap.parse_args()
 
     # arm BEFORE backend init: a wedged tunnel hangs inside jax.devices()
@@ -492,11 +511,13 @@ def main():
             if watchdog is not None:
                 watchdog.cancel()
                 watchdog = _arm_watchdog(2500)  # long-seq compile headroom
-            v, mfu = run_config(b, s, args.steps, quiet=True)
+            v, mfu = run_config(b, s, args.steps, quiet=True,
+                                window=args.window)
             if watchdog is not None:
                 watchdog.cancel()
             print(json.dumps({
-                "metric": "gpt2s_16k_train_tokens_per_sec_per_chip",
+                "metric": "gpt2s_16k_train_tokens_per_sec_per_chip"
+                          + (f"_w{args.window}" if args.window else ""),
                 "value": round(v, 1), "unit": "tokens/s",
                 "vs_baseline": round(v / BASELINE_TOKENS_PER_SEC, 3),
                 "mfu": round(mfu, 4), "config": args.config}))
@@ -576,7 +597,7 @@ def main():
         probes = {}
         for b in (16, 24):
             try:
-                probes[b], _ = run_config(b, seq, 6)
+                probes[b], _ = run_config(b, seq, 6, window=args.window)
             except Exception as e:
                 print(f"  probe batch={b} failed ({e})", file=sys.stderr)
         if probes:
@@ -613,11 +634,13 @@ def main():
         }))
         return
 
-    tps, mfu = run_config(batch, seq, args.steps, quiet=True)
+    tps, mfu = run_config(batch, seq, args.steps, quiet=True,
+                          window=args.window)
     if watchdog is not None:
         watchdog.cancel()
     line = {
-        "metric": "gpt2s_train_tokens_per_sec_per_chip",
+        "metric": "gpt2s_train_tokens_per_sec_per_chip"
+                  + (f"_w{args.window}" if args.window else ""),
         "value": round(tps, 1),
         "unit": "tokens/s",
         "vs_baseline": round(tps / BASELINE_TOKENS_PER_SEC, 3),
