@@ -96,7 +96,11 @@ def _gpt2s_setup(batch, seq, cfg_fn=None, window=None):
         cfg = GPTConfig(vocab_size=cfg.vocab_size,
                         hidden_size=cfg.hidden_size,
                         num_layers=cfg.num_layers, num_heads=cfg.num_heads,
-                        max_seq_len=cfg.max_seq_len, dropout=0.0,
+                        max_seq_len=cfg.max_seq_len, dropout=cfg.dropout,
+                        intermediate_size=cfg.intermediate_size,
+                        use_flash=cfg.use_flash,
+                        gelu_approx=cfg.gelu_approx,
+                        num_kv_heads=getattr(cfg, "num_kv_heads", None),
                         attention_window=window)
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
@@ -611,7 +615,7 @@ def main():
         for b, s in ((8, 1024), (16, 1024), (24, 1024), (16, 2048),
                      (8, 2048), (4, 4096), (8, 4096)):
             try:
-                tps, mfu = run_config(b, s, args.steps)
+                tps, mfu = run_config(b, s, args.steps, window=args.window)
             except Exception as e:
                 print(f"  batch={b} seq={s}: failed ({e})", file=sys.stderr)
                 continue
@@ -627,7 +631,8 @@ def main():
             print(json.dumps({"error": "every sweep config failed"}))
             sys.exit(1)
         print(json.dumps({
-            "metric": "gpt2s_train_tokens_per_sec_per_chip",
+            "metric": "gpt2s_train_tokens_per_sec_per_chip"
+                      + (f"_w{args.window}" if args.window else ""),
             "value": round(tps, 1), "unit": "tokens/s",
             "vs_baseline": round(tps / BASELINE_TOKENS_PER_SEC, 3),
             "mfu": round(mfu, 4), "config": cfg,

@@ -25,7 +25,8 @@ class GPTConfig:
                  num_experts=0, moe_every=2, moe_k=2, moe_capacity_factor=2.0,
                  moe_aux_weight=0.01, moe_mesh=None,
                  sequence_parallel=False, sp_mesh=None, sp_impl="ring",
-                 gelu_approx=False, attention_window=None):
+                 gelu_approx=False, attention_window=None,
+                 num_kv_heads=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -119,6 +120,20 @@ class GPTConfig:
                 raise ValueError("attention_window does not compose with "
                                  "sequence_parallel yet")
         self.attention_window = attention_window
+        # grouped-query attention (GQA): num_kv_heads < num_heads shares
+        # each K/V head across a group of query heads — the KV cache (the
+        # serving memory bound) shrinks by num_heads/num_kv_heads. Default
+        # = num_heads (plain MHA, the packed qkv layout unchanged).
+        num_kv_heads = num_kv_heads if num_kv_heads is not None else num_heads
+        if isinstance(num_kv_heads, bool) or not (
+                1 <= num_kv_heads <= num_heads) or                 num_heads % num_kv_heads != 0:
+            raise ValueError(
+                f"num_kv_heads ({num_kv_heads!r}) must divide num_heads "
+                f"({num_heads}) and lie in [1, num_heads]")
+        if num_kv_heads != num_heads and tensor_parallel:
+            raise ValueError("GQA with tensor_parallel layers is not "
+                             "supported yet (KV-head sharding)")
+        self.num_kv_heads = num_kv_heads
 
     @staticmethod
     def small():
@@ -140,6 +155,11 @@ class GPTAttention(nn.Layer):
         h = cfg.hidden_size
         self.num_heads = cfg.num_heads
         self.head_dim = h // cfg.num_heads
+        # GQA: K/V projections carry num_kv_heads heads; for plain MHA
+        # (kv == heads) the packed layout is EXACTLY the historical
+        # [h, 3h] — existing checkpoints load unchanged
+        self.num_kv_heads = getattr(cfg, "num_kv_heads", cfg.num_heads)
+        qkv_out = (self.num_heads + 2 * self.num_kv_heads) * self.head_dim
         self.use_flash = getattr(cfg, "use_flash", True)
         self.window = getattr(cfg, "attention_window", None)
         self.sp_mesh = cfg.sp_mesh if getattr(cfg, "sequence_parallel", False) else None
@@ -147,22 +167,33 @@ class GPTAttention(nn.Layer):
         if cfg.tensor_parallel:
             from ..distributed.split import ColumnParallelLinear, RowParallelLinear
 
-            self.qkv = ColumnParallelLinear(h, 3 * h)
+            self.qkv = ColumnParallelLinear(h, qkv_out)
             self.proj = RowParallelLinear(h, h)
         else:
-            self.qkv = nn.Linear(h, 3 * h)
+            self.qkv = nn.Linear(h, qkv_out)
             self.proj = nn.Linear(h, h)
         self.dropout = cfg.dropout
 
     def forward(self, x):
         b, s, h = x.shape
-        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        H, K, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        qkv = self.qkv(x)
         from ..tensor.manipulation import split as tsplit
 
-        q, k, v = tsplit(qkv, 3, axis=2)
-        q = q.reshape([b, s, self.num_heads, self.head_dim])
-        k = k.reshape([b, s, self.num_heads, self.head_dim])
-        v = v.reshape([b, s, self.num_heads, self.head_dim])
+        # boundary split [q | k | v]: identical to the historical
+        # (3, H, hd) unpacking when K == H
+        q, k, v = tsplit(qkv, [H * hd, K * hd, K * hd], axis=-1)
+        q = q.reshape([b, s, H, hd])
+        k = k.reshape([b, s, K, hd])
+        v = v.reshape([b, s, K, hd])
+        if K != H:
+            # expand shared K/V heads across their query groups for the
+            # dense/flash attention math (the cache-side decode keeps the
+            # compact K heads — that is where GQA's memory win lives)
+            from ..tensor.manipulation import repeat_interleave
+
+            k = repeat_interleave(k, H // K, axis=2)
+            v = repeat_interleave(v, H // K, axis=2)
         if self.sp_mesh is not None and "sp" in self.sp_mesh.axis_names:
             from ..core.dispatch import apply
             from ..distributed.long_context import sequence_parallel_attention
@@ -412,15 +443,19 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
     scale = 1.0 / math.sqrt(hd)
     int8_cache = cache_dtype == "int8"
     win = getattr(cfg, "attention_window", None)
-    H_loc = Hh // tp_size  # local heads (== Hh when not tensor-parallel)
+    KVh = getattr(cfg, "num_kv_heads", Hh)  # GQA: compact K/V heads
+    g = Hh // KVh                           # query heads per kv head
+    H_loc = Hh // tp_size   # local q heads (== Hh when not tensor-parallel)
+    KV_loc = KVh // tp_size  # (GQA+tp rejected at config: KVh==Hh under tp)
 
     def cache_init(b_, T_, dt):
-        shape = (L, b_, H_loc, T_, hd)
+        # the cache holds only the COMPACT kv heads — the GQA serving win
+        shape = (L, b_, KV_loc, T_, hd)
         if not int8_cache:
             z = jnp.zeros(shape, dt)
             return z, jnp.zeros_like(z)
         vals = jnp.zeros(shape, jnp.int8)
-        scales = jnp.zeros((L, b_, H_loc, T_, 1), jnp.float32)
+        scales = jnp.zeros((L, b_, KV_loc, T_, 1), jnp.float32)
         return (vals, scales), (jnp.zeros_like(vals),
                                 jnp.zeros_like(scales))
 
@@ -464,12 +499,21 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
             qkv = jnp.einsum("bti,iknd->btknd",
                              h_in, p[pre + "attn.qkv.weight"]) \
                 + p[pre + "attn.qkv.bias"]
+            q = jnp.moveaxis(qkv[:, :, 0], 1, 2)      # [B, H_loc, t, hd]
+            k = jnp.moveaxis(qkv[:, :, 1], 1, 2)
+            v = jnp.moveaxis(qkv[:, :, 2], 1, 2)
         else:
-            qkv = (h_in @ p[pre + "attn.qkv.weight"]
-                   + p[pre + "attn.qkv.bias"]).reshape(bb, t, 3, H_loc, hd)
-        q = jnp.moveaxis(qkv[:, :, 0], 1, 2)          # [B, H_loc, t, hd]
-        k = jnp.moveaxis(qkv[:, :, 1], 1, 2)
-        v = jnp.moveaxis(qkv[:, :, 2], 1, 2)
+            # boundary split [q | k | v] — identical to the historical
+            # (3, H, hd) unpacking for MHA, compact kv heads for GQA
+            flat = h_in @ p[pre + "attn.qkv.weight"] \
+                + p[pre + "attn.qkv.bias"]
+            q = jnp.moveaxis(
+                flat[..., :Hh * hd].reshape(bb, t, Hh, hd), 1, 2)
+            k = jnp.moveaxis(
+                flat[..., Hh * hd:(Hh + KVh) * hd].reshape(bb, t, KVh, hd),
+                1, 2)
+            v = jnp.moveaxis(
+                flat[..., (Hh + KVh) * hd:].reshape(bb, t, KVh, hd), 1, 2)
         kc = _store(kc, k, i, pos)
         vc = _store(vc, v, i, pos)
         # causal over cache columns: query row r (column pos+r) sees
@@ -482,10 +526,23 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
         if key_valid is not None:
             self_col = cols[None] == rows[None]        # keep self: no NaN rows
             mask = mask & (key_valid[:, None, :] | self_col)
-        att = jnp.einsum("bhtd,bhTd->bhtT", q, _load(kc, i, q.dtype)) * scale
-        att = jnp.where(mask[:, None], att, -jnp.inf)
-        att = jax.nn.softmax(att, axis=-1)
-        out = jnp.einsum("bhtT,bhTd->bhtd", att, _load(vc, i, att.dtype))
+        if g == 1:
+            att = jnp.einsum("bhtd,bhTd->bhtT", q,
+                             _load(kc, i, q.dtype)) * scale
+            att = jnp.where(mask[:, None], att, -jnp.inf)
+            att = jax.nn.softmax(att, axis=-1)
+            out = jnp.einsum("bhtT,bhTd->bhtd", att,
+                             _load(vc, i, att.dtype))
+        else:
+            # grouped queries share their kv head: [B, KVh, g, t, *]
+            qg = q.reshape(bb, KVh, g, t, hd)
+            att = jnp.einsum("bkgtd,bkTd->bkgtT", qg,
+                             _load(kc, i, q.dtype)) * scale
+            att = jnp.where(mask[:, None, None], att, -jnp.inf)
+            att = jax.nn.softmax(att, axis=-1)
+            out = jnp.einsum("bkgtT,bkTd->bkgtd", att,
+                             _load(vc, i, att.dtype)).reshape(
+                                 bb, Hh, t, hd)
         out = jnp.moveaxis(out, 1, 2).reshape(bb, t, H_loc * hd)
         proj = out @ p[pre + "attn.proj.weight"]  # row-parallel under tp
         if tp_axis is not None:
@@ -620,6 +677,9 @@ def _tp_setup(tp_mesh, cfg, params):
     reshapes+specs the params. Returns (tp_axis, tp_size, params, specs)."""
     if "mp" not in tp_mesh.axis_names:
         raise ValueError("tp_mesh needs an 'mp' axis")
+    if getattr(cfg, "num_kv_heads", cfg.num_heads) != cfg.num_heads:
+        raise ValueError("GQA tensor-parallel serving is not supported yet "
+                         "(KV-head sharding); serve dense or use MHA")
     tp_size = tp_mesh.shape["mp"]
     Hh, inter = cfg.num_heads, cfg.intermediate_size
     if Hh % tp_size != 0 or inter % tp_size != 0:
@@ -909,6 +969,7 @@ def _gpt_speculative(model, draft_model, input_ids, max_new_tokens, k=4,
                  # value-based draft identity (id() could alias a GC'd
                  # model of a different architecture)
                  d_cfg.num_layers, d_cfg.hidden_size, d_cfg.num_heads,
+                 getattr(d_cfg, "num_kv_heads", d_cfg.num_heads),
                  d_cfg.vocab_size, d_cfg.max_seq_len, eos_token_id,
                  ("tp", tp_mesh) if tp_mesh is not None else None)
     store = model.__dict__.setdefault("_generate_compiled", {})

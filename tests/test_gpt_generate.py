@@ -699,3 +699,112 @@ def test_attention_window_decode_matches_cache_free():
     import pytest
     with pytest.raises(ValueError, match="attention_window"):
         GPTConfig(attention_window=0)
+
+
+class TestGroupedQueryAttention:
+    """GQA (num_kv_heads < num_heads): compact K/V heads shared per query
+    group — the KV cache shrinks by heads/kv_heads while the math equals an
+    MHA model whose kv weights are replicated per group."""
+
+    def _gqa(self):
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=64, dropout=0.0,
+                        num_kv_heads=2)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        return cfg, m
+
+    def test_equals_mha_with_replicated_kv(self):
+        """Replicating each kv head across its group inside an MHA model
+        must reproduce the GQA forward exactly."""
+        cfg, m = self._gqa()
+        H, K = 4, 2
+        hd = cfg.hidden_size // H
+        paddle.seed(1)
+        mha = GPTForCausalLM(GPTConfig(vocab_size=128, hidden_size=64,
+                                       num_layers=2, num_heads=4,
+                                       max_seq_len=64, dropout=0.0))
+        mha.eval()
+        sd = m.state_dict()
+        out_sd = {}
+        for n, v in mha.state_dict().items():
+            src = np.asarray(sd[n].numpy()) if n in sd else None
+            if n.endswith("attn.qkv.weight"):
+                gq = np.asarray(sd[n].numpy())  # [h, (H+2K)*hd]
+                q_w = gq[:, :H * hd]
+                k_w = gq[:, H * hd:(H + K) * hd].reshape(-1, K, hd)
+                v_w = gq[:, (H + K) * hd:].reshape(-1, K, hd)
+                rep = lambda w: np.repeat(w, H // K, axis=1).reshape(
+                    -1, H * hd)
+                out_sd[n] = np.concatenate([q_w, rep(k_w), rep(v_w)], axis=1)
+            elif n.endswith("attn.qkv.bias"):
+                gb = np.asarray(sd[n].numpy())
+                q_b = gb[:H * hd]
+                k_b = gb[H * hd:(H + K) * hd].reshape(K, hd)
+                v_b = gb[(H + K) * hd:].reshape(K, hd)
+                rep = lambda w: np.repeat(w, H // K, axis=0).reshape(-1)
+                out_sd[n] = np.concatenate([q_b, rep(k_b), rep(v_b)])
+            else:
+                out_sd[n] = src
+        mha.set_state_dict(out_sd)
+        ids = paddle.to_tensor(
+            np.random.RandomState(2).randint(0, 128, (2, 16)).astype(np.int32))
+        np.testing.assert_allclose(np.asarray(mha(ids)._data),
+                                   np.asarray(m(ids)._data),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_decode_matches_cache_free(self):
+        cfg, m = self._gqa()
+        ids = paddle.to_tensor(
+            np.random.RandomState(2).randint(0, 128, (2, 12)).astype(np.int32))
+        cur = np.asarray(ids._data)
+        for _ in range(8):
+            logits = np.asarray(m(paddle.to_tensor(cur))._data)
+            nxt = logits[:, -1].argmax(-1).astype(np.int32)[:, None]
+            cur = np.concatenate([cur, nxt], axis=1)
+        gen = np.asarray(m.generate(ids, max_new_tokens=8,
+                                    temperature=0.0)._data)
+        np.testing.assert_array_equal(gen, cur)
+        # int8 cache composes with the compact kv heads
+        i8 = np.asarray(m.generate(ids, max_new_tokens=8, temperature=0.0,
+                                   cache_dtype="int8")._data)
+        agree = (i8[:, 12:] == gen[:, 12:]).mean()
+        assert agree > 0.5
+
+    def test_cache_holds_compact_kv_heads(self):
+        from paddle_tpu.models.gpt import _decode_fns
+
+        cfg, _ = self._gqa()
+        import jax.numpy as jnp
+
+        _, _, cache_init = _decode_fns(cfg, False, False)
+        (kc), _ = cache_init(1, 32, jnp.float32)
+        assert kc.shape[2] == 2  # kv heads, not the 4 query heads
+
+    def test_trains(self):
+        cfg, m = self._gqa()
+        m.train()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=m.parameters())
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 128, (2, 16)).astype(np.int32))
+        losses = []
+        for _ in range(4):
+            loss = m.loss(ids, ids)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss._data)))
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+    def test_validation(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            GPTConfig(num_heads=4, num_kv_heads=3)
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            GPTConfig(num_heads=4, num_kv_heads=0)
+        with pytest.raises(ValueError, match="GQA"):
+            GPTConfig(num_heads=4, num_kv_heads=2, tensor_parallel=True,
+                      dropout=0.0)
