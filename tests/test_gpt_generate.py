@@ -808,3 +808,30 @@ class TestGroupedQueryAttention:
         with pytest.raises(ValueError, match="GQA"):
             GPTConfig(num_heads=4, num_kv_heads=2, tensor_parallel=True,
                       dropout=0.0)
+
+
+def test_combined_serving_knobs_window_gqa_int8():
+    """The serving knobs compose: sliding-window + GQA + int8 KV cache in
+    one model — decode must still match the cache-free forward exactly
+    (f32) and run finite with the quantized cache."""
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=64, dropout=0.0,
+                    num_kv_heads=2, attention_window=8)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(5).randint(0, 128, (2, 12)).astype(np.int32))
+    cur = np.asarray(ids._data)
+    for _ in range(8):
+        logits = np.asarray(m(paddle.to_tensor(cur))._data)
+        nxt = logits[:, -1].argmax(-1).astype(np.int32)[:, None]
+        cur = np.concatenate([cur, nxt], axis=1)
+    gen = np.asarray(m.generate(ids, max_new_tokens=8,
+                                temperature=0.0)._data)
+    np.testing.assert_array_equal(gen, cur)
+    i8 = np.asarray(m.generate(ids, max_new_tokens=8, temperature=0.0,
+                               cache_dtype="int8")._data)
+    assert i8.shape == gen.shape
+    agree = (i8[:, 12:] == gen[:, 12:]).mean()
+    assert agree > 0.5
