@@ -822,11 +822,7 @@ def test_combined_serving_knobs_window_gqa_int8():
     m.eval()
     ids = paddle.to_tensor(
         np.random.RandomState(5).randint(0, 128, (2, 12)).astype(np.int32))
-    cur = np.asarray(ids._data)
-    for _ in range(8):
-        logits = np.asarray(m(paddle.to_tensor(cur))._data)
-        nxt = logits[:, -1].argmax(-1).astype(np.int32)[:, None]
-        cur = np.concatenate([cur, nxt], axis=1)
+    cur = _reference_greedy(m, np.asarray(ids._data), 8)
     gen = np.asarray(m.generate(ids, max_new_tokens=8,
                                 temperature=0.0)._data)
     np.testing.assert_array_equal(gen, cur)
