@@ -1,0 +1,86 @@
+"""The bench watchdog must never erase a completed measurement.
+
+Round-3 failure mode (NOTES_r3.md): the 900s watchdog killed a run where
+backend init + tracing completed but the first heavy measurement didn't,
+reducing the whole round to an error line. The wedge-proofing contract:
+
+- bench emits a micro metric (2-layer GPT canary) flushed BEFORE any heavy
+  compile starts (bench.run_micro, wired in main() on TPU);
+- if a LATER phase hangs, the watchdog re-emits the last complete metric
+  line as the LAST json line and exits 0 (the driver parses the last line
+  + return code);
+- only a run with no measurement at all exits 3, with an "error" line
+  that has no "metric"/"value" keys so it can never parse as a number.
+"""
+import json
+import subprocess
+import sys
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+
+def _run(code):
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, cwd=REPO, timeout=120)
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    return r.returncode, [json.loads(l) for l in lines]
+
+
+def test_watchdog_reemits_last_good_line_and_exits_zero():
+    rc, lines = _run(
+        "import time\n"
+        "import bench\n"
+        "bench._emit({'metric': 'm', 'value': 1.0, 'unit': 'u',"
+        " 'vs_baseline': 0.1})\n"
+        "bench._arm_watchdog(1)\n"
+        "time.sleep(30)\n")
+    assert rc == 0
+    last = lines[-1]
+    assert last["metric"] == "m" and last["value"] == 1.0
+    assert "watchdog_note" in last
+
+
+def test_watchdog_rescue_of_micro_canary_exits_two():
+    # the toy canary is driver-verifiable evidence of a healthy window, but
+    # a run that only measured the canary must not book as a success (rc 0)
+    rc, lines = _run(
+        "import time\n"
+        "import bench\n"
+        "bench._emit({'metric': 'micro_gpt2_train_tokens_per_sec_per_chip',"
+        " 'value': 5.0, 'unit': 'tokens/s', 'vs_baseline': 0.0,"
+        " 'config': 'micro'})\n"
+        "bench._arm_watchdog(1)\n"
+        "time.sleep(30)\n")
+    assert rc == 2
+    last = lines[-1]
+    assert last["config"] == "micro" and "watchdog_note" in last
+
+
+def test_watchdog_with_no_measurement_exits_three_unparseable():
+    rc, lines = _run(
+        "import time\n"
+        "import bench\n"
+        "bench._arm_watchdog(1)\n"
+        "time.sleep(30)\n")
+    assert rc == 3
+    last = lines[-1]
+    assert "error" in last
+    assert "metric" not in last and "value" not in last
+
+
+def test_emit_tracks_last_good():
+    import bench
+    prev = bench._LAST_GOOD
+    try:
+        bench._emit({"metric": "x", "value": 2.0})
+        assert bench._LAST_GOOD == {"metric": "x", "value": 2.0}
+    finally:
+        bench._LAST_GOOD = prev
+
+
+def test_micro_canary_runs_on_cpu():
+    # the canary itself must be cheap and correct everywhere: a wedge-proof
+    # canary that crashes is worse than none
+    import bench
+    sps, mfu = bench.run_micro(quiet=True)
+    assert sps > 0

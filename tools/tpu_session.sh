@@ -5,11 +5,25 @@
 set -u
 cd "$(dirname "$0")/.."
 
-echo "[tpu_session] bench (gpt2s + resnet50 extra)..." >&2
-timeout 3500 python bench.py > /tmp/tpu_bench.json 2>/tmp/tpu_bench.log
+echo "[tpu_session] bench (gpt2s + canary + resnet50/decode extras)..." >&2
+# budget = worst-case sum of bench.py's internal watchdog windows
+# (900 init+canary, 1200 probes, 900 headline, 1200 resnet, 1200 decode)
+# + slack: the OUTER timeout must never fire while an inner window is
+# still open, or a slow-but-healthy run is killed with rc=124 after its
+# headline already landed
+timeout 6000 python bench.py > /tmp/tpu_bench.json 2>/tmp/tpu_bench.log
 echo "[tpu_session] bench exit=$? $(cat /tmp/tpu_bench.json 2>/dev/null)" >&2
 
-if grep -q '"metric"' /tmp/tpu_bench.json 2>/dev/null; then
+# gate on the HEADLINE metric, not any '"metric"' — the wedge-canary line
+# alone must not green-light five staged heavy compiles against a tunnel
+# that wedged during the gpt2s compile. (The default run's decode extra
+# intentionally duplicates the staged bf16 decode half below: the extra is
+# the wedge-proof capture for the driver's standalone `python bench.py`,
+# which records only that one process's lines.)
+# ... and bail on any watchdog rescue ("watchdog_note"): a rescued run means
+# the tunnel wedged mid-session — don't burn hours of staged compiles on it
+if grep -q '"gpt2s_train_tokens_per_sec_per_chip"' /tmp/tpu_bench.json 2>/dev/null \
+    && ! grep -q '"watchdog_note"' /tmp/tpu_bench.json 2>/dev/null; then
   echo "[tpu_session] pipeline memory on chip..." >&2
   timeout 1800 python tools/pipeline_memory.py \
     > /tmp/tpu_pipeline_memory.json 2>/tmp/tpu_pipeline_memory.log
