@@ -1,0 +1,599 @@
+"""jaxpr → ONNX graph converter.
+
+TPU-native design: instead of an op-by-op layer converter (paddle2onnx's
+approach over the reference's Program protobuf), the layer is traced ONCE to
+a jaxpr — the same functional trace jit/export use — and each jax primitive
+is lowered to standard ONNX ops (opset 13). Composite layers therefore export
+as their mathematical decomposition (LayerNorm → ReduceMean/Sub/Div chain,
+softmax → max/exp/sum/div), which any ONNX runtime executes without custom
+domains. Reference parity target: python/paddle/onnx/export.py:21.
+
+Supported primitive set covers the traced graphs of LeNet, ResNet, and the
+GPT block family (Conv/MatMul/Relu-as-Max/Gelu-as-Erf/softmax chain/
+LayerNorm chain/MaxPool/Reshape/Transpose/Add/Gather...). Unsupported
+primitives raise UnsupportedOpError naming the primitive.
+"""
+import numpy as np
+
+from . import proto
+
+
+class UnsupportedOpError(RuntimeError):
+    pass
+
+
+def _np_dtype(aval):
+    return str(np.dtype(aval.dtype))
+
+
+class _Graph:
+    """Accumulates ONNX nodes/initializers with SSA naming."""
+
+    def __init__(self):
+        self.nodes = []
+        self.initializers = []
+        self._init_names = set()
+        self.var_names = {}     # jax Var -> onnx value name
+        self.produced = set()   # names produced by a node (not init/input)
+        self._value_cache = {}  # (dtype, shape, bytes) -> initializer name
+        self.counter = 0
+
+    def fresh(self, hint="t"):
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def name_of(self, atom):
+        """ONNX value name for a jaxpr atom (Var or Literal)."""
+        from jax._src.core import Literal
+
+        if isinstance(atom, Literal):
+            return self.const(np.asarray(atom.val))
+        if atom not in self.var_names:
+            self.var_names[atom] = self.fresh("v")
+        return self.var_names[atom]
+
+    def const(self, array, name=None):
+        arr = np.asarray(array)
+        if name is None:
+            # dedup small constants by value: jaxpr Literals repeat the
+            # same scalars (1.0, 0.5, sqrt(2)...) once per layer
+            if arr.size <= 64:
+                key = (str(arr.dtype), arr.shape, arr.tobytes())
+                cached = self._value_cache.get(key)
+                if cached is not None:
+                    return cached
+                name = self.fresh("const")
+                self._value_cache[key] = name
+            else:
+                name = self.fresh("const")
+        if name not in self._init_names:
+            self._init_names.add(name)
+            self.initializers.append(proto.tensor_proto(name, arr))
+        return name
+
+    def shape_const(self, dims):
+        return self.const(np.asarray(dims, np.int64))
+
+    def add(self, op_type, inputs, n_out=1, attrs=None, out_names=None):
+        outs = out_names or [self.fresh(op_type.lower())
+                             for _ in range(n_out)]
+        self.nodes.append(proto.node_proto(
+            op_type, inputs, outs, name=self.fresh(f"n_{op_type}"),
+            attrs=attrs))
+        self.produced.update(outs)
+        return outs if n_out != 1 or out_names else outs[0]
+
+
+_ELEMENTWISE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div", "max": "Max",
+    "min": "Min", "pow": "Pow", "neg": "Neg", "exp": "Exp", "log": "Log",
+    "sqrt": "Sqrt", "tanh": "Tanh", "logistic": "Sigmoid", "abs": "Abs",
+    "sign": "Sign", "floor": "Floor", "ceil": "Ceil", "erf": "Erf",
+    "sin": "Sin", "cos": "Cos", "not": "Not", "and": "And", "or": "Or",
+}
+_COMPARE = {"lt": "Less", "le": "LessOrEqual", "gt": "Greater",
+            "ge": "GreaterOrEqual", "eq": "Equal"}
+_REDUCE_ATTR_AXES = {"reduce_max": "ReduceMax", "reduce_min": "ReduceMin",
+                     "reduce_prod": "ReduceProd"}
+
+
+class Converter:
+    def __init__(self):
+        self.g = _Graph()
+
+    # -- entry ---------------------------------------------------------------
+    def convert_jaxpr(self, closed_jaxpr, input_names):
+        """closed_jaxpr: jax ClosedJaxpr whose first invars are weights
+        (callers pass them via env pre-binding), remaining are graph inputs.
+        input_names: names for the GRAPH inputs (last len(input_names)
+        invars). Weights invars must already be bound in self.g.var_names
+        (as initializers)."""
+        jaxpr = closed_jaxpr.jaxpr
+        for var, cval in zip(jaxpr.constvars, closed_jaxpr.consts):
+            self.g.var_names[var] = self.g.const(np.asarray(cval))
+        n_in = len(input_names)
+        graph_inputs = jaxpr.invars[len(jaxpr.invars) - n_in:]
+        for var, nm in zip(graph_inputs, input_names):
+            self.g.var_names[var] = nm
+        self._eqns(jaxpr.eqns)
+        out_names = []
+        for ov in jaxpr.outvars:
+            nm = self.g.name_of(ov)
+            out_names.append(nm)
+        return graph_inputs, jaxpr.outvars, out_names
+
+    def _eqns(self, eqns):
+        for eqn in eqns:
+            self._eqn(eqn)
+
+    # -- dispatch ------------------------------------------------------------
+    def _eqn(self, eqn):
+        p = eqn.primitive.name
+        handler = getattr(self, f"_op_{p}", None)
+        if handler is not None:
+            return handler(eqn)
+        if p in _ELEMENTWISE:
+            ins = [self.g.name_of(v) for v in eqn.invars]
+            self.g.add(_ELEMENTWISE[p], ins,
+                       out_names=[self.g.name_of(eqn.outvars[0])])
+            return
+        if p in _COMPARE:
+            ins = [self.g.name_of(v) for v in eqn.invars]
+            self.g.add(_COMPARE[p], ins,
+                       out_names=[self.g.name_of(eqn.outvars[0])])
+            return
+        if p in _REDUCE_ATTR_AXES:
+            (x,) = eqn.invars
+            axes = [int(a) for a in eqn.params["axes"]]
+            self.g.add(_REDUCE_ATTR_AXES[p], [self.g.name_of(x)],
+                       attrs={"axes": axes, "keepdims": 0},
+                       out_names=[self.g.name_of(eqn.outvars[0])])
+            return
+        raise UnsupportedOpError(
+            f"paddle_tpu.onnx: no ONNX lowering for jax primitive '{p}' "
+            f"(eqn: {eqn})")
+
+    # -- call-like primitives: inline ---------------------------------------
+    def _inline(self, eqn, inner_jaxpr, consts):
+        """Inline a sub-jaxpr with PROPER SCOPING: jax caches and SHARES
+        jaxpr objects (two relu eqns carry the identical call_jaxpr with
+        the same Var objects), so the inner vars' name bindings must be
+        saved/cleared per inline and restored after — otherwise the second
+        inline of a shared jaxpr silently reuses the first one's SSA names
+        and two nodes write the same output."""
+        from jax._src.core import Literal
+
+        owned = list(inner_jaxpr.invars) + list(inner_jaxpr.constvars)
+        for e in inner_jaxpr.eqns:
+            owned.extend(e.outvars)   # nested sub-jaxprs scope themselves
+        saved = {v: self.g.var_names[v] for v in owned
+                 if v in self.g.var_names}
+        for v in owned:
+            self.g.var_names.pop(v, None)
+
+        for var, cval in zip(inner_jaxpr.constvars, consts):
+            self.g.var_names[var] = self.g.const(np.asarray(cval))
+        for inner_v, outer_atom in zip(inner_jaxpr.invars, eqn.invars):
+            self.g.var_names[inner_v] = self.g.name_of(outer_atom)
+        self._eqns(inner_jaxpr.eqns)
+        out_names = []
+        for inner_v in inner_jaxpr.outvars:
+            if isinstance(inner_v, Literal):
+                out_names.append(self.g.const(np.asarray(inner_v.val)))
+            else:
+                out_names.append(self.g.name_of(inner_v))
+
+        for v in owned:
+            self.g.var_names.pop(v, None)
+        self.g.var_names.update(saved)
+        for outer_v, nm in zip(eqn.outvars, out_names):
+            self.g.var_names[outer_v] = nm
+
+    def _op_pjit(self, eqn):
+        closed = eqn.params["jaxpr"]
+        self._inline(eqn, closed.jaxpr, closed.consts)
+
+    _op_jit = _op_pjit
+    _op_closed_call = _op_pjit
+
+    def _op_custom_jvp_call(self, eqn):
+        closed = eqn.params["call_jaxpr"]
+        self._inline(eqn, closed.jaxpr, closed.consts)
+
+    def _op_custom_vjp_call(self, eqn):
+        closed = eqn.params["call_jaxpr"]
+        self._inline(eqn, closed.jaxpr, closed.consts)
+
+    def _op_remat2(self, eqn):
+        self._inline(eqn, eqn.params["jaxpr"], ())
+
+    _op_checkpoint = _op_remat2
+
+    # -- structural ----------------------------------------------------------
+    def _op_copy(self, eqn):
+        self.g.add("Identity", [self.g.name_of(eqn.invars[0])],
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    _op_stop_gradient = _op_copy
+    _op_copy_p = _op_copy
+
+    def _op_convert_element_type(self, eqn):
+        to = proto.NP_TO_ONNX[str(np.dtype(eqn.params["new_dtype"]))]
+        self.g.add("Cast", [self.g.name_of(eqn.invars[0])],
+                   attrs={"to": to},
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_reshape(self, eqn):
+        if eqn.params.get("dimensions") is not None:
+            raise UnsupportedOpError("reshape with dimension permutation")
+        shape = self.g.shape_const(eqn.params["new_sizes"])
+        self.g.add("Reshape", [self.g.name_of(eqn.invars[0]), shape],
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_transpose(self, eqn):
+        perm = [int(d) for d in eqn.params["permutation"]]
+        self.g.add("Transpose", [self.g.name_of(eqn.invars[0])],
+                   attrs={"perm": perm},
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_broadcast_in_dim(self, eqn):
+        (x,) = eqn.invars
+        out_shape = [int(d) for d in eqn.params["shape"]]
+        bdims = [int(d) for d in eqn.params["broadcast_dimensions"]]
+        in_shape = list(x.aval.shape)
+        # place each input dim at its broadcast position, 1 elsewhere
+        mid = [1] * len(out_shape)
+        for src, dst in enumerate(bdims):
+            mid[dst] = in_shape[src]
+        nm = self.g.name_of(x)
+        if mid != in_shape or len(mid) != len(in_shape):
+            nm = self.g.add("Reshape", [nm, self.g.shape_const(mid)])
+        self.g.add("Expand", [nm, self.g.shape_const(out_shape)],
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_concatenate(self, eqn):
+        ins = [self.g.name_of(v) for v in eqn.invars]
+        self.g.add("Concat", ins,
+                   attrs={"axis": int(eqn.params["dimension"])},
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_split(self, eqn):
+        sizes = [int(s) for s in eqn.params["sizes"]]
+        split = self.g.const(np.asarray(sizes, np.int64))
+        self.g.add("Split", [self.g.name_of(eqn.invars[0]), split],
+                   n_out=len(eqn.outvars),
+                   attrs={"axis": int(eqn.params["axis"])},
+                   out_names=[self.g.name_of(v) for v in eqn.outvars])
+
+    def _op_squeeze(self, eqn):
+        out_shape = list(eqn.outvars[0].aval.shape)
+        self.g.add("Reshape", [self.g.name_of(eqn.invars[0]),
+                               self.g.shape_const(out_shape)],
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    _op_expand_dims = _op_squeeze
+
+    def _op_slice(self, eqn):
+        starts = [int(s) for s in eqn.params["start_indices"]]
+        ends = [int(s) for s in eqn.params["limit_indices"]]
+        strides = eqn.params.get("strides")
+        steps = ([int(s) for s in strides] if strides is not None
+                 else [1] * len(starts))
+        axes = list(range(len(starts)))
+        ins = [self.g.name_of(eqn.invars[0]),
+               self.g.const(np.asarray(starts, np.int64)),
+               self.g.const(np.asarray(ends, np.int64)),
+               self.g.const(np.asarray(axes, np.int64)),
+               self.g.const(np.asarray(steps, np.int64))]
+        self.g.add("Slice", ins,
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_rev(self, eqn):
+        # reverse along dims == Slice with step -1 on those axes
+        dims = [int(d) for d in eqn.params["dimensions"]]
+        n = len(dims)
+        ins = [self.g.name_of(eqn.invars[0]),
+               self.g.const(np.asarray([-1] * n, np.int64)),
+               self.g.const(np.asarray([np.iinfo(np.int64).min] * n,
+                                       np.int64)),
+               self.g.const(np.asarray(dims, np.int64)),
+               self.g.const(np.asarray([-1] * n, np.int64))]
+        self.g.add("Slice", ins,
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_pad(self, eqn):
+        x, pad_val = eqn.invars
+        cfg = eqn.params["padding_config"]
+        if any(int(i) != 0 for _, _, i in cfg):
+            raise UnsupportedOpError("pad with interior (dilation) padding")
+        if any(int(lo) < 0 or int(hi) < 0 for lo, hi, _ in cfg):
+            raise UnsupportedOpError("negative (cropping) pad")
+        pads = ([int(lo) for lo, _, _ in cfg]
+                + [int(hi) for _, hi, _ in cfg])
+        ins = [self.g.name_of(x),
+               self.g.const(np.asarray(pads, np.int64)),
+               self.g.name_of(pad_val)]
+        self.g.add("Pad", ins, attrs={"mode": b"constant"},
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_iota(self, eqn):
+        # static shapes: an iota is a compile-time constant — bake it
+        shape = tuple(int(d) for d in eqn.params["shape"])
+        dim = int(eqn.params["dimension"])
+        dt = np.dtype(eqn.params["dtype"])
+        ar = np.arange(shape[dim], dtype=dt)
+        ar = np.broadcast_to(
+            ar.reshape([-1 if i == dim else 1 for i in range(len(shape))]),
+            shape)
+        self.g.var_names[eqn.outvars[0]] = self.g.const(np.ascontiguousarray(ar))
+
+    def _op_select_n(self, eqn):
+        pred, *cases = eqn.invars
+        if len(cases) != 2:
+            raise UnsupportedOpError("select_n with >2 cases")
+        if str(np.dtype(pred.aval.dtype)) != "bool":
+            raise UnsupportedOpError("select_n with integer predicate")
+        # select_n: False -> cases[0]; Where: cond True -> first branch
+        self.g.add("Where", [self.g.name_of(pred),
+                             self.g.name_of(cases[1]),
+                             self.g.name_of(cases[0])],
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_clamp(self, eqn):
+        lo, x, hi = eqn.invars
+        m = self.g.add("Max", [self.g.name_of(x), self.g.name_of(lo)])
+        self.g.add("Min", [m, self.g.name_of(hi)],
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    # -- math that needs decomposition ---------------------------------------
+    def _op_square(self, eqn):
+        nm = self.g.name_of(eqn.invars[0])
+        self.g.add("Mul", [nm, nm],
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_rsqrt(self, eqn):
+        s = self.g.add("Sqrt", [self.g.name_of(eqn.invars[0])])
+        self.g.add("Reciprocal", [s],
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_erfc(self, eqn):
+        dt = np.dtype(eqn.outvars[0].aval.dtype)
+        e = self.g.add("Erf", [self.g.name_of(eqn.invars[0])])
+        one = self.g.const(np.asarray(1, dt))
+        self.g.add("Sub", [one, e],
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_integer_pow(self, eqn):
+        y = int(eqn.params["y"])
+        nm = self.g.name_of(eqn.invars[0])
+        out = self.g.name_of(eqn.outvars[0])
+        if y == 2:
+            self.g.add("Mul", [nm, nm], out_names=[out])
+        else:
+            dt = np.dtype(eqn.invars[0].aval.dtype)
+            self.g.add("Pow", [nm, self.g.const(np.asarray(y, dt))],
+                       out_names=[out])
+
+    def _op_ne(self, eqn):
+        ins = [self.g.name_of(v) for v in eqn.invars]
+        e = self.g.add("Equal", ins)
+        self.g.add("Not", [e], out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_reduce_sum(self, eqn):
+        axes = self.g.const(
+            np.asarray([int(a) for a in eqn.params["axes"]], np.int64))
+        self.g.add("ReduceSum", [self.g.name_of(eqn.invars[0]), axes],
+                   attrs={"keepdims": 0},
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_argmax(self, eqn):
+        axes = eqn.params["axes"]
+        if len(axes) != 1:
+            raise UnsupportedOpError("argmax over multiple axes")
+        out_dt = proto.NP_TO_ONNX[str(np.dtype(eqn.params["index_dtype"]))]
+        a = self.g.add("ArgMax", [self.g.name_of(eqn.invars[0])],
+                       attrs={"axis": int(axes[0]), "keepdims": 0})
+        self.g.add("Cast", [a], attrs={"to": out_dt},
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_argmin(self, eqn):
+        axes = eqn.params["axes"]
+        if len(axes) != 1:
+            raise UnsupportedOpError("argmin over multiple axes")
+        out_dt = proto.NP_TO_ONNX[str(np.dtype(eqn.params["index_dtype"]))]
+        a = self.g.add("ArgMin", [self.g.name_of(eqn.invars[0])],
+                       attrs={"axis": int(axes[0]), "keepdims": 0})
+        self.g.add("Cast", [a], attrs={"to": out_dt},
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_cumsum(self, eqn):
+        ax = self.g.const(np.asarray(int(eqn.params["axis"]), np.int64))
+        self.g.add("CumSum", [self.g.name_of(eqn.invars[0]), ax],
+                   attrs={"reverse": 1 if eqn.params.get("reverse") else 0},
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    # -- the big three: dot_general / conv / reduce_window -------------------
+    def _op_dot_general(self, eqn):
+        lhs, rhs = eqn.invars
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        lc, rc, lb, rb = (list(map(int, d)) for d in (lc, rc, lb, rb))
+        lshape, rshape = list(lhs.aval.shape), list(rhs.aval.shape)
+        lf = [d for d in range(len(lshape)) if d not in lc + lb]
+        rf = [d for d in range(len(rshape)) if d not in rc + rb]
+
+        lnm, rnm = self.g.name_of(lhs), self.g.name_of(rhs)
+        # fast path: plain 2D matmul already in [M,K] x [K,N] layout
+        if (not lb and len(lshape) == 2 and len(rshape) == 2
+                and lc == [1] and rc == [0]):
+            self.g.add("MatMul", [lnm, rnm],
+                       out_names=[self.g.name_of(eqn.outvars[0])])
+            return
+
+        def prod(dims, shape):
+            out = 1
+            for d in dims:
+                out *= shape[d]
+            return out
+
+        bdims = [lshape[d] for d in lb]
+        m, k = prod(lf, lshape), prod(lc, lshape)
+        n = prod(rf, rshape)
+        # lhs -> [B..., M, K]
+        perm_l = lb + lf + lc
+        if perm_l != list(range(len(lshape))):
+            lnm = self.g.add("Transpose", [lnm], attrs={"perm": perm_l})
+        lnm = self.g.add("Reshape", [lnm, self.g.shape_const(bdims + [m, k])])
+        # rhs -> [B..., K, N]
+        perm_r = rb + rc + rf
+        if perm_r != list(range(len(rshape))):
+            rnm = self.g.add("Transpose", [rnm], attrs={"perm": perm_r})
+        rnm = self.g.add("Reshape", [rnm, self.g.shape_const(bdims + [k, n])])
+        mm = self.g.add("MatMul", [lnm, rnm])
+        out_shape = (bdims + [lshape[d] for d in lf]
+                     + [rshape[d] for d in rf])
+        self.g.add("Reshape", [mm, self.g.shape_const(out_shape)],
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_conv_general_dilated(self, eqn):
+        x, w = eqn.invars
+        p = eqn.params
+        dn = p["dimension_numbers"]
+        nd = len(x.aval.shape)
+        spatial = list(range(2, nd))
+        if (tuple(dn.lhs_spec) != tuple([0, 1] + spatial)
+                or tuple(dn.rhs_spec) != tuple([0, 1] + spatial)
+                or tuple(dn.out_spec) != tuple([0, 1] + spatial)):
+            raise UnsupportedOpError(
+                "conv with non-NCHW/OIHW dimension numbers")
+        if any(int(d) != 1 for d in p["lhs_dilation"]):
+            raise UnsupportedOpError("transposed conv (lhs_dilation != 1)")
+        if int(p.get("batch_group_count", 1)) != 1:
+            raise UnsupportedOpError("batch_group_count != 1")
+        pads = ([int(lo) for lo, _ in p["padding"]]
+                + [int(hi) for _, hi in p["padding"]])
+        attrs = {
+            "strides": [int(s) for s in p["window_strides"]],
+            "pads": pads,
+            "dilations": [int(d) for d in p["rhs_dilation"]],
+            "group": int(p["feature_group_count"]),
+            "kernel_shape": [int(w.aval.shape[d]) for d in spatial],
+        }
+        self.g.add("Conv", [self.g.name_of(x), self.g.name_of(w)],
+                   attrs=attrs,
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _pool_common(self, eqn):
+        p = eqn.params
+        win = [int(d) for d in p["window_dimensions"]]
+        strides = [int(d) for d in p["window_strides"]]
+        padding = [(int(lo), int(hi)) for lo, hi in p["padding"]]
+        if win[0] != 1 or win[1] != 1:
+            raise UnsupportedOpError(
+                "reduce_window over batch/channel dims (not NCHW pooling)")
+        if strides[:2] != [1, 1] or padding[:2] != [(0, 0), (0, 0)]:
+            raise UnsupportedOpError(
+                "reduce_window with stride/pad on batch or channel dims")
+        if any(int(d) != 1 for d in p.get("base_dilation", [1] * len(win))):
+            raise UnsupportedOpError("reduce_window with base dilation")
+        if any(int(d) != 1 for d in p.get("window_dilation", [1] * len(win))):
+            raise UnsupportedOpError("reduce_window with window dilation")
+        pads = ([lo for lo, _ in padding[2:]] + [hi for _, hi in padding[2:]])
+        attrs = {"kernel_shape": win[2:], "strides": strides[2:],
+                 "pads": pads}
+        return attrs, win
+
+    def _op_reduce_window_max(self, eqn):
+        attrs, _ = self._pool_common(eqn)
+        self.g.add("MaxPool", [self.g.name_of(eqn.invars[0])], attrs=attrs,
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_reduce_window_sum(self, eqn):
+        attrs, win = self._pool_common(eqn)
+        attrs["count_include_pad"] = 1
+        ap = self.g.add("AveragePool", [self.g.name_of(eqn.invars[0])],
+                        attrs=attrs)
+        dt = np.dtype(eqn.outvars[0].aval.dtype)
+        k = self.g.const(np.asarray(float(np.prod(win)), dt))
+        self.g.add("Mul", [ap, k],
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_gather(self, eqn):
+        operand, indices = eqn.invars
+        dn = eqn.params["dimension_numbers"]
+        slice_sizes = [int(s) for s in eqn.params["slice_sizes"]]
+        oshape = list(operand.aval.shape)
+        ishape = list(indices.aval.shape)
+        # the jnp.take(x, ids, axis=a) lowering: one collapsed slice dim at
+        # axis a, start_index_map == (a,), full slices elsewhere, index
+        # vector as the trailing dim of `indices`
+        if (len(dn.start_index_map) != 1
+                or list(dn.collapsed_slice_dims) != list(dn.start_index_map)
+                or getattr(dn, "operand_batching_dims", ())):
+            raise UnsupportedOpError(
+                f"gather with general dimension_numbers {dn}")
+        axis = int(dn.start_index_map[0])
+        expect = list(oshape)
+        expect[axis] = 1
+        if slice_sizes != expect:
+            raise UnsupportedOpError(
+                f"gather with partial slice sizes {slice_sizes}")
+        if ishape[-1] != 1:
+            raise UnsupportedOpError("gather with multi-dim index vectors")
+        # offset dims must be the trailing dims (take semantics)
+        n_batch = len(ishape) - 1
+        out_rank = n_batch + len(oshape) - 1
+        if list(dn.offset_dims) != list(range(n_batch, out_rank)):
+            raise UnsupportedOpError("gather with interleaved offset dims")
+        if axis != 0:
+            raise UnsupportedOpError("gather along non-leading axis")
+        idx = self.g.add("Reshape", [self.g.name_of(indices),
+                                     self.g.shape_const(ishape[:-1])])
+        self.g.add("Gather", [self.g.name_of(operand), idx],
+                   attrs={"axis": axis},
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+
+def convert(pure_fn, params_flat_named, example_args, input_names=None,
+            model_name="model"):
+    """Trace pure_fn(params_list, *args) and convert to ONNX model bytes.
+
+    params_flat_named: list of (name, np.ndarray) weights — become graph
+    initializers. example_args: example input arrays (fix the traced
+    shapes; ONNX export is static-shape by design here, matching the
+    reference's fixed-shape .onnx outputs).
+    """
+    import jax
+
+    arrs = [np.asarray(a) for a in example_args]
+    names = list(input_names or [f"input_{i}" for i in range(len(arrs))])
+    closed = jax.make_jaxpr(
+        lambda ps, *xs: pure_fn(ps, *xs))(
+            [v for _, v in params_flat_named], *arrs)
+
+    conv = Converter()
+    jaxpr = closed.jaxpr
+    n_params = len(params_flat_named)
+    for var, (pname, pval) in zip(jaxpr.invars[:n_params],
+                                  params_flat_named):
+        conv.g.var_names[var] = conv.g.const(np.asarray(pval), name=pname)
+    graph_in_vars, out_vars, out_names = conv.convert_jaxpr(closed, names)
+
+    # a graph output must be a unique node-produced name: passthrough
+    # outputs (an input, an initializer, or a repeated var) get an Identity
+    seen = set()
+    for i, nm in enumerate(out_names):
+        if nm not in conv.g.produced or nm in seen:
+            out_names[i] = conv.g.add("Identity", [nm])
+        seen.add(out_names[i])
+
+    in_infos = [proto.value_info(
+        nm, proto.NP_TO_ONNX[str(a.dtype)], a.shape)
+        for nm, a in zip(names, arrs)]
+    out_infos = []
+    for ov, nm in zip(out_vars, out_names):
+        out_infos.append(proto.value_info(
+            nm, proto.NP_TO_ONNX[str(np.dtype(ov.aval.dtype))],
+            [int(d) for d in ov.aval.shape]))
+    graph = proto.graph_proto(model_name, conv.g.nodes,
+                              conv.g.initializers, in_infos, out_infos)
+    return proto.model_proto(graph)

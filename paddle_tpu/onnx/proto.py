@@ -1,0 +1,329 @@
+"""Minimal ONNX protobuf wire format — emitter AND parser, no `onnx` dep.
+
+The reference delegates ONNX emission to the external `paddle2onnx` package
+(/root/reference/python/paddle/onnx/export.py:21); this build has no `onnx`
+package in-image, so the length-delimited protobuf wire format is hand-rolled
+here from the public onnx.proto schema. Only the message subset the exporter
+emits is modeled (ModelProto / GraphProto / NodeProto / TensorProto /
+ValueInfoProto / AttributeProto). The parser reads back exactly this subset —
+export.py round-trips every written file through it and re-executes the graph
+in numpy as a structural + numerical self-check.
+
+Wire format recap: each field is a (tag, payload) pair; tag = field_number<<3
+| wire_type; wire_type 0 = varint, 1 = 64-bit, 2 = length-delimited, 5 =
+32-bit. Packed repeated scalars are a length-delimited blob of varints/fixed.
+"""
+import struct
+
+# --- TensorProto.DataType enum (public onnx.proto values) -------------------
+FLOAT, UINT8, INT8, UINT16, INT16, INT32, INT64, STRING, BOOL = range(1, 10)
+FLOAT16, DOUBLE, UINT32, UINT64 = 10, 11, 12, 13
+BFLOAT16 = 16
+
+NP_TO_ONNX = {
+    "float32": FLOAT, "float64": DOUBLE, "float16": FLOAT16,
+    "bfloat16": BFLOAT16, "int32": INT32, "int64": INT64, "int8": INT8,
+    "uint8": UINT8, "bool": BOOL, "uint32": UINT32, "uint64": UINT64,
+    "int16": INT16, "uint16": UINT16,
+}
+ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
+
+# --- AttributeProto.AttributeType enum --------------------------------------
+A_FLOAT, A_INT, A_STRING, A_TENSOR, A_GRAPH = 1, 2, 3, 4, 5
+A_FLOATS, A_INTS, A_STRINGS = 6, 7, 8
+
+
+# ---------------------------------------------------------------------------
+# wire-level encoding
+# ---------------------------------------------------------------------------
+
+def _varint(n):
+    if n < 0:  # protobuf int64: negatives are 10-byte two's complement
+        n += 1 << 64
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def f_varint(field, value):
+    return _tag(field, 0) + _varint(int(value))
+
+
+def f_bytes(field, payload):
+    if isinstance(payload, str):
+        payload = payload.encode("utf-8")
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def f_float(field, value):
+    return _tag(field, 5) + struct.pack("<f", float(value))
+
+
+# ---------------------------------------------------------------------------
+# message builders (return serialized bytes)
+# ---------------------------------------------------------------------------
+
+def tensor_proto(name, array):
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9 (little-endian)."""
+    import numpy as np
+
+    arr = np.asarray(array)
+    # ascontiguousarray promotes 0-d to 1-d — restore the true shape
+    arr = np.ascontiguousarray(arr).reshape(arr.shape)
+    dt = NP_TO_ONNX[str(arr.dtype)]
+    out = b""
+    for d in arr.shape:
+        out += f_varint(1, d)
+    out += f_varint(2, dt)
+    out += f_bytes(8, name)
+    out += f_bytes(9, arr.tobytes())
+    return out
+
+
+def attribute(name, value):
+    """AttributeProto: name=1, f=2, i=3, s=4, t=5, g=6, floats=7, ints=8,
+    strings=9, type=20."""
+    out = f_bytes(1, name)
+    if isinstance(value, bool):
+        out += f_varint(3, int(value)) + f_varint(20, A_INT)
+    elif isinstance(value, int):
+        out += f_varint(3, value) + f_varint(20, A_INT)
+    elif isinstance(value, float):
+        out += f_float(2, value) + f_varint(20, A_FLOAT)
+    elif isinstance(value, (bytes, str)):
+        out += f_bytes(4, value) + f_varint(20, A_STRING)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            for v in value:
+                out += f_float(7, v)
+            out += f_varint(20, A_FLOATS)
+        else:
+            for v in value:
+                out += f_varint(8, int(v))
+            out += f_varint(20, A_INTS)
+    else:
+        raise TypeError(f"unsupported attribute value {value!r}")
+    return out
+
+
+def node_proto(op_type, inputs, outputs, name="", attrs=None):
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+    out = b""
+    for i in inputs:
+        out += f_bytes(1, i)
+    for o in outputs:
+        out += f_bytes(2, o)
+    if name:
+        out += f_bytes(3, name)
+    out += f_bytes(4, op_type)
+    for k, v in (attrs or {}).items():
+        out += f_bytes(5, attribute(k, v))
+    return out
+
+
+def value_info(name, elem_type, shape):
+    """ValueInfoProto: name=1, type=2; TypeProto.tensor_type=1;
+    Tensor: elem_type=1, shape=2; TensorShapeProto.dim=1; dim_value=1."""
+    shape_body = b""
+    for d in shape:
+        shape_body += f_bytes(1, f_varint(1, int(d)))
+    tensor_body = f_varint(1, elem_type) + f_bytes(2, shape_body)
+    type_body = f_bytes(1, tensor_body)
+    return f_bytes(1, name) + f_bytes(2, type_body)
+
+
+def graph_proto(name, nodes, initializers, inputs, outputs):
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    out = b""
+    for n in nodes:
+        out += f_bytes(1, n)
+    out += f_bytes(2, name)
+    for t in initializers:
+        out += f_bytes(5, t)
+    for vi in inputs:
+        out += f_bytes(11, vi)
+    for vi in outputs:
+        out += f_bytes(12, vi)
+    return out
+
+
+def model_proto(graph, opset=13, producer="paddle_tpu"):
+    """ModelProto: ir_version=1, producer_name=2, graph=7, opset_import=8;
+    OperatorSetIdProto: domain=1, version=2."""
+    opset_body = f_bytes(1, "") + f_varint(2, opset)
+    return (f_varint(1, 8)            # IR version 8 (supports opset 13)
+            + f_bytes(2, producer)
+            + f_bytes(7, graph)
+            + f_bytes(8, opset_body))
+
+
+# ---------------------------------------------------------------------------
+# parser (reads back the subset above)
+# ---------------------------------------------------------------------------
+
+def _read_varint(buf, pos):
+    shift, val = 0, 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, pos
+        shift += 7
+
+
+def _fields(buf):
+    """Yield (field_number, wire_type, value) over a serialized message."""
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == 1:
+            val = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _signed64(v):
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def parse_tensor(buf):
+    import numpy as np
+
+    dims, dtype, name, raw = [], None, "", b""
+    for field, _, val in _fields(buf):
+        if field == 1:
+            dims.append(_signed64(val))
+        elif field == 2:
+            dtype = val
+        elif field == 8:
+            name = val.decode()
+        elif field == 9:
+            raw = val
+    arr = np.frombuffer(raw, dtype=ONNX_TO_NP[dtype]).reshape(dims)
+    return name, arr
+
+
+def parse_attribute(buf):
+    name, atype, fv, iv, sv, floats, ints = "", None, None, None, None, [], []
+    for field, _, val in _fields(buf):
+        if field == 1:
+            name = val.decode()
+        elif field == 2:
+            fv = val
+        elif field == 3:
+            iv = _signed64(val)
+        elif field == 4:
+            sv = val
+        elif field == 7:
+            floats.append(val)
+        elif field == 8:
+            ints.append(_signed64(val))
+        elif field == 20:
+            atype = val
+    if atype == A_FLOAT:
+        return name, fv
+    if atype == A_INT:
+        return name, iv
+    if atype == A_STRING:
+        return name, sv
+    if atype == A_FLOATS:
+        return name, floats
+    if atype == A_INTS:
+        return name, ints
+    raise ValueError(f"unsupported attribute type {atype} for {name!r}")
+
+
+def parse_node(buf):
+    inputs, outputs, name, op_type, attrs = [], [], "", "", {}
+    for field, _, val in _fields(buf):
+        if field == 1:
+            inputs.append(val.decode())
+        elif field == 2:
+            outputs.append(val.decode())
+        elif field == 3:
+            name = val.decode()
+        elif field == 4:
+            op_type = val.decode()
+        elif field == 5:
+            k, v = parse_attribute(val)
+            attrs[k] = v
+    return {"op_type": op_type, "inputs": inputs, "outputs": outputs,
+            "name": name, "attrs": attrs}
+
+
+def parse_value_info(buf):
+    name, elem_type, shape = "", None, []
+    for field, _, val in _fields(buf):
+        if field == 1:
+            name = val.decode()
+        elif field == 2:
+            for f2, _, v2 in _fields(val):      # TypeProto
+                if f2 == 1:                      # tensor_type
+                    for f3, _, v3 in _fields(v2):
+                        if f3 == 1:
+                            elem_type = v3
+                        elif f3 == 2:            # shape
+                            for f4, _, v4 in _fields(v3):
+                                if f4 == 1:      # dim
+                                    for f5, _, v5 in _fields(v4):
+                                        if f5 == 1:
+                                            shape.append(_signed64(v5))
+    return {"name": name, "elem_type": elem_type, "shape": shape}
+
+
+def parse_graph(buf):
+    nodes, inits, inputs, outputs, name = [], {}, [], [], ""
+    for field, _, val in _fields(buf):
+        if field == 1:
+            nodes.append(parse_node(val))
+        elif field == 2:
+            name = val.decode()
+        elif field == 5:
+            n, arr = parse_tensor(val)
+            inits[n] = arr
+        elif field == 11:
+            inputs.append(parse_value_info(val))
+        elif field == 12:
+            outputs.append(parse_value_info(val))
+    return {"name": name, "nodes": nodes, "initializers": inits,
+            "inputs": inputs, "outputs": outputs}
+
+
+def parse_model(buf):
+    graph, ir_version, opset, producer = None, None, None, ""
+    for field, _, val in _fields(buf):
+        if field == 1:
+            ir_version = val
+        elif field == 2:
+            producer = val.decode()
+        elif field == 7:
+            graph = parse_graph(val)
+        elif field == 8:
+            for f2, _, v2 in _fields(val):
+                if f2 == 2:
+                    opset = v2
+    return {"ir_version": ir_version, "producer": producer,
+            "opset": opset, "graph": graph}

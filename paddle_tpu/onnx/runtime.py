@@ -1,0 +1,246 @@
+"""Numpy re-executor for the ONNX op subset the converter emits.
+
+This is the validation half of `paddle.onnx.export`: every exported file is
+parsed back (proto.parse_model) and re-executed here, in pure numpy with no
+jax involvement, and the result is compared against the layer's own output.
+A model that round-trips through serialized-protobuf → parse → numpy and
+matches to tolerance is structurally valid and numerically faithful.
+
+Covers exactly the opset-13 node set converter.py can produce. Kept
+independent of the converter's internals on purpose — it consumes only the
+parsed file, like an external runtime would.
+"""
+import math
+
+import numpy as np
+
+from . import proto
+
+
+def _erf(x):
+    return np.vectorize(math.erf, otypes=[x.dtype])(x) \
+        if x.size else x.copy()
+
+
+def _pool_views(x, kernel, strides, pads, pad_value):
+    """Yield (window_view_stack, axis) for NCHW pooling via explicit pad +
+    strided window extraction (loops over the small kernel only)."""
+    kh, kw = kernel
+    sh, sw = strides
+    ph0, pw0, ph1, pw1 = pads
+    xp = np.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)),
+                constant_values=pad_value)
+    b, c, H, W = xp.shape
+    oh = (H - kh) // sh + 1
+    ow = (W - kw) // sw + 1
+    stack = np.empty((kh * kw, x.shape[0], x.shape[1], oh, ow), x.dtype)
+    i = 0
+    for dy in range(kh):
+        for dx in range(kw):
+            stack[i] = xp[:, :, dy:dy + sh * oh:sh, dx:dx + sw * ow:sw]
+            i += 1
+    return stack
+
+
+def _conv(x, w, attrs):
+    strides = attrs.get("strides", [1, 1])
+    pads = attrs.get("pads", [0, 0, 0, 0])
+    dil = attrs.get("dilations", [1, 1])
+    group = attrs.get("group", 1)
+    ph0, pw0, ph1, pw1 = pads
+    xp = np.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    b, cin, H, W = xp.shape
+    cout, cin_g, kh, kw = w.shape
+    ekh, ekw = (kh - 1) * dil[0] + 1, (kw - 1) * dil[1] + 1
+    oh = (H - ekh) // strides[0] + 1
+    ow = (W - ekw) // strides[1] + 1
+    out = np.zeros((b, cout, oh, ow), np.result_type(x, w))
+    og = cout // group
+    for g in range(group):
+        xg = xp[:, g * cin_g:(g + 1) * cin_g]
+        wg = w[g * og:(g + 1) * og]          # [og, cin_g, kh, kw]
+        # im2col over the kernel footprint
+        acc = np.zeros((b, og, oh, ow), out.dtype)
+        for dy in range(kh):
+            for dx in range(kw):
+                patch = xg[:, :, dy * dil[0]:dy * dil[0] + strides[0] * oh:strides[0],
+                           dx * dil[1]:dx * dil[1] + strides[1] * ow:strides[1]]
+                # [b,cin_g,oh,ow] x [og,cin_g] -> [b,og,oh,ow]
+                acc += np.einsum("bchw,oc->bohw", patch, wg[:, :, dy, dx])
+        out[:, g * og:(g + 1) * og] = acc
+    return out
+
+
+def _slice(x, starts, ends, axes, steps):
+    sl = [slice(None)] * x.ndim
+    for s, e, a, st in zip(starts, ends, axes, steps):
+        # ONNX clamps out-of-range starts/ends (INT64_MIN end + step -1
+        # means "through the first element")
+        if st > 0:
+            sl[a] = slice(int(s), int(min(e, np.iinfo(np.int64).max)),
+                          int(st))
+        else:
+            e = int(e)
+            sl[a] = slice(int(s), None if e <= -x.shape[a] - 1 else e,
+                          int(st))
+    return x[tuple(sl)]
+
+
+def run(model_bytes, inputs):
+    """Execute a serialized ONNX model on numpy inputs.
+
+    inputs: dict name -> array, or list matching graph input order.
+    Returns list of output arrays (graph output order).
+    """
+    model = proto.parse_model(model_bytes)
+    g = model["graph"]
+    env = dict(g["initializers"])
+    if isinstance(inputs, dict):
+        env.update({k: np.asarray(v) for k, v in inputs.items()})
+    else:
+        for vi, arr in zip(g["inputs"], inputs):
+            env[vi["name"]] = np.asarray(arr)
+
+    for node in g["nodes"]:
+        op = node["op_type"]
+        ins = [env[n] for n in node["inputs"]]
+        at = node["attrs"]
+        if op == "Add":
+            out = ins[0] + ins[1]
+        elif op == "Sub":
+            out = ins[0] - ins[1]
+        elif op == "Mul":
+            out = ins[0] * ins[1]
+        elif op == "Div":
+            if np.issubdtype(ins[0].dtype, np.floating):
+                out = ins[0] / ins[1]
+            else:  # ONNX (and lax.div) integer division truncates toward 0
+                out = np.trunc(ins[0] / ins[1]).astype(ins[0].dtype)
+        elif op == "Max":
+            out = np.maximum(ins[0], ins[1])
+        elif op == "Min":
+            out = np.minimum(ins[0], ins[1])
+        elif op == "Pow":
+            out = np.power(ins[0], ins[1]).astype(ins[0].dtype)
+        elif op == "Neg":
+            out = -ins[0]
+        elif op == "Exp":
+            out = np.exp(ins[0])
+        elif op == "Log":
+            out = np.log(ins[0])
+        elif op == "Sqrt":
+            out = np.sqrt(ins[0])
+        elif op == "Reciprocal":
+            out = 1.0 / ins[0]
+        elif op == "Tanh":
+            out = np.tanh(ins[0])
+        elif op == "Sigmoid":
+            out = 1.0 / (1.0 + np.exp(-ins[0]))
+        elif op == "Erf":
+            out = _erf(ins[0])
+        elif op == "Abs":
+            out = np.abs(ins[0])
+        elif op == "Sign":
+            out = np.sign(ins[0])
+        elif op == "Floor":
+            out = np.floor(ins[0])
+        elif op == "Ceil":
+            out = np.ceil(ins[0])
+        elif op == "Sin":
+            out = np.sin(ins[0])
+        elif op == "Cos":
+            out = np.cos(ins[0])
+        elif op == "Not":
+            out = ~ins[0]
+        elif op == "And":
+            out = ins[0] & ins[1]
+        elif op == "Or":
+            out = ins[0] | ins[1]
+        elif op == "Less":
+            out = ins[0] < ins[1]
+        elif op == "LessOrEqual":
+            out = ins[0] <= ins[1]
+        elif op == "Greater":
+            out = ins[0] > ins[1]
+        elif op == "GreaterOrEqual":
+            out = ins[0] >= ins[1]
+        elif op == "Equal":
+            out = ins[0] == ins[1]
+        elif op == "Where":
+            out = np.where(ins[0], ins[1], ins[2])
+        elif op == "Cast":
+            out = ins[0].astype(proto.ONNX_TO_NP[at["to"]])
+        elif op == "Identity":
+            out = ins[0]
+        elif op == "Reshape":
+            out = ins[0].reshape([int(d) for d in ins[1]])
+        elif op == "Transpose":
+            out = np.transpose(ins[0], at["perm"])
+        elif op == "Expand":
+            out = np.broadcast_to(ins[0], [int(d) for d in ins[1]])
+        elif op == "Concat":
+            out = np.concatenate(ins, axis=at["axis"])
+        elif op == "Split":
+            sizes = [int(s) for s in ins[1]]
+            outs = np.split(ins[0], np.cumsum(sizes)[:-1], axis=at["axis"])
+            for nm, o in zip(node["outputs"], outs):
+                env[nm] = o
+            continue
+        elif op == "Slice":
+            out = _slice(ins[0], ins[1], ins[2], ins[3], ins[4])
+        elif op == "Pad":
+            pads = [int(p) for p in ins[1]]
+            n = len(pads) // 2
+            out = np.pad(ins[0], list(zip(pads[:n], pads[n:])),
+                         constant_values=ins[2])
+        elif op == "ReduceSum":
+            axes = tuple(int(a) for a in ins[1])
+            out = ins[0].sum(axis=axes, keepdims=bool(at.get("keepdims", 1)))
+        elif op in ("ReduceMax", "ReduceMin", "ReduceProd", "ReduceMean"):
+            fn = {"ReduceMax": np.max, "ReduceMin": np.min,
+                  "ReduceProd": np.prod, "ReduceMean": np.mean}[op]
+            out = fn(ins[0], axis=tuple(at["axes"]),
+                     keepdims=bool(at.get("keepdims", 1)))
+        elif op == "ArgMax":
+            out = np.argmax(ins[0], axis=at["axis"]).astype(np.int64)
+            if at.get("keepdims", 1):
+                out = np.expand_dims(out, at["axis"])
+        elif op == "ArgMin":
+            out = np.argmin(ins[0], axis=at["axis"]).astype(np.int64)
+            if at.get("keepdims", 1):
+                out = np.expand_dims(out, at["axis"])
+        elif op == "CumSum":
+            out = np.cumsum(ins[0], axis=int(ins[1]))
+            if at.get("reverse"):
+                raise NotImplementedError("CumSum reverse")
+        elif op == "MatMul":
+            out = np.matmul(ins[0], ins[1])
+        elif op == "Conv":
+            out = _conv(ins[0], ins[1], at)
+            if len(ins) > 2:
+                out = out + ins[2].reshape(1, -1, 1, 1)
+        elif op == "MaxPool":
+            stack = _pool_views(ins[0], at["kernel_shape"],
+                                at.get("strides", [1, 1]),
+                                at.get("pads", [0, 0, 0, 0]),
+                                -np.inf)
+            out = stack.max(axis=0)
+        elif op == "AveragePool":
+            if not at.get("count_include_pad"):
+                raise NotImplementedError(
+                    "AveragePool without count_include_pad")
+            stack = _pool_views(ins[0], at["kernel_shape"],
+                                at.get("strides", [1, 1]),
+                                at.get("pads", [0, 0, 0, 0]), 0.0)
+            out = stack.mean(axis=0)
+        elif op == "Gather":
+            out = np.take(ins[0], ins[1].astype(np.int64),
+                          axis=at.get("axis", 0))
+        elif op == "Clip":
+            out = np.clip(ins[0], ins[1] if len(ins) > 1 else None,
+                          ins[2] if len(ins) > 2 else None)
+        else:
+            raise NotImplementedError(f"onnx.runtime: op {op}")
+        env[node["outputs"][0]] = np.asarray(out)
+
+    return [env[vi["name"]] for vi in g["outputs"]]

@@ -136,8 +136,12 @@ def _write_export_artifact(pure, params, shapes, dtypes, path_prefix):
     return wrote_artifact
 
 
-def _save_layer(path_prefix, feed_vars, layer):
-    params = {n: np.asarray(t._data) for n, t in layer.state_dict().items()}
+def layer_pure_fn(layer, force_eval=False):
+    """Pure `(params_dict, *arrays) -> forward output` view of a Layer —
+    the substitute-params/trace/restore dance shared by jit.save /
+    save_inference_model (here) and paddle.onnx.export. force_eval=True
+    additionally pins train=False for the trace (inference export); the
+    jit.save path keeps the layer's current mode (r3 behavior)."""
 
     def pure(params_d, *args):
         wrapped = [Tensor(a) for a in args]
@@ -146,7 +150,12 @@ def _save_layer(path_prefix, feed_vars, layer):
         named = dict(layer.named_parameters())
         named.update(dict(layer.named_buffers()))
         saved = {n: t._data for n, t in named.items()}
+        saved_modes = ([(l, l.training)
+                        for l in [layer] + layer.sublayers()]
+                       if force_eval else [])
         try:
+            for l, _ in saved_modes:
+                l.training = False
             for n, v in params_d.items():
                 if n in named:
                     named[n]._data = v
@@ -155,9 +164,18 @@ def _save_layer(path_prefix, feed_vars, layer):
         finally:
             for n, t in named.items():
                 t._data = saved[n]
+            for l, m in saved_modes:
+                l.training = m
         return jax.tree_util.tree_map(
             lambda v: v._data if isinstance(v, Tensor) else v, out,
             is_leaf=lambda v: isinstance(v, Tensor))
+
+    return pure
+
+
+def _save_layer(path_prefix, feed_vars, layer):
+    params = {n: np.asarray(t._data) for n, t in layer.state_dict().items()}
+    pure = layer_pure_fn(layer)
 
     shapes = [tuple(v.shape) for v in feed_vars]
     dtypes = [v.dtype for v in feed_vars]

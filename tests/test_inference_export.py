@@ -214,22 +214,30 @@ class TestProgramPathSaveInferenceModel:
 
 
 class TestOnnxExportHonesty:
-    """VERDICT r2 weak #2: onnx.export must not write a fake .onnx."""
+    """r3: export refused to write fake .onnx; r4 ships the real emitter
+    (tests/test_onnx_export.py) — here we pin that the honesty contract
+    SURVIVES it: a real .onnx is written only when validated, and the
+    native artifact always saves alongside."""
 
-    def test_refuses_fake_onnx_but_saves_native(self, tmp_path):
+    def test_writes_real_onnx_and_native_artifact(self, tmp_path):
         paddle.seed(0)
         net = nn.Sequential(nn.Linear(4, 2))
         net.eval()
         prefix = str(tmp_path / "om")
-        with pytest.raises(RuntimeError, match="No .onnx file was written"):
-            paddle.onnx.export(
-                net, prefix,
-                input_spec=[paddle.jit.InputSpec([2, 4], "float32")])
-        assert not os.path.exists(prefix + ".onnx")
-        # the native artifact WAS saved and loads
+        onnx_path = paddle.onnx.export(
+            net, prefix,
+            input_spec=[paddle.jit.InputSpec([2, 4], "float32")])
+        assert os.path.exists(onnx_path)
+        # the native artifact is still saved and loads
         loaded = paddle.jit.load(prefix)
         out = loaded(paddle.to_tensor(np.ones((2, 4), np.float32)))
         assert tuple(out.shape) == (2, 2)
+        # and the .onnx re-executes in numpy to the same result
+        from paddle_tpu.onnx import runtime
+        x = np.ones((2, 4), np.float32)
+        (got,) = runtime.run(open(onnx_path, "rb").read(), [x])
+        np.testing.assert_allclose(got, np.asarray(net(
+            paddle.to_tensor(x))._data), atol=1e-5, rtol=1e-5)
 
 
 class TestConvertToMixedPrecision:
