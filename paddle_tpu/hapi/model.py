@@ -349,6 +349,12 @@ class Model:
     def summary(self, input_size=None, dtype=None):
         from .model_summary import summary
 
+        if input_size is None and self._inputs:
+            # reference fallback: use the InputSpec list given to Model()
+            input_size = [tuple(s.shape) for s in self._inputs]
+            if dtype is None:
+                dtype = [str(getattr(s, "dtype", None) or "float32")
+                         for s in self._inputs]
         return summary(self.network, input_size, dtypes=dtype)
 
     # -- helpers ----------------------------------------------------------------
@@ -402,21 +408,32 @@ class Model:
 
 
 def flops(net, input_size, custom_ops=None, print_detail=False):
-    """paddle.flops rough parity: counts matmul/conv FLOPs via cost analysis."""
-    import jax
-    import jax.numpy as jnp
+    """paddle.flops parity (reference hapi/dynamic_flops.py): per-layer
+    FLOP counts from the same forward-hook pass that powers summary —
+    conv / linear / attention families counted from hooked shapes;
+    custom_ops maps a Layer class to fn(layer, input_shape, output_shape)
+    -> flops for anything else. print_detail prints the per-layer table."""
+    from .model_summary import summary_string
 
-    from ..core.tape import global_tape
-
-    x = jnp.zeros(tuple(input_size), dtype=jnp.float32)
-
-    def fwd(v):
-        with global_tape().pause():
-            return net(Tensor(v))._data
-
-    try:
-        analysis = jax.jit(fwd).lower(x).compile().cost_analysis()
-        f = analysis.get("flops", 0.0) if isinstance(analysis, dict) else 0.0
-        return int(f)
-    except Exception:
-        return 0
+    _, info = summary_string(net, input_size=input_size)
+    total = 0
+    rows = []
+    for r in info["records"]:
+        f = r["flops"]
+        if custom_ops:
+            fn = custom_ops.get(type(r["layer"]))
+            if fn is not None:
+                f = int(fn(r["layer"], r["input_shape"], r["output_shape"]))
+        total += f
+        rows.append((r["key"], r["input_shape"], r["output_shape"],
+                     r["nb_params"], f))
+    if print_detail:
+        w = max([12] + [len(k) for k, *_ in rows])
+        print(f"{'Layer':<{w}}  {'Input Shape':<22}{'Output Shape':<22}"
+              f"{'Params':>12}{'FLOPs':>16}")
+        print("-" * (w + 74))
+        for k, i, o, p, f in rows:
+            print(f"{k:<{w}}  {str(i):<22}{str(o):<22}{p:>12,}{f:>16,}")
+        print("-" * (w + 74))
+        print(f"Total FLOPs: {total:,}")
+    return int(total)

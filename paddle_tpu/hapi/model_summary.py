@@ -1,29 +1,245 @@
-"""Model summary (python/paddle/hapi/model_summary.py parity)."""
+"""Model summary — full parity with the reference's hook-driven table
+(python/paddle/hapi/model_summary.py: per-layer input/output shapes via
+forward hooks, trainable split, memory-estimate footer), built on this
+framework's own Layer hook API.
+
+`summary_string` also powers `paddle.flops(..., print_detail=True)`:
+per-layer FLOP counts are derived from the hooked shapes for the matmul-
+bearing layers (conv / linear / attention), the reference's
+hapi/dynamic_flops.py role.
+"""
+import numbers
+
 import numpy as np
 
 from ..core.tensor import Tensor
 
+__all__ = ["summary", "summary_string"]
+
+
+def _normalize_shape(shape):
+    """Replace a single batch None/-1 with 1; validate the rest positive."""
+    unknown = 0
+    out = []
+    for d in shape:
+        if d is None or (isinstance(d, numbers.Number) and d == -1):
+            unknown += 1
+            if unknown > 1:
+                raise ValueError(
+                    "input_size: only the batch dim may be None or -1")
+            out.append(1)
+        else:
+            d = int(d)
+            if d <= 0:
+                raise ValueError(f"input_size dims must be positive, got {d}")
+            out.append(d)
+    return tuple(out)
+
+
+def _is_plain_shape(s):
+    return isinstance(s, (list, tuple)) and all(
+        isinstance(d, numbers.Number) or d is None for d in s)
+
+
+def _build_inputs(input_size, dtypes):
+    """input_size: tuple | InputSpec | list of those → list of Tensors."""
+    specs = []
+
+    def collect(sz):
+        if hasattr(sz, "shape"):                      # InputSpec
+            specs.append((_normalize_shape(sz.shape),
+                          str(getattr(sz, "dtype", None) or "float32")))
+        elif _is_plain_shape(sz):
+            specs.append((_normalize_shape(sz), None))
+        elif isinstance(sz, (list, tuple)):
+            for item in sz:
+                collect(item)
+        else:
+            raise TypeError(f"unsupported input_size entry {sz!r}")
+
+    collect(input_size)
+    if dtypes is not None:
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else [dtypes]
+        specs = [(sh, str(dts[min(i, len(dts) - 1)]))
+                 for i, (sh, _) in enumerate(specs)]
+    rng = np.random.RandomState(0)
+    out = []
+    for sh, dt in specs:
+        dt = np.dtype(dt or "float32")
+        if np.issubdtype(dt, np.floating):
+            out.append(Tensor(rng.rand(*sh).astype(dt)))
+        else:
+            out.append(Tensor(np.zeros(sh, dt)))
+    return out
+
+
+def _shape_of(x):
+    if isinstance(x, (list, tuple)):
+        return [_shape_of(v) for v in x]
+    return list(getattr(x, "shape", []))
+
+
+def _numel(shape_tree):
+    if not shape_tree:
+        return 0
+    if isinstance(shape_tree[0], list):
+        return sum(_numel(s) for s in shape_tree)
+    return int(np.prod(shape_tree))
+
+
+def _layer_flops(layer, in_shapes, out_shapes):
+    """FLOPs for the matmul-bearing layer families, from hooked shapes
+    (multiply-accumulate = 2 FLOPs, the convention the MFU numbers use)."""
+    cls = type(layer).__name__
+    try:
+        if cls.startswith("Conv") and getattr(layer, "weight", None) \
+                is not None:
+            w = layer.weight.shape          # [Cout, Cin/g, *k]
+            out = out_shapes if not isinstance(out_shapes[0], list) \
+                else out_shapes[0]
+            return 2 * int(np.prod(w)) * int(np.prod(out[2:])) * out[0]
+        if cls == "Linear" and getattr(layer, "weight", None) is not None:
+            w = layer.weight.shape          # [in, out]
+            ins = in_shapes if not isinstance(in_shapes[0], list) \
+                else in_shapes[0]
+            batch_elems = int(np.prod(ins[:-1])) if len(ins) > 1 else 1
+            return 2 * batch_elems * int(np.prod(w))
+        if hasattr(layer, "num_heads") and hasattr(layer, "head_dim"):
+            # attention core: QK^T and PV, 2*b*s_q*s_kv*h each (the
+            # q/k/v/out projections are Linear sublayers, counted above);
+            # cross-attention takes s_kv from the key input when present
+            if isinstance(in_shapes[0], list):
+                q = in_shapes[0]
+                kv = in_shapes[1] if len(in_shapes) > 1 \
+                    and isinstance(in_shapes[1], list) \
+                    and len(in_shapes[1]) >= 3 else q
+            else:
+                q = kv = in_shapes
+            if len(q) >= 3:
+                h = layer.num_heads * layer.head_dim
+                return 4 * q[0] * q[1] * kv[1] * h
+    except Exception:
+        pass
+    return 0
+
+
+def summary_string(model, input_size=None, dtypes=None, input=None):
+    """Build the summary table. Returns (table_str, params_info);
+    params_info carries the totals AND the per-layer records (paddle.flops
+    reuses them for its per-layer detail table)."""
+    if input is not None:
+        xs = input if isinstance(input, (list, tuple)) else [input]
+        xs = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+              for x in xs]
+    else:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        xs = _build_inputs(input_size, dtypes)
+
+    records = []     # per executed leaf layer, in execution order
+    hooks = []
+    container_types = {"Sequential", "LayerList", "ParameterList"}
+
+    def register(layer):
+        cls = type(layer).__name__
+        if layer is model and list(model.sublayers()):
+            return
+        if cls in container_types:
+            return
+
+        def hook(lyr, inputs, output, _cls=cls):
+            n_params = 0
+            trainable = False
+            for p in lyr._parameters.values():
+                if p is None:
+                    continue
+                n_params += int(p.size)
+                if getattr(p, "trainable", True) and \
+                        not getattr(p, "stop_gradient", False):
+                    trainable = True
+            in_sh = _shape_of(list(inputs) if len(inputs) != 1
+                              else inputs[0])
+            out_sh = _shape_of(output if not isinstance(output, tuple)
+                               or len(output) != 1 else output[0])
+            records.append({
+                "key": f"{_cls}-{len(records) + 1}", "layer": lyr,
+                "input_shape": in_sh, "output_shape": out_sh,
+                "nb_params": n_params, "trainable": trainable,
+                "flops": _layer_flops(lyr, in_sh, out_sh),
+            })
+
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    was_training = model.training
+    model.eval()
+    try:
+        model.apply(register)
+        model(*xs)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            model.train()
+
+    # column widths stretch to content (reference layout)
+    w_layer = max([15] + [len(r["key"]) for r in records])
+    w_in = max([20] + [len(str(r["input_shape"])) for r in records])
+    w_out = max([20] + [len(str(r["output_shape"])) for r in records])
+    w_par = max([15] + [len(f"{r['nb_params']:,}") for r in records])
+    w_table = w_layer + w_in + w_out + w_par + 5
+
+    lines = ["-" * w_table,
+             f"{'Layer (type)':^{w_layer}} {'Input Shape':^{w_in}} "
+             f"{'Output Shape':^{w_out}} {'Param #':^{w_par}}",
+             "=" * w_table]
+    total_output_elems = 0
+    for r in records:
+        lines.append(
+            f"{r['key']:^{w_layer}} {str(r['input_shape']):^{w_in}} "
+            f"{str(r['output_shape']):^{w_out}} "
+            f"{'{:,}'.format(r['nb_params']):^{w_par}}")
+        total_output_elems += _numel(r["output_shape"])
+
+    # totals from parameters() directly — NOT from the hook records, which
+    # miss root-level params and double-count weight-shared layers
+    total_params = trainable_params = 0
+    seen = set()
+    for p in model.parameters():
+        if id(p) in seen:
+            continue
+        seen.add(id(p))
+        total_params += int(p.size)
+        if getattr(p, "trainable", True) and \
+                not getattr(p, "stop_gradient", False):
+            trainable_params += int(p.size)
+
+    input_elems = sum(int(np.prod(x.shape)) for x in xs)
+    input_mb = input_elems * 4.0 / (1024 ** 2)
+    # x2: forward activations + their gradients (reference convention)
+    output_mb = 2.0 * total_output_elems * 4.0 / (1024 ** 2)
+    params_mb = total_params * 4.0 / (1024 ** 2)
+
+    lines += ["=" * w_table,
+              f"Total params: {total_params:,}",
+              f"Trainable params: {trainable_params:,}",
+              f"Non-trainable params: {total_params - trainable_params:,}",
+              "-" * w_table,
+              f"Input size (MB): {input_mb:.2f}",
+              f"Forward/backward pass size (MB): {output_mb:.2f}",
+              f"Params size (MB): {params_mb:.2f}",
+              f"Estimated Total Size (MB): "
+              f"{input_mb + output_mb + params_mb:.2f}",
+              "-" * w_table]
+    info = {"total_params": int(total_params),
+            "trainable_params": int(trainable_params),
+            "records": records}
+    return "\n".join(lines) + "\n", info
+
 
 def summary(net, input_size=None, dtypes=None, input=None):
-    rows = []
-    total_params = 0
-    trainable_params = 0
-    for name, layer in net.named_sublayers(include_self=False):
-        n_params = sum(p.size for p in layer._parameters.values() if p is not None)
-        total_params_layer = n_params
-        rows.append((name or layer.__class__.__name__, layer.__class__.__name__, total_params_layer))
-    for p in net.parameters():
-        total_params += p.size
-        if getattr(p, "trainable", True):
-            trainable_params += p.size
-    print("-" * 64)
-    print(f"{'Layer':<30}{'Type':<22}{'Params':>10}")
-    print("=" * 64)
-    for name, typ, n in rows:
-        print(f"{name:<30}{typ:<22}{n:>10,}")
-    print("=" * 64)
-    print(f"Total params: {total_params:,}")
-    print(f"Trainable params: {trainable_params:,}")
-    print(f"Non-trainable params: {total_params - trainable_params:,}")
-    print("-" * 64)
-    return {"total_params": total_params, "trainable_params": trainable_params}
+    """Print the per-layer summary table; returns
+    {'total_params', 'trainable_params'} (reference return contract)."""
+    text, info = summary_string(net, input_size, dtypes=dtypes, input=input)
+    print(text)
+    return {"total_params": info["total_params"],
+            "trainable_params": info["trainable_params"]}

@@ -1,0 +1,174 @@
+"""hapi summary/flops full parity (reference hapi/model_summary.py —
+hook-driven per-layer shapes, trainable split, memory footer — and
+hapi/dynamic_flops.py per-layer FLOPs)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.hapi.model_summary import summary_string
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(0)
+
+
+def _lenet():
+    from paddle_tpu.vision.models import LeNet
+
+    return LeNet()
+
+
+class TestSummaryTable:
+    def test_lenet_per_layer_shapes(self, capsys):
+        info = paddle.summary(_lenet(), (1, 1, 28, 28))
+        out = capsys.readouterr().out
+        # column-for-column comparable to the reference table
+        assert "Layer (type)" in out and "Input Shape" in out \
+            and "Output Shape" in out and "Param #" in out
+        assert "Conv2D-1" in out and "[1, 6, 28, 28]" in out
+        assert "MaxPool2D-3" in out and "[1, 6, 14, 14]" in out
+        assert "Linear-7" in out and "[1, 400]" in out and "[1, 120]" in out
+        assert "Total params: 61,610" in out
+        assert "Trainable params: 61,610" in out
+        assert "Non-trainable params: 0" in out
+        # memory estimate footer
+        assert "Input size (MB):" in out
+        assert "Forward/backward pass size (MB):" in out
+        assert "Params size (MB): 0.24" in out
+        assert "Estimated Total Size (MB):" in out
+        assert info == {"total_params": 61610, "trainable_params": 61610}
+
+    def test_gpt_per_layer_shapes(self, capsys):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=32, dropout=0.0)
+        info = paddle.summary(GPTForCausalLM(cfg), (1, 16), dtypes="int32")
+        out = capsys.readouterr().out
+        assert "Embedding-1" in out or "Embedding" in out
+        assert "[1, 16, 64]" in out          # hidden stream shape
+        assert "[1, 16, 192]" in out         # fused qkv projection
+        assert "GPTAttention" in out         # nested custom layers appear
+        assert info["total_params"] == info["trainable_params"] > 0
+
+    def test_batch_dim_none_becomes_one(self):
+        _, info = summary_string(_lenet(), (None, 1, 28, 28))
+        assert info["records"][0]["input_shape"] == [1, 1, 28, 28]
+        with pytest.raises(ValueError, match="batch"):
+            summary_string(_lenet(), (None, None, 28, 28))
+
+    def test_input_tensor_instead_of_size(self):
+        x = paddle.to_tensor(np.zeros((2, 1, 28, 28), np.float32))
+        _, info = summary_string(_lenet(), input=x)
+        assert info["records"][0]["input_shape"] == [2, 1, 28, 28]
+        assert info["total_params"] == 61610
+
+    def test_trainable_split(self, capsys):
+        net = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        for p in net[0].parameters():
+            p.stop_gradient = True
+        info = paddle.summary(net, (1, 4))
+        out = capsys.readouterr().out
+        assert info["total_params"] == 4 * 8 + 8 + 8 * 2 + 2
+        assert info["trainable_params"] == 8 * 2 + 2
+        assert "Non-trainable params: 40" in out
+
+    def test_training_mode_restored(self):
+        net = _lenet()
+        net.train()
+        summary_string(net, (1, 1, 28, 28))
+        assert net.training
+        net.eval()
+        summary_string(net, (1, 1, 28, 28))
+        assert not net.training
+
+    def test_root_level_params_counted(self):
+        class WithRootParam(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.w = self.create_parameter([7, 7])
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.fc(x) + self.w.sum()
+
+        _, info = summary_string(WithRootParam(), (1, 4))
+        assert info["total_params"] == 7 * 7 + 4 * 4 + 4
+
+    def test_weight_shared_layer_not_double_counted(self):
+        class Shared(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.fc(self.fc(x))
+
+        _, info = summary_string(Shared(), (1, 4))
+        assert info["total_params"] == 4 * 4 + 4
+        # the layer still appears twice in the execution table
+        assert [r["key"] for r in info["records"]] \
+            == ["Linear-1", "Linear-2"]
+
+    def test_model_summary_falls_back_to_input_specs(self, capsys):
+        from paddle_tpu.static import InputSpec
+
+        m = paddle.Model(nn.Linear(4, 2),
+                         inputs=[InputSpec([None, 4], "float32")])
+        info = m.summary()
+        capsys.readouterr()
+        assert info["total_params"] == 4 * 2 + 2
+
+    def test_multi_input(self):
+        class TwoIn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a = nn.Linear(3, 4)
+                self.b = nn.Linear(5, 4)
+
+            def forward(self, x, y):
+                return self.a(x) + self.b(y)
+
+        _, info = summary_string(TwoIn(), [(1, 3), (1, 5)])
+        keys = [r["key"] for r in info["records"]]
+        assert keys == ["Linear-1", "Linear-2"]
+
+
+class TestFlops:
+    def test_lenet_flops_exact(self):
+        # conv: 2 * prod(w) * out_hw * batch; linear: 2 * batch * prod(w)
+        expect = (2 * (6 * 1 * 3 * 3) * 28 * 28
+                  + 2 * (16 * 6 * 5 * 5) * 10 * 10
+                  + 2 * (400 * 120 + 120 * 84 + 84 * 10))
+        assert paddle.flops(_lenet(), (1, 1, 28, 28)) == expect
+
+    def test_gpt_flops_counts_attention(self):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=32, dropout=0.0)
+        b, s, h = 1, 16, 64
+        linears_per_block = 2 * b * s * (h * 3 * h + h * h
+                                         + h * 4 * h + 4 * h * h)
+        attn_per_block = 4 * b * s * s * h
+        assert paddle.flops(GPTForCausalLM(cfg), (b, s)) \
+            == 2 * (linears_per_block + attn_per_block)  # 2 blocks
+
+    def test_print_detail_table(self, capsys):
+        total = paddle.flops(_lenet(), (1, 1, 28, 28), print_detail=True)
+        out = capsys.readouterr().out
+        assert "FLOPs" in out and f"Total FLOPs: {total:,}" in out
+        assert "Conv2D-1" in out
+
+    def test_custom_ops_override(self):
+        class Odd(nn.Layer):
+            def forward(self, x):
+                return x * 2
+
+        net = nn.Sequential(nn.Linear(4, 4), Odd())
+        base = paddle.flops(net, (1, 4))
+        with_custom = paddle.flops(
+            net, (1, 4), custom_ops={Odd: lambda l, i, o: 1000})
+        assert with_custom == base + 1000
