@@ -1,0 +1,99 @@
+"""Python side of the C TRAINING API (native/capi.cc PD_CreateTrainer /
+PD_TrainStepFloat / PD_TrainerSave).
+
+Reference parity: paddle/fluid/train/demo/demo_trainer.cc — a standalone
+C/C++ host that loads a Python-authored model and runs real training steps
+without any Python source of its own. TPU-native shape: the host drives a
+jitted SpmdTrainer step through the embedded interpreter; parameters and
+optimizer state live DEVICE-SIDE between calls (only the scalar loss
+crosses the C boundary per step), so the hot path is one cached XLA
+executable per (shape, dtype) signature.
+"""
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def create_trainer(model_prefix, optimizer_name, learning_rate, loss_name):
+    """Load the jit.save'd trainable Layer at model_prefix and wrap it in a
+    single-device SpmdTrainer with the named optimizer and loss."""
+    import jax
+
+    import paddle_tpu as paddle
+    from ..distributed.mesh import build_mesh
+    from ..distributed.spmd import SpmdTrainer
+    from .. import nn
+
+    with open(model_prefix + ".pdmodel", "rb") as f:
+        layer = pickle.load(f)
+    if layer is None:
+        raise ValueError(
+            "PD_CreateTrainer needs the pickled-Layer artifact (the "
+            "jax.export inference artifact is not trainable); re-save "
+            "with jit.save on a picklable Layer")
+    if os.path.exists(model_prefix + ".pdiparams"):
+        with open(model_prefix + ".pdiparams", "rb") as f:
+            layer.set_state_dict(pickle.load(f))
+    layer.train()
+
+    opts = {
+        "sgd": lambda: paddle.optimizer.SGD(
+            learning_rate=learning_rate, parameters=layer.parameters()),
+        "momentum": lambda: paddle.optimizer.Momentum(
+            learning_rate=learning_rate, momentum=0.9,
+            parameters=layer.parameters()),
+        "adam": lambda: paddle.optimizer.Adam(
+            learning_rate=learning_rate, parameters=layer.parameters()),
+        "adamw": lambda: paddle.optimizer.AdamW(
+            learning_rate=learning_rate, parameters=layer.parameters()),
+    }
+    if optimizer_name not in opts:
+        raise ValueError(f"unknown optimizer '{optimizer_name}' "
+                         f"(supported: {sorted(opts)})")
+    losses = {
+        "cross_entropy": nn.CrossEntropyLoss,
+        "mse": nn.MSELoss,
+    }
+    if loss_name not in losses:
+        raise ValueError(f"unknown loss '{loss_name}' "
+                         f"(supported: {sorted(losses)})")
+
+    mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+    trainer = SpmdTrainer(layer, opts[optimizer_name](),
+                          loss_fn=losses[loss_name](), mesh=mesh)
+    return trainer
+
+
+def train_step_bytes(trainer, x_buf, x_shape, y_buf, y_shape, y_is_float):
+    """One jitted train step on raw C buffers; returns the scalar loss.
+    x is float32; y is int64 labels (classification) or float32 targets
+    (y_is_float, e.g. mse)."""
+    x = np.frombuffer(x_buf, np.float32).reshape([int(s) for s in x_shape])
+    ydt = np.float32 if y_is_float else np.int64
+    y = np.frombuffer(y_buf, ydt).reshape([int(s) for s in y_shape])
+    loss = trainer.train_step(Tensor(x), Tensor(y))
+    return float(np.asarray(loss._data))
+
+
+def save_params(trainer, prefix):
+    """Persist the trained parameters in the jit.save fallback format, so
+    PD_CreatePredictor / jit.load serve the trained model from `prefix`
+    (the pickled .pdmodel must already exist there or be copied)."""
+    trainer.sync_to_layer()   # device-side train state -> Layer tensors
+    state = {n: np.asarray(t._data)
+             for n, t in trainer.layer.state_dict().items()}
+    os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
+    with open(prefix + ".pdiparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+    # a stale durable artifact at this prefix would shadow the trained
+    # params (jit.load prefers .pdmodel.jaxexport + .pdiparams.npz, which
+    # still hold the UNtrained weights) — same hygiene as jit.save
+    for stale in (".pdmodel.jaxexport", ".pdiparams.npz"):
+        try:
+            os.remove(prefix + stale)
+        except FileNotFoundError:
+            pass
+    return prefix

@@ -48,6 +48,56 @@ struct Predictor {
   PyObject* obj;  // paddle_tpu TranslatedLayer / Predictor callable
 };
 
+struct Trainer {
+  PyObject* obj;     // paddle_tpu SpmdTrainer (params held device-side)
+  double last_loss;
+};
+
+// Call a function in the given bridge module; returns new reference or null
+// (error recorded). Steals nothing.
+PyObject* call_bridge(const char* module, const char* fn, PyObject* args) {
+  PyObject* mod = PyImport_ImportModule(module);
+  if (!mod) {
+    set_error("import bridge failed");
+    return nullptr;
+  }
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (!f) {
+    set_error("bridge function missing");
+    return nullptr;
+  }
+  PyObject* res = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  if (!res) set_error(fn);
+  return res;
+}
+
+// Validate a shape and return the element count, or -1.
+int64_t checked_numel(const int64_t* shape, int ndim) {
+  if (ndim <= 0 || ndim > 16) return -1;
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) {
+    if (shape[i] <= 0 || n > (int64_t{1} << 40) / (shape[i] + 1)) return -1;
+    n *= shape[i];
+  }
+  return n;
+}
+
+PyObject* shape_list(const int64_t* shape, int ndim) {
+  PyObject* shp = PyList_New(ndim);
+  if (!shp) return nullptr;
+  for (int i = 0; i < ndim; ++i) {
+    PyObject* v = PyLong_FromLongLong(shape[i]);
+    if (!v) {
+      Py_DECREF(shp);
+      return nullptr;
+    }
+    PyList_SET_ITEM(shp, i, v);
+  }
+  return shp;
+}
+
 }  // namespace
 
 extern "C" {
@@ -118,46 +168,19 @@ int64_t PD_PredictorRunFloat(void* h, const float* data, const int64_t* shape,
   int64_t n_out = -1;
 
   do {
-    if (ndim <= 0 || ndim > 16) {
-      g_last_error = "invalid ndim";
-      break;
-    }
-    int64_t n_in = 1;
-    bool bad = false;
-    for (int i = 0; i < ndim; ++i) {
-      if (shape[i] <= 0 || n_in > (int64_t{1} << 40) / (shape[i] + 1)) {
-        bad = true;
-        break;
-      }
-      n_in *= shape[i];
-    }
-    if (bad) {
+    int64_t n_in = checked_numel(shape, ndim);
+    if (n_in < 0) {
       g_last_error = "invalid shape (non-positive or overflowing dims)";
       break;
     }
     // marshal via bytes (no per-element boxing; bridge uses np.frombuffer)
     PyObject* buf = PyBytes_FromStringAndSize(
         reinterpret_cast<const char*>(data), n_in * sizeof(float));
-    PyObject* shp = buf ? PyList_New(ndim) : nullptr;
+    PyObject* shp = buf ? shape_list(shape, ndim) : nullptr;
     if (!buf || !shp) {
       set_error("allocation failed");
       Py_XDECREF(buf);
       Py_XDECREF(shp);
-      break;
-    }
-    bool shp_ok = true;
-    for (int i = 0; i < ndim; ++i) {
-      PyObject* v = PyLong_FromLongLong(shape[i]);
-      if (!v) {
-        shp_ok = false;
-        break;
-      }
-      PyList_SET_ITEM(shp, i, v);
-    }
-    if (!shp_ok) {
-      set_error("allocation failed");
-      Py_DECREF(buf);
-      Py_DECREF(shp);
       break;
     }
     PyObject* helper = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
@@ -205,6 +228,136 @@ int64_t PD_PredictorRunFloat(void* h, const float* data, const int64_t* shape,
 
   PyGILState_Release(gil);
   return n_out;
+}
+
+// ---- training (reference paddle/fluid/train/demo/demo_trainer.cc) --------
+//
+// A standalone C host trains a Python-authored, jit.save'd model: params +
+// optimizer state stay device-side inside the SpmdTrainer between calls;
+// each PD_TrainStepFloat runs ONE cached jitted fwd+bwd+update step and
+// returns only the scalar loss over the C boundary.
+
+void* PD_CreateTrainer(const char* model_prefix, const char* optimizer,
+                       double learning_rate, const char* loss) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  void* result = nullptr;
+  PyObject* args = Py_BuildValue("(ssds)", model_prefix, optimizer,
+                                 learning_rate, loss);
+  if (!args) {
+    set_error("allocation failed");
+  } else {
+    PyObject* t = call_bridge("paddle_tpu.inference.capi_train_bridge",
+                              "create_trainer", args);
+    Py_DECREF(args);
+    if (t) result = new Trainer{t, 0.0};
+  }
+  PyGILState_Release(gil);
+  return result;
+}
+
+void PD_DestroyTrainer(void* h) {
+  if (!h) return;
+  Trainer* t = static_cast<Trainer*>(h);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(t->obj);
+  PyGILState_Release(gil);
+  delete t;
+}
+
+// One train step: x float32, y int64 labels (or float32 targets when
+// y_is_float != 0, e.g. mse). Returns 0 and stores the loss (PD_GetLoss),
+// or -1 (PD_GetLastError).
+int PD_TrainStepFloat(void* h, const float* x, const int64_t* x_shape,
+                      int x_ndim, const void* y, const int64_t* y_shape,
+                      int y_ndim, int y_is_float) {
+  if (!h) {
+    g_last_error = "null trainer";
+    return -1;
+  }
+  Trainer* t = static_cast<Trainer*>(h);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+
+  do {
+    int64_t nx = checked_numel(x_shape, x_ndim);
+    int64_t ny = checked_numel(y_shape, y_ndim);
+    if (nx < 0 || ny < 0) {
+      g_last_error = "invalid shape (non-positive or overflowing dims)";
+      break;
+    }
+    PyObject* xb = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(x), nx * sizeof(float));
+    PyObject* yb = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(y),
+        ny * (y_is_float ? sizeof(float) : sizeof(int64_t)));
+    PyObject* xs = shape_list(x_shape, x_ndim);
+    PyObject* ys = shape_list(y_shape, y_ndim);
+    if (!xb || !yb || !xs || !ys) {
+      set_error("allocation failed");
+      Py_XDECREF(xb);
+      Py_XDECREF(yb);
+      Py_XDECREF(xs);
+      Py_XDECREF(ys);
+      break;
+    }
+    PyObject* args = PyTuple_Pack(6, t->obj, xb, xs, yb, ys,
+                                  y_is_float ? Py_True : Py_False);
+    Py_DECREF(xb);
+    Py_DECREF(yb);
+    Py_DECREF(xs);
+    Py_DECREF(ys);
+    if (!args) {
+      set_error("allocation failed");
+      break;
+    }
+    PyObject* res = call_bridge("paddle_tpu.inference.capi_train_bridge",
+                                "train_step_bytes", args);
+    Py_DECREF(args);
+    if (!res) break;
+    double loss = PyFloat_AsDouble(res);
+    Py_DECREF(res);
+    if (PyErr_Occurred()) {
+      // keep last_loss at the most recent SUCCESSFUL step's value
+      set_error("non-scalar loss");
+      break;
+    }
+    t->last_loss = loss;
+    rc = 0;
+  } while (false);
+
+  PyGILState_Release(gil);
+  return rc;
+}
+
+double PD_GetLoss(void* h) {
+  if (!h) return 0.0;
+  return static_cast<Trainer*>(h)->last_loss;
+}
+
+// Persist the trained parameters at `prefix` (jit.save fallback format —
+// PD_CreatePredictor/jit.load then serve the trained weights). 0 = ok.
+int PD_TrainerSave(void* h, const char* prefix) {
+  if (!h) {
+    g_last_error = "null trainer";
+    return -1;
+  }
+  Trainer* t = static_cast<Trainer*>(h);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* args = Py_BuildValue("(Os)", t->obj, prefix);
+  if (!args) {
+    set_error("allocation failed");
+  } else {
+    PyObject* res = call_bridge("paddle_tpu.inference.capi_train_bridge",
+                                "save_params", args);
+    Py_DECREF(args);
+    if (res) {
+      rc = 0;
+      Py_DECREF(res);
+    }
+  }
+  PyGILState_Release(gil);
+  return rc;
 }
 
 }  // extern "C"

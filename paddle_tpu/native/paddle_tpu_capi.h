@@ -29,6 +29,28 @@ int64_t PD_PredictorRunFloat(void* predictor, const float* data,
 
 const char* PD_GetLastError(void);
 
+/* ---- training (reference paddle/fluid/train/demo/demo_trainer.cc) ----
+ * Load a jit.save'd trainable Layer and train it from pure C: params and
+ * optimizer state stay device-side between calls; each step runs one
+ * cached jitted fwd+bwd+update and returns only the scalar loss.
+ * optimizer: "sgd" | "momentum" | "adam" | "adamw";
+ * loss: "cross_entropy" | "mse". NULL on failure (see PD_GetLastError). */
+void* PD_CreateTrainer(const char* model_prefix, const char* optimizer,
+                       double learning_rate, const char* loss);
+void PD_DestroyTrainer(void* trainer);
+
+/* One train step: x float32; y int64 labels, or float32 targets when
+ * y_is_float != 0 (mse). Returns 0 (loss via PD_GetLoss) or -1. */
+int PD_TrainStepFloat(void* trainer, const float* x, const int64_t* x_shape,
+                      int x_ndim, const void* y, const int64_t* y_shape,
+                      int y_ndim, int y_is_float);
+
+/* Loss of the most recent successful PD_TrainStepFloat. */
+double PD_GetLoss(void* trainer);
+
+/* Persist trained params at prefix (servable via PD_CreatePredictor). */
+int PD_TrainerSave(void* trainer, const char* prefix);
+
 #ifdef __cplusplus
 }
 #endif
