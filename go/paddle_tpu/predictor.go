@@ -4,6 +4,7 @@
 // Build: the shared library must be built first (see
 // paddle_tpu/native/paddle_tpu_capi.h), then:
 //
+//	CGO_CFLAGS="-I<repo>/paddle_tpu/native" \
 //	CGO_LDFLAGS="-L<path> -lpaddle_tpu_capi $(python3-config --embed --ldflags)" go build
 //
 // NOTE: no Go toolchain ships in the framework CI image, so this client is

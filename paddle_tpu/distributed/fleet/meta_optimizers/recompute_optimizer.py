@@ -21,4 +21,15 @@ class RecomputeOptimizer(MetaOptimizerBase):
             named = [c for c in cfg.checkpoints if c in _REMAT_POLICIES]
             if named:
                 trainer_kwargs["recompute_policy"] = named[0]
+            else:
+                import warnings
+
+                # a reference-style tensor-name list would otherwise be
+                # silently dropped (full remat, no signal)
+                warnings.warn(
+                    "recompute_configs.checkpoints entries "
+                    f"{list(cfg.checkpoints)!r} name no known remat policy "
+                    f"({sorted(_REMAT_POLICIES)}); reference-style tensor "
+                    "names are not supported — falling back to full "
+                    "rematerialization")
         return trainer_kwargs, optimizer

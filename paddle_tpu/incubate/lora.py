@@ -145,9 +145,20 @@ def apply_lora(layer, r=8, alpha=None, dropout=0.0, target_modules=None,
             f"no nn.Linear sublayer matched target_modules={target_modules}")
     # first-seen wins: a second apply_lora (disjoint target_modules) must not
     # overwrite the original snapshot with the post-freeze_rest state, or
-    # merge_lora would permanently freeze unrelated params
+    # merge_lora would permanently freeze unrelated params. Params living
+    # under a PREVIOUS apply_lora's wrappers are excluded by wrapper
+    # MEMBERSHIP (not name patterns — a user module legitimately named
+    # 'base' must stay in the snapshot): their '.base.'/'lora_*' names are
+    # dead keys once merge restores the pre-wrap name shape.
+    wrapped_prefixes = [qual for qual, sub in layer.named_sublayers()
+                        if isinstance(sub, LoRALinear)]
+
+    def _under_wrapper(name):
+        return any(name.startswith(p + ".") for p in wrapped_prefixes)
+
     prev_trainable = {n: getattr(p, "trainable", True)
-                      for n, p in layer.named_parameters()}
+                      for n, p in layer.named_parameters()
+                      if not _under_wrapper(n)}
     prev_trainable.update(layer.__dict__.get("_lora_prev_trainable", {}))
     wrappers = {}  # id(base Linear) -> its single shared LoRALinear
     for parent, key, _ in sites:

@@ -125,8 +125,9 @@ class GPTConfig:
         # serving memory bound) shrinks by num_heads/num_kv_heads. Default
         # = num_heads (plain MHA, the packed qkv layout unchanged).
         num_kv_heads = num_kv_heads if num_kv_heads is not None else num_heads
-        if isinstance(num_kv_heads, bool) or not (
-                1 <= num_kv_heads <= num_heads) or                 num_heads % num_kv_heads != 0:
+        if (isinstance(num_kv_heads, bool)
+                or not (1 <= num_kv_heads <= num_heads)
+                or num_heads % num_kv_heads != 0):
             raise ValueError(
                 f"num_kv_heads ({num_kv_heads!r}) must divide num_heads "
                 f"({num_heads}) and lie in [1, num_heads]")
@@ -970,7 +971,12 @@ def _gpt_speculative(model, draft_model, input_ids, max_new_tokens, k=4,
                  # model of a different architecture)
                  d_cfg.num_layers, d_cfg.hidden_size, d_cfg.num_heads,
                  getattr(d_cfg, "num_kv_heads", d_cfg.num_heads),
-                 d_cfg.vocab_size, d_cfg.max_seq_len, eos_token_id,
+                 d_cfg.vocab_size, d_cfg.max_seq_len,
+                 # the jitted closure also bakes the draft's attention
+                 # window and gelu flavor — a second draft sharing the
+                 # dims but differing here must NOT reuse the program
+                 getattr(d_cfg, "attention_window", None),
+                 getattr(d_cfg, "gelu_approx", False), eos_token_id,
                  ("tp", tp_mesh) if tp_mesh is not None else None)
     store = model.__dict__.setdefault("_generate_compiled", {})
     if cache_key not in store:

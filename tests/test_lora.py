@@ -302,3 +302,30 @@ class TestLoRATensorParallel:
         assert merge_lora(model) == 2
         np.testing.assert_allclose(np.asarray(model(ids)._data), y,
                                    atol=1e-4, rtol=1e-4)
+
+
+class TestUserModuleNamedBase:
+    """Snapshot exclusion must key on wrapper MEMBERSHIP, not the '.base.'
+    name pattern: a user submodule legitimately named 'base' has to survive
+    a second apply_lora + merge with its trainable state restored."""
+
+    def test_second_apply_restores_module_named_base(self):
+        from paddle_tpu.incubate.lora import apply_lora, merge_lora
+
+        class Enc(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.base = nn.Linear(4, 4)
+                self.q = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.q(self.base(x))
+
+        paddle.seed(0)
+        m = Enc()
+        apply_lora(m, r=2, target_modules=["q"])
+        assert not m.base.weight.trainable        # frozen by freeze_rest
+        apply_lora(m, r=2, target_modules=["base"])
+        merge_lora(m)
+        assert m.base.weight.trainable
+        assert m.q.weight.trainable
