@@ -658,7 +658,10 @@ def _host_gather(v):
     except RuntimeError:
         from jax.experimental import multihost_utils
 
-        return np.asarray(multihost_utils.process_allgather(v))
+        # tiled=True: a global array sharded across processes assembles
+        # into its global shape (non-tiled gather of non-fully-addressable
+        # arrays is rejected by jax); fully-replicated arrays pass through
+        return np.asarray(multihost_utils.process_allgather(v, tiled=True))
 
 
 def _validate_state_keys(what, got, expected):

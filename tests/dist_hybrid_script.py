@@ -1,0 +1,89 @@
+"""Worker for the 4-process HYBRID harness (test_dist_multiproc.py):
+dp ACROSS processes x mp WITHIN each process — the multi-controller
+topology a real pod runs (each host owns a tensor-parallel group slice,
+data parallelism spans hosts).
+
+Each of the 4 processes brings 2 virtual CPU devices (XLA_FLAGS from the
+test); jax.distributed stitches them into one 8-device mesh (dp=4, mp=2)
+where a process's two local devices form its mp pair. Mid-run the FULL
+train state is gathered (trainer.state_dict() — a cross-group collect of
+ZeRO-sharded params + Adam moments) and restored into a FRESH trainer;
+the loss trajectory must continue unperturbed and match a single-process
+8-device control run.
+
+Reference parity: scales test_dist_base.py's 2-trainer pattern
+(python/paddle/fluid/tests/unittests/test_dist_base.py:671) to the
+4-process hybrid the reference runs via fleetrun on real clusters.
+"""
+import argparse
+import json
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--save_at", type=int, default=3,
+                    help="gather+restore the train state before this step")
+    args = ap.parse_args()
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import env as denv
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.distributed.split import collect_spmd_specs
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM, GPTPretrainLoss
+
+    denv.init_distributed()
+    rank = denv.get_rank()
+    n_devices = len(jax.devices())
+    assert n_devices == 8, n_devices
+    mesh = build_mesh((4, 2), ("dp", "mp"))   # mp pair = one process
+
+    def make_trainer():
+        paddle.seed(0)
+        cfg = GPTConfig.tiny()
+        cfg.tensor_parallel = True            # Column/RowParallel over 'mp'
+        model = GPTForCausalLM(cfg)
+        loss_layer = GPTPretrainLoss()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        trainer = SpmdTrainer(
+            model, opt,
+            loss_fn=lambda logits, labels: loss_layer(logits, labels),
+            mesh=mesh, dp_axis="dp", sharding_stage=2,
+            extra_param_specs=collect_spmd_specs(model))
+        return cfg, trainer
+
+    cfg, trainer = make_trainer()
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, cfg.vocab_size, (8, 32)).astype(np.int32)
+    labels = rng.randint(0, cfg.vocab_size, (8, 32)).astype(np.int32)
+
+    losses = []
+    for step in range(args.steps):
+        if step == args.save_at:
+            # cross-group gather of the FULL sharded train state, restored
+            # into a brand-new trainer — the trajectory must not notice
+            state = trainer.state_dict()
+            _, trainer = make_trainer()
+            trainer.set_state_dict(state)
+        loss = trainer.train_step(paddle.to_tensor(ids),
+                                  paddle.to_tensor(labels))
+        losses.append(float(np.asarray(loss._data)))
+
+    if rank == 0:
+        with open(args.out, "w") as f:
+            json.dump({"world": denv.get_world_size(),
+                       "n_devices": n_devices, "losses": losses}, f)
+    print(f"rank {rank} done: {losses[-1]:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -22,13 +22,15 @@ def _free_port():
     return port
 
 
-def _run_fleet(tmp_path, nproc, steps=5, timeout=420):
-    out = str(tmp_path / f"losses_{nproc}.json")
-    script = os.path.join(os.path.dirname(__file__), "dist_dp_script.py")
+def _run_fleet(tmp_path, nproc, steps=5, timeout=420,
+               script_name="dist_dp_script.py", devices_per_proc=1):
+    out = str(tmp_path / f"losses_{script_name}_{nproc}.json")
+    script = os.path.join(os.path.dirname(__file__), script_name)
     env = dict(
         os.environ,
         PYTHONPATH=os.getcwd(),
-        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        XLA_FLAGS=f"--xla_force_host_platform_device_count="
+                  f"{devices_per_proc}",
         JAX_PLATFORMS="cpu",
     )
     env.pop("PADDLE_TRAINER_ID", None)
@@ -56,3 +58,26 @@ class TestMultiProcessDP:
                                    rtol=1e-4, atol=1e-6)
         # and training actually progressed
         assert two["losses"][-1] < two["losses"][0]
+
+
+@pytest.mark.slow
+class TestFourProcessHybrid:
+    """VERDICT r3 #5: 4 processes x 2 CPU devices each — dp ACROSS
+    processes x mp WITHIN (the multi-controller topology of a real pod) —
+    with a mid-run cross-group checkpoint gather/restore. Loss-parity vs
+    one process owning all 8 devices."""
+
+    def test_hybrid_dp_mp_matches_single_process(self, tmp_path):
+        multi = _run_fleet(tmp_path, nproc=4,
+                           script_name="dist_hybrid_script.py",
+                           devices_per_proc=2, timeout=900)
+        single = _run_fleet(tmp_path, nproc=1,
+                            script_name="dist_hybrid_script.py",
+                            devices_per_proc=8, timeout=900)
+        assert multi["world"] == 4 and multi["n_devices"] == 8
+        assert single["world"] == 1 and single["n_devices"] == 8
+        # bit-for-bit same global program; the mid-run state_dict()
+        # gather + fresh-trainer restore (step 3) must not perturb it
+        np.testing.assert_allclose(multi["losses"], single["losses"],
+                                   rtol=1e-4, atol=1e-6)
+        assert multi["losses"][-1] < multi["losses"][0]
