@@ -98,8 +98,38 @@ class NativeSparseTable:
             self._destroy(h)
             self._h = None
 
+    _q = None   # int8 serving store: (sorted ids, int8 codes, f32 scales)
+
+    def quantize(self):
+        """Freeze into int8 serving form (lookup_table_dequant parity —
+        same contract as SparseTable.quantize): rows exported from the C++
+        engine into an int8-codes + per-row-absmax store; pulls dequantize,
+        pushes are refused."""
+        ids = np.sort(self.keys())
+        rows = self.get_rows(ids)
+        scales = np.max(np.abs(rows), axis=1)
+        scales[scales == 0.0] = 1.0
+        codes = np.clip(np.rint(rows / scales[:, None] * 127.0),
+                        -127, 127).astype(np.int8)
+        self._q = (ids, codes, scales.astype(np.float32))
+
+    @property
+    def quantized(self):
+        return self._q is not None
+
     def pull(self, ids):
         ids = np.ascontiguousarray(np.asarray(ids, np.int64).ravel())
+        if self._q is not None:
+            q_ids, codes, scales = self._q
+            idx = np.searchsorted(q_ids, ids)
+            idx_c = np.clip(idx, 0, max(len(q_ids) - 1, 0))
+            hit = (len(q_ids) > 0) & (q_ids[idx_c] == ids)
+            out = np.zeros((len(ids), self.dim), np.float32)
+            if np.any(hit):
+                sel = idx_c[hit]
+                out[hit] = codes[sel].astype(np.float32) \
+                    * (scales[sel, None] / 127.0)
+            return out
         out = np.empty((len(ids), self.dim), np.float32)
         self._lib.pst_pull(self._h, _i64p(ids), len(ids), _f32p(out))
         return out
@@ -112,6 +142,10 @@ class NativeSparseTable:
         return out
 
     def push(self, ids, grads):
+        if self._q is not None:
+            raise RuntimeError(
+                "NativeSparseTable is quantized (int8 serving mode) — "
+                "pushes are not accepted")
         ids = np.ascontiguousarray(np.asarray(ids, np.int64).ravel())
         grads = np.ascontiguousarray(
             np.asarray(grads, np.float32).reshape(len(ids), self.dim))

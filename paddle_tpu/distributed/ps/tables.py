@@ -125,6 +125,9 @@ class SparseTable:
         # admission policy (CountFilterEntry / ProbabilityEntry); None admits all
         self._entry = entry
         self._seen = {}
+        # int8 serving mode (lookup_table_dequant parity): rows stored as
+        # (int8 codes, f32 absmax scale), dequantized on pull
+        self._qrows = None
 
     def _init_row(self, rid):
         if self._initializer == "zeros":
@@ -141,6 +144,30 @@ class SparseTable:
         self._seen[rid] = self._seen.get(rid, 0) + 1
         return self._entry.admit(self._seen[rid], self._rng)
 
+    def quantize(self):
+        """Freeze the table into int8 serving form (lookup_table_dequant
+        parity, operators/lookup_table_dequant_op: the deployed table keeps
+        int8 rows ~4x smaller; lookups dequantize on the fly). Per-row
+        absmax scale; the f32 rows are dropped and the table becomes
+        serve-only — push() raises, matching the inference-side op."""
+        with self._lock:
+            self._qrows = {}
+            for rid, row in self._rows.items():
+                scale = float(np.max(np.abs(row))) or 1.0
+                codes = np.clip(np.rint(row / scale * 127.0),
+                                -127, 127).astype(np.int8)
+                self._qrows[rid] = (codes, np.float32(scale))
+            self._rows = {}
+            self._slots = {}
+
+    @property
+    def quantized(self):
+        return self._qrows is not None
+
+    def _dequant(self, rid):
+        codes, scale = self._qrows[rid]
+        return codes.astype(np.float32) * (scale / 127.0)
+
     def pull(self, ids):
         ids = np.asarray(ids, np.int64).ravel()
         zero = np.zeros(self.dim, np.float32)
@@ -148,7 +175,11 @@ class SparseTable:
             out = []
             for i in ids:
                 rid = int(i)
-                if rid in self._rows:
+                if self._qrows is not None:
+                    # int8 serving mode: dequantize; unknown keys read zero
+                    out.append(self._dequant(rid) if rid in self._qrows
+                               else zero)
+                elif rid in self._rows:
                     out.append(self._rows[rid])
                 elif self._admitted(rid):
                     out.append(self._init_row(rid))
@@ -156,10 +187,18 @@ class SparseTable:
                     out.append(zero)  # filtered keys read as zeros until admitted
             return np.stack(out)
 
+    def _refuse_if_quantized(self):
+        # call with self._lock HELD: the check must not race quantize()
+        if self._qrows is not None:
+            raise RuntimeError(
+                "SparseTable is quantized (int8 serving mode) — pushes are "
+                "not accepted; re-deploy an f32 table to keep training")
+
     def push(self, ids, grads):
         ids = np.asarray(ids, np.int64).ravel()
         grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
         with self._lock:
+            self._refuse_if_quantized()
             # duplicate ids in one batch accumulate (reference merges by id)
             order = np.argsort(ids, kind="stable")
             uniq, starts = np.unique(ids[order], return_index=True)
@@ -174,7 +213,8 @@ class SparseTable:
 
     def size(self):
         with self._lock:
-            return len(self._rows)
+            return len(self._qrows if self._qrows is not None
+                       else self._rows)
 
 
 class GeoSparseTable(SparseTable):
@@ -191,6 +231,7 @@ class GeoSparseTable(SparseTable):
         ids = np.asarray(ids, np.int64).ravel()
         deltas = np.asarray(deltas, np.float32).reshape(len(ids), self.dim)
         with self._lock:
+            self._refuse_if_quantized()   # serve-only table: no geo writes
             for rid, d in zip(ids, deltas):
                 rid = int(rid)
                 if rid not in self._rows:

@@ -1,0 +1,185 @@
+"""Tests backing the op-coverage N/A claims for the reference's fused
+kernels (VERDICT r3 #7): `conv2d_fusion`, `conv2d_inception_fusion`, and
+`multi_gru` exist in the reference because CUDA needs hand-written fused
+kernels; on this architecture XLA performs the fusion. These tests compile
+the equivalent subgraphs and assert, on the optimized HLO, that the
+elementwise epilogues really are fused (no standalone add/maximum/tanh
+instructions in the ENTRY computation — they live inside fusion bodies).
+"""
+import re
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _entry_block(hlo_text):
+    """The ENTRY computation's instruction lines (fusion bodies excluded)."""
+    m = re.search(r"^ENTRY [^{]*\{(.*?)^\}", hlo_text,
+                  re.DOTALL | re.MULTILINE)
+    assert m, "no ENTRY computation in HLO"
+    return m.group(1)
+
+
+def _unfused_ops(entry, op_names):
+    hits = []
+    for line in entry.splitlines():
+        for op in op_names:
+            # instruction form: "%name = f32[...] add(...)"
+            if re.search(rf"= [a-z0-9\[\],{{}}]+ {op}\(", line.strip()):
+                hits.append(line.strip())
+    return hits
+
+
+def _compiled_text(layer, *args):
+    from paddle_tpu.static.io import layer_pure_fn
+
+    params = {n: np.asarray(t._data) for n, t in layer.state_dict().items()}
+    pure = layer_pure_fn(layer, force_eval=True)
+    return jax.jit(pure).lower(params, *args).compile().as_text()
+
+
+class TestConv2dFusion:
+    def test_conv_bias_relu_epilogue_is_fused(self):
+        """conv2d_fusion = conv + bias + activation in one kernel
+        (operators/fused/conv2d_fusion_op). XLA fuses the epilogue."""
+        paddle.seed(0)
+        net = nn.Sequential(nn.Conv2D(8, 16, 3, padding=1), nn.ReLU())
+        txt = _compiled_text(net, np.zeros((1, 8, 16, 16), np.float32))
+        assert txt.count("fusion(") > 0
+        entry = _entry_block(txt)
+        assert _unfused_ops(entry, ["add", "maximum"]) == []
+
+
+class TestConv2dInceptionFusion:
+    def test_inception_branches_one_program(self):
+        """conv2d_inception_fusion = the 4-branch inception block as one
+        kernel. Compiled here as ONE XLA program: branch epilogues fused,
+        concat stitches device-side (no per-branch round trips)."""
+
+        class Inception(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.b1 = nn.Conv2D(8, 8, 1)
+                self.b3 = nn.Conv2D(8, 8, 3, padding=1)
+                self.b5 = nn.Conv2D(8, 8, 5, padding=2)
+                self.proj = nn.Conv2D(8, 8, 1)
+                self.pool = nn.MaxPool2D(3, stride=1, padding=1)
+                self.act = nn.ReLU()
+
+            def forward(self, x):
+                outs = [self.act(self.b1(x)), self.act(self.b3(x)),
+                        self.act(self.b5(x)), self.act(self.proj(self.pool(x)))]
+                return paddle.concat(outs, axis=1)
+
+        paddle.seed(0)
+        txt = _compiled_text(Inception(), np.zeros((1, 8, 12, 12),
+                                                   np.float32))
+        assert txt.count("fusion(") > 0
+        entry = _entry_block(txt)
+        # every branch's bias-add + relu epilogue is fused away
+        assert _unfused_ops(entry, ["add", "maximum"]) == []
+        # and the whole block compiled to a single executable containing
+        # the concatenate (present somewhere, possibly inside a fusion)
+        assert "concatenate" in txt
+
+
+class TestMultiGRUFusion:
+    def test_stacked_gru_gates_fused(self):
+        """multi_gru = fused stacked-GRU inference kernel (oneDNN). Here
+        the 2-layer GRU compiles to one program whose per-step gate math
+        (matmul epilogues: add/sigmoid/tanh/mul) is XLA-fused inside the
+        scan body."""
+        paddle.seed(0)
+        net = nn.GRU(input_size=16, hidden_size=16, num_layers=2)
+        x = np.zeros((2, 8, 16), np.float32)
+        txt = _compiled_text(net, x)
+        assert txt.count("fusion(") > 0
+        entry = _entry_block(txt)
+        # the gate elementwise chain must not execute as standalone
+        # ENTRY-level instructions
+        assert _unfused_ops(entry, ["tanh", "logistic", "multiply"]) == []
+
+
+class TestSparseTableInt8Serving:
+    def test_lookup_table_dequant_roundtrip(self):
+        """lookup_table_dequant parity (operators/lookup_table_dequant_op):
+        the PS sparse table freezes to int8 rows + per-row absmax scale,
+        pulls dequantize on the fly (~4x smaller serving table)."""
+        from paddle_tpu.distributed.ps.tables import SparseTable
+
+        t = SparseTable(dim=8, seed=0)
+        ids = np.arange(32, dtype=np.int64)
+        dense = t.pull(ids)                   # materialize rows
+        assert t.size() == 32 and not t.quantized
+
+        t.quantize()
+        assert t.quantized and t.size() == 32
+        got = t.pull(ids)
+        # absmax int8: max error is scale/127 per element
+        scales = np.max(np.abs(dense), axis=1, keepdims=True)
+        assert np.all(np.abs(got - dense) <= scales / 127.0 + 1e-8)
+        # storage really is int8 codes
+        codes, scale = t._qrows[0]
+        assert codes.dtype == np.int8
+        # unknown keys read zeros; training pushes are refused
+        assert np.allclose(t.pull([999]), 0.0)
+        with pytest.raises(RuntimeError, match="quantized"):
+            t.push(ids[:2], np.ones((2, 8), np.float32))
+
+
+class TestNativeTableInt8Serving:
+    def test_native_table_quantize_matches_contract(self):
+        """The preferred native (C++) backend keeps the same quantize()
+        contract — table.quantize() must not depend on which backend
+        make_sparse_table picked."""
+        from paddle_tpu.distributed.ps import native_table as nt
+
+        try:
+            t = nt.NativeSparseTable(dim=8, seed=0)
+        except Exception:
+            pytest.skip("native table lib unavailable")
+        ids = np.arange(16, dtype=np.int64)
+        dense = t.pull(ids)
+        t.quantize()
+        assert t.quantized
+        got = t.pull(ids)
+        scales = np.max(np.abs(dense), axis=1, keepdims=True)
+        assert np.all(np.abs(got - dense) <= scales / 127.0 + 1e-8)
+        assert np.allclose(t.pull([12345]), 0.0)   # miss reads zeros
+        with pytest.raises(RuntimeError, match="quantized"):
+            t.push(ids[:2], np.ones((2, 8), np.float32))
+
+
+class TestGeoTableQuantizedGuard:
+    def test_geo_push_delta_refused_when_quantized(self):
+        from paddle_tpu.distributed.ps.tables import GeoSparseTable
+
+        t = GeoSparseTable(dim=4, trainers=2, seed=0)
+        t.pull(np.arange(4))
+        t.quantize()
+        with pytest.raises(RuntimeError, match="quantized"):
+            t.push_delta(0, np.arange(2), np.ones((2, 4), np.float32))
+
+
+class TestGradOpsAutodiffRealized:
+    def test_cross_entropy_grad_via_tape(self):
+        """cross_entropy_grad2 (and every *_grad registration) is realized
+        by the generic tape/vjp autodiff, not per-op grad kernels: the
+        gradient of cross_entropy matches the analytic softmax-minus-onehot
+        form."""
+        paddle.seed(0)
+        logits = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 5).astype(np.float32))
+        logits.stop_gradient = False
+        labels = paddle.to_tensor(np.array([1, 0, 3, 2], np.int64))
+        loss = nn.CrossEntropyLoss()(logits, labels)
+        loss.backward()
+        g = np.asarray(logits.grad._data)
+        p = np.exp(np.asarray(logits._data))
+        p /= p.sum(-1, keepdims=True)
+        onehot = np.eye(5, dtype=np.float32)[np.asarray(labels._data)]
+        np.testing.assert_allclose(g, (p - onehot) / 4, atol=1e-5)

@@ -84,19 +84,42 @@ ALIAS = {  # op name -> our API name
  "adam":"Adam","adamax":"Adamax","adagrad":"Adagrad","rmsprop":"RMSProp","ftrl":"Ftrl","dpsgd":"Dpsgd","lamb":"Lamb",
  "average_accumulates":"ModelAverage","check_finite_and_unscale":"GradScaler","update_loss_scaling":"GradScaler",
  "clip":"clip","clip_by_norm":"clip","hard_sigmoid":"hardsigmoid","hard_swish":"hardswish","hard_shrink":"hardshrink",
+ # int8 serving table: pull() dequantizes (tests/test_xla_fusion_na.py)
+ "lookup_table_dequant":"SparseTable.quantize",
 }
 import paddle_tpu.vision.transforms as VTR
-MODS = [paddle, F, nn, V, T, I, S, D, M, VTR, paddle.optimizer, paddle.amp, paddle.metric, paddle.static.nn]
+import paddle_tpu.distributed.ps.tables as PST
+MODS = [paddle, F, nn, V, T, I, S, D, M, VTR, PST, paddle.optimizer, paddle.amp, paddle.metric, paddle.static.nn]
 def have(n):
     target = ALIAS.get(n, n)
+    # dotted targets resolve attribute chains (e.g. a class method:
+    # "SparseTable.quantize" — the int8 table realizing lookup_table_dequant)
+    def _has(m, tgt):
+        for part in tgt.split("."):
+            if not hasattr(m, part):
+                return False
+            m = getattr(m, part)
+        return True
     # Tensor methods count (e.g. set_value — the reference's set_value op
     # surfaces as Tensor.set_value in 2.x)
-    return any(hasattr(m, target) for m in MODS) or \
+    return any(_has(m, target) for m in MODS) or \
         hasattr(paddle.Tensor, target)
 missing = sorted(n for n in names if not have(n))
 # infra/framework ops that are N/A by design on this architecture
-INFRA = re.compile(r"^(c_|fake_|fused_|fusion_|lookup_sparse_table|pull_|push_|quantize|dequantize|requantize|moving_average_abs_max|send|recv|listen|fetch|feed|load|save|memcpy|delete_var|get_places|enqueue|dequeue|checkpoint|prefetch|gen_nccl|gen_bkcl|nccl|ascend|heter|ref_by_trainer|rank_attention|batch_fc|pyramid_hash|filter_by_instag|tensorrt|lite_engine|run_program|seed|dgc|distributed_|split_byref|split_ids|merge_ids|split_selected_rows|merge_selected_rows|get_tensor_from_selected_rows|beam_search$|read|write_to_array|read_from_array|array_to_lod|lod_|merge_lod|split_lod|reorder_lod|max_sequence_len|shrink_rnn|rnn_memory|select_input|select_output|tensor_array|sparse_tensor_load|coalesce_tensor|share_data|update_loss|mul$|inplace_abn|sequence_)")
-core_missing = [n for n in missing if not INFRA.match(n)]
+INFRA = re.compile(r"^(c_|fake_|fused_|fusion_|lookup_sparse_table|pull_|push_|quantize|dequantize|requantize|moving_average_abs_max|send|recv|listen|fetch|feed|load|save|memcpy|delete_var|get_places|enqueue|dequeue|checkpoint|prefetch|create_custom_reader|gen_nccl|gen_bkcl|nccl|ascend|heter|ref_by_trainer|rank_attention|batch_fc|pyramid_hash|filter_by_instag|tensorrt|lite_engine|run_program|seed|dgc|distributed_|split_byref|split_ids|merge_ids|split_selected_rows|merge_selected_rows|get_tensor_from_selected_rows|beam_search$|read|write_to_array|read_from_array|array_to_lod|lod_|merge_lod|split_lod|reorder_lod|max_sequence_len|shrink_rnn|rnn_memory|select_input|select_output|tensor_array|sparse_tensor_load|coalesce_tensor|share_data|update_loss|mul$|inplace_abn|sequence_)")
+# CUDA hand-fused kernels whose role XLA's own fusion plays — each claim is
+# ASSERTED on optimized HLO by tests/test_xla_fusion_na.py (epilogues fused,
+# no standalone elementwise in ENTRY), not just argued
+FUSED_XLA = {"conv2d_fusion", "conv2d_inception_fusion", "multi_gru"}
+# grad registrations are realized by the generic tape/vjp autodiff (SURVEY
+# layer 4c), not per-op grad kernels. `*_grad` names are already dropped at
+# the scan; cross_entropy2's separately-registered `_grad2` is the one
+# residual that reaches here. Backed by the analytic-gradient check in
+# tests/test_xla_fusion_na.py::TestGradOpsAutodiffRealized.
+GRAD_REALIZED = re.compile(r".*_grad2$")
+core_missing = [n for n in missing
+                if not INFRA.match(n) and n not in FUSED_XLA
+                and not GRAD_REALIZED.match(n)]
 
 if __name__ == "__main__":
     print("reference ops:", len(names), "| unmatched:", len(missing),
