@@ -264,27 +264,46 @@ class EarlyStopping(Callback):
 
 
 class VisualDL(Callback):
-    """VisualDL writer parity — writes scalar logs as TSV (no visualdl dep in image)."""
+    """VisualDL writer parity (hapi/callbacks.py VisualDL): streams train
+    scalars in the standard TF events wire format that BOTH VisualDL and
+    TensorBoard read (utils/tb_writer.py — no visualdl/tensorboard dep in
+    image), plus a human-greppable scalars.tsv alongside."""
 
     def __init__(self, log_dir):
         super().__init__()
         self.log_dir = log_dir
         os.makedirs(log_dir, exist_ok=True)
         self._f = None
+        self._events = None
         self._step = 0
 
     def on_train_begin(self, logs=None):
+        from ..utils.tb_writer import EventFileWriter
+
         self._f = open(os.path.join(self.log_dir, "scalars.tsv"), "a")
+        self._events = EventFileWriter(os.path.join(self.log_dir, "train"))
 
     def on_train_batch_end(self, step, logs=None):
         self._step += 1
         for k, v in (logs or {}).items():
             if isinstance(v, numbers.Number):
                 self._f.write(f"{self._step}\t{k}\t{v}\n")
+                self._events.add_scalar(f"train/{k}", float(v), self._step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._events:
+            self._events.flush()
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            if isinstance(v, numbers.Number) and self._events:
+                self._events.add_scalar(f"eval/{k}", float(v), self._step)
 
     def on_train_end(self, logs=None):
         if self._f:
             self._f.close()
+        if self._events:
+            self._events.close()
 
 
 class ReduceLROnPlateau(Callback):

@@ -1,34 +1,120 @@
-"""Progress bar (python/paddle/hapi/progressbar.py parity, simplified terminal output)."""
+"""Progress bar (python/paddle/hapi/progressbar.py parity): the reference's
+keras-style training display — `step  3/10 [=====>....]` bar with metric
+values, adaptive s/ms/us-per-step rate, ETA, terminal-width clamp, and the
+three verbosity modes (1 = in-place dynamic bar, 2/3 = one line per update,
+0 = silent). Unknown totals (num=None) print one line per update even at
+verbose=1 — the reference's own behavior (no in-place bar without a
+total)."""
+import os
+import shutil
 import sys
 import time
 
+import numpy as np
+
+
+def _fmt_value(v):
+    if isinstance(v, (float, np.floating)):
+        return f" {v:.4f}" if abs(v) > 1e-3 else f" {v:.4e}"
+    if isinstance(v, np.ndarray) and v.size == 1 and \
+            np.issubdtype(v.dtype, np.floating):
+        x = float(v.reshape(()))
+        return f" {x:.4f}" if abs(x) > 1e-3 else f" {x:.4e}"
+    return f" {v}"
+
+
+def _fmt_values(values):
+    info = ""
+    for k, val in (values or []):
+        info += f" - {k}:"
+        for v in (val if isinstance(val, list) else [val]):
+            info += _fmt_value(v)
+    return info
+
+
+def _fmt_eta(eta):
+    if eta > 3600:
+        return f"{int(eta // 3600)}:{int(eta % 3600 // 60):02d}:" \
+               f"{int(eta % 60):02d}"
+    if eta > 60:
+        return f"{int(eta // 60)}:{int(eta % 60):02d}"
+    return f"{int(eta)}s"
+
+
+def _fmt_rate(time_per_unit):
+    if time_per_unit >= 1 or time_per_unit == 0:
+        return f" - {time_per_unit:.0f}s/step"
+    if time_per_unit >= 1e-3:
+        return f" - {time_per_unit * 1e3:.0f}ms/step"
+    return f" - {time_per_unit * 1e6:.0f}us/step"
+
 
 class ProgressBar:
-    def __init__(self, num=None, width=30, verbose=1, start=True, file=sys.stdout):
+    def __init__(self, num=None, width=30, verbose=1, start=True,
+                 file=sys.stdout):
+        if isinstance(num, int) and num <= 0:
+            raise TypeError("num should be None or a positive integer")
         self._num = num
-        self._width = width
         self._verbose = verbose
         self._file = file
+        # clamp the bar to the terminal so counter + metrics fit on one
+        # line — but only when actually writing to the controlling
+        # terminal; explicit files keep the requested width (deterministic
+        # output regardless of the ambient COLUMNS)
+        if file in (sys.stdout, sys.stderr):
+            term_w = shutil.get_terminal_size((80, 24)).columns or 80
+            width = min(width, max(int(term_w * 0.6), 10),
+                        term_w - 50 if term_w > 60 else width)
+        self._width = width
+        self._total_width = 0
         self._start = time.time()
-        self._last_update = 0
+        self._dynamic = (hasattr(file, "isatty") and file.isatty()) \
+            or "PYCHARM_HOSTED" in os.environ or "ipykernel" in sys.modules
+
+    def start(self):
+        self._file.flush()
+        self._start = time.time()
+
+    def _bar(self, current_num):
+        if self._num is None:
+            return f"step {current_num:3d}"
+        digits = len(str(self._num))
+        head = f"step {current_num:{digits}d}/{self._num} ["
+        frac = min(float(current_num) / self._num, 1.0)
+        filled = int(self._width * frac)
+        body = ""
+        if filled > 0:
+            body += "=" * (filled - 1)
+            body += "=" if current_num >= self._num else ">"
+        body += "." * (self._width - filled)
+        return head + body + "]"
 
     def update(self, current_num, values=None):
         if self._verbose == 0:
             return
         now = time.time()
-        metrics = " - ".join(
-            f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
-            for k, v in (values or [])
-        )
-        if self._num:
-            msg = f"step {current_num}/{self._num} - {metrics}"
-        else:
-            msg = f"step {current_num} - {metrics}"
+        time_per_unit = (now - self._start) / current_num if current_num \
+            else 0
+        info = _fmt_values(values)
+
         if self._verbose == 1:
-            self._file.write("\r" + msg)
-            if self._num and current_num >= self._num:
+            prev_width = self._total_width
+            if self._dynamic:
+                self._file.write("\r")
+            else:
                 self._file.write("\n")
-        elif self._verbose == 2 and (self._num is None or current_num >= self._num or now - self._last_update > 10):
-            self._file.write(msg + "\n")
-        self._last_update = now
+            line = self._bar(current_num) + info
+            if self._num is not None and current_num < self._num:
+                line += " - ETA: " \
+                    + _fmt_eta(time_per_unit * (self._num - current_num))
+            line += _fmt_rate(time_per_unit)
+            self._total_width = len(line)
+            if prev_width > self._total_width:   # erase the longer old line
+                line += " " * (prev_width - self._total_width)
+            if self._num is None or current_num >= self._num:
+                line += "\n"
+            self._file.write(line)
+        else:   # verbose 2/3: one full line per update
+            self._file.write(self._bar(current_num).split(" [")[0] + info
+                             + _fmt_rate(time_per_unit) + "\n")
         self._file.flush()

@@ -1,0 +1,130 @@
+"""hapi observability surfaces: the reference-style progress bar
+(hapi/progressbar.py) and the TF-events scalar writer behind the VisualDL
+callback (utils/tb_writer.py — standard wire format, crc-checked)."""
+import glob
+import io
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.hapi.progressbar import ProgressBar
+from paddle_tpu.utils import tb_writer
+
+
+class TestProgressBar:
+    def _render(self, num, updates, verbose=1, elapsed=2.0):
+        buf = io.StringIO()
+        buf.isatty = lambda: True
+        pb = ProgressBar(num=num, verbose=verbose, file=buf)
+        pb._start = time.time() - elapsed
+        for step, values in updates:
+            pb.update(step, values)
+        return buf.getvalue()
+
+    def test_bar_eta_rate_and_values(self):
+        out = self._render(10, [(3, [("loss", 0.1234), ("acc", 5e-4)])])
+        assert "step  3/10 [" in out          # digit-padded counter
+        assert "==>" in out and "....." in out
+        assert "loss: 0.1234" in out
+        assert "acc: 5.0000e-04" in out       # small values in sci form
+        assert "ETA:" in out and "ms/step" in out
+
+    def test_completion_fills_bar_and_newlines(self):
+        out = self._render(4, [(4, [("loss", 1.0)])])
+        assert "[" + "=" * 30 + "]" in out
+        assert "ETA" not in out and out.endswith("\n")
+
+    def test_unknown_total_verbose2(self):
+        buf = io.StringIO()
+        pb = ProgressBar(num=None, verbose=2, file=buf)
+        pb.update(7, [("loss", 1.5)])
+        assert "step   7" in buf.getvalue()
+        assert "loss: 1.5000" in buf.getvalue()
+
+    def test_verbose_zero_silent(self):
+        out = self._render(10, [(5, [("loss", 1.0)])], verbose=0)
+        assert out == ""
+
+    def test_rejects_nonpositive_num(self):
+        import pytest
+
+        with pytest.raises(TypeError):
+            ProgressBar(num=0)
+
+
+class TestTBWriter:
+    def test_crc32c_known_vector(self):
+        # the standard Castagnoli check value
+        assert tb_writer.crc32c(b"123456789") == 0xE3069283
+
+    def test_roundtrip_scalars(self, tmp_path):
+        w = tb_writer.EventFileWriter(str(tmp_path))
+        w.add_scalar("train/loss", 0.5, 1)
+        w.add_scalar("train/loss", 0.25, 2)
+        w.add_scalar("eval/acc", 0.9, 2)
+        w.close()
+        (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+        scalars = tb_writer.read_scalars(path)
+        assert (1, "train/loss", np.float32(0.5)) in scalars
+        assert (2, "train/loss", np.float32(0.25)) in scalars
+        assert (2, "eval/acc", np.float32(0.9)) in scalars
+
+    def test_torn_tail_returns_prefix(self, tmp_path):
+        w = tb_writer.EventFileWriter(str(tmp_path))
+        w.add_scalar("a", 1.0, 1)
+        w.add_scalar("b", 2.0, 2)
+        w.close()
+        (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+        raw = open(path, "rb").read()
+        open(path, "wb").write(raw[:-7])     # kill mid-final-record
+        scalars = tb_writer.read_scalars(path)
+        assert (1, "a", np.float32(1.0)) in scalars
+        assert all(tag != "b" for _, tag, _ in scalars)
+
+    def test_two_writers_same_second_distinct_files(self, tmp_path):
+        w1 = tb_writer.EventFileWriter(str(tmp_path))
+        w2 = tb_writer.EventFileWriter(str(tmp_path))
+        w1.close(); w2.close()
+        assert len(glob.glob(str(tmp_path / "events.out.tfevents.*"))) == 2
+
+    def test_corruption_detected(self, tmp_path):
+        import pytest
+
+        w = tb_writer.EventFileWriter(str(tmp_path))
+        w.add_scalar("t", 1.0, 1)
+        w.close()
+        (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+        raw = bytearray(open(path, "rb").read())
+        raw[-6] ^= 0xFF                      # flip a payload byte
+        open(path, "wb").write(bytes(raw))
+        with pytest.raises(ValueError, match="crc"):
+            tb_writer.read_scalars(path)
+
+
+class TestVisualDLCallback:
+    def test_fit_writes_events_and_tsv(self, tmp_path):
+        from paddle_tpu import nn
+        from paddle_tpu.hapi.callbacks import VisualDL
+
+        paddle.seed(0)
+        model = paddle.Model(nn.Sequential(nn.Flatten(), nn.Linear(4, 2)))
+        model.prepare(paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=model.network.parameters()),
+            nn.CrossEntropyLoss())
+        x = np.random.RandomState(0).rand(16, 4).astype(np.float32)
+        y = np.random.RandomState(1).randint(0, 2, (16, 1)).astype(np.int64)
+
+        class DS:
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return x[i], y[i]
+
+        model.fit(DS(), epochs=1, batch_size=8, verbose=0,
+                  callbacks=[VisualDL(str(tmp_path))])
+        assert (tmp_path / "scalars.tsv").exists()
+        (path,) = glob.glob(str(tmp_path / "train" / "events.out.*"))
+        scalars = tb_writer.read_scalars(path)
+        assert any(tag == "train/loss" for _, tag, _ in scalars)
