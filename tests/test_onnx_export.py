@@ -132,6 +132,35 @@ class TestGPTExport:
         assert len(model["graph"]["outputs"]) == 2
 
 
+class TestWiderModelCoverage:
+    def test_bert_multi_input_multi_output(self, tmp_path):
+        from paddle_tpu.models import BertConfig, BertModel
+
+        cfg = BertConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                         num_heads=4, intermediate_size=128,
+                         max_position=64, dropout=0.0)
+        p = paddle.onnx.export(BertModel(cfg), str(tmp_path / "bert"),
+                               input_spec=[InputSpec([1, 16], "int32"),
+                                           InputSpec([1, 16], "int32")])
+        model = proto.parse_model(open(p, "rb").read())
+        assert len(model["graph"]["inputs"]) == 2
+        assert len(model["graph"]["outputs"]) == 2   # sequence + pooled
+        assert model["graph"]["outputs"][0]["shape"] == [1, 16, 64]
+
+    def test_mobilenetv2_group_convs(self, tmp_path):
+        from paddle_tpu.vision.models import mobilenet_v2
+
+        p = paddle.onnx.export(mobilenet_v2(num_classes=10),
+                               str(tmp_path / "mb2"),
+                               input_spec=[InputSpec([1, 3, 32, 32],
+                                                     "float32")])
+        model = proto.parse_model(open(p, "rb").read())
+        groups = [n["attrs"].get("group", 1)
+                  for n in model["graph"]["nodes"]
+                  if n["op_type"] == "Conv"]
+        assert max(groups) > 1   # the depthwise convs kept their groups
+
+
 class TestFailureContract:
     def test_unsupported_primitive_raises_and_writes_no_onnx(self, tmp_path):
         class Sorts(nn.Layer):
