@@ -549,6 +549,16 @@ def main():
 
     import jax
 
+    try:
+        # persistent compilation cache: a probe session that compiled these
+        # programs makes the driver's later bench run skip straight to
+        # measurement — shrinking the window a tunnel wedge can hit
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/paddle_tpu_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:
+        print(f"  compilation cache unavailable ({e})", file=sys.stderr)
+
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     if not on_tpu:
         watchdog.cancel()

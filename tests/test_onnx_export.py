@@ -3,7 +3,7 @@
 The exporter traces the layer to a jaxpr, lowers to ONNX opset-13 ops,
 hand-emits the protobuf wire format, then parses the file back and
 re-executes it in pure numpy against the layer's own output (1e-5).
-These tests drive that pipeline over the three flagship families and the
+These tests drive that pipeline over the flagship model families and the
 failure contract (unsupported primitive -> loud error, no .onnx written).
 """
 import os
