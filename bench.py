@@ -474,6 +474,23 @@ def _decode_mbu(cfg, batch, tps, prompt, new_tokens, cache_dtype=None,
     return tps * bytes_per_token / hbm_bw
 
 
+def enable_tpu_compile_cache():
+    """Persistent compilation cache (ONE place for the dir + policy — also
+    used by tools/pipeline_memory.py and tools/profile_gpt.py): a probe
+    session that compiled these programs makes the driver's later bench
+    run skip straight to measurement, shrinking the window a tunnel wedge
+    can hit. Call only on TPU: CPU AOT cache hits can trip host
+    machine-feature mismatches (the loader warns about SIGILL)."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/paddle_tpu_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:
+        print(f"  compilation cache unavailable ({e})", file=sys.stderr)
+
+
 def _arm_watchdog(seconds=900):
     """If the TPU tunnel is wedged (device init / compile hangs), don't hang
     until the driver's kill: if ANY measurement already completed, re-emit
@@ -549,17 +566,9 @@ def main():
 
     import jax
 
-    try:
-        # persistent compilation cache: a probe session that compiled these
-        # programs makes the driver's later bench run skip straight to
-        # measurement — shrinking the window a tunnel wedge can hit
-        jax.config.update("jax_compilation_cache_dir",
-                          "/tmp/paddle_tpu_jax_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception as e:
-        print(f"  compilation cache unavailable ({e})", file=sys.stderr)
-
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    if on_tpu:
+        enable_tpu_compile_cache()
     if not on_tpu:
         watchdog.cancel()
         watchdog = None
@@ -719,16 +728,24 @@ def main():
             line["extra"] = extra
         _emit(line)
         return
-    # batch 16 was the r1 sweet spot at seq 1024 (batch 32 exceeded 16G HBM);
-    # the r2 flash-attention retune cut attention HBM traffic, so when no
-    # explicit --batch is given on TPU, a quick 2-config probe (6 steps each)
-    # picks between 16 and 24 before the full 20-step measurement.
+    # batch 16 was the r1 sweet spot at seq 1024; the r2 flash retune cut
+    # attention HBM traffic, so when no explicit --batch is given on TPU a
+    # quick probe (6 steps each) picks among 16/24/32 before the full
+    # 20-step measurement.
     batch = args.batch or (16 if on_tpu else 2)
     seq = args.seq or (1024 if on_tpu else 128)
 
     if on_tpu and args.batch is None and not args.sweep:
+        if watchdog is not None:
+            # fresh window sized for THREE cold compiles (the canary's
+            # re-arm doesn't run under --no-micro; don't let the probes
+            # eat the init window on a healthy device)
+            watchdog.cancel()
+            watchdog = _arm_watchdog(1500)
         probes = {}
-        for b in (16, 24):
+        # 32 exceeded 16G HBM in r1 PRE-flash; the flash retune freed the
+        # attention HBM, so it may fit now — OOM fails fast and is caught
+        for b in (16, 24, 32):
             try:
                 probes[b], _ = run_config(b, seq, 6, window=args.window)
             except Exception as e:

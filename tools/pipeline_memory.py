@@ -68,6 +68,10 @@ def main():
     import jax
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    if on_tpu:
+        import bench
+
+        bench.enable_tpu_compile_cache()
     devices = jax.devices()
     pp = args.layers if len(devices) >= args.layers else max(
         d for d in (4, 2, 1) if len(devices) >= d)

@@ -46,6 +46,9 @@ def main():
     import jax.numpy as jnp
 
     import bench
+
+    if jax.devices()[0].platform in ("tpu", "axon"):
+        bench.enable_tpu_compile_cache()
     import paddle_tpu as paddle
     from paddle_tpu.core.generator import default_generator
 
