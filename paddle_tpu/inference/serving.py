@@ -1,0 +1,230 @@
+"""Continuous-batching serving engine (beyond the reference).
+
+The reference serves LMs request-at-a-time through its predictor; modern
+LLM serving interleaves requests so a long generation never blocks a short
+one. This engine is that recipe, TPU-shaped:
+
+- a FIXED [max_batch, max_seq] KV cache (static shapes — one compiled
+  decode program, ever);
+- each slot carries its own sequence position: the decode step runs the
+  whole batch with PER-ROW positions and per-row cache columns
+  (models/gpt.py _decode_fns grew a vectorized-pos path for this);
+- admission prefills a new prompt into a fresh single-row cache (prompt
+  right-padded to a length bucket, so prefill compiles once per bucket)
+  and copies that row into the big cache — one row copy per admission,
+  nothing per step;
+- right-pad junk in the prefill is never read: it sits at columns the
+  causal mask hides until the decode loop OVERWRITES them (the store runs
+  before attention each step);
+- finished slots (eos / max_new_tokens / capacity) free immediately and
+  the next queued request takes the slot on the following step() —
+  continuous batching, not static batching.
+
+Greedy decoding (exact parity with `model.generate(temperature=0)` per
+request, asserted in tests). Composes with bf16 serving params/cache
+(dtype="bfloat16") and the int8 KV cache (cache_dtype="int8").
+"""
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["ServingEngine", "Request"]
+
+
+class Request:
+    """One submitted prompt and, when finished, its generated tokens."""
+
+    def __init__(self, rid, prompt_ids, max_new_tokens):
+        self.rid = rid
+        self.prompt_ids = np.asarray(prompt_ids, np.int32).ravel()
+        self.max_new_tokens = int(max_new_tokens)
+        self.output_ids = []          # generated tokens (no prompt echo)
+        self.finished = False
+        self.finish_reason = None     # "eos" | "length" | "capacity"
+
+    @property
+    def tokens(self):
+        return np.asarray(self.output_ids, np.int32)
+
+
+class ServingEngine:
+    def __init__(self, model, max_batch=4, dtype=None, cache_dtype=None,
+                 eos_token_id=None, prompt_buckets=(32, 64, 128, 256, 512,
+                                                    1024)):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.gpt import (_check_decode_config, _decode_fns,
+                                  _decode_compute_dtype, _decode_params)
+
+        cfg = model.cfg
+        _check_decode_config(cfg)
+        self.cfg = cfg
+        self.B = int(max_batch)
+        self.T = cfg.max_seq_len
+        self.eos = eos_token_id
+        self._buckets = tuple(sorted(b for b in prompt_buckets
+                                     if b <= self.T))
+        if not self._buckets:
+            raise ValueError("no prompt bucket fits max_seq_len")
+        untied, untied_bias, params = _decode_params(model, "the model")
+        self._compute_dtype = _decode_compute_dtype(dtype)
+        if self._compute_dtype is not None:
+            params = {k: (v.astype(self._compute_dtype)
+                          if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                      for k, v in params.items()}
+        self._params = params
+        fwd, logits_of, cache_init = _decode_fns(cfg, untied, untied_bias,
+                                                 cache_dtype=cache_dtype)
+        cache_dt = self._compute_dtype or jnp.float32
+
+        self._kc, self._vc = cache_init(self.B, self.T, cache_dt)
+
+        def prefill(p, ids_padded, true_len):
+            """ids_padded [1, Pb] right-padded; returns (kc1, vc1,
+            first_token). Junk beyond true_len is causally invisible and
+            later overwritten by the decode loop."""
+            kc1, vc1 = cache_init(1, self.T, cache_dt)
+            x, kc1, vc1 = fwd(p, ids_padded, 0, kc1, vc1)
+            x_last = jax.lax.dynamic_slice_in_dim(
+                x, true_len - 1, 1, axis=1)[:, 0]
+            logits = logits_of(p, x_last).astype(jnp.float32)
+            return kc1, vc1, jnp.argmax(logits, -1).astype(jnp.int32)[0]
+
+        def admit(big, row, r):
+            """Copy a 1-row cache into row r of the big cache (r traced —
+            one compile covers every slot)."""
+
+            def put(b_leaf, r_leaf):
+                return jax.lax.dynamic_update_slice(
+                    b_leaf, r_leaf, (0, r, 0, 0, 0))
+
+            if isinstance(big, tuple):
+                return (put(big[0], row[0]), put(big[1], row[1]))
+            return put(big, row)
+
+        def step(p, kc, vc, last_toks, pos_vec):
+            """One decode step for ALL slots at their own positions.
+            last_toks [B], pos_vec [B] (the column each slot writes)."""
+            x, kc, vc = fwd(p, last_toks[:, None], pos_vec, kc, vc)
+            logits = logits_of(p, x[:, 0]).astype(jnp.float32)
+            return jnp.argmax(logits, -1).astype(jnp.int32), kc, vc
+
+        # donate the big cache through admit/step: XLA aliases it in place
+        # instead of copying GBs of K/V per token (the loop this engine
+        # exists to make fast); CPU backends that can't donate just warn
+        self._prefill = jax.jit(prefill)
+        self._admit = jax.jit(admit, donate_argnums=(0,))
+        self._step = jax.jit(step, donate_argnums=(1, 2))
+
+        # host-side slot state
+        self._slot_req = [None] * self.B        # Request or None
+        self._pos = np.zeros(self.B, np.int32)  # next write column
+        self._last = np.zeros(self.B, np.int32)
+        self._queue = []
+        self._next_rid = 0
+        self._finished = {}
+
+    # -- API -----------------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens=32):
+        """Queue a prompt; returns the request id."""
+        ids = prompt_ids._data if isinstance(prompt_ids, Tensor) \
+            else np.asarray(prompt_ids)
+        ids = np.asarray(ids, np.int32).ravel()
+        if max_new_tokens < 1:   # generate()'s own validation, mirrored
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if len(ids) == 0:
+            raise ValueError("empty prompt")
+        if len(ids) + 1 > self.T:
+            raise ValueError(
+                f"prompt ({len(ids)}) too long for max_seq_len {self.T}")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(Request(rid, ids, max_new_tokens))
+        return rid
+
+    def _bucket(self, n):
+        for b in self._buckets:
+            if n <= b:
+                return b
+        return self.T
+
+    def _finish(self, slot, reason):
+        req = self._slot_req[slot]
+        req.finished = True
+        req.finish_reason = reason
+        self._finished[req.rid] = req
+        self._slot_req[slot] = None
+
+    def _admit_one(self, slot, req):
+        import jax.numpy as jnp
+
+        n = len(req.prompt_ids)
+        pb = self._bucket(n)
+        padded = np.zeros((1, pb), np.int32)
+        padded[0, :n] = req.prompt_ids
+        kc1, vc1, tok = self._prefill(self._params, jnp.asarray(padded),
+                                      np.int32(n))
+        self._kc = self._admit(self._kc, kc1, slot)
+        self._vc = self._admit(self._vc, vc1, slot)
+        tok = int(tok)
+        self._slot_req[slot] = req
+        self._pos[slot] = n
+        self._last[slot] = tok
+        req.output_ids.append(tok)
+        self._after_emit(slot, req)
+
+    def _after_emit(self, slot, req):
+        if self.eos is not None and req.output_ids[-1] == self.eos:
+            self._finish(slot, "eos")
+        elif len(req.output_ids) >= req.max_new_tokens:
+            self._finish(slot, "length")
+        elif self._pos[slot] >= self.T:   # next write column out of cache
+            self._finish(slot, "capacity")
+
+    def step(self):
+        """Admit queued requests into free slots, then run ONE decode step
+        for every active slot. Returns requests finished this step."""
+        import jax.numpy as jnp
+
+        before = set(self._finished)
+        for slot in range(self.B):
+            # while, not if: a request finishing DURING admission (eos on
+            # its prefill token / max_new_tokens=1) frees the slot for the
+            # next queued request in the same step
+            while self._slot_req[slot] is None and self._queue:
+                self._admit_one(slot, self._queue.pop(0))
+                if self._slot_req[slot] is not None:
+                    break
+
+        active = [s for s in range(self.B) if self._slot_req[s] is not None]
+        if active:
+            # inactive slots ride along harmlessly: their rows are
+            # don't-care (freed) and re-prefilled on admission
+            next_toks, self._kc, self._vc = self._step(
+                self._params, self._kc, self._vc,
+                jnp.asarray(self._last), jnp.asarray(self._pos))
+            next_toks = np.asarray(next_toks)
+            for s in active:
+                self._pos[s] += 1
+                self._last[s] = next_toks[s]
+                req = self._slot_req[s]
+                req.output_ids.append(int(next_toks[s]))
+                self._after_emit(s, req)
+        return [self._finished[r] for r in set(self._finished) - before]
+
+    def has_work(self):
+        return bool(self._queue) or any(r is not None
+                                        for r in self._slot_req)
+
+    def run_until_complete(self, max_steps=100_000):
+        """Drain the queue; returns {rid: Request}."""
+        steps = 0
+        while self.has_work():
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError("serving engine did not converge "
+                                   f"within {max_steps} steps")
+        return dict(self._finished)

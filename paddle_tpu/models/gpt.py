@@ -460,8 +460,18 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
         return (vals, scales), (jnp.zeros_like(vals),
                                 jnp.zeros_like(scales))
 
+    def _row_update(cache_i, val, pos_vec):
+        """Row b of `val` [B, KVh, t, hd] lands at its OWN column
+        pos_vec[b] (continuous-batching serving: slots sit at different
+        sequence positions)."""
+        return jax.vmap(lambda row, v, p_: jax.lax.dynamic_update_slice(
+            row, v, (0, p_, 0)))(cache_i, val, pos_vec)
+
     def _store(c, val, i, pos):
+        per_row = jnp.ndim(pos) == 1
         if not int8_cache:
+            if per_row:
+                return c.at[i].set(_row_update(c[i], val, pos))
             return jax.lax.dynamic_update_slice(c, val[None],
                                                 (i, 0, 0, pos, 0))
         vals, scales = c
@@ -470,6 +480,9 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
                 jnp.float32) / 127.0, 1e-8)
         q = jnp.clip(jnp.round(val.astype(jnp.float32) / s),
                      -127, 127).astype(jnp.int8)
+        if per_row:
+            return (vals.at[i].set(_row_update(vals[i], q, pos)),
+                    scales.at[i].set(_row_update(scales[i], s, pos)))
         return (jax.lax.dynamic_update_slice(vals, q[None], (i, 0, 0, pos, 0)),
                 jax.lax.dynamic_update_slice(scales, s[None],
                                              (i, 0, 0, pos, 0)))
@@ -517,15 +530,18 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
                 flat[..., (Hh + KVh) * hd:].reshape(bb, t, KVh, hd), 1, 2)
         kc = _store(kc, k, i, pos)
         vc = _store(vc, v, i, pos)
-        # causal over cache columns: query row r (column pos+r) sees
-        # cache column c iff c <= pos + r
-        cols = jnp.arange(T)[None, :]
-        rows = pos + jnp.arange(t)[:, None]
-        mask = (cols <= rows)[None]                    # [1, t, T]
+        # causal over cache columns: query row r (column pos+r) sees cache
+        # column c iff c <= pos + r. pos is a scalar (whole batch at one
+        # frontier) or [B] (per-slot frontiers — continuous batching); one
+        # mask construction serves both via a leading 1-or-B dim.
+        pos_b = jnp.atleast_1d(pos)
+        rows = pos_b[:, None, None] + jnp.arange(t)[None, :, None]
+        cols = jnp.arange(T)[None, None, :]
+        mask = cols <= rows                            # [1-or-B, t, T]
         if win is not None:  # sliding window: same band as training
-            mask &= ((rows - cols) < win)[None]
+            mask &= (rows - cols) < win
         if key_valid is not None:
-            self_col = cols[None] == rows[None]        # keep self: no NaN rows
+            self_col = cols == rows                    # keep self: no NaN rows
             mask = mask & (key_valid[:, None, :] | self_col)
         if g == 1:
             att = jnp.einsum("bhtd,bhTd->bhtT", q,
@@ -569,7 +585,12 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
     def fwd(p, tok_ids, pos, kc, vc, key_valid=None, pos_ids=None):
         t = tok_ids.shape[1]
         if pos_ids is None:
-            wpe = jax.lax.dynamic_slice_in_dim(p["gpt.wpe.weight"], pos, t)
+            if jnp.ndim(pos) == 1:   # per-row pos needs per-row pe too
+                pos_ids = pos[:, None] + jnp.arange(t)[None, :]
+                wpe = jnp.take(p["gpt.wpe.weight"], pos_ids, axis=0)
+            else:
+                wpe = jax.lax.dynamic_slice_in_dim(p["gpt.wpe.weight"],
+                                                   pos, t)
         else:
             # ragged rows: per-row position ids (left-padding support)
             wpe = jnp.take(p["gpt.wpe.weight"], pos_ids, axis=0)
