@@ -19,12 +19,14 @@ Other BASELINE.md milestone configs measure standalone via --config:
   --config ppyolo        PP-YOLOE train step imgs/s (+ infer+NMS imgs/s extra)
   --config gpt2m         GPT-2-medium (~350M) train step, tokens/s (BASELINE #4 class)
   --config gpt2s_16k     GPT-2s train step at seq 16384 (flash long-context)
+  --config gpt2s_serve   continuous-batching ServingEngine, aggregate new tok/s
 The default (gpt2s) run also appends an "extra" dict with a quick ResNet-50
 measurement when the chip is healthy (disable with --no-extra).
 
 Usage: python bench.py [--batch B] [--seq S] [--steps N] [--sweep]
-                       [--config gpt2s|resnet50|bert_dp|lenet|gpt2s_decode|ppyolo|gpt2m]
-                       [--no-extra]
+                       [--config gpt2s|resnet50|bert_dp|lenet|gpt2s_decode|
+                                 ppyolo|gpt2m|gpt2s_16k|gpt2s_serve]
+                       [--no-extra] [--no-micro]
 """
 import argparse
 import json
@@ -491,6 +493,56 @@ def enable_tpu_compile_cache():
         print(f"  compilation cache unavailable ({e})", file=sys.stderr)
 
 
+def run_serve(slots, n_requests, quiet=False):
+    """Serving-engine metric: continuous batching over one fixed KV cache
+    (bf16 params/cache, mixed prompt lengths, eos-free greedy), aggregate
+    NEW tokens/s across all requests — the serving dual of gpt2s_decode's
+    static-batch number."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models import GPTForCausalLM
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    cfg = _gpt2s_cfg(on_tpu, 1024 if on_tpu else 256)
+    new_tokens = 128 if on_tpu else 8
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    eng = ServingEngine(model, max_batch=slots,
+                        dtype="bfloat16" if on_tpu else None)
+    rng = np.random.RandomState(0)
+    lens = [int(rng.randint(32, 128)) for _ in range(n_requests)]
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in lens]
+    # warmup: compile EVERY prefill bucket the timed prompts will hit,
+    # plus the decode step, off the clock
+    seen_buckets = set()
+    for p in prompts:
+        b = eng._bucket(len(p))
+        if b not in seen_buckets:
+            seen_buckets.add(b)
+            eng.submit(p, max_new_tokens=2)
+    eng.run_until_complete()
+
+    t0 = time.perf_counter()
+    for p in prompts:
+        eng.submit(p, max_new_tokens=new_tokens)
+    res = eng.run_until_complete()
+    dt = time.perf_counter() - t0
+    # res accumulates across the engine's lifetime: count only the timed
+    # requests (the warmups ran with max_new_tokens=2)
+    total_new = sum(len(res[r].tokens) for r in res
+                    if res[r].max_new_tokens == new_tokens)
+    tps = total_new / dt
+    if not quiet:
+        print(f"  serve slots={slots} reqs={n_requests}: {tps:,.0f} "
+              f"new tok/s aggregate", file=sys.stderr)
+    return tps
+
+
 def _arm_watchdog(seconds=900):
     """If the TPU tunnel is wedged (device init / compile hangs), don't hang
     until the driver's kill: if ANY measurement already completed, re-emit
@@ -550,7 +602,7 @@ def main():
     ap.add_argument("--config", default="gpt2s",
                     choices=["gpt2s", "resnet50", "bert_dp", "lenet",
                              "gpt2s_decode", "ppyolo", "gpt2m",
-                             "gpt2s_16k"])
+                             "gpt2s_16k", "gpt2s_serve"])
     ap.add_argument("--no-extra", action="store_true",
                     help="skip the appended quick ResNet-50 measurement")
     ap.add_argument("--no-micro", action="store_true",
@@ -645,6 +697,17 @@ def main():
                 except Exception as e:
                     print(f"  int8-kv decode failed ({e})", file=sys.stderr)
                     return
+        elif args.config == "gpt2s_serve":
+            slots = args.batch or (8 if on_tpu else 2)
+            n_req = 3 * slots
+            if watchdog is not None:
+                # prefill-bucket compiles + the per-row decode step
+                watchdog.cancel()
+                watchdog = _arm_watchdog(2500)
+            v = run_serve(slots, n_req, quiet=True)
+            metric, unit, base = \
+                "gpt2s_serve_continuous_new_tokens_per_sec_per_chip", \
+                "tokens/s", 1000.0  # same class target as gpt2s_decode
         elif args.config == "gpt2s_16k":
             # long-context single chip: flash attention is what makes 16k
             # fit (VMEM-resident blocks; nothing scales with seq in VMEM)

@@ -49,6 +49,11 @@ if grep -q '"gpt2s_train_tokens_per_sec_per_chip"' /tmp/tpu_bench.json 2>/dev/nu
     > /tmp/tpu_bench_16k.json 2>/tmp/tpu_bench_16k.log
   echo "[tpu_session] 16k exit=$? $(cat /tmp/tpu_bench_16k.json 2>/dev/null)" >&2
 
+  echo "[tpu_session] continuous-batching serve config..." >&2
+  timeout 3500 python bench.py --config gpt2s_serve \
+    > /tmp/tpu_bench_serve.json 2>/tmp/tpu_bench_serve.log
+  echo "[tpu_session] serve exit=$? $(cat /tmp/tpu_bench_serve.json 2>/dev/null)" >&2
+
   echo "[tpu_session] ppyolo config..." >&2
   # two fresh heavy compiles (train step + to_static infer+NMS): give it the
   # same worst-case budget as the main bench so timeout never kills mid-compile
