@@ -20,9 +20,13 @@ one. This engine is that recipe, TPU-shaped:
   the next queued request takes the slot on the following step() —
   continuous batching, not static batching.
 
-Greedy decoding (exact parity with `model.generate(temperature=0)` per
-request, asserted in tests). Composes with bf16 serving params/cache
-(dtype="bfloat16") and the int8 KV cache (cache_dtype="int8").
+Per-request decoding knobs: temperature=0 (default) is greedy with EXACT
+parity vs a solo `model.generate(temperature=0)` (asserted in tests);
+temperature>0 samples from the (optionally top_k-truncated) distribution
+with a deterministic per-request PRNG stream, without disturbing greedy
+neighbors — an all-greedy batch dispatches to a lean argmax-only compiled
+step. Composes with bf16 serving params/cache (dtype="bfloat16") and the
+int8 KV cache (cache_dtype="int8").
 """
 import numpy as np
 
@@ -34,10 +38,14 @@ __all__ = ["ServingEngine", "Request"]
 class Request:
     """One submitted prompt and, when finished, its generated tokens."""
 
-    def __init__(self, rid, prompt_ids, max_new_tokens):
+    def __init__(self, rid, prompt_ids, max_new_tokens, temperature=0.0,
+                 top_k=None, seed=None):
         self.rid = rid
         self.prompt_ids = np.asarray(prompt_ids, np.int32).ravel()
         self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.seed = rid if seed is None else int(seed)
         self.output_ids = []          # generated tokens (no prompt echo)
         self.finished = False
         self.finish_reason = None     # "eos" | "length" | "capacity"
@@ -82,14 +90,13 @@ class ServingEngine:
 
         def prefill(p, ids_padded, true_len):
             """ids_padded [1, Pb] right-padded; returns (kc1, vc1,
-            first_token). Junk beyond true_len is causally invisible and
-            later overwritten by the decode loop."""
+            last_logits [vocab]). Junk beyond true_len is causally
+            invisible and later overwritten by the decode loop."""
             kc1, vc1 = cache_init(1, self.T, cache_dt)
             x, kc1, vc1 = fwd(p, ids_padded, 0, kc1, vc1)
             x_last = jax.lax.dynamic_slice_in_dim(
                 x, true_len - 1, 1, axis=1)[:, 0]
-            logits = logits_of(p, x_last).astype(jnp.float32)
-            return kc1, vc1, jnp.argmax(logits, -1).astype(jnp.int32)[0]
+            return kc1, vc1, logits_of(p, x_last).astype(jnp.float32)[0]
 
         def admit(big, row, r):
             """Copy a 1-row cache into row r of the big cache (r traced —
@@ -103,37 +110,94 @@ class ServingEngine:
                 return (put(big[0], row[0]), put(big[1], row[1]))
             return put(big, row)
 
-        def step(p, kc, vc, last_toks, pos_vec):
-            """One decode step for ALL slots at their own positions.
-            last_toks [B], pos_vec [B] (the column each slot writes)."""
+        vocab = cfg.vocab_size
+
+        def _pick(logits, temps, kvec, seeds, pos_vec):
+            """Per-row pick: temperature 0 = exact greedy (the argmax path
+            is untouched); temperature > 0 samples from the (optionally
+            per-row top-k truncated) distribution with a PRNG key derived
+            from (request seed, position) — deterministic per request,
+            independent across slots."""
+            greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+
+            # per-row top-k cutoff (kvec = vocab means no truncation)
+            srt = jnp.sort(logits, axis=-1)[:, ::-1]
+            cut = jnp.take_along_axis(
+                srt, jnp.clip(kvec - 1, 0, vocab - 1)[:, None], axis=-1)
+            lg = jnp.where(logits < cut, -jnp.inf, logits)
+            safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+
+            def draw(row_logits, seed, p_):
+                key = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.PRNGKey(0), seed), p_)
+                return jax.random.categorical(key, row_logits)
+
+            sampled = jax.vmap(draw)(lg / safe_t, seeds,
+                                     pos_vec).astype(jnp.int32)
+            return jnp.where(temps > 0, sampled, greedy)
+
+        def step_greedy(p, kc, vc, last_toks, pos_vec):
+            """One decode step for ALL slots at their own positions —
+            argmax only (the default workload keeps its lean hot loop:
+            no sort/categorical machinery compiled in)."""
             x, kc, vc = fwd(p, last_toks[:, None], pos_vec, kc, vc)
             logits = logits_of(p, x[:, 0]).astype(jnp.float32)
             return jnp.argmax(logits, -1).astype(jnp.int32), kc, vc
+
+        def step_sample(p, kc, vc, last_toks, pos_vec, temps, kvec, seeds):
+            """Decode step with per-request sampling knobs [B] (used only
+            while at least one active request has temperature > 0)."""
+            x, kc, vc = fwd(p, last_toks[:, None], pos_vec, kc, vc)
+            logits = logits_of(p, x[:, 0]).astype(jnp.float32)
+            return _pick(logits, temps, kvec, seeds, pos_vec), kc, vc
 
         # donate the big cache through admit/step: XLA aliases it in place
         # instead of copying GBs of K/V per token (the loop this engine
         # exists to make fast); CPU backends that can't donate just warn
         self._prefill = jax.jit(prefill)
         self._admit = jax.jit(admit, donate_argnums=(0,))
-        self._step = jax.jit(step, donate_argnums=(1, 2))
+        self._step_greedy = jax.jit(step_greedy, donate_argnums=(1, 2))
+        self._step_sample = jax.jit(step_sample, donate_argnums=(1, 2))
+        # the prefill token goes through the SAME pick as decode steps
+        self._pick1 = jax.jit(lambda lg, t, k, s, p_: _pick(
+            lg[None], t[None], k[None], s[None], p_[None])[0])
 
         # host-side slot state
         self._slot_req = [None] * self.B        # Request or None
         self._pos = np.zeros(self.B, np.int32)  # next write column
         self._last = np.zeros(self.B, np.int32)
+        self._temps = np.zeros(self.B, np.float32)   # 0 = greedy
+        self._topk = np.full(self.B, self.cfg.vocab_size, np.int32)
+        self._seeds = np.zeros(self.B, np.int32)
         self._queue = []
         self._next_rid = 0
         self._finished = {}
 
     # -- API -----------------------------------------------------------------
-    def submit(self, prompt_ids, max_new_tokens=32):
-        """Queue a prompt; returns the request id."""
+    def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
+               top_k=None, seed=None):
+        """Queue a prompt; returns the request id. temperature=0 (default)
+        decodes greedy; temperature>0 samples (optionally top_k-truncated)
+        with a per-request deterministic PRNG stream (seed defaults to the
+        request id)."""
         ids = prompt_ids._data if isinstance(prompt_ids, Tensor) \
             else np.asarray(prompt_ids)
         ids = np.asarray(ids, np.int32).ravel()
         if max_new_tokens < 1:   # generate()'s own validation, mirrored
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if top_k is not None and top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if seed is not None:
+            # fail HERE, not at admission steps later: the PRNG fold takes
+            # an int32 (mask a 64-bit time/hash seed yourself if desired)
+            seed = int(seed)
+            if not -2**31 <= seed < 2**31:
+                raise ValueError(
+                    f"seed must fit int32, got {seed} (mask with "
+                    "& 0x7FFFFFFF for hash/time-derived seeds)")
         if len(ids) == 0:
             raise ValueError("empty prompt")
         if len(ids) + 1 > self.T:
@@ -141,7 +205,9 @@ class ServingEngine:
                 f"prompt ({len(ids)}) too long for max_seq_len {self.T}")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(Request(rid, ids, max_new_tokens))
+        self._queue.append(Request(rid, ids, max_new_tokens,
+                                   temperature=temperature, top_k=top_k,
+                                   seed=seed))
         return rid
 
     def _bucket(self, n):
@@ -164,14 +230,22 @@ class ServingEngine:
         pb = self._bucket(n)
         padded = np.zeros((1, pb), np.int32)
         padded[0, :n] = req.prompt_ids
-        kc1, vc1, tok = self._prefill(self._params, jnp.asarray(padded),
-                                      np.int32(n))
+        kc1, vc1, logits = self._prefill(self._params, jnp.asarray(padded),
+                                         np.int32(n))
         self._kc = self._admit(self._kc, kc1, slot)
         self._vc = self._admit(self._vc, vc1, slot)
-        tok = int(tok)
+        temp = np.float32(req.temperature)
+        topk = np.int32(req.top_k or self.cfg.vocab_size)
+        seed = np.int32(req.seed)
+        # fold value = index of the context's last token (n-1), matching
+        # the decode step's schedule (each emission folds a unique value)
+        tok = int(self._pick1(logits, temp, topk, seed, np.int32(n - 1)))
         self._slot_req[slot] = req
         self._pos[slot] = n
         self._last[slot] = tok
+        self._temps[slot] = temp
+        self._topk[slot] = topk
+        self._seeds[slot] = seed
         req.output_ids.append(tok)
         self._after_emit(slot, req)
 
@@ -201,10 +275,19 @@ class ServingEngine:
         active = [s for s in range(self.B) if self._slot_req[s] is not None]
         if active:
             # inactive slots ride along harmlessly: their rows are
-            # don't-care (freed) and re-prefilled on admission
-            next_toks, self._kc, self._vc = self._step(
-                self._params, self._kc, self._vc,
-                jnp.asarray(self._last), jnp.asarray(self._pos))
+            # don't-care (freed) and re-prefilled on admission. Host-side
+            # dispatch: an all-greedy batch keeps the lean argmax step
+            # (no sort/categorical in its compiled program at all).
+            if any(self._temps[s] > 0 for s in active):
+                next_toks, self._kc, self._vc = self._step_sample(
+                    self._params, self._kc, self._vc,
+                    jnp.asarray(self._last), jnp.asarray(self._pos),
+                    jnp.asarray(self._temps), jnp.asarray(self._topk),
+                    jnp.asarray(self._seeds))
+            else:
+                next_toks, self._kc, self._vc = self._step_greedy(
+                    self._params, self._kc, self._vc,
+                    jnp.asarray(self._last), jnp.asarray(self._pos))
             next_toks = np.asarray(next_toks)
             for s in active:
                 self._pos[s] += 1
