@@ -58,12 +58,13 @@ class Request:
 class ServingEngine:
     def __init__(self, model, max_batch=4, dtype=None, cache_dtype=None,
                  eos_token_id=None, prompt_buckets=(32, 64, 128, 256, 512,
-                                                    1024)):
+                                                    1024), tp_mesh=None):
         import jax
         import jax.numpy as jnp
 
         from ..models.gpt import (_check_decode_config, _decode_fns,
-                                  _decode_compute_dtype, _decode_params)
+                                  _decode_compute_dtype, _decode_params,
+                                  _tp_setup)
 
         cfg = model.cfg
         _check_decode_config(cfg)
@@ -81,12 +82,43 @@ class ServingEngine:
             params = {k: (v.astype(self._compute_dtype)
                           if jnp.issubdtype(v.dtype, jnp.floating) else v)
                       for k, v in params.items()}
+        # tensor-parallel serving: dense checkpoint Megatron-split over an
+        # 'mp' mesh (same recipe as generate(tp_mesh=...)); the engine's
+        # PERSISTENT KV cache lives head-sharded across the mesh
+        tp_axis, tp_size, tp_specs = None, 1, None
+        if tp_mesh is not None:
+            tp_axis, tp_size, params, tp_specs = _tp_setup(tp_mesh, cfg,
+                                                           params)
+        self._tp_mesh = tp_mesh
         self._params = params
         fwd, logits_of, cache_init = _decode_fns(cfg, untied, untied_bias,
-                                                 cache_dtype=cache_dtype)
+                                                 cache_dtype=cache_dtype,
+                                                 tp_axis=tp_axis,
+                                                 tp_size=tp_size)
         cache_dt = self._compute_dtype or jnp.float32
 
-        self._kc, self._vc = cache_init(self.B, self.T, cache_dt)
+        if tp_mesh is None:
+            self._kc, self._vc = cache_init(self.B, self.T, cache_dt)
+        else:
+            # allocate the GLOBAL cache (full KV heads) sharded on the
+            # head axis, DIRECTLY into its sharding (no transient
+            # single-device copy). The global layout comes from the DENSE
+            # cache_init via eval_shape — one source of truth, so a cache
+            # layout change in _decode_fns can't silently diverge here.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            dense_cache_init = _decode_fns(cfg, untied, untied_bias,
+                                           cache_dtype=cache_dtype)[2]
+            tpl = jax.eval_shape(
+                lambda: dense_cache_init(self.B, self.T, cache_dt))
+            cache_spec = P(None, None, "mp", None, None)
+            shard = NamedSharding(tp_mesh, cache_spec)
+            alloc = jax.jit(
+                lambda: jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), tpl),
+                out_shardings=jax.tree_util.tree_map(lambda s: shard, tpl))
+            self._kc, self._vc = alloc()
+            self._cache_spec = cache_spec
 
         def prefill(p, ids_padded, true_len):
             """ids_padded [1, Pb] right-padded; returns (kc1, vc1,
@@ -154,10 +186,29 @@ class ServingEngine:
         # donate the big cache through admit/step: XLA aliases it in place
         # instead of copying GBs of K/V per token (the loop this engine
         # exists to make fast); CPU backends that can't donate just warn
-        self._prefill = jax.jit(prefill)
+        if tp_mesh is None:
+            self._prefill = jax.jit(prefill)
+            self._step_greedy = jax.jit(step_greedy, donate_argnums=(1, 2))
+            self._step_sample = jax.jit(step_sample, donate_argnums=(1, 2))
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            from ..models.gpt import _tp_wrap
+
+            cs = self._cache_spec   # pytree-prefix: covers int8 tuples too
+            self._prefill = _tp_wrap(prefill, tp_mesh, tp_specs, 0,
+                                     (cs, cs, P()),
+                                     in_specs=(tp_specs, P(), P()))
+            self._step_greedy = _tp_wrap(
+                step_greedy, tp_mesh, tp_specs, 0, (P(), cs, cs),
+                in_specs=(tp_specs, cs, cs, P(), P()), donate=(1, 2))
+            self._step_sample = _tp_wrap(
+                step_sample, tp_mesh, tp_specs, 0, (P(), cs, cs),
+                in_specs=(tp_specs, cs, cs, P(), P(), P(), P(), P()),
+                donate=(1, 2))
+        # admit slices only the batch axis: a plain jit partitions it
+        # fine over the head-sharded cache
         self._admit = jax.jit(admit, donate_argnums=(0,))
-        self._step_greedy = jax.jit(step_greedy, donate_argnums=(1, 2))
-        self._step_sample = jax.jit(step_sample, donate_argnums=(1, 2))
         # the prefill token goes through the SAME pick as decode steps
         self._pick1 = jax.jit(lambda lg, t, k, s, p_: _pick(
             lg[None], t[None], k[None], s[None], p_[None])[0])

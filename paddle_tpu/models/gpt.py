@@ -712,9 +712,13 @@ def _tp_setup(tp_mesh, cfg, params):
     return "mp", tp_size, params, specs
 
 
-def _tp_wrap(run, tp_mesh, tp_specs, n_extra_in, out_specs):
-    """jit(shard_map(run)) for TP serving: params sharded per tp_specs,
-    the n_extra_in trailing args and all outputs replicated."""
+def _tp_wrap(run, tp_mesh, tp_specs, n_extra_in, out_specs, in_specs=None,
+             donate=()):
+    """jit(shard_map(run)) for TP serving: params sharded per tp_specs and
+    the n_extra_in trailing args replicated — or fully explicit in_specs
+    (the serving engine passes its head-sharded cache specs); `donate`
+    forwards to jit (in-place cache updates). Owns the shard_map
+    import/check_vma version dance in ONE place."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -722,14 +726,15 @@ def _tp_wrap(run, tp_mesh, tp_specs, n_extra_in, out_specs):
         from jax import shard_map as _sm
     except ImportError:
         from jax.experimental.shard_map import shard_map as _sm
-    in_specs = (tp_specs,) + (P(),) * n_extra_in
+    if in_specs is None:
+        in_specs = (tp_specs,) + (P(),) * n_extra_in
     try:
         mapped = _sm(run, mesh=tp_mesh, in_specs=in_specs,
                      out_specs=out_specs, check_vma=False)
     except TypeError:  # older jax: no check_vma param
         mapped = _sm(run, mesh=tp_mesh, in_specs=in_specs,
                      out_specs=out_specs)
-    return jax.jit(mapped)
+    return jax.jit(mapped, donate_argnums=donate)
 
 
 def _gpt_generate(model, input_ids, max_new_tokens, temperature, top_k,
