@@ -27,6 +27,12 @@ with a deterministic per-request PRNG stream, without disturbing greedy
 neighbors — an all-greedy batch dispatches to a lean argmax-only compiled
 step. Composes with bf16 serving params/cache (dtype="bfloat16") and the
 int8 KV cache (cache_dtype="int8").
+
+`prefill_chunk=C` enables CHUNKED prefill: a long prompt is consumed C
+tokens per step() with decode steps for active slots running in between,
+so an arriving 1024-token prompt stalls inter-token latency by one chunk's
+compute, not one full prefill (the whole-prompt path remains the default;
+outputs are identical either way — asserted in tests).
 """
 import numpy as np
 
@@ -58,7 +64,8 @@ class Request:
 class ServingEngine:
     def __init__(self, model, max_batch=4, dtype=None, cache_dtype=None,
                  eos_token_id=None, prompt_buckets=(32, 64, 128, 256, 512,
-                                                    1024), tp_mesh=None):
+                                                    1024), tp_mesh=None,
+                 prefill_chunk=None):
         import jax
         import jax.numpy as jnp
 
@@ -72,6 +79,16 @@ class ServingEngine:
         self.B = int(max_batch)
         self.T = cfg.max_seq_len
         self.eos = eos_token_id
+        # argument validation FIRST — before any device allocation/compile
+        if prefill_chunk is not None:
+            if tp_mesh is not None:
+                raise ValueError(
+                    "prefill_chunk with tp_mesh is not supported yet "
+                    "(the chunk side-cache would need sharded allocation)")
+            if not 1 <= int(prefill_chunk) <= self.T:
+                raise ValueError(
+                    f"prefill_chunk must be in [1, max_seq_len={self.T}], "
+                    f"got {prefill_chunk}")
         self._buckets = tuple(sorted(b for b in prompt_buckets
                                      if b <= self.T))
         if not self._buckets:
@@ -128,6 +145,19 @@ class ServingEngine:
             x, kc1, vc1 = fwd(p, ids_padded, 0, kc1, vc1)
             x_last = jax.lax.dynamic_slice_in_dim(
                 x, true_len - 1, 1, axis=1)[:, 0]
+            return kc1, vc1, logits_of(p, x_last).astype(jnp.float32)[0]
+
+        def prefill_start():
+            return cache_init(1, self.T, cache_dt)
+
+        def prefill_chunk_fn(p, chunk_ids, offset, kc1, vc1, last_in_chunk):
+            """Consume ONE fixed-size chunk at column `offset` of the slot's
+            side cache; returns updated cache + the logits at
+            last_in_chunk (only meaningful on the final chunk — junk
+            columns beyond it are causally invisible/overwritten)."""
+            x, kc1, vc1 = fwd(p, chunk_ids, offset, kc1, vc1)
+            x_last = jax.lax.dynamic_slice_in_dim(
+                x, last_in_chunk, 1, axis=1)[:, 0]
             return kc1, vc1, logits_of(p, x_last).astype(jnp.float32)[0]
 
         def admit(big, row, r):
@@ -213,6 +243,12 @@ class ServingEngine:
         self._pick1 = jax.jit(lambda lg, t, k, s, p_: _pick(
             lg[None], t[None], k[None], s[None], p_[None])[0])
 
+        self._chunk = None if prefill_chunk is None else int(prefill_chunk)
+        self._prefill_start = prefill_start
+        self._prefill_chunk = jax.jit(prefill_chunk_fn,
+                                      donate_argnums=(3, 4))
+        self._prefilling = {}   # slot -> [req, kc1, vc1, consumed_offset]
+
         # host-side slot state
         self._slot_req = [None] * self.B        # Request or None
         self._pos = np.zeros(self.B, np.int32)  # next write column
@@ -274,15 +310,10 @@ class ServingEngine:
         self._finished[req.rid] = req
         self._slot_req[slot] = None
 
-    def _admit_one(self, slot, req):
-        import jax.numpy as jnp
-
+    def _activate(self, slot, req, kc1, vc1, logits):
+        """Shared admission tail: copy the side cache into the slot's row
+        and emit the first generated token through the standard pick."""
         n = len(req.prompt_ids)
-        pb = self._bucket(n)
-        padded = np.zeros((1, pb), np.int32)
-        padded[0, :n] = req.prompt_ids
-        kc1, vc1, logits = self._prefill(self._params, jnp.asarray(padded),
-                                         np.int32(n))
         self._kc = self._admit(self._kc, kc1, slot)
         self._vc = self._admit(self._vc, vc1, slot)
         temp = np.float32(req.temperature)
@@ -300,6 +331,50 @@ class ServingEngine:
         req.output_ids.append(tok)
         self._after_emit(slot, req)
 
+    def _admit_one(self, slot, req):
+        import jax.numpy as jnp
+
+        n_chunks_end = 0 if self._chunk is None else \
+            -(-len(req.prompt_ids) // self._chunk) * self._chunk
+        if self._chunk is not None and n_chunks_end <= self.T:
+            # chunked admission: reserve the slot, consume the prompt one
+            # chunk per step() so active decodes run in between
+            self._slot_req[slot] = req
+            self._prefilling[slot] = [req, *self._prefill_start(), 0]
+            return
+        # whole-prompt (bucketed) prefill — also the fallback when the
+        # chunk schedule's fixed-width final write would cross max_seq_len
+        # (dynamic_update_slice CLAMPS out-of-range starts, which would
+        # silently shift tokens onto valid prefix columns)
+        n = len(req.prompt_ids)
+        pb = self._bucket(n)
+        padded = np.zeros((1, pb), np.int32)
+        padded[0, :n] = req.prompt_ids
+        kc1, vc1, logits = self._prefill(self._params, jnp.asarray(padded),
+                                         np.int32(n))
+        self._activate(slot, req, kc1, vc1, logits)
+
+    def _advance_prefill(self, slot):
+        """Consume one chunk of a reserved slot's prompt; on the final
+        chunk, activate the slot."""
+        import jax.numpy as jnp
+
+        req, kc1, vc1, off = self._prefilling[slot]
+        n = len(req.prompt_ids)
+        C = self._chunk
+        end = min(off + C, n)
+        chunk = np.zeros((1, C), np.int32)
+        chunk[0, :end - off] = req.prompt_ids[off:end]
+        kc1, vc1, logits = self._prefill_chunk(
+            self._params, jnp.asarray(chunk), np.int32(off), kc1, vc1,
+            np.int32(end - off - 1))
+        if end >= n:
+            del self._prefilling[slot]
+            self._slot_req[slot] = None   # _activate re-binds
+            self._activate(slot, req, kc1, vc1, logits)
+        else:
+            self._prefilling[slot] = [req, kc1, vc1, end]
+
     def _after_emit(self, slot, req):
         if self.eos is not None and req.output_ids[-1] == self.eos:
             self._finish(slot, "eos")
@@ -314,6 +389,10 @@ class ServingEngine:
         import jax.numpy as jnp
 
         before = set(self._finished)
+        # chunked admissions in flight advance ONE chunk each, so active
+        # decodes below never wait for a whole long prefill
+        for slot in list(self._prefilling):
+            self._advance_prefill(slot)
         for slot in range(self.B):
             # while, not if: a request finishing DURING admission (eos on
             # its prefill token / max_new_tokens=1) frees the slot for the
@@ -323,7 +402,9 @@ class ServingEngine:
                 if self._slot_req[slot] is not None:
                     break
 
-        active = [s for s in range(self.B) if self._slot_req[s] is not None]
+        active = [s for s in range(self.B)
+                  if self._slot_req[s] is not None
+                  and s not in self._prefilling]
         if active:
             # inactive slots ride along harmlessly: their rows are
             # don't-care (freed) and re-prefilled on admission. Host-side

@@ -180,6 +180,71 @@ class TestSampling:
                        seed=2 ** 31)
 
 
+class TestChunkedPrefill:
+    def test_chunked_matches_unchunked(self, rng):
+        m = _model()
+        for chunk, plen in ((16, 50), (7, 23), (32, 9)):   # incl. p < C
+            eng = ServingEngine(m, max_batch=2, prefill_chunk=chunk)
+            p = rng.randint(0, 256, (plen,)).astype(np.int32)
+            rid = eng.submit(p, max_new_tokens=8)
+            res = eng.run_until_complete()
+            np.testing.assert_array_equal(res[rid].tokens,
+                                          _ref_new_tokens(m, p, 8))
+
+    def test_decode_interleaves_with_long_prefill(self, rng):
+        # the whole point: an active request keeps emitting one token per
+        # step WHILE a long prompt is being consumed chunk by chunk
+        m = _model()
+        eng = ServingEngine(m, max_batch=2, prefill_chunk=16)
+        p_short = rng.randint(0, 256, (5,)).astype(np.int32)
+        p_long = rng.randint(0, 256, (60,)).astype(np.int32)
+        r_s = eng.submit(p_short, max_new_tokens=20)
+        eng.step()                         # short admitted + 1 decode
+        short_req = eng._slot_req[[s for s in range(2)
+                                   if eng._slot_req[s]][0]]
+        r_l = eng.submit(p_long, max_new_tokens=4)
+        counts = []
+        for _ in range(3):                 # 60/16 -> 4 chunks in flight
+            eng.step()
+            counts.append(len(short_req.output_ids))
+        # short request gained a token EVERY step despite the prefill
+        assert counts == [counts[0], counts[0] + 1, counts[0] + 2]
+        res = eng.run_until_complete()
+        np.testing.assert_array_equal(res[r_s].tokens,
+                                      _ref_new_tokens(m, p_short, 20))
+        np.testing.assert_array_equal(res[r_l].tokens,
+                                      _ref_new_tokens(m, p_long, 4))
+
+    def test_final_chunk_crossing_T_falls_back_whole_prefill(self, rng):
+        # reviewer-reproduced corruption: T=128, chunk=96, prompt 100 —
+        # the fixed-width final chunk would write past T and
+        # dynamic_update_slice CLAMPS, shifting tokens onto valid prefix
+        # columns. Such prompts must take the whole-prefill path instead.
+        m = _model()
+        eng = ServingEngine(m, max_batch=1, prefill_chunk=96)
+        p = rng.randint(0, 256, (100,)).astype(np.int32)
+        rid = eng.submit(p, max_new_tokens=6)
+        res = eng.run_until_complete()
+        np.testing.assert_array_equal(res[rid].tokens,
+                                      _ref_new_tokens(m, p, 6))
+
+    def test_chunk_validation(self, rng):
+        import jax
+
+        m = _model()
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            ServingEngine(m, max_batch=1, prefill_chunk=0)
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            ServingEngine(m, max_batch=1, prefill_chunk=129)  # > T
+        if len(jax.devices()) >= 4:
+            from paddle_tpu.distributed.mesh import build_mesh
+
+            mesh = build_mesh((4,), ("mp",), devices=jax.devices()[:4])
+            with pytest.raises(ValueError, match="tp_mesh"):
+                ServingEngine(m, max_batch=1, tp_mesh=mesh,
+                              prefill_chunk=8)
+
+
 class TestSlotLifecycle:
     def test_eos_frees_slot_for_queued_request(self, rng):
         m = _model()
