@@ -33,6 +33,11 @@ tokens per step() with decode steps for active slots running in between,
 so an arriving 1024-token prompt stalls inter-token latency by one chunk's
 compute, not one full prefill (the whole-prompt path remains the default;
 outputs are identical either way — asserted in tests).
+
+`register_prefix(ids)` caches a shared prefix's KV ONCE (system prompts):
+requests submitted with `prefix_id=` start from a copy of that cache and
+prefill only their suffix — identical outputs to resending the full
+prompt, without recomputing the prefix per request.
 """
 import numpy as np
 
@@ -45,13 +50,15 @@ class Request:
     """One submitted prompt and, when finished, its generated tokens."""
 
     def __init__(self, rid, prompt_ids, max_new_tokens, temperature=0.0,
-                 top_k=None, seed=None):
+                 top_k=None, seed=None, prefix_id=None, prefix_len=0):
         self.rid = rid
         self.prompt_ids = np.asarray(prompt_ids, np.int32).ravel()
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.top_k = top_k
         self.seed = rid if seed is None else int(seed)
+        self.prefix_id = prefix_id          # registered shared prefix, or
+        self.prefix_len = int(prefix_len)   # 0 = no prefix reuse
         self.output_ids = []          # generated tokens (no prompt echo)
         self.finished = False
         self.finish_reason = None     # "eos" | "length" | "capacity"
@@ -247,7 +254,14 @@ class ServingEngine:
         self._prefill_start = prefill_start
         self._prefill_chunk = jax.jit(prefill_chunk_fn,
                                       donate_argnums=(3, 4))
-        self._prefilling = {}   # slot -> [req, kc1, vc1, consumed_offset]
+        # slot -> [req, kc1, vc1, consumed_offset, chunk_width]
+        self._prefilling = {}
+        # registered shared prefixes: pid -> (ids, kc1, vc1). The chunk fn
+        # DONATES its cache args, so admissions consume a fresh COPY
+        self._prefixes = {}
+        self._next_pid = 0
+        self._copy_cache = jax.jit(
+            lambda c: jax.tree_util.tree_map(jnp.array, c))
 
         # host-side slot state
         self._slot_req = [None] * self.B        # Request or None
@@ -261,8 +275,45 @@ class ServingEngine:
         self._finished = {}
 
     # -- API -----------------------------------------------------------------
+    def register_prefix(self, prefix_ids):
+        """Prefill a shared prefix (e.g. a system prompt) ONCE and cache
+        its KV; returns a prefix id for submit(prefix_id=...). Requests
+        using it prefill only their suffix."""
+        import jax.numpy as jnp
+
+        if self._tp_mesh is not None:
+            raise ValueError("register_prefix with tp_mesh is not "
+                             "supported yet (sharded side cache)")
+        ids = prefix_ids._data if isinstance(prefix_ids, Tensor) \
+            else np.asarray(prefix_ids)
+        ids = np.asarray(ids, np.int32).ravel()
+        if len(ids) == 0:
+            raise ValueError("empty prefix")
+        if len(ids) + 2 > self.T:
+            raise ValueError(
+                f"prefix ({len(ids)}) too long for max_seq_len {self.T}")
+        n = len(ids)
+        pb = self._bucket(n)
+        padded = np.zeros((1, pb), np.int32)
+        padded[0, :n] = ids
+        kc1, vc1, _ = self._prefill(self._params, jnp.asarray(padded),
+                                    np.int32(n))
+        pid = self._next_pid
+        self._next_pid += 1
+        self._prefixes[pid] = (ids, kc1, vc1)
+        return pid
+
+    def unregister_prefix(self, prefix_id):
+        """Free a registered prefix's cached KV (each pins a [1, max_seq]
+        side cache on device — long-lived engines rotating system prompts
+        should release retired ones). In-flight requests that already
+        copied it are unaffected; later submits with this id raise."""
+        if prefix_id not in self._prefixes:
+            raise ValueError(f"unknown prefix_id {prefix_id}")
+        del self._prefixes[prefix_id]
+
     def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
-               top_k=None, seed=None):
+               top_k=None, seed=None, prefix_id=None):
         """Queue a prompt; returns the request id. temperature=0 (default)
         decodes greedy; temperature>0 samples (optionally top_k-truncated)
         with a per-request deterministic PRNG stream (seed defaults to the
@@ -287,6 +338,15 @@ class ServingEngine:
                     "& 0x7FFFFFFF for hash/time-derived seeds)")
         if len(ids) == 0:
             raise ValueError("empty prompt")
+        prefix_len = 0
+        if prefix_id is not None:
+            if prefix_id not in self._prefixes:
+                raise ValueError(f"unknown prefix_id {prefix_id}")
+            prefix_ids = self._prefixes[prefix_id][0]
+            prefix_len = len(prefix_ids)
+            # the request's logical prompt = prefix + suffix; only the
+            # suffix will be prefilled (from the cached prefix KV)
+            ids = np.concatenate([prefix_ids, ids])
         if len(ids) + 1 > self.T:
             raise ValueError(
                 f"prompt ({len(ids)}) too long for max_seq_len {self.T}")
@@ -294,7 +354,8 @@ class ServingEngine:
         self._next_rid += 1
         self._queue.append(Request(rid, ids, max_new_tokens,
                                    temperature=temperature, top_k=top_k,
-                                   seed=seed))
+                                   seed=seed, prefix_id=prefix_id,
+                                   prefix_len=prefix_len))
         return rid
 
     def _bucket(self, n):
@@ -334,19 +395,40 @@ class ServingEngine:
     def _admit_one(self, slot, req):
         import jax.numpy as jnp
 
+        prefix_len = req.prefix_len
+        n = len(req.prompt_ids)
+        if prefix_len and req.prefix_id not in self._prefixes:
+            # prefix unregistered while this request sat in the queue: the
+            # combined prompt is already in prompt_ids — whole-prefill it
+            prefix_len = 0
+        if prefix_len:
+            # suffix-only prefill from a COPY of the cached prefix KV
+            # (the chunk program donates its cache args); chunk width =
+            # the engine's prefill_chunk or a default for prefix users
+            C = self._chunk or min(64, self.T)
+            end = prefix_len + -(-(n - prefix_len) // C) * C
+            if end <= self.T:
+                _, kc_p, vc_p = self._prefixes[req.prefix_id]
+                kc1 = self._copy_cache(kc_p)
+                vc1 = self._copy_cache(vc_p)
+                self._slot_req[slot] = req
+                self._prefilling[slot] = [req, kc1, vc1, prefix_len, C]
+                return
+            # else: fall through to whole-prompt prefill (recomputes the
+            # prefix — slower but correct near the capacity edge)
         n_chunks_end = 0 if self._chunk is None else \
-            -(-len(req.prompt_ids) // self._chunk) * self._chunk
+            -(-n // self._chunk) * self._chunk
         if self._chunk is not None and n_chunks_end <= self.T:
             # chunked admission: reserve the slot, consume the prompt one
             # chunk per step() so active decodes run in between
             self._slot_req[slot] = req
-            self._prefilling[slot] = [req, *self._prefill_start(), 0]
+            self._prefilling[slot] = [req, *self._prefill_start(), 0,
+                                      self._chunk]
             return
         # whole-prompt (bucketed) prefill — also the fallback when the
         # chunk schedule's fixed-width final write would cross max_seq_len
         # (dynamic_update_slice CLAMPS out-of-range starts, which would
         # silently shift tokens onto valid prefix columns)
-        n = len(req.prompt_ids)
         pb = self._bucket(n)
         padded = np.zeros((1, pb), np.int32)
         padded[0, :n] = req.prompt_ids
@@ -359,9 +441,8 @@ class ServingEngine:
         chunk, activate the slot."""
         import jax.numpy as jnp
 
-        req, kc1, vc1, off = self._prefilling[slot]
+        req, kc1, vc1, off, C = self._prefilling[slot]
         n = len(req.prompt_ids)
-        C = self._chunk
         end = min(off + C, n)
         chunk = np.zeros((1, C), np.int32)
         chunk[0, :end - off] = req.prompt_ids[off:end]
@@ -373,7 +454,7 @@ class ServingEngine:
             self._slot_req[slot] = None   # _activate re-binds
             self._activate(slot, req, kc1, vc1, logits)
         else:
-            self._prefilling[slot] = [req, kc1, vc1, end]
+            self._prefilling[slot] = [req, kc1, vc1, end, C]
 
     def _after_emit(self, slot, req):
         if self.eos is not None and req.output_ids[-1] == self.eos:

@@ -245,6 +245,72 @@ class TestChunkedPrefill:
                               prefill_chunk=8)
 
 
+class TestPrefixCaching:
+    def test_prefix_cached_requests_match_full_prompt(self, rng):
+        m = _model()
+        eng = ServingEngine(m, max_batch=2)
+        prefix = rng.randint(0, 256, (20,)).astype(np.int32)
+        pid = eng.register_prefix(prefix)
+        sufs = [rng.randint(0, 256, (n,)).astype(np.int32)
+                for n in (5, 11, 30)]
+        rids = [eng.submit(s, max_new_tokens=8, prefix_id=pid)
+                for s in sufs]
+        res = eng.run_until_complete()
+        for rid, s in zip(rids, sufs):
+            full = np.concatenate([prefix, s])
+            np.testing.assert_array_equal(res[rid].tokens,
+                                          _ref_new_tokens(m, full, 8))
+        # the prefix cache survives its consumers (the chunk program
+        # donates; admissions must copy): a LATER request still works
+        s2 = rng.randint(0, 256, (7,)).astype(np.int32)
+        r2 = eng.submit(s2, max_new_tokens=6, prefix_id=pid)
+        res2 = eng.run_until_complete()
+        np.testing.assert_array_equal(
+            res2[r2].tokens,
+            _ref_new_tokens(m, np.concatenate([prefix, s2]), 6))
+
+    def test_prefix_near_capacity_falls_back(self, rng):
+        m = _model()
+        eng = ServingEngine(m, max_batch=1)
+        prefix = rng.randint(0, 256, (90,)).astype(np.int32)
+        pid = eng.register_prefix(prefix)
+        s = rng.randint(0, 256, (30,)).astype(np.int32)  # 90+64-chunk > T
+        rid = eng.submit(s, max_new_tokens=4, prefix_id=pid)
+        res = eng.run_until_complete()
+        np.testing.assert_array_equal(
+            res[rid].tokens,
+            _ref_new_tokens(m, np.concatenate([prefix, s]), 4))
+
+    def test_unregister_frees_prefix(self, rng):
+        m = _model()
+        eng = ServingEngine(m, max_batch=1)
+        prefix = rng.randint(0, 256, (10,)).astype(np.int32)
+        pid = eng.register_prefix(prefix)
+        s = rng.randint(0, 256, (4,)).astype(np.int32)
+        rid = eng.submit(s, max_new_tokens=4, prefix_id=pid)
+        eng.unregister_prefix(pid)
+        # the QUEUED request already captured the combined prompt — it
+        # must whole-prefill correctly despite the freed prefix cache
+        res = eng.run_until_complete()
+        np.testing.assert_array_equal(
+            res[rid].tokens,
+            _ref_new_tokens(m, np.concatenate([prefix, s]), 4))
+        with pytest.raises(ValueError, match="prefix_id"):
+            eng.submit(s, prefix_id=pid)
+        with pytest.raises(ValueError, match="prefix_id"):
+            eng.unregister_prefix(pid)
+
+    def test_prefix_validation(self, rng):
+        m = _model()
+        eng = ServingEngine(m, max_batch=1)
+        with pytest.raises(ValueError, match="prefix_id"):
+            eng.submit(np.zeros((3,), np.int32), prefix_id=99)
+        with pytest.raises(ValueError, match="empty"):
+            eng.register_prefix(np.zeros((0,), np.int32))
+        with pytest.raises(ValueError, match="too long"):
+            eng.register_prefix(np.zeros((200,), np.int32))
+
+
 class TestSlotLifecycle:
     def test_eos_frees_slot_for_queued_request(self, rng):
         m = _model()
