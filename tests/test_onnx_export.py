@@ -116,6 +116,20 @@ class TestGPTExport:
         (got,) = runtime.run(open(p, "rb").read(), [ids])
         np.testing.assert_allclose(got, expect, atol=1e-4, rtol=1e-4)
 
+    @pytest.mark.parametrize("kw", [{"num_kv_heads": 2},
+                                    {"attention_window": 8}])
+    def test_gpt_attention_variants(self, tmp_path, kw):
+        # GQA (grouped einsums) and sliding-window (banded mask) lower to
+        # the same standard op set and pass the numpy self-check
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=32, dropout=0.0, **kw)
+        p = paddle.onnx.export(GPTForCausalLM(cfg), str(tmp_path / "v"),
+                               input_spec=[InputSpec([1, 16], "int32")])
+        model = proto.parse_model(open(p, "rb").read())
+        assert model["graph"]["outputs"][0]["shape"] == [1, 16, 128]
+
     def test_multi_output_forward(self, tmp_path):
         class TwoOut(nn.Layer):
             def __init__(self):
