@@ -556,6 +556,7 @@ def _arm_watchdog(seconds=900):
     def _fire():
         if _LAST_GOOD is not None:
             line = dict(_LAST_GOOD)
+            line["partial"] = True  # truncated run — later phase(s) missing
             line["watchdog_note"] = (
                 f"a later phase hung >{seconds}s; this is the last complete "
                 "measurement")

@@ -101,7 +101,7 @@ class ProgressBar:
             prev_width = self._total_width
             if self._dynamic:
                 self._file.write("\r")
-            else:
+            elif prev_width > 0:  # newline separates lines, not a leading one
                 self._file.write("\n")
             line = self._bar(current_num) + info
             if self._num is not None and current_num < self._num:

@@ -145,6 +145,12 @@ class Converter:
         if p in _REDUCE_ATTR_AXES:
             (x,) = eqn.invars
             axes = [int(a) for a in eqn.params["axes"]]
+            if not axes:  # reduce over no axes is the identity (ONNX's
+                # empty-axes attr means reduce-ALL with noop_with_empty_axes
+                # unset, so it cannot express this case directly)
+                self.g.add("Identity", [self.g.name_of(x)],
+                           out_names=[self.g.name_of(eqn.outvars[0])])
+                return
             self.g.add(_REDUCE_ATTR_AXES[p], [self.g.name_of(x)],
                        attrs={"axes": axes, "keepdims": 0},
                        out_names=[self.g.name_of(eqn.outvars[0])])
@@ -380,6 +386,11 @@ class Converter:
         self.g.add("Not", [e], out_names=[self.g.name_of(eqn.outvars[0])])
 
     def _op_reduce_sum(self, eqn):
+        if not len(eqn.params["axes"]):  # identity; an empty axes INPUT
+            # means reduce-all in ONNX (noop_with_empty_axes defaults to 0)
+            self.g.add("Identity", [self.g.name_of(eqn.invars[0])],
+                       out_names=[self.g.name_of(eqn.outvars[0])])
+            return
         axes = self.g.const(
             np.asarray([int(a) for a in eqn.params["axes"]], np.int64))
         self.g.add("ReduceSum", [self.g.name_of(eqn.invars[0]), axes],

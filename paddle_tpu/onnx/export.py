@@ -57,6 +57,13 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
     from ..static import io
     from . import converter, runtime
 
+    if opset_version != 13:
+        import warnings
+        warnings.warn(
+            f"paddle_tpu.onnx.export: requested opset {opset_version} but "
+            "only opset 13 is emitted; the produced file declares 13 and an "
+            "older runtime may reject it", stacklevel=2)
+
     # native portable artifact alongside, as before (jit.save handles specs)
     pjit.save(layer, path, input_spec=input_spec)
 

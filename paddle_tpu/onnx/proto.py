@@ -103,14 +103,26 @@ def attribute(name, value):
     elif isinstance(value, (bytes, str)):
         out += f_bytes(4, value) + f_varint(20, A_STRING)
     elif isinstance(value, (list, tuple)):
-        if value and isinstance(value[0], float):
+        import numbers
+        import numpy as _np
+        if not value:
+            raise TypeError(
+                f"attribute {name!r}: empty list has no inferable ONNX type; "
+                "pass an explicit scalar or drop the attribute")
+        is_float = lambda v: isinstance(v, (float, _np.floating))
+        is_int = lambda v: isinstance(v, numbers.Integral)
+        if all(is_float(v) for v in value):
             for v in value:
-                out += f_float(7, v)
+                out += f_float(7, float(v))
             out += f_varint(20, A_FLOATS)
-        else:
+        elif all(is_int(v) for v in value):
             for v in value:
                 out += f_varint(8, int(v))
             out += f_varint(20, A_INTS)
+        else:
+            raise TypeError(
+                f"attribute {name!r}: mixed/unsupported element types "
+                f"{[type(v).__name__ for v in value]}")
     else:
         raise TypeError(f"unsupported attribute value {value!r}")
     return out

@@ -194,7 +194,9 @@ def run(model_bytes, inputs):
             out = np.pad(ins[0], list(zip(pads[:n], pads[n:])),
                          constant_values=ins[2])
         elif op == "ReduceSum":
-            axes = tuple(int(a) for a in ins[1])
+            # ONNX noop_with_empty_axes=0 (the default): empty axes input
+            # means reduce over ALL axes, unlike numpy's sum(axis=()).
+            axes = tuple(int(a) for a in ins[1]) if len(ins[1]) else None
             out = ins[0].sum(axis=axes, keepdims=bool(at.get("keepdims", 1)))
         elif op in ("ReduceMax", "ReduceMin", "ReduceProd", "ReduceMean"):
             fn = {"ReduceMax": np.max, "ReduceMin": np.min,

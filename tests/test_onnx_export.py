@@ -208,3 +208,37 @@ class TestFailureContract:
             paddle.onnx.export(nn.Linear(2, 2), str(tmp_path / "bad"),
                                input_spec=[InputSpec([1, 2], "float32")])
         assert not os.path.exists(str(tmp_path / "bad") + ".onnx")
+
+    def test_attribute_proto_rejects_ambiguous_lists(self):
+        # empty and mixed lists have no safe wire encoding: raise, never
+        # silently default to A_INTS (advisor finding r4)
+        with pytest.raises(TypeError, match="empty list"):
+            proto.attribute("axes", [])
+        with pytest.raises(TypeError, match="mixed"):
+            proto.attribute("vals", [1, "a"])
+        # numpy float elements must encode as floats, not truncate to ints
+        fl = proto.attribute("scales", [np.float32(0.5), np.float64(1.5)])
+        assert fl == proto.attribute("scales", [0.5, 1.5])
+        # numpy ints still take the ints path
+        il = proto.attribute("axes", [np.int64(0), 1])
+        assert il == proto.attribute("axes", [0, 1])
+
+    def test_empty_axes_reductions_export_as_identity(self, tmp_path):
+        # paddle.sum/max(x, axis=[]) traces to reduce_{sum,max}[axes=()],
+        # which ONNX cannot express (empty axes = reduce-ALL there); the
+        # converter must lower it to Identity and the self-check must pass
+        class EmptyAxes(nn.Layer):
+            def forward(self, x):
+                return paddle.sum(x, axis=[]) + paddle.max(x, axis=[])
+
+        p = str(tmp_path / "ea")
+        paddle.onnx.export(EmptyAxes(), p,
+                           input_spec=[InputSpec([2, 3], "float32")])
+        assert os.path.exists(p + ".onnx")
+
+    def test_nonstandard_opset_warns(self, tmp_path):
+        with pytest.warns(UserWarning, match="opset 9"):
+            paddle.onnx.export(nn.Linear(2, 2), str(tmp_path / "m9"),
+                               input_spec=[InputSpec([1, 2], "float32")],
+                               opset_version=9)
+        assert os.path.exists(str(tmp_path / "m9") + ".onnx")

@@ -52,6 +52,19 @@ class TestProgressBar:
         with pytest.raises(TypeError):
             ProgressBar(num=0)
 
+    def test_non_tty_verbose1_no_leading_blank_line(self):
+        # non-tty at verbose=1 prints one line per update; the first line
+        # must not be preceded by a spurious blank line
+        buf = io.StringIO()
+        buf.isatty = lambda: False
+        pb = ProgressBar(num=4, verbose=1, file=buf)
+        pb._start = time.time() - 1.0
+        pb.update(1, [("loss", 1.0)])
+        pb.update(2, [("loss", 0.5)])
+        out = buf.getvalue()
+        assert not out.startswith("\n")
+        assert out.count("\n") == 1  # exactly one separator between 2 lines
+
 
 class TestTBWriter:
     def test_crc32c_known_vector(self):
