@@ -915,3 +915,164 @@ def bilateral_slice(x, guide, grid, has_offset=False, name=None):
         return out
 
     return apply(fn, _t(x), _t(guide), _t(grid))
+
+
+def batch_fc(input, w, bias=None, act=None, name=None):
+    """batch_fc_op.cc parity (per-slot FC for rank models): input
+    [slot_pairs_num, batch_size, in_dim], w [slot_pairs_num, in_dim, out_dim],
+    bias [slot_pairs_num, out_dim]; out[s] = act(input[s] @ w[s] + bias[s]).
+    One batched MXU matmul replaces the reference's per-slot GEMM loop
+    (batch_fc_op.cu). The fluid wrapper created the parameters from
+    param_size/bias_size attrs; here they are passed explicitly like the
+    rest of this functional family."""
+    args = [_t(input), _t(w)]
+    if bias is not None:
+        args.append(_t(bias))
+
+    def fn(v, wv, *b):
+        out = jnp.einsum("sbi,sio->sbo", v, wv)
+        if b:
+            out = out + b[0][:, None, :]
+        if act is not None:
+            if act not in ("relu", "sigmoid", "tanh"):
+                raise ValueError(f"unsupported act {act!r}")
+            out = getattr(jax.nn, act)(out)
+        return out
+
+    return apply(fn, *args)
+
+
+def rank_attention(input, rank_offset, rank_param, max_rank=3, max_size=0,
+                   name=None):
+    """rank_attention_op parity (rank-aware feature crossing in CTR models,
+    rank_attention.cu.h:32-95): each instance i with its own rank `lower`
+    gathers up to max_rank peer instances; peer slot k contributes
+    x[index_k] @ P[lower, faster_k] where P is rank_param reshaped to
+    [max_rank, max_rank, in_dim, out_dim] (the reference's
+    start = lower*max_rank + faster block layout). Slots with lower<0 or
+    faster<0 contribute 0 (the CUDA kernels' `continue` on zeroed buffers).
+
+    input [B, D]; rank_offset [B, 2*max_rank+1] int32 — column 0 the
+    instance's 1-based rank, then (faster_rank, row_index) pairs;
+    rank_param [max_rank*max_rank*D, out_dim] (the fluid wrapper's asserted
+    shape). Returns [B, out_dim]. Gathers + one batched einsum instead of
+    the expand-to-[B, max_rank*D] staging buffers the CUDA path builds."""
+    def fn(xv, ro, pv):
+        B, D = xv.shape
+        O = pv.shape[-1]
+        P = pv.reshape(max_rank, max_rank, D, O)
+        ro = ro.astype(jnp.int32)
+        lower = ro[:, 0] - 1                                  # [B]
+        faster = ro[:, 1::2] - 1                              # [B, K]
+        index = ro[:, 2::2]                                   # [B, K]
+        valid = (lower[:, None] >= 0) & (faster >= 0)
+        xk = jnp.where(valid[:, :, None],
+                       xv[jnp.clip(index, 0, B - 1)], 0)      # [B, K, D]
+        pk = P[jnp.clip(lower, 0)[:, None], jnp.clip(faster, 0)]
+        pk = jnp.where(valid[:, :, None, None], pk, 0)        # [B, K, D, O]
+        return jnp.einsum("bkd,bkdo->bo", xk, pk)
+
+    return apply(fn, _t(input), _t(rank_offset).detach(), _t(rank_param))
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0, name=None):
+    """filter_by_instag_op.cc parity: keep the instances whose tag list
+    intersects filter_tag. Padded TPU form: ins [N, ...] rows, ins_tag
+    [N, Tmax] int64 padded with -1 (the reference walks per-instance LoD tag
+    lists), filter_tag 1-D. Instead of compacting to a shorter tensor
+    (data-dependent shape), rows that fail the filter are zeroed and their
+    loss_weight is 0 — downstream losses multiply by loss_weight, so the
+    training math matches the reference's compacted batch. Returns
+    [out [N, ...], loss_weight [N, 1] float]. out_val_if_empty (the value
+    the reference writes into its single placeholder row when NOTHING
+    passes) is accepted for signature parity; the padded form keeps shape,
+    so it never needs to materialize that placeholder."""
+    def fn(v, tags, ft):
+        match = (tags[:, :, None] == ft[None, None, :])       # [N, T, F]
+        match &= (tags >= 0)[:, :, None]                      # padding slots
+        keep = match.any(axis=(1, 2))                         # [N]
+        shaped = keep.reshape((-1,) + (1,) * (v.ndim - 1))
+        out = jnp.where(shaped, v, jnp.asarray(0, v.dtype))
+        lw = keep.astype(jnp.float32)[:, None]
+        return out, lw
+
+    return apply(fn, _t(ins), _t(ins_tag).detach(),
+                 _t(filter_tag).detach().reshape([-1]))
+
+
+def search_pyramid_hash(x, length, weights, num_emb, space_len, pyramid_layer,
+                        rand_len, drop_out_percent=0.0, is_training=True,
+                        seed=1, step=0, name=None):
+    """pyramid_hash_op.cc parity (PyramidDNN hashed n-gram embeddings): every
+    n-gram of length 2..pyramid_layer gets an embedding made of
+    num_emb/rand_len strips of the weight table, strip j starting at
+    hash(ngram, seed=j*rand_len) % space_len (hash_embedding_ff,
+    pyramid_hash_op.cc:226-247). Padded TPU form: x [B, T] int32 token ids +
+    length [B]; weights [space_len + rand_len] (same +rand_len slack row
+    block as the reference's [space_len+rand_len, 1] table). Returns
+    (out [B, N, num_emb], ngram_length [B]) with rows ordered ngram-size
+    then start position like the reference's loop; invalid/dropped ngrams
+    are zero rows instead of being compacted away (static shapes — callers
+    seq-pool over ngram_length, and zero rows are no-ops under sum pooling).
+
+    Deviations (documented, structural parity kept): the hash is a
+    vectorized integer avalanche over the id window, not XXH32 of raw bytes
+    (both are arbitrary fixed hashes into a LEARNED table — only
+    determinism matters); train-time ngram dropout hashes (window, seed,
+    `step`) rather than drawing rand_r — pass the global training step so
+    a FRESH ngram subset drops each step (a fixed step would permanently
+    exclude the same ngrams from training); the white/black-list
+    bloom filters (use_filter path) are descoped with the PS-side filter
+    tooling. Eval scales by drop_out_percent only when it is set (> 0) —
+    the reference's unconditional axpy would zero eval output at the
+    attr's own default of 0."""
+    if num_emb % rand_len:
+        raise ValueError(f"num_emb ({num_emb}) must be a multiple of "
+                         f"rand_len ({rand_len})")
+    n_chunks = num_emb // rand_len
+
+    def _u32(v):
+        return np.uint32(v & 0xFFFFFFFF)
+
+    def _hash(win, salt):
+        # avalanche mix of the id window [B, L, n] + salt -> uint32
+        h = jnp.full(win.shape[:2], _u32(2166136261 ^ (seed * 16777619)),
+                     jnp.uint32)
+        for t in range(win.shape[-1]):
+            h = (h ^ win[..., t].astype(jnp.uint32)) * np.uint32(16777619)
+            h = h ^ (h >> 15)
+        h = (h ^ _u32(salt * 2654435761)) * np.uint32(2246822519)
+        return h ^ (h >> 13)
+
+    def fn(v, ln, wv):
+        wv = wv.reshape(-1)
+        B, T = v.shape
+        ln32 = ln.astype(jnp.int32)
+        blocks, counts = [], []
+        for ilayer in range(1, pyramid_layer):
+            n, L = ilayer + 1, T - ilayer
+            if L <= 0:
+                break
+            win = jnp.stack([v[:, l:l + L] for l in range(n)], -1)  # [B,L,n]
+            ok = (jnp.arange(L)[None, :] + ilayer) < ln32[:, None]  # [B, L]
+            if is_training and drop_out_percent > 0:
+                u = _hash(win, 7919 + 104729 * int(step)) \
+                    .astype(jnp.float32) / 4294967296.0
+                ok &= (u >= drop_out_percent)
+            pos = jnp.stack([_hash(win, j * rand_len) % np.uint32(space_len)
+                             for j in range(n_chunks)], -1)  # [B, L, chunks]
+            idx = (pos[..., None].astype(jnp.int32)
+                   + jnp.arange(rand_len, dtype=jnp.int32))
+            emb = wv[idx].reshape(B, L, num_emb)
+            blocks.append(emb * ok[:, :, None].astype(wv.dtype))
+            counts.append(ok.sum(axis=1).astype(jnp.int32))
+        if not blocks:
+            return (jnp.zeros((B, 1, num_emb), wv.dtype),
+                    jnp.zeros((B,), jnp.int32))
+        out = jnp.concatenate(blocks, axis=1)
+        if not is_training and drop_out_percent > 0:
+            out = out * drop_out_percent
+        return out, sum(counts)
+
+    return apply(fn, _t(x).detach(), _t(length).detach(), _t(weights))

@@ -311,3 +311,48 @@ def sequence_conv(x, length, weight, context_length, context_start=None,
         return out * valid[:, :, None]
 
     return apply(fn, *args)
+
+
+def sequence_topk_avg_pooling(x, row_length, col_length, topks, channel_num,
+                              name=None):
+    """sequence_topk_avg_pooling_op.cc:131 parity (text-matching pooling over
+    a per-sample score map): for each (row r, channel j), take the top-k
+    column scores and emit their mean for every k in `topks` — the divisor is
+    always k, with missing positions contributing 0, exactly the reference's
+    running-sum-with-padding rule (sequence_topk_avg_pooling_op.h:150-166).
+
+    Padded TPU form of the LoD op: x [B, channel_num, Rmax, Cmax] score maps,
+    row_length/col_length [B] valid sizes; output [B, Rmax,
+    channel_num * len(topks)] laid out row -> channel -> k like the
+    reference's out_slice indexing, rows past row_length zeroed. The `pos`
+    output (top-k indices the reference materializes for its hand-written
+    grad) is not produced — autodiff differentiates the gather directly."""
+    topks = [int(k) for k in topks]
+    if not topks or min(topks) < 1:
+        raise ValueError(f"topks must be positive ints, got {topks}")
+    max_k = max(topks)
+
+    def fn(v, rl, cl):
+        B, C, R, Cm = v.shape
+        if C != channel_num:
+            raise ValueError(
+                f"x has {C} channels but channel_num={channel_num}")
+        rl32 = rl.astype(jnp.int32)
+        cl32 = cl.astype(jnp.int32)
+        colmask = jnp.arange(Cm)[None, :] < cl32[:, None]     # [B, Cm]
+        neg = jnp.asarray(-jnp.inf, v.dtype)
+        vm = jnp.where(colmask[:, None, None, :], v, neg)
+        if max_k > Cm:  # shorter-than-k columns pad like the reference
+            vm = jnp.pad(vm, ((0, 0),) * 3 + ((0, max_k - Cm),),
+                         constant_values=neg)
+        vals = jax.lax.top_k(vm, max_k)[0]                    # [B,C,R,max_k]
+        vals = jnp.where(jnp.isfinite(vals), vals, 0)         # padding -> +0
+        cums = jnp.cumsum(vals, axis=-1)
+        outs = jnp.stack([cums[..., k - 1] / k for k in topks],
+                         axis=-1)                             # [B,C,R,K]
+        out = jnp.transpose(outs, (0, 2, 1, 3)).reshape(
+            B, R, C * len(topks))
+        rowmask = (jnp.arange(R)[None, :] < rl32[:, None]).astype(v.dtype)
+        return out * rowmask[:, :, None]
+
+    return apply(fn, _t(x), _t(row_length).detach(), _t(col_length).detach())

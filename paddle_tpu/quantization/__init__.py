@@ -19,6 +19,7 @@ from .quant_ops import (  # noqa: F401
     fake_quantize_abs_max,
     fake_quantize_channel_wise_abs_max,
     fake_quantize_moving_average_abs_max,
+    fake_quantize_range_abs_max,
     quantize_to_int8,
 )
 from .imperative import ImperativeQuantAware, QuantConfig  # noqa: F401

@@ -52,6 +52,31 @@ def fake_quantize_moving_average_abs_max(x, state_scale, bits=8, rate=0.9,
     return _ste(x, q), new_scale
 
 
+def fake_quantize_range_abs_max(x, scales_window, it, bits=8,
+                                window_size=10000, training=True):
+    """Activation fake quant with a sliding-window abs-max range
+    (operators/fake_quantize_op.cc FakeQuantizeRangeAbsMax /
+    FindRangeAbsMaxFunctor): the observer keeps the last `window_size`
+    per-step abs-max values and quantizes with their maximum. The
+    reference's incremental update (track last max, rescan only when the
+    evicted entry WAS the max) is an optimization of exactly this running
+    window max — computed directly here, one reduction under jit.
+
+    scales_window: [window_size] array (the observer state, zeros-init);
+    it: scalar int32 step counter. Returns (q, new_window, new_it, scale).
+    Eval mode quantizes with the stored window max without updating it."""
+    qmax = _qmax(bits)
+    cur = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    if training:
+        new_window = scales_window.at[it % window_size].set(cur)
+        new_it = it + 1
+    else:
+        new_window, new_it = scales_window, it
+    scale = jnp.maximum(jnp.max(new_window), 1e-8)
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax) / qmax * scale
+    return _ste(x, q), new_window, new_it, scale
+
+
 def quantize_to_int8(w, axis=-1):
     """Real int8 weight quantization for export. Returns (int8 array, f32 scales)."""
     reduce_axes = tuple(i for i in range(w.ndim) if i != (axis % w.ndim))

@@ -42,6 +42,34 @@ def test_core_unmatched_stays_documented(oc):
     assert oc.core_missing == [], oc.core_missing
 
 
+def test_disposition_table_is_exhaustive_and_regex_free(oc):
+    """VERDICT r4 #2: every unmatched op has an EXPLICIT disposition —
+    no prefix regex, no stale rows, every implemented-as target live."""
+    assert oc.undispositioned == [], oc.undispositioned
+    assert oc.stale == [], oc.stale
+    assert oc.bad_targets == [], oc.bad_targets
+    # the classifying regexes are gone for good
+    assert not hasattr(oc, "INFRA")
+    assert not hasattr(oc, "GRAD_REALIZED")
+    # every entry is one of the three honest kinds
+    for op, (kind, tgt, note) in oc.DISPOSITION.items():
+        assert kind in ("implemented-as", "N/A", "descoped"), (op, kind)
+        if kind == "implemented-as":
+            assert tgt, op
+        else:
+            assert note, op  # N/A and descoped must state their reason
+
+
+def test_r4_flagged_compute_ops_are_now_implemented(oc):
+    """The five ops the r4 audit found swept by the old INFRA regex are
+    real implementations now (tests/test_rec_ops.py), so they must MATCH
+    (not appear in the unmatched list at all)."""
+    for op in ("sequence_topk_avg_pooling", "batch_fc", "rank_attention",
+               "filter_by_instag", "pyramid_hash"):
+        assert oc.have(op), op
+        assert op not in oc.missing, op
+
+
 def test_fused_xla_claims_are_test_backed(oc):
     # the FUSED_XLA classification is only honest while the asserting test
     # file exists and names each op

@@ -43,6 +43,27 @@ class TestFakeQuantOps:
         _, s3 = fake_quantize_moving_average_abs_max(x2, s2, training=False)
         np.testing.assert_allclose(float(s3), float(s2), rtol=1e-6)
 
+    def test_range_abs_max_window(self):
+        from paddle_tpu.quantization import fake_quantize_range_abs_max
+
+        win = jnp.zeros((3,))
+        it = jnp.asarray(0, jnp.int32)
+        q, win, it, s1 = fake_quantize_range_abs_max(
+            jnp.ones((4,)) * 2.0, win, it, window_size=3, training=True)
+        assert float(s1) == 2.0 and int(it) == 1
+        _, win, it, s2 = fake_quantize_range_abs_max(
+            jnp.ones((4,)) * 8.0, win, it, window_size=3, training=True)
+        assert float(s2) == 8.0
+        # two more small steps evict the 8.0 entry from the 3-slot window
+        for v in (1.0, 1.0, 1.0):
+            _, win, it, s = fake_quantize_range_abs_max(
+                jnp.ones((4,)) * v, win, it, window_size=3, training=True)
+        np.testing.assert_allclose(float(s), 1.0, rtol=1e-6)
+        # eval: quantize with the stored window max, no state update
+        _, win2, it2, se = fake_quantize_range_abs_max(
+            jnp.ones((4,)) * 99.0, win, it, window_size=3, training=False)
+        assert float(se) == 1.0 and int(it2) == int(it)
+
     def test_int8_roundtrip(self):
         rng = np.random.RandomState(1)
         w = jnp.asarray(rng.randn(16, 8).astype(np.float32))

@@ -1,10 +1,16 @@
 """Operator-coverage report: reference REGISTER_OPERATOR surface vs this
-package. Aliases map reference op names to the 2.x API names they became;
-the INFRA pattern classifies framework/fused/PS-wire ops that are N/A by
-design on this architecture (XLA fusion, collective API, tensor arrays,
-DataLoader, quantization/ package). Prints the residual list.
+package. Aliases map reference op names to the 2.x API names they became.
 
-Usage: python tools/op_coverage.py
+Every reference op with no name/alias match gets an EXPLICIT per-op entry in
+DISPOSITION (VERDICT r4 #2 — no prefix regex sweeping): either
+`implemented-as <dotted api>` (target resolved against the live package),
+`N/A <reason>` (the role exists but the architecture dissolves the op —
+XLA fusion, jit feed binding, padded LoD), or `descoped <reason>` (a
+conscious, documented non-goal). The audit test
+(tests/test_op_coverage_audit.py) pins: zero unclassified ops, zero stale
+entries, every implemented-as target resolvable.
+
+Usage: python tools/op_coverage.py [-v]
 """
 import jax; jax.config.update("jax_platforms", "cpu")
 import glob, os, re, sys
@@ -25,6 +31,11 @@ import paddle_tpu.incubate as I
 import paddle_tpu.static as S
 import paddle_tpu.distributed as D
 import paddle_tpu.metric as M
+import paddle_tpu.quantization as Q
+import paddle_tpu.distributed.ps  # noqa: F401 — resolves ps.* targets
+import paddle_tpu.distributed.ps.tables  # noqa: F401
+import paddle_tpu.io.multislot  # noqa: F401 — resolves io.multislot targets
+import paddle_tpu.jit  # noqa: F401
 
 ALIAS = {  # op name -> our API name
  "elementwise_add":"add","elementwise_sub":"subtract","elementwise_mul":"multiply","elementwise_div":"divide",
@@ -86,10 +97,16 @@ ALIAS = {  # op name -> our API name
  "clip":"clip","clip_by_norm":"clip","hard_sigmoid":"hardsigmoid","hard_swish":"hardswish","hard_shrink":"hardshrink",
  # int8 serving table: pull() dequantizes (tests/test_xla_fusion_na.py)
  "lookup_table_dequant":"SparseTable.quantize",
+ # r5: hashed n-gram embeddings (pyramid_hash_op.cc) under the fluid
+ # contrib wrapper's name
+ "pyramid_hash":"search_pyramid_hash",
+ # QAT channel-wise quant: same op, 2.x argument order in the name
+ "fake_channel_wise_quantize_abs_max":"fake_quantize_channel_wise_abs_max",
 }
 import paddle_tpu.vision.transforms as VTR
 import paddle_tpu.distributed.ps.tables as PST
-MODS = [paddle, F, nn, V, T, I, S, D, M, VTR, PST, paddle.optimizer, paddle.amp, paddle.metric, paddle.static.nn]
+MODS = [paddle, F, nn, V, T, I, S, D, M, Q, VTR, PST, paddle.optimizer,
+        paddle.amp, paddle.metric, paddle.static.nn]
 def have(n):
     target = ALIAS.get(n, n)
     # dotted targets resolve attribute chains (e.g. a class method:
@@ -105,23 +122,250 @@ def have(n):
     return any(_has(m, target) for m in MODS) or \
         hasattr(paddle.Tensor, target)
 missing = sorted(n for n in names if not have(n))
-# infra/framework ops that are N/A by design on this architecture
-INFRA = re.compile(r"^(c_|fake_|fused_|fusion_|lookup_sparse_table|pull_|push_|quantize|dequantize|requantize|moving_average_abs_max|send|recv|listen|fetch|feed|load|save|memcpy|delete_var|get_places|enqueue|dequeue|checkpoint|prefetch|create_custom_reader|gen_nccl|gen_bkcl|nccl|ascend|heter|ref_by_trainer|rank_attention|batch_fc|pyramid_hash|filter_by_instag|tensorrt|lite_engine|run_program|seed|dgc|distributed_|split_byref|split_ids|merge_ids|split_selected_rows|merge_selected_rows|get_tensor_from_selected_rows|beam_search$|read|write_to_array|read_from_array|array_to_lod|lod_|merge_lod|split_lod|reorder_lod|max_sequence_len|shrink_rnn|rnn_memory|select_input|select_output|tensor_array|sparse_tensor_load|coalesce_tensor|share_data|update_loss|mul$|inplace_abn|sequence_)")
-# CUDA hand-fused kernels whose role XLA's own fusion plays — each claim is
-# ASSERTED on optimized HLO by tests/test_xla_fusion_na.py (epilogues fused,
-# no standalone elementwise in ENTRY), not just argued
+
+
+def IMPL(target, note=""):
+    """Realized by a live API; `target` is a dotted path from the paddle
+    root, verified resolvable by resolve_target()."""
+    return ("implemented-as", target, note)
+
+
+def NA(reason):
+    """The op's ROLE exists but this architecture dissolves the op itself
+    (XLA owns it, jit binding owns it, padded LoD removes it)."""
+    return ("N/A", "", reason)
+
+
+def DESCOPED(reason):
+    """Conscious non-goal, recorded in PARITY.md."""
+    return ("descoped", "", reason)
+
+
+_XLA_FUSED = ("CUDA hand-fused kernel; XLA fuses the same pattern — "
+              "ENTRY-block-asserted in tests/test_xla_fusion_na.py")
+_STREAM = ("CUDA stream ordering; XLA schedules compute and collectives "
+           "inside one program, no stream-sync ops exist")
+_RANK_TABLE = ("fluid DynamicRNN LoD-rank-table machinery; lax.scan over "
+               "padded batches (nn.RNN / nn.LSTM) replaces DynamicRNN")
+_SELROWS = ("SelectedRows sparse-gradient container; gradients are dense "
+            "by design (PARITY — XLA has no ragged rows), PS sparse paths "
+            "use the C++ table engine instead")
+_BOXPS = ("BoxPS — Baidu's GPU-box embedded-PS appliance path; "
+          "hardware-specific, descoped with heter-PS (PARITY §descopes)")
+
+# Every unmatched reference op, individually adjudicated. Order mirrors the
+# reference source tree: collectives, PS wire, quantization, fused kernels,
+# LoD/array control flow, executor plumbing, engines.
+DISPOSITION = {
+    # --- collective comm (operators/collective/) -------------------------
+    "c_allgather": IMPL("distributed.all_gather"),
+    "c_allreduce_sum": IMPL("distributed.all_reduce"),
+    "c_reducescatter": IMPL("distributed.reduce_scatter"),
+    "c_comm_init": IMPL("distributed.init_parallel_env",
+                        "NCCL communicator bootstrap -> mesh construction"),
+    "c_comm_init_all": IMPL("distributed.init_parallel_env"),
+    "c_gen_nccl_id": IMPL("distributed.init_parallel_env",
+                          "ncclUniqueId TCP exchange -> "
+                          "jax.distributed.initialize"),
+    "c_gen_bkcl_id": IMPL("distributed.init_parallel_env"),
+    "gen_nccl_id": IMPL("distributed.init_parallel_env"),
+    "gen_bkcl_id": IMPL("distributed.init_parallel_env"),
+    "c_sync_calc_stream": NA(_STREAM),
+    "c_sync_comm_stream": NA(_STREAM),
+    "c_wait_comm": NA(_STREAM),
+    "c_wait_compute": NA(_STREAM),
+    "nccl": NA("raw ncclAllReduce/Bcast/Reduce op wrappers; XLA ICI "
+               "collectives are the duals (distributed/collective.py)"),
+    "ascend_trigger": NA("Ascend-NPU scheduling hook; TPU is the "
+                         "first-class device here"),
+    # --- PS wire ops (operators/distributed/, pscore) --------------------
+    "listen_and_serv": IMPL("distributed.ps.server"),
+    "heter_listen_and_serv": DESCOPED("heter-PS GPU-cache serving path "
+                                      "(PARITY §descopes)"),
+    "send_and_recv": IMPL("distributed.ps.rpc"),
+    "send_barrier": IMPL("distributed.barrier"),
+    "fetch_barrier": IMPL("distributed.barrier"),
+    "prefetch": IMPL("distributed.ps.rpc",
+                     "sparse-row prefetch rides the same RPC pull"),
+    "recv_save": IMPL("distributed.ps.runtime",
+                      "server-side snapshot save"),
+    "checkpoint_notify": IMPL("distributed.ps.runtime",
+                              "snapshot trigger RPC"),
+    "distributed_lookup_table": IMPL("distributed.ps.tables.SparseTable"),
+    "lookup_sparse_table_read": IMPL("distributed.ps.tables.SparseTable"),
+    "lookup_sparse_table_write": IMPL("distributed.ps.tables.SparseTable"),
+    "lookup_sparse_table_init": IMPL("distributed.ps.tables.SparseTable"),
+    "lookup_sparse_table_merge": IMPL("distributed.ps.tables.SparseTable"),
+    "lookup_sparse_table_grad_split": IMPL(
+        "distributed.ps.tables.SparseTable"),
+    "lookup_sparse_table_fuse_adam": IMPL(
+        "distributed.ps.tables.SparseTable",
+        "server-side fused optimizer update (C++ sparse_table.cc)"),
+    "lookup_sparse_table_fuse_sgd": IMPL(
+        "distributed.ps.tables.SparseTable"),
+    "pull_sparse": IMPL("distributed.ps.rpc"),
+    "pull_sparse_v2": IMPL("distributed.ps.rpc"),
+    "push_sparse": IMPL("distributed.ps.rpc"),
+    "push_sparse_v2": IMPL("distributed.ps.rpc"),
+    "push_dense": IMPL("distributed.ps.rpc"),
+    "pull_box_sparse": DESCOPED(_BOXPS),
+    "pull_box_extended_sparse": DESCOPED(_BOXPS),
+    "push_box_sparse": DESCOPED(_BOXPS),
+    "push_box_extended_sparse": DESCOPED(_BOXPS),
+    "split_ids": IMPL("distributed.ps.server",
+                      "id->shard routing lives in the server"),
+    "merge_ids": IMPL("distributed.ps.server"),
+    "split_byref": NA("zero-copy row split feeding per-server sends; the "
+                      "RPC layer shards rows itself (distributed/ps/rpc.py)"),
+    "fake_init": NA("trainer-side placeholder init for remote params; "
+                    "params live server-side (distributed/ps/server.py)"),
+    "sparse_tensor_load": IMPL("distributed.ps.runtime",
+                               "PS snapshot load path"),
+    # --- quantization (operators/fake_quantize_op.cc etc.) ---------------
+    "fake_quantize_dequantize_abs_max": IMPL(
+        "quantization.fake_quantize_abs_max",
+        "fake_quantize_* IS quantize-dequantize with straight-through grad"),
+    "fake_quantize_dequantize_moving_average_abs_max": IMPL(
+        "quantization.fake_quantize_moving_average_abs_max"),
+    "fake_channel_wise_quantize_dequantize_abs_max": IMPL(
+        "quantization.fake_quantize_channel_wise_abs_max"),
+    "fake_dequantize_max_abs": IMPL("quantization.dequantize"),
+    "fake_channel_wise_dequantize_max_abs": IMPL("quantization.dequantize"),
+    "dequantize_abs_max": IMPL("quantization.dequantize"),
+    "moving_average_abs_max_scale": IMPL(
+        "quantization.fake_quantize_moving_average_abs_max",
+        "scale-tracking-only variant of the same observer"),
+    "quantize": NA("oneDNN int8 graph-pass op pair; TPU int8 deployment is "
+                   "the quantize_to_int8 artifact (quantization/ptq.py)"),
+    "requantize": NA("oneDNN int8 re-scale between int8 kernels; XLA owns "
+                     "the int8 dataflow"),
+    "dequantize_log": DESCOPED("log-scale quantization table (mobile slim "
+                               "artifact); abs-max int8 is the supported "
+                               "deployment format"),
+    # --- CUDA/oneDNN hand-fused kernels (operators/fused/) ---------------
+    "conv2d_fusion": NA(_XLA_FUSED),
+    "conv2d_inception_fusion": NA(_XLA_FUSED),
+    "multi_gru": NA(_XLA_FUSED),
+    "fused_batch_norm_act": NA(_XLA_FUSED),
+    "fused_bn_add_activation": NA(_XLA_FUSED),
+    "fused_elemwise_activation": NA(_XLA_FUSED),
+    "fused_elemwise_add_activation": NA(_XLA_FUSED),
+    "fused_embedding_fc_lstm": NA(_XLA_FUSED),
+    "fused_embedding_seq_pool": NA(_XLA_FUSED),
+    "fused_fc_elementwise_layernorm": NA(_XLA_FUSED),
+    "fusion_group": NA("runtime CUDA codegen for elementwise groups; "
+                       "XLA's fusion pass is this, always on"),
+    "fusion_gru": NA(_XLA_FUSED),
+    "fusion_lstm": NA(_XLA_FUSED),
+    "fusion_repeated_fc_relu": NA(_XLA_FUSED),
+    "fusion_seqconv_eltadd_relu": NA(_XLA_FUSED),
+    "fusion_seqexpand_concat_fc": NA(_XLA_FUSED),
+    "fusion_seqpool_concat": NA(_XLA_FUSED),
+    "fusion_seqpool_cvm_concat": NA(_XLA_FUSED),
+    "fusion_squared_mat_sub": NA(_XLA_FUSED),
+    "fusion_transpose_flatten_concat": NA(_XLA_FUSED),
+    "inplace_abn": NA("in-place activated BN saves activation memory; "
+                      "jax.checkpoint/remat owns the memory trade "
+                      "(distributed/spmd.py recompute)"),
+    # --- LoD / TensorArray control flow (operators/lod_*, *_array) -------
+    "write_to_array": IMPL("array_write"),
+    "read_from_array": IMPL("array_read"),
+    "lod_array_length": IMPL("array_length"),
+    "array_to_lod_tensor": NA("TensorArray->LoD glue; LoD is padded+mask "
+                              "by design (PARITY), arrays stack via "
+                              "paddle.concat/stack"),
+    "lod_tensor_to_array": NA("LoD->TensorArray glue; same padded design"),
+    "tensor_array_to_tensor": IMPL("create_array",
+                                   "array list + paddle.concat/stack"),
+    "lod_rank_table": NA(_RANK_TABLE),
+    "max_sequence_len": NA(_RANK_TABLE),
+    "reorder_lod_tensor_by_rank": NA(_RANK_TABLE),
+    "shrink_rnn_memory": NA(_RANK_TABLE),
+    "rnn_memory_helper": NA(_RANK_TABLE),
+    "lod_reset": NA("rewrites LoD metadata in place; padded+mask carries "
+                    "explicit length tensors instead (nn/functional/"
+                    "sequence.py family)"),
+    "split_lod_tensor": NA("fluid IfElse mask-split plumbing; lax.cond "
+                           "traces both branches (paddle.static.nn.cond)"),
+    "merge_lod_tensor": NA("fluid IfElse merge; jnp.where/lax.cond"),
+    "merge_lod_tensor_infer": NA("inference-mode IfElse merge; lax.cond"),
+    "select_input": NA("cond-block input router; lax.cond"),
+    "select_output": NA("cond-block output router; lax.cond"),
+    # --- SelectedRows plumbing -------------------------------------------
+    "get_tensor_from_selected_rows": NA(_SELROWS),
+    "merge_selected_rows": NA(_SELROWS),
+    "split_selected_rows": NA(_SELROWS),
+    # --- executor / scope / IO plumbing ----------------------------------
+    "feed": NA("Executor feed slot; jit argument binding "
+               "(static/__init__.py Executor.run feed dict)"),
+    "fetch": NA("Executor fetch slot; jit result binding"),
+    "delete_var": NA("scope GC op; XLA buffer liveness + python GC"),
+    "memcpy": NA("explicit H2D/D2H staging between scopes; "
+                 "jax.device_put and XLA manage placement"),
+    "get_places": IMPL("static.cpu_places",
+                       "device enumeration (paddle.static.cuda_places / "
+                       "paddle.get_device)"),
+    "load_combine": IMPL("static.load",
+                         "combined-file parameter bundle load"),
+    "save_combine": IMPL("static.save"),
+    "create_custom_reader": IMPL("io.DataLoader",
+                                 "decorated reader pipeline"),
+    "read": IMPL("io.DataLoader", "reader-op dequeue = loader iteration"),
+    "run_program": IMPL("jit.load",
+                        "dygraph sub-Program execution for loaded models"),
+    "coalesce_tensor": NA("grad-buffer fusion for allreduce bucketing; "
+                          "XLA's all-reduce combiner + SPMD own it "
+                          "(distributed/spmd.py)"),
+    "cross_entropy_grad2": NA("separately-registered grad kernel; tape "
+                              "autodiff realizes it, analytic-grad-checked "
+                              "(tests/test_xla_fusion_na.py::"
+                              "TestGradOpsAutodiffRealized)"),
+    # --- alternate inference engines -------------------------------------
+    "tensorrt_engine": NA("TensorRT subgraph offload; XLA is the compiler "
+                          "on TPU (inference/ Predictor AOT path)"),
+    "lite_engine": DESCOPED("Paddle-Lite mobile subgraph engine; "
+                            "deployment here is jit.save / ONNX export"),
+}
+
+
+def resolve_target(target):
+    """Dotted path from the paddle root (submodules imported above)."""
+    m = paddle
+    for part in target.split("."):
+        if not hasattr(m, part):
+            return False
+        m = getattr(m, part)
+    return True
+
+
+undispositioned = [n for n in missing if n not in DISPOSITION]
+stale = sorted(set(DISPOSITION) - set(missing))
+bad_targets = [n for n, (kind, tgt, _) in sorted(DISPOSITION.items())
+               if kind == "implemented-as" and not resolve_target(tgt)]
+core_missing = undispositioned + bad_targets
+# ops whose N/A cites the HLO-fusion assertion file — the audit test checks
+# the three specifically-asserted kernels appear there by name
 FUSED_XLA = {"conv2d_fusion", "conv2d_inception_fusion", "multi_gru"}
-# grad registrations are realized by the generic tape/vjp autodiff (SURVEY
-# layer 4c), not per-op grad kernels. `*_grad` names are already dropped at
-# the scan; cross_entropy2's separately-registered `_grad2` is the one
-# residual that reaches here. Backed by the analytic-gradient check in
-# tests/test_xla_fusion_na.py::TestGradOpsAutodiffRealized.
-GRAD_REALIZED = re.compile(r".*_grad2$")
-core_missing = [n for n in missing
-                if not INFRA.match(n) and n not in FUSED_XLA
-                and not GRAD_REALIZED.match(n)]
 
 if __name__ == "__main__":
+    kinds = {}
+    for n in missing:
+        k = DISPOSITION.get(n, ("UNCLASSIFIED", "", ""))[0]
+        kinds[k] = kinds.get(k, 0) + 1
     print("reference ops:", len(names), "| unmatched:", len(missing),
-          "| core unmatched:", len(core_missing))
-    print(core_missing)
+          "| dispositions:", dict(sorted(kinds.items())),
+          "| unclassified:", len(undispositioned),
+          "| stale entries:", len(stale),
+          "| unresolvable targets:", len(bad_targets))
+    if "-v" in sys.argv or undispositioned or stale or bad_targets:
+        width = max((len(n) for n in missing), default=10)
+        for n in missing:
+            kind, tgt, note = DISPOSITION.get(n, ("UNCLASSIFIED", "", ""))
+            detail = tgt if kind == "implemented-as" else note
+            if kind == "implemented-as" and note:
+                detail += f"  ({note})"
+            print(f"  {n:<{width}}  {kind:<15} {detail}")
+        for n in stale:
+            print(f"  STALE entry (op now matched or gone): {n}")
+        for n in bad_targets:
+            print(f"  UNRESOLVABLE target: {n} -> {DISPOSITION[n][1]}")
