@@ -88,10 +88,6 @@ class ServingEngine:
         self.eos = eos_token_id
         # argument validation FIRST — before any device allocation/compile
         if prefill_chunk is not None:
-            if tp_mesh is not None:
-                raise ValueError(
-                    "prefill_chunk with tp_mesh is not supported yet "
-                    "(the chunk side-cache would need sharded allocation)")
             if not 1 <= int(prefill_chunk) <= self.T:
                 raise ValueError(
                     f"prefill_chunk must be in [1, max_seq_len={self.T}], "
@@ -143,6 +139,16 @@ class ServingEngine:
                 out_shardings=jax.tree_util.tree_map(lambda s: shard, tpl))
             self._kc, self._vc = alloc()
             self._cache_spec = cache_spec
+            # single-row SIDE caches (chunked prefill staging, shared
+            # prefixes) use the same global-layout + head-sharded
+            # allocation recipe as the big cache
+            side_tpl = jax.eval_shape(
+                lambda: dense_cache_init(1, self.T, cache_dt))
+            side_alloc = jax.jit(
+                lambda: jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), side_tpl),
+                out_shardings=jax.tree_util.tree_map(
+                    lambda s: shard, side_tpl))
 
         def prefill(p, ids_padded, true_len):
             """ids_padded [1, Pb] right-padded; returns (kc1, vc1,
@@ -243,6 +249,14 @@ class ServingEngine:
                 step_sample, tp_mesh, tp_specs, 0, (P(), cs, cs),
                 in_specs=(tp_specs, cs, cs, P(), P(), P(), P(), P()),
                 donate=(1, 2))
+            # chunked prefill composes with tp: the chunk side-cache
+            # allocates head-sharded (side_alloc above) and the chunk
+            # program runs inside the same shard_map recipe
+            self._prefill_start = side_alloc
+            self._prefill_chunk = _tp_wrap(
+                prefill_chunk_fn, tp_mesh, tp_specs, 0, (cs, cs, P()),
+                in_specs=(tp_specs, P(), P(), cs, cs, P()),
+                donate=(3, 4))
         # admit slices only the batch axis: a plain jit partitions it
         # fine over the head-sharded cache
         self._admit = jax.jit(admit, donate_argnums=(0,))
@@ -251,9 +265,10 @@ class ServingEngine:
             lg[None], t[None], k[None], s[None], p_[None])[0])
 
         self._chunk = None if prefill_chunk is None else int(prefill_chunk)
-        self._prefill_start = prefill_start
-        self._prefill_chunk = jax.jit(prefill_chunk_fn,
-                                      donate_argnums=(3, 4))
+        if tp_mesh is None:
+            self._prefill_start = prefill_start
+            self._prefill_chunk = jax.jit(prefill_chunk_fn,
+                                          donate_argnums=(3, 4))
         # slot -> [req, kc1, vc1, consumed_offset, chunk_width]
         self._prefilling = {}
         # registered shared prefixes: pid -> (ids, kc1, vc1). The chunk fn
@@ -281,9 +296,6 @@ class ServingEngine:
         using it prefill only their suffix."""
         import jax.numpy as jnp
 
-        if self._tp_mesh is not None:
-            raise ValueError("register_prefix with tp_mesh is not "
-                             "supported yet (sharded side cache)")
         ids = prefix_ids._data if isinstance(prefix_ids, Tensor) \
             else np.asarray(prefix_ids)
         ids = np.asarray(ids, np.int32).ravel()

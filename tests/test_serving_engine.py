@@ -236,13 +236,6 @@ class TestChunkedPrefill:
             ServingEngine(m, max_batch=1, prefill_chunk=0)
         with pytest.raises(ValueError, match="prefill_chunk"):
             ServingEngine(m, max_batch=1, prefill_chunk=129)  # > T
-        if len(jax.devices()) >= 4:
-            from paddle_tpu.distributed.mesh import build_mesh
-
-            mesh = build_mesh((4,), ("mp",), devices=jax.devices()[:4])
-            with pytest.raises(ValueError, match="tp_mesh"):
-                ServingEngine(m, max_batch=1, tp_mesh=mesh,
-                              prefill_chunk=8)
 
 
 class TestPrefixCaching:
@@ -309,6 +302,82 @@ class TestPrefixCaching:
             eng.register_prefix(np.zeros((0,), np.int32))
         with pytest.raises(ValueError, match="too long"):
             eng.register_prefix(np.zeros((200,), np.int32))
+
+
+class TestTPComposition:
+    """r5 (VERDICT r4 #3): chunked prefill and shared-prefix caching now
+    COMPOSE with tensor-parallel serving — the side caches use the same
+    head-sharded eval_shape + NamedSharding allocation as the persistent
+    cache, and the chunk program runs inside the same shard_map recipe.
+    Same exact-parity bar as every other serving mode."""
+
+    def _mesh(self):
+        import jax
+
+        from paddle_tpu.distributed.mesh import build_mesh
+
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 virtual devices")
+        return build_mesh((4,), ("mp",), devices=jax.devices()[:4])
+
+    def test_tp_chunked_matches_generate(self, rng):
+        m = _model()
+        eng = ServingEngine(m, max_batch=2, tp_mesh=self._mesh(),
+                            prefill_chunk=8)
+        prompts = [rng.randint(0, 256, (n,)).astype(np.int32)
+                   for n in (21, 6, 13)]
+        rids = [eng.submit(p, max_new_tokens=7) for p in prompts]
+        res = eng.run_until_complete()
+        for rid, p in zip(rids, prompts):
+            np.testing.assert_array_equal(res[rid].tokens,
+                                          _ref_new_tokens(m, p, 7))
+
+    def test_tp_prefix_matches_full_prompt(self, rng):
+        m = _model()
+        eng = ServingEngine(m, max_batch=2, tp_mesh=self._mesh())
+        prefix = rng.randint(0, 256, (20,)).astype(np.int32)
+        pid = eng.register_prefix(prefix)
+        sufs = [rng.randint(0, 256, (n,)).astype(np.int32)
+                for n in (5, 12)]
+        rids = [eng.submit(s, max_new_tokens=6, prefix_id=pid)
+                for s in sufs]
+        res = eng.run_until_complete()
+        for rid, s in zip(rids, sufs):
+            np.testing.assert_array_equal(
+                res[rid].tokens,
+                _ref_new_tokens(m, np.concatenate([prefix, s]), 6))
+
+    def test_tp_prefix_with_chunked_and_int8(self, rng):
+        # the full matrix corner: tp x chunked x prefix x int8 KV
+        m = _model()
+        eng = ServingEngine(m, max_batch=2, tp_mesh=self._mesh(),
+                            prefill_chunk=8, cache_dtype="int8")
+        prefix = rng.randint(0, 256, (17,)).astype(np.int32)
+        pid = eng.register_prefix(prefix)
+        s = rng.randint(0, 256, (9,)).astype(np.int32)
+        rid = eng.submit(s, max_new_tokens=5, prefix_id=pid)
+        p2 = rng.randint(0, 256, (24,)).astype(np.int32)
+        r2 = eng.submit(p2, max_new_tokens=5)
+        res = eng.run_until_complete()
+        np.testing.assert_array_equal(
+            res[rid].tokens,
+            _ref_new_tokens(m, np.concatenate([prefix, s]), 5,
+                            cache_dtype="int8"))
+        # the plain-chunked half of the corner (no prefix) must hold too
+        np.testing.assert_array_equal(
+            res[r2].tokens, _ref_new_tokens(m, p2, 5, cache_dtype="int8"))
+
+    def test_tp_prefix_near_capacity_falls_back(self, rng):
+        m = _model()
+        eng = ServingEngine(m, max_batch=1, tp_mesh=self._mesh())
+        prefix = rng.randint(0, 256, (90,)).astype(np.int32)
+        pid = eng.register_prefix(prefix)
+        s = rng.randint(0, 256, (30,)).astype(np.int32)  # 90+64-chunk > T
+        rid = eng.submit(s, max_new_tokens=4, prefix_id=pid)
+        res = eng.run_until_complete()
+        np.testing.assert_array_equal(
+            res[rid].tokens,
+            _ref_new_tokens(m, np.concatenate([prefix, s]), 4))
 
 
 class TestSlotLifecycle:
