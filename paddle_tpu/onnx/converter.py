@@ -30,13 +30,24 @@ class _Graph:
     """Accumulates ONNX nodes/initializers with SSA naming."""
 
     def __init__(self):
-        self.nodes = []
+        # nodes stay as SPECS (op_type, inputs, outputs, name, attrs)
+        # until build_nodes(): the dynamic-batch rewrite and the
+        # initializer dedup pass both need to compare/remap inputs before
+        # anything is serialized
+        self.node_specs = []
         self.initializers = []
         self._init_names = set()
         self.var_names = {}     # jax Var -> onnx value name
         self.produced = set()   # names produced by a node (not init/input)
         self._value_cache = {}  # (dtype, shape, bytes) -> initializer name
         self.counter = 0
+        # dynamic-batch bookkeeping: raw arrays + list index per
+        # initializer (so a shape const can be REWRITTEN after the
+        # two-trace diff), and (op_type, operand position) per consumer of
+        # each value name — position matters: only the SHAPE operand
+        # (input 1) of Reshape/Expand is rewritable
+        self.init_arrays = {}   # name -> (index in initializers, ndarray)
+        self.consumers = {}     # value name -> set of (op_type, arg_pos)
 
     def fresh(self, hint="t"):
         self.counter += 1
@@ -52,12 +63,16 @@ class _Graph:
             self.var_names[atom] = self.fresh("v")
         return self.var_names[atom]
 
-    def const(self, array, name=None):
+    def const(self, array, name=None, dedup=True):
         arr = np.asarray(array)
         if name is None:
             # dedup small constants by value: jaxpr Literals repeat the
-            # same scalars (1.0, 0.5, sqrt(2)...) once per layer
-            if arr.size <= 64:
+            # same scalars (1.0, 0.5, sqrt(2)...) once per layer.
+            # Shape vectors opt OUT (dedup=False): a batch-carrying shape
+            # like [B*T, H] can coincidentally equal an unrelated constant
+            # at one batch size but not another, which would break the
+            # dynamic-batch two-trace structural diff.
+            if dedup and arr.size <= 64:
                 key = (str(arr.dtype), arr.shape, arr.tobytes())
                 cached = self._value_cache.get(key)
                 if cached is not None:
@@ -68,20 +83,33 @@ class _Graph:
                 name = self.fresh("const")
         if name not in self._init_names:
             self._init_names.add(name)
+            self.init_arrays[name] = (len(self.initializers), arr)
             self.initializers.append(proto.tensor_proto(name, arr))
         return name
 
+    def replace_const(self, name, arr):
+        """Rewrite an initializer in place (dynamic-batch shape surgery)."""
+        idx, _ = self.init_arrays[name]
+        arr = np.asarray(arr)
+        self.init_arrays[name] = (idx, arr)
+        self.initializers[idx] = proto.tensor_proto(name, arr)
+
     def shape_const(self, dims):
-        return self.const(np.asarray(dims, np.int64))
+        return self.const(np.asarray(dims, np.int64), dedup=False)
 
     def add(self, op_type, inputs, n_out=1, attrs=None, out_names=None):
         outs = out_names or [self.fresh(op_type.lower())
                              for _ in range(n_out)]
-        self.nodes.append(proto.node_proto(
-            op_type, inputs, outs, name=self.fresh(f"n_{op_type}"),
-            attrs=attrs))
+        self.node_specs.append([op_type, list(inputs), list(outs),
+                                self.fresh(f"n_{op_type}"), attrs])
         self.produced.update(outs)
+        for pos, nm in enumerate(inputs):
+            self.consumers.setdefault(nm, set()).add((op_type, pos))
         return outs if n_out != 1 or out_names else outs[0]
+
+    def build_nodes(self):
+        return [proto.node_proto(op, ins, outs, name=nm, attrs=attrs)
+                for op, ins, outs, nm, attrs in self.node_specs]
 
 
 _ELEMENTWISE = {
@@ -160,13 +188,14 @@ class Converter:
             f"(eqn: {eqn})")
 
     # -- call-like primitives: inline ---------------------------------------
-    def _inline(self, eqn, inner_jaxpr, consts):
-        """Inline a sub-jaxpr with PROPER SCOPING: jax caches and SHARES
-        jaxpr objects (two relu eqns carry the identical call_jaxpr with
-        the same Var objects), so the inner vars' name bindings must be
-        saved/cleared per inline and restored after — otherwise the second
-        inline of a shared jaxpr silently reuses the first one's SSA names
-        and two nodes write the same output."""
+    def _inline_body(self, inner_jaxpr, consts, input_names):
+        """Emit a sub-jaxpr's eqns with its invars bound to `input_names`;
+        returns the body's output value names. PROPER SCOPING: jax caches
+        and SHARES jaxpr objects (two relu eqns carry the identical
+        call_jaxpr with the same Var objects), so the inner vars' name
+        bindings must be saved/cleared per inline and restored after —
+        otherwise the second inline of a shared jaxpr silently reuses the
+        first one's SSA names and two nodes write the same output."""
         from jax._src.core import Literal
 
         owned = list(inner_jaxpr.invars) + list(inner_jaxpr.constvars)
@@ -179,8 +208,8 @@ class Converter:
 
         for var, cval in zip(inner_jaxpr.constvars, consts):
             self.g.var_names[var] = self.g.const(np.asarray(cval))
-        for inner_v, outer_atom in zip(inner_jaxpr.invars, eqn.invars):
-            self.g.var_names[inner_v] = self.g.name_of(outer_atom)
+        for inner_v, nm in zip(inner_jaxpr.invars, input_names):
+            self.g.var_names[inner_v] = nm
         self._eqns(inner_jaxpr.eqns)
         out_names = []
         for inner_v in inner_jaxpr.outvars:
@@ -192,8 +221,66 @@ class Converter:
         for v in owned:
             self.g.var_names.pop(v, None)
         self.g.var_names.update(saved)
+        return out_names
+
+    def _inline(self, eqn, inner_jaxpr, consts):
+        out_names = self._inline_body(
+            inner_jaxpr, consts,
+            [self.g.name_of(a) for a in eqn.invars])
         for outer_v, nm in zip(eqn.outvars, out_names):
             self.g.var_names[outer_v] = nm
+
+    def _op_scan(self, eqn):
+        """lax.scan UNROLLED (static length — the RNN/LSTM/GRU layer
+        family's time loop): each step inlines the body with the carries
+        threaded through and xs[t] sliced out; stacked ys re-assemble with
+        Concat. The unrolled form needs no ONNX Loop subgraph and the
+        numpy re-executor verifies it like any other graph (the
+        reference's paddle2onnx emits recurrent layers as fused ONNX
+        LSTM/GRU kernels — an unrolled graph trades file size for exact
+        per-step parity with the traced model)."""
+        closed = eqn.params["jaxpr"]
+        inner = closed.jaxpr
+        nc = int(eqn.params["num_consts"])
+        nk = int(eqn.params["num_carry"])
+        L = int(eqn.params["length"])
+        rev = bool(eqn.params.get("reverse", False))
+        const_names = [self.g.name_of(a) for a in eqn.invars[:nc]]
+        carry_names = [self.g.name_of(a) for a in eqn.invars[nc:nc + nk]]
+        xs = eqn.invars[nc + nk:]
+        ax0 = self.g.const(np.asarray([0], np.int64))
+        one = self.g.const(np.asarray([1], np.int64))
+        n_ys = len(eqn.outvars) - nk
+        ys_steps = [[] for _ in range(n_ys)]
+        order = range(L - 1, -1, -1) if rev else range(L)
+        for t in order:
+            x_names = []
+            for xv in xs:
+                sl = self.g.add("Slice", [
+                    self.g.name_of(xv),
+                    self.g.const(np.asarray([t], np.int64)),
+                    self.g.const(np.asarray([t + 1], np.int64)),
+                    ax0, one])
+                step_shape = list(xv.aval.shape[1:])
+                x_names.append(self.g.add(
+                    "Reshape", [sl, self.g.shape_const(step_shape)]))
+            outs = self._inline_body(inner, closed.consts,
+                                     const_names + carry_names + x_names)
+            carry_names = outs[:nk]
+            for i, y in enumerate(outs[nk:]):
+                yv = eqn.outvars[nk + i]
+                ys_steps[i].append(self.g.add(
+                    "Reshape", [y, self.g.shape_const(
+                        [1] + list(yv.aval.shape[1:]))]))
+        for ov, nm in zip(eqn.outvars[:nk], carry_names):
+            self.g.var_names[ov] = nm
+        for i, ov in enumerate(eqn.outvars[nk:]):
+            steps = ys_steps[i][::-1] if rev else ys_steps[i]
+            if len(steps) == 1:
+                self.g.var_names[ov] = steps[0]
+            else:
+                self.g.var_names[ov] = self.g.add(
+                    "Concat", steps, attrs={"axis": 0})
 
     def _op_pjit(self, eqn):
         closed = eqn.params["jaxpr"]
@@ -564,19 +651,10 @@ class Converter:
                    out_names=[self.g.name_of(eqn.outvars[0])])
 
 
-def convert(pure_fn, params_flat_named, example_args, input_names=None,
-            model_name="model"):
-    """Trace pure_fn(params_list, *args) and convert to ONNX model bytes.
-
-    params_flat_named: list of (name, np.ndarray) weights — become graph
-    initializers. example_args: example input arrays (fix the traced
-    shapes; ONNX export is static-shape by design here, matching the
-    reference's fixed-shape .onnx outputs).
-    """
+def _convert_once(pure_fn, params_flat_named, arrs, names):
+    """One trace+convert pass; returns (conv, out_vars, out_names)."""
     import jax
 
-    arrs = [np.asarray(a) for a in example_args]
-    names = list(input_names or [f"input_{i}" for i in range(len(arrs))])
     closed = jax.make_jaxpr(
         lambda ps, *xs: pure_fn(ps, *xs))(
             [v for _, v in params_flat_named], *arrs)
@@ -596,15 +674,152 @@ def convert(pure_fn, params_flat_named, example_args, input_names=None,
         if nm not in conv.g.produced or nm in seen:
             out_names[i] = conv.g.add("Identity", [nm])
         seen.add(out_names[i])
+    return conv, out_vars, out_names
 
-    in_infos = [proto.value_info(
-        nm, proto.NP_TO_ONNX[str(a.dtype)], a.shape)
-        for nm, a in zip(names, arrs)]
+
+def _batch_polymorphic_rewrite(conv, conv2):
+    """Make the traced graph batch-size-polymorphic by DIFFING two traces
+    (batch B vs B+1): structurally identical graphs whose only differences
+    are batch-carrying shape constants get those constants rewritten to
+    ONNX's symbolic forms — Reshape targets to 0 (copy input dim) or a
+    single -1 (infer, covers flattened B*k dims), Expand shapes to 1
+    (two-way broadcast keeps the input's dim). Anything else that differs
+    means the model genuinely computes with the batch size; raise rather
+    than emit a graph that would be silently wrong at other batches. The
+    export validator re-executes at BOTH batch sizes afterwards, so a
+    rewrite this diff got wrong cannot ship."""
+    g1, g2 = conv.g, conv2.g
+    if len(g1.node_specs) != len(g2.node_specs) or \
+            len(g1.initializers) != len(g2.initializers):
+        raise UnsupportedOpError(
+            "dynamic batch: traced graph structure depends on the batch "
+            "size (node/initializer counts differ between batch traces)")
+    if g1.node_specs != g2.node_specs:
+        raise UnsupportedOpError(
+            "dynamic batch: node wiring depends on the batch size")
+    by_index = {idx: (nm, arr) for nm, (idx, arr) in g1.init_arrays.items()}
+    for nm, (idx, a2) in g2.init_arrays.items():
+        nm1, a1 = by_index[idx]
+        if nm1 != nm:
+            raise UnsupportedOpError(
+                "dynamic batch: initializer naming depends on batch size")
+        same_meta = a1.shape == a2.shape and a1.dtype == a2.dtype
+        eq_nan = np.issubdtype(a1.dtype, np.floating)  # NaN consts (masks)
+        if same_meta and np.array_equal(a1, a2, equal_nan=eq_nan):
+            continue
+        cons = g1.consumers.get(nm, set())
+        # rewritable ONLY as the SHAPE operand (position 1) of Reshape or
+        # Expand — the same values as a DATA operand anywhere would be
+        # silently corrupted by a rewrite
+        ok_shape = (a1.dtype == np.int64 and a1.ndim == 1
+                    and a1.shape == a2.shape)
+        ops = {op for op, _ in cons}
+        positions_ok = cons and all(pos == 1 and op in ("Reshape", "Expand")
+                                    for op, pos in cons)
+        if not ok_shape or not positions_ok:
+            raise UnsupportedOpError(
+                f"dynamic batch: constant {nm} (consumed by {sorted(cons)})"
+                " differs between batch traces and is not a rewritable "
+                "shape vector — the model is not batch-polymorphic")
+        diff = [i for i in range(a1.size) if a1[i] != a2[i]]
+        new = a1.copy()
+        if ops == {"Reshape"}:
+            if len(diff) == 1:
+                new[diff[0]] = -1          # infer: covers B and B*k dims
+            else:
+                for i in diff:
+                    new[i] = 0             # copy input dim at that index
+        elif ops == {"Expand"}:
+            for i in diff:
+                new[i] = 1                 # two-way broadcast keeps input
+        else:  # mixed consumers: no single rewrite is sound
+            raise UnsupportedOpError(
+                f"dynamic batch: shape constant {nm} feeds both Reshape "
+                "and Expand; cannot rewrite soundly")
+        conv.g.replace_const(nm, new)
+
+
+def _dedup_initializers(g):
+    """Merge byte-identical const_* initializers and remap node inputs.
+    Runs AFTER the dynamic-batch rewrite (shape_const skips value-dedup at
+    creation so the two-trace diff stays structurally aligned; the
+    unrolled-scan path would otherwise ship one identical shape vector
+    per timestep). Named weights are never merged."""
+    canon, rename = {}, {}
+    new_inits, new_arrays = [], {}
+    ordered = sorted(g.init_arrays.items(), key=lambda kv: kv[1][0])
+    for nm, (_, arr) in ordered:
+        if nm.startswith("const_"):
+            key = (str(arr.dtype), arr.shape, arr.tobytes())
+            if key in canon:
+                rename[nm] = canon[key]
+                continue
+            canon[key] = nm
+        new_arrays[nm] = (len(new_inits), arr)
+        new_inits.append(proto.tensor_proto(nm, arr))
+    g.initializers = new_inits
+    g.init_arrays = new_arrays
+    if rename:
+        for spec in g.node_specs:
+            spec[1] = [rename.get(nm, nm) for nm in spec[1]]
+
+
+def convert(pure_fn, params_flat_named, example_args, input_names=None,
+            model_name="model", dynamic_batch_axes=None):
+    """Trace pure_fn(params_list, *args) and convert to ONNX model bytes.
+
+    params_flat_named: list of (name, np.ndarray) weights — become graph
+    initializers. example_args: example input arrays (fix the traced
+    shapes). dynamic_batch_axes: list of bool per input — True marks the
+    input's axis 0 as the symbolic batch dimension 'N' (the reference
+    delegates dynamic axes to paddle2onnx; here a second trace at batch+1
+    proves the graph is batch-polymorphic and batch-carrying shape
+    constants are rewritten to symbolic forms — see
+    _batch_polymorphic_rewrite).
+    """
+    arrs = [np.asarray(a) for a in example_args]
+    names = list(input_names or [f"input_{i}" for i in range(len(arrs))])
+    dyn = list(dynamic_batch_axes or [])
+    conv, out_vars, out_names = _convert_once(
+        pure_fn, params_flat_named, arrs, names)
+
+    # out_dyn_syms[i]: axis -> symbolic name. 'N' ONLY when the axis IS the
+    # batch dimension (size B in one trace, B+1 in the other); other
+    # batch-dependent sizes (a flattened B*T, say) get their own distinct
+    # symbol so downstream shape inference can't unify contradictions.
+    out_dyn_syms = [dict() for _ in out_vars]
+    if any(dyn):
+        b1 = next(a.shape[0] for a, d in zip(arrs, dyn) if d)
+        arrs2 = [np.concatenate([a, a[:1]], axis=0) if d else a
+                 for a, d in zip(arrs, dyn)]
+        conv2, out_vars2, _ = _convert_once(
+            pure_fn, params_flat_named, arrs2, names)
+        _batch_polymorphic_rewrite(conv, conv2)
+        for i, (ov, ov2) in enumerate(zip(out_vars, out_vars2)):
+            s1, s2 = tuple(ov.aval.shape), tuple(ov2.aval.shape)
+            if len(s1) != len(s2):
+                raise UnsupportedOpError(
+                    "dynamic batch: output rank depends on batch size")
+            for a in range(len(s1)):
+                if s1[a] != s2[a]:
+                    sym = "N" if (s1[a], s2[a]) == (b1, b1 + 1) \
+                        else f"dyn_{i}_{a}"
+                    out_dyn_syms[i][a] = sym
+    _dedup_initializers(conv.g)
+
+    def _dims(shape, syms):
+        return [syms.get(a, int(d)) for a, d in enumerate(shape)]
+
+    in_infos = []
+    for i, (nm, a) in enumerate(zip(names, arrs)):
+        syms = {0: "N"} if (i < len(dyn) and dyn[i]) else {}
+        in_infos.append(proto.value_info(
+            nm, proto.NP_TO_ONNX[str(a.dtype)], _dims(a.shape, syms)))
     out_infos = []
-    for ov, nm in zip(out_vars, out_names):
+    for ov, nm, syms in zip(out_vars, out_names, out_dyn_syms):
         out_infos.append(proto.value_info(
             nm, proto.NP_TO_ONNX[str(np.dtype(ov.aval.dtype))],
-            [int(d) for d in ov.aval.shape]))
-    graph = proto.graph_proto(model_name, conv.g.nodes,
+            _dims(tuple(int(d) for d in ov.aval.shape), syms)))
+    graph = proto.graph_proto(model_name, conv.g.build_nodes(),
                               conv.g.initializers, in_infos, out_infos)
     return proto.model_proto(graph)

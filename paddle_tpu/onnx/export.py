@@ -45,7 +45,12 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
     configs: `output_spec` accepted for signature parity (ignored — all
     forward outputs are exported); `atol`/`rtol` override the validation
     tolerances (defaults 1e-5); `validate=False` skips the numpy
-    re-execution (e.g. huge models).
+    re-execution (e.g. huge models); `dynamic_batch` (default True)
+    controls whether InputSpec dims of None/-1 on axis 0 become a symbolic
+    'N' batch dimension in the emitted graph — proven sound by a second
+    trace at batch+1 (converter._batch_polymorphic_rewrite) and validated
+    by re-executing at BOTH batch sizes; models whose graphs genuinely
+    depend on the batch size raise UnsupportedOpError under it.
 
     Raises converter.UnsupportedOpError if the traced graph contains a
     primitive with no ONNX lowering — no .onnx is written in that case
@@ -84,36 +89,60 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
     pure_d = io.layer_pure_fn(layer, force_eval=True)  # inference graph
 
     def pure(plist, *xs):
+        import jax
+
         out = pure_d(dict(zip(names, plist)), *xs)
-        return list(out) if isinstance(out, (list, tuple)) else [out]
+        # fully flatten nested outputs (e.g. LSTM's (out, (h, c))) — the
+        # graph outputs are the flat leaves, in tree order
+        return jax.tree_util.tree_leaves(out)
 
     input_names = [getattr(s, "name", None) or f"input_{i}"
                    for i, s in enumerate(spec_list)]
+    dyn_axes = None
+    if configs.get("dynamic_batch", True):
+        dyn_axes = [bool(getattr(s, "shape", None))
+                    and len(s.shape) > 0
+                    and (s.shape[0] is None or int(s.shape[0]) < 0)
+                    for s in spec_list]
+        if not any(dyn_axes):
+            dyn_axes = None
     model_bytes = converter.convert(pure, params_named, args,
-                                    input_names=input_names)
+                                    input_names=input_names,
+                                    dynamic_batch_axes=dyn_axes)
 
     if configs.get("validate", True):
-        expect = [np.asarray(v) for v in
-                  pure([v for _, v in params_named], *args)]
-        got = runtime.run(model_bytes, dict(zip(input_names, args)))
         atol = configs.get("atol", 1e-5)
         rtol = configs.get("rtol", 1e-5)
-        if len(got) != len(expect):
-            raise RuntimeError(
-                f"onnx.export self-check: output arity {len(got)} != "
-                f"{len(expect)}")
-        for i, (a, b) in enumerate(zip(got, expect)):
-            if tuple(a.shape) != tuple(b.shape):
+
+        def check(arg_set):
+            expect = [np.asarray(v) for v in
+                      pure([v for _, v in params_named], *arg_set)]
+            got = runtime.run(model_bytes,
+                              dict(zip(input_names, arg_set)))
+            if len(got) != len(expect):
                 raise RuntimeError(
-                    f"onnx.export self-check: output {i} shape {a.shape} "
-                    f"!= {b.shape}")
-            if not np.allclose(a.astype(np.float64), b.astype(np.float64),
-                               atol=atol, rtol=rtol):
-                diff = float(np.max(np.abs(a.astype(np.float64)
-                                           - b.astype(np.float64))))
-                raise RuntimeError(
-                    f"onnx.export self-check: output {i} max diff {diff} "
-                    f"exceeds atol={atol}/rtol={rtol}")
+                    f"onnx.export self-check: output arity {len(got)} != "
+                    f"{len(expect)}")
+            for i, (a, b) in enumerate(zip(got, expect)):
+                if tuple(a.shape) != tuple(b.shape):
+                    raise RuntimeError(
+                        f"onnx.export self-check: output {i} shape "
+                        f"{a.shape} != {b.shape}")
+                if not np.allclose(a.astype(np.float64),
+                                   b.astype(np.float64),
+                                   atol=atol, rtol=rtol):
+                    diff = float(np.max(np.abs(a.astype(np.float64)
+                                               - b.astype(np.float64))))
+                    raise RuntimeError(
+                        f"onnx.export self-check: output {i} max diff "
+                        f"{diff} exceeds atol={atol}/rtol={rtol}")
+
+        check(args)
+        if dyn_axes:
+            # the dynamic-batch claim is only honest if the graph runs
+            # and matches at a batch size the trace never saw
+            check([np.concatenate([a, a[:1]], axis=0) if d else a
+                   for a, d in zip(args, dyn_axes)])
 
     onnx_path = path if path.endswith(".onnx") else path + ".onnx"
     with open(onnx_path, "wb") as f:

@@ -145,10 +145,15 @@ def node_proto(op_type, inputs, outputs, name="", attrs=None):
 
 def value_info(name, elem_type, shape):
     """ValueInfoProto: name=1, type=2; TypeProto.tensor_type=1;
-    Tensor: elem_type=1, shape=2; TensorShapeProto.dim=1; dim_value=1."""
+    Tensor: elem_type=1, shape=2; TensorShapeProto.dim=1; dim_value=1,
+    dim_param=2 (a str entry in `shape` becomes a symbolic dimension —
+    the dynamic-batch export path emits 'N' for the batch axis)."""
     shape_body = b""
     for d in shape:
-        shape_body += f_bytes(1, f_varint(1, int(d)))
+        if isinstance(d, str):
+            shape_body += f_bytes(1, f_bytes(2, d))
+        else:
+            shape_body += f_bytes(1, f_varint(1, int(d)))
     tensor_body = f_varint(1, elem_type) + f_bytes(2, shape_body)
     type_body = f_bytes(1, tensor_body)
     return f_bytes(1, name) + f_bytes(2, type_body)

@@ -173,11 +173,21 @@ def run(model_bytes, inputs):
         elif op == "Identity":
             out = ins[0]
         elif op == "Reshape":
-            out = ins[0].reshape([int(d) for d in ins[1]])
+            # ONNX semantics: 0 copies the input dim at that index (with
+            # allowzero=0, the default), -1 infers — both are what the
+            # dynamic-batch export emits for batch-carrying shape consts
+            tgt = [int(d) for d in ins[1]]
+            tgt = [ins[0].shape[i] if d == 0 else d
+                   for i, d in enumerate(tgt)]
+            out = ins[0].reshape(tgt)
         elif op == "Transpose":
             out = np.transpose(ins[0], at["perm"])
         elif op == "Expand":
-            out = np.broadcast_to(ins[0], [int(d) for d in ins[1]])
+            # ONNX Expand is TWO-WAY broadcast: output dim = max(input,
+            # shape) per numpy rules (a 1 in `shape` keeps the input dim)
+            tgt = np.broadcast_shapes(ins[0].shape,
+                                      tuple(int(d) for d in ins[1]))
+            out = np.broadcast_to(ins[0], tgt)
         elif op == "Concat":
             out = np.concatenate(ins, axis=at["axis"])
         elif op == "Split":
