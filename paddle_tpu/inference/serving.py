@@ -315,6 +315,24 @@ class ServingEngine:
         self._prefixes[pid] = (ids, kc1, vc1)
         return pid
 
+    def get_request(self, rid):
+        """The live Request object for a submitted id — queued, in-flight,
+        or finished (observability: latency trackers read output_ids as
+        tokens stream without touching engine internals). Raises KeyError
+        for an unknown id."""
+        for req in self._queue:
+            if req.rid == rid:
+                return req
+        for req in self._slot_req:
+            if req is not None and req.rid == rid:
+                return req
+        for entry in self._prefilling.values():
+            if entry[0].rid == rid:
+                return entry[0]
+        if rid in self._finished:
+            return self._finished[rid]
+        raise KeyError(f"unknown request id {rid}")
+
     def unregister_prefix(self, prefix_id):
         """Free a registered prefix's cached KV (each pins a [1, max_seq]
         side cache on device — long-lived engines rotating system prompts

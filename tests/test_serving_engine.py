@@ -452,3 +452,20 @@ class TestSlotLifecycle:
         res = eng.run_until_complete()
         assert sorted(res) == sorted(rids)
         assert all(len(res[r].tokens) == 5 for r in rids)
+
+
+class TestObservability:
+    def test_get_request_across_lifecycle(self, rng):
+        m = _model()
+        eng = ServingEngine(m, max_batch=1)
+        p = rng.randint(0, 256, (5,)).astype(np.int32)
+        r1 = eng.submit(p, max_new_tokens=3)
+        r2 = eng.submit(p, max_new_tokens=3)
+        assert eng.get_request(r2).rid == r2      # still queued (1 slot)
+        eng.step()
+        assert eng.get_request(r1).rid == r1      # in-flight or finished
+        eng.run_until_complete()
+        assert eng.get_request(r1).finished
+        assert eng.get_request(r2).finished
+        with pytest.raises(KeyError):
+            eng.get_request(999)
