@@ -5,6 +5,8 @@ from .segment import segment_max, segment_mean, segment_min, segment_sum  # noqa
 from . import optimizer  # noqa: F401
 from . import reader  # noqa: F401
 from . import lora  # noqa: F401
+from . import contrib_layers  # noqa: F401  (LayerHelper is resolved at
+# call time inside its functions, so this import order is safe)
 
 
 class LayerHelper:

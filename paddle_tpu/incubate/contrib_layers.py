@@ -1,0 +1,86 @@
+"""fluid.contrib.layers-style wrappers for the recommendation/text-matching
+op family (python/paddle/fluid/contrib/layers/nn.py parity): the reference
+signatures create the parameters from `param_attr`/size attrs inside the
+call; these wrappers do the same via LayerHelper and delegate the math to
+the functional forms in nn/functional (tests/test_rec_ops.py mirrors the
+C++ kernels). Eager-friendly: each call creates fresh parameters, exactly
+like the fluid helpers did under a program guard."""
+import numpy as np
+
+from ..nn import functional as F
+
+
+def batch_fc(input, param_size, param_attr=None, bias_size=None,
+             bias_attr=None, act=None):
+    """fluid.contrib.layers.batch_fc parity
+    (contrib/layers/nn.py:1382): w/bias created from the size attrs."""
+    from . import LayerHelper
+
+    helper = LayerHelper("batch_fc")
+    if tuple(input.shape[0:1]) != tuple(param_size[0:1]) or \
+            input.shape[2] != param_size[1]:
+        raise ValueError(
+            f"param_size {param_size} incompatible with input "
+            f"{tuple(input.shape)}")
+    w = helper.create_parameter(attr=param_attr, shape=list(param_size))
+    b = None
+    if bias_size is not None:
+        if list(bias_size) != [param_size[0], param_size[2]]:
+            raise ValueError(
+                f"bias_size {bias_size} must be [slot, out] = "
+                f"[{param_size[0]}, {param_size[2]}]")
+        b = helper.create_parameter(attr=bias_attr, shape=list(bias_size))
+    return F.batch_fc(input, w, b, act=act)
+
+
+def rank_attention(input, rank_offset, rank_param_shape,
+                   rank_param_attr=None, max_rank=3, max_size=0):
+    """fluid.contrib.layers.rank_attention parity
+    (contrib/layers/nn.py:1314), including its shape assert."""
+    from . import LayerHelper
+
+    helper = LayerHelper("rank_attention")
+    if input.shape[1] * max_rank * max_rank != rank_param_shape[0]:
+        raise ValueError(
+            f"rank_param_shape[0] ({rank_param_shape[0]}) must equal "
+            f"in_dim*max_rank^2 ({input.shape[1] * max_rank * max_rank})")
+    rank_param = helper.create_parameter(attr=rank_param_attr,
+                                         shape=list(rank_param_shape))
+    return F.rank_attention(input, rank_offset, rank_param,
+                            max_rank=max_rank, max_size=max_size)
+
+
+def search_pyramid_hash(input, length, num_emb, space_len, pyramid_layer,
+                        rand_len, drop_out_percent=0.0, is_training=True,
+                        seed=1, step=0, param_attr=None, dtype="float32"):
+    """fluid.contrib.layers.search_pyramid_hash parity
+    (contrib/layers/nn.py:668): the [space_len + rand_len] hash table is
+    the created parameter (the reference's white/black-list args are
+    descoped with the PS filter tooling — see the functional docstring).
+    Padded dialect: input [B, T] int ids + length [B]."""
+    from . import LayerHelper
+
+    helper = LayerHelper("pyramid_hash")
+    weights = helper.create_parameter(attr=param_attr,
+                                      shape=[space_len + rand_len],
+                                      dtype=dtype)
+    return F.search_pyramid_hash(
+        input, length, weights, num_emb=num_emb, space_len=space_len,
+        pyramid_layer=pyramid_layer, rand_len=rand_len,
+        drop_out_percent=drop_out_percent, is_training=is_training,
+        seed=seed, step=step)
+
+
+def sequence_topk_avg_pooling(input, row, col, topks, channel_num):
+    """fluid.contrib.layers.sequence_topk_avg_pooling parity
+    (contrib/layers/nn.py:333) over the padded dialect: input
+    [B, channel_num, Rmax, Cmax], row/col the per-sample lengths."""
+    return F.sequence_topk_avg_pooling(input, row, col, topks=topks,
+                                       channel_num=channel_num)
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    """fluid.layers.filter_by_instag parity (layers/nn.py:10115)."""
+    return F.filter_by_instag(ins, ins_tag, filter_tag, is_lod=is_lod,
+                              out_val_if_empty=out_val_if_empty)

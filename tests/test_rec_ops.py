@@ -221,3 +221,38 @@ class TestSearchPyramidHash:
                 paddle.to_tensor([3]),
                 paddle.to_tensor(np.zeros(66, np.float32)),
                 num_emb=5, space_len=64, pyramid_layer=3, rand_len=2)
+
+
+class TestContribLayerWrappers:
+    """fluid.contrib.layers-style signatures (parameters created from
+    attrs inside the call) delegating to the functional forms."""
+
+    def test_batch_fc_creates_params_and_runs(self):
+        from paddle_tpu.incubate import contrib_layers as cl
+
+        x = paddle.to_tensor(np.ones((3, 4, 5), np.float32))
+        out = cl.batch_fc(x, param_size=[3, 5, 6], bias_size=[3, 6],
+                          act="relu")
+        assert tuple(out.shape) == (3, 4, 6)
+        with pytest.raises(ValueError, match="bias_size"):
+            cl.batch_fc(x, param_size=[3, 5, 6], bias_size=[3, 7])
+
+    def test_rank_attention_shape_assert(self):
+        from paddle_tpu.incubate import contrib_layers as cl
+
+        x = paddle.to_tensor(np.ones((4, 2), np.float32))
+        ro = paddle.to_tensor(np.zeros((4, 7), np.int32))
+        out = cl.rank_attention(x, ro, rank_param_shape=[18, 3],
+                                max_rank=3)
+        assert tuple(out.shape) == (4, 3)
+        with pytest.raises(ValueError, match="rank_param_shape"):
+            cl.rank_attention(x, ro, rank_param_shape=[17, 3], max_rank=3)
+
+    def test_pyramid_hash_creates_table(self):
+        from paddle_tpu.incubate import contrib_layers as cl
+
+        ids = paddle.to_tensor(np.array([[3, 1, 4]], np.int32))
+        out, nlen = cl.search_pyramid_hash(
+            ids, paddle.to_tensor([3]), num_emb=4, space_len=32,
+            pyramid_layer=3, rand_len=2)
+        assert tuple(out.shape)[2] == 4 and int(nlen.numpy()[0]) == 3
