@@ -38,6 +38,16 @@ outputs are identical either way — asserted in tests).
 requests submitted with `prefix_id=` start from a copy of that cache and
 prefill only their suffix — identical outputs to resending the full
 prompt, without recomputing the prefix per request.
+
+`draft_model=` turns on SPECULATIVE continuous batching (the batched form
+of `generate_speculative`): each round a small draft proposes `spec_k`
+tokens per slot and the target verifies all slots in ONE (spec_k+1)-token
+forward at per-slot positions, emitting 1..spec_k+1 tokens per slot per
+round — output bit-identical to plain greedy. Rounds run while every
+active slot is greedy with cache headroom; sampling neighbors or
+near-capacity slots fall back to exact single-token steps. Composes with
+chunked prefill, shared prefixes, bf16/int8 caches, and tp_mesh (the
+draft stays replicated; the target verify shares the head-sharded cache).
 """
 import numpy as np
 
@@ -72,7 +82,7 @@ class ServingEngine:
     def __init__(self, model, max_batch=4, dtype=None, cache_dtype=None,
                  eos_token_id=None, prompt_buckets=(32, 64, 128, 256, 512,
                                                     1024), tp_mesh=None,
-                 prefill_chunk=None):
+                 prefill_chunk=None, draft_model=None, spec_k=4):
         import jax
         import jax.numpy as jnp
 
@@ -92,6 +102,16 @@ class ServingEngine:
                 raise ValueError(
                     f"prefill_chunk must be in [1, max_seq_len={self.T}], "
                     f"got {prefill_chunk}")
+        if draft_model is not None:
+            if draft_model.cfg.vocab_size != cfg.vocab_size:
+                raise ValueError("draft and target must share a vocabulary")
+            if not (1 <= int(spec_k) <= 16):
+                raise ValueError(f"spec_k must be in [1, 16], got {spec_k}")
+            if draft_model.cfg.max_seq_len < self.T:
+                raise ValueError(
+                    f"draft max_seq_len ({draft_model.cfg.max_seq_len}) "
+                    f"must cover the target's ({self.T})")
+            _check_decode_config(draft_model.cfg)
         self._buckets = tuple(sorted(b for b in prompt_buckets
                                      if b <= self.T))
         if not self._buckets:
@@ -278,6 +298,109 @@ class ServingEngine:
         self._copy_cache = jax.jit(
             lambda c: jax.tree_util.tree_map(jnp.array, c))
 
+        # --- speculative decoding: a draft model proposes spec_k tokens
+        # per round, the target verifies them in ONE multi-token forward
+        # at PER-SLOT positions and accepts the longest matching prefix
+        # plus its own fix-up token — 1..spec_k+1 tokens per round, output
+        # bit-identical to plain greedy (same scheme as
+        # generate_speculative, batched over slots; the cache invariant —
+        # junk columns past the accepted frontier are causally invisible
+        # and overwritten — is the one admission prefill already relies
+        # on). Rounds run only while EVERY active slot is greedy with
+        # spec_k+1 columns of cache headroom; otherwise the engine falls
+        # back to single-token steps (still exact).
+        self._draft = None
+        if draft_model is not None:
+            self._spec_k = K = int(spec_k)
+            d_untied, d_untied_bias, params_d = _decode_params(
+                draft_model, "the draft model")
+            if self._compute_dtype is not None:
+                params_d = {n: (v.astype(self._compute_dtype)
+                                if jnp.issubdtype(v.dtype, jnp.floating)
+                                else v) for n, v in params_d.items()}
+            # the draft is small by design: it stays replicated (dense
+            # fns) even when the target serves tensor-parallel
+            fwd_d, logits_d, cache_init_d = _decode_fns(
+                draft_model.cfg, d_untied, d_untied_bias,
+                cache_dtype=cache_dtype)
+            self._params_d = params_d
+            self._kc_d, self._vc_d = cache_init_d(self.B, self.T, cache_dt)
+
+            def draft_row():
+                return cache_init_d(1, self.T, cache_dt)
+
+            def draft_feed(pd, ids_padded, offset, kc1, vc1):
+                """Write a token block's draft KV at `offset` (whole-prompt
+                prefill at 0, or one chunk of a chunked admission)."""
+                _, kc1, vc1 = fwd_d(pd, ids_padded, offset, kc1, vc1)
+                return kc1, vc1
+
+            def draft_propose(pd, kc_d, vc_d, last, pos_vec):
+                """K sequential draft steps at per-row positions; also
+                writes the K-th proposal's KV (an all-accepted round
+                continues PAST that column — an unwritten column inside
+                the accepted prefix would poison later attention)."""
+                d_cur = last
+                props = []
+                for j in range(K):
+                    xd, kc_d, vc_d = fwd_d(pd, d_cur[:, None], pos_vec + j,
+                                           kc_d, vc_d)
+                    d_cur = jnp.argmax(
+                        logits_d(pd, xd[:, 0]).astype(jnp.float32),
+                        -1).astype(jnp.int32)
+                    props.append(d_cur)
+                _, kc_d, vc_d = fwd_d(pd, d_cur[:, None], pos_vec + K,
+                                      kc_d, vc_d)
+                return jnp.stack(props, axis=1), kc_d, vc_d
+
+            def verify(p, kc, vc, last, pos_vec, props):
+                """One (K+1)-token target forward per slot row: accept the
+                longest prefix where each proposal equals the target's own
+                argmax after the same context, emit it plus the target's
+                fix-up token. emit[s, j] is meaningful for j <= m[s]."""
+                seq = jnp.concatenate([last[:, None], props], axis=1)
+                x, kc, vc = fwd(p, seq, pos_vec, kc, vc)
+                preds = jnp.argmax(
+                    logits_of(p, x).astype(jnp.float32),
+                    -1).astype(jnp.int32)                     # [B, K+1]
+                matches = (props == preds[:, :K]).astype(jnp.int32)
+                m = jnp.cumprod(matches, axis=1).sum(axis=1)  # [B] 0..K
+                fix = jnp.take_along_axis(preds, m[:, None], axis=1)
+                j_idx = jnp.arange(K + 1)[None]
+                padded = jnp.pad(props, ((0, 0), (0, 1)))
+                emit = jnp.where(j_idx < m[:, None], padded, fix)
+                return emit, m, kc, vc
+
+            def draft_sync(pd, kc_d, vc_d, last, pos_vec):
+                """One 1-token draft forward at per-row positions: keeps
+                the draft KV cache in lockstep during single-token
+                FALLBACK steps (sampling neighbors / near-capacity), so a
+                slot that lives through a fallback resumes speculative
+                rounds with an intact draft context instead of a
+                permanently cold one."""
+                _, kc_d, vc_d = fwd_d(pd, last[:, None], pos_vec,
+                                      kc_d, vc_d)
+                return kc_d, vc_d
+
+            self._draft = draft_model
+            self._draft_row = draft_row
+            self._draft_sync = jax.jit(draft_sync, donate_argnums=(1, 2))
+            self._draft_feed = jax.jit(draft_feed, donate_argnums=(3, 4))
+            self._draft_propose = jax.jit(draft_propose,
+                                          donate_argnums=(1, 2))
+            if tp_mesh is None:
+                self._verify = jax.jit(verify, donate_argnums=(1, 2))
+            else:
+                from jax.sharding import PartitionSpec as P
+
+                from ..models.gpt import _tp_wrap
+
+                cs = self._cache_spec
+                self._verify = _tp_wrap(
+                    verify, tp_mesh, tp_specs, 0, (P(), P(), cs, cs),
+                    in_specs=(tp_specs, cs, cs, P(), P(), P()),
+                    donate=(1, 2))
+
         # host-side slot state
         self._slot_req = [None] * self.B        # Request or None
         self._pos = np.zeros(self.B, np.int32)  # next write column
@@ -310,9 +433,15 @@ class ServingEngine:
         padded[0, :n] = ids
         kc1, vc1, _ = self._prefill(self._params, jnp.asarray(padded),
                                     np.int32(n))
+        kc1d = vc1d = None
+        if self._draft is not None:  # the draft replays suffixes from its
+            # own cached prefix KV, like the target
+            kc1d, vc1d = self._draft_feed(self._params_d,
+                                          jnp.asarray(padded), np.int32(0),
+                                          *self._draft_row())
         pid = self._next_pid
         self._next_pid += 1
-        self._prefixes[pid] = (ids, kc1, vc1)
+        self._prefixes[pid] = (ids, kc1, vc1, kc1d, vc1d)
         return pid
 
     def get_request(self, rid):
@@ -401,12 +530,16 @@ class ServingEngine:
         self._finished[req.rid] = req
         self._slot_req[slot] = None
 
-    def _activate(self, slot, req, kc1, vc1, logits):
-        """Shared admission tail: copy the side cache into the slot's row
-        and emit the first generated token through the standard pick."""
+    def _activate(self, slot, req, kc1, vc1, logits, draft_caches=None):
+        """Shared admission tail: copy the side cache(s) into the slot's
+        row and emit the first generated token through the standard pick."""
         n = len(req.prompt_ids)
         self._kc = self._admit(self._kc, kc1, slot)
         self._vc = self._admit(self._vc, vc1, slot)
+        if draft_caches is not None:
+            kc1d, vc1d = draft_caches
+            self._kc_d = self._admit(self._kc_d, kc1d, slot)
+            self._vc_d = self._admit(self._vc_d, vc1d, slot)
         temp = np.float32(req.temperature)
         topk = np.int32(req.top_k or self.cfg.vocab_size)
         seed = np.int32(req.seed)
@@ -438,11 +571,16 @@ class ServingEngine:
             C = self._chunk or min(64, self.T)
             end = prefix_len + -(-(n - prefix_len) // C) * C
             if end <= self.T:
-                _, kc_p, vc_p = self._prefixes[req.prefix_id]
+                _, kc_p, vc_p, kc_pd, vc_pd = self._prefixes[req.prefix_id]
                 kc1 = self._copy_cache(kc_p)
                 vc1 = self._copy_cache(vc_p)
+                kc1d = vc1d = None
+                if self._draft is not None:
+                    kc1d = self._copy_cache(kc_pd)
+                    vc1d = self._copy_cache(vc_pd)
                 self._slot_req[slot] = req
-                self._prefilling[slot] = [req, kc1, vc1, prefix_len, C]
+                self._prefilling[slot] = [req, kc1, vc1, prefix_len, C,
+                                          kc1d, vc1d]
                 return
             # else: fall through to whole-prompt prefill (recomputes the
             # prefix — slower but correct near the capacity edge)
@@ -452,8 +590,11 @@ class ServingEngine:
             # chunked admission: reserve the slot, consume the prompt one
             # chunk per step() so active decodes run in between
             self._slot_req[slot] = req
+            kc1d = vc1d = None
+            if self._draft is not None:
+                kc1d, vc1d = self._draft_row()
             self._prefilling[slot] = [req, *self._prefill_start(), 0,
-                                      self._chunk]
+                                      self._chunk, kc1d, vc1d]
             return
         # whole-prompt (bucketed) prefill — also the fallback when the
         # chunk schedule's fixed-width final write would cross max_seq_len
@@ -464,14 +605,20 @@ class ServingEngine:
         padded[0, :n] = req.prompt_ids
         kc1, vc1, logits = self._prefill(self._params, jnp.asarray(padded),
                                          np.int32(n))
-        self._activate(slot, req, kc1, vc1, logits)
+        draft_caches = None
+        if self._draft is not None:
+            draft_caches = self._draft_feed(self._params_d,
+                                            jnp.asarray(padded),
+                                            np.int32(0), *self._draft_row())
+        self._activate(slot, req, kc1, vc1, logits,
+                       draft_caches=draft_caches)
 
     def _advance_prefill(self, slot):
         """Consume one chunk of a reserved slot's prompt; on the final
         chunk, activate the slot."""
         import jax.numpy as jnp
 
-        req, kc1, vc1, off, C = self._prefilling[slot]
+        req, kc1, vc1, off, C, kc1d, vc1d = self._prefilling[slot]
         n = len(req.prompt_ids)
         end = min(off + C, n)
         chunk = np.zeros((1, C), np.int32)
@@ -479,12 +626,18 @@ class ServingEngine:
         kc1, vc1, logits = self._prefill_chunk(
             self._params, jnp.asarray(chunk), np.int32(off), kc1, vc1,
             np.int32(end - off - 1))
+        if self._draft is not None:
+            kc1d, vc1d = self._draft_feed(self._params_d,
+                                          jnp.asarray(chunk),
+                                          np.int32(off), kc1d, vc1d)
         if end >= n:
             del self._prefilling[slot]
             self._slot_req[slot] = None   # _activate re-binds
-            self._activate(slot, req, kc1, vc1, logits)
+            self._activate(slot, req, kc1, vc1, logits,
+                           draft_caches=(None if self._draft is None
+                                         else (kc1d, vc1d)))
         else:
-            self._prefilling[slot] = [req, kc1, vc1, end, C]
+            self._prefilling[slot] = [req, kc1, vc1, end, C, kc1d, vc1d]
 
     def _after_emit(self, slot, req):
         if self.eos is not None and req.output_ids[-1] == self.eos:
@@ -517,6 +670,24 @@ class ServingEngine:
                   if self._slot_req[s] is not None
                   and s not in self._prefilling]
         if active:
+            # speculative round: every active slot greedy AND spec_k+1
+            # columns of headroom (near-capacity slots fall back to exact
+            # single-token steps — junk writes past T would clamp)
+            if (self._draft is not None
+                    and all(self._temps[s] == 0 for s in active)
+                    and all(int(self._pos[s]) + self._spec_k + 1 <= self.T
+                            for s in active)):
+                self._step_speculative(active)
+                return [self._finished[r]
+                        for r in set(self._finished) - before]
+            # fallback (single-token) step with a draft around: mirror the
+            # fed token into the draft cache so later speculative rounds
+            # see an intact context (review r5: without this, one sampling
+            # neighbor permanently cold-starts every survivor's draft)
+            if self._draft is not None:
+                self._kc_d, self._vc_d = self._draft_sync(
+                    self._params_d, self._kc_d, self._vc_d,
+                    jnp.asarray(self._last), jnp.asarray(self._pos))
             # inactive slots ride along harmlessly: their rows are
             # don't-care (freed) and re-prefilled on admission. Host-side
             # dispatch: an all-greedy batch keeps the lean argmax step
@@ -539,6 +710,42 @@ class ServingEngine:
                 req.output_ids.append(int(next_toks[s]))
                 self._after_emit(s, req)
         return [self._finished[r] for r in set(self._finished) - before]
+
+    def _step_speculative(self, active):
+        """One speculative round for all active (greedy) slots: K draft
+        proposals per slot, one batched (K+1)-token target verify at
+        per-slot positions, 1..K+1 tokens emitted per slot. Tokens are
+        appended one at a time through the standard _after_emit, so
+        eos/length finishing matches the single-token engine exactly;
+        junk positions on freed/mid-prefill rows ride along like every
+        other batched step. Clamping the draft's junk-row writes is safe
+        for the same reason admission row-copies are: those rows are
+        fully overwritten before they are read."""
+        import jax.numpy as jnp
+
+        props, self._kc_d, self._vc_d = self._draft_propose(
+            self._params_d, self._kc_d, self._vc_d,
+            jnp.asarray(self._last), jnp.asarray(self._pos))
+        emit, m, self._kc, self._vc = self._verify(
+            self._params, self._kc, self._vc, jnp.asarray(self._last),
+            jnp.asarray(self._pos), props)
+        emit = np.asarray(emit)
+        m = np.asarray(m)
+        for s in active:
+            n_acc = int(m[s]) + 1
+            toks = emit[s, :n_acc]
+            req = self._slot_req[s]
+            old_pos = int(self._pos[s])
+            self._last[s] = int(toks[-1])
+            for i, t in enumerate(toks):
+                # advance pos PER TOKEN so _after_emit's eos/length/
+                # capacity decisions are made at exactly the state the
+                # single-token engine would have seen
+                self._pos[s] = old_pos + i + 1
+                req.output_ids.append(int(t))
+                self._after_emit(s, req)
+                if req.finished:
+                    break
 
     def has_work(self):
         return bool(self._queue) or any(r is not None

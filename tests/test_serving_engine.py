@@ -469,3 +469,172 @@ class TestObservability:
         assert eng.get_request(r2).finished
         with pytest.raises(KeyError):
             eng.get_request(999)
+
+
+class TestSpeculative:
+    """Speculative continuous batching (draft_model=): output must be
+    BIT-IDENTICAL to plain greedy — the draft only changes how many
+    target forwards it takes, never what is emitted."""
+
+    def _draft(self):
+        paddle.seed(7)
+        d = GPTForCausalLM(GPTConfig(vocab_size=256, hidden_size=32,
+                                     num_layers=1, num_heads=2,
+                                     max_seq_len=128, dropout=0.0))
+        d.eval()
+        return d
+
+    def test_matches_plain_greedy_engine_and_generate(self, rng):
+        m = _model()
+        eng = ServingEngine(m, max_batch=3, draft_model=self._draft(),
+                            spec_k=4)
+        prompts = [rng.randint(0, 256, (n,)).astype(np.int32)
+                   for n in (5, 9, 17, 3, 26)]
+        rids = [eng.submit(p, max_new_tokens=12) for p in prompts]
+        res = eng.run_until_complete()
+        for rid, p in zip(rids, prompts):
+            np.testing.assert_array_equal(res[rid].tokens,
+                                          _ref_new_tokens(m, p, 12))
+
+    def test_self_draft_accepts_everything(self, rng):
+        # draft == target: every proposal accepted, so each round emits
+        # spec_k+1 tokens and the drain takes ~1/(k+1) the steps
+        m = _model()
+        eng = ServingEngine(m, max_batch=1, draft_model=m, spec_k=3)
+        p = rng.randint(0, 256, (6,)).astype(np.int32)
+        rid = eng.submit(p, max_new_tokens=12)
+        steps = 0
+        while eng.has_work():
+            eng.step()
+            steps += 1
+        res = eng._finished
+        np.testing.assert_array_equal(res[rid].tokens,
+                                      _ref_new_tokens(m, p, 12))
+        # 1 admission step (emits 1) + ceil(11/4) spec rounds = 4 steps
+        assert steps <= 5, steps
+
+    def test_sampling_neighbor_falls_back_but_stays_exact(self, rng):
+        m = _model()
+        eng = ServingEngine(m, max_batch=2, draft_model=self._draft(),
+                            spec_k=4)
+        pg = rng.randint(0, 256, (7,)).astype(np.int32)
+        ps = rng.randint(0, 256, (9,)).astype(np.int32)
+        rg = eng.submit(pg, max_new_tokens=10)
+        rs = eng.submit(ps, max_new_tokens=10, temperature=0.9, seed=3)
+        res = eng.run_until_complete()
+        np.testing.assert_array_equal(res[rg].tokens,
+                                      _ref_new_tokens(m, pg, 10))
+        assert len(res[rs].tokens) == 10
+
+    def test_composes_with_chunked_and_prefix(self, rng):
+        m = _model()
+        eng = ServingEngine(m, max_batch=2, draft_model=self._draft(),
+                            spec_k=3, prefill_chunk=8)
+        prefix = rng.randint(0, 256, (20,)).astype(np.int32)
+        pid = eng.register_prefix(prefix)
+        s = rng.randint(0, 256, (6,)).astype(np.int32)
+        r1 = eng.submit(s, max_new_tokens=8, prefix_id=pid)
+        p2 = rng.randint(0, 256, (21,)).astype(np.int32)
+        r2 = eng.submit(p2, max_new_tokens=8)
+        res = eng.run_until_complete()
+        np.testing.assert_array_equal(
+            res[r1].tokens,
+            _ref_new_tokens(m, np.concatenate([prefix, s]), 8))
+        np.testing.assert_array_equal(res[r2].tokens,
+                                      _ref_new_tokens(m, p2, 8))
+
+    def test_eos_mid_round_and_near_capacity_fallback(self, rng):
+        m = _model()
+        # run requests long enough to push pos toward max_seq_len=128 so
+        # the near-capacity single-token fallback engages, and finish on
+        # capacity — all still exact vs the plain engine
+        eng = ServingEngine(m, max_batch=1, draft_model=self._draft(),
+                            spec_k=4)
+        p = rng.randint(0, 256, (100,)).astype(np.int32)
+        rid = eng.submit(p, max_new_tokens=64)  # 100 + 64 > 128: capacity
+        res = eng.run_until_complete()
+        plain = ServingEngine(m, max_batch=1)
+        rid_p = plain.submit(p, max_new_tokens=64)
+        res_p = plain.run_until_complete()
+        np.testing.assert_array_equal(res[rid].tokens, res_p[rid_p].tokens)
+        assert res[rid].finish_reason == res_p[rid_p].finish_reason \
+            == "capacity"
+        # eos inside an accepted run truncates exactly like 1-token steps
+        eng2 = ServingEngine(m, max_batch=1, draft_model=m, spec_k=4,
+                             eos_token_id=int(
+                                 _ref_new_tokens(m, p[:10], 6)[3]))
+        rid2 = eng2.submit(p[:10], max_new_tokens=20)
+        res2 = eng2.run_until_complete()
+        eng3 = ServingEngine(m, max_batch=1, eos_token_id=int(
+            _ref_new_tokens(m, p[:10], 6)[3]))
+        rid3 = eng3.submit(p[:10], max_new_tokens=20)
+        res3 = eng3.run_until_complete()
+        np.testing.assert_array_equal(res2[rid2].tokens, res3[rid3].tokens)
+        assert res2[rid2].finish_reason == res3[rid3].finish_reason
+
+    def test_draft_cache_stays_warm_through_fallback(self, rng):
+        # a sampling neighbor forces single-token fallback steps; once it
+        # finishes, the surviving greedy slot must resume EFFECTIVE
+        # speculation (draft cache kept in sync during fallback) — with
+        # draft == target every proposal accepts, so the remaining tokens
+        # arrive spec_k+1 per round
+        m = _model()
+        eng = ServingEngine(m, max_batch=2, draft_model=m, spec_k=3)
+        pg = rng.randint(0, 256, (6,)).astype(np.int32)
+        ps = rng.randint(0, 256, (8,)).astype(np.int32)
+        rg = eng.submit(pg, max_new_tokens=30)
+        rs = eng.submit(ps, max_new_tokens=4, temperature=0.9, seed=1)
+        steps = 0
+        while eng.has_work():
+            eng.step()
+            steps += 1
+        res = eng._finished
+        np.testing.assert_array_equal(res[rg].tokens,
+                                      _ref_new_tokens(m, pg, 30))
+        # ~4 fallback steps while the sampler lives (emits 4 + admission),
+        # then (30 - ~5) remaining tokens at 4/round: well under the ~30
+        # steps a cold draft cache would force
+        assert steps <= 14, steps
+
+    def test_validation(self, rng):
+        m = _model()
+        paddle.seed(3)
+        bad_vocab = GPTForCausalLM(GPTConfig(
+            vocab_size=128, hidden_size=32, num_layers=1, num_heads=2,
+            max_seq_len=128, dropout=0.0))
+        with pytest.raises(ValueError, match="vocabulary"):
+            ServingEngine(m, draft_model=bad_vocab)
+        with pytest.raises(ValueError, match="spec_k"):
+            ServingEngine(m, draft_model=self._draft(), spec_k=0)
+        short = GPTForCausalLM(GPTConfig(
+            vocab_size=256, hidden_size=32, num_layers=1, num_heads=2,
+            max_seq_len=64, dropout=0.0))
+        short.eval()
+        with pytest.raises(ValueError, match="max_seq_len"):
+            ServingEngine(m, draft_model=short)
+
+
+class TestSpeculativeTP:
+    def test_tp_target_with_replicated_draft(self, rng):
+        import jax
+
+        from paddle_tpu.distributed.mesh import build_mesh
+
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 virtual devices")
+        mesh = build_mesh((4,), ("mp",), devices=jax.devices()[:4])
+        m = _model()
+        paddle.seed(7)
+        d = GPTForCausalLM(GPTConfig(vocab_size=256, hidden_size=32,
+                                     num_layers=1, num_heads=2,
+                                     max_seq_len=128, dropout=0.0))
+        d.eval()
+        eng = ServingEngine(m, max_batch=2, tp_mesh=mesh, draft_model=d,
+                            spec_k=3)
+        prompts = [rng.randint(0, 256, (n,)).astype(np.int32)
+                   for n in (5, 11)]
+        rids = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        res = eng.run_until_complete()
+        for rid, p in zip(rids, prompts):
+            np.testing.assert_array_equal(res[rid].tokens,
+                                          _ref_new_tokens(m, p, 8))
