@@ -60,12 +60,14 @@ class Request:
     """One submitted prompt and, when finished, its generated tokens."""
 
     def __init__(self, rid, prompt_ids, max_new_tokens, temperature=0.0,
-                 top_k=None, seed=None, prefix_id=None, prefix_len=0):
+                 top_k=None, top_p=None, seed=None, prefix_id=None,
+                 prefix_len=0):
         self.rid = rid
         self.prompt_ids = np.asarray(prompt_ids, np.int32).ravel()
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.top_k = top_k
+        self.top_p = top_p
         self.seed = rid if seed is None else int(seed)
         self.prefix_id = prefix_id          # registered shared prefix, or
         self.prefix_len = int(prefix_len)   # 0 = no prefix reuse
@@ -207,12 +209,14 @@ class ServingEngine:
 
         vocab = cfg.vocab_size
 
-        def _pick(logits, temps, kvec, seeds, pos_vec):
+        def _pick(logits, temps, kvec, pvec, seeds, pos_vec):
             """Per-row pick: temperature 0 = exact greedy (the argmax path
             is untouched); temperature > 0 samples from the (optionally
-            per-row top-k truncated) distribution with a PRNG key derived
-            from (request seed, position) — deterministic per request,
-            independent across slots."""
+            per-row top-k and/or top-p truncated) distribution with a PRNG
+            key derived from (request seed, position) — deterministic per
+            request, independent across slots. Nucleus filtering runs on
+            the temperature-scaled logits, exactly like generate()'s
+            single-request pick (models/gpt.py _gpt_generate)."""
             greedy = jnp.argmax(logits, -1).astype(jnp.int32)
 
             # per-row top-k cutoff (kvec = vocab means no truncation)
@@ -221,13 +225,23 @@ class ServingEngine:
                 srt, jnp.clip(kvec - 1, 0, vocab - 1)[:, None], axis=-1)
             lg = jnp.where(logits < cut, -jnp.inf, logits)
             safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+            lgt = lg / safe_t
+            # per-row nucleus (pvec = 1.0 means no truncation): smallest
+            # sorted prefix reaching mass p; the top token always survives
+            srt_t = jnp.sort(lgt, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(srt_t, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            k_keep = jnp.sum(cum - probs < pvec[:, None], axis=-1)
+            cutoff = jnp.take_along_axis(
+                srt_t, jnp.maximum(k_keep - 1, 0)[:, None], axis=-1)
+            lgt = jnp.where(lgt < cutoff, -jnp.inf, lgt)
 
             def draw(row_logits, seed, p_):
                 key = jax.random.fold_in(
                     jax.random.fold_in(jax.random.PRNGKey(0), seed), p_)
                 return jax.random.categorical(key, row_logits)
 
-            sampled = jax.vmap(draw)(lg / safe_t, seeds,
+            sampled = jax.vmap(draw)(lgt, seeds,
                                      pos_vec).astype(jnp.int32)
             return jnp.where(temps > 0, sampled, greedy)
 
@@ -239,12 +253,13 @@ class ServingEngine:
             logits = logits_of(p, x[:, 0]).astype(jnp.float32)
             return jnp.argmax(logits, -1).astype(jnp.int32), kc, vc
 
-        def step_sample(p, kc, vc, last_toks, pos_vec, temps, kvec, seeds):
+        def step_sample(p, kc, vc, last_toks, pos_vec, temps, kvec,
+                        pvec, seeds):
             """Decode step with per-request sampling knobs [B] (used only
             while at least one active request has temperature > 0)."""
             x, kc, vc = fwd(p, last_toks[:, None], pos_vec, kc, vc)
             logits = logits_of(p, x[:, 0]).astype(jnp.float32)
-            return _pick(logits, temps, kvec, seeds, pos_vec), kc, vc
+            return _pick(logits, temps, kvec, pvec, seeds, pos_vec), kc, vc
 
         # donate the big cache through admit/step: XLA aliases it in place
         # instead of copying GBs of K/V per token (the loop this engine
@@ -267,7 +282,7 @@ class ServingEngine:
                 in_specs=(tp_specs, cs, cs, P(), P()), donate=(1, 2))
             self._step_sample = _tp_wrap(
                 step_sample, tp_mesh, tp_specs, 0, (P(), cs, cs),
-                in_specs=(tp_specs, cs, cs, P(), P(), P(), P(), P()),
+                in_specs=(tp_specs, cs, cs, P(), P(), P(), P(), P(), P()),
                 donate=(1, 2))
             # chunked prefill composes with tp: the chunk side-cache
             # allocates head-sharded (side_alloc above) and the chunk
@@ -281,8 +296,8 @@ class ServingEngine:
         # fine over the head-sharded cache
         self._admit = jax.jit(admit, donate_argnums=(0,))
         # the prefill token goes through the SAME pick as decode steps
-        self._pick1 = jax.jit(lambda lg, t, k, s, p_: _pick(
-            lg[None], t[None], k[None], s[None], p_[None])[0])
+        self._pick1 = jax.jit(lambda lg, t, k, tp, s, p_: _pick(
+            lg[None], t[None], k[None], tp[None], s[None], p_[None])[0])
 
         self._chunk = None if prefill_chunk is None else int(prefill_chunk)
         if tp_mesh is None:
@@ -407,6 +422,7 @@ class ServingEngine:
         self._last = np.zeros(self.B, np.int32)
         self._temps = np.zeros(self.B, np.float32)   # 0 = greedy
         self._topk = np.full(self.B, self.cfg.vocab_size, np.int32)
+        self._topp = np.ones(self.B, np.float32)     # 1.0 = no nucleus
         self._seeds = np.zeros(self.B, np.int32)
         self._queue = []
         self._next_rid = 0
@@ -472,10 +488,11 @@ class ServingEngine:
         del self._prefixes[prefix_id]
 
     def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
-               top_k=None, seed=None, prefix_id=None):
+               top_k=None, top_p=None, seed=None, prefix_id=None):
         """Queue a prompt; returns the request id. temperature=0 (default)
-        decodes greedy; temperature>0 samples (optionally top_k-truncated)
-        with a per-request deterministic PRNG stream (seed defaults to the
+        decodes greedy; temperature>0 samples (optionally top_k- and/or
+        top_p/nucleus-truncated, same semantics as generate()) with a
+        per-request deterministic PRNG stream (seed defaults to the
         request id)."""
         ids = prompt_ids._data if isinstance(prompt_ids, Tensor) \
             else np.asarray(prompt_ids)
@@ -487,6 +504,8 @@ class ServingEngine:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         if top_k is not None and top_k < 1:
             raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if seed is not None:
             # fail HERE, not at admission steps later: the PRNG fold takes
             # an int32 (mask a 64-bit time/hash seed yourself if desired)
@@ -513,7 +532,8 @@ class ServingEngine:
         self._next_rid += 1
         self._queue.append(Request(rid, ids, max_new_tokens,
                                    temperature=temperature, top_k=top_k,
-                                   seed=seed, prefix_id=prefix_id,
+                                   top_p=top_p, seed=seed,
+                                   prefix_id=prefix_id,
                                    prefix_len=prefix_len))
         return rid
 
@@ -542,15 +562,18 @@ class ServingEngine:
             self._vc_d = self._admit(self._vc_d, vc1d, slot)
         temp = np.float32(req.temperature)
         topk = np.int32(req.top_k or self.cfg.vocab_size)
+        topp = np.float32(1.0 if req.top_p is None else req.top_p)
         seed = np.int32(req.seed)
         # fold value = index of the context's last token (n-1), matching
         # the decode step's schedule (each emission folds a unique value)
-        tok = int(self._pick1(logits, temp, topk, seed, np.int32(n - 1)))
+        tok = int(self._pick1(logits, temp, topk, topp, seed,
+                              np.int32(n - 1)))
         self._slot_req[slot] = req
         self._pos[slot] = n
         self._last[slot] = tok
         self._temps[slot] = temp
         self._topk[slot] = topk
+        self._topp[slot] = topp
         self._seeds[slot] = seed
         req.output_ids.append(tok)
         self._after_emit(slot, req)
@@ -697,7 +720,7 @@ class ServingEngine:
                     self._params, self._kc, self._vc,
                     jnp.asarray(self._last), jnp.asarray(self._pos),
                     jnp.asarray(self._temps), jnp.asarray(self._topk),
-                    jnp.asarray(self._seeds))
+                    jnp.asarray(self._topp), jnp.asarray(self._seeds))
             else:
                 next_toks, self._kc, self._vc = self._step_greedy(
                     self._params, self._kc, self._vc,

@@ -168,6 +168,33 @@ class TestSampling:
         np.testing.assert_array_equal(res[rid].tokens,
                                       _ref_new_tokens(m, p, 10))
 
+    def test_top_p_tiny_nucleus_is_greedy(self, rng):
+        # a nucleus small enough to keep only the top token reduces to
+        # greedy (the top token always survives) — same as generate()
+        m = _model()
+        eng = ServingEngine(m, max_batch=1)
+        p = rng.randint(0, 256, (8,)).astype(np.int32)
+        rid = eng.submit(p, max_new_tokens=10, temperature=0.7,
+                         top_p=1e-9)
+        res = eng.run_until_complete()
+        np.testing.assert_array_equal(res[rid].tokens,
+                                      _ref_new_tokens(m, p, 10))
+
+    def test_top_p_deterministic_and_composes_with_top_k(self, rng):
+        m = _model()
+        p = rng.randint(0, 256, (6,)).astype(np.int32)
+
+        def run(top_p, top_k=None):
+            eng = ServingEngine(m, max_batch=1)
+            rid = eng.submit(p, max_new_tokens=12, temperature=0.9,
+                             top_p=top_p, top_k=top_k, seed=5)
+            return list(eng.run_until_complete()[rid].tokens)
+
+        assert run(0.9) == run(0.9)             # deterministic per seed
+        assert run(0.9, top_k=40) == run(0.9, top_k=40)
+        # top_p=1.0 is exactly the no-nucleus path
+        assert run(1.0) == run(None)
+
     def test_sampling_validation(self, rng):
         m = _model()
         eng = ServingEngine(m, max_batch=1)
@@ -175,6 +202,12 @@ class TestSampling:
             eng.submit(np.zeros((3,), np.int32), temperature=-0.1)
         with pytest.raises(ValueError, match="top_k"):
             eng.submit(np.zeros((3,), np.int32), top_k=0)
+        with pytest.raises(ValueError, match="top_p"):
+            eng.submit(np.zeros((3,), np.int32), temperature=0.5,
+                       top_p=0.0)
+        with pytest.raises(ValueError, match="top_p"):
+            eng.submit(np.zeros((3,), np.int32), temperature=0.5,
+                       top_p=1.5)
         with pytest.raises(ValueError, match="seed"):
             eng.submit(np.zeros((3,), np.int32), temperature=0.5,
                        seed=2 ** 31)
