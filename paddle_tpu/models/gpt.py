@@ -880,7 +880,9 @@ def _gpt_speculative(model, draft_model, input_ids, max_new_tokens, k=4,
         model, input_ids, max_new_tokens)
     if b != 1:
         raise ValueError(f"speculative decoding is batch-1 (got batch {b}); "
-                         "run rows separately or use generate()")
+                         "run rows separately, use generate(), or serve "
+                         "batches speculatively via inference.serving."
+                         "ServingEngine(draft_model=...)")
     if draft_model.cfg.vocab_size != cfg.vocab_size:
         raise ValueError("draft and target must share a vocabulary")
     if not (1 <= k <= 16):

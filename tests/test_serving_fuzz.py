@@ -16,6 +16,8 @@ from paddle_tpu.models import GPTConfig, GPTForCausalLM
     (0, {}),
     (1, {"prefill_chunk": 16}),
     (2, {"dtype": "bfloat16", "cache_dtype": "int8"}),
+    (3, {"spec": True, "prefill_chunk": 16}),   # speculative rounds +
+    # fallbacks + chunked admissions churning together (r5)
 ])
 def test_random_scenario_exact_greedy_parity(scenario_seed, engine_kw):
     paddle.seed(0)
@@ -24,6 +26,14 @@ def test_random_scenario_exact_greedy_parity(scenario_seed, engine_kw):
     m = GPTForCausalLM(cfg)
     m.eval()
     rng = np.random.RandomState(scenario_seed)
+    engine_kw = dict(engine_kw)
+    if engine_kw.pop("spec", False):
+        paddle.seed(11)
+        d = GPTForCausalLM(GPTConfig(vocab_size=256, hidden_size=32,
+                                     num_layers=1, num_heads=2,
+                                     max_seq_len=160, dropout=0.0))
+        d.eval()
+        engine_kw.update(draft_model=d, spec_k=3)
     eng = ServingEngine(m, max_batch=3, **engine_kw)
 
     prefix = rng.randint(0, 256, (12,)).astype(np.int32)
