@@ -50,7 +50,10 @@ if grep -q '"gpt2s_train_tokens_per_sec_per_chip"' /tmp/tpu_bench.json 2>/dev/nu
   echo "[tpu_session] 16k exit=$? $(cat /tmp/tpu_bench_16k.json 2>/dev/null)" >&2
 
   echo "[tpu_session] continuous-batching serve config..." >&2
-  timeout 3500 python bench.py --config gpt2s_serve \
+  # r5: the serve config runs TWO phases (drain + mixed-realism) with
+  # inner watchdog windows of 2500 + 1500; the outer budget must cover
+  # both plus init or a slow-but-healthy mixed phase dies at rc=124
+  timeout 6000 python bench.py --config gpt2s_serve \
     > /tmp/tpu_bench_serve.json 2>/tmp/tpu_bench_serve.log
   echo "[tpu_session] serve exit=$? $(cat /tmp/tpu_bench_serve.json 2>/dev/null)" >&2
 
