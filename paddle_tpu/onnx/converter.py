@@ -48,6 +48,9 @@ class _Graph:
         # (input 1) of Reshape/Expand is rewritable
         self.init_arrays = {}   # name -> (index in initializers, ndarray)
         self.consumers = {}     # value name -> set of (op_type, arg_pos)
+        # Slice-ends const name -> per-entry "is a full-span slice" flags
+        # (written by _op_slice; consulted by the dynamic-batch rewrite)
+        self.ends_full_span = {}
 
     def fresh(self, hint="t"):
         self.counter += 1
@@ -373,11 +376,63 @@ class Converter:
         steps = ([int(s) for s in strides] if strides is not None
                  else [1] * len(starts))
         axes = list(range(len(starts)))
+        shape = [int(d) for d in eqn.invars[0].aval.shape]
+        # starts/ends via shape_const (no value-dedup): limit_indices carry
+        # the batch size on full-span axes and must stay structurally
+        # aligned across the dynamic-batch two-trace diff
+        ends_nm = self.g.shape_const(ends)
+        # record which entries are FULL-SPAN slices of their axis — the
+        # only entries the dynamic-batch rewrite may soundly replace with
+        # INT64_MAX (a partial-span batch-tracking end has no faithful
+        # symbolic form; the rewrite raises rather than rely on the
+        # optional validator to catch the corruption)
+        self.g.ends_full_span[ends_nm] = tuple(
+            s == 0 and e == d and st == 1
+            for s, e, d, st in zip(starts, ends, shape, steps))
         ins = [self.g.name_of(eqn.invars[0]),
-               self.g.const(np.asarray(starts, np.int64)),
-               self.g.const(np.asarray(ends, np.int64)),
-               self.g.const(np.asarray(axes, np.int64)),
-               self.g.const(np.asarray(steps, np.int64))]
+               self.g.shape_const(starts),
+               ends_nm,
+               self.g.shape_const(axes),
+               self.g.shape_const(steps)]
+        self.g.add("Slice", ins,
+                   out_names=[self.g.name_of(eqn.outvars[0])])
+
+    def _op_dynamic_slice(self, eqn):
+        """dynamic_slice -> Slice with per-axis start/end parts. Full-span
+        axes (size == dim — e.g. the batch axis of x[:, -1]) get the
+        static pair (0, INT64_MAX), which is batch-size-independent by
+        construction, so this lowering needs no dynamic-batch rewrite at
+        all. Partial axes reproduce jax's start clamping
+        (start <- clip(start, 0, dim - size)) with Cast+Clip on the traced
+        start scalar, then end = start + size."""
+        sizes = [int(s) for s in eqn.params["slice_sizes"]]
+        shape = [int(d) for d in eqn.invars[0].aval.shape]
+        i64max = np.iinfo(np.int64).max
+        one_shape = self.g.shape_const([1])
+        start_parts, end_parts = [], []
+        for a, z, d in zip(eqn.invars[1:], sizes, shape):
+            if z == d:                      # full span: static, batch-free
+                start_parts.append(self.g.const(np.zeros(1, np.int64)))
+                end_parts.append(self.g.const(
+                    np.asarray([i64max], np.int64)))
+                continue
+            s64 = self.g.add("Cast", [self.g.name_of(a)],
+                             attrs={"to": proto.NP_TO_ONNX["int64"]})
+            clipped = self.g.add("Clip", [
+                s64, self.g.const(np.asarray(0, np.int64)),
+                self.g.const(np.asarray(d - z, np.int64))])
+            s_vec = self.g.add("Reshape", [clipped, one_shape])
+            start_parts.append(s_vec)
+            end_parts.append(self.g.add(
+                "Add", [s_vec, self.g.const(np.asarray([z], np.int64))]))
+        ndim = len(shape)
+        starts_t = start_parts[0] if ndim == 1 else \
+            self.g.add("Concat", start_parts, attrs={"axis": 0})
+        ends_t = end_parts[0] if ndim == 1 else \
+            self.g.add("Concat", end_parts, attrs={"axis": 0})
+        ins = [self.g.name_of(eqn.invars[0]), starts_t, ends_t,
+               self.g.shape_const(list(range(ndim))),
+               self.g.shape_const([1] * ndim)]
         self.g.add("Slice", ins,
                    out_names=[self.g.name_of(eqn.outvars[0])])
 
@@ -708,15 +763,17 @@ def _batch_polymorphic_rewrite(conv, conv2):
         if same_meta and np.array_equal(a1, a2, equal_nan=eq_nan):
             continue
         cons = g1.consumers.get(nm, set())
-        # rewritable ONLY as the SHAPE operand (position 1) of Reshape or
-        # Expand — the same values as a DATA operand anywhere would be
-        # silently corrupted by a rewrite
+        # rewritable ONLY as the SHAPE operand (position 1) of Reshape/
+        # Expand or the ENDS operand (position 2) of Slice — the same
+        # values as a DATA operand anywhere would be silently corrupted
         ok_shape = (a1.dtype == np.int64 and a1.ndim == 1
                     and a1.shape == a2.shape)
         ops = {op for op, _ in cons}
-        positions_ok = cons and all(pos == 1 and op in ("Reshape", "Expand")
-                                    for op, pos in cons)
-        if not ok_shape or not positions_ok:
+        positions_ok = cons and all(
+            (op in ("Reshape", "Expand") and pos == 1)
+            or (op == "Slice" and pos == 2)
+            for op, pos in cons)
+        if not ok_shape or not positions_ok or len(ops) != 1:
             raise UnsupportedOpError(
                 f"dynamic batch: constant {nm} (consumed by {sorted(cons)})"
                 " differs between batch traces and is not a rewritable "
@@ -732,10 +789,20 @@ def _batch_polymorphic_rewrite(conv, conv2):
         elif ops == {"Expand"}:
             for i in diff:
                 new[i] = 1                 # two-way broadcast keeps input
-        else:  # mixed consumers: no single rewrite is sound
-            raise UnsupportedOpError(
-                f"dynamic batch: shape constant {nm} feeds both Reshape "
-                "and Expand; cannot rewrite soundly")
+        else:  # Slice ends: INT64_MAX ("through the end") is sound ONLY
+            # for entries _op_slice recorded as FULL-SPAN in both traces —
+            # a partial-span batch-tracking end (x[:-1]) has no faithful
+            # symbolic form and must raise even under validate=False
+            fs1 = g1.ends_full_span.get(nm, ())
+            fs2 = g2.ends_full_span.get(nm, ())
+            if not all(i < len(fs1) and fs1[i] and i < len(fs2) and fs2[i]
+                       for i in diff):
+                raise UnsupportedOpError(
+                    f"dynamic batch: Slice end constant {nm} tracks the "
+                    "batch size through a PARTIAL-span slice — not "
+                    "batch-polymorphic")
+            for i in diff:
+                new[i] = np.iinfo(np.int64).max
         conv.g.replace_const(nm, new)
 
 

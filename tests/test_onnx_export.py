@@ -251,6 +251,47 @@ class TestFailureContract:
         want = np.asarray(net(paddle.to_tensor(ids))._data)
         np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
 
+    def test_dynamic_batch_with_slice_on_batch_axis(self, tmp_path):
+        # x[:, -1]-style slices trace with the full batch size in the
+        # slice's ends vector; the rewrite emits INT64_MAX ("to the end")
+        from paddle_tpu.onnx import runtime as onnx_rt
+
+        class LastStep(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 3)
+
+            def forward(self, x):
+                return self.fc(x[:, -1])      # [B, T, 4] -> [B, 3]
+
+        paddle.seed(0)
+        net = LastStep()
+        net.eval()
+        p = str(tmp_path / "lastestep")
+        paddle.onnx.export(net, p,
+                           input_spec=[InputSpec([None, 5, 4], "float32")])
+        blob = open(p + ".onnx", "rb").read()
+        x = np.random.RandomState(3).rand(6, 5, 4).astype("float32")
+        (got,) = onnx_rt.run(blob, {"input_0": x})
+        want = np.asarray(net(paddle.to_tensor(x))._data)
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+    def test_partial_batch_slice_raises_even_without_validator(self,
+                                                               tmp_path):
+        # x[:-1] slices the batch axis PARTIALLY: no symbolic form exists,
+        # and the refusal must not depend on the (skippable) re-execution
+        # validator — validate=False must still raise, never write
+        class DropLast(nn.Layer):
+            def forward(self, x):
+                return x[:-1] * 2.0
+
+        p = str(tmp_path / "droplast")
+        with pytest.raises(UnsupportedOpError):
+            paddle.onnx.export(DropLast(), p,
+                               input_spec=[InputSpec([None, 3], "float32")],
+                               validate=False)
+        assert not os.path.exists(p + ".onnx")
+
     def test_batch_dependent_model_raises_under_dynamic(self, tmp_path):
         # a forward that genuinely computes WITH the batch size cannot be
         # batch-polymorphic: export must refuse, not emit a wrong graph
