@@ -468,9 +468,14 @@ def _decode_mbu(cfg, batch, tps, prompt, new_tokens, cache_dtype=None,
     streamed_params = _n_params(cfg) - v * h  # minus the gathered embedding
     kv_heads = getattr(cfg, "num_kv_heads", None) or cfg.num_heads
     head_dim = h // cfg.num_heads
-    cache_el = 1 if cache_dtype == "int8" else 2
+    # quantized caches stream 1-byte values PLUS the f32 per-row scale
+    # (4 bytes per head_dim-element row) — omit it and quantized MBU reads
+    # a few percent low vs the bf16 leg
+    cache_el = 1 if cache_dtype in ("int8", "fp8") else 2
     avg_len = prompt + new_tokens / 2
-    cache_bytes = batch * 2 * L * avg_len * kv_heads * head_dim * cache_el
+    row_bytes = head_dim * cache_el + \
+        (4 if cache_dtype in ("int8", "fp8") else 0)
+    cache_bytes = batch * 2 * L * avg_len * kv_heads * row_bytes
     bytes_per_token = (2 * streamed_params + cache_bytes) / batch
     hbm_bw = 819e9 if on_tpu else float("inf")
     return tps * bytes_per_token / hbm_bw
@@ -758,26 +763,43 @@ def main():
             # top-level "mbu" (mid-run emit AND final line); extras carry
             # only the int8 A/B pair
             line_fields["mbu"] = round(mbu, 4)
-            if on_tpu:  # int8-KV A/B rides the same healthy window
-                # the measured bf16 number must survive a slow/hung int8
-                # half: emit it now (ppyolo pattern; LAST line is the most
-                # complete) and give the int8 recompile a fresh window
-                _emit({"metric": metric, "value": round(v, 1),
-                       "unit": unit, "vs_baseline": round(v / base, 3),
-                       "mbu": round(mbu, 4), "config": args.config})
-                if watchdog is not None:
-                    watchdog.cancel()
-                    watchdog = _arm_watchdog(1500)
-                try:
-                    i8, i8_mbu = run_decode(b, args.steps, quiet=True,
-                                            cache_dtype="int8")
-                    extra = {
-                        "gpt2s_decode_int8_kv_new_tokens_per_sec_per_chip":
-                        round(i8, 1),
-                        "gpt2s_decode_int8_kv_mbu": round(i8_mbu, 4)}
-                except Exception as e:
-                    print(f"  int8-kv decode failed ({e})", file=sys.stderr)
-                    return
+            if on_tpu:  # int8/fp8-KV A/B legs ride the same healthy window
+
+                def bank(extra_d=None):
+                    """Emit the decode line (ONE construction for both the
+                    banked fallbacks and the final form) and open a fresh
+                    watchdog window for the next quantized-cache leg — an
+                    already-banked line survives a later leg's wedge or
+                    crash (the watchdog re-emits the LAST line)."""
+                    nonlocal watchdog
+                    line = {"metric": metric, "value": round(v, 1),
+                            "unit": unit,
+                            "vs_baseline": round(v / base, 3),
+                            "mbu": round(mbu, 4), "config": args.config}
+                    if extra_d:
+                        line["extra"] = dict(extra_d)
+                    _emit(line)
+                    if watchdog is not None:
+                        watchdog.cancel()
+                        watchdog = _arm_watchdog(1500)
+
+                extra = {}
+                bank()
+                for leg in ("int8", "fp8"):
+                    try:
+                        tps_q, mbu_q = run_decode(b, args.steps,
+                                                  quiet=True,
+                                                  cache_dtype=leg)
+                    except Exception as e:
+                        print(f"  {leg}-kv decode failed ({e})",
+                              file=sys.stderr)
+                        return
+                    extra["gpt2s_decode_" + leg
+                          + "_kv_new_tokens_per_sec_per_chip"] \
+                        = round(tps_q, 1)
+                    extra[f"gpt2s_decode_{leg}_kv_mbu"] = round(mbu_q, 4)
+                    if leg != "fp8":     # the final form falls through to
+                        bank(extra)      # the shared emit below
         elif args.config == "gpt2s_serve":
             slots = args.batch or (8 if on_tpu else 2)
             n_req = 3 * slots

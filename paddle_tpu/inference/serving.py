@@ -99,6 +99,7 @@ class ServingEngine:
         self.T = cfg.max_seq_len
         self.eos = eos_token_id
         # argument validation FIRST — before any device allocation/compile
+        # (cache_dtype is validated centrally by _decode_fns' _QUANT table)
         if prefill_chunk is not None:
             if not 1 <= int(prefill_chunk) <= self.T:
                 raise ValueError(

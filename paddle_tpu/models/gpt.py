@@ -348,9 +348,6 @@ class GPTForCausalLM(nn.Layer):
         cache holds only local heads, two psums per layer ride the ICI —
         for models too big for one chip's HBM.
         See _gpt_generate/_gpt_beam_search for the TPU design notes."""
-        if cache_dtype not in (None, "int8"):
-            raise ValueError(
-                f"cache_dtype must be None or 'int8', got {cache_dtype!r}")
         if num_beams > 1:
             if top_p is not None or top_k is not None:
                 raise ValueError(
@@ -426,9 +423,12 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
     cache_dtype='int8' stores the cache as int8 values + per-row (over hd)
     f32 absmax scales, halving the HBM traffic of the cache reads that
     bound the decode loop even vs a bf16 cache; values dequantize blockwise
-    into the attention einsums (XLA fuses the multiply into the read). No
-    reference analog (the reference has no fused KV-cache decode at all) —
-    this is the int8-KV serving recipe from modern LLM inference stacks.
+    into the attention einsums (XLA fuses the multiply into the read).
+    cache_dtype='fp8' stores float8_e4m3fn at the same byte footprint —
+    scaled casts keep a mantissa instead of integer rounding (native fp8
+    on v5e+-class TPUs). No reference analog (the reference has no fused
+    KV-cache decode at all) — these are the quantized-KV serving recipes
+    from modern LLM inference stacks.
 
     tp_axis/tp_size: tensor-parallel serving inside shard_map — attention
     heads and the MLP inner dim are sharded over the mesh axis (Megatron
@@ -442,7 +442,21 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
     L, Hh = cfg.num_layers, cfg.num_heads
     hd = cfg.hidden_size // Hh
     scale = 1.0 / math.sqrt(hd)
-    int8_cache = cache_dtype == "int8"
+    # quantized cache formats: (storage dtype, qmax, integer rounding).
+    # int8 rounds+clips to +-127; fp8 (e4m3fn, max ~448) just casts — the
+    # per-row absmax scale puts values inside its representable range, and
+    # the cast keeps a mantissa instead of rounding to integers (coarser
+    # scale granularity, finer within-row resolution)
+    _QUANT = {"int8": (jnp.int8, 127.0, True),
+              "fp8": (jnp.float8_e4m3fn, 448.0, False)}
+    if cache_dtype is not None and cache_dtype not in _QUANT:
+        # the single interpreter of cache_dtype validates it for EVERY
+        # entry point (generate, beam, speculative, ServingEngine) — a
+        # typo must never silently serve a full-precision cache
+        raise ValueError(
+            f"cache_dtype must be None, 'int8', or 'fp8', "
+            f"got {cache_dtype!r}")
+    quant = _QUANT.get(cache_dtype)
     win = getattr(cfg, "attention_window", None)
     KVh = getattr(cfg, "num_kv_heads", Hh)  # GQA: compact K/V heads
     g = Hh // KVh                           # query heads per kv head
@@ -452,10 +466,10 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
     def cache_init(b_, T_, dt):
         # the cache holds only the COMPACT kv heads — the GQA serving win
         shape = (L, b_, KV_loc, T_, hd)
-        if not int8_cache:
+        if quant is None:
             z = jnp.zeros(shape, dt)
             return z, jnp.zeros_like(z)
-        vals = jnp.zeros(shape, jnp.int8)
+        vals = jnp.zeros(shape, quant[0])
         scales = jnp.zeros((L, b_, KV_loc, T_, 1), jnp.float32)
         return (vals, scales), (jnp.zeros_like(vals),
                                 jnp.zeros_like(scales))
@@ -469,17 +483,20 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
 
     def _store(c, val, i, pos):
         per_row = jnp.ndim(pos) == 1
-        if not int8_cache:
+        if quant is None:
             if per_row:
                 return c.at[i].set(_row_update(c[i], val, pos))
             return jax.lax.dynamic_update_slice(c, val[None],
                                                 (i, 0, 0, pos, 0))
+        qdt, qmax, integer = quant
         vals, scales = c
         s = jnp.maximum(
             jnp.max(jnp.abs(val), axis=-1, keepdims=True).astype(
-                jnp.float32) / 127.0, 1e-8)
-        q = jnp.clip(jnp.round(val.astype(jnp.float32) / s),
-                     -127, 127).astype(jnp.int8)
+                jnp.float32) / qmax, 1e-8)
+        q = val.astype(jnp.float32) / s
+        if integer:
+            q = jnp.clip(jnp.round(q), -qmax, qmax)
+        q = q.astype(qdt)
         if per_row:
             return (vals.at[i].set(_row_update(vals[i], q, pos)),
                     scales.at[i].set(_row_update(scales[i], s, pos)))
@@ -488,7 +505,7 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
                                              (i, 0, 0, pos, 0)))
 
     def _load(c, i, like):
-        if not int8_cache:
+        if quant is None:
             return c[i]
         vals, scales = c
         return (vals[i].astype(jnp.float32) * scales[i]).astype(like)

@@ -455,6 +455,77 @@ class TestInt8KVCache:
         assert sizes["int8"] < 0.75 * sizes["f32"], sizes
 
 
+class TestFp8KVCache:
+    """cache_dtype='fp8' (r5): float8_e4m3fn KV cache at int8's byte
+    footprint — scaled casts keep a mantissa instead of integer
+    rounding; the same (values, scales) plumbing as int8."""
+
+    def test_greedy_tracks_f32_cache_closely(self):
+        model = _model()
+        ids = paddle.to_tensor(
+            np.random.RandomState(5).randint(0, 128, (2, 6)).astype(np.int32))
+        f32 = np.asarray(model.generate(ids, max_new_tokens=8,
+                                        temperature=0.0)._data)
+        f8 = np.asarray(model.generate(ids, max_new_tokens=8,
+                                       temperature=0.0,
+                                       cache_dtype="fp8")._data)
+        assert f8.shape == f32.shape
+        agree = (f8[:, 6:] == f32[:, 6:]).mean()
+        assert agree > 0.5, (agree, f8, f32)
+
+    def test_serving_engine_fp8_exact_parity_vs_generate_fp8(self):
+        from paddle_tpu.inference.serving import ServingEngine
+
+        model = _model()
+        eng = ServingEngine(model, max_batch=2, cache_dtype="fp8")
+        rng = np.random.RandomState(6)
+        prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
+                   for n in (5, 9)]
+        rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        res = eng.run_until_complete()
+        for rid, p in zip(rids, prompts):
+            ref = np.asarray(model.generate(
+                paddle.to_tensor(p[None]), max_new_tokens=6,
+                temperature=0.0, cache_dtype="fp8")._data)[0, len(p):]
+            np.testing.assert_array_equal(res[rid].tokens, ref)
+
+    def test_cache_codec_dtypes_and_range(self):
+        # the cache really stores the quantized dtype (int8 / e4m3fn), and
+        # the fp8 codec's qmax=448 sits inside e4m3fn's representable range
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.gpt import _decode_fns
+
+        model = _model()
+        cfg = model.cfg
+        for cd in ("int8", "fp8"):
+            _, _, cache_init = _decode_fns(cfg, False, False,
+                                           cache_dtype=cd)
+            kc, vc = cache_init(1, 8, jnp.float32)
+            assert (kc[0].dtype == (jnp.int8 if cd == "int8"
+                                    else jnp.float8_e4m3fn))
+        x = jnp.asarray(447.0, jnp.float32).astype(jnp.float8_e4m3fn)
+        assert float(x.astype(jnp.float32)) > 400.0
+
+    def test_central_validation_covers_speculative(self):
+        # the _QUANT table is the single interpreter of cache_dtype: a
+        # typo through ANY entry point (here the speculative path, which
+        # has no validation of its own) must raise, never silently serve
+        # a full-precision cache
+        model = _model()
+        ids = paddle.to_tensor(np.ones((1, 4), np.int32))
+        with pytest.raises(ValueError, match="cache_dtype"):
+            model.generate_speculative(model, ids, max_new_tokens=2,
+                                       cache_dtype="f8")
+
+    def test_engine_rejects_unknown_cache_dtype(self):
+        from paddle_tpu.inference.serving import ServingEngine
+
+        model = _model()
+        with pytest.raises(ValueError, match="cache_dtype"):
+            ServingEngine(model, cache_dtype="int4")
+
+
 class TestSpeculativeDecoding:
     """generate_speculative: draft proposes k, target verifies in one
     forward; output must equal the target's own greedy decode."""
