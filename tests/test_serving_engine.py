@@ -380,6 +380,18 @@ class TestTPComposition:
                 res[rid].tokens,
                 _ref_new_tokens(m, np.concatenate([prefix, s]), 6))
 
+    def test_tp_with_fp8_kv(self, rng):
+        # fp8 cache tuple shares int8's (vals, scales) structure, so the
+        # head-sharded pytree-prefix spec must cover it identically
+        m = _model()
+        eng = ServingEngine(m, max_batch=2, tp_mesh=self._mesh(),
+                            cache_dtype="fp8")
+        p = rng.randint(0, 256, (9,)).astype(np.int32)
+        rid = eng.submit(p, max_new_tokens=6)
+        res = eng.run_until_complete()
+        np.testing.assert_array_equal(
+            res[rid].tokens, _ref_new_tokens(m, p, 6, cache_dtype="fp8"))
+
     def test_tp_prefix_with_chunked_and_int8(self, rng):
         # the full matrix corner: tp x chunked x prefix x int8 KV
         m = _model()

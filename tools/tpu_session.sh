@@ -34,8 +34,10 @@ if grep -q '"gpt2s_train_tokens_per_sec_per_chip"' /tmp/tpu_bench.json 2>/dev/nu
     > /tmp/tpu_bench_bert.json 2>/tmp/tpu_bench_bert.log
   echo "[tpu_session] bert exit=$? $(cat /tmp/tpu_bench_bert.json 2>/dev/null)" >&2
 
-  echo "[tpu_session] decode config (bf16 + int8-KV A/B)..." >&2
-  timeout 3500 python bench.py --config gpt2s_decode \
+  echo "[tpu_session] decode config (bf16 + int8 + fp8 KV A/B)..." >&2
+  # r5: three legs, inner watchdog windows ~900+1500+1500+1500 — the
+  # outer budget must cover them all
+  timeout 6500 python bench.py --config gpt2s_decode \
     > /tmp/tpu_bench_decode.json 2>/tmp/tpu_bench_decode.log
   echo "[tpu_session] decode exit=$? $(cat /tmp/tpu_bench_decode.json 2>/dev/null)" >&2
 
