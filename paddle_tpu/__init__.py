@@ -77,6 +77,7 @@ from . import linalg  # noqa: E402
 from . import fft  # noqa: E402
 from . import distribution  # noqa: E402
 from . import onnx  # noqa: E402
+from . import analysis  # noqa: E402
 from . import quantization  # noqa: E402
 from . import profiler as profiler  # noqa: E402
 from . import utils  # noqa: E402

@@ -1,0 +1,33 @@
+"""Graph-analysis pass registry: static inspection of traced jaxprs.
+
+Reference parity: the REGISTER_PASS layer (paddle/fluid/framework/ir — 107
+graph passes that inspect and rewrite the IR before execution). XLA owns
+rewriting here, so this package keeps the half the reference could not
+delegate: *analysis* — static detection of correctness and performance
+hazards in the traced program before it runs on the TPU (host syncs inside
+hot loops, PRNG key reuse, silent dtype widening, dead graph regions,
+recompilation triggers, collective drift, missed donations).
+
+Two surfaces:
+  - jaxpr passes (`registry.register_pass` + `run_passes`) over any traced
+    function, Program, or Predictor;
+  - an AST source linter (`source_lint`) with framework-specific rules run
+    over paddle_tpu/ itself.
+
+CLI: ``python tools/graph_lint.py --model gpt --json``; the tier-1 gate
+(tests/test_graph_lint_gate.py) pins zero error-severity findings on the
+bundled models and the serving decode step. See docs/ANALYSIS.md.
+"""
+from .registry import (  # noqa: F401
+    AnalysisContext,
+    AnalysisReport,
+    Finding,
+    SEVERITIES,
+    register_pass,
+    registered_passes,
+    run_passes,
+)
+from .collectives import count_hlo_collectives  # noqa: F401
+from . import passes  # noqa: F401  — registers the builtin pass battery
+from .source_lint import lint_path, lint_source  # noqa: F401
+from .targets import analyze_model, analyze_serving_decode  # noqa: F401

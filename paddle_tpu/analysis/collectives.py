@@ -1,0 +1,50 @@
+"""Collective-stream accounting, shared between the analysis passes and
+the perf-budget gate (tests/test_perf_budgets.py imports
+count_hlo_collectives — the exact-HLO-count machinery lived there first).
+
+EQuARX (arXiv:2506.17615) motivates this surface: on TPU slices the
+collective stream IS the scaling budget, so an unplanned all-gather is a
+regression worth failing a build over, and it is visible statically.
+"""
+import re
+
+# post-partitioning HLO op spellings (start variants cover async pairs)
+_HLO_KINDS = {
+    "all-reduce": r"all-reduce\(|all-reduce-start\(",
+    "all-gather": r"all-gather\(|all-gather-start\(",
+    "reduce-scatter": r"reduce-scatter\(",
+    "all-to-all": r"all-to-all\(",
+    "collective-permute": r"collective-permute\(|collective-permute-start\(",
+}
+
+HLO_COLLECTIVE_KINDS = tuple(_HLO_KINDS)
+
+# jaxpr-level collective primitives -> the HLO family they lower into
+JAXPR_COLLECTIVES = {
+    "psum": "all-reduce", "pmin": "all-reduce", "pmax": "all-reduce",
+    "all_gather": "all-gather", "reduce_scatter": "reduce-scatter",
+    "psum_scatter": "reduce-scatter", "all_to_all": "all-to-all",
+    "ppermute": "collective-permute", "pgather": "all-gather",
+}
+
+
+def count_hlo_collectives(hlo_text, kinds=("all-reduce", "all-gather",
+                                           "reduce-scatter")):
+    """Exact collective-op counts in compiled HLO text.
+
+    Default kinds match the historical perf-budget recording format, so
+    existing tests/perf_budgets.json baselines stay byte-compatible.
+    """
+    return {k: len(re.findall(_HLO_KINDS[k], hlo_text)) for k in kinds}
+
+
+def count_jaxpr_collectives(jaxpr):
+    """Collective eqn counts (by HLO family) at every nesting depth."""
+    from .jaxpr_utils import iter_eqns
+
+    out = {}
+    for eqn, _ in iter_eqns(jaxpr):
+        fam = JAXPR_COLLECTIVES.get(eqn.primitive.name)
+        if fam is not None:
+            out[fam] = out.get(fam, 0) + 1
+    return out

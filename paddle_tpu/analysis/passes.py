@@ -1,0 +1,415 @@
+"""Builtin jaxpr analysis passes (the REGISTER_PASS battery).
+
+Every pass is `fn(ctx) -> list[Finding]`, registered under a unique name
+with a default severity. Passes only read the jaxpr — nothing is compiled
+or executed — so the whole battery runs in milliseconds even over the
+flagship model traces, cheap enough for the tier-1 gate.
+
+Severity contract (pinned by tests/test_graph_lint_gate.py): the bundled
+models and the serving decode step must produce ZERO error findings;
+warnings are allowed and counted against tests/lint_baseline.json.
+"""
+import numpy as np
+
+from .collectives import (HLO_COLLECTIVE_KINDS, count_hlo_collectives,
+                          count_jaxpr_collectives)
+from .jaxpr_utils import fmt_aval, is_key_aval, iter_eqns, sub_jaxprs
+from .registry import register_pass
+
+# ---------------------------------------------------------------------------
+# host-sync: callbacks block the device stream (device_get / .item()-shaped
+# pulls raise at trace time and are policed by Tensor._to_host + the source
+# linter; what CAN hide in a traced graph is a callback primitive).
+# ---------------------------------------------------------------------------
+
+_BLOCKING_CALLBACKS = {"pure_callback", "io_callback", "callback"}
+_DEBUG_CALLBACKS = {"debug_callback", "debug_print"}
+
+
+@register_pass("host-sync", severity="error")
+def host_sync(ctx):
+    out = []
+    for eqn, path in iter_eqns(ctx.jaxpr):
+        p = eqn.primitive.name
+        if p in _BLOCKING_CALLBACKS:
+            out.append(host_sync.finding(
+                f"host callback '{p}' inside the traced graph: every step "
+                "round-trips device->host->device (the .numpy()/.item() "
+                "class of sync, compiled in)", where=path))
+        elif p in _DEBUG_CALLBACKS:
+            out.append(host_sync.finding(
+                f"debug callback '{p}' in traced graph: fine for "
+                "debugging, a host sync per step if left in a hot loop",
+                where=path, severity="warning"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PRNG hygiene: key reuse + baked trace-time keys.
+#
+# Consuming the same key twice — by two samplers, OR by two splits (split
+# is deterministic: split(k) twice yields identical subkeys) — means
+# correlated randomness. Alias-producing eqns (slice/squeeze on a key
+# array) are resolved to (root, selector) identities so the canonical
+# dropout chain `split -> keys[0], keys[1]` does not false-positive while
+# `keys[0], keys[0]` does.
+# ---------------------------------------------------------------------------
+
+_RANDOM_SINKS = {"random_bits", "threefry2x32", "random_gamma",
+                 "rng_bit_generator"}
+_KEY_DERIVERS = {"random_split", "random_fold_in"}   # consume key material
+_KEY_ALIASES = {"copy", "device_put", "broadcast_in_dim", "reshape",
+                "slice", "squeeze", "expand_dims", "transpose",
+                "convert_element_type", "random_wrap", "random_unwrap",
+                "dynamic_slice", "gather"}
+_ALIAS_PARAM_KEYS = ("start_indices", "limit_indices", "strides",
+                     "dimensions", "permutation", "new_sizes",
+                     "slice_sizes", "broadcast_dimensions", "shape",
+                     "dimension_numbers")
+
+
+class _KeyFlow:
+    """Per-jaxpr key-usage analysis with memoized recursion into calls."""
+
+    def __init__(self):
+        self.memo = {}       # id(jaxpr) -> set of materially-used invar idx
+        self.findings = []   # [(sites,)] — each a reuse of one identity
+
+    def _alias_id(self, producers, var, depth=0):
+        from .jaxpr_utils import is_literal
+
+        eqn = producers.get(id(var))
+        if eqn is None or depth > 64:
+            return id(var)
+        if eqn.primitive.name in _KEY_ALIASES and eqn.invars and \
+                hasattr(eqn.invars[0], "aval"):
+            # a TRACED operand (dynamic_slice start, gather indices) makes
+            # the selection value-dependent — two such slices may or may
+            # not pick the same key, so each stays a DISTINCT identity
+            # (conservative: misses reuse via equal traced indices, never
+            # false-positives on keys[i] vs keys[j])
+            if any(not is_literal(v) for v in eqn.invars[1:]):
+                return id(var)
+            sel = tuple((k, str(eqn.params[k])) for k in _ALIAS_PARAM_KEYS
+                        if k in eqn.params)
+            return (self._alias_id(producers, eqn.invars[0], depth + 1),
+                    eqn.primitive.name, sel)
+        return id(var)
+
+    def analyze(self, jaxpr, path=""):
+        """Returns the set of invar indices whose keys are materially
+        consumed (directly or transitively); records reuse findings."""
+        key = id(jaxpr)
+        if key in self.memo:
+            return self.memo[key]
+        self.memo[key] = set()   # cycle guard (jaxprs are acyclic, but…)
+
+        producers = {}
+        for eqn in jaxpr.eqns:
+            for ov in eqn.outvars:
+                producers[id(ov)] = eqn
+        uses = {}        # alias identity -> [(path, primitive), ...]
+
+        def use(var, where, prim):
+            ident = self._alias_id(producers, var)
+            uses.setdefault(ident, []).append((where, prim))
+
+        for i, eqn in enumerate(jaxpr.eqns):
+            here = f"{path}eqns[{i}]"
+            p = eqn.primitive.name
+            if p in _RANDOM_SINKS or p in _KEY_DERIVERS:
+                for v in eqn.invars:
+                    if hasattr(v, "aval") and is_key_aval(v.aval):
+                        use(v, here, p)
+                continue
+            subs = [s for _, s in sub_jaxprs(eqn)]
+            if subs:
+                tag = eqn.params.get("name", "")
+                label = f"{p}:{tag}" if tag else p
+                for sub in subs:
+                    inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+                    used_idx = self.analyze(inner, f"{here}/{label}/")
+                    # align inner invars to the eqn's trailing invars
+                    # (cond carries a leading predicate, scan leading
+                    # consts — tail alignment covers both)
+                    off = len(eqn.invars) - len(inner.invars)
+                    for idx in used_idx:
+                        j = idx + off
+                        if 0 <= j < len(eqn.invars):
+                            v = eqn.invars[j]
+                            if hasattr(v, "aval") and is_key_aval(v.aval):
+                                use(v, here, label)
+
+        invar_ids = {id(v): i for i, v in enumerate(jaxpr.invars)}
+        used_invars = set()
+        for ident, sites in uses.items():
+            root = ident
+            while isinstance(root, tuple):
+                root = root[0]
+            if root in invar_ids:
+                used_invars.add(invar_ids[root])
+            if len(sites) >= 2:   # memoization => reported once per jaxpr
+                self.findings.append((sites,))
+        self.memo[key] = used_invars
+        return used_invars
+
+
+@register_pass("prng-key-reuse", severity="error")
+def prng_key_reuse(ctx):
+    flow = _KeyFlow()
+    flow.analyze(ctx.jaxpr)
+    out = []
+    for (sites,) in flow.findings:
+        where = sites[0][0]
+        consumers = ", ".join(f"{prim} @ {p}" for p, prim in sites[:4])
+        out.append(prng_key_reuse.finding(
+            f"PRNG key consumed {len(sites)}x — identical key material "
+            f"feeds [{consumers}]; split the key per consumer "
+            "(jax.random.split) or fold_in distinct data", where=where))
+    return out
+
+
+@register_pass("prng-const-key", severity="warning")
+def prng_const_key(ctx):
+    """A key baked as a trace-time constant: every invocation of the
+    compiled program replays the SAME randomness (the generator.py
+    docstring's stale-dropout-mask hazard, detected statically)."""
+    const_ids = {id(cv): i for i, cv in enumerate(ctx.jaxpr.constvars)
+                 if is_key_aval(cv.aval)}
+    if not const_ids:
+        return []
+    # constvars are scoped to the top level — one finding per (key, site)
+    consumed = set()
+    for eqn, path in iter_eqns(ctx.jaxpr, max_depth=0):
+        for v in eqn.invars:
+            if id(v) in const_ids:
+                consumed.add((const_ids[id(v)], path))
+    out = []
+    for idx, path in sorted(consumed):
+        out.append(prng_const_key.finding(
+            "PRNG key baked into the trace as a constant: the compiled "
+            "program reuses identical randomness every call (draw keys "
+            "inside a traced_rng scope or thread them as arguments)",
+            where=path))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dtype-promotion audit: silent widening costs 2x bytes (f32->f64 also
+# 10-100x FLOPs on TPU, which has no f64 units).
+# ---------------------------------------------------------------------------
+
+_WIDENINGS = {  # (src, dst) -> severity
+    ("float32", "float64"): "error",
+    ("bfloat16", "float32"): "warning",
+    ("float16", "float32"): "warning",
+    ("int32", "int64"): "warning",
+}
+
+
+@register_pass("dtype-promotion", severity="warning")
+def dtype_promotion(ctx):
+    groups = {}   # (src, dst) -> [paths]
+    for eqn, path in iter_eqns(ctx.jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        v = eqn.invars[0]
+        if not hasattr(v, "aval"):
+            continue
+        if getattr(v.aval, "weak_type", False):
+            continue   # python-scalar promotion, not a data widening
+        pair = (str(v.aval.dtype), str(np.dtype(eqn.params["new_dtype"])))
+        if pair in _WIDENINGS:
+            groups.setdefault(pair, []).append(path)
+    out = []
+    for (src, dst), paths in sorted(groups.items()):
+        sev = _WIDENINGS[(src, dst)]
+        ex = "; ".join(paths[:3]) + ("; …" if len(paths) > 3 else "")
+        out.append(dtype_promotion.finding(
+            f"silent {src}->{dst} widening x{len(paths)} (examples: {ex}) "
+            "— 2x bytes moved per widened tensor"
+            + ("; f64 has no TPU unit" if dst == "float64" else ""),
+            where=paths[0], severity=sev))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dead-code report: eqns whose outputs nothing consumes. XLA DCEs them at
+# compile time, but tracing/lowering them still costs, and dead regions
+# usually mean a model wiring bug (an output computed and dropped).
+# ---------------------------------------------------------------------------
+
+
+def _dead_eqns(jaxpr, path=""):
+    import jax
+
+    live = {id(v) for v in jaxpr.outvars if hasattr(v, "aval")}
+    dead = []
+    for i in range(len(jaxpr.eqns) - 1, -1, -1):
+        eqn = jaxpr.eqns[i]
+        outs_alive = bool(eqn.effects) or any(
+            not isinstance(v, jax.core.DropVar) and id(v) in live
+            for v in eqn.outvars)
+        if outs_alive:
+            for v in eqn.invars:
+                if hasattr(v, "aval"):
+                    live.add(id(v))
+        else:
+            dead.append((f"{path}eqns[{i}]", eqn.primitive.name,
+                         fmt_aval(eqn.outvars[0].aval)
+                         if eqn.outvars else ""))
+    for i, eqn in enumerate(jaxpr.eqns):
+        tag = eqn.params.get("name", "")
+        label = (f"{eqn.primitive.name}:{tag}" if tag
+                 else eqn.primitive.name)
+        for _, sub in sub_jaxprs(eqn):
+            inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+            dead.extend(_dead_eqns(inner, f"{path}eqns[{i}]/{label}/"))
+    return dead
+
+
+@register_pass("dead-code", severity="info")
+def dead_code(ctx):
+    dead = _dead_eqns(ctx.jaxpr)
+    if not dead:
+        return []
+    total = sum(1 for _ in iter_eqns(ctx.jaxpr))
+    ex = ", ".join(f"{prim}@{p}" for p, prim, _ in dead[:4])
+    sev = "warning" if len(dead) * 4 > total else "info"
+    return [dead_code.finding(
+        f"{len(dead)}/{total} eqns compute values nothing consumes "
+        f"(examples: {ex}) — XLA will DCE them, but dead regions usually "
+        "mean a dropped output or stale wiring", where=dead[0][0],
+        severity=sev)]
+
+
+# ---------------------------------------------------------------------------
+# recompilation-hazard scan: python scalars / arrays closed over as consts.
+# A const that varies per call (a step count, a freshly-drawn array) means
+# a new trace+compile per call — the classic silent-recompile bug.
+# ---------------------------------------------------------------------------
+
+
+@register_pass("recompile-hazard", severity="info")
+def recompile_hazard(ctx):
+    out = []
+    scalars = []
+    for cv, c in zip(ctx.jaxpr.constvars, ctx.consts):
+        if is_key_aval(cv.aval):
+            continue   # prng-const-key owns baked keys
+        size = int(np.prod(getattr(cv.aval, "shape", ()) or (1,)))
+        if getattr(cv.aval, "shape", None) == ():
+            scalars.append(fmt_aval(cv.aval))
+        elif size >= ctx.large_threshold:
+            out.append(recompile_hazard.finding(
+                f"large array ({fmt_aval(cv.aval)}, {size} elems) closed "
+                "over as a trace constant — baked into the executable "
+                "(weights should flow as arguments; a varying closure "
+                "forces a recompile per distinct value)",
+                where="constvars", severity="warning"))
+    if scalars:
+        out.append(recompile_hazard.finding(
+            f"{len(scalars)} python scalar(s) baked as trace constants "
+            f"({', '.join(scalars[:6])}) — if any varies across calls, "
+            "each new value re-traces and re-compiles the program",
+            where="constvars"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# collective-count audit: the EQuARX-motivated collective-stream ledger.
+# ---------------------------------------------------------------------------
+
+
+@register_pass("collective-count", severity="info")
+def collective_count(ctx):
+    out = []
+    jx = count_jaxpr_collectives(ctx.jaxpr)
+    for fam in sorted(jx):
+        out.append(collective_count.finding(
+            f"{jx[fam]} {fam} collective(s) in the traced graph",
+            where=fam))
+    if ctx.hlo_text is not None:
+        # count every family the jaxpr side knows, not just the 3 kinds
+        # the perf-budget recording format defaults to
+        hlo = count_hlo_collectives(ctx.hlo_text,
+                                    kinds=HLO_COLLECTIVE_KINDS)
+        present = {k: v for k, v in hlo.items() if v}
+        if present:
+            out.append(collective_count.finding(
+                f"post-partitioning HLO collective counts: {present} "
+                "(exact — the perf-budget gate pins these per program)",
+                where="hlo"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# unsharded-large-tensor: under a mesh, big intermediates with no sharding
+# constraint replicate on every device — the classic HBM blow-up.
+# ---------------------------------------------------------------------------
+
+
+@register_pass("unsharded-large-tensor", severity="warning")
+def unsharded_large_tensor(ctx):
+    if ctx.mesh is None:
+        return []
+    constrained = set()
+    for eqn, _ in iter_eqns(ctx.jaxpr):
+        if eqn.primitive.name == "sharding_constraint":
+            for v in list(eqn.invars) + list(eqn.outvars):
+                constrained.add(id(v))
+    offenders = []
+    for eqn, path in iter_eqns(ctx.jaxpr):
+        if eqn.primitive.name == "sharding_constraint":
+            continue
+        for v in eqn.outvars:
+            if not hasattr(v, "aval") or id(v) in constrained:
+                continue
+            shape = getattr(v.aval, "shape", ())
+            if shape and int(np.prod(shape)) >= ctx.large_threshold:
+                offenders.append((path, fmt_aval(v.aval)))
+    if not offenders:
+        return []
+    ex = "; ".join(f"{a} @ {p}" for p, a in offenders[:4])
+    return [unsharded_large_tensor.finding(
+        f"{len(offenders)} intermediate(s) >= {ctx.large_threshold} "
+        f"elements with no sharding constraint under a "
+        f"{dict(ctx.mesh.shape)} mesh (examples: {ex}) — replicated on "
+        "every device unless the partitioner guesses right",
+        where=offenders[0][0])]
+
+
+# ---------------------------------------------------------------------------
+# donation-miss: an input whose shape/dtype matches an output could be
+# donated (aliased in place) — not donating doubles its HBM footprint.
+# ---------------------------------------------------------------------------
+
+
+@register_pass("donation-miss", severity="info")
+def donation_miss(ctx):
+    outs = {}
+    for ov in ctx.jaxpr.outvars:
+        if hasattr(ov, "aval") and getattr(ov.aval, "shape", None) is not None:
+            outs.setdefault(
+                (tuple(ov.aval.shape), str(ov.aval.dtype)), 0)
+            outs[(tuple(ov.aval.shape), str(ov.aval.dtype))] += 1
+    missed = []
+    for i, iv in enumerate(ctx.jaxpr.invars):
+        if ctx.donated is not None and i in ctx.donated:
+            continue
+        aval = getattr(iv, "aval", None)
+        if aval is None or not getattr(aval, "shape", None):
+            continue
+        size = int(np.prod(aval.shape))
+        key = (tuple(aval.shape), str(aval.dtype))
+        if size >= ctx.large_threshold and outs.get(key, 0) > 0:
+            missed.append((i, fmt_aval(aval)))
+    if not missed:
+        return []
+    sev = "warning" if ctx.donated is not None else "info"
+    ex = ", ".join(f"invar[{i}] {a}" for i, a in missed[:4])
+    return [donation_miss.finding(
+        f"{len(missed)} large input(s) whose shape/dtype matches an "
+        f"output are not donated ({ex}) — donate_argnums would let XLA "
+        "reuse the buffer in place (2x HBM otherwise)",
+        where=f"invar[{missed[0][0]}]", severity=sev)]

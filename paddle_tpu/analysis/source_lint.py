@@ -1,0 +1,179 @@
+"""AST-based source linter with framework-specific rules, run over
+paddle_tpu/ itself (tools/graph_lint.py --all and the tier-1 gate).
+
+Rules target the hazards the jaxpr passes cannot see because they happen
+BEFORE tracing:
+
+  np-random-in-traced-code : np.random.* inside a function of the
+      trace-reachable core (nn/, models/, ops/, tensor/, core/, amp/).
+      Under jit the draw happens once at trace time and the sample is
+      BAKED into the compiled program — every step replays it. Layer
+      __init__ / parameter-init code is exempt (runs eagerly, once).
+  time-in-traced-code : time.time()/perf_counter() in the same scope —
+      a trace-time constant masquerading as a clock.
+  mutable-default-arg : list/dict/set literal defaults on methods of
+      nn.Layer subclasses — shared across every instance of the layer
+      (the classic aliasing bug, promoted to error because layers are
+      long-lived and cloned).
+
+Suppression: a trailing ``# lint: allow(<rule>)`` comment on the
+offending line acknowledges a documented, deliberate exception (e.g. an
+eager host op that already warns under tracing).
+"""
+import ast
+import os
+import re
+
+from .registry import Finding
+
+# packages whose function bodies are reachable from a jit trace
+_TRACED_PKGS = ("nn", "models", "ops", "tensor", "core", "amp")
+# methods that run eagerly at construction time, never inside a trace
+_INIT_METHODS = {"__init__", "__init_subclass__", "reset_parameters",
+                 "_init_weights", "extra_repr", "__repr__"}
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([a-z0-9_,\- ]+)\)")
+
+RULES = {
+    "np-random-in-traced-code": "error",
+    "time-in-traced-code": "warning",
+    "mutable-default-arg": "error",
+    "syntax-error": "error",
+}
+
+
+def _allowed(lines, lineno, rule):
+    if 1 <= lineno <= len(lines):
+        m = _ALLOW_RE.search(lines[lineno - 1])
+        if m and rule in [r.strip() for r in m.group(1).split(",")]:
+            return True
+    return False
+
+
+def _dotted(node):
+    """'np.random.uniform' for an Attribute/Call chain, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_layer_class(cls):
+    for b in cls.bases:
+        name = _dotted(b) or (b.id if isinstance(b, ast.Name) else "")
+        if name.split(".")[-1] in ("Layer", "Module"):
+            return True
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rel_path, lines, traced):
+        self.rel = rel_path
+        self.lines = lines
+        self.traced = traced
+        self.findings = []
+        self._func_stack = []
+        self._class_stack = []
+
+    def _emit(self, rule, lineno, message):
+        if _allowed(self.lines, lineno, rule):
+            return
+        self.findings.append(Finding(
+            rule, RULES[rule], message, where=f"{self.rel}:{lineno}"))
+
+    # -- function / class scoping ------------------------------------------
+    def visit_ClassDef(self, node):
+        self._class_stack.append(node)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_func(self, node):
+        if (self._class_stack and _is_layer_class(self._class_stack[-1])
+                and self._func_stack == []):
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]
+            for d in defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    self._emit(
+                        "mutable-default-arg", d.lineno,
+                        f"mutable default argument on "
+                        f"{self._class_stack[-1].name}.{node.name} — "
+                        "shared across every call and instance; default "
+                        "to None and build inside the body")
+        self._func_stack.append(node)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _in_traced_scope(self):
+        if not self.traced or not self._func_stack:
+            return False
+        return self._func_stack[0].name not in _INIT_METHODS
+
+    # -- call-site rules ----------------------------------------------------
+    def visit_Call(self, node):
+        name = _dotted(node.func)
+        if self._in_traced_scope():
+            if name.startswith(("np.random.", "numpy.random.")) or \
+                    name in ("np.random", "numpy.random"):
+                self._emit(
+                    "np-random-in-traced-code", node.lineno,
+                    f"{name}(...) in jit-reachable code: under a trace "
+                    "the draw happens once and the sample is baked into "
+                    "the compiled program — use jax.random with a "
+                    "threaded key (or mark a documented eager host op "
+                    "with `# lint: allow(np-random-in-traced-code)`)")
+            elif name in ("time.time", "time.perf_counter",
+                          "time.monotonic"):
+                self._emit(
+                    "time-in-traced-code", node.lineno,
+                    f"{name}() in jit-reachable code: a trace-time "
+                    "constant, frozen into the compiled program")
+        self.generic_visit(node)
+
+
+def lint_source(source, rel_path="<string>", traced=True):
+    """Lint one python source string; returns a list of Finding."""
+    tree = ast.parse(source)
+    v = _Visitor(rel_path, source.splitlines(), traced)
+    v.visit(tree)
+    v.findings.sort(key=lambda f: f.where)
+    return v.findings
+
+
+def _is_traced_module(rel_path):
+    top = rel_path.split(os.sep)[0]
+    if top not in _TRACED_PKGS:
+        return False
+    # vision/io/text/datasets are host-side by design; nn/, models/ etc.
+    # are fully trace-reachable
+    return True
+
+
+def lint_path(root=None):
+    """Lint the paddle_tpu package tree; returns a list of Finding."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = []
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            try:
+                findings.extend(
+                    lint_source(src, rel, traced=_is_traced_module(rel)))
+            except SyntaxError as e:   # pragma: no cover — repo is valid
+                findings.append(Finding(
+                    "syntax-error", "error",
+                    f"unparseable source: {e}", where=rel))
+    return findings

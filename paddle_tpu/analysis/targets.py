@@ -1,0 +1,106 @@
+"""Standard analysis targets: the bundled models' forwards and the serving
+engine's decode step, traced to jaxprs and run through the pass battery.
+
+Shapes are CPU-shrunk (the tests/test_perf_budgets.py convention) so the
+whole battery — trace + passes, no compilation — fits inside the tier-1
+budget. Python warnings raised DURING tracing (truncated dtypes, baked
+trace-time draws…) are converted into findings under the synthetic pass
+name ``trace-warnings`` so dtype-hygiene regressions in model code fail
+the same gate as jaxpr-level findings.
+"""
+import warnings
+
+from .registry import Finding, run_passes
+
+# small-but-structural configs: 2 layers keeps every eqn pattern of the
+# full models (block stacking, final norm, tied head) at trace cost ~100ms
+_MODEL_DIMS = dict(vocab_size=256, hidden_size=64, num_layers=2,
+                   num_heads=4, dropout=0.0)
+
+MODEL_TARGETS = ("gpt", "bert", "ernie")
+
+
+def _build_model(name):
+    import paddle_tpu as paddle
+    from ..models import (BertConfig, BertModel, ErnieConfig, ErnieModel,
+                          GPTConfig, GPTForCausalLM)
+
+    paddle.seed(0)
+    if name == "gpt":
+        m = GPTForCausalLM(GPTConfig(max_seq_len=64, **_MODEL_DIMS))
+    elif name == "bert":
+        m = BertModel(BertConfig(max_position=64, intermediate_size=256,
+                                 **_MODEL_DIMS))
+    elif name == "ernie":
+        m = ErnieModel(ErnieConfig(max_position=64, intermediate_size=256,
+                                   **_MODEL_DIMS))
+    else:
+        raise ValueError(
+            f"unknown model target {name!r}; choose from {MODEL_TARGETS}")
+    m.eval()
+    return m
+
+
+def _trace_with_warnings(trace_fn):
+    """Run trace_fn, returning (closed_jaxpr, [Finding from warnings])."""
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        closed = trace_fn()
+    findings = [
+        Finding("trace-warnings", "warning",
+                f"python warning during trace: {w.category.__name__}: "
+                f"{w.message}", where=f"{w.filename}:{w.lineno}")
+        for w in caught]
+    return closed, findings
+
+
+def analyze_model(name, training=False, **run_kwargs):
+    """Trace one bundled model's forward and run the full pass battery."""
+    import jax.numpy as jnp
+
+    from .jaxpr_utils import trace_layer
+
+    m = _build_model(name)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    closed, warn_findings = _trace_with_warnings(
+        lambda: trace_layer(m, ids, training=training))
+    report = run_passes(closed, name=f"{name}_forward", **run_kwargs)
+    report.extend(warn_findings)
+    return report.sort()
+
+
+def analyze_serving_decode(**run_kwargs):
+    """The ServingEngine greedy decode step — the serve hot loop.
+
+    The engine donates its KV caches (donate_argnums=(1, 2) on
+    _step_greedy); that intent is threaded into the donation-miss pass.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..inference.serving import ServingEngine
+
+    def build():
+        eng = ServingEngine(_build_model("gpt"), max_batch=2)
+        pos = jnp.zeros((eng.B,), jnp.int32)
+        tok = jnp.zeros((eng.B,), jnp.int32)
+        return jax.make_jaxpr(eng._step_greedy)(
+            eng._params, eng._kc, eng._vc, tok, pos)
+
+    closed, warn_findings = _trace_with_warnings(build)
+    report = run_passes(closed, name="serve_decode_step",
+                        donated=_cache_invars(closed), **run_kwargs)
+    report.extend(warn_findings)
+    return report.sort()
+
+
+def _cache_invars(closed):
+    """Indices of invars that look like the donated KV caches: rank >= 4
+    arrays (layers x batch x seq x heads…) — the only buffers
+    _step_greedy donates."""
+    out = set()
+    for i, iv in enumerate(closed.jaxpr.invars):
+        shp = getattr(iv.aval, "shape", ())
+        if shp is not None and len(shp) >= 4:
+            out.add(i)
+    return out

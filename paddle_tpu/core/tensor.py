@@ -99,15 +99,45 @@ class Tensor:
         return self.ndim
 
     def numpy(self):
-        return np.asarray(self._data)
+        return self._to_host()
 
     def item(self, *args):
         if args:
-            return self.numpy().item(*args)
-        return self.numpy().item()
+            return self._to_host().item(*args)
+        return self._to_host().item()
 
     def tolist(self):
-        return self.numpy().tolist()
+        return self._to_host().tolist()
+
+    def _to_host(self):
+        """Single device->host chokepoint behind numpy()/item()/tolist()/
+        __bool__/__int__/__float__ — THE sync the analysis layer polices.
+
+        Inside a jax trace the value is abstract, so a host pull can never
+        succeed; FLAGS_trace_host_sync picks what happens before jax's own
+        (opaque) tracer error: "silent" (default — prior behavior),
+        "warn" (explain the sync, then let jax raise), or "error" (raise
+        immediately with the framework-level message). Eager tensors are
+        unaffected in every mode.
+        """
+        data = self._data
+        if _is_tracer(data):
+            from .. import flags as _flags
+
+            mode = _flags.get_flag("trace_host_sync", "silent")
+            if mode in ("warn", "error"):
+                msg = ("Tensor host sync (.numpy()/.item()/.tolist()/"
+                       "bool()/int()/float()) inside a traced function: "
+                       "the value is abstract at trace time and each call "
+                       "would block the device stream at run time. Return "
+                       "the tensor from the jitted function instead, or "
+                       "use jax.debug hooks for prints.")
+                if mode == "error":
+                    raise RuntimeError(msg)
+                import warnings
+
+                warnings.warn(msg, stacklevel=3)
+        return np.asarray(data)
 
     def __len__(self):
         if self.ndim == 0:

@@ -58,6 +58,11 @@ define_flag("sort_sum_gradient", False, "deterministic grad accumulation order (
 define_flag("benchmark", False, "sync after each op for timing")
 define_flag("seed", 0, "global random seed")
 define_flag("use_bfloat16", True, "prefer bfloat16 matmuls on MXU")
+define_flag("trace_host_sync", "silent",
+            "what Tensor._to_host does when a host pull (.numpy()/.item()) "
+            "happens inside a jax trace: silent (jax's own tracer error), "
+            "warn (explain the sync first), error (raise immediately). "
+            "The analysis host-sync pass polices the compiled-in form.")
 define_flag("flash_attention_block", 0,
             "force the flash-attention Pallas block size (128/256/512); "
             "0 = auto (largest of 512/256/128 dividing seq). For on-chip "

@@ -127,12 +127,26 @@ class Predictor:
     def get_output_handle(self, name):
         return _IOTensor(self._outputs, name)
 
-    def run(self, inputs=None):
-        """inputs: optional list of numpy arrays (paddle_infer.Predictor.run parity)."""
+    def _stage_inputs(self, inputs):
+        """Bind positional inputs to named slots and collect the call's
+        arrays in slot order. Inputs beyond the known names ride along
+        POSITIONALLY for this call only (they used to be staged under an
+        unlisted name and silently dropped from the forward call) —
+        nothing persists for them, so an accidental surplus input fails
+        its own call without poisoning later ones."""
+        extras = []
         if inputs is not None:
             for i, a in enumerate(inputs):
-                self._inputs[f"input_{i}" if i >= len(self._input_names) else self._input_names[i]] = a
-        arrs = [self._inputs[n] for n in self._input_names if n in self._inputs]
+                if i < len(self._input_names):
+                    self._inputs[self._input_names[i]] = a
+                else:
+                    extras.append(a)
+        return [self._inputs[n] for n in self._input_names
+                if n in self._inputs] + extras
+
+    def run(self, inputs=None):
+        """inputs: optional list of numpy arrays (paddle_infer.Predictor.run parity)."""
+        arrs = self._stage_inputs(inputs)
         if self._aot is not None:
             try:
                 return self._pack_outputs(self._aot(*arrs))
@@ -146,40 +160,67 @@ class Predictor:
                 self._aot = None
         key = tuple((a.shape, str(a.dtype)) for a in arrs)
         if key not in self._compiled:
-            layer = self._layer
-            tape = global_tape()
-            hint = self._precision
-
-            low_precision = bool(hint) and \
-                hint.get("dtype") in ("bfloat16", "float16")
-
-            def pure(*xs):
-                import contextlib
-
-                amp_ctx = contextlib.nullcontext()
-                if low_precision:
-                    from ..amp import auto_cast
-
-                    amp_ctx = auto_cast(
-                        True, dtype=hint["dtype"],
-                        custom_black_list=hint.get("black_list") or None)
-                with tape.pause(), amp_ctx:
-                    out = layer(*[Tensor(x) for x in xs])
-                out = jax.tree_util.tree_map(
-                    lambda v: v._data if isinstance(v, Tensor) else v, out,
-                    is_leaf=lambda v: isinstance(v, Tensor),
-                )
-                if low_precision and hint.get("keep_io_types", True):
-                    out = jax.tree_util.tree_map(
-                        lambda v: v.astype(jnp.float32)
-                        if hasattr(v, "dtype")
-                        and jnp.issubdtype(v.dtype, jnp.floating)
-                        and v.dtype != jnp.float32 else v, out)
-                return out
-
-            self._compiled[key] = jax.jit(pure)
+            self._compiled[key] = jax.jit(self._pure_fn())
         out = self._compiled[key](*[jnp.asarray(a) for a in arrs])
         return self._pack_outputs(out)
+
+    def _pure_fn(self):
+        """The pure forward Run() jits — also handed (un-jitted) to
+        paddle_tpu.analysis via analysis_jaxpr, so lint findings refer to
+        the exact graph the predictor executes."""
+        layer = self._layer
+        tape = global_tape()
+        hint = self._precision
+
+        low_precision = bool(hint) and \
+            hint.get("dtype") in ("bfloat16", "float16")
+
+        def pure(*xs):
+            import contextlib
+
+            amp_ctx = contextlib.nullcontext()
+            if low_precision:
+                from ..amp import auto_cast
+
+                amp_ctx = auto_cast(
+                    True, dtype=hint["dtype"],
+                    custom_black_list=hint.get("black_list") or None)
+            with tape.pause(), amp_ctx:
+                out = layer(*[Tensor(x) for x in xs])
+            out = jax.tree_util.tree_map(
+                lambda v: v._data if isinstance(v, Tensor) else v, out,
+                is_leaf=lambda v: isinstance(v, Tensor),
+            )
+            if low_precision and hint.get("keep_io_types", True):
+                out = jax.tree_util.tree_map(
+                    lambda v: v.astype(jnp.float32)
+                    if hasattr(v, "dtype")
+                    and jnp.issubdtype(v.dtype, jnp.floating)
+                    and v.dtype != jnp.float32 else v, out)
+            return out
+
+        return pure
+
+    def analysis_jaxpr(self, inputs=None):
+        """Trace the predictor's forward to a ClosedJaxpr for
+        paddle_tpu.analysis.run_passes (tracing only — nothing runs).
+
+        inputs: optional list of numpy arrays; defaults to whatever was
+        staged via get_input_handle().copy_from_cpu(). Requires the
+        re-jit (pickled-Layer) path — the AOT artifact is already
+        compiled HLO with no jaxpr to inspect.
+        """
+        arrs = self._stage_inputs(inputs)
+        if not arrs:
+            raise ValueError("analysis_jaxpr: no inputs staged — pass "
+                             "inputs= or copy_from_cpu first")
+        if self._layer is None:
+            self._load_pickled_layer(self.config.model_path)
+        if self._layer is None:
+            raise RuntimeError("analysis_jaxpr: AOT-only artifact (no "
+                               "pickled Layer to re-trace)")
+        return jax.make_jaxpr(self._pure_fn())(
+            *[jnp.asarray(a) for a in arrs])
 
     def _pack_outputs(self, out):
         outs = out if isinstance(out, (list, tuple)) else [out]

@@ -45,7 +45,8 @@ class BertEmbeddings(nn.Layer):
         from ..tensor.creation import arange, zeros_like
 
         s = input_ids.shape[1]
-        pos = arange(s, dtype="int64")
+        pos = arange(s, dtype="int32")  # int32: x64 is off on TPU/CPU — an "int64" request
+        # is truncated with a per-call UserWarning (caught by the analysis trace-warnings gate)
         x = self.word(input_ids) + self.position(pos)
         if token_type_ids is None:
             # BERT semantics: absent segment ids mean segment 0, whose

@@ -290,7 +290,8 @@ class GPTModel(nn.Layer):
 
         from ..tensor.creation import arange
 
-        pos = arange(s, dtype="int64")
+        pos = arange(s, dtype="int32")  # int32: x64 is off on TPU/CPU — an "int64" request
+        # is truncated with a per-call UserWarning (caught by the analysis trace-warnings gate)
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
         for blk in self.blocks:
@@ -1236,7 +1237,8 @@ class GPTEmbed(nn.Layer):
         from ..tensor.creation import arange
 
         s = input_ids.shape[-1]
-        pos = arange(s, dtype="int64")
+        pos = arange(s, dtype="int32")  # int32: x64 is off on TPU/CPU — an "int64" request
+        # is truncated with a per-call UserWarning (caught by the analysis trace-warnings gate)
         return self.drop(self.wte(input_ids) + self.wpe(pos))
 
 

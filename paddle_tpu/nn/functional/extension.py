@@ -593,7 +593,7 @@ def tdm_sampler(x, travel, layer, neg_samples_num_list, layer_offset_lod,
     ids = np.asarray(_t(x)._data).astype(np.int64).reshape(-1)
     trav = np.asarray(_t(travel)._data).astype(np.int64)
     lay = np.asarray(_t(layer)._data).astype(np.int64).reshape(-1)
-    rng_ = np.random.RandomState(seed if seed else None)
+    rng_ = np.random.RandomState(seed if seed else None)  # lint: allow(np-random-in-traced-code) — documented eager host op
     L = len(neg_samples_num_list)
     per = [n + (1 if output_positive else 0) for n in neg_samples_num_list]
     width = sum(per)

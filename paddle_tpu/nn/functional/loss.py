@@ -523,7 +523,7 @@ def nce(input, label, weight, bias=None, num_total_classes=None,
             "host-side at trace time and BAKED into the compiled program — "
             "every step reuses the same negatives. Build the loss eagerly "
             "(or re-trace per epoch) to resample.", stacklevel=2)
-    rng_ = np.random.RandomState(seed)
+    rng_ = np.random.RandomState(seed)  # lint: allow(np-random-in-traced-code) — warns under trace above
     if sampler == "uniform":
         neg = rng_.randint(0, R, size=(B, num_neg_samples))
         probs = np.full(R, 1.0 / R)

@@ -226,6 +226,49 @@ class Program:
             c._loss_id = None
         return c
 
+    def analysis_jaxpr(self, feed=None, fetch_list=None):
+        """Trace the recorded program — exactly as Executor.run would
+        replay it — to a jax ClosedJaxpr for paddle_tpu.analysis.
+
+        This is the Program-level hook for the pass registry (the
+        reference's REGISTER_PASS layer inspects the Program graph; here
+        the passes inspect the jaxpr of its jitted replay). The pure
+        replay fn is the SAME one Executor._compile jits, so findings
+        refer to the graph that actually runs. Nothing is compiled or
+        executed — tracing only.
+
+            prog.analysis_jaxpr(feed={"x": np.zeros((4, 8), "float32")})
+
+        fetch_list defaults to the outputs of the last recorded op (or
+        the attached loss when an optimizer is set). A program with an
+        optimizer attached traces the TRAIN step (forward + grads +
+        optimizer update), matching what Executor.run executes for it.
+        """
+        feed = {k: jnp.asarray(np.asarray(v))
+                for k, v in (feed or {}).items()}
+        self._ensure_scope()
+        exe = Executor()
+        if fetch_list:
+            fetch_ids = tuple(exe._fetch_id(self, f) for f in fetch_list)
+        elif self._loss_id is not None:
+            fetch_ids = (self._loss_id,)
+        elif self.ops:
+            fetch_ids = tuple(self.ops[-1].out_ids)
+        else:
+            raise ValueError("analysis_jaxpr: empty program (no recorded "
+                             "ops) and no fetch_list")
+        train = self._optimizer is not None and self._loss_id is not None
+        fn = _build_program_fn(self, tuple(feed), fetch_ids, train=train)
+        params = self._scope["params"]
+        if not train:
+            return jax.make_jaxpr(fn)(params, feed)
+        opt = self._optimizer
+        opt_state = (self._scope["opt_state"]
+                     if self._scope["opt_state"] is not None
+                     else opt.functional_init(params))
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        return jax.make_jaxpr(fn)(params, opt_state, lr, feed)
+
 
 _default_main = [Program()]
 _default_startup = [Program()]
@@ -453,89 +496,98 @@ class Executor:
         return [Tensor(f) for f in fetches]
 
     def _compile(self, program, feed_names, fetch_ids, train):
-        targets = list(fetch_ids) + ([program._loss_id] if train else [])
-        ops = _slice_ops(program, targets)
+        return jax.jit(_build_program_fn(program, feed_names, fetch_ids,
+                                         train))
 
-        # validate feeds BEFORE jit: every needed placeholder must be fed
-        bound = set()
-        for name in feed_names:
-            if name not in program.placeholders:
-                raise ValueError(f"feed '{name}' is not a static.data "
-                                 "placeholder of this program")
-            bound.add(program.placeholders[name])
-        bound |= set(program.params)
-        def _missing(vid, what):
-            for n, pvid in program.placeholders.items():
-                if pvid == vid:
-                    raise ValueError(f"placeholder '{n}' is required by the "
-                                     f"{what} but missing from feed")
-            raise ValueError(f"{what} references a var with no producer "
-                             "(was it built in a different program?)")
 
+def _build_program_fn(program, feed_names, fetch_ids, train):
+    """Build the pure replay fn Executor jits: (params, feed) -> fetches
+    for eval, (params, opt_state, lr, feed) -> (params', state', fetches)
+    for train. Shared with Program.analysis_jaxpr so the analysis passes
+    see the exact graph the executor runs."""
+    targets = list(fetch_ids) + ([program._loss_id] if train else [])
+    ops = _slice_ops(program, targets)
+
+    # validate feeds BEFORE jit: every needed placeholder must be fed
+    bound = set()
+    for name in feed_names:
+        if name not in program.placeholders:
+            raise ValueError(f"feed '{name}' is not a static.data "
+                             "placeholder of this program")
+        bound.add(program.placeholders[name])
+    bound |= set(program.params)
+    def _missing(vid, what):
+        for n, pvid in program.placeholders.items():
+            if pvid == vid:
+                raise ValueError(f"placeholder '{n}' is required by the "
+                                 f"{what} but missing from feed")
+        raise ValueError(f"{what} references a var with no producer "
+                         "(was it built in a different program?)")
+
+    for op in ops:
+        for spec in op.arg_specs:
+            if spec[0] == "var" and spec[1] not in bound:
+                _missing(spec[1], "fetch_list")
+        bound |= set(op.out_ids)
+    for fid in targets:
+        if fid is not None and fid not in bound:
+            _missing(fid, "fetch_list")
+
+    ph = program.placeholders
+    params_map = dict(program.params)
+
+    def forward(param_arrays, feed_arrays):
+        env = {}
+        for name, arr in feed_arrays.items():
+            env[ph[name]] = arr
+        for vid, name in params_map.items():
+            env[vid] = param_arrays[name]
         for op in ops:
-            for spec in op.arg_specs:
-                if spec[0] == "var" and spec[1] not in bound:
-                    _missing(spec[1], "fetch_list")
-            bound |= set(op.out_ids)
-        for fid in targets:
-            if fid is not None and fid not in bound:
-                _missing(fid, "fetch_list")
+            vals = [env[s[1]] if s[0] == "var" else s[1]
+                    for s in op.arg_specs]
+            out = op.fn(*vals, **op.kwargs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for oid, o in zip(op.out_ids, outs):
+                env[oid] = o
+        return env
 
-        ph = program.placeholders
-        params_map = dict(program.params)
+    if not train:
+        def ev(param_arrays, feed_arrays):
+            env = forward(param_arrays, feed_arrays)
+            return [env[i] for i in fetch_ids]
 
-        def forward(param_arrays, feed_arrays):
-            env = {}
-            for name, arr in feed_arrays.items():
-                env[ph[name]] = arr
-            for vid, name in params_map.items():
-                env[vid] = param_arrays[name]
-            for op in ops:
-                vals = [env[s[1]] if s[0] == "var" else s[1]
-                        for s in op.arg_specs]
-                out = op.fn(*vals, **op.kwargs)
-                outs = out if isinstance(out, (tuple, list)) else (out,)
-                for oid, o in zip(op.out_ids, outs):
-                    env[oid] = o
-            return env
+        return ev
 
-        if not train:
-            def ev(param_arrays, feed_arrays):
-                env = forward(param_arrays, feed_arrays)
-                return [env[i] for i in fetch_ids]
+    opt = program._optimizer
+    loss_id = program._loss_id  # snapshot: closures must not pin program
+    # update ONLY params the sliced loss graph actually uses (a second
+    # model in the same program must not weight-decay toward zero), and
+    # honor minimize(parameters=/no_grad_set=)
+    used = set()
+    for op in ops:
+        for s in op.arg_specs:
+            if s[0] == "var" and s[1] in params_map:
+                used.add(params_map[s[1]])
+    train_names = (used if program._train_param_names is None
+                   else used & program._train_param_names)
 
-            return jax.jit(ev)
+    def step(param_arrays, opt_state, lr, feed_arrays):
+        sub = {n: param_arrays[n] for n in train_names}
 
-        opt = program._optimizer
-        loss_id = program._loss_id  # snapshot: closures must not pin program
-        # update ONLY params the sliced loss graph actually uses (a second
-        # model in the same program must not weight-decay toward zero), and
-        # honor minimize(parameters=/no_grad_set=)
-        used = set()
-        for op in ops:
-            for s in op.arg_specs:
-                if s[0] == "var" and s[1] in params_map:
-                    used.add(params_map[s[1]])
-        train_names = (used if program._train_param_names is None
-                       else used & program._train_param_names)
+        def loss_fn(sp):
+            env = forward({**param_arrays, **sp}, feed_arrays)
+            return env[loss_id].astype(jnp.float32), env
 
-        def step(param_arrays, opt_state, lr, feed_arrays):
-            sub = {n: param_arrays[n] for n in train_names}
+        (_, env), grads = jax.value_and_grad(loss_fn, has_aux=True)(sub)
+        sub_state = {n: opt_state[n] for n in train_names}
+        sub_state["__step__"] = opt_state["__step__"]
+        new_sub, new_sub_state = opt.functional_apply(sub, grads,
+                                                      sub_state, lr=lr)
+        new_p = {**param_arrays, **new_sub}
+        new_s = {**opt_state, **new_sub_state}
+        return new_p, new_s, [env[i] for i in fetch_ids]
 
-            def loss_fn(sp):
-                env = forward({**param_arrays, **sp}, feed_arrays)
-                return env[loss_id].astype(jnp.float32), env
-
-            (_, env), grads = jax.value_and_grad(loss_fn, has_aux=True)(sub)
-            sub_state = {n: opt_state[n] for n in train_names}
-            sub_state["__step__"] = opt_state["__step__"]
-            new_sub, new_sub_state = opt.functional_apply(sub, grads,
-                                                          sub_state, lr=lr)
-            new_p = {**param_arrays, **new_sub}
-            new_s = {**opt_state, **new_sub_state}
-            return new_p, new_s, [env[i] for i in fetch_ids]
-
-        return jax.jit(step)
+    return step
 
 
 # re-exports for API-surface parity

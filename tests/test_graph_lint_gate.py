@@ -1,0 +1,143 @@
+"""Tier-1 graph-lint gate: the analysis pass battery over the bundled
+models, the serving decode step, and the framework source — every run.
+
+Contract (ISSUE 1 acceptance + the reference's always-on REGISTER_PASS
+validation layer):
+
+ - >= 8 distinct passes registered;
+ - gpt/bert/ernie forward and the serving decode step: ZERO
+   error-severity findings, ever (errors are correctness hazards — a new
+   one fails this gate loudly, like a new all-gather fails the perf gate);
+ - warning counts per target pinned to tests/lint_baseline.json — a NEW
+   warning fails until acknowledged by re-recording;
+ - tools/op_coverage.py --json shares the graph_lint report schema and
+   carries zero audit errors;
+ - the CLI itself (`python tools/graph_lint.py --model gpt --json`) runs
+   on the CPU mesh and reports through the shared schema.
+
+Budget: in-process analysis is trace-only (no compilation), ~6 s; the one
+subprocess CLI check pays a fresh interpreter+jax import. Not slow-marked.
+
+Regenerate the baseline after an INTENTIONAL change:
+    python tests/test_graph_lint_gate.py --record
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "lint_baseline.json")
+
+GATED_TARGETS = ("gpt", "bert", "ernie", "serving", "source_lint")
+
+
+def _load_graph_lint():
+    spec = importlib.util.spec_from_file_location(
+        "graph_lint", os.path.join(REPO, "tools", "graph_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _full_report():
+    return _load_graph_lint().build_report(
+        models=("gpt", "bert", "ernie"), serving=True, source=True)
+
+
+@pytest.fixture(scope="module")
+def report():
+    return _full_report()
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    if not os.path.exists(BASELINE_PATH):
+        pytest.fail("tests/lint_baseline.json missing — run "
+                    "`python tests/test_graph_lint_gate.py --record`")
+    return json.load(open(BASELINE_PATH))
+
+
+def test_pass_battery_registered(report):
+    assert len(report["passes"]) >= 8, report["passes"]
+    assert len(report["rules"]) >= 3, report["rules"]
+
+
+def test_all_targets_present(report):
+    assert set(report["targets"]) == set(GATED_TARGETS)
+
+
+@pytest.mark.parametrize("target", GATED_TARGETS)
+def test_zero_error_findings(report, target):
+    rep = report["targets"][target]
+    errors = [f for f in rep["findings"] if f["severity"] == "error"]
+    assert errors == [], (
+        f"{target}: NEW error-severity analysis findings:\n" + "\n".join(
+            f"  [{f['pass']}] {f['message']} @ {f['where']}"
+            for f in errors))
+
+
+@pytest.mark.parametrize("target", GATED_TARGETS)
+def test_warning_baseline(report, baseline, target):
+    got = report["targets"][target]["counts"]["warning"]
+    want = baseline["targets"][target]["warning"]
+    assert got <= want, (
+        f"{target}: {got} warning(s) vs recorded baseline {want} — a new "
+        "analysis warning appeared; fix it or acknowledge via "
+        "`python tests/test_graph_lint_gate.py --record`")
+
+
+def test_op_coverage_shares_schema():
+    spec = importlib.util.spec_from_file_location(
+        "op_coverage", os.path.join(REPO, "tools", "op_coverage.py"))
+    opcov = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(opcov)
+
+    rep = opcov.json_report()
+    # one schema across both tools: the gate reads either identically
+    for r in (rep,):
+        assert set(r) >= {"tool", "passes", "targets", "totals"}
+        for t in r["targets"].values():
+            assert set(t) >= {"name", "counts", "findings"}
+            assert set(t["counts"]) == {"error", "warning", "info"}
+    assert rep["totals"]["error"] == 0, rep["targets"]["op_coverage"][
+        "findings"]
+
+
+def test_cli_model_gpt_json():
+    """The acceptance-criterion invocation, end to end on the CPU mesh."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graph_lint.py"),
+         "--model", "gpt", "--json"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rep = json.loads(out.stdout)
+    assert rep["tool"] == "graph_lint"
+    assert len(rep["passes"]) >= 8
+    assert rep["targets"]["gpt"]["counts"]["error"] == 0
+    assert rep["totals"]["error"] == 0
+
+
+def _record():
+    report = _full_report()
+    base = {"targets": {n: r["counts"]
+                        for n, r in report["targets"].items()}}
+    json.dump(base, open(BASELINE_PATH, "w"), indent=1)
+    print(f"recorded -> {BASELINE_PATH}")
+    print(json.dumps(base, indent=1))
+
+
+if __name__ == "__main__":
+    if "--record" in sys.argv:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        _record()
+    else:
+        print(__doc__)

@@ -18,7 +18,6 @@ Regenerate budgets after an INTENTIONAL change:
 """
 import json
 import os
-import re
 import sys
 
 import numpy as np
@@ -29,6 +28,11 @@ sys.path.insert(0, REPO)
 BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "perf_budgets.json")
 
+# the exact-HLO-count machinery moved into the analysis layer (the
+# collective-count pass and this gate share one counter; same recorded
+# format, so existing perf_budgets.json baselines stay valid)
+from paddle_tpu.analysis.collectives import count_hlo_collectives
+
 # FLOPs should be near-exact for fixed shapes; bytes-accessed wobbles more
 # across XLA versions (layout/fusion choices), so its band is wider. The
 # bands are tight enough that the failure the gate exists for — 2x bytes,
@@ -37,14 +41,7 @@ FLOPS_BAND = (0.75, 1.30)
 BYTES_BAND = (0.50, 1.45)
 
 
-def _count_collectives(hlo_text):
-    return {
-        "all-reduce": len(re.findall(r"all-reduce\(|all-reduce-start\(",
-                                     hlo_text)),
-        "all-gather": len(re.findall(r"all-gather\(|all-gather-start\(",
-                                     hlo_text)),
-        "reduce-scatter": len(re.findall(r"reduce-scatter\(", hlo_text)),
-    }
+_count_collectives = count_hlo_collectives
 
 
 def _cost(compiled):

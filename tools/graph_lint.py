@@ -1,0 +1,128 @@
+"""Graph lint CLI: run the analysis pass battery + source linter.
+
+    python tools/graph_lint.py --model gpt            # one model, human
+    python tools/graph_lint.py --model bert --json    # machine-readable
+    python tools/graph_lint.py --all --json           # models + serving
+                                                      # decode + source lint
+    python tools/graph_lint.py --source               # source lint only
+    python tools/graph_lint.py --list                 # registered passes
+
+Report format (shared with tools/op_coverage.py --json so the tier-1 gate
+reads both through one schema):
+
+    {"tool": ..., "passes": [...],
+     "targets": {name: {"name", "counts": {error,warning,info},
+                        "findings": [{"pass","severity","message","where"}]}},
+     "totals": {error, warning, info}}
+
+Exit code: 1 when any error-severity finding exists, else 0 — wired into
+tier-1 by tests/test_graph_lint_gate.py, which also pins the warning
+baseline (tests/lint_baseline.json).
+
+Reference analog: `--print_pass_history`-style pass introspection over the
+REGISTER_PASS registry (SURVEY §1 layer 3/4), as a standing CI gate.
+"""
+import argparse
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_report(models=(), serving=False, source=False, training=False):
+    """Run the requested targets; returns the shared-format report dict."""
+    from paddle_tpu.analysis import registered_passes
+    from paddle_tpu.analysis.registry import AnalysisReport
+    from paddle_tpu.analysis.source_lint import RULES, lint_path
+    from paddle_tpu.analysis.targets import (analyze_model,
+                                             analyze_serving_decode)
+
+    targets = {}
+    for name in models:
+        targets[name] = analyze_model(name, training=training)
+    if serving:
+        targets["serving"] = analyze_serving_decode()
+    if source:
+        rep = AnalysisReport(name="source_lint")
+        rep.extend(lint_path())
+        targets["source_lint"] = rep.sort()
+
+    totals = {"error": 0, "warning": 0, "info": 0}
+    for rep in targets.values():
+        for sev, n in rep.counts().items():
+            totals[sev] = totals.get(sev, 0) + n
+    return {
+        "tool": "graph_lint",
+        "passes": registered_passes(),
+        "rules": sorted(RULES),
+        "targets": {n: r.to_dict() for n, r in targets.items()},
+        "totals": totals,
+    }
+
+
+def main(argv=None):
+    from paddle_tpu.analysis.targets import MODEL_TARGETS
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", choices=MODEL_TARGETS, action="append",
+                    default=[], help="analyze one bundled model's forward")
+    ap.add_argument("--all", action="store_true",
+                    help="all models + serving decode + source lint")
+    ap.add_argument("--serving", action="store_true",
+                    help="analyze the serving engine decode step")
+    ap.add_argument("--source", action="store_true",
+                    help="run the AST source linter over paddle_tpu/")
+    ap.add_argument("--train", action="store_true",
+                    help="trace models in training mode (dropout on)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the machine-readable report")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered passes and lint rules")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        from paddle_tpu.analysis import registered_passes
+        from paddle_tpu.analysis.source_lint import RULES
+
+        print("jaxpr passes:")
+        for p in registered_passes():
+            print(f"  {p}")
+        print("source-lint rules:")
+        for r, sev in sorted(RULES.items()):
+            print(f"  {r} [{sev}]")
+        return 0
+
+    models = list(args.model)
+    serving, source = args.serving, args.source
+    if args.all:
+        models = list(MODEL_TARGETS)
+        serving = source = True
+    if not models and not serving and not source:
+        ap.error("pick a target: --model NAME, --serving, --source or --all")
+
+    report = build_report(models=models, serving=serving, source=source,
+                          training=args.train)
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        for name, rep in report["targets"].items():
+            c = rep["counts"]
+            print(f"{name}: {c['error']} error(s), {c['warning']} "
+                  f"warning(s), {c['info']} info")
+            for f in rep["findings"]:
+                loc = f" @ {f['where']}" if f["where"] else ""
+                print(f"  [{f['severity']}] {f['pass']}: "
+                      f"{f['message']}{loc}")
+        t = report["totals"]
+        print(f"total: {t['error']} error(s), {t['warning']} warning(s), "
+              f"{t['info']} info across {len(report['targets'])} target(s); "
+              f"{len(report['passes'])} passes registered")
+    return 1 if report["totals"]["error"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,7 +10,11 @@ conscious, documented non-goal). The audit test
 (tests/test_op_coverage_audit.py) pins: zero unclassified ops, zero stale
 entries, every implemented-as target resolvable.
 
-Usage: python tools/op_coverage.py [-v]
+Usage: python tools/op_coverage.py [-v] [--json]
+
+--json emits the machine-readable report in the same schema as
+tools/graph_lint.py --json (tool/targets/counts/findings/totals), so the
+lint gate and the coverage audit share one report format.
 """
 import jax; jax.config.update("jax_platforms", "cpu")
 import glob, os, re, sys
@@ -347,7 +351,58 @@ core_missing = undispositioned + bad_targets
 # the three specifically-asserted kernels appear there by name
 FUSED_XLA = {"conv2d_fusion", "conv2d_inception_fusion", "multi_gru"}
 
+def json_report():
+    """Shared graph_lint report schema: every audit failure (unclassified
+    op, stale entry, unresolvable target) is an error-severity finding."""
+    kinds = {}
+    for n in missing:
+        k = DISPOSITION.get(n, ("UNCLASSIFIED", "", ""))[0]
+        kinds[k] = kinds.get(k, 0) + 1
+    findings = []
+    # without the reference checkout (names empty) the unclassified/stale
+    # checks are vacuous — every DISPOSITION entry would read as "stale".
+    # Only the target-resolution audit stays meaningful: it validates
+    # against the LIVE package, no reference tree needed.
+    if names:
+        for n in undispositioned:
+            findings.append({"pass": "op-unclassified", "severity": "error",
+                             "message": f"reference op '{n}' has no API "
+                                        "match and no DISPOSITION entry",
+                             "where": n})
+        for n in stale:
+            findings.append({"pass": "op-stale-disposition",
+                             "severity": "error",
+                             "message": f"DISPOSITION entry '{n}' no longer "
+                                        "matches a missing reference op",
+                             "where": n})
+    for n in bad_targets:
+        findings.append({"pass": "op-unresolvable-target",
+                         "severity": "error",
+                         "message": f"implemented-as target for '{n}' does "
+                                    f"not resolve: {DISPOSITION[n][1]}",
+                         "where": n})
+    counts = {"error": len(findings), "warning": 0, "info": 0}
+    return {
+        "tool": "op_coverage",
+        "passes": ["op-unclassified", "op-stale-disposition",
+                   "op-unresolvable-target"],
+        "targets": {"op_coverage": {"name": "op_coverage",
+                                    "counts": counts,
+                                    "findings": findings}},
+        "totals": dict(counts),
+        "meta": {"reference_ops": len(names), "unmatched": len(missing),
+                 "reference_available": bool(names),
+                 "dispositions": dict(sorted(kinds.items()))},
+    }
+
+
 if __name__ == "__main__":
+    if "--json" in sys.argv:
+        import json as _json
+
+        rep = json_report()
+        print(_json.dumps(rep, indent=1))
+        sys.exit(1 if rep["totals"]["error"] else 0)
     kinds = {}
     for n in missing:
         k = DISPOSITION.get(n, ("UNCLASSIFIED", "", ""))[0]
