@@ -48,12 +48,41 @@ def _emit(line):
     """Print a metric line immediately (flushed) and remember it as the
     best-so-far result for the watchdog to fall back on. Deep-copied so
     later in-place mutation of nested dicts (the incremental "extra"
-    block) can't change what the async watchdog would re-emit."""
+    block) can't change what the async watchdog would re-emit.
+
+    Every line carries the runtime-telemetry snapshot ("monitor": compile
+    counts, step/TTFT latencies, host syncs...) so the recorded number is
+    attributable: a wedged round's last line shows exactly how far the
+    instrumented stack got."""
     import copy
 
     global _LAST_GOOD
+    try:
+        from paddle_tpu import monitor
+
+        line = dict(line, monitor=monitor.flatten(monitor.snapshot()))
+    except Exception:
+        pass  # the metric line must never die on telemetry
     _LAST_GOOD = copy.deepcopy(line)
     print(json.dumps(line), flush=True)
+
+
+def _heartbeat(phase, status="start", **fields):
+    """Phase heartbeat into the monitor JSONL event log
+    (FLAGS_monitor_log_path; defaults to /tmp/paddle_tpu_bench_events.jsonl
+    for bench runs): when a later compile wedges past the watchdog, the
+    log's last heartbeat names the wedged phase instead of an opaque
+    'no measurement within 900s'."""
+    try:
+        from paddle_tpu import flags, monitor
+
+        if not flags.get_flag("monitor_log_path", ""):
+            flags.set_flags(
+                {"monitor_log_path": "/tmp/paddle_tpu_bench_events.jsonl"})
+        monitor.log_event("bench_phase", phase=phase, status=status,
+                          **fields)
+    except Exception:
+        pass
 
 
 def _n_params(cfg):
@@ -705,6 +734,7 @@ def main():
     import jax
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    _heartbeat("device_init", "done", on_tpu=on_tpu)
     if on_tpu:
         enable_tpu_compile_cache()
     if not on_tpu:
@@ -723,7 +753,9 @@ def main():
         # so a wedge later in the run can never reduce this process to a
         # watchdog error (the watchdog re-emits the last complete line).
         try:
+            _heartbeat("micro_canary")
             sps, _ = run_micro(quiet=True)
+            _heartbeat("micro_canary", "done")
             # vs_baseline 0.0: a toy config has no baseline target and its
             # raw tokens/s against the headline's 10k would misread as a
             # baseline-beating result
@@ -732,6 +764,7 @@ def main():
                    "vs_baseline": 0.0, "config": "micro",
                    "note": "wedge-canary (2-layer GPT); headline follows"})
         except Exception as e:
+            _heartbeat("micro_canary", "failed", error=str(e))
             print(f"  micro canary failed ({e})", file=sys.stderr)
         finally:
             # fresh window either way: a slow canary FAILURE must not eat
@@ -741,6 +774,7 @@ def main():
                 watchdog = _arm_watchdog(1200)
 
     if args.config != "gpt2s":
+        _heartbeat("config:" + args.config)
         extra = None
         line_fields = {}  # extra TOP-LEVEL fields for the final line (mbu)
         if args.config == "resnet50":
@@ -940,6 +974,7 @@ def main():
             watchdog.cancel()
             watchdog = _arm_watchdog(1500)
         probes = {}
+        _heartbeat("batch_probe")
         # 32 exceeded 16G HBM in r1 PRE-flash; the flash retune freed the
         # attention HBM, so it may fit now — OOM fails fast and is caught
         for b in (16, 24, 32):
@@ -954,6 +989,7 @@ def main():
             watchdog = _arm_watchdog(900)
 
     if args.sweep:
+        _heartbeat("sweep")
         best = (0.0, 0.0, None)
         for b, s in ((8, 1024), (16, 1024), (24, 1024), (16, 2048),
                      (8, 2048), (4, 4096), (8, 4096)):
@@ -982,8 +1018,10 @@ def main():
         })
         return
 
+    _heartbeat("headline_gpt2s", batch=batch, seq=seq)
     tps, mfu = run_config(batch, seq, args.steps, quiet=True,
                           window=args.window)
+    _heartbeat("headline_gpt2s", "done")
     line = {
         "metric": "gpt2s_train_tokens_per_sec_per_chip"
                   + (f"_w{args.window}" if args.window else ""),
@@ -1005,6 +1043,7 @@ def main():
             watchdog.cancel()
             watchdog = _arm_watchdog(1200)
         try:
+            _heartbeat("extra:resnet50")
             ips = run_resnet50(64, 10, quiet=True)
             extra["resnet50_train_imgs_per_sec_per_chip"] = round(ips, 1)
             line["extra"] = extra
@@ -1015,6 +1054,7 @@ def main():
             watchdog.cancel()
             watchdog = _arm_watchdog(1200)
         try:
+            _heartbeat("extra:gpt2s_decode")
             dtps, dmbu = run_decode(8, 20, quiet=True)
             extra["gpt2s_decode_new_tokens_per_sec_per_chip"] = round(dtps, 1)
             extra["gpt2s_decode_mbu"] = round(dmbu, 4)

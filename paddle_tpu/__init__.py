@@ -80,6 +80,7 @@ from . import onnx  # noqa: E402
 from . import analysis  # noqa: E402
 from . import quantization  # noqa: E402
 from . import profiler as profiler  # noqa: E402
+from . import monitor  # noqa: E402
 from . import utils  # noqa: E402
 from . import regularizer  # noqa: E402
 from . import compat  # noqa: E402

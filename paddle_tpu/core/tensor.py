@@ -19,6 +19,20 @@ from .tape import Node, global_tape
 
 _SCALAR_TYPES = (int, float, bool, np.number, np.bool_)
 
+_HOST_SYNC_STAT = [None]  # lazy: core must import before the monitor package
+
+
+def _host_sync_counter():
+    c = _HOST_SYNC_STAT[0]
+    if c is None:
+        from ..monitor import counter
+
+        c = _HOST_SYNC_STAT[0] = counter(
+            "host_sync_total",
+            "device->host pulls through Tensor._to_host "
+            "(.numpy()/.item()/.tolist()/bool()/int()/float())")
+    return c
+
 
 def _is_tensor(x):
     return isinstance(x, Tensor)
@@ -121,6 +135,7 @@ class Tensor:
         unaffected in every mode.
         """
         data = self._data
+        _host_sync_counter().inc()
         if _is_tracer(data):
             from .. import flags as _flags
 

@@ -20,10 +20,25 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import monitor as _monitor
 from ..core.tensor import Tensor
 from . import env as _env
 
 _SPMD_AXIS = []  # stack of axis names active under spmd_context
+
+
+def _stat(kind, x):
+    """Count one collective API call (+payload bytes) under the HLO-family
+    names analysis/collectives.py uses, so the monitor's runtime counters
+    and the static collective-count pass read through one vocabulary.
+    List/tuple payloads sum over their elements, so the byte count for one
+    logical collective is the same whichever argument form the caller
+    used."""
+    if isinstance(x, (list, tuple)):
+        nbytes = sum(_monitor.tensor_nbytes(v) for v in x)
+    else:
+        nbytes = _monitor.tensor_nbytes(x)
+    _monitor.record_collective(kind, nbytes)
 
 
 class ReduceOp:
@@ -93,6 +108,7 @@ def _unary_collective(x, spmd_fn, eager_multi_fn=None):
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     ax = _axis(group)
+    _stat("all-reduce", tensor)
 
     def spmd(v):
         if op in (ReduceOp.SUM, "sum"):
@@ -137,6 +153,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     ax = _axis(group)
+    _stat("all-gather", tensor)
     if in_spmd_context():
         from ..core.dispatch import apply
 
@@ -161,6 +178,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
 
 def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
     ax = _axis(group)
+    _stat("reduce-scatter", tensor_or_tensor_list)
     from ..core.dispatch import apply
 
     src = tensor_or_tensor_list
@@ -183,6 +201,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, s
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
     ax = _axis(group)
+    _stat("all-gather", tensor)  # the SPMD broadcast lowers via all_gather
     if in_spmd_context():
         from ..core.dispatch import apply
 
@@ -234,6 +253,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     ax = _axis(group)
+    _stat("all-to-all", in_tensor_list)
     from ..core.dispatch import apply
     from ..tensor.manipulation import stack
 
@@ -252,6 +272,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
 def send(tensor, dst=0, group=None, sync_op=True):
     """send_v2 parity. In SPMD, point-to-point is ppermute (used by pipeline)."""
     ax = _axis(group)
+    _stat("collective-permute", tensor)
     if in_spmd_context():
         from ..core.dispatch import apply
 
@@ -262,6 +283,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 def recv(tensor, src=0, group=None, sync_op=True):
     ax = _axis(group)
+    _stat("collective-permute", tensor)
     if in_spmd_context():
         from ..core.dispatch import apply
 
@@ -274,6 +296,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
 
 def p2p_shift(x, axis_name, shift=1):
     """Ring shift (ppermute) — the building block of ring attention and 1F1B."""
+    _stat("collective-permute", x)
     idx_pairs = None
 
     def fn(v):

@@ -15,15 +15,46 @@ TPU-native design: ONE jitted train step over a Mesh.
  - gradient merge / accumulation: lax.scan over micro-batches.
 """
 import functools
+import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import flags as _flags
+from .. import monitor as _monitor
 from ..core.tape import global_tape
 from ..core.tensor import Tensor
+from ..profiler import RecordEvent as _RecordEvent
 from .mesh import get_mesh
+
+# the static.Executor metric families under site="trainer": one snapshot
+# schema covers both train paths (names/labels must match static's)
+_COMPILES = _monitor.counter(
+    "compile_total", "jit compiles of the recorded-program replay",
+    labelnames=("site",))
+_COMPILE_CACHE = _monitor.counter(
+    "compile_cache_total",
+    "jit-cache lookups by feed-signature (event: hit|miss)",
+    labelnames=("site", "event", "sig"))
+_COMPILE_MS = _monitor.histogram(
+    "compile_ms", "wall time of one jit compile (trace+lower handoff)",
+    labelnames=("site",))
+_STEP_MS = _monitor.histogram(
+    "step_latency_ms",
+    "Executor.run / train_step wall time (host dispatch; device-complete "
+    "when FLAGS_benchmark=1 forces a sync)", labelnames=("site",))
+_BENCH_SYNC = _monitor.counter(
+    "benchmark_sync_total",
+    "FLAGS_benchmark block_until_ready syncs on fetches",
+    labelnames=("site",))
+
+
+def _batch_sig_label(batch_arrays):
+    return "|".join(
+        f"{a.dtype}[{','.join(str(d) for d in a.shape)}]"
+        for a in batch_arrays) or "-"
 
 
 def _pvary(x, ax):
@@ -581,9 +612,19 @@ class SpmdTrainer:
     def train_step(self, *batch):
         from ..core.generator import default_generator
 
+        t_step = time.perf_counter()
         batch_arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(np.asarray(b)) for b in batch]
         if self._compiled is None:
-            self._compiled = self._build(batch_arrays)
+            if _monitor.is_enabled():
+                _COMPILE_CACHE.labels(site="trainer", event="miss",
+                                      sig=_batch_sig_label(batch_arrays)).inc()
+            with _RecordEvent("trainer/compile"), \
+                    _monitor.timed(_COMPILE_MS.labels(site="trainer")):
+                self._compiled = self._build(batch_arrays)
+            _COMPILES.labels(site="trainer").inc()
+        elif _monitor.is_enabled():
+            _COMPILE_CACHE.labels(site="trainer", event="hit",
+                                  sig=_batch_sig_label(batch_arrays)).inc()
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
         # fresh per-step randomness (dropout etc.): deterministic under
         # paddle.seed, varies per step — a trace-time key would bake ONE
@@ -594,7 +635,7 @@ class SpmdTrainer:
                 self.params, self.opt_state, self.buffers, lr, rng, *batch_arrays
             )
             self.optimizer._step_count += 1
-            return Tensor(loss)
+            return self._finish_step(loss, t_step)
         if self.return_outputs:  # ctor rejects localsgd/dgc combinations
             loss, self.params, self.opt_state, self.buffers, outs = self._compiled(
                 self.params, self.opt_state, self.buffers, lr, rng, *batch_arrays
@@ -607,6 +648,18 @@ class SpmdTrainer:
         self.optimizer._step_count += 1
         if isinstance(self.optimizer._lr, object) and hasattr(self.optimizer._lr, "step"):
             pass  # LR schedulers advance via user calls (paddle semantics)
+        return self._finish_step(loss, t_step)
+
+    def _finish_step(self, loss, t_step):
+        """Monitor tail of train_step: optional FLAGS_benchmark device sync
+        (so step_latency_ms measures device work) + the latency sample."""
+        if _flags.get_flag("benchmark"):
+            if hasattr(loss, "block_until_ready"):
+                loss.block_until_ready()
+            _BENCH_SYNC.labels(site="trainer").inc()
+        if _monitor.is_enabled():
+            _STEP_MS.labels(site="trainer").observe(
+                (time.perf_counter() - t_step) * 1e3)
         return Tensor(loss)
 
     def sync_to_layer(self):

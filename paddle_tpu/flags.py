@@ -55,7 +55,10 @@ def get_flag(name, default=None):
 # core flags (platform/flags.cc parity where meaningful on TPU)
 define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf (flags.cc:44)")
 define_flag("sort_sum_gradient", False, "deterministic grad accumulation order (flags.cc:527)")
-define_flag("benchmark", False, "sync after each op for timing")
+define_flag("benchmark", False,
+            "Executor.run blocks until fetches are device-complete so the "
+            "monitor's step_latency_ms measures device work, not dispatch; "
+            "each sync is counted as benchmark_sync_total")
 define_flag("seed", 0, "global random seed")
 define_flag("use_bfloat16", True, "prefer bfloat16 matmuls on MXU")
 define_flag("trace_host_sync", "silent",

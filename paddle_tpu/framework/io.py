@@ -6,10 +6,33 @@ load; fluid/dygraph/checkpoint.py:56 save_dygraph. Tensors are stored as numpy a
 """
 import os
 import pickle
+import time
 
 import numpy as np
 
+from .. import monitor as _monitor
 from ..core.tensor import Tensor
+from ..profiler import RecordEvent as _RecordEvent
+
+_CKPT = _monitor.counter("checkpoint_total", "paddle.save/load calls",
+                         labelnames=("op",))
+_CKPT_MS = _monitor.histogram("checkpoint_ms", "save/load wall time",
+                              labelnames=("op",))
+_CKPT_BYTES = _monitor.counter("checkpoint_bytes_total",
+                               "bytes written/read by paddle.save/load",
+                               labelnames=("op",))
+
+
+def _record_ckpt(op, path, t0):
+    if not _monitor.is_enabled():
+        return
+    _CKPT.labels(op=op).inc()
+    _CKPT_MS.labels(op=op).observe((time.perf_counter() - t0) * 1e3)
+    try:
+        _CKPT_BYTES.labels(op=op).inc(os.path.getsize(path))
+    except OSError:
+        pass
+    _monitor.log_event("checkpoint", op=op, path=path)
 
 
 def _pack(obj):
@@ -43,31 +66,37 @@ def save(obj, path, protocol=4, **configs):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    key = configs.get("encryption_key")
-    if key is not None:
-        from .crypto import AESCipher
+    t0 = time.perf_counter()
+    with _RecordEvent("checkpoint/save"):
+        key = configs.get("encryption_key")
+        if key is not None:
+            from .crypto import AESCipher
 
-        payload = AESCipher(key).encrypt(pickle.dumps(_pack(obj),
-                                                      protocol=protocol))
-        with open(path, "wb") as f:
-            f.write(payload)
-    else:  # streaming path: no full-payload copy in memory
-        with open(path, "wb") as f:
-            pickle.dump(_pack(obj), f, protocol=protocol)
+            payload = AESCipher(key).encrypt(pickle.dumps(_pack(obj),
+                                                          protocol=protocol))
+            with open(path, "wb") as f:
+                f.write(payload)
+        else:  # streaming path: no full-payload copy in memory
+            with open(path, "wb") as f:
+                pickle.dump(_pack(obj), f, protocol=protocol)
+    _record_ckpt("save", path, t0)
 
 
 def load(path, **configs):
     from .crypto import _MAGIC
 
     key = configs.get("encryption_key")
-    with open(path, "rb") as f:
+    t0 = time.perf_counter()
+    with _RecordEvent("checkpoint/load"), open(path, "rb") as f:
         if f.read(4) == _MAGIC:
             if key is None:
                 raise ValueError(f"{path} is encrypted; pass encryption_key=")
             from .crypto import AESCipher
 
             f.seek(0)
-            return _unpack(pickle.loads(AESCipher(key).decrypt(f.read())))
+            out = _unpack(pickle.loads(AESCipher(key).decrypt(f.read())))
+            _record_ckpt("load", path, t0)
+            return out
         if key is not None:
             # caller expected an authenticated payload — a plain-pickle file
             # here means tampering or a save/load mismatch, not a soft fallback
@@ -75,7 +104,9 @@ def load(path, **configs):
                 f"encryption_key given but {path} is not encrypted "
                 "(magic header missing); refusing to load unauthenticated data")
         f.seek(0)
-        return _unpack(pickle.load(f))
+        out = _unpack(pickle.load(f))
+    _record_ckpt("load", path, t0)
+    return out
 
 
 def save_dygraph(state_dict, model_path):

@@ -49,15 +49,78 @@ near-capacity slots fall back to exact single-token steps. Composes with
 chunked prefill, shared prefixes, bf16/int8 caches, and tp_mesh (the
 draft stays replicated; the target verify shares the head-sharded cache).
 """
+import time
+
 import numpy as np
 
+from .. import monitor as _monitor
 from ..core.tensor import Tensor
 
 __all__ = ["ServingEngine", "Request"]
 
+# engine metrics in the default registry (every engine in the process
+# shares them; per-engine views live on ServingEngine.stats())
+_REQ_SUBMITTED = _monitor.counter(
+    "serving_requests_submitted_total", "requests accepted by submit()")
+_REQ_FINISHED = _monitor.counter(
+    "serving_requests_finished_total",
+    "finished requests by reason (eos|length|capacity)",
+    labelnames=("reason",))
+_TOKENS = _monitor.counter(
+    "serving_tokens_total", "generated tokens across all requests")
+_QUEUE_WAIT_MS = _monitor.histogram(
+    "serving_queue_wait_ms", "submit() -> admission start wait")
+_TTFT_MS = _monitor.histogram(
+    "serving_ttft_ms", "submit() -> first generated token")
+_ITL_MS = _monitor.histogram(
+    "serving_inter_token_ms",
+    "gap between consecutive generated tokens of one request (a "
+    "speculative round lands its accepted run at once: near-zero gaps)")
+_STEPS = _monitor.counter(
+    "serving_steps_total",
+    "engine step slices by kind "
+    "(decode_greedy|decode_sample|prefill_chunk|speculative)",
+    labelnames=("kind",))
+_OCCUPANCY = _monitor.gauge(
+    "serving_batch_occupancy", "active decode slots at the last step()")
+_PREFIX = _monitor.counter(
+    "serving_prefix_cache_total",
+    "prefix-reuse admissions: hit = suffix-only prefill from cached KV, "
+    "miss = a prefix_id request that fell back to whole-prompt prefill",
+    labelnames=("event",))
+_SPEC = _monitor.counter(
+    "serving_spec_tokens_total",
+    "speculative decoding draft tokens (proposed vs accepted)",
+    labelnames=("event",))
+
+
+class _MsSummary:
+    """O(1) per-request/per-engine latency accumulator for stats()."""
+
+    __slots__ = ("count", "sum", "min", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def add(self, v):
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+
+    def to_dict(self):
+        return {"count": self.count, "sum_ms": self.sum,
+                "avg_ms": self.sum / self.count if self.count else 0.0,
+                "min_ms": self.min or 0.0, "max_ms": self.max or 0.0}
+
 
 class Request:
-    """One submitted prompt and, when finished, its generated tokens."""
+    """One submitted prompt and, when finished, its generated tokens.
+    Lifecycle timestamps (perf_counter seconds) are stamped by the engine;
+    ``stats()`` is the per-request observability view."""
 
     def __init__(self, rid, prompt_ids, max_new_tokens, temperature=0.0,
                  top_k=None, top_p=None, seed=None, prefix_id=None,
@@ -74,10 +137,52 @@ class Request:
         self.output_ids = []          # generated tokens (no prompt echo)
         self.finished = False
         self.finish_reason = None     # "eos" | "length" | "capacity"
+        self.submit_time = None       # stamped by ServingEngine.submit
+        self.admit_time = None        # admission start (queue wait ends)
+        self.first_token_time = None
+        self.last_token_time = None
+        self.finish_time = None
+        self._inter_token = _MsSummary()
 
     @property
     def tokens(self):
         return np.asarray(self.output_ids, np.int32)
+
+    def _note_token(self, now):
+        """Record one emitted token; returns the inter-token gap in ms
+        (None for the first token)."""
+        gap = None
+        if self.first_token_time is None:
+            self.first_token_time = now
+        else:
+            gap = (now - self.last_token_time) * 1e3
+            self._inter_token.add(gap)
+        self.last_token_time = now
+        return gap
+
+    def stats(self):
+        """Per-request latency/throughput stats (ms), live at any point of
+        the lifecycle — the latency-tracker surface get_request promises."""
+        out = {"rid": self.rid, "finished": self.finished,
+               "finish_reason": self.finish_reason,
+               "prompt_tokens": int(len(self.prompt_ids)),
+               "prefix_tokens": self.prefix_len,
+               "new_tokens": len(self.output_ids)}
+        if self.submit_time is not None and self.admit_time is not None:
+            out["queue_wait_ms"] = (self.admit_time - self.submit_time) * 1e3
+        if self.submit_time is not None \
+                and self.first_token_time is not None:
+            out["ttft_ms"] = (self.first_token_time
+                              - self.submit_time) * 1e3
+        out["inter_token"] = self._inter_token.to_dict()
+        if self.first_token_time is not None \
+                and self.last_token_time is not None \
+                and len(self.output_ids) > 1:
+            dt = self.last_token_time - self.first_token_time
+            if dt > 0:
+                out["decode_tokens_per_sec"] = \
+                    (len(self.output_ids) - 1) / dt
+        return out
 
 
 class ServingEngine:
@@ -417,6 +522,15 @@ class ServingEngine:
                     in_specs=(tp_specs, cs, cs, P(), P(), P()),
                     donate=(1, 2))
 
+        # engine-local observability accumulators (the module-level monitor
+        # metrics aggregate across engines; stats() reports THIS engine)
+        self._m = {"submitted": 0, "finished": {}, "tokens": 0,
+                   "steps": {}, "spec_proposed": 0, "spec_accepted": 0,
+                   "prefix_hit": 0, "prefix_miss": 0,
+                   "occupancy_sum": 0, "occupancy_steps": 0,
+                   "queue_wait_ms": _MsSummary(), "ttft_ms": _MsSummary(),
+                   "inter_token_ms": _MsSummary()}
+
         # host-side slot state
         self._slot_req = [None] * self.B        # Request or None
         self._pos = np.zeros(self.B, np.int32)  # next write column
@@ -461,11 +575,58 @@ class ServingEngine:
         self._prefixes[pid] = (ids, kc1, vc1, kc1d, vc1d)
         return pid
 
+    def _count_step(self, kind):
+        self._m["steps"][kind] = self._m["steps"].get(kind, 0) + 1
+        _STEPS.labels(kind=kind).inc()
+
+    def stats(self):
+        """Engine-lifetime observability snapshot: request counts by
+        outcome, token totals, step split (prefill/decode/speculative),
+        batch-occupancy average, prefix-cache hit rate, speculative
+        accept rate, and queue-wait/TTFT/inter-token latency summaries.
+        Host-side accounting only — never touches the device. The same
+        families stream into paddle_tpu.monitor (serving_* metrics) for
+        the snapshot/Prometheus/JSONL exporters."""
+        m = self._m
+        occ = (m["occupancy_sum"] / m["occupancy_steps"]
+               if m["occupancy_steps"] else 0.0)
+        prefix_n = m["prefix_hit"] + m["prefix_miss"]
+        out = {
+            "slots": self.B,
+            "requests": {"submitted": m["submitted"],
+                         "queued": len(self._queue),
+                         "prefilling": len(self._prefilling),
+                         # decoding slots only: mid-prefill slots hold a
+                         # _slot_req reservation but belong to "prefilling"
+                         "running": sum(1 for s in range(self.B)
+                                        if self._slot_req[s] is not None
+                                        and s not in self._prefilling),
+                         "finished": dict(m["finished"])},
+            "tokens_generated": m["tokens"],
+            "steps": dict(m["steps"]),
+            "batch_occupancy_avg": occ,
+            "prefix_cache": {"hit": m["prefix_hit"],
+                             "miss": m["prefix_miss"],
+                             "hit_rate": (m["prefix_hit"] / prefix_n
+                                          if prefix_n else None)},
+            "speculative": {"proposed": m["spec_proposed"],
+                            "accepted": m["spec_accepted"],
+                            "accept_rate": (m["spec_accepted"]
+                                            / m["spec_proposed"]
+                                            if m["spec_proposed"]
+                                            else None)},
+            "queue_wait_ms": m["queue_wait_ms"].to_dict(),
+            "ttft_ms": m["ttft_ms"].to_dict(),
+            "inter_token_ms": m["inter_token_ms"].to_dict(),
+        }
+        return out
+
     def get_request(self, rid):
         """The live Request object for a submitted id — queued, in-flight,
-        or finished (observability: latency trackers read output_ids as
-        tokens stream without touching engine internals). Raises KeyError
-        for an unknown id."""
+        or finished. The per-request observability surface: read
+        output_ids as tokens stream, or req.stats() for queue-wait/TTFT/
+        inter-token latencies (engine-level aggregates: stats()). Raises
+        KeyError for an unknown id."""
         for req in self._queue:
             if req.rid == rid:
                 return req
@@ -531,11 +692,14 @@ class ServingEngine:
                 f"prompt ({len(ids)}) too long for max_seq_len {self.T}")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(Request(rid, ids, max_new_tokens,
-                                   temperature=temperature, top_k=top_k,
-                                   top_p=top_p, seed=seed,
-                                   prefix_id=prefix_id,
-                                   prefix_len=prefix_len))
+        req = Request(rid, ids, max_new_tokens,
+                      temperature=temperature, top_k=top_k,
+                      top_p=top_p, seed=seed, prefix_id=prefix_id,
+                      prefix_len=prefix_len)
+        req.submit_time = time.perf_counter()
+        self._queue.append(req)
+        self._m["submitted"] += 1
+        _REQ_SUBMITTED.inc()
         return rid
 
     def _bucket(self, n):
@@ -548,6 +712,9 @@ class ServingEngine:
         req = self._slot_req[slot]
         req.finished = True
         req.finish_reason = reason
+        req.finish_time = time.perf_counter()
+        self._m["finished"][reason] = self._m["finished"].get(reason, 0) + 1
+        _REQ_FINISHED.labels(reason=reason).inc()
         self._finished[req.rid] = req
         self._slot_req[slot] = None
 
@@ -579,6 +746,16 @@ class ServingEngine:
         req.output_ids.append(tok)
         self._after_emit(slot, req)
 
+    def _note_admission(self, req):
+        """Queue wait ends when admission work starts (prefill or slot
+        reservation); prefix hit/miss is counted at the branch that
+        actually decides reuse (_admit_one)."""
+        req.admit_time = time.perf_counter()
+        wait_ms = (req.admit_time - req.submit_time) * 1e3 \
+            if req.submit_time is not None else 0.0
+        self._m["queue_wait_ms"].add(wait_ms)
+        _QUEUE_WAIT_MS.observe(wait_ms)
+
     def _admit_one(self, slot, req):
         import jax.numpy as jnp
 
@@ -588,6 +765,7 @@ class ServingEngine:
             # prefix unregistered while this request sat in the queue: the
             # combined prompt is already in prompt_ids — whole-prefill it
             prefix_len = 0
+        self._note_admission(req)
         if prefix_len:
             # suffix-only prefill from a COPY of the cached prefix KV
             # (the chunk program donates its cache args); chunk width =
@@ -595,6 +773,8 @@ class ServingEngine:
             C = self._chunk or min(64, self.T)
             end = prefix_len + -(-(n - prefix_len) // C) * C
             if end <= self.T:
+                self._m["prefix_hit"] += 1
+                _PREFIX.labels(event="hit").inc()
                 _, kc_p, vc_p, kc_pd, vc_pd = self._prefixes[req.prefix_id]
                 kc1 = self._copy_cache(kc_p)
                 vc1 = self._copy_cache(vc_p)
@@ -608,6 +788,9 @@ class ServingEngine:
                 return
             # else: fall through to whole-prompt prefill (recomputes the
             # prefix — slower but correct near the capacity edge)
+        if req.prefix_len:   # wanted prefix reuse, got a full recompute
+            self._m["prefix_miss"] += 1
+            _PREFIX.labels(event="miss").inc()
         n_chunks_end = 0 if self._chunk is None else \
             -(-n // self._chunk) * self._chunk
         if self._chunk is not None and n_chunks_end <= self.T:
@@ -643,6 +826,7 @@ class ServingEngine:
         import jax.numpy as jnp
 
         req, kc1, vc1, off, C, kc1d, vc1d = self._prefilling[slot]
+        self._count_step("prefill_chunk")
         n = len(req.prompt_ids)
         end = min(off + C, n)
         chunk = np.zeros((1, C), np.int32)
@@ -664,6 +848,18 @@ class ServingEngine:
             self._prefilling[slot] = [req, kc1, vc1, end, C, kc1d, vc1d]
 
     def _after_emit(self, slot, req):
+        now = time.perf_counter()
+        gap_ms = req._note_token(now)
+        self._m["tokens"] += 1
+        _TOKENS.inc()
+        if gap_ms is None:  # first generated token: TTFT
+            if req.submit_time is not None:
+                ttft = (now - req.submit_time) * 1e3
+                self._m["ttft_ms"].add(ttft)
+                _TTFT_MS.observe(ttft)
+        else:
+            self._m["inter_token_ms"].add(gap_ms)
+            _ITL_MS.observe(gap_ms)
         if self.eos is not None and req.output_ids[-1] == self.eos:
             self._finish(slot, "eos")
         elif len(req.output_ids) >= req.max_new_tokens:
@@ -693,6 +889,9 @@ class ServingEngine:
         active = [s for s in range(self.B)
                   if self._slot_req[s] is not None
                   and s not in self._prefilling]
+        self._m["occupancy_sum"] += len(active)
+        self._m["occupancy_steps"] += 1
+        _OCCUPANCY.set(len(active))
         if active:
             # speculative round: every active slot greedy AND spec_k+1
             # columns of headroom (near-capacity slots fall back to exact
@@ -717,12 +916,14 @@ class ServingEngine:
             # dispatch: an all-greedy batch keeps the lean argmax step
             # (no sort/categorical in its compiled program at all).
             if any(self._temps[s] > 0 for s in active):
+                self._count_step("decode_sample")
                 next_toks, self._kc, self._vc = self._step_sample(
                     self._params, self._kc, self._vc,
                     jnp.asarray(self._last), jnp.asarray(self._pos),
                     jnp.asarray(self._temps), jnp.asarray(self._topk),
                     jnp.asarray(self._topp), jnp.asarray(self._seeds))
             else:
+                self._count_step("decode_greedy")
                 next_toks, self._kc, self._vc = self._step_greedy(
                     self._params, self._kc, self._vc,
                     jnp.asarray(self._last), jnp.asarray(self._pos))
@@ -747,6 +948,7 @@ class ServingEngine:
         fully overwritten before they are read."""
         import jax.numpy as jnp
 
+        self._count_step("speculative")
         props, self._kc_d, self._vc_d = self._draft_propose(
             self._params_d, self._kc_d, self._vc_d,
             jnp.asarray(self._last), jnp.asarray(self._pos))
@@ -755,6 +957,12 @@ class ServingEngine:
             jnp.asarray(self._pos), props)
         emit = np.asarray(emit)
         m = np.asarray(m)
+        proposed = self._spec_k * len(active)
+        accepted = int(sum(int(m[s]) for s in active))
+        self._m["spec_proposed"] += proposed
+        self._m["spec_accepted"] += accepted
+        _SPEC.labels(event="proposed").inc(proposed)
+        _SPEC.labels(event="accepted").inc(accepted)
         for s in active:
             n_acc = int(m[s]) + 1
             toks = emit[s, :n_acc]

@@ -1,0 +1,176 @@
+"""Runtime telemetry: the framework's metrics registry and exporters.
+
+Reference parity: paddle/fluid/platform/monitor.h — StatRegistry +
+STAT_ADD/STAT_SUB macros, the always-on named-stat layer the reference
+sprinkles through its executors and collectives — paired here with
+paddle_tpu.profiler's RecordEvent trees (profiler.{h,cc} parity). The
+profiler answers "where did this step's time go"; this module answers
+"what has the process done and how fast, cumulatively" — counters,
+gauges, and latency histograms cheap enough to leave on in serving.
+
+Instrumented hot paths (each records into the DEFAULT registry):
+
+- ``static.Executor.run``/``_compile`` — compile count, jit-cache
+  hit/miss per feed-signature, step wall time, FLAGS_benchmark syncs;
+- ``distributed.spmd.SpmdTrainer.train_step`` — same compile-cache and
+  step-latency families under ``site="trainer"``;
+- ``Tensor._to_host()`` — every device->host sync (the PR-1 chokepoint);
+- ``inference.ServingEngine`` — request lifecycle: queue wait, TTFT,
+  inter-token latency, batch occupancy, prefill/decode/speculative step
+  split, prefix-cache hit rate, speculative accept rate (plus
+  per-request ``Request.stats()`` / engine ``ServingEngine.stats()``);
+- ``distributed.collective.*`` — call count + payload bytes by HLO
+  family (analysis/collectives.py naming);
+- ``framework.io.save/load`` — checkpoint count, wall time, bytes.
+
+Three exporters, one schema (docs/OBSERVABILITY.md):
+``snapshot()`` JSON dict -> ``to_json`` / ``to_prometheus`` text /
+``log_event``+``log_snapshot`` JSONL (``FLAGS_monitor_log_path``).
+
+``FLAGS_monitor=0`` (or ``disable()``) turns every recording call into a
+single boolean check — the tier-1 overhead gate in
+tests/test_perf_budgets.py holds that bar.
+"""
+import contextlib
+import time
+
+from .. import flags as _flags
+from .exporters import (flatten, log_event, log_snapshot, parse_prometheus,
+                        to_json, to_prometheus)
+from .registry import (DEFAULT_BUCKETS, LABEL_CARDINALITY_CAP,
+                       OVERFLOW_LABEL, Counter, Gauge, Histogram,
+                       StatRegistry)
+
+__all__ = [
+    "StatRegistry", "Counter", "Gauge", "Histogram", "DEFAULT_BUCKETS",
+    "LABEL_CARDINALITY_CAP", "OVERFLOW_LABEL",
+    "default_registry", "counter", "gauge", "histogram", "snapshot",
+    "reset", "enable", "disable", "is_enabled", "timed",
+    "to_json", "to_prometheus", "parse_prometheus", "flatten",
+    "log_event", "log_snapshot", "record_collective", "tensor_nbytes",
+    "STAT_ADD", "STAT_SUB", "STAT_RESET",
+]
+
+_flags.define_flag("monitor", True,
+                   "runtime telemetry registry on/off; off turns every "
+                   "instrumented call site into one boolean check")
+_flags.define_flag("monitor_log_path", "",
+                   "JSONL structured-event log path for "
+                   "monitor.log_event/log_snapshot (empty = disabled); "
+                   "bench.py phase heartbeats land here")
+
+_DEFAULT = StatRegistry(enabled=bool(_flags.get_flag("monitor", True)))
+
+
+def default_registry():
+    return _DEFAULT
+
+
+def counter(name, help="", labelnames=()):
+    return _DEFAULT.counter(name, help=help, labelnames=labelnames)
+
+
+def gauge(name, help="", labelnames=()):
+    return _DEFAULT.gauge(name, help=help, labelnames=labelnames)
+
+
+def histogram(name, help="", labelnames=(), buckets=None):
+    return _DEFAULT.histogram(name, help=help, labelnames=labelnames,
+                              buckets=buckets)
+
+
+def snapshot():
+    return _DEFAULT.snapshot()
+
+
+def reset():
+    _DEFAULT.reset()
+
+
+def enable():
+    _DEFAULT.enable()
+
+
+def disable():
+    _DEFAULT.disable()
+
+
+def is_enabled():
+    return _DEFAULT.is_enabled()
+
+
+@contextlib.contextmanager
+def timed(hist_or_bound):
+    """Observe a with-block's wall time in MILLISECONDS on a histogram
+    (or a .labels(...) handle). Skips the clock reads when disabled."""
+    if not _DEFAULT.is_enabled():
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        hist_or_bound.observe((time.perf_counter() - t0) * 1e3)
+
+
+# ---- monitor.h macro parity --------------------------------------------------
+# STAT_ADD/STAT_SUB mutate one named int stat; monitor.h stats can go both
+# ways, so they map onto gauges in the default registry.
+
+def STAT_ADD(name, value=1):
+    gauge(name).inc(value)
+
+
+def STAT_SUB(name, value=1):
+    gauge(name).dec(value)
+
+
+def STAT_RESET(name):
+    gauge(name).set(0)
+
+
+# ---- shared instrumentation helpers ------------------------------------------
+
+_COLL_CALLS = None
+_COLL_BYTES = None
+
+
+def tensor_nbytes(x):
+    """Payload bytes of a Tensor/jax array/np array — works on tracers too
+    (aval carries shape+dtype); returns 0 when undeterminable."""
+    try:
+        data = getattr(x, "_data", x)
+        shape = getattr(data, "shape", None)
+        dtype = getattr(data, "dtype", None)
+        if shape is None or dtype is None:
+            return 0
+        n = 1
+        for s in shape:
+            n *= int(s)
+        return n * dtype.itemsize
+    except Exception:
+        return 0
+
+
+def record_collective(kind, nbytes=0):
+    """Count one collective API call by HLO family (`kind` follows
+    analysis/collectives.py naming: all-reduce, all-gather,
+    reduce-scatter, all-to-all, collective-permute). Calls made inside a
+    jit trace count once per TRACE (host-side accounting), mirroring the
+    static collective-count pass rather than a device profiler."""
+    global _COLL_CALLS, _COLL_BYTES
+    if not _DEFAULT.is_enabled():
+        return
+    if _COLL_CALLS is None:
+        _COLL_CALLS = counter(
+            "collective_calls_total",
+            "collective API calls by HLO family (trace-time accounting; "
+            "exact per-execution counts live in the perf-budget HLO gate)",
+            labelnames=("op",))
+        _COLL_BYTES = counter(
+            "collective_bytes_total",
+            "payload bytes handed to collective API calls, by HLO family",
+            labelnames=("op",))
+    _COLL_CALLS.labels(op=kind).inc()
+    if nbytes:
+        _COLL_BYTES.labels(op=kind).inc(nbytes)

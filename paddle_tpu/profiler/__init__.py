@@ -19,6 +19,11 @@ _LOCAL = threading.local()
 _ENABLED = [False]
 _EVENTS = []  # (name, start_ns, end_ns, thread_id, depth)
 _LOCK = threading.Lock()
+# the jax device trace is PROCESS state (one trace per process), so its
+# on/off flag must be module state: keeping it in threading.local meant a
+# stop_profiler from any thread other than the starter silently leaked
+# the running trace (the watchdog/monitor threads are exactly such callers)
+_JAX_TRACE = [False]
 
 
 class RecordEvent:
@@ -57,15 +62,17 @@ def start_profiler(state="All", tracer_option="Default", log_dir=None):
     _ENABLED[0] = True
     _EVENTS.clear()
     if log_dir:
-        jax.profiler.start_trace(log_dir)
-        _LOCAL.jax_trace = True
+        with _LOCK:
+            jax.profiler.start_trace(log_dir)
+            _JAX_TRACE[0] = True
 
 
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     _ENABLED[0] = False
-    if getattr(_LOCAL, "jax_trace", False):
-        jax.profiler.stop_trace()
-        _LOCAL.jax_trace = False
+    with _LOCK:
+        if _JAX_TRACE[0]:
+            jax.profiler.stop_trace()
+            _JAX_TRACE[0] = False
     return summary(sorted_key)
 
 

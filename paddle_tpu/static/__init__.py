@@ -17,17 +17,52 @@ optimizer functionally (jax.value_and_grad over the replay + functional_apply),
 the append_backward program-surgery equivalent.
 """
 import contextlib
+import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import flags as _flags
+from .. import monitor as _monitor
 from ..core import dtype as dtype_mod
 from ..core import dispatch as _dispatch
 from ..core.tensor import Tensor, ParamBase
 from ..jit import InputSpec  # noqa: F401
+from ..profiler import RecordEvent as _RecordEvent
 
 _STATIC_MODE = [False]
+
+# shared-name metric families (site label: "executor" here, "trainer" in
+# distributed/spmd.py) — one snapshot schema covers both train paths
+_COMPILES = _monitor.counter(
+    "compile_total", "jit compiles of the recorded-program replay",
+    labelnames=("site",))
+_COMPILE_CACHE = _monitor.counter(
+    "compile_cache_total",
+    "jit-cache lookups by feed-signature (event: hit|miss)",
+    labelnames=("site", "event", "sig"))
+_COMPILE_MS = _monitor.histogram(
+    "compile_ms", "wall time of one jit compile (trace+lower handoff)",
+    labelnames=("site",))
+_STEP_MS = _monitor.histogram(
+    "step_latency_ms",
+    "Executor.run / train_step wall time (host dispatch; device-complete "
+    "when FLAGS_benchmark=1 forces a sync)", labelnames=("site",))
+_BENCH_SYNC = _monitor.counter(
+    "benchmark_sync_total",
+    "FLAGS_benchmark block_until_ready syncs on fetches",
+    labelnames=("site",))
+
+
+def _feed_sig_label(sig):
+    """Compact feed-signature label, e.g. 'x:float32[2,8]|y:int32[2]'.
+    Cardinality is capped by the registry's overflow series."""
+    if not sig:
+        return "-"
+    return "|".join(
+        f"{k}:{dt}[{','.join(str(d) for d in shape)}]"
+        for k, shape, dt in sig)
 
 
 def enable_static():
@@ -458,6 +493,7 @@ class Executor:
         raise TypeError(f"cannot fetch {type(f).__name__}")
 
     def _run_program(self, program, feed, fetch_list, return_numpy):
+        t_step = time.perf_counter()
         program._ensure_scope()
         fetch_ids = tuple(self._fetch_id(program, f) for f in fetch_list)
         train = program._optimizer is not None and program._loss_id is not None
@@ -469,28 +505,53 @@ class Executor:
         cache = program._exec_cache
         key = (program._version, train, fetch_ids, sig)
         if key not in cache:
-            cache[key] = self._compile(program, tuple(feed_arrays),
-                                       fetch_ids, train)
+            if _monitor.is_enabled():
+                _COMPILE_CACHE.labels(site="executor", event="miss",
+                                      sig=_feed_sig_label(sig)).inc()
+            with _RecordEvent("executor/compile"), \
+                    _monitor.timed(_COMPILE_MS.labels(site="executor")):
+                cache[key] = self._compile(program, tuple(feed_arrays),
+                                           fetch_ids, train)
+            _COMPILES.labels(site="executor").inc()
+        elif _monitor.is_enabled():
+            _COMPILE_CACHE.labels(site="executor", event="hit",
+                                  sig=_feed_sig_label(sig)).inc()
         compiled = cache[key]
         scope = program._scope
-        if train:
-            opt = program._optimizer
-            if scope["opt_state"] is None:
-                scope["opt_state"] = opt.functional_init(scope["params"])
+        with _RecordEvent("executor/run"):
+            if train:
+                opt = program._optimizer
+                if scope["opt_state"] is None:
+                    scope["opt_state"] = opt.functional_init(scope["params"])
+                else:
+                    for n, v in scope["params"].items():
+                        if n not in scope["opt_state"]:
+                            scope["opt_state"][n] = \
+                                opt.functional_init({n: v})[n]
+                lr = jnp.asarray(opt.get_lr(), jnp.float32)
+                new_p, new_s, fetches = compiled(scope["params"],
+                                                 scope["opt_state"], lr,
+                                                 feed_arrays)
+                scope["params"] = new_p
+                scope["opt_state"] = new_s
+                opt._step_count += 1
+                program._sync_params_to_tensors()
             else:
-                for n, v in scope["params"].items():
-                    if n not in scope["opt_state"]:
-                        scope["opt_state"][n] = opt.functional_init({n: v})[n]
-            lr = jnp.asarray(opt.get_lr(), jnp.float32)
-            new_p, new_s, fetches = compiled(scope["params"],
-                                             scope["opt_state"], lr,
-                                             feed_arrays)
-            scope["params"] = new_p
-            scope["opt_state"] = new_s
-            opt._step_count += 1
-            program._sync_params_to_tensors()
-        else:
-            fetches = compiled(scope["params"], feed_arrays)
+                fetches = compiled(scope["params"], feed_arrays)
+            if _flags.get_flag("benchmark"):
+                # step timings measure DEVICE work, not dispatch: block on
+                # every fetch (train steps also pin the updated params so
+                # a fetchless run(feed=...) still syncs the real step)
+                sync_on = list(fetches)
+                if train and scope["params"]:
+                    sync_on.append(next(iter(scope["params"].values())))
+                for f in sync_on:
+                    if hasattr(f, "block_until_ready"):
+                        f.block_until_ready()
+                _BENCH_SYNC.labels(site="executor").inc()
+        if _monitor.is_enabled():
+            _STEP_MS.labels(site="executor").observe(
+                (time.perf_counter() - t_step) * 1e3)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return [Tensor(f) for f in fetches]

@@ -1,0 +1,516 @@
+"""Runtime telemetry layer (paddle_tpu.monitor): registry contract,
+exporter schema round-trip, disabled-mode no-op, and the instrumented
+hot paths (Executor, trainer, Tensor._to_host, collectives, checkpoint
+I/O, serving engine) actually moving their counters.
+
+Reference analog: platform/monitor.h StatRegistry + STAT_ADD and the
+profiler.cc RecordEvent layer — ISSUE 2's acceptance criteria live here:
+a gpt train step and a ServingEngine decode loop must each produce a
+non-empty snapshot with compile-cache + step-latency (+ TTFT/inter-token
+for serving) exported identically via JSON and Prometheus text.
+"""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.monitor.registry import (LABEL_CARDINALITY_CAP,
+                                         OVERFLOW_LABEL, StatRegistry)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    monitor.enable()
+    monitor.reset()
+    yield
+    monitor.enable()
+
+
+class TestRegistryContract:
+    def test_counter_gauge_histogram_basics(self):
+        r = StatRegistry()
+        c = r.counter("c_total")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        g = r.gauge("g")
+        g.set(7)
+        g.dec(2)
+        g.inc(1)
+        assert g.value == 6.0
+        h = r.histogram("h_ms", buckets=(1.0, 10.0))
+        h.observe(0.5)
+        h.observe(5.0)
+        h.observe(50.0)
+        assert h.count == 3
+        assert h.sum == pytest.approx(55.5)
+
+    def test_get_or_create_returns_same_metric(self):
+        r = StatRegistry()
+        assert r.counter("x") is r.counter("x")
+
+    def test_kind_and_label_conflicts_raise(self):
+        r = StatRegistry()
+        r.counter("x")
+        with pytest.raises(ValueError, match="already registered"):
+            r.gauge("x")
+        r.counter("y", labelnames=("a",))
+        with pytest.raises(ValueError, match="labels"):
+            r.counter("y", labelnames=("b",))
+
+    def test_histogram_bucket_conflict_raises(self):
+        r = StatRegistry()
+        h = r.histogram("h", buckets=(1.0, 10.0))
+        assert r.histogram("h", buckets=(10.0, 1.0)) is h  # order-insensitive
+        with pytest.raises(ValueError, match="buckets"):
+            r.histogram("h", buckets=(100.0, 200.0))
+
+    def test_counter_cannot_decrease(self):
+        r = StatRegistry()
+        with pytest.raises(ValueError, match="cannot decrease"):
+            r.counter("x").inc(-1)
+
+    def test_wrong_method_for_kind(self):
+        r = StatRegistry()
+        with pytest.raises(TypeError):
+            r.counter("x").observe(1)
+        with pytest.raises(TypeError):
+            r.histogram("h").set(1)
+        with pytest.raises(TypeError):
+            r.counter("x").dec()
+
+    def test_labels_validation(self):
+        r = StatRegistry()
+        c = r.counter("x", labelnames=("op",))
+        with pytest.raises(ValueError, match="declares labels"):
+            c.inc()  # labeled metric needs .labels(...)
+        with pytest.raises(ValueError, match="takes labels"):
+            c.labels(other="y")
+        c.labels(op="a").inc(2)
+        c.labels(op="b").inc(3)
+        vals = {s.labels["op"]: s.value for s in c.series()}
+        assert vals == {"a": 2.0, "b": 3.0}
+
+    def test_thread_safety(self):
+        r = StatRegistry()
+        c = r.counter("t_total")
+        h = r.histogram("t_ms", buckets=(10.0,))
+        n, threads = 2000, []
+
+        def work():
+            for _ in range(n):
+                c.inc()
+                h.observe(1.0)
+
+        for _ in range(4):
+            threads.append(threading.Thread(target=work))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 4 * n
+        assert h.count == 4 * n
+
+    def test_reset_keeps_metrics_registered(self):
+        r = StatRegistry()
+        c = r.counter("x")
+        lc = r.counter("y", labelnames=("k",))
+        c.inc(5)
+        lc.labels(k="v").inc(2)
+        r.reset()
+        assert r.get("x") is c
+        assert c.value == 0.0
+        assert lc.series() == []   # labeled children dropped
+        c.inc()                    # cached handles still work
+        lc.labels(k="v").inc()
+        assert c.value == 1.0
+
+
+class TestHistogramBuckets:
+    def test_le_is_inclusive_and_cumulative(self):
+        r = StatRegistry()
+        h = r.histogram("h", buckets=(1.0, 5.0, 10.0))
+        for v in (0.5, 1.0, 3.0, 10.0, 99.0):
+            h.observe(v)
+        (series,) = h.series()
+        d = series.to_dict()
+        # cumulative: <=1 -> 2 (0.5, 1.0 inclusive), <=5 -> 3, <=10 -> 4
+        assert d["buckets"] == [[1.0, 2], [5.0, 3], [10.0, 4], ["+Inf", 5]]
+        assert d["count"] == 5
+        assert d["sum"] == pytest.approx(113.5)
+
+    def test_default_buckets_sorted(self):
+        assert list(monitor.DEFAULT_BUCKETS) == \
+            sorted(monitor.DEFAULT_BUCKETS)
+
+
+class TestLabelCardinalityCap:
+    def test_overflow_series(self):
+        r = StatRegistry()
+        c = r.counter("x", labelnames=("sig",))
+        for i in range(LABEL_CARDINALITY_CAP + 40):
+            c.labels(sig=f"s{i}").inc()
+        series = c.series()
+        assert len(series) <= LABEL_CARDINALITY_CAP + 1
+        overflow = [s for s in series
+                    if s.labels["sig"] == OVERFLOW_LABEL]
+        assert len(overflow) == 1
+        # nothing lost: every inc landed somewhere
+        assert sum(s.value for s in series) == LABEL_CARDINALITY_CAP + 40
+
+
+class TestExporters:
+    def _build(self, r):
+        r.counter("req_total", "reqs", labelnames=("op",)) \
+            .labels(op="all-reduce").inc(3)
+        r.gauge("occ").set(2)
+        h = r.histogram("lat_ms", buckets=(1.0, 10.0))
+        h.observe(0.5)
+        h.observe(20.0)
+
+    def test_prometheus_round_trip(self):
+        r = StatRegistry()
+        self._build(r)
+        snap = r.snapshot()
+        text = monitor.to_prometheus(snap)
+        parsed = monitor.parse_prometheus(text)
+        assert parsed[("req_total", frozenset({("op", "all-reduce")}))] == 3
+        assert parsed[("occ", frozenset())] == 2
+        assert parsed[("lat_ms_bucket", frozenset({("le", "1")}))] == 1
+        assert parsed[("lat_ms_bucket", frozenset({("le", "+Inf")}))] == 2
+        assert parsed[("lat_ms_sum", frozenset())] == pytest.approx(20.5)
+        assert parsed[("lat_ms_count", frozenset())] == 2
+
+    def test_json_and_prometheus_share_one_snapshot(self):
+        """Identical export: both wire forms are pure functions of ONE
+        snapshot dict — counter/gauge values and histogram count/sum must
+        agree sample for sample."""
+        r = StatRegistry()
+        self._build(r)
+        snap = r.snapshot()
+        via_json = json.loads(monitor.to_json(snap))
+        parsed = monitor.parse_prometheus(monitor.to_prometheus(snap))
+        for m in via_json["metrics"]:
+            for s in m["series"]:
+                key = frozenset(s["labels"].items())
+                if m["type"] in ("counter", "gauge"):
+                    assert parsed[(m["name"], key)] == s["value"]
+                else:
+                    assert parsed[(m["name"] + "_count", key)] == s["count"]
+                    assert parsed[(m["name"] + "_sum", key)] == \
+                        pytest.approx(s["sum"])
+                    from paddle_tpu.monitor.exporters import _num
+
+                    for le, cum in s["buckets"]:
+                        le_s = "+Inf" if le == "+Inf" else _num(le)
+                        assert parsed[(m["name"] + "_bucket",
+                                       key | {("le", le_s)})] == cum
+
+    def test_round_trip_escaped_label_values(self):
+        """Backslash-then-n, quotes, and newlines in label VALUES must
+        survive to_prometheus -> parse_prometheus exactly (single-pass
+        unescape; sequential replaces decode 'backslash n' as newline)."""
+        r = StatRegistry()
+        c = r.counter("esc_total", labelnames=("v",))
+        tricky = ["a\\nb", 'say "hi"', "line1\nline2", "back\\slash", "x,y"]
+        for i, v in enumerate(tricky):
+            c.labels(v=v).inc(i + 1)
+        parsed = monitor.parse_prometheus(
+            monitor.to_prometheus(r.snapshot()))
+        for i, v in enumerate(tricky):
+            assert parsed[("esc_total", frozenset({("v", v)}))] == i + 1
+
+    def test_flatten(self):
+        r = StatRegistry()
+        self._build(r)
+        flat = monitor.flatten(r.snapshot())
+        assert flat["req_total{op=all-reduce}"] == 3.0
+        assert flat["occ"] == 2.0
+        assert flat["lat_ms"]["count"] == 2
+
+    def test_jsonl_event_log(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        old = paddle.get_flags("FLAGS_monitor_log_path")
+        paddle.set_flags({"monitor_log_path": path})
+        try:
+            rec = monitor.log_event("bench_phase", phase="headline",
+                                    status="start")
+            assert rec["event"] == "bench_phase"
+            r = StatRegistry()
+            r.counter("x").inc()
+            monitor.log_snapshot(r.snapshot())
+            lines = [json.loads(ln) for ln in
+                     open(path).read().splitlines()]
+            assert lines[0]["phase"] == "headline"
+            assert lines[1]["event"] == "snapshot"
+            assert lines[1]["snapshot"]["metrics"][0]["name"] == "x"
+        finally:
+            paddle.set_flags({"monitor_log_path":
+                              old.get("FLAGS_monitor_log_path", "")})
+
+    def test_event_log_disabled_without_path(self):
+        paddle.set_flags({"monitor_log_path": ""})
+        assert monitor.log_event("x") is None
+
+
+class TestDisabledMode:
+    def test_disabled_records_nothing(self):
+        r = StatRegistry()
+        c = r.counter("x")
+        h = r.histogram("h")
+        g = r.gauge("g")
+        r.disable()
+        c.inc()
+        h.observe(1.0)
+        g.set(5)
+        assert c.value == 0.0
+        assert h.count == 0
+        assert g.value == 0.0
+        r.enable()
+        c.inc()
+        assert c.value == 1.0
+
+    def test_default_registry_toggle(self):
+        c = monitor.counter("toggle_probe_total")
+        monitor.disable()
+        c.inc()
+        assert c.value == 0.0
+        monitor.enable()
+        c.inc()
+        assert c.value == 1.0
+
+    def test_timed_skips_clock_when_disabled(self):
+        h = monitor.histogram("timed_probe_ms")
+        with monitor.timed(h):
+            pass
+        assert h.count == 1
+        monitor.disable()
+        with monitor.timed(h):
+            pass
+        monitor.enable()
+        assert h.count == 1
+
+
+class TestStatMacros:
+    def test_stat_add_sub_reset(self):
+        monitor.STAT_ADD("STAT_gpu0_mem", 100)
+        monitor.STAT_ADD("STAT_gpu0_mem", 20)
+        monitor.STAT_SUB("STAT_gpu0_mem", 50)
+        assert monitor.gauge("STAT_gpu0_mem").value == 70
+        monitor.STAT_RESET("STAT_gpu0_mem")
+        assert monitor.gauge("STAT_gpu0_mem").value == 0
+
+
+class TestInstrumentedHotPaths:
+    def test_host_sync_counter_moves(self):
+        c = monitor.counter("host_sync_total")
+        before = c.value
+        t = paddle.to_tensor([1.0, 2.0])
+        t.numpy()
+        t.item(0)
+        t.tolist()
+        assert c.value == before + 3
+
+    def test_collective_count_and_bytes(self):
+        import paddle_tpu.distributed as dist
+
+        t = paddle.to_tensor(np.ones((4, 4), np.float32))
+        dist.all_reduce(t)
+        calls = monitor.counter("collective_calls_total",
+                                labelnames=("op",))
+        byts = monitor.counter("collective_bytes_total",
+                               labelnames=("op",))
+        assert calls.labels(op="all-reduce").value == 1
+        assert byts.labels(op="all-reduce").value == 64.0
+
+    def test_checkpoint_counters(self, tmp_path):
+        p = str(tmp_path / "ck.pdparams")
+        paddle.save({"w": paddle.to_tensor([1.0, 2.0])}, p)
+        paddle.load(p)
+        c = monitor.counter("checkpoint_total", labelnames=("op",))
+        h = monitor.histogram("checkpoint_ms", labelnames=("op",))
+        b = monitor.counter("checkpoint_bytes_total", labelnames=("op",))
+        assert c.labels(op="save").value == 1
+        assert c.labels(op="load").value == 1
+        assert h.labels(op="save").count == 1
+        assert b.labels(op="load").value > 0
+
+
+class TestProfilerJaxTraceFix:
+    def test_stop_from_another_thread_stops_the_trace(self, monkeypatch,
+                                                      tmp_path):
+        """The satellite fix: the jax device-trace flag is PROCESS state —
+        stop_profiler from a different thread than the starter must stop
+        the trace (it used to silently leak it via threading.local)."""
+        from paddle_tpu import profiler as prof
+
+        calls = []
+        monkeypatch.setattr("jax.profiler.start_trace",
+                            lambda d: calls.append(("start", d)))
+        monkeypatch.setattr("jax.profiler.stop_trace",
+                            lambda: calls.append(("stop",)))
+        prof.start_profiler(log_dir=str(tmp_path))
+        assert calls == [("start", str(tmp_path))]
+        t = threading.Thread(target=prof.stop_profiler)
+        t.start()
+        t.join()
+        assert calls[-1] == ("stop",)
+        # and the flag is cleared: a second stop must not double-stop
+        prof.stop_profiler()
+        assert calls.count(("stop",)) == 1
+
+
+def _tiny_static_program():
+    import paddle_tpu.static as st
+
+    main, startup = st.Program(), st.Program()
+    st.enable_static()
+    try:
+        with st.program_guard(main, startup):
+            x = st.data("x", [None, 4])
+            w = paddle.create_parameter([4, 4])
+            y = paddle.matmul(x, w)
+    finally:
+        st.disable_static()
+    return main, startup, y
+
+
+class TestExecutorInstrumentation:
+    def test_cache_hit_miss_and_step_latency(self):
+        import paddle_tpu.static as st
+
+        main, startup, y = _tiny_static_program()
+        exe = st.Executor()
+        exe.run(startup)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        cache = monitor.counter("compile_cache_total",
+                                labelnames=("site", "event", "sig"))
+        steps = monitor.histogram("step_latency_ms", labelnames=("site",))
+        sig = "x:float32[2,4]"
+        before = steps.labels(site="executor").count
+        exe.run(main, feed=feed, fetch_list=[y])
+        exe.run(main, feed=feed, fetch_list=[y])
+        assert cache.labels(site="executor", event="miss",
+                            sig=sig).value == 1
+        assert cache.labels(site="executor", event="hit",
+                            sig=sig).value == 1
+        assert steps.labels(site="executor").count == before + 2
+        assert monitor.counter(
+            "compile_total", labelnames=("site",)).labels(
+            site="executor").value == 1
+        # a NEW feed signature is a new cache entry -> a second miss
+        exe.run(main, feed={"x": np.ones((3, 4), np.float32)},
+                fetch_list=[y])
+        assert cache.labels(site="executor", event="miss",
+                            sig="x:float32[3,4]").value == 1
+
+    def test_flags_benchmark_counts_syncs(self):
+        import paddle_tpu.static as st
+
+        main, startup, y = _tiny_static_program()
+        exe = st.Executor()
+        exe.run(startup)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        sync = monitor.counter("benchmark_sync_total",
+                               labelnames=("site",))
+        before = sync.labels(site="executor").value
+        exe.run(main, feed=feed, fetch_list=[y])
+        assert sync.labels(site="executor").value == before  # flag off
+        paddle.set_flags({"benchmark": True})
+        try:
+            exe.run(main, feed=feed, fetch_list=[y])
+        finally:
+            paddle.set_flags({"benchmark": False})
+        assert sync.labels(site="executor").value == before + 1
+
+    def test_disabled_monitor_records_nothing_on_run(self):
+        import paddle_tpu.static as st
+
+        main, startup, y = _tiny_static_program()
+        exe = st.Executor()
+        exe.run(startup)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        monitor.disable()
+        try:
+            exe.run(main, feed=feed, fetch_list=[y])
+        finally:
+            monitor.enable()
+        steps = monitor.histogram("step_latency_ms", labelnames=("site",))
+        assert steps.labels(site="executor").count == 0
+
+
+class TestMetricsDumpTool:
+    def _load(self):
+        import importlib.util
+        import os
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "metrics_dump", os.path.join(repo, "tools", "metrics_dump.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules.pop("metrics_dump", None)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_report_shares_graph_lint_schema(self):
+        """The CI contract: metrics_dump --json reads through the same
+        loader as graph_lint/op_coverage (tool/passes/targets/totals;
+        targets carry name/counts/findings)."""
+        md = self._load()
+        rep = md.build_report(["serving"])
+        assert set(rep) >= {"tool", "passes", "targets", "totals"}
+        assert rep["tool"] == "metrics_dump"
+        for t in rep["targets"].values():
+            assert set(t) >= {"name", "counts", "findings"}
+            assert set(t["counts"]) == {"error", "warning", "info"}
+        assert rep["totals"]["error"] == 0, rep["targets"]["serving"][
+            "findings"]
+        # the serving snapshot carries the acceptance histograms
+        fams = {m["name"] for m in
+                rep["targets"]["serving"]["snapshot"]["metrics"]
+                if m["series"]}
+        assert {"serving_ttft_ms", "serving_inter_token_ms"} <= fams
+
+
+class TestAcceptanceEndToEnd:
+    """ISSUE 2 acceptance: one gpt train step and one serving decode loop
+    each produce a non-empty snapshot with the required families, exported
+    identically via JSON and Prometheus text."""
+
+    def _roundtrip_identical(self, snap):
+        parsed = monitor.parse_prometheus(monitor.to_prometheus(snap))
+        via_json = json.loads(monitor.to_json(snap))
+        for m in via_json["metrics"]:
+            for s in m["series"]:
+                key = frozenset(s["labels"].items())
+                if m["type"] in ("counter", "gauge"):
+                    assert parsed[(m["name"].replace("-", "_"), key)] == \
+                        s["value"]
+                else:
+                    assert parsed[(m["name"] + "_count", key)] == s["count"]
+
+    def test_gpt_train_step_snapshot(self):
+        md = TestMetricsDumpTool()._load()
+        monitor.reset()
+        md.run_train_step("gpt")
+        snap = monitor.snapshot()
+        fams = {m["name"] for m in snap["metrics"] if m["series"]}
+        assert {"compile_cache_total", "compile_total",
+                "step_latency_ms"} <= fams
+        self._roundtrip_identical(snap)
+
+    def test_serving_decode_loop_snapshot(self):
+        md = TestMetricsDumpTool()._load()
+        monitor.reset()
+        stats = md.run_serving_loop()
+        snap = monitor.snapshot()
+        fams = {m["name"] for m in snap["metrics"] if m["series"]}
+        assert {"serving_ttft_ms", "serving_inter_token_ms",
+                "serving_queue_wait_ms", "serving_tokens_total"} <= fams
+        self._roundtrip_identical(snap)
+        assert stats["tokens_generated"] > 0
+        assert stats["ttft_ms"]["count"] == 2

@@ -515,6 +515,65 @@ class TestObservability:
         with pytest.raises(KeyError):
             eng.get_request(999)
 
+    def test_engine_stats_and_monitor_counters_move(self, rng):
+        """ISSUE 2: the serving.py docstring's promised latency trackers —
+        stats() aggregates and the monitor's serving_* families both move
+        over a drain, and the per-request view carries TTFT."""
+        from paddle_tpu import monitor
+
+        monitor.reset()
+        m = _model()
+        eng = ServingEngine(m, max_batch=2)
+        rids = [eng.submit(rng.randint(0, 256, (4 + i,)).astype(np.int32),
+                           max_new_tokens=4) for i in range(3)]
+        eng.run_until_complete()
+        s = eng.stats()
+        assert s["requests"]["submitted"] == 3
+        assert s["requests"]["finished"] == {"length": 3}
+        assert s["tokens_generated"] == 12
+        assert s["steps"].get("decode_greedy", 0) >= 3
+        assert s["ttft_ms"]["count"] == 3
+        assert s["inter_token_ms"]["count"] == 9   # 3 reqs x 3 gaps
+        assert s["queue_wait_ms"]["count"] == 3
+        assert 0 < s["batch_occupancy_avg"] <= 2
+        # per-request view (the get_request latency-tracker surface)
+        r = eng.get_request(rids[0])
+        assert r.stats()["new_tokens"] == 4
+        assert r.stats()["ttft_ms"] > 0
+        assert r.stats()["inter_token"]["count"] == 3
+        # the same families stream into the global monitor registry
+        flat = monitor.flatten(monitor.snapshot())
+        assert flat["serving_requests_submitted_total"] == 3
+        assert flat["serving_requests_finished_total{reason=length}"] == 3
+        assert flat["serving_tokens_total"] == 12
+        assert flat["serving_ttft_ms"]["count"] == 3
+        assert flat["serving_inter_token_ms"]["count"] == 9
+
+    def test_prefix_and_spec_rates_in_stats(self, rng):
+        from paddle_tpu import monitor
+
+        monitor.reset()
+        m = _model()
+        eng = ServingEngine(m, max_batch=2)
+        pre = rng.randint(0, 256, (8,)).astype(np.int32)
+        pid = eng.register_prefix(pre)
+        eng.submit(rng.randint(0, 256, (4,)).astype(np.int32),
+                   max_new_tokens=2, prefix_id=pid)
+        eng.run_until_complete()
+        s = eng.stats()
+        assert s["prefix_cache"] == {"hit": 1, "miss": 0, "hit_rate": 1.0}
+        # speculative accounting: a self-draft engine accepts everything
+        paddle.seed(0)
+        eng2 = ServingEngine(_model(), max_batch=2, draft_model=_model(),
+                             spec_k=3)
+        eng2.submit(rng.randint(0, 256, (5,)).astype(np.int32),
+                    max_new_tokens=7)
+        eng2.run_until_complete()
+        s2 = eng2.stats()
+        assert s2["speculative"]["proposed"] > 0
+        assert s2["speculative"]["accept_rate"] == 1.0  # draft == target
+        assert s2["steps"].get("speculative", 0) >= 1
+
 
 class TestSpeculative:
     """Speculative continuous batching (draft_model=): output must be

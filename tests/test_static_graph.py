@@ -92,6 +92,33 @@ class TestFitALine:
             (l,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
             assert np.isfinite(float(l))
 
+    def test_monitor_counts_compiles_and_steps(self):
+        """ISSUE 2: Executor.run streams compile-cache and step-latency
+        telemetry — per feed-signature, one miss then hits; a new batch
+        size is a new signature (and a fresh compile)."""
+        from paddle_tpu import monitor
+
+        monitor.reset()
+        main, startup, test_prog, x, y, pred, loss = _build_fit_a_line()
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        cache = monitor.counter("compile_cache_total",
+                                labelnames=("site", "event", "sig"))
+        xs = np.random.rand(8, 13).astype(np.float32)
+        ys = np.random.rand(8, 1).astype(np.float32)
+        for _ in range(3):
+            exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        sig = "x:float32[8,13]|y:float32[8,1]"
+        assert cache.labels(site="executor", event="miss", sig=sig).value == 1
+        assert cache.labels(site="executor", event="hit", sig=sig).value == 2
+        exe.run(main, feed={"x": xs[:4], "y": ys[:4]}, fetch_list=[loss])
+        assert cache.labels(site="executor", event="miss",
+                            sig="x:float32[4,13]|y:float32[4,1]").value == 1
+        assert monitor.counter("compile_total", labelnames=("site",)) \
+            .labels(site="executor").value == 2
+        assert monitor.histogram("step_latency_ms", labelnames=("site",)) \
+            .labels(site="executor").count == 4
+
 
 class TestStaticMnistMLP:
     def test_recognize_digits_shape(self):

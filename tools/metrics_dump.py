@@ -1,0 +1,203 @@
+"""Metrics dump CLI: run an instrumented workload, print the monitor snapshot.
+
+    python tools/metrics_dump.py --model gpt              # one gpt train step
+    python tools/metrics_dump.py --serving                # serving decode loop
+    python tools/metrics_dump.py --model bert --prometheus
+    python tools/metrics_dump.py --all --json             # machine-readable
+
+Each target resets the default registry, runs the workload at CPU-shrunk
+shapes (the analysis/targets.py convention — 2 steps, so BOTH the
+compile-cache miss and the hit counters move), then exports the registry.
+
+Default output is the snapshot JSON (one schema for all exporters);
+--prometheus prints the text exposition of the SAME snapshot. --json
+emits the tools/graph_lint.py report schema ({"tool", "passes",
+"targets": {name: {"name", "counts", "findings"}}, "totals"}, plus a
+per-target "snapshot") so CI reads all three audit tools through one
+loader; a target whose snapshot is MISSING a required metric family
+(compile-cache + step-latency for train, TTFT + inter-token for serving)
+reports an error-severity finding and the exit code is 1 — the
+acceptance-criterion check in executable form.
+"""
+import argparse
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODEL_TARGETS = ("gpt", "bert", "ernie")
+
+# metric families that MUST be non-empty in a target's snapshot
+_REQUIRED = {
+    "train": ("compile_cache_total", "compile_total", "step_latency_ms"),
+    "serving": ("serving_ttft_ms", "serving_inter_token_ms",
+                "serving_requests_submitted_total", "serving_tokens_total"),
+}
+
+_DIMS = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+             dropout=0.0)
+
+
+def run_train_step(name, steps=2):
+    """One jitted train step (+1 cache-hit step) for a bundled model."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.models import (BertConfig, BertForPretraining,
+                                   BertPretrainLoss, ErnieConfig,
+                                   ErnieForPretraining, ErniePretrainLoss,
+                                   GPTConfig, GPTForCausalLM,
+                                   GPTPretrainLoss)
+
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    b, s = 2, 16
+    if name == "gpt":
+        model = GPTForCausalLM(GPTConfig(max_seq_len=64, **_DIMS))
+        loss = GPTPretrainLoss()
+        batch = (rng.randint(0, 256, (b, s)).astype(np.int32),
+                 rng.randint(0, 256, (b, s)).astype(np.int32))
+    elif name == "bert":
+        model = BertForPretraining(BertConfig(max_position=64,
+                                              intermediate_size=256, **_DIMS))
+        loss = BertPretrainLoss()
+        batch = (rng.randint(0, 256, (b, s)).astype(np.int32),
+                 np.zeros((b, s), np.int32),
+                 rng.randint(0, 256, (b, s)).astype(np.int32))
+    elif name == "ernie":
+        model = ErnieForPretraining(ErnieConfig(max_position=64,
+                                                intermediate_size=256,
+                                                **_DIMS))
+        loss = ErniePretrainLoss()
+        batch = (rng.randint(0, 256, (b, s)).astype(np.int32),
+                 np.zeros((b, s), np.int32),
+                 rng.randint(0, 256, (b, s)).astype(np.int32))
+    else:
+        raise ValueError(f"unknown model {name!r}; choose from "
+                         f"{MODEL_TARGETS}")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+    trainer = SpmdTrainer(model, opt, loss_fn=loss, mesh=mesh)
+    tensors = [paddle.to_tensor(a) for a in batch]
+    for _ in range(steps):
+        out = trainer.train_step(*tensors)
+    return float(np.asarray(out._data))
+
+
+def run_serving_loop(new_tokens=6):
+    """A small ServingEngine decode loop: two mixed-length prompts drained
+    through the continuous-batching step()."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    model = GPTForCausalLM(GPTConfig(max_seq_len=64, **_DIMS))
+    model.eval()
+    eng = ServingEngine(model, max_batch=2)
+    rng = np.random.RandomState(0)
+    eng.submit(rng.randint(0, 256, (8,)).astype(np.int32),
+               max_new_tokens=new_tokens)
+    eng.submit(rng.randint(0, 256, (12,)).astype(np.int32),
+               max_new_tokens=new_tokens - 2)
+    eng.run_until_complete()
+    return eng.stats()
+
+
+def _metric_families(snap):
+    return {m["name"]: m for m in snap["metrics"] if m["series"]}
+
+
+def run_target(name):
+    """Run one target against a freshly-reset registry; returns
+    (snapshot, findings) with findings in the graph_lint format."""
+    from paddle_tpu import monitor
+
+    monitor.reset()
+    kind = "serving" if name == "serving" else "train"
+    if kind == "serving":
+        run_serving_loop()
+    else:
+        run_train_step(name)
+    snap = monitor.snapshot()
+    fams = _metric_families(snap)
+    findings = []
+    for req in _REQUIRED[kind]:
+        if req not in fams:
+            findings.append({
+                "pass": "metrics-present", "severity": "error",
+                "message": f"required metric family {req!r} missing or "
+                           f"empty after the {name} run", "where": name})
+    from paddle_tpu.monitor import flatten
+
+    for key, val in sorted(flatten(snap).items()):
+        findings.append({"pass": "metrics", "severity": "info",
+                         "message": f"{key} = {val}", "where": name})
+    return snap, findings
+
+
+def build_report(targets):
+    """The tools/graph_lint.py-schema report over the requested targets."""
+    report = {"tool": "metrics_dump", "passes": [], "targets": {},
+              "totals": {"error": 0, "warning": 0, "info": 0}}
+    for name in targets:
+        snap, findings = run_target(name)
+        counts = {"error": 0, "warning": 0, "info": 0}
+        for f in findings:
+            counts[f["severity"]] += 1
+        report["targets"][name] = {"name": name, "counts": counts,
+                                   "findings": findings, "snapshot": snap}
+        for sev, n in counts.items():
+            report["totals"][sev] += n
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", choices=MODEL_TARGETS, action="append",
+                    default=[], help="run one bundled model's train step")
+    ap.add_argument("--serving", action="store_true",
+                    help="run the ServingEngine decode loop")
+    ap.add_argument("--all", action="store_true",
+                    help="all models + the serving loop")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the graph_lint-schema machine report")
+    ap.add_argument("--prometheus", action="store_true",
+                    help="emit Prometheus text exposition instead of JSON")
+    args = ap.parse_args(argv)
+
+    targets = list(args.model)
+    if args.serving:
+        targets.append("serving")
+    if args.all:
+        targets = list(MODEL_TARGETS) + ["serving"]
+    if not targets:
+        ap.error("pick a target: --model NAME, --serving or --all")
+
+    report = build_report(targets)
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+    elif args.prometheus:
+        from paddle_tpu.monitor import to_prometheus
+
+        for name, t in report["targets"].items():
+            print(f"# target: {name}")
+            print(to_prometheus(t["snapshot"]))
+    else:
+        for name, t in report["targets"].items():
+            print(f"# target: {name}")
+            print(json.dumps(t["snapshot"], indent=1, sort_keys=True))
+    return 1 if report["totals"]["error"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
