@@ -67,19 +67,53 @@ def _emit(line):
     print(json.dumps(line), flush=True)
 
 
+# cumulative compile-cache counts at the previous heartbeat, so each
+# bench_phase line also carries the DELTA attributable to its phase
+_LAST_CACHE_COUNTS = {}
+
+
+def _compile_cache_counts():
+    """Aggregate compile_cache_total by (event, source) across all sites —
+    the per-phase attribution signal: a wedged round whose heartbeats show
+    only miss_fresh deltas died compiling; one showing hit_disk warmed
+    from FLAGS_jit_cache_dir and its time went to runtime."""
+    from paddle_tpu import monitor
+
+    out = {}
+    metric = monitor.default_registry().get("compile_cache_total")
+    if metric is None:
+        return out
+    for s in metric.series():
+        key = (f"{s.labels.get('event', '?')}_"
+               f"{s.labels.get('source', '?')}")
+        out[key] = out.get(key, 0) + int(s.value)
+    return out
+
+
 def _heartbeat(phase, status="start", **fields):
     """Phase heartbeat into the monitor JSONL event log
     (FLAGS_monitor_log_path; defaults to /tmp/paddle_tpu_bench_events.jsonl
     for bench runs): when a later compile wedges past the watchdog, the
     log's last heartbeat names the wedged phase instead of an opaque
-    'no measurement within 900s'."""
+    'no measurement within 900s'. Each line carries the compile-cache
+    hit/miss counts by source (memory|disk|fresh) plus the delta since
+    the previous heartbeat, so a wedged phase is attributable to compile
+    vs runtime from the artifact alone."""
     try:
         from paddle_tpu import flags, monitor
 
         if not flags.get_flag("monitor_log_path", ""):
             flags.set_flags(
                 {"monitor_log_path": "/tmp/paddle_tpu_bench_events.jsonl"})
+        counts = _compile_cache_counts()
+        delta = {k: v - _LAST_CACHE_COUNTS.get(k, 0)
+                 for k, v in counts.items()
+                 if v != _LAST_CACHE_COUNTS.get(k, 0)}
+        _LAST_CACHE_COUNTS.clear()
+        _LAST_CACHE_COUNTS.update(counts)
         monitor.log_event("bench_phase", phase=phase, status=status,
+                          compile_cache=counts, compile_cache_delta=delta,
+                          jit_cache_dir=flags.get_flag("jit_cache_dir", ""),
                           **fields)
     except Exception:
         pass
@@ -735,6 +769,17 @@ def main():
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     _heartbeat("device_init", "done", on_tpu=on_tpu)
+    # FLAGS_jit_cache_dir (env or set_flags) turns on the framework's own
+    # persistent AOT executable cache: every SpmdTrainer/Executor/
+    # ServingEngine compile below loads from it when warm (the aot_warm
+    # tool pre-populates it) — a probe session's 26-minute compile becomes
+    # this run's millisecond deserialize. Heartbeats carry the hit/miss
+    # split so the artifact shows whether a round ran warm.
+    from paddle_tpu import flags as _ptflags
+
+    if _ptflags.get_flag("jit_cache_dir", ""):
+        print(f"  AOT executable cache: "
+              f"{_ptflags.get_flag('jit_cache_dir')}", file=sys.stderr)
     if on_tpu:
         enable_tpu_compile_cache()
     if not on_tpu:

@@ -26,21 +26,17 @@ from .. import flags as _flags
 from .. import monitor as _monitor
 from ..core.tape import global_tape
 from ..core.tensor import Tensor
+from ..framework import aot as _aot
 from ..profiler import RecordEvent as _RecordEvent
 from .mesh import get_mesh
 
-# the static.Executor metric families under site="trainer": one snapshot
-# schema covers both train paths (names/labels must match static's)
-_COMPILES = _monitor.counter(
-    "compile_total", "jit compiles of the recorded-program replay",
-    labelnames=("site",))
-_COMPILE_CACHE = _monitor.counter(
-    "compile_cache_total",
-    "jit-cache lookups by feed-signature (event: hit|miss)",
-    labelnames=("site", "event", "sig"))
+# compile_total/compile_cache_total are declared (and recorded) by
+# framework/aot.py's record_compile — one mapping for every site; this
+# module reports under site="trainer" so one snapshot schema covers both
+# train paths
 _COMPILE_MS = _monitor.histogram(
-    "compile_ms", "wall time of one jit compile (trace+lower handoff)",
-    labelnames=("site",))
+    "compile_ms", "wall time to obtain an executable (fresh compile, or "
+    "lower+deserialize on an AOT-cache hit)", labelnames=("site",))
 _STEP_MS = _monitor.histogram(
     "step_latency_ms",
     "Executor.run / train_step wall time (host dispatch; device-complete "
@@ -200,7 +196,8 @@ class SpmdTrainer:
             if extra_kwargs.get("remat_offload"):
                 raise ValueError("remat_offload and recompute_policy both "
                                  "select a jax.checkpoint policy — pick one")
-        self._compiled = None
+        self._compiled = None       # latest executable (back-compat handle)
+        self._compiled_store = {}   # batch-signature -> executable
         self.params = {n: p._data for n, p in layer.named_parameters() if getattr(p, "trainable", True)}
         self.frozen = {n: p._data for n, p in layer.named_parameters() if not getattr(p, "trainable", True)}
         self.buffers = {n: b._data for n, b in layer.named_buffers()}
@@ -608,46 +605,94 @@ class SpmdTrainer:
         return jax.jit(step, in_shardings=in_shardings,
                        out_shardings=out_shardings, donate_argnums=(0, 1))
 
+    # -- compile (lazy or warm-start) ------------------------------------------
+    @staticmethod
+    def _batch_sig_key(batch_arrays):
+        return tuple((tuple(a.shape), str(a.dtype)) for a in batch_arrays)
+
+    def _aot_compile(self, batch_arrays, lr, rng, force=False):
+        """Build the jitted step for THIS batch signature and obtain its
+        executable — through the persistent AOT cache (framework/aot.py)
+        when FLAGS_jit_cache_dir is set, else the plain lazy jit. Compiled
+        steps are kept per batch signature (a trailing partial batch must
+        not evict or shadow the full-batch executable); batch_arrays may
+        be jax.ShapeDtypeStructs (aot_build: nothing is executed)."""
+        sig = _batch_sig_label(batch_arrays)
+        with _RecordEvent("trainer/compile"), \
+                _monitor.timed(_COMPILE_MS.labels(site="trainer")):
+            jitted = self._build(batch_arrays)
+            compiled, source = _aot.compile_cached(
+                jitted,
+                (self.params, self.opt_state, self.buffers, lr, rng,
+                 *batch_arrays),
+                site="trainer", force=force,
+                extra_key=("trainer", _aot.mesh_fingerprint(self.mesh),
+                           self.dp_axis, self.sharding_stage,
+                           self.accumulate_steps))
+        self._compiled_store[self._batch_sig_key(batch_arrays)] = compiled
+        self._compiled = compiled  # latest executable (back-compat handle)
+        _aot.record_compile("trainer", sig, source)
+        return source
+
+    def aot_build(self, batch_specs):
+        """Warm-start: compile the train step from batch shape specs — no
+        real data, nothing executed. One (shape, dtype) pair (or
+        jax.ShapeDtypeStruct) per train_step positional arg::
+
+            trainer.aot_build([((8, 128), "int32"), ((8, 128), "int32")])
+
+        With FLAGS_jit_cache_dir set, the executable is loaded from /
+        stored into the persistent cache; without it, the step is still
+        AOT-compiled in memory. Either way the first train_step pays zero
+        compile. Returns where the executable came from (disk|fresh)."""
+        from ..core.generator import default_generator
+
+        specs = []
+        for spec in batch_specs:
+            if isinstance(spec, jax.ShapeDtypeStruct):
+                specs.append(spec)
+            else:
+                shape, dtype = spec
+                specs.append(jax.ShapeDtypeStruct(tuple(shape),
+                                                  np.dtype(dtype)))
+        lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
+        rng = default_generator().fold_in(self.optimizer._step_count)
+        return self._aot_compile(specs, lr, rng, force=True)
+
     # -- public ---------------------------------------------------------------
     def train_step(self, *batch):
         from ..core.generator import default_generator
 
         t_step = time.perf_counter()
         batch_arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(np.asarray(b)) for b in batch]
-        if self._compiled is None:
-            if _monitor.is_enabled():
-                _COMPILE_CACHE.labels(site="trainer", event="miss",
-                                      sig=_batch_sig_label(batch_arrays)).inc()
-            with _RecordEvent("trainer/compile"), \
-                    _monitor.timed(_COMPILE_MS.labels(site="trainer")):
-                self._compiled = self._build(batch_arrays)
-            _COMPILES.labels(site="trainer").inc()
-        elif _monitor.is_enabled():
-            _COMPILE_CACHE.labels(site="trainer", event="hit",
-                                  sig=_batch_sig_label(batch_arrays)).inc()
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
         # fresh per-step randomness (dropout etc.): deterministic under
         # paddle.seed, varies per step — a trace-time key would bake ONE
         # dropout mask into the compiled program
         rng = default_generator().fold_in(self.optimizer._step_count)
+        compiled = self._compiled_store.get(self._batch_sig_key(batch_arrays))
+        if compiled is None:
+            self._aot_compile(batch_arrays, lr, rng)
+            compiled = self._compiled
+        elif _monitor.is_enabled():
+            _aot.record_compile("trainer", _batch_sig_label(batch_arrays),
+                                "memory")
         if self.localsgd_k or self._is_dgc():
-            loss, self.params, self.opt_state, self.buffers = self._compiled(
+            loss, self.params, self.opt_state, self.buffers = compiled(
                 self.params, self.opt_state, self.buffers, lr, rng, *batch_arrays
             )
             self.optimizer._step_count += 1
             return self._finish_step(loss, t_step)
         if self.return_outputs:  # ctor rejects localsgd/dgc combinations
-            loss, self.params, self.opt_state, self.buffers, outs = self._compiled(
+            loss, self.params, self.opt_state, self.buffers, outs = compiled(
                 self.params, self.opt_state, self.buffers, lr, rng, *batch_arrays
             )
             self.last_outputs = jax.tree_util.tree_map(Tensor, outs)
         else:
-            loss, self.params, self.opt_state, self.buffers = self._compiled(
+            loss, self.params, self.opt_state, self.buffers = compiled(
                 self.params, self.opt_state, self.buffers, lr, rng, *batch_arrays
             )
         self.optimizer._step_count += 1
-        if isinstance(self.optimizer._lr, object) and hasattr(self.optimizer._lr, "step"):
-            pass  # LR schedulers advance via user calls (paddle semantics)
         return self._finish_step(loss, t_step)
 
     def _finish_step(self, loss, t_step):

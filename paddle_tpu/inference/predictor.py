@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from ..core.tape import global_tape
 from ..core.tensor import Tensor
+from ..framework import aot as _aot
 
 
 class Config:
@@ -67,7 +68,7 @@ class Predictor:
         self._inputs = {}
         self._outputs = {}
         self._layer = None
-        self._compiled = {}
+        self._compiled = None  # CachedJit over _pure_fn (per-shape inside)
         self._input_names = ["input_0"]
         self._load()
 
@@ -100,6 +101,7 @@ class Predictor:
             self._load_pickled_layer(path)
 
     def _load_pickled_layer(self, path):
+        self._compiled = None  # a (re)loaded layer invalidates compiled fns
         if path and os.path.exists(path + ".pdmodel"):
             with open(path + ".pdmodel", "rb") as f:
                 self._layer = pickle.load(f)
@@ -158,10 +160,13 @@ class Predictor:
                 if self._layer is None:
                     raise
                 self._aot = None
-        key = tuple((a.shape, str(a.dtype)) for a in arrs)
-        if key not in self._compiled:
-            self._compiled[key] = jax.jit(self._pure_fn())
-        out = self._compiled[key](*[jnp.asarray(a) for a in arrs])
+        if self._compiled is None:
+            # one wrapper, one per-shape executable map inside; compiles
+            # go through the persistent AOT cache when
+            # FLAGS_jit_cache_dir is set (framework/aot.py)
+            self._compiled = _aot.cached_jit(
+                self._pure_fn(), site="predictor", label="predictor_run")
+        out = self._compiled(*[jnp.asarray(a) for a in arrs])
         return self._pack_outputs(out)
 
     def _pure_fn(self):

@@ -55,6 +55,7 @@ import numpy as np
 
 from .. import monitor as _monitor
 from ..core.tensor import Tensor
+from ..framework import aot as _aot
 
 __all__ = ["ServingEngine", "Request"]
 
@@ -367,57 +368,74 @@ class ServingEngine:
             logits = logits_of(p, x[:, 0]).astype(jnp.float32)
             return _pick(logits, temps, kvec, pvec, seeds, pos_vec), kc, vc
 
+        # every program in the family goes through the persistent AOT
+        # compile cache (framework/aot.py): with FLAGS_jit_cache_dir set,
+        # a fresh server process deserializes executables instead of
+        # re-jitting the whole family; warmup() compiles them from shape
+        # specs before traffic. Flag unset = plain jax.jit behavior.
+        _mesh_fp = _aot.mesh_fingerprint(tp_mesh)
+
+        def _cj(fn=None, label=None, jit=None, donate=()):
+            return _aot.cached_jit(fn, jit=jit, site="serving", label=label,
+                                   donate_argnums=donate,
+                                   record_event="serving/compile",
+                                   extra_key=(_mesh_fp,))
+
         # donate the big cache through admit/step: XLA aliases it in place
         # instead of copying GBs of K/V per token (the loop this engine
         # exists to make fast); CPU backends that can't donate just warn
         if tp_mesh is None:
-            self._prefill = jax.jit(prefill)
-            self._step_greedy = jax.jit(step_greedy, donate_argnums=(1, 2))
-            self._step_sample = jax.jit(step_sample, donate_argnums=(1, 2))
+            self._prefill = _cj(prefill, "prefill")
+            self._step_greedy = _cj(step_greedy, "step_greedy",
+                                    donate=(1, 2))
+            self._step_sample = _cj(step_sample, "step_sample",
+                                    donate=(1, 2))
         else:
             from jax.sharding import PartitionSpec as P
 
             from ..models.gpt import _tp_wrap
 
             cs = self._cache_spec   # pytree-prefix: covers int8 tuples too
-            self._prefill = _tp_wrap(prefill, tp_mesh, tp_specs, 0,
-                                     (cs, cs, P()),
-                                     in_specs=(tp_specs, P(), P()))
-            self._step_greedy = _tp_wrap(
+            self._prefill = _cj(jit=_tp_wrap(
+                prefill, tp_mesh, tp_specs, 0, (cs, cs, P()),
+                in_specs=(tp_specs, P(), P())), label="prefill")
+            self._step_greedy = _cj(jit=_tp_wrap(
                 step_greedy, tp_mesh, tp_specs, 0, (P(), cs, cs),
-                in_specs=(tp_specs, cs, cs, P(), P()), donate=(1, 2))
-            self._step_sample = _tp_wrap(
+                in_specs=(tp_specs, cs, cs, P(), P()), donate=(1, 2)),
+                label="step_greedy")
+            self._step_sample = _cj(jit=_tp_wrap(
                 step_sample, tp_mesh, tp_specs, 0, (P(), cs, cs),
                 in_specs=(tp_specs, cs, cs, P(), P(), P(), P(), P(), P()),
-                donate=(1, 2))
+                donate=(1, 2)), label="step_sample")
             # chunked prefill composes with tp: the chunk side-cache
             # allocates head-sharded (side_alloc above) and the chunk
             # program runs inside the same shard_map recipe
             self._prefill_start = side_alloc
-            self._prefill_chunk = _tp_wrap(
+            self._prefill_chunk = _cj(jit=_tp_wrap(
                 prefill_chunk_fn, tp_mesh, tp_specs, 0, (cs, cs, P()),
                 in_specs=(tp_specs, P(), P(), cs, cs, P()),
-                donate=(3, 4))
+                donate=(3, 4)), label="prefill_chunk")
         # admit slices only the batch axis: a plain jit partitions it
         # fine over the head-sharded cache
-        self._admit = jax.jit(admit, donate_argnums=(0,))
+        self._admit = _cj(admit, "admit", donate=(0,))
         # the prefill token goes through the SAME pick as decode steps
-        self._pick1 = jax.jit(lambda lg, t, k, tp, s, p_: _pick(
-            lg[None], t[None], k[None], tp[None], s[None], p_[None])[0])
+        self._pick1 = _cj(lambda lg, t, k, tp, s, p_: _pick(
+            lg[None], t[None], k[None], tp[None], s[None], p_[None])[0],
+            "pick1")
 
         self._chunk = None if prefill_chunk is None else int(prefill_chunk)
         if tp_mesh is None:
             self._prefill_start = prefill_start
-            self._prefill_chunk = jax.jit(prefill_chunk_fn,
-                                          donate_argnums=(3, 4))
+            self._prefill_chunk = _cj(prefill_chunk_fn, "prefill_chunk",
+                                      donate=(3, 4))
         # slot -> [req, kc1, vc1, consumed_offset, chunk_width]
         self._prefilling = {}
         # registered shared prefixes: pid -> (ids, kc1, vc1). The chunk fn
         # DONATES its cache args, so admissions consume a fresh COPY
         self._prefixes = {}
         self._next_pid = 0
-        self._copy_cache = jax.jit(
-            lambda c: jax.tree_util.tree_map(jnp.array, c))
+        self._copy_cache = _cj(
+            lambda c: jax.tree_util.tree_map(jnp.array, c), "copy_cache")
 
         # --- speculative decoding: a draft model proposes spec_k tokens
         # per round, the target verifies them in ONE multi-token forward
@@ -505,22 +523,22 @@ class ServingEngine:
 
             self._draft = draft_model
             self._draft_row = draft_row
-            self._draft_sync = jax.jit(draft_sync, donate_argnums=(1, 2))
-            self._draft_feed = jax.jit(draft_feed, donate_argnums=(3, 4))
-            self._draft_propose = jax.jit(draft_propose,
-                                          donate_argnums=(1, 2))
+            self._draft_sync = _cj(draft_sync, "draft_sync", donate=(1, 2))
+            self._draft_feed = _cj(draft_feed, "draft_feed", donate=(3, 4))
+            self._draft_propose = _cj(draft_propose, "draft_propose",
+                                      donate=(1, 2))
             if tp_mesh is None:
-                self._verify = jax.jit(verify, donate_argnums=(1, 2))
+                self._verify = _cj(verify, "verify", donate=(1, 2))
             else:
                 from jax.sharding import PartitionSpec as P
 
                 from ..models.gpt import _tp_wrap
 
                 cs = self._cache_spec
-                self._verify = _tp_wrap(
+                self._verify = _cj(jit=_tp_wrap(
                     verify, tp_mesh, tp_specs, 0, (P(), P(), cs, cs),
                     in_specs=(tp_specs, cs, cs, P(), P(), P()),
-                    donate=(1, 2))
+                    donate=(1, 2)), label="verify")
 
         # engine-local observability accumulators (the module-level monitor
         # metrics aggregate across engines; stats() reports THIS engine)
@@ -574,6 +592,99 @@ class ServingEngine:
         self._next_pid += 1
         self._prefixes[pid] = (ids, kc1, vc1, kc1d, vc1d)
         return pid
+
+    def warmup(self, batch_shapes=None, sampling=True):
+        """Compile the engine's whole jitted program family BEFORE traffic,
+        from shape specs only — no real prompts, nothing executed, the KV
+        cache untouched. With FLAGS_jit_cache_dir set the executables load
+        from (or persist into) the on-disk AOT cache, so a fresh server
+        process performs zero XLA compiles; without the flag the programs
+        are still AOT-compiled in memory (submit/step then pay none).
+
+        batch_shapes: iterable of prompt lengths to warm prefill buckets
+        for (bucketed exactly like submit(); default: every configured
+        bucket). sampling=False skips the sampling decode step for
+        all-greedy deployments. Returns {program: warmed-signature count}.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def aval(t):
+            return jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    a.shape, a.dtype, sharding=getattr(a, "sharding", None)),
+                t)
+
+        def f32(shape=()):
+            return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+        def i32(shape=()):
+            return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+        counts = {}
+
+        def warm(cj, *specs):
+            counts[cj._label] = counts.get(cj._label, 0) + \
+                (1 if cj.warm(*specs) else 0)
+
+        B, V = self.B, self.cfg.vocab_size
+        p = aval(self._params)
+        kc, vc = aval(self._kc), aval(self._vc)
+        kc1, vc1 = jax.eval_shape(lambda: self._prefill_start())
+        lg_spec = f32((V,))
+        if self._tp_mesh is not None:
+            # eval_shape drops out_shardings: re-attach the head-sharded
+            # side-cache placement (same every-leaf recipe as the ctor's
+            # side_alloc) or the warmed executables would be compiled for
+            # unsharded rows and rejected at first admission. The prefill
+            # logits likewise arrive mesh-replicated, so pick1's spec
+            # must carry that placement too.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sh = NamedSharding(self._tp_mesh, self._cache_spec)
+            reshard = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                               sharding=sh), t)
+            kc1, vc1 = reshard(kc1), reshard(vc1)
+            lg_spec = jax.ShapeDtypeStruct(
+                (V,), jnp.float32,
+                sharding=NamedSharding(self._tp_mesh, P()))
+        lens = (list(batch_shapes) if batch_shapes is not None
+                else list(self._buckets))
+        buckets = sorted({self._bucket(int(n)) for n in lens})
+        for pb in buckets:
+            warm(self._prefill, p, i32((1, pb)), i32())
+        warm(self._step_greedy, p, kc, vc, i32((B,)), i32((B,)))
+        if sampling:
+            warm(self._step_sample, p, kc, vc, i32((B,)), i32((B,)),
+                 f32((B,)), i32((B,)), f32((B,)), i32((B,)))
+        warm(self._pick1, lg_spec, f32(), i32(), f32(), i32(), i32())
+        # slot index rides as a weakly-typed python int, exactly as the
+        # live _activate call passes it
+        warm(self._admit, kc, kc1, 0)
+        warm(self._copy_cache, kc1)
+        if self._chunk is not None:
+            warm(self._prefill_chunk, p, i32((1, self._chunk)), i32(),
+                 kc1, vc1, i32())
+        if self._draft is not None:
+            pd = aval(self._params_d)
+            kcd, vcd = aval(self._kc_d), aval(self._vc_d)
+            kc1d, vc1d = jax.eval_shape(self._draft_row)
+            for pb in buckets:
+                warm(self._draft_feed, pd, i32((1, pb)), i32(), kc1d, vc1d)
+            if self._chunk is not None:
+                warm(self._draft_feed, pd, i32((1, self._chunk)), i32(),
+                     kc1d, vc1d)
+            warm(self._draft_propose, pd, kcd, vcd, i32((B,)), i32((B,)))
+            warm(self._verify, p, kc, vc, i32((B,)), i32((B,)),
+                 i32((B, self._spec_k)))
+            warm(self._draft_sync, pd, kcd, vcd, i32((B,)), i32((B,)))
+            # admissions also row-copy into the DRAFT cache (its shapes
+            # differ from the target's) and prefix reuse copies draft
+            # side caches — warm those signatures too
+            warm(self._admit, kcd, kc1d, 0)
+            warm(self._copy_cache, kc1d)
+        return counts
 
     def _count_step(self, kind):
         self._m["steps"][kind] = self._m["steps"].get(kind, 0) + 1

@@ -357,6 +357,9 @@ def set_verbosity(level=0, also_to_stdout=False):
     os.environ["PADDLE_TPU_D2S_VERBOSITY"] = str(level)
 
 
+from . import aot  # noqa: E402,F401  (persistent AOT compile-cache façade)
+
+
 class ProgramTranslator:
     """Compat singleton (dygraph_to_static ProgramTranslator): enable()
     toggles whether @to_static transforms or falls straight through."""

@@ -21,7 +21,11 @@ Instrumented hot paths (each records into the DEFAULT registry):
   per-request ``Request.stats()`` / engine ``ServingEngine.stats()``);
 - ``distributed.collective.*`` — call count + payload bytes by HLO
   family (analysis/collectives.py naming);
-- ``framework.io.save/load`` — checkpoint count, wall time, bytes.
+- ``framework.io.save/load`` — checkpoint count, wall time, bytes;
+- ``framework.aot`` — the persistent AOT compile cache: the shared
+  ``compile_cache_total`` family carries a ``source=memory|disk|fresh``
+  label, plus serialize/deserialize latency + entry-size histograms and
+  store/evict counters (docs/AOT.md).
 
 Three exporters, one schema (docs/OBSERVABILITY.md):
 ``snapshot()`` JSON dict -> ``to_json`` / ``to_prometheus`` text /

@@ -28,23 +28,18 @@ from .. import monitor as _monitor
 from ..core import dtype as dtype_mod
 from ..core import dispatch as _dispatch
 from ..core.tensor import Tensor, ParamBase
+from ..framework import aot as _aot
 from ..jit import InputSpec  # noqa: F401
 from ..profiler import RecordEvent as _RecordEvent
 
 _STATIC_MODE = [False]
 
-# shared-name metric families (site label: "executor" here, "trainer" in
-# distributed/spmd.py) — one snapshot schema covers both train paths
-_COMPILES = _monitor.counter(
-    "compile_total", "jit compiles of the recorded-program replay",
-    labelnames=("site",))
-_COMPILE_CACHE = _monitor.counter(
-    "compile_cache_total",
-    "jit-cache lookups by feed-signature (event: hit|miss)",
-    labelnames=("site", "event", "sig"))
+# compile_total/compile_cache_total are declared (and recorded) by
+# framework/aot.py's record_compile — one mapping for every site; this
+# module reports under site="executor" with the feed-signature label
 _COMPILE_MS = _monitor.histogram(
-    "compile_ms", "wall time of one jit compile (trace+lower handoff)",
-    labelnames=("site",))
+    "compile_ms", "wall time to obtain an executable (fresh compile, or "
+    "lower+deserialize on an AOT-cache hit)", labelnames=("site",))
 _STEP_MS = _monitor.histogram(
     "step_latency_ms",
     "Executor.run / train_step wall time (host dispatch; device-complete "
@@ -63,6 +58,12 @@ def _feed_sig_label(sig):
     return "|".join(
         f"{k}:{dt}[{','.join(str(d) for d in shape)}]"
         for k, shape, dt in sig)
+
+
+def _record_compile(sig, source):
+    """Executor compile-cache telemetry — the shared aot.record_compile
+    mapping under site=executor with the feed-signature label."""
+    _aot.record_compile("executor", _feed_sig_label(sig), source)
 
 
 def enable_static():
@@ -304,6 +305,58 @@ class Program:
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         return jax.make_jaxpr(fn)(params, opt_state, lr, feed)
 
+    def aot_compile(self, feed_specs, fetch_list=None):
+        """Warm-start: compile the EXACT executable Executor.run would jit
+        for this feed signature — from shape specs, no real batch — and
+        park it in the program's jit cache (plus the on-disk AOT cache
+        when FLAGS_jit_cache_dir is set).
+
+            prog.aot_compile({"x": ((8, 13), "float32"),
+                              "y": ((8, 1), "float32")},
+                             fetch_list=[loss])
+
+        feed_specs: {name: (shape, dtype) | InputSpec | ShapeDtypeStruct}.
+        fetch_list defaults to the attached loss (train programs) or the
+        last recorded op's outputs — pass the same fetch_list the serving
+        run will use, since the cache key includes the fetch set. A
+        program with an optimizer attached compiles the TRAIN step.
+        Works without the disk flag too (in-memory AOT). Returns where
+        the executable came from: "memory"|"disk"|"fresh"."""
+        feed = {}
+        for name in sorted(feed_specs):
+            spec = feed_specs[name]
+            if isinstance(spec, jax.ShapeDtypeStruct):
+                shape, dtype = spec.shape, spec.dtype
+            elif isinstance(spec, InputSpec):
+                shape, dtype = spec.shape, spec.dtype
+            else:
+                shape, dtype = spec
+            feed[name] = jax.ShapeDtypeStruct(
+                tuple(shape), dtype_mod.convert_dtype(dtype))
+        self._ensure_scope()
+        exe = Executor()
+        if fetch_list:
+            fetch_ids = tuple(exe._fetch_id(self, f) for f in fetch_list)
+        elif self._loss_id is not None:
+            fetch_ids = (self._loss_id,)
+        elif self.ops:
+            fetch_ids = tuple(self.ops[-1].out_ids)
+        else:
+            raise ValueError("aot_compile: empty program (no recorded ops) "
+                             "and no fetch_list")
+        train, sig, key, lr, example = _exec_key_and_example(
+            self, feed, fetch_ids)
+        if key in self._exec_cache:
+            _record_compile(sig, "memory")  # warm audits count this too
+            return "memory"
+        with _RecordEvent("executor/compile"), \
+                _monitor.timed(_COMPILE_MS.labels(site="executor")):
+            compiled, source = exe._compile(self, tuple(feed), fetch_ids,
+                                            train, example, force=True)
+        self._exec_cache[key] = compiled
+        _record_compile(sig, source)
+        return source
+
 
 _default_main = [Program()]
 _default_startup = [Program()]
@@ -384,6 +437,35 @@ _dispatch._STATIC_REBIND[0] = _rebind_hook
 
 
 # -- execution -----------------------------------------------------------------
+
+def _exec_key_and_example(program, feed, fetch_ids):
+    """The ONE source of the executor's jit-cache key and AOT example
+    args, shared by Executor._run_program and Program.aot_compile so a
+    warm-started entry is exactly the one run() later looks up. `feed`
+    maps name -> array or ShapeDtypeStruct in canonical (sorted) order;
+    materializes optimizer state (train programs) as a side effect.
+    Returns (train, sig, key, lr, example_args)."""
+    train = program._optimizer is not None and program._loss_id is not None
+    sig = tuple((k, v.shape, str(v.dtype)) for k, v in feed.items())
+    key = (program._version, train, fetch_ids, sig)
+    scope = program._scope
+    lr = None
+    if train:
+        # optimizer state materializes BEFORE compile: the AOT path
+        # lowers against the live (params, opt_state, lr, feed) values
+        opt = program._optimizer
+        if scope["opt_state"] is None:
+            scope["opt_state"] = opt.functional_init(scope["params"])
+        else:
+            for n, v in scope["params"].items():
+                if n not in scope["opt_state"]:
+                    scope["opt_state"][n] = opt.functional_init({n: v})[n]
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        example = (scope["params"], scope["opt_state"], lr, feed)
+    else:
+        example = (scope["params"], feed)
+    return train, sig, key, lr, example
+
 
 def _slice_ops(program, target_ids):
     """Backward slice: only ops the targets (+loss) actually need run."""
@@ -496,39 +578,30 @@ class Executor:
         t_step = time.perf_counter()
         program._ensure_scope()
         fetch_ids = tuple(self._fetch_id(program, f) for f in fetch_list)
-        train = program._optimizer is not None and program._loss_id is not None
-        feed_arrays = {k: jnp.asarray(np.asarray(v)) for k, v in feed.items()}
-        sig = tuple(sorted((k, v.shape, str(v.dtype))
-                           for k, v in feed_arrays.items()))
+        # canonical (sorted) feed order: the jit-cache key sorts the
+        # signature, so the compiled closure must be built from the same
+        # order — otherwise two insertion orders of the same feed dict
+        # alias one cache entry built from whichever order arrived first
+        feed_arrays = {k: jnp.asarray(np.asarray(feed[k]))
+                       for k in sorted(feed)}
+        train, sig, key, lr, example = _exec_key_and_example(
+            program, feed_arrays, fetch_ids)
         # cache lives ON the program (not the executor) so dropped programs
         # release their compiled closures and baked arrays with them
         cache = program._exec_cache
-        key = (program._version, train, fetch_ids, sig)
+        scope = program._scope
         if key not in cache:
-            if _monitor.is_enabled():
-                _COMPILE_CACHE.labels(site="executor", event="miss",
-                                      sig=_feed_sig_label(sig)).inc()
             with _RecordEvent("executor/compile"), \
                     _monitor.timed(_COMPILE_MS.labels(site="executor")):
-                cache[key] = self._compile(program, tuple(feed_arrays),
-                                           fetch_ids, train)
-            _COMPILES.labels(site="executor").inc()
-        elif _monitor.is_enabled():
-            _COMPILE_CACHE.labels(site="executor", event="hit",
-                                  sig=_feed_sig_label(sig)).inc()
+                cache[key], source = self._compile(
+                    program, tuple(feed_arrays), fetch_ids, train, example)
+            _record_compile(sig, source)
+        else:
+            _record_compile(sig, "memory")
         compiled = cache[key]
-        scope = program._scope
         with _RecordEvent("executor/run"):
             if train:
                 opt = program._optimizer
-                if scope["opt_state"] is None:
-                    scope["opt_state"] = opt.functional_init(scope["params"])
-                else:
-                    for n, v in scope["params"].items():
-                        if n not in scope["opt_state"]:
-                            scope["opt_state"][n] = \
-                                opt.functional_init({n: v})[n]
-                lr = jnp.asarray(opt.get_lr(), jnp.float32)
                 new_p, new_s, fetches = compiled(scope["params"],
                                                  scope["opt_state"], lr,
                                                  feed_arrays)
@@ -556,9 +629,19 @@ class Executor:
             return [np.asarray(f) for f in fetches]
         return [Tensor(f) for f in fetches]
 
-    def _compile(self, program, feed_names, fetch_ids, train):
-        return jax.jit(_build_program_fn(program, feed_names, fetch_ids,
-                                         train))
+    def _compile(self, program, feed_names, fetch_ids, train, example_args,
+                 force=False):
+        """jit the pure replay; with FLAGS_jit_cache_dir set, compile it
+        eagerly through the persistent executable cache (framework/aot.py).
+        Returns (callable, source: bypass|disk|fresh); `example_args` may
+        mix live arrays and jax.ShapeDtypeStructs. force=True (aot_compile)
+        compiles eagerly even without a cache dir — warm-start must never
+        hand back a lazy jit."""
+        jitted = jax.jit(_build_program_fn(program, feed_names, fetch_ids,
+                                           train))
+        return _aot.compile_cached(jitted, example_args, site="executor",
+                                   extra_key=("executor", train),
+                                   force=force)
 
 
 def _build_program_fn(program, feed_names, fetch_ids, train):

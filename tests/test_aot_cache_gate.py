@@ -1,0 +1,212 @@
+"""Tier-1 gate for the persistent AOT compile cache (ISSUE 3): with
+FLAGS_jit_cache_dir UNSET every compile site behaves exactly as before —
+no lowering, no hashing, no disk I/O, and per-call wrapper overhead
+bounded like the monitor's disabled fast path. Plus: tools/aot_warm.py
+--json exits 1 when any site fails to serialize."""
+import importlib.util
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.framework import aot
+
+
+@pytest.fixture(autouse=True)
+def _flag_unset():
+    paddle.set_flags({"jit_cache_dir": ""})
+    yield
+    paddle.set_flags({"jit_cache_dir": ""})
+
+
+def _forbid_disk(monkeypatch):
+    """Any touch of the cache machinery while the flag is unset is a
+    regression — the zero-overhead contract."""
+    def boom(*a, **k):
+        raise AssertionError("AOT cache machinery ran with "
+                             "FLAGS_jit_cache_dir unset")
+    monkeypatch.setattr(aot, "_load_entry", boom)
+    monkeypatch.setattr(aot, "_store_entry", boom)
+    monkeypatch.setattr(aot, "_cache_key", boom)
+
+
+class TestFlagUnsetIsExactlyBefore:
+    def test_compile_cached_returns_the_jit_untouched(self, monkeypatch):
+        _forbid_disk(monkeypatch)
+        jitted = jax.jit(lambda a: a + 1)
+        got, source = aot.compile_cached(jitted, (jnp.ones(3),), site="t")
+        assert got is jitted and source == "bypass"
+
+    def test_executor_and_trainer_paths_do_no_disk_io(self, monkeypatch):
+        _forbid_disk(monkeypatch)
+        import paddle_tpu.static as st
+        from paddle_tpu.distributed.mesh import build_mesh
+        from paddle_tpu.distributed.spmd import SpmdTrainer
+
+        # executor
+        paddle.seed(0)
+        main, startup = st.Program(), st.Program()
+        st.enable_static()
+        try:
+            with st.program_guard(main, startup):
+                x = st.data("x", [None, 4])
+                w = paddle.create_parameter([4, 4])
+                y = paddle.matmul(x, w)
+        finally:
+            st.disable_static()
+        exe = st.Executor()
+        exe.run(startup)
+        (r,) = exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                       fetch_list=[y])
+        assert np.isfinite(r).all()
+        # trainer (1-layer linear regression keeps this cheap)
+        model = paddle.nn.Linear(4, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+        tr = SpmdTrainer(model, opt, loss_fn=paddle.nn.MSELoss(), mesh=mesh)
+        loss = tr.train_step(np.ones((2, 4), np.float32),
+                             np.zeros((2, 1), np.float32))
+        assert np.isfinite(float(np.asarray(loss._data)))
+        # serving-style wrapper
+        cj = aot.cached_jit(lambda a: a * 2, site="t", label="gate")
+        np.testing.assert_array_equal(np.asarray(cj(jnp.ones(3))),
+                                      np.full(3, 2.0))
+
+    def test_metrics_identical_to_before(self):
+        """Flag unset: the executor still reports miss(fresh)/hit(memory)
+        exactly as the pre-AOT instrumentation did — one fresh compile,
+        then memory hits (no disk series anywhere)."""
+        import paddle_tpu.static as st
+
+        monitor.reset()
+        paddle.seed(0)
+        main, startup = st.Program(), st.Program()
+        st.enable_static()
+        try:
+            with st.program_guard(main, startup):
+                x = st.data("x", [None, 4])
+                w = paddle.create_parameter([4, 4])
+                y = paddle.matmul(x, w)
+        finally:
+            st.disable_static()
+        exe = st.Executor()
+        exe.run(startup)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        exe.run(main, feed=feed, fetch_list=[y])
+        exe.run(main, feed=feed, fetch_list=[y])
+        cache = monitor.counter("compile_cache_total",
+                                labelnames=("site", "event", "sig",
+                                            "source"))
+        sig = "x:float32[2,4]"
+        assert cache.labels(site="executor", event="miss", sig=sig,
+                            source="fresh").value == 1
+        assert cache.labels(site="executor", event="hit", sig=sig,
+                            source="memory").value == 1
+        metric = monitor.default_registry().get("compile_cache_total")
+        assert not any(s.labels.get("source") == "disk"
+                       for s in metric.series())
+
+    def test_aot_compile_forces_in_memory_without_flag(self, monkeypatch):
+        """Warm-start must never hand back a lazy jit: Program.aot_compile
+        with the flag unset still AOT-compiles (in memory, zero disk) and
+        the later run() pays no compile."""
+        _forbid_disk(monkeypatch)
+        import paddle_tpu.static as st
+
+        paddle.seed(0)
+        main, startup = st.Program(), st.Program()
+        st.enable_static()
+        try:
+            with st.program_guard(main, startup):
+                x = st.data("x", [None, 4])
+                w = paddle.create_parameter([4, 4])
+                y = paddle.matmul(x, w)
+        finally:
+            st.disable_static()
+        exe = st.Executor()
+        exe.run(startup)
+        assert main.aot_compile({"x": ((2, 4), "float32")},
+                                fetch_list=[y]) == "fresh"
+        compiles = monitor.counter("compile_total", labelnames=("site",))
+        before = compiles.labels(site="executor").value
+        (r,) = exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                       fetch_list=[y])
+        assert np.isfinite(r).all()
+        assert compiles.labels(site="executor").value == before
+
+    def test_wrapper_disabled_overhead(self):
+        """The CachedJit fast path (flag unset, nothing warmed) must cost
+        one empty-dict + flag check per call — same bar and method as
+        test_monitor_disabled_overhead (<5us/call against a no-op target,
+        ~25x the expected cost)."""
+        import time
+
+        sink = []
+        cj = aot.cached_jit(jit=sink.append, site="t", label="overhead")
+        n = 100_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            cj(None)
+        per_call_us = (time.perf_counter() - t0) / n * 1e6
+        assert per_call_us < 5.0, (
+            f"CachedJit disabled path costs {per_call_us:.2f}us/call — "
+            "the flag-unset fast path regressed")
+        assert len(sink) == n  # every call actually delegated
+
+
+class TestAotWarmTool:
+    def _load(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "aot_warm", os.path.join(repo, "tools", "aot_warm.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules.pop("aot_warm", None)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_no_cache_dir_is_an_error(self):
+        aw = self._load()
+        assert aw.main(["--model", "gpt", "--json"]) == 1
+
+    def test_serialize_failure_exits_1(self, tmp_path, monkeypatch, capsys):
+        """The CI contract: any site whose executable cannot be
+        serialized must fail the warm run (a deploy would silently
+        recompile otherwise)."""
+        import json
+
+        aw = self._load()
+
+        def broken(compiled):
+            raise ValueError("serialization intentionally broken")
+        import jax.experimental.serialize_executable as se
+
+        monkeypatch.setattr(se, "serialize", broken)
+        rc = aw.main(["--model", "gpt", "--json",
+                      "--cache-dir", str(tmp_path / "aot")])
+        paddle.set_flags({"jit_cache_dir": ""})
+        assert rc == 1
+        report = json.loads(capsys.readouterr().out)
+        assert report["totals"]["error"] >= 1
+        msgs = [f["message"] for f in report["targets"]["gpt"]["findings"]
+                if f["severity"] == "error"]
+        assert any("serialize" in m for m in msgs)
+
+    def test_warm_then_report_clean(self, tmp_path, capsys):
+        import json
+
+        aw = self._load()
+        rc = aw.main(["--model", "gpt", "--json",
+                      "--cache-dir", str(tmp_path / "aot")])
+        paddle.set_flags({"jit_cache_dir": ""})
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert set(report) >= {"tool", "passes", "targets", "totals"}
+        assert report["totals"]["error"] == 0
+        assert os.listdir(str(tmp_path / "aot"))

@@ -387,16 +387,17 @@ class TestExecutorInstrumentation:
         exe.run(startup)
         feed = {"x": np.ones((2, 4), np.float32)}
         cache = monitor.counter("compile_cache_total",
-                                labelnames=("site", "event", "sig"))
+                                labelnames=("site", "event", "sig",
+                                            "source"))
         steps = monitor.histogram("step_latency_ms", labelnames=("site",))
         sig = "x:float32[2,4]"
         before = steps.labels(site="executor").count
         exe.run(main, feed=feed, fetch_list=[y])
         exe.run(main, feed=feed, fetch_list=[y])
         assert cache.labels(site="executor", event="miss",
-                            sig=sig).value == 1
+                            sig=sig, source="fresh").value == 1
         assert cache.labels(site="executor", event="hit",
-                            sig=sig).value == 1
+                            sig=sig, source="memory").value == 1
         assert steps.labels(site="executor").count == before + 2
         assert monitor.counter(
             "compile_total", labelnames=("site",)).labels(
@@ -405,7 +406,7 @@ class TestExecutorInstrumentation:
         exe.run(main, feed={"x": np.ones((3, 4), np.float32)},
                 fetch_list=[y])
         assert cache.labels(site="executor", event="miss",
-                            sig="x:float32[3,4]").value == 1
+                            sig="x:float32[3,4]", source="fresh").value == 1
 
     def test_flags_benchmark_counts_syncs(self):
         import paddle_tpu.static as st

@@ -103,21 +103,56 @@ class TestFitALine:
         exe = paddle.static.Executor()
         exe.run(startup)
         cache = monitor.counter("compile_cache_total",
-                                labelnames=("site", "event", "sig"))
+                                labelnames=("site", "event", "sig",
+                                            "source"))
         xs = np.random.rand(8, 13).astype(np.float32)
         ys = np.random.rand(8, 1).astype(np.float32)
         for _ in range(3):
             exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
         sig = "x:float32[8,13]|y:float32[8,1]"
-        assert cache.labels(site="executor", event="miss", sig=sig).value == 1
-        assert cache.labels(site="executor", event="hit", sig=sig).value == 2
+        assert cache.labels(site="executor", event="miss", sig=sig,
+                            source="fresh").value == 1
+        assert cache.labels(site="executor", event="hit", sig=sig,
+                            source="memory").value == 2
         exe.run(main, feed={"x": xs[:4], "y": ys[:4]}, fetch_list=[loss])
         assert cache.labels(site="executor", event="miss",
-                            sig="x:float32[4,13]|y:float32[4,1]").value == 1
+                            sig="x:float32[4,13]|y:float32[4,1]",
+                            source="fresh").value == 1
         assert monitor.counter("compile_total", labelnames=("site",)) \
             .labels(site="executor").value == 2
         assert monitor.histogram("step_latency_ms", labelnames=("site",)) \
             .labels(site="executor").count == 4
+
+    def test_feed_dict_order_is_canonicalized(self):
+        """Regression: the jit-cache key sorts the feed signature, but the
+        compiled closure used to be built from dict INSERTION order — two
+        insertion orders of the same feeds aliased one cache entry. Feeds
+        are now sorted before compile, so both orders share one compile
+        AND produce identical results."""
+        from paddle_tpu import monitor
+
+        monitor.reset()
+        main, startup, test_prog, x, y, pred, loss = _build_fit_a_line()
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        xs = np.random.rand(8, 13).astype(np.float32)
+        ys = np.random.rand(8, 1).astype(np.float32)
+        fwd = {"x": xs, "y": ys}
+        rev = {"y": ys, "x": xs}
+        assert list(fwd) != list(rev)  # genuinely different insertion order
+        (l1,) = exe.run(main, feed=fwd, fetch_list=[loss])
+        # reversed-order feed must hit the same cache entry and stay
+        # correct (it replays through the sorted closure)
+        (l2,) = exe.run(main, feed=rev, fetch_list=[loss])
+        assert np.isfinite(float(l1)) and np.isfinite(float(l2))
+        cache = monitor.counter("compile_cache_total",
+                                labelnames=("site", "event", "sig",
+                                            "source"))
+        sig = "x:float32[8,13]|y:float32[8,1]"
+        assert cache.labels(site="executor", event="miss", sig=sig,
+                            source="fresh").value == 1
+        assert cache.labels(site="executor", event="hit", sig=sig,
+                            source="memory").value == 1
 
 
 class TestStaticMnistMLP:

@@ -1,0 +1,203 @@
+"""AOT warm CLI: pre-populate a persistent compile cache before traffic.
+
+    python tools/aot_warm.py --cache-dir /var/cache/paddle_tpu_aot --model gpt
+    python tools/aot_warm.py --cache-dir /var/cache/paddle_tpu_aot --serving
+    python tools/aot_warm.py --all --json        # cache dir from FLAGS env
+
+Each target compiles its site's executables from SHAPE SPECS only — no
+real batches, nothing executed — through the persistent AOT cache
+(paddle_tpu/framework/aot.py): ``SpmdTrainer.aot_build`` for the bundled
+models' train steps, ``ServingEngine.warmup`` for the serving program
+family. A later process (bench.py, a serving deploy) started with the
+same ``FLAGS_jit_cache_dir`` then deserializes executables instead of
+recompiling — the serve-deploy recipe in docs/AOT.md.
+
+--json emits the tools/graph_lint.py report schema ({"tool", "passes",
+"targets": {name: {"name", "counts", "findings"}}, "totals"}) so CI reads
+all the audit tools through one loader. Exit code 1 when any site failed
+to SERIALIZE an executable (aot_store_total{event="error"} moved — the
+compile still ran, but the cache gained nothing, which a warm-start
+deploy must treat as a failure) or when no cache dir is configured.
+
+Shapes are the CPU-shrunk tools/metrics_dump.py dims; a production warm
+run would import its real model config and call the same three APIs
+(aot_build / warmup / Program.aot_compile) directly.
+"""
+import argparse
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODEL_TARGETS = ("gpt", "bert", "ernie")
+
+# deliberately tiny: this tool demonstrates/pins the warm recipe; a
+# production warm run imports its real model config instead
+_DIMS = dict(vocab_size=128, hidden_size=32, num_layers=1, num_heads=2,
+             dropout=0.0)
+_B, _S = 2, 16
+
+
+def warm_train(name):
+    """AOT-build one bundled model's train step from batch specs; returns
+    where the executable came from (disk|fresh)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.models import (BertConfig, BertForPretraining,
+                                   BertPretrainLoss, ErnieConfig,
+                                   ErnieForPretraining, ErniePretrainLoss,
+                                   GPTConfig, GPTForCausalLM,
+                                   GPTPretrainLoss)
+
+    paddle.seed(0)
+    ids = ((_B, _S), "int32")
+    if name == "gpt":
+        model = GPTForCausalLM(GPTConfig(max_seq_len=64, **_DIMS))
+        loss, specs = GPTPretrainLoss(), [ids, ids]
+    elif name == "bert":
+        model = BertForPretraining(BertConfig(max_position=64,
+                                              intermediate_size=256, **_DIMS))
+        loss, specs = BertPretrainLoss(), [ids, ids, ids]
+    elif name == "ernie":
+        model = ErnieForPretraining(ErnieConfig(max_position=64,
+                                                intermediate_size=256,
+                                                **_DIMS))
+        loss, specs = ErniePretrainLoss(), [ids, ids, ids]
+    else:
+        raise ValueError(f"unknown model {name!r}; choose from "
+                         f"{MODEL_TARGETS}")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+    trainer = SpmdTrainer(model, opt, loss_fn=loss, mesh=mesh)
+    return {"train_step": trainer.aot_build(specs)}
+
+
+def warm_serving():
+    """Warm the ServingEngine program family from shape specs."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    model = GPTForCausalLM(GPTConfig(max_seq_len=64, **_DIMS))
+    model.eval()
+    eng = ServingEngine(model, max_batch=2)
+    return eng.warmup()
+
+
+def _store_counts():
+    """(ok, error) totals of aot_store_total across all sites."""
+    from paddle_tpu import monitor
+
+    ok = err = 0
+    metric = monitor.default_registry().get("aot_store_total")
+    if metric is not None:
+        for s in metric.series():
+            if s.labels.get("event") == "error":
+                err += int(s.value)
+            elif s.labels.get("event") == "ok":
+                ok += int(s.value)
+    return ok, err
+
+
+def run_target(name):
+    """Warm one target; returns findings in the graph_lint format."""
+    ok0, err0 = _store_counts()
+    findings = []
+    try:
+        detail = warm_serving() if name == "serving" else warm_train(name)
+    except Exception as e:
+        findings.append({
+            "pass": "aot-warm", "severity": "error",
+            "message": f"warmup raised {type(e).__name__}: {e}",
+            "where": name})
+        return findings
+    ok1, err1 = _store_counts()
+    if err1 > err0:
+        findings.append({
+            "pass": "aot-serialize", "severity": "error",
+            "message": f"{err1 - err0} executable(s) failed to serialize "
+                       "into the cache (compiled fine, but a warm-start "
+                       "deploy would recompile them)", "where": name})
+    for prog, got in sorted(detail.items()):
+        findings.append({"pass": "aot-warm", "severity": "info",
+                         "message": f"{prog}: {got}", "where": name})
+    findings.append({"pass": "aot-warm", "severity": "info",
+                     "message": f"cache entries written: {ok1 - ok0}",
+                     "where": name})
+    return findings
+
+
+def build_report(targets):
+    """The tools/graph_lint.py-schema report over the requested targets."""
+    from paddle_tpu.framework import aot
+
+    report = {"tool": "aot_warm", "passes": ["aot-warm", "aot-serialize"],
+              "targets": {}, "totals": {"error": 0, "warning": 0, "info": 0}}
+    for name in targets:
+        findings = []
+        if not aot.enabled():
+            findings.append({
+                "pass": "aot-warm", "severity": "error",
+                "message": "FLAGS_jit_cache_dir is not set — nothing to "
+                           "populate (pass --cache-dir or export "
+                           "FLAGS_jit_cache_dir)", "where": name})
+        else:
+            findings.extend(run_target(name))
+        counts = {"error": 0, "warning": 0, "info": 0}
+        for f in findings:
+            counts[f["severity"]] += 1
+        report["targets"][name] = {"name": name, "counts": counts,
+                                   "findings": findings}
+        for sev, n in counts.items():
+            report["totals"][sev] += n
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", choices=MODEL_TARGETS, action="append",
+                    default=[], help="warm one bundled model's train step")
+    ap.add_argument("--serving", action="store_true",
+                    help="warm the ServingEngine program family")
+    ap.add_argument("--all", action="store_true",
+                    help="all models + the serving family")
+    ap.add_argument("--cache-dir", default=None,
+                    help="sets FLAGS_jit_cache_dir for this run")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the graph_lint-schema machine report")
+    args = ap.parse_args(argv)
+
+    if args.cache_dir:
+        from paddle_tpu import flags
+
+        flags.set_flags({"jit_cache_dir": args.cache_dir})
+
+    targets = list(args.model)
+    if args.serving:
+        targets.append("serving")
+    if args.all:
+        targets = list(MODEL_TARGETS) + ["serving"]
+    if not targets:
+        ap.error("pick a target: --model NAME, --serving or --all")
+
+    report = build_report(targets)
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        for name, t in report["targets"].items():
+            c = t["counts"]
+            print(f"{name}: {c['error']} error(s), {c['info']} info")
+            for f in t["findings"]:
+                print(f"  [{f['severity']}] {f['pass']}: {f['message']}")
+    return 1 if report["totals"]["error"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
