@@ -81,6 +81,7 @@ from . import analysis  # noqa: E402
 from . import quantization  # noqa: E402
 from . import profiler as profiler  # noqa: E402
 from . import monitor  # noqa: E402
+from . import testing  # noqa: E402
 from . import utils  # noqa: E402
 from . import regularizer  # noqa: E402
 from . import compat  # noqa: E402

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 from .. import monitor as _monitor
 from ..core.tensor import Tensor
+from ..testing import failpoints as _fp
 from . import env as _env
 
 _SPMD_AXIS = []  # stack of axis names active under spmd_context
@@ -33,7 +34,9 @@ def _stat(kind, x):
     and the static collective-count pass read through one vocabulary.
     List/tuple payloads sum over their elements, so the byte count for one
     logical collective is the same whichever argument form the caller
-    used."""
+    used. Also the chokepoint where the `collective/call` failpoint fires —
+    a fault injected here surfaces as a failed collective to the caller."""
+    _fp.failpoint("collective/call")
     if isinstance(x, (list, tuple)):
         nbytes = sum(_monitor.tensor_nbytes(v) for v in x)
     else:

@@ -28,6 +28,7 @@ from ..core.tape import global_tape
 from ..core.tensor import Tensor
 from ..framework import aot as _aot
 from ..profiler import RecordEvent as _RecordEvent
+from ..testing import failpoints as _failpoints
 from .mesh import get_mesh
 
 # compile_total/compile_cache_total are declared (and recorded) by
@@ -45,6 +46,11 @@ _BENCH_SYNC = _monitor.counter(
     "benchmark_sync_total",
     "FLAGS_benchmark block_until_ready syncs on fetches",
     labelnames=("site",))
+_SKIPPED = _monitor.counter(
+    "train_step_skipped_total",
+    "updates skipped by the FLAGS_check_nan_inf non-finite guard (params/"
+    "optimizer state left bit-identical; > FLAGS_max_skip_steps "
+    "consecutive skips raise)", labelnames=("reason",))
 
 
 def _batch_sig_label(batch_arrays):
@@ -197,7 +203,10 @@ class SpmdTrainer:
                 raise ValueError("remat_offload and recompute_policy both "
                                  "select a jax.checkpoint policy — pick one")
         self._compiled = None       # latest executable (back-compat handle)
-        self._compiled_store = {}   # batch-signature -> executable
+        self._compiled_store = {}   # (batch-sig, guarded) -> (executable,
+        #                             guarded) — guarded steps return an
+        #                             extra on-device finiteness flag
+        self._nonfinite_streak = 0  # consecutive skipped steps
         self.params = {n: p._data for n, p in layer.named_parameters() if getattr(p, "trainable", True)}
         self.frozen = {n: p._data for n, p in layer.named_parameters() if not getattr(p, "trainable", True)}
         self.buffers = {n: b._data for n, b in layer.named_buffers()}
@@ -403,6 +412,7 @@ class SpmdTrainer:
         accum = self.accumulate_steps
 
         want_out = self.return_outputs
+        guard = self._guard_active()
 
         def step(params, opt_state, buffers, lr, rng, *batch):
             def loss_fn(p, b, r):
@@ -438,6 +448,28 @@ class SpmdTrainer:
                 (loss, (new_buffers, outputs)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params, batch, rng)
             new_params, new_state = self.optimizer.functional_apply(params, grads, opt_state, lr=lr)
+            if guard:
+                # FLAGS_check_nan_inf: ONE fused on-device finiteness
+                # verdict over loss + every gradient; a non-finite step
+                # selects the PRE-update params/state/buffers (bit-
+                # identical — __step__ included, so the LR schedule does
+                # not advance either) and reports the flag to the host
+                finite = jnp.isfinite(loss)
+                for g in jax.tree_util.tree_leaves(grads):
+                    finite = jnp.logical_and(
+                        finite, jnp.all(jnp.isfinite(g)))
+
+                def keep(new, old):
+                    return jnp.where(finite, new, old)
+
+                new_params = jax.tree_util.tree_map(keep, new_params, params)
+                new_state = jax.tree_util.tree_map(keep, new_state, opt_state)
+                new_buffers = jax.tree_util.tree_map(
+                    keep, new_buffers, buffers)
+                if want_out:
+                    return (loss, new_params, new_state, new_buffers,
+                            outputs, finite)
+                return loss, new_params, new_state, new_buffers, finite
             if want_out:
                 return loss, new_params, new_state, new_buffers, outputs
             return loss, new_params, new_state, new_buffers
@@ -460,6 +492,8 @@ class SpmdTrainer:
         if want_out:
             # outputs: per-example arrays, batch-sharded over dp (prefix spec)
             out_shardings = out_shardings + (batch_shard,)
+        if guard:
+            out_shardings = out_shardings + (repl,)   # the finite flag
         return jax.jit(step, in_shardings=in_shardings, out_shardings=out_shardings,
                        donate_argnums=(0, 1))
 
@@ -610,6 +644,19 @@ class SpmdTrainer:
     def _batch_sig_key(batch_arrays):
         return tuple((tuple(a.shape), str(a.dtype)) for a in batch_arrays)
 
+    def _guard_active(self):
+        """FLAGS_check_nan_inf builds the step with the on-device non-
+        finite guard (docs/ROBUSTNESS.md). localsgd/DGC shard_map programs
+        don't thread the verdict — the flag is ignored there."""
+        return (bool(_flags.get_flag("check_nan_inf"))
+                and not self.localsgd_k and not self._is_dgc())
+
+    def _exec_key(self, batch_arrays):
+        # the guard changes the compiled program's output arity, so it is
+        # part of the executable's identity: toggling the flag recompiles
+        # instead of mis-unpacking a stale executable
+        return (self._batch_sig_key(batch_arrays), self._guard_active())
+
     def _aot_compile(self, batch_arrays, lr, rng, force=False):
         """Build the jitted step for THIS batch signature and obtain its
         executable — through the persistent AOT cache (framework/aot.py)
@@ -618,6 +665,7 @@ class SpmdTrainer:
         not evict or shadow the full-batch executable); batch_arrays may
         be jax.ShapeDtypeStructs (aot_build: nothing is executed)."""
         sig = _batch_sig_label(batch_arrays)
+        guarded = self._guard_active()
         with _RecordEvent("trainer/compile"), \
                 _monitor.timed(_COMPILE_MS.labels(site="trainer")):
             jitted = self._build(batch_arrays)
@@ -628,8 +676,9 @@ class SpmdTrainer:
                 site="trainer", force=force,
                 extra_key=("trainer", _aot.mesh_fingerprint(self.mesh),
                            self.dp_axis, self.sharding_stage,
-                           self.accumulate_steps))
-        self._compiled_store[self._batch_sig_key(batch_arrays)] = compiled
+                           self.accumulate_steps, guarded))
+        self._compiled_store[self._exec_key(batch_arrays)] = (compiled,
+                                                              guarded)
         self._compiled = compiled  # latest executable (back-compat handle)
         _aot.record_compile("trainer", sig, source)
         return source
@@ -663,6 +712,7 @@ class SpmdTrainer:
     def train_step(self, *batch):
         from ..core.generator import default_generator
 
+        _failpoints.failpoint("trainer/step")
         t_step = time.perf_counter()
         batch_arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(np.asarray(b)) for b in batch]
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
@@ -670,28 +720,54 @@ class SpmdTrainer:
         # paddle.seed, varies per step — a trace-time key would bake ONE
         # dropout mask into the compiled program
         rng = default_generator().fold_in(self.optimizer._step_count)
-        compiled = self._compiled_store.get(self._batch_sig_key(batch_arrays))
-        if compiled is None:
+        entry = self._compiled_store.get(self._exec_key(batch_arrays))
+        if entry is None:
             self._aot_compile(batch_arrays, lr, rng)
-            compiled = self._compiled
+            entry = self._compiled_store[self._exec_key(batch_arrays)]
         elif _monitor.is_enabled():
             _aot.record_compile("trainer", _batch_sig_label(batch_arrays),
                                 "memory")
+        compiled, guarded = entry
         if self.localsgd_k or self._is_dgc():
             loss, self.params, self.opt_state, self.buffers = compiled(
                 self.params, self.opt_state, self.buffers, lr, rng, *batch_arrays
             )
             self.optimizer._step_count += 1
             return self._finish_step(loss, t_step)
+        finite = None
+        out = compiled(
+            self.params, self.opt_state, self.buffers, lr, rng, *batch_arrays
+        )
         if self.return_outputs:  # ctor rejects localsgd/dgc combinations
-            loss, self.params, self.opt_state, self.buffers, outs = compiled(
-                self.params, self.opt_state, self.buffers, lr, rng, *batch_arrays
-            )
+            if guarded:
+                loss, self.params, self.opt_state, self.buffers, outs, \
+                    finite = out
+            else:
+                loss, self.params, self.opt_state, self.buffers, outs = out
             self.last_outputs = jax.tree_util.tree_map(Tensor, outs)
         else:
-            loss, self.params, self.opt_state, self.buffers = compiled(
-                self.params, self.opt_state, self.buffers, lr, rng, *batch_arrays
-            )
+            if guarded:
+                loss, self.params, self.opt_state, self.buffers, finite = out
+            else:
+                loss, self.params, self.opt_state, self.buffers = out
+        if finite is not None and not bool(np.asarray(finite)):
+            # update was skipped ON DEVICE (params/state/buffers selected
+            # pre-update, bit-identical); the host decides whether the run
+            # survives. _step_count stays put: the skipped step retries
+            # with the same LR/rng schedule position.
+            self._nonfinite_streak += 1
+            _SKIPPED.labels(reason="nonfinite").inc()
+            max_skip = int(_flags.get_flag("max_skip_steps", 3))
+            if self._nonfinite_streak > max_skip:
+                raise FloatingPointError(
+                    f"train_step: non-finite loss/gradients for "
+                    f"{self._nonfinite_streak} consecutive steps "
+                    f"(> FLAGS_max_skip_steps={max_skip}); aborting — "
+                    "parameters are unchanged (all updates were skipped); "
+                    "inspect the data pipeline / learning rate")
+            return self._finish_step(loss, t_step)
+        if finite is not None:
+            self._nonfinite_streak = 0
         self.optimizer._step_count += 1
         return self._finish_step(loss, t_step)
 

@@ -53,7 +53,15 @@ def get_flag(name, default=None):
 
 
 # core flags (platform/flags.cc parity where meaningful on TPU)
-define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf (flags.cc:44)")
+define_flag("check_nan_inf", False,
+            "scan op outputs for NaN/Inf (flags.cc:44); SpmdTrainer builds "
+            "its step with an on-device loss/grad finiteness check and "
+            "SKIPS the update on a non-finite step (docs/ROBUSTNESS.md)")
+define_flag("max_skip_steps", 3,
+            "with FLAGS_check_nan_inf: how many CONSECUTIVE non-finite "
+            "train steps may be skipped before train_step raises "
+            "FloatingPointError (a transient loss spike recovers; a "
+            "diverged run fails loudly)")
 define_flag("sort_sum_gradient", False, "deterministic grad accumulation order (flags.cc:527)")
 define_flag("benchmark", False,
             "Executor.run blocks until fetches are device-complete so the "

@@ -3,7 +3,15 @@
 Reference parity: python/paddle/framework/io.py:202 save (pickled state_dict) / :292
 load; fluid/dygraph/checkpoint.py:56 save_dygraph. Tensors are stored as numpy arrays
 (bfloat16 kept via ml_dtypes view round-trip).
+
+Durability (docs/ROBUSTNESS.md): ``save`` writes to a same-directory tmp
+file and commits with ``os.replace`` — a crash mid-save can never leave a
+partial file at the destination — and appends a sha256 integrity footer
+that ``load`` verifies (bit rot / torn writes raise
+:class:`CheckpointCorruptError` instead of unpickling garbage). Footerless
+files written by older versions still load (unverified).
 """
+import hashlib
 import os
 import pickle
 import time
@@ -13,6 +21,18 @@ import numpy as np
 from .. import monitor as _monitor
 from ..core.tensor import Tensor
 from ..profiler import RecordEvent as _RecordEvent
+from ..testing import failpoints as _fp
+
+# integrity footer: 8-byte magic + sha256(payload), appended after the
+# pickled/encrypted payload. pickle stops at its STOP opcode, so a footer
+# at the tail never confuses a reader that skips verification.
+_FOOTER_MAGIC = b"PTSHA256"
+_FOOTER_LEN = len(_FOOTER_MAGIC) + 32
+
+
+class CheckpointCorruptError(RuntimeError):
+    """The checkpoint file failed its integrity check (sha256 footer
+    mismatch) or cannot be unpickled — truncated or corrupt write."""
 
 _CKPT = _monitor.counter("checkpoint_total", "paddle.save/load calls",
                          labelnames=("op",))
@@ -60,26 +80,141 @@ def _unpack(obj):
     return obj
 
 
+class _HashingWriter:
+    """File-object shim that feeds every written byte into a sha256 as the
+    pickler streams, so the footer costs no second pass over the payload."""
+
+    __slots__ = ("_f", "_h")
+
+    def __init__(self, f, h):
+        self._f = f
+        self._h = h
+
+    def write(self, b):
+        self._h.update(b)
+        return self._f.write(b)
+
+
+def _reclaim_stale_tmps(path):
+    """Remove ``<path>.tmp.<pid>`` leftovers from earlier crashed saves of
+    the SAME destination whose writer process is gone — repeated crashes
+    must not accumulate multi-GB tmp files. Live pids (another process —
+    or thread — mid-save of this path) are left alone."""
+    d = os.path.dirname(path) or "."
+    prefix = os.path.basename(path) + ".tmp."
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return
+    for name in names:
+        if not name.startswith(prefix):
+            continue
+        try:
+            pid = int(name[len(prefix):])
+            os.kill(pid, 0)
+        except ValueError:
+            continue            # not one of ours
+        except ProcessLookupError:
+            try:
+                os.remove(os.path.join(d, name))
+            except OSError:
+                pass
+        except OSError:
+            continue            # e.g. EPERM: pid exists
+
+
 def save(obj, path, protocol=4, **configs):
     """configs: encryption_key=<str|bytes> encrypts the payload at rest
-    (framework/io/crypto parity, native AES-256-CTR + HMAC)."""
+    (framework/io/crypto parity, native AES-256-CTR + HMAC).
+
+    Atomic + verified: the payload streams into ``<path>.tmp.<pid>``, gets
+    a sha256 integrity footer, is fsync'd, and only then renames over
+    `path` (directory entry fsync'd too). A crash at ANY point leaves
+    either the old file or the new one — never a torn write — plus at
+    worst a stale tmp file, which the next save of the same path reclaims
+    once its writer pid is gone."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
+    _reclaim_stale_tmps(path)
     t0 = time.perf_counter()
+    tmp = f"{path}.tmp.{os.getpid()}"
     with _RecordEvent("checkpoint/save"):
-        key = configs.get("encryption_key")
-        if key is not None:
-            from .crypto import AESCipher
+        try:
+            h = hashlib.sha256()
+            with open(tmp, "wb") as f:
+                w = _HashingWriter(f, h)
+                key = configs.get("encryption_key")
+                if key is not None:
+                    from .crypto import AESCipher
 
-            payload = AESCipher(key).encrypt(pickle.dumps(_pack(obj),
-                                                          protocol=protocol))
-            with open(path, "wb") as f:
-                f.write(payload)
-        else:  # streaming path: no full-payload copy in memory
-            with open(path, "wb") as f:
-                pickle.dump(_pack(obj), f, protocol=protocol)
+                    w.write(AESCipher(key).encrypt(
+                        pickle.dumps(_pack(obj), protocol=protocol)))
+                else:  # streaming path: no full-payload copy in memory
+                    pickle.dump(_pack(obj), w, protocol=protocol)
+                # crash window under test: payload on disk, no footer, no
+                # commit — the destination must stay untouched
+                _fp.failpoint("ckpt/write")
+                f.write(_FOOTER_MAGIC + h.digest())
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)  # atomic commit
+            _fsync_dir(os.path.dirname(os.path.abspath(path)))
+        except BaseException:
+            # an error path reclaims its own tmp; a SIGKILL can't — the
+            # CheckpointSaver startup sweep handles those
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
     _record_ckpt("save", path, t0)
+
+
+def _fsync_dir(path):
+    """fsync the directory entry so a just-committed rename survives power
+    loss, completing the atomic-commit durability story. Best-effort: some
+    filesystems refuse to open or fsync directories."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _verify_footer(f, path):
+    """Verify the sha256 footer if present; returns (payload length,
+    footer-verified?) and leaves the file position at 0. Footerless
+    (pre-durability) files pass through unverified; a digest mismatch
+    raises CheckpointCorruptError."""
+    size = f.seek(0, os.SEEK_END)
+    if size >= _FOOTER_LEN:
+        f.seek(size - _FOOTER_LEN)
+        tail = f.read(_FOOTER_LEN)
+        if tail[:len(_FOOTER_MAGIC)] == _FOOTER_MAGIC:
+            h = hashlib.sha256()
+            f.seek(0)
+            left = size - _FOOTER_LEN
+            while left:
+                chunk = f.read(min(1 << 20, left))
+                if not chunk:
+                    break
+                h.update(chunk)
+                left -= len(chunk)
+            if h.digest() != tail[len(_FOOTER_MAGIC):]:
+                raise CheckpointCorruptError(
+                    f"{path}: integrity check failed — sha256 of the "
+                    "payload does not match the footer (truncated or "
+                    "corrupt write); restore from an older checkpoint")
+            f.seek(0)
+            return size - _FOOTER_LEN, True
+    f.seek(0)
+    return size, False
 
 
 def load(path, **configs):
@@ -88,13 +223,16 @@ def load(path, **configs):
     key = configs.get("encryption_key")
     t0 = time.perf_counter()
     with _RecordEvent("checkpoint/load"), open(path, "rb") as f:
+        _fp.failpoint("ckpt/read")
+        payload_len, verified = _verify_footer(f, path)
         if f.read(4) == _MAGIC:
             if key is None:
                 raise ValueError(f"{path} is encrypted; pass encryption_key=")
             from .crypto import AESCipher
 
             f.seek(0)
-            out = _unpack(pickle.loads(AESCipher(key).decrypt(f.read())))
+            out = _unpack(pickle.loads(AESCipher(key).decrypt(
+                f.read(payload_len))))
             _record_ckpt("load", path, t0)
             return out
         if key is not None:
@@ -104,7 +242,21 @@ def load(path, **configs):
                 f"encryption_key given but {path} is not encrypted "
                 "(magic header missing); refusing to load unauthenticated data")
         f.seek(0)
-        out = _unpack(pickle.load(f))
+        try:
+            out = _unpack(pickle.load(f))
+        except (pickle.UnpicklingError, EOFError, ValueError) as e:
+            # AttributeError/MemoryError are deliberately NOT here: they
+            # are as likely environmental (a class moved between versions,
+            # OOM on a big state_dict) as corruption, and a corrupt
+            # classification lets CheckpointSaver's fallback walk DELETE
+            # the file — when ambiguous, propagate and keep the data
+            if verified:
+                # the sha256 footer proved the bytes are exactly what save
+                # wrote — this failure is environmental, NOT corruption
+                raise
+            raise CheckpointCorruptError(
+                f"{path}: cannot unpickle checkpoint payload ({e}) — the "
+                "file is truncated or corrupt") from e
     _record_ckpt("load", path, t0)
     return out
 

@@ -13,26 +13,106 @@ import json
 import os
 import shutil
 import time
+import warnings
 
 import numpy as np
 
+from ... import monitor as _monitor
+from ...framework.io import CheckpointCorruptError, _fsync_dir
 from ...framework.io import load as pload
 from ...framework.io import save as psave
+from ...testing import failpoints as _fp
 
 _JOB_ID_ENV = "PADDLE_JOB_ID"
 _CHECKPOINT_PATH_ENV = "PADDLE_CHECKPOINT_DIR"
 
+# errors that mean THIS checkpoint's bytes are bad (evict + fall back);
+# anything else — permissions, fd exhaustion, a missing encryption key —
+# must propagate instead of destroying a checkpoint that may be fine
+_CORRUPT_ERRORS = (CheckpointCorruptError, json.JSONDecodeError, EOFError,
+                   FileNotFoundError, NotADirectoryError, UnicodeDecodeError)
+
+# tmp dirs a save_checkpoint in THIS process is writing right now — a
+# sibling CheckpointSaver constructed on another thread must not sweep them
+_ACTIVE_TMPS = set()
+
+_RECOVER = _monitor.counter(
+    "checkpoint_recover_total",
+    "checkpoint recovery actions by reason (corrupt = an unreadable newest "
+    "checkpoint was evicted and an older one restored; tmp_swept = a stale "
+    ".tmp dir from a crashed run was reclaimed)",
+    labelnames=("reason",))
+
 
 class CheckpointSaver:
-    """checkpoint_saver.py parity: numbered checkpoints, keep max_num."""
+    """checkpoint_saver.py parity: numbered checkpoints, keep max_num.
+
+    Robustness (docs/ROBUSTNESS.md): construction sweeps orphaned
+    ``__paddle_checkpoint__.*.tmp`` dirs left by crashed runs, and
+    ``load_checkpoint()`` (no explicit number) walks backward to the newest
+    *valid* checkpoint, evicting corrupt ones instead of crashing on them —
+    a process killed mid-save never bricks the resume path."""
 
     def __init__(self, directory, max_num=3):
         self.directory = directory
         self.max_num = max_num
         os.makedirs(directory, exist_ok=True)
+        self.sweep_tmp()
 
     def _ckpt_dir(self, no):
         return os.path.join(self.directory, f"__paddle_checkpoint__.{no}")
+
+    # a marker-less tmp dir younger than this may be a concurrent saver
+    # between its makedirs and its owner.pid write — don't sweep it yet
+    _TMP_GRACE_S = 60.0
+
+    @staticmethod
+    def _tmp_is_orphan(tmp_dir):
+        """True when a tmp dir is a reclaimable crash leftover. A dir whose
+        owner.pid marker names a live OTHER process is a concurrent saver
+        mid-commit in a shared directory; our own pid is live only while a
+        save_checkpoint is actually inside its commit window (_ACTIVE_TMPS
+        — another thread of this process), otherwise it is an aborted
+        attempt. A marker-less dir gets a short grace period to cover the
+        makedirs→marker-write window."""
+        if os.path.abspath(tmp_dir) in _ACTIVE_TMPS:
+            return False   # a saver thread in THIS process is writing it
+        try:
+            with open(os.path.join(tmp_dir, "owner.pid")) as f:
+                pid = int(f.read().strip())
+        except (OSError, ValueError):
+            try:
+                age = time.time() - os.stat(tmp_dir).st_mtime
+            except OSError:
+                return False   # vanished under us — nothing to reclaim
+            return age > CheckpointSaver._TMP_GRACE_S
+        if pid == os.getpid():
+            return True    # ours but not active — an aborted attempt
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return True
+        except OSError:
+            pass           # e.g. EPERM: it exists but isn't ours
+        return False
+
+    def sweep_tmp(self):
+        """Reclaim orphaned .tmp checkpoint dirs (crash-mid-save leftovers);
+        returns how many were removed. Tmp dirs owned by a live concurrent
+        saver (owner.pid marker, or young enough to still be writing one)
+        are left alone."""
+        removed = 0
+        for name in os.listdir(self.directory):
+            if name.startswith("__paddle_checkpoint__.") \
+                    and name.endswith(".tmp"):
+                path = os.path.join(self.directory, name)
+                if not self._tmp_is_orphan(path):
+                    continue
+                shutil.rmtree(path, ignore_errors=True)
+                removed += 1
+        if removed and _monitor.is_enabled():
+            _RECOVER.labels(reason="tmp_swept").inc(removed)
+        return removed
 
     def get_checkpoint_numbers(self):
         nums = []
@@ -48,25 +128,57 @@ class CheckpointSaver:
         nums = self.get_checkpoint_numbers()
         no = (nums[-1] + 1) if nums else 0
         tmp = self._ckpt_dir(no) + ".tmp"
-        os.makedirs(tmp, exist_ok=True)
-        psave(state, os.path.join(tmp, "state.pdparams"))
-        with open(os.path.join(tmp, "meta.json"), "w") as f:
-            json.dump({"no": no, "time": time.time(), **(meta or {})}, f)
-        os.rename(tmp, self._ckpt_dir(no))  # atomic commit
+        _ACTIVE_TMPS.add(os.path.abspath(tmp))
+        try:
+            os.makedirs(tmp, exist_ok=True)
+            with open(os.path.join(tmp, "owner.pid"), "w") as f:
+                f.write(str(os.getpid()))   # sweep_tmp skips live owners
+            psave(state, os.path.join(tmp, "state.pdparams"))
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump({"no": no, "time": time.time(), **(meta or {})}, f)
+            _fp.failpoint("ckpt/commit")
+            os.remove(os.path.join(tmp, "owner.pid"))
+            os.rename(tmp, self._ckpt_dir(no))  # atomic commit
+        finally:
+            _ACTIVE_TMPS.discard(os.path.abspath(tmp))
+        # make the commit durable BEFORE rotating older checkpoints away:
+        # a crash here must find either the new dir or the old ones on disk
+        _fsync_dir(self.directory)
         for old in self.get_checkpoint_numbers()[: -self.max_num]:
             shutil.rmtree(self._ckpt_dir(old), ignore_errors=True)
         return no
 
-    def load_checkpoint(self, no=None):
-        nums = self.get_checkpoint_numbers()
-        if not nums:
-            return None, None
-        no = no if no is not None else nums[-1]
+    def _load_one(self, no):
         d = self._ckpt_dir(no)
         state = pload(os.path.join(d, "state.pdparams"))
         with open(os.path.join(d, "meta.json")) as f:
             meta = json.load(f)
         return state, meta
+
+    def load_checkpoint(self, no=None):
+        """Newest valid checkpoint (or the explicit `no`, which raises on
+        corruption instead of falling back). An unreadable newest
+        checkpoint — truncated state file, missing meta, failed sha256
+        footer — is EVICTED and the walk continues to the previous one,
+        counting checkpoint_recover_total{reason=corrupt}."""
+        nums = self.get_checkpoint_numbers()
+        if not nums:
+            return None, None
+        if no is not None:
+            return self._load_one(no)
+        for cand in reversed(nums):
+            try:
+                return self._load_one(cand)
+            except _CORRUPT_ERRORS as e:
+                d = self._ckpt_dir(cand)
+                warnings.warn(
+                    f"checkpoint {d} is unreadable ({type(e).__name__}: "
+                    f"{e}); evicting it and falling back to the previous "
+                    "checkpoint")
+                shutil.rmtree(d, ignore_errors=True)
+                if _monitor.is_enabled():
+                    _RECOVER.labels(reason="corrupt").inc()
+        return None, None
 
 
 class TrainEpochRange:

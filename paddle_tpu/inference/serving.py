@@ -39,6 +39,18 @@ requests submitted with `prefix_id=` start from a copy of that cache and
 prefill only their suffix — identical outputs to resending the full
 prompt, without recomputing the prefix per request.
 
+Robustness (docs/ROBUSTNESS.md): per-request `deadline_ms` finishes an
+overdue request with reason="deadline" while batch-mates continue;
+`cancel(rid)` evicts a queued or in-flight request; `max_queue=` bounds
+the admission queue — a full queue rejects (`QueueFullError`) or, when the
+incoming request outranks a queued one, load-sheds the lowest-priority
+entry (reason="shed", `request_shed_total{reason}`); per-slot host-side
+failures are ISOLATED (the failing slot finishes with reason="error" and
+is evicted, the rest of the batch continues); `health()` reports
+ok/degraded/draining and `drain()` stops admission for graceful shutdown.
+A non-converging `run_until_complete` fails its in-flight requests with
+reason="engine_stalled" instead of leaving them dangling.
+
 `draft_model=` turns on SPECULATIVE continuous batching (the batched form
 of `generate_speculative`): each round a small draft proposes `spec_k`
 tokens per slot and the target verifies all slots in ONE (spec_k+1)-token
@@ -56,8 +68,14 @@ import numpy as np
 from .. import monitor as _monitor
 from ..core.tensor import Tensor
 from ..framework import aot as _aot
+from ..testing import failpoints as _fp
 
-__all__ = ["ServingEngine", "Request"]
+__all__ = ["ServingEngine", "Request", "QueueFullError"]
+
+
+class QueueFullError(RuntimeError):
+    """submit() rejected: the bounded admission queue is full and the
+    request's priority does not outrank any queued entry."""
 
 # engine metrics in the default registry (every engine in the process
 # shares them; per-engine views live on ServingEngine.stats())
@@ -93,6 +111,17 @@ _SPEC = _monitor.counter(
     "serving_spec_tokens_total",
     "speculative decoding draft tokens (proposed vs accepted)",
     labelnames=("event",))
+_SHED = _monitor.counter(
+    "request_shed_total",
+    "load-shedding on the bounded admission queue (queue_full = incoming "
+    "request rejected with QueueFullError; preempted = a lower-priority "
+    "queued request was finished with reason='shed' to admit a higher-"
+    "priority one)",
+    labelnames=("reason",))
+_DEADLINE = _monitor.counter(
+    "request_deadline_exceeded_total",
+    "requests finished with reason='deadline' (per-request deadline_ms "
+    "elapsed before completion)")
 
 
 class _MsSummary:
@@ -125,7 +154,7 @@ class Request:
 
     def __init__(self, rid, prompt_ids, max_new_tokens, temperature=0.0,
                  top_k=None, top_p=None, seed=None, prefix_id=None,
-                 prefix_len=0):
+                 prefix_len=0, deadline_ms=None, priority=0):
         self.rid = rid
         self.prompt_ids = np.asarray(prompt_ids, np.int32).ravel()
         self.max_new_tokens = int(max_new_tokens)
@@ -135,9 +164,13 @@ class Request:
         self.seed = rid if seed is None else int(seed)
         self.prefix_id = prefix_id          # registered shared prefix, or
         self.prefix_len = int(prefix_len)   # 0 = no prefix reuse
+        self.deadline_ms = deadline_ms      # None = no deadline
+        self.priority = int(priority)       # higher outranks on a full queue
         self.output_ids = []          # generated tokens (no prompt echo)
         self.finished = False
-        self.finish_reason = None     # "eos" | "length" | "capacity"
+        # "eos" | "length" | "capacity" | "deadline" | "error" |
+        # "cancelled" | "shed" | "engine_stalled"
+        self.finish_reason = None
         self.submit_time = None       # stamped by ServingEngine.submit
         self.admit_time = None        # admission start (queue wait ends)
         self.first_token_time = None
@@ -190,7 +223,8 @@ class ServingEngine:
     def __init__(self, model, max_batch=4, dtype=None, cache_dtype=None,
                  eos_token_id=None, prompt_buckets=(32, 64, 128, 256, 512,
                                                     1024), tp_mesh=None,
-                 prefill_chunk=None, draft_model=None, spec_k=4):
+                 prefill_chunk=None, draft_model=None, spec_k=4,
+                 max_queue=None):
         import jax
         import jax.numpy as jnp
 
@@ -211,6 +245,9 @@ class ServingEngine:
                 raise ValueError(
                     f"prefill_chunk must be in [1, max_seq_len={self.T}], "
                     f"got {prefill_chunk}")
+        if max_queue is not None and int(max_queue) < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self._max_queue = None if max_queue is None else int(max_queue)
         if draft_model is not None:
             if draft_model.cfg.vocab_size != cfg.vocab_size:
                 raise ValueError("draft and target must share a vocabulary")
@@ -560,6 +597,12 @@ class ServingEngine:
         self._queue = []
         self._next_rid = 0
         self._finished = {}
+        # robustness state: draining stops admission; step/error counters
+        # feed health()'s ok|degraded|draining verdict
+        self._draining = False
+        self._deadline_live = 0   # unfinished requests carrying deadline_ms
+        self._step_no = 0
+        self._last_error_step = None
 
     # -- API -----------------------------------------------------------------
     def register_prefix(self, prefix_ids):
@@ -729,6 +772,7 @@ class ServingEngine:
             "queue_wait_ms": m["queue_wait_ms"].to_dict(),
             "ttft_ms": m["ttft_ms"].to_dict(),
             "inter_token_ms": m["inter_token_ms"].to_dict(),
+            "health": self.health(),
         }
         return out
 
@@ -761,12 +805,24 @@ class ServingEngine:
         del self._prefixes[prefix_id]
 
     def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
-               top_k=None, top_p=None, seed=None, prefix_id=None):
+               top_k=None, top_p=None, seed=None, prefix_id=None,
+               deadline_ms=None, priority=0):
         """Queue a prompt; returns the request id. temperature=0 (default)
         decodes greedy; temperature>0 samples (optionally top_k- and/or
         top_p/nucleus-truncated, same semantics as generate()) with a
         per-request deterministic PRNG stream (seed defaults to the
-        request id)."""
+        request id).
+
+        deadline_ms: wall-clock budget from submit; an overdue request is
+        finished with reason="deadline" at the next step() (batch-mates
+        are untouched). priority: higher values outrank on a FULL bounded
+        queue (max_queue=): the lowest-priority queued request is shed
+        (reason="shed") to admit a strictly-higher-priority arrival;
+        otherwise submit raises QueueFullError."""
+        if self._draining:
+            raise RuntimeError(
+                "ServingEngine is draining — not accepting new requests "
+                "(in-flight work runs to completion; see drain())")
         ids = prompt_ids._data if isinstance(prompt_ids, Tensor) \
             else np.asarray(prompt_ids)
         ids = np.asarray(ids, np.int32).ravel()
@@ -775,6 +831,8 @@ class ServingEngine:
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
         if temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if deadline_ms is not None and not deadline_ms > 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
         if top_k is not None and top_k < 1:
             raise ValueError(f"top_k must be >= 1, got {top_k}")
         if top_p is not None and not 0.0 < top_p <= 1.0:
@@ -801,13 +859,36 @@ class ServingEngine:
         if len(ids) + 1 > self.T:
             raise ValueError(
                 f"prompt ({len(ids)}) too long for max_seq_len {self.T}")
+        priority = int(priority)
+        if self._max_queue is not None and len(self._queue) >= self._max_queue:
+            # shed the lowest-priority queued request (newest among ties —
+            # it has the least sunk wait) iff the arrival strictly outranks
+            # it; otherwise reject the arrival
+            victim_idx = None
+            for i, r in enumerate(self._queue):
+                if victim_idx is None \
+                        or r.priority <= self._queue[victim_idx].priority:
+                    victim_idx = i
+            if self._queue[victim_idx].priority < priority:
+                victim = self._queue.pop(victim_idx)
+                self._finish_req(victim, "shed")
+                _SHED.labels(reason="preempted").inc()
+            else:
+                _SHED.labels(reason="queue_full").inc()
+                raise QueueFullError(
+                    f"admission queue full ({len(self._queue)}/"
+                    f"{self._max_queue}); request rejected — retry later "
+                    "or submit with a higher priority")
         rid = self._next_rid
         self._next_rid += 1
         req = Request(rid, ids, max_new_tokens,
                       temperature=temperature, top_k=top_k,
                       top_p=top_p, seed=seed, prefix_id=prefix_id,
-                      prefix_len=prefix_len)
+                      prefix_len=prefix_len, deadline_ms=deadline_ms,
+                      priority=priority)
         req.submit_time = time.perf_counter()
+        if deadline_ms is not None:
+            self._deadline_live += 1
         self._queue.append(req)
         self._m["submitted"] += 1
         _REQ_SUBMITTED.inc()
@@ -819,15 +900,110 @@ class ServingEngine:
                 return b
         return self.T
 
-    def _finish(self, slot, reason):
-        req = self._slot_req[slot]
+    def _finish_req(self, req, reason, slot=None):
+        """Terminal transition for a request wherever it lives: stamps the
+        outcome, records it, and (slot given) frees the slot + any
+        in-flight prefill reservation. Freed rows need no scrubbing — the
+        next admission's row copy overwrites them (the invariant the whole
+        engine rides on)."""
         req.finished = True
         req.finish_reason = reason
         req.finish_time = time.perf_counter()
+        if req.deadline_ms is not None:
+            self._deadline_live -= 1
         self._m["finished"][reason] = self._m["finished"].get(reason, 0) + 1
         _REQ_FINISHED.labels(reason=reason).inc()
         self._finished[req.rid] = req
-        self._slot_req[slot] = None
+        if slot is not None:
+            self._slot_req[slot] = None
+            self._prefilling.pop(slot, None)
+
+    def _finish(self, slot, reason):
+        self._finish_req(self._slot_req[slot], reason, slot=slot)
+
+    def _note_error(self):
+        self._last_error_step = self._step_no
+
+    def cancel(self, rid):
+        """Cancel a queued or in-flight request: it is finished immediately
+        with reason="cancelled" and its slot (if any) freed for the next
+        admission. Returns True if cancelled, False if the request had
+        already finished; raises KeyError for an unknown id."""
+        for i, req in enumerate(self._queue):
+            if req.rid == rid:
+                self._queue.pop(i)
+                self._finish_req(req, "cancelled")
+                return True
+        for slot, entry in list(self._prefilling.items()):
+            if entry[0].rid == rid:
+                self._finish_req(entry[0], "cancelled", slot=slot)
+                return True
+        for slot in range(self.B):
+            req = self._slot_req[slot]
+            if req is not None and req.rid == rid:
+                self._finish_req(req, "cancelled", slot=slot)
+                return True
+        if rid in self._finished:
+            return False
+        raise KeyError(f"unknown request id {rid}")
+
+    def drain(self, stop=True):
+        """Graceful-shutdown valve: stop admitting new requests (submit()
+        raises) while queued and in-flight work runs to completion via
+        step()/run_until_complete(). health() reports "draining" until
+        drain(False) re-opens admission."""
+        self._draining = bool(stop)
+
+    def health(self):
+        """Liveness verdict for load balancers: state is "draining" after
+        drain(), "degraded" when a request finished with reason="error" in
+        the last 100 steps or the bounded queue is at >= 80% depth, else
+        "ok". Also wired into stats()["health"]."""
+        state = "ok"
+        if self._draining:
+            state = "draining"
+        else:
+            recent_error = (self._last_error_step is not None
+                            and self._step_no - self._last_error_step <= 100)
+            q_pressure = (self._max_queue is not None and len(self._queue)
+                          >= max(1, int(0.8 * self._max_queue)))
+            if recent_error or q_pressure:
+                state = "degraded"
+        return {"state": state,
+                "queue_depth": len(self._queue),
+                "queue_limit": self._max_queue,
+                "active_slots": sum(1 for r in self._slot_req
+                                    if r is not None),
+                "errors": self._m["finished"].get("error", 0),
+                "steps": self._step_no}
+
+    def _expire_deadlines(self):
+        """Finish every overdue request (reason="deadline") wherever it
+        lives — queue, mid-prefill, or an active slot. Batch-mates are
+        untouched: a freed slot is just another don't-care row until the
+        next admission overwrites it."""
+        if not self._deadline_live:
+            return   # nothing carries a deadline: keep step() O(1) here
+        now = time.perf_counter()
+
+        def overdue(req):
+            return (req.deadline_ms is not None
+                    and (now - req.submit_time) * 1e3 > req.deadline_ms)
+
+        for req in [r for r in self._queue if overdue(r)]:
+            self._queue.remove(req)
+            self._finish_req(req, "deadline")
+            _DEADLINE.inc()
+        for slot, entry in list(self._prefilling.items()):
+            if overdue(entry[0]):
+                self._finish_req(entry[0], "deadline", slot=slot)
+                _DEADLINE.inc()
+        for slot in range(self.B):
+            req = self._slot_req[slot]
+            if req is not None and slot not in self._prefilling \
+                    and overdue(req):
+                self._finish_req(req, "deadline", slot=slot)
+                _DEADLINE.inc()
 
     def _activate(self, slot, req, kc1, vc1, logits, draft_caches=None):
         """Shared admission tail: copy the side cache(s) into the slot's
@@ -980,20 +1156,43 @@ class ServingEngine:
 
     def step(self):
         """Admit queued requests into free slots, then run ONE decode step
-        for every active slot. Returns requests finished this step."""
+        for every active slot. Returns requests finished this step.
+
+        Per-request failure isolation: host-side per-slot work (admission,
+        chunked-prefill advance, token emission) that throws finishes ONLY
+        that slot's request with reason="error" and evicts it — the rest
+        of the batch continues. A failure in the batched device program
+        itself is not isolatable (one executable) and propagates."""
         import jax.numpy as jnp
 
+        _fp.failpoint("serving/step")
+        self._step_no += 1
         before = set(self._finished)
+        # after the snapshot: deadline expiries belong to THIS step's
+        # returned finishes, same as error/eos/length
+        self._expire_deadlines()
         # chunked admissions in flight advance ONE chunk each, so active
         # decodes below never wait for a whole long prefill
         for slot in list(self._prefilling):
-            self._advance_prefill(slot)
+            req = self._prefilling[slot][0]
+            try:
+                self._advance_prefill(slot)
+            except Exception:
+                self._finish_req(req, "error", slot=slot)
+                self._note_error()
         for slot in range(self.B):
             # while, not if: a request finishing DURING admission (eos on
             # its prefill token / max_new_tokens=1) frees the slot for the
             # next queued request in the same step
             while self._slot_req[slot] is None and self._queue:
-                self._admit_one(slot, self._queue.pop(0))
+                req = self._queue.pop(0)
+                try:
+                    self._admit_one(slot, req)
+                except Exception:
+                    # half-done admission must not leak a reservation
+                    self._finish_req(req, "error", slot=slot)
+                    self._note_error()
+                    continue
                 if self._slot_req[slot] is not None:
                     break
 
@@ -1040,11 +1239,17 @@ class ServingEngine:
                     jnp.asarray(self._last), jnp.asarray(self._pos))
             next_toks = np.asarray(next_toks)
             for s in active:
-                self._pos[s] += 1
-                self._last[s] = next_toks[s]
                 req = self._slot_req[s]
-                req.output_ids.append(int(next_toks[s]))
-                self._after_emit(s, req)
+                try:
+                    _fp.failpoint("serving/slot")
+                    self._pos[s] += 1
+                    self._last[s] = next_toks[s]
+                    req.output_ids.append(int(next_toks[s]))
+                    self._after_emit(s, req)
+                except Exception:
+                    if self._slot_req[s] is not None:
+                        self._finish_req(req, "error", slot=s)
+                    self._note_error()
         return [self._finished[r] for r in set(self._finished) - before]
 
     def _step_speculative(self, active):
@@ -1075,32 +1280,56 @@ class ServingEngine:
         _SPEC.labels(event="proposed").inc(proposed)
         _SPEC.labels(event="accepted").inc(accepted)
         for s in active:
-            n_acc = int(m[s]) + 1
-            toks = emit[s, :n_acc]
             req = self._slot_req[s]
-            old_pos = int(self._pos[s])
-            self._last[s] = int(toks[-1])
-            for i, t in enumerate(toks):
-                # advance pos PER TOKEN so _after_emit's eos/length/
-                # capacity decisions are made at exactly the state the
-                # single-token engine would have seen
-                self._pos[s] = old_pos + i + 1
-                req.output_ids.append(int(t))
-                self._after_emit(s, req)
-                if req.finished:
-                    break
+            try:
+                _fp.failpoint("serving/slot")
+                n_acc = int(m[s]) + 1
+                toks = emit[s, :n_acc]
+                old_pos = int(self._pos[s])
+                self._last[s] = int(toks[-1])
+                for i, t in enumerate(toks):
+                    # advance pos PER TOKEN so _after_emit's eos/length/
+                    # capacity decisions are made at exactly the state the
+                    # single-token engine would have seen
+                    self._pos[s] = old_pos + i + 1
+                    req.output_ids.append(int(t))
+                    self._after_emit(s, req)
+                    if req.finished:
+                        break
+            except Exception:
+                if self._slot_req[s] is not None:
+                    self._finish_req(req, "error", slot=s)
+                self._note_error()
 
     def has_work(self):
         return bool(self._queue) or any(r is not None
                                         for r in self._slot_req)
 
     def run_until_complete(self, max_steps=100_000):
-        """Drain the queue; returns {rid: Request}."""
+        """Drain the queue; returns {rid: Request}. Non-convergence fails
+        every in-flight request with reason="engine_stalled" (nothing is
+        left dangling for callers polling get_request) and raises with
+        their rids."""
         steps = 0
         while self.has_work():
             self.step()
             steps += 1
             if steps > max_steps:
-                raise RuntimeError("serving engine did not converge "
-                                   f"within {max_steps} steps")
+                stalled = []
+                for req in list(self._queue):
+                    self._queue.remove(req)
+                    self._finish_req(req, "engine_stalled")
+                    stalled.append(req.rid)
+                for slot, entry in list(self._prefilling.items()):
+                    self._finish_req(entry[0], "engine_stalled", slot=slot)
+                    stalled.append(entry[0].rid)
+                for slot in range(self.B):
+                    req = self._slot_req[slot]
+                    if req is not None:
+                        self._finish_req(req, "engine_stalled", slot=slot)
+                        stalled.append(req.rid)
+                raise RuntimeError(
+                    "serving engine did not converge within "
+                    f"{max_steps} steps; failed in-flight requests "
+                    f"{sorted(set(stalled))} with reason='engine_stalled'")
         return dict(self._finished)

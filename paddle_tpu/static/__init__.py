@@ -31,6 +31,7 @@ from ..core.tensor import Tensor, ParamBase
 from ..framework import aot as _aot
 from ..jit import InputSpec  # noqa: F401
 from ..profiler import RecordEvent as _RecordEvent
+from ..testing import failpoints as _failpoints
 
 _STATIC_MODE = [False]
 
@@ -637,6 +638,7 @@ class Executor:
         mix live arrays and jax.ShapeDtypeStructs. force=True (aot_compile)
         compiles eagerly even without a cache dir — warm-start must never
         hand back a lazy jit."""
+        _failpoints.failpoint("exe/compile")
         jitted = jax.jit(_build_program_fn(program, feed_names, fetch_ids,
                                            train))
         return _aot.compile_cached(jitted, example_args, site="executor",

@@ -1,0 +1,12 @@
+"""Test-support machinery that ships with the framework.
+
+`failpoints` is the registered fault-injection framework (docs/ROBUSTNESS.md):
+sites planted in the runtime's recovery-critical paths (checkpoint write/read,
+executor compile, collectives, the serving step loop) that are a single
+boolean check when disabled and inject errors/delays/kills when armed via
+``FLAGS_failpoints`` or ``failpoints.scoped(...)``.
+"""
+from . import failpoints  # noqa: F401
+from .failpoints import FailpointError, failpoint  # noqa: F401
+
+__all__ = ["failpoints", "failpoint", "FailpointError"]

@@ -1,0 +1,277 @@
+"""Registered fault-injection framework (docs/ROBUSTNESS.md).
+
+Production faults — a process killed mid-checkpoint, a compile that dies, a
+slot whose host-side bookkeeping throws — are rare exactly when you test and
+common exactly when you ship. This module plants named *failpoint sites* in
+the runtime's recovery-critical paths so chaos tests (and tools/chaos_check.py)
+can make those faults happen on demand:
+
+    from paddle_tpu.testing import failpoints
+
+    with failpoints.scoped("ckpt/write=error:1"):
+        paddle.save(state, path)          # raises FailpointError once
+
+or process-wide via the flag (parsed at import, re-appliable after
+``paddle.set_flags`` with :func:`arm_from_flag`)::
+
+    FLAGS_failpoints="ckpt/write=error:1,serving/step=delay:5" python train.py
+
+Spec syntax: ``site=action[,site=action...]`` with actions
+
+- ``error`` / ``error:N`` — raise :class:`FailpointError` at the site (N
+  times, then the site auto-disarms; no N = every hit);
+- ``delay:MS`` — sleep MS milliseconds per hit (latency injection);
+- ``kill`` — SIGKILL the process at the site (crash-mid-operation tests, in
+  the spirit of tests/test_auto_checkpoint_kill.py).
+
+Discipline: **disabled is one boolean check** — the same bar as
+``monitor.is_enabled()``, pinned by tests/test_failpoints_gate.py (<5µs/call
+and zero behavior/metric drift with nothing armed). Sites are REGISTERED
+(the ``SITES`` table below); arming a typo'd name raises with the known list.
+"""
+import contextlib
+import os
+import signal
+import threading
+import time
+
+from .. import flags as _flags
+from .. import monitor as _monitor
+
+__all__ = [
+    "SITES", "FailpointError", "failpoint", "arm", "disarm", "reset",
+    "armed", "hits", "is_enabled", "scoped", "parse", "arm_from_flag",
+]
+
+_flags.define_flag(
+    "failpoints", "",
+    "fault-injection spec 'site=action[,site=action...]' with actions "
+    "error[:N] | delay:MS | kill; empty = every failpoint site is a single "
+    "boolean check (see paddle_tpu/testing/failpoints.py SITES)")
+
+#: every plantable site, registered centrally so arming a typo fails fast.
+SITES = {
+    "ckpt/write": "framework.io.save — payload written to the tmp file, "
+                  "before the integrity footer + atomic commit",
+    "ckpt/read": "framework.io.load — before the checkpoint file is read",
+    "ckpt/commit": "CheckpointSaver.save_checkpoint — before the checkpoint "
+                   "dir renames into place",
+    "exe/compile": "static.Executor._compile — before building/compiling "
+                   "the program",
+    "collective/call": "distributed.collective — every collective API call",
+    "serving/step": "ServingEngine.step — top of the engine step loop",
+    "serving/slot": "ServingEngine per-slot host work — isolated: an "
+                    "injected error finishes only that slot's request "
+                    "(reason='error'), batch-mates continue",
+    "trainer/step": "SpmdTrainer.train_step — before the compiled step "
+                    "dispatches",
+}
+
+
+class FailpointError(RuntimeError):
+    """The injected fault. Distinct from organic errors so recovery paths
+    can be asserted to have handled *this* failure."""
+
+
+class _Action:
+    __slots__ = ("kind", "arg", "remaining")
+
+    def __init__(self, kind, arg=None, remaining=None):
+        self.kind = kind            # "error" | "delay" | "kill"
+        self.arg = arg              # delay ms
+        self.remaining = remaining  # None = unlimited
+
+    def spec(self):
+        if self.kind == "delay":
+            return f"delay:{self.arg:g}"
+        if self.kind == "error" and self.remaining is not None:
+            return f"error:{self.remaining}"
+        return self.kind
+
+
+_LOCK = threading.RLock()
+_ENABLED = False    # the ONE read on the disabled fast path
+_ARMED = {}         # site -> _Action
+_HITS = {}          # site -> fire count since last reset()
+_TRIG = None        # lazy failpoint_trigger_total counter
+
+
+def _parse_action(site, text):
+    kind, _, arg = text.partition(":")
+    kind = kind.strip()
+    if kind == "error":
+        n = None
+        if arg:
+            n = int(arg)
+            if n < 1:
+                raise ValueError(f"failpoint {site}: error count must be "
+                                 f">= 1, got {n}")
+        return _Action("error", remaining=n)
+    if kind == "delay":
+        if not arg:
+            raise ValueError(f"failpoint {site}: delay needs milliseconds "
+                             "(delay:MS)")
+        ms = float(arg)
+        if ms < 0:
+            raise ValueError(f"failpoint {site}: delay must be >= 0 ms")
+        return _Action("delay", arg=ms)
+    if kind == "kill":
+        return _Action("kill")
+    raise ValueError(f"failpoint {site}: unknown action {text!r} "
+                     "(expected error[:N] | delay:MS | kill)")
+
+
+def parse(spec):
+    """Parse a ``site=action,site=action`` spec string into
+    {site: _Action}; validates site names against :data:`SITES`."""
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        site, sep, action = part.partition("=")
+        site = site.strip()
+        if not sep or not action.strip():
+            raise ValueError(f"failpoint spec {part!r}: expected "
+                             "site=action")
+        if site not in SITES:
+            raise ValueError(f"unknown failpoint site {site!r}; known "
+                             f"sites: {', '.join(sorted(SITES))}")
+        out[site] = _parse_action(site, action.strip())
+    return out
+
+
+def _refresh_enabled():
+    global _ENABLED
+    _ENABLED = bool(_ARMED)
+
+
+def arm(site, action):
+    """Arm one site; `action` is an action spec string (``error``,
+    ``error:2``, ``delay:10``, ``kill``)."""
+    if site not in SITES:
+        raise ValueError(f"unknown failpoint site {site!r}; known sites: "
+                         f"{', '.join(sorted(SITES))}")
+    with _LOCK:
+        _ARMED[site] = _parse_action(site, action)
+        _refresh_enabled()
+
+
+def disarm(site):
+    with _LOCK:
+        _ARMED.pop(site, None)
+        _refresh_enabled()
+
+
+def reset():
+    """Disarm every site and zero the hit counters."""
+    with _LOCK:
+        _ARMED.clear()
+        _HITS.clear()
+        _refresh_enabled()
+
+
+def armed():
+    """{site: action-spec-string} for currently armed sites."""
+    with _LOCK:
+        return {s: a.spec() for s, a in _ARMED.items()}
+
+
+def hits(site):
+    """How many times `site` has fired since the last reset()."""
+    with _LOCK:
+        return _HITS.get(site, 0)
+
+
+def is_enabled():
+    return _ENABLED
+
+
+def arm_from_flag():
+    """(Re-)apply the FLAGS_failpoints spec — call after paddle.set_flags
+    changes the flag at runtime (import-time env values apply
+    automatically)."""
+    spec = _flags.get_flag("failpoints", "") or ""
+    actions = parse(spec)
+    with _LOCK:
+        _ARMED.clear()
+        _ARMED.update(actions)
+        _refresh_enabled()
+
+
+@contextlib.contextmanager
+def scoped(spec):
+    """Arm a spec for the with-block, restoring the previous arming (and
+    enabled state) on exit — the test-side entry point::
+
+        with failpoints.scoped("serving/slot=error:1"):
+            engine.step()
+    """
+    actions = parse(spec)
+    with _LOCK:
+        saved = dict(_ARMED)
+        _ARMED.update(actions)
+        _refresh_enabled()
+    try:
+        yield
+    finally:
+        with _LOCK:
+            _ARMED.clear()
+            _ARMED.update(saved)
+            _refresh_enabled()
+
+
+def _note_fire(site, kind):
+    global _TRIG
+    if not _monitor.is_enabled():
+        return
+    if _TRIG is None:
+        _TRIG = _monitor.counter(
+            "failpoint_trigger_total",
+            "armed failpoint fires by site and action (always zero in "
+            "production: the series only exists once a fault is injected)",
+            labelnames=("site", "action"))
+    _TRIG.labels(site=site, action=kind).inc()
+
+
+def failpoint(site):
+    """The planted call. Disabled (nothing armed anywhere): one boolean
+    check and return — the fast path tests/test_failpoints_gate.py pins."""
+    if not _ENABLED:
+        return
+    _fire(site)
+
+
+def _fire(site):
+    with _LOCK:
+        act = _ARMED.get(site)
+        if act is None:
+            return
+        if act.remaining is not None and act.remaining <= 0:
+            # an exhausted error:N re-armed by scoped()'s restore (the
+            # _Action is shared, its budget already spent) — disarm, don't
+            # fire an N+1th time
+            del _ARMED[site]
+            _refresh_enabled()
+            return
+        _HITS[site] = _HITS.get(site, 0) + 1
+        if act.remaining is not None:
+            act.remaining -= 1
+            if act.remaining <= 0:
+                del _ARMED[site]
+                _refresh_enabled()
+        kind = act.kind
+        delay_ms = act.arg
+    _note_fire(site, kind)
+    if kind == "error":
+        raise FailpointError(f"failpoint {site!r}: injected error")
+    if kind == "delay":
+        time.sleep(delay_ms / 1e3)
+        return
+    if kind == "kill":   # crash-mid-operation: no cleanup handlers run
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+# import-time arming from the environment (FLAGS_failpoints=...)
+if _flags.get_flag("failpoints", ""):
+    arm_from_flag()

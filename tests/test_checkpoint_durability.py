@@ -1,0 +1,306 @@
+"""Durable checkpoints (docs/ROBUSTNESS.md): paddle.save commits atomically
+with a sha256 integrity footer, paddle.load rejects corrupt/truncated files
+with a clear error, and CheckpointSaver walks back to the newest VALID
+checkpoint (evicting corrupt ones) and sweeps crash leftovers. The slow
+subprocess test SIGKILLs a save mid-write — in the spirit of
+tests/test_auto_checkpoint_kill.py — and proves the destination never tears
+and the saver falls back."""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.framework.io import CheckpointCorruptError
+from paddle_tpu.incubate.checkpoint.auto_checkpoint import CheckpointSaver
+from paddle_tpu.testing import failpoints as fp
+from paddle_tpu.testing.failpoints import FailpointError
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.reset()
+    yield
+    fp.reset()
+
+
+def _state():
+    return {"w": paddle.to_tensor(np.arange(8, dtype=np.float32)),
+            "step": 7}
+
+
+class TestAtomicSave:
+    def test_round_trip_with_footer(self, tmp_path):
+        p = str(tmp_path / "m.pdparams")
+        paddle.save(_state(), p)
+        out = paddle.load(p)
+        np.testing.assert_array_equal(np.asarray(out["w"]._data),
+                                      np.arange(8, dtype=np.float32))
+        assert out["step"] == 7
+        # the footer is really there
+        from paddle_tpu.framework.io import _FOOTER_MAGIC
+        blob = open(p, "rb").read()
+        assert blob[-40:-32] == _FOOTER_MAGIC
+
+    def test_failed_save_leaves_destination_untouched(self, tmp_path):
+        p = str(tmp_path / "m.pdparams")
+        paddle.save({"v": 1}, p)
+        before = open(p, "rb").read()
+        with fp.scoped("ckpt/write=error:1"):
+            with pytest.raises(FailpointError):
+                paddle.save({"v": 2}, p)
+        assert open(p, "rb").read() == before
+        assert paddle.load(p) == {"v": 1}
+        # the error path reclaimed its tmp file
+        assert [f for f in os.listdir(str(tmp_path)) if ".tmp" in f] == []
+
+    def test_failed_first_save_leaves_no_file(self, tmp_path):
+        p = str(tmp_path / "fresh.pdparams")
+        with fp.scoped("ckpt/write=error:1"):
+            with pytest.raises(FailpointError):
+                paddle.save({"v": 1}, p)
+        assert not os.path.exists(p)
+
+
+class TestCorruptionRejection:
+    def test_flipped_byte_is_rejected(self, tmp_path):
+        p = str(tmp_path / "m.pdparams")
+        paddle.save(_state(), p)
+        blob = bytearray(open(p, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(p, "wb").write(bytes(blob))
+        with pytest.raises(CheckpointCorruptError, match="sha256"):
+            paddle.load(p)
+
+    def test_truncated_file_is_rejected(self, tmp_path):
+        p = str(tmp_path / "m.pdparams")
+        paddle.save(_state(), p)
+        blob = open(p, "rb").read()
+        open(p, "wb").write(blob[: len(blob) // 2])
+        with pytest.raises(CheckpointCorruptError):
+            paddle.load(p)
+
+    def test_empty_file_is_rejected_clearly(self, tmp_path):
+        p = str(tmp_path / "m.pdparams")
+        open(p, "wb").close()
+        with pytest.raises(CheckpointCorruptError, match="truncated"):
+            paddle.load(p)
+
+    def test_verified_file_unpickle_failure_is_not_corruption(self,
+                                                              tmp_path):
+        """A file whose sha256 footer verifies holds exactly the bytes save
+        wrote — an unpickle failure there is environmental (class moved
+        between versions, OOM), and must NOT be classified corrupt, or the
+        saver's fallback walk would evict a good checkpoint over it."""
+        import hashlib
+
+        from paddle_tpu.framework.io import _FOOTER_MAGIC
+
+        # a pickle referencing an attribute that does not exist at load
+        # time: GLOBAL os.NoSuchClass123 -> AttributeError inside load
+        payload = b"\x80\x04cos\nNoSuchClass123\n."
+        p = str(tmp_path / "moved.pdparams")
+        with open(p, "wb") as f:
+            f.write(payload)
+            f.write(_FOOTER_MAGIC + hashlib.sha256(payload).digest())
+        with pytest.raises(AttributeError):
+            paddle.load(p)
+        # ambiguous errors (AttributeError/MemoryError) propagate for
+        # footerless files too — only unambiguous pickle-level damage
+        # (UnpicklingError/EOFError/ValueError) is classified corrupt
+        legacy = str(tmp_path / "legacy_torn.pdparams")
+        open(legacy, "wb").write(payload)
+        with pytest.raises(AttributeError):
+            paddle.load(legacy)
+        torn = str(tmp_path / "garbage.pdparams")
+        open(torn, "wb").write(b"not a pickle at all")
+        with pytest.raises(CheckpointCorruptError):
+            paddle.load(torn)
+        # saver walk: the verified-but-unloadable checkpoint propagates
+        # instead of being evicted
+        saver = CheckpointSaver(str(tmp_path / "ckpts"))
+        saver.save_checkpoint({"v": 1})
+        sp = os.path.join(str(tmp_path / "ckpts"),
+                          "__paddle_checkpoint__.0", "state.pdparams")
+        with open(sp, "wb") as f:
+            f.write(payload)
+            f.write(_FOOTER_MAGIC + hashlib.sha256(payload).digest())
+        with pytest.raises(AttributeError):
+            saver.load_checkpoint()
+        assert saver.get_checkpoint_numbers() == [0]   # not evicted
+
+    def test_legacy_footerless_file_still_loads(self, tmp_path):
+        import pickle
+
+        p = str(tmp_path / "old.pdparams")
+        with open(p, "wb") as f:
+            pickle.dump({"legacy": True}, f, protocol=4)
+        assert paddle.load(p) == {"legacy": True}
+
+    def test_encrypted_round_trip_keeps_integrity_check(self, tmp_path):
+        p = str(tmp_path / "enc.pdparams")
+        paddle.save(_state(), p, encryption_key="k" * 32)
+        out = paddle.load(p, encryption_key="k" * 32)
+        assert out["step"] == 7
+        blob = bytearray(open(p, "rb").read())
+        blob[10] ^= 0xFF
+        open(p, "wb").write(bytes(blob))
+        with pytest.raises(CheckpointCorruptError, match="sha256"):
+            paddle.load(p, encryption_key="k" * 32)
+
+
+class TestSaverFallback:
+    def test_corrupt_newest_falls_back_and_evicts(self, tmp_path):
+        monitor.reset()
+        saver = CheckpointSaver(str(tmp_path))
+        saver.save_checkpoint({"v": paddle.to_tensor(np.zeros(2))},
+                              meta={"epoch": 0})
+        saver.save_checkpoint({"v": paddle.to_tensor(np.ones(2))},
+                              meta={"epoch": 1})
+        newest = os.path.join(str(tmp_path), "__paddle_checkpoint__.1",
+                              "state.pdparams")
+        blob = open(newest, "rb").read()
+        open(newest, "wb").write(blob[:24])   # truncate the newest
+        with pytest.warns(UserWarning, match="unreadable"):
+            state, meta = saver.load_checkpoint()
+        assert meta["epoch"] == 0
+        np.testing.assert_array_equal(np.asarray(state["v"]._data),
+                                      np.zeros(2))
+        assert saver.get_checkpoint_numbers() == [0]   # corrupt one evicted
+        c = monitor.counter("checkpoint_recover_total",
+                            labelnames=("reason",))
+        assert c.labels(reason="corrupt").value == 1
+
+    def test_all_corrupt_returns_none(self, tmp_path):
+        saver = CheckpointSaver(str(tmp_path))
+        saver.save_checkpoint({"v": 1})
+        f = os.path.join(str(tmp_path), "__paddle_checkpoint__.0",
+                         "state.pdparams")
+        open(f, "wb").write(b"garbage")
+        with pytest.warns(UserWarning):
+            state, meta = saver.load_checkpoint()
+        assert state is None and meta is None
+
+    def test_explicit_number_raises_instead_of_falling_back(self, tmp_path):
+        saver = CheckpointSaver(str(tmp_path))
+        saver.save_checkpoint({"v": 1})
+        saver.save_checkpoint({"v": 2})
+        f = os.path.join(str(tmp_path), "__paddle_checkpoint__.1",
+                         "state.pdparams")
+        open(f, "wb").write(b"garbage")
+        with pytest.raises(Exception):
+            saver.load_checkpoint(no=1)
+
+    def test_non_corruption_error_does_not_evict(self, tmp_path):
+        """A checkpoint that fails to load for a NON-corruption reason (here:
+        encrypted state, no key) must propagate the error, not be rmtree'd —
+        eviction is reserved for bad bytes."""
+        saver = CheckpointSaver(str(tmp_path))
+        saver.save_checkpoint({"v": 1})
+        enc = os.path.join(str(tmp_path), "__paddle_checkpoint__.0",
+                           "state.pdparams")
+        paddle.save({"v": 1}, enc, encryption_key="k" * 32)
+        with pytest.raises(ValueError, match="encrypted"):
+            saver.load_checkpoint()
+        assert saver.get_checkpoint_numbers() == [0]   # still on disk
+
+    def test_startup_sweeps_orphaned_tmp_dirs(self, tmp_path):
+        monitor.reset()
+        orphan = os.path.join(str(tmp_path), "__paddle_checkpoint__.4.tmp")
+        os.makedirs(orphan)
+        open(os.path.join(orphan, "state.pdparams.tmp.123"), "wb").write(b"x")
+        # age the marker-less dir past the mid-creation grace period
+        old = time.time() - 3600
+        os.utime(orphan, (old, old))
+        saver = CheckpointSaver(str(tmp_path))
+        assert not os.path.exists(orphan)
+        assert saver.get_checkpoint_numbers() == []
+        c = monitor.counter("checkpoint_recover_total",
+                            labelnames=("reason",))
+        assert c.labels(reason="tmp_swept").value == 1
+
+    def test_sweep_spares_live_concurrent_savers_tmp(self, tmp_path):
+        """A tmp dir whose owner.pid names a live OTHER process is a
+        concurrent saver mid-commit in a shared directory — sweeping it
+        would turn its atomic rename into ENOENT."""
+        live = os.path.join(str(tmp_path), "__paddle_checkpoint__.7.tmp")
+        os.makedirs(live)
+        with open(os.path.join(live, "owner.pid"), "w") as f:
+            f.write(str(os.getppid()))   # alive, and not us
+        CheckpointSaver(str(tmp_path))
+        assert os.path.isdir(live)
+        # once the owner is gone (dead pid), the next start reclaims it
+        with open(os.path.join(live, "owner.pid"), "w") as f:
+            f.write("999999999")
+        CheckpointSaver(str(tmp_path))
+        assert not os.path.exists(live)
+
+    def test_failed_save_checkpoint_leftovers_are_swept_next_start(
+            self, tmp_path):
+        saver = CheckpointSaver(str(tmp_path))
+        with fp.scoped("ckpt/commit=error:1"):
+            with pytest.raises(FailpointError):
+                saver.save_checkpoint({"v": 1})
+        # the aborted attempt left its tmp dir — a "crash" leftover
+        assert any(n.endswith(".tmp") for n in os.listdir(str(tmp_path)))
+        CheckpointSaver(str(tmp_path))   # restart sweeps
+        assert not any(n.endswith(".tmp")
+                       for n in os.listdir(str(tmp_path)))
+
+
+_KILL_WORKER = r'''
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.incubate.checkpoint.auto_checkpoint import CheckpointSaver
+from paddle_tpu.testing import failpoints
+
+save_dir = sys.argv[1]
+saver = CheckpointSaver(save_dir)
+saver.save_checkpoint({"v": paddle.to_tensor(np.zeros(4))},
+                      meta={"epoch": 0})
+print("SAVED_0", flush=True)
+# the second save dies by SIGKILL after the payload bytes are written but
+# BEFORE the integrity footer and the atomic commit
+failpoints.arm("ckpt/write", "kill")
+saver.save_checkpoint({"v": paddle.to_tensor(np.ones(4))},
+                      meta={"epoch": 1})
+print("UNREACHABLE", flush=True)
+'''
+
+
+@pytest.mark.slow
+def test_sigkill_mid_save_falls_back_to_previous_valid(tmp_path):
+    """Crash-mid-save e2e: the killed process leaves only a .tmp dir (the
+    destination is never torn — atomic commit), a restarted CheckpointSaver
+    sweeps it and resumes from the previous valid checkpoint."""
+    script = tmp_path / "worker.py"
+    script.write_text(_KILL_WORKER)
+    save_dir = tmp_path / "ckpts"
+    repo_root = os.path.dirname(os.path.dirname(paddle.__file__))
+    env = dict(os.environ, PYTHONPATH=repo_root + (
+        os.pathsep + os.environ["PYTHONPATH"]
+        if os.environ.get("PYTHONPATH") else ""))
+    res = subprocess.run([sys.executable, str(script), str(save_dir)],
+                         capture_output=True, text=True, timeout=300,
+                         env=env)
+    assert res.returncode == -signal.SIGKILL, (res.returncode, res.stderr)
+    assert "SAVED_0" in res.stdout and "UNREACHABLE" not in res.stdout
+    names = os.listdir(str(save_dir))
+    assert "__paddle_checkpoint__.0" in names
+    # checkpoint 1 never committed; its partial write sits in a .tmp dir
+    assert "__paddle_checkpoint__.1" not in names
+    assert any(n.endswith(".tmp") for n in names)
+
+    saver = CheckpointSaver(str(save_dir))   # "restart": sweeps the orphan
+    assert not any(n.endswith(".tmp") for n in os.listdir(str(save_dir)))
+    state, meta = saver.load_checkpoint()
+    assert meta["epoch"] == 0
+    np.testing.assert_array_equal(np.asarray(state["v"]._data), np.zeros(4))

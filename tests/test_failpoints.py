@@ -1,0 +1,176 @@
+"""Failpoint framework contract (paddle_tpu/testing/failpoints.py): spec
+parsing, arming/disarming, error:N counting, delay, scoped restore, flag
+arming, and the planted sites actually firing in their host modules."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.testing import failpoints as fp
+from paddle_tpu.testing.failpoints import FailpointError
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    fp.reset()
+    yield
+    fp.reset()
+    paddle.set_flags({"failpoints": ""})
+
+
+class TestSpecParsing:
+    def test_parse_multi_site_spec(self):
+        acts = fp.parse("ckpt/write=error:2, serving/step=delay:5")
+        assert set(acts) == {"ckpt/write", "serving/step"}
+        assert acts["ckpt/write"].kind == "error"
+        assert acts["ckpt/write"].remaining == 2
+        assert acts["serving/step"].kind == "delay"
+        assert acts["serving/step"].arg == 5.0
+
+    def test_unknown_site_lists_known_ones(self):
+        with pytest.raises(ValueError, match="known sites.*ckpt/write"):
+            fp.parse("no/such/site=error")
+
+    def test_bad_specs(self):
+        with pytest.raises(ValueError, match="site=action"):
+            fp.parse("ckpt/write")
+        with pytest.raises(ValueError, match="unknown action"):
+            fp.parse("ckpt/write=explode")
+        with pytest.raises(ValueError, match="delay needs"):
+            fp.parse("ckpt/write=delay")
+        with pytest.raises(ValueError, match=">= 1"):
+            fp.parse("ckpt/write=error:0")
+
+    def test_empty_spec_parses_empty(self):
+        assert fp.parse("") == {}
+        assert fp.parse(" , ") == {}
+
+
+class TestArming:
+    def test_arm_disarm_round_trip(self):
+        assert not fp.is_enabled()
+        fp.arm("ckpt/write", "error")
+        assert fp.is_enabled()
+        assert fp.armed() == {"ckpt/write": "error"}
+        fp.disarm("ckpt/write")
+        assert not fp.is_enabled()
+
+    def test_error_n_auto_disarms_after_n_fires(self):
+        fp.arm("ckpt/read", "error:2")
+        for _ in range(2):
+            with pytest.raises(FailpointError, match="ckpt/read"):
+                fp.failpoint("ckpt/read")
+        # third hit: site disarmed itself, nothing fires
+        fp.failpoint("ckpt/read")
+        assert fp.hits("ckpt/read") == 2
+        assert not fp.is_enabled()
+
+    def test_unarmed_site_is_inert_while_another_is_armed(self):
+        fp.arm("ckpt/write", "error")
+        fp.failpoint("serving/step")   # not armed: no-op
+        assert fp.hits("serving/step") == 0
+
+    def test_delay_sleeps(self):
+        fp.arm("serving/step", "delay:30")
+        t0 = time.perf_counter()
+        fp.failpoint("serving/step")
+        assert (time.perf_counter() - t0) * 1e3 >= 25
+
+    def test_scoped_restores_previous_state(self):
+        fp.arm("ckpt/write", "error:5")
+        with fp.scoped("ckpt/read=error:1"):
+            assert set(fp.armed()) == {"ckpt/write", "ckpt/read"}
+            with pytest.raises(FailpointError):
+                fp.failpoint("ckpt/read")
+        assert set(fp.armed()) == {"ckpt/write"}
+        assert fp.is_enabled()
+        fp.reset()
+        with fp.scoped("ckpt/read=error:1"):
+            pass
+        assert not fp.is_enabled()
+
+    def test_exhausted_error_n_does_not_refire_after_scoped_restore(self):
+        """scoped() restores the pre-scope arming dict by reference; an
+        error:N exhausted INSIDE the scope must stay exhausted after exit —
+        its budget is spent, not reset."""
+        fp.arm("ckpt/write", "error:1")
+        with fp.scoped("serving/step=delay:1"):
+            with pytest.raises(FailpointError):
+                fp.failpoint("ckpt/write")   # consumes the one shot
+        fp.failpoint("ckpt/write")   # restored-but-spent: must NOT fire
+        assert fp.hits("ckpt/write") == 1
+        assert not fp.is_enabled()
+
+    def test_arm_from_flag(self):
+        paddle.set_flags({"failpoints": "exe/compile=error:1"})
+        fp.arm_from_flag()
+        assert fp.armed() == {"exe/compile": "error:1"}
+        paddle.set_flags({"failpoints": ""})
+        fp.arm_from_flag()
+        assert not fp.is_enabled()
+
+    def test_trigger_metric_series_appears_on_fire(self):
+        from paddle_tpu import monitor
+
+        fp.arm("serving/step", "delay:0")
+        fp.failpoint("serving/step")
+        metric = monitor.default_registry().get("failpoint_trigger_total")
+        assert any(s.labels == {"site": "serving/step", "action": "delay"}
+                   for s in metric.series())
+
+
+class TestPlantedSites:
+    """Each planted site fires in its host module when armed."""
+
+    def test_ckpt_write_and_read_sites(self, tmp_path):
+        p = str(tmp_path / "s.pdparams")
+        with fp.scoped("ckpt/write=error:1"):
+            with pytest.raises(FailpointError):
+                paddle.save({"a": 1}, p)
+        paddle.save({"a": 1}, p)
+        with fp.scoped("ckpt/read=error:1"):
+            with pytest.raises(FailpointError):
+                paddle.load(p)
+        assert paddle.load(p) == {"a": 1}
+
+    def test_ckpt_commit_site(self, tmp_path):
+        from paddle_tpu.incubate.checkpoint.auto_checkpoint import \
+            CheckpointSaver
+
+        saver = CheckpointSaver(str(tmp_path))
+        with fp.scoped("ckpt/commit=error:1"):
+            with pytest.raises(FailpointError):
+                saver.save_checkpoint({"v": 1})
+        assert saver.get_checkpoint_numbers() == []   # nothing committed
+
+    def test_exe_compile_site(self):
+        import paddle_tpu.static as st
+
+        paddle.seed(0)
+        main, startup = st.Program(), st.Program()
+        st.enable_static()
+        try:
+            with st.program_guard(main, startup):
+                x = st.data("x", [None, 4])
+                w = paddle.create_parameter([4, 4])
+                y = paddle.matmul(x, w)
+        finally:
+            st.disable_static()
+        exe = st.Executor()
+        exe.run(startup)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        with fp.scoped("exe/compile=error:1"):
+            with pytest.raises(FailpointError):
+                exe.run(main, feed=feed, fetch_list=[y])
+        (r,) = exe.run(main, feed=feed, fetch_list=[y])   # recovers
+        assert np.isfinite(r).all()
+
+    def test_collective_call_site(self):
+        from paddle_tpu.distributed import collective
+
+        t = paddle.to_tensor(np.ones(4, np.float32))
+        with fp.scoped("collective/call=error:1"):
+            with pytest.raises(FailpointError):
+                collective.all_reduce(t)
+        collective.all_reduce(t)   # disarmed: identity at world_size 1

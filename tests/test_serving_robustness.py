@@ -1,0 +1,235 @@
+"""Serving robustness (inference/serving.py, docs/ROBUSTNESS.md): deadlines
+finish overdue requests without touching batch-mates, cancel() evicts
+anywhere in the lifecycle, the bounded queue rejects or priority-sheds,
+per-slot failures are isolated (injected via the serving/slot failpoint),
+health() reports ok/degraded/draining, and a stalled run_until_complete
+fails its in-flight requests instead of leaving them dangling."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.inference.serving import QueueFullError, ServingEngine
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.testing import failpoints as fp
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.reset()
+    yield
+    fp.reset()
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                    num_heads=2, max_seq_len=64, dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.RandomState(0)
+    return [rng.randint(0, 64, (n,)).astype(np.int32) for n in (5, 9, 4)]
+
+
+def _ref(m, p, n):
+    out = m.generate(paddle.to_tensor(p[None]), max_new_tokens=n,
+                     temperature=0.0)
+    return np.asarray(out._data)[0, len(p):]
+
+
+class TestDeadlines:
+    def test_overdue_request_finishes_with_deadline_reason(self, model,
+                                                           prompts):
+        eng = ServingEngine(model, max_batch=2)
+        r1 = eng.submit(prompts[0], max_new_tokens=6)
+        r2 = eng.submit(prompts[1], max_new_tokens=6, deadline_ms=0.001)
+        time.sleep(0.005)
+        res = eng.run_until_complete()
+        assert res[r2].finish_reason == "deadline"
+        # the batch-mate is untouched: exact greedy parity
+        np.testing.assert_array_equal(res[r1].tokens,
+                                      _ref(model, prompts[0], 6))
+        assert res[r1].finish_reason == "length"
+
+    def test_mid_decode_deadline(self, model, prompts):
+        eng = ServingEngine(model, max_batch=2)
+        # warm the whole program family first: the deadline clock starts
+        # at submit, and a cold first step pays seconds of compile
+        eng.submit(prompts[2], max_new_tokens=2)
+        eng.run_until_complete()
+        r1 = eng.submit(prompts[0], max_new_tokens=30)
+        r2 = eng.submit(prompts[1], max_new_tokens=30, deadline_ms=500)
+        for _ in range(3):
+            eng.step()
+        assert not eng.get_request(r2).finished
+        time.sleep(0.6)
+        eng.step()
+        assert eng.get_request(r2).finish_reason == "deadline"
+        res = eng.run_until_complete()
+        np.testing.assert_array_equal(res[r1].tokens,
+                                      _ref(model, prompts[0], 30))
+
+    def test_deadline_expiry_is_reported_by_step(self, model, prompts):
+        """step() returns every request finished during THAT step —
+        deadline expiries included, not just eos/length/error, or a
+        caller consuming step()'s return leaks expired requests."""
+        eng = ServingEngine(model, max_batch=1)
+        rid = eng.submit(prompts[0], max_new_tokens=2, deadline_ms=0.001)
+        time.sleep(0.005)
+        done = eng.step()
+        assert [r.rid for r in done] == [rid]
+        assert done[0].finish_reason == "deadline"
+
+    def test_deadline_metric_counts(self, model, prompts):
+        monitor.reset()
+        eng = ServingEngine(model, max_batch=1)
+        eng.submit(prompts[0], max_new_tokens=2, deadline_ms=0.001)
+        time.sleep(0.005)
+        eng.run_until_complete()
+        assert monitor.counter(
+            "request_deadline_exceeded_total").value == 1
+
+    def test_deadline_validation(self, model, prompts):
+        eng = ServingEngine(model, max_batch=1)
+        with pytest.raises(ValueError, match="deadline_ms"):
+            eng.submit(prompts[0], deadline_ms=0)
+        with pytest.raises(ValueError, match="deadline_ms"):
+            eng.submit(prompts[0], deadline_ms=-5)
+
+
+class TestCancel:
+    def test_cancel_everywhere_in_the_lifecycle(self, model, prompts):
+        eng = ServingEngine(model, max_batch=1)
+        r1 = eng.submit(prompts[0], max_new_tokens=8)
+        r2 = eng.submit(prompts[1], max_new_tokens=8)
+        eng.step()                       # r1 active, r2 queued
+        assert eng.cancel(r2) is True    # queued
+        assert eng.get_request(r2).finish_reason == "cancelled"
+        assert eng.cancel(r1) is True    # in-flight (slot freed)
+        assert eng.cancel(r1) is False   # already finished
+        with pytest.raises(KeyError):
+            eng.cancel(10_000)
+        assert not eng.has_work()
+
+    def test_cancelled_slot_is_reused(self, model, prompts):
+        eng = ServingEngine(model, max_batch=1)
+        r1 = eng.submit(prompts[0], max_new_tokens=20)
+        eng.step()
+        eng.cancel(r1)
+        r2 = eng.submit(prompts[1], max_new_tokens=5)
+        res = eng.run_until_complete()
+        np.testing.assert_array_equal(res[r2].tokens,
+                                      _ref(model, prompts[1], 5))
+
+
+class TestBoundedQueue:
+    def test_queue_full_raises(self, model, prompts):
+        monitor.reset()
+        eng = ServingEngine(model, max_batch=1, max_queue=1)
+        eng.submit(prompts[0], max_new_tokens=2)
+        with pytest.raises(QueueFullError, match="queue full"):
+            eng.submit(prompts[1], max_new_tokens=2)
+        shed = monitor.counter("request_shed_total", labelnames=("reason",))
+        assert shed.labels(reason="queue_full").value == 1
+
+    def test_higher_priority_sheds_lowest(self, model, prompts):
+        monitor.reset()
+        eng = ServingEngine(model, max_batch=1, max_queue=1)
+        low = eng.submit(prompts[0], max_new_tokens=2, priority=0)
+        high = eng.submit(prompts[1], max_new_tokens=2, priority=5)
+        assert eng.get_request(low).finish_reason == "shed"
+        shed = monitor.counter("request_shed_total", labelnames=("reason",))
+        assert shed.labels(reason="preempted").value == 1
+        res = eng.run_until_complete()
+        assert res[high].finish_reason == "length"
+
+    def test_equal_priority_does_not_shed(self, model, prompts):
+        eng = ServingEngine(model, max_batch=1, max_queue=1)
+        eng.submit(prompts[0], max_new_tokens=2, priority=3)
+        with pytest.raises(QueueFullError):
+            eng.submit(prompts[1], max_new_tokens=2, priority=3)
+
+    def test_max_queue_validation(self, model):
+        with pytest.raises(ValueError, match="max_queue"):
+            ServingEngine(model, max_batch=1, max_queue=0)
+
+
+class TestErrorIsolation:
+    def test_injected_slot_error_evicts_only_that_request(self, model,
+                                                          prompts):
+        eng = ServingEngine(model, max_batch=2)
+        rids = [eng.submit(p, max_new_tokens=6) for p in prompts[:2]]
+        eng.step()   # both admitted + first decode
+        with fp.scoped("serving/slot=error:1"):
+            eng.step()
+        res = eng.run_until_complete()
+        reasons = {rid: res[rid].finish_reason for rid in rids}
+        assert sorted(reasons.values()) == ["error", "length"]
+        # the survivor decodes to EXACT parity — its slot never noticed
+        (surv,) = [rid for rid in rids if reasons[rid] == "length"]
+        np.testing.assert_array_equal(
+            res[surv].tokens,
+            _ref(model, prompts[rids.index(surv)], 6))
+
+    def test_step_site_error_propagates_but_state_survives(self, model,
+                                                           prompts):
+        eng = ServingEngine(model, max_batch=2)
+        r1 = eng.submit(prompts[0], max_new_tokens=6)
+        with fp.scoped("serving/step=error:1"):
+            with pytest.raises(fp.FailpointError):
+                eng.step()
+        res = eng.run_until_complete()   # engine still functional
+        np.testing.assert_array_equal(res[r1].tokens,
+                                      _ref(model, prompts[0], 6))
+
+
+class TestHealthAndDrain:
+    def test_health_transitions(self, model, prompts):
+        eng = ServingEngine(model, max_batch=2, max_queue=10)
+        assert eng.health()["state"] == "ok"
+        eng.submit(prompts[0], max_new_tokens=4)
+        eng.step()
+        with fp.scoped("serving/slot=error:1"):
+            eng.step()
+        assert eng.health()["state"] == "degraded"   # recent slot error
+        eng.drain()
+        assert eng.health()["state"] == "draining"
+        with pytest.raises(RuntimeError, match="draining"):
+            eng.submit(prompts[1])
+        eng.drain(False)
+        assert eng.health()["state"] == "degraded"   # error still recent
+
+    def test_queue_pressure_degrades(self, model, prompts):
+        eng = ServingEngine(model, max_batch=1, max_queue=2)
+        eng.submit(prompts[0], max_new_tokens=2)
+        eng.submit(prompts[1], max_new_tokens=2)
+        assert eng.health()["state"] == "degraded"
+        assert eng.stats()["health"]["state"] == "degraded"
+
+    def test_stats_carries_health(self, model):
+        eng = ServingEngine(model, max_batch=1)
+        h = eng.stats()["health"]
+        assert h["state"] == "ok" and h["queue_depth"] == 0
+
+
+class TestStall:
+    def test_non_convergence_fails_in_flight_requests(self, model, prompts):
+        eng = ServingEngine(model, max_batch=1)
+        r1 = eng.submit(prompts[0], max_new_tokens=30)
+        r2 = eng.submit(prompts[1], max_new_tokens=30)
+        with pytest.raises(RuntimeError) as ei:
+            eng.run_until_complete(max_steps=3)
+        msg = str(ei.value)
+        assert "engine_stalled" in msg
+        assert str(r1) in msg and str(r2) in msg
+        for rid in (r1, r2):
+            assert eng.get_request(rid).finish_reason == "engine_stalled"
+        assert not eng.has_work()

@@ -1,0 +1,136 @@
+"""SpmdTrainer non-finite step guard (FLAGS_check_nan_inf,
+docs/ROBUSTNESS.md): a NaN/Inf loss or gradient SKIPS the optimizer update
+on-device — params, optimizer moments, and step counters stay bit-identical
+— for up to FLAGS_max_skip_steps consecutive steps before train_step raises
+FloatingPointError. With the flag off (default) behavior is exactly
+pre-guard."""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.distributed.spmd import SpmdTrainer
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    yield
+    paddle.set_flags({"check_nan_inf": False, "max_skip_steps": 3})
+
+
+def _trainer(**kw):
+    paddle.seed(0)
+    model = paddle.nn.Linear(4, 1)
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=model.parameters())
+    mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+    return SpmdTrainer(model, opt, loss_fn=paddle.nn.MSELoss(), mesh=mesh,
+                       **kw), opt
+
+
+def _snapshot(tr):
+    snap = {f"p/{k}": np.asarray(v).copy() for k, v in tr.params.items()}
+    for pname, st in tr.opt_state.items():
+        if pname == "__step__":
+            snap["__step__"] = np.asarray(st).copy()
+        else:
+            for k, v in st.items():
+                snap[f"s/{pname}/{k}"] = np.asarray(v).copy()
+    return snap
+
+
+def _assert_bit_identical(tr, snap):
+    now = _snapshot(tr)
+    assert set(now) == set(snap)
+    for k in snap:
+        assert now[k].tobytes() == snap[k].tobytes(), k
+
+
+X = np.ones((2, 4), np.float32)
+Y = np.zeros((2, 1), np.float32)
+XNAN = X.copy()
+XNAN[0, 0] = np.nan
+
+
+class TestGuard:
+    def test_nonfinite_step_skips_update_bit_identical(self):
+        paddle.set_flags({"check_nan_inf": True})
+        tr, opt = _trainer()
+        tr.train_step(X, Y)                    # one clean step
+        snap = _snapshot(tr)
+        count_before = opt._step_count
+        loss = tr.train_step(XNAN, Y)          # poisoned batch
+        assert np.isnan(float(np.asarray(loss._data)))
+        _assert_bit_identical(tr, snap)        # params AND Adam moments
+        assert opt._step_count == count_before  # LR schedule did not move
+        assert tr._nonfinite_streak == 1
+
+    def test_skip_metric_counts(self):
+        monitor.reset()
+        paddle.set_flags({"check_nan_inf": True})
+        tr, _ = _trainer()
+        tr.train_step(XNAN, Y)
+        skipped = monitor.counter("train_step_skipped_total",
+                                  labelnames=("reason",))
+        assert skipped.labels(reason="nonfinite").value == 1
+
+    def test_finite_step_resets_the_streak(self):
+        paddle.set_flags({"check_nan_inf": True, "max_skip_steps": 2})
+        tr, _ = _trainer()
+        tr.train_step(XNAN, Y)
+        tr.train_step(XNAN, Y)
+        assert tr._nonfinite_streak == 2
+        tr.train_step(X, Y)                    # recovery
+        assert tr._nonfinite_streak == 0
+        tr.train_step(XNAN, Y)                 # a fresh streak may restart
+        assert tr._nonfinite_streak == 1
+
+    def test_raises_after_max_consecutive_skips(self):
+        paddle.set_flags({"check_nan_inf": True, "max_skip_steps": 2})
+        tr, _ = _trainer()
+        snap = _snapshot(tr)
+        tr.train_step(XNAN, Y)
+        tr.train_step(XNAN, Y)
+        with pytest.raises(FloatingPointError, match="max_skip_steps"):
+            tr.train_step(XNAN, Y)
+        _assert_bit_identical(tr, snap)        # nothing ever applied
+
+    def test_inf_gradient_also_skips(self):
+        paddle.set_flags({"check_nan_inf": True})
+        tr, _ = _trainer()
+        snap = _snapshot(tr)
+        xinf = X.copy()
+        xinf[0, 0] = np.inf
+        tr.train_step(xinf, Y)
+        _assert_bit_identical(tr, snap)
+
+    def test_flag_off_is_pre_guard_behavior(self):
+        tr, opt = _trainer()
+        loss = tr.train_step(XNAN, Y)          # default flag: no guard
+        assert np.isnan(float(np.asarray(loss._data)))
+        # the update DID apply (NaN propagates into params) and counters moved
+        assert opt._step_count == 1
+        assert any(np.isnan(np.asarray(v)).any()
+                   for v in tr.params.values())
+
+    def test_toggling_flag_recompiles_not_misunpacks(self):
+        tr, opt = _trainer()
+        tr.train_step(X, Y)                    # unguarded executable cached
+        paddle.set_flags({"check_nan_inf": True})
+        snap = _snapshot(tr)
+        tr.train_step(XNAN, Y)                 # guarded executable, same sig
+        _assert_bit_identical(tr, snap)
+        paddle.set_flags({"check_nan_inf": False})
+        tr.train_step(X, Y)                    # back to the unguarded one
+        assert opt._step_count == 2
+
+    def test_guarded_clean_training_still_converges(self):
+        paddle.set_flags({"check_nan_inf": True})
+        tr, _ = _trainer()
+        losses = [float(np.asarray(tr.train_step(X, Y)._data))
+                  for _ in range(5)]
+        assert losses[-1] < losses[0]
+        assert tr._nonfinite_streak == 0

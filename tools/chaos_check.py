@@ -1,0 +1,332 @@
+"""Chaos gate CLI: drive short serving + trainer + checkpoint loops under a
+canned fault schedule (paddle_tpu.testing.failpoints) and verify every
+recovery path actually recovers.
+
+    python tools/chaos_check.py           # human-readable
+    python tools/chaos_check.py --json    # machine-readable report
+
+Checks (one entry per name in `passes`):
+
+  ckpt_atomic        a save killed between payload and commit leaves the
+                     destination checkpoint untouched
+  ckpt_fallback      a corrupt newest checkpoint is evicted and the
+                     previous valid one restored
+  serving_deadline   an overdue request finishes reason="deadline" while
+                     its batch-mate decodes to exact greedy parity
+  serving_slot_error an injected per-slot error evicts ONLY that slot;
+                     the survivor stays bit-exact
+  serving_shed       a full bounded queue raises QueueFullError and a
+                     higher-priority arrival sheds the lowest
+  trainer_nonfinite  a NaN batch under FLAGS_check_nan_inf skips the
+                     update, leaving params/moments bit-identical
+
+Report format: the tools/graph_lint.py schema ({"tool", "passes",
+"targets": {name: {"name", "counts", "findings"}}, "totals"}), so CI reads
+graph_lint, op_coverage, metrics_dump, aot_warm, and chaos_check through
+one loader. Exit code 1 when any recovery path fails (error-severity
+finding), else 0. Wired into tier-1 by tests/test_failpoints_gate.py.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PASSES = ["ckpt_atomic", "ckpt_fallback", "serving_deadline",
+          "serving_slot_error", "serving_shed", "trainer_nonfinite"]
+
+
+def _finding(name, severity, message, where=""):
+    return {"pass": name, "severity": severity, "message": message,
+            "where": where}
+
+
+def _ok(name, message):
+    return _finding(name, "info", message)
+
+
+def _check_ckpt_atomic():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.testing import failpoints as fp
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "state.pdparams")
+        paddle.save({"w": paddle.to_tensor(np.ones(4))}, p)
+        before = open(p, "rb").read()
+        try:
+            with fp.scoped("ckpt/write=error:1"):
+                paddle.save({"w": paddle.to_tensor(np.zeros(4))}, p)
+            return [_finding("ckpt_atomic", "error",
+                             "armed ckpt/write failpoint did not fire")]
+        except fp.FailpointError:
+            pass
+        if open(p, "rb").read() != before:
+            return [_finding("ckpt_atomic", "error",
+                             "destination changed after a failed save — "
+                             "the commit is not atomic", where=p)]
+        out = paddle.load(p)
+        if not np.array_equal(np.asarray(out["w"]._data), np.ones(4)):
+            return [_finding("ckpt_atomic", "error",
+                             "surviving checkpoint does not load the "
+                             "pre-fault state", where=p)]
+    return [_ok("ckpt_atomic",
+                "failed save left the committed checkpoint bit-intact")]
+
+
+def _check_ckpt_fallback():
+    import warnings
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.checkpoint.auto_checkpoint import \
+        CheckpointSaver
+
+    with tempfile.TemporaryDirectory() as d:
+        saver = CheckpointSaver(d)
+        saver.save_checkpoint({"v": paddle.to_tensor(np.zeros(2))},
+                              meta={"epoch": 0})
+        saver.save_checkpoint({"v": paddle.to_tensor(np.ones(2))},
+                              meta={"epoch": 1})
+        newest = os.path.join(d, "__paddle_checkpoint__.1",
+                              "state.pdparams")
+        blob = open(newest, "rb").read()
+        open(newest, "wb").write(blob[: len(blob) // 2])   # truncate
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            state, meta = saver.load_checkpoint()
+        if meta is None or meta.get("epoch") != 0:
+            return [_finding("ckpt_fallback", "error",
+                             "corrupt newest checkpoint did not fall back "
+                             f"to the previous valid one (meta={meta})",
+                             where=newest)]
+        if saver.get_checkpoint_numbers() != [0]:
+            return [_finding("ckpt_fallback", "error",
+                             "corrupt checkpoint was not evicted: "
+                             f"{saver.get_checkpoint_numbers()}")]
+    return [_ok("ckpt_fallback",
+                "corrupt newest checkpoint evicted; epoch-0 state restored")]
+
+
+def _tiny_model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                    num_heads=2, max_seq_len=64, dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _ref_tokens(m, p, n):
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    out = m.generate(paddle.to_tensor(p[None]), max_new_tokens=n,
+                     temperature=0.0)
+    return np.asarray(out._data)[0, len(p):]
+
+
+def _check_serving_deadline(m):
+    import numpy as np
+
+    from paddle_tpu.inference.serving import ServingEngine
+
+    rng = np.random.RandomState(0)
+    p1 = rng.randint(0, 64, (5,)).astype(np.int32)
+    p2 = rng.randint(0, 64, (9,)).astype(np.int32)
+    eng = ServingEngine(m, max_batch=2)
+    r1 = eng.submit(p1, max_new_tokens=6)
+    r2 = eng.submit(p2, max_new_tokens=6, deadline_ms=0.001)
+    time.sleep(0.005)
+    res = eng.run_until_complete()
+    if res[r2].finish_reason != "deadline":
+        return [_finding("serving_deadline", "error",
+                         "overdue request finished with "
+                         f"{res[r2].finish_reason!r}, not 'deadline'")]
+    if not np.array_equal(res[r1].tokens, _ref_tokens(m, p1, 6)):
+        return [_finding("serving_deadline", "error",
+                         "batch-mate of an expired request lost greedy "
+                         "parity")]
+    return [_ok("serving_deadline",
+                "overdue request expired; batch-mate stayed bit-exact")]
+
+
+def _check_serving_slot_error(m):
+    import numpy as np
+
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.testing import failpoints as fp
+
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, 64, (n,)).astype(np.int32) for n in (4, 7)]
+    eng = ServingEngine(m, max_batch=2)
+    rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.step()
+    with fp.scoped("serving/slot=error:1"):
+        eng.step()
+    res = eng.run_until_complete()
+    reasons = {rid: res[rid].finish_reason for rid in rids}
+    if sorted(reasons.values()) != ["error", "length"]:
+        return [_finding("serving_slot_error", "error",
+                         "injected slot error did not evict exactly one "
+                         f"request (reasons={reasons})")]
+    (surv,) = [rid for rid in rids if reasons[rid] == "length"]
+    if not np.array_equal(res[surv].tokens,
+                          _ref_tokens(m, prompts[rids.index(surv)], 6)):
+        return [_finding("serving_slot_error", "error",
+                         "the surviving slot lost greedy parity")]
+    return [_ok("serving_slot_error",
+                "injected slot error isolated; survivor bit-exact")]
+
+
+def _check_serving_shed(m):
+    import numpy as np
+
+    from paddle_tpu.inference.serving import QueueFullError, ServingEngine
+
+    rng = np.random.RandomState(2)
+    p = rng.randint(0, 64, (5,)).astype(np.int32)
+    eng = ServingEngine(m, max_batch=1, max_queue=1)
+    low = eng.submit(p, max_new_tokens=2, priority=0)
+    try:
+        eng.submit(p, max_new_tokens=2, priority=0)
+        return [_finding("serving_shed", "error",
+                         "full queue accepted an equal-priority request")]
+    except QueueFullError:
+        pass
+    eng.submit(p, max_new_tokens=2, priority=5)
+    if eng.get_request(low).finish_reason != "shed":
+        return [_finding("serving_shed", "error",
+                         "higher-priority arrival did not shed the "
+                         "lowest-priority queued request")]
+    return [_ok("serving_shed",
+                "queue bound enforced; priority shedding works")]
+
+
+def _check_trainer_nonfinite():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+
+    paddle.set_flags({"check_nan_inf": True})
+    try:
+        paddle.seed(0)
+        model = paddle.nn.Linear(4, 1)
+        opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                    parameters=model.parameters())
+        mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+        tr = SpmdTrainer(model, opt, loss_fn=paddle.nn.MSELoss(),
+                         mesh=mesh)
+        x = np.ones((2, 4), np.float32)
+        y = np.zeros((2, 1), np.float32)
+        tr.train_step(x, y)
+        snap = {k: np.asarray(v).copy() for k, v in tr.params.items()}
+        count = opt._step_count
+        xnan = x.copy()
+        xnan[0, 0] = np.nan
+        loss = tr.train_step(xnan, y)
+        if not np.isnan(float(np.asarray(loss._data))):
+            return [_finding("trainer_nonfinite", "error",
+                             "poisoned batch did not produce a NaN loss — "
+                             "the scenario itself is broken")]
+        drift = [k for k, v in tr.params.items()
+                 if np.asarray(tr.params[k]).tobytes() != snap[k].tobytes()]
+        if drift:
+            return [_finding("trainer_nonfinite", "error",
+                             "non-finite step leaked into parameters: "
+                             f"{drift}")]
+        if opt._step_count != count:
+            return [_finding("trainer_nonfinite", "error",
+                             "skipped step advanced the optimizer step "
+                             "count")]
+    finally:
+        paddle.set_flags({"check_nan_inf": False})
+    return [_ok("trainer_nonfinite",
+                "NaN step skipped; parameters bit-identical")]
+
+
+def build_report(only=None):
+    """Run the fault schedule; `only` restricts to a subset of PASSES
+    (the model is only built when a serving check is selected)."""
+    selected = set(only) if only else set(PASSES)
+    unknown = selected - set(PASSES)
+    if unknown:
+        raise ValueError(f"unknown chaos pass(es) {sorted(unknown)}; "
+                         f"known: {PASSES}")
+    findings = []
+    checks = [
+        ("ckpt_atomic", _check_ckpt_atomic),
+        ("ckpt_fallback", _check_ckpt_fallback),
+        ("trainer_nonfinite", _check_trainer_nonfinite),
+    ]
+    if selected & {"serving_deadline", "serving_slot_error",
+                   "serving_shed"}:
+        m = _tiny_model()
+        checks += [
+            ("serving_deadline", lambda: _check_serving_deadline(m)),
+            ("serving_slot_error", lambda: _check_serving_slot_error(m)),
+            ("serving_shed", lambda: _check_serving_shed(m)),
+        ]
+    for name, fn in checks:
+        if name not in selected:
+            continue
+        try:
+            findings.extend(fn())
+        except Exception as e:   # a crashed check IS a failed recovery path
+            findings.append(_finding(
+                name, "error",
+                f"check crashed: {type(e).__name__}: {e}"))
+    counts = {"error": 0, "warning": 0, "info": 0}
+    for f in findings:
+        counts[f["severity"]] = counts.get(f["severity"], 0) + 1
+    return {
+        "tool": "chaos_check",
+        "passes": PASSES,
+        "targets": {"chaos": {"name": "chaos", "counts": counts,
+                              "findings": findings}},
+        "totals": dict(counts),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the machine-readable report")
+    ap.add_argument("--only", action="append", choices=PASSES,
+                    help="run only this check (repeatable)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.testing import failpoints as fp
+
+    fp.reset()   # a canned schedule must start from a clean slate
+    try:
+        report = build_report(only=args.only)
+    finally:
+        fp.reset()
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        for f in report["targets"]["chaos"]["findings"]:
+            print(f"  [{f['severity']}] {f['pass']}: {f['message']}")
+        t = report["totals"]
+        print(f"total: {t['error']} error(s), {t['info']} recovery "
+              f"path(s) verified")
+    return 1 if report["totals"]["error"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
