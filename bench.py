@@ -100,7 +100,7 @@ def _heartbeat(phase, status="start", **fields):
     the previous heartbeat, so a wedged phase is attributable to compile
     vs runtime from the artifact alone."""
     try:
-        from paddle_tpu import flags, monitor
+        from paddle_tpu import flags, monitor, trace
 
         if not flags.get_flag("monitor_log_path", ""):
             flags.set_flags(
@@ -111,9 +111,14 @@ def _heartbeat(phase, status="start", **fields):
                  if v != _LAST_CACHE_COUNTS.get(k, 0)}
         _LAST_CACHE_COUNTS.clear()
         _LAST_CACHE_COUNTS.update(counts)
+        # trace summary (FLAGS_trace runs): span count + top-3 span
+        # totals, so a wedged phase's heartbeat also names WHERE the
+        # traced time went (prefill vs decode vs compile vs checkpoint)
+        tsum = trace.snapshot_summary(3)
         monitor.log_event("bench_phase", phase=phase, status=status,
                           compile_cache=counts, compile_cache_delta=delta,
                           jit_cache_dir=flags.get_flag("jit_cache_dir", ""),
+                          trace_spans=tsum["spans"], trace_top=tsum["top"],
                           **fields)
     except Exception:
         pass

@@ -81,6 +81,11 @@ from . import analysis  # noqa: E402
 from . import quantization  # noqa: E402
 from . import profiler as profiler  # noqa: E402
 from . import monitor  # noqa: E402
+# the dotted import FIRST: it forces the tracing subpackage to load and
+# replaces the 'trace' attr (the tensor-star math op) with the CALLABLE
+# module — paddle.trace(x) keeps the op API, paddle.trace.span() traces
+from .trace import costs as _trace_costs  # noqa: E402,F401
+from . import trace  # noqa: E402
 from . import testing  # noqa: E402
 from . import utils  # noqa: E402
 from . import regularizer  # noqa: E402

@@ -15,12 +15,15 @@ reference's behavior when nranks == 1 (collective ops skip NCCL).
 No stream-sync ops exist: XLA orders collectives (c_sync_*_stream -> no-op).
 """
 import contextlib
+import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from .. import monitor as _monitor
+from ..trace import costs as _costs  # noqa: F401  (imports the module)
+from .. import trace as _trace
 from ..core.tensor import Tensor
 from ..testing import failpoints as _fp
 from . import env as _env
@@ -42,6 +45,14 @@ def _stat(kind, x):
     else:
         nbytes = _monitor.tensor_nbytes(x)
     _monitor.record_collective(kind, nbytes)
+    if _trace.is_enabled():
+        # instantaneous span tagged with the payload size: host-side
+        # API-call accounting (a call inside a jit trace records once per
+        # TRACE), inheriting trace/parent ids from any enclosing span
+        now = time.perf_counter_ns()
+        _trace.emit("collective/" + kind, now, now,
+                    subsystem="collective", parent=_trace.current_span(),
+                    bytes=nbytes)
 
 
 class ReduceOp:

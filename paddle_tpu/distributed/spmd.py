@@ -24,6 +24,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import flags as _flags
 from .. import monitor as _monitor
+from ..trace import costs as _costs
+from .. import trace as _trace
 from ..core.tape import global_tape
 from ..core.tensor import Tensor
 from ..framework import aot as _aot
@@ -207,6 +209,18 @@ class SpmdTrainer:
         #                             guarded) — guarded steps return an
         #                             extra on-device finiteness flag
         self._nonfinite_streak = 0  # consecutive skipped steps
+        self._nonfinite_total = 0   # lifetime skipped steps (stats())
+        # step-time accounting for stats(): host wall time per step plus
+        # the FLAGS_benchmark sync share, joined with the cost registry's
+        # per-executable FLOPs into the MFU report (docs/OBSERVABILITY.md)
+        self._step_count = 0
+        self._step_ms_sum = 0.0
+        self._sync_ms_sum = 0.0
+        self._last_sig = None       # batch-sig label of the last step
+        self._step_span = None      # open span of the in-flight step
+        self._cost_entries = {}     # THIS trainer's sig -> cost entry: a
+        #                             second trainer with the same batch
+        #                             shapes must not clobber our join
         self.params = {n: p._data for n, p in layer.named_parameters() if getattr(p, "trainable", True)}
         self.frozen = {n: p._data for n, p in layer.named_parameters() if not getattr(p, "trainable", True)}
         self.buffers = {n: b._data for n, b in layer.named_buffers()}
@@ -673,7 +687,7 @@ class SpmdTrainer:
                 jitted,
                 (self.params, self.opt_state, self.buffers, lr, rng,
                  *batch_arrays),
-                site="trainer", force=force,
+                site="trainer", force=force or _trace.is_enabled(),
                 extra_key=("trainer", _aot.mesh_fingerprint(self.mesh),
                            self.dp_axis, self.sharding_stage,
                            self.accumulate_steps, guarded))
@@ -681,6 +695,10 @@ class SpmdTrainer:
                                                               guarded)
         self._compiled = compiled  # latest executable (back-compat handle)
         _aot.record_compile("trainer", sig, source)
+        cost_entry = _costs.record("trainer", sig,
+                                   _aot.executable_of(compiled))
+        if cost_entry is not None:
+            self._cost_entries[sig] = cost_entry
         return source
 
     def aot_build(self, batch_specs):
@@ -720,68 +738,157 @@ class SpmdTrainer:
         # paddle.seed, varies per step — a trace-time key would bake ONE
         # dropout mask into the compiled program
         rng = default_generator().fold_in(self.optimizer._step_count)
+        sig_label = _batch_sig_label(batch_arrays)
+        self._last_sig = sig_label
         entry = self._compiled_store.get(self._exec_key(batch_arrays))
         if entry is None:
-            self._aot_compile(batch_arrays, lr, rng)
+            source = self._aot_compile(batch_arrays, lr, rng)
             entry = self._compiled_store[self._exec_key(batch_arrays)]
-        elif _monitor.is_enabled():
-            _aot.record_compile("trainer", _batch_sig_label(batch_arrays),
-                                "memory")
+        else:
+            source = "memory"
+            if _monitor.is_enabled():
+                _aot.record_compile("trainer", sig_label, "memory")
         compiled, guarded = entry
-        if self.localsgd_k or self._is_dgc():
-            loss, self.params, self.opt_state, self.buffers = compiled(
+        # exec window starts AFTER compile resolution: stats()/MFU must
+        # divide flops by run time, not by jit-build + AOT-compile time
+        # (step_latency_ms keeps its historical include-compile meaning)
+        t_exec = time.perf_counter()
+        # step span: compile-cache source + batch signature (+sync time,
+        # stamped by _finish_step); carries the step's trace identity
+        self._step_span = _trace.start_span(
+            "train_step", subsystem="trainer", sig=sig_label, source=source,
+            step=int(self.optimizer._step_count), guarded=guarded)
+        try:
+            if self.localsgd_k or self._is_dgc():
+                loss, self.params, self.opt_state, self.buffers = compiled(
+                    self.params, self.opt_state, self.buffers, lr, rng, *batch_arrays
+                )
+                self.optimizer._step_count += 1
+                return self._finish_step(loss, t_step, t_exec)
+            finite = None
+            out = compiled(
                 self.params, self.opt_state, self.buffers, lr, rng, *batch_arrays
             )
+            if self.return_outputs:  # ctor rejects localsgd/dgc combinations
+                if guarded:
+                    loss, self.params, self.opt_state, self.buffers, outs, \
+                        finite = out
+                else:
+                    loss, self.params, self.opt_state, self.buffers, outs = out
+                self.last_outputs = jax.tree_util.tree_map(Tensor, outs)
+            else:
+                if guarded:
+                    loss, self.params, self.opt_state, self.buffers, finite = out
+                else:
+                    loss, self.params, self.opt_state, self.buffers = out
+            if finite is not None and not bool(np.asarray(finite)):
+                # update was skipped ON DEVICE (params/state/buffers selected
+                # pre-update, bit-identical); the host decides whether the run
+                # survives. _step_count stays put: the skipped step retries
+                # with the same LR/rng schedule position.
+                self._nonfinite_streak += 1
+                self._nonfinite_total += 1
+                _SKIPPED.labels(reason="nonfinite").inc()
+                sp = self._step_span
+                if sp is not None:
+                    sp.set(skipped="nonfinite")
+                max_skip = int(_flags.get_flag("max_skip_steps", 3))
+                if self._nonfinite_streak > max_skip:
+                    raise FloatingPointError(
+                        f"train_step: non-finite loss/gradients for "
+                        f"{self._nonfinite_streak} consecutive steps "
+                        f"(> FLAGS_max_skip_steps={max_skip}); aborting — "
+                        "parameters are unchanged (all updates were skipped); "
+                        "inspect the data pipeline / learning rate")
+                return self._finish_step(loss, t_step, t_exec)
+            if finite is not None:
+                self._nonfinite_streak = 0
             self.optimizer._step_count += 1
-            return self._finish_step(loss, t_step)
-        finite = None
-        out = compiled(
-            self.params, self.opt_state, self.buffers, lr, rng, *batch_arrays
-        )
-        if self.return_outputs:  # ctor rejects localsgd/dgc combinations
-            if guarded:
-                loss, self.params, self.opt_state, self.buffers, outs, \
-                    finite = out
-            else:
-                loss, self.params, self.opt_state, self.buffers, outs = out
-            self.last_outputs = jax.tree_util.tree_map(Tensor, outs)
-        else:
-            if guarded:
-                loss, self.params, self.opt_state, self.buffers, finite = out
-            else:
-                loss, self.params, self.opt_state, self.buffers = out
-        if finite is not None and not bool(np.asarray(finite)):
-            # update was skipped ON DEVICE (params/state/buffers selected
-            # pre-update, bit-identical); the host decides whether the run
-            # survives. _step_count stays put: the skipped step retries
-            # with the same LR/rng schedule position.
-            self._nonfinite_streak += 1
-            _SKIPPED.labels(reason="nonfinite").inc()
-            max_skip = int(_flags.get_flag("max_skip_steps", 3))
-            if self._nonfinite_streak > max_skip:
-                raise FloatingPointError(
-                    f"train_step: non-finite loss/gradients for "
-                    f"{self._nonfinite_streak} consecutive steps "
-                    f"(> FLAGS_max_skip_steps={max_skip}); aborting — "
-                    "parameters are unchanged (all updates were skipped); "
-                    "inspect the data pipeline / learning rate")
-            return self._finish_step(loss, t_step)
-        if finite is not None:
-            self._nonfinite_streak = 0
-        self.optimizer._step_count += 1
-        return self._finish_step(loss, t_step)
+            return self._finish_step(loss, t_step, t_exec)
+        except BaseException:
+            # the failing step still leaves its span (the very step a
+            # trace gets pulled for); a stale handle must not leak into
+            # the next step's _finish_step
+            sp = self._step_span
+            if sp is not None:
+                sp.end(error=True)
+                self._step_span = None
+            raise
 
-    def _finish_step(self, loss, t_step):
+    def _finish_step(self, loss, t_step, t_exec=None):
         """Monitor tail of train_step: optional FLAGS_benchmark device sync
-        (so step_latency_ms measures device work) + the latency sample."""
+        (so step_latency_ms measures device work) + the latency sample +
+        the step-span/stats() accounting the MFU report reads. `t_step`
+        includes any compile (the histogram's historical meaning);
+        `t_exec` excludes it — that is what stats()/MFU accumulate, so a
+        2-step run is not dominated by the first step's compile."""
+        sync_ms = 0.0
         if _flags.get_flag("benchmark"):
+            t_sync = time.perf_counter()
             if hasattr(loss, "block_until_ready"):
                 loss.block_until_ready()
             _BENCH_SYNC.labels(site="trainer").inc()
+            sync_ms = (time.perf_counter() - t_sync) * 1e3
+        now = time.perf_counter()
+        step_ms = (now - t_step) * 1e3
+        exec_ms = (now - (t_exec if t_exec is not None else t_step)) * 1e3
         if _monitor.is_enabled():
-            _STEP_MS.labels(site="trainer").observe(
-                (time.perf_counter() - t_step) * 1e3)
+            _STEP_MS.labels(site="trainer").observe(step_ms)
+        self._step_count += 1
+        self._step_ms_sum += exec_ms
+        self._sync_ms_sum += sync_ms
+        sp = self._step_span
+        if sp is not None:
+            sp.end(sync_ms=sync_ms, step_ms=step_ms, exec_ms=exec_ms)
+            self._step_span = None
+            _trace.add_counter_sample("trainer_step_ms", step_ms)
         return Tensor(loss)
+
+    def stats(self):
+        """Trainer observability snapshot: step counts/wall time joined
+        with the device cost registry into an MFU estimate.
+
+        ``mfu`` = per-step executable FLOPs (XLA ``cost_analysis()``,
+        captured at compile under site="trainer") / (average measured
+        step wall seconds × device peak FLOP/s). The flops source is the
+        compiled train-step executable itself — forward+backward+update,
+        exactly what ran — not an analytic 6·N·tokens formula. None until
+        both a step has run and the cost registry holds this batch
+        signature's entry (FLAGS_trace=1, FLAGS_jit_cache_dir, or
+        aot_build() all populate it)."""
+        # THIS trainer's entry first: the site-global table keys by batch
+        # signature only, which two trainers over different models can
+        # share (tools/metrics_dump.py --all does exactly that)
+        entry = (self._cost_entries.get(self._last_sig)
+                 or _costs.get("trainer", self._last_sig)
+                 if self._last_sig else None)
+        n = self._step_count
+        avg_ms = self._step_ms_sum / n if n else None
+        flops = entry.get("flops") if entry else None
+        peak = _costs.peak_flops()
+        mfu = None
+        if flops and avg_ms and peak:
+            mfu = float(flops) / ((avg_ms / 1e3) * peak)
+        return {
+            "steps": n,
+            "step_ms_total": self._step_ms_sum,
+            "step_ms_avg": avg_ms,
+            "batch_sig": self._last_sig,
+            "flops_per_step": flops,
+            "hbm": ({k: entry[k] for k in ("peak_bytes", "argument_bytes",
+                                           "output_bytes", "temp_bytes")
+                     if k in entry} if entry else None),
+            "peak_flops": peak,
+            "mfu": mfu,
+            "breakdown": {
+                "sync_ms_total": self._sync_ms_sum,
+                "dispatch_ms_total": max(
+                    0.0, self._step_ms_sum - self._sync_ms_sum),
+                "nonfinite_skipped_total": self._nonfinite_total,
+                "nonfinite_streak": self._nonfinite_streak,
+            },
+            "device_memory": _costs.sample_device_memory(),
+        }
 
     def sync_to_layer(self):
         """Write the (possibly sharded) params back into the Layer's tensors."""

@@ -57,10 +57,14 @@ import jax
 
 from .. import flags as _flags
 from .. import monitor as _monitor
+# the dotted form FIRST: it imports the paddle_tpu.trace module (the
+# package attribute may still be the paddle.trace math op at this point)
+from ..trace import costs as _costs
+from .. import trace as _trace
 from ..profiler import RecordEvent as _RecordEvent
 
 __all__ = ["cache_dir", "enabled", "args_signature", "mesh_fingerprint",
-           "compile_cached", "CachedJit", "cached_jit"]
+           "compile_cached", "CachedJit", "cached_jit", "executable_of"]
 
 _flags.define_flag(
     "jit_cache_dir", "",
@@ -131,6 +135,15 @@ def record_compile(site, sig_label, source):
             source="disk" if source == "disk" else "fresh").inc()
     if source != "disk":
         _COMPILES.labels(site=site).inc()
+
+
+def executable_of(fn):
+    """The underlying XLA executable of a compile_cached/CachedJit
+    result, or None for bypass results (a plain lazy jit has no
+    executable to cost-account until its first call)."""
+    if isinstance(fn, _GuardedCompiled):
+        return fn._compiled
+    return None
 
 
 def cache_dir():
@@ -466,6 +479,14 @@ class CachedJit:
         self._record_event = record_event or f"{site}/compile"
         self._extra_key = tuple(extra_key) + (self._label,)
         self._store = {}
+        self._cost_entries = {}   # sig -> trace.costs entry (exact per
+        #                           signature: bucketed families differ)
+        # wrapper-LOCAL execution accounting: two engines sharing the
+        # 'serving' site must not average each other's program flops
+        # (callers are effectively single-threaded per wrapper; these are
+        # observability counters, not the registry's locked metrics)
+        self._exec_calls = 0
+        self._exec_flops = 0.0
 
     def lower(self, *args, **kwargs):
         return self._jit.lower(*args, **kwargs)
@@ -483,6 +504,15 @@ class CachedJit:
                 self._jit, args, site=self._site,
                 extra_key=self._extra_key, force=True)
         record_compile(self._site, self._label_of(args), source)
+        # device cost registry: every executable this wrapper obtains —
+        # fresh, warmed, or an AOT-cache deserialize hit — lands its
+        # cost_analysis()/memory_analysis() under (site, program label);
+        # the exact per-signature entry is also kept so executions of a
+        # bucketed family account each bucket's own flops
+        entry = _costs.record(self._site, self._label_of(args),
+                              executable_of(compiled))
+        if entry is not None:
+            self._cost_entries[sig] = entry
         self._store[sig] = compiled
         return compiled
 
@@ -498,17 +528,29 @@ class CachedJit:
 
     def __call__(self, *args):
         store = self._store
-        if not store and not enabled():
+        if not store and not enabled() and not _trace.is_enabled():
             return self._jit(*args)
         sig = args_signature(args)
         compiled = store.get(sig)
         if compiled is None:
-            if not enabled():
+            if not enabled() and not _trace.is_enabled():
                 return self._jit(*args)  # warmed, but not for this sig
+            # FLAGS_trace forces eager AOT (in memory when no cache dir)
+            # so the cost registry sees an executable for every program
             compiled = self._compile(sig, args)
         else:
             record_compile(self._site, self._label_of(args), "memory")
+        entry = self._cost_entries.get(sig)
+        if entry is not None:   # wrapper-local: no lock on the hot path
+            self._exec_calls += 1
+            self._exec_flops += entry.get("flops", 0.0)
         return compiled(*args)
+
+    def executed(self):
+        """THIS wrapper's execution accounting: {"calls", "flops"} summed
+        over every signature it dispatched (per-bucket exact). Empty
+        until cost entries exist (FLAGS_trace / cache dir / warm())."""
+        return {"calls": self._exec_calls, "flops": self._exec_flops}
 
 
 def cached_jit(fn=None, **kwargs):

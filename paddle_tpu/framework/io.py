@@ -19,6 +19,8 @@ import time
 import numpy as np
 
 from .. import monitor as _monitor
+from ..trace import costs as _costs  # noqa: F401  (imports the module)
+from .. import trace as _trace
 from ..core.tensor import Tensor
 from ..profiler import RecordEvent as _RecordEvent
 from ..testing import failpoints as _fp
@@ -43,15 +45,20 @@ _CKPT_BYTES = _monitor.counter("checkpoint_bytes_total",
                                labelnames=("op",))
 
 
-def _record_ckpt(op, path, t0):
+def _record_ckpt(op, path, t0, span=None):
+    nbytes = None
+    try:
+        nbytes = os.path.getsize(path)
+    except OSError:
+        pass
+    if span is not None:   # trace span tagged with the payload size
+        span.end(path=path, **({} if nbytes is None else {"bytes": nbytes}))
     if not _monitor.is_enabled():
         return
     _CKPT.labels(op=op).inc()
     _CKPT_MS.labels(op=op).observe((time.perf_counter() - t0) * 1e3)
-    try:
-        _CKPT_BYTES.labels(op=op).inc(os.path.getsize(path))
-    except OSError:
-        pass
+    if nbytes is not None:
+        _CKPT_BYTES.labels(op=op).inc(nbytes)
     _monitor.log_event("checkpoint", op=op, path=path)
 
 
@@ -138,6 +145,7 @@ def save(obj, path, protocol=4, **configs):
         os.makedirs(d, exist_ok=True)
     _reclaim_stale_tmps(path)
     t0 = time.perf_counter()
+    sp = _trace.start_span("checkpoint/save", subsystem="io")
     tmp = f"{path}.tmp.{os.getpid()}"
     with _RecordEvent("checkpoint/save"):
         try:
@@ -167,8 +175,9 @@ def save(obj, path, protocol=4, **configs):
                 os.remove(tmp)
             except OSError:
                 pass
+            sp.end(error=True)   # the failed save still leaves its span
             raise
-    _record_ckpt("save", path, t0)
+    _record_ckpt("save", path, t0, span=sp)
 
 
 def _fsync_dir(path):
@@ -222,42 +231,52 @@ def load(path, **configs):
 
     key = configs.get("encryption_key")
     t0 = time.perf_counter()
-    with _RecordEvent("checkpoint/load"), open(path, "rb") as f:
-        _fp.failpoint("ckpt/read")
-        payload_len, verified = _verify_footer(f, path)
-        if f.read(4) == _MAGIC:
-            if key is None:
-                raise ValueError(f"{path} is encrypted; pass encryption_key=")
-            from .crypto import AESCipher
+    sp = _trace.start_span("checkpoint/load", subsystem="io")
+    try:
+        with _RecordEvent("checkpoint/load"), open(path, "rb") as f:
+            _fp.failpoint("ckpt/read")
+            payload_len, verified = _verify_footer(f, path)
+            if f.read(4) == _MAGIC:
+                if key is None:
+                    raise ValueError(
+                        f"{path} is encrypted; pass encryption_key=")
+                from .crypto import AESCipher
 
+                f.seek(0)
+                out = _unpack(pickle.loads(AESCipher(key).decrypt(
+                    f.read(payload_len))))
+                _record_ckpt("load", path, t0, span=sp)
+                return out
+            if key is not None:
+                # caller expected an authenticated payload — a plain-pickle
+                # file here means tampering or a save/load mismatch, not a
+                # soft fallback
+                raise ValueError(
+                    f"encryption_key given but {path} is not encrypted "
+                    "(magic header missing); refusing to load "
+                    "unauthenticated data")
             f.seek(0)
-            out = _unpack(pickle.loads(AESCipher(key).decrypt(
-                f.read(payload_len))))
-            _record_ckpt("load", path, t0)
-            return out
-        if key is not None:
-            # caller expected an authenticated payload — a plain-pickle file
-            # here means tampering or a save/load mismatch, not a soft fallback
-            raise ValueError(
-                f"encryption_key given but {path} is not encrypted "
-                "(magic header missing); refusing to load unauthenticated data")
-        f.seek(0)
-        try:
-            out = _unpack(pickle.load(f))
-        except (pickle.UnpicklingError, EOFError, ValueError) as e:
-            # AttributeError/MemoryError are deliberately NOT here: they
-            # are as likely environmental (a class moved between versions,
-            # OOM on a big state_dict) as corruption, and a corrupt
-            # classification lets CheckpointSaver's fallback walk DELETE
-            # the file — when ambiguous, propagate and keep the data
-            if verified:
-                # the sha256 footer proved the bytes are exactly what save
-                # wrote — this failure is environmental, NOT corruption
-                raise
-            raise CheckpointCorruptError(
-                f"{path}: cannot unpickle checkpoint payload ({e}) — the "
-                "file is truncated or corrupt") from e
-    _record_ckpt("load", path, t0)
+            try:
+                out = _unpack(pickle.load(f))
+            except (pickle.UnpicklingError, EOFError, ValueError) as e:
+                # AttributeError/MemoryError are deliberately NOT here:
+                # they are as likely environmental (a class moved between
+                # versions, OOM on a big state_dict) as corruption, and a
+                # corrupt classification lets CheckpointSaver's fallback
+                # walk DELETE the file — when ambiguous, propagate and
+                # keep the data
+                if verified:
+                    # the sha256 footer proved the bytes are exactly what
+                    # save wrote — this failure is environmental, NOT
+                    # corruption
+                    raise
+                raise CheckpointCorruptError(
+                    f"{path}: cannot unpickle checkpoint payload ({e}) — "
+                    "the file is truncated or corrupt") from e
+    except BaseException:
+        sp.end(error=True)   # the failed load still leaves its span
+        raise
+    _record_ckpt("load", path, t0, span=sp)
     return out
 
 

@@ -66,6 +66,8 @@ import time
 import numpy as np
 
 from .. import monitor as _monitor
+from ..trace import costs as _costs
+from .. import trace as _trace
 from ..core.tensor import Tensor
 from ..framework import aot as _aot
 from ..testing import failpoints as _fp
@@ -167,6 +169,11 @@ class Request:
         self.deadline_ms = deadline_ms      # None = no deadline
         self.priority = int(priority)       # higher outranks on a full queue
         self.output_ids = []          # generated tokens (no prompt echo)
+        # tracing (FLAGS_trace): one trace_id per request; the root span
+        # lives submit() -> finish reason, queue_wait is its first child
+        self.trace_id = None
+        self._span = None
+        self._qspan = None
         self.finished = False
         # "eos" | "length" | "capacity" | "deadline" | "error" |
         # "cancelled" | "shed" | "engine_stalled"
@@ -198,6 +205,7 @@ class Request:
         """Per-request latency/throughput stats (ms), live at any point of
         the lifecycle — the latency-tracker surface get_request promises."""
         out = {"rid": self.rid, "finished": self.finished,
+               "trace_id": self.trace_id,   # joins req stats to its spans
                "finish_reason": self.finish_reason,
                "prompt_tokens": int(len(self.prompt_ids)),
                "prefix_tokens": self.prefix_len,
@@ -580,7 +588,8 @@ class ServingEngine:
         # engine-local observability accumulators (the module-level monitor
         # metrics aggregate across engines; stats() reports THIS engine)
         self._m = {"submitted": 0, "finished": {}, "tokens": 0,
-                   "steps": {}, "spec_proposed": 0, "spec_accepted": 0,
+                   "steps": {}, "step_ms": {}, "spec_proposed": 0,
+                   "spec_accepted": 0,
                    "prefix_hit": 0, "prefix_miss": 0,
                    "occupancy_sum": 0, "occupancy_steps": 0,
                    "queue_wait_ms": _MsSummary(), "ttft_ms": _MsSummary(),
@@ -623,6 +632,11 @@ class ServingEngine:
         pb = self._bucket(n)
         padded = np.zeros((1, pb), np.int32)
         padded[0, :n] = ids
+        # accounted as a "prefill" slice: the prefill PROGRAM runs here,
+        # so its wall time must land in the same breakdown kind its
+        # executed-flops counters feed — otherwise stats()['breakdown']
+        # reports registration FLOPs with zero matching wall time
+        t0 = time.perf_counter()
         kc1, vc1, _ = self._prefill(self._params, jnp.asarray(padded),
                                     np.int32(n))
         kc1d = vc1d = None
@@ -631,6 +645,7 @@ class ServingEngine:
             kc1d, vc1d = self._draft_feed(self._params_d,
                                           jnp.asarray(padded), np.int32(0),
                                           *self._draft_row())
+        self._acc_ms("prefill", t0)
         pid = self._next_pid
         self._next_pid += 1
         self._prefixes[pid] = (ids, kc1, vc1, kc1d, vc1d)
@@ -733,6 +748,15 @@ class ServingEngine:
         self._m["steps"][kind] = self._m["steps"].get(kind, 0) + 1
         _STEPS.labels(kind=kind).inc()
 
+    def _acc_ms(self, kind, t0):
+        """Accumulate one step-kind slice's wall time (host-observed) for
+        stats()['breakdown']; returns the elapsed ms."""
+        ms = (time.perf_counter() - t0) * 1e3
+        st = self._m["step_ms"].setdefault(kind, [0, 0.0])
+        st[0] += 1
+        st[1] += ms
+        return ms
+
     def stats(self):
         """Engine-lifetime observability snapshot: request counts by
         outcome, token totals, step split (prefill/decode/speculative),
@@ -772,8 +796,85 @@ class ServingEngine:
             "queue_wait_ms": m["queue_wait_ms"].to_dict(),
             "ttft_ms": m["ttft_ms"].to_dict(),
             "inter_token_ms": m["inter_token_ms"].to_dict(),
+            "breakdown": self._breakdown(),
             "health": self.health(),
         }
+        return out
+
+    def _kind_programs(self, kind):
+        """THIS engine's CachedJit wrappers whose device work the kind's
+        wall time covers (speculative = draft proposal + target verify).
+        Two draft programs are deliberately unattributed because ONE
+        wrapper's cumulative counters feed MORE than one kind and cannot
+        be split: draft_sync runs inside both decode kinds' fallback
+        steps, and draft_feed inside whole-prompt (prefill), chunked
+        (prefill_chunk), AND prefix-registration windows — draft-enabled
+        engines therefore understate those kinds' flops by the (small by
+        design) draft model's share rather than double-count it."""
+        progs = {
+            "prefill": [getattr(self, "_prefill", None)],
+            "prefill_chunk": [getattr(self, "_prefill_chunk", None)],
+            "decode_greedy": [getattr(self, "_step_greedy", None)],
+            "decode_sample": [getattr(self, "_step_sample", None)],
+            "speculative": [getattr(self, "_draft_propose", None),
+                            getattr(self, "_verify", None)],
+        }
+        return [p for p in progs.get(kind, ())
+                if isinstance(p, _aot.CachedJit)]
+
+    def _breakdown(self):
+        """Step-time breakdown: host wall time per step kind joined with
+        THIS engine's executed device FLOPs (each program wrapper's own
+        per-signature accounting — a bucketed prefill family weights
+        every bucket's flops, and a second engine in the process cannot
+        bleed into this one's numbers). flops fields appear once the
+        program family has executables captured — FLAGS_trace=1,
+        FLAGS_jit_cache_dir, or warmup() all populate them; without them
+        the wall-time split still stands on its own."""
+        total_ms = sum(st[1] for st in self._m["step_ms"].values())
+        kinds = {}
+        flops_total = 0.0
+        flops_known = False
+        for kind in sorted(self._m["step_ms"]):
+            count, ms = self._m["step_ms"][kind]
+            row = {"count": count, "wall_ms": ms,
+                   "wall_fraction": (ms / total_ms) if total_ms else 0.0}
+            wrappers = self._kind_programs(kind)
+            ex_calls, ex_flops = 0, 0.0
+            for w in wrappers:
+                e = w.executed()
+                ex_calls = max(ex_calls, e["calls"])
+                ex_flops += e["flops"]
+            per_call = total = None
+            if ex_calls:
+                total = ex_flops
+                per_call = ex_flops / ex_calls
+            else:
+                # no execution accounting (e.g. programs ran before any
+                # cost capture): fall back to the site-global latest
+                # entries under the SAME wrappers' labels, so the two
+                # paths agree on what one call covers
+                entries = [_costs.get("serving", w._label)
+                           for w in wrappers]
+                entries = [e for e in entries if e is not None]
+                if entries:
+                    per_call = sum(e["flops"] for e in entries)
+                    total = per_call * count
+            if per_call is not None:
+                row["flops_per_call"] = per_call
+                row["device_flops_total"] = total
+                flops_total += total
+                flops_known = True
+            kinds[kind] = row
+        out = {"kinds": kinds, "wall_ms_total": total_ms}
+        if flops_known:
+            out["device_flops_total"] = flops_total
+            peak = _costs.peak_flops()
+            if total_ms > 0 and peak:
+                # achieved device FLOP/s over the engine's measured step
+                # time, against the chip's peak — the serving-side MFU
+                out["device_flops_per_sec"] = flops_total / (total_ms / 1e3)
+                out["mfu"] = out["device_flops_per_sec"] / peak
         return out
 
     def get_request(self, rid):
@@ -887,6 +988,17 @@ class ServingEngine:
                       prefix_len=prefix_len, deadline_ms=deadline_ms,
                       priority=priority)
         req.submit_time = time.perf_counter()
+        if _trace.is_enabled():
+            # end-to-end trace: every request gets a trace_id here; all
+            # later spans (queue-wait, prefill chunks, per-step decode,
+            # speculative, finish) parent back to this root span
+            req.trace_id = _trace.new_trace_id()
+            req._span = _trace.start_span(
+                "request", subsystem="serving", trace_id=req.trace_id,
+                rid=rid, prompt_tokens=int(len(ids)),
+                prefix_tokens=prefix_len, priority=priority)
+            req._qspan = _trace.start_span(
+                "queue_wait", subsystem="serving", parent=req._span)
         if deadline_ms is not None:
             self._deadline_live += 1
         self._queue.append(req)
@@ -909,6 +1021,13 @@ class ServingEngine:
         req.finished = True
         req.finish_reason = reason
         req.finish_time = time.perf_counter()
+        if req._qspan is not None:   # finished while still queued
+            req._qspan.end()
+            req._qspan = None
+        if req._span is not None:
+            req._span.end(finish_reason=reason,
+                          new_tokens=len(req.output_ids))
+            req._span = None
         if req.deadline_ms is not None:
             self._deadline_live -= 1
         self._m["finished"][reason] = self._m["finished"].get(reason, 0) + 1
@@ -1042,6 +1161,9 @@ class ServingEngine:
             if req.submit_time is not None else 0.0
         self._m["queue_wait_ms"].add(wait_ms)
         _QUEUE_WAIT_MS.observe(wait_ms)
+        if req._qspan is not None:
+            req._qspan.end(wait_ms=wait_ms)
+            req._qspan = None
 
     def _admit_one(self, slot, req):
         import jax.numpy as jnp
@@ -1062,16 +1184,27 @@ class ServingEngine:
             if end <= self.T:
                 self._m["prefix_hit"] += 1
                 _PREFIX.labels(event="hit").inc()
-                _, kc_p, vc_p, kc_pd, vc_pd = self._prefixes[req.prefix_id]
-                kc1 = self._copy_cache(kc_p)
-                vc1 = self._copy_cache(vc_p)
-                kc1d = vc1d = None
-                if self._draft is not None:
-                    kc1d = self._copy_cache(kc_pd)
-                    vc1d = self._copy_cache(vc_pd)
-                self._slot_req[slot] = req
-                self._prefilling[slot] = [req, kc1, vc1, prefix_len, C,
-                                          kc1d, vc1d]
+                sp = None if req._span is None else _trace.start_span(
+                    "admit", subsystem="serving", parent=req._span,
+                    slot=slot, prefix="hit", prefix_tokens=prefix_len)
+                try:
+                    _, kc_p, vc_p, kc_pd, vc_pd = \
+                        self._prefixes[req.prefix_id]
+                    kc1 = self._copy_cache(kc_p)
+                    vc1 = self._copy_cache(vc_p)
+                    kc1d = vc1d = None
+                    if self._draft is not None:
+                        kc1d = self._copy_cache(kc_pd)
+                        vc1d = self._copy_cache(vc_pd)
+                    self._slot_req[slot] = req
+                    self._prefilling[slot] = [req, kc1, vc1, prefix_len, C,
+                                              kc1d, vc1d]
+                except BaseException:
+                    if sp is not None:
+                        sp.end(error=True)
+                    raise
+                if sp is not None:
+                    sp.end()
                 return
             # else: fall through to whole-prompt prefill (recomputes the
             # prefix — slower but correct near the capacity edge)
@@ -1095,17 +1228,33 @@ class ServingEngine:
         # (dynamic_update_slice CLAMPS out-of-range starts, which would
         # silently shift tokens onto valid prefix columns)
         pb = self._bucket(n)
-        padded = np.zeros((1, pb), np.int32)
-        padded[0, :n] = req.prompt_ids
-        kc1, vc1, logits = self._prefill(self._params, jnp.asarray(padded),
-                                         np.int32(n))
-        draft_caches = None
-        if self._draft is not None:
-            draft_caches = self._draft_feed(self._params_d,
-                                            jnp.asarray(padded),
-                                            np.int32(0), *self._draft_row())
-        self._activate(slot, req, kc1, vc1, logits,
-                       draft_caches=draft_caches)
+        t0 = time.perf_counter()
+        sp = None if req._span is None else _trace.start_span(
+            "prefill", subsystem="serving", parent=req._span, slot=slot,
+            tokens=n, bucket=pb)
+        try:
+            padded = np.zeros((1, pb), np.int32)
+            padded[0, :n] = req.prompt_ids
+            kc1, vc1, logits = self._prefill(self._params,
+                                             jnp.asarray(padded),
+                                             np.int32(n))
+            draft_caches = None
+            if self._draft is not None:
+                draft_caches = self._draft_feed(self._params_d,
+                                                jnp.asarray(padded),
+                                                np.int32(0),
+                                                *self._draft_row())
+            self._activate(slot, req, kc1, vc1, logits,
+                           draft_caches=draft_caches)
+        except BaseException:
+            # the failing admission's span must still be recorded (the
+            # request itself is finished reason="error" by step())
+            if sp is not None:
+                sp.end(error=True)
+            raise
+        self._acc_ms("prefill", t0)
+        if sp is not None:
+            sp.end()
 
     def _advance_prefill(self, slot):
         """Consume one chunk of a reserved slot's prompt; on the final
@@ -1114,25 +1263,38 @@ class ServingEngine:
 
         req, kc1, vc1, off, C, kc1d, vc1d = self._prefilling[slot]
         self._count_step("prefill_chunk")
+        t0 = time.perf_counter()
+        sp = None if req._span is None else _trace.start_span(
+            "prefill_chunk", subsystem="serving", parent=req._span,
+            slot=slot, offset=off, width=C)
         n = len(req.prompt_ids)
         end = min(off + C, n)
-        chunk = np.zeros((1, C), np.int32)
-        chunk[0, :end - off] = req.prompt_ids[off:end]
-        kc1, vc1, logits = self._prefill_chunk(
-            self._params, jnp.asarray(chunk), np.int32(off), kc1, vc1,
-            np.int32(end - off - 1))
-        if self._draft is not None:
-            kc1d, vc1d = self._draft_feed(self._params_d,
-                                          jnp.asarray(chunk),
-                                          np.int32(off), kc1d, vc1d)
-        if end >= n:
-            del self._prefilling[slot]
-            self._slot_req[slot] = None   # _activate re-binds
-            self._activate(slot, req, kc1, vc1, logits,
-                           draft_caches=(None if self._draft is None
-                                         else (kc1d, vc1d)))
-        else:
-            self._prefilling[slot] = [req, kc1, vc1, end, C, kc1d, vc1d]
+        try:
+            chunk = np.zeros((1, C), np.int32)
+            chunk[0, :end - off] = req.prompt_ids[off:end]
+            kc1, vc1, logits = self._prefill_chunk(
+                self._params, jnp.asarray(chunk), np.int32(off), kc1, vc1,
+                np.int32(end - off - 1))
+            if self._draft is not None:
+                kc1d, vc1d = self._draft_feed(self._params_d,
+                                              jnp.asarray(chunk),
+                                              np.int32(off), kc1d, vc1d)
+            if end >= n:
+                del self._prefilling[slot]
+                self._slot_req[slot] = None   # _activate re-binds
+                self._activate(slot, req, kc1, vc1, logits,
+                               draft_caches=(None if self._draft is None
+                                             else (kc1d, vc1d)))
+            else:
+                self._prefilling[slot] = [req, kc1, vc1, end, C, kc1d,
+                                          vc1d]
+        except BaseException:
+            if sp is not None:   # record the failing chunk's span too
+                sp.end(error=True)
+            raise
+        self._acc_ms("prefill_chunk", t0)
+        if sp is not None:
+            sp.end(consumed=end)
 
     def _after_emit(self, slot, req):
         now = time.perf_counter()
@@ -1202,6 +1364,7 @@ class ServingEngine:
         self._m["occupancy_sum"] += len(active)
         self._m["occupancy_steps"] += 1
         _OCCUPANCY.set(len(active))
+        _trace.add_counter_sample("serving_batch_occupancy", len(active))
         if active:
             # speculative round: every active slot greedy AND spec_k+1
             # columns of headroom (near-capacity slots fall back to exact
@@ -1217,6 +1380,8 @@ class ServingEngine:
             # fed token into the draft cache so later speculative rounds
             # see an intact context (review r5: without this, one sampling
             # neighbor permanently cold-starts every survivor's draft)
+            t0 = time.perf_counter()
+            t0_ns = time.perf_counter_ns()
             if self._draft is not None:
                 self._kc_d, self._vc_d = self._draft_sync(
                     self._params_d, self._kc_d, self._vc_d,
@@ -1226,18 +1391,22 @@ class ServingEngine:
             # dispatch: an all-greedy batch keeps the lean argmax step
             # (no sort/categorical in its compiled program at all).
             if any(self._temps[s] > 0 for s in active):
-                self._count_step("decode_sample")
+                kind = "decode_sample"
+                self._count_step(kind)
                 next_toks, self._kc, self._vc = self._step_sample(
                     self._params, self._kc, self._vc,
                     jnp.asarray(self._last), jnp.asarray(self._pos),
                     jnp.asarray(self._temps), jnp.asarray(self._topk),
                     jnp.asarray(self._topp), jnp.asarray(self._seeds))
             else:
-                self._count_step("decode_greedy")
+                kind = "decode_greedy"
+                self._count_step(kind)
                 next_toks, self._kc, self._vc = self._step_greedy(
                     self._params, self._kc, self._vc,
                     jnp.asarray(self._last), jnp.asarray(self._pos))
             next_toks = np.asarray(next_toks)
+            self._acc_ms(kind, t0)
+            t1_ns = time.perf_counter_ns()
             for s in active:
                 req = self._slot_req[s]
                 try:
@@ -1245,6 +1414,13 @@ class ServingEngine:
                     self._pos[s] += 1
                     self._last[s] = next_toks[s]
                     req.output_ids.append(int(next_toks[s]))
+                    if req._span is not None:
+                        # slot-level decode slice: the batched device
+                        # step's window, attributed to this request
+                        _trace.emit("decode", t0_ns, t1_ns,
+                                    subsystem="serving", parent=req._span,
+                                    slot=s, pos=int(self._pos[s]),
+                                    kind=kind, token=int(next_toks[s]))
                     self._after_emit(s, req)
                 except Exception:
                     if self._slot_req[s] is not None:
@@ -1265,14 +1441,25 @@ class ServingEngine:
         import jax.numpy as jnp
 
         self._count_step("speculative")
+        t0 = time.perf_counter()
+        t0_ns = time.perf_counter_ns()
         props, self._kc_d, self._vc_d = self._draft_propose(
             self._params_d, self._kc_d, self._vc_d,
             jnp.asarray(self._last), jnp.asarray(self._pos))
+        t_draft_ns = time.perf_counter_ns()
         emit, m, self._kc, self._vc = self._verify(
             self._params, self._kc, self._vc, jnp.asarray(self._last),
             jnp.asarray(self._pos), props)
         emit = np.asarray(emit)
         m = np.asarray(m)
+        t1_ns = time.perf_counter_ns()
+        self._acc_ms("speculative", t0)
+        if _trace.is_enabled():
+            _trace.emit("spec_draft", t0_ns, t_draft_ns,
+                        subsystem="serving", slots=len(active),
+                        k=self._spec_k)
+            _trace.emit("spec_verify", t_draft_ns, t1_ns,
+                        subsystem="serving", slots=len(active))
         proposed = self._spec_k * len(active)
         accepted = int(sum(int(m[s]) for s in active))
         self._m["spec_proposed"] += proposed
@@ -1287,6 +1474,11 @@ class ServingEngine:
                 toks = emit[s, :n_acc]
                 old_pos = int(self._pos[s])
                 self._last[s] = int(toks[-1])
+                if req._span is not None:
+                    _trace.emit("decode", t0_ns, t1_ns,
+                                subsystem="serving", parent=req._span,
+                                slot=s, pos=old_pos, kind="speculative",
+                                accepted=int(m[s]), emitted=n_acc)
                 for i, t in enumerate(toks):
                     # advance pos PER TOKEN so _after_emit's eos/length/
                     # capacity decisions are made at exactly the state the

@@ -9,7 +9,6 @@ capture delegates to jax.profiler (XPlane -> TensorBoard / Perfetto, replacing t
 DeviceTracer). `export_chrome_tracing` emits chrome://tracing JSON like timeline.py.
 """
 import contextlib
-import json
 import threading
 import time
 
@@ -60,7 +59,8 @@ class RecordEvent:
 def start_profiler(state="All", tracer_option="Default", log_dir=None):
     """EnableProfiler parity; also starts the jax device trace when a log_dir is given."""
     _ENABLED[0] = True
-    _EVENTS.clear()
+    with _LOCK:
+        _EVENTS.clear()
     if log_dir:
         with _LOCK:
             jax.profiler.start_trace(log_dir)
@@ -76,9 +76,21 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     return summary(sorted_key)
 
 
+def host_events():
+    """Snapshot of the recorded host events, sorted by start time —
+    (name, start_ns, end_ns, thread_id, depth) tuples. The read is taken
+    under _LOCK: concurrent RecordEvent.end appends must never be seen
+    half-way (list.append is atomic, but iterating while appending from
+    another thread can observe a torn ordering)."""
+    with _LOCK:
+        evts = list(_EVENTS)
+    evts.sort(key=lambda e: e[1])
+    return evts
+
+
 def summary(sorted_key=None):
     agg = {}
-    for name, s, e, tid, depth in _EVENTS:
+    for name, s, e, tid, depth in host_events():
         st = agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
         dur = (e - s) / 1e6
         st[0] += 1
@@ -90,21 +102,32 @@ def summary(sorted_key=None):
          "avg_ms": v[1] / v[0] if v[0] else 0.0}
         for k, v in agg.items()
     ]
+    return _sort_rows(rows, sorted_key)
+
+
+def _sort_rows(rows, sorted_key):
     if sorted_key in ("total", None):
         rows.sort(key=lambda r: -r["total_ms"])
     elif sorted_key == "calls":
         rows.sort(key=lambda r: -r["calls"])
+    elif sorted_key in ("avg", "ave"):
+        rows.sort(key=lambda r: -r["avg_ms"])
+    elif sorted_key == "max":
+        rows.sort(key=lambda r: -r["max_ms"])
+    elif sorted_key == "min":
+        rows.sort(key=lambda r: -r["min_ms"])
     return rows
 
 
 def export_chrome_tracing(path):
-    """tools/timeline.py parity: chrome://tracing JSON."""
-    events = []
-    for name, s, e, tid, depth in _EVENTS:
-        events.append({"name": name, "ph": "X", "ts": s / 1e3, "dur": (e - s) / 1e3,
-                       "pid": 0, "tid": tid, "cat": "host"})
-    with open(path, "w") as f:
-        json.dump({"traceEvents": events}, f)
+    """tools/timeline.py parity: chrome://tracing JSON. Delegates to the
+    merged exporter (paddle_tpu.trace.export_chrome), so host events are
+    emitted sorted by start time — nested RecordEvents render as a tree
+    from ts/dur ordering instead of unordered same-tier slices — and the
+    old API's output gains whatever trace spans / counter samples exist."""
+    from .. import trace as _trace
+
+    _trace.export_chrome(path)
     return path
 
 
@@ -139,4 +162,9 @@ class Profiler:
         self.stop()
 
     def summary(self, sorted_by=None, **kw):
-        return self._rows or summary()
+        """Rows from the last stop() (or the live buffer), honoring
+        sorted_by ("total"|"calls"|"avg"|"max"|"min") — previously the
+        argument was silently ignored."""
+        if self._rows is None:
+            return summary(sorted_by)
+        return _sort_rows(list(self._rows), sorted_by)

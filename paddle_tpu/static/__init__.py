@@ -25,6 +25,8 @@ import jax.numpy as jnp
 
 from .. import flags as _flags
 from .. import monitor as _monitor
+from ..trace import costs as _costs
+from .. import trace as _trace
 from ..core import dtype as dtype_mod
 from ..core import dispatch as _dispatch
 from ..core.tensor import Tensor, ParamBase
@@ -356,6 +358,8 @@ class Program:
                                             train, example, force=True)
         self._exec_cache[key] = compiled
         _record_compile(sig, source)
+        _costs.record("executor", _feed_sig_label(sig),
+                            _aot.executable_of(compiled))
         return source
 
 
@@ -591,16 +595,28 @@ class Executor:
         # release their compiled closures and baked arrays with them
         cache = program._exec_cache
         scope = program._scope
+        sig_label = _feed_sig_label(sig)   # computed ONCE per run
         if key not in cache:
             with _RecordEvent("executor/compile"), \
                     _monitor.timed(_COMPILE_MS.labels(site="executor")):
+                # FLAGS_trace forces an eager AOT compile (in memory) so
+                # the cost registry can read the executable's
+                # cost_analysis(); flag unset keeps the lazy-jit bypass
                 cache[key], source = self._compile(
-                    program, tuple(feed_arrays), fetch_ids, train, example)
-            _record_compile(sig, source)
+                    program, tuple(feed_arrays), fetch_ids, train, example,
+                    force=_trace.is_enabled())
+            _aot.record_compile("executor", sig_label, source)
+            _costs.record("executor", sig_label,
+                          _aot.executable_of(cache[key]))
         else:
-            _record_compile(sig, "memory")
+            source = "memory"
+            _aot.record_compile("executor", sig_label, "memory")
         compiled = cache[key]
-        with _RecordEvent("executor/run"):
+        # step span: compile-cache source + feed signature + sync time —
+        # the executor half of the ISSUE-5 end-to-end trace propagation
+        sp = _trace.span("executor/run", subsystem="executor",
+                         sig=sig_label, source=source, train=train)
+        with sp, _RecordEvent("executor/run"):
             if train:
                 opt = program._optimizer
                 new_p, new_s, fetches = compiled(scope["params"],
@@ -616,6 +632,7 @@ class Executor:
                 # step timings measure DEVICE work, not dispatch: block on
                 # every fetch (train steps also pin the updated params so
                 # a fetchless run(feed=...) still syncs the real step)
+                t_sync = time.perf_counter()
                 sync_on = list(fetches)
                 if train and scope["params"]:
                     sync_on.append(next(iter(scope["params"].values())))
@@ -623,6 +640,7 @@ class Executor:
                     if hasattr(f, "block_until_ready"):
                         f.block_until_ready()
                 _BENCH_SYNC.labels(site="executor").inc()
+                sp.set(sync_ms=(time.perf_counter() - t_sync) * 1e3)
         if _monitor.is_enabled():
             _STEP_MS.labels(site="executor").observe(
                 (time.perf_counter() - t_step) * 1e3)

@@ -1,0 +1,196 @@
+"""Device cost registry: per-executable FLOPs/bytes/HBM accounting.
+
+XLA already knows what every compiled program costs —
+``compiled.cost_analysis()`` (flops, bytes accessed) and
+``compiled.memory_analysis()`` (argument/output/temp/peak HBM) — but
+until now that data only surfaced in ad-hoc scripts
+(tools/profile_gpt.py, tools/pipeline_memory.py). This module captures
+it ONCE at every compile site — ``Executor._compile``,
+``SpmdTrainer._aot_compile``, the ``ServingEngine``/``Predictor``
+``CachedJit`` program family, including AOT-cache deserialize hits in
+framework/aot.py — into a per-executable table keyed ``(site, sig)``,
+and exports it as gauges:
+
+- ``program_flops{site,sig}`` — per-execution FLOPs of the executable;
+- ``program_hbm_bytes{site,kind}`` — kind in
+  ``peak|argument|output|temp|generated_code`` for the site's most
+  recently captured executable (full per-sig detail: :func:`table`);
+- ``device_hbm_used_bytes{device}`` — sampled from
+  ``device.memory_stats()`` where the backend provides it
+  (:func:`sample_device_memory`; TPU yes, CPU no).
+
+Joined with measured step wall time this is the roofline/MFU layer
+(Tensor Processing Primitives, arXiv:2104.05755): a step's model FLOPs
+over ``wall_time × peak_flops`` — ``SpmdTrainer.stats()["mfu"]`` and
+``ServingEngine.stats()["breakdown"]`` read through :func:`get`.
+
+Capture never raises: a backend whose executables lack cost analysis
+degrades to an absent entry, not a crashed compile path.
+"""
+import threading
+
+from .. import flags as _flags
+from .. import monitor as _monitor
+
+__all__ = ["record", "get", "table", "reset", "sample_device_memory",
+           "peak_flops"]
+
+_flags.define_flag(
+    "device_peak_flops", 0.0,
+    "peak device FLOP/s used as the MFU denominator; 0 = auto from the "
+    "device kind table (unknown kinds fall back to a nominal 1e12 so "
+    "MFU stays finite — absolute values are only meaningful on known "
+    "hardware)")
+
+_LOCK = threading.Lock()
+_TABLE = {}   # (site, sig) -> entry dict
+
+_FLOPS_G = None
+_HBM_G = None
+_DEV_G = None
+
+_HBM_KINDS = ("peak", "argument", "output", "temp", "generated_code")
+
+#: bf16 peak FLOP/s per chip by device-kind substring (TPU datasheet
+#: numbers); matched case-insensitively, first hit wins
+_PEAK_FLOPS_BY_KIND = (
+    ("v6e", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+_NOMINAL_PEAK = 1e12
+
+
+def _gauges():
+    global _FLOPS_G, _HBM_G, _DEV_G
+    if _FLOPS_G is None:
+        _FLOPS_G = _monitor.gauge(
+            "program_flops",
+            "per-execution FLOPs of a compiled executable "
+            "(XLA cost_analysis)", labelnames=("site", "sig"))
+        _HBM_G = _monitor.gauge(
+            "program_hbm_bytes",
+            "HBM footprint of the site's most recently captured "
+            "executable by kind (XLA memory_analysis; per-sig detail in "
+            "trace.costs.table())", labelnames=("site", "kind"))
+        _DEV_G = _monitor.gauge(
+            "device_hbm_used_bytes",
+            "live device memory in use (device.memory_stats(), where the "
+            "backend provides it)", labelnames=("device",))
+    return _FLOPS_G, _HBM_G, _DEV_G
+
+
+def _cost_dict(compiled):
+    """cost_analysis() returns a dict on some backends, a one-element
+    list of dicts on others — normalize to one merged dict."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, dict):
+        return ca
+    out = {}
+    for d in ca or []:
+        for k, v in d.items():
+            out[k] = out.get(k, 0.0) + float(v)
+    return out
+
+
+def record(site, sig, compiled):
+    """Capture one executable's cost+memory analysis under (site, sig).
+    `compiled` may be None (bypass paths) — a no-op then. Returns the
+    entry dict or None. Never raises."""
+    if compiled is None:
+        return None
+    try:
+        cost = _cost_dict(compiled)
+        entry = {"site": str(site), "sig": str(sig),
+                 "flops": float(cost.get("flops", 0.0)),
+                 "bytes_accessed": float(cost.get("bytes accessed", 0.0))}
+        try:
+            ma = compiled.memory_analysis()
+            arg = int(getattr(ma, "argument_size_in_bytes", 0))
+            out = int(getattr(ma, "output_size_in_bytes", 0))
+            tmp = int(getattr(ma, "temp_size_in_bytes", 0))
+            gen = int(getattr(ma, "generated_code_size_in_bytes", 0))
+            # donated buffers appear in BOTH argument and output sizes;
+            # alias_size is that overlap — subtract it or the serving
+            # decode programs (which donate the KV caches, their largest
+            # buffers) overstate peak HBM by up to 2x
+            alias = int(getattr(ma, "alias_size_in_bytes", 0))
+            entry.update(argument_bytes=arg, output_bytes=out,
+                         temp_bytes=tmp, generated_code_bytes=gen,
+                         alias_bytes=alias,
+                         peak_bytes=arg + out + tmp + gen - alias)
+        except Exception:
+            pass
+    except Exception:
+        return None
+    with _LOCK:
+        _TABLE[(str(site), str(sig))] = entry
+    if _monitor.is_enabled():
+        flops_g, hbm_g, _ = _gauges()
+        flops_g.labels(site=site, sig=sig).set(entry["flops"])
+        for kind in _HBM_KINDS:
+            v = entry.get(f"{kind}_bytes")
+            if v is not None:
+                hbm_g.labels(site=site, kind=kind).set(v)
+    return entry
+
+
+def get(site, sig):
+    """The captured entry for (site, sig), or None."""
+    with _LOCK:
+        return _TABLE.get((str(site), str(sig)))
+
+
+def table():
+    """Snapshot of every captured entry (list of dicts)."""
+    with _LOCK:
+        return [dict(v) for v in _TABLE.values()]
+
+
+def reset():
+    with _LOCK:
+        _TABLE.clear()
+
+
+def sample_device_memory():
+    """Set device_hbm_used_bytes{device} from device.memory_stats() for
+    every device that reports it; returns {device_str: bytes_in_use}.
+    CPU backends report nothing — the gauge simply stays absent."""
+    import jax
+
+    out = {}
+    for d in jax.devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        used = stats.get("bytes_in_use")
+        if used is None:
+            continue
+        out[str(d)] = int(used)
+        if _monitor.is_enabled():
+            _gauges()[2].labels(device=str(d)).set(int(used))
+    return out
+
+
+def peak_flops(device=None):
+    """The MFU denominator: FLAGS_device_peak_flops when set, else the
+    device-kind table, else a nominal 1e12 (keeps MFU finite on backends
+    with no published peak, e.g. the CPU test harness)."""
+    override = float(_flags.get_flag("device_peak_flops", 0.0) or 0.0)
+    if override > 0:
+        return override
+    import jax
+
+    d = device or jax.devices()[0]
+    kind = str(getattr(d, "device_kind", d.platform)).lower()
+    for needle, flops in _PEAK_FLOPS_BY_KIND:
+        if needle in kind:
+            return flops
+    return _NOMINAL_PEAK

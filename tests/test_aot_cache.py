@@ -98,6 +98,37 @@ class TestCachedJitRoundTrip:
         aot.cached_jit(lambda a: a + 2, site="t", label="b")(jnp.ones(3))
         assert len(os.listdir(cache_dir)) == 2
 
+    def test_cost_registry_captures_disk_hit_executables(self, cache_dir):
+        """ISSUE 5: a DESERIALIZED executable must land in the device
+        cost registry exactly like a fresh compile — flops + HBM kinds
+        under (site, program label) — so MFU/breakdown joins work in a
+        warm-started process that never compiled anything."""
+        from paddle_tpu.trace import costs
+
+        costs.reset()
+        fn = lambda a: (a @ a).sum()                      # noqa: E731
+        x = jnp.ones((8, 8))
+        aot.cached_jit(fn, site="t", label="matmul")(x)
+        fresh = costs.get("t", "matmul")
+        assert fresh is not None and fresh["flops"] > 0
+        assert fresh["peak_bytes"] > 0
+        # a fresh wrapper (new process stand-in): the disk hit re-records
+        costs.reset()
+        assert costs.get("t", "matmul") is None
+        monitor.reset()
+        cj2 = aot.cached_jit(fn, site="t", label="matmul")
+        cj2(x)
+        assert _flat_compiles("t") == {("hit", "disk"): 1}
+        hit = costs.get("t", "matmul")
+        assert hit is not None
+        assert hit["flops"] == fresh["flops"]
+        for kind in ("argument_bytes", "output_bytes", "temp_bytes"):
+            assert hit[kind] == fresh[kind]
+        flops_g = monitor.default_registry().get("program_flops")
+        assert any(s.labels == {"site": "t", "sig": "matmul"}
+                   and s.value == hit["flops"]
+                   for s in flops_g.series())
+
     def test_corrupt_entry_evicted_and_recompiled(self, cache_dir):
         fn = lambda a: a * 3  # noqa: E731
         aot.cached_jit(fn, site="t", label="c")(jnp.ones(4))

@@ -4,6 +4,7 @@
     python tools/metrics_dump.py --serving                # serving decode loop
     python tools/metrics_dump.py --model bert --prometheus
     python tools/metrics_dump.py --all --json             # machine-readable
+    python tools/metrics_dump.py --serving --trace        # + span summary
 
 Each target resets the default registry, runs the workload at CPU-shrunk
 shapes (the analysis/targets.py convention — 2 steps, so BOTH the
@@ -117,17 +118,29 @@ def _metric_families(snap):
     return {m["name"]: m for m in snap["metrics"] if m["series"]}
 
 
-def run_target(name):
+def run_target(name, with_trace=False):
     """Run one target against a freshly-reset registry; returns
-    (snapshot, findings) with findings in the graph_lint format."""
-    from paddle_tpu import monitor
+    (snapshot, findings, trace_summary) with findings in the graph_lint
+    format. with_trace=True runs the workload under FLAGS_trace and
+    attaches the compact span summary (count + top-3 totals — the same
+    view bench.py's phase heartbeats carry)."""
+    from paddle_tpu import monitor, trace
 
     monitor.reset()
+    trace_summary = None
     kind = "serving" if name == "serving" else "train"
-    if kind == "serving":
-        run_serving_loop()
-    else:
-        run_train_step(name)
+    if with_trace:
+        trace.clear()
+        trace.enable()
+    try:
+        if kind == "serving":
+            run_serving_loop()
+        else:
+            run_train_step(name)
+    finally:
+        if with_trace:
+            trace_summary = trace.snapshot_summary(3)
+            trace.disable()
     snap = monitor.snapshot()
     fams = _metric_families(snap)
     findings = []
@@ -142,20 +155,23 @@ def run_target(name):
     for key, val in sorted(flatten(snap).items()):
         findings.append({"pass": "metrics", "severity": "info",
                          "message": f"{key} = {val}", "where": name})
-    return snap, findings
+    return snap, findings, trace_summary
 
 
-def build_report(targets):
+def build_report(targets, with_trace=False):
     """The tools/graph_lint.py-schema report over the requested targets."""
     report = {"tool": "metrics_dump", "passes": [], "targets": {},
               "totals": {"error": 0, "warning": 0, "info": 0}}
     for name in targets:
-        snap, findings = run_target(name)
+        snap, findings, trace_summary = run_target(name,
+                                                   with_trace=with_trace)
         counts = {"error": 0, "warning": 0, "info": 0}
         for f in findings:
             counts[f["severity"]] += 1
         report["targets"][name] = {"name": name, "counts": counts,
                                    "findings": findings, "snapshot": snap}
+        if trace_summary is not None:
+            report["targets"][name]["trace"] = trace_summary
         for sev, n in counts.items():
             report["totals"][sev] += n
     return report
@@ -173,6 +189,9 @@ def main(argv=None):
                     help="emit the graph_lint-schema machine report")
     ap.add_argument("--prometheus", action="store_true",
                     help="emit Prometheus text exposition instead of JSON")
+    ap.add_argument("--trace", action="store_true", dest="with_trace",
+                    help="run targets under FLAGS_trace and attach the "
+                         "span summary (count + top-3 totals) per target")
     args = ap.parse_args(argv)
 
     targets = list(args.model)
@@ -183,7 +202,7 @@ def main(argv=None):
     if not targets:
         ap.error("pick a target: --model NAME, --serving or --all")
 
-    report = build_report(targets)
+    report = build_report(targets, with_trace=args.with_trace)
     if args.as_json:
         print(json.dumps(report, indent=1))
     elif args.prometheus:
@@ -195,6 +214,8 @@ def main(argv=None):
     else:
         for name, t in report["targets"].items():
             print(f"# target: {name}")
+            if "trace" in t:
+                print(json.dumps({"trace": t["trace"]}, sort_keys=True))
             print(json.dumps(t["snapshot"], indent=1, sort_keys=True))
     return 1 if report["totals"]["error"] else 0
 
