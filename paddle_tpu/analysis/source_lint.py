@@ -15,6 +15,12 @@ BEFORE tracing:
       nn.Layer subclasses — shared across every instance of the layer
       (the classic aliasing bug, promoted to error because layers are
       long-lived and cloned).
+  private-model-import-in-serving : a module under inference/ or
+      serving/ importing a module-PRIVATE name (``_foo``) from
+      ``models.*``. The serving tier is model-agnostic by contract
+      (docs/SERVING.md): models plug in through the DecodeModel registry
+      (serving/decode_model.py), never by reaching into a model module's
+      privates — that coupling is exactly what ISSUE 6 removed.
 
 Suppression: a trailing ``# lint: allow(<rule>)`` comment on the
 offending line acknowledges a documented, deliberate exception (e.g. an
@@ -28,6 +34,9 @@ from .registry import Finding
 
 # packages whose function bodies are reachable from a jit trace
 _TRACED_PKGS = ("nn", "models", "ops", "tensor", "core", "amp")
+# packages forming the serving tier: model access ONLY via the DecodeModel
+# registry, never a model module's privates
+_SERVING_PKGS = ("inference", "serving")
 # methods that run eagerly at construction time, never inside a trace
 _INIT_METHODS = {"__init__", "__init_subclass__", "reset_parameters",
                  "_init_weights", "extra_repr", "__repr__"}
@@ -38,6 +47,7 @@ RULES = {
     "np-random-in-traced-code": "error",
     "time-in-traced-code": "warning",
     "mutable-default-arg": "error",
+    "private-model-import-in-serving": "error",
     "syntax-error": "error",
 }
 
@@ -70,10 +80,11 @@ def _is_layer_class(cls):
 
 
 class _Visitor(ast.NodeVisitor):
-    def __init__(self, rel_path, lines, traced):
+    def __init__(self, rel_path, lines, traced, serving=False):
         self.rel = rel_path
         self.lines = lines
         self.traced = traced
+        self.serving = serving
         self.findings = []
         self._func_stack = []
         self._class_stack = []
@@ -115,6 +126,27 @@ class _Visitor(ast.NodeVisitor):
             return False
         return self._func_stack[0].name not in _INIT_METHODS
 
+    # -- import rules -------------------------------------------------------
+    def visit_ImportFrom(self, node):
+        # serving tier: `from ..models.X import _private` (any nesting,
+        # module- or function-level) couples the engine to one model's
+        # internals — the DecodeModel registry is the doorway
+        mod = node.module or ""
+        if self.serving and (mod == "models" or mod.startswith("models.")
+                             or ".models." in mod
+                             or mod.endswith(".models")):
+            private = sorted(a.name for a in node.names
+                             if a.name.startswith("_"))
+            if private:
+                self._emit(
+                    "private-model-import-in-serving", node.lineno,
+                    f"serving code imports module-private "
+                    f"{', '.join(private)} from {mod!r}: the serving "
+                    "tier is model-agnostic — go through the DecodeModel "
+                    "registry (paddle_tpu/serving/decode_model.py) or "
+                    "register an adapter on the model module")
+        self.generic_visit(node)
+
     # -- call-site rules ----------------------------------------------------
     def visit_Call(self, node):
         name = _dotted(node.func)
@@ -137,10 +169,14 @@ class _Visitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def lint_source(source, rel_path="<string>", traced=True):
-    """Lint one python source string; returns a list of Finding."""
+def lint_source(source, rel_path="<string>", traced=True, serving=None):
+    """Lint one python source string; returns a list of Finding.
+    serving=None derives the serving-tier flag from rel_path (modules
+    under inference/ or serving/)."""
+    if serving is None:
+        serving = _is_serving_module(rel_path)
     tree = ast.parse(source)
-    v = _Visitor(rel_path, source.splitlines(), traced)
+    v = _Visitor(rel_path, source.splitlines(), traced, serving=serving)
     v.visit(tree)
     v.findings.sort(key=lambda f: f.where)
     return v.findings
@@ -153,6 +189,10 @@ def _is_traced_module(rel_path):
     # vision/io/text/datasets are host-side by design; nn/, models/ etc.
     # are fully trace-reachable
     return True
+
+
+def _is_serving_module(rel_path):
+    return rel_path.split(os.sep)[0] in _SERVING_PKGS
 
 
 def lint_path(root=None):

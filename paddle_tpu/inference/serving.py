@@ -60,6 +60,15 @@ active slot is greedy with cache headroom; sampling neighbors or
 near-capacity slots fall back to exact single-token steps. Composes with
 chunked prefill, shared prefixes, bf16/int8 caches, and tp_mesh (the
 draft stays replicated; the target verify shares the head-sharded cache).
+
+Multi-engine tier (docs/SERVING.md): the engine is MODEL-AGNOSTIC — all
+model-specific decode math arrives through the DecodeModel adapter
+resolved from `paddle_tpu.serving.decode_model` (gpt registers itself;
+`decode_model=` picks explicitly). `submit(trace_id=, parent_span=)`
+lets a fronting `serving.Router` thread its placement span into the
+request's trace, and `admit_prefilled()` accepts a KV row prefilled by a
+`serving.PrefillWorker` — the prefill/decode disaggregation handoff,
+bit-identical to local admission.
 """
 import time
 
@@ -70,6 +79,7 @@ from ..trace import costs as _costs
 from .. import trace as _trace
 from ..core.tensor import Tensor
 from ..framework import aot as _aot
+from ..serving import decode_model as _dm_registry
 from ..testing import failpoints as _fp
 
 __all__ = ["ServingEngine", "Request", "QueueFullError"]
@@ -232,16 +242,19 @@ class ServingEngine:
                  eos_token_id=None, prompt_buckets=(32, 64, 128, 256, 512,
                                                     1024), tp_mesh=None,
                  prefill_chunk=None, draft_model=None, spec_k=4,
-                 max_queue=None):
+                 max_queue=None, decode_model=None):
         import jax
         import jax.numpy as jnp
 
-        from ..models.gpt import (_check_decode_config, _decode_fns,
-                                  _decode_compute_dtype, _decode_params,
-                                  _tp_setup)
-
+        # the engine is model-agnostic: every model-specific decode entry
+        # point (config check, param extraction, decode math, tp recipe)
+        # comes through the DecodeModel adapter resolved here — never from
+        # a model module's privates (docs/SERVING.md; lint-enforced by
+        # analysis/source_lint.py private-model-import-in-serving)
+        dm = _dm_registry.resolve(model, decode_model)
+        self._dm = dm
         cfg = model.cfg
-        _check_decode_config(cfg)
+        dm.check_config(cfg)
         self.cfg = cfg
         self.B = int(max_batch)
         self.T = cfg.max_seq_len
@@ -256,7 +269,9 @@ class ServingEngine:
         if max_queue is not None and int(max_queue) < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self._max_queue = None if max_queue is None else int(max_queue)
+        dm_d = None
         if draft_model is not None:
+            dm_d = _dm_registry.resolve(draft_model, None)
             if draft_model.cfg.vocab_size != cfg.vocab_size:
                 raise ValueError("draft and target must share a vocabulary")
             if not (1 <= int(spec_k) <= 16):
@@ -265,13 +280,13 @@ class ServingEngine:
                 raise ValueError(
                     f"draft max_seq_len ({draft_model.cfg.max_seq_len}) "
                     f"must cover the target's ({self.T})")
-            _check_decode_config(draft_model.cfg)
+            dm_d.check_config(draft_model.cfg)
         self._buckets = tuple(sorted(b for b in prompt_buckets
                                      if b <= self.T))
         if not self._buckets:
             raise ValueError("no prompt bucket fits max_seq_len")
-        untied, untied_bias, params = _decode_params(model, "the model")
-        self._compute_dtype = _decode_compute_dtype(dtype)
+        params, dm_aux = dm.extract_params(model, "the model")
+        self._compute_dtype = dm.compute_dtype(dtype)
         if self._compute_dtype is not None:
             params = {k: (v.astype(self._compute_dtype)
                           if jnp.issubdtype(v.dtype, jnp.floating) else v)
@@ -281,14 +296,14 @@ class ServingEngine:
         # PERSISTENT KV cache lives head-sharded across the mesh
         tp_axis, tp_size, tp_specs = None, 1, None
         if tp_mesh is not None:
-            tp_axis, tp_size, params, tp_specs = _tp_setup(tp_mesh, cfg,
-                                                           params)
+            tp_axis, tp_size, params, tp_specs = dm.tp_setup(tp_mesh, cfg,
+                                                             params)
         self._tp_mesh = tp_mesh
         self._params = params
-        fwd, logits_of, cache_init = _decode_fns(cfg, untied, untied_bias,
-                                                 cache_dtype=cache_dtype,
-                                                 tp_axis=tp_axis,
-                                                 tp_size=tp_size)
+        fwd, logits_of, cache_init = dm.decode_fns(cfg, dm_aux,
+                                                   cache_dtype=cache_dtype,
+                                                   tp_axis=tp_axis,
+                                                   tp_size=tp_size)
         cache_dt = self._compute_dtype or jnp.float32
 
         if tp_mesh is None:
@@ -301,8 +316,8 @@ class ServingEngine:
             # layout change in _decode_fns can't silently diverge here.
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            dense_cache_init = _decode_fns(cfg, untied, untied_bias,
-                                           cache_dtype=cache_dtype)[2]
+            dense_cache_init = dm.decode_fns(cfg, dm_aux,
+                                             cache_dtype=cache_dtype)[2]
             tpl = jax.eval_shape(
                 lambda: dense_cache_init(self.B, self.T, cache_dt))
             cache_spec = P(None, None, "mp", None, None)
@@ -438,8 +453,7 @@ class ServingEngine:
         else:
             from jax.sharding import PartitionSpec as P
 
-            from ..models.gpt import _tp_wrap
-
+            _tp_wrap = dm.tp_wrap
             cs = self._cache_spec   # pytree-prefix: covers int8 tuples too
             self._prefill = _cj(jit=_tp_wrap(
                 prefill, tp_mesh, tp_specs, 0, (cs, cs, P()),
@@ -496,7 +510,7 @@ class ServingEngine:
         self._draft = None
         if draft_model is not None:
             self._spec_k = K = int(spec_k)
-            d_untied, d_untied_bias, params_d = _decode_params(
+            params_d, dm_d_aux = dm_d.extract_params(
                 draft_model, "the draft model")
             if self._compute_dtype is not None:
                 params_d = {n: (v.astype(self._compute_dtype)
@@ -504,9 +518,8 @@ class ServingEngine:
                                 else v) for n, v in params_d.items()}
             # the draft is small by design: it stays replicated (dense
             # fns) even when the target serves tensor-parallel
-            fwd_d, logits_d, cache_init_d = _decode_fns(
-                draft_model.cfg, d_untied, d_untied_bias,
-                cache_dtype=cache_dtype)
+            fwd_d, logits_d, cache_init_d = dm_d.decode_fns(
+                draft_model.cfg, dm_d_aux, cache_dtype=cache_dtype)
             self._params_d = params_d
             self._kc_d, self._vc_d = cache_init_d(self.B, self.T, cache_dt)
 
@@ -577,10 +590,8 @@ class ServingEngine:
             else:
                 from jax.sharding import PartitionSpec as P
 
-                from ..models.gpt import _tp_wrap
-
                 cs = self._cache_spec
-                self._verify = _cj(jit=_tp_wrap(
+                self._verify = _cj(jit=dm.tp_wrap(
                     verify, tp_mesh, tp_specs, 0, (P(), P(), cs, cs),
                     in_specs=(tp_specs, cs, cs, P(), P(), P()),
                     donate=(1, 2)), label="verify")
@@ -604,6 +615,11 @@ class ServingEngine:
         self._topp = np.ones(self.B, np.float32)     # 1.0 = no nucleus
         self._seeds = np.zeros(self.B, np.int32)
         self._queue = []
+        # disaggregated prefill->decode handoff (admit_prefilled): rows
+        # whose prompt KV arrived already prefilled, waiting for a slot.
+        # Plain engines never touch it beyond an empty-list truthiness
+        # check per step (gate-pinned in tests/test_router_gate.py).
+        self._handoff = []
         self._next_rid = 0
         self._finished = {}
         # robustness state: draining stops admission; step/error counters
@@ -773,6 +789,7 @@ class ServingEngine:
             "slots": self.B,
             "requests": {"submitted": m["submitted"],
                          "queued": len(self._queue),
+                         "handoff": len(self._handoff),
                          "prefilling": len(self._prefilling),
                          # decoding slots only: mid-prefill slots hold a
                          # _slot_req reservation but belong to "prefilling"
@@ -892,6 +909,9 @@ class ServingEngine:
         for entry in self._prefilling.values():
             if entry[0].rid == rid:
                 return entry[0]
+        for entry in self._handoff:
+            if entry[0].rid == rid:
+                return entry[0]
         if rid in self._finished:
             return self._finished[rid]
         raise KeyError(f"unknown request id {rid}")
@@ -905,28 +925,10 @@ class ServingEngine:
             raise ValueError(f"unknown prefix_id {prefix_id}")
         del self._prefixes[prefix_id]
 
-    def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
-               top_k=None, top_p=None, seed=None, prefix_id=None,
-               deadline_ms=None, priority=0):
-        """Queue a prompt; returns the request id. temperature=0 (default)
-        decodes greedy; temperature>0 samples (optionally top_k- and/or
-        top_p/nucleus-truncated, same semantics as generate()) with a
-        per-request deterministic PRNG stream (seed defaults to the
-        request id).
-
-        deadline_ms: wall-clock budget from submit; an overdue request is
-        finished with reason="deadline" at the next step() (batch-mates
-        are untouched). priority: higher values outrank on a FULL bounded
-        queue (max_queue=): the lowest-priority queued request is shed
-        (reason="shed") to admit a strictly-higher-priority arrival;
-        otherwise submit raises QueueFullError."""
-        if self._draining:
-            raise RuntimeError(
-                "ServingEngine is draining — not accepting new requests "
-                "(in-flight work runs to completion; see drain())")
-        ids = prompt_ids._data if isinstance(prompt_ids, Tensor) \
-            else np.asarray(prompt_ids)
-        ids = np.asarray(ids, np.int32).ravel()
+    def _validate_decode_args(self, ids, max_new_tokens, temperature,
+                              deadline_ms, top_k, top_p, seed):
+        """Shared submit()/admit_prefilled() argument validation; returns
+        the int-converted seed (None stays None)."""
         if max_new_tokens < 1:   # generate()'s own validation, mirrored
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -948,6 +950,70 @@ class ServingEngine:
                     "& 0x7FFFFFFF for hash/time-derived seeds)")
         if len(ids) == 0:
             raise ValueError("empty prompt")
+        return seed
+
+    def _new_request(self, ids, max_new_tokens, temperature, top_k, top_p,
+                     seed, prefix_id, prefix_len, deadline_ms, priority,
+                     trace_id=None, parent_span=None):
+        """Accepted-request factory shared by submit()/admit_prefilled():
+        mints the rid, stamps submit_time, opens the trace spans (a
+        router/pool passes its own trace_id — and optionally its routing
+        span as parent — so one request's spans thread
+        router -> engine -> slot), and counts the submission."""
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(rid, ids, max_new_tokens,
+                      temperature=temperature, top_k=top_k,
+                      top_p=top_p, seed=seed, prefix_id=prefix_id,
+                      prefix_len=prefix_len, deadline_ms=deadline_ms,
+                      priority=priority)
+        req.submit_time = time.perf_counter()
+        if _trace.is_enabled():
+            # end-to-end trace: every request gets a trace_id here; all
+            # later spans (queue-wait, prefill chunks, per-step decode,
+            # speculative, finish) parent back to this root span
+            req.trace_id = trace_id or _trace.new_trace_id()
+            req._span = _trace.start_span(
+                "request", subsystem="serving", trace_id=req.trace_id,
+                parent=parent_span, rid=rid, prompt_tokens=int(len(ids)),
+                prefix_tokens=prefix_len, priority=priority)
+            req._qspan = _trace.start_span(
+                "queue_wait", subsystem="serving", parent=req._span)
+        if deadline_ms is not None:
+            self._deadline_live += 1
+        self._m["submitted"] += 1
+        _REQ_SUBMITTED.inc()
+        return req
+
+    def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
+               top_k=None, top_p=None, seed=None, prefix_id=None,
+               deadline_ms=None, priority=0, trace_id=None,
+               parent_span=None):
+        """Queue a prompt; returns the request id. temperature=0 (default)
+        decodes greedy; temperature>0 samples (optionally top_k- and/or
+        top_p/nucleus-truncated, same semantics as generate()) with a
+        per-request deterministic PRNG stream (seed defaults to the
+        request id).
+
+        deadline_ms: wall-clock budget from submit; an overdue request is
+        finished with reason="deadline" at the next step() (batch-mates
+        are untouched). priority: higher values outrank on a FULL bounded
+        queue (max_queue=): the lowest-priority queued request is shed
+        (reason="shed") to admit a strictly-higher-priority arrival;
+        otherwise submit raises QueueFullError.
+
+        trace_id/parent_span: a fronting Router propagates its per-request
+        trace id (and its routing span) so the engine's spans join the
+        router's trace instead of minting a fresh one."""
+        if self._draining:
+            raise RuntimeError(
+                "ServingEngine is draining — not accepting new requests "
+                "(in-flight work runs to completion; see drain())")
+        ids = prompt_ids._data if isinstance(prompt_ids, Tensor) \
+            else np.asarray(prompt_ids)
+        ids = np.asarray(ids, np.int32).ravel()
+        seed = self._validate_decode_args(ids, max_new_tokens, temperature,
+                                          deadline_ms, top_k, top_p, seed)
         prefix_len = 0
         if prefix_id is not None:
             if prefix_id not in self._prefixes:
@@ -961,50 +1027,96 @@ class ServingEngine:
             raise ValueError(
                 f"prompt ({len(ids)}) too long for max_seq_len {self.T}")
         priority = int(priority)
-        if self._max_queue is not None and len(self._queue) >= self._max_queue:
-            # shed the lowest-priority queued request (newest among ties —
-            # it has the least sunk wait) iff the arrival strictly outranks
-            # it; otherwise reject the arrival
+        if self._max_queue is not None and \
+                len(self._queue) + len(self._handoff) >= self._max_queue:
+            # the bound covers BOTH admission backlogs (queue + prefilled
+            # handoff rows) — matching admit_prefilled and health().
+            # Shed the lowest-priority queued request (newest among ties —
+            # it has the least sunk wait) iff the arrival strictly
+            # outranks it; handoff rows are never shed (their prefill is
+            # already paid); otherwise reject the arrival
             victim_idx = None
             for i, r in enumerate(self._queue):
                 if victim_idx is None \
                         or r.priority <= self._queue[victim_idx].priority:
                     victim_idx = i
-            if self._queue[victim_idx].priority < priority:
+            if victim_idx is not None \
+                    and self._queue[victim_idx].priority < priority:
                 victim = self._queue.pop(victim_idx)
                 self._finish_req(victim, "shed")
                 _SHED.labels(reason="preempted").inc()
             else:
                 _SHED.labels(reason="queue_full").inc()
                 raise QueueFullError(
-                    f"admission queue full ({len(self._queue)}/"
-                    f"{self._max_queue}); request rejected — retry later "
-                    "or submit with a higher priority")
-        rid = self._next_rid
-        self._next_rid += 1
-        req = Request(rid, ids, max_new_tokens,
-                      temperature=temperature, top_k=top_k,
-                      top_p=top_p, seed=seed, prefix_id=prefix_id,
-                      prefix_len=prefix_len, deadline_ms=deadline_ms,
-                      priority=priority)
-        req.submit_time = time.perf_counter()
-        if _trace.is_enabled():
-            # end-to-end trace: every request gets a trace_id here; all
-            # later spans (queue-wait, prefill chunks, per-step decode,
-            # speculative, finish) parent back to this root span
-            req.trace_id = _trace.new_trace_id()
-            req._span = _trace.start_span(
-                "request", subsystem="serving", trace_id=req.trace_id,
-                rid=rid, prompt_tokens=int(len(ids)),
-                prefix_tokens=prefix_len, priority=priority)
-            req._qspan = _trace.start_span(
-                "queue_wait", subsystem="serving", parent=req._span)
-        if deadline_ms is not None:
-            self._deadline_live += 1
+                    f"admission queue full ({len(self._queue)} queued "
+                    f"+ {len(self._handoff)} handoff / {self._max_queue});"
+                    " request rejected — retry later or submit with a "
+                    "higher priority")
+        req = self._new_request(ids, max_new_tokens, temperature, top_k,
+                                top_p, seed, prefix_id, prefix_len,
+                                deadline_ms, priority, trace_id=trace_id,
+                                parent_span=parent_span)
         self._queue.append(req)
-        self._m["submitted"] += 1
-        _REQ_SUBMITTED.inc()
-        return rid
+        return req.rid
+
+    def admit_prefilled(self, prompt_ids, kv_row, logits,
+                        max_new_tokens=32, temperature=0.0, top_k=None,
+                        top_p=None, seed=None, deadline_ms=None,
+                        priority=0, trace_id=None, parent_span=None):
+        """Disaggregated prefill->decode handoff (docs/SERVING.md): admit
+        a request whose prompt KV was ALREADY prefilled elsewhere.
+
+        ``kv_row`` is the (kc1, vc1) single-row cache pair matching this
+        engine's DecodeModel cache spec — i.e. produced by a
+        ``serving.PrefillWorker`` (or another engine) built from the SAME
+        adapter, config, dtype and cache_dtype. ``logits`` is the
+        prompt's last-position vocab logits [V] (f32). The row waits in
+        the handoff queue until a slot frees, then the standard admission
+        tail runs: row copy into the big cache + first token through the
+        same pick program submit()'s own prefill uses — outputs are
+        bit-identical to submitting the prompt to this engine directly
+        (pinned by tests/test_serving_disagg.py).
+
+        Returns the request id. Raises while draining; a bounded engine
+        (max_queue=) rejects with QueueFullError when queue + handoff
+        backlogs are at the bound (no priority shedding across handoff
+        rows — the producer should back off or pick another engine);
+        speculative engines (draft_model=) do not compose with handoff
+        (the draft's side cache was never prefilled)."""
+        if self._draining:
+            raise RuntimeError(
+                "ServingEngine is draining — not accepting new requests "
+                "(in-flight work runs to completion; see drain())")
+        if self._draft is not None:
+            raise RuntimeError(
+                "admit_prefilled does not compose with speculative "
+                "decoding (draft_model=): the handoff row carries no "
+                "draft-model KV — disaggregate with a plain engine")
+        ids = prompt_ids._data if isinstance(prompt_ids, Tensor) \
+            else np.asarray(prompt_ids)
+        ids = np.asarray(ids, np.int32).ravel()
+        seed = self._validate_decode_args(ids, max_new_tokens, temperature,
+                                          deadline_ms, top_k, top_p, seed)
+        if len(ids) + 1 > self.T:
+            raise ValueError(
+                f"prompt ({len(ids)}) too long for max_seq_len {self.T}")
+        # the bound check runs AFTER validation (matching submit()): an
+        # unservable request must fail permanently (ValueError), never
+        # masquerade as retryable backpressure
+        if self._max_queue is not None \
+                and len(self._queue) + len(self._handoff) >= self._max_queue:
+            _SHED.labels(reason="queue_full").inc()
+            raise QueueFullError(
+                f"admission queue full ({len(self._queue)} queued + "
+                f"{len(self._handoff)} handoff / {self._max_queue}); "
+                "handoff rejected — back off or target another engine")
+        kc1, vc1 = kv_row
+        req = self._new_request(ids, max_new_tokens, temperature, top_k,
+                                top_p, seed, None, 0, deadline_ms,
+                                int(priority), trace_id=trace_id,
+                                parent_span=parent_span)
+        self._handoff.append([req, kc1, vc1, logits])
+        return req.rid
 
     def _bucket(self, n):
         for b in self._buckets:
@@ -1057,6 +1169,11 @@ class ServingEngine:
             if entry[0].rid == rid:
                 self._finish_req(entry[0], "cancelled", slot=slot)
                 return True
+        for entry in list(self._handoff):
+            if entry[0].rid == rid:
+                self._handoff.remove(entry)
+                self._finish_req(entry[0], "cancelled")
+                return True
         for slot in range(self.B):
             req = self._slot_req[slot]
             if req is not None and req.rid == rid:
@@ -1077,19 +1194,23 @@ class ServingEngine:
         """Liveness verdict for load balancers: state is "draining" after
         drain(), "degraded" when a request finished with reason="error" in
         the last 100 steps or the bounded queue is at >= 80% depth, else
-        "ok". Also wired into stats()["health"]."""
+        "ok". Also wired into stats()["health"]. queue_depth counts BOTH
+        admission backlogs — the regular queue and the prefilled-handoff
+        queue — so a disaggregated decode engine can't look idle while
+        holding a deep handoff backlog."""
+        depth = len(self._queue) + len(self._handoff)
         state = "ok"
         if self._draining:
             state = "draining"
         else:
             recent_error = (self._last_error_step is not None
                             and self._step_no - self._last_error_step <= 100)
-            q_pressure = (self._max_queue is not None and len(self._queue)
+            q_pressure = (self._max_queue is not None and depth
                           >= max(1, int(0.8 * self._max_queue)))
             if recent_error or q_pressure:
                 state = "degraded"
         return {"state": state,
-                "queue_depth": len(self._queue),
+                "queue_depth": depth,
                 "queue_limit": self._max_queue,
                 "active_slots": sum(1 for r in self._slot_req
                                     if r is not None),
@@ -1112,6 +1233,10 @@ class ServingEngine:
         for req in [r for r in self._queue if overdue(r)]:
             self._queue.remove(req)
             self._finish_req(req, "deadline")
+            _DEADLINE.inc()
+        for entry in [e for e in self._handoff if overdue(e[0])]:
+            self._handoff.remove(entry)
+            self._finish_req(entry[0], "deadline")
             _DEADLINE.inc()
         for slot, entry in list(self._prefilling.items()):
             if overdue(entry[0]):
@@ -1345,16 +1470,31 @@ class ServingEngine:
         for slot in range(self.B):
             # while, not if: a request finishing DURING admission (eos on
             # its prefill token / max_new_tokens=1) frees the slot for the
-            # next queued request in the same step
-            while self._slot_req[slot] is None and self._queue:
-                req = self._queue.pop(0)
-                try:
-                    self._admit_one(slot, req)
-                except Exception:
-                    # half-done admission must not leak a reservation
-                    self._finish_req(req, "error", slot=slot)
-                    self._note_error()
-                    continue
+            # next queued request in the same step. Handoff rows admit
+            # FIRST — their prefill is already paid, holding them behind
+            # un-prefilled queue entries would waste the disaggregation
+            while self._slot_req[slot] is None and (self._handoff
+                                                    or self._queue):
+                if self._handoff:
+                    req, kc1, vc1, logits = self._handoff.pop(0)
+                    try:
+                        self._note_admission(req)
+                        t0 = time.perf_counter()
+                        self._activate(slot, req, kc1, vc1, logits)
+                        self._acc_ms("handoff_admit", t0)
+                    except Exception:
+                        self._finish_req(req, "error", slot=slot)
+                        self._note_error()
+                        continue
+                else:
+                    req = self._queue.pop(0)
+                    try:
+                        self._admit_one(slot, req)
+                    except Exception:
+                        # half-done admission must not leak a reservation
+                        self._finish_req(req, "error", slot=slot)
+                        self._note_error()
+                        continue
                 if self._slot_req[slot] is not None:
                     break
 
@@ -1494,8 +1634,8 @@ class ServingEngine:
                 self._note_error()
 
     def has_work(self):
-        return bool(self._queue) or any(r is not None
-                                        for r in self._slot_req)
+        return bool(self._queue) or bool(self._handoff) \
+            or any(r is not None for r in self._slot_req)
 
     def run_until_complete(self, max_steps=100_000):
         """Drain the queue; returns {rid: Request}. Non-convergence fails
@@ -1512,6 +1652,10 @@ class ServingEngine:
                     self._queue.remove(req)
                     self._finish_req(req, "engine_stalled")
                     stalled.append(req.rid)
+                for entry in list(self._handoff):
+                    self._handoff.remove(entry)
+                    self._finish_req(entry[0], "engine_stalled")
+                    stalled.append(entry[0].rid)
                 for slot, entry in list(self._prefilling.items()):
                     self._finish_req(entry[0], "engine_stalled", slot=slot)
                     stalled.append(entry[0].rid)

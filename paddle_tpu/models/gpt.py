@@ -16,6 +16,7 @@ import numpy as np
 from .. import nn
 from ..core.tensor import Tensor
 from ..nn import functional as F
+from ..serving import decode_model as _decode_model
 
 
 class GPTConfig:
@@ -1308,3 +1309,54 @@ def gpt2_small(**kw):
 
 def gpt2_medium(**kw):
     return GPTForCausalLM(GPTConfig.medium())
+
+
+class GPTDecodeModel(_decode_model.DecodeModel):
+    """The gpt family's DecodeModel adapter (serving/decode_model.py):
+    the serving tier's ONLY doorway into this module — every method
+    delegates to the same decode helpers generate()/ServingEngine
+    historically used, so engine outputs through the registry are
+    byte-identical to the direct-import era."""
+
+    name = "gpt"
+
+    def check_config(self, cfg):
+        _check_decode_config(cfg)
+
+    def compute_dtype(self, dtype):
+        return _decode_compute_dtype(dtype)
+
+    def extract_params(self, model, who):
+        untied, untied_bias, params = _decode_params(model, who)
+        return params, (untied, untied_bias)
+
+    def decode_fns(self, cfg, aux, cache_dtype=None, tp_axis=None,
+                   tp_size=1):
+        untied, untied_bias = aux
+        return _decode_fns(cfg, untied, untied_bias,
+                           cache_dtype=cache_dtype, tp_axis=tp_axis,
+                           tp_size=tp_size)
+
+    def tp_setup(self, tp_mesh, cfg, params):
+        return _tp_setup(tp_mesh, cfg, params)
+
+    def tp_wrap(self, run, tp_mesh, tp_specs, n_extra_in, out_specs,
+                in_specs=None, donate=()):
+        return _tp_wrap(run, tp_mesh, tp_specs, n_extra_in, out_specs,
+                        in_specs=in_specs, donate=donate)
+
+    def cache_spec(self, cfg):
+        KVh = getattr(cfg, "num_kv_heads", None) or cfg.num_heads
+        hd = cfg.hidden_size // cfg.num_heads
+        return {"kind": "kv_pair",
+                "layout": "[L, B, KVh, T, hd]",
+                "axes": {"L": cfg.num_layers, "KVh": KVh,
+                         "T": cfg.max_seq_len, "hd": hd},
+                "quantized": "per-side (values, scales) tuple when the "
+                             "engine's cache_dtype is int8/fp8"}
+
+    def matches(self, model):
+        return isinstance(model, GPTForCausalLM)
+
+
+_decode_model.register_decode_model(GPTDecodeModel())

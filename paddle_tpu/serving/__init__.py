@@ -1,0 +1,42 @@
+"""Multi-engine serving tier (docs/SERVING.md).
+
+Three layers over the continuous-batching ``ServingEngine``:
+
+- :mod:`.decode_model` — the documented decode-model protocol + registry
+  that makes the engine model-agnostic (gpt registers itself; add your
+  own family without touching engine code);
+- :mod:`.router` — a front-door ``Router`` fanning ``submit()`` across N
+  named engine instances with deadline/priority-aware placement,
+  session/prefix-affinity hashing, drain-aware failover, and trace_id
+  propagation (router -> engine -> slot spans share one trace);
+- :mod:`.disagg` — ``DisaggregatedPool``: dedicated prefill workers hand
+  finished KV rows to decode engines (the MPMD per-stage split),
+  bit-identical to the monolithic engine.
+
+Import cost discipline: ``Router``/``DisaggregatedPool`` load lazily —
+constructing a plain single-engine ``ServingEngine`` never imports them
+(pinned by tests/test_router_gate.py).
+"""
+from . import decode_model  # noqa: F401  (registry: always available)
+from .decode_model import (  # noqa: F401
+    DecodeModel, get_decode_model, register_decode_model,
+    registered_decode_models)
+
+__all__ = ["decode_model", "DecodeModel", "register_decode_model",
+           "get_decode_model", "registered_decode_models", "Router",
+           "DisaggregatedPool", "PrefillWorker"]
+
+_LAZY_ATTRS = {"Router": ".router",
+               "DisaggregatedPool": ".disagg",
+               "PrefillWorker": ".disagg",
+               "router": ".router",
+               "disagg": ".disagg"}
+
+
+def __getattr__(name):   # PEP 562: lazy submodule/class loading
+    if name in _LAZY_ATTRS:
+        import importlib
+
+        mod = importlib.import_module(_LAZY_ATTRS[name], __name__)
+        return mod if name in ("router", "disagg") else getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,168 @@
+"""The decode-model interface: what a model must provide to be SERVED.
+
+The continuous-batching ``ServingEngine`` (inference/serving.py), the
+front-door ``Router`` (serving/router.py), and the prefill/decode
+``DisaggregatedPool`` (serving/disagg.py) are model-agnostic: they drive
+any model through the :class:`DecodeModel` adapter protocol below instead
+of importing a model module's privates. A model family registers ONE
+adapter (``register_decode_model``); the serving tier resolves it by name
+or by inspecting the model instance (``resolve``).
+
+The protocol (docs/SERVING.md for the full contract):
+
+``check_config(cfg)``
+    Reject configs the decode programs cannot serve (MoE, megatron-
+    training layouts, ...). Raises ``ValueError``.
+``compute_dtype(dtype)``
+    Map a user dtype string to the decode compute dtype (``None`` = f32).
+``extract_params(model, who)``
+    Name-addressed param snapshot -> ``(params, aux)``. ``params`` is a
+    flat ``{name: jax array}`` dict; ``aux`` is adapter-opaque state
+    threaded back into :meth:`decode_fns` (e.g. untied-head flags).
+``decode_fns(cfg, aux, cache_dtype=None, tp_axis=None, tp_size=1)``
+    The pure-jnp decode math: ``(fwd, logits_of, cache_init)`` with
+
+    - ``cache_init(b, T, dt) -> (kc, vc)`` — the KV-cache pytree pair.
+      Each of kc/vc is one "cache side": a plain array (leading axes
+      ``[L, b, KVh, T, ...]``) or a (values, scales) tuple for quantized
+      caches. Row 0..b-1 is one slot; the pair for ``b=1`` is the unit of
+      PREFILL->DECODE HANDOFF (``ServingEngine.admit_prefilled``) — any
+      engine built from the same adapter+config accepts another's rows.
+    - ``fwd(params, tok_ids [B, t], pos, kc, vc) -> (x, kc, vc)`` — run
+      the stack writing K/V at column(s) ``pos`` (scalar or per-row [B]).
+    - ``logits_of(params, x_last) -> logits`` — project hidden states to
+      vocab logits.
+``tp_setup(tp_mesh, cfg, params)``
+    Tensor-parallel serving setup -> ``(tp_axis, tp_size, params,
+    param_specs)``; raise if the config cannot shard.
+``tp_wrap(run, tp_mesh, tp_specs, n_extra_in, out_specs, in_specs=None,
+  donate=())``
+    jit(shard_map(run)) for the tp programs.
+``cache_spec(cfg)``
+    Machine-readable description of the cache pytree (layout string,
+    axis names, quantized or not) — the handoff contract in data form.
+``matches(model)``
+    True when this adapter serves ``model`` (used by :func:`resolve`).
+
+Exact-parity bar: an engine serving a model THROUGH its adapter must be
+byte-identical to one calling the model's decode helpers directly — the
+adapter delegates, it never re-implements math.
+"""
+import importlib
+
+__all__ = ["DecodeModel", "register_decode_model", "get_decode_model",
+           "registered_decode_models", "resolve", "cache_row_bytes"]
+
+
+class DecodeModel:
+    """Base adapter; subclasses implement the protocol documented in the
+    module docstring. ``name`` is the registry key."""
+
+    name = None
+
+    # -- required ----------------------------------------------------------
+    def check_config(self, cfg):
+        raise NotImplementedError
+
+    def compute_dtype(self, dtype):
+        raise NotImplementedError
+
+    def extract_params(self, model, who):
+        raise NotImplementedError
+
+    def decode_fns(self, cfg, aux, cache_dtype=None, tp_axis=None,
+                   tp_size=1):
+        raise NotImplementedError
+
+    def matches(self, model):
+        raise NotImplementedError
+
+    # -- optional (dense-only adapters may leave these) --------------------
+    def tp_setup(self, tp_mesh, cfg, params):
+        raise NotImplementedError(
+            f"decode model {self.name!r} does not support tensor-parallel "
+            "serving")
+
+    def tp_wrap(self, run, tp_mesh, tp_specs, n_extra_in, out_specs,
+                in_specs=None, donate=()):
+        raise NotImplementedError(
+            f"decode model {self.name!r} does not support tensor-parallel "
+            "serving")
+
+    def cache_spec(self, cfg):
+        """Default spec: opaque pytree pair, described minimally."""
+        return {"kind": "kv_pair", "layout": "adapter-defined",
+                "quantized": None}
+
+
+# name -> DecodeModel instance. Model modules register themselves at
+# import; the _LAZY table lets the serving tier resolve a bundled family
+# without the caller having imported its module first.
+_REGISTRY = {}
+_LAZY = {"gpt": "paddle_tpu.models.gpt"}
+
+
+def register_decode_model(adapter, clobber=False):
+    """Register a :class:`DecodeModel` instance under ``adapter.name``.
+    Re-registering an existing name raises unless ``clobber=True`` (a
+    silent overwrite could swap the serving math under a live engine)."""
+    name = getattr(adapter, "name", None)
+    if not name:
+        raise ValueError("decode-model adapter needs a non-empty .name")
+    if name in _REGISTRY and not clobber:
+        raise ValueError(
+            f"decode model {name!r} is already registered "
+            f"({type(_REGISTRY[name]).__name__}); pass clobber=True to "
+            "replace it")
+    _REGISTRY[name] = adapter
+    return adapter
+
+
+def _materialize(name):
+    if name not in _REGISTRY and name in _LAZY:
+        importlib.import_module(_LAZY[name])   # module registers itself
+    return _REGISTRY.get(name)
+
+
+def get_decode_model(name):
+    """The registered adapter for ``name``; imports a bundled family's
+    module lazily. Raises ``KeyError`` with the known names."""
+    adapter = _materialize(name)
+    if adapter is None:
+        known = sorted(set(_REGISTRY) | set(_LAZY))
+        raise KeyError(
+            f"no decode model registered under {name!r}; known: {known}")
+    return adapter
+
+
+def registered_decode_models():
+    """Tuple of registered names (lazy bundled families included)."""
+    return tuple(sorted(set(_REGISTRY) | set(_LAZY)))
+
+
+def resolve(model, spec=None):
+    """The adapter serving ``model``: ``spec`` may be a registry name, a
+    DecodeModel instance, or None (probe every adapter's ``matches``)."""
+    if isinstance(spec, DecodeModel):
+        return spec
+    if spec is not None:
+        return get_decode_model(spec)
+    for name in registered_decode_models():
+        adapter = _materialize(name)
+        if adapter is not None and adapter.matches(model):
+            return adapter
+    raise TypeError(
+        f"no registered decode model serves {type(model).__name__}; "
+        f"known: {sorted(registered_decode_models())} — register a "
+        "DecodeModel adapter (see paddle_tpu/serving/decode_model.py) or "
+        "pass decode_model= explicitly")
+
+
+def cache_row_bytes(row):
+    """Total device bytes of one handoff unit (any cache pytree: a
+    (kc, vc) pair, one side, or a quantized (values, scales) tuple) —
+    the payload accounting behind ``kv_handoff_bytes_total``."""
+    import jax
+
+    return int(sum(x.size * x.dtype.itemsize
+                   for x in jax.tree_util.tree_leaves(row)))

@@ -413,6 +413,38 @@ class TestSourceLint:
                "        return x\n")
         assert lint_source(src, "nn/layer/fake.py", traced=True) == []
 
+    def test_private_model_import_in_serving_positive(self):
+        # both module-level and function-level imports are caught
+        src = ("from ..models.gpt import _decode_fns\n"
+               "def build():\n"
+               "    from ..models.gpt import _tp_wrap, GPTConfig\n")
+        fs = lint_source(src, "inference/serving.py", traced=False)
+        assert [f.pass_name for f in fs] == \
+            ["private-model-import-in-serving"] * 2
+        assert all(f.severity == "error" for f in fs)
+        assert fs[0].where == "inference/serving.py:1"
+        # the serving/ package is covered too
+        fs = lint_source("from ..models.bert import _x\n",
+                         "serving/router.py", traced=False)
+        assert [f.pass_name for f in fs] == \
+            ["private-model-import-in-serving"]
+
+    def test_private_model_import_public_and_elsewhere_exempt(self):
+        # public names are the supported surface
+        assert lint_source("from ..models.gpt import GPTForCausalLM\n",
+                           "inference/predictor.py", traced=False) == []
+        # model modules may use their own privates (adapter registration)
+        assert lint_source("from .gpt import _decode_fns\n",
+                           "models/zoo.py", traced=True) == []
+        # non-serving packages are out of scope for this rule
+        assert lint_source("from ..models.gpt import _decode_fns\n",
+                           "hapi/model.py", traced=False) == []
+
+    def test_private_model_import_allow_marker(self):
+        src = ("from ..models.gpt import _x  "
+               "# lint: allow(private-model-import-in-serving)\n")
+        assert lint_source(src, "inference/serving.py", traced=False) == []
+
 
 # ---------------------------------------------------------------------------
 # analysis hooks: static Program and inference Predictor

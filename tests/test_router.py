@@ -1,0 +1,325 @@
+"""Front-door Router (serving/router.py): fan-out over named engines,
+session/prefix affinity, deadline-aware placement, drain-aware failover,
+engine-death failover, and trace threading. Every completion holds the
+serving tier's exact-parity bar vs solo generate()."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor, trace
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.serving.router import NoLiveEngineError, Router
+from paddle_tpu.testing import failpoints as fp
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=64, dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.RandomState(0)
+
+
+def _ref(m, prompt, n):
+    out = m.generate(paddle.to_tensor(prompt[None]), max_new_tokens=n,
+                     temperature=0.0)
+    return np.asarray(out._data)[0, len(prompt):]
+
+
+def _two_engine_router(model, **eng_kw):
+    return Router({"a": ServingEngine(model, max_batch=2, **eng_kw),
+                   "b": ServingEngine(model, max_batch=2, **eng_kw)})
+
+
+class TestFanout:
+    def test_two_engine_fanout_with_exact_parity(self, model, rng):
+        router = _two_engine_router(model)
+        prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
+                   for n in (4, 7, 9, 5, 12, 6)]
+        rids = [router.submit(p, max_new_tokens=6, session_id=i)
+                for i, p in enumerate(prompts)]
+        res = router.run_until_complete()
+        assert len(res) == 6
+        for rid, p in zip(rids, prompts):
+            np.testing.assert_array_equal(res[rid].tokens,
+                                          _ref(model, p, 6))
+            assert res[rid].finish_reason == "length"
+        st = router.stats()["router"]
+        # distinct sessions hash across BOTH engines (fan-out, not a
+        # degenerate single-engine pile-up)
+        assert set(st["requests"]) == {"a", "b"}
+        assert sum(st["requests"].values()) == 6
+
+    def test_router_requests_metric(self, model, rng):
+        monitor.reset()
+        router = _two_engine_router(model)
+        for i in range(4):
+            router.submit(rng.randint(0, 128, (5,)).astype(np.int32),
+                          max_new_tokens=2, session_id=i)
+        router.run_until_complete()
+        flat = monitor.flatten(monitor.snapshot())
+        total = sum(v for k, v in flat.items()
+                    if k.startswith("router_requests_total"))
+        assert total == 4
+
+    def test_engine_level_shed_is_collected(self, model, rng):
+        """A request finished OUTSIDE step() (priority-shed at submit
+        time by the engine's bounded queue) must still surface in the
+        router's results, not strand in the mapping."""
+        router = Router({"only": ServingEngine(model, max_batch=1,
+                                               max_queue=1)})
+        p = rng.randint(0, 128, (5,)).astype(np.int32)
+        r_low = router.submit(p, max_new_tokens=2, priority=0)
+        r_high = router.submit(p, max_new_tokens=2, priority=5)
+        done = router.step()
+        assert r_low in done
+        assert done[r_low].finish_reason == "shed"
+        res = router.run_until_complete()
+        assert res[r_high].finish_reason == "length"
+        assert router.stats()["router"]["outstanding"] == 0
+
+    def test_model_labels_route_per_model(self, model, rng):
+        router = Router({"a": ServingEngine(model, max_batch=2),
+                         "b": ServingEngine(model, max_batch=2)},
+                        models={"a": "gpt-a", "b": "gpt-b"})
+        p = rng.randint(0, 128, (5,)).astype(np.int32)
+        rid = router.submit(p, max_new_tokens=2, model="gpt-b")
+        assert router._reqs[rid].engine == "b"
+        with pytest.raises(NoLiveEngineError):
+            router.submit(p, max_new_tokens=2, model="gpt-z")
+
+
+class TestAffinity:
+    def test_session_affinity_pins_one_engine(self, model, rng):
+        router = _two_engine_router(model)
+        rids = [router.submit(rng.randint(0, 128, (5,)).astype(np.int32),
+                              max_new_tokens=2, session_id="chat-1")
+                for _ in range(4)]
+        router.run_until_complete()
+        engines = {router._reqs[r].engine for r in rids}
+        assert len(engines) == 1
+        aff = router.stats()["router"]["affinity"]
+        assert aff == {"hit": 3, "miss": 1, "hit_rate": 0.75}
+
+    def test_prefix_affinity_hit_rate_matches_single_engine(self, model,
+                                                            rng):
+        prefix = rng.randint(0, 128, (16,)).astype(np.int32)
+        suffixes = [rng.randint(0, 128, (4,)).astype(np.int32)
+                    for _ in range(4)]
+
+        # single-engine baseline: register once, every submit hits.
+        # prefill_chunk=8 keeps the suffix chunk schedule inside the
+        # small test cache (prefix_len + chunk <= max_seq_len)
+        solo = ServingEngine(model, max_batch=2, prefill_chunk=8)
+        pid = solo.register_prefix(prefix)
+        srids = [solo.submit(s, max_new_tokens=4, prefix_id=pid)
+                 for s in suffixes]
+        sres = solo.run_until_complete()
+        base = solo.stats()["prefix_cache"]
+        assert base["hit_rate"] == 1.0
+
+        # routed: affinity sends every same-prefix request to ONE engine,
+        # which registers the prefix lazily ONCE — aggregate hit rate must
+        # be >= the single-engine baseline (here: equal)
+        router = _two_engine_router(model, prefill_chunk=8)
+        rpid = router.register_prefix(prefix)
+        rrids = [router.submit(s, max_new_tokens=4, prefix_id=rpid)
+                 for s in suffixes]
+        rres = router.run_until_complete()
+        assert len(router._prefix_sites[rpid]) == 1   # one warm engine
+        hits = misses = 0
+        for st in router.stats()["engines"].values():
+            hits += st["prefix_cache"]["hit"]
+            misses += st["prefix_cache"]["miss"]
+        assert hits / (hits + misses) >= base["hit_rate"]
+        # identical tokens either way (prefix reuse is exact)
+        for sr, rr in zip(srids, rrids):
+            np.testing.assert_array_equal(sres[sr].tokens,
+                                          rres[rr].tokens)
+
+
+class TestDeadlinePlacement:
+    def test_deadline_routes_to_least_loaded(self, model, rng):
+        eng_busy = ServingEngine(model, max_batch=1)
+        eng_idle = ServingEngine(model, max_batch=1)
+        router = Router({"busy": eng_busy, "idle": eng_idle})
+        # pile queued work onto "busy" directly (bypassing placement)
+        for _ in range(3):
+            eng_busy.submit(rng.randint(0, 128, (5,)).astype(np.int32),
+                            max_new_tokens=4)
+        # a deadline request must ignore its affinity hash and take the
+        # engine most likely to start it in time
+        p = rng.randint(0, 128, (5,)).astype(np.int32)
+        rid = router.submit(p, max_new_tokens=4, session_id="s",
+                            deadline_ms=60_000)
+        assert router._reqs[rid].engine == "idle"
+        res = router.run_until_complete()
+        np.testing.assert_array_equal(res[rid].tokens, _ref(model, p, 4))
+
+    def test_queue_full_retries_on_other_candidates(self, model, rng):
+        router = _two_engine_router(model, max_queue=1)
+        # fill whichever engine session "s" hashes to
+        r0 = router.submit(rng.randint(0, 128, (5,)).astype(np.int32),
+                           max_new_tokens=2, session_id="s")
+        first = router._reqs[r0].engine
+        r1 = router.submit(rng.randint(0, 128, (5,)).astype(np.int32),
+                           max_new_tokens=2, session_id="s")
+        # same affinity target, full queue -> placed on the OTHER engine
+        # instead of propagating QueueFullError
+        assert router._reqs[r1].engine != first
+        router.run_until_complete()
+
+
+class TestFailover:
+    def test_drain_reroutes_queued_keeps_inflight(self, model, rng):
+        router = Router({"c": ServingEngine(model, max_batch=1),
+                         "d": ServingEngine(model, max_batch=1)})
+        prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
+                   for n in (5, 9, 13)]
+        # one session -> all three requests pile on one engine
+        rids = [router.submit(p, max_new_tokens=6, session_id="s")
+                for p in prompts]
+        router.step()                      # first request is now in-flight
+        target = router._reqs[rids[0]].engine
+        assert all(router._reqs[r].engine == target for r in rids)
+        router.drain(target)
+        # queued requests moved off; the in-flight one finishes in place
+        assert router._reqs[rids[0]].engine == target
+        assert all(router._reqs[r].engine != target for r in rids[1:])
+        assert router.health()[target]["state"] == "draining"
+        res = router.run_until_complete()
+        for rid, p in zip(rids, prompts):
+            np.testing.assert_array_equal(res[rid].tokens,
+                                          _ref(model, p, 6))
+        assert router.stats()["router"]["failover"]["drain"] == 2
+        # placement skips the draining engine for NEW work
+        r_new = router.submit(prompts[0], max_new_tokens=2,
+                              session_id="s")
+        assert router._reqs[r_new].engine != target
+        router.run_until_complete()
+
+    def test_engine_death_mid_stream_finishes_on_survivor(self, model,
+                                                          rng):
+        router = _two_engine_router(model)
+        prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
+                   for n in (5, 8, 11, 6)]
+        rids = [router.submit(p, max_new_tokens=8, session_id=i)
+                for i, p in enumerate(prompts)]
+        for _ in range(2):
+            router.step()                  # some tokens already decoded
+        with fp.scoped("serving/step=error:1"):
+            router.step()                  # first stepped engine dies
+        st = router.stats()["router"]
+        assert len(st["dead"]) == 1
+        assert st["failover"]["engine_error"] >= 1
+        res = router.run_until_complete()
+        # every request — including the dead engine's in-flight ones —
+        # finished on the survivor with exact greedy parity
+        for rid, p in zip(rids, prompts):
+            np.testing.assert_array_equal(res[rid].tokens,
+                                          _ref(model, p, 8))
+            assert res[rid].finish_reason == "length"
+        survivor = st["alive"]
+        assert all(router._reqs[r].engine in survivor for r in rids)
+
+    def test_failover_parks_when_survivor_queue_full(self, model, rng):
+        """An engine death while the survivor's bounded queue is full is
+        TRANSIENT pressure: the stranded requests park and complete once
+        the survivor drains — they are not terminally cancelled and the
+        router does not falsely report 'no live engine'."""
+        router = Router({"a": ServingEngine(model, max_batch=1,
+                                            max_queue=1),
+                         "b": ServingEngine(model, max_batch=1,
+                                            max_queue=1)})
+        prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
+                   for n in (4, 6, 8, 5)]
+        rids = []
+        rids.append(router.submit(prompts[0], max_new_tokens=4))
+        rids.append(router.submit(prompts[1], max_new_tokens=4))
+        router.step()   # both admitted into slots; queues empty again
+        rids.append(router.submit(prompts[2], max_new_tokens=4))
+        rids.append(router.submit(prompts[3], max_new_tokens=4))
+        with fp.scoped("serving/step=error:1"):
+            router.step()   # one engine dies; the survivor is at bound
+        st = router.stats()["router"]
+        assert len(st["dead"]) == 1
+        assert st["parked"] >= 1   # transient pressure, not cancellation
+        res = router.run_until_complete()
+        for rid, p in zip(rids, prompts):
+            np.testing.assert_array_equal(res[rid].tokens,
+                                          _ref(model, p, 4))
+            assert res[rid].finish_reason == "length"
+        assert router.stats()["router"]["parked"] == 0
+
+    def test_cancel_of_parked_request_sticks(self, model, rng):
+        """cancel() of a request parked by failover must be terminal —
+        the next step() must NOT re-dispatch it to the survivor."""
+        router = Router({"a": ServingEngine(model, max_batch=1,
+                                            max_queue=1),
+                         "b": ServingEngine(model, max_batch=1,
+                                            max_queue=1)})
+        prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
+                   for n in (4, 6, 8, 5)]
+        rids = [router.submit(prompts[0], max_new_tokens=4),
+                router.submit(prompts[1], max_new_tokens=4)]
+        router.step()
+        rids.append(router.submit(prompts[2], max_new_tokens=4))
+        rids.append(router.submit(prompts[3], max_new_tokens=4))
+        with fp.scoped("serving/step=error:1"):
+            router.step()
+        parked = [r for r in rids if router._reqs[r] in router._parked]
+        assert parked
+        victim = parked[0]
+        assert router.cancel(victim) is True
+        res = router.run_until_complete()
+        assert res[victim].finish_reason == "cancelled"
+        assert router._reqs[victim] not in router._parked
+
+    def test_all_engines_dead_is_loud(self, model, rng):
+        router = Router({"only": ServingEngine(model, max_batch=1)})
+        router.submit(rng.randint(0, 128, (5,)).astype(np.int32),
+                      max_new_tokens=4)
+        with fp.scoped("serving/step=error:1"):
+            with pytest.raises(NoLiveEngineError):
+                router.step()
+
+
+class TestObservability:
+    def test_route_span_threads_router_engine_slot(self, model, rng):
+        trace.clear()
+        trace.enable()
+        try:
+            router = _two_engine_router(model)
+            rid = router.submit(rng.randint(0, 128, (5,)).astype(np.int32),
+                                max_new_tokens=3, session_id="t")
+            router.run_until_complete()
+        finally:
+            trace.disable()
+        tid = router._reqs[rid].trace_id
+        fam = {s.name for s in trace.spans() if s.trace_id == tid}
+        # one trace threads the route decision, the engine request root,
+        # its queue wait, admission prefill, and slot-level decode steps
+        assert {"route", "request", "queue_wait", "prefill",
+                "decode"} <= fam
+        route = [s for s in trace.spans()
+                 if s.name == "route" and s.trace_id == tid][0]
+        assert route.attrs["engine"] == router._reqs[rid].engine
+
+    def test_get_request_and_cancel(self, model, rng):
+        router = _two_engine_router(model)
+        p = rng.randint(0, 128, (5,)).astype(np.int32)
+        rid = router.submit(p, max_new_tokens=4, session_id="x")
+        req = router.get_request(rid)
+        assert not req.finished
+        assert router.cancel(rid) is True
+        assert router.get_request(rid).finish_reason == "cancelled"
+        with pytest.raises(KeyError):
+            router.get_request(999)

@@ -1,0 +1,137 @@
+"""DecodeModel protocol + registry (serving/decode_model.py): the serving
+tier's only doorway into model code. Contract: gpt resolves lazily, the
+engine served THROUGH the registry is byte-identical to the pre-registry
+engine (same decode helpers under the adapter), and unknown models fail
+with an actionable error."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.serving import decode_model as dm
+
+
+def _model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=64, dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+class TestRegistry:
+    def test_gpt_resolves_lazily_by_name(self):
+        adapter = dm.get_decode_model("gpt")
+        assert adapter.name == "gpt"
+        assert "gpt" in dm.registered_decode_models()
+
+    def test_resolve_by_instance_and_spec(self):
+        m = _model()
+        a = dm.resolve(m)                      # probe matches()
+        assert a.name == "gpt"
+        assert dm.resolve(m, "gpt") is a       # by name
+        assert dm.resolve(m, a) is a           # pass-through instance
+
+    def test_unknown_name_lists_known(self):
+        with pytest.raises(KeyError, match="gpt"):
+            dm.get_decode_model("nope")
+
+    def test_unmatched_model_is_actionable(self):
+        with pytest.raises(TypeError, match="DecodeModel adapter"):
+            dm.resolve(object())
+
+    def test_duplicate_registration_rejected(self):
+        class Fake(dm.DecodeModel):
+            name = "gpt"
+
+        with pytest.raises(ValueError, match="already registered"):
+            dm.register_decode_model(Fake())
+        # clobber + restore (keeps the real adapter installed for the
+        # rest of the suite)
+        real = dm.get_decode_model("gpt")
+        dm.register_decode_model(Fake(), clobber=True)
+        try:
+            assert isinstance(dm.get_decode_model("gpt"), Fake)
+        finally:
+            dm.register_decode_model(real, clobber=True)
+
+    def test_nameless_adapter_rejected(self):
+        with pytest.raises(ValueError, match="name"):
+            dm.register_decode_model(dm.DecodeModel())
+
+
+class TestGPTAdapter:
+    def test_cache_spec_documents_layout(self):
+        m = _model()
+        spec = dm.resolve(m).cache_spec(m.cfg)
+        assert spec["kind"] == "kv_pair"
+        assert spec["layout"] == "[L, B, KVh, T, hd]"
+        assert spec["axes"] == {"L": 2, "KVh": 2, "T": 64, "hd": 16}
+
+    def test_decode_fns_cache_init_matches_spec(self):
+        import jax.numpy as jnp
+
+        m = _model()
+        a = dm.resolve(m)
+        params, aux = a.extract_params(m, "the model")
+        fwd, logits_of, cache_init = a.decode_fns(m.cfg, aux)
+        kc, vc = cache_init(3, 64, jnp.float32)
+        assert kc.shape == vc.shape == (2, 3, 2, 64, 16)
+
+    def test_cache_row_bytes(self):
+        import jax.numpy as jnp
+
+        m = _model()
+        a = dm.resolve(m)
+        _, aux = a.extract_params(m, "the model")
+        cache_init = a.decode_fns(m.cfg, aux)[2]
+        row = cache_init(1, 64, jnp.float32)
+        # two sides x [L=2, 1, KVh=2, T=64, hd=16] f32
+        assert dm.cache_row_bytes(row) == 2 * 2 * 2 * 64 * 16 * 4
+
+    def test_compute_dtype_and_config_check_delegate(self):
+        import jax.numpy as jnp
+
+        m = _model()
+        a = dm.resolve(m)
+        assert a.compute_dtype(None) is None
+        assert a.compute_dtype("bfloat16") == jnp.bfloat16
+        moe = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=32, num_experts=2)
+        with pytest.raises(ValueError):
+            a.check_config(moe)
+
+
+class TestEngineThroughRegistry:
+    def test_engine_outputs_identical_by_every_resolution_path(self):
+        m = _model()
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
+                   for n in (5, 11)]
+
+        def run(**kw):
+            eng = ServingEngine(m, max_batch=2, **kw)
+            rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+            res = eng.run_until_complete()
+            return [res[r].tokens for r in rids]
+
+        base = run()                               # resolve by matches()
+        by_name = run(decode_model="gpt")          # resolve by name
+        by_inst = run(decode_model=dm.get_decode_model("gpt"))
+        for a, b, c in zip(base, by_name, by_inst):
+            np.testing.assert_array_equal(a, b)
+            np.testing.assert_array_equal(a, c)
+        # and exact solo-generate parity (the serving tier's parity bar)
+        for p, toks in zip(prompts, base):
+            ref = m.generate(paddle.to_tensor(p[None]), max_new_tokens=6,
+                             temperature=0.0)
+            np.testing.assert_array_equal(
+                toks, np.asarray(ref._data)[0, len(p):])
+
+    def test_dense_base_adapter_rejects_tp(self):
+        a = dm.DecodeModel()
+        a.name = "dense-only"
+        with pytest.raises(NotImplementedError, match="tensor-parallel"):
+            a.tp_setup(None, None, None)

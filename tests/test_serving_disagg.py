@@ -1,0 +1,241 @@
+"""Prefill/decode disaggregation (serving/disagg.py): the acceptance bar
+is BIT-IDENTICAL completions vs the monolithic engine on the same
+prompts, with every handoff metered (kv_handoff span +
+kv_handoff_bytes_total)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor, trace
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.serving import decode_model as dm
+from paddle_tpu.serving.disagg import DisaggregatedPool, PrefillWorker
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=64, dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.RandomState(0)
+
+
+class TestBitIdentical:
+    def test_pool_matches_monolithic_engine(self, model, rng):
+        prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
+                   for n in (5, 9, 17, 4, 12)]
+        mono = ServingEngine(model, max_batch=2)
+        mrids = [mono.submit(p, max_new_tokens=8) for p in prompts]
+        mres = mono.run_until_complete()
+
+        pool = DisaggregatedPool(model, prefill_workers=2,
+                                 decode_engines=2, max_batch=2)
+        prids = [pool.submit(p, max_new_tokens=8) for p in prompts]
+        pres = pool.run_until_complete()
+        for mr, pr in zip(mrids, prids):
+            np.testing.assert_array_equal(pres[pr].tokens,
+                                          mres[mr].tokens)
+            assert pres[pr].finish_reason == mres[mr].finish_reason
+        st = pool.stats()["pool"]
+        assert st["handoffs"] == 5 and st["pending"] == 0
+        # the split actually fanned decode work out
+        assert len(st["per_engine"]) == 2
+
+    def test_sampling_seeds_survive_the_handoff(self, model, rng):
+        p = rng.randint(0, 128, (7,)).astype(np.int32)
+        mono = ServingEngine(model, max_batch=2)
+        r = mono.submit(p, max_new_tokens=6, temperature=0.8, top_k=20,
+                        seed=1234)
+        mres = mono.run_until_complete()
+        pool = DisaggregatedPool(model, prefill_workers=1,
+                                 decode_engines=1, max_batch=2)
+        pr = pool.submit(p, max_new_tokens=6, temperature=0.8, top_k=20,
+                         seed=1234)
+        pres = pool.run_until_complete()
+        np.testing.assert_array_equal(pres[pr].tokens, mres[r].tokens)
+
+    def test_backpressure_more_requests_than_slots(self, model, rng):
+        pool = DisaggregatedPool(model, prefill_workers=1,
+                                 decode_engines=1, max_batch=2)
+        prompts = [rng.randint(0, 128, (4 + i,)).astype(np.int32)
+                   for i in range(6)]
+        rids = [pool.submit(p, max_new_tokens=4) for p in prompts]
+        pool.step()
+        # only as many handoffs as the decode tier has room for
+        assert pool.stats()["pool"]["handoffs"] <= 2
+        res = pool.run_until_complete()
+        assert len(res) == 6
+        for rid, p in zip(rids, prompts):
+            ref = model.generate(paddle.to_tensor(p[None]),
+                                 max_new_tokens=4, temperature=0.0)
+            np.testing.assert_array_equal(
+                res[rid].tokens, np.asarray(ref._data)[0, len(p):])
+
+
+class TestHandoffAccounting:
+    def test_kv_handoff_metrics(self, model, rng):
+        monitor.reset()
+        pool = DisaggregatedPool(model, prefill_workers=1,
+                                 decode_engines=1, max_batch=2)
+        pool.submit(rng.randint(0, 128, (5,)).astype(np.int32),
+                    max_new_tokens=2)
+        pool.submit(rng.randint(0, 128, (9,)).astype(np.int32),
+                    max_new_tokens=2)
+        pool.run_until_complete()
+        flat = monitor.flatten(monitor.snapshot())
+        # one [L=2, 1, KVh=2, T=64, hd=16] f32 row per side, two sides,
+        # two handoffs
+        expect = 2 * (2 * 2 * 2 * 64 * 16 * 4)
+        assert flat["kv_handoff_bytes_total"] == expect
+        assert flat["kv_handoff_total{event=ok}"] == 2
+        assert pool.stats()["pool"]["handoff_bytes"] == expect
+
+    def test_kv_handoff_span_threads_to_the_decode_request(self, model,
+                                                           rng):
+        trace.clear()
+        trace.enable()
+        try:
+            pool = DisaggregatedPool(model, prefill_workers=1,
+                                     decode_engines=1, max_batch=2)
+            rid = pool.submit(rng.randint(0, 128, (5,)).astype(np.int32),
+                              max_new_tokens=3)
+            pool.run_until_complete()
+        finally:
+            trace.disable()
+        handoffs = [s for s in trace.spans() if s.name == "kv_handoff"]
+        assert len(handoffs) == 1
+        sp = handoffs[0]
+        assert sp.attrs["bytes"] > 0 and sp.attrs["engine"] == "decode0"
+        # the engine request + decode spans joined the handoff's trace
+        fam = {s.name for s in trace.spans()
+               if s.trace_id == sp.trace_id}
+        assert {"kv_handoff", "request", "decode"} <= fam
+        assert pool.get_request(rid).trace_id == sp.trace_id
+
+
+class TestAdmitPrefilled:
+    def test_manual_worker_to_engine_handoff(self, model, rng):
+        """The raw interface a remote prefill tier would drive: worker
+        prefills, engine admits the row, outputs match submit()."""
+        p = rng.randint(0, 128, (9,)).astype(np.int32)
+        eng = ServingEngine(model, max_batch=2)
+        r_direct = eng.submit(p, max_new_tokens=5)
+        worker = PrefillWorker(model)
+        kv_row, logits = worker.prefill(p)
+        r_handoff = eng.admit_prefilled(p, kv_row, logits,
+                                        max_new_tokens=5)
+        res = eng.run_until_complete()
+        np.testing.assert_array_equal(res[r_handoff].tokens,
+                                      res[r_direct].tokens)
+        assert dm.cache_row_bytes(kv_row) > 0
+        assert worker.stats()["prefills"] == 1
+
+    def test_handoff_queue_lifecycle(self, model, rng):
+        p = rng.randint(0, 128, (5,)).astype(np.int32)
+        eng = ServingEngine(model, max_batch=1)
+        worker = PrefillWorker(model)
+        kv_row, logits = worker.prefill(p)
+        rid = eng.admit_prefilled(p, kv_row, logits, max_new_tokens=4)
+        # visible while waiting in the handoff queue...
+        assert eng.get_request(rid).rid == rid
+        assert eng.has_work()
+        assert eng.stats()["requests"]["handoff"] == 1
+        # ...and cancellable there
+        assert eng.cancel(rid) is True
+        assert eng.get_request(rid).finish_reason == "cancelled"
+        assert not eng.has_work()
+
+    def test_admit_prefilled_validation(self, model, rng):
+        p = rng.randint(0, 128, (5,)).astype(np.int32)
+        eng = ServingEngine(model, max_batch=1)
+        worker = PrefillWorker(model)
+        kv_row, logits = worker.prefill(p)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.admit_prefilled(p, kv_row, logits, max_new_tokens=0)
+        with pytest.raises(ValueError, match="empty prompt"):
+            eng.admit_prefilled(np.zeros((0,), np.int32), kv_row, logits)
+        eng.drain()
+        with pytest.raises(RuntimeError, match="draining"):
+            eng.admit_prefilled(p, kv_row, logits)
+
+    def test_bounded_engine_rejects_handoff_when_full(self, model, rng):
+        """max_queue bounds the TOTAL admission backlog (queue +
+        handoff): a producer pushing prefilled rows past the bound gets
+        QueueFullError instead of unbounded growth, and health() sees
+        the handoff backlog as queue depth."""
+        from paddle_tpu.inference.serving import QueueFullError
+
+        p = rng.randint(0, 128, (5,)).astype(np.int32)
+        eng = ServingEngine(model, max_batch=1, max_queue=2)
+        worker = PrefillWorker(model)
+        kv_row, logits = worker.prefill(p)
+        eng.admit_prefilled(p, *worker.prefill(p), max_new_tokens=2)
+        eng.admit_prefilled(p, *worker.prefill(p), max_new_tokens=2)
+        assert eng.health()["queue_depth"] == 2
+        assert eng.health()["state"] == "degraded"   # >= 80% of bound
+        with pytest.raises(QueueFullError):
+            eng.admit_prefilled(p, kv_row, logits, max_new_tokens=2)
+        res = eng.run_until_complete()
+        assert len(res) == 2
+
+    def test_bounded_pool_never_wastes_a_prefill(self, model, rng):
+        """With max_queue < max_batch the pool's backpressure must gate
+        BEFORE the prefill forward runs: each prompt is prefilled exactly
+        once (a row computed then rejected by QueueFullError would be
+        recomputed every step)."""
+        pool = DisaggregatedPool(model, prefill_workers=1,
+                                 decode_engines=1, max_batch=4,
+                                 max_queue=1)
+        prompts = [rng.randint(0, 128, (4 + i,)).astype(np.int32)
+                   for i in range(4)]
+        rids = [pool.submit(p, max_new_tokens=3) for p in prompts]
+        res = pool.run_until_complete()
+        assert len(res) == 4
+        assert pool.workers[0].stats()["prefills"] == 4
+        for rid, p in zip(rids, prompts):
+            ref = model.generate(paddle.to_tensor(p[None]),
+                                 max_new_tokens=3, temperature=0.0)
+            np.testing.assert_array_equal(
+                res[rid].tokens, np.asarray(ref._data)[0, len(p):])
+
+    def test_speculative_engine_rejects_handoff(self, model, rng):
+        draft = model   # any valid decode model works as its own draft
+        eng = ServingEngine(model, max_batch=1, draft_model=draft,
+                            spec_k=2)
+        worker = PrefillWorker(model)
+        kv_row, logits = worker.prefill(
+            rng.randint(0, 128, (5,)).astype(np.int32))
+        with pytest.raises(RuntimeError, match="speculative"):
+            eng.admit_prefilled(np.arange(3, dtype=np.int32), kv_row,
+                                logits)
+
+    def test_pool_submit_fails_fast_on_bad_args(self, model, rng):
+        """Invalid decode args are rejected at pool.submit — a bad
+        request that only failed at handoff time would wedge the pool
+        (re-raised from every step, blocking the prefill queue)."""
+        pool = DisaggregatedPool(model, prefill_workers=1,
+                                 decode_engines=1, max_batch=2)
+        p = rng.randint(0, 128, (5,)).astype(np.int32)
+        with pytest.raises(ValueError, match="temperature"):
+            pool.submit(p, temperature=-1)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            pool.submit(p, max_new_tokens=0)
+        # the rejected submits left nothing pending; valid traffic flows
+        rid = pool.submit(p, max_new_tokens=3)
+        res = pool.run_until_complete()
+        assert res[rid].finish_reason == "length"
+
+    def test_worker_validation(self, model):
+        worker = PrefillWorker(model)
+        with pytest.raises(ValueError, match="empty prompt"):
+            worker.prefill(np.zeros((0,), np.int32))
+        with pytest.raises(ValueError, match="too long"):
+            worker.prefill(np.zeros((64,), np.int32))

@@ -17,6 +17,10 @@ Checks (one entry per name in `passes`):
                      the survivor stays bit-exact
   serving_shed       a full bounded queue raises QueueFullError and a
                      higher-priority arrival sheds the lowest
+  router_failover    one of a Router's two engines is killed mid-stream
+                     via the serving/step failpoint; every request —
+                     including the dead engine's in-flight ones —
+                     finishes on the survivor with exact greedy parity
   trainer_nonfinite  a NaN batch under FLAGS_check_nan_inf skips the
                      update, leaving params/moments bit-identical
 
@@ -39,7 +43,8 @@ jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 PASSES = ["ckpt_atomic", "ckpt_fallback", "serving_deadline",
-          "serving_slot_error", "serving_shed", "trainer_nonfinite"]
+          "serving_slot_error", "serving_shed", "router_failover",
+          "trainer_nonfinite"]
 
 
 def _finding(name, severity, message, where=""):
@@ -215,6 +220,53 @@ def _check_serving_shed(m):
                 "queue bound enforced; priority shedding works")]
 
 
+def _check_router_failover(m):
+    import numpy as np
+
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.serving.router import Router
+    from paddle_tpu.testing import failpoints as fp
+
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, 64, (n,)).astype(np.int32)
+               for n in (4, 7, 9)]
+    router = Router({"a": ServingEngine(m, max_batch=2),
+                     "b": ServingEngine(m, max_batch=2)})
+    rids = [router.submit(p, max_new_tokens=6, session_id=i)
+            for i, p in enumerate(prompts)]
+    for _ in range(2):
+        router.step()   # tokens already streaming on both engines
+    with fp.scoped("serving/step=error:1"):
+        router.step()   # the first stepped engine dies mid-stream
+    st = router.stats()["router"]
+    if len(st["dead"]) != 1:
+        return [_finding("router_failover", "error",
+                         "killed engine was not marked dead "
+                         f"(dead={st['dead']})")]
+    res = router.run_until_complete()
+    for rid, p in zip(rids, prompts):
+        if res[rid].finish_reason != "length":
+            return [_finding(
+                "router_failover", "error",
+                f"request {rid} finished with "
+                f"{res[rid].finish_reason!r}, not 'length' — the finish "
+                "reason was lost in the failover")]
+        if not np.array_equal(res[rid].tokens, _ref_tokens(m, p, 6)):
+            return [_finding("router_failover", "error",
+                             f"request {rid} lost greedy parity after "
+                             "re-routing to the survivor")]
+    (survivor,) = st["alive"]
+    stranded = [rid for rid in rids
+                if router._reqs[rid].engine != survivor]
+    if stranded:
+        return [_finding("router_failover", "error",
+                         f"requests {stranded} did not end on the "
+                         f"surviving engine {survivor!r}")]
+    return [_ok("router_failover",
+                "engine killed mid-stream; all requests finished on the "
+                "survivor, bit-exact, reasons recorded")]
+
+
 def _check_trainer_nonfinite():
     import numpy as np
 
@@ -274,12 +326,13 @@ def build_report(only=None):
         ("trainer_nonfinite", _check_trainer_nonfinite),
     ]
     if selected & {"serving_deadline", "serving_slot_error",
-                   "serving_shed"}:
+                   "serving_shed", "router_failover"}:
         m = _tiny_model()
         checks += [
             ("serving_deadline", lambda: _check_serving_deadline(m)),
             ("serving_slot_error", lambda: _check_serving_slot_error(m)),
             ("serving_shed", lambda: _check_serving_shed(m)),
+            ("router_failover", lambda: _check_router_failover(m)),
         ]
     for name, fn in checks:
         if name not in selected:

@@ -2,6 +2,7 @@
 
     python tools/metrics_dump.py --model gpt              # one gpt train step
     python tools/metrics_dump.py --serving                # serving decode loop
+    python tools/metrics_dump.py --router                 # multi-engine tier
     python tools/metrics_dump.py --model bert --prometheus
     python tools/metrics_dump.py --all --json             # machine-readable
     python tools/metrics_dump.py --serving --trace        # + span summary
@@ -37,6 +38,8 @@ _REQUIRED = {
     "train": ("compile_cache_total", "compile_total", "step_latency_ms"),
     "serving": ("serving_ttft_ms", "serving_inter_token_ms",
                 "serving_requests_submitted_total", "serving_tokens_total"),
+    "router": ("router_requests_total", "kv_handoff_bytes_total",
+               "kv_handoff_total", "serving_requests_submitted_total"),
 }
 
 _DIMS = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
@@ -114,6 +117,39 @@ def run_serving_loop(new_tokens=6):
     return eng.stats()
 
 
+def run_router_loop(new_tokens=4):
+    """The multi-engine serving tier: a 2-engine Router fanning three
+    session-keyed prompts, then a DisaggregatedPool (1 prefill worker ->
+    1 decode engine) handing off two prefilled KV rows — exercises
+    router_requests_total AND the kv_handoff familes in one target."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving.disagg import DisaggregatedPool
+    from paddle_tpu.serving.router import Router
+
+    paddle.seed(0)
+    model = GPTForCausalLM(GPTConfig(max_seq_len=64, **_DIMS))
+    model.eval()
+    rng = np.random.RandomState(0)
+    router = Router({"a": ServingEngine(model, max_batch=2),
+                     "b": ServingEngine(model, max_batch=2)})
+    for i in range(3):
+        router.submit(rng.randint(0, 256, (6 + i,)).astype(np.int32),
+                      max_new_tokens=new_tokens, session_id=i)
+    router.run_until_complete()
+    pool = DisaggregatedPool(model, prefill_workers=1, decode_engines=1,
+                             max_batch=2)
+    for n in (5, 9):
+        pool.submit(rng.randint(0, 256, (n,)).astype(np.int32),
+                    max_new_tokens=new_tokens)
+    pool.run_until_complete()
+    return {"router": router.stats()["router"],
+            "pool": pool.stats()["pool"]}
+
+
 def _metric_families(snap):
     return {m["name"]: m for m in snap["metrics"] if m["series"]}
 
@@ -128,13 +164,15 @@ def run_target(name, with_trace=False):
 
     monitor.reset()
     trace_summary = None
-    kind = "serving" if name == "serving" else "train"
+    kind = name if name in ("serving", "router") else "train"
     if with_trace:
         trace.clear()
         trace.enable()
     try:
         if kind == "serving":
             run_serving_loop()
+        elif kind == "router":
+            run_router_loop()
         else:
             run_train_step(name)
     finally:
@@ -183,6 +221,11 @@ def main(argv=None):
                     default=[], help="run one bundled model's train step")
     ap.add_argument("--serving", action="store_true",
                     help="run the ServingEngine decode loop")
+    ap.add_argument("--router", action="store_true", dest="router",
+                    help="run the multi-engine tier (Router fan-out + "
+                         "disaggregated prefill/decode handoff); exit 1 "
+                         "when the router/kv_handoff metric families are "
+                         "missing")
     ap.add_argument("--all", action="store_true",
                     help="all models + the serving loop")
     ap.add_argument("--json", action="store_true", dest="as_json",
@@ -197,10 +240,13 @@ def main(argv=None):
     targets = list(args.model)
     if args.serving:
         targets.append("serving")
+    if args.router:
+        targets.append("router")
     if args.all:
-        targets = list(MODEL_TARGETS) + ["serving"]
+        targets = list(MODEL_TARGETS) + ["serving", "router"]
     if not targets:
-        ap.error("pick a target: --model NAME, --serving or --all")
+        ap.error("pick a target: --model NAME, --serving, --router or "
+                 "--all")
 
     report = build_report(targets, with_trace=args.with_trace)
     if args.as_json:

@@ -2,6 +2,7 @@
 
     python tools/trace_dump.py --model gpt --train        # traced train step
     python tools/trace_dump.py --serving                  # traced serving loop
+    python tools/trace_dump.py --router                   # multi-engine tier
     python tools/trace_dump.py --serving --chrome out.json
     python tools/trace_dump.py --all --json               # machine report
 
@@ -36,6 +37,10 @@ MODEL_TARGETS = ("gpt", "bert", "ernie")
 REQUIRED = {
     "train": ("train_step",),
     "serving": ("request", "queue_wait", "prefill", "decode"),
+    # the multi-engine tier: route (Router placement) + kv_handoff
+    # (disaggregated prefill->decode transfer) threading into the same
+    # engine span families the monolithic loop emits
+    "router": ("route", "kv_handoff", "request", "queue_wait", "decode"),
 }
 
 
@@ -62,12 +67,14 @@ def run_target(name):
     try:
         if name == "serving":
             md.run_serving_loop()
+        elif name == "router":
+            md.run_router_loop()
         else:
             md.run_train_step(name)
     finally:
         trace.disable()
     spans = trace.spans()
-    kind = "serving" if name == "serving" else "train"
+    kind = name if name in ("serving", "router") else "train"
     names = {s.name for s in spans}
     findings = []
     for fam in REQUIRED[kind]:
@@ -92,6 +99,24 @@ def run_target(name):
                     "message": f"request trace {root.trace_id} is missing "
                                f"span families {sorted(missing)}",
                     "where": name})
+    if kind == "router":
+        # placement and handoff spans must THREAD into engine traces:
+        # a route/kv_handoff trace_id with no request/decode members
+        # means the propagation chain (submit trace_id=/parent_span=)
+        # broke somewhere
+        for fam, need in (("route", {"request"}),
+                          ("kv_handoff", {"request", "decode"})):
+            for root in [s for s in spans if s.name == fam]:
+                members = {s.name for s in spans
+                           if s.trace_id == root.trace_id}
+                missing = need - members
+                if missing:
+                    findings.append({
+                        "pass": "trace-linkage", "severity": "error",
+                        "message": f"{fam} trace {root.trace_id} is "
+                                   f"missing span families "
+                                   f"{sorted(missing)}",
+                        "where": name})
     if kind == "train":
         steps = [s for s in spans if s.name == "train_step"]
         if steps and not any(
@@ -143,8 +168,14 @@ def main(argv=None):
                          "(default gpt when no --model given)")
     ap.add_argument("--serving", action="store_true",
                     help="trace the ServingEngine decode loop")
+    ap.add_argument("--router", action="store_true", dest="router",
+                    help="trace the multi-engine tier (Router fan-out + "
+                         "disaggregated handoff); exit 1 when the "
+                         "route/kv_handoff span families are missing or "
+                         "unlinked")
     ap.add_argument("--all", action="store_true",
-                    help="all models + the serving loop")
+                    help="all models + the serving loop + the router "
+                         "tier")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the graph_lint-schema machine report")
     ap.add_argument("--chrome", metavar="OUT.json",
@@ -157,11 +188,13 @@ def main(argv=None):
         targets = ["gpt"]
     if args.serving:
         targets.append("serving")
+    if args.router:
+        targets.append("router")
     if args.all:
-        targets = list(MODEL_TARGETS) + ["serving"]
+        targets = list(MODEL_TARGETS) + ["serving", "router"]
     if not targets:
-        ap.error("pick a target: --model NAME [--train], --serving or "
-                 "--all")
+        ap.error("pick a target: --model NAME [--train], --serving, "
+                 "--router or --all")
 
     report = build_report(targets)
     if args.chrome:
