@@ -30,6 +30,7 @@ Usage: python bench.py [--batch B] [--seq S] [--steps N] [--sweep]
 """
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -65,6 +66,61 @@ def _emit(line):
         pass  # the metric line must never die on telemetry
     _LAST_GOOD = copy.deepcopy(line)
     print(json.dumps(line), flush=True)
+
+
+# --- banked-legs resume (ROADMAP item 4) -------------------------------------
+# Each completed leg's metric line is appended to the --banked JSONL the
+# moment it lands; a re-invocation with the same file skips already-banked
+# legs, so five wedged rounds can still assemble one complete result
+# inside the TPU-tunnel watchdog window.
+
+_BANKED_PATH = None
+_BANKED = {}
+
+
+def _bank_load(path):
+    """Read the banked-legs JSONL from an earlier (possibly wedged)
+    invocation: one {"leg", "line"} record per completed measurement."""
+    global _BANKED_PATH
+    _BANKED_PATH = path
+    _BANKED.clear()
+    if not path or not os.path.exists(path):
+        return
+    try:
+        with open(path) as f:
+            for raw in f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    rec = json.loads(raw)
+                except ValueError:
+                    continue   # torn tail line from a killed writer
+                if isinstance(rec, dict) and "leg" in rec:
+                    _BANKED[rec["leg"]] = rec.get("line")
+    except OSError as e:
+        print(f"  banked file unreadable ({e})", file=sys.stderr)
+
+
+def _bank(leg, line):
+    """Persist one completed leg NOW (append + flush + fsync): a later
+    wedge, crash, or kill cannot erase it."""
+    _BANKED[leg] = line
+    if not _BANKED_PATH:
+        return
+    try:
+        with open(_BANKED_PATH, "a") as f:
+            f.write(json.dumps({"ts": round(time.time(), 3), "leg": leg,
+                                "line": line}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError as e:
+        print(f"  banking leg {leg!r} failed ({e})", file=sys.stderr)
+
+
+def _banked(leg):
+    """The banked payload for a leg, or None (leg must be re-measured)."""
+    return _BANKED.get(leg)
 
 
 # cumulative compile-cache counts at the previous heartbeat, so each
@@ -115,6 +171,13 @@ def _heartbeat(phase, status="start", **fields):
         # totals, so a wedged phase's heartbeat also names WHERE the
         # traced time went (prefill vs decode vs compile vs checkpoint)
         tsum = trace.snapshot_summary(3)
+        # flight recorder: every heartbeat beats the bench/phase beacon
+        # and stamps the current phase into the dump-bundle context, so a
+        # sentinel/watchdog dump names the wedged phase by itself
+        monitor.blackbox.beacon("bench/phase")
+        monitor.blackbox.set_context("bench_phase",
+                                     f"{phase}:{status}")
+        monitor.blackbox.note("bench_phase", phase=phase, status=status)
         monitor.log_event("bench_phase", phase=phase, status=status,
                           compile_cache=counts, compile_cache_delta=delta,
                           jit_cache_dir=flags.get_flag("jit_cache_dir", ""),
@@ -703,10 +766,34 @@ def _arm_watchdog(seconds=900):
     exit 0 — a wedge after a success must not erase the success. Only a
     run with NO measurement at all exits 3 with an error line (no
     "metric"/"value" keys, so a failure never parses as a number)."""
-    import os
     import threading
 
+    def _dump_bundle():
+        """Best-effort, BOUNDED dump attempt: the bundle's context names
+        the wedged phase and its stacks show where every thread hung —
+        but a dump that itself blocks (the wedged process may hold the
+        very locks the bundle writer needs) must never stand between the
+        watchdog and its exit, so it runs on a helper thread with a
+        join timeout."""
+        try:
+            from paddle_tpu.monitor import blackbox
+
+            if not blackbox.is_enabled():
+                return
+            t = threading.Thread(
+                target=blackbox.dump, args=("stall",),
+                kwargs={"site": "bench/watchdog",
+                        "extra": {"watchdog_s": seconds}},
+                name="bench-watchdog-dump", daemon=True)
+            t.start()
+            t.join(timeout=30)
+        except Exception:
+            pass
+
     def _fire():
+        # the re-emit comes FIRST: the driver parses the LAST JSON line,
+        # and nothing — dump included — may stand between a wedged
+        # process and that line
         if _LAST_GOOD is not None:
             line = dict(_LAST_GOOD)
             line["partial"] = True  # truncated run — later phase(s) missing
@@ -714,6 +801,7 @@ def _arm_watchdog(seconds=900):
                 f"a later phase hung >{seconds}s; this is the last complete "
                 "measurement")
             print(json.dumps(line), flush=True)
+            _dump_bundle()
             # exit 0 only when a REAL config measurement survived; if all
             # we have is the toy canary, exit 2: the line is still
             # driver-verifiable evidence of a healthy window, but the run
@@ -723,6 +811,7 @@ def _arm_watchdog(seconds=900):
             "error": f"watchdog: no measurement within {seconds}s — "
                      "TPU tunnel unavailable/wedged",
         }), flush=True)
+        _dump_bundle()
         os._exit(3)
 
     t = threading.Timer(seconds, _fire)
@@ -764,7 +853,53 @@ def main():
     ap.add_argument("--window", type=int, default=None,
                     help="sliding-window attention width for gpt2s/gpt2s_16k "
                          "(flash kernels skip out-of-band blocks)")
+    ap.add_argument("--banked", default=None, metavar="PATH",
+                    help="banked-legs JSONL: completed legs are appended "
+                         "here as they land and SKIPPED on re-invocation, "
+                         "so retries inside the TPU-tunnel window resume "
+                         "instead of re-measuring (ROADMAP item 4)")
     args = ap.parse_args()
+
+    _bank_load(args.banked)
+
+    def leg_key(base):
+        """Banked-leg key = leg name + every explicitly pinned
+        measurement parameter: a leg banked under one configuration must
+        never satisfy a re-invocation asking for a different one
+        (--window/--batch/--seq/--steps each change what is measured)."""
+        parts = [base]
+        if args.batch is not None:
+            parts.append(f"b{args.batch}")
+        if args.seq is not None:
+            parts.append(f"s{args.seq}")
+        if args.steps != 20:
+            parts.append(f"st{args.steps}")
+        if args.window is not None:
+            parts.append(f"w{args.window}")
+        return ":".join(parts)
+
+    headline_leg = leg_key("headline")
+
+    # black-box flight recorder + stall sentinel (docs/OBSERVABILITY.md):
+    # armed BEFORE backend init so a wedged phase — device init, a heavy
+    # compile, a serving drain — produces a dump bundle naming the phase
+    # (bench_phase context + beacon table + all-thread stacks) instead of
+    # only the watchdog note. Default threshold 850s: just inside the
+    # initial 900s watchdog window (a real init wedge dumps before the
+    # kill) but above any leg the 900s windows consider healthy — a
+    # sentinel bundle from a 1200/2500s re-armed window means "no
+    # progress for 850s", evidence, not a verdict (the watchdog decides
+    # life/death). FLAGS_stall_timeout_s overrides.
+    try:
+        from paddle_tpu import flags as _bb_flags
+        from paddle_tpu.monitor import blackbox as _bb
+
+        _bb.enable()
+        _bb.start_sentinel(
+            timeout_s=float(_bb_flags.get_flag("stall_timeout_s", 0.0))
+            or 850.0)
+    except Exception as e:
+        print(f"  blackbox recorder unavailable ({e})", file=sys.stderr)
 
     # arm BEFORE backend init: a wedged tunnel hangs inside jax.devices()
     # itself, which is precisely the case the watchdog must catch
@@ -803,16 +938,24 @@ def main():
         # so a wedge later in the run can never reduce this process to a
         # watchdog error (the watchdog re-emits the last complete line).
         try:
-            _heartbeat("micro_canary")
-            sps, _ = run_micro(quiet=True)
-            _heartbeat("micro_canary", "done")
-            # vs_baseline 0.0: a toy config has no baseline target and its
-            # raw tokens/s against the headline's 10k would misread as a
-            # baseline-beating result
-            _emit({"metric": "micro_gpt2_train_tokens_per_sec_per_chip",
-                   "value": round(sps, 1), "unit": "tokens/s",
-                   "vs_baseline": 0.0, "config": "micro",
-                   "note": "wedge-canary (2-layer GPT); headline follows"})
+            micro_banked = _banked("micro")
+            if micro_banked is not None:
+                print("  micro canary: banked, skipping", file=sys.stderr)
+                _emit(dict(micro_banked, banked=True))
+            else:
+                _heartbeat("micro_canary")
+                sps, _ = run_micro(quiet=True)
+                _heartbeat("micro_canary", "done")
+                # vs_baseline 0.0: a toy config has no baseline target and
+                # its raw tokens/s against the headline's 10k would
+                # misread as a baseline-beating result
+                line = {"metric": "micro_gpt2_train_tokens_per_sec_per_chip",
+                        "value": round(sps, 1), "unit": "tokens/s",
+                        "vs_baseline": 0.0, "config": "micro",
+                        "note": "wedge-canary (2-layer GPT); "
+                                "headline follows"}
+                _emit(line)
+                _bank("micro", line)
         except Exception as e:
             _heartbeat("micro_canary", "failed", error=str(e))
             print(f"  micro canary failed ({e})", file=sys.stderr)
@@ -824,6 +967,16 @@ def main():
                 watchdog = _arm_watchdog(1200)
 
     if args.config != "gpt2s":
+        leg = leg_key("config:" + args.config)
+        cached = _banked(leg)
+        if cached is not None:
+            # the whole config leg already landed in an earlier invocation
+            # of this round: re-emit the banked line, skip the compiles
+            print(f"  {leg}: banked, skipping", file=sys.stderr)
+            if watchdog is not None:
+                watchdog.cancel()
+            _emit(dict(cached, banked=True))
+            return
         _heartbeat("config:" + args.config)
         extra = None
         line_fields = {}  # extra TOP-LEVEL fields for the final line (mbu)
@@ -916,15 +1069,17 @@ def main():
                 return
             if watchdog is not None:
                 watchdog.cancel()
-            _emit({"metric": metric, "value": round(v, 1), "unit": unit,
-                   "vs_baseline": round(v / base, 3),
-                   "config": args.config,
-                   "extra": {
-                       "mixed_new_tokens_per_sec": round(mtps, 1),
-                       "mixed_inter_token_p50_ms": round(p50, 2),
-                       "mixed_inter_token_p99_ms": round(p99, 2),
-                       "mixed_ttft_p50_ms": round(t50, 2),
-                       "mixed_ttft_p99_ms": round(t99, 2)}})
+            line = {"metric": metric, "value": round(v, 1), "unit": unit,
+                    "vs_baseline": round(v / base, 3),
+                    "config": args.config,
+                    "extra": {
+                        "mixed_new_tokens_per_sec": round(mtps, 1),
+                        "mixed_inter_token_p50_ms": round(p50, 2),
+                        "mixed_inter_token_p99_ms": round(p99, 2),
+                        "mixed_ttft_p50_ms": round(t50, 2),
+                        "mixed_ttft_p99_ms": round(t99, 2)}}
+            _emit(line)
+            _bank(leg, line)
             return
         elif args.config == "gpt2s_16k":
             # long-context single chip: flash attention is what makes 16k
@@ -938,12 +1093,14 @@ def main():
                                 window=args.window)
             if watchdog is not None:
                 watchdog.cancel()
-            _emit({
+            line = {
                 "metric": "gpt2s_16k_train_tokens_per_sec_per_chip"
                           + (f"_w{args.window}" if args.window else ""),
                 "value": round(v, 1), "unit": "tokens/s",
                 "vs_baseline": round(v / BASELINE_TOKENS_PER_SEC, 3),
-                "mfu": round(mfu, 4), "config": args.config})
+                "mfu": round(mfu, 4), "config": args.config}
+            _emit(line)
+            _bank(leg, line)
             return
         elif args.config == "gpt2m":
             b = args.batch or (8 if on_tpu else 2)
@@ -958,12 +1115,14 @@ def main():
                                 cfg_fn=_gpt2m_cfg)
             if watchdog is not None:
                 watchdog.cancel()
-            _emit({
+            line = {
                 "metric": "gpt2m_train_tokens_per_sec_per_chip",
                 "value": round(v, 1), "unit": "tokens/s",
                 # same 10k tok/s/device class target as the BERT/ERNIE row
                 "vs_baseline": round(v / BASELINE_TOKENS_PER_SEC, 3),
-                "mfu": round(mfu, 4), "config": args.config})
+                "mfu": round(mfu, 4), "config": args.config}
+            _emit(line)
+            _bank(leg, line)
             return
         elif args.config == "ppyolo":
             b = args.batch or (8 if on_tpu else 1)
@@ -1008,6 +1167,7 @@ def main():
         if extra:
             line["extra"] = extra
         _emit(line)
+        _bank(leg, line)
         return
     # batch 16 was the r1 sweet spot at seq 1024; the r2 flash retune cut
     # attention HBM traffic, so when no explicit --batch is given on TPU a
@@ -1016,7 +1176,8 @@ def main():
     batch = args.batch or (16 if on_tpu else 2)
     seq = args.seq or (1024 if on_tpu else 128)
 
-    if on_tpu and args.batch is None and not args.sweep:
+    if on_tpu and args.batch is None and not args.sweep \
+            and _banked(headline_leg) is None:
         if watchdog is not None:
             # fresh window sized for THREE cold compiles (the canary's
             # re-arm doesn't run under --no-micro; don't let the probes
@@ -1043,11 +1204,23 @@ def main():
         best = (0.0, 0.0, None)
         for b, s in ((8, 1024), (16, 1024), (24, 1024), (16, 2048),
                      (8, 2048), (4, 4096), (8, 4096)):
+            sweep_leg = leg_key(f"sweep:{b}x{s}")
+            got = _banked(sweep_leg)
+            if got is not None:
+                # this (batch, seq) leg landed in an earlier invocation:
+                # reuse its number instead of paying the compile again
+                tps, mfu = float(got["tps"]), float(got["mfu"])
+                print(f"  batch={b} seq={s}: banked {tps:,.0f} tok/s",
+                      file=sys.stderr)
+                if tps > best[0]:
+                    best = (tps, mfu, (b, s))
+                continue
             try:
                 tps, mfu = run_config(b, s, args.steps, window=args.window)
             except Exception as e:
                 print(f"  batch={b} seq={s}: failed ({e})", file=sys.stderr)
                 continue
+            _bank(sweep_leg, {"tps": tps, "mfu": mfu})
             if watchdog is not None:
                 # first config proved the tunnel healthy; a long sweep is
                 # not a wedge — stand the watchdog down
@@ -1068,50 +1241,64 @@ def main():
         })
         return
 
-    _heartbeat("headline_gpt2s", batch=batch, seq=seq)
-    tps, mfu = run_config(batch, seq, args.steps, quiet=True,
-                          window=args.window)
-    _heartbeat("headline_gpt2s", "done")
-    line = {
-        "metric": "gpt2s_train_tokens_per_sec_per_chip"
-                  + (f"_w{args.window}" if args.window else ""),
-        "value": round(tps, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(tps / BASELINE_TOKENS_PER_SEC, 3),
-        "mfu": round(mfu, 4),
-    }
-    # the headline is the round's deliverable: emit it the moment it exists
-    # (the LAST line — re-emitted below with extras — is the most complete)
-    _emit(line)
+    headline_banked = _banked(headline_leg)
+    if headline_banked is not None:
+        print("  headline: banked, skipping", file=sys.stderr)
+        line = dict(headline_banked, banked=True)
+        _emit(line)
+    else:
+        _heartbeat("headline_gpt2s", batch=batch, seq=seq)
+        tps, mfu = run_config(batch, seq, args.steps, quiet=True,
+                              window=args.window)
+        _heartbeat("headline_gpt2s", "done")
+        line = {
+            "metric": "gpt2s_train_tokens_per_sec_per_chip"
+                      + (f"_w{args.window}" if args.window else ""),
+            "value": round(tps, 1),
+            "unit": "tokens/s",
+            "vs_baseline": round(tps / BASELINE_TOKENS_PER_SEC, 3),
+            "mfu": round(mfu, 4),
+        }
+        # the headline is the round's deliverable: emit AND bank it the
+        # moment it exists (the LAST line — re-emitted below with extras —
+        # is the most complete; the banked copy survives any later wedge)
+        _emit(line)
+        _bank(headline_leg, line)
     if on_tpu and not args.no_extra:
         # chip proven healthy by the main measurement: append the ResNet-50
         # milestone (BASELINE #2) and the serving decode metric with MBU,
         # each under a fresh watchdog window — a hang or failure in an
         # extra must not cost the headline (the watchdog re-emits it).
-        extra = {}
-        if watchdog is not None:
-            watchdog.cancel()
-            watchdog = _arm_watchdog(1200)
-        try:
-            _heartbeat("extra:resnet50")
-            ips = run_resnet50(64, 10, quiet=True)
-            extra["resnet50_train_imgs_per_sec_per_chip"] = round(ips, 1)
-            line["extra"] = extra
-            _emit(line)
-        except Exception as e:
-            print(f"  resnet50 extra failed ({e})", file=sys.stderr)
-        if watchdog is not None:
-            watchdog.cancel()
-            watchdog = _arm_watchdog(1200)
-        try:
-            _heartbeat("extra:gpt2s_decode")
+        # Each extra is its own banked leg: a retry re-measures only the
+        # legs that never landed.
+        def _resnet_extra():
+            return {"resnet50_train_imgs_per_sec_per_chip":
+                    round(run_resnet50(64, 10, quiet=True), 1)}
+
+        def _decode_extra():
             dtps, dmbu = run_decode(8, 20, quiet=True)
-            extra["gpt2s_decode_new_tokens_per_sec_per_chip"] = round(dtps, 1)
-            extra["gpt2s_decode_mbu"] = round(dmbu, 4)
+            return {"gpt2s_decode_new_tokens_per_sec_per_chip":
+                    round(dtps, 1),
+                    "gpt2s_decode_mbu": round(dmbu, 4)}
+
+        extra = {}
+        for extra_leg, measure in (("extra:resnet50", _resnet_extra),
+                                   ("extra:gpt2s_decode", _decode_extra)):
+            got = _banked(extra_leg)
+            if got is None:
+                if watchdog is not None:
+                    watchdog.cancel()
+                    watchdog = _arm_watchdog(1200)
+                try:
+                    _heartbeat(extra_leg)
+                    got = measure()
+                    _bank(extra_leg, got)
+                except Exception as e:
+                    print(f"  {extra_leg} failed ({e})", file=sys.stderr)
+                    continue
+            extra.update(got)
             line["extra"] = extra
             _emit(line)
-        except Exception as e:
-            print(f"  decode extra failed ({e})", file=sys.stderr)
     if watchdog is not None:
         watchdog.cancel()
 

@@ -1,0 +1,26 @@
+"""Debug façade over the black-box flight recorder.
+
+``paddle_tpu.debug`` re-exports the :mod:`paddle_tpu.monitor.blackbox`
+surface under the name operators reach for first::
+
+    from paddle_tpu import debug
+
+    debug.beacon("my_loop")            # progress beacon per iteration
+    debug.start_sentinel(timeout_s=60) # stall watcher -> dump bundles
+    path = debug.dump("signal")        # on-demand bundle, returns path
+
+The implementation (flight-recorder ring, beacon registry, stall
+sentinel, dump bundles, SIGUSR1/excepthook integration) lives in
+``paddle_tpu/monitor/blackbox.py``; see docs/OBSERVABILITY.md
+"Flight recorder & stall diagnostics" and tools/blackbox_dump.py.
+"""
+from ..monitor import blackbox  # noqa: F401
+from ..monitor.blackbox import (  # noqa: F401
+    BUNDLE_KEYS, beacon, beacons, capacity, context, default_dir, disable,
+    dump, enable, install_hooks, is_enabled, load_bundle, note, note_span,
+    progress, quiesce, register_provider, reset, ring, ring_summary,
+    sentinel_running, set_capacity, set_context, start_sentinel,
+    stop_sentinel, sync_from_flag, validate_bundle)
+
+__all__ = ["blackbox"] + [n for n in dir(blackbox)
+                          if n in blackbox.__all__]

@@ -24,6 +24,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import flags as _flags
 from .. import monitor as _monitor
+from ..monitor import blackbox as _blackbox
 from ..trace import costs as _costs
 from .. import trace as _trace
 from ..core.tape import global_tape
@@ -728,6 +729,14 @@ class SpmdTrainer:
 
     # -- public ---------------------------------------------------------------
     def train_step(self, *batch):
+        # window beacon around the whole step (compile included): a hung
+        # compile or device dispatch leaves an active, non-advancing
+        # trainer/step site for the stall sentinel; a finished training
+        # run deactivates it instead of reading as stalled forever
+        with _blackbox.progress("trainer/step"):
+            return self._train_step_impl(*batch)
+
+    def _train_step_impl(self, *batch):
         from ..core.generator import default_generator
 
         _failpoints.failpoint("trainer/step")

@@ -61,6 +61,7 @@ from .. import monitor as _monitor
 # package attribute may still be the paddle.trace math op at this point)
 from ..trace import costs as _costs
 from .. import trace as _trace
+from ..monitor import blackbox as _blackbox
 from ..profiler import RecordEvent as _RecordEvent
 
 __all__ = ["cache_dir", "enabled", "args_signature", "mesh_fingerprint",
@@ -128,6 +129,9 @@ def record_compile(site, sig_label, source):
             _COMPILE_CACHE.labels(site=site, event="hit", sig=sig_label,
                                   source="memory").inc()
         return
+    # flight-recorder tag for every non-memory resolution: disk loads and
+    # fresh compiles are exactly the events a wedged round asks about
+    _blackbox.note("compile", site=site, sig=sig_label, source=source)
     if _monitor.is_enabled():
         _COMPILE_CACHE.labels(
             site=site, event="hit" if source == "disk" else "miss",
@@ -433,15 +437,22 @@ def compile_cached(jitted, example_args, *, site, extra_key=(),
     if not enabled():
         if not force:
             return jitted, "bypass"
-        compiled = jitted.lower(*_canonical_specs(example_args)).compile()
+        # the progress window brackets every eager XLA compile: a hung
+        # compile leaves an ACTIVE, non-advancing aot/compile beacon for
+        # the stall sentinel to name (monitor/blackbox.py)
+        with _blackbox.progress("aot/compile"):
+            compiled = jitted.lower(
+                *_canonical_specs(example_args)).compile()
         return _GuardedCompiled(compiled, jitted), "fresh"
-    lowered = jitted.lower(*_canonical_specs(example_args))
-    key = _cache_key(lowered, extra_key)
-    compiled = _load_entry(_entry_path(key), site)
-    if compiled is not None:
-        return _GuardedCompiled(compiled, jitted, _entry_path(key)), "disk"
-    compiled = lowered.compile()
-    stored = _store_entry(key, compiled, site)
+    with _blackbox.progress("aot/compile"):
+        lowered = jitted.lower(*_canonical_specs(example_args))
+        key = _cache_key(lowered, extra_key)
+        compiled = _load_entry(_entry_path(key), site)
+        if compiled is not None:
+            return _GuardedCompiled(compiled, jitted,
+                                    _entry_path(key)), "disk"
+        compiled = lowered.compile()
+        stored = _store_entry(key, compiled, site)
     # the guard knows the entry path so a call-rejected executable also
     # removes its own just-written file (a later process must not
     # deserialize a binary this one already proved uncallable)

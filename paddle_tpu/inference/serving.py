@@ -75,6 +75,7 @@ import time
 import numpy as np
 
 from .. import monitor as _monitor
+from ..monitor import blackbox as _blackbox
 from ..trace import costs as _costs
 from .. import trace as _trace
 from ..core.tensor import Tensor
@@ -235,6 +236,31 @@ class Request:
                 out["decode_tokens_per_sec"] = \
                     (len(self.output_ids) - 1) / dt
         return out
+
+
+def _blackbox_request_table(eng):
+    """One engine's in-flight request table for a blackbox dump bundle:
+    where every unfinished request lives and how far it got — the
+    'which rids were mid-flight when it wedged' evidence."""
+    running = [{"rid": r.rid, "slot": s, "pos": int(eng._pos[s]),
+                "new_tokens": len(r.output_ids)}
+               for s, r in enumerate(eng._slot_req)
+               if r is not None and s not in eng._prefilling]
+    table = {
+        "slots": eng.B,
+        "step_no": eng._step_no,
+        "draining": eng._draining,
+        "queued": [r.rid for r in eng._queue],
+        "handoff": [e[0].rid for e in eng._handoff],
+        "prefilling": {s: e[0].rid for s, e in eng._prefilling.items()},
+        "running": running,
+        "finished": len(eng._finished),
+    }
+    table["in_flight"] = sorted(
+        set(table["queued"]) | set(table["handoff"])
+        | set(table["prefilling"].values())
+        | {r["rid"] for r in running})
+    return table
 
 
 class ServingEngine:
@@ -628,6 +654,10 @@ class ServingEngine:
         self._deadline_live = 0   # unfinished requests carrying deadline_ms
         self._step_no = 0
         self._last_error_step = None
+        # blackbox dump bundles carry every live engine's in-flight
+        # request table (weakly held; only read at dump time)
+        _blackbox.register_provider("serving_engine", self,
+                                    _blackbox_request_table)
 
     # -- API -----------------------------------------------------------------
     def register_prefix(self, prefix_ids):
@@ -1291,6 +1321,10 @@ class ServingEngine:
             req._qspan = None
 
     def _admit_one(self, slot, req):
+        with _blackbox.progress("serving/admit"):
+            self._admit_one_inner(slot, req)
+
+    def _admit_one_inner(self, slot, req):
         import jax.numpy as jnp
 
         prefix_len = req.prefix_len
@@ -1450,6 +1484,15 @@ class ServingEngine:
         that slot's request with reason="error" and evicts it — the rest
         of the batch continues. A failure in the batched device program
         itself is not isolatable (one executable) and propagates."""
+        # window beacon around the WHOLE step (the failpoint delay
+        # included): a thread wedged anywhere inside leaves an active,
+        # non-advancing site for the stall sentinel to name — and a
+        # finished sibling engine cannot mask it, because the site only
+        # deactivates when the LAST open step window closes
+        with _blackbox.progress("serving/step"):
+            return self._step_inner()
+
+    def _step_inner(self):
         import jax.numpy as jnp
 
         _fp.failpoint("serving/step")
@@ -1478,10 +1521,11 @@ class ServingEngine:
                 if self._handoff:
                     req, kc1, vc1, logits = self._handoff.pop(0)
                     try:
-                        self._note_admission(req)
-                        t0 = time.perf_counter()
-                        self._activate(slot, req, kc1, vc1, logits)
-                        self._acc_ms("handoff_admit", t0)
+                        with _blackbox.progress("serving/admit"):
+                            self._note_admission(req)
+                            t0 = time.perf_counter()
+                            self._activate(slot, req, kc1, vc1, logits)
+                            self._acc_ms("handoff_admit", t0)
                     except Exception:
                         self._finish_req(req, "error", slot=slot)
                         self._note_error()
@@ -1648,6 +1692,14 @@ class ServingEngine:
             steps += 1
             if steps > max_steps:
                 stalled = []
+                # the dump captures the wedge's live state; the finishes
+                # below rewrite it, so write the bundle FIRST
+                dump_path = None
+                if _blackbox.is_enabled():
+                    dump_path = _blackbox.dump(
+                        "stall", site="serving/step",
+                        extra={"trigger": "run_until_complete",
+                               "max_steps": max_steps})
                 for req in list(self._queue):
                     self._queue.remove(req)
                     self._finish_req(req, "engine_stalled")
@@ -1667,5 +1719,7 @@ class ServingEngine:
                 raise RuntimeError(
                     "serving engine did not converge within "
                     f"{max_steps} steps; failed in-flight requests "
-                    f"{sorted(set(stalled))} with reason='engine_stalled'")
+                    f"{sorted(set(stalled))} with reason='engine_stalled'"
+                    + (f"; blackbox dump bundle: {dump_path}"
+                       if dump_path else ""))
         return dict(self._finished)

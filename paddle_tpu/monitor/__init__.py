@@ -52,7 +52,7 @@ __all__ = [
     "reset", "enable", "disable", "is_enabled", "timed",
     "to_json", "to_prometheus", "parse_prometheus", "flatten",
     "log_event", "log_snapshot", "record_collective", "tensor_nbytes",
-    "STAT_ADD", "STAT_SUB", "STAT_RESET",
+    "STAT_ADD", "STAT_SUB", "STAT_RESET", "blackbox",
 ]
 
 _flags.define_flag("monitor", True,
@@ -163,6 +163,10 @@ def record_collective(kind, nbytes=0):
     jit trace count once per TRACE (host-side accounting), mirroring the
     static collective-count pass rather than a device profiler."""
     global _COLL_CALLS, _COLL_BYTES
+    # flight-recorder byte tag BEFORE the monitor-enabled early-out: the
+    # two recorders are independent flags, and the last collectives
+    # before a wedge are prime evidence even with metrics off
+    blackbox.note("collective", op=kind, bytes=int(nbytes))
     if not _DEFAULT.is_enabled():
         return
     if _COLL_CALLS is None:
@@ -178,3 +182,9 @@ def record_collective(kind, nbytes=0):
     _COLL_CALLS.labels(op=kind).inc()
     if nbytes:
         _COLL_BYTES.labels(op=kind).inc(nbytes)
+
+
+# the black-box flight recorder rides inside the monitor package (its
+# counters live in this registry); imported last so its lazy metric
+# creation finds the helpers above already defined
+from . import blackbox  # noqa: E402,F401

@@ -28,6 +28,7 @@ import time
 import numpy as np
 
 from .. import monitor as _monitor
+from ..monitor import blackbox as _blackbox
 from .. import trace as _trace
 from ..core.tensor import Tensor
 from ..framework import aot as _aot
@@ -117,8 +118,10 @@ class PrefillWorker:
         padded = np.zeros((1, pb), np.int32)
         padded[0, :n] = ids
         t0 = time.perf_counter()
-        kc1, vc1, logits = self._prefill(self._params, jnp.asarray(padded),
-                                         np.int32(n))
+        with _blackbox.progress("disagg/prefill"):
+            kc1, vc1, logits = self._prefill(self._params,
+                                             jnp.asarray(padded),
+                                             np.int32(n))
         self._m["prefills"] += 1
         self._m["prefill_ms"] += (time.perf_counter() - t0) * 1e3
         return (kc1, vc1), logits
@@ -214,7 +217,16 @@ class DisaggregatedPool:
     def _advance_prefill(self):
         """Prefill pending prompts (round-robin over workers) while any
         decode engine has room, handing each finished row off."""
+        if not self._pending:
+            return
+        # window beacon: the site is watched only while handoffs are in
+        # flight (per-iteration beats inside keep the counter advancing)
+        with _blackbox.progress("disagg/handoff"):
+            self._advance_prefill_inner()
+
+    def _advance_prefill_inner(self):
         while self._pending:
+            _blackbox.beacon("disagg/handoff")
             name = self._target_engine()
             if self._free_slots(name) <= 0:
                 return   # decode tier full: natural backpressure
@@ -310,9 +322,17 @@ class DisaggregatedPool:
             self.step()
             steps += 1
             if steps > max_steps:
-                raise RuntimeError(
-                    f"disaggregated pool did not converge within "
-                    f"{max_steps} steps")
+                msg = (f"disaggregated pool did not converge within "
+                       f"{max_steps} steps")
+                if _blackbox.is_enabled():
+                    path = _blackbox.dump(
+                        "stall", site="disagg/handoff",
+                        extra={"trigger": "run_until_complete",
+                               "max_steps": max_steps,
+                               "pending": len(self._pending)})
+                    if path:
+                        msg += f"; blackbox dump bundle: {path}"
+                raise RuntimeError(msg)
         return dict(self._results)
 
     def stats(self):

@@ -37,6 +37,7 @@ import zlib
 import numpy as np
 
 from .. import monitor as _monitor
+from ..monitor import blackbox as _blackbox
 from .. import trace as _trace
 from ..core.tensor import Tensor
 from ..inference.serving import QueueFullError
@@ -88,6 +89,19 @@ class _RouterReq:
         self.t0 = None   # first router-level submit (deadline anchor)
 
 
+def _blackbox_router_table(router):
+    """Router placement state for a dump bundle: which engines are
+    alive/dead, what each one still owes, and what is parked."""
+    owed = {}
+    for (name, erid), rid in router._by_engine.items():
+        owed.setdefault(name, []).append(rid)
+    return {"alive": sorted(router._alive),
+            "dead": sorted(set(router._engines) - router._alive),
+            "outstanding": {n: sorted(rids) for n, rids in owed.items()},
+            "parked": [r.rid for r in router._parked],
+            "finished": len(router._results)}
+
+
 class Router:
     def __init__(self, engines, models=None, affinity_tokens=8):
         """engines: ``{name: ServingEngine}`` (order = step order).
@@ -113,6 +127,12 @@ class Router:
         self._affinity_seen = {}  # affinity key -> engine_name
         self._m = {"requests": {}, "failover": {}, "affinity_hit": 0,
                    "affinity_miss": 0}
+        # one all-dead dump per outage: a front-end retry loop hammering
+        # submit() against a dead router must not write a bundle per call
+        self._no_live_dumped = False
+        # blackbox dump bundles carry the router's placement state next
+        # to each engine's own in-flight table (weakly held)
+        _blackbox.register_provider("router", self, _blackbox_router_table)
 
     # -- placement ---------------------------------------------------------
     def _health(self, name):
@@ -130,10 +150,22 @@ class Router:
                 continue
             out.append(name)
         if not out:
-            raise NoLiveEngineError(
-                f"no live admitting engine for model={model!r} "
-                f"(alive: {sorted(self._alive)}, "
-                f"engines: {sorted(self._engines)})")
+            # the all-dead path is the router's terminal wedge: leave a
+            # dump bundle behind and name it in the error, so the
+            # operator gets stacks + per-engine state, not just a message
+            msg = (f"no live admitting engine for model={model!r} "
+                   f"(alive: {sorted(self._alive)}, "
+                   f"engines: {sorted(self._engines)})")
+            if _blackbox.is_enabled() and not self._no_live_dumped:
+                self._no_live_dumped = True
+                path = _blackbox.dump(
+                    "crash", site="router/no_live_engine",
+                    extra={"model": repr(model),
+                           "alive": sorted(self._alive),
+                           "engines": sorted(self._engines)})
+                if path:
+                    msg += f"; blackbox dump bundle: {path}"
+            raise NoLiveEngineError(msg)
         return out
 
     def _load_score(self, name):
@@ -439,6 +471,10 @@ class Router:
         """One step across every live engine; an engine that raises is
         failed over. Returns the router requests finished this step as
         {rid: Request}."""
+        with _blackbox.progress("router/step"):
+            return self._step_inner()
+
+    def _step_inner(self):
         done = {}
         if self._parked:
             # capacity may have freed since the failover that parked
@@ -496,9 +532,17 @@ class Router:
             self.step()
             steps += 1
             if steps > max_steps:
-                raise RuntimeError(
-                    f"router did not converge within {max_steps} steps; "
-                    f"outstanding: {sorted(self._by_engine.values())}")
+                msg = (f"router did not converge within {max_steps} "
+                       "steps; outstanding: "
+                       f"{sorted(self._by_engine.values())}")
+                if _blackbox.is_enabled():
+                    path = _blackbox.dump(
+                        "stall", site="router/step",
+                        extra={"trigger": "run_until_complete",
+                               "max_steps": max_steps})
+                    if path:
+                        msg += f"; blackbox dump bundle: {path}"
+                raise RuntimeError(msg)
         return dict(self._results)
 
     # -- observability -----------------------------------------------------

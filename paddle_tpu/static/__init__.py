@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from .. import flags as _flags
 from .. import monitor as _monitor
+from ..monitor import blackbox as _blackbox
 from ..trace import costs as _costs
 from .. import trace as _trace
 from ..core import dtype as dtype_mod
@@ -580,6 +581,13 @@ class Executor:
         raise TypeError(f"cannot fetch {type(f).__name__}")
 
     def _run_program(self, program, feed, fetch_list, return_numpy):
+        # window beacon: watched only while a run (compile included) is
+        # actually in flight — a finished session never reads as a stall
+        with _blackbox.progress("executor/run"):
+            return self._run_program_impl(program, feed, fetch_list,
+                                          return_numpy)
+
+    def _run_program_impl(self, program, feed, fetch_list, return_numpy):
         t_step = time.perf_counter()
         program._ensure_scope()
         fetch_ids = tuple(self._fetch_id(program, f) for f in fetch_list)

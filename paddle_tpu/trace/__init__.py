@@ -55,8 +55,8 @@ from .. import flags as _flags
 __all__ = [
     "Span", "span", "start_span", "emit", "current_span", "new_trace_id",
     "enable", "disable", "is_enabled", "sync_from_flag", "clear",
-    "spans", "set_capacity", "capacity", "summary", "top_spans",
-    "add_counter_sample", "counter_samples", "export_chrome",
+    "spans", "open_spans", "set_capacity", "capacity", "summary",
+    "top_spans", "add_counter_sample", "counter_samples", "export_chrome",
     "load_spans", "costs",
 ]
 
@@ -81,6 +81,8 @@ _SPAN_IDS = itertools.count(1)
 _TRACE_IDS = itertools.count(1)
 _BUF = collections.deque(maxlen=int(_flags.get_flag("trace_buffer", 4096)))
 _SAMPLES = collections.deque(maxlen=4096)   # (ts_ns, name, value)
+_OPEN = {}                  # span_id -> OPEN Span (entered/started, not
+_OPEN_CAP = 8192            # yet ended) — the blackbox dump's span tree
 
 
 def is_enabled():
@@ -124,12 +126,32 @@ def clear():
     with _LOCK:
         _BUF.clear()
         _SAMPLES.clear()
+        _OPEN.clear()
 
 
 def spans():
     """Snapshot of the ring buffer (oldest first)."""
     with _LOCK:
         return list(_BUF)
+
+
+def open_spans():
+    """Every span currently OPEN (entered or started, not yet ended) as
+    dicts with end_ns=None — the live span tree a blackbox dump bundle
+    captures, so a wedge shows WHICH requests/steps were mid-flight."""
+    with _LOCK:
+        return [sp.to_dict() for sp in _OPEN.values()]
+
+
+def _track_open(sp):
+    with _LOCK:
+        if len(_OPEN) >= _OPEN_CAP:   # leaked never-ended spans must not
+            _OPEN.pop(next(iter(_OPEN)))   # grow the table without bound
+        _OPEN[sp.span_id] = sp
+    # flight-recorder OPEN digest (one boolean check when the recorder
+    # is off): a span that never closes is exactly the wedge evidence
+    _blackbox.note("span_open", name=sp.name, subsystem=sp.subsystem,
+                   trace_id=sp.trace_id)
 
 
 def counter_samples():
@@ -215,6 +237,7 @@ class Span:
         self.start_ns = time.perf_counter_ns()   # exclude setup time
         st.append(self)
         self._pushed = True
+        _track_open(self)
         return self
 
     def __exit__(self, exc_type, exc, tb):
@@ -244,6 +267,8 @@ class Span:
         """Stamp the end time and record the span (idempotent)."""
         if self.end_ns is not None:
             return self
+        with _LOCK:
+            _OPEN.pop(self.span_id, None)
         if attrs:
             self.attrs.update(attrs)
         if self.trace_id is None:
@@ -287,6 +312,8 @@ def _json_safe(v):
 def _record(sp):
     with _LOCK:
         _BUF.append(sp)
+    _blackbox.note_span(sp)   # flight-recorder close digest (one boolean
+    #                           check when the recorder is off)
     path = _flags.get_flag("trace_log_path", "")
     if path:
         from .. import monitor as _monitor
@@ -331,8 +358,10 @@ def start_span(name, subsystem=None, trace_id=None, parent=None, **attrs):
         # a root started explicitly IS a new trace: mint the id now so
         # children created before .end() inherit it
         trace_id = new_trace_id()
-    return Span(name, trace_id=trace_id, parent_id=parent,
-                subsystem=subsystem, attrs=attrs)
+    sp = Span(name, trace_id=trace_id, parent_id=parent,
+              subsystem=subsystem, attrs=attrs)
+    _track_open(sp)
+    return sp
 
 
 def emit(name, start_ns, end_ns, subsystem=None, trace_id=None, parent=None,
@@ -479,6 +508,11 @@ def load_spans(path):
 
 # seed from the environment (FLAGS_trace=1 python serve.py)
 sync_from_flag()
+
+# span-close digests feed the black-box flight recorder; imported at the
+# bottom (lazily resolved attribute at call time) so the monitor/trace
+# import order stays cycle-free whichever package loads first
+from ..monitor import blackbox as _blackbox  # noqa: E402
 
 from . import costs  # noqa: E402,F401
 

@@ -90,6 +90,82 @@ def test_micro_canary_runs_on_cpu():
     assert sps > 0
 
 
+def test_banked_legs_round_trip(tmp_path):
+    # ROADMAP item 4: each completed leg persists to the --banked JSONL
+    # as it lands and is skipped (re-used) on re-invocation
+    import bench
+    path = str(tmp_path / "banked.jsonl")
+    try:
+        bench._bank_load(path)
+        assert bench._banked("headline") is None
+        line = {"metric": "m", "value": 1.5, "unit": "u"}
+        bench._bank("headline", line)
+        bench._bank("sweep:8x1024", {"tps": 10.0, "mfu": 0.1})
+        # a fresh loader (new invocation) sees both legs
+        bench._bank_load(path)
+        assert bench._banked("headline") == line
+        assert bench._banked("sweep:8x1024") == {"tps": 10.0, "mfu": 0.1}
+    finally:
+        bench._bank_load(None)
+
+
+def test_banked_file_tolerates_torn_tail(tmp_path):
+    # a killed writer can leave a torn last line: the loader must keep
+    # every complete leg instead of dying on the tail
+    import bench
+    path = str(tmp_path / "banked.jsonl")
+    try:
+        bench._bank_load(path)
+        bench._bank("micro", {"metric": "m", "value": 2.0})
+        with open(path, "a") as f:
+            f.write('{"leg": "headline", "line": {"metr')  # torn
+        bench._bank_load(path)
+        assert bench._banked("micro") == {"metric": "m", "value": 2.0}
+        assert bench._banked("headline") is None
+    finally:
+        bench._bank_load(None)
+
+
+def test_banked_config_leg_skips_measurement(tmp_path):
+    # a banked --config leg re-emits its stored line without re-measuring
+    # (the second invocation finishes fast and marks the line banked)
+    banked = str(tmp_path / "banked.jsonl")
+    code = (
+        "import sys\n"
+        "sys.argv = ['bench.py', '--config', 'lenet', '--steps', '2',\n"
+        "            '--batch', '4', '--banked', %r]\n"
+        "import bench\n"
+        "bench.main()\n" % banked
+    )
+    rc1, lines1 = _run(code)
+    assert rc1 == 0
+    first = lines1[-1]
+    assert first["config"] == "lenet" and "banked" not in first
+    rc2, lines2 = _run(code)
+    assert rc2 == 0
+    second = lines2[-1]
+    assert second.get("banked") is True
+    assert second["value"] == first["value"]
+
+
+def test_heartbeat_beats_blackbox_beacon_and_context():
+    # wedge attribution: every phase heartbeat beats the bench/phase
+    # beacon and stamps the phase into the dump-bundle context
+    import bench
+    from paddle_tpu.monitor import blackbox
+    blackbox.enable(install=False)
+    try:
+        blackbox.reset()
+        bench._heartbeat("unit_test_phase", "start")
+        assert blackbox.beacons()["bench/phase"]["count"] >= 1
+        assert blackbox.context()["bench_phase"] == "unit_test_phase:start"
+        assert any(r["kind"] == "bench_phase"
+                   for r in blackbox.ring())
+    finally:
+        blackbox.disable()
+        blackbox.reset()
+
+
 def test_serve_mixed_reports_latency_percentiles():
     # r5 (VERDICT r4 #7): the serve bench's realism scenario — staggered
     # arrivals, sampling mix, chunked prefill — must produce a positive

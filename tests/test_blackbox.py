@@ -1,0 +1,480 @@
+"""Black-box flight recorder (ISSUE 7): ring bounds/thread-safety, the
+beacon registry contract, sentinel fire/no-fire semantics, dump-bundle
+round-trips (stacks + ring + metrics + request tables), the SIGUSR1 and
+excepthook dump paths, and the engine/router errors that name the bundle
+they just wrote."""
+import glob
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import flags, trace
+from paddle_tpu.monitor import blackbox
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    blackbox.stop_sentinel()
+    blackbox.disable()
+    blackbox.reset()
+    yield
+    blackbox.stop_sentinel()
+    blackbox.disable()
+    blackbox.reset()
+
+
+@pytest.fixture
+def enabled(tmp_path):
+    """Recorder on, bundles into tmp_path, flag restored afterwards."""
+    old = flags.get_flag("blackbox_dir", "")
+    flags.set_flags({"blackbox_dir": str(tmp_path)})
+    blackbox.enable(install=False)
+    yield str(tmp_path)
+    flags.set_flags({"blackbox_dir": old})
+
+
+def _bundles(d):
+    return sorted(glob.glob(os.path.join(d, "blackbox-*.json")))
+
+
+def _tiny_model():
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                    num_heads=2, max_seq_len=64, dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+class TestRing:
+    def test_bounded_oldest_dropped(self, enabled):
+        blackbox.set_capacity(8)
+        try:
+            for i in range(20):
+                blackbox.note("e", i=i)
+            ring = blackbox.ring()
+            assert len(ring) == 8
+            assert [r["i"] for r in ring] == list(range(12, 20))
+        finally:
+            blackbox.set_capacity(512)
+
+    def test_thread_safety(self, enabled):
+        blackbox.set_capacity(10_000)
+        try:
+            def worker(k):
+                for i in range(500):
+                    blackbox.note("t", k=k, i=i)
+                    blackbox.beacon(f"thread{k}")
+            threads = [threading.Thread(target=worker, args=(k,))
+                       for k in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(blackbox.ring()) == 2000
+            for k in range(4):
+                assert blackbox.beacons()[f"thread{k}"]["count"] == 500
+        finally:
+            blackbox.set_capacity(512)
+
+    def test_ring_summary(self, enabled):
+        for i in range(7):
+            blackbox.note("e", i=i)
+        s = blackbox.ring_summary(3)
+        assert s["events"] == 7
+        assert [r["i"] for r in s["tail"]] == [4, 5, 6]
+
+
+class TestBeacons:
+    def test_registry_contract(self, enabled):
+        blackbox.beacon("site_a")
+        blackbox.beacon("site_a")
+        blackbox.beacon("site_b")
+        b = blackbox.beacons()
+        assert b["site_a"]["count"] == 2
+        assert b["site_b"]["count"] == 1
+        assert b["site_a"]["active"] and b["site_b"]["active"]
+        assert b["site_a"]["age_s"] < 1.0
+        blackbox.quiesce("site_a")
+        assert not blackbox.beacons()["site_a"]["active"]
+        blackbox.beacon("site_a")   # a beat re-activates
+        assert blackbox.beacons()["site_a"]["active"]
+        blackbox.quiesce()          # all-sites form
+        assert not any(v["active"] for v in blackbox.beacons().values())
+
+    def test_progress_window(self, enabled):
+        with blackbox.progress("win"):
+            assert blackbox.beacons()["win"]["active"]
+        assert not blackbox.beacons()["win"]["active"]
+
+    def test_reset_clears(self, enabled):
+        blackbox.beacon("x")
+        blackbox.note("e")
+        blackbox.set_context("k", "v")
+        blackbox.reset()
+        assert blackbox.beacons() == {}
+        assert blackbox.ring() == []
+        assert blackbox.context() == {}
+
+
+class TestSentinel:
+    def test_fires_on_frozen_beacon(self, enabled):
+        blackbox.beacon("frozen")
+        blackbox.start_sentinel(timeout_s=0.15, poll_s=0.05)
+        deadline = time.time() + 3.0
+        while time.time() < deadline and not _bundles(enabled):
+            time.sleep(0.05)
+        bundles = _bundles(enabled)
+        assert len(bundles) == 1, "sentinel did not fire on a frozen site"
+        bundle = blackbox.load_bundle(bundles[0])
+        assert bundle["reason"] == "stall"
+        assert bundle["site"] == "frozen"
+        # one bundle per episode: the frozen site must not dump again
+        time.sleep(0.4)
+        assert len(_bundles(enabled)) == 1
+
+    def test_does_not_fire_on_slow_but_advancing(self, enabled):
+        stop = threading.Event()
+
+        def beat():
+            while not stop.wait(0.05):
+                blackbox.beacon("slow")
+
+        t = threading.Thread(target=beat, daemon=True)
+        t.start()
+        try:
+            blackbox.start_sentinel(timeout_s=0.3, poll_s=0.05)
+            time.sleep(0.9)
+            assert _bundles(enabled) == [], \
+                "a slow-but-advancing beacon must never read as a stall"
+        finally:
+            stop.set()
+            t.join()
+
+    def test_does_not_fire_on_quiesced_site(self, enabled):
+        blackbox.beacon("done")
+        blackbox.quiesce("done")
+        blackbox.start_sentinel(timeout_s=0.1, poll_s=0.05)
+        time.sleep(0.4)
+        assert _bundles(enabled) == []
+
+    def test_names_most_recently_advancing_site(self, enabled):
+        """Two stalled sites: the bundle names the one that was advancing
+        last — the wedged loop, not a long-idle leftover."""
+        blackbox.beacon("old_idle")
+        time.sleep(0.25)
+        blackbox.beacon("wedged_loop")
+        # BOTH sites are already past the timeout at the first poll, so
+        # one bundle covers the episode and must name the fresher site
+        time.sleep(0.2)
+        blackbox.start_sentinel(timeout_s=0.15, poll_s=0.05)
+        deadline = time.time() + 3.0
+        while time.time() < deadline and not _bundles(enabled):
+            time.sleep(0.05)
+        bundle = blackbox.load_bundle(_bundles(enabled)[0])
+        assert bundle["site"] == "wedged_loop"
+        stalled = {s["site"] for s in bundle["extra"]["stalled"]}
+        assert stalled == {"old_idle", "wedged_loop"}
+
+    def test_re_arms_after_progress(self, enabled):
+        blackbox.beacon("flappy")
+        blackbox.start_sentinel(timeout_s=0.12, poll_s=0.04)
+        deadline = time.time() + 3.0
+        while time.time() < deadline and len(_bundles(enabled)) < 1:
+            time.sleep(0.04)
+        assert len(_bundles(enabled)) == 1
+        blackbox.beacon("flappy")   # progress re-arms the episode
+        deadline = time.time() + 3.0
+        while time.time() < deadline and len(_bundles(enabled)) < 2:
+            time.sleep(0.04)
+        assert len(_bundles(enabled)) == 2
+
+    def test_thread_name_and_stop(self, enabled):
+        blackbox.start_sentinel(timeout_s=5.0)
+        assert blackbox.sentinel_running()
+        assert any(t.name == blackbox.SENTINEL_THREAD_NAME
+                   for t in threading.enumerate())
+        blackbox.stop_sentinel()
+        assert not blackbox.sentinel_running()
+
+
+class TestDumpBundle:
+    def test_round_trip_completeness(self, enabled):
+        from paddle_tpu import monitor
+
+        blackbox.beacon("rt_site")
+        blackbox.note("evidence", n=1)
+        blackbox.set_context("phase", "testing")
+        monitor.counter("rt_probe_total").inc()
+        path = blackbox.dump("signal", site="rt_site",
+                             extra={"k": "v"})
+        assert path is not None and os.path.exists(path)
+        bundle = blackbox.load_bundle(path)
+        assert blackbox.validate_bundle(bundle) == []
+        # stacks: this thread must appear, mid-dump
+        stacks = bundle["stacks"]
+        assert any("dump" in "".join(th["stack"]) for th in stacks)
+        # ring + beacons + context round-trip
+        assert any(r["kind"] == "evidence" for r in bundle["ring"])
+        assert bundle["beacons"]["rt_site"]["count"] == 1
+        assert bundle["context"]["phase"] == "testing"
+        assert bundle["extra"] == {"k": "v"}
+        # full metrics snapshot rides along
+        names = {m["name"] for m in bundle["metrics"]["metrics"]}
+        assert "rt_probe_total" in names
+        assert "faulthandler" in bundle
+
+    def test_dump_counts_metric_and_ring(self, enabled):
+        from paddle_tpu import monitor
+
+        path = blackbox.dump("signal")
+        assert path is not None
+        metric = monitor.default_registry().get("blackbox_dump_total")
+        series = {tuple(sorted(s.labels.items())): s.value
+                  for s in metric.series()}
+        assert series[(("reason", "signal"),)] >= 1
+        assert any(r["kind"] == "dump" for r in blackbox.ring())
+
+    def test_dump_emits_span_when_tracing(self, enabled):
+        trace.clear()
+        trace.enable()
+        try:
+            blackbox.dump("signal")
+        finally:
+            trace.disable()
+        names = [s.name for s in trace.spans()]
+        assert "blackbox_dump" in names
+        trace.clear()
+
+    def test_open_span_tree_captured(self, enabled):
+        trace.clear()
+        trace.enable()
+        try:
+            sp = trace.start_span("wedged_request", subsystem="serving")
+            path = blackbox.dump("signal")
+            bundle = blackbox.load_bundle(path)
+            open_names = {s["name"] for s in bundle["open_spans"]}
+            assert "wedged_request" in open_names
+            sp.end()
+            path2 = blackbox.dump("signal")
+            bundle2 = blackbox.load_bundle(path2)
+            assert "wedged_request" not in {
+                s["name"] for s in bundle2["open_spans"]}
+        finally:
+            trace.disable()
+            trace.clear()
+
+    def test_span_close_digest_lands_in_ring(self, enabled):
+        trace.clear()
+        trace.enable()
+        try:
+            with trace.span("digested", subsystem="t"):
+                pass
+        finally:
+            trace.disable()
+            trace.clear()
+        assert any(r["kind"] == "span" and r["name"] == "digested"
+                   for r in blackbox.ring())
+
+    def test_request_table_provider(self, enabled):
+        from paddle_tpu.inference.serving import ServingEngine
+
+        m = _tiny_model()
+        eng = ServingEngine(m, max_batch=1)
+        rng = np.random.RandomState(0)
+        r0 = eng.submit(rng.randint(0, 64, (4,)).astype(np.int32),
+                        max_new_tokens=8)
+        r1 = eng.submit(rng.randint(0, 64, (6,)).astype(np.int32),
+                        max_new_tokens=8)
+        eng.step()   # r0 running in the slot, r1 queued
+        path = blackbox.dump("signal")
+        bundle = blackbox.load_bundle(path)
+        tables = [t["table"] for t in bundle["requests"]
+                  if t["kind"] == "serving_engine"]
+        assert tables, "engine never registered its provider"
+        t = tables[-1]
+        assert set(t["in_flight"]) == {r0, r1}
+        assert r1 in t["queued"]
+        assert any(row["rid"] == r0 for row in t["running"])
+        eng.run_until_complete()
+
+    def test_bundle_dir_pruned_to_cap(self, enabled):
+        old = flags.get_flag("blackbox_max_bundles", 32)
+        flags.set_flags({"blackbox_max_bundles": 3})
+        try:
+            paths = [blackbox.dump("signal") for _ in range(5)]
+            kept = _bundles(enabled)
+            assert len(kept) == 3
+            # newest survive: the last three written paths remain
+            assert set(kept) == set(paths[-3:])
+        finally:
+            flags.set_flags({"blackbox_max_bundles": old})
+
+    def test_dump_never_raises(self, tmp_path):
+        # unwritable dir: dump returns None instead of crashing the host
+        blackbox.enable(install=False)
+        bad = tmp_path / "not_a_dir"
+        bad.write_text("file, not dir")
+        assert blackbox.dump("signal",
+                             dir_=str(bad / "sub")) is None
+
+
+class TestCrashAndSignalPaths:
+    def test_sigusr1_dump(self, enabled):
+        if not hasattr(signal, "SIGUSR1"):
+            pytest.skip("no SIGUSR1 on this platform")
+        old = signal.getsignal(signal.SIGUSR1)
+        blackbox.install_hooks()
+        # install_hooks latches; re-assert the handler for this test
+        signal.signal(signal.SIGUSR1, blackbox._on_signal)
+        try:
+            os.kill(os.getpid(), signal.SIGUSR1)
+            deadline = time.time() + 2.0
+            while time.time() < deadline and not _bundles(enabled):
+                time.sleep(0.02)
+            bundles = _bundles(enabled)
+            assert bundles, "SIGUSR1 did not produce a bundle"
+            bundle = blackbox.load_bundle(bundles[0])
+            assert bundle["reason"] == "signal"
+        finally:
+            signal.signal(signal.SIGUSR1, old)
+
+    def test_excepthook_dump(self, enabled):
+        try:
+            raise ValueError("boom for the recorder")
+        except ValueError as e:
+            blackbox._on_excepthook(ValueError, e, e.__traceback__)
+        bundles = _bundles(enabled)
+        assert bundles
+        bundle = blackbox.load_bundle(bundles[-1])
+        assert bundle["reason"] == "crash"
+        assert "boom for the recorder" in bundle["extra"]["exception"]
+
+    def test_engine_stalled_error_names_dump_path(self, enabled):
+        from paddle_tpu.inference.serving import ServingEngine
+
+        m = _tiny_model()
+        eng = ServingEngine(m, max_batch=1)
+        rng = np.random.RandomState(1)
+        rid = eng.submit(rng.randint(0, 64, (4,)).astype(np.int32),
+                         max_new_tokens=30)
+        with pytest.raises(RuntimeError) as exc:
+            eng.run_until_complete(max_steps=2)
+        msg = str(exc.value)
+        assert "blackbox dump bundle:" in msg
+        path = msg.rsplit("blackbox dump bundle: ", 1)[1]
+        bundle = blackbox.load_bundle(path)
+        assert bundle["reason"] == "stall"
+        assert bundle["site"] == "serving/step"
+        # the dump ran BEFORE the finishes: the rid is still in-flight
+        tables = [t["table"] for t in bundle["requests"]
+                  if t["kind"] == "serving_engine"]
+        assert any(rid in t["in_flight"] for t in tables)
+        assert eng.get_request(rid).finish_reason == "engine_stalled"
+
+    def test_router_all_dead_error_names_dump_path(self, enabled):
+        from paddle_tpu.inference.serving import ServingEngine
+        from paddle_tpu.serving.router import NoLiveEngineError, Router
+
+        m = _tiny_model()
+        router = Router({"a": ServingEngine(m, max_batch=1)})
+        router._alive.discard("a")   # every engine dead
+        rng = np.random.RandomState(2)
+        with pytest.raises(NoLiveEngineError) as exc:
+            router.submit(rng.randint(0, 64, (4,)).astype(np.int32),
+                          max_new_tokens=2)
+        msg = str(exc.value)
+        assert "blackbox dump bundle:" in msg
+        path = msg.rsplit("blackbox dump bundle: ", 1)[1]
+        bundle = blackbox.load_bundle(path)
+        assert bundle["reason"] == "crash"
+        assert bundle["site"] == "router/no_live_engine"
+        assert bundle["extra"]["dead" if "dead" in bundle["extra"]
+                               else "engines"] is not None
+
+    def test_engine_stall_without_recorder_keeps_old_error(self):
+        """Flag off: the engine_stalled error reads exactly as before —
+        no dump, no path in the message."""
+        from paddle_tpu.inference.serving import ServingEngine
+
+        m = _tiny_model()
+        eng = ServingEngine(m, max_batch=1)
+        rng = np.random.RandomState(1)
+        eng.submit(rng.randint(0, 64, (4,)).astype(np.int32),
+                   max_new_tokens=30)
+        with pytest.raises(RuntimeError) as exc:
+            eng.run_until_complete(max_steps=2)
+        assert "blackbox" not in str(exc.value)
+
+
+class TestWorkloadBeacons:
+    def test_serving_and_trainer_sites_register(self, enabled):
+        from paddle_tpu.inference.serving import ServingEngine
+
+        m = _tiny_model()
+        eng = ServingEngine(m, max_batch=1)
+        rng = np.random.RandomState(0)
+        eng.submit(rng.randint(0, 64, (4,)).astype(np.int32),
+                   max_new_tokens=3)
+        eng.run_until_complete()
+        sites = blackbox.beacons()
+        assert sites["serving/step"]["count"] >= 2
+        # the step window closed with the last step: a finished drain
+        # never reads as a stall
+        assert not sites["serving/step"]["active"]
+        assert "serving/admit" in sites
+        assert not sites["serving/admit"]["active"]  # window closed
+
+        import jax
+
+        from paddle_tpu.distributed.mesh import build_mesh
+        from paddle_tpu.distributed.spmd import SpmdTrainer
+
+        model = paddle.nn.Linear(4, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+        tr = SpmdTrainer(model, opt, loss_fn=paddle.nn.MSELoss(),
+                         mesh=mesh)
+        tr.train_step(np.ones((2, 4), np.float32),
+                      np.zeros((2, 1), np.float32))
+        assert blackbox.beacons()["trainer/step"]["count"] == 1
+
+    def test_router_and_disagg_sites_register(self, enabled):
+        from paddle_tpu.inference.serving import ServingEngine
+        from paddle_tpu.serving.disagg import DisaggregatedPool
+        from paddle_tpu.serving.router import Router
+
+        m = _tiny_model()
+        rng = np.random.RandomState(0)
+        router = Router({"a": ServingEngine(m, max_batch=1)})
+        router.submit(rng.randint(0, 64, (4,)).astype(np.int32),
+                      max_new_tokens=2)
+        router.run_until_complete()
+        pool = DisaggregatedPool(m, prefill_workers=1, decode_engines=1,
+                                 max_batch=1)
+        pool.submit(rng.randint(0, 64, (5,)).astype(np.int32),
+                    max_new_tokens=2)
+        pool.run_until_complete()
+        sites = blackbox.beacons()
+        for site in ("router/step", "disagg/handoff", "disagg/prefill"):
+            assert sites[site]["count"] >= 1, site
+            assert not sites[site]["active"], site
+
+    def test_collective_and_checkpoint_tags(self, enabled, tmp_path):
+        from paddle_tpu.distributed import collective
+
+        collective.all_reduce(paddle.to_tensor(np.ones(2, np.float32)))
+        p = str(tmp_path / "ckpt.pdparams")
+        paddle.save({"w": paddle.to_tensor(np.ones(3))}, p)
+        kinds = [r["kind"] for r in blackbox.ring()]
+        assert "collective" in kinds
+        assert "checkpoint" in kinds

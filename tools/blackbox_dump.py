@@ -1,0 +1,197 @@
+"""Blackbox dump CLI: trigger, read, and validate flight-recorder bundles.
+
+    python tools/blackbox_dump.py --trigger 12345       # SIGUSR1 a live pid
+    python tools/blackbox_dump.py --read BUNDLE.json    # pretty-printer
+    python tools/blackbox_dump.py --read BUNDLE.json --json
+    python tools/blackbox_dump.py --latest [--dir D]    # newest bundle
+
+``--trigger PID`` sends SIGUSR1 to a live process running with
+``FLAGS_blackbox=1`` — its installed handler writes a dump bundle to its
+``FLAGS_blackbox_dir`` (default <tmp>/paddle_tpu_blackbox) without
+stopping it. ``--read`` loads a bundle, validates the required keys
+(reason, beacon table, ring, all-thread stacks, metrics snapshot,
+in-flight request tables, context) and prints the wedge-reading view:
+which site stalled, what every thread was doing, the last ring events,
+and which requests were mid-flight. A missing or malformed bundle is an
+error-severity finding and **exit code 1** — the CI contract.
+
+``--json`` emits the tools/graph_lint.py report schema ({"tool",
+"passes", "targets": {name: {"name", "counts", "findings"}}, "totals"},
+plus the parsed "bundle" per target) so CI reads graph_lint /
+metrics_dump / trace_dump / chaos_check / blackbox_dump through one
+loader. See docs/OBSERVABILITY.md "Flight recorder & stall diagnostics".
+"""
+import argparse
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PASSES = ["bundle-valid", "bundle-content"]
+
+
+def _finding(name, severity, message, where=""):
+    return {"pass": name, "severity": severity, "message": message,
+            "where": where}
+
+
+def audit_bundle(path):
+    """Load + validate one bundle; returns (bundle | None, findings)."""
+    from paddle_tpu.monitor import blackbox
+
+    try:
+        bundle = blackbox.load_bundle(path)
+    except ValueError as e:
+        return None, [_finding("bundle-valid", "error", str(e), where=path)]
+    findings = [_finding("bundle-valid", "info",
+                         f"bundle well-formed (reason={bundle['reason']!r}, "
+                         f"site={bundle.get('site')!r})", where=path)]
+    if not bundle.get("stacks"):
+        findings.append(_finding(
+            "bundle-content", "error",
+            "bundle has no thread stacks — the dump writer captured "
+            "nothing attributable", where=path))
+    if bundle["reason"] not in ("stall", "signal", "crash"):
+        findings.append(_finding(
+            "bundle-content", "warning",
+            f"unknown dump reason {bundle['reason']!r} (expected "
+            "stall|signal|crash)", where=path))
+    if bundle["reason"] == "stall" and not bundle.get("site"):
+        findings.append(_finding(
+            "bundle-content", "error",
+            "a stall bundle must name the stalled beacon site",
+            where=path))
+    return bundle, findings
+
+
+def summarize(bundle, out=sys.stdout):
+    """The human wedge-reading view of one bundle."""
+    w = out.write
+    w(f"# blackbox bundle: reason={bundle['reason']} "
+      f"site={bundle.get('site')} pid={bundle['pid']}\n")
+    ctx = bundle.get("context") or {}
+    if ctx:
+        w(f"  context: {json.dumps(ctx, sort_keys=True)}\n")
+    w("  beacons:\n")
+    for site, b in sorted((bundle.get("beacons") or {}).items()):
+        flag = " <-- stalled" if site == bundle.get("site") else ""
+        w(f"    {site:<20} count={b['count']:<8} age={b['age_s']}s "
+          f"active={b['active']}{flag}\n")
+    reqs = bundle.get("requests") or []
+    for entry in reqs:
+        if "error" in entry:
+            w(f"  {entry['kind']}: provider error {entry['error']}\n")
+            continue
+        w(f"  {entry['kind']}: "
+          f"{json.dumps(entry['table'], sort_keys=True)}\n")
+    ring = bundle.get("ring") or []
+    w(f"  ring ({len(ring)} events, newest last):\n")
+    for rec in ring[-10:]:
+        w(f"    {json.dumps(rec, sort_keys=True)}\n")
+    w(f"  threads ({len(bundle.get('stacks') or [])}):\n")
+    for th in bundle.get("stacks") or []:
+        w(f"    -- {th['name']} (tid {th['thread_id']})\n")
+        for line in th["stack"][-4:]:
+            for ln in line.rstrip().splitlines():
+                w(f"       {ln}\n")
+
+
+def build_report(paths):
+    report = {"tool": "blackbox_dump", "passes": PASSES, "targets": {},
+              "totals": {"error": 0, "warning": 0, "info": 0}}
+    for path in paths:
+        bundle, findings = audit_bundle(path)
+        counts = {"error": 0, "warning": 0, "info": 0}
+        for f in findings:
+            counts[f["severity"]] += 1
+        name = os.path.basename(path)
+        report["targets"][name] = {"name": name, "counts": counts,
+                                   "findings": findings}
+        if bundle is not None:
+            report["targets"][name]["bundle"] = bundle
+        for sev, n in counts.items():
+            report["totals"][sev] += n
+    return report
+
+
+def _latest(d):
+    from paddle_tpu.monitor import blackbox
+
+    d = d or blackbox.default_dir()
+    def mtime(p):
+        # a live recorder may prune a bundle between the listing and the
+        # stat: score vanished entries oldest instead of crashing
+        try:
+            return os.path.getmtime(p)
+        except OSError:
+            return 0.0
+
+    try:
+        names = [os.path.join(d, n) for n in os.listdir(d)
+                 if n.startswith("blackbox-") and n.endswith(".json")]
+    except OSError:
+        return None
+    return max(names, key=mtime) if names else None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trigger", metavar="PID", type=int,
+                    help="SIGUSR1 a live FLAGS_blackbox=1 process: it "
+                         "writes a dump bundle and keeps running")
+    ap.add_argument("--read", metavar="BUNDLE", action="append",
+                    default=[],
+                    help="load + validate a bundle (repeatable); exit 1 "
+                         "on a missing/malformed one")
+    ap.add_argument("--latest", action="store_true",
+                    help="read the newest bundle in --dir (default: the "
+                         "default blackbox dir)")
+    ap.add_argument("--dir", default=None,
+                    help="bundle directory for --latest")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the graph_lint-schema machine report")
+    args = ap.parse_args(argv)
+
+    if args.trigger is not None:
+        if not hasattr(signal, "SIGUSR1"):
+            print("SIGUSR1 unavailable on this platform", file=sys.stderr)
+            return 1
+        try:
+            os.kill(args.trigger, signal.SIGUSR1)
+        except OSError as e:
+            print(f"cannot signal pid {args.trigger}: {e}",
+                  file=sys.stderr)
+            return 1
+        print(f"SIGUSR1 sent to {args.trigger}; the bundle lands in its "
+              "FLAGS_blackbox_dir")
+        return 0
+
+    paths = list(args.read)
+    if args.latest:
+        p = _latest(args.dir)
+        if p is None:
+            print("no bundles found", file=sys.stderr)
+            return 1
+        paths.append(p)
+    if not paths:
+        ap.error("pick an action: --trigger PID, --read BUNDLE, "
+                 "or --latest")
+
+    report = build_report(paths)
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        for name, t in report["targets"].items():
+            for f in t["findings"]:
+                if f["severity"] != "info":
+                    print(f"  [{f['severity']}] {f['pass']}: "
+                          f"{f['message']}")
+            if "bundle" in t:
+                summarize(t["bundle"])
+    return 1 if report["totals"]["error"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

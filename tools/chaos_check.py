@@ -21,6 +21,11 @@ Checks (one entry per name in `passes`):
                      via the serving/step failpoint; every request —
                      including the dead engine's in-flight ones —
                      finishes on the survivor with exact greedy parity
+  stall_dump         a serving/step=delay failpoint wedges an engine;
+                     the blackbox stall sentinel fires DURING the wedge
+                     and its dump bundle names site=serving/step, the
+                     in-flight rids, and all-thread stacks — then the
+                     engine drains to exact greedy parity
   trainer_nonfinite  a NaN batch under FLAGS_check_nan_inf skips the
                      update, leaving params/moments bit-identical
 
@@ -44,7 +49,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 PASSES = ["ckpt_atomic", "ckpt_fallback", "serving_deadline",
           "serving_slot_error", "serving_shed", "router_failover",
-          "trainer_nonfinite"]
+          "stall_dump", "trainer_nonfinite"]
 
 
 def _finding(name, severity, message, where=""):
@@ -267,6 +272,85 @@ def _check_router_failover(m):
                 "survivor, bit-exact, reasons recorded")]
 
 
+def _check_stall_dump(m):
+    """Chaos-injected stall: a serving/step=delay failpoint wedges one
+    engine step; the sentinel (short timeout) must fire DURING the wedge
+    and leave a bundle naming site=serving/step + the in-flight rids."""
+    import glob
+
+    import numpy as np
+
+    from paddle_tpu import flags
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.monitor import blackbox as bb
+    from paddle_tpu.testing import failpoints as fp
+
+    rng = np.random.RandomState(4)
+    prompt = rng.randint(0, 64, (5,)).astype(np.int32)
+    tmp_ctx = tempfile.TemporaryDirectory(
+        prefix="paddle_tpu_chaos_blackbox_")
+    d = tmp_ctx.name
+    old_dir = flags.get_flag("blackbox_dir", "")
+    was_enabled = bb.is_enabled()
+    bb.enable(install=False)
+    flags.set_flags({"blackbox_dir": d})
+    try:
+        eng = ServingEngine(m, max_batch=1)
+        rid = eng.submit(prompt, max_new_tokens=6)
+        eng.step()   # a healthy beat first: the stall is a TRANSITION
+        bb.start_sentinel(timeout_s=0.15, poll_s=0.05)
+        with fp.scoped("serving/step=delay:800"):
+            eng.step()   # wedged inside the delay; the sentinel fires
+        # the sentinel writes the bundle on ITS thread: poll briefly so a
+        # loaded CI machine's slow write doesn't read as a missed fire
+        deadline = time.time() + 3.0
+        bundles = []
+        while time.time() < deadline:
+            bundles = sorted(glob.glob(os.path.join(d,
+                                                    "blackbox-*.json")))
+            if bundles:
+                break
+            time.sleep(0.05)
+        if not bundles:
+            return [_finding("stall_dump", "error",
+                             "sentinel did not write a dump bundle while "
+                             "the engine step was wedged")]
+        bundle = bb.load_bundle(bundles[0])
+        if bundle["reason"] != "stall" \
+                or bundle.get("site") != "serving/step":
+            return [_finding(
+                "stall_dump", "error",
+                f"bundle names reason={bundle['reason']!r} "
+                f"site={bundle.get('site')!r}, expected a stall at "
+                "serving/step")]
+        tables = [t["table"] for t in bundle.get("requests", [])
+                  if t.get("kind") == "serving_engine" and "table" in t]
+        if not any(rid in t.get("in_flight", []) for t in tables):
+            return [_finding("stall_dump", "error",
+                             f"wedged request rid={rid} missing from the "
+                             "bundle's in-flight request tables")]
+        if not bundle.get("stacks"):
+            return [_finding("stall_dump", "error",
+                             "bundle carries no all-thread stacks")]
+        res = eng.run_until_complete()
+        if not np.array_equal(res[rid].tokens, _ref_tokens(m, prompt, 6)):
+            return [_finding("stall_dump", "error",
+                             "the wedged-then-released request lost "
+                             "greedy parity")]
+    finally:
+        bb.stop_sentinel()
+        flags.set_flags({"blackbox_dir": old_dir})
+        bb.quiesce()
+        bb.reset()
+        if not was_enabled:
+            bb.disable()
+        tmp_ctx.cleanup()
+    return [_ok("stall_dump",
+                "sentinel fired during the wedge; bundle named "
+                "site=serving/step + in-flight rids; drain stayed "
+                "bit-exact")]
+
+
 def _check_trainer_nonfinite():
     import numpy as np
 
@@ -326,13 +410,14 @@ def build_report(only=None):
         ("trainer_nonfinite", _check_trainer_nonfinite),
     ]
     if selected & {"serving_deadline", "serving_slot_error",
-                   "serving_shed", "router_failover"}:
+                   "serving_shed", "router_failover", "stall_dump"}:
         m = _tiny_model()
         checks += [
             ("serving_deadline", lambda: _check_serving_deadline(m)),
             ("serving_slot_error", lambda: _check_serving_slot_error(m)),
             ("serving_shed", lambda: _check_serving_shed(m)),
             ("router_failover", lambda: _check_router_failover(m)),
+            ("stall_dump", lambda: _check_stall_dump(m)),
         ]
     for name, fn in checks:
         if name not in selected:

@@ -3,6 +3,7 @@
     python tools/metrics_dump.py --model gpt              # one gpt train step
     python tools/metrics_dump.py --serving                # serving decode loop
     python tools/metrics_dump.py --router                 # multi-engine tier
+    python tools/metrics_dump.py --blackbox               # flight recorder
     python tools/metrics_dump.py --model bert --prometheus
     python tools/metrics_dump.py --all --json             # machine-readable
     python tools/metrics_dump.py --serving --trace        # + span summary
@@ -40,6 +41,10 @@ _REQUIRED = {
                 "serving_requests_submitted_total", "serving_tokens_total"),
     "router": ("router_requests_total", "kv_handoff_bytes_total",
                "kv_handoff_total", "serving_requests_submitted_total"),
+    # the flight-recorder families (monitor/blackbox.py): a dump and its
+    # ring events must land in the registry when the recorder runs
+    "blackbox": ("blackbox_dump_total", "blackbox_ring_events_total",
+                 "serving_requests_submitted_total"),
 }
 
 _DIMS = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
@@ -150,6 +155,37 @@ def run_router_loop(new_tokens=4):
             "pool": pool.stats()["pool"]}
 
 
+def run_blackbox_loop(new_tokens=4):
+    """The flight-recorder target: a short serving loop with the
+    recorder ON, then one on-demand dump bundle into a throwaway dir —
+    moves blackbox_ring_events_total (beacon ring feeds) and
+    blackbox_dump_total{reason=signal} in one pass."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.monitor import blackbox
+
+    was = blackbox.is_enabled()
+    blackbox.enable(install=False)
+    d = tempfile.mkdtemp(prefix="paddle_tpu_blackbox_dump_")
+    try:
+        run_serving_loop(new_tokens=new_tokens)
+        path = blackbox.dump("signal", site="metrics_dump", dir_=d)
+        if path is None:
+            raise RuntimeError("blackbox.dump() wrote no bundle")
+        bundle = blackbox.load_bundle(path)
+        return {"bundle": os.path.basename(path),
+                "ring": blackbox.ring_summary(3),
+                "providers": [t.get("kind")
+                              for t in bundle.get("requests", [])]}
+    finally:
+        blackbox.quiesce()
+        blackbox.reset()
+        if not was:
+            blackbox.disable()
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _metric_families(snap):
     return {m["name"]: m for m in snap["metrics"] if m["series"]}
 
@@ -164,7 +200,7 @@ def run_target(name, with_trace=False):
 
     monitor.reset()
     trace_summary = None
-    kind = name if name in ("serving", "router") else "train"
+    kind = name if name in ("serving", "router", "blackbox") else "train"
     if with_trace:
         trace.clear()
         trace.enable()
@@ -173,6 +209,8 @@ def run_target(name, with_trace=False):
             run_serving_loop()
         elif kind == "router":
             run_router_loop()
+        elif kind == "blackbox":
+            run_blackbox_loop()
         else:
             run_train_step(name)
     finally:
@@ -226,8 +264,14 @@ def main(argv=None):
                          "disaggregated prefill/decode handoff); exit 1 "
                          "when the router/kv_handoff metric families are "
                          "missing")
+    ap.add_argument("--blackbox", action="store_true", dest="blackbox",
+                    help="run the flight-recorder target (serving loop "
+                         "with FLAGS_blackbox + one dump bundle); exit 1 "
+                         "when the blackbox_* metric families are "
+                         "missing")
     ap.add_argument("--all", action="store_true",
-                    help="all models + the serving loop")
+                    help="all models + the serving loop + the router "
+                         "and flight-recorder tiers")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the graph_lint-schema machine report")
     ap.add_argument("--prometheus", action="store_true",
@@ -242,11 +286,13 @@ def main(argv=None):
         targets.append("serving")
     if args.router:
         targets.append("router")
+    if args.blackbox:
+        targets.append("blackbox")
     if args.all:
-        targets = list(MODEL_TARGETS) + ["serving", "router"]
+        targets = list(MODEL_TARGETS) + ["serving", "router", "blackbox"]
     if not targets:
-        ap.error("pick a target: --model NAME, --serving, --router or "
-                 "--all")
+        ap.error("pick a target: --model NAME, --serving, --router, "
+                 "--blackbox or --all")
 
     report = build_report(targets, with_trace=args.with_trace)
     if args.as_json:

@@ -153,6 +153,15 @@ def build_report(targets):
             "trace": trace.snapshot_summary(5),
             "cost_table": costs.table(),
         }
+        if name in ("serving", "router"):
+            # with the flight recorder on, the serving/router targets
+            # also carry the ring summary (span digests + byte tags) —
+            # the same view a dump bundle would open with
+            from paddle_tpu.monitor import blackbox
+
+            if blackbox.is_enabled():
+                report["targets"][name]["blackbox_ring"] = \
+                    blackbox.ring_summary(5)
         for sev, n in counts.items():
             report["totals"][sev] += n
     return report
