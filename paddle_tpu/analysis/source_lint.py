@@ -21,6 +21,14 @@ BEFORE tracing:
       (docs/SERVING.md): models plug in through the DecodeModel registry
       (serving/decode_model.py), never by reaching into a model module's
       privates — that coupling is exactly what ISSUE 6 removed.
+  nonreduced-client-output : a function in federated/ returns a
+      ``client_map`` result that never passed through a ``federated_*``
+      reduce (or ``collective.client_reduce``). Client-placed values
+      escaping a federated API leak per-client data to the server
+      unaggregated AND skip the metered collective chokepoint — the
+      MapReduce contract (docs/FEDERATED.md) is map THEN reduce. A
+      deliberate client-placed return (e.g. ``client_map`` itself)
+      carries ``# lint: allow(client_output)``.
 
 Suppression: a trailing ``# lint: allow(<rule>)`` comment on the
 offending line acknowledges a documented, deliberate exception (e.g. an
@@ -48,15 +56,23 @@ RULES = {
     "time-in-traced-code": "warning",
     "mutable-default-arg": "error",
     "private-model-import-in-serving": "error",
+    "nonreduced-client-output": "error",
     "syntax-error": "error",
 }
+
+# shorthand markers accepted in allow(...) alongside the full rule name
+_RULE_ALIASES = {"nonreduced-client-output": ("client_output",)}
 
 
 def _allowed(lines, lineno, rule):
     if 1 <= lineno <= len(lines):
         m = _ALLOW_RE.search(lines[lineno - 1])
-        if m and rule in [r.strip() for r in m.group(1).split(",")]:
-            return True
+        if m:
+            names = [r.strip() for r in m.group(1).split(",")]
+            if rule in names:
+                return True
+            if any(a in names for a in _RULE_ALIASES.get(rule, ())):
+                return True
     return False
 
 
@@ -80,14 +96,19 @@ def _is_layer_class(cls):
 
 
 class _Visitor(ast.NodeVisitor):
-    def __init__(self, rel_path, lines, traced, serving=False):
+    def __init__(self, rel_path, lines, traced, serving=False,
+                 federated=False):
         self.rel = rel_path
         self.lines = lines
         self.traced = traced
         self.serving = serving
+        self.federated = federated
         self.findings = []
         self._func_stack = []
         self._class_stack = []
+        # per-function {name: lineno} of client_map results not yet passed
+        # through a federated_* reduce (nonreduced-client-output)
+        self._client_vals = []
 
     def _emit(self, rule, lineno, message):
         if _allowed(self.lines, lineno, rule):
@@ -115,11 +136,67 @@ class _Visitor(ast.NodeVisitor):
                         "shared across every call and instance; default "
                         "to None and build inside the body")
         self._func_stack.append(node)
+        self._client_vals.append({})
         self.generic_visit(node)
+        self._client_vals.pop()
         self._func_stack.pop()
 
     visit_FunctionDef = _visit_func
     visit_AsyncFunctionDef = _visit_func
+
+    # -- nonreduced-client-output bookkeeping (federated/ modules) ----------
+    @staticmethod
+    def _is_client_map_call(node):
+        return (isinstance(node, ast.Call)
+                and _dotted(node.func).split(".")[-1] == "client_map")
+
+    @staticmethod
+    def _is_reduce_call(node):
+        last = _dotted(node.func).split(".")[-1]
+        return last.startswith("federated_") or last == "client_reduce"
+
+    def visit_Assign(self, node):
+        if self.federated and self._client_vals:
+            scope = self._client_vals[-1]
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if self._is_client_map_call(node.value):
+                for n in names:
+                    scope[n] = node.lineno
+            else:
+                for n in names:
+                    scope.pop(n, None)   # rebound to something else
+        self.generic_visit(node)
+
+    def _mark_reduced(self, node):
+        """A federated_* reduce consumed these args: clear every Name
+        reachable inside them (generous by design — a lint heuristic)."""
+        scope = self._client_vals[-1]
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Name):
+                    scope.pop(sub.id, None)
+
+    def visit_Return(self, node):
+        if self.federated and self._client_vals and node.value is not None:
+            scope = self._client_vals[-1]
+            parts = (node.value.elts
+                     if isinstance(node.value, (ast.Tuple, ast.List))
+                     else [node.value])
+            fname = self._func_stack[-1].name if self._func_stack else "?"
+            for part in parts:
+                escaped = (isinstance(part, ast.Name) and part.id in scope) \
+                    or self._is_client_map_call(part)
+                if escaped:
+                    self._emit(
+                        "nonreduced-client-output", node.lineno,
+                        f"{fname} returns a client_map result that never "
+                        "passed through a federated_* reduce: client-"
+                        "placed values must aggregate via federated_sum/"
+                        "mean/weighted_mean (the metered collective "
+                        "chokepoint) before escaping a federated API, or "
+                        "carry `# lint: allow(client_output)` when client "
+                        "placement is the contract")
+        self.generic_visit(node)
 
     def _in_traced_scope(self):
         if not self.traced or not self._func_stack:
@@ -150,6 +227,9 @@ class _Visitor(ast.NodeVisitor):
     # -- call-site rules ----------------------------------------------------
     def visit_Call(self, node):
         name = _dotted(node.func)
+        if self.federated and self._client_vals \
+                and self._is_reduce_call(node):
+            self._mark_reduced(node)
         if self._in_traced_scope():
             if name.startswith(("np.random.", "numpy.random.")) or \
                     name in ("np.random", "numpy.random"):
@@ -169,14 +249,18 @@ class _Visitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def lint_source(source, rel_path="<string>", traced=True, serving=None):
+def lint_source(source, rel_path="<string>", traced=True, serving=None,
+                federated=None):
     """Lint one python source string; returns a list of Finding.
-    serving=None derives the serving-tier flag from rel_path (modules
-    under inference/ or serving/)."""
+    serving=None / federated=None derive the tier flags from rel_path
+    (modules under inference|serving/ resp. federated/)."""
     if serving is None:
         serving = _is_serving_module(rel_path)
+    if federated is None:
+        federated = _is_federated_module(rel_path)
     tree = ast.parse(source)
-    v = _Visitor(rel_path, source.splitlines(), traced, serving=serving)
+    v = _Visitor(rel_path, source.splitlines(), traced, serving=serving,
+                 federated=federated)
     v.visit(tree)
     v.findings.sort(key=lambda f: f.where)
     return v.findings
@@ -193,6 +277,10 @@ def _is_traced_module(rel_path):
 
 def _is_serving_module(rel_path):
     return rel_path.split(os.sep)[0] in _SERVING_PKGS
+
+
+def _is_federated_module(rel_path):
+    return rel_path.split(os.sep)[0] == "federated"
 
 
 def lint_path(root=None):

@@ -12,8 +12,11 @@ import types
 
 import numpy as np
 
+from .tiny_corpus import TinyCorpus, tiny_corpus
+
 __all__ = ["mnist", "cifar", "uci_housing", "imdb", "imikolov", "movielens",
-           "wmt14", "wmt16", "conll05", "flowers", "voc2012", "common"]
+           "wmt14", "wmt16", "conll05", "flowers", "voc2012", "common",
+           "TinyCorpus", "tiny_corpus"]
 
 
 def _creator(ds_factory, mapper=None):

@@ -1,0 +1,81 @@
+"""An in-repo, deterministic character-level corpus for the LM book tests.
+
+No network, no files, no RNG: the text is expanded from fixed sentence
+templates at import cost only (a few KB), so every run — the LM book test
+(tests/test_book_lm.py), the federated client partitioner, CI on any
+machine — sees byte-identical data. The templates are deliberately
+low-entropy (a small closed vocabulary, rigid syntax) so a tiny GPT
+reaches a meaningful next-char loss in a few hundred CPU steps while
+still having enough structure that convergence proves real learning, not
+memorizing one string.
+"""
+import numpy as np
+
+__all__ = ["TinyCorpus", "tiny_corpus"]
+
+_SUBJECTS = ("the cat", "the dog", "the bird", "a fox", "the owl",
+             "the fish", "a crab", "the mouse")
+_VERBS = ("sees", "finds", "follows", "watches", "likes", "meets")
+_OBJECTS = ("the moon", "the river", "a tree", "the hill", "a star",
+            "the sea", "the sun", "a leaf")
+
+
+def _book_text(repeats=3):
+    """Expand the templates into a deterministic little 'book'."""
+    lines = []
+    for r in range(repeats):
+        for i, s in enumerate(_SUBJECTS):
+            v = _VERBS[(i + r) % len(_VERBS)]
+            o = _OBJECTS[(i * 3 + r) % len(_OBJECTS)]
+            lines.append(f"{s} {v} {o}.")
+    return " ".join(lines) + "\n"
+
+
+class TinyCorpus:
+    """A char-level corpus: text, vocab, encode/decode, and next-token
+    example windows — everything the book test and the federated
+    partitioner need, with zero I/O."""
+
+    def __init__(self, text):
+        self.text = text
+        chars = sorted(set(text))
+        self.stoi = {c: i for i, c in enumerate(chars)}
+        self.itos = {i: c for i, c in enumerate(chars)}
+        self.ids = np.asarray([self.stoi[c] for c in text], np.int32)
+
+    @property
+    def vocab_size(self):
+        return len(self.stoi)
+
+    def encode(self, s):
+        """Text -> int32 ids; raises KeyError on out-of-vocabulary chars
+        (the corpus IS the vocabulary)."""
+        return np.asarray([self.stoi[c] for c in s], np.int32)
+
+    def decode(self, ids):
+        return "".join(self.itos[int(i)] for i in np.asarray(ids).ravel())
+
+    def examples(self, seq_len=16, stride=None):
+        """Sliding next-token windows: X[i] = ids[i:i+L], Y[i] = the same
+        window shifted one char (the labels GPTPretrainLoss expects).
+        ``stride`` defaults to seq_len (non-overlapping windows)."""
+        stride = int(stride or seq_len)
+        L = int(seq_len)
+        # last valid start is len-L-1 (Y needs one lookahead char)
+        starts = range(0, len(self.ids) - L, stride)
+        X = np.stack([self.ids[s:s + L] for s in starts])
+        Y = np.stack([self.ids[s + 1:s + L + 1] for s in starts])
+        return X, Y
+
+    def __len__(self):
+        return len(self.ids)
+
+    def __repr__(self):
+        return (f"TinyCorpus(chars={len(self.ids)}, "
+                f"vocab={self.vocab_size})")
+
+
+def tiny_corpus(repeats=3):
+    """The deterministic in-repo corpus (same text for the same
+    ``repeats``, always)."""
+    return TinyCorpus(_book_text(repeats))

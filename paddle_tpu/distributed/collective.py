@@ -325,6 +325,59 @@ def p2p_shift(x, axis_name, shift=1):
     return fn(x)
 
 
+def client_reduce(x, op=ReduceOp.SUM, axis_name="clients", placed=True,
+                  kind="federated_sum"):
+    """The federated MapReduce reduce chokepoint (paddle_tpu.federated).
+
+    Every cross-client aggregation funnels through here so it inherits the
+    collective discipline for free: byte metering
+    (``collective_bytes_total{op=federated_*}``), the ``collective/call``
+    failpoint, an instantaneous ``collective/<op>`` span, and — once the
+    EQuARX-style quantized reduces land (ROADMAP item 2) — whatever
+    compression the chokepoint grows. Two placements:
+
+    - ``placed=True`` — inside a ``client_map`` body (a vmap/shard_map axis
+      named `axis_name` is in scope): lowers to ``jax.lax.psum``/``pmean``/
+      ... on the named axis, which XLA differentiates and, when the clients
+      axis is sharded over a mesh, schedules as a real cross-device reduce;
+    - ``placed=False`` — server-side on a clients-leading array: reduces
+      axis 0 (the eager FedAvg aggregation path).
+
+    Like every collective here, a call inside a jit trace is counted once
+    per TRACE (host-side accounting)."""
+    _stat(kind, x)
+
+    def named(v):
+        if op in (ReduceOp.SUM, "sum"):
+            return jax.lax.psum(v, axis_name)
+        if op in (ReduceOp.MAX, "max"):
+            return jax.lax.pmax(v, axis_name)
+        if op in (ReduceOp.MIN, "min"):
+            return jax.lax.pmin(v, axis_name)
+        if op in (ReduceOp.AVG, "avg"):
+            return jax.lax.pmean(v, axis_name)
+        raise ValueError(f"client_reduce: unsupported op {op!r}")
+
+    def leading(v):
+        v = jnp.asarray(v)
+        if op in (ReduceOp.SUM, "sum"):
+            return jnp.sum(v, axis=0)
+        if op in (ReduceOp.MAX, "max"):
+            return jnp.max(v, axis=0)
+        if op in (ReduceOp.MIN, "min"):
+            return jnp.min(v, axis=0)
+        if op in (ReduceOp.AVG, "avg"):
+            return jnp.mean(v, axis=0)
+        raise ValueError(f"client_reduce: unsupported op {op!r}")
+
+    fn = named if placed else leading
+    if isinstance(x, Tensor):
+        from ..core.dispatch import apply
+
+        return apply(fn, x)
+    return fn(x)
+
+
 def barrier(group=None):
     if in_spmd_context():
         return

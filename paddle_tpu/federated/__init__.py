@@ -1,0 +1,25 @@
+"""Federated MapReduce: a ``clients`` axis for the SPMD stack.
+
+DrJAX-style primitives (PAPERS.md, arXiv:2403.07128) — ``client_map``
+over a named ``clients`` mesh axis with differentiable ``federated_*``
+reduces through the metered collective chokepoint — plus a
+``FederatedAverager`` FedAvg/FedSGD loop that composes with
+``incubate.lora`` for federated/multi-task fine-tuning. See
+docs/FEDERATED.md.
+
+Deliberately NOT imported by ``paddle_tpu/__init__.py``: a deployment
+that never federates never pays for (or registers metrics from) this
+package — tests/test_federated_gate.py pins that.
+"""
+from .averaging import FederatedAverager
+from .data import partition_clients
+from .primitives import (CLIENTS_AXIS, broadcast_to_clients, client_map,
+                         federated_mean, federated_sum,
+                         federated_weighted_mean, in_client_map,
+                         num_clients)
+
+__all__ = [
+    "CLIENTS_AXIS", "broadcast_to_clients", "client_map", "federated_sum",
+    "federated_mean", "federated_weighted_mean", "in_client_map",
+    "num_clients", "partition_clients", "FederatedAverager",
+]

@@ -1,0 +1,286 @@
+"""FedAvg/FedSGD training loop over the MapReduce primitives.
+
+``FederatedAverager`` drives the classic federated round (McMahan et al.
+2017) on top of the framework's existing pieces instead of inventing new
+ones: local client steps reuse the eager autograd loop + a throwaway
+``optimizer.SGD``; the server update reuses ANY ``paddle_tpu.optimizer``
+(SGD(lr=1) = plain FedAvg, AdamW = FedAdam-style server adaptivity) by
+handing it the aggregated update as a pseudo-gradient; and the
+cross-client aggregation is one ``federated_weighted_mean`` over the
+flattened trainable deltas — through the metered collective chokepoint,
+so ``collective_bytes_total{op=federated_sum}`` reports exactly the
+aggregated payload bytes.
+
+LoRA multi-task fine-tuning composes for free: run
+``incubate.lora.apply_lora`` (or ``mark_only_lora_trainable``) on the
+model first and only the adapters are trainable, so only adapter deltas
+travel — the aggregation payload shrinks from the full model to
+O(r * (in+out)) per wrapped layer (docs/FEDERATED.md has the recipe).
+
+Observability discipline (PR 2-7): ``federated_round_total``,
+``federated_client_examples``, ``federated_client_dropped_total`` and
+``federated_round_ms`` in the monitor registry; ``federated_round`` /
+``client_update`` / ``federated_aggregate`` spans; a ``federated_round``
+flight-recorder digest; and the ``federated/round`` failpoint at each
+client's update — an injected fault drops THAT client and the round
+completes with the surviving cohort. All of it is inert-by-default: no
+metric family, span, or import exists until a FederatedAverager runs
+(tests/test_federated_gate.py pins this).
+"""
+import time
+
+import numpy as np
+
+from .. import monitor as _monitor
+from .. import trace as _trace
+from ..core.tape import no_grad
+from ..core.tensor import Tensor, to_tensor
+from ..monitor import blackbox as _blackbox
+from ..testing import failpoints as _fp
+from .primitives import federated_weighted_mean
+
+__all__ = ["FederatedAverager"]
+
+_M = None   # lazy federated metric family handles
+
+
+def _metrics():
+    global _M
+    if _M is None:
+        _M = {
+            "rounds": _monitor.counter(
+                "federated_round_total",
+                "completed federated rounds by algorithm",
+                labelnames=("algorithm",)),
+            "examples": _monitor.histogram(
+                "federated_client_examples",
+                "examples processed per client update (count = client "
+                "updates, sum = total examples)",
+                buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+                         4096, 16384, 65536)),
+            "dropped": _monitor.counter(
+                "federated_client_dropped_total",
+                "client updates dropped mid-round (failpoint or organic "
+                "error); the round completes with the surviving cohort",
+                labelnames=("reason",)),
+            "round_ms": _monitor.histogram(
+                "federated_round_ms",
+                "wall time of one federated round (sampling + local "
+                "updates + aggregation + server update)"),
+        }
+    return _M
+
+
+class FederatedAverager:
+    """FedAvg/FedSGD driver: sample a cohort, run per-client local steps,
+    aggregate example-weighted deltas through ``federated_weighted_mean``,
+    apply the update with the server optimizer.
+
+    ``client_data`` is a sequence of client datasets — each a list of
+    ``(inputs, labels)`` numpy batch tuples (``federated.partition_clients``
+    builds these). ``loss_fn(outputs, labels)`` is any callable (a loss
+    Layer or function). Only params with ``trainable=True`` participate —
+    freeze the rest (e.g. ``incubate.lora.mark_only_lora_trainable``) and
+    their values never leave the server.
+
+    ``algorithm="fedavg"``: each client runs ``local_steps`` of
+    SGD(``local_lr``), the delta ``local - global`` aggregates, and the
+    server optimizer consumes ``-delta`` as a pseudo-gradient (SGD(lr=1)
+    reproduces textbook FedAvg; an adaptive server optimizer gives
+    FedAdam/FedOpt behavior). ``algorithm="fedsgd"``: clients compute one
+    gradient, no local step; the aggregated gradient feeds the server
+    optimizer directly."""
+
+    def __init__(self, model, loss_fn, client_data, server_optimizer=None,
+                 clients_per_round=None, local_steps=1, local_lr=0.1,
+                 algorithm="fedavg", seed=0):
+        if algorithm not in ("fedavg", "fedsgd"):
+            raise ValueError(f"algorithm must be 'fedavg' or 'fedsgd', "
+                             f"got {algorithm!r}")
+        if not client_data:
+            raise ValueError("client_data is empty — nothing to federate")
+        self.model = model
+        self.loss_fn = loss_fn
+        self.client_data = list(client_data)
+        self.n_clients = len(self.client_data)
+        self.algorithm = algorithm
+        self.local_steps = int(local_steps)
+        self.local_lr = float(local_lr)
+        self.clients_per_round = int(clients_per_round or self.n_clients)
+        if not 1 <= self.clients_per_round <= self.n_clients:
+            raise ValueError(
+                f"clients_per_round must be in [1, {self.n_clients}], got "
+                f"{self.clients_per_round}")
+        self._trainable = [(n, p) for n, p in model.named_parameters()
+                           if getattr(p, "trainable", True)]
+        if not self._trainable:
+            raise ValueError("model has no trainable parameters (did "
+                             "mark_only_lora_trainable run before LoRA "
+                             "was applied?)")
+        from ..optimizer import SGD
+
+        if server_optimizer is None:
+            server_optimizer = SGD(
+                learning_rate=1.0,
+                parameters=[p for _, p in self._trainable])
+        self.server_optimizer = server_optimizer
+        self._rng = np.random.RandomState(seed)
+        self.round_num = 0
+        # one shared local optimizer: plain SGD is stateless, so reusing
+        # it across clients leaks nothing and keeps ONE jitted update rule
+        # instead of a fresh jit wrapper (and compile) per client
+        self._local_opt = SGD(learning_rate=self.local_lr,
+                              parameters=[p for _, p in self._trainable])
+        # flatten/unflatten layout over the trainable set (fixed per run)
+        self._shapes = [tuple(p.shape) for _, p in self._trainable]
+        self._sizes = [int(np.prod(s)) if s else 1 for s in self._shapes]
+        self._offsets = np.cumsum([0] + self._sizes)
+
+    # -- parameter plumbing ------------------------------------------------
+    def _snapshot(self):
+        return [np.array(np.asarray(p._data), copy=True)
+                for _, p in self._trainable]
+
+    def _restore(self, vals):
+        for (_, p), v in zip(self._trainable, vals):
+            p.set_value(v)
+
+    def _flatten(self, vals):
+        return np.concatenate([np.asarray(v, np.float32).ravel()
+                               for v in vals])
+
+    def _unflatten(self, flat):
+        return [np.asarray(flat[a:b], np.float32).reshape(s)
+                for a, b, s in zip(self._offsets[:-1], self._offsets[1:],
+                                   self._shapes)]
+
+    # -- one client's contribution -----------------------------------------
+    def _client_update(self, cid, global_vals):
+        """Run one client's local work from the current global params;
+        returns (flat delta-or-grad float32 vector, n_examples). The
+        caller restores global params afterwards."""
+        batches = self.client_data[cid]
+        if not batches:
+            raise ValueError(f"client {cid} has no batches")
+        n_examples = 0
+        if self.algorithm == "fedsgd":
+            x, y = batches[0]
+            loss = self.loss_fn(self.model(to_tensor(x)), to_tensor(y))
+            for _, p in self._trainable:
+                p.clear_grad()
+            loss.backward()
+            grads = [np.asarray(p.grad._data) if p.grad is not None
+                     else np.zeros(p.shape, np.float32)
+                     for _, p in self._trainable]
+            for _, p in self._trainable:
+                p.clear_grad()
+            return self._flatten(grads), len(x)
+        local_opt = self._local_opt
+        for step in range(self.local_steps):
+            x, y = batches[step % len(batches)]
+            loss = self.loss_fn(self.model(to_tensor(x)), to_tensor(y))
+            loss.backward()
+            local_opt.step()
+            local_opt.clear_grad()
+            n_examples += len(x)
+        delta = [np.asarray(p._data) - g
+                 for (_, p), g in zip(self._trainable, global_vals)]
+        return self._flatten(delta), n_examples
+
+    def _apply_server_update(self, flat_update):
+        """Feed the aggregated update to the server optimizer as a
+        pseudo-gradient: FedAvg descends along -delta (so the optimizer's
+        `p -= lr * g` applies +delta at lr=1), FedSGD along the averaged
+        gradient itself."""
+        sign = -1.0 if self.algorithm == "fedavg" else 1.0
+        for (_, p), part in zip(self._trainable,
+                                self._unflatten(sign * flat_update)):
+            p.grad = Tensor(part.astype(np.asarray(p._data).dtype),
+                            stop_gradient=True)
+        self.server_optimizer.step()
+        self.server_optimizer.clear_grad()
+
+    # -- the round ---------------------------------------------------------
+    def run_round(self):
+        """One federated round. Returns a stats dict: cohort/survivor/
+        dropped counts, total examples, and the aggregated update's L2
+        norm. A client whose update raises (the ``federated/round``
+        failpoint, or an organic per-client error) is dropped; the round
+        completes with the survivors. Raises only when EVERY sampled
+        client fails — there is nothing to aggregate."""
+        rnd = self.round_num
+        t0 = time.perf_counter()
+        cohort = sorted(self._rng.choice(
+            self.n_clients, size=self.clients_per_round, replace=False))
+        global_vals = self._snapshot()
+        deltas, weights, dropped = [], [], 0
+        with _trace.span("federated_round", subsystem="federated",
+                         round=rnd, cohort=len(cohort)):
+            for cid in cohort:
+                try:
+                    with _trace.span("client_update", subsystem="federated",
+                                     client=int(cid)) as sp:
+                        _fp.failpoint("federated/round")
+                        vec, n_ex = self._client_update(cid, global_vals)
+                        sp.set(examples=n_ex)
+                except Exception as e:
+                    # per-client isolation, like serving's per-slot
+                    # errors: the client is dropped (injected fault or
+                    # organic error alike), its partial update shed, and
+                    # the round completes with the survivors
+                    dropped += 1
+                    if _monitor.is_enabled():
+                        reason = ("failpoint"
+                                  if isinstance(e, _fp.FailpointError)
+                                  else "error")
+                        _metrics()["dropped"].labels(reason=reason).inc()
+                    self._restore(global_vals)
+                    for _, p in self._trainable:
+                        p.clear_grad()   # a death mid-backward must not
+                        #                  bleed grads into the next client
+                    continue
+                self._restore(global_vals)
+                deltas.append(vec)
+                weights.append(float(n_ex))
+                if _monitor.is_enabled():
+                    _metrics()["examples"].observe(n_ex)
+            if not deltas:
+                raise RuntimeError(
+                    f"federated round {rnd}: every client in the "
+                    f"{len(cohort)}-client cohort failed; nothing to "
+                    "aggregate")
+            with _trace.span("federated_aggregate", subsystem="federated",
+                             clients=len(deltas)):
+                stacked = np.stack(deltas)          # [survivors, n_params]
+                agg = np.asarray(federated_weighted_mean(
+                    stacked, np.asarray(weights, np.float32)))
+            self._apply_server_update(agg)
+        self.round_num += 1
+        if _monitor.is_enabled():
+            m = _metrics()
+            m["rounds"].labels(algorithm=self.algorithm).inc()
+            m["round_ms"].observe((time.perf_counter() - t0) * 1e3)
+        stats = {"round": rnd, "cohort": len(cohort),
+                 "survivors": len(deltas), "dropped": dropped,
+                 "examples": int(sum(weights)),
+                 "update_norm": float(np.linalg.norm(agg))}
+        _blackbox.note("federated_round", **stats)
+        return stats
+
+    def run(self, rounds):
+        """Drive ``rounds`` rounds; returns the per-round stats list."""
+        return [self.run_round() for _ in range(int(rounds))]
+
+    # -- evaluation --------------------------------------------------------
+    def evaluate(self):
+        """Example-weighted mean loss of the CURRENT global model over
+        every client's data (the FedAvg objective being minimized)."""
+        total, n = 0.0, 0
+        with no_grad():
+            for batches in self.client_data:
+                for x, y in batches:
+                    loss = self.loss_fn(self.model(to_tensor(x)),
+                                        to_tensor(y))
+                    total += float(np.asarray(loss._data)) * len(x)
+                    n += len(x)
+        return total / max(n, 1)

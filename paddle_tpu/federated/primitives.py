@@ -1,0 +1,203 @@
+"""DrJAX-style federated MapReduce primitives over a named ``clients`` axis.
+
+Per DrJAX (PAPERS.md, arXiv:2403.07128): a federated computation is a
+sharded map over a *clients* axis plus differentiable reduces. Here the
+map is ``jax.vmap(fn, axis_name="clients")`` — so a reduce inside the
+mapped body is a real named-axis collective (``jax.lax.psum`` on
+``"clients"``) that XLA differentiates like any other primitive, and when
+the leading clients dimension of the inputs is sharded over a mesh
+``clients`` axis (``distributed.mesh.client_mesh``), GSPMD partitions the
+per-client work across devices and schedules the reduce on the ICI. The
+same program runs unchanged on 1 device (clients stacked in one shard) or
+N (clients spread) — placement is sharding, not code.
+
+Every cross-client reduce flows through
+``distributed.collective.client_reduce`` — the framework's collective
+chokepoint — so federated aggregation is byte-metered
+(``collective_bytes_total{op=federated_sum}``), span-traced
+(``collective/federated_sum``), and failpoint-covered
+(``collective/call``) exactly like dp all-reduces, and will inherit the
+planned quantized-reduce path (ROADMAP item 2) for free.
+
+Two placements for values (DrJAX's federated types, structurally):
+
+- *server* — an ordinary array/Tensor;
+- *clients* — an array whose LEADING axis is the clients dimension
+  (``broadcast_to_clients`` lifts server -> clients; ``federated_sum`` /
+  ``federated_mean`` / ``federated_weighted_mean`` lower clients ->
+  server).
+
+Inside a ``client_map`` body the clients axis is a *named* vmap axis, so
+the reduce primitives switch to psum/pmean on it automatically (the body
+sees per-client values, the reduce returns the replicated aggregate).
+"""
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..distributed import collective as _coll
+
+__all__ = [
+    "CLIENTS_AXIS", "in_client_map", "num_clients", "broadcast_to_clients",
+    "client_map", "federated_sum", "federated_mean",
+    "federated_weighted_mean",
+]
+
+CLIENTS_AXIS = "clients"
+
+_MAP_DEPTH = []   # truthy while a client_map body is being traced/executed
+
+
+def in_client_map():
+    """True inside a ``client_map`` body (the ``clients`` vmap axis is in
+    scope, so reduces lower to named-axis collectives)."""
+    return bool(_MAP_DEPTH)
+
+
+@contextlib.contextmanager
+def _map_scope():
+    _MAP_DEPTH.append(CLIENTS_AXIS)
+    try:
+        yield
+    finally:
+        _MAP_DEPTH.pop()
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _shard_clients(arr, mesh):
+    """Pin a clients-leading array's leading axis onto the mesh 'clients'
+    axis. Under a trace this is a sharding constraint; eagerly it is a
+    device_put — either way XLA sees the same placement."""
+    if mesh is None or CLIENTS_AXIS not in mesh.axis_names:
+        return arr
+    sh = NamedSharding(mesh, P(CLIENTS_AXIS))
+    if isinstance(arr, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(arr, sh)
+    return jax.device_put(jnp.asarray(arr), sh)
+
+
+def num_clients(x=None):
+    """The clients-axis size: inside a ``client_map`` body this is the
+    named-axis size (``psum(1, 'clients')``); outside, the leading-axis
+    length of the given clients-placed array."""
+    if in_client_map():
+        return jax.lax.psum(1, CLIENTS_AXIS)
+    if x is None:
+        raise ValueError("num_clients() outside client_map needs a "
+                         "clients-placed array to read the axis from")
+    return int(_unwrap(x).shape[0])
+
+
+def broadcast_to_clients(x, n_clients, mesh=None):
+    """Server -> clients placement: replicate ``x`` along a new leading
+    clients axis (shape ``[n_clients, *x.shape]``). With a ``clients``
+    mesh, the result is sharded over that axis — on TPU the broadcast is
+    then a real transfer; on one device it is a view-cheap tile. Returns
+    the same kind (Tensor in -> Tensor out); the broadcast is
+    differentiable (its reverse is a cross-client sum), and Tensor inputs
+    keep their tape link through ``dispatch.apply``."""
+    n = int(n_clients)
+    if isinstance(x, Tensor):
+        from ..core.dispatch import apply
+
+        out = apply(lambda v: jnp.broadcast_to(v[None], (n,) + v.shape), x)
+        out._data = _shard_clients(out._data, mesh)
+        return out
+    arr = jnp.asarray(x)
+    return _shard_clients(jnp.broadcast_to(arr[None], (n,) + arr.shape),
+                          mesh)
+
+
+def client_map(fn, *args, mesh=None, in_axes=0, out_axes=0):
+    """Map ``fn`` over the clients axis — DrJAX's ``map_fn``.
+
+    ``fn`` receives one client's slice of each mapped arg (leading axis
+    stripped) and runs with the ``clients`` axis IN SCOPE: ``federated_*``
+    reduces inside the body lower to named-axis collectives and return the
+    replicated aggregate to every client. ``in_axes`` follows ``jax.vmap``
+    (``None`` broadcasts a server-placed value to every client without
+    materializing copies). With ``mesh`` (a Mesh carrying a ``clients``
+    axis, e.g. ``distributed.mesh.client_mesh``), mapped inputs are
+    sharded over it so the per-client work partitions across devices.
+
+    Tensor args ride the autograd tape (the whole mapped computation is
+    one vjp node); raw arrays compose with jax.grad/jit as usual. The
+    result keeps the clients leading axis — pass it through a
+    ``federated_*`` reduce before it escapes a federated API
+    (analysis/source_lint.py's ``nonreduced-client-output`` rule holds
+    paddle_tpu's own federated code to that)."""
+    def body(*xs):
+        with _map_scope():
+            return fn(*xs)
+
+    mapped = jax.vmap(body, in_axes=in_axes, out_axes=out_axes,
+                      axis_name=CLIENTS_AXIS)
+    if mesh is not None:
+        axes = (in_axes if isinstance(in_axes, (tuple, list))
+                else [in_axes] * len(args))
+        bad = [ax for ax in axes if ax not in (None, 0)]
+        if bad:
+            raise ValueError(
+                "client_map(mesh=...) shards the LEADING axis over the "
+                "'clients' mesh axis; mapped in_axes must be 0 (or None "
+                f"for broadcast), got {list(axes)} — move the clients "
+                "dimension to axis 0 (e.g. jnp.moveaxis) before sharding")
+        for a, ax in zip(args, axes):
+            if ax is None:
+                continue
+            # placement-only move (values identical): a Tensor keeps its
+            # identity — and with it its tape link — by resharding its
+            # buffer in place, exactly like the in-place collectives do
+            if isinstance(a, Tensor):
+                a._data = _shard_clients(a._data, mesh)
+        args = tuple(a if (ax is None or isinstance(a, Tensor))
+                     else _shard_clients(a, mesh)
+                     for a, ax in zip(args, axes))
+    if any(isinstance(a, Tensor) for a in args):
+        from ..core.dispatch import apply
+
+        return apply(mapped, *args)
+    return mapped(*args)
+
+
+def federated_sum(x):
+    """Differentiable cross-client sum — the MapReduce reduce. Inside a
+    ``client_map`` body: ``psum`` over the named ``clients`` axis (every
+    client receives the replicated total); outside: reduce the leading
+    clients axis to a server-placed value. Either way the reduce goes
+    through ``distributed.collective.client_reduce`` and is metered as
+    ``collective_bytes_total{op=federated_sum}``."""
+    return _coll.client_reduce(x, op=_coll.ReduceOp.SUM,
+                               axis_name=CLIENTS_AXIS,
+                               placed=in_client_map())
+
+
+def federated_mean(x):
+    """Uniform cross-client mean: ``federated_sum(x) / n_clients`` (one
+    metered reduce plus a free scalar divide)."""
+    n = num_clients(None if in_client_map() else x)
+    return federated_sum(x) / n
+
+
+def federated_weighted_mean(x, w):
+    """Example-weighted cross-client mean — FedAvg's aggregation:
+    ``sum_c(w_c * x_c) / sum_c(w_c)``. ``w`` is one non-negative scalar
+    per client (inside ``client_map``: this client's weight; outside: a
+    ``[n_clients]`` vector broadcast against ``x``'s trailing dims). Both
+    sums are metered ``federated_sum`` reduces, so the numerator's byte
+    count is exactly the aggregated payload (the adapter bytes in a LoRA
+    FedAvg round)."""
+    if not in_client_map():
+        warr = jnp.asarray(_unwrap(w), dtype=jnp.float32)
+        xa = _unwrap(x)
+        w = warr.reshape((-1,) + (1,) * (np.ndim(xa) - 1))
+    num = federated_sum(x * w)
+    den = federated_sum(w)
+    return num / den

@@ -65,6 +65,10 @@ SITES = {
                     "(reason='error'), batch-mates continue",
     "trainer/step": "SpmdTrainer.train_step — before the compiled step "
                     "dispatches",
+    "federated/round": "federated.FederatedAverager — each client's local "
+                       "update inside a round; an injected error drops "
+                       "that client (federated_client_dropped_total) and "
+                       "the round completes with the surviving cohort",
 }
 
 

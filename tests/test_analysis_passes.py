@@ -446,6 +446,101 @@ class TestSourceLint:
         assert lint_source(src, "inference/serving.py", traced=False) == []
 
 
+class TestNonreducedClientOutput:
+    """ISSUE 8 lint satellite: a client_map result must not escape a
+    federated/ API without passing through a federated_* reduce (or carry
+    an explicit `# lint: allow(client_output)` marker)."""
+
+    def test_positive_assigned_then_returned(self):
+        src = ("def api(xs):\n"
+               "    vals = client_map(fn, xs)\n"
+               "    return vals\n")
+        fs = lint_source(src, "federated/primitives.py", traced=False)
+        assert [f.pass_name for f in fs] == ["nonreduced-client-output"]
+        assert fs[0].severity == "error"
+        assert "federated_sum" in fs[0].message
+
+    def test_positive_direct_return(self):
+        src = ("def api(xs):\n"
+               "    return client_map(fn, xs)\n")
+        fs = lint_source(src, "federated/averaging.py", traced=False)
+        assert [f.pass_name for f in fs] == ["nonreduced-client-output"]
+
+    def test_positive_in_tuple_return(self):
+        src = ("def api(xs):\n"
+               "    vals = client_map(fn, xs)\n"
+               "    total = federated_sum(other(xs))\n"
+               "    return total, vals\n")
+        fs = lint_source(src, "federated/x.py", traced=False)
+        assert [f.pass_name for f in fs] == ["nonreduced-client-output"]
+
+    def test_negative_value_fed_through_reduce_expression(self):
+        """A name consumed INSIDE a reduce's argument expression counts
+        as reduced (the heuristic clears every name the reduce saw)."""
+        src = ("def api(xs):\n"
+               "    vals = client_map(fn, xs)\n"
+               "    return federated_sum(vals * 2)\n")
+        assert lint_source(src, "federated/x.py", traced=False) == []
+
+    def test_negative_reduced_before_return(self):
+        src = ("def api(xs):\n"
+               "    vals = client_map(fn, xs)\n"
+               "    return federated_mean(vals)\n")
+        assert lint_source(src, "federated/primitives.py",
+                           traced=False) == []
+
+    def test_negative_client_reduce_chokepoint(self):
+        src = ("def api(xs):\n"
+               "    vals = client_map(fn, xs)\n"
+               "    out = _coll.client_reduce(vals)\n"
+               "    return out\n")
+        assert lint_source(src, "federated/primitives.py",
+                           traced=False) == []
+
+    def test_negative_rebound_name(self):
+        src = ("def api(xs):\n"
+               "    vals = client_map(fn, xs)\n"
+               "    vals = federated_sum(vals)\n"
+               "    return vals\n")
+        assert lint_source(src, "federated/x.py", traced=False) == []
+
+    def test_allow_marker_short_and_full(self):
+        src = ("def api(xs):\n"
+               "    vals = client_map(fn, xs)\n"
+               "    return vals  # lint: allow(client_output)\n")
+        assert lint_source(src, "federated/primitives.py",
+                           traced=False) == []
+        src2 = ("def api(xs):\n"
+                "    vals = client_map(fn, xs)\n"
+                "    return vals  # lint: allow(nonreduced-client-output)\n")
+        assert lint_source(src2, "federated/primitives.py",
+                           traced=False) == []
+
+    def test_rule_scoped_to_federated_modules(self):
+        src = ("def api(xs):\n"
+               "    vals = client_map(fn, xs)\n"
+               "    return vals\n")
+        assert lint_source(src, "distributed/spmd.py", traced=False) == []
+        assert lint_source(src, "nn/layer/common.py", traced=True) == []
+
+    def test_repo_federated_package_is_clean(self):
+        """paddle_tpu's own federated/ modules hold the bar the rule
+        sets (any deliberate client-placed return carries the marker)."""
+        import os
+
+        import paddle_tpu.federated as fed
+
+        root = os.path.dirname(os.path.abspath(fed.__file__))
+        for fn in sorted(os.listdir(root)):
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(root, fn), encoding="utf-8") as f:
+                src = f.read()
+            fs = lint_source(src, f"federated/{fn}", traced=False)
+            assert [f_ for f_ in fs
+                    if f_.pass_name == "nonreduced-client-output"] == []
+
+
 # ---------------------------------------------------------------------------
 # analysis hooks: static Program and inference Predictor
 # ---------------------------------------------------------------------------

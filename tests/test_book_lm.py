@@ -1,0 +1,133 @@
+"""The end-to-end LM book test (ROADMAP item 5, VERDICT #7).
+
+The train -> save -> serve proof on real (in-repo, deterministic) data:
+tiny GPT trained on the character corpus via ``Model.fit`` to a pinned
+loss threshold, checkpointed durably through ``CheckpointSaver``,
+reloaded into a FRESH differently-seeded model, and served through
+``ServingEngine`` — with the served greedy completion equal to the
+direct ``generate()`` output, token for token.
+
+The whole chain trains once (module-scoped fixture, ~7 s on the CPU
+harness); the threshold (0.35) carries ~2x margin over the calibrated
+16-epoch loss (~0.19).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.checkpoint.auto_checkpoint import CheckpointSaver
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.models import GPTConfig, GPTForCausalLM, GPTPretrainLoss
+
+LOSS_THRESHOLD = 0.35
+SEQ_LEN = 16
+CFG = dict(vocab_size=32, hidden_size=64, num_layers=1, num_heads=2,
+           max_seq_len=64, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    return paddle.dataset.tiny_corpus()
+
+
+@pytest.fixture(scope="module")
+def trained(corpus):
+    """Train once via Model.fit (jit adapter: the whole step is one XLA
+    program, batch sharded over the 8-device dp mesh); returns
+    (network, eval_loss)."""
+    X, Y = corpus.examples(seq_len=SEQ_LEN, stride=4)
+
+    class DS(paddle.io.Dataset):
+        def __len__(self):
+            return len(X)
+
+        def __getitem__(self, i):
+            return X[i], Y[i]
+
+    paddle.seed(0)
+    net = GPTForCausalLM(GPTConfig(**CFG))
+    model = paddle.Model(net, use_jit=True)
+    model.prepare(
+        paddle.optimizer.Adam(learning_rate=3e-3,
+                              parameters=net.parameters()),
+        GPTPretrainLoss())
+    model.fit(DS(), epochs=16, batch_size=16, shuffle=True, verbose=0,
+              drop_last=True)
+    logs = model.evaluate(DS(), batch_size=16, verbose=0)
+    return net, float(logs["loss"])
+
+
+@pytest.fixture(scope="module")
+def checkpoint_dir(trained, tmp_path_factory):
+    """Durable checkpoint of the trained weights via CheckpointSaver
+    (atomic rename commit + corrupt-fallback recovery, docs/ROBUSTNESS.md)."""
+    net, loss = trained
+    d = tmp_path_factory.mktemp("book_lm_ckpt")
+    saver = CheckpointSaver(str(d))
+    no = saver.save_checkpoint({"model": net.state_dict()},
+                               meta={"loss": loss})
+    assert no == 0
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def restored(checkpoint_dir):
+    """A FRESH model (different seed — nothing survives but the
+    checkpoint bytes) restored from the newest valid checkpoint."""
+    state, meta = CheckpointSaver(checkpoint_dir).load_checkpoint()
+    assert state is not None and "loss" in meta
+    paddle.seed(12345)
+    net = GPTForCausalLM(GPTConfig(**CFG))
+    net.set_state_dict(state["model"])
+    net.eval()
+    return net, meta
+
+
+def _greedy_new_tokens(net, prompt, n):
+    out = net.generate(paddle.to_tensor(prompt[None]), max_new_tokens=n,
+                       temperature=0)
+    seqs = out[0] if isinstance(out, tuple) else out
+    ids = np.asarray(seqs._data if hasattr(seqs, "_data") else seqs)
+    return ids[0, len(prompt):]
+
+
+class TestBookLM:
+    def test_fit_reaches_loss_threshold(self, trained):
+        _, loss = trained
+        assert np.isfinite(loss)
+        assert loss < LOSS_THRESHOLD, (
+            f"tiny-GPT Model.fit stalled at loss {loss:.4f} "
+            f">= {LOSS_THRESHOLD}")
+
+    def test_checkpoint_restores_identical_weights(self, trained,
+                                                   restored):
+        net, _ = trained
+        net2, meta = restored
+        want = {n: np.asarray(t._data)
+                for n, t in net.state_dict().items()}
+        got = {n: np.asarray(t._data)
+               for n, t in net2.state_dict().items()}
+        assert sorted(want) == sorted(got)
+        for n in want:
+            np.testing.assert_array_equal(want[n], got[n], err_msg=n)
+        assert meta["loss"] < LOSS_THRESHOLD
+
+    def test_served_completions_match_direct_generate(self, restored,
+                                                      corpus):
+        """The book proof's last leg: the checkpoint served through the
+        continuous-batching ServingEngine decodes the SAME greedy tokens
+        as direct generate(), across interleaved requests — and the
+        completion is real learned structure (in-vocabulary text), not
+        noise."""
+        net, _ = restored
+        prompts = [corpus.encode("the cat "), corpus.encode("the owl ")]
+        n_new = 10
+        eng = ServingEngine(net, max_batch=2)
+        rids = [eng.submit(p, max_new_tokens=n_new) for p in prompts]
+        res = eng.run_until_complete()
+        for rid, p in zip(rids, prompts):
+            served = np.asarray(res[rid].tokens)
+            np.testing.assert_array_equal(served,
+                                          _greedy_new_tokens(net, p, n_new))
+            assert all(0 <= t < corpus.vocab_size for t in served)
+            assert set(corpus.decode(served)) <= set(corpus.text)

@@ -1,0 +1,166 @@
+"""Tier-1 federated gate: the federated tier costs a plain SPMD
+deployment NOTHING when no federated API is touched.
+
+Pins (ISSUE 8 satellite, same pattern as test_router_gate.py):
+ - a plain SpmdTrainer train step never imports paddle_tpu.federated
+   (subprocess check — the package is NOT on paddle_tpu/__init__'s
+   import surface);
+ - a plain trainer run leaves ZERO federated_* metric series and ZERO
+   federated-subsystem spans;
+ - the federated/round failpoint site and the nonreduced-client-output
+   lint rule are REGISTERED (arming/suppressing a typo'd name must fail
+   fast);
+ - tools/metrics_dump.py --federated exits 1 when the federated metric
+   families are missing (the CI contract in executable form).
+"""
+import importlib.util
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor, nn, trace
+from paddle_tpu.testing import failpoints
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _plain_train_steps(steps=3):
+    import jax
+
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=net.parameters())
+    mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+    trainer = SpmdTrainer(net, opt, loss_fn=nn.MSELoss(), mesh=mesh)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(4, 4).astype(np.float32))
+    for _ in range(steps):
+        out = trainer.train_step(x, y)
+    return float(np.asarray(out._data))
+
+
+class TestZeroOverheadPlainTrainer:
+    def test_plain_trainer_never_imports_federated(self):
+        """The structural form of 'zero overhead': no federated API
+        touched -> the package (and its metric registrations) is never
+        even imported."""
+        code = (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "import numpy as np\n"
+            "import paddle_tpu as paddle\n"
+            "from paddle_tpu import nn\n"
+            "from paddle_tpu.distributed.mesh import build_mesh\n"
+            "from paddle_tpu.distributed.spmd import SpmdTrainer\n"
+            "paddle.seed(0)\n"
+            "net = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 4))\n"
+            "opt = paddle.optimizer.AdamW(learning_rate=1e-3,\n"
+            "    parameters=net.parameters())\n"
+            "mesh = build_mesh((1,), ('dp',), devices=jax.devices()[:1])\n"
+            "tr = SpmdTrainer(net, opt, loss_fn=nn.MSELoss(), mesh=mesh)\n"
+            "x = paddle.to_tensor(np.ones((4, 8), np.float32))\n"
+            "y = paddle.to_tensor(np.ones((4, 4), np.float32))\n"
+            "tr.train_step(x, y)\n"
+            "import sys\n"
+            "bad = [k for k in sys.modules\n"
+            "       if k.startswith('paddle_tpu.federated')]\n"
+            "assert not bad, f'federated tier imported eagerly: {bad}'\n"
+            "print('LAZY_OK')\n")
+        out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                             capture_output=True, text=True, timeout=560)
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "LAZY_OK" in out.stdout
+
+    def test_plain_trainer_zero_federated_metrics_and_spans(self):
+        monitor.reset()
+        trace.clear()
+        trace.enable()
+        try:
+            _plain_train_steps()
+        finally:
+            trace.disable()
+        flat = monitor.flatten(monitor.snapshot())
+        # zeroed () series can survive monitor.reset() when an earlier
+        # in-process test ran the federated tier — zero overhead means
+        # nothing was RECORDED by the plain trainer run
+        leaked = {k: v for k, v in flat.items()
+                  if k.startswith("federated_")
+                  and (v["count"] if isinstance(v, dict) else v)}
+        assert not leaked, leaked
+        # no federated_sum collective rode along either
+        assert not {k for k in flat
+                    if "op=federated" in k and flat[k]}, flat
+        assert not [s for s in trace.spans()
+                    if s.subsystem == "federated"
+                    or s.name.startswith("federated")]
+        # the trainer's own span family is intact
+        assert "train_step" in {s.name for s in trace.spans()}
+
+
+class TestRegistrations:
+    def test_failpoint_site_registered(self):
+        assert "federated/round" in failpoints.SITES
+        failpoints.arm("federated/round", "error:1")
+        try:
+            assert failpoints.armed() == {"federated/round": "error:1"}
+        finally:
+            failpoints.reset()
+
+    def test_lint_rule_registered(self):
+        from paddle_tpu.analysis.source_lint import RULES
+
+        assert RULES.get("nonreduced-client-output") == "error"
+
+    def test_clients_axis_documented_in_mesh(self):
+        from paddle_tpu.distributed import mesh
+
+        assert "clients" in (mesh.__doc__ or "")
+        assert callable(mesh.client_mesh)
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.pop(name, None)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestFederatedToolGate:
+    def test_metrics_dump_federated_missing_metrics_exits_1(
+            self, capsys, monkeypatch):
+        md = _load_tool("metrics_dump")
+        monkeypatch.setattr(md, "run_federated_loop", lambda **kw: None)
+        rc = md.main(["--federated", "--json"])
+        assert rc == 1
+        import json
+
+        report = json.loads(capsys.readouterr().out)
+        missing = {f["message"].split("'")[1]
+                   for f in report["targets"]["federated"]["findings"]
+                   if f["pass"] == "metrics-present"}
+        # federated_round_total is labeled, so monitor.reset() drops its
+        # series entirely; the histogram family may survive as a zeroed
+        # () series when an earlier in-process test touched it
+        assert "federated_round_total" in missing
+
+    @pytest.mark.slow
+    def test_metrics_dump_federated_green_subprocess(self):
+        """Subprocess CI form: the --federated tool runs clean at HEAD
+        (the green path; tier-1 covers the exit-1 contract above)."""
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "metrics_dump.py"),
+             "--federated", "--json"],
+            cwd=REPO, capture_output=True, text=True, timeout=560)
+        assert out.returncode == 0, out.stderr[-2000:]

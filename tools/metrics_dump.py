@@ -4,6 +4,7 @@
     python tools/metrics_dump.py --serving                # serving decode loop
     python tools/metrics_dump.py --router                 # multi-engine tier
     python tools/metrics_dump.py --blackbox               # flight recorder
+    python tools/metrics_dump.py --federated              # 2-client FedAvg
     python tools/metrics_dump.py --model bert --prometheus
     python tools/metrics_dump.py --all --json             # machine-readable
     python tools/metrics_dump.py --serving --trace        # + span summary
@@ -45,6 +46,10 @@ _REQUIRED = {
     # ring events must land in the registry when the recorder runs
     "blackbox": ("blackbox_dump_total", "blackbox_ring_events_total",
                  "serving_requests_submitted_total"),
+    # the federated tier (docs/FEDERATED.md): round + per-client-examples
+    # families, and the aggregation bytes through the collective chokepoint
+    "federated": ("federated_round_total", "federated_client_examples",
+                  "collective_bytes_total"),
 }
 
 _DIMS = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
@@ -155,6 +160,31 @@ def run_router_loop(new_tokens=4):
             "pool": pool.stats()["pool"]}
 
 
+def run_federated_loop(rounds=1):
+    """The federated tier target: a 2-client LoRA FedAvg round — moves
+    federated_round_total, federated_client_examples, and the
+    collective_bytes_total{op=federated_sum} aggregation bytes in one
+    pass."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.federated import FederatedAverager, partition_clients
+    from paddle_tpu.incubate.lora import apply_lora
+
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    net = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 4))
+    apply_lora(net, r=2, alpha=4)
+    X = rng.randn(16, 8).astype(np.float32)
+    Y = rng.randn(16, 4).astype(np.float32)
+    fed = FederatedAverager(
+        net, nn.MSELoss(), partition_clients((X, Y), 2, batch_size=8),
+        local_steps=2, local_lr=0.1, seed=0)
+    stats = fed.run(rounds)
+    return {"rounds": stats, "loss": fed.evaluate()}
+
+
 def run_blackbox_loop(new_tokens=4):
     """The flight-recorder target: a short serving loop with the
     recorder ON, then one on-demand dump bundle into a throwaway dir —
@@ -200,7 +230,8 @@ def run_target(name, with_trace=False):
 
     monitor.reset()
     trace_summary = None
-    kind = name if name in ("serving", "router", "blackbox") else "train"
+    kind = (name if name in ("serving", "router", "blackbox", "federated")
+            else "train")
     if with_trace:
         trace.clear()
         trace.enable()
@@ -211,6 +242,8 @@ def run_target(name, with_trace=False):
             run_router_loop()
         elif kind == "blackbox":
             run_blackbox_loop()
+        elif kind == "federated":
+            run_federated_loop()
         else:
             run_train_step(name)
     finally:
@@ -269,9 +302,14 @@ def main(argv=None):
                          "with FLAGS_blackbox + one dump bundle); exit 1 "
                          "when the blackbox_* metric families are "
                          "missing")
+    ap.add_argument("--federated", action="store_true", dest="federated",
+                    help="run the federated tier (2-client LoRA FedAvg "
+                         "round); exit 1 when the federated_round_total/"
+                         "federated_client_examples metric families are "
+                         "missing")
     ap.add_argument("--all", action="store_true",
-                    help="all models + the serving loop + the router "
-                         "and flight-recorder tiers")
+                    help="all models + the serving loop + the router, "
+                         "flight-recorder and federated tiers")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the graph_lint-schema machine report")
     ap.add_argument("--prometheus", action="store_true",
@@ -288,11 +326,14 @@ def main(argv=None):
         targets.append("router")
     if args.blackbox:
         targets.append("blackbox")
+    if args.federated:
+        targets.append("federated")
     if args.all:
-        targets = list(MODEL_TARGETS) + ["serving", "router", "blackbox"]
+        targets = list(MODEL_TARGETS) + ["serving", "router", "blackbox",
+                                         "federated"]
     if not targets:
         ap.error("pick a target: --model NAME, --serving, --router, "
-                 "--blackbox or --all")
+                 "--blackbox, --federated or --all")
 
     report = build_report(targets, with_trace=args.with_trace)
     if args.as_json:
