@@ -206,9 +206,11 @@ class SpmdTrainer:
                 raise ValueError("remat_offload and recompute_policy both "
                                  "select a jax.checkpoint policy — pick one")
         self._compiled = None       # latest executable (back-compat handle)
-        self._compiled_store = {}   # (batch-sig, guarded) -> (executable,
-        #                             guarded) — guarded steps return an
-        #                             extra on-device finiteness flag
+        self._compiled_store = {}   # (batch-sig, guarded, numerics) ->
+        #                             (executable, guarded, numerics) —
+        #                             the two flags change the step's
+        #                             output arity (finiteness verdict /
+        #                             fused health-stats leg)
         self._nonfinite_streak = 0  # consecutive skipped steps
         self._nonfinite_total = 0   # lifetime skipped steps (stats())
         # step-time accounting for stats(): host wall time per step plus
@@ -222,6 +224,13 @@ class SpmdTrainer:
         self._cost_entries = {}     # THIS trainer's sig -> cost entry: a
         #                             second trainer with the same batch
         #                             shapes must not clobber our join
+        # numerics telescope (FLAGS_numerics, docs/OBSERVABILITY.md):
+        # the monitor is created lazily on the first armed fetch so the
+        # plain path never imports monitor/numerics.py at all
+        self._numerics = None
+        self._numerics_seen = 0            # armed steps so far
+        self._numerics_last_device = None  # device-resident stats leg
+        self._numerics_last_host = None    # cached fetch of the above
         self.params = {n: p._data for n, p in layer.named_parameters() if getattr(p, "trainable", True)}
         self.frozen = {n: p._data for n, p in layer.named_parameters() if not getattr(p, "trainable", True)}
         self.buffers = {n: b._data for n, b in layer.named_buffers()}
@@ -428,6 +437,14 @@ class SpmdTrainer:
 
         want_out = self.return_outputs
         guard = self._guard_active()
+        narmed = self._numerics_active()
+        if narmed:
+            from ..monitor import numerics as _numerics
+
+            # SORTED param order: jax returns dict pytrees key-sorted, so
+            # self.params' insertion order changes after the first step —
+            # sorted is the one order that matches across build/fetch
+            stat_layers = sorted(self.params)
 
         def step(params, opt_state, buffers, lr, rng, *batch):
             def loss_fn(p, b, r):
@@ -463,6 +480,14 @@ class SpmdTrainer:
                 (loss, (new_buffers, outputs)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params, batch, rng)
             new_params, new_state = self.optimizer.functional_apply(params, grads, opt_state, lr=lr)
+            nstats = None
+            if narmed:
+                # FLAGS_numerics: the fused per-layer health aggregation
+                # (monitor/numerics.py), computed on the RAW grads and
+                # update BEFORE any guard select — a poisoned step must
+                # still name the layer that went non-finite
+                nstats = _numerics.device_stats(
+                    stat_layers, loss, grads, params, new_params)
             if guard:
                 # FLAGS_check_nan_inf: ONE fused on-device finiteness
                 # verdict over loss + every gradient; a non-finite step
@@ -481,13 +506,14 @@ class SpmdTrainer:
                 new_state = jax.tree_util.tree_map(keep, new_state, opt_state)
                 new_buffers = jax.tree_util.tree_map(
                     keep, new_buffers, buffers)
-                if want_out:
-                    return (loss, new_params, new_state, new_buffers,
-                            outputs, finite)
-                return loss, new_params, new_state, new_buffers, finite
+            out = [loss, new_params, new_state, new_buffers]
             if want_out:
-                return loss, new_params, new_state, new_buffers, outputs
-            return loss, new_params, new_state, new_buffers
+                out.append(outputs)
+            if narmed:
+                out.append(nstats)
+            if guard:
+                out.append(finite)
+            return tuple(out)
 
         batch_shard = NamedSharding(mesh, P(ax))
         repl = NamedSharding(mesh, P())
@@ -507,6 +533,9 @@ class SpmdTrainer:
         if want_out:
             # outputs: per-example arrays, batch-sharded over dp (prefix spec)
             out_shardings = out_shardings + (batch_shard,)
+        if narmed:
+            out_shardings = out_shardings + (
+                _numerics.stat_shardings(repl),)   # the stats leg
         if guard:
             out_shardings = out_shardings + (repl,)   # the finite flag
         return jax.jit(step, in_shardings=in_shardings, out_shardings=out_shardings,
@@ -666,11 +695,22 @@ class SpmdTrainer:
         return (bool(_flags.get_flag("check_nan_inf"))
                 and not self.localsgd_k and not self._is_dgc())
 
+    def _numerics_active(self):
+        """FLAGS_numerics appends the fused health-stats leg to the
+        compiled step (monitor/numerics.py, docs/OBSERVABILITY.md
+        "Numerics telescope"). localsgd/DGC shard_map programs don't
+        thread it — the same carve-out as the non-finite guard. The flag
+        lives in flags.py so this check never imports the telescope."""
+        return (bool(_flags.get_flag("numerics"))
+                and not self.localsgd_k and not self._is_dgc())
+
     def _exec_key(self, batch_arrays):
-        # the guard changes the compiled program's output arity, so it is
-        # part of the executable's identity: toggling the flag recompiles
-        # instead of mis-unpacking a stale executable
-        return (self._batch_sig_key(batch_arrays), self._guard_active())
+        # the guard/numerics legs change the compiled program's output
+        # arity, so they are part of the executable's identity: toggling
+        # either flag recompiles instead of mis-unpacking a stale
+        # executable
+        return (self._batch_sig_key(batch_arrays), self._guard_active(),
+                self._numerics_active())
 
     def _aot_compile(self, batch_arrays, lr, rng, force=False):
         """Build the jitted step for THIS batch signature and obtain its
@@ -681,6 +721,7 @@ class SpmdTrainer:
         be jax.ShapeDtypeStructs (aot_build: nothing is executed)."""
         sig = _batch_sig_label(batch_arrays)
         guarded = self._guard_active()
+        narmed = self._numerics_active()
         with _RecordEvent("trainer/compile"), \
                 _monitor.timed(_COMPILE_MS.labels(site="trainer")):
             jitted = self._build(batch_arrays)
@@ -691,9 +732,10 @@ class SpmdTrainer:
                 site="trainer", force=force or _trace.is_enabled(),
                 extra_key=("trainer", _aot.mesh_fingerprint(self.mesh),
                            self.dp_axis, self.sharding_stage,
-                           self.accumulate_steps, guarded))
+                           self.accumulate_steps, guarded, narmed))
         self._compiled_store[self._exec_key(batch_arrays)] = (compiled,
-                                                              guarded)
+                                                              guarded,
+                                                              narmed)
         self._compiled = compiled  # latest executable (back-compat handle)
         _aot.record_compile("trainer", sig, source)
         cost_entry = _costs.record("trainer", sig,
@@ -742,6 +784,10 @@ class SpmdTrainer:
         _failpoints.failpoint("trainer/step")
         t_step = time.perf_counter()
         batch_arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(np.asarray(b)) for b in batch]
+        # value-transforming failpoint (scale:F) — chaos tests inject a
+        # gradient spike / non-finite batch here; one boolean check when
+        # nothing is armed (docs/ROBUSTNESS.md)
+        batch_arrays = _failpoints.transform("trainer/batch", batch_arrays)
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
         # fresh per-step randomness (dropout etc.): deterministic under
         # paddle.seed, varies per step — a trace-time key would bake ONE
@@ -757,7 +803,7 @@ class SpmdTrainer:
             source = "memory"
             if _monitor.is_enabled():
                 _aot.record_compile("trainer", sig_label, "memory")
-        compiled, guarded = entry
+        compiled, guarded, narmed = entry
         # exec window starts AFTER compile resolution: stats()/MFU must
         # divide flops by run time, not by jit-build + AOT-compile time
         # (step_latency_ms keeps its historical include-compile meaning)
@@ -774,22 +820,24 @@ class SpmdTrainer:
                 )
                 self.optimizer._step_count += 1
                 return self._finish_step(loss, t_step, t_exec)
-            finite = None
-            out = compiled(
+            out = list(compiled(
                 self.params, self.opt_state, self.buffers, lr, rng, *batch_arrays
-            )
+            ))
+            # fixed unpack order matching _build's packing: loss, state,
+            # then the optional legs — outputs / numerics stats / finite
+            loss = out.pop(0)
+            self.params = out.pop(0)
+            self.opt_state = out.pop(0)
+            self.buffers = out.pop(0)
             if self.return_outputs:  # ctor rejects localsgd/dgc combinations
-                if guarded:
-                    loss, self.params, self.opt_state, self.buffers, outs, \
-                        finite = out
-                else:
-                    loss, self.params, self.opt_state, self.buffers, outs = out
-                self.last_outputs = jax.tree_util.tree_map(Tensor, outs)
-            else:
-                if guarded:
-                    loss, self.params, self.opt_state, self.buffers, finite = out
-                else:
-                    loss, self.params, self.opt_state, self.buffers = out
+                self.last_outputs = jax.tree_util.tree_map(Tensor,
+                                                           out.pop(0))
+            nstats = out.pop(0) if narmed else None
+            finite = out.pop(0) if guarded else None
+            if nstats is not None:
+                # keep the stats leg device-resident; the host fetch
+                # happens only every FLAGS_numerics_interval steps
+                self._numerics_note(nstats)
             if finite is not None and not bool(np.asarray(finite)):
                 # update was skipped ON DEVICE (params/state/buffers selected
                 # pre-update, bit-identical); the host decides whether the run
@@ -853,6 +901,53 @@ class SpmdTrainer:
             _trace.add_counter_sample("trainer_step_ms", step_ms)
         return Tensor(loss)
 
+    # -- numerics telescope ----------------------------------------------------
+    def _numerics_note(self, nstats):
+        """Bank the step's device-resident stats leg; fetch to host only
+        every FLAGS_numerics_interval steps — between fetches the arrays
+        never cross the device boundary."""
+        self._numerics_seen += 1
+        self._numerics_last_device = nstats
+        self._numerics_last_host = None
+        interval = max(1, int(_flags.get_flag("numerics_interval", 1)))
+        if self._numerics_seen % interval == 0:
+            self.numerics_fetch()
+
+    def numerics_fetch(self):
+        """Fetch the latest on-device numerics stats to the host, feed
+        the drift detectors, and return the host dict (STAT_KEYS ->
+        np arrays, rows in ``sorted(self.params)`` order) — or None when
+        FLAGS_numerics never armed a step. Idempotent per step (the
+        parity harness force-fetches after every step without double-
+        observing); emits a ``numerics/fetch`` span."""
+        if self._numerics_last_host is not None:
+            return self._numerics_last_host
+        nstats = self._numerics_last_device
+        if nstats is None:
+            return None
+        from ..monitor import numerics as _numerics_mod
+
+        if self._numerics is None:
+            # sorted order — matching _build's stat_layers (see there)
+            self._numerics = _numerics_mod.NumericsMonitor(
+                sorted(self.params), source="trainer")
+        with _trace.span("numerics/fetch", subsystem="trainer",
+                         step=int(self.optimizer._step_count)):
+            if _monitor.is_enabled():
+                with _monitor.timed(
+                        _numerics_mod._metrics()["fetch_ms"]):
+                    host = jax.device_get(nstats)
+            else:
+                host = jax.device_get(nstats)
+        host = {k: np.asarray(v) for k, v in host.items()}
+        self._numerics_last_host = host
+        # stamp anomalies with the OPTIMIZER step — the same clock the
+        # train_step/numerics-fetch spans carry, so a crash bundle's
+        # anomaly cross-references its span tree (skipped guard steps
+        # repeat a step number; that IS the schedule position retried)
+        self._numerics.observe(host, step=int(self.optimizer._step_count))
+        return host
+
     def stats(self):
         """Trainer observability snapshot: step counts/wall time joined
         with the device cost registry into an MFU estimate.
@@ -897,6 +992,11 @@ class SpmdTrainer:
                 "nonfinite_streak": self._nonfinite_streak,
             },
             "device_memory": _costs.sample_device_memory(),
+            # the numerics telescope's model-health snapshot (None until
+            # FLAGS_numerics arms a step — the plain path never even
+            # imports the module)
+            "numerics": (self._numerics.snapshot()
+                         if self._numerics is not None else None),
         }
 
     def sync_to_layer(self):

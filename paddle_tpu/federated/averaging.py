@@ -126,6 +126,7 @@ class FederatedAverager:
         self.server_optimizer = server_optimizer
         self._rng = np.random.RandomState(seed)
         self.round_num = 0
+        self._numerics = None   # lazy telescope hook (FLAGS_numerics)
         # one shared local optimizer: plain SGD is stateless, so reusing
         # it across clients leaks nothing and keeps ONE jitted update rule
         # instead of a fresh jit wrapper (and compile) per client
@@ -254,6 +255,7 @@ class FederatedAverager:
                 stacked = np.stack(deltas)          # [survivors, n_params]
                 agg = np.asarray(federated_weighted_mean(
                     stacked, np.asarray(weights, np.float32)))
+            self._note_numerics(rnd, agg, global_vals)
             self._apply_server_update(agg)
         self.round_num += 1
         if _monitor.is_enabled():
@@ -266,6 +268,39 @@ class FederatedAverager:
                  "update_norm": float(np.linalg.norm(agg))}
         _blackbox.note("federated_round", **stats)
         return stats
+
+    def _note_numerics(self, rnd, agg, global_vals):
+        """FLAGS_numerics: feed the round's aggregate through the same
+        telescope path the trainer uses — the cohort-weighted delta norm
+        (``agg`` is already the example-weighted mean, so its norm IS the
+        cohort-weighted one) and the update/param ratio land as
+        ``numerics_*{layer="federated/round"}`` series with the full
+        ring/EMA drift detection behind them. One flag check when unset:
+        the plain path never imports the telescope (gate-pinned to zero
+        drift by tests/test_numerics_gate.py)."""
+        from .. import flags as _flags
+
+        if not _flags.get_flag("numerics"):
+            return
+        from ..monitor import numerics as _numerics
+
+        if self._numerics is None:
+            self._numerics = _numerics.NumericsMonitor(
+                ["federated/round"], source="federated")
+        agg = np.asarray(agg, np.float32)
+        delta_norm = float(np.linalg.norm(agg))
+        param_norm = float(np.linalg.norm(self._flatten(global_vals)))
+        finite = np.isfinite(agg)
+        self._numerics.observe({
+            "grad_norm": np.asarray([delta_norm], np.float32),
+            "grad_absmax": np.asarray(
+                [np.max(np.abs(agg)) if agg.size else 0.0], np.float32),
+            "nonfinite": np.asarray([float(np.sum(~finite))], np.float32),
+            "param_norm": np.asarray([param_norm], np.float32),
+            "update_norm": np.asarray([delta_norm], np.float32),
+            "update_ratio": np.asarray(
+                [delta_norm / (param_norm + 1e-12)], np.float32),
+        }, step=rnd)
 
     def run(self, rounds):
         """Drive ``rounds`` rounds; returns the per-round stats list."""

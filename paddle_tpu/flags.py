@@ -21,6 +21,13 @@ def define_flag(name, default, help_str=""):
             value = float(env)
         else:
             value = env
+    existing = _REGISTRY.get(name)
+    if existing is not None:
+        # an explicit set_flags() made BEFORE the defining module loaded
+        # wins: lazily-imported modules (monitor/numerics.py) define
+        # their flags on first import, and defining must never clobber a
+        # value the user already set
+        value = existing["value"]
     _REGISTRY[name] = {"value": value, "default": default, "help": help_str}
     return value
 
@@ -74,6 +81,18 @@ define_flag("trace_host_sync", "silent",
             "happens inside a jax trace: silent (jax's own tracer error), "
             "warn (explain the sync first), error (raise immediately). "
             "The analysis host-sync pass polices the compiled-in form.")
+define_flag("numerics", False,
+            "numerics telescope (monitor/numerics.py): SpmdTrainer builds "
+            "its step with ONE fused on-device per-layer tensor-health "
+            "aggregation (grad/param norms, update ratio, non-finite "
+            "counts, quantile digest) feeding drift detectors; unset, the "
+            "train step is bit-identical to the un-instrumented one. "
+            "Defined here (not in the numerics module) so the trainer can "
+            "gate on it without importing the telescope at all")
+define_flag("numerics_interval", 1,
+            "with FLAGS_numerics: fetch the on-device stats to the host "
+            "every N train steps (the stats stay device-resident between "
+            "fetches — no new per-step host sync)")
 define_flag("flash_attention_block", 0,
             "force the flash-attention Pallas block size (128/256/512); "
             "0 = auto (largest of 512/256/128 dividing seq). For on-chip "

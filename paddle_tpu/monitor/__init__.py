@@ -55,6 +55,18 @@ __all__ = [
     "STAT_ADD", "STAT_SUB", "STAT_RESET", "blackbox",
 ]
 
+
+def __getattr__(name):   # PEP 562
+    # the numerics telescope loads lazily: a plain (FLAGS_numerics unset)
+    # process must never import it — tests/test_numerics_gate.py pins the
+    # subprocess form of this. Deliberately NOT in __all__: a star-import
+    # resolves every listed name, which would defeat the laziness
+    if name == "numerics":
+        import importlib
+
+        return importlib.import_module(".numerics", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 _flags.define_flag("monitor", True,
                    "runtime telemetry registry on/off; off turns every "
                    "instrumented call site into one boolean check")

@@ -1,0 +1,446 @@
+"""Numerics telescope: fused on-device tensor-health stats + drift detectors.
+
+PRs 2/5/7 made the *system* observable — metrics, spans/MFU, the flight
+recorder. The model interior stayed a black box: the PR 4 non-finite
+guard fires only after a step is already ruined. This module watches the
+numbers themselves, TPU-natively:
+
+**Fused stats** — when ``FLAGS_numerics`` is armed, ``SpmdTrainer._build``
+appends :func:`device_stats` to the existing jitted step: ONE fused
+on-device aggregation producing, per layer, the gradient L2 norm / rms /
+absmax / max, the non-finite element count, the post-update param norm,
+the update norm and update/param ratio, and a small abs-gradient
+quantile digest (p50/p90/p99 over a deterministic strided subsample so
+huge tensors don't pay a full device sort). The stacked result rides the
+step's output tuple — device-resident, replicated — and is fetched to
+host only every ``FLAGS_numerics_interval`` steps under a
+``numerics/fetch`` span (no new per-step host syncs).
+
+**Drift detectors** — :class:`NumericsMonitor` keeps bounded per-series
+history rings with EMA mean/variance baselines and runs anomaly rules on
+every fetch:
+
+- ``grad_spike``  — a layer's grad norm jumps past
+  ``FLAGS_numerics_spike_sigma`` sigmas of its EMA baseline;
+- ``dead_layer``  — a layer's gradient is exactly zero for
+  ``FLAGS_numerics_dead_steps`` consecutive observations;
+- ``update_ratio`` — the update/param ratio leaves the sane band
+  (> ``FLAGS_numerics_ratio_max``) AND sits well above the layer's own
+  EMA baseline (fresh zero-init params legitimately run O(1) ratios
+  through warmup): the step is rewriting the layer;
+- ``nonfinite``   — non-finite elements in a layer's gradient (named
+  *per layer*, before/alongside the PR 4 whole-step guard);
+- ``loss_plateau`` — the loss stops moving across the last
+  ``FLAGS_numerics_plateau_window`` fetches.
+
+Each anomaly increments ``numerics_anomaly_total{kind,layer}``, lands in
+the PR 7 flight-recorder ring (``numerics_anomaly`` note), and the
+monitor registers itself as a blackbox dump provider so a crash bundle
+carries the last model-health snapshot.
+
+Everything is inert-by-default with the PR 2–7 discipline: the trainer
+gates on ``FLAGS_numerics`` (defined in flags.py so the plain path never
+imports this module), the disarmed step is bit-identical, and no
+``numerics_*`` metric/span series exists until armed
+(tests/test_numerics_gate.py pins all of it). The lockstep A/B
+loss-parity harness over these stats lives in
+:mod:`paddle_tpu.testing.parity` (docs/OBSERVABILITY.md "Numerics
+telescope").
+"""
+import collections
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import flags as _flags
+from . import blackbox as _blackbox
+
+__all__ = [
+    "STAT_KEYS", "QUANTILES", "DIGEST_CAP", "MIN_BASELINE_POINTS",
+    "is_armed", "device_stats", "stat_shardings", "NumericsMonitor",
+]
+
+_flags.define_flag(
+    "numerics_history", 64,
+    "per-series history-ring capacity of the numerics drift detectors "
+    "(oldest observations dropped past it)")
+_flags.define_flag(
+    "numerics_spike_sigma", 6.0,
+    "grad-norm spike rule: fire numerics_anomaly_total{kind=grad_spike} "
+    "when a layer's grad norm exceeds its EMA baseline by this many "
+    "(floored) standard deviations")
+_flags.define_flag(
+    "numerics_dead_steps", 3,
+    "dead-layer rule: fire after this many CONSECUTIVE observations of "
+    "an exactly-zero gradient for one layer")
+_flags.define_flag(
+    "numerics_ratio_max", 0.25,
+    "update-ratio band rule: fire when ||update||/||param|| exceeds "
+    "this (the step is rewriting the layer, not nudging it)")
+_flags.define_flag(
+    "numerics_plateau_window", 8,
+    "loss-plateau rule: the loss ring length inspected; a full ring "
+    "whose spread is below numerics_plateau_eps fires once per episode")
+_flags.define_flag(
+    "numerics_plateau_eps", 1e-4,
+    "loss-plateau rule: relative spread (max-min over the window, "
+    "scaled by |mean|) under which the loss counts as flat")
+
+#: keys of the device_stats output dict — the trainer builds the step's
+#: out_shardings for the stats leg from this list, so it is part of the
+#: compiled program's shape contract
+STAT_KEYS = ("grad_norm", "grad_rms", "grad_absmax", "grad_max",
+             "nonfinite", "param_norm", "update_norm", "update_ratio",
+             "quantiles", "loss")
+
+#: abs-gradient quantile digest points (p50/p90/p99)
+QUANTILES = (0.5, 0.9, 0.99)
+
+#: quantile digests over tensors larger than this use a deterministic
+#: strided subsample — a full device sort of an embedding-table gradient
+#: would dominate the step it is meant to observe
+DIGEST_CAP = 4096
+
+#: EMA baselines need this many observations before the spike rule arms
+#: (a 2-point "baseline" would fire on ordinary early-training motion)
+MIN_BASELINE_POINTS = 3
+
+#: the update-ratio rule skips layers whose param norm is below this —
+#: against a ~zero denominator (a fresh zero-init bias) the ratio is
+#: meaningless and would fire on every ordinary step
+RATIO_PARAM_FLOOR = 1e-2
+
+
+def is_armed():
+    """The one master switch (FLAGS_numerics). The trainer reads the
+    flag directly so the disarmed path never imports this module; this
+    helper is for code that already did."""
+    return bool(_flags.get_flag("numerics", False))
+
+
+# -- fused on-device aggregation ----------------------------------------------
+
+def _digest_source(flat):
+    """Deterministic strided subsample for the quantile digest. Ceil
+    division: a floor stride would degenerate to a prefix-only sample
+    for sizes just past the cap, silently blinding the digest to the
+    tail of row-major tensors."""
+    n = flat.shape[0]
+    if n <= DIGEST_CAP:
+        return flat
+    stride = -(-n // DIGEST_CAP)
+    return flat[::stride][:DIGEST_CAP]
+
+
+def device_stats(names, loss, grads, old_params, new_params):
+    """The fused per-layer health aggregation, traced INTO the jitted
+    train step (everything here is jnp on tracers; XLA fuses it with the
+    backward pass it reads from). Returns a dict of stacked float32
+    arrays — one row per layer in ``names`` order — matching
+    :data:`STAT_KEYS`. Computed on the RAW grads/updates, before the PR 4
+    guard's where-select, so a poisoned step still shows WHICH layer
+    went non-finite."""
+    gn, rms, amax, gmax, nonf, pn, un, ratio, digs = \
+        [], [], [], [], [], [], [], [], []
+    qs = jnp.asarray(QUANTILES, jnp.float32)
+    for name in names:
+        g = grads[name].astype(jnp.float32).ravel()
+        p_new = new_params[name].astype(jnp.float32).ravel()
+        p_old = old_params[name].astype(jnp.float32).ravel()
+        size = max(1, g.shape[0] if g.shape else 1)
+        sq = jnp.sum(g * g)
+        norm = jnp.sqrt(sq)
+        gn.append(norm)
+        rms.append(jnp.sqrt(sq / size))
+        ag = jnp.abs(g)
+        amax.append(jnp.max(ag))
+        gmax.append(jnp.max(g))
+        nonf.append(jnp.sum(~jnp.isfinite(g)).astype(jnp.float32))
+        pnorm = jnp.sqrt(jnp.sum(p_new * p_new))
+        upd = p_new - p_old
+        unorm = jnp.sqrt(jnp.sum(upd * upd))
+        pn.append(pnorm)
+        un.append(unorm)
+        ratio.append(unorm / (pnorm + 1e-12))
+        digs.append(jnp.quantile(_digest_source(ag), qs))
+    return {
+        "grad_norm": jnp.stack(gn),
+        "grad_rms": jnp.stack(rms),
+        "grad_absmax": jnp.stack(amax),
+        "grad_max": jnp.stack(gmax),
+        "nonfinite": jnp.stack(nonf),
+        "param_norm": jnp.stack(pn),
+        "update_norm": jnp.stack(un),
+        "update_ratio": jnp.stack(ratio),
+        "quantiles": jnp.stack(digs),           # [layers, len(QUANTILES)]
+        "loss": jnp.asarray(loss, jnp.float32),
+    }
+
+
+def stat_shardings(replicated):
+    """out_shardings leg for the stats dict (everything replicated)."""
+    return {k: replicated for k in STAT_KEYS}
+
+
+# -- metric families (lazy: no numerics_* series until armed) ------------------
+
+_M = None
+
+
+def _metrics():
+    global _M
+    if _M is None:
+        from .. import monitor as _monitor
+
+        _M = {
+            "grad_norm": _monitor.gauge(
+                "numerics_grad_norm",
+                "per-layer gradient L2 norm at the last numerics fetch",
+                labelnames=("layer",)),
+            "param_norm": _monitor.gauge(
+                "numerics_param_norm",
+                "per-layer post-update parameter L2 norm at the last "
+                "numerics fetch", labelnames=("layer",)),
+            "update_ratio": _monitor.gauge(
+                "numerics_update_ratio",
+                "per-layer ||update|| / ||param|| at the last numerics "
+                "fetch (federated rounds report the cohort-weighted "
+                "aggregate under layer='federated/round')",
+                labelnames=("layer",)),
+            "grad_rms": _monitor.gauge(
+                "numerics_grad_rms",
+                "per-layer gradient RMS at the last numerics fetch",
+                labelnames=("layer",)),
+            "grad_absmax": _monitor.gauge(
+                "numerics_grad_absmax",
+                "per-layer max |grad| at the last numerics fetch",
+                labelnames=("layer",)),
+            "loss": _monitor.gauge(
+                "numerics_loss",
+                "loss at the last numerics fetch (the plateau detector's "
+                "input)"),
+            "nonfinite": _monitor.counter(
+                "numerics_nonfinite_total",
+                "non-finite gradient elements seen, by layer (counts "
+                "elements, not steps — one poisoned embedding row reads "
+                "differently than a fully-NaN tensor)",
+                labelnames=("layer",)),
+            "anomaly": _monitor.counter(
+                "numerics_anomaly_total",
+                "drift-detector fires by rule and layer (grad_spike | "
+                "dead_layer | update_ratio | nonfinite | loss_plateau)",
+                labelnames=("kind", "layer")),
+            "fetch_ms": _monitor.histogram(
+                "numerics_fetch_ms",
+                "wall time of one device->host numerics stats fetch "
+                "(every FLAGS_numerics_interval steps)"),
+        }
+    return _M
+
+
+class _Ema:
+    """EMA mean/variance baseline for one (layer, stat) series."""
+
+    __slots__ = ("mean", "var", "n")
+
+    def __init__(self):
+        self.mean = 0.0
+        self.var = 0.0
+        self.n = 0
+
+    def update(self, x, alpha=0.25):
+        if self.n == 0:
+            self.mean = x
+            self.var = 0.0
+        else:
+            diff = x - self.mean
+            incr = alpha * diff
+            self.mean += incr
+            self.var = (1.0 - alpha) * (self.var + diff * incr)
+        self.n += 1
+
+    def std(self):
+        return math.sqrt(max(self.var, 0.0))
+
+
+class NumericsMonitor:
+    """Host-side half of the telescope: per-layer history rings, EMA
+    baselines, the anomaly rules, and the metric/blackbox surfacing.
+    One per SpmdTrainer (created lazily on the first armed fetch) or per
+    FederatedAverager; registers itself as a blackbox dump provider so
+    every crash/stall bundle carries the last model-health snapshot."""
+
+    def __init__(self, layers, source="trainer"):
+        self.layers = [str(n) for n in layers]
+        self.source = str(source)
+        maxlen = max(2, int(_flags.get_flag("numerics_history", 64)))
+        self._hist = collections.defaultdict(
+            lambda: collections.deque(maxlen=maxlen))   # (layer, stat) ->
+        self._ema = {}                                  # (layer, stat) -> _Ema
+        self._dead = {}                                 # layer -> zero streak
+        self._plateau_active = False
+        self.anomalies = collections.deque(maxlen=64)
+        self.fetches = 0
+        self.last_step = None
+        self.last_loss = None
+        self._last = {}          # layer -> {stat: float} (latest snapshot)
+        _blackbox.register_provider("numerics", self,
+                                    lambda m: m.snapshot())
+
+    # -- ring/baseline plumbing -------------------------------------------
+    def history(self, layer, stat):
+        """The bounded observation ring for one (layer, stat) series."""
+        return list(self._hist[(layer, stat)])
+
+    def _push(self, layer, stat, value):
+        self._hist[(layer, stat)].append(value)
+
+    def _baseline(self, layer, stat):
+        key = (layer, stat)
+        ema = self._ema.get(key)
+        if ema is None:
+            ema = self._ema[key] = _Ema()
+        return ema
+
+    def _fire(self, kind, layer, step, value, baseline=None):
+        rec = {"kind": kind, "layer": layer, "step": step,
+               "value": None if value is None else float(value)}
+        if baseline is not None:
+            rec["baseline"] = float(baseline)
+        self.anomalies.append(rec)
+        from .. import monitor as _monitor
+
+        if _monitor.is_enabled():
+            _metrics()["anomaly"].labels(kind=kind, layer=layer).inc()
+        _blackbox.note("numerics_anomaly", source=self.source, rule=kind,
+                       layer=layer, step=step, value=rec["value"])
+        return rec
+
+    # -- the fetch entry point --------------------------------------------
+    def observe(self, host_stats, step):
+        """Ingest one host-fetched stats dict ({stat: np.ndarray row per
+        layer in self.layers order}; missing keys tolerated — the
+        federated path reports a partial set). Updates gauges and rings,
+        runs every detector, returns the list of NEW anomalies."""
+        from .. import monitor as _monitor
+
+        step = int(step)
+        self.fetches += 1
+        self.last_step = step
+        fired = []
+        per_layer = {k: np.asarray(v) for k, v in host_stats.items()
+                     if k in STAT_KEYS and k != "loss"}
+        loss = host_stats.get("loss")
+        mon = _monitor.is_enabled()
+        m = _metrics() if mon else None
+        spike_sigma = float(_flags.get_flag("numerics_spike_sigma", 6.0))
+        dead_steps = max(1, int(_flags.get_flag("numerics_dead_steps", 3)))
+        ratio_max = float(_flags.get_flag("numerics_ratio_max", 0.25))
+
+        for i, layer in enumerate(self.layers):
+            snap = self._last.setdefault(layer, {})
+            for stat, arr in per_layer.items():
+                if i >= len(arr):
+                    continue
+                val = arr[i]
+                if stat == "quantiles":
+                    snap["quantiles"] = [float(q) for q in
+                                         np.asarray(val).ravel()]
+                    continue
+                val = float(val)
+                snap[stat] = val
+                if mon and stat in ("grad_norm", "param_norm",
+                                    "update_ratio", "grad_rms",
+                                    "grad_absmax"):
+                    m[stat].labels(layer=layer).set(
+                        val if math.isfinite(val) else -1.0)
+            # ---- detectors (per layer) --------------------------------
+            gn = snap.get("grad_norm")
+            if gn is not None:
+                base = self._baseline(layer, "grad_norm")
+                if base.n >= MIN_BASELINE_POINTS and math.isfinite(gn):
+                    floor = max(base.std(), 0.05 * abs(base.mean), 1e-9)
+                    if gn > base.mean + spike_sigma * floor:
+                        fired.append(self._fire(
+                            "grad_spike", layer, step, gn,
+                            baseline=base.mean))
+                if math.isfinite(gn):
+                    base.update(gn)
+                self._push(layer, "grad_norm", gn)
+                # dead layer: EXACT zero — an optimizer that unhooked a
+                # layer produces true zeros, not small floats
+                if gn == 0.0:
+                    streak = self._dead.get(layer, 0) + 1
+                    self._dead[layer] = streak
+                    if streak == dead_steps:
+                        fired.append(self._fire(
+                            "dead_layer", layer, step, 0.0))
+                else:
+                    self._dead[layer] = 0
+            ratio = snap.get("update_ratio")
+            if ratio is not None:
+                self._push(layer, "update_ratio", ratio)
+                base = self._baseline(layer, "update_ratio")
+                pnorm = snap.get("param_norm")
+                # out of band AND well above the layer's own baseline: a
+                # fresh zero-init param legitimately runs ratios of O(1)
+                # for its first steps (norm growing from nothing), so the
+                # absolute band alone would cry wolf through warmup
+                if (base.n >= MIN_BASELINE_POINTS
+                        and math.isfinite(ratio) and ratio > ratio_max
+                        and ratio > 3.0 * abs(base.mean)
+                        and (pnorm is None or pnorm > RATIO_PARAM_FLOOR)):
+                    fired.append(self._fire(
+                        "update_ratio", layer, step, ratio,
+                        baseline=base.mean))
+                if math.isfinite(ratio):
+                    base.update(ratio)
+            nonf = snap.get("nonfinite")
+            if nonf:
+                if mon:
+                    m["nonfinite"].labels(layer=layer).inc(nonf)
+                fired.append(self._fire("nonfinite", layer, step, nonf))
+
+        # ---- loss plateau (whole model) -------------------------------
+        if loss is not None:
+            loss = float(np.asarray(loss))
+            self.last_loss = loss
+            if mon:
+                m["loss"].set(loss if math.isfinite(loss) else -1.0)
+            ring = self._hist[("loss", "loss")]
+            ring.append(loss)
+            # window clamped to the ring's capacity: a window larger
+            # than numerics_history could otherwise never fill and the
+            # plateau rule would be silently dead
+            window = max(2, min(
+                int(_flags.get_flag("numerics_plateau_window", 8)),
+                ring.maxlen))
+            eps = float(_flags.get_flag("numerics_plateau_eps", 1e-4))
+            tail = list(ring)[-window:]
+            if len(tail) >= window and all(math.isfinite(v) for v in tail):
+                spread = max(tail) - min(tail)
+                scale = max(abs(sum(tail) / len(tail)), 1e-6)
+                if spread <= eps * scale:
+                    if not self._plateau_active:
+                        self._plateau_active = True
+                        fired.append(self._fire(
+                            "loss_plateau", "loss", step, loss,
+                            baseline=spread))
+                else:
+                    self._plateau_active = False
+        return fired
+
+    # -- surfacing ---------------------------------------------------------
+    def snapshot(self):
+        """JSON-able model-health snapshot: the blackbox dump provider
+        table and ``SpmdTrainer.stats()["numerics"]``."""
+        return {
+            "source": self.source,
+            "step": self.last_step,
+            "loss": self.last_loss,
+            "fetches": self.fetches,
+            "layers": {layer: dict(stats)
+                       for layer, stats in self._last.items()},
+            "anomalies": list(self.anomalies)[-10:],
+        }

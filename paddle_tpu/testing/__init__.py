@@ -5,8 +5,23 @@ sites planted in the runtime's recovery-critical paths (checkpoint write/read,
 executor compile, collectives, the serving step loop) that are a single
 boolean check when disabled and inject errors/delays/kills when armed via
 ``FLAGS_failpoints`` or ``failpoints.scoped(...)``.
+
+`parity` is the lockstep A/B loss-parity harness (docs/OBSERVABILITY.md
+"Numerics telescope"): two trainers over identical batches under a
+reference vs candidate flag-set, per-step loss + grad-stat divergence
+asserted within declared tolerances. Loaded lazily — importing the
+failpoint framework must not pull the numerics telescope along.
 """
 from . import failpoints  # noqa: F401
 from .failpoints import FailpointError, failpoint  # noqa: F401
 
 __all__ = ["failpoints", "failpoint", "FailpointError"]
+
+
+def __getattr__(name):   # PEP 562: lazy parity-harness loading — NOT in
+    # __all__ (a star-import would resolve it and defeat the laziness)
+    if name == "parity":
+        import importlib
+
+        return importlib.import_module(".parity", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -39,8 +39,9 @@ from .. import flags as _flags
 from .. import monitor as _monitor
 
 __all__ = [
-    "SITES", "FailpointError", "failpoint", "arm", "disarm", "reset",
-    "armed", "hits", "is_enabled", "scoped", "parse", "arm_from_flag",
+    "SITES", "FailpointError", "failpoint", "transform", "arm", "disarm",
+    "reset", "armed", "hits", "is_enabled", "scoped", "parse",
+    "arm_from_flag",
 ]
 
 _flags.define_flag(
@@ -65,6 +66,12 @@ SITES = {
                     "(reason='error'), batch-mates continue",
     "trainer/step": "SpmdTrainer.train_step — before the compiled step "
                     "dispatches",
+    "trainer/batch": "SpmdTrainer.train_step — the batch arrays on their "
+                     "way into the compiled step; a scale:F action "
+                     "multiplies every FLOAT array by F (scale:nan "
+                     "poisons them) so chaos tests can inject a gradient "
+                     "spike or a non-finite step with real data flow "
+                     "(integer arrays — token ids — pass untouched)",
     "federated/round": "federated.FederatedAverager — each client's local "
                        "update inside a round; an injected error drops "
                        "that client (federated_client_dropped_total) and "
@@ -88,6 +95,8 @@ class _Action:
     def spec(self):
         if self.kind == "delay":
             return f"delay:{self.arg:g}"
+        if self.kind == "scale":
+            return f"scale:{self.arg:g}"
         if self.kind == "error" and self.remaining is not None:
             return f"error:{self.remaining}"
         return self.kind
@@ -121,8 +130,13 @@ def _parse_action(site, text):
         return _Action("delay", arg=ms)
     if kind == "kill":
         return _Action("kill")
+    if kind == "scale":
+        if not arg:
+            raise ValueError(f"failpoint {site}: scale needs a factor "
+                             "(scale:F — float('nan') poisons)")
+        return _Action("scale", arg=float(arg))   # float() accepts 'nan'
     raise ValueError(f"failpoint {site}: unknown action {text!r} "
-                     "(expected error[:N] | delay:MS | kill)")
+                     "(expected error[:N] | delay:MS | scale:F | kill)")
 
 
 def parse(spec):
@@ -246,10 +260,46 @@ def failpoint(site):
     _fire(site)
 
 
+def transform(site, value):
+    """Value-transforming failpoint: plant where data flows through a
+    site. Disabled (nothing armed anywhere): one boolean check, `value`
+    returned untouched. A ``scale:F`` action multiplies every FLOAT
+    array in `value` (a single array, or a list/tuple of them) by F —
+    ``scale:nan`` poisons them into a non-finite step — while integer
+    arrays pass through unchanged; any other armed action behaves
+    exactly as :func:`failpoint` (error raises, delay sleeps) before
+    `value` is returned."""
+    if not _ENABLED:
+        return value
+    with _LOCK:
+        act = _ARMED.get(site)
+        scale = act is not None and act.kind == "scale"
+        if scale:
+            _HITS[site] = _HITS.get(site, 0) + 1
+            factor = act.arg
+    if not scale:
+        _fire(site)
+        return value
+    _note_fire(site, "scale")
+    import numpy as _np
+
+    def _scaled(a):
+        dt = getattr(a, "dtype", None)
+        if dt is None or not _np.issubdtype(_np.dtype(dt), _np.floating):
+            return a
+        return a * _np.asarray(factor).astype(dt)
+
+    if isinstance(value, (list, tuple)):
+        return type(value)(_scaled(a) for a in value)
+    return _scaled(value)
+
+
 def _fire(site):
     with _LOCK:
         act = _ARMED.get(site)
-        if act is None:
+        if act is None or act.kind == "scale":
+            # scale actions only act through transform(); a plain
+            # failpoint() at the same site must not consume or crash
             return
         if act.remaining is not None and act.remaining <= 0:
             # an exhausted error:N re-armed by scoped()'s restore (the

@@ -46,6 +46,57 @@ class TestSpecParsing:
         assert fp.parse("") == {}
         assert fp.parse(" , ") == {}
 
+    def test_parse_scale_action(self):
+        acts = fp.parse("trainer/batch=scale:1e4")
+        assert acts["trainer/batch"].kind == "scale"
+        assert acts["trainer/batch"].arg == 1e4
+        import math
+
+        assert math.isnan(fp.parse("trainer/batch=scale:nan")
+                          ["trainer/batch"].arg)
+        with pytest.raises(ValueError, match="scale needs"):
+            fp.parse("trainer/batch=scale")
+
+
+class TestTransformSite:
+    """transform(): the value-transforming failpoint form
+    (docs/ROBUSTNESS.md scale:F) — floats scaled, ints untouched,
+    disarmed = identity, non-scale actions fire as usual."""
+
+    def test_disarmed_identity(self):
+        val = [np.ones(3, np.float32)]
+        assert fp.transform("trainer/batch", val) is val
+
+    def test_scale_floats_only_and_counts_hits(self):
+        with fp.scoped("trainer/batch=scale:2"):
+            out = fp.transform("trainer/batch",
+                               (np.full(3, 1.5, np.float32),
+                                np.arange(3, dtype=np.int32)))
+        assert isinstance(out, tuple)
+        np.testing.assert_array_equal(out[0], np.full(3, 3.0))
+        np.testing.assert_array_equal(out[1], np.arange(3))
+        assert out[1].dtype == np.int32
+        assert fp.hits("trainer/batch") == 1
+
+    def test_scale_nan_poisons(self):
+        with fp.scoped("trainer/batch=scale:nan"):
+            (out,) = fp.transform("trainer/batch",
+                                  [np.ones(4, np.float32)])
+        assert np.isnan(out).all()
+
+    def test_error_action_fires_through_transform(self):
+        with fp.scoped("trainer/batch=error:1"):
+            with pytest.raises(FailpointError):
+                fp.transform("trainer/batch", [np.ones(2)])
+
+    def test_plain_failpoint_ignores_scale_arming(self):
+        with fp.scoped("trainer/batch=scale:3"):
+            fp.failpoint("trainer/batch")   # no raise, no hit consumed
+            assert fp.hits("trainer/batch") == 0
+
+    def test_trainer_batch_site_registered(self):
+        assert "trainer/batch" in fp.SITES
+
 
 class TestArming:
     def test_arm_disarm_round_trip(self):

@@ -1,0 +1,544 @@
+"""Numerics telescope unit coverage (ISSUE 9): fused on-device stat
+correctness vs numpy on known tensors, drift-detector positive/negative
+cases, history-ring bounds, blackbox-bundle inclusion, trainer/federated
+integration, and the lockstep A/B parity harness."""
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor, nn, trace
+from paddle_tpu.monitor import blackbox, numerics
+from paddle_tpu.testing import failpoints as fp
+from paddle_tpu.testing import parity
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    monitor.reset()
+    fp.reset()
+    yield
+    paddle.set_flags({"numerics": False, "numerics_interval": 1,
+                      "check_nan_inf": False})
+    monitor.reset()
+    fp.reset()
+
+
+def _mesh1():
+    from paddle_tpu.distributed.mesh import build_mesh
+
+    return build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+
+
+def _linear_trainer(lr=0.05, model_dims=(8, 4)):
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+
+    paddle.seed(0)
+    model = nn.Linear(*model_dims)
+    opt = paddle.optimizer.SGD(learning_rate=lr,
+                               parameters=model.parameters())
+    return SpmdTrainer(model, opt, loss_fn=nn.MSELoss(), mesh=_mesh1())
+
+
+def _batch(rows=4, din=8, dout=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(rows, din).astype(np.float32),
+            rng.randn(rows, dout).astype(np.float32))
+
+
+class TestDeviceStats:
+    """The fused aggregation agrees with numpy on known tensors."""
+
+    def test_stats_match_numpy(self):
+        g = np.array([[3.0, -4.0], [0.5, 0.0]], np.float32)
+        old = np.array([[1.0, 1.0], [1.0, 1.0]], np.float32)
+        new = np.array([[1.1, 0.9], [1.0, 1.0]], np.float32)
+        out = numerics.device_stats(
+            ["w"], jnp.float32(2.5), {"w": jnp.asarray(g)},
+            {"w": jnp.asarray(old)}, {"w": jnp.asarray(new)})
+        assert set(out) == set(numerics.STAT_KEYS)
+        np.testing.assert_allclose(out["grad_norm"],
+                                   [np.linalg.norm(g)], rtol=1e-6)
+        np.testing.assert_allclose(out["grad_rms"],
+                                   [np.sqrt(np.mean(g ** 2))], rtol=1e-6)
+        np.testing.assert_allclose(out["grad_absmax"], [4.0])
+        np.testing.assert_allclose(out["grad_max"], [3.0])
+        np.testing.assert_allclose(out["nonfinite"], [0.0])
+        np.testing.assert_allclose(out["param_norm"],
+                                   [np.linalg.norm(new)], rtol=1e-6)
+        upd = np.linalg.norm(new - old)
+        np.testing.assert_allclose(out["update_norm"], [upd], rtol=1e-6)
+        np.testing.assert_allclose(
+            out["update_ratio"], [upd / (np.linalg.norm(new) + 1e-12)],
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            out["quantiles"][0],
+            np.quantile(np.abs(g).ravel(), numerics.QUANTILES), rtol=1e-5)
+        np.testing.assert_allclose(out["loss"], 2.5)
+
+    def test_nonfinite_counts_elements(self):
+        g = np.array([np.nan, np.inf, 1.0, -np.inf], np.float32)
+        p = np.ones(4, np.float32)
+        out = numerics.device_stats(
+            ["w"], jnp.float32(0.0), {"w": jnp.asarray(g)},
+            {"w": jnp.asarray(p)}, {"w": jnp.asarray(p)})
+        assert float(out["nonfinite"][0]) == 3.0
+
+    def test_multi_layer_rows_follow_name_order(self):
+        gs = {"a": jnp.ones((2,)), "b": jnp.full((3,), 2.0)}
+        ps = {"a": jnp.zeros((2,)), "b": jnp.zeros((3,))}
+        out = numerics.device_stats(["b", "a"], jnp.float32(0.0),
+                                    gs, ps, ps)
+        np.testing.assert_allclose(
+            out["grad_norm"],
+            [np.linalg.norm([2.0] * 3), np.linalg.norm([1.0] * 2)],
+            rtol=1e-6)
+
+    def test_digest_subsample_spans_the_whole_tensor(self):
+        """Just past the cap, the stride must still cover the tail — a
+        floor stride would quietly sample only the tensor's prefix."""
+        n = numerics.DIGEST_CAP + 10
+        src = np.asarray(numerics._digest_source(jnp.arange(n)))
+        assert len(src) <= numerics.DIGEST_CAP
+        assert src.max() >= n - 2   # the sample reaches the tail
+
+    def test_digest_subsample_is_deterministic(self):
+        rng = np.random.RandomState(0)
+        g = rng.randn(numerics.DIGEST_CAP * 4).astype(np.float32)
+        p = np.zeros_like(g)
+        a = numerics.device_stats(["w"], jnp.float32(0.0),
+                                  {"w": jnp.asarray(g)},
+                                  {"w": jnp.asarray(p)},
+                                  {"w": jnp.asarray(p)})
+        b = numerics.device_stats(["w"], jnp.float32(0.0),
+                                  {"w": jnp.asarray(g)},
+                                  {"w": jnp.asarray(p)},
+                                  {"w": jnp.asarray(p)})
+        np.testing.assert_array_equal(np.asarray(a["quantiles"]),
+                                      np.asarray(b["quantiles"]))
+
+
+def _obs(gn=1.0, ratio=0.01, pn=10.0, nonf=0.0, loss=None):
+    host = {"grad_norm": np.asarray([gn], np.float32),
+            "update_ratio": np.asarray([ratio], np.float32),
+            "param_norm": np.asarray([pn], np.float32),
+            "nonfinite": np.asarray([nonf], np.float32)}
+    if loss is not None:
+        host["loss"] = np.float32(loss)
+    return host
+
+
+class TestDetectors:
+    def test_grad_spike_fires_and_names_layer(self):
+        mon = numerics.NumericsMonitor(["lyr"])
+        for i in range(5):
+            assert mon.observe(_obs(gn=1.0 + 0.01 * i), step=i) == []
+        fired = mon.observe(_obs(gn=100.0), step=5)
+        kinds = {(a["kind"], a["layer"]) for a in fired}
+        assert ("grad_spike", "lyr") in kinds
+        reg = monitor.default_registry().get("numerics_anomaly_total")
+        assert reg.labels(kind="grad_spike", layer="lyr").value == 1
+
+    def test_steady_training_never_fires(self):
+        mon = numerics.NumericsMonitor(["lyr"])
+        rng = np.random.RandomState(0)
+        for i in range(30):
+            fired = mon.observe(
+                _obs(gn=1.0 + 0.05 * rng.randn(),
+                     loss=2.0 - 0.05 * i), step=i)
+            assert fired == [], fired
+
+    def test_spike_needs_baseline_warmup(self):
+        mon = numerics.NumericsMonitor(["lyr"])
+        fired = mon.observe(_obs(gn=1000.0), step=0)
+        assert not any(a["kind"] == "grad_spike" for a in fired)
+
+    def test_dead_layer_streak_fires_once_and_rearms(self):
+        paddle.set_flags({"numerics_dead_steps": 3})
+        try:
+            mon = numerics.NumericsMonitor(["lyr"])
+            fired = []
+            for i in range(5):
+                fired += mon.observe(_obs(gn=0.0), step=i)
+            dead = [a for a in fired if a["kind"] == "dead_layer"]
+            assert len(dead) == 1 and dead[0]["layer"] == "lyr"
+            mon.observe(_obs(gn=1.0), step=5)   # recovery resets streak
+            fired = []
+            for i in range(6, 9):
+                fired += mon.observe(_obs(gn=0.0), step=i)
+            assert sum(a["kind"] == "dead_layer" for a in fired) == 1
+        finally:
+            paddle.set_flags({"numerics_dead_steps": 3})
+
+    def test_update_ratio_band(self):
+        mon = numerics.NumericsMonitor(["lyr"])
+        for i in range(4):
+            assert mon.observe(_obs(ratio=0.01), step=i) == []
+        fired = mon.observe(_obs(ratio=0.9), step=4)
+        assert any(a["kind"] == "update_ratio" for a in fired)
+
+    def test_update_ratio_ignores_fresh_zeroish_params(self):
+        """A fresh zero-init param runs O(1) ratios through warmup — the
+        rule must not cry wolf on it."""
+        mon = numerics.NumericsMonitor(["bias"])
+        fired = []
+        for i, r in enumerate((1.0, 0.5, 0.35, 0.3)):
+            fired += mon.observe(_obs(ratio=r, pn=0.05 * (i + 1)),
+                                 step=i)
+        assert not any(a["kind"] == "update_ratio" for a in fired), fired
+
+    def test_nonfinite_fires_and_counts_elements(self):
+        mon = numerics.NumericsMonitor(["lyr"])
+        fired = mon.observe(_obs(gn=float("nan"), nonf=7.0), step=0)
+        assert any(a["kind"] == "nonfinite" for a in fired)
+        reg = monitor.default_registry().get("numerics_nonfinite_total")
+        assert reg.labels(layer="lyr").value == 7.0
+
+    def test_loss_plateau_fires_once_per_episode(self):
+        paddle.set_flags({"numerics_plateau_window": 4})
+        try:
+            mon = numerics.NumericsMonitor(["lyr"])
+            fired = []
+            for i in range(8):
+                fired += mon.observe(_obs(loss=1.2345), step=i)
+            plateaus = [a for a in fired if a["kind"] == "loss_plateau"]
+            assert len(plateaus) == 1 and plateaus[0]["layer"] == "loss"
+            # motion clears the episode; a second flat stretch re-fires
+            for i in range(8, 12):
+                mon.observe(_obs(loss=1.0 - 0.2 * i), step=i)
+            fired = []
+            for i in range(12, 18):
+                fired += mon.observe(_obs(loss=0.5), step=i)
+            assert sum(a["kind"] == "loss_plateau" for a in fired) == 1
+        finally:
+            paddle.set_flags({"numerics_plateau_window": 8})
+
+    def test_loss_plateau_window_clamped_to_history(self):
+        """A window larger than the ring could never fill — the rule
+        clamps to ring capacity instead of going silently dead."""
+        paddle.set_flags({"numerics_history": 4,
+                          "numerics_plateau_window": 64})
+        try:
+            mon = numerics.NumericsMonitor(["lyr"])
+            fired = []
+            for i in range(6):
+                fired += mon.observe(_obs(loss=3.14), step=i)
+            assert any(a["kind"] == "loss_plateau" for a in fired)
+        finally:
+            paddle.set_flags({"numerics_history": 64,
+                              "numerics_plateau_window": 8})
+
+    def test_history_ring_is_bounded(self):
+        paddle.set_flags({"numerics_history": 8})
+        try:
+            mon = numerics.NumericsMonitor(["lyr"])
+            for i in range(50):
+                mon.observe(_obs(gn=float(i)), step=i)
+            ring = mon.history("lyr", "grad_norm")
+            assert len(ring) == 8
+            assert ring[-1] == 49.0
+            assert len(mon.anomalies) <= 64
+        finally:
+            paddle.set_flags({"numerics_history": 64})
+
+    def test_snapshot_is_json_able(self):
+        import json
+
+        mon = numerics.NumericsMonitor(["lyr"])
+        mon.observe(_obs(gn=float("nan"), nonf=1.0, loss=2.0), step=0)
+        snap = mon.snapshot()
+        assert snap["layers"]["lyr"]["nonfinite"] == 1.0
+        json.dumps(snap, default=str)   # must not raise
+
+
+class TestBlackboxInclusion:
+    def test_bundle_carries_numerics_snapshot(self, tmp_path):
+        was = blackbox.is_enabled()
+        blackbox.enable(install=False)
+        try:
+            mon = numerics.NumericsMonitor(["lyr"], source="test")
+            mon.observe(_obs(gn=3.0, loss=1.5), step=7)
+            path = blackbox.dump("signal", site="test",
+                                 dir_=str(tmp_path))
+            bundle = blackbox.load_bundle(path)
+            tables = [t for t in bundle["requests"]
+                      if t.get("kind") == "numerics"]
+            assert tables, bundle["requests"]
+            table = tables[-1]["table"]
+            assert table["source"] == "test"
+            assert table["layers"]["lyr"]["grad_norm"] == 3.0
+        finally:
+            blackbox.reset()
+            if not was:
+                blackbox.disable()
+
+    def test_anomaly_lands_in_flight_recorder_ring(self):
+        was = blackbox.is_enabled()
+        blackbox.enable(install=False)
+        try:
+            mon = numerics.NumericsMonitor(["lyr"])
+            mon.observe(_obs(nonf=2.0), step=0)
+            kinds = [r for r in blackbox.ring()
+                     if r["kind"] == "numerics_anomaly"]
+            assert kinds and kinds[-1]["rule"] == "nonfinite"
+            assert kinds[-1]["layer"] == "lyr"
+        finally:
+            blackbox.reset()
+            if not was:
+                blackbox.disable()
+
+
+class TestTrainerIntegration:
+    def test_interval_batches_host_fetches(self):
+        paddle.set_flags({"numerics": True, "numerics_interval": 3})
+        tr = _linear_trainer()
+        x, y = _batch()
+        tr.train_step(x, y)
+        tr.train_step(x, y)
+        assert tr.stats()["numerics"] is None      # no fetch yet
+        tr.train_step(x, y)                        # 3rd step: fetch
+        snap = tr.stats()["numerics"]
+        assert snap is not None and snap["fetches"] == 1
+        assert set(snap["layers"]) == {"weight", "bias"}
+
+    def test_fetch_span_and_metric_families(self):
+        paddle.set_flags({"numerics": True, "numerics_interval": 1})
+        trace.clear()
+        trace.enable()
+        try:
+            tr = _linear_trainer()
+            x, y = _batch()
+            tr.train_step(x, y)
+        finally:
+            trace.disable()
+        assert "numerics/fetch" in {s.name for s in trace.spans()}
+        reg = monitor.default_registry()
+        for fam in ("numerics_grad_norm", "numerics_update_ratio",
+                    "numerics_param_norm", "numerics_fetch_ms"):
+            metric = reg.get(fam)
+            assert metric is not None and list(metric.series()), fam
+
+    def test_stats_rows_align_with_sorted_param_names(self):
+        """The jit returns dict pytrees key-sorted; the telescope's row
+        order must match its layer-name order regardless."""
+        paddle.set_flags({"numerics": True, "numerics_interval": 1})
+        tr = _linear_trainer()
+        x, y = _batch()
+        for _ in range(2):
+            tr.train_step(x, y)
+        snap = tr.stats()["numerics"]
+        host = tr.numerics_fetch()
+        layers = sorted(tr.params)
+        for i, name in enumerate(layers):
+            assert snap["layers"][name]["grad_norm"] == pytest.approx(
+                float(host["grad_norm"][i]))
+        # the bias (dim 4) and weight (8x4) have different param norms —
+        # misaligned rows would swap these
+        w = np.asarray(tr.params["weight"])
+        assert snap["layers"]["weight"]["param_norm"] == pytest.approx(
+            float(np.linalg.norm(w)), rel=1e-5)
+
+    def test_numerics_fetch_idempotent_per_step(self):
+        paddle.set_flags({"numerics": True, "numerics_interval": 1})
+        tr = _linear_trainer()
+        x, y = _batch()
+        tr.train_step(x, y)
+        assert tr.stats()["numerics"]["fetches"] == 1
+        tr.numerics_fetch()
+        tr.numerics_fetch()
+        assert tr.stats()["numerics"]["fetches"] == 1   # no re-observe
+
+    def test_guarded_step_reports_poisoned_layers(self):
+        """check_nan_inf + numerics: the skipped step still fetches
+        stats naming WHICH layer went non-finite."""
+        paddle.set_flags({"numerics": True, "numerics_interval": 1,
+                          "check_nan_inf": True})
+        tr = _linear_trainer()
+        x, y = _batch()
+        for _ in range(2):
+            tr.train_step(x, y)
+        with fp.scoped("trainer/batch=scale:nan"):
+            tr.train_step(x, y)
+        snap = tr.stats()["numerics"]
+        assert tr.stats()["breakdown"]["nonfinite_skipped_total"] == 1
+        nonf = [a for a in snap["anomalies"]
+                if a["kind"] == "nonfinite"]
+        assert nonf and all(a["layer"] in ("weight", "bias")
+                            for a in nonf)
+        # anomalies carry the OPTIMIZER step clock (same as the spans),
+        # even though the guard skip did not advance it
+        assert all(a["step"] == tr.optimizer._step_count for a in nonf)
+
+    def test_spike_detector_fires_before_guard(self):
+        """The chaos_check numerics_anomaly scenario in unit form: a
+        finite 1e4x spike fires the detector while the guard stays
+        silent; the nan step after trips the guard."""
+        paddle.set_flags({"numerics": True, "numerics_interval": 1,
+                          "check_nan_inf": True})
+        tr = _linear_trainer()
+        x, y = _batch()
+        for _ in range(4):
+            tr.train_step(x, y)
+        assert not tr._numerics.anomalies
+        with fp.scoped("trainer/batch=scale:10000"):
+            tr.train_step(x, y)
+        assert any(a["kind"] == "grad_spike"
+                   for a in tr._numerics.anomalies)
+        assert tr.stats()["breakdown"]["nonfinite_skipped_total"] == 0
+        with fp.scoped("trainer/batch=scale:nan"):
+            tr.train_step(x, y)
+        assert tr.stats()["breakdown"]["nonfinite_skipped_total"] == 1
+
+    def test_toggling_flag_recompiles_not_misunpacks(self):
+        tr = _linear_trainer()
+        x, y = _batch()
+        tr.train_step(x, y)
+        paddle.set_flags({"numerics": True, "numerics_interval": 1})
+        tr.train_step(x, y)          # new exec key: recompile, no crash
+        assert tr.stats()["numerics"]["fetches"] == 1
+        paddle.set_flags({"numerics": False})
+        loss = tr.train_step(x, y)
+        assert math.isfinite(float(np.asarray(loss._data)))
+
+
+class TestFailpointScaleAction:
+    def test_parse_and_spec_roundtrip(self):
+        acts = fp.parse("trainer/batch=scale:2.5")
+        assert acts["trainer/batch"].spec() == "scale:2.5"
+        acts = fp.parse("trainer/batch=scale:nan")
+        assert math.isnan(acts["trainer/batch"].arg)
+        with pytest.raises(ValueError):
+            fp.parse("trainer/batch=scale")
+
+    def test_transform_scales_floats_only(self):
+        with fp.scoped("trainer/batch=scale:2"):
+            out = fp.transform("trainer/batch",
+                               [np.ones(3, np.float32),
+                                np.ones(3, np.int32)])
+        np.testing.assert_array_equal(out[0], 2 * np.ones(3))
+        np.testing.assert_array_equal(out[1], np.ones(3, np.int32))
+        assert out[1].dtype == np.int32
+        assert fp.hits("trainer/batch") == 1
+
+    def test_transform_disarmed_is_identity(self):
+        x = [np.ones(3, np.float32)]
+        out = fp.transform("trainer/batch", x)
+        assert out is x
+
+    def test_transform_fires_error_actions_too(self):
+        with fp.scoped("trainer/batch=error:1"):
+            with pytest.raises(fp.FailpointError):
+                fp.transform("trainer/batch", [np.ones(2)])
+
+    def test_plain_failpoint_ignores_scale(self):
+        with fp.scoped("trainer/batch=scale:3"):
+            fp.failpoint("trainer/batch")   # must not raise or consume
+            assert fp.hits("trainer/batch") == 0
+
+
+class TestFederatedWiring:
+    def _averager(self):
+        from paddle_tpu.federated import FederatedAverager
+
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        net = nn.Linear(6, 3)
+        X = rng.randn(8, 6).astype(np.float32)
+        Y = rng.randn(8, 3).astype(np.float32)
+        data = [[(X[:4], Y[:4])], [(X[4:], Y[4:])]]
+        return FederatedAverager(net, nn.MSELoss(), data, local_steps=1,
+                                 local_lr=0.05, seed=0)
+
+    def test_round_reports_through_numerics_path(self):
+        paddle.set_flags({"numerics": True})
+        fed = self._averager()
+        fed.run(2)
+        snap = fed._numerics.snapshot()
+        assert snap["source"] == "federated"
+        row = snap["layers"]["federated/round"]
+        assert row["grad_norm"] > 0 and 0 <= row["update_ratio"] < 1
+        reg = monitor.default_registry().get("numerics_update_ratio")
+        assert reg.labels(layer="federated/round").value == pytest.approx(
+            row["update_ratio"])
+
+    def test_plain_round_stays_dark(self):
+        fed = self._averager()
+        fed.run(1)
+        assert fed._numerics is None
+        reg = monitor.default_registry().get("numerics_update_ratio")
+        assert reg is None or not any(
+            s.labels.get("layer") == "federated/round"
+            for s in reg.series())
+
+
+class TestParityHarness:
+    def _build(self, lr=0.05):
+        def f():
+            return _linear_trainer(lr=lr)
+        return f
+
+    def _batches(self, n=3):
+        return [_batch(seed=i) for i in range(n)]
+
+    def test_identical_configs_pass_exact(self):
+        report = parity.run_parity(self._build(), self._batches(),
+                                   loss_rtol=0.0, loss_atol=0.0)
+        assert not report["diverged"]
+        assert report["max_abs_loss_diff"] == 0.0
+        assert parity.assert_parity(report) is report
+
+    def test_lr_perturbation_diverges_and_names_step_stat(self):
+        report = parity.run_parity(
+            self._build(), self._batches(),
+            build_candidate=self._build(lr=0.5),
+            loss_rtol=0.0, loss_atol=0.0)
+        assert report["diverged"]
+        d = report["first_divergence"]
+        assert d["stat"] in ("loss",) + parity.STAT_COMPARE_KEYS
+        with pytest.raises(parity.ParityDivergence) as e:
+            parity.assert_parity(report)
+        assert f"step {d['step']}" in str(e.value)
+        assert d["stat"] in str(e.value)
+
+    def test_declared_band_absorbs_small_divergence(self):
+        report = parity.run_parity(
+            self._build(), self._batches(),
+            build_candidate=self._build(lr=0.05000001),
+            loss_rtol=1e-3, loss_atol=1e-3, stat_rtol=0.05,
+            stat_atol=0.05)
+        assert not report["diverged"], report["first_divergence"]
+
+    def test_flag_scope_undefines_introduced_flags(self):
+        """A flag the scope INTRODUCED (its defining module not yet
+        loaded) must be un-defined on exit — otherwise one side's
+        candidate config would survive define_flag's existing-value-wins
+        rule and leak into the other side."""
+        from paddle_tpu import flags
+
+        probe = "parity_probe_lazy_flag"
+        assert probe not in flags._REGISTRY
+        with parity.flag_scope({probe: 9}):
+            assert flags.get_flag(probe) == 9
+        assert probe not in flags._REGISTRY
+        assert flags.get_flag(probe) is None
+
+    def test_flag_scope_restores(self):
+        from paddle_tpu import flags
+
+        before = flags.get_flag("numerics")
+        with parity.flag_scope({"numerics": True,
+                                "FLAGS_numerics_interval": 7}):
+            assert flags.get_flag("numerics") is True
+            assert flags.get_flag("numerics_interval") == 7
+        assert flags.get_flag("numerics") == before
+        assert flags.get_flag("numerics_interval") == 1
+
+    def test_harness_leaves_numerics_flag_unset(self):
+        from paddle_tpu import flags
+
+        parity.run_lockstep(self._build(), self._batches(1))
+        assert not flags.get_flag("numerics")

@@ -260,6 +260,123 @@ def test_tp4_serve_step_collective_counts(budgets):
     assert got["all-reduce"] == 2 * 2  # 2 psums x num_layers(=2)
 
 
+# -- per-model step-time / MFU floors (ROADMAP item 3) ------------------------
+# Wall-time floors are env-dependent in a way FLOPs budgets are not, so
+# they follow the dp8 ZeRO-2 pattern: --record stamps an environment
+# fingerprint next to the baselines and the gate only compares where the
+# fingerprint matches THIS machine — elsewhere it skips with structure
+# verified (re-record to pin the new environment).
+
+STEP_FLOOR_MODELS = ("gpt", "bert")
+#: measured-vs-recorded slack: CI machines share cores; a true
+#: regression (2x slower step from an accidental host sync or a
+#: recompile-per-step bug) still blows through 3x
+STEP_TIME_SLACK = 3.0
+
+
+def _steptime_env():
+    import os
+    import platform
+
+    import jax
+    import jaxlib
+
+    return {"jax": jax.__version__, "jaxlib": jaxlib.__version__,
+            "python": platform.python_version(),
+            "machine": platform.machine(),
+            "cpu_count": os.cpu_count()}
+
+
+def _floor_trainer(name):
+    """A tiny train setup per model (metrics_dump shapes), with the cost
+    registry populated via aot_build so stats()["mfu"] is finite."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.models import (BertConfig, BertForPretraining,
+                                   BertPretrainLoss, GPTConfig,
+                                   GPTForCausalLM, GPTPretrainLoss)
+
+    dims = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                dropout=0.0)
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    b, s = 2, 16
+    if name == "gpt":
+        model = GPTForCausalLM(GPTConfig(max_seq_len=64, **dims))
+        loss = GPTPretrainLoss()
+        batch = (rng.randint(0, 256, (b, s)).astype(np.int32),
+                 rng.randint(0, 256, (b, s)).astype(np.int32))
+    elif name == "bert":
+        model = BertForPretraining(BertConfig(max_position=64,
+                                              intermediate_size=256,
+                                              **dims))
+        loss = BertPretrainLoss()
+        batch = (rng.randint(0, 256, (b, s)).astype(np.int32),
+                 np.zeros((b, s), np.int32),
+                 rng.randint(0, 256, (b, s)).astype(np.int32))
+    else:
+        raise ValueError(name)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+    trainer = SpmdTrainer(model, opt, loss_fn=loss, mesh=mesh)
+    trainer.aot_build([(a.shape, a.dtype) for a in batch])
+    tensors = [paddle.to_tensor(a) for a in batch]
+    return trainer, tensors
+
+
+def _measure_step_floor(name, warmup=2, steps=5):
+    trainer, tensors = _floor_trainer(name)
+    for _ in range(warmup):
+        out = trainer.train_step(*tensors)
+    np.asarray(out._data)           # device-complete before timing
+    t0 = __import__("time").perf_counter()
+    for _ in range(steps):
+        out = trainer.train_step(*tensors)
+    np.asarray(out._data)           # include the device tail
+    wall_ms = (__import__("time").perf_counter() - t0) * 1e3 / steps
+    st = trainer.stats()
+    return {"step_ms": wall_ms, "mfu": st["mfu"]}
+
+
+def _measure_step_floors():
+    return {"env": _steptime_env(),
+            "floors": {name: _measure_step_floor(name)
+                       for name in STEP_FLOOR_MODELS}}
+
+
+@pytest.mark.parametrize("model", STEP_FLOOR_MODELS)
+def test_step_time_and_mfu_floor(model, budgets):
+    import jax
+
+    if jax.devices()[0].platform != "cpu":
+        pytest.skip("floors recorded on the CPU backend")
+    rec = budgets.get("step_time_floors")
+    if not rec or model not in rec.get("floors", {}):
+        pytest.skip("no recorded step-time floor — run `python "
+                    "tests/test_perf_budgets.py --record-steptime`")
+    if rec.get("env") != _steptime_env():
+        pytest.skip("step-time floor recorded on a different "
+                    "environment — wall time is not comparable; "
+                    "re-record here to pin this machine")
+    want = rec["floors"][model]
+    got = _measure_step_floor(model)
+    assert got["step_ms"] <= want["step_ms"] * STEP_TIME_SLACK, (
+        f"{model}: train step {got['step_ms']:.2f}ms vs recorded "
+        f"{want['step_ms']:.2f}ms (> {STEP_TIME_SLACK}x) — a speed "
+        "regression (host sync? recompile per step?); re-record only if "
+        "intentional")
+    # the MFU floor is the same claim through the cost registry: flops
+    # are pinned by the budgets above, so mfu degrades iff step time does
+    if want.get("mfu") and got.get("mfu"):
+        assert got["mfu"] >= want["mfu"] / STEP_TIME_SLACK, (
+            f"{model}: MFU {got['mfu']:.3e} vs recorded "
+            f"{want['mfu']:.3e} — the speed loop went backwards")
+
+
 def test_monitor_disabled_overhead():
     """Tier-1 overhead gate (ISSUE 2): with the monitor disabled every
     instrumented call site must cost ONE boolean check — bounded here
@@ -301,8 +418,22 @@ if __name__ == "__main__":
         jax.config.update("jax_platforms", "cpu")
         assert jax.devices()[0].platform == "cpu"
         budgets = _measure()
+        budgets["step_time_floors"] = _measure_step_floors()
         json.dump(budgets, open(BUDGET_PATH, "w"), indent=1)
         print(f"recorded -> {BUDGET_PATH}")
         print(json.dumps(budgets, indent=1))
+    elif "--record-steptime" in sys.argv:
+        # stamp ONLY the step-time/MFU floors (+ env fingerprint),
+        # leaving the FLOPs/collective budgets untouched — the usual move
+        # when picking the floors up on a new machine
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        assert jax.devices()[0].platform == "cpu"
+        budgets = json.load(open(BUDGET_PATH))
+        budgets["step_time_floors"] = _measure_step_floors()
+        json.dump(budgets, open(BUDGET_PATH, "w"), indent=1)
+        print(f"recorded step-time floors -> {BUDGET_PATH}")
+        print(json.dumps(budgets["step_time_floors"], indent=1))
     else:
         print(__doc__)

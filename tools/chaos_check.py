@@ -28,6 +28,12 @@ Checks (one entry per name in `passes`):
                      engine drains to exact greedy parity
   trainer_nonfinite  a NaN batch under FLAGS_check_nan_inf skips the
                      update, leaving params/moments bit-identical
+  numerics_anomaly   a trainer/batch=scale failpoint injects a gradient
+                     spike: the numerics telescope's drift detector
+                     fires (naming the layer) BEFORE the non-finite
+                     guard ever trips; a follow-up scale:nan step then
+                     trips the guard AND the per-layer nonfinite
+                     detector on the same step
 
 Report format: the tools/graph_lint.py schema ({"tool", "passes",
 "targets": {name: {"name", "counts", "findings"}}, "totals"}), so CI reads
@@ -49,7 +55,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 PASSES = ["ckpt_atomic", "ckpt_fallback", "serving_deadline",
           "serving_slot_error", "serving_shed", "router_failover",
-          "stall_dump", "trainer_nonfinite"]
+          "stall_dump", "trainer_nonfinite", "numerics_anomaly"]
 
 
 def _finding(name, severity, message, where=""):
@@ -395,6 +401,82 @@ def _check_trainer_nonfinite():
                 "NaN step skipped; parameters bit-identical")]
 
 
+def _check_numerics_anomaly():
+    """Chaos-injected drift: a trainer/batch=scale:1e4 failpoint blows
+    one step's gradients up — finite, so the PR 4 guard stays silent,
+    but the telescope's grad-spike detector must fire and NAME the
+    layer. A scale:nan step afterwards trips the guard; the per-layer
+    nonfinite detector must fire alongside it. Proves detection comes
+    BEFORE the step is ruined."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.testing import failpoints as fp
+
+    name = "numerics_anomaly"
+    old = {k: paddle.get_flags(["FLAGS_" + k])["FLAGS_" + k]
+           for k in ("numerics", "numerics_interval", "check_nan_inf")}
+    paddle.set_flags({"numerics": True, "numerics_interval": 1,
+                      "check_nan_inf": True})
+    try:
+        paddle.seed(0)
+        model = paddle.nn.Linear(8, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=model.parameters())
+        mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+        tr = SpmdTrainer(model, opt, loss_fn=paddle.nn.MSELoss(),
+                         mesh=mesh)
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 8).astype(np.float32)
+        y = rng.randn(4, 4).astype(np.float32)
+        for _ in range(4):          # baseline: the EMA learns "normal"
+            tr.train_step(x, y)
+        if tr._numerics.anomalies:
+            return [_finding(name, "error",
+                             "detector cried wolf during baseline "
+                             f"training: {list(tr._numerics.anomalies)}")]
+        skipped = tr.stats()["breakdown"]["nonfinite_skipped_total"]
+        with fp.scoped("trainer/batch=scale:10000"):
+            tr.train_step(x, y)     # finite spike: detector territory
+        spikes = [a for a in tr._numerics.anomalies
+                  if a["kind"] == "grad_spike"]
+        if not spikes:
+            return [_finding(name, "error",
+                             "injected gradient spike did not fire the "
+                             "grad_spike detector")]
+        if not spikes[0].get("layer"):
+            return [_finding(name, "error",
+                             "grad_spike anomaly does not name a layer")]
+        after_spike = tr.stats()["breakdown"]["nonfinite_skipped_total"]
+        if after_spike != skipped:
+            return [_finding(name, "error",
+                             "the finite spike tripped the non-finite "
+                             "guard — the detector did not get there "
+                             "first")]
+        with fp.scoped("trainer/batch=scale:nan"):
+            tr.train_step(x, y)     # poisoned step: guard territory
+        if tr.stats()["breakdown"]["nonfinite_skipped_total"] \
+                != skipped + 1:
+            return [_finding(name, "error",
+                             "scale:nan step did not trip the "
+                             "FLAGS_check_nan_inf guard")]
+        nonf = [a for a in tr._numerics.anomalies
+                if a["kind"] == "nonfinite" and a.get("layer")]
+        if not nonf:
+            return [_finding(name, "error",
+                             "poisoned step fired no per-layer "
+                             "nonfinite anomaly — the guard knows the "
+                             "step died but not WHERE")]
+    finally:
+        paddle.set_flags(old)
+    return [_ok(name,
+                f"grad_spike named layer {spikes[0]['layer']!r} before "
+                "the non-finite guard tripped; the nan step then fired "
+                f"nonfinite on {sorted({a['layer'] for a in nonf})}")]
+
+
 def build_report(only=None):
     """Run the fault schedule; `only` restricts to a subset of PASSES
     (the model is only built when a serving check is selected)."""
@@ -408,6 +490,7 @@ def build_report(only=None):
         ("ckpt_atomic", _check_ckpt_atomic),
         ("ckpt_fallback", _check_ckpt_fallback),
         ("trainer_nonfinite", _check_trainer_nonfinite),
+        ("numerics_anomaly", _check_numerics_anomaly),
     ]
     if selected & {"serving_deadline", "serving_slot_error",
                    "serving_shed", "router_failover", "stall_dump"}:

@@ -5,6 +5,7 @@
     python tools/metrics_dump.py --router                 # multi-engine tier
     python tools/metrics_dump.py --blackbox               # flight recorder
     python tools/metrics_dump.py --federated              # 2-client FedAvg
+    python tools/metrics_dump.py --numerics               # numerics telescope
     python tools/metrics_dump.py --model bert --prometheus
     python tools/metrics_dump.py --all --json             # machine-readable
     python tools/metrics_dump.py --serving --trace        # + span summary
@@ -50,6 +51,11 @@ _REQUIRED = {
     # families, and the aggregation bytes through the collective chokepoint
     "federated": ("federated_round_total", "federated_client_examples",
                   "collective_bytes_total"),
+    # the numerics telescope (docs/OBSERVABILITY.md): per-layer health
+    # gauges plus at least one detector fire from the loop's deliberate
+    # lr blow-up step
+    "numerics": ("numerics_grad_norm", "numerics_update_ratio",
+                 "numerics_anomaly_total"),
 }
 
 _DIMS = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
@@ -185,6 +191,43 @@ def run_federated_loop(rounds=1):
     return {"rounds": stats, "loss": fed.evaluate()}
 
 
+def run_numerics_loop(steps=5):
+    """The numerics-telescope target: a tiny-GPT train loop with
+    FLAGS_numerics armed (interval=1), plus one deliberately blown-up
+    learning-rate step so the update-ratio drift detector fires — moves
+    numerics_grad_norm/numerics_update_ratio gauges AND
+    numerics_anomaly_total in one pass."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import flags
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM, GPTPretrainLoss
+
+    old = {k: flags.get_flag(k) for k in ("numerics", "numerics_interval")}
+    paddle.set_flags({"numerics": True, "numerics_interval": 1})
+    try:
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        model = GPTForCausalLM(GPTConfig(max_seq_len=64, **_DIMS))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+        trainer = SpmdTrainer(model, opt, loss_fn=GPTPretrainLoss(),
+                              mesh=mesh)
+        batch = [paddle.to_tensor(
+            rng.randint(0, 256, (2, 16)).astype(np.int32))
+            for _ in range(2)]
+        for _ in range(steps - 1):
+            trainer.train_step(*batch)
+        opt.set_lr(50.0)   # one rewriting step: the detector's job
+        trainer.train_step(*batch)
+        return trainer.stats()["numerics"]
+    finally:
+        paddle.set_flags(old)
+
+
 def run_blackbox_loop(new_tokens=4):
     """The flight-recorder target: a short serving loop with the
     recorder ON, then one on-demand dump bundle into a throwaway dir —
@@ -230,7 +273,8 @@ def run_target(name, with_trace=False):
 
     monitor.reset()
     trace_summary = None
-    kind = (name if name in ("serving", "router", "blackbox", "federated")
+    kind = (name if name in ("serving", "router", "blackbox", "federated",
+                             "numerics")
             else "train")
     if with_trace:
         trace.clear()
@@ -244,6 +288,8 @@ def run_target(name, with_trace=False):
             run_blackbox_loop()
         elif kind == "federated":
             run_federated_loop()
+        elif kind == "numerics":
+            run_numerics_loop()
         else:
             run_train_step(name)
     finally:
@@ -307,9 +353,15 @@ def main(argv=None):
                          "round); exit 1 when the federated_round_total/"
                          "federated_client_examples metric families are "
                          "missing")
+    ap.add_argument("--numerics", action="store_true", dest="numerics",
+                    help="run the numerics telescope (tiny-GPT train "
+                         "loop with FLAGS_numerics armed + one blown-up "
+                         "lr step); exit 1 when the numerics_grad_norm/"
+                         "numerics_update_ratio/numerics_anomaly_total "
+                         "families are missing")
     ap.add_argument("--all", action="store_true",
                     help="all models + the serving loop + the router, "
-                         "flight-recorder and federated tiers")
+                         "flight-recorder, federated and numerics tiers")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the graph_lint-schema machine report")
     ap.add_argument("--prometheus", action="store_true",
@@ -328,12 +380,14 @@ def main(argv=None):
         targets.append("blackbox")
     if args.federated:
         targets.append("federated")
+    if args.numerics:
+        targets.append("numerics")
     if args.all:
         targets = list(MODEL_TARGETS) + ["serving", "router", "blackbox",
-                                         "federated"]
+                                         "federated", "numerics"]
     if not targets:
         ap.error("pick a target: --model NAME, --serving, --router, "
-                 "--blackbox, --federated or --all")
+                 "--blackbox, --federated, --numerics or --all")
 
     report = build_report(targets, with_trace=args.with_trace)
     if args.as_json:

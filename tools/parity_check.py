@@ -1,0 +1,204 @@
+"""A/B loss-parity gate CLI: run lockstep trainer pairs under reference vs
+candidate flag-sets and fail loudly (exit 1, naming the diverging step and
+stat) when a pair leaves its declared tolerance band.
+
+    python tools/parity_check.py --ab check_nan_inf     # PR 4 guard: exact
+    python tools/parity_check.py --ab use_bfloat16      # flag A/B: exact
+    python tools/parity_check.py --ab amp_bf16          # bf16 amp: banded
+    python tools/parity_check.py --all
+    python tools/parity_check.py --perturb-lr 5 --json  # negative control
+
+The harness is paddle_tpu/testing/parity.py (docs/OBSERVABILITY.md
+"Numerics telescope"): both sides train the SAME seeded tiny GPT over
+IDENTICAL batches with the numerics telescope armed, and every step's
+loss + per-layer grad stats are compared within each target's DECLARED
+tolerance. ``--perturb-lr F`` runs the harness's own negative control — a
+candidate whose learning rate is scaled by F must diverge, and the run
+exits 1 naming where; CI uses it to prove the gate can actually fail.
+
+This is the acceptance gate ROADMAP item 2's quantized all-reduce plugs
+into: add its flag-set as a target with the loss band the quantization
+paper claims, and ship only when this exits 0.
+
+Report format: the tools/graph_lint.py schema ({"tool", "passes",
+"targets": {name: {"name", "counts", "findings", "report"}}, "totals"})
+so CI reads every audit tool through one loader.
+"""
+import argparse
+import functools
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_trainer(lr=1e-2, amp_dtype=None):
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainLoss)
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                    num_heads=2, max_seq_len=32, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    loss = GPTPretrainLoss()
+    opt = paddle.optimizer.AdamW(learning_rate=lr,
+                                 parameters=model.parameters())
+    mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+    return SpmdTrainer(model, opt, loss_fn=loss, mesh=mesh,
+                       amp_dtype=amp_dtype)
+
+
+def _batches(steps, batch=2, seq=12):
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    return [(rng.randint(0, 64, (batch, seq)).astype(np.int32),
+             rng.randint(0, 64, (batch, seq)).astype(np.int32))
+            for _ in range(steps)]
+
+
+#: each target declares ITS tolerance — exact for program-identical or
+#: bit-exact-by-contract A/Bs, a written band for genuinely lossy ones
+AB_TARGETS = {
+    # FLAGS_use_bfloat16 keys the AOT cache today and grows real lowering
+    # the day ROADMAP item 3 widens MXU coverage — the A/B pins EXACT
+    # parity now and becomes the alarm that rings then
+    "use_bfloat16": dict(
+        reference_flags={"use_bfloat16": False},
+        candidate_flags={"use_bfloat16": True},
+        loss_rtol=0.0, loss_atol=0.0, stat_rtol=0.0, stat_atol=0.0),
+    # the PR 4 guard rebuilds the step with the fused finiteness verdict
+    # + where-selects; on finite data its contract is BIT-exact
+    "check_nan_inf": dict(
+        reference_flags={},
+        candidate_flags={"check_nan_inf": True},
+        loss_rtol=0.0, loss_atol=0.0, stat_rtol=0.0, stat_atol=0.0),
+    # bf16 autocast genuinely changes the numbers; the declared band is
+    # the acceptance envelope (one part in 2^8 mantissa, headroom for
+    # accumulation) — the shape every lossy candidate (ROADMAP item 2's
+    # quantized all-reduce) will reuse
+    "amp_bf16": dict(
+        candidate_build=functools.partial(_build_trainer,
+                                          amp_dtype="bfloat16"),
+        reference_flags={}, candidate_flags={},
+        loss_rtol=0.08, loss_atol=0.05, stat_rtol=0.6, stat_atol=0.1),
+}
+
+
+def _finding(name, severity, message, where=""):
+    return {"pass": name, "severity": severity, "message": message,
+            "where": where}
+
+
+def run_target(name, steps=4, perturb_lr=None):
+    """Run one A/B; returns (report, findings). `perturb_lr` builds the
+    negative-control target instead (candidate lr scaled — MUST
+    diverge)."""
+    from paddle_tpu.testing import parity
+
+    if perturb_lr is not None:
+        spec = dict(
+            candidate_build=functools.partial(_build_trainer,
+                                              lr=1e-2 * perturb_lr),
+            reference_flags={}, candidate_flags={},
+            loss_rtol=0.0, loss_atol=0.0, stat_rtol=0.0, stat_atol=0.0)
+    else:
+        spec = AB_TARGETS[name]
+    report = parity.run_parity(
+        _build_trainer, _batches(steps),
+        build_candidate=spec.get("candidate_build"),
+        reference_flags=spec["reference_flags"],
+        candidate_flags=spec["candidate_flags"],
+        loss_rtol=spec["loss_rtol"], loss_atol=spec["loss_atol"],
+        stat_rtol=spec["stat_rtol"], stat_atol=spec["stat_atol"])
+    findings = []
+    if report["diverged"]:
+        d = report["first_divergence"]
+        where = d["stat"] + (f"[{d['layer']}]" if d.get("layer") else "")
+        findings.append(_finding(
+            name, "error",
+            f"A/B diverged at step {d['step']} on {where}: "
+            f"reference={d['reference']:.6g} "
+            f"candidate={d['candidate']:.6g} "
+            f"(|diff|={d['abs_diff']:.3g}, tolerances "
+            f"{report['tolerances']})", where=where))
+    else:
+        findings.append(_finding(
+            name, "info",
+            f"{report['steps']} lockstep steps within declared "
+            f"tolerance (max |loss diff| "
+            f"{report['max_abs_loss_diff']:.3g})"))
+    return report, findings
+
+
+def build_report(targets, steps=4, perturb_lr=None):
+    report = {"tool": "parity_check", "passes": list(targets), "targets": {},
+              "totals": {"error": 0, "warning": 0, "info": 0}}
+    jobs = [(t, None) for t in targets]
+    if perturb_lr is not None:
+        jobs.append(("perturb_lr", perturb_lr))
+        report["passes"].append("perturb_lr")
+    for name, factor in jobs:
+        try:
+            ab_report, findings = run_target(name, steps=steps,
+                                             perturb_lr=factor)
+        except Exception as e:   # a crashed A/B is a failed gate
+            ab_report = None
+            findings = [_finding(name, "error",
+                                 f"A/B crashed: {type(e).__name__}: {e}")]
+        counts = {"error": 0, "warning": 0, "info": 0}
+        for f in findings:
+            counts[f["severity"]] += 1
+        report["targets"][name] = {"name": name, "counts": counts,
+                                   "findings": findings,
+                                   "report": ab_report}
+        for sev, n in counts.items():
+            report["totals"][sev] += n
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ab", action="append", choices=sorted(AB_TARGETS),
+                    default=[], help="run one named A/B target "
+                    "(repeatable)")
+    ap.add_argument("--all", action="store_true",
+                    help="run every named A/B target")
+    ap.add_argument("--perturb-lr", type=float, default=None,
+                    dest="perturb_lr", metavar="F",
+                    help="negative control: candidate lr scaled by F "
+                         "under zero tolerance — MUST diverge (exit 1 "
+                         "naming the step/stat); proves the gate can "
+                         "fail")
+    ap.add_argument("--steps", type=int, default=4,
+                    help="lockstep steps per side (default 4)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the graph_lint-schema machine report")
+    args = ap.parse_args(argv)
+
+    targets = sorted(AB_TARGETS) if args.all else list(args.ab)
+    if not targets and args.perturb_lr is None:
+        ap.error("pick a target: --ab NAME, --all, or --perturb-lr F")
+
+    report = build_report(targets, steps=args.steps,
+                          perturb_lr=args.perturb_lr)
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        for t in report["targets"].values():
+            for f in t["findings"]:
+                print(f"  [{f['severity']}] {f['pass']}: {f['message']}")
+        t = report["totals"]
+        print(f"total: {t['error']} divergence(s), {t['info']} A/B(s) "
+              f"within tolerance")
+    return 1 if report["totals"]["error"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
