@@ -48,3 +48,44 @@ def count_jaxpr_collectives(jaxpr):
         if fam is not None:
             out[fam] = out.get(fam, 0) + 1
     return out
+
+
+# -- the quantized reduce family (distributed/compress.py) ---------------------
+# A wire-compressed all-reduce decomposes into a reduce-scatter phase (the
+# int8 shard exchange — all_to_all of the quantized payload, or a
+# quantized psum_scatter) and an all-gather phase (the re-quantized
+# reduced shards going back out). The payload dtype is the tell: the
+# exchange ops carry the int8 wire format, while their small float32
+# scale side-channels ride as ordinary all_to_all/all_gather eqns.
+
+QUANTIZED_WIRE_DTYPES = ("int8", "uint8")
+
+#: jaxpr exchange primitives a quantized reduce is built from, mapped to
+#: the phase they implement when the payload is a wire dtype
+_QUANTIZED_PHASES = {
+    "all_to_all": "quantized-reduce-scatter",
+    "psum_scatter": "quantized-reduce-scatter",
+    "all_gather": "quantized-all-gather",
+}
+
+
+def count_quantized_collectives(jaxpr):
+    """Exact counts of the wire-compressed exchange pair: all_to_all/
+    psum_scatter ("quantized-reduce-scatter") and all_gather
+    ("quantized-all-gather") eqns whose payload dtype is int8/uint8, at
+    every nesting depth. Zero for any program that never quantized a
+    collective — tests/test_perf_budgets.py pins the dp8 quantized train
+    step to exactly one of each."""
+    from .jaxpr_utils import iter_eqns
+
+    out = {fam: 0 for fam in ("quantized-reduce-scatter",
+                              "quantized-all-gather")}
+    for eqn, _ in iter_eqns(jaxpr):
+        fam = _QUANTIZED_PHASES.get(eqn.primitive.name)
+        if fam is None or not eqn.invars:
+            continue
+        aval = getattr(eqn.invars[0], "aval", None)
+        if aval is not None and str(getattr(aval, "dtype", "")) in \
+                QUANTIZED_WIRE_DTYPES:
+            out[fam] += 1
+    return out

@@ -12,7 +12,8 @@ warnings are allowed and counted against tests/lint_baseline.json.
 import numpy as np
 
 from .collectives import (HLO_COLLECTIVE_KINDS, count_hlo_collectives,
-                          count_jaxpr_collectives)
+                          count_jaxpr_collectives,
+                          count_quantized_collectives)
 from .jaxpr_utils import fmt_aval, is_key_aval, iter_eqns, sub_jaxprs
 from .registry import register_pass
 
@@ -329,6 +330,15 @@ def collective_count(ctx):
         out.append(collective_count.finding(
             f"{jx[fam]} {fam} collective(s) in the traced graph",
             where=fam))
+    quant = {k: v for k, v in count_quantized_collectives(ctx.jaxpr).items()
+             if v}
+    if quant:
+        out.append(collective_count.finding(
+            f"quantized reduce family (int8 wire): {quant} — the "
+            "reduce-scatter/all-gather pair of a wire-compressed "
+            "all-reduce (distributed/compress.py, docs/DISTRIBUTED.md); "
+            "their payload bytes are collective_bytes_total wire bytes, "
+            "not the dequantized fp32 size", where="quantized"))
     if ctx.hlo_text is not None:
         # count every family the jaxpr side knows, not just the 3 kinds
         # the perf-budget recording format defaults to

@@ -55,6 +55,28 @@ def _stat(kind, x):
                     bytes=nbytes)
 
 
+def record_compressed(kind, logical_nbytes, wire_nbytes):
+    """Chokepoint accounting for a WIRE-COMPRESSED collective (the
+    quantized reduce family, docs/DISTRIBUTED.md): like :func:`_stat` it
+    fires the ``collective/call`` failpoint and counts the call, but
+    ``collective_bytes_total{op=kind}`` gets the bytes that actually
+    cross the interconnect (int8 payload + scales) while the fp32 bytes
+    the encoding displaced land in ``collective_bytes_saved_total{op}``.
+    For uncompressed ops wire == logical and :func:`_stat` is unchanged —
+    the PR 2 meaning of every existing series is preserved. Emits a
+    ``collective/quantized`` span carrying both numbers."""
+    _fp.failpoint("collective/call")
+    _monitor.record_collective(
+        kind, int(wire_nbytes),
+        saved_bytes=max(0, int(logical_nbytes) - int(wire_nbytes)))
+    if _trace.is_enabled():
+        now = time.perf_counter_ns()
+        _trace.emit("collective/quantized", now, now,
+                    subsystem="collective", parent=_trace.current_span(),
+                    op=kind, bytes=int(wire_nbytes),
+                    logical_bytes=int(logical_nbytes))
+
+
 class ReduceOp:
     SUM = "sum"
     MAX = "max"
@@ -120,8 +142,119 @@ def _unary_collective(x, spmd_fn, eager_multi_fn=None):
     return spmd_fn(x)
 
 
-def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+def _compress_bits(compress):
+    """Normalize the all_reduce/client_reduce `compress` opt-in: None/0/
+    False = off; True = int8; an int = that wire width (validated by the
+    compress module)."""
+    if not compress:
+        return None
+    return 8 if compress is True else int(compress)
+
+
+def _compressed_reduce(x, op, axis_name, bits, kind, key=None,
+                       placed=False, leading=False):
+    """The chokepoint's compressed path (ROADMAP item 2). Three
+    placements, mirroring the uncompressed ops:
+
+    - `placed` — inside a shard_map/client_map body on a named axis: the
+      payload goes through :func:`compress.quantized_all_reduce` (int8
+      wire, float32 accumulation, straight-through gradient);
+    - `leading` — server-side clients-leading array (client_reduce's
+      eager FedAvg form): each leading slice pays one quantize-dequantize
+      round-trip (its simulated wire trip) before the float32 axis-0
+      reduce;
+    - neither — eager world-size-1 'all-reduce': identity semantics, but
+      the caller opted into the wire format, so the one local
+      quantization round-trip is applied — the error a mesh would see is
+      visible (and testable) on a laptop too.
+
+    SUM/AVG only, float payloads only — anything else must stay exact and
+    raises instead of silently shipping fp32."""
+    from . import compress as _compress
+
+    if op not in (ReduceOp.SUM, "sum", ReduceOp.AVG, "avg"):
+        raise ValueError(
+            f"compressed reduce supports SUM/AVG, got {op!r} "
+            "(MAX/MIN/PROD have no meaningful quantized accumulation)")
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if not jnp.issubdtype(data.dtype, jnp.floating):
+        raise ValueError(
+            f"compressed reduce needs a float payload, got "
+            f"{data.dtype} (integer reductions must stay exact)")
+    mean = op in (ReduceOp.AVG, "avg")
+    if placed:
+        # per-op payload accounting; the block*world shard padding the
+        # traced exchange adds is not visible here (axis size is only
+        # known under the trace) — a slight under-count for payloads
+        # that aren't world-shard multiples
+        wire = _compress.wire_bytes(int(data.size), bits=bits)
+        fn = lambda v: _compress.quantized_all_reduce(
+            v, axis_name, key=key, bits=bits, mean=mean)
+    elif leading:
+        # each leading row is an independent payload (its own blocks +
+        # scales) — meter the sum of the per-row encodings
+        rows_n = int(data.shape[0]) if data.ndim else 1
+        row_sz = int(data.size) // max(rows_n, 1)
+        wire = rows_n * _compress.wire_bytes(row_sz, bits=bits)
+
+        def fn(v, _key=key if key is not None else _eager_quant_key()):
+            rows = [
+                _compress.quantize_dequantize(
+                    v[i], jax.random.fold_in(_key, i), bits=bits)
+                for i in range(v.shape[0])]
+            stacked = jnp.stack(rows)
+            return jnp.mean(stacked, 0) if mean else jnp.sum(stacked, 0)
+    elif _env.get_world_size() > 1:
+        # raise BEFORE any metering/failpoint: an op that never runs
+        # must not count as a completed quantized collective
+        raise NotImplementedError(
+            "compressed eager multi-process all_reduce is not implemented "
+            "— compression targets the SPMD/ICI path (docs/DISTRIBUTED.md)")
+    else:
+        wire = _compress.wire_bytes(int(data.size), bits=bits)
+        fn = lambda v: _compress.quantize_dequantize(
+            v, key if key is not None else _eager_quant_key(), bits=bits)
+    record_compressed(kind, logical_nbytes=_monitor.tensor_nbytes(x),
+                      wire_nbytes=wire)
+    if isinstance(x, Tensor):
+        from ..core.dispatch import apply
+
+        return apply(fn, x)
+    return fn(jnp.asarray(x))
+
+
+_EAGER_QUANT_SEQ = [0]
+
+
+def _eager_quant_key():
+    """Per-call stochastic-rounding key for eager compressed reduces:
+    seeded from the global generator (deterministic under paddle.seed)
+    and advanced per call so repeated reduces never share rounding
+    noise."""
+    from ..core.generator import default_generator
+
+    _EAGER_QUANT_SEQ[0] += 1
+    return default_generator().fold_in(0x514152 + _EAGER_QUANT_SEQ[0])
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               compress=None):
     ax = _axis(group)
+    bits = _compress_bits(compress)
+    if bits is not None:
+        out = _compressed_reduce(tensor, op, ax, bits, "quantized_all_reduce",
+                                 placed=in_spmd_context())
+        if isinstance(tensor, Tensor) and isinstance(out, Tensor) \
+                and out is not tensor:
+            # paddle all_reduce is in-place on the tensor — including
+            # the world-size-1 compressed form, whose quantization
+            # round-trip must land in the caller's tensor (a caller
+            # ignoring the return value sees the same lossy wire format
+            # it would see on a mesh)
+            tensor._data = out._data
+            tensor._node = out._node
+            return tensor
+        return out
     _stat("all-reduce", tensor)
 
     def spmd(v):
@@ -326,7 +459,7 @@ def p2p_shift(x, axis_name, shift=1):
 
 
 def client_reduce(x, op=ReduceOp.SUM, axis_name="clients", placed=True,
-                  kind="federated_sum"):
+                  kind="federated_sum", compress=None, compress_key=None):
     """The federated MapReduce reduce chokepoint (paddle_tpu.federated).
 
     Every cross-client aggregation funnels through here so it inherits the
@@ -344,7 +477,17 @@ def client_reduce(x, op=ReduceOp.SUM, axis_name="clients", placed=True,
       axis 0 (the eager FedAvg aggregation path).
 
     Like every collective here, a call inside a jit trace is counted once
-    per TRACE (host-side accounting)."""
+    per TRACE (host-side accounting). ``compress=8`` (or ``True``) opts a
+    placed SUM/AVG into the int8 quantized reduce — the EQuARX-style wire
+    format the trainer's FLAGS_quantized_allreduce uses, with the same
+    straight-through gradient, metered as
+    ``collective_bytes_total{op=kind}`` wire bytes +
+    ``collective_bytes_saved_total{op=kind}``."""
+    bits = _compress_bits(compress)
+    if bits is not None:
+        return _compressed_reduce(x, op, axis_name, bits, kind,
+                                  key=compress_key, placed=placed,
+                                  leading=not placed)
     _stat(kind, x)
 
     def named(v):

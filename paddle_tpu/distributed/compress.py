@@ -1,0 +1,256 @@
+"""Gradient compression for the collective chokepoint (docs/DISTRIBUTED.md).
+
+EQuARX-style quantized all-reduce (arXiv:2506.17615): on TPU slices the
+collective stream IS the scaling budget, and for data-parallel training
+almost all of it is one op — the per-step gradient all-reduce. This module
+shrinks that op's wire format to int8 while keeping the *accumulation* in
+float32:
+
+- :func:`quantize` / :func:`dequantize` — per-block symmetric int8 with a
+  float32 block-max scale and **stochastic rounding** driven by a
+  deterministic PRNG key (threaded from the train step's key, so a run is
+  reproducible under ``paddle.seed`` and two ranks never share rounding
+  noise);
+- :func:`quantized_all_reduce` — the two-phase exchange: each rank
+  quantizes its local tensor, the **reduce-scatter phase** moves int8
+  shards (``lax.all_to_all`` of the quantized payload + scales), every
+  rank **dequantizes and accumulates its shard in float32**, re-quantizes
+  the reduced shard, and the **all-gather phase** moves int8 back out.
+  Accumulation never happens in int8 — the only rounding is the two
+  quantize steps, never a saturating integer sum;
+- error feedback — :func:`quantized_all_reduce_ef` also returns the local
+  quantize-dequantize round-trip so the caller can carry
+  ``residual = input - roundtrip`` into the next step
+  (``SpmdTrainer`` rides it on the optimizer-state pytree as
+  ``__qar_residual__``): the quantization error is re-injected instead of
+  lost, which is what keeps the loss curve on top of the fp32 one.
+
+Non-finite safety: a NaN/Inf element poisons its block's *scale* (float32,
+NaN-preserving), so the dequantized block comes back non-finite — a
+poisoned step stays loud exactly like the uncompressed path, and the int8
+payload (whose cast from NaN is undefined) never decides the result.
+
+Byte accounting rides the collective chokepoint's discipline
+(:func:`paddle_tpu.distributed.collective.record_compressed`):
+``collective_bytes_total{op=...}`` counts the **wire** encoding,
+``collective_bytes_saved_total{op=...}`` the fp32 bytes it displaced, and
+a ``collective/quantized`` span tags each traced call. This module is
+imported lazily — a trainer with ``FLAGS_quantized_allreduce`` and
+``FLAGS_shard_weight_update`` unset never loads it
+(tests/test_compress_gate.py pins the subprocess form).
+"""
+import jax
+import jax.numpy as jnp
+
+from .. import monitor as _monitor
+
+__all__ = [
+    "DEFAULT_BLOCK", "SUPPORTED_BITS", "quantize", "dequantize",
+    "quantize_dequantize", "quantized_all_reduce",
+    "quantized_all_reduce_ef", "padded_size", "wire_bytes", "error_gauge",
+]
+
+#: quantization block length (elements sharing one float32 scale). 256
+#: keeps the scale overhead at 4/256 ≈ 1.6% of the int8 payload while
+#: staying fine-grained enough that one outlier only poisons its block.
+DEFAULT_BLOCK = 256
+
+#: wire formats this build supports. 8 = int8 payload; sub-byte packing
+#: (4-bit nibbles) is future work — the flag validates loudly instead of
+#: silently shipping fp32.
+SUPPORTED_BITS = (8,)
+
+
+def _check_bits(bits):
+    if int(bits) not in SUPPORTED_BITS:
+        raise ValueError(
+            f"quantized all-reduce supports bits in {SUPPORTED_BITS} "
+            f"(int8 wire format), got {bits!r}")
+    return int(bits)
+
+
+def padded_size(n, block=DEFAULT_BLOCK, world=1):
+    """Elements after padding `n` up to a whole number of blocks per
+    rank-shard: the padded length is a multiple of ``block * world`` so
+    the reduce-scatter phase hands every rank whole blocks."""
+    unit = int(block) * int(world)
+    return -(-int(n) // unit) * unit
+
+
+def wire_bytes(n, bits=8, block=DEFAULT_BLOCK, world=1):
+    """Bytes of ONE quantized payload (int8 data + float32 block scales)
+    for an `n`-element tensor — the chokepoint's per-op accounting unit
+    (an fp32 all-reduce likewise counts its payload once, not per ring
+    hop; see docs/DISTRIBUTED.md)."""
+    bits = _check_bits(bits)
+    padded = padded_size(n, block=block, world=world)
+    return padded * bits // 8 + (padded // int(block)) * 4
+
+
+# -- core quantize / dequantize -----------------------------------------------
+
+def _stochastic_round(v, key):
+    """Unbiased round: floor(v) + Bernoulli(frac(v)). E[out] == v."""
+    lo = jnp.floor(v)
+    frac = v - lo
+    u = jax.random.uniform(key, v.shape, dtype=v.dtype)
+    return lo + (frac > u).astype(v.dtype)
+
+
+def quantize(flat, key, bits=8, block=DEFAULT_BLOCK):
+    """Per-block symmetric stochastic quantize of a 1-D float32 array
+    whose length is a multiple of `block`. Returns ``(q, scales)`` with
+    ``q`` int8 of `flat`'s length and ``scales`` float32 of length
+    ``len(flat) // block`` (the block-max / 127 step size). A zero block
+    quantizes to exact zeros; a non-finite element makes its block's
+    scale non-finite (loud on dequantize)."""
+    bits = _check_bits(bits)
+    qmax = float(2 ** (bits - 1) - 1)
+    blocks = flat.reshape(-1, int(block)).astype(jnp.float32)
+    scale = jnp.max(jnp.abs(blocks), axis=1) / qmax          # [nblocks]
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = _stochastic_round(blocks / safe[:, None], key)
+    q = jnp.clip(q, -qmax, qmax).astype(jnp.int8)
+    return q.reshape(-1), scale.astype(jnp.float32)
+
+
+def dequantize(q, scales, block=DEFAULT_BLOCK):
+    """Inverse of :func:`quantize`: int8 payload × its block scale."""
+    return (q.astype(jnp.float32).reshape(-1, int(block))
+            * scales[:, None].astype(jnp.float32)).reshape(-1)
+
+
+def quantize_dequantize(x, key, bits=8, block=DEFAULT_BLOCK):
+    """One local quantization round-trip (pad → quantize → dequantize →
+    trim), preserving `x`'s shape; float32 result. This is what a
+    world-size-1 'all-reduce' of the compressed path computes — callers
+    see the real quantization error even without a mesh."""
+    flat = jnp.asarray(x).astype(jnp.float32).ravel()
+    n = flat.shape[0]
+    padded = padded_size(n, block=block)
+    flat = jnp.pad(flat, (0, padded - n))
+    q, s = quantize(flat, key, bits=bits, block=block)
+    return dequantize(q, s, block=block)[:n].reshape(jnp.shape(x))
+
+
+# -- the two-phase quantized all-reduce ---------------------------------------
+
+def _exchange_reduce(flat, axis_name, key, bits, block):
+    """Phase 1 on a padded 1-D float32 array: quantize the local tensor,
+    all_to_all the int8 shards + scales, dequant-accumulate this rank's
+    shard in float32. Returns ``(shard_sum, local_roundtrip)`` — the
+    rank's float32 slice of the cross-replica SUM, and the local
+    dequantized round-trip (for error feedback)."""
+    world = jax.lax.psum(1, axis_name)
+    q, s = quantize(flat, jax.random.fold_in(key, jax.lax.axis_index(axis_name)),
+                    bits=bits, block=block)
+    local_rt = dequantize(q, s, block=block)
+    if world == 1:
+        return local_rt, local_rt
+    shard = flat.shape[0] // world
+    q_peers = jax.lax.all_to_all(q.reshape(world, shard), axis_name,
+                                 split_axis=0, concat_axis=0)
+    s_peers = jax.lax.all_to_all(s.reshape(world, shard // int(block)),
+                                 axis_name, split_axis=0, concat_axis=0)
+    # float32 accumulation of the dequantized peer shards — the int8
+    # payload is never summed
+    acc = jnp.sum(q_peers.astype(jnp.float32).reshape(world, -1, int(block))
+                  * s_peers[:, :, None].astype(jnp.float32), axis=0)
+    return acc.reshape(-1), local_rt
+
+
+def _gather_full(shard_sum, axis_name, key, bits, block):
+    """Phase 2: re-quantize the reduced shard and all-gather the int8
+    form; every rank dequantizes the identical full result."""
+    world = jax.lax.psum(1, axis_name)
+    if world == 1:
+        return shard_sum
+    idx = jax.lax.axis_index(axis_name)
+    q2, s2 = quantize(shard_sum,
+                      jax.random.fold_in(jax.random.fold_in(key, idx), 1),
+                      bits=bits, block=block)
+    qg = jax.lax.all_gather(q2, axis_name, tiled=True)
+    sg = jax.lax.all_gather(s2, axis_name, tiled=True)
+    return dequantize(qg, sg, block=block)
+
+
+def quantized_all_reduce_ef(x, axis_name, key, bits=8, block=DEFAULT_BLOCK,
+                            mean=False, meter=None):
+    """The full quantized all-reduce with the error-feedback hook:
+    returns ``(reduced, local_roundtrip)`` — the float32 cross-replica
+    SUM (or mean) of `x` in `x`'s shape, and ``dequantize(quantize(x))``
+    so the caller can carry ``x - local_roundtrip`` as next step's
+    residual. Must run under a mesh axis (shard_map/pmap/vmap) named
+    `axis_name`. `meter` optionally names the op for the chokepoint's
+    byte accounting (None = caller meters)."""
+    bits = _check_bits(bits)
+    world = jax.lax.psum(1, axis_name)
+    flat = jnp.asarray(x).astype(jnp.float32).ravel()
+    n = flat.shape[0]
+    padded = padded_size(n, block=block, world=world)
+    if meter:
+        from . import collective as _coll
+
+        _coll.record_compressed(
+            meter, logical_nbytes=n * 4,
+            wire_nbytes=wire_bytes(n, bits=bits, block=block, world=world))
+    flat = jnp.pad(flat, (0, padded - n))
+    shard_sum, local_rt = _exchange_reduce(flat, axis_name, key, bits, block)
+    full = _gather_full(shard_sum, axis_name, key, bits, block)
+    out = full[:n]
+    if mean:
+        out = out / world
+    return out.reshape(jnp.shape(x)), local_rt[:n].reshape(jnp.shape(x))
+
+
+def quantized_all_reduce(x, axis_name, key=None, bits=8,
+                         block=DEFAULT_BLOCK, mean=False, meter=None):
+    """Drop-in quantized ``psum``/``pmean`` over `axis_name` (the public
+    form ROADMAP item 2 names): int8 wire format, float32 accumulation,
+    stochastic rounding under `key` (derived from the global generator
+    when omitted — pass a key under jit for per-step randomness).
+
+    Differentiable with a straight-through estimator: the backward pass
+    treats the op as the exact sum it approximates (cotangent passes
+    through unchanged, matching ``psum``'s replicated-cotangent rule), so
+    ``federated_sum``-style callers can opt in without losing their
+    gradient."""
+    bits = _check_bits(bits)
+    if key is None:
+        from ..core.generator import default_generator
+
+        key = default_generator().fold_in(0x514152)   # "QAR"
+
+    @jax.custom_vjp
+    def _qar(v):
+        out, _ = quantized_all_reduce_ef(v, axis_name, key, bits=bits,
+                                         block=block, mean=mean, meter=meter)
+        return out
+
+    def _fwd(v):
+        return _qar(v), None
+
+    def _bwd(_, ct):
+        return (ct,)
+
+    _qar.defvjp(_fwd, _bwd)
+    return _qar(jnp.asarray(x))
+
+
+# -- lazy observability -------------------------------------------------------
+
+_GAUGE = None
+
+
+def error_gauge():
+    """The ``quantize_error_norm`` gauge (lazy — no series until a
+    compressed trainer actually fetches its banked error scalar)."""
+    global _GAUGE
+    if _GAUGE is None:
+        _GAUGE = _monitor.gauge(
+            "quantize_error_norm",
+            "global L2 norm of the last step's gradient quantization "
+            "error (the error-feedback residual that will be re-injected "
+            "next step); fetched lazily via SpmdTrainer.stats() / "
+            "quantize_error()")
+    return _GAUGE

@@ -66,11 +66,17 @@ def _pvary(x, ax):
     """Mark x device-varying over `ax` inside shard_map. Differentiating
     w.r.t. an UNVARYING (replicated) input auto-psums the cotangent across
     the axis — so a "local" gradient taken against replicated params comes
-    back pre-summed. pvary first keeps the grad genuinely rank-local."""
+    back pre-summed. pvary first keeps the grad genuinely rank-local.
+    On jax versions with neither pcast nor pvary, shard_map's cotangents
+    for replicated inputs are already rank-local (no auto-psum — verified
+    empirically on 0.4.x) and the identity fallback is correct."""
     try:
         return jax.lax.pcast(x, (ax,), to="varying")
     except (AttributeError, TypeError):
-        return jax.lax.pvary(x, (ax,))
+        try:
+            return jax.lax.pvary(x, (ax,))
+        except (AttributeError, TypeError):
+            return x
 
 
 def owned_device_put(v, sh):
@@ -206,11 +212,13 @@ class SpmdTrainer:
                 raise ValueError("remat_offload and recompute_policy both "
                                  "select a jax.checkpoint policy — pick one")
         self._compiled = None       # latest executable (back-compat handle)
-        self._compiled_store = {}   # (batch-sig, guarded, numerics) ->
-        #                             (executable, guarded, numerics) —
-        #                             the two flags change the step's
-        #                             output arity (finiteness verdict /
-        #                             fused health-stats leg)
+        self._compiled_store = {}   # (batch-sig, guarded, numerics,
+        #                             quantized, shard_update) ->
+        #                             (executable, guarded, numerics,
+        #                             qerr-leg) — these flags change the
+        #                             step's output arity (finiteness
+        #                             verdict / fused health-stats leg /
+        #                             quantization-error scalar)
         self._nonfinite_streak = 0  # consecutive skipped steps
         self._nonfinite_total = 0   # lifetime skipped steps (stats())
         # step-time accounting for stats(): host wall time per step plus
@@ -235,7 +243,104 @@ class SpmdTrainer:
         self.frozen = {n: p._data for n, p in layer.named_parameters() if not getattr(p, "trainable", True)}
         self.buffers = {n: b._data for n, b in layer.named_buffers()}
         self.opt_state = optimizer.functional_init(self.params)
+        # bandwidth-frugal dp (docs/DISTRIBUTED.md): both flags are
+        # consumed HERE — the quantized reduce lays residual state into
+        # the opt-state pytree and update sharding re-shapes the moments,
+        # so a post-construction toggle raises (see _compress_active)
+        # instead of silently mis-reducing
+        self._quantized, self._shard_update = self._resolve_compress()
+        self._qerr_device = None    # banked per-step quantization-error
+        #                             norm (device-resident; fetched
+        #                             lazily by quantize_error())
         self._place_state()
+
+    # -- bandwidth-frugal dp (quantized all-reduce / update sharding) ----------
+    def _resolve_compress(self):
+        """Consume FLAGS_quantized_allreduce / FLAGS_shard_weight_update
+        at construction. Returns (quantized, shard_update) after
+        validating the config: both run the plain-dp shard_map step, so
+        ZeRO stages / gradient merge / tensor-parallel specs /
+        return_outputs are rejected loudly; localsgd/DGC silently ignore
+        the flags (they own their reduce — the PR 4 guard's carve-out).
+        Also captures bits/min-size and the eligibility set (float
+        params >= FLAGS_quantized_allreduce_min_size elements)."""
+        q = bool(_flags.get_flag("quantized_allreduce", False))
+        s = bool(_flags.get_flag("shard_weight_update", False))
+        self._qar_bits = int(_flags.get_flag("quantized_allreduce_bits", 8))
+        self._qar_min_size = int(
+            _flags.get_flag("quantized_allreduce_min_size", 1024))
+        self._qar_eligible = frozenset()
+        self._shard_state_keys = {}
+        self._shard_ps = {}
+        if not (q or s) or self.localsgd_k or self._is_dgc():
+            return False, False
+        names = ("FLAGS_quantized_allreduce" if q else "") \
+            + ("+" if q and s else "") \
+            + ("FLAGS_shard_weight_update" if s else "")
+        if self.sharding_stage > 0:
+            raise ValueError(
+                f"{names} targets the plain-dp path; sharding_stage="
+                f"{self.sharding_stage} already reduce-scatters through "
+                "XLA's ZeRO shardings — pick one (docs/DISTRIBUTED.md "
+                "composition matrix)")
+        if self.accumulate_steps > 1:
+            raise ValueError(
+                f"{names} does not compose with gradient merge "
+                "(accumulate_steps > 1) yet")
+        if self.extra_param_specs:
+            raise ValueError(
+                f"{names} does not compose with tensor-parallel "
+                "extra_param_specs (params must be replicated over dp)")
+        if self.return_outputs:
+            raise ValueError(
+                f"{names} steps run under shard_map, which does not "
+                "thread network outputs (same restriction as "
+                "localsgd/DGC)")
+        if q:
+            from . import compress as _compress
+
+            _compress._check_bits(self._qar_bits)
+            self._qar_eligible = frozenset(
+                n for n, v in self.params.items()
+                if jnp.issubdtype(v.dtype, jnp.floating)
+                and v.size >= self._qar_min_size)
+        if s and type(self.optimizer).__name__ in ("Lamb", "Lars",
+                                                   "LarsMomentum"):
+            raise ValueError(
+                "FLAGS_shard_weight_update needs an elementwise update "
+                f"rule; {type(self.optimizer).__name__}'s trust-ratio "
+                "reads whole-parameter norms, which a 1/dp shard cannot "
+                "see (docs/DISTRIBUTED.md)")
+        return q, s
+
+    def _compress_active(self):
+        """FLAGS_quantized_allreduce was consumed at construction (the
+        error-feedback residuals ride the opt-state pytree laid out
+        then); a post-construction toggle is loud instead of silently
+        mis-reducing. localsgd/DGC carve-out as for the PR 4 guard —
+        the disarmed check is one get_flag + compare."""
+        q = bool(_flags.get_flag("quantized_allreduce", False))
+        if q != self._quantized and not self.localsgd_k \
+                and not self._is_dgc():
+            raise RuntimeError(
+                "FLAGS_quantized_allreduce changed after this trainer "
+                "was constructed; the quantized reduce lays out its "
+                "error-feedback residual state at __init__ — build a "
+                "new SpmdTrainer under the new flag value")
+        return self._quantized
+
+    def _shard_update_active(self):
+        """FLAGS_shard_weight_update, same construction-time contract
+        as _compress_active (the optimizer moments are stored sharded)."""
+        s = bool(_flags.get_flag("shard_weight_update", False))
+        if s != self._shard_update and not self.localsgd_k \
+                and not self._is_dgc():
+            raise RuntimeError(
+                "FLAGS_shard_weight_update changed after this trainer "
+                "was constructed; update sharding re-shapes the "
+                "optimizer-state pytree at __init__ — build a new "
+                "SpmdTrainer under the new flag value")
+        return self._shard_update
 
     # -- sharding placement ----------------------------------------------------
     def _offload_state_shardings(self, force=False):
@@ -321,6 +426,83 @@ class SpmdTrainer:
             self.opt_state = {
                 pname: (owned_device_put(st, self.s_shardings[pname]) if pname == "__step__"
                         else {k: owned_device_put(v, self.s_shardings[pname][k]) for k, v in st.items()})
+                for pname, st in self.opt_state.items()
+            }
+            return
+        if self._quantized or self._shard_update:
+            # bandwidth-frugal dp layout (docs/DISTRIBUTED.md): params and
+            # buffers replicated (the step all-gathers updated params
+            # itself when sharding the update); with shard_weight_update
+            # every param-shaped optimizer moment is flattened, padded,
+            # and stored [dp, shard] over the dp axis (scalar state like
+            # Adam's beta powers stays replicated — its update is
+            # rank-invariant); with quantized_allreduce each eligible
+            # param carries a per-rank error-feedback residual
+            # [dp, *shape] under the reserved __qar_residual__ key
+            ndp = mesh.shape[ax]
+            block = 1
+            if self._quantized:
+                from . import compress as _compress
+
+                block = _compress.DEFAULT_BLOCK
+            self.p_shardings = {k: NamedSharding(mesh, P())
+                                for k in self.params}
+            self.b_shardings = {k: NamedSharding(mesh, P())
+                                for k in self.buffers}
+            if self._shard_update:
+                for k, v in self.params.items():
+                    if k in self._qar_eligible:
+                        # the quantized exchange hands each rank whole
+                        # blocks — the state shard must line up with it
+                        unit = block * ndp
+                        self._shard_ps[k] = (-(-int(v.size) // unit)
+                                             * unit) // ndp
+                    else:
+                        self._shard_ps[k] = -(-int(v.size) // ndp)
+            s_sh, new_state = {}, {}
+            for pname, st in self.opt_state.items():
+                if pname == "__step__":
+                    s_sh[pname] = NamedSharding(mesh, P())
+                    new_state[pname] = st
+                    continue
+                p = self.params[pname]
+                sub_sh, sub, sharded_keys = {}, {}, set()
+                for k, v in st.items():
+                    if (self._shard_update
+                            and getattr(v, "shape", None) == p.shape):
+                        ps = self._shard_ps[pname]
+                        flat = jnp.pad(jnp.ravel(v),
+                                       (0, ps * ndp - int(v.size)))
+                        sub[k] = flat.reshape(ndp, ps)
+                        sub_sh[k] = NamedSharding(mesh, P(ax))
+                        sharded_keys.add(k)
+                    else:
+                        sub[k] = v
+                        sub_sh[k] = NamedSharding(mesh, P())
+                s_sh[pname] = sub_sh
+                new_state[pname] = sub
+                self._shard_state_keys[pname] = sharded_keys
+            if self._quantized:
+                res_sh, res = {}, {}
+                for name in sorted(self._qar_eligible):
+                    v = self.params[name]
+                    res[name] = jnp.zeros((ndp,) + tuple(v.shape),
+                                          jnp.float32)
+                    res_sh[name] = NamedSharding(mesh, P(ax))
+                new_state["__qar_residual__"] = res
+                s_sh["__qar_residual__"] = res_sh
+            self.s_shardings = s_sh
+            self.opt_state = new_state
+            self.params = {k: owned_device_put(v, self.p_shardings[k])
+                           for k, v in self.params.items()}
+            self.buffers = {k: owned_device_put(v, self.b_shardings[k])
+                            for k, v in self.buffers.items()}
+            self.opt_state = {
+                pname: (owned_device_put(st, self.s_shardings[pname])
+                        if pname == "__step__"
+                        else {k: owned_device_put(v,
+                                                  self.s_shardings[pname][k])
+                              for k, v in st.items()})
                 for pname, st in self.opt_state.items()
             }
             return
@@ -430,6 +612,8 @@ class SpmdTrainer:
             return self._build_localsgd(batch_arrays)
         if self._is_dgc():
             return self._build_dgc(batch_arrays)
+        if self._compress_active() or self._shard_update_active():
+            return self._build_dp_compressed(batch_arrays)
         mesh = self.mesh
         ax = self.dp_axis
         fwd = self._wrapped_forward()
@@ -541,18 +725,31 @@ class SpmdTrainer:
         return jax.jit(step, in_shardings=in_shardings, out_shardings=out_shardings,
                        donate_argnums=(0, 1))
 
-    def _shard_map(self, f, in_specs, out_specs):
+    def _shard_map(self, f, in_specs, out_specs, check_rep=True):
+        """check_rep=False is for bodies whose replicated outputs flow
+        through all_gather: the values are identical on every rank by
+        construction (deterministic dequantize of identical gathered
+        bytes), but static rep-inference cannot prove it — the compressed
+        dp step's tests assert the cross-replica equality dynamically."""
         ax = self.dp_axis
         try:
             return jax.shard_map(f, mesh=self.mesh, in_specs=in_specs,
-                                 out_specs=out_specs, axis_names={ax})
+                                 out_specs=out_specs, axis_names={ax},
+                                 **({} if check_rep
+                                    else {"check_vma": False}))
         except (AttributeError, TypeError):
             try:
                 from jax import shard_map as sm
             except ImportError:
                 from jax.experimental.shard_map import shard_map as sm
 
-            return sm(f, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs)
+            try:
+                return sm(f, mesh=self.mesh, in_specs=in_specs,
+                          out_specs=out_specs,
+                          **({} if check_rep else {"check_rep": False}))
+            except TypeError:
+                return sm(f, mesh=self.mesh, in_specs=in_specs,
+                          out_specs=out_specs)
 
     def _build_localsgd(self, batch_arrays):
         """LocalSGD (fleet/meta_optimizers/localsgd_optimizer.py parity, SPMD):
@@ -683,6 +880,317 @@ class SpmdTrainer:
         return jax.jit(step, in_shardings=in_shardings,
                        out_shardings=out_shardings, donate_argnums=(0, 1))
 
+    def _build_dp_compressed(self, batch_arrays):
+        """Plain-dp train step with an EXPLICIT gradient exchange
+        (shard_map over dp) replacing the jit path's XLA-inserted psum,
+        so the wire format is ours to choose (docs/DISTRIBUTED.md):
+
+        - FLAGS_quantized_allreduce (EQuARX, arXiv:2506.17615): eligible
+          grads are padded to quantization blocks, error-feedback
+          corrected, bundled, and moved through
+          compress.quantized_all_reduce_ef — int8 on the wire, float32
+          accumulation, stochastic rounding keyed off the step rng;
+          per-layer residuals ride the opt-state pytree as
+          __qar_residual__. Small/non-float grads stay on the exact fp32
+          pmean.
+        - FLAGS_shard_weight_update (arXiv:2004.13336): per param, grads
+          are reduce-scattered, the optimizer update runs on each
+          replica's 1/dp shard against its sharded moments, and only the
+          UPDATED param all-gathers back — no replica computes the same
+          update twice. Composed with the quantized flag, the quantized
+          exchange's scatter phase feeds the sharded update directly
+          (the fp32 all-reduce never exists in any form).
+
+        The PR 4 guard threads through: the finiteness verdict is taken
+        on the RAW local loss/grads before any quantization and pmin'd
+        across ranks, and the where-select restores params, buffers, AND
+        the residuals/sharded moments bit-exactly — a skipped step
+        carries no quantization poison forward. The numerics telescope's
+        stats leg reads the REDUCED grads, with the per-layer non-finite
+        element counts psum'd from the raw local grads so a poisoned
+        step still names the dying layer."""
+        from . import collective as _coll
+        from . import compress as _compress
+        from ..optimizer.optimizer import _GLOBAL_NORM_TYPES
+
+        mesh, ax = self.mesh, self.dp_axis
+        ndp = mesh.shape[ax]
+        opt = self.optimizer
+        fwd = self._wrapped_forward()
+        quant, shard_upd = self._quantized, self._shard_update
+        bits, block = self._qar_bits, _compress.DEFAULT_BLOCK
+        guard = self._guard_active()
+        narmed = self._numerics_active()
+        if narmed:
+            from ..monitor import numerics as _numerics
+
+            stat_layers = sorted(self.params)
+        eligible = self._qar_eligible
+        pnames = list(self.params)
+        shapes = {n: (tuple(v.shape), int(v.size), v.dtype)
+                  for n, v in self.params.items()}
+        has_clip = (opt._grad_clip is not None
+                    and isinstance(opt._grad_clip, _GLOBAL_NORM_TYPES))
+
+        # static bundle plan for the fused quantized reduce (quant-only
+        # mode): each eligible grad padded to whole blocks so no scale
+        # spans two layers, then one exchange moves the whole bundle
+        plan, bundle = [], 0
+        if quant and not shard_upd:
+            for name in pnames:
+                if name in eligible:
+                    L = -(-shapes[name][1] // block) * block
+                    plan.append((name, bundle, L))
+                    bundle += L
+            unit = block * ndp
+            bundle = -(-bundle // unit) * unit if bundle else 0
+
+        def step(params, opt_state, buffers, lr, rng, *batch):
+            def local(params, state_r, buffers, lr, rng, *batch_local):
+                res_in = state_r.get("__qar_residual__", {})
+                st_in = {n: v for n, v in state_r.items()
+                         if n != "__qar_residual__"}
+                # differentiate against VARYING params so grads stay
+                # rank-local and the explicit exchange below is the one
+                # true cross-rank reduce (see _pvary)
+                params_v = {n: _pvary(p, ax) for n, p in params.items()}
+
+                def loss_fn(pp, b):
+                    loss, nb, _ = fwd(pp, buffers, b, rng)
+                    return loss.astype(jnp.float32), nb
+
+                (loss, new_buf), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params_v, batch_local)
+                qkey = jax.random.fold_in(rng, 0x514152)
+                finite = None
+                if guard:
+                    # verdict on the RAW local values, agreed across
+                    # ranks BEFORE any quantization touches the grads
+                    finite = jnp.isfinite(loss)
+                    for g in jax.tree_util.tree_leaves(grads):
+                        finite = jnp.logical_and(
+                            finite, jnp.all(jnp.isfinite(g)))
+                    finite = jax.lax.pmin(
+                        finite.astype(jnp.int32), ax) > 0
+                raw_nonf = None
+                if narmed:
+                    raw_nonf = jax.lax.psum(jnp.stack([
+                        jnp.sum(~jnp.isfinite(
+                            grads[n].astype(jnp.float32))
+                        ).astype(jnp.float32)
+                        for n in stat_layers]), ax)
+
+                red = {}          # name -> full-shape MEAN grad (f32)
+                g_shards = {}     # name -> [ps] MEAN grad shard (f32)
+                res_out = {}
+                qerr_sq = jnp.zeros((), jnp.float32)
+                if plan and bundle:
+                    parts, logical = [], 0
+                    for name, off, L in plan:
+                        g32 = grads[name].astype(jnp.float32).ravel()
+                        inp = (g32 + res_in[name][0]
+                               .astype(jnp.float32).ravel())
+                        parts.append(jnp.pad(inp, (0, L - g32.shape[0])))
+                        logical += shapes[name][1] * 4
+                    tail = bundle - sum(L for _, _, L in plan)
+                    if tail:
+                        parts.append(jnp.zeros((tail,), jnp.float32))
+                    flat = (jnp.concatenate(parts) if len(parts) > 1
+                            else parts[0])
+                    _coll.record_compressed(
+                        "quantized_all_reduce", logical,
+                        bundle * bits // 8 + (bundle // block) * 4)
+                    reduced, local_rt = _compress.quantized_all_reduce_ef(
+                        flat, ax, qkey, bits=bits, block=block)
+                    for name, off, L in plan:
+                        shape, size, _ = shapes[name]
+                        red[name] = (reduced[off:off + size]
+                                     / ndp).reshape(shape)
+                        r_new = (flat[off:off + size]
+                                 - local_rt[off:off + size]).reshape(shape)
+                        res_out[name] = r_new
+                        qerr_sq = qerr_sq + jnp.sum(r_new * r_new)
+                if shard_upd:
+                    for i, name in enumerate(pnames):
+                        shape, size, _ = shapes[name]
+                        ps = self._shard_ps[name]
+                        g32 = grads[name].astype(jnp.float32).ravel()
+                        if name in eligible:
+                            inp = (g32 + res_in[name][0]
+                                   .astype(jnp.float32).ravel())
+                            flat = jnp.pad(inp, (0, ps * ndp - size))
+                            _coll.record_compressed(
+                                "quantized_reduce_scatter", size * 4,
+                                ps * ndp * bits // 8
+                                + (ps * ndp // block) * 4)
+                            shard_sum, local_rt = _compress._exchange_reduce(
+                                flat, ax, jax.random.fold_in(qkey, i),
+                                bits, block)
+                            r_new = (inp - local_rt[:size]).reshape(shape)
+                            res_out[name] = r_new
+                            qerr_sq = qerr_sq + jnp.sum(r_new * r_new)
+                        else:
+                            flat = jnp.pad(g32, (0, ps * ndp - size))
+                            _monitor.record_collective(
+                                "reduce-scatter",
+                                _monitor.tensor_nbytes(flat))
+                            shard_sum = jax.lax.psum_scatter(
+                                flat, ax, tiled=True)
+                        g_shards[name] = shard_sum / ndp
+                else:
+                    for name in pnames:
+                        if name not in red:
+                            g = grads[name]
+                            _monitor.record_collective(
+                                "all-reduce", _monitor.tensor_nbytes(g))
+                            red[name] = jax.lax.pmean(g, ax)
+
+                # ---- optimizer update ---------------------------------
+                if shard_upd:
+                    wd = jnp.asarray(opt._wd, jnp.float32)
+                    stats_red = None
+                    if narmed:
+                        # the telescope reads full-shape reduced grads
+                        # (pre-clip, like the plain path); gathering them
+                        # is diagnostic-only traffic
+                        stats_red = {}
+                        for name in pnames:
+                            shape, size, _ = shapes[name]
+                            full = jax.lax.all_gather(
+                                g_shards[name], ax, tiled=True)
+                            stats_red[name] = full[:size].reshape(shape)
+                    if has_clip:
+                        local_sq = sum(jnp.sum(v * v)
+                                       for v in g_shards.values())
+                        gnorm = jnp.sqrt(jax.lax.psum(local_sq, ax))
+                        clip_norm = opt._grad_clip.clip_norm
+                        scale = clip_norm / jnp.maximum(gnorm, clip_norm)
+                        g_shards = {k: v * scale
+                                    for k, v in g_shards.items()}
+                    idx = jax.lax.axis_index(ax)
+                    new_params, new_st = {}, {}
+                    for name in pnames:
+                        shape, size, dtype = shapes[name]
+                        ps = self._shard_ps[name]
+                        p = params[name]
+                        p_flat = jnp.pad(jnp.ravel(p),
+                                         (0, ps * ndp - size))
+                        p_shard = jax.lax.dynamic_slice_in_dim(
+                            p_flat, idx * ps, ps)
+                        sharded = self._shard_state_keys.get(name, set())
+                        st_shard = {k: (v[0] if k in sharded else v)
+                                    for k, v in st_in[name].items()}
+                        new_p_shard, new_st_shard = opt._rule_with_decay(
+                            p_shard, g_shards[name].astype(p.dtype),
+                            st_shard, lr, wd)
+                        _monitor.record_collective(
+                            "all-gather",
+                            _monitor.tensor_nbytes(new_p_shard) * ndp)
+                        full = jax.lax.all_gather(new_p_shard, ax,
+                                                  tiled=True)
+                        new_params[name] = full[:size].reshape(shape)
+                        new_st[name] = {
+                            k: (v[None] if k in sharded else v)
+                            for k, v in new_st_shard.items()}
+                    new_st["__step__"] = st_in["__step__"] + 1
+                else:
+                    stats_red = red
+                    new_params, new_st = opt.functional_apply(
+                        params, red, st_in, lr=lr)
+
+                loss_red = jax.lax.pmean(loss, ax)
+                nstats = None
+                if narmed:
+                    nstats = _numerics.device_stats(
+                        stat_layers, loss_red, stats_red, params,
+                        new_params)
+                    # raw-grad attribution: the reduced grads a poisoned
+                    # step produces are already NaN-scaled, but the
+                    # per-layer ELEMENT counts must come from the raw
+                    # local grads (psum'd above) to match the plain
+                    # path's naming contract
+                    nstats = dict(nstats)
+                    nstats["nonfinite"] = raw_nonf
+                if quant:
+                    new_st = dict(new_st)
+                    new_st["__qar_residual__"] = {
+                        n: res_out[n][None] for n in res_out}
+                qerr = None
+                if quant:
+                    if guard:
+                        # a guard-skipped step restores the OLD
+                        # residuals — report THEIR norm, not the
+                        # poisoned one this step computed and discarded
+                        old_sq = jnp.zeros((), jnp.float32)
+                        for n in res_out:
+                            r_old = res_in[n][0].astype(jnp.float32)
+                            old_sq = old_sq + jnp.sum(r_old * r_old)
+                        qerr_sq = jnp.where(finite, qerr_sq, old_sq)
+                    qerr = jnp.sqrt(jax.lax.psum(qerr_sq, ax))
+                new_buffers = {n: jax.lax.pmean(v, ax)
+                               for n, v in new_buf.items()}
+                if guard:
+                    def keep(new, old):
+                        return jnp.where(finite, new, old)
+
+                    new_params = jax.tree_util.tree_map(
+                        keep, new_params, params)
+                    new_st = jax.tree_util.tree_map(
+                        keep, new_st, dict(state_r))
+                    new_buffers = jax.tree_util.tree_map(
+                        keep, new_buffers, buffers)
+                out = [loss_red, new_params, new_st, new_buffers]
+                if narmed:
+                    out.append(nstats)
+                if guard:
+                    out.append(finite)
+                if quant:
+                    out.append(qerr)
+                return tuple(out)
+
+            state_spec = {}
+            for pname, st in opt_state.items():
+                if pname == "__step__":
+                    state_spec[pname] = P()
+                elif pname == "__qar_residual__":
+                    state_spec[pname] = {k: P(ax) for k in st}
+                else:
+                    sharded = self._shard_state_keys.get(pname, set())
+                    state_spec[pname] = {
+                        k: (P(ax) if k in sharded else P()) for k in st}
+            in_specs = (
+                {n: P() for n in params}, state_spec,
+                {n: P() for n in buffers}, P(), P(),
+            ) + tuple(P(ax) for _ in batch)
+            out_specs = [P(), {n: P() for n in params}, state_spec,
+                         {n: P() for n in buffers}]
+            if narmed:
+                out_specs.append({k: P() for k in _numerics.STAT_KEYS})
+            if guard:
+                out_specs.append(P())
+            if quant:
+                out_specs.append(P())
+            return self._shard_map(local, in_specs, tuple(out_specs),
+                                   check_rep=False)(
+                params, opt_state, buffers, lr, rng, *batch)
+
+        batch_shard = NamedSharding(mesh, P(ax))
+        repl = NamedSharding(mesh, P())
+        in_shardings = (self.p_shardings, dict(self.s_shardings),
+                        self.b_shardings, repl,
+                        repl) + tuple(batch_shard for _ in batch_arrays)
+        out_shardings = [repl, self.p_shardings, dict(self.s_shardings),
+                         self.b_shardings]
+        if narmed:
+            out_shardings.append(_numerics.stat_shardings(repl))
+        if guard:
+            out_shardings.append(repl)
+        if quant:
+            out_shardings.append(repl)
+        return jax.jit(step, in_shardings=in_shardings,
+                       out_shardings=tuple(out_shardings),
+                       donate_argnums=(0, 1))
+
     # -- compile (lazy or warm-start) ------------------------------------------
     @staticmethod
     def _batch_sig_key(batch_arrays):
@@ -708,9 +1216,14 @@ class SpmdTrainer:
         # the guard/numerics legs change the compiled program's output
         # arity, so they are part of the executable's identity: toggling
         # either flag recompiles instead of mis-unpacking a stale
-        # executable
+        # executable. The compressed-dp legs join too (quantized adds
+        # the qerr output; both swap the whole program) — they are
+        # construction-time static, but _compress_active/_shard_update_
+        # active also make a post-hoc flag flip raise here instead of
+        # silently reusing the wrong executable
         return (self._batch_sig_key(batch_arrays), self._guard_active(),
-                self._numerics_active())
+                self._numerics_active(), self._compress_active(),
+                self._shard_update_active())
 
     def _aot_compile(self, batch_arrays, lr, rng, force=False):
         """Build the jitted step for THIS batch signature and obtain its
@@ -732,10 +1245,11 @@ class SpmdTrainer:
                 site="trainer", force=force or _trace.is_enabled(),
                 extra_key=("trainer", _aot.mesh_fingerprint(self.mesh),
                            self.dp_axis, self.sharding_stage,
-                           self.accumulate_steps, guarded, narmed))
-        self._compiled_store[self._exec_key(batch_arrays)] = (compiled,
-                                                              guarded,
-                                                              narmed)
+                           self.accumulate_steps, guarded, narmed,
+                           self._quantized, self._shard_update,
+                           self._qar_bits, self._qar_min_size))
+        self._compiled_store[self._exec_key(batch_arrays)] = (
+            compiled, guarded, narmed, self._quantized)
         self._compiled = compiled  # latest executable (back-compat handle)
         _aot.record_compile("trainer", sig, source)
         cost_entry = _costs.record("trainer", sig,
@@ -803,7 +1317,7 @@ class SpmdTrainer:
             source = "memory"
             if _monitor.is_enabled():
                 _aot.record_compile("trainer", sig_label, "memory")
-        compiled, guarded, narmed = entry
+        compiled, guarded, narmed, qleg = entry
         # exec window starts AFTER compile resolution: stats()/MFU must
         # divide flops by run time, not by jit-build + AOT-compile time
         # (step_latency_ms keeps its historical include-compile meaning)
@@ -834,6 +1348,11 @@ class SpmdTrainer:
                                                            out.pop(0))
             nstats = out.pop(0) if narmed else None
             finite = out.pop(0) if guarded else None
+            if qleg:
+                # the quantization-error norm stays device-resident
+                # until quantize_error()/stats() asks for it — no new
+                # per-step host sync
+                self._qerr_device = out.pop(0)
             if nstats is not None:
                 # keep the stats leg device-resident; the host fetch
                 # happens only every FLAGS_numerics_interval steps
@@ -900,6 +1419,22 @@ class SpmdTrainer:
             self._step_span = None
             _trace.add_counter_sample("trainer_step_ms", step_ms)
         return Tensor(loss)
+
+    # -- quantized-reduce observability ----------------------------------------
+    def quantize_error(self):
+        """Host-fetch the last quantized step's global quantization-error
+        L2 norm — the error-feedback residual about to be re-injected —
+        and publish the lazy ``quantize_error_norm`` gauge. None until a
+        FLAGS_quantized_allreduce step has run; between calls the scalar
+        stays device-resident (no per-step host sync)."""
+        if self._qerr_device is None:
+            return None
+        val = float(np.asarray(self._qerr_device))
+        if _monitor.is_enabled() and np.isfinite(val):
+            from . import compress as _compress
+
+            _compress.error_gauge().set(val)
+        return val
 
     # -- numerics telescope ----------------------------------------------------
     def _numerics_note(self, nstats):
@@ -992,6 +1527,10 @@ class SpmdTrainer:
                 "nonfinite_streak": self._nonfinite_streak,
             },
             "device_memory": _costs.sample_device_memory(),
+            # quantized-reduce health: the last step's EF-residual norm
+            # (None unless FLAGS_quantized_allreduce built this trainer)
+            "quantize_error_norm": (self.quantize_error()
+                                    if self._quantized else None),
             # the numerics telescope's model-health snapshot (None until
             # FLAGS_numerics arms a step — the plain path never even
             # imports the module)

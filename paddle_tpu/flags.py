@@ -93,6 +93,38 @@ define_flag("numerics_interval", 1,
             "with FLAGS_numerics: fetch the on-device stats to the host "
             "every N train steps (the stats stay device-resident between "
             "fetches — no new per-step host sync)")
+define_flag("quantized_allreduce", False,
+            "EQuARX-style quantized gradient all-reduce "
+            "(distributed/compress.py, docs/DISTRIBUTED.md): on the "
+            "plain-dp SpmdTrainer path the per-step grad psum becomes an "
+            "int8-wire reduce (stochastic rounding, fp32 accumulation) "
+            "with per-layer error-feedback residuals riding the "
+            "optimizer-state pytree. Read at TRAINER CONSTRUCTION (the "
+            "residual state is laid out then) — changing it under a live "
+            "trainer raises instead of silently mis-reducing. localsgd/"
+            "DGC steps ignore it (they own their reduce), like the "
+            "FLAGS_check_nan_inf carve-out. Unset, the trainer never "
+            "imports the compress module and the step is byte-identical")
+define_flag("quantized_allreduce_bits", 8,
+            "wire width of the quantized all-reduce payload; 8 (int8) is "
+            "the supported format — anything else fails loudly at "
+            "trainer construction. Read at trainer construction")
+define_flag("quantized_allreduce_min_size", 1024,
+            "with FLAGS_quantized_allreduce: tensors smaller than this "
+            "many elements (and all non-float gradients) skip "
+            "quantization and stay on the exact fp32 reduce — the scale "
+            "overhead and risk aren't worth <4KB of wire. Read at "
+            "trainer construction")
+define_flag("shard_weight_update", False,
+            "arXiv:2004.13336-style cross-replica update sharding for "
+            "plain dp (docs/DISTRIBUTED.md): reduce-scatter the grads, "
+            "compute the optimizer update on each replica's 1/dp shard "
+            "(optimizer moments stored sharded — ZeRO-2-like memory), "
+            "all-gather the updated params; bit-compared EXACT against "
+            "the replicated update by tools/parity_check.py. Composes "
+            "with FLAGS_quantized_allreduce (the quantized exchange "
+            "feeds the sharded update). Read at trainer construction; "
+            "localsgd/DGC ignore it")
 define_flag("flash_attention_block", 0,
             "force the flash-attention Pallas block size (128/256/512); "
             "0 = auto (largest of 512/256/128 dividing seq). For on-chip "

@@ -149,6 +149,7 @@ def STAT_RESET(name):
 
 _COLL_CALLS = None
 _COLL_BYTES = None
+_COLL_SAVED = None
 
 
 def tensor_nbytes(x):
@@ -168,13 +169,21 @@ def tensor_nbytes(x):
         return 0
 
 
-def record_collective(kind, nbytes=0):
+def record_collective(kind, nbytes=0, saved_bytes=0):
     """Count one collective API call by HLO family (`kind` follows
     analysis/collectives.py naming: all-reduce, all-gather,
     reduce-scatter, all-to-all, collective-permute). Calls made inside a
     jit trace count once per TRACE (host-side accounting), mirroring the
-    static collective-count pass rather than a device profiler."""
-    global _COLL_CALLS, _COLL_BYTES
+    static collective-count pass rather than a device profiler.
+
+    `nbytes` is what actually crosses the interconnect: for uncompressed
+    ops that IS the logical payload (the PR 2 meaning, unchanged); for
+    wire-compressed ops (the quantized reduce family,
+    docs/DISTRIBUTED.md) the caller passes the encoded wire bytes here
+    and the fp32 bytes the encoding displaced as `saved_bytes`, which
+    land in the lazy ``collective_bytes_saved_total{op}`` counter —
+    ``bytes + saved`` recovers the dequantized logical payload."""
+    global _COLL_CALLS, _COLL_BYTES, _COLL_SAVED
     # flight-recorder byte tag BEFORE the monitor-enabled early-out: the
     # two recorders are independent flags, and the last collectives
     # before a wedge are prime evidence even with metrics off
@@ -189,11 +198,24 @@ def record_collective(kind, nbytes=0):
             labelnames=("op",))
         _COLL_BYTES = counter(
             "collective_bytes_total",
-            "payload bytes handed to collective API calls, by HLO family",
+            "bytes a collective API call puts on the wire, by HLO family "
+            "(== the logical payload except for wire-compressed ops, "
+            "whose fp32 displacement is collective_bytes_saved_total)",
             labelnames=("op",))
     _COLL_CALLS.labels(op=kind).inc()
     if nbytes:
         _COLL_BYTES.labels(op=kind).inc(nbytes)
+    if saved_bytes:
+        if _COLL_SAVED is None:
+            _COLL_SAVED = counter(
+                "collective_bytes_saved_total",
+                "fp32 bytes a wire-compressed collective (quantized "
+                "reduce family) did NOT move: logical payload minus the "
+                "int8+scales wire encoding counted in "
+                "collective_bytes_total (lazy — no series until a "
+                "compressed op runs)",
+                labelnames=("op",))
+        _COLL_SAVED.labels(op=kind).inc(saved_bytes)
 
 
 # the black-box flight recorder rides inside the monitor package (its

@@ -302,6 +302,59 @@ class TestCollectiveCount:
                        "reduce-scatter": 1}
 
 
+class TestQuantizedCollectiveClassifier:
+    """count_quantized_collectives: the int8 exchange/gather pair of a
+    wire-compressed all-reduce (distributed/compress.py), classified by
+    payload dtype so the perf-budget gate can pin exact counts."""
+
+    @staticmethod
+    def _pair(dtype):
+        def f(x):
+            q = x.astype(dtype).reshape(2, -1)
+            ex = jax.lax.all_to_all(q, "i", split_axis=0, concat_axis=0)
+            return jax.lax.all_gather(ex.reshape(-1)[:4], "i",
+                                      tiled=True)
+
+        return jax.make_jaxpr(f, axis_env=[("i", 2)])(jnp.ones(8))
+
+    def test_positive_int8_pair(self):
+        from paddle_tpu.analysis.collectives import \
+            count_quantized_collectives
+
+        got = count_quantized_collectives(self._pair(jnp.int8).jaxpr)
+        assert got == {"quantized-reduce-scatter": 1,
+                       "quantized-all-gather": 1}
+
+    def test_negative_fp32_pair_not_classified(self):
+        from paddle_tpu.analysis.collectives import \
+            count_quantized_collectives
+
+        got = count_quantized_collectives(self._pair(jnp.float32).jaxpr)
+        assert got == {"quantized-reduce-scatter": 0,
+                       "quantized-all-gather": 0}
+
+    def test_negative_plain_model(self):
+        from paddle_tpu.analysis.collectives import \
+            count_quantized_collectives
+
+        closed = jax.make_jaxpr(lambda x: x @ x)(jnp.ones((4, 4)))
+        got = count_quantized_collectives(closed.jaxpr)
+        assert sum(got.values()) == 0
+
+    def test_pass_emits_classification(self):
+        rep = run_passes(self._pair(jnp.int8),
+                         passes=["collective-count"])
+        msgs = [f.message for f in _by_pass(rep, "collective-count")]
+        assert any("quantized reduce family" in m for m in msgs), msgs
+
+    def test_pass_silent_without_quantized_ops(self):
+        closed = jax.make_jaxpr(lambda x: jax.lax.psum(x, "i"),
+                                axis_env=[("i", 2)])(1.0)
+        rep = run_passes(closed, passes=["collective-count"])
+        msgs = [f.message for f in _by_pass(rep, "collective-count")]
+        assert msgs and not any("quantized" in m for m in msgs)
+
+
 class TestUnshardedLargeTensor:
     def _mesh(self):
         return jax.sharding.Mesh(np.array(jax.devices()[:2]), ("dp",))

@@ -34,6 +34,14 @@ Checks (one entry per name in `passes`):
                      guard ever trips; a follow-up scale:nan step then
                      trips the guard AND the per-layer nonfinite
                      detector on the same step
+  quantized_nonfinite a trainer/batch=scale:nan failpoint under the
+                     FLAGS_quantized_allreduce path: the PR 4 guard
+                     still trips through the int8 reduce (NaN poisons
+                     the fp32 block scales, staying loud), params stay
+                     bit-identical, AND the error-feedback residuals
+                     are where-selected back bit-exactly — no
+                     quantization poison carried into the next step,
+                     which then trains normally
 
 Report format: the tools/graph_lint.py schema ({"tool", "passes",
 "targets": {name: {"name", "counts", "findings"}}, "totals"}), so CI reads
@@ -55,7 +63,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 PASSES = ["ckpt_atomic", "ckpt_fallback", "serving_deadline",
           "serving_slot_error", "serving_shed", "router_failover",
-          "stall_dump", "trainer_nonfinite", "numerics_anomaly"]
+          "stall_dump", "trainer_nonfinite", "numerics_anomaly",
+          "quantized_nonfinite"]
 
 
 def _finding(name, severity, message, where=""):
@@ -477,6 +486,93 @@ def _check_numerics_anomaly():
                 f"nonfinite on {sorted({a['layer'] for a in nonf})}")]
 
 
+def _check_quantized_nonfinite():
+    """Chaos-injected poison under the quantized reduce: a scale:nan
+    batch must trip the PR 4 guard THROUGH the int8 wire format (the NaN
+    rides the fp32 block scales — the int8 payload never decides), and
+    the where-select must restore params AND the error-feedback residuals
+    bit-exactly, so no quantization poison leaks into the next step."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.testing import failpoints as fp
+
+    name = "quantized_nonfinite"
+    old = {k: paddle.get_flags(["FLAGS_" + k])["FLAGS_" + k]
+           for k in ("quantized_allreduce", "quantized_allreduce_min_size",
+                     "check_nan_inf")}
+    paddle.set_flags({"quantized_allreduce": True,
+                      "quantized_allreduce_min_size": 1,
+                      "check_nan_inf": True})
+    try:
+        paddle.seed(0)
+        model = paddle.nn.Linear(8, 4)
+        opt = paddle.optimizer.AdamW(learning_rate=0.05,
+                                     parameters=model.parameters())
+        mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+        tr = SpmdTrainer(model, opt, loss_fn=paddle.nn.MSELoss(),
+                         mesh=mesh)
+        if not tr._quantized or not tr._qar_eligible:
+            return [_finding(name, "error",
+                             "scenario broken: the trainer did not arm "
+                             "the quantized reduce")]
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 8).astype(np.float32)
+        y = rng.randn(4, 4).astype(np.float32)
+        for _ in range(2):
+            tr.train_step(x, y)
+        snap_p = {k: np.asarray(v).copy() for k, v in tr.params.items()}
+        snap_r = {k: np.asarray(v).copy()
+                  for k, v in tr.opt_state["__qar_residual__"].items()}
+        if not any(np.any(v != 0) for v in snap_r.values()):
+            return [_finding(name, "error",
+                             "scenario broken: error-feedback residuals "
+                             "never became non-zero during baseline "
+                             "training")]
+        skipped = tr.stats()["breakdown"]["nonfinite_skipped_total"]
+        with fp.scoped("trainer/batch=scale:nan"):
+            loss = tr.train_step(x, y)
+        if not np.isnan(float(np.asarray(loss._data))):
+            return [_finding(name, "error",
+                             "poisoned batch did not produce a NaN loss "
+                             "through the quantized reduce — the int8 "
+                             "path swallowed the poison")]
+        if tr.stats()["breakdown"]["nonfinite_skipped_total"] \
+                != skipped + 1:
+            return [_finding(name, "error",
+                             "scale:nan step did not trip the "
+                             "FLAGS_check_nan_inf guard under the "
+                             "quantized path")]
+        drift = [k for k in snap_p
+                 if np.asarray(tr.params[k]).tobytes()
+                 != snap_p[k].tobytes()]
+        if drift:
+            return [_finding(name, "error",
+                             "non-finite quantized step leaked into "
+                             f"parameters: {drift}")]
+        poisoned = [k for k in snap_r
+                    if np.asarray(
+                        tr.opt_state["__qar_residual__"][k]).tobytes()
+                    != snap_r[k].tobytes()]
+        if poisoned:
+            return [_finding(name, "error",
+                             "error-feedback residuals were not "
+                             "where-selected back on the skipped step — "
+                             f"poison carried forward in: {poisoned}")]
+        after = tr.train_step(x, y)
+        if not np.isfinite(float(np.asarray(after._data))):
+            return [_finding(name, "error",
+                             "the step AFTER the skip is non-finite — "
+                             "residual state carried poison")]
+    finally:
+        paddle.set_flags(old)
+    return [_ok(name,
+                "NaN step skipped through the int8 reduce; params and "
+                "EF residuals bit-identical; next step trained clean")]
+
+
 def build_report(only=None):
     """Run the fault schedule; `only` restricts to a subset of PASSES
     (the model is only built when a serving check is selected)."""
@@ -491,6 +587,7 @@ def build_report(only=None):
         ("ckpt_fallback", _check_ckpt_fallback),
         ("trainer_nonfinite", _check_trainer_nonfinite),
         ("numerics_anomaly", _check_numerics_anomaly),
+        ("quantized_nonfinite", _check_quantized_nonfinite),
     ]
     if selected & {"serving_deadline", "serving_slot_error",
                    "serving_shed", "router_failover", "stall_dump"}:

@@ -6,6 +6,7 @@
     python tools/metrics_dump.py --blackbox               # flight recorder
     python tools/metrics_dump.py --federated              # 2-client FedAvg
     python tools/metrics_dump.py --numerics               # numerics telescope
+    python tools/metrics_dump.py --quantized              # int8 grad reduce
     python tools/metrics_dump.py --model bert --prometheus
     python tools/metrics_dump.py --all --json             # machine-readable
     python tools/metrics_dump.py --serving --trace        # + span summary
@@ -56,6 +57,21 @@ _REQUIRED = {
     # lr blow-up step
     "numerics": ("numerics_grad_norm", "numerics_update_ratio",
                  "numerics_anomaly_total"),
+    # the quantized all-reduce (docs/DISTRIBUTED.md): wire + saved bytes
+    # through the collective chokepoint, and the lazily-published
+    # error-feedback norm gauge; a label check below additionally pins
+    # the op=quantized_all_reduce series
+    "quantized": ("collective_bytes_total", "collective_bytes_saved_total",
+                  "quantize_error_norm", "compile_cache_total"),
+}
+
+#: (family, label, value) series that must exist in a target's snapshot,
+#: beyond the family-level check — compressed ops share their families
+#: with the uncompressed world, so the op label is the contract
+_REQUIRED_SERIES = {
+    "quantized": (("collective_bytes_total", "op", "quantized_all_reduce"),
+                  ("collective_bytes_saved_total", "op",
+                   "quantized_all_reduce")),
 }
 
 _DIMS = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
@@ -228,6 +244,45 @@ def run_numerics_loop(steps=5):
         paddle.set_flags(old)
 
 
+def run_quantized_loop(steps=2):
+    """The quantized all-reduce target: a tiny-GPT dp train step with
+    FLAGS_quantized_allreduce armed (consumed at trainer construction) —
+    moves collective_bytes_total{op=quantized_all_reduce} and
+    collective_bytes_saved_total through the chokepoint's trace-time
+    metering, and stats() publishes the quantize_error_norm gauge."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import flags
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM, GPTPretrainLoss
+
+    old = {k: flags.get_flag(k)
+           for k in ("quantized_allreduce", "quantized_allreduce_min_size")}
+    paddle.set_flags({"quantized_allreduce": True,
+                      "quantized_allreduce_min_size": 1024})
+    try:
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        model = GPTForCausalLM(GPTConfig(max_seq_len=64, **_DIMS))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+        trainer = SpmdTrainer(model, opt, loss_fn=GPTPretrainLoss(),
+                              mesh=mesh)
+        batch = [paddle.to_tensor(
+            rng.randint(0, 256, (2, 16)).astype(np.int32))
+            for _ in range(2)]
+        for _ in range(steps):
+            trainer.train_step(*batch)
+        st = trainer.stats()
+        return {"quantize_error_norm": st["quantize_error_norm"],
+                "steps": st["steps"]}
+    finally:
+        paddle.set_flags(old)
+
+
 def run_blackbox_loop(new_tokens=4):
     """The flight-recorder target: a short serving loop with the
     recorder ON, then one on-demand dump bundle into a throwaway dir —
@@ -274,7 +329,7 @@ def run_target(name, with_trace=False):
     monitor.reset()
     trace_summary = None
     kind = (name if name in ("serving", "router", "blackbox", "federated",
-                             "numerics")
+                             "numerics", "quantized")
             else "train")
     if with_trace:
         trace.clear()
@@ -290,6 +345,8 @@ def run_target(name, with_trace=False):
             run_federated_loop()
         elif kind == "numerics":
             run_numerics_loop()
+        elif kind == "quantized":
+            run_quantized_loop()
         else:
             run_train_step(name)
     finally:
@@ -305,6 +362,13 @@ def run_target(name, with_trace=False):
                 "pass": "metrics-present", "severity": "error",
                 "message": f"required metric family {req!r} missing or "
                            f"empty after the {name} run", "where": name})
+    for fam_name, lkey, lval in _REQUIRED_SERIES.get(kind, ()):
+        series = fams.get(fam_name, {}).get("series", [])
+        if not any(s.get("labels", {}).get(lkey) == lval for s in series):
+            findings.append({
+                "pass": "metrics-present", "severity": "error",
+                "message": f"required series {fam_name}{{{lkey}={lval}}} "
+                           f"missing after the {name} run", "where": name})
     from paddle_tpu.monitor import flatten
 
     for key, val in sorted(flatten(snap).items()):
@@ -359,9 +423,16 @@ def main(argv=None):
                          "lr step); exit 1 when the numerics_grad_norm/"
                          "numerics_update_ratio/numerics_anomaly_total "
                          "families are missing")
+    ap.add_argument("--quantized", action="store_true", dest="quantized",
+                    help="run the quantized all-reduce target (tiny-GPT "
+                         "dp step with FLAGS_quantized_allreduce armed); "
+                         "exit 1 unless collective_bytes_total"
+                         "{op=quantized_all_reduce} and "
+                         "collective_bytes_saved_total are present")
     ap.add_argument("--all", action="store_true",
                     help="all models + the serving loop + the router, "
-                         "flight-recorder, federated and numerics tiers")
+                         "flight-recorder, federated, numerics and "
+                         "quantized tiers")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the graph_lint-schema machine report")
     ap.add_argument("--prometheus", action="store_true",
@@ -382,12 +453,16 @@ def main(argv=None):
         targets.append("federated")
     if args.numerics:
         targets.append("numerics")
+    if args.quantized:
+        targets.append("quantized")
     if args.all:
         targets = list(MODEL_TARGETS) + ["serving", "router", "blackbox",
-                                         "federated", "numerics"]
+                                         "federated", "numerics",
+                                         "quantized"]
     if not targets:
         ap.error("pick a target: --model NAME, --serving, --router, "
-                 "--blackbox, --federated, --numerics or --all")
+                 "--blackbox, --federated, --numerics, --quantized or "
+                 "--all")
 
     report = build_report(targets, with_trace=args.with_trace)
     if args.as_json:

@@ -2,11 +2,15 @@
 candidate flag-sets and fail loudly (exit 1, naming the diverging step and
 stat) when a pair leaves its declared tolerance band.
 
-    python tools/parity_check.py --ab check_nan_inf     # PR 4 guard: exact
-    python tools/parity_check.py --ab use_bfloat16      # flag A/B: exact
-    python tools/parity_check.py --ab amp_bf16          # bf16 amp: banded
+    python tools/parity_check.py --ab check_nan_inf        # PR 4 guard: exact
+    python tools/parity_check.py --ab use_bfloat16         # flag A/B: exact
+    python tools/parity_check.py --ab amp_bf16             # bf16 amp: banded
+    python tools/parity_check.py --ab quantized_allreduce  # int8 reduce: banded
+    python tools/parity_check.py --ab shard_weight_update  # ZeRO-ish: EXACT
     python tools/parity_check.py --all
-    python tools/parity_check.py --perturb-lr 5 --json  # negative control
+    python tools/parity_check.py --perturb-lr 5 --json     # negative control
+    python tools/parity_check.py --ab quantized_allreduce --perturb-lr 6
+    # ^ runs the target AND its in-band negative control (must exit 1)
 
 The harness is paddle_tpu/testing/parity.py (docs/OBSERVABILITY.md
 "Numerics telescope"): both sides train the SAME seeded tiny GPT over
@@ -16,9 +20,12 @@ tolerance. ``--perturb-lr F`` runs the harness's own negative control — a
 candidate whose learning rate is scaled by F must diverge, and the run
 exits 1 naming where; CI uses it to prove the gate can actually fail.
 
-This is the acceptance gate ROADMAP item 2's quantized all-reduce plugs
-into: add its flag-set as a target with the loss band the quantization
-paper claims, and ship only when this exits 0.
+This IS the acceptance gate ROADMAP item 2 named: `quantized_allreduce`
+runs FLAGS_quantized_allreduce as the candidate inside its declared loss
+band, `shard_weight_update` pins FLAGS_shard_weight_update EXACT, and
+`--perturb-lr F` combined with `--ab NAME` re-runs each named target with
+the candidate's lr scaled by F under the SAME band — which must diverge
+(exit 1), proving the band is a gate and not a rubber stamp.
 
 Report format: the tools/graph_lint.py schema ({"tool", "passes",
 "targets": {name: {"name", "counts", "findings", "report"}}, "totals"})
@@ -88,6 +95,23 @@ AB_TARGETS = {
                                           amp_dtype="bfloat16"),
         reference_flags={}, candidate_flags={},
         loss_rtol=0.08, loss_atol=0.05, stat_rtol=0.6, stat_atol=0.1),
+    # ROADMAP item 2's quantized all-reduce (distributed/compress.py):
+    # int8 block-max quantization with stochastic rounding + error
+    # feedback is a genuinely lossy reduce — the declared band matches
+    # amp_bf16's (per-element error ~blockmax/127 ≈ bf16's 2^-8
+    # mantissa step, residual feedback keeping the drift bounded). THIS
+    # is the ship gate the flag must pass (docs/DISTRIBUTED.md)
+    "quantized_allreduce": dict(
+        reference_flags={},
+        candidate_flags={"quantized_allreduce": True},
+        loss_rtol=0.08, loss_atol=0.05, stat_rtol=0.6, stat_atol=0.1),
+    # arXiv:2004.13336 update sharding re-distributes WHERE the
+    # optimizer update is computed, not WHAT it computes: elementwise
+    # rules on 1/dp shards are the same arithmetic — verified EXACT
+    "shard_weight_update": dict(
+        reference_flags={},
+        candidate_flags={"shard_weight_update": True},
+        loss_rtol=0.0, loss_atol=0.0, stat_rtol=0.0, stat_atol=0.0),
 }
 
 
@@ -97,17 +121,29 @@ def _finding(name, severity, message, where=""):
 
 
 def run_target(name, steps=4, perturb_lr=None):
-    """Run one A/B; returns (report, findings). `perturb_lr` builds the
-    negative-control target instead (candidate lr scaled — MUST
-    diverge)."""
+    """Run one A/B; returns (report, findings). `perturb_lr` builds a
+    negative-control variant instead (candidate lr scaled — MUST
+    diverge): standalone (`name == "perturb_lr"`) under zero tolerance,
+    or — when `name` is a real target — under THAT target's own flags
+    and declared band, proving the band itself can fail (the CI lane's
+    companion run for the banded quantized_allreduce gate)."""
     from paddle_tpu.testing import parity
 
     if perturb_lr is not None:
-        spec = dict(
-            candidate_build=functools.partial(_build_trainer,
-                                              lr=1e-2 * perturb_lr),
-            reference_flags={}, candidate_flags={},
-            loss_rtol=0.0, loss_atol=0.0, stat_rtol=0.0, stat_atol=0.0)
+        if name in AB_TARGETS:
+            spec = dict(AB_TARGETS[name])
+            base = spec.get("candidate_build")
+            kw = dict(getattr(base, "keywords", None) or {})
+            kw["lr"] = 1e-2 * perturb_lr
+            spec["candidate_build"] = functools.partial(_build_trainer,
+                                                        **kw)
+        else:
+            spec = dict(
+                candidate_build=functools.partial(_build_trainer,
+                                                  lr=1e-2 * perturb_lr),
+                reference_flags={}, candidate_flags={},
+                loss_rtol=0.0, loss_atol=0.0, stat_rtol=0.0,
+                stat_atol=0.0)
     else:
         spec = AB_TARGETS[name]
     report = parity.run_parity(
@@ -140,24 +176,32 @@ def run_target(name, steps=4, perturb_lr=None):
 def build_report(targets, steps=4, perturb_lr=None):
     report = {"tool": "parity_check", "passes": list(targets), "targets": {},
               "totals": {"error": 0, "warning": 0, "info": 0}}
-    jobs = [(t, None) for t in targets]
+    jobs = [(t, t, None) for t in targets]
     if perturb_lr is not None:
-        jobs.append(("perturb_lr", perturb_lr))
-        report["passes"].append("perturb_lr")
-    for name, factor in jobs:
+        if targets:
+            # negative control per named target, in ITS band — MUST
+            # diverge (exit 1), proving each new gate can actually fail
+            for t in targets:
+                jobs.append((f"{t}+perturb_lr", t, perturb_lr))
+                report["passes"].append(f"{t}+perturb_lr")
+        else:
+            jobs.append(("perturb_lr", "perturb_lr", perturb_lr))
+            report["passes"].append("perturb_lr")
+    for label, name, factor in jobs:
         try:
             ab_report, findings = run_target(name, steps=steps,
                                              perturb_lr=factor)
         except Exception as e:   # a crashed A/B is a failed gate
             ab_report = None
-            findings = [_finding(name, "error",
+            findings = [_finding(label, "error",
                                  f"A/B crashed: {type(e).__name__}: {e}")]
         counts = {"error": 0, "warning": 0, "info": 0}
         for f in findings:
+            f["pass"] = label
             counts[f["severity"]] += 1
-        report["targets"][name] = {"name": name, "counts": counts,
-                                   "findings": findings,
-                                   "report": ab_report}
+        report["targets"][label] = {"name": label, "counts": counts,
+                                    "findings": findings,
+                                    "report": ab_report}
         for sev, n in counts.items():
             report["totals"][sev] += n
     return report
