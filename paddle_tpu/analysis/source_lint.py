@@ -21,6 +21,15 @@ BEFORE tracing:
       (docs/SERVING.md): models plug in through the DecodeModel registry
       (serving/decode_model.py), never by reaching into a model module's
       privates — that coupling is exactly what ISSUE 6 removed.
+  step-loop-host-sync : a per-step host pull (np.asarray /
+      jax.device_get / .item() / .block_until_ready()) inside the
+      trainer/serving HOT-PATH functions (SpmdTrainer.train_step's
+      implementation chain, ServingEngine.step's) — each one serializes
+      the dispatch pipeline once per step. The deliberate syncs (the
+      benchmark sync, the decode token fetch, the windowed deferred
+      guard drain, host-side batch ingest) carry
+      ``# lint: allow(step-loop-host-sync)``; anything new is an error
+      (the ISSUE 11 satellite: hot paths stay clean).
   nonreduced-client-output : a function in federated/ returns a
       ``client_map`` result that never passed through a ``federated_*``
       reduce (or ``collective.client_reduce``). Client-placed values
@@ -57,8 +66,28 @@ RULES = {
     "mutable-default-arg": "error",
     "private-model-import-in-serving": "error",
     "nonreduced-client-output": "error",
+    "step-loop-host-sync": "error",
     "syntax-error": "error",
 }
+
+#: per-step hot-path functions policed by step-loop-host-sync: the
+#: train-step and serving-step implementation chains. Keyed by the
+#: module's path relative to the paddle_tpu package root.
+HOT_PATHS = {
+    os.path.join("distributed", "spmd.py"): {
+        "train_step", "_train_step_impl", "_finish_step",
+        "_drain_verdicts"},
+    os.path.join("inference", "serving.py"): {
+        "step", "_step_inner", "_step_inner_sync", "_step_inner_async",
+        "_step_speculative", "_advance_prefill", "_activate",
+        "_admit_one_inner", "_advance_and_admit", "_dispatch_decode",
+        "_apply_decode"},
+}
+
+#: dotted call names that pull device values to the host
+_SYNC_CALLS = {"np.asarray", "numpy.asarray", "jax.device_get"}
+#: method names that pull device values to the host when called
+_SYNC_METHODS = {"item", "block_until_ready"}
 
 # shorthand markers accepted in allow(...) alongside the full rule name
 _RULE_ALIASES = {"nonreduced-client-output": ("client_output",)}
@@ -97,12 +126,13 @@ def _is_layer_class(cls):
 
 class _Visitor(ast.NodeVisitor):
     def __init__(self, rel_path, lines, traced, serving=False,
-                 federated=False):
+                 federated=False, hot_funcs=None):
         self.rel = rel_path
         self.lines = lines
         self.traced = traced
         self.serving = serving
         self.federated = federated
+        self.hot_funcs = hot_funcs or frozenset()
         self.findings = []
         self._func_stack = []
         self._class_stack = []
@@ -203,6 +233,11 @@ class _Visitor(ast.NodeVisitor):
             return False
         return self._func_stack[0].name not in _INIT_METHODS
 
+    def _in_hot_scope(self):
+        """Inside a policed per-step hot-path function (closures nested
+        in one count — they run per step too)."""
+        return any(f.name in self.hot_funcs for f in self._func_stack)
+
     # -- import rules -------------------------------------------------------
     def visit_ImportFrom(self, node):
         # serving tier: `from ..models.X import _private` (any nesting,
@@ -230,6 +265,18 @@ class _Visitor(ast.NodeVisitor):
         if self.federated and self._client_vals \
                 and self._is_reduce_call(node):
             self._mark_reduced(node)
+        if self.hot_funcs and self._in_hot_scope():
+            last = name.split(".")[-1]
+            if name in _SYNC_CALLS or (last in _SYNC_METHODS
+                                       and "." in name):
+                self._emit(
+                    "step-loop-host-sync", node.lineno,
+                    f"{name}(...) inside per-step hot path "
+                    f"{self._func_stack[-1].name}: a host pull here "
+                    "serializes the dispatch pipeline EVERY step — "
+                    "defer/batch the fetch (docs/PERF.md), or mark a "
+                    "deliberate sync with "
+                    "`# lint: allow(step-loop-host-sync)`")
         if self._in_traced_scope():
             if name.startswith(("np.random.", "numpy.random.")) or \
                     name in ("np.random", "numpy.random"):
@@ -250,17 +297,20 @@ class _Visitor(ast.NodeVisitor):
 
 
 def lint_source(source, rel_path="<string>", traced=True, serving=None,
-                federated=None):
+                federated=None, hot_funcs=None):
     """Lint one python source string; returns a list of Finding.
     serving=None / federated=None derive the tier flags from rel_path
-    (modules under inference|serving/ resp. federated/)."""
+    (modules under inference|serving/ resp. federated/); hot_funcs=None
+    derives the step-loop-host-sync function set from HOT_PATHS."""
     if serving is None:
         serving = _is_serving_module(rel_path)
     if federated is None:
         federated = _is_federated_module(rel_path)
+    if hot_funcs is None:
+        hot_funcs = HOT_PATHS.get(rel_path, frozenset())
     tree = ast.parse(source)
     v = _Visitor(rel_path, source.splitlines(), traced, serving=serving,
-                 federated=federated)
+                 federated=federated, hot_funcs=hot_funcs)
     v.visit(tree)
     v.findings.sort(key=lambda f: f.where)
     return v.findings

@@ -1,0 +1,98 @@
+"""Async double-buffered step dispatch (FLAGS_async_dispatch).
+
+jax already dispatches device work asynchronously; what serializes a
+train loop is the HOST — per-step verdict fetches, batch marshalling,
+admission bookkeeping — standing between one dispatch and the next.
+This module holds the host-side machinery the flag arms
+(docs/PERF.md):
+
+- :class:`StepHandle` — the lazy step result ``SpmdTrainer.train_step``
+  returns under the flag. It IS a :class:`~paddle_tpu.core.tensor.Tensor`
+  (the loss), so every existing caller keeps working; ``result()``
+  blocks for the device value and ``scheduled_step`` names the schedule
+  position the step was dispatched at.
+- the ``async_*`` metric families (created here, lazily, so a
+  flags-unset process never grows the series) and the blackbox provider
+  table, so a crash/stall bundle records how deep the in-flight
+  deferred-verdict window was when the process wedged.
+
+The deferred-verdict ledger itself lives on the trainer
+(``SpmdTrainer._pending_verdicts``): the non-async path defers the
+guard fetch by ONE step (docs/PERF.md "deferred guard") without ever
+importing this module — gate-pinned by tests/test_async_gate.py.
+"""
+import numpy as np
+
+from .. import monitor as _monitor
+from ..core.tensor import Tensor
+
+__all__ = ["StepHandle", "window_depth_gauge", "verdict_fetch_counter",
+           "blackbox_table"]
+
+_DEPTH_G = None
+_FETCH_C = None
+
+
+def window_depth_gauge(site="trainer"):
+    """``async_window_depth{site}`` — pending deferred verdicts at the
+    moment of a drain (how far the host ran ahead of the device's
+    verdicts). Labeled so ``monitor.reset()`` drops the children and the
+    family reads empty again (the metrics_dump --async missing-series
+    contract)."""
+    global _DEPTH_G
+    if _DEPTH_G is None:
+        _DEPTH_G = _monitor.gauge(
+            "async_window_depth",
+            "deferred non-finite-guard verdicts in flight when a drain "
+            "fetched them (FLAGS_async_dispatch; docs/PERF.md)",
+            labelnames=("site",))
+    return _DEPTH_G.labels(site=site)
+
+
+def verdict_fetch_counter(site="trainer"):
+    """``async_verdict_fetch_total{site}`` — host syncs spent on guard
+    verdicts: ONE per drain, covering up to FLAGS_async_window steps."""
+    global _FETCH_C
+    if _FETCH_C is None:
+        _FETCH_C = _monitor.counter(
+            "async_verdict_fetch_total",
+            "deferred guard-verdict drains (each fetches every pending "
+            "verdict in one device_get; <= 1 per FLAGS_async_window "
+            "steps on the steady-state async path)",
+            labelnames=("site",))
+    return _FETCH_C.labels(site=site)
+
+
+class StepHandle(Tensor):
+    """Lazy train-step result: a Tensor wrapping the (async-dispatched)
+    device loss, plus the step's schedule identity. Materializing it in
+    any Tensor way (``float()``, ``.numpy()``, ``np.asarray``) blocks
+    for the device value — fetch at a window boundary, not per step."""
+
+    def __init__(self, loss_data, scheduled_step, trainer=None):
+        super().__init__(loss_data)
+        #: optimizer schedule position this step was dispatched at
+        self.scheduled_step = int(scheduled_step)
+        self._trainer = trainer
+
+    def result(self):
+        """Block for the loss AND drain any pending guard verdicts (so
+        a deferred FloatingPointError surfaces here, not on an unrelated
+        later call). Returns the loss as a float."""
+        if self._trainer is not None:
+            self._trainer.guard_sync()
+        return float(np.asarray(self._data))
+
+
+def blackbox_table(trainer):
+    """The trainer's async-dispatch state for a blackbox dump bundle:
+    how deep the deferred-verdict window was when the process wedged."""
+    return {
+        "window": trainer._async_window,
+        "pending": len(trainer._pending_verdicts),
+        "max_depth": trainer._window_max_depth,
+        "verdict_fetches": trainer._verdict_fetches,
+        "nonfinite_skipped_total": trainer._nonfinite_total,
+        "nonfinite_streak": trainer._nonfinite_streak,
+        "prefetch_hits": trainer._prefetch_hits,
+    }

@@ -252,6 +252,27 @@ class SpmdTrainer:
         self._qerr_device = None    # banked per-step quantization-error
         #                             norm (device-resident; fetched
         #                             lazily by quantize_error())
+        # async double-buffered dispatch (docs/PERF.md): the flag and its
+        # window are consumed HERE (post-hoc toggles raise via
+        # _async_active); the deferred-guard ledger below exists on EVERY
+        # trainer — the non-async path defers the verdict fetch by one
+        # step, the armed path by up to FLAGS_async_window steps. Only
+        # the armed path imports distributed/async_dispatch.py.
+        self._async, self._async_window = self._resolve_async()
+        self._overlap_comm = self._resolve_overlap()
+        self._pending_verdicts = []  # [(schedule position, device bool)]
+        self._guard_abort = None     # undelivered deferred FloatingPointError
+        self._verdict_fetches = 0    # drains (host syncs) so far
+        self._window_max_depth = 0   # deepest in-flight window seen
+        self._prefetch_hits = 0      # prefetch()-staged batches consumed
+        self._prefetched = None      # (ids key, device arrays) or None
+        if self._async:
+            from . import async_dispatch as _async_mod
+
+            # crash/stall bundles record how deep the in-flight window
+            # was (weakly held — same contract as the serving provider)
+            _blackbox.register_provider("trainer_async", self,
+                                        _async_mod.blackbox_table)
         self._place_state()
 
     # -- bandwidth-frugal dp (quantized all-reduce / update sharding) ----------
@@ -341,6 +362,168 @@ class SpmdTrainer:
                 "optimizer-state pytree at __init__ — build a new "
                 "SpmdTrainer under the new flag value")
         return self._shard_update
+
+    # -- async double-buffered dispatch (docs/PERF.md) -------------------------
+    def _resolve_async(self):
+        """Consume FLAGS_async_dispatch / FLAGS_async_window at
+        construction. Returns (armed, window); window is 1 when the flag
+        is unset — the non-async deferred-by-one guard fetch."""
+        a = bool(_flags.get_flag("async_dispatch", False))
+        w = max(1, int(_flags.get_flag("async_window", 8))) if a else 1
+        return a, w
+
+    def _async_active(self):
+        """FLAGS_async_dispatch was consumed at construction (the step
+        handle/window machinery is armed then); a post-construction
+        toggle is loud instead of silently changing what train_step
+        returns. One get_flag + compare when disarmed."""
+        a = bool(_flags.get_flag("async_dispatch", False))
+        if a != self._async:
+            raise RuntimeError(
+                "FLAGS_async_dispatch changed after this trainer was "
+                "constructed; the step-handle/deferred-verdict window is "
+                "armed at __init__ — build a new SpmdTrainer under the "
+                "new flag value")
+        return self._async
+
+    def _resolve_overlap(self):
+        """Consume FLAGS_overlap_grad_comm at construction: per-layer
+        int8 exchange legs interleavable with backward compute. Only
+        meaningful on the quantized quant-only path — anything else is
+        rejected loudly (shard_weight_update already exchanges per leg);
+        localsgd/DGC ignore it like every compress flag."""
+        o = bool(_flags.get_flag("overlap_grad_comm", False))
+        if not o or self.localsgd_k or self._is_dgc():
+            return False
+        if not self._quantized:
+            raise ValueError(
+                "FLAGS_overlap_grad_comm splits the quantized gradient "
+                "exchange into per-layer legs — it requires "
+                "FLAGS_quantized_allreduce (docs/PERF.md overlap matrix)")
+        if self._shard_update:
+            raise ValueError(
+                "FLAGS_overlap_grad_comm composed with "
+                "FLAGS_shard_weight_update is redundant: the sharded "
+                "update already exchanges one quantized leg per param")
+        return True
+
+    def _overlap_active(self):
+        """Construction-time contract for FLAGS_overlap_grad_comm (the
+        leg structure is part of the compiled program's identity)."""
+        o = bool(_flags.get_flag("overlap_grad_comm", False))
+        if o != self._overlap_comm and not self.localsgd_k \
+                and not self._is_dgc():
+            raise RuntimeError(
+                "FLAGS_overlap_grad_comm changed after this trainer was "
+                "constructed; the per-leg exchange structure is compiled "
+                "in — build a new SpmdTrainer under the new flag value")
+        return self._overlap_comm
+
+    def _drain_verdicts(self, force=False, deliver=False):
+        """Host-fetch pending deferred guard verdicts and replay the
+        skip bookkeeping in dispatch order (docs/PERF.md "deferred
+        guard"). Without `force`, drains only when the window is full —
+        ONE host sync per FLAGS_async_window steps. A trailing skip
+        rolls the optimizer schedule position back (the device never
+        advanced __step__ for it — the retry contract holds); a streak
+        beyond FLAGS_max_skip_steps raises the same FloatingPointError
+        the per-step fetch used to, just up to a window later.
+
+        The abort is STICKY until delivered through a train_step call
+        (`deliver=True`): a drain triggered inside an observability
+        helper (stats() under a scraper's try/except) may have its
+        raise swallowed, but the run still cannot train past the limit
+        — the next train_step entry re-raises it."""
+        if self._guard_abort is not None:
+            err = self._guard_abort
+            if deliver:
+                self._guard_abort = None
+            raise err
+        pending = self._pending_verdicts
+        if not pending or (not force and len(pending) < self._async_window):
+            return
+        if len(pending) > self._window_max_depth:
+            self._window_max_depth = len(pending)
+        batch, self._pending_verdicts = pending, []
+        self._verdict_fetches += 1
+        if self._async and _monitor.is_enabled():
+            from . import async_dispatch as _async_mod
+
+            _async_mod.window_depth_gauge().set(len(batch))
+            _async_mod.verdict_fetch_counter().inc()
+        # ONE device_get for the whole window — THE deliberate host sync
+        # of the guard path (everything else stays device-resident)
+        vals = jax.device_get([v for _, v in batch])  # lint: allow(step-loop-host-sync)
+        raise_streak = None
+        for (pos, _), val in zip(batch, vals):
+            if bool(val):   # device_get above already landed it on host
+                self._nonfinite_streak = 0
+                continue
+            # the update was skipped ON DEVICE (params/state/buffers
+            # where-selected pre-update, __step__ included); the host
+            # learns now
+            self._nonfinite_streak += 1
+            self._nonfinite_total += 1
+            if pos == self.optimizer._step_count - 1:
+                # the skip is the NEWEST dispatch — nothing consumed
+                # the next schedule position yet, so rewind and the
+                # retry reuses this slot (the window-1 / sync-path
+                # retry contract, exactly). A MID-window skip's
+                # position is burned instead: later dispatches already
+                # advanced the schedule, and rewinding would hand the
+                # next dispatch an rng position an APPLIED step
+                # already consumed (duplicated dropout masks).
+                self.optimizer._step_count -= 1
+            _SKIPPED.labels(reason="nonfinite").inc()
+            if _trace.is_enabled():
+                # the skipping step's own span ended long ago — the
+                # trace-level skip signal lands at discovery time
+                with _trace.span("guard/skip", subsystem="trainer",
+                                 step=int(pos)):
+                    pass
+            max_skip = int(_flags.get_flag("max_skip_steps", 3))
+            if self._nonfinite_streak > max_skip:
+                raise_streak = self._nonfinite_streak
+        if raise_streak is not None:
+            max_skip = int(_flags.get_flag("max_skip_steps", 3))
+            err = FloatingPointError(
+                f"train_step: non-finite loss/gradients for "
+                f"{raise_streak} consecutive steps "
+                f"(> FLAGS_max_skip_steps={max_skip}); aborting — "
+                "every skipped step left parameters untouched (the "
+                "on-device where-select); finite steps dispatched LATER "
+                "in this deferred window (if any) applied normally "
+                "before the limit was discovered (docs/PERF.md); "
+                "inspect the data pipeline / learning rate")
+            if not deliver:
+                self._guard_abort = err   # sticky until train_step sees it
+            raise err
+
+    def guard_sync(self):
+        """Force-fetch every pending deferred guard verdict NOW: after
+        this, stats()/streak counters reflect every dispatched step and
+        a pending FloatingPointError surfaces here. The per-step fetch
+        the pre-async trainer did, on demand."""
+        self._drain_verdicts(force=True)
+
+    def prefetch(self, *batch):
+        """Stage the NEXT step's batch on device (async double-
+        buffering): device_put runs asynchronously, so the transfer
+        overlaps the in-flight step's compute. The next train_step call
+        made with the SAME array objects consumes the staged copies
+        instead of re-marshalling them. The originals are HELD here
+        until consumed (identity is the match key), and a train_step
+        over DIFFERENT arrays discards the staging. Standard
+        double-buffer contract: do not mutate a staged array in place
+        before the step that consumes it — the device copy was taken
+        at prefetch() time."""
+        from jax.sharding import NamedSharding as _NS
+
+        shard = _NS(self.mesh, P(self.dp_axis))
+        arrays = [jax.device_put(
+            b._data if isinstance(b, Tensor) else jnp.asarray(np.asarray(b)),
+            shard) for b in batch]
+        self._prefetched = (batch, arrays)
 
     # -- sharding placement ----------------------------------------------------
     def _offload_state_shardings(self, force=False):
@@ -934,16 +1117,26 @@ class SpmdTrainer:
 
         # static bundle plan for the fused quantized reduce (quant-only
         # mode): each eligible grad padded to whole blocks so no scale
-        # spans two layers, then one exchange moves the whole bundle
-        plan, bundle = [], 0
+        # spans two layers, then one exchange moves the whole bundle.
+        # FLAGS_overlap_grad_comm instead plans one leg per eligible
+        # layer: the legs are independent collectives XLA's scheduler is
+        # free to interleave with the remaining backward compute (the
+        # EQuARX hide-behind-compute condition; docs/PERF.md)
+        plan, bundle, legs = [], 0, []
         if quant and not shard_upd:
-            for name in pnames:
-                if name in eligible:
-                    L = -(-shapes[name][1] // block) * block
-                    plan.append((name, bundle, L))
-                    bundle += L
             unit = block * ndp
-            bundle = -(-bundle // unit) * unit if bundle else 0
+            if self._overlap_comm:
+                for name in pnames:
+                    if name in eligible:
+                        L = -(-shapes[name][1] // unit) * unit
+                        legs.append((name, L))
+            else:
+                for name in pnames:
+                    if name in eligible:
+                        L = -(-shapes[name][1] // block) * block
+                        plan.append((name, bundle, L))
+                        bundle += L
+                bundle = -(-bundle // unit) * unit if bundle else 0
 
         def step(params, opt_state, buffers, lr, rng, *batch):
             def local(params, state_r, buffers, lr, rng, *batch_local):
@@ -984,6 +1177,28 @@ class SpmdTrainer:
                 g_shards = {}     # name -> [ps] MEAN grad shard (f32)
                 res_out = {}
                 qerr_sq = jnp.zeros((), jnp.float32)
+                if legs:
+                    # overlapped per-layer legs: each eligible grad is
+                    # its own EF-corrected int8 exchange with a per-leg
+                    # rounding key — independent ops the scheduler can
+                    # pipeline against backward compute
+                    for i, (name, L) in enumerate(legs):
+                        shape, size, _ = shapes[name]
+                        g32 = grads[name].astype(jnp.float32).ravel()
+                        inp = (g32 + res_in[name][0]
+                               .astype(jnp.float32).ravel())
+                        flat = jnp.pad(inp, (0, L - size))
+                        _coll.record_compressed(
+                            "quantized_all_reduce", size * 4,
+                            L * bits // 8 + (L // block) * 4)
+                        reduced, local_rt = \
+                            _compress.quantized_all_reduce_ef(
+                                flat, ax, jax.random.fold_in(qkey, i),
+                                bits=bits, block=block)
+                        red[name] = (reduced[:size] / ndp).reshape(shape)
+                        r_new = (inp - local_rt[:size]).reshape(shape)
+                        res_out[name] = r_new
+                        qerr_sq = qerr_sq + jnp.sum(r_new * r_new)
                 if plan and bundle:
                     parts, logical = [], 0
                     for name, off, L in plan:
@@ -1223,7 +1438,7 @@ class SpmdTrainer:
         # silently reusing the wrong executable
         return (self._batch_sig_key(batch_arrays), self._guard_active(),
                 self._numerics_active(), self._compress_active(),
-                self._shard_update_active())
+                self._shard_update_active(), self._overlap_active())
 
     def _aot_compile(self, batch_arrays, lr, rng, force=False):
         """Build the jitted step for THIS batch signature and obtain its
@@ -1247,7 +1462,8 @@ class SpmdTrainer:
                            self.dp_axis, self.sharding_stage,
                            self.accumulate_steps, guarded, narmed,
                            self._quantized, self._shard_update,
-                           self._qar_bits, self._qar_min_size))
+                           self._qar_bits, self._qar_min_size,
+                           self._overlap_comm))
         self._compiled_store[self._exec_key(batch_arrays)] = (
             compiled, guarded, narmed, self._quantized)
         self._compiled = compiled  # latest executable (back-compat handle)
@@ -1296,8 +1512,28 @@ class SpmdTrainer:
         from ..core.generator import default_generator
 
         _failpoints.failpoint("trainer/step")
+        self._async_active()   # post-hoc toggle raises (ctor contract)
+        # deferred guard (docs/PERF.md): settle PREVIOUS steps' verdicts
+        # before this step's schedule position is read — a full window
+        # drains in ONE device_get; a trailing skip rewinds the
+        # schedule so this dispatch retries the skipped position.
+        # deliver=True: a sticky abort a swallowed stats() drain left
+        # behind is re-raised (and cleared) HERE, to train_step's caller
+        self._drain_verdicts(deliver=True)
         t_step = time.perf_counter()
-        batch_arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(np.asarray(b)) for b in batch]
+        pre, self._prefetched = self._prefetched, None
+        if pre is not None and len(pre[0]) == len(batch) \
+                and all(a is b for a, b in zip(pre[0], batch)):
+            # prefetch() already staged THESE arrays on device while the
+            # previous step ran — consume the copies, skip marshalling.
+            # (A non-matching step discards the staging: stale copies
+            # must not linger to be consumed many steps later.)
+            batch_arrays = pre[1]
+            self._prefetch_hits += 1
+        else:
+            batch_arrays = [b._data if isinstance(b, Tensor)
+                            else jnp.asarray(np.asarray(b))  # lint: allow(step-loop-host-sync)
+                            for b in batch]
         # value-transforming failpoint (scale:F) — chaos tests inject a
         # gradient spike / non-finite batch here; one boolean check when
         # nothing is armed (docs/ROBUSTNESS.md)
@@ -1357,28 +1593,15 @@ class SpmdTrainer:
                 # keep the stats leg device-resident; the host fetch
                 # happens only every FLAGS_numerics_interval steps
                 self._numerics_note(nstats)
-            if finite is not None and not bool(np.asarray(finite)):
-                # update was skipped ON DEVICE (params/state/buffers selected
-                # pre-update, bit-identical); the host decides whether the run
-                # survives. _step_count stays put: the skipped step retries
-                # with the same LR/rng schedule position.
-                self._nonfinite_streak += 1
-                self._nonfinite_total += 1
-                _SKIPPED.labels(reason="nonfinite").inc()
-                sp = self._step_span
-                if sp is not None:
-                    sp.set(skipped="nonfinite")
-                max_skip = int(_flags.get_flag("max_skip_steps", 3))
-                if self._nonfinite_streak > max_skip:
-                    raise FloatingPointError(
-                        f"train_step: non-finite loss/gradients for "
-                        f"{self._nonfinite_streak} consecutive steps "
-                        f"(> FLAGS_max_skip_steps={max_skip}); aborting — "
-                        "parameters are unchanged (all updates were skipped); "
-                        "inspect the data pipeline / learning rate")
-                return self._finish_step(loss, t_step, t_exec)
             if finite is not None:
-                self._nonfinite_streak = 0
+                # DEFERRED verdict (docs/PERF.md): the skip already
+                # happened on device if it happened at all — bank the
+                # device-resident verdict instead of syncing on it here.
+                # The schedule advances optimistically; _drain_verdicts
+                # rewinds it when a skip is discovered, so the loss
+                # trajectory is bit-exact with the old per-step fetch.
+                self._pending_verdicts.append(
+                    (int(self.optimizer._step_count), finite))
             self.optimizer._step_count += 1
             return self._finish_step(loss, t_step, t_exec)
         except BaseException:
@@ -1398,12 +1621,20 @@ class SpmdTrainer:
         includes any compile (the histogram's historical meaning);
         `t_exec` excludes it — that is what stats()/MFU accumulate, so a
         2-step run is not dominated by the first step's compile."""
+        # the handle's schedule identity, captured BEFORE the benchmark
+        # drain below may rewind the counter for this very step's skip
+        sched = int(self.optimizer._step_count) - 1
         sync_ms = 0.0
         if _flags.get_flag("benchmark"):
             t_sync = time.perf_counter()
             if hasattr(loss, "block_until_ready"):
-                loss.block_until_ready()
+                loss.block_until_ready()  # lint: allow(step-loop-host-sync)
             _BENCH_SYNC.labels(site="trainer").inc()
+            # the device is drained anyway: settle pending guard
+            # verdicts for free (same-call skip visibility under
+            # FLAGS_benchmark, exactly the pre-deferral semantics);
+            # deliver=True — this raise reaches train_step's caller
+            self._drain_verdicts(force=True, deliver=True)
             sync_ms = (time.perf_counter() - t_sync) * 1e3
         now = time.perf_counter()
         step_ms = (now - t_step) * 1e3
@@ -1418,6 +1649,10 @@ class SpmdTrainer:
             sp.end(sync_ms=sync_ms, step_ms=step_ms, exec_ms=exec_ms)
             self._step_span = None
             _trace.add_counter_sample("trainer_step_ms", step_ms)
+        if self._async:
+            from . import async_dispatch as _async_mod
+
+            return _async_mod.StepHandle(loss, sched, trainer=self)
         return Tensor(loss)
 
     # -- quantized-reduce observability ----------------------------------------
@@ -1495,6 +1730,10 @@ class SpmdTrainer:
         both a step has run and the cost registry holds this batch
         signature's entry (FLAGS_trace=1, FLAGS_jit_cache_dir, or
         aot_build() all populate it)."""
+        # settle deferred guard verdicts first: the skip counters below
+        # must reflect every dispatched step (one cheap device_get — by
+        # stats() time the steps in question have long completed)
+        self.guard_sync()
         # THIS trainer's entry first: the site-global table keys by batch
         # signature only, which two trainers over different models can
         # share (tools/metrics_dump.py --all does exactly that)
@@ -1525,6 +1764,12 @@ class SpmdTrainer:
                     0.0, self._step_ms_sum - self._sync_ms_sum),
                 "nonfinite_skipped_total": self._nonfinite_total,
                 "nonfinite_streak": self._nonfinite_streak,
+                # deferred-guard accounting (docs/PERF.md): host syncs
+                # spent on verdicts and how far the host ran ahead
+                "verdict_fetches": self._verdict_fetches,
+                "verdict_window": self._async_window,
+                "window_max_depth": self._window_max_depth,
+                "prefetch_hits": self._prefetch_hits,
             },
             "device_memory": _costs.sample_device_memory(),
             # quantized-reduce health: the last step's EF-residual norm

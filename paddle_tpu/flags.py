@@ -125,6 +125,40 @@ define_flag("shard_weight_update", False,
             "with FLAGS_quantized_allreduce (the quantized exchange "
             "feeds the sharded update). Read at trainer construction; "
             "localsgd/DGC ignore it")
+define_flag("async_dispatch", False,
+            "double-buffered step dispatch (docs/PERF.md): SpmdTrainer "
+            "returns a lazy StepHandle (distributed/async_dispatch.py), "
+            "the non-finite guard verdict is fetched in windows of "
+            "FLAGS_async_window steps instead of per step, and "
+            "ServingEngine.step overlaps admission/bookkeeping for the "
+            "next round with the current round's device compute. Read at "
+            "TRAINER/ENGINE CONSTRUCTION — a post-construction toggle "
+            "under a live trainer raises. Unset, the async module is "
+            "never imported and behavior is byte-identical")
+define_flag("async_window", 8,
+            "with FLAGS_async_dispatch: how many steps the host may run "
+            "ahead of the deferred non-finite-guard verdict fetch (the "
+            "FLAGS_max_skip_steps/FloatingPointError contract holds — "
+            "the host just learns about an on-device skip up to this "
+            "many steps later). 1 = fetch every step (the non-async "
+            "deferred-by-one behavior). Read at trainer construction")
+define_flag("overlap_grad_comm", False,
+            "with FLAGS_quantized_allreduce (quant-only mode): split the "
+            "fused int8 gradient exchange into per-layer legs so XLA's "
+            "scheduler can interleave the collective legs with backward "
+            "compute (EQuARX hides the quantized exchange behind "
+            "compute; docs/PERF.md overlap matrix). Changes the rounding "
+            "rng per leg — parity-banded vs the fused bundle. Read at "
+            "trainer construction; raises without quantized_allreduce "
+            "or combined with shard_weight_update (already per-leg)")
+define_flag("tpp_kernels", False,
+            "TPP-style Pallas micro-kernel registry (ops/tpp.py, "
+            "arXiv:2104.05755): GPT blocks route their fusion-hostile "
+            "hot ops — the fused MLP block and the layernorm->matmul "
+            "prologue — through blocked Pallas kernels (interpret-mode "
+            "on CPU). Read at trace time in models/gpt.py; unset, the "
+            "registry module is never imported and the traced program "
+            "is byte-identical")
 define_flag("flash_attention_block", 0,
             "force the flash-attention Pallas block size (128/256/512); "
             "0 = auto (largest of 512/256/128 dividing seq). For on-chip "

@@ -74,6 +74,7 @@ import time
 
 import numpy as np
 
+from .. import flags as _flags
 from .. import monitor as _monitor
 from ..monitor import blackbox as _blackbox
 from ..trace import costs as _costs
@@ -622,6 +623,18 @@ class ServingEngine:
                     in_specs=(tp_specs, cs, cs, P(), P(), P()),
                     donate=(1, 2)), label="verify")
 
+        # async double-buffered rounds (FLAGS_async_dispatch, docs/
+        # PERF.md): consumed at ENGINE CONSTRUCTION like the trainer's
+        # copy of the flag. Armed, step() dispatches round N's decode
+        # FIRST and runs round N+1's admission/bookkeeping while the
+        # device computes, fetching tokens last — the host work hides
+        # behind device compute. Speculative engines keep the sync step
+        # (the draft round's host orchestration is itself the dispatch).
+        self._async = bool(_flags.get_flag("async_dispatch", False))
+        self._async_ms = ({"dispatch_ms": 0.0, "overlap_ms": 0.0,
+                           "fetch_ms": 0.0, "rounds": 0}
+                          if self._async else None)
+
         # engine-local observability accumulators (the module-level monitor
         # metrics aggregate across engines; stats() reports THIS engine)
         self._m = {"submitted": 0, "finished": {}, "tokens": 0,
@@ -797,7 +810,13 @@ class ServingEngine:
     def _acc_ms(self, kind, t0):
         """Accumulate one step-kind slice's wall time (host-observed) for
         stats()['breakdown']; returns the elapsed ms."""
-        ms = (time.perf_counter() - t0) * 1e3
+        return self._acc_ms_value(kind, (time.perf_counter() - t0) * 1e3)
+
+    def _acc_ms_value(self, kind, ms):
+        """Accumulate an already-computed slice (the async step books
+        dispatch+fetch windows only — the overlapped admission window is
+        booked under its own kinds by _advance_and_admit, and counting
+        it twice would make the kinds sum past real wall time)."""
         st = self._m["step_ms"].setdefault(kind, [0, 0.0])
         st[0] += 1
         st[1] += ms
@@ -914,6 +933,17 @@ class ServingEngine:
                 flops_known = True
             kinds[kind] = row
         out = {"kinds": kinds, "wall_ms_total": total_ms}
+        if self._async_ms is not None:
+            # async rounds: how much of the decode wall time was host
+            # dispatch vs the overlapped admission window vs the token
+            # fetch — the dispatch-vs-sync fraction the async path
+            # exists to shrink (docs/PERF.md)
+            a = dict(self._async_ms)
+            covered = a["dispatch_ms"] + a["overlap_ms"] + a["fetch_ms"]
+            a["dispatch_fraction"] = (
+                (a["dispatch_ms"] + a["overlap_ms"]) / covered
+                if covered else 0.0)
+            out["async_overlap"] = a
         if flops_known:
             out["device_flops_total"] = flops_total
             peak = _costs.peak_flops()
@@ -1415,6 +1445,105 @@ class ServingEngine:
         if sp is not None:
             sp.end()
 
+    def _note_occupancy(self, active):
+        self._m["occupancy_sum"] += len(active)
+        self._m["occupancy_steps"] += 1
+        _OCCUPANCY.set(len(active))
+        _trace.add_counter_sample("serving_batch_occupancy", len(active))
+
+    def _dispatch_decode(self, active):
+        """Enqueue ONE decode program for the active slots (device work
+        starts immediately — jax dispatch is asynchronous). Host-side
+        dispatch: an all-greedy batch keeps the lean argmax step (no
+        sort/categorical in its compiled program at all); inactive slots
+        ride along harmlessly — their rows are don't-care (freed) and
+        re-prefilled on admission. Returns (device tokens, kind)."""
+        import jax.numpy as jnp
+
+        if any(self._temps[s] > 0 for s in active):
+            kind = "decode_sample"
+            next_toks, self._kc, self._vc = self._step_sample(
+                self._params, self._kc, self._vc,
+                jnp.asarray(self._last), jnp.asarray(self._pos),
+                jnp.asarray(self._temps), jnp.asarray(self._topk),
+                jnp.asarray(self._topp), jnp.asarray(self._seeds))
+        else:
+            kind = "decode_greedy"
+            next_toks, self._kc, self._vc = self._step_greedy(
+                self._params, self._kc, self._vc,
+                jnp.asarray(self._last), jnp.asarray(self._pos))
+        self._count_step(kind)
+        return next_toks, kind
+
+    def _apply_decode(self, active, next_toks, kind, t0_ns, t1_ns):
+        """Emit one fetched round's tokens slot by slot. Per-slot
+        failures isolate (the failing request finishes reason="error");
+        the slot-level decode span attributes the batched device step's
+        window to each request."""
+        for s in active:
+            req = self._slot_req[s]
+            try:
+                _fp.failpoint("serving/slot")
+                self._pos[s] += 1
+                self._last[s] = next_toks[s]
+                req.output_ids.append(int(next_toks[s]))
+                if req._span is not None:
+                    _trace.emit("decode", t0_ns, t1_ns,
+                                subsystem="serving", parent=req._span,
+                                slot=s, pos=int(self._pos[s]),
+                                kind=kind, token=int(next_toks[s]))
+                self._after_emit(s, req)
+            except Exception:
+                if self._slot_req[s] is not None:
+                    self._finish_req(req, "error", slot=s)
+                self._note_error()
+
+    def _advance_and_admit(self):
+        """The round's admission window, shared by the sync and async
+        steps: advance every in-flight chunked prefill ONE chunk (so
+        active decodes never wait for a whole long prefill), then admit
+        queued/handoff requests into free slots. Per-request failures
+        isolate: the failing request finishes reason="error" and the
+        pass continues."""
+        for slot in list(self._prefilling):
+            req = self._prefilling[slot][0]
+            try:
+                self._advance_prefill(slot)
+            except Exception:
+                self._finish_req(req, "error", slot=slot)
+                self._note_error()
+        for slot in range(self.B):
+            # while, not if: a request finishing DURING admission (eos on
+            # its prefill token / max_new_tokens=1) frees the slot for the
+            # next queued request in the same pass. Handoff rows admit
+            # FIRST — their prefill is already paid, holding them behind
+            # un-prefilled queue entries would waste the disaggregation
+            while self._slot_req[slot] is None and (self._handoff
+                                                    or self._queue):
+                if self._handoff:
+                    req, kc1, vc1, logits = self._handoff.pop(0)
+                    try:
+                        with _blackbox.progress("serving/admit"):
+                            self._note_admission(req)
+                            t0 = time.perf_counter()
+                            self._activate(slot, req, kc1, vc1, logits)
+                            self._acc_ms("handoff_admit", t0)
+                    except Exception:
+                        self._finish_req(req, "error", slot=slot)
+                        self._note_error()
+                        continue
+                else:
+                    req = self._queue.pop(0)
+                    try:
+                        self._admit_one(slot, req)
+                    except Exception:
+                        # half-done admission must not leak a reservation
+                        self._finish_req(req, "error", slot=slot)
+                        self._note_error()
+                        continue
+                if self._slot_req[slot] is not None:
+                    break
+
     def _advance_prefill(self, slot):
         """Consume one chunk of a reserved slot's prompt; on the final
         chunk, activate the slot."""
@@ -1493,6 +1622,74 @@ class ServingEngine:
             return self._step_inner()
 
     def _step_inner(self):
+        # FLAGS_async_dispatch (construction-consumed): overlap round
+        # N+1's host admission/bookkeeping with round N's device compute.
+        # Speculative engines stay on the sync step (see __init__).
+        if self._async and self._draft is None:
+            return self._step_inner_async()
+        return self._step_inner_sync()
+
+    def _step_inner_async(self):
+        """The async round (docs/PERF.md): dispatch the decode program
+        for the slots active at entry (device starts immediately — jax
+        dispatch is asynchronous), then run the HOST work of the next
+        round — chunked-prefill advances and queue admissions — while
+        the device computes, and only then fetch the round's tokens.
+        Per-request token streams are bit-identical to the sync step
+        (each slot's decode depends only on its own cache row/position);
+        a request admitted this round starts decoding next round instead
+        of this one, so drains may take one extra step() call."""
+        _fp.failpoint("serving/step")
+        self._step_no += 1
+        before = set(self._finished)
+        self._expire_deadlines()
+        active = [s for s in range(self.B)
+                  if self._slot_req[s] is not None
+                  and s not in self._prefilling]
+        self._note_occupancy(active)
+        am = self._async_ms
+        am["rounds"] += 1
+        dispatched = None
+        t0_ns = time.perf_counter_ns()
+        if active:
+            dispatched = self._dispatch_decode(active)
+        t_disp_ns = time.perf_counter_ns()
+        am["dispatch_ms"] += (t_disp_ns - t0_ns) / 1e6
+        # ---- overlapped host window: round N+1's admission work runs
+        # while round N's decode executes on device. The row copies the
+        # admissions enqueue (_admit) sequence AFTER the in-flight decode
+        # on its output cache — device-ordered, rows disjoint.
+        self._advance_and_admit()
+        t_ov_ns = time.perf_counter_ns()
+        am["overlap_ms"] += (t_ov_ns - t_disp_ns) / 1e6
+        if dispatched is not None:
+            next_toks, kind = dispatched
+            # THE round's one host sync: everything admission needed to
+            # do already happened while the device was busy
+            next_toks = np.asarray(next_toks)  # lint: allow(step-loop-host-sync)
+            t1_ns = time.perf_counter_ns()
+            am["fetch_ms"] += (t1_ns - t_ov_ns) / 1e6
+            # the kind's wall slice = dispatch + fetch windows; the
+            # overlapped admission window is already booked under its
+            # own kinds by _advance_and_admit
+            self._acc_ms_value(
+                kind, (t_disp_ns - t0_ns + t1_ns - t_ov_ns) / 1e6)
+            self._apply_decode(active, next_toks, kind, t0_ns, t1_ns)
+        else:
+            t1_ns = t_ov_ns
+        if _trace.is_enabled():
+            # the PR 5 dispatch-vs-sync breakdown, span-attributed: the
+            # admission window rides INSIDE the device-compute window
+            _trace.emit("dispatch/decode", t0_ns, t_disp_ns,
+                        subsystem="serving", slots=len(active))
+            _trace.emit("dispatch/overlap", t_disp_ns, t_ov_ns,
+                        subsystem="serving")
+            if dispatched is not None:
+                _trace.emit("dispatch/fetch", t_ov_ns, t1_ns,
+                            subsystem="serving")
+        return [self._finished[r] for r in set(self._finished) - before]
+
+    def _step_inner_sync(self):
         import jax.numpy as jnp
 
         _fp.failpoint("serving/step")
@@ -1503,52 +1700,12 @@ class ServingEngine:
         self._expire_deadlines()
         # chunked admissions in flight advance ONE chunk each, so active
         # decodes below never wait for a whole long prefill
-        for slot in list(self._prefilling):
-            req = self._prefilling[slot][0]
-            try:
-                self._advance_prefill(slot)
-            except Exception:
-                self._finish_req(req, "error", slot=slot)
-                self._note_error()
-        for slot in range(self.B):
-            # while, not if: a request finishing DURING admission (eos on
-            # its prefill token / max_new_tokens=1) frees the slot for the
-            # next queued request in the same step. Handoff rows admit
-            # FIRST — their prefill is already paid, holding them behind
-            # un-prefilled queue entries would waste the disaggregation
-            while self._slot_req[slot] is None and (self._handoff
-                                                    or self._queue):
-                if self._handoff:
-                    req, kc1, vc1, logits = self._handoff.pop(0)
-                    try:
-                        with _blackbox.progress("serving/admit"):
-                            self._note_admission(req)
-                            t0 = time.perf_counter()
-                            self._activate(slot, req, kc1, vc1, logits)
-                            self._acc_ms("handoff_admit", t0)
-                    except Exception:
-                        self._finish_req(req, "error", slot=slot)
-                        self._note_error()
-                        continue
-                else:
-                    req = self._queue.pop(0)
-                    try:
-                        self._admit_one(slot, req)
-                    except Exception:
-                        # half-done admission must not leak a reservation
-                        self._finish_req(req, "error", slot=slot)
-                        self._note_error()
-                        continue
-                if self._slot_req[slot] is not None:
-                    break
+        self._advance_and_admit()
 
         active = [s for s in range(self.B)
                   if self._slot_req[s] is not None
                   and s not in self._prefilling]
-        self._m["occupancy_sum"] += len(active)
-        self._m["occupancy_steps"] += 1
-        _OCCUPANCY.set(len(active))
-        _trace.add_counter_sample("serving_batch_occupancy", len(active))
+        self._note_occupancy(active)
         if active:
             # speculative round: every active slot greedy AND spec_k+1
             # columns of headroom (near-capacity slots fall back to exact
@@ -1570,46 +1727,11 @@ class ServingEngine:
                 self._kc_d, self._vc_d = self._draft_sync(
                     self._params_d, self._kc_d, self._vc_d,
                     jnp.asarray(self._last), jnp.asarray(self._pos))
-            # inactive slots ride along harmlessly: their rows are
-            # don't-care (freed) and re-prefilled on admission. Host-side
-            # dispatch: an all-greedy batch keeps the lean argmax step
-            # (no sort/categorical in its compiled program at all).
-            if any(self._temps[s] > 0 for s in active):
-                kind = "decode_sample"
-                self._count_step(kind)
-                next_toks, self._kc, self._vc = self._step_sample(
-                    self._params, self._kc, self._vc,
-                    jnp.asarray(self._last), jnp.asarray(self._pos),
-                    jnp.asarray(self._temps), jnp.asarray(self._topk),
-                    jnp.asarray(self._topp), jnp.asarray(self._seeds))
-            else:
-                kind = "decode_greedy"
-                self._count_step(kind)
-                next_toks, self._kc, self._vc = self._step_greedy(
-                    self._params, self._kc, self._vc,
-                    jnp.asarray(self._last), jnp.asarray(self._pos))
-            next_toks = np.asarray(next_toks)
+            next_toks, kind = self._dispatch_decode(active)
+            next_toks = np.asarray(next_toks)  # lint: allow(step-loop-host-sync)
             self._acc_ms(kind, t0)
             t1_ns = time.perf_counter_ns()
-            for s in active:
-                req = self._slot_req[s]
-                try:
-                    _fp.failpoint("serving/slot")
-                    self._pos[s] += 1
-                    self._last[s] = next_toks[s]
-                    req.output_ids.append(int(next_toks[s]))
-                    if req._span is not None:
-                        # slot-level decode slice: the batched device
-                        # step's window, attributed to this request
-                        _trace.emit("decode", t0_ns, t1_ns,
-                                    subsystem="serving", parent=req._span,
-                                    slot=s, pos=int(self._pos[s]),
-                                    kind=kind, token=int(next_toks[s]))
-                    self._after_emit(s, req)
-                except Exception:
-                    if self._slot_req[s] is not None:
-                        self._finish_req(req, "error", slot=s)
-                    self._note_error()
+            self._apply_decode(active, next_toks, kind, t0_ns, t1_ns)
         return [self._finished[r] for r in set(self._finished) - before]
 
     def _step_speculative(self, active):
@@ -1634,8 +1756,8 @@ class ServingEngine:
         emit, m, self._kc, self._vc = self._verify(
             self._params, self._kc, self._vc, jnp.asarray(self._last),
             jnp.asarray(self._pos), props)
-        emit = np.asarray(emit)
-        m = np.asarray(m)
+        emit = np.asarray(emit)  # lint: allow(step-loop-host-sync)
+        m = np.asarray(m)  # lint: allow(step-loop-host-sync)
         t1_ns = time.perf_counter_ns()
         self._acc_ms("speculative", t0)
         if _trace.is_enabled():

@@ -266,8 +266,35 @@ class GPTBlock(nn.Layer):
 
     def forward(self, x):
         x = x + self.drop(self.attn(self.ln1(x)))
-        x = x + self.drop(self.mlp(self.ln2(x)))
+        y = self._tpp_mlp(x)
+        if y is None:
+            y = self.mlp(self.ln2(x))
+        x = x + self.drop(y)
         return x
+
+    def _tpp_mlp(self, x):
+        """FLAGS_tpp_kernels (docs/PERF.md): route ln2+MLP through the
+        TPP registry's ported ops — ln_matmul (the layernorm->matmul
+        prologue) feeding the fused gelu+projection tail. One get_flag
+        when disarmed; the registry module is only imported armed. None
+        = dense fallback (flag unset, MoE/tensor-parallel MLPs, or
+        shapes the registry can't tile). Kernel path needs functional
+        autodiff (SpmdTrainer) — custom_vjp does not ride the eager
+        tape, same restriction as every Pallas op here."""
+        from .. import flags as _flags
+
+        if not _flags.get_flag("tpp_kernels", False):
+            return None
+        from .. import nn as _nn
+
+        if not isinstance(self.mlp, GPTMLP) \
+                or not isinstance(self.mlp.fc1, _nn.Linear):
+            return None
+        from ..core.tensor import Tensor
+        from ..ops import tpp
+
+        out = tpp.gpt_block_mlp(x._data, self.ln2, self.mlp)
+        return None if out is None else Tensor(out)
 
 
 class GPTModel(nn.Layer):

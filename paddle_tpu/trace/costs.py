@@ -32,8 +32,8 @@ import threading
 from .. import flags as _flags
 from .. import monitor as _monitor
 
-__all__ = ["record", "get", "table", "reset", "sample_device_memory",
-           "peak_flops"]
+__all__ = ["record", "record_manual", "get", "table", "reset",
+           "sample_device_memory", "peak_flops"]
 
 _flags.define_flag(
     "device_peak_flops", 0.0,
@@ -137,6 +137,33 @@ def record(site, sig, compiled):
             if v is not None:
                 hbm_g.labels(site=site, kind=kind).set(v)
     return entry
+
+
+def record_manual(site, sig, flops=0.0, bytes_accessed=0.0):
+    """Capture an ANALYTIC cost entry under (site, sig) — for work that
+    has no standalone executable to ask, e.g. a Pallas micro-kernel
+    living inside a larger jitted program (ops/tpp.py registers each
+    op's per-call FLOPs/bytes here under site="tpp"). Repeated calls
+    ACCUMULATE (a kernel invoked N times per trace reports N times its
+    per-call cost) and bump a ``calls`` field; the same gauges as
+    :func:`record` are updated. Never raises."""
+    try:
+        with _LOCK:
+            entry = _TABLE.get((str(site), str(sig)))
+            if entry is None:
+                entry = {"site": str(site), "sig": str(sig),
+                         "flops": 0.0, "bytes_accessed": 0.0, "calls": 0}
+                _TABLE[(str(site), str(sig))] = entry
+            entry["flops"] += float(flops)
+            entry["bytes_accessed"] += float(bytes_accessed)
+            entry["calls"] += 1
+            snap = dict(entry)
+        if _monitor.is_enabled():
+            flops_g, _, _ = _gauges()
+            flops_g.labels(site=site, sig=sig).set(snap["flops"])
+        return snap
+    except Exception:
+        return None
 
 
 def get(site, sig):

@@ -732,6 +732,80 @@ class TestToHostFlag:
         assert paddle.to_tensor(3.5).item() == 3.5
 
 
+class TestStepLoopHostSync:
+    """ISSUE 11: per-step host pulls inside the trainer/serving hot
+    paths are errors unless they carry the allow-marker."""
+
+    HOT = ("import numpy as np\n"
+           "class SpmdTrainer:\n"
+           "    def _train_step_impl(self, x):\n"
+           "        return np.asarray(x)\n")
+
+    def test_positive_np_asarray_in_hot_path(self):
+        fs = lint_source(self.HOT,
+                         os.path.join("distributed", "spmd.py"))
+        assert [f.pass_name for f in fs] == ["step-loop-host-sync"]
+        assert fs[0].severity == "error"
+
+    def test_positive_item_and_block_until_ready(self):
+        src = ("class ServingEngine:\n"
+               "    def _step_inner(self, toks):\n"
+               "        toks.block_until_ready()\n"
+               "        return toks.item()\n")
+        fs = lint_source(src, os.path.join("inference", "serving.py"))
+        assert [f.pass_name for f in fs] == ["step-loop-host-sync"] * 2
+
+    def test_positive_nested_closure_in_hot_path_counts(self):
+        src = ("import numpy as np\n"
+               "class SpmdTrainer:\n"
+               "    def _drain_verdicts(self, vals):\n"
+               "        def inner(v):\n"
+               "            return np.asarray(v)\n"
+               "        return [inner(v) for v in vals]\n")
+        fs = lint_source(src, os.path.join("distributed", "spmd.py"))
+        assert [f.pass_name for f in fs] == ["step-loop-host-sync"]
+
+    def test_negative_allow_marker(self):
+        src = ("import numpy as np\n"
+               "class SpmdTrainer:\n"
+               "    def _train_step_impl(self, x):\n"
+               "        return np.asarray(x)"
+               "  # lint: allow(step-loop-host-sync)\n")
+        assert lint_source(src,
+                           os.path.join("distributed", "spmd.py")) == []
+
+    def test_negative_outside_hot_functions_and_files(self):
+        src = ("import numpy as np\n"
+               "class SpmdTrainer:\n"
+               "    def stats(self, x):\n"
+               "        return np.asarray(x)\n")
+        assert lint_source(src,
+                           os.path.join("distributed", "spmd.py")) == []
+        assert lint_source(self.HOT, "nn/layer/fake.py",
+                           traced=False) == []
+
+    def test_repo_hot_paths_are_clean(self):
+        # the ISSUE 11 satellite: after the deferred-guard fix, the
+        # live spmd/serving hot paths carry ONLY allow-marked syncs
+        from paddle_tpu.analysis.source_lint import lint_path
+
+        fs = [f for f in lint_path()
+              if f.pass_name == "step-loop-host-sync"]
+        assert fs == [], [f.where for f in fs]
+
+    def test_repo_allow_markers_still_present(self):
+        # the deliberate syncs double as documentation: the windowed
+        # drain fetch, the benchmark sync, the decode token fetch
+        for rel, needle in (
+                ("paddle_tpu/distributed/spmd.py", "device_get"),
+                ("paddle_tpu/inference/serving.py", "np.asarray"),
+        ):
+            src = open(os.path.join(REPO, rel)).read()
+            marked = [ln for ln in src.splitlines()
+                      if "lint: allow(step-loop-host-sync)" in ln]
+            assert any(needle in ln for ln in marked), (rel, needle)
+
+
 # ---------------------------------------------------------------------------
 # regression assertions for the real findings the passes surfaced
 # ---------------------------------------------------------------------------

@@ -314,8 +314,11 @@ def _compressed_step_jaxpr(quant, shard, min_size=1024):
             trainer.params, trainer.opt_state, trainer.buffers, lr, key,
             jnp.asarray(ids), jnp.asarray(labels))
         snap = monitor.snapshot()
+        # counter/gauge series only: unlabeled HISTOGRAM series (e.g.
+        # serving_ttft_ms, observed by an earlier test in the same
+        # process) survive monitor.reset() zeroed and carry no "value"
         fams = {m["name"]: {tuple(sorted(s["labels"].items())): s["value"]
-                            for s in m["series"]}
+                            for s in m["series"] if "value" in s}
                 for m in snap["metrics"] if m["series"]}
         return trainer, jaxpr, fams
     finally:
@@ -396,6 +399,48 @@ def test_dp8_shard_update_collectives():
     assert fam.get("all-reduce", 0) == 1 + len(trainer.buffers), fam
     assert count_quantized_collectives(jaxpr) == {
         "quantized-reduce-scatter": 0, "quantized-all-gather": 0}
+
+
+def test_dp8_overlap_quantized_collectives():
+    """FLAGS_overlap_grad_comm (ISSUE 11): the fused bundle splits into
+    one int8 exchange pair PER eligible layer — independent legs XLA's
+    scheduler can interleave with backward compute. Structure computed
+    from the model, pinned exactly."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis.collectives import (
+        count_jaxpr_collectives, count_quantized_collectives)
+
+    if jax.devices()[0].platform != "cpu" or len(jax.devices()) < 8:
+        pytest.skip("needs the 8-virtual-device CPU mesh")
+    old = paddle.get_flags(["FLAGS_overlap_grad_comm"])
+    paddle.set_flags({"overlap_grad_comm": True})
+    try:
+        trainer, jaxpr, fams = _compressed_step_jaxpr(quant=True,
+                                                      shard=False)
+    finally:
+        paddle.set_flags(old)
+    n_el = len(trainer._qar_eligible)
+    assert n_el > 1   # otherwise legs == bundle and this proves nothing
+    q = count_quantized_collectives(jaxpr)
+    assert q == {"quantized-reduce-scatter": n_el,
+                 "quantized-all-gather": n_el}, (
+        f"overlapped exchange structure changed: {q} — expected one "
+        f"int8 leg per eligible layer ({n_el})")
+    fam = count_jaxpr_collectives(jaxpr)
+    # int8 payload + f32 scales per leg and phase
+    assert fam.get("all-to-all", 0) == 2 * n_el, fam
+    assert fam.get("all-gather", 0) == 2 * n_el, fam
+    # the metered logical payload is unchanged: same grads, same bytes
+    wire = _series(fams, "collective_bytes_total", "quantized_all_reduce")
+    saved = _series(fams, "collective_bytes_saved_total",
+                    "quantized_all_reduce")
+    eligible_fp32 = sum(
+        int(np.asarray(trainer.params[n]).size) * 4
+        for n in trainer._qar_eligible)
+    assert wire + saved == eligible_fp32
+    assert wire > 0 and wire + saved >= QUANT_WIRE_RATIO * wire
 
 
 def test_dp8_composed_quantized_shard_collectives():
@@ -542,6 +587,107 @@ def test_step_time_and_mfu_floor(model, budgets):
             f"{want['mfu']:.3e} — the speed loop went backwards")
 
 
+# -- dispatch fraction floor (ISSUE 11) ---------------------------------------
+# host-dispatch ms / step ms for the guarded tiny-GPT step, measured
+# under FLAGS_benchmark (so sync_ms captures the device wait) with
+# FLAGS_check_nan_inf armed. Before the deferred guard, the per-step
+# verdict fetch blocked INSIDE the dispatch window and the fraction sat
+# near 1.0; with the deferred drain the device wait lands in sync_ms.
+# Same env-fingerprint discipline as the step-time floors.
+
+DISPATCH_GAP_SHRINK = 0.75
+
+
+def _measure_dispatch_fraction(warmup=2, steps=8):
+    import paddle_tpu as paddle
+
+    old = paddle.get_flags(["FLAGS_check_nan_inf", "FLAGS_benchmark"])
+    paddle.set_flags({"check_nan_inf": True, "benchmark": True})
+    try:
+        trainer, tensors = _floor_trainer("gpt")
+        for _ in range(warmup):
+            trainer.train_step(*tensors)
+        # reset the accounting windows after warmup/compile
+        trainer._step_ms_sum = trainer._sync_ms_sum = 0.0
+        trainer._step_count = 0
+        for _ in range(steps):
+            trainer.train_step(*tensors)
+        bd = trainer.stats()["breakdown"]
+        total = bd["dispatch_ms_total"] + bd["sync_ms_total"]
+        return {"fraction": bd["dispatch_ms_total"] / total,
+                "dispatch_ms": bd["dispatch_ms_total"] / steps,
+                "sync_ms": bd["sync_ms_total"] / steps}
+    finally:
+        paddle.set_flags(old)
+
+
+def test_dispatch_fraction_floor(budgets):
+    import jax
+
+    if jax.devices()[0].platform != "cpu":
+        pytest.skip("floors recorded on the CPU backend")
+    rec = budgets.get("dispatch_fraction")
+    if not rec:
+        pytest.skip("no recorded dispatch-fraction floor — run `python "
+                    "tests/test_perf_budgets.py --record-steptime`")
+    if rec.get("env") != _steptime_env():
+        pytest.skip("dispatch-fraction floor recorded on a different "
+                    "environment — re-record here to pin this machine")
+    got = _measure_dispatch_fraction()
+    want = rec["fraction"]
+    # the fraction lives in [0, 1], so gate the IDLE GAP (1 - fraction):
+    # a reintroduced per-step blocking sync pushes the fraction toward
+    # 1.0, eating the gap — allow at most DISPATCH_GAP_SHRINK of it to
+    # vanish before failing (a multiplicative band on the fraction
+    # itself would clamp to 1.0 and never fire)
+    bound = want + (1.0 - want) * DISPATCH_GAP_SHRINK
+    assert got["fraction"] <= bound, (
+        f"guarded tiny-GPT dispatch fraction {got['fraction']:.4f} vs "
+        f"recorded {want:.4f} (bound {bound:.4f}) — host work crept "
+        "back between dispatches (a per-step sync?); re-record only if "
+        "intentional")
+    # the absolute half (the CPU backend dispatches near-synchronously,
+    # so the ratio alone under-constrains): per-step host-dispatch ms
+    # may not regress past the step-time slack
+    assert got["dispatch_ms"] <= rec["dispatch_ms"] * STEP_TIME_SLACK, (
+        f"guarded tiny-GPT host-dispatch {got['dispatch_ms']:.2f}ms/step "
+        f"vs recorded {rec['dispatch_ms']:.2f} (> {STEP_TIME_SLACK}x) — "
+        "a dispatch-path speed regression; re-record only if intentional")
+
+
+def test_async_window_cuts_verdict_fetches():
+    """The structural half of the ISSUE 11 acceptance criterion,
+    machine-independent: the guarded tiny-GPT trainer under
+    FLAGS_async_dispatch performs <= 1 verdict host-sync per
+    FLAGS_async_window steps (the windowed drain), vs one per step for
+    the window-1 path."""
+    import paddle_tpu as paddle
+
+    old = paddle.get_flags(["FLAGS_check_nan_inf", "FLAGS_async_dispatch",
+                            "FLAGS_async_window"])
+    paddle.set_flags({"check_nan_inf": True, "async_dispatch": True,
+                      "async_window": 4})
+    try:
+        trainer, tensors = _floor_trainer("gpt")
+        for _ in range(12):
+            trainer.train_step(*tensors)
+        assert trainer._verdict_fetches <= 12 // 4, (
+            trainer._verdict_fetches)
+        trainer.guard_sync()
+        assert trainer._nonfinite_total == 0
+    finally:
+        paddle.set_flags(old)
+    paddle.set_flags({"check_nan_inf": True})
+    try:
+        trainer, tensors = _floor_trainer("gpt")
+        for _ in range(4):
+            trainer.train_step(*tensors)
+        # window 1: one drain per step (still deferred — entry fetches)
+        assert trainer._verdict_fetches == 3
+    finally:
+        paddle.set_flags({"check_nan_inf": old["FLAGS_check_nan_inf"]})
+
+
 def test_monitor_disabled_overhead():
     """Tier-1 overhead gate (ISSUE 2): with the monitor disabled every
     instrumented call site must cost ONE boolean check — bounded here
@@ -584,6 +730,8 @@ if __name__ == "__main__":
         assert jax.devices()[0].platform == "cpu"
         budgets = _measure()
         budgets["step_time_floors"] = _measure_step_floors()
+        budgets["dispatch_fraction"] = dict(
+            _measure_dispatch_fraction(), env=_steptime_env())
         json.dump(budgets, open(BUDGET_PATH, "w"), indent=1)
         print(f"recorded -> {BUDGET_PATH}")
         print(json.dumps(budgets, indent=1))
@@ -597,8 +745,12 @@ if __name__ == "__main__":
         assert jax.devices()[0].platform == "cpu"
         budgets = json.load(open(BUDGET_PATH))
         budgets["step_time_floors"] = _measure_step_floors()
+        budgets["dispatch_fraction"] = dict(
+            _measure_dispatch_fraction(), env=_steptime_env())
         json.dump(budgets, open(BUDGET_PATH, "w"), indent=1)
         print(f"recorded step-time floors -> {BUDGET_PATH}")
-        print(json.dumps(budgets["step_time_floors"], indent=1))
+        print(json.dumps({"step_time_floors": budgets["step_time_floors"],
+                          "dispatch_fraction":
+                          budgets["dispatch_fraction"]}, indent=1))
     else:
         print(__doc__)

@@ -3,7 +3,14 @@ docs/ROBUSTNESS.md): a NaN/Inf loss or gradient SKIPS the optimizer update
 on-device — params, optimizer moments, and step counters stay bit-identical
 — for up to FLAGS_max_skip_steps consecutive steps before train_step raises
 FloatingPointError. With the flag off (default) behavior is exactly
-pre-guard."""
+pre-guard.
+
+Since ISSUE 11 the HOST learns about a skip DEFERRED (docs/PERF.md): the
+verdict is fetched at the next train_step entry (window 1), at a
+FLAGS_benchmark sync, at stats(), or on guard_sync() — never by a blocking
+per-step sync inside the step itself. Tests force the fetch with
+guard_sync() where they assert host-visible skip state; the device-side
+bit-identical contract needs no sync at all."""
 import numpy as np
 import pytest
 
@@ -65,6 +72,7 @@ class TestGuard:
         loss = tr.train_step(XNAN, Y)          # poisoned batch
         assert np.isnan(float(np.asarray(loss._data)))
         _assert_bit_identical(tr, snap)        # params AND Adam moments
+        tr.guard_sync()                        # deferred verdict fetch
         assert opt._step_count == count_before  # LR schedule did not move
         assert tr._nonfinite_streak == 1
 
@@ -73,6 +81,7 @@ class TestGuard:
         paddle.set_flags({"check_nan_inf": True})
         tr, _ = _trainer()
         tr.train_step(XNAN, Y)
+        tr.guard_sync()
         skipped = monitor.counter("train_step_skipped_total",
                                   labelnames=("reason",))
         assert skipped.labels(reason="nonfinite").value == 1
@@ -82,10 +91,13 @@ class TestGuard:
         tr, _ = _trainer()
         tr.train_step(XNAN, Y)
         tr.train_step(XNAN, Y)
+        tr.guard_sync()
         assert tr._nonfinite_streak == 2
         tr.train_step(X, Y)                    # recovery
+        tr.guard_sync()
         assert tr._nonfinite_streak == 0
         tr.train_step(XNAN, Y)                 # a fresh streak may restart
+        tr.guard_sync()
         assert tr._nonfinite_streak == 1
 
     def test_raises_after_max_consecutive_skips(self):
@@ -94,9 +106,21 @@ class TestGuard:
         snap = _snapshot(tr)
         tr.train_step(XNAN, Y)
         tr.train_step(XNAN, Y)
+        tr.train_step(XNAN, Y)
         with pytest.raises(FloatingPointError, match="max_skip_steps"):
-            tr.train_step(XNAN, Y)
+            tr.guard_sync()                    # the deferred raise site
         _assert_bit_identical(tr, snap)        # nothing ever applied
+
+    def test_raise_also_fires_from_the_next_step_entry(self):
+        """Without an explicit guard_sync, the window-1 entry drain of
+        the NEXT train_step call surfaces the deferred raise — the run
+        cannot silently train past the streak limit."""
+        paddle.set_flags({"check_nan_inf": True, "max_skip_steps": 1})
+        tr, _ = _trainer()
+        tr.train_step(XNAN, Y)
+        tr.train_step(XNAN, Y)   # entry drain books skip 1 (<= max)
+        with pytest.raises(FloatingPointError, match="max_skip_steps"):
+            tr.train_step(X, Y)  # entry drain books skip 2 -> raise
 
     def test_inf_gradient_also_skips(self):
         paddle.set_flags({"check_nan_inf": True})

@@ -64,7 +64,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 PASSES = ["ckpt_atomic", "ckpt_fallback", "serving_deadline",
           "serving_slot_error", "serving_shed", "router_failover",
           "stall_dump", "trainer_nonfinite", "numerics_anomaly",
-          "quantized_nonfinite"]
+          "quantized_nonfinite", "async_nonfinite"]
 
 
 def _finding(name, severity, message, where=""):
@@ -394,6 +394,9 @@ def _check_trainer_nonfinite():
             return [_finding("trainer_nonfinite", "error",
                              "poisoned batch did not produce a NaN loss — "
                              "the scenario itself is broken")]
+        # ISSUE 11 deferred guard: the verdict is fetched at the next
+        # step/stats boundary — force it so the skip is booked
+        tr.guard_sync()
         drift = [k for k, v in tr.params.items()
                  if np.asarray(tr.params[k]).tobytes() != snap[k].tobytes()]
         if drift:
@@ -573,6 +576,114 @@ def _check_quantized_nonfinite():
                 "EF residuals bit-identical; next step trained clean")]
 
 
+def _check_async_nonfinite():
+    """Chaos-injected poison under FLAGS_async_dispatch: a scale:nan
+    batch's verdict is only FETCHED up to FLAGS_async_window steps
+    later — the deferred drain must still book the skip (within the
+    window), the device-side where-select must have left params and
+    schedule bit-identical, the next step must train clean, and a
+    blackbox dump bundle must record how deep the in-flight window
+    was."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import flags
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.monitor import blackbox as bb
+    from paddle_tpu.testing import failpoints as fp
+
+    name = "async_nonfinite"
+    old = {k: paddle.get_flags(["FLAGS_" + k])["FLAGS_" + k]
+           for k in ("async_dispatch", "async_window", "check_nan_inf")}
+    paddle.set_flags({"async_dispatch": True, "async_window": 4,
+                      "check_nan_inf": True})
+    tmp_ctx = tempfile.TemporaryDirectory(
+        prefix="paddle_tpu_chaos_async_blackbox_")
+    old_dir = flags.get_flag("blackbox_dir", "")
+    was_enabled = bb.is_enabled()
+    bb.enable(install=False)
+    flags.set_flags({"blackbox_dir": tmp_ctx.name})
+    try:
+        paddle.seed(0)
+        model = paddle.nn.Linear(8, 4)
+        opt = paddle.optimizer.AdamW(learning_rate=0.05,
+                                     parameters=model.parameters())
+        mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+        tr = SpmdTrainer(model, opt, loss_fn=paddle.nn.MSELoss(),
+                         mesh=mesh)
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 8).astype(np.float32)
+        y = rng.randn(4, 4).astype(np.float32)
+        for _ in range(2):
+            tr.train_step(x, y)
+        tr.guard_sync()
+        snap = {k: np.asarray(v).copy() for k, v in tr.params.items()}
+        count = opt._step_count
+        skipped = tr._nonfinite_total
+        with fp.scoped("trainer/batch=scale:nan"):
+            tr.train_step(x, y)
+        if tr._nonfinite_total != skipped:
+            return [_finding(name, "error",
+                             "the verdict was fetched eagerly — the "
+                             "async path did not defer it")]
+        if len(tr._pending_verdicts) != 1:
+            return [_finding(name, "error",
+                             "poisoned step's verdict is not in the "
+                             "deferred window")]
+        dump_path = bb.dump("stall", site="trainer/step",
+                            extra={"trigger": "chaos async_nonfinite"})
+        tr.guard_sync()   # within the window: the host now learns
+        if tr._nonfinite_total != skipped + 1:
+            return [_finding(name, "error",
+                             "deferred drain did not book the skipped "
+                             "step within the window")]
+        if opt._step_count != count:
+            return [_finding(name, "error",
+                             "skipped step left the optimizer schedule "
+                             f"moved ({opt._step_count} != {count})")]
+        drift = [k for k in snap
+                 if np.asarray(tr.params[k]).tobytes()
+                 != snap[k].tobytes()]
+        if drift:
+            return [_finding(name, "error",
+                             "non-finite step leaked into parameters "
+                             f"under async dispatch: {drift}")]
+        if dump_path is None:
+            return [_finding(name, "error",
+                             "blackbox dump failed to write")]
+        bundle = bb.load_bundle(dump_path)
+        tables = [t["table"] for t in bundle.get("requests", [])
+                  if t.get("kind") == "trainer_async" and "table" in t]
+        if not tables:
+            return [_finding(name, "error",
+                             "dump bundle carries no trainer_async "
+                             "in-flight window table")]
+        tbl = tables[-1]
+        if tbl.get("window") != 4 or tbl.get("pending") != 1:
+            return [_finding(name, "error",
+                             "bundle's window table does not record the "
+                             f"in-flight depth (got {tbl})")]
+        after = tr.train_step(x, y)
+        tr.guard_sync()
+        if not np.isfinite(float(np.asarray(after._data))):
+            return [_finding(name, "error",
+                             "the step AFTER the deferred skip is "
+                             "non-finite")]
+    finally:
+        paddle.set_flags(old)
+        flags.set_flags({"blackbox_dir": old_dir})
+        bb.quiesce()
+        bb.reset()
+        if not was_enabled:
+            bb.disable()
+        tmp_ctx.cleanup()
+    return [_ok(name,
+                "nan step's verdict deferred 1-in-window, drain booked "
+                "the skip, params/schedule bit-identical, bundle "
+                "recorded window depth, next step trained clean")]
+
+
 def build_report(only=None):
     """Run the fault schedule; `only` restricts to a subset of PASSES
     (the model is only built when a serving check is selected)."""
@@ -588,6 +699,7 @@ def build_report(only=None):
         ("trainer_nonfinite", _check_trainer_nonfinite),
         ("numerics_anomaly", _check_numerics_anomaly),
         ("quantized_nonfinite", _check_quantized_nonfinite),
+        ("async_nonfinite", _check_async_nonfinite),
     ]
     if selected & {"serving_deadline", "serving_slot_error",
                    "serving_shed", "router_failover", "stall_dump"}:

@@ -63,6 +63,11 @@ _REQUIRED = {
     # the op=quantized_all_reduce series
     "quantized": ("collective_bytes_total", "collective_bytes_saved_total",
                   "quantize_error_norm", "compile_cache_total"),
+    # async double-buffered dispatch (docs/PERF.md): the deferred-guard
+    # drain families plus the TPP kernel-call counter from the armed
+    # tiny-GPT loop (the loop arms both ISSUE 11 flags)
+    "async": ("async_verdict_fetch_total", "async_window_depth",
+              "tpp_kernel_calls_total", "compile_cache_total"),
 }
 
 #: (family, label, value) series that must exist in a target's snapshot,
@@ -72,6 +77,8 @@ _REQUIRED_SERIES = {
     "quantized": (("collective_bytes_total", "op", "quantized_all_reduce"),
                   ("collective_bytes_saved_total", "op",
                    "quantized_all_reduce")),
+    "async": (("tpp_kernel_calls_total", "op", "ln_matmul"),
+              ("tpp_kernel_calls_total", "op", "fused_mlp")),
 }
 
 _DIMS = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
@@ -283,6 +290,48 @@ def run_quantized_loop(steps=2):
         paddle.set_flags(old)
 
 
+def run_async_loop(steps=5):
+    """The async-dispatch target: a tiny-GPT train loop with
+    FLAGS_async_dispatch + FLAGS_check_nan_inf + FLAGS_tpp_kernels all
+    armed (window 2, so >= 2 deferred drains happen inside the loop) —
+    moves async_verdict_fetch_total / async_window_depth and the
+    tpp_kernel_calls_total{op=...} series in one pass."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import flags
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM, GPTPretrainLoss
+
+    old = {k: flags.get_flag(k)
+           for k in ("async_dispatch", "async_window", "check_nan_inf",
+                     "tpp_kernels")}
+    paddle.set_flags({"async_dispatch": True, "async_window": 2,
+                      "check_nan_inf": True, "tpp_kernels": True})
+    try:
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        model = GPTForCausalLM(GPTConfig(max_seq_len=64, **_DIMS))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+        trainer = SpmdTrainer(model, opt, loss_fn=GPTPretrainLoss(),
+                              mesh=mesh)
+        batch = [paddle.to_tensor(
+            rng.randint(0, 256, (2, 16)).astype(np.int32))
+            for _ in range(2)]
+        for _ in range(steps):
+            trainer.train_step(*batch)
+        trainer.guard_sync()
+        st = trainer.stats()
+        return {"verdict_fetches": st["breakdown"]["verdict_fetches"],
+                "window_max_depth": st["breakdown"]["window_max_depth"],
+                "steps": st["steps"]}
+    finally:
+        paddle.set_flags(old)
+
+
 def run_blackbox_loop(new_tokens=4):
     """The flight-recorder target: a short serving loop with the
     recorder ON, then one on-demand dump bundle into a throwaway dir —
@@ -329,7 +378,7 @@ def run_target(name, with_trace=False):
     monitor.reset()
     trace_summary = None
     kind = (name if name in ("serving", "router", "blackbox", "federated",
-                             "numerics", "quantized")
+                             "numerics", "quantized", "async")
             else "train")
     if with_trace:
         trace.clear()
@@ -347,6 +396,8 @@ def run_target(name, with_trace=False):
             run_numerics_loop()
         elif kind == "quantized":
             run_quantized_loop()
+        elif kind == "async":
+            run_async_loop()
         else:
             run_train_step(name)
     finally:
@@ -429,10 +480,17 @@ def main(argv=None):
                          "exit 1 unless collective_bytes_total"
                          "{op=quantized_all_reduce} and "
                          "collective_bytes_saved_total are present")
+    ap.add_argument("--async", action="store_true", dest="async_",
+                    help="run the async-dispatch target (tiny-GPT loop "
+                         "with FLAGS_async_dispatch + FLAGS_tpp_kernels "
+                         "armed); exit 1 unless the "
+                         "async_verdict_fetch_total/async_window_depth "
+                         "families and tpp_kernel_calls_total{op=...} "
+                         "series are present")
     ap.add_argument("--all", action="store_true",
                     help="all models + the serving loop + the router, "
-                         "flight-recorder, federated, numerics and "
-                         "quantized tiers")
+                         "flight-recorder, federated, numerics, "
+                         "quantized and async tiers")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the graph_lint-schema machine report")
     ap.add_argument("--prometheus", action="store_true",
@@ -455,14 +513,16 @@ def main(argv=None):
         targets.append("numerics")
     if args.quantized:
         targets.append("quantized")
+    if args.async_:
+        targets.append("async")
     if args.all:
         targets = list(MODEL_TARGETS) + ["serving", "router", "blackbox",
                                          "federated", "numerics",
-                                         "quantized"]
+                                         "quantized", "async"]
     if not targets:
         ap.error("pick a target: --model NAME, --serving, --router, "
-                 "--blackbox, --federated, --numerics, --quantized or "
-                 "--all")
+                 "--blackbox, --federated, --numerics, --quantized, "
+                 "--async or --all")
 
     report = build_report(targets, with_trace=args.with_trace)
     if args.as_json:
