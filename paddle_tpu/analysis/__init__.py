@@ -31,3 +31,68 @@ from .collectives import count_hlo_collectives  # noqa: F401
 from . import passes  # noqa: F401  — registers the builtin pass battery
 from .source_lint import lint_path, lint_source  # noqa: F401
 from .targets import analyze_model, analyze_serving_decode  # noqa: F401
+
+
+def contract_reports(targets=None):
+    """The ISSUE 12 contract-auditor battery: run the four static
+    contract passes over the repo; returns {target: AnalysisReport} for
+    targets ``flags`` (flag_audit), ``imports`` (import_graph lazy
+    closure), ``observability`` (obs_audit docs/code/metrics_dump
+    drift), ``threads`` (the unlocked-thread-shared-write lint over
+    THREAD_SHARED_MODULES). `targets` picks a subset (None = all four —
+    only the picked passes run). CLI: ``python tools/contract_audit.py``."""
+    import os
+
+    from . import flag_audit, import_graph, obs_audit
+    from .source_lint import THREAD_SHARED_MODULES, lint_thread_discipline
+
+    picked = ("flags", "imports", "observability", "threads") \
+        if targets is None else tuple(targets)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    reports = {}
+    if "flags" in picked:
+        rep = AnalysisReport(name="flags")
+        rep.extend(flag_audit.audit_package())
+        reports["flags"] = rep.sort()
+    if "imports" in picked:
+        rep = AnalysisReport(name="imports")
+        rep.extend(import_graph.audit_package())
+        reports["imports"] = rep.sort()
+    if "observability" in picked:
+        rep = AnalysisReport(name="observability")
+        rep.extend(obs_audit.audit_package())
+        reports["observability"] = rep.sort()
+    if "threads" in picked:
+        rep = AnalysisReport(name="threads")
+        for rel, lock in sorted(THREAD_SHARED_MODULES.items()):
+            path = os.path.join(pkg_root, rel)
+            with open(path, encoding="utf-8") as f:
+                rep.extend(lint_thread_discipline(f.read(), rel, lock))
+        reports["threads"] = rep.sort()
+    return reports
+
+
+def contract_rules():
+    """{rule: severity} over the source linter AND the contract-auditor
+    passes — the one vocabulary --list-rules prints (with allow-marker
+    spellings from analysis/allowlist.py)."""
+    from . import flag_audit, import_graph, obs_audit, source_lint
+
+    merged = {}
+    for mod in (source_lint, flag_audit, import_graph, obs_audit):
+        merged.update(mod.RULES)
+    return merged
+
+
+def rule_table():
+    """The --list-rules text both CLIs print (tools/contract_audit.py
+    and tools/graph_lint.py): every rule, its severity, and every
+    accepted allow-marker spelling — one implementation so the two
+    surfaces can never drift."""
+    from .allowlist import spellings
+
+    lines = [f"{'rule':<34} {'severity':<9} allow-marker spelling(s)"]
+    for rule, sev in sorted(contract_rules().items()):
+        marks = ", ".join(f"# lint: allow({s})" for s in spellings(rule))
+        lines.append(f"{rule:<34} {sev:<9} {marks}")
+    return "\n".join(lines)

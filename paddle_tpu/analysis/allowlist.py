@@ -1,0 +1,52 @@
+"""One shared ``# lint: allow(...)`` vocabulary for every static pass.
+
+Before ISSUE 12 each analysis surface grew its own suppression parsing
+(source_lint carried the regex + a private alias table); the contract
+auditor adds three more AST passes that all need the same escape hatch,
+so the marker grammar, the alias table, and the lookup live here once.
+
+A suppression is a trailing comment on the offending line::
+
+    rng = np.random.RandomState(seed)  # lint: allow(np-random-in-traced-code)
+
+Markers accept either the full rule name or any registered shorthand
+alias (e.g. ``client_output`` for ``nonreduced-client-output``).
+``tools/contract_audit.py --list-rules`` and ``tools/graph_lint.py
+--list-rules`` print every rule with its accepted spellings so the
+escape is discoverable without reading this file.
+"""
+import re
+
+__all__ = ["ALLOW_RE", "RULE_ALIASES", "allowed", "spellings"]
+
+ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([a-z0-9_,\- ]+)\)")
+
+#: rule -> shorthand marker spellings accepted alongside the full name.
+#: ONE table for source_lint AND the contract-auditor passes — a new
+#: pass registers its aliases here, never in a private copy.
+RULE_ALIASES = {
+    "nonreduced-client-output": ("client_output",),
+    "orphan-flag-unread": ("orphan-flag",),
+    "orphan-flag-undefined": ("orphan-flag",),
+    "lazy-module-leak": ("lazy-import", "eager-import"),
+    "unlocked-thread-shared-write": ("thread-shared-write",),
+    "hot-path-flag-read": ("hot-flag-read",),
+    "metric-undocumented": ("undocumented-metric",),
+    "span-undocumented": ("undocumented-span",),
+}
+
+
+def spellings(rule):
+    """Every marker spelling that suppresses `rule` (full name first)."""
+    return (rule,) + tuple(RULE_ALIASES.get(rule, ()))
+
+
+def allowed(lines, lineno, rule):
+    """True when line `lineno` (1-based) of `lines` carries an allow
+    marker naming `rule` (or one of its aliases)."""
+    if 1 <= lineno <= len(lines):
+        m = ALLOW_RE.search(lines[lineno - 1])
+        if m:
+            names = [r.strip() for r in m.group(1).split(",")]
+            return any(s in names for s in spellings(rule))
+    return False

@@ -1,0 +1,286 @@
+"""Static import-graph analysis: the lazy-import closure contract.
+
+The framework's inert-by-default discipline says a plain (flags-unset)
+trainer/engine process must never import the optional subsystems — the
+compress module, the async dispatcher, the TPP kernel registry, the
+numerics telescope, the parity harness, the flight recorder, the
+federated tier, and the router/disagg serving layers. Ten
+``test_*_gate.py`` files each pin ONE of those by spawning a subprocess
+and asserting ``'x' not in sys.modules``; this module proves the whole
+family at once, statically: build the module-level import graph of
+``paddle_tpu/`` (function-local imports and PEP 562 ``__getattr__``
+loaders are *lazy* edges), compute the eager closure of the plain
+trainer/engine roots, and fail if any manifest-lazy module is inside it
+— with the offending import chain in the finding. The subprocess pins
+stay as belt-and-braces; this check catches the leak at lint time, with
+provenance, for every module in the manifest including ones a future PR
+adds.
+
+Declaring a new lazy module = appending its dotted name to
+:data:`LAZY_MODULES` (a ``pkg.sub`` entry covers the whole subtree).
+A deliberate module-level import of a lazy module (e.g. the env-flag
+arming hook in ``monitor/__init__``) carries
+``# lint: allow(lazy-import)`` and is treated as a lazy (conditional)
+edge.
+"""
+import ast
+import os
+
+from .allowlist import allowed
+from .registry import Finding
+
+__all__ = ["RULES", "LAZY_MODULES", "PLAIN_CLOSURE_ROOTS", "ImportGraph",
+           "build_graph", "audit_package"]
+
+RULES = {
+    "lazy-module-leak": "error",
+    "lazy-manifest-stale": "error",
+}
+
+#: the lazy-module manifest: none of these may be module-level-importable
+#: from the plain trainer/engine closure. A name covers its subtree.
+LAZY_MODULES = (
+    "paddle_tpu.distributed.compress",       # int8 grad reduce (ISSUE 10)
+    "paddle_tpu.distributed.async_dispatch", # StepHandle window (ISSUE 11)
+    "paddle_tpu.ops.tpp",                    # Pallas micro-kernels (ISSUE 11)
+    "paddle_tpu.monitor.numerics",           # numerics telescope (ISSUE 9)
+    "paddle_tpu.monitor.blackbox",           # flight recorder (ISSUE 7/12)
+    "paddle_tpu.testing.parity",             # A/B parity harness (ISSUE 9)
+    "paddle_tpu.federated",                  # federated tier (ISSUE 8)
+    "paddle_tpu.serving.router",             # multi-engine tier (ISSUE 6)
+    "paddle_tpu.serving.disagg",             # prefill/decode split (ISSUE 6)
+)
+
+#: what a plain trainer/engine process imports (the roots of the closure
+#: the ten subprocess gates each rebuild by hand)
+PLAIN_CLOSURE_ROOTS = (
+    "paddle_tpu",
+    "paddle_tpu.distributed.spmd",
+    "paddle_tpu.distributed.mesh",
+    "paddle_tpu.inference.serving",
+)
+
+
+class _ImportScan(ast.NodeVisitor):
+    def __init__(self, lines):
+        self.lines = lines
+        self.stmts = []    # (node, lazy: bool)
+        self._depth = 0
+
+    def _visit_func(self, node):
+        self._depth += 1
+        self.generic_visit(node)
+        self._depth -= 1
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+    visit_Lambda = _visit_func
+
+    def _add(self, node):
+        lazy = self._depth > 0 or allowed(self.lines, node.lineno,
+                                          "lazy-module-leak")
+        self.stmts.append((node, lazy))
+
+    def visit_Import(self, node):
+        self._add(node)
+
+    def visit_ImportFrom(self, node):
+        self._add(node)
+
+
+class ImportGraph:
+    """Module-level import graph of one python package tree.
+
+    modules      : set of dotted module names found on disk
+    eager[m]     : {dep: lineno} — module-level import edges
+    lazy[m]      : {dep: lineno} — function-local / allow-marked edges
+    """
+
+    def __init__(self, package):
+        self.package = package
+        self.modules = set()
+        self.packages = set()
+        self.eager = {}
+        self.lazy = {}
+
+    # -- resolution ----------------------------------------------------------
+    def _known(self, name):
+        return name in self.modules
+
+    def _parents(self, name):
+        """Importing a.b.c executes a and a.b too."""
+        out = []
+        parts = name.split(".")
+        for i in range(1, len(parts)):
+            p = ".".join(parts[:i])
+            if self._known(p):
+                out.append(p)
+        return out
+
+    def _add_edge(self, table, src, dst, lineno):
+        if dst == src or not self._known(dst):
+            return
+        table.setdefault(dst, lineno)
+        for p in self._parents(dst):
+            if p != src:
+                table.setdefault(p, lineno)
+
+    def _resolve(self, mod, node):
+        """Yield dotted targets of one import statement in module `mod`."""
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                yield a.name
+            return
+        # ImportFrom
+        if node.level == 0:
+            base = node.module or ""
+        else:
+            # the package context of `mod`
+            ctx = mod if mod in self.packages else mod.rsplit(".", 1)[0]
+            parts = ctx.split(".")
+            if node.level > 1:
+                parts = parts[:len(parts) - (node.level - 1)]
+            base = ".".join(parts)
+            if node.module:
+                base = f"{base}.{node.module}" if base else node.module
+        if base:
+            yield base
+        for a in node.names:
+            if a.name == "*":
+                continue
+            cand = f"{base}.{a.name}" if base else a.name
+            if self._known(cand):
+                yield cand
+
+    def add_module(self, name, source, is_package=False):
+        self.modules.add(name)
+        if is_package:
+            self.packages.add(name)
+        self.eager.setdefault(name, {})
+        self.lazy.setdefault(name, {})
+        scan = _ImportScan(source.splitlines())
+        try:
+            scan.visit(ast.parse(source))
+        except SyntaxError:
+            return
+        for node, lazy in scan.stmts:
+            for dst in self._resolve(name, node):
+                self._add_edge(self.lazy[name] if lazy else self.eager[name],
+                               name, dst, node.lineno)
+
+    # -- closure -------------------------------------------------------------
+    def eager_closure(self, roots):
+        """{module: shortest eager import chain (list of modules)} for
+        everything reachable from `roots` over module-level edges."""
+        out = {}
+        frontier = [r for r in roots if self._known(r)]
+        for r in frontier:
+            out[r] = [r]
+        while frontier:
+            nxt = []
+            for m in frontier:
+                for dep in sorted(self.eager.get(m, ())):
+                    if dep not in out:
+                        out[dep] = out[m] + [dep]
+                        nxt.append(dep)
+            frontier = nxt
+        return out
+
+    def expand(self, manifest_entry):
+        """Concrete modules covered by one manifest name (subtree)."""
+        return sorted(m for m in self.modules
+                      if m == manifest_entry
+                      or m.startswith(manifest_entry + "."))
+
+
+def build_graph(root=None, sources=None, package=None):
+    """Build the ImportGraph of paddle_tpu/ (or of synthetic `sources`:
+    {dotted module name: source}; package names ending in a component
+    named '__init__' are not expected — pass packages via `package`-less
+    dotted names and list them in sources with their submodules)."""
+    if sources is not None:
+        g = ImportGraph(package or "pkg")
+        # first pass: register names so `_known` sees siblings
+        pkgs = set()
+        for name in sources:
+            parts = name.split(".")
+            for i in range(1, len(parts)):
+                pkgs.add(".".join(parts[:i]))
+        for name, src in sources.items():
+            g.modules.add(name)
+        g.packages |= {p for p in pkgs if p in g.modules}
+        # a name that has submodules is a package
+        for name in list(g.modules):
+            if any(m.startswith(name + ".") for m in g.modules):
+                g.packages.add(name)
+        for name, src in sources.items():
+            g.add_module(name, src, is_package=name in g.packages)
+        return g
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg_name = os.path.basename(root)
+    g = ImportGraph(pkg_name)
+    entries = []   # (dotted, path, is_package)
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"
+                       and os.path.exists(os.path.join(dirpath, d,
+                                                       "__init__.py"))]
+        rel = os.path.relpath(dirpath, root)
+        base = pkg_name if rel == "." else \
+            pkg_name + "." + rel.replace(os.sep, ".")
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            if fn == "__init__.py":
+                entries.append((base, os.path.join(dirpath, fn), True))
+            else:
+                entries.append((f"{base}.{fn[:-3]}",
+                                os.path.join(dirpath, fn), False))
+    for name, _, is_pkg in entries:
+        g.modules.add(name)
+        if is_pkg:
+            g.packages.add(name)
+    for name, path, is_pkg in entries:
+        with open(path, encoding="utf-8") as f:
+            g.add_module(name, f.read(), is_package=is_pkg)
+    return g
+
+
+def audit_graph(g, manifest=LAZY_MODULES, roots=PLAIN_CLOSURE_ROOTS):
+    """Check the lazy manifest against the eager closure; [Finding]."""
+    findings = []
+    closure = g.eager_closure(roots)
+    for entry in manifest:
+        concrete = g.expand(entry)
+        if not concrete:
+            findings.append(Finding(
+                "lazy-manifest-stale", RULES["lazy-manifest-stale"],
+                f"lazy-module manifest names {entry!r} but no such "
+                "module exists — remove the entry or fix the name",
+                where="analysis/import_graph.py:LAZY_MODULES"))
+            continue
+        for mod in concrete:
+            chain = closure.get(mod)
+            if chain is not None:
+                findings.append(Finding(
+                    "lazy-module-leak", RULES["lazy-module-leak"],
+                    f"manifest-lazy module {mod} is eagerly importable "
+                    "from the plain trainer/engine closure via "
+                    f"{' -> '.join(chain)} — move the import into the "
+                    "consuming function (or behind a PEP 562 "
+                    "__getattr__); a deliberate flag-guarded module-"
+                    "level import carries `# lint: allow(lazy-import)`",
+                    where=mod))
+    for r in roots:
+        if not g._known(r):
+            findings.append(Finding(
+                "lazy-manifest-stale", RULES["lazy-manifest-stale"],
+                f"plain-closure root {r!r} names no existing module",
+                where="analysis/import_graph.py:PLAIN_CLOSURE_ROOTS"))
+    findings.sort(key=lambda f: f.where)
+    return findings
+
+
+def audit_package(root=None):
+    """The repo audit: graph paddle_tpu/ and check the manifest."""
+    return audit_graph(build_graph(root))

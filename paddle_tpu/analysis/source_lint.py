@@ -38,15 +38,26 @@ BEFORE tracing:
       MapReduce contract (docs/FEDERATED.md) is map THEN reduce. A
       deliberate client-placed return (e.g. ``client_map`` itself)
       carries ``# lint: allow(client_output)``.
+  unlocked-thread-shared-write : in a module that spawns daemon threads
+      (THREAD_SHARED_MODULES: the blackbox sentinel, the monitor
+      registry, the profiler), a write to module-global shared state
+      reachable from a thread body that is not under the module's
+      designated lock. The GIL makes ``x += 1`` interleavable, not
+      atomic — cross-thread mutations take the lock or carry
+      ``# lint: allow(thread-shared-write)`` with a reason (e.g. a
+      single-slot boolean latch).
 
 Suppression: a trailing ``# lint: allow(<rule>)`` comment on the
 offending line acknowledges a documented, deliberate exception (e.g. an
-eager host op that already warns under tracing).
+eager host op that already warns under tracing). The marker grammar and
+alias table are shared with the contract-auditor passes
+(analysis/allowlist.py).
 """
 import ast
 import os
-import re
 
+from .allowlist import RULE_ALIASES as _RULE_ALIASES  # noqa: F401 (compat)
+from .allowlist import allowed as _shared_allowed
 from .registry import Finding
 
 # packages whose function bodies are reachable from a jit trace
@@ -58,8 +69,6 @@ _SERVING_PKGS = ("inference", "serving")
 _INIT_METHODS = {"__init__", "__init_subclass__", "reset_parameters",
                  "_init_weights", "extra_repr", "__repr__"}
 
-_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([a-z0-9_,\- ]+)\)")
-
 RULES = {
     "np-random-in-traced-code": "error",
     "time-in-traced-code": "warning",
@@ -67,6 +76,7 @@ RULES = {
     "private-model-import-in-serving": "error",
     "nonreduced-client-output": "error",
     "step-loop-host-sync": "error",
+    "unlocked-thread-shared-write": "error",
     "syntax-error": "error",
 }
 
@@ -89,20 +99,18 @@ _SYNC_CALLS = {"np.asarray", "numpy.asarray", "jax.device_get"}
 #: method names that pull device values to the host when called
 _SYNC_METHODS = {"item", "block_until_ready"}
 
-# shorthand markers accepted in allow(...) alongside the full rule name
-_RULE_ALIASES = {"nonreduced-client-output": ("client_output",)}
+#: modules that spawn daemon threads (or are mutated cross-thread) and
+#: their designated lock name — the unlocked-thread-shared-write rule
+#: polices writes to module-global state reachable from thread bodies.
+#: Keyed by path relative to the paddle_tpu package root.
+THREAD_SHARED_MODULES = {
+    os.path.join("monitor", "blackbox.py"): "_LOCK",
+    os.path.join("monitor", "registry.py"): "_lock",
+    os.path.join("profiler", "__init__.py"): "_LOCK",
+}
 
-
-def _allowed(lines, lineno, rule):
-    if 1 <= lineno <= len(lines):
-        m = _ALLOW_RE.search(lines[lineno - 1])
-        if m:
-            names = [r.strip() for r in m.group(1).split(",")]
-            if rule in names:
-                return True
-            if any(a in names for a in _RULE_ALIASES.get(rule, ())):
-                return True
-    return False
+# the shared marker grammar lives in analysis/allowlist.py
+_allowed = _shared_allowed
 
 
 def _dotted(node):
@@ -296,22 +304,273 @@ class _Visitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _dotted_last(node):
+    d = _dotted(node)
+    return d.split(".")[-1] if d else ""
+
+
+class _ThreadScan(ast.NodeVisitor):
+    """Phase 1 of the thread-discipline lint: module globals, function
+    defs (by simple name), intra-module call edges, thread-body roots."""
+
+    def __init__(self):
+        self.module_globals = set()
+        self.funcs = {}          # name -> [FunctionDef]
+        self.calls = {}          # func name -> {called simple names}
+        self.thread_roots = set()
+        self.lock_seen = False
+        self._stack = []
+        self._class_bases = []
+
+    def set_lock(self, lock_name):
+        self._lock_name = lock_name
+
+    def visit_ClassDef(self, node):
+        bases = [_dotted_last(b) if not isinstance(b, ast.Name) else b.id
+                 for b in node.bases]
+        self._class_bases.append(bases)
+        self.generic_visit(node)
+        self._class_bases.pop()
+
+    def _visit_func(self, node):
+        self.funcs.setdefault(node.name, []).append(node)
+        # a Thread subclass's run() IS a thread body
+        if node.name == "run" and self._class_bases \
+                and any(b.endswith("Thread") for b in self._class_bases[-1]):
+            self.thread_roots.add("run")
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _visit_assign_targets(self, targets):
+        if self._stack:
+            return
+        for t in targets:
+            if isinstance(t, ast.Name):
+                self.module_globals.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                self._visit_assign_targets(list(t.elts))
+
+    def visit_Assign(self, node):
+        self._visit_assign_targets(node.targets)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        self._visit_assign_targets([node.target])
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        name = _dotted(node.func)
+        if self._stack:
+            self.calls.setdefault(self._stack[-1], set()).add(
+                name.split(".")[-1])
+        if name.split(".")[-1] == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    tgt = _dotted_last(kw.value) if not isinstance(
+                        kw.value, ast.Name) else kw.value.id
+                    if tgt:
+                        self.thread_roots.add(tgt)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        if node.attr == getattr(self, "_lock_name", None):
+            self.lock_seen = True
+        self.generic_visit(node)
+
+    def visit_Name(self, node):
+        if node.id == getattr(self, "_lock_name", None):
+            self.lock_seen = True
+        self.generic_visit(node)
+
+
+class _WriteScan(ast.NodeVisitor):
+    """Phase 2: inside one (thread-reachable) function, flag writes to
+    module-global-rooted state outside `with <lock>:` blocks."""
+
+    def __init__(self, module_globals, lock_name, rel, lines, emit):
+        self.module_globals = module_globals
+        self.lock_name = lock_name
+        self.rel = rel
+        self.lines = lines
+        self.emit = emit
+        self._lock_depth = 0
+        self._locals = set()
+        self._globals_decl = set()
+
+    def prime(self, func):
+        args = func.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            self._locals.add(a.arg)
+        def bound_names(t, out):
+            # only PLAIN name bindings shadow: `x = ...`, `x, y = ...`.
+            # A Subscript/Attribute target (`_STATE["k"] = v`) mutates
+            # the module object — its root must NOT count as local
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List, ast.Starred)):
+                for el in getattr(t, "elts", [t.value] if isinstance(
+                        t, ast.Starred) else []):
+                    bound_names(el, out)
+
+        for node in ast.walk(func):
+            if isinstance(node, ast.Global):
+                self._globals_decl.update(node.names)
+            elif isinstance(node, ast.arg):
+                # nested-def / lambda parameters shadow too
+                self._locals.add(node.arg)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    bound_names(t, self._locals)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                bound_names(node.target, self._locals)
+            elif isinstance(node, ast.withitem) and node.optional_vars:
+                bound_names(node.optional_vars, self._locals)
+        self._locals -= self._globals_decl
+        return self
+
+    # nested defs are visited for writes too (they run on the thread),
+    # but their params/locals shadow — good enough for a lint heuristic
+
+    def _is_locked_with(self, node):
+        for item in node.items:
+            if _dotted_last(item.context_expr) == self.lock_name:
+                return True
+        return False
+
+    def visit_With(self, node):
+        locked = self._is_locked_with(node)
+        if locked:
+            self._lock_depth += 1
+        self.generic_visit(node)
+        if locked:
+            self._lock_depth -= 1
+
+    visit_AsyncWith = visit_With
+
+    def _root_name(self, t):
+        while isinstance(t, (ast.Attribute, ast.Subscript)):
+            t = t.value
+        return t.id if isinstance(t, ast.Name) else None
+
+    def _check_target(self, t, lineno):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                self._check_target(el, lineno)
+            return
+        shared = False
+        if isinstance(t, ast.Name):
+            shared = t.id in self._globals_decl \
+                or (t.id in self.module_globals
+                    and t.id not in self._locals)
+        else:
+            root = self._root_name(t)
+            shared = root is not None and root in self.module_globals \
+                and root not in self._locals
+        if shared and self._lock_depth == 0:
+            name = self._root_name(t) if not isinstance(t, ast.Name) \
+                else t.id
+            self.emit(
+                "unlocked-thread-shared-write", lineno,
+                f"write to module-shared {name!r} reachable from a "
+                f"daemon-thread body without holding {self.lock_name} — "
+                "the GIL interleaves, it does not serialize; take the "
+                "lock or mark a deliberate single-slot latch with "
+                "`# lint: allow(thread-shared-write)`")
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._check_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        self._check_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._check_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+
+def lint_thread_discipline(source, rel_path="<string>", lock_name="_LOCK"):
+    """The unlocked-thread-shared-write rule over one module: find
+    thread bodies (``threading.Thread(target=...)`` targets and
+    ``Thread``-subclass ``run`` methods), walk the same-module call
+    graph they can reach, and flag writes to module-global-rooted state
+    outside ``with <lock_name>:``. Returns [Finding]."""
+    findings = []
+    lines = source.splitlines()
+
+    def emit(rule, lineno, message):
+        if not _allowed(lines, lineno, rule):
+            findings.append(Finding(rule, RULES[rule], message,
+                                    where=f"{rel_path}:{lineno}"))
+
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding("syntax-error", "error",
+                        f"unparseable source: {e}", where=rel_path)]
+    scan = _ThreadScan()
+    scan.set_lock(lock_name)
+    scan.visit(tree)
+    if not scan.lock_seen:
+        findings.append(Finding(
+            "unlocked-thread-shared-write",
+            RULES["unlocked-thread-shared-write"],
+            f"{rel_path} is declared thread-shared "
+            f"(THREAD_SHARED_MODULES) but its designated lock "
+            f"{lock_name!r} appears nowhere in the module",
+            where=rel_path))
+    if not scan.thread_roots:
+        return findings
+    # names reachable from the thread bodies over same-module calls
+    reach, frontier = set(scan.thread_roots), list(scan.thread_roots)
+    while frontier:
+        fn = frontier.pop()
+        for callee in scan.calls.get(fn, ()):
+            if callee in scan.funcs and callee not in reach:
+                reach.add(callee)
+                frontier.append(callee)
+    for fname in sorted(reach):
+        for func in scan.funcs.get(fname, ()):
+            _WriteScan(scan.module_globals, lock_name, rel_path, lines,
+                       emit).prime(func).visit(func)
+    findings.sort(key=lambda f: f.where)
+    return findings
+
+
 def lint_source(source, rel_path="<string>", traced=True, serving=None,
-                federated=None, hot_funcs=None):
+                federated=None, hot_funcs=None, thread_lock=None):
     """Lint one python source string; returns a list of Finding.
     serving=None / federated=None derive the tier flags from rel_path
     (modules under inference|serving/ resp. federated/); hot_funcs=None
-    derives the step-loop-host-sync function set from HOT_PATHS."""
+    derives the step-loop-host-sync function set from HOT_PATHS;
+    thread_lock=None derives the thread-discipline lock from
+    THREAD_SHARED_MODULES."""
     if serving is None:
         serving = _is_serving_module(rel_path)
     if federated is None:
         federated = _is_federated_module(rel_path)
     if hot_funcs is None:
         hot_funcs = HOT_PATHS.get(rel_path, frozenset())
+    if thread_lock is None:
+        thread_lock = THREAD_SHARED_MODULES.get(rel_path)
     tree = ast.parse(source)
     v = _Visitor(rel_path, source.splitlines(), traced, serving=serving,
                  federated=federated, hot_funcs=hot_funcs)
     v.visit(tree)
+    if thread_lock:
+        v.findings.extend(lint_thread_discipline(source, rel_path,
+                                                 thread_lock))
     v.findings.sort(key=lambda f: f.where)
     return v.findings
 

@@ -12,6 +12,8 @@ import time
 import jax
 import numpy as np
 
+from .. import flags as _flags
+
 
 class Generator:
     def __init__(self, seed=None):
@@ -55,7 +57,9 @@ class Generator:
         return jax.random.fold_in(jax.random.key(self._seed), data)
 
 
-_DEFAULT = Generator(0)
+# FLAGS_seed seeds the default generator at import (env: FLAGS_seed=N);
+# paddle.seed() overrides it at runtime — unset, this is Generator(0)
+_DEFAULT = Generator(int(_flags.get_flag("seed", 0)))
 
 
 def default_generator():

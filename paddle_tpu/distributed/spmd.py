@@ -24,7 +24,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import flags as _flags
 from .. import monitor as _monitor
-from ..monitor import blackbox as _blackbox
+from ..monitor import blackbox_lazy as _blackbox  # import-free recorder facade (ISSUE 12)
 from ..trace import costs as _costs
 from .. import trace as _trace
 from ..core.tape import global_tape

@@ -35,7 +35,7 @@ from .. import monitor as _monitor
 from .. import trace as _trace
 from ..core.tape import no_grad
 from ..core.tensor import Tensor, to_tensor
-from ..monitor import blackbox as _blackbox
+from ..monitor import blackbox_lazy as _blackbox  # import-free recorder facade (ISSUE 12)
 from ..testing import failpoints as _fp
 from .primitives import federated_weighted_mean
 

@@ -23,11 +23,27 @@ def define_flag(name, default, help_str=""):
             value = env
     existing = _REGISTRY.get(name)
     if existing is not None:
+        # Two real definitions disagreeing about the default is a bug:
+        # whichever module imported first silently won (and its env
+        # parsing keyed off ITS default's type). Raise instead — the
+        # idempotent same-default path stays allowed, and entries a
+        # set_flags() created before the defining module loaded
+        # ("provisional": the user picked a value, never a default) are
+        # adopted, not conflicted with.
+        if not existing.get("provisional") \
+                and repr(existing["default"]) != repr(default):
+            raise ValueError(
+                f"FLAGS_{name} re-defined with default {default!r} but "
+                f"an earlier define_flag said {existing['default']!r} — "
+                "conflicting defaults would be resolved by import order; "
+                "one definition must own the default")
         # an explicit set_flags() made BEFORE the defining module loaded
         # wins: lazily-imported modules (monitor/numerics.py) define
         # their flags on first import, and defining must never clobber a
         # value the user already set
         value = existing["value"]
+        if not help_str:
+            help_str = existing["help"]
     _REGISTRY[name] = {"value": value, "default": default, "help": help_str}
     return value
 
@@ -37,7 +53,13 @@ def set_flags(flags):
     for k, v in flags.items():
         k = k[6:] if k.startswith("FLAGS_") else k
         if k not in _REGISTRY:
-            define_flag(k, v)
+            # provisional entry, NOT define_flag: an explicit set wins
+            # over any FLAGS_* env var (exactly as it does for an
+            # already-defined flag), and the defining module may load
+            # later with the authoritative default + help (see
+            # define_flag's provisional adoption)
+            _REGISTRY[k] = {"value": v, "default": v, "help": "",
+                            "provisional": True}
         else:
             _REGISTRY[k]["value"] = v
 
@@ -69,12 +91,18 @@ define_flag("max_skip_steps", 3,
             "train steps may be skipped before train_step raises "
             "FloatingPointError (a transient loss spike recovers; a "
             "diverged run fails loudly)")
-define_flag("sort_sum_gradient", False, "deterministic grad accumulation order (flags.cc:527)")
+define_flag("sort_sum_gradient", False,  # lint: allow(orphan-flag) — reference-parity stub (flags.cc:527): tape accumulation is already deterministic here, kept for set_flags API compat
+            "deterministic grad accumulation order (flags.cc:527); the "
+            "TPU tape accumulates in recording order deterministically, "
+            "so this is accepted-and-ignored for API compatibility")
 define_flag("benchmark", False,
             "Executor.run blocks until fetches are device-complete so the "
             "monitor's step_latency_ms measures device work, not dispatch; "
             "each sync is counted as benchmark_sync_total")
-define_flag("seed", 0, "global random seed")
+define_flag("seed", 0,
+            "initial global random seed: seeds the default RNG generator "
+            "at process start (core/generator.py); paddle.seed() "
+            "overrides it at runtime")
 define_flag("use_bfloat16", True, "prefer bfloat16 matmuls on MXU")
 define_flag("trace_host_sync", "silent",
             "what Tensor._to_host does when a host pull (.numpy()/.item()) "
@@ -159,6 +187,15 @@ define_flag("tpp_kernels", False,
             "on CPU). Read at trace time in models/gpt.py; unset, the "
             "registry module is never imported and the traced program "
             "is byte-identical")
+define_flag("blackbox", False,
+            "black-box flight recorder on/off (monitor/blackbox.py): "
+            "progress beacons, the bounded event ring, and dump-bundle "
+            "plumbing; off turns every beacon()/note() call site into "
+            "one boolean check (tests/test_blackbox_gate.py pins "
+            "<5us/call and zero drift). Defined here (not in the "
+            "recorder module) so the monitor package can gate on it "
+            "without importing the recorder at all — monitor/blackbox.py "
+            "is manifest-lazy (analysis/import_graph.py)")
 define_flag("flash_attention_block", 0,
             "force the flash-attention Pallas block size (128/256/512); "
             "0 = auto (largest of 512/256/128 dividing seq). For on-chip "

@@ -61,7 +61,7 @@ from .. import monitor as _monitor
 # package attribute may still be the paddle.trace math op at this point)
 from ..trace import costs as _costs
 from .. import trace as _trace
-from ..monitor import blackbox as _blackbox
+from ..monitor import blackbox_lazy as _blackbox  # import-free recorder facade (ISSUE 12)
 from ..profiler import RecordEvent as _RecordEvent
 
 __all__ = ["cache_dir", "enabled", "args_signature", "mesh_fingerprint",

@@ -55,8 +55,7 @@ def _record_ckpt(op, path, t0, span=None):
         span.end(path=path, **({} if nbytes is None else {"bytes": nbytes}))
     # flight-recorder byte tag (one boolean check when the recorder is
     # off): a checkpoint in flight at wedge time shows in the ring
-    _monitor.blackbox.note("checkpoint", op=op, path=str(path),
-                           bytes=nbytes)
+    _monitor.bb_note("checkpoint", op=op, path=str(path), bytes=nbytes)
     if not _monitor.is_enabled():
         return
     _CKPT.labels(op=op).inc()

@@ -52,19 +52,23 @@ __all__ = [
     "reset", "enable", "disable", "is_enabled", "timed",
     "to_json", "to_prometheus", "parse_prometheus", "flatten",
     "log_event", "log_snapshot", "record_collective", "tensor_nbytes",
-    "STAT_ADD", "STAT_SUB", "STAT_RESET", "blackbox",
+    "STAT_ADD", "STAT_SUB", "STAT_RESET",
+    "blackbox_on", "bb_note", "bb_note_span", "bb_beacon", "bb_progress",
+    "bb_register_provider", "bb_dump", "blackbox_lazy",
 ]
 
 
 def __getattr__(name):   # PEP 562
-    # the numerics telescope loads lazily: a plain (FLAGS_numerics unset)
-    # process must never import it — tests/test_numerics_gate.py pins the
-    # subprocess form of this. Deliberately NOT in __all__: a star-import
-    # resolves every listed name, which would defeat the laziness
-    if name == "numerics":
+    # the numerics telescope AND the flight recorder load lazily: a plain
+    # (flags-unset) process must never import either —
+    # tests/test_numerics_gate.py and the ISSUE 12 import-graph contract
+    # (analysis/import_graph.py LAZY_MODULES) pin it. Deliberately NOT in
+    # __all__: a star-import resolves every listed name, which would
+    # defeat the laziness
+    if name in ("numerics", "blackbox"):
         import importlib
 
-        return importlib.import_module(".numerics", __name__)
+        return importlib.import_module("." + name, __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 _flags.define_flag("monitor", True,
@@ -187,7 +191,7 @@ def record_collective(kind, nbytes=0, saved_bytes=0):
     # flight-recorder byte tag BEFORE the monitor-enabled early-out: the
     # two recorders are independent flags, and the last collectives
     # before a wedge are prime evidence even with metrics off
-    blackbox.note("collective", op=kind, bytes=int(nbytes))
+    bb_note("collective", op=kind, bytes=int(nbytes))
     if not _DEFAULT.is_enabled():
         return
     if _COLL_CALLS is None:
@@ -218,7 +222,116 @@ def record_collective(kind, nbytes=0, saved_bytes=0):
         _COLL_SAVED.labels(op=kind).inc(saved_bytes)
 
 
-# the black-box flight recorder rides inside the monitor package (its
-# counters live in this registry); imported last so its lazy metric
-# creation finds the helpers above already defined
-from . import blackbox  # noqa: E402,F401
+# ---- flight-recorder indirection (ISSUE 12) ----------------------------------
+# monitor/blackbox.py is MANIFEST-LAZY (analysis/import_graph.py): a plain
+# process never imports it. Its on/off latch and the provider registry
+# live HERE so every instrumented hot path stays one boolean check
+# without pulling the recorder in; blackbox adopts these objects as its
+# own at import (the latch list is shared, not copied).
+
+import threading as _threading  # noqa: E402  (for the pre-import lock)
+
+_BB_ON = [False]          # flipped by blackbox.enable()/disable()
+_BB_PROVIDERS = []        # (kind, weakref(obj), fn) — shared with blackbox
+_BB_PROVIDER_CAP = 64     # one cap, adopted by blackbox.register_provider
+_BB_PROVIDERS_LOCK = _threading.Lock()   # the ONE lock for the provider
+#                          list — blackbox.register_provider adopts it
+#                          too, so pre- and post-import registrations
+#                          can never interleave under different locks
+_BB_NULL_CM = contextlib.nullcontext()
+
+
+def blackbox_on():
+    """Is the flight recorder enabled? One list read — safe to call on
+    any hot path without importing the recorder."""
+    return _BB_ON[0]
+
+
+def _bb():
+    from . import blackbox
+
+    return blackbox
+
+
+def bb_note(kind, **fields):
+    """Forward one flight-recorder ring event iff the recorder is on
+    (disabled: one boolean check, no blackbox import)."""
+    if _BB_ON[0]:
+        _bb().note(kind, **fields)
+
+
+def bb_note_span(sp):
+    if _BB_ON[0]:
+        _bb().note_span(sp)
+
+
+def bb_beacon(site):
+    if _BB_ON[0]:
+        _bb().beacon(site)
+
+
+def bb_progress(site):
+    """`with bb_progress(site):` — a blackbox progress window when the
+    recorder is on, a no-op context otherwise."""
+    if not _BB_ON[0]:
+        return _BB_NULL_CM
+    return _bb().progress(site)
+
+
+def bb_dump(reason, **kw):
+    """Write a dump bundle (imports the recorder; a disabled recorder
+    writes nothing and returns None). Keywords pass through to
+    blackbox.dump (site=, extra=, dir_=)."""
+    if not _BB_ON[0]:
+        return None
+    return _bb().dump(reason, **kw)
+
+
+def bb_register_provider(kind, obj, fn):
+    """Register a live-state dump provider WITHOUT importing the
+    recorder: entries land in the shared list blackbox adopts at import
+    (same weakref shape + cap as blackbox.register_provider)."""
+    import sys as _sys
+    import weakref
+
+    # delegate only to a FULLY-initialized module: mid-import (another
+    # thread is executing blackbox.py right now) the half-built module
+    # already sits in sys.modules without register_provider — fall
+    # through to the shared list, which blackbox mutates under the SAME
+    # _BB_PROVIDERS_LOCK, so nothing is lost either way
+    mod = _sys.modules.get(__name__ + ".blackbox")
+    reg = getattr(mod, "register_provider", None)
+    if reg is not None:
+        reg(kind, obj, fn)
+        return
+    with _BB_PROVIDERS_LOCK:
+        _BB_PROVIDERS[:] = [(k, r, f) for (k, r, f) in _BB_PROVIDERS
+                            if r() is not None][-(_BB_PROVIDER_CAP - 1):]
+        _BB_PROVIDERS.append((str(kind), weakref.ref(obj), fn))
+
+
+class _BlackboxLazy:
+    """The recorder API surface the instrumented hot paths consume,
+    import-free: ``from ..monitor import blackbox_lazy as _blackbox``
+    keeps every call site spelled exactly as before ISSUE 12 while the
+    heavy module (ring, sentinel, bundle writer) loads only once the
+    recorder is actually enabled."""
+
+    is_enabled = staticmethod(blackbox_on)
+    note = staticmethod(bb_note)
+    note_span = staticmethod(bb_note_span)
+    beacon = staticmethod(bb_beacon)
+    progress = staticmethod(bb_progress)
+    register_provider = staticmethod(bb_register_provider)
+    dump = staticmethod(bb_dump)
+
+
+blackbox_lazy = _BlackboxLazy()
+
+
+# env-armed opt-in (FLAGS_blackbox=1 python serve.py): load the recorder
+# eagerly so its sync_from_flag() enables it at import, exactly as when
+# it rode the package import. The flag itself is defined in flags.py so
+# this check never touches the lazy module.
+if _flags.get_flag("blackbox", False):
+    from . import blackbox  # noqa: E402,F401  # lint: allow(lazy-import)

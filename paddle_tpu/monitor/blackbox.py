@@ -80,12 +80,10 @@ __all__ = [
     "install_hooks", "reset", "BUNDLE_KEYS",
 ]
 
-_flags.define_flag(
-    "blackbox", False,
-    "black-box flight recorder on/off (monitor/blackbox.py): progress "
-    "beacons, the bounded event ring, and dump-bundle plumbing; off "
-    "turns every beacon()/note() call site into one boolean check "
-    "(tests/test_blackbox_gate.py pins <5us/call and zero drift)")
+# FLAGS_blackbox itself is defined in flags.py: the monitor package
+# gates its env-armed eager import on it, and this module is
+# manifest-lazy (analysis/import_graph.py) — defining the switch here
+# would mean importing the module to learn whether to import it
 _flags.define_flag(
     "blackbox_dir", "",
     "directory dump bundles are written to; empty = "
@@ -106,13 +104,20 @@ _flags.define_flag(
     "pruned after each write): an oscillating stall or crash storm "
     "must never fill the disk of the host it is diagnosing")
 
-_ENABLED = [False]            # the ONE read on every disabled fast path
+# this module is manifest-lazy (ISSUE 12): the enabled latch and the
+# provider list are OWNED by the parent package (monitor/__init__.py
+# _BB_ON/_BB_PROVIDERS) so instrumented hot paths can check/queue
+# without importing the recorder; we adopt the SAME objects — flipping
+# _ENABLED[0] here is what monitor.blackbox_on() reads out there
+from .. import monitor as _parent  # noqa: E402  (fully imported first)
+
+_ENABLED = _parent._BB_ON     # the ONE read on every disabled fast path
 _AUTO_SENTINEL = [False]      # beacon() auto-starts the sentinel thread
 _LOCK = threading.RLock()
 _RING = collections.deque(maxlen=int(_flags.get_flag("blackbox_ring", 512)))
 _BEACONS = {}                 # site -> _Beacon
 _CONTEXT = {}                 # ambient key/value carried in every bundle
-_PROVIDERS = []               # (kind, weakref(obj), fn(obj) -> table)
+_PROVIDERS = _parent._BB_PROVIDERS   # (kind, weakref(obj), fn(obj)->table)
 _SENTINEL = None              # the live _Sentinel thread, or None
 _HOOKS = [False]              # excepthook/atexit installation latch
 _SIGNAL_HOOK = [False]        # SIGUSR1 latch (separate: only the main
@@ -242,10 +247,14 @@ def _count_ring_event():
     if not _monitor.is_enabled():
         return
     if _RING_TOTAL is None:
-        _RING_TOTAL = _monitor.counter(
-            "blackbox_ring_events_total",
-            "events appended to the flight-recorder ring (only exists "
-            "once FLAGS_blackbox is on)")
+        # double-checked publish of the cached handle (the metric itself
+        # is get-or-create under the registry's own lock either way)
+        with _LOCK:
+            if _RING_TOTAL is None:
+                _RING_TOTAL = _monitor.counter(
+                    "blackbox_ring_events_total",
+                    "events appended to the flight-recorder ring (only "
+                    "exists once FLAGS_blackbox is on)")
     _RING_TOTAL.inc()
 
 
@@ -347,7 +356,11 @@ def beacons():
 
 # -- in-flight state providers ------------------------------------------------
 
-_PROVIDER_CAP = 64
+# the cap AND the list lock are owned by the parent package:
+# monitor.bb_register_provider mutates the same list pre-import, so both
+# sides must serialize on the same lock against the same bound
+_PROVIDER_CAP = _parent._BB_PROVIDER_CAP
+_PROVIDERS_LOCK = _parent._BB_PROVIDERS_LOCK
 
 
 def register_provider(kind, obj, fn):
@@ -355,7 +368,7 @@ def register_provider(kind, obj, fn):
     return a JSON-able table (e.g. a serving engine's in-flight request
     table). `obj` is held weakly — dead providers are pruned, the list is
     capped so short-lived engines cannot grow it without bound."""
-    with _LOCK:
+    with _PROVIDERS_LOCK:
         _PROVIDERS[:] = [(k, r, f) for (k, r, f) in _PROVIDERS
                          if r() is not None][-(_PROVIDER_CAP - 1):]
         _PROVIDERS.append((str(kind), weakref.ref(obj), fn))
@@ -363,7 +376,7 @@ def register_provider(kind, obj, fn):
 
 def _provider_tables():
     out = []
-    with _LOCK:
+    with _PROVIDERS_LOCK:
         providers = list(_PROVIDERS)
     for kind, ref, fn in providers:
         obj = ref()
@@ -432,12 +445,14 @@ def _count_dump(reason):
     if not _monitor.is_enabled():
         return
     if _DUMP_TOTAL is None:
-        _DUMP_TOTAL = _monitor.counter(
-            "blackbox_dump_total",
-            "dump bundles written, by trigger "
-            "(stall = sentinel/non-convergence, signal = SIGUSR1/"
-            "on-demand, crash = excepthook/abnormal exit)",
-            labelnames=("reason",))
+        with _LOCK:   # double-checked publish of the cached handle
+            if _DUMP_TOTAL is None:
+                _DUMP_TOTAL = _monitor.counter(
+                    "blackbox_dump_total",
+                    "dump bundles written, by trigger "
+                    "(stall = sentinel/non-convergence, signal = SIGUSR1/"
+                    "on-demand, crash = excepthook/abnormal exit)",
+                    labelnames=("reason",))
     _DUMP_TOTAL.labels(reason=reason).inc()
 
 

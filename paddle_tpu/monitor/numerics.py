@@ -54,7 +54,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from .. import flags as _flags
-from . import blackbox as _blackbox
+from . import blackbox_lazy as _blackbox  # import-free recorder facade
 
 __all__ = [
     "STAT_KEYS", "QUANTILES", "DIGEST_CAP", "MIN_BASELINE_POINTS",

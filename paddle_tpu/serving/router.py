@@ -37,7 +37,7 @@ import zlib
 import numpy as np
 
 from .. import monitor as _monitor
-from ..monitor import blackbox as _blackbox
+from ..monitor import blackbox_lazy as _blackbox  # import-free recorder facade (ISSUE 12)
 from .. import trace as _trace
 from ..core.tensor import Tensor
 from ..inference.serving import QueueFullError

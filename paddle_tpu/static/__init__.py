@@ -25,7 +25,7 @@ import jax.numpy as jnp
 
 from .. import flags as _flags
 from .. import monitor as _monitor
-from ..monitor import blackbox as _blackbox
+from ..monitor import blackbox_lazy as _blackbox  # import-free recorder facade (ISSUE 12)
 from ..trace import costs as _costs
 from .. import trace as _trace
 from ..core import dtype as dtype_mod

@@ -512,7 +512,9 @@ sync_from_flag()
 # span-close digests feed the black-box flight recorder; imported at the
 # bottom (lazily resolved attribute at call time) so the monitor/trace
 # import order stays cycle-free whichever package loads first
-from ..monitor import blackbox as _blackbox  # noqa: E402
+from ..monitor import blackbox_lazy as _blackbox  # noqa: E402  (ISSUE 12:
+# the facade forwards only while the recorder is enabled — a traced but
+# unrecorded process never imports monitor/blackbox.py)
 
 from . import costs  # noqa: E402,F401
 

@@ -842,3 +842,444 @@ class TestRepoRegressions:
                     "paddle_tpu/nn/functional/loss.py"):
             src = open(os.path.join(REPO, rel)).read()
             assert "lint: allow(np-random-in-traced-code)" in src, rel
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12: contract-auditor passes (flag / import / observability / thread)
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.analysis import allowlist  # noqa: E402
+from paddle_tpu.analysis import flag_audit  # noqa: E402
+from paddle_tpu.analysis import import_graph  # noqa: E402
+from paddle_tpu.analysis import obs_audit  # noqa: E402
+from paddle_tpu.analysis.source_lint import (  # noqa: E402
+    THREAD_SHARED_MODULES, lint_thread_discipline)
+
+
+def _flag_findings(sources, **kw):
+    kw.setdefault("hot_paths", {})
+    kw.setdefault("lazy_modules", ())
+    return flag_audit.audit_inventory(flag_audit.collect(sources), **kw)
+
+
+def _rules_of(findings):
+    return {f.pass_name for f in findings}
+
+
+class TestFlagAudit:
+    def test_orphan_flag_unread_planted(self):
+        fs = _flag_findings({"m.py": 'define_flag("dead_probe", 0, "h")\n'})
+        assert _rules_of(fs) == {"orphan-flag-unread"}
+        assert fs[0].severity == "error"
+        assert "dead_probe" in fs[0].message
+
+    def test_read_flag_is_not_orphan(self):
+        fs = _flag_findings({
+            "m.py": 'define_flag("live_probe", 0, "h")\n',
+            "n.py": 'x = get_flag("live_probe", 0)\n'})
+        assert fs == []
+
+    def test_orphan_flag_undefined_planted(self):
+        fs = _flag_findings({"m.py": 'x = get_flag("never_defined")\n'})
+        assert _rules_of(fs) == {"orphan-flag-undefined"}
+
+    def test_missing_help_planted(self):
+        fs = _flag_findings({
+            "m.py": 'define_flag("helpless", 1)\n'
+                    'y = get_flag("helpless")\n'})
+        assert _rules_of(fs) == {"flag-missing-help"}
+
+    def test_conflicting_default_planted(self):
+        fs = _flag_findings({
+            "a.py": 'define_flag("dup", 1, "h")\nga = get_flag("dup")\n',
+            "b.py": 'define_flag("dup", 2, "h")\n'})
+        assert "flag-default-conflict" in _rules_of(fs)
+
+    def test_default_drift_warns(self):
+        fs = _flag_findings({
+            "a.py": 'define_flag("drifty", 8, "h")\n',
+            "b.py": 'x = get_flag("drifty", 4)\n'})
+        assert _rules_of(fs) == {"flag-default-drift"}
+        assert all(f.severity == "warning" for f in fs)
+
+    def test_structural_key_miss_planted(self):
+        src = ('define_flag("structural_probe", False, "h")\n'
+               'def consume(self):\n'
+               '    self._sp = get_flag("structural_probe", False)\n')
+        fs = _flag_findings({"m.py": src},
+                            structural=("structural_probe",))
+        assert "structural-flag-key-miss" in _rules_of(fs)
+
+    def test_structural_flag_reaching_exec_key_is_clean(self):
+        src = ('define_flag("structural_ok", False, "h")\n'
+               'def consume(self):\n'
+               '    self._sp = get_flag("structural_ok", False)\n'
+               'def _exec_key(self, sig):\n'
+               '    return (sig, self._sp)\n')
+        fs = _flag_findings({"m.py": src}, structural=("structural_ok",))
+        assert fs == []
+
+    def test_structural_flag_via_extra_key_is_clean(self):
+        src = ('define_flag("structural_ek", False, "h")\n'
+               'def consume(self):\n'
+               '    self._ek = get_flag("structural_ek", False)\n'
+               'def compile(self):\n'
+               '    c = compile_cached(f, extra_key=("t", self._ek))\n')
+        fs = _flag_findings({"m.py": src}, structural=("structural_ek",))
+        assert fs == []
+
+    def test_structural_flag_via_carrier_hop_is_clean(self):
+        # the spmd.py shape: _resolve() consumes the flag, its result is
+        # assigned to self._q, and self._q joins the key
+        src = ('define_flag("structural_hop", False, "h")\n'
+               'def _resolve(self):\n'
+               '    return get_flag("structural_hop", False)\n'
+               'def __init__(self):\n'
+               '    self._q = self._resolve()\n'
+               'def _exec_key(self, sig):\n'
+               '    return (sig, self._q)\n')
+        fs = _flag_findings({"m.py": src},
+                            structural=("structural_hop",))
+        assert fs == []
+
+    def test_hot_path_flag_read_planted(self):
+        src = ('define_flag("hot_probe", False, "h")\n'
+               'def train_step(self):\n'
+               '    if get_flag("hot_probe", False):\n'
+               '        pass\n')
+        fs = _flag_findings({"m.py": src}, structural=("hot_probe",),
+                            hot_paths={"m.py": {"train_step"}})
+        assert "hot-path-flag-read" in _rules_of(fs)
+
+    def test_active_checker_read_is_sanctioned(self):
+        src = ('define_flag("hot_ok", False, "h")\n'
+               'def _guard_active(self):\n'
+               '    return get_flag("hot_ok", False) == self._g\n'
+               'def _exec_key(self, sig):\n'
+               '    return (sig, self._guard_active())\n')
+        fs = _flag_findings({"m.py": src}, structural=("hot_ok",),
+                            hot_paths={"m.py": {"_guard_active"}})
+        assert fs == []
+
+    def test_allow_marker_suppresses_orphan(self):
+        fs = _flag_findings({
+            "m.py": 'define_flag("stub", 0, "h")'
+                    '  # lint: allow(orphan-flag)\n'})
+        assert fs == []
+
+    def test_repo_flags_are_clean(self):
+        assert flag_audit.audit_package() == []
+
+    def test_repo_structural_flags_all_reach_keys(self):
+        # every declared structural flag exists AND joins a key — the
+        # acceptance-criterion form of the pass over the real tree
+        scans = flag_audit.collect(flag_audit.package_sources())
+        defined = set()
+        for s in scans.values():
+            defined |= {n for n, _, _, _ in s.defines}
+        assert set(flag_audit.STRUCTURAL_FLAGS) <= defined
+
+
+class TestImportGraphAudit:
+    def _graph(self, sources):
+        return import_graph.build_graph(sources=sources)
+
+    def test_eager_leak_planted(self):
+        g = self._graph({
+            "pkg": "",
+            "pkg.core": "from . import heavy\n",
+            "pkg.heavy": "",
+        })
+        fs = import_graph.audit_graph(g, manifest=("pkg.heavy",),
+                                      roots=("pkg.core",))
+        assert [f.pass_name for f in fs] == ["lazy-module-leak"]
+        assert "pkg.core -> pkg.heavy" in fs[0].message
+
+    def test_function_local_import_is_lazy(self):
+        g = self._graph({
+            "pkg": "",
+            "pkg.core": "def go():\n    from . import heavy\n",
+            "pkg.heavy": "",
+        })
+        fs = import_graph.audit_graph(g, manifest=("pkg.heavy",),
+                                      roots=("pkg.core",))
+        assert fs == []
+
+    def test_allow_marked_module_level_import_is_conditional(self):
+        g = self._graph({
+            "pkg": "",
+            "pkg.core": "from . import heavy"
+                        "  # lint: allow(lazy-import)\n",
+            "pkg.heavy": "",
+        })
+        fs = import_graph.audit_graph(g, manifest=("pkg.heavy",),
+                                      roots=("pkg.core",))
+        assert fs == []
+
+    def test_transitive_leak_reports_chain(self):
+        g = self._graph({
+            "pkg": "",
+            "pkg.a": "from . import b\n",
+            "pkg.b": "from . import heavy\n",
+            "pkg.heavy": "",
+        })
+        fs = import_graph.audit_graph(g, manifest=("pkg.heavy",),
+                                      roots=("pkg.a",))
+        assert len(fs) == 1
+        assert "pkg.a -> pkg.b -> pkg.heavy" in fs[0].message
+
+    def test_subtree_manifest_entry(self):
+        g = self._graph({
+            "pkg": "",
+            "pkg.core": "from .fed import avg\n",
+            "pkg.fed": "",
+            "pkg.fed.avg": "",
+        })
+        fs = import_graph.audit_graph(g, manifest=("pkg.fed",),
+                                      roots=("pkg.core",))
+        leaked = {f.where for f in fs}
+        assert "pkg.fed.avg" in leaked and "pkg.fed" in leaked
+
+    def test_stale_manifest_entry(self):
+        g = self._graph({"pkg": "", "pkg.core": ""})
+        fs = import_graph.audit_graph(g, manifest=("pkg.ghost",),
+                                      roots=("pkg.core",))
+        assert [f.pass_name for f in fs] == ["lazy-manifest-stale"]
+
+    def test_repo_manifest_modules_exist(self):
+        g = import_graph.build_graph()
+        for entry in import_graph.LAZY_MODULES:
+            assert g.expand(entry), entry
+
+    def test_repo_plain_closure_is_clean(self):
+        # the one generated check unifying the ten subprocess no-import
+        # pins: every manifest-lazy module stays out of the closure
+        assert import_graph.audit_package() == []
+
+    def test_repo_closure_is_nontrivial(self):
+        # guard against the checker trivially passing on a broken graph
+        g = import_graph.build_graph()
+        closure = g.eager_closure(import_graph.PLAIN_CLOSURE_ROOTS)
+        assert len(closure) > 50
+        assert "paddle_tpu.distributed.spmd" in closure
+        assert "paddle_tpu.monitor" in closure
+
+
+_OBS_DOC = """
+# doc
+
+## Metric family reference
+
+| family | kind |
+|---|---|
+| `good_total` | counter |
+
+## Span name reference
+
+| span | subsystem |
+|---|---|
+| `phase` | app |
+| `collective/<op>` | collective |
+"""
+
+
+class TestObsAudit:
+    def test_clean_inventory(self):
+        srcs = {"m.py": '_C = _monitor.counter("good_total", "h")\n'
+                        'with _trace.span("phase"):\n    pass\n'}
+        assert obs_audit.audit_inventory(srcs, _OBS_DOC) == []
+
+    def test_undocumented_metric_planted(self):
+        srcs = {"m.py": '_C = _monitor.counter("good_total", "h")\n'
+                        'with _trace.span("phase"):\n    pass\n'
+                        '_D = _monitor.gauge("rogue_gauge", "h")\n'}
+        fs = obs_audit.audit_inventory(srcs, _OBS_DOC)
+        assert [f.pass_name for f in fs] == ["metric-undocumented"]
+        assert "rogue_gauge" in fs[0].message
+
+    def test_doc_stale_metric(self):
+        fs = obs_audit.audit_inventory({"m.py": "x = 1\n"}, _OBS_DOC)
+        assert "metric-doc-stale" in {f.pass_name for f in fs}
+
+    def test_undocumented_span_planted(self):
+        srcs = {"m.py": '_C = _monitor.counter("good_total", "h")\n'
+                        'sp = _trace.start_span("rogue_span")\n'}
+        fs = obs_audit.audit_inventory(srcs, _OBS_DOC)
+        assert "span-undocumented" in {f.pass_name for f in fs}
+
+    def test_dynamic_span_row_accepted(self):
+        # collective/<op> has no literal call site; DYNAMIC_SPANS covers it
+        srcs = {"m.py": '_C = _monitor.counter("good_total", "h")\n'
+                        'with _trace.span("phase"):\n    pass\n'}
+        fs = obs_audit.audit_inventory(srcs, _OBS_DOC)
+        assert "span-doc-stale" not in {f.pass_name for f in fs}
+
+    def test_stale_span_row(self):
+        doc = _OBS_DOC + "| `gone_span` | app |\n"
+        srcs = {"m.py": '_C = _monitor.counter("good_total", "h")\n'
+                        'with _trace.span("phase"):\n    pass\n'}
+        fs = obs_audit.audit_inventory(srcs, doc)
+        assert "span-doc-stale" in {f.pass_name for f in fs}
+
+    def test_required_family_gone_planted(self):
+        dump = '_REQUIRED = {"train": ("good_total", "vanished_total")}\n'
+        srcs = {"m.py": '_C = _monitor.counter("good_total", "h")\n'
+                        'with _trace.span("phase"):\n    pass\n'}
+        fs = obs_audit.audit_inventory(srcs, _OBS_DOC, dump_source=dump)
+        assert [f.pass_name for f in fs] == ["required-family-gone"]
+        assert "vanished_total" in fs[0].message
+
+    def test_required_series_families_checked(self):
+        dump = ('_REQUIRED_SERIES = {"q": (("lost_total", "op", "x"),)}\n')
+        srcs = {"m.py": '_C = _monitor.counter("good_total", "h")\n'
+                        'with _trace.span("phase"):\n    pass\n'}
+        fs = obs_audit.audit_inventory(srcs, _OBS_DOC, dump_source=dump)
+        assert "required-family-gone" in {f.pass_name for f in fs}
+
+    def test_allow_marker_suppresses_undocumented(self):
+        srcs = {"m.py": '_C = _monitor.counter("good_total", "h")\n'
+                        'with _trace.span("phase"):\n    pass\n'
+                        '_P = _monitor.gauge("private_g", "h")'
+                        '  # lint: allow(undocumented-metric)\n'}
+        fs = obs_audit.audit_inventory(srcs, _OBS_DOC)
+        assert fs == []
+
+    def test_harvest_is_receiver_scoped(self):
+        # only the telemetry module aliases register: a bare emit()
+        # helper (the analysis passes' own finding emitters) or a
+        # foreign .counter() must not be harvested
+        srcs = {"m.py": 'emit("deadcode", scan, 1, "msg")\n'
+                        'scan.counter("not_a_metric", 2)\n'
+                        'sp.span("not_a_span")\n'}
+        assert obs_audit.code_span_names(srcs) == {}
+        assert obs_audit.code_metric_families(srcs) == {}
+
+    def test_repo_observability_is_clean(self):
+        assert obs_audit.audit_package() == []
+
+
+_THREADED_BAD = """
+import threading
+_LOCK = threading.Lock()
+_STATE = {}
+_COUNT = [0]
+
+def worker():
+    _STATE["k"] = 1
+    _COUNT[0] += 1
+
+threading.Thread(target=worker, daemon=True).start()
+"""
+
+_THREADED_GOOD = """
+import threading
+_LOCK = threading.Lock()
+_STATE = {}
+
+def worker():
+    local = {}
+    local["k"] = 1
+    with _LOCK:
+        _STATE["k"] = 1
+
+threading.Thread(target=worker, daemon=True).start()
+"""
+
+
+class TestThreadDisciplineLint:
+    def test_unlocked_write_planted(self):
+        fs = lint_thread_discipline(_THREADED_BAD, "m.py", "_LOCK")
+        assert {f.pass_name for f in fs} == {"unlocked-thread-shared-write"}
+        assert len(fs) == 2   # _STATE and _COUNT
+
+    def test_locked_and_local_writes_are_clean(self):
+        assert lint_thread_discipline(_THREADED_GOOD, "m.py",
+                                      "_LOCK") == []
+
+    def test_thread_subclass_run_is_a_root(self):
+        src = ("import threading\n"
+               "_LOCK = threading.Lock()\n"
+               "_S = {}\n"
+               "class W(threading.Thread):\n"
+               "    def run(self):\n"
+               "        _S['x'] = 1\n")
+        fs = lint_thread_discipline(src, "m.py", "_LOCK")
+        assert len(fs) == 1 and fs[0].pass_name == \
+            "unlocked-thread-shared-write"
+
+    def test_reachable_callee_is_policed(self):
+        src = ("import threading\n"
+               "_LOCK = threading.Lock()\n"
+               "_S = {}\n"
+               "def helper():\n"
+               "    _S['x'] = 1\n"
+               "def body():\n"
+               "    helper()\n"
+               "threading.Thread(target=body).start()\n")
+        fs = lint_thread_discipline(src, "m.py", "_LOCK")
+        assert len(fs) == 1
+
+    def test_unreachable_function_not_policed(self):
+        src = ("import threading\n"
+               "_LOCK = threading.Lock()\n"
+               "_S = {}\n"
+               "def not_a_thread():\n"
+               "    _S['x'] = 1\n"
+               "def body():\n"
+               "    pass\n"
+               "threading.Thread(target=body).start()\n")
+        assert lint_thread_discipline(src, "m.py", "_LOCK") == []
+
+    def test_allow_marker_suppresses(self):
+        src = ("import threading\n"
+               "_LOCK = threading.Lock()\n"
+               "_ON = [False]\n"
+               "def body():\n"
+               "    _ON[0] = True  # lint: allow(thread-shared-write)\n"
+               "threading.Thread(target=body).start()\n")
+        assert lint_thread_discipline(src, "m.py", "_LOCK") == []
+
+    def test_nested_function_param_shadows_global(self):
+        # a nested def's parameter named like a module global is LOCAL —
+        # writing through it must not be flagged
+        src = ("import threading\n"
+               "_LOCK = threading.Lock()\n"
+               "_STATE = {}\n"
+               "def worker():\n"
+               "    def fmt(_STATE):\n"
+               "        _STATE['k'] = 1\n"
+               "    fmt({})\n"
+               "threading.Thread(target=worker).start()\n")
+        assert lint_thread_discipline(src, "m.py", "_LOCK") == []
+
+    def test_missing_designated_lock_is_loud(self):
+        src = "import threading\n_S = {}\n"
+        fs = lint_thread_discipline(src, "m.py", "_MISSING_LOCK")
+        assert len(fs) == 1
+        assert "appears nowhere" in fs[0].message
+
+    def test_repo_thread_modules_are_clean(self):
+        for rel, lock in THREAD_SHARED_MODULES.items():
+            src = open(os.path.join(REPO, "paddle_tpu", rel)).read()
+            assert lint_thread_discipline(src, rel, lock) == [], rel
+
+
+class TestAllowlistConsolidation:
+    def test_every_rule_has_spellings(self):
+        from paddle_tpu.analysis import contract_rules
+
+        for rule in contract_rules():
+            sp = allowlist.spellings(rule)
+            assert sp[0] == rule
+
+    def test_aliases_resolve(self):
+        lines = ["x = 1  # lint: allow(client_output)"]
+        assert allowlist.allowed(lines, 1, "nonreduced-client-output")
+        assert not allowlist.allowed(lines, 1, "orphan-flag-unread")
+
+    def test_source_lint_shares_the_table(self):
+        # the old private copy is gone: source_lint re-exports the shared
+        # alias table object
+        from paddle_tpu.analysis import source_lint
+
+        assert source_lint._RULE_ALIASES is allowlist.RULE_ALIASES

@@ -1,0 +1,325 @@
+"""Tier-1 contract-auditor gate (ISSUE 12): the repo's flag-gating,
+lazy-import, observability-inventory, and thread-discipline invariants
+are machine-checked every run.
+
+Contract (the acceptance criteria, in executable form):
+
+ - `tools/contract_audit.py` reports ZERO error-severity findings on all
+   four targets (flags / imports / observability / threads) — errors are
+   contract violations and are FIXED, never baselined;
+ - warning/info counts are pinned to tests/contract_baseline.json (a new
+   warning fails until acknowledged by re-recording) and the recorded
+   baseline itself is empty or comment-justified;
+ - `python tools/contract_audit.py --json` exits 0 (the CLI form);
+ - conflicting-default `define_flag` re-definition raises; the
+   idempotent same-default path and the set_flags-before-define
+   (provisional) path keep working;
+ - every flag in the runtime registry carries a non-empty help string;
+ - each pass demonstrably fails on a planted violation (the full pos/neg
+   matrix lives in tests/test_analysis_passes.py);
+ - the ten subprocess no-import pins stay as belt-and-braces: one plain
+   trainer+engine subprocess asserts EVERY manifest-lazy module is
+   absent from sys.modules — the dynamic twin of the static closure
+   check (and the pin for the newly-lazy monitor/blackbox.py).
+
+Regenerate the baseline after an INTENTIONAL change:
+    python tools/contract_audit.py --record
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "contract_baseline.json")
+TARGETS = ("flags", "imports", "observability", "threads")
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location(
+        "contract_audit", os.path.join(REPO, "tools", "contract_audit.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def report():
+    return _load_tool().build_report()
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    if not os.path.exists(BASELINE_PATH):
+        pytest.fail("tests/contract_baseline.json missing — run "
+                    "`python tools/contract_audit.py --record`")
+    return json.load(open(BASELINE_PATH))
+
+
+# ---------------------------------------------------------------------------
+# the repo is clean
+# ---------------------------------------------------------------------------
+
+
+def test_all_targets_present(report):
+    assert set(report["targets"]) == set(TARGETS)
+    assert len(report["passes"]) >= 12   # the consolidated rule table
+
+
+@pytest.mark.parametrize("target", TARGETS)
+def test_zero_error_findings(report, target):
+    rep = report["targets"][target]
+    errors = [f for f in rep["findings"] if f["severity"] == "error"]
+    assert errors == [], (
+        f"{target}: contract violations (fix them — errors never go "
+        "into the baseline):\n" + "\n".join(
+            f"  [{f['pass']}] {f['message']} @ {f['where']}"
+            for f in errors))
+
+
+@pytest.mark.parametrize("target", TARGETS)
+def test_warning_baseline(report, baseline, target):
+    got = report["targets"][target]["counts"]["warning"]
+    want = baseline["targets"][target]["warning"]
+    assert got <= want, (
+        f"{target}: {got} warning(s) vs recorded {want} — fix it or "
+        "acknowledge via `python tools/contract_audit.py --record`")
+
+
+def test_baseline_never_carries_errors(baseline):
+    for name, counts in baseline["targets"].items():
+        assert set(counts) <= {"warning", "info"}, (
+            f"{name}: the baseline may only pin warning/info counts — "
+            "error findings are fixed, not recorded")
+
+
+def test_record_writes_counts_only(report, tmp_path):
+    tool = _load_tool()
+    path = tmp_path / "baseline.json"
+    base = tool.record_baseline(report, path=str(path))
+    on_disk = json.load(open(path))
+    assert on_disk == base
+    for counts in on_disk["targets"].values():
+        assert set(counts) <= {"warning", "info"}
+
+
+# ---------------------------------------------------------------------------
+# rule-table consolidation (--list-rules)
+# ---------------------------------------------------------------------------
+
+
+def test_rule_table_is_consolidated():
+    from paddle_tpu.analysis import (contract_rules, flag_audit,
+                                     import_graph, obs_audit, source_lint)
+    from paddle_tpu.analysis.allowlist import spellings
+
+    merged = contract_rules()
+    for mod in (source_lint, flag_audit, import_graph, obs_audit):
+        for rule, sev in mod.RULES.items():
+            assert merged[rule] == sev
+    # every rule resolves to at least its own spelling; the documented
+    # shorthands stay registered
+    for rule in merged:
+        assert spellings(rule)[0] == rule
+    assert "client_output" in spellings("nonreduced-client-output")
+    assert "thread-shared-write" in spellings(
+        "unlocked-thread-shared-write")
+    assert "lazy-import" in spellings("lazy-module-leak")
+    assert "orphan-flag" in spellings("orphan-flag-unread")
+
+
+def test_graph_lint_contracts_umbrella():
+    """tools/graph_lint.py --contracts folds the auditor into the shared
+    report (and --all includes it)."""
+    spec = importlib.util.spec_from_file_location(
+        "graph_lint", os.path.join(REPO, "tools", "graph_lint.py"))
+    gl = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gl)
+    rep = gl.build_report(contracts=True)
+    for t in TARGETS:
+        assert f"contract_{t}" in rep["targets"]
+        assert rep["targets"][f"contract_{t}"]["counts"]["error"] == 0
+    assert rep["totals"]["error"] == 0
+
+
+# ---------------------------------------------------------------------------
+# define_flag conflicting-default contract (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestDefineFlagConflicts:
+    def test_conflicting_default_raises(self):
+        from paddle_tpu import flags
+
+        probe = "contract_gate_conflict_probe"
+        try:
+            flags.define_flag(probe, 1, "first")
+            with pytest.raises(ValueError, match="conflicting defaults"):
+                flags.define_flag(probe, 2, "second")
+            # the registry keeps the FIRST (authoritative) definition
+            assert flags.get_flag(probe) == 1
+            assert flags._REGISTRY[probe]["default"] == 1
+        finally:
+            flags._REGISTRY.pop(probe, None)
+
+    def test_type_change_is_a_conflict(self):
+        from paddle_tpu import flags
+
+        probe = "contract_gate_type_probe"
+        try:
+            flags.define_flag(probe, False, "bool flag")
+            with pytest.raises(ValueError, match="conflicting defaults"):
+                flags.define_flag(probe, 0, "int flag")   # False != 0 here
+        finally:
+            flags._REGISTRY.pop(probe, None)
+
+    def test_same_default_redefine_is_idempotent(self):
+        from paddle_tpu import flags
+
+        probe = "contract_gate_idem_probe"
+        try:
+            flags.define_flag(probe, 5, "h")
+            flags.set_flags({probe: 9})
+            assert flags.define_flag(probe, 5, "h") == 9   # value kept
+        finally:
+            flags._REGISTRY.pop(probe, None)
+
+    def test_set_flags_before_define_still_adopts(self):
+        """The lazy-module pattern (tests/test_numerics_gate.py pins the
+        original form): a user value set before the defining module
+        loads survives, and the later real definition owns the default
+        WITHOUT tripping the conflict check."""
+        from paddle_tpu import flags
+
+        probe = "contract_gate_provisional_probe"
+        try:
+            flags.set_flags({probe: 17})
+            assert flags.define_flag(probe, 3, "late definer") == 17
+            assert flags._REGISTRY[probe]["default"] == 3
+            assert not flags._REGISTRY[probe].get("provisional")
+        finally:
+            flags._REGISTRY.pop(probe, None)
+
+
+def test_every_registered_flag_has_help():
+    """Acceptance criterion: no flag in the runtime registry without a
+    help string — including the ones lazy modules define."""
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu import flags
+    # pull in every lazy flag-defining module
+    import paddle_tpu.framework.aot  # noqa: F401
+    import paddle_tpu.monitor.blackbox  # noqa: F401
+    import paddle_tpu.monitor.numerics  # noqa: F401
+    import paddle_tpu.testing.failpoints  # noqa: F401
+    import paddle_tpu.trace  # noqa: F401
+    import paddle_tpu.trace.costs  # noqa: F401
+
+    missing = [n for n, e in flags._REGISTRY.items()
+               if not e.get("provisional") and not e["help"]]
+    assert missing == [], f"flags without help strings: {missing}"
+
+
+# ---------------------------------------------------------------------------
+# planted-violation smoke (full matrix in test_analysis_passes.py)
+# ---------------------------------------------------------------------------
+
+
+def test_each_pass_fails_on_a_planted_violation():
+    from paddle_tpu.analysis import flag_audit, import_graph, obs_audit
+    from paddle_tpu.analysis.source_lint import lint_thread_discipline
+
+    fs = flag_audit.audit_inventory(
+        flag_audit.collect({"m.py": 'define_flag("orphan_x", 0, "h")\n'}),
+        hot_paths={}, lazy_modules=())
+    assert any(f.pass_name == "orphan-flag-unread" for f in fs)
+
+    g = import_graph.build_graph(sources={
+        "p": "", "p.core": "from . import lazy_mod\n", "p.lazy_mod": ""})
+    fs = import_graph.audit_graph(g, manifest=("p.lazy_mod",),
+                                  roots=("p.core",))
+    assert any(f.pass_name == "lazy-module-leak" for f in fs)
+
+    doc = ("## Metric family reference\n\n| family |\n|---|\n"
+           "## Span name reference\n\n| span |\n|---|\n")
+    fs = obs_audit.audit_inventory(
+        {"m.py": '_C = _monitor.counter("undoc_total", "h")\n'}, doc)
+    assert any(f.pass_name == "metric-undocumented" for f in fs)
+
+    src = ("import threading\n_LOCK = threading.Lock()\n_S = {}\n"
+           "def w():\n    _S['k'] = 1\n"
+           "threading.Thread(target=w).start()\n")
+    fs = lint_thread_discipline(src, "m.py", "_LOCK")
+    assert any(f.pass_name == "unlocked-thread-shared-write" for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end CLI + dynamic no-import pin (subprocesses)
+# ---------------------------------------------------------------------------
+
+
+def test_cli_json_exits_zero():
+    """THE acceptance invocation: zero error findings, empty baseline."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "contract_audit.py"),
+         "--json"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rep = json.loads(out.stdout)
+    assert rep["tool"] == "contract_audit"
+    assert set(rep["targets"]) == set(TARGETS)
+    assert rep["totals"]["error"] == 0
+
+
+def test_plain_process_imports_no_manifest_lazy_module():
+    """Belt-and-braces for the static closure check: a plain trainer AND
+    a plain engine in one subprocess, then every LAZY_MODULES name (and
+    its subtree) must be absent from sys.modules. This is the dynamic
+    pin for monitor/blackbox.py going manifest-lazy in ISSUE 12."""
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import paddle_tpu as paddle\n"
+        "from paddle_tpu import nn\n"
+        "from paddle_tpu.distributed.mesh import build_mesh\n"
+        "from paddle_tpu.distributed.spmd import SpmdTrainer\n"
+        "from paddle_tpu.inference.serving import ServingEngine\n"
+        "from paddle_tpu.models import GPTConfig, GPTForCausalLM\n"
+        "paddle.seed(0)\n"
+        "net = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 4))\n"
+        "opt = paddle.optimizer.AdamW(learning_rate=1e-3,\n"
+        "    parameters=net.parameters())\n"
+        "mesh = build_mesh((1,), ('dp',), devices=jax.devices()[:1])\n"
+        "tr = SpmdTrainer(net, opt, loss_fn=nn.MSELoss(), mesh=mesh)\n"
+        "x = paddle.to_tensor(np.ones((4, 8), np.float32))\n"
+        "y = paddle.to_tensor(np.ones((4, 4), np.float32))\n"
+        "tr.train_step(x, y)\n"
+        "m = GPTForCausalLM(GPTConfig(vocab_size=64, hidden_size=32,\n"
+        "    num_layers=1, num_heads=2, max_seq_len=32))\n"
+        "m.eval()\n"
+        "eng = ServingEngine(m, max_batch=1)\n"
+        "eng.submit(np.arange(4, dtype=np.int32), max_new_tokens=2)\n"
+        "eng.run_until_complete()\n"
+        "import sys\n"
+        "from paddle_tpu.analysis.import_graph import LAZY_MODULES\n"
+        "bad = [m for m in sys.modules\n"
+        "       for entry in LAZY_MODULES\n"
+        "       if m == entry or m.startswith(entry + '.')]\n"
+        "assert not bad, f'manifest-lazy modules imported: {bad}'\n"
+        "print('CLEAN')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         capture_output=True, text=True, timeout=560,
+                         env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "CLEAN" in out.stdout
+
+
+if __name__ == "__main__":
+    print(__doc__)

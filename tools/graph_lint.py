@@ -4,8 +4,12 @@
     python tools/graph_lint.py --model bert --json    # machine-readable
     python tools/graph_lint.py --all --json           # models + serving
                                                       # decode + source lint
+                                                      # + contract auditor
     python tools/graph_lint.py --source               # source lint only
+    python tools/graph_lint.py --contracts            # ISSUE 12 contract
+                                                      # auditor passes
     python tools/graph_lint.py --list                 # registered passes
+    python tools/graph_lint.py --list-rules           # rules + allow markers
 
 Report format (shared with tools/op_coverage.py --json so the tier-1 gate
 reads both through one schema):
@@ -33,7 +37,8 @@ jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_report(models=(), serving=False, source=False, training=False):
+def build_report(models=(), serving=False, source=False, training=False,
+                 contracts=False):
     """Run the requested targets; returns the shared-format report dict."""
     from paddle_tpu.analysis import registered_passes
     from paddle_tpu.analysis.registry import AnalysisReport
@@ -50,6 +55,11 @@ def build_report(models=(), serving=False, source=False, training=False):
         rep = AnalysisReport(name="source_lint")
         rep.extend(lint_path())
         targets["source_lint"] = rep.sort()
+    if contracts:
+        from paddle_tpu.analysis import contract_reports
+
+        for name, rep in contract_reports().items():
+            targets[f"contract_{name}"] = rep
 
     totals = {"error": 0, "warning": 0, "info": 0}
     for rep in targets.values():
@@ -76,12 +86,19 @@ def main(argv=None):
                     help="analyze the serving engine decode step")
     ap.add_argument("--source", action="store_true",
                     help="run the AST source linter over paddle_tpu/")
+    ap.add_argument("--contracts", action="store_true",
+                    help="run the ISSUE 12 contract auditor (flag / "
+                         "lazy-import / observability / thread passes; "
+                         "same battery as tools/contract_audit.py)")
     ap.add_argument("--train", action="store_true",
                     help="trace models in training mode (dropout on)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the machine-readable report")
     ap.add_argument("--list", action="store_true",
                     help="list registered passes and lint rules")
+    ap.add_argument("--list-rules", action="store_true", dest="list_rules",
+                    help="list every source/contract rule with severity "
+                         "and allow-marker spellings")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -96,16 +113,23 @@ def main(argv=None):
             print(f"  {r} [{sev}]")
         return 0
 
+    if args.list_rules:
+        from paddle_tpu.analysis import rule_table
+
+        print(rule_table())
+        return 0
+
     models = list(args.model)
-    serving, source = args.serving, args.source
+    serving, source, contracts = args.serving, args.source, args.contracts
     if args.all:
         models = list(MODEL_TARGETS)
-        serving = source = True
-    if not models and not serving and not source:
-        ap.error("pick a target: --model NAME, --serving, --source or --all")
+        serving = source = contracts = True
+    if not models and not serving and not source and not contracts:
+        ap.error("pick a target: --model NAME, --serving, --source, "
+                 "--contracts or --all")
 
     report = build_report(models=models, serving=serving, source=source,
-                          training=args.train)
+                          training=args.train, contracts=contracts)
     if args.as_json:
         print(json.dumps(report, indent=1))
     else:
