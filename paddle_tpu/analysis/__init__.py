@@ -29,25 +29,30 @@ from .registry import (  # noqa: F401
 )
 from .collectives import count_hlo_collectives  # noqa: F401
 from . import passes  # noqa: F401  — registers the builtin pass battery
+from . import sharding_flow  # noqa: F401  — registers the ISSUE 13 passes
 from .source_lint import lint_path, lint_source  # noqa: F401
 from .targets import analyze_model, analyze_serving_decode  # noqa: F401
+from .sharding_flow import sharding_reports  # noqa: F401
 
 
-def contract_reports(targets=None):
-    """The ISSUE 12 contract-auditor battery: run the four static
+def contract_reports(targets=None, handoff_baseline=None):
+    """The contract-auditor battery (ISSUE 12 + 13): run the static
     contract passes over the repo; returns {target: AnalysisReport} for
     targets ``flags`` (flag_audit), ``imports`` (import_graph lazy
     closure), ``observability`` (obs_audit docs/code/metrics_dump
     drift), ``threads`` (the unlocked-thread-shared-write lint over
-    THREAD_SHARED_MODULES). `targets` picks a subset (None = all four —
-    only the picked passes run). CLI: ``python tools/contract_audit.py``."""
+    THREAD_SHARED_MODULES), ``handoff`` (handoff_schema transfer-edge
+    declarations vs tests/handoff_baseline.json), ``pallas``
+    (pallas_audit kernel block/VMEM/accumulator budgets). `targets`
+    picks a subset (None = all six — only the picked passes run).
+    CLI: ``python tools/contract_audit.py``."""
     import os
 
     from . import flag_audit, import_graph, obs_audit
     from .source_lint import THREAD_SHARED_MODULES, lint_thread_discipline
 
-    picked = ("flags", "imports", "observability", "threads") \
-        if targets is None else tuple(targets)
+    picked = ("flags", "imports", "observability", "threads", "handoff",
+              "pallas") if targets is None else tuple(targets)
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     reports = {}
     if "flags" in picked:
@@ -69,17 +74,33 @@ def contract_reports(targets=None):
             with open(path, encoding="utf-8") as f:
                 rep.extend(lint_thread_discipline(f.read(), rel, lock))
         reports["threads"] = rep.sort()
+    if "handoff" in picked:
+        from . import handoff_schema
+
+        rep = AnalysisReport(name="handoff")
+        rep.extend(handoff_schema.audit_package(
+            baseline_path=handoff_baseline))
+        reports["handoff"] = rep.sort()
+    if "pallas" in picked:
+        from . import pallas_audit
+
+        rep = AnalysisReport(name="pallas")
+        rep.extend(pallas_audit.audit_package())
+        reports["pallas"] = rep.sort()
     return reports
 
 
 def contract_rules():
     """{rule: severity} over the source linter AND the contract-auditor
     passes — the one vocabulary --list-rules prints (with allow-marker
-    spellings from analysis/allowlist.py)."""
-    from . import flag_audit, import_graph, obs_audit, source_lint
+    spellings from analysis/allowlist.py). The ISSUE 13 jaxpr-level
+    sharding rules ride along: one vocabulary across every surface."""
+    from . import (flag_audit, handoff_schema, import_graph, obs_audit,
+                   pallas_audit, sharding_flow, source_lint)
 
     merged = {}
-    for mod in (source_lint, flag_audit, import_graph, obs_audit):
+    for mod in (source_lint, flag_audit, import_graph, obs_audit,
+                sharding_flow, handoff_schema, pallas_audit):
         merged.update(mod.RULES)
     return merged
 

@@ -33,6 +33,15 @@ RULE_ALIASES = {
     "hot-path-flag-read": ("hot-flag-read",),
     "metric-undocumented": ("undocumented-metric",),
     "span-undocumented": ("undocumented-span",),
+    # ISSUE 13: sharding-flow / transfer-edge / kernel-budget rules
+    "implicit-replication": ("replicated-tensor",),
+    "resharding-churn": ("reshard-churn",),
+    "collective-axis-mismatch": ("bad-collective-axis",),
+    "ppermute-malformed": ("bad-ppermute",),
+    "branch-collective-mismatch": ("branch-collectives",),
+    "handoff-schema-drift": ("handoff-drift",),
+    "kernel-vmem-over-budget": ("vmem-budget",),
+    "kernel-low-precision-accumulator": ("int8-accumulator",),
 }
 
 

@@ -1,0 +1,450 @@
+"""Typed transfer edges: declared, statically audited handoff schemas.
+
+The repo has four cross-program hand-offs whose payload layout was, until
+ISSUE 13, an implicit contract between a producer and a consumer that
+only broke at runtime (or silently corrupted a KV cache):
+
+- ``disagg_kv`` — the prefill→decode KV handoff
+  (``PrefillWorker.prefill`` → ``ServingEngine.admit_prefilled``);
+- ``pipeline_stage`` — the stage-boundary activation a ppermute ring
+  carries between pipeline ranks;
+- ``federated_adapter`` — the flattened trainable-delta payload a
+  federated client returns for aggregation;
+- ``checkpoint_state`` — the {params, opt_state, step, lr} tree
+  ``gather_train_state`` writes and ``restore_train_state`` re-places.
+
+Each edge is declared ONCE, as a module-level **literal** dict named in
+:data:`EDGES` (e.g. ``serving/disagg.py HANDOFF_SCHEMA``). Literal
+matters: this module AST-extracts the declaration without importing the
+declaring module, so the audit sees exactly what the runtime consumes —
+one source of truth, checked from both sides:
+
+- statically: ``audit_package()`` extracts every declaration, verifies
+  the producer/consumer sites exist (and reference the schema where
+  ``runtime_checked``), checks payload well-formedness, and pins each
+  edge's fingerprint against ``tests/handoff_baseline.json`` — a silent
+  KV-layout or payload drift fails lint before it corrupts a handoff;
+- at runtime: consumers call :func:`validate` with the SAME declaration
+  (``ServingEngine.admit_prefilled``, the pipeline trainer's stage-edge
+  check) so a malformed payload raises naming the offending leaf.
+
+Payload grammar — a dict of leaf specs (nesting allowed)::
+
+    {"kc": {"shape": ("L", 1, "KVh", "T", "hd"), "dtype": "$cache",
+            "layout": "[L, B, KVh, T, hd]", "quantizable": True}}
+
+``shape`` entries are ints, symbolic dim names (bound via ``dims=`` at
+validation, or on first use — consistency is still enforced), or the
+``"..."`` wildcard (any trailing dims). ``dtype`` is a numpy dtype name
+or a ``$name`` symbol bound via ``dtypes=``. ``quantizable`` leaves
+accept a ``(values, scales)`` pair in place of the dense array (the
+int8/fp8 KV-cache codec). CLI: ``python tools/contract_audit.py
+--handoff`` (``--record`` stamps the baseline). See docs/ANALYSIS.md
+"Declaring a transfer edge".
+"""
+import ast
+import json
+import os
+
+from .registry import Finding
+
+RULES = {
+    "handoff-schema-missing": "error",
+    "handoff-schema-malformed": "error",
+    "handoff-site-unwired": "error",
+    "handoff-schema-drift": "error",
+    "handoff-schema-unpinned": "error",
+    "handoff-baseline-stale": "error",
+}
+
+#: edge name -> (repo-relative declaring file, module-level attr). A new
+#: cross-program hand-off registers here AND declares the literal; the
+#: audit fails on either half alone.
+EDGES = {
+    "disagg_kv": ("paddle_tpu/serving/disagg.py", "HANDOFF_SCHEMA"),
+    "pipeline_stage": ("paddle_tpu/distributed/pipeline.py",
+                       "HANDOFF_SCHEMA"),
+    "federated_adapter": ("paddle_tpu/federated/averaging.py",
+                          "HANDOFF_SCHEMA"),
+    "checkpoint_state": ("paddle_tpu/distributed/spmd.py",
+                         "CHECKPOINT_SCHEMA"),
+}
+
+BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "tests", "handoff_baseline.json")
+
+_REQUIRED_KEYS = ("edge", "payload", "producer", "consumer")
+
+
+def _pkg_root():
+    """Directory containing the paddle_tpu package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# runtime validation (the consumer half)
+# ---------------------------------------------------------------------------
+
+
+class HandoffMismatch(ValueError):
+    """A payload that does not match its edge's declared schema; the
+    message names the edge, the leaf, and the field that diverged."""
+
+
+def _is_leaf_spec(node):
+    return isinstance(node, dict) and ("shape" in node or "dtype" in node
+                                       or "kind" in node)
+
+
+def _leaves(payload, prefix=""):
+    for k in sorted(payload):
+        v = payload[k]
+        path = f"{prefix}{k}"
+        if _is_leaf_spec(v):
+            yield path, v
+        elif isinstance(v, dict):
+            yield from _leaves(v, f"{path}.")
+        else:
+            yield path, {"malformed": v}
+
+
+def _check_shape(edge, leaf, declared, actual, binds):
+    decl = list(declared)
+    act = list(actual)
+    if decl and decl[-1] == "...":
+        decl = decl[:-1]
+        if len(act) < len(decl):
+            raise HandoffMismatch(
+                f"[{edge}] {leaf}: rank {len(act)} < the declared "
+                f"{len(decl)} leading dim(s) {tuple(declared)}")
+        act = act[:len(decl)]
+    elif len(decl) != len(act):
+        raise HandoffMismatch(
+            f"[{edge}] {leaf}: rank {len(act)} != declared rank "
+            f"{len(decl)} ({tuple(declared)} vs {tuple(actual)})")
+    for i, (d, a) in enumerate(zip(decl, act)):
+        if d == "...":
+            return
+        if isinstance(d, int):
+            if int(a) != d:
+                raise HandoffMismatch(
+                    f"[{edge}] {leaf}: dim[{i}] is {a}, declared {d}")
+        else:
+            want = binds.setdefault(str(d), int(a))
+            if int(a) != want:
+                raise HandoffMismatch(
+                    f"[{edge}] {leaf}: dim[{i}] ('{d}') is {a}, but "
+                    f"'{d}' is bound to {want} elsewhere in this payload")
+
+
+def _check_dtype(edge, leaf, declared, actual, dtypes):
+    want = declared
+    if isinstance(want, str) and want.startswith("$"):
+        want = (dtypes or {}).get(want[1:])
+        if want is None:
+            return   # unbound dtype symbol: structural check only
+    if str(actual) != str(want):
+        raise HandoffMismatch(
+            f"[{edge}] {leaf}: dtype {actual}, declared {want}")
+
+
+def validate(schema, values, dims=None, dtypes=None):
+    """Check a payload against its declared schema.
+
+    ``values`` maps leaf names (nested dicts allowed) to arrays — or to
+    ``(values, scales)`` pairs for ``quantizable`` leaves. ``dims`` binds
+    symbolic dim names ({"L": 2, "T": 64, ...}); unbound symbols bind on
+    first use and must then agree across leaves. ``dtypes`` binds
+    ``$name`` dtype symbols. Raises :class:`HandoffMismatch` naming the
+    edge, leaf and field; returns the final symbol bindings.
+    """
+    edge = schema.get("edge", "?")
+    binds = dict(dims or {})
+    for leaf, spec in _leaves(schema["payload"]):
+        if "malformed" in spec:
+            raise HandoffMismatch(
+                f"[{edge}] {leaf}: malformed leaf spec {spec['malformed']!r}")
+        if spec.get("kind") == "opaque" or "shape" not in spec:
+            continue   # structural-only leaves (checkpoint trees)
+        node = values
+        for part in leaf.split("."):
+            if not isinstance(node, dict) or part not in node:
+                raise HandoffMismatch(
+                    f"[{edge}] payload is missing leaf '{leaf}'")
+            node = node[part]
+        if spec.get("quantizable") and isinstance(node, tuple):
+            if len(node) != 2:
+                raise HandoffMismatch(
+                    f"[{edge}] {leaf}: quantized side must be a "
+                    f"(values, scales) pair, got a {len(node)}-tuple")
+            vals, scales = node
+            _check_shape(edge, f"{leaf}.values", spec["shape"],
+                         vals.shape, binds)
+            if "dtype" in spec:
+                # the quantized side's VALUES dtype honors the same
+                # declaration the dense side does (a producer built with
+                # a different cache codec must fail here, not corrupt
+                # the consumer's cache on the row copy)
+                _check_dtype(edge, f"{leaf}.values", spec["dtype"],
+                             vals.dtype, dtypes)
+            scale_shape = tuple(spec["shape"][:-1]) + (1,)
+            _check_shape(edge, f"{leaf}.scales", scale_shape,
+                         scales.shape, binds)
+            _check_dtype(edge, f"{leaf}.scales", "float32", scales.dtype,
+                         dtypes)
+            continue
+        if isinstance(node, tuple):
+            raise HandoffMismatch(
+                f"[{edge}] {leaf}: got a tuple where a plain array is "
+                "declared (quantized row handed to a dense-cache engine?)")
+        shape = getattr(node, "shape", None)
+        if shape is None:
+            raise HandoffMismatch(
+                f"[{edge}] {leaf}: expected an array, got "
+                f"{type(node).__name__}")
+        _check_shape(edge, leaf, spec["shape"], shape, binds)
+        if "dtype" in spec:
+            _check_dtype(edge, leaf, spec["dtype"],
+                         getattr(node, "dtype", "?"), dtypes)
+    return binds
+
+
+# ---------------------------------------------------------------------------
+# static extraction + fingerprinting (the audit half)
+# ---------------------------------------------------------------------------
+
+
+def extract_declaration(relpath, attr, pkg_root=None):
+    """AST-extract the literal ``attr = {...}`` declaration from a file
+    WITHOUT importing it. Returns the dict, or raises ValueError."""
+    path = os.path.join(pkg_root or _pkg_root(), relpath)
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=relpath)
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            targets = [node.target.id]
+        if attr in targets:
+            try:
+                return ast.literal_eval(node.value)
+            except (ValueError, SyntaxError) as exc:
+                raise ValueError(
+                    f"{relpath}: {attr} must be a pure literal (the "
+                    f"static audit and the runtime consumer must read "
+                    f"the same bytes): {exc}") from None
+    raise ValueError(f"{relpath}: no module-level literal {attr} found")
+
+
+def fingerprint(schema):
+    """Canonical, diff-stable form of an edge declaration: the payload,
+    the producer/consumer wiring, AND the runtime_checked bit (dropping
+    a consumer's runtime validation is drift too) — doc prose excluded."""
+    def canon(v):
+        if isinstance(v, dict):
+            return {k: canon(v[k]) for k in sorted(v)}
+        if isinstance(v, (list, tuple)):
+            return [canon(x) for x in v]
+        return v
+
+    keys = _REQUIRED_KEYS + ("runtime_checked",)
+    return canon({k: schema[k] for k in keys if k in schema})
+
+
+def _find_def(tree, dotted):
+    """Locate 'fn' or 'Class.method' in a parsed module; returns the
+    (start, end) line span or None."""
+    parts = dotted.split(".")
+    body = tree.body
+    node = None
+    for i, part in enumerate(parts):
+        node = next(
+            (n for n in body
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)) and n.name == part), None)
+        if node is None:
+            return None
+        body = getattr(node, "body", [])
+    return (node.lineno, getattr(node, "end_lineno", node.lineno))
+
+
+def _site_check(edge, role, site, attr, runtime_checked, pkg_root):
+    """A site spec 'path/to/file.py::Qual.name' must exist; a
+    runtime-checked edge's file must reference the schema attr."""
+    out = []
+    try:
+        relpath, dotted = site.split("::", 1)
+    except ValueError:
+        return [Finding("handoff-site-unwired", "error",
+                        f"[{edge}] {role} site {site!r} is not "
+                        "'relpath.py::Qual.name'", where=site)]
+    path = os.path.join(pkg_root, relpath)
+    if not os.path.exists(path):
+        return [Finding("handoff-site-unwired", "error",
+                        f"[{edge}] {role} file {relpath} does not exist",
+                        where=site)]
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    span = _find_def(ast.parse(src, filename=relpath), dotted)
+    if span is None:
+        out.append(Finding(
+            "handoff-site-unwired", "error",
+            f"[{edge}] {role} '{dotted}' not found in {relpath} — the "
+            "declaration points at a site that no longer exists",
+            where=site))
+    elif runtime_checked and role == "consumer" and attr not in src:
+        out.append(Finding(
+            "handoff-site-unwired", "error",
+            f"[{edge}] consumer file {relpath} never references "
+            f"{attr} — the runtime validation is supposed to consume "
+            "the same declaration the audit extracts", where=site))
+    return out
+
+
+def load_declarations(pkg_root=None):
+    """{edge: schema-dict} for every registered edge, plus extraction
+    findings for the ones that fail."""
+    root = pkg_root or _pkg_root()
+    decls, findings = {}, []
+    for edge, (relpath, attr) in sorted(EDGES.items()):
+        try:
+            decl = extract_declaration(relpath, attr, pkg_root=root)
+        except (ValueError, OSError) as exc:
+            findings.append(Finding(
+                "handoff-schema-missing", "error",
+                f"[{edge}] {exc}", where=f"{relpath}::{attr}"))
+            continue
+        decls[edge] = decl
+    return decls, findings
+
+
+def _well_formed(edge, decl, relpath, attr):
+    out = []
+    where = f"{relpath}::{attr}"
+    missing = [k for k in _REQUIRED_KEYS if k not in decl]
+    if missing:
+        out.append(Finding(
+            "handoff-schema-malformed", "error",
+            f"[{edge}] declaration lacks {missing}", where=where))
+        return out
+    if decl["edge"] != edge:
+        out.append(Finding(
+            "handoff-schema-malformed", "error",
+            f"[{edge}] declaration names edge {decl['edge']!r} but is "
+            f"registered as {edge!r}", where=where))
+    for leaf, spec in _leaves(decl["payload"]):
+        if "malformed" in spec:
+            out.append(Finding(
+                "handoff-schema-malformed", "error",
+                f"[{edge}] payload leaf '{leaf}' is not a leaf spec: "
+                f"{spec['malformed']!r}", where=where))
+            continue
+        shape = spec.get("shape")
+        if shape is not None:
+            bad = [d for d in shape
+                   if not isinstance(d, int) and not isinstance(d, str)]
+            if bad:
+                out.append(Finding(
+                    "handoff-schema-malformed", "error",
+                    f"[{edge}] {leaf}: shape entries must be ints or "
+                    f"symbolic names, got {bad}", where=where))
+    return out
+
+
+def check_baseline(decls, baseline):
+    """Drift findings: every declared edge must be pinned with an equal
+    fingerprint, and the baseline must not name edges that are gone."""
+    out = []
+    pinned = (baseline or {}).get("edges", {})
+    for edge, decl in sorted(decls.items()):
+        want = pinned.get(edge)
+        got = fingerprint(decl)
+        if want is None:
+            out.append(Finding(
+                "handoff-schema-unpinned", "error",
+                f"[{edge}] edge is not in the recorded baseline — stamp "
+                "it with `python tools/contract_audit.py --record` (a "
+                "NEW transfer edge is an intentional act)", where=edge))
+        elif want != got:
+            diffs = _diff_fingerprints(want, got)
+            out.append(Finding(
+                "handoff-schema-drift", "error",
+                f"[{edge}] declared schema drifted from the recorded "
+                f"baseline ({'; '.join(diffs[:4])}) — a consumer built "
+                "against the recorded layout would mis-read this "
+                "payload; re-record ONLY if every side moved together",
+                where=edge))
+    for edge in sorted(set(pinned) - set(decls)):
+        out.append(Finding(
+            "handoff-baseline-stale", "error",
+            f"[{edge}] baseline pins an edge that is no longer "
+            "declared — remove it via --record", where=edge))
+    return out
+
+
+def _diff_fingerprints(want, got, prefix=""):
+    diffs = []
+    if isinstance(want, dict) and isinstance(got, dict):
+        for k in sorted(set(want) | set(got)):
+            if k not in want:
+                diffs.append(f"{prefix}{k}: added")
+            elif k not in got:
+                diffs.append(f"{prefix}{k}: removed")
+            elif want[k] != got[k]:
+                diffs.extend(_diff_fingerprints(want[k], got[k],
+                                                f"{prefix}{k}."))
+    elif want != got:
+        diffs.append(f"{prefix[:-1] or 'value'}: {want!r} -> {got!r}")
+    return diffs
+
+
+def record_baseline(path=None, pkg_root=None):
+    """Stamp every extractable edge's fingerprint; returns the baseline
+    dict (the contract_audit --record entry point)."""
+    decls, findings = load_declarations(pkg_root=pkg_root)
+    bad = [f for f in findings]
+    if bad:
+        raise ValueError(
+            "cannot record a baseline over broken declarations: "
+            + "; ".join(f.message for f in bad))
+    base = {"edges": {e: fingerprint(d) for e, d in sorted(decls.items())}}
+    with open(path or BASELINE_PATH, "w") as f:
+        json.dump(base, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return base
+
+
+def audit_package(pkg_root=None, baseline_path=None):
+    """The full handoff audit: extraction + well-formedness + site wiring
+    + baseline drift. Returns a list of Findings."""
+    root = pkg_root or _pkg_root()
+    decls, findings = load_declarations(pkg_root=root)
+    for edge, decl in sorted(decls.items()):
+        relpath, attr = EDGES[edge]
+        fs = _well_formed(edge, decl, relpath, attr)
+        findings.extend(fs)
+        if fs:
+            continue
+        rc = bool(decl.get("runtime_checked"))
+        findings.extend(_site_check(edge, "producer", decl["producer"],
+                                    attr, rc, root))
+        findings.extend(_site_check(edge, "consumer", decl["consumer"],
+                                    attr, rc, root))
+    bpath = baseline_path or BASELINE_PATH
+    if os.path.exists(bpath):
+        with open(bpath) as f:
+            baseline = json.load(f)
+    else:
+        baseline = None
+        findings.append(Finding(
+            "handoff-schema-unpinned", "error",
+            f"no recorded baseline at {bpath} — run `python "
+            "tools/contract_audit.py --record`", where=bpath))
+    if baseline is not None:
+        findings.extend(check_baseline(decls, baseline))
+    return findings

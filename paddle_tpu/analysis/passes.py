@@ -109,19 +109,43 @@ class _KeyFlow:
         for eqn in jaxpr.eqns:
             for ov in eqn.outvars:
                 producers[id(ov)] = eqn
-        uses = {}        # alias identity -> [(path, primitive), ...]
+        # per alias identity: "direct" sink/split consumptions, and
+        # fold_in consumptions bucketed by their fold operand. Folding
+        # DISTINCT data into one key is the documented-safe idiom
+        # (per-rank/per-phase fold_ins in distributed/compress.py);
+        # everything else — two sinks, two splits, two fold_ins of the
+        # SAME data, or a raw sink/split MIXED with any fold of the same
+        # key — is correlated randomness and still flags.
+        uses = {}   # alias identity -> {"direct": [...], "folds": {disc: [...]}}
 
-        def use(var, where, prim):
+        def use(var, where, prim, disc=None):
             ident = self._alias_id(producers, var)
-            uses.setdefault(ident, []).append((where, prim))
+            entry = uses.setdefault(ident, {"direct": [], "folds": {}})
+            if disc is None:
+                entry["direct"].append((where, prim))
+            else:
+                entry["folds"].setdefault(disc, []).append((where, prim))
+
+        from .jaxpr_utils import is_literal
+
+        def fold_disc(eqn):
+            """random_fold_in's consumption bucket: the fold operand
+            (literal value, or traced-var identity)."""
+            parts = []
+            for v in eqn.invars:
+                if hasattr(v, "aval") and is_key_aval(v.aval):
+                    continue
+                parts.append(str(v.val) if is_literal(v) else id(v))
+            return tuple(parts)
 
         for i, eqn in enumerate(jaxpr.eqns):
             here = f"{path}eqns[{i}]"
             p = eqn.primitive.name
             if p in _RANDOM_SINKS or p in _KEY_DERIVERS:
+                disc = fold_disc(eqn) if p == "random_fold_in" else None
                 for v in eqn.invars:
                     if hasattr(v, "aval") and is_key_aval(v.aval):
-                        use(v, here, p)
+                        use(v, here, p, disc)
                 continue
             subs = [s for _, s in sub_jaxprs(eqn)]
             if subs:
@@ -143,14 +167,27 @@ class _KeyFlow:
 
         invar_ids = {id(v): i for i, v in enumerate(jaxpr.invars)}
         used_invars = set()
-        for ident, sites in uses.items():
+        for ident, entry in uses.items():
             root = ident
             while isinstance(root, tuple):
                 root = root[0]
             if root in invar_ids:
                 used_invars.add(invar_ids[root])
-            if len(sites) >= 2:   # memoization => reported once per jaxpr
-                self.findings.append((sites,))
+            direct, folds = entry["direct"], entry["folds"]
+            # memoization => each condition reported once per jaxpr,
+            # and ONE finding per reused alias identity
+            if len(direct) >= 2:
+                self.findings.append((direct,))
+            for sites in folds.values():
+                if len(sites) >= 2:
+                    self.findings.append((sites,))
+            if len(direct) == 1 and folds:
+                # raw consumption + fold(s) of the SAME key: the sink's
+                # stream is correlated with every folded child stream
+                # (one representative site per fold bucket; the >=2
+                # direct case already reported this alias above)
+                self.findings.append(
+                    (direct + [s[0] for s in folds.values()],))
         self.memo[key] = used_invars
         return used_invars
 
@@ -354,39 +391,9 @@ def collective_count(ctx):
 
 
 # ---------------------------------------------------------------------------
-# unsharded-large-tensor: under a mesh, big intermediates with no sharding
-# constraint replicate on every device — the classic HBM blow-up.
-# ---------------------------------------------------------------------------
-
-
-@register_pass("unsharded-large-tensor", severity="warning")
-def unsharded_large_tensor(ctx):
-    if ctx.mesh is None:
-        return []
-    constrained = set()
-    for eqn, _ in iter_eqns(ctx.jaxpr):
-        if eqn.primitive.name == "sharding_constraint":
-            for v in list(eqn.invars) + list(eqn.outvars):
-                constrained.add(id(v))
-    offenders = []
-    for eqn, path in iter_eqns(ctx.jaxpr):
-        if eqn.primitive.name == "sharding_constraint":
-            continue
-        for v in eqn.outvars:
-            if not hasattr(v, "aval") or id(v) in constrained:
-                continue
-            shape = getattr(v.aval, "shape", ())
-            if shape and int(np.prod(shape)) >= ctx.large_threshold:
-                offenders.append((path, fmt_aval(v.aval)))
-    if not offenders:
-        return []
-    ex = "; ".join(f"{a} @ {p}" for p, a in offenders[:4])
-    return [unsharded_large_tensor.finding(
-        f"{len(offenders)} intermediate(s) >= {ctx.large_threshold} "
-        f"elements with no sharding constraint under a "
-        f"{dict(ctx.mesh.shape)} mesh (examples: {ex}) — replicated on "
-        "every device unless the partitioner guesses right",
-        where=offenders[0][0])]
+# (unsharded-large-tensor moved to sharding_flow.py as the spec-propagating
+# `implicit-replication` pass — ISSUE 13 upgraded the size-only heuristic
+# into provenance-chained replication analysis.)
 
 
 # ---------------------------------------------------------------------------

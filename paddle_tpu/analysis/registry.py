@@ -100,17 +100,20 @@ class AnalysisContext:
     closed_jaxpr : jax ClosedJaxpr of the analyzed function
     name         : label for the report
     mesh         : optional jax Mesh the function is meant to run under
-                   (enables the unsharded-large-tensor pass)
+                   (enables the sharding-flow passes)
     donated      : optional frozenset of invar indices already donated
                    (None = donation intent unknown; the donation pass
                    reports at info severity then)
     hlo_text     : optional compiled HLO text (enables the exact-count
                    collective audit on top of the jaxpr-level counts)
     large_threshold : element count above which a tensor is "large"
+    in_specs     : optional per-invar shardings (NamedSharding /
+                   PartitionSpec / None), seeding the sharding-flow
+                   propagation when the trace itself carries none
     """
 
     def __init__(self, closed_jaxpr, name="", mesh=None, donated=None,
-                 hlo_text=None, large_threshold=1 << 20):
+                 hlo_text=None, large_threshold=1 << 20, in_specs=None):
         self.closed_jaxpr = closed_jaxpr
         self.jaxpr = closed_jaxpr.jaxpr
         self.consts = list(closed_jaxpr.consts)
@@ -119,6 +122,7 @@ class AnalysisContext:
         self.donated = donated if donated is None else frozenset(donated)
         self.hlo_text = hlo_text
         self.large_threshold = int(large_threshold)
+        self.in_specs = None if in_specs is None else tuple(in_specs)
 
 
 _PASSES = {}        # name -> (fn, default_severity)
@@ -174,7 +178,7 @@ def _as_closed_jaxpr(fn_or_jaxpr, args, kwargs):
 
 def run_passes(fn_or_jaxpr, *args, passes=None, name=None, mesh=None,
                donated=None, hlo_text=None, large_threshold=1 << 20,
-               **kwargs):
+               in_specs=None, **kwargs):
     """Run (a subset of) the registered passes; returns an AnalysisReport.
 
     fn_or_jaxpr: a jax ClosedJaxpr/Jaxpr, or a callable traced with *args
@@ -184,7 +188,8 @@ def run_passes(fn_or_jaxpr, *args, passes=None, name=None, mesh=None,
     closed = _as_closed_jaxpr(fn_or_jaxpr, args, kwargs)
     label = name or getattr(fn_or_jaxpr, "__name__", "") or "jaxpr"
     ctx = AnalysisContext(closed, name=label, mesh=mesh, donated=donated,
-                          hlo_text=hlo_text, large_threshold=large_threshold)
+                          hlo_text=hlo_text, large_threshold=large_threshold,
+                          in_specs=in_specs)
     selected = list(_PASS_ORDER) if passes is None else list(passes)
     unknown = [p for p in selected if p not in _PASSES]
     if unknown:

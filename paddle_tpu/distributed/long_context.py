@@ -87,12 +87,12 @@ def ring_attention_spmd(q, k, v, axis_name="sp", causal=False):
 # ---------------------------------------------------------------------------
 
 def _vary(x, axis_name):
-    """Mark a carry init as device-varying over the ring axis (shard_map
-    vma typing)."""
-    try:
-        return jax.lax.pcast(x, (axis_name,), to="varying")
-    except (AttributeError, TypeError):
-        return jax.lax.pvary(x, (axis_name,))
+    """Mark a carry init as device-varying over the ring axis — the ONE
+    shared helper (spmd._pvary: pcast -> pvary -> identity where neither
+    exists; such jax builds predate vma typing)."""
+    from .spmd import _pvary
+
+    return _pvary(x, axis_name)
 
 
 def _fold_heads(x):

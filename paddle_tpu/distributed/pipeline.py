@@ -23,6 +23,31 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.tape import global_tape
 from ..core.tensor import Tensor
 
+#: The stage-boundary transfer edge (ISSUE 13; docs/ANALYSIS.md
+#: "Declaring a transfer edge"): what one pipeline rank's ppermute hands
+#: the next rank every tick. The static auditor
+#: (analysis/handoff_schema.py) AST-extracts this literal and pins its
+#: fingerprint in tests/handoff_baseline.json; PipelineTrainer validates
+#: its stage activation against the same declaration at build time
+#: (``mb`` binds to the micro-batch rows, ``...`` covers the stage's
+#: feature dims, ``$act`` the activation dtype). ROADMAP 3's MPMD
+#: stage-program abstraction types its transfer edges with exactly this
+#: payload form.
+HANDOFF_SCHEMA = {
+    "edge": "pipeline_stage",
+    "producer": ("paddle_tpu/distributed/pipeline.py::"
+                 "PipelineTrainer._pipelined"),
+    "consumer": ("paddle_tpu/distributed/pipeline.py::"
+                 "PipelineTrainer.train_step"),
+    "runtime_checked": True,
+    "doc": "one micro-batch of stage activations, carried rank->rank by "
+           "the ppermute ring each schedule tick",
+    "payload": {
+        "activation": {"shape": ("mb", "..."), "dtype": "$act",
+                       "layout": "[micro_batch, *stage_features]"},
+    },
+}
+
 
 def _smap(f, mesh, in_specs, out_specs):
     try:
@@ -95,15 +120,10 @@ class Pipeline:
             n_ticks = n_micro + n_stage - 1
             y_shape = x_all.shape[1:]
 
-            def _vary(arr):
-                # mark carry init as device-varying over 'pp' (shard_map vma typing)
-                try:
-                    return jax.lax.pcast(arr, (ax,), to="varying")
-                except (AttributeError, TypeError):
-                    return jax.lax.pvary(arr, (ax,))
-
-            buf = _vary(jnp.zeros_like(x_all[0]))  # activation held by this rank
-            outs = _vary(jnp.zeros((n_micro,) + y_shape, x_all.dtype))
+            # mark carry inits as device-varying over 'pp' (the module-
+            # level _vary: shard_map vma typing, identity fallback)
+            buf = _vary(jnp.zeros_like(x_all[0]), ax)  # rank-held activation
+            outs = _vary(jnp.zeros((n_micro,) + y_shape, x_all.dtype), ax)
             perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
 
             def tick(t, carry):
@@ -153,10 +173,12 @@ class Pipeline:
 # ---------------------------------------------------------------------------
 
 def _vary(arr, ax):
-    try:
-        return jax.lax.pcast(arr, (ax,), to="varying")
-    except (AttributeError, TypeError):
-        return jax.lax.pvary(arr, (ax,))
+    """Device-varying carry mark — the ONE shared helper (spmd._pvary:
+    pcast -> pvary -> identity where neither exists; such jax builds
+    predate vma typing and the identity is exact there)."""
+    from .spmd import _pvary
+
+    return _pvary(arr, ax)
 
 
 class PipelineTrainer:
@@ -231,6 +253,7 @@ class PipelineTrainer:
         self.schedule_mode = schedule_mode
         self.donate = donate
         self._compiled = None
+        self._edge_checked = False
 
         # stage params must be uniformly trainable across stages (they are one
         # stacked array) — a per-stage freeze cannot be expressed, so reject it
@@ -395,6 +418,8 @@ class PipelineTrainer:
         mb = x.shape[0] // self.n_micro
         x_micro = x.reshape((self.n_micro, mb) + x.shape[1:])
         y_micro = y.reshape((self.n_micro, mb) + y.shape[1:])
+        if not self._edge_checked:
+            self._validate_stage_edge(x_micro)
         if self._compiled is None:
             self._compiled = self._build()
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
@@ -402,6 +427,33 @@ class PipelineTrainer:
             self.params, self.opt_state, self.frozen, lr, x_micro, y_micro)
         self.optimizer._step_count += 1
         return Tensor(loss)
+
+    def _validate_stage_edge(self, x_micro):
+        """Typed transfer edge (ISSUE 13): shape-infer one micro-batch
+        through `pre` (eval_shape — nothing executes) and validate the
+        activation the ppermute ring will carry against HANDOFF_SCHEMA —
+        the same declaration the static auditor extracts and baselines.
+        Runs once per trainer; raises HandoffMismatch naming the leaf."""
+        from ..analysis import handoff_schema as _hs
+
+        pre_params = {k.split("::", 1)[1]: v
+                      for k, v in {**self.frozen, **self.params}.items()
+                      if k.startswith("pre::")}
+        act = jax.eval_shape(
+            lambda p, xi: _pure_call(self.pre, p, xi), pre_params,
+            jax.ShapeDtypeStruct(tuple(x_micro.shape[1:]), x_micro.dtype))
+        # "$act" binds to the STAGES' compute dtype (their first floating
+        # param), not to the payload's own dtype — the check must be able
+        # to fail when `pre` hands the ring an activation the stacked
+        # stage programs do not compute in
+        stage_dt = next(
+            (str(v.dtype) for k, v in {**self.params, **self.frozen}.items()
+             if k.startswith("stage::")
+             and jnp.issubdtype(v.dtype, jnp.floating)), str(act.dtype))
+        _hs.validate(HANDOFF_SCHEMA, {"activation": act},
+                     dims={"mb": int(x_micro.shape[1])},
+                     dtypes={"act": stage_dt})
+        self._edge_checked = True
 
     def sync_to_layer(self):
         """Write trained params back into pre/stages/post Layer tensors.

@@ -34,6 +34,33 @@ from ..profiler import RecordEvent as _RecordEvent
 from ..testing import failpoints as _failpoints
 from .mesh import get_mesh
 
+#: The checkpoint transfer edge (ISSUE 13; docs/ANALYSIS.md "Declaring a
+#: transfer edge"): the host-side train-state tree gather_train_state
+#: writes and restore_train_state re-places onto live shardings.
+#: Statically extracted and baseline-pinned by
+#: analysis/handoff_schema.py — ROADMAP 5's topology-aware resharding
+#: grows this edge into a logical [param, shard-spec] tree, and the
+#: baseline is where that (intentional) drift gets acknowledged.
+CHECKPOINT_SCHEMA = {
+    "edge": "checkpoint_state",
+    "producer": "paddle_tpu/distributed/spmd.py::gather_train_state",
+    "consumer": "paddle_tpu/distributed/spmd.py::restore_train_state",
+    "runtime_checked": False,
+    "doc": "host snapshot of the sharded train state; __qar_residual__ "
+           "(quantized-allreduce error feedback) and [dp, shard] "
+           "optimizer moments ride opt_state",
+    "payload": {
+        "params": {"kind": "opaque",
+                   "layout": "{param_name: host array}"},
+        "opt_state": {"kind": "opaque",
+                      "layout": "{param_name: {moment: host array}} + "
+                                "__step__"},
+        "optimizer_step_count": {"kind": "scalar", "dtype": "int"},
+        "lr_scheduler": {"kind": "opaque",
+                         "layout": "scheduler state_dict or None"},
+    },
+}
+
 # compile_total/compile_cache_total are declared (and recorded) by
 # framework/aot.py's record_compile — one mapping for every site; this
 # module reports under site="trainer" so one snapshot schema covers both
@@ -905,8 +932,12 @@ class SpmdTrainer:
                 _numerics.stat_shardings(repl),)   # the stats leg
         if guard:
             out_shardings = out_shardings + (repl,)   # the finite flag
+        # buffers (argnum 2) donate like params/opt_state: the trainer
+        # owns them (owned_device_put) and rebinds them from the step
+        # output every call — not donating doubled their HBM footprint
+        # (the donation-miss finding ISSUE 13's sharding targets surfaced)
         return jax.jit(step, in_shardings=in_shardings, out_shardings=out_shardings,
-                       donate_argnums=(0, 1))
+                       donate_argnums=(0, 1, 2))
 
     def _shard_map(self, f, in_specs, out_specs, check_rep=True):
         """check_rep=False is for bodies whose replicated outputs flow
@@ -992,7 +1023,8 @@ class SpmdTrainer:
                         self.b_shardings, repl, repl) + tuple(batch_shard for _ in batch_arrays)
         out_shardings = (repl, self.p_shardings, dict(self.s_shardings), self.b_shardings)
         return jax.jit(step, in_shardings=in_shardings,
-                       out_shardings=out_shardings, donate_argnums=(0, 1))
+                       out_shardings=out_shardings,
+                       donate_argnums=(0, 1, 2))  # buffers too (ISSUE 13)
 
     def _build_dgc(self, batch_arrays):
         """DGC (dgc_momentum_op.cc parity) with a REAL cross-rank sparse
@@ -1061,7 +1093,8 @@ class SpmdTrainer:
                         self.b_shardings, repl, repl) + tuple(batch_shard for _ in batch_arrays)
         out_shardings = (repl, self.p_shardings, dict(self.s_shardings), self.b_shardings)
         return jax.jit(step, in_shardings=in_shardings,
-                       out_shardings=out_shardings, donate_argnums=(0, 1))
+                       out_shardings=out_shardings,
+                       donate_argnums=(0, 1, 2))  # buffers too (ISSUE 13)
 
     def _build_dp_compressed(self, batch_arrays):
         """Plain-dp train step with an EXPLICIT gradient exchange
@@ -1404,7 +1437,7 @@ class SpmdTrainer:
             out_shardings.append(repl)
         return jax.jit(step, in_shardings=in_shardings,
                        out_shardings=tuple(out_shardings),
-                       donate_argnums=(0, 1))
+                       donate_argnums=(0, 1, 2))   # buffers too (ISSUE 13)
 
     # -- compile (lazy or warm-start) ------------------------------------------
     @staticmethod
@@ -1784,13 +1817,21 @@ class SpmdTrainer:
         }
 
     def sync_to_layer(self):
-        """Write the (possibly sharded) params back into the Layer's tensors."""
+        """Write the (possibly sharded) params back into the Layer's tensors.
+
+        Copies (never aliases) the trainer's arrays — the pipeline
+        trainer's documented rule: the jitted step donates params, state
+        AND buffers, so handing the live buffers to the Layer would let
+        the next train_step invalidate the Layer's eager tensors on a
+        donation-honoring backend. device_get lands an independent HOST
+        copy (the pre-existing stage>=3 numpy-in-_data contract) — no
+        re-upload, no second device-resident model."""
         named = dict(self.layer.named_parameters())
         for n, v in self.params.items():
-            named[n]._data = jax.device_get(v) if self.sharding_stage >= 3 else v
+            named[n]._data = jax.device_get(v)
         named_b = dict(self.layer.named_buffers())
         for n, v in self.buffers.items():
-            named_b[n]._data = v
+            named_b[n]._data = jax.device_get(v)
 
     # -- checkpoint / resume ---------------------------------------------------
     def state_dict(self):

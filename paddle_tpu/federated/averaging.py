@@ -39,7 +39,31 @@ from ..monitor import blackbox_lazy as _blackbox  # import-free recorder facade 
 from ..testing import failpoints as _fp
 from .primitives import federated_weighted_mean
 
-__all__ = ["FederatedAverager"]
+__all__ = ["FederatedAverager", "HANDOFF_SCHEMA"]
+
+#: The client->server adapter-payload transfer edge (ISSUE 13; docs/
+#: ANALYSIS.md "Declaring a transfer edge"). Statically extracted and
+#: baseline-pinned by analysis/handoff_schema.py: the LoRA multi-task
+#: byte math (rounds * C * (adapter_params * 4 + 4), asserted exactly in
+#: tests/test_federated.py) depends on this payload staying a flat f32
+#: delta vector + one example count — drift fails lint.
+HANDOFF_SCHEMA = {
+    "edge": "federated_adapter",
+    "producer": ("paddle_tpu/federated/averaging.py::"
+                 "FederatedAverager._client_update"),
+    "consumer": ("paddle_tpu/federated/averaging.py::"
+                 "FederatedAverager.run_round"),
+    "runtime_checked": False,
+    "doc": "one client's round contribution: the flattened trainable "
+           "deltas (adapter-only under LoRA) weighted by its example "
+           "count through ONE federated_weighted_mean",
+    "payload": {
+        "delta": {"shape": ("n_trainable",), "dtype": "float32",
+                  "layout": "flat concat of trainable params in "
+                            "snapshot order"},
+        "n_examples": {"kind": "scalar", "dtype": "int"},
+    },
+}
 
 _M = None   # lazy federated metric family handles
 

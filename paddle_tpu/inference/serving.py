@@ -1160,6 +1160,27 @@ class ServingEngine:
         if len(ids) + 1 > self.T:
             raise ValueError(
                 f"prompt ({len(ids)}) too long for max_seq_len {self.T}")
+        # typed transfer edge (ISSUE 13, docs/ANALYSIS.md): the row must
+        # match the disagg_kv HANDOFF_SCHEMA — the SAME literal the
+        # static auditor extracts and baselines — with symbolic dims
+        # bound to THIS engine's cache. A drifted/misshaped row raises
+        # here, naming the offending leaf, instead of corrupting a slot.
+        kc1, vc1 = kv_row
+        from ..analysis import handoff_schema as _hs
+        from ..serving.disagg import HANDOFF_SCHEMA
+
+        side = self._kc[0] if isinstance(self._kc, tuple) else self._kc
+        dims = {}
+        if getattr(side, "ndim", 0) == 5:
+            L, _, KVh, T, hd = side.shape
+            dims = {"L": int(L), "KVh": int(KVh), "T": int(T),
+                    "hd": int(hd)}
+        vocab = getattr(self.cfg, "vocab_size", None)
+        if vocab:
+            dims["V"] = int(vocab)
+        _hs.validate(HANDOFF_SCHEMA,
+                     {"kc": kc1, "vc": vc1, "logits": logits},
+                     dims=dims, dtypes={"cache": str(side.dtype)})
         # the bound check runs AFTER validation (matching submit()): an
         # unservable request must fail permanently (ValueError), never
         # masquerade as retryable backpressure
@@ -1170,7 +1191,6 @@ class ServingEngine:
                 f"admission queue full ({len(self._queue)} queued + "
                 f"{len(self._handoff)} handoff / {self._max_queue}); "
                 "handoff rejected — back off or target another engine")
-        kc1, vc1 = kv_row
         req = self._new_request(ids, max_new_tokens, temperature, top_k,
                                 top_p, seed, None, 0, deadline_ms,
                                 int(priority), trace_id=trace_id,

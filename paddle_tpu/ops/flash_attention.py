@@ -61,6 +61,74 @@ def _on_tpu():
         return False
 
 
+# ---------------------------------------------------------------------------
+# static audit manifest (analysis/pallas_audit.py, ISSUE 13)
+# ---------------------------------------------------------------------------
+
+#: representative supported configs: the s=1024 entry floor and the 16k
+#: long-context windowed config, at the gpt2s head_dim
+_AUDIT_CONFIGS = ((1024, 64), (16384, 64))
+
+
+def audit_manifest():
+    """Audit entries for the fwd/dq/dkv kernels — block sizes through
+    the SAME _block_for the runtime uses (pure arithmetic)."""
+    entries = []
+    for dtype in ("float32", "bfloat16"):
+        for s, d in _AUDIT_CONFIGS:
+            blk = _block_for(s)
+            row = [{"name": "q", "block": (blk, d), "dtype": dtype},
+                   {"name": "k", "block": (blk, d), "dtype": dtype},
+                   {"name": "v", "block": (blk, d), "dtype": dtype}]
+            entries.append({
+                "kernel": f"flash.fwd[s={s},d={d},{dtype}]",
+                "op": "flash_fwd", "in_dtype": dtype,
+                "acc_dtype": "float32", "matmul": True,
+                "grid": {"seq_q": (s, blk), "seq_k": (s, blk)},
+                "buffers": row + [
+                    {"name": "o", "block": (blk, d), "dtype": dtype},
+                    {"name": "lse", "block": (1, blk),
+                     "dtype": "float32"},
+                    {"name": "acc(scratch)", "block": (blk, d),
+                     "dtype": "float32", "stream": False},
+                    {"name": "m(scratch)", "block": (blk, 128),
+                     "dtype": "float32", "stream": False},
+                    {"name": "l(scratch)", "block": (blk, 128),
+                     "dtype": "float32", "stream": False}]})
+            entries.append({
+                "kernel": f"flash.dq[s={s},d={d},{dtype}]",
+                "op": "flash_dq", "in_dtype": dtype,
+                "acc_dtype": "float32", "matmul": True,
+                "grid": {"seq_q": (s, blk), "seq_k": (s, blk)},
+                "buffers": row + [
+                    {"name": "do", "block": (blk, d), "dtype": dtype},
+                    {"name": "lse", "block": (1, blk),
+                     "dtype": "float32"},
+                    {"name": "delta", "block": (1, blk),
+                     "dtype": "float32"},
+                    {"name": "dq", "block": (blk, d), "dtype": dtype},
+                    {"name": "dq_acc(scratch)", "block": (blk, d),
+                     "dtype": "float32", "stream": False}]})
+            entries.append({
+                "kernel": f"flash.dkv[s={s},d={d},{dtype}]",
+                "op": "flash_dkv", "in_dtype": dtype,
+                "acc_dtype": "float32", "matmul": True,
+                "grid": {"seq_q": (s, blk), "seq_k": (s, blk)},
+                "buffers": row + [
+                    {"name": "do", "block": (blk, d), "dtype": dtype},
+                    {"name": "lse", "block": (1, blk),
+                     "dtype": "float32"},
+                    {"name": "delta", "block": (1, blk),
+                     "dtype": "float32"},
+                    {"name": "dk", "block": (blk, d), "dtype": dtype},
+                    {"name": "dv", "block": (blk, d), "dtype": dtype},
+                    {"name": "dk_acc(scratch)", "block": (blk, d),
+                     "dtype": "float32", "stream": False},
+                    {"name": "dv_acc(scratch)", "block": (blk, d),
+                     "dtype": "float32", "stream": False}]})
+    return entries
+
+
 def supported(q_shape, dtype_str):
     """q_shape: (batch, seq, heads, head_dim)."""
     if len(q_shape) != 4:

@@ -81,6 +81,28 @@ def nms_keep_mask_pallas(boxes, iou_threshold, interpret=False):
     return keep[0, :n] > 0
 
 
+# ---------------------------------------------------------------------------
+# static audit manifest (analysis/pallas_audit.py, ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def audit_manifest():
+    """One entry at the supported() cap: the whole problem lives in VMEM
+    (no grid streaming), so the audit checks the worst-case residency."""
+    n_pad = 8192   # supported() upper bound, already lane-aligned
+    return [{
+        "kernel": f"nms.sweep[n={n_pad}]", "op": "nms",
+        "in_dtype": "float32", "matmul": False,
+        "grid": {"n": (n_pad, LANE)},
+        "buffers": [
+            {"name": "boxes", "block": (4, n_pad), "dtype": "float32",
+             "stream": False},
+            {"name": "thresh", "block": (1, 1), "dtype": "float32",
+             "stream": False},
+            {"name": "keep", "block": (1, n_pad), "dtype": "int32",
+             "stream": False}]}]
+
+
 _DISABLED = [False]  # session-wide negative cache after a lowering failure
 
 

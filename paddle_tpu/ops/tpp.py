@@ -49,7 +49,7 @@ from ..trace import costs as _costs
 
 __all__ = ["matmul", "bias_act", "softmax_rows", "masked_reduce",
            "ln_matmul", "fused_mlp", "gpt_block_mlp", "registry_table",
-           "pick_block", "supported_2d"]
+           "pick_block", "supported_2d", "audit_manifest"]
 
 _LN_EPS = 1e-5   # nn.LayerNorm's default epsilon (the only one GPT uses)
 
@@ -130,6 +130,82 @@ def _note_call(entry, op, flops, nbytes):
     if _monitor.is_enabled():
         _calls().labels(op=op).inc()
     _costs.record_manual("tpp", op, flops=flops, bytes_accessed=nbytes)
+
+
+# ---------------------------------------------------------------------------
+# static audit manifest (analysis/pallas_audit.py, ISSUE 13)
+# ---------------------------------------------------------------------------
+
+#: representative production shapes: the gpt2s hot path (hidden 768,
+#: intermediate 3072, m = rows per kernel call). The manifest derives
+#: blocks through the SAME pick_block/supported_2d the runtime uses, so
+#: a block-table change flows straight into the lint-time budget check.
+_AUDIT_SHAPES = ((512, 768, 3072), (512, 3072, 768))
+_AUDIT_DTYPES = ("float32", "bfloat16")
+
+
+def _matmul_entry(kernel, m, k, n, dtype, block, ln_prologue=False,
+                  has_bias=True):
+    bm, bn, bk = block
+    bufs = [{"name": "x", "block": (bm, bk), "dtype": dtype}]
+    if ln_prologue:
+        bufs += [{"name": "gamma", "block": (1, bk), "dtype": dtype},
+                 {"name": "beta", "block": (1, bk), "dtype": dtype}]
+    bufs.append({"name": "w", "block": (bk, bn), "dtype": dtype})
+    if has_bias:
+        bufs.append({"name": "bias", "block": (1, bn), "dtype": dtype})
+    bufs += [{"name": "out", "block": (bm, bn), "dtype": dtype},
+             {"name": "acc(scratch)", "block": (bm, bn),
+              "dtype": "float32", "stream": False}]
+    return {"kernel": kernel, "op": kernel.split("[")[0],
+            "in_dtype": dtype, "acc_dtype": "float32", "matmul": True,
+            "grid": {"m": (m, bm), "n": (n, bn), "k": (k, bk)},
+            "buffers": bufs}
+
+
+def audit_manifest():
+    """Declarative audit entries for every TPP kernel shape class —
+    pure arithmetic mirroring the builders (nothing compiles)."""
+    entries = []
+    for dtype in _AUDIT_DTYPES:
+        for m, k, n in _AUDIT_SHAPES:
+            block = supported_2d(m, k, n, dtype)
+            if block is None:
+                continue
+            entries.append(_matmul_entry(
+                f"tpp.matmul[{m}x{k}x{n},{dtype}]", m, k, n, dtype,
+                block))
+        m, k, n = _AUDIT_SHAPES[0]
+        bm, bn = pick_block(m), pick_block(n)
+        # ln_matmul pins bk == k (LN row stats need the whole row)
+        entries.append(_matmul_entry(
+            f"tpp.ln_matmul[{m}x{k}x{n},{dtype}]", m, k, n, dtype,
+            (bm, bn, k), ln_prologue=True))
+        bm, bn = pick_block(m), pick_block(k)
+        entries.append({
+            "kernel": f"tpp.bias_act[{m}x{k},{dtype}]", "op": "bias_act",
+            "in_dtype": dtype, "matmul": False,
+            "grid": {"m": (m, bm), "n": (k, bn)},
+            "buffers": [
+                {"name": "x", "block": (bm, bn), "dtype": dtype},
+                {"name": "bias", "block": (1, bn), "dtype": dtype},
+                {"name": "out", "block": (bm, bn), "dtype": dtype}]})
+        entries.append({
+            "kernel": f"tpp.softmax_rows[{m}x{k},{dtype}]",
+            "op": "softmax_rows", "in_dtype": dtype, "matmul": False,
+            "grid": {"m": (m, bm)},
+            "buffers": [
+                {"name": "x", "block": (bm, k), "dtype": dtype},
+                {"name": "out", "block": (bm, k), "dtype": dtype}]})
+        entries.append({
+            "kernel": f"tpp.masked_reduce[{m}x{k},{dtype}]",
+            "op": "masked_reduce", "in_dtype": dtype, "matmul": False,
+            "grid": {"m": (m, bm)},
+            "buffers": [
+                {"name": "x", "block": (bm, k), "dtype": dtype},
+                {"name": "mask", "block": (bm, k), "dtype": "int32"},
+                {"name": "out", "block": (bm, 1), "dtype": dtype}]})
+    return entries
 
 
 # ---------------------------------------------------------------------------

@@ -34,7 +34,36 @@ from ..core.tensor import Tensor
 from ..framework import aot as _aot
 from . import decode_model as _dm_registry
 
-__all__ = ["PrefillWorker", "DisaggregatedPool"]
+__all__ = ["PrefillWorker", "DisaggregatedPool", "HANDOFF_SCHEMA"]
+
+#: The prefill->decode KV transfer edge, declared (ISSUE 13; docs/
+#: ANALYSIS.md "Declaring a transfer edge"). This literal is the ONE
+#: source of truth for the handoff payload: the static auditor
+#: (analysis/handoff_schema.py) AST-extracts it and pins its fingerprint
+#: in tests/handoff_baseline.json, and ``ServingEngine.admit_prefilled``
+#: validates every incoming row against it at runtime — a silent
+#: KV-layout drift fails lint AND raises at the door, never corrupts a
+#: decode. Symbolic dims bind to the consuming engine's config (L =
+#: num_layers, KVh = compact kv heads, T = max_seq_len, hd = head_dim,
+#: V = vocab); ``$cache`` binds to the engine's cache dtype;
+#: ``quantizable`` sides accept the int8/fp8 (values, scales) pair.
+HANDOFF_SCHEMA = {
+    "edge": "disagg_kv",
+    "producer": "paddle_tpu/serving/disagg.py::PrefillWorker.prefill",
+    "consumer": ("paddle_tpu/inference/serving.py::"
+                 "ServingEngine.admit_prefilled"),
+    "runtime_checked": True,
+    "doc": "one prefilled single-row KV cache pair + the prompt's "
+           "last-position vocab logits, in the DecodeModel adapter's "
+           "documented cache-pytree layout",
+    "payload": {
+        "kc": {"shape": ("L", 1, "KVh", "T", "hd"), "dtype": "$cache",
+               "layout": "[L, B, KVh, T, hd]", "quantizable": True},
+        "vc": {"shape": ("L", 1, "KVh", "T", "hd"), "dtype": "$cache",
+               "layout": "[L, B, KVh, T, hd]", "quantizable": True},
+        "logits": {"shape": ("V",), "dtype": "float32"},
+    },
+}
 
 _KV_BYTES = _monitor.counter(
     "kv_handoff_bytes_total",

@@ -152,6 +152,40 @@ class TestPrngKeyReuse:
         rep = run_passes(f, jax.random.key(0), passes=["prng-key-reuse"])
         assert rep.findings == []
 
+    def test_negative_fold_in_distinct_data(self):
+        # the documented-safe compress.py idiom: per-rank/per-phase
+        # fold_ins of ONE key with DISTINCT data (ISSUE 13 fix — this
+        # false-positived the first time the quantized program was
+        # analyzed)
+        def f(k):
+            a = jax.random.uniform(jax.random.fold_in(k, 1), (2,))
+            b = jax.random.uniform(jax.random.fold_in(k, 2), (2,))
+            return a + b
+
+        rep = run_passes(f, jax.random.key(0), passes=["prng-key-reuse"])
+        assert rep.findings == []
+
+    def test_positive_fold_in_same_data_twice(self):
+        def f(k):
+            a = jax.random.uniform(jax.random.fold_in(k, 7), (2,))
+            b = jax.random.normal(jax.random.fold_in(k, 7), (2,))
+            return a + b
+
+        rep = run_passes(f, jax.random.key(0), passes=["prng-key-reuse"])
+        assert len(rep.errors) == 1
+
+    def test_positive_sink_mixed_with_fold(self):
+        # a raw sink consumption of a key that is ALSO folded stays a
+        # finding (the review-caught false-negative window)
+        def f(k):
+            a = jax.random.uniform(k, (2,))
+            b = jax.random.uniform(jax.random.fold_in(k, 3), (2,))
+            return a + b
+
+        rep = run_passes(f, jax.random.key(0), passes=["prng-key-reuse"])
+        assert len(rep.errors) == 1
+        assert "random_fold_in" in rep.errors[0].message
+
     def test_negative_distinct_slices_of_split(self):
         # the canonical dropout chain: keys[0] / keys[1] are different
         # slices of one split — aliases must not be conflated
@@ -355,27 +389,84 @@ class TestQuantizedCollectiveClassifier:
         assert msgs and not any("quantized" in m for m in msgs)
 
 
-class TestUnshardedLargeTensor:
-    def _mesh(self):
-        return jax.sharding.Mesh(np.array(jax.devices()[:2]), ("dp",))
+class TestImplicitReplication:
+    """The ISSUE 13 upgrade of unsharded-large-tensor: spec propagation
+    with provenance — only replication MATERIALIZED in-graph fires."""
 
-    def test_positive(self):
-        def f(x, y):
-            return (x @ y) * 2.0
+    def _mesh(self, n=2):
+        return jax.sharding.Mesh(np.array(jax.devices()[:n]), ("dp",))
 
-        rep = run_passes(f, jnp.ones((32, 32)), jnp.ones((32, 32)),
-                         passes=["unsharded-large-tensor"],
-                         mesh=self._mesh(), large_threshold=512)
+    def test_positive_materialized_with_provenance(self):
+        mesh = self._mesh()
+
+        def f(x):
+            big = jnp.broadcast_to(jnp.arange(64, dtype=jnp.float32),
+                                   (64, 64))
+            return x + big.sum()
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        cj = jax.make_jaxpr(jax.jit(
+            f, in_shardings=NamedSharding(mesh, P("dp"))))(jnp.ones((8,)))
+        rep = run_passes(cj, passes=["implicit-replication"], mesh=mesh,
+                         large_threshold=1024)
         assert len(rep.warnings) == 1
-        assert "no sharding constraint" in rep.warnings[0].message
+        msg = rep.warnings[0].message
+        assert "materialized replicated" in msg
+        assert "provenance:" in msg and "broadcast_in_dim" in msg
+
+    def test_negative_derived_from_sharded_input(self):
+        mesh = self._mesh()
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def f(x):
+            return (x @ x.T).sum()
+
+        cj = jax.make_jaxpr(jax.jit(
+            f, in_shardings=NamedSharding(mesh, P("dp"))))(
+                jnp.ones((64, 64)))
+        rep = run_passes(cj, passes=["implicit-replication"], mesh=mesh,
+                         large_threshold=1024)
+        assert rep.findings == []
+
+    def test_negative_declared_replicated_input_is_intentional(self):
+        mesh = self._mesh()
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def f(w):
+            return w * 0.01   # dp-replicated weight-decay-style math
+
+        cj = jax.make_jaxpr(jax.jit(
+            f, in_shardings=NamedSharding(mesh, P())))(jnp.ones((64, 64)))
+        rep = run_passes(cj, passes=["implicit-replication"], mesh=mesh,
+                         large_threshold=1024)
+        assert rep.findings == []
 
     def test_negative_no_mesh(self):
-        def f(x, y):
-            return (x @ y) * 2.0
+        def f(x):
+            return jnp.broadcast_to(jnp.arange(64, dtype=jnp.float32),
+                                    (64, 64)).sum() + x
 
-        rep = run_passes(f, jnp.ones((32, 32)), jnp.ones((32, 32)),
-                         passes=["unsharded-large-tensor"],
-                         large_threshold=512)
+        rep = run_passes(f, jnp.ones(()),
+                         passes=["implicit-replication"],
+                         large_threshold=1024)
+        assert rep.findings == []
+
+    def test_negative_constrained_value_not_flagged(self):
+        mesh = self._mesh()
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def f(x):
+            big = jnp.broadcast_to(jnp.arange(64, dtype=jnp.float32),
+                                   (64, 64))
+            big = jax.lax.with_sharding_constraint(
+                big, NamedSharding(mesh, P("dp")))
+            return x + big.sum()
+
+        cj = jax.make_jaxpr(jax.jit(
+            f, in_shardings=NamedSharding(mesh, P("dp"))))(jnp.ones((8,)))
+        rep = run_passes(cj, passes=["implicit-replication"], mesh=mesh,
+                         large_threshold=1024)
         assert rep.findings == []
 
 
@@ -1283,3 +1374,448 @@ class TestAllowlistConsolidation:
         from paddle_tpu.analysis import source_lint
 
         assert source_lint._RULE_ALIASES is allowlist.RULE_ALIASES
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13: sharding-flow passes (planted pos/neg per rule)
+# ---------------------------------------------------------------------------
+
+
+def _smap():
+    try:
+        from jax import shard_map as sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm
+
+
+def _mesh4(names=("dp",)):
+    import math
+
+    n = 4 if len(names) == 1 else 4
+    devs = np.array(jax.devices()[:n])
+    if len(names) > 1:
+        devs = devs.reshape((2, 2))
+    return jax.sharding.Mesh(devs, names)
+
+
+class TestCollectiveAxisMismatch:
+    def _traced_psum(self, axis="dp"):
+        from jax.sharding import PartitionSpec as P
+
+        mesh = _mesh4()
+
+        def g(x):
+            return jax.lax.psum(x, axis)
+
+        return jax.make_jaxpr(_smap()(g, mesh=mesh, in_specs=P("dp"),
+                                      out_specs=P(),
+                                      check_rep=False))(jnp.ones((8,)))
+
+    def test_positive_axis_absent_from_deployment_mesh(self):
+        other = jax.sharding.Mesh(np.array(jax.devices()[:4]), ("x",))
+        rep = run_passes(self._traced_psum(),
+                         passes=["collective-axis-mismatch"], mesh=other)
+        msgs = [f.message for f in rep.errors]
+        assert any("'dp' absent from the deployment mesh" in m
+                   for m in msgs), msgs
+        assert any("shard_map binds axis 'dp'" in m for m in msgs)
+
+    def test_positive_mesh_axis_size_mismatch(self):
+        bigger = jax.sharding.Mesh(
+            np.array(jax.devices()[:8]), ("dp",))
+        rep = run_passes(self._traced_psum(),
+                         passes=["collective-axis-mismatch"], mesh=bigger)
+        assert any("size" in f.message for f in rep.errors), \
+            [f.message for f in rep.errors]
+
+    def test_negative_matching_mesh(self):
+        rep = run_passes(self._traced_psum(),
+                         passes=["collective-axis-mismatch"],
+                         mesh=_mesh4())
+        assert rep.findings == []
+
+    def test_negative_no_deployment_mesh(self):
+        # self-consistent program, no mesh to check against
+        rep = run_passes(self._traced_psum(),
+                         passes=["collective-axis-mismatch"])
+        assert rep.findings == []
+
+
+class TestPpermuteMalformed:
+    def _traced(self, perm):
+        from jax.sharding import PartitionSpec as P
+
+        mesh = _mesh4()
+
+        def g(x):
+            return jax.lax.ppermute(x, "dp", perm)
+
+        return jax.make_jaxpr(_smap()(g, mesh=mesh, in_specs=P("dp"),
+                                      out_specs=P("dp"),
+                                      check_rep=False))(jnp.ones((8,)))
+
+    def test_positive_non_bijective(self):
+        rep = run_passes(self._traced([(0, 1), (1, 1)]),
+                         passes=["ppermute-malformed"], mesh=_mesh4())
+        assert any("not a bijection" in f.message for f in rep.errors), \
+            [f.message for f in rep.errors]
+
+    def test_positive_self_referential(self):
+        rep = run_passes(self._traced([(0, 0), (1, 2)]),
+                         passes=["ppermute-malformed"], mesh=_mesh4())
+        assert any("self-referential" in f.message for f in rep.errors)
+
+    def test_positive_out_of_range(self):
+        from paddle_tpu.analysis.sharding_flow import check_permutation
+
+        problems = check_permutation(((0, 7),), axis_size=4)
+        assert any("outside the axis size" in p for p in problems)
+
+    def test_negative_ring(self):
+        ring = [(i, (i + 1) % 4) for i in range(4)]
+        rep = run_passes(self._traced(ring),
+                         passes=["ppermute-malformed"], mesh=_mesh4())
+        assert rep.findings == []
+
+    def test_check_permutation_unit(self):
+        from paddle_tpu.analysis.sharding_flow import check_permutation
+
+        assert check_permutation([(0, 1), (1, 0)]) == []
+        assert check_permutation([(0, 1), (0, 2)])      # dup source
+        assert check_permutation([(1, 1)])              # self edge
+
+
+class TestBranchCollectiveMismatch:
+    def _traced(self, both_arms):
+        from jax.sharding import PartitionSpec as P
+
+        mesh = _mesh4()
+
+        def taken(v):
+            return jax.lax.psum(v, "dp")
+
+        def other(v):
+            return taken(v) if both_arms else v * 2.0
+
+        def g(x):
+            return jax.lax.cond(x[0] > 0, taken, other, x)
+
+        return jax.make_jaxpr(_smap()(g, mesh=mesh, in_specs=P("dp"),
+                                      out_specs=P("dp"),
+                                      check_rep=False))(jnp.ones((8,)))
+
+    def test_positive_one_arm_collective(self):
+        rep = run_passes(self._traced(both_arms=False),
+                         passes=["branch-collective-mismatch"],
+                         mesh=_mesh4())
+        assert len(rep.errors) == 1
+        assert "different collective sequences" in rep.errors[0].message
+        assert "arm[0]" in rep.errors[0].message
+
+    def test_negative_matched_arms(self):
+        rep = run_passes(self._traced(both_arms=True),
+                         passes=["branch-collective-mismatch"],
+                         mesh=_mesh4())
+        assert rep.findings == []
+
+    def test_while_predicate_collective_warns(self):
+        from jax.sharding import PartitionSpec as P
+
+        mesh = _mesh4()
+
+        def g(x):
+            def cond(c):
+                return jax.lax.psum(c.sum(), "dp") < 10.0
+
+            def body(c):
+                return c + 1.0
+
+            return jax.lax.while_loop(cond, body, x)
+
+        cj = jax.make_jaxpr(_smap()(g, mesh=mesh, in_specs=P("dp"),
+                                    out_specs=P("dp"),
+                                    check_rep=False))(jnp.ones((8,)))
+        rep = run_passes(cj, passes=["branch-collective-mismatch"],
+                         mesh=_mesh4())
+        assert len(rep.warnings) == 1
+        assert "while-loop predicate" in rep.warnings[0].message
+
+    def test_fori_loop_negative(self):
+        # counter-predicate loops (the pipeline schedule) stay silent
+        from jax.sharding import PartitionSpec as P
+
+        mesh = _mesh4()
+
+        def g(x):
+            return jax.lax.fori_loop(
+                0, 4, lambda i, c: jax.lax.ppermute(
+                    c, "dp", [(j, (j + 1) % 4) for j in range(4)]), x)
+
+        cj = jax.make_jaxpr(_smap()(g, mesh=mesh, in_specs=P("dp"),
+                                    out_specs=P("dp"),
+                                    check_rep=False))(jnp.ones((8,)))
+        rep = run_passes(cj, passes=["branch-collective-mismatch"],
+                         mesh=_mesh4())
+        assert rep.findings == []
+
+
+class TestReshardingChurn:
+    def test_positive_spec_flip(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = _mesh4()
+
+        def f(x):
+            y = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P("dp")))
+            return jax.lax.with_sharding_constraint(
+                y * 1.0, NamedSharding(mesh, P(None)))
+
+        cj = jax.make_jaxpr(jax.jit(f))(jnp.ones((64, 64)))
+        rep = run_passes(cj, passes=["resharding-churn"], mesh=mesh,
+                         large_threshold=1024)
+        assert len(rep.warnings) == 1
+        msg = rep.warnings[0].message
+        assert "re-constrained" in msg and "all-gather" in msg
+
+    def test_negative_same_spec_twice(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = _mesh4()
+
+        def f(x):
+            y = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P("dp")))
+            return jax.lax.with_sharding_constraint(
+                y * 1.0, NamedSharding(mesh, P("dp")))
+
+        cj = jax.make_jaxpr(jax.jit(f))(jnp.ones((64, 64)))
+        rep = run_passes(cj, passes=["resharding-churn"], mesh=mesh,
+                         large_threshold=1024)
+        assert rep.findings == []
+
+    def test_negative_small_tensor(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = _mesh4()
+
+        def f(x):
+            y = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P("dp")))
+            return jax.lax.with_sharding_constraint(
+                y * 1.0, NamedSharding(mesh, P(None)))
+
+        cj = jax.make_jaxpr(jax.jit(f))(jnp.ones((8, 8)))
+        rep = run_passes(cj, passes=["resharding-churn"], mesh=mesh,
+                         large_threshold=1024)
+        assert rep.findings == []
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13: handoff schemas (planted drift + validation matrix)
+# ---------------------------------------------------------------------------
+
+
+class TestHandoffSchema:
+    def _schema(self):
+        return {
+            "edge": "test_edge",
+            "producer": "paddle_tpu/serving/disagg.py::PrefillWorker.prefill",
+            "consumer": ("paddle_tpu/inference/serving.py::"
+                         "ServingEngine.admit_prefilled"),
+            "payload": {
+                "kc": {"shape": ("L", 1, "T"), "dtype": "$cache",
+                       "quantizable": True},
+                "logits": {"shape": ("V",), "dtype": "float32"},
+            },
+        }
+
+    def test_validate_good_payload_binds_dims(self):
+        from paddle_tpu.analysis import handoff_schema as hs
+
+        binds = hs.validate(self._schema(),
+                            {"kc": np.zeros((2, 1, 8), np.float32),
+                             "logits": np.zeros((16,), np.float32)})
+        assert binds == {"L": 2, "T": 8, "V": 16}
+
+    def test_validate_cross_leaf_consistency(self):
+        from paddle_tpu.analysis import handoff_schema as hs
+
+        sch = self._schema()
+        sch["payload"]["vc"] = {"shape": ("L", 1, "T"),
+                                "dtype": "float32"}
+        with pytest.raises(hs.HandoffMismatch, match="'L'"):
+            hs.validate(sch, {"kc": np.zeros((2, 1, 8), np.float32),
+                              "vc": np.zeros((3, 1, 8), np.float32),
+                              "logits": np.zeros((16,), np.float32)})
+
+    def test_validate_quantized_pair(self):
+        from paddle_tpu.analysis import handoff_schema as hs
+
+        vals = np.zeros((2, 1, 8), np.int8)
+        scales = np.zeros((2, 1, 1), np.float32)
+        hs.validate(self._schema(),
+                    {"kc": (vals, scales),
+                     "logits": np.zeros((16,), np.float32)},
+                    dtypes={"cache": "int8"})
+        # scales must be f32
+        with pytest.raises(hs.HandoffMismatch, match="scales"):
+            hs.validate(self._schema(),
+                        {"kc": (vals, scales.astype(np.float16)),
+                         "logits": np.zeros((16,), np.float32)})
+        # the VALUES dtype honors the declaration too: a producer built
+        # with a different cache codec must fail, not corrupt the cache
+        with pytest.raises(hs.HandoffMismatch, match=r"kc\.values"):
+            hs.validate(self._schema(),
+                        {"kc": (vals.astype(np.uint8), scales),
+                         "logits": np.zeros((16,), np.float32)},
+                        dtypes={"cache": "int8"})
+
+    def test_validate_missing_leaf_and_wrong_rank(self):
+        from paddle_tpu.analysis import handoff_schema as hs
+
+        with pytest.raises(hs.HandoffMismatch, match="missing leaf"):
+            hs.validate(self._schema(),
+                        {"kc": np.zeros((2, 1, 8), np.float32)})
+        with pytest.raises(hs.HandoffMismatch, match="rank"):
+            hs.validate(self._schema(),
+                        {"kc": np.zeros((2, 1), np.float32),
+                         "logits": np.zeros((16,), np.float32)})
+
+    def test_wildcard_trailing_dims(self):
+        from paddle_tpu.analysis import handoff_schema as hs
+
+        sch = {"edge": "e", "producer": "p", "consumer": "c",
+               "payload": {"act": {"shape": ("mb", "..."),
+                                   "dtype": "float32"}}}
+        hs.validate(sch, {"act": np.zeros((4, 7, 9), np.float32)},
+                    dims={"mb": 4})
+        with pytest.raises(hs.HandoffMismatch, match="'mb'"):
+            hs.validate(sch, {"act": np.zeros((5, 7, 9), np.float32)},
+                        dims={"mb": 4})
+
+    def test_planted_drift_detected(self):
+        from paddle_tpu.analysis import handoff_schema as hs
+
+        decl = self._schema()
+        base = {"edges": {"test_edge": hs.fingerprint(decl)}}
+        assert hs.check_baseline({"test_edge": decl}, base) == []
+
+        drifted = dict(decl, payload={
+            "kc": {"shape": ("L", 1, "T"), "dtype": "bfloat16",
+                   "quantizable": True},
+            "logits": {"shape": ("V",), "dtype": "float32"}})
+        fs = hs.check_baseline({"test_edge": drifted}, base)
+        assert len(fs) == 1 and fs[0].pass_name == "handoff-schema-drift"
+        assert "kc" in fs[0].message and "bfloat16" in fs[0].message
+
+    def test_unpinned_and_stale_edges(self):
+        from paddle_tpu.analysis import handoff_schema as hs
+
+        decl = self._schema()
+        fs = hs.check_baseline({"test_edge": decl}, {"edges": {}})
+        assert fs[0].pass_name == "handoff-schema-unpinned"
+        fs = hs.check_baseline({}, {"edges": {"gone": {}}})
+        assert fs[0].pass_name == "handoff-baseline-stale"
+
+    def test_extraction_rejects_non_literal(self, tmp_path):
+        from paddle_tpu.analysis import handoff_schema as hs
+
+        mod = tmp_path / "decl.py"
+        mod.write_text("X = 1\nHANDOFF_SCHEMA = make_schema()\n")
+        with pytest.raises(ValueError, match="pure literal"):
+            hs.extract_declaration("decl.py", "HANDOFF_SCHEMA",
+                                   pkg_root=str(tmp_path))
+        with pytest.raises(ValueError, match="no module-level literal"):
+            hs.extract_declaration("decl.py", "OTHER_SCHEMA",
+                                   pkg_root=str(tmp_path))
+
+    def test_site_check_catches_unwired_consumer(self, tmp_path):
+        from paddle_tpu.analysis import handoff_schema as hs
+
+        mod = tmp_path / "m.py"
+        mod.write_text("def produce():\n    pass\n")
+        fs = hs._site_check("e", "consumer", "m.py::produce",
+                            "HANDOFF_SCHEMA", True, str(tmp_path))
+        assert fs and "never references" in fs[0].message
+        fs = hs._site_check("e", "consumer", "m.py::missing_fn",
+                            "HANDOFF_SCHEMA", False, str(tmp_path))
+        assert fs and "not found" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13: pallas kernel budget audit (planted violations)
+# ---------------------------------------------------------------------------
+
+
+class TestPallasAudit:
+    def test_planted_vmem_over_budget_names_buffers(self):
+        from paddle_tpu.analysis import pallas_audit as pa
+
+        entry = {"kernel": "planted.big", "matmul": False,
+                 "grid": {"m": (4096, 2048)},
+                 "buffers": [
+                     {"name": "x", "block": (2048, 2048),
+                      "dtype": "float32"},
+                     {"name": "w", "block": (2048, 2048),
+                      "dtype": "float32"}]}
+        fs = [f for f in pa.audit_entry(entry)
+              if f.pass_name == "kernel-vmem-over-budget"]
+        assert len(fs) == 1
+        msg = fs[0].message
+        # per-buffer breakdown, double-buffering accounted
+        assert "w=32768KiB" in msg and "x=32768KiB" in msg
+        assert "double-buffered" in msg
+
+    def test_planted_int8_accumulator(self):
+        from paddle_tpu.analysis import pallas_audit as pa
+
+        entry = {"kernel": "planted.int8", "matmul": True,
+                 "in_dtype": "int8", "acc_dtype": "int8",
+                 "grid": {}, "buffers": []}
+        fs = pa.audit_entry(entry)
+        assert any(f.pass_name == "kernel-low-precision-accumulator"
+                   and "saturate" in f.message for f in fs)
+        # f32 accumulator passes
+        entry["acc_dtype"] = "float32"
+        assert pa.audit_entry(entry) == []
+
+    def test_planted_ragged_grid(self):
+        from paddle_tpu.analysis import pallas_audit as pa
+
+        entry = {"kernel": "planted.ragged", "matmul": False,
+                 "grid": {"m": (100, 32)}, "buffers": []}
+        fs = pa.audit_entry(entry)
+        assert any(f.pass_name == "kernel-grid-indivisible"
+                   and "ragged 4-wide tail" in f.message for f in fs)
+
+    def test_planted_sublane_misalignment_warns(self):
+        from paddle_tpu.analysis import pallas_audit as pa
+
+        entry = {"kernel": "planted.sub", "matmul": False, "grid": {},
+                 "buffers": [{"name": "x", "block": (12, 128),
+                              "dtype": "bfloat16"}]}
+        fs = pa.audit_entry(entry)
+        assert any(f.severity == "warning" and "min tile" in f.message
+                   for f in fs)
+
+    def test_double_buffer_accounting(self):
+        from paddle_tpu.analysis import pallas_audit as pa
+
+        streamed = {"name": "x", "block": (128, 128), "dtype": "float32"}
+        resident = dict(streamed, stream=False)
+        assert pa.buffer_bytes(streamed) == 2 * pa.buffer_bytes(resident)
+
+    def test_manifest_derives_from_live_block_tables(self):
+        # the audit shapes go through the SAME pick_block the runtime
+        # uses — a block-table change flows into the audit
+        from paddle_tpu.analysis import pallas_audit as pa
+        from paddle_tpu.ops import tpp
+
+        entries = [e for e in pa.collect_manifest()
+                   if e["kernel"].startswith("tpp.matmul")]
+        assert entries
+        for e in entries:
+            m, bm = e["grid"]["m"]
+            assert bm == tpp.pick_block(m)

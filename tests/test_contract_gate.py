@@ -38,7 +38,8 @@ sys.path.insert(0, REPO)
 
 BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "contract_baseline.json")
-TARGETS = ("flags", "imports", "observability", "threads")
+TARGETS = ("flags", "imports", "observability", "threads", "handoff",
+           "pallas")   # handoff/pallas joined in ISSUE 13
 
 
 def _load_tool():
@@ -116,11 +117,14 @@ def test_record_writes_counts_only(report, tmp_path):
 
 def test_rule_table_is_consolidated():
     from paddle_tpu.analysis import (contract_rules, flag_audit,
-                                     import_graph, obs_audit, source_lint)
+                                     handoff_schema, import_graph,
+                                     obs_audit, pallas_audit,
+                                     sharding_flow, source_lint)
     from paddle_tpu.analysis.allowlist import spellings
 
     merged = contract_rules()
-    for mod in (source_lint, flag_audit, import_graph, obs_audit):
+    for mod in (source_lint, flag_audit, import_graph, obs_audit,
+                sharding_flow, handoff_schema, pallas_audit):
         for rule, sev in mod.RULES.items():
             assert merged[rule] == sev
     # every rule resolves to at least its own spelling; the documented

@@ -1,11 +1,13 @@
-"""Contract auditor CLI: the flag/lazy-import/observability/thread
-invariants, machine-checked (ISSUE 12; docs/ANALYSIS.md "Contract
-auditor").
+"""Contract auditor CLI: the flag/lazy-import/observability/thread/
+handoff/kernel invariants, machine-checked (ISSUE 12 + 13;
+docs/ANALYSIS.md "Contract auditor").
 
-    python tools/contract_audit.py                    # all four passes
+    python tools/contract_audit.py                    # all six passes
     python tools/contract_audit.py --flags --imports  # a subset
+    python tools/contract_audit.py --handoff          # transfer edges only
+    python tools/contract_audit.py --pallas           # kernel budgets only
     python tools/contract_audit.py --json             # machine-readable
-    python tools/contract_audit.py --record           # regen the baseline
+    python tools/contract_audit.py --record           # regen BOTH baselines
     python tools/contract_audit.py --list-rules       # rules + markers
 
 Targets:
@@ -25,14 +27,24 @@ Targets:
                   target — deliberate overlap (each CLI is complete on
                   its own); exit codes key off "any error", so the
                   double view never flips a verdict
+  handoff       : analysis/handoff_schema.py — every declared transfer
+                  edge (disagg KV, pipeline stage, federated adapter,
+                  checkpoint tree) extracted from source, producer/
+                  consumer sites verified, fingerprints pinned against
+                  tests/handoff_baseline.json (drift = error)
+  pallas        : analysis/pallas_audit.py — every registered kernel's
+                  grid/block divisibility, MXU/VPU alignment, static
+                  VMEM budget, fp32-accumulator checks
 
 Report format: the tools/graph_lint.py schema ({"tool", "passes",
 "targets": {name: {"name","counts","findings"}}, "totals"}), so CI reads
 every audit tool through one loader. Exit code 1 when any
 error-severity finding exists. Warning counts are pinned by the tier-1
 gate (tests/test_contract_gate.py) against tests/contract_baseline.json;
-``--record`` regenerates it after an INTENTIONAL change — errors are
-never baselined, they are fixed.
+``--record`` regenerates it (AND tests/handoff_baseline.json) after an
+INTENTIONAL change — errors are never baselined, they are fixed (the
+one exception is handoff drift, where --record IS the act of moving
+both sides of the edge together).
 """
 import argparse
 import json
@@ -41,18 +53,20 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-TARGETS = ("flags", "imports", "observability", "threads")
+TARGETS = ("flags", "imports", "observability", "threads", "handoff",
+           "pallas")
 BASELINE_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "tests", "contract_baseline.json")
 
 
-def build_report(targets=TARGETS):
+def build_report(targets=TARGETS, handoff_baseline=None):
     """Run the requested contract passes; graph_lint-schema dict."""
     from paddle_tpu.analysis import contract_reports, contract_rules
 
     picked = contract_reports(targets=[n for n in TARGETS
-                                       if n in targets])
+                                       if n in targets],
+                              handoff_baseline=handoff_baseline)
     totals = {"error": 0, "warning": 0, "info": 0}
     for rep in picked.values():
         for sev, n in rep.counts().items():
@@ -94,11 +108,21 @@ def main(argv=None):
                     "only")
     ap.add_argument("--threads", action="store_true",
                     help="run the thread-discipline lint only")
+    ap.add_argument("--handoff", action="store_true",
+                    help="run the transfer-edge schema audit only")
+    ap.add_argument("--pallas", action="store_true",
+                    help="run the Pallas kernel budget audit only")
+    ap.add_argument("--handoff-baseline", default=None,
+                    dest="handoff_baseline", metavar="PATH",
+                    help="override the handoff baseline path (the gate's "
+                         "planted-drift smoke uses this)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the machine-readable report")
     ap.add_argument("--record", action="store_true",
-                    help="regenerate tests/contract_baseline.json "
-                         "(warning/info counts; errors never baseline)")
+                    help="regenerate tests/contract_baseline.json AND "
+                         "tests/handoff_baseline.json (warning/info "
+                         "counts + edge fingerprints; errors never "
+                         "baseline)")
     ap.add_argument("--list-rules", action="store_true", dest="list_rules",
                     help="print every rule, severity and allow-marker "
                          "spelling")
@@ -111,12 +135,23 @@ def main(argv=None):
     picked = [n for n, on in (("flags", args.flags),
                               ("imports", args.imports),
                               ("observability", args.obs),
-                              ("threads", args.threads)) if on] or TARGETS
+                              ("threads", args.threads),
+                              ("handoff", args.handoff),
+                              ("pallas", args.pallas)) if on] or TARGETS
     if args.record and tuple(picked) != TARGETS:
         # a partial baseline would KeyError the tier-1 gate on the
         # missing targets — recording is always the full battery
         picked = TARGETS
-    report = build_report(picked)
+    if args.record:
+        # stamp the edge fingerprints FIRST so the drift pass in the
+        # battery below sees (and reports against) the fresh baseline
+        from paddle_tpu.analysis import handoff_schema
+
+        hb = handoff_schema.record_baseline(path=args.handoff_baseline)
+        print(f"recorded -> "
+              f"{args.handoff_baseline or handoff_schema.BASELINE_PATH} "
+              f"({len(hb['edges'])} transfer edge(s))")
+    report = build_report(picked, handoff_baseline=args.handoff_baseline)
     if args.record:
         base = record_baseline(report)
         print(f"recorded -> {BASELINE_PATH}")

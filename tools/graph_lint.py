@@ -5,9 +5,14 @@
     python tools/graph_lint.py --all --json           # models + serving
                                                       # decode + source lint
                                                       # + contract auditor
+                                                      # + sharding flow
     python tools/graph_lint.py --source               # source lint only
     python tools/graph_lint.py --contracts            # ISSUE 12 contract
                                                       # auditor passes
+    python tools/graph_lint.py --sharding             # ISSUE 13: bundled
+                                                      # distributed programs
+                                                      # under their meshes
+    python tools/graph_lint.py --sharding-target dp8_quantized   # one
     python tools/graph_lint.py --list                 # registered passes
     python tools/graph_lint.py --list-rules           # rules + allow markers
 
@@ -31,6 +36,17 @@ import json
 import os
 import sys
 
+# the sharding-flow targets trace dp8/pp4 programs: give the CPU backend
+# its virtual devices BEFORE jax initializes (the tests/conftest.py mesh).
+# APPEND to any user-set XLA_FLAGS — a plain setdefault would silently
+# collapse the battery to 1 device (vacuously-clean reports) whenever the
+# user exports XLA_FLAGS for unrelated tuning
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -38,7 +54,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def build_report(models=(), serving=False, source=False, training=False,
-                 contracts=False):
+                 contracts=False, sharding=False, sharding_targets=None):
     """Run the requested targets; returns the shared-format report dict."""
     from paddle_tpu.analysis import registered_passes
     from paddle_tpu.analysis.registry import AnalysisReport
@@ -60,6 +76,11 @@ def build_report(models=(), serving=False, source=False, training=False,
 
         for name, rep in contract_reports().items():
             targets[f"contract_{name}"] = rep
+    if sharding or sharding_targets:
+        from paddle_tpu.analysis import sharding_reports
+
+        for name, rep in sharding_reports(targets=sharding_targets).items():
+            targets[f"sharding_{name}"] = rep
 
     totals = {"error": 0, "warning": 0, "info": 0}
     for rep in targets.values():
@@ -87,9 +108,18 @@ def main(argv=None):
     ap.add_argument("--source", action="store_true",
                     help="run the AST source linter over paddle_tpu/")
     ap.add_argument("--contracts", action="store_true",
-                    help="run the ISSUE 12 contract auditor (flag / "
-                         "lazy-import / observability / thread passes; "
-                         "same battery as tools/contract_audit.py)")
+                    help="run the contract auditor (flag / lazy-import / "
+                         "observability / thread / handoff / pallas "
+                         "passes; same battery as tools/contract_audit.py)")
+    ap.add_argument("--sharding", action="store_true",
+                    help="run the sharding-flow battery over the bundled "
+                         "distributed programs under their real meshes "
+                         "(gpt/bert/ernie train + serving + dp8 "
+                         "quantized + pipeline + disagg)")
+    ap.add_argument("--sharding-target", action="append", default=[],
+                    dest="sharding_targets", metavar="NAME",
+                    help="one sharding target (repeatable; implies "
+                         "--sharding for the picked subset)")
     ap.add_argument("--train", action="store_true",
                     help="trace models in training mode (dropout on)")
     ap.add_argument("--json", action="store_true", dest="as_json",
@@ -121,15 +151,20 @@ def main(argv=None):
 
     models = list(args.model)
     serving, source, contracts = args.serving, args.source, args.contracts
+    sharding = args.sharding
+    sharding_targets = list(args.sharding_targets) or None
     if args.all:
         models = list(MODEL_TARGETS)
-        serving = source = contracts = True
-    if not models and not serving and not source and not contracts:
+        serving = source = contracts = sharding = True
+    if not models and not serving and not source and not contracts \
+            and not sharding and not sharding_targets:
         ap.error("pick a target: --model NAME, --serving, --source, "
-                 "--contracts or --all")
+                 "--contracts, --sharding or --all")
 
     report = build_report(models=models, serving=serving, source=source,
-                          training=args.train, contracts=contracts)
+                          training=args.train, contracts=contracts,
+                          sharding=sharding,
+                          sharding_targets=sharding_targets)
     if args.as_json:
         print(json.dumps(report, indent=1))
     else:
