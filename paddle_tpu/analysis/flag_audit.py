@@ -73,6 +73,7 @@ STRUCTURAL_FLAGS = (
     "overlap_grad_comm",
     "use_bfloat16",
     "flash_attention_block",
+    "mpmd",
 )
 
 #: function names whose bodies ARE executable-identity expressions —

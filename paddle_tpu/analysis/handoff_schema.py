@@ -68,6 +68,10 @@ EDGES = {
                           "HANDOFF_SCHEMA"),
     "checkpoint_state": ("paddle_tpu/distributed/spmd.py",
                          "CHECKPOINT_SCHEMA"),
+    "mpmd_activation": ("paddle_tpu/distributed/stage.py",
+                        "HANDOFF_SCHEMA"),
+    "mpmd_grad": ("paddle_tpu/distributed/stage.py",
+                  "HANDOFF_SCHEMA_GRAD"),
 }
 
 BASELINE_PATH = os.path.join(

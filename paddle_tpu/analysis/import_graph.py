@@ -49,6 +49,7 @@ LAZY_MODULES = (
     "paddle_tpu.federated",                  # federated tier (ISSUE 8)
     "paddle_tpu.serving.router",             # multi-engine tier (ISSUE 6)
     "paddle_tpu.serving.disagg",             # prefill/decode split (ISSUE 6)
+    "paddle_tpu.distributed.stage",          # MPMD stage runtime (ISSUE 15)
 )
 
 #: what a plain trainer/engine process imports (the roots of the closure

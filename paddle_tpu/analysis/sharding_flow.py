@@ -698,7 +698,7 @@ def resharding_churn(ctx):
 # ---------------------------------------------------------------------------
 
 SHARDING_TARGETS = ("gpt_train", "bert_train", "ernie_train", "serving",
-                    "dp8_quantized", "pipeline", "disagg")
+                    "dp8_quantized", "pipeline", "disagg", "mpmd_train")
 
 #: analysis threshold for the bundled CPU-shrunk programs. 1<<17 keeps
 #: the CI-size traces quiet (a [16, 4, 16, 16] attention mask is 16k
@@ -855,6 +855,50 @@ def _target_pipeline():
     return closed, dict(mesh=mesh, donated=_donated_of(closed))
 
 
+def _target_mpmd():
+    """The FLAGS_mpmd armed pipeline (distributed/stage.py): per-stage
+    programs on their own mesh slices. The traced program is the fused
+    last stage (loss + grads — the densest of the per-stage programs);
+    its mesh is that stage's OWN mesh, not the trainer's."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from .. import flags as _flags
+    from ..distributed.mesh import build_mesh
+    from ..distributed.pipeline import PipelineTrainer
+    from ..models import GPTConfig, GPTForCausalLM
+
+    n_pp = max(2, _dp(2))
+    old = {"mpmd": _flags.get_flag("mpmd", False)}
+    _flags.set_flags({"mpmd": True})
+    try:
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=n_pp,
+                        num_heads=4, max_seq_len=64, dropout=0.0)
+        model = GPTForCausalLM(cfg)
+        pre, stages, post = model.pipeline_split(n_pp)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        mesh = build_mesh((n_pp,), ("pp",), devices=jax.devices()[:n_pp])
+        tr = PipelineTrainer(pre, stages, post, opt, mesh=mesh,
+                             n_micro=n_pp, schedule_mode="F-then-B")
+        rng = np.random.RandomState(0)
+        b, s = n_pp * 2, 16
+        mb = b // tr.n_micro
+        x_micro = jnp.asarray(
+            rng.randint(0, 256, (b, s)).astype(np.int32)).reshape(
+                (tr.n_micro, mb, s))
+        y_micro = jnp.asarray(
+            rng.randint(0, 256, (b, s)).astype(np.int32)).reshape(
+                (tr.n_micro, mb, s))
+        runner = tr._mpmd_runner
+        closed = runner.lint_jaxpr(x_micro, y_micro)
+    finally:
+        _flags.set_flags(old)
+    return closed, dict(mesh=runner.stage_meshes[-1], donated=set())
+
+
 def _target_serving(large_threshold=TARGET_THRESHOLD):
     from .targets import analyze_serving_decode
 
@@ -900,6 +944,7 @@ def sharding_reports(targets=None, large_threshold=TARGET_THRESHOLD):
         "dp8_quantized": _target_dp8_quantized,
         "pipeline": _target_pipeline,
         "disagg": _target_disagg,
+        "mpmd_train": _target_mpmd,
     }
     reports = {}
     for name in picked:

@@ -46,7 +46,8 @@ from .. import monitor as _monitor
 
 __all__ = [
     "DEFAULT_BLOCK", "SUPPORTED_BITS", "quantize", "dequantize",
-    "quantize_dequantize", "quantized_all_reduce",
+    "quantize_dequantize", "quantize_rows", "dequantize_rows",
+    "quantized_all_reduce",
     "quantized_all_reduce_ef", "padded_size", "wire_bytes", "error_gauge",
 ]
 
@@ -118,6 +119,36 @@ def dequantize(q, scales, block=DEFAULT_BLOCK):
     """Inverse of :func:`quantize`: int8 payload × its block scale."""
     return (q.astype(jnp.float32).reshape(-1, int(block))
             * scales[:, None].astype(jnp.float32)).reshape(-1)
+
+
+def quantize_rows(x):
+    """Per-last-axis-row symmetric int8 quantize for TRANSFER payloads
+    (stage edges): returns ``(q, scales)`` with ``q`` int8 of `x`'s
+    shape and ``scales`` float32 of shape ``x.shape[:-1] + (1,)`` —
+    exactly the encoded form a ``quantizable`` ``HANDOFF_SCHEMA`` leaf
+    declares (analysis/handoff_schema.py).
+
+    Unlike :func:`quantize` (gradient reduction) this rounds to NEAREST,
+    deterministically: a transfer is decoded once by one consumer, so
+    unbiasedness across repetitions buys nothing, while determinism buys
+    schedule-independent bit-exact replay (chaos drains, parity pins). A
+    zero row encodes to exact zeros; a non-finite element poisons its
+    row's scale — loud at decode, never silently clipped."""
+    a = jnp.asarray(x)
+    scale = (jnp.max(jnp.abs(a), axis=-1, keepdims=True)
+             .astype(jnp.float32) / 127.0)
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(a.astype(jnp.float32) / safe),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_rows(q, scales, dtype=jnp.float32):
+    """Inverse of :func:`quantize_rows`: int8 rows × their row scale,
+    cast back to the payload's declared `dtype`. Zero-scale rows decode
+    to exact zeros."""
+    return (q.astype(jnp.float32) * scales.astype(jnp.float32)).astype(
+        dtype)
 
 
 def quantize_dequantize(x, key, bits=8, block=DEFAULT_BLOCK):

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from .spmd import _pvary as _vary   # the ONE device-varying carry helper
+
 
 # the one source of truth for sequence-parallel attention impl names
 # (GPTConfig validates against this same tuple)
@@ -85,15 +87,6 @@ def ring_attention_spmd(q, k, v, axis_name="sp", causal=False):
 # ---------------------------------------------------------------------------
 # Ring attention with per-block Pallas flash kernels (forward AND backward).
 # ---------------------------------------------------------------------------
-
-def _vary(x, axis_name):
-    """Mark a carry init as device-varying over the ring axis — the ONE
-    shared helper (spmd._pvary: pcast -> pvary -> identity where neither
-    exists; such jax builds predate vma typing)."""
-    from .spmd import _pvary
-
-    return _pvary(x, axis_name)
-
 
 def _fold_heads(x):
     b, s, h, d = x.shape

@@ -20,8 +20,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import flags as _flags
 from ..core.tape import global_tape
 from ..core.tensor import Tensor
+from .spmd import _pvary as _vary   # the ONE device-varying carry helper
 
 #: The stage-boundary transfer edge (ISSUE 13; docs/ANALYSIS.md
 #: "Declaring a transfer edge"): what one pipeline rank's ppermute hands
@@ -172,15 +174,6 @@ class Pipeline:
 # Pipeline *training* — fwd + bwd + optimizer across stages
 # ---------------------------------------------------------------------------
 
-def _vary(arr, ax):
-    """Device-varying carry mark — the ONE shared helper (spmd._pvary:
-    pcast -> pvary -> identity where neither exists; such jax builds
-    predate vma typing and the identity is exact there)."""
-    from .spmd import _pvary
-
-    return _pvary(arr, ax)
-
-
 class PipelineTrainer:
     """Pipeline-parallel TRAINING over a pp(×dp) mesh — one jitted step.
 
@@ -214,13 +207,20 @@ class PipelineTrainer:
 
     def __init__(self, pre, stages, post_loss, optimizer, mesh=None,
                  pp_axis="pp", dp_axis="dp", n_micro=None,
-                 schedule_mode="1F1B", donate=True, stage_param_specs=None):
+                 schedule_mode="1F1B", donate=True, stage_param_specs=None,
+                 stage_meshes=None, compress=None):
         """stage_param_specs: optional {stage_param_name: PartitionSpec}
         (collect_spmd_specs of one stage) adding a TENSOR-PARALLEL axis under
         the pipeline: stacked stage params shard P('pp', *spec) and XLA's
         sharding propagation inserts the mp collectives inside each stage
         tick (the shard_map is manual over pp only; dp/mp stay automatic) —
-        3-axis pp x dp x mp hybrid parallelism."""
+        3-axis pp x dp x mp hybrid parallelism.
+
+        stage_meshes / compress apply only under FLAGS_mpmd
+        (distributed/stage.py): an explicit per-stage mesh list (unequal
+        device counts allowed) and int8 edge quantization (compress=8) for
+        the activation edges. With the flag unset both must stay None —
+        passing them is a config error, not a silent no-op."""
         from .mesh import get_mesh
 
         from .split import collect_spmd_specs
@@ -279,6 +279,45 @@ class PipelineTrainer:
             dst["post::" + n] = p._data
         self.opt_state = optimizer.functional_init(self.params)
         self._place_state()
+        # MPMD stage-program runtime (distributed/stage.py): the flag is
+        # consumed HERE — the armed trainer builds per-stage programs and
+        # typed edges over the state placed above, so a post-construction
+        # toggle raises (_mpmd_active) instead of silently switching
+        # schedulers mid-run. Only the armed path imports the module.
+        self._mpmd = bool(_flags.get_flag("mpmd", False))
+        self._mpmd_runner = None
+        if not self._mpmd and (stage_meshes is not None
+                               or compress is not None):
+            raise ValueError(
+                "stage_meshes/compress are MPMD edge options "
+                "(distributed/stage.py) — set FLAGS_mpmd before "
+                "constructing the trainer")
+        if self._mpmd:
+            from . import stage as _stage_mod
+
+            self._mpmd_runner = _stage_mod.MpmdPipelineRunner(
+                self, stage_meshes=stage_meshes, compress=compress)
+
+    def _mpmd_active(self):
+        """FLAGS_mpmd was consumed at construction (the stage programs
+        and edges are built then); a post-construction toggle is loud
+        instead of silently swapping schedulers. One get_flag + compare
+        when disarmed."""
+        m = bool(_flags.get_flag("mpmd", False))
+        if m != self._mpmd:
+            raise RuntimeError(
+                "FLAGS_mpmd changed after this PipelineTrainer was "
+                "constructed; the stage programs and transfer edges are "
+                "built at __init__ — build a new PipelineTrainer under "
+                "the new flag value")
+        return self._mpmd
+
+    def numerics_fetch(self):
+        """Numerics-telescope drain hook (testing/parity.py lockstep
+        harness). The pipeline step doesn't thread the telescope — same
+        carve-out as localsgd/DGC — so there is never anything to
+        fetch."""
+        return None
 
     # -- sharding placement ----------------------------------------------------
     def _sharding_for(self, name):
@@ -420,6 +459,10 @@ class PipelineTrainer:
         y_micro = y.reshape((self.n_micro, mb) + y.shape[1:])
         if not self._edge_checked:
             self._validate_stage_edge(x_micro)
+        if self._mpmd_active():
+            loss = self._mpmd_runner.train_step(x_micro, y_micro)
+            self.optimizer._step_count += 1
+            return Tensor(loss)
         if self._compiled is None:
             self._compiled = self._build()
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)

@@ -287,6 +287,7 @@ class SpmdTrainer:
         # the armed path imports distributed/async_dispatch.py.
         self._async, self._async_window = self._resolve_async()
         self._overlap_comm = self._resolve_overlap()
+        self._mpmd = self._resolve_mpmd()
         self._pending_verdicts = []  # [(schedule position, device bool)]
         self._guard_abort = None     # undelivered deferred FloatingPointError
         self._verdict_fetches = 0    # drains (host syncs) so far
@@ -389,6 +390,29 @@ class SpmdTrainer:
                 "optimizer-state pytree at __init__ — build a new "
                 "SpmdTrainer under the new flag value")
         return self._shard_update
+
+    # -- MPMD stage runtime (distributed/stage.py) -----------------------------
+    def _resolve_mpmd(self):
+        """Consume FLAGS_mpmd at construction. The data-parallel trainer
+        has no stage split — the flag only keys the executables here
+        (exec key + AOT extra_key), so an MPMD-armed process never
+        aliases a cache entry with a plain one; the armed runtime itself
+        lives on PipelineTrainer/DisaggregatedPool."""
+        return bool(_flags.get_flag("mpmd", False))
+
+    def _mpmd_active(self):
+        """FLAGS_mpmd was consumed at construction (it is baked into
+        this trainer's executable keys); a post-construction toggle is
+        loud instead of silently re-keying mid-run. One get_flag +
+        compare when disarmed."""
+        m = bool(_flags.get_flag("mpmd", False))
+        if m != self._mpmd:
+            raise RuntimeError(
+                "FLAGS_mpmd changed after this trainer was constructed; "
+                "the flag is baked into the executable cache keys at "
+                "__init__ — build a new trainer under the new flag "
+                "value")
+        return self._mpmd
 
     # -- async double-buffered dispatch (docs/PERF.md) -------------------------
     def _resolve_async(self):
@@ -1471,7 +1495,8 @@ class SpmdTrainer:
         # silently reusing the wrong executable
         return (self._batch_sig_key(batch_arrays), self._guard_active(),
                 self._numerics_active(), self._compress_active(),
-                self._shard_update_active(), self._overlap_active())
+                self._shard_update_active(), self._overlap_active(),
+                self._mpmd_active())
 
     def _aot_compile(self, batch_arrays, lr, rng, force=False):
         """Build the jitted step for THIS batch signature and obtain its
@@ -1496,7 +1521,7 @@ class SpmdTrainer:
                            self.accumulate_steps, guarded, narmed,
                            self._quantized, self._shard_update,
                            self._qar_bits, self._qar_min_size,
-                           self._overlap_comm))
+                           self._overlap_comm, self._mpmd))
         self._compiled_store[self._exec_key(batch_arrays)] = (
             compiled, guarded, narmed, self._quantized)
         self._compiled = compiled  # latest executable (back-compat handle)

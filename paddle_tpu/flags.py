@@ -187,6 +187,19 @@ define_flag("tpp_kernels", False,
             "on CPU). Read at trace time in models/gpt.py; unset, the "
             "registry module is never imported and the traced program "
             "is byte-identical")
+define_flag("mpmd", False,
+            "MPMD stage-program runtime (distributed/stage.py, "
+            "arXiv:2412.14374): PipelineTrainer schedules its stages as "
+            "per-stage AOT-cached programs on their own mesh slices "
+            "connected by typed, backpressured transfer edges (1F1B / "
+            "F-then-B / interleaved tick orderings over the same edges), "
+            "and DisaggregatedPool routes its prefill->decode hand-off "
+            "over the same edge abstraction (compress=8 rides the "
+            "EQuARX int8 row codec). Read at TRAINER/POOL CONSTRUCTION "
+            "— a post-construction toggle under a live trainer raises. "
+            "Unset, distributed/stage.py is never imported "
+            "(manifest-lazy; analysis/import_graph.py) and behavior is "
+            "byte-identical")
 define_flag("blackbox", False,
             "black-box flight recorder on/off (monitor/blackbox.py): "
             "progress beacons, the bounded event ring, and dump-bundle "

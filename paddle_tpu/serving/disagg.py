@@ -27,6 +27,7 @@ import time
 
 import numpy as np
 
+from .. import flags as _flags
 from .. import monitor as _monitor
 from ..monitor import blackbox_lazy as _blackbox  # import-free recorder facade (ISSUE 12)
 from .. import trace as _trace
@@ -168,12 +169,34 @@ class DisaggregatedPool:
                  max_batch=4, dtype=None, cache_dtype=None,
                  eos_token_id=None,
                  prompt_buckets=(32, 64, 128, 256, 512, 1024),
-                 max_queue=None, decode_model=None):
+                 max_queue=None, decode_model=None, compress=None):
         from ..inference.serving import ServingEngine
 
         if int(prefill_workers) < 1 or int(decode_engines) < 1:
             raise ValueError("the pool needs >= 1 prefill worker and "
                              ">= 1 decode engine")
+        # MPMD stage edge (distributed/stage.py): FLAGS_mpmd is consumed
+        # HERE — armed, the prefill->decode hand-off travels a typed
+        # StageEdge validating this module's HANDOFF_SCHEMA (compress=8
+        # rides the int8 row codec); a post-construction toggle raises
+        # (_mpmd_active). Unset, the module is never imported and the
+        # hand-off below is byte-identical to the pre-PR pool.
+        self._mpmd = bool(_flags.get_flag("mpmd", False))
+        self._edge = None
+        self._backpressure_excs = ()
+        if compress is not None and not self._mpmd:
+            raise ValueError(
+                "compress quantizes the prefill->decode stage edge "
+                "(distributed/stage.py) — set FLAGS_mpmd before "
+                "constructing the pool")
+        if self._mpmd:
+            from ..distributed import stage as _stage_mod
+
+            self._edge = _stage_mod.StageEdge(
+                "disagg_kv", HANDOFF_SCHEMA,
+                capacity=int(decode_engines) * int(max_batch),
+                compress=compress)
+            self._backpressure_excs = (_stage_mod.EdgeFullError,)
         shared = dict(dtype=dtype, cache_dtype=cache_dtype,
                       prompt_buckets=prompt_buckets,
                       decode_model=decode_model)
@@ -193,6 +216,19 @@ class DisaggregatedPool:
         self._next_worker = 0
         self._m = {"submitted": 0, "handoffs": 0, "handoff_bytes": 0,
                    "per_engine": {}}
+
+    def _mpmd_active(self):
+        """FLAGS_mpmd was consumed at construction (the stage edge is
+        built then); a post-construction toggle is loud instead of
+        silently re-routing the hand-off. One get_flag + compare when
+        disarmed."""
+        m = bool(_flags.get_flag("mpmd", False))
+        if m != self._mpmd:
+            raise RuntimeError(
+                "FLAGS_mpmd changed after this DisaggregatedPool was "
+                "constructed; the prefill->decode stage edge is built at "
+                "__init__ — build a new pool under the new flag value")
+        return self._mpmd
 
     def submit(self, prompt_ids, max_new_tokens=32, **kwargs):
         """Queue one prompt; returns the pool request id. kwargs pass
@@ -280,7 +316,20 @@ class DisaggregatedPool:
                 rid=rid, engine=name, prompt_tokens=int(len(ids)))
             try:
                 kv_row, logits = worker.prefill(ids)
-                nbytes = _dm_registry.cache_row_bytes(kv_row)
+                if self._edge is not None:
+                    # MPMD routing: the row crosses a typed StageEdge —
+                    # validated against HANDOFF_SCHEMA, quantized when
+                    # the edge compresses, metered (wire bytes) at the
+                    # edge's own kv_handoff_bytes_total chokepoint
+                    kc1, vc1 = kv_row
+                    nbytes = self._edge.put(
+                        {"kc": kc1, "vc": vc1, "logits": logits},
+                        dtypes={"cache": str(kc1.dtype)})
+                    payload = self._edge.get()
+                    kv_row = (payload["kc"], payload["vc"])
+                    logits = payload["logits"]
+                else:
+                    nbytes = _dm_registry.cache_row_bytes(kv_row)
                 erid = eng.admit_prefilled(ids, kv_row, logits,
                                            trace_id=tid, parent_span=sp,
                                            **eng_kwargs)
@@ -292,16 +341,18 @@ class DisaggregatedPool:
                     sp.end(error=True)
                 from ..inference.serving import QueueFullError
 
-                if isinstance(exc, QueueFullError):
-                    # a bounded decode engine at capacity is BACKPRESSURE
-                    # (same as no free slots), not a pool failure — retry
-                    # the handoff on a later step
+                if isinstance(exc,
+                              (QueueFullError,) + self._backpressure_excs):
+                    # a bounded decode engine (or a full stage edge) at
+                    # capacity is BACKPRESSURE (same as no free slots),
+                    # not a pool failure — retry the handoff later
                     return
                 _KV_HANDOFFS.labels(event="error").inc()
                 raise
             if sp is not None:
                 sp.end(bytes=nbytes)
-            _KV_BYTES.inc(nbytes)
+            if self._edge is None:
+                _KV_BYTES.inc(nbytes)   # armed: the edge already metered
             _KV_HANDOFFS.labels(event="ok").inc()
             self._m["handoffs"] += 1
             self._m["handoff_bytes"] += nbytes
@@ -313,6 +364,7 @@ class DisaggregatedPool:
     def step(self):
         """Advance prefill handoffs, then one decode step per engine.
         Returns the pool requests finished this step as {rid: Request}."""
+        self._mpmd_active()
         self._advance_prefill()
         done = {}
         for name, eng in self.engines.items():
@@ -366,8 +418,11 @@ class DisaggregatedPool:
 
     def stats(self):
         """Pool-level handoff accounting + each side's own stats."""
-        return {
+        out = {
             "pool": dict(self._m, pending=len(self._pending)),
             "workers": [w.stats() for w in self.workers],
             "engines": {n: e.stats() for n, e in self.engines.items()},
         }
+        if self._edge is not None:
+            out["edge"] = dict(self._edge.stats)
+        return out

@@ -76,6 +76,11 @@ SITES = {
                        "update inside a round; an injected error drops "
                        "that client (federated_client_dropped_total) and "
                        "the round completes with the surviving cohort",
+    "stage/edge": "distributed.stage.StageEdge.put — inside the edge's "
+                  "blackbox progress window, before the payload is "
+                  "validated/encoded onto the queue; a delay here reads "
+                  "as a stalled stage to the stall sentinel, an error "
+                  "leaves the payload un-enqueued (producer retries)",
 }
 
 

@@ -26,6 +26,14 @@ Checks (one entry per name in `passes`):
                      and its dump bundle names site=serving/step, the
                      in-flight rids, and all-thread stacks — then the
                      engine drains to exact greedy parity
+  stage_backpressure with FLAGS_mpmd armed the disagg pool's handoff
+                     rides a typed StageEdge: a full edge rejects the
+                     overflow put (EdgeFullError, counted, nothing
+                     lost on drain), a stage/edge=delay failpoint
+                     wedges one hand-off mid-run and the stall
+                     sentinel fires DURING the wedge naming
+                     site=stage/edge, then the drain keeps exact
+                     greedy parity with edge puts==gets==prompts
   trainer_nonfinite  a NaN batch under FLAGS_check_nan_inf skips the
                      update, leaving params/moments bit-identical
   numerics_anomaly   a trainer/batch=scale failpoint injects a gradient
@@ -63,8 +71,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 PASSES = ["ckpt_atomic", "ckpt_fallback", "serving_deadline",
           "serving_slot_error", "serving_shed", "router_failover",
-          "stall_dump", "trainer_nonfinite", "numerics_anomaly",
-          "quantized_nonfinite", "async_nonfinite"]
+          "stall_dump", "stage_backpressure", "trainer_nonfinite",
+          "numerics_anomaly", "quantized_nonfinite", "async_nonfinite"]
 
 
 def _finding(name, severity, message, where=""):
@@ -364,6 +372,130 @@ def _check_stall_dump(m):
                 "sentinel fired during the wedge; bundle named "
                 "site=serving/step + in-flight rids; drain stayed "
                 "bit-exact")]
+
+
+def _check_stage_backpressure(m):
+    """Chaos-injected MPMD edge stall: with FLAGS_mpmd armed the disagg
+    pool's prefill->decode hand-off travels a typed StageEdge. First a
+    full edge must reject the overflow put (EdgeFullError, counted as
+    backpressure) and still drain every accepted payload FIFO bit-exact;
+    then a stage/edge=delay failpoint wedges one live hand-off inside the
+    edge's beacon window — the stall sentinel must fire DURING the wedge
+    naming site=stage/edge, and the post-stall drain must keep exact
+    greedy parity with edge puts==gets==prompts (no payload lost)."""
+    import glob
+
+    import numpy as np
+
+    from paddle_tpu import flags
+    from paddle_tpu.monitor import blackbox as bb
+    from paddle_tpu.serving.disagg import DisaggregatedPool
+    from paddle_tpu.testing import failpoints as fp
+
+    name = "stage_backpressure"
+    old_mpmd = flags.get_flag("mpmd", False)
+    flags.set_flags({"mpmd": True})
+    try:
+        from paddle_tpu.distributed import stage as stage_mod
+
+        # 1) a FULL edge backpressures without loss: a capacity-2 queue
+        # rejects the third put before doing any work, counts it, then
+        # drains FIFO bit-exact and accepts the retried payload
+        edge = stage_mod.StageEdge("chaos", stage_mod.HANDOFF_SCHEMA,
+                                   capacity=2)
+        rows = [np.full((1, 2, 4), float(i + 1), np.float32)
+                for i in range(3)]
+        for r in rows[:2]:
+            edge.put({"activation": r})
+        try:
+            edge.put({"activation": rows[2]})
+            return [_finding(name, "error",
+                             "third put on a capacity-2 edge did not "
+                             "raise EdgeFullError")]
+        except stage_mod.EdgeFullError:
+            pass
+        if edge.stats["backpressured"] != 1 or edge.stats["puts"] != 2:
+            return [_finding(name, "error",
+                             "rejected put was not booked as pure "
+                             f"backpressure: {edge.stats}")]
+        drained = [edge.get()["activation"] for _ in range(2)]
+        edge.put({"activation": rows[2]})   # the producer's retry lands
+        drained.append(edge.get()["activation"])
+        for want, got in zip(rows, drained):
+            if not np.array_equal(np.asarray(got), want):
+                return [_finding(name, "error",
+                                 "backpressured edge lost or reordered a "
+                                 "payload on drain")]
+
+        # 2) the armed pool wedged INSIDE a live edge put: two waves of
+        # prompts so the wedged step still has a free decode slot (and
+        # therefore actually touches the edge), healthy beat first so
+        # the stall is a transition the sentinel can see
+        rng = np.random.RandomState(7)
+        prompts = [rng.randint(0, 64, (n,)).astype(np.int32)
+                   for n in (5, 7, 4, 6, 5, 8)]
+        tmp_ctx = tempfile.TemporaryDirectory(
+            prefix="paddle_tpu_chaos_stage_")
+        d = tmp_ctx.name
+        old_dir = flags.get_flag("blackbox_dir", "")
+        was_enabled = bb.is_enabled()
+        bb.enable(install=False)
+        flags.set_flags({"blackbox_dir": d})
+        try:
+            pool = DisaggregatedPool(m, prefill_workers=1,
+                                     decode_engines=1, max_batch=3)
+            rids = [pool.submit(p, max_new_tokens=5) for p in prompts[:2]]
+            pool.step()   # healthy hand-offs first
+            rids += [pool.submit(p, max_new_tokens=5) for p in prompts[2:]]
+            bb.start_sentinel(timeout_s=0.15, poll_s=0.05)
+            with fp.scoped("stage/edge=delay:800"):
+                pool.step()   # one free slot -> one wedged hand-off
+            deadline = time.time() + 3.0
+            bundles = []
+            while time.time() < deadline:
+                bundles = sorted(glob.glob(os.path.join(
+                    d, "blackbox-*.json")))
+                if bundles:
+                    break
+                time.sleep(0.05)
+            if not bundles:
+                return [_finding(name, "error",
+                                 "sentinel wrote no dump bundle while a "
+                                 "stage-edge hand-off was wedged")]
+            bundle = bb.load_bundle(bundles[0])
+            if bundle["reason"] != "stall" \
+                    or bundle.get("site") != "stage/edge":
+                return [_finding(
+                    name, "error",
+                    f"bundle names reason={bundle['reason']!r} "
+                    f"site={bundle.get('site')!r}, expected a stall at "
+                    "stage/edge")]
+            res = pool.run_until_complete()
+            for rid, p in zip(rids, prompts):
+                if not np.array_equal(res[rid].tokens,
+                                      _ref_tokens(m, p, 5)):
+                    return [_finding(name, "error",
+                                     "post-stall drain lost greedy "
+                                     f"parity for rid={rid}")]
+            st = pool.stats()["edge"]
+            if st["puts"] != len(prompts) or st["gets"] != len(prompts):
+                return [_finding(name, "error",
+                                 "edge puts/gets do not match the prompt "
+                                 f"count — a payload was lost: {st}")]
+        finally:
+            bb.stop_sentinel()
+            flags.set_flags({"blackbox_dir": old_dir})
+            bb.quiesce()
+            bb.reset()
+            if not was_enabled:
+                bb.disable()
+            tmp_ctx.cleanup()
+    finally:
+        flags.set_flags({"mpmd": old_mpmd})
+    return [_ok(name,
+                "full edge backpressured without loss; sentinel fired "
+                "during the wedge naming site=stage/edge; post-stall "
+                "drain stayed bit-exact with puts==gets==prompts")]
 
 
 def _check_trainer_nonfinite():
@@ -702,7 +834,8 @@ def build_report(only=None):
         ("async_nonfinite", _check_async_nonfinite),
     ]
     if selected & {"serving_deadline", "serving_slot_error",
-                   "serving_shed", "router_failover", "stall_dump"}:
+                   "serving_shed", "router_failover", "stall_dump",
+                   "stage_backpressure"}:
         m = _tiny_model()
         checks += [
             ("serving_deadline", lambda: _check_serving_deadline(m)),
@@ -710,6 +843,8 @@ def build_report(only=None):
             ("serving_shed", lambda: _check_serving_shed(m)),
             ("router_failover", lambda: _check_router_failover(m)),
             ("stall_dump", lambda: _check_stall_dump(m)),
+            ("stage_backpressure",
+             lambda: _check_stage_backpressure(m)),
         ]
     for name, fn in checks:
         if name not in selected:

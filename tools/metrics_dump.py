@@ -7,6 +7,7 @@
     python tools/metrics_dump.py --federated              # 2-client FedAvg
     python tools/metrics_dump.py --numerics               # numerics telescope
     python tools/metrics_dump.py --quantized              # int8 grad reduce
+    python tools/metrics_dump.py --mpmd                   # stage-graph pipeline
     python tools/metrics_dump.py --model bert --prometheus
     python tools/metrics_dump.py --all --json             # machine-readable
     python tools/metrics_dump.py --serving --trace        # + span summary
@@ -29,6 +30,8 @@ import argparse
 import json
 import os
 import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
 import jax
 
@@ -68,6 +71,13 @@ _REQUIRED = {
     # tiny-GPT loop (the loop arms both ISSUE 11 flags)
     "async": ("async_verdict_fetch_total", "async_window_depth",
               "tpp_kernel_calls_total", "compile_cache_total"),
+    # the MPMD stage runtime (docs/DISTRIBUTED.md "Stage programs"): edge
+    # wire bytes, the quantized-edge savings through the collective
+    # chokepoint, and per-stage compiles through the shared AOT cache;
+    # run_mpmd_loop additionally asserts the stage_step spans of one
+    # traced step share their stage_graph root's trace_id
+    "mpmd": ("kv_handoff_bytes_total", "collective_bytes_saved_total",
+             "collective_bytes_total", "compile_cache_total"),
 }
 
 #: (family, label, value) series that must exist in a target's snapshot,
@@ -79,6 +89,8 @@ _REQUIRED_SERIES = {
                    "quantized_all_reduce")),
     "async": (("tpp_kernel_calls_total", "op", "ln_matmul"),
               ("tpp_kernel_calls_total", "op", "fused_mlp")),
+    "mpmd": (("collective_bytes_saved_total", "op", "stage_edge"),
+             ("collective_bytes_total", "op", "stage_edge")),
 }
 
 _DIMS = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
@@ -332,6 +344,65 @@ def run_async_loop(steps=5):
         paddle.set_flags(old)
 
 
+def run_mpmd_loop(steps=2):
+    """The MPMD stage-runtime target: a 2-stage pipeline trainer rebased
+    onto StageGraph (FLAGS_mpmd armed at construction) with a compress=8
+    activation edge — moves kv_handoff_bytes_total (edge wire bytes),
+    collective_bytes_{total,saved_total}{op=stage_edge} (quantized-edge
+    wire vs logical accounting) and compile_cache_total{site=stage} in
+    one pass. The last step runs under trace and the loop asserts every
+    stage_step span shares its stage_graph root's trace_id — the span
+    contract in executable form, independent of --trace."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import flags, trace
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.pipeline import PipelineTrainer
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    old = {"mpmd": flags.get_flag("mpmd")}
+    paddle.set_flags({"mpmd": True})
+    was_tracing = trace.is_enabled()
+    try:
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        model = GPTForCausalLM(GPTConfig(max_seq_len=64, **_DIMS))
+        pre, stages, post = model.pipeline_split(2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        mesh = build_mesh((2,), ("pp",), devices=jax.devices()[:2])
+        trainer = PipelineTrainer(pre, stages, post, opt, mesh=mesh,
+                                  n_micro=2, schedule_mode="1F1B",
+                                  compress=8)
+        batch = [rng.randint(0, 256, (2, 16)).astype(np.int32)
+                 for _ in range(2)]
+        for _ in range(steps):
+            trainer.train_step(*batch)
+        if not was_tracing:
+            trace.enable()
+        trainer.train_step(*batch)
+        roots = [s for s in trace.spans() if s.name == "stage_graph"]
+        ticks = [s for s in trace.spans() if s.name == "stage_step"]
+        if not roots or not ticks:
+            raise RuntimeError("traced MPMD step recorded no stage_graph/"
+                               "stage_step spans")
+        root_ids = {s.trace_id for s in roots}
+        stray = {s.trace_id for s in ticks} - root_ids
+        if stray:
+            raise RuntimeError("stage_step spans carry trace_ids with no "
+                               f"stage_graph root: {sorted(stray)}")
+        es = trainer._mpmd_runner.stats()
+        return {"steps": steps + 1,
+                "stage_step_spans": len(ticks),
+                "trace_ids": len(root_ids),
+                "edges": es["edges"]}
+    finally:
+        if not was_tracing:
+            trace.disable()
+        paddle.set_flags(old)
+
+
 def run_blackbox_loop(new_tokens=4):
     """The flight-recorder target: a short serving loop with the
     recorder ON, then one on-demand dump bundle into a throwaway dir —
@@ -363,8 +434,23 @@ def run_blackbox_loop(new_tokens=4):
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _series_moved(m, s):
+    if m["type"] == "histogram":
+        return s["count"] > 0
+    if m["type"] == "counter":
+        return s["value"] != 0
+    return True                      # a gauge legitimately reads 0
+
+
 def _metric_families(snap):
-    return {m["name"]: m for m in snap["metrics"] if m["series"]}
+    """Families with at least one live series. A counter/histogram family
+    whose every series is zero counts as EMPTY: monitor.reset() keeps
+    registered metric objects (zeroed), so an in-process caller that ran
+    other workloads first would otherwise see families 'present' that the
+    target never touched — the subprocess and in-process verdicts must
+    agree."""
+    return {m["name"]: m for m in snap["metrics"]
+            if any(_series_moved(m, s) for s in m["series"])}
 
 
 def run_target(name, with_trace=False):
@@ -378,7 +464,7 @@ def run_target(name, with_trace=False):
     monitor.reset()
     trace_summary = None
     kind = (name if name in ("serving", "router", "blackbox", "federated",
-                             "numerics", "quantized", "async")
+                             "numerics", "quantized", "async", "mpmd")
             else "train")
     if with_trace:
         trace.clear()
@@ -398,6 +484,8 @@ def run_target(name, with_trace=False):
             run_quantized_loop()
         elif kind == "async":
             run_async_loop()
+        elif kind == "mpmd":
+            run_mpmd_loop()
         else:
             run_train_step(name)
     finally:
@@ -487,10 +575,17 @@ def main(argv=None):
                          "async_verdict_fetch_total/async_window_depth "
                          "families and tpp_kernel_calls_total{op=...} "
                          "series are present")
+    ap.add_argument("--mpmd", action="store_true", dest="mpmd",
+                    help="run the MPMD stage-runtime target (2-stage "
+                         "pipeline on StageGraph with FLAGS_mpmd armed "
+                         "and a compress=8 activation edge); exit 1 "
+                         "unless kv_handoff_bytes_total and "
+                         "collective_bytes_{total,saved_total}"
+                         "{op=stage_edge} are present")
     ap.add_argument("--all", action="store_true",
                     help="all models + the serving loop + the router, "
                          "flight-recorder, federated, numerics, "
-                         "quantized and async tiers")
+                         "quantized, async and mpmd tiers")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the graph_lint-schema machine report")
     ap.add_argument("--prometheus", action="store_true",
@@ -515,14 +610,16 @@ def main(argv=None):
         targets.append("quantized")
     if args.async_:
         targets.append("async")
+    if args.mpmd:
+        targets.append("mpmd")
     if args.all:
         targets = list(MODEL_TARGETS) + ["serving", "router", "blackbox",
                                          "federated", "numerics",
-                                         "quantized", "async"]
+                                         "quantized", "async", "mpmd"]
     if not targets:
         ap.error("pick a target: --model NAME, --serving, --router, "
                  "--blackbox, --federated, --numerics, --quantized, "
-                 "--async or --all")
+                 "--async, --mpmd or --all")
 
     report = build_report(targets, with_trace=args.with_trace)
     if args.as_json:

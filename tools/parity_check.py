@@ -37,6 +37,10 @@ import json
 import os
 import sys
 
+# 8 host devices BEFORE jax loads — the MPMD pipeline targets need a
+# real 2-device pp mesh (same forcing as tests/conftest.py)
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -59,6 +63,29 @@ def _build_trainer(lr=1e-2, amp_dtype=None):
     mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
     return SpmdTrainer(model, opt, loss_fn=loss, mesh=mesh,
                        amp_dtype=amp_dtype)
+
+
+def _build_pipeline_trainer(lr=1e-2, compress=None):
+    """2-stage pipeline twin of _build_trainer for the MPMD A/Bs: the
+    armed/disarmed sides build the SAME seeded split model; only the
+    scheduler differs. compress=8 quantizes the activation edges
+    (meaningful only under FLAGS_mpmd — run_lockstep arms it via
+    candidate_flags before build())."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.pipeline import PipelineTrainer
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    pre, stages, post = model.pipeline_split(2)
+    opt = paddle.optimizer.AdamW(learning_rate=lr,
+                                 parameters=model.parameters())
+    mesh = build_mesh((2,), ("pp",), devices=jax.devices()[:2])
+    kw = {"compress": compress} if compress is not None else {}
+    return PipelineTrainer(pre, stages, post, opt, mesh=mesh, n_micro=2,
+                           schedule_mode="1F1B", **kw)
 
 
 def _batches(steps, batch=2, seq=12):
@@ -130,6 +157,27 @@ AB_TARGETS = {
         reference_flags={},
         candidate_flags={"tpp_kernels": True},
         loss_rtol=1e-3, loss_atol=1e-4, stat_rtol=0.05, stat_atol=1e-3),
+    # ISSUE 15 MPMD runtime (distributed/stage.py): the same 2-stage
+    # split model trained by the monolithic scanned schedule (reference)
+    # vs per-stage programs + typed edges (candidate). The arithmetic is
+    # the same matmuls, but grad accumulation is restructured (per-micro
+    # vjp sums vs autodiff-of-scan) — a minutely different float
+    # program, pinned in the tpp_kernels-class band
+    "mpmd_pipeline": dict(
+        reference_build=_build_pipeline_trainer,
+        reference_flags={},
+        candidate_flags={"mpmd": True},
+        loss_rtol=1e-3, loss_atol=1e-4, stat_rtol=0.05, stat_atol=1e-3),
+    # armed-vs-armed with the activation edges quantized (compress=8,
+    # int8 row codec): genuinely lossy transfers — the declared band is
+    # the quantized_allreduce envelope (per-element error ~rowmax/127)
+    "mpmd_quantized_edge": dict(
+        reference_build=_build_pipeline_trainer,
+        candidate_build=functools.partial(_build_pipeline_trainer,
+                                          compress=8),
+        reference_flags={"mpmd": True},
+        candidate_flags={"mpmd": True},
+        loss_rtol=0.08, loss_atol=0.05, stat_rtol=0.6, stat_atol=0.1),
 }
 
 
@@ -150,11 +198,13 @@ def run_target(name, steps=4, perturb_lr=None):
     if perturb_lr is not None:
         if name in AB_TARGETS:
             spec = dict(AB_TARGETS[name])
-            base = spec.get("candidate_build")
+            base = (spec.get("candidate_build")
+                    or spec.get("reference_build", _build_trainer))
+            base_fn = base.func if isinstance(base, functools.partial) \
+                else base
             kw = dict(getattr(base, "keywords", None) or {})
             kw["lr"] = 1e-2 * perturb_lr
-            spec["candidate_build"] = functools.partial(_build_trainer,
-                                                        **kw)
+            spec["candidate_build"] = functools.partial(base_fn, **kw)
         else:
             spec = dict(
                 candidate_build=functools.partial(_build_trainer,
@@ -165,7 +215,7 @@ def run_target(name, steps=4, perturb_lr=None):
     else:
         spec = AB_TARGETS[name]
     report = parity.run_parity(
-        _build_trainer, _batches(steps),
+        spec.get("reference_build", _build_trainer), _batches(steps),
         build_candidate=spec.get("candidate_build"),
         reference_flags=spec["reference_flags"],
         candidate_flags=spec["candidate_flags"],
