@@ -95,12 +95,14 @@ def contract_rules():
     passes — the one vocabulary --list-rules prints (with allow-marker
     spellings from analysis/allowlist.py). The ISSUE 13 jaxpr-level
     sharding rules ride along: one vocabulary across every surface."""
-    from . import (flag_audit, handoff_schema, import_graph, obs_audit,
-                   pallas_audit, sharding_flow, source_lint)
+    from . import (cost_model, flag_audit, handoff_schema, import_graph,
+                   obs_audit, pallas_audit, plan_search, sharding_flow,
+                   source_lint)
 
     merged = {}
     for mod in (source_lint, flag_audit, import_graph, obs_audit,
-                sharding_flow, handoff_schema, pallas_audit):
+                sharding_flow, handoff_schema, pallas_audit,
+                cost_model, plan_search):
         merged.update(mod.RULES)
     return merged
 

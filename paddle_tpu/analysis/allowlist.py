@@ -42,6 +42,11 @@ RULE_ALIASES = {
     "handoff-schema-drift": ("handoff-drift",),
     "kernel-vmem-over-budget": ("vmem-budget",),
     "kernel-low-precision-accumulator": ("int8-accumulator",),
+    # ISSUE 16: auto-parallel plan-search rules (cost_model/plan_search)
+    "plan-invalid-config": ("bad-plan",),
+    "plan-hbm-over-budget": ("hbm-budget",),
+    "plan-handoff-mismatch": ("plan-handoff",),
+    "plan-space-empty": ("empty-plan-space",),
 }
 
 

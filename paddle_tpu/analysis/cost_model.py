@@ -1,0 +1,454 @@
+"""Static cost model for auto-parallel plan search (ROADMAP item 4).
+
+The reference Paddle picks a distributed strategy by trial runs over
+fleet configs; here the strategy is priced WITHOUT executing anything,
+by composing the static layers the repo already has:
+
+- **compute** comes from the cost registry (trace/costs.py): the tiny
+  bundled trainer step is jit-lowered once per model and XLA's
+  ``cost_analysis()`` supplies total FLOPs / bytes accessed (trace +
+  lower only — nothing runs). The per-device roofline is
+  ``max(flops/peak_flops, bytes/hbm_bw)`` with the pipeline bubble
+  factor ``(pp - 1 + n_micro) / n_micro`` on pipelined plans.
+- **communication** comes from the sharding-flow analyzer when the
+  plan's traced program carries explicit collectives (the shard_map
+  paths: quantized all-reduce, pipeline ppermute) — see
+  :func:`sharding_flow.flow_summary` — and from the documented analytic
+  ring term ``2 (n-1)/n × grad bytes`` when the collective is
+  XLA-inserted (plain-dp pjit carries no collective eqns to measure).
+  Stage-edge bytes always come from the declared transfer schema via
+  :func:`handoff_schema.wire_bytes` (dense vs the measured
+  ``4 / (1 + 4/D)`` int8 ratio; grad edges stay dense — the schema
+  says so, not this module).
+- **memory** is priced per device (params + optimizer state + live
+  activations + the quantized reduce's error-feedback residuals)
+  against an HBM budget, and the per-stage activation working set is
+  pushed through the SAME Pallas VMEM accounting registered kernels
+  use (:func:`pallas_audit.audit_tile`, 16 MiB/core, streamed buffers
+  double-buffered).
+
+The model is deliberately coarse — it ranks candidate partitionings of
+the CPU-shrunk bundled models, it does not predict wall seconds — but
+every term is monotone in the thing it prices (more compress => fewer
+wire bytes, bigger dp => smaller per-device HBM), which the planted
+tests in tests/test_analysis_passes.py pin.
+
+Manifest-lazy (analysis/import_graph.py LAZY_MODULES): a plain trainer
+never imports this module; tools/plan_search.py and graph_lint --plan
+reach it function-locally.
+"""
+import numpy as np
+
+from .registry import Finding
+
+__all__ = ["RULES", "Plan", "ModelProfile", "CostModel",
+           "int8_wire_ratio", "GLOBAL_BATCH", "SEQ_LEN",
+           "DEFAULT_HBM_BYTES"]
+
+RULES = {
+    "plan-invalid-config": "error",
+    "plan-hbm-over-budget": "error",
+}
+
+#: fixed global batch every candidate plan divides (strong scaling —
+#: this is what makes "bigger dp" buy anything at all); matches the
+#: dp8 shape of the bundled sharding targets (b = 2 * 8, s = 16)
+GLOBAL_BATCH = 16
+SEQ_LEN = 16
+
+#: per-device HBM budget the memory term is checked against. The
+#: bundled tiny models sit ~6 orders of magnitude under it; the planted
+#: tests and the CLI's --hbm-gb shrink it to exercise the rejection.
+DEFAULT_HBM_BYTES = 16 << 30
+
+#: per-message launch overhead charged per collective / edge transfer.
+#: Deliberately small relative to the wire terms even at the bundled
+#: tiny-model scale: byte totals decide the ranking, message counts
+#: only break ties (a latency constant big enough to matter at CI
+#: shapes would invert the compress-wins ordering that holds at real
+#: shapes, where grads are GBs and launches stay microseconds)
+LINK_LATENCY_S = 1e-7
+
+#: live-activation multiple of one layer's boundary activation (attn
+#: scores + mlp intermediates kept for backward, coarse)
+ACT_LIVE_FACTOR = 4
+
+#: the quantized all-reduce's per-block scale granularity
+#: (distributed/compress.py; blocks of 256 share one float32 scale)
+QAR_BLOCK = 256
+
+#: interconnect bytes/s the comm seconds are priced at. Nominal — on
+#: the CPU test harness only the RELATIVE ordering of plans matters,
+#: and every plan is priced with the same constant.
+NOMINAL_NET_BW = 50e9
+
+
+def int8_wire_ratio(d):
+    """Dense-float32 over int8-wire byte ratio for a row of ``d``
+    elements under the row codec (int8 values + one float32 scale per
+    row): ``4 / (1 + 4/d)`` — 3.94x at d=256, 3.76x at d=64. The same
+    ratio distributed/stage.py documents for StageEdge compress=8."""
+    d = int(d)
+    if d <= 0:
+        raise ValueError(f"row length must be positive, got {d}")
+    return 4.0 / (1.0 + 4.0 / d)
+
+
+class Plan:
+    """One candidate partitioning of a bundled model.
+
+    dp/mp/pp are mesh axis sizes (1 = axis absent); ``n_micro`` is the
+    pipeline micro-batch count (pp plans only), ``stage_layers`` the
+    per-stage layer index lists (equal cuts from the enumerator);
+    ``quantized_allreduce`` arms the int8 dp grad reduce,
+    ``edge_compress`` (None | 8) the forward stage-edge codec.
+    ``compress_grad_edge`` exists so a deliberately-bad plan can ask
+    for the thing the grad-edge schema forbids — the verifier rejects
+    it through handoff_schema.validate, never silently.
+    """
+
+    __slots__ = ("dp", "mp", "pp", "n_micro", "stage_layers",
+                 "quantized_allreduce", "edge_compress",
+                 "compress_grad_edge")
+
+    def __init__(self, dp=1, mp=1, pp=1, n_micro=None, stage_layers=None,
+                 quantized_allreduce=False, edge_compress=None,
+                 compress_grad_edge=False):
+        self.dp = int(dp)
+        self.mp = int(mp)
+        self.pp = int(pp)
+        self.n_micro = int(n_micro) if n_micro else (self.pp
+                                                     if self.pp > 1 else 1)
+        self.stage_layers = (None if stage_layers is None
+                             else [list(s) for s in stage_layers])
+        self.quantized_allreduce = bool(quantized_allreduce)
+        self.edge_compress = edge_compress
+        self.compress_grad_edge = bool(compress_grad_edge)
+
+    @property
+    def mesh_axes(self):
+        """(axis_names, axis_sizes) of the mesh this plan runs on."""
+        names, sizes = [], []
+        for n, s in (("dp", self.dp), ("mp", self.mp), ("pp", self.pp)):
+            if s > 1:
+                names.append(n)
+                sizes.append(s)
+        if not names:          # the single-device degenerate plan
+            names, sizes = ["dp"], [1]
+        return tuple(names), tuple(sizes)
+
+    @property
+    def n_devices(self):
+        return self.dp * self.mp * self.pp
+
+    def describe(self):
+        parts = [f"dp{self.dp}"]
+        if self.mp > 1:
+            parts.append(f"mp{self.mp}")
+        if self.pp > 1:
+            parts.append(f"pp{self.pp}x{self.n_micro}mb")
+        if self.quantized_allreduce:
+            parts.append("int8grad")
+        if self.edge_compress:
+            parts.append(f"edge_c{self.edge_compress}")
+        if self.compress_grad_edge:
+            parts.append("gradedge_c8")
+        return "+".join(parts)
+
+    def to_dict(self):
+        return {"dp": self.dp, "mp": self.mp, "pp": self.pp,
+                "n_micro": self.n_micro, "stage_layers": self.stage_layers,
+                "quantized_allreduce": self.quantized_allreduce,
+                "edge_compress": self.edge_compress,
+                "describe": self.describe()}
+
+    def __repr__(self):
+        return f"Plan({self.describe()})"
+
+
+class ModelProfile:
+    """Trace-only cost profile of one bundled tiny model.
+
+    ``trace()`` builds the dp=1 trainer (the same setup the sharding
+    targets use), jit-LOWERS its step — no execution — and reads XLA's
+    ``cost_analysis()`` for total step FLOPs / bytes accessed, scaled
+    linearly from the trace batch to :data:`GLOBAL_BATCH`. Parameter /
+    optimizer-state bytes and the quantized-reduce eligibility set
+    (float params >= 1024 elements, the _resolve_compress rule) come
+    from the constructed trainer's pytrees. The measured entry is
+    recorded into the cost registry under ``site="plan"`` so
+    ``trace.costs.table()`` shows what the planner priced.
+    """
+
+    __slots__ = ("name", "n_layers", "hidden", "seq", "vocab",
+                 "step_flops", "step_bytes", "param_bytes", "opt_bytes",
+                 "qar_eligible_bytes", "supports_pipeline", "supports_mp")
+
+    def __init__(self, name, n_layers, hidden, seq, vocab, step_flops,
+                 step_bytes, param_bytes, opt_bytes, qar_eligible_bytes,
+                 supports_pipeline=False, supports_mp=False):
+        self.name = name
+        self.n_layers = int(n_layers)
+        self.hidden = int(hidden)
+        self.seq = int(seq)
+        self.vocab = int(vocab)
+        self.step_flops = float(step_flops)
+        self.step_bytes = float(step_bytes)
+        self.param_bytes = int(param_bytes)
+        self.opt_bytes = int(opt_bytes)
+        self.qar_eligible_bytes = int(qar_eligible_bytes)
+        self.supports_pipeline = bool(supports_pipeline)
+        self.supports_mp = bool(supports_mp)
+
+    @classmethod
+    def trace(cls, model_name):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.generator import default_generator
+        from ..trace import costs
+        from .sharding_flow import _tiny_train_setup
+
+        trainer, batch, _ = _tiny_train_setup(model_name, dp=1)
+        step = trainer._build(list(batch))
+        lr = jnp.asarray(trainer.optimizer.get_lr(), dtype=jnp.float32)
+        key = default_generator().fold_in(0)
+        lowered = jax.jit(step).lower(trainer.params, trainer.opt_state,
+                                      trainer.buffers, lr, key, *batch)
+        ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):   # some backends: list of dicts
+            merged = {}
+            for d in ca or []:
+                for k, v in d.items():
+                    merged[k] = merged.get(k, 0.0) + float(v)
+            ca = merged
+        trace_batch = int(batch[0].shape[0])
+        scale = GLOBAL_BATCH / float(trace_batch)
+        step_flops = float(ca.get("flops", 0.0)) * scale
+        step_bytes = float(ca.get("bytes accessed", 0.0)) * scale
+        costs.record_manual("plan", f"{model_name}.step",
+                            flops=step_flops, bytes_accessed=step_bytes)
+
+        def _nbytes(tree):
+            return sum(int(np.prod(v.shape)) * v.dtype.itemsize
+                       for v in jax.tree_util.tree_leaves(tree)
+                       if hasattr(v, "shape"))
+
+        params = trainer.params
+        param_bytes = _nbytes(params)
+        opt_bytes = _nbytes(trainer.opt_state)
+        eligible = sum(
+            int(np.prod(v.shape)) * v.dtype.itemsize
+            for v in params.values()
+            if jnp.issubdtype(v.dtype, jnp.floating)
+            and int(np.prod(v.shape)) >= 1024)
+        layer = trainer.layer
+        from ..distributed.split import collect_spmd_specs
+
+        return cls(
+            name=model_name,
+            n_layers=2, hidden=64, seq=SEQ_LEN, vocab=256,
+            step_flops=step_flops, step_bytes=step_bytes,
+            param_bytes=param_bytes, opt_bytes=opt_bytes,
+            qar_eligible_bytes=eligible,
+            supports_pipeline=hasattr(layer, "pipeline_split"),
+            supports_mp=bool(collect_spmd_specs(layer)))
+
+    def to_dict(self):
+        return {s: getattr(self, s) for s in self.__slots__}
+
+
+class CostModel:
+    """Price a :class:`Plan` against a :class:`ModelProfile`.
+
+    All knobs are constructor parameters (no flags — the audit-facing
+    budgets stay explicit): ``hbm_bytes`` the per-device HBM budget,
+    ``peak`` / ``hbm_bw`` / ``net_bw`` the roofline denominators
+    (default: trace.costs.peak_flops() and the nominal bandwidths —
+    on the CPU harness only relative ordering matters).
+    """
+
+    def __init__(self, hbm_bytes=DEFAULT_HBM_BYTES, peak=None,
+                 hbm_bw=None, net_bw=NOMINAL_NET_BW):
+        self.hbm_bytes = int(hbm_bytes)
+        self._peak = peak
+        self._hbm_bw = hbm_bw
+        self.net_bw = float(net_bw)
+
+    @property
+    def peak(self):
+        if self._peak is None:
+            from ..trace import costs
+
+            self._peak = float(costs.peak_flops())
+        return self._peak
+
+    @property
+    def hbm_bw(self):
+        if self._hbm_bw is None:
+            from ..trace import costs
+
+            self._hbm_bw = float(costs.peak_hbm_bandwidth())
+        return self._hbm_bw
+
+    # -- config sanity (the planner's OWN named rejections) -----------------
+    def check_config(self, plan, profile, devices):
+        """plan-invalid-config findings for configurations no analyzer
+        gets a chance to see (nothing traceable exists to analyze)."""
+        out = []
+
+        def bad(msg):
+            out.append(Finding("plan-invalid-config", "error", msg,
+                               where=plan.describe()))
+
+        if plan.dp < 1 or plan.mp < 1 or plan.pp < 1:
+            bad(f"axis sizes must be >= 1, got dp={plan.dp} "
+                f"mp={plan.mp} pp={plan.pp}")
+            return out
+        if GLOBAL_BATCH % plan.dp:
+            bad(f"dp={plan.dp} does not divide the global batch "
+                f"{GLOBAL_BATCH}")
+        if plan.mp > 1 and not profile.supports_mp:
+            bad(f"mp={plan.mp} but model '{profile.name}' declares no "
+                "tensor-parallel param specs "
+                "(distributed/split.collect_spmd_specs is empty) — the "
+                "mp axis would replicate every parameter")
+        if plan.pp > 1:
+            if not profile.supports_pipeline:
+                bad(f"pp={plan.pp} but model '{profile.name}' has no "
+                    "pipeline_split()")
+            if profile.n_layers % plan.pp:
+                bad(f"pp={plan.pp} does not divide the {profile.n_layers}"
+                    "-layer body into equal stages")
+            if GLOBAL_BATCH % plan.n_micro:
+                bad(f"n_micro={plan.n_micro} does not divide the global "
+                    f"batch {GLOBAL_BATCH}")
+            if plan.n_micro < plan.pp:
+                bad(f"n_micro={plan.n_micro} < pp={plan.pp}: the "
+                    "schedule cannot fill the pipeline")
+        if plan.pp == 1 and (plan.edge_compress or plan.compress_grad_edge):
+            bad("edge compression without a pipeline axis — there is no "
+                "stage edge to compress")
+        if plan.quantized_allreduce and plan.dp == 1:
+            bad("quantized_allreduce with dp=1 — there is no gradient "
+                "reduce to compress")
+        if plan.quantized_allreduce and plan.mp > 1:
+            bad("quantized_allreduce does not compose with tensor-"
+                "parallel extra_param_specs (params must be replicated "
+                "over dp — distributed/spmd.py _resolve_compress)")
+        return out
+
+    # -- memory -------------------------------------------------------------
+    def memory_bytes(self, plan, profile):
+        """Per-device HBM bytes, as (total, breakdown dict)."""
+        state = (profile.param_bytes + profile.opt_bytes) / (
+            plan.mp * plan.pp)
+        boundary = (GLOBAL_BATCH / plan.dp) * profile.seq * \
+            profile.hidden * 4
+        if plan.pp > 1:
+            mb_boundary = (GLOBAL_BATCH / plan.n_micro) * profile.seq * \
+                profile.hidden * 4
+            inflight = min(plan.pp, plan.n_micro)
+            act = (profile.n_layers / plan.pp) * ACT_LIVE_FACTOR * \
+                mb_boundary * inflight
+        else:
+            act = profile.n_layers * ACT_LIVE_FACTOR * boundary / plan.mp
+        residual = profile.qar_eligible_bytes \
+            if plan.quantized_allreduce else 0
+        total = state + act + residual
+        return total, {"state_bytes": state, "activation_bytes": act,
+                       "qar_residual_bytes": residual}
+
+    def check_memory(self, plan, profile):
+        total, brk = self.memory_bytes(plan, profile)
+        if total <= self.hbm_bytes:
+            return []
+        detail = ", ".join(f"{k}={v / (1 << 20):.1f}MiB"
+                           for k, v in brk.items() if v)
+        return [Finding(
+            "plan-hbm-over-budget", "error",
+            f"per-device HBM {total / (1 << 20):.1f} MiB exceeds the "
+            f"{self.hbm_bytes / (1 << 20):.0f} MiB budget ({detail}) — "
+            "raise dp/pp or shrink the per-device batch",
+            where=plan.describe())]
+
+    # -- communication ------------------------------------------------------
+    def comm_terms(self, plan, profile, flow=None):
+        """Per-device communication bytes by source, plus a message
+        count for the latency term. ``flow`` is a
+        sharding_flow.flow_summary dict of the plan's traced program
+        class; when it carries measured collective bytes (the shard_map
+        paths) those REPLACE the analytic dp-sync term."""
+        terms = {"dp_sync_bytes": 0.0, "mp_sync_bytes": 0.0,
+                 "edge_wire_bytes": 0.0, "measured": False}
+        messages = 0
+
+        measured = float((flow or {}).get("collective_bytes_total", 0.0))
+        if plan.pp == 1 and measured > 0:
+            # explicit collectives in the traced program (quantized
+            # shard_map reduce): the analyzer's numbers win
+            terms["dp_sync_bytes"] = measured
+            terms["measured"] = True
+            messages += sum((flow.get("collective_counts") or {}).values())
+        elif plan.dp > 1:
+            ring = 2.0 * (plan.dp - 1) / plan.dp
+            grad = profile.param_bytes
+            if plan.quantized_allreduce:
+                elig = profile.qar_eligible_bytes
+                wire = elig / int8_wire_ratio(QAR_BLOCK) + (grad - elig)
+            else:
+                wire = grad
+            terms["dp_sync_bytes"] = ring * wire
+            messages += 3 if plan.quantized_allreduce else 1
+
+        if plan.mp > 1:
+            act_dev = (GLOBAL_BATCH / plan.dp) * profile.seq * \
+                profile.hidden * 4
+            terms["mp_sync_bytes"] = 4 * profile.n_layers * \
+                2.0 * (plan.mp - 1) / plan.mp * act_dev
+            messages += 4 * profile.n_layers
+
+        if plan.pp > 1:
+            from . import handoff_schema
+
+            mb = GLOBAL_BATCH // plan.n_micro
+            dims = {"mb": mb, "t": profile.seq, "d": profile.hidden}
+            fwd = handoff_schema.wire_bytes(
+                "mpmd_activation", dims, compress=plan.edge_compress)
+            bwd = handoff_schema.wire_bytes("mpmd_grad", dims)
+            boundaries = plan.pp - 1
+            terms["edge_wire_bytes"] = boundaries * plan.n_micro * \
+                (fwd + bwd)
+            messages += 2 * boundaries * plan.n_micro
+            # the dp grad sync still applies inside each stage when the
+            # plan carries both axes (not enumerated today, priced for
+            # completeness) — pure-pp plans have per-stage params, no sync
+
+        return terms, messages
+
+    # -- the score ----------------------------------------------------------
+    def score(self, plan, profile, flow=None):
+        """Cost breakdown dict for one plan; ``total_s`` is the rank
+        key (smaller wins). Never raises on a verified plan."""
+        shards = plan.dp * plan.mp * plan.pp
+        flops_dev = profile.step_flops / shards
+        bytes_dev = profile.step_bytes / shards
+        compute_s = max(flops_dev / self.peak, bytes_dev / self.hbm_bw)
+        bubble = 1.0
+        if plan.pp > 1:
+            bubble = (plan.pp - 1 + plan.n_micro) / float(plan.n_micro)
+        compute_s *= bubble
+
+        terms, messages = self.comm_terms(plan, profile, flow=flow)
+        comm_bytes = (terms["dp_sync_bytes"] + terms["mp_sync_bytes"] +
+                      terms["edge_wire_bytes"])
+        comm_s = comm_bytes / self.net_bw + messages * LINK_LATENCY_S
+
+        mem, mem_brk = self.memory_bytes(plan, profile)
+        out = {"plan": plan.to_dict(), "compute_s": compute_s,
+               "bubble": bubble, "comm_s": comm_s,
+               "comm_bytes": comm_bytes, "messages": messages,
+               "mem_bytes_per_device": mem,
+               "total_s": compute_s + comm_s,
+               "terms": dict(terms, **mem_brk)}
+        return out

@@ -215,6 +215,80 @@ def validate(schema, values, dims=None, dtypes=None):
     return binds
 
 
+_DECL_CACHE = {}
+
+
+def _declaration(edge):
+    """The AST-extracted declaration for an EDGES name (cached — the
+    plan-search cost model calls this per candidate)."""
+    if edge not in _DECL_CACHE:
+        if edge not in EDGES:
+            raise ValueError(f"unknown edge {edge!r}; "
+                             f"declared edges: {sorted(EDGES)}")
+        _DECL_CACHE[edge] = extract_declaration(*EDGES[edge])
+    return _DECL_CACHE[edge]
+
+
+def wire_bytes(edge, dims, compress=None, dtypes=None):
+    """Bytes one payload crossing `edge` puts on the wire.
+
+    `edge` is an :data:`EDGES` name (or a schema dict); `dims` binds
+    every symbolic dim. ``compress=None`` prices the dense payload at
+    the declared dtype (``$sym`` dtypes resolve via `dtypes`, default
+    float32 — the repo's training activation dtype); ``compress=8``
+    prices ``quantizable`` leaves under the row codec — int8 values
+    plus one float32 scale per row of the minor dim, the
+    ``4 / (1 + 4/D)`` wire ratio over float32 that
+    distributed/stage.py's StageEdge measures. Non-quantizable leaves
+    stay dense either way (the schema, not the caller, decides what may
+    shrink). Raises ValueError on unbound dims, wildcard shapes, or
+    opaque leaves — a wire-byte count needs a concrete payload."""
+    if compress not in (None, 8):
+        raise ValueError(f"compress must be None or 8, got {compress!r}")
+    schema = _declaration(edge) if isinstance(edge, str) else edge
+    name = schema.get("edge", "?")
+    binds = dict(dims or {})
+    total = 0
+    for leaf, spec in _leaves(schema["payload"]):
+        if "malformed" in spec:
+            raise ValueError(
+                f"[{name}] {leaf}: malformed leaf spec "
+                f"{spec['malformed']!r}")
+        if spec.get("kind") == "opaque" or "shape" not in spec:
+            raise ValueError(
+                f"[{name}] {leaf}: opaque/shapeless leaf has no "
+                "statically computable wire size")
+        shape = []
+        for i, d in enumerate(spec["shape"]):
+            if d == "...":
+                raise ValueError(
+                    f"[{name}] {leaf}: wildcard dim[{i}] — bind a "
+                    "concrete payload shape to price it")
+            if isinstance(d, int):
+                shape.append(d)
+            elif str(d) in binds:
+                shape.append(int(binds[str(d)]))
+            else:
+                raise ValueError(
+                    f"[{name}] {leaf}: unbound dim '{d}' — pass it in "
+                    "dims=")
+        n = 1
+        for d in shape:
+            n *= d
+        declared = spec.get("dtype", "float32")
+        if isinstance(declared, str) and declared.startswith("$"):
+            declared = (dtypes or {}).get(declared[1:], "float32")
+        import numpy as _np
+
+        itemsize = _np.dtype(str(declared)).itemsize
+        if compress and spec.get("quantizable"):
+            rows = n // shape[-1] if shape else 0
+            total += n * 1 + rows * 4
+        else:
+            total += n * itemsize
+    return total
+
+
 # ---------------------------------------------------------------------------
 # static extraction + fingerprinting (the audit half)
 # ---------------------------------------------------------------------------

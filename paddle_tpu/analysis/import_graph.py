@@ -50,6 +50,8 @@ LAZY_MODULES = (
     "paddle_tpu.serving.router",             # multi-engine tier (ISSUE 6)
     "paddle_tpu.serving.disagg",             # prefill/decode split (ISSUE 6)
     "paddle_tpu.distributed.stage",          # MPMD stage runtime (ISSUE 15)
+    "paddle_tpu.analysis.cost_model",        # plan-search pricing (ISSUE 16)
+    "paddle_tpu.analysis.plan_search",       # plan enumerator (ISSUE 16)
 )
 
 #: what a plain trainer/engine process imports (the roots of the closure

@@ -147,6 +147,20 @@ def audit_entry(entry, budget=VMEM_BUDGET_BYTES):
     return out
 
 
+def audit_tile(name, block, dtype="float32", budget=VMEM_BUDGET_BYTES,
+               stream=True):
+    """Findings for one synthetic streamed buffer tile — the planner's
+    per-stage activation working set (analysis/plan_search.py) priced
+    with the SAME rules as registered kernels: streamed blocks are
+    double-buffered, the budget is the 16 MiB per-core VMEM. Alignment
+    findings ride along at their usual severities; only the budget rule
+    is an error."""
+    entry = {"kernel": str(name), "matmul": False, "grid": {},
+             "buffers": [{"name": "tile", "block": tuple(block),
+                          "dtype": str(dtype), "stream": bool(stream)}]}
+    return audit_entry(entry, budget=budget)
+
+
 def collect_manifest():
     """Every registered kernel family's manifest entries. Imports the
     ops modules (jax import cost only — nothing compiles or runs)."""

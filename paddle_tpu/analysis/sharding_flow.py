@@ -925,6 +925,98 @@ def _target_disagg():
     return closed, dict(mesh=None)
 
 
+def flow_summary(closed, mesh=None, large_threshold=TARGET_THRESHOLD):
+    """Machine-readable communication summary of one traced program —
+    the dict counterpart of the finding-producing passes, consumed by
+    the plan-search cost model (analysis/cost_model.py).
+
+    Collective payload bytes are summed per family with the per-device
+    ring wire factor applied — ``2 (n-1)/n`` for reduce (psum and kin),
+    ``(n-1)/n`` for exchange (all_gather/all_to_all/scatter), ``1`` for
+    permute — where ``n`` is the product of the collective's axis sizes
+    resolved against the enclosing shard_map's mesh (falling back to
+    `mesh`); unresolvable axes get factor 1. Resharding-churn bytes sum
+    the payloads of every :class:`_SpecFlow` churn event (a layout
+    change re-materializes the value once on the wire). Trace-only,
+    like everything else here."""
+    jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+    from .jaxpr_utils import is_literal
+
+    fam_bytes = {"reduce": 0.0, "exchange": 0.0, "permute": 0.0}
+    fam_counts = {"reduce": 0, "exchange": 0, "permute": 0}
+    for eqn, path, env, sm_mesh in _iter_with_axes(jaxpr):
+        p = eqn.primitive.name
+        if p not in COLLECTIVE_PRIMS:
+            continue
+        fam = ("reduce" if p in REDUCE_PRIMS
+               else "exchange" if p in EXCHANGE_PRIMS else "permute")
+        payload = sum(
+            _size(v) * getattr(getattr(v.aval, "dtype", None),
+                               "itemsize", 4)
+            for v in eqn.invars if not is_literal(v))
+        n = 1
+        for a in _axes_of(eqn):
+            sz = _axis_size(a, sm_mesh, mesh)
+            if sz:
+                n *= int(sz)
+        if fam == "reduce":
+            factor = 2.0 * (n - 1) / n if n > 1 else 0.0
+        elif fam == "exchange":
+            factor = (n - 1) / n if n > 1 else 0.0
+        else:
+            factor = 1.0
+        fam_bytes[fam] += payload * factor
+        fam_counts[fam] += 1
+    flow = _SpecFlow(large_threshold)
+    flow.run(jaxpr)
+    churn_bytes = sum(
+        _size(var) * getattr(getattr(var.aval, "dtype", None),
+                             "itemsize", 4)
+        for _, _, _, var in flow.churn)
+    return {
+        "collective_bytes": fam_bytes,
+        "collective_counts": fam_counts,
+        "collective_bytes_total": sum(fam_bytes.values()),
+        "resharding_churn_bytes": churn_bytes,
+        "resharding_events": len(flow.churn),
+    }
+
+
+def _target_builders():
+    """target name -> () -> (ClosedJaxpr, run_passes kwargs), for every
+    jaxpr-producing sharding target (serving builds its own report)."""
+    return {
+        "gpt_train": lambda: _target_train("gpt"),
+        "bert_train": lambda: _target_train("bert"),
+        "ernie_train": lambda: _target_train("ernie"),
+        "dp8_quantized": _target_dp8_quantized,
+        "pipeline": _target_pipeline,
+        "disagg": _target_disagg,
+        "mpmd_train": _target_mpmd,
+    }
+
+
+def sharding_summaries(targets=None, large_threshold=TARGET_THRESHOLD):
+    """{target: flow_summary dict} over the bundled distributed
+    programs — per-program resharding-churn bytes and collective byte
+    totals as plain data (the findings stay with sharding_reports).
+    `targets` picks a subset; ``serving`` has no single jaxpr and is
+    excluded from the default set."""
+    builders = _target_builders()
+    picked = tuple(targets) if targets is not None \
+        else tuple(builders)
+    unknown = [t for t in picked if t not in builders]
+    if unknown:
+        raise ValueError(f"unknown sharding summary target(s) {unknown}; "
+                         f"choose from {sorted(builders)}")
+    out = {}
+    for name in picked:
+        closed, kw = builders[name]()
+        out[name] = flow_summary(closed, mesh=kw.get("mesh"),
+                                 large_threshold=large_threshold)
+    return out
+
+
 def sharding_reports(targets=None, large_threshold=TARGET_THRESHOLD):
     """{target: AnalysisReport} for the bundled distributed programs,
     traced under their real meshes and run through the full pass battery
@@ -937,15 +1029,7 @@ def sharding_reports(targets=None, large_threshold=TARGET_THRESHOLD):
     if unknown:
         raise ValueError(f"unknown sharding target(s) {unknown}; "
                          f"choose from {SHARDING_TARGETS}")
-    builders = {
-        "gpt_train": lambda: _target_train("gpt"),
-        "bert_train": lambda: _target_train("bert"),
-        "ernie_train": lambda: _target_train("ernie"),
-        "dp8_quantized": _target_dp8_quantized,
-        "pipeline": _target_pipeline,
-        "disagg": _target_disagg,
-        "mpmd_train": _target_mpmd,
-    }
+    builders = _target_builders()
     reports = {}
     for name in picked:
         if name == "serving":

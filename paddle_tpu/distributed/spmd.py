@@ -1948,3 +1948,44 @@ def restore_train_state(state, p_shardings, s_shardings, optimizer):
     if state.get("lr_scheduler") and hasattr(lr, "set_state_dict"):
         lr.set_state_dict(state["lr_scheduler"])
     return params, opt_state
+
+
+def spmd_trainer_from_plan(config, layer, optimizer, loss_fn=None):
+    """Realize a plan-search emission (analysis/plan_search.emit,
+    ``kind="spmd"``) as a live :class:`SpmdTrainer`.
+
+    The config is plain data — this function imports nothing from the
+    analysis layer, so the plain-trainer closure stays planner-free.
+    ``config["flags"]`` must already be SET: trainer construction
+    consumes them (the _resolve_compress contract), so a mismatch here
+    would silently build a different trainer than the plan scored —
+    instead it raises naming the flag."""
+    from .. import flags as _flags
+    from .mesh import build_mesh
+    from .split import collect_spmd_specs
+
+    if config.get("kind") != "spmd":
+        raise ValueError(
+            f"config kind {config.get('kind')!r} is not 'spmd' — "
+            "stage_graph configs realize via "
+            "distributed/stage.py pipeline_trainer_from_plan")
+    for name, want in (config.get("flags") or {}).items():
+        got = bool(_flags.get_flag(name, False))
+        if got != bool(want):
+            raise ValueError(
+                f"plan config wants FLAGS_{name}={want} but the process "
+                f"has {got} — set the flag BEFORE realizing (trainer "
+                "construction consumes it)")
+    mesh_cfg = config["mesh"]
+    import jax
+
+    shape = tuple(int(s) for s in mesh_cfg["shape"])
+    n = 1
+    for s in shape:
+        n *= s
+    mesh = build_mesh(shape, tuple(mesh_cfg["axes"]),
+                      devices=jax.devices()[:n])
+    extra = collect_spmd_specs(layer) \
+        if config.get("spmd", {}).get("tensor_parallel") else None
+    return SpmdTrainer(layer, optimizer, loss_fn=loss_fn, mesh=mesh,
+                       extra_param_specs=extra or None)

@@ -682,3 +682,50 @@ class MpmdPipelineRunner:
                 "stage_devices": [len(m.devices.ravel())
                                   for m in self.stage_meshes],
                 "edges": self.graph.edge_stats()}
+
+
+def pipeline_trainer_from_plan(config, model, optimizer):
+    """Realize a plan-search emission (analysis/plan_search.emit,
+    ``kind="stage_graph"``) as a FLAGS_mpmd :class:`PipelineTrainer`
+    whose runner builds this module's typed-edge StageGraph.
+
+    FLAGS_mpmd must already be set (the trainer consumes it at
+    construction); ``model`` must expose ``pipeline_split``. The stage
+    cut is the config's per-stage layer lists — equal cuts, which is
+    what ``pipeline_split(pp)`` produces; a config whose cuts disagree
+    with an equal split is rejected loudly rather than silently
+    re-cut."""
+    import jax
+
+    from .. import flags as _flags
+    from .mesh import build_mesh
+    from .pipeline import PipelineTrainer
+
+    if config.get("kind") != "stage_graph":
+        raise ValueError(
+            f"config kind {config.get('kind')!r} is not 'stage_graph'")
+    if not _flags.get_flag("mpmd", False):
+        raise ValueError(
+            "plan config arms the MPMD stage runtime — set FLAGS_mpmd "
+            "before realizing (PipelineTrainer consumes it at "
+            "construction)")
+    if not hasattr(model, "pipeline_split"):
+        raise ValueError(
+            f"{type(model).__name__} has no pipeline_split(); the plan "
+            "search only emits stage_graph configs for models that do")
+    pipe = config["pipeline"]
+    cuts = pipe.get("stage_layers") or []
+    pp = len(cuts) or int(config["mesh"]["shape"][
+        config["mesh"]["axes"].index("pp")])
+    sizes = {len(c) for c in cuts} if cuts else set()
+    if len(sizes) > 1:
+        raise ValueError(
+            f"unequal stage cuts {cuts}: pipeline_split(pp) produces "
+            "equal stages — re-emit the plan")
+    pre, stages, post = model.pipeline_split(pp)
+    mesh = build_mesh((pp,), ("pp",), devices=jax.devices()[:pp])
+    return PipelineTrainer(
+        pre, stages, post, optimizer, mesh=mesh,
+        n_micro=int(pipe["n_micro"]),
+        schedule_mode=pipe.get("schedule", "1F1B"),
+        compress=pipe.get("compress"))

@@ -33,7 +33,7 @@ from .. import flags as _flags
 from .. import monitor as _monitor
 
 __all__ = ["record", "record_manual", "get", "table", "reset",
-           "sample_device_memory", "peak_flops"]
+           "sample_device_memory", "peak_flops", "peak_hbm_bandwidth"]
 
 _flags.define_flag(
     "device_peak_flops", 0.0,
@@ -63,6 +63,20 @@ _PEAK_FLOPS_BY_KIND = (
     ("v2", 45e12),
 )
 _NOMINAL_PEAK = 1e12
+
+#: HBM bytes/s per chip by device-kind substring (approximate datasheet
+#: numbers — the bandwidth side of the roofline the plan-search cost
+#: model prices against); same matching rules as the FLOPs table
+_PEAK_HBM_BW_BY_KIND = (
+    ("v6e", 1.6e12),
+    ("v5p", 2.8e12),
+    ("v5e", 0.8e12),
+    ("v5 lite", 0.8e12),
+    ("v4", 1.2e12),
+    ("v3", 0.9e12),
+    ("v2", 0.7e12),
+)
+_NOMINAL_HBM_BW = 1e11
 
 
 def _gauges():
@@ -221,3 +235,19 @@ def peak_flops(device=None):
         if needle in kind:
             return flops
     return _NOMINAL_PEAK
+
+
+def peak_hbm_bandwidth(device=None):
+    """Peak HBM bytes/s from the device-kind table, else a nominal
+    1e11 — the bandwidth denominator of the roofline
+    (analysis/cost_model.py prices ``max(flops/peak, bytes/bw)`` with
+    it; like :func:`peak_flops`, absolute values only mean something on
+    known hardware)."""
+    import jax
+
+    d = device or jax.devices()[0]
+    kind = str(getattr(d, "device_kind", d.platform)).lower()
+    for needle, bw in _PEAK_HBM_BW_BY_KIND:
+        if needle in kind:
+            return bw
+    return _NOMINAL_HBM_BW

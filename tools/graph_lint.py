@@ -13,6 +13,16 @@
                                                       # distributed programs
                                                       # under their meshes
     python tools/graph_lint.py --sharding-target dp8_quantized   # one
+    python tools/graph_lint.py --plan                 # ISSUE 16: auto-
+                                                      # parallelism plan
+                                                      # search over the
+                                                      # bundled models
+    python tools/graph_lint.py --tier1                # fast subset (models
+                                                      # + source + contracts
+                                                      # — no tracing-heavy
+                                                      # sharding/plan/serving
+                                                      # batteries)
+    python tools/graph_lint.py --all --timings        # per-target wall secs
     python tools/graph_lint.py --list                 # registered passes
     python tools/graph_lint.py --list-rules           # rules + allow markers
 
@@ -54,33 +64,57 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def build_report(models=(), serving=False, source=False, training=False,
-                 contracts=False, sharding=False, sharding_targets=None):
-    """Run the requested targets; returns the shared-format report dict."""
+                 contracts=False, sharding=False, sharding_targets=None,
+                 plan=False, plan_models=None):
+    """Run the requested targets; returns the shared-format report dict.
+    A ``timings`` key maps each target (group key ``contract``/
+    ``sharding`` for the multi-target batteries, which run as one call)
+    to its wall seconds — ``--timings`` prints it and the plan gate
+    (tests/test_plan_gate.py) budgets the plan battery against it."""
+    import time
+
     from paddle_tpu.analysis import registered_passes
     from paddle_tpu.analysis.registry import AnalysisReport
     from paddle_tpu.analysis.source_lint import RULES, lint_path
     from paddle_tpu.analysis.targets import (analyze_model,
                                              analyze_serving_decode)
 
-    targets = {}
+    targets, timings = {}, {}
+
+    def timed(key, fn):
+        t0 = time.perf_counter()
+        out = fn()
+        timings[key] = round(time.perf_counter() - t0, 3)
+        return out
+
     for name in models:
-        targets[name] = analyze_model(name, training=training)
+        targets[name] = timed(
+            name, lambda n=name: analyze_model(n, training=training))
     if serving:
-        targets["serving"] = analyze_serving_decode()
+        targets["serving"] = timed("serving", analyze_serving_decode)
     if source:
         rep = AnalysisReport(name="source_lint")
-        rep.extend(lint_path())
+        rep.extend(timed("source_lint", lint_path))
         targets["source_lint"] = rep.sort()
     if contracts:
         from paddle_tpu.analysis import contract_reports
 
-        for name, rep in contract_reports().items():
+        for name, rep in timed("contract", contract_reports).items():
             targets[f"contract_{name}"] = rep
     if sharding or sharding_targets:
         from paddle_tpu.analysis import sharding_reports
 
-        for name, rep in sharding_reports(targets=sharding_targets).items():
+        for name, rep in timed(
+                "sharding",
+                lambda: sharding_reports(targets=sharding_targets)).items():
             targets[f"sharding_{name}"] = rep
+    if plan:
+        from paddle_tpu.analysis import plan_search
+
+        for name in (plan_models or ("gpt", "bert")):
+            targets[f"plan_{name}"] = timed(
+                f"plan_{name}",
+                lambda n=name: plan_search.search(n).to_report())
 
     totals = {"error": 0, "warning": 0, "info": 0}
     for rep in targets.values():
@@ -92,6 +126,7 @@ def build_report(models=(), serving=False, source=False, training=False,
         "rules": sorted(RULES),
         "targets": {n: r.to_dict() for n, r in targets.items()},
         "totals": totals,
+        "timings": timings,
     }
 
 
@@ -120,6 +155,16 @@ def main(argv=None):
                     dest="sharding_targets", metavar="NAME",
                     help="one sharding target (repeatable; implies "
                          "--sharding for the picked subset)")
+    ap.add_argument("--plan", action="store_true",
+                    help="run the auto-parallelism plan search over the "
+                         "bundled models (analysis/plan_search.py; full "
+                         "surface: tools/plan_search.py)")
+    ap.add_argument("--tier1", action="store_true",
+                    help="the fast subset (models + source + contracts) "
+                         "— skips the tracing-heavy serving/sharding/"
+                         "plan batteries")
+    ap.add_argument("--timings", action="store_true",
+                    help="print per-target wall seconds after the report")
     ap.add_argument("--train", action="store_true",
                     help="trace models in training mode (dropout on)")
     ap.add_argument("--json", action="store_true", dest="as_json",
@@ -151,20 +196,23 @@ def main(argv=None):
 
     models = list(args.model)
     serving, source, contracts = args.serving, args.source, args.contracts
-    sharding = args.sharding
+    sharding, plan = args.sharding, args.plan
     sharding_targets = list(args.sharding_targets) or None
     if args.all:
         models = list(MODEL_TARGETS)
-        serving = source = contracts = sharding = True
+        serving = source = contracts = sharding = plan = True
+    if args.tier1:
+        models = list(MODEL_TARGETS)
+        source = contracts = True
     if not models and not serving and not source and not contracts \
-            and not sharding and not sharding_targets:
+            and not sharding and not sharding_targets and not plan:
         ap.error("pick a target: --model NAME, --serving, --source, "
-                 "--contracts, --sharding or --all")
+                 "--contracts, --sharding, --plan, --tier1 or --all")
 
     report = build_report(models=models, serving=serving, source=source,
                           training=args.train, contracts=contracts,
                           sharding=sharding,
-                          sharding_targets=sharding_targets)
+                          sharding_targets=sharding_targets, plan=plan)
     if args.as_json:
         print(json.dumps(report, indent=1))
     else:
@@ -180,6 +228,11 @@ def main(argv=None):
         print(f"total: {t['error']} error(s), {t['warning']} warning(s), "
               f"{t['info']} info across {len(report['targets'])} target(s); "
               f"{len(report['passes'])} passes registered")
+    if args.timings and not args.as_json:
+        print("timings:")
+        for key, secs in sorted(report["timings"].items(),
+                                key=lambda kv: -kv[1]):
+            print(f"  {key:<24} {secs:7.3f}s")
     return 1 if report["totals"]["error"] else 0
 
 

@@ -1,0 +1,178 @@
+"""Auto-parallelism plan search CLI: rank dp/mp/pp/stage partitionings.
+
+    python tools/plan_search.py --model gpt             # rank plans, human
+    python tools/plan_search.py --model gpt --top 5     # only the top 5
+    python tools/plan_search.py --model gpt --explain   # per-plan cost
+                                                        # breakdown + every
+                                                        # rejection with the
+                                                        # analyzer pass that
+                                                        # killed it
+    python tools/plan_search.py --model gpt --model bert --json
+    python tools/plan_search.py --model gpt --emit      # winning plan as a
+                                                        # ready-to-run config
+    python tools/plan_search.py --model gpt --hbm-gb 0.001   # shrink the
+                                                        # budget: every plan
+                                                        # rejected -> exit 1
+
+The static cost model (analysis/cost_model.py) prices compute from the
+cost registry's traced flops/bytes, communication from the sharding-flow
+analyzer's measured collective bytes plus HANDOFF_SCHEMA-derived edge
+wire bytes, and memory against per-device HBM and the Pallas VMEM
+budgets; the enumerator (analysis/plan_search.py) rejects invalid plans
+through the EXISTING analyzers — a rejection always names the failing
+pass, it never crashes. Nothing executes on devices: trace-only.
+
+Report format: the tools/graph_lint.py schema ({"tool", "passes",
+"rules", "targets": {name: {"name","counts","findings"}}, "totals"}) —
+``graph_lint --plan`` folds the same targets into its battery. Exit
+code 1 when any error-severity finding exists, i.e. when a model's
+search space contains ZERO valid plans (``plan-space-empty``).
+"""
+import argparse
+import json
+import os
+import sys
+
+# plan verification traces shard_map programs against the deployment
+# mesh: give the CPU backend its virtual devices BEFORE jax initializes
+# (the tests/conftest.py mesh). APPEND to any user-set XLA_FLAGS — a
+# plain setdefault would silently collapse the search to 1 device
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _explain_lines(result, top=None):
+    """Human cost breakdown: every ranked plan's terms, then every
+    rejected plan with the analyzer pass(es) that killed it."""
+    lines = []
+    ranked = result.ranked[:top] if top else result.ranked
+    for i, (plan, score) in enumerate(ranked):
+        t = score["terms"]
+        lines.append(
+            f"  #{i + 1} {plan.describe()}: total "
+            f"{score['total_s'] * 1e6:.2f}us")
+        lines.append(
+            f"      compute {score['compute_s'] * 1e6:.2f}us "
+            f"(bubble x{score['bubble']:.2f}), comm "
+            f"{score['comm_s'] * 1e6:.2f}us "
+            f"({score['comm_bytes'] / 1024:.1f} KiB over "
+            f"{score['messages']} message(s), "
+            f"{'measured' if t.get('measured') else 'analytic'})")
+        lines.append(
+            f"      bytes: dp_sync {t['dp_sync_bytes'] / 1024:.1f} KiB, "
+            f"mp_sync {t['mp_sync_bytes'] / 1024:.1f} KiB, "
+            f"edge_wire {t['edge_wire_bytes'] / 1024:.1f} KiB; "
+            f"hbm/device {score['mem_bytes_per_device'] / (1 << 20):.2f} "
+            f"MiB (state {t['state_bytes'] / (1 << 20):.2f}, act "
+            f"{t['activation_bytes'] / (1 << 20):.2f})")
+    for plan, errs in result.rejected:
+        passes = sorted({e.pass_name for e in errs})
+        lines.append(f"  -- {plan.describe()}: REJECTED by {passes}")
+        for e in errs:
+            lines.append(f"      [{e.pass_name}] {e.message}")
+    return lines
+
+
+def build_report(models, devices=None, hbm_bytes=None, top=None):
+    """Run the search per model; returns (graph_lint-schema report,
+    {model: SearchResult})."""
+    from paddle_tpu.analysis import registered_passes
+    from paddle_tpu.analysis import cost_model, plan_search
+
+    results, targets = {}, {}
+    for model in models:
+        res = plan_search.search(model, devices=devices,
+                                 hbm_bytes=hbm_bytes)
+        results[model] = res
+        targets[f"plan_{model}"] = res.to_report(top=top)
+    totals = {"error": 0, "warning": 0, "info": 0}
+    for rep in targets.values():
+        for sev, n in rep.counts().items():
+            totals[sev] = totals.get(sev, 0) + n
+    rules = dict(cost_model.RULES)
+    rules.update(plan_search.RULES)
+    return {
+        "tool": "plan_search",
+        "passes": registered_passes(),
+        "rules": sorted(rules),
+        "targets": {n: r.to_dict() for n, r in targets.items()},
+        "totals": totals,
+    }, results
+
+
+def main(argv=None):
+    from paddle_tpu.analysis.plan_search import PLAN_MODELS
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", choices=PLAN_MODELS, action="append",
+                    default=[],
+                    help="bundled model to plan for (repeatable; "
+                         "default gpt)")
+    ap.add_argument("--top", type=int, default=None, metavar="K",
+                    help="report only the K best-ranked plans")
+    ap.add_argument("--devices", type=int, default=None, metavar="N",
+                    help="plan for an N-device pool (default: the "
+                         "visible jax device count)")
+    ap.add_argument("--hbm-gb", type=float, default=None, dest="hbm_gb",
+                    metavar="GB",
+                    help="per-device HBM budget in GiB (default 16)")
+    ap.add_argument("--explain", action="store_true",
+                    help="per-plan cost-term breakdown + every rejected "
+                         "plan with the analyzer pass that rejected it")
+    ap.add_argument("--emit", action="store_true",
+                    help="print each model's winning plan as the "
+                         "ready-to-run trainer config JSON")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the machine-readable report (adds a "
+                         "'search' key with ranked scores + rejections)")
+    args = ap.parse_args(argv)
+
+    models = list(args.model) or ["gpt"]
+    hbm_bytes = int(args.hbm_gb * (1 << 30)) if args.hbm_gb else None
+    report, results = build_report(models, devices=args.devices,
+                                   hbm_bytes=hbm_bytes, top=args.top)
+
+    if args.as_json:
+        report["search"] = {m: r.to_dict(top=args.top)
+                            for m, r in results.items()}
+        if args.emit:
+            from paddle_tpu.analysis.plan_search import emit
+
+            report["configs"] = {
+                m: emit(r.best[0], r.profile)
+                for m, r in results.items() if r.best}
+        print(json.dumps(report, indent=1))
+    else:
+        for model, res in results.items():
+            print(f"plan_{model}: {len(res.ranked)} valid plan(s), "
+                  f"{len(res.rejected)} rejected")
+            if args.explain:
+                for line in _explain_lines(res, top=args.top):
+                    print(line)
+            else:
+                rep = report["targets"][f"plan_{model}"]
+                for f in rep["findings"]:
+                    print(f"  [{f['severity']}] {f['pass']}: "
+                          f"{f['message']}")
+            if args.emit and res.best:
+                from paddle_tpu.analysis.plan_search import emit
+
+                print(f"  config: "
+                      f"{json.dumps(emit(res.best[0], res.profile))}")
+        t = report["totals"]
+        print(f"total: {t['error']} error(s), {t['warning']} warning(s), "
+              f"{t['info']} info across {len(report['targets'])} "
+              "target(s)")
+    return 1 if report["totals"]["error"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
