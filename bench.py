@@ -104,18 +104,31 @@ def _bank_load(path):
 
 def _bank(leg, line):
     """Persist one completed leg NOW (append + flush + fsync): a later
-    wedge, crash, or kill cannot erase it."""
+    wedge, crash, or kill cannot erase it. With FLAGS_perf_ledger armed
+    the leg also lands as one perf-ledger row (site=bench/<leg>), so
+    retried BENCH rounds auto-accumulate cross-run calibration data."""
     _BANKED[leg] = line
-    if not _BANKED_PATH:
-        return
+    if _BANKED_PATH:
+        try:
+            with open(_BANKED_PATH, "a") as f:
+                f.write(json.dumps({"ts": round(time.time(), 3),
+                                    "leg": leg, "line": line}) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as e:
+            print(f"  banking leg {leg!r} failed ({e})", file=sys.stderr)
     try:
-        with open(_BANKED_PATH, "a") as f:
-            f.write(json.dumps({"ts": round(time.time(), 3), "leg": leg,
-                                "line": line}) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
-    except OSError as e:
-        print(f"  banking leg {leg!r} failed ({e})", file=sys.stderr)
+        from paddle_tpu import flags
+
+        if flags.get_flag("perf_ledger", False) \
+                and isinstance(line, dict):
+            from paddle_tpu.monitor import perfledger
+
+            perfledger.record_leg(leg, line)
+    except Exception as e:
+        # ledger telemetry must never cost a banked measurement
+        print(f"  perf-ledger row for leg {leg!r} failed ({e})",
+              file=sys.stderr)
 
 
 def _banked(leg):
@@ -780,10 +793,24 @@ def _arm_watchdog(seconds=900):
 
             if not blackbox.is_enabled():
                 return
+            extra = {"watchdog_s": seconds}
+            try:
+                from paddle_tpu import flags
+
+                if flags.get_flag("perf_ledger", False):
+                    # the last perf rows before the wedge ride along in
+                    # the bundle (the ledger's dump provider adds its
+                    # snapshot too, once any site constructed it)
+                    from paddle_tpu.monitor import perfledger
+
+                    extra["perf_ledger_tail"] = perfledger.tail(
+                        flags.get_flag("perf_ledger_path", ""), 10)
+            except Exception:
+                pass
             t = threading.Thread(
                 target=blackbox.dump, args=("stall",),
                 kwargs={"site": "bench/watchdog",
-                        "extra": {"watchdog_s": seconds}},
+                        "extra": extra},
                 name="bench-watchdog-dump", daemon=True)
             t.start()
             t.join(timeout=30)

@@ -47,6 +47,10 @@ RULE_ALIASES = {
     "plan-hbm-over-budget": ("hbm-budget",),
     "plan-handoff-mismatch": ("plan-handoff",),
     "plan-space-empty": ("empty-plan-space",),
+    # ISSUE 17: measured-constant calibration rules (analysis/calibrate)
+    "calib-insufficient-rows": ("calib-rows",),
+    "calib-no-signal": ("calib-signal",),
+    "calib-fit-unstable": ("calib-unstable",),
 }
 
 

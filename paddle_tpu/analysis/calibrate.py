@@ -1,0 +1,204 @@
+"""Measured-constant calibration of the plan cost model (ISSUE 17).
+
+The static cost model (analysis/cost_model.py) prices compute and
+communication against *nominal* constants — datasheet peak flops, a
+device-kind HBM table, a fixed interconnect bandwidth. This module
+closes ROADMAP item 4's named follow-on ("feed a banked BENCH
+measurement back into the cost constants"): it least-squares fits the
+*effective* constants out of perf-ledger rows
+(monitor/perfledger.py) and emits a calibration table
+``CostModel(constants=)`` consumes, so ``tools/plan_search.py
+--calibrated`` ranks plans against the hardware the ledger actually
+observed.
+
+Fits (all through-origin least squares — the physically honest model,
+``t ≈ work / rate``, has no intercept):
+
+- **effective peak flops** from rows carrying ``flops_per_step`` +
+  ``exec_ms`` (or ``step_ms``): minimizing ``Σ (t - f/P)²`` over the
+  rate gives ``P = Σf² / Σ(f·t)``;
+- **effective HBM bandwidth** from rows carrying ``bytes_per_step``
+  (the executable's XLA ``bytes accessed``) + the same wall time — an
+  upper-bound-coupled estimate (compute and memory share the step), so
+  it is reported as *effective*, never datasheet;
+- **per-collective-op wire bandwidth** from rows whose ``collectives``
+  table carries TIMED entries (``{op: {"bytes": B, "ms": T}}`` — bench
+  legs and synthetic rows; cumulative untimed tallies are skipped), one
+  rate per op, plus a bytes-weighted aggregate ``net_bandwidth``.
+
+Rows are grouped by the ledger's CORE env fingerprint — a laptop's rows
+must never calibrate a TPU pod's cost model. Everything reports through
+the graph_lint finding schema (``RULES`` below) so
+``tools/perf_report.py --calibrate`` folds into the battery.
+
+Manifest-lazy (analysis/import_graph.py LAZY_MODULES): nothing on a
+plain trainer/engine path imports this module.
+"""
+import json
+import math
+
+from .registry import Finding
+from ..monitor import perfledger as _pl
+
+__all__ = ["RULES", "SCHEMA_VERSION", "MIN_ROWS", "fit_rate",
+           "calibrate", "save_table", "load_table",
+           "constants_for_cost_model"]
+
+RULES = {
+    # fewer matching rows than MIN_ROWS for a fit: the constant is
+    # omitted, the nominal table stays in force
+    "calib-insufficient-rows": "warning",
+    # rows exist but none carry the fields a fit needs
+    "calib-no-signal": "warning",
+    # a fit produced a non-finite / non-positive rate (degenerate rows)
+    "calib-fit-unstable": "warning",
+}
+
+#: calibration table schema version
+SCHEMA_VERSION = 1
+
+#: minimum (work, time) pairs before a fit is trusted
+MIN_ROWS = 3
+
+
+def _num(v):
+    return (isinstance(v, (int, float)) and not isinstance(v, bool)
+            and math.isfinite(float(v)))
+
+
+def fit_rate(pairs):
+    """Through-origin least squares of ``t ≈ work / rate`` over
+    ``(work, t_seconds)`` pairs: ``rate = Σw² / Σ(w·t)``. Returns None
+    on degenerate input (no positive-work/positive-time pairs)."""
+    sww = swt = 0.0
+    for w, t in pairs:
+        if w > 0 and t > 0:
+            sww += w * w
+            swt += w * t
+    if swt <= 0.0:
+        return None
+    return sww / swt
+
+
+def calibrate(rows, env=None):
+    """Fit the constants table from ledger rows filtered to one CORE env
+    fingerprint (default: this process's). Returns ``(table,
+    findings)`` — the table always exists; missing fits surface as
+    warning findings and absent keys (CostModel falls back to nominal
+    for those)."""
+    fp = env if env is not None else _pl.env_fingerprint()
+    key = _pl.fingerprint_key(fp)
+    use = [r for r in rows
+           if _pl.fingerprint_key(r.get("env") or {}) == key]
+    findings = []
+    flops_pairs, bytes_pairs = [], []
+    wire_pairs = {}   # op -> [(bytes, s)]
+    for r in use:
+        m = r.get("metrics") or {}
+        # exec_ms excludes compile resolution; only fall back to the
+        # whole-step wall time for rows that did NOT resolve a compile
+        t = m.get("exec_ms") if _num(m.get("exec_ms")) \
+            else (None if m.get("cold") else m.get("step_ms"))
+        t_s = float(t) / 1e3 if _num(t) and float(t) > 0 else None
+        if t_s is not None:
+            f = m.get("flops_per_step")
+            if _num(f) and float(f) > 0:
+                flops_pairs.append((float(f), t_s))
+            b = m.get("bytes_per_step")
+            if _num(b) and float(b) > 0:
+                bytes_pairs.append((float(b), t_s))
+        coll = m.get("collectives")
+        if isinstance(coll, dict):
+            for op, d in coll.items():
+                if not isinstance(d, dict):
+                    continue
+                wb, wt = d.get("bytes"), d.get("ms")
+                if _num(wb) and _num(wt) and float(wb) > 0 \
+                        and float(wt) > 0:
+                    wire_pairs.setdefault(str(op), []).append(
+                        (float(wb), float(wt) / 1e3))
+
+    constants = {}
+
+    def _fit(name, pairs, signal):
+        if len(pairs) < MIN_ROWS:
+            rule = "calib-no-signal" if not pairs \
+                else "calib-insufficient-rows"
+            findings.append(Finding(
+                rule, "warning",
+                f"{name}: {len(pairs)} usable row(s) carrying {signal} "
+                f"(need {MIN_ROWS}) — nominal constant stays in force",
+                where=f"env:{key}"))
+            return None
+        rate = fit_rate(pairs)
+        if rate is None or not math.isfinite(rate) or rate <= 0:
+            findings.append(Finding(
+                "calib-fit-unstable", "warning",
+                f"{name}: degenerate fit over {len(pairs)} row(s) — "
+                "nominal constant stays in force", where=f"env:{key}"))
+            return None
+        return rate
+
+    peak = _fit("peak_flops", flops_pairs, "flops_per_step + wall time")
+    if peak is not None:
+        constants["peak_flops"] = peak
+    hbm = _fit("hbm_bandwidth", bytes_pairs, "bytes_per_step + wall time")
+    if hbm is not None:
+        constants["hbm_bandwidth"] = hbm
+    per_op = {}
+    if not wire_pairs:
+        findings.append(Finding(
+            "calib-no-signal", "warning",
+            "net_bandwidth: no row carries timed collective entries "
+            "({op: {bytes, ms}}) — nominal interconnect bandwidth "
+            "stays in force", where=f"env:{key}"))
+    for op in sorted(wire_pairs):
+        rate = _fit(f"net_bandwidth[{op}]", wire_pairs[op],
+                    "timed collective bytes")
+        if rate is not None:
+            per_op[op] = rate
+    if per_op:
+        constants["net_bandwidth_per_op"] = per_op
+        weights = {op: sum(w for w, _ in wire_pairs[op]) for op in per_op}
+        total_w = sum(weights.values())
+        constants["net_bandwidth"] = sum(
+            per_op[op] * weights[op] for op in per_op) / total_w
+    table = {
+        "v": SCHEMA_VERSION,
+        "rows": len(use),
+        "rows_total": len(rows),
+        "env": {k: fp.get(k) for k in _pl.CORE_FINGERPRINT},
+        "fits": {
+            "peak_flops": len(flops_pairs),
+            "hbm_bandwidth": len(bytes_pairs),
+            "net_bandwidth": {op: len(p) for op, p in
+                              sorted(wire_pairs.items())},
+        },
+        "constants": constants,
+    }
+    return table, findings
+
+
+def save_table(table, path):
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(table, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def load_table(path):
+    """Load a calibration table; raises ValueError on a foreign schema
+    (a silently mis-read table would mis-price every plan)."""
+    with open(path, "r", encoding="utf-8") as f:
+        table = json.load(f)
+    if not isinstance(table, dict) or table.get("v") != SCHEMA_VERSION:
+        raise ValueError(
+            f"{path}: not a calibration table (want v={SCHEMA_VERSION}, "
+            f"got {table.get('v') if isinstance(table, dict) else table!r})")
+    return table
+
+
+def constants_for_cost_model(table):
+    """The subset of a table ``CostModel(constants=)`` recognizes."""
+    c = table.get("constants") or {}
+    return {k: c[k] for k in ("peak_flops", "hbm_bandwidth",
+                              "net_bandwidth") if c.get(k)}

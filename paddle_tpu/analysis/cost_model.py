@@ -266,14 +266,32 @@ class CostModel:
     ``peak`` / ``hbm_bw`` / ``net_bw`` the roofline denominators
     (default: trace.costs.peak_flops() and the nominal bandwidths —
     on the CPU harness only relative ordering matters).
+
+    ``constants`` injects a MEASURED constants table
+    (analysis/calibrate.py over perf-ledger rows; ``tools/plan_search.py
+    --calibrated``): recognized keys ``peak_flops`` / ``hbm_bandwidth``
+    / ``net_bandwidth`` override the corresponding denominator, so plan
+    ranking prices against the hardware the ledger actually observed
+    instead of the nominal tables. Explicit ``peak=``/``hbm_bw=``/
+    ``net_bw=`` arguments still win — a caller pinning a denominator by
+    hand outranks a recorded table.
     """
 
     def __init__(self, hbm_bytes=DEFAULT_HBM_BYTES, peak=None,
-                 hbm_bw=None, net_bw=NOMINAL_NET_BW):
+                 hbm_bw=None, net_bw=NOMINAL_NET_BW, constants=None):
         self.hbm_bytes = int(hbm_bytes)
         self._peak = peak
         self._hbm_bw = hbm_bw
         self.net_bw = float(net_bw)
+        self.constants = dict(constants) if constants else None
+        if self.constants:
+            if peak is None and self.constants.get("peak_flops"):
+                self._peak = float(self.constants["peak_flops"])
+            if hbm_bw is None and self.constants.get("hbm_bandwidth"):
+                self._hbm_bw = float(self.constants["hbm_bandwidth"])
+            if net_bw == NOMINAL_NET_BW \
+                    and self.constants.get("net_bandwidth"):
+                self.net_bw = float(self.constants["net_bandwidth"])
 
     @property
     def peak(self):

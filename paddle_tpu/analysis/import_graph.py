@@ -52,6 +52,8 @@ LAZY_MODULES = (
     "paddle_tpu.distributed.stage",          # MPMD stage runtime (ISSUE 15)
     "paddle_tpu.analysis.cost_model",        # plan-search pricing (ISSUE 16)
     "paddle_tpu.analysis.plan_search",       # plan enumerator (ISSUE 16)
+    "paddle_tpu.monitor.perfledger",         # perf ledger + sentinel (ISSUE 17)
+    "paddle_tpu.analysis.calibrate",         # measured-constant fits (ISSUE 17)
 )
 
 #: what a plain trainer/engine process imports (the roots of the closure

@@ -266,6 +266,20 @@ class SpmdTrainer:
         self._numerics_seen = 0            # armed steps so far
         self._numerics_last_device = None  # device-resident stats leg
         self._numerics_last_host = None    # cached fetch of the above
+        # perf ledger (FLAGS_perf_ledger, docs/OBSERVABILITY.md):
+        # consumed at construction. Deliberately NON-structural — the
+        # ledger only observes host-side timings and never changes the
+        # compiled program, so it joins NO executable key (armed and
+        # disarmed runs share AOT entries and train byte-identically);
+        # disarmed, the hook in _finish_step is one `is not None`
+        self._perf_ledger = None
+        self._perf_mesh_fp = None
+        self._perf_cold = False   # last step resolved a compile
+        if _flags.get_flag("perf_ledger", False):
+            from ..monitor import perfledger as _perfledger
+
+            self._perf_ledger = _perfledger.get_ledger()
+            self._perf_mesh_fp = _aot.mesh_fingerprint(self.mesh)
         self.params = {n: p._data for n, p in layer.named_parameters() if getattr(p, "trainable", True)}
         self.frozen = {n: p._data for n, p in layer.named_parameters() if not getattr(p, "trainable", True)}
         self.buffers = {n: b._data for n, b in layer.named_buffers()}
@@ -1515,7 +1529,12 @@ class SpmdTrainer:
                 jitted,
                 (self.params, self.opt_state, self.buffers, lr, rng,
                  *batch_arrays),
-                site="trainer", force=force or _trace.is_enabled(),
+                # the perf ledger forces the eager (cost-accountable)
+                # compile exactly as tracing does: MFU needs the
+                # executable's flops, which a lazy bypass jit never
+                # exposes — same program, so still non-structural
+                site="trainer", force=force or _trace.is_enabled()
+                or self._perf_ledger is not None,
                 extra_key=("trainer", _aot.mesh_fingerprint(self.mesh),
                            self.dp_axis, self.sharding_stage,
                            self.accumulate_steps, guarded, narmed,
@@ -1612,6 +1631,8 @@ class SpmdTrainer:
             if _monitor.is_enabled():
                 _aot.record_compile("trainer", sig_label, "memory")
         compiled, guarded, narmed, qleg = entry
+        if self._perf_ledger is not None:
+            self._perf_cold = source != "memory"
         # exec window starts AFTER compile resolution: stats()/MFU must
         # divide flops by run time, not by jit-build + AOT-compile time
         # (step_latency_ms keeps its historical include-compile meaning)
@@ -1707,11 +1728,39 @@ class SpmdTrainer:
             sp.end(sync_ms=sync_ms, step_ms=step_ms, exec_ms=exec_ms)
             self._step_span = None
             _trace.add_counter_sample("trainer_step_ms", step_ms)
+        if self._perf_ledger is not None:
+            self._ledger_step(step_ms, exec_ms, sync_ms)
         if self._async:
             from . import async_dispatch as _async_mod
 
             return _async_mod.StepHandle(loss, sched, trainer=self)
         return Tensor(loss)
+
+    # -- perf ledger (FLAGS_perf_ledger) ---------------------------------------
+    def _ledger_step(self, step_ms, exec_ms, sync_ms):
+        """Armed-only per-step perf-ledger feed: the regression sentinel
+        sees every step's wall times + t_exec-windowed MFU; a JSONL row
+        (sig + mesh fingerprint) lands every FLAGS_perf_ledger_interval
+        steps. A step that resolved a compile is recorded (``cold: 1``)
+        but kept OUT of the baseline — its jit-build wall time is not
+        the steady state the sentinel guards. Host-side bookkeeping only
+        — the compiled step is the disarmed one."""
+        m = {"step_ms": step_ms, "exec_ms": exec_ms, "sync_ms": sync_ms}
+        if self._perf_cold:
+            m["cold"] = 1
+        entry = (self._cost_entries.get(self._last_sig)
+                 or _costs.get("trainer", self._last_sig)
+                 if self._last_sig else None)
+        flops = entry.get("flops") if entry else None
+        peak = _costs.peak_flops()
+        if flops and exec_ms and peak:
+            m["mfu"] = float(flops) / ((exec_ms / 1e3) * peak)
+            m["flops_per_step"] = flops
+        if entry and entry.get("bytes_accessed"):
+            m["bytes_per_step"] = entry["bytes_accessed"]
+        self._perf_ledger.on_step("trainer", m, sig=self._last_sig,
+                                  mesh=self._perf_mesh_fp,
+                                  check=not self._perf_cold)
 
     # -- quantized-reduce observability ----------------------------------------
     def quantize_error(self):

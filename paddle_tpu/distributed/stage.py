@@ -44,12 +44,14 @@ This module is manifest-lazy (analysis/import_graph.py): with
 byte-identical to the pre-PR build (tests/test_stage_gate.py).
 """
 import collections
+import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import flags as _flags
 from .. import monitor as _monitor
 from ..monitor import blackbox_lazy as _blackbox  # import-free recorder facade
 from .. import trace as _trace
@@ -328,6 +330,13 @@ class StageGraph:
         self.name = name
         self.stages = {}
         self.edges = {}
+        # perf ledger (FLAGS_perf_ledger, docs/OBSERVABILITY.md):
+        # consumed at construction; disarmed, run() pays one `is None`
+        self._perf_ledger = None
+        if _flags.get_flag("perf_ledger", False):
+            from ..monitor import perfledger as _perfledger
+
+            self._perf_ledger = _perfledger.get_ledger()
 
     def add_stage(self, program):
         self.stages[program.name] = program
@@ -344,6 +353,7 @@ class StageGraph:
         root = _trace.start_span("stage_graph", subsystem="stage",
                                  trace_id=trace_id, graph=self.name) \
             if traced else None
+        t0 = time.perf_counter() if self._perf_ledger is not None else None
         out = []
         try:
             for sname, thunk in plan:
@@ -359,7 +369,29 @@ class StageGraph:
         finally:
             if root is not None:
                 root.end(ticks=len(out))
+            if t0 is not None:
+                self._ledger_run((time.perf_counter() - t0) * 1e3,
+                                 len(out))
         return out
+
+    def _ledger_run(self, run_ms, ticks):
+        """Armed-only (FLAGS_perf_ledger) per-run feed: run/mean-tick
+        wall ms through the regression sentinel, with the edge transfer
+        tallies riding the row (every FLAGS_perf_ledger_interval-th
+        run)."""
+        m = {"run_ms": run_ms, "ticks": ticks}
+        if ticks:
+            m["tick_ms"] = run_ms / ticks
+        edges = {}
+        for name, st in self.edge_stats().items():
+            nums = {k: v for k, v in st.items()
+                    if isinstance(v, (int, float))
+                    and not isinstance(v, bool)}
+            if nums:
+                edges[name] = nums
+        if edges:
+            m["edges"] = edges
+        self._perf_ledger.on_step("stage/" + self.name, m)
 
     def edge_stats(self):
         return {n: dict(e.stats) for n, e in sorted(self.edges.items())}

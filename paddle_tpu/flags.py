@@ -213,3 +213,37 @@ define_flag("flash_attention_block", 0,
             "force the flash-attention Pallas block size (128/256/512); "
             "0 = auto (largest of 512/256/128 dividing seq). For on-chip "
             "tuning sweeps: FLAGS_flash_attention_block=256 python bench.py")
+define_flag("perf_ledger", False,
+            "persistent perf ledger (monitor/perfledger.py, "
+            "docs/OBSERVABILITY.md): trainer/engine/stage-graph/bench "
+            "step telemetry (wall ms, MFU, collective bytes, dispatch "
+            "fraction, latency digests) is appended as env-fingerprinted "
+            "JSONL rows to FLAGS_perf_ledger_path, with an EMA/sigma "
+            "regression sentinel firing perf_regression_total{site,"
+            "metric}. DELIBERATELY NON-STRUCTURAL: the ledger only "
+            "observes host-side timings and never changes any compiled "
+            "program, so it does NOT join the executable keys (armed and "
+            "disarmed runs share AOT cache entries and train "
+            "byte-identically — tests/test_perfledger_gate.py pins it). "
+            "Unset, the ledger module is never imported and every hook "
+            "is one boolean check. Defined here (not in the ledger "
+            "module) so trainers can gate on it without importing it")
+define_flag("perf_ledger_path", "",
+            "with FLAGS_perf_ledger: path of the append-only JSONL "
+            "ledger file. Appends are atomic (single write+flush+fsync "
+            "per row) and readers tolerate a torn tail, like bench.py "
+            "--banked. Empty = rows are kept in-process only (sentinel "
+            "and metrics still run; nothing persists)")
+define_flag("perf_ledger_sigma", 4.0,
+            "with FLAGS_perf_ledger: regression threshold — a step "
+            "metric more than this many EMA standard deviations on the "
+            "bad side of its per-(site,metric) baseline fires "
+            "perf_regression_total and notes the blackbox ring")
+define_flag("perf_ledger_warmup", 5,
+            "with FLAGS_perf_ledger: observations of a (site,metric) "
+            "series before the sentinel may fire (the EMA baseline "
+            "needs points; the NumericsMonitor warmup contract)")
+define_flag("perf_ledger_interval", 1,
+            "with FLAGS_perf_ledger: append a ledger row every N "
+            "observations per site (the sentinel still sees every "
+            "observation; only row volume is throttled)")

@@ -667,6 +667,17 @@ class ServingEngine:
         self._deadline_live = 0   # unfinished requests carrying deadline_ms
         self._step_no = 0
         self._last_error_step = None
+        # perf ledger (FLAGS_perf_ledger, docs/OBSERVABILITY.md):
+        # consumed at ENGINE CONSTRUCTION like the trainer's copy.
+        # Non-structural — host-side accounting only; disarmed, step()
+        # pays one `is not None`
+        self._perf_ledger = None
+        self._perf_rounds = 0
+        if _flags.get_flag("perf_ledger", False):
+            from ..monitor import perfledger as _perfledger
+
+            self._perf_ledger = _perfledger.get_ledger()
+
         # blackbox dump bundles carry every live engine's in-flight
         # request table (weakly held; only read at dump time)
         _blackbox.register_provider("serving_engine", self,
@@ -1639,7 +1650,27 @@ class ServingEngine:
         # finished sibling engine cannot mask it, because the site only
         # deactivates when the LAST open step window closes
         with _blackbox.progress("serving/step"):
-            return self._step_inner()
+            if self._perf_ledger is None:
+                return self._step_inner()
+            t0 = time.perf_counter()
+            try:
+                return self._step_inner()
+            finally:
+                self._ledger_round((time.perf_counter() - t0) * 1e3)
+
+    def _ledger_round(self, step_ms):
+        """Armed-only (FLAGS_perf_ledger) per-round feed: the regression
+        sentinel sees every round's wall ms; every
+        FLAGS_perf_ledger_interval-th round appends the full
+        stats()['breakdown'] ledger row (per-kind step ms, executed
+        device flops, queue-wait/TTFT/inter-token digests)."""
+        led = self._perf_ledger
+        led.observe("serving", {"step_ms": step_ms})
+        self._perf_rounds += 1
+        if self._perf_rounds % led.interval == 0:
+            from ..monitor import perfledger as _perfledger
+
+            _perfledger.record_engine(self, ledger=led)
 
     def _step_inner(self):
         # FLAGS_async_dispatch (construction-consumed): overlap round

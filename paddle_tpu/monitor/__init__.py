@@ -59,13 +59,13 @@ __all__ = [
 
 
 def __getattr__(name):   # PEP 562
-    # the numerics telescope AND the flight recorder load lazily: a plain
-    # (flags-unset) process must never import either —
-    # tests/test_numerics_gate.py and the ISSUE 12 import-graph contract
-    # (analysis/import_graph.py LAZY_MODULES) pin it. Deliberately NOT in
-    # __all__: a star-import resolves every listed name, which would
-    # defeat the laziness
-    if name in ("numerics", "blackbox"):
+    # the numerics telescope, the flight recorder, AND the perf ledger
+    # load lazily: a plain (flags-unset) process must never import any —
+    # tests/test_numerics_gate.py, tests/test_perfledger_gate.py, and
+    # the ISSUE 12 import-graph contract (analysis/import_graph.py
+    # LAZY_MODULES) pin it. Deliberately NOT in __all__: a star-import
+    # resolves every listed name, which would defeat the laziness
+    if name in ("numerics", "blackbox", "perfledger"):
         import importlib
 
         return importlib.import_module("." + name, __name__)
@@ -335,3 +335,11 @@ blackbox_lazy = _BlackboxLazy()
 # this check never touches the lazy module.
 if _flags.get_flag("blackbox", False):
     from . import blackbox  # noqa: E402,F401  # lint: allow(lazy-import)
+
+# same opt-in for the perf ledger (FLAGS_perf_ledger=1 python bench.py):
+# create the process ledger eagerly so its blackbox dump provider and
+# env fingerprint exist before the first recording site runs.
+if _flags.get_flag("perf_ledger", False):
+    from . import perfledger  # noqa: E402,F401  # lint: allow(lazy-import)
+
+    perfledger.get_ledger()

@@ -86,6 +86,35 @@ class _HistogramSeries:
         return {"labels": dict(self.labels), "count": self.count,
                 "sum": self.sum, "buckets": out}
 
+    def _quantile(self, q):
+        """Estimate the q-quantile from the bucket layout: linear
+        interpolation inside the winning bucket (prometheus
+        histogram_quantile discipline); observations that landed past
+        the last finite bound clamp to it — the layout cannot resolve
+        further."""
+        if self.count <= 0:
+            return 0.0
+        target = q * self.count
+        cum, lo = 0, 0.0
+        for le, n in zip(self.buckets, self.counts):
+            if n and cum + n >= target:
+                frac = (target - cum) / n
+                return lo + (le - lo) * min(max(frac, 0.0), 1.0)
+            cum += n
+            lo = le
+        return self.buckets[-1]
+
+    def summary(self, quantiles=(0.5, 0.9, 0.99)):
+        """Quantile digest of this series: {"count", "sum", "p50",
+        "p90", "p99"} (keys follow the requested quantiles). Estimates,
+        not exact order statistics — the raw observations are gone; only
+        the bucket layout remains. An empty series digests to zeros."""
+        out = {"count": self.count, "sum": self.sum}
+        for q in quantiles:
+            out["p" + format(q * 100, "g").replace(".", "_")] = \
+                self._quantile(q)
+        return out
+
 
 class _Bound:
     """A metric bound to one label combination — the mutation handle the
@@ -142,6 +171,18 @@ class _Bound:
             s.counts[bisect.bisect_left(s.buckets, v)] += 1
             s.sum += v
             s.count += 1
+
+    def summary(self, quantiles=(0.5, 0.9, 0.99)):
+        """Quantile digest of the bound series (histogram only); an
+        unobserved label combination digests to zeros."""
+        m = self._metric
+        if m.kind != "histogram":
+            raise TypeError(f"{m.kind} {m.name!r} has no summary()")
+        with m._lock:
+            s = m._peek(self._key)
+            if s is None:
+                s = m._new_series(self._key)  # zeros; NOT registered
+            return s.summary(quantiles)
 
     # reads (tests / stats()) ----------------------------------------------
     @property
@@ -235,6 +276,9 @@ class Metric:
 
     def observe(self, v):
         self._require_unlabeled().observe(v)
+
+    def summary(self, quantiles=(0.5, 0.9, 0.99)):
+        return self._require_unlabeled().summary(quantiles)
 
     @property
     def value(self):

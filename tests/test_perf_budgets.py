@@ -15,10 +15,20 @@ the same idea in compile-time form (SURVEY §6 tooling).
 Regenerate budgets after an INTENTIONAL change:
     python tests/test_perf_budgets.py --record
 (budget drift then shows up in the diff for review, like any golden file).
+
+The wall-time floors (step time / MFU / dispatch fraction) live
+separately, as perf-ledger rows in tests/perf_baseline.jsonl
+(monitor/perfledger.py row schema, env-fingerprint-gated exactly like
+every other ledger consumer — ISSUE 17 retired this file's private
+fingerprint format). Re-pin them on a new machine with:
+    python tests/test_perf_budgets.py --record-steptime
+(appends rows — the ledger discipline; the newest env-matching row
+wins).
 """
 import json
 import os
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -472,10 +482,11 @@ def test_dp8_composed_quantized_shard_collectives():
 
 # -- per-model step-time / MFU floors (ROADMAP item 3) ------------------------
 # Wall-time floors are env-dependent in a way FLOPs budgets are not, so
-# they follow the dp8 ZeRO-2 pattern: --record stamps an environment
-# fingerprint next to the baselines and the gate only compares where the
+# they are stored as perf-ledger rows (tests/perf_baseline.jsonl) keyed
+# by the ledger's CORE env fingerprint: the gate only compares where the
 # fingerprint matches THIS machine — elsewhere it skips with structure
-# verified (re-record to pin the new environment).
+# verified (--record-steptime appends a fresh row to pin the new
+# environment; the newest matching row wins).
 
 STEP_FLOOR_MODELS = ("gpt", "bert")
 #: measured-vs-recorded slack: CI machines share cores; a true
@@ -483,18 +494,32 @@ STEP_FLOOR_MODELS = ("gpt", "bert")
 #: recompile-per-step bug) still blows through 3x
 STEP_TIME_SLACK = 3.0
 
+BASELINE_LEDGER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "perf_baseline.jsonl")
 
-def _steptime_env():
-    import os
-    import platform
 
-    import jax
-    import jaxlib
+def _ledger_floor(site):
+    """The newest env-matching baseline row's metrics for one budget
+    site from the committed ledger, or None (skip: this machine has no
+    recorded floor)."""
+    from paddle_tpu.monitor import perfledger
 
-    return {"jax": jax.__version__, "jaxlib": jaxlib.__version__,
-            "python": platform.python_version(),
-            "machine": platform.machine(),
-            "cpu_count": os.cpu_count()}
+    key = perfledger.fingerprint_key(perfledger.env_fingerprint())
+    rows = [r for r in perfledger.load_rows(BASELINE_LEDGER)
+            if r.get("site") == site
+            and perfledger.fingerprint_key(r.get("env") or {}) == key]
+    return (rows[-1].get("metrics") or None) if rows else None
+
+
+def _bank_floor(site, metrics):
+    """Append one baseline row (the ledger append-only discipline — a
+    re-record never rewrites history, the diff shows both)."""
+    from paddle_tpu.monitor import perfledger
+
+    perfledger.append_row(BASELINE_LEDGER, {
+        "v": perfledger.SCHEMA_VERSION, "ts": round(time.time(), 3),
+        "site": site, "sig": None, "mesh": None,
+        "env": perfledger.env_fingerprint(), "metrics": metrics})
 
 
 def _floor_trainer(name):
@@ -552,27 +577,23 @@ def _measure_step_floor(name, warmup=2, steps=5):
     return {"step_ms": wall_ms, "mfu": st["mfu"]}
 
 
-def _measure_step_floors():
-    return {"env": _steptime_env(),
-            "floors": {name: _measure_step_floor(name)
-                       for name in STEP_FLOOR_MODELS}}
+def _record_step_floors():
+    for name in STEP_FLOOR_MODELS:
+        _bank_floor("budget/" + name, _measure_step_floor(name))
+    _bank_floor("budget/dispatch", _measure_dispatch_fraction())
 
 
 @pytest.mark.parametrize("model", STEP_FLOOR_MODELS)
-def test_step_time_and_mfu_floor(model, budgets):
+def test_step_time_and_mfu_floor(model):
     import jax
 
     if jax.devices()[0].platform != "cpu":
         pytest.skip("floors recorded on the CPU backend")
-    rec = budgets.get("step_time_floors")
-    if not rec or model not in rec.get("floors", {}):
-        pytest.skip("no recorded step-time floor — run `python "
-                    "tests/test_perf_budgets.py --record-steptime`")
-    if rec.get("env") != _steptime_env():
-        pytest.skip("step-time floor recorded on a different "
-                    "environment — wall time is not comparable; "
-                    "re-record here to pin this machine")
-    want = rec["floors"][model]
+    want = _ledger_floor("budget/" + model)
+    if not want:
+        pytest.skip("no env-matching step-time baseline row — run "
+                    "`python tests/test_perf_budgets.py "
+                    "--record-steptime` to pin this machine")
     got = _measure_step_floor(model)
     assert got["step_ms"] <= want["step_ms"] * STEP_TIME_SLACK, (
         f"{model}: train step {got['step_ms']:.2f}ms vs recorded "
@@ -621,18 +642,16 @@ def _measure_dispatch_fraction(warmup=2, steps=8):
         paddle.set_flags(old)
 
 
-def test_dispatch_fraction_floor(budgets):
+def test_dispatch_fraction_floor():
     import jax
 
     if jax.devices()[0].platform != "cpu":
         pytest.skip("floors recorded on the CPU backend")
-    rec = budgets.get("dispatch_fraction")
+    rec = _ledger_floor("budget/dispatch")
     if not rec:
-        pytest.skip("no recorded dispatch-fraction floor — run `python "
-                    "tests/test_perf_budgets.py --record-steptime`")
-    if rec.get("env") != _steptime_env():
-        pytest.skip("dispatch-fraction floor recorded on a different "
-                    "environment — re-record here to pin this machine")
+        pytest.skip("no env-matching dispatch-fraction baseline row — "
+                    "run `python tests/test_perf_budgets.py "
+                    "--record-steptime` to pin this machine")
     got = _measure_dispatch_fraction()
     want = rec["fraction"]
     # the fraction lives in [0, 1], so gate the IDLE GAP (1 - fraction):
@@ -729,28 +748,19 @@ if __name__ == "__main__":
         jax.config.update("jax_platforms", "cpu")
         assert jax.devices()[0].platform == "cpu"
         budgets = _measure()
-        budgets["step_time_floors"] = _measure_step_floors()
-        budgets["dispatch_fraction"] = dict(
-            _measure_dispatch_fraction(), env=_steptime_env())
         json.dump(budgets, open(BUDGET_PATH, "w"), indent=1)
-        print(f"recorded -> {BUDGET_PATH}")
+        _record_step_floors()
+        print(f"recorded -> {BUDGET_PATH} (+ floors -> {BASELINE_LEDGER})")
         print(json.dumps(budgets, indent=1))
     elif "--record-steptime" in sys.argv:
-        # stamp ONLY the step-time/MFU floors (+ env fingerprint),
-        # leaving the FLOPs/collective budgets untouched — the usual move
-        # when picking the floors up on a new machine
+        # append ONLY fresh step-time/MFU/dispatch floor rows to the
+        # baseline ledger, leaving the FLOPs/collective budgets untouched
+        # — the usual move when picking the floors up on a new machine
         import jax
 
         jax.config.update("jax_platforms", "cpu")
         assert jax.devices()[0].platform == "cpu"
-        budgets = json.load(open(BUDGET_PATH))
-        budgets["step_time_floors"] = _measure_step_floors()
-        budgets["dispatch_fraction"] = dict(
-            _measure_dispatch_fraction(), env=_steptime_env())
-        json.dump(budgets, open(BUDGET_PATH, "w"), indent=1)
-        print(f"recorded step-time floors -> {BUDGET_PATH}")
-        print(json.dumps({"step_time_floors": budgets["step_time_floors"],
-                          "dispatch_fraction":
-                          budgets["dispatch_fraction"]}, indent=1))
+        _record_step_floors()
+        print(f"recorded step-time floor rows -> {BASELINE_LEDGER}")
     else:
         print(__doc__)

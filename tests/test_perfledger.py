@@ -1,0 +1,285 @@
+"""Perf-ledger unit tests (ISSUE 17): JSONL row schema + torn-tail
+recovery, env-fingerprint gating of baselines, the regression
+sentinel's direction/latch/negative behavior, least-squares calibration
+recovering planted constants, and CostModel(constants=) actually
+re-pricing the plan ranking."""
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu import flags
+from paddle_tpu.analysis import calibrate, cost_model, plan_search
+from paddle_tpu.monitor import perfledger as pl
+
+
+def _row(site="trainer", env=None, **metrics):
+    return {"v": pl.SCHEMA_VERSION, "ts": 0.0, "site": site, "sig": None,
+            "mesh": None, "env": env or pl.env_fingerprint(),
+            "metrics": metrics}
+
+
+def _ledger(tmp_path, warmup=3, sigma=4.0, interval=1):
+    old = {k: flags.get_flag(k) for k in
+           ("perf_ledger_warmup", "perf_ledger_sigma",
+            "perf_ledger_interval")}
+    flags.set_flags({"perf_ledger_warmup": warmup,
+                     "perf_ledger_sigma": sigma,
+                     "perf_ledger_interval": interval})
+    try:
+        return pl.PerfLedger(path=str(tmp_path / "ledger.jsonl"))
+    finally:
+        flags.set_flags(old)
+
+
+class TestRows:
+    def test_row_roundtrip_sanitizes_and_sorts(self, tmp_path):
+        """One row, one line: numpy scalars become floats, non-finite
+        values become null, foreign-schema rows are skipped on load."""
+        path = str(tmp_path / "l.jsonl")
+        pl.append_row(path, _row(step_ms=np.float32(4.25),
+                                 mfu=float("nan"), cold=1))
+        pl.append_row(path, dict(_row(step_ms=1.0), v=99))  # foreign
+        rows = pl.load_rows(path)
+        assert len(rows) == 1
+        m = rows[0]["metrics"]
+        assert m["step_ms"] == 4.25 and isinstance(m["step_ms"], float)
+        assert m["mfu"] is None
+        assert m["cold"] == 1
+        # one JSON object per line, stable key order
+        with open(path) as f:
+            first = f.readline()
+        assert json.loads(first)["site"] == "trainer"
+        assert first.index('"env"') < first.index('"metrics"')
+
+    def test_torn_tail_and_noise_skipped(self, tmp_path):
+        """A killed writer's partial last line (and blank/garbage lines)
+        never poison the readable prefix."""
+        path = str(tmp_path / "l.jsonl")
+        for i in range(3):
+            pl.append_row(path, _row(step_ms=float(i)))
+        with open(path, "a") as f:
+            f.write("\n")
+            f.write('{"v": 1, "site": "trainer", "metr')  # torn tail
+        rows = pl.load_rows(path)
+        assert [r["metrics"]["step_ms"] for r in rows] == [0.0, 1.0, 2.0]
+        assert pl.tail(path, 2)[-1]["metrics"]["step_ms"] == 2.0
+        assert pl.load_rows(str(tmp_path / "absent.jsonl")) == []
+
+    def test_append_failure_drops_telemetry_not_the_step(self, tmp_path):
+        """A revoked path swallows the OSError — the observed step must
+        never pay for its own telemetry."""
+        led = _ledger(tmp_path)
+        led.path = str(tmp_path / "no" / "such" / "dir" / "l.jsonl")
+        led.on_step("trainer", {"step_ms": 4.0})
+        assert led.rows_written == 0
+        assert led._last_row["trainer"]["metrics"]["step_ms"] == 4.0
+
+
+class TestBaselines:
+    def test_fingerprint_gates_foreign_rows(self):
+        """A cross-machine row must never tighten this machine's
+        floors: only rows whose CORE fingerprint matches fold in."""
+        here = pl.env_fingerprint()
+        there = dict(here, jax="9.9.99")
+        rows = [_row(step_ms=4.0), _row(step_ms=4.0),
+                _row(step_ms=400.0, env=there)]
+        base = pl.baselines(rows)
+        assert base[("trainer", "step_ms")].n == 2
+        assert base[("trainer", "step_ms")].mean == pytest.approx(4.0)
+        # ...and nothing folds under the foreign fingerprint's key
+        assert pl.baselines(rows, env=there)[
+            ("trainer", "step_ms")].n == 1
+
+    def test_cold_and_nonsentinel_rows_stay_out(self):
+        """Compile-resolving windows (cold) and direction-less metrics
+        (dispatch_fraction) are recorded in rows but never baselined."""
+        rows = [_row(step_ms=4.0, dispatch_fraction=0.9),
+                _row(step_ms=4000.0, cold=1)]
+        base = pl.baselines(rows)
+        assert base[("trainer", "step_ms")].n == 1
+        assert ("trainer", "dispatch_fraction") not in base
+
+    def test_check_value_direction_and_floor(self):
+        ema = pl.Ema()
+        for _ in range(5):
+            ema.update(4.0)
+        regressed, excess = pl.check_value(ema, "step_ms", 400.0, 4.0)
+        assert regressed and excess > 4.0
+        assert not pl.check_value(ema, "step_ms", 4.1, 4.0)[0]
+        # LOW_IS_BAD flips the direction: a HIGHER mfu is never a
+        # regression, a collapsed one is
+        for _ in range(5):
+            ema.update(4.0)
+        assert not pl.check_value(ema, "mfu", 8.0, 4.0)[0]
+        assert pl.check_value(ema, "mfu", 0.1, 4.0)[0]
+
+
+class TestSentinel:
+    def test_regression_fires_once_per_episode(self, tmp_path):
+        """Positive: a planted slowdown past warmup fires exactly one
+        (site, metric)-named record; sustained breach stays latched; a
+        return to band re-arms."""
+        led = _ledger(tmp_path, warmup=3, sigma=4.0)
+        for _ in range(4):
+            assert led.on_step("trainer", {"step_ms": 4.0}) == []
+        fired = led.on_step("trainer", {"step_ms": 400.0})
+        assert [(f["site"], f["metric"]) for f in fired] == \
+            [("trainer", "step_ms")]
+        assert fired[0]["value"] == 400.0
+        # latched: the sustained breach is one episode, not one per step
+        assert led.on_step("trainer", {"step_ms": 400.0}) == []
+        # the breach never dragged the baseline up to meet it
+        assert led._ema[("trainer", "step_ms")].mean == pytest.approx(4.0)
+        for _ in range(2):
+            assert led.on_step("trainer", {"step_ms": 4.0}) == []
+        assert led.on_step("trainer", {"step_ms": 400.0})  # re-armed
+        assert len(pl.load_rows(led.path)) == 9
+
+    def test_negative_no_fire_in_band_or_during_warmup(self, tmp_path):
+        led = _ledger(tmp_path, warmup=3)
+        assert led.on_step("trainer", {"step_ms": 900.0}) == []  # warmup
+        led = _ledger(tmp_path, warmup=3)
+        vals = [4.0, 4.2, 3.9, 4.1, 4.05, 3.95, 4.15]
+        assert all(led.on_step("trainer", {"step_ms": v}) == []
+                   for v in vals)
+
+    def test_cold_step_skips_check_but_lands_row(self, tmp_path):
+        led = _ledger(tmp_path, warmup=2)
+        for _ in range(3):
+            led.on_step("trainer", {"step_ms": 4.0})
+        fired = led.on_step("trainer", {"step_ms": 4000.0, "cold": 1},
+                            check=False)
+        assert fired == []
+        assert pl.load_rows(led.path)[-1]["metrics"]["cold"] == 1
+        # the steady-state baseline survived the compile window
+        assert led._ema[("trainer", "step_ms")].mean == pytest.approx(4.0)
+
+    def test_interval_thins_rows_not_the_sentinel(self, tmp_path):
+        led = _ledger(tmp_path, interval=3)
+        for i in range(6):
+            led.on_step("trainer", {"step_ms": 4.0})
+        assert len(pl.load_rows(led.path)) == 2
+        assert led._ema[("trainer", "step_ms")].n == 6
+        led.on_step("trainer", {"step_ms": 4.0}, force=True)
+        assert len(pl.load_rows(led.path)) == 3
+
+    def test_snapshot_is_bundle_fodder(self, tmp_path):
+        led = _ledger(tmp_path, warmup=2)
+        for _ in range(3):
+            led.on_step("trainer", {"step_ms": 4.0})
+        led.on_step("trainer", {"step_ms": 400.0})
+        snap = led.snapshot()
+        assert snap["rows_written"] == 4
+        assert snap["sites"] == {"trainer": 4}
+        assert snap["regressions"][-1]["metric"] == "step_ms"
+        assert snap["tail"]
+        json.dumps(snap)  # bundle-safe
+
+
+class TestCalibration:
+    def test_fit_recovers_planted_constants_exactly(self):
+        """Noise-free planted rows: 1e9 flops / 4ms -> 2.5e11 flops/s,
+        1e8 bytes / 4ms -> 2.5e10 B/s, 1 MiB / 1ms -> ~1.05e9 B/s."""
+        rows = [_row(exec_ms=4.0, flops_per_step=1e9, bytes_per_step=1e8,
+                     collectives={"all-reduce": {"bytes": float(1 << 20),
+                                                 "ms": 1.0}})
+                for _ in range(4)]
+        table, findings = calibrate.calibrate(rows)
+        c = table["constants"]
+        assert c["peak_flops"] == pytest.approx(2.5e11)
+        assert c["hbm_bandwidth"] == pytest.approx(2.5e10)
+        assert c["net_bandwidth"] == pytest.approx((1 << 20) / 1e-3)
+        assert c["net_bandwidth_per_op"]["all-reduce"] == \
+            pytest.approx((1 << 20) / 1e-3)
+        assert not findings
+        assert table["rows"] == 4 and table["fits"]["peak_flops"] == 4
+        got = calibrate.constants_for_cost_model(table)
+        assert set(got) == {"peak_flops", "hbm_bandwidth",
+                            "net_bandwidth"}
+
+    def test_cold_rows_and_foreign_env_stay_out_of_the_fit(self):
+        """A compile-resolving step's step_ms fallback and another
+        machine's rows must not bend the rates."""
+        good = [_row(exec_ms=4.0, flops_per_step=1e9) for _ in range(3)]
+        cold = [_row(step_ms=4000.0, flops_per_step=1e9, cold=1)]
+        foreign = [_row(exec_ms=400.0, flops_per_step=1e9,
+                        env=dict(pl.env_fingerprint(), jax="9.9.99"))]
+        table, _ = calibrate.calibrate(good + cold + foreign)
+        assert table["constants"]["peak_flops"] == pytest.approx(2.5e11)
+        assert table["rows"] == 4  # foreign row filtered before fitting
+        # ...but a cold row WITH exec_ms is usable: the exec window
+        # excludes compile resolution by construction
+        table2, _ = calibrate.calibrate(
+            good + [_row(exec_ms=4.0, flops_per_step=1e9, cold=1)])
+        assert table2["fits"]["peak_flops"] == 4
+
+    def test_findings_name_missing_signal(self):
+        """Too few rows -> calib-insufficient-rows; zero signal ->
+        calib-no-signal; every fit degrades to the nominal constant."""
+        table, findings = calibrate.calibrate(
+            [_row(exec_ms=4.0, flops_per_step=1e9,
+                  bytes_per_step=1e8)] * 2)
+        assert table["constants"] == {}
+        rules = sorted(f.pass_name for f in findings)
+        assert rules == ["calib-insufficient-rows",
+                         "calib-insufficient-rows", "calib-no-signal"]
+        table, findings = calibrate.calibrate([_row(loss=1.0)] * 4)
+        assert {f.pass_name for f in findings} == {"calib-no-signal"}
+        assert all(f.severity == "warning" for f in findings)
+
+    def test_table_roundtrip_rejects_foreign_schema(self, tmp_path):
+        table, _ = calibrate.calibrate(
+            [_row(exec_ms=4.0, flops_per_step=1e9)] * 3)
+        path = str(tmp_path / "t.json")
+        calibrate.save_table(table, path)
+        assert calibrate.load_table(path)["constants"]["peak_flops"] == \
+            pytest.approx(2.5e11)
+        with open(path, "w") as f:
+            json.dump({"v": 99}, f)
+        with pytest.raises(ValueError, match="calibration table"):
+            calibrate.load_table(path)
+
+    def test_fit_rate_degenerate(self):
+        assert calibrate.fit_rate([]) is None
+        assert calibrate.fit_rate([(0.0, 1.0), (1.0, 0.0)]) is None
+        assert calibrate.fit_rate([(2.0, 1.0)]) == pytest.approx(2.0)
+
+
+class TestCostModelRerank:
+    def test_constants_override_denominators(self):
+        cm = cost_model.CostModel(constants={"peak_flops": 2.5e11,
+                                             "hbm_bandwidth": 2.5e10,
+                                             "net_bandwidth": 1e9})
+        assert cm.peak == 2.5e11
+        assert cm.hbm_bw == 2.5e10
+        assert cm.net_bw == 1e9
+        # explicit kwargs still win over the measured table
+        cm = cost_model.CostModel(peak=1.0,
+                                  constants={"peak_flops": 2.5e11})
+        assert cm.peak == 1.0
+
+    def test_calibrated_constants_rerank_the_search(self):
+        """The acceptance pin: a measured interconnect so slow that
+        every wire byte dominates must hand the win to the plan moving
+        the fewest bytes — calibration changes the ORDER, not just the
+        prices."""
+        nominal = plan_search.search("gpt")
+        assert nominal.ranked
+        cm = cost_model.CostModel(
+            constants={"net_bandwidth": 1.0})  # 1 B/s interconnect
+        slow = plan_search.search("gpt", cm=cm)
+        assert slow.ranked
+        best_plan, best_score = slow.ranked[0]
+        assert best_score["comm_bytes"] == min(
+            s["comm_bytes"] for _, s in slow.ranked)
+        # and the prices moved: the same winning plan costs more under
+        # the measured (slower) constants than under the nominal table
+        nom_by_desc = {p.describe(): s for p, s in nominal.ranked}
+        moved = [d for p, s in slow.ranked
+                 for d in [p.describe()]
+                 if d in nom_by_desc and s["comm_bytes"] > 0
+                 and s["total_s"] > nom_by_desc[d]["total_s"]]
+        assert moved, "slow interconnect re-priced no comm-bearing plan"

@@ -8,6 +8,7 @@
     python tools/metrics_dump.py --numerics               # numerics telescope
     python tools/metrics_dump.py --quantized              # int8 grad reduce
     python tools/metrics_dump.py --mpmd                   # stage-graph pipeline
+    python tools/metrics_dump.py --ledger                 # perf ledger + sentinel
     python tools/metrics_dump.py --model bert --prometheus
     python tools/metrics_dump.py --all --json             # machine-readable
     python tools/metrics_dump.py --serving --trace        # + span summary
@@ -78,6 +79,11 @@ _REQUIRED = {
     # traced step share their stage_graph root's trace_id
     "mpmd": ("kv_handoff_bytes_total", "collective_bytes_saved_total",
              "collective_bytes_total", "compile_cache_total"),
+    # the perf ledger (docs/OBSERVABILITY.md "Perf ledger"): rows landing
+    # per armed trainer step plus one sentinel fire from the loop's
+    # deliberate failpoint-delayed step
+    "ledger": ("perf_ledger_rows_total", "perf_regression_total",
+               "step_latency_ms", "compile_cache_total"),
 }
 
 #: (family, label, value) series that must exist in a target's snapshot,
@@ -91,6 +97,8 @@ _REQUIRED_SERIES = {
               ("tpp_kernel_calls_total", "op", "fused_mlp")),
     "mpmd": (("collective_bytes_saved_total", "op", "stage_edge"),
              ("collective_bytes_total", "op", "stage_edge")),
+    "ledger": (("perf_ledger_rows_total", "site", "trainer"),
+               ("perf_regression_total", "metric", "step_ms")),
 }
 
 _DIMS = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
@@ -403,6 +411,73 @@ def run_mpmd_loop(steps=2):
         paddle.set_flags(old)
 
 
+def run_ledger_loop(steps=6, delay_ms=400):
+    """The perf-ledger target: a tiny-GPT train loop with
+    FLAGS_perf_ledger armed (interval=1, warmup=3, rows into a
+    throwaway JSONL) — every warm step appends a row
+    (perf_ledger_rows_total{site=trainer}) and builds the sentinel's
+    EMA baseline; one final step runs under a planted
+    ``trainer/batch=delay:MS`` failpoint (inside the step-timer window,
+    before the exec window) so its step_ms lands sigma-out-of-band and
+    perf_regression_total{site=trainer,metric=step_ms} fires — the
+    regression sentinel's whole loop in one target."""
+    import os as _os
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import flags
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM, GPTPretrainLoss
+    from paddle_tpu.monitor import perfledger
+    from paddle_tpu.testing import failpoints
+
+    old = {k: flags.get_flag(k)
+           for k in ("perf_ledger", "perf_ledger_path",
+                     "perf_ledger_warmup", "perf_ledger_interval")}
+    fd, path = tempfile.mkstemp(suffix=".jsonl",
+                                prefix="paddle_tpu_ledger_")
+    _os.close(fd)
+    paddle.set_flags({"perf_ledger": True, "perf_ledger_path": path,
+                      "perf_ledger_warmup": 3, "perf_ledger_interval": 1})
+    perfledger.reset_ledger()   # re-read the knobs just set
+    try:
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        model = GPTForCausalLM(GPTConfig(max_seq_len=64, **_DIMS))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+        trainer = SpmdTrainer(model, opt, loss_fn=GPTPretrainLoss(),
+                              mesh=mesh)
+        batch = [paddle.to_tensor(
+            rng.randint(0, 256, (2, 16)).astype(np.int32))
+            for _ in range(2)]
+        for _ in range(steps):
+            trainer.train_step(*batch)
+        with failpoints.scoped(f"trainer/batch=delay:{delay_ms}"):
+            trainer.train_step(*batch)   # the sentinel's job
+        led = perfledger.get_ledger()
+        rows = perfledger.load_rows(path)
+        if not rows:
+            raise RuntimeError("armed trainer appended no ledger rows")
+        if not any(r["metric"] == "step_ms" for r in led.regressions):
+            raise RuntimeError(
+                "planted trainer/batch delay fired no step_ms regression")
+        return {"rows": len(rows), "rows_written": led.rows_written,
+                "regressions": list(led.regressions),
+                "sites": sorted({r.get("site") for r in rows})}
+    finally:
+        paddle.set_flags(old)
+        perfledger.reset_ledger()
+        try:
+            _os.unlink(path)
+        except OSError:
+            pass
+
+
 def run_blackbox_loop(new_tokens=4):
     """The flight-recorder target: a short serving loop with the
     recorder ON, then one on-demand dump bundle into a throwaway dir —
@@ -442,6 +517,24 @@ def _series_moved(m, s):
     return True                      # a gauge legitimately reads 0
 
 
+def _histogram_summaries():
+    """p50/p90/p99 digests (registry ``summary()``) of every live
+    histogram series — keyed ``family{labels}``; what the human output
+    prints under ``# histograms`` and --json carries per target."""
+    from paddle_tpu import monitor
+
+    out = {}
+    for m in monitor.default_registry().metrics():
+        if m.kind != "histogram":
+            continue
+        for s in m.series():
+            if not s.count:
+                continue
+            lab = ",".join(f"{k}={v}" for k, v in sorted(s.labels.items()))
+            out[m.name + ("{" + lab + "}" if lab else "")] = s.summary()
+    return out
+
+
 def _metric_families(snap):
     """Families with at least one live series. A counter/histogram family
     whose every series is zero counts as EMPTY: monitor.reset() keeps
@@ -464,7 +557,8 @@ def run_target(name, with_trace=False):
     monitor.reset()
     trace_summary = None
     kind = (name if name in ("serving", "router", "blackbox", "federated",
-                             "numerics", "quantized", "async", "mpmd")
+                             "numerics", "quantized", "async", "mpmd",
+                             "ledger")
             else "train")
     if with_trace:
         trace.clear()
@@ -486,6 +580,8 @@ def run_target(name, with_trace=False):
             run_async_loop()
         elif kind == "mpmd":
             run_mpmd_loop()
+        elif kind == "ledger":
+            run_ledger_loop()
         else:
             run_train_step(name)
     finally:
@@ -493,6 +589,7 @@ def run_target(name, with_trace=False):
             trace_summary = trace.snapshot_summary(3)
             trace.disable()
     snap = monitor.snapshot()
+    summaries = _histogram_summaries()
     fams = _metric_families(snap)
     findings = []
     for req in _REQUIRED[kind]:
@@ -513,7 +610,7 @@ def run_target(name, with_trace=False):
     for key, val in sorted(flatten(snap).items()):
         findings.append({"pass": "metrics", "severity": "info",
                          "message": f"{key} = {val}", "where": name})
-    return snap, findings, trace_summary
+    return snap, findings, trace_summary, summaries
 
 
 def build_report(targets, with_trace=False):
@@ -521,13 +618,15 @@ def build_report(targets, with_trace=False):
     report = {"tool": "metrics_dump", "passes": [], "targets": {},
               "totals": {"error": 0, "warning": 0, "info": 0}}
     for name in targets:
-        snap, findings, trace_summary = run_target(name,
-                                                   with_trace=with_trace)
+        snap, findings, trace_summary, summaries = run_target(
+            name, with_trace=with_trace)
         counts = {"error": 0, "warning": 0, "info": 0}
         for f in findings:
             counts[f["severity"]] += 1
         report["targets"][name] = {"name": name, "counts": counts,
                                    "findings": findings, "snapshot": snap}
+        if summaries:
+            report["targets"][name]["histograms"] = summaries
         if trace_summary is not None:
             report["targets"][name]["trace"] = trace_summary
         for sev, n in counts.items():
@@ -582,10 +681,16 @@ def main(argv=None):
                          "unless kv_handoff_bytes_total and "
                          "collective_bytes_{total,saved_total}"
                          "{op=stage_edge} are present")
+    ap.add_argument("--ledger", action="store_true", dest="ledger",
+                    help="run the perf-ledger target (tiny-GPT loop with "
+                         "FLAGS_perf_ledger armed + one failpoint-delayed "
+                         "step); exit 1 unless perf_ledger_rows_total"
+                         "{site=trainer} and perf_regression_total"
+                         "{metric=step_ms} are present")
     ap.add_argument("--all", action="store_true",
                     help="all models + the serving loop + the router, "
                          "flight-recorder, federated, numerics, "
-                         "quantized, async and mpmd tiers")
+                         "quantized, async, mpmd and perf-ledger tiers")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the graph_lint-schema machine report")
     ap.add_argument("--prometheus", action="store_true",
@@ -612,14 +717,17 @@ def main(argv=None):
         targets.append("async")
     if args.mpmd:
         targets.append("mpmd")
+    if args.ledger:
+        targets.append("ledger")
     if args.all:
         targets = list(MODEL_TARGETS) + ["serving", "router", "blackbox",
                                          "federated", "numerics",
-                                         "quantized", "async", "mpmd"]
+                                         "quantized", "async", "mpmd",
+                                         "ledger"]
     if not targets:
         ap.error("pick a target: --model NAME, --serving, --router, "
                  "--blackbox, --federated, --numerics, --quantized, "
-                 "--async, --mpmd or --all")
+                 "--async, --mpmd, --ledger or --all")
 
     report = build_report(targets, with_trace=args.with_trace)
     if args.as_json:
@@ -636,6 +744,10 @@ def main(argv=None):
             if "trace" in t:
                 print(json.dumps({"trace": t["trace"]}, sort_keys=True))
             print(json.dumps(t["snapshot"], indent=1, sort_keys=True))
+            if "histograms" in t:
+                print("# histograms (p50/p90/p99)")
+                for key, d in sorted(t["histograms"].items()):
+                    print(f"{key}: " + json.dumps(d, sort_keys=True))
     return 1 if report["totals"]["error"] else 0
 
 

@@ -1,0 +1,374 @@
+"""Perf report CLI: record, check, calibrate and explain the perf ledger.
+
+    python tools/perf_report.py --record --path perf.jsonl    # append one
+                                                        # measured tiny-GPT
+                                                        # window of rows
+    python tools/perf_report.py --check --path perf.jsonl     # fresh
+                                                        # measurement vs the
+                                                        # ledger baselines;
+                                                        # exit 1 names every
+                                                        # regressed
+                                                        # site+metric
+    python tools/perf_report.py --check --path perf.jsonl --inject \
+            trainer/batch=delay:400                     # sentinel self-test:
+                                                        # plant a slowdown in
+                                                        # the fresh run
+    python tools/perf_report.py --calibrate --path perf.jsonl \
+            --out table.json                            # least-squares the
+                                                        # measured constants
+                                                        # (plan_search
+                                                        # --calibrated eats
+                                                        # the table)
+    python tools/perf_report.py --explain --path perf.jsonl   # what the
+                                                        # ledger knows
+    python tools/perf_report.py --check --path perf.jsonl --json
+
+The ledger (monitor/perfledger.py, FLAGS_perf_ledger) is the persistent
+record; this CLI is the loop around it. --record measures this machine
+(a CPU-shrunk tiny-GPT train window, the tools/metrics_dump.py
+convention) and appends rows. --check re-measures into a THROWAWAY
+ledger — a regressed check must never contaminate the baselines — and
+compares every sentinel-directed (site, metric) mean against the stored
+rows' EMA baselines (cold compile-resolving rows excluded); each
+breach is one error finding naming the site and metric. --calibrate
+fits effective peak-flops / HBM / interconnect constants
+(analysis/calibrate.py) into the table ``plan_search --calibrated``
+consumes.
+
+Report format: the tools/graph_lint.py schema ({"tool", "passes",
+"rules", "targets": {name: {"name", "counts", "findings"}}, "totals"})
+so CI reads every audit tool through one loader. Exit 1 when any
+error-severity finding exists.
+"""
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: this tool's own finding rules (calibration adds analysis/calibrate.py
+#: RULES; both tables ride in the report's "rules" list)
+RULES = {
+    # a fresh measurement breached its stored baseline — names site+metric
+    "perf-regression": "error",
+    # a measured sentinel-direction metric has no (or too short) baseline
+    "perf-no-baseline": "warning",
+    # the ledger holds no usable rows for the requested operation
+    "perf-ledger-empty": "error",
+    # --record appended nothing (armed run produced no rows)
+    "perf-record-empty": "error",
+}
+
+_DIMS = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+             dropout=0.0)
+
+
+def _finding(pass_name, severity, message, where=""):
+    return {"pass": pass_name, "severity": severity, "message": message,
+            "where": where}
+
+
+def _measure(path, steps=8, inject=None):
+    """One armed tiny-GPT train window appending rows to ``path``:
+    the measurement both --record and --check share (--check points it
+    at a throwaway file). Returns the trainer's stats()."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM, GPTPretrainLoss
+    from paddle_tpu.monitor import perfledger
+    from paddle_tpu.testing import failpoints
+
+    from paddle_tpu import flags
+
+    old = {k: flags.get_flag(k)
+           for k in ("perf_ledger", "perf_ledger_path",
+                     "perf_ledger_interval")}
+    paddle.set_flags({"perf_ledger": True, "perf_ledger_path": path,
+                      "perf_ledger_interval": 1})
+    perfledger.reset_ledger()
+    try:
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        model = GPTForCausalLM(GPTConfig(max_seq_len=64, **_DIMS))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        mesh = build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+        trainer = SpmdTrainer(model, opt, loss_fn=GPTPretrainLoss(),
+                              mesh=mesh)
+        batch = [paddle.to_tensor(
+            rng.randint(0, 256, (2, 16)).astype(np.int32))
+            for _ in range(2)]
+        if inject:
+            with failpoints.scoped(inject):
+                for _ in range(steps):
+                    trainer.train_step(*batch)
+        else:
+            for _ in range(steps):
+                trainer.train_step(*batch)
+        return trainer.stats()
+    finally:
+        paddle.set_flags(old)
+        perfledger.reset_ledger()
+
+
+def _fresh_means(rows):
+    """Per-(site, metric) mean over a fresh run's warm (non-cold) rows,
+    sentinel-directed metrics only."""
+    from paddle_tpu.monitor import perfledger as pl
+
+    acc = {}
+    for r in rows:
+        m = r.get("metrics") or {}
+        if m.get("cold"):
+            continue
+        site = r.get("site")
+        for name, v in m.items():
+            if name not in pl.HIGH_IS_BAD and name not in pl.LOW_IS_BAD:
+                continue
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            s, n = acc.get((site, name), (0.0, 0))
+            acc[(site, name)] = (s + float(v), n + 1)
+    return {k: s / n for k, (s, n) in acc.items() if n}
+
+
+def run_record(path, steps=8):
+    from paddle_tpu.monitor import perfledger as pl
+
+    before = len(pl.load_rows(path))
+    _measure(path, steps=steps)
+    after = len(pl.load_rows(path))
+    findings = []
+    if after <= before:
+        findings.append(_finding(
+            "perf-record-empty", "error",
+            f"armed measurement appended no rows to {path}", where=path))
+    else:
+        findings.append(_finding(
+            "record", "info",
+            f"appended {after - before} row(s) ({after} total) to {path}",
+            where=path))
+    return findings
+
+
+def run_check(path, steps=8, sigma=None, inject=None):
+    """Fresh measurement vs the ledger's EMA baselines; one error
+    finding per breached (site, metric)."""
+    import tempfile
+
+    from paddle_tpu import flags
+    from paddle_tpu.monitor import perfledger as pl
+
+    if sigma is None:
+        sigma = float(flags.get_flag("perf_ledger_sigma", 4.0))
+    rows = pl.load_rows(path)
+    if not rows:
+        return [_finding(
+            "perf-ledger-empty", "error",
+            f"no usable rows in {path!r} — run --record first",
+            where=path)]
+    base = pl.baselines(rows)
+    warmup = max(2, int(flags.get_flag("perf_ledger_warmup", 5)))
+    fd, tmp = tempfile.mkstemp(suffix=".jsonl", prefix="perf_check_")
+    os.close(fd)
+    try:
+        _measure(tmp, steps=steps, inject=inject)
+        fresh = _fresh_means(pl.load_rows(tmp))
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    findings = []
+    if not fresh:
+        return [_finding(
+            "perf-ledger-empty", "error",
+            "fresh measurement produced no warm rows to check",
+            where=path)]
+    for (site, metric) in sorted(fresh):
+        value = fresh[(site, metric)]
+        ema = base.get((site, metric))
+        if ema is None or ema.n < warmup:
+            findings.append(_finding(
+                "perf-no-baseline", "warning",
+                f"{site}/{metric}: {0 if ema is None else ema.n} stored "
+                f"observation(s) (need {warmup}) — measured {value:.4g}, "
+                "not checked", where=f"{site}/{metric}"))
+            continue
+        regressed, excess = pl.check_value(ema, metric, value, sigma)
+        if regressed:
+            findings.append(_finding(
+                "perf-regression", "error",
+                f"{site}/{metric} regressed: measured {value:.4g} vs "
+                f"baseline {ema.mean:.4g} ± {ema.std():.4g} "
+                f"({excess:.1f} floored sigmas, threshold {sigma:g})",
+                where=f"{site}/{metric}"))
+        else:
+            findings.append(_finding(
+                "check", "info",
+                f"{site}/{metric}: {value:.4g} within baseline "
+                f"{ema.mean:.4g} ± {ema.std():.4g} ({excess:+.1f}σ)",
+                where=f"{site}/{metric}"))
+    return findings
+
+
+def run_calibrate(path, out=None):
+    from paddle_tpu.analysis import calibrate
+    from paddle_tpu.monitor import perfledger as pl
+
+    rows = pl.load_rows(path)
+    if not rows:
+        return [_finding(
+            "perf-ledger-empty", "error",
+            f"no usable rows in {path!r} — run --record first",
+            where=path)], None
+    table, calib_findings = calibrate.calibrate(rows)
+    findings = [f.to_dict() for f in calib_findings]
+    findings.append(_finding(
+        "calibrate", "info",
+        f"fit {sorted(table['constants'])} from {table['rows']} row(s) "
+        f"(of {table['rows_total']} total) for env "
+        f"{pl.fingerprint_key(table['env'])}", where=path))
+    if out:
+        calibrate.save_table(table, out)
+        findings.append(_finding(
+            "calibrate", "info", f"table written to {out}", where=out))
+    return findings, table
+
+
+def run_explain(path):
+    """What the ledger knows: row counts per site/env, the baselines a
+    --check would enforce, and the recent regressions rows recorded."""
+    from paddle_tpu.monitor import perfledger as pl
+
+    rows = pl.load_rows(path)
+    if not rows:
+        return [_finding(
+            "perf-ledger-empty", "error",
+            f"no usable rows in {path!r} — run --record first",
+            where=path)]
+    findings = []
+    sites, envs = {}, {}
+    for r in rows:
+        sites[r.get("site")] = sites.get(r.get("site"), 0) + 1
+        key = pl.fingerprint_key(r.get("env") or {})
+        envs[key] = envs.get(key, 0) + 1
+    findings.append(_finding(
+        "explain", "info",
+        f"{len(rows)} row(s): " +
+        ", ".join(f"{s}={n}" for s, n in sorted(sites.items())),
+        where=path))
+    for key, n in sorted(envs.items()):
+        findings.append(_finding(
+            "explain", "info", f"env [{key}]: {n} row(s)", where=path))
+    for (site, metric), ema in sorted(pl.baselines(rows).items()):
+        findings.append(_finding(
+            "explain", "info",
+            f"baseline {site}/{metric}: {ema.mean:.4g} ± {ema.std():.4g} "
+            f"over {ema.n} obs", where=f"{site}/{metric}"))
+    return findings
+
+
+def build_report(ops, path, steps=8, sigma=None, inject=None, out=None):
+    """graph_lint-schema report over the requested operations."""
+    from paddle_tpu.analysis import calibrate
+
+    rules = dict(RULES)
+    rules.update(calibrate.RULES)
+    report = {"tool": "perf_report", "passes": sorted(ops),
+              "rules": sorted(rules), "targets": {},
+              "totals": {"error": 0, "warning": 0, "info": 0}}
+    for op in ops:
+        if op == "record":
+            findings = run_record(path, steps=steps)
+        elif op == "check":
+            findings = run_check(path, steps=steps, sigma=sigma,
+                                 inject=inject)
+        elif op == "calibrate":
+            findings, table = run_calibrate(path, out=out)
+            if table is not None:
+                report["calibration"] = table
+        else:
+            findings = run_explain(path)
+        counts = {"error": 0, "warning": 0, "info": 0}
+        for f in findings:
+            counts[f["severity"]] += 1
+        report["targets"][op] = {"name": op, "counts": counts,
+                                 "findings": findings}
+        for sev, n in counts.items():
+            report["totals"][sev] += n
+    return report
+
+
+def main(argv=None):
+    from paddle_tpu import flags
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--path", default=None, metavar="LEDGER",
+                    help="the ledger JSONL (default: "
+                         "FLAGS_perf_ledger_path)")
+    ap.add_argument("--record", action="store_true",
+                    help="measure this machine and append rows")
+    ap.add_argument("--check", action="store_true",
+                    help="fresh measurement vs the stored baselines; "
+                         "exit 1 names every regressed site+metric")
+    ap.add_argument("--calibrate", action="store_true",
+                    help="least-squares the measured cost-model "
+                         "constants from the rows (see --out)")
+    ap.add_argument("--explain", action="store_true",
+                    help="row counts, env groups and the baselines a "
+                         "--check would enforce")
+    ap.add_argument("--out", default=None, metavar="TABLE",
+                    help="where --calibrate writes the constants table "
+                         "(plan_search --calibrated reads it)")
+    ap.add_argument("--steps", type=int, default=8,
+                    help="train steps per measurement window (default 8)")
+    ap.add_argument("--sigma", type=float, default=None,
+                    help="regression threshold in floored EMA sigmas "
+                         "(default FLAGS_perf_ledger_sigma)")
+    ap.add_argument("--inject", default=None, metavar="SPEC",
+                    help="failpoint spec planted during the --check "
+                         "measurement (sentinel self-test, e.g. "
+                         "trainer/batch=delay:400)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the machine-readable report")
+    args = ap.parse_args(argv)
+
+    ops = [op for op, on in (("record", args.record), ("check", args.check),
+                             ("calibrate", args.calibrate),
+                             ("explain", args.explain)) if on]
+    if not ops:
+        ap.error("pick an operation: --record, --check, --calibrate "
+                 "and/or --explain")
+    path = args.path or flags.get_flag("perf_ledger_path", "")
+    if not path:
+        ap.error("no ledger path: pass --path or set "
+                 "FLAGS_perf_ledger_path")
+
+    report = build_report(ops, path, steps=args.steps, sigma=args.sigma,
+                          inject=args.inject, out=args.out)
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        for name, t in report["targets"].items():
+            print(f"{name}:")
+            for f in t["findings"]:
+                print(f"  [{f['severity']}] {f['pass']}: {f['message']}")
+        t = report["totals"]
+        print(f"total: {t['error']} error(s), {t['warning']} warning(s), "
+              f"{t['info']} info across {len(report['targets'])} "
+              "target(s)")
+    return 1 if report["totals"]["error"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

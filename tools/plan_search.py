@@ -10,6 +10,11 @@
     python tools/plan_search.py --model gpt --model bert --json
     python tools/plan_search.py --model gpt --emit      # winning plan as a
                                                         # ready-to-run config
+    python tools/plan_search.py --model gpt --calibrated table.json
+                                                        # price plans with
+                                                        # measured constants
+                                                        # (perf_report
+                                                        # --calibrate)
     python tools/plan_search.py --model gpt --hbm-gb 0.001   # shrink the
                                                         # budget: every plan
                                                         # rejected -> exit 1
@@ -81,16 +86,32 @@ def _explain_lines(result, top=None):
     return lines
 
 
-def build_report(models, devices=None, hbm_bytes=None, top=None):
+def build_report(models, devices=None, hbm_bytes=None, top=None,
+                 calibrated=None):
     """Run the search per model; returns (graph_lint-schema report,
-    {model: SearchResult})."""
+    {model: SearchResult}). ``calibrated`` is a calibration-table path
+    (tools/perf_report.py --calibrate): its measured constants replace
+    the nominal peak-flops/HBM/interconnect rates in the cost model —
+    ranking only; validity checks are constant-free."""
     from paddle_tpu.analysis import registered_passes
     from paddle_tpu.analysis import cost_model, plan_search
 
+    cm = None
+    calibration = None
+    if calibrated:
+        from paddle_tpu.analysis import calibrate
+
+        table = calibrate.load_table(calibrated)
+        constants = calibrate.constants_for_cost_model(table)
+        cm = cost_model.CostModel(
+            hbm_bytes=hbm_bytes or cost_model.DEFAULT_HBM_BYTES,
+            constants=constants)
+        calibration = {"path": calibrated, "rows": table.get("rows"),
+                       "env": table.get("env"), "constants": constants}
     results, targets = {}, {}
     for model in models:
         res = plan_search.search(model, devices=devices,
-                                 hbm_bytes=hbm_bytes)
+                                 hbm_bytes=hbm_bytes, cm=cm)
         results[model] = res
         targets[f"plan_{model}"] = res.to_report(top=top)
     totals = {"error": 0, "warning": 0, "info": 0}
@@ -99,13 +120,16 @@ def build_report(models, devices=None, hbm_bytes=None, top=None):
             totals[sev] = totals.get(sev, 0) + n
     rules = dict(cost_model.RULES)
     rules.update(plan_search.RULES)
-    return {
+    report = {
         "tool": "plan_search",
         "passes": registered_passes(),
         "rules": sorted(rules),
         "targets": {n: r.to_dict() for n, r in targets.items()},
         "totals": totals,
-    }, results
+    }
+    if calibration is not None:
+        report["calibration"] = calibration
+    return report, results
 
 
 def main(argv=None):
@@ -124,6 +148,11 @@ def main(argv=None):
     ap.add_argument("--hbm-gb", type=float, default=None, dest="hbm_gb",
                     metavar="GB",
                     help="per-device HBM budget in GiB (default 16)")
+    ap.add_argument("--calibrated", default=None, metavar="TABLE",
+                    help="price plans with the measured constants from a "
+                         "calibration table (tools/perf_report.py "
+                         "--calibrate) instead of the nominal "
+                         "peak-flops/HBM/interconnect rates")
     ap.add_argument("--explain", action="store_true",
                     help="per-plan cost-term breakdown + every rejected "
                          "plan with the analyzer pass that rejected it")
@@ -138,7 +167,8 @@ def main(argv=None):
     models = list(args.model) or ["gpt"]
     hbm_bytes = int(args.hbm_gb * (1 << 30)) if args.hbm_gb else None
     report, results = build_report(models, devices=args.devices,
-                                   hbm_bytes=hbm_bytes, top=args.top)
+                                   hbm_bytes=hbm_bytes, top=args.top,
+                                   calibrated=args.calibrated)
 
     if args.as_json:
         report["search"] = {m: r.to_dict(top=args.top)
