@@ -74,6 +74,7 @@ STRUCTURAL_FLAGS = (
     "use_bfloat16",
     "flash_attention_block",
     "mpmd",
+    "paged_kv",
 )
 
 #: function names whose bodies ARE executable-identity expressions —

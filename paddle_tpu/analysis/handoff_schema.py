@@ -62,6 +62,7 @@ RULES = {
 #: audit fails on either half alone.
 EDGES = {
     "disagg_kv": ("paddle_tpu/serving/disagg.py", "HANDOFF_SCHEMA"),
+    "kv_page_admit": ("paddle_tpu/serving/paging.py", "HANDOFF_SCHEMA"),
     "pipeline_stage": ("paddle_tpu/distributed/pipeline.py",
                        "HANDOFF_SCHEMA"),
     "federated_adapter": ("paddle_tpu/federated/averaging.py",

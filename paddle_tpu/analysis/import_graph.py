@@ -54,6 +54,7 @@ LAZY_MODULES = (
     "paddle_tpu.analysis.plan_search",       # plan enumerator (ISSUE 16)
     "paddle_tpu.monitor.perfledger",         # perf ledger + sentinel (ISSUE 17)
     "paddle_tpu.analysis.calibrate",         # measured-constant fits (ISSUE 17)
+    "paddle_tpu.serving.paging",             # paged KV block pool (ISSUE 18)
 )
 
 #: what a plain trainer/engine process imports (the roots of the closure

@@ -301,9 +301,16 @@ def sequence_parallel_attention(q, k, v, mesh, impl="ring", causal=False,
     try:
         mapped = smap(body, mesh=mesh, in_specs=(spec, spec, spec),
                       out_specs=spec, **kw)
-    except TypeError:  # older jax: no check_vma param (no vma checking)
-        mapped = smap(body, mesh=mesh, in_specs=(spec, spec, spec),
-                      out_specs=spec)
+    except TypeError:
+        # older jax spells the knob check_rep; keep the check off when
+        # the flash body's pallas_call outputs carry no vma typing
+        try:
+            mapped = smap(body, mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec,
+                          **({"check_rep": False} if kw else {}))
+        except TypeError:  # no replication-check knob in this jax at all
+            mapped = smap(body, mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec)
     return mapped(q, k, v)
 
 

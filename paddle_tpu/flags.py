@@ -200,6 +200,23 @@ define_flag("mpmd", False,
             "Unset, distributed/stage.py is never imported "
             "(manifest-lazy; analysis/import_graph.py) and behavior is "
             "byte-identical")
+define_flag("paged_kv", False,
+            "paged KV-cache + batched multi-LoRA serving "
+            "(serving/paging.py, arXiv:2309.06180 recipe): ServingEngine "
+            "replaces its dense [max_batch, max_seq] KV cache with a "
+            "physical block pool + per-slot block tables — whole-budget "
+            "reservation at admission (PagePoolFullError backpressure "
+            "BEFORE any prefill compute), refcounted shared-prefix "
+            "frames with copy-on-write boundary blocks, int8 cold-page "
+            "compression (page_cold_steps=, EQuARX row codec), and "
+            "named-adapter decode (load_adapter/submit(adapter=)) "
+            "batched in the ONE jitted step via a gathered low-rank "
+            "delta — no per-adapter programs, no recompiles. Read at "
+            "ENGINE CONSTRUCTION — a post-construction toggle under a "
+            "live paged engine raises; the boolean joins the serving AOT "
+            "extra_key so paged executables never alias dense ones. "
+            "Unset, serving/paging.py is never imported (manifest-lazy; "
+            "analysis/import_graph.py) and the engine is byte-identical")
 define_flag("blackbox", False,
             "black-box flight recorder on/off (monitor/blackbox.py): "
             "progress beacons, the bounded event ring, and dump-bundle "

@@ -28,7 +28,7 @@ from ..nn.layer.common import Linear
 from ..nn.layer.layers import Layer
 
 __all__ = ["LoRALinear", "apply_lora", "merge_lora", "lora_parameters",
-           "lora_state_dict", "mark_only_lora_trainable"]
+           "lora_state_dict", "mark_only_lora_trainable", "export_lora"]
 
 
 def _freeze(p):
@@ -220,3 +220,32 @@ def lora_state_dict(layer):
     """Adapter-only checkpoint: {qualified_name: numpy array} for A/B."""
     return {n: np.asarray(p.numpy()) for n, p in layer.named_parameters()
             if "lora_A" in n or "lora_B" in n}
+
+
+def export_lora(layer):
+    """One adapter in serving-export form: ``{"rank": r, "scaling": s,
+    "factors": {qualified_name: {"A": [in, r], "B": [r, out]}}}`` with
+    plain numpy factors. This is the unit ``ServingEngine.load_adapter``
+    accepts — the decode model's ``lora_pack`` maps the qualified names
+    onto its stacked per-layer sites. Rank and scaling must be uniform
+    across sites: the batched multi-LoRA decode stacks every adapter into
+    ONE ``[S, L, in, r]`` tensor, so there is no per-site rank axis."""
+    factors, ranks, scalings = {}, set(), set()
+    for qual, sub in layer.named_sublayers():
+        if isinstance(sub, LoRALinear):
+            factors[qual] = {"A": np.asarray(sub.lora_A.numpy()),
+                             "B": np.asarray(sub.lora_B.numpy())}
+            ranks.add(int(sub.r))
+            scalings.add(float(sub.scaling))
+    if not factors:
+        raise ValueError(
+            "export_lora: no LoRALinear sublayers found — apply_lora first "
+            "(merged adapters cannot be exported; keep them un-merged for "
+            "multi-LoRA serving)")
+    if len(ranks) != 1 or len(scalings) != 1:
+        raise ValueError(
+            f"export_lora: multi-LoRA serving needs ONE uniform rank and "
+            f"scaling per adapter, got ranks={sorted(ranks)}, "
+            f"scalings={sorted(scalings)}")
+    return {"rank": ranks.pop(), "scaling": scalings.pop(),
+            "factors": factors}

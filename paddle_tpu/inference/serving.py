@@ -91,6 +91,14 @@ class QueueFullError(RuntimeError):
     """submit() rejected: the bounded admission queue is full and the
     request's priority does not outrank any queued entry."""
 
+
+class _AdapterUnavailable(RuntimeError):
+    """Paged admission found the request's adapter not loaded (evicted
+    mid-flight): requeue-at-head backpressure, exactly like
+    ``PagePoolFullError`` — never a reason='error' finish. The request
+    re-admits, and regenerates bit-identically, once the adapter is
+    loaded again."""
+
 # engine metrics in the default registry (every engine in the process
 # shares them; per-engine views live on ServingEngine.stats())
 _REQ_SUBMITTED = _monitor.counter(
@@ -168,7 +176,7 @@ class Request:
 
     def __init__(self, rid, prompt_ids, max_new_tokens, temperature=0.0,
                  top_k=None, top_p=None, seed=None, prefix_id=None,
-                 prefix_len=0, deadline_ms=None, priority=0):
+                 prefix_len=0, deadline_ms=None, priority=0, adapter=None):
         self.rid = rid
         self.prompt_ids = np.asarray(prompt_ids, np.int32).ravel()
         self.max_new_tokens = int(max_new_tokens)
@@ -178,6 +186,7 @@ class Request:
         self.seed = rid if seed is None else int(seed)
         self.prefix_id = prefix_id          # registered shared prefix, or
         self.prefix_len = int(prefix_len)   # 0 = no prefix reuse
+        self.adapter = adapter    # loaded LoRA adapter name (paged engines)
         self.deadline_ms = deadline_ms      # None = no deadline
         self.priority = int(priority)       # higher outranks on a full queue
         self.output_ids = []          # generated tokens (no prompt echo)
@@ -269,7 +278,9 @@ class ServingEngine:
                  eos_token_id=None, prompt_buckets=(32, 64, 128, 256, 512,
                                                     1024), tp_mesh=None,
                  prefill_chunk=None, draft_model=None, spec_k=4,
-                 max_queue=None, decode_model=None):
+                 max_queue=None, decode_model=None, page_block=None,
+                 page_blocks=None, max_adapters=None, lora_rank=None,
+                 page_cold_steps=None):
         import jax
         import jax.numpy as jnp
 
@@ -296,6 +307,47 @@ class ServingEngine:
         if max_queue is not None and int(max_queue) < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self._max_queue = None if max_queue is None else int(max_queue)
+        # paged KV + batched multi-LoRA serving (FLAGS_paged_kv, ISSUE 18).
+        # STRUCTURAL and construction-consumed: the boolean read here joins
+        # the AOT extra_key below (paged executables never alias dense
+        # ones), and _paged_active() raises on a post-construction disarm.
+        # Armed, the dense [max_batch, max_seq] cache is replaced by a
+        # physical block pool + per-slot block tables (serving/paging.py)
+        # with whole-budget reservation at admission, refcounted prefix
+        # sharing, int8 cold pages, and per-request adapter deltas batched
+        # inside the one jitted decode step.
+        _paged = bool(_flags.get_flag("paged_kv", False))
+        self._paged = _paged
+        _pg_set = sorted(k for k, v in (
+            ("page_block", page_block), ("page_blocks", page_blocks),
+            ("max_adapters", max_adapters), ("lora_rank", lora_rank),
+            ("page_cold_steps", page_cold_steps)) if v is not None)
+        if not _paged and _pg_set:
+            raise ValueError(
+                f"{', '.join(_pg_set)}= need FLAGS_paged_kv=1 — the paged "
+                "engine is flag-gated (structural; consumed at engine "
+                "construction)")
+        if _paged:
+            if tp_mesh is not None:
+                raise ValueError(
+                    "FLAGS_paged_kv does not compose with tp_mesh= serving:"
+                    " the block pool is single-host state — serve tensor-"
+                    "parallel engines dense")
+            if draft_model is not None:
+                raise ValueError(
+                    "FLAGS_paged_kv does not compose with draft_model= "
+                    "(speculative rounds write multi-token columns; the "
+                    "paged scatter writes one frontier column per step)")
+            if cache_dtype is not None:
+                raise ValueError(
+                    "FLAGS_paged_kv does not compose with cache_dtype=: "
+                    "hot pages live at the compute dtype; the cold tier is "
+                    "the pool's int8 page codec (page_cold_steps=)")
+            if prefill_chunk is not None:
+                raise ValueError(
+                    "FLAGS_paged_kv does not compose with prefill_chunk= "
+                    "(paged admission prefills whole prompts into blocks "
+                    "reserved up front)")
         dm_d = None
         if draft_model is not None:
             dm_d = _dm_registry.resolve(draft_model, None)
@@ -333,7 +385,48 @@ class ServingEngine:
                                                    tp_size=tp_size)
         cache_dt = self._compute_dtype or jnp.float32
 
-        if tp_mesh is None:
+        if _paged:
+            # no dense [B, T] cache: physical K/V lives in the block pool;
+            # each decode step gathers it through the block tables into the
+            # exact dense layout fwd consumes, then scatters the frontier
+            # column back (paged programs below)
+            from ..serving import paging as _paging
+
+            self._paging = _paging
+            side = jax.eval_shape(lambda: cache_init(1, self.T, cache_dt))
+            L, _, KVh, _, hd = side[0].shape
+            bs_pg = 16 if page_block is None else int(page_block)
+            if bs_pg < 1 or self.T % bs_pg:
+                raise ValueError(
+                    f"page_block must divide max_seq_len={self.T}, "
+                    f"got {page_block}")
+            maxb = self.T // bs_pg
+            # default pool: every slot can hold a full-length session,
+            # plus the permanent NULL frame — a ceiling, not a win; the
+            # memory win comes from page_blocks= sized to the real
+            # shared-prefix workload (tools/parity_check.py paged_kv)
+            n_blocks = (self.B * maxb + 1 if page_blocks is None
+                        else int(page_blocks))
+            self._pool = _paging.PagePool(
+                (int(L), int(KVh), int(hd)), cache_dt, bs_pg, n_blocks,
+                self.B, self.T, cold_after=page_cold_steps)
+            self._kc = self._vc = None
+            n_ad = 8 if max_adapters is None else int(max_adapters)
+            self._lora_rank = 8 if lora_rank is None else int(lora_rank)
+            self._adapters = None
+            self._lora = None
+            if n_ad > 0:
+                try:
+                    # slot 0 is the permanent all-zero BASE adapter: base
+                    # requests take the lora path with an exact-zero delta
+                    self._lora = dm.lora_init(cfg, n_ad + 1,
+                                              self._lora_rank,
+                                              dtype=self._compute_dtype)
+                    self._adapters = _paging.AdapterRegistry(n_ad)
+                except NotImplementedError:
+                    pass   # pool serves base-only; adapter APIs raise
+            self._adapter_slot = np.zeros(self.B, np.int32)
+        elif tp_mesh is None:
             self._kc, self._vc = cache_init(self.B, self.T, cache_dt)
         else:
             # allocate the GLOBAL cache (full KV heads) sharded on the
@@ -455,6 +548,55 @@ class ServingEngine:
             logits = logits_of(p, x[:, 0]).astype(jnp.float32)
             return _pick(logits, temps, kvec, pvec, seeds, pos_vec), kc, vc
 
+        if _paged:
+            _paging_mod = self._paging
+            _has_lora = self._lora is not None
+
+            def _fwd_pg(p, toks, pos, kc, vc, lora, aids):
+                if _has_lora:
+                    return fwd(p, toks, pos, kc, vc, lora=lora,
+                               adapter_ids=aids)
+                return fwd(p, toks, pos, kc, vc)
+
+            def prefill_paged(p, ids_padded, true_len, lora, aid):
+                """Whole-prompt prefill with the request's adapter delta
+                applied (aid [1]; slot 0 = base = exact-zero add): the
+                prefilled row and first-token logits match a dedicated
+                engine serving that adapter byte-for-byte."""
+                kc1, vc1 = cache_init(1, self.T, cache_dt)
+                x, kc1, vc1 = _fwd_pg(p, ids_padded, 0, kc1, vc1, lora, aid)
+                x_last = jax.lax.dynamic_slice_in_dim(
+                    x, true_len - 1, 1, axis=1)[:, 0]
+                return kc1, vc1, logits_of(p, x_last).astype(jnp.float32)[0]
+
+            def step_greedy_paged(p, kp, vp, tables, last_toks, pos_vec,
+                                  lora, aids):
+                """Paged decode step: gather pool frames -> the dense
+                [L, B, KVh, T, hd] layout, run the UNCHANGED decode math
+                (per-row adapter deltas included), scatter each row's
+                frontier column back into its frame. Junk in null/free
+                columns sits strictly above every row's position, so
+                causal masking makes tokens bit-identical to the dense
+                engine's."""
+                kc, vc = _paging_mod.gather_dense(kp, vp, tables)
+                x, kc, vc = _fwd_pg(p, last_toks[:, None], pos_vec, kc, vc,
+                                    lora, aids)
+                kp, vp = _paging_mod.scatter_cols(kp, vp, kc, vc, tables,
+                                                  pos_vec)
+                logits = logits_of(p, x[:, 0]).astype(jnp.float32)
+                return jnp.argmax(logits, -1).astype(jnp.int32), kp, vp
+
+            def step_sample_paged(p, kp, vp, tables, last_toks, pos_vec,
+                                  temps, kvec, pvec, seeds, lora, aids):
+                kc, vc = _paging_mod.gather_dense(kp, vp, tables)
+                x, kc, vc = _fwd_pg(p, last_toks[:, None], pos_vec, kc, vc,
+                                    lora, aids)
+                kp, vp = _paging_mod.scatter_cols(kp, vp, kc, vc, tables,
+                                                  pos_vec)
+                logits = logits_of(p, x[:, 0]).astype(jnp.float32)
+                return (_pick(logits, temps, kvec, pvec, seeds, pos_vec),
+                        kp, vp)
+
         # every program in the family goes through the persistent AOT
         # compile cache (framework/aot.py): with FLAGS_jit_cache_dir set,
         # a fresh server process deserializes executables instead of
@@ -466,7 +608,7 @@ class ServingEngine:
             return _aot.cached_jit(fn, jit=jit, site="serving", label=label,
                                    donate_argnums=donate,
                                    record_event="serving/compile",
-                                   extra_key=(_mesh_fp,))
+                                   extra_key=(_mesh_fp, _paged))
 
         # donate the big cache through admit/step: XLA aliases it in place
         # instead of copying GBs of K/V per token (the loop this engine
@@ -477,6 +619,16 @@ class ServingEngine:
                                     donate=(1, 2))
             self._step_sample = _cj(step_sample, "step_sample",
                                     donate=(1, 2))
+            if _paged:
+                # pool sides donate through the step exactly like the
+                # dense big cache: the scatter updates them in place
+                self._prefill_pg = _cj(prefill_paged, "prefill_paged")
+                self._step_greedy_pg = _cj(step_greedy_paged,
+                                           "step_greedy_paged",
+                                           donate=(1, 2))
+                self._step_sample_pg = _cj(step_sample_paged,
+                                           "step_sample_paged",
+                                           donate=(1, 2))
         else:
             from jax.sharding import PartitionSpec as P
 
@@ -684,12 +836,22 @@ class ServingEngine:
                                     _blackbox_request_table)
 
     # -- API -----------------------------------------------------------------
-    def register_prefix(self, prefix_ids):
+    def register_prefix(self, prefix_ids, adapter=None):
         """Prefill a shared prefix (e.g. a system prompt) ONCE and cache
         its KV; returns a prefix id for submit(prefix_id=...). Requests
-        using it prefill only their suffix."""
+        using it prefill only their suffix.
+
+        Paged engines (FLAGS_paged_kv): the prefix's full blocks land in
+        the pool ONCE and every session submitting with this prefix_id
+        maps them SHARED (refcounted; a partial boundary block is copied
+        private at admission — copy-on-write). ``adapter=`` prefills the
+        prefix under that loaded adapter's delta; sessions share the
+        frames only when their adapter matches."""
         import jax.numpy as jnp
 
+        if adapter is not None and not self._paged:
+            raise ValueError(
+                "register_prefix(adapter=) needs FLAGS_paged_kv=1")
         ids = prefix_ids._data if isinstance(prefix_ids, Tensor) \
             else np.asarray(prefix_ids)
         ids = np.asarray(ids, np.int32).ravel()
@@ -702,6 +864,21 @@ class ServingEngine:
         pb = self._bucket(n)
         padded = np.zeros((1, pb), np.int32)
         padded[0, :n] = ids
+        if self._paged:
+            aid = self._resolve_adapter_slot(adapter)
+            t0 = time.perf_counter()
+            kc1, vc1, _ = self._prefill_pg(
+                self._params, jnp.asarray(padded), np.int32(n),
+                self._lora, jnp.asarray([aid], np.int32))
+            self._acc_ms("prefill", t0)
+            pid = self._next_pid
+            self._next_pid += 1
+            # full blocks land in the pool once (put_prefix may raise
+            # PagePoolFullError — nothing is registered then); the dense
+            # row is dropped, sessions re-block only their suffix
+            self._pool.put_prefix(pid, kc1[:, 0], vc1[:, 0], n)
+            self._prefixes[pid] = (ids, "paged", adapter, None, None)
+            return pid
         # accounted as a "prefill" slice: the prefill PROGRAM runs here,
         # so its wall time must land in the same breakdown kind its
         # executed-flops counters feed — otherwise stats()['breakdown']
@@ -757,6 +934,24 @@ class ServingEngine:
 
         B, V = self.B, self.cfg.vocab_size
         p = aval(self._params)
+        if self._paged:
+            lens = (list(batch_shapes) if batch_shapes is not None
+                    else list(self._buckets))
+            lora = aval(self._lora)
+            kp, vp = aval(self._pool.kp), aval(self._pool.vp)
+            tb = i32((B, self._pool.maxb))
+            for pb in sorted({self._bucket(int(n)) for n in lens}):
+                warm(self._prefill_pg, p, i32((1, pb)), i32(), lora,
+                     i32((1,)))
+            warm(self._step_greedy_pg, p, kp, vp, tb, i32((B,)),
+                 i32((B,)), lora, i32((B,)))
+            if sampling:
+                warm(self._step_sample_pg, p, kp, vp, tb, i32((B,)),
+                     i32((B,)), f32((B,)), i32((B,)), f32((B,)),
+                     i32((B,)), lora, i32((B,)))
+            warm(self._pick1, f32((V,)), f32(), i32(), f32(), i32(),
+                 i32())
+            return counts
         kc, vc = aval(self._kc), aval(self._vc)
         kc1, vc1 = jax.eval_shape(lambda: self._prefill_start())
         lg_spec = f32((V,))
@@ -876,6 +1071,23 @@ class ServingEngine:
             "breakdown": self._breakdown(),
             "health": self.health(),
         }
+        if self._paged:
+            pg = self._pool.stats()
+            live = sum(1 for r in self._slot_req if r is not None)
+            pg["live_sessions"] = live
+            # pool bytes actually held per live session vs what the dense
+            # engine pins per slot (one full-length row) — the paged-KV
+            # memory win in one ratio (gate-asserted ≥ 2x under shared
+            # prefixes in tests/test_paging_gate.py)
+            pg["kv_bytes_per_session"] = (
+                self._pool.bytes_in_use() / live if live else 0.0)
+            pg["dense_bytes_per_session"] = (
+                self._pool.block_bytes * self._pool.maxb)
+            if self._adapters is not None:
+                ad = self._adapters.stats()
+                ad["loaded_names"] = sorted(self._adapters.loaded())
+                pg["adapters"] = ad
+            out["paging"] = pg
         return out
 
     def _kind_programs(self, kind):
@@ -889,10 +1101,13 @@ class ServingEngine:
         engines therefore understate those kinds' flops by the (small by
         design) draft model's share rather than double-count it."""
         progs = {
-            "prefill": [getattr(self, "_prefill", None)],
+            "prefill": [getattr(self, "_prefill", None),
+                        getattr(self, "_prefill_pg", None)],
             "prefill_chunk": [getattr(self, "_prefill_chunk", None)],
-            "decode_greedy": [getattr(self, "_step_greedy", None)],
-            "decode_sample": [getattr(self, "_step_sample", None)],
+            "decode_greedy": [getattr(self, "_step_greedy", None),
+                              getattr(self, "_step_greedy_pg", None)],
+            "decode_sample": [getattr(self, "_step_sample", None),
+                              getattr(self, "_step_sample_pg", None)],
             "speculative": [getattr(self, "_draft_propose", None),
                             getattr(self, "_verify", None)],
         }
@@ -994,7 +1209,123 @@ class ServingEngine:
         copied it are unaffected; later submits with this id raise."""
         if prefix_id not in self._prefixes:
             raise ValueError(f"unknown prefix_id {prefix_id}")
+        if self._paged and self._prefixes[prefix_id][1] == "paged":
+            # drop the registry's frame references; frames still mapped by
+            # live sessions stay alive until those sessions finish
+            self._pool.drop_prefix(prefix_id)
         del self._prefixes[prefix_id]
+
+    # -- multi-LoRA adapter management (FLAGS_paged_kv engines) --------------
+    def _require_adapters(self):
+        if not self._paged:
+            raise RuntimeError(
+                "multi-LoRA adapters need FLAGS_paged_kv=1 — the paged "
+                "engine owns the adapter registry (docs/SERVING.md)")
+        if self._adapters is None:
+            raise RuntimeError(
+                f"decode model {self._dm.name!r} does not support "
+                "multi-LoRA serving (no lora_init), or the engine was "
+                "built with max_adapters=0")
+
+    def _resolve_adapter_slot(self, name):
+        """Loaded adapter name -> device slot index (None -> 0 = base)."""
+        if name is None:
+            return 0
+        self._require_adapters()
+        slot = self._adapters.peek(name)
+        if slot is None:
+            raise ValueError(
+                f"adapter {name!r} is not loaded — load_adapter() it "
+                f"first (loaded: {sorted(self._adapters.loaded())})")
+        return slot
+
+    def load_adapter(self, name, exported, pin=False):
+        """Hot-load one exported LoRA adapter (``incubate.lora.
+        export_lora`` form) into a device slot of the stacked multi-LoRA
+        factors; returns the slot index. Requests then select it with
+        submit(adapter=name) — every loaded adapter decodes batched in
+        the SAME jitted step (one gathered einsum per site; no
+        per-adapter programs, no recompiles: the write below is a
+        same-shape .at[slot].set).
+
+        A full registry evicts the least-recently-used unpinned adapter;
+        its in-flight sessions restart from the queue head and complete
+        bit-identically once their adapter returns (greedy/seeded decode
+        is deterministic — chaos-pinned by tools/chaos_check.py
+        adapter_evict_under_load). pin=True exempts this adapter from
+        LRU eviction; loading raises RuntimeError while every slot is
+        pinned, ValueError for a malformed/duplicate adapter (a bad
+        adapter never evicts a healthy one)."""
+        self._require_adapters()
+        if not isinstance(name, str) or not name:
+            raise ValueError(
+                f"adapter name must be a non-empty str, got {name!r}")
+        if self._adapters.peek(name) is not None:
+            raise ValueError(f"adapter {name!r} is already loaded")
+        _fp.failpoint("serving/adapter")
+        # pack BEFORE claiming a slot: packing validates rank/shape/layer
+        # coverage, and a malformed adapter must leave the registry and
+        # the device factors exactly as they were
+        packed = self._dm.lora_pack(self.cfg, exported, self._lora_rank)
+        slot, evicted = self._adapters.admit(name, pin=pin)
+        if evicted is not None:
+            self._restart_adapter_sessions(evicted)
+        self._write_adapter_slot(slot, packed)
+        return slot
+
+    def evict_adapter(self, name):
+        """Explicitly evict a loaded adapter: its device slot zeroes and
+        its in-flight sessions are reset and requeued at the head (they
+        wait there — _AdapterUnavailable backpressure — and regenerate
+        bit-identically once the adapter is loaded again). Returns the
+        freed slot index; KeyError for an unknown name."""
+        self._require_adapters()
+        _fp.failpoint("serving/adapter")
+        slot = self._adapters.evict(name)
+        self._write_adapter_slot(slot, None)
+        self._restart_adapter_sessions(name)
+        return slot
+
+    def _write_adapter_slot(self, slot, packed):
+        """Write (packed) or zero (None) ONE slot of the stacked device
+        factors — same-shape .at[slot].set updates only, so the decode
+        programs never re-trace."""
+        import jax.numpy as jnp
+
+        lora = dict(self._lora)
+        scale = 0.0 if packed is None else float(packed["scale"])
+        lora["scale"] = lora["scale"].at[slot].set(scale)
+        for kind in self._lora:
+            if kind == "scale":
+                continue
+            fac = dict(self._lora[kind])
+            for side in ("A", "B"):
+                new = 0.0 if packed is None else jnp.asarray(
+                    packed[kind][side], fac[side].dtype)
+                fac[side] = fac[side].at[slot].set(new)
+            lora[kind] = fac
+        self._lora = lora
+
+    def _restart_adapter_sessions(self, name):
+        """An evicted adapter's in-flight sessions cannot keep decoding
+        (their slot's factors just zeroed): free each session's blocks,
+        reset it to its pre-admission state, and requeue it at the head.
+        Deterministic decode (greedy, or the per-request seeded PRNG
+        stream) regenerates the SAME tokens on re-admission, so an evict
+        + reload mid-stream is invisible in the output."""
+        for s in range(self.B):
+            req = self._slot_req[s]
+            if req is None or req.adapter != name:
+                continue
+            self._pool.free_slot(s)
+            self._slot_req[s] = None
+            self._prefilling.pop(s, None)
+            self._adapter_slot[s] = 0
+            req.output_ids.clear()
+            req.first_token_time = None
+            req.last_token_time = None
+            req._inter_token = _MsSummary()
+            self._queue.insert(0, req)
 
     def _validate_decode_args(self, ids, max_new_tokens, temperature,
                               deadline_ms, top_k, top_p, seed):
@@ -1025,7 +1356,7 @@ class ServingEngine:
 
     def _new_request(self, ids, max_new_tokens, temperature, top_k, top_p,
                      seed, prefix_id, prefix_len, deadline_ms, priority,
-                     trace_id=None, parent_span=None):
+                     trace_id=None, parent_span=None, adapter=None):
         """Accepted-request factory shared by submit()/admit_prefilled():
         mints the rid, stamps submit_time, opens the trace spans (a
         router/pool passes its own trace_id — and optionally its routing
@@ -1037,7 +1368,7 @@ class ServingEngine:
                       temperature=temperature, top_k=top_k,
                       top_p=top_p, seed=seed, prefix_id=prefix_id,
                       prefix_len=prefix_len, deadline_ms=deadline_ms,
-                      priority=priority)
+                      priority=priority, adapter=adapter)
         req.submit_time = time.perf_counter()
         if _trace.is_enabled():
             # end-to-end trace: every request gets a trace_id here; all
@@ -1059,7 +1390,7 @@ class ServingEngine:
     def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
                top_k=None, top_p=None, seed=None, prefix_id=None,
                deadline_ms=None, priority=0, trace_id=None,
-               parent_span=None):
+               parent_span=None, adapter=None):
         """Queue a prompt; returns the request id. temperature=0 (default)
         decodes greedy; temperature>0 samples (optionally top_k- and/or
         top_p/nucleus-truncated, same semantics as generate()) with a
@@ -1075,11 +1406,24 @@ class ServingEngine:
 
         trace_id/parent_span: a fronting Router propagates its per-request
         trace id (and its routing span) so the engine's spans join the
-        router's trace instead of minting a fresh one."""
+        router's trace instead of minting a fresh one.
+
+        adapter: name of a LOADED LoRA adapter (FLAGS_paged_kv engines,
+        load_adapter()); its low-rank delta applies to this request only,
+        batched with every other adapter's requests in the same decode
+        step — outputs are byte-identical to a dedicated engine serving
+        the merged adapter. None = base weights."""
         if self._draining:
             raise RuntimeError(
                 "ServingEngine is draining — not accepting new requests "
                 "(in-flight work runs to completion; see drain())")
+        if adapter is not None:
+            self._require_adapters()
+            if self._adapters.lookup(adapter) is None:
+                raise ValueError(
+                    f"adapter {adapter!r} is not loaded — load_adapter() "
+                    f"it first (loaded: "
+                    f"{sorted(self._adapters.loaded())})")
         ids = prompt_ids._data if isinstance(prompt_ids, Tensor) \
             else np.asarray(prompt_ids)
         ids = np.asarray(ids, np.int32).ravel()
@@ -1097,6 +1441,19 @@ class ServingEngine:
         if len(ids) + 1 > self.T:
             raise ValueError(
                 f"prompt ({len(ids)}) too long for max_seq_len {self.T}")
+        if self._paged:
+            # reject requests that can NEVER fit the pool up front: the
+            # whole-budget reservation (reserve-before-compute) would
+            # otherwise raise PagePoolFullError at every admission attempt
+            # and the request would requeue forever
+            need = self._pool.blocks_for(
+                min(self.T, len(ids) + int(max_new_tokens)))
+            cap = self._pool.stats()["n_blocks"] - 1   # frame 0 = null
+            if need > cap:
+                raise ValueError(
+                    f"request needs {need} KV blocks but the page pool "
+                    f"only has {cap}; raise page_blocks or shorten the "
+                    "request")
         priority = int(priority)
         if self._max_queue is not None and \
                 len(self._queue) + len(self._handoff) >= self._max_queue:
@@ -1126,7 +1483,7 @@ class ServingEngine:
         req = self._new_request(ids, max_new_tokens, temperature, top_k,
                                 top_p, seed, prefix_id, prefix_len,
                                 deadline_ms, priority, trace_id=trace_id,
-                                parent_span=parent_span)
+                                parent_span=parent_span, adapter=adapter)
         self._queue.append(req)
         return req.rid
 
@@ -1163,6 +1520,12 @@ class ServingEngine:
                 "admit_prefilled does not compose with speculative "
                 "decoding (draft_model=): the handoff row carries no "
                 "draft-model KV — disaggregate with a plain engine")
+        if self._paged:
+            raise RuntimeError(
+                "admit_prefilled does not compose with FLAGS_paged_kv: "
+                "the handoff row targets the dense big cache, a paged "
+                "engine re-blocks prompts locally — disaggregate with "
+                "dense decode engines")
         ids = prompt_ids._data if isinstance(prompt_ids, Tensor) \
             else np.asarray(prompt_ids)
         ids = np.asarray(ids, np.int32).ravel()
@@ -1239,6 +1602,11 @@ class ServingEngine:
         if slot is not None:
             self._slot_req[slot] = None
             self._prefilling.pop(slot, None)
+            if self._paged:
+                # return the session's frames (shared prefix frames only
+                # deref); no-op for a slot that never reserved
+                self._pool.free_slot(slot)
+                self._adapter_slot[slot] = 0
 
     def _finish(self, slot, reason):
         self._finish_req(self._slot_req[slot], reason, slot=slot)
@@ -1344,8 +1712,17 @@ class ServingEngine:
         """Shared admission tail: copy the side cache(s) into the slot's
         row and emit the first generated token through the standard pick."""
         n = len(req.prompt_ids)
-        self._kc = self._admit(self._kc, kc1, slot)
-        self._vc = self._admit(self._vc, vc1, slot)
+        if self._paged:
+            # HANDOFF_SCHEMA "kv_page_admit" producer site: the prefilled
+            # dense row re-blocks into the slot's reserved PRIVATE frames
+            # (shared prefix frames stay untouched — admit_row writes only
+            # past the shared span)
+            self._pool.admit_row(slot, kc1[:, 0], vc1[:, 0])
+            self._adapter_slot[slot] = self._resolve_adapter_slot(
+                req.adapter)
+        else:
+            self._kc = self._admit(self._kc, kc1, slot)
+            self._vc = self._admit(self._vc, vc1, slot)
         if draft_caches is not None:
             kc1d, vc1d = draft_caches
             self._kc_d = self._admit(self._kc_d, kc1d, slot)
@@ -1388,6 +1765,8 @@ class ServingEngine:
     def _admit_one_inner(self, slot, req):
         import jax.numpy as jnp
 
+        if self._paged:
+            return self._admit_one_paged(slot, req)
         prefix_len = req.prefix_len
         n = len(req.prompt_ids)
         if prefix_len and req.prefix_id not in self._prefixes:
@@ -1476,6 +1855,70 @@ class ServingEngine:
         if sp is not None:
             sp.end()
 
+    def _admit_one_paged(self, slot, req):
+        """Paged admission: reserve the session's WHOLE block budget
+        FIRST — a pool that cannot cover it raises PagePoolFullError
+        here, before any prefill compute runs or any state mutates
+        (_advance_and_admit turns that into requeue-at-head
+        backpressure). A registered prefix under the SAME adapter maps
+        its full blocks shared (refcount++, zero new bytes); a partial
+        boundary block is re-blocked private (copy-on-write). Then one
+        whole-prompt prefill (the request's adapter delta applied) and
+        _activate re-blocks the row into the reserved private frames."""
+        import jax.numpy as jnp
+
+        aid = 0
+        if req.adapter is not None:
+            aid = None if self._adapters is None \
+                else self._adapters.peek(req.adapter)
+            if aid is None:
+                raise _AdapterUnavailable(
+                    f"adapter {req.adapter!r} is not loaded (evicted "
+                    "mid-flight?) — the request waits at the queue head "
+                    "for a reload")
+        n = len(req.prompt_ids)
+        shared, cow = (), False
+        prefix_len = req.prefix_len
+        entry = None
+        if prefix_len and req.prefix_id in self._prefixes:
+            entry = self._prefixes[req.prefix_id]
+            if not (entry[1] == "paged" and entry[2] == req.adapter):
+                entry = None   # foreign-adapter prefix: full recompute
+        if entry is not None:
+            # may raise PagePoolFullError while re-admitting cold pages —
+            # before reserve(), so backpressure stays mutation-free
+            frames = self._pool.prefix_frames(req.prefix_id)
+            if frames:
+                shared = frames
+                cow = prefix_len % self._pool.bs != 0
+        self._pool.reserve(slot, min(self.T, n + req.max_new_tokens),
+                           shared_frames=shared, cow=cow)
+        if prefix_len:   # counted only once reservation succeeds — a
+            # backpressure retry must not inflate the hit rate
+            ev = "hit" if shared else "miss"
+            self._m[f"prefix_{ev}"] += 1
+            _PREFIX.labels(event=ev).inc()
+        self._note_admission(req)
+        pb = self._bucket(n)
+        t0 = time.perf_counter()
+        sp = None if req._span is None else _trace.start_span(
+            "prefill", subsystem="serving", parent=req._span, slot=slot,
+            tokens=n, bucket=pb, paged=True)
+        try:
+            padded = np.zeros((1, pb), np.int32)
+            padded[0, :n] = req.prompt_ids
+            kc1, vc1, logits = self._prefill_pg(
+                self._params, jnp.asarray(padded), np.int32(n),
+                self._lora, jnp.asarray([aid], np.int32))
+            self._activate(slot, req, kc1, vc1, logits)
+        except BaseException:
+            if sp is not None:
+                sp.end(error=True)
+            raise
+        self._acc_ms("prefill", t0)
+        if sp is not None:
+            sp.end()
+
     def _note_occupancy(self, active):
         self._m["occupancy_sum"] += len(active)
         self._m["occupancy_steps"] += 1
@@ -1491,6 +1934,29 @@ class ServingEngine:
         re-prefilled on admission. Returns (device tokens, kind)."""
         import jax.numpy as jnp
 
+        if self._paged:
+            # block tables + adapter ids ride to the device each round
+            # (tiny int32 [B, maxb]/[B] uploads); the pool sides donate
+            # through the step like the dense big cache
+            pool = self._pool
+            tables = pool.tables_device()
+            aids = jnp.asarray(self._adapter_slot)
+            if any(self._temps[s] > 0 for s in active):
+                kind = "decode_sample"
+                next_toks, pool.kp, pool.vp = self._step_sample_pg(
+                    self._params, pool.kp, pool.vp, tables,
+                    jnp.asarray(self._last), jnp.asarray(self._pos),
+                    jnp.asarray(self._temps), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp), jnp.asarray(self._seeds),
+                    self._lora, aids)
+            else:
+                kind = "decode_greedy"
+                next_toks, pool.kp, pool.vp = self._step_greedy_pg(
+                    self._params, pool.kp, pool.vp, tables,
+                    jnp.asarray(self._last), jnp.asarray(self._pos),
+                    self._lora, aids)
+            self._count_step(kind)
+            return next_toks, kind
         if any(self._temps[s] > 0 for s in active):
             kind = "decode_sample"
             next_toks, self._kc, self._vc = self._step_sample(
@@ -1567,7 +2033,19 @@ class ServingEngine:
                     req = self._queue.pop(0)
                     try:
                         self._admit_one(slot, req)
-                    except Exception:
+                    except Exception as e:
+                        if self._paged and isinstance(
+                                e, (self._paging.PagePoolFullError,
+                                    _AdapterUnavailable)):
+                            # admission BACKPRESSURE, not a failure: the
+                            # pool cannot cover the request's whole block
+                            # budget (or its adapter was evicted and not
+                            # yet reloaded). Nothing ran and nothing was
+                            # reserved — requeue at the head and stop
+                            # admitting this round; finishing sessions
+                            # free blocks for the retry
+                            self._queue.insert(0, req)
+                            return
                         # half-done admission must not leak a reservation
                         self._finish_req(req, "error", slot=slot)
                         self._note_error()
@@ -1672,11 +2150,32 @@ class ServingEngine:
 
             _perfledger.record_engine(self, ledger=led)
 
+    def _paged_active(self):
+        """Construction-consumed FLAGS_paged_kv vs the live flag: a
+        post-construction disarm under a live paged engine raises (there
+        is no dense cache to fall back to; the cached boolean also joins
+        the AOT extra_key, so a rebuilt engine recompiles rather than
+        aliasing paged executables). Dense engines short-circuit — they
+        never read the flag per step."""
+        if self._paged and not _flags.get_flag("paged_kv", False):
+            raise RuntimeError(
+                "FLAGS_paged_kv was disarmed under a live paged engine — "
+                "the flag is consumed at ENGINE CONSTRUCTION; build a new "
+                "engine instead of toggling it mid-flight")
+        return self._paged
+
     def _step_inner(self):
+        if self._paged_active():
+            # cold-page sweep rides the step cadence: registry-only prefix
+            # frames untouched for page_cold_steps sweeps compress to int8
+            # host pages (host bookkeeping; no device sync)
+            self._pool.sweep()
         # FLAGS_async_dispatch (construction-consumed): overlap round
         # N+1's host admission/bookkeeping with round N's device compute.
-        # Speculative engines stay on the sync step (see __init__).
-        if self._async and self._draft is None:
+        # Speculative engines stay on the sync step (see __init__);
+        # paged engines too (their admission mutates the pool the
+        # dispatched step's tables were snapshotted from).
+        if self._async and self._draft is None and not self._paged:
             return self._step_inner_async()
         return self._step_inner_sync()
 

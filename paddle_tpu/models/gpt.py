@@ -10,6 +10,7 @@ building blocks (python/paddle/nn/layer/transformer.py); there is no gpt model f
 the reference tree — this is the framework's own model zoo.
 """
 import math
+import re
 
 import numpy as np
 
@@ -544,15 +545,31 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
         var = jnp.mean((x - mu) ** 2, -1, keepdims=True)
         return (x - mu) / jnp.sqrt(var + 1e-5) * w + bb
 
-    def block(p, i, x, kc, vc, pos, key_valid=None):
+    def block(p, i, x, kc, vc, pos, key_valid=None, lora=None):
         """x [B, t, h] whose first column sits at cache column `pos`.
         key_valid [B, T] (optional): False columns (left-pad slots) are
         masked out of every real query; a pad-position query still sees
         itself so its softmax row is never empty (its lane is garbage that
-        no valid query ever reads)."""
+        no valid query ever reads).
+
+        lora (optional, multi-LoRA serving): per-row ALREADY-GATHERED
+        adapter factors — {"_scale": [B], kind: (A [B, L, din, r],
+        B [B, L, r, dout])} for kind in qkv|proj|fc1|fc2. Each present
+        kind's matmul grows a per-row low-rank delta
+        ``(x @ A[:, i]) @ B[:, i] * scale`` batched over rows by ONE
+        gathered einsum pair — no per-adapter program, no recompiles."""
         pre = f"gpt.blocks.{i}."
         bb, t = x.shape[0], x.shape[1]
         T = (kc[0] if isinstance(kc, tuple) else kc).shape[3]
+
+        def _ldelta(xin, kind):
+            A, Bm = lora[kind]
+            d = jnp.einsum("bti,bir->btr", xin, A[:, i])
+            d = jnp.einsum("btr,bro->bto", d, Bm[:, i])
+            # adapter slot 0 is all-zero (base requests): the delta is an
+            # exact-zero add in xin's dtype, never a dtype promotion
+            return (d * lora["_scale"][:, None, None]).astype(xin.dtype)
+
         h_in = ln(x, p[pre + "ln1.weight"], p[pre + "ln1.bias"])
         if tp_axis is not None:
             # column-parallel qkv over LOCAL heads: weight [h, 3, H_loc, hd]
@@ -567,6 +584,8 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
             # (3, H, hd) unpacking for MHA, compact kv heads for GQA
             flat = h_in @ p[pre + "attn.qkv.weight"] \
                 + p[pre + "attn.qkv.bias"]
+            if lora is not None and "qkv" in lora:
+                flat = flat + _ldelta(h_in, "qkv")
             q = jnp.moveaxis(
                 flat[..., :Hh * hd].reshape(bb, t, Hh, hd), 1, 2)
             k = jnp.moveaxis(
@@ -608,14 +627,19 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
                                  bb, Hh, t, hd)
         out = jnp.moveaxis(out, 1, 2).reshape(bb, t, H_loc * hd)
         proj = out @ p[pre + "attn.proj.weight"]  # row-parallel under tp
+        if lora is not None and "proj" in lora:
+            proj = proj + _ldelta(out, "proj")
         if tp_axis is not None:
             proj = jax.lax.psum(proj, tp_axis)
         x = x + proj + p[pre + "attn.proj.bias"]
         h2 = ln(x, p[pre + "ln2.weight"], p[pre + "ln2.bias"])
-        h2 = jax.nn.gelu(h2 @ p[pre + "mlp.fc1.weight"]
-                         + p[pre + "mlp.fc1.bias"],
-                         approximate=getattr(cfg, "gelu_approx", False))
+        a1 = h2 @ p[pre + "mlp.fc1.weight"] + p[pre + "mlp.fc1.bias"]
+        if lora is not None and "fc1" in lora:
+            a1 = a1 + _ldelta(h2, "fc1")
+        h2 = jax.nn.gelu(a1, approximate=getattr(cfg, "gelu_approx", False))
         mlp = h2 @ p[pre + "mlp.fc2.weight"]      # row-parallel under tp
+        if lora is not None and "fc2" in lora:
+            mlp = mlp + _ldelta(h2, "fc2")
         if tp_axis is not None:
             mlp = jax.lax.psum(mlp, tp_axis)
         x = x + mlp + p[pre + "mlp.fc2.bias"]
@@ -628,7 +652,8 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
             return out + p["lm_head.bias"] if untied_bias else out
         return h @ p["gpt.wte.weight"].T
 
-    def fwd(p, tok_ids, pos, kc, vc, key_valid=None, pos_ids=None):
+    def fwd(p, tok_ids, pos, kc, vc, key_valid=None, pos_ids=None,
+            lora=None, adapter_ids=None):
         t = tok_ids.shape[1]
         if pos_ids is None:
             if jnp.ndim(pos) == 1:   # per-row pos needs per-row pe too
@@ -641,8 +666,24 @@ def _decode_fns(cfg, untied, untied_bias, cache_dtype=None, tp_axis=None,
             # ragged rows: per-row position ids (left-padding support)
             wpe = jnp.take(p["gpt.wpe.weight"], pos_ids, axis=0)
         x = jnp.take(p["gpt.wte.weight"], tok_ids, axis=0) + wpe
+        lg = None
+        if lora is not None:
+            if tp_axis is not None:
+                # the low-rank delta would need its own column/row split
+                # and psum placement — unsupported rather than wrong
+                raise ValueError(
+                    "multi-LoRA decode is not supported under tensor-"
+                    "parallel serving (tp_mesh); serve adapters dense")
+            # ONE gather per step hoists every row's adapter factors out
+            # of the layer loop: [S, L, din, r] -> [B, L, din, r]
+            lg = {"_scale": lora["scale"][adapter_ids]}
+            for kind in ("qkv", "proj", "fc1", "fc2"):
+                if kind in lora:
+                    lg[kind] = (lora[kind]["A"][adapter_ids],
+                                lora[kind]["B"][adapter_ids])
         for i in range(L):
-            x, kc, vc = block(p, i, x, kc, vc, pos, key_valid=key_valid)
+            x, kc, vc = block(p, i, x, kc, vc, pos, key_valid=key_valid,
+                              lora=lg)
         return x, kc, vc
 
     return fwd, logits_of, cache_init
@@ -777,9 +818,18 @@ def _tp_wrap(run, tp_mesh, tp_specs, n_extra_in, out_specs, in_specs=None,
     try:
         mapped = _sm(run, mesh=tp_mesh, in_specs=in_specs,
                      out_specs=out_specs, check_vma=False)
-    except TypeError:  # older jax: no check_vma param
-        mapped = _sm(run, mesh=tp_mesh, in_specs=in_specs,
-                     out_specs=out_specs)
+    except TypeError:
+        # older jax spells the knob check_rep; the check must actually be
+        # OFF either way — replication inference has no rule for the
+        # decode loop's while/scan carries (beam search, speculative),
+        # and falling back to a CHECKING shard_map turns those decodes
+        # into trace-time errors (the PR 17 clean-HEAD TP failures)
+        try:
+            mapped = _sm(run, mesh=tp_mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+        except TypeError:  # no replication checking in this jax at all
+            mapped = _sm(run, mesh=tp_mesh, in_specs=in_specs,
+                         out_specs=out_specs)
     return jax.jit(mapped, donate_argnums=donate)
 
 
@@ -1381,6 +1431,69 @@ class GPTDecodeModel(_decode_model.DecodeModel):
                          "T": cfg.max_seq_len, "hd": hd},
                 "quantized": "per-side (values, scales) tuple when the "
                              "engine's cache_dtype is int8/fp8"}
+
+    # multi-LoRA batched decode: the four adapter sites mirror block()'s
+    # four matmuls. Every slot carries all four kinds (absent sites are
+    # exact zeros) so hot-loading an adapter into a freed slot is one
+    # uniform .at[slot].set — no per-site-set program variants.
+    _LORA_SITES = {"attn.qkv": "qkv", "attn.proj": "proj",
+                   "mlp.fc1": "fc1", "mlp.fc2": "fc2"}
+
+    def _lora_dims(self, cfg):
+        Hh = cfg.num_heads
+        KVh = getattr(cfg, "num_kv_heads", None) or Hh
+        hd = cfg.hidden_size // Hh
+        h, inner = cfg.hidden_size, cfg.intermediate_size
+        return {"qkv": (h, (Hh + 2 * KVh) * hd), "proj": (h, h),
+                "fc1": (h, inner), "fc2": (inner, h)}
+
+    def lora_init(self, cfg, n_slots, rank, dtype=None):
+        import jax.numpy as jnp
+
+        dt = dtype or jnp.float32
+        L = cfg.num_layers
+        pack = {"scale": jnp.zeros((n_slots,), jnp.float32)}
+        for kind, (din, dout) in self._lora_dims(cfg).items():
+            pack[kind] = {
+                "A": jnp.zeros((n_slots, L, din, rank), dt),
+                "B": jnp.zeros((n_slots, L, rank, dout), dt)}
+        return pack
+
+    def lora_pack(self, cfg, exported, rank):
+        L = cfg.num_layers
+        r = int(exported["rank"])
+        if r > rank:
+            raise ValueError(
+                f"adapter rank {r} exceeds the engine's lora_rank={rank}; "
+                "rebuild the engine with a larger lora_rank")
+        dims = self._lora_dims(cfg)
+        slot = {"scale": float(exported["scaling"])}
+        for kind, (din, dout) in dims.items():
+            slot[kind] = {"A": np.zeros((L, din, rank), np.float32),
+                          "B": np.zeros((L, rank, dout), np.float32)}
+        pat = re.compile(r"(?:^|\.)blocks\.(\d+)\.(attn\.qkv|attn\.proj|"
+                         r"mlp\.fc1|mlp\.fc2)$")
+        for qual, fac in exported["factors"].items():
+            m = pat.search(qual)
+            if m is None:
+                raise ValueError(
+                    f"adapter site {qual!r} has no batched-decode "
+                    "injection point (gpt serves LoRA on attn.qkv/"
+                    "attn.proj/mlp.fc1/mlp.fc2 only) — merge_lora this "
+                    "adapter and serve it dense instead")
+            i, kind = int(m.group(1)), self._LORA_SITES[m.group(2)]
+            A, B = np.asarray(fac["A"]), np.asarray(fac["B"])
+            din, dout = dims[kind]
+            if A.shape != (din, r) or B.shape != (r, dout):
+                raise ValueError(
+                    f"adapter site {qual!r}: factors {A.shape}/{B.shape} "
+                    f"do not match the config ({(din, r)}/{(r, dout)})")
+            if not 0 <= i < L:
+                raise ValueError(f"adapter site {qual!r}: layer {i} out of "
+                                 f"range for num_layers={L}")
+            slot[kind]["A"][i, :, :r] = A
+            slot[kind]["B"][i, :r, :] = B
+        return slot
 
     def matches(self, model):
         return isinstance(model, GPTForCausalLM)

@@ -74,7 +74,7 @@ CORE_FINGERPRINT = ("jax", "jaxlib", "python", "machine", "cpu_count")
 #: metrics where LARGER observations are regressions (wall times)
 HIGH_IS_BAD = ("step_ms", "exec_ms", "sync_ms", "compile_ms",
                "queue_wait_ms", "ttft_ms", "inter_token_ms", "tick_ms",
-               "run_ms", "fetch_ms")
+               "run_ms", "fetch_ms", "kv_bytes_per_session")
 
 #: metrics where SMALLER observations are regressions (throughputs).
 #: ``dispatch_fraction`` is deliberately in NEITHER list: the budget
@@ -594,7 +594,24 @@ def record_engine(engine, ledger=None, site="serving"):
         dig = _hist_summary("serving_" + key)
         if dig:
             m[key[:-3] + "digest"] = dig
-    return led.on_step(site, m, force=True)
+    out = led.on_step(site, m, force=True)
+    pg = st.get("paging")
+    if isinstance(pg, dict):
+        # paged engines (FLAGS_paged_kv) append a second row under
+        # site/paged_step: pool occupancy + the per-session KV footprint
+        # the block tables exist to shrink. kv_bytes_per_session is
+        # sentinel-watched HIGH_IS_BAD — a sharing regression (lost
+        # prefix dedup, leaked frames) fires perf_regression_total
+        # before it becomes an OOM
+        mp = {k: v for k, v in pg.items()
+              if isinstance(v, (int, float)) and not isinstance(v, bool)}
+        ad = pg.get("adapters")
+        if isinstance(ad, dict):
+            for k, v in ad.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    mp["adapter_" + str(k)] = v
+        led.on_step(site + "/paged_step", mp, force=True)
+    return out
 
 
 def record_stage_runner(runner, ledger=None, site="stage"):

@@ -48,8 +48,9 @@ from .. import monitor as _monitor
 from ..trace import costs as _costs
 
 __all__ = ["matmul", "bias_act", "softmax_rows", "masked_reduce",
-           "ln_matmul", "fused_mlp", "gpt_block_mlp", "registry_table",
-           "pick_block", "supported_2d", "audit_manifest"]
+           "ln_matmul", "fused_mlp", "gpt_block_mlp", "paged_attention",
+           "paged_attention_ref", "registry_table", "pick_block",
+           "supported_2d", "audit_manifest"]
 
 _LN_EPS = 1e-5   # nn.LayerNorm's default epsilon (the only one GPT uses)
 
@@ -205,7 +206,226 @@ def audit_manifest():
                 {"name": "x", "block": (bm, k), "dtype": dtype},
                 {"name": "mask", "block": (bm, k), "dtype": "int32"},
                 {"name": "out", "block": (bm, 1), "dtype": dtype}]})
+    for B, H, hd, bs, maxb in _PAGED_AUDIT_SHAPES:
+        for variant, page_dt in (("dense", "float32"), ("int8", "int8")):
+            bufs = [
+                {"name": "q", "block": (1, H, hd), "dtype": "float32"},
+                {"name": "k_page", "block": (1, H, bs, hd),
+                 "dtype": page_dt},
+                {"name": "v_page", "block": (1, H, bs, hd),
+                 "dtype": page_dt}]
+            if variant == "int8":
+                bufs += [{"name": "k_scales", "block": (1, H, bs, 1),
+                          "dtype": "float32"},
+                         {"name": "v_scales", "block": (1, H, bs, 1),
+                          "dtype": "float32"}]
+            bufs += [
+                {"name": "out", "block": (1, H, hd), "dtype": "float32"},
+                {"name": "m(scratch)", "block": (H, 1),
+                 "dtype": "float32", "stream": False},
+                {"name": "l(scratch)", "block": (H, 1),
+                 "dtype": "float32", "stream": False},
+                {"name": "acc(scratch)", "block": (H, hd),
+                 "dtype": "float32", "stream": False}]
+            entries.append({
+                "kernel": f"tpp.paged_attention[{variant},B{B}xH{H}x"
+                          f"{hd},bs{bs}x{maxb}]",
+                "op": "paged_attention",
+                "in_dtype": page_dt, "acc_dtype": "float32",
+                "matmul": True,
+                "grid": {"b": (B, 1), "j": (maxb, 1)},
+                "buffers": bufs})
     return entries
+
+
+# ---------------------------------------------------------------------------
+# paged attention (the FLAGS_paged_kv decode kernel, ISSUE 18)
+# ---------------------------------------------------------------------------
+
+#: bundled paged_attention audit shapes: (B, H, hd, bs, maxb) — a
+#: v5e-class serving point (128-lane head dim, 32-deep blocks so the
+#: int8 page variant meets its 32-row sublane tile too)
+_PAGED_AUDIT_SHAPES = ((16, 8, 128, 32, 16),)
+
+
+def _paged_attention_kernel(tables_ref, lens_ref, *refs, bs, maxb, scale,
+                            quantized):
+    """One (b, j) grid step of the block-table decode attention: the
+    scalar-prefetched table picked THIS j's physical frame (the K/V
+    BlockSpec index_map reads tables_ref before the body runs), so the
+    body only flash-accumulates one [KVh, bs, hd] block into the online
+    softmax state (m/l/acc scratch, f32)."""
+    import jax.experimental.pallas as pl
+
+    idx = 0
+    q_ref = refs[idx]; idx += 1
+    k_ref = refs[idx]; idx += 1
+    v_ref = refs[idx]; idx += 1
+    ks_ref = vs_ref = None
+    if quantized:
+        ks_ref = refs[idx]; idx += 1
+        vs_ref = refs[idx]; idx += 1
+    o_ref = refs[idx]; idx += 1
+    m_ref, l_ref, acc_ref = refs[idx], refs[idx + 1], refs[idx + 2]
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)             # [H, hd]
+    k = k_ref[0].astype(jnp.float32)             # [KVh, bs, hd]
+    v = v_ref[0].astype(jnp.float32)
+    if quantized:                                # int8 pages: row codec
+        k = k * ks_ref[0].astype(jnp.float32)
+        v = v * vs_ref[0].astype(jnp.float32)
+    s = jnp.einsum("hd,hcd->hc", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    col = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+    s = jnp.where(col < lens_ref[b], s, -jnp.inf)
+    m_prev = m_ref[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    # a fully-masked block keeps m at -inf; substitute 0 so the exps
+    # below see finite-minus-finite (they all collapse to exp(-inf)=0)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe)
+    alpha = jnp.exp(m_prev - m_safe)
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jnp.einsum(
+        "hc,hcd->hd", p, v, preferred_element_type=jnp.float32)
+    m_ref[...] = m_new
+
+    @pl.when(j == maxb - 1)
+    def _writeback():
+        l = l_ref[...]
+        o_ref[0] = (acc_ref[...] /
+                    jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def _build_paged_attention(dtype, shape_key, quantized):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    H, hd, bs, maxb = shape_key
+    interpret = not _on_tpu()
+    scale = 1.0 / (hd ** 0.5)
+
+    def call(q, kp, vp, tables, lengths, k_scales=None, v_scales=None):
+        B = q.shape[0]
+        kern = functools.partial(
+            _paged_attention_kernel, bs=bs, maxb=maxb, scale=scale,
+            quantized=quantized)
+        # the block table is the scalar-prefetch payload: the K/V specs'
+        # index_map picks each step's PHYSICAL frame from it
+        in_specs = [
+            pl.BlockSpec((1, H, hd), lambda b, j, t, n: (b, 0, 0)),
+            pl.BlockSpec((1, H, bs, hd),
+                         lambda b, j, t, n: (t[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, H, bs, hd),
+                         lambda b, j, t, n: (t[b, j], 0, 0, 0)),
+        ]
+        args = [q, kp, vp]
+        if quantized:
+            in_specs += [
+                pl.BlockSpec((1, H, bs, 1),
+                             lambda b, j, t, n: (t[b, j], 0, 0, 0)),
+                pl.BlockSpec((1, H, bs, 1),
+                             lambda b, j, t, n: (t[b, j], 0, 0, 0)),
+            ]
+            args += [k_scales, v_scales]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, maxb),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, H, hd),
+                                   lambda b, j, t, n: (b, 0, 0)),
+            scratch_shapes=[pltpu.VMEM((H, 1), jnp.float32),
+                            pltpu.VMEM((H, 1), jnp.float32),
+                            pltpu.VMEM((H, hd), jnp.float32)],
+        )
+        return pl.pallas_call(
+            kern,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
+            interpret=interpret,
+        )(tables.astype(jnp.int32), lengths.astype(jnp.int32), *args)
+
+    return call
+
+
+def paged_attention(q, kp, vp, tables, lengths, k_scales=None,
+                    v_scales=None):
+    """Block-table decode attention (one layer, one query per row).
+
+    ``q`` [B, H, hd]; ``kp``/``vp`` [NB, H, bs, hd] physical KV frames;
+    ``tables`` int [B, maxb] frame indices; ``lengths`` int [B] — row b
+    attends columns ``0..lengths[b]-1`` of its logical cache. K/V blocks
+    are gathered BY TABLE INDEX through scalar-prefetched BlockSpec
+    index maps (never materializing the dense cache) and folded into an
+    online-softmax f32 accumulator per row — the flash recipe over
+    paged storage. With ``k_scales``/``v_scales`` ([NB, H, bs, 1] f32)
+    the frames hold int8 pages (distributed/compress.py row codec) and
+    dequantize on load; outputs then carry the codec's declared band vs
+    the dense reference (:func:`paged_attention_ref` pins both paths)."""
+    B, H, hd = q.shape
+    NB, Hk, bs, hd_k = kp.shape
+    if Hk != H or hd_k != hd:
+        raise ValueError(
+            f"paged_attention serves H == KVh (got q heads {H}, kv heads "
+            f"{Hk}) and matching head dim (got {hd} vs {hd_k}) — grouped "
+            "queries reshape outside the kernel")
+    maxb = tables.shape[1]
+    quantized = k_scales is not None
+    if quantized != (v_scales is not None):
+        raise ValueError("pass both k_scales and v_scales, or neither")
+    shape_key = (H, hd, bs, maxb)
+    variant = "int8" if quantized else "dense"
+    entry = _kernel_entry(
+        f"paged_attention|{variant}", q.dtype, shape_key,
+        lambda: _build_paged_attention(q.dtype, shape_key, quantized))
+    item = jnp.dtype(q.dtype).itemsize
+    page_item = 1 if quantized else jnp.dtype(kp.dtype).itemsize
+    T = maxb * bs
+    _note_call(entry, "paged_attention",
+               4.0 * B * H * T * hd,
+               (2 * B * H * hd * item              # q + out
+                + 2 * B * maxb * H * bs * hd * page_item  # gathered pages
+                + B * maxb * 4 + B * 4))           # tables + lengths
+    return entry["fn"](q, kp, vp, tables, lengths, k_scales, v_scales)
+
+
+def paged_attention_ref(q, kp, vp, tables, lengths, k_scales=None,
+                        v_scales=None):
+    """Pure-lax reference for :func:`paged_attention`: gather the pool
+    through the tables into the dense layout, plain masked softmax
+    attention in f32. The kernel must match within the declared band
+    (f32 pages: online-softmax reassociation only; int8 pages add the
+    row codec's quantization band)."""
+    B, H, hd = q.shape
+    maxb = tables.shape[1]
+    bs = kp.shape[2]
+
+    def dense(pool, scales):
+        g = pool[tables].astype(jnp.float32)     # [B, maxb, H, bs, hd]
+        if scales is not None:
+            g = g * scales[tables].astype(jnp.float32)
+        g = jnp.transpose(g, (0, 2, 1, 3, 4))
+        return g.reshape(B, H, maxb * bs, hd)
+
+    k = dense(kp, k_scales)
+    v = dense(vp, v_scales)
+    s = jnp.einsum("bhd,bhTd->bhT", q.astype(jnp.float32), k) \
+        * (1.0 / (hd ** 0.5))
+    cols = jnp.arange(maxb * bs)[None, None, :]
+    s = jnp.where(cols < lengths[:, None, None], s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - jnp.where(jnp.isfinite(m), m, 0.0))
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    p = p / jnp.where(denom == 0.0, 1.0, denom)
+    return jnp.einsum("bhT,bhTd->bhd", p, v).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------

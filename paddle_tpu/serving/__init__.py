@@ -11,11 +11,16 @@ Three layers over the continuous-batching ``ServingEngine``:
   propagation (router -> engine -> slot spans share one trace);
 - :mod:`.disagg` — ``DisaggregatedPool``: dedicated prefill workers hand
   finished KV rows to decode engines (the MPMD per-stage split),
-  bit-identical to the monolithic engine.
+  bit-identical to the monolithic engine;
+- :mod:`.paging` — the FLAGS_paged_kv block pool: paged KV frames with
+  per-slot block tables, refcounted shared prefixes (copy-on-write
+  boundary blocks), int8 cold pages, and the multi-LoRA ``AdapterRegistry``
+  behind ``ServingEngine.load_adapter``/``submit(adapter=)``.
 
-Import cost discipline: ``Router``/``DisaggregatedPool`` load lazily —
-constructing a plain single-engine ``ServingEngine`` never imports them
-(pinned by tests/test_router_gate.py).
+Import cost discipline: ``Router``/``DisaggregatedPool``/``PagePool``
+load lazily — constructing a plain single-engine ``ServingEngine`` never
+imports them (pinned by tests/test_router_gate.py and
+tests/test_paging_gate.py).
 """
 from . import decode_model  # noqa: F401  (registry: always available)
 from .decode_model import (  # noqa: F401
@@ -24,13 +29,18 @@ from .decode_model import (  # noqa: F401
 
 __all__ = ["decode_model", "DecodeModel", "register_decode_model",
            "get_decode_model", "registered_decode_models", "Router",
-           "DisaggregatedPool", "PrefillWorker"]
+           "DisaggregatedPool", "PrefillWorker", "PagePool",
+           "PagePoolFullError", "AdapterRegistry"]
 
 _LAZY_ATTRS = {"Router": ".router",
                "DisaggregatedPool": ".disagg",
                "PrefillWorker": ".disagg",
+               "PagePool": ".paging",
+               "PagePoolFullError": ".paging",
+               "AdapterRegistry": ".paging",
                "router": ".router",
-               "disagg": ".disagg"}
+               "disagg": ".disagg",
+               "paging": ".paging"}
 
 
 def __getattr__(name):   # PEP 562: lazy submodule/class loading
@@ -38,5 +48,6 @@ def __getattr__(name):   # PEP 562: lazy submodule/class loading
         import importlib
 
         mod = importlib.import_module(_LAZY_ATTRS[name], __name__)
-        return mod if name in ("router", "disagg") else getattr(mod, name)
+        return mod if name in ("router", "disagg", "paging") \
+            else getattr(mod, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

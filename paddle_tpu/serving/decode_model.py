@@ -41,6 +41,12 @@ The protocol (docs/SERVING.md for the full contract):
 ``cache_spec(cfg)``
     Machine-readable description of the cache pytree (layout string,
     axis names, quantized or not) — the handoff contract in data form.
+``lora_init(cfg, n_slots, rank, dtype=None)`` / ``lora_pack(cfg,
+  exported, rank)``
+    Optional multi-LoRA batched decode (FLAGS_paged_kv engines): the
+    stacked adapter pytree (slot 0 all-zero = base) and the packing of
+    one exported adapter into a slot. ``fwd`` grows ``lora=`` /
+    ``adapter_ids=`` kwargs applying the per-row low-rank delta.
 ``matches(model)``
     True when this adapter serves ``model`` (used by :func:`resolve`).
 
@@ -93,6 +99,24 @@ class DecodeModel:
         """Default spec: opaque pytree pair, described minimally."""
         return {"kind": "kv_pair", "layout": "adapter-defined",
                 "quantized": None}
+
+    # -- optional (multi-LoRA batched decode, FLAGS_paged_kv engines) ------
+    def lora_init(self, cfg, n_slots, rank, dtype=None):
+        """Zero-filled stacked adapter pytree for ``n_slots`` adapter
+        slots at ``rank`` (slot 0 is reserved all-zero = base requests);
+        the pytree feeds ``fwd(..., lora=, adapter_ids=)``."""
+        raise NotImplementedError(
+            f"decode model {self.name!r} does not support multi-LoRA "
+            "serving")
+
+    def lora_pack(self, cfg, exported, rank):
+        """One exported adapter (``incubate.lora.export_lora`` form) ->
+        the per-slot update written into the stacked pytree: same tree
+        shape as one ``lora_init`` slot, factors zero-padded to ``rank``
+        (an exact-zero pad — padded lanes contribute nothing)."""
+        raise NotImplementedError(
+            f"decode model {self.name!r} does not support multi-LoRA "
+            "serving")
 
 
 # name -> DecodeModel instance. Model modules register themselves at

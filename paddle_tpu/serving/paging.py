@@ -1,0 +1,533 @@
+"""Paged KV-cache block pool + multi-LoRA adapter registry (FLAGS_paged_kv).
+
+The dense ``ServingEngine`` allocates one FIXED ``[max_batch, max_seq]``
+KV cache, so every session pays worst-case KV bytes and prefix-shared
+sessions duplicate physical KV. This module is the vLLM-style fix,
+TPU-shaped: physical KV lives in a pool of fixed-size blocks
+(``[n_blocks, L, KVh, block_size, hd]`` per side, frame 0 a permanent
+all-zero NULL frame), each slot holds a BLOCK TABLE of frame indices, and
+the decode step gathers the pool through the tables into the exact dense
+``[L, B, KVh, T, hd]`` layout the unchanged decode math consumes — so the
+paged engine is bit-identical to the dense engine by construction (the
+gathered cache differs only in causally-masked junk columns).
+
+Sharing model:
+
+- **Reservation up front**: a session's whole block budget
+  (``ceil(min(T, prompt + max_new) / block_size)`` blocks) is reserved at
+  admission, BEFORE any prefill compute — a full pool raises
+  :class:`PagePoolFullError` with no work done (the ``EdgeFullError``
+  backpressure discipline), and decode never allocates.
+- **Prefix sharing + COW**: ``register_prefix`` writes the prefix's FULL
+  blocks into the pool once; every session admitting with that prefix
+  maps its leading table entries to the SAME frames (refcounted). The
+  partial boundary block (``prefix_len % block_size != 0``) is where the
+  session's own tokens land next to prefix content, so it is COPIED to a
+  private frame at admission — copy-on-write at first divergence, counted
+  on ``kv_page_cow_total``. Shared frames are read-only by layout: the
+  decode frontier column always lives in a private frame.
+- **Cold pages**: a prefix frame no live session references, untouched
+  for ``cold_after`` sweeps, is compressed to int8 via the
+  ``distributed/compress.py`` row codec (deterministic nearest rounding)
+  and its frame FREED; the next admission touch decompresses it into a
+  fresh frame. Dense parity is exact with cold compression off; int8
+  cold pages carry the codec's declared band (per row of ``hd``:
+  ``|err| <= absmax / 254``). Metered on
+  ``kv_page_blocks_total{state=hot|cold}``.
+
+Multi-LoRA tenancy rides the same pool: :class:`AdapterRegistry` manages
+named adapter slots (slot 0 is reserved all-zero = base requests) with
+LRU eviction and pinning, metered on
+``serving_adapter_total{event=load|evict|hit}``. The engine keeps the
+stacked factors device-resident and applies each row's adapter delta via
+one gathered batched einsum inside the SAME jitted step (models/gpt.py
+``_decode_fns`` ``lora=`` path) — no per-adapter recompiles.
+
+Import discipline: a plain (disarmed) ``ServingEngine`` never imports
+this module (pinned by tests/test_paging_gate.py; ``import_graph``
+LAZY_MODULES). docs/SERVING.md "Paged KV & multi-LoRA" for block math.
+"""
+import numpy as np
+
+from .. import monitor as _monitor
+from ..analysis import handoff_schema as _hs
+
+__all__ = ["PagePool", "PagePoolFullError", "AdapterRegistry",
+           "gather_dense", "scatter_cols", "HANDOFF_SCHEMA"]
+
+# pool metrics in the default registry (process-wide, like the serving
+# counters; per-pool gauges live on PagePool.stats())
+_BLOCKS = _monitor.counter(
+    "kv_page_blocks_total",
+    "KV pool block transitions: hot = a frame allocated (admission, "
+    "prefix registration, cold-page decompression), cold = a frame "
+    "compressed to an int8 host page and freed",
+    labelnames=("state",))
+_COW = _monitor.counter(
+    "kv_page_cow_total",
+    "copy-on-write boundary blocks: a session admitted on a shared "
+    "prefix whose length is not block-aligned copies the partial block "
+    "to a private frame before writing its own tokens")
+_ADAPTER = _monitor.counter(
+    "serving_adapter_total",
+    "multi-LoRA adapter registry events (load = factors written into a "
+    "device slot, evict = LRU or explicit eviction freed a slot, hit = "
+    "a submitted request resolved an already-loaded adapter)",
+    labelnames=("event",))
+
+
+#: The per-session admission payload the pool consumes: the prefilled KV
+#: row pair (the SAME handoff unit the dense engine's ``_admit`` copies
+#: into its big cache, one slot row) plus the slot's block table. The
+#: pool re-blocks the row into its reserved private frames; a layout
+#: drift here would corrupt every block-table gather that follows.
+HANDOFF_SCHEMA = {
+    "edge": "kv_page_admit",
+    "payload": {
+        "kc": {"shape": ("L", "KVh", "T", "hd"), "dtype": "$cache",
+               "layout": "[L, KVh, T, hd] (one prefilled slot row; "
+                         "T = max_blocks * block_size)",
+               "quantizable": False},
+        "vc": {"shape": ("L", "KVh", "T", "hd"), "dtype": "$cache",
+               "layout": "[L, KVh, T, hd]", "quantizable": False},
+        "table": {"shape": ("maxb",), "dtype": "int32",
+                  "layout": "[max_blocks] frame indices (0 = null frame)"},
+    },
+    "producer": "paddle_tpu/inference/serving.py::ServingEngine._activate",
+    "consumer": "paddle_tpu/serving/paging.py::PagePool.admit_row",
+    "runtime_checked": True,
+    "doc": "paged-KV admission: prefilled dense row -> pool blocks",
+}
+
+
+class PagePoolFullError(RuntimeError):
+    """Block reservation rejected: the pool has fewer free frames than
+    the session's whole budget. Raised BEFORE any prefill compute or
+    table mutation — admission backpressure, not a mid-decode fault."""
+
+
+def gather_dense(kp, vp, tables):
+    """Gather the pool through per-slot block tables into the dense
+    cache layout the decode math consumes.
+
+    ``kp``/``vp``: ``[NB, L, KVh, bs, hd]``; ``tables``: int ``[B, maxb]``.
+    Returns ``(kc, vc)`` shaped ``[L, B, KVh, maxb*bs, hd]`` — the exact
+    dense-engine layout, so the unchanged ``fwd`` runs on it. Table
+    entries of 0 read the null frame; those columns are only ever
+    causally masked (a session's reserved frames cover every column its
+    queries can see)."""
+    import jax.numpy as jnp
+
+    def one(pool):
+        g = pool[tables]                       # [B, maxb, L, KVh, bs, hd]
+        g = jnp.transpose(g, (2, 0, 3, 1, 4, 5))
+        L, B, KVh, maxb, bs, hd = g.shape
+        return g.reshape(L, B, KVh, maxb * bs, hd)
+
+    return one(kp), one(vp)
+
+
+def scatter_cols(kp, vp, kc, vc, tables, pos):
+    """Write each row's frontier column ``pos[b]`` of the post-step dense
+    cache back into its pool frame (the inverse of one column of
+    :func:`gather_dense`).
+
+    A slot with no active session maps to the null frame; its junk write
+    lands there and is never read meaningfully (null-frame columns are
+    causally masked for every live query)."""
+    import jax.numpy as jnp
+
+    bs = kp.shape[3]
+    B = tables.shape[0]
+    blk = pos // bs
+    off = pos % bs
+    frames = jnp.take_along_axis(tables, blk[:, None], axis=1)[:, 0]
+    rows = jnp.arange(B)
+    colk = kc[:, rows, :, pos, :]              # [B, L, KVh, hd]
+    colv = vc[:, rows, :, pos, :]
+    kp = kp.at[frames, :, :, off, :].set(colk)
+    vp = vp.at[frames, :, :, off, :].set(colv)
+    return kp, vp
+
+
+class _PrefixEntry:
+    __slots__ = ("frames", "cold", "last_use", "n_blocks")
+
+    def __init__(self, frames):
+        self.frames = list(frames)   # hot frame id, or None while cold
+        self.cold = {}               # block idx -> (kq, ks, vq, vs) host
+        self.last_use = 0
+        self.n_blocks = len(frames)
+
+
+class PagePool:
+    """The physical KV block pool + per-slot block tables (host-side
+    bookkeeping; the device arrays ``kp``/``vp`` thread through the
+    engine's jitted programs and are written back here).
+
+    ``dims`` = ``(L, KVh, hd)`` of the served config; ``max_seq`` must be
+    a multiple of ``block_size`` (the gather math relies on it). Frame 0
+    is the permanent null frame: all-zero, never allocated, the target of
+    every unreserved table entry."""
+
+    def __init__(self, dims, dtype, block_size, n_blocks, max_batch,
+                 max_seq, cold_after=None):
+        import jax.numpy as jnp
+
+        L, KVh, hd = dims
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if max_seq % block_size != 0:
+            raise ValueError(
+                f"max_seq={max_seq} must be a multiple of "
+                f"block_size={block_size} (the block-table gather "
+                "reconstructs the dense cache as maxb*bs columns)")
+        if n_blocks < 2:
+            raise ValueError(
+                f"n_blocks must be >= 2 (frame 0 is the null frame), "
+                f"got {n_blocks}")
+        self.dims = (L, KVh, hd)
+        self.dtype = jnp.dtype(dtype)
+        self.bs = int(block_size)
+        self.n_blocks = int(n_blocks)
+        self.maxb = max_seq // self.bs
+        self.max_seq = int(max_seq)
+        self.cold_after = cold_after
+        self.kp = jnp.zeros((n_blocks, L, KVh, self.bs, hd), dtype)
+        self.vp = jnp.zeros_like(self.kp)
+        self.refs = np.zeros(n_blocks, np.int64)
+        self.refs[0] = 1                       # null frame: held forever
+        self._free = list(range(n_blocks - 1, 0, -1))  # pop() -> frame 1 first
+        self.tables = np.zeros((max_batch, self.maxb), np.int32)
+        self._nres = np.zeros(max_batch, np.int64)
+        self._nshared = np.zeros(max_batch, np.int64)
+        self._prefixes = {}
+        self._sweeps = 0
+        self._cold_pages = 0
+        self._cold_bytes = 0
+
+    # -- geometry ---------------------------------------------------------
+    @property
+    def block_bytes(self):
+        """Device bytes of ONE block across both sides (k + v)."""
+        L, KVh, hd = self.dims
+        return 2 * L * KVh * self.bs * hd * self.dtype.itemsize
+
+    def blocks_for(self, n_cols):
+        """Whole-budget block count for a session spanning ``n_cols``."""
+        return -(-int(n_cols) // self.bs)
+
+    def free_blocks(self):
+        return len(self._free)
+
+    def tables_device(self):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self.tables)
+
+    # -- allocation -------------------------------------------------------
+    def _alloc(self, n):
+        if n > len(self._free):
+            raise PagePoolFullError(
+                f"KV page pool exhausted: need {n} free block(s), have "
+                f"{len(self._free)} of {self.n_blocks - 1} — admission "
+                "backs off until sessions finish (raise page_blocks= to "
+                "provision more)")
+        frames = [self._free.pop() for _ in range(n)]
+        for f in frames:
+            self.refs[f] = 1
+        if n:
+            _BLOCKS.labels(state="hot").inc(n)
+        return frames
+
+    def _deref(self, frame):
+        f = int(frame)
+        if f == 0:
+            return
+        self.refs[f] -= 1
+        if self.refs[f] == 0:
+            self._free.append(f)
+
+    def reserve(self, slot, n_cols, shared_frames=(), cow=False):
+        """Reserve the slot's WHOLE block budget for a session spanning
+        ``n_cols`` cache columns: leading table entries map to
+        ``shared_frames`` (refcounted prefix blocks), the rest allocate
+        private frames. Raises :class:`PagePoolFullError` before any
+        mutation when the pool cannot cover the private part; ``cow``
+        marks a boundary-block copy (prefix not block-aligned)."""
+        need = self.blocks_for(n_cols)
+        n_shared = len(shared_frames)
+        if n_shared > need:
+            raise ValueError(
+                f"slot {slot}: {n_shared} shared frames exceed the "
+                f"{need}-block budget for {n_cols} columns")
+        if self._nres[slot]:
+            raise RuntimeError(f"slot {slot} already holds a reservation")
+        priv = self._alloc(need - n_shared)    # raises before mutation
+        for j, f in enumerate(shared_frames):
+            self.tables[slot, j] = f
+            self.refs[int(f)] += 1
+        for j, f in enumerate(priv):
+            self.tables[slot, n_shared + j] = f
+        self._nres[slot] = need
+        self._nshared[slot] = n_shared
+        if cow:
+            _COW.inc()
+        return need
+
+    def free_slot(self, slot):
+        """Release a finished session's frames (shared frames deref; a
+        prefix frame survives on its registry pin)."""
+        for j in range(int(self._nres[slot])):
+            self._deref(self.tables[slot, j])
+        self.tables[slot, :] = 0
+        self._nres[slot] = 0
+        self._nshared[slot] = 0
+
+    def admit_row(self, slot, kc_row, vc_row):
+        """Re-block a prefilled dense row into the slot's PRIVATE frames
+        (the reserved entries past the shared prefix). The COW boundary
+        block is covered here too: the row carries the prefix content at
+        its columns, so the private boundary frame gets prefix + session
+        tokens in one write. Validates :data:`HANDOFF_SCHEMA`."""
+        import jax.numpy as jnp
+
+        L, KVh, hd = self.dims
+        _hs.validate(
+            HANDOFF_SCHEMA,
+            {"kc": kc_row, "vc": vc_row, "table": self.tables[slot]},
+            dims={"L": L, "KVh": KVh, "T": self.max_seq, "hd": hd,
+                  "maxb": self.maxb},
+            dtypes={"cache": str(self.dtype)})
+        lo, hi = int(self._nshared[slot]), int(self._nres[slot])
+        # fixed-shape scatter: one compiled write-back for EVERY admission
+        # shape — non-private entries aim past the pool and drop
+        fw = np.full(self.maxb, self.n_blocks, np.int32)
+        fw[lo:hi] = self.tables[slot, lo:hi]
+        fw_d = jnp.asarray(fw)
+
+        def blocks(row):
+            b = row.reshape(L, KVh, self.maxb, self.bs, hd)
+            return jnp.transpose(b, (2, 0, 1, 3, 4))
+
+        self.kp = self.kp.at[fw_d].set(blocks(kc_row), mode="drop")
+        self.vp = self.vp.at[fw_d].set(blocks(vc_row), mode="drop")
+
+    # -- shared prefixes + cold pages -------------------------------------
+    def put_prefix(self, key, kc_row, vc_row, prefix_len):
+        """Write a registered prefix's FULL blocks into the pool once
+        (pinned by the registry ref). Returns the number of shared
+        blocks; a prefix shorter than one block shares nothing (its
+        content rides each session's private boundary frame)."""
+        import jax.numpy as jnp
+
+        if key in self._prefixes:
+            raise ValueError(f"prefix {key!r} already registered")
+        n_full = int(prefix_len) // self.bs
+        frames = self._alloc(n_full)           # raises before mutation
+        if n_full:
+            L, KVh, hd = self.dims
+            fw = np.full(self.maxb, self.n_blocks, np.int32)
+            fw[:n_full] = frames
+            fw_d = jnp.asarray(fw)
+
+            def blocks(row):
+                b = row.reshape(L, KVh, self.maxb, self.bs, hd)
+                return jnp.transpose(b, (2, 0, 1, 3, 4))
+
+            self.kp = self.kp.at[fw_d].set(blocks(kc_row), mode="drop")
+            self.vp = self.vp.at[fw_d].set(blocks(vc_row), mode="drop")
+        entry = _PrefixEntry(frames)
+        entry.last_use = self._sweeps
+        self._prefixes[key] = entry
+        return n_full
+
+    def prefix_frames(self, key):
+        """The shared frame list for a registered prefix, decompressing
+        any cold page back into a fresh hot frame (the touch path).
+        Raises :class:`PagePoolFullError` when decompression cannot get
+        a frame. Returns ``None`` for an unknown key."""
+        entry = self._prefixes.get(key)
+        if entry is None:
+            return None
+        entry.last_use = self._sweeps
+        if entry.cold:
+            import jax.numpy as jnp
+
+            from ..distributed import compress as _compress
+
+            need = len(entry.cold)
+            if need > len(self._free):
+                raise PagePoolFullError(
+                    f"cold-page decompression for prefix {key!r} needs "
+                    f"{need} free block(s), have {len(self._free)}")
+            for idx in sorted(entry.cold):
+                kq, ks, vq, vs = entry.cold.pop(idx)
+                (f,) = self._alloc(1)
+                self.kp = self.kp.at[f].set(jnp.asarray(
+                    _compress.dequantize_rows(kq, ks, self.dtype)))
+                self.vp = self.vp.at[f].set(jnp.asarray(
+                    _compress.dequantize_rows(vq, vs, self.dtype)))
+                entry.frames[idx] = f
+                self._cold_pages -= 1
+                self._cold_bytes -= kq.size + ks.size * 4 \
+                    + vq.size + vs.size * 4
+        return list(entry.frames)
+
+    def drop_prefix(self, key):
+        """Unpin a registered prefix (frames free once no session refs
+        them; cold pages are discarded)."""
+        entry = self._prefixes.pop(key)
+        for f in entry.frames:
+            if f is not None:
+                self._deref(f)
+        self._cold_pages -= len(entry.cold)
+        self._cold_bytes -= sum(
+            kq.size + ks.size * 4 + vq.size + vs.size * 4
+            for kq, ks, vq, vs in entry.cold.values())
+
+    def sweep(self):
+        """One cold-compression round (the engine calls this per step):
+        a prefix frame with NO live session ref, untouched for
+        ``cold_after`` sweeps, compresses to an int8 host page
+        (deterministic row codec) and frees its frame."""
+        self._sweeps += 1
+        if self.cold_after is None:
+            return 0
+        compressed = 0
+        for key, entry in self._prefixes.items():
+            if self._sweeps - entry.last_use < self.cold_after:
+                continue
+            for idx, f in enumerate(entry.frames):
+                if f is None or self.refs[f] != 1:
+                    continue                   # a session still maps it
+                from ..distributed import compress as _compress
+
+                kb = np.asarray(self.kp[f])
+                vb = np.asarray(self.vp[f])
+                kq, ks = (np.asarray(a) for a in
+                          _compress.quantize_rows(kb))
+                vq, vs = (np.asarray(a) for a in
+                          _compress.quantize_rows(vb))
+                entry.cold[idx] = (kq, ks, vq, vs)
+                entry.frames[idx] = None
+                self._deref(f)
+                self._cold_pages += 1
+                self._cold_bytes += kq.size + ks.size * 4 \
+                    + vq.size + vs.size * 4
+                compressed += 1
+        if compressed:
+            _BLOCKS.labels(state="cold").inc(compressed)
+        return compressed
+
+    # -- accounting -------------------------------------------------------
+    def live_blocks(self):
+        """Frames currently allocated (hot), null frame excluded."""
+        return self.n_blocks - 1 - len(self._free)
+
+    def bytes_in_use(self):
+        """Physical KV bytes the pool holds right now: hot frames at the
+        device dtype plus compressed cold pages (int8 values + f32 row
+        scales). Shared prefix frames count ONCE — this is the number
+        the >= 2x KV-bytes-per-session gate divides."""
+        return self.live_blocks() * self.block_bytes + self._cold_bytes
+
+    def stats(self):
+        return {
+            "block_size": self.bs,
+            "n_blocks": self.n_blocks,
+            "max_blocks_per_slot": self.maxb,
+            "free_blocks": len(self._free),
+            "live_blocks": self.live_blocks(),
+            "cold_pages": self._cold_pages,
+            "block_bytes": self.block_bytes,
+            "bytes_in_use": self.bytes_in_use(),
+            "cold_bytes": self._cold_bytes,
+            "prefixes": len(self._prefixes),
+            "sweeps": self._sweeps,
+        }
+
+
+class AdapterRegistry:
+    """Named multi-LoRA adapter slots with LRU eviction + pinning.
+
+    Slot 0 is reserved (all-zero factors = base-model requests); usable
+    slots are ``1..n_slots``. The registry is pure bookkeeping — the
+    engine owns the stacked device factors and writes/zeroes slots on
+    load/evict. Events land on ``serving_adapter_total{event}``."""
+
+    def __init__(self, n_slots):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        self.n_slots = int(n_slots)
+        self._slots = {}                       # name -> slot index
+        self._pinned = set()
+        self._lru = []                         # oldest first
+        self._free = list(range(self.n_slots, 0, -1))
+
+    def lookup(self, name):
+        """Resolve a loaded adapter (LRU-touch + hit count), else None."""
+        slot = self._slots.get(name)
+        if slot is not None:
+            self._touch(name)
+            _ADAPTER.labels(event="hit").inc()
+        return slot
+
+    def peek(self, name):
+        """Resolve without touching LRU or counting a hit."""
+        return self._slots.get(name)
+
+    def _touch(self, name):
+        if name in self._lru:
+            self._lru.remove(name)
+        self._lru.append(name)
+
+    def admit(self, name, pin=False):
+        """Claim a slot for ``name``: a free slot if any, else evict the
+        LRU unpinned adapter. Returns ``(slot, evicted_name)`` —
+        ``evicted_name`` is not None when an adapter was displaced (the
+        engine must zero/overwrite the device slot and requeue that
+        adapter's in-flight sessions). Raises when every slot is pinned."""
+        if name in self._slots:
+            raise ValueError(f"adapter {name!r} is already loaded")
+        evicted = None
+        if self._free:
+            slot = self._free.pop()
+        else:
+            victim = next((n for n in self._lru
+                           if n not in self._pinned), None)
+            if victim is None:
+                raise RuntimeError(
+                    f"all {self.n_slots} adapter slots are pinned — "
+                    "evict_adapter() one or raise max_adapters=")
+            slot = self._slots.pop(victim)
+            self._lru.remove(victim)
+            _ADAPTER.labels(event="evict").inc()
+            evicted = victim
+        self._slots[name] = slot
+        if pin:
+            self._pinned.add(name)
+        self._touch(name)
+        _ADAPTER.labels(event="load").inc()
+        return slot, evicted
+
+    def evict(self, name):
+        """Explicitly evict ``name`` (pinned or not); returns its slot."""
+        if name not in self._slots:
+            raise KeyError(f"adapter {name!r} is not loaded")
+        slot = self._slots.pop(name)
+        self._pinned.discard(name)
+        if name in self._lru:
+            self._lru.remove(name)
+        self._free.append(slot)
+        _ADAPTER.labels(event="evict").inc()
+        return slot
+
+    def loaded(self):
+        return dict(self._slots)
+
+    def stats(self):
+        return {"n_slots": self.n_slots,
+                "loaded": len(self._slots),
+                "pinned": len(self._pinned),
+                "free_slots": len(self._free)}

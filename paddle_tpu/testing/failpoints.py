@@ -81,6 +81,10 @@ SITES = {
                   "validated/encoded onto the queue; a delay here reads "
                   "as a stalled stage to the stall sentinel, an error "
                   "leaves the payload un-enqueued (producer retries)",
+    "serving/adapter": "ServingEngine.load_adapter/evict_adapter — before "
+                       "the adapter registry or the device factors "
+                       "mutate; an injected error leaves both exactly as "
+                       "they were (in-flight sessions keep decoding)",
 }
 
 

@@ -50,6 +50,17 @@ Checks (one entry per name in `passes`):
                      are where-selected back bit-exactly — no
                      quantization poison carried into the next step,
                      which then trains normally
+  adapter_evict_under_load the FLAGS_paged_kv engine's hot adapter is
+                     evicted mid-stream: the live session requeues (not
+                     reason='error'), re-admits after a hot-reload and
+                     finishes bit-exact vs an undisturbed twin; a
+                     serving/adapter=error:1 failpoint on a load leaves
+                     the registry untouched
+  page_pool_full     paged-KV pool exhaustion backpressures BEFORE any
+                     work: a never-fits request is rejected at submit
+                     with zero pool mutation, transient exhaustion
+                     requeues to bit-exact completion, drain frees
+                     every block
 
 Report format: the tools/graph_lint.py schema ({"tool", "passes",
 "targets": {name: {"name", "counts", "findings"}}, "totals"}), so CI reads
@@ -72,7 +83,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 PASSES = ["ckpt_atomic", "ckpt_fallback", "serving_deadline",
           "serving_slot_error", "serving_shed", "router_failover",
           "stall_dump", "stage_backpressure", "trainer_nonfinite",
-          "numerics_anomaly", "quantized_nonfinite", "async_nonfinite"]
+          "numerics_anomaly", "quantized_nonfinite", "async_nonfinite",
+          "adapter_evict_under_load", "page_pool_full"]
 
 
 def _finding(name, severity, message, where=""):
@@ -498,6 +510,168 @@ def _check_stage_backpressure(m):
                 "drain stayed bit-exact with puts==gets==prompts")]
 
 
+def _export_tiny_adapter(m, seed):
+    """A LoRA export over the tiny chaos model, lora_B randomized so the
+    adapter's delta actually moves tokens."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.lora import apply_lora, export_lora
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                    num_heads=2, max_seq_len=64, dropout=0.0)
+    m2 = GPTForCausalLM(cfg)
+    m2.load_dict(m.state_dict())
+    apply_lora(m2, r=4, alpha=8)
+    rng = np.random.RandomState(seed)
+    for n_, p_ in m2.named_parameters():
+        if "lora_B" in n_:
+            p_.set_value(paddle.to_tensor(
+                rng.normal(0, 0.3, p_.shape).astype(np.float32)))
+    return export_lora(m2)
+
+
+def _check_adapter_evict_under_load(m):
+    """Chaos-injected adapter churn on the FLAGS_paged_kv engine: the hot
+    adapter is evicted while its session is mid-stream — the session must
+    be booted back to the queue (NOT finished reason='error'), re-admit
+    after the adapter hot-reloads, and finish bit-exact against an
+    undisturbed twin. A serving/adapter=error:1 failpoint on a load must
+    additionally leave the registry and device factors exactly as they
+    were, with in-flight sessions still decoding."""
+    import numpy as np
+
+    from paddle_tpu import flags
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.testing import failpoints as fp
+
+    name = "adapter_evict_under_load"
+    old = {"paged_kv": flags.get_flag("paged_kv")}
+    flags.set_flags({"paged_kv": True})
+    try:
+        expA = _export_tiny_adapter(m, 11)
+        expB = _export_tiny_adapter(m, 12)
+        rng = np.random.RandomState(5)
+        prompt = rng.randint(0, 64, (5,)).astype(np.int32)
+
+        ref_eng = ServingEngine(m, max_batch=2, max_adapters=2)
+        ref_eng.load_adapter("hot", expA)
+        rr = ref_eng.submit(prompt, max_new_tokens=8, adapter="hot")
+        ref = tuple(int(t)
+                    for t in ref_eng.run_until_complete()[rr].output_ids)
+
+        eng = ServingEngine(m, max_batch=2, max_adapters=2)
+        eng.load_adapter("hot", expA)
+        rid = eng.submit(prompt, max_new_tokens=8, adapter="hot")
+        for _ in range(3):
+            eng.step()          # mid-stream: tokens already emitted
+        if not eng.get_request(rid).output_ids:
+            return [_finding(name, "error",
+                             "scenario broken: no tokens streamed before "
+                             "the eviction")]
+        eng.evict_adapter("hot")   # under load: boots the live session
+        req = eng.get_request(rid)
+        if req.finish_reason is not None:
+            return [_finding(name, "error",
+                             "evicting the hot adapter finished its "
+                             f"session (reason={req.finish_reason!r}) "
+                             "instead of requeueing it")]
+        with fp.scoped("serving/adapter=error:1"):
+            try:
+                eng.load_adapter("other", expB)
+                return [_finding(name, "error",
+                                 "armed serving/adapter failpoint did "
+                                 "not fire on load_adapter")]
+            except fp.FailpointError:
+                pass
+        if eng._adapters.lookup("other") is not None:
+            return [_finding(name, "error",
+                             "a load that died on the failpoint still "
+                             "mutated the adapter registry")]
+        eng.load_adapter("hot", expA)   # hot-reload: the session re-admits
+        res = eng.run_until_complete()
+        got = tuple(int(t) for t in res[rid].output_ids)
+        if res[rid].finish_reason != "length" or got != ref:
+            return [_finding(
+                name, "error",
+                "evicted-then-reloaded session lost bit-exactness vs the "
+                f"undisturbed twin (reason={res[rid].finish_reason!r}, "
+                f"got={list(got)}, want={list(ref)})")]
+    finally:
+        flags.set_flags(old)
+    return [_ok(name,
+                "hot adapter evicted mid-stream; session requeued (not "
+                "errored), re-admitted after hot-reload, bit-exact vs "
+                "the undisturbed twin; a failed load left the registry "
+                "untouched")]
+
+
+def _check_page_pool_full(m):
+    """Paged-KV pool exhaustion: reservation-before-compute means a full
+    pool backpressures BEFORE any prefill work — a permanently-oversized
+    request is rejected at submit() (pool counters unmoved), and
+    transient exhaustion requeues sessions until blocks free, every one
+    finishing reason='length' bit-exact against a roomy-pool twin."""
+    import numpy as np
+
+    from paddle_tpu import flags
+    from paddle_tpu.inference.serving import ServingEngine
+
+    name = "page_pool_full"
+    old = {"paged_kv": flags.get_flag("paged_kv")}
+    flags.set_flags({"paged_kv": True})
+    try:
+        rng = np.random.RandomState(6)
+        prompts = [rng.randint(0, 64, (n,)).astype(np.int32)
+                   for n in (4, 6, 5)]
+
+        # 3 usable frames (+ null): a 60-column budget needs 4 blocks —
+        # never fits; the 3-block transient requests fit one at a time
+        eng = ServingEngine(m, max_batch=4, page_blocks=4)
+        free0 = eng._pool.stats()["free_blocks"]
+        try:
+            eng.submit(rng.randint(0, 64, (40,)).astype(np.int32),
+                       max_new_tokens=20)
+            return [_finding(name, "error",
+                             "a request that can NEVER fit the pool was "
+                             "accepted instead of rejected at submit()")]
+        except ValueError:
+            pass
+        if eng._pool.stats()["free_blocks"] != free0:
+            return [_finding(name, "error",
+                             "the rejected oversized request leaked pool "
+                             "blocks — work happened before the "
+                             "reservation check")]
+        rids = [eng.submit(p, max_new_tokens=30) for p in prompts]
+        res = eng.run_until_complete()
+        roomy = ServingEngine(m, max_batch=4)
+        rids2 = [roomy.submit(p, max_new_tokens=30) for p in prompts]
+        res2 = roomy.run_until_complete()
+        for i, (a, b) in enumerate(zip(rids, rids2)):
+            if res[a].finish_reason != "length":
+                return [_finding(
+                    name, "error",
+                    f"request {i} under the tiny pool finished "
+                    f"{res[a].finish_reason!r}, not 'length' — "
+                    "backpressure turned into an error")]
+            if [int(t) for t in res[a].output_ids] \
+                    != [int(t) for t in res2[b].output_ids]:
+                return [_finding(name, "error",
+                                 f"request {i} lost bit-exactness under "
+                                 "pool-full requeueing")]
+        if eng._pool.stats()["live_blocks"] != 0:
+            return [_finding(name, "error",
+                             "drained engine still holds live pool "
+                             f"blocks: {eng._pool.stats()}")]
+    finally:
+        flags.set_flags(old)
+    return [_ok(name,
+                "oversized request rejected before any work; transient "
+                "pool exhaustion requeued sessions to bit-exact "
+                "completion; all blocks freed on drain")]
+
+
 def _check_trainer_nonfinite():
     import numpy as np
 
@@ -835,7 +1009,8 @@ def build_report(only=None):
     ]
     if selected & {"serving_deadline", "serving_slot_error",
                    "serving_shed", "router_failover", "stall_dump",
-                   "stage_backpressure"}:
+                   "stage_backpressure", "adapter_evict_under_load",
+                   "page_pool_full"}:
         m = _tiny_model()
         checks += [
             ("serving_deadline", lambda: _check_serving_deadline(m)),
@@ -845,6 +1020,9 @@ def build_report(only=None):
             ("stall_dump", lambda: _check_stall_dump(m)),
             ("stage_backpressure",
              lambda: _check_stage_backpressure(m)),
+            ("adapter_evict_under_load",
+             lambda: _check_adapter_evict_under_load(m)),
+            ("page_pool_full", lambda: _check_page_pool_full(m)),
         ]
     for name, fn in checks:
         if name not in selected:

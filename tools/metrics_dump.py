@@ -9,6 +9,7 @@
     python tools/metrics_dump.py --quantized              # int8 grad reduce
     python tools/metrics_dump.py --mpmd                   # stage-graph pipeline
     python tools/metrics_dump.py --ledger                 # perf ledger + sentinel
+    python tools/metrics_dump.py --paged                  # paged KV + multi-LoRA
     python tools/metrics_dump.py --model bert --prometheus
     python tools/metrics_dump.py --all --json             # machine-readable
     python tools/metrics_dump.py --serving --trace        # + span summary
@@ -84,6 +85,13 @@ _REQUIRED = {
     # deliberate failpoint-delayed step
     "ledger": ("perf_ledger_rows_total", "perf_regression_total",
                "step_latency_ms", "compile_cache_total"),
+    # the paged-KV serving tier (docs/SERVING.md "Paged KV & multi-LoRA"):
+    # block churn by temperature, at least one copy-on-write boundary
+    # clone, and the adapter-registry lifecycle counters from the armed
+    # 2-adapter loop
+    "paged": ("kv_page_blocks_total", "kv_page_cow_total",
+              "serving_adapter_total", "serving_requests_submitted_total",
+              "serving_ttft_ms"),
 }
 
 #: (family, label, value) series that must exist in a target's snapshot,
@@ -99,6 +107,11 @@ _REQUIRED_SERIES = {
              ("collective_bytes_total", "op", "stage_edge")),
     "ledger": (("perf_ledger_rows_total", "site", "trainer"),
                ("perf_regression_total", "metric", "step_ms")),
+    "paged": (("kv_page_blocks_total", "state", "hot"),
+              ("kv_page_blocks_total", "state", "cold"),
+              ("serving_adapter_total", "event", "load"),
+              ("serving_adapter_total", "event", "hit"),
+              ("serving_adapter_total", "event", "evict")),
 }
 
 _DIMS = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
@@ -478,6 +491,64 @@ def run_ledger_loop(steps=6, delay_ms=400):
             pass
 
 
+def run_paged_loop(new_tokens=4):
+    """The paged-KV target: an armed (FLAGS_paged_kv) 2-adapter engine —
+    a registered shared prefix whose length straddles a block boundary
+    (copy-on-write fires at admission), adapter-routed sessions (load +
+    hit events), idle sweeps past page_cold_steps (blocks demote to int8
+    cold pages) and one explicit evict — moves kv_page_blocks_total
+    {state=hot|cold}, kv_page_cow_total and serving_adapter_total
+    {event=load|hit|evict} in one pass."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import flags
+    from paddle_tpu.incubate.lora import apply_lora, export_lora
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    old = {"paged_kv": flags.get_flag("paged_kv")}
+    paddle.set_flags({"paged_kv": True})
+    try:
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        model = GPTForCausalLM(GPTConfig(max_seq_len=64, **_DIMS))
+        model.eval()
+
+        def _adapter(seed):
+            m2 = GPTForCausalLM(GPTConfig(max_seq_len=64, **_DIMS))
+            m2.load_dict(model.state_dict())
+            apply_lora(m2, r=4, alpha=8)
+            r = np.random.RandomState(seed)
+            for n_, p_ in m2.named_parameters():
+                if "lora_B" in n_:
+                    p_.set_value(paddle.to_tensor(
+                        r.normal(0, 0.1, p_.shape).astype(np.float32)))
+            return export_lora(m2)
+
+        eng = ServingEngine(model, max_batch=4, max_adapters=2,
+                            page_cold_steps=2)
+        eng.load_adapter("bot-a", _adapter(1))
+        eng.load_adapter("bot-b", _adapter(2))
+        # prefix of 20 tokens with 16-token blocks: the boundary block is
+        # partial, so every admission clones it (kv_page_cow_total)
+        pid = eng.register_prefix(
+            rng.randint(0, 256, (20,)).astype(np.int32))
+        for i in range(3):
+            eng.submit(rng.randint(0, 256, (2 + i,)).astype(np.int32),
+                       max_new_tokens=new_tokens, prefix_id=pid)
+        for name in ("bot-a", "bot-b"):
+            eng.submit(rng.randint(0, 256, (6,)).astype(np.int32),
+                       max_new_tokens=new_tokens, adapter=name)
+        eng.run_until_complete()
+        for _ in range(4):
+            eng.step()   # idle sweeps: the prefix blocks age cold
+        eng.evict_adapter("bot-b")
+        return eng.stats()["paging"]
+    finally:
+        paddle.set_flags(old)
+
+
 def run_blackbox_loop(new_tokens=4):
     """The flight-recorder target: a short serving loop with the
     recorder ON, then one on-demand dump bundle into a throwaway dir —
@@ -558,7 +629,7 @@ def run_target(name, with_trace=False):
     trace_summary = None
     kind = (name if name in ("serving", "router", "blackbox", "federated",
                              "numerics", "quantized", "async", "mpmd",
-                             "ledger")
+                             "ledger", "paged")
             else "train")
     if with_trace:
         trace.clear()
@@ -582,6 +653,8 @@ def run_target(name, with_trace=False):
             run_mpmd_loop()
         elif kind == "ledger":
             run_ledger_loop()
+        elif kind == "paged":
+            run_paged_loop()
         else:
             run_train_step(name)
     finally:
@@ -687,10 +760,18 @@ def main(argv=None):
                          "step); exit 1 unless perf_ledger_rows_total"
                          "{site=trainer} and perf_regression_total"
                          "{metric=step_ms} are present")
+    ap.add_argument("--paged", action="store_true", dest="paged",
+                    help="run the paged-KV target (FLAGS_paged_kv engine "
+                         "with 2 LoRA adapters, a boundary-straddling "
+                         "shared prefix and cold sweeps); exit 1 unless "
+                         "kv_page_blocks_total{state=hot|cold}, "
+                         "kv_page_cow_total and serving_adapter_total"
+                         "{event=load|hit|evict} are present")
     ap.add_argument("--all", action="store_true",
                     help="all models + the serving loop + the router, "
                          "flight-recorder, federated, numerics, "
-                         "quantized, async, mpmd and perf-ledger tiers")
+                         "quantized, async, mpmd, perf-ledger and "
+                         "paged-KV tiers")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the graph_lint-schema machine report")
     ap.add_argument("--prometheus", action="store_true",
@@ -719,15 +800,17 @@ def main(argv=None):
         targets.append("mpmd")
     if args.ledger:
         targets.append("ledger")
+    if args.paged:
+        targets.append("paged")
     if args.all:
         targets = list(MODEL_TARGETS) + ["serving", "router", "blackbox",
                                          "federated", "numerics",
                                          "quantized", "async", "mpmd",
-                                         "ledger"]
+                                         "ledger", "paged"]
     if not targets:
         ap.error("pick a target: --model NAME, --serving, --router, "
                  "--blackbox, --federated, --numerics, --quantized, "
-                 "--async, --mpmd, --ledger or --all")
+                 "--async, --mpmd, --ledger, --paged or --all")
 
     report = build_report(targets, with_trace=args.with_trace)
     if args.as_json:

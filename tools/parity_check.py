@@ -7,6 +7,8 @@ stat) when a pair leaves its declared tolerance band.
     python tools/parity_check.py --ab amp_bf16             # bf16 amp: banded
     python tools/parity_check.py --ab quantized_allreduce  # int8 reduce: banded
     python tools/parity_check.py --ab shard_weight_update  # ZeRO-ish: EXACT
+    python tools/parity_check.py --ab multi_lora           # pooled vs dedicated
+    python tools/parity_check.py --ab paged_kv             # armed vs dense
     python tools/parity_check.py --all
     python tools/parity_check.py --perturb-lr 5 --json     # negative control
     python tools/parity_check.py --ab quantized_allreduce --perturb-lr 6
@@ -186,6 +188,193 @@ def _finding(name, severity, message, where=""):
             "where": where}
 
 
+def _serving_fixture():
+    """Seeded tiny GPT + two exported LoRA adapters shared by the
+    serving-side parity targets (multi_lora / paged_kv)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.lora import apply_lora, export_lora
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=64, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+
+    def _adapter(seed):
+        m2 = GPTForCausalLM(cfg)
+        m2.load_dict(model.state_dict())
+        apply_lora(m2, r=4, alpha=8)
+        rng = np.random.RandomState(seed)
+        for n_, p_ in m2.named_parameters():
+            if "lora_B" in n_:
+                p_.set_value(paddle.to_tensor(
+                    rng.normal(0, 0.3, p_.shape).astype(np.float32)))
+        return export_lora(m2)
+
+    return model, {"alpha": _adapter(1), "beta": _adapter(2)}
+
+
+def _drain(eng, jobs):
+    """Submit [(prompt, kwargs)] jobs and return their outputs as
+    int-token tuples, in job order."""
+    rids = [eng.submit(list(p), **kw) for p, kw in jobs]
+    res = eng.run_until_complete()
+    return [tuple(int(t) for t in res[r].output_ids) for r in rids]
+
+
+def run_multi_lora(steps=4):
+    """ONE pooled multi-adapter engine vs a dedicated single-adapter
+    engine per adapter (same batched-LoRA math, adapter alone in its
+    pool): every session — greedy and seeded-sampled, base and
+    adapter-routed — must be BYTE-identical. The acceptance bar for
+    FLAGS_paged_kv batched multi-LoRA decode (docs/SERVING.md)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import flags
+    from paddle_tpu.inference.serving import ServingEngine
+
+    old = {"paged_kv": flags.get_flag("paged_kv")}
+    paddle.set_flags({"paged_kv": True})
+    try:
+        model, adapters = _serving_fixture()
+        prompts = [[3, 14, 15, 9, 2, 6], [7, 1, 19], [21, 22, 23, 24]]
+        n_new = 4 + steps
+
+        def _jobs(adapter):
+            out = []
+            for i, p in enumerate(prompts):
+                kw = dict(max_new_tokens=n_new, adapter=adapter)
+                if i == 2:   # one seeded-sampled session per adapter
+                    kw.update(temperature=0.8, top_k=16, seed=11)
+                out.append((p, kw))
+            return out
+
+        pooled = ServingEngine(model, max_batch=4, max_adapters=4)
+        for name, exp in adapters.items():
+            pooled.load_adapter(name, exp)
+        pooled_out = {name: _drain(pooled, _jobs(name))
+                      for name in list(adapters) + [None]}
+
+        findings, sessions = [], 0
+        for name in list(adapters) + [None]:
+            dedicated = ServingEngine(model, max_batch=4,
+                                      max_adapters=4)
+            if name is not None:
+                dedicated.load_adapter(name, adapters[name])
+            ded_out = _drain(dedicated, _jobs(name))
+            for i, (a, b) in enumerate(zip(pooled_out[name], ded_out)):
+                sessions += 1
+                if a != b:
+                    findings.append(_finding(
+                        "multi_lora", "error",
+                        f"adapter={name!r} session {i}: pooled engine "
+                        f"diverged from its dedicated twin — pooled="
+                        f"{list(a)} dedicated={list(b)}",
+                        where=f"adapter={name}/session{i}"))
+        if not findings:
+            findings.append(_finding(
+                "multi_lora", "info",
+                f"{sessions} sessions ({len(adapters)} adapters + base, "
+                "greedy + seeded-sampled) byte-identical between the "
+                "pooled engine and dedicated per-adapter engines"))
+        report = {"sessions": sessions, "adapters": sorted(adapters),
+                  "diverged": any(f["severity"] == "error"
+                                  for f in findings)}
+        return report, findings
+    finally:
+        paddle.set_flags(old)
+
+
+def run_paged_kv(steps=4):
+    """FLAGS_paged_kv armed vs disarmed: the paged engine's dense decode
+    must be BYTE-identical to the contiguous-cache engine (junk/null
+    page columns are causally masked — exact by contract). Plus the int8
+    cold-page band: a prefix block compressed cold and decompressed on
+    touch must sit within the deterministic row codec's quantization
+    step (|err| <= row absmax / 127)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import flags
+    from paddle_tpu.inference.serving import ServingEngine
+
+    model, _ = _serving_fixture()
+    prompts = [[3, 14, 15, 9, 2, 6], [7, 1, 19], [21, 22, 23, 24]]
+    n_new = 4 + steps
+
+    def _jobs():
+        out = []
+        for i, p in enumerate(prompts):
+            kw = dict(max_new_tokens=n_new)
+            if i == 2:
+                kw.update(temperature=0.8, top_k=16, seed=11)
+            out.append((p, kw))
+        return out
+
+    old = {"paged_kv": flags.get_flag("paged_kv")}
+    findings = []
+    try:
+        paddle.set_flags({"paged_kv": False})
+        dense_out = _drain(ServingEngine(model, max_batch=4), _jobs())
+        paddle.set_flags({"paged_kv": True})
+        paged_out = _drain(ServingEngine(model, max_batch=4), _jobs())
+        for i, (a, b) in enumerate(zip(dense_out, paged_out)):
+            if a != b:
+                findings.append(_finding(
+                    "paged_kv", "error",
+                    f"session {i}: armed paged engine diverged from the "
+                    f"disarmed dense engine — dense={list(a)} "
+                    f"paged={list(b)}", where=f"session{i}"))
+
+        # int8 cold band: hot frame -> sweep cold -> touch decompress
+        eng = ServingEngine(model, max_batch=2, page_cold_steps=1)
+        pool = eng._pool
+        pid = eng.register_prefix(list(range(2, 34)))   # 2 full blocks
+        frames = pool.prefix_frames(pid)
+        hot_k = np.asarray(pool.kp[np.array(frames)])
+        for _ in range(4):
+            pool.sweep()
+        if pool.stats()["cold_pages"] == 0:
+            findings.append(_finding(
+                "paged_kv", "error",
+                "prefix blocks never compressed cold under "
+                "page_cold_steps=1 idle sweeps", where="cold"))
+        else:
+            frames2 = pool.prefix_frames(pid)   # touch: decompress
+            back_k = np.asarray(pool.kp[np.array(frames2)])
+            err = np.abs(back_k.astype(np.float64)
+                         - hot_k.astype(np.float64))
+            # per-row band of the row codec: absmax/127 (+ float eps)
+            band = np.abs(hot_k).max(axis=-1, keepdims=True) / 127.0 \
+                + 1e-6
+            worst = float((err - band).max())
+            if worst > 0:
+                findings.append(_finding(
+                    "paged_kv", "error",
+                    f"cold int8 round-trip left the row-codec band by "
+                    f"{worst:.3g}", where="cold"))
+            else:
+                findings.append(_finding(
+                    "paged_kv", "info",
+                    f"{len(dense_out)} sessions byte-identical armed vs "
+                    f"disarmed; int8 cold round-trip within the "
+                    f"rowmax/127 band (max err {float(err.max()):.3g})"))
+        report = {"sessions": len(dense_out),
+                  "diverged": any(f["severity"] == "error"
+                                  for f in findings)}
+        return report, findings
+    finally:
+        paddle.set_flags(old)
+
+
+#: serving-side parity targets — engine-vs-engine token comparisons, not
+#: trainer lockstep A/Bs; they run through their own runners and skip
+#: the --perturb-lr trainer companion machinery
+SERVING_TARGETS = {"multi_lora": run_multi_lora, "paged_kv": run_paged_kv}
+
+
 def run_target(name, steps=4, perturb_lr=None):
     """Run one A/B; returns (report, findings). `perturb_lr` builds a
     negative-control variant instead (candidate lr scaled — MUST
@@ -195,6 +384,8 @@ def run_target(name, steps=4, perturb_lr=None):
     companion run for the banded quantized_allreduce gate)."""
     from paddle_tpu.testing import parity
 
+    if perturb_lr is None and name in SERVING_TARGETS:
+        return SERVING_TARGETS[name](steps=steps)
     if perturb_lr is not None:
         if name in AB_TARGETS:
             spec = dict(AB_TARGETS[name])
@@ -249,7 +440,10 @@ def build_report(targets, steps=4, perturb_lr=None):
         if targets:
             # negative control per named target, in ITS band — MUST
             # diverge (exit 1), proving each new gate can actually fail
+            # (trainer A/Bs only: the serving targets have no lr to turn)
             for t in targets:
+                if t in SERVING_TARGETS:
+                    continue
                 jobs.append((f"{t}+perturb_lr", t, perturb_lr))
                 report["passes"].append(f"{t}+perturb_lr")
         else:
@@ -277,7 +471,8 @@ def build_report(targets, steps=4, perturb_lr=None):
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--ab", action="append", choices=sorted(AB_TARGETS),
+    ap.add_argument("--ab", action="append",
+                    choices=sorted(AB_TARGETS) + sorted(SERVING_TARGETS),
                     default=[], help="run one named A/B target "
                     "(repeatable)")
     ap.add_argument("--all", action="store_true",
@@ -294,7 +489,8 @@ def main(argv=None):
                     help="emit the graph_lint-schema machine report")
     args = ap.parse_args(argv)
 
-    targets = sorted(AB_TARGETS) if args.all else list(args.ab)
+    targets = (sorted(AB_TARGETS) + sorted(SERVING_TARGETS)) if args.all \
+        else list(args.ab)
     if not targets and args.perturb_lr is None:
         ap.error("pick a target: --ab NAME, --all, or --perturb-lr F")
 
