@@ -75,6 +75,7 @@ STRUCTURAL_FLAGS = (
     "flash_attention_block",
     "mpmd",
     "paged_kv",
+    "elastic",
 )
 
 #: function names whose bodies ARE executable-identity expressions —

@@ -55,6 +55,7 @@ LAZY_MODULES = (
     "paddle_tpu.monitor.perfledger",         # perf ledger + sentinel (ISSUE 17)
     "paddle_tpu.analysis.calibrate",         # measured-constant fits (ISSUE 17)
     "paddle_tpu.serving.paging",             # paged KV block pool (ISSUE 18)
+    "paddle_tpu.distributed.elastic",        # auto-resume supervisor (ISSUE 19)
 )
 
 #: what a plain trainer/engine process imports (the roots of the closure

@@ -1,0 +1,199 @@
+"""Elastic auto-resume supervisor (FLAGS_elastic; docs/DISTRIBUTED.md
+"Elastic training").
+
+Production fleets lose and gain slices; a preemption mid-step must not
+turn a dp8 run into a dead run. This module closes the loop the
+checkpoint layer opened: :class:`ElasticSupervisor` wraps a train loop
+and wires three existing recovery mechanisms into one retry-with-backoff
+policy —
+
+- the PR 4 :class:`CheckpointSaver` corrupt-fallback walk-back
+  (incubate/checkpoint/auto_checkpoint.py): the newest READABLE
+  checkpoint wins, unreadable ones are evicted loudly;
+- the topology-aware restore (distributed/spmd.py
+  ``restore_train_state``): the checkpoint's ``shard_specs`` leaf lets
+  it land on a DIFFERENT dp factorization, so the supervisor resumes on
+  a shrunken mesh when the original shape is gone — [dp, shard] moments
+  re-laid bit-exactly, ``__qar_residual__`` EF residuals folded;
+- the PR 7 blackbox flight recorder: every recovery writes a crash
+  bundle (when the recorder is armed) and a ring note naming the
+  reason, the failed step, and the replacement mesh — recoveries are
+  attributable, never silent.
+
+Every recovery also lands in ``elastic_resume_total{reason}`` (lazy —
+the family only exists once something was actually recovered) and, under
+``FLAGS_perf_ledger``, a ledger row at site ``elastic/resume`` so
+recovery cost shows up in the cross-run ledger next to step time.
+
+This module is manifest-lazy (analysis/import_graph.py LAZY_MODULES):
+with ``FLAGS_elastic`` unset nothing imports it and a plain trainer is
+byte-identical to the pre-elastic build (tests/test_elastic_gate.py).
+"""
+import time
+
+import numpy as np
+
+from .. import flags as _flags
+from .. import monitor as _monitor
+from ..monitor import blackbox_lazy as _blackbox
+from ..testing import failpoints as _fp
+
+__all__ = ["ElasticSupervisor"]
+
+_ELASTIC_RESUME = None  # lazy elastic_resume_total — shared family with
+#                         stage.py's stage_replace call site (the
+#                         registry is get-or-create by name)
+
+
+def _note_resume(reason):
+    global _ELASTIC_RESUME
+    if not _monitor.is_enabled():
+        return
+    if _ELASTIC_RESUME is None:
+        _ELASTIC_RESUME = _monitor.counter(
+            "elastic_resume_total",
+            "elastic recoveries by reason (failpoint | nonfinite | crash "
+            "from the supervisor's resume path, stage_replace from MPMD "
+            "stage rebinding); zero unless FLAGS_elastic machinery "
+            "actually recovered something",
+            labelnames=("reason",))
+    _ELASTIC_RESUME.labels(reason=reason).inc()
+
+
+def _classify(exc):
+    if isinstance(exc, _fp.FailpointError):
+        return "failpoint"
+    if isinstance(exc, FloatingPointError):
+        return "nonfinite"
+    return "crash"
+
+
+class ElasticSupervisor:
+    """Retry-with-backoff auto-resume around a step loop.
+
+    ::
+
+        saver = CheckpointSaver(ckpt_dir)
+        sup = ElasticSupervisor(
+            build_trainer,                      # mesh -> SpmdTrainer
+            saver,
+            mesh_factories=[full_mesh_or_none,  # preference order;
+                            shrunken_mesh],     # None = shape is gone
+            checkpoint_interval=1)
+        losses = sup.run(batches)               # indexable batch tuples
+
+    ``build_trainer(mesh)`` constructs a fresh trainer on the given
+    mesh; ``mesh_factories`` is walked in preference order on every
+    (re)build — a factory returning ``None`` means that topology is
+    currently infeasible (its slice was preempted), so recovery falls
+    through to the next, shrunken, shape. The restored checkpoint
+    reshards onto whatever factorization won (``shard_specs``).
+
+    A step that raises consumes one retry: the failure is classified
+    (``failpoint`` — an injected :class:`FailpointError` —, ``nonfinite``
+    or ``crash``), bundled/noted, and the loop resumes from the newest
+    readable checkpoint, replaying any steps since it. Retries beyond
+    ``max_retries`` re-raise the original error. Each attempt sleeps
+    ``backoff_s * attempt`` and passes the registered ``elastic/resume``
+    failpoint (so retry exhaustion is itself chaos-testable).
+    """
+
+    def __init__(self, build_trainer, saver, mesh_factories,
+                 checkpoint_interval=1, max_retries=3, backoff_s=0.0):
+        if not _flags.get_flag("elastic", False):
+            raise RuntimeError(
+                "ElasticSupervisor requires FLAGS_elastic=1 — the flag "
+                "is structural (it keys the trainer's executables) and "
+                "gates this module's import (docs/DISTRIBUTED.md)")
+        if not mesh_factories:
+            raise ValueError("mesh_factories must name at least one "
+                             "candidate topology")
+        self.build_trainer = build_trainer
+        self.saver = saver
+        self.mesh_factories = list(mesh_factories)
+        self.checkpoint_interval = max(1, int(checkpoint_interval))
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.trainer = None
+        self.recoveries = []   # [{reason, step, mesh, downtime_ms}]
+
+    def _next_mesh(self):
+        for factory in self.mesh_factories:
+            mesh = factory()
+            if mesh is not None:
+                return mesh
+        raise RuntimeError(
+            "no feasible mesh: every mesh_factories candidate returned "
+            "None (all topologies preempted)")
+
+    def _resume(self, trainer):
+        """Restore the newest readable checkpoint (corrupt-fallback
+        walk-back built into the saver) onto `trainer`; returns the next
+        step index to run."""
+        state, meta = self.saver.load_checkpoint()
+        if state is None:
+            return 0
+        trainer.set_state_dict(state)
+        return int((meta or {}).get("step", -1)) + 1
+
+    def run(self, batches):
+        """Drive ``trainer.train_step(*batches[i])`` over every batch,
+        checkpointing every ``checkpoint_interval`` steps and auto-
+        resuming on failure. Returns the loss trajectory (one float per
+        batch index; replayed steps overwrite, so the trajectory is the
+        one the SURVIVING lineage trained)."""
+        mesh = self._next_mesh()
+        self.trainer = self.build_trainer(mesh)
+        step = self._resume(self.trainer)
+        losses = {}
+        retries = 0
+        n = len(batches)
+        while step < n:
+            try:
+                loss = self.trainer.train_step(*batches[step])
+                losses[step] = float(
+                    np.asarray(getattr(loss, "_data", loss)))
+                if (step + 1) % self.checkpoint_interval == 0:
+                    self.saver.save_checkpoint(self.trainer.state_dict(),
+                                               meta={"step": step})
+                step += 1
+            except Exception as exc:   # noqa: BLE001 — classified below
+                reason = _classify(exc)
+                retries += 1
+                if retries > self.max_retries:
+                    raise
+                t_fail = time.perf_counter()
+                _blackbox.note("elastic_resume", reason=reason,
+                               step=step, retries=retries,
+                               error=f"{type(exc).__name__}: {exc}")
+                if _blackbox.is_enabled():
+                    # PR 7 crash bundle: ring + providers + env, the
+                    # post-mortem that names THIS recovery
+                    _blackbox.dump("crash", site="elastic/resume",
+                                   extra={"reason": reason, "step": step,
+                                          "retries": retries})
+                _fp.failpoint("elastic/resume")
+                if self.backoff_s:
+                    time.sleep(self.backoff_s * retries)
+                mesh = self._next_mesh()
+                self.trainer = self.build_trainer(mesh)
+                step = self._resume(self.trainer)
+                downtime_ms = (time.perf_counter() - t_fail) * 1e3
+                _note_resume(reason)
+                rec = {"reason": reason, "step": step,
+                       "mesh": tuple(mesh.shape.values()),
+                       "downtime_ms": downtime_ms}
+                self.recoveries.append(rec)
+                _blackbox.note("elastic_resumed", **rec)
+                if _flags.get_flag("perf_ledger", False):
+                    from ..monitor import perfledger as _perfledger
+
+                    # force=True: every recovery lands a row;
+                    # check=False: downtime is out-of-distribution by
+                    # nature, it must not poison step-time baselines
+                    _perfledger.get_ledger().on_step(
+                        "elastic/resume",
+                        {"downtime_ms": downtime_ms,
+                         "retries": retries, "resume_step": step},
+                        mesh=mesh, force=True, check=False)
+        return [losses[i] for i in sorted(losses)]

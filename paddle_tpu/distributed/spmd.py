@@ -48,7 +48,10 @@ CHECKPOINT_SCHEMA = {
     "runtime_checked": False,
     "doc": "host snapshot of the sharded train state; __qar_residual__ "
            "(quantized-allreduce error feedback) and [dp, shard] "
-           "optimizer moments ride opt_state",
+           "optimizer moments ride opt_state; shard_specs records the "
+           "logical [param, shard-spec] layout that wrote them so a "
+           "restore onto a different dp/mp factorization re-lays-out "
+           "(ISSUE 19 topology-aware resharding)",
     "payload": {
         "params": {"kind": "opaque",
                    "layout": "{param_name: host array}"},
@@ -58,6 +61,13 @@ CHECKPOINT_SCHEMA = {
         "optimizer_step_count": {"kind": "scalar", "dtype": "int"},
         "lr_scheduler": {"kind": "opaque",
                          "layout": "scheduler state_dict or None"},
+        "shard_specs": {"kind": "opaque",
+                        "layout": "writer topology metadata: {v, mode, "
+                                  "ndp, dp_axis, shard_update, quantized, "
+                                  "sharding_stage, params: {name: {shape, "
+                                  "size}}, shard_ps, sharded_keys, "
+                                  "qar_eligible} or None (pre-elastic "
+                                  "checkpoint)"},
     },
 }
 
@@ -81,6 +91,29 @@ _SKIPPED = _monitor.counter(
     "updates skipped by the FLAGS_check_nan_inf non-finite guard (params/"
     "optimizer state left bit-identical; > FLAGS_max_skip_steps "
     "consecutive skips raise)", labelnames=("reason",))
+
+_RESHARD = None  # lazy checkpoint_reshard_total — only a cross-topology
+#                  restore (FLAGS_elastic posture) ever creates the family
+
+
+def _note_reshard(action, n=1):
+    """Count one topology-aware restore action (lazy, the failpoints
+    _note_fire pattern): moment_reshard / moment_shard / moment_unshard
+    (bit-exact re-layouts of [dp, shard] moments), residual_fold /
+    residual_zero / residual_drop (__qar_residual__ EF residuals re-laid
+    or deterministically zeroed), step_passthrough (replicated scalars)."""
+    global _RESHARD
+    if not _monitor.is_enabled():
+        return
+    if _RESHARD is None:
+        _RESHARD = _monitor.counter(
+            "checkpoint_reshard_total",
+            "topology-aware checkpoint restore actions by kind "
+            "(docs/DISTRIBUTED.md \"Elastic training\" reshard semantics "
+            "table; zero unless a checkpoint restores onto a different "
+            "dp/mp factorization)",
+            labelnames=("action",))
+    _RESHARD.labels(action=action).inc(n)
 
 
 def _batch_sig_label(batch_arrays):
@@ -302,6 +335,7 @@ class SpmdTrainer:
         self._async, self._async_window = self._resolve_async()
         self._overlap_comm = self._resolve_overlap()
         self._mpmd = self._resolve_mpmd()
+        self._elastic = self._resolve_elastic()
         self._pending_verdicts = []  # [(schedule position, device bool)]
         self._guard_abort = None     # undelivered deferred FloatingPointError
         self._verdict_fetches = 0    # drains (host syncs) so far
@@ -427,6 +461,29 @@ class SpmdTrainer:
                 "__init__ — build a new trainer under the new flag "
                 "value")
         return self._mpmd
+
+    # -- elastic training (distributed/elastic.py) -----------------------------
+    def _resolve_elastic(self):
+        """Consume FLAGS_elastic at construction. Arms resize(mesh) and
+        keys the executables (exec key + AOT extra_key) so an elastic
+        world never aliases a plain cache entry; the supervisor itself
+        lives in the manifest-lazy distributed/elastic.py — a plain
+        trainer never imports it (tests/test_elastic_gate.py)."""
+        return bool(_flags.get_flag("elastic", False))
+
+    def _elastic_active(self):
+        """FLAGS_elastic was consumed at construction (it is baked into
+        this trainer's executable keys and gates resize); a
+        post-construction toggle is loud instead of silently re-keying
+        mid-run. One get_flag + compare when disarmed."""
+        e = bool(_flags.get_flag("elastic", False))
+        if e != self._elastic:
+            raise RuntimeError(
+                "FLAGS_elastic changed after this trainer was "
+                "constructed; the flag is baked into the executable "
+                "cache keys at __init__ — build a new trainer under the "
+                "new flag value")
+        return self._elastic
 
     # -- async double-buffered dispatch (docs/PERF.md) -------------------------
     def _resolve_async(self):
@@ -1510,7 +1567,7 @@ class SpmdTrainer:
         return (self._batch_sig_key(batch_arrays), self._guard_active(),
                 self._numerics_active(), self._compress_active(),
                 self._shard_update_active(), self._overlap_active(),
-                self._mpmd_active())
+                self._mpmd_active(), self._elastic_active())
 
     def _aot_compile(self, batch_arrays, lr, rng, force=False):
         """Build the jitted step for THIS batch signature and obtain its
@@ -1540,7 +1597,7 @@ class SpmdTrainer:
                            self.accumulate_steps, guarded, narmed,
                            self._quantized, self._shard_update,
                            self._qar_bits, self._qar_min_size,
-                           self._overlap_comm, self._mpmd))
+                           self._overlap_comm, self._mpmd, self._elastic))
         self._compiled_store[self._exec_key(batch_arrays)] = (
             compiled, guarded, narmed, self._quantized)
         self._compiled = compiled  # latest executable (back-compat handle)
@@ -1908,29 +1965,162 @@ class SpmdTrainer:
             named_b[n]._data = jax.device_get(v)
 
     # -- checkpoint / resume ---------------------------------------------------
+    def _checkpoint_layout(self):
+        """Logical [param, shard-spec] metadata for THIS trainer's state
+        layout — the ``shard_specs`` leaf of every checkpoint it writes
+        (CHECKPOINT_SCHEMA), and the restore target description when it
+        reads one. Pure data (shapes, sizes, key sets) so it pickles
+        through framework/io.py unchanged."""
+        if self.localsgd_k:
+            mode = "localsgd"
+        elif self._is_dgc():
+            mode = "dgc"
+        elif self._quantized or self._shard_update:
+            mode = "compressed"
+        else:
+            mode = "plain"
+        return {
+            "v": 1,
+            "mode": mode,
+            "ndp": int(self.mesh.shape[self.dp_axis]),
+            "dp_axis": self.dp_axis,
+            "shard_update": bool(self._shard_update),
+            "quantized": bool(self._quantized),
+            "sharding_stage": int(self.sharding_stage),
+            "params": {k: {"shape": [int(d) for d in v.shape],
+                           "size": int(v.size)}
+                       for k, v in self.params.items()},
+            "shard_ps": {k: int(ps) for k, ps in self._shard_ps.items()},
+            "sharded_keys": {p: sorted(ks)
+                             for p, ks in self._shard_state_keys.items()},
+            "qar_eligible": sorted(self._qar_eligible),
+        }
+
     def state_dict(self):
         """Host-side checkpoint of the FULL train state — params, buffers,
         optimizer moments, step counters, LR-scheduler state — gathered
         from whatever shardings are live. `paddle.save(trainer.state_dict(),
         path)` + `set_state_dict(paddle.load(path))` resumes bit-exact
-        (asserted by tests/test_trainer_checkpoint.py)."""
+        (asserted by tests/test_trainer_checkpoint.py). The snapshot also
+        carries this trainer's shard-spec layout so it restores onto a
+        DIFFERENT dp/mp factorization (docs/DISTRIBUTED.md "Elastic
+        training")."""
         state = gather_train_state(self.params, self.opt_state,
-                                   self.optimizer)
+                                   self.optimizer,
+                                   layout=self._checkpoint_layout())
         state["buffers"] = {k: _host_gather(v)
                             for k, v in self.buffers.items()}
         return state
 
     def set_state_dict(self, state):
         """Restore a state_dict() checkpoint, re-placing every array with
-        the trainer's live shardings (same mesh topology). Key mismatches
-        (stale checkpoint vs a changed model) fail fast with names."""
+        the trainer's live shardings. A checkpoint written under a
+        different dp/mp factorization (its ``shard_specs`` leaf differs
+        from this trainer's layout) is re-laid-out on load —
+        topology-aware resharding, counted in
+        checkpoint_reshard_total{action}. Key mismatches (stale
+        checkpoint vs a changed model) fail fast with names."""
         self.params, self.opt_state = restore_train_state(
-            state, self.p_shardings, self.s_shardings, self.optimizer)
+            state, self.p_shardings, self.s_shardings, self.optimizer,
+            layout=self._checkpoint_layout())
         _validate_state_keys("buffers", state.get("buffers", {}),
                              self.b_shardings)
         self.buffers = {k: owned_device_put(jnp.asarray(v),
                                             self.b_shardings[k])
                         for k, v in state.get("buffers", {}).items()}
+
+    # -- elastic resize (FLAGS_elastic; docs/DISTRIBUTED.md) -------------------
+    def resize(self, mesh):
+        """Elastic topology change in place: drain the in-flight window,
+        snapshot the live state at its logical shapes, swap the mesh,
+        and re-place everything under the new dp factorization. The next
+        train_step warm-restarts through the AOT disk cache —
+        mesh_fingerprint (already in every key) hashes shape/kind, not
+        device ids, so a replacement slice of the same shape disk-hits
+        while a genuinely different factorization recompiles cleanly.
+
+        Requires FLAGS_elastic at construction (the flag is structural);
+        localsgd/DGC are rejected — their per-rank replicas/residuals
+        have no topology-independent logical form. [dp, shard] moments
+        re-lay bit-exactly; __qar_residual__ EF residuals fold their
+        summed pending correction into rank 0 of the new factorization
+        (counted residual_fold — total correction preserved, per-rank
+        distribution is not)."""
+        self._elastic_active()
+        if not self._elastic:
+            raise RuntimeError(
+                "SpmdTrainer.resize requires FLAGS_elastic=1 at trainer "
+                "construction — the flag is structural (it keys every "
+                "executable); build elastic trainers from the start")
+        if self.localsgd_k or self._is_dgc():
+            raise NotImplementedError(
+                "resize() is not supported with localsgd/DGC per-rank "
+                "state (no topology-independent logical form)")
+        if self.dp_axis not in mesh.axis_names:
+            raise ValueError(
+                f"replacement mesh has axes {mesh.axis_names}, missing "
+                f"this trainer's dp axis {self.dp_axis!r}")
+        # drain: settle every deferred verdict (and surface a pending
+        # FloatingPointError) before the state is captured
+        self._drain_verdicts(force=True, deliver=True)
+        state = self.state_dict()
+        src = state["shard_specs"]
+        old_fp = _aot.mesh_fingerprint(self.mesh)
+        self.mesh = mesh
+        # executables are keyed WITHOUT mesh identity (_exec_key) — a
+        # stale store would silently run the old factorization's program
+        self._compiled = None
+        self._compiled_store.clear()
+        self._prefetched = None
+        self._cost_entries = {}
+        if self._perf_ledger is not None:
+            self._perf_mesh_fp = _aot.mesh_fingerprint(mesh)
+        # logicalize the snapshot, then let _place_state re-derive the
+        # whole placement vocabulary (shard_ps/sharded keys/zero
+        # residuals) for the new mesh — a from-scratch layout of the
+        # logical values, so moments land bit-exact
+        folds = {}
+        opt_l = {}
+        for pname, st in state["opt_state"].items():
+            if pname == "__step__":
+                opt_l[pname] = st
+                continue
+            if pname == "__qar_residual__":
+                for k, v in st.items():
+                    folds[k] = np.asarray(v).sum(axis=0)
+                continue
+            sk = set((src or {}).get("sharded_keys", {}).get(pname, ()))
+            sub = {}
+            for k, v in st.items():
+                arr = np.asarray(v)
+                if k in sk:
+                    meta = src["params"][pname]
+                    arr = arr.reshape(-1)[:int(meta["size"])] \
+                             .reshape(tuple(meta["shape"]))
+                    _note_reshard("moment_reshard")
+                sub[k] = arr
+            opt_l[pname] = sub
+        self.params = {k: np.asarray(v)
+                       for k, v in state["params"].items()}
+        self.buffers = {k: np.asarray(v)
+                        for k, v in state["buffers"].items()}
+        self.opt_state = opt_l
+        self._shard_ps = {}
+        self._shard_state_keys = {}
+        self._place_state()
+        if folds and "__qar_residual__" in self.opt_state:
+            ndp = int(mesh.shape[self.dp_axis])
+            res = {}
+            for name, sh in self.s_shardings["__qar_residual__"].items():
+                buf = np.zeros((ndp,) + folds[name].shape, np.float32)
+                buf[0] = folds[name]
+                res[name] = owned_device_put(buf, sh)
+                _note_reshard("residual_fold")
+            self.opt_state["__qar_residual__"] = res
+        _blackbox.note("trainer_resize", old_mesh=str(old_fp),
+                       new_mesh=str(_aot.mesh_fingerprint(mesh)),
+                       ndp=int(mesh.shape[self.dp_axis]))
+        return self
 
 
 def data_parallel_step_fn(layer, optimizer, loss_fn, mesh=None, **kw):
@@ -1963,8 +2153,15 @@ def _validate_state_keys(what, got, expected):
             "changed model?)")
 
 
-def gather_train_state(params, opt_state, optimizer):
-    """Host-side {params, opt_state, step, lr_scheduler} snapshot."""
+def gather_train_state(params, opt_state, optimizer, layout=None):
+    """Host-side {params, opt_state, step, lr_scheduler} snapshot.
+
+    `layout` (SpmdTrainer._checkpoint_layout()) stamps the writer's
+    logical [param, shard-spec] metadata into the snapshot's
+    ``shard_specs`` leaf (CHECKPOINT_SCHEMA) so restore_train_state can
+    re-lay-out onto a different dp/mp factorization; None (the
+    PipelineTrainer / pre-elastic path) writes a same-topology-only
+    checkpoint, exactly as before."""
     lr = optimizer._lr
     return {
         "params": {k: _host_gather(v) for k, v in params.items()},
@@ -1975,14 +2172,112 @@ def gather_train_state(params, opt_state, optimizer):
         "optimizer_step_count": int(optimizer._step_count),
         "lr_scheduler": (lr.state_dict()
                          if hasattr(lr, "state_dict") else None),
+        "shard_specs": layout,
     }
 
 
-def restore_train_state(state, p_shardings, s_shardings, optimizer):
+def _layouts_differ(src, dst):
+    """Do two _checkpoint_layout() dicts describe different opt-state
+    topologies? Only the fields that change the PLACED form matter —
+    ndp alone is harmless for logical-shaped (plain/ZeRO) state."""
+    return any(src.get(k) != dst.get(k)
+               for k in ("mode", "ndp", "shard_ps", "sharded_keys",
+                         "qar_eligible"))
+
+
+def _reshard_opt_state(opt_host, src, dst):
+    """Transform a host opt_state snapshot written under layout `src`
+    into the placed form layout `dst` expects (ISSUE 19 topology-aware
+    resharding; docs/DISTRIBUTED.md "Elastic training").
+
+    [dp, shard] moments re-flatten to their logical param shape and
+    re-pad to the destination factorization — bit-exact, the padding is
+    zeros the sharded update never reads. ``__qar_residual__`` EF
+    residuals are genuinely per-rank: each one is folded (summed over
+    the writer's ranks) into rank 0 of the destination — the TOTAL
+    pending error-feedback correction is preserved exactly, its per-rank
+    distribution is not — or deterministically zeroed/dropped when only
+    one side runs quantized. Every action lands in
+    checkpoint_reshard_total{action}."""
+    if src.get("mode") in ("localsgd", "dgc") \
+            or dst.get("mode") in ("localsgd", "dgc"):
+        raise ValueError(
+            "cross-topology restore of localsgd/DGC state is not "
+            "supported: per-rank replicas/residuals have no "
+            "topology-independent logical form (docs/DISTRIBUTED.md)")
+    ndp_t = int(dst["ndp"])
+    out = {}
+    for pname, st in opt_host.items():
+        if pname == "__step__":
+            out[pname] = st
+            _note_reshard("step_passthrough")
+            continue
+        if pname == "__qar_residual__":
+            continue   # handled below against dst's eligibility set
+        src_sk = set(src.get("sharded_keys", {}).get(pname, ()))
+        dst_sk = set(dst.get("sharded_keys", {}).get(pname, ()))
+        meta = dst.get("params", {}).get(pname) \
+            or src.get("params", {}).get(pname)
+        sub = {}
+        for k, v in st.items():
+            arr = np.asarray(v)
+            if k in src_sk:
+                # placed [ndp_s, ps_s] -> logical (padding is zeros)
+                arr = arr.reshape(-1)[:int(meta["size"])] \
+                         .reshape(tuple(meta["shape"]))
+            if k in dst_sk:
+                ps_t = int(dst["shard_ps"][pname])
+                flat = np.pad(arr.reshape(-1),
+                              (0, ps_t * ndp_t - arr.size))
+                sub[k] = flat.reshape(ndp_t, ps_t)
+                _note_reshard("moment_reshard" if k in src_sk
+                              else "moment_shard")
+            else:
+                sub[k] = arr
+                if k in src_sk:
+                    _note_reshard("moment_unshard")
+        out[pname] = sub
+    dst_eligible = list(dst.get("qar_eligible", ()))
+    src_res = opt_host.get("__qar_residual__", {})
+    if dst_eligible:
+        res = {}
+        for name in dst_eligible:
+            meta = dst.get("params", {}).get(name) \
+                or src.get("params", {}).get(name)
+            shape = (ndp_t,) + tuple(meta["shape"])
+            buf = np.zeros(shape, np.float32)
+            if name in src_res:
+                # fold: the summed pending EF correction lands on rank 0
+                buf[0] = np.asarray(src_res[name]).sum(axis=0)
+                _note_reshard("residual_fold")
+            else:
+                _note_reshard("residual_zero")
+            res[name] = buf
+        out["__qar_residual__"] = res
+    dropped = set(src_res) - set(dst_eligible)
+    if dropped:
+        _note_reshard("residual_drop", n=len(dropped))
+    return out
+
+
+def restore_train_state(state, p_shardings, s_shardings, optimizer,
+                        layout=None):
     """Re-place a gather_train_state snapshot onto live shardings; restores
-    step counters and LR-scheduler state. Returns (params, opt_state)."""
+    step counters and LR-scheduler state. Returns (params, opt_state).
+
+    With `layout` (the DESTINATION trainer's _checkpoint_layout()) and a
+    snapshot that carries its writer's ``shard_specs``, a checkpoint
+    written under a different dp/mp factorization is re-laid-out first
+    (_reshard_opt_state) — [dp, shard] moments bit-exact, EF residuals
+    folded or zeroed, every action counted. Either side missing keeps
+    the pre-elastic same-topology contract."""
+    opt_host = state["opt_state"]
+    src = state.get("shard_specs")
+    if src is not None and layout is not None \
+            and _layouts_differ(src, layout):
+        opt_host = _reshard_opt_state(opt_host, src, layout)
     _validate_state_keys("params", state["params"], p_shardings)
-    _validate_state_keys("opt_state", state["opt_state"], s_shardings)
+    _validate_state_keys("opt_state", opt_host, s_shardings)
     params = {k: owned_device_put(jnp.asarray(v), p_shardings[k])
               for k, v in state["params"].items()}
     opt_state = {
@@ -1991,7 +2286,7 @@ def restore_train_state(state, p_shardings, s_shardings, optimizer):
                 else {k: owned_device_put(jnp.asarray(v),
                                           s_shardings[pname][k])
                       for k, v in st.items()})
-        for pname, st in state["opt_state"].items()}
+        for pname, st in opt_host.items()}
     optimizer._step_count = int(state.get("optimizer_step_count", 0))
     lr = optimizer._lr
     if state.get("lr_scheduler") and hasattr(lr, "set_state_dict"):

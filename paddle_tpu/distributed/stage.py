@@ -110,6 +110,26 @@ _EDGE_BYTES = _monitor.counter(
     "bytes handed between stage programs (KV rows, activations, grads) "
     "— wire bytes: a compress=8 edge counts the int8+scales payload")
 
+_ELASTIC_RESUME = None  # lazy elastic_resume_total — same family the
+#                         ElasticSupervisor (distributed/elastic.py)
+#                         counts under; get-or-create by name, so both
+#                         call sites increment ONE family
+
+
+def _note_elastic_resume(reason):
+    global _ELASTIC_RESUME
+    if not _monitor.is_enabled():
+        return
+    if _ELASTIC_RESUME is None:
+        _ELASTIC_RESUME = _monitor.counter(
+            "elastic_resume_total",
+            "elastic recoveries by reason (failpoint | nonfinite | crash "
+            "from the supervisor's resume path, stage_replace from MPMD "
+            "stage rebinding); zero unless FLAGS_elastic machinery "
+            "actually recovered something",
+            labelnames=("reason",))
+    _ELASTIC_RESUME.labels(reason=reason).inc()
+
 
 class EdgeFullError(RuntimeError):
     """A producer ran ahead of its consumer past the edge's capacity —
@@ -297,6 +317,7 @@ class StageProgram:
     def __init__(self, name, fn, mesh=None):
         self.name = name
         self.mesh = mesh
+        self._fn = fn   # retained so rebind() can recompile elsewhere
         self._sharding = (NamedSharding(mesh, P())
                          if mesh is not None else None)
         self._jit = _aot.cached_jit(
@@ -309,12 +330,30 @@ class StageProgram:
         return x
 
     def __call__(self, *args):
+        _fp.failpoint("stage/run")
         if self._sharding is not None:
             args = jax.tree_util.tree_map(self._commit, args)
         return self._jit(*args)
 
     def warm(self, *specs):
         return self._jit.warm(*specs)
+
+    def rebind(self, mesh):
+        """Re-pin THIS program to a replacement mesh (the PR 15
+        remainder, armed by MpmdPipelineRunner.replace_stage): a fresh
+        CachedJit keyed by the new mesh_fingerprint — which hashes
+        shape/kind, not device ids, so a same-shape replacement slice
+        disk-hits a warmed FLAGS_jit_cache_dir instead of recompiling.
+        Sibling programs are untouched (their CachedJit objects keep
+        their compiled entries)."""
+        self.mesh = mesh
+        self._sharding = (NamedSharding(mesh, P())
+                         if mesh is not None else None)
+        self._jit = _aot.cached_jit(
+            self._fn, site="stage", label=self.name,
+            record_event="stage/compile",
+            extra_key=("stage", _aot.mesh_fingerprint(mesh)))
+        return self
 
 
 class StageGraph:
@@ -541,6 +580,38 @@ class MpmdPipelineRunner:
         for e in self.act_edges + self.grad_edges:
             self.graph.add_edge(e)
         self._opt_step = None
+
+    # -- MPMD stage elasticity (FLAGS_elastic; docs/DISTRIBUTED.md) ---------
+    def replace_stage(self, k, mesh):
+        """Re-bind stage ``k``'s program(s) to a replacement mesh WITHOUT
+        recompiling siblings — the MPMD elasticity axis: one stage's
+        slice dies, the other K-1 compiled programs (and their warmed
+        AOT entries) survive untouched. Requires FLAGS_elastic (the
+        structural elastic posture); a same-shape replacement slice
+        disk-hits FLAGS_jit_cache_dir via the mesh fingerprint. Counted
+        in elastic_resume_total{reason="stage_replace"} and noted on the
+        blackbox ring so the recovery is attributable."""
+        if not _flags.get_flag("elastic", False):
+            raise RuntimeError(
+                "MpmdPipelineRunner.replace_stage requires "
+                "FLAGS_elastic=1 — stage elasticity is part of the "
+                "structural elastic posture (docs/DISTRIBUTED.md)")
+        K = self.n_stages
+        if not 0 <= k < K:
+            raise ValueError(f"stage index {k} out of range [0, {K})")
+        if k == 0:
+            names = ["fwd0", "bwd0"]
+        elif k == K - 1:
+            names = [f"last{k}"]
+        else:
+            names = [f"fwd{k}", f"bwd{k}"]
+        for name in names:
+            self.programs[name].rebind(mesh)
+        self.stage_meshes[k] = mesh
+        _note_elastic_resume("stage_replace")
+        _blackbox.note("stage_replace", stage=k, programs=names,
+                       mesh=str(_aot.mesh_fingerprint(mesh)))
+        return self
 
     # -- per-step execution -------------------------------------------------
     def _split_groups(self):

@@ -264,3 +264,25 @@ define_flag("perf_ledger_interval", 1,
             "with FLAGS_perf_ledger: append a ledger row every N "
             "observations per site (the sentinel still sees every "
             "observation; only row volume is throttled)")
+define_flag("elastic", False,
+            "elastic preemption-tolerant training "
+            "(distributed/elastic.py supervisor + the spmd.py "
+            "topology-aware checkpoint reshard, arXiv:2412.14374 "
+            "posture): gather_train_state stamps logical [param, "
+            "shard-spec] metadata into every checkpoint so "
+            "restore_train_state re-lays-out [dp, shard] moments and "
+            "__qar_residual__ EF residuals onto a DIFFERENT dp/mp "
+            "factorization (checkpoint_reshard_total{action}), "
+            "SpmdTrainer.resize(mesh) drains and re-places live state "
+            "onto a replacement mesh through the AOT disk cache, "
+            "StageProgram.rebind/MpmdPipelineRunner.replace_stage swap "
+            "one MPMD stage mesh without recompiling siblings, and "
+            "ElasticSupervisor wires CheckpointSaver corrupt-fallback + "
+            "blackbox crash bundles into retry-with-backoff resume on a "
+            "shrunken mesh (elastic_resume_total{reason}). Read at "
+            "TRAINER CONSTRUCTION — a post-construction toggle under a "
+            "live trainer raises (_elastic_active). STRUCTURAL: the "
+            "boolean joins _exec_key and the AOT extra_key so an "
+            "elastic world never aliases a plain executable. Unset, "
+            "distributed/elastic.py is never imported (manifest-lazy; "
+            "analysis/import_graph.py) and training is byte-identical")

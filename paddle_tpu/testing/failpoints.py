@@ -85,6 +85,16 @@ SITES = {
                        "the adapter registry or the device factors "
                        "mutate; an injected error leaves both exactly as "
                        "they were (in-flight sessions keep decoding)",
+    "stage/run": "distributed.stage.StageProgram.__call__ — before the "
+                 "compiled stage dispatches; an injected error reads as "
+                 "one stage's slice dying mid-schedule, the trigger for "
+                 "MpmdPipelineRunner.replace_stage elasticity "
+                 "(tools/chaos_check.py stage_replace)",
+    "elastic/resume": "distributed.elastic.ElasticSupervisor — before "
+                      "each recovery attempt rebuilds a trainer and "
+                      "restores the latest checkpoint; an error here "
+                      "consumes one retry from the backoff budget "
+                      "(retry-exhaustion tests)",
 }
 
 

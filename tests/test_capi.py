@@ -1,6 +1,7 @@
 """C inference API tests: build the native shim, load a jit-saved model through
 the C ABI via ctypes, and compare against the in-process Python predictor."""
 import ctypes
+import functools
 import os
 import subprocess
 
@@ -24,6 +25,26 @@ def _build():
     subprocess.run(["g++", "-O2", "-fPIC", "-shared", "-std=c++17", *inc,
                     "-o", _SO, _SRC], check=True, capture_output=True)
     return _SO
+
+
+@functools.lru_cache(maxsize=1)
+def _jax_export_works():
+    """Probe the same path static/io.py's _write_export_artifact takes:
+    some jax builds ship a jax.export whose export()/serialize() raises
+    (io.py then warns 'jax.export serialization unavailable' and skips
+    writing the .pdmodel.jaxexport artifact). Tests that require the
+    durable artifact on disk can only run where the environment can
+    actually produce one."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        exported = jax.export.export(jax.jit(lambda x: x * 2))(
+            jax.ShapeDtypeStruct((2,), jnp.float32))
+        exported.serialize()
+        return True
+    except Exception:
+        return False
 
 
 class TestCAPI:
@@ -143,6 +164,13 @@ class TestCAPITraining:
         acc = (out.argmax(-1) == y).mean()
         assert acc >= 0.75, acc   # memorized the batch
 
+    @pytest.mark.skipif(
+        not _jax_export_works(),
+        reason="this jax build's jax.export.export/serialize raises — "
+               "static/io.py falls back to StableHLO text + params "
+               "('jax.export serialization unavailable') and never "
+               "writes the .pdmodel.jaxexport durable artifact this "
+               "test shadows")
     def test_save_over_durable_artifact_serves_trained_params(self,
                                                               tmp_path):
         # jit.save WITH input_spec writes the durable jax.export artifact;

@@ -1,0 +1,203 @@
+"""Tier-1 acceptance gates for elastic preemption-tolerant training
+(ISSUE 19).
+
+Three gates, all tier-1 (deliberately NOT marked ``slow``):
+
+1. **Import pinning** (subprocess): with ``FLAGS_elastic`` unset, a
+   plain trainer run never imports ``paddle_tpu.distributed.elastic``
+   — the supervisor is manifest-lazy, the disarmed loss transcript is
+   byte-identical across two runs of the same binary, and the
+   construction-pinned ``_elastic_active`` check costs < 5µs/call.
+2. **Reshard correctness**: a dp8 checkpoint (FLAGS_shard_weight_update
+   [dp, shard] moments + FLAGS_quantized_allreduce error-feedback
+   residuals) restored onto a dp4 trainer re-lays every sharded moment
+   BIT-exactly to the numpy re-layout of the writer's shards, passes
+   ``__step__`` through exactly, folds the EF residual into rank 0
+   exactly (the one deliberate divergence from a from-scratch dp4
+   gather: the writer's accumulated residual is conserved, not zeroed
+   — rows 1..3 zero), and the restored trainer trains on.
+3. **Chaos passes** (subprocess): ``tools/chaos_check.py --only
+   elastic_resume --only stage_replace`` exits 0 — the kill/resume and
+   stage-death/rebind recovery paths hold end to end.
+"""
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import flags
+
+REPO = Path(__file__).resolve().parent.parent
+
+CFG = dict(vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+           max_seq_len=32, dropout=0.0)
+
+
+def _build(ndp, lr=1e-2):
+    import jax
+
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainLoss)
+
+    paddle.seed(0)
+    model = GPTForCausalLM(GPTConfig(**CFG))
+    opt = paddle.optimizer.AdamW(learning_rate=lr,
+                                 parameters=model.parameters())
+    return SpmdTrainer(model, opt, loss_fn=GPTPretrainLoss(),
+                       mesh=build_mesh((ndp,), ("dp",),
+                                       devices=jax.devices()[:ndp]))
+
+
+def _batches(steps, batch=8, seq=12):
+    rng = np.random.RandomState(0)
+    return [(rng.randint(0, 64, (batch, seq)).astype(np.int32),
+             rng.randint(0, 64, (batch, seq)).astype(np.int32))
+            for _ in range(steps)]
+
+
+_GATE_CODE = r"""
+import sys
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.distributed.spmd import SpmdTrainer
+from paddle_tpu.models import GPTConfig, GPTForCausalLM, GPTPretrainLoss
+import jax
+
+paddle.seed(0)
+model = GPTForCausalLM(GPTConfig(vocab_size=64, hidden_size=32,
+                                 num_layers=1, num_heads=2,
+                                 max_seq_len=32, dropout=0.0))
+opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                             parameters=model.parameters())
+tr = SpmdTrainer(model, opt, loss_fn=GPTPretrainLoss(),
+                 mesh=build_mesh((1,), ("dp",), devices=jax.devices()[:1]))
+rng = np.random.RandomState(0)
+losses = []
+for _ in range(2):
+    x = rng.randint(0, 64, (2, 12)).astype(np.int32)
+    y = rng.randint(0, 64, (2, 12)).astype(np.int32)
+    losses.append(float(np.asarray(tr.train_step(x, y)._data)))
+assert "paddle_tpu.distributed.elastic" not in sys.modules, \
+    "plain trainer imported distributed.elastic"
+print("TOKENS", [f"{l:.17g}" for l in losses])
+print("GATE_OK")
+"""
+
+
+def test_plain_trainer_never_imports_elastic():
+    """The disarmed path is structurally untouched: no elastic import
+    and a byte-identical loss transcript across two runs."""
+    outs = []
+    for _ in range(2):
+        r = subprocess.run([sys.executable, "-c", _GATE_CODE], cwd=REPO,
+                           capture_output=True, text=True, timeout=560)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "GATE_OK" in r.stdout
+        outs.append([l for l in r.stdout.splitlines()
+                     if l.startswith("TOKENS")])
+    assert outs[0] == outs[1]
+
+
+def test_disarmed_elastic_check_under_5us():
+    """The construction-pinned flag check on the hot path is one dict
+    lookup + compare — the same bar monitor.is_enabled() holds."""
+    tr = _build(1)
+    tr.train_step(*_batches(1, batch=2)[0])   # settle compilation
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        tr._elastic_active()
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 5e-6, f"{per_call * 1e6:.2f}µs per disarmed check"
+
+
+def test_dp8_checkpoint_reshards_onto_dp4():
+    """dp8 -> dp4: every [dp, shard] moment re-lays BIT-exactly to the
+    numpy re-layout of the writer's shards, ``__step__`` passes through
+    exactly, and the EF residual folds into rank 0 exactly — the one
+    declared divergence from a from-scratch dp4 gather (which would
+    start the residual at zero; the fold conserves the writer's
+    accumulated error feedback instead). The restored trainer then
+    trains a finite step."""
+    old = {k: flags.get_flag(k)
+           for k in ("elastic", "shard_weight_update",
+                     "quantized_allreduce")}
+    paddle.set_flags({"elastic": True, "shard_weight_update": True,
+                      "quantized_allreduce": True})
+    try:
+        data = _batches(3)
+        tr8 = _build(8)
+        for x, y in data[:2]:
+            tr8.train_step(x, y)
+        state8 = tr8.state_dict()
+        src = state8["shard_specs"]
+        assert src is not None and src["ndp"] == 8
+        assert src["qar_eligible"], "no EF residuals to reshard"
+
+        tr4 = _build(4)
+        tr4.set_state_dict(tr8.state_dict())
+        state4 = tr4.state_dict()
+        dst = state4["shard_specs"]
+        assert dst["ndp"] == 4
+
+        # layout parity with a from-scratch dp4 gather: same keys, same
+        # shard geometry
+        scratch4 = _build(4)
+        sc = scratch4.state_dict()
+        assert set(state4["opt_state"]) == set(sc["opt_state"])
+        assert dst["shard_ps"] == sc["shard_specs"]["shard_ps"]
+
+        opt8, opt4 = state8["opt_state"], state4["opt_state"]
+        assert np.asarray(opt4["__step__"]) \
+            == np.asarray(opt8["__step__"])
+        checked = 0
+        for pname, slots in opt8.items():
+            if pname in ("__step__", "__qar_residual__"):
+                continue
+            meta = src["params"][pname]
+            ps8 = src["shard_ps"][pname]
+            ps4 = dst["shard_ps"][pname]
+            for skey in src["sharded_keys"].get(pname, ()):
+                a8 = np.asarray(slots[skey])
+                assert a8.shape == (8, ps8)
+                logical = a8.reshape(-1)[:meta["size"]]
+                expect = np.pad(logical, (0, ps4 * 4 - meta["size"]))
+                expect = expect.reshape(4, ps4)
+                np.testing.assert_array_equal(
+                    np.asarray(opt4[pname][skey]), expect,
+                    err_msg=f"{pname}/{skey} not bit-exact across "
+                            "the dp8 -> dp4 re-layout")
+                checked += 1
+        assert checked > 0, "no sharded moments exercised"
+
+        res8, res4 = opt8["__qar_residual__"], opt4["__qar_residual__"]
+        for rname in src["qar_eligible"]:
+            r8 = np.asarray(res8[rname])
+            r4 = np.asarray(res4[rname])
+            assert r8.shape[0] == 8 and r4.shape[0] == 4
+            np.testing.assert_array_equal(
+                r4[0], r8.sum(axis=0),
+                err_msg=f"{rname}: residual fold into rank 0 diverged")
+            np.testing.assert_array_equal(
+                r4[1:], np.zeros_like(r4[1:]),
+                err_msg=f"{rname}: non-root residual rows not zeroed")
+
+        loss = float(np.asarray(tr4.train_step(*data[2])._data))
+        assert np.isfinite(loss)
+    finally:
+        paddle.set_flags(old)
+
+
+def test_chaos_elastic_passes_exit_zero():
+    r = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "chaos_check.py"),
+         "--only", "elastic_resume", "--only", "stage_replace"],
+        cwd=REPO, capture_output=True, text=True, timeout=560)
+    assert r.returncode == 0, r.stdout + r.stderr

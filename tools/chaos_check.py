@@ -61,6 +61,22 @@ Checks (one entry per name in `passes`):
                      with zero pool mutation, transient exhaustion
                      requeues to bit-exact completion, drain frees
                      every block
+  elastic_resume     a dp8 run under the ElasticSupervisor is killed
+                     mid-step (trainer/step failpoint) with the dp8
+                     topology marked gone: the supervisor resumes on
+                     dp4 through the topology-aware restore, the loss
+                     trajectory stays within tolerance of an
+                     uninterrupted dp8 twin, and the recovery is
+                     attributed (blackbox crash bundle at
+                     site=elastic/resume + elastic_resume_total
+                     {reason=failpoint})
+  stage_replace      one stage of a FLAGS_mpmd 2-stage pipeline is
+                     killed via the stage/run failpoint; replace_stage
+                     rebinds JUST that stage onto a replacement mesh
+                     (sibling programs' compiled entries asserted
+                     untouched, the rebind disk-hits a warmed
+                     FLAGS_jit_cache_dir) and training continues to
+                     loss parity with an uninterrupted twin
 
 Report format: the tools/graph_lint.py schema ({"tool", "passes",
 "targets": {name: {"name", "counts", "findings"}}, "totals"}), so CI reads
@@ -75,6 +91,11 @@ import sys
 import tempfile
 import time
 
+# the elastic passes build dp8 meshes on the CPU backend (same forcing
+# as tools/parity_check.py — must precede the jax import)
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -84,7 +105,8 @@ PASSES = ["ckpt_atomic", "ckpt_fallback", "serving_deadline",
           "serving_slot_error", "serving_shed", "router_failover",
           "stall_dump", "stage_backpressure", "trainer_nonfinite",
           "numerics_anomaly", "quantized_nonfinite", "async_nonfinite",
-          "adapter_evict_under_load", "page_pool_full"]
+          "adapter_evict_under_load", "page_pool_full",
+          "elastic_resume", "stage_replace"]
 
 
 def _finding(name, severity, message, where=""):
@@ -990,6 +1012,260 @@ def _check_async_nonfinite():
                 "recorded window depth, next step trained clean")]
 
 
+def _check_elastic_resume():
+    """Chaos-injected preemption: kill a dp8 supervised run mid-step and
+    mark the dp8 topology gone — the ElasticSupervisor must resume on
+    dp4 (topology-aware restore: [dp, shard] moments re-laid), keep the
+    loss trajectory within tolerance of an uninterrupted dp8 twin, and
+    leave the recovery attributable (blackbox crash bundle at
+    site=elastic/resume, elastic_resume_total{reason=failpoint})."""
+    import glob
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import flags, monitor
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.monitor import blackbox as bb
+    from paddle_tpu.testing import failpoints as fp
+
+    name = "elastic_resume"
+    old = {k: flags.get_flag(k)
+           for k in ("elastic", "shard_weight_update", "blackbox_dir")}
+    tmp_ctx = tempfile.TemporaryDirectory(prefix="paddle_tpu_chaos_elastic_")
+    was_enabled = bb.is_enabled()
+    bb.enable(install=False)
+    paddle.set_flags({"elastic": True, "shard_weight_update": True,
+                      "blackbox_dir": os.path.join(tmp_ctx.name, "bb")})
+    try:
+        class MLP(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.l1 = paddle.nn.Linear(64, 64)
+                self.l2 = paddle.nn.Linear(64, 1)
+
+            def forward(self, x):
+                return self.l2(paddle.nn.functional.relu(self.l1(x)))
+
+        def build(mesh):
+            paddle.seed(0)
+            m = MLP()
+            opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                         parameters=m.parameters())
+            return SpmdTrainer(
+                m, opt, loss_fn=lambda p, y: ((p - y) ** 2).mean(),
+                mesh=mesh)
+
+        rng = np.random.RandomState(0)
+        data = [(rng.randn(8, 64).astype(np.float32),
+                 rng.randn(8, 1).astype(np.float32)) for _ in range(6)]
+
+        # the uninterrupted dp8 twin
+        twin = build(build_mesh((8,), ("dp",), devices=jax.devices()[:8]))
+        twin_losses = [float(np.asarray(twin.train_step(x, y)._data))
+                       for x, y in data]
+
+        from paddle_tpu.distributed.elastic import ElasticSupervisor
+        from paddle_tpu.incubate.checkpoint.auto_checkpoint import \
+            CheckpointSaver
+
+        alive = {"dp8": True}
+
+        def dp8():
+            return build_mesh((8,), ("dp",), devices=jax.devices()[:8]) \
+                if alive["dp8"] else None
+
+        def dp4():
+            return build_mesh((4,), ("dp",), devices=jax.devices()[:4])
+
+        class KillAt(list):
+            def __init__(self, items, at):
+                super().__init__(items)
+                self.at, self.fired = at, False
+
+            def __getitem__(self, i):
+                if i == self.at and not self.fired:
+                    self.fired = True
+                    alive["dp8"] = False
+                    fp.arm("trainer/step", "error:1")
+                return super().__getitem__(i)
+
+        sup = ElasticSupervisor(
+            build, CheckpointSaver(os.path.join(tmp_ctx.name, "ckpt")),
+            [dp8, dp4], checkpoint_interval=1)
+        losses = sup.run(KillAt(data, 3))
+
+        if not sup.recoveries:
+            return [_finding(name, "error",
+                             "the killed step produced no recovery")]
+        rec = sup.recoveries[0]
+        if rec["reason"] != "failpoint":
+            return [_finding(name, "error",
+                             f"recovery reason {rec['reason']!r}, "
+                             "expected 'failpoint' (the injected kill)")]
+        if int(sup.trainer.mesh.shape["dp"]) != 4:
+            return [_finding(name, "error",
+                             "supervisor did not resume on the shrunken "
+                             "dp4 mesh")]
+        drift = max(abs(a - b) for a, b in zip(losses, twin_losses))
+        if not np.allclose(losses, twin_losses, rtol=1e-4, atol=5e-4):
+            return [_finding(
+                name, "error",
+                f"resumed dp4 loss trajectory diverged from the "
+                f"uninterrupted dp8 twin (max |diff|={drift:.3e}, "
+                "band rtol=1e-4 atol=5e-4)")]
+        # attribution: the crash bundle names the recovery site
+        bundles = sorted(glob.glob(os.path.join(
+            tmp_ctx.name, "bb", "blackbox-*.json")))
+        if not bundles:
+            return [_finding(name, "error",
+                             "recovery wrote no blackbox crash bundle")]
+        bundle = bb.load_bundle(bundles[0])
+        if bundle.get("site") != "elastic/resume" \
+                or bundle.get("reason") != "crash":
+            return [_finding(
+                name, "error",
+                f"bundle names reason={bundle.get('reason')!r} "
+                f"site={bundle.get('site')!r}, expected a crash bundle "
+                "at elastic/resume")]
+        # ...and the lazy counter carries the reason
+        snap = monitor.snapshot()
+        moved = [s for m in snap["metrics"]
+                 if m["name"] == "elastic_resume_total"
+                 for s in m["series"]
+                 if s["labels"].get("reason") == "failpoint"
+                 and s["value"] > 0]
+        if not moved:
+            return [_finding(name, "error",
+                             "elastic_resume_total{reason=failpoint} "
+                             "did not move")]
+    finally:
+        fp.reset()
+        paddle.set_flags(old)
+        bb.quiesce()
+        bb.reset()
+        if not was_enabled:
+            bb.disable()
+        tmp_ctx.cleanup()
+    return [_ok(name,
+                f"dp8 kill at step {rec['step'] - 1} resumed on dp4 "
+                f"(reason={rec['reason']}, max loss drift "
+                f"{drift:.1e}); bundle at site=elastic/resume + "
+                "elastic_resume_total attribute the recovery")]
+
+
+def _check_stage_replace():
+    """Chaos-injected stage death: kill one stage of a FLAGS_mpmd
+    2-stage pipeline via stage/run, rebind JUST that stage onto a
+    replacement mesh (replace_stage), and keep training — siblings'
+    compiled programs must be untouched (object identity) and the
+    rebind must disk-hit the warmed AOT cache; losses stay at parity
+    with an uninterrupted twin."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import flags, monitor
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.pipeline import PipelineTrainer
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.testing import failpoints as fp
+
+    name = "stage_replace"
+    old = {k: flags.get_flag(k)
+           for k in ("mpmd", "elastic", "jit_cache_dir")}
+    tmp_ctx = tempfile.TemporaryDirectory(prefix="paddle_tpu_chaos_stage_")
+    paddle.set_flags({"mpmd": True, "elastic": True,
+                      "jit_cache_dir": os.path.join(tmp_ctx.name, "aot")})
+    try:
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=16, dropout=0.0)
+        rng = np.random.RandomState(0)
+        batches = [[rng.randint(0, 64, (2, 16)).astype(np.int32)
+                    for _ in range(2)] for _ in range(4)]
+
+        def build():
+            paddle.seed(0)
+            model = GPTForCausalLM(cfg)
+            pre, stages, post = model.pipeline_split(2)
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=model.parameters())
+            mesh = build_mesh((2,), ("pp",), devices=jax.devices()[:2])
+            return PipelineTrainer(pre, stages, post, opt, mesh=mesh,
+                                   n_micro=2, schedule_mode="1F1B")
+
+        twin = build()
+        twin_losses = [float(np.asarray(twin.train_step(*b)._data))
+                       for b in batches]
+
+        tr = build()
+        losses = [float(np.asarray(tr.train_step(*b)._data))
+                  for b in batches[:2]]
+        runner = tr._mpmd_runner
+        sibling_jits = {n: p._jit for n, p in runner.programs.items()
+                        if n not in ("fwd0", "bwd0")}
+        fp.arm("stage/run", "error:1")
+        try:
+            tr.train_step(*batches[2])
+            return [_finding(name, "error",
+                             "armed stage/run failpoint did not fire")]
+        except fp.FailpointError:
+            pass
+        # stage 0's slice died: rebind fwd0/bwd0 onto a replacement
+        # device (same shape/kind -> same mesh fingerprint -> disk hit)
+        replacement = build_mesh((1,), ("stage",),
+                                 devices=[jax.devices()[2]])
+        runner.replace_stage(0, replacement)
+        losses += [float(np.asarray(tr.train_step(*b)._data))
+                   for b in batches[2:]]
+
+        drift = max(abs(a - b) for a, b in zip(losses, twin_losses))
+        if not np.allclose(losses, twin_losses, rtol=1e-5, atol=1e-5):
+            return [_finding(
+                name, "error",
+                f"post-replace loss trajectory diverged from the "
+                f"uninterrupted twin (max |diff|={drift:.3e})")]
+        recompiled = [n for n, j in sibling_jits.items()
+                      if runner.programs[n]._jit is not j]
+        if recompiled:
+            return [_finding(name, "error",
+                             "replace_stage touched sibling stage "
+                             f"programs: {recompiled}")]
+        if runner.stage_meshes[0] is not replacement:
+            return [_finding(name, "error",
+                             "replace_stage did not record the "
+                             "replacement mesh")]
+        snap = monitor.snapshot()
+        disk_hits = sum(
+            s["value"] for m in snap["metrics"]
+            if m["name"] == "compile_cache_total" for s in m["series"]
+            if s["labels"].get("site") == "stage"
+            and s["labels"].get("source") == "disk")
+        if not disk_hits:
+            return [_finding(name, "error",
+                             "rebound stage did not disk-hit the warmed "
+                             "AOT cache (compile_cache_total"
+                             "{site=stage,source=disk} empty)")]
+        moved = [s for m in snap["metrics"]
+                 if m["name"] == "elastic_resume_total"
+                 for s in m["series"]
+                 if s["labels"].get("reason") == "stage_replace"
+                 and s["value"] > 0]
+        if not moved:
+            return [_finding(name, "error",
+                             "elastic_resume_total{reason=stage_replace} "
+                             "did not move")]
+    finally:
+        fp.reset()
+        paddle.set_flags(old)
+        tmp_ctx.cleanup()
+    return [_ok(name,
+                f"killed stage 0 rebound onto a replacement mesh "
+                f"(siblings untouched, {int(disk_hits)} stage disk "
+                f"hit(s)); loss parity with the twin (max drift "
+                f"{drift:.1e})")]
+
+
 def build_report(only=None):
     """Run the fault schedule; `only` restricts to a subset of PASSES
     (the model is only built when a serving check is selected)."""
@@ -1006,6 +1282,8 @@ def build_report(only=None):
         ("numerics_anomaly", _check_numerics_anomaly),
         ("quantized_nonfinite", _check_quantized_nonfinite),
         ("async_nonfinite", _check_async_nonfinite),
+        ("elastic_resume", _check_elastic_resume),
+        ("stage_replace", _check_stage_replace),
     ]
     if selected & {"serving_deadline", "serving_slot_error",
                    "serving_shed", "router_failover", "stall_dump",

@@ -92,6 +92,12 @@ _REQUIRED = {
     "paged": ("kv_page_blocks_total", "kv_page_cow_total",
               "serving_adapter_total", "serving_requests_submitted_total",
               "serving_ttft_ms"),
+    # elastic training (docs/DISTRIBUTED.md "Elastic training"): a
+    # supervised dp2 run killed mid-step resumes on dp1 — the recovery
+    # counter, the topology-aware restore's reshard actions, and the
+    # recovery-cost ledger row at site elastic/resume
+    "elastic": ("elastic_resume_total", "checkpoint_reshard_total",
+                "perf_ledger_rows_total", "step_latency_ms"),
 }
 
 #: (family, label, value) series that must exist in a target's snapshot,
@@ -112,6 +118,9 @@ _REQUIRED_SERIES = {
               ("serving_adapter_total", "event", "load"),
               ("serving_adapter_total", "event", "hit"),
               ("serving_adapter_total", "event", "evict")),
+    "elastic": (("elastic_resume_total", "reason", "failpoint"),
+                ("checkpoint_reshard_total", "action", "moment_reshard"),
+                ("perf_ledger_rows_total", "site", "elastic/resume")),
 }
 
 _DIMS = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
@@ -491,6 +500,119 @@ def run_ledger_loop(steps=6, delay_ms=400):
             pass
 
 
+def run_elastic_loop(steps=5, kill_at=2):
+    """The elastic-training target (docs/DISTRIBUTED.md "Elastic
+    training"): an ElasticSupervisor drives a tiny dp2 MLP trainer with
+    FLAGS_elastic + FLAGS_shard_weight_update armed, checkpointing every
+    step; a ``trainer/step=error:1`` failpoint kills step ``kill_at``
+    and marks the dp2 topology gone, so the supervisor resumes — on dp1,
+    through the topology-aware restore — moving
+    elastic_resume_total{reason=failpoint}, the reshard actions in
+    checkpoint_reshard_total{action=...}, and (FLAGS_perf_ledger armed
+    too) the recovery-cost row at site ``elastic/resume``."""
+    import os as _os
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import flags
+    from paddle_tpu.distributed.elastic import ElasticSupervisor
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.incubate.checkpoint.auto_checkpoint import \
+        CheckpointSaver
+    from paddle_tpu.monitor import perfledger
+    from paddle_tpu.testing import failpoints
+
+    old = {k: flags.get_flag(k)
+           for k in ("elastic", "shard_weight_update", "perf_ledger",
+                     "perf_ledger_path", "perf_ledger_warmup",
+                     "perf_ledger_interval")}
+    fd, path = tempfile.mkstemp(suffix=".jsonl",
+                                prefix="paddle_tpu_elastic_")
+    _os.close(fd)
+    ckpt_dir = tempfile.mkdtemp(prefix="paddle_tpu_elastic_ckpt_")
+    paddle.set_flags({"elastic": True, "shard_weight_update": True,
+                      "perf_ledger": True, "perf_ledger_path": path,
+                      "perf_ledger_warmup": 1, "perf_ledger_interval": 1})
+    perfledger.reset_ledger()
+    try:
+        class MLP(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.l1 = paddle.nn.Linear(64, 64)
+                self.l2 = paddle.nn.Linear(64, 1)
+
+            def forward(self, x):
+                return self.l2(paddle.nn.functional.relu(self.l1(x)))
+
+        def build(mesh):
+            paddle.seed(0)
+            m = MLP()
+            opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                         parameters=m.parameters())
+            return SpmdTrainer(
+                m, opt, loss_fn=lambda p, y: ((p - y) ** 2).mean(),
+                mesh=mesh)
+
+        alive = {"dp2": True}
+
+        def dp2():
+            return build_mesh((2,), ("dp",),
+                              devices=jax.devices()[:2]) \
+                if alive["dp2"] else None
+
+        def dp1():
+            return build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+
+        rng = np.random.RandomState(0)
+        data = [(rng.randn(8, 64).astype(np.float32),
+                 rng.randn(8, 1).astype(np.float32))
+                for _ in range(steps)]
+
+        class KillAt(list):
+            """Arms the kill from inside the batch lookup, so the
+            failpoint fires on exactly the requested step."""
+
+            def __init__(self, items, at):
+                super().__init__(items)
+                self.at, self.fired = at, False
+
+            def __getitem__(self, i):
+                if i == self.at and not self.fired:
+                    self.fired = True
+                    alive["dp2"] = False
+                    failpoints.arm("trainer/step", "error:1")
+                return super().__getitem__(i)
+
+        sup = ElasticSupervisor(build, CheckpointSaver(ckpt_dir),
+                                [dp2, dp1], checkpoint_interval=1)
+        losses = sup.run(KillAt(data, kill_at))
+        if not sup.recoveries:
+            raise RuntimeError("the killed step produced no recovery")
+        if int(sup.trainer.mesh.shape["dp"]) != 1:
+            raise RuntimeError("supervisor did not resume on the "
+                               "shrunken dp1 mesh")
+        rows = perfledger.load_rows(path)
+        if not any(r.get("site") == "elastic/resume" for r in rows):
+            raise RuntimeError("recovery appended no elastic/resume "
+                               "perf-ledger row")
+        return {"losses": losses,
+                "recoveries": list(sup.recoveries),
+                "ledger_sites": sorted({r.get("site") for r in rows})}
+    finally:
+        failpoints.reset()
+        paddle.set_flags(old)
+        perfledger.reset_ledger()
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        try:
+            _os.unlink(path)
+        except OSError:
+            pass
+
+
 def run_paged_loop(new_tokens=4):
     """The paged-KV target: an armed (FLAGS_paged_kv) 2-adapter engine —
     a registered shared prefix whose length straddles a block boundary
@@ -629,7 +751,7 @@ def run_target(name, with_trace=False):
     trace_summary = None
     kind = (name if name in ("serving", "router", "blackbox", "federated",
                              "numerics", "quantized", "async", "mpmd",
-                             "ledger", "paged")
+                             "ledger", "paged", "elastic")
             else "train")
     if with_trace:
         trace.clear()
@@ -655,6 +777,8 @@ def run_target(name, with_trace=False):
             run_ledger_loop()
         elif kind == "paged":
             run_paged_loop()
+        elif kind == "elastic":
+            run_elastic_loop()
         else:
             run_train_step(name)
     finally:
@@ -767,11 +891,19 @@ def main(argv=None):
                          "kv_page_blocks_total{state=hot|cold}, "
                          "kv_page_cow_total and serving_adapter_total"
                          "{event=load|hit|evict} are present")
+    ap.add_argument("--elastic", action="store_true", dest="elastic",
+                    help="run the elastic-training target (supervised "
+                         "dp2 MLP killed mid-step via failpoint, resumed "
+                         "on dp1 through the topology-aware restore); "
+                         "exit 1 unless elastic_resume_total"
+                         "{reason=failpoint}, checkpoint_reshard_total"
+                         "{action=moment_reshard} and the elastic/resume "
+                         "perf-ledger row are present")
     ap.add_argument("--all", action="store_true",
                     help="all models + the serving loop + the router, "
                          "flight-recorder, federated, numerics, "
-                         "quantized, async, mpmd, perf-ledger and "
-                         "paged-KV tiers")
+                         "quantized, async, mpmd, perf-ledger, paged-KV "
+                         "and elastic tiers")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the graph_lint-schema machine report")
     ap.add_argument("--prometheus", action="store_true",
@@ -802,15 +934,18 @@ def main(argv=None):
         targets.append("ledger")
     if args.paged:
         targets.append("paged")
+    if args.elastic:
+        targets.append("elastic")
     if args.all:
         targets = list(MODEL_TARGETS) + ["serving", "router", "blackbox",
                                          "federated", "numerics",
                                          "quantized", "async", "mpmd",
-                                         "ledger", "paged"]
+                                         "ledger", "paged", "elastic"]
     if not targets:
         ap.error("pick a target: --model NAME, --serving, --router, "
                  "--blackbox, --federated, --numerics, --quantized, "
-                 "--async, --mpmd, --ledger, --paged or --all")
+                 "--async, --mpmd, --ledger, --paged, --elastic or "
+                 "--all")
 
     report = build_report(targets, with_trace=args.with_trace)
     if args.as_json:

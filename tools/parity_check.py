@@ -9,6 +9,7 @@ stat) when a pair leaves its declared tolerance band.
     python tools/parity_check.py --ab shard_weight_update  # ZeRO-ish: EXACT
     python tools/parity_check.py --ab multi_lora           # pooled vs dedicated
     python tools/parity_check.py --ab paged_kv             # armed vs dense
+    python tools/parity_check.py --ab reshard              # dp8 ckpt -> dp4/dp2xmp2
     python tools/parity_check.py --all
     python tools/parity_check.py --perturb-lr 5 --json     # negative control
     python tools/parity_check.py --ab quantized_allreduce --perturb-lr 6
@@ -375,6 +376,138 @@ def run_paged_kv(steps=4):
 SERVING_TARGETS = {"multi_lora": run_multi_lora, "paged_kv": run_paged_kv}
 
 
+def _reshard_counts():
+    """{action: value} of checkpoint_reshard_total right now (0-dict when
+    the family hasn't been created yet)."""
+    from paddle_tpu import monitor
+
+    out = {}
+    for m in monitor.snapshot()["metrics"]:
+        if m["name"] != "checkpoint_reshard_total":
+            continue
+        for s in m["series"]:
+            out[s["labels"]["action"]] = s["value"]
+    return out
+
+
+def run_reshard(steps=4, perturb_lr=None):
+    """Topology-aware checkpoint reshard A/B (the FLAGS_elastic
+    tentpole, docs/DISTRIBUTED.md "Elastic training"): a dp8 trainer
+    with FLAGS_shard_weight_update ([dp, shard] moments) checkpoints at
+    the midpoint, and the state_dict — carrying its ``shard_specs``
+    topology leaf — restores onto a FRESH dp4 trainer AND a FRESH
+    dp2x2 (dp x mp factorization of the same 4 devices) trainer. Each
+    continuation must track the uninterrupted dp8 twin within the
+    declared band (loss_rtol=1e-3, loss_atol=1e-4: re-layout changes
+    psum order, the only float freedom — the moments themselves re-lay
+    bit-exactly, pinned by tests/test_elastic_gate.py). The restore is
+    also required to ATTRIBUTE itself: checkpoint_reshard_total
+    {action=moment_reshard} must move, proving the topology-aware path
+    engaged rather than a lucky same-layout load.
+
+    ``perturb_lr`` scales the CONTINUATION trainers' lr — the
+    ``--perturb-lr`` companion negative control, which must leave the
+    band (exit 1), proving the band is a gate and not a rubber stamp."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import flags
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainLoss)
+
+    name = "reshard" if perturb_lr is None else "reshard+perturb_lr"
+    LOSS_RTOL, LOSS_ATOL = 1e-3, 1e-4
+    if steps < 2:
+        raise ValueError("the reshard A/B needs >= 2 steps (train, "
+                         "checkpoint at the midpoint, continue)")
+    split = steps // 2
+
+    def _build(shape, axes, ndev, lr=1e-2):
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_heads=2, max_seq_len=32, dropout=0.0)
+        model = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=lr,
+                                     parameters=model.parameters())
+        return SpmdTrainer(model, opt, loss_fn=GPTPretrainLoss(),
+                           mesh=build_mesh(shape, axes,
+                                           devices=jax.devices()[:ndev]))
+
+    old = {k: flags.get_flag(k)
+           for k in ("elastic", "shard_weight_update")}
+    paddle.set_flags({"elastic": True, "shard_weight_update": True})
+    try:
+        data = _batches(steps, batch=8)   # 8 divides dp8 / dp4 / dp2
+
+        def _loss(tr, x, y):
+            return float(np.asarray(tr.train_step(x, y)._data))
+
+        twin = _build((8,), ("dp",), 8)
+        twin_losses = [_loss(twin, x, y) for x, y in data]
+
+        primary = _build((8,), ("dp",), 8)
+        head = [_loss(primary, x, y) for x, y in data[:split]]
+
+        lr = 1e-2 * (perturb_lr if perturb_lr is not None else 1.0)
+        findings, worst = [], 0.0
+        for label, shape, axes, ndev in (
+                ("dp4", (4,), ("dp",), 4),
+                ("dp2xmp2", (2, 2), ("dp", "mp"), 4)):
+            before = _reshard_counts()
+            cont = _build(shape, axes, ndev, lr=lr)
+            # a fresh gather per continuation: restore re-lays the
+            # [dp, shard] moments in place of the writer's layout
+            cont.set_state_dict(primary.state_dict())
+            relaid = _reshard_counts().get("moment_reshard", 0) \
+                - before.get("moment_reshard", 0)
+            if relaid <= 0:
+                findings.append(_finding(
+                    name, "error",
+                    f"{label}: restore onto a different factorization "
+                    "never re-laid a moment (checkpoint_reshard_total"
+                    "{action=moment_reshard} did not move)",
+                    where=label))
+                continue
+            losses = head + [_loss(cont, x, y) for x, y in data[split:]]
+            for i, (a, b) in enumerate(zip(losses, twin_losses)):
+                diff = abs(a - b)
+                worst = max(worst, diff)
+                if diff > LOSS_ATOL + LOSS_RTOL * abs(b):
+                    findings.append(_finding(
+                        name, "error",
+                        f"{label}: continuation left the declared band "
+                        f"at step {i}: twin={b:.6g} resumed={a:.6g} "
+                        f"(|diff|={diff:.3g}, loss_rtol={LOSS_RTOL} "
+                        f"loss_atol={LOSS_ATOL})",
+                        where=f"{label}/step{i}"))
+                    break
+        if not findings:
+            findings.append(_finding(
+                name, "info",
+                f"dp8 checkpoint at step {split} continued on dp4 and "
+                f"dp2xmp2 within the declared band (max |loss diff| "
+                f"{worst:.3g}; moments re-laid, attributed via "
+                "checkpoint_reshard_total)"))
+        report = {"steps": steps, "split": split,
+                  "tolerances": {"loss_rtol": LOSS_RTOL,
+                                 "loss_atol": LOSS_ATOL},
+                  "max_abs_loss_diff": worst,
+                  "reshard_actions": _reshard_counts(),
+                  "diverged": any(f["severity"] == "error"
+                                  for f in findings)}
+        return report, findings
+    finally:
+        paddle.set_flags(old)
+
+
+#: self-running trainer-side targets that manage their own twin AND
+#: their own --perturb-lr companion (the factor reaches them as a
+#: kwarg instead of riding the lockstep harness)
+CUSTOM_TARGETS = {"reshard": run_reshard}
+
+
 def run_target(name, steps=4, perturb_lr=None):
     """Run one A/B; returns (report, findings). `perturb_lr` builds a
     negative-control variant instead (candidate lr scaled — MUST
@@ -384,6 +517,8 @@ def run_target(name, steps=4, perturb_lr=None):
     companion run for the banded quantized_allreduce gate)."""
     from paddle_tpu.testing import parity
 
+    if name in CUSTOM_TARGETS:
+        return CUSTOM_TARGETS[name](steps=steps, perturb_lr=perturb_lr)
     if perturb_lr is None and name in SERVING_TARGETS:
         return SERVING_TARGETS[name](steps=steps)
     if perturb_lr is not None:
@@ -472,7 +607,8 @@ def build_report(targets, steps=4, perturb_lr=None):
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--ab", action="append",
-                    choices=sorted(AB_TARGETS) + sorted(SERVING_TARGETS),
+                    choices=(sorted(AB_TARGETS) + sorted(SERVING_TARGETS)
+                             + sorted(CUSTOM_TARGETS)),
                     default=[], help="run one named A/B target "
                     "(repeatable)")
     ap.add_argument("--all", action="store_true",
@@ -489,8 +625,8 @@ def main(argv=None):
                     help="emit the graph_lint-schema machine report")
     args = ap.parse_args(argv)
 
-    targets = (sorted(AB_TARGETS) + sorted(SERVING_TARGETS)) if args.all \
-        else list(args.ab)
+    targets = (sorted(AB_TARGETS) + sorted(SERVING_TARGETS)
+               + sorted(CUSTOM_TARGETS)) if args.all else list(args.ab)
     if not targets and args.perturb_lr is None:
         ap.error("pick a target: --ab NAME, --all, or --perturb-lr F")
 
