@@ -136,6 +136,41 @@ def _banked(leg):
     return _BANKED.get(leg)
 
 
+def _goodput_leg(leg):
+    """Open a goodput run for the NEXT measurement leg (FLAGS_goodput,
+    docs/OBSERVABILITY.md "Goodput ledger"): ``start_run`` finalizes the
+    previous leg's run — its bucket breakdown lands as one perf-ledger
+    row at site=run/goodput — so each bench leg's wall time is accounted
+    separately. Disarmed this is one flag lookup; a failure never costs
+    the measurement."""
+    try:
+        from paddle_tpu import flags as _gp_flags
+
+        if not _gp_flags.get_flag("goodput", False):
+            return
+        from paddle_tpu.monitor import goodput as _goodput
+
+        _goodput.start_run("bench/" + leg)
+    except Exception as e:
+        print(f"  goodput run for leg {leg!r} failed ({e})",
+              file=sys.stderr)
+
+
+def _goodput_close():
+    """Finalize the LAST leg's goodput run (atexit: main has many exit
+    paths and the final row must land on all of them)."""
+    try:
+        from paddle_tpu import flags as _gp_flags
+
+        if not _gp_flags.get_flag("goodput", False):
+            return
+        from paddle_tpu.monitor import goodput as _goodput
+
+        _goodput.end_run()
+    except Exception as e:
+        print(f"  goodput finalize failed ({e})", file=sys.stderr)
+
+
 # cumulative compile-cache counts at the previous heartbeat, so each
 # bench_phase line also carries the DELTA attributable to its phase
 _LAST_CACHE_COUNTS = {}
@@ -928,6 +963,14 @@ def main():
     except Exception as e:
         print(f"  blackbox recorder unavailable ({e})", file=sys.stderr)
 
+    # goodput accountant (FLAGS_goodput): every leg below opens its own
+    # run via _goodput_leg; the atexit hook finalizes the last one on
+    # every exit path (watchdog kill excepted — the blackbox bundle's
+    # goodput provider still carries that run's breakdown)
+    import atexit
+
+    atexit.register(_goodput_close)
+
     # arm BEFORE backend init: a wedged tunnel hangs inside jax.devices()
     # itself, which is precisely the case the watchdog must catch
     watchdog = _arm_watchdog(900)
@@ -971,6 +1014,7 @@ def main():
                 _emit(dict(micro_banked, banked=True))
             else:
                 _heartbeat("micro_canary")
+                _goodput_leg("micro")
                 sps, _ = run_micro(quiet=True)
                 _heartbeat("micro_canary", "done")
                 # vs_baseline 0.0: a toy config has no baseline target and
@@ -1005,6 +1049,7 @@ def main():
             _emit(dict(cached, banked=True))
             return
         _heartbeat("config:" + args.config)
+        _goodput_leg(leg)
         extra = None
         line_fields = {}  # extra TOP-LEVEL fields for the final line (mbu)
         if args.config == "resnet50":
@@ -1213,6 +1258,7 @@ def main():
             watchdog = _arm_watchdog(1500)
         probes = {}
         _heartbeat("batch_probe")
+        _goodput_leg("batch_probe")
         # 32 exceeded 16G HBM in r1 PRE-flash; the flash retune freed the
         # attention HBM, so it may fit now — OOM fails fast and is caught
         for b in (16, 24, 32):
@@ -1228,6 +1274,7 @@ def main():
 
     if args.sweep:
         _heartbeat("sweep")
+        _goodput_leg("sweep")
         best = (0.0, 0.0, None)
         for b, s in ((8, 1024), (16, 1024), (24, 1024), (16, 2048),
                      (8, 2048), (4, 4096), (8, 4096)):
@@ -1275,6 +1322,7 @@ def main():
         _emit(line)
     else:
         _heartbeat("headline_gpt2s", batch=batch, seq=seq)
+        _goodput_leg(headline_leg)
         tps, mfu = run_config(batch, seq, args.steps, quiet=True,
                               window=args.window)
         _heartbeat("headline_gpt2s", "done")
@@ -1318,6 +1366,7 @@ def main():
                     watchdog = _arm_watchdog(1200)
                 try:
                     _heartbeat(extra_leg)
+                    _goodput_leg(extra_leg)
                     got = measure()
                     _bank(extra_leg, got)
                 except Exception as e:

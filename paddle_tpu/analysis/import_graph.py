@@ -56,6 +56,7 @@ LAZY_MODULES = (
     "paddle_tpu.analysis.calibrate",         # measured-constant fits (ISSUE 17)
     "paddle_tpu.serving.paging",             # paged KV block pool (ISSUE 18)
     "paddle_tpu.distributed.elastic",        # auto-resume supervisor (ISSUE 19)
+    "paddle_tpu.monitor.goodput",            # goodput wall-clock accountant (ISSUE 20)
 )
 
 #: what a plain trainer/engine process imports (the roots of the closure

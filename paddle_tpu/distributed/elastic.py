@@ -29,6 +29,7 @@ This module is manifest-lazy (analysis/import_graph.py LAZY_MODULES):
 with ``FLAGS_elastic`` unset nothing imports it and a plain trainer is
 byte-identical to the pre-elastic build (tests/test_elastic_gate.py).
 """
+import contextlib
 import time
 
 import numpy as np
@@ -116,6 +117,15 @@ class ElasticSupervisor:
         self.backoff_s = float(backoff_s)
         self.trainer = None
         self.recoveries = []   # [{reason, step, mesh, downtime_ms}]
+        # goodput accountant (FLAGS_goodput, ISSUE 20): consumed at
+        # construction like the trainer's copy — the recovery leg books
+        # `resume_backoff` (with the nested checkpoint load / reshard
+        # booking their own buckets); disarmed, one `is not None`
+        self._goodput = None
+        if _flags.get_flag("goodput", False):
+            from ..monitor import goodput as _goodput
+
+            self._goodput = _goodput
 
     def _next_mesh(self):
         for factory in self.mesh_factories:
@@ -173,11 +183,20 @@ class ElasticSupervisor:
                                    extra={"reason": reason, "step": step,
                                           "retries": retries})
                 _fp.failpoint("elastic/resume")
-                if self.backoff_s:
-                    time.sleep(self.backoff_s * retries)
-                mesh = self._next_mesh()
-                self.trainer = self.build_trainer(mesh)
-                step = self._resume(self.trainer)
+                if self._goodput is not None:
+                    self._goodput.count("resume")
+                # the whole recovery leg is `resume_backoff`; the
+                # checkpoint load and any cross-topology re-layout inside
+                # _resume nest their own ckpt_restore/reshard buckets,
+                # pausing this one (exclusive attribution)
+                with (self._goodput.bucket("resume_backoff")
+                      if self._goodput is not None
+                      else contextlib.nullcontext()):
+                    if self.backoff_s:
+                        time.sleep(self.backoff_s * retries)
+                    mesh = self._next_mesh()
+                    self.trainer = self.build_trainer(mesh)
+                    step = self._resume(self.trainer)
                 downtime_ms = (time.perf_counter() - t_fail) * 1e3
                 _note_resume(reason)
                 rec = {"reason": reason, "step": step,

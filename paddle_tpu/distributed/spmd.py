@@ -14,6 +14,7 @@ TPU-native design: ONE jitted train step over a Mesh.
  - recompute: jax.checkpoint on the forward.
  - gradient merge / accumulation: lax.scan over micro-batches.
 """
+import contextlib
 import functools
 import time
 
@@ -30,6 +31,7 @@ from .. import trace as _trace
 from ..core.tape import global_tape
 from ..core.tensor import Tensor
 from ..framework import aot as _aot
+from ..framework import lineage as _lineage
 from ..profiler import RecordEvent as _RecordEvent
 from ..testing import failpoints as _failpoints
 from .mesh import get_mesh
@@ -51,7 +53,8 @@ CHECKPOINT_SCHEMA = {
            "optimizer moments ride opt_state; shard_specs records the "
            "logical [param, shard-spec] layout that wrote them so a "
            "restore onto a different dp/mp factorization re-lays-out "
-           "(ISSUE 19 topology-aware resharding)",
+           "(ISSUE 19 topology-aware resharding); __weight_version__ "
+           "stamps the writer's weight lineage (ISSUE 20)",
     "payload": {
         "params": {"kind": "opaque",
                    "layout": "{param_name: host array}"},
@@ -68,6 +71,13 @@ CHECKPOINT_SCHEMA = {
                                   "size}}, shard_ps, sharded_keys, "
                                   "qar_eligible} or None (pre-elastic "
                                   "checkpoint)"},
+        "__weight_version__": {"kind": "opaque",
+                               "layout": "{run_id, counter, origin} "
+                                         "weight-version lineage stamp "
+                                         "(framework/lineage.py) or "
+                                         "absent — a pre-version "
+                                         "checkpoint restores as "
+                                         "version 0 (ISSUE 20)"},
     },
 }
 
@@ -313,6 +323,24 @@ class SpmdTrainer:
 
             self._perf_ledger = _perfledger.get_ledger()
             self._perf_mesh_fp = _aot.mesh_fingerprint(self.mesh)
+        # weight-version lineage (framework/lineage.py, ISSUE 20):
+        # ALWAYS-ON host metadata — every weight state this trainer
+        # produces carries a monotone (run_id, counter, origin) stamp,
+        # bumped per step/restore/reshard, written into checkpoints as
+        # the __weight_version__ leaf and onto train_step spans. No
+        # metric series, no compiled-program effect: parity is trivial.
+        self.weight_version = _lineage.WeightVersion(
+            _lineage.new_run_id(), 0, "init")
+        # goodput accountant (FLAGS_goodput, docs/OBSERVABILITY.md):
+        # consumed at construction. Deliberately NON-structural like the
+        # perf ledger — wall-clock bucketing only, joins NO executable
+        # key; disarmed, every hook is one `is not None`
+        self._goodput = None
+        if _flags.get_flag("goodput", False):
+            from ..monitor import goodput as _goodput
+
+            self._goodput = _goodput
+            _goodput.ensure_run(self.weight_version.run_id)
         self.params = {n: p._data for n, p in layer.named_parameters() if getattr(p, "trainable", True)}
         self.frozen = {n: p._data for n, p in layer.named_parameters() if not getattr(p, "trainable", True)}
         self.buffers = {n: b._data for n, b in layer.named_buffers()}
@@ -1579,7 +1607,9 @@ class SpmdTrainer:
         sig = _batch_sig_label(batch_arrays)
         guarded = self._guard_active()
         narmed = self._numerics_active()
-        with _RecordEvent("trainer/compile"), \
+        with (self._goodput.bucket("compile") if self._goodput is not None
+              else contextlib.nullcontext()), \
+                _RecordEvent("trainer/compile"), \
                 _monitor.timed(_COMPILE_MS.labels(site="trainer")):
             jitted = self._build(batch_arrays)
             compiled, source = _aot.compile_cached(
@@ -1639,6 +1669,13 @@ class SpmdTrainer:
         # compile or device dispatch leaves an active, non-advancing
         # trainer/step site for the stall sentinel; a finished training
         # run deactivates it instead of reading as stalled forever
+        if self._goodput is not None:
+            # goodput `step` bucket around the whole step — a compile
+            # resolving inside nests its own bucket and PAUSES this one,
+            # so productive time never double-books (FLAGS_goodput)
+            with self._goodput.bucket("step"), \
+                    _blackbox.progress("trainer/step"):
+                return self._train_step_impl(*batch)
         with _blackbox.progress("trainer/step"):
             return self._train_step_impl(*batch)
 
@@ -1696,9 +1733,11 @@ class SpmdTrainer:
         t_exec = time.perf_counter()
         # step span: compile-cache source + batch signature (+sync time,
         # stamped by _finish_step); carries the step's trace identity
+        # and the weight version this step advances FROM (ISSUE 20)
         self._step_span = _trace.start_span(
             "train_step", subsystem="trainer", sig=sig_label, source=source,
-            step=int(self.optimizer._step_count), guarded=guarded)
+            step=int(self.optimizer._step_count), guarded=guarded,
+            weight_version=str(self.weight_version))
         try:
             if self.localsgd_k or self._is_dgc():
                 loss, self.params, self.opt_state, self.buffers = compiled(
@@ -1760,6 +1799,10 @@ class SpmdTrainer:
         # the handle's schedule identity, captured BEFORE the benchmark
         # drain below may rewind the counter for this very step's skip
         sched = int(self.optimizer._step_count) - 1
+        # the params this step produced are a NEW weight state (a
+        # device-side skip still re-ran the program; the lineage tracks
+        # states served/trained, not loss-improving updates)
+        self.weight_version = self.weight_version.bump("step")
         sync_ms = 0.0
         if _flags.get_flag("benchmark"):
             t_sync = time.perf_counter()
@@ -2007,7 +2050,8 @@ class SpmdTrainer:
         training")."""
         state = gather_train_state(self.params, self.opt_state,
                                    self.optimizer,
-                                   layout=self._checkpoint_layout())
+                                   layout=self._checkpoint_layout(),
+                                   weight_version=self.weight_version)
         state["buffers"] = {k: _host_gather(v)
                             for k, v in self.buffers.items()}
         return state
@@ -2019,15 +2063,38 @@ class SpmdTrainer:
         from this trainer's layout) is re-laid-out on load —
         topology-aware resharding, counted in
         checkpoint_reshard_total{action}. Key mismatches (stale
-        checkpoint vs a changed model) fail fast with names."""
-        self.params, self.opt_state = restore_train_state(
-            state, self.p_shardings, self.s_shardings, self.optimizer,
-            layout=self._checkpoint_layout())
-        _validate_state_keys("buffers", state.get("buffers", {}),
-                             self.b_shardings)
-        self.buffers = {k: owned_device_put(jnp.asarray(v),
-                                            self.b_shardings[k])
-                        for k, v in state.get("buffers", {}).items()}
+        checkpoint vs a changed model) fail fast with names.
+
+        Weight lineage (ISSUE 20): the restored state's
+        ``__weight_version__`` leaf (absent — a pre-version checkpoint —
+        reads as counter 0) re-joins this trainer's lineage at
+        ``max(live, loaded) + 1`` so the counter stays monotone across
+        restore AND replay, with origin ``restore`` (``reshard`` when
+        the layouts differed and the moments were re-laid-out)."""
+        src = state.get("shard_specs")
+        layout = self._checkpoint_layout()
+        resharded = src is not None and _layouts_differ(src, layout)
+        gp_bucket = "reshard" if resharded else "ckpt_restore"
+        with (self._goodput.bucket(gp_bucket)
+              if self._goodput is not None
+              else contextlib.nullcontext()):
+            self.params, self.opt_state = restore_train_state(
+                state, self.p_shardings, self.s_shardings, self.optimizer,
+                layout=layout)
+            _validate_state_keys("buffers", state.get("buffers", {}),
+                                 self.b_shardings)
+            self.buffers = {k: owned_device_put(jnp.asarray(v),
+                                                self.b_shardings[k])
+                            for k, v in state.get("buffers", {}).items()}
+        loaded = _lineage.WeightVersion.from_dict(
+            state.get("__weight_version__"),
+            run_id=self.weight_version.run_id)
+        self.weight_version = _lineage.WeightVersion(
+            self.weight_version.run_id,
+            max(self.weight_version.counter, loaded.counter) + 1,
+            "reshard" if resharded else "restore")
+        if resharded and self._goodput is not None:
+            self._goodput.count("reshard")
 
     # -- elastic resize (FLAGS_elastic; docs/DISTRIBUTED.md) -------------------
     def resize(self, mesh):
@@ -2046,6 +2113,15 @@ class SpmdTrainer:
         summed pending correction into rank 0 of the new factorization
         (counted residual_fold — total correction preserved, per-rank
         distribution is not)."""
+        if self._goodput is None:
+            return self._resize_impl(mesh)
+        # goodput `reshard` bucket + event count around the whole
+        # drain/snapshot/re-place leg (FLAGS_goodput; ISSUE 20)
+        with self._goodput.bucket("reshard"):
+            self._goodput.count("reshard")
+            return self._resize_impl(mesh)
+
+    def _resize_impl(self, mesh):
         self._elastic_active()
         if not self._elastic:
             raise RuntimeError(
@@ -2117,6 +2193,8 @@ class SpmdTrainer:
                 res[name] = owned_device_put(buf, sh)
                 _note_reshard("residual_fold")
             self.opt_state["__qar_residual__"] = res
+        # the re-placed params are a new weight state in this lineage
+        self.weight_version = self.weight_version.bump("reshard")
         _blackbox.note("trainer_resize", old_mesh=str(old_fp),
                        new_mesh=str(_aot.mesh_fingerprint(mesh)),
                        ndp=int(mesh.shape[self.dp_axis]))
@@ -2153,7 +2231,8 @@ def _validate_state_keys(what, got, expected):
             "changed model?)")
 
 
-def gather_train_state(params, opt_state, optimizer, layout=None):
+def gather_train_state(params, opt_state, optimizer, layout=None,
+                       weight_version=None):
     """Host-side {params, opt_state, step, lr_scheduler} snapshot.
 
     `layout` (SpmdTrainer._checkpoint_layout()) stamps the writer's
@@ -2161,9 +2240,12 @@ def gather_train_state(params, opt_state, optimizer, layout=None):
     ``shard_specs`` leaf (CHECKPOINT_SCHEMA) so restore_train_state can
     re-lay-out onto a different dp/mp factorization; None (the
     PipelineTrainer / pre-elastic path) writes a same-topology-only
-    checkpoint, exactly as before."""
+    checkpoint, exactly as before. `weight_version`
+    (framework/lineage.py) stamps the writer's lineage into the
+    ``__weight_version__`` leaf; None omits it (the checkpoint loads as
+    version 0 — the pre-version contract)."""
     lr = optimizer._lr
-    return {
+    out = {
         "params": {k: _host_gather(v) for k, v in params.items()},
         "opt_state": {
             pname: (_host_gather(st) if pname == "__step__"
@@ -2174,6 +2256,9 @@ def gather_train_state(params, opt_state, optimizer, layout=None):
                          if hasattr(lr, "state_dict") else None),
         "shard_specs": layout,
     }
+    if weight_version is not None:
+        out["__weight_version__"] = weight_version.to_dict()
+    return out
 
 
 def _layouts_differ(src, dst):

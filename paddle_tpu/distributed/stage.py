@@ -44,6 +44,7 @@ This module is manifest-lazy (analysis/import_graph.py): with
 byte-identical to the pre-PR build (tests/test_stage_gate.py).
 """
 import collections
+import contextlib
 import time
 
 import numpy as np
@@ -129,6 +130,19 @@ def _note_elastic_resume(reason):
             "actually recovered something",
             labelnames=("reason",))
     _ELASTIC_RESUME.labels(reason=reason).inc()
+
+
+def _goodput_bucket(name):
+    """Goodput wall-time attribution for edge transfers (FLAGS_goodput,
+    ISSUE 20): null context unless the accountant is armed — one flag
+    read per put, and the disarmed path never imports monitor/goodput.py
+    (manifest-lazy). Edge validate/quantize/enqueue time books as
+    ``edge_wait``, pausing the enclosing tick's ``step`` bucket."""
+    if not _flags.get_flag("goodput", False):
+        return contextlib.nullcontext()
+    from ..monitor import goodput as _goodput
+
+    return _goodput.bucket(name)
 
 
 class EdgeFullError(RuntimeError):
@@ -236,7 +250,8 @@ class StageEdge:
                 f"stage edge {self.name!r} is full ({self.capacity} "
                 "payload(s) in flight) — backpressure: drain the "
                 "consumer before producing more")
-        with _blackbox.progress("stage/edge"):
+        with _goodput_bucket("edge_wait"), \
+                _blackbox.progress("stage/edge"):
             _fp.failpoint("stage/edge")
             bind_dims = dict(self._dims, **(dims or {}))
             bind_dtypes = dict(self._dtypes, **(dtypes or {}))
@@ -369,6 +384,11 @@ class StageGraph:
         self.name = name
         self.stages = {}
         self.edges = {}
+        #: weight lineage the ticks execute under (framework/lineage.py,
+        #: ISSUE 20): set by whoever drives the graph (MpmdPipelineRunner
+        #: refreshes it from its trainer each step); surfaced on every
+        #: ``stage_step`` span when set
+        self.weight_version = None
         # perf ledger (FLAGS_perf_ledger, docs/OBSERVABILITY.md):
         # consumed at construction; disarmed, run() pays one `is None`
         self._perf_ledger = None
@@ -376,6 +396,14 @@ class StageGraph:
             from ..monitor import perfledger as _perfledger
 
             self._perf_ledger = _perfledger.get_ledger()
+        # goodput accountant (FLAGS_goodput, ISSUE 20): same
+        # construction-consumed pattern — each tick books `step`, edge
+        # transfers inside it nest `edge_wait`
+        self._goodput = None
+        if _flags.get_flag("goodput", False):
+            from ..monitor import goodput as _goodput
+
+            self._goodput = _goodput
 
     def add_stage(self, program):
         self.stages[program.name] = program
@@ -396,11 +424,16 @@ class StageGraph:
         out = []
         try:
             for sname, thunk in plan:
+                attrs = {} if self.weight_version is None else \
+                    {"weight_version": str(self.weight_version)}
                 sp = _trace.start_span(
                     "stage_step", subsystem="stage", parent=root,
-                    stage=sname) if traced else None
+                    stage=sname, **attrs) if traced else None
                 try:
-                    with _blackbox.progress(f"stage/{sname}"):
+                    with (self._goodput.bucket("step")
+                          if self._goodput is not None
+                          else contextlib.nullcontext()), \
+                            _blackbox.progress(f"stage/{sname}"):
                         out.append(thunk())
                 finally:
                     if sp is not None:
@@ -721,6 +754,10 @@ class MpmdPipelineRunner:
                     plan.append((_name(k, "fwd"), fwd_tick(k, m)))
                 for k in range(K - 2, -1, -1):
                     plan.append((_name(k, "bwd"), bwd_tick(k, m)))
+        # weight lineage (ISSUE 20): the ticks about to run execute under
+        # the trainer's CURRENT version — refresh per step, not at
+        # construction, so post-restore/reshard bumps show on spans
+        self.graph.weight_version = getattr(tr, "weight_version", None)
         self.graph.run(plan)
 
         def _acc(trees):

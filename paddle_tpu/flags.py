@@ -286,3 +286,28 @@ define_flag("elastic", False,
             "elastic world never aliases a plain executable. Unset, "
             "distributed/elastic.py is never imported (manifest-lazy; "
             "analysis/import_graph.py) and training is byte-identical")
+define_flag("goodput", False,
+            "goodput ledger + weight-version lineage metrics "
+            "(monitor/goodput.py, docs/OBSERVABILITY.md): a per-run "
+            "wall-clock accountant classifies every second into "
+            "exclusive buckets {step, compile, ckpt_save, ckpt_restore, "
+            "reshard, resume_backoff, stall, edge_wait, other} via hooks "
+            "in the trainer/AOT path, checkpoint save/restore, the "
+            "elastic supervisor, and the MPMD stage runtime — published "
+            "as goodput_seconds_total{bucket} + goodput_fraction, one "
+            "site=run/goodput perf-ledger row per run (FLAGS_perf_ledger "
+            "also armed; goodput itself is sentinel-watched LOW_IS_BAD), "
+            "and a blackbox dump provider naming the active bucket at "
+            "crash time. Also gates the serving lineage families "
+            "(serving_weight_version / serving_stale_sessions_total). "
+            "DELIBERATELY NON-STRUCTURAL: host-side accounting only — "
+            "it joins NO executable key (armed and disarmed runs share "
+            "AOT entries and train byte-identically — "
+            "tests/test_goodput_gate.py pins it). Unset, "
+            "monitor/goodput.py is never imported and every hook is one "
+            "cached boolean. Defined here (not in the accountant module) "
+            "so hook sites can gate on it without importing it")
+define_flag("goodput_stall_s", 2.0,
+            "with FLAGS_goodput: an unattributed gap (no bucket active) "
+            "at least this many seconds books as `stall`; shorter gaps "
+            "book as `other` (loop/bookkeeping overhead)")

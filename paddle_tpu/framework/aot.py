@@ -413,6 +413,23 @@ class _GuardedCompiled:
             return self._jit(*args)
 
 
+def _goodput_compile():
+    """`compile` wall-time attribution (FLAGS_goodput, ISSUE 20): a null
+    context unless the goodput accountant is armed. Booked at THE
+    compile chokepoint, so trainer AOT misses, serving warmups, and
+    elastic resize warm-restarts all attribute — nested inside the
+    trainer's `step` bucket, the compile time pauses it (exclusive
+    buckets). One flag read per compile; the disarmed path never imports
+    monitor/goodput.py (manifest-lazy)."""
+    import contextlib
+
+    if not _flags.get_flag("goodput", False):
+        return contextlib.nullcontext()
+    from ..monitor import goodput as _goodput
+
+    return _goodput.bucket("compile")
+
+
 def compile_cached(jitted, example_args, *, site, extra_key=(),
                    force=False):
     """Obtain an executable for ``jitted`` at ``example_args`` (real
@@ -440,11 +457,11 @@ def compile_cached(jitted, example_args, *, site, extra_key=(),
         # the progress window brackets every eager XLA compile: a hung
         # compile leaves an ACTIVE, non-advancing aot/compile beacon for
         # the stall sentinel to name (monitor/blackbox.py)
-        with _blackbox.progress("aot/compile"):
+        with _goodput_compile(), _blackbox.progress("aot/compile"):
             compiled = jitted.lower(
                 *_canonical_specs(example_args)).compile()
         return _GuardedCompiled(compiled, jitted), "fresh"
-    with _blackbox.progress("aot/compile"):
+    with _goodput_compile(), _blackbox.progress("aot/compile"):
         lowered = jitted.lower(*_canonical_specs(example_args))
         key = _cache_key(lowered, extra_key)
         compiled = _load_entry(_entry_path(key), site)

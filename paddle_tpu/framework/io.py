@@ -11,6 +11,7 @@ that ``load`` verifies (bit rot / torn writes raise
 :class:`CheckpointCorruptError` instead of unpickling garbage). Footerless
 files written by older versions still load (unverified).
 """
+import contextlib
 import hashlib
 import os
 import pickle
@@ -18,6 +19,7 @@ import time
 
 import numpy as np
 
+from .. import flags as _flags
 from .. import monitor as _monitor
 from ..trace import costs as _costs  # noqa: F401  (imports the module)
 from .. import trace as _trace
@@ -43,6 +45,20 @@ _CKPT_MS = _monitor.histogram("checkpoint_ms", "save/load wall time",
 _CKPT_BYTES = _monitor.counter("checkpoint_bytes_total",
                                "bytes written/read by paddle.save/load",
                                labelnames=("op",))
+
+
+def _goodput_bucket(name):
+    """ckpt_save/ckpt_restore wall-time attribution (FLAGS_goodput,
+    ISSUE 20): a null context unless the goodput accountant is armed —
+    one flag read per save/load, and the disarmed path never imports
+    monitor/goodput.py (manifest-lazy). Booked HERE, at the one
+    chokepoint every checkpoint byte passes, so CheckpointSaver,
+    state_dict round-trips, and direct paddle.save/load all attribute."""
+    if not _flags.get_flag("goodput", False):
+        return contextlib.nullcontext()
+    from ..monitor import goodput as _goodput
+
+    return _goodput.bucket(name)
 
 
 def _record_ckpt(op, path, t0, span=None):
@@ -150,7 +166,7 @@ def save(obj, path, protocol=4, **configs):
     t0 = time.perf_counter()
     sp = _trace.start_span("checkpoint/save", subsystem="io")
     tmp = f"{path}.tmp.{os.getpid()}"
-    with _RecordEvent("checkpoint/save"):
+    with _goodput_bucket("ckpt_save"), _RecordEvent("checkpoint/save"):
         try:
             h = hashlib.sha256()
             with open(tmp, "wb") as f:
@@ -236,7 +252,8 @@ def load(path, **configs):
     t0 = time.perf_counter()
     sp = _trace.start_span("checkpoint/load", subsystem="io")
     try:
-        with _RecordEvent("checkpoint/load"), open(path, "rb") as f:
+        with _goodput_bucket("ckpt_restore"), \
+                _RecordEvent("checkpoint/load"), open(path, "rb") as f:
             _fp.failpoint("ckpt/read")
             payload_len, verified = _verify_footer(f, path)
             if f.read(4) == _MAGIC:

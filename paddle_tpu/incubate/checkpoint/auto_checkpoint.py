@@ -9,6 +9,7 @@ TPU-native design: orbax-style local/remote dir checkpoints of
 (model state_dict, optimizer state, epoch/step counters) with atomic rename commits;
 the SPMD trainer's sharded params are gathered on save, resharded on load.
 """
+import contextlib
 import json
 import os
 import shutil
@@ -17,6 +18,7 @@ import warnings
 
 import numpy as np
 
+from ... import flags as _flags
 from ... import monitor as _monitor
 from ...framework.io import CheckpointCorruptError, _fsync_dir
 from ...framework.io import load as pload
@@ -35,6 +37,20 @@ _CORRUPT_ERRORS = (CheckpointCorruptError, json.JSONDecodeError, EOFError,
 # tmp dirs a save_checkpoint in THIS process is writing right now — a
 # sibling CheckpointSaver constructed on another thread must not sweep them
 _ACTIVE_TMPS = set()
+
+def _goodput_bucket(name):
+    """ckpt_save/ckpt_restore attribution for the SAVER's own overhead
+    (FLAGS_goodput, ISSUE 20) — tmp-dir setup, meta.json, commit rename,
+    rotation, and the corrupt-fallback walk-back. The inner psave/pload
+    legs nest the SAME bucket via framework/io.py (harmless: one pauses
+    while the other books, totals stay exclusive). Null context when the
+    accountant is disarmed; the import stays manifest-lazy."""
+    if not _flags.get_flag("goodput", False):
+        return contextlib.nullcontext()
+    from ...monitor import goodput as _goodput
+
+    return _goodput.bucket(name)
+
 
 _RECOVER = _monitor.counter(
     "checkpoint_recover_total",
@@ -125,28 +141,31 @@ class CheckpointSaver:
         return sorted(nums)
 
     def save_checkpoint(self, state, meta=None):
-        nums = self.get_checkpoint_numbers()
-        no = (nums[-1] + 1) if nums else 0
-        tmp = self._ckpt_dir(no) + ".tmp"
-        _ACTIVE_TMPS.add(os.path.abspath(tmp))
-        try:
-            os.makedirs(tmp, exist_ok=True)
-            with open(os.path.join(tmp, "owner.pid"), "w") as f:
-                f.write(str(os.getpid()))   # sweep_tmp skips live owners
-            psave(state, os.path.join(tmp, "state.pdparams"))
-            with open(os.path.join(tmp, "meta.json"), "w") as f:
-                json.dump({"no": no, "time": time.time(), **(meta or {})}, f)
-            _fp.failpoint("ckpt/commit")
-            os.remove(os.path.join(tmp, "owner.pid"))
-            os.rename(tmp, self._ckpt_dir(no))  # atomic commit
-        finally:
-            _ACTIVE_TMPS.discard(os.path.abspath(tmp))
-        # make the commit durable BEFORE rotating older checkpoints away:
-        # a crash here must find either the new dir or the old ones on disk
-        _fsync_dir(self.directory)
-        for old in self.get_checkpoint_numbers()[: -self.max_num]:
-            shutil.rmtree(self._ckpt_dir(old), ignore_errors=True)
-        return no
+        with _goodput_bucket("ckpt_save"):
+            nums = self.get_checkpoint_numbers()
+            no = (nums[-1] + 1) if nums else 0
+            tmp = self._ckpt_dir(no) + ".tmp"
+            _ACTIVE_TMPS.add(os.path.abspath(tmp))
+            try:
+                os.makedirs(tmp, exist_ok=True)
+                with open(os.path.join(tmp, "owner.pid"), "w") as f:
+                    f.write(str(os.getpid()))  # sweep_tmp skips live owners
+                psave(state, os.path.join(tmp, "state.pdparams"))
+                with open(os.path.join(tmp, "meta.json"), "w") as f:
+                    json.dump({"no": no, "time": time.time(),
+                               **(meta or {})}, f)
+                _fp.failpoint("ckpt/commit")
+                os.remove(os.path.join(tmp, "owner.pid"))
+                os.rename(tmp, self._ckpt_dir(no))  # atomic commit
+            finally:
+                _ACTIVE_TMPS.discard(os.path.abspath(tmp))
+            # make the commit durable BEFORE rotating older checkpoints
+            # away: a crash here must find either the new dir or the old
+            # ones on disk
+            _fsync_dir(self.directory)
+            for old in self.get_checkpoint_numbers()[: -self.max_num]:
+                shutil.rmtree(self._ckpt_dir(old), ignore_errors=True)
+            return no
 
     def _load_one(self, no):
         d = self._ckpt_dir(no)
@@ -161,24 +180,25 @@ class CheckpointSaver:
         checkpoint — truncated state file, missing meta, failed sha256
         footer — is EVICTED and the walk continues to the previous one,
         counting checkpoint_recover_total{reason=corrupt}."""
-        nums = self.get_checkpoint_numbers()
-        if not nums:
+        with _goodput_bucket("ckpt_restore"):
+            nums = self.get_checkpoint_numbers()
+            if not nums:
+                return None, None
+            if no is not None:
+                return self._load_one(no)
+            for cand in reversed(nums):
+                try:
+                    return self._load_one(cand)
+                except _CORRUPT_ERRORS as e:
+                    d = self._ckpt_dir(cand)
+                    warnings.warn(
+                        f"checkpoint {d} is unreadable ({type(e).__name__}: "
+                        f"{e}); evicting it and falling back to the "
+                        "previous checkpoint")
+                    shutil.rmtree(d, ignore_errors=True)
+                    if _monitor.is_enabled():
+                        _RECOVER.labels(reason="corrupt").inc()
             return None, None
-        if no is not None:
-            return self._load_one(no)
-        for cand in reversed(nums):
-            try:
-                return self._load_one(cand)
-            except _CORRUPT_ERRORS as e:
-                d = self._ckpt_dir(cand)
-                warnings.warn(
-                    f"checkpoint {d} is unreadable ({type(e).__name__}: "
-                    f"{e}); evicting it and falling back to the previous "
-                    "checkpoint")
-                shutil.rmtree(d, ignore_errors=True)
-                if _monitor.is_enabled():
-                    _RECOVER.labels(reason="corrupt").inc()
-        return None, None
 
 
 class TrainEpochRange:

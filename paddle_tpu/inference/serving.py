@@ -81,6 +81,7 @@ from ..trace import costs as _costs
 from .. import trace as _trace
 from ..core.tensor import Tensor
 from ..framework import aot as _aot
+from ..framework import lineage as _lineage
 from ..serving import decode_model as _dm_registry
 from ..testing import failpoints as _fp
 
@@ -205,6 +206,13 @@ class Request:
         self.last_token_time = None
         self.finish_time = None
         self._inter_token = _MsSummary()
+        # weight lineage (framework/lineage.py, ISSUE 20): the engine
+        # stamps at submission which weight (and adapter) version this
+        # session decodes under — a hot_swap mid-stream leaves the
+        # session on its pre-swap stamp, which _finish_req counts as a
+        # stale finish (serving_stale_sessions_total, FLAGS_goodput)
+        self.weight_version = None
+        self.adapter_version = None
 
     @property
     def tokens(self):
@@ -231,6 +239,10 @@ class Request:
                "prompt_tokens": int(len(self.prompt_ids)),
                "prefix_tokens": self.prefix_len,
                "new_tokens": len(self.output_ids)}
+        if self.weight_version is not None:
+            out["weight_version"] = str(self.weight_version)
+        if self.adapter_version is not None:
+            out["adapter_version"] = str(self.adapter_version)
         if self.submit_time is not None and self.admit_time is not None:
             out["queue_wait_ms"] = (self.admit_time - self.submit_time) * 1e3
         if self.submit_time is not None \
@@ -829,6 +841,23 @@ class ServingEngine:
             from ..monitor import perfledger as _perfledger
 
             self._perf_ledger = _perfledger.get_ledger()
+        # weight-version lineage (framework/lineage.py, ISSUE 20):
+        # always-on host metadata — the engine mints a version for the
+        # params it was built with, bumps it on hot_swap(), and stamps
+        # every accepted request with the version it will decode under.
+        # Adapter slots carry their own load-time stamps. METRIC
+        # publication (serving_weight_version gauge, stale-session
+        # counter) rides the goodput accountant, consumed here like the
+        # perf ledger: disarmed costs one `is not None` per finish.
+        self._weight_version = _lineage.WeightVersion(
+            _lineage.new_run_id(), 0, "init")
+        self._adapter_versions = {}   # adapter name -> WeightVersion
+        self._goodput = None
+        if _flags.get_flag("goodput", False):
+            from ..monitor import goodput as _goodput
+
+            self._goodput = _goodput
+            _goodput.note_serving_version(self._weight_version.counter)
 
         # blackbox dump bundles carry every live engine's in-flight
         # request table (weakly held; only read at dump time)
@@ -1070,7 +1099,14 @@ class ServingEngine:
             "inter_token_ms": m["inter_token_ms"].to_dict(),
             "breakdown": self._breakdown(),
             "health": self.health(),
+            # lineage (ISSUE 20): what the engine serves RIGHT NOW;
+            # per-request stamps live in each request's stats()
+            "weight_version": str(self._weight_version),
         }
+        if self._adapter_versions:
+            out["adapter_versions"] = {
+                n: str(v)
+                for n, v in sorted(self._adapter_versions.items())}
         if self._paged:
             pg = self._pool.stats()
             live = sum(1 for r in self._slot_req if r is not None)
@@ -1270,7 +1306,14 @@ class ServingEngine:
         slot, evicted = self._adapters.admit(name, pin=pin)
         if evicted is not None:
             self._restart_adapter_sessions(evicted)
+            self._adapter_versions.pop(evicted, None)
         self._write_adapter_slot(slot, packed)
+        # lineage stamp (ISSUE 20): which base-weight version this
+        # adapter's factors were loaded under, origin adapter_load —
+        # completions submitted with adapter=name carry it
+        self._adapter_versions[name] = _lineage.WeightVersion(
+            self._weight_version.run_id, self._weight_version.counter,
+            "adapter_load")
         return slot
 
     def evict_adapter(self, name):
@@ -1284,6 +1327,7 @@ class ServingEngine:
         slot = self._adapters.evict(name)
         self._write_adapter_slot(slot, None)
         self._restart_adapter_sessions(name)
+        self._adapter_versions.pop(name, None)
         return slot
 
     def _write_adapter_slot(self, slot, packed):
@@ -1326,6 +1370,67 @@ class ServingEngine:
             req.last_token_time = None
             req._inter_token = _MsSummary()
             self._queue.insert(0, req)
+
+    def hot_swap(self, model, decode_model=None):
+        """Replace the served weights IN PLACE with `model`'s — same
+        architecture, same shapes/dtypes — without recompiling or
+        dropping sessions, and bump the engine's weight version (origin
+        ``hot_swap``). The params are step ARGUMENTS, not closure
+        captures, so identically-shaped replacements reuse every warmed
+        executable.
+
+        Sessions already in flight keep decoding — each finishes under
+        the replacement weights but CARRIES its submission-time version
+        stamp, so its completion is attributable to the lineage it
+        started on (and counts ``serving_stale_sessions_total`` under
+        FLAGS_goodput). Requests submitted after the swap carry the
+        bumped version. Returns the new :class:`WeightVersion`.
+
+        Rejects tensor-parallel engines (the Megatron re-split would
+        re-place device state mid-flight) and any replacement whose
+        extracted param tree differs in keys, shapes, or dtypes — a
+        mismatched swap must fail loudly BEFORE touching served state."""
+        import jax.numpy as jnp
+
+        if self._tp_mesh is not None:
+            raise ValueError(
+                "hot_swap does not compose with tp_mesh= serving — "
+                "restart the engine to replace tensor-parallel weights")
+        dm = _dm_registry.resolve(model, decode_model)
+        if type(dm) is not type(self._dm):
+            raise ValueError(
+                f"hot_swap: replacement model resolves to decode adapter "
+                f"{type(dm).__name__}, engine serves "
+                f"{type(self._dm).__name__}")
+        dm.check_config(model.cfg)
+        params, _ = dm.extract_params(model, "the replacement model")
+        if self._compute_dtype is not None:
+            params = {k: (v.astype(self._compute_dtype)
+                          if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                      for k, v in params.items()}
+        if set(params) != set(self._params):
+            missing = sorted(set(self._params) - set(params))
+            extra = sorted(set(params) - set(self._params))
+            raise ValueError(
+                f"hot_swap: param tree mismatch (missing {missing[:3]}, "
+                f"unexpected {extra[:3]}) — the replacement must be the "
+                "same architecture")
+        for k in sorted(params):
+            new, cur = params[k], self._params[k]
+            if tuple(new.shape) != tuple(cur.shape) \
+                    or new.dtype != cur.dtype:
+                raise ValueError(
+                    f"hot_swap: param {k!r} is {new.shape}/{new.dtype}, "
+                    f"engine serves {cur.shape}/{cur.dtype} — shapes and "
+                    "dtypes must match exactly (no recompiles)")
+        self._params = params
+        self._weight_version = self._weight_version.bump("hot_swap")
+        if self._goodput is not None:
+            self._goodput.note_serving_version(
+                self._weight_version.counter)
+        _blackbox.note("hot_swap",
+                       version=str(self._weight_version))
+        return self._weight_version
 
     def _validate_decode_args(self, ids, max_new_tokens, temperature,
                               deadline_ms, top_k, top_p, seed):
@@ -1370,6 +1475,12 @@ class ServingEngine:
                       prefix_len=prefix_len, deadline_ms=deadline_ms,
                       priority=priority, adapter=adapter)
         req.submit_time = time.perf_counter()
+        # lineage stamp (ISSUE 20): the version of the weights (and of
+        # the selected adapter) this session will decode under — read at
+        # finish to detect sessions that outlived a hot_swap
+        req.weight_version = self._weight_version
+        if adapter is not None:
+            req.adapter_version = self._adapter_versions.get(adapter)
         if _trace.is_enabled():
             # end-to-end trace: every request gets a trace_id here; all
             # later spans (queue-wait, prefill chunks, per-step decode,
@@ -1598,6 +1709,13 @@ class ServingEngine:
             self._deadline_live -= 1
         self._m["finished"][reason] = self._m["finished"].get(reason, 0) + 1
         _REQ_FINISHED.labels(reason=reason).inc()
+        if (self._goodput is not None and req.weight_version is not None
+                and req.weight_version.counter
+                < self._weight_version.counter):
+            # the session finished under weights older than what the
+            # engine now serves (a hot_swap landed mid-stream) — exactly
+            # once per stale finish (FLAGS_goodput, ISSUE 20)
+            self._goodput.note_stale_session()
         self._finished[req.rid] = req
         if slot is not None:
             self._slot_req[slot] = None

@@ -59,13 +59,14 @@ __all__ = [
 
 
 def __getattr__(name):   # PEP 562
-    # the numerics telescope, the flight recorder, AND the perf ledger
-    # load lazily: a plain (flags-unset) process must never import any —
-    # tests/test_numerics_gate.py, tests/test_perfledger_gate.py, and
+    # the numerics telescope, the flight recorder, the perf ledger, AND
+    # the goodput accountant load lazily: a plain (flags-unset) process
+    # must never import any — tests/test_numerics_gate.py,
+    # tests/test_perfledger_gate.py, tests/test_goodput_gate.py, and
     # the ISSUE 12 import-graph contract (analysis/import_graph.py
     # LAZY_MODULES) pin it. Deliberately NOT in __all__: a star-import
     # resolves every listed name, which would defeat the laziness
-    if name in ("numerics", "blackbox", "perfledger"):
+    if name in ("numerics", "blackbox", "perfledger", "goodput"):
         import importlib
 
         return importlib.import_module("." + name, __name__)
@@ -343,3 +344,10 @@ if _flags.get_flag("perf_ledger", False):
     from . import perfledger  # noqa: E402,F401  # lint: allow(lazy-import)
 
     perfledger.get_ledger()
+
+# same opt-in for the goodput accountant (FLAGS_goodput=1 python ...):
+# import the module eagerly so hook sites' construction-consumed handles
+# resolve without re-paying the import inside a step loop. No run is
+# opened here — trainers/supervisors/tools ensure_run() when they start.
+if _flags.get_flag("goodput", False):
+    from . import goodput  # noqa: E402,F401  # lint: allow(lazy-import)

@@ -41,8 +41,22 @@ def to_json(snap, indent=None):
     return json.dumps(snap, indent=indent, sort_keys=True)
 
 
-def to_prometheus(snap):
-    """Prometheus text exposition of a registry snapshot."""
+#: histogram summary() percentile -> prometheus quantile label value
+_QUANTILES = (("p50", "0.5"), ("p90", "0.9"), ("p99", "0.99"))
+
+
+def to_prometheus(snap, summaries=None):
+    """Prometheus text exposition of a registry snapshot.
+
+    ``summaries`` (optional) is the ``{'name{k=v,...}': {'p50': ...,
+    'p90': ..., 'p99': ...}}`` digest tools/metrics_dump.py computes from
+    the registry histograms' ``summary()`` — given, each histogram series
+    additionally emits standard ``name{...,quantile="0.5"} v`` samples,
+    so the percentile digest survives the text form and
+    :func:`parse_prometheus` round-trips it losslessly instead of the
+    digest lines being dropped (or, worse, crashing the parser as the
+    old human-format ``name{...}: {json}`` lines did). Default (None)
+    output is byte-identical to the historical form."""
     lines = []
     for m in snap["metrics"]:
         name = m["name"].replace("-", "_").replace(".", "_")
@@ -61,20 +75,46 @@ def to_prometheus(snap):
                 base = _label_str(s["labels"])
                 lines.append(f"{name}_sum{base} {_num(s['sum'])}")
                 lines.append(f"{name}_count{base} {s['count']}")
+                if summaries:
+                    lb0 = s["labels"]
+                    key = m["name"] + ("" if not lb0 else "{" + ",".join(
+                        f"{k}={lb0[k]}" for k in sorted(lb0)) + "}")
+                    summ = summaries.get(key) or {}
+                    for pct, q in _QUANTILES:
+                        if summ.get(pct) is None:
+                            continue
+                        lb = dict(lb0)
+                        lb["quantile"] = q
+                        lines.append(
+                            f"{name}{_label_str(lb)} {_num(summ[pct])}")
     return "\n".join(lines) + "\n"
 
 
-def parse_prometheus(text):
+def parse_prometheus(text, skipped=None):
     """Invert to_prometheus: {(sample_name, frozenset(labels)): value}.
-    Covers exactly the subset to_prometheus emits (no exemplars/escapes
-    beyond its own) — the exporter round-trip contract, not a general
-    prometheus parser."""
+    Covers exactly the subset to_prometheus emits — including the
+    ``quantile=``-labelled summary samples the ``summaries=`` form adds
+    (no exemplars/escapes beyond its own) — the exporter round-trip
+    contract, not a general prometheus parser.
+
+    A non-comment line that is not a valid sample (e.g. a human-format
+    ``name{...}: {json}`` percentile digest from an older metrics dump)
+    is SKIPPED instead of raising; pass a list as ``skipped`` to collect
+    ``(line, reason)`` pairs — explicit skip-with-reason rather than a
+    silent drop or a ValueError crash mid-parse."""
     out = {}
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
         body, _, val = line.rpartition(" ")
+        try:
+            value = float("inf") if val == "+Inf" else float(val)
+        except ValueError:
+            if skipped is not None:
+                skipped.append((line, f"sample value {val!r} is not a "
+                                      "float — not exposition format"))
+            continue
         if "{" in body:
             name, _, rest = body.partition("{")
             rest = rest.rstrip("}")
@@ -91,8 +131,7 @@ def parse_prometheus(text):
                     lambda mt: {"n": "\n"}.get(mt.group(1), mt.group(1)), v)
         else:
             name, labels = body, {}
-        out[(name, frozenset(labels.items()))] = \
-            float("inf") if val == "+Inf" else float(val)
+        out[(name, frozenset(labels.items()))] = value
     return out
 
 

@@ -80,7 +80,10 @@ HIGH_IS_BAD = ("step_ms", "exec_ms", "sync_ms", "compile_ms",
 #: ``dispatch_fraction`` is deliberately in NEITHER list: the budget
 #: tests treat a HIGH fraction (host-bound step) as the failure, so it
 #: is recorded in rows but never sentinel-fired.
-LOW_IS_BAD = ("mfu", "tokens_per_s", "prefix_hit_rate", "accept_rate")
+LOW_IS_BAD = ("mfu", "tokens_per_s", "prefix_hit_rate", "accept_rate",
+              "goodput")   # run/goodput rows (monitor/goodput.py): a
+#                            goodput fraction BELOW its banked baseline
+#                            is the regression (ISSUE 20)
 
 
 def is_armed():

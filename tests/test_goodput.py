@@ -1,0 +1,335 @@
+"""Unit coverage for the goodput ledger + weight-version lineage
+(ISSUE 20): bucket exclusivity under nesting, run-row schema + ledger
+append through the direction-aware sentinel, WeightVersion monotonicity
+across checkpoint -> restore -> reshard -> hot_swap, pre-version
+checkpoints loading as v0, the stale-session counter firing exactly
+once per stale finish, and the exporters' histogram-percentile
+round-trip regression (metrics_dump output must parse losslessly or
+skip with a reason)."""
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import flags, monitor
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.distributed.spmd import SpmdTrainer
+from paddle_tpu.framework import lineage
+from paddle_tpu.monitor import goodput
+
+
+@pytest.fixture(autouse=True)
+def _clean_goodput():
+    goodput.reset()
+    yield
+    goodput.reset()
+
+
+class TestBucketAccounting:
+    def test_nesting_pauses_outer_and_buckets_sum_to_wall(self):
+        """A compile resolving inside a step books `compile`, not
+        `step` (exclusive attribution), and the bucket totals sum to
+        the run's wall time by construction."""
+        run = goodput.GoodputRun("t/nest", stall_threshold_s=10.0)
+        with run.bucket("step"):
+            time.sleep(0.03)
+            with run.bucket("compile"):
+                assert run.active() == "compile"
+                time.sleep(0.05)
+            assert run.active() == "step"
+            time.sleep(0.02)
+        row = run.finalize()
+        assert run.buckets["compile"] >= 0.05
+        assert run.buckets["step"] >= 0.04
+        # the nested 0.05s must NOT also be in step (< outer + slack)
+        assert run.buckets["step"] < 0.05 + 0.05
+        assert sum(run.buckets.values()) == pytest.approx(
+            row["wall_s"], rel=1e-6)
+
+    def test_gap_books_stall_past_threshold_other_under(self):
+        run = goodput.GoodputRun("t/gap", stall_threshold_s=0.04)
+        time.sleep(0.06)              # idle gap >= threshold
+        run.begin("step")
+        run.end("step")
+        time.sleep(0.01)              # idle gap < threshold
+        run.finalize()
+        assert run.buckets["stall"] >= 0.06
+        assert run.buckets["other"] > 0.0
+        assert run.buckets["other"] < 0.04
+
+    def test_unbalanced_end_is_no_op_and_unknown_bucket_raises(self):
+        run = goodput.GoodputRun("t/unbal", stall_threshold_s=10.0)
+        run.end("step")               # no matching begin: no-op
+        assert run.active() is None
+        with pytest.raises(ValueError):
+            run.begin("not_a_bucket")
+
+    def test_finalize_idempotent_and_last_bucket_survives_unwind(self):
+        """An exception unwinds the active bucket BEFORE a crash dump
+        lands — `last_bucket` keeps the "what was it doing" answer."""
+        run = goodput.GoodputRun("t/kill", stall_threshold_s=10.0)
+        with pytest.raises(RuntimeError):
+            with run.bucket("step"):
+                time.sleep(0.01)
+                raise RuntimeError("kill")
+        snap = run.snapshot()
+        assert snap["active_bucket"] is None
+        assert snap["last_bucket"] == "step"
+        r1 = run.finalize()
+        r2 = run.finalize()
+        assert r1["wall_s"] == r2["wall_s"]
+
+    def test_module_helpers_are_noops_without_a_run(self):
+        assert goodput.current_run() is None
+        with goodput.bucket("step"):
+            pass
+        goodput.count("resume")
+        assert goodput.end_run() is None
+
+
+class TestRunRowAndLedger:
+    def test_row_schema(self):
+        run = goodput.start_run("t/schema")
+        with goodput.bucket("step"):
+            time.sleep(0.01)
+        goodput.count("resume")
+        goodput.count("reshard", 2)
+        row = goodput.end_run()
+        assert set(row) == {"run_id", "goodput", "wall_s", "n_resumes",
+                            "n_reshards", "buckets"}
+        assert row["run_id"] == "t/schema"
+        assert row["n_resumes"] == 1 and row["n_reshards"] == 2
+        assert set(row["buckets"]) == set(goodput.BUCKETS)
+        assert 0.0 < row["goodput"] <= 1.0
+
+    def test_end_run_appends_ledger_row_through_sentinel(self, tmp_path):
+        """FLAGS_perf_ledger also armed: the finalized run lands one
+        site=run/goodput row keyed by its run_id, and `goodput` is
+        sentinel-directed LOW_IS_BAD."""
+        from paddle_tpu.monitor import perfledger
+
+        path = str(tmp_path / "perf.jsonl")
+        old = {k: flags.get_flag(k)
+               for k in ("perf_ledger", "perf_ledger_path",
+                         "perf_ledger_interval")}
+        paddle.set_flags({"perf_ledger": True, "perf_ledger_path": path,
+                          "perf_ledger_interval": 1})
+        perfledger.reset_ledger()
+        try:
+            goodput.start_run("t/ledger")
+            with goodput.bucket("step"):
+                time.sleep(0.01)
+            row = goodput.end_run()
+            rows = [r for r in perfledger.load_rows(path)
+                    if r.get("site") == "run/goodput"]
+            assert rows and rows[0]["sig"] == "t/ledger"
+            m = rows[0]["metrics"]
+            assert m["goodput"] == pytest.approx(row["goodput"])
+            assert m["buckets"]["step"] > 0.0
+            assert "goodput" in perfledger.LOW_IS_BAD
+        finally:
+            paddle.set_flags(old)
+            perfledger.reset_ledger()
+
+    def test_start_run_finalizes_unfinished_prior_leg(self):
+        first = goodput.start_run("t/leg1")
+        with goodput.bucket("step"):
+            time.sleep(0.005)
+        second = goodput.start_run("t/leg2")
+        assert first.finalized
+        assert goodput.current_run() is second
+        assert goodput.ensure_run("t/other") is second   # no clobber
+        goodput.end_run()
+
+
+def _tiny_trainer(n_dev=1):
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    net = nn.Linear(8, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    mesh = build_mesh((n_dev,), ("dp",), devices=jax.devices()[:n_dev])
+    return SpmdTrainer(net, opt, loss_fn=nn.MSELoss(), mesh=mesh)
+
+
+class TestWeightVersionLineage:
+    def test_bump_is_monotone_and_origin_checked(self):
+        v = lineage.WeightVersion("r", 0, "init")
+        seen = [v]
+        for origin in ("step", "restore", "reshard", "hot_swap",
+                       "adapter_load"):
+            seen.append(seen[-1].bump(origin))
+        counters = [x.counter for x in seen]
+        assert counters == sorted(counters) and len(set(counters)) == 6
+        with pytest.raises(ValueError):
+            v.bump("teleport")
+
+    def test_from_dict_malformed_is_v0(self):
+        v = lineage.WeightVersion.from_dict(None, run_id="r")
+        assert (v.counter, v.origin) == (0, "init")
+        v = lineage.WeightVersion.from_dict({"counter": "junk"},
+                                            run_id="r")
+        assert (v.counter, v.origin) == (0, "init")
+
+    def test_trainer_lineage_checkpoint_restore_reshard(self):
+        """counter strictly increases across step -> save -> restore
+        (origin `restore`) -> live resize (origin `reshard`); a restore
+        rejoins at max(live, loaded) + 1 so two lineages never share a
+        counter value."""
+        rng = np.random.RandomState(0)
+        x = rng.rand(4, 8).astype(np.float32)
+        y = rng.rand(4, 4).astype(np.float32)
+        old = {k: flags.get_flag(k)
+               for k in ("elastic", "shard_weight_update")}
+        # resize() is elastic-only and FLAGS_elastic is structural: it
+        # must be armed at trainer construction
+        paddle.set_flags({"elastic": True, "shard_weight_update": True})
+        try:
+            self._lineage_walk(x, y)
+        finally:
+            paddle.set_flags(old)
+
+    def _lineage_walk(self, x, y):
+        tr = _tiny_trainer(1)
+        history = [tr.weight_version.counter]
+        tr.train_step(x, y)
+        tr.train_step(x, y)
+        history.append(tr.weight_version.counter)
+        state = tr.state_dict()
+        saved = lineage.WeightVersion.from_dict(
+            state["__weight_version__"], run_id=tr.weight_version.run_id)
+        assert saved.counter == tr.weight_version.counter
+        tr.train_step(x, y)                       # live moves past saved
+        tr.set_state_dict(state)
+        history.append(tr.weight_version.counter)
+        assert tr.weight_version.origin == "restore"
+        tr.resize(build_mesh((2,), ("dp",), devices=jax.devices()[:2]))
+        history.append(tr.weight_version.counter)
+        assert tr.weight_version.origin == "reshard"
+        assert history == sorted(history)
+        assert len(set(history)) == len(history)  # strictly monotone
+
+    def test_pre_version_checkpoint_loads_as_v0(self):
+        """A checkpoint written before this PR has no __weight_version__
+        leaf: it loads as version 0 and the live trainer rejoins at
+        live+1 (handoff baseline covers the schema side)."""
+        tr = _tiny_trainer(1)
+        state = tr.state_dict()
+        state.pop("__weight_version__")
+        before = tr.weight_version.counter
+        tr.set_state_dict(state)
+        assert tr.weight_version.counter == before + 1
+        assert tr.weight_version.origin == "restore"
+
+
+class TestServingStaleSessions:
+    def _model(self):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_heads=2, max_seq_len=64, dropout=0.0)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        return m
+
+    def test_hot_swap_stamps_and_counts_stale_exactly_once(self):
+        """A session submitted pre-swap finishes carrying its pre-swap
+        stamp and counts ONE stale finish; a post-swap session carries
+        the bumped version and counts nothing. Same weights both sides,
+        so tokens are bit-exact across the swap."""
+        from paddle_tpu.inference.serving import ServingEngine
+
+        old = flags.get_flag("goodput")
+        paddle.set_flags({"goodput": True})
+        try:
+            m = self._model()
+            eng = ServingEngine(m, max_batch=2)
+            rng = np.random.RandomState(0)
+            prompt = rng.randint(0, 64, (6,)).astype(np.int32)
+
+            def stale_total():
+                flat = monitor.flatten(monitor.snapshot())
+                return flat.get("serving_stale_sessions_total", 0)
+
+            base = stale_total()
+            rid0 = eng.submit(prompt, max_new_tokens=4)
+            v1 = eng.hot_swap(m)      # same weights: outputs unchanged
+            assert v1.counter == 1 and v1.origin == "hot_swap"
+            res = eng.run_until_complete()
+            tok0 = res[rid0].tokens.tolist()
+            s0 = eng.get_request(rid0).stats()
+            assert s0["weight_version"].split(":")[1] == "0"
+            assert stale_total() == base + 1      # exactly once
+            rid1 = eng.submit(prompt, max_new_tokens=4)
+            res = eng.run_until_complete()
+            s1 = eng.get_request(rid1).stats()
+            assert s1["weight_version"].split(":")[1] == "1"
+            assert stale_total() == base + 1      # fresh finish: no inc
+            assert res[rid1].tokens.tolist() == tok0   # bit-exact
+            assert eng.stats()["weight_version"].split(":")[2] \
+                == "hot_swap"
+        finally:
+            paddle.set_flags({"goodput": old})
+
+    def test_hot_swap_rejects_mismatched_architecture(self):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        from paddle_tpu.inference.serving import ServingEngine
+
+        eng = ServingEngine(self._model(), max_batch=2)
+        paddle.seed(1)
+        other = GPTForCausalLM(GPTConfig(
+            vocab_size=64, hidden_size=16, num_layers=1, num_heads=2,
+            max_seq_len=64, dropout=0.0))
+        other.eval()
+        with pytest.raises(ValueError):
+            eng.hot_swap(other)
+
+
+class TestExporterRoundtrip:
+    def test_histogram_percentiles_roundtrip(self):
+        """The regression this PR fixes: metrics_dump --prometheus now
+        emits quantile-labelled samples for each histogram's digest,
+        and parse_prometheus reads them back instead of dropping (or
+        crashing on) percentile lines."""
+        from paddle_tpu.monitor import exporters
+
+        monitor.reset()
+        h = monitor.histogram("rt_ms", "roundtrip test",
+                              labelnames=("site",))
+        for v in (1.0, 2.0, 3.0, 10.0):
+            h.labels(site="a").observe(v)
+        snap = monitor.snapshot()
+        summ = {"rt_ms{site=a}": {"p50": 2.0, "p90": 3.0, "p99": 10.0}}
+        text = exporters.to_prometheus(snap, summaries=summ)
+        assert 'rt_ms{quantile="0.5",site="a"} 2' in text
+        parsed = exporters.parse_prometheus(text)
+        key = ("rt_ms", frozenset({("site", "a"),
+                                   ("quantile", "0.99")}.__iter__()))
+        assert parsed[key] == 10.0
+        # default form stays byte-identical to the historical output
+        assert exporters.to_prometheus(snap) == \
+            exporters.to_prometheus(snap, summaries=None)
+
+    def test_non_exposition_line_skips_with_reason(self):
+        from paddle_tpu.monitor import exporters
+
+        text = ('good_total 3\n'
+                'rt_ms{site="a"}: {"p50": 2.0, "p90": 3.0}\n')
+        skipped = []
+        parsed = exporters.parse_prometheus(text, skipped=skipped)
+        assert parsed[("good_total", frozenset())] == 3.0
+        assert len(skipped) == 1
+        line, reason = skipped[0]
+        assert line.startswith("rt_ms") and "not a float" in reason

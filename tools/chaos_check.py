@@ -70,6 +70,14 @@ Checks (one entry per name in `passes`):
                      attributed (blackbox crash bundle at
                      site=elastic/resume + elastic_resume_total
                      {reason=failpoint})
+  goodput_attribution the elastic_resume kill re-run under FLAGS_goodput:
+                     the finalized run's ledger row books nonzero
+                     resume_backoff + ckpt_restore + reshard seconds,
+                     its buckets sum to wall time within 10%, its
+                     goodput lands below an uninterrupted twin's (which
+                     books >= 95% of post-warmup wall as step+compile),
+                     and the crash bundle's goodput provider names the
+                     bucket active at kill time (step)
   stage_replace      one stage of a FLAGS_mpmd 2-stage pipeline is
                      killed via the stage/run failpoint; replace_stage
                      rebinds JUST that stage onto a replacement mesh
@@ -106,7 +114,7 @@ PASSES = ["ckpt_atomic", "ckpt_fallback", "serving_deadline",
           "stall_dump", "stage_backpressure", "trainer_nonfinite",
           "numerics_anomaly", "quantized_nonfinite", "async_nonfinite",
           "adapter_evict_under_load", "page_pool_full",
-          "elastic_resume", "stage_replace"]
+          "elastic_resume", "stage_replace", "goodput_attribution"]
 
 
 def _finding(name, severity, message, where=""):
@@ -1155,6 +1163,196 @@ def _check_elastic_resume():
                 "elastic_resume_total attribute the recovery")]
 
 
+def _check_goodput_attribution():
+    """Chaos-injected preemption under the goodput ledger: the dp8 kill +
+    dp4 resume of elastic_resume re-run with FLAGS_goodput armed. The
+    finalized run's ledger row must book NONZERO resume_backoff +
+    ckpt_restore + reshard seconds, its buckets must sum to the run's
+    wall time within 10% (exclusive attribution), its goodput must land
+    BELOW an uninterrupted twin's, the twin must book >= 95% of its
+    post-warmup wall as step+compile, and the recovery's crash bundle
+    must carry the goodput provider naming the bucket active at kill
+    time."""
+    import glob
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import flags, monitor
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.monitor import blackbox as bb
+    from paddle_tpu.monitor import goodput, perfledger
+    from paddle_tpu.testing import failpoints as fp
+
+    name = "goodput_attribution"
+    old = {k: flags.get_flag(k)
+           for k in ("goodput", "elastic", "shard_weight_update",
+                     "blackbox_dir", "perf_ledger", "perf_ledger_path",
+                     "perf_ledger_warmup", "perf_ledger_interval")}
+    tmp_ctx = tempfile.TemporaryDirectory(prefix="paddle_tpu_chaos_goodput_")
+    ledger_path = os.path.join(tmp_ctx.name, "perf.jsonl")
+    was_enabled = bb.is_enabled()
+    bb.enable(install=False)
+    paddle.set_flags({"goodput": True, "elastic": True,
+                      "shard_weight_update": True,
+                      "blackbox_dir": os.path.join(tmp_ctx.name, "bb"),
+                      "perf_ledger": True,
+                      "perf_ledger_path": ledger_path,
+                      "perf_ledger_warmup": 1, "perf_ledger_interval": 1})
+    perfledger.reset_ledger()
+    goodput.reset()
+    try:
+        class MLP(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.l1 = paddle.nn.Linear(64, 64)
+                self.l2 = paddle.nn.Linear(64, 1)
+
+            def forward(self, x):
+                return self.l2(paddle.nn.functional.relu(self.l1(x)))
+
+        def build(mesh):
+            paddle.seed(0)
+            m = MLP()
+            opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                         parameters=m.parameters())
+            return SpmdTrainer(
+                m, opt, loss_fn=lambda p, y: ((p - y) ** 2).mean(),
+                mesh=mesh)
+
+        rng = np.random.RandomState(0)
+        data = [(rng.randn(8, 64).astype(np.float32),
+                 rng.randn(8, 1).astype(np.float32)) for _ in range(6)]
+
+        # uninterrupted dp8 twin, post-warmup: one step outside its run
+        # absorbs trainer build + first compile, the accounted window is
+        # pure steady-state stepping
+        twin = build(build_mesh((8,), ("dp",), devices=jax.devices()[:8]))
+        twin.train_step(*data[0])
+        goodput.start_run("chaos/goodput-twin")
+        for x, y in data[1:]:
+            twin.train_step(x, y)
+        twin_row = goodput.end_run()
+        productive = (twin_row["buckets"]["step"]
+                      + twin_row["buckets"]["compile"])
+        if productive < 0.95 * twin_row["wall_s"]:
+            return [_finding(
+                name, "error",
+                f"uninterrupted twin booked only {productive:.3f}s of "
+                f"{twin_row['wall_s']:.3f}s post-warmup wall as "
+                "step+compile (< 95%)")]
+
+        from paddle_tpu.distributed.elastic import ElasticSupervisor
+        from paddle_tpu.incubate.checkpoint.auto_checkpoint import \
+            CheckpointSaver
+
+        alive = {"dp8": True}
+
+        def dp8():
+            return build_mesh((8,), ("dp",), devices=jax.devices()[:8]) \
+                if alive["dp8"] else None
+
+        def dp4():
+            return build_mesh((4,), ("dp",), devices=jax.devices()[:4])
+
+        class KillAt(list):
+            def __init__(self, items, at):
+                super().__init__(items)
+                self.at, self.fired = at, False
+
+            def __getitem__(self, i):
+                if i == self.at and not self.fired:
+                    self.fired = True
+                    alive["dp8"] = False
+                    fp.arm("trainer/step", "error:1")
+                return super().__getitem__(i)
+
+        goodput.start_run("chaos/goodput")
+        sup = ElasticSupervisor(
+            build, CheckpointSaver(os.path.join(tmp_ctx.name, "ckpt")),
+            [dp8, dp4], checkpoint_interval=1)
+        sup.run(KillAt(data, 3))
+        row = goodput.end_run()
+        if not sup.recoveries:
+            return [_finding(name, "error",
+                             "the killed step produced no recovery")]
+        if int(sup.trainer.mesh.shape["dp"]) != 4:
+            return [_finding(name, "error",
+                             "supervisor did not resume on the shrunken "
+                             "dp4 mesh")]
+        # the recovery legs must be attributed, not lumped into step/other
+        zero = [b for b in ("resume_backoff", "ckpt_restore", "reshard")
+                if not row["buckets"].get(b, 0.0) > 0.0]
+        if zero:
+            return [_finding(
+                name, "error",
+                f"killed+resumed run booked no seconds in {zero} — "
+                f"buckets: { {k: round(v, 4) for k, v in row['buckets'].items()} }")]
+        booked = sum(row["buckets"].values())
+        if abs(booked - row["wall_s"]) > 0.1 * row["wall_s"]:
+            return [_finding(
+                name, "error",
+                f"buckets sum to {booked:.3f}s but the run walled "
+                f"{row['wall_s']:.3f}s — outside the 10% band")]
+        if not row["goodput"] < twin_row["goodput"]:
+            return [_finding(
+                name, "error",
+                f"interrupted run's goodput {row['goodput']:.3f} is not "
+                f"below the uninterrupted twin's "
+                f"{twin_row['goodput']:.3f}")]
+        # the ledger row landed at site=run/goodput with the breakdown
+        rows = perfledger.load_rows(ledger_path)
+        grows = [r for r in rows if r.get("site") == "run/goodput"
+                 and r.get("sig") == "chaos/goodput"]
+        if not grows:
+            return [_finding(name, "error",
+                             "finalized run appended no run/goodput "
+                             "perf-ledger row")]
+        # the crash bundle's goodput provider names the kill-time bucket
+        bundles = sorted(glob.glob(os.path.join(
+            tmp_ctx.name, "bb", "blackbox-*.json")))
+        if not bundles:
+            return [_finding(name, "error",
+                             "recovery wrote no blackbox crash bundle")]
+        bundle = bb.load_bundle(bundles[0])
+        tables = [p for p in bundle.get("requests", [])
+                  if p.get("kind") == "goodput"]
+        if not tables:
+            return [_finding(name, "error",
+                             "crash bundle carries no goodput provider "
+                             "table")]
+        gp = tables[0].get("table", {})
+        at_kill = gp.get("active_bucket") or gp.get("last_bucket")
+        if at_kill != "step":
+            return [_finding(
+                name, "error",
+                f"crash bundle's goodput table names {at_kill!r} at kill "
+                "time, expected 'step' (the failpoint fired mid-step)")]
+        if not gp.get("buckets", {}).get("step", 0.0) > 0.0:
+            return [_finding(name, "error",
+                             "crash bundle's goodput breakdown books no "
+                             "step seconds before the kill")]
+    finally:
+        fp.reset()
+        paddle.set_flags(old)
+        perfledger.reset_ledger()
+        goodput.reset()
+        bb.quiesce()
+        bb.reset()
+        if not was_enabled:
+            bb.disable()
+        tmp_ctx.cleanup()
+    return [_ok(name,
+                f"killed dp8 run booked its recovery "
+                f"(resume_backoff={row['buckets']['resume_backoff']:.3f}s,"
+                f" ckpt_restore={row['buckets']['ckpt_restore']:.3f}s, "
+                f"reshard={row['buckets']['reshard']:.3f}s; buckets sum "
+                f"within 10% of {row['wall_s']:.3f}s wall); goodput "
+                f"{row['goodput']:.3f} < twin {twin_row['goodput']:.3f}, "
+                "crash bundle names bucket 'step' at kill time")]
+
+
 def _check_stage_replace():
     """Chaos-injected stage death: kill one stage of a FLAGS_mpmd
     2-stage pipeline via stage/run, rebind JUST that stage onto a
@@ -1284,6 +1482,7 @@ def build_report(only=None):
         ("async_nonfinite", _check_async_nonfinite),
         ("elastic_resume", _check_elastic_resume),
         ("stage_replace", _check_stage_replace),
+        ("goodput_attribution", _check_goodput_attribution),
     ]
     if selected & {"serving_deadline", "serving_slot_error",
                    "serving_shed", "router_failover", "stall_dump",

@@ -10,6 +10,7 @@
     python tools/metrics_dump.py --mpmd                   # stage-graph pipeline
     python tools/metrics_dump.py --ledger                 # perf ledger + sentinel
     python tools/metrics_dump.py --paged                  # paged KV + multi-LoRA
+    python tools/metrics_dump.py --goodput                # goodput ledger + lineage
     python tools/metrics_dump.py --model bert --prometheus
     python tools/metrics_dump.py --all --json             # machine-readable
     python tools/metrics_dump.py --serving --trace        # + span summary
@@ -98,6 +99,13 @@ _REQUIRED = {
     # recovery-cost ledger row at site elastic/resume
     "elastic": ("elastic_resume_total", "checkpoint_reshard_total",
                 "perf_ledger_rows_total", "step_latency_ms"),
+    # the goodput ledger (docs/OBSERVABILITY.md "Goodput ledger"): a
+    # supervised run with FLAGS_goodput armed, killed once mid-step, must
+    # book the recovery into the exclusive buckets and finalize the
+    # fraction gauge; the serving leg's engine publishes its
+    # weight-version lineage gauge
+    "goodput": ("goodput_seconds_total", "goodput_fraction",
+                "serving_weight_version", "perf_ledger_rows_total"),
 }
 
 #: (family, label, value) series that must exist in a target's snapshot,
@@ -121,6 +129,15 @@ _REQUIRED_SERIES = {
     "elastic": (("elastic_resume_total", "reason", "failpoint"),
                 ("checkpoint_reshard_total", "action", "moment_reshard"),
                 ("perf_ledger_rows_total", "site", "elastic/resume")),
+    # per-bucket attribution: the killed+resumed run must book productive
+    # steps, checkpoint traffic both ways, the recovery leg, and the
+    # dp2->dp1 cross-topology restore — plus the per-run ledger row
+    "goodput": (("goodput_seconds_total", "bucket", "step"),
+                ("goodput_seconds_total", "bucket", "ckpt_save"),
+                ("goodput_seconds_total", "bucket", "ckpt_restore"),
+                ("goodput_seconds_total", "bucket", "resume_backoff"),
+                ("goodput_seconds_total", "bucket", "reshard"),
+                ("perf_ledger_rows_total", "site", "run/goodput")),
 }
 
 _DIMS = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
@@ -613,6 +630,161 @@ def run_elastic_loop(steps=5, kill_at=2):
             pass
 
 
+def run_goodput_loop(steps=5, kill_at=2, new_tokens=3):
+    """The goodput-ledger target (docs/OBSERVABILITY.md "Goodput
+    ledger"): the elastic dp2->dp1 kill-and-resume loop re-run with
+    FLAGS_goodput armed — every wall-second of the supervised run books
+    into an exclusive bucket (productive ``step``, checkpoint traffic,
+    the ``resume_backoff`` recovery leg, the cross-topology ``reshard``
+    restore), ``end_run()`` finalizes the fraction gauge and appends the
+    ``site=run/goodput`` perf-ledger row — then a tiny ServingEngine
+    serves one completion across a same-weights ``hot_swap()``, moving
+    the ``serving_weight_version`` lineage gauge and the stale-session
+    counter."""
+    import os as _os
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import flags
+    from paddle_tpu.distributed.elastic import ElasticSupervisor
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.spmd import SpmdTrainer
+    from paddle_tpu.incubate.checkpoint.auto_checkpoint import \
+        CheckpointSaver
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.monitor import goodput, perfledger
+    from paddle_tpu.testing import failpoints
+
+    old = {k: flags.get_flag(k)
+           for k in ("goodput", "elastic", "shard_weight_update",
+                     "perf_ledger", "perf_ledger_path",
+                     "perf_ledger_warmup", "perf_ledger_interval")}
+    fd, path = tempfile.mkstemp(suffix=".jsonl",
+                                prefix="paddle_tpu_goodput_")
+    _os.close(fd)
+    ckpt_dir = tempfile.mkdtemp(prefix="paddle_tpu_goodput_ckpt_")
+    paddle.set_flags({"goodput": True, "elastic": True,
+                      "shard_weight_update": True,
+                      "perf_ledger": True, "perf_ledger_path": path,
+                      "perf_ledger_warmup": 1, "perf_ledger_interval": 1})
+    perfledger.reset_ledger()
+    goodput.reset()
+    try:
+        class MLP(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.l1 = paddle.nn.Linear(64, 64)
+                self.l2 = paddle.nn.Linear(64, 1)
+
+            def forward(self, x):
+                return self.l2(paddle.nn.functional.relu(self.l1(x)))
+
+        def build(mesh):
+            paddle.seed(0)
+            m = MLP()
+            opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                         parameters=m.parameters())
+            return SpmdTrainer(
+                m, opt, loss_fn=lambda p, y: ((p - y) ** 2).mean(),
+                mesh=mesh)
+
+        alive = {"dp2": True}
+
+        def dp2():
+            return build_mesh((2,), ("dp",),
+                              devices=jax.devices()[:2]) \
+                if alive["dp2"] else None
+
+        def dp1():
+            return build_mesh((1,), ("dp",), devices=jax.devices()[:1])
+
+        rng = np.random.RandomState(0)
+        data = [(rng.randn(8, 64).astype(np.float32),
+                 rng.randn(8, 1).astype(np.float32))
+                for _ in range(steps)]
+
+        class KillAt(list):
+            """Arms the kill from inside the batch lookup, so the
+            failpoint fires on exactly the requested step."""
+
+            def __init__(self, items, at):
+                super().__init__(items)
+                self.at, self.fired = at, False
+
+            def __getitem__(self, i):
+                if i == self.at and not self.fired:
+                    self.fired = True
+                    alive["dp2"] = False
+                    failpoints.arm("trainer/step", "error:1")
+                return super().__getitem__(i)
+
+        goodput.start_run("metrics_dump/goodput")
+        sup = ElasticSupervisor(build, CheckpointSaver(ckpt_dir),
+                                [dp2, dp1], checkpoint_interval=1)
+        sup.run(KillAt(data, kill_at))
+        row = goodput.end_run()
+        if row is None:
+            raise RuntimeError("no goodput run was open at end_run()")
+        for b in ("step", "ckpt_save", "ckpt_restore", "resume_backoff",
+                  "reshard"):
+            if not row["buckets"].get(b, 0.0) > 0.0:
+                raise RuntimeError(
+                    f"killed+resumed run booked no {b!r} seconds: "
+                    f"{row['buckets']}")
+        booked = sum(row["buckets"].values())
+        if abs(booked - row["wall_s"]) > 0.1 * row["wall_s"]:
+            raise RuntimeError(
+                f"buckets sum to {booked:.3f}s but the run walled "
+                f"{row['wall_s']:.3f}s — exclusive attribution leaked")
+        rows = perfledger.load_rows(path)
+        if not any(r.get("site") == "run/goodput" for r in rows):
+            raise RuntimeError("finalized run appended no run/goodput "
+                               "perf-ledger row")
+
+        # serving lineage leg: one completion finishes under the swapped
+        # engine's OLD version (stale), the next under the bumped one
+        paddle.seed(0)
+        model = GPTForCausalLM(GPTConfig(max_seq_len=64, **_DIMS))
+        model.eval()
+        eng = ServingEngine(model, max_batch=2)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 256, (8,)).astype(np.int32)
+        rid0 = eng.submit(ids, max_new_tokens=new_tokens)
+        v1 = eng.hot_swap(model)   # same weights: outputs bit-identical
+        if v1.counter != 1 or v1.origin != "hot_swap":
+            raise RuntimeError(f"hot_swap minted {v1} — expected "
+                               "counter 1, origin hot_swap")
+        eng.run_until_complete()
+        rid1 = eng.submit(ids, max_new_tokens=new_tokens)
+        eng.run_until_complete()
+        s0 = eng.get_request(rid0).stats()
+        s1 = eng.get_request(rid1).stats()
+        if s0.get("weight_version", "").split(":")[1:2] != ["0"]:
+            raise RuntimeError(f"pre-swap completion carries "
+                               f"{s0.get('weight_version')!r}, not v0")
+        if s1.get("weight_version", "").split(":")[1:2] != ["1"]:
+            raise RuntimeError(f"post-swap completion carries "
+                               f"{s1.get('weight_version')!r}, not v1")
+        return {"run": row, "ledger_sites":
+                sorted({r.get("site") for r in rows}),
+                "serving_versions": [s0.get("weight_version"),
+                                     s1.get("weight_version")]}
+    finally:
+        failpoints.reset()
+        paddle.set_flags(old)
+        perfledger.reset_ledger()
+        goodput.reset()
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        try:
+            _os.unlink(path)
+        except OSError:
+            pass
+
+
 def run_paged_loop(new_tokens=4):
     """The paged-KV target: an armed (FLAGS_paged_kv) 2-adapter engine —
     a registered shared prefix whose length straddles a block boundary
@@ -751,7 +923,7 @@ def run_target(name, with_trace=False):
     trace_summary = None
     kind = (name if name in ("serving", "router", "blackbox", "federated",
                              "numerics", "quantized", "async", "mpmd",
-                             "ledger", "paged", "elastic")
+                             "ledger", "paged", "elastic", "goodput")
             else "train")
     if with_trace:
         trace.clear()
@@ -779,6 +951,8 @@ def run_target(name, with_trace=False):
             run_paged_loop()
         elif kind == "elastic":
             run_elastic_loop()
+        elif kind == "goodput":
+            run_goodput_loop()
         else:
             run_train_step(name)
     finally:
@@ -899,11 +1073,19 @@ def main(argv=None):
                          "{reason=failpoint}, checkpoint_reshard_total"
                          "{action=moment_reshard} and the elastic/resume "
                          "perf-ledger row are present")
+    ap.add_argument("--goodput", action="store_true", dest="goodput",
+                    help="run the goodput-ledger target (the elastic "
+                         "kill-and-resume loop with FLAGS_goodput armed, "
+                         "plus one served completion across a hot_swap); "
+                         "exit 1 unless goodput_seconds_total{bucket=...}"
+                         " per attribution bucket, goodput_fraction, "
+                         "serving_weight_version and the run/goodput "
+                         "perf-ledger row are present")
     ap.add_argument("--all", action="store_true",
                     help="all models + the serving loop + the router, "
                          "flight-recorder, federated, numerics, "
-                         "quantized, async, mpmd, perf-ledger, paged-KV "
-                         "and elastic tiers")
+                         "quantized, async, mpmd, perf-ledger, paged-KV, "
+                         "elastic and goodput tiers")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the graph_lint-schema machine report")
     ap.add_argument("--prometheus", action="store_true",
@@ -936,16 +1118,19 @@ def main(argv=None):
         targets.append("paged")
     if args.elastic:
         targets.append("elastic")
+    if args.goodput:
+        targets.append("goodput")
     if args.all:
         targets = list(MODEL_TARGETS) + ["serving", "router", "blackbox",
                                          "federated", "numerics",
                                          "quantized", "async", "mpmd",
-                                         "ledger", "paged", "elastic"]
+                                         "ledger", "paged", "elastic",
+                                         "goodput"]
     if not targets:
         ap.error("pick a target: --model NAME, --serving, --router, "
                  "--blackbox, --federated, --numerics, --quantized, "
-                 "--async, --mpmd, --ledger, --paged, --elastic or "
-                 "--all")
+                 "--async, --mpmd, --ledger, --paged, --elastic, "
+                 "--goodput or --all")
 
     report = build_report(targets, with_trace=args.with_trace)
     if args.as_json:
@@ -955,7 +1140,11 @@ def main(argv=None):
 
         for name, t in report["targets"].items():
             print(f"# target: {name}")
-            print(to_prometheus(t["snapshot"]))
+            # summaries= folds the p50/p90/p99 digests in as standard
+            # quantile samples, so parse_prometheus round-trips the
+            # percentiles instead of dropping them
+            print(to_prometheus(t["snapshot"],
+                                summaries=t.get("histograms")))
     else:
         for name, t in report["targets"].items():
             print(f"# target: {name}")

@@ -21,6 +21,11 @@
                                                         # the table)
     python tools/perf_report.py --explain --path perf.jsonl   # what the
                                                         # ledger knows
+    python tools/perf_report.py --goodput --path perf.jsonl   # the last
+                                                        # run/goodput row's
+                                                        # bucket table
+                                                        # (FLAGS_goodput
+                                                        # runs append them)
     python tools/perf_report.py --check --path perf.jsonl --json
 
 The ledger (monitor/perfledger.py, FLAGS_perf_ledger) is the persistent
@@ -278,6 +283,39 @@ def run_explain(path):
     return findings
 
 
+def run_goodput(path):
+    """The last ``site=run/goodput`` row's bucket table: where every
+    wall-second of the most recent FLAGS_goodput-accounted run went
+    (monitor/goodput.py appends one row per finalized run)."""
+    from paddle_tpu.monitor import perfledger as pl
+
+    rows = [r for r in pl.load_rows(path)
+            if r.get("site") == "run/goodput"]
+    if not rows:
+        return [_finding(
+            "perf-ledger-empty", "error",
+            f"no run/goodput rows in {path!r} — finalize a FLAGS_goodput "
+            "run (or tools/metrics_dump.py --goodput) first",
+            where=path)]
+    row = rows[-1]
+    m = row.get("metrics") or {}
+    buckets = m.get("buckets") or {}
+    wall = float(m.get("wall_s", 0.0)) or 1.0
+    findings = [_finding(
+        "goodput", "info",
+        f"run {row.get('sig')}: goodput {float(m.get('goodput', 0.0)):.3f}"
+        f" over {float(m.get('wall_s', 0.0)):.3f}s wall "
+        f"({int(m.get('n_resumes', 0))} resume(s), "
+        f"{int(m.get('n_reshards', 0))} reshard(s); "
+        f"{len(rows)} run/goodput row(s) total)", where=path)]
+    for b, secs in sorted(buckets.items(), key=lambda kv: -kv[1]):
+        findings.append(_finding(
+            "goodput", "info",
+            f"{b:<14} {float(secs):8.3f}s  {100.0 * float(secs) / wall:5.1f}%",
+            where=f"run/goodput/{b}"))
+    return findings
+
+
 def build_report(ops, path, steps=8, sigma=None, inject=None, out=None):
     """graph_lint-schema report over the requested operations."""
     from paddle_tpu.analysis import calibrate
@@ -297,6 +335,8 @@ def build_report(ops, path, steps=8, sigma=None, inject=None, out=None):
             findings, table = run_calibrate(path, out=out)
             if table is not None:
                 report["calibration"] = table
+        elif op == "goodput":
+            findings = run_goodput(path)
         else:
             findings = run_explain(path)
         counts = {"error": 0, "warning": 0, "info": 0}
@@ -327,6 +367,10 @@ def main(argv=None):
     ap.add_argument("--explain", action="store_true",
                     help="row counts, env groups and the baselines a "
                          "--check would enforce")
+    ap.add_argument("--goodput", action="store_true",
+                    help="print the last run/goodput row's bucket table "
+                         "(where every wall-second of the most recent "
+                         "accounted run went)")
     ap.add_argument("--out", default=None, metavar="TABLE",
                     help="where --calibrate writes the constants table "
                          "(plan_search --calibrated reads it)")
@@ -345,10 +389,11 @@ def main(argv=None):
 
     ops = [op for op, on in (("record", args.record), ("check", args.check),
                              ("calibrate", args.calibrate),
-                             ("explain", args.explain)) if on]
+                             ("explain", args.explain),
+                             ("goodput", args.goodput)) if on]
     if not ops:
-        ap.error("pick an operation: --record, --check, --calibrate "
-                 "and/or --explain")
+        ap.error("pick an operation: --record, --check, --calibrate, "
+                 "--explain and/or --goodput")
     path = args.path or flags.get_flag("perf_ledger_path", "")
     if not path:
         ap.error("no ledger path: pass --path or set "
